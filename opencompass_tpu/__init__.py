@@ -1,0 +1,10 @@
+"""opencompass_tpu — a TPU-native LLM evaluation framework.
+
+Capability target: the OpenCompass evaluation platform (see SURVEY.md), rebuilt
+TPU-first — JAX/XLA/pjit execution over sharded device meshes instead of
+torch/CUDA, with the same config → partition → infer → eval → summarize
+pipeline and file-keyed resumability.
+"""
+__version__ = '0.1.0'
+
+from .config import Config, ConfigDict, read_base  # noqa

@@ -1,0 +1,240 @@
+"""Python-file config system.
+
+Configs are plain ``.py`` files whose top-level variables become the config.
+Files compose through ``with read_base():`` blocks containing relative imports
+that are resolved against the config file's own path (not sys.path), e.g.::
+
+    from .datasets.mmlu.mmlu_gen import mmlu_datasets
+    with read_base():
+        from ..models.llama_7b import models
+
+Components are expressed as ``dict(type=Class | 'Name', ...)`` and built via
+:mod:`opencompass_tpu.registry`.
+
+This replaces the reference's mmengine ``Config.fromfile`` + ``read_base``
+(reference run.py:142, configs/eval_internlm_7b.py:1-8) with a dependency-free
+implementation.  ``Config.dump`` serializes back to a Python file — the
+cross-process handoff format used by runners (reference runners/local.py:113-116).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+
+@contextmanager
+def read_base():
+    """Marker context manager for config-composition import blocks.
+
+    Never executed at config-load time (the loader intercepts the block); the
+    no-op body lets config files still be imported as normal Python modules.
+    """
+    yield
+
+
+class ConfigDict(dict):
+    """Dict with attribute access; nested dicts are wrapped on the way in."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+        for src in (*args, kwargs):
+            if src:
+                for k, v in dict(src).items():
+                    self[k] = v
+
+    @staticmethod
+    def _wrap(value):
+        if isinstance(value, ConfigDict):
+            return value
+        if isinstance(value, dict):
+            return ConfigDict(value)
+        if isinstance(value, (list, tuple)):
+            return type(value)(ConfigDict._wrap(v) for v in value)
+        return value
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, self._wrap(value))
+
+    def __getattr__(self, name):
+        try:
+            return self[name]
+        except KeyError:
+            raise AttributeError(
+                f"'ConfigDict' object has no attribute '{name}'")
+
+    def __setattr__(self, name, value):
+        self[name] = value
+
+    def __delattr__(self, name):
+        try:
+            del self[name]
+        except KeyError:
+            raise AttributeError(name)
+
+    def setdefault(self, key, default=None):
+        if key not in self:
+            self[key] = default
+        return self[key]
+
+    def copy(self) -> 'ConfigDict':
+        return ConfigDict(self)
+
+    def to_dict(self) -> Dict[str, Any]:
+        def unwrap(v):
+            if isinstance(v, dict):
+                return {k: unwrap(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return type(v)(unwrap(x) for x in v)
+            return v
+
+        return unwrap(self)
+
+
+def _is_read_base_block(node: ast.stmt) -> bool:
+    if not isinstance(node, ast.With) or len(node.items) != 1:
+        return False
+    expr = node.items[0].context_expr
+    if not isinstance(expr, ast.Call):
+        return False
+    func = expr.func
+    name = func.id if isinstance(func, ast.Name) else getattr(func, 'attr', '')
+    return name == 'read_base'
+
+
+def _resolve_relative(filename: str, level: int, module: Optional[str]) -> str:
+    """Map a relative import inside ``read_base`` to a config file path."""
+    base = os.path.dirname(os.path.abspath(filename))
+    for _ in range(level - 1):
+        base = os.path.dirname(base)
+    parts = (module or '').split('.') if module else []
+    path = os.path.join(base, *parts) + '.py'
+    if not os.path.isfile(path):
+        # 'from .models import llama' style: module is a package dir and the
+        # imported names are files inside it.
+        pkg = os.path.join(base, *parts)
+        if os.path.isdir(pkg):
+            return pkg
+        raise FileNotFoundError(
+            f'read_base import in {filename}: no config file {path}')
+    return path
+
+
+class Config(ConfigDict):
+    """A loaded config file."""
+
+    @staticmethod
+    def fromfile(filename: str) -> 'Config':
+        filename = os.path.abspath(os.path.expanduser(filename))
+        ns = Config._exec_config_file(filename)
+        public = {
+            k: v
+            for k, v in ns.items()
+            if not k.startswith('_') and not callable(v)
+            and not isinstance(v, type(os))  # drop imported modules
+        }
+        cfg = Config(public)
+        cfg.__dict__['_filename'] = filename
+        return cfg
+
+    @property
+    def filename(self) -> Optional[str]:
+        return self.__dict__.get('_filename')
+
+    @staticmethod
+    def _exec_config_file(filename: str) -> Dict[str, Any]:
+        with open(filename, encoding='utf-8') as f:
+            source = f.read()
+        tree = ast.parse(source, filename=filename)
+        ns: Dict[str, Any] = {
+            '__file__': filename,
+            'read_base': read_base,
+        }
+        for node in tree.body:
+            if _is_read_base_block(node):
+                for stmt in node.body:
+                    if not isinstance(stmt, ast.ImportFrom):
+                        raise SyntaxError(
+                            f'{filename}: only "from ... import ..." is '
+                            'allowed inside read_base()')
+                    Config._exec_base_import(filename, stmt, ns)
+            else:
+                code = compile(
+                    ast.Module(body=[node], type_ignores=[]), filename, 'exec')
+                exec(code, ns)
+        return ns
+
+    @staticmethod
+    def _exec_base_import(filename: str, stmt: ast.ImportFrom,
+                          ns: Dict[str, Any]):
+        target = _resolve_relative(filename, stmt.level or 1, stmt.module)
+        if os.path.isdir(target):
+            # Importing files from a package dir: each name is a file.
+            for alias in stmt.names:
+                sub = os.path.join(target, alias.name + '.py')
+                sub_ns = Config._exec_config_file(sub)
+                ns[alias.asname or alias.name] = ConfigDict({
+                    k: v
+                    for k, v in sub_ns.items() if not k.startswith('_')
+                })
+            return
+        base_ns = Config._exec_config_file(target)
+        for alias in stmt.names:
+            if alias.name == '*':
+                for k, v in base_ns.items():
+                    if not k.startswith('_') and k != 'read_base':
+                        ns[k] = v
+                continue
+            if alias.name not in base_ns:
+                raise ImportError(
+                    f'{target} has no config variable {alias.name!r} '
+                    f'(imported from {filename})')
+            ns[alias.asname or alias.name] = base_ns[alias.name]
+
+    # -- serialization ----------------------------------------------------
+    def dump(self, path: str):
+        """Write the config as an executable Python file.
+
+        Class references become dotted-path strings, which the registries
+        resolve at build time — the dumped file round-trips through
+        :meth:`fromfile` (the reference relies on the same dump/reload cycle
+        to guarantee a serializable config: reference run.py:169-175).
+        """
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        lines = []
+        for key, value in self.items():
+            lines.append(f'{key} = {_pyrepr(value)}')
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write('\n'.join(lines) + '\n')
+
+    def merge_from_dict(self, options: Dict[str, Any]):
+        """Set possibly-dotted keys, e.g. ``{'infer.runner.max_num_workers': 4}``."""
+        for key, value in options.items():
+            node = self
+            parts = key.split('.')
+            for part in parts[:-1]:
+                node = node.setdefault(part, {})
+            node[parts[-1]] = value
+
+
+def _pyrepr(value: Any, indent: int = 0) -> str:
+    pad = '    ' * (indent + 1)
+    end_pad = '    ' * indent
+    if isinstance(value, dict):
+        if not value:
+            return '{}'
+        items = ',\n'.join(f'{pad}{_pyrepr(k)}: {_pyrepr(v, indent + 1)}'
+                           for k, v in value.items())
+        return '{\n' + items + f'\n{end_pad}}}'
+    if isinstance(value, (list, tuple)):
+        if not value:
+            return repr(value)
+        items = ',\n'.join(f'{pad}{_pyrepr(v, indent + 1)}' for v in value)
+        open_, close = ('[', ']') if isinstance(value, list) else ('(', ')')
+        return open_ + '\n' + items + f'\n{end_pad}' + close
+    if isinstance(value, type):
+        return repr(f'{value.__module__}.{value.__qualname__}')
+    if callable(value) and hasattr(value, '__module__'):
+        return repr(f'{value.__module__}.{value.__qualname__}')
+    return repr(value)

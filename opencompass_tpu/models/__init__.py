@@ -1,0 +1,8 @@
+from .base import BaseModel, LMTemplateParser  # noqa
+from .base_api import APITemplateParser, BaseAPIModel, TokenBucket  # noqa
+from .fake import FakeModel  # noqa
+
+__all__ = [
+    'BaseModel', 'LMTemplateParser', 'APITemplateParser', 'BaseAPIModel',
+    'TokenBucket', 'FakeModel'
+]

@@ -1,0 +1,278 @@
+"""Model abstraction + meta-template parsing.
+
+A model exposes three measurement primitives consumed by the inferencers:
+``generate`` (free-form completion), ``get_ppl`` (per-sequence perplexity with
+optional context masking) and ``get_token_len``.  Before any of those run, the
+structured prompt IR (:class:`~opencompass_tpu.utils.prompt.PromptList`) is
+folded through the model's **meta template** — per-role begin/end decorations,
+with ``generate: True`` marking where generation starts (gen-mode parsing
+truncates the prompt there so the model completes the assistant turn).
+
+Behavioral parity: reference opencompass/models/base.py:10-394 (BaseModel,
+LMTemplateParser).  The section/round walking logic is shared with the API
+parser via :class:`MetaTemplateWalker` instead of being duplicated.
+"""
+from __future__ import annotations
+
+import abc
+from copy import deepcopy
+from typing import Dict, List, Optional, Tuple, Union
+
+from opencompass_tpu.utils.prompt import PromptList
+
+PromptType = Union[PromptList, str]
+
+
+class MetaTemplateWalker:
+    """Shared machinery for walking a PromptList against a meta template.
+
+    A meta template is ``dict(round=[role dicts...], begin=..., end=...,
+    reserved_roles=[...], eos_token_id=...)``.  Subclasses override the three
+    ``emit_*`` hooks to produce either a flat string (LM models) or a chat
+    message list (API models).
+    """
+
+    def __init__(self, meta_template: Optional[Dict] = None):
+        self.meta_template = meta_template
+        self.roles: Dict[str, dict] = {}
+        if meta_template:
+            assert 'round' in meta_template, \
+                'meta template requires a "round" key'
+            assert isinstance(meta_template['round'], list)
+            sources = [meta_template['round']]
+            if 'reserved_roles' in meta_template:
+                assert isinstance(meta_template['reserved_roles'], list)
+                sources.append(meta_template['reserved_roles'])
+            for source in sources:
+                for item in source:
+                    if isinstance(item, dict):
+                        if item['role'] in self.roles:
+                            raise ValueError(
+                                f'duplicate role {item["role"]} in meta '
+                                'template')
+                        self.roles[item['role']] = dict(item)
+
+    # -- hooks -------------------------------------------------------------
+
+    def _role_config(self, role_prompt: Dict) -> Dict:
+        """Role config for an IR item, merged with the item's own fields."""
+        role = role_prompt.get('role')
+        if role not in self.roles:
+            role = role_prompt.get('fallback_role')
+        if role not in self.roles:
+            raise KeyError(f'{role_prompt} has neither a known role nor a '
+                           'fallback role')
+        merged = dict(self.roles[role])
+        merged.update(role_prompt)
+        return merged
+
+    def _split_rounds(self, dialogue: List) -> List[int]:
+        """Index ranges of dialogue rounds: a new round starts whenever the
+        role order wraps around relative to the meta round template."""
+        role_order = {
+            cfg['role']: i
+            for i, cfg in enumerate(self.meta_template['round'])
+            if isinstance(cfg, dict)
+        }
+        last = -1
+        cuts = [0]
+        for idx, item in enumerate(dialogue):
+            if isinstance(item, str):
+                continue
+            pos = role_order.get(item.get('role'))
+            if pos is None:
+                pos = role_order.get(item.get('fallback_role'))
+                if pos is None:
+                    raise KeyError(f'{item} has neither a known role nor a '
+                                   'fallback role')
+            if pos <= last:
+                cuts.append(idx)
+            last = pos
+        cuts.append(len(dialogue))
+        return cuts
+
+    def _updated_roles(self, round_template) -> Dict[str, Dict]:
+        """Per-round role dict: defaults overridden by this round's items."""
+        role_dict = deepcopy(self.roles)
+        items = round_template
+        if isinstance(round_template, dict):
+            items = [round_template]
+        elif isinstance(round_template, str):
+            items = []
+        for item in items:
+            if not isinstance(item, dict):
+                continue
+            role = item.get('role')
+            if role not in self.roles:
+                role = item.get('fallback_role')
+            if role in role_dict:
+                role_dict[role].update(item)
+        return role_dict
+
+    def walk(self, prompt_template: PromptList, mode: str):
+        """Yield (kind, payload) events: ``('str', s)``, ``('role', (item,
+        role_dict, for_gen))`` for begin/end-section items, or ``('round',
+        (round_items, role_dict, for_gen))`` for each dialogue round.  The
+        consumer decides when to stop (gen-mode truncation)."""
+        section_stack: List[Tuple[str, int]] = []
+        for i, item in enumerate(prompt_template):
+            if isinstance(item, str):
+                yield ('str', item)
+            elif isinstance(item, dict) and 'section' in item:
+                if item['pos'] == 'begin':
+                    assert item['section'] in ('begin', 'round', 'end', 'ice')
+                    section_stack.append((item['section'], i + 1))
+                elif item['pos'] == 'end':
+                    section_name, start = section_stack.pop()
+                    assert section_name == item['section']
+                    if section_name in ('round', 'ice'):
+                        dialogue = prompt_template[start:i]
+                        cuts = self._split_rounds(dialogue)
+                        for r in range(len(cuts) - 1):
+                            round_items = dialogue[cuts[r]:cuts[r + 1]]
+                            for_gen = (mode == 'gen'
+                                       and section_name == 'round'
+                                       and r == len(cuts) - 2)
+                            yield ('round',
+                                   (self.meta_template['round'],
+                                    self._updated_roles(round_items), for_gen))
+                else:
+                    raise ValueError(f'invalid section pos {item["pos"]}')
+            elif section_stack and section_stack[-1][0] in ('begin', 'end'):
+                yield ('role', (item, self._updated_roles(item), mode == 'gen'))
+
+
+def _flatten_without_meta(prompt_template) -> str:
+    """No-meta-template fallback: join strings and role prompts with newlines,
+    dropping section markers (reference models/base.py:259-273)."""
+    parts: List[str] = []
+    for item in prompt_template:
+        if isinstance(item, dict) and set(item.keys()) == {'section', 'pos'}:
+            continue
+        if isinstance(item, str):
+            if item:
+                parts.append(item)
+        elif item.get('prompt', ''):
+            parts.append(item['prompt'])
+    return '\n'.join(parts)
+
+
+class LMTemplateParser(MetaTemplateWalker):
+    """Folds the prompt IR into a single flat string for LM-style models."""
+
+    def parse_template(self, prompt_template: PromptType, mode: str):
+        assert mode in ('ppl', 'gen')
+        if isinstance(prompt_template, list) \
+                and not isinstance(prompt_template, PromptList):
+            return [self.parse_template(p, mode) for p in prompt_template]
+        if isinstance(prompt_template, str):
+            return prompt_template
+        if not self.meta_template:
+            return _flatten_without_meta(prompt_template)
+
+        prompt = ''
+        generate = True
+        for kind, payload in self.walk(prompt_template, mode):
+            if not generate:
+                break
+            if kind == 'str':
+                prompt += payload
+            elif kind == 'round':
+                round_spec, role_dict, for_gen = payload
+                piece, generate = self._items2str(round_spec, role_dict,
+                                                  for_gen)
+                prompt += piece
+            else:  # single role in begin/end section
+                item, role_dict, for_gen = payload
+                piece, generate = self._items2str(item, role_dict, for_gen)
+                prompt += piece
+
+        prompt = self.meta_template.get('begin', '') + prompt
+        if generate:
+            prompt += self.meta_template.get('end', '')
+        return prompt
+
+    def _items2str(self, spec, role_dict, for_gen) -> Tuple[str, bool]:
+        if isinstance(spec, str):
+            return spec, True
+        if isinstance(spec, dict):
+            cfg = role_dict.get(spec['role'],
+                                role_dict.get(spec.get('fallback_role')))
+            out = cfg.get('begin', '')
+            if for_gen and cfg.get('generate', False):
+                return out, False
+            out += cfg.get('prompt', '') + cfg.get('end', '')
+            return out, True
+        out = ''
+        cont = True
+        for item in spec:
+            piece, cont = self._items2str(item, role_dict, for_gen)
+            out += piece
+            if not cont:
+                break
+        return out, cont
+
+
+class BaseModel(abc.ABC):
+    """Base class for all model wrappers.
+
+    Args:
+        path: checkpoint path / model identifier.
+        max_seq_len: hard context limit — inferencers' truncation loops use it.
+        tokenizer_only: load only the tokenizer (for prompt viewing / length
+            measurement without touching the accelerator).
+        meta_template: the model's role template (see module docstring).
+    """
+
+    is_api: bool = False
+
+    def __init__(self,
+                 path: str,
+                 max_seq_len: int = 2048,
+                 tokenizer_only: bool = False,
+                 meta_template: Optional[Dict] = None,
+                 generation_kwargs: Optional[Dict] = None):
+        self.path = path
+        self.max_seq_len = max_seq_len
+        self.tokenizer_only = tokenizer_only
+        self.template_parser = LMTemplateParser(meta_template)
+        self.generation_kwargs = generation_kwargs or {}
+        self.eos_token_id = None
+        if meta_template and 'eos_token_id' in meta_template:
+            self.eos_token_id = meta_template['eos_token_id']
+
+    @abc.abstractmethod
+    def generate(self, inputs: List[str], max_out_len: int) -> List[str]:
+        """Greedy/sampled completion for each input string."""
+
+    @abc.abstractmethod
+    def get_ppl(self,
+                inputs: List[str],
+                mask_length: Optional[List[int]] = None) -> List[float]:
+        """Mean per-token NLL of each input.  With ``mask_length``, the first
+        ``mask_length[i]`` tokens are excluded (normalized-PPL mode)."""
+
+    @abc.abstractmethod
+    def get_token_len(self, prompt: str) -> int:
+        """Tokenized length of ``prompt``."""
+
+    # -- template-aware entry points used by inferencers -------------------
+    def parse_template(self, prompt_template: PromptType, mode: str):
+        return self.template_parser.parse_template(prompt_template, mode)
+
+    def get_ppl_from_template(self, templates, mask_length=None):
+        inputs = self.parse_template(templates, mode='ppl')
+        return self.get_ppl(inputs, mask_length)
+
+    def generate_from_template(self, templates, max_out_len: int):
+        inputs = self.parse_template(templates, mode='gen')
+        return self.generate(inputs, max_out_len=max_out_len)
+
+    def get_token_len_from_template(self, templates, mode: str = 'ppl'):
+        prompts = self.parse_template(templates, mode=mode)
+        is_batched = isinstance(prompts, list) \
+            and not isinstance(prompts, PromptList)
+        if not is_batched:
+            prompts = [prompts]
+        lens = [self.get_token_len(str(p)) for p in prompts]
+        return lens if is_batched else lens[0]

@@ -1,0 +1,179 @@
+"""API-model abstraction: chat-message template parsing + rate limiting.
+
+API models receive the prompt IR as a list of ``{'role': api_role, 'prompt':
+text}`` chat messages rather than a flat string.  Consecutive same-role
+messages are merged; gen-mode parsing stops before the first role marked
+``generate: True`` (the assistant turn the API will produce).
+
+Behavioral parity: reference opencompass/models/base_api.py:17-399
+(BaseAPIModel, APITemplateParser, TokenBucket).
+"""
+from __future__ import annotations
+
+import re
+import threading
+import warnings
+from abc import abstractmethod
+from time import sleep
+from typing import Dict, List, Optional, Tuple, Union
+
+from opencompass_tpu.utils.prompt import PromptList
+
+from .base import BaseModel, MetaTemplateWalker
+
+PromptType = Union[PromptList, str]
+
+
+class TokenBucket:
+    """Semaphore refilled by a daemon thread at ``rate`` tokens/sec, used to
+    cap API queries-per-second across the inferencer's worker threads."""
+
+    def __init__(self, rate: float):
+        self._rate = rate
+        self._tokens = threading.Semaphore(0)
+        self._started = False
+
+    def _refill(self):
+        while True:
+            if self._tokens._value < self._rate:
+                self._tokens.release()
+            sleep(1 / self._rate)
+
+    def get_token(self):
+        if not self._started:
+            self._started = True
+            threading.Thread(target=self._refill, daemon=True).start()
+        self._tokens.acquire()
+
+
+class APITemplateParser(MetaTemplateWalker):
+    """Folds the prompt IR into a chat-message PromptList for API models."""
+
+    def parse_template(self, prompt_template: PromptType, mode: str):
+        assert mode in ('ppl', 'gen')
+        if isinstance(prompt_template, list) \
+                and not isinstance(prompt_template, PromptList):
+            return [self.parse_template(p, mode) for p in prompt_template]
+        if isinstance(prompt_template, str):
+            return prompt_template
+        if not self.meta_template:
+            # Flatten to newline-joined plain text.
+            parts = []
+            for item in prompt_template:
+                if isinstance(item, dict) \
+                        and set(item.keys()) == {'section', 'pos'}:
+                    continue
+                if isinstance(item, str):
+                    if item:
+                        parts.append(item)
+                elif item.get('prompt', ''):
+                    parts.append(item['prompt'])
+            return '\n'.join(parts)
+
+        messages = PromptList()
+        generate = True
+        for kind, payload in self.walk(prompt_template, mode):
+            if not generate:
+                break
+            if kind == 'str':
+                if payload.strip():
+                    warnings.warn('Non-empty raw string in prompt template '
+                                  'is dropped for API models.')
+            elif kind == 'round':
+                round_spec, role_dict, for_gen = payload
+                out, generate = self._items2api(round_spec, role_dict, for_gen)
+                messages += out
+            else:
+                item, role_dict, for_gen = payload
+                out, generate = self._items2api(item, role_dict, for_gen)
+                if isinstance(out, dict):
+                    messages.append(out)
+                else:
+                    messages += out
+
+        # Merge consecutive same-role messages.
+        if messages:
+            merged = PromptList([messages[0]])
+            for item in messages[1:]:
+                if item['role'] == merged[-1]['role']:
+                    merged[-1]['prompt'] += '\n' + item['prompt']
+                else:
+                    merged.append(item)
+            messages = merged
+        return messages
+
+    def _items2api(self, spec, role_dict, for_gen) -> Tuple[list, bool]:
+        if isinstance(spec, dict):
+            msg, cont = self._role2message(spec, role_dict, for_gen)
+            return msg, cont
+        out = []
+        cont = True
+        for item in spec:
+            if isinstance(item, str):
+                raise TypeError('Raw strings without an explicit role are not '
+                                'allowed in API meta templates.')
+            msg, cont = self._role2message(item, role_dict, for_gen)
+            if msg:
+                out.append(msg)
+            if not cont:
+                break
+        return out, cont
+
+    def _role2message(self, role_prompt, role_dict,
+                      for_gen) -> Tuple[Optional[dict], bool]:
+        cfg = role_dict.get(role_prompt['role'],
+                            role_dict.get(role_prompt.get('fallback_role')))
+        if for_gen and cfg.get('generate', False):
+            return None, False
+        prompt = cfg.get('begin', '') + cfg.get('prompt', '') \
+            + cfg.get('end', '')
+        return {'role': cfg['api_role'], 'prompt': prompt}, True
+
+
+class BaseAPIModel(BaseModel):
+    """Base class for API-served models.
+
+    Args:
+        path: model identifier passed to the API.
+        query_per_second: rate limit enforced via :class:`TokenBucket`.
+        retry: attempts per query before giving up.
+    """
+
+    is_api: bool = True
+
+    def __init__(self,
+                 path: str,
+                 query_per_second: int = 1,
+                 retry: int = 2,
+                 max_seq_len: int = 2048,
+                 meta_template: Optional[Dict] = None,
+                 generation_kwargs: Optional[Dict] = None):
+        self.path = path
+        self.max_seq_len = max_seq_len
+        self.meta_template = meta_template
+        self.retry = retry
+        self.query_per_second = query_per_second
+        self.token_bucket = TokenBucket(query_per_second)
+        self.template_parser = APITemplateParser(meta_template)
+        self.generation_kwargs = generation_kwargs or {}
+        self.logger = None
+
+    @abstractmethod
+    def generate(self, inputs: List[PromptType], max_out_len: int) -> List[str]:
+        """Generate completions via the API."""
+
+    def get_ppl(self, inputs, mask_length=None):
+        raise NotImplementedError(
+            f'{type(self).__name__} does not support PPL-mode evaluation.')
+
+    def get_token_len(self, prompt: str) -> int:
+        """Heuristic token count without a real tokenizer: English words +
+        CJK characters (reference base_api.py:82-103)."""
+        english_parts = re.sub(r'[一-鿿]+', ' ', prompt)
+        english_count = sum(1 for part in english_parts.split() if part)
+        chinese_count = sum(1 for ch in prompt if '一' <= ch <= '鿿')
+        return english_count + chinese_count
+
+    def wait(self):
+        """Block until the rate limiter grants the next query."""
+        return self.token_bucket.get_token()

@@ -1,0 +1,70 @@
+"""Deterministic fake model for hermetic pipeline tests.
+
+The reference ships no model fakes (its tests never touch a model — reference
+tests/ are template-only); this fills that gap per SURVEY.md §4 so the full
+infer → eval → summarize pipeline runs on CPU with reproducible outputs.
+"""
+import hashlib
+import zlib
+from typing import Dict, List, Optional
+
+from opencompass_tpu.registry import MODELS
+
+from .base import BaseModel
+
+
+@MODELS.register_module()
+class FakeModel(BaseModel):
+    """A model whose outputs are pure functions of its inputs.
+
+    * ``generate``: echoes a deterministic digest of the prompt, or, when
+      ``canned_responses`` maps a substring of the prompt to an answer,
+      returns that answer (lets tests construct known accuracy outcomes).
+    * ``get_ppl``: stable per-string pseudo-perplexity via crc32, or the value
+      from ``canned_ppls`` for prompts containing a given substring.
+    * ``get_token_len``: whitespace token count (×1 token per word).
+    """
+
+    def __init__(self,
+                 path: str = 'fake',
+                 max_seq_len: int = 2048,
+                 meta_template: Optional[Dict] = None,
+                 canned_responses: Optional[Dict[str, str]] = None,
+                 canned_ppls: Optional[Dict[str, float]] = None,
+                 tokenizer_only: bool = False):
+        super().__init__(path=path,
+                         max_seq_len=max_seq_len,
+                         tokenizer_only=tokenizer_only,
+                         meta_template=meta_template)
+        self.canned_responses = canned_responses or {}
+        self.canned_ppls = canned_ppls or {}
+
+    def generate(self, inputs: List[str], max_out_len: int) -> List[str]:
+        out = []
+        for prompt in inputs:
+            prompt = str(prompt)
+            for key, resp in self.canned_responses.items():
+                if key in prompt:
+                    out.append(resp)
+                    break
+            else:
+                digest = hashlib.sha256(prompt.encode()).hexdigest()[:8]
+                out.append(f'fake-{digest}')
+        return out
+
+    def get_ppl(self,
+                inputs: List[str],
+                mask_length: Optional[List[int]] = None) -> List[float]:
+        out = []
+        for prompt in inputs:
+            prompt = str(prompt)
+            for key, ppl in self.canned_ppls.items():
+                if key in prompt:
+                    out.append(float(ppl))
+                    break
+            else:
+                out.append(1.0 + (zlib.crc32(prompt.encode()) % 10000) / 100.0)
+        return out
+
+    def get_token_len(self, prompt: str) -> int:
+        return max(1, len(str(prompt).split()))

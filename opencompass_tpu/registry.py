@@ -1,0 +1,114 @@
+"""Component registries.
+
+A :class:`Registry` maps string names to classes so configs can say
+``dict(type='PPLInferencer', ...)`` (or pass the class object directly) and the
+framework builds the component.  Replaces the reference's mmengine registries
+(reference ``opencompass/registry.py:1-25``) with a dependency-free design that
+supports lazy location scanning: modules listed in ``locations`` are only
+imported on first lookup miss, keeping import time low.
+"""
+from __future__ import annotations
+
+import importlib
+import inspect
+from typing import Any, Callable, Dict, List, Optional, Type
+
+
+class Registry:
+
+    def __init__(self, name: str, locations: Optional[List[str]] = None):
+        self.name = name
+        self._registry: Dict[str, Type] = {}
+        self._locations = list(locations or [])
+        self._scanned = False
+
+    # -- registration -----------------------------------------------------
+    def register_module(self,
+                        name: Optional[str] = None,
+                        module: Optional[Type] = None,
+                        force: bool = False) -> Callable:
+        """Register a class (decorator or direct call)."""
+        if module is not None:
+            self._register(module, name, force)
+            return module
+
+        def decorator(cls):
+            self._register(cls, name, force)
+            return cls
+
+        return decorator
+
+    def _register(self, cls: Type, name: Optional[str], force: bool):
+        keys = [name] if isinstance(name, str) else (name or [cls.__name__])
+        for key in keys:
+            if not force and key in self._registry \
+                    and self._registry[key] is not cls:
+                raise KeyError(
+                    f'{key} already registered in {self.name} registry')
+            self._registry[key] = cls
+
+    # -- lookup -----------------------------------------------------------
+    def _scan_locations(self):
+        if self._scanned:
+            return
+        self._scanned = True
+        for loc in self._locations:
+            importlib.import_module(loc)
+
+    def get(self, key: str) -> Optional[Type]:
+        if key not in self._registry:
+            self._scan_locations()
+        if key not in self._registry and '.' in key:
+            # Fully-qualified 'pkg.module.Class' escape hatch.
+            mod_name, _, cls_name = key.rpartition('.')
+            try:
+                cls = getattr(importlib.import_module(mod_name), cls_name)
+                self._registry[key] = cls
+            except (ImportError, AttributeError):
+                return None
+        return self._registry.get(key)
+
+    def __contains__(self, key: str) -> bool:
+        return self.get(key) is not None
+
+    def build(self, cfg: Dict[str, Any], **default_kwargs) -> Any:
+        """Instantiate ``cfg['type']`` with the remaining keys as kwargs."""
+        if not isinstance(cfg, dict) or 'type' not in cfg:
+            raise TypeError(f'{self.name}: config must be a dict with a '
+                            f'"type" key, got {cfg!r}')
+        cfg = dict(cfg)
+        obj_type = cfg.pop('type')
+        if isinstance(obj_type, str):
+            cls = self.get(obj_type)
+            if cls is None:
+                raise KeyError(f'{obj_type} is not registered in the '
+                               f'{self.name} registry')
+        elif inspect.isclass(obj_type) or callable(obj_type):
+            cls = obj_type
+        else:
+            raise TypeError(f'type must be a str or class, got {obj_type!r}')
+        kwargs = {**default_kwargs, **cfg}
+        return cls(**kwargs)
+
+
+_LOC = 'opencompass_tpu'
+
+PARTITIONERS = Registry('partitioner', locations=[f'{_LOC}.partitioners'])
+RUNNERS = Registry('runner', locations=[f'{_LOC}.runners'])
+TASKS = Registry('task', locations=[f'{_LOC}.tasks'])
+MODELS = Registry('model', locations=[f'{_LOC}.models'])
+LOAD_DATASET = Registry('load_dataset', locations=[f'{_LOC}.datasets'])
+TEXT_POSTPROCESSORS = Registry(
+    'text_postprocessor',
+    locations=[f'{_LOC}.utils.text_postprocessors', f'{_LOC}.datasets'])
+EVALUATORS = Registry('evaluator', locations=[f'{_LOC}.icl.evaluators'])
+ICL_INFERENCERS = Registry('icl_inferencer',
+                           locations=[f'{_LOC}.icl.inferencers'])
+ICL_RETRIEVERS = Registry('icl_retriever', locations=[f'{_LOC}.icl.retrievers'])
+ICL_DATASET_READERS = Registry('icl_dataset_reader',
+                               locations=[f'{_LOC}.icl.dataset_reader'])
+ICL_PROMPT_TEMPLATES = Registry('icl_prompt_template',
+                                locations=[f'{_LOC}.icl.prompt_template'])
+ICL_EVALUATORS = Registry('icl_evaluator',
+                          locations=[f'{_LOC}.icl.evaluators',
+                                     f'{_LOC}.datasets'])

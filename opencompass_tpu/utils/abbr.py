@@ -1,0 +1,47 @@
+"""Abbreviation / output-path scheme.
+
+Output-file existence is the framework's completion + resume protocol, so the
+naming here is load-bearing: predictions land at
+``{work_dir}/predictions/{model_abbr}/{dataset_abbr}.json`` and a partitioner
+skips any (model, dataset) pair whose file already exists.
+Parity: reference opencompass/utils/abbr.py:7-46.
+"""
+import os.path as osp
+from typing import Dict
+
+
+def model_abbr_from_cfg(cfg: Dict) -> str:
+    if 'abbr' in cfg:
+        return cfg['abbr']
+    type_name = cfg['type']
+    if not isinstance(type_name, str):
+        type_name = type_name.__name__
+    tail = '_'.join(str(cfg.get('path', '')).split('/')[-2:])
+    return f'{type_name}_{tail}'.replace('/', '_')
+
+
+def dataset_abbr_from_cfg(cfg: Dict) -> str:
+    if 'abbr' in cfg:
+        return cfg['abbr']
+    abbr = str(cfg.get('path', ''))
+    if 'name' in cfg:
+        abbr += '_' + cfg['name']
+    return abbr.replace('/', '_')
+
+
+def task_abbr_from_cfg(task: Dict) -> str:
+    """``[model/dataset,model/dataset2,...]`` — the task's display name."""
+    pairs = []
+    for i, model in enumerate(task['models']):
+        for dataset in task['datasets'][i]:
+            pairs.append(f'{model_abbr_from_cfg(model)}/'
+                         f'{dataset_abbr_from_cfg(dataset)}')
+    return '[' + ','.join(pairs) + ']'
+
+
+def get_infer_output_path(model_cfg: Dict,
+                          dataset_cfg: Dict,
+                          root_path: str,
+                          file_extension: str = 'json') -> str:
+    return osp.join(root_path, model_abbr_from_cfg(model_cfg),
+                    f'{dataset_abbr_from_cfg(dataset_cfg)}.{file_extension}')

@@ -1,0 +1,27 @@
+"""Config → component builders that strip orchestration-only keys.
+
+Dataset configs carry ``infer_cfg``/``eval_cfg``/``abbr`` and model configs
+carry ``run_cfg``/``max_out_len``/``batch_size``/``abbr`` which are consumed by
+the scheduler, not the constructors.  Parity: reference utils/build.py:8-22.
+"""
+import copy
+
+from opencompass_tpu.registry import LOAD_DATASET, MODELS
+
+DATASET_NON_CTOR_KEYS = ('infer_cfg', 'eval_cfg', 'abbr')
+MODEL_NON_CTOR_KEYS = ('run_cfg', 'max_out_len', 'batch_size', 'abbr',
+                       'summarizer_abbr')
+
+
+def build_dataset_from_cfg(dataset_cfg):
+    dataset_cfg = copy.deepcopy(dataset_cfg)
+    for key in DATASET_NON_CTOR_KEYS:
+        dataset_cfg.pop(key, None)
+    return LOAD_DATASET.build(dataset_cfg)
+
+
+def build_model_from_cfg(model_cfg):
+    model_cfg = copy.deepcopy(model_cfg)
+    for key in MODEL_NON_CTOR_KEYS:
+        model_cfg.pop(key, None)
+    return MODELS.build(model_cfg)

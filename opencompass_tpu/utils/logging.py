@@ -1,0 +1,40 @@
+"""Singleton logger, rank-aware.
+
+Only JAX process 0 logs at the requested level; other processes drop to ERROR
+to keep multi-host logs readable (replaces the reference's
+``LOCAL_RANK``-gated mmengine loggers — reference openicl/utils/logging.py,
+utils/logging.py).
+"""
+import logging
+import os
+import sys
+from typing import Optional
+
+_LOGGER: Optional[logging.Logger] = None
+
+LOG_FORMAT = '%(asctime)s - %(name)s - %(levelname)s - %(message)s'
+
+
+def _process_index() -> int:
+    # Avoid importing jax (and initializing the backend) just to log: in
+    # multi-host runs the launcher exports JAX_PROCESS_INDEX for us.
+    for var in ('JAX_PROCESS_INDEX', 'PROCESS_INDEX', 'LOCAL_RANK'):
+        if var in os.environ:
+            try:
+                return int(os.environ[var])
+            except ValueError:
+                pass
+    return 0
+
+
+def get_logger(level=logging.INFO) -> logging.Logger:
+    global _LOGGER
+    if _LOGGER is None:
+        logger = logging.getLogger('opencompass_tpu')
+        logger.propagate = False
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(logging.Formatter(LOG_FORMAT))
+        logger.addHandler(handler)
+        logger.setLevel(level if _process_index() == 0 else logging.ERROR)
+        _LOGGER = logger
+    return _LOGGER

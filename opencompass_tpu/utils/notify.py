@@ -1,0 +1,41 @@
+"""Webhook notifier (Lark/Feishu-compatible).
+
+Posts run start/finish/summary messages to a webhook URL configured as
+``lark_bot_url`` in the run config.  Parity: reference utils/lark.py:1-39.
+Network failures are swallowed — notification must never fail a run.
+"""
+import json
+from typing import List, Optional, Union
+
+
+class LarkReporter:
+
+    def __init__(self, url: str):
+        self.url = url
+
+    def post(self,
+             content: Union[str, List[List[dict]]],
+             title: Optional[str] = None):
+        if title is None:
+            title = 'Eval task reminder'
+        if isinstance(content, str):
+            content = [[{'tag': 'text', 'text': content}]]
+        msg = {
+            'msg_type': 'post',
+            'content': {
+                'post': {
+                    'zh_cn': {
+                        'title': title,
+                        'content': content
+                    }
+                }
+            }
+        }
+        try:
+            import requests
+            requests.post(self.url,
+                          data=json.dumps(msg),
+                          headers={'Content-Type': 'application/json'},
+                          timeout=10)
+        except Exception:  # noqa: BLE001 — notification is best-effort
+            pass

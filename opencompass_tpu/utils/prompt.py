@@ -1,0 +1,170 @@
+"""Prompt intermediate representation (IR).
+
+A prompt travels through the framework as either a plain ``str`` or a
+:class:`PromptList` — a list mixing strings, role dicts
+(``{'role': 'HUMAN', 'prompt': '...'}``) and section markers
+(``{'section': 'round', 'pos': 'begin'}``).  Template parsers in
+``opencompass_tpu.models`` flatten the IR into model-specific strings or chat
+messages.
+
+Behavioral parity: reference ``opencompass/utils/prompt.py:11-204`` (safe_format,
+get_prompt_hash, PromptList semantics).  Re-implemented from scratch.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from copy import deepcopy
+from typing import Dict, List, Union
+
+
+def safe_format(s: str, **kwargs) -> str:
+    """Substitute ``{key}`` placeholders; unknown placeholders are left as-is.
+
+    Unlike ``str.format`` this never raises ``KeyError`` and ignores stray
+    braces, which prompt templates are full of (e.g. LaTeX, code).
+    Parity: reference utils/prompt.py:11-24.
+    """
+    for key, value in kwargs.items():
+        s = s.replace('{' + key + '}', str(value))
+    return s
+
+
+def _normalize_types(obj):
+    """Make an infer_cfg JSON-serializable: classes → their bare names."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if k == 'type':
+                if isinstance(v, type):
+                    v = v.__name__
+                elif isinstance(v, str):
+                    v = v.split('.')[-1]
+            else:
+                v = _normalize_types(v)
+            out[k] = v
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [_normalize_types(v) for v in obj]
+    if isinstance(obj, type):
+        return obj.__name__
+    return obj
+
+
+def get_prompt_hash(dataset_cfg) -> str:
+    """SHA-256 of the normalized ``infer_cfg`` — the dataset-config version id.
+
+    Config filenames carry the first 6 hex chars (e.g. ``mmlu_gen_a484b3``) so
+    result tables can show which prompt produced a score.
+    Parity: reference utils/prompt.py:27-61.
+    """
+    if isinstance(dataset_cfg, list):
+        if len(dataset_cfg) == 1:
+            dataset_cfg = dataset_cfg[0]
+        else:
+            combined = ','.join(get_prompt_hash(cfg) for cfg in dataset_cfg)
+            return hashlib.sha256(combined.encode()).hexdigest()
+    infer_cfg = deepcopy(dict(dataset_cfg['infer_cfg']))
+    if 'reader_cfg' in infer_cfg:
+        # Newer config style: fold the reader column spec into the hash input
+        # so changing columns re-versions the prompt.
+        reader_cfg = dataset_cfg.get('reader_cfg', {})
+        infer_cfg['reader'] = dict(
+            type='DatasetReader',
+            input_columns=reader_cfg.get('input_columns'),
+            output_column=reader_cfg.get('output_column'))
+        own_reader = infer_cfg.get('reader_cfg', {})
+        if 'train_split' in own_reader:
+            infer_cfg['retriever']['index_split'] = own_reader['train_split']
+        if 'test_split' in own_reader:
+            infer_cfg['retriever']['test_split'] = own_reader['test_split']
+    d_json = json.dumps(_normalize_types(infer_cfg), sort_keys=True)
+    return hashlib.sha256(d_json.encode()).hexdigest()
+
+
+class PromptList(list):
+    """List-based prompt IR with string-like ``format``/``replace`` and concat.
+
+    Items are strings, role dicts, or section markers.  All operations return
+    new PromptLists (except ``+=``).  Parity: reference utils/prompt.py:64-204.
+    """
+
+    def format(self, **kwargs) -> 'PromptList':
+        """Apply :func:`safe_format` to every string and role-dict prompt."""
+        out = PromptList()
+        for item in self:
+            if isinstance(item, Dict):
+                new_item = deepcopy(item)
+                if 'prompt' in item:
+                    new_item['prompt'] = safe_format(item['prompt'], **kwargs)
+                out.append(new_item)
+            else:
+                out.append(safe_format(item, **kwargs))
+        return out
+
+    def replace(self, src: str, dst: Union[str, 'PromptList']) -> 'PromptList':
+        """Replace ``src`` everywhere.  When ``dst`` is a PromptList, string
+        items are split at ``src`` and the PromptList is spliced in (this is
+        how in-context examples — themselves PromptLists — are inserted at an
+        ``ice_token``).  Splicing into a role dict's prompt is an error."""
+        out = PromptList()
+        for item in self:
+            if isinstance(item, str):
+                if isinstance(dst, str):
+                    out.append(item.replace(src, dst))
+                else:
+                    pieces = item.split(src)
+                    for i, piece in enumerate(pieces):
+                        if piece:
+                            out.append(piece)
+                        if i < len(pieces) - 1:
+                            out += dst
+            elif isinstance(item, Dict):
+                new_item = deepcopy(item)
+                if 'prompt' in item and src in item['prompt']:
+                    if isinstance(dst, PromptList):
+                        raise TypeError(
+                            f'Found keyword {src} in a dict prompt; cannot '
+                            'splice a PromptList inside a role dict.')
+                    new_item['prompt'] = new_item['prompt'].replace(src, dst)
+                out.append(new_item)
+            else:
+                out.append(item.replace(src, dst))
+        return out
+
+    def __add__(self, other) -> 'PromptList':
+        if not other:
+            return PromptList(self)
+        if isinstance(other, str):
+            return PromptList([*self, other])
+        return PromptList(list.__add__(self, other))
+
+    def __radd__(self, other) -> 'PromptList':
+        if not other:
+            return PromptList(self)
+        if isinstance(other, str):
+            return PromptList([other, *self])
+        return PromptList(list(other) + list(self))
+
+    def __iadd__(self, other) -> 'PromptList':
+        if not other:
+            return self
+        if isinstance(other, str):
+            self.append(other)
+        else:
+            list.__iadd__(self, other)
+        return self
+
+    def __str__(self) -> str:
+        """Flatten to plain text: strings + role prompts, markers dropped."""
+        parts: List[str] = []
+        for item in self:
+            if isinstance(item, str):
+                parts.append(item)
+            elif isinstance(item, dict):
+                if 'prompt' in item:
+                    parts.append(item['prompt'])
+            else:
+                raise TypeError(
+                    f'Invalid item of type {type(item)} in PromptList')
+        return ''.join(parts)

@@ -1,0 +1,47 @@
+"""General-purpose answer extractors applied to model generations before
+scoring.  Dataset-specific postprocessors live with their dataset modules.
+Parity: reference utils/text_postprocessors.py:6-56.
+"""
+import re
+
+from opencompass_tpu.registry import TEXT_POSTPROCESSORS
+
+
+@TEXT_POSTPROCESSORS.register_module('general')
+def general_postprocess(text: str) -> str:
+    """Keep text before the first newline/period/comma, strip punctuation,
+    articles, and extra whitespace."""
+    truncated = re.split(r'[\n.,]', text, 1)[0]
+    no_punct = re.sub(r'[^\w\s]', '', truncated)
+    no_articles = re.sub(r'\b(a|an|the)\b', '', no_punct, flags=re.IGNORECASE)
+    return re.sub(r'\s+', ' ', no_articles).strip()
+
+
+@TEXT_POSTPROCESSORS.register_module('general_cn')
+def general_cn_postprocess(text: str) -> str:
+    """Chinese variant: jieba-segment the raw text into space-joined tokens."""
+    import jieba
+    return ' '.join(jieba.cut(text))
+
+
+@TEXT_POSTPROCESSORS.register_module('first-capital')
+def first_capital_postprocess(text: str) -> str:
+    """First uppercase character — the A/B/C/D multiple-choice extractor."""
+    for ch in text:
+        if ch.isupper():
+            return ch
+    return ''
+
+
+@TEXT_POSTPROCESSORS.register_module('first-capital-multi')
+def first_capital_postprocess_multi(text: str) -> str:
+    """First run of A-D capitals, for multi-answer multiple choice."""
+    match = re.search(r'([A-D]+)', text)
+    return match.group(1) if match else ''
+
+
+@TEXT_POSTPROCESSORS.register_module('first-number')
+def first_number_postprocess(text: str) -> str:
+    """First (possibly signed / decimal) number in the text."""
+    match = re.search(r'-?\d+(\.\d+)?', text.replace(',', ''))
+    return match.group(0) if match else ''

@@ -1,0 +1,18 @@
+"""Lightweight argument validators (reference utils/types.py)."""
+from typing import Any, List
+
+
+def check_type_list(obj: Any, typelist: List) -> Any:
+    for t in typelist:
+        if t is None:
+            if obj is None:
+                return obj
+        elif isinstance(obj, t):
+            return obj
+    raise TypeError(f'Expected one of {typelist}, got {type(obj)}')
+
+
+def check_str(obj: Any) -> str:
+    if not isinstance(obj, str):
+        raise TypeError(f'Expected str, got {type(obj)}')
+    return obj

@@ -1,0 +1,87 @@
+"""APITemplateParser semantics (mirrors reference
+tests/prompt/test_api_template_parser.py): chat-message conversion,
+same-role merging, raw-string drops, gen-mode truncation."""
+import warnings
+
+from opencompass_tpu.models import APITemplateParser
+from opencompass_tpu.utils.prompt import PromptList
+
+META = dict(round=[
+    dict(role='HUMAN', api_role='user'),
+    dict(role='BOT', api_role='assistant', generate=True),
+])
+
+
+def _round_pl():
+    return PromptList([
+        dict(section='round', pos='begin'),
+        dict(role='HUMAN', prompt='q0'),
+        dict(role='BOT', prompt='a0'),
+        dict(role='HUMAN', prompt='q1'),
+        dict(role='BOT', prompt=''),
+        dict(section='round', pos='end'),
+    ])
+
+
+def test_messages_and_gen_truncation():
+    parser = APITemplateParser(META)
+    out = parser.parse_template(_round_pl(), mode='gen')
+    assert [m['role'] for m in out] == ['user', 'assistant', 'user']
+    assert [m['prompt'] for m in out] == ['q0', 'a0', 'q1']
+
+
+def test_ppl_mode_keeps_final_answer():
+    parser = APITemplateParser(META)
+    out = parser.parse_template(_round_pl(), mode='ppl')
+    assert [m['role'] for m in out] == \
+        ['user', 'assistant', 'user', 'assistant']
+
+
+def test_same_role_merge():
+    meta = dict(round=[
+        dict(role='HUMAN', api_role='user'),
+        dict(role='BOT', api_role='assistant', generate=True),
+    ], reserved_roles=[dict(role='SYSTEM', api_role='user')])
+    parser = APITemplateParser(meta)
+    pl = PromptList([
+        dict(section='begin', pos='begin'),
+        dict(role='SYSTEM', prompt='sys'),
+        dict(section='begin', pos='end'),
+        dict(section='round', pos='begin'),
+        dict(role='HUMAN', prompt='q'),
+        dict(role='BOT', prompt=''),
+        dict(section='round', pos='end'),
+    ])
+    out = parser.parse_template(pl, mode='gen')
+    # SYSTEM(api user) merges with HUMAN(api user)
+    assert len(out) == 1
+    assert out[0] == {'role': 'user', 'prompt': 'sys\nq'}
+
+
+def test_raw_string_dropped_with_warning():
+    parser = APITemplateParser(META)
+    pl = PromptList([
+        'stray text',
+        dict(section='round', pos='begin'),
+        dict(role='HUMAN', prompt='q'),
+        dict(role='BOT', prompt=''),
+        dict(section='round', pos='end'),
+    ])
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter('always')
+        out = parser.parse_template(pl, mode='gen')
+    assert any('ignored' in str(x.message) or 'dropped' in str(x.message)
+               for x in w)
+    assert [m['prompt'] for m in out] == ['q']
+
+
+def test_no_meta_template_flattens():
+    parser = APITemplateParser(None)
+    pl = PromptList([dict(role='HUMAN', prompt='q'),
+                     dict(role='BOT', prompt='a')])
+    assert parser.parse_template(pl, mode='ppl') == 'q\na'
+
+
+def test_str_passthrough():
+    parser = APITemplateParser(META)
+    assert parser.parse_template('plain', mode='gen') == 'plain'

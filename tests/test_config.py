@@ -1,0 +1,101 @@
+"""Config system: fromfile, read_base composition, dump round-trip, registry."""
+import os
+
+from opencompass_tpu.config import Config
+from opencompass_tpu.registry import Registry
+
+
+def _write(tmp_path, rel, content):
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(content)
+    return str(path)
+
+
+def test_fromfile_basic(tmp_path):
+    p = _write(tmp_path, 'a.py', "x = 1\nmodels = [dict(type='Fake', a=2)]\n")
+    cfg = Config.fromfile(p)
+    assert cfg.x == 1
+    assert cfg.models[0].type == 'Fake'
+    assert cfg.models[0].a == 2
+
+
+def test_read_base_composition(tmp_path):
+    _write(tmp_path, 'base/models.py', "models = [dict(type='M', n=1)]\n")
+    p = _write(
+        tmp_path, 'eval.py', 'from opencompass_tpu import read_base\n'
+        'with read_base():\n'
+        '    from .base.models import models\n'
+        'work_dir = "out"\n')
+    cfg = Config.fromfile(p)
+    assert cfg.models[0].n == 1
+    assert cfg.work_dir == 'out'
+
+
+def test_read_base_parent_level(tmp_path):
+    _write(tmp_path, 'datasets/mmlu.py', 'ds = [dict(abbr="mmlu")]\n')
+    p = _write(
+        tmp_path, 'runs/eval.py', 'from opencompass_tpu import read_base\n'
+        'with read_base():\n'
+        '    from ..datasets.mmlu import ds\n')
+    cfg = Config.fromfile(p)
+    assert cfg.ds[0].abbr == 'mmlu'
+
+
+def test_dump_roundtrip(tmp_path):
+    from opencompass_tpu.models import FakeModel
+    p = _write(tmp_path, 'a.py', 'x = {"k": [1, 2, {"n": None}]}\n')
+    cfg = Config.fromfile(p)
+    cfg['models'] = [dict(type=FakeModel, path='fake')]
+    out = str(tmp_path / 'dump.py')
+    cfg.dump(out)
+    cfg2 = Config.fromfile(out)
+    assert cfg2.x == {'k': [1, 2, {'n': None}]}
+    assert cfg2.models[0].type == 'opencompass_tpu.models.fake.FakeModel'
+
+
+def test_registry_build_with_string_and_class():
+    reg = Registry('test')
+
+    @reg.register_module()
+    class Foo:
+
+        def __init__(self, v=0):
+            self.v = v
+
+    assert reg.build(dict(type='Foo', v=3)).v == 3
+    assert reg.build(dict(type=Foo, v=4)).v == 4
+
+
+def test_registry_dotted_path_fallback():
+    reg = Registry('test2')
+    obj = reg.build(dict(type='opencompass_tpu.models.fake.FakeModel',
+                         path='fake'))
+    assert obj.path == 'fake'
+
+
+def test_merge_from_dict(tmp_path):
+    p = _write(tmp_path, 'a.py', 'infer = dict(runner=dict(n=1))\n')
+    cfg = Config.fromfile(p)
+    cfg.merge_from_dict({'infer.runner.n': 8, 'new.key': 'v'})
+    assert cfg.infer.runner.n == 8
+    assert cfg.new.key == 'v'
+
+
+def test_prompt_hash_stability():
+    from opencompass_tpu.utils.prompt import get_prompt_hash
+    cfg = dict(infer_cfg=dict(
+        prompt_template=dict(type='PromptTemplate', template='{q}'),
+        retriever=dict(type='ZeroRetriever'),
+        inferencer=dict(type='GenInferencer')))
+    h1 = get_prompt_hash(cfg)
+    h2 = get_prompt_hash(dict(infer_cfg=dict(
+        inferencer=dict(type='GenInferencer'),
+        retriever=dict(type='ZeroRetriever'),
+        prompt_template=dict(type='PromptTemplate', template='{q}'))))
+    assert h1 == h2 and len(h1) == 64
+    h3 = get_prompt_hash(dict(infer_cfg=dict(
+        prompt_template=dict(type='PromptTemplate', template='{q} changed'),
+        retriever=dict(type='ZeroRetriever'),
+        inferencer=dict(type='GenInferencer'))))
+    assert h3 != h1

@@ -1,0 +1,114 @@
+"""LMTemplateParser semantics (mirrors reference
+tests/prompt/test_lm_template_parser.py): role decoration, round splitting,
+gen-mode truncation at generate=True."""
+from opencompass_tpu.models import LMTemplateParser
+from opencompass_tpu.utils.prompt import PromptList
+
+META = dict(
+    begin='<BOS>',
+    round=[
+        dict(role='HUMAN', begin='<human>', end='</human>\n'),
+        dict(role='BOT', begin='<bot>', end='</bot>\n', generate=True),
+    ],
+    end='<EOS>',
+)
+
+
+def make_round_prompt(n_rounds=1, with_answer=True):
+    pl = PromptList()
+    pl.append(dict(section='round', pos='begin'))
+    for i in range(n_rounds):
+        pl.append(dict(role='HUMAN', prompt=f'q{i}'))
+        if with_answer or i < n_rounds - 1:
+            pl.append(dict(role='BOT', prompt=f'a{i}'))
+        else:
+            pl.append(dict(role='BOT', prompt=''))
+    pl.append(dict(section='round', pos='end'))
+    return pl
+
+
+def test_plain_string_passthrough():
+    parser = LMTemplateParser(META)
+    assert parser.parse_template('hello', mode='gen') == 'hello'
+
+
+def test_no_meta_template_join():
+    parser = LMTemplateParser(None)
+    pl = PromptList([dict(section='round', pos='begin'),
+                     dict(role='HUMAN', prompt='q'),
+                     dict(role='BOT', prompt='a'),
+                     dict(section='round', pos='end')])
+    assert parser.parse_template(pl, mode='ppl') == 'q\na'
+
+
+def test_ppl_mode_full_decoration():
+    parser = LMTemplateParser(META)
+    out = parser.parse_template(make_round_prompt(1), mode='ppl')
+    assert out == '<BOS><human>q0</human>\n<bot>a0</bot>\n<EOS>'
+
+
+def test_gen_mode_truncates_at_generate_role():
+    parser = LMTemplateParser(META)
+    out = parser.parse_template(make_round_prompt(1), mode='gen')
+    # stops after BOT's begin, no BOT prompt/end, no meta end
+    assert out == '<BOS><human>q0</human>\n<bot>'
+
+
+def test_multi_round_gen_keeps_earlier_answers():
+    parser = LMTemplateParser(META)
+    out = parser.parse_template(make_round_prompt(2), mode='gen')
+    assert out == ('<BOS><human>q0</human>\n<bot>a0</bot>\n'
+                   '<human>q1</human>\n<bot>')
+
+
+def test_ice_section_never_truncates():
+    parser = LMTemplateParser(META)
+    pl = PromptList()
+    pl.append(dict(section='ice', pos='begin'))
+    pl.append(dict(role='HUMAN', prompt='iq'))
+    pl.append(dict(role='BOT', prompt='ia'))
+    pl.append(dict(section='ice', pos='end'))
+    pl.append(dict(section='round', pos='begin'))
+    pl.append(dict(role='HUMAN', prompt='q'))
+    pl.append(dict(role='BOT', prompt=''))
+    pl.append(dict(section='round', pos='end'))
+    out = parser.parse_template(pl, mode='gen')
+    # ice round fully rendered (including bot answer), live round truncated
+    assert out == ('<BOS><human>iq</human>\n<bot>ia</bot>\n'
+                   '<human>q</human>\n<bot>')
+
+
+def test_begin_section_roles_are_decorated():
+    meta = dict(
+        round=[dict(role='HUMAN', begin='H:', end='\n'),
+               dict(role='BOT', begin='B:', end='\n', generate=True)],
+        reserved_roles=[dict(role='SYSTEM', begin='S:', end='\n')],
+    )
+    parser = LMTemplateParser(meta)
+    pl = PromptList()
+    pl.append(dict(section='begin', pos='begin'))
+    pl.append(dict(role='SYSTEM', prompt='sys'))
+    pl.append(dict(section='begin', pos='end'))
+    pl.append(dict(section='round', pos='begin'))
+    pl.append(dict(role='HUMAN', prompt='q'))
+    pl.append(dict(role='BOT', prompt=''))
+    pl.append(dict(section='round', pos='end'))
+    out = parser.parse_template(pl, mode='gen')
+    assert out == 'S:sys\nH:q\nB:'
+
+
+def test_fallback_role():
+    parser = LMTemplateParser(META)
+    pl = PromptList([dict(section='round', pos='begin'),
+                     dict(role='UNKNOWN', fallback_role='HUMAN', prompt='q'),
+                     dict(role='BOT', prompt='a'),
+                     dict(section='round', pos='end')])
+    out = parser.parse_template(pl, mode='ppl')
+    assert out == '<BOS><human>q</human>\n<bot>a</bot>\n<EOS>'
+
+
+def test_batched_parse():
+    parser = LMTemplateParser(META)
+    outs = parser.parse_template([make_round_prompt(1), 'raw'], mode='ppl')
+    assert isinstance(outs, list) and len(outs) == 2
+    assert outs[1] == 'raw'

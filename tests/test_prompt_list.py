@@ -1,0 +1,72 @@
+"""PromptList IR semantics (mirrors reference tests/prompt/test_prompt_list.py)."""
+from opencompass_tpu.utils.prompt import PromptList, safe_format
+
+
+def test_safe_format_known_and_unknown_keys():
+    assert safe_format('a {x} b {y}', x=1) == 'a 1 b {y}'
+    assert safe_format('no placeholders') == 'no placeholders'
+    assert safe_format('{a}{a}', a='z') == 'zz'
+
+
+def test_add_str_and_promptlist():
+    pl = PromptList(['a']) + 'b'
+    assert isinstance(pl, PromptList) and list(pl) == ['a', 'b']
+    pl2 = pl + PromptList(['c'])
+    assert list(pl2) == ['a', 'b', 'c']
+    assert isinstance(pl2, PromptList)
+
+
+def test_radd_and_empty():
+    pl = 'x' + PromptList(['y'])
+    assert isinstance(pl, PromptList) and list(pl) == ['x', 'y']
+    assert list('' + PromptList(['y'])) == ['y']
+    assert list(PromptList(['y']) + '') == ['y']
+    assert list(PromptList(['y']) + None) == ['y']
+
+
+def test_iadd():
+    pl = PromptList(['a'])
+    pl += 'b'
+    pl += PromptList(['c'])
+    pl += ''
+    assert list(pl) == ['a', 'b', 'c']
+
+
+def test_str_flattens_role_dicts():
+    pl = PromptList(
+        ['pre ', {'role': 'HUMAN', 'prompt': 'Q'},
+         {'section': 'round', 'pos': 'begin'}, ' post'])
+    assert str(pl) == 'pre Q post'
+
+
+def test_format_touches_strings_and_prompts():
+    pl = PromptList(['{q} ', {'role': 'HUMAN', 'prompt': 'ask {q}'},
+                     {'section': 'ice', 'pos': 'begin'}])
+    out = pl.format(q='why')
+    assert str(out) == 'why ask why'
+    # original untouched
+    assert str(pl) == '{q} ask {q}'
+
+
+def test_replace_with_str():
+    pl = PromptList(['a </E> b', {'role': 'HUMAN', 'prompt': 'x </E> y'}])
+    out = pl.replace('</E>', 'ICE')
+    assert str(out) == 'a ICE b' + 'x ICE y'
+
+
+def test_replace_with_promptlist_splices_strings():
+    ice = PromptList([{'role': 'HUMAN', 'prompt': 'example'}])
+    pl = PromptList(['head </E> tail'])
+    out = pl.replace('</E>', ice)
+    assert out[0] == 'head '
+    assert out[1] == {'role': 'HUMAN', 'prompt': 'example'}
+    assert out[2] == ' tail'
+
+
+def test_replace_promptlist_into_role_dict_raises():
+    pl = PromptList([{'role': 'HUMAN', 'prompt': 'has </E> token'}])
+    try:
+        pl.replace('</E>', PromptList(['x']))
+        raise AssertionError('expected TypeError')
+    except TypeError:
+        pass
