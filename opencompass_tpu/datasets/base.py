@@ -1,0 +1,31 @@
+"""Dataset base class.
+
+A dataset module provides a static ``load(**kwargs)`` returning a HuggingFace
+``Dataset``/``DatasetDict``; the instance wraps it in a
+:class:`~opencompass_tpu.icl.dataset_reader.DatasetReader` according to
+``reader_cfg``.  Parity: reference opencompass/datasets/base.py:9-28.
+"""
+from typing import Dict, Optional, Union
+
+from datasets import Dataset, DatasetDict
+
+from opencompass_tpu.icl.dataset_reader import DatasetReader
+
+
+class BaseDataset:
+
+    def __init__(self, reader_cfg: Optional[Dict] = None, **kwargs):
+        self.dataset = self.load(**kwargs)
+        self.reader = DatasetReader(self.dataset, **(reader_cfg or {}))
+
+    @property
+    def train(self) -> Dataset:
+        return self.reader.dataset['train']
+
+    @property
+    def test(self) -> Dataset:
+        return self.reader.dataset['test']
+
+    @staticmethod
+    def load(**kwargs) -> Union[Dataset, DatasetDict]:
+        raise NotImplementedError

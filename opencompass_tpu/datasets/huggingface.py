@@ -1,0 +1,52 @@
+"""Passthrough loaders.
+
+``HFDataset`` delegates to ``datasets.load_dataset`` (reference
+datasets/huggingface.py:8-13).  ``JsonDataset`` loads local JSON/JSONL files —
+the hermetic path used in air-gapped environments and tests.
+"""
+import json
+
+from datasets import Dataset, DatasetDict, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class HFDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        return load_dataset(**kwargs)
+
+
+@LOAD_DATASET.register_module()
+class JsonDataset(BaseDataset):
+    """Load splits from local JSON/JSONL files.
+
+    Args:
+        path: file for a single split, or dict of split -> file.
+    """
+
+    @staticmethod
+    def load(path, **kwargs):
+        if isinstance(path, dict):
+            return DatasetDict(
+                {split: JsonDataset._load_one(p)
+                 for split, p in path.items()})
+        return JsonDataset._load_one(path)
+
+    @staticmethod
+    def _load_one(path):
+        rows = []
+        with open(path, encoding='utf-8') as f:
+            if path.endswith('.jsonl'):
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+            else:
+                data = json.load(f)
+                rows = data if isinstance(data, list) else data['data']
+        return Dataset.from_list(rows)

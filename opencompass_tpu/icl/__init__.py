@@ -1,0 +1,2 @@
+from .dataset_reader import DatasetReader  # noqa
+from .prompt_template import PromptTemplate  # noqa

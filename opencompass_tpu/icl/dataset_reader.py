@@ -1,0 +1,103 @@
+"""DatasetReader — normalizes arbitrary datasets into train/test splits and
+declares which columns are model inputs vs the reference output.
+
+``test_range`` is a slice string like ``"[0:200]"`` written by the
+SizePartitioner when it splits an oversized dataset into row-range shards.
+Parity: reference openicl/icl_dataset_reader.py:16-242 (minus the
+torch-tokenizing DatasetEncoder, which the TPU TopK retriever replaces with
+host-side embedding).
+"""
+from typing import Dict, List, Optional, Union
+
+from datasets import Dataset, DatasetDict
+
+from opencompass_tpu.registry import ICL_DATASET_READERS
+from opencompass_tpu.utils.types import check_str, check_type_list
+
+
+def parse_range_str(range_str: str, n: int) -> List[int]:
+    """Parse ``"[a:b]"`` / ``"[a:b:c]"`` into row indices of a length-n split
+    without using ``eval``."""
+    body = range_str.strip()
+    if body.startswith('['):
+        body = body[1:]
+    if body.endswith(']'):
+        body = body[:-1]
+    parts = [p.strip() for p in body.split(':')]
+    if len(parts) == 1:
+        return [range(n)[int(parts[0])]]
+    vals = [int(p) if p else None for p in parts]
+    return list(range(n)[slice(*vals)])
+
+
+@ICL_DATASET_READERS.register_module()
+class DatasetReader:
+    """Wraps a dataset and its column roles.
+
+    Args:
+        dataset: ``Dataset`` or ``DatasetDict``.
+        input_columns: column name(s) rendered into the prompt.
+        output_column: the reference/label column (may be None for datasets
+            scored externally).
+        train_split / test_split: which raw splits play the train (in-context
+            example pool) and test roles.
+        test_range: optional slice string applied to the test split.
+    """
+
+    def __init__(self,
+                 dataset: Union[Dataset, DatasetDict],
+                 input_columns: Union[List[str], str],
+                 output_column: Optional[str],
+                 train_split: str = 'train',
+                 test_split: str = 'test',
+                 test_range: Optional[str] = None):
+        self.input_columns = check_type_list(input_columns, [List, str])
+        if isinstance(self.input_columns, str):
+            self.input_columns = self.input_columns.split()
+        self.output_column = None
+        if output_column:
+            self.output_column = check_str(output_column)
+
+        if isinstance(dataset, Dataset):
+            dataset = DatasetDict({'train': dataset, 'test': dataset})
+        else:
+            missing = [s for s in (train_split, test_split)
+                       if s not in dataset]
+            if missing:
+                raise KeyError(f'splits {missing} not found in dataset '
+                               f'(has {list(dataset.keys())})')
+            dataset = DatasetDict({
+                'train': dataset[train_split],
+                'test': dataset[test_split],
+            })
+        if test_range is not None:
+            idxs = parse_range_str(test_range, len(dataset['test']))
+            dataset = DatasetDict({
+                'train': dataset['train'],
+                'test': dataset['test'].select(idxs),
+            })
+        self.dataset = dataset
+
+    # -- corpora for retrieval --------------------------------------------
+    def generate_input_field_corpus(self, dataset: Dataset) -> List[str]:
+        """One space-joined string of the input columns per row — what
+        similarity retrievers embed/tokenize."""
+        return [
+            ' '.join(str(entry[col]) for col in self.input_columns)
+            for entry in dataset
+        ]
+
+    def generate_output_field_corpus(self, dataset: Dataset) -> List[str]:
+        return [str(entry[self.output_column]) for entry in dataset]
+
+    def generate_input_output_field_corpus(self, dataset: Dataset) -> List[str]:
+        cols = list(self.input_columns)
+        if self.output_column:
+            cols.append(self.output_column)
+        return [
+            ' '.join(str(entry[col]) for col in cols) for entry in dataset
+        ]
+
+    def __repr__(self):
+        return (f'DatasetReader(input_columns={self.input_columns}, '
+                f'output_column={self.output_column})')

@@ -1,0 +1,5 @@
+from .base import BaseEvaluator  # noqa
+from .em import EMEvaluator  # noqa
+from .metrics import (AccEvaluator, AUCROCEvaluator, BleuEvaluator,  # noqa
+                      MccEvaluator, RandomEvaluator, RougeEvaluator,
+                      SquadEvaluator)

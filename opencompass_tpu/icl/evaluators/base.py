@@ -1,0 +1,9 @@
+"""Evaluator contract: ``score(predictions, references) -> dict`` with metric
+names as keys (reference icl_evaluator/icl_base_evaluator.py:5-10)."""
+from typing import List
+
+
+class BaseEvaluator:
+
+    def score(self, predictions: List, references: List) -> dict:
+        raise NotImplementedError

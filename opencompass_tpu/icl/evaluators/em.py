@@ -1,0 +1,26 @@
+"""Exact-match after general postprocessing of both sides
+(reference icl_evaluator/icl_em_evaluator.py:8-34)."""
+from typing import List
+
+from opencompass_tpu.registry import ICL_EVALUATORS
+from opencompass_tpu.utils.text_postprocessors import general_postprocess
+
+from .base import BaseEvaluator
+
+
+@ICL_EVALUATORS.register_module()
+class EMEvaluator(BaseEvaluator):
+
+    def score(self, predictions: List, references: List) -> dict:
+        if len(predictions) != len(references):
+            return {'error': 'predictions and references have different '
+                             'length'}
+        predictions = [general_postprocess(str(p)) for p in predictions]
+        processed_answers = [
+            [general_postprocess(str(a)) for a in (ref if isinstance(
+                ref, list) else [ref])] for ref in references
+        ]
+        correct = sum(
+            pred in answers
+            for pred, answers in zip(predictions, processed_answers))
+        return {'score': 100 * correct / max(1, len(predictions))}

@@ -1,0 +1,190 @@
+"""Core metric evaluators.
+
+The reference wraps HuggingFace ``evaluate`` (icl_hf_evaluator.py:9-199);
+that library is not a dependency here, so the metrics are computed natively
+(accuracy/MCC via sklearn, ROUGE via rouge_score, BLEU via sacrebleu, SQuAD
+F1/EM re-implemented from its standard definition).
+"""
+import random
+from typing import Callable, List, Optional
+
+from opencompass_tpu.registry import ICL_EVALUATORS
+
+from .base import BaseEvaluator
+
+
+class _MappingEvaluator(BaseEvaluator):
+    """Maps string labels to stable ints first, so metrics that need numeric
+    classes (accuracy, MCC) accept arbitrary label vocabularies (reference
+    AccEvaluator._preprocess, icl_hf_evaluator.py:66-108)."""
+
+    seed = 0
+
+    def _to_ids(self, predictions: List, references: List):
+        mapping = {}
+
+        def lookup(item):
+            key = str(item)
+            if key not in mapping:
+                mapping[key] = len(mapping)
+            return mapping[key]
+
+        return ([lookup(p) for p in predictions],
+                [lookup(r) for r in references])
+
+
+@ICL_EVALUATORS.register_module()
+class AccEvaluator(_MappingEvaluator):
+    """Classification accuracy (percentage)."""
+
+    def score(self, predictions: List, references: List) -> dict:
+        if len(predictions) != len(references):
+            return {'error': 'predictions and references have different '
+                             'length'}
+        pred_ids, ref_ids = self._to_ids(predictions, references)
+        correct = sum(p == r for p, r in zip(pred_ids, ref_ids))
+        return {'accuracy': 100 * correct / max(1, len(predictions))}
+
+
+@ICL_EVALUATORS.register_module()
+class MccEvaluator(_MappingEvaluator):
+    """Matthews correlation coefficient (×100)."""
+
+    def score(self, predictions: List, references: List) -> dict:
+        if len(predictions) != len(references):
+            return {'error': 'predictions and references have different '
+                             'length'}
+        from sklearn.metrics import matthews_corrcoef
+        pred_ids, ref_ids = self._to_ids(predictions, references)
+        return {
+            'matthews_correlation':
+            100 * float(matthews_corrcoef(ref_ids, pred_ids))
+        }
+
+
+@ICL_EVALUATORS.register_module()
+class RougeEvaluator(BaseEvaluator):
+    """ROUGE-1/2/L f-measures averaged over the corpus (×100)."""
+
+    def score(self, predictions: List, references: List) -> dict:
+        if len(predictions) != len(references):
+            return {'error': 'predictions and references have different '
+                             'length'}
+        from rouge_score import rouge_scorer
+        scorer = rouge_scorer.RougeScorer(
+            ['rouge1', 'rouge2', 'rougeL', 'rougeLsum'], use_stemmer=True)
+        totals = {k: 0.0 for k in ('rouge1', 'rouge2', 'rougeL', 'rougeLsum')}
+        for pred, ref in zip(predictions, references):
+            ref_list = ref if isinstance(ref, list) else [ref]
+            # multi-reference: best score over references
+            best = {k: 0.0 for k in totals}
+            for r in ref_list:
+                result = scorer.score(str(r), str(pred))
+                for k in totals:
+                    best[k] = max(best[k], result[k].fmeasure)
+            for k in totals:
+                totals[k] += best[k]
+        n = max(1, len(predictions))
+        return {k: 100 * v / n for k, v in totals.items()}
+
+
+@ICL_EVALUATORS.register_module()
+class BleuEvaluator(BaseEvaluator):
+    """Corpus BLEU via sacrebleu."""
+
+    def score(self, predictions: List, references: List) -> dict:
+        if len(predictions) != len(references):
+            return {'error': 'predictions and references have different '
+                             'length'}
+        import sacrebleu
+        refs = [[str(r) for r in (ref if isinstance(ref, list) else [ref])]
+                for ref in references]
+        max_refs = max(len(r) for r in refs)
+        ref_streams = [[
+            refs[i][j] if j < len(refs[i]) else refs[i][0]
+            for i in range(len(refs))
+        ] for j in range(max_refs)]
+        bleu = sacrebleu.corpus_bleu([str(p) for p in predictions],
+                                     ref_streams)
+        return {'bleu': bleu.score}
+
+
+def _squad_normalize(text: str) -> str:
+    import re
+    import string
+    text = str(text).lower()
+    text = ''.join(ch for ch in text if ch not in set(string.punctuation))
+    text = re.sub(r'\b(a|an|the)\b', ' ', text)
+    return ' '.join(text.split())
+
+
+def _squad_f1(pred: str, ref: str) -> float:
+    pred_tokens = _squad_normalize(pred).split()
+    ref_tokens = _squad_normalize(ref).split()
+    if not pred_tokens or not ref_tokens:
+        return float(pred_tokens == ref_tokens)
+    common = {}
+    for tok in pred_tokens:
+        common[tok] = common.get(tok, 0) + 1
+    overlap = 0
+    for tok in ref_tokens:
+        if common.get(tok, 0) > 0:
+            overlap += 1
+            common[tok] -= 1
+    if overlap == 0:
+        return 0.0
+    precision = overlap / len(pred_tokens)
+    recall = overlap / len(ref_tokens)
+    return 2 * precision * recall / (precision + recall)
+
+
+@ICL_EVALUATORS.register_module()
+class SquadEvaluator(BaseEvaluator):
+    """SQuAD-style token F1 and exact match over (possibly multi-) answers.
+
+    Predictions are truncated at the first newline before scoring, matching
+    the reference's behavior (icl_hf_evaluator.py:158-199) for few-shot QA
+    generations that continue with the next question.
+    """
+
+    def score(self, predictions: List, references: List) -> dict:
+        if len(predictions) != len(references):
+            return {'error': 'predictions and references have different '
+                             'length'}
+        f1_total, em_total = 0.0, 0.0
+        for pred, ref in zip(predictions, references):
+            pred = str(pred).split('\n')[0].strip()
+            answers = ref if isinstance(ref, list) else [ref]
+            f1_total += max(_squad_f1(pred, str(a)) for a in answers)
+            em_total += max(
+                float(_squad_normalize(pred) == _squad_normalize(str(a)))
+                for a in answers)
+        n = max(1, len(predictions))
+        return {'score': 100 * f1_total / n, 'exact_match': 100 * em_total / n}
+
+
+@ICL_EVALUATORS.register_module()
+class AUCROCEvaluator(BaseEvaluator):
+    """ROC-AUC over condprob predictions (prob vectors from CLPInferencer);
+    references are binary labels (reference icl_aucroc_evaluator.py:11-41)."""
+
+    def score(self, predictions: List, references: List) -> dict:
+        if len(predictions) != len(references):
+            return {'error': 'predictions and references have different '
+                             'length'}
+        from sklearn.metrics import roc_auc_score
+        scores = [p[1] if isinstance(p, (list, tuple)) else p
+                  for p in predictions]
+        return {'auc_score': 100 * float(roc_auc_score(references, scores))}
+
+
+@ICL_EVALUATORS.register_module()
+class RandomEvaluator(BaseEvaluator):
+    """Sanity-check evaluator: scores a random baseline."""
+
+    def score(self, predictions: List, references: List) -> dict:
+        rng = random.Random(0)
+        correct = sum(
+            rng.choice([p for p in set(map(str, predictions))] or ['']) ==
+            str(r) for r in references)
+        return {'score': 100 * correct / max(1, len(references))}

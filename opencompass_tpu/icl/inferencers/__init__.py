@@ -1,0 +1,3 @@
+from .base import BaseInferencer  # noqa
+from .gen import GenInferencer  # noqa
+from .ppl import PPLInferencer  # noqa

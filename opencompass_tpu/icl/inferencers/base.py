@@ -1,0 +1,107 @@
+"""Inferencer base + prediction output handlers.
+
+The output JSON written here is the framework's wire format: its existence
+signals task completion to partitioners/runners, and evaluators read it back.
+Parity: reference openicl/icl_inferencer/icl_base_inferencer.py:15-163.
+"""
+import json
+import os
+from pathlib import Path
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from opencompass_tpu.icl.retrievers.base import is_main_process
+
+
+class BaseInferencer:
+
+    def __init__(self,
+                 model,
+                 max_seq_len: Optional[int] = None,
+                 batch_size: int = 1,
+                 output_json_filepath: str = './icl_inference_output',
+                 output_json_filename: str = 'predictions',
+                 **kwargs):
+        self.model = model
+        self.max_seq_len = max_seq_len
+        self.batch_size = batch_size
+        self.output_json_filepath = output_json_filepath
+        self.output_json_filename = output_json_filename
+        self.is_main_process = is_main_process()
+
+    @staticmethod
+    def get_batches(items: List, batch_size: int) -> Iterator[List]:
+        """Plain host-side batching — no torch DataLoader on the TPU path."""
+        for i in range(0, len(items), batch_size):
+            yield items[i:i + batch_size]
+
+    def inference(self, retriever, ice_template=None, prompt_template=None,
+                  output_json_filepath=None, output_json_filename=None):
+        raise NotImplementedError
+
+
+def dump_results_dict(results_dict, filename):
+    os.makedirs(os.path.dirname(os.path.abspath(filename)), exist_ok=True)
+    with open(filename, 'w', encoding='utf-8') as f:
+        json.dump(results_dict, f, indent=4, ensure_ascii=False)
+
+
+def load_results_dict(filename):
+    with open(filename, encoding='utf-8') as f:
+        return json.load(f)
+
+
+class GenInferencerOutputHandler:
+    """``{idx: {origin_prompt, prediction}}``"""
+
+    def __init__(self):
+        self.results_dict = {}
+
+    def write_to_json(self, save_dir: str, filename: str):
+        dump_results_dict(self.results_dict, str(Path(save_dir) / filename))
+
+    def save_results(self, origin_prompt, prediction, idx):
+        self.results_dict[str(idx)] = {
+            'origin_prompt': origin_prompt,
+            'prediction': prediction,
+        }
+
+
+class PPLInferencerOutputHandler:
+    """Per-item record: in-context examples, per-label prompt + PPL, and the
+    final argmin-PPL prediction."""
+
+    def __init__(self):
+        self.results_dict = {}
+
+    def write_to_json(self, save_dir: str, filename: str):
+        dump_results_dict(self.results_dict, str(Path(save_dir) / filename))
+
+    def _entry(self, idx):
+        return self.results_dict.setdefault(str(idx), {})
+
+    def save_ice(self, ice):
+        for idx, example in enumerate(ice):
+            self._entry(idx)['in-context examples'] = example
+
+    def save_predictions(self, predictions):
+        for idx, prediction in enumerate(predictions):
+            self._entry(idx)['prediction'] = prediction
+
+    def save_prompt_and_ppl(self, label, testing_input, prompt, ppl, idx):
+        record = self._entry(idx).setdefault(f'label: {label}', {})
+        record['testing input'] = testing_input
+        record['prompt'] = prompt
+        record['PPL'] = float(ppl)
+
+    def save_prompt_and_condprob(self, testing_input, prompt, cond_prob, idx,
+                                 choices):
+        entry = self._entry(idx)
+        entry['testing input'] = testing_input
+        entry['prompt'] = prompt
+        entry['choices'] = choices
+        # Prob vector doubles as the prediction so AUC-style evaluators can
+        # consume it directly; pred_label is the argmax convenience.
+        entry['prediction'] = cond_prob
+        entry['pred_label'] = int(np.argmax(cond_prob))

@@ -1,0 +1,136 @@
+"""Generation inferencer — the free-form completion measurement path.
+
+Pipeline: retrieve example ids → render prompts (dropping trailing in-context
+examples until each prompt fits ``max_seq_len``) → resume from a ``tmp_``
+partial file if present → batched ``generate_from_template`` → periodic
+``save_every`` flushes → final predictions JSON.
+Parity: reference openicl/icl_inferencer/icl_gen_inferencer.py:22-183.
+"""
+import os
+import os.path as osp
+from typing import List, Optional
+
+from opencompass_tpu.registry import ICL_INFERENCERS
+from opencompass_tpu.utils.logging import get_logger
+
+from .base import (BaseInferencer, GenInferencerOutputHandler,
+                   load_results_dict)
+
+logger = get_logger()
+
+
+@ICL_INFERENCERS.register_module()
+class GenInferencer(BaseInferencer):
+
+    def __init__(self,
+                 model,
+                 max_out_len: int,
+                 max_seq_len: Optional[int] = None,
+                 batch_size: int = 1,
+                 gen_field_replace_token: str = '',
+                 output_json_filepath: str = './icl_inference_output',
+                 output_json_filename: str = 'predictions',
+                 save_every: Optional[int] = None,
+                 fix_id_list: Optional[List[int]] = None,
+                 **kwargs):
+        super().__init__(model=model,
+                         max_seq_len=max_seq_len,
+                         batch_size=batch_size,
+                         output_json_filepath=output_json_filepath,
+                         output_json_filename=output_json_filename,
+                         **kwargs)
+        self.gen_field_replace_token = gen_field_replace_token
+        self.max_out_len = max_out_len
+        self.fix_id_list = fix_id_list
+        if self.model.is_api and save_every is None:
+            save_every = 1  # API calls are slow and flaky: flush each batch
+        self.save_every = save_every
+
+    def inference(self,
+                  retriever,
+                  ice_template=None,
+                  prompt_template=None,
+                  output_json_filepath: Optional[str] = None,
+                  output_json_filename: Optional[str] = None) -> List:
+        output_handler = GenInferencerOutputHandler()
+        output_json_filepath = output_json_filepath \
+            or self.output_json_filepath
+        output_json_filename = output_json_filename \
+            or self.output_json_filename
+
+        if 'Fix' in type(retriever).__name__ and self.fix_id_list:
+            ice_idx_list = retriever.retrieve(self.fix_id_list)
+        else:
+            ice_idx_list = retriever.retrieve()
+
+        prompt_list = self.build_prompt_list(
+            ice_idx_list,
+            retriever,
+            ice_template=ice_template,
+            prompt_template=prompt_template)
+
+        # Sample-level resume: pick up from a tmp_ flush of a previous run.
+        index = 0
+        tmp_json_filepath = os.path.join(output_json_filepath,
+                                         'tmp_' + output_json_filename)
+        if osp.exists(tmp_json_filepath):
+            output_handler.results_dict = load_results_dict(tmp_json_filepath)
+            index = len(output_handler.results_dict)
+
+        logger.info('Starting inference process...')
+        for entry in self.get_batches(prompt_list[index:], self.batch_size):
+            parsed_entries = self.model.parse_template(entry, mode='gen')
+            generated = self.model.generate_from_template(
+                entry, max_out_len=self.max_out_len)
+            for prompt, prediction in zip(parsed_entries, generated):
+                output_handler.save_results(prompt, prediction, index)
+                index += 1
+            if (self.save_every is not None and index % self.save_every == 0
+                    and self.is_main_process):
+                output_handler.write_to_json(output_json_filepath,
+                                             'tmp_' + output_json_filename)
+
+        if self.is_main_process:
+            os.makedirs(output_json_filepath, exist_ok=True)
+            output_handler.write_to_json(output_json_filepath,
+                                         output_json_filename)
+            if osp.exists(tmp_json_filepath):
+                os.remove(tmp_json_filepath)
+        return [
+            sample['prediction']
+            for sample in output_handler.results_dict.values()
+        ]
+
+    def build_prompt_list(self,
+                          ice_idx_list,
+                          retriever,
+                          ice_template=None,
+                          prompt_template=None) -> List:
+        """Render every prompt, shrinking each one's in-context example list
+        from the tail until it fits ``max_seq_len``."""
+        prompt_list = []
+        for idx, ice_idx in enumerate(ice_idx_list):
+            ice = retriever.generate_ice(ice_idx, ice_template=ice_template)
+            prompt = retriever.generate_prompt_for_generate_task(
+                idx,
+                ice,
+                gen_field_replace_token=self.gen_field_replace_token,
+                ice_template=ice_template,
+                prompt_template=prompt_template)
+            if self.max_seq_len is not None:
+                token_num = self.model.get_token_len_from_template(prompt,
+                                                                   mode='gen')
+                while len(ice_idx) > 0 and token_num > self.max_seq_len:
+                    ice_idx = ice_idx[:-1]
+                    ice = retriever.generate_ice(ice_idx,
+                                                 ice_template=ice_template)
+                    prompt = retriever.generate_prompt_for_generate_task(
+                        idx,
+                        ice,
+                        gen_field_replace_token=self.gen_field_replace_token,
+                        ice_template=ice_template,
+                        prompt_template=prompt_template)
+                    token_num = self.model.get_token_len_from_template(
+                        prompt, mode='gen')
+            prompt_list.append(prompt)
+        return prompt_list

@@ -1,0 +1,171 @@
+"""Perplexity inferencer — the label-ranking measurement path.
+
+For each candidate label, every test item is rendered into a label-conditional
+prompt and scored by mean per-token NLL; the prediction is the argmin-PPL
+label.  With ``normalizing_str`` the prompt is split at the template's
+``sep_token`` into context+answer, and the score is
+``PPL(context+answer | mask context) − PPL(normalizing_str+answer | mask
+normalizing_str)`` — length-normalized conditional scoring.
+Parity: reference openicl/icl_inferencer/icl_ppl_inferencer.py:20-212.
+"""
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from opencompass_tpu.registry import ICL_INFERENCERS
+from opencompass_tpu.utils.logging import get_logger
+
+from .base import BaseInferencer, PPLInferencerOutputHandler
+
+logger = get_logger()
+
+
+@ICL_INFERENCERS.register_module()
+class PPLInferencer(BaseInferencer):
+
+    def __init__(self,
+                 model,
+                 max_seq_len: Optional[int] = None,
+                 batch_size: int = 1,
+                 output_json_filepath: str = './icl_inference_output',
+                 output_json_filename: str = 'predictions',
+                 labels: Optional[List] = None,
+                 fix_id_list: Optional[List[int]] = None,
+                 **kwargs):
+        super().__init__(model=model,
+                         max_seq_len=max_seq_len,
+                         batch_size=batch_size,
+                         output_json_filepath=output_json_filepath,
+                         output_json_filename=output_json_filename,
+                         **kwargs)
+        self.labels = labels
+        self.fix_id_list = fix_id_list
+
+    def inference(self,
+                  retriever,
+                  ice_template=None,
+                  prompt_template=None,
+                  output_json_filepath: Optional[str] = None,
+                  output_json_filename: Optional[str] = None,
+                  normalizing_str: Optional[str] = None) -> List:
+        output_handler = PPLInferencerOutputHandler()
+        output_json_filepath = output_json_filepath \
+            or self.output_json_filepath
+        output_json_filename = output_json_filename \
+            or self.output_json_filename
+
+        if self.fix_id_list:
+            ice_idx_list = retriever.retrieve(self.fix_id_list)
+        else:
+            ice_idx_list = retriever.retrieve()
+
+        labels = self.labels if self.labels is not None else \
+            retriever.get_labels(ice_template=ice_template,
+                                 prompt_template=prompt_template)
+
+        ice = [
+            retriever.generate_ice(ice_idx_list[idx],
+                                   ice_template=ice_template)
+            for idx in range(len(ice_idx_list))
+        ]
+        output_handler.save_ice(self.model.parse_template(ice, mode='ppl'))
+
+        label_ppls = []
+        for label in labels:
+            index = 0
+            prompt_list = []
+            sub_ppl_list = []
+            normalizing_prompt_list = []
+            context_length_list = []
+
+            for idx in range(len(ice_idx_list)):
+                prompt = retriever.generate_label_prompt(
+                    idx,
+                    ice[idx],
+                    label,
+                    ice_template=ice_template,
+                    prompt_template=prompt_template,
+                    remain_sep=normalizing_str is not None)
+                if self.max_seq_len is not None:
+                    token_num = self.model.get_token_len_from_template(
+                        prompt, mode='ppl')
+                    while len(ice_idx_list[idx]) > 0 \
+                            and token_num > self.max_seq_len:
+                        ice_idx_list[idx] = ice_idx_list[idx][:-1]
+                        ice[idx] = retriever.generate_ice(
+                            ice_idx_list[idx], ice_template=ice_template)
+                        prompt = retriever.generate_label_prompt(
+                            idx,
+                            ice[idx],
+                            label,
+                            ice_template=ice_template,
+                            prompt_template=prompt_template)
+                        token_num = self.model.get_token_len_from_template(
+                            prompt, mode='ppl')
+
+                if normalizing_str is not None:
+                    assert isinstance(prompt, str), (
+                        'normalizing_str requires plain-string prompts')
+                    sep_token = (prompt_template.sep_token
+                                 if prompt_template is not None else
+                                 ice_template.sep_token)
+                    sep_pos = prompt.find(sep_token)
+                    context = prompt[:sep_pos]
+                    answer = prompt[sep_pos:].replace(sep_token, '')
+                    prompt = context + answer
+                    normalizing_prompt_list.append(normalizing_str + answer)
+                    context_length_list.append(
+                        self.model.get_token_len_from_template(context,
+                                                               mode='ppl'))
+                prompt_list.append(prompt)
+
+            if normalizing_str is not None:
+                norm_len = self.model.get_token_len_from_template(
+                    normalizing_str, mode='ppl')
+
+            logger.info(f"Calculating PPL for prompts labeled '{label}'")
+            for start in range(0, len(prompt_list), self.batch_size):
+                sub_prompt_list = prompt_list[start:start + self.batch_size]
+                if normalizing_str is not None:
+                    sub_ctx_lens = context_length_list[start:start +
+                                                       self.batch_size]
+                    sub_norm_prompts = normalizing_prompt_list[
+                        start:start + self.batch_size]
+                    res1 = np.asarray(
+                        self.model.get_ppl_from_template(
+                            sub_prompt_list, mask_length=sub_ctx_lens))
+                    res2 = np.asarray(
+                        self.model.get_ppl_from_template(
+                            sub_norm_prompts,
+                            mask_length=[norm_len] * len(sub_norm_prompts)))
+                    sub_res = (res1 - res2).tolist()
+                else:
+                    sub_res = list(
+                        self.model.get_ppl_from_template(sub_prompt_list))
+                for res, prompt in zip(
+                        sub_res,
+                        self.model.parse_template(sub_prompt_list,
+                                                  mode='ppl')):
+                    sub_ppl_list.append(res)
+                    ice_str = str(
+                        self.model.parse_template(ice[index], mode='ppl'))
+                    output_handler.save_prompt_and_ppl(
+                        label, prompt.replace(ice_str, ''), prompt, res,
+                        index)
+                    index += 1
+            label_ppls.append(sub_ppl_list)
+
+        predictions = []
+        for per_item in zip(*label_ppls):
+            predictions.append(labels[per_item.index(min(per_item))])
+        output_handler.save_predictions(predictions)
+
+        if self.is_main_process:
+            os.makedirs(output_json_filepath, exist_ok=True)
+            output_handler.write_to_json(output_json_filepath,
+                                         output_json_filename)
+        return [
+            sample['prediction']
+            for sample in output_handler.results_dict.values()
+        ]

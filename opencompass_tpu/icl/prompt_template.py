@@ -1,0 +1,152 @@
+"""PromptTemplate — renders a single dataset entry into the prompt IR.
+
+Template forms (parity: reference openicl/icl_prompt_template.py:13-259):
+
+* plain ``str`` with ``{column}`` placeholders;
+* ``dict`` mapping each label to a template (PPL mode — one prompt per label);
+* "meta" ``dict`` with only ``begin``/``round``/``end`` keys, whose rounds are
+  role dicts — encoded into a sectioned :class:`PromptList` for the model's
+  meta-template parser.
+
+``ice_token`` marks where in-context examples are spliced in; ``sep_token``
+marks the context/answer boundary used by normalized-PPL scoring.
+"""
+import copy
+from typing import Dict, Hashable, List, Optional, Union
+
+from opencompass_tpu.registry import ICL_PROMPT_TEMPLATES
+from opencompass_tpu.utils.prompt import PromptList, safe_format
+from opencompass_tpu.utils.types import check_type_list
+
+PromptType = Union[PromptList, str]
+
+
+@ICL_PROMPT_TEMPLATES.register_module()
+class PromptTemplate:
+
+    def __init__(self,
+                 template: Union[Dict, str],
+                 ice_token: Optional[str] = None,
+                 sep_token: Optional[str] = None):
+        self.template = template
+        assert isinstance(self.template, (str, Dict))
+        self.ice_token = check_type_list(ice_token, [None, str])
+        self.sep_token = check_type_list(sep_token, [None, str])
+        self.prompt_type = 'origin'
+        self._validate()
+
+    def _validate(self):
+        if isinstance(self.template, Dict):
+            meta_keys = sum(k in self.template
+                            for k in ('begin', 'round', 'end'))
+            if meta_keys == len(self.template):
+                self.prompt_type = 'meta'
+            for value in self.template.values():
+                if not isinstance(value, (str, list, dict)):
+                    raise TypeError('template dict values must be '
+                                    f'str/list/dict, got {value!r}')
+                if isinstance(value, str) and self.ice_token \
+                        and self.ice_token not in value:
+                    raise LookupError(
+                        f'ice_token {self.ice_token!r} not in {value!r}')
+        elif self.ice_token and self.ice_token not in self.template:
+            raise LookupError(
+                f'ice_token {self.ice_token!r} not in template')
+
+    # -- rendering ---------------------------------------------------------
+    def generate_ice_item(self, entry: Dict, label: Hashable) -> PromptType:
+        """Render one in-context example (answer included)."""
+        if isinstance(self.template, str) or self.prompt_type == 'meta':
+            tp = self.template
+        else:
+            tp = self.template[label]
+        tp = self._encode(tp, ice=True)
+        if self.sep_token is not None:
+            tp = tp.replace(self.sep_token, '')
+        if self.ice_token is not None:
+            tp = tp.replace(self.ice_token, '')
+        return self._fill(tp, entry)
+
+    def generate_label_prompt_item(self,
+                                   entry: Dict,
+                                   ice: PromptType,
+                                   label: Hashable,
+                                   remain_sep: bool = False) -> PromptType:
+        """Render the full prompt for one (test item, candidate label) pair —
+        the PPL-mode unit of work."""
+        if isinstance(self.template, str) or self.prompt_type == 'meta':
+            tp = self.template
+        else:
+            tp = self.template[label]
+        tp = self._encode(tp, ice=False)
+        if not remain_sep and self.sep_token is not None:
+            tp = tp.replace(self.sep_token, '')
+        if self.ice_token is not None:
+            tp = tp.replace(self.ice_token, ice)
+        return self._fill(tp, entry)
+
+    def generate_item(self,
+                      entry: Dict,
+                      output_field: Optional[Hashable] = None,
+                      output_field_replace_token: str = '',
+                      ice_field_replace_token: str = '') -> PromptType:
+        """Render the gen-mode prompt: the output column is blanked so the
+        model must produce it."""
+        if isinstance(self.template, str):
+            tp = self.template
+        elif self.prompt_type == 'origin':
+            tp = self.template[next(iter(self.template))]
+            tp = self._encode(tp, ice=False)
+        else:
+            tp = self._encode(self.template, ice=False)
+        if self.ice_token is not None:
+            tp = tp.replace(self.ice_token, ice_field_replace_token)
+        if self.sep_token is not None:
+            tp = tp.replace(self.sep_token, '')
+        if output_field is not None:
+            entry = copy.deepcopy(entry)
+            entry[output_field] = output_field_replace_token
+        return self._fill(tp, entry)
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _fill(tp: PromptType, entry: Dict) -> PromptType:
+        if isinstance(tp, str):
+            return safe_format(tp, **entry)
+        return tp.format(**entry)
+
+    def _encode(self, template: Union[List, Dict, str],
+                ice: bool) -> PromptType:
+        """Wrap a meta-style template's round list with section markers.
+
+        In-context examples carry only the ``round`` turns (no begin/end
+        sections), wrapped in an ``ice`` section so the meta-template parser
+        never gen-truncates inside them."""
+        if isinstance(template, str):
+            return template
+        prompt = PromptList()
+        if not ice and 'begin' in template:
+            prompt.append(dict(section='begin', pos='begin'))
+            begin = template['begin']
+            if isinstance(begin, list):
+                prompt += begin
+            else:
+                prompt.append(begin)
+            prompt.append(dict(section='begin', pos='end'))
+        section = 'ice' if ice else 'round'
+        prompt.append(dict(section=section, pos='begin'))
+        prompt += template['round']
+        prompt.append(dict(section=section, pos='end'))
+        if not ice and 'end' in template:
+            prompt.append(dict(section='end', pos='begin'))
+            end = template['end']
+            if isinstance(end, list):
+                prompt += end
+            else:
+                prompt.append(end)
+            prompt.append(dict(section='end', pos='end'))
+        return prompt
+
+    def __repr__(self):
+        return (f'PromptTemplate(template={self.template!r}, '
+                f'ice_token={self.ice_token!r})')

@@ -1,0 +1,4 @@
+from .base import BaseRetriever  # noqa
+from .fix_k import FixKRetriever  # noqa
+from .random_retriever import RandomRetriever  # noqa
+from .zero import ZeroRetriever  # noqa

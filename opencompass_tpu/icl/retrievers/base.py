@@ -1,0 +1,137 @@
+"""Base in-context-example retriever.
+
+A retriever picks, for every test item, the indices of train-split rows to use
+as in-context examples, and knows how to render them (plus the test item) into
+prompts via the ice/prompt templates.
+Parity: reference openicl/icl_retriever/icl_base_retriever.py:11-208.
+"""
+from abc import abstractmethod
+from typing import List, Optional
+
+from opencompass_tpu.icl.prompt_template import PromptTemplate
+from opencompass_tpu.utils.prompt import PromptList
+
+
+def is_main_process() -> bool:
+    """True on JAX process 0 (replaces mmengine.dist.is_main_process)."""
+    import os
+    for var in ('JAX_PROCESS_INDEX', 'PROCESS_INDEX'):
+        if var in os.environ:
+            try:
+                return int(os.environ[var]) == 0
+            except ValueError:
+                pass
+    return True
+
+
+class BaseRetriever:
+    """Args:
+        dataset: a ``BaseDataset`` (uses its ``reader``/``train``/``test``).
+        ice_separator: joiner between plain-string in-context examples.
+        ice_eos_token: terminator appended after the last example.
+        ice_num: how many examples to retrieve per test item.
+    """
+
+    def __init__(self,
+                 dataset,
+                 ice_separator: str = '\n',
+                 ice_eos_token: str = '\n',
+                 ice_num: int = 1):
+        self.ice_separator = ice_separator
+        self.ice_eos_token = ice_eos_token
+        self.ice_num = ice_num
+        self.is_main_process = is_main_process()
+        self.dataset_reader = dataset.reader
+        self.index_ds = dataset.train
+        self.test_ds = dataset.test
+
+    @abstractmethod
+    def retrieve(self) -> List[List[int]]:
+        """In-context example indices for each test item."""
+
+    def get_labels(self,
+                   ice_template: Optional[PromptTemplate] = None,
+                   prompt_template: Optional[PromptTemplate] = None):
+        """Candidate labels for PPL ranking: template dict keys if available,
+        else the unique values of the output column."""
+        if prompt_template is not None \
+                and isinstance(prompt_template.template, dict):
+            return list(prompt_template.template.keys())
+        if ice_template is not None and ice_template.ice_token is not None \
+                and isinstance(ice_template.template, dict):
+            return list(ice_template.template.keys())
+        return list(set(self.test_ds[self.dataset_reader.output_column]))
+
+    def generate_ice(self,
+                     idx_list: List[int],
+                     ice_template: Optional[PromptTemplate] = None):
+        """Join the rendered in-context examples for one test item."""
+        if ice_template is None:
+            assert len(idx_list) == 0, (
+                'ice_template is required when the retriever returns '
+                'non-empty example lists; use ZeroRetriever for zero-shot.')
+            return ''
+        if ice_template.prompt_type == 'meta':
+            ice_separator, ice_eos_token = '', ''
+        else:
+            ice_separator = self.ice_separator
+            ice_eos_token = self.ice_eos_token
+        items = [
+            ice_template.generate_ice_item(
+                self.index_ds[idx],
+                self.index_ds[idx][self.dataset_reader.output_column])
+            for idx in idx_list
+        ]
+        if items and isinstance(items[0], PromptList):
+            ice = PromptList()
+            for item in items:
+                ice += item + ice_separator
+            ice.append(ice_eos_token)
+            return ice
+        return ice_separator.join(items) + ice_eos_token
+
+    def generate_label_prompt(self,
+                              idx: int,
+                              ice,
+                              label,
+                              ice_template: Optional[PromptTemplate] = None,
+                              prompt_template: Optional[PromptTemplate] = None,
+                              remain_sep: bool = False):
+        """PPL-mode prompt for one (test item, label)."""
+        template = self._pick_template(ice_template, prompt_template)
+        return template.generate_label_prompt_item(self.test_ds[idx], ice,
+                                                   label, remain_sep)
+
+    def generate_prompt_for_generate_task(
+            self,
+            idx: int,
+            ice,
+            gen_field_replace_token: str = '',
+            ice_template: Optional[PromptTemplate] = None,
+            prompt_template: Optional[PromptTemplate] = None):
+        """Gen-mode prompt for one test item (answer field blanked)."""
+        template = self._pick_template(ice_template, prompt_template)
+        return template.generate_item(
+            self.test_ds[idx],
+            output_field=self.dataset_reader.output_column,
+            output_field_replace_token=gen_field_replace_token,
+            ice_field_replace_token=ice)
+
+    @staticmethod
+    def _pick_template(ice_template, prompt_template) -> PromptTemplate:
+        """prompt_template renders the final prompt when given (it must carry
+        the ice_token if examples are in play); otherwise the ice_template
+        doubles as the prompt template."""
+        if prompt_template is not None and ice_template is not None:
+            if prompt_template.ice_token is None:
+                raise ValueError('prompt_template has no ice_token but '
+                                 'in-context examples were requested')
+            return prompt_template
+        if prompt_template is not None:
+            return prompt_template
+        if ice_template is not None:
+            if ice_template.ice_token is None:
+                raise ValueError('ice_template used as prompt template needs '
+                                 'an ice_token')
+            return ice_template
+        raise ValueError('either ice_template or prompt_template is required')

@@ -1,0 +1,32 @@
+"""Fixed-id retriever: the same `fix_id_list` examples for every test item —
+the standard k-shot setup (reference icl_fix_k_retriever.py:15-52)."""
+from typing import List, Optional
+
+from opencompass_tpu.registry import ICL_RETRIEVERS
+
+from .base import BaseRetriever
+
+
+@ICL_RETRIEVERS.register_module()
+class FixKRetriever(BaseRetriever):
+
+    def __init__(self,
+                 dataset,
+                 fix_id_list: Optional[List[int]] = None,
+                 ice_separator: str = '\n',
+                 ice_eos_token: str = '\n',
+                 ice_num: int = 1):
+        super().__init__(dataset, ice_separator, ice_eos_token, ice_num)
+        self.fix_id_list = fix_id_list
+
+    def retrieve(self, id_list: Optional[List[int]] = None) -> List[List[int]]:
+        ids = id_list if id_list is not None else self.fix_id_list
+        if ids is None:
+            raise ValueError('FixKRetriever needs fix_id_list (from config) '
+                             'or an id_list argument')
+        n = len(self.index_ds)
+        for i in ids:
+            if i >= n:
+                raise IndexError(f'fix id {i} out of range for train split '
+                                 f'of size {n}')
+        return [list(ids) for _ in range(len(self.test_ds))]

@@ -1,0 +1,29 @@
+"""Seeded random retriever (reference icl_random_retriever.py:14-40)."""
+from typing import List, Optional
+
+import numpy as np
+
+from opencompass_tpu.registry import ICL_RETRIEVERS
+
+from .base import BaseRetriever
+
+
+@ICL_RETRIEVERS.register_module()
+class RandomRetriever(BaseRetriever):
+
+    def __init__(self,
+                 dataset,
+                 ice_separator: str = '\n',
+                 ice_eos_token: str = '\n',
+                 ice_num: int = 1,
+                 seed: int = 43):
+        super().__init__(dataset, ice_separator, ice_eos_token, ice_num)
+        self.seed = seed
+
+    def retrieve(self, id_list: Optional[List[int]] = None) -> List[List[int]]:
+        rng = np.random.default_rng(self.seed)
+        num_idx = len(self.index_ds)
+        return [
+            rng.choice(num_idx, self.ice_num, replace=False).tolist()
+            for _ in range(len(self.test_ds))
+        ]

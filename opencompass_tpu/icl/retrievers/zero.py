@@ -1,0 +1,16 @@
+"""Zero-shot retriever (reference icl_zero_retriever.py:10-26)."""
+from typing import List, Optional
+
+from opencompass_tpu.registry import ICL_RETRIEVERS
+
+from .base import BaseRetriever
+
+
+@ICL_RETRIEVERS.register_module()
+class ZeroRetriever(BaseRetriever):
+
+    def __init__(self, dataset, ice_eos_token: str = ''):
+        super().__init__(dataset, '', ice_eos_token, 0)
+
+    def retrieve(self, id_list: Optional[List[int]] = None) -> List[List[int]]:
+        return [[] for _ in range(len(self.test_ds))]

@@ -1,0 +1,146 @@
+"""End-to-end ICL engine tests on a FakeModel: PPL ranking, generation,
+truncation loops, resume."""
+import json
+
+from datasets import Dataset, DatasetDict
+
+from opencompass_tpu.datasets.base import BaseDataset
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.prompt_template import PromptTemplate
+from opencompass_tpu.icl.retrievers import FixKRetriever, ZeroRetriever
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.models import FakeModel
+
+
+class ToyDataset(BaseDataset):
+
+    @staticmethod
+    def load(n_test=4):
+        train = Dataset.from_list([
+            {'question': f'train q{i}', 'answer': 'A' if i % 2 == 0 else 'B'}
+            for i in range(8)
+        ])
+        test = Dataset.from_list([
+            {'question': f'test q{i}', 'answer': 'A' if i % 2 == 0 else 'B'}
+            for i in range(n_test)
+        ])
+        return DatasetDict({'train': train, 'test': test})
+
+
+READER_CFG = dict(input_columns=['question'], output_column='answer')
+
+
+def test_ppl_inference_ranking(tmp_path):
+    ds = ToyDataset(reader_cfg=READER_CFG)
+    # label-keyed template: PPL mode scores each candidate answer
+    template = PromptTemplate({
+        'A': '</E>Q: {question}\nA: A',
+        'B': '</E>Q: {question}\nA: B',
+    }, ice_token='</E>')
+    # canned: 'A: A' prompts get low ppl for even questions
+    model = FakeModel(canned_ppls={
+        'q0\nA: A': 1.0, 'q0\nA: B': 5.0,
+        'q1\nA: A': 5.0, 'q1\nA: B': 1.0,
+        'q2\nA: A': 1.0, 'q2\nA: B': 5.0,
+        'q3\nA: A': 5.0, 'q3\nA: B': 1.0,
+    })
+    retriever = ZeroRetriever(ds)
+    inferencer = PPLInferencer(model=model, batch_size=2,
+                               output_json_filepath=str(tmp_path))
+    preds = inferencer.inference(retriever, prompt_template=template)
+    assert preds == ['A', 'B', 'A', 'B']
+    # perfect accuracy against references
+    result = AccEvaluator().score(preds, ds.test['answer'])
+    assert result['accuracy'] == 100.0
+    # output JSON structure
+    saved = json.loads((tmp_path / 'predictions').read_text())
+    assert saved['0']['prediction'] == 'A'
+    assert 'label: A' in saved['0'] and 'PPL' in saved['0']['label: A']
+
+
+def test_gen_inference_with_ice(tmp_path):
+    ds = ToyDataset(reader_cfg=READER_CFG)
+    ice_template = PromptTemplate('Q: {question}\nA: {answer}')
+    prompt_template = PromptTemplate('</E>Q: {question}\nA: {answer}',
+                                     ice_token='</E>')
+    model = FakeModel(canned_responses={'test q0': 'A', 'test q1': 'B',
+                                        'test q2': 'B', 'test q3': 'B'})
+    retriever = FixKRetriever(ds, fix_id_list=[0, 1])
+    inferencer = GenInferencer(model=model, max_out_len=10, batch_size=3,
+                               output_json_filepath=str(tmp_path))
+    preds = inferencer.inference(retriever, ice_template=ice_template,
+                                 prompt_template=prompt_template)
+    assert preds == ['A', 'B', 'B', 'B']
+    saved = json.loads((tmp_path / 'predictions').read_text())
+    # prompt contains the two in-context examples and blanked answer
+    assert 'train q0' in saved['0']['origin_prompt']
+    assert saved['0']['origin_prompt'].endswith('Q: test q0\nA: ')
+    result = EMEvaluator().score(preds, ds.test['answer'])
+    assert result['score'] == 75.0
+
+
+def test_gen_truncation_drops_ice(tmp_path):
+    ds = ToyDataset(reader_cfg=READER_CFG)
+    ice_template = PromptTemplate('Q: {question}\nA: {answer}')
+    prompt_template = PromptTemplate('</E>Q: {question}\nA: {answer}',
+                                     ice_token='</E>')
+    model = FakeModel()  # token len = word count
+    retriever = FixKRetriever(ds, fix_id_list=[0, 1, 2, 3])
+    # 4 ice ≈ 4*6 + 6 words; cap at 20 so some ice must drop
+    inferencer = GenInferencer(model=model, max_out_len=5, max_seq_len=20,
+                               batch_size=2,
+                               output_json_filepath=str(tmp_path))
+    prompts = inferencer.build_prompt_list(
+        retriever.retrieve(), retriever,
+        ice_template=ice_template, prompt_template=prompt_template)
+    for p in prompts:
+        assert model.get_token_len(str(p)) <= 20
+        assert 'train q0' in str(p)  # earliest ice survives
+
+
+def test_gen_resume_from_tmp(tmp_path):
+    ds = ToyDataset(reader_cfg=READER_CFG)
+    template = PromptTemplate('Q: {question}\nA: {answer}')
+    model = FakeModel(canned_responses={'test': 'X'})
+    retriever = ZeroRetriever(ds)
+    # Pre-seed a tmp file holding 2 fake results
+    tmp_file = tmp_path / 'tmp_predictions'
+    tmp_file.write_text(json.dumps({
+        '0': {'origin_prompt': 'p0', 'prediction': 'SAVED0'},
+        '1': {'origin_prompt': 'p1', 'prediction': 'SAVED1'},
+    }))
+    inferencer = GenInferencer(model=model, max_out_len=5, batch_size=2,
+                               output_json_filepath=str(tmp_path))
+    preds = inferencer.inference(retriever, prompt_template=template)
+    assert preds[:2] == ['SAVED0', 'SAVED1']  # resumed, not recomputed
+    assert preds[2:] == ['X', 'X']
+    assert not tmp_file.exists()  # tmp removed after final write
+
+
+def test_ppl_normalizing_str(tmp_path):
+    ds = ToyDataset(reader_cfg=READER_CFG, n_test=1)
+    template = PromptTemplate({
+        'A': 'ctx {question}</S>answer A',
+        'B': 'ctx {question}</S>answer B',
+    }, sep_token='</S>')
+    calls = []
+
+    class SpyModel(FakeModel):
+
+        def get_ppl(self, inputs, mask_length=None):
+            calls.append((list(map(str, inputs)), mask_length))
+            return [1.0] * len(inputs)
+
+    model = SpyModel()
+    retriever = ZeroRetriever(ds)
+    inferencer = PPLInferencer(model=model, batch_size=1,
+                               output_json_filepath=str(tmp_path))
+    inferencer.inference(retriever, prompt_template=template,
+                         normalizing_str='NORM')
+    # two labels × (real + normalizing) calls
+    assert len(calls) == 4
+    real_inputs, real_mask = calls[0]
+    assert real_inputs[0] == 'ctx test q0answer A'
+    assert real_mask is not None
+    norm_inputs, norm_mask = calls[1]
+    assert norm_inputs[0] == 'NORManswer A'
