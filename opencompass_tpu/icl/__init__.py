@@ -1,2 +1,6 @@
 from .dataset_reader import DatasetReader  # noqa
 from .prompt_template import PromptTemplate  # noqa
+from .evaluators import AccEvaluator, BaseEvaluator, EMEvaluator  # noqa
+from .inferencers import GenInferencer, PPLInferencer  # noqa
+from .retrievers import (BaseRetriever, FixKRetriever,  # noqa
+                         RandomRetriever, ZeroRetriever)

@@ -100,7 +100,8 @@ class PPLInferencer(BaseInferencer):
                             ice[idx],
                             label,
                             ice_template=ice_template,
-                            prompt_template=prompt_template)
+                            prompt_template=prompt_template,
+                            remain_sep=normalizing_str is not None)
                         token_num = self.model.get_token_len_from_template(
                             prompt, mode='ppl')
 
@@ -111,6 +112,11 @@ class PPLInferencer(BaseInferencer):
                                  if prompt_template is not None else
                                  ice_template.sep_token)
                     sep_pos = prompt.find(sep_token)
+                    if sep_pos < 0:
+                        raise ValueError(
+                            f'sep_token {sep_token!r} not found in prompt; '
+                            'normalizing_str needs a template with a '
+                            'sep_token marking the context/answer split')
                     context = prompt[:sep_pos]
                     answer = prompt[sep_pos:].replace(sep_token, '')
                     prompt = context + answer

@@ -1,8 +1,10 @@
 from .base import BaseModel, LMTemplateParser  # noqa
 from .base_api import APITemplateParser, BaseAPIModel, TokenBucket  # noqa
 from .fake import FakeModel  # noqa
+from .jax_lm import JaxLM  # noqa
+from .tokenizer import ByteTokenizer, load_tokenizer  # noqa
 
 __all__ = [
     'BaseModel', 'LMTemplateParser', 'APITemplateParser', 'BaseAPIModel',
-    'TokenBucket', 'FakeModel'
+    'TokenBucket', 'FakeModel', 'JaxLM', 'ByteTokenizer', 'load_tokenizer'
 ]

@@ -1,0 +1,256 @@
+"""JaxLM — the TPU-native model wrapper (the reference's HuggingFaceCausalLM
+equivalent, reference opencompass/models/huggingface.py:15-337, rebuilt for
+XLA instead of torch.cuda).
+
+Design points (SURVEY.md §7):
+
+- **Bucketed static shapes.** torch tolerates ragged batches; XLA compiles
+  per shape.  Sequence lengths round up to power-of-two buckets (multiples
+  of 128 above 128, MXU-tile friendly) and batches to power-of-two sizes, so
+  a task's batches reuse a handful of compiled executables.  `jax.jit`'s
+  shape-keyed cache holds them.
+- **Host-side tokenization, device-side everything else.** `get_ppl` is one
+  jitted forward + shifted-CE (nn/loss.py); `generate` is one jitted
+  prefill + `lax.while_loop` decode (nn/decode.py).  Token counts are cached
+  (`get_token_len`) because inferencer truncation loops call it repeatedly
+  per prompt shrink (reference icl_gen_inferencer.py:150-183 pattern).
+- **Mesh-transparent.** With ``parallel=dict(data=..., model=..., seq=...)``
+  the same jitted functions run tensor/data-sharded: params are placed via
+  Megatron-style NamedShardings (nn/sharding.py), activations follow
+  `with_sharding_constraint`s inside the forward.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opencompass_tpu.nn import (TransformerConfig, forward, greedy_generate,
+                                init_params, sequence_nll, shard_params)
+from opencompass_tpu.parallel.mesh import MeshSpec, make_mesh, use_mesh
+from opencompass_tpu.registry import MODELS
+from opencompass_tpu.utils.logging import get_logger
+
+from .base import BaseModel
+from .tokenizer import load_tokenizer
+
+logger = get_logger()
+
+
+def _bucket(n: int, lo: int = 32, hi: Optional[int] = None) -> int:
+    """Round up to a power of two in [lo, hi]."""
+    b = lo
+    while b < n:
+        b *= 2
+    return min(b, hi) if hi else b
+
+
+@MODELS.register_module()
+class JaxLM(BaseModel):
+    """A causal LM evaluated through jitted JAX functions.
+
+    Args:
+        path: HF checkpoint dir (config.json + shards) or '' for random
+            init from ``config`` (hermetic tests / benchmarks).
+        config: TransformerConfig, preset name ('llama','opt',...) or dict
+            of TransformerConfig fields; required when ``path`` has no
+            config.json.
+        parallel: mesh axis sizes, e.g. ``dict(data=-1, model=1, seq=1)``.
+            Only built when >1 device is visible or sizes demand it.
+        dtype: parameter/compute dtype override ('bfloat16' on TPU,
+            'float32' for bit-stable CPU tests).
+        batch_bucket / seq_bucket_min: shape-bucketing knobs.
+    """
+
+    def __init__(self,
+                 path: str = '',
+                 max_seq_len: int = 2048,
+                 config: Union[TransformerConfig, str, Dict, None] = None,
+                 parallel: Optional[Dict] = None,
+                 dtype: Optional[str] = None,
+                 tokenizer_path: Optional[str] = None,
+                 tokenizer_kwargs: Optional[Dict] = None,
+                 meta_template: Optional[Dict] = None,
+                 generation_kwargs: Optional[Dict] = None,
+                 seed: int = 0,
+                 tokenizer_only: bool = False,
+                 batch_padding: bool = True,
+                 run_cfg: Optional[Dict] = None):
+        super().__init__(path=path, max_seq_len=max_seq_len,
+                         tokenizer_only=tokenizer_only,
+                         meta_template=meta_template,
+                         generation_kwargs=generation_kwargs)
+        self.cfg = self._resolve_config(path, config, dtype, max_seq_len)
+        self.tokenizer = load_tokenizer(
+            tokenizer_path or path, tokenizer_kwargs,
+            vocab_size=self.cfg.vocab_size if self.cfg else 512)
+        if self.eos_token_id is None:
+            self.eos_token_id = self.tokenizer.eos_token_id
+        self._token_len_cache: Dict[str, int] = {}
+        self._gen_fn_cache: Dict[tuple, object] = {}
+        self.mesh = None
+        self.params = None
+        if not tokenizer_only:
+            self._load_params(path, seed)
+            self._maybe_shard(parallel)
+
+    # -- setup -------------------------------------------------------------
+
+    def _resolve_config(self, path, config, dtype, max_seq_len
+                        ) -> Optional[TransformerConfig]:
+        import dataclasses
+        if isinstance(config, TransformerConfig):
+            cfg = config
+        elif isinstance(config, str):
+            cfg = getattr(TransformerConfig, config)()
+        elif isinstance(config, dict):
+            kw = dict(config)
+            preset = kw.pop('preset', None)
+            if preset:
+                cfg = dataclasses.replace(
+                    getattr(TransformerConfig, preset)(), **kw)
+            else:
+                cfg = TransformerConfig(**kw)
+        elif path and os.path.isfile(os.path.join(path, 'config.json')):
+            from opencompass_tpu.nn.hf_convert import load_hf_config
+            cfg = TransformerConfig.from_hf_config(load_hf_config(path))
+        else:
+            raise ValueError('JaxLM needs `config` or a checkpoint path '
+                             'with config.json')
+        if dtype:
+            cfg = dataclasses.replace(cfg, dtype=dtype)
+        if cfg.max_seq_len < max_seq_len:
+            cfg = dataclasses.replace(cfg, max_seq_len=max_seq_len)
+        return cfg
+
+    def _load_params(self, path: str, seed: int):
+        has_ckpt = path and os.path.isdir(path) and any(
+            f.endswith(('.safetensors', '.bin')) for f in os.listdir(path))
+        if has_ckpt:
+            from opencompass_tpu.nn.hf_convert import convert_checkpoint
+            self.cfg, np_params = convert_checkpoint(path, self.cfg)
+            self.params = jax.tree_util.tree_map(jnp.asarray, np_params)
+            logger.info(f'loaded checkpoint from {path}')
+        else:
+            if path:
+                logger.warning(f'no weights under {path!r}; random init '
+                               f'(seed={seed})')
+            self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
+
+    def _maybe_shard(self, parallel: Optional[Dict]):
+        n_dev = len(jax.devices())
+        parallel = parallel or {}
+        want = max(1, abs(parallel.get('model', 1)) *
+                   abs(parallel.get('seq', 1)))
+        if n_dev == 1 and want <= 1:
+            return
+        spec = MeshSpec(data=parallel.get('data', -1),
+                        model=parallel.get('model', 1),
+                        seq=parallel.get('seq', 1))
+        self.mesh = make_mesh(spec)
+        self.params = shard_params(self.params, self.cfg, self.mesh)
+        shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
+        logger.info(f'mesh: {shape}')
+
+    # -- jitted kernels (cached per static config) -------------------------
+
+    @functools.cached_property
+    def _ppl_fn(self):
+        cfg = self.cfg
+
+        @jax.jit
+        def ppl(params, tokens, mask, mask_length):
+            logits = forward(params, cfg, tokens, mask)
+            return sequence_nll(logits, tokens, mask, mask_length)
+        return ppl
+
+    def _gen_fn(self, max_new: int, temperature: float, top_k: int):
+        # per-instance cache (a class-level lru_cache would pin `self` — and
+        # its multi-GB param pytree — alive across model swaps)
+        key = (max_new, temperature, top_k)
+        fn = self._gen_fn_cache.get(key)
+        if fn is not None:
+            return fn
+        cfg = self.cfg
+        eos = self.eos_token_id
+        pad = self.tokenizer.pad_token_id or 0
+
+        @jax.jit
+        def gen(params, tokens, mask, rng):
+            return greedy_generate(params, cfg, tokens, mask, max_new,
+                                   eos_token_id=eos, pad_token_id=pad,
+                                   temperature=temperature, top_k=top_k,
+                                   rng=rng)
+        self._gen_fn_cache[key] = gen
+        return gen
+
+    # -- BaseModel contract ------------------------------------------------
+
+    def get_token_len(self, prompt: str) -> int:
+        prompt = str(prompt)
+        n = self._token_len_cache.get(prompt)
+        if n is None:
+            n = len(self.tokenizer.encode(prompt))
+            self._token_len_cache[prompt] = n
+        return n
+
+    def _encode_batch(self, inputs: List[str], left_pad: bool,
+                      max_len: int) -> tuple:
+        """Tokenize + bucket-pad.  Returns (tokens, mask) int32/bool arrays
+        of shape (bucket_batch, bucket_len)."""
+        ids = [self.tokenizer.encode(str(s))[:max_len] for s in inputs]
+        longest = max((len(x) for x in ids), default=1)
+        S = _bucket(max(longest, 1), hi=max(max_len, 32))
+        B = _bucket(len(ids), lo=1)
+        pad_id = self.tokenizer.pad_token_id or 0
+        tokens = np.full((B, S), pad_id, np.int32)
+        mask = np.zeros((B, S), bool)
+        for i, row in enumerate(ids):
+            if left_pad:
+                tokens[i, S - len(row):] = row
+                mask[i, S - len(row):] = True
+            else:
+                tokens[i, :len(row)] = row
+                mask[i, :len(row)] = True
+        return jnp.asarray(tokens), jnp.asarray(mask), ids
+
+    def get_ppl(self,
+                inputs: List[str],
+                mask_length: Optional[List[int]] = None) -> List[float]:
+        with use_mesh(self.mesh):
+            tokens, mask, ids = self._encode_batch(
+                inputs, left_pad=False, max_len=self.max_seq_len)
+            ml = np.zeros((tokens.shape[0],), np.int32)
+            if mask_length is not None:
+                ml[:len(mask_length)] = np.asarray(mask_length, np.int32)
+            nll = self._ppl_fn(self.params, tokens, mask, jnp.asarray(ml))
+            return np.asarray(nll)[:len(inputs)].tolist()
+
+    def generate(self, inputs: List[str], max_out_len: int) -> List[str]:
+        gk = dict(self.generation_kwargs)
+        temperature = float(gk.get('temperature', 0.0))
+        if not gk.get('do_sample', False):
+            temperature = 0.0
+        top_k = int(gk.get('top_k', 0))
+        seed = int(gk.get('seed', 0))
+        with use_mesh(self.mesh):
+            max_prompt = max(self.max_seq_len - max_out_len, 32)
+            tokens, mask, ids = self._encode_batch(
+                inputs, left_pad=True, max_len=max_prompt)
+            fn = self._gen_fn(int(max_out_len), temperature, top_k)
+            out, lengths = fn(self.params, tokens, mask,
+                              jax.random.PRNGKey(seed))
+        out = np.asarray(out)
+        lengths = np.asarray(lengths)
+        texts = []
+        for i in range(len(inputs)):
+            n = int(lengths[i])
+            row = out[i, :n]
+            if self.eos_token_id is not None:
+                row = row[row != self.eos_token_id]
+            texts.append(self.tokenizer.decode(row))
+        return texts

@@ -1,0 +1,94 @@
+"""Tokenizer loading with a hermetic fallback.
+
+The reference always has network access to pull HF tokenizers (reference
+opencompass/models/huggingface.py:68-95).  This environment may not, so:
+try `transformers.AutoTokenizer` from a local path / cache first, and fall
+back to a deterministic byte-level tokenizer so every pipeline (tests, bench,
+dry runs) works offline.  All tokenization is host-side — token ids are the
+only thing shipped to the TPU (SURVEY.md §7 hard part (d)).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer: ids 0..255 = bytes, then specials.
+
+    Deterministic, reversible, zero-asset — the hermetic stand-in for a real
+    BPE vocab.  vocab_size defaults to 512 so tiny test models can share it.
+    """
+
+    def __init__(self, vocab_size: int = 512):
+        assert vocab_size >= 259
+        self.vocab_size = vocab_size
+        self.pad_token_id = 256
+        self.bos_token_id = 257
+        self.eos_token_id = 258
+
+    def encode(self, text: str, add_bos: bool = False) -> List[int]:
+        ids = list(text.encode('utf-8'))
+        return [self.bos_token_id] + ids if add_bos else ids
+
+    def decode(self, ids) -> str:
+        data = bytes(i for i in ids if int(i) < 256)
+        return data.decode('utf-8', errors='ignore')
+
+    def __call__(self, text: str):
+        return {'input_ids': self.encode(text)}
+
+
+class TokenizerAdapter:
+    """Uniform surface over HF tokenizers and ByteTokenizer: ``encode``,
+    ``decode``, ``pad_token_id``, ``eos_token_id``, ``vocab_size``."""
+
+    def __init__(self, inner, kind: str):
+        self.inner = inner
+        self.kind = kind
+        if kind == 'hf':
+            self.eos_token_id = inner.eos_token_id
+            pad = inner.pad_token_id
+            self.pad_token_id = pad if pad is not None else \
+                (self.eos_token_id if self.eos_token_id is not None else 0)
+            self.bos_token_id = getattr(inner, 'bos_token_id', None)
+            self.vocab_size = len(inner)
+        else:
+            self.eos_token_id = inner.eos_token_id
+            self.pad_token_id = inner.pad_token_id
+            self.bos_token_id = inner.bos_token_id
+            self.vocab_size = inner.vocab_size
+
+    def encode(self, text: str, add_special_tokens: bool = False
+               ) -> List[int]:
+        if self.kind == 'hf':
+            return self.inner.encode(text,
+                                     add_special_tokens=add_special_tokens)
+        return self.inner.encode(text, add_bos=add_special_tokens)
+
+    def decode(self, ids) -> str:
+        if self.kind == 'hf':
+            return self.inner.decode(ids, skip_special_tokens=True)
+        return self.inner.decode(ids)
+
+
+def load_tokenizer(path: Optional[str],
+                   tokenizer_kwargs: Optional[dict] = None,
+                   vocab_size: int = 512) -> TokenizerAdapter:
+    """AutoTokenizer if resolvable locally, else ByteTokenizer."""
+    if path and (os.path.isdir(path) or not path.startswith('byte')):
+        try:
+            from transformers import AutoTokenizer
+            tok = AutoTokenizer.from_pretrained(
+                path, local_files_only=True, trust_remote_code=False,
+                **(tokenizer_kwargs or {}))
+            return TokenizerAdapter(tok, 'hf')
+        except Exception as exc:  # offline / missing vocab
+            logger.warning(
+                f'AutoTokenizer({path!r}) unavailable ({exc}); '
+                'falling back to ByteTokenizer')
+    return TokenizerAdapter(ByteTokenizer(vocab_size), 'byte')

@@ -1,0 +1,18 @@
+"""TPU-native transformer stack: pure-functional JAX forward/decode/loss.
+
+This is the execution layer (SURVEY.md §1 L1) rebuilt TPU-first: instead of the
+reference's torch/transformers library calls (reference
+opencompass/models/huggingface.py:127-293), models are JAX pytrees evaluated
+through jit-compiled functions with explicit `jax.sharding` annotations so a
+single code path serves one chip, a v5e-8 slice, or a multi-host pod.
+"""
+from .config import TransformerConfig
+from .transformer import init_params, forward, prefill, decode_step
+from .loss import sequence_nll
+from .decode import greedy_generate
+from .sharding import param_shardings, shard_params
+
+__all__ = [
+    'TransformerConfig', 'init_params', 'forward', 'prefill', 'decode_step',
+    'sequence_nll', 'greedy_generate', 'param_shardings', 'shard_params',
+]

@@ -1,0 +1,193 @@
+"""Transformer architecture config covering the model families the reference
+evaluates through HuggingFace wrappers (reference opencompass/models/
+huggingface.py:15-337 loads arbitrary AutoModelForCausalLM checkpoints; the
+families actually exercised by its configs are LLaMA/vicuna, OPT, InternLM,
+Falcon, Baichuan — see configs/models/*.py).
+
+One dataclass parameterizes all of them; presets below pin each family's
+switches (activation, norm, positional encoding, biases, gated vs plain MLP,
+parallel residual).  All sizes default to TPU-friendly values; `head_dim`
+stays a multiple of 128 for MXU tiling wherever the checkpoint allows.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    max_seq_len: int = 2048
+    activation: str = 'silu'          # silu | gelu | gelu_new | relu
+    norm: str = 'rmsnorm'             # rmsnorm | layernorm
+    positional: str = 'rope'          # rope | learned
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    qkv_bias: bool = False            # qwen2-style attention biases
+    o_bias: bool = False
+    mlp_bias: bool = False
+    gated_mlp: bool = True            # llama gate/up/down; False = fc1/fc2
+    parallel_residual: bool = False   # falcon/gpt-neox style
+    final_norm: bool = True
+    # learned-positional models (OPT) offset position ids by 2
+    pos_offset: int = 0
+    dtype: str = 'bfloat16'           # parameter/compute dtype
+    # scan-over-layers keeps compile time O(1) in depth; turn off to inspect
+    # per-layer arrays by name.
+    scan_layers: bool = True
+    remat: bool = False
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def q_dim(self) -> int:
+        return self.num_heads * self.head_dim
+
+    @property
+    def kv_dim(self) -> int:
+        return self.num_kv_heads * self.head_dim
+
+    # -- family presets ----------------------------------------------------
+
+    @staticmethod
+    def llama(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+              num_kv_heads=None, intermediate_size=11008, max_seq_len=2048,
+              rope_theta=10000.0, **kw) -> 'TransformerConfig':
+        """LLaMA / Mistral / InternLM family: RMSNorm, RoPE, SwiGLU."""
+        num_kv_heads = num_kv_heads or num_heads
+        return TransformerConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_kv_heads, head_dim=hidden_size // num_heads,
+            intermediate_size=intermediate_size, max_seq_len=max_seq_len,
+            rope_theta=rope_theta, **kw)
+
+    @staticmethod
+    def qwen2(vocab_size=151936, hidden_size=3584, num_layers=28,
+              num_heads=28, num_kv_heads=4, intermediate_size=18944,
+              max_seq_len=4096, rope_theta=1000000.0, **kw):
+        """Qwen2 family: llama-shaped + QKV biases + GQA."""
+        return TransformerConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_kv_heads, head_dim=hidden_size // num_heads,
+            intermediate_size=intermediate_size, max_seq_len=max_seq_len,
+            rope_theta=rope_theta, qkv_bias=True, **kw)
+
+    @staticmethod
+    def opt(vocab_size=50272, hidden_size=768, num_layers=12, num_heads=12,
+            intermediate_size=3072, max_seq_len=2048, **kw):
+        """OPT family (BASELINE config 1 uses OPT-125M): LayerNorm, learned
+        positions (offset 2), ReLU 2-layer MLP, tied embeddings, biases."""
+        return TransformerConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_heads, head_dim=hidden_size // num_heads,
+            intermediate_size=intermediate_size, max_seq_len=max_seq_len,
+            activation='relu', norm='layernorm', positional='learned',
+            pos_offset=2, tie_embeddings=True, qkv_bias=True, o_bias=True,
+            mlp_bias=True, gated_mlp=False, **kw)
+
+    @staticmethod
+    def gpt2(vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12,
+             intermediate_size=3072, max_seq_len=1024, **kw):
+        return TransformerConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_heads, head_dim=hidden_size // num_heads,
+            intermediate_size=intermediate_size, max_seq_len=max_seq_len,
+            activation='gelu_new', norm='layernorm', positional='learned',
+            tie_embeddings=True, qkv_bias=True, o_bias=True, mlp_bias=True,
+            gated_mlp=False, **kw)
+
+    @staticmethod
+    def falcon(vocab_size=65024, hidden_size=4544, num_layers=32,
+               num_heads=71, num_kv_heads=1, intermediate_size=18176,
+               max_seq_len=2048, **kw):
+        """Falcon family: MQA + parallel attention/MLP residual."""
+        return TransformerConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_kv_heads, head_dim=hidden_size // num_heads,
+            intermediate_size=intermediate_size, max_seq_len=max_seq_len,
+            norm='layernorm', gated_mlp=False, activation='gelu',
+            parallel_residual=True, tie_embeddings=True, **kw)
+
+    @staticmethod
+    def tiny(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
+             num_kv_heads=2, intermediate_size=128, max_seq_len=256, **kw):
+        """Hermetic-test scale."""
+        return TransformerConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_kv_heads, head_dim=hidden_size // num_heads,
+            intermediate_size=intermediate_size, max_seq_len=max_seq_len,
+            dtype='float32', **kw)
+
+    @staticmethod
+    def from_hf_config(hf: dict) -> 'TransformerConfig':
+        """Build from a HuggingFace ``config.json`` dict (the same contract
+        the reference gets for free from AutoModel; we map explicitly)."""
+        mt = (hf.get('model_type') or '').lower()
+        if mt in ('llama', 'mistral', 'internlm', 'internlm2', 'baichuan'):
+            return TransformerConfig.llama(
+                vocab_size=hf['vocab_size'],
+                hidden_size=hf['hidden_size'],
+                num_layers=hf['num_hidden_layers'],
+                num_heads=hf['num_attention_heads'],
+                num_kv_heads=hf.get('num_key_value_heads'),
+                intermediate_size=hf['intermediate_size'],
+                max_seq_len=hf.get('max_position_embeddings', 2048),
+                rope_theta=hf.get('rope_theta', 10000.0),
+                norm_eps=hf.get('rms_norm_eps', 1e-5),
+                tie_embeddings=hf.get('tie_word_embeddings', False))
+        if mt == 'qwen2':
+            return TransformerConfig.qwen2(
+                vocab_size=hf['vocab_size'],
+                hidden_size=hf['hidden_size'],
+                num_layers=hf['num_hidden_layers'],
+                num_heads=hf['num_attention_heads'],
+                num_kv_heads=hf.get('num_key_value_heads'),
+                intermediate_size=hf['intermediate_size'],
+                max_seq_len=hf.get('max_position_embeddings', 4096),
+                rope_theta=hf.get('rope_theta', 1000000.0),
+                norm_eps=hf.get('rms_norm_eps', 1e-6),
+                tie_embeddings=hf.get('tie_word_embeddings', False))
+        if mt == 'opt':
+            return TransformerConfig.opt(
+                vocab_size=hf['vocab_size'],
+                hidden_size=hf['hidden_size'],
+                num_layers=hf['num_hidden_layers'],
+                num_heads=hf['num_attention_heads'],
+                intermediate_size=hf['ffn_dim'],
+                max_seq_len=hf.get('max_position_embeddings', 2048))
+        if mt == 'gpt2':
+            return TransformerConfig.gpt2(
+                vocab_size=hf['vocab_size'],
+                hidden_size=hf['n_embd'],
+                num_layers=hf['n_layer'],
+                num_heads=hf['n_head'],
+                intermediate_size=hf.get('n_inner') or 4 * hf['n_embd'],
+                max_seq_len=hf.get('n_positions', 1024))
+        if mt == 'falcon':
+            return TransformerConfig.falcon(
+                vocab_size=hf['vocab_size'],
+                hidden_size=hf['hidden_size'],
+                num_layers=hf['num_hidden_layers'],
+                num_heads=hf['num_attention_heads'],
+                num_kv_heads=hf.get('num_kv_heads', 1),
+                intermediate_size=4 * hf['hidden_size'],
+                max_seq_len=2048)
+        raise ValueError(f'unsupported model_type: {mt!r}')

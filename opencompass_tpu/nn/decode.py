@@ -1,0 +1,98 @@
+"""Autoregressive decoding as a single jitted `lax.while_loop`.
+
+Replaces the reference's `model.generate(...)` library call (reference
+opencompass/models/huggingface.py:127-199) with an explicit KV-cache loop:
+prefill the left-padded prompt once, then one `decode_step` per token with a
+static cache size of ``prompt_len + max_new_tokens``.  Early-exits when every
+sequence has emitted EOS (while_loop cond), so short completions don't pay
+for the full budget.  Greedy by default; temperature/top-k sampling via
+``rng``.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import TransformerConfig
+from .transformer import decode_step, init_cache, prefill
+
+
+def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
+            top_k: int) -> jax.Array:
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def greedy_generate(params, cfg: TransformerConfig, tokens: jax.Array,
+                    pad_mask: jax.Array, max_new_tokens: int,
+                    eos_token_id: Optional[int] = None,
+                    pad_token_id: int = 0,
+                    temperature: float = 0.0,
+                    top_k: int = 0,
+                    rng: Optional[jax.Array] = None
+                    ) -> Tuple[jax.Array, jax.Array]:
+    """Generate up to ``max_new_tokens`` per sequence.
+
+    tokens/pad_mask: (B, S) left-padded prompts.  Returns (out_tokens
+    (B, max_new_tokens) padded with ``pad_token_id`` after EOS, lengths (B,)).
+    Jit-safe: call under `jax.jit` with ``max_new_tokens`` static.
+    """
+    B, S = tokens.shape
+    total = S + max_new_tokens
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    cache = init_cache(cfg, B, total)
+    logits, cache, next_pos = prefill(params, cfg, tokens, pad_mask, cache)
+
+    kv_valid = jnp.zeros((B, total), jnp.bool_)
+    kv_valid = jax.lax.dynamic_update_slice_in_dim(
+        kv_valid, pad_mask.astype(jnp.bool_), 0, axis=1)
+
+    rng, key = jax.random.split(rng)
+    first = _sample(logits, key, temperature, top_k)
+    out = jnp.full((B, max_new_tokens), pad_token_id, tokens.dtype)
+    out = out.at[:, 0].set(first.astype(tokens.dtype))
+    done = jnp.zeros((B,), jnp.bool_)
+    if eos_token_id is not None:
+        done = first == eos_token_id
+
+    def cond(carry):
+        step, _, _, _, _, done, _, _ = carry
+        return (step < max_new_tokens) & ~jnp.all(done)
+
+    def body(carry):
+        step, token, cache, kv_valid, positions, done, out, rng = carry
+        slot = S + step - 1  # slot where `token` (emitted at step-1) lives
+        kv_valid = kv_valid | (jnp.arange(total)[None, :] == slot)
+        logits, cache = decode_step(params, cfg, token, cache, slot,
+                                    positions, kv_valid)
+        rng, key = jax.random.split(rng)
+        nxt = _sample(logits, key, temperature, top_k).astype(token.dtype)
+        nxt = jnp.where(done, jnp.asarray(pad_token_id, token.dtype), nxt)
+        out = jax.lax.dynamic_update_slice_in_dim(
+            out, nxt[:, None], step, axis=1)
+        if eos_token_id is not None:
+            done = done | (nxt == eos_token_id)
+        return (step + 1, nxt, cache, kv_valid, positions + 1, done, out, rng)
+
+    carry = (jnp.asarray(1), first.astype(tokens.dtype), cache, kv_valid,
+             next_pos, done, out, rng)
+    step, _, _, _, _, _, out, _ = jax.lax.while_loop(cond, body, carry)
+
+    if eos_token_id is not None:
+        # length = index of first EOS + 1, or max_new_tokens
+        is_eos = out == eos_token_id
+        any_eos = jnp.any(is_eos, axis=-1)
+        first_eos = jnp.argmax(is_eos, axis=-1)
+        lengths = jnp.where(any_eos, first_eos + 1, max_new_tokens)
+    else:
+        lengths = jnp.full((B,), max_new_tokens)
+    return out, lengths

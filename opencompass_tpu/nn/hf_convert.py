@@ -1,0 +1,315 @@
+"""HuggingFace checkpoint → JAX param pytree, streaming shard-by-shard.
+
+The reference leans on ``AutoModelForCausalLM.from_pretrained`` (reference
+opencompass/models/huggingface.py:97-108); the TPU build loads raw tensors
+from safetensors / torch shards directly into numpy (bf16 via ml_dtypes),
+maps names per family, and stacks per-layer arrays along the leading scan
+axis expected by nn/transformer.py.  No torch graph is ever built; peak host
+memory stays ~one shard above the final pytree.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+try:
+    import ml_dtypes
+    _BF16 = ml_dtypes.bfloat16
+except ImportError:  # pragma: no cover
+    _BF16 = np.float32
+
+from opencompass_tpu.utils.logging import get_logger
+
+from .config import TransformerConfig
+
+logger = get_logger()
+
+# (path-in-pytree, needs_transpose). `L` in the regex is the layer index.
+_LLAMA_MAP = {
+    r'model\.embed_tokens\.weight': (('embed',), False),
+    r'model\.layers\.(\d+)\.input_layernorm\.weight':
+        (('layers', 'attn_norm', 'scale'), False),
+    r'model\.layers\.(\d+)\.post_attention_layernorm\.weight':
+        (('layers', 'mlp_norm', 'scale'), False),
+    r'model\.layers\.(\d+)\.self_attn\.q_proj\.weight':
+        (('layers', 'q', 'w'), True),
+    r'model\.layers\.(\d+)\.self_attn\.k_proj\.weight':
+        (('layers', 'k', 'w'), True),
+    r'model\.layers\.(\d+)\.self_attn\.v_proj\.weight':
+        (('layers', 'v', 'w'), True),
+    r'model\.layers\.(\d+)\.self_attn\.o_proj\.weight':
+        (('layers', 'o', 'w'), True),
+    r'model\.layers\.(\d+)\.self_attn\.q_proj\.bias':
+        (('layers', 'q', 'b'), False),
+    r'model\.layers\.(\d+)\.self_attn\.k_proj\.bias':
+        (('layers', 'k', 'b'), False),
+    r'model\.layers\.(\d+)\.self_attn\.v_proj\.bias':
+        (('layers', 'v', 'b'), False),
+    r'model\.layers\.(\d+)\.mlp\.gate_proj\.weight':
+        (('layers', 'gate', 'w'), True),
+    r'model\.layers\.(\d+)\.mlp\.up_proj\.weight':
+        (('layers', 'up', 'w'), True),
+    r'model\.layers\.(\d+)\.mlp\.down_proj\.weight':
+        (('layers', 'down', 'w'), True),
+    r'model\.norm\.weight': (('final_norm', 'scale'), False),
+    r'lm_head\.weight': (('lm_head',), True),
+}
+
+_OPT_MAP = {
+    r'(?:model\.)?decoder\.embed_tokens\.weight': (('embed',), False),
+    r'(?:model\.)?decoder\.embed_positions\.weight': (('pos_embed',), False),
+    r'(?:model\.)?decoder\.final_layer_norm\.weight':
+        (('final_norm', 'scale'), False),
+    r'(?:model\.)?decoder\.final_layer_norm\.bias':
+        (('final_norm', 'bias'), False),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn_layer_norm\.weight':
+        (('layers', 'attn_norm', 'scale'), False),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn_layer_norm\.bias':
+        (('layers', 'attn_norm', 'bias'), False),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.final_layer_norm\.weight':
+        (('layers', 'mlp_norm', 'scale'), False),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.final_layer_norm\.bias':
+        (('layers', 'mlp_norm', 'bias'), False),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.q_proj\.weight':
+        (('layers', 'q', 'w'), True),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.k_proj\.weight':
+        (('layers', 'k', 'w'), True),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.v_proj\.weight':
+        (('layers', 'v', 'w'), True),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.out_proj\.weight':
+        (('layers', 'o', 'w'), True),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.q_proj\.bias':
+        (('layers', 'q', 'b'), False),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.k_proj\.bias':
+        (('layers', 'k', 'b'), False),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.v_proj\.bias':
+        (('layers', 'v', 'b'), False),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.out_proj\.bias':
+        (('layers', 'o', 'b'), False),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.fc1\.weight':
+        (('layers', 'fc1', 'w'), True),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.fc1\.bias':
+        (('layers', 'fc1', 'b'), False),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.fc2\.weight':
+        (('layers', 'fc2', 'w'), True),
+    r'(?:model\.)?decoder\.layers\.(\d+)\.fc2\.bias':
+        (('layers', 'fc2', 'b'), False),
+}
+
+# GPT-2 Conv1D weights are already (in, out): no transpose; c_attn splits.
+_GPT2_MAP = {
+    r'(?:transformer\.)?wte\.weight': (('embed',), False),
+    r'(?:transformer\.)?wpe\.weight': (('pos_embed',), False),
+    r'(?:transformer\.)?ln_f\.weight': (('final_norm', 'scale'), False),
+    r'(?:transformer\.)?ln_f\.bias': (('final_norm', 'bias'), False),
+    r'(?:transformer\.)?h\.(\d+)\.ln_1\.weight':
+        (('layers', 'attn_norm', 'scale'), False),
+    r'(?:transformer\.)?h\.(\d+)\.ln_1\.bias':
+        (('layers', 'attn_norm', 'bias'), False),
+    r'(?:transformer\.)?h\.(\d+)\.ln_2\.weight':
+        (('layers', 'mlp_norm', 'scale'), False),
+    r'(?:transformer\.)?h\.(\d+)\.ln_2\.bias':
+        (('layers', 'mlp_norm', 'bias'), False),
+    r'(?:transformer\.)?h\.(\d+)\.attn\.c_attn\.weight':
+        (('layers', '_qkv', 'w'), False),
+    r'(?:transformer\.)?h\.(\d+)\.attn\.c_attn\.bias':
+        (('layers', '_qkv', 'b'), False),
+    r'(?:transformer\.)?h\.(\d+)\.attn\.c_proj\.weight':
+        (('layers', 'o', 'w'), False),
+    r'(?:transformer\.)?h\.(\d+)\.attn\.c_proj\.bias':
+        (('layers', 'o', 'b'), False),
+    r'(?:transformer\.)?h\.(\d+)\.mlp\.c_fc\.weight':
+        (('layers', 'fc1', 'w'), False),
+    r'(?:transformer\.)?h\.(\d+)\.mlp\.c_fc\.bias':
+        (('layers', 'fc1', 'b'), False),
+    r'(?:transformer\.)?h\.(\d+)\.mlp\.c_proj\.weight':
+        (('layers', 'fc2', 'w'), False),
+    r'(?:transformer\.)?h\.(\d+)\.mlp\.c_proj\.bias':
+        (('layers', 'fc2', 'b'), False),
+}
+
+# Baichuan = llama shape with fused W_pack (3*hidden, hidden).
+_BAICHUAN_MAP = dict(_LLAMA_MAP)
+_BAICHUAN_MAP[r'model\.layers\.(\d+)\.self_attn\.W_pack\.weight'] = (
+    ('layers', '_wpack', 'w'), True)
+
+# Falcon: fused query_key_value with MQA layout [n_head*hd q | hd k | hd v].
+_FALCON_MAP = {
+    r'transformer\.word_embeddings\.weight': (('embed',), False),
+    r'transformer\.ln_f\.weight': (('final_norm', 'scale'), False),
+    r'transformer\.ln_f\.bias': (('final_norm', 'bias'), False),
+    r'transformer\.h\.(\d+)\.input_layernorm\.weight':
+        (('layers', 'attn_norm', 'scale'), False),
+    r'transformer\.h\.(\d+)\.input_layernorm\.bias':
+        (('layers', 'attn_norm', 'bias'), False),
+    r'transformer\.h\.(\d+)\.self_attention\.query_key_value\.weight':
+        (('layers', '_qkv_mqa', 'w'), True),
+    r'transformer\.h\.(\d+)\.self_attention\.dense\.weight':
+        (('layers', 'o', 'w'), True),
+    r'transformer\.h\.(\d+)\.mlp\.dense_h_to_4h\.weight':
+        (('layers', 'fc1', 'w'), True),
+    r'transformer\.h\.(\d+)\.mlp\.dense_4h_to_h\.weight':
+        (('layers', 'fc2', 'w'), True),
+}
+
+# InternLM2: fused grouped wqkv [per kv group: ratio q heads | k | v].
+_INTERNLM2_MAP = {
+    r'model\.tok_embeddings\.weight': (('embed',), False),
+    r'model\.norm\.weight': (('final_norm', 'scale'), False),
+    r'output\.weight': (('lm_head',), True),
+    r'model\.layers\.(\d+)\.attention_norm\.weight':
+        (('layers', 'attn_norm', 'scale'), False),
+    r'model\.layers\.(\d+)\.ffn_norm\.weight':
+        (('layers', 'mlp_norm', 'scale'), False),
+    r'model\.layers\.(\d+)\.attention\.wqkv\.weight':
+        (('layers', '_wqkv_grouped', 'w'), True),
+    r'model\.layers\.(\d+)\.attention\.wo\.weight':
+        (('layers', 'o', 'w'), True),
+    r'model\.layers\.(\d+)\.feed_forward\.w1\.weight':
+        (('layers', 'gate', 'w'), True),
+    r'model\.layers\.(\d+)\.feed_forward\.w3\.weight':
+        (('layers', 'up', 'w'), True),
+    r'model\.layers\.(\d+)\.feed_forward\.w2\.weight':
+        (('layers', 'down', 'w'), True),
+}
+
+_FAMILY_MAPS = {
+    'llama': _LLAMA_MAP, 'mistral': _LLAMA_MAP, 'qwen2': _LLAMA_MAP,
+    'internlm': _LLAMA_MAP, 'internlm2': _INTERNLM2_MAP,
+    'baichuan': _BAICHUAN_MAP, 'falcon': _FALCON_MAP,
+    'opt': _OPT_MAP, 'gpt2': _GPT2_MAP,
+}
+
+
+def _iter_checkpoint_tensors(path: str):
+    """Yield (name, numpy array) across safetensors/torch shards."""
+    st_files = sorted(f for f in os.listdir(path)
+                      if f.endswith('.safetensors'))
+    if st_files:
+        from safetensors import safe_open
+        for fname in st_files:
+            with safe_open(os.path.join(path, fname), framework='np') as f:
+                for name in f.keys():
+                    yield name, f.get_tensor(name)
+        return
+    bin_files = sorted(f for f in os.listdir(path)
+                       if re.fullmatch(r'pytorch_model.*\.bin', f))
+    if not bin_files:
+        raise FileNotFoundError(f'no checkpoint shards under {path}')
+    import torch
+    for fname in bin_files:
+        sd = torch.load(os.path.join(path, fname), map_location='cpu',
+                        weights_only=True)
+        for name, tensor in sd.items():
+            if tensor.dtype == torch.bfloat16:
+                yield name, tensor.view(torch.uint16).numpy().view(_BF16)
+            else:
+                yield name, tensor.numpy()
+        del sd
+
+
+def _split_fused_qkv(layers: Dict, cfg: TransformerConfig):
+    """Split family-specific fused QKV projections into q/k/v.
+
+    All fused weights arrive here already transposed to (L, in, fused_out).
+    - ``_qkv``: GPT-2 c_attn, [D q | D k | D v].
+    - ``_qkv_mqa``: Falcon, [n_head*hd q | hd k | hd v].
+    - ``_wqkv_grouped``: InternLM2, per-kv-group [ratio q heads | k | v].
+    - ``_wpack``: Baichuan, [D q | D k | D v] (MHA thirds).
+    """
+    hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
+    D = cfg.hidden_size
+    if '_qkv' in layers or '_wpack' in layers:
+        fused = layers.pop('_qkv', None) or layers.pop('_wpack')
+        w = fused['w']                      # (L, D, 3D)
+        layers['q'] = {'w': w[:, :, :D]}
+        layers['k'] = {'w': w[:, :, D:2 * D]}
+        layers['v'] = {'w': w[:, :, 2 * D:]}
+        if 'b' in fused:
+            b = fused['b']
+            layers['q']['b'] = b[:, :D]
+            layers['k']['b'] = b[:, D:2 * D]
+            layers['v']['b'] = b[:, 2 * D:]
+    if '_qkv_mqa' in layers:
+        w = layers.pop('_qkv_mqa')['w']     # (L, D, (H+2K)*hd)
+        q_dim = H * hd
+        layers['q'] = {'w': w[:, :, :q_dim]}
+        layers['k'] = {'w': w[:, :, q_dim:q_dim + K * hd]}
+        layers['v'] = {'w': w[:, :, q_dim + K * hd:]}
+    if '_wqkv_grouped' in layers:
+        w = layers.pop('_wqkv_grouped')['w']  # (L, D, K*(ratio+2)*hd)
+        L = w.shape[0]
+        ratio = H // K
+        g = w.reshape(L, D, K, ratio + 2, hd)
+        layers['q'] = {'w': np.ascontiguousarray(
+            g[:, :, :, :ratio].reshape(L, D, H * hd))}
+        layers['k'] = {'w': np.ascontiguousarray(
+            g[:, :, :, ratio].reshape(L, D, K * hd))}
+        layers['v'] = {'w': np.ascontiguousarray(
+            g[:, :, :, ratio + 1].reshape(L, D, K * hd))}
+
+
+def load_hf_config(path: str) -> dict:
+    with open(os.path.join(path, 'config.json')) as f:
+        return json.load(f)
+
+
+def convert_checkpoint(path: str, cfg: Optional[TransformerConfig] = None,
+                       dtype=None) -> Tuple[TransformerConfig, Dict]:
+    """Load a HF checkpoint dir into (config, param pytree)."""
+    hf_cfg = load_hf_config(path)
+    cfg = cfg or TransformerConfig.from_hf_config(hf_cfg)
+    family = (hf_cfg.get('model_type') or '').lower()
+    name_map = _FAMILY_MAPS.get(family)
+    if name_map is None:
+        raise ValueError(f'no weight map for model_type {family!r}')
+    compiled = [(re.compile(pat), dest) for pat, dest in name_map.items()]
+    dtype = dtype or (_BF16 if cfg.dtype == 'bfloat16' else
+                      np.dtype(cfg.dtype))
+
+    L = cfg.num_layers
+    staging: Dict[Tuple, dict] = {}   # path -> {layer_idx or None: array}
+    for name, arr in _iter_checkpoint_tensors(path):
+        for pat, (dest, transpose) in compiled:
+            m = pat.fullmatch(name)
+            if not m:
+                continue
+            if transpose:
+                arr = arr.T
+            arr = np.ascontiguousarray(arr).astype(dtype, copy=False)
+            idx = int(m.group(1)) if m.groups() else None
+            staging.setdefault(dest, {})[idx] = arr
+            break
+        else:
+            logger.warning(f'unmapped checkpoint tensor: {name}')
+
+    params: Dict = {}
+
+    def put(dest_path, value):
+        node = params
+        for key in dest_path[:-1]:
+            node = node.setdefault(key, {})
+        node[dest_path[-1]] = value
+
+    for dest, by_layer in staging.items():
+        if None in by_layer:
+            put(dest, by_layer[None])
+        else:
+            missing = [i for i in range(L) if i not in by_layer]
+            if missing:
+                raise ValueError(f'{dest}: missing layers {missing[:5]}...')
+            put(dest, np.stack([by_layer[i] for i in range(L)]))
+
+    _split_fused_qkv(params.get('layers', {}), cfg)
+
+    if cfg.tie_embeddings:
+        params.pop('lm_head', None)
+    elif 'lm_head' not in params and 'embed' in params:
+        # some checkpoints omit lm_head when tied but config says untied
+        logger.warning('lm_head missing; tying to embeddings')
+        params['lm_head'] = np.ascontiguousarray(params['embed'].T)
+    return cfg, params

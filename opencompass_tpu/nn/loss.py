@@ -1,0 +1,42 @@
+"""Sequence scoring: shifted cross-entropy → mean per-token NLL.
+
+Same measurement as the reference's `HuggingFace.get_ppl` (reference
+opencompass/models/huggingface.py:254-293): shift logits/targets by one,
+per-token CE, zero out pads and (optionally) the first ``mask_length`` context
+tokens, mean over the remaining answer tokens.  Computed fully on-device in
+one jitted call; fp32 log-softmax accumulation.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Per-position NLL of the *next* token.  logits: (B, S, V), tokens:
+    (B, S) → (B, S-1) where entry j scores tokens[:, j+1]."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32), axis=-1)
+    targets = tokens[:, 1:]
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+
+def sequence_nll(logits: jax.Array, tokens: jax.Array, pad_mask: jax.Array,
+                 mask_length: Optional[jax.Array] = None) -> jax.Array:
+    """Mean NLL per sequence (B,).
+
+    ``pad_mask`` (B, S): real tokens.  ``mask_length`` (B,): exclude the
+    first N tokens of each sequence from the loss (normalized-PPL mode; the
+    target at shifted position j is original token j+1, so positions with
+    j+1 < mask_length are dropped — mirrors the reference's
+    ``mask[i][:mask_length[i]] = 0`` on the shifted loss).
+    """
+    nll = token_nll(logits, tokens)          # (B, S-1)
+    valid = pad_mask[:, 1:].astype(jnp.float32)
+    if mask_length is not None:
+        pos = jnp.arange(1, tokens.shape[1])[None, :]
+        valid = valid * (pos >= mask_length[:, None])
+    total = jnp.sum(nll * valid, axis=-1)
+    count = jnp.maximum(jnp.sum(valid, axis=-1), 1.0)
+    return total / count

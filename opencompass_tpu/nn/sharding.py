@@ -1,0 +1,97 @@
+"""Megatron-style parameter shardings over the ('data','seq','model') mesh.
+
+The reference never shards parameters itself — it delegates to
+``device_map='auto'`` layer placement (reference opencompass/models/
+huggingface.py:55) or external model-parallel libs (models/glm.py:74).  Here
+tensor parallelism is explicit: column-shard the projections whose output dim
+is per-head (q/k/v, gate/up/fc1), row-shard the ones that contract the
+sharded dim (o, down/fc2) — XLA then inserts one psum per block on the
+row-sharded matmul outputs, riding ICI.
+
+Layer params carry a leading ``num_layers`` scan axis → specs below prepend
+`None` for it automatically.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .config import TransformerConfig
+
+# spec for the *last* dims of each weight; leading layer axis added for
+# entries under 'layers'.
+_LAYER_SPECS = {
+    'q': {'w': P(None, 'model'), 'b': P('model')},
+    'k': {'w': P(None, 'model'), 'b': P('model')},
+    'v': {'w': P(None, 'model'), 'b': P('model')},
+    'o': {'w': P('model', None), 'b': P(None)},
+    'gate': {'w': P(None, 'model'), 'b': P('model')},
+    'up': {'w': P(None, 'model'), 'b': P('model')},
+    'down': {'w': P('model', None), 'b': P(None)},
+    'fc1': {'w': P(None, 'model'), 'b': P('model')},
+    'fc2': {'w': P('model', None), 'b': P(None)},
+    'attn_norm': {'scale': P(None), 'bias': P(None)},
+    'mlp_norm': {'scale': P(None), 'bias': P(None)},
+}
+
+_TOP_SPECS = {
+    'embed': P(None, 'model'),        # hidden-sharded: logits psum via head.T
+    'pos_embed': P(None, None),
+    'lm_head': P(None, 'model'),      # vocab-sharded logits
+    'final_norm': {'scale': P(None), 'bias': P(None)},
+}
+
+
+def param_specs(cfg: TransformerConfig) -> Dict:
+    """PartitionSpec pytree matching `init_params(cfg, ...)`'s structure."""
+    specs: Dict = {'embed': _TOP_SPECS['embed'], 'layers': {}}
+    if cfg.positional == 'learned':
+        specs['pos_embed'] = _TOP_SPECS['pos_embed']
+    if cfg.final_norm:
+        specs['final_norm'] = {'scale': P(None)}
+        if cfg.norm == 'layernorm':
+            specs['final_norm']['bias'] = P(None)
+    if not cfg.tie_embeddings:
+        specs['lm_head'] = _TOP_SPECS['lm_head']
+
+    def with_layer_axis(spec: P) -> P:
+        return P(None, *spec)
+
+    names = ['attn_norm', 'mlp_norm', 'q', 'k', 'v', 'o']
+    names += ['gate', 'up', 'down'] if cfg.gated_mlp else ['fc1', 'fc2']
+    for name in names:
+        specs['layers'][name] = {}
+        for leaf in ('w', 'b', 'scale', 'bias'):
+            if leaf in _LAYER_SPECS[name]:
+                specs['layers'][name][leaf] = with_layer_axis(
+                    _LAYER_SPECS[name][leaf])
+    return specs
+
+
+def _prune_to(params: Dict, specs: Dict) -> Dict:
+    """Drop spec entries whose param leaf doesn't exist (optional biases)."""
+    out = {}
+    for key, val in params.items():
+        spec = specs[key]
+        out[key] = _prune_to(val, spec) if isinstance(val, dict) else spec
+    return out
+
+
+def param_shardings(cfg: TransformerConfig, mesh: Mesh,
+                    params: Optional[Dict] = None) -> Dict:
+    """NamedSharding pytree for `jit(in_shardings=...)` / device_put."""
+    specs = param_specs(cfg)
+    if params is not None:
+        specs = _prune_to(params, specs)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params: Dict, cfg: TransformerConfig, mesh: Mesh) -> Dict:
+    """Place a (host or single-device) param pytree onto the mesh."""
+    shardings = param_shardings(cfg, mesh, params)
+    return jax.tree_util.tree_map(jax.device_put, params, shardings)

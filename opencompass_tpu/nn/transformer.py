@@ -1,0 +1,360 @@
+"""Pure-functional decoder-only transformer.
+
+Parameters are a plain pytree (nested dicts of arrays) with layer parameters
+stacked along a leading ``num_layers`` axis so the block stack runs under
+``lax.scan`` — one compiled block regardless of depth (compile time and HBM
+code size stay O(1) in layers).  Forward math mirrors what the reference gets
+from ``transformers`` models (reference opencompass/models/huggingface.py:
+201-293 calls ``self.model(...)`` for logits), but written TPU-first:
+
+- matmuls in bfloat16 on the MXU, softmax/normalization accumulated in fp32;
+- static shapes everywhere — callers bucket sequence lengths (models/jax_lm.py);
+- no data-dependent Python control flow: decode is `lax.while_loop`
+  (decode.py), the layer stack is `lax.scan`;
+- `with_sharding_constraint` annotations keyed to the ('data','seq','model')
+  mesh (parallel/mesh.py) so XLA lays out activations/collectives for TP.
+
+Supports GQA/MQA, RoPE or learned positions, RMSNorm/LayerNorm, gated
+(SwiGLU) or plain MLPs, parallel residual (Falcon) — see nn/config.py.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from opencompass_tpu.parallel.mesh import current_mesh
+
+from .config import TransformerConfig
+
+Params = Dict
+
+
+def _shard(x, spec: P):
+    """Sharding constraint that is a no-op outside a mesh context."""
+    if current_mesh() is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
+    """Random init (trunc-normal-ish scaled); layer params stacked on axis 0."""
+    dtype = cfg.jnp_dtype
+    keys = iter(jax.random.split(key, 32))
+
+    def dense(key, shape, scale=None):
+        scale = scale if scale is not None else shape[-2] ** -0.5
+        return (jax.random.normal(key, shape, jnp.float32) * scale
+                ).astype(dtype)
+
+    L, D, F = cfg.num_layers, cfg.hidden_size, cfg.intermediate_size
+    Q, KV = cfg.q_dim, cfg.kv_dim
+
+    def norm_p():
+        p = {'scale': jnp.ones((L, D), dtype)}
+        if cfg.norm == 'layernorm':
+            p['bias'] = jnp.zeros((L, D), dtype)
+        return p
+
+    layers = {
+        'attn_norm': norm_p(),
+        'mlp_norm': norm_p(),
+        'q': {'w': dense(next(keys), (L, D, Q))},
+        'k': {'w': dense(next(keys), (L, D, KV))},
+        'v': {'w': dense(next(keys), (L, D, KV))},
+        'o': {'w': dense(next(keys), (L, Q, D))},
+    }
+    if cfg.qkv_bias:
+        for name in ('q', 'k', 'v'):
+            dim = Q if name == 'q' else KV
+            layers[name]['b'] = jnp.zeros((L, dim), dtype)
+    if cfg.o_bias:
+        layers['o']['b'] = jnp.zeros((L, D), dtype)
+    if cfg.gated_mlp:
+        layers['gate'] = {'w': dense(next(keys), (L, D, F))}
+        layers['up'] = {'w': dense(next(keys), (L, D, F))}
+        layers['down'] = {'w': dense(next(keys), (L, F, D))}
+    else:
+        layers['fc1'] = {'w': dense(next(keys), (L, D, F))}
+        layers['fc2'] = {'w': dense(next(keys), (L, F, D))}
+        if cfg.mlp_bias:
+            layers['fc1']['b'] = jnp.zeros((L, F), dtype)
+            layers['fc2']['b'] = jnp.zeros((L, D), dtype)
+    if cfg.mlp_bias and cfg.gated_mlp:
+        layers['gate']['b'] = jnp.zeros((L, F), dtype)
+        layers['up']['b'] = jnp.zeros((L, F), dtype)
+        layers['down']['b'] = jnp.zeros((L, D), dtype)
+
+    params: Params = {
+        'embed': dense(next(keys), (cfg.vocab_size, D), scale=0.02),
+        'layers': layers,
+    }
+    if cfg.positional == 'learned':
+        params['pos_embed'] = dense(
+            next(keys), (cfg.max_seq_len + cfg.pos_offset, D), scale=0.02)
+    if cfg.final_norm:
+        params['final_norm'] = {'scale': jnp.ones((D,), dtype)}
+        if cfg.norm == 'layernorm':
+            params['final_norm']['bias'] = jnp.zeros((D,), dtype)
+    if not cfg.tie_embeddings:
+        params['lm_head'] = dense(next(keys), (D, cfg.vocab_size), scale=0.02)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# primitives
+# ---------------------------------------------------------------------------
+
+def _norm(x, p, cfg: TransformerConfig):
+    x32 = x.astype(jnp.float32)
+    if cfg.norm == 'rmsnorm':
+        x32 = x32 * jax.lax.rsqrt(
+            jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + cfg.norm_eps)
+        return (x32 * p['scale'].astype(jnp.float32)).astype(x.dtype)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    x32 = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
+    out = x32 * p['scale'].astype(jnp.float32) + p['bias'].astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _act(x, kind: str):
+    if kind == 'silu':
+        return jax.nn.silu(x)
+    if kind == 'gelu':
+        return jax.nn.gelu(x, approximate=False)
+    if kind == 'gelu_new':
+        return jax.nn.gelu(x, approximate=True)
+    if kind == 'relu':
+        return jax.nn.relu(x)
+    raise ValueError(kind)
+
+
+def _linear(x, p):
+    y = x @ p['w']
+    if 'b' in p:
+        y = y + p['b']
+    return y
+
+
+def _rope(x, positions, theta: float):
+    """HF-convention RoPE: rotate halves.  x: (B, T, H, hd)."""
+    hd = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, hd // 2, dtype=jnp.float32) / (hd // 2))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, T, hd/2)
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention(q, k, v, mask, cfg: TransformerConfig):
+    """Grouped-query attention.  q: (B,T,H,hd); k,v: (B,S,K,hd);
+    mask: (B,T,S) boolean (True = attend).  fp32 softmax accumulation."""
+    B, T, H, hd = q.shape
+    S, K = k.shape[1], k.shape[2]
+    G = H // K
+    qg = q.reshape(B, T, K, G, hd)
+    scores = jnp.einsum('btkgh,bskh->bkgts', qg, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores * (hd ** -0.5)
+    scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum('bkgts,bskh->btkgh', probs.astype(v.dtype), v)
+    return out.reshape(B, T, H, hd)
+
+
+def _block(cfg: TransformerConfig, x, lp, positions, mask,
+           cache_slice=None, cache_index=None):
+    """One transformer block.  x: (B,T,D).  With a cache slice, K/V for the
+    current tokens are written at ``cache_index`` and attention runs over the
+    whole cache; without, attention is over the current sequence only."""
+    B, T, D = x.shape
+    h = _norm(x, lp['attn_norm'], cfg)
+    q = _linear(h, lp['q']).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = _linear(h, lp['k']).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = _linear(h, lp['v']).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    q = _shard(q, P('data', None, 'model', None))
+    k = _shard(k, P('data', None, 'model', None))
+    v = _shard(v, P('data', None, 'model', None))
+
+    if cfg.positional == 'rope':
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+
+    new_cache = None
+    if cache_slice is not None:
+        ck = jax.lax.dynamic_update_slice_in_dim(
+            cache_slice['k'], k.astype(cache_slice['k'].dtype), cache_index,
+            axis=1)
+        cv = jax.lax.dynamic_update_slice_in_dim(
+            cache_slice['v'], v.astype(cache_slice['v'].dtype), cache_index,
+            axis=1)
+        new_cache = {'k': ck, 'v': cv}
+        k, v = ck, cv
+
+    attn = _attention(q, k, v, mask, cfg)
+    attn = _linear(attn.reshape(B, T, cfg.q_dim), lp['o'])
+    attn = _shard(attn, P('data', None, None))
+
+    if cfg.parallel_residual:
+        h2 = h  # falcon: single pre-norm feeds both attn and mlp
+    else:
+        x = x + attn
+        h2 = _norm(x, lp['mlp_norm'], cfg)
+
+    if cfg.gated_mlp:
+        mlp = _linear(_shard(_act(_linear(h2, lp['gate']), cfg.activation)
+                             * _linear(h2, lp['up']),
+                             P('data', None, 'model')), lp['down'])
+    else:
+        mlp = _linear(_shard(_act(_linear(h2, lp['fc1']), cfg.activation),
+                             P('data', None, 'model')), lp['fc2'])
+    mlp = _shard(mlp, P('data', None, None))
+
+    if cfg.parallel_residual:
+        x = x + attn + mlp
+    else:
+        x = x + mlp
+    return x, new_cache
+
+
+def _stack(cfg: TransformerConfig, x, layers, positions, mask,
+           cache=None, cache_index=None):
+    """Run the block stack via lax.scan over stacked layer params."""
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(
+            block, static_argnums=(0,),
+            policy=jax.checkpoint_policies.nothing_saveable)
+
+    if cache is None:
+        def step(h, lp):
+            h, _ = block(cfg, h, lp, positions, mask)
+            return h, None
+        if cfg.scan_layers:
+            x, _ = jax.lax.scan(step, x, layers)
+        else:
+            for i in range(cfg.num_layers):
+                lp = jax.tree_util.tree_map(lambda a: a[i], layers)
+                x, _ = step(x, lp)[0], None
+        return x, None
+
+    def step(h, layer_and_cache):
+        lp, cs = layer_and_cache
+        h, new_cs = block(cfg, h, lp, positions, mask, cs, cache_index)
+        return h, new_cs
+    if cfg.scan_layers:
+        x, new_cache = jax.lax.scan(step, x, (layers, cache))
+    else:
+        slices = []
+        for i in range(cfg.num_layers):
+            lp = jax.tree_util.tree_map(lambda a: a[i], layers)
+            cs = jax.tree_util.tree_map(lambda a: a[i], cache)
+            x, ncs = block(cfg, x, lp, positions, mask, cs, cache_index)
+            slices.append(ncs)
+        new_cache = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *slices)
+    return x, new_cache
+
+
+def _embed(params, cfg: TransformerConfig, tokens, positions):
+    x = params['embed'][tokens].astype(cfg.jnp_dtype)
+    if cfg.positional == 'learned':
+        pos = jnp.clip(positions + cfg.pos_offset, 0,
+                       params['pos_embed'].shape[0] - 1)
+        x = x + params['pos_embed'][pos].astype(cfg.jnp_dtype)
+    return _shard(x, P('data', None, None))
+
+
+def _unembed(params, cfg: TransformerConfig, x):
+    if cfg.final_norm:
+        x = _norm(x, params['final_norm'], cfg)
+    head = params['embed'].T if cfg.tie_embeddings else params['lm_head']
+    logits = jnp.einsum('btd,dv->btv', x, head,
+                        preferred_element_type=jnp.float32)
+    return _shard(logits, P('data', None, 'model'))
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+def forward(params: Params, cfg: TransformerConfig, tokens: jax.Array,
+            pad_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Full-sequence causal forward → fp32 logits (B, S, V).
+
+    ``pad_mask`` (B, S) marks real tokens (right- or left-padding both work:
+    positions are per-example cumulative counts of real tokens, pads cannot
+    be attended to).  This is the PPL path (reference huggingface.py:254-293
+    equivalent measurement).
+    """
+    B, S = tokens.shape
+    if pad_mask is None:
+        pad_mask = jnp.ones((B, S), jnp.bool_)
+    pad_mask = pad_mask.astype(jnp.bool_)
+    positions = jnp.cumsum(pad_mask, axis=-1) - 1
+    positions = jnp.maximum(positions, 0)
+    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    mask = causal[None, :, :] & pad_mask[:, None, :]
+    x = _embed(params, cfg, tokens, positions)
+    x, _ = _stack(cfg, x, params['layers'], positions, mask)
+    return _unembed(params, cfg, x)
+
+
+def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
+               dtype=None) -> Dict:
+    dtype = dtype or cfg.jnp_dtype
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return {'k': jnp.zeros(shape, dtype), 'v': jnp.zeros(shape, dtype)}
+
+
+def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
+            pad_mask: jax.Array, cache: Dict
+            ) -> Tuple[jax.Array, Dict, jax.Array]:
+    """Process a left-padded prompt batch, filling cache slots [0, S).
+
+    Returns (last-position logits (B, V), cache, per-example positions of the
+    *next* token).  Left padding keeps every example's last real token at
+    slot S-1, so decode steps append at a common slot index — one static
+    shape for the whole batch (XLA-friendly; no per-example gather).
+    """
+    B, S = tokens.shape
+    pad_mask = pad_mask.astype(jnp.bool_)
+    positions = jnp.cumsum(pad_mask, axis=-1) - 1
+    positions = jnp.maximum(positions, 0)
+    # prompt token i occupies cache slot i → query i may attend slots j <= i
+    causal = jnp.tril(jnp.ones((S, cache['k'].shape[2]), jnp.bool_))
+    # valid kv slots during prefill: the first S slots, minus pads
+    kv_valid = jnp.zeros((B, cache['k'].shape[2]), jnp.bool_)
+    kv_valid = jax.lax.dynamic_update_slice_in_dim(kv_valid, pad_mask, 0,
+                                                   axis=1)
+    mask = causal[None, :, :] & kv_valid[:, None, :]
+    x = _embed(params, cfg, tokens, positions)
+    x, cache = _stack(cfg, x, params['layers'], positions, mask, cache, 0)
+    logits = _unembed(params, cfg, x[:, -1:, :])[:, 0, :]
+    next_pos = positions[:, -1] + 1
+    return logits, cache, next_pos
+
+
+def decode_step(params: Params, cfg: TransformerConfig, token: jax.Array,
+                cache: Dict, slot: jax.Array, positions: jax.Array,
+                kv_valid: jax.Array) -> Tuple[jax.Array, Dict]:
+    """One autoregressive step.  token: (B,); slot: scalar cache index;
+    positions: (B,) rope positions for this token; kv_valid: (B, S_max)
+    validity after this token is written.  Returns (logits (B,V), cache)."""
+    B = token.shape[0]
+    x = _embed(params, cfg, token[:, None], positions[:, None])
+    mask = kv_valid[:, None, :]
+    x, cache = _stack(cfg, x, params['layers'], positions[:, None], mask,
+                      cache, slot)
+    logits = _unembed(params, cfg, x)[:, 0, :]
+    return logits, cache

@@ -1,0 +1,17 @@
+"""Device-mesh and collective-level parallelism.
+
+The reference's "distributed evaluation" is a scheduler of independent
+processes (SURVEY.md §2.7); its only in-model parallelism is delegated to
+external libs (torchrun/NCCL, reference tasks/openicl_infer.py:34-40).  Here
+parallelism is first-class: a `jax.sharding.Mesh` with ``data`` / ``model`` /
+``seq`` axes, Megatron-style parameter shardings (nn/sharding.py), and ring
+attention over the ``seq`` axis for long contexts (ring_attention.py).  XLA
+inserts the collectives (psum/all-gather/ppermute) over ICI.
+"""
+from .mesh import (MeshSpec, make_mesh, use_mesh, current_mesh,
+                   current_mesh_axes, local_device_count)
+
+__all__ = [
+    'MeshSpec', 'make_mesh', 'use_mesh', 'current_mesh',
+    'current_mesh_axes', 'local_device_count',
+]

@@ -3,10 +3,20 @@ sharding/collective paths are exercised hermetically (no TPU required)."""
 import os
 import sys
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+os.environ['JAX_PLATFORMS'] = 'cpu'
+# the axon TPU plugin (sitecustomize) registers itself whenever
+# PALLAS_AXON_POOL_IPS is set and then forces jax_platforms to the real
+# chip via jax.config.update — which runs before this conftest. Clear the
+# env for subprocesses and override jax.config so tests stay hermetic on
+# the virtual CPU mesh.
+os.environ.pop('PALLAS_AXON_POOL_IPS', None)
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', 'cpu')

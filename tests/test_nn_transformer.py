@@ -1,0 +1,150 @@
+"""nn/ stack: forward, cached decode, sharding equivalence, architectures."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opencompass_tpu.nn import (TransformerConfig, forward, greedy_generate,
+                                init_params, sequence_nll, shard_params)
+from opencompass_tpu.parallel import MeshSpec, make_mesh, use_mesh
+
+
+@pytest.fixture(scope='module')
+def tiny():
+    cfg = TransformerConfig.tiny()
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def test_forward_shapes_and_dtype(tiny):
+    cfg, params = tiny
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                              cfg.vocab_size)
+    logits = forward(params, cfg, toks)
+    assert logits.shape == (2, 16, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_pad_mask_right_does_not_change_prefix_logits(tiny):
+    cfg, params = tiny
+    toks = jax.random.randint(jax.random.PRNGKey(1), (1, 8), 0,
+                              cfg.vocab_size)
+    full = forward(params, cfg, toks)
+    padded = jnp.concatenate(
+        [toks, jnp.zeros((1, 4), toks.dtype)], axis=1)
+    mask = jnp.concatenate(
+        [jnp.ones((1, 8), bool), jnp.zeros((1, 4), bool)], axis=1)
+    out = forward(params, cfg, padded, mask)
+    np.testing.assert_allclose(np.asarray(out[:, :8]), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_decode_matches_teacher_forcing(tiny):
+    cfg, params = tiny
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0,
+                                cfg.vocab_size)
+    pmask = jnp.ones((2, 8), bool)
+    out, _ = greedy_generate(params, cfg, prompt, pmask, 6)
+    full = jnp.concatenate([prompt, out], axis=1)
+    ref = jnp.argmax(forward(params, cfg, full), axis=-1)
+    for i in range(6):
+        assert bool(jnp.all(ref[:, 7 + i] == out[:, i])), f'step {i}'
+
+
+def test_decode_left_padding_invariance(tiny):
+    cfg, params = tiny
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0,
+                                cfg.vocab_size)
+    pmask = jnp.ones((2, 8), bool)
+    out1, _ = greedy_generate(params, cfg, prompt, pmask, 5)
+    padded = jnp.concatenate(
+        [jnp.zeros((2, 3), prompt.dtype), prompt], axis=1)
+    padmask = jnp.concatenate([jnp.zeros((2, 3), bool), pmask], axis=1)
+    out2, _ = greedy_generate(params, cfg, padded, padmask, 5)
+    assert bool(jnp.all(out1 == out2))
+
+
+def test_eos_early_stop_pads_output(tiny):
+    cfg, params = tiny
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 8), 0,
+                                cfg.vocab_size)
+    pmask = jnp.ones((1, 8), bool)
+    base, _ = greedy_generate(params, cfg, prompt, pmask, 8)
+    eos = int(base[0, 2])  # pretend the 3rd emitted token is EOS
+    out, lengths = greedy_generate(params, cfg, prompt, pmask, 8,
+                                   eos_token_id=eos, pad_token_id=0)
+    n = int(lengths[0])
+    assert n <= 3 or eos not in base[0, :3]
+    assert bool(jnp.all(out[0, n:] == 0))
+
+
+def test_sequence_nll_mask_length_excludes_context(tiny):
+    cfg, params = tiny
+    toks = jax.random.randint(jax.random.PRNGKey(5), (2, 12), 0,
+                              cfg.vocab_size)
+    mask = jnp.ones((2, 12), bool)
+    logits = forward(params, cfg, toks, mask)
+    full = sequence_nll(logits, toks, mask)
+    masked = sequence_nll(logits, toks, mask,
+                          mask_length=jnp.asarray([6, 6]))
+    assert full.shape == (2,)
+    assert not np.allclose(np.asarray(full), np.asarray(masked))
+
+
+def test_tensor_parallel_matches_single_device(tiny):
+    cfg, params = tiny
+    toks = jax.random.randint(jax.random.PRNGKey(6), (4, 16), 0,
+                              cfg.vocab_size)
+    ref = forward(params, cfg, toks)
+    mesh = make_mesh(MeshSpec(data=2, model=2, seq=1))
+    with use_mesh(mesh):
+        sp = shard_params(params, cfg, mesh)
+        out = jax.jit(lambda p, t: forward(p, cfg, t))(sp, toks)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_tensor_parallel_decode_matches(tiny):
+    cfg, params = tiny
+    prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0,
+                                cfg.vocab_size)
+    pmask = jnp.ones((2, 8), bool)
+    ref, _ = greedy_generate(params, cfg, prompt, pmask, 4)
+    mesh = make_mesh(MeshSpec(data=2, model=2, seq=1))
+    with use_mesh(mesh):
+        sp = shard_params(params, cfg, mesh)
+        out, _ = jax.jit(
+            lambda p, t, m: greedy_generate(p, cfg, t, m, 4))(sp, prompt,
+                                                              pmask)
+    assert bool(jnp.all(out == ref))
+
+
+@pytest.mark.parametrize('family_kw', [
+    dict(norm='layernorm', positional='learned', gated_mlp=False,
+         activation='relu', qkv_bias=True, o_bias=True, mlp_bias=True,
+         tie_embeddings=True, pos_offset=2),           # OPT-style
+    dict(parallel_residual=True, norm='layernorm', gated_mlp=False,
+         activation='gelu', num_kv_heads=1),           # Falcon-style MQA
+    dict(qkv_bias=True, num_kv_heads=2),               # Qwen2-style GQA
+])
+def test_architecture_variants_run(family_kw):
+    cfg = TransformerConfig.tiny(**family_kw)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                              cfg.vocab_size)
+    logits = forward(params, cfg, toks)
+    assert logits.shape == (2, 8, cfg.vocab_size)
+    out, _ = greedy_generate(params, cfg, toks, jnp.ones((2, 8), bool), 3)
+    assert out.shape == (2, 3)
+
+
+def test_scan_vs_unrolled_layers_match(tiny):
+    cfg, params = tiny
+    import dataclasses
+    cfg2 = dataclasses.replace(cfg, scan_layers=False)
+    toks = jax.random.randint(jax.random.PRNGKey(8), (1, 8), 0,
+                              cfg.vocab_size)
+    a = forward(params, cfg, toks)
+    b = forward(params, cfg2, toks)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                               atol=1e-5)
