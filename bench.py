@@ -1,0 +1,113 @@
+"""Headline benchmark: eval samples/sec/chip on the PPL + generation paths.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload mirrors the reference's hot loops (SURVEY.md §3.2-3.3): batched
+PPL scoring (one forward + shifted CE per batch — the MMLU/PIQA-style
+ranking path) and batched greedy generation (the GSM8K-style path), on a
+llama-family model in bf16.  The reference publishes no perf numbers
+(BASELINE.md), so ``vs_baseline`` compares against the previous round's
+recorded value when available (BENCH_r*.json), else 1.0.
+
+Run on whatever jax.devices() offers (the driver provides one real TPU
+chip); value is normalized per chip.
+"""
+import glob
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from opencompass_tpu.nn import (TransformerConfig, forward, greedy_generate,
+                                init_params, sequence_nll)
+
+# llama-shaped; sized so bench (compile + run) stays under ~3 min on one chip
+CFG = TransformerConfig.llama(
+    vocab_size=32000, hidden_size=1024, num_layers=8, num_heads=16,
+    num_kv_heads=16, intermediate_size=2816, max_seq_len=2048)
+
+PPL_BATCH, PPL_SEQ, PPL_ITERS = 32, 512, 8
+GEN_BATCH, GEN_PROMPT, GEN_NEW = 16, 128, 64
+
+
+def _bench_ppl(params):
+    @jax.jit
+    def step(params, tokens, mask):
+        return sequence_nll(forward(params, CFG, tokens, mask), tokens, mask)
+
+    tokens = jnp.ones((PPL_BATCH, PPL_SEQ), jnp.int32)
+    mask = jnp.ones((PPL_BATCH, PPL_SEQ), jnp.bool_)
+    # host fetch (not block_until_ready) to fully drain compile + queue:
+    # some PJRT backends return from block early while work is in flight
+    np.asarray(step(params, tokens, mask))
+    t0 = time.perf_counter()
+    for _ in range(PPL_ITERS):
+        out = step(params, tokens, mask)
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+    return PPL_BATCH * PPL_ITERS / dt
+
+
+def _bench_gen(params):
+    @jax.jit
+    def step(params, tokens, mask):
+        return greedy_generate(params, CFG, tokens, mask, GEN_NEW,
+                               eos_token_id=None)[0]
+
+    tokens = jnp.ones((GEN_BATCH, GEN_PROMPT), jnp.int32)
+    mask = jnp.ones((GEN_BATCH, GEN_PROMPT), jnp.bool_)
+    np.asarray(step(params, tokens, mask))  # compile + full sync
+    t0 = time.perf_counter()
+    out = step(params, tokens, mask)
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+    return GEN_BATCH / dt, GEN_BATCH * GEN_NEW / dt
+
+
+def _previous_value():
+    best = None
+    for path in sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), 'BENCH_r*.json'))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if rec.get('unit', '').startswith('samples/sec'):
+                best = rec.get('value', best)
+        except Exception:
+            pass
+    return best
+
+
+def main():
+    n_chips = max(1, len(jax.devices()))
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    ppl_sps = _bench_ppl(params)
+    gen_sps, gen_tps = _bench_gen(params)
+    # headline: harmonic-style blend of the two eval paths, per chip
+    value = 2.0 / (1.0 / ppl_sps + 1.0 / gen_sps) / n_chips
+    prev = _previous_value()
+    record = {
+        'metric': 'eval samples/sec/chip (PPL b32xs512 + gen b16 p128+64, '
+                  'llama-1024x8 bf16)',
+        'value': round(value, 3),
+        'unit': 'samples/sec/chip',
+        'vs_baseline': round(value / prev, 3) if prev else 1.0,
+        'detail': {
+            'ppl_samples_per_sec': round(ppl_sps, 3),
+            'gen_samples_per_sec': round(gen_sps, 3),
+            'gen_tokens_per_sec': round(gen_tps, 1),
+            'n_chips': n_chips,
+            'platform': jax.devices()[0].platform,
+        },
+    }
+    print(json.dumps(record))
+
+
+if __name__ == '__main__':
+    main()

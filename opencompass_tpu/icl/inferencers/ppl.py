@@ -111,6 +111,11 @@ class PPLInferencer(BaseInferencer):
                     sep_token = (prompt_template.sep_token
                                  if prompt_template is not None else
                                  ice_template.sep_token)
+                    if sep_token is None:
+                        raise ValueError(
+                            'normalizing_str needs a template constructed '
+                            'with a sep_token marking the context/answer '
+                            'split')
                     sep_pos = prompt.find(sep_token)
                     if sep_pos < 0:
                         raise ValueError(

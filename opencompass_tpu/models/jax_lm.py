@@ -84,7 +84,12 @@ class JaxLM(BaseModel):
                          tokenizer_only=tokenizer_only,
                          meta_template=meta_template,
                          generation_kwargs=generation_kwargs)
-        self.cfg = self._resolve_config(path, config, dtype, max_seq_len)
+        try:
+            self.cfg = self._resolve_config(path, config, dtype, max_seq_len)
+        except ValueError:
+            if not tokenizer_only:
+                raise
+            self.cfg = None  # token counting needs no model config
         self.tokenizer = load_tokenizer(
             tokenizer_path or path, tokenizer_kwargs,
             vocab_size=self.cfg.vocab_size if self.cfg else 512)
@@ -161,6 +166,16 @@ class JaxLM(BaseModel):
     @functools.cached_property
     def _ppl_fn(self):
         cfg = self.cfg
+        mesh = self.mesh
+        use_ring = mesh is not None and mesh.shape.get('seq', 1) > 1
+        if use_ring:
+            from opencompass_tpu.parallel.ring_attention import ring_forward
+
+            @jax.jit
+            def ppl(params, tokens, mask, mask_length):
+                logits = ring_forward(params, cfg, tokens, mask, mesh)
+                return sequence_nll(logits, tokens, mask, mask_length)
+            return ppl
 
         @jax.jit
         def ppl(params, tokens, mask, mask_length):
@@ -205,7 +220,8 @@ class JaxLM(BaseModel):
         ids = [self.tokenizer.encode(str(s))[:max_len] for s in inputs]
         longest = max((len(x) for x in ids), default=1)
         S = _bucket(max(longest, 1), hi=max(max_len, 32))
-        B = _bucket(len(ids), lo=1)
+        min_b = self.mesh.shape.get('data', 1) if self.mesh is not None else 1
+        B = _bucket(len(ids), lo=max(1, min_b))
         pad_id = self.tokenizer.pad_token_id or 0
         tokens = np.full((B, S), pad_id, np.int32)
         mask = np.zeros((B, S), bool)
@@ -232,9 +248,10 @@ class JaxLM(BaseModel):
 
     def generate(self, inputs: List[str], max_out_len: int) -> List[str]:
         gk = dict(self.generation_kwargs)
-        temperature = float(gk.get('temperature', 0.0))
-        if not gk.get('do_sample', False):
-            temperature = 0.0
+        if gk.get('do_sample', False):
+            temperature = float(gk.get('temperature', 1.0))  # HF default
+        else:
+            temperature = 0.0  # greedy
         top_k = int(gk.get('top_k', 0))
         seed = int(gk.get('seed', 0))
         with use_mesh(self.mesh):

@@ -159,7 +159,8 @@ class TransformerConfig:
                 hidden_size=hf['hidden_size'],
                 num_layers=hf['num_hidden_layers'],
                 num_heads=hf['num_attention_heads'],
-                num_kv_heads=hf.get('num_key_value_heads'),
+                num_kv_heads=(hf.get('num_key_value_heads')
+                              or hf['num_attention_heads']),
                 intermediate_size=hf['intermediate_size'],
                 max_seq_len=hf.get('max_position_embeddings', 4096),
                 rope_theta=hf.get('rope_theta', 1000000.0),

@@ -58,11 +58,15 @@ def greedy_generate(params, cfg: TransformerConfig, tokens: jax.Array,
 
     rng, key = jax.random.split(rng)
     first = _sample(logits, key, temperature, top_k)
+    # all-pad rows (batch-bucket filler) count as done immediately so they
+    # can't defeat the all-done early exit below
+    empty = ~jnp.any(pad_mask.astype(jnp.bool_), axis=-1)
+    first = jnp.where(empty, jnp.asarray(pad_token_id, first.dtype), first)
     out = jnp.full((B, max_new_tokens), pad_token_id, tokens.dtype)
     out = out.at[:, 0].set(first.astype(tokens.dtype))
-    done = jnp.zeros((B,), jnp.bool_)
+    done = empty
     if eos_token_id is not None:
-        done = first == eos_token_id
+        done = done | (first == eos_token_id)
 
     def cond(carry):
         step, _, _, _, _, done, _, _ = carry

@@ -304,7 +304,14 @@ def convert_checkpoint(path: str, cfg: Optional[TransformerConfig] = None,
                 raise ValueError(f'{dest}: missing layers {missing[:5]}...')
             put(dest, np.stack([by_layer[i] for i in range(L)]))
 
-    _split_fused_qkv(params.get('layers', {}), cfg)
+    layers = params.get('layers', {})
+    if family == 'falcon' and hf_cfg.get('new_decoder_architecture') \
+            and '_qkv_mqa' in layers:
+        # falcon-40b/180b store QKV interleaved per kv-group ([q*ratio|k|v]
+        # per group — same layout as internlm2 wqkv), not the falcon-7b
+        # block layout the _qkv_mqa split assumes
+        layers['_wqkv_grouped'] = layers.pop('_qkv_mqa')
+    _split_fused_qkv(layers, cfg)
 
     if cfg.tie_embeddings:
         params.pop('lm_head', None)

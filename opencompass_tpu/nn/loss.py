@@ -38,5 +38,11 @@ def sequence_nll(logits: jax.Array, tokens: jax.Array, pad_mask: jax.Array,
         pos = jnp.arange(1, tokens.shape[1])[None, :]
         valid = valid * (pos >= mask_length[:, None])
     total = jnp.sum(nll * valid, axis=-1)
-    count = jnp.maximum(jnp.sum(valid, axis=-1), 1.0)
-    return total / count
+    # reference divides by the count of *real tokens* (minus mask_length),
+    # not scored targets (reference huggingface.py:287-292: lens = (inputs
+    # != pad).sum(-1); lens -= mask_length; loss.sum(-1)/lens) — candidate
+    # ranking is sensitive to this R vs R-1 factor for short answers.
+    count = jnp.sum(pad_mask.astype(jnp.float32), axis=-1)
+    if mask_length is not None:
+        count = count - mask_length.astype(jnp.float32)
+    return total / jnp.maximum(count, 1.0)

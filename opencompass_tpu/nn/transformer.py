@@ -173,10 +173,12 @@ def _attention(q, k, v, mask, cfg: TransformerConfig):
 
 
 def _block(cfg: TransformerConfig, x, lp, positions, mask,
-           cache_slice=None, cache_index=None):
+           cache_slice=None, cache_index=None, attn_fn=None):
     """One transformer block.  x: (B,T,D).  With a cache slice, K/V for the
     current tokens are written at ``cache_index`` and attention runs over the
-    whole cache; without, attention is over the current sequence only."""
+    whole cache; without, attention is over the current sequence only.
+    ``attn_fn(q, k, v)`` overrides the attention op (ring attention plugs in
+    here); the default is full masked attention."""
     B, T, D = x.shape
     h = _norm(x, lp['attn_norm'], cfg)
     q = _linear(h, lp['q']).reshape(B, T, cfg.num_heads, cfg.head_dim)
@@ -201,7 +203,10 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
         new_cache = {'k': ck, 'v': cv}
         k, v = ck, cv
 
-    attn = _attention(q, k, v, mask, cfg)
+    if attn_fn is not None:
+        attn = attn_fn(q, k, v)
+    else:
+        attn = _attention(q, k, v, mask, cfg)
     attn = _linear(attn.reshape(B, T, cfg.q_dim), lp['o'])
     attn = _shard(attn, P('data', None, None))
 
@@ -228,9 +233,10 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
 
 
 def _stack(cfg: TransformerConfig, x, layers, positions, mask,
-           cache=None, cache_index=None):
+           cache=None, cache_index=None, attn_fn=None):
     """Run the block stack via lax.scan over stacked layer params."""
-    block = _block
+    def block(cfg, *args, **kw):
+        return _block(cfg, *args, attn_fn=attn_fn, **kw)
     if cfg.remat:
         block = jax.checkpoint(
             block, static_argnums=(0,),
@@ -245,7 +251,7 @@ def _stack(cfg: TransformerConfig, x, layers, positions, mask,
         else:
             for i in range(cfg.num_layers):
                 lp = jax.tree_util.tree_map(lambda a: a[i], layers)
-                x, _ = step(x, lp)[0], None
+                x, _ = step(x, lp)
         return x, None
 
     def step(h, layer_and_cache):

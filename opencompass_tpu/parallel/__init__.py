@@ -9,9 +9,11 @@ attention over the ``seq`` axis for long contexts (ring_attention.py).  XLA
 inserts the collectives (psum/all-gather/ppermute) over ICI.
 """
 from .mesh import (MeshSpec, make_mesh, use_mesh, current_mesh,
-                   current_mesh_axes, local_device_count)
+                   current_mesh_axes, local_device_count, manual_axes)
+from .ring_attention import ring_forward
 
 __all__ = [
     'MeshSpec', 'make_mesh', 'use_mesh', 'current_mesh',
-    'current_mesh_axes', 'local_device_count',
+    'current_mesh_axes', 'local_device_count', 'manual_axes',
+    'ring_forward',
 ]

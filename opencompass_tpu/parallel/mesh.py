@@ -90,7 +90,22 @@ def use_mesh(mesh: Optional[Mesh]):
 
 
 def current_mesh() -> Optional[Mesh]:
+    if getattr(_state, 'constraints_disabled', False):
+        return None
     return getattr(_state, 'mesh', None)
+
+
+@contextlib.contextmanager
+def manual_axes():
+    """Suppress `with_sharding_constraint` annotations in model code while
+    inside a `shard_map` body (where mesh axes are manually mapped and
+    PartitionSpec constraints would be rejected)."""
+    prev = getattr(_state, 'constraints_disabled', False)
+    _state.constraints_disabled = True
+    try:
+        yield
+    finally:
+        _state.constraints_disabled = prev
 
 
 def current_mesh_axes() -> Tuple[str, ...]:

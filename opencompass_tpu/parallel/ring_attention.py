@@ -1,0 +1,117 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context support the reference never had — it *truncates* long prompts
+(reference icl_gen_inferencer.py:167-181, huggingface.py:142-145).  Here a
+sequence is sharded into chunks over the mesh's ``seq`` axis; each device
+computes blockwise attention for its local queries while K/V chunks rotate
+around the ring via ``ppermute`` (one ICI hop per step), with flash-style
+running-max/denominator accumulation in fp32.  Peak memory per device is
+O(S/n · S/n) scores instead of O(S²), and the K/V transfer overlaps with the
+current block's compute in XLA's schedule.
+
+`ring_forward` runs the full transformer stack under `shard_map` with this
+attention, sharing the block/stack code in nn/transformer.py via its
+``attn_fn`` hook.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from .mesh import manual_axes
+
+
+def _ring_attention(q, k, v, kv_valid, q_index, axis_name: str):
+    """Blockwise ring attention for one shard_map-mapped chunk.
+
+    q: (B, T, H, hd) local queries; k/v: (B, T, K, hd) local K/V chunk;
+    kv_valid: (B, T) validity of local K/V slots; q_index: (T,) global
+    sequence indices of the local queries (for causal masking).
+    Returns (B, T, H, hd).
+    """
+    B, T, H, hd = q.shape
+    K = k.shape[2]
+    G = H // K
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    qg = q.reshape(B, T, K, G, hd)
+    scale = hd ** -0.5
+
+    m0 = jnp.full((B, K, G, T), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, K, G, T), jnp.float32)
+    o0 = jnp.zeros((B, K, G, T, hd), jnp.float32)
+    perm = [(i, (i - 1) % n) for i in range(n)]  # send left; recv from right
+
+    def step(s, carry):
+        k_c, v_c, valid_c, m, l, o = carry
+        src = (my + s) % n                     # which chunk we hold now
+        kv_index = src * T + jnp.arange(T)
+        mask = (kv_index[None, :] <= q_index[:, None])[None, :, :] \
+            & valid_c[:, None, :]              # (B, T_q, T_kv)
+        scores = jnp.einsum('btkgh,bskh->bkgts', qg, k_c,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(mask[:, None, None, :, :], scores, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        # fully-masked-so-far rows keep m=-inf; guard the exp arithmetic
+        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(jnp.where(jnp.isfinite(scores),
+                              scores - m_safe[..., None], -jnp.inf))
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            'bkgts,bskh->bkgth', p, v_c.astype(jnp.float32))
+        k_n, v_n, valid_n = jax.lax.ppermute((k_c, v_c, valid_c),
+                                             axis_name, perm)
+        return k_n, v_n, valid_n, m_new, l, o
+
+    _, _, _, _, l, o = jax.lax.fori_loop(
+        0, n, step, (k, v, kv_valid, m0, l0, o0))
+    out = o / jnp.maximum(l[..., None], 1e-30)
+    # (B, K, G, T, hd) -> (B, T, H, hd)
+    return out.transpose(0, 3, 1, 2, 4).reshape(B, T, H, hd).astype(q.dtype)
+
+
+def ring_forward(params, cfg, tokens: jax.Array, pad_mask: jax.Array,
+                 mesh: Mesh) -> jax.Array:
+    """Full-sequence causal forward with the sequence dim sharded over the
+    mesh's ``seq`` axis (ring attention) and batch over ``data``.
+
+    Same math as nn.transformer.forward — fp32 logits (B, S, V).  Requires
+    S divisible by the seq axis size; ``model`` axis must be 1 (combine
+    TP with ring attention later if a workload demands both).
+    """
+    from opencompass_tpu.nn.transformer import _embed, _stack, _unembed
+
+    n_seq = mesh.shape['seq']
+    B, S = tokens.shape
+    assert S % n_seq == 0, f'seq len {S} not divisible by seq axis {n_seq}'
+    assert mesh.shape.get('model', 1) == 1, \
+        'ring_forward supports data+seq meshes (model axis must be 1)'
+    pad_mask = pad_mask.astype(jnp.bool_)
+    positions = jnp.maximum(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
+    T = S // n_seq
+
+    def body(params, tokens_c, pad_c, pos_c):
+        my = jax.lax.axis_index('seq')
+        q_index = my * T + jnp.arange(T)
+
+        def attn_fn(q, k, v):
+            return _ring_attention(q, k, v, pad_c, q_index, 'seq')
+
+        with manual_axes():
+            x = _embed(params, cfg, tokens_c, pos_c)
+            x, _ = _stack(cfg, x, params['layers'], pos_c, mask=None,
+                          attn_fn=attn_fn)
+            return _unembed(params, cfg, x)
+
+    f = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P('data', 'seq'), P('data', 'seq'),
+                  P('data', 'seq')),
+        out_specs=P('data', 'seq', None),
+        check_vma=False)
+    return f(params, tokens, pad_mask, positions)
