@@ -15,6 +15,7 @@ chip); value is normalized per chip.
 import glob
 import json
 import os
+import re
 import sys
 import time
 
@@ -71,9 +72,14 @@ def _bench_gen(params):
 
 
 def _previous_value():
+    def round_num(path):
+        m = re.search(r'BENCH_r(\d+)\.json$', path)
+        return int(m.group(1)) if m else -1
+
     best = None
     for path in sorted(glob.glob(os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), 'BENCH_r*.json'))):
+            os.path.dirname(os.path.abspath(__file__)), 'BENCH_r*.json')),
+            key=round_num):
         try:
             with open(path) as f:
                 rec = json.load(f)

@@ -1,0 +1,26 @@
+from opencompass_tpu.datasets.demo import DemoDataset
+from opencompass_tpu.icl import (FixKRetriever, GenInferencer,
+                                 PromptTemplate)
+from opencompass_tpu.icl.evaluators import EMEvaluator
+
+demo_reader_cfg = dict(input_columns=['question'], output_column='answer')
+
+demo_infer_cfg = dict(
+    ice_template=dict(type=PromptTemplate,
+                      template='Q: {question}\nA: {answer}\n'),
+    prompt_template=dict(type=PromptTemplate,
+                         template='</E>Q: {question}\nA:',
+                         ice_token='</E>'),
+    retriever=dict(type=FixKRetriever, fix_id_list=[0, 1, 2]),
+    inferencer=dict(type=GenInferencer, max_out_len=8),
+)
+
+demo_eval_cfg = dict(evaluator=dict(type=EMEvaluator))
+
+demo_gen_datasets = [
+    dict(type=DemoDataset,
+         abbr='demo-gen',
+         reader_cfg=demo_reader_cfg,
+         infer_cfg=demo_infer_cfg,
+         eval_cfg=demo_eval_cfg),
+]

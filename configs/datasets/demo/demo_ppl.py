@@ -1,0 +1,28 @@
+from opencompass_tpu.datasets.demo import DemoDataset
+from opencompass_tpu.icl import PPLInferencer, PromptTemplate, ZeroRetriever
+from opencompass_tpu.icl.evaluators import AccEvaluator
+
+demo_reader_cfg = dict(input_columns=['question'], output_column='parity',
+                       test_range='[0:8]')
+
+# label-ranking: score the prompt under each fixed candidate label
+demo_ppl_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            'even': 'Q: is {question} even or odd?\nA: even',
+            'odd': 'Q: is {question} even or odd?\nA: odd',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer),
+)
+
+demo_ppl_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+demo_ppl_datasets = [
+    dict(type=DemoDataset,
+         abbr='demo-ppl',
+         reader_cfg=demo_reader_cfg,
+         infer_cfg=demo_ppl_infer_cfg,
+         eval_cfg=demo_ppl_eval_cfg),
+]

@@ -1,0 +1,27 @@
+"""Hermetic end-to-end demo: FakeModel over the built-in demo datasets.
+
+    python run.py configs/eval_demo.py --debug
+
+Swap the model for `configs/models/jax_llama_tiny.py` to exercise the TPU
+path with random weights.
+"""
+from opencompass_tpu.models import FakeModel
+
+with read_base():
+    from .datasets.demo.demo_gen import demo_gen_datasets
+    from .datasets.demo.demo_ppl import demo_ppl_datasets
+
+datasets = [*demo_gen_datasets, *demo_ppl_datasets]
+
+models = [
+    dict(type=FakeModel,
+         abbr='fake-demo',
+         path='fake',
+         max_seq_len=2048,
+         batch_size=4,
+         # the canned response makes ~half the gen answers exact-match
+         canned_responses={'A:': '101'},
+         run_cfg=dict(num_devices=0)),
+]
+
+work_dir = './outputs/demo'

@@ -1,0 +1,13 @@
+"""Tiny random-weights llama-family JaxLM — device-path smoke model."""
+from opencompass_tpu.models import JaxLM
+
+models = [
+    dict(type=JaxLM,
+         abbr='jax-llama-tiny',
+         path='',
+         config='tiny',
+         max_seq_len=256,
+         batch_size=4,
+         max_out_len=16,
+         run_cfg=dict(num_devices=1)),
+]

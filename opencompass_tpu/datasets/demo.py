@@ -1,0 +1,31 @@
+"""Deterministic built-in demo dataset (no network, no files).
+
+Arithmetic QA rows — the hermetic stand-in for the reference's
+``eval_demo.py`` smoke config (reference configs/eval_demo.py:11-28), usable
+with FakeModel for pipeline tests or JaxLM for device smoke runs.
+"""
+from datasets import Dataset, DatasetDict
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class DemoDataset(BaseDataset):
+
+    @staticmethod
+    def load(n_train: int = 8, n_test: int = 16):
+        def rows(n, offset):
+            qs, ans, par = [], [], []
+            for i in range(n):
+                a, b = i + offset, 2 * i + 1
+                qs.append(f'{a}+{b}=?')
+                ans.append(str(a + b))
+                par.append('even' if (a + b) % 2 == 0 else 'odd')
+            return {'question': qs, 'answer': ans, 'parity': par}
+
+        return DatasetDict({
+            'train': Dataset.from_dict(rows(n_train, 1)),
+            'test': Dataset.from_dict(rows(n_test, 100)),
+        })

@@ -153,6 +153,10 @@ class JaxLM(BaseModel):
                    abs(parallel.get('seq', 1)))
         if n_dev == 1 and want <= 1:
             return
+        if parallel.get('model', 1) > 1 and parallel.get('seq', 1) > 1:
+            raise ValueError(
+                'combining model (tensor) and seq (ring attention) axes is '
+                'not supported yet; pick one of model>1 or seq>1')
         spec = MeshSpec(data=parallel.get('data', -1),
                         model=parallel.get('model', 1),
                         seq=parallel.get('seq', 1))
@@ -221,7 +225,13 @@ class JaxLM(BaseModel):
         longest = max((len(x) for x in ids), default=1)
         S = _bucket(max(longest, 1), hi=max(max_len, 32))
         min_b = self.mesh.shape.get('data', 1) if self.mesh is not None else 1
+        seq_par = self.mesh.shape.get('seq', 1) if self.mesh is not None \
+            else 1
+        if S % seq_par:  # ring attention shards S over the seq axis
+            S = (S // seq_par + 1) * seq_par
         B = _bucket(len(ids), lo=max(1, min_b))
+        if B % min_b:  # non-pow2 data axis
+            B = (B // min_b + 1) * min_b
         pad_id = self.tokenizer.pad_token_id or 0
         tokens = np.full((B, S), pad_id, np.int32)
         mask = np.zeros((B, S), bool)

@@ -138,14 +138,20 @@ _BAICHUAN_MAP[r'model\.layers\.(\d+)\.self_attn\.W_pack\.weight'] = (
     ('layers', '_wpack', 'w'), True)
 
 # Falcon: fused query_key_value with MQA layout [n_head*hd q | hd k | hd v].
+# falcon-7b names its single shared pre-norm 'input_layernorm';
+# falcon-40b/180b use separate 'ln_attn' / 'ln_mlp' (both parallel-residual).
 _FALCON_MAP = {
     r'transformer\.word_embeddings\.weight': (('embed',), False),
     r'transformer\.ln_f\.weight': (('final_norm', 'scale'), False),
     r'transformer\.ln_f\.bias': (('final_norm', 'bias'), False),
-    r'transformer\.h\.(\d+)\.input_layernorm\.weight':
+    r'transformer\.h\.(\d+)\.(?:input_layernorm|ln_attn)\.weight':
         (('layers', 'attn_norm', 'scale'), False),
-    r'transformer\.h\.(\d+)\.input_layernorm\.bias':
+    r'transformer\.h\.(\d+)\.(?:input_layernorm|ln_attn)\.bias':
         (('layers', 'attn_norm', 'bias'), False),
+    r'transformer\.h\.(\d+)\.ln_mlp\.weight':
+        (('layers', 'mlp_norm', 'scale'), False),
+    r'transformer\.h\.(\d+)\.ln_mlp\.bias':
+        (('layers', 'mlp_norm', 'bias'), False),
     r'transformer\.h\.(\d+)\.self_attention\.query_key_value\.weight':
         (('layers', '_qkv_mqa', 'w'), True),
     r'transformer\.h\.(\d+)\.self_attention\.dense\.weight':

@@ -211,7 +211,8 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
     attn = _shard(attn, P('data', None, None))
 
     if cfg.parallel_residual:
-        h2 = h  # falcon: single pre-norm feeds both attn and mlp
+        # falcon-7b: one shared pre-norm; falcon-40b/180b: separate ln_mlp
+        h2 = _norm(x, lp['mlp_norm'], cfg) if 'mlp_norm' in lp else h
     else:
         x = x + attn
         h2 = _norm(x, lp['mlp_norm'], cfg)

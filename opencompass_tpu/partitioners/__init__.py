@@ -1,0 +1,5 @@
+from .base import BasePartitioner  # noqa
+from .naive import NaivePartitioner  # noqa
+from .size import SizePartitioner  # noqa
+
+__all__ = ['BasePartitioner', 'NaivePartitioner', 'SizePartitioner']

@@ -1,0 +1,44 @@
+"""Partitioners: turn (models × datasets) into independent task configs.
+
+This is the primary scale-out axis (SURVEY.md §2.7): tasks are embarrassingly
+parallel and communicate only through output files.  Parity: reference
+partitioners/base.py:10-83.
+"""
+from __future__ import annotations
+
+import copy
+from abc import abstractmethod
+from typing import Dict, List
+
+from opencompass_tpu.config import Config, ConfigDict
+from opencompass_tpu.utils.logging import get_logger
+
+
+class BasePartitioner:
+
+    def __init__(self, out_dir: str):
+        self.logger = get_logger()
+        self.out_dir = out_dir
+
+    def __call__(self, cfg: Dict) -> List[Dict]:
+        """cfg has ``models``, ``datasets``, ``work_dir``; returns a list of
+        task configs, each with narrowed ``models`` / ``datasets`` plus the
+        shared ``work_dir``."""
+        cfg = copy.deepcopy(cfg if isinstance(cfg, Config) else Config(cfg))
+        models = cfg['models']
+        datasets = cfg['datasets']
+        work_dir = cfg['work_dir']
+        tasks = self.partition(models, datasets, work_dir, self.out_dir)
+        self.logger.info(f'Partitioned into {len(tasks)} tasks.')
+        for i, task in enumerate(tasks):
+            self.logger.debug(f'Task {i}: {task}')
+        return tasks
+
+    @abstractmethod
+    def partition(self, models: List[ConfigDict], datasets: List[ConfigDict],
+                  work_dir: str, out_dir: str) -> List[Dict]:
+        """Return task configs, each shaped::
+
+            {'models': [model1], 'datasets': [[ds1, ds2]],
+             'work_dir': work_dir}
+        """

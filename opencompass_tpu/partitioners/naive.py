@@ -1,0 +1,31 @@
+"""One task per (model, dataset) pair, skipping pairs whose output already
+exists — the incremental-resume behavior partitions key on (parity:
+reference partitioners/naive.py:13-60).
+"""
+from __future__ import annotations
+
+import os.path as osp
+from typing import Dict, List
+
+from opencompass_tpu.registry import PARTITIONERS
+from opencompass_tpu.utils.abbr import get_infer_output_path
+
+from .base import BasePartitioner
+
+
+@PARTITIONERS.register_module()
+class NaivePartitioner(BasePartitioner):
+
+    def partition(self, models, datasets, work_dir, out_dir) -> List[Dict]:
+        tasks = []
+        for model in models:
+            for dataset in datasets:
+                filename = get_infer_output_path(model, dataset, out_dir)
+                if osp.exists(filename):
+                    continue
+                tasks.append({
+                    'models': [model],
+                    'datasets': [[dataset]],
+                    'work_dir': work_dir,
+                })
+        return tasks

@@ -1,0 +1,154 @@
+"""Cost-aware partitioner: bin-packs datasets into tasks and splits oversized
+datasets by row range.
+
+Cost model (parity: reference partitioners/size.py:16-187): generation-mode
+datasets cost ``gen_task_coef × rows`` (autoregressive decode dominates);
+PPL-mode datasets cost ``num_labels × rows`` (one forward per label — see
+SURVEY.md §3.3).  Oversized datasets are split by rewriting
+``reader_cfg.test_range`` to ``"[i:j]"`` slices; shard outputs get ``_k``
+filename suffixes which the eval task stitches back together.  Dataset row
+counts are cached in ``.cache/dataset_size.json`` because counting requires
+loading the dataset.
+"""
+from __future__ import annotations
+
+import copy
+import json
+import math
+import os
+import os.path as osp
+from typing import Dict, List, Union
+
+from opencompass_tpu.registry import PARTITIONERS
+from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,
+                                        get_infer_output_path)
+from opencompass_tpu.utils.build import build_dataset_from_cfg
+
+from .base import BasePartitioner
+
+
+@PARTITIONERS.register_module()
+class SizePartitioner(BasePartitioner):
+    """Args:
+        out_dir: prediction output root (existence check for resume).
+        max_task_size: cost budget per task.
+        gen_task_coef: cost multiplier for generation-mode datasets.
+        dataset_size_path: row-count cache file.
+    """
+
+    def __init__(self,
+                 out_dir: str,
+                 max_task_size: int = 2000,
+                 gen_task_coef: int = 20,
+                 dataset_size_path: str = '.cache/dataset_size.json'):
+        super().__init__(out_dir)
+        self.max_task_size = max_task_size
+        self.gen_task_coef = gen_task_coef
+        self.dataset_size_path = dataset_size_path
+        self._size_cache: Dict[str, int] = {}
+
+    def partition(self, models, datasets, work_dir, out_dir) -> List[Dict]:
+        datasets = sorted(datasets, key=lambda x: self.get_cost(x),
+                          reverse=True)
+        tasks = []
+        for model in models:
+            chunks = []  # (cost, dataset(s)) pending bin-packing
+            for dataset in datasets:
+                filename = get_infer_output_path(model, dataset, out_dir)
+                if osp.exists(filename):
+                    continue
+                dataset_size = self.get_cost(dataset)
+                if dataset_size > self.max_task_size:
+                    root, ext = osp.splitext(filename)
+                    dataset_splits = self.split_dataset(dataset)
+                    for i, dataset_split in enumerate(dataset_splits):
+                        if not osp.exists(f'{root}_{i}{ext}'):
+                            chunks.append((self.max_task_size,
+                                           dataset_split))
+                else:
+                    chunks.append((dataset_size, dataset))
+
+            # first-fit-decreasing bin packing
+            chunks.sort(key=lambda x: x[0], reverse=True)
+            bins: List[List] = []
+            bin_sizes: List[int] = []
+            for cost, dataset in chunks:
+                for i, size in enumerate(bin_sizes):
+                    if size + cost <= self.max_task_size:
+                        bins[i].append(dataset)
+                        bin_sizes[i] += cost
+                        break
+                else:
+                    bins.append([dataset])
+                    bin_sizes.append(cost)
+            for bin_datasets in bins:
+                tasks.append({
+                    'models': [model],
+                    'datasets': [bin_datasets],
+                    'work_dir': work_dir,
+                })
+        return tasks
+
+    def split_dataset(self, dataset_cfg: Dict) -> List[Dict]:
+        """Split by rewriting reader_cfg.test_range into row slices whose
+        per-split cost ≈ max_task_size."""
+        dataset_size = self.get_size(dataset_cfg)
+        split_size = max(
+            1, self.max_task_size //
+            max(1, self.get_factor(dataset_cfg)))
+        num_splits = math.ceil(dataset_size / split_size)
+        splits = []
+        abbr = dataset_abbr_from_cfg(dataset_cfg)
+        for i in range(num_splits):
+            cfg = copy.deepcopy(dataset_cfg)
+            cfg['abbr'] = f'{abbr}_{i}'
+            cfg.setdefault('reader_cfg', {})
+            cfg['reader_cfg']['test_range'] = \
+                f'[{i * split_size}:{(i + 1) * split_size}]'
+            splits.append(cfg)
+        return splits
+
+    def get_factor(self, dataset_cfg: Dict) -> int:
+        """Per-row cost factor: #labels for PPL templates, gen_task_coef for
+        generation templates."""
+        infer_cfg = dataset_cfg.get('infer_cfg', {})
+        template = (infer_cfg.get('prompt_template', {}).get('template')
+                    or infer_cfg.get('ice_template', {}).get('template'))
+        inferencer = str(infer_cfg.get('inferencer', {}).get('type', ''))
+        if isinstance(template, dict) and 'PPL' in inferencer:
+            return len(template)
+        return self.gen_task_coef
+
+    def get_cost(self, dataset_cfg: Dict) -> int:
+        return self.get_size(dataset_cfg) * self.get_factor(dataset_cfg)
+
+    def get_size(self, dataset_cfg: Dict) -> int:
+        # cache key + measurement are whole-dataset: strip test_range (and
+        # the `_i` abbr suffix a split carries) before counting, then apply
+        # the slice arithmetic host-side
+        base_cfg = copy.deepcopy(dataset_cfg)
+        test_range = base_cfg.get('reader_cfg', {}).pop('test_range', '')
+        abbr = dataset_abbr_from_cfg(base_cfg)
+
+        if not self._size_cache and osp.exists(self.dataset_size_path):
+            with open(self.dataset_size_path) as f:
+                self._size_cache = json.load(f)
+        if abbr not in self._size_cache:
+            dataset = build_dataset_from_cfg(base_cfg)
+            self._size_cache[abbr] = len(dataset.test)
+            os.makedirs(osp.dirname(self.dataset_size_path) or '.',
+                        exist_ok=True)
+            with open(self.dataset_size_path, 'w') as f:
+                json.dump(self._size_cache, f, indent=2)
+        size = self._size_cache[abbr]
+        if test_range:
+            size = len(range(size)[_parse_slice(test_range)])
+        return size
+
+
+def _parse_slice(expr: str) -> slice:
+    """``"[a:b]"`` → slice(a, b) without eval."""
+    body = expr.strip()[1:-1]
+    parts = body.split(':')
+    vals = [int(p) if p.strip() else None for p in parts]
+    return slice(*vals)

@@ -1,0 +1,5 @@
+from .base import BaseRunner  # noqa
+from .local import LocalRunner  # noqa
+from .slurm import SlurmRunner  # noqa
+
+__all__ = ['BaseRunner', 'LocalRunner', 'SlurmRunner']

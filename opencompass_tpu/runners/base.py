@@ -1,0 +1,63 @@
+"""Runners: launch a list of task configs and collect (name, returncode).
+
+Parity: reference runners/base.py:10-83.  A runner owns a task *type*
+(OpenICLInferTask / OpenICLEvalTask); each task config is dumped to a temp
+Python file and handed to a fresh process (the filesystem is the only
+cross-process protocol — SURVEY.md §2.7).
+"""
+from __future__ import annotations
+
+from abc import abstractmethod
+from typing import Any, Dict, List, Tuple
+
+from opencompass_tpu.config import ConfigDict
+from opencompass_tpu.registry import TASKS
+from opencompass_tpu.utils.logging import get_logger
+from opencompass_tpu.utils.notify import LarkReporter
+
+
+class BaseRunner:
+    """Args:
+        task: task type config, e.g. ``dict(type='OpenICLInferTask')``.
+        debug: run tasks serially in-process (no subprocess, live output).
+        lark_bot_url: optional webhook for run reports.
+    """
+
+    def __init__(self,
+                 task: Dict,
+                 debug: bool = False,
+                 lark_bot_url: str = None):
+        self.task_cfg = ConfigDict(task)
+        self.debug = debug
+        self.logger = get_logger()
+        self.reporter = LarkReporter(lark_bot_url) if lark_bot_url else None
+
+    def __call__(self, tasks: List[Dict]):
+        status = self.launch(tasks)
+        self.summarize(status)
+        return status
+
+    @abstractmethod
+    def launch(self, tasks: List[Dict]) -> List[Tuple[str, int]]:
+        """Launch all tasks; return (task_name, returncode) pairs."""
+
+    def build_task(self, task_cfg: Dict) -> Any:
+        type_cfg = dict(self.task_cfg)
+        cls = type_cfg.pop('type')
+        if isinstance(cls, str):
+            resolved = TASKS.get(cls)
+            if resolved is None:
+                raise KeyError(f'{cls} is not a registered task type')
+            cls = resolved
+        return cls(task_cfg, **type_cfg)
+
+    def summarize(self, status: List[Tuple[str, int]]):
+        failed = [name for name, code in status if code != 0]
+        for name in failed:
+            self.logger.error(f'{name} failed with code '
+                              f'{dict(status)[name]}')
+        if self.reporter:
+            total = len(status)
+            self.reporter.post(
+                f'{total - len(failed)}/{total} tasks succeeded'
+                + (f'; failed: {failed[:5]}' if failed else ''))

@@ -1,0 +1,142 @@
+"""Local runner: thread pool + TPU-chip slot allocator.
+
+Rework of the reference's GPU allocator (reference runners/local.py:21-144):
+slots are TPU chips instead of CUDA devices, and the launched command is
+always plain ``python`` — in-task multi-chip parallelism happens through the
+model's mesh, not ``torchrun`` (SURVEY.md §2.7).  Tasks declaring
+``run_cfg.num_devices == 0`` (eval tasks, API models, FakeModel) are forced
+onto CPU (``JAX_PLATFORMS=cpu``) so they never contend for the chip lock —
+a TPU chip is exclusive to one process, unlike CUDA's shared contexts.
+"""
+from __future__ import annotations
+
+import os
+import os.path as osp
+import subprocess
+import tempfile
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Tuple
+
+from opencompass_tpu.registry import RUNNERS
+from opencompass_tpu.utils.abbr import task_abbr_from_cfg
+
+from .base import BaseRunner
+
+
+@RUNNERS.register_module()
+class LocalRunner(BaseRunner):
+    """Args:
+        task: task type config.
+        max_num_workers: thread-pool width.
+        num_devices: accelerator chips this host offers (None = autodetect
+            from TPU_VISIBLE_CHIPS/JAX env, default 1).
+        keep_tmp_file: keep the dumped per-task config files for debugging.
+    """
+
+    def __init__(self,
+                 task: Dict,
+                 max_num_workers: int = 16,
+                 num_devices: int = None,
+                 debug: bool = False,
+                 lark_bot_url: str = None,
+                 keep_tmp_file: bool = False):
+        super().__init__(task=task, debug=debug, lark_bot_url=lark_bot_url)
+        self.max_num_workers = max_num_workers
+        if num_devices is None:
+            visible = os.environ.get('TPU_VISIBLE_CHIPS', '')
+            num_devices = len(visible.split(',')) if visible else 1
+        self.num_devices = num_devices
+        self.keep_tmp_file = keep_tmp_file
+        self._slot_lock = threading.Lock()
+        self._slots = [False] * self.num_devices  # True = in use
+
+    def launch(self, tasks: List[Dict]) -> List[Tuple[str, int]]:
+        if self.debug:
+            status = []
+            for task_cfg in tasks:
+                task = self.build_task(task_cfg)
+                self.logger.info(f'Running {task.name} in-process (debug)')
+                task.run()
+                status.append((task.name, 0))
+            return status
+
+        with ThreadPoolExecutor(max_workers=self.max_num_workers) as pool:
+            return list(pool.map(self._launch, tasks))
+
+    # -- slot allocator ----------------------------------------------------
+
+    def _acquire_slots(self, n: int) -> List[int]:
+        if n == 0:
+            return []
+        assert n <= self.num_devices, (
+            f'task wants {n} devices, host offers {self.num_devices}')
+        while True:
+            with self._slot_lock:
+                free = [i for i, used in enumerate(self._slots) if not used]
+                if len(free) >= n:
+                    ids = free[:n]
+                    for i in ids:
+                        self._slots[i] = True
+                    return ids
+            time.sleep(1)
+
+    def _release_slots(self, ids: List[int]):
+        with self._slot_lock:
+            for i in ids:
+                self._slots[i] = False
+
+    # -- per-task launch ---------------------------------------------------
+
+    def _launch(self, task_cfg: Dict) -> Tuple[str, int]:
+        task = self.build_task(task_cfg)
+        name = task.name
+        chip_ids = self._acquire_slots(task.num_devices)
+        try:
+            tmp = tempfile.NamedTemporaryFile(
+                mode='w', suffix='_params.py', delete=False)
+            try:
+                task.cfg.dump(tmp.name)
+                cmd = task.get_command(cfg_path=tmp.name,
+                                       template='{task_cmd}')
+                env = dict(os.environ)
+                # make the package importable from any cwd
+                import opencompass_tpu
+                pkg_root = osp.dirname(osp.dirname(opencompass_tpu.__file__))
+                env['PYTHONPATH'] = pkg_root + (
+                    ':' + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+                if task.num_devices > 0:
+                    env['TPU_VISIBLE_CHIPS'] = ','.join(map(str, chip_ids))
+                else:
+                    # CPU-only task: never contend for the exclusive chip
+                    env['JAX_PLATFORMS'] = 'cpu'
+                    env.pop('PALLAS_AXON_POOL_IPS', None)
+                log_path = task.get_log_path('out')
+                os.makedirs(osp.dirname(log_path), exist_ok=True)
+                self.logger.info(f'launch {name} (devices={chip_ids})')
+                with open(log_path, 'w') as log_file:
+                    result = subprocess.run(cmd, shell=True, text=True,
+                                            stdout=log_file,
+                                            stderr=subprocess.STDOUT,
+                                            env=env)
+                returncode = result.returncode
+                missing = [p for p in task.get_output_paths()
+                           if not osp.exists(p)]
+                if returncode == 0 and missing:
+                    self.logger.warning(
+                        f'{name}: exit 0 but outputs missing: '
+                        f'{missing[:3]}')
+                    returncode = 1
+                if returncode != 0:
+                    self.logger.warning(
+                        f'task {name} failed with code {returncode}; '
+                        f'see {log_path}')
+            finally:
+                if self.keep_tmp_file:
+                    self.logger.info(f'task cfg kept at {tmp.name}')
+                else:
+                    os.unlink(tmp.name)
+        finally:
+            self._release_slots(chip_ids)
+        return name, returncode

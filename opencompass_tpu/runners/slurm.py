@@ -1,0 +1,118 @@
+"""Slurm runner: submit each task as an ``srun`` allocation with retry.
+
+Parity: reference runners/slurm.py:19-148, with GPU gres swapped for
+whatever the cluster exposes TPU-side (``--gres`` string is configurable
+because TPU clusters name resources differently than ``gpu:N``).  Retries
+while exit ≠ 0 *or* any expected output file is missing, with 0-10 s submit
+jitter against thundering-herd scheduling.
+"""
+from __future__ import annotations
+
+import os
+import os.path as osp
+import random
+import subprocess
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Tuple
+
+from opencompass_tpu.registry import RUNNERS
+
+from .base import BaseRunner
+
+
+@RUNNERS.register_module()
+class SlurmRunner(BaseRunner):
+    """Args:
+        task: task type config.
+        max_num_workers: concurrent srun submissions.
+        retry: re-submission attempts per task.
+        partition / quotatype / qos: cluster knobs.
+        gres_template: resource request format, ``{n}`` = device count
+            (default ``tpu:{n}``; use ``gpu:{n}`` on GPU clusters).
+    """
+
+    def __init__(self,
+                 task: Dict,
+                 max_num_workers: int = 32,
+                 retry: int = 2,
+                 partition: str = None,
+                 quotatype: str = None,
+                 qos: str = None,
+                 gres_template: str = 'tpu:{n}',
+                 debug: bool = False,
+                 lark_bot_url: str = None):
+        super().__init__(task=task, debug=debug, lark_bot_url=lark_bot_url)
+        self.max_num_workers = max_num_workers
+        self.retry = retry
+        self.partition = partition
+        self.quotatype = quotatype
+        self.qos = qos
+        self.gres_template = gres_template
+
+    def launch(self, tasks: List[Dict]) -> List[Tuple[str, int]]:
+        if self.debug:
+            status = []
+            for task_cfg in tasks:
+                task = self.build_task(task_cfg)
+                task.run()
+                status.append((task.name, 0))
+            return status
+        with ThreadPoolExecutor(max_workers=self.max_num_workers) as pool:
+            return list(pool.map(self._launch, tasks))
+
+    def _srun_prefix(self, task) -> str:
+        parts = ['srun']
+        if self.partition:
+            parts.append(f'-p {self.partition}')
+        if self.quotatype:
+            parts.append(f'--quotatype={self.quotatype}')
+        if self.qos:
+            parts.append(f'--qos={self.qos}')
+        if task.num_devices > 0:
+            parts.append(
+                f'--gres={self.gres_template.format(n=task.num_devices)}')
+        safe_name = task.name[:60].replace('[', '_').replace(']', '_')
+        parts.append(f'-N1 -J {safe_name!r}')
+        return ' '.join(parts)
+
+    def _launch(self, task_cfg: Dict) -> Tuple[str, int]:
+        task = self.build_task(task_cfg)
+        name = task.name
+        # jitter submissions to avoid thundering herd on the scheduler
+        time.sleep(random.uniform(0, 10))
+        tmp = tempfile.NamedTemporaryFile(
+            mode='w', suffix='_params.py', delete=False)
+        try:
+            task.cfg.dump(tmp.name)
+            template = self._srun_prefix(task) + ' {task_cmd}'
+            cmd = task.get_command(cfg_path=tmp.name, template=template)
+            import opencompass_tpu
+            pkg_root = osp.dirname(osp.dirname(opencompass_tpu.__file__))
+            cmd = f'PYTHONPATH={pkg_root}:$PYTHONPATH {cmd}'
+            log_path = task.get_log_path('out')
+            os.makedirs(osp.dirname(log_path), exist_ok=True)
+            returncode = 1
+            for attempt in range(self.retry + 1):
+                with open(log_path, 'w') as log_file:
+                    result = subprocess.run(cmd, shell=True, text=True,
+                                            stdout=log_file,
+                                            stderr=subprocess.STDOUT)
+                returncode = result.returncode
+                if not self._job_failed(returncode, task):
+                    returncode = 0
+                    break
+                self.logger.warning(
+                    f'{name} attempt {attempt + 1} failed '
+                    f'(code {returncode}); retrying')
+            if self._job_failed(returncode, task):
+                returncode = returncode or 1
+        finally:
+            os.unlink(tmp.name)
+        return name, returncode
+
+    @staticmethod
+    def _job_failed(returncode: int, task) -> bool:
+        return returncode != 0 or any(
+            not osp.exists(p) for p in task.get_output_paths())

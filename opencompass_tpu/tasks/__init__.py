@@ -1,0 +1,5 @@
+from .base import BaseTask  # noqa
+from .openicl_infer import OpenICLInferTask  # noqa
+from .openicl_eval import OpenICLEvalTask  # noqa
+
+__all__ = ['BaseTask', 'OpenICLInferTask', 'OpenICLEvalTask']

@@ -1,0 +1,34 @@
+"""Task launcher: ``python -m opencompass_tpu.tasks <TaskType> <cfg.py>``.
+
+A single entry point avoids the runpy double-import trap (running a task
+module directly via ``-m`` would execute it twice: once as a package import,
+once as ``__main__``, re-registering its class).
+"""
+import argparse
+import time
+
+from opencompass_tpu.config import Config
+from opencompass_tpu.registry import TASKS
+from opencompass_tpu.utils.logging import get_logger
+
+
+def main():
+    parser = argparse.ArgumentParser(description='Run a task standalone')
+    parser.add_argument('task_type', help='registered task class name')
+    parser.add_argument('config', help='task config file path')
+    args = parser.parse_args()
+
+    logger = get_logger()
+    cls = TASKS.get(args.task_type)
+    if cls is None:
+        raise SystemExit(f'unknown task type {args.task_type!r}')
+    cfg = Config.fromfile(args.config)
+    task = cls(cfg)
+    logger.info(f'Task {task.name}')
+    start = time.time()
+    task.run()
+    logger.info(f'time elapsed: {time.time() - start:.2f}s')
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,84 @@
+"""Task layer: a task is an independently-runnable unit of (models × datasets)
+work, re-invokable as a standalone script — the process boundary that makes
+runners trivial (SURVEY.md §2.1; parity: reference tasks/base.py:10-87).
+
+Output-file existence is the completion criterion runners/partitioners key
+on (reference abbr.py:38-46 protocol).
+"""
+from __future__ import annotations
+
+import os.path as osp
+from typing import Dict, List
+
+from opencompass_tpu.config import Config
+from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,
+                                        get_infer_output_path,
+                                        model_abbr_from_cfg,
+                                        task_abbr_from_cfg)
+
+
+class BaseTask:
+    """Base class for tasks.
+
+    Args:
+        cfg: the task config — a full run config narrowed to this task's
+            ``models`` (list) and ``datasets`` (list-of-lists, one inner list
+            per model).
+    """
+
+    name_prefix: str = ''
+    log_subdir: str = ''
+    output_subdir: str = ''
+
+    def __init__(self, cfg: Dict):
+        cfg = Config(cfg) if not isinstance(cfg, Config) else cfg
+        self.cfg = cfg
+        self.model_cfgs = cfg['models']
+        self.dataset_cfgs = cfg['datasets']
+        self.work_dir = cfg.get('work_dir', './outputs/default')
+        run_cfgs = [m.get('run_cfg', {}) for m in self.model_cfgs]
+        self.num_devices = max(
+            (rc.get('num_devices', rc.get('num_gpus', 0))
+             for rc in run_cfgs), default=0)
+        self.num_procs = max(
+            (rc.get('num_procs', 1) for rc in run_cfgs), default=1)
+
+    @property
+    def name(self) -> str:
+        return self.name_prefix + task_abbr_from_cfg(
+            {'models': self.model_cfgs, 'datasets': self.dataset_cfgs})
+
+    def __repr__(self):
+        return f'{type(self).__name__}({self.name})'
+
+    def get_log_path(self, file_extension: str = 'out') -> str:
+        """Log path keyed to the task's first model/dataset pair."""
+        return osp.join(
+            self.work_dir, self.log_subdir,
+            model_abbr_from_cfg(self.model_cfgs[0]),
+            f'{dataset_abbr_from_cfg(self.dataset_cfgs[0][0])}.'
+            f'{file_extension}')
+
+    def get_output_paths(self, file_extension: str = 'json') -> List[str]:
+        """Every output file this task is expected to produce; their
+        existence is how runners decide success/skip."""
+        paths = []
+        for i, model in enumerate(self.model_cfgs):
+            for dataset in self.dataset_cfgs[i]:
+                paths.append(
+                    get_infer_output_path(
+                        model, dataset,
+                        osp.join(self.work_dir, self.output_subdir),
+                        file_extension))
+        return paths
+
+    def get_command(self, cfg_path: str, template: str) -> str:
+        """Shell command to run this task out-of-process.
+
+        ``template`` contains ``{task_cmd}``, e.g. ``"{task_cmd}"`` or a
+        wrapper like ``srun ... {task_cmd}``.
+        """
+        raise NotImplementedError
+
+    def run(self):
+        raise NotImplementedError

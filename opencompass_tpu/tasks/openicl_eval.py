@@ -1,0 +1,160 @@
+"""Evaluation task: load predictions, postprocess, score vs references.
+
+CPU-only (``num_devices = 0``) — scoring never touches the accelerator.
+Handles partial prediction shards ``_0.json, _1.json, ...`` produced by
+size-partitioned infer tasks.  Runnable standalone, same as the infer task.
+Parity: reference tasks/openicl_eval.py:17-178.
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+from typing import Dict, List, Optional
+
+from opencompass_tpu.registry import (ICL_EVALUATORS, TASKS,
+                                      TEXT_POSTPROCESSORS)
+from opencompass_tpu.utils.abbr import get_infer_output_path
+from opencompass_tpu.utils.build import build_dataset_from_cfg
+from opencompass_tpu.utils.logging import get_logger
+
+from .base import BaseTask
+
+logger = get_logger()
+
+
+def _postprocessor_from_cfg(cfg: Dict):
+    """(callable, kwargs) from ``dict(type='name'|callable, **kwargs)``."""
+    cfg = dict(cfg)
+    proc = cfg.pop('type')
+    if isinstance(proc, str):
+        resolved = TEXT_POSTPROCESSORS.get(proc)
+        if resolved is None:
+            raise KeyError(f'unknown text postprocessor {proc!r}')
+        proc = resolved
+    return proc, cfg
+
+
+def extract_role_pred(s: str, begin_str: Optional[str],
+                      end_str: Optional[str]) -> str:
+    """Extract the model's own turn from a raw completion: text after the
+    first ``begin_str`` and before the next ``end_str`` (parity: reference
+    openicl_eval.py:133-161)."""
+    start = 0
+    end = len(s)
+    if begin_str:
+        begin_idx = s.find(begin_str)
+        if begin_idx != -1:
+            start = begin_idx + len(begin_str)
+    if end_str:
+        end_idx = s.find(end_str, start)
+        if end_idx != -1:
+            end = end_idx
+    return s[start:end]
+
+
+@TASKS.register_module()
+class OpenICLEvalTask(BaseTask):
+
+    name_prefix = 'OpenICLEval'
+    log_subdir = 'logs/eval'
+    output_subdir = 'results'
+
+    def __init__(self, cfg):
+        super().__init__(cfg)
+        self.num_devices = 0
+
+    def get_command(self, cfg_path: str,
+                    template: str = '{task_cmd}') -> str:
+        task_cmd = ('python -m opencompass_tpu.tasks OpenICLEvalTask '
+                    f'{cfg_path}')
+        return template.format(task_cmd=task_cmd)
+
+    def run(self):
+        for i, model_cfg in enumerate(self.model_cfgs):
+            for dataset_cfg in self.dataset_cfgs[i]:
+                self.model_cfg = model_cfg
+                self.dataset_cfg = dataset_cfg
+                self.eval_cfg = dataset_cfg.get('eval_cfg', {})
+                self.output_column = dataset_cfg['reader_cfg'][
+                    'output_column']
+                out_path = get_infer_output_path(
+                    model_cfg, dataset_cfg,
+                    osp.join(self.work_dir, 'results'))
+                if osp.exists(out_path):
+                    continue
+                self._score(out_path)
+
+    def _load_predictions(self) -> Optional[List[Dict]]:
+        """Prediction records in index order, stitching `_k` shards."""
+        filename = get_infer_output_path(
+            self.model_cfg, self.dataset_cfg,
+            osp.join(self.work_dir, 'predictions'))
+        if osp.exists(filename):
+            with open(filename) as f:
+                preds = json.load(f)
+            return [preds[str(i)] for i in range(len(preds))]
+        # partial shards from a size-partitioned run
+        root, ext = osp.splitext(filename)
+        records = []
+        i = 0
+        while osp.exists(f'{root}_{i}{ext}'):
+            with open(f'{root}_{i}{ext}') as f:
+                sub = json.load(f)
+            records.extend(sub[str(k)] for k in range(len(sub)))
+            i += 1
+        return records or None
+
+    def _score(self, out_path: str):
+        records = self._load_predictions()
+        if not records:
+            logger.error(f'No predictions found for {self.dataset_cfg} — '
+                         'did the infer task run?')
+            return
+        pred_strs = [rec.get('prediction') for rec in records]
+
+        if self.eval_cfg.get('pred_role') and 'meta_template' in \
+                self.model_cfg:
+            role_cfg = None
+            meta = self.model_cfg['meta_template']
+            for item in meta.get('round', []):
+                if isinstance(item, dict) \
+                        and item.get('role') == self.eval_cfg['pred_role']:
+                    role_cfg = item
+            if role_cfg is not None:
+                pred_strs = [
+                    extract_role_pred(str(s), role_cfg.get('begin'),
+                                      role_cfg.get('end'))
+                    for s in pred_strs
+                ]
+
+        if 'pred_postprocessor' in self.eval_cfg:
+            proc, kwargs = _postprocessor_from_cfg(
+                self.eval_cfg['pred_postprocessor'])
+            pred_strs = [proc(str(s), **kwargs) for s in pred_strs]
+
+        dataset = build_dataset_from_cfg(self.dataset_cfg)
+        references = dataset.test[self.output_column] \
+            if self.output_column else None
+        # size-split tasks carry a test_range slice in reader_cfg, which
+        # build_dataset_from_cfg already applied; references align 1:1
+        if 'dataset_postprocessor' in self.eval_cfg and references:
+            proc, kwargs = _postprocessor_from_cfg(
+                self.eval_cfg['dataset_postprocessor'])
+            references = [proc(str(r), **kwargs) for r in references]
+
+        evaluator_cfg = dict(self.eval_cfg.get(
+            'evaluator', {'type': 'AccEvaluator'}))
+        evaluator = ICL_EVALUATORS.build(evaluator_cfg)
+        result = evaluator.score(predictions=pred_strs,
+                                 references=references)
+
+        if 'error' in result:
+            logger.error(
+                f'Task {self.name}: {result["error"]}')
+            return
+        logger.info(f'Task {self.name}: {result}')
+
+        os.makedirs(osp.dirname(out_path), exist_ok=True)
+        with open(out_path, 'w') as f:
+            json.dump(result, f, ensure_ascii=False, indent=4)

@@ -1,0 +1,95 @@
+"""Inference task: for each (model, dataset) pair, run the ICL pipeline
+(retriever → templates → inferencer) and write predictions JSON.
+
+Runnable standalone (``python -m opencompass_tpu.tasks OpenICLInferTask
+cfg.py``) — the runner re-invokes it across the process boundary
+(parity: reference tasks/openicl_infer.py:17-129).  TPU difference: no
+``torchrun`` wrapper — multi-device execution happens *inside* the process
+via the model's mesh (pjit shardings), so the command is always plain
+``python`` and the runner instead pins visible devices via env.
+"""
+from __future__ import annotations
+
+import os.path as osp
+from typing import Any, Dict
+
+from opencompass_tpu.registry import (ICL_INFERENCERS, ICL_PROMPT_TEMPLATES,
+                                      ICL_RETRIEVERS, TASKS)
+from opencompass_tpu.utils.abbr import get_infer_output_path
+from opencompass_tpu.utils.build import (build_dataset_from_cfg,
+                                         build_model_from_cfg)
+from opencompass_tpu.utils.logging import get_logger
+
+from .base import BaseTask
+
+logger = get_logger()
+
+
+@TASKS.register_module()
+class OpenICLInferTask(BaseTask):
+
+    name_prefix = 'OpenICLInfer'
+    log_subdir = 'logs/infer'
+    output_subdir = 'predictions'
+
+    def get_command(self, cfg_path: str,
+                    template: str = '{task_cmd}') -> str:
+        task_cmd = ('python -m opencompass_tpu.tasks OpenICLInferTask '
+                    f'{cfg_path}')
+        return template.format(task_cmd=task_cmd)
+
+    def run(self):
+        for i, model_cfg in enumerate(self.model_cfgs):
+            self.max_out_len = model_cfg.get('max_out_len')
+            self.batch_size = model_cfg.get('batch_size', 1)
+            self.max_seq_len = model_cfg.get('max_seq_len')
+            model = build_model_from_cfg(model_cfg)
+
+            for dataset_cfg in self.dataset_cfgs[i]:
+                self.model_cfg = model_cfg
+                self.dataset_cfg = dataset_cfg
+                self.infer_cfg = dataset_cfg['infer_cfg']
+                out_path = get_infer_output_path(
+                    model_cfg, dataset_cfg,
+                    osp.join(self.work_dir, 'predictions'))
+                if osp.exists(out_path):
+                    continue
+                self._inference(model, out_path)
+
+    def _inference(self, model, out_path: str):
+        assert 'ice_template' in self.infer_cfg \
+            or 'prompt_template' in self.infer_cfg, \
+            'Both ice_template and prompt_template cannot be None ' \
+            'simultaneously.'
+        ice_template = None
+        if 'ice_template' in self.infer_cfg:
+            ice_template = ICL_PROMPT_TEMPLATES.build(
+                self.infer_cfg['ice_template'])
+        prompt_template = None
+        if 'prompt_template' in self.infer_cfg:
+            prompt_template = ICL_PROMPT_TEMPLATES.build(
+                self.infer_cfg['prompt_template'])
+
+        dataset = build_dataset_from_cfg(self.dataset_cfg)
+        retriever_cfg = dict(self.infer_cfg['retriever'])
+        retriever_cfg['dataset'] = dataset
+        retriever = ICL_RETRIEVERS.build(retriever_cfg)
+
+        inferencer_cfg = dict(self.infer_cfg['inferencer'])
+        inferencer_cfg['model'] = model
+        self._set_default(inferencer_cfg, 'max_out_len', self.max_out_len)
+        self._set_default(inferencer_cfg, 'max_seq_len', self.max_seq_len)
+        inferencer_cfg.setdefault('batch_size', self.batch_size)
+        inferencer = ICL_INFERENCERS.build(inferencer_cfg)
+
+        out_dir, out_file = osp.split(out_path)
+        inferencer.inference(retriever,
+                             ice_template=ice_template,
+                             prompt_template=prompt_template,
+                             output_json_filepath=out_dir,
+                             output_json_filename=out_file)
+
+    @staticmethod
+    def _set_default(cfg: Dict[str, Any], key: str, value):
+        if value is not None and key not in cfg:
+            cfg[key] = value

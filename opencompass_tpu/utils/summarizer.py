@@ -1,0 +1,161 @@
+"""Results aggregation → summary tables (txt + csv).
+
+Loads every ``results/{model}/{dataset}.json``, picks each dataset's primary
+metric, computes summary-group averages (naive or weighted), and renders a
+model × dataset table.  Parity: reference utils/summarizer.py:19-233 (minus
+the external tabulate dep — plain fixed-width rendering).
+"""
+from __future__ import annotations
+
+import csv
+import json
+import os
+import os.path as osp
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,
+                                        model_abbr_from_cfg)
+from opencompass_tpu.utils.logging import get_logger
+
+METRIC_WHITELIST = ['score', 'auc_score', 'accuracy', 'humaneval_pass@1',
+                    'rouge1', 'avg_toxicity_score', 'bleurt_diff', 'matthews_correlation']
+METRIC_BLACKLIST = ['bp', 'sys_len', 'ref_len']
+
+
+class Summarizer:
+
+    def __init__(self, config, dataset_abbrs: Optional[List[str]] = None,
+                 summary_groups: Optional[List[Dict]] = None):
+        self.cfg = config
+        self.logger = get_logger()
+        summarizer_cfg = config.get('summarizer', {}) or {}
+        self.dataset_abbrs = dataset_abbrs \
+            or summarizer_cfg.get('dataset_abbrs')
+        self.summary_groups = summary_groups \
+            or summarizer_cfg.get('summary_groups', [])
+
+    # -- load --------------------------------------------------------------
+
+    def _load_results(self):
+        """raw[model_abbr][dataset_abbr] = metric dict"""
+        work_dir = self.cfg['work_dir']
+        raw = defaultdict(dict)
+        modes = {}
+        for model in self.cfg.get('models', []):
+            m_abbr = model_abbr_from_cfg(model)
+            for dataset in self.cfg.get('datasets', []):
+                d_abbr = dataset_abbr_from_cfg(dataset)
+                path = osp.join(work_dir, 'results', m_abbr,
+                                f'{d_abbr}.json')
+                if not osp.exists(path):
+                    continue
+                with open(path) as f:
+                    result = json.load(f)
+                result.pop('details', None)
+                raw[m_abbr][d_abbr] = result
+                inferencer = str(dataset.get('infer_cfg', {})
+                                 .get('inferencer', {}).get('type', ''))
+                modes[d_abbr] = ('ppl' if 'PPL' in inferencer else
+                                 'clp' if 'CLP' in inferencer else 'gen')
+        return raw, modes
+
+    @staticmethod
+    def _primary_metric(result: Dict) -> Optional[str]:
+        for metric in METRIC_WHITELIST:
+            if metric in result:
+                return metric
+        for metric in result:
+            if metric not in METRIC_BLACKLIST \
+                    and isinstance(result[metric], (int, float)):
+                return metric
+        return None
+
+    # -- aggregate ---------------------------------------------------------
+
+    def _apply_groups(self, raw: Dict, modes: Dict):
+        """summary_groups: [{'name': ..., 'subsets': [...], optional
+        'weights': {abbr: w}}] → synthesized per-group average rows."""
+        for group in self.summary_groups:
+            name = group['name']
+            subsets = group['subsets']
+            weights = group.get('weights', {})
+            for m_abbr, results in raw.items():
+                scores, total_w = [], 0.0
+                missing = []
+                for abbr in subsets:
+                    if abbr not in results:
+                        missing.append(abbr)
+                        continue
+                    metric = self._primary_metric(results[abbr])
+                    if metric is None:
+                        missing.append(abbr)
+                        continue
+                    w = weights.get(abbr, 1.0)
+                    scores.append(w * float(results[abbr][metric]))
+                    total_w += w
+                if missing:
+                    results[name] = {
+                        'naive_average':
+                            f'missing {len(missing)} subsets'}
+                    continue
+                if total_w:
+                    key = 'weighted_average' if weights else 'naive_average'
+                    results[name] = {key: sum(scores) / total_w}
+                modes[name] = modes.get(subsets[0], 'gen') \
+                    if subsets else 'gen'
+
+    # -- render ------------------------------------------------------------
+
+    def summarize(self, time_str: str = 'default') -> str:
+        raw, modes = self._load_results()
+        self._apply_groups(raw, modes)
+        model_abbrs = [model_abbr_from_cfg(m)
+                       for m in self.cfg.get('models', [])]
+        if self.dataset_abbrs:
+            dataset_abbrs = list(self.dataset_abbrs)
+        else:
+            seen = []
+            for results in raw.values():
+                for abbr in results:
+                    if abbr not in seen:
+                        seen.append(abbr)
+            dataset_abbrs = seen
+
+        header = ['dataset', 'mode'] + model_abbrs
+        rows = [header]
+        for d_abbr in dataset_abbrs:
+            row = [d_abbr, modes.get(d_abbr, '-')]
+            for m_abbr in model_abbrs:
+                result = raw.get(m_abbr, {}).get(d_abbr)
+                metric = self._primary_metric(result) if result else None
+                if metric is None:
+                    row.append('-')
+                else:
+                    value = result[metric]
+                    row.append(f'{value:.2f}'
+                               if isinstance(value, float) else str(value))
+            rows.append(row)
+
+        widths = [max(len(str(r[i])) for r in rows)
+                  for i in range(len(header))]
+        lines = []
+        for i, row in enumerate(rows):
+            lines.append('  '.join(str(c).ljust(w)
+                                   for c, w in zip(row, widths)))
+            if i == 0:
+                lines.append('  '.join('-' * w for w in widths))
+        table = '\n'.join(lines)
+
+        work_dir = self.cfg['work_dir']
+        out_dir = osp.join(work_dir, 'summary')
+        os.makedirs(out_dir, exist_ok=True)
+        txt_path = osp.join(out_dir, f'summary_{time_str}.txt')
+        with open(txt_path, 'w') as f:
+            f.write(table + '\n')
+        csv_path = osp.join(out_dir, f'summary_{time_str}.csv')
+        with open(csv_path, 'w', newline='') as f:
+            csv.writer(f).writerows(rows)
+        self.logger.info(f'write summary to {osp.abspath(txt_path)}')
+        print(table)
+        return table

@@ -1,0 +1,149 @@
+"""Orchestration: partitioners, runners, tasks, summarizer, run.py CLI."""
+import json
+import os
+import os.path as osp
+import subprocess
+import sys
+
+import pytest
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+def _demo_cfg(work_dir, models=None):
+    from opencompass_tpu.config import Config
+    cfg = Config.fromfile(osp.join(REPO, 'configs/eval_demo.py'))
+    cfg['work_dir'] = str(work_dir)
+    if models is not None:
+        cfg['models'] = models
+    return cfg
+
+
+def test_naive_partitioner_skips_existing(tmp_path):
+    from opencompass_tpu.partitioners import NaivePartitioner
+    cfg = _demo_cfg(tmp_path)
+    out_dir = str(tmp_path / 'predictions')
+    part = NaivePartitioner(out_dir)
+    tasks = part(cfg)
+    assert len(tasks) == 2  # 1 model × 2 datasets
+    # simulate one output existing → one task disappears
+    done = tasks[0]['datasets'][0][0]
+    from opencompass_tpu.utils.abbr import get_infer_output_path
+    path = get_infer_output_path(tasks[0]['models'][0], done, out_dir)
+    os.makedirs(osp.dirname(path), exist_ok=True)
+    with open(path, 'w') as f:
+        f.write('{}')
+    assert len(part(cfg)) == 1
+
+
+def test_size_partitioner_splits_and_packs(tmp_path):
+    from opencompass_tpu.partitioners import SizePartitioner
+    cfg = _demo_cfg(tmp_path)
+    part = SizePartitioner(str(tmp_path / 'predictions'),
+                           max_task_size=100, gen_task_coef=20,
+                           dataset_size_path=str(tmp_path / 'size.json'))
+    tasks = part(cfg)
+    # demo-gen: 16 rows × 20 = 320 → split into ceil(16/5)=4 shards;
+    # demo-ppl: 8 rows × 2 labels = 16 → one small task
+    split_abbrs = [ds['abbr'] for t in tasks for ds in t['datasets'][0]]
+    assert sum(a.startswith('demo-gen_') for a in split_abbrs) == 4
+    assert 'demo-ppl' in split_abbrs
+    ranges = [ds['reader_cfg']['test_range'] for t in tasks
+              for ds in t['datasets'][0] if ds['abbr'].startswith('demo-gen')]
+    assert ranges[0] == '[0:5]'
+    # size cache persisted
+    assert json.loads((tmp_path / 'size.json').read_text())['demo-gen'] == 16
+
+
+def test_size_partitioner_cost_model():
+    from opencompass_tpu.partitioners import SizePartitioner
+    part = SizePartitioner('/nonexistent', gen_task_coef=20)
+    gen_cfg = {'infer_cfg': {'inferencer': {'type': 'GenInferencer'},
+                             'prompt_template': {'template': 'x'}}}
+    ppl_cfg = {'infer_cfg': {'inferencer': {'type': 'PPLInferencer'},
+                             'prompt_template': {'template': {'A': 'a',
+                                                              'B': 'b',
+                                                              'C': 'c'}}}}
+    assert part.get_factor(gen_cfg) == 20
+    assert part.get_factor(ppl_cfg) == 3
+
+
+def _run_cli(args, env_extra=None):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, 'run.py', *args], cwd=REPO, env=env,
+        capture_output=True, text=True, timeout=240)
+
+
+@pytest.mark.slow
+def test_run_cli_end_to_end_with_resume(tmp_path):
+    work = str(tmp_path / 'out')
+    r = _run_cli(['configs/eval_demo.py', '-w', work,
+                  '--max-num-workers', '2'])
+    assert r.returncode == 0, r.stdout + r.stderr
+    run_dirs = os.listdir(work)
+    assert len(run_dirs) == 1
+    root = osp.join(work, run_dirs[0])
+    assert osp.exists(osp.join(root, 'predictions/fake-demo/demo-gen.json'))
+    assert osp.exists(osp.join(root, 'results/fake-demo/demo-ppl.json'))
+    summary = [f for f in os.listdir(osp.join(root, 'summary'))
+               if f.endswith('.txt')]
+    assert summary
+    text = open(osp.join(root, 'summary', summary[0])).read()
+    assert 'demo-gen' in text and 'demo-ppl' in text
+
+    # resume: everything exists → both phases skip, same summary
+    r2 = _run_cli(['configs/eval_demo.py', '-w', work, '-r'])
+    assert r2.returncode == 0
+    assert 'skipping infer' in r2.stdout + r2.stderr
+    assert 'skipping eval' in r2.stdout + r2.stderr
+
+
+@pytest.mark.slow
+def test_run_cli_size_split_stitching(tmp_path):
+    """Oversized dataset → _k prediction shards → eval stitches them."""
+    work = str(tmp_path / 'out')
+    r = _run_cli(['configs/eval_demo.py', '-w', work,
+                  '--max-partition-size', '100', '--debug'])
+    assert r.returncode == 0, r.stdout + r.stderr
+    root = osp.join(work, os.listdir(work)[0])
+    shards = [f for f in os.listdir(osp.join(root, 'predictions/fake-demo'))
+              if f.startswith('demo-gen_')]
+    assert len(shards) == 4
+    result = json.load(open(osp.join(root,
+                                     'results/fake-demo/demo-gen.json')))
+    assert 'score' in result
+
+
+def test_summarizer_groups(tmp_path):
+    from opencompass_tpu.utils.summarizer import Summarizer
+    cfg = _demo_cfg(tmp_path)
+    cfg['summarizer'] = {
+        'summary_groups': [
+            {'name': 'demo-avg', 'subsets': ['demo-gen', 'demo-ppl']},
+            {'name': 'demo-weighted',
+             'subsets': ['demo-gen', 'demo-ppl'],
+             'weights': {'demo-gen': 3, 'demo-ppl': 1}},
+        ]
+    }
+    res_dir = tmp_path / 'results' / 'fake-demo'
+    res_dir.mkdir(parents=True)
+    (res_dir / 'demo-gen.json').write_text('{"score": 80.0}')
+    (res_dir / 'demo-ppl.json').write_text('{"accuracy": 40.0}')
+    table = Summarizer(cfg).summarize('t')
+    assert 'demo-avg' in table
+    lines = {l.split()[0]: l for l in table.splitlines() if l.strip()}
+    assert '60.00' in lines['demo-avg']          # (80+40)/2
+    assert '70.00' in lines['demo-weighted']     # (3*80+40)/4
+
+
+def test_eval_task_pred_role_extraction(tmp_path):
+    from opencompass_tpu.tasks.openicl_eval import extract_role_pred
+    s = '<sys>ignored</sys><bot>The answer</bot>trailing'
+    assert extract_role_pred(s, '<bot>', '</bot>') == 'The answer'
+    assert extract_role_pred(s, None, None) == s
+    assert extract_role_pred(s, '<missing>', '</bot>') == \
+        '<sys>ignored</sys><bot>The answer'
