@@ -1,0 +1,28 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.c3 import C3Dataset
+
+C3_reader_cfg = dict(
+    input_columns=['question', 'content', 'choice0', 'choice1', 'choice2',
+                   'choice3'],
+    output_column='label')
+
+C3_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            i: f'文章：{{content}}\n问题：{{question}}\n答案：{{choice{i}}}'
+            for i in range(4)
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+C3_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+C3_datasets = [
+    dict(abbr='C3', type=C3Dataset,
+         path='./data/CLUE/C3/dev_0.json',
+         reader_cfg=C3_reader_cfg, infer_cfg=C3_infer_cfg,
+         eval_cfg=C3_eval_cfg)
+]

@@ -1,0 +1,24 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.cmrc import CMRCDataset
+
+CMRC_reader_cfg = dict(input_columns=['question', 'context'],
+                       output_column='answers')
+
+CMRC_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template='文章：{context}\n根据上文，回答如下问题：{question}\n答：'),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=50))
+
+CMRC_eval_cfg = dict(evaluator=dict(type=EMEvaluator),
+                     pred_postprocessor=dict(type='cmrc'))
+
+CMRC_datasets = [
+    dict(abbr='CMRC_dev', type=CMRCDataset,
+         path='./data/CLUE/CMRC/dev.json',
+         reader_cfg=CMRC_reader_cfg, infer_cfg=CMRC_infer_cfg,
+         eval_cfg=CMRC_eval_cfg)
+]

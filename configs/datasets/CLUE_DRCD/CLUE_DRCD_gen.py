@@ -1,0 +1,24 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.cmrc import DRCDDataset
+
+DRCD_reader_cfg = dict(input_columns=['question', 'context'],
+                       output_column='answers')
+
+DRCD_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template='文章：{context}\n根据上文，回答如下问题：{question}\n答：'),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=50))
+
+DRCD_eval_cfg = dict(evaluator=dict(type=EMEvaluator),
+                     pred_postprocessor=dict(type='drcd'))
+
+DRCD_datasets = [
+    dict(abbr='DRCD_dev', type=DRCDDataset,
+         path='./data/CLUE/DRCD/dev.json',
+         reader_cfg=DRCD_reader_cfg, infer_cfg=DRCD_infer_cfg,
+         eval_cfg=DRCD_eval_cfg)
+]

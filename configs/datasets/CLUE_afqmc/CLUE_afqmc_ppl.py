@@ -1,0 +1,25 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.huggingface import HFDataset
+
+afqmc_reader_cfg = dict(input_columns=['sentence1', 'sentence2'],
+                        output_column='label', test_split='validation')
+
+afqmc_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: '"{sentence1}"与"{sentence2}"不同。',
+            1: '"{sentence1}"与"{sentence2}"相似。',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+afqmc_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+afqmc_datasets = [
+    dict(abbr='afqmc-dev', type=HFDataset, path='clue', name='afqmc',
+         reader_cfg=afqmc_reader_cfg, infer_cfg=afqmc_infer_cfg,
+         eval_cfg=afqmc_eval_cfg)
+]

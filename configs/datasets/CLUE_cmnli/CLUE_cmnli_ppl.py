@@ -1,0 +1,26 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.huggingface import HFDataset
+
+cmnli_reader_cfg = dict(input_columns=['sentence1', 'sentence2'],
+                        output_column='label', test_split='validation')
+
+cmnli_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: '{sentence1}？对，{sentence2}',
+            1: '{sentence1}？错，{sentence2}',
+            2: '{sentence1}？或许，{sentence2}',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+cmnli_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+cmnli_datasets = [
+    dict(abbr='cmnli', type=HFDataset, path='clue', name='cmnli',
+         reader_cfg=cmnli_reader_cfg, infer_cfg=cmnli_infer_cfg,
+         eval_cfg=cmnli_eval_cfg)
+]

@@ -1,0 +1,26 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.huggingface import HFDataset
+
+bustm_reader_cfg = dict(input_columns=['sentence1', 'sentence2'],
+                        output_column='label', test_split='train')
+
+bustm_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: '"{sentence1}"与"{sentence2}"说的不是一件事情。',
+            1: '"{sentence1}"与"{sentence2}"说的是一件事情。',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+bustm_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+bustm_datasets = [
+    dict(abbr='bustm-dev', type=HFDataset, path='json',
+         data_files='./data/FewCLUE/bustm/dev_few_all.json', split='train',
+         reader_cfg=bustm_reader_cfg, infer_cfg=bustm_infer_cfg,
+         eval_cfg=bustm_eval_cfg)
+]

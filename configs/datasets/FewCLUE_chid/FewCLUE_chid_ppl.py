@@ -1,0 +1,24 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.chid import CHIDDataset
+
+chid_reader_cfg = dict(
+    input_columns=[f'content{i}' for i in range(7)],
+    output_column='answer')
+
+chid_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={i: f'以下句子是否通顺？{{content{i}}}这个句子是通顺的。'
+                  for i in range(7)}),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+chid_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+chid_datasets = [
+    dict(abbr='chid-dev', type=CHIDDataset, path='./data/FewCLUE/chid/dev_few_all.json',
+         reader_cfg=chid_reader_cfg, infer_cfg=chid_infer_cfg,
+         eval_cfg=chid_eval_cfg)
+]

@@ -1,0 +1,27 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.cluewsc import CluewscDataset
+
+cluewsc_reader_cfg = dict(
+    input_columns=['span1', 'span2', 'text', 'new_text'],
+    output_column='answer')
+
+cluewsc_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: '{text}其中"{span2}"指代的不是"{span1}"。',
+            1: '{text}其中"{span2}"指代的是"{span1}"。',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+cluewsc_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+cluewsc_datasets = [
+    dict(abbr='cluewsc-dev', type=CluewscDataset, path='json',
+         data_files='./data/FewCLUE/cluewsc/dev_few_all.json', split='train',
+         reader_cfg=cluewsc_reader_cfg, infer_cfg=cluewsc_infer_cfg,
+         eval_cfg=cluewsc_eval_cfg)
+]

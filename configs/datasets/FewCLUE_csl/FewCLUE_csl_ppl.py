@@ -1,0 +1,26 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.clue_fewclue import CslDataset
+
+csl_reader_cfg = dict(input_columns=['abst', 'keywords'],
+                      output_column='label')
+
+csl_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: '摘要：{abst}',
+            1: '摘要：{abst}\n关键词：{keywords}',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+csl_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+csl_datasets = [
+    dict(abbr='csl-dev', type=CslDataset, path='json',
+         data_files='./data/FewCLUE/csl/dev_few_all.json', split='train',
+         reader_cfg=csl_reader_cfg, infer_cfg=csl_infer_cfg,
+         eval_cfg=csl_eval_cfg)
+]

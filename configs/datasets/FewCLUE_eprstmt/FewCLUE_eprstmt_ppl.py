@@ -1,0 +1,25 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.clue_fewclue import eprstmtDataset_V2
+
+eprstmt_reader_cfg = dict(input_columns=['sentence'], output_column='label')
+
+eprstmt_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            'A': '内容："{sentence}"。情感分析：积极。',
+            'B': '内容："{sentence}"。情感分析：消极。',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+eprstmt_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+eprstmt_datasets = [
+    dict(abbr='eprstmt-dev', type=eprstmtDataset_V2,
+         path='./data/FewCLUE/eprstmt/dev_few_all.json',
+         reader_cfg=eprstmt_reader_cfg, infer_cfg=eprstmt_infer_cfg,
+         eval_cfg=eprstmt_eval_cfg)
+]

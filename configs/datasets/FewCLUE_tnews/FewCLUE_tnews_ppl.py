@@ -1,0 +1,27 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.clue_fewclue import TNewsDataset
+
+tnews_reader_cfg = dict(input_columns=['sentence'],
+                        output_column='label_desc2')
+
+_labels = ['农业新闻', '旅游新闻', '游戏新闻', '科技类别公司新闻',
+           '体育类别新闻', '初升高教育新闻', '娱乐圈新闻', '投资资讯',
+           '军事类别常识', '车辆新闻', '楼市新闻', '环球不含中国类别新闻',
+           '书籍文化历史类别新闻', '故事类别新闻', '股票市场类别新闻']
+
+tnews_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={lb: f'{{sentence}}这篇新闻属于：{lb}' for lb in _labels}),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+tnews_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+tnews_datasets = [
+    dict(abbr='tnews-dev', type=TNewsDataset, path='clue', name='tnews',
+         reader_cfg=tnews_reader_cfg, infer_cfg=tnews_infer_cfg,
+         eval_cfg=tnews_eval_cfg)
+]

@@ -1,0 +1,40 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+"""Gaokao-Bench single/multi-choice subsets (MCQ JSON files)."""
+from opencompass_tpu.datasets.GaokaoBench import GaokaoBenchDataset
+
+_mcq_files = {
+    '2010-2022_Math_II_MCQs': 'single_choice',
+    '2010-2022_Math_I_MCQs': 'single_choice',
+    '2010-2022_History_MCQs': 'single_choice',
+    '2010-2022_Biology_MCQs': 'single_choice',
+    '2010-2022_Political_Science_MCQs': 'single_choice',
+    '2010-2022_Physics_MCQs': 'multi_choice',
+    '2010-2022_Chemistry_MCQs': 'single_choice',
+    '2010-2013_English_MCQs': 'single_choice',
+    '2010-2022_Chinese_Modern_Lit': 'multi_question_choice',
+    '2010-2022_English_Fill_in_Blanks': 'multi_question_choice',
+    '2012-2022_English_Cloze_Test': 'five_out_of_seven',
+    '2010-2022_Geography_MCQs': 'multi_question_choice',
+    '2010-2022_English_Reading_Comp': 'multi_question_choice',
+    '2010-2022_Chinese_Lang_and_Usage_MCQs': 'multi_question_choice',
+}
+
+GaokaoBench_datasets = []
+for _name, _qtype in _mcq_files.items():
+    GaokaoBench_datasets.append(dict(
+        abbr=f'GaokaoBench_{_name}',
+        type=GaokaoBenchDataset,
+        path=f'./data/GAOKAO-BENCH/data/Multiple-choice_Questions/{_name}.json',
+        reader_cfg=dict(input_columns=['question'], output_column='answer'),
+        infer_cfg=dict(
+            prompt_template=dict(
+                type=PromptTemplate,
+                template=dict(round=[
+                    dict(role='HUMAN', prompt='{question}'),
+                ])),
+            retriever=dict(type=ZeroRetriever),
+            inferencer=dict(type=GenInferencer, max_out_len=1024)),
+        eval_cfg=dict(
+            evaluator=dict(type=f'GaokaoBenchEvaluator_{_qtype}'))))

@@ -1,0 +1,25 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.huggingface import HFDataset
+
+AX_b_reader_cfg = dict(input_columns=['sentence1', 'sentence2'],
+                       output_column='label', test_split='test')
+
+AX_b_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: '{sentence1}?entailment, {sentence2}',
+            1: '{sentence1}?not_entailment, {sentence2}',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+AX_b_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+AX_b_datasets = [
+    dict(abbr='AX_b', type=HFDataset, path='super_glue', name='axb',
+         reader_cfg=AX_b_reader_cfg, infer_cfg=AX_b_infer_cfg,
+         eval_cfg=AX_b_eval_cfg)
+]

@@ -1,0 +1,26 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.huggingface import HFDataset
+
+CB_reader_cfg = dict(input_columns=['premise', 'hypothesis'],
+                     output_column='label', test_split='validation')
+
+CB_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: '{premise}?entailment, {hypothesis}',
+            1: '{premise}?contradiction, {hypothesis}',
+            2: '{premise}?neutral, {hypothesis}',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+CB_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+CB_datasets = [
+    dict(abbr='CB', type=HFDataset, path='super_glue', name='cb',
+         reader_cfg=CB_reader_cfg, infer_cfg=CB_infer_cfg,
+         eval_cfg=CB_eval_cfg)
+]

@@ -1,0 +1,26 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.huggingface import HFDataset
+
+COPA_reader_cfg = dict(
+    input_columns=['question', 'premise', 'choice1', 'choice2'],
+    output_column='label', test_split='validation')
+
+COPA_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: 'Premise: {premise}。\nQuestion: {question}。\nAnswer: {choice1}。',
+            1: 'Premise: {premise}。\nQuestion: {question}。\nAnswer: {choice2}。',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+COPA_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+COPA_datasets = [
+    dict(abbr='COPA', type=HFDataset, path='super_glue', name='copa',
+         reader_cfg=COPA_reader_cfg, infer_cfg=COPA_infer_cfg,
+         eval_cfg=COPA_eval_cfg)
+]

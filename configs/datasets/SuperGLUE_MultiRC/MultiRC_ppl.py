@@ -1,0 +1,28 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.multirc import MultiRCDataset
+
+MultiRC_reader_cfg = dict(input_columns=['question', 'text', 'answer'],
+                          output_column='label')
+
+MultiRC_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: ('Passage: {text}\nQuestion: {question}\n'
+                'Answer: {answer}\nIs it true? No.'),
+            1: ('Passage: {text}\nQuestion: {question}\n'
+                'Answer: {answer}\nIs it true? Yes.'),
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+MultiRC_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+MultiRC_datasets = [
+    dict(abbr='MultiRC', type=MultiRCDataset,
+         path='./data/SuperGLUE/MultiRC/val.jsonl',
+         reader_cfg=MultiRC_reader_cfg, infer_cfg=MultiRC_infer_cfg,
+         eval_cfg=MultiRC_eval_cfg)
+]

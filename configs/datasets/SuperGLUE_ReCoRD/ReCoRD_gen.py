@@ -1,0 +1,26 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.record import ReCoRDDataset
+
+ReCoRD_reader_cfg = dict(input_columns=['question', 'text'],
+                         output_column='answers')
+
+ReCoRD_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=('Passage: {text}\nResult: {question}\nQuestion: '
+                  'What entity does ____ refer to in the result?\n'
+                  'Answer: ')),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=50))
+
+ReCoRD_eval_cfg = dict(evaluator=dict(type=EMEvaluator),
+                       pred_postprocessor=dict(type='ReCoRD'))
+
+ReCoRD_datasets = [
+    dict(abbr='ReCoRD', type=ReCoRDDataset,
+         path='./data/SuperGLUE/ReCoRD/val.jsonl',
+         reader_cfg=ReCoRD_reader_cfg, infer_cfg=ReCoRD_infer_cfg,
+         eval_cfg=ReCoRD_eval_cfg)
+]

@@ -1,0 +1,22 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.wsc import WSCDataset
+
+WSC_reader_cfg = dict(input_columns=['span1', 'span2', 'text', 'new_text'],
+                      output_column='answer')
+
+WSC_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={0: '{text}', 1: '{new_text}'}),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+WSC_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+WSC_datasets = [
+    dict(abbr='WSC', type=WSCDataset, path='super_glue', name='wsc',
+         reader_cfg=WSC_reader_cfg, infer_cfg=WSC_infer_cfg,
+         eval_cfg=WSC_eval_cfg)
+]

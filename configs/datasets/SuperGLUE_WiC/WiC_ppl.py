@@ -1,0 +1,28 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.wic import WiCDataset
+
+WiC_reader_cfg = dict(
+    input_columns=['word', 'sentence1', 'sentence2'],
+    output_column='answer', test_split='validation')
+
+WiC_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: ('Sentence 1: {sentence1}\nSentence 2: {sentence2}\n'
+                "'{word}' has different meanings above."),
+            1: ('Sentence 1: {sentence1}\nSentence 2: {sentence2}\n'
+                "'{word}' has the same meaning above."),
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+WiC_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+WiC_datasets = [
+    dict(abbr='WiC', type=WiCDataset, path='super_glue', name='wic',
+         reader_cfg=WiC_reader_cfg, infer_cfg=WiC_infer_cfg,
+         eval_cfg=WiC_eval_cfg)
+]

@@ -1,0 +1,27 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.xsum import XsumDataset
+from opencompass_tpu.icl.evaluators import RougeEvaluator
+
+Xsum_reader_cfg = dict(input_columns=['dialogue'], output_column='summary')
+
+Xsum_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=('Document：{dialogue}\n'
+                  'Based on the previous text, provide a brief single '
+                  'summary:')),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=128))
+
+Xsum_eval_cfg = dict(evaluator=dict(type=RougeEvaluator),
+                     pred_postprocessor=dict(type='Xsum'))
+
+Xsum_datasets = [
+    dict(abbr='Xsum', type=XsumDataset,
+         path='./data/Xsum/dev.jsonl',
+         reader_cfg=Xsum_reader_cfg,
+         infer_cfg=Xsum_infer_cfg,
+         eval_cfg=Xsum_eval_cfg)
+]

@@ -1,0 +1,56 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.agieval import (AGIEvalDataset_v2,
+                                               AGIEvalEvaluator)
+
+agieval_single_choice_sets = [
+    'gaokao-chinese', 'gaokao-english', 'gaokao-geography',
+    'gaokao-history', 'gaokao-biology', 'gaokao-chemistry',
+    'gaokao-mathqa', 'logiqa-zh', 'lsat-ar', 'lsat-lr', 'lsat-rc',
+    'logiqa-en', 'sat-math', 'sat-en', 'sat-en-without-passage',
+    'aqua-rat',
+]
+agieval_cloze_sets = ['gaokao-mathcloze', 'math']
+
+agieval_datasets = []
+for _name in agieval_single_choice_sets:
+    agieval_datasets.append(dict(
+        abbr=f'agieval-{_name}',
+        type=AGIEvalDataset_v2,
+        path='./data/AGIEval/data/v1/',
+        name=_name,
+        setting_name='zero-shot',
+        reader_cfg=dict(input_columns=['question', 'options'],
+                        output_column='label'),
+        infer_cfg=dict(
+            prompt_template=dict(
+                type=PromptTemplate,
+                template=dict(round=[
+                    dict(role='HUMAN',
+                         prompt='{question}\n{options}\nAnswer: '),
+                ])),
+            retriever=dict(type=ZeroRetriever),
+            inferencer=dict(type=GenInferencer, max_out_len=1024)),
+        eval_cfg=dict(
+            evaluator=dict(type=AccEvaluator),
+            pred_postprocessor=dict(type='agieval-single-choice'))))
+
+for _name in agieval_cloze_sets:
+    agieval_datasets.append(dict(
+        abbr=f'agieval-{_name}',
+        type=AGIEvalDataset_v2,
+        path='./data/AGIEval/data/v1/',
+        name=_name,
+        setting_name='zero-shot',
+        reader_cfg=dict(input_columns=['question', 'options'],
+                        output_column='label'),
+        infer_cfg=dict(
+            prompt_template=dict(
+                type=PromptTemplate,
+                template=dict(round=[
+                    dict(role='HUMAN', prompt='{question}\nAnswer: '),
+                ])),
+            retriever=dict(type=ZeroRetriever),
+            inferencer=dict(type=GenInferencer, max_out_len=1024)),
+        eval_cfg=dict(evaluator=dict(type=AGIEvalEvaluator))))

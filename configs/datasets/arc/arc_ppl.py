@@ -1,0 +1,31 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.arc import ARCDataset
+
+arc_reader_cfg = dict(
+    input_columns=['question', 'textA', 'textB', 'textC', 'textD'],
+    output_column='answerKey')
+
+arc_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            opt: f'Question: {{question}}\nAnswer: {{text{opt}}}'
+            for opt in 'ABCD'
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+arc_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+arc_datasets = [
+    dict(abbr='ARC-c', type=ARCDataset,
+         path='./data/ARC/ARC-c/ARC-Challenge-Dev.jsonl',
+         reader_cfg=arc_reader_cfg, infer_cfg=arc_infer_cfg,
+         eval_cfg=arc_eval_cfg),
+    dict(abbr='ARC-e', type=ARCDataset,
+         path='./data/ARC/ARC-e/ARC-Easy-Dev.jsonl',
+         reader_cfg=arc_reader_cfg, infer_cfg=arc_infer_cfg,
+         eval_cfg=arc_eval_cfg),
+]

@@ -1,0 +1,81 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.bbh import BBHDataset, BBHEvaluator
+
+bbh_reader_cfg = dict(input_columns=['input'], output_column='target')
+
+bbh_multiple_choice_sets = [
+    "temporal_sequences",
+    "disambiguation_qa",
+    "date_understanding",
+    "tracking_shuffled_objects_three_objects",
+    "penguins_in_a_table",
+    "geometric_shapes",
+    "snarks",
+    "ruin_names",
+    "tracking_shuffled_objects_seven_objects",
+    "tracking_shuffled_objects_five_objects",
+    "logical_deduction_three_objects",
+    "hyperbaton",
+    "logical_deduction_five_objects",
+    "logical_deduction_seven_objects",
+    "movie_recommendation",
+    "salient_translation_error_detection",
+    "reasoning_about_colored_objects"
+]
+bbh_free_form_sets = [
+    "multistep_arithmetic_two",
+    "navigate",
+    "dyck_languages",
+    "word_sorting",
+    "sports_understanding",
+    "boolean_expressions",
+    "object_counting",
+    "formal_fallacies",
+    "causal_judgement",
+    "web_of_lies"
+]
+
+bbh_datasets = []
+for _name in bbh_multiple_choice_sets:
+    bbh_datasets.append(dict(
+        type=BBHDataset,
+        path='./data/BBH/data',
+        name=_name,
+        abbr=f'bbh-{_name}',
+        reader_cfg=bbh_reader_cfg,
+        infer_cfg=dict(
+            prompt_template=dict(
+                type=PromptTemplate,
+                template=dict(round=[
+                    dict(role='HUMAN',
+                         prompt=("Follow the given examples and answer the "
+                                 "question.\nQ: {input}\nA: Let's think "
+                                 "step by step.")),
+                ])),
+            retriever=dict(type=ZeroRetriever),
+            inferencer=dict(type=GenInferencer, max_out_len=512)),
+        eval_cfg=dict(evaluator=dict(type=AccEvaluator),
+                      pred_postprocessor=dict(type='bbh-mcq'),
+                      # gold targets are '(B)'-style; normalize both sides
+                      dataset_postprocessor=dict(type='bbh-mcq'))))
+for _name in bbh_free_form_sets:
+    bbh_datasets.append(dict(
+        type=BBHDataset,
+        path='./data/BBH/data',
+        name=_name,
+        abbr=f'bbh-{_name}',
+        reader_cfg=bbh_reader_cfg,
+        infer_cfg=dict(
+            prompt_template=dict(
+                type=PromptTemplate,
+                template=dict(round=[
+                    dict(role='HUMAN',
+                         prompt=("Follow the given examples and answer the "
+                                 "question.\nQ: {input}\nA: Let's think "
+                                 "step by step.")),
+                ])),
+            retriever=dict(type=ZeroRetriever),
+            inferencer=dict(type=GenInferencer, max_out_len=512)),
+        eval_cfg=dict(evaluator=dict(type=BBHEvaluator))))

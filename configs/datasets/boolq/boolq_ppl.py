@@ -1,0 +1,25 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.boolq import BoolQDataset
+
+BoolQ_reader_cfg = dict(input_columns=['question', 'passage'],
+                        output_column='answer', test_split='validation')
+
+BoolQ_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: '{passage}\nQuestion: {question}\nAnswer: No',
+            1: '{passage}\nQuestion: {question}\nAnswer: Yes',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+BoolQ_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+BoolQ_datasets = [
+    dict(abbr='BoolQ', type=BoolQDataset, path='super_glue', name='boolq',
+         reader_cfg=BoolQ_reader_cfg, infer_cfg=BoolQ_infer_cfg,
+         eval_cfg=BoolQ_eval_cfg)
+]

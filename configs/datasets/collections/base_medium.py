@@ -1,0 +1,51 @@
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from ..mmlu.mmlu_ppl import mmlu_datasets
+    from ..ceval.ceval_gen import ceval_datasets
+    from ..agieval.agieval_gen import agieval_datasets
+    from ..GaokaoBench.GaokaoBench_gen import GaokaoBench_datasets
+    from ..bbh.bbh_gen import bbh_datasets
+    from ..gsm8k.gsm8k_gen import gsm8k_datasets
+    from ..math.math_gen import math_datasets
+    from ..humaneval.humaneval_gen import humaneval_datasets
+    from ..mbpp.mbpp_gen import mbpp_datasets
+    from ..lambada.lambada_gen import lambada_datasets
+    from ..storycloze.storycloze_ppl import storycloze_datasets
+    from ..piqa.piqa_ppl import piqa_datasets
+    from ..siqa.siqa_ppl import siqa_datasets
+    from ..hellaswag.hellaswag_ppl import hellaswag_datasets
+    from ..winogrande.winogrande_ppl import winogrande_datasets
+    from ..obqa.obqa_ppl import obqa_datasets
+    from ..commonsenseqa.commonsenseqa_ppl import commonsenseqa_datasets
+    from ..triviaqa.triviaqa_gen import triviaqa_datasets
+    from ..nq.nq_gen import nq_datasets
+    from ..race.race_ppl import race_datasets
+    from ..arc.arc_ppl import arc_datasets
+    from ..boolq.boolq_ppl import BoolQ_datasets
+    from ..SuperGLUE_CB.CB_ppl import CB_datasets
+    from ..SuperGLUE_COPA.COPA_ppl import COPA_datasets
+    from ..SuperGLUE_MultiRC.MultiRC_ppl import MultiRC_datasets
+    from ..SuperGLUE_ReCoRD.ReCoRD_gen import ReCoRD_datasets
+    from ..SuperGLUE_WiC.WiC_ppl import WiC_datasets
+    from ..SuperGLUE_WSC.WSC_ppl import WSC_datasets
+    from ..CLUE_C3.CLUE_C3_ppl import C3_datasets
+    from ..CLUE_CMRC.CLUE_CMRC_gen import CMRC_datasets
+    from ..CLUE_DRCD.CLUE_DRCD_gen import DRCD_datasets
+    from ..CLUE_afqmc.CLUE_afqmc_ppl import afqmc_datasets
+    from ..CLUE_cmnli.CLUE_cmnli_ppl import cmnli_datasets
+    from ..FewCLUE_chid.FewCLUE_chid_ppl import chid_datasets
+    from ..FewCLUE_eprstmt.FewCLUE_eprstmt_ppl import eprstmt_datasets
+    from ..FewCLUE_tnews.FewCLUE_tnews_ppl import tnews_datasets
+    from ..FewCLUE_csl.FewCLUE_csl_ppl import csl_datasets
+    from ..FewCLUE_cluewsc.FewCLUE_cluewsc_ppl import cluewsc_datasets
+    from ..crowspairs.crowspairs_ppl import crowspairs_datasets
+    from ..Xsum.Xsum_gen import Xsum_datasets
+    from ..lcsts.lcsts_gen import lcsts_datasets
+    from ..summedits.summedits_gen import summedits_datasets
+    from ..strategyqa.strategyqa_gen import strategyqa_datasets
+    from ..theoremqa.theoremqa_gen import theoremqa_datasets
+    from ..drop.drop_gen import drop_datasets
+
+datasets = sum((v for k, v in locals().items() if k.endswith('_datasets')),
+               [])

@@ -1,0 +1,16 @@
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from ..mmlu.mmlu_ppl import mmlu_datasets
+    from ..ceval.ceval_gen import ceval_datasets
+    from ..gsm8k.gsm8k_gen import gsm8k_datasets
+    from ..piqa.piqa_ppl import piqa_datasets
+    from ..siqa.siqa_ppl import siqa_datasets
+    from ..hellaswag.hellaswag_ppl import hellaswag_datasets
+    from ..winogrande.winogrande_ppl import winogrande_datasets
+    from ..obqa.obqa_ppl import obqa_datasets
+    from ..triviaqa.triviaqa_gen import triviaqa_datasets
+    from ..nq.nq_gen import nq_datasets
+
+datasets = sum((v for k, v in locals().items() if k.endswith('_datasets')),
+               [])

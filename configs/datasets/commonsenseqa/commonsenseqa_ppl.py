@@ -1,0 +1,26 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.commonsenseqa import commonsenseqaDataset
+
+commonsenseqa_reader_cfg = dict(
+    input_columns=['question', 'A', 'B', 'C', 'D', 'E'],
+    output_column='answerKey', test_split='validation')
+
+commonsenseqa_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={opt: f'Answer the following question:\n{{question}}\n'
+                       f'Answer: {{{opt}}}' for opt in 'ABCDE'}),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+commonsenseqa_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+commonsenseqa_datasets = [
+    dict(abbr='commonsense_qa', type=commonsenseqaDataset,
+         path='commonsense_qa',
+         reader_cfg=commonsenseqa_reader_cfg,
+         infer_cfg=commonsenseqa_infer_cfg,
+         eval_cfg=commonsenseqa_eval_cfg)
+]

@@ -1,0 +1,24 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.drop import dropDataset
+
+drop_reader_cfg = dict(input_columns=['prompt', 'question'],
+                       output_column='answers',
+                       train_split='validation',
+                       test_split='validation')
+
+drop_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=('Text: {prompt}\nQuestion: {question}\nAnswer:')),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=50))
+
+drop_eval_cfg = dict(evaluator=dict(type=EMEvaluator))
+
+drop_datasets = [
+    dict(abbr='drop', type=dropDataset, path='drop',
+         reader_cfg=drop_reader_cfg, infer_cfg=drop_infer_cfg,
+         eval_cfg=drop_eval_cfg)
+]

@@ -1,0 +1,43 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.gsm8k import (GSM8KDataset, gsm8k_postprocess,
+                                             gsm8k_dataset_postprocess)
+
+gsm8k_reader_cfg = dict(input_columns=['question'], output_column='answer')
+
+# 2-exemplar chain-of-thought prompt; the trailing 'The answer is N' line is
+# what gsm8k_postprocess extracts.
+_cot = (
+    "Question: A pencil costs 3 dollars and a notebook costs 5 dollars. "
+    "How much do 2 pencils and 1 notebook cost?\n"
+    "Let's think step by step\nAnswer:\n"
+    "Two pencils cost 2 x 3 = 6 dollars.\n"
+    "Adding one notebook costs 6 + 5 = 11 dollars.\n"
+    "The answer is 11\n\n"
+    "Question: A farm has 12 cows and sells a quarter of them. "
+    "How many cows remain?\n"
+    "Let's think step by step\nAnswer:\n"
+    "A quarter of 12 is 12 / 4 = 3 cows sold.\n"
+    "So 12 - 3 = 9 cows remain.\n"
+    "The answer is 9\n\n"
+    "Question: {question}\nLet's think step by step\nAnswer:{answer}")
+
+gsm8k_infer_cfg = dict(
+    prompt_template=dict(type=PromptTemplate, template=_cot),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=512))
+
+gsm8k_eval_cfg = dict(
+    evaluator=dict(type=AccEvaluator),
+    pred_postprocessor=dict(type=gsm8k_postprocess),
+    dataset_postprocessor=dict(type=gsm8k_dataset_postprocess))
+
+gsm8k_datasets = [
+    dict(abbr='gsm8k',
+         type=GSM8KDataset,
+         path='./data/gsm8k',
+         reader_cfg=gsm8k_reader_cfg,
+         infer_cfg=gsm8k_infer_cfg,
+         eval_cfg=gsm8k_eval_cfg)
+]

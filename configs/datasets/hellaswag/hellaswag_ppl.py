@@ -1,0 +1,23 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.hellaswag import hellaswagDataset
+
+hellaswag_reader_cfg = dict(
+    input_columns=['ctx', 'A', 'B', 'C', 'D'],
+    output_column='label', test_split='validation')
+
+hellaswag_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={i: f'{{ctx}} {{{opt}}}' for i, opt in enumerate('ABCD')}),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+hellaswag_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+hellaswag_datasets = [
+    dict(abbr='hellaswag', type=hellaswagDataset, path='hellaswag',
+         reader_cfg=hellaswag_reader_cfg, infer_cfg=hellaswag_infer_cfg,
+         eval_cfg=hellaswag_eval_cfg)
+]

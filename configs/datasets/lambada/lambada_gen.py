@@ -1,0 +1,28 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.lambada import lambadaDataset, LambadaEvaluator
+
+lambada_reader_cfg = dict(input_columns=['prompt'], output_column='label',
+                          train_split='test')
+
+lambada_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=dict(round=[
+            dict(role='HUMAN',
+                 prompt='Please complete the following sentence:\n{prompt}'),
+        ])),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=5))
+
+lambada_eval_cfg = dict(evaluator=dict(type=LambadaEvaluator))
+
+lambada_datasets = [
+    dict(abbr='lambada',
+         type=lambadaDataset,
+         path='craffel/openai_lambada',
+         reader_cfg=lambada_reader_cfg,
+         infer_cfg=lambada_infer_cfg,
+         eval_cfg=lambada_eval_cfg)
+]

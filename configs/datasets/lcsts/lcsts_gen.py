@@ -1,0 +1,23 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.lcsts import LCSTSDataset
+from opencompass_tpu.icl.evaluators import RougeEvaluator
+
+lcsts_reader_cfg = dict(input_columns=['content'], output_column='abst')
+
+lcsts_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template='阅读以下文章，并给出简短的摘要：{content}\n摘要如下：'),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=64))
+
+lcsts_eval_cfg = dict(evaluator=dict(type=RougeEvaluator),
+                      pred_postprocessor=dict(type='lcsts'))
+
+lcsts_datasets = [
+    dict(abbr='lcsts', type=LCSTSDataset, path='./data/LCSTS',
+         reader_cfg=lcsts_reader_cfg, infer_cfg=lcsts_infer_cfg,
+         eval_cfg=lcsts_eval_cfg)
+]

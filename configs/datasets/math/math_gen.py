@@ -1,0 +1,31 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.math import (MATHDataset, MATHEvaluator,
+                                            math_postprocess)
+
+math_reader_cfg = dict(input_columns=['problem'], output_column='solution')
+
+math_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=dict(round=[
+            dict(role='HUMAN',
+                 prompt=('Problem:\n{problem}\nSolve the problem step by '
+                         'step and put your final answer in \\boxed{}.\n'
+                         'Solution:')),
+        ])),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=512))
+
+math_eval_cfg = dict(evaluator=dict(type=MATHEvaluator),
+                     pred_postprocessor=dict(type=math_postprocess))
+
+math_datasets = [
+    dict(abbr='math',
+         type=MATHDataset,
+         path='./data/math/math.json',
+         reader_cfg=math_reader_cfg,
+         infer_cfg=math_infer_cfg,
+         eval_cfg=math_eval_cfg)
+]

@@ -1,0 +1,31 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.mbpp import MBPPDataset, MBPPEvaluator
+
+mbpp_reader_cfg = dict(input_columns=['text', 'test_list'],
+                       output_column='test_list_2')
+
+mbpp_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=dict(round=[
+            dict(role='HUMAN',
+                 prompt=('You are an expert Python programmer, and here is '
+                         'your task: {text} Your code should pass these '
+                         'tests:\n\n {test_list}  \n')),
+            dict(role='BOT', prompt="[BEGIN]\n"),
+        ])),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=512))
+
+mbpp_eval_cfg = dict(evaluator=dict(type=MBPPEvaluator), pred_role='BOT')
+
+mbpp_datasets = [
+    dict(abbr='mbpp',
+         type=MBPPDataset,
+         path='./data/mbpp/mbpp.jsonl',
+         reader_cfg=mbpp_reader_cfg,
+         infer_cfg=mbpp_infer_cfg,
+         eval_cfg=mbpp_eval_cfg)
+]

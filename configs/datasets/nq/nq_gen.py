@@ -1,0 +1,30 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.natural_question import (NaturalQuestionDataset,
+                                                        NQEvaluator)
+
+nq_reader_cfg = dict(input_columns=['question'], output_column='answer',
+                     train_split='dev', test_split='test')
+
+nq_infer_cfg = dict(
+    ice_template=dict(
+        type=PromptTemplate,
+        template=dict(round=[
+            dict(role='HUMAN',
+                 prompt='Answer these questions:\nQ: {question}?\nA: '),
+            dict(role='BOT', prompt='{answer}'),
+        ])),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=50))
+
+nq_eval_cfg = dict(evaluator=dict(type=NQEvaluator), pred_role='BOT')
+
+nq_datasets = [
+    dict(abbr='nq',
+         type=NaturalQuestionDataset,
+         path='./data/nq/',
+         reader_cfg=nq_reader_cfg,
+         infer_cfg=nq_infer_cfg,
+         eval_cfg=nq_eval_cfg)
+]

@@ -1,0 +1,22 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.obqa import OBQADataset
+
+obqa_reader_cfg = dict(input_columns=['question_stem', 'A', 'B', 'C', 'D'],
+                       output_column='answerKey')
+
+obqa_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={opt: f'{{question_stem}} {{{opt}}}' for opt in 'ABCD'}),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+obqa_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+obqa_datasets = [
+    dict(abbr='openbookqa', type=OBQADataset, path='openbookqa',
+         reader_cfg=obqa_reader_cfg, infer_cfg=obqa_infer_cfg,
+         eval_cfg=obqa_eval_cfg)
+]

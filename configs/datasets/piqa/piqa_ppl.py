@@ -1,0 +1,25 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.huggingface import HFDataset
+
+piqa_reader_cfg = dict(input_columns=['goal', 'sol1', 'sol2'],
+                       output_column='label', test_split='validation')
+
+piqa_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            0: 'The following makes sense: \nQ: {goal}\nA: {sol1}\n',
+            1: 'The following makes sense: \nQ: {goal}\nA: {sol2}\n',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+piqa_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+piqa_datasets = [
+    dict(abbr='piqa', type=HFDataset, path='piqa',
+         reader_cfg=piqa_reader_cfg, infer_cfg=piqa_infer_cfg,
+         eval_cfg=piqa_eval_cfg)
+]

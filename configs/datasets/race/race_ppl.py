@@ -1,0 +1,31 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.race import RaceDataset
+
+race_reader_cfg = dict(
+    input_columns=['article', 'question', 'A', 'B', 'C', 'D'],
+    output_column='answer')
+
+race_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            opt: ('Read the article, and answer the question.\n\n'
+                  f'Article:\n{{article}}\n\nQ: {{question}}\n\nA: '
+                  f'{{{opt}}}')
+            for opt in 'ABCD'
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+race_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+race_datasets = [
+    dict(abbr='race-middle', type=RaceDataset, path='race', name='middle',
+         reader_cfg=race_reader_cfg, infer_cfg=race_infer_cfg,
+         eval_cfg=race_eval_cfg),
+    dict(abbr='race-high', type=RaceDataset, path='race', name='high',
+         reader_cfg=race_reader_cfg, infer_cfg=race_infer_cfg,
+         eval_cfg=race_eval_cfg),
+]

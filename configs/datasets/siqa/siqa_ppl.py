@@ -1,0 +1,27 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.huggingface import HFDataset
+
+siqa_reader_cfg = dict(
+    input_columns=['context', 'question', 'answerA', 'answerB', 'answerC'],
+    output_column='label', test_split='validation')
+
+siqa_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            1: '{context} \nQ: {question}\nA: {answerA}',
+            2: '{context} \nQ: {question}\nA: {answerB}',
+            3: '{context} \nQ: {question}\nA: {answerC}',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+siqa_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+siqa_datasets = [
+    dict(abbr='siqa', type=HFDataset, path='social_i_qa',
+         reader_cfg=siqa_reader_cfg, infer_cfg=siqa_infer_cfg,
+         eval_cfg=siqa_eval_cfg)
+]

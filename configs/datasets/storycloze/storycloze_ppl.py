@@ -1,0 +1,28 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.storycloze import storyclozeDataset
+
+storycloze_reader_cfg = dict(
+    input_columns=['context', 'sentence_quiz1', 'sentence_quiz2'],
+    output_column='answer_right_ending')
+
+storycloze_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={
+            1: '{context} {sentence_quiz1}',
+            2: '{context} {sentence_quiz2}',
+        }),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+storycloze_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+storycloze_datasets = [
+    dict(abbr='story_cloze', type=storyclozeDataset,
+         path='juletxara/xstory_cloze', name='en',
+         reader_cfg=storycloze_reader_cfg,
+         infer_cfg=storycloze_infer_cfg,
+         eval_cfg=storycloze_eval_cfg)
+]

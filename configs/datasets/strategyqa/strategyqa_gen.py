@@ -1,0 +1,28 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.huggingface import HFDataset
+
+strategyqa_reader_cfg = dict(input_columns=['question'],
+                             output_column='answer', train_split='test')
+
+strategyqa_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=('Question: {question}\n'
+                  "Let's think step by step and answer yes or no.\n"
+                  'Answer:')),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=256))
+
+strategyqa_eval_cfg = dict(
+    evaluator=dict(type=AccEvaluator),
+    pred_postprocessor=dict(type='strategyqa'),
+    dataset_postprocessor=dict(type='strategyqa_dataset'))
+
+strategyqa_datasets = [
+    dict(abbr='strategyqa', type=HFDataset, path='wics/strategy-qa',
+         reader_cfg=strategyqa_reader_cfg,
+         infer_cfg=strategyqa_infer_cfg,
+         eval_cfg=strategyqa_eval_cfg)
+]

@@ -1,0 +1,31 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.summedits import SummeditsDataset_V2
+
+summedits_reader_cfg = dict(input_columns=['doc', 'summary'],
+                            output_column='label')
+
+summedits_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=dict(round=[
+            dict(role='HUMAN',
+                 prompt=('Document:\n{doc}\nSummary:\n{summary}\n'
+                         'Is the summary factually consistent with the '
+                         'document? Answer A for yes or B for no.\n'
+                         'Answer:')),
+        ])),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=5))
+
+summedits_eval_cfg = dict(evaluator=dict(type=AccEvaluator),
+                          pred_postprocessor=dict(type='first-capital'))
+
+summedits_datasets = [
+    dict(abbr='summedits', type=SummeditsDataset_V2,
+         path='./data/summedits/summedits.jsonl',
+         reader_cfg=summedits_reader_cfg,
+         infer_cfg=summedits_infer_cfg,
+         eval_cfg=summedits_eval_cfg)
+]

@@ -1,0 +1,30 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.TheoremQA import TheoremQADataset
+
+theoremqa_reader_cfg = dict(input_columns=['Question', 'Answer_type'],
+                            output_column='Answer', train_split='test')
+
+theoremqa_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=('Below is an instruction that describes a task, paired '
+                  'with an input that provides further context. Write a '
+                  'response that appropriately completes the request.\n\n'
+                  '### Instruction:\nAnswer the following question. The '
+                  'answer ends with "The answer is therefore X."\n\n'
+                  '### Input:\n{Question}\n\n### Response:')),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=512))
+
+theoremqa_eval_cfg = dict(evaluator=dict(type=AccEvaluator),
+                          pred_postprocessor=dict(type='TheoremQA'))
+
+theoremqa_datasets = [
+    dict(abbr='TheoremQA', type=TheoremQADataset,
+         path='./data/TheoremQA/test.csv',
+         reader_cfg=theoremqa_reader_cfg,
+         infer_cfg=theoremqa_infer_cfg,
+         eval_cfg=theoremqa_eval_cfg)
+]

@@ -1,0 +1,30 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.triviaqa import (TriviaQADataset,
+                                                TriviaQAEvaluator)
+
+triviaqa_reader_cfg = dict(input_columns=['question'], output_column='answer',
+                           train_split='dev', test_split='dev')
+
+triviaqa_infer_cfg = dict(
+    ice_template=dict(
+        type=PromptTemplate,
+        template=dict(round=[
+            dict(role='HUMAN', prompt='Answer these questions:\nQ: {question}\nA: '),
+            dict(role='BOT', prompt='{answer}'),
+        ])),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=50))
+
+triviaqa_eval_cfg = dict(evaluator=dict(type=TriviaQAEvaluator),
+                         pred_role='BOT')
+
+triviaqa_datasets = [
+    dict(abbr='triviaqa',
+         type=TriviaQADataset,
+         path='./data/triviaqa',
+         reader_cfg=triviaqa_reader_cfg,
+         infer_cfg=triviaqa_infer_cfg,
+         eval_cfg=triviaqa_eval_cfg)
+]

@@ -1,0 +1,25 @@
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator, EMEvaluator
+from opencompass_tpu.datasets.winogrande import winograndeDataset
+
+winogrande_reader_cfg = dict(input_columns=['opt1', 'opt2'],
+                             output_column='answer',
+                             test_split='validation')
+
+winogrande_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template={1: 'Good sentence: {opt1}', 2: 'Good sentence: {opt2}'}),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=PPLInferencer))
+
+winogrande_eval_cfg = dict(evaluator=dict(type=AccEvaluator))
+
+winogrande_datasets = [
+    dict(abbr='winogrande', type=winograndeDataset, path='winogrande',
+         name='winogrande_xs',
+         reader_cfg=winogrande_reader_cfg,
+         infer_cfg=winogrande_infer_cfg,
+         eval_cfg=winogrande_eval_cfg)
+]

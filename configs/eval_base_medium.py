@@ -1,0 +1,9 @@
+"""Full base-suite evaluation (the reference's base_medium equivalent)."""
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from .datasets.collections.base_medium import datasets
+    from .models.jax_llama_7b import models
+    from .summarizers.medium import summarizer
+
+work_dir = './outputs/base_medium'

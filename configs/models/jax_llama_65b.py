@@ -1,0 +1,14 @@
+"""LLaMA-65B: tensor-parallel over 8 chips (Megatron shardings)."""
+from opencompass_tpu.models import JaxLM
+
+models = [
+    dict(type=JaxLM,
+         abbr='llama-65b-jax',
+         path='./models/llama-65b-hf',
+         max_seq_len=2048,
+         batch_size=8,
+         max_out_len=100,
+         dtype='bfloat16',
+         parallel=dict(data=1, model=8),
+         run_cfg=dict(num_devices=8)),
+]

@@ -1,0 +1,14 @@
+"""LLaMA-7B through the TPU-native JaxLM (HF checkpoint dir)."""
+from opencompass_tpu.models import JaxLM
+
+models = [
+    dict(type=JaxLM,
+         abbr='llama-7b-jax',
+         path='./models/llama-7b-hf',   # HF checkpoint dir (config+shards)
+         max_seq_len=2048,
+         batch_size=16,
+         max_out_len=100,
+         dtype='bfloat16',
+         parallel=dict(data=-1, model=1),
+         run_cfg=dict(num_devices=1)),
+]

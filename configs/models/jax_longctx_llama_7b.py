@@ -1,0 +1,14 @@
+"""Long-context eval: sequence-parallel ring attention over 4 chips."""
+from opencompass_tpu.models import JaxLM
+
+models = [
+    dict(type=JaxLM,
+         abbr='llama-7b-jax-sp4',
+         path='./models/llama-7b-hf',
+         max_seq_len=32768,
+         batch_size=2,
+         max_out_len=100,
+         dtype='bfloat16',
+         parallel=dict(data=-1, model=1, seq=4),
+         run_cfg=dict(num_devices=4)),
+]

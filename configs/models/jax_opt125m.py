@@ -1,0 +1,12 @@
+"""OPT-125M — the BASELINE config-1 smoke model."""
+from opencompass_tpu.models import JaxLM
+
+models = [
+    dict(type=JaxLM,
+         abbr='opt125m-jax',
+         path='./models/opt-125m',
+         max_seq_len=2048,
+         batch_size=32,
+         max_out_len=100,
+         run_cfg=dict(num_devices=1)),
+]

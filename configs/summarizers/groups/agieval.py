@@ -1,0 +1,11 @@
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from ...datasets.agieval.agieval_gen import (agieval_cloze_sets,
+                                                 agieval_single_choice_sets)
+
+agieval_summary_groups = [
+    {'name': 'agieval',
+     'subsets': [f'agieval-{s}' for s in
+                 agieval_single_choice_sets + agieval_cloze_sets]},
+]

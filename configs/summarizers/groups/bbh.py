@@ -1,0 +1,11 @@
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from ...datasets.bbh.bbh_gen import (bbh_free_form_sets,
+                                         bbh_multiple_choice_sets)
+
+bbh_summary_groups = [
+    {'name': 'bbh',
+     'subsets': [f'bbh-{s}' for s in
+                 bbh_multiple_choice_sets + bbh_free_form_sets]},
+]

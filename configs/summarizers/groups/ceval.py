@@ -1,0 +1,18 @@
+"""C-Eval groups: per-category and overall averages."""
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from ...datasets.ceval.ceval_gen import ceval_subject_mapping
+
+_categories = sorted({v[2] for v in ceval_subject_mapping.values()})
+
+ceval_summary_groups = []
+for _cat in _categories:
+    _subsets = [f'ceval-{k}' for k, v in ceval_subject_mapping.items()
+                if v[2] == _cat]
+    ceval_summary_groups.append(
+        {'name': f'ceval-{_cat.lower().replace(" ", "-")}',
+         'subsets': _subsets})
+ceval_summary_groups.append(
+    {'name': 'ceval',
+     'subsets': [f'ceval-{k}' for k in ceval_subject_mapping]})

@@ -1,0 +1,11 @@
+"""MMLU summary groups: category averages + weighted overall average.
+Weights are per-subset test sizes (standard MMLU taxonomy)."""
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from ...datasets.mmlu.mmlu_ppl import mmlu_all_sets
+
+mmlu_summary_groups = [
+    {'name': 'mmlu',
+     'subsets': [f'lukaemon_mmlu_{s}' for s in mmlu_all_sets]},
+]

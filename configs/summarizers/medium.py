@@ -1,0 +1,13 @@
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from .groups.mmlu import mmlu_summary_groups
+    from .groups.ceval import ceval_summary_groups
+    from .groups.bbh import bbh_summary_groups
+    from .groups.agieval import agieval_summary_groups
+
+summarizer = dict(
+    summary_groups=sum(
+        (v for k, v in locals().items() if k.endswith('_summary_groups')),
+        []),
+)

@@ -1,0 +1,103 @@
+"""GaokaoBench: Chinese college-entrance-exam questions with per-question-type
+scoring rules.
+
+Parity: reference opencompass/datasets/GaokaoBench.py — letter extraction
+per question type ('【答案】' markers, last-letter for single choice), partial
+credit for multi_choice (2 points exact, 1 point subset), and one registered
+evaluator alias per question type.
+"""
+import json
+import re
+
+from datasets import Dataset
+
+from opencompass_tpu.icl.evaluators import BaseEvaluator
+from opencompass_tpu.registry import ICL_EVALUATORS, LOAD_DATASET
+
+from .base import BaseDataset
+
+VALID_QUESTION_TYPES = [
+    'single_choice', 'multi_choice', 'multi_question_choice',
+    'five_out_of_seven', 'cloze', 'subjective', 'correction'
+]
+
+
+@LOAD_DATASET.register_module()
+class GaokaoBenchDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        with open(path, encoding='utf-8') as f:
+            data = json.load(f)
+        return Dataset.from_list(data['example'])
+
+
+class GaokaoBenchEvaluator(BaseEvaluator):
+
+    def __init__(self, question_type):
+        assert question_type in VALID_QUESTION_TYPES
+        self.question_type = question_type
+
+    # -- answer extraction per question type -------------------------------
+
+    def extract_answers(self, output: str, answer_length=None):
+        qt = self.question_type
+        if qt == 'single_choice':
+            # last A-D letter in the generation
+            letters = re.findall(r'[A-D]', output[::-1])
+            return [letters[0]] if letters else []
+        if qt == 'multi_question_choice':
+            marked = re.findall(r'【答案】\s*[:：]*\s*[A-Z]', output)
+            if len(marked) == answer_length:
+                return [re.findall(r'[A-Z]', m)[0] for m in marked]
+            letters = re.findall(r'[A-Z]', output)
+            return letters[:answer_length]
+        if qt == 'multi_choice':
+            content = re.sub(r'\s+', '', output)
+            marker = content.find('【答案】')
+            region = content[marker:] if marker > 0 else content[-10:]
+            letters = ''.join(re.findall(r'[A-D]', region))
+            return [letters] if letters else []
+        if qt == 'five_out_of_seven':
+            return re.findall(r'[A-G]', output)[:5]
+        return []
+
+    @staticmethod
+    def _same_length(pred, refr):
+        return pred if len(pred) == len(refr) else ['Z'] * len(refr)
+
+    def score(self, predictions, references):
+        scorable = ('single_choice', 'multi_choice',
+                    'multi_question_choice', 'five_out_of_seven')
+        if self.question_type not in scorable:
+            return {'score': 0}
+        correct, total = 0, 0
+        for pred, refr in zip(predictions, references):
+            if self.question_type == 'multi_question_choice':
+                pred = self.extract_answers(pred, len(refr))
+            else:
+                pred = self.extract_answers(pred)
+            pred = self._same_length(pred, refr)
+            if self.question_type == 'multi_choice':
+                for p, r in zip(pred, refr):
+                    if p == r:
+                        correct += 2
+                    elif all(ch in r for ch in p):
+                        correct += 1
+                    total += 2
+            else:
+                for p, r in zip(pred, refr):
+                    correct += int(p == r)
+                    total += 1
+        return {'score': 100 * correct / max(1, total)}
+
+
+def _register_gaokao_alias(question_type):
+    ICL_EVALUATORS.register_module(
+        name=f'GaokaoBenchEvaluator_{question_type}',
+        module=lambda *a, **kw: GaokaoBenchEvaluator(
+            question_type, *a, **kw))
+
+
+for _qt in VALID_QUESTION_TYPES:
+    _register_gaokao_alias(_qt)

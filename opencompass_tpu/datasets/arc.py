@@ -1,0 +1,33 @@
+"""AI2 ARC (easy/challenge): 4-choice science questions from jsonl.
+
+Parity: reference opencompass/datasets/arc.py — questions with ≠4 choices
+are dropped; choices unpacked to textA..textD.
+"""
+import json
+
+from datasets import Dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class ARCDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, errors='ignore', encoding='utf-8') as f:
+            for line in f:
+                item = json.loads(line.strip())
+                choices = item['question']['choices']
+                if len(choices) != 4:
+                    continue
+                rows.append({
+                    'question': item['question']['stem'],
+                    'answerKey': item['answerKey'],
+                    **{f'text{letter}': choice['text']
+                       for letter, choice in zip('ABCD', choices)},
+                })
+        return Dataset.from_list(rows)

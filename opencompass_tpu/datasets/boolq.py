@@ -1,0 +1,38 @@
+"""BoolQ: yes/no reading comprehension.
+
+Parity: reference opencompass/datasets/boolq.py — V1 maps true/false to
+1/0 ints for ppl templates; V2 reads local jsonl and letter-codes.
+"""
+import json
+
+from datasets import Dataset, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class BoolQDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            example['answer'] = int(example['label'] == 'true')
+            return example
+
+        return load_dataset(**kwargs).map(prep)
+
+
+@LOAD_DATASET.register_module()
+class BoolQDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                row = json.loads(line)
+                row['label'] = {'true': 'A', 'false': 'B'}[row['label']]
+                rows.append(row)
+        return Dataset.from_list(rows)

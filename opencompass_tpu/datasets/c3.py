@@ -1,0 +1,62 @@
+"""C3: Chinese multiple-choice reading comprehension.
+
+Parity: reference opencompass/datasets/c3.py — choices padded to 4 (V1
+repeats the first choice, V2 pads '[NULL]'); V2 letter-codes labels.
+"""
+import json
+
+from datasets import Dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+def _iter_questions(path):
+    with open(path, encoding='utf-8') as f:
+        data = json.load(f)
+    for passage, questions, *_ in data:
+        yield passage, questions
+
+
+@LOAD_DATASET.register_module()
+class C3Dataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        for passage, questions in _iter_questions(path):
+            content = ' '.join(''.join(p) for p in passage)
+            for q in questions:
+                label = q['choice'].index(q['answer'])
+                choices = list(q['choice'])
+                choices += [choices[0]] * (4 - len(choices))
+                rows.append({
+                    'content': content,
+                    'question': q['question'],
+                    'choices': choices,
+                    **{f'choice{i}': choices[i] for i in range(4)},
+                    'label': label,
+                })
+        return Dataset.from_list(rows)
+
+
+@LOAD_DATASET.register_module()
+class C3Dataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        for passage, questions in _iter_questions(path):
+            content = ''.join(''.join(p) for p in passage)
+            for q in questions:
+                label = 'ABCD'[q['choice'].index(q['answer'])]
+                choices = list(q['choice'])
+                choices += ['[NULL]'] * (4 - len(choices))
+                rows.append({
+                    'content': content,
+                    'question': q['question'],
+                    **{f'choice{i}': choices[i] for i in range(4)},
+                    'label': label,
+                })
+        return Dataset.from_list(rows)

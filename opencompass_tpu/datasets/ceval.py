@@ -1,0 +1,32 @@
+"""C-Eval: Chinese multi-subject exam (csv per subject, dev/val/test).
+
+Parity: reference opencompass/datasets/ceval.py — missing answer/explanation
+columns are padded with empty strings so all splits share a schema.
+"""
+import os.path as osp
+
+from datasets import DatasetDict, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class CEvalDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str, name: str):
+        def load_csv(split):
+            return load_dataset(
+                'csv',
+                data_files=osp.join(path, split, f'{name}_{split}.csv'),
+                split='train')
+
+        dev = load_csv('dev')
+        val = load_csv('val')
+        val = val.add_column('explanation', [''] * len(val))
+        test = load_csv('test')
+        test = test.add_column('answer', [''] * len(test)) \
+                   .add_column('explanation', [''] * len(test))
+        return DatasetDict({'val': val, 'dev': dev, 'test': test})

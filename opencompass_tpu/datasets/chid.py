@@ -1,0 +1,45 @@
+"""CHID: Chinese idiom cloze.
+
+Parity: reference opencompass/datasets/chid.py — V1 expands each candidate
+into a filled-in content{i} column (ppl); V2 blanks the idiom and
+letter-codes candidates (gen).
+"""
+import json
+
+from datasets import Dataset, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class CHIDDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            for i, cand in enumerate(example['candidates']):
+                example[f'content{i}'] = example['content'].replace(
+                    '#idiom#', cand)
+            return example
+
+        return load_dataset(**kwargs).map(prep)
+
+
+@LOAD_DATASET.register_module()
+class CHIDDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                row = json.loads(line)
+                item = {'content': row['content'].replace('#idiom#',
+                                                          '______')}
+                for i, cand in enumerate(row['candidates']):
+                    item[chr(ord('A') + i)] = cand
+                item['answer'] = 'ABCDEFG'[row['answer']]
+                rows.append(item)
+        return Dataset.from_list(rows)

@@ -1,0 +1,130 @@
+"""CLUE / FewCLUE jsonl loaders sharing the letter-coded label pattern:
+AFQMC (sentence-pair similarity), BUSTM (short-text matching), eprstmt
+(sentiment), cmnli (NLI), CSL (keyword authenticity), TNews (topic).
+
+Parity: reference opencompass/datasets/{afqmcd,bustum,eprstmt,cmnli,csl,
+tnews}.py.
+"""
+import json
+
+from datasets import Dataset, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+def _load_jsonl(path):
+    with open(path, encoding='utf-8') as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+@LOAD_DATASET.register_module()
+class AFQMCDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        for row in _load_jsonl(path):
+            row['label'] = 'AB'[int(row['label'])]
+            rows.append(row)
+        return Dataset.from_list(rows)
+
+
+@LOAD_DATASET.register_module()
+class bustumDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        for row in _load_jsonl(path):
+            row['label'] = 'AB'[int(row['label'])]
+            rows.append(row)
+        return Dataset.from_list(rows)
+
+
+@LOAD_DATASET.register_module()
+class eprstmtDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        return Dataset.from_list([{
+            'sentence': row['sentence'],
+            'label': {'Positive': 'A', 'Negative': 'B'}[row['label']],
+        } for row in _load_jsonl(path)])
+
+
+@LOAD_DATASET.register_module()
+class cmnliDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        for row in _load_jsonl(path):
+            if row['label'] == '-':
+                continue
+            row['label'] = {'entailment': 'A', 'contradiction': 'B',
+                            'neutral': 'C'}[row['label']]
+            rows.append(row)
+        return Dataset.from_list(rows)
+
+
+@LOAD_DATASET.register_module()
+class CslDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            example['keywords'] = '，'.join(example['keyword'])
+            return example
+
+        return load_dataset(**kwargs).map(prep)
+
+
+@LOAD_DATASET.register_module()
+class CslDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        return Dataset.from_list([{
+            'abst': row['abst'],
+            'keywords': '，'.join(row['keyword']),
+            'label': 'AB'[int(row['label'])],
+        } for row in _load_jsonl(path)])
+
+
+_TNEWS_DESC = {
+    'news_agriculture': '农业新闻', 'news_travel': '旅游新闻',
+    'news_game': '游戏新闻', 'news_tech': '科技类别公司新闻',
+    'news_sports': '体育类别新闻', 'news_edu': '初升高教育新闻',
+    'news_entertainment': '娱乐圈新闻', 'news_finance': '投资资讯',
+    'news_military': '军事类别常识', 'news_car': '车辆新闻',
+    'news_house': '楼市新闻', 'news_world': '环球不含中国类别新闻',
+    'news_culture': '书籍文化历史类别新闻', 'news_story': '故事类别新闻',
+    'news_stock': '股票市场类别新闻',
+}
+_TNEWS_LETTER = {k: chr(ord('A') + i)
+                 for i, k in enumerate(_TNEWS_DESC)}
+
+
+@LOAD_DATASET.register_module()
+class TNewsDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            example['label_desc2'] = _TNEWS_DESC[example['label_desc']]
+            return example
+
+        return load_dataset(**kwargs).map(prep)
+
+
+@LOAD_DATASET.register_module()
+class TNewsDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        return Dataset.from_list([{
+            'sentence': row['sentence'],
+            'label_desc2': _TNEWS_LETTER[row['label_desc']],
+        } for row in _load_jsonl(path)])

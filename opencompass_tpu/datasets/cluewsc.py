@@ -1,0 +1,50 @@
+"""CLUEWSC: Chinese Winograd schema.
+
+Parity: reference opencompass/datasets/cluewsc.py — V1 substitutes the
+pronoun character span with span1 (character-level, unlike English WSC's
+word-level); V2 letter-codes.
+"""
+import json
+
+from datasets import Dataset, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class CluewscDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            target = example['target']
+            chars = list(example['text'])
+            chars[target['span2_index']] = target['span1_text']
+            example['new_text'] = ''.join(chars)
+            example['answer'] = int(example['label'] == 'true')
+            example['span1'] = target['span1_text']
+            example['span2'] = target['span2_text']
+            del example['target']
+            return example
+
+        return load_dataset(**kwargs).map(prep)
+
+
+@LOAD_DATASET.register_module()
+class CluewscDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                row = json.loads(line)
+                rows.append({
+                    'span1': row['target']['span1_text'],
+                    'span2': row['target']['span2_text'],
+                    'text': row['text'],
+                    'label': {'true': 'A', 'false': 'B'}[row['label']],
+                })
+        return Dataset.from_list(rows)

@@ -1,0 +1,57 @@
+"""CMRC / DRCD: Chinese span-extraction reading comprehension (SQuAD-style
+JSON), with the '答案是' answer extractor.
+
+Parity: reference opencompass/datasets/cmrc.py, drcd.py (identical shape).
+"""
+import json
+
+from datasets import Dataset
+
+from opencompass_tpu.registry import LOAD_DATASET, TEXT_POSTPROCESSORS
+
+from .base import BaseDataset
+
+
+def _load_squad_style(path):
+    with open(path, encoding='utf-8') as f:
+        data = json.load(f)
+    rows = []
+    for article in data['data']:
+        for paragraph in article['paragraphs']:
+            for qa in paragraph['qas']:
+                rows.append({
+                    'context': paragraph['context'],
+                    'question': qa['question'],
+                    'answers': list({a['text'] for a in qa['answers']}),
+                })
+    return Dataset.from_list(rows)
+
+
+@LOAD_DATASET.register_module()
+class CMRCDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        return _load_squad_style(path)
+
+
+@LOAD_DATASET.register_module()
+class DRCDDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        return _load_squad_style(path)
+
+
+@TEXT_POSTPROCESSORS.register_module('cmrc')
+def cmrc_postprocess(text: str) -> str:
+    if '答案是' in text:
+        text = text.split('答案是')[1]
+    return text
+
+
+@TEXT_POSTPROCESSORS.register_module('drcd')
+def drcd_postprocess(text: str) -> str:
+    if '答案是' in text:
+        text = text.split('答案是')[1]
+    return text

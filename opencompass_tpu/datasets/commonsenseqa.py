@@ -1,0 +1,23 @@
+"""CommonsenseQA: 5-choice commonsense questions.
+
+Parity: reference opencompass/datasets/commonsenseqa.py.
+"""
+from datasets import load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class commonsenseqaDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            for i, text in enumerate(example['choices']['text'][:5]):
+                example[chr(ord('A') + i)] = text
+            return example
+
+        return load_dataset(**kwargs).map(prep) \
+            .remove_columns(['question_concept', 'id', 'choices'])

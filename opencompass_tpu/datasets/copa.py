@@ -1,0 +1,25 @@
+"""COPA: choice of plausible alternatives (jsonl).
+
+Parity: reference opencompass/datasets/copa.py (V2 letter-codes labels).
+"""
+import json
+
+from datasets import Dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class COPADataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                row = json.loads(line)
+                row['label'] = 'AB'[row['label']]
+                rows.append(row)
+        return Dataset.from_list(rows)

@@ -1,0 +1,27 @@
+"""DROP: discrete reasoning over paragraphs (numeric-answer subset).
+
+Parity: reference opencompass/datasets/drop.py.
+"""
+from datasets import DatasetDict, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class dropDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        data = load_dataset(**kwargs, split='validation')
+        data = data.filter(
+            lambda ex: 'number' in ex['answers_spans']['types'])
+
+        def prep(example):
+            example['answers'] = example['answers_spans']['spans']
+            example['prompt'] = example.pop('passage')
+            return example
+
+        data = data.map(prep).remove_columns(['section_id', 'query_id'])
+        return DatasetDict({'validation': data})

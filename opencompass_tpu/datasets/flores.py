@@ -1,0 +1,36 @@
+"""FLORES-200 translation (first 100 devtest rows).
+
+Parity: reference opencompass/datasets/flores.py.
+"""
+import re
+
+from datasets import DatasetDict, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET, TEXT_POSTPROCESSORS
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class FloresFirst100Dataset(BaseDataset):
+
+    @staticmethod
+    def load(name: str):
+        return DatasetDict({
+            'dev': load_dataset('facebook/flores', name=name, split='dev'),
+            'devtest': load_dataset('facebook/flores', name=name,
+                                    split='devtest[:100]'),
+        })
+
+
+@TEXT_POSTPROCESSORS.register_module('flores')
+def flores_postprocess(text: str) -> str:
+    return text.strip().split('\n')[0]
+
+
+@TEXT_POSTPROCESSORS.register_module('flores-chinese')
+def flores_postprocess_chinese(text: str) -> str:
+    import jieba
+    first = text.strip().split('\n')[0]
+    cleaned = re.sub(r'\s+', ' ', first).strip()
+    return ' '.join(jieba.cut(cleaned))

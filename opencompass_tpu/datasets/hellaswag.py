@@ -1,0 +1,39 @@
+"""HellaSwag: sentence completion, 4 endings.
+
+Parity: reference opencompass/datasets/hellaswag.py (endings list unpacked
+into A-D columns; V2 additionally letter-codes the label for gen mode).
+"""
+from datasets import load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+def _unpack_endings(example):
+    for i, ending in enumerate(example['endings'][:4]):
+        example[chr(ord('A') + i)] = ending
+    return example
+
+
+@LOAD_DATASET.register_module()
+class hellaswagDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        return load_dataset(**kwargs).map(_unpack_endings) \
+            .remove_columns(['endings'])
+
+
+@LOAD_DATASET.register_module()
+class hellaswagDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            _unpack_endings(example)
+            label = example['label']
+            example['label'] = 'ABCD'[int(label)] if label else 'NULL'
+            return example
+
+        return load_dataset(**kwargs).map(prep).remove_columns(['endings'])

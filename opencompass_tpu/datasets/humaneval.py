@@ -1,0 +1,121 @@
+"""HumanEval: Python function completion scored by test execution.
+
+The reference shells out to OpenAI's ``human_eval`` package (reference
+opencompass/datasets/humaneval.py:9-42).  This environment has no network
+and no that package, so the evaluator here is self-contained: completions
+are executed against each problem's check() function in a subprocess with a
+timeout, and pass@k is the unbiased estimator over n samples.
+"""
+import json
+import math
+import os.path as osp
+import subprocess
+import sys
+import tempfile
+from typing import List
+
+from datasets import Dataset, DatasetDict
+
+from opencompass_tpu.icl.evaluators import BaseEvaluator
+from opencompass_tpu.registry import (ICL_EVALUATORS, LOAD_DATASET,
+                                      TEXT_POSTPROCESSORS)
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class HumanEvalDataset(BaseDataset):
+    """Loads a HumanEval-format jsonl (task_id/prompt/test/entry_point)."""
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                if line.strip():
+                    rows.append(json.loads(line))
+        ds = Dataset.from_list(rows)
+        return DatasetDict({'train': ds, 'test': ds})
+
+
+def _run_candidate(problem: dict, completion: str, timeout: float) -> bool:
+    """Execute prompt+completion+test in an isolated python subprocess."""
+    program = (problem['prompt'] + completion + '\n' + problem['test'] +
+               f"\ncheck({problem['entry_point']})\n")
+    with tempfile.NamedTemporaryFile('w', suffix='.py', delete=False) as f:
+        f.write(program)
+        path = f.name
+    try:
+        proc = subprocess.run([sys.executable, path], capture_output=True,
+                              timeout=timeout)
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+    finally:
+        import os
+        os.unlink(path)
+
+
+def pass_at_k(n: int, c: int, k: int) -> float:
+    """Unbiased pass@k estimator (Codex paper): 1 - C(n-c,k)/C(n,k)."""
+    if n - c < k:
+        return 1.0
+    return 1.0 - math.prod(1.0 - k / i for i in range(n - c + 1, n + 1))
+
+
+@ICL_EVALUATORS.register_module()
+class HumanEvaluator(BaseEvaluator):
+    """Args:
+        k: pass@k values to report.
+        problem_file: jsonl with task prompts/tests; when omitted,
+            references must be the problem dicts themselves.
+        timeout: per-candidate execution wall-clock limit.
+    """
+
+    def __init__(self, k: List[int] = [1],
+                 problem_file: str = None, timeout: float = 10.0):
+        self.k = k
+        self.problem_file = problem_file
+        self.timeout = timeout
+
+    def score(self, predictions, references):
+        if self.problem_file and osp.exists(self.problem_file):
+            problems = []
+            with open(self.problem_file, encoding='utf-8') as f:
+                for line in f:
+                    if line.strip():
+                        problems.append(json.loads(line))
+        else:
+            problems = references
+        if len(predictions) != len(problems):
+            return {'error': 'predictions and problems have different '
+                             'length'}
+        passed = [
+            _run_candidate(prob, pred, self.timeout) if isinstance(
+                prob, dict) else False
+            for prob, pred in zip(problems, predictions)
+        ]
+        n, c = len(passed), sum(passed)
+        # one sample per task → only pass@1 is well-defined; pass@k for
+        # k>1 needs n samples *per problem* (use pass_at_k per task then)
+        out = {'humaneval_pass@1': 100 * c / max(1, n)}
+        for k in self.k:
+            if k > 1:
+                out[f'humaneval_pass@{k}'] = None  # needs multi-sampling
+        return out
+
+
+@TEXT_POSTPROCESSORS.register_module('humaneval')
+def humaneval_postprocess(text: str) -> str:
+    """Trim a generation down to the function body continuation."""
+    text = text.split('\n\n')[0]
+    if '```' in text:
+        text = text.split('```')[1]
+    if text.strip().startswith('def'):
+        text = '\n'.join(text.split('\n')[1:])
+    if not text.startswith('    '):
+        if text.startswith(' '):
+            text = '    ' + text.lstrip()
+        else:
+            text = '\n'.join('    ' + line for line in text.split('\n'))
+    return text

@@ -1,0 +1,19 @@
+"""IWSLT2017 translation (translation dict flattened to columns).
+
+Parity: reference opencompass/datasets/iwslt2017.py.
+"""
+from datasets import load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class IWSLT2017Dataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        data = load_dataset(**kwargs)
+        return data.map(lambda ex: ex['translation']) \
+                   .remove_columns('translation')

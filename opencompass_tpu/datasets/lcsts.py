@@ -1,0 +1,33 @@
+"""LCSTS: Chinese short-text summarization (parallel src/tgt files).
+
+Parity: reference opencompass/datasets/lcsts.py.
+"""
+import os.path as osp
+
+from datasets import Dataset
+
+from opencompass_tpu.registry import LOAD_DATASET, TEXT_POSTPROCESSORS
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class LCSTSDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        with open(osp.join(path, 'test.src.txt'), encoding='utf-8') as f:
+            sources = [line.strip() for line in f]
+        with open(osp.join(path, 'test.tgt.txt'), encoding='utf-8') as f:
+            targets = [line.strip() for line in f]
+        return Dataset.from_dict({'content': sources, 'abst': targets})
+
+
+@TEXT_POSTPROCESSORS.register_module('lcsts')
+def lcsts_postprocess(text: str) -> str:
+    text = text.strip().split('\n')[0].replace('своей', '').strip()
+    if text.startswith('1. '):
+        text = text.replace('1. ', '')
+    if text.startswith('- '):
+        text = text.replace('- ', '')
+    return text.strip('“，。！”')

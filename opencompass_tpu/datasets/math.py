@@ -1,0 +1,207 @@
+"""MATH: competition mathematics with LaTeX answers.
+
+Parity: reference opencompass/datasets/math.py:13-310 — the loader extracts
+the last ``\\boxed{...}`` span from each solution as the gold answer;
+``math_postprocess`` normalizes a model generation to a canonical final
+answer; ``MATHEvaluator.is_equiv`` compares predictions after a LaTeX
+canonicalization pass (frac/sqrt bracing, unit stripping, etc.).
+"""
+import json
+import re
+
+from datasets import Dataset, DatasetDict
+
+from opencompass_tpu.icl.evaluators import BaseEvaluator
+from opencompass_tpu.registry import (ICL_EVALUATORS, LOAD_DATASET,
+                                      TEXT_POSTPROCESSORS)
+
+from .base import BaseDataset
+
+
+def last_boxed_answer(solution: str):
+    """Contents of the last \\boxed{...} (or \\fbox{...}) in a solution."""
+    idx = solution.rfind('\\boxed')
+    if idx < 0:
+        idx = solution.rfind('\\fbox')
+        if idx < 0:
+            return None
+    depth = 0
+    for j in range(idx, len(solution)):
+        if solution[j] == '{':
+            depth += 1
+        elif solution[j] == '}':
+            depth -= 1
+            if depth == 0:
+                span = solution[idx:j + 1]
+                inner = span[span.index('{') + 1:-1]
+                return inner
+    return None
+
+
+@LOAD_DATASET.register_module()
+class MATHDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        with open(path, encoding='utf-8') as f:
+            data = json.load(f)
+        rows = [{
+            'problem': item['problem'],
+            'solution': last_boxed_answer(item['solution']),
+        } for item in data.values()]
+        ds = Dataset.from_list(rows)
+        return DatasetDict({'train': ds, 'test': ds})
+
+
+_SUBSTITUTIONS = [('an ', ''), ('a ', ''), ('.$', '$'), ('\\$', ''),
+                  (r'\ ', ''), (' ', ''), ('mbox', 'text'),
+                  (',\\text{and}', ','), ('\\text{and}', ','),
+                  ('\\text{m}', '\\text{}'), ('\\le', '<')]
+_REMOVED = [
+    'square', 'ways', 'integers', 'dollars', 'mph', 'inches', 'ft', 'hours',
+    'km', 'units', '\\ldots', 'sue', 'points', 'feet', 'minutes', 'digits',
+    'cents', 'degrees', 'cm', 'gm', 'pounds', 'meters', 'meals', 'edges',
+    'students', 'childrentickets', 'multiples', '\\text{s}', '\\text{.}',
+    '\\text{\ns}', '\\text{}^2', '\\text{}^3', '\\text{\n}', '\\text{}',
+    r'\mathrm{th}', r'^\circ', r'^{\circ}', r'\;', r',\!', '{,}', '"',
+    '\\dots', '\n', '\r', '\f'
+]
+
+
+def _normalize_final_answer(ans: str) -> str:
+    for before, after in _SUBSTITUTIONS:
+        ans = ans.replace(before, after)
+    for expr in _REMOVED:
+        ans = ans.replace(expr, '')
+    ans = re.sub(r'(\\text\{)(.*?)(\})', r'\2', ans)
+    ans = re.sub(r'(\\textbf\{)(.*?)(\})', r'\2', ans)
+    ans = re.sub(r'(\\overline\{)(.*?)(\})', r'\2', ans)
+    ans = re.sub(r'(\\boxed\{)(.*)(\})', r'\2', ans)
+    tail = re.findall(r'finalansweris(.*)', ans)
+    if tail:
+        ans = tail[-1]
+    boxed = re.findall(r'oxed\{(.*?)\}', ans)
+    if boxed:
+        ans = boxed[-1]
+    dollars = re.findall(r'\$(.*?)\$', ans)
+    if dollars:
+        ans = dollars[-1]
+    ans = ans.strip()
+    if 'rac' in ans and '\\frac' not in ans:
+        ans = ans.replace('rac', '\\frac')
+    ans = re.sub(r'(frac)([^{])(.)', r'frac{\2}{\3}', ans)
+    ans = re.sub(r'(sqrt)([^{])', r'sqrt{\2}', ans)
+    ans = ans.replace('$', '')
+    if ans.replace(',', '').isdigit():
+        ans = ans.replace(',', '')
+    return ans
+
+
+@TEXT_POSTPROCESSORS.register_module('math_postprocess')
+def math_postprocess(text: str) -> str:
+    for sentence in text.split('.'):
+        if 'final answer' in sentence.lower():
+            return _normalize_final_answer(sentence)
+    return _normalize_final_answer(text.split('.')[0])
+
+
+# -- LaTeX canonicalization for equivalence scoring -------------------------
+
+def _fix_fracs(s: str) -> str:
+    parts = s.split('\\frac')
+    out = parts[0]
+    for part in parts[1:]:
+        out += '\\frac'
+        if not part:
+            return s
+        if part[0] == '{':
+            out += part
+        elif len(part) < 2:
+            return s
+        else:
+            a, b, rest = part[0], part[1], part[2:]
+            out += ('{' + a + '}{' + b + '}' + rest) if b != '{' \
+                else ('{' + a + '}' + b + rest)
+    return out
+
+
+def _fix_a_slash_b(s: str) -> str:
+    parts = s.split('/')
+    if len(parts) != 2:
+        return s
+    try:
+        a, b = int(parts[0]), int(parts[1])
+        if s == f'{a}/{b}':
+            return '\\frac{' + str(a) + '}{' + str(b) + '}'
+    except ValueError:
+        pass
+    return s
+
+
+def _remove_right_units(s: str) -> str:
+    if '\\text{ ' in s:
+        parts = s.split('\\text{ ')
+        if len(parts) == 2:
+            return parts[0]
+        raise ValueError('multiple unit annotations')
+    return s
+
+
+def _fix_sqrt(s: str) -> str:
+    if '\\sqrt' not in s:
+        return s
+    parts = s.split('\\sqrt')
+    out = parts[0]
+    for part in parts[1:]:
+        if part and part[0] != '{':
+            out += '\\sqrt{' + part[0] + '}' + part[1:]
+        else:
+            out += '\\sqrt' + part
+    return out
+
+
+def math_strip_string(s: str) -> str:
+    """Canonicalize a LaTeX answer for string equality."""
+    s = s.replace('\n', '').replace('\\!', '').replace('\\\\', '\\')
+    s = s.replace('tfrac', 'frac').replace('dfrac', 'frac')
+    s = s.replace('\\left', '').replace('\\right', '')
+    s = s.replace('^{\\circ}', '').replace('^\\circ', '')
+    s = s.replace('\\$', '')
+    s = _remove_right_units(s)
+    s = s.replace('\\%', '')
+    s = s.replace(' .', ' 0.').replace('{.', '{0.')
+    if not s:
+        return s
+    if s[0] == '.':
+        s = '0' + s
+    halves = s.split('=')
+    if len(halves) == 2 and len(halves[0]) <= 2:
+        s = halves[1]
+    s = _fix_sqrt(s)
+    s = s.replace(' ', '')
+    s = _fix_fracs(s)
+    if s == '0.5':
+        s = '\\frac{1}{2}'
+    return _fix_a_slash_b(s)
+
+
+@ICL_EVALUATORS.register_module()
+class MATHEvaluator(BaseEvaluator):
+
+    def is_equiv(self, a, b) -> bool:
+        if a is None and b is None:
+            return True
+        if a is None or b is None:
+            return False
+        try:
+            return math_strip_string(a) == math_strip_string(b)
+        except Exception:
+            return a == b
+
+    def score(self, predictions, references):
+        if len(predictions) != len(references):
+            return {'error': 'predictions and references have different '
+                             'length'}
+        correct = sum(self.is_equiv(p, r)
+                      for p, r in zip(predictions, references))
+        return {'accuracy': 100 * correct / len(predictions)}

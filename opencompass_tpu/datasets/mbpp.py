@@ -1,0 +1,117 @@
+"""MBPP: basic Python problems scored by assertion execution.
+
+Parity: reference opencompass/datasets/mbpp.py — rows 0-10 are the few-shot
+pool, 10-510 the test split; predictions are trimmed of [BEGIN]/[DONE]
+wrappers and executed with the task's assertions under stdout/stderr
+swallowing and a wall-clock limit.
+"""
+import contextlib
+import io
+import re
+import signal
+
+from datasets import DatasetDict, load_dataset
+
+from opencompass_tpu.icl.evaluators import BaseEvaluator
+from opencompass_tpu.registry import ICL_EVALUATORS, LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class MBPPDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        def with_joined_tests(example):
+            example['test_case'] = example['test_list']
+            example['test_list'] = '\n'.join(example['test_list'])
+            example['test_list_2'] = example['test_list']
+            return example
+
+        train = load_dataset('json', data_files=path,
+                             split='train[:10]').map(with_joined_tests)
+        test = load_dataset('json', data_files=path,
+                            split='train[10:510]').map(with_joined_tests)
+        return DatasetDict({'train': train, 'test': test})
+
+
+class _Timeout(Exception):
+    pass
+
+
+@contextlib.contextmanager
+def _time_limit(seconds: float):
+    def handler(signum, frame):
+        raise _Timeout('time out')
+
+    signal.setitimer(signal.ITIMER_REAL, seconds)
+    signal.signal(signal.SIGALRM, handler)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+
+
+class _DevNullIO(io.StringIO):
+    """Write-only stream: exec'd code must not read our stdin."""
+
+    def read(self, *args, **kwargs):
+        raise IOError
+
+    readline = readlines = read
+
+    def readable(self):
+        return False
+
+
+class _redirect_stdin(contextlib._RedirectStream):
+    _stream = 'stdin'
+
+
+@contextlib.contextmanager
+def _swallow_io():
+    stream = _DevNullIO()
+    with contextlib.redirect_stdout(stream), \
+            contextlib.redirect_stderr(stream), _redirect_stdin(stream):
+        yield
+
+
+@ICL_EVALUATORS.register_module()
+class MBPPEvaluator(BaseEvaluator):
+
+    def score(self, predictions, references):
+        if len(predictions) != len(references):
+            return {'error': 'predictions and references have different '
+                             'length'}
+        tally = {'pass': 0, 'timeout': 0, 'failed': 0, 'wrong_answer': 0}
+        for tests, pred in zip(references, predictions):
+            program = self._extract_code(pred) + '\n' + str(tests)
+            try:
+                with _swallow_io(), _time_limit(2):
+                    exec(program, {})
+                tally['pass'] += 1
+            except _Timeout:
+                tally['timeout'] += 1
+            except AssertionError:
+                tally['wrong_answer'] += 1
+            except BaseException:  # noqa: BLE001 — arbitrary exec failures
+                tally['failed'] += 1
+        tally['score'] = 100 * tally['pass'] / len(predictions)
+        return tally
+
+    @staticmethod
+    def _extract_code(text: str) -> str:
+        text = text.strip()
+        done = re.search(r"('\s*|)(\[DONE\]|DONE)", text)
+        if done:
+            text = text[:done.start()]
+        begin = re.search(r"(\[BEGIN\]|BEGIN)('\s*|)", text)
+        if begin:
+            text = text[begin.end():]
+        text = text.strip()
+        if text.startswith("'"):
+            text = text[1:]
+        if text.endswith("'"):
+            text = text[:-1]
+        return text

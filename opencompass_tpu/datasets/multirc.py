@@ -1,0 +1,44 @@
+"""MultiRC: multi-sentence reading comprehension, per-answer binary labels.
+
+Parity: reference opencompass/datasets/multirc.py (V2 letter-codes labels
+via 'BA'[label]: 1 → 'A' yes, 0 → 'B' no).
+"""
+import json
+
+from datasets import Dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+def _iter_rows(path):
+    with open(path, errors='ignore', encoding='utf-8') as f:
+        for line in f:
+            sample = json.loads(line.strip())
+            text = sample['passage']['text']
+            for q in sample['passage']['questions']:
+                for a in q['answers']:
+                    yield text, q['question'], a['text'], a['label']
+
+
+@LOAD_DATASET.register_module()
+class MultiRCDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        return Dataset.from_list([
+            {'text': t, 'question': q, 'answer': a, 'label': label}
+            for t, q, a, label in _iter_rows(path)
+        ])
+
+
+@LOAD_DATASET.register_module()
+class MultiRCDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        return Dataset.from_list([
+            {'text': t, 'question': q, 'answer': a, 'label': 'BA'[label]}
+            for t, q, a, label in _iter_rows(path)
+        ])

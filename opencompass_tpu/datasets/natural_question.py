@@ -1,0 +1,36 @@
+"""Natural Questions open-domain QA (TSV files).
+
+Parity: reference opencompass/datasets/natural_question.py — dev split keeps
+only the first answer (few-shot pool); scoring is multi-reference EM after
+general postprocessing.
+"""
+import os.path as osp
+
+from datasets import DatasetDict
+
+from opencompass_tpu.icl.evaluators import BaseEvaluator
+from opencompass_tpu.registry import ICL_EVALUATORS, LOAD_DATASET
+
+from .base import BaseDataset
+from .triviaqa import _load_qa_tsv, multi_ref_em_score
+
+
+@LOAD_DATASET.register_module()
+class NaturalQuestionDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        return DatasetDict({
+            'dev': _load_qa_tsv(osp.join(path, 'nq-dev.qa.csv'), True),
+            'test': _load_qa_tsv(osp.join(path, 'nq-test.qa.csv'), False),
+        })
+
+
+@ICL_EVALUATORS.register_module()
+class NQEvaluator(BaseEvaluator):
+
+    def score(self, predictions, references):
+        if len(predictions) != len(references):
+            return {'error': 'predictions and references have different '
+                             'length'}
+        return {'score': multi_ref_em_score(predictions, references)}

@@ -1,0 +1,24 @@
+"""OpenBookQA: elementary-science multiple choice.
+
+Parity: reference opencompass/datasets/obqa.py — choices['text'] unpacked
+into A-D columns.
+"""
+from datasets import load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class OBQADataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            for i, text in enumerate(example['choices']['text'][:4]):
+                example[chr(ord('A') + i)] = text
+            return example
+
+        return load_dataset(**kwargs).map(prep) \
+            .remove_columns(['id', 'choices'])

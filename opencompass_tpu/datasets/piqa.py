@@ -1,0 +1,24 @@
+"""PIQA: physical commonsense, 2-choice (ppl or gen-AB mode).
+
+Parity: reference opencompass/datasets/piqa.py (V2 maps the int label to
+A/B letters for gen-mode scoring; ppl mode uses the raw HF columns).
+"""
+from datasets import load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class piqaDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def to_letter(example):
+            label = example.pop('label')
+            assert isinstance(label, int)
+            example['answer'] = 'AB'[label] if label >= 0 else 'NULL'
+            return example
+
+        return load_dataset(**kwargs).map(to_letter)

@@ -1,0 +1,38 @@
+"""ReCoRD: cloze-style reading comprehension with entity answers.
+
+Parity: reference opencompass/datasets/record.py — one row per (passage,
+query), '@highlight' markers stripped, '@placeholder' → '____', answers as
+a candidate list; postprocessor takes the first line minus 'Answer: '.
+"""
+import json
+
+from datasets import Dataset
+
+from opencompass_tpu.registry import LOAD_DATASET, TEXT_POSTPROCESSORS
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class ReCoRDDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, errors='ignore', encoding='utf-8') as f:
+            for line in f:
+                sample = json.loads(line.strip())
+                text = sample['passage']['text'].replace('@highlight', '')
+                for qa in sample['qas']:
+                    rows.append({
+                        'text': text,
+                        'question': qa['query'].replace('@placeholder',
+                                                        '____'),
+                        'answers': [a['text'] for a in qa['answers']],
+                    })
+        return Dataset.from_list(rows)
+
+
+@TEXT_POSTPROCESSORS.register_module('ReCoRD')
+def ReCoRD_postprocess(text: str) -> str:
+    return text.strip().split('\n')[0].replace('Answer: ', '').strip()

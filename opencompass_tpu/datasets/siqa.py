@@ -1,0 +1,22 @@
+"""SIQA: social commonsense, 3-choice.
+
+Parity: reference opencompass/datasets/siqa.py (V2 maps 1/2/3 labels to
+A/B/C letters).
+"""
+from datasets import load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class siqaDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def to_letter(example):
+            example['label'] = ' ABC'[int(example['label'])]
+            return example
+
+        return load_dataset(**kwargs).map(to_letter)

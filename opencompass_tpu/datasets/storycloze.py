@@ -1,0 +1,39 @@
+"""StoryCloze: pick the right story ending.
+
+Parity: reference opencompass/datasets/storycloze.py — train+eval splits
+concatenated; four context sentences joined; V2 letter-codes the answer.
+"""
+from datasets import DatasetDict, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+def _join_context(example):
+    example['context'] = ' '.join(
+        example[f'input_sentence_{i}'] for i in range(1, 5))
+    return example
+
+
+@LOAD_DATASET.register_module()
+class storyclozeDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        data = load_dataset(**kwargs, split='train+eval').map(_join_context)
+        return DatasetDict({'test': data})
+
+
+@LOAD_DATASET.register_module()
+class storyclozeDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            _join_context(example)
+            example['answer_right_ending'] = \
+                ' AB'[example['answer_right_ending']]
+            return example
+
+        return load_dataset(**kwargs, split='train+eval').map(prep)

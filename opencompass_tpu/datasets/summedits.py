@@ -1,0 +1,26 @@
+"""SummEdits: factual-consistency detection (jsonl).
+
+Parity: reference opencompass/datasets/summedits.py ('BA'[label]: 1 → 'A'
+consistent, 0 → 'B' inconsistent).
+"""
+import json
+
+from datasets import Dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class SummeditsDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                row = json.loads(line)
+                row['label'] = 'BA'[row['label']]
+                rows.append(row)
+        return Dataset.from_list(rows)

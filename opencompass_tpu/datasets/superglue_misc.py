@@ -1,0 +1,39 @@
+"""Small SuperGLUE/GLUE jsonl loaders: AX (entailment), CB (3-way NLI).
+
+Parity: reference opencompass/datasets/ax.py, cb.py.
+"""
+import json
+
+from datasets import Dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+def _jsonl_with_label_map(path, label_map):
+    rows = []
+    with open(path, encoding='utf-8') as f:
+        for line in f:
+            row = json.loads(line)
+            row['label'] = label_map[row['label']]
+            rows.append(row)
+    return Dataset.from_list(rows)
+
+
+@LOAD_DATASET.register_module()
+class AXDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        return _jsonl_with_label_map(
+            path, {'entailment': 'A', 'not_entailment': 'B'})
+
+
+@LOAD_DATASET.register_module()
+class CBDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        return _jsonl_with_label_map(
+            path, {'contradiction': 'A', 'entailment': 'B', 'neutral': 'C'})

@@ -1,0 +1,92 @@
+"""Toxicity / safety datasets: RealToxicityPrompts, CivilComments,
+JigsawMultilingual, and the plain-prompt Safety list.
+
+Parity: reference opencompass/datasets/{realtoxicprompts,civilcomments,
+jigsawmultilingual,safety}.py.
+"""
+import csv
+
+from datasets import Dataset, DatasetDict, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class RealToxicPromptsDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        challenging_subset = kwargs.pop('challenging_subset', False)
+        if kwargs['path'] == 'allenai/real-toxicity-prompts':
+            dataset = load_dataset(**kwargs)
+        else:
+            dataset = DatasetDict(
+                train=Dataset.from_file(kwargs.pop('path')))
+
+        def flatten_prompt(example):
+            for key, value in example['prompt'].items():
+                example['prompt_' + key] = value
+            del example['prompt']
+            return example
+
+        dataset = dataset.map(flatten_prompt)
+        if challenging_subset:
+            return dataset.filter(lambda ex: ex['challenging'])
+        return dataset
+
+
+@LOAD_DATASET.register_module()
+class CivilCommentsDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        extra_cols = ['severe_toxicity', 'obscene', 'threat', 'insult',
+                      'identity_attack', 'sexual_explicit']
+        train = load_dataset(**kwargs, split='train') \
+            .remove_columns(extra_cols)
+        test = load_dataset(**kwargs, split='test') \
+            .remove_columns(extra_cols) \
+            .shuffle(seed=42).select(range(10000))
+
+        def prep(example):
+            example['label'] = int(example['toxicity'] >= 0.5)
+            example['choices'] = ['no', 'yes']
+            return example
+
+        return DatasetDict({'train': train, 'test': test.map(prep)})
+
+
+@LOAD_DATASET.register_module()
+class JigsawMultilingualDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str, label: str, lang: str):
+        assert lang in ('es', 'fr', 'it', 'pt', 'ru', 'tr')
+        rows = []
+        with open(path, encoding='utf-8') as text_f, \
+                open(label, encoding='utf-8') as label_f:
+            for text_row, label_row in zip(csv.reader(text_f),
+                                           csv.reader(label_f)):
+                if text_row[2] == lang:
+                    assert text_row[0] == label_row[0]
+                    rows.append({
+                        'idx': len(rows),
+                        'text': text_row[1],
+                        'label': int(label_row[1]),
+                        'choices': ['no', 'yes'],
+                    })
+        return DatasetDict({'test': Dataset.from_list(rows)})
+
+
+@LOAD_DATASET.register_module()
+class SafetyDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        with open(path, encoding='utf-8') as f:
+            rows = [{'idx': i, 'prompt': line.strip()}
+                    for i, line in enumerate(
+                        l for l in f if l.strip())]
+        return DatasetDict({'test': Dataset.from_list(rows)})

@@ -1,0 +1,67 @@
+"""TriviaQA open-domain QA (TSV files, multi-reference exact match).
+
+Parity: reference opencompass/datasets/triviaqa.py — answers column is a
+python-literal list; test split keeps only the first answer for few-shot
+rendering; scoring lowercases, strips the first line, drops an 'answer is'
+prefix, then checks membership in the candidate answer set.
+"""
+import ast
+import csv
+import os.path as osp
+
+from datasets import Dataset, DatasetDict
+
+from opencompass_tpu.icl.evaluators import BaseEvaluator
+from opencompass_tpu.registry import ICL_EVALUATORS, LOAD_DATASET
+from opencompass_tpu.utils.text_postprocessors import general_postprocess
+
+from .base import BaseDataset
+
+
+def _load_qa_tsv(filename: str, first_answer_only: bool):
+    rows = []
+    with open(filename, encoding='utf-8') as f:
+        for row in csv.reader(f, delimiter='\t'):
+            assert len(row) == 2, f'malformed qa row: {row}'
+            answers = ast.literal_eval(row[1])
+            rows.append({
+                'question': row[0],
+                'answer': answers[0] if first_answer_only else answers,
+            })
+    return Dataset.from_list(rows)
+
+
+@LOAD_DATASET.register_module()
+class TriviaQADataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        return DatasetDict({
+            'dev': _load_qa_tsv(osp.join(path, 'trivia-dev.qa.csv'), False),
+            'test': _load_qa_tsv(osp.join(path, 'trivia-test.qa.csv'), True),
+        })
+
+
+def multi_ref_em_score(predictions, references):
+    """Shared EM-over-candidates metric for TriviaQA/NQ-style scoring."""
+    hits = 0
+    for pred, cands in zip(predictions, references):
+        pred = pred.split('\n')[0].lower()
+        if 'answer is' in pred:
+            pred = pred.split('answer is')[-1]
+        pred = general_postprocess(pred)
+        if isinstance(cands, str):
+            cands = [cands]
+        norm = [general_postprocess(c).lower() for c in cands]
+        hits += int(pred in norm)
+    return 100 * hits / len(predictions)
+
+
+@ICL_EVALUATORS.register_module()
+class TriviaQAEvaluator(BaseEvaluator):
+
+    def score(self, predictions, references):
+        if len(predictions) != len(references):
+            return {'error': 'predictions and references have different '
+                             'length'}
+        return {'score': multi_ref_em_score(predictions, references)}

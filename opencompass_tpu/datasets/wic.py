@@ -1,0 +1,37 @@
+"""WiC: word-in-context sense disambiguation.
+
+Parity: reference opencompass/datasets/wic.py.
+"""
+import json
+
+from datasets import Dataset, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class WiCDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            example['answer'] = int(example['label'] == 'true')
+            return example
+
+        return load_dataset(**kwargs).map(prep)
+
+
+@LOAD_DATASET.register_module()
+class WiCDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                row = json.loads(line)
+                row['label'] = {'true': 'A', 'false': 'B'}[row['label']]
+                rows.append(row)
+        return Dataset.from_list(rows)

@@ -1,0 +1,24 @@
+"""Winograd schema challenge.
+
+Parity: reference opencompass/datasets/winograd.py — options list unpacked
+into opt1/opt2, 'text' renamed to 'prompt'.
+"""
+from datasets import load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class winogradDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            example['prompt'] = example.pop('text')
+            example['opt1'], example['opt2'] = example['options'][:2]
+            return example
+
+        return load_dataset(**kwargs).map(prep) \
+            .remove_columns(['options', 'source'])

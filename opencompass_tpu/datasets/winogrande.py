@@ -1,0 +1,40 @@
+"""WinoGrande: pronoun resolution via sentence completion.
+
+Parity: reference opencompass/datasets/winogrande.py — the '_' placeholder
+is substituted with each option to form two full sentences (opt1/opt2);
+V2 letter-codes the answer for gen mode.
+"""
+from datasets import load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+def _fill_options(example):
+    sentence = example.pop('sentence')
+    example['opt1'] = sentence.replace('_', example.pop('option1'))
+    example['opt2'] = sentence.replace('_', example.pop('option2'))
+    return example
+
+
+@LOAD_DATASET.register_module()
+class winograndeDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        return load_dataset(**kwargs).map(_fill_options)
+
+
+@LOAD_DATASET.register_module()
+class winograndeDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            _fill_options(example)
+            answer = example.pop('answer')
+            example['label'] = ' AB'[int(answer)] if answer != '' else 'NULL'
+            return example
+
+        return load_dataset(**kwargs).map(prep)

@@ -1,0 +1,83 @@
+"""WSC: Winograd schema coreference (SuperGLUE form).
+
+Parity: reference opencompass/datasets/wsc.py — V1 substitutes the pronoun
+with span1 to build new_text; V2 is plain span extraction; V3 wraps spans
+with * / # markers in the text.
+"""
+import json
+
+from datasets import Dataset, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class WSCDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        def prep(example):
+            target = example['target']
+            words = example['text'].split(' ')
+            words[target['span2_index']] = target['span1_text']
+            example['new_text'] = ' '.join(words)
+            example['answer'] = int(example['label'] == 'true')
+            example['span1'] = target['span1_text']
+            example['span2'] = target['span2_text']
+            del example['target']
+            return example
+
+        return load_dataset(**kwargs).map(prep)
+
+
+@LOAD_DATASET.register_module()
+class WSCDataset_V2(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                item = json.loads(line)
+                rows.append({
+                    'span1': item['target']['span1_text'],
+                    'span2': item['target']['span2_text'],
+                    'text': item['text'],
+                    'label': {'true': 'A', 'false': 'B'}[item['label']],
+                })
+        return Dataset.from_list(rows)
+
+
+@LOAD_DATASET.register_module()
+class WSCDataset_V3(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, encoding='utf-8') as f:
+            for line in f:
+                item = json.loads(line)
+                target = item['target']
+                words = item['text'].split(' ')
+                s1, s2 = target['span1_text'], target['span2_text']
+                s1_range = range(target['span1_index'],
+                                 target['span1_index'] + len(s1.split(' ')))
+                s2_range = range(target['span2_index'],
+                                 target['span2_index'] + len(s2.split(' ')))
+                marked = []
+                for i, word in enumerate(words):
+                    if i == s1_range.start:
+                        marked.append(f'* {s1} *')
+                    elif i == s2_range.start:
+                        marked.append(f'# {s2} #')
+                    elif i not in s1_range and i not in s2_range:
+                        marked.append(word)
+                rows.append({
+                    'span1': s1,
+                    'span2': s2,
+                    'text': ' '.join(marked),
+                    'label': {'true': 'A', 'false': 'B'}[item['label']],
+                })
+        return Dataset.from_list(rows)

@@ -1,0 +1,22 @@
+"""XCOPA: multilingual COPA (validation splits of all languages combined).
+
+Parity: reference opencompass/datasets/xcopa.py.
+"""
+from datasets import concatenate_datasets, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+_LANGS = ['et', 'ht', 'it', 'id', 'qu', 'sw', 'zh', 'ta', 'th', 'tr', 'vi']
+_ALL = _LANGS + [f'translation-{lang}' for lang in _LANGS]
+
+
+@LOAD_DATASET.register_module()
+class XCOPADataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        path = kwargs.get('path')
+        parts = [load_dataset(path, lang)['validation'] for lang in _ALL]
+        return concatenate_datasets(parts)

@@ -1,0 +1,31 @@
+"""XL-Sum: multilingual summarization (all language validation splits).
+
+Parity: reference opencompass/datasets/xlsum.py.
+"""
+from datasets import concatenate_datasets, load_dataset
+
+from opencompass_tpu.registry import LOAD_DATASET
+
+from .base import BaseDataset
+
+_LANGS = [
+    'oromo', 'french', 'amharic', 'arabic', 'azerbaijani', 'bengali',
+    'burmese', 'chinese_simplified', 'chinese_traditional', 'welsh',
+    'english', 'kirundi', 'gujarati', 'hausa', 'hindi', 'igbo',
+    'indonesian', 'japanese', 'korean', 'kyrgyz', 'marathi', 'spanish',
+    'scottish_gaelic', 'nepali', 'pashto', 'persian', 'pidgin',
+    'portuguese', 'punjabi', 'russian', 'serbian_cyrillic',
+    'serbian_latin', 'sinhala', 'somali', 'swahili', 'tamil', 'telugu',
+    'thai', 'tigrinya', 'turkish', 'ukrainian', 'urdu', 'uzbek',
+    'vietnamese', 'yoruba'
+]
+
+
+@LOAD_DATASET.register_module()
+class XLSUMDataset(BaseDataset):
+
+    @staticmethod
+    def load(**kwargs):
+        path = kwargs.get('path')
+        parts = [load_dataset(path, lang)['validation'] for lang in _LANGS]
+        return concatenate_datasets(parts)

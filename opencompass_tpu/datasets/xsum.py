@@ -1,0 +1,35 @@
+"""XSum-style dialogue summarization (jsonl, first 1000 rows).
+
+Parity: reference opencompass/datasets/xsum.py.
+"""
+import json
+
+from datasets import Dataset
+
+from opencompass_tpu.registry import LOAD_DATASET, TEXT_POSTPROCESSORS
+
+from .base import BaseDataset
+
+
+@LOAD_DATASET.register_module()
+class XsumDataset(BaseDataset):
+
+    @staticmethod
+    def load(path: str):
+        rows = []
+        with open(path, errors='ignore', encoding='utf-8') as f:
+            for i, line in enumerate(f):
+                if i == 1000:
+                    break
+                sample = json.loads(line.strip())
+                if isinstance(sample['dialogue'], float) \
+                        or isinstance(sample['summary'], float):
+                    continue
+                rows.append({'dialogue': sample['dialogue'],
+                             'summary': sample['summary']})
+        return Dataset.from_list(rows)
+
+
+@TEXT_POSTPROCESSORS.register_module('Xsum')
+def Xsum_postprocess(text: str) -> str:
+    return text.strip().split('\n')[0].strip()

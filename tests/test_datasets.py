@@ -1,0 +1,332 @@
+"""Dataset loaders (against synthetic fixture files), postprocessors, and
+custom evaluators — all hermetic."""
+import json
+
+import pytest
+
+
+# -- text postprocessors ----------------------------------------------------
+
+def test_gsm8k_postprocessors():
+    from opencompass_tpu.datasets.gsm8k import (gsm8k_dataset_postprocess,
+                                                gsm8k_postprocess)
+    assert gsm8k_dataset_postprocess('blah blah #### 1,234') == '1234'
+    assert gsm8k_postprocess('So the answer is 42 dollars.\n\nextra') == '42'
+    assert gsm8k_postprocess('no numbers here') == ''
+
+
+def test_bbh_postprocessors_and_evaluator():
+    from opencompass_tpu.datasets.bbh import (BBHEvaluator,
+                                              bbh_freeform_postprocess,
+                                              bbh_mcq_postprocess)
+    assert bbh_mcq_postprocess('the answer is (B).') == 'B'
+    assert bbh_mcq_postprocess('the answer is C') == 'C'
+    assert bbh_freeform_postprocess('the answer is valid.') == 'valid'
+    res = BBHEvaluator().score(['the answer is yes', 'the answer is no'],
+                               ['yes', 'yes'])
+    assert res['score'] == 50.0
+
+
+def test_math_extraction_and_equivalence():
+    from opencompass_tpu.datasets.math import (MATHEvaluator,
+                                               last_boxed_answer,
+                                               math_postprocess)
+    assert last_boxed_answer(r'text \boxed{\frac{1}{2}} more') == \
+        r'\frac{1}{2}'
+    assert last_boxed_answer('no box') is None
+    ev = MATHEvaluator()
+    assert ev.is_equiv('1/2', '\\frac{1}{2}')
+    assert ev.is_equiv('0.5', '\\frac{1}{2}')
+    assert ev.is_equiv('\\tfrac{1}{2}', '\\frac{1}{2}')
+    assert not ev.is_equiv('2', '3')
+    assert 'accuracy' in ev.score(['1/2'], ['\\frac{1}{2}'])
+    out = math_postprocess('The final answer is $\\frac{3}{4}$.')
+    assert out == '\\frac{3}{4}'
+
+
+def test_humaneval_evaluator_and_postprocess():
+    from opencompass_tpu.datasets.humaneval import (HumanEvaluator,
+                                                    humaneval_postprocess,
+                                                    pass_at_k)
+    problem = {
+        'prompt': 'def add(a, b):\n',
+        'test': 'def check(f):\n    assert f(1, 2) == 3\n',
+        'entry_point': 'add',
+    }
+    good = '    return a + b\n'
+    bad = '    return a - b\n'
+    res = HumanEvaluator(k=[1]).score([good, bad], [problem, problem])
+    assert res['humaneval_pass@1'] == 50.0
+    assert pass_at_k(10, 10, 1) == 1.0
+    assert pass_at_k(10, 0, 5) == 0.0
+    assert humaneval_postprocess('return 1\n\nrest').startswith('    ')
+
+
+def test_mbpp_evaluator():
+    from opencompass_tpu.datasets.mbpp import MBPPEvaluator
+    tests = 'assert add(1, 2) == 3'
+    good = '[BEGIN]def add(a, b):\n    return a + b[DONE]'
+    wrong = 'def add(a, b):\n    return a - b'
+    broken = 'def add(a, b) return'
+    res = MBPPEvaluator().score([good, wrong, broken],
+                                [tests, tests, tests])
+    assert res['pass'] == 1 and res['wrong_answer'] == 1 \
+        and res['failed'] == 1
+    assert abs(res['score'] - 100 / 3) < 1e-6
+
+
+def test_trivia_nq_evaluators():
+    from opencompass_tpu.datasets.natural_question import NQEvaluator
+    from opencompass_tpu.datasets.triviaqa import TriviaQAEvaluator
+    res = TriviaQAEvaluator().score(
+        ['The answer is Paris.', 'London\nmore text'],
+        [['paris', 'the city of light'], ['Berlin']])
+    assert res['score'] == 50.0
+    res = NQEvaluator().score(['paris'], [['Paris']])
+    assert res['score'] == 100.0
+
+
+def test_lambada_evaluator():
+    from opencompass_tpu.datasets.lambada import LambadaEvaluator
+    res = LambadaEvaluator().score(['word, extra', 'wrong'],
+                                   ['word', 'right'])
+    assert res['accuracy'] == 50.0
+
+
+def test_strategyqa_postprocessors():
+    from opencompass_tpu.datasets.strategyqa import (
+        strategyqa_dataset_postprocess, strategyqa_pred_postprocess)
+    assert strategyqa_pred_postprocess('So the answer is Yes.') == 'yes'
+    assert strategyqa_dataset_postprocess('True') == 'yes'
+    assert strategyqa_dataset_postprocess('False') == 'no'
+
+
+def test_gaokao_evaluator():
+    from opencompass_tpu.datasets.GaokaoBench import GaokaoBenchEvaluator
+    ev = GaokaoBenchEvaluator('single_choice')
+    res = ev.score(['所以选B', '答案是A'], [['B'], ['C']])
+    assert res['score'] == 50.0
+    ev = GaokaoBenchEvaluator('multi_choice')
+    # exact (2/2) + subset partial credit (1/2)
+    res = ev.score(['【答案】AB', '【答案】A'], [['AB'], ['AB']])
+    assert res['score'] == 75.0
+
+
+def test_agieval_parse_and_evaluator():
+    from opencompass_tpu.datasets.agieval import (AGIEvalEvaluator,
+                                                  first_capital_letter,
+                                                  parse_math_answer)
+    assert parse_math_answer(r'stuff \boxed{42}') == '42'
+    assert parse_math_answer('x = 7') == '7'
+    assert parse_math_answer('the result is $y=3$') == '3'
+    assert first_capital_letter('answer: C') == 'C'
+    res = AGIEvalEvaluator().score([r'\boxed{1/2}'], ['\\frac{1}{2}'])
+    assert res['score'] == 100.0
+
+
+def test_truthfulqa_evaluator():
+    from opencompass_tpu.datasets.truthfulqa import TruthfulQAEvaluator
+    refs = [{'answers': {'best_answer': 'the sky is blue',
+                         'correct_answers': ['the sky is blue'],
+                         'incorrect_answers': ['the sky is green']}}]
+    res = TruthfulQAEvaluator().score(['the sky is blue'], refs)
+    assert res['f1_acc'] == 100.0
+    assert res['f1_max'] == 100.0
+
+
+# -- loaders over synthetic fixture files -----------------------------------
+
+def test_mmlu_loader(tmp_path):
+    from opencompass_tpu.datasets.mmlu import MMLUDataset
+    for split in ('dev', 'test'):
+        d = tmp_path / split
+        d.mkdir()
+        (d / f'anatomy_{split}.csv').write_text(
+            '"What is 1+1?","1","2","3","4","B"\n')
+    ds = MMLUDataset.load(str(tmp_path), 'anatomy')
+    assert ds['test'][0]['target'] == 'B'
+    assert ds['dev'][0]['A'] == '1'
+
+
+def test_arc_loader(tmp_path):
+    from opencompass_tpu.datasets.arc import ARCDataset
+    rows = [
+        {'answerKey': 'B', 'question': {
+            'stem': 'Q1', 'choices': [{'text': f'c{i}'} for i in range(4)]}},
+        {'answerKey': 'A', 'question': {
+            'stem': 'Q2', 'choices': [{'text': 'x'}] * 3}},  # dropped
+    ]
+    p = tmp_path / 'arc.jsonl'
+    p.write_text('\n'.join(json.dumps(r) for r in rows))
+    ds = ARCDataset.load(str(p))
+    assert len(ds) == 1
+    assert ds[0]['textC'] == 'c2'
+
+
+def test_boolq_copa_wsc_v2_loaders(tmp_path):
+    from opencompass_tpu.datasets.boolq import BoolQDataset_V2
+    from opencompass_tpu.datasets.copa import COPADataset_V2
+    from opencompass_tpu.datasets.wsc import WSCDataset_V2
+    p = tmp_path / 'boolq.jsonl'
+    p.write_text(json.dumps({'label': 'true', 'passage': 'p',
+                             'question': 'q'}) + '\n')
+    assert BoolQDataset_V2.load(str(p))[0]['label'] == 'A'
+    p = tmp_path / 'copa.jsonl'
+    p.write_text(json.dumps({'label': 1, 'premise': 'p', 'choice1': 'a',
+                             'choice2': 'b', 'question': 'cause'}) + '\n')
+    assert COPADataset_V2.load(str(p))[0]['label'] == 'B'
+    p = tmp_path / 'wsc.jsonl'
+    p.write_text(json.dumps({
+        'text': 'the cat sat', 'label': 'false',
+        'target': {'span1_text': 'cat', 'span1_index': 1,
+                   'span2_text': 'it', 'span2_index': 2}}) + '\n')
+    row = WSCDataset_V2.load(str(p))[0]
+    assert row['label'] == 'B' and row['span1'] == 'cat'
+
+
+def test_record_multirc_loaders(tmp_path):
+    from opencompass_tpu.datasets.multirc import MultiRCDataset_V2
+    from opencompass_tpu.datasets.record import ReCoRDDataset
+    p = tmp_path / 'record.jsonl'
+    p.write_text(json.dumps({
+        'passage': {'text': 'text @highlight more'},
+        'qas': [{'query': 'X @placeholder Y',
+                 'answers': [{'text': 'ans'}]}]}) + '\n')
+    row = ReCoRDDataset.load(str(p))[0]
+    assert '____' in row['question'] and '@highlight' not in row['text']
+    p = tmp_path / 'multirc.jsonl'
+    p.write_text(json.dumps({
+        'passage': {'text': 't', 'questions': [
+            {'question': 'q',
+             'answers': [{'text': 'a', 'label': 1}]}]}}) + '\n')
+    assert MultiRCDataset_V2.load(str(p))[0]['label'] == 'A'
+
+
+def test_c3_chid_loaders(tmp_path):
+    from opencompass_tpu.datasets.c3 import C3Dataset_V2
+    from opencompass_tpu.datasets.chid import CHIDDataset_V2
+    p = tmp_path / 'c3.json'
+    p.write_text(json.dumps([
+        [[['para one'], ['para two']],
+         [{'question': 'q', 'choice': ['a', 'b'], 'answer': 'b'}]],
+    ]))
+    row = C3Dataset_V2.load(str(p))[0]
+    assert row['label'] == 'B' and row['choice3'] == '[NULL]'
+    p = tmp_path / 'chid.jsonl'
+    p.write_text(json.dumps({
+        'content': 'x#idiom#y', 'candidates': ['一', '二'],
+        'answer': 1}) + '\n')
+    row = CHIDDataset_V2.load(str(p))[0]
+    assert row['answer'] == 'B' and '______' in row['content']
+
+
+def test_cmrc_loader_and_postprocess(tmp_path):
+    from opencompass_tpu.datasets.cmrc import CMRCDataset, cmrc_postprocess
+    p = tmp_path / 'cmrc.json'
+    p.write_text(json.dumps({'data': [
+        {'paragraphs': [{'context': 'ctx', 'qas': [
+            {'question': 'q',
+             'answers': [{'text': 'a'}, {'text': 'a'}]}]}]},
+    ]}))
+    row = CMRCDataset.load(str(p))[0]
+    assert row['answers'] == ['a']
+    assert cmrc_postprocess('所以答案是北京') == '北京'
+
+
+def test_gaokao_agieval_math_loaders(tmp_path):
+    from opencompass_tpu.datasets.agieval import AGIEvalDataset_v2
+    from opencompass_tpu.datasets.GaokaoBench import GaokaoBenchDataset
+    from opencompass_tpu.datasets.math import MATHDataset
+    p = tmp_path / 'gaokao.json'
+    p.write_text(json.dumps({'example': [{'question': 'q',
+                                          'answer': ['A']}]}))
+    assert GaokaoBenchDataset.load(str(p))[0]['answer'] == ['A']
+    p = tmp_path / 'agi.jsonl'
+    p.write_text(json.dumps({'passage': 'P. ', 'question': 'Q?',
+                             'options': ['(A) x', '(B) y'],
+                             'label': 'A'}) + '\n')
+    ds = AGIEvalDataset_v2.load(str(tmp_path), 'agi')
+    assert ds[0]['question'].startswith('P. ')
+    p = tmp_path / 'math.json'
+    p.write_text(json.dumps({'0': {
+        'problem': 'what?', 'solution': 'thus \\boxed{42}'}}))
+    assert MATHDataset.load(str(p)).reader is not None \
+        if hasattr(MATHDataset.load(str(p)), 'reader') \
+        else MATHDataset.load(str(p))['test'][0]['solution'] == '42'
+
+
+def test_gsm8k_humaneval_loaders(tmp_path):
+    from opencompass_tpu.datasets.gsm8k import GSM8KDataset
+    from opencompass_tpu.datasets.humaneval import HumanEvalDataset
+    for split in ('train', 'test'):
+        (tmp_path / f'{split}.jsonl').write_text(
+            json.dumps({'question': 'q', 'answer': 'a #### 5'}) + '\n')
+    ds = GSM8KDataset.load(str(tmp_path))
+    assert ds['test'][0]['answer'].endswith('5')
+    p = tmp_path / 'he.jsonl'
+    p.write_text(json.dumps({'task_id': 'HumanEval/0', 'prompt': 'def f():',
+                             'test': 'def check(f): pass',
+                             'entry_point': 'f'}) + '\n')
+    assert HumanEvalDataset.load(str(p))['test'][0]['entry_point'] == 'f'
+
+
+def test_clue_loaders(tmp_path):
+    from opencompass_tpu.datasets.clue_fewclue import (AFQMCDataset_V2,
+                                                       TNewsDataset_V2,
+                                                       cmnliDataset_V2,
+                                                       eprstmtDataset_V2)
+    p = tmp_path / 'afqmc.jsonl'
+    p.write_text(json.dumps({'sentence1': 'a', 'sentence2': 'b',
+                             'label': '1'}) + '\n')
+    assert AFQMCDataset_V2.load(str(p))[0]['label'] == 'B'
+    p = tmp_path / 'eprstmt.jsonl'
+    p.write_text(json.dumps({'sentence': 's', 'label': 'Negative'}) + '\n')
+    assert eprstmtDataset_V2.load(str(p))[0]['label'] == 'B'
+    p = tmp_path / 'cmnli.jsonl'
+    p.write_text(json.dumps({'sentence1': 'a', 'sentence2': 'b',
+                             'label': 'neutral'}) + '\n' +
+                 json.dumps({'sentence1': 'x', 'sentence2': 'y',
+                             'label': '-'}) + '\n')
+    ds = cmnliDataset_V2.load(str(p))
+    assert len(ds) == 1 and ds[0]['label'] == 'C'
+    p = tmp_path / 'tnews.jsonl'
+    p.write_text(json.dumps({'sentence': 's',
+                             'label_desc': 'news_game'}) + '\n')
+    assert TNewsDataset_V2.load(str(p))[0]['label_desc2'] == 'C'
+
+
+def test_summedits_xsum_safety_loaders(tmp_path):
+    from opencompass_tpu.datasets.summedits import SummeditsDataset_V2
+    from opencompass_tpu.datasets.toxicity import SafetyDataset
+    from opencompass_tpu.datasets.xsum import XsumDataset
+    p = tmp_path / 'se.jsonl'
+    p.write_text(json.dumps({'doc': 'd', 'summary': 's', 'label': 1})
+                 + '\n')
+    assert SummeditsDataset_V2.load(str(p))[0]['label'] == 'A'
+    p = tmp_path / 'xsum.jsonl'
+    p.write_text(json.dumps({'dialogue': 'd', 'summary': 's'}) + '\n')
+    assert XsumDataset.load(str(p))[0]['summary'] == 's'
+    p = tmp_path / 'safety.txt'
+    p.write_text('prompt one\n\nprompt two\n')
+    assert len(SafetyDataset.load(str(p))['test']) == 2
+
+
+def test_ceval_loader(tmp_path):
+    from opencompass_tpu.datasets.ceval import CEvalDataset
+    header = 'id,question,A,B,C,D,answer,explanation\n'
+    for split, extra in (('dev', '0,q,1,2,3,4,B,why\n'),
+                         ('val', None), ('test', None)):
+        d = tmp_path / split
+        d.mkdir()
+        if split == 'dev':
+            (d / f'law_{split}.csv').write_text(header + extra)
+        elif split == 'val':
+            (d / f'law_{split}.csv').write_text(
+                'id,question,A,B,C,D,answer\n0,q,1,2,3,4,A\n')
+        else:
+            (d / f'law_{split}.csv').write_text(
+                'id,question,A,B,C,D\n0,q,1,2,3,4\n')
+    ds = CEvalDataset.load(str(tmp_path), 'law')
+    assert ds['dev'][0]['answer'] == 'B'
+    assert ds['test'][0]['answer'] == ''
+    assert ds['val'][0]['explanation'] == ''
