@@ -1,0 +1,120 @@
+"""Conditional-log-probability inferencer for single-token choices.
+
+One forward pass per prompt; the prediction is softmax over the candidate
+choices' first-token logits at the prompt's final position (reference
+openicl/icl_inferencer/icl_clp_inferencer.py:24-223).  TPU-first difference:
+the reference appends a dummy token and indexes logits by tokenized prompt
+length host-side; here the model's ``get_choice_logprobs`` primitive handles
+positions on-device (left-aligned padding mask), so there is no dummy-token
+bookkeeping and one jitted executable serves the whole batch.
+"""
+import os
+from typing import List, Optional
+
+from opencompass_tpu.registry import ICL_INFERENCERS
+from opencompass_tpu.utils.logging import get_logger
+
+from .base import BaseInferencer, PPLInferencerOutputHandler
+
+logger = get_logger()
+
+
+@ICL_INFERENCERS.register_module()
+class CLPInferencer(BaseInferencer):
+    """Args:
+        single_token: only single-token choices are supported (parity with
+            the reference, which hard-fails otherwise).
+    """
+
+    def __init__(self,
+                 model,
+                 max_seq_len: Optional[int] = None,
+                 batch_size: int = 1,
+                 output_json_filepath: str = './icl_inference_output',
+                 output_json_filename: str = 'predictions',
+                 fix_id_list: Optional[List[int]] = None,
+                 single_token: bool = True,
+                 **kwargs):
+        super().__init__(model=model,
+                         max_seq_len=max_seq_len,
+                         batch_size=batch_size,
+                         output_json_filepath=output_json_filepath,
+                         output_json_filename=output_json_filename,
+                         **kwargs)
+        assert single_token, 'CLPInferencer supports single-token choices'
+        self.fix_id_list = fix_id_list
+
+    def inference(self,
+                  retriever,
+                  ice_template=None,
+                  prompt_template=None,
+                  output_json_filepath: Optional[str] = None,
+                  output_json_filename: Optional[str] = None) -> List:
+        output_handler = PPLInferencerOutputHandler()
+        output_json_filepath = output_json_filepath \
+            or self.output_json_filepath
+        output_json_filename = output_json_filename \
+            or self.output_json_filename
+
+        if not hasattr(self.model, 'get_choice_logprobs'):
+            raise TypeError(
+                f'{type(self.model).__name__} does not implement '
+                'get_choice_logprobs; CLPInferencer needs a logits-capable '
+                'model')
+
+        if self.fix_id_list:
+            ice_idx_list = retriever.retrieve(self.fix_id_list)
+        else:
+            ice_idx_list = retriever.retrieve()
+
+        ice = [
+            retriever.generate_ice(ice_idx_list[idx],
+                                   ice_template=ice_template)
+            for idx in range(len(ice_idx_list))
+        ]
+        output_handler.save_ice(ice)
+
+        choices = retriever.test_ds[0]['choices']
+
+        prompt_list = []
+        for idx in range(len(ice_idx_list)):
+            prompt = retriever.generate_prompt_for_generate_task(
+                idx, ice[idx], ice_template=ice_template,
+                prompt_template=prompt_template)
+            if self.max_seq_len is not None:
+                token_num = self.model.get_token_len_from_template(
+                    prompt, mode='gen')
+                while len(ice_idx_list[idx]) > 0 \
+                        and token_num + 1 > self.max_seq_len:
+                    ice_idx_list[idx] = ice_idx_list[idx][:-1]
+                    ice[idx] = retriever.generate_ice(
+                        ice_idx_list[idx], ice_template=ice_template)
+                    prompt = retriever.generate_prompt_for_generate_task(
+                        idx, ice[idx], ice_template=ice_template,
+                        prompt_template=prompt_template)
+                    token_num = self.model.get_token_len_from_template(
+                        prompt, mode='gen')
+            prompt_list.append(prompt)
+
+        logger.info('Calculating conditional log probability for prompts.')
+        index = 0
+        for start in range(0, len(prompt_list), self.batch_size):
+            sub_prompts = prompt_list[start:start + self.batch_size]
+            parsed = self.model.parse_template(sub_prompts, mode='gen')
+            probs = self.model.get_choice_logprobs(parsed, choices)
+            for res, prompt in zip(probs, parsed):
+                ice_str = str(
+                    self.model.parse_template(ice[index], mode='gen'))
+                output_handler.save_prompt_and_condprob(
+                    prompt.replace(ice_str, ''), prompt, list(res), index,
+                    choices)
+                index += 1
+
+        if self.is_main_process:
+            os.makedirs(output_json_filepath, exist_ok=True)
+            output_handler.write_to_json(output_json_filepath,
+                                         output_json_filename)
+        return [
+            sample['prediction']
+            for sample in output_handler.results_dict.values()
+        ]

@@ -2,3 +2,6 @@ from .base import BaseRetriever  # noqa
 from .fix_k import FixKRetriever  # noqa
 from .random_retriever import RandomRetriever  # noqa
 from .zero import ZeroRetriever  # noqa
+from .bm25 import BM25Retriever  # noqa
+from .topk import TopkRetriever  # noqa
+from .advanced import DPPRetriever, MDLRetriever, VotekRetriever  # noqa

@@ -1,0 +1,192 @@
+"""Advanced example-selection strategies on top of TopK embeddings:
+MDL (minimum description length re-ranking with a scorer LM), Vote-k
+(diversity voting), and DPP (determinantal point process MAP).
+
+Parity: reference openicl/icl_retriever/icl_mdl_retriever.py:19-186,
+icl_votek_retriever.py:15-99, icl_dpp_retriever.py:15-126 (the latter two
+are marked untested upstream).  TPU-first differences: the MDL scorer is any
+registered framework model (JaxLM on the chip, FakeModel in tests) via its
+``get_ppl`` primitive instead of a torch AutoModel; kernels and similarity
+matrices are plain numpy (tiny).
+"""
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+from typing import List, Optional
+
+import numpy as np
+
+from opencompass_tpu.registry import ICL_RETRIEVERS
+from opencompass_tpu.utils.logging import get_logger
+
+from .topk import TopkRetriever
+
+logger = get_logger()
+
+
+@ICL_RETRIEVERS.register_module()
+class MDLRetriever(TopkRetriever):
+    """Re-rank TopK candidates by description length of the test input
+    conditioned on the in-context examples.
+
+    Args:
+        candidate_num: TopK pool size to permute over.
+        select_time: number of candidate orderings sampled per test item.
+        metric_model: model config (dict) or instance whose ``get_ppl``
+            scores each (ice + input) rendering; required.
+        ce_temperature: reserved for parity; scores are mean NLLs.
+    """
+
+    def __init__(self, dataset, ice_separator: str = '\n',
+                 ice_eos_token: str = '\n', ice_num: int = 1,
+                 sentence_transformers_model_name: str =
+                 'all-mpnet-base-v2',
+                 batch_size: int = 64, hash_dim: int = 512,
+                 candidate_num: int = 8, select_time: int = 5,
+                 metric_model=None, seed: int = 1):
+        super().__init__(dataset, ice_separator, ice_eos_token, ice_num,
+                         sentence_transformers_model_name, batch_size,
+                         hash_dim)
+        self.candidate_num = candidate_num
+        self.select_time = select_time
+        self.seed = seed
+        if isinstance(metric_model, dict):
+            from opencompass_tpu.utils.build import build_model_from_cfg
+            metric_model = build_model_from_cfg(metric_model)
+        if metric_model is None:
+            raise ValueError('MDLRetriever needs a metric_model with '
+                             'get_ppl')
+        self.metric_model = metric_model
+
+    def retrieve(self) -> List[List[int]]:
+        ids, _, _ = self.topk_with_embeddings(self.candidate_num)
+        test_corpus = self.dataset_reader.generate_input_field_corpus(
+            self.test_ds)
+        index_corpus = self.dataset_reader.generate_input_output_field_corpus(
+            self.index_ds)
+        rng = random.Random(self.seed)
+        out = []
+        for row_ids, test_input in zip(ids.tolist(), test_corpus):
+            best_perm, best_nll = list(row_ids[:self.ice_num]), None
+            for trial in range(self.select_time):
+                if trial == 0:
+                    perm = list(row_ids[:self.ice_num])
+                else:
+                    perm = rng.sample(list(row_ids),
+                                      min(self.ice_num, len(row_ids)))
+                ice = self.ice_separator.join(
+                    index_corpus[i] for i in perm) + self.ice_eos_token
+                # mask the ICE so only the test input's description length
+                # is scored (reference icl_mdl_retriever.py:87-182)
+                ice_len = self.metric_model.get_token_len(ice)
+                nll = self.metric_model.get_ppl(
+                    [ice + test_input], mask_length=[ice_len])[0]
+                if best_nll is None or nll < best_nll:
+                    best_nll, best_perm = nll, perm
+            out.append([int(i) for i in best_perm])
+        return out
+
+
+@ICL_RETRIEVERS.register_module()
+class VotekRetriever(TopkRetriever):
+    """Vote-k: pick a fixed, diverse, high-coverage example set shared by
+    every test item."""
+
+    def __init__(self, dataset, ice_separator: str = '\n',
+                 ice_eos_token: str = '\n', ice_num: int = 1,
+                 sentence_transformers_model_name: str =
+                 'all-mpnet-base-v2',
+                 batch_size: int = 64, hash_dim: int = 512,
+                 votek_k: int = 3):
+        super().__init__(dataset, ice_separator, ice_eos_token, ice_num,
+                         sentence_transformers_model_name, batch_size,
+                         hash_dim)
+        self.votek_k = votek_k
+
+    def _votek_select(self, embeddings: np.ndarray, select_num: int,
+                      k: int, overlap_threshold: float) -> List[int]:
+        n = len(embeddings)
+        sims = embeddings @ embeddings.T  # unit vectors → cosine
+        votes = defaultdict(list)
+        for i in range(n):
+            nearest = np.argsort(sims[:, i])[-k - 1:-1]
+            for j in nearest:
+                if j != i:
+                    votes[int(j)].append(i)
+        ranked = sorted(votes.items(), key=lambda kv: -len(kv[1]))
+        selected: List[int] = []
+        j = 0
+        while len(selected) < select_num and j < len(ranked):
+            cand = set(ranked[j][1])
+            overlaps = any(
+                len(cand & set(ranked[prev][1])) >=
+                overlap_threshold * len(cand) for prev in range(j))
+            if not overlaps:
+                selected.append(int(ranked[j][0]))
+            j += 1
+        if len(selected) < select_num:
+            rest = [i for i in range(n) if i not in selected]
+            selected += random.sample(rest, select_num - len(selected))
+        return selected
+
+    def retrieve(self) -> List[List[int]]:
+        embeds = np.asarray(self.index_embeds)
+        chosen = self._votek_select(embeds, self.ice_num, self.votek_k,
+                                    overlap_threshold=1)
+        return [list(chosen) for _ in range(len(self.test_ds))]
+
+
+def _map_dpp(kernel: np.ndarray, max_length: int) -> List[int]:
+    """Greedy MAP inference for a DPP (fast-greedy algorithm)."""
+    item_size = kernel.shape[0]
+    cis = np.zeros((max_length, item_size))
+    di2s = np.copy(np.diag(kernel))
+    selected = [int(np.argmax(di2s))]
+    while len(selected) < max_length:
+        k = len(selected) - 1
+        ci_optimal = cis[:k, selected[-1]]
+        di_optimal = np.sqrt(max(di2s[selected[-1]], 1e-12))
+        elements = kernel[selected[-1], :]
+        eis = (elements - ci_optimal @ cis[:k, :]) / di_optimal
+        cis[k, :] = eis
+        di2s -= np.square(eis)
+        di2s[selected[-1]] = -np.inf
+        best = int(np.argmax(di2s))
+        if di2s[best] < 1e-10:
+            break
+        selected.append(best)
+    return selected
+
+
+@ICL_RETRIEVERS.register_module()
+class DPPRetriever(TopkRetriever):
+    """Two-stage DPP: TopK candidate pool, then MAP-diverse subset ordered
+    by relevance."""
+
+    def __init__(self, dataset, ice_separator: str = '\n',
+                 ice_eos_token: str = '\n', ice_num: int = 1,
+                 sentence_transformers_model_name: str =
+                 'all-mpnet-base-v2',
+                 batch_size: int = 64, hash_dim: int = 512,
+                 candidate_num: int = 10, scale_factor: float = 0.1):
+        super().__init__(dataset, ice_separator, ice_eos_token, ice_num,
+                         sentence_transformers_model_name, batch_size,
+                         hash_dim)
+        self.candidate_num = candidate_num
+        self.scale_factor = scale_factor
+
+    def retrieve(self) -> List[List[int]]:
+        ids, test_embeds, index_embeds = self.topk_with_embeddings(
+            self.candidate_num)
+        out = []
+        for row_ids, query in zip(ids, test_embeds):
+            near = index_embeds[row_ids]
+            rel = (near @ query + 1) / 2          # non-negative relevance
+            rel = np.exp((rel - rel.max()) / (2 * self.scale_factor))
+            sim = near @ near.T
+            kernel = rel[:, None] * sim * rel[None, :]
+            chosen = _map_dpp(kernel, min(self.ice_num, len(row_ids)))
+            chosen = sorted(chosen, key=lambda i: -rel[i])
+            out.append([int(row_ids[i]) for i in chosen])
+        return out

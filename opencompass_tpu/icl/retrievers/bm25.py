@@ -1,0 +1,83 @@
+"""BM25 retriever: lexical top-k in-context example selection.
+
+The reference wraps rank_bm25 + nltk tokenization (reference
+openicl/icl_retriever/icl_bm25_retriever.py:18-74); this environment has no
+rank_bm25, so Okapi BM25 is implemented directly (same scoring function,
+k1=1.5, b=0.75) over a simple regex word tokenizer with an nltk upgrade
+when importable.
+"""
+from __future__ import annotations
+
+import math
+import re
+from collections import Counter
+from typing import List, Optional
+
+from opencompass_tpu.registry import ICL_RETRIEVERS
+from opencompass_tpu.utils.logging import get_logger
+
+from .base import BaseRetriever
+
+logger = get_logger()
+
+
+def _tokenize(text: str) -> List[str]:
+    try:
+        from nltk.tokenize import word_tokenize
+        return [w.lower() for w in word_tokenize(text)]
+    except Exception:
+        return re.findall(r"\w+", text.lower())
+
+
+class OkapiBM25:
+    """Minimal Okapi BM25 over a tokenized corpus."""
+
+    def __init__(self, corpus: List[List[str]], k1: float = 1.5,
+                 b: float = 0.75):
+        self.k1 = k1
+        self.b = b
+        self.corpus = corpus
+        self.doc_lens = [len(doc) for doc in corpus]
+        self.avg_len = sum(self.doc_lens) / max(1, len(corpus))
+        self.doc_freqs = [Counter(doc) for doc in corpus]
+        df: Counter = Counter()
+        for doc in corpus:
+            df.update(set(doc))
+        n = len(corpus)
+        self.idf = {term: math.log((n - f + 0.5) / (f + 0.5) + 1)
+                    for term, f in df.items()}
+
+    def scores(self, query: List[str]) -> List[float]:
+        out = []
+        for freqs, dl in zip(self.doc_freqs, self.doc_lens):
+            score = 0.0
+            norm = self.k1 * (1 - self.b + self.b * dl / self.avg_len)
+            for term in query:
+                tf = freqs.get(term, 0)
+                if tf:
+                    score += self.idf.get(term, 0.0) * tf * (self.k1 + 1) \
+                        / (tf + norm)
+            out.append(score)
+        return out
+
+
+@ICL_RETRIEVERS.register_module()
+class BM25Retriever(BaseRetriever):
+
+    def __init__(self, dataset, ice_separator: str = '\n',
+                 ice_eos_token: str = '\n', ice_num: int = 1):
+        super().__init__(dataset, ice_separator, ice_eos_token, ice_num)
+        corpus = self.dataset_reader.generate_input_field_corpus(
+            self.index_ds)
+        self._index = OkapiBM25([_tokenize(doc) for doc in corpus])
+
+    def retrieve(self) -> List[List[int]]:
+        queries = self.dataset_reader.generate_input_field_corpus(
+            self.test_ds)
+        logger.info('Retrieving data for test set...')
+        out = []
+        for query in queries:
+            scores = self._index.scores(_tokenize(query))
+            ranked = sorted(range(len(scores)), key=lambda i: -scores[i])
+            out.append(ranked[:self.ice_num])
+        return out

@@ -1,0 +1,129 @@
+"""TopK retriever: semantic nearest-neighbour example selection.
+
+The reference embeds with SentenceTransformer and searches a faiss
+IndexFlatIP (reference openicl/icl_retriever/icl_topk_retriever.py:25-203).
+TPU-first replacement: corpora are ≤ a few 10k rows, so exact MIPS is one
+jitted ``embeddings @ query.T`` + ``lax.top_k`` on the accelerator — no ANN
+library.  The encoder is pluggable: SentenceTransformer when importable,
+otherwise a deterministic hashed bag-of-words projection (offline-safe; same
+cosine-similarity geometry, lower quality).
+"""
+from __future__ import annotations
+
+import functools
+import hashlib
+import re
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opencompass_tpu.registry import ICL_RETRIEVERS
+from opencompass_tpu.utils.logging import get_logger
+
+from .base import BaseRetriever
+
+logger = get_logger()
+
+
+class HashedBowEncoder:
+    """Deterministic feature-hashing sentence encoder (no model assets).
+
+    Each token contributes ±1 on a hashed coordinate (sign from a second
+    hash); vectors are L2-normalized so inner product = cosine.
+    """
+
+    def __init__(self, dim: int = 512):
+        self.dim = dim
+
+    def encode(self, sentences: List[str]) -> np.ndarray:
+        out = np.zeros((len(sentences), self.dim), np.float32)
+        for i, sent in enumerate(sentences):
+            for tok in re.findall(r'\w+', str(sent).lower()):
+                h = hashlib.md5(tok.encode()).digest()
+                idx = int.from_bytes(h[:4], 'little') % self.dim
+                sign = 1.0 if h[4] % 2 else -1.0
+                out[i, idx] += sign
+        norms = np.linalg.norm(out, axis=1, keepdims=True)
+        return out / np.maximum(norms, 1e-8)
+
+
+def _build_encoder(model_name: str, dim: int):
+    try:
+        # cache-only probe first: the SentenceTransformer constructor spends
+        # minutes in network retries when offline, so only build it if the
+        # checkpoint is already local
+        from huggingface_hub import snapshot_download
+        repo = model_name if '/' in model_name \
+            else f'sentence-transformers/{model_name}'
+        snapshot_download(repo_id=repo, local_files_only=True)
+        from sentence_transformers import SentenceTransformer
+        model = SentenceTransformer(model_name)
+
+        class _STEncoder:
+            def encode(self, sentences):
+                emb = model.encode(sentences, show_progress_bar=False)
+                emb = np.asarray(emb, np.float32)
+                return emb / np.maximum(
+                    np.linalg.norm(emb, axis=1, keepdims=True), 1e-8)
+
+        return _STEncoder()
+    except Exception as exc:
+        logger.warning(f'sentence-transformers unavailable ({exc}); '
+                       'using hashed bag-of-words encoder')
+        return HashedBowEncoder(dim)
+
+
+@ICL_RETRIEVERS.register_module()
+class TopkRetriever(BaseRetriever):
+    """Args:
+        sentence_transformers_model_name: encoder checkpoint when the
+            sentence-transformers package is available.
+        hash_dim: fallback hashed-BoW dimensionality.
+    """
+
+    def __init__(self, dataset, ice_separator: str = '\n',
+                 ice_eos_token: str = '\n', ice_num: int = 1,
+                 sentence_transformers_model_name: str =
+                 'all-mpnet-base-v2',
+                 batch_size: int = 64,
+                 hash_dim: int = 512):
+        super().__init__(dataset, ice_separator, ice_eos_token, ice_num)
+        self.batch_size = batch_size
+        self.encoder = _build_encoder(sentence_transformers_model_name,
+                                      hash_dim)
+        corpus = self.dataset_reader.generate_input_field_corpus(
+            self.index_ds)
+        self.index_embeds = jnp.asarray(self.encoder.encode(corpus))
+
+    @staticmethod
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def _mips(index, queries, k):
+        """Exact MIPS on-device: one matmul + top_k (shared jit cache)."""
+        return jax.lax.top_k(queries @ index.T, k)[1]
+
+    def _knn(self, queries: np.ndarray, k: int) -> np.ndarray:
+        return np.asarray(
+            self._mips(self.index_embeds, jnp.asarray(queries), k))
+
+    def retrieve(self) -> List[List[int]]:
+        test_corpus = self.dataset_reader.generate_input_field_corpus(
+            self.test_ds)
+        logger.info('Embedding + retrieving test set...')
+        k = min(self.ice_num, int(self.index_embeds.shape[0]))
+        ids = []
+        for start in range(0, len(test_corpus), self.batch_size):
+            batch = self.encoder.encode(
+                test_corpus[start:start + self.batch_size])
+            ids.extend(self._knn(batch, k).tolist())
+        return [list(map(int, row)) for row in ids]
+
+    def topk_with_embeddings(self, k: int):
+        """(ids, test_embeds, index_embeds) for subclass strategies."""
+        test_corpus = self.dataset_reader.generate_input_field_corpus(
+            self.test_ds)
+        test_embeds = self.encoder.encode(test_corpus)
+        k = min(k, int(self.index_embeds.shape[0]))
+        ids = self._knn(test_embeds, k)
+        return ids, test_embeds, np.asarray(self.index_embeds)

@@ -68,3 +68,16 @@ class FakeModel(BaseModel):
 
     def get_token_len(self, prompt: str) -> int:
         return max(1, len(str(prompt).split()))
+
+    def get_choice_logprobs(self, inputs, choices):
+        """Deterministic prob vectors: canned_ppls keys act as (prompt
+        substring → preferred choice index via lowest pseudo-PPL)."""
+        out = []
+        for prompt in inputs:
+            scores = [
+                1.0 / self.get_ppl([f'{prompt} {choice}'])[0]
+                for choice in choices
+            ]
+            total = sum(scores)
+            out.append([s / total for s in scores])
+        return out

@@ -218,10 +218,14 @@ class JaxLM(BaseModel):
         return n
 
     def _encode_batch(self, inputs: List[str], left_pad: bool,
-                      max_len: int) -> tuple:
+                      max_len: int, keep: str = 'head') -> tuple:
         """Tokenize + bucket-pad.  Returns (tokens, mask) int32/bool arrays
-        of shape (bucket_batch, bucket_len)."""
-        ids = [self.tokenizer.encode(str(s))[:max_len] for s in inputs]
+        of shape (bucket_batch, bucket_len).  ``keep`` picks which end
+        survives truncation: 'head' (HF-parity default) or 'tail' (for
+        scoring at the prompt end, e.g. CLP)."""
+        ids = [self.tokenizer.encode(str(s)) for s in inputs]
+        ids = [(row[:max_len] if keep == 'head' else row[-max_len:])
+               for row in ids]
         longest = max((len(x) for x in ids), default=1)
         S = _bucket(max(longest, 1), hi=max(max_len, 32))
         min_b = self.mesh.shape.get('data', 1) if self.mesh is not None else 1
@@ -255,6 +259,51 @@ class JaxLM(BaseModel):
                 ml[:len(mask_length)] = np.asarray(mask_length, np.int32)
             nll = self._ppl_fn(self.params, tokens, mask, jnp.asarray(ml))
             return np.asarray(nll)[:len(inputs)].tolist()
+
+    @functools.cached_property
+    def _choice_logits_fn(self):
+        """Jitted forward returning logits at each sequence's last real
+        position (right-padded batch).  Uses ring attention when the mesh
+        has a seq axis, same as the PPL path."""
+        cfg = self.cfg
+        mesh = self.mesh
+        use_ring = mesh is not None and mesh.shape.get('seq', 1) > 1
+        if use_ring:
+            from opencompass_tpu.parallel.ring_attention import ring_forward
+
+        @jax.jit
+        def last_logits(params, tokens, mask):
+            if use_ring:
+                logits = ring_forward(params, cfg, tokens, mask, mesh)
+            else:
+                logits = forward(params, cfg, tokens, mask)
+            last = jnp.maximum(
+                jnp.sum(mask.astype(jnp.int32), axis=-1) - 1, 0)
+            return jnp.take_along_axis(
+                logits, last[:, None, None], axis=1)[:, 0, :]
+        return last_logits
+
+    def get_choice_logprobs(self, inputs: List[str],
+                            choices: List[str]) -> List[List[float]]:
+        """Softmax over the choices' first-token logits at the prompt end
+        (the CLP measurement — reference icl_clp_inferencer.py:206-223)."""
+        choice_ids = []
+        for choice in choices:
+            ids = self.tokenizer.encode(str(choice))
+            if not ids:
+                raise ValueError(f'choice {choice!r} tokenizes to nothing')
+            choice_ids.append(ids[0])
+        with use_mesh(self.mesh):
+            # keep the tail: the choice position is the prompt's end
+            tokens, mask, _ = self._encode_batch(
+                inputs, left_pad=False, max_len=self.max_seq_len,
+                keep='tail')
+            logits = self._choice_logits_fn(self.params, tokens, mask)
+        logits = np.asarray(logits, np.float64)[:len(inputs)]
+        sub = logits[:, choice_ids]
+        sub = np.exp(sub - sub.max(axis=-1, keepdims=True))
+        sub = sub / sub.sum(axis=-1, keepdims=True)
+        return sub.tolist()
 
     def generate(self, inputs: List[str], max_out_len: int) -> List[str]:
         gk = dict(self.generation_kwargs)

@@ -1,0 +1,123 @@
+"""OpenAI-compatible chat API model.
+
+Parity: reference opencompass/models/openai_api.py:13-155 — ThreadPoolExecutor
+fan-out, HUMAN/BOT/SYSTEM → user/assistant/system role mapping, retry on
+rate-limit with token-bucket pacing, tiktoken-or-heuristic token counting.
+Implemented over ``urllib`` so any OpenAI-compatible endpoint (vLLM, llama
+server, proxies) works without the openai SDK; zero-egress environments get
+a clean error only at call time.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Union
+
+from opencompass_tpu.registry import MODELS
+from opencompass_tpu.utils.logging import get_logger
+from opencompass_tpu.utils.prompt import PromptList
+
+from .base_api import BaseAPIModel
+
+logger = get_logger()
+
+PromptType = Union[PromptList, str]
+
+OPENAI_API_BASE = os.environ.get(
+    'OPENAI_API_BASE', 'https://api.openai.com/v1/chat/completions')
+
+
+@MODELS.register_module()
+class OpenAI(BaseAPIModel):
+    """Args:
+        path: model name (e.g. 'gpt-4').
+        key: API key, or 'ENV' to read OPENAI_API_KEY.
+        max_out_len / temperature: generation defaults.
+        openai_api_base: endpoint URL (any OpenAI-compatible server).
+    """
+
+    is_api = True
+
+    def __init__(self,
+                 path: str = 'gpt-3.5-turbo',
+                 max_seq_len: int = 2048,
+                 query_per_second: int = 1,
+                 retry: int = 2,
+                 key: str = 'ENV',
+                 meta_template: Optional[Dict] = None,
+                 openai_api_base: str = OPENAI_API_BASE,
+                 temperature: Optional[float] = None,
+                 generation_kwargs: Optional[Dict] = None):
+        super().__init__(path=path,
+                         max_seq_len=max_seq_len,
+                         meta_template=meta_template,
+                         query_per_second=query_per_second,
+                         retry=retry,
+                         generation_kwargs=generation_kwargs)
+        self.temperature = temperature
+        self.key = os.environ.get('OPENAI_API_KEY', '') if key == 'ENV' \
+            else key
+        self.url = openai_api_base
+
+    def generate(self, inputs: List[PromptType],
+                 max_out_len: int = 512) -> List[str]:
+        with ThreadPoolExecutor() as executor:
+            return list(
+                executor.map(self._generate, inputs,
+                             [max_out_len] * len(inputs)))
+
+    def _to_messages(self, prompt: PromptType) -> List[Dict]:
+        if isinstance(prompt, str):
+            return [{'role': 'user', 'content': prompt}]
+        role_map = {'HUMAN': 'user', 'BOT': 'assistant', 'SYSTEM': 'system'}
+        return [{
+            'role': role_map.get(item['role'], 'user'),
+            'content': item['prompt'],
+        } for item in prompt]
+
+    def _generate(self, prompt: PromptType, max_out_len: int) -> str:
+        messages = self._to_messages(prompt)
+        body = {
+            'model': self.path,
+            'messages': messages,
+            'max_tokens': max_out_len,
+        }
+        if self.temperature is not None:
+            body['temperature'] = self.temperature
+        body.update(self.generation_kwargs)
+
+        for attempt in range(self.retry + 1):
+            self.wait()
+            try:
+                request = urllib.request.Request(
+                    self.url,
+                    data=json.dumps(body).encode(),
+                    headers={
+                        'Content-Type': 'application/json',
+                        'Authorization': f'Bearer {self.key}',
+                    })
+                with urllib.request.urlopen(request, timeout=60) as resp:
+                    data = json.loads(resp.read())
+                return data['choices'][0]['message']['content'].strip()
+            except urllib.error.HTTPError as err:
+                if err.code == 429:  # rate limited — back off and retry
+                    logger.warning('rate limited; backing off')
+                    time.sleep(2 ** attempt)
+                    continue
+                logger.error(f'API error {err.code}: {err.reason}')
+            except Exception as exc:  # noqa: BLE001 — network variance
+                logger.error(f'API request failed: {exc}')
+                time.sleep(1)
+        return ''
+
+    def get_token_len(self, prompt: str) -> int:
+        try:
+            import tiktoken
+            enc = tiktoken.encoding_for_model(self.path)
+            return len(enc.encode(prompt))
+        except Exception:
+            return super().get_token_len(prompt)

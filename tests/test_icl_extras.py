@@ -1,0 +1,172 @@
+"""CLP inferencer, BM25/TopK/MDL/Votek/DPP retrievers, OpenAI API model."""
+import json
+from unittest import mock
+
+import numpy as np
+import pytest
+from datasets import Dataset, DatasetDict
+
+from opencompass_tpu.datasets.base import BaseDataset
+from opencompass_tpu.models import FakeModel
+
+
+class ChoiceDS(BaseDataset):
+    @staticmethod
+    def load():
+        rows = {
+            'question': ['Is fire hot?', 'Is ice hot?'],
+            'choices': [['yes', 'no'], ['yes', 'no']],
+            'label': ['yes', 'no'],
+        }
+        train = {
+            'question': ['Is the sun bright?'],
+            'choices': [['yes', 'no']],
+            'label': ['yes'],
+        }
+        return DatasetDict({'test': Dataset.from_dict(rows),
+                            'train': Dataset.from_dict(train)})
+
+
+def _choice_ds():
+    return ChoiceDS(reader_cfg=dict(input_columns=['question'],
+                                    output_column='label'))
+
+
+def test_clp_inferencer_with_fake_model(tmp_path):
+    from opencompass_tpu.icl import PromptTemplate, ZeroRetriever
+    from opencompass_tpu.icl.inferencers import CLPInferencer
+    model = FakeModel(canned_ppls={'fire hot?\nA: yes': 1.0,
+                                   'ice hot?\nA: no': 1.0})
+    tpl = PromptTemplate('</E>Q: {question}\nA:', ice_token='</E>')
+    inferencer = CLPInferencer(model=model, batch_size=2)
+    preds = inferencer.inference(ZeroRetriever(_choice_ds()),
+                                 ice_template=tpl,
+                                 output_json_filepath=str(tmp_path))
+    assert len(preds) == 2
+    for probs in preds:
+        assert len(probs) == 2
+        assert abs(sum(probs) - 1.0) < 1e-6
+    # canned low-ppl choices dominate
+    assert np.argmax(preds[0]) == 0  # yes
+    assert np.argmax(preds[1]) == 1  # no
+    out = json.load(open(tmp_path / 'predictions'))
+    assert out['0']['choices'] == ['yes', 'no']
+    assert 'pred_label' in out['0']
+
+
+def test_clp_inferencer_with_jax_model(tmp_path):
+    from opencompass_tpu.icl import PromptTemplate, ZeroRetriever
+    from opencompass_tpu.icl.inferencers import CLPInferencer
+    from opencompass_tpu.models import JaxLM
+    model = JaxLM(config='tiny', max_seq_len=128)
+    tpl = PromptTemplate('</E>Q: {question}\nA:', ice_token='</E>')
+    inferencer = CLPInferencer(model=model, batch_size=2)
+    preds = inferencer.inference(ZeroRetriever(_choice_ds()),
+                                 ice_template=tpl,
+                                 output_json_filepath=str(tmp_path))
+    assert len(preds) == 2
+    for probs in preds:
+        assert abs(sum(probs) - 1.0) < 1e-3
+    # deterministic across calls
+    preds2 = inferencer.inference(ZeroRetriever(_choice_ds()),
+                                  ice_template=tpl,
+                                  output_json_filepath=str(tmp_path))
+    assert np.allclose(preds, preds2)
+
+
+class CorpusDS(BaseDataset):
+    @staticmethod
+    def load():
+        train = {
+            'text': ['the cat sat on the mat',
+                     'quantum physics is fascinating',
+                     'dogs love playing fetch',
+                     'the stock market crashed today'],
+            'label': ['a', 'b', 'c', 'd'],
+        }
+        test = {
+            'text': ['a cat on a mat', 'physics of quantum systems'],
+            'label': ['a', 'b'],
+        }
+        return DatasetDict({'train': Dataset.from_dict(train),
+                            'test': Dataset.from_dict(test)})
+
+
+def _corpus_ds():
+    return CorpusDS(reader_cfg=dict(input_columns=['text'],
+                                    output_column='label'))
+
+
+def test_bm25_retriever():
+    from opencompass_tpu.icl.retrievers import BM25Retriever
+    retriever = BM25Retriever(_corpus_ds(), ice_num=2)
+    ids = retriever.retrieve()
+    assert len(ids) == 2
+    assert ids[0][0] == 0  # cat/mat doc is the lexical match
+    assert ids[1][0] == 1  # quantum physics doc
+
+
+def test_topk_retriever_hashed_bow():
+    from opencompass_tpu.icl.retrievers import TopkRetriever
+    retriever = TopkRetriever(_corpus_ds(), ice_num=2)
+    ids = retriever.retrieve()
+    assert len(ids) == 2 and all(len(r) == 2 for r in ids)
+    assert ids[0][0] == 0
+    assert ids[1][0] == 1
+
+
+def test_mdl_retriever_with_fake_metric():
+    from opencompass_tpu.icl.retrievers import MDLRetriever
+    metric = FakeModel(canned_ppls={'cat': 0.5})
+    retriever = MDLRetriever(_corpus_ds(), ice_num=1, candidate_num=3,
+                             select_time=3, metric_model=metric)
+    ids = retriever.retrieve()
+    assert len(ids) == 2 and all(len(r) == 1 for r in ids)
+
+
+def test_votek_and_dpp_retrievers():
+    from opencompass_tpu.icl.retrievers import DPPRetriever, VotekRetriever
+    votek = VotekRetriever(_corpus_ds(), ice_num=2, votek_k=2)
+    ids = votek.retrieve()
+    assert len(ids) == 2
+    assert ids[0] == ids[1]  # shared fixed set
+    assert len(set(ids[0])) == 2
+    dpp = DPPRetriever(_corpus_ds(), ice_num=2, candidate_num=3)
+    ids = dpp.retrieve()
+    assert len(ids) == 2
+    for row in ids:
+        assert len(set(row)) == len(row) <= 2
+
+
+def test_openai_role_mapping_and_request():
+    from opencompass_tpu.models.openai_api import OpenAI
+    from opencompass_tpu.utils.prompt import PromptList
+    model = OpenAI(path='gpt-test', key='sk-fake', query_per_second=100)
+    msgs = model._to_messages(PromptList([
+        dict(role='SYSTEM', prompt='be brief'),
+        dict(role='HUMAN', prompt='hi'),
+        dict(role='BOT', prompt='hello'),
+    ]))
+    assert [m['role'] for m in msgs] == ['system', 'user', 'assistant']
+
+    response = mock.MagicMock()
+    response.read.return_value = json.dumps({
+        'choices': [{'message': {'content': ' pong '}}]}).encode()
+    response.__enter__ = lambda s: response
+    response.__exit__ = mock.MagicMock(return_value=False)
+    with mock.patch('urllib.request.urlopen', return_value=response) as m:
+        out = model.generate(['ping'], max_out_len=16)
+    assert out == ['pong']
+    sent = json.loads(m.call_args[0][0].data)
+    assert sent['model'] == 'gpt-test'
+    assert sent['messages'] == [{'role': 'user', 'content': 'ping'}]
+
+
+def test_openai_returns_empty_on_failure():
+    from opencompass_tpu.models.openai_api import OpenAI
+    model = OpenAI(path='gpt-test', key='sk-fake', retry=0,
+                   query_per_second=100)
+    with mock.patch('urllib.request.urlopen',
+                    side_effect=OSError('no network')):
+        out = model.generate(['ping'], max_out_len=4)
+    assert out == ['']

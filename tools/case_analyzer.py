@@ -1,0 +1,92 @@
+"""Bad-case extraction: join predictions + results, write markdown reports
+of the wrong cases per (model, dataset).
+
+Parity: reference tools/case_analyzer.py:37-194 ('BadcaseShower').
+
+    python tools/case_analyzer.py configs/eval_demo.py -w outputs/demo/<ts>
+"""
+import argparse
+import json
+import os
+import os.path as osp
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from opencompass_tpu.config import Config  # noqa: E402
+from opencompass_tpu.registry import TEXT_POSTPROCESSORS  # noqa: E402
+from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,  # noqa: E402
+                                        model_abbr_from_cfg)
+from opencompass_tpu.utils.build import build_dataset_from_cfg  # noqa: E402
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(description='Extract bad cases')
+    parser.add_argument('config', help='config file path')
+    parser.add_argument('-w', '--work-dir', required=True,
+                        help='the timestamped run directory')
+    parser.add_argument('-o', '--out-dir', default=None,
+                        help='report output dir (default {work_dir}/badcase)')
+    return parser.parse_args()
+
+
+def _norm(eval_cfg, value, key):
+    if key in eval_cfg:
+        cfg = dict(eval_cfg[key])
+        proc = cfg.pop('type')
+        if isinstance(proc, str):
+            proc = TEXT_POSTPROCESSORS.get(proc)
+        if proc:
+            return proc(str(value), **cfg)
+    return str(value)
+
+
+def analyze(model_cfg, dataset_cfg, work_dir, out_dir):
+    m_abbr = model_abbr_from_cfg(model_cfg)
+    d_abbr = dataset_abbr_from_cfg(dataset_cfg)
+    pred_path = osp.join(work_dir, 'predictions', m_abbr, f'{d_abbr}.json')
+    if not osp.exists(pred_path):
+        return None
+    with open(pred_path) as f:
+        preds = json.load(f)
+
+    dataset = build_dataset_from_cfg(dataset_cfg)
+    out_col = dataset_cfg['reader_cfg']['output_column']
+    refs = dataset.test[out_col] if out_col else []
+    eval_cfg = dataset_cfg.get('eval_cfg', {})
+
+    lines = [f'# Bad cases: {m_abbr} / {d_abbr}', '']
+    n_bad = 0
+    for i in range(len(preds)):
+        rec = preds[str(i)]
+        pred = rec.get('prediction')
+        if isinstance(pred, list):  # condprob vector
+            continue
+        gold = refs[i] if i < len(refs) else None
+        if _norm(eval_cfg, pred, 'pred_postprocessor') == \
+                _norm(eval_cfg, gold, 'dataset_postprocessor'):
+            continue
+        n_bad += 1
+        lines += [f'## case {i}', '### prompt', '```',
+                  str(rec.get('origin_prompt', ''))[:2000], '```',
+                  f'### prediction\n`{pred}`', f'### gold\n`{gold}`', '']
+    os.makedirs(out_dir, exist_ok=True)
+    report = osp.join(out_dir, f'{m_abbr}_{d_abbr}.md')
+    with open(report, 'w') as f:
+        f.write('\n'.join(lines))
+    print(f'{m_abbr}/{d_abbr}: {n_bad} bad cases → {report}')
+    return report
+
+
+def main():
+    args = parse_args()
+    cfg = Config.fromfile(args.config)
+    out_dir = args.out_dir or osp.join(args.work_dir, 'badcase')
+    for model_cfg in cfg.get('models', []):
+        for dataset_cfg in cfg.get('datasets', []):
+            analyze(model_cfg, dataset_cfg, args.work_dir, out_dir)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,107 @@
+"""Render the exact prompts a config would produce — without running
+inference.
+
+Parity: reference tools/prompt_viewer.py:16-217 (minus the curses menu; use
+``-p pattern`` to filter datasets, ``-a`` for all, ``-n count`` for how many
+prompts per dataset).
+
+    python tools/prompt_viewer.py configs/eval_demo.py -a -n 2
+"""
+import argparse
+import fnmatch
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from opencompass_tpu.config import Config  # noqa: E402
+from opencompass_tpu.registry import (ICL_PROMPT_TEMPLATES,  # noqa: E402
+                                      ICL_RETRIEVERS)
+from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,  # noqa: E402
+                                        model_abbr_from_cfg)
+from opencompass_tpu.utils.build import (build_dataset_from_cfg,  # noqa: E402
+                                         build_model_from_cfg)
+
+
+def parse_args():
+    parser = argparse.ArgumentParser(
+        description='View the prompts an eval config will produce')
+    parser.add_argument('config', help='config file path')
+    parser.add_argument('-a', '--all', action='store_true',
+                        help='show all datasets (default: first)')
+    parser.add_argument('-p', '--pattern', type=str,
+                        help='fnmatch pattern over dataset abbrs')
+    parser.add_argument('-n', '--count', type=int, default=1,
+                        help='prompts to display per dataset')
+    return parser.parse_args()
+
+
+def render_prompts(model_cfg, dataset_cfg, count: int):
+    infer_cfg = dataset_cfg['infer_cfg']
+    dataset = build_dataset_from_cfg(dataset_cfg)
+    model_cfg = dict(model_cfg)
+    model_cfg['tokenizer_only'] = True
+    try:
+        model = build_model_from_cfg(model_cfg)
+    except Exception:
+        from opencompass_tpu.models import FakeModel
+        model = FakeModel()
+
+    ice_template = prompt_template = None
+    if 'ice_template' in infer_cfg:
+        ice_template = ICL_PROMPT_TEMPLATES.build(infer_cfg['ice_template'])
+    if 'prompt_template' in infer_cfg:
+        prompt_template = ICL_PROMPT_TEMPLATES.build(
+            infer_cfg['prompt_template'])
+    retriever_cfg = dict(infer_cfg['retriever'])
+    retriever_cfg['dataset'] = dataset
+    retriever = ICL_RETRIEVERS.build(retriever_cfg)
+
+    fix_id_list = infer_cfg.get('inferencer', {}).get('fix_id_list')
+    ice_idx_list = retriever.retrieve(fix_id_list) if fix_id_list \
+        else retriever.retrieve()
+
+    inferencer_type = str(infer_cfg.get('inferencer', {}).get('type', ''))
+    mode = 'ppl' if 'PPL' in inferencer_type else 'gen'
+    for idx in range(min(count, len(ice_idx_list))):
+        ice = retriever.generate_ice(ice_idx_list[idx],
+                                     ice_template=ice_template)
+        if mode == 'ppl':
+            labels = retriever.get_labels(ice_template=ice_template,
+                                          prompt_template=prompt_template)
+            for label in labels:
+                prompt = retriever.generate_label_prompt(
+                    idx, ice, label, ice_template=ice_template,
+                    prompt_template=prompt_template)
+                print(f'---------- [{idx}] label: {label} ----------')
+                print(model.parse_template(prompt, mode='ppl'))
+        else:
+            prompt = retriever.generate_prompt_for_generate_task(
+                idx, ice, ice_template=ice_template,
+                prompt_template=prompt_template)
+            print(f'---------- [{idx}] ----------')
+            print(model.parse_template(prompt, mode='gen'))
+
+
+def main():
+    args = parse_args()
+    cfg = Config.fromfile(args.config)
+    datasets = cfg['datasets']
+    if args.pattern:
+        datasets = [d for d in datasets if fnmatch.fnmatch(
+            dataset_abbr_from_cfg(d), args.pattern)]
+    elif not args.all:
+        datasets = datasets[:1]
+    if not datasets:
+        raise SystemExit('no datasets match')
+    model_cfg = cfg['models'][0] if cfg.get('models') else {}
+    for dataset_cfg in datasets:
+        abbr = dataset_abbr_from_cfg(dataset_cfg)
+        model_abbr = model_abbr_from_cfg(model_cfg) if model_cfg else '-'
+        print(f'========== {model_abbr} / {abbr} ==========')
+        render_prompts(model_cfg, dataset_cfg, args.count)
+
+
+if __name__ == '__main__':
+    main()

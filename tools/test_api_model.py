@@ -1,0 +1,51 @@
+"""Smoke-test an API model config: template parsing + a few short
+generations.
+
+Parity: reference tools/test_api_model.py:156-206.
+
+    python tools/test_api_model.py configs/models/openai_gpt4.py [-n 2]
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from opencompass_tpu.config import Config  # noqa: E402
+from opencompass_tpu.utils.abbr import model_abbr_from_cfg  # noqa: E402
+from opencompass_tpu.utils.build import build_model_from_cfg  # noqa: E402
+from opencompass_tpu.utils.prompt import PromptList  # noqa: E402
+
+PROBES = [
+    'Hello! Reply with one word.',
+    PromptList([
+        dict(role='HUMAN', prompt='What is 2+2? Answer with a digit.'),
+    ]),
+]
+
+
+def main():
+    parser = argparse.ArgumentParser(description='API model smoke test')
+    parser.add_argument('config', help='model config file')
+    parser.add_argument('-n', type=int, default=2,
+                        help='number of probe prompts')
+    args = parser.parse_args()
+
+    cfg = Config.fromfile(args.config)
+    for model_cfg in cfg['models']:
+        abbr = model_abbr_from_cfg(model_cfg)
+        print(f'=== {abbr} ===')
+        model = build_model_from_cfg(model_cfg)
+        for probe in PROBES[:args.n]:
+            parsed = model.parse_template(probe, mode='gen')
+            print(f'--- parsed prompt ---\n{parsed}')
+            try:
+                out = model.generate_from_template([probe], max_out_len=16)
+                print(f'--- response ---\n{out[0]!r}')
+            except Exception as exc:  # noqa: BLE001 — smoke tool
+                print(f'--- request failed: {exc}')
+
+
+if __name__ == '__main__':
+    main()
