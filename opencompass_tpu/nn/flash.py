@@ -1,0 +1,73 @@
+"""Pallas flash-attention for the TPU full-sequence path.
+
+Wraps JAX's bundled TPU Pallas kernel
+(jax.experimental.pallas.ops.tpu.flash_attention): blockwise softmax
+accumulation in VMEM instead of materializing the (S, S) score matrix in
+HBM — the reference never needed this because torch/cuda handled attention
+inside `transformers` (reference opencompass/models/huggingface.py:201-226).
+Used for PPL-scoring forwards when shapes are kernel-friendly; padding is
+expressed through segment ids (pads get segment 0, real tokens 1) so the
+kernel's causal+segment masking reproduces `_attention`'s mask exactly for
+right-padded batches.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+@functools.cache
+def _kernel():
+    try:
+        from jax.experimental.pallas.ops.tpu import flash_attention as fa
+        return fa
+    except ImportError:  # pragma: no cover
+        return None
+
+
+def flash_supported(num_heads: int, num_kv_heads: int, head_dim: int,
+                    seq_len: int) -> bool:
+    """Conservative gate: TPU platform, MXU-friendly head_dim, block-sized
+    sequence, and a head count GQA can be expanded to."""
+    if _kernel() is None:
+        return False
+    try:
+        platform = jax.devices()[0].platform
+    except RuntimeError:
+        return False
+    if platform != 'tpu':
+        return False
+    return (head_dim % 128 == 0 and seq_len % 128 == 0
+            and num_heads % num_kv_heads == 0)
+
+
+def flash_attention(q, k, v, pad_mask, scale: float):
+    """q: (B, T, H, hd); k/v: (B, T, K, hd); pad_mask: (B, T) bool.
+    Returns (B, T, H, hd).  Causal; pads contribute nothing to real rows."""
+    fa = _kernel()
+    B, T, H, hd = q.shape
+    K = k.shape[2]
+    if K != H:  # expand grouped KV heads for the kernel
+        k = jnp.repeat(k, H // K, axis=2)
+        v = jnp.repeat(v, H // K, axis=2)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    seg = pad_mask.astype(jnp.int32)
+    segment_ids = fa.SegmentIds(q=seg, kv=seg)
+    block = min(512, T)
+    sizes = fa.BlockSizes(
+        block_q=block, block_k_major=block, block_k=block, block_b=1,
+        block_q_major_dkv=block, block_k_major_dkv=block,
+        block_k_dkv=block, block_q_dkv=block,
+        block_k_major_dq=block, block_k_dq=block, block_q_dq=block)
+    out = fa.flash_attention(qt, kt, vt, segment_ids=segment_ids,
+                             causal=True, sm_scale=scale,
+                             block_sizes=sizes)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

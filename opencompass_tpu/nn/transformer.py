@@ -296,13 +296,15 @@ def _unembed(params, cfg: TransformerConfig, x):
 # ---------------------------------------------------------------------------
 
 def forward(params: Params, cfg: TransformerConfig, tokens: jax.Array,
-            pad_mask: Optional[jax.Array] = None) -> jax.Array:
+            pad_mask: Optional[jax.Array] = None,
+            use_flash: bool = True) -> jax.Array:
     """Full-sequence causal forward → fp32 logits (B, S, V).
 
     ``pad_mask`` (B, S) marks real tokens (right- or left-padding both work:
     positions are per-example cumulative counts of real tokens, pads cannot
     be attended to).  This is the PPL path (reference huggingface.py:254-293
-    equivalent measurement).
+    equivalent measurement).  On TPU with kernel-friendly shapes the
+    attention runs through the Pallas flash kernel (nn/flash.py).
     """
     B, S = tokens.shape
     if pad_mask is None:
@@ -310,10 +312,22 @@ def forward(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     pad_mask = pad_mask.astype(jnp.bool_)
     positions = jnp.cumsum(pad_mask, axis=-1) - 1
     positions = jnp.maximum(positions, 0)
+
+    attn_fn = None
+    if use_flash:
+        from .flash import flash_attention as _flash
+        from .flash import flash_supported
+        if flash_supported(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, S):
+            scale = cfg.head_dim ** -0.5
+
+            def attn_fn(q, k, v):
+                return _flash(q, k, v, pad_mask, scale)
+
     causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
     mask = causal[None, :, :] & pad_mask[:, None, :]
     x = _embed(params, cfg, tokens, positions)
-    x, _ = _stack(cfg, x, params['layers'], positions, mask)
+    x, _ = _stack(cfg, x, params['layers'], positions, mask,
+                  attn_fn=attn_fn)
     return _unembed(params, cfg, x)
 
 
