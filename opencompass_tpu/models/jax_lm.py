@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import os
+from collections import OrderedDict
 from typing import Dict, List, Optional, Union
 
 import jax
@@ -95,7 +96,13 @@ class JaxLM(BaseModel):
             vocab_size=self.cfg.vocab_size if self.cfg else 512)
         if self.eos_token_id is None:
             self.eos_token_id = self.tokenizer.eos_token_id
+        # token-id LRU shared by get_token_len and _encode_batch so the
+        # truncation loop's counting pass tokenizes each prompt once; the
+        # id lists are bounded (shrink-loop variants would otherwise pile up
+        # GBs over a 100k-sample task) while the int length cache is not
         self._token_len_cache: Dict[str, int] = {}
+        self._token_ids_cache: 'OrderedDict[str, List[int]]' = OrderedDict()
+        self._ids_cache_max = 8192
         self._gen_fn_cache: Dict[tuple, object] = {}
         self.mesh = None
         self.params = None
@@ -209,12 +216,27 @@ class JaxLM(BaseModel):
 
     # -- BaseModel contract ------------------------------------------------
 
+    def _encode_ids(self, text: str) -> List[int]:
+        """Tokenize with the tokenizer's own specials (BOS for llama-family
+        HF tokenizers), matching the reference's HF-default tokenization
+        (reference models/huggingface.py:142,181,262).  Cached: truncation
+        loops re-count the same shrinking prompts (ADVICE r1)."""
+        ids = self._token_ids_cache.get(text)
+        if ids is None:
+            ids = self.tokenizer.encode(text, add_special_tokens=True)
+            self._token_ids_cache[text] = ids
+            self._token_len_cache[text] = len(ids)
+            if len(self._token_ids_cache) > self._ids_cache_max:
+                self._token_ids_cache.popitem(last=False)
+        else:
+            self._token_ids_cache.move_to_end(text)
+        return ids
+
     def get_token_len(self, prompt: str) -> int:
         prompt = str(prompt)
         n = self._token_len_cache.get(prompt)
         if n is None:
-            n = len(self.tokenizer.encode(prompt))
-            self._token_len_cache[prompt] = n
+            n = len(self._encode_ids(prompt))
         return n
 
     def _encode_batch(self, inputs: List[str], left_pad: bool,
@@ -223,7 +245,7 @@ class JaxLM(BaseModel):
         of shape (bucket_batch, bucket_len).  ``keep`` picks which end
         survives truncation: 'head' (HF-parity default) or 'tail' (for
         scoring at the prompt end, e.g. CLP)."""
-        ids = [self.tokenizer.encode(str(s)) for s in inputs]
+        ids = [self._encode_ids(str(s)) for s in inputs]
         ids = [(row[:max_len] if keep == 'head' else row[-max_len:])
                for row in ids]
         longest = max((len(x) for x in ids), default=1)
@@ -289,7 +311,9 @@ class JaxLM(BaseModel):
         (the CLP measurement — reference icl_clp_inferencer.py:206-223)."""
         choice_ids = []
         for choice in choices:
-            ids = self.tokenizer.encode(str(choice))
+            # no specials here: we want the choice's own first token, not BOS
+            ids = self.tokenizer.encode(str(choice),
+                                        add_special_tokens=False)
             if not ids:
                 raise ValueError(f'choice {choice!r} tokenizes to nothing')
             choice_ids.append(ids[0])

@@ -112,7 +112,11 @@ class OpenAI(BaseAPIModel):
             except Exception as exc:  # noqa: BLE001 — network variance
                 logger.error(f'API request failed: {exc}')
                 time.sleep(1)
-        return ''
+        # fail the task rather than scoring empty predictions as wrong
+        # answers (reference models/openai_api.py raises after its budget)
+        raise RuntimeError(
+            f'OpenAI API request failed after {self.retry + 1} attempts '
+            f'({self.url})')
 
     def get_token_len(self, prompt: str) -> int:
         try:

@@ -141,6 +141,13 @@ class TransformerConfig:
         """Build from a HuggingFace ``config.json`` dict (the same contract
         the reference gets for free from AutoModel; we map explicitly)."""
         mt = (hf.get('model_type') or '').lower()
+        if mt == 'baichuan' and hf.get('num_hidden_layers', 0) >= 40:
+            # Baichuan-13B (40 layers / hidden 5120) uses ALiBi positions,
+            # not RoPE — only the 7B variant is llama-shaped.  Loading it
+            # through the RoPE preset would silently produce wrong logits.
+            raise ValueError(
+                'Baichuan ALiBi variants (13B+) are not supported; only the '
+                'RoPE-based Baichuan-7B maps onto the llama preset')
         if mt in ('llama', 'mistral', 'internlm', 'internlm2', 'baichuan'):
             return TransformerConfig.llama(
                 vocab_size=hf['vocab_size'],

@@ -43,8 +43,13 @@ def flash_supported(num_heads: int, num_kv_heads: int, head_dim: int,
         return False
     if platform != 'tpu':
         return False
-    return (head_dim % 128 == 0 and seq_len % 128 == 0
-            and num_heads % num_kv_heads == 0)
+    # the kernel requires seq_len divisible by its block size, which
+    # flash_attention picks as min(512, seq_len) — so 128/256 work whole-seq,
+    # and longer sequences must be multiples of 512 (bucketed lengths like
+    # 640 would crash inside the kernel)
+    block = min(512, seq_len)
+    return (head_dim % 128 == 0 and seq_len % block == 0
+            and seq_len % 128 == 0 and num_heads % num_kv_heads == 0)
 
 
 def flash_attention(q, k, v, pad_mask, scale: float):

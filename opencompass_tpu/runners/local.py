@@ -93,50 +93,57 @@ class LocalRunner(BaseRunner):
         task = self.build_task(task_cfg)
         name = task.name
         chip_ids = self._acquire_slots(task.num_devices)
+        returncode = 1  # dump/get_command failures must not mask as success
         try:
             tmp = tempfile.NamedTemporaryFile(
                 mode='w', suffix='_params.py', delete=False)
             try:
                 task.cfg.dump(tmp.name)
-                cmd = task.get_command(cfg_path=tmp.name,
-                                       template='{task_cmd}')
-                env = dict(os.environ)
-                # make the package importable from any cwd
-                import opencompass_tpu
-                pkg_root = osp.dirname(osp.dirname(opencompass_tpu.__file__))
-                env['PYTHONPATH'] = pkg_root + (
-                    ':' + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
-                if task.num_devices > 0:
-                    env['TPU_VISIBLE_CHIPS'] = ','.join(map(str, chip_ids))
-                else:
-                    # CPU-only task: never contend for the exclusive chip
-                    env['JAX_PLATFORMS'] = 'cpu'
-                    env.pop('PALLAS_AXON_POOL_IPS', None)
-                log_path = task.get_log_path('out')
-                os.makedirs(osp.dirname(log_path), exist_ok=True)
-                self.logger.info(f'launch {name} (devices={chip_ids})')
-                with open(log_path, 'w') as log_file:
-                    result = subprocess.run(cmd, shell=True, text=True,
-                                            stdout=log_file,
-                                            stderr=subprocess.STDOUT,
-                                            env=env)
-                returncode = result.returncode
-                missing = [p for p in task.get_output_paths()
-                           if not osp.exists(p)]
-                if returncode == 0 and missing:
-                    self.logger.warning(
-                        f'{name}: exit 0 but outputs missing: '
-                        f'{missing[:3]}')
-                    returncode = 1
-                if returncode != 0:
-                    self.logger.warning(
-                        f'task {name} failed with code {returncode}; '
-                        f'see {log_path}')
+                returncode = self._run_task(task, name, tmp.name, chip_ids)
             finally:
                 if self.keep_tmp_file:
                     self.logger.info(f'task cfg kept at {tmp.name}')
                 else:
                     os.unlink(tmp.name)
+        except Exception:
+            # one bad task must not crash the pool and its sibling tasks
+            self.logger.exception(f'task {name} failed to launch')
         finally:
             self._release_slots(chip_ids)
         return name, returncode
+
+    def _run_task(self, task, name: str, cfg_path: str,
+                  chip_ids: List[int]) -> int:
+        cmd = task.get_command(cfg_path=cfg_path, template='{task_cmd}')
+        env = dict(os.environ)
+        # make the package importable from any cwd
+        import opencompass_tpu
+        pkg_root = osp.dirname(osp.dirname(opencompass_tpu.__file__))
+        env['PYTHONPATH'] = pkg_root + (
+            ':' + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+        if task.num_devices > 0:
+            env['TPU_VISIBLE_CHIPS'] = ','.join(map(str, chip_ids))
+        else:
+            # CPU-only task: never contend for the exclusive chip
+            env['JAX_PLATFORMS'] = 'cpu'
+            env.pop('PALLAS_AXON_POOL_IPS', None)
+        log_path = task.get_log_path('out')
+        os.makedirs(osp.dirname(log_path), exist_ok=True)
+        self.logger.info(f'launch {name} (devices={chip_ids})')
+        with open(log_path, 'w') as log_file:
+            result = subprocess.run(cmd, shell=True, text=True,
+                                    stdout=log_file,
+                                    stderr=subprocess.STDOUT,
+                                    env=env)
+        returncode = result.returncode
+        missing = [p for p in task.get_output_paths()
+                   if not osp.exists(p)]
+        if returncode == 0 and missing:
+            self.logger.warning(
+                f'{name}: exit 0 but outputs missing: {missing[:3]}')
+            returncode = 1
+        if returncode != 0:
+            self.logger.warning(
+                f'task {name} failed with code {returncode}; '
+                f'see {log_path}')
+        return returncode

@@ -162,11 +162,12 @@ def test_openai_role_mapping_and_request():
     assert sent['messages'] == [{'role': 'user', 'content': 'ping'}]
 
 
-def test_openai_returns_empty_on_failure():
+def test_openai_raises_after_retry_budget():
+    # a dead endpoint must fail the task, not score '' as a wrong answer
     from opencompass_tpu.models.openai_api import OpenAI
     model = OpenAI(path='gpt-test', key='sk-fake', retry=0,
                    query_per_second=100)
     with mock.patch('urllib.request.urlopen',
                     side_effect=OSError('no network')):
-        out = model.generate(['ping'], max_out_len=4)
-    assert out == ['']
+        with pytest.raises(RuntimeError, match='failed after 1 attempts'):
+            model.generate(['ping'], max_out_len=4)

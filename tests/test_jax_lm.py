@@ -21,8 +21,27 @@ def test_bucketing():
 
 def test_get_token_len(lm):
     n = lm.get_token_len('hello world')
-    assert n == len('hello world'.encode())  # byte tokenizer
+    # byte tokenizer + BOS (HF-default tokenization parity: llama-family
+    # tokenizers prepend BOS, so counting must include specials)
+    assert n == len('hello world'.encode()) + 1
     assert lm.get_token_len('hello world') == n  # cached
+
+
+def test_tokenize_once_per_prompt():
+    # the truncation loop counts tokens, then _encode_batch ships the same
+    # strings — the shared id cache must keep it to one encode per prompt
+    lm = JaxLM(config='tiny', max_seq_len=256)
+    calls = []
+    inner_encode = lm.tokenizer.encode
+    lm.tokenizer.encode = lambda text, **kw: (calls.append(text),
+                                              inner_encode(text, **kw))[1]
+    prompts = ['alpha beta', 'gamma delta']
+    for p in prompts:
+        lm.get_token_len(p)
+    lm.get_ppl(prompts)
+    lm.get_ppl(prompts)
+    assert calls.count('alpha beta') == 1
+    assert calls.count('gamma delta') == 1
 
 
 def test_get_ppl_deterministic_and_ranked(lm):
