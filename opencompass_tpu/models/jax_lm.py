@@ -22,6 +22,7 @@ Design points (SURVEY.md §7):
 from __future__ import annotations
 
 import functools
+import hashlib
 import os
 from collections import OrderedDict
 from typing import Dict, List, Optional, Union
@@ -97,12 +98,15 @@ class JaxLM(BaseModel):
         if self.eos_token_id is None:
             self.eos_token_id = self.tokenizer.eos_token_id
         # token-id LRU shared by get_token_len and _encode_batch so the
-        # truncation loop's counting pass tokenizes each prompt once; the
-        # id lists are bounded (shrink-loop variants would otherwise pile up
-        # GBs over a 100k-sample task) while the int length cache is not
-        self._token_len_cache: Dict[str, int] = {}
-        self._token_ids_cache: 'OrderedDict[str, List[int]]' = OrderedDict()
+        # truncation loop's counting pass tokenizes each prompt once.
+        # Both caches key on a string digest and are bounded: full prompt
+        # strings or unbounded growth would pile up GBs over a 100k-sample
+        # task (prompts can be KBs each, shrink loops multiply variants).
+        self._token_len_cache: 'OrderedDict[bytes, int]' = OrderedDict()
+        self._token_ids_cache: 'OrderedDict[bytes, List[int]]' = \
+            OrderedDict()
         self._ids_cache_max = 8192
+        self._len_cache_max = 1_000_000
         self._gen_fn_cache: Dict[tuple, object] = {}
         self.mesh = None
         self.params = None
@@ -216,25 +220,33 @@ class JaxLM(BaseModel):
 
     # -- BaseModel contract ------------------------------------------------
 
+    @staticmethod
+    def _cache_key(text: str) -> bytes:
+        return hashlib.blake2b(text.encode('utf-8'),
+                               digest_size=16).digest()
+
     def _encode_ids(self, text: str) -> List[int]:
         """Tokenize with the tokenizer's own specials (BOS for llama-family
         HF tokenizers), matching the reference's HF-default tokenization
         (reference models/huggingface.py:142,181,262).  Cached: truncation
         loops re-count the same shrinking prompts (ADVICE r1)."""
-        ids = self._token_ids_cache.get(text)
+        key = self._cache_key(text)
+        ids = self._token_ids_cache.get(key)
         if ids is None:
             ids = self.tokenizer.encode(text, add_special_tokens=True)
-            self._token_ids_cache[text] = ids
-            self._token_len_cache[text] = len(ids)
+            self._token_ids_cache[key] = ids
             if len(self._token_ids_cache) > self._ids_cache_max:
                 self._token_ids_cache.popitem(last=False)
+            self._token_len_cache[key] = len(ids)
+            if len(self._token_len_cache) > self._len_cache_max:
+                self._token_len_cache.popitem(last=False)
         else:
-            self._token_ids_cache.move_to_end(text)
+            self._token_ids_cache.move_to_end(key)
         return ids
 
     def get_token_len(self, prompt: str) -> int:
         prompt = str(prompt)
-        n = self._token_len_cache.get(prompt)
+        n = self._token_len_cache.get(self._cache_key(prompt))
         if n is None:
             n = len(self._encode_ids(prompt))
         return n

@@ -66,9 +66,16 @@ class OpenAI(BaseAPIModel):
     def generate(self, inputs: List[PromptType],
                  max_out_len: int = 512) -> List[str]:
         with ThreadPoolExecutor() as executor:
-            return list(
-                executor.map(self._generate, inputs,
-                             [max_out_len] * len(inputs)))
+            futures = [executor.submit(self._generate, p, max_out_len)
+                       for p in inputs]
+            try:
+                return [f.result() for f in futures]
+            except Exception:
+                # fail fast: a dead endpoint must not burn the full retry
+                # budget on every queued prompt before the task fails
+                for f in futures:
+                    f.cancel()
+                raise
 
     def _to_messages(self, prompt: PromptType) -> List[Dict]:
         if isinstance(prompt, str):

@@ -29,6 +29,8 @@ from .config import TransformerConfig
 logger = get_logger()
 
 # (path-in-pytree, needs_transpose). `L` in the regex is the layer index.
+# q/k/v weights keep torch's (out, in) orientation — the pytree convention
+# (transformer._linear_nt); other projections store (in, out).
 _LLAMA_MAP = {
     r'model\.embed_tokens\.weight': (('embed',), False),
     r'model\.layers\.(\d+)\.input_layernorm\.weight':
@@ -36,11 +38,11 @@ _LLAMA_MAP = {
     r'model\.layers\.(\d+)\.post_attention_layernorm\.weight':
         (('layers', 'mlp_norm', 'scale'), False),
     r'model\.layers\.(\d+)\.self_attn\.q_proj\.weight':
-        (('layers', 'q', 'w'), True),
+        (('layers', 'q', 'w'), False),
     r'model\.layers\.(\d+)\.self_attn\.k_proj\.weight':
-        (('layers', 'k', 'w'), True),
+        (('layers', 'k', 'w'), False),
     r'model\.layers\.(\d+)\.self_attn\.v_proj\.weight':
-        (('layers', 'v', 'w'), True),
+        (('layers', 'v', 'w'), False),
     r'model\.layers\.(\d+)\.self_attn\.o_proj\.weight':
         (('layers', 'o', 'w'), True),
     r'model\.layers\.(\d+)\.self_attn\.q_proj\.bias':
@@ -75,11 +77,11 @@ _OPT_MAP = {
     r'(?:model\.)?decoder\.layers\.(\d+)\.final_layer_norm\.bias':
         (('layers', 'mlp_norm', 'bias'), False),
     r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.q_proj\.weight':
-        (('layers', 'q', 'w'), True),
+        (('layers', 'q', 'w'), False),
     r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.k_proj\.weight':
-        (('layers', 'k', 'w'), True),
+        (('layers', 'k', 'w'), False),
     r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.v_proj\.weight':
-        (('layers', 'v', 'w'), True),
+        (('layers', 'v', 'w'), False),
     r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.out_proj\.weight':
         (('layers', 'o', 'w'), True),
     r'(?:model\.)?decoder\.layers\.(\d+)\.self_attn\.q_proj\.bias':
@@ -221,7 +223,8 @@ def _iter_checkpoint_tensors(path: str):
 def _split_fused_qkv(layers: Dict, cfg: TransformerConfig):
     """Split family-specific fused QKV projections into q/k/v.
 
-    All fused weights arrive here already transposed to (L, in, fused_out).
+    All fused weights arrive here already transposed to (L, in, fused_out);
+    the split q/k/v are re-transposed to the canonical (L, out, in).
     - ``_qkv``: GPT-2 c_attn, [D q | D k | D v].
     - ``_qkv_mqa``: Falcon, [n_head*hd q | hd k | hd v].
     - ``_wqkv_grouped``: InternLM2, per-kv-group [ratio q heads | k | v].
@@ -229,12 +232,16 @@ def _split_fused_qkv(layers: Dict, cfg: TransformerConfig):
     """
     hd, H, K = cfg.head_dim, cfg.num_heads, cfg.num_kv_heads
     D = cfg.hidden_size
+
+    def _nt(a):  # (L, in, out) slice -> canonical (L, out, in)
+        return np.ascontiguousarray(a.transpose(0, 2, 1))
+
     if '_qkv' in layers or '_wpack' in layers:
         fused = layers.pop('_qkv', None) or layers.pop('_wpack')
         w = fused['w']                      # (L, D, 3D)
-        layers['q'] = {'w': w[:, :, :D]}
-        layers['k'] = {'w': w[:, :, D:2 * D]}
-        layers['v'] = {'w': w[:, :, 2 * D:]}
+        layers['q'] = {'w': _nt(w[:, :, :D])}
+        layers['k'] = {'w': _nt(w[:, :, D:2 * D])}
+        layers['v'] = {'w': _nt(w[:, :, 2 * D:])}
         if 'b' in fused:
             b = fused['b']
             layers['q']['b'] = b[:, :D]
@@ -243,19 +250,17 @@ def _split_fused_qkv(layers: Dict, cfg: TransformerConfig):
     if '_qkv_mqa' in layers:
         w = layers.pop('_qkv_mqa')['w']     # (L, D, (H+2K)*hd)
         q_dim = H * hd
-        layers['q'] = {'w': w[:, :, :q_dim]}
-        layers['k'] = {'w': w[:, :, q_dim:q_dim + K * hd]}
-        layers['v'] = {'w': w[:, :, q_dim + K * hd:]}
+        layers['q'] = {'w': _nt(w[:, :, :q_dim])}
+        layers['k'] = {'w': _nt(w[:, :, q_dim:q_dim + K * hd])}
+        layers['v'] = {'w': _nt(w[:, :, q_dim + K * hd:])}
     if '_wqkv_grouped' in layers:
         w = layers.pop('_wqkv_grouped')['w']  # (L, D, K*(ratio+2)*hd)
         L = w.shape[0]
         ratio = H // K
         g = w.reshape(L, D, K, ratio + 2, hd)
-        layers['q'] = {'w': np.ascontiguousarray(
-            g[:, :, :, :ratio].reshape(L, D, H * hd))}
-        layers['k'] = {'w': np.ascontiguousarray(
-            g[:, :, :, ratio].reshape(L, D, K * hd))}
-        layers['v'] = {'w': np.ascontiguousarray(
+        layers['q'] = {'w': _nt(g[:, :, :, :ratio].reshape(L, D, H * hd))}
+        layers['k'] = {'w': _nt(g[:, :, :, ratio].reshape(L, D, K * hd))}
+        layers['v'] = {'w': _nt(
             g[:, :, :, ratio + 1].reshape(L, D, K * hd))}
 
 

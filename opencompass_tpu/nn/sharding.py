@@ -24,9 +24,11 @@ from .config import TransformerConfig
 # spec for the *last* dims of each weight; leading layer axis added for
 # entries under 'layers'.
 _LAYER_SPECS = {
-    'q': {'w': P(None, 'model'), 'b': P('model')},
-    'k': {'w': P(None, 'model'), 'b': P('model')},
-    'v': {'w': P(None, 'model'), 'b': P('model')},
+    # q/k/v are stored (out, in) — transformer._linear_nt — so the
+    # column-parallel (per-head output) dim is first
+    'q': {'w': P('model', None), 'b': P('model')},
+    'k': {'w': P('model', None), 'b': P('model')},
+    'v': {'w': P('model', None), 'b': P('model')},
     'o': {'w': P('model', None), 'b': P(None)},
     'gate': {'w': P(None, 'model'), 'b': P('model')},
     'up': {'w': P(None, 'model'), 'b': P('model')},

@@ -66,9 +66,10 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
     layers = {
         'attn_norm': norm_p(),
         'mlp_norm': norm_p(),
-        'q': {'w': dense(next(keys), (L, D, Q))},
-        'k': {'w': dense(next(keys), (L, D, KV))},
-        'v': {'w': dense(next(keys), (L, D, KV))},
+        # q/k/v store (out, in) — see _linear_nt for why
+        'q': {'w': dense(next(keys), (L, Q, D), scale=D ** -0.5)},
+        'k': {'w': dense(next(keys), (L, KV, D), scale=D ** -0.5)},
+        'v': {'w': dense(next(keys), (L, KV, D), scale=D ** -0.5)},
         'o': {'w': dense(next(keys), (L, Q, D))},
     }
     if cfg.qkv_bias:
@@ -144,6 +145,23 @@ def _linear(x, p):
     return y
 
 
+def _linear_nt(x, p):
+    """Linear with the weight stored (out, in) — torch/HF orientation.
+
+    q/k/v keep this layout on purpose: the KV-cache decode step prefers the
+    contraction dim minor-most, and storing the weights that way makes the
+    storage layout the preferred layout.  With (in, out) storage, XLA
+    inserts full-stack transposed copies of q/k/v ahead of the decode loop
+    (3 GB of HLO temps at 7B — enough to OOM a 16 GB chip).  The MXU
+    handles the 'NT' contraction in prefill/PPL matmuls natively, so the
+    full-sequence path loses nothing.
+    """
+    y = jnp.einsum('...i,oi->...o', x, p['w'])
+    if 'b' in p:
+        y = y + p['b']
+    return y
+
+
 def _rope(x, positions, theta: float):
     """HF-convention RoPE: rotate halves.  x: (B, T, H, hd)."""
     hd = x.shape[-1]
@@ -181,9 +199,9 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
     here); the default is full masked attention."""
     B, T, D = x.shape
     h = _norm(x, lp['attn_norm'], cfg)
-    q = _linear(h, lp['q']).reshape(B, T, cfg.num_heads, cfg.head_dim)
-    k = _linear(h, lp['k']).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-    v = _linear(h, lp['v']).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    q = _linear_nt(h, lp['q']).reshape(B, T, cfg.num_heads, cfg.head_dim)
+    k = _linear_nt(h, lp['k']).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    v = _linear_nt(h, lp['v']).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
     q = _shard(q, P('data', None, 'model', None))
     k = _shard(k, P('data', None, 'model', None))
     v = _shard(v, P('data', None, 'model', None))

@@ -48,10 +48,11 @@ def test_llama_roundtrip(tmp_path):
     assert cfg.num_kv_heads == 2
     assert params['embed'].shape == (V, D)
     assert params['lm_head'].shape == (D, V)  # transposed
+    # q/k/v keep torch's (out, in) orientation (transformer._linear_nt)
     np.testing.assert_allclose(
         np.asarray(params['layers']['q']['w'][0], np.float32),
-        tensors['model.layers.0.self_attn.q_proj.weight'].T, rtol=1e-2)
-    assert params['layers']['k']['w'].shape == (L, D, kv)
+        tensors['model.layers.0.self_attn.q_proj.weight'], rtol=1e-2)
+    assert params['layers']['k']['w'].shape == (L, kv, D)
 
     # converted params must run through the model
     import jax.numpy as jnp
@@ -90,10 +91,10 @@ def test_gpt2_fused_qkv_split(tmp_path):
     fused = tensors['h.0.attn.c_attn.weight']
     np.testing.assert_allclose(
         np.asarray(params['layers']['q']['w'][0], np.float32),
-        fused[:, :D], rtol=1e-2)
+        fused[:, :D].T, rtol=1e-2)
     np.testing.assert_allclose(
         np.asarray(params['layers']['v']['w'][0], np.float32),
-        fused[:, 2 * D:], rtol=1e-2)
+        fused[:, 2 * D:].T, rtol=1e-2)
     assert 'lm_head' not in params  # tied
 
 
@@ -120,11 +121,11 @@ def test_falcon_mqa_split(tmp_path):
     }
     _write_ckpt(str(tmp_path), hf, tensors)
     cfg, params = convert_checkpoint(str(tmp_path))
-    assert params['layers']['q']['w'].shape == (L, D, H * hd)
-    assert params['layers']['k']['w'].shape == (L, D, hd)
+    assert params['layers']['q']['w'].shape == (L, H * hd, D)
+    assert params['layers']['k']['w'].shape == (L, hd, D)
     np.testing.assert_allclose(
         np.asarray(params['layers']['k']['w'][0], np.float32),
-        fused.T[:, H * hd:(H + 1) * hd], rtol=1e-2)
+        fused[H * hd:(H + 1) * hd, :], rtol=1e-2)
 
 
 def test_unknown_family_raises(tmp_path):
