@@ -1,21 +1,29 @@
-"""Headline benchmark: eval samples/sec/chip on the PPL + generation paths.
+"""Headline benchmark: Llama-7B-class eval throughput per chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
-Workload mirrors the reference's hot loops (SURVEY.md §3.2-3.3): batched
-PPL scoring (one forward + shifted CE per batch — the MMLU/PIQA-style
-ranking path) and batched greedy generation (the GSM8K-style path), on a
-llama-family model in bf16.  The reference publishes no perf numbers
-(BASELINE.md), so ``vs_baseline`` compares against the previous round's
-recorded value when available (BENCH_r*.json), else 1.0.
+Workload mirrors the reference's hot loops (SURVEY.md §3.2-3.3) at the
+BASELINE north-star scale (Llama-7B geometry, random init, bf16):
 
-Run on whatever jax.devices() offers (the driver provides one real TPU
-chip); value is normalized per chip.
+- PPL scoring: one jitted forward + shifted CE per batch — the MMLU/PIQA
+  ranking path.  Reported with achieved TFLOP/s and MFU, flash attention on
+  and off (nn/flash.py Pallas kernel vs einsum attention).
+- Greedy generation: jitted prefill + while-loop KV-cache decode — the
+  GSM8K path.
+
+``vs_baseline``: the reference publishes no perf numbers (BASELINE.md), so
+the baseline is an analytic single-A100-80GB estimate of the same blended
+workload under generous assumptions for the reference stack (50% MFU
+compute, 70% of 2.04TB/s HBM during decode; details in `detail.a100_est`).
+BASELINE.json's north star is >=3x single-A100 samples/sec on a v5e-16;
+tasks are partitioned per chip (runners/local.py), so 16 chips scale this
+per-chip number linearly.
+
+A smaller llama-1024x8 config is also timed for round-over-round
+continuity with BENCH_r01 (detail.small).
 """
-import glob
 import json
 import os
-import re
 import sys
 import time
 
@@ -28,37 +36,58 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from opencompass_tpu.nn import (TransformerConfig, forward, greedy_generate,
                                 init_params, sequence_nll)
 
-# llama-shaped; sized so bench (compile + run) stays under ~3 min on one chip
-CFG = TransformerConfig.llama(
+CFG_7B = TransformerConfig.llama(
+    vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+    num_kv_heads=32, intermediate_size=11008, max_seq_len=2048)
+
+CFG_SMALL = TransformerConfig.llama(
     vocab_size=32000, hidden_size=1024, num_layers=8, num_heads=16,
     num_kv_heads=16, intermediate_size=2816, max_seq_len=2048)
 
-PPL_BATCH, PPL_SEQ, PPL_ITERS = 32, 512, 8
+# peak dense bf16 TFLOP/s per chip, for MFU
+_PEAK_TFLOPS = {'TPU v5 lite': 197.0, 'TPU v5': 459.0, 'TPU v4': 275.0,
+                'TPU v6 lite': 918.0}
+
+PPL_BATCH, PPL_SEQ, PPL_ITERS = 16, 512, 6
 GEN_BATCH, GEN_PROMPT, GEN_NEW = 16, 128, 64
 
 
-def _bench_ppl(params):
+def _param_count(cfg):
+    D, F, L, V = (cfg.hidden_size, cfg.intermediate_size, cfg.num_layers,
+                  cfg.vocab_size)
+    per_layer = D * (cfg.q_dim + 2 * cfg.kv_dim) + cfg.q_dim * D + 3 * D * F
+    return L * per_layer + 2 * V * D
+
+
+def _blend(a, b):
+    """Harmonic blend of the two eval paths (equal sample weight)."""
+    return 2.0 / (1.0 / a + 1.0 / b)
+
+
+def _bench_ppl(params, cfg, iters, use_flash=True, batch=PPL_BATCH):
     @jax.jit
     def step(params, tokens, mask):
-        return sequence_nll(forward(params, CFG, tokens, mask), tokens, mask)
+        logits = forward(params, cfg, tokens, mask, use_flash=use_flash)
+        return sequence_nll(logits, tokens, mask)
 
-    tokens = jnp.ones((PPL_BATCH, PPL_SEQ), jnp.int32)
-    mask = jnp.ones((PPL_BATCH, PPL_SEQ), jnp.bool_)
-    # host fetch (not block_until_ready) to fully drain compile + queue:
-    # some PJRT backends return from block early while work is in flight
+    tokens = jnp.ones((batch, PPL_SEQ), jnp.int32)
+    mask = jnp.ones((batch, PPL_SEQ), jnp.bool_)
+    # host fetch (not block_until_ready) to fully drain compile + queue
     np.asarray(step(params, tokens, mask))
     t0 = time.perf_counter()
-    for _ in range(PPL_ITERS):
+    for _ in range(iters):
         out = step(params, tokens, mask)
     np.asarray(out)
-    dt = time.perf_counter() - t0
-    return PPL_BATCH * PPL_ITERS / dt
+    dt = (time.perf_counter() - t0) / iters
+    samples_per_sec = batch / dt
+    tflops = 2 * _param_count(cfg) * batch * PPL_SEQ / dt / 1e12
+    return samples_per_sec, tflops
 
 
-def _bench_gen(params):
+def _bench_gen(params, cfg):
     @jax.jit
     def step(params, tokens, mask):
-        return greedy_generate(params, CFG, tokens, mask, GEN_NEW,
+        return greedy_generate(params, cfg, tokens, mask, GEN_NEW,
                                eos_token_id=None)[0]
 
     tokens = jnp.ones((GEN_BATCH, GEN_PROMPT), jnp.int32)
@@ -71,45 +100,75 @@ def _bench_gen(params):
     return GEN_BATCH / dt, GEN_BATCH * GEN_NEW / dt
 
 
-def _previous_value():
-    def round_num(path):
-        m = re.search(r'BENCH_r(\d+)\.json$', path)
-        return int(m.group(1)) if m else -1
-
-    best = None
-    for path in sorted(glob.glob(os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), 'BENCH_r*.json')),
-            key=round_num):
-        try:
-            with open(path) as f:
-                rec = json.load(f)
-            if rec.get('unit', '').startswith('samples/sec'):
-                best = rec.get('value', best)
-        except Exception:
-            pass
-    return best
+def _a100_estimate(cfg):
+    """Single-A100-80GB blended samples/sec under generous assumptions."""
+    n = _param_count(cfg)
+    peak, hbm = 312e12, 2.039e12
+    ppl_sps = 0.5 * peak / (2 * n * PPL_SEQ)
+    prefill = 2 * n * GEN_BATCH * GEN_PROMPT / (0.5 * peak)
+    decode = GEN_NEW * (2 * n) / (0.7 * hbm)  # bf16 weights re-read per step
+    gen_sps = GEN_BATCH / (prefill + decode)
+    return {
+        'blended': _blend(ppl_sps, gen_sps),
+        'ppl_samples_per_sec': round(ppl_sps, 2),
+        'gen_samples_per_sec': round(gen_sps, 2),
+        'assumptions': 'A100-80GB SXM, 312 TFLOP/s bf16 at 50% MFU, '
+                       'decode weight-bound at 70% of 2.04 TB/s HBM',
+    }
 
 
 def main():
     n_chips = max(1, len(jax.devices()))
-    params = init_params(CFG, jax.random.PRNGKey(0))
-    ppl_sps = _bench_ppl(params)
-    gen_sps, gen_tps = _bench_gen(params)
-    # headline: harmonic-style blend of the two eval paths, per chip
-    value = 2.0 / (1.0 / ppl_sps + 1.0 / gen_sps) / n_chips
-    prev = _previous_value()
+    kind = getattr(jax.devices()[0], 'device_kind', '')
+    peak = _PEAK_TFLOPS.get(kind)
+
+    # continuity config first (small; freed before the 7B params land);
+    # batch 32 matches BENCH_r01's 'PPL b32xs512' so values are comparable
+    params = init_params(CFG_SMALL, jax.random.PRNGKey(0))
+    small_ppl, _ = _bench_ppl(params, CFG_SMALL, 8, batch=32)
+    small_gen, small_tps = _bench_gen(params, CFG_SMALL)
+    small_value = _blend(small_ppl, small_gen) / n_chips
+    del params
+
+    params = jax.jit(init_params, static_argnums=0)(
+        CFG_7B, jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    ppl_sps, ppl_tflops = _bench_ppl(params, CFG_7B, PPL_ITERS)
+    _, ppl_tflops_noflash = _bench_ppl(params, CFG_7B, PPL_ITERS,
+                                       use_flash=False)
+    gen_sps, gen_tps = _bench_gen(params, CFG_7B)
+
+    value = _blend(ppl_sps, gen_sps) / n_chips
+    a100 = _a100_estimate(CFG_7B)
     record = {
-        'metric': 'eval samples/sec/chip (PPL b32xs512 + gen b16 p128+64, '
-                  'llama-1024x8 bf16)',
+        'metric': 'eval samples/sec/chip (PPL b%dxs%d + gen b%d p%d+%d, '
+                  'llama-7B bf16)' % (PPL_BATCH, PPL_SEQ, GEN_BATCH,
+                                      GEN_PROMPT, GEN_NEW),
         'value': round(value, 3),
         'unit': 'samples/sec/chip',
-        'vs_baseline': round(value / prev, 3) if prev else 1.0,
+        'vs_baseline': round(value / a100['blended'], 3),
         'detail': {
             'ppl_samples_per_sec': round(ppl_sps, 3),
+            'ppl_tflops': round(ppl_tflops, 1),
+            'ppl_mfu': round(ppl_tflops / peak, 3) if peak else None,
+            'ppl_tflops_noflash': round(ppl_tflops_noflash, 1),
+            'flash_speedup': round(ppl_tflops / ppl_tflops_noflash, 3),
             'gen_samples_per_sec': round(gen_sps, 3),
             'gen_tokens_per_sec': round(gen_tps, 1),
+            'params_b': round(_param_count(CFG_7B) / 1e9, 2),
             'n_chips': n_chips,
             'platform': jax.devices()[0].platform,
+            'device_kind': kind,
+            'peak_tflops': peak,
+            'a100_est': a100,
+            'small': {
+                'config': 'llama-1024x8, ppl b32xs512 (BENCH_r01 '
+                          'continuity)',
+                'value': round(small_value, 3),
+                'ppl_samples_per_sec': round(small_ppl, 3),
+                'gen_samples_per_sec': round(small_gen, 3),
+                'gen_tokens_per_sec': round(small_tps, 1),
+            },
         },
     }
     print(json.dumps(record))

@@ -9,6 +9,11 @@ os.environ['JAX_PLATFORMS'] = 'cpu'
 # chip via jax.config.update — which runs before this conftest. Clear the
 # env for subprocesses and override jax.config so tests stay hermetic on
 # the virtual CPU mesh.
+# stash the TPU plugin config so hardware-marked tests can restore it in
+# their subprocess envs (tests themselves stay on the CPU mesh)
+if os.environ.get('PALLAS_AXON_POOL_IPS'):
+    os.environ.setdefault('OC_TPU_AXON_IPS',
+                          os.environ['PALLAS_AXON_POOL_IPS'])
 os.environ.pop('PALLAS_AXON_POOL_IPS', None)
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
