@@ -1,0 +1,26 @@
+"""Multi-host demo: a 2-process JAX group evaluating the demo PPL suite.
+
+    python run.py configs/eval_demo_multihost.py --debug
+
+The runner launches the infer task via tasks/launch.py (the torchrun
+analog): 2 processes form one `jax.distributed` group and shard a tiny
+JaxLM over the combined device mesh; only rank 0 writes predictions.  On
+real TPU pods the cluster scheduler provides the OC_*/SLURM_* process-group
+env instead and `run_cfg.num_procs` matches the host count.
+"""
+with read_base():
+    from .datasets.demo.demo_ppl import demo_ppl_datasets
+
+datasets = [*demo_ppl_datasets]
+
+models = [
+    dict(type='JaxLM',
+         abbr='tiny-multihost',
+         config='tiny',
+         max_seq_len=128,
+         parallel=dict(data=-1, model=1),
+         batch_size=4,
+         run_cfg=dict(num_devices=0, num_procs=2)),
+]
+
+work_dir = './outputs/demo_multihost'

@@ -10,6 +10,7 @@ import os
 import os.path as osp
 from typing import List, Optional
 
+from opencompass_tpu.parallel.distributed import broadcast_object
 from opencompass_tpu.registry import ICL_INFERENCERS
 from opencompass_tpu.utils.logging import get_logger
 
@@ -70,12 +71,18 @@ class GenInferencer(BaseInferencer):
             prompt_template=prompt_template)
 
         # Sample-level resume: pick up from a tmp_ flush of a previous run.
+        # Rank 0 reads the file; the decision is broadcast so every process
+        # in a multi-host group runs the same number of batches.
         index = 0
         tmp_json_filepath = os.path.join(output_json_filepath,
                                          'tmp_' + output_json_filename)
-        if osp.exists(tmp_json_filepath):
-            output_handler.results_dict = load_results_dict(tmp_json_filepath)
-            index = len(output_handler.results_dict)
+        resumed = None
+        if self.is_main_process and osp.exists(tmp_json_filepath):
+            resumed = load_results_dict(tmp_json_filepath)
+        resumed = broadcast_object(resumed)
+        if resumed:
+            output_handler.results_dict = resumed
+            index = len(resumed)
 
         logger.info('Starting inference process...')
         for entry in self.get_batches(prompt_list[index:], self.batch_size):

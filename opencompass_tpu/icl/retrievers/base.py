@@ -12,16 +12,8 @@ from opencompass_tpu.icl.prompt_template import PromptTemplate
 from opencompass_tpu.utils.prompt import PromptList
 
 
-def is_main_process() -> bool:
-    """True on JAX process 0 (replaces mmengine.dist.is_main_process)."""
-    import os
-    for var in ('JAX_PROCESS_INDEX', 'PROCESS_INDEX'):
-        if var in os.environ:
-            try:
-                return int(os.environ[var]) == 0
-            except ValueError:
-                pass
-    return True
+from opencompass_tpu.parallel.distributed import is_main_process  # noqa: F401
+# (re-exported: inferencers/retrievers historically import it from here)
 
 
 class BaseRetriever:

@@ -31,6 +31,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
 from opencompass_tpu.nn import (TransformerConfig, forward, greedy_generate,
                                 init_params, sequence_nll, shard_params)
 from opencompass_tpu.parallel.mesh import MeshSpec, make_mesh, use_mesh
@@ -148,9 +151,19 @@ class JaxLM(BaseModel):
             f.endswith(('.safetensors', '.bin')) for f in os.listdir(path))
         if has_ckpt:
             from opencompass_tpu.nn.hf_convert import convert_checkpoint
-            self.cfg, np_params = convert_checkpoint(path, self.cfg)
-            self.params = jax.tree_util.tree_map(jnp.asarray, np_params)
+            # stays host numpy: _maybe_shard places shards directly, so the
+            # full model never has to fit on a single chip
+            self.cfg, self.params = convert_checkpoint(path, self.cfg)
             logger.info(f'loaded checkpoint from {path}')
+        elif jax.process_count() > 1:
+            if path:
+                logger.warning(f'no weights under {path!r}; random init '
+                               f'(seed={seed})')
+            # host-side init: every process derives the identical pytree
+            # from the seed, then contributes its local shards.  (Must be a
+            # *local* device — jax.devices()[0] may belong to rank 0.)
+            with jax.default_device(jax.local_devices(backend='cpu')[0]):
+                self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
         else:
             if path:
                 logger.warning(f'no weights under {path!r}; random init '
@@ -163,6 +176,9 @@ class JaxLM(BaseModel):
         want = max(1, abs(parallel.get('model', 1)) *
                    abs(parallel.get('seq', 1)))
         if n_dev == 1 and want <= 1:
+            # no mesh: commit host (checkpoint) params to the device once,
+            # rather than re-uploading per jitted call
+            self.params = jax.tree_util.tree_map(jnp.asarray, self.params)
             return
         if parallel.get('model', 1) > 1 and parallel.get('seq', 1) > 1:
             raise ValueError(
@@ -175,6 +191,27 @@ class JaxLM(BaseModel):
         self.params = shard_params(self.params, self.cfg, self.mesh)
         shape = dict(zip(self.mesh.axis_names, self.mesh.devices.shape))
         logger.info(f'mesh: {shape}')
+
+    # -- multi-host array plumbing -----------------------------------------
+
+    def _multihost(self) -> bool:
+        return self.mesh is not None and jax.process_count() > 1
+
+    def _put(self, arr, spec: P):
+        """Host array -> device array.  Across hosts every process holds the
+        same full batch; each contributes the shards its devices own."""
+        if not self._multihost():
+            return jnp.asarray(arr)
+        from opencompass_tpu.parallel.distributed import make_global_array
+        return make_global_array(arr, NamedSharding(self.mesh, spec))
+
+    def _replicate(self, x):
+        """Inside-jit constraint making an output fully replicated, so every
+        host can read it without cross-process gathers afterwards."""
+        if not self._multihost():
+            return x
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(self.mesh, P()))
 
     # -- jitted kernels (cached per static config) -------------------------
 
@@ -189,13 +226,15 @@ class JaxLM(BaseModel):
             @jax.jit
             def ppl(params, tokens, mask, mask_length):
                 logits = ring_forward(params, cfg, tokens, mask, mesh)
-                return sequence_nll(logits, tokens, mask, mask_length)
+                return self._replicate(
+                    sequence_nll(logits, tokens, mask, mask_length))
             return ppl
 
         @jax.jit
         def ppl(params, tokens, mask, mask_length):
             logits = forward(params, cfg, tokens, mask)
-            return sequence_nll(logits, tokens, mask, mask_length)
+            return self._replicate(
+                sequence_nll(logits, tokens, mask, mask_length))
         return ppl
 
     def _gen_fn(self, max_new: int, temperature: float, top_k: int):
@@ -211,10 +250,11 @@ class JaxLM(BaseModel):
 
         @jax.jit
         def gen(params, tokens, mask, rng):
-            return greedy_generate(params, cfg, tokens, mask, max_new,
-                                   eos_token_id=eos, pad_token_id=pad,
-                                   temperature=temperature, top_k=top_k,
-                                   rng=rng)
+            out = greedy_generate(params, cfg, tokens, mask, max_new,
+                                  eos_token_id=eos, pad_token_id=pad,
+                                  temperature=temperature, top_k=top_k,
+                                  rng=rng)
+            return jax.tree_util.tree_map(self._replicate, out)
         self._gen_fn_cache[key] = gen
         return gen
 
@@ -280,7 +320,8 @@ class JaxLM(BaseModel):
             else:
                 tokens[i, :len(row)] = row
                 mask[i, :len(row)] = True
-        return jnp.asarray(tokens), jnp.asarray(mask), ids
+        spec = P('data', None)
+        return self._put(tokens, spec), self._put(mask, spec), ids
 
     def get_ppl(self,
                 inputs: List[str],
@@ -291,7 +332,8 @@ class JaxLM(BaseModel):
             ml = np.zeros((tokens.shape[0],), np.int32)
             if mask_length is not None:
                 ml[:len(mask_length)] = np.asarray(mask_length, np.int32)
-            nll = self._ppl_fn(self.params, tokens, mask, jnp.asarray(ml))
+            nll = self._ppl_fn(self.params, tokens, mask,
+                               self._put(ml, P('data')))
             return np.asarray(nll)[:len(inputs)].tolist()
 
     @functools.cached_property
@@ -313,8 +355,8 @@ class JaxLM(BaseModel):
                 logits = forward(params, cfg, tokens, mask)
             last = jnp.maximum(
                 jnp.sum(mask.astype(jnp.int32), axis=-1) - 1, 0)
-            return jnp.take_along_axis(
-                logits, last[:, None, None], axis=1)[:, 0, :]
+            return self._replicate(jnp.take_along_axis(
+                logits, last[:, None, None], axis=1)[:, 0, :])
         return last_logits
 
     def get_choice_logprobs(self, inputs: List[str],
@@ -355,7 +397,7 @@ class JaxLM(BaseModel):
                 inputs, left_pad=True, max_len=max_prompt)
             fn = self._gen_fn(int(max_out_len), temperature, top_k)
             out, lengths = fn(self.params, tokens, mask,
-                              jax.random.PRNGKey(seed))
+                              self._put(jax.random.PRNGKey(seed), P()))
         out = np.asarray(out)
         lengths = np.asarray(lengths)
         texts = []

@@ -94,6 +94,14 @@ def param_shardings(cfg: TransformerConfig, mesh: Mesh,
 
 
 def shard_params(params: Dict, cfg: TransformerConfig, mesh: Mesh) -> Dict:
-    """Place a (host or single-device) param pytree onto the mesh."""
+    """Place a (host or single-device) param pytree onto the mesh.
+
+    Works for meshes spanning multiple processes: each process holds the
+    full host copy (identical across hosts — same seed or same checkpoint)
+    and contributes the shards its local devices own.  Keep checkpoint
+    params as host numpy until this call — a device detour would need the
+    whole model to fit on one chip.
+    """
+    from opencompass_tpu.parallel.distributed import make_global_array
     shardings = param_shardings(cfg, mesh, params)
-    return jax.tree_util.tree_map(jax.device_put, params, shardings)
+    return jax.tree_util.tree_map(make_global_array, params, shardings)

@@ -8,6 +8,8 @@ parallelism is first-class: a `jax.sharding.Mesh` with ``data`` / ``model`` /
 attention over the ``seq`` axis for long contexts (ring_attention.py).  XLA
 inserts the collectives (psum/all-gather/ppermute) over ICI.
 """
+from .distributed import (init_from_env, is_main_process, process_count,
+                          process_index, shutdown)
 from .mesh import (MeshSpec, make_mesh, use_mesh, current_mesh,
                    current_mesh_axes, local_device_count, manual_axes)
 from .ring_attention import ring_forward
@@ -15,5 +17,6 @@ from .ring_attention import ring_forward
 __all__ = [
     'MeshSpec', 'make_mesh', 'use_mesh', 'current_mesh',
     'current_mesh_axes', 'local_device_count', 'manual_axes',
-    'ring_forward',
+    'ring_forward', 'init_from_env', 'is_main_process', 'process_count',
+    'process_index', 'shutdown',
 ]

@@ -1,0 +1,168 @@
+"""Multi-host process-group initialization (jax.distributed).
+
+The reference scales across hosts with ``torchrun --nproc_per_node`` +
+NCCL process groups consumed by external model code (reference
+tasks/openicl_infer.py:34-40, runners/local.py:119-124) and gates output
+writes on ``mmengine.dist.is_main_process`` (reference
+openicl/icl_inferencer/icl_base_inferencer.py:49).  The TPU-native analog:
+one Python process per host, ``jax.distributed.initialize`` to form the
+global device mesh (collectives ride ICI within a slice, DCN across), and
+``jax.process_index() == 0`` for write gating.
+
+Environment contract (set by tasks/launch.py locally, or by the cluster
+scheduler on real pods):
+
+- ``OC_COORDINATOR``     host:port of process 0 (default 127.0.0.1:29500)
+- ``OC_NUM_PROCESSES``   process-group size
+- ``OC_PROCESS_ID``      this process's rank
+
+Slurm equivalents (``SLURM_NTASKS``/``SLURM_PROCID``) are honored when the
+OC_* variables are absent, so ``srun -n N`` tasks form a group without a
+wrapper.  On Cloud TPU pods with none of these set,
+``jax.distributed.initialize()`` auto-detects from the TPU metadata when
+``OC_AUTO_DISTRIBUTED=1``.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import Optional
+
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+_initialized = False
+
+
+def _env_spec() -> Optional[dict]:
+    if 'OC_NUM_PROCESSES' in os.environ:
+        n = int(os.environ['OC_NUM_PROCESSES'])
+        if n <= 1:
+            return None
+        return dict(
+            coordinator_address=os.environ.get('OC_COORDINATOR',
+                                               '127.0.0.1:29500'),
+            num_processes=n,
+            process_id=int(os.environ.get('OC_PROCESS_ID', '0')))
+    if 'SLURM_NTASKS' in os.environ and 'OC_COORDINATOR' in os.environ:
+        n = int(os.environ['SLURM_NTASKS'])
+        if n <= 1:
+            return None
+        return dict(coordinator_address=os.environ['OC_COORDINATOR'],
+                    num_processes=n,
+                    process_id=int(os.environ.get('SLURM_PROCID', '0')))
+    return None
+
+
+def init_from_env() -> int:
+    """Join the process group described by the environment (idempotent).
+
+    Returns this process's index (0 when single-process).  Must run before
+    the first `jax.devices()` call so the backend sees the global topology.
+    """
+    global _initialized
+    spec = _env_spec()
+    if spec is None and os.environ.get('OC_AUTO_DISTRIBUTED') == '1':
+        spec = {}  # TPU-pod metadata auto-detection
+    if spec is None:
+        return process_index()
+    if _initialized:
+        return process_index()
+    import jax
+    jax.distributed.initialize(**spec)
+    _initialized = True
+    # export for is_main_process()/logging call sites that must not pay a
+    # jax import (subprocesses, log setup before backend init)
+    os.environ.setdefault('JAX_PROCESS_INDEX', str(jax.process_index()))
+    logger.info(f'joined process group: rank {jax.process_index()}/'
+                f'{jax.process_count()}, '
+                f'{len(jax.local_devices())} local / '
+                f'{len(jax.devices())} global devices')
+    return jax.process_index()
+
+
+def shutdown():
+    global _initialized
+    if _initialized:
+        import jax
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    """Rank without forcing backend initialization: env first, then a
+    live jax module if one is already imported and initialized."""
+    for var in ('OC_PROCESS_ID', 'JAX_PROCESS_INDEX', 'PROCESS_INDEX',
+                'SLURM_PROCID'):
+        if var in os.environ:
+            try:
+                return int(os.environ[var])
+            except ValueError:
+                pass
+    jax = sys.modules.get('jax')
+    if jax is not None and _initialized:
+        return jax.process_index()
+    return 0
+
+
+def process_count() -> int:
+    for var in ('OC_NUM_PROCESSES', 'SLURM_NTASKS'):
+        if var in os.environ:
+            try:
+                return max(1, int(os.environ[var]))
+            except ValueError:
+                pass
+    jax = sys.modules.get('jax')
+    if jax is not None and _initialized:
+        return jax.process_count()
+    return 1
+
+
+def is_main_process() -> bool:
+    """True on rank 0 (replaces mmengine.dist.is_main_process)."""
+    return process_index() == 0
+
+
+def broadcast_object(obj):
+    """Rank 0's ``obj`` on every process (identity when not distributed).
+
+    Filesystem-derived control flow (skip-if-output-exists, tmp resume)
+    must be decided once and shared: only rank 0 writes those files, so on
+    pods without a shared work_dir the other ranks would diverge in how
+    many collective calls they make and deadlock the group.
+    """
+    if not _initialized:
+        return obj
+    import pickle
+
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+    if jax.process_index() == 0:
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+    else:
+        payload = np.zeros(0, np.uint8)
+    size = int(multihost_utils.broadcast_one_to_all(
+        np.asarray(payload.size, np.int64)))
+    buf = np.zeros(size, np.uint8)
+    if jax.process_index() == 0:
+        buf[:] = payload
+    buf = multihost_utils.broadcast_one_to_all(buf)
+    return pickle.loads(np.asarray(buf).tobytes())
+
+
+def make_global_array(host_array, sharding):
+    """Place a host array onto a (possibly multi-process) sharding.
+
+    Every process passes the same full host value; each contributes the
+    shards its local devices own.  Single source for this placement logic
+    (used by nn/sharding.shard_params and models/jax_lm.JaxLM).
+    """
+    import jax
+    import numpy as np
+    if jax.process_count() == 1:
+        return jax.device_put(host_array, sharding)
+    host = np.asarray(host_array)
+    return jax.make_array_from_callback(host.shape, sharding,
+                                        lambda idx: host[idx])

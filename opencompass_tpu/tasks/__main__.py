@@ -8,6 +8,7 @@ import argparse
 import time
 
 from opencompass_tpu.config import Config
+from opencompass_tpu.parallel.distributed import init_from_env, shutdown
 from opencompass_tpu.registry import TASKS
 from opencompass_tpu.utils.logging import get_logger
 
@@ -19,6 +20,7 @@ def main():
     args = parser.parse_args()
 
     logger = get_logger()
+    init_from_env()  # join the multi-host group before touching devices
     cls = TASKS.get(args.task_type)
     if cls is None:
         raise SystemExit(f'unknown task type {args.task_type!r}')
@@ -26,7 +28,10 @@ def main():
     task = cls(cfg)
     logger.info(f'Task {task.name}')
     start = time.time()
-    task.run()
+    try:
+        task.run()
+    finally:
+        shutdown()
     logger.info(f'time elapsed: {time.time() - start:.2f}s')
 
 

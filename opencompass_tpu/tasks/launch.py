@@ -1,0 +1,124 @@
+"""Multi-process task launcher — the ``torchrun`` analog.
+
+``python -m opencompass_tpu.tasks.launch --nprocs N [--] cmd args...``
+spawns ``cmd`` N times with the OC_* process-group environment
+(parallel/distributed.py contract) pointing at a local coordinator, streams
+each child's output with a ``[pK]`` prefix, and exits non-zero if any child
+fails.  Reference equivalent: the ``torchrun --master_port=rand
+--nproc_per_node {num_procs}`` command template
+(reference tasks/openicl_infer.py:34-40).
+
+On a single machine this emulates N hosts (each child sees only its local
+devices plus the process group); on a real cluster the scheduler sets the
+OC_*/SLURM_* variables instead and this wrapper is unnecessary.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _stream(proc: subprocess.Popen, rank: int):
+    for line in proc.stdout:
+        sys.stdout.write(f'[p{rank}] {line}')
+        sys.stdout.flush()
+
+
+def _chip_partition(nprocs: int):
+    """Per-rank TPU chip assignments for local emulation, or None.
+
+    Local children would otherwise all try to claim every chip.  Honors an
+    existing TPU_VISIBLE_CHIPS set by the runner's slot allocator.  When
+    chips can't be split evenly (e.g. a single chip shared by 2 procs),
+    returns None — callers should run such groups on CPU devices
+    (JAX_PLATFORMS=cpu) or one-process-per-host where the scheduler owns
+    device visibility.
+    """
+    if os.environ.get('JAX_PLATFORMS', '').startswith('cpu'):
+        return None  # CPU devices are per-process anyway
+    chips = os.environ.get('TPU_VISIBLE_CHIPS')
+    if not chips:
+        return None
+    ids = [c for c in chips.split(',') if c]
+    if len(ids) % nprocs:
+        return None
+    per = len(ids) // nprocs
+    return [','.join(ids[r * per:(r + 1) * per]) for r in range(nprocs)]
+
+
+def launch(nprocs: int, cmd: list, port: int = 0) -> int:
+    port = port or _free_port()
+    chip_split = _chip_partition(nprocs)
+    if (chip_split is None
+            and not os.environ.get('JAX_PLATFORMS', '').startswith('cpu')
+            and os.environ.get('TPU_VISIBLE_CHIPS')):
+        sys.stderr.write(
+            'launch: TPU_VISIBLE_CHIPS not divisible by nprocs; children '
+            'may contend for chips\n')
+    procs, threads = [], []
+    for rank in range(nprocs):
+        env = dict(os.environ)
+        env['OC_COORDINATOR'] = f'127.0.0.1:{port}'
+        env['OC_NUM_PROCESSES'] = str(nprocs)
+        env['OC_PROCESS_ID'] = str(rank)
+        env['JAX_PROCESS_INDEX'] = str(rank)
+        if chip_split is not None:
+            env['TPU_VISIBLE_CHIPS'] = chip_split[rank]
+        proc = subprocess.Popen(cmd, env=env, text=True,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT)
+        procs.append(proc)
+        t = threading.Thread(target=_stream, args=(proc, rank), daemon=True)
+        t.start()
+        threads.append(t)
+
+    # fail fast: one dead rank leaves the rest blocked in collectives, so
+    # kill the survivors instead of hanging until a distributed timeout
+    rc = 0
+    live = list(procs)
+    while live:
+        for proc in list(live):
+            code = proc.poll()
+            if code is None:
+                continue
+            live.remove(proc)
+            rc = rc or code
+            if code != 0:
+                for other in live:
+                    other.terminate()
+        time.sleep(0.2)
+    for t in threads:
+        t.join(timeout=5)
+    return rc
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description='Launch a command as an N-process JAX group')
+    parser.add_argument('--nprocs', type=int, required=True)
+    parser.add_argument('--port', type=int, default=0,
+                        help='coordinator port (default: pick a free one)')
+    parser.add_argument('cmd', nargs=argparse.REMAINDER,
+                        help='command to run per process')
+    args = parser.parse_args()
+    cmd = args.cmd
+    if cmd and cmd[0] == '--':
+        cmd = cmd[1:]
+    if not cmd:
+        raise SystemExit('no command given')
+    raise SystemExit(launch(args.nprocs, cmd, args.port))
+
+
+if __name__ == '__main__':
+    main()

@@ -13,6 +13,8 @@ from __future__ import annotations
 import os.path as osp
 from typing import Any, Dict
 
+from opencompass_tpu.parallel.distributed import (broadcast_object,
+                                                  is_main_process)
 from opencompass_tpu.registry import (ICL_INFERENCERS, ICL_PROMPT_TEMPLATES,
                                       ICL_RETRIEVERS, TASKS)
 from opencompass_tpu.utils.abbr import get_infer_output_path
@@ -36,6 +38,11 @@ class OpenICLInferTask(BaseTask):
                     template: str = '{task_cmd}') -> str:
         task_cmd = ('python -m opencompass_tpu.tasks OpenICLInferTask '
                     f'{cfg_path}')
+        if self.num_procs > 1:
+            # multi-host process group (the reference's `torchrun
+            # --nproc_per_node` analog; one process per host on real pods)
+            task_cmd = (f'python -m opencompass_tpu.tasks.launch '
+                        f'--nprocs {self.num_procs} -- {task_cmd}')
         return template.format(task_cmd=task_cmd)
 
     def run(self):
@@ -52,7 +59,10 @@ class OpenICLInferTask(BaseTask):
                 out_path = get_infer_output_path(
                     model_cfg, dataset_cfg,
                     osp.join(self.work_dir, 'predictions'))
-                if osp.exists(out_path):
+                # rank 0 owns the filesystem view; broadcast so a
+                # multi-host group takes the same skip decision
+                if broadcast_object(osp.exists(out_path)
+                                    if is_main_process() else None):
                     continue
                 self._inference(model, out_path)
 
