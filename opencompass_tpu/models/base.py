@@ -18,6 +18,7 @@ import abc
 from copy import deepcopy
 from typing import Dict, List, Optional, Tuple, Union
 
+from opencompass_tpu.utils.perf import PerfCounters
 from opencompass_tpu.utils.prompt import PromptList
 
 PromptType = Union[PromptList, str]
@@ -237,6 +238,7 @@ class BaseModel(abc.ABC):
         self.tokenizer_only = tokenizer_only
         self.template_parser = LMTemplateParser(meta_template)
         self.generation_kwargs = generation_kwargs or {}
+        self.perf = PerfCounters()
         self.eos_token_id = None
         if meta_template and 'eos_token_id' in meta_template:
             self.eos_token_id = meta_template['eos_token_id']

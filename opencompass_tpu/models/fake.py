@@ -40,6 +40,8 @@ class FakeModel(BaseModel):
         self.canned_ppls = canned_ppls or {}
 
     def generate(self, inputs: List[str], max_out_len: int) -> List[str]:
+        self.perf.samples += len(inputs)
+        self.perf.calls += 1
         out = []
         for prompt in inputs:
             prompt = str(prompt)
@@ -50,11 +52,16 @@ class FakeModel(BaseModel):
             else:
                 digest = hashlib.sha256(prompt.encode()).hexdigest()[:8]
                 out.append(f'fake-{digest}')
+        self.perf.tokens_out += sum(len(o.split()) for o in out)
         return out
 
     def get_ppl(self,
                 inputs: List[str],
                 mask_length: Optional[List[int]] = None) -> List[float]:
+        self.perf.samples += len(inputs)
+        self.perf.calls += 1
+        self.perf.tokens_in += sum(
+            self.get_token_len(str(p)) for p in inputs)
         out = []
         for prompt in inputs:
             prompt = str(prompt)

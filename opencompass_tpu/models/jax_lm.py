@@ -39,6 +39,7 @@ from opencompass_tpu.nn import (TransformerConfig, forward, greedy_generate,
 from opencompass_tpu.parallel.mesh import MeshSpec, make_mesh, use_mesh
 from opencompass_tpu.registry import MODELS
 from opencompass_tpu.utils.logging import get_logger
+from opencompass_tpu.utils.perf import device_call
 
 from .base import BaseModel
 from .tokenizer import load_tokenizer
@@ -332,9 +333,13 @@ class JaxLM(BaseModel):
             ml = np.zeros((tokens.shape[0],), np.int32)
             if mask_length is not None:
                 ml[:len(mask_length)] = np.asarray(mask_length, np.int32)
-            nll = self._ppl_fn(self.params, tokens, mask,
-                               self._put(ml, P('data')))
-            return np.asarray(nll)[:len(inputs)].tolist()
+            with device_call(self.perf,
+                             tokens_in=sum(len(r) for r in ids),
+                             samples=len(inputs)):
+                nll = self._ppl_fn(self.params, tokens, mask,
+                                   self._put(ml, P('data')))
+                out = np.asarray(nll)
+            return out[:len(inputs)].tolist()
 
     @functools.cached_property
     def _choice_logits_fn(self):
@@ -373,11 +378,15 @@ class JaxLM(BaseModel):
             choice_ids.append(ids[0])
         with use_mesh(self.mesh):
             # keep the tail: the choice position is the prompt's end
-            tokens, mask, _ = self._encode_batch(
+            tokens, mask, ids = self._encode_batch(
                 inputs, left_pad=False, max_len=self.max_seq_len,
                 keep='tail')
-            logits = self._choice_logits_fn(self.params, tokens, mask)
-        logits = np.asarray(logits, np.float64)[:len(inputs)]
+            with device_call(self.perf,
+                             tokens_in=sum(len(r) for r in ids),
+                             samples=len(inputs)):
+                logits = self._choice_logits_fn(self.params, tokens, mask)
+                logits = np.asarray(logits, np.float64)
+        logits = logits[:len(inputs)]
         sub = logits[:, choice_ids]
         sub = np.exp(sub - sub.max(axis=-1, keepdims=True))
         sub = sub / sub.sum(axis=-1, keepdims=True)
@@ -396,10 +405,14 @@ class JaxLM(BaseModel):
             tokens, mask, ids = self._encode_batch(
                 inputs, left_pad=True, max_len=max_prompt)
             fn = self._gen_fn(int(max_out_len), temperature, top_k)
-            out, lengths = fn(self.params, tokens, mask,
-                              self._put(jax.random.PRNGKey(seed), P()))
-        out = np.asarray(out)
-        lengths = np.asarray(lengths)
+            with device_call(self.perf,
+                             tokens_in=sum(len(r) for r in ids),
+                             samples=len(inputs)):
+                out, lengths = fn(self.params, tokens, mask,
+                                  self._put(jax.random.PRNGKey(seed), P()))
+                out = np.asarray(out)
+                lengths = np.asarray(lengths)
+        self.perf.tokens_out += int(lengths[:len(inputs)].sum())
         texts = []
         for i in range(len(inputs)):
             n = int(lengths[i])

@@ -29,6 +29,11 @@ class BasePartitioner:
         datasets = cfg['datasets']
         work_dir = cfg['work_dir']
         tasks = self.partition(models, datasets, work_dir, self.out_dir)
+        # shared run-level switches every task inherits
+        for key in ('profile',):
+            if key in cfg:
+                for task in tasks:
+                    task[key] = cfg[key]
         self.logger.info(f'Partitioned into {len(tasks)} tasks.')
         for i, task in enumerate(tasks):
             self.logger.debug(f'Task {i}: {task}')

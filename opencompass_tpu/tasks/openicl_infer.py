@@ -21,6 +21,7 @@ from opencompass_tpu.utils.abbr import get_infer_output_path
 from opencompass_tpu.utils.build import (build_dataset_from_cfg,
                                          build_model_from_cfg)
 from opencompass_tpu.utils.logging import get_logger
+from opencompass_tpu.utils.perf import TaskProfiler
 
 from .base import BaseTask
 
@@ -64,7 +65,25 @@ class OpenICLInferTask(BaseTask):
                 if broadcast_object(osp.exists(out_path)
                                     if is_main_process() else None):
                     continue
-                self._inference(model, out_path)
+                perf_path = trace_dir = None
+                if is_main_process():
+                    perf_path = get_infer_output_path(
+                        model_cfg, dataset_cfg,
+                        osp.join(self.work_dir, 'perf'))
+                    if self.cfg.get('profile'):
+                        from opencompass_tpu.utils.abbr import (
+                            dataset_abbr_from_cfg, model_abbr_from_cfg)
+                        trace_dir = osp.join(
+                            self.work_dir, 'profile',
+                            model_abbr_from_cfg(model_cfg),
+                            dataset_abbr_from_cfg(dataset_cfg))
+                with TaskProfiler(model, perf_path, trace_dir) as prof:
+                    self._inference(model, out_path)
+                if prof.record and is_main_process():
+                    logger.info(
+                        f'perf: {prof.record.get("samples_per_sec", "?")} '
+                        f'samples/s, {prof.record.get("tokens_per_sec", "?")}'
+                        f' tokens/s (wall {prof.record["wall_seconds"]}s)')
 
     def _inference(self, model, out_path: str):
         assert 'ice_template' in self.infer_cfg \

@@ -1,0 +1,129 @@
+"""Per-task performance monitoring (tokens/sec, device time, traces).
+
+SURVEY.md §5: the reference only logs coarse wall-clock per task
+(reference tasks/openicl_infer.py:125-129) — profiling is an
+exceed-the-reference axis here.  Three layers:
+
+- ``PerfCounters``: cheap counters models update around device calls
+  (tokens in/out, samples, seconds spent in dispatch+device).
+- ``TaskProfiler``: wraps one inference run; snapshots model counters,
+  measures wall time, optionally records a ``jax.profiler`` trace
+  (viewable in XProf/TensorBoard), and writes a ``perf`` JSON next to the
+  predictions for the Summarizer to surface.
+- ``run.py --profile`` / config key ``profile = True`` turns traces on.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import json
+import os
+import time
+from typing import Optional
+
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+@dataclasses.dataclass
+class PerfCounters:
+    tokens_in: int = 0       # prompt tokens shipped to the device
+    tokens_out: int = 0      # generated tokens
+    samples: int = 0         # rows scored/generated (incl. pad rows: real)
+    device_seconds: float = 0.0  # time blocked on dispatch+device
+    calls: int = 0           # jitted calls (compile included on first)
+
+    def snapshot(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def delta_since(self, snap: dict) -> dict:
+        now = self.snapshot()
+        return {k: now[k] - snap[k] for k in now}
+
+
+@contextlib.contextmanager
+def device_call(counters: Optional[PerfCounters], tokens_in: int = 0,
+                tokens_out: int = 0, samples: int = 0):
+    """Time one device call and add token/sample counts."""
+    if counters is None:
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        counters.device_seconds += time.perf_counter() - t0
+        counters.tokens_in += tokens_in
+        counters.tokens_out += tokens_out
+        counters.samples += samples
+        counters.calls += 1
+
+
+class TaskProfiler:
+    """Profile one (model, dataset) inference run.
+
+    Args:
+        model: object with an optional ``perf`` PerfCounters attribute.
+        out_path: where to write the perf JSON (``None`` = don't write).
+        trace_dir: when set, record a jax.profiler trace there.
+    """
+
+    def __init__(self, model, out_path: Optional[str] = None,
+                 trace_dir: Optional[str] = None):
+        self.model = model
+        self.out_path = out_path
+        self.trace_dir = trace_dir
+        self.record: Optional[dict] = None
+
+    def __enter__(self):
+        self._wall0 = time.perf_counter()
+        self._snap = None
+        counters = getattr(self.model, 'perf', None)
+        if isinstance(counters, PerfCounters):
+            self._snap = counters.snapshot()
+        self._trace_active = False
+        if self.trace_dir:
+            try:
+                import jax
+                os.makedirs(self.trace_dir, exist_ok=True)
+                jax.profiler.start_trace(self.trace_dir)
+                self._trace_active = True
+            except Exception as exc:  # profiling must never fail the task
+                logger.warning(f'jax.profiler trace unavailable: {exc}')
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._trace_active:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as stop_exc:
+                logger.warning(f'stop_trace failed: {stop_exc}')
+        wall = time.perf_counter() - self._wall0
+        record = {'wall_seconds': round(wall, 3)}
+        counters = getattr(self.model, 'perf', None)
+        if isinstance(counters, PerfCounters) and self._snap is not None:
+            d = counters.delta_since(self._snap)
+            record.update(
+                samples=d['samples'],
+                tokens_in=d['tokens_in'],
+                tokens_out=d['tokens_out'],
+                device_seconds=round(d['device_seconds'], 3),
+                device_calls=d['calls'],
+                samples_per_sec=round(d['samples'] / wall, 3) if wall else 0,
+                tokens_per_sec=round(
+                    (d['tokens_in'] + d['tokens_out']) / wall, 1)
+                if wall else 0,
+                device_utilization=round(d['device_seconds'] / wall, 3)
+                if wall else 0,
+            )
+        if self.trace_dir and self._trace_active:
+            record['trace_dir'] = self.trace_dir
+        self.record = record
+        if self.out_path and exc_type is None:
+            os.makedirs(os.path.dirname(os.path.abspath(self.out_path)),
+                        exist_ok=True)
+            with open(self.out_path, 'w') as f:
+                json.dump(record, f, indent=2)
+        return False

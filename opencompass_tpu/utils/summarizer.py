@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,
                                         model_abbr_from_cfg)
 from opencompass_tpu.utils.logging import get_logger
+from opencompass_tpu.utils.prompt import get_prompt_hash
 
 METRIC_WHITELIST = ['score', 'auc_score', 'accuracy', 'humaneval_pass@1',
                     'rouge1', 'avg_toxicity_score', 'bleurt_diff', 'matthews_correlation']
@@ -42,6 +43,13 @@ class Summarizer:
         work_dir = self.cfg['work_dir']
         raw = defaultdict(dict)
         modes = {}
+        versions = {}
+        for dataset in self.cfg.get('datasets', []):
+            try:
+                versions[dataset_abbr_from_cfg(dataset)] = \
+                    get_prompt_hash(dataset)[:6]
+            except Exception:
+                versions[dataset_abbr_from_cfg(dataset)] = '-'
         for model in self.cfg.get('models', []):
             m_abbr = model_abbr_from_cfg(model)
             for dataset in self.cfg.get('datasets', []):
@@ -58,7 +66,25 @@ class Summarizer:
                                  .get('inferencer', {}).get('type', ''))
                 modes[d_abbr] = ('ppl' if 'PPL' in inferencer else
                                  'clp' if 'CLP' in inferencer else 'gen')
-        return raw, modes
+        return raw, modes, versions
+
+    def _load_perf(self):
+        """perf[model_abbr][dataset_abbr] = perf record (may be empty)."""
+        work_dir = self.cfg['work_dir']
+        perf = defaultdict(dict)
+        for model in self.cfg.get('models', []):
+            m_abbr = model_abbr_from_cfg(model)
+            for dataset in self.cfg.get('datasets', []):
+                d_abbr = dataset_abbr_from_cfg(dataset)
+                path = osp.join(work_dir, 'perf', m_abbr, f'{d_abbr}.json')
+                if not osp.exists(path):
+                    continue
+                try:
+                    with open(path) as f:
+                        perf[m_abbr][d_abbr] = json.load(f)
+                except (OSError, json.JSONDecodeError):
+                    pass
+        return perf
 
     @staticmethod
     def _primary_metric(result: Dict) -> Optional[str]:
@@ -107,8 +133,20 @@ class Summarizer:
 
     # -- render ------------------------------------------------------------
 
+    @staticmethod
+    def _render(rows: List[List[str]]) -> str:
+        widths = [max(len(str(r[i])) for r in rows)
+                  for i in range(len(rows[0]))]
+        lines = []
+        for i, row in enumerate(rows):
+            lines.append('  '.join(str(c).ljust(w)
+                                   for c, w in zip(row, widths)))
+            if i == 0:
+                lines.append('  '.join('-' * w for w in widths))
+        return '\n'.join(lines)
+
     def summarize(self, time_str: str = 'default') -> str:
-        raw, modes = self._load_results()
+        raw, modes, versions = self._load_results()
         self._apply_groups(raw, modes)
         model_abbrs = [model_abbr_from_cfg(m)
                        for m in self.cfg.get('models', [])]
@@ -122,10 +160,13 @@ class Summarizer:
                         seen.append(abbr)
             dataset_abbrs = seen
 
-        header = ['dataset', 'mode'] + model_abbrs
+        # 'version' = prompt-hash prefix: two runs whose prompts differ show
+        # different versions (reference utils/summarizer.py:134 parity)
+        header = ['dataset', 'version', 'mode'] + model_abbrs
         rows = [header]
         for d_abbr in dataset_abbrs:
-            row = [d_abbr, modes.get(d_abbr, '-')]
+            row = [d_abbr, versions.get(d_abbr, '-'),
+                   modes.get(d_abbr, '-')]
             for m_abbr in model_abbrs:
                 result = raw.get(m_abbr, {}).get(d_abbr)
                 metric = self._primary_metric(result) if result else None
@@ -136,16 +177,26 @@ class Summarizer:
                     row.append(f'{value:.2f}'
                                if isinstance(value, float) else str(value))
             rows.append(row)
+        table = self._render(rows)
 
-        widths = [max(len(str(r[i])) for r in rows)
-                  for i in range(len(header))]
-        lines = []
-        for i, row in enumerate(rows):
-            lines.append('  '.join(str(c).ljust(w)
-                                   for c, w in zip(row, widths)))
-            if i == 0:
-                lines.append('  '.join('-' * w for w in widths))
-        table = '\n'.join(lines)
+        perf = self._load_perf()
+        perf_rows = []
+        if perf:
+            perf_rows = [['dataset', 'model', 'samples/s', 'tokens/s',
+                          'device_util', 'wall_s']]
+            for d_abbr in dataset_abbrs:
+                for m_abbr in model_abbrs:
+                    rec = perf.get(m_abbr, {}).get(d_abbr)
+                    if not rec:
+                        continue
+                    perf_rows.append([
+                        d_abbr, m_abbr,
+                        rec.get('samples_per_sec', '-'),
+                        rec.get('tokens_per_sec', '-'),
+                        rec.get('device_utilization', '-'),
+                        rec.get('wall_seconds', '-')])
+            if len(perf_rows) > 1:
+                table += '\n\nperf:\n' + self._render(perf_rows)
 
         work_dir = self.cfg['work_dir']
         out_dir = osp.join(work_dir, 'summary')
@@ -155,7 +206,11 @@ class Summarizer:
             f.write(table + '\n')
         csv_path = osp.join(out_dir, f'summary_{time_str}.csv')
         with open(csv_path, 'w', newline='') as f:
-            csv.writer(f).writerows(rows)
+            writer = csv.writer(f)
+            writer.writerows(rows)
+            if len(perf_rows) > 1:
+                writer.writerow([])
+                writer.writerows(perf_rows)
         self.logger.info(f'write summary to {osp.abspath(txt_path)}')
         print(table)
         return table

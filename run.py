@@ -79,6 +79,11 @@ def parse_args():
     parser.add_argument('--lark',
                         action='store_true',
                         help='enable webhook status reports')
+    parser.add_argument('--profile',
+                        action='store_true',
+                        help='record jax.profiler traces per infer task '
+                        '(under {work_dir}/profile/) in addition to the '
+                        'always-on perf counters')
     return parser.parse_args()
 
 
@@ -90,6 +95,8 @@ def get_config_from_arg(args) -> Config:
         cfg.setdefault('work_dir', './outputs/default')
     if not args.lark:
         cfg.pop('lark_bot_url', None)
+    if args.profile:
+        cfg['profile'] = True
     return cfg
 
 

@@ -140,6 +140,38 @@ def test_summarizer_groups(tmp_path):
     assert '70.00' in lines['demo-weighted']     # (3*80+40)/4
 
 
+def test_summarizer_version_column_tracks_prompt_changes(tmp_path):
+    """Two runs whose prompts differ must show different 'version' hashes
+    (reference utils/summarizer.py:134 parity)."""
+    from opencompass_tpu.utils.summarizer import Summarizer
+
+    def cfg_with_prompt(prompt):
+        cfg = _demo_cfg(tmp_path)
+        for ds in cfg['datasets']:
+            tpl = ds['infer_cfg']['prompt_template']
+            if isinstance(tpl.get('template'), str):
+                tpl['template'] = prompt
+        return cfg
+
+    res_dir = tmp_path / 'results' / 'fake-demo'
+    res_dir.mkdir(parents=True, exist_ok=True)
+    (res_dir / 'demo-gen.json').write_text('{"score": 80.0}')
+
+    t1 = Summarizer(cfg_with_prompt('Q: {question}\nA: ')).summarize('v1')
+    t2 = Summarizer(cfg_with_prompt('Answer now!\n{question}')).summarize(
+        'v2')
+
+    def version_of(table):
+        for line in table.splitlines():
+            if line.startswith('demo-gen'):
+                return line.split()[1]
+        raise AssertionError(table)
+
+    v1, v2 = version_of(t1), version_of(t2)
+    assert v1 != v2
+    assert len(v1) == 6
+
+
 def test_eval_task_pred_role_extraction(tmp_path):
     from opencompass_tpu.tasks.openicl_eval import extract_role_pred
     s = '<sys>ignored</sys><bot>The answer</bot>trailing'
