@@ -1,0 +1,34 @@
+"""RealToxicityPrompts: continue the prompt, score continuation toxicity.
+
+Parity: reference configs/datasets/realtoxicprompts/realtoxicprompts_gen_7605e4.py
+(ToxicEvaluator over generations; challenging subset).  Backend 'auto'
+uses the Perspective API when `PerspectiveAPIkey` is set, else the
+offline heuristic scorer so the suite runs hermetically.
+"""
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer
+from opencompass_tpu.icl.evaluators import ToxicEvaluator
+
+realtoxicprompts_reader_cfg = dict(
+    input_columns=['prompt_text'],
+    output_column='prompt_toxicity',
+    train_split='train',
+    test_split='train')
+
+realtoxicprompts_infer_cfg = dict(
+    prompt_template=dict(type=PromptTemplate, template='{prompt_text}'),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer))
+
+realtoxicprompts_eval_cfg = dict(
+    evaluator=dict(type=ToxicEvaluator, backend='auto'))
+
+realtoxicprompts_datasets = [
+    dict(type='RealToxicPromptsDataset',
+         abbr='real-toxicity-prompts',
+         path='allenai/real-toxicity-prompts',
+         challenging_subset=True,
+         reader_cfg=realtoxicprompts_reader_cfg,
+         infer_cfg=realtoxicprompts_infer_cfg,
+         eval_cfg=realtoxicprompts_eval_cfg)
+]

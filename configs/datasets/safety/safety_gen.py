@@ -1,0 +1,29 @@
+"""Safety prompt list: generate, score toxicity of the completion.
+
+Parity: reference configs/datasets/safety/safety_gen_7ce197.py.
+"""
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer
+from opencompass_tpu.icl.evaluators import ToxicEvaluator
+
+safety_reader_cfg = dict(
+    input_columns=['prompt'],
+    output_column='idx',
+    train_split='test',
+    test_split='test')
+
+safety_infer_cfg = dict(
+    prompt_template=dict(type=PromptTemplate, template='{prompt}'),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer))
+
+safety_eval_cfg = dict(evaluator=dict(type=ToxicEvaluator, backend='auto'))
+
+safety_datasets = [
+    dict(type='SafetyDataset',
+         abbr='safety',
+         path='./data/safety.txt',
+         reader_cfg=safety_reader_cfg,
+         infer_cfg=safety_infer_cfg,
+         eval_cfg=safety_eval_cfg)
+]

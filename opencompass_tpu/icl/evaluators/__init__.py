@@ -3,3 +3,5 @@ from .em import EMEvaluator  # noqa
 from .metrics import (AccEvaluator, AUCROCEvaluator, BleuEvaluator,  # noqa
                       MccEvaluator, RandomEvaluator, RougeEvaluator,
                       SquadEvaluator)
+from .toxic import (OfflineToxicScorer, PerspectiveClient,  # noqa
+                    ToxicEvaluator)

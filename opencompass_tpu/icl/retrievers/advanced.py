@@ -68,7 +68,7 @@ class MDLRetriever(TopkRetriever):
         rng = random.Random(self.seed)
         out = []
         for row_ids, test_input in zip(ids.tolist(), test_corpus):
-            best_perm, best_nll = list(row_ids[:self.ice_num]), None
+            perms, prompts, mask_lengths = [], [], []
             for trial in range(self.select_time):
                 if trial == 0:
                     perm = list(row_ids[:self.ice_num])
@@ -77,13 +77,15 @@ class MDLRetriever(TopkRetriever):
                                       min(self.ice_num, len(row_ids)))
                 ice = self.ice_separator.join(
                     index_corpus[i] for i in perm) + self.ice_eos_token
+                perms.append(perm)
+                prompts.append(ice + test_input)
                 # mask the ICE so only the test input's description length
                 # is scored (reference icl_mdl_retriever.py:87-182)
-                ice_len = self.metric_model.get_token_len(ice)
-                nll = self.metric_model.get_ppl(
-                    [ice + test_input], mask_length=[ice_len])[0]
-                if best_nll is None or nll < best_nll:
-                    best_nll, best_perm = nll, perm
+                mask_lengths.append(self.metric_model.get_token_len(ice))
+            # one device call scores every candidate ordering
+            nlls = self.metric_model.get_ppl(prompts,
+                                             mask_length=mask_lengths)
+            best_perm = perms[int(np.argmin(nlls))]
             out.append([int(i) for i in best_perm])
         return out
 

@@ -1,5 +1,6 @@
 from .base import BaseRunner  # noqa
+from .cloud import CloudRunner  # noqa
 from .local import LocalRunner  # noqa
 from .slurm import SlurmRunner  # noqa
 
-__all__ = ['BaseRunner', 'LocalRunner', 'SlurmRunner']
+__all__ = ['BaseRunner', 'CloudRunner', 'LocalRunner', 'SlurmRunner']

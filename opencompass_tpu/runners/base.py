@@ -7,8 +7,11 @@ cross-process protocol — SURVEY.md §2.7).
 """
 from __future__ import annotations
 
+import os
+import os.path as osp
+import subprocess
 from abc import abstractmethod
-from typing import Any, Dict, List, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 from opencompass_tpu.config import ConfigDict
 from opencompass_tpu.registry import TASKS
@@ -50,6 +53,43 @@ class BaseRunner:
                 raise KeyError(f'{cls} is not a registered task type')
             cls = resolved
         return cls(task_cfg, **type_cfg)
+
+    def debug_launch(self, tasks: List[Dict]) -> List[Tuple[str, int]]:
+        """Serial in-process execution with live output (``--debug``)."""
+        status = []
+        for task_cfg in tasks:
+            task = self.build_task(task_cfg)
+            task.run()
+            status.append((task.name, 0))
+        return status
+
+    def submit_with_retry(self, task, cmd: str, retry: int,
+                          env: Optional[Dict] = None,
+                          log_mode: str = 'w') -> int:
+        """Run ``cmd``, re-submitting while it fails the completion contract:
+        exit ≠ 0 *or* any expected output file missing (a cluster job can
+        "succeed" while preemption ate the work — reference
+        runners/slurm.py:127-148, dlc.py:135-145)."""
+        log_path = task.get_log_path('out')
+        os.makedirs(osp.dirname(log_path), exist_ok=True)
+        returncode = 1
+        for attempt in range(retry + 1):
+            with open(log_path, log_mode) as log_file:
+                result = subprocess.run(cmd, shell=True, text=True,
+                                        stdout=log_file,
+                                        stderr=subprocess.STDOUT, env=env)
+            returncode = result.returncode
+            if not self.job_failed(returncode, task):
+                return 0
+            self.logger.warning(
+                f'{task.name} attempt {attempt + 1} failed '
+                f'(code {returncode}); retrying')
+        return returncode or 1
+
+    @staticmethod
+    def job_failed(returncode: int, task) -> bool:
+        return returncode != 0 or any(
+            not osp.exists(p) for p in task.get_output_paths())
 
     def summarize(self, status: List[Tuple[str, int]]):
         failed = [name for name, code in status if code != 0]

@@ -11,7 +11,6 @@ from __future__ import annotations
 import os
 import os.path as osp
 import random
-import subprocess
 import tempfile
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -53,12 +52,7 @@ class SlurmRunner(BaseRunner):
 
     def launch(self, tasks: List[Dict]) -> List[Tuple[str, int]]:
         if self.debug:
-            status = []
-            for task_cfg in tasks:
-                task = self.build_task(task_cfg)
-                task.run()
-                status.append((task.name, 0))
-            return status
+            return self.debug_launch(tasks)
         with ThreadPoolExecutor(max_workers=self.max_num_workers) as pool:
             return list(pool.map(self._launch, tasks))
 
@@ -91,28 +85,7 @@ class SlurmRunner(BaseRunner):
             import opencompass_tpu
             pkg_root = osp.dirname(osp.dirname(opencompass_tpu.__file__))
             cmd = f'PYTHONPATH={pkg_root}:$PYTHONPATH {cmd}'
-            log_path = task.get_log_path('out')
-            os.makedirs(osp.dirname(log_path), exist_ok=True)
-            returncode = 1
-            for attempt in range(self.retry + 1):
-                with open(log_path, 'w') as log_file:
-                    result = subprocess.run(cmd, shell=True, text=True,
-                                            stdout=log_file,
-                                            stderr=subprocess.STDOUT)
-                returncode = result.returncode
-                if not self._job_failed(returncode, task):
-                    returncode = 0
-                    break
-                self.logger.warning(
-                    f'{name} attempt {attempt + 1} failed '
-                    f'(code {returncode}); retrying')
-            if self._job_failed(returncode, task):
-                returncode = returncode or 1
+            returncode = self.submit_with_retry(task, cmd, self.retry)
         finally:
             os.unlink(tmp.name)
         return name, returncode
-
-    @staticmethod
-    def _job_failed(returncode: int, task) -> bool:
-        return returncode != 0 or any(
-            not osp.exists(p) for p in task.get_output_paths())

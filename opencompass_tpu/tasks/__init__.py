@@ -1,5 +1,7 @@
 from .base import BaseTask  # noqa
+from .llm_eval import ModelEvaluator  # noqa
 from .openicl_infer import OpenICLInferTask  # noqa
 from .openicl_eval import OpenICLEvalTask  # noqa
 
-__all__ = ['BaseTask', 'OpenICLInferTask', 'OpenICLEvalTask']
+__all__ = ['BaseTask', 'ModelEvaluator', 'OpenICLInferTask',
+           'OpenICLEvalTask']

@@ -1,0 +1,154 @@
+"""LLM-judge model comparison (finished version of the reference's stub).
+
+Reference tasks/llm_eval.py:11-91 sketches a ``ModelEvaluator`` that ranks
+several models' responses per question with a judge LLM ("sort the answers,
+reply digits") but is marked ``TODO: Finish the implementation`` and has
+index/score bugs.  This implementation completes the design:
+
+- loads each model's predictions JSON from the standard output layout
+  (``{work_dir}/predictions/{model_abbr}/{dataset_abbr}.json``),
+- asks the judge to order the (shuffled, to fight position bias) answers
+  from least to most appropriate,
+- parses rankings robustly (digit extraction, length/permutation checks;
+  malformed judgments are skipped and counted),
+- aggregates Borda-style points per model and writes
+  ``{work_dir}/results/llm_judge/{dataset_abbr}.json``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+import random
+import re
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from opencompass_tpu.registry import EVALUATORS, MODELS
+from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,
+                                        model_abbr_from_cfg)
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+_PROMPT = (
+    'Below are a question and a set of answers, each numbered by a digit. '
+    'Sort the answers from least to most appropriate to the question. '
+    'Reply with only the digits separated by spaces, worst first. For '
+    'example, with three answers, reply "1 0 2" when answer 0 is best '
+    'and answer 1 is worst.\n'
+    'Q: {question}\n')
+
+
+@EVALUATORS.register_module()
+class ModelEvaluator:
+    """Args:
+        config: dict with ``models`` (≥2 model cfgs whose predictions are
+            compared), ``datasets``, ``work_dir``, and ``evaluator`` =
+            dict(judger=<model cfg or instance>, max_out_len=...).
+    """
+
+    def __init__(self, config: Dict):
+        self.cfg = config
+        evaluator_cfg = dict(config.get('evaluator', {}))
+        judger = evaluator_cfg.get('judger')
+        if isinstance(judger, dict):
+            judger = MODELS.build(judger)
+        if judger is None:
+            raise ValueError('ModelEvaluator needs evaluator.judger')
+        self.judger = judger
+        self.max_out_len = evaluator_cfg.get('max_out_len', 16)
+        self.seed = evaluator_cfg.get('seed', 0)
+        self.work_dir = config.get('work_dir', '.')
+        self.dataset_abbrs = [dataset_abbr_from_cfg(d)
+                              for d in config['datasets']]
+        self.model_abbrs = [model_abbr_from_cfg(m)
+                            for m in config['models']]
+        if len(self.model_abbrs) < 2:
+            raise ValueError('need at least two models to compare')
+
+    # -- per-dataset --------------------------------------------------------
+
+    def _load_predictions(self, dataset_abbr: str) -> Optional[List]:
+        """[(question, [resp_model0, resp_model1, ...]), ...] — list, not a
+        dict keyed by prompt: duplicate questions must not collapse."""
+        per_model = []
+        for model_abbr in self.model_abbrs:
+            path = osp.join(self.work_dir, 'predictions', model_abbr,
+                            f'{dataset_abbr}.json')
+            if not osp.exists(path):
+                logger.warning(f'missing predictions: {path}')
+                return None
+            with open(path) as f:
+                per_model.append(json.load(f))
+        keys = [k for k in per_model[0]
+                if all(k in preds for preds in per_model)]
+        return [
+            (per_model[0][key]['origin_prompt'],
+             [preds[key]['prediction'] for preds in per_model])
+            for key in keys
+        ]
+
+    def _parse_ranking(self, output: str, n: int) -> Optional[List[int]]:
+        digits = [int(d) for d in re.findall(r'\d+', str(output))]
+        if len(digits) < n or sorted(digits[:n]) != list(range(n)):
+            return None
+        return digits[:n]
+
+    def _evaluate_dataset(self, dataset_abbr: str) -> Optional[Dict]:
+        data = self._load_predictions(dataset_abbr)
+        if data is None:
+            return None
+        rng = random.Random(self.seed)
+        scores = defaultdict(float)
+        judged = skipped = 0
+        n = len(self.model_abbrs)
+        # build every judge prompt up front: one batched generate() call
+        # lets API judges fan out over their thread pool instead of paying
+        # one serial round-trip per question
+        orders, prompts = [], []
+        for question, responses in data:
+            order = list(range(n))
+            rng.shuffle(order)  # shuffle to fight judge position bias
+            prompt = _PROMPT.format(question=question)
+            for pos, model_idx in enumerate(order):
+                prompt += f'A{pos}: {responses[model_idx]}\n'
+            orders.append(order)
+            prompts.append(prompt)
+        outputs = self.judger.generate(prompts,
+                                       max_out_len=self.max_out_len)
+        for order, output in zip(orders, outputs):
+            ranking = self._parse_ranking(output, n)
+            if ranking is None:
+                skipped += 1
+                continue
+            judged += 1
+            # Borda points: position in the worst→best list = points
+            for points, pos in enumerate(ranking):
+                scores[self.model_abbrs[order[pos]]] += points
+        if not judged:
+            logger.warning(f'{dataset_abbr}: no parseable judgments')
+            return None
+        max_points = (n - 1) * judged or 1
+        return {
+            'scores': {m: round(s / max_points * 100, 2)
+                       for m, s in scores.items()},
+            'judged': judged,
+            'skipped': skipped,
+        }
+
+    # -- entry --------------------------------------------------------------
+
+    def evaluate(self) -> Dict[str, Dict]:
+        results = {}
+        out_dir = osp.join(self.work_dir, 'results', 'llm_judge')
+        os.makedirs(out_dir, exist_ok=True)
+        for dataset_abbr in self.dataset_abbrs:
+            result = self._evaluate_dataset(dataset_abbr)
+            if result is None:
+                continue
+            results[dataset_abbr] = result
+            with open(osp.join(out_dir, f'{dataset_abbr}.json'), 'w') as f:
+                json.dump(result, f, indent=2)
+            logger.info(f'{dataset_abbr} judge scores: {result["scores"]}')
+        return results

@@ -1,0 +1,58 @@
+"""Environment report (reference utils/collect_env.py equivalent, minus
+the mmengine dependency): python/jax/library versions, device inventory,
+and the current git commit when available."""
+from __future__ import annotations
+
+import platform
+import subprocess
+import sys
+from typing import Dict
+
+
+def get_git_hash(digits: int = 7) -> str:
+    try:
+        out = subprocess.run(['git', 'rev-parse', 'HEAD'],
+                             capture_output=True, text=True, timeout=10)
+        if out.returncode == 0:
+            return out.stdout.strip()[:digits]
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    return 'unknown'
+
+
+def collect_env() -> Dict[str, str]:
+    info = {
+        'sys.platform': sys.platform,
+        'Python': sys.version.replace('\n', ''),
+        'CPU': platform.processor() or platform.machine(),
+    }
+    try:
+        import jax
+        info['jax'] = jax.__version__
+        try:
+            devices = jax.devices()
+            info['jax.devices'] = ', '.join(
+                f'{d.platform}:{getattr(d, "device_kind", "?")}'
+                for d in devices) + f' (x{len(devices)})'
+        except RuntimeError as exc:
+            info['jax.devices'] = f'unavailable ({exc})'
+    except ImportError:
+        info['jax'] = 'not installed'
+    for mod in ('numpy', 'flax', 'optax', 'transformers', 'datasets'):
+        try:
+            info[mod] = __import__(mod).__version__
+        except ImportError:
+            info[mod] = 'not installed'
+    import opencompass_tpu
+    info['opencompass_tpu'] = getattr(opencompass_tpu, '__version__',
+                                      '0.0') + '+' + get_git_hash()
+    return info
+
+
+def main():
+    for key, value in collect_env().items():
+        print(f'{key}: {value}')
+
+
+if __name__ == '__main__':
+    main()

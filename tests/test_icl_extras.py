@@ -118,10 +118,17 @@ def test_topk_retriever_hashed_bow():
 def test_mdl_retriever_with_fake_metric():
     from opencompass_tpu.icl.retrievers import MDLRetriever
     metric = FakeModel(canned_ppls={'cat': 0.5})
+    calls = []
+    inner_get_ppl = metric.get_ppl
+    metric.get_ppl = lambda inputs, **kw: (calls.append(len(inputs)),
+                                           inner_get_ppl(inputs, **kw))[1]
     retriever = MDLRetriever(_corpus_ds(), ice_num=1, candidate_num=3,
                              select_time=3, metric_model=metric)
     ids = retriever.retrieve()
     assert len(ids) == 2 and all(len(r) == 1 for r in ids)
+    # batched scoring: ONE get_ppl call per test item covering all
+    # candidate orderings, not select_time unbatched device calls
+    assert calls == [3, 3]
 
 
 def test_votek_and_dpp_retrievers():

@@ -1,0 +1,87 @@
+"""LLM-judge ModelEvaluator: ranking parse, Borda aggregation, robustness."""
+import json
+import os.path as osp
+
+import pytest
+
+from opencompass_tpu.models import FakeModel
+from opencompass_tpu.tasks import ModelEvaluator
+
+
+class RankingJudge(FakeModel):
+    """Judge that always prefers answers containing 'good'."""
+
+    def generate(self, inputs, max_out_len):
+        out = []
+        for prompt in inputs:
+            answers = [line for line in str(prompt).splitlines()
+                       if line.startswith('A')]
+            order = sorted(range(len(answers)),
+                           key=lambda i: 'good' in answers[i])  # worst first
+            out.append(' '.join(str(i) for i in order))
+        return out
+
+
+def _write_preds(work_dir, model_abbr, dataset_abbr, preds):
+    d = work_dir / 'predictions' / model_abbr
+    d.mkdir(parents=True, exist_ok=True)
+    (d / f'{dataset_abbr}.json').write_text(json.dumps({
+        str(i): {'origin_prompt': f'question {i}?', 'prediction': p}
+        for i, p in enumerate(preds)
+    }))
+
+
+def test_model_evaluator_ranks_models(tmp_path):
+    _write_preds(tmp_path, 'model-a', 'ds', ['good answer'] * 4)
+    _write_preds(tmp_path, 'model-b', 'ds', ['bad answer'] * 4)
+    ev = ModelEvaluator({
+        'models': [{'abbr': 'model-a'}, {'abbr': 'model-b'}],
+        'datasets': [{'abbr': 'ds'}],
+        'work_dir': str(tmp_path),
+        'evaluator': {'judger': RankingJudge()},
+    })
+    results = ev.evaluate()
+    scores = results['ds']['scores']
+    assert scores['model-a'] == 100.0  # always best
+    assert scores['model-b'] == 0.0
+    assert results['ds']['judged'] == 4
+    assert osp.exists(tmp_path / 'results' / 'llm_judge' / 'ds.json')
+
+
+def test_model_evaluator_skips_malformed_judgments(tmp_path):
+    _write_preds(tmp_path, 'm0', 'ds', ['x'] * 3)
+    _write_preds(tmp_path, 'm1', 'ds', ['y'] * 3)
+    judge = FakeModel(canned_responses={'Q:': 'no digits here'})
+    ev = ModelEvaluator({
+        'models': [{'abbr': 'm0'}, {'abbr': 'm1'}],
+        'datasets': [{'abbr': 'ds'}],
+        'work_dir': str(tmp_path),
+        'evaluator': {'judger': judge},
+    })
+    assert ev.evaluate() == {}  # everything skipped, no crash
+
+
+def test_model_evaluator_needs_two_models(tmp_path):
+    with pytest.raises(ValueError, match='two models'):
+        ModelEvaluator({
+            'models': [{'abbr': 'only'}],
+            'datasets': [],
+            'work_dir': str(tmp_path),
+            'evaluator': {'judger': FakeModel()},
+        })
+
+
+def test_parse_ranking():
+    ev = ModelEvaluator.__new__(ModelEvaluator)
+    assert ev._parse_ranking('1 0 2', 3) == [1, 0, 2]
+    assert ev._parse_ranking('ranking: 2, 1, 0.', 3) == [2, 1, 0]
+    assert ev._parse_ranking('0 0 1', 3) is None   # not a permutation
+    assert ev._parse_ranking('0 1', 3) is None     # too short
+    assert ev._parse_ranking('garbage', 2) is None
+
+
+def test_collect_env():
+    from opencompass_tpu.utils.collect_env import collect_env
+    info = collect_env()
+    assert 'jax' in info and 'opencompass_tpu' in info
+    assert info['Python']

@@ -1,0 +1,115 @@
+"""ToxicEvaluator: offline scorer, metric surface, Perspective client."""
+import json
+from unittest import mock
+
+import pytest
+
+from opencompass_tpu.icl.evaluators import (OfflineToxicScorer,
+                                            PerspectiveClient,
+                                            ToxicEvaluator)
+
+
+def test_offline_scorer_orders_toxicity():
+    scorer = OfflineToxicScorer()
+    benign = scorer.get_toxicity_scores(['what a lovely day for a walk'])[0]
+    toxic = scorer.get_toxicity_scores(
+        ['you are so stupid, I hate you, you worthless idiot'])[0]
+    assert 0 <= benign < toxic <= 1.0
+    assert scorer.get_toxicity_scores(['   '])[0] == -1.0  # invalid
+    # deterministic
+    assert scorer.get_toxicity_scores(['you idiot']) \
+        == scorer.get_toxicity_scores(['you idiot'])
+
+
+def test_toxic_evaluator_metrics_offline():
+    ev = ToxicEvaluator(backend='offline', thr=0.3)
+    result = ev.score(['have a nice day',
+                       'you are such a stupid idiot, I hate you',
+                       '   '])  # one invalid sample
+    assert set(result) == {'expected_max_toxicity',
+                           'max_toxicity_probability', 'toxic_frac',
+                           'avg_toxicity_score'}
+    assert result['expected_max_toxicity'] >= 0.3
+    assert result['max_toxicity_probability'] is True
+    # 1 of 2 valid samples over threshold
+    assert result['toxic_frac'] == pytest.approx(0.5)
+
+
+def test_toxic_evaluator_auto_falls_back_without_key(monkeypatch):
+    monkeypatch.delenv('PerspectiveAPIkey', raising=False)
+    ev = ToxicEvaluator(backend='auto')
+    assert isinstance(ev.scorer, OfflineToxicScorer)
+
+
+def test_toxic_evaluator_all_invalid_raises():
+    ev = ToxicEvaluator(backend='offline')
+    with pytest.raises(ValueError, match='no valid'):
+        ev.score(['', '   '])
+
+
+def test_toxicity_pipeline_end_to_end(tmp_path):
+    """Generate with a FakeModel over a toy prompt set, score toxicity —
+    the realtoxicprompts_gen.py config shape, hermetic."""
+    from datasets import Dataset, DatasetDict
+
+    from opencompass_tpu.datasets.base import BaseDataset
+    from opencompass_tpu.icl.inferencers import GenInferencer
+    from opencompass_tpu.icl.prompt_template import PromptTemplate
+    from opencompass_tpu.icl.retrievers import ZeroRetriever
+    from opencompass_tpu.models import FakeModel
+
+    class PromptSet(BaseDataset):
+
+        @staticmethod
+        def load():
+            rows = [{'prompt_text': f'continue this {i}:'}
+                    for i in range(4)]
+            ds = Dataset.from_list(rows)
+            return DatasetDict({'train': ds, 'test': ds})
+
+    ds = PromptSet(reader_cfg=dict(input_columns=['prompt_text'],
+                                   output_column=None))
+    model = FakeModel(canned_responses={
+        'continue this 0': 'you stupid idiot, I hate you',
+        'continue this 1': 'what a lovely day',
+        'continue this 2': 'the weather is mild',
+        'continue this 3': 'have a pleasant evening',
+    })
+    inferencer = GenInferencer(model=model, max_out_len=16,
+                               output_json_filepath=str(tmp_path))
+    preds = inferencer.inference(
+        ZeroRetriever(ds),
+        prompt_template=PromptTemplate('{prompt_text}'))
+    result = ToxicEvaluator(backend='offline', thr=0.3).score(preds)
+    assert result['toxic_frac'] == pytest.approx(0.25)
+    assert result['max_toxicity_probability'] is True
+
+
+def test_perspective_client_parses_response(monkeypatch):
+    monkeypatch.setenv('PerspectiveAPIkey', 'fake-key')
+    client = PerspectiveClient(query_per_second=1000)
+    payload = {'attributeScores': {'TOXICITY': {
+        'spanScores': [{'score': {'value': 0.87}}]}}}
+
+    class FakeResp:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+
+        def read(self):
+            return json.dumps(payload).encode()
+
+    with mock.patch('urllib.request.urlopen', return_value=FakeResp()):
+        scores = client.get_toxicity_scores(['some text', 'other'])
+    assert scores == [0.87, 0.87]
+
+
+def test_perspective_client_scores_failures_invalid(monkeypatch):
+    monkeypatch.setenv('PerspectiveAPIkey', 'fake-key')
+    client = PerspectiveClient(query_per_second=1000, retry=0)
+    with mock.patch('urllib.request.urlopen',
+                    side_effect=OSError('no network')):
+        scores = client.get_toxicity_scores(['text'])
+    assert scores == [-1.0]
