@@ -2,5 +2,7 @@ from .dataset_reader import DatasetReader  # noqa
 from .prompt_template import PromptTemplate  # noqa
 from .evaluators import AccEvaluator, BaseEvaluator, EMEvaluator  # noqa
 from .inferencers import GenInferencer, PPLInferencer  # noqa
-from .retrievers import (BaseRetriever, FixKRetriever,  # noqa
-                         RandomRetriever, ZeroRetriever)
+from .retrievers import (BaseRetriever, BM25Retriever,  # noqa
+                         DPPRetriever, FixKRetriever, MDLRetriever,
+                         RandomRetriever, TopkRetriever, VotekRetriever,
+                         ZeroRetriever)

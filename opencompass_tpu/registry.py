@@ -53,7 +53,19 @@ class Registry:
             return
         self._scanned = True
         for loc in self._locations:
-            importlib.import_module(loc)
+            mod = importlib.import_module(loc)
+            # package locations register classes from their submodules
+            # (e.g. every opencompass_tpu.datasets.<family> module)
+            if hasattr(mod, '__path__'):
+                import pkgutil
+                for info in pkgutil.walk_packages(mod.__path__,
+                                                  prefix=loc + '.'):
+                    try:
+                        importlib.import_module(info.name)
+                    except ImportError as exc:  # optional-dep module
+                        import logging
+                        logging.getLogger('opencompass_tpu').warning(
+                            f'registry scan skipped {info.name}: {exc}')
 
     def get(self, key: str) -> Optional[Type]:
         if key not in self._registry:
