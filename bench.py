@@ -49,7 +49,7 @@ _PEAK_TFLOPS = {'TPU v5 lite': 197.0, 'TPU v5': 459.0, 'TPU v4': 275.0,
                 'TPU v6 lite': 918.0}
 
 PPL_BATCH, PPL_SEQ, PPL_ITERS = 16, 512, 6
-GEN_BATCH, GEN_PROMPT, GEN_NEW = 16, 128, 64
+GEN_BATCH, GEN_PROMPT, GEN_NEW = 32, 128, 64
 
 
 def _param_count(cfg):

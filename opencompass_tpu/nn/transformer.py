@@ -273,21 +273,34 @@ def _stack(cfg: TransformerConfig, x, layers, positions, mask,
                 x, _ = step(x, lp)
         return x, None
 
-    def step(h, layer_and_cache):
-        lp, cs = layer_and_cache
+    # The cache rides the scan CARRY as one stacked array with per-layer
+    # dynamic indexing — NOT as scan xs/ys.  A ys output would allocate a
+    # fresh stacked cache buffer and copy every layer's full (B,S,K,hd)
+    # slice on every decode step (~1.5 GB/step at 7B geometry); carried
+    # buffers alias across iterations, so the dynamic updates happen in
+    # place and each step writes only the new token's slots.
+    def step(carry, layer_and_index):
+        h, cache_full = carry
+        lp, li = layer_and_index
+        cs = jax.tree_util.tree_map(
+            lambda a: jax.lax.dynamic_index_in_dim(a, li, 0,
+                                                   keepdims=False),
+            cache_full)
         h, new_cs = block(cfg, h, lp, positions, mask, cs, cache_index)
-        return h, new_cs
+        cache_full = jax.tree_util.tree_map(
+            lambda full, ncs: jax.lax.dynamic_update_index_in_dim(
+                full, ncs.astype(full.dtype), li, 0),
+            cache_full, new_cs)
+        return (h, cache_full), None
     if cfg.scan_layers:
-        x, new_cache = jax.lax.scan(step, x, (layers, cache))
+        (x, new_cache), _ = jax.lax.scan(
+            step, (x, cache), (layers, jnp.arange(cfg.num_layers)))
     else:
-        slices = []
+        new_cache = cache
         for i in range(cfg.num_layers):
             lp = jax.tree_util.tree_map(lambda a: a[i], layers)
-            cs = jax.tree_util.tree_map(lambda a: a[i], cache)
-            x, ncs = block(cfg, x, lp, positions, mask, cs, cache_index)
-            slices.append(ncs)
-        new_cache = jax.tree_util.tree_map(
-            lambda *xs: jnp.stack(xs), *slices)
+            (x, new_cache), _ = step((x, new_cache),
+                                     (lp, jnp.asarray(i)))
     return x, new_cache
 
 
