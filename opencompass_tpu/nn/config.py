@@ -29,7 +29,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     activation: str = 'silu'          # silu | gelu | gelu_new | relu
     norm: str = 'rmsnorm'             # rmsnorm | layernorm
-    positional: str = 'rope'          # rope | learned
+    positional: str = 'rope'          # rope | learned | alibi
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
@@ -141,15 +141,14 @@ class TransformerConfig:
         """Build from a HuggingFace ``config.json`` dict (the same contract
         the reference gets for free from AutoModel; we map explicitly)."""
         mt = (hf.get('model_type') or '').lower()
-        if mt == 'baichuan' and hf.get('num_hidden_layers', 0) >= 40:
-            # Baichuan-13B (40 layers / hidden 5120) uses ALiBi positions,
-            # not RoPE — only the 7B variant is llama-shaped.  Loading it
-            # through the RoPE preset would silently produce wrong logits.
-            raise ValueError(
-                'Baichuan ALiBi variants (13B+) are not supported; only the '
-                'RoPE-based Baichuan-7B maps onto the llama preset')
         if mt in ('llama', 'mistral', 'internlm', 'internlm2', 'baichuan'):
+            kw = {}
+            if mt == 'baichuan' and hf.get('num_hidden_layers', 0) >= 40:
+                # Baichuan-13B (40 layers / hidden 5120) uses ALiBi
+                # positions; only the 7B variant is RoPE/llama-shaped.
+                kw['positional'] = 'alibi'
             return TransformerConfig.llama(
+                **kw,
                 vocab_size=hf['vocab_size'],
                 hidden_size=hf['hidden_size'],
                 num_layers=hf['num_hidden_layers'],

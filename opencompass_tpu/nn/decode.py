@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from .config import TransformerConfig
-from .transformer import decode_step, init_cache, prefill
+from .transformer import decode_step, init_cache, prefill, token_positions
 
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
@@ -55,6 +55,15 @@ def greedy_generate(params, cfg: TransformerConfig, tokens: jax.Array,
     kv_valid = jnp.zeros((B, total), jnp.bool_)
     kv_valid = jax.lax.dynamic_update_slice_in_dim(
         kv_valid, pad_mask.astype(jnp.bool_), 0, axis=1)
+    # per-slot positions, tracked only when the attention bias reads them
+    # (pads are masked anyway; other models shouldn't pay the carry)
+    use_kv_pos = cfg.positional == 'alibi'
+    if use_kv_pos:
+        kv_pos = jnp.zeros((B, total), jnp.int32)
+        kv_pos = jax.lax.dynamic_update_slice_in_dim(
+            kv_pos, token_positions(pad_mask), 0, axis=1)
+    else:
+        kv_pos = jnp.zeros((B, 0), jnp.int32)  # empty carry placeholder
 
     rng, key = jax.random.split(rng)
     first = _sample(logits, key, temperature, top_k)
@@ -69,15 +78,21 @@ def greedy_generate(params, cfg: TransformerConfig, tokens: jax.Array,
         done = done | (first == eos_token_id)
 
     def cond(carry):
-        step, _, _, _, _, done, _, _ = carry
+        step, _, _, _, _, _, done, _, _ = carry
         return (step < max_new_tokens) & ~jnp.all(done)
 
     def body(carry):
-        step, token, cache, kv_valid, positions, done, out, rng = carry
+        (step, token, cache, kv_valid, kv_pos, positions, done, out,
+         rng) = carry
         slot = S + step - 1  # slot where `token` (emitted at step-1) lives
-        kv_valid = kv_valid | (jnp.arange(total)[None, :] == slot)
+        is_slot = jnp.arange(total)[None, :] == slot
+        kv_valid = kv_valid | is_slot
+        if use_kv_pos:
+            kv_pos = jnp.where(is_slot, positions[:, None], kv_pos)
         logits, cache = decode_step(params, cfg, token, cache, slot,
-                                    positions, kv_valid)
+                                    positions, kv_valid,
+                                    kv_positions=kv_pos if use_kv_pos
+                                    else None)
         rng, key = jax.random.split(rng)
         nxt = _sample(logits, key, temperature, top_k).astype(token.dtype)
         nxt = jnp.where(done, jnp.asarray(pad_token_id, token.dtype), nxt)
@@ -85,11 +100,12 @@ def greedy_generate(params, cfg: TransformerConfig, tokens: jax.Array,
             out, nxt[:, None], step, axis=1)
         if eos_token_id is not None:
             done = done | (nxt == eos_token_id)
-        return (step + 1, nxt, cache, kv_valid, positions + 1, done, out, rng)
+        return (step + 1, nxt, cache, kv_valid, kv_pos, positions + 1,
+                done, out, rng)
 
     carry = (jnp.asarray(1), first.astype(tokens.dtype), cache, kv_valid,
-             next_pos, done, out, rng)
-    step, _, _, _, _, _, out, _ = jax.lax.while_loop(cond, body, carry)
+             kv_pos, next_pos, done, out, rng)
+    step, _, _, _, _, _, _, out, _ = jax.lax.while_loop(cond, body, carry)
 
     if eos_token_id is not None:
         # length = index of first EOS + 1, or max_new_tokens

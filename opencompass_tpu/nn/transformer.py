@@ -162,6 +162,30 @@ def _linear_nt(x, p):
     return y
 
 
+def _alibi_slopes(n_heads: int) -> jax.Array:
+    """Per-head ALiBi slopes (closed form from the paper; non-power-of-two
+    head counts interpolate from the next power of two)."""
+    import math
+
+    def pow2(k):
+        start = 2.0 ** (-(2.0 ** -(math.log2(k) - 3)))
+        return [start ** (i + 1) for i in range(k)]
+
+    if math.log2(n_heads).is_integer():
+        slopes = pow2(n_heads)
+    else:
+        k = 2 ** math.floor(math.log2(n_heads))
+        slopes = pow2(k) + pow2(2 * k)[0::2][:n_heads - k]
+    return jnp.asarray(slopes, jnp.float32)
+
+
+def _alibi_bias(cfg: TransformerConfig, q_pos, kv_pos) -> jax.Array:
+    """(B, H, T, S) additive attention bias: -slope * distance-to-past."""
+    rel = (kv_pos[:, None, :] - q_pos[:, :, None]).astype(jnp.float32)
+    slopes = _alibi_slopes(cfg.num_heads)
+    return slopes[None, :, None, None] * rel[:, None, :, :]
+
+
 def _rope(x, positions, theta: float):
     """HF-convention RoPE: rotate halves.  x: (B, T, H, hd)."""
     hd = x.shape[-1]
@@ -174,9 +198,10 @@ def _rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
-def _attention(q, k, v, mask, cfg: TransformerConfig):
+def _attention(q, k, v, mask, cfg: TransformerConfig, bias=None):
     """Grouped-query attention.  q: (B,T,H,hd); k,v: (B,S,K,hd);
-    mask: (B,T,S) boolean (True = attend).  fp32 softmax accumulation."""
+    mask: (B,T,S) boolean (True = attend); bias: optional (B,H,T,S)
+    additive fp32 scores (ALiBi).  fp32 softmax accumulation."""
     B, T, H, hd = q.shape
     S, K = k.shape[1], k.shape[2]
     G = H // K
@@ -184,6 +209,8 @@ def _attention(q, k, v, mask, cfg: TransformerConfig):
     scores = jnp.einsum('btkgh,bskh->bkgts', qg, k,
                         preferred_element_type=jnp.float32)
     scores = scores * (hd ** -0.5)
+    if bias is not None:
+        scores = scores + bias.reshape(B, K, G, T, S)
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum('bkgts,bskh->btkgh', probs.astype(v.dtype), v)
@@ -191,7 +218,8 @@ def _attention(q, k, v, mask, cfg: TransformerConfig):
 
 
 def _block(cfg: TransformerConfig, x, lp, positions, mask,
-           cache_slice=None, cache_index=None, attn_fn=None):
+           cache_slice=None, cache_index=None, attn_fn=None,
+           kv_positions=None):
     """One transformer block.  x: (B,T,D).  With a cache slice, K/V for the
     current tokens are written at ``cache_index`` and attention runs over the
     whole cache; without, attention is over the current sequence only.
@@ -224,7 +252,11 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
     if attn_fn is not None:
         attn = attn_fn(q, k, v)
     else:
-        attn = _attention(q, k, v, mask, cfg)
+        bias = None
+        if cfg.positional == 'alibi':
+            kv_pos = kv_positions if kv_positions is not None else positions
+            bias = _alibi_bias(cfg, positions, kv_pos)
+        attn = _attention(q, k, v, mask, cfg, bias=bias)
     attn = _linear(attn.reshape(B, T, cfg.q_dim), lp['o'])
     attn = _shard(attn, P('data', None, None))
 
@@ -252,10 +284,11 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
 
 
 def _stack(cfg: TransformerConfig, x, layers, positions, mask,
-           cache=None, cache_index=None, attn_fn=None):
+           cache=None, cache_index=None, attn_fn=None, kv_positions=None):
     """Run the block stack via lax.scan over stacked layer params."""
     def block(cfg, *args, **kw):
-        return _block(cfg, *args, attn_fn=attn_fn, **kw)
+        return _block(cfg, *args, attn_fn=attn_fn,
+                      kv_positions=kv_positions, **kw)
     if cfg.remat:
         block = jax.checkpoint(
             block, static_argnums=(0,),
@@ -304,6 +337,14 @@ def _stack(cfg: TransformerConfig, x, layers, positions, mask,
     return x, new_cache
 
 
+def token_positions(pad_mask) -> jax.Array:
+    """Per-example positions = cumulative count of real tokens (pads share
+    position 0 and are never attended to).  The single source of the
+    position convention for forward, prefill, and the decode loop."""
+    positions = jnp.cumsum(pad_mask.astype(jnp.int32), axis=-1) - 1
+    return jnp.maximum(positions, 0)
+
+
 def _embed(params, cfg: TransformerConfig, tokens, positions):
     x = params['embed'][tokens].astype(cfg.jnp_dtype)
     if cfg.positional == 'learned':
@@ -341,11 +382,10 @@ def forward(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     if pad_mask is None:
         pad_mask = jnp.ones((B, S), jnp.bool_)
     pad_mask = pad_mask.astype(jnp.bool_)
-    positions = jnp.cumsum(pad_mask, axis=-1) - 1
-    positions = jnp.maximum(positions, 0)
+    positions = token_positions(pad_mask)
 
     attn_fn = None
-    if use_flash:
+    if use_flash and cfg.positional != 'alibi':
         from .flash import flash_attention as _flash
         from .flash import flash_supported
         if flash_supported(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, S):
@@ -381,8 +421,7 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     """
     B, S = tokens.shape
     pad_mask = pad_mask.astype(jnp.bool_)
-    positions = jnp.cumsum(pad_mask, axis=-1) - 1
-    positions = jnp.maximum(positions, 0)
+    positions = token_positions(pad_mask)
     # prompt token i occupies cache slot i → query i may attend slots j <= i
     causal = jnp.tril(jnp.ones((S, cache['k'].shape[2]), jnp.bool_))
     # valid kv slots during prefill: the first S slots, minus pads
@@ -390,8 +429,13 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     kv_valid = jax.lax.dynamic_update_slice_in_dim(kv_valid, pad_mask, 0,
                                                    axis=1)
     mask = causal[None, :, :] & kv_valid[:, None, :]
+    # per-slot positions for position-dependent attention bias (ALiBi)
+    kv_positions = jnp.zeros((B, cache['k'].shape[2]), positions.dtype)
+    kv_positions = jax.lax.dynamic_update_slice_in_dim(
+        kv_positions, positions, 0, axis=1)
     x = _embed(params, cfg, tokens, positions)
-    x, cache = _stack(cfg, x, params['layers'], positions, mask, cache, 0)
+    x, cache = _stack(cfg, x, params['layers'], positions, mask, cache, 0,
+                      kv_positions=kv_positions)
     logits = _unembed(params, cfg, x[:, -1:, :])[:, 0, :]
     next_pos = positions[:, -1] + 1
     return logits, cache, next_pos
@@ -399,14 +443,21 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
 
 def decode_step(params: Params, cfg: TransformerConfig, token: jax.Array,
                 cache: Dict, slot: jax.Array, positions: jax.Array,
-                kv_valid: jax.Array) -> Tuple[jax.Array, Dict]:
+                kv_valid: jax.Array,
+                kv_positions: Optional[jax.Array] = None
+                ) -> Tuple[jax.Array, Dict]:
     """One autoregressive step.  token: (B,); slot: scalar cache index;
     positions: (B,) rope positions for this token; kv_valid: (B, S_max)
-    validity after this token is written.  Returns (logits (B,V), cache)."""
+    validity after this token is written; kv_positions: (B, S_max)
+    per-slot positions (needed for ALiBi).  Returns (logits (B,V), cache).
+    """
+    if cfg.positional == 'alibi' and kv_positions is None:
+        raise ValueError('ALiBi models need kv_positions (per-cache-slot '
+                         'positions) in decode_step')
     B = token.shape[0]
     x = _embed(params, cfg, token[:, None], positions[:, None])
     mask = kv_valid[:, None, :]
     x, cache = _stack(cfg, x, params['layers'], positions[:, None], mask,
-                      cache, slot)
+                      cache, slot, kv_positions=kv_positions)
     logits = _unembed(params, cfg, x)[:, 0, :]
     return logits, cache

@@ -88,6 +88,8 @@ def ring_forward(params, cfg, tokens: jax.Array, pad_mask: jax.Array,
 
     n_seq = mesh.shape['seq']
     B, S = tokens.shape
+    assert cfg.positional != 'alibi', \
+        'ring attention does not support ALiBi positional bias yet'
     assert S % n_seq == 0, f'seq len {S} not divisible by seq axis {n_seq}'
     assert mesh.shape.get('model', 1) == 1, \
         'ring_forward supports data+seq meshes (model axis must be 1)'

@@ -126,6 +126,7 @@ def test_tensor_parallel_decode_matches(tiny):
     dict(parallel_residual=True, norm='layernorm', gated_mlp=False,
          activation='gelu', num_kv_heads=1),           # Falcon-style MQA
     dict(qkv_bias=True, num_kv_heads=2),               # Qwen2-style GQA
+    dict(positional='alibi'),                          # Baichuan-13B style
 ])
 def test_architecture_variants_run(family_kw):
     cfg = TransformerConfig.tiny(**family_kw)
@@ -136,6 +137,67 @@ def test_architecture_variants_run(family_kw):
     assert logits.shape == (2, 8, cfg.vocab_size)
     out, _ = greedy_generate(params, cfg, toks, jnp.ones((2, 8), bool), 3)
     assert out.shape == (2, 3)
+
+
+def test_alibi_decode_matches_teacher_forcing():
+    """ALiBi bias must agree between the full forward and the cached
+    decode path (per-slot kv positions)."""
+    cfg = TransformerConfig.tiny(positional='alibi')
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0,
+                                cfg.vocab_size)
+    pmask = jnp.ones((2, 8), bool)
+    out, _ = greedy_generate(params, cfg, prompt, pmask, 6)
+    full = jnp.concatenate([prompt, out], axis=1)
+    ref = jnp.argmax(forward(params, cfg, full), axis=-1)
+    for i in range(6):
+        assert bool(jnp.all(ref[:, 7 + i] == out[:, i])), f'step {i}'
+    # left-padding invariance: slot index != position, bias must follow
+    # positions, not slots
+    padded = jnp.concatenate(
+        [jnp.zeros((2, 3), prompt.dtype), prompt], axis=1)
+    padmask = jnp.concatenate([jnp.zeros((2, 3), bool), pmask], axis=1)
+    out2, _ = greedy_generate(params, cfg, padded, padmask, 6)
+    assert bool(jnp.all(out == out2))
+
+
+def test_alibi_bias_applied_and_shaped():
+    """The bias actually reaches the scores (zeroing it changes logits)
+    and follows the paper's slope/distance form."""
+    from unittest import mock
+
+    from opencompass_tpu.nn import transformer as T
+
+    slopes = np.asarray(T._alibi_slopes(8))
+    assert slopes.shape == (8,)
+    assert np.all(np.diff(slopes) < 0) and slopes[0] == 0.5
+    q_pos = jnp.asarray([[2, 3]])
+    kv_pos = jnp.asarray([[0, 1, 2, 3]])
+    bias = np.asarray(T._alibi_bias(
+        TransformerConfig.tiny(positional='alibi'), q_pos, kv_pos))
+    # head 0 slope for 4 heads is 2^-2; distance 2 → bias -1.0
+    assert bias[0, 0, 0, 0] == pytest.approx(-0.25 * 2)
+
+    cfg = TransformerConfig.tiny(positional='alibi')
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(4), (1, 8), 0,
+                              cfg.vocab_size)
+    with_bias = np.asarray(forward(params, cfg, toks))
+    with mock.patch.object(T, '_alibi_bias',
+                           lambda *a: jnp.zeros((1, cfg.num_heads, 8, 8))):
+        without = np.asarray(forward(params, cfg, toks))
+    assert not np.allclose(with_bias, without)
+
+
+def test_baichuan_13b_maps_to_alibi():
+    hf = dict(model_type='baichuan', vocab_size=64000, hidden_size=5120,
+              num_hidden_layers=40, num_attention_heads=40,
+              intermediate_size=13696, max_position_embeddings=4096)
+    cfg = TransformerConfig.from_hf_config(hf)
+    assert cfg.positional == 'alibi'
+    hf7b = dict(hf, hidden_size=4096, num_hidden_layers=32,
+                num_attention_heads=32, intermediate_size=11008)
+    assert TransformerConfig.from_hf_config(hf7b).positional == 'rope'
 
 
 def test_scan_vs_unrolled_layers_match(tiny):
