@@ -185,6 +185,10 @@ class JaxLM(BaseModel):
             raise ValueError(
                 'combining model (tensor) and seq (ring attention) axes is '
                 'not supported yet; pick one of model>1 or seq>1')
+        if parallel.get('seq', 1) > 1 and self.cfg is not None \
+                and self.cfg.positional == 'alibi':
+            raise ValueError('ring attention (seq>1) does not support '
+                             'ALiBi models yet; use data/model axes')
         spec = MeshSpec(data=parallel.get('data', -1),
                         model=parallel.get('model', 1),
                         seq=parallel.get('seq', 1))

@@ -16,7 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from .config import TransformerConfig
-from .transformer import decode_step, init_cache, prefill, token_positions
+from .transformer import decode_step, init_cache, prefill, slot_positions
 
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
@@ -59,9 +59,7 @@ def greedy_generate(params, cfg: TransformerConfig, tokens: jax.Array,
     # (pads are masked anyway; other models shouldn't pay the carry)
     use_kv_pos = cfg.positional == 'alibi'
     if use_kv_pos:
-        kv_pos = jnp.zeros((B, total), jnp.int32)
-        kv_pos = jax.lax.dynamic_update_slice_in_dim(
-            kv_pos, token_positions(pad_mask), 0, axis=1)
+        kv_pos = slot_positions(pad_mask, total)
     else:
         kv_pos = jnp.zeros((B, 0), jnp.int32)  # empty carry placeholder
 

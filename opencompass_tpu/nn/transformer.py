@@ -345,6 +345,15 @@ def token_positions(pad_mask) -> jax.Array:
     return jnp.maximum(positions, 0)
 
 
+def slot_positions(pad_mask, total: int) -> jax.Array:
+    """(B, total) positions of cache slots [0, S) filled by a prompt; the
+    decode loop appends positions for later slots as it writes them."""
+    B = pad_mask.shape[0]
+    out = jnp.zeros((B, total), jnp.int32)
+    return jax.lax.dynamic_update_slice_in_dim(
+        out, token_positions(pad_mask), 0, axis=1)
+
+
 def _embed(params, cfg: TransformerConfig, tokens, positions):
     x = params['embed'][tokens].astype(cfg.jnp_dtype)
     if cfg.positional == 'learned':
@@ -430,9 +439,7 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
                                                    axis=1)
     mask = causal[None, :, :] & kv_valid[:, None, :]
     # per-slot positions for position-dependent attention bias (ALiBi)
-    kv_positions = jnp.zeros((B, cache['k'].shape[2]), positions.dtype)
-    kv_positions = jax.lax.dynamic_update_slice_in_dim(
-        kv_positions, positions, 0, axis=1)
+    kv_positions = slot_positions(pad_mask, cache['k'].shape[2])
     x = _embed(params, cfg, tokens, positions)
     x, cache = _stack(cfg, x, params['layers'], positions, mask, cache, 0,
                       kv_positions=kv_positions)

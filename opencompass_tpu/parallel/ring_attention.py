@@ -88,8 +88,11 @@ def ring_forward(params, cfg, tokens: jax.Array, pad_mask: jax.Array,
 
     n_seq = mesh.shape['seq']
     B, S = tokens.shape
-    assert cfg.positional != 'alibi', \
-        'ring attention does not support ALiBi positional bias yet'
+    if cfg.positional == 'alibi':
+        # not an assert: `python -O` would strip it and silently compute
+        # attention without the ALiBi bias (wrong logits for every sample)
+        raise ValueError('ring attention does not support ALiBi positional '
+                         'bias yet; run ALiBi models without a seq axis')
     assert S % n_seq == 0, f'seq len {S} not divisible by seq axis {n_seq}'
     assert mesh.shape.get('model', 1) == 1, \
         'ring_forward supports data+seq meshes (model axis must be 1)'
