@@ -38,6 +38,7 @@ class TransformerConfig:
     mlp_bias: bool = False
     gated_mlp: bool = True            # llama gate/up/down; False = fc1/fc2
     parallel_residual: bool = False   # falcon/gpt-neox style
+    embed_norm: bool = False          # bloom: LayerNorm after embedding
     final_norm: bool = True
     # learned-positional models (OPT) offset position ids by 2
     pos_offset: int = 0
@@ -126,6 +127,20 @@ class TransformerConfig:
             parallel_residual=True, tie_embeddings=True, **kw)
 
     @staticmethod
+    def bloom(vocab_size=250880, hidden_size=1024, num_layers=24,
+              num_heads=16, max_seq_len=2048, **kw):
+        """BLOOM family: ALiBi positions, LayerNorm (incl. one after the
+        embedding), plain GELU MLP, all biases, tied embeddings."""
+        return TransformerConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_heads, head_dim=hidden_size // num_heads,
+            intermediate_size=4 * hidden_size, max_seq_len=max_seq_len,
+            activation='gelu_new', norm='layernorm', positional='alibi',
+            tie_embeddings=True, embed_norm=True, qkv_bias=True,
+            o_bias=True, mlp_bias=True, gated_mlp=False, **kw)
+
+    @staticmethod
     def tiny(vocab_size=512, hidden_size=64, num_layers=2, num_heads=4,
              num_kv_heads=2, intermediate_size=128, max_seq_len=256, **kw):
         """Hermetic-test scale."""
@@ -188,13 +203,29 @@ class TransformerConfig:
                 num_heads=hf['n_head'],
                 intermediate_size=hf.get('n_inner') or 4 * hf['n_embd'],
                 max_seq_len=hf.get('n_positions', 1024))
+        if mt == 'bloom':
+            return TransformerConfig.bloom(
+                vocab_size=hf['vocab_size'],
+                hidden_size=hf.get('hidden_size', hf.get('n_embed')),
+                num_layers=hf.get('num_hidden_layers', hf.get('n_layer')),
+                num_heads=hf.get('num_attention_heads', hf.get('n_head')),
+                norm_eps=hf.get('layer_norm_epsilon', 1e-5))
         if mt == 'falcon':
+            # config.json keeps num_kv_heads == num_heads even for MQA
+            # checkpoints; the runtime collapses K/V to 1 head whenever
+            # multi_query is set without the new (grouped) architecture
+            if hf.get('new_decoder_architecture'):
+                num_kv = hf.get('num_kv_heads', 1)
+            elif hf.get('multi_query', True):
+                num_kv = 1
+            else:
+                num_kv = hf['num_attention_heads']
             return TransformerConfig.falcon(
                 vocab_size=hf['vocab_size'],
                 hidden_size=hf['hidden_size'],
                 num_layers=hf['num_hidden_layers'],
                 num_heads=hf['num_attention_heads'],
-                num_kv_heads=hf.get('num_kv_heads', 1),
+                num_kv_heads=num_kv,
                 intermediate_size=4 * hf['hidden_size'],
                 max_seq_len=2048)
         raise ValueError(f'unsupported model_type: {mt!r}')

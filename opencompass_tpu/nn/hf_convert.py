@@ -164,6 +164,42 @@ _FALCON_MAP = {
         (('layers', 'fc2', 'w'), True),
 }
 
+# BLOOM: fused query_key_value interleaved PER HEAD ([q_h|k_h|v_h] blocks),
+# plus an embedding LayerNorm; lm_head tied to word_embeddings.
+_BLOOM_MAP = {
+    r'(?:transformer\.)?word_embeddings\.weight': (('embed',), False),
+    r'(?:transformer\.)?word_embeddings_layernorm\.weight':
+        (('embed_norm', 'scale'), False),
+    r'(?:transformer\.)?word_embeddings_layernorm\.bias':
+        (('embed_norm', 'bias'), False),
+    r'(?:transformer\.)?ln_f\.weight': (('final_norm', 'scale'), False),
+    r'(?:transformer\.)?ln_f\.bias': (('final_norm', 'bias'), False),
+    r'(?:transformer\.)?h\.(\d+)\.input_layernorm\.weight':
+        (('layers', 'attn_norm', 'scale'), False),
+    r'(?:transformer\.)?h\.(\d+)\.input_layernorm\.bias':
+        (('layers', 'attn_norm', 'bias'), False),
+    r'(?:transformer\.)?h\.(\d+)\.post_attention_layernorm\.weight':
+        (('layers', 'mlp_norm', 'scale'), False),
+    r'(?:transformer\.)?h\.(\d+)\.post_attention_layernorm\.bias':
+        (('layers', 'mlp_norm', 'bias'), False),
+    r'(?:transformer\.)?h\.(\d+)\.self_attention\.query_key_value\.weight':
+        (('layers', '_qkv_bloom', 'w'), False),
+    r'(?:transformer\.)?h\.(\d+)\.self_attention\.query_key_value\.bias':
+        (('layers', '_qkv_bloom', 'b'), False),
+    r'(?:transformer\.)?h\.(\d+)\.self_attention\.dense\.weight':
+        (('layers', 'o', 'w'), True),
+    r'(?:transformer\.)?h\.(\d+)\.self_attention\.dense\.bias':
+        (('layers', 'o', 'b'), False),
+    r'(?:transformer\.)?h\.(\d+)\.mlp\.dense_h_to_4h\.weight':
+        (('layers', 'fc1', 'w'), True),
+    r'(?:transformer\.)?h\.(\d+)\.mlp\.dense_h_to_4h\.bias':
+        (('layers', 'fc1', 'b'), False),
+    r'(?:transformer\.)?h\.(\d+)\.mlp\.dense_4h_to_h\.weight':
+        (('layers', 'fc2', 'w'), True),
+    r'(?:transformer\.)?h\.(\d+)\.mlp\.dense_4h_to_h\.bias':
+        (('layers', 'fc2', 'b'), False),
+}
+
 # InternLM2: fused grouped wqkv [per kv group: ratio q heads | k | v].
 _INTERNLM2_MAP = {
     r'model\.tok_embeddings\.weight': (('embed',), False),
@@ -189,7 +225,7 @@ _FAMILY_MAPS = {
     'llama': _LLAMA_MAP, 'mistral': _LLAMA_MAP, 'qwen2': _LLAMA_MAP,
     'internlm': _LLAMA_MAP, 'internlm2': _INTERNLM2_MAP,
     'baichuan': _BAICHUAN_MAP, 'falcon': _FALCON_MAP,
-    'opt': _OPT_MAP, 'gpt2': _GPT2_MAP,
+    'opt': _OPT_MAP, 'gpt2': _GPT2_MAP, 'bloom': _BLOOM_MAP,
 }
 
 
@@ -223,10 +259,13 @@ def _iter_checkpoint_tensors(path: str):
 def _split_fused_qkv(layers: Dict, cfg: TransformerConfig):
     """Split family-specific fused QKV projections into q/k/v.
 
-    All fused weights arrive here already transposed to (L, in, fused_out);
-    the split q/k/v are re-transposed to the canonical (L, out, in).
+    Most fused weights arrive here already transposed to (L, in,
+    fused_out) and their q/k/v splits are re-transposed to the canonical
+    (L, out, in); ``_qkv_bloom`` instead stays in torch orientation
+    (L, 3*D, D) because its per-head interleave splits naturally there.
     - ``_qkv``: GPT-2 c_attn, [D q | D k | D v].
     - ``_qkv_mqa``: Falcon, [n_head*hd q | hd k | hd v].
+    - ``_qkv_bloom``: BLOOM, per-head [q_h | k_h | v_h] blocks, (out, in).
     - ``_wqkv_grouped``: InternLM2, per-kv-group [ratio q heads | k | v].
     - ``_wpack``: Baichuan, [D q | D k | D v] (MHA thirds).
     """
@@ -247,6 +286,19 @@ def _split_fused_qkv(layers: Dict, cfg: TransformerConfig):
             layers['q']['b'] = b[:, :D]
             layers['k']['b'] = b[:, D:2 * D]
             layers['v']['b'] = b[:, 2 * D:]
+    if '_qkv_bloom' in layers:
+        fused = layers.pop('_qkv_bloom')
+        w = fused['w']                      # (L, 3*D, D): [qh|kh|vh]/head
+        L = w.shape[0]
+        g = w.reshape(L, H, 3, hd, D)
+        for i, name in enumerate(('q', 'k', 'v')):
+            layers[name] = {'w': np.ascontiguousarray(
+                g[:, :, i].reshape(L, H * hd, D))}
+        if 'b' in fused:
+            b = fused['b'].reshape(L, H, 3, hd)
+            for i, name in enumerate(('q', 'k', 'v')):
+                layers[name]['b'] = np.ascontiguousarray(
+                    b[:, :, i].reshape(L, H * hd))
     if '_qkv_mqa' in layers:
         w = layers.pop('_qkv_mqa')['w']     # (L, D, (H+2K)*hd)
         q_dim = H * hd

@@ -44,6 +44,7 @@ _TOP_SPECS = {
     'pos_embed': P(None, None),
     'lm_head': P(None, 'model'),      # vocab-sharded logits
     'final_norm': {'scale': P(None), 'bias': P(None)},
+    'embed_norm': {'scale': P(None), 'bias': P(None)},
 }
 
 
@@ -52,6 +53,8 @@ def param_specs(cfg: TransformerConfig) -> Dict:
     specs: Dict = {'embed': _TOP_SPECS['embed'], 'layers': {}}
     if cfg.positional == 'learned':
         specs['pos_embed'] = _TOP_SPECS['pos_embed']
+    if cfg.embed_norm:
+        specs['embed_norm'] = dict(_TOP_SPECS['embed_norm'])
     if cfg.final_norm:
         specs['final_norm'] = {'scale': P(None)}
         if cfg.norm == 'layernorm':

@@ -100,6 +100,9 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
     if cfg.positional == 'learned':
         params['pos_embed'] = dense(
             next(keys), (cfg.max_seq_len + cfg.pos_offset, D), scale=0.02)
+    if cfg.embed_norm:
+        params['embed_norm'] = {'scale': jnp.ones((D,), dtype),
+                                'bias': jnp.zeros((D,), dtype)}
     if cfg.final_norm:
         params['final_norm'] = {'scale': jnp.ones((D,), dtype)}
         if cfg.norm == 'layernorm':
@@ -360,6 +363,8 @@ def _embed(params, cfg: TransformerConfig, tokens, positions):
         pos = jnp.clip(positions + cfg.pos_offset, 0,
                        params['pos_embed'].shape[0] - 1)
         x = x + params['pos_embed'][pos].astype(cfg.jnp_dtype)
+    if cfg.embed_norm:
+        x = _norm(x, params['embed_norm'], cfg)
     return _shard(x, P('data', None, None))
 
 

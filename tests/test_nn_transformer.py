@@ -127,6 +127,10 @@ def test_tensor_parallel_decode_matches(tiny):
          activation='gelu', num_kv_heads=1),           # Falcon-style MQA
     dict(qkv_bias=True, num_kv_heads=2),               # Qwen2-style GQA
     dict(positional='alibi'),                          # Baichuan-13B style
+    dict(positional='alibi', norm='layernorm', embed_norm=True,
+         gated_mlp=False, activation='gelu_new', qkv_bias=True,
+         o_bias=True, mlp_bias=True, tie_embeddings=True,
+         num_kv_heads=4),                              # BLOOM style (MHA)
 ])
 def test_architecture_variants_run(family_kw):
     cfg = TransformerConfig.tiny(**family_kw)

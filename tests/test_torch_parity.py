@@ -108,6 +108,25 @@ def test_gpt2_parity(tmp_path):
 
 
 @pytest.mark.slow
+def test_bloom_alibi_parity(tmp_path):
+    # cross-checks our ALiBi bias math against torch's implementation
+    cfg = transformers.BloomConfig(
+        vocab_size=128, hidden_size=64, n_layer=2, n_head=4,
+        attn_implementation='eager')
+    _compare(tmp_path, _make(transformers.BloomForCausalLM, cfg), 128)
+
+
+@pytest.mark.slow
+def test_falcon_mqa_parity(tmp_path):
+    cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, new_decoder_architecture=False,
+        multi_query=True, parallel_attn=True, bias=False, alibi=False,
+        attn_implementation='eager')
+    _compare(tmp_path, _make(transformers.FalconForCausalLM, cfg), 128)
+
+
+@pytest.mark.slow
 def test_qwen2_parity(tmp_path):
     cfg = transformers.Qwen2Config(
         vocab_size=128, hidden_size=64, intermediate_size=128,
