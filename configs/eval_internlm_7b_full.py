@@ -1,0 +1,10 @@
+"""BASELINE milestone 3: InternLM-7B over the full dataset collection,
+size-partitioned across every available chip/host.
+
+    python run.py configs/eval_internlm_7b_full.py --max-partition-size 2000
+"""
+with read_base():
+    from .datasets.collections.base_full import datasets
+    from .models.jax_internlm_7b import models
+
+work_dir = './outputs/internlm_7b_full'

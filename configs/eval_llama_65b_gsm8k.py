@@ -1,0 +1,12 @@
+"""BASELINE milestone 4: Llama-65B on GSM8K chain-of-thought, 8-way
+tensor parallel.
+
+    python run.py configs/eval_llama_65b_gsm8k.py
+"""
+with read_base():
+    from .datasets.gsm8k.gsm8k_gen import gsm8k_datasets
+    from .models.jax_llama_65b import models
+
+datasets = [*gsm8k_datasets]
+
+work_dir = './outputs/llama_65b_gsm8k'

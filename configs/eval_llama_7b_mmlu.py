@@ -1,0 +1,14 @@
+"""BASELINE milestone 2: Llama-7B on MMLU 5-shot generation, one chip.
+
+    python run.py configs/eval_llama_7b_mmlu.py
+"""
+with read_base():
+    from .datasets.mmlu.mmlu_gen import mmlu_datasets
+    from .models.jax_llama_7b import models
+    from .summarizers.groups.mmlu import mmlu_summary_groups
+
+datasets = [*mmlu_datasets]
+
+summarizer = dict(summary_groups=mmlu_summary_groups)
+
+work_dir = './outputs/llama_7b_mmlu'

@@ -1,0 +1,13 @@
+"""BASELINE milestone 5: Falcon-40B + Baichuan-13B mixed sweep over the
+medium collection, size-partitioned (multi-slice scheduling).
+
+    python run.py configs/eval_mixed_sweep.py --max-partition-size 2000
+"""
+with read_base():
+    from .datasets.collections.base_medium import datasets
+    from .models.jax_falcon_40b import models as falcon_models
+    from .models.jax_baichuan_13b import models as baichuan_models
+
+models = [*falcon_models, *baichuan_models]
+
+work_dir = './outputs/mixed_sweep'

@@ -1,0 +1,11 @@
+"""BASELINE milestone 1: OPT-125M over the demo PPL suite (single host).
+
+    python run.py configs/eval_opt125m_demo.py --debug
+"""
+with read_base():
+    from .datasets.demo.demo_ppl import demo_ppl_datasets
+    from .models.jax_opt125m import models
+
+datasets = [*demo_ppl_datasets]
+
+work_dir = './outputs/opt125m_demo'

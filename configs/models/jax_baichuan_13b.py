@@ -1,0 +1,17 @@
+"""Baichuan-13B: ALiBi attention, tensor-parallel over 2 chips."""
+from opencompass_tpu.models import JaxLM
+
+models = [
+    dict(type=JaxLM,
+         abbr='baichuan-13b-jax',
+         path='./models/baichuan-13b-hf',
+         config=dict(preset='llama', vocab_size=64000, hidden_size=5120,
+                     num_layers=40, num_heads=40,
+                     intermediate_size=13696, positional='alibi'),
+         max_seq_len=2048,
+         batch_size=8,
+         max_out_len=100,
+         dtype='bfloat16',
+         parallel=dict(data=-1, model=2),
+         run_cfg=dict(num_devices=2)),
+]

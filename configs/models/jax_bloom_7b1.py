@@ -1,11 +1,12 @@
-"""LLaMA-7B through the TPU-native JaxLM (HF checkpoint dir)."""
+"""BLOOM-7B1 through JaxLM (ALiBi + embedding LayerNorm)."""
 from opencompass_tpu.models import JaxLM
 
 models = [
     dict(type=JaxLM,
-         abbr='llama-7b-jax',
-         path='./models/llama-7b-hf',   # HF checkpoint dir (config+shards)
-         config=dict(preset='llama'),
+         abbr='bloom-7b1-jax',
+         path='./models/bloom-7b1-hf',
+         config=dict(preset='bloom', vocab_size=250880, hidden_size=4096,
+                     num_layers=30, num_heads=32),
          max_seq_len=2048,
          batch_size=16,
          max_out_len=100,

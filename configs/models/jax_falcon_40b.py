@@ -1,0 +1,17 @@
+"""Falcon-40B: tensor-parallel over 8 chips (grouped-KV fused QKV)."""
+from opencompass_tpu.models import JaxLM
+
+models = [
+    dict(type=JaxLM,
+         abbr='falcon-40b-jax',
+         path='./models/falcon-40b-hf',
+         config=dict(preset='falcon', hidden_size=8192, num_layers=60,
+                     num_heads=128, num_kv_heads=8,
+                     intermediate_size=32768),
+         max_seq_len=2048,
+         batch_size=8,
+         max_out_len=100,
+         dtype='bfloat16',
+         parallel=dict(data=1, model=8),
+         run_cfg=dict(num_devices=8)),
+]

@@ -1,11 +1,11 @@
-"""LLaMA-7B through the TPU-native JaxLM (HF checkpoint dir)."""
+"""InternLM-7B through JaxLM (llama-family preset auto-detected)."""
 from opencompass_tpu.models import JaxLM
 
 models = [
     dict(type=JaxLM,
-         abbr='llama-7b-jax',
-         path='./models/llama-7b-hf',   # HF checkpoint dir (config+shards)
-         config=dict(preset='llama'),
+         abbr='internlm-7b-jax',
+         path='./models/internlm-7b-hf',
+         config=dict(preset='llama', vocab_size=103168),
          max_seq_len=2048,
          batch_size=16,
          max_out_len=100,

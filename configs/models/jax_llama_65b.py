@@ -5,6 +5,8 @@ models = [
     dict(type=JaxLM,
          abbr='llama-65b-jax',
          path='./models/llama-65b-hf',
+         config=dict(preset='llama', hidden_size=8192, num_layers=80,
+                     num_heads=64, intermediate_size=22016),
          max_seq_len=2048,
          batch_size=8,
          max_out_len=100,

@@ -5,6 +5,7 @@ models = [
     dict(type=JaxLM,
          abbr='llama-7b-jax-sp4',
          path='./models/llama-7b-hf',
+         config=dict(preset='llama'),
          max_seq_len=32768,
          batch_size=2,
          max_out_len=100,

@@ -5,6 +5,7 @@ models = [
     dict(type=JaxLM,
          abbr='opt125m-jax',
          path='./models/opt-125m',
+         config='opt',
          max_seq_len=2048,
          batch_size=32,
          max_out_len=100,

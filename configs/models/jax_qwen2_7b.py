@@ -1,0 +1,15 @@
+"""Qwen2-7B through JaxLM (GQA + QKV biases)."""
+from opencompass_tpu.models import JaxLM
+
+models = [
+    dict(type=JaxLM,
+         abbr='qwen2-7b-jax',
+         path='./models/qwen2-7b-hf',
+         config=dict(preset='qwen2'),
+         max_seq_len=4096,
+         batch_size=16,
+         max_out_len=100,
+         dtype='bfloat16',
+         parallel=dict(data=-1, model=1),
+         run_cfg=dict(num_devices=1)),
+]

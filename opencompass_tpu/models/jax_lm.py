@@ -131,8 +131,11 @@ class JaxLM(BaseModel):
             kw = dict(config)
             preset = kw.pop('preset', None)
             if preset:
-                cfg = dataclasses.replace(
-                    getattr(TransformerConfig, preset)(), **kw)
+                # call the preset with the overrides (NOT replace() on a
+                # built default) so derived fields — head_dim,
+                # num_kv_heads, intermediate_size — are recomputed from
+                # the overridden sizes
+                cfg = getattr(TransformerConfig, preset)(**kw)
             else:
                 cfg = TransformerConfig(**kw)
         elif path and os.path.isfile(os.path.join(path, 'config.json')):
