@@ -184,10 +184,6 @@ class JaxLM(BaseModel):
             # rather than re-uploading per jitted call
             self.params = jax.tree_util.tree_map(jnp.asarray, self.params)
             return
-        if parallel.get('model', 1) > 1 and parallel.get('seq', 1) > 1:
-            raise ValueError(
-                'combining model (tensor) and seq (ring attention) axes is '
-                'not supported yet; pick one of model>1 or seq>1')
         if parallel.get('seq', 1) > 1 and self.cfg is not None \
                 and self.cfg.positional == 'alibi':
             raise ValueError('ring attention (seq>1) does not support '
@@ -400,6 +396,14 @@ class JaxLM(BaseModel):
         return sub.tolist()
 
     def generate(self, inputs: List[str], max_out_len: int) -> List[str]:
+        if self.mesh is not None and self.mesh.shape.get('seq', 1) > 1 \
+                and not getattr(self, '_warned_seq_gen', False):
+            self._warned_seq_gen = True
+            logger.warning(
+                'generation does not use the seq (ring attention) axis; '
+                'decode work is replicated across it — size the seq axis '
+                'for scoring workloads, or use a data/model-only mesh for '
+                'generation tasks')
         gk = dict(self.generation_kwargs)
         if gk.get('do_sample', False):
             temperature = float(gk.get('temperature', 1.0))  # HF default

@@ -220,19 +220,35 @@ def _attention(q, k, v, mask, cfg: TransformerConfig, bias=None):
     return out.reshape(B, T, H, hd)
 
 
+def _row_parallel(x, p, tp_axis):
+    """Row-sharded linear inside shard_map: local matmul, psum over the
+    tensor-parallel axis, bias added once after the reduction (the bias is
+    replicated — adding it per shard would count it n_tp times)."""
+    y = jax.lax.psum(x @ p['w'], tp_axis)
+    if 'b' in p:
+        y = y + p['b']
+    return y
+
+
 def _block(cfg: TransformerConfig, x, lp, positions, mask,
            cache_slice=None, cache_index=None, attn_fn=None,
-           kv_positions=None):
+           kv_positions=None, tp_axis=None):
     """One transformer block.  x: (B,T,D).  With a cache slice, K/V for the
     current tokens are written at ``cache_index`` and attention runs over the
     whole cache; without, attention is over the current sequence only.
     ``attn_fn(q, k, v)`` overrides the attention op (ring attention plugs in
-    here); the default is full masked attention."""
+    here); the default is full masked attention.  ``tp_axis`` names a
+    manually-mapped tensor-parallel mesh axis (shard_map bodies, where the
+    GSPMD sharding constraints are inert): q/k/v/gate/up arrive
+    column-sharded so head/ffn dims below are local, and the o/down
+    projections psum over it."""
     B, T, D = x.shape
     h = _norm(x, lp['attn_norm'], cfg)
-    q = _linear_nt(h, lp['q']).reshape(B, T, cfg.num_heads, cfg.head_dim)
-    k = _linear_nt(h, lp['k']).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
-    v = _linear_nt(h, lp['v']).reshape(B, T, cfg.num_kv_heads, cfg.head_dim)
+    # head dims inferred (-1): under tp_axis the projections are local
+    # shards with num_heads/n_tp (and num_kv_heads/n_tp) heads
+    q = _linear_nt(h, lp['q']).reshape(B, T, -1, cfg.head_dim)
+    k = _linear_nt(h, lp['k']).reshape(B, T, -1, cfg.head_dim)
+    v = _linear_nt(h, lp['v']).reshape(B, T, -1, cfg.head_dim)
     q = _shard(q, P('data', None, 'model', None))
     k = _shard(k, P('data', None, 'model', None))
     v = _shard(v, P('data', None, 'model', None))
@@ -260,7 +276,11 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
             kv_pos = kv_positions if kv_positions is not None else positions
             bias = _alibi_bias(cfg, positions, kv_pos)
         attn = _attention(q, k, v, mask, cfg, bias=bias)
-    attn = _linear(attn.reshape(B, T, cfg.q_dim), lp['o'])
+    attn2d = attn.reshape(B, T, -1)
+    if tp_axis is None:
+        attn = _linear(attn2d, lp['o'])
+    else:
+        attn = _row_parallel(attn2d, lp['o'], tp_axis)
     attn = _shard(attn, P('data', None, None))
 
     if cfg.parallel_residual:
@@ -271,12 +291,15 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
         h2 = _norm(x, lp['mlp_norm'], cfg)
 
     if cfg.gated_mlp:
-        mlp = _linear(_shard(_act(_linear(h2, lp['gate']), cfg.activation)
-                             * _linear(h2, lp['up']),
-                             P('data', None, 'model')), lp['down'])
+        inner = _shard(_act(_linear(h2, lp['gate']), cfg.activation)
+                       * _linear(h2, lp['up']), P('data', None, 'model'))
+        mlp = _linear(inner, lp['down']) if tp_axis is None \
+            else _row_parallel(inner, lp['down'], tp_axis)
     else:
-        mlp = _linear(_shard(_act(_linear(h2, lp['fc1']), cfg.activation),
-                             P('data', None, 'model')), lp['fc2'])
+        inner = _shard(_act(_linear(h2, lp['fc1']), cfg.activation),
+                       P('data', None, 'model'))
+        mlp = _linear(inner, lp['fc2']) if tp_axis is None \
+            else _row_parallel(inner, lp['fc2'], tp_axis)
     mlp = _shard(mlp, P('data', None, None))
 
     if cfg.parallel_residual:
@@ -287,11 +310,12 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
 
 
 def _stack(cfg: TransformerConfig, x, layers, positions, mask,
-           cache=None, cache_index=None, attn_fn=None, kv_positions=None):
+           cache=None, cache_index=None, attn_fn=None, kv_positions=None,
+           tp_axis=None):
     """Run the block stack via lax.scan over stacked layer params."""
     def block(cfg, *args, **kw):
         return _block(cfg, *args, attn_fn=attn_fn,
-                      kv_positions=kv_positions, **kw)
+                      kv_positions=kv_positions, tp_axis=tp_axis, **kw)
     if cfg.remat:
         block = jax.checkpoint(
             block, static_argnums=(0,),

@@ -81,12 +81,20 @@ def ring_forward(params, cfg, tokens: jax.Array, pad_mask: jax.Array,
     mesh's ``seq`` axis (ring attention) and batch over ``data``.
 
     Same math as nn.transformer.forward — fp32 logits (B, S, V).  Requires
-    S divisible by the seq axis size; ``model`` axis must be 1 (combine
-    TP with ring attention later if a workload demands both).
+    S divisible by the seq axis size.  A ``model`` axis > 1 runs
+    Megatron-style tensor parallelism *inside* the shard_map: q/k/v and
+    gate/up weights stay column-sharded per device (heads/ffn local), the
+    o/down projections psum over the axis, and each device's ring spans
+    its own seq-axis column — so a 3D data×seq×model mesh serves
+    long-context and big-model scaling together.
     """
-    from opencompass_tpu.nn.transformer import _embed, _stack, _unembed
+    from opencompass_tpu.nn.sharding import _prune_to, param_specs
+    from opencompass_tpu.nn.transformer import (_embed, _stack, _unembed,
+                                                token_positions)
 
     n_seq = mesh.shape['seq']
+    n_tp = mesh.shape.get('model', 1)
+    tp_axis = 'model' if n_tp > 1 else None
     B, S = tokens.shape
     if cfg.positional == 'alibi':
         # not an assert: `python -O` would strip it and silently compute
@@ -94,11 +102,30 @@ def ring_forward(params, cfg, tokens: jax.Array, pad_mask: jax.Array,
         raise ValueError('ring attention does not support ALiBi positional '
                          'bias yet; run ALiBi models without a seq axis')
     assert S % n_seq == 0, f'seq len {S} not divisible by seq axis {n_seq}'
-    assert mesh.shape.get('model', 1) == 1, \
-        'ring_forward supports data+seq meshes (model axis must be 1)'
+    if n_tp > 1 and cfg.num_kv_heads % n_tp:
+        raise ValueError(f'num_kv_heads {cfg.num_kv_heads} not divisible '
+                         f'by model axis {n_tp}')
     pad_mask = pad_mask.astype(jnp.bool_)
-    positions = jnp.maximum(jnp.cumsum(pad_mask, axis=-1) - 1, 0)
+    positions = token_positions(pad_mask)
     T = S // n_seq
+
+    # per-leaf input specs: layer projections keep their Megatron sharding
+    # (locally-sharded compute + explicit psums).  The input embedding (and
+    # a tied unembedding) is consumed replicated — its gather/norm need the
+    # full hidden dim — but an untied lm_head keeps its vocab shard, so
+    # each TP device emits only its logits slice (out_specs puts the vocab
+    # dim on 'model'), avoiding an all-gather of the largest table and a
+    # duplicated (B,T,D)x(D,V) matmul per device.
+    specs = param_specs(cfg)
+    vocab_sharded = n_tp > 1 and not cfg.tie_embeddings
+    for name in ('embed', 'pos_embed'):
+        if name in specs:
+            specs[name] = P(None, None)
+    if 'lm_head' in specs and not vocab_sharded:
+        specs['lm_head'] = P(None, None)
+    param_in_specs = _prune_to(params, specs)
+    logits_spec = P('data', 'seq', 'model') if vocab_sharded \
+        else P('data', 'seq', None)
 
     def body(params, tokens_c, pad_c, pos_c):
         my = jax.lax.axis_index('seq')
@@ -110,13 +137,13 @@ def ring_forward(params, cfg, tokens: jax.Array, pad_mask: jax.Array,
         with manual_axes():
             x = _embed(params, cfg, tokens_c, pos_c)
             x, _ = _stack(cfg, x, params['layers'], pos_c, mask=None,
-                          attn_fn=attn_fn)
+                          attn_fn=attn_fn, tp_axis=tp_axis)
             return _unembed(params, cfg, x)
 
     f = jax.shard_map(
         body, mesh=mesh,
-        in_specs=(P(), P('data', 'seq'), P('data', 'seq'),
+        in_specs=(param_in_specs, P('data', 'seq'), P('data', 'seq'),
                   P('data', 'seq')),
-        out_specs=P('data', 'seq', None),
+        out_specs=logits_spec,
         check_vma=False)
     return f(params, tokens, pad_mask, positions)
