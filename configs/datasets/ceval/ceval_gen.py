@@ -6,262 +6,262 @@ from opencompass_tpu.datasets.ceval import CEvalDataset
 ceval_subject_mapping = {
     "computer_network": [
         "Computer Network",
-        "\\u8ba1\\u7b97\\u673a\\u7f51\\u7edc",
+        "计算机网络",
         "STEM"
     ],
     "operating_system": [
         "Operating System",
-        "\\u64cd\\u4f5c\\u7cfb\\u7edf",
+        "操作系统",
         "STEM"
     ],
     "computer_architecture": [
         "Computer Architecture",
-        "\\u8ba1\\u7b97\\u673a\\u7ec4\\u6210",
+        "计算机组成",
         "STEM"
     ],
     "college_programming": [
         "College Programming",
-        "\\u5927\\u5b66\\u7f16\\u7a0b",
+        "大学编程",
         "STEM"
     ],
     "college_physics": [
         "College Physics",
-        "\\u5927\\u5b66\\u7269\\u7406",
+        "大学物理",
         "STEM"
     ],
     "college_chemistry": [
         "College Chemistry",
-        "\\u5927\\u5b66\\u5316\\u5b66",
+        "大学化学",
         "STEM"
     ],
     "advanced_mathematics": [
         "Advanced Mathematics",
-        "\\u9ad8\\u7b49\\u6570\\u5b66",
+        "高等数学",
         "STEM"
     ],
     "probability_and_statistics": [
         "Probability and Statistics",
-        "\\u6982\\u7387\\u7edf\\u8ba1",
+        "概率统计",
         "STEM"
     ],
     "discrete_mathematics": [
         "Discrete Mathematics",
-        "\\u79bb\\u6563\\u6570\\u5b66",
+        "离散数学",
         "STEM"
     ],
     "electrical_engineer": [
         "Electrical Engineer",
-        "\\u6ce8\\u518c\\u7535\\u6c14\\u5de5\\u7a0b\\u5e08",
+        "注册电气工程师",
         "STEM"
     ],
     "metrology_engineer": [
         "Metrology Engineer",
-        "\\u6ce8\\u518c\\u8ba1\\u91cf\\u5e08",
+        "注册计量师",
         "STEM"
     ],
     "high_school_mathematics": [
         "High School Mathematics",
-        "\\u9ad8\\u4e2d\\u6570\\u5b66",
+        "高中数学",
         "STEM"
     ],
     "high_school_physics": [
         "High School Physics",
-        "\\u9ad8\\u4e2d\\u7269\\u7406",
+        "高中物理",
         "STEM"
     ],
     "high_school_chemistry": [
         "High School Chemistry",
-        "\\u9ad8\\u4e2d\\u5316\\u5b66",
+        "高中化学",
         "STEM"
     ],
     "high_school_biology": [
         "High School Biology",
-        "\\u9ad8\\u4e2d\\u751f\\u7269",
+        "高中生物",
         "STEM"
     ],
     "middle_school_mathematics": [
         "Middle School Mathematics",
-        "\\u521d\\u4e2d\\u6570\\u5b66",
+        "初中数学",
         "STEM"
     ],
     "middle_school_biology": [
         "Middle School Biology",
-        "\\u521d\\u4e2d\\u751f\\u7269",
+        "初中生物",
         "STEM"
     ],
     "middle_school_physics": [
         "Middle School Physics",
-        "\\u521d\\u4e2d\\u7269\\u7406",
+        "初中物理",
         "STEM"
     ],
     "middle_school_chemistry": [
         "Middle School Chemistry",
-        "\\u521d\\u4e2d\\u5316\\u5b66",
+        "初中化学",
         "STEM"
     ],
     "veterinary_medicine": [
         "Veterinary Medicine",
-        "\\u517d\\u533b\\u5b66",
+        "兽医学",
         "STEM"
     ],
     "college_economics": [
         "College Economics",
-        "\\u5927\\u5b66\\u7ecf\\u6d4e\\u5b66",
+        "大学经济学",
         "Social Science"
     ],
     "business_administration": [
         "Business Administration",
-        "\\u5de5\\u5546\\u7ba1\\u7406",
+        "工商管理",
         "Social Science"
     ],
     "marxism": [
         "Marxism",
-        "\\u9a6c\\u514b\\u601d\\u4e3b\\u4e49\\u57fa\\u672c\\u539f\\u7406",
+        "马克思主义基本原理",
         "Social Science"
     ],
     "mao_zedong_thought": [
         "Mao Zedong Thought",
-        "\\u6bdb\\u6cfd\\u4e1c\\u601d\\u60f3\\u548c\\u4e2d\\u56fd\\u7279\\u8272\\u793e\\u4f1a\\u4e3b\\u4e49\\u7406\\u8bba\\u4f53\\u7cfb\\u6982\\u8bba",
+        "毛泽东思想和中国特色社会主义理论体系概论",
         "Social Science"
     ],
     "education_science": [
         "Education Science",
-        "\\u6559\\u80b2\\u5b66",
+        "教育学",
         "Social Science"
     ],
     "teacher_qualification": [
         "Teacher Qualification",
-        "\\u6559\\u5e08\\u8d44\\u683c",
+        "教师资格",
         "Social Science"
     ],
     "high_school_politics": [
         "High School Politics",
-        "\\u9ad8\\u4e2d\\u653f\\u6cbb",
+        "高中政治",
         "Social Science"
     ],
     "high_school_geography": [
         "High School Geography",
-        "\\u9ad8\\u4e2d\\u5730\\u7406",
+        "高中地理",
         "Social Science"
     ],
     "middle_school_politics": [
         "Middle School Politics",
-        "\\u521d\\u4e2d\\u653f\\u6cbb",
+        "初中政治",
         "Social Science"
     ],
     "middle_school_geography": [
         "Middle School Geography",
-        "\\u521d\\u4e2d\\u5730\\u7406",
+        "初中地理",
         "Social Science"
     ],
     "modern_chinese_history": [
         "Modern Chinese History",
-        "\\u8fd1\\u4ee3\\u53f2\\u7eb2\\u8981",
+        "近代史纲要",
         "Humanities"
     ],
     "ideological_and_moral_cultivation": [
         "Ideological and Moral Cultivation",
-        "\\u601d\\u60f3\\u9053\\u5fb7\\u4fee\\u517b\\u4e0e\\u6cd5\\u5f8b\\u57fa\\u7840",
+        "思想道德修养与法律基础",
         "Humanities"
     ],
     "logic": [
         "Logic",
-        "\\u903b\\u8f91\\u5b66",
+        "逻辑学",
         "Humanities"
     ],
     "law": [
         "Law",
-        "\\u6cd5\\u5b66",
+        "法学",
         "Humanities"
     ],
     "chinese_language_and_literature": [
         "Chinese Language and Literature",
-        "\\u4e2d\\u56fd\\u8bed\\u8a00\\u6587\\u5b66",
+        "中国语言文学",
         "Humanities"
     ],
     "art_studies": [
         "Art Studies",
-        "\\u827a\\u672f\\u5b66",
+        "艺术学",
         "Humanities"
     ],
     "professional_tour_guide": [
         "Professional Tour Guide",
-        "\\u5bfc\\u6e38\\u8d44\\u683c",
+        "导游资格",
         "Humanities"
     ],
     "legal_professional": [
         "Legal Professional",
-        "\\u6cd5\\u5f8b\\u804c\\u4e1a\\u8d44\\u683c",
+        "法律职业资格",
         "Humanities"
     ],
     "high_school_chinese": [
         "High School Chinese",
-        "\\u9ad8\\u4e2d\\u8bed\\u6587",
+        "高中语文",
         "Humanities"
     ],
     "high_school_history": [
         "High School History",
-        "\\u9ad8\\u4e2d\\u5386\\u53f2",
+        "高中历史",
         "Humanities"
     ],
     "middle_school_history": [
         "Middle School History",
-        "\\u521d\\u4e2d\\u5386\\u53f2",
+        "初中历史",
         "Humanities"
     ],
     "civil_servant": [
         "Civil Servant",
-        "\\u516c\\u52a1\\u5458",
+        "公务员",
         "Other"
     ],
     "sports_science": [
         "Sports Science",
-        "\\u4f53\\u80b2\\u5b66",
+        "体育学",
         "Other"
     ],
     "plant_protection": [
         "Plant Protection",
-        "\\u690d\\u7269\\u4fdd\\u62a4",
+        "植物保护",
         "Other"
     ],
     "basic_medicine": [
         "Basic Medicine",
-        "\\u57fa\\u7840\\u533b\\u5b66",
+        "基础医学",
         "Other"
     ],
     "clinical_medicine": [
         "Clinical Medicine",
-        "\\u4e34\\u5e8a\\u533b\\u5b66",
+        "临床医学",
         "Other"
     ],
     "urban_and_rural_planner": [
         "Urban and Rural Planner",
-        "\\u6ce8\\u518c\\u57ce\\u4e61\\u89c4\\u5212\\u5e08",
+        "注册城乡规划师",
         "Other"
     ],
     "accountant": [
         "Accountant",
-        "\\u6ce8\\u518c\\u4f1a\\u8ba1\\u5e08",
+        "注册会计师",
         "Other"
     ],
     "fire_engineer": [
         "Fire Engineer",
-        "\\u6ce8\\u518c\\u6d88\\u9632\\u5de5\\u7a0b\\u5e08",
+        "注册消防工程师",
         "Other"
     ],
     "environmental_impact_assessment_engineer": [
         "Environmental Impact Assessment Engineer",
-        "\\u73af\\u5883\\u5f71\\u54cd\\u8bc4\\u4ef7\\u5de5\\u7a0b\\u5e08",
+        "环境影响评价工程师",
         "Other"
     ],
     "tax_accountant": [
         "Tax Accountant",
-        "\\u7a0e\\u52a1\\u5e08",
+        "税务师",
         "Other"
     ],
     "physician": [
         "Physician",
-        "\\u533b\\u5e08\\u8d44\\u683c",
+        "医师资格",
         "Other"
     ]
 }

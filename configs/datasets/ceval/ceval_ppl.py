@@ -1,0 +1,30 @@
+"""C-Eval letter-PPL variant: score P(letter | question+options) for each of
+A-D and pick the argmin-PPL letter (the base-model measurement; the gen
+form lives in ceval_gen.py)."""
+from opencompass_tpu.config import read_base
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever
+from opencompass_tpu.icl.inferencers import PPLInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator
+from opencompass_tpu.datasets.ceval import CEvalDataset
+
+with read_base():
+    from .ceval_gen import ceval_subject_mapping, ceval_reader_cfg
+
+ceval_datasets = []
+for _name, (_en, _ch, _cat) in ceval_subject_mapping.items():
+    _base = (f'以下是中国关于{_ch}考试的单项选择题，请选出其中的正确答案。\n'
+             '{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: ')
+    _infer_cfg = dict(
+        prompt_template=dict(
+            type=PromptTemplate,
+            template={letter: _base + letter for letter in 'ABCD'}),
+        retriever=dict(type=ZeroRetriever),
+        inferencer=dict(type=PPLInferencer))
+    ceval_datasets.append(
+        dict(abbr=f'ceval-{_name}-ppl',
+             type=CEvalDataset,
+             path='./data/ceval/formal_ceval',
+             name=_name,
+             reader_cfg=ceval_reader_cfg,
+             infer_cfg=_infer_cfg,
+             eval_cfg=dict(evaluator=dict(type=AccEvaluator))))

@@ -1,0 +1,32 @@
+"""GSM8K zero-shot chain-of-thought variant (no exemplars; relies on the
+"Let's think step by step" elicitation — the 2-exemplar form is
+gsm8k_gen.py)."""
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator
+from opencompass_tpu.datasets.gsm8k import (GSM8KDataset, gsm8k_postprocess,
+                                             gsm8k_dataset_postprocess)
+
+gsm8k_reader_cfg = dict(input_columns=['question'], output_column='answer')
+
+gsm8k_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=("Question: {question}\nLet's think step by step, then "
+                  "state the final line as 'The answer is N'.\nAnswer:")),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=512))
+
+gsm8k_eval_cfg = dict(
+    evaluator=dict(type=AccEvaluator),
+    pred_postprocessor=dict(type=gsm8k_postprocess),
+    dataset_postprocessor=dict(type=gsm8k_dataset_postprocess))
+
+gsm8k_datasets = [
+    dict(abbr='gsm8k_0shot',
+         type=GSM8KDataset,
+         path='./data/gsm8k',
+         reader_cfg=gsm8k_reader_cfg,
+         infer_cfg=gsm8k_infer_cfg,
+         eval_cfg=gsm8k_eval_cfg)
+]

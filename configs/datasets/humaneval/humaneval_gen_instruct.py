@@ -1,0 +1,38 @@
+"""HumanEval instruction-wrapped variant for chat-tuned models (the bare
+code-completion form is humaneval_gen.py)."""
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer
+from opencompass_tpu.datasets.humaneval import (HumanEvalDataset,
+                                                 HumanEvaluator,
+                                                 humaneval_postprocess)
+
+humaneval_reader_cfg = dict(input_columns=['prompt'], output_column='task_id',
+                            train_split='test')
+
+humaneval_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=dict(round=[
+            dict(role='HUMAN',
+                 prompt=('You are an expert Python programmer.  Complete '
+                         'the function below; reply with the code only, no '
+                         'explanations.\n{prompt}')),
+        ])),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=512))
+
+humaneval_eval_cfg = dict(
+    evaluator=dict(type=HumanEvaluator,
+                   problem_file='./data/humaneval/human-eval-v2.jsonl',
+                   k=[1]),
+    pred_role='BOT',
+    pred_postprocessor=dict(type=humaneval_postprocess))
+
+humaneval_datasets = [
+    dict(abbr='openai_humaneval_instruct',
+         type=HumanEvalDataset,
+         path='./data/humaneval/human-eval-v2.jsonl',
+         reader_cfg=humaneval_reader_cfg,
+         infer_cfg=humaneval_infer_cfg,
+         eval_cfg=humaneval_eval_cfg)
+]

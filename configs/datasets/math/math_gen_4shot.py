@@ -1,0 +1,39 @@
+"""MATH 4-shot variant: worked \\boxed{} exemplars drawn from the train
+split (the zero-shot instruction form is math_gen.py)."""
+from opencompass_tpu.icl import PromptTemplate, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer
+from opencompass_tpu.datasets.math import (MATHDataset, MATHEvaluator,
+                                            math_postprocess)
+
+math_reader_cfg = dict(input_columns=['problem'], output_column='solution')
+
+math_infer_cfg = dict(
+    ice_template=dict(
+        type=PromptTemplate,
+        template=dict(round=[
+            dict(role='HUMAN', prompt='Problem:\n{problem}\nSolution:'),
+            dict(role='BOT', prompt='{solution}\n'),
+        ])),
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=dict(
+            begin='</E>',
+            round=[
+                dict(role='HUMAN', prompt='Problem:\n{problem}\nSolution:'),
+            ]),
+        ice_token='</E>'),
+    retriever=dict(type=FixKRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=512,
+                    fix_id_list=[0, 1, 2, 3]))
+
+math_eval_cfg = dict(evaluator=dict(type=MATHEvaluator),
+                     pred_postprocessor=dict(type=math_postprocess))
+
+math_datasets = [
+    dict(abbr='math_4shot',
+         type=MATHDataset,
+         path='./data/math/math.json',
+         reader_cfg=math_reader_cfg,
+         infer_cfg=math_infer_cfg,
+         eval_cfg=math_eval_cfg)
+]

@@ -1,0 +1,37 @@
+"""MMLU zero-shot generation variant (no in-context exemplars — probes raw
+instruction following; the 5-shot form lives in mmlu_gen.py)."""
+from opencompass_tpu.config import read_base
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator
+from opencompass_tpu.datasets.mmlu import MMLUDataset
+
+with read_base():
+    from .mmlu_gen import mmlu_all_sets, mmlu_reader_cfg
+
+mmlu_datasets = []
+for _name in mmlu_all_sets:
+    _hint = (f'There is a single choice question about '
+             f'{_name.replace("_", " ")}. Answer the question by replying '
+             'A, B, C or D.')
+    _infer_cfg = dict(
+        prompt_template=dict(
+            type=PromptTemplate,
+            template=dict(round=[
+                dict(role='HUMAN',
+                     prompt=(f'{_hint}\nQ: {{input}}\n'
+                             'A. {A}\nB. {B}\nC. {C}\nD. {D}\n'
+                             'A: ')),
+            ])),
+        retriever=dict(type=ZeroRetriever),
+        inferencer=dict(type=GenInferencer, max_out_len=5))
+    _eval_cfg = dict(evaluator=dict(type=AccEvaluator),
+                     pred_postprocessor=dict(type='first-capital'))
+    mmlu_datasets.append(
+        dict(abbr=f'lukaemon_mmlu_{_name}_0shot',
+             type=MMLUDataset,
+             path='./data/mmlu/',
+             name=_name,
+             reader_cfg=mmlu_reader_cfg,
+             infer_cfg=_infer_cfg,
+             eval_cfg=_eval_cfg))

@@ -1,0 +1,41 @@
+"""Natural Questions 5-shot variant: fixed dev-split exemplars before each
+question (zero-shot form is nq_gen.py; the dev split is a genuine held-out
+pool, so no gold-answer leakage into prompts)."""
+from opencompass_tpu.icl import PromptTemplate, FixKRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer
+from opencompass_tpu.datasets.natural_question import (NaturalQuestionDataset,
+                                                        NQEvaluator)
+
+nq_reader_cfg = dict(input_columns=['question'], output_column='answer',
+                     train_split='dev', test_split='test')
+
+nq_infer_cfg = dict(
+    ice_template=dict(
+        type=PromptTemplate,
+        template=dict(round=[
+            dict(role='HUMAN', prompt='Q: {question}?'),
+            dict(role='BOT', prompt='A: {answer}\n'),
+        ])),
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=dict(
+            begin='</E>',
+            round=[
+                dict(role='HUMAN', prompt='Q: {question}?'),
+                dict(role='BOT', prompt='A: '),
+            ]),
+        ice_token='</E>'),
+    retriever=dict(type=FixKRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=50,
+                    fix_id_list=[0, 1, 2, 3, 4]))
+
+nq_eval_cfg = dict(evaluator=dict(type=NQEvaluator), pred_role='BOT')
+
+nq_datasets = [
+    dict(abbr='nq_5shot',
+         type=NaturalQuestionDataset,
+         path='./data/nq/',
+         reader_cfg=nq_reader_cfg,
+         infer_cfg=nq_infer_cfg,
+         eval_cfg=nq_eval_cfg)
+]

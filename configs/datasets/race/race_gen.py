@@ -1,0 +1,36 @@
+"""RACE generation variant: lettered options + first-capital extraction
+(the candidate-text PPL form lives in race_ppl.py)."""
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer
+from opencompass_tpu.icl.evaluators import AccEvaluator
+from opencompass_tpu.datasets.race import RaceDataset
+
+race_reader_cfg = dict(
+    input_columns=['article', 'question', 'A', 'B', 'C', 'D'],
+    output_column='answer')
+
+race_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=dict(round=[
+            dict(role='HUMAN',
+                 prompt=('Read the article, and answer the question by '
+                         'replying A, B, C or D.\n\nArticle:\n{article}\n\n'
+                         'Q: {question}\n\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n'
+                         'Answer:')),
+        ])),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=5))
+
+race_eval_cfg = dict(evaluator=dict(type=AccEvaluator),
+                     pred_role='BOT',
+                     pred_postprocessor=dict(type='first-capital'))
+
+race_datasets = [
+    dict(abbr='race-middle', type=RaceDataset, path='race', name='middle',
+         reader_cfg=race_reader_cfg, infer_cfg=race_infer_cfg,
+         eval_cfg=race_eval_cfg),
+    dict(abbr='race-high', type=RaceDataset, path='race', name='high',
+         reader_cfg=race_reader_cfg, infer_cfg=race_infer_cfg,
+         eval_cfg=race_eval_cfg),
+]

@@ -1,0 +1,32 @@
+"""TriviaQA instruction variant: explicit short-answer directive for
+chat-tuned models (the bare Q/A form is triviaqa_gen.py)."""
+from opencompass_tpu.icl import PromptTemplate, ZeroRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer
+from opencompass_tpu.datasets.triviaqa import (TriviaQADataset,
+                                                TriviaQAEvaluator)
+
+triviaqa_reader_cfg = dict(input_columns=['question'], output_column='answer',
+                           train_split='dev', test_split='dev')
+
+triviaqa_infer_cfg = dict(
+    prompt_template=dict(
+        type=PromptTemplate,
+        template=dict(round=[
+            dict(role='HUMAN',
+                 prompt=('Answer the trivia question with just the answer, '
+                         'no explanation.\nQ: {question}\nA:')),
+        ])),
+    retriever=dict(type=ZeroRetriever),
+    inferencer=dict(type=GenInferencer, max_out_len=50))
+
+triviaqa_eval_cfg = dict(evaluator=dict(type=TriviaQAEvaluator),
+                         pred_role='BOT')
+
+triviaqa_datasets = [
+    dict(abbr='triviaqa_instruct',
+         type=TriviaQADataset,
+         path='./data/triviaqa',
+         reader_cfg=triviaqa_reader_cfg,
+         infer_cfg=triviaqa_infer_cfg,
+         eval_cfg=triviaqa_eval_cfg)
+]

@@ -43,10 +43,66 @@ class AGIEvalDataset_v2(BaseDataset):
         return Dataset.from_list(rows)
 
 
-# alias: the v1 class in the reference builds the same rows through its
-# dataset_loader machinery; the jsonl schema is what ships with AGIEval.
-AGIEvalDataset = LOAD_DATASET.register_module(
-    name='AGIEvalDataset', module=AGIEvalDataset_v2)
+# Subset families drive the zero-shot framing (reference
+# agieval/dataset_loader.py:14-24).
+ENGLISH_QA = ('lsat-ar', 'lsat-lr', 'lsat-rc', 'logiqa-en', 'sat-math',
+              'sat-en', 'aqua-rat', 'sat-en-without-passage',
+              'gaokao-english')
+CHINESE_QA = ('logiqa-zh', 'jec-qa-kd', 'jec-qa-ca', 'gaokao-chinese',
+              'gaokao-geography', 'gaokao-history', 'gaokao-biology',
+              'gaokao-chemistry', 'gaokao-physics', 'gaokao-mathqa')
+ENGLISH_CLOZE = ('math',)
+CHINESE_CLOZE = ('gaokao-mathcloze',)
+
+
+def _zero_shot_prompt(item: dict, name: str) -> str:
+    """Bake the zero-shot question framing into a single string.
+
+    Mirrors reference agieval/dataset_loader.py:30-57 (convert_zero_shot):
+    QA subsets append the options plus an "answer is" lead-in in the
+    subset's language; cloze subsets just frame Q/A.
+    """
+    passage = item.get('passage') or ''
+    options = item.get('options') or []
+    if name in ENGLISH_QA:
+        count = len(options) or 5
+        if count == 1:
+            count = 5
+        return (passage + 'Q: ' + item['question'] + ' ' +
+                'Answer Choices: ' + ' '.join(options) + '\n' +
+                f'A: Among A through {"ABCDEFG"[count - 1]}, the answer is')
+    if name in CHINESE_QA:
+        count = len(options) or 4
+        if count == 1:
+            count = 4
+        return (passage + '问题：' + item['question'] + ' ' +
+                '选项：' + ' '.join(options) + '\n' +
+                f'答案：从A到{"ABCDEFG"[count - 1]}, 我们应选择')
+    if name in ENGLISH_CLOZE:
+        return passage + 'Q: ' + item['question'] + '\nA: The answer is'
+    if name in CHINESE_CLOZE:
+        return passage + '问题：' + item['question'] + '\n答案：'
+    raise KeyError(f'unknown AGIEval subset: {name!r}')
+
+
+@LOAD_DATASET.register_module()
+class AGIEvalDataset(BaseDataset):
+    """v1 loader: rows are (id, problem_input, label) with the zero-shot
+    prompt pre-baked (reference agieval/agieval.py:16-33)."""
+
+    @staticmethod
+    def load(path: str, name: str, setting_name: str = 'zero-shot'):
+        assert setting_name == 'zero-shot', 'only zero-shot is supported'
+        rows = []
+        with open(osp.join(path, f'{name}.jsonl'), encoding='utf-8') as f:
+            for i, line in enumerate(f):
+                item = json.loads(line.strip())
+                rows.append({
+                    'id': i,
+                    'problem_input': _zero_shot_prompt(item, name),
+                    'label': item.get('label') or item.get('answer'),
+                })
+        return Dataset.from_list(rows)
 
 
 def _remove_few_shot_prefix(s: str) -> str:

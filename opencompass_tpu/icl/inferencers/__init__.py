@@ -1,4 +1,4 @@
 from .base import BaseInferencer  # noqa
-from .gen import GenInferencer  # noqa
+from .gen import GenInferencer, GLMChoiceInferencer  # noqa
 from .ppl import PPLInferencer  # noqa
 from .clp import CLPInferencer  # noqa

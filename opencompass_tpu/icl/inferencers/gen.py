@@ -87,8 +87,7 @@ class GenInferencer(BaseInferencer):
         logger.info('Starting inference process...')
         for entry in self.get_batches(prompt_list[index:], self.batch_size):
             parsed_entries = self.model.parse_template(entry, mode='gen')
-            generated = self.model.generate_from_template(
-                entry, max_out_len=self.max_out_len)
+            generated = self._generate_batch(entry, parsed_entries)
             for prompt, prediction in zip(parsed_entries, generated):
                 output_handler.save_results(prompt, prediction, index)
                 index += 1
@@ -107,6 +106,11 @@ class GenInferencer(BaseInferencer):
             sample['prediction']
             for sample in output_handler.results_dict.values()
         ]
+
+    def _generate_batch(self, entry, parsed_entries) -> List[str]:
+        """One batched model call; the hook GLMChoiceInferencer overrides."""
+        return self.model.generate_from_template(
+            entry, max_out_len=self.max_out_len)
 
     def build_prompt_list(self,
                           ice_idx_list,
@@ -141,3 +145,22 @@ class GenInferencer(BaseInferencer):
                         prompt, mode='gen')
             prompt_list.append(prompt)
         return prompt_list
+
+
+@ICL_INFERENCERS.register_module()
+class GLMChoiceInferencer(GenInferencer):
+    """Multiple-choice via the model's ``choice()`` conditional-log-prob API
+    (reference icl_gen_inferencer.py:186-248).  The prediction saved for each
+    sample is the chosen option string, so downstream eval is identical to a
+    generation run that emitted the letter."""
+
+    def __init__(self, *args, choices=('A', 'B', 'C', 'D'), **kwargs):
+        super().__init__(*args, **kwargs)
+        self.choices = list(choices)
+
+    def _generate_batch(self, entry, parsed_entries) -> List[str]:
+        inputs = parsed_entries
+        if not isinstance(inputs, list):
+            inputs = [inputs]
+        return self.model.choice([str(p) for p in inputs],
+                                 choices=self.choices)

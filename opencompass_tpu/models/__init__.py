@@ -1,10 +1,12 @@
 from .base import BaseModel, LMTemplateParser  # noqa
 from .base_api import APITemplateParser, BaseAPIModel, TokenBucket  # noqa
 from .fake import FakeModel  # noqa
+from .glm import GLM130B  # noqa
 from .jax_lm import JaxLM  # noqa
 from .tokenizer import ByteTokenizer, load_tokenizer  # noqa
 
 __all__ = [
     'BaseModel', 'LMTemplateParser', 'APITemplateParser', 'BaseAPIModel',
-    'TokenBucket', 'FakeModel', 'JaxLM', 'ByteTokenizer', 'load_tokenizer'
+    'TokenBucket', 'FakeModel', 'GLM130B', 'JaxLM', 'ByteTokenizer',
+    'load_tokenizer'
 ]

@@ -258,6 +258,30 @@ class BaseModel(abc.ABC):
     def get_token_len(self, prompt: str) -> int:
         """Tokenized length of ``prompt``."""
 
+    def choice(self, inputs: List[str], choices: List[str]) -> List[str]:
+        """Pick the choice with the highest conditional log prob of its full
+        token sequence given the input (reference models/glm.py:132-164
+        ``cond_log_prob`` measurement).  Default implementation scores every
+        (input, choice) pair through ``get_ppl`` with the input masked out,
+        converting mean answer-token NLL back to a summed log prob so
+        different-length choices compare fairly."""
+        texts, ctx_lens, ans_lens = [], [], []
+        for inp in inputs:
+            ctx = self.get_token_len(inp)
+            for c in choices:
+                full = inp + c
+                texts.append(full)
+                ctx_lens.append(ctx)
+                ans_lens.append(max(self.get_token_len(full) - ctx, 1))
+        nll = self.get_ppl(texts, mask_length=ctx_lens)
+        n = len(choices)
+        out = []
+        for i in range(len(inputs)):
+            scores = [-nll[i * n + j] * ans_lens[i * n + j]
+                      for j in range(n)]
+            out.append(choices[scores.index(max(scores))])
+        return out
+
     # -- template-aware entry points used by inferencers -------------------
     def parse_template(self, prompt_template: PromptType, mode: str):
         return self.template_parser.parse_template(prompt_template, mode)

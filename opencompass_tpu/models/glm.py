@@ -1,0 +1,52 @@
+"""GLM-130B-family model wrapper (parity: reference opencompass/models/
+glm.py:16-407, which drives the external GLM-130B/SwissArmyTransformer
+package over 8 GPUs with --model-parallel-size 8).
+
+TPU-native design: no external package — the GLM architecture runs on the
+in-repo JAX transformer stack with ``prefix_lm`` attention (bidirectional
+context, causal answer; nn/transformer.py), tensor-parallel over the mesh
+``model`` axis instead of SAT's megatron groups.  The reference wrapper's
+three measurement APIs map to:
+
+- ``choice(inputs, choices)`` — conditional log prob of each choice's full
+  token sequence given the bidirectional context (reference glm.py:132-164);
+  inherited from BaseModel.choice, which routes through the prefix-aware
+  ``get_ppl``.
+- ``get_ppl`` — forward + shifted CE with the context masked out and
+  attended bidirectionally (reference glm.py:380-406 builds the same
+  context/answer split via GLM attention masks by hand).
+- ``generate`` — the reference fills a [MASK]/[gMASK] span with a
+  left-to-right strategy (glm.py:166-285); here the prompt is the
+  bidirectional prefix and decode proceeds causally from its end, which is
+  exactly the [gMASK] (generation-mask-at-end) path — the only one the
+  reference's eval configs use.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Union
+
+from opencompass_tpu.registry import MODELS
+
+from .jax_lm import JaxLM
+
+
+@MODELS.register_module()
+class GLM130B(JaxLM):
+    """Args mirror JaxLM; ``config`` defaults to the GLM-130B preset and
+    ``parallel`` to 8-way tensor parallelism (the reference's
+    --model-parallel-size 8, reference glm.py:74)."""
+
+    def __init__(self,
+                 path: str = '',
+                 max_seq_len: int = 2048,
+                 config: Union[str, Dict, None] = None,
+                 parallel: Optional[Dict] = None,
+                 **kwargs):
+        if config is None:
+            config = 'glm130b'
+        elif isinstance(config, dict) and 'preset' not in config:
+            config = dict(config, preset='glm130b')
+        if parallel is None:
+            parallel = dict(data=1, model=8, seq=1)
+        super().__init__(path=path, max_seq_len=max_seq_len, config=config,
+                         parallel=parallel, **kwargs)

@@ -225,6 +225,10 @@ class JaxLM(BaseModel):
         mesh = self.mesh
         use_ring = mesh is not None and mesh.shape.get('seq', 1) > 1
         if use_ring:
+            if cfg.prefix_lm:
+                raise ValueError('prefix-LM scoring is not supported with '
+                                 'sequence parallelism (ring attention is '
+                                 'causal-blocked); use a data/model mesh')
             from opencompass_tpu.parallel.ring_attention import ring_forward
 
             @jax.jit
@@ -236,7 +240,14 @@ class JaxLM(BaseModel):
 
         @jax.jit
         def ppl(params, tokens, mask, mask_length):
-            logits = forward(params, cfg, tokens, mask)
+            prefix_mask = None
+            if cfg.prefix_lm:
+                # scoring batches are right-padded, so the first
+                # mask_length[i] slots are the bidirectional context
+                pos = jnp.arange(tokens.shape[1])[None, :]
+                prefix_mask = pos < mask_length[:, None]
+            logits = forward(params, cfg, tokens, mask,
+                             prefix_mask=prefix_mask)
             return self._replicate(
                 sequence_nll(logits, tokens, mask, mask_length))
         return ppl

@@ -42,6 +42,10 @@ class TransformerConfig:
     final_norm: bool = True
     # learned-positional models (OPT) offset position ids by 2
     pos_offset: int = 0
+    # GLM-family prefix LM: context tokens attend bidirectionally, answer
+    # tokens causally (scoring passes the context extent via mask_length;
+    # generation treats the whole prompt as context)
+    prefix_lm: bool = False
     dtype: str = 'bfloat16'           # parameter/compute dtype
     # scan-over-layers keeps compile time O(1) in depth; turn off to inspect
     # per-layer arrays by name.
@@ -100,6 +104,25 @@ class TransformerConfig:
             activation='relu', norm='layernorm', positional='learned',
             pos_offset=2, tie_embeddings=True, qkv_bias=True, o_bias=True,
             mlp_bias=True, gated_mlp=False, **kw)
+
+    @staticmethod
+    def glm130b(vocab_size=150528, hidden_size=12288, num_layers=70,
+                num_heads=96, intermediate_size=32768, max_seq_len=2048,
+                **kw):
+        """GLM-130B family (reference models/glm.py evaluates it through the
+        external SwissArmyTransformer package): RoPE, GeGLU, LayerNorm,
+        prefix-LM attention (bidirectional context / causal answer).
+        Approximation: pre-norm residuals instead of DeepNorm post-norm —
+        the measurement paths (choice/get_ppl/generate) are exact, the
+        checkpoint math is the documented divergence."""
+        return TransformerConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_heads, head_dim=hidden_size // num_heads,
+            intermediate_size=intermediate_size, max_seq_len=max_seq_len,
+            activation='gelu', norm='layernorm', positional='rope',
+            gated_mlp=True, qkv_bias=True, o_bias=True, mlp_bias=True,
+            prefix_lm=True, **kw)
 
     @staticmethod
     def gpt2(vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12,

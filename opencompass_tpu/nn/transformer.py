@@ -407,7 +407,8 @@ def _unembed(params, cfg: TransformerConfig, x):
 
 def forward(params: Params, cfg: TransformerConfig, tokens: jax.Array,
             pad_mask: Optional[jax.Array] = None,
-            use_flash: bool = True) -> jax.Array:
+            use_flash: bool = True,
+            prefix_mask: Optional[jax.Array] = None) -> jax.Array:
     """Full-sequence causal forward → fp32 logits (B, S, V).
 
     ``pad_mask`` (B, S) marks real tokens (right- or left-padding both work:
@@ -415,6 +416,9 @@ def forward(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     be attended to).  This is the PPL path (reference huggingface.py:254-293
     equivalent measurement).  On TPU with kernel-friendly shapes the
     attention runs through the Pallas flash kernel (nn/flash.py).
+
+    ``prefix_mask`` (B, S) marks prefix-LM context tokens that every query
+    may attend to regardless of order (GLM-family bidirectional context).
     """
     B, S = tokens.shape
     if pad_mask is None:
@@ -423,7 +427,7 @@ def forward(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     positions = token_positions(pad_mask)
 
     attn_fn = None
-    if use_flash and cfg.positional != 'alibi':
+    if use_flash and cfg.positional != 'alibi' and prefix_mask is None:
         from .flash import flash_attention as _flash
         from .flash import flash_supported
         if flash_supported(cfg.num_heads, cfg.num_kv_heads, cfg.head_dim, S):
@@ -434,6 +438,9 @@ def forward(params: Params, cfg: TransformerConfig, tokens: jax.Array,
 
     causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
     mask = causal[None, :, :] & pad_mask[:, None, :]
+    if prefix_mask is not None:
+        mask = mask | (prefix_mask.astype(jnp.bool_)
+                       & pad_mask)[:, None, :]
     x = _embed(params, cfg, tokens, positions)
     x, _ = _stack(cfg, x, params['layers'], positions, mask,
                   attn_fn=attn_fn)
@@ -467,6 +474,10 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     kv_valid = jax.lax.dynamic_update_slice_in_dim(kv_valid, pad_mask, 0,
                                                    axis=1)
     mask = causal[None, :, :] & kv_valid[:, None, :]
+    if cfg.prefix_lm:
+        # the whole prompt is bidirectional context; decode steps that
+        # follow are causal over it (GLM-family generation)
+        mask = kv_valid[:, None, :]
     # per-slot positions for position-dependent attention bias (ALiBi)
     kv_positions = slot_positions(pad_mask, cache['k'].shape[2])
     x = _embed(params, cfg, tokens, positions)

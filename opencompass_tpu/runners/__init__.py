@@ -1,6 +1,8 @@
 from .base import BaseRunner  # noqa
 from .cloud import CloudRunner  # noqa
+from .dlc import DLCRunner  # noqa
 from .local import LocalRunner  # noqa
 from .slurm import SlurmRunner  # noqa
 
-__all__ = ['BaseRunner', 'CloudRunner', 'LocalRunner', 'SlurmRunner']
+__all__ = ['BaseRunner', 'CloudRunner', 'DLCRunner', 'LocalRunner',
+           'SlurmRunner']

@@ -1,0 +1,79 @@
+"""Aliyun DLC runner (parity: reference opencompass/runners/dlc.py:19-153).
+
+A thin preset over :class:`CloudRunner`: the reference builds a
+``dlc create job --command '<source bashrc; conda activate env; cd pwd;
+task cmd>' --worker_count 1 --worker_gpu N ...`` line from an
+``aliyun_cfg`` dict and then applies the shared
+retry-while-outputs-missing contract.  Here the same line is assembled
+into CloudRunner's ``submit_template`` so the submit/retry machinery is
+shared; the accelerator count flag is ``--worker_gpu`` for drop-in config
+compatibility even though tasks count TPU devices.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from opencompass_tpu.registry import RUNNERS
+
+from .cloud import CloudRunner
+
+
+@RUNNERS.register_module()
+class DLCRunner(CloudRunner):
+    """Args:
+        task: task type config.
+        aliyun_cfg: cluster config; recognised keys (all optional except
+            dlc_config_path/workspace_id/worker_image in real deployments):
+            ``bashrc_path``, ``conda_env_name``, ``dlc_config_path``,
+            ``workspace_id``, ``worker_image``, ``python_env_path``.
+        max_num_workers / retry / debug / lark_bot_url: as CloudRunner.
+    """
+
+    def __init__(self,
+                 task: Dict,
+                 aliyun_cfg: Optional[Dict] = None,
+                 max_num_workers: int = 32,
+                 retry: int = 2,
+                 debug: bool = False,
+                 lark_bot_url: str = None):
+        aliyun_cfg = dict(aliyun_cfg or {})
+        setup = []
+        bashrc = aliyun_cfg.get('bashrc_path')
+        if bashrc:
+            setup.append(f'source {bashrc}')
+        conda_env = aliyun_cfg.get('conda_env_name')
+        if conda_env:
+            setup.append(f'conda activate {conda_env}')
+        python_env = aliyun_cfg.get('python_env_path')
+        if python_env:
+            setup.append(f'export PATH={python_env}/bin:$PATH')
+        # bake in the submit host's cwd (shared filesystem assumption, as in
+        # the reference) — a literal $PWD would expand on the worker to the
+        # container's initial directory and break relative output paths
+        setup.append(f'cd {os.getcwd()}')
+        shell = '; '.join(setup + ['{task_cmd}'])
+        parts = [
+            'dlc create job',
+            f"--command '{shell}'",
+            '--kind PyTorchJob',
+            '--name {name}',
+            '--worker_count 1',
+            '--worker_gpu {num_devices}',
+            '--worker_cpu 8',
+            '--worker_memory 64',
+            '--interactive',
+        ]
+        if aliyun_cfg.get('worker_image'):
+            parts.append(f"--worker_image {aliyun_cfg['worker_image']}")
+        if aliyun_cfg.get('workspace_id'):
+            parts.append(f"--workspace_id {aliyun_cfg['workspace_id']}")
+        if aliyun_cfg.get('dlc_config_path'):
+            parts.append(f"--config {aliyun_cfg['dlc_config_path']}")
+        super().__init__(task=task,
+                         submit_template=' '.join(parts),
+                         max_num_workers=max_num_workers,
+                         retry=retry,
+                         debug=debug,
+                         lark_bot_url=lark_bot_url)
+        self.aliyun_cfg = aliyun_cfg

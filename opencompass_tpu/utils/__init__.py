@@ -1,7 +1,10 @@
 from .abbr import (dataset_abbr_from_cfg, get_infer_output_path,  # noqa
                    model_abbr_from_cfg, task_abbr_from_cfg)
 from .build import build_dataset_from_cfg, build_model_from_cfg  # noqa
+from .fileio import (get_file_backend, patch_fileio,  # noqa
+                     patch_hf_auto_model, register_backend)
 from .logging import get_logger  # noqa
+from .menu import Menu  # noqa
 from .notify import LarkReporter  # noqa
 from .prompt import PromptList, get_prompt_hash, safe_format  # noqa
 from .text_postprocessors import *  # noqa

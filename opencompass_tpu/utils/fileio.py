@@ -1,0 +1,157 @@
+"""Remote-storage routing for file access (parity: reference
+opencompass/utils/fileio.py:1-168, which monkey-patches ``open``/``os.path``/
+``shutil``/``torch.load`` through mmengine's petrel/S3 backends).
+
+TPU-native design: instead of hard-wiring one vendor client, a tiny backend
+registry maps URI prefixes (``gs://``, ``s3://``, ...) to user-registered
+backend objects.  ``patch_fileio()`` temporarily reroutes the standard file
+APIs so code that was written against local paths (dataset loaders, HF
+``from_pretrained``) can read from object storage unchanged.  No backend is
+bundled — environments with network storage register their own client:
+
+    from opencompass_tpu.utils import fileio
+    fileio.register_backend('gs://', MyGCSBackend())
+
+A backend must implement: ``get(path) -> bytes``, ``exists(path) -> bool``,
+``isfile``, ``isdir``, ``join_path(a, *parts) -> str``,
+``list_dir(path) -> list[str]``.
+"""
+from __future__ import annotations
+
+import io
+import os
+from contextlib import contextmanager
+from typing import Dict, Optional
+
+_BACKENDS: Dict[str, object] = {}
+
+
+def register_backend(prefix: str, backend) -> None:
+    """Route paths starting with `prefix` (e.g. ``'gs://'``) to `backend`."""
+    _BACKENDS[prefix] = backend
+
+
+def get_file_backend(path) -> Optional[object]:
+    """Backend owning `path`, or None for plain local paths."""
+    if not isinstance(path, (str, os.PathLike)):
+        return None
+    s = os.fspath(path)
+    for prefix, backend in _BACKENDS.items():
+        if s.startswith(prefix):
+            return backend
+    return None
+
+
+@contextmanager
+def patch_fileio(global_vars=None):
+    """Reroute open/os.path/os.listdir/shutil.copy through backends.
+
+    Re-entrant: nested calls are no-ops.  `global_vars` lets a caller whose
+    module captured ``open`` by value (``from builtins import open``) get the
+    patched one injected.
+    """
+    if getattr(patch_fileio, '_patched', False):
+        yield
+        return
+    patch_fileio._patched = True
+    import builtins
+    import shutil
+    backups = []
+
+    def _patch(module, name, new):
+        backups.append((module, name, getattr(module, name)))
+        new._fallback = getattr(module, name)
+        setattr(module, name, new)
+
+    def _open(file, mode='r', *args, **kwargs):
+        backend = get_file_backend(file)
+        if backend is None:
+            return _open._fallback(file, mode, *args, **kwargs)
+        if 'w' in mode or 'a' in mode or '+' in mode:
+            raise NotImplementedError(
+                'patch_fileio only supports reads from remote backends')
+        data = backend.get(os.fspath(file))
+        if 'b' in mode:
+            return io.BytesIO(data)
+        encoding = kwargs.get('encoding') or (args[1] if len(args) > 1
+                                              else None) or 'utf-8'
+        errors = kwargs.get('errors') or (args[2] if len(args) > 2
+                                          else None) or 'strict'
+        return io.StringIO(data.decode(encoding, errors))
+
+    def _join(a, *paths):
+        backend = get_file_backend(a)
+        if backend is None:
+            return _join._fallback(a, *paths)
+        return backend.join_path(os.fspath(a), *[p for p in paths if p])
+
+    def _make_pred(name):
+        def pred(path):
+            backend = get_file_backend(path)
+            if backend is None:
+                return pred._fallback(path)
+            return getattr(backend, name)(os.fspath(path))
+        return pred
+
+    def _listdir(path='.'):
+        backend = get_file_backend(path)
+        if backend is None:
+            return _listdir._fallback(path)
+        return backend.list_dir(os.fspath(path))
+
+    def _copy(src, dst, **kwargs):
+        backend = get_file_backend(src)
+        if backend is None:
+            return _copy._fallback(src, dst, **kwargs)
+        with open(dst, 'wb') as f:
+            f.write(backend.get(os.fspath(src)))
+        return dst
+
+    _patch(builtins, 'open', _open)
+    _patch(os.path, 'join', _join)
+    for name in ('exists', 'isfile', 'isdir'):
+        _patch(os.path, name, _make_pred(name))
+    _patch(os, 'listdir', _listdir)
+    _patch(shutil, 'copy', _copy)
+    if global_vars is not None and 'open' in global_vars:
+        bak_open = global_vars['open']
+        global_vars['open'] = builtins.open
+    try:
+        yield
+    finally:
+        for module, name, old in backups:
+            setattr(module, name, old)
+        if global_vars is not None and 'open' in global_vars:
+            global_vars['open'] = bak_open
+        patch_fileio._patched = False
+
+
+def patch_hf_auto_model(cache_dir=None):
+    """Make HF ``from_pretrained`` read through the backend registry and pin
+    a cache dir (parity: reference fileio.py patch_hf_auto_model).  Idempotent.
+    """
+    if hasattr(patch_hf_auto_model, '_patched'):
+        return
+    patch_hf_auto_model._patched = True
+    from transformers.modeling_utils import PreTrainedModel
+    from transformers.models.auto.auto_factory import _BaseAutoModelClass
+
+    ori_model = PreTrainedModel.from_pretrained.__func__
+    ori_auto = _BaseAutoModelClass.from_pretrained.__func__
+
+    @classmethod
+    def model_pt(cls, pretrained_model_name_or_path, *args, **kwargs):
+        kwargs.setdefault('cache_dir', cache_dir)
+        with patch_fileio():
+            return ori_model(cls, pretrained_model_name_or_path, *args,
+                             **kwargs)
+
+    @classmethod
+    def auto_pt(cls, pretrained_model_name_or_path, *args, **kwargs):
+        kwargs.setdefault('cache_dir', cache_dir)
+        with patch_fileio():
+            return ori_auto(cls, pretrained_model_name_or_path, *args,
+                            **kwargs)
+
+    PreTrainedModel.from_pretrained = model_pt
+    _BaseAutoModelClass.from_pretrained = auto_pt

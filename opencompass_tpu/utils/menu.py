@@ -1,0 +1,92 @@
+"""Interactive terminal picker used by tools/prompt_viewer.py.
+
+Parity: reference opencompass/utils/menu.py (curses Menu that walks the
+user through one selection per list).  This version adds a dumb-terminal
+fallback (numbered stdin prompt) so the tools still work over plain
+pipes/ssh sessions where curses can't initialize.
+"""
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+
+class Menu:
+    """Select one item from each of several lists.
+
+    Args:
+        lists: one list of option strings per selection round.
+        prompts: optional prompt line shown above each list.
+    """
+
+    def __init__(self, lists: List[List[str]],
+                 prompts: Optional[List[str]] = None):
+        self.choices_lists = lists
+        self.prompts = prompts or ['Please make a selection:'] * len(lists)
+        self.choices: List[str] = []
+
+    def run(self) -> List[str]:
+        if not sys.stdin.isatty() or not sys.stdout.isatty():
+            return self._run_plain()
+        try:
+            import curses
+            curses.wrapper(self._main_loop)
+        except Exception:  # no TERM, broken terminfo, ...
+            return self._run_plain()
+        return self.choices
+
+    # -- plain fallback ----------------------------------------------------
+    def _run_plain(self) -> List[str]:
+        self.choices = []
+        for options, prompt in zip(self.choices_lists, self.prompts):
+            print(prompt)
+            for i, opt in enumerate(options, 1):
+                print(f'  {i}. {opt}')
+            while True:
+                try:
+                    raw = input(f'choice [1-{len(options)}]: ').strip()
+                except EOFError:
+                    print(f'stdin closed — defaulting to 1. {options[0]}')
+                    self.choices.append(options[0])
+                    break
+                if raw.isdigit() and 1 <= int(raw) <= len(options):
+                    self.choices.append(options[int(raw) - 1])
+                    break
+                print('invalid choice, try again')
+        return self.choices
+
+    # -- curses mode -------------------------------------------------------
+    def _main_loop(self, stdscr):
+        import curses
+        curses.curs_set(0)
+        curses.init_pair(1, curses.COLOR_BLACK, curses.COLOR_WHITE)
+        self.choices = []
+        for options, prompt in zip(self.choices_lists, self.prompts):
+            idx, offset = 0, 0
+            while True:
+                stdscr.clear()
+                h, w = stdscr.getmaxyx()
+                max_rows = h - 2
+                if idx < offset:
+                    offset = idx
+                elif idx >= offset + max_rows:
+                    offset = idx - max_rows + 1
+                stdscr.addnstr(0, 0, prompt, w - 1)
+                for row, opt in enumerate(options[offset:offset + max_rows]):
+                    y = row + 1
+                    x = max(0, w // 2 - len(opt) // 2)
+                    if offset + row == idx:
+                        stdscr.attron(curses.color_pair(1))
+                        stdscr.addnstr(y, x, opt, w - x - 1)
+                        stdscr.attroff(curses.color_pair(1))
+                    else:
+                        stdscr.addnstr(y, x, opt, w - x - 1)
+                stdscr.refresh()
+                key = stdscr.getch()
+                if key == curses.KEY_UP and idx > 0:
+                    idx -= 1
+                elif key == curses.KEY_DOWN and idx < len(options) - 1:
+                    idx += 1
+                elif key in (curses.KEY_ENTER, 10, 13):
+                    self.choices.append(options[idx])
+                    break
