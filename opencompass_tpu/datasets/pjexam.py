@@ -37,25 +37,40 @@ class PJExamDataset(BaseDataset):
         return DatasetDict({'test': Dataset.from_list(rows)})
 
 
-def _answer_segment(text: str) -> str:
+def _answer_segment(text: str):
+    """Text between 【答案】 and <eoa>, or None when unmarked."""
     m = re.search(r'【答案】(.*?)(?:<eoa>|$)', text, re.S)
-    return (m.group(1) if m else text).strip()
+    return m.group(1).strip() if m else None
 
 
 def _extract_letters(text: str) -> str:
-    """A-G letters, uppercase, sorted, deduped so 'BA' == 'AB'."""
-    return ''.join(sorted(dict.fromkeys(re.findall(r'[A-G]',
-                                                   text.upper()))))
+    """A-G letters, sorted, deduped so 'BA' == 'AB'."""
+    return ''.join(sorted(dict.fromkeys(re.findall(r'[A-G]', text))))
+
+
+def _pred_letters(pred: str) -> str:
+    seg = _answer_segment(pred)
+    if seg is not None:
+        return _extract_letters(seg)
+    # unmarked prediction: only standalone capital letters count — bare
+    # [A-G] over prose would harvest letters out of ordinary words
+    return ''.join(sorted(dict.fromkeys(
+        re.findall(r'\b([A-G])\b', pred))))
 
 
 def _is_correct(pred: str, ref: str) -> bool:
     ref_seg = _answer_segment(ref)
+    if ref_seg is None:
+        ref_seg = ref.strip()
     ref_letters = _extract_letters(ref_seg)
     if ref_letters:
-        return _extract_letters(_answer_segment(pred)) == ref_letters
+        return _pred_letters(pred) == ref_letters
     # cloze subsets (*-math): the standard answer has no choice letters —
     # exact-match the answer text instead of auto-failing
-    return ref_seg != '' and _answer_segment(pred) == ref_seg
+    pred_seg = _answer_segment(pred)
+    if pred_seg is None:
+        pred_seg = pred.strip()
+    return ref_seg != '' and pred_seg == ref_seg
 
 
 @ICL_EVALUATORS.register_module()

@@ -265,8 +265,15 @@ class BaseModel(abc.ABC):
         (input, choice) pair through ``get_ppl`` with the input masked out,
         converting mean answer-token NLL back to a summed log prob so
         different-length choices compare fairly."""
+        max_ans = max(self.get_token_len(c) for c in choices)
         texts, ctx_lens, ans_lens = [], [], []
         for inp in inputs:
+            # scoring batches truncate from the tail, so an over-long
+            # context would silently cut off the answer tokens and score
+            # every choice 0 — drop the oldest context until it fits
+            budget = self.max_seq_len - max_ans - 1
+            while inp and self.get_token_len(inp) > budget:
+                inp = inp[max(len(inp) // 8, 1):]
             ctx = self.get_token_len(inp)
             for c in choices:
                 full = inp + c

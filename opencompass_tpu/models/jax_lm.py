@@ -85,6 +85,7 @@ class JaxLM(BaseModel):
                  seed: int = 0,
                  tokenizer_only: bool = False,
                  batch_padding: bool = True,
+                 quantize: Optional[str] = None,
                  run_cfg: Optional[Dict] = None):
         super().__init__(path=path, max_seq_len=max_seq_len,
                          tokenizer_only=tokenizer_only,
@@ -112,6 +113,10 @@ class JaxLM(BaseModel):
         self._ids_cache_max = 8192
         self._len_cache_max = 1_000_000
         self._gen_fn_cache: Dict[tuple, object] = {}
+        if quantize not in (None, 'int8'):
+            raise ValueError(f'unsupported quantize={quantize!r} '
+                             "(only 'int8')")
+        self.quantize = quantize
         self.mesh = None
         self.params = None
         if not tokenizer_only:
@@ -159,6 +164,10 @@ class JaxLM(BaseModel):
             # full model never has to fit on a single chip
             self.cfg, self.params = convert_checkpoint(path, self.cfg)
             logger.info(f'loaded checkpoint from {path}')
+            if self.quantize == 'int8':
+                # host-side: only the int8 tensors ever reach a chip
+                from opencompass_tpu.nn.quant import quantize_params
+                self.params = quantize_params(self.params, self.cfg)
         elif jax.process_count() > 1:
             if path:
                 logger.warning(f'no weights under {path!r}; random init '
@@ -168,11 +177,30 @@ class JaxLM(BaseModel):
             # *local* device — jax.devices()[0] may belong to rank 0.)
             with jax.default_device(jax.local_devices(backend='cpu')[0]):
                 self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
+            if self.quantize == 'int8':
+                from opencompass_tpu.nn.quant import quantize_params
+                self.params = jax.tree_util.tree_map(np.asarray,
+                                                     self.params)
+                self.params = quantize_params(self.params, self.cfg)
         else:
             if path:
                 logger.warning(f'no weights under {path!r}; random init '
                                f'(seed={seed})')
-            self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
+            if self.quantize == 'int8':
+                # ONE fused program: the bf16 weights are scheduler temps
+                # freed as each int8 consumer runs, so init+quantize of a
+                # near-HBM-sized model fits without fragmentation (a
+                # sequence of per-leaf donations fragments the allocator;
+                # host init is minutes-slow at 7B)
+                from opencompass_tpu.nn.quant import quantize_params
+                cfg = self.cfg
+                self.params = jax.jit(
+                    lambda key: quantize_params(init_params(cfg, key),
+                                                cfg))(
+                                                    jax.random.PRNGKey(seed))
+            else:
+                self.params = init_params(self.cfg,
+                                          jax.random.PRNGKey(seed))
 
     def _maybe_shard(self, parallel: Optional[Dict]):
         n_dev = len(jax.devices())
@@ -371,7 +399,11 @@ class JaxLM(BaseModel):
             if use_ring:
                 logits = ring_forward(params, cfg, tokens, mask, mesh)
             else:
-                logits = forward(params, cfg, tokens, mask)
+                # prefix-LM (GLM): the whole prompt is bidirectional
+                # context when scoring the next-token choice
+                prefix = mask if cfg.prefix_lm else None
+                logits = forward(params, cfg, tokens, mask,
+                                 prefix_mask=prefix)
             last = jnp.maximum(
                 jnp.sum(mask.astype(jnp.int32), axis=-1) - 1, 0)
             return self._replicate(jnp.take_along_axis(

@@ -1,0 +1,81 @@
+"""Weight-only int8 quantization for the decode-bound eval path.
+
+Decode reads every weight byte once per generated token, so on a v5e the
+per-step floor is weight-bytes / HBM bandwidth (measured ~75% of peak on
+the matmul stream).  Storing the transformer matmul weights as int8 with a
+per-output-channel bf16 scale halves those bytes; the MXU consumes the
+int8 operand through an on-the-fly convert fused into the matmul, and the
+product is rescaled after the contraction (valid because the scale is
+constant along the contraction axis).
+
+Quality: symmetric per-channel weight-only int8 is the standard inference
+recipe — embeddings, lm_head, norms, and biases stay in bf16, activations
+are never quantized.  Opt in via ``JaxLM(..., quantize='int8')``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+# layer-dict entries that are matmul weights (contraction axis differs by
+# storage orientation: q/k/v are (out, in) — see transformer._linear_nt)
+_NT_KEYS = ('q', 'k', 'v')
+_IN_OUT_KEYS = ('o', 'gate', 'up', 'down', 'fc1', 'fc2')
+
+
+def _quantize_math(w, axis: int, xp):
+    amax = xp.max(xp.abs(w.astype(xp.float32)), axis=axis, keepdims=True)
+    scale = xp.maximum(amax / 127.0, 1e-12)
+    wq = xp.clip(xp.round(w.astype(xp.float32) / scale), -127,
+                 127).astype(xp.int8)
+    return wq, xp.squeeze(scale, axis=axis).astype(xp.float32)
+
+
+def _quantize_weight(w, axis: int):
+    """Symmetric int8 over `axis` (the contraction axis); returns (wq, s)
+    with s shaped like w minus that axis.
+
+    Host numpy arrays stay on the host (checkpoint params are quantized
+    before sharding so the full model never has to fit one chip).  Device
+    arrays go through a per-leaf jit; for near-HBM-sized models prefer
+    tracing quantize_params together with the initializer in ONE program
+    (see models/jax_lm.py) so the full-precision weights only ever exist
+    as scheduler temps.
+    """
+    import jax
+    if isinstance(w, jax.core.Tracer) or not isinstance(w, jax.Array):
+        xp = jnp if isinstance(w, jax.core.Tracer) else np
+        return _quantize_math(w, axis, xp)
+    return jax.jit(functools.partial(_quantize_math, axis=axis, xp=jnp))(w)
+
+
+def quantize_params(params, cfg):
+    """Return a copy of `params` with layer matmul weights int8-quantized.
+
+    Works on host numpy or device arrays (and traces cleanly under jit);
+    leaves everything except the layer matmul 'w' entries untouched.
+    Handles both stacked (scan) and per-layer (unrolled list) layouts —
+    the contraction axis is counted from the trailing end so a leading
+    layer dim never shifts it.
+    """
+    def quantize_layer(layer):
+        out = {}
+        for name, p in layer.items():
+            if isinstance(p, dict) and 'w' in p and np.ndim(p['w']) >= 2:
+                axis = -1 if name in _NT_KEYS else -2
+                if name in _NT_KEYS or name in _IN_OUT_KEYS:
+                    wq, s = _quantize_weight(p['w'], axis)
+                    q = dict(p, w=wq, s=s.astype(jnp.bfloat16))
+                    out[name] = q
+                    continue
+            out[name] = p
+        return out
+
+    layers = params['layers']
+    if isinstance(layers, (list, tuple)):
+        new_layers = type(layers)(quantize_layer(lp) for lp in layers)
+    else:
+        new_layers = quantize_layer(layers)
+    return dict(params, layers=new_layers)

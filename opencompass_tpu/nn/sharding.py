@@ -25,16 +25,17 @@ from .config import TransformerConfig
 # entries under 'layers'.
 _LAYER_SPECS = {
     # q/k/v are stored (out, in) — transformer._linear_nt — so the
-    # column-parallel (per-head output) dim is first
-    'q': {'w': P('model', None), 'b': P('model')},
-    'k': {'w': P('model', None), 'b': P('model')},
-    'v': {'w': P('model', None), 'b': P('model')},
-    'o': {'w': P('model', None), 'b': P(None)},
-    'gate': {'w': P(None, 'model'), 'b': P('model')},
-    'up': {'w': P(None, 'model'), 'b': P('model')},
-    'down': {'w': P('model', None), 'b': P(None)},
-    'fc1': {'w': P(None, 'model'), 'b': P('model')},
-    'fc2': {'w': P('model', None), 'b': P(None)},
+    # column-parallel (per-head output) dim is first.  's' is the int8
+    # per-output-channel dequant scale (nn/quant.py): same layout as 'b'.
+    'q': {'w': P('model', None), 'b': P('model'), 's': P('model')},
+    'k': {'w': P('model', None), 'b': P('model'), 's': P('model')},
+    'v': {'w': P('model', None), 'b': P('model'), 's': P('model')},
+    'o': {'w': P('model', None), 'b': P(None), 's': P(None)},
+    'gate': {'w': P(None, 'model'), 'b': P('model'), 's': P('model')},
+    'up': {'w': P(None, 'model'), 'b': P('model'), 's': P('model')},
+    'down': {'w': P('model', None), 'b': P(None), 's': P(None)},
+    'fc1': {'w': P(None, 'model'), 'b': P('model'), 's': P('model')},
+    'fc2': {'w': P('model', None), 'b': P(None), 's': P(None)},
     'attn_norm': {'scale': P(None), 'bias': P(None)},
     'mlp_norm': {'scale': P(None), 'bias': P(None)},
 }
@@ -69,7 +70,7 @@ def param_specs(cfg: TransformerConfig) -> Dict:
     names += ['gate', 'up', 'down'] if cfg.gated_mlp else ['fc1', 'fc2']
     for name in names:
         specs['layers'][name] = {}
-        for leaf in ('w', 'b', 'scale', 'bias'):
+        for leaf in ('w', 'b', 's', 'scale', 'bias'):
             if leaf in _LAYER_SPECS[name]:
                 specs['layers'][name][leaf] = with_layer_axis(
                     _LAYER_SPECS[name][leaf])

@@ -142,7 +142,11 @@ def _act(x, kind: str):
 
 
 def _linear(x, p):
-    y = x @ p['w']
+    w = p['w']
+    if w.dtype == jnp.int8:  # weight-only quant (nn/quant.py)
+        y = (x @ w.astype(x.dtype)) * p['s'].astype(x.dtype)
+    else:
+        y = x @ w
     if 'b' in p:
         y = y + p['b']
     return y
@@ -159,7 +163,12 @@ def _linear_nt(x, p):
     handles the 'NT' contraction in prefill/PPL matmuls natively, so the
     full-sequence path loses nothing.
     """
-    y = jnp.einsum('...i,oi->...o', x, p['w'])
+    w = p['w']
+    if w.dtype == jnp.int8:
+        y = jnp.einsum('...i,oi->...o', x, w.astype(x.dtype)) \
+            * p['s'].astype(x.dtype)
+    else:
+        y = jnp.einsum('...i,oi->...o', x, w)
     if 'b' in p:
         y = y + p['b']
     return y
@@ -223,8 +232,16 @@ def _attention(q, k, v, mask, cfg: TransformerConfig, bias=None):
 def _row_parallel(x, p, tp_axis):
     """Row-sharded linear inside shard_map: local matmul, psum over the
     tensor-parallel axis, bias added once after the reduction (the bias is
-    replicated — adding it per shard would count it n_tp times)."""
-    y = jax.lax.psum(x @ p['w'], tp_axis)
+    replicated — adding it per shard would count it n_tp times).  The int8
+    dequant scale is per-output-channel (constant along the sharded
+    contraction), so rescaling the local partial product commutes with the
+    psum."""
+    w = p['w']
+    if w.dtype == jnp.int8:
+        y = (x @ w.astype(x.dtype)) * p['s'].astype(x.dtype)
+    else:
+        y = x @ w
+    y = jax.lax.psum(y, tp_axis)
     if 'b' in p:
         y = y + p['b']
     return y

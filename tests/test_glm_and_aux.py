@@ -197,3 +197,31 @@ def test_agieval_v1_chinese_cloze(tmp_path):
     ds = AGIEvalDataset.load(path=str(tmp_path), name='gaokao-mathcloze')
     assert ds[0]['problem_input'] == '问题：求x\n答案：'
     assert ds[0]['label'] == '42'
+
+
+def test_pjexam_evaluator_letter_and_cloze():
+    from opencompass_tpu.datasets.pjexam import PJExamEvaluator
+    ev = PJExamEvaluator()
+    # marked predictions
+    r = ev.score(['【解析】...<eoe>\n【答案】B<eoa>'], ['B'])
+    assert r['accuracy'] == 100
+    # unmarked prose must not harvest letters out of words
+    r = ev.score(['The answer is B'], ['B'])
+    assert r['accuracy'] == 100
+    r = ev.score(['BAGGAGE claims everywhere'], ['B'])
+    assert r['accuracy'] == 0
+    # multi-letter, order-insensitive
+    r = ev.score(['【答案】DB<eoa>'], ['BD'])
+    assert r['accuracy'] == 100
+    # cloze: numeric std_ans, exact match
+    r = ev.score(['【答案】42<eoa>'], ['42'])
+    assert r['accuracy'] == 100
+    r = ev.score(['【答案】41<eoa>'], ['42'])
+    assert r['accuracy'] == 0
+
+
+def test_choice_truncates_overlong_context():
+    m = FakeModel(max_seq_len=32)
+    long_input = 'word ' * 500
+    out = m.choice([long_input], [' yes', ' no'])
+    assert out[0] in (' yes', ' no')
