@@ -44,8 +44,10 @@ def _answer_segment(text: str):
 
 
 def _extract_letters(text: str) -> str:
-    """A-G letters, sorted, deduped so 'BA' == 'AB'."""
-    return ''.join(sorted(dict.fromkeys(re.findall(r'[A-G]', text))))
+    """A-G letters (case-insensitive — used only on marked answer
+    segments), sorted, deduped so 'BA' == 'AB'."""
+    return ''.join(sorted(dict.fromkeys(re.findall(r'[A-G]',
+                                                   text.upper()))))
 
 
 def _pred_letters(pred: str) -> str:

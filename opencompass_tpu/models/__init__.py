@@ -1,5 +1,6 @@
 from .base import BaseModel, LMTemplateParser  # noqa
 from .base_api import APITemplateParser, BaseAPIModel, TokenBucket  # noqa
+from .completions_api import CompletionsAPI  # noqa
 from .fake import FakeModel  # noqa
 from .glm import GLM130B  # noqa
 from .jax_lm import JaxLM  # noqa
@@ -7,6 +8,6 @@ from .tokenizer import ByteTokenizer, load_tokenizer  # noqa
 
 __all__ = [
     'BaseModel', 'LMTemplateParser', 'APITemplateParser', 'BaseAPIModel',
-    'TokenBucket', 'FakeModel', 'GLM130B', 'JaxLM', 'ByteTokenizer',
-    'load_tokenizer'
+    'CompletionsAPI', 'TokenBucket', 'FakeModel', 'GLM130B', 'JaxLM',
+    'ByteTokenizer', 'load_tokenizer'
 ]

@@ -1,0 +1,135 @@
+"""Text-completions API model with logprob-based PPL.
+
+Parity: reference openicl/utils/api_service.py:1-104 — standalone
+OPT-175B / GPT-3 helpers (``api_get_ppl`` via ``echo=True, max_tokens=0``
+logprobs, ``api_get_tokens`` completions) that no other reference module
+imports.  Here the same measurements are a first-class model wrapper over
+any OpenAI-compatible ``/v1/completions`` endpoint, so API-served base
+models can run BOTH eval modes — free-form generation and PPL ranking —
+through the standard inferencers (the chat wrapper, models/openai_api.py,
+can only generate).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Union
+
+from opencompass_tpu.registry import MODELS
+from opencompass_tpu.utils.logging import get_logger
+from opencompass_tpu.utils.prompt import PromptList
+
+from .base_api import BaseAPIModel
+
+PromptType = Union[PromptList, str]
+
+logger = get_logger()
+
+
+@MODELS.register_module()
+class CompletionsAPI(BaseAPIModel):
+    """Args:
+        path: model name sent in the request body.
+        url: completions endpoint (e.g. 'http://host:8000/v1/completions').
+        key: bearer token, or 'ENV' to read OPENAI_API_KEY ('' = no auth).
+        query_per_second / retry: rate limiting and retry budget.
+    """
+
+    is_api = True
+
+    def __init__(self,
+                 path: str,
+                 url: str,
+                 max_seq_len: int = 2048,
+                 query_per_second: int = 1,
+                 retry: int = 2,
+                 key: str = 'ENV',
+                 meta_template: Optional[Dict] = None,
+                 temperature: Optional[float] = None,
+                 generation_kwargs: Optional[Dict] = None):
+        super().__init__(path=path,
+                         max_seq_len=max_seq_len,
+                         meta_template=meta_template,
+                         query_per_second=query_per_second,
+                         retry=retry,
+                         generation_kwargs=generation_kwargs)
+        self.url = url
+        self.key = os.environ.get('OPENAI_API_KEY', '') if key == 'ENV' \
+            else key
+        self.temperature = temperature
+
+    # -- transport ---------------------------------------------------------
+
+    def _post(self, body: Dict) -> Dict:
+        headers = {'Content-Type': 'application/json'}
+        if self.key:
+            headers['Authorization'] = f'Bearer {self.key}'
+        for attempt in range(self.retry + 1):
+            self.wait()
+            try:
+                request = urllib.request.Request(
+                    self.url, data=json.dumps(body).encode(),
+                    headers=headers)
+                with urllib.request.urlopen(request, timeout=120) as resp:
+                    return json.loads(resp.read())
+            except urllib.error.HTTPError as err:
+                if err.code == 429:
+                    logger.warning('rate limited; backing off')
+                    time.sleep(2 ** attempt)
+                    continue
+                logger.error(f'API error {err.code}: {err.reason}')
+            except Exception as exc:  # noqa: BLE001 — network variance
+                logger.error(f'API request failed: {exc}')
+                time.sleep(1)
+        raise RuntimeError(
+            f'completions API failed after {self.retry + 1} attempts '
+            f'({self.url})')
+
+    # -- BaseModel contract ------------------------------------------------
+
+    def generate(self, inputs: List[PromptType],
+                 max_out_len: int = 512) -> List[str]:
+        def one(prompt):
+            body = {'model': self.path, 'prompt': str(prompt),
+                    'max_tokens': max_out_len}
+            if self.temperature is not None:
+                body['temperature'] = self.temperature
+            body.update(self.generation_kwargs)
+            data = self._post(body)
+            return data['choices'][0]['text']
+        with ThreadPoolExecutor() as pool:
+            futures = [pool.submit(one, p) for p in inputs]
+            try:
+                return [f.result() for f in futures]
+            except Exception:
+                for f in futures:
+                    f.cancel()
+                raise
+
+    def get_ppl(self,
+                inputs: List[str],
+                mask_length: Optional[List[int]] = None) -> List[float]:
+        """Mean token NLL via echoed prompt logprobs (the reference
+        api_get_ppl measurement: ``echo=True, max_tokens=0`` and sum of
+        ``token_logprobs`` — reference api_service.py:53-70).  With
+        ``mask_length``, the first N tokens' logprobs are excluded."""
+        def one(args):
+            i, text = args
+            body = {'model': self.path, 'prompt': str(text),
+                    'max_tokens': 0, 'echo': True, 'logprobs': 0}
+            data = self._post(body)
+            lp = data['choices'][0]['logprobs']['token_logprobs']
+            # the first token has no conditional logprob (null)
+            vals = [x for x in lp if x is not None]
+            if mask_length is not None:
+                skip = mask_length[i] - (len(lp) - len(vals))
+                vals = vals[max(skip, 0):]
+            if not vals:
+                return 0.0
+            return -sum(vals) / len(vals)
+        with ThreadPoolExecutor() as pool:
+            return list(pool.map(one, enumerate(inputs)))

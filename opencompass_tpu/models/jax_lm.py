@@ -113,10 +113,14 @@ class JaxLM(BaseModel):
         self._ids_cache_max = 8192
         self._len_cache_max = 1_000_000
         self._gen_fn_cache: Dict[tuple, object] = {}
-        if quantize not in (None, 'int8'):
+        if quantize not in (None, 'int8', 'int8-kv'):
             raise ValueError(f'unsupported quantize={quantize!r} '
-                             "(only 'int8')")
+                             "('int8' = weight-only, 'int8-kv' = weights "
+                             '+ decode KV cache)')
         self.quantize = quantize
+        if quantize == 'int8-kv' and self.cfg is not None:
+            import dataclasses
+            self.cfg = dataclasses.replace(self.cfg, kv_quant=True)
         self.mesh = None
         self.params = None
         if not tokenizer_only:
@@ -164,7 +168,7 @@ class JaxLM(BaseModel):
             # full model never has to fit on a single chip
             self.cfg, self.params = convert_checkpoint(path, self.cfg)
             logger.info(f'loaded checkpoint from {path}')
-            if self.quantize == 'int8':
+            if self.quantize in ('int8', 'int8-kv'):
                 # host-side: only the int8 tensors ever reach a chip
                 from opencompass_tpu.nn.quant import quantize_params
                 self.params = quantize_params(self.params, self.cfg)
@@ -177,7 +181,7 @@ class JaxLM(BaseModel):
             # *local* device — jax.devices()[0] may belong to rank 0.)
             with jax.default_device(jax.local_devices(backend='cpu')[0]):
                 self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
-            if self.quantize == 'int8':
+            if self.quantize in ('int8', 'int8-kv'):
                 from opencompass_tpu.nn.quant import quantize_params
                 self.params = jax.tree_util.tree_map(np.asarray,
                                                      self.params)
@@ -186,7 +190,7 @@ class JaxLM(BaseModel):
             if path:
                 logger.warning(f'no weights under {path!r}; random init '
                                f'(seed={seed})')
-            if self.quantize == 'int8':
+            if self.quantize in ('int8', 'int8-kv'):
                 # ONE fused program: the bf16 weights are scheduler temps
                 # freed as each int8 consumer runs, so init+quantize of a
                 # near-HBM-sized model fits without fragmentation (a
@@ -392,6 +396,11 @@ class JaxLM(BaseModel):
         mesh = self.mesh
         use_ring = mesh is not None and mesh.shape.get('seq', 1) > 1
         if use_ring:
+            if cfg.prefix_lm:
+                raise ValueError('prefix-LM choice scoring is not '
+                                 'supported with sequence parallelism '
+                                 '(ring attention is causal-blocked); use '
+                                 'a data/model mesh')
             from opencompass_tpu.parallel.ring_attention import ring_forward
 
         @jax.jit

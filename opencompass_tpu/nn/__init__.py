@@ -7,12 +7,14 @@ through jit-compiled functions with explicit `jax.sharding` annotations so a
 single code path serves one chip, a v5e-8 slice, or a multi-host pod.
 """
 from .config import TransformerConfig
-from .transformer import init_params, forward, prefill, decode_step
+from .transformer import (init_params, forward, prefill, decode_step,
+                          init_cache)
 from .loss import sequence_nll
 from .decode import greedy_generate
 from .sharding import param_shardings, shard_params
 
 __all__ = [
     'TransformerConfig', 'init_params', 'forward', 'prefill', 'decode_step',
+    'init_cache',
     'sequence_nll', 'greedy_generate', 'param_shardings', 'shard_params',
 ]

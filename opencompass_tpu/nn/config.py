@@ -46,6 +46,10 @@ class TransformerConfig:
     # tokens causally (scoring passes the context extent via mask_length;
     # generation treats the whole prompt as context)
     prefix_lm: bool = False
+    # int8 KV cache with per-vector scales (decode path only — scoring
+    # builds no cache and is numerically unaffected); halves the
+    # cache-read bytes that dominate large-batch decode attention
+    kv_quant: bool = False
     dtype: str = 'bfloat16'           # parameter/compute dtype
     # scan-over-layers keeps compile time O(1) in depth; turn off to inspect
     # per-layer arrays by name.

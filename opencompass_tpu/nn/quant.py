@@ -64,6 +64,9 @@ def quantize_params(params, cfg):
         out = {}
         for name, p in layer.items():
             if isinstance(p, dict) and 'w' in p and np.ndim(p['w']) >= 2:
+                if getattr(p['w'], 'dtype', None) == jnp.int8:
+                    out[name] = p  # already quantized: keep its scales
+                    continue
                 axis = -1 if name in _NT_KEYS else -2
                 if name in _NT_KEYS or name in _IN_OUT_KEYS:
                     wq, s = _quantize_weight(p['w'], axis)

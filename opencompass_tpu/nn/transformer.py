@@ -210,22 +210,43 @@ def _rope(x, positions, theta: float):
     return out.astype(x.dtype)
 
 
-def _attention(q, k, v, mask, cfg: TransformerConfig, bias=None):
+def _attention(q, k, v, mask, cfg: TransformerConfig, bias=None,
+               k_scale=None, v_scale=None):
     """Grouped-query attention.  q: (B,T,H,hd); k,v: (B,S,K,hd);
     mask: (B,T,S) boolean (True = attend); bias: optional (B,H,T,S)
-    additive fp32 scores (ALiBi).  fp32 softmax accumulation."""
+    additive fp32 scores (ALiBi).  fp32 softmax accumulation.
+
+    With an int8 KV cache, k/v arrive int8 and k_scale/v_scale (B,S,K)
+    carry each vector's dequant scale.  The scales are constant along the
+    head_dim contraction, so they fold into the scores (for k) and the
+    probabilities (for v) instead of materializing a dequantized cache.
+    """
     B, T, H, hd = q.shape
     S, K = k.shape[1], k.shape[2]
     G = H // K
     qg = q.reshape(B, T, K, G, hd)
-    scores = jnp.einsum('btkgh,bskh->bkgts', qg, k,
+    kk = k.astype(qg.dtype) if k.dtype == jnp.int8 else k
+    scores = jnp.einsum('btkgh,bskh->bkgts', qg, kk,
                         preferred_element_type=jnp.float32)
     scores = scores * (hd ** -0.5)
+    if k_scale is not None:
+        # (B,S,K) -> (B,K,1,1,S)
+        scores = scores * jnp.transpose(
+            k_scale.astype(jnp.float32), (0, 2, 1))[:, :, None, None, :]
     if bias is not None:
         scores = scores + bias.reshape(B, K, G, T, S)
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum('bkgts,bskh->btkgh', probs.astype(v.dtype), v)
+    if v.dtype == jnp.int8:
+        pd = qg.dtype
+        if v_scale is not None:
+            probs = probs * jnp.transpose(
+                v_scale.astype(jnp.float32),
+                (0, 2, 1))[:, :, None, None, :]
+        out = jnp.einsum('bkgts,bskh->btkgh', probs.astype(pd),
+                         v.astype(pd))
+    else:
+        out = jnp.einsum('bkgts,bskh->btkgh', probs.astype(v.dtype), v)
     return out.reshape(B, T, H, hd)
 
 
@@ -275,15 +296,23 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
         k = _rope(k, positions, cfg.rope_theta)
 
     new_cache = None
+    k_scale = v_scale = None
     if cache_slice is not None:
-        ck = jax.lax.dynamic_update_slice_in_dim(
-            cache_slice['k'], k.astype(cache_slice['k'].dtype), cache_index,
-            axis=1)
-        cv = jax.lax.dynamic_update_slice_in_dim(
-            cache_slice['v'], v.astype(cache_slice['v'].dtype), cache_index,
-            axis=1)
-        new_cache = {'k': ck, 'v': cv}
-        k, v = ck, cv
+        if 'ks' in cache_slice:  # int8 KV cache (cfg.kv_quant)
+            k, ks_new = _quantize_kv(k)
+            v, vs_new = _quantize_kv(v)
+            kq = {'ks': ks_new.astype(cache_slice['ks'].dtype),
+                  'vs': vs_new.astype(cache_slice['vs'].dtype)}
+        else:
+            kq = {}
+        new_cache = {}
+        for name, cur in (('k', k), ('v', v), *kq.items()):
+            new_cache[name] = jax.lax.dynamic_update_slice_in_dim(
+                cache_slice[name], cur.astype(cache_slice[name].dtype),
+                cache_index, axis=1)
+        k, v = new_cache['k'], new_cache['v']
+        if kq:
+            k_scale, v_scale = new_cache['ks'], new_cache['vs']
 
     if attn_fn is not None:
         attn = attn_fn(q, k, v)
@@ -292,7 +321,8 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
         if cfg.positional == 'alibi':
             kv_pos = kv_positions if kv_positions is not None else positions
             bias = _alibi_bias(cfg, positions, kv_pos)
-        attn = _attention(q, k, v, mask, cfg, bias=bias)
+        attn = _attention(q, k, v, mask, cfg, bias=bias,
+                          k_scale=k_scale, v_scale=v_scale)
     attn2d = attn.reshape(B, T, -1)
     if tp_axis is None:
         attn = _linear(attn2d, lp['o'])
@@ -468,7 +498,23 @@ def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                dtype=None) -> Dict:
     dtype = dtype or cfg.jnp_dtype
     shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    if cfg.kv_quant:
+        sshape = shape[:-1]
+        return {'k': jnp.zeros(shape, jnp.int8),
+                'v': jnp.zeros(shape, jnp.int8),
+                'ks': jnp.ones(sshape, dtype),
+                'vs': jnp.ones(sshape, dtype)}
     return {'k': jnp.zeros(shape, dtype), 'v': jnp.zeros(shape, dtype)}
+
+
+def _quantize_kv(x):
+    """Per-vector (over head_dim) symmetric int8: (B,T,K,hd) ->
+    (int8 same shape, scales (B,T,K))."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    xi = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                  -127, 127).astype(jnp.int8)
+    return xi, scale
 
 
 def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,

@@ -1,0 +1,92 @@
+"""CompletionsAPI: generation and echo-logprob PPL over a mocked
+OpenAI-compatible /v1/completions endpoint."""
+import io
+import json
+
+import numpy as np
+import pytest
+
+from opencompass_tpu.models import CompletionsAPI
+
+
+class _FakeResponse:
+    def __init__(self, payload):
+        self._data = json.dumps(payload).encode()
+
+    def read(self):
+        return self._data
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def _patch_endpoint(monkeypatch, handler):
+    def fake_urlopen(request, timeout=None):
+        body = json.loads(request.data)
+        return _FakeResponse(handler(body))
+    monkeypatch.setattr('urllib.request.urlopen', fake_urlopen)
+
+
+def test_generate(monkeypatch):
+    def handler(body):
+        assert body['model'] == 'opt-175b'
+        assert body['max_tokens'] == 16
+        return {'choices': [{'text': f" -> completion of {body['prompt']}"}]}
+    _patch_endpoint(monkeypatch, handler)
+    m = CompletionsAPI(path='opt-175b', url='http://x/v1/completions',
+                       key='', query_per_second=1000)
+    out = m.generate(['a', 'b'], max_out_len=16)
+    assert out == [' -> completion of a', ' -> completion of b']
+
+
+def test_get_ppl_echo_logprobs(monkeypatch):
+    def handler(body):
+        assert body == {'model': 'm', 'prompt': body['prompt'],
+                        'max_tokens': 0, 'echo': True, 'logprobs': 0}
+        # 4 tokens: first logprob is null (no conditional), then 3 values
+        return {'choices': [{'logprobs': {
+            'token_logprobs': [None, -1.0, -2.0, -3.0]}}]}
+    _patch_endpoint(monkeypatch, handler)
+    m = CompletionsAPI(path='m', url='http://x', key='',
+                       query_per_second=1000)
+    ppl = m.get_ppl(['some text'])
+    np.testing.assert_allclose(ppl, [2.0])
+    # mask_length=2 masks the null + the first real logprob
+    ppl = m.get_ppl(['some text'], mask_length=[2])
+    np.testing.assert_allclose(ppl, [2.5])
+
+
+def test_ppl_inferencer_over_completions_api(monkeypatch, tmp_path):
+    """The ranking path works end-to-end over an API-served base model."""
+    from opencompass_tpu.datasets.base import BaseDataset
+    from opencompass_tpu.icl import PromptTemplate
+    from opencompass_tpu.icl.inferencers import PPLInferencer
+    from opencompass_tpu.icl.retrievers import ZeroRetriever
+    from datasets import Dataset, DatasetDict
+
+    def handler(body):
+        # favor prompts ending in 'B': higher logprobs -> lower ppl
+        good = str(body['prompt']).strip().endswith('B')
+        lp = -0.1 if good else -5.0
+        return {'choices': [{'logprobs': {
+            'token_logprobs': [None, lp, lp, lp]}}]}
+    _patch_endpoint(monkeypatch, handler)
+
+    class _Toy(BaseDataset):
+        @staticmethod
+        def load():
+            rows = [{'q': f'q{i}', 'a': 'B'} for i in range(2)]
+            return DatasetDict({'train': Dataset.from_list(rows),
+                                'test': Dataset.from_list(rows)})
+
+    ds = _Toy(reader_cfg=dict(input_columns=['q'], output_column='a'))
+    m = CompletionsAPI(path='m', url='http://x', key='',
+                       query_per_second=1000)
+    inf = PPLInferencer(model=m, batch_size=2,
+                        output_json_filepath=str(tmp_path))
+    tmpl = PromptTemplate({'A': 'Q: {q}\nA: A', 'B': 'Q: {q}\nA: B'})
+    preds = inf.inference(ZeroRetriever(ds), prompt_template=tmpl)
+    assert preds == ['B', 'B']
