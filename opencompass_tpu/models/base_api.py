@@ -177,3 +177,45 @@ class BaseAPIModel(BaseModel):
     def wait(self):
         """Block until the rate limiter grants the next query."""
         return self.token_bucket.get_token()
+
+    def post_json(self, url: str, body: Dict,
+                  headers: Optional[Dict] = None,
+                  timeout: float = 120) -> Dict:
+        """Rate-limited JSON POST with the shared retry policy: 429 backs
+        off exponentially, other 4xx fail fast (retrying cannot fix auth or
+        a bad request), 5xx/network errors burn the retry budget; the
+        final error chains the last underlying exception."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+        from opencompass_tpu.utils.logging import get_logger
+        logger = get_logger()
+        hdrs = {'Content-Type': 'application/json', **(headers or {})}
+        last_exc = None
+        for attempt in range(self.retry + 1):
+            self.wait()
+            try:
+                request = urllib.request.Request(
+                    url, data=_json.dumps(body).encode(), headers=hdrs)
+                with urllib.request.urlopen(request,
+                                            timeout=timeout) as resp:
+                    return _json.loads(resp.read())
+            except urllib.error.HTTPError as err:
+                if err.code == 429:
+                    logger.warning('rate limited; backing off')
+                    sleep(2 ** attempt)
+                    last_exc = err
+                    continue
+                if 400 <= err.code < 500:
+                    raise RuntimeError(
+                        f'API rejected the request ({err.code} '
+                        f'{err.reason}, {url})') from err
+                logger.error(f'API error {err.code}: {err.reason}')
+                last_exc = err
+            except Exception as exc:  # noqa: BLE001 — network variance
+                logger.error(f'API request failed: {exc}')
+                last_exc = exc
+                sleep(1)
+        raise RuntimeError(
+            f'API request failed after {self.retry + 1} attempts '
+            f'({url})') from last_exc

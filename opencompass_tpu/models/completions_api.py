@@ -11,11 +11,7 @@ can only generate).
 """
 from __future__ import annotations
 
-import json
 import os
-import time
-import urllib.error
-import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Union
 
@@ -65,29 +61,10 @@ class CompletionsAPI(BaseAPIModel):
     # -- transport ---------------------------------------------------------
 
     def _post(self, body: Dict) -> Dict:
-        headers = {'Content-Type': 'application/json'}
+        headers = {}
         if self.key:
             headers['Authorization'] = f'Bearer {self.key}'
-        for attempt in range(self.retry + 1):
-            self.wait()
-            try:
-                request = urllib.request.Request(
-                    self.url, data=json.dumps(body).encode(),
-                    headers=headers)
-                with urllib.request.urlopen(request, timeout=120) as resp:
-                    return json.loads(resp.read())
-            except urllib.error.HTTPError as err:
-                if err.code == 429:
-                    logger.warning('rate limited; backing off')
-                    time.sleep(2 ** attempt)
-                    continue
-                logger.error(f'API error {err.code}: {err.reason}')
-            except Exception as exc:  # noqa: BLE001 — network variance
-                logger.error(f'API request failed: {exc}')
-                time.sleep(1)
-        raise RuntimeError(
-            f'completions API failed after {self.retry + 1} attempts '
-            f'({self.url})')
+        return self.post_json(self.url, body, headers=headers)
 
     # -- BaseModel contract ------------------------------------------------
 
@@ -115,21 +92,49 @@ class CompletionsAPI(BaseAPIModel):
                 mask_length: Optional[List[int]] = None) -> List[float]:
         """Mean token NLL via echoed prompt logprobs (the reference
         api_get_ppl measurement: ``echo=True, max_tokens=0`` and sum of
-        ``token_logprobs`` — reference api_service.py:53-70).  With
-        ``mask_length``, the first N tokens' logprobs are excluded."""
-        def one(args):
-            i, text = args
-            body = {'model': self.path, 'prompt': str(text),
-                    'max_tokens': 0, 'echo': True, 'logprobs': 0}
-            data = self._post(body)
-            lp = data['choices'][0]['logprobs']['token_logprobs']
-            # the first token has no conditional logprob (null)
-            vals = [x for x in lp if x is not None]
-            if mask_length is not None:
-                skip = mask_length[i] - (len(lp) - len(vals))
-                vals = vals[max(skip, 0):]
+        ``token_logprobs`` — reference api_service.py:53-70).
+
+        ``mask_length`` is rejected: those counts come from the client's
+        heuristic tokenizer (base_api.get_token_len: words + CJK chars)
+        and do not line up with the server's BPE token stream, so masking
+        by them would silently skew normalized-PPL scores.
+        """
+        if mask_length is not None:
+            raise NotImplementedError(
+                'CompletionsAPI.get_ppl cannot honor mask_length: context '
+                'lengths measured by the heuristic client tokenizer do '
+                "not map onto the server's BPE logprobs.  Use a PPL "
+                'template without normalizing_str for API models.')
+
+        def one(text):
+            vals = self._echo_logprobs(text)
             if not vals:
                 return 0.0
             return -sum(vals) / len(vals)
         with ThreadPoolExecutor() as pool:
-            return list(pool.map(one, enumerate(inputs)))
+            return list(pool.map(one, inputs))
+
+    def _echo_logprobs(self, text: str) -> List[float]:
+        body = {'model': self.path, 'prompt': str(text),
+                'max_tokens': 0, 'echo': True, 'logprobs': 0}
+        data = self._post(body)
+        lp = data['choices'][0]['logprobs']['token_logprobs']
+        # the first token has no conditional logprob (null)
+        return [x for x in lp if x is not None]
+
+    def choice(self, inputs: List[str], choices: List[str]) -> List[str]:
+        """Exact conditional log prob per choice, server-side tokenization:
+        sum_logprobs(input + choice) - sum_logprobs(input) is the answer
+        span's log prob regardless of how the heuristic client tokenizer
+        would have counted it.  The bare-input term is scored once per
+        input, not once per (input, choice) pair."""
+        def sum_lp(text):
+            return sum(self._echo_logprobs(text))
+        with ThreadPoolExecutor() as pool:
+            base = list(pool.map(sum_lp, inputs))
+            full = list(pool.map(
+                sum_lp, [inp + c for inp in inputs for c in choices]))
+        n = len(choices)
+        return [choices[max(range(n),
+                            key=lambda j: full[i * n + j] - base[i])]
+                for i in range(len(inputs))]

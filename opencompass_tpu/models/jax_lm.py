@@ -86,6 +86,7 @@ class JaxLM(BaseModel):
                  tokenizer_only: bool = False,
                  batch_padding: bool = True,
                  quantize: Optional[str] = None,
+                 convert_cache: Optional[str] = None,
                  run_cfg: Optional[Dict] = None):
         super().__init__(path=path, max_seq_len=max_seq_len,
                          tokenizer_only=tokenizer_only,
@@ -121,6 +122,7 @@ class JaxLM(BaseModel):
         if quantize == 'int8-kv' and self.cfg is not None:
             import dataclasses
             self.cfg = dataclasses.replace(self.cfg, kv_quant=True)
+        self.convert_cache = convert_cache
         self.mesh = None
         self.params = None
         if not tokenizer_only:
@@ -163,10 +165,12 @@ class JaxLM(BaseModel):
         has_ckpt = path and os.path.isdir(path) and any(
             f.endswith(('.safetensors', '.bin')) for f in os.listdir(path))
         if has_ckpt:
-            from opencompass_tpu.nn.hf_convert import convert_checkpoint
+            from opencompass_tpu.nn.hf_convert import \
+                convert_checkpoint_cached
             # stays host numpy: _maybe_shard places shards directly, so the
             # full model never has to fit on a single chip
-            self.cfg, self.params = convert_checkpoint(path, self.cfg)
+            self.cfg, self.params = convert_checkpoint_cached(
+                path, self.cfg, cache_dir=self.convert_cache)
             logger.info(f'loaded checkpoint from {path}')
             if self.quantize in ('int8', 'int8-kv'):
                 # host-side: only the int8 tensors ever reach a chip

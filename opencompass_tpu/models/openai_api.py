@@ -9,11 +9,7 @@ a clean error only at call time.
 """
 from __future__ import annotations
 
-import json
 import os
-import time
-import urllib.error
-import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Union
 
@@ -97,33 +93,14 @@ class OpenAI(BaseAPIModel):
             body['temperature'] = self.temperature
         body.update(self.generation_kwargs)
 
-        for attempt in range(self.retry + 1):
-            self.wait()
-            try:
-                request = urllib.request.Request(
-                    self.url,
-                    data=json.dumps(body).encode(),
-                    headers={
-                        'Content-Type': 'application/json',
-                        'Authorization': f'Bearer {self.key}',
-                    })
-                with urllib.request.urlopen(request, timeout=60) as resp:
-                    data = json.loads(resp.read())
-                return data['choices'][0]['message']['content'].strip()
-            except urllib.error.HTTPError as err:
-                if err.code == 429:  # rate limited — back off and retry
-                    logger.warning('rate limited; backing off')
-                    time.sleep(2 ** attempt)
-                    continue
-                logger.error(f'API error {err.code}: {err.reason}')
-            except Exception as exc:  # noqa: BLE001 — network variance
-                logger.error(f'API request failed: {exc}')
-                time.sleep(1)
-        # fail the task rather than scoring empty predictions as wrong
-        # answers (reference models/openai_api.py raises after its budget)
-        raise RuntimeError(
-            f'OpenAI API request failed after {self.retry + 1} attempts '
-            f'({self.url})')
+        # shared transport (base_api.post_json): rate limiting, 429
+        # backoff, 4xx fast-fail, exception chaining.  A failure raises so
+        # the task fails rather than scoring empty predictions as wrong
+        # answers (reference models/openai_api.py raises after its budget).
+        data = self.post_json(
+            self.url, body,
+            headers={'Authorization': f'Bearer {self.key}'}, timeout=60)
+        return data['choices'][0]['message']['content'].strip()
 
     def get_token_len(self, prompt: str) -> int:
         try:

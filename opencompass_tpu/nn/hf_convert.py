@@ -383,3 +383,121 @@ def convert_checkpoint(path: str, cfg: Optional[TransformerConfig] = None,
         logger.warning('lm_head missing; tying to embeddings')
         params['lm_head'] = np.ascontiguousarray(params['embed'].T)
     return cfg, params
+
+
+# ---------------------------------------------------------------------------
+# converted-checkpoint cache
+# ---------------------------------------------------------------------------
+
+def _ckpt_fingerprint(path: str, cfg: Optional[TransformerConfig]) -> str:
+    """Key the cache on the source shard set (name/size/mtime) plus the
+    EFFECTIVE dtype the conversion targets (cfg=None resolves to what
+    from_hf_config would pick, so explicit-cfg and derived-cfg callers
+    share entries) — edits or re-downloads invalidate it."""
+    import hashlib
+    if cfg is None:
+        try:
+            cfg = TransformerConfig.from_hf_config(load_hf_config(path))
+        except Exception:
+            pass
+    parts = [cfg.dtype if cfg else 'auto']
+    for f in sorted(os.listdir(path)):
+        if f.endswith(('.safetensors', '.bin', '.json')):
+            st = os.stat(os.path.join(path, f))
+            parts.append(f'{f}:{st.st_size}:{int(st.st_mtime)}')
+    return hashlib.sha256('|'.join(parts).encode()).hexdigest()[:16]
+
+
+def _flatten_tree(tree, prefix=()):
+    out = {}
+    for key, val in tree.items():
+        if isinstance(val, dict):
+            out.update(_flatten_tree(val, prefix + (key,)))
+        else:
+            out['/'.join(prefix + (key,))] = val
+    return out
+
+
+def _unflatten_tree(flat):
+    out: Dict = {}
+    for path, val in flat.items():
+        node = out
+        keys = path.split('/')
+        for key in keys[:-1]:
+            node = node.setdefault(key, {})
+        node[keys[-1]] = val
+    return out
+
+
+def save_converted(loc: str, cfg: TransformerConfig, params: Dict) -> None:
+    """Write a converted pytree as raw-byte npz + manifest (self-contained:
+    bf16 via ml_dtypes dtype names, no torch/orbax needed to read back).
+
+    Runtime-only flags (kv_quant, remat) are reset in the stored config —
+    they don't affect the weights and must not leak from the first caller
+    to later cache hits.  The manifest is written atomically: it is also
+    the cache-hit marker, so a partial one must never exist.
+    """
+    import dataclasses
+    os.makedirs(loc, exist_ok=True)
+    flat = _flatten_tree(params)
+    manifest = {k: {'dtype': str(np.asarray(v).dtype),
+                    'shape': list(np.asarray(v).shape)}
+                for k, v in flat.items()}
+    # pid-unique tmp names: concurrent task processes converting the same
+    # checkpoint must not interleave writes into one file before replace
+    tmp = os.path.join(loc, f'params.tmp.{os.getpid()}.npz')
+    np.savez(tmp, **{k: np.frombuffer(np.ascontiguousarray(v).tobytes(),
+                                      np.uint8)
+                     for k, v in flat.items()})
+    os.replace(tmp, os.path.join(loc, 'params.npz'))
+    stored_cfg = dataclasses.replace(cfg, kv_quant=False, remat=False)
+    mtmp = os.path.join(loc, f'manifest.json.tmp.{os.getpid()}')
+    with open(mtmp, 'w') as f:
+        json.dump({'config': dataclasses.asdict(stored_cfg),
+                   'arrays': manifest}, f)
+    os.replace(mtmp, os.path.join(loc, 'manifest.json'))
+
+
+def load_converted(loc: str) -> Tuple[TransformerConfig, Dict]:
+    import ml_dtypes  # noqa: F401 — registers bfloat16 et al. with numpy
+    with open(os.path.join(loc, 'manifest.json')) as f:
+        meta = json.load(f)
+    cfg = TransformerConfig(**meta['config'])
+    flat = {}
+    with np.load(os.path.join(loc, 'params.npz')) as z:
+        for key, info in meta['arrays'].items():
+            flat[key] = np.frombuffer(
+                z[key].tobytes(), np.dtype(info['dtype'])).reshape(
+                    info['shape'])
+    return cfg, _unflatten_tree(flat)
+
+
+def convert_checkpoint_cached(path: str,
+                              cfg: Optional[TransformerConfig] = None,
+                              cache_dir: Optional[str] = None
+                              ) -> Tuple[TransformerConfig, Dict]:
+    """convert_checkpoint with an on-disk cache of the converted pytree.
+
+    Repeated evals of the same model skip the torch/safetensors shard walk
+    and name mapping — the dominant startup cost for multi-GB checkpoints.
+    """
+    if not cache_dir:
+        return convert_checkpoint(path, cfg)
+    loc = os.path.join(cache_dir, _ckpt_fingerprint(path, cfg))
+    if os.path.isfile(os.path.join(loc, 'manifest.json')):
+        try:
+            cached_cfg, params = load_converted(loc)
+            logger.info(f'loaded converted-checkpoint cache {loc}')
+            # the caller's cfg wins (it carries runtime flags like
+            # kv_quant / remat); the cached one fills in when none given
+            return (cfg if cfg is not None else cached_cfg), params
+        except Exception as exc:  # corrupt cache: fall back to the source
+            logger.warning(f'convert cache {loc} unreadable ({exc}); '
+                           're-converting')
+    out_cfg, params = convert_checkpoint(path, cfg)
+    try:
+        save_converted(loc, out_cfg, params)
+    except OSError as exc:  # cache is best-effort (disk full, read-only fs)
+        logger.warning(f'could not write convert cache {loc}: {exc}')
+    return out_cfg, params

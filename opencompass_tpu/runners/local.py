@@ -41,7 +41,17 @@ class LocalRunner(BaseRunner):
                  num_devices: int = None,
                  debug: bool = False,
                  lark_bot_url: str = None,
-                 keep_tmp_file: bool = False):
+                 keep_tmp_file: bool = False,
+                 task_timeout: float = None,
+                 stall_timeout: float = None,
+                 retry: int = 0):
+        """``task_timeout``: kill a task after this many wall-clock seconds.
+        ``stall_timeout``: kill a task whose log stops growing for this
+        long (hung-process detection — a compile or a wedged device holds a
+        chip slot forever otherwise; first-compile on TPU takes minutes, so
+        values under ~600 s are risky).  ``retry``: relaunch attempts after
+        a failure/kill (the reference's LocalRunner has none —
+        reference runners/local.py:139-141 only warns)."""
         super().__init__(task=task, debug=debug, lark_bot_url=lark_bot_url)
         self.max_num_workers = max_num_workers
         if num_devices is None:
@@ -49,6 +59,9 @@ class LocalRunner(BaseRunner):
             num_devices = len(visible.split(',')) if visible else 1
         self.num_devices = num_devices
         self.keep_tmp_file = keep_tmp_file
+        self.task_timeout = task_timeout
+        self.stall_timeout = stall_timeout
+        self.retry = retry
         self._slot_lock = threading.Lock()
         self._slots = [False] * self.num_devices  # True = in use
 
@@ -129,21 +142,75 @@ class LocalRunner(BaseRunner):
             env.pop('PALLAS_AXON_POOL_IPS', None)
         log_path = task.get_log_path('out')
         os.makedirs(osp.dirname(log_path), exist_ok=True)
-        self.logger.info(f'launch {name} (devices={chip_ids})')
-        with open(log_path, 'w') as log_file:
-            result = subprocess.run(cmd, shell=True, text=True,
-                                    stdout=log_file,
-                                    stderr=subprocess.STDOUT,
-                                    env=env)
-        returncode = result.returncode
-        missing = [p for p in task.get_output_paths()
-                   if not osp.exists(p)]
-        if returncode == 0 and missing:
-            self.logger.warning(
-                f'{name}: exit 0 but outputs missing: {missing[:3]}')
-            returncode = 1
-        if returncode != 0:
+        for attempt in range(self.retry + 1):
+            self.logger.info(f'launch {name} (devices={chip_ids}'
+                             + (f', attempt {attempt + 1}' if attempt
+                                else '') + ')')
+            returncode = self._run_once(cmd, env, log_path, name)
+            missing = [p for p in task.get_output_paths()
+                       if not osp.exists(p)]
+            if returncode == 0 and missing:
+                self.logger.warning(
+                    f'{name}: exit 0 but outputs missing: {missing[:3]}')
+                returncode = 1
+            if returncode == 0:
+                return 0
             self.logger.warning(
                 f'task {name} failed with code {returncode}; '
                 f'see {log_path}')
         return returncode
+
+    def _run_once(self, cmd: str, env: Dict, log_path: str,
+                  name: str) -> int:
+        """Run the task command under the watchdog: kill on wall-clock
+        timeout or when the log file stops growing (hung process)."""
+        watchdog = self.task_timeout is not None \
+            or self.stall_timeout is not None
+        with open(log_path, 'w') as log_file:
+            # Under a watchdog, each task gets its own process group so a
+            # kill takes down the whole tree (the multi-host launcher
+            # spawns workers that would otherwise survive holding the TPU
+            # chips while the slot is reassigned).  Without one, tasks
+            # stay in the runner's group so Ctrl-C still reaches them.
+            proc = subprocess.Popen(cmd, shell=True, text=True,
+                                    stdout=log_file,
+                                    stderr=subprocess.STDOUT,
+                                    env=env, start_new_session=watchdog)
+            if not watchdog:
+                return proc.wait()
+
+            def kill_tree():
+                import signal
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    proc.kill()
+                proc.wait()
+
+            start = time.time()
+            last_size, last_growth = -1, time.time()
+            while True:
+                try:
+                    return proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+                now = time.time()
+                if self.task_timeout and now - start > self.task_timeout:
+                    self.logger.error(
+                        f'{name}: killed after {self.task_timeout:.0f}s '
+                        'wall-clock timeout')
+                    kill_tree()
+                    return -9
+                if self.stall_timeout:
+                    try:
+                        size = os.stat(log_path).st_size
+                    except OSError:
+                        size = -1
+                    if size != last_size:
+                        last_size, last_growth = size, now
+                    elif now - last_growth > self.stall_timeout:
+                        self.logger.error(
+                            f'{name}: killed — log stalled for '
+                            f'{self.stall_timeout:.0f}s')
+                        kill_tree()
+                        return -9

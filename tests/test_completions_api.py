@@ -54,9 +54,10 @@ def test_get_ppl_echo_logprobs(monkeypatch):
                        query_per_second=1000)
     ppl = m.get_ppl(['some text'])
     np.testing.assert_allclose(ppl, [2.0])
-    # mask_length=2 masks the null + the first real logprob
-    ppl = m.get_ppl(['some text'], mask_length=[2])
-    np.testing.assert_allclose(ppl, [2.5])
+    # mask_length counts come from the heuristic client tokenizer and
+    # cannot map onto server BPE logprobs — must refuse, not skew scores
+    with pytest.raises(NotImplementedError):
+        m.get_ppl(['some text'], mask_length=[2])
 
 
 def test_ppl_inferencer_over_completions_api(monkeypatch, tmp_path):
@@ -90,3 +91,18 @@ def test_ppl_inferencer_over_completions_api(monkeypatch, tmp_path):
     tmpl = PromptTemplate({'A': 'Q: {q}\nA: A', 'B': 'Q: {q}\nA: B'})
     preds = inf.inference(ZeroRetriever(ds), prompt_template=tmpl)
     assert preds == ['B', 'B']
+
+
+def test_choice_via_echo_logprobs(monkeypatch):
+    def handler(body):
+        # higher logprobs when the prompt ends with ' right'
+        good = str(body['prompt']).endswith(' right')
+        lp = -0.5 if good else -4.0
+        n_tok = len(str(body['prompt']).split())
+        return {'choices': [{'logprobs': {
+            'token_logprobs': [None] + [lp] * n_tok}}]}
+    _patch_endpoint(monkeypatch, handler)
+    m = CompletionsAPI(path='m', url='http://x', key='',
+                       query_per_second=1000)
+    out = m.choice(['the answer is', 'pick'], [' right', ' wrong'])
+    assert out == [' right', ' right']

@@ -134,3 +134,104 @@ def test_unknown_family_raises(tmp_path):
     with pytest.raises(ValueError, match='unsupported|no weight map'):
         cfg = TransformerConfig.tiny()
         convert_checkpoint(str(tmp_path), cfg)
+
+
+def test_convert_cache_roundtrip(tmp_path, monkeypatch):
+    from opencompass_tpu.nn import hf_convert
+    rng = np.random.RandomState(1)
+    D, F, V, L, H = 16, 32, 64, 2, 4
+    hf = dict(model_type='llama', vocab_size=V, hidden_size=D,
+              num_hidden_layers=L, num_attention_heads=H,
+              num_key_value_heads=2, intermediate_size=F,
+              max_position_embeddings=128, rms_norm_eps=1e-6,
+              tie_word_embeddings=False)
+    hd = D // H
+    kv = 2 * hd
+    tensors = {'model.embed_tokens.weight': rng.randn(V, D),
+               'model.norm.weight': np.ones(D),
+               'lm_head.weight': rng.randn(V, D)}
+    for i in range(L):
+        p = f'model.layers.{i}'
+        tensors[f'{p}.input_layernorm.weight'] = np.ones(D)
+        tensors[f'{p}.post_attention_layernorm.weight'] = np.ones(D)
+        tensors[f'{p}.self_attn.q_proj.weight'] = rng.randn(D, D)
+        tensors[f'{p}.self_attn.k_proj.weight'] = rng.randn(kv, D)
+        tensors[f'{p}.self_attn.v_proj.weight'] = rng.randn(kv, D)
+        tensors[f'{p}.self_attn.o_proj.weight'] = rng.randn(D, D)
+        tensors[f'{p}.mlp.gate_proj.weight'] = rng.randn(F, D)
+        tensors[f'{p}.mlp.up_proj.weight'] = rng.randn(F, D)
+        tensors[f'{p}.mlp.down_proj.weight'] = rng.randn(D, F)
+    tensors = {k: v.astype(np.float32) for k, v in tensors.items()}
+    ckpt = tmp_path / 'ckpt'
+    ckpt.mkdir()
+    _write_ckpt(str(ckpt), hf, tensors)
+    cache = tmp_path / 'cache'
+
+    cfg1, p1 = hf_convert.convert_checkpoint_cached(
+        str(ckpt), cache_dir=str(cache))
+    # second load must come from cache — make a re-conversion impossible
+    monkeypatch.setattr(hf_convert, '_iter_checkpoint_tensors',
+                        lambda *_: (_ for _ in ()).throw(
+                            AssertionError('re-converted instead of '
+                                           'using cache')))
+    cfg2, p2 = hf_convert.convert_checkpoint_cached(
+        str(ckpt), cache_dir=str(cache))
+    assert cfg2 == cfg1
+    flat1 = hf_convert._flatten_tree(p1)
+    flat2 = hf_convert._flatten_tree(p2)
+    assert set(flat1) == set(flat2)
+    for k in flat1:
+        a, b = np.asarray(flat1[k]), np.asarray(flat2[k])
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(
+            a.view(np.uint8).ravel(), b.view(np.uint8).ravel())
+
+    # a requested cfg wins over the cached manifest on hits...
+    import dataclasses
+    req = dataclasses.replace(cfg1, kv_quant=True)
+    cfg3, _ = hf_convert.convert_checkpoint_cached(
+        str(ckpt), cfg=req, cache_dir=str(cache))
+    assert cfg3.kv_quant
+    # ...and runtime flags never leak INTO the stored manifest
+    cfg4, _ = hf_convert.convert_checkpoint_cached(
+        str(ckpt), cfg=None, cache_dir=str(cache))
+    assert not cfg4.kv_quant
+
+
+def test_convert_cache_corrupt_manifest_falls_back(tmp_path, monkeypatch):
+    from opencompass_tpu.nn import hf_convert
+    rng = np.random.RandomState(2)
+    D, V = 16, 64
+    hf = dict(model_type='llama', vocab_size=V, hidden_size=D,
+              num_hidden_layers=1, num_attention_heads=4,
+              num_key_value_heads=2, intermediate_size=32,
+              max_position_embeddings=128, rms_norm_eps=1e-6,
+              tie_word_embeddings=False)
+    hd = D // 4
+    tensors = {'model.embed_tokens.weight': rng.randn(V, D),
+               'model.norm.weight': np.ones(D),
+               'lm_head.weight': rng.randn(V, D),
+               'model.layers.0.input_layernorm.weight': np.ones(D),
+               'model.layers.0.post_attention_layernorm.weight': np.ones(D),
+               'model.layers.0.self_attn.q_proj.weight': rng.randn(D, D),
+               'model.layers.0.self_attn.k_proj.weight':
+                   rng.randn(2 * hd, D),
+               'model.layers.0.self_attn.v_proj.weight':
+                   rng.randn(2 * hd, D),
+               'model.layers.0.self_attn.o_proj.weight': rng.randn(D, D),
+               'model.layers.0.mlp.gate_proj.weight': rng.randn(32, D),
+               'model.layers.0.mlp.up_proj.weight': rng.randn(32, D),
+               'model.layers.0.mlp.down_proj.weight': rng.randn(D, 32)}
+    tensors = {k: v.astype(np.float32) for k, v in tensors.items()}
+    ckpt = tmp_path / 'ckpt'
+    ckpt.mkdir()
+    _write_ckpt(str(ckpt), hf, tensors)
+    cache = tmp_path / 'cache'
+    cfg1, _ = hf_convert.convert_checkpoint_cached(str(ckpt),
+                                                   cache_dir=str(cache))
+    # truncate the manifest: a later load must re-convert, not crash
+    loc = next(cache.iterdir())
+    (loc / 'manifest.json').write_text('{"config": {')
+    cfg2, p2 = hf_convert.convert_checkpoint_cached(str(ckpt),
+                                                    cache_dir=str(cache))
+    assert cfg2 == cfg1 and 'embed' in p2

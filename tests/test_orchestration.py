@@ -179,3 +179,32 @@ def test_eval_task_pred_role_extraction(tmp_path):
     assert extract_role_pred(s, None, None) == s
     assert extract_role_pred(s, '<missing>', '</bot>') == \
         '<sys>ignored</sys><bot>The answer'
+
+
+def test_local_runner_watchdog_kills_hung_task(tmp_path):
+    from opencompass_tpu.runners import LocalRunner
+    r = LocalRunner(task=dict(type='OpenICLInferTask'),
+                    stall_timeout=2, retry=0)
+    log = tmp_path / 'hung.out'
+    # a command that writes once then hangs silently
+    rc = r._run_once('echo started && sleep 60', dict(os.environ),
+                     str(log), 'hung-task')
+    assert rc == -9
+    assert 'started' in log.read_text()
+
+
+def test_local_runner_timeout_kills_task(tmp_path):
+    from opencompass_tpu.runners import LocalRunner
+    r = LocalRunner(task=dict(type='OpenICLInferTask'), task_timeout=2)
+    rc = r._run_once('sleep 60', dict(os.environ),
+                     str(tmp_path / 't.out'), 'slow-task')
+    assert rc == -9
+
+
+def test_local_runner_fast_task_unaffected(tmp_path):
+    from opencompass_tpu.runners import LocalRunner
+    r = LocalRunner(task=dict(type='OpenICLInferTask'),
+                    task_timeout=30, stall_timeout=30)
+    rc = r._run_once('echo ok', dict(os.environ),
+                     str(tmp_path / 'f.out'), 'fast-task')
+    assert rc == 0

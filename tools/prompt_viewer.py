@@ -88,14 +88,30 @@ def main():
     args = parse_args()
     cfg = Config.fromfile(args.config)
     datasets = cfg['datasets']
+    model_cfgs = cfg.get('models') or [{}]
     if args.pattern:
         datasets = [d for d in datasets if fnmatch.fnmatch(
             dataset_abbr_from_cfg(d), args.pattern)]
-    elif not args.all:
-        datasets = datasets[:1]
+        model_cfg = model_cfgs[0]
+    elif not args.all and sys.stdin.isatty() \
+            and (len(datasets) > 1 or len(model_cfgs) > 1):
+        # interactive picker, one selection per list (reference
+        # tools/prompt_viewer.py + utils/menu.py); degrades to a numbered
+        # stdin prompt on dumb terminals
+        from opencompass_tpu.utils import Menu
+        model_names = [model_abbr_from_cfg(m) if m else '-'
+                       for m in model_cfgs]
+        ds_names = [dataset_abbr_from_cfg(d) for d in datasets]
+        chosen = Menu([model_names, ds_names],
+                      prompts=['Choose a model:', 'Choose a dataset:']).run()
+        model_cfg = model_cfgs[model_names.index(chosen[0])]
+        datasets = [datasets[ds_names.index(chosen[1])]]
+    else:
+        model_cfg = model_cfgs[0]
+        if not args.all:
+            datasets = datasets[:1]  # non-interactive default: first only
     if not datasets:
         raise SystemExit('no datasets match')
-    model_cfg = cfg['models'][0] if cfg.get('models') else {}
     for dataset_cfg in datasets:
         abbr = dataset_abbr_from_cfg(dataset_cfg)
         model_abbr = model_abbr_from_cfg(model_cfg) if model_cfg else '-'
