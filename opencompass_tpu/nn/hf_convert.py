@@ -391,16 +391,26 @@ def convert_checkpoint(path: str, cfg: Optional[TransformerConfig] = None,
 
 def _ckpt_fingerprint(path: str, cfg: Optional[TransformerConfig]) -> str:
     """Key the cache on the source shard set (name/size/mtime) plus the
-    EFFECTIVE dtype the conversion targets (cfg=None resolves to what
-    from_hf_config would pick, so explicit-cfg and derived-cfg callers
-    share entries) — edits or re-downloads invalidate it."""
+    EFFECTIVE structural config the conversion targets: cfg=None resolves
+    to what from_hf_config would pick, so explicit-cfg and derived-cfg
+    callers share entries, while a truncated/overridden cfg (fewer layers,
+    tied embeddings, other dtype — all of which change the stored pytree)
+    gets its own entry.  Runtime-only flags are normalized out."""
+    import dataclasses
     import hashlib
     if cfg is None:
         try:
             cfg = TransformerConfig.from_hf_config(load_hf_config(path))
         except Exception:
             pass
-    parts = [cfg.dtype if cfg else 'auto']
+    if cfg is not None:
+        structural = dataclasses.asdict(dataclasses.replace(
+            cfg, kv_quant=False, remat=False, scan_layers=True,
+            max_seq_len=0))
+        cfg_key = json.dumps(structural, sort_keys=True)
+    else:
+        cfg_key = 'auto'
+    parts = [cfg_key]
     for f in sorted(os.listdir(path)):
         if f.endswith(('.safetensors', '.bin', '.json')):
             st = os.stat(os.path.join(path, f))

@@ -146,7 +146,8 @@ class LocalRunner(BaseRunner):
             self.logger.info(f'launch {name} (devices={chip_ids}'
                              + (f', attempt {attempt + 1}' if attempt
                                 else '') + ')')
-            returncode = self._run_once(cmd, env, log_path, name)
+            returncode = self._run_once(cmd, env, log_path, name,
+                                        attempt=attempt)
             missing = [p for p in task.get_output_paths()
                        if not osp.exists(p)]
             if returncode == 0 and missing:
@@ -161,12 +162,24 @@ class LocalRunner(BaseRunner):
         return returncode
 
     def _run_once(self, cmd: str, env: Dict, log_path: str,
-                  name: str) -> int:
+                  name: str, attempt: int = 0) -> int:
         """Run the task command under the watchdog: kill on wall-clock
         timeout or when the log file stops growing (hung process)."""
         watchdog = self.task_timeout is not None \
             or self.stall_timeout is not None
-        with open(log_path, 'w') as log_file:
+        if watchdog:
+            # stall detection reads the log file's size; python
+            # block-buffers redirected stdout (~8 KB), which would make a
+            # healthy slow-logging task look hung
+            env = dict(env, PYTHONUNBUFFERED='1')
+        # append on retries: the failed attempt's log is the evidence the
+        # failure warning points the user at
+        mode = 'a' if attempt else 'w'
+        with open(log_path, mode) as log_file:
+            if attempt:
+                log_file.write(f'\n===== retry attempt {attempt + 1} '
+                               f'=====\n')
+                log_file.flush()
             # Under a watchdog, each task gets its own process group so a
             # kill takes down the whole tree (the multi-host launcher
             # spawns workers that would otherwise survive holding the TPU
@@ -187,30 +200,37 @@ class LocalRunner(BaseRunner):
                     proc.kill()
                 proc.wait()
 
-            start = time.time()
-            last_size, last_growth = -1, time.time()
-            while True:
-                try:
-                    return proc.wait(timeout=5)
-                except subprocess.TimeoutExpired:
-                    pass
-                now = time.time()
-                if self.task_timeout and now - start > self.task_timeout:
-                    self.logger.error(
-                        f'{name}: killed after {self.task_timeout:.0f}s '
-                        'wall-clock timeout')
-                    kill_tree()
-                    return -9
-                if self.stall_timeout:
+            try:
+                start = time.time()
+                last_size, last_growth = -1, time.time()
+                while True:
                     try:
-                        size = os.stat(log_path).st_size
-                    except OSError:
-                        size = -1
-                    if size != last_size:
-                        last_size, last_growth = size, now
-                    elif now - last_growth > self.stall_timeout:
+                        return proc.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        pass
+                    now = time.time()
+                    if self.task_timeout \
+                            and now - start > self.task_timeout:
                         self.logger.error(
-                            f'{name}: killed — log stalled for '
-                            f'{self.stall_timeout:.0f}s')
+                            f'{name}: killed after '
+                            f'{self.task_timeout:.0f}s wall-clock timeout')
                         kill_tree()
                         return -9
+                    if self.stall_timeout:
+                        try:
+                            size = os.stat(log_path).st_size
+                        except OSError:
+                            size = -1
+                        if size != last_size:
+                            last_size, last_growth = size, now
+                        elif now - last_growth > self.stall_timeout:
+                            self.logger.error(
+                                f'{name}: killed — log stalled for '
+                                f'{self.stall_timeout:.0f}s')
+                            kill_tree()
+                            return -9
+            except BaseException:
+                # Ctrl-C / pool teardown: the detached session would
+                # otherwise outlive the runner holding its TPU chips
+                kill_tree()
+                raise

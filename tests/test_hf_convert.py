@@ -235,3 +235,49 @@ def test_convert_cache_corrupt_manifest_falls_back(tmp_path, monkeypatch):
     cfg2, p2 = hf_convert.convert_checkpoint_cached(str(ckpt),
                                                     cache_dir=str(cache))
     assert cfg2 == cfg1 and 'embed' in p2
+
+
+def test_convert_cache_keys_on_structural_cfg(tmp_path):
+    """A truncated/overridden cfg must not collide with the full-model
+    entry (different stored pytrees)."""
+    import dataclasses
+    from opencompass_tpu.nn import hf_convert
+    rng = np.random.RandomState(3)
+    D, V = 16, 64
+    hf = dict(model_type='llama', vocab_size=V, hidden_size=D,
+              num_hidden_layers=2, num_attention_heads=4,
+              num_key_value_heads=2, intermediate_size=32,
+              max_position_embeddings=128, rms_norm_eps=1e-6,
+              tie_word_embeddings=False)
+    hd = D // 4
+    tensors = {'model.embed_tokens.weight': rng.randn(V, D),
+               'model.norm.weight': np.ones(D),
+               'lm_head.weight': rng.randn(V, D)}
+    for i in range(2):
+        p = f'model.layers.{i}'
+        tensors[f'{p}.input_layernorm.weight'] = np.ones(D)
+        tensors[f'{p}.post_attention_layernorm.weight'] = np.ones(D)
+        tensors[f'{p}.self_attn.q_proj.weight'] = rng.randn(D, D)
+        tensors[f'{p}.self_attn.k_proj.weight'] = rng.randn(2 * hd, D)
+        tensors[f'{p}.self_attn.v_proj.weight'] = rng.randn(2 * hd, D)
+        tensors[f'{p}.self_attn.o_proj.weight'] = rng.randn(D, D)
+        tensors[f'{p}.mlp.gate_proj.weight'] = rng.randn(32, D)
+        tensors[f'{p}.mlp.up_proj.weight'] = rng.randn(32, D)
+        tensors[f'{p}.mlp.down_proj.weight'] = rng.randn(D, 32)
+    tensors = {k: v.astype(np.float32) for k, v in tensors.items()}
+    ckpt = tmp_path / 'ckpt'
+    ckpt.mkdir()
+    _write_ckpt(str(ckpt), hf, tensors)
+
+    full = hf_convert.TransformerConfig.from_hf_config(
+        hf_convert.load_hf_config(str(ckpt)))
+    trunc = dataclasses.replace(full, num_layers=1)
+    k_none = hf_convert._ckpt_fingerprint(str(ckpt), None)
+    k_full = hf_convert._ckpt_fingerprint(str(ckpt), full)
+    k_trunc = hf_convert._ckpt_fingerprint(str(ckpt), trunc)
+    assert k_none == k_full            # derived == explicit-equivalent
+    assert k_trunc != k_full           # structural change = new entry
+    # runtime flags don't fork entries
+    k_kv = hf_convert._ckpt_fingerprint(
+        str(ckpt), dataclasses.replace(full, kv_quant=True))
+    assert k_kv == k_full

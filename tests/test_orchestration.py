@@ -4,6 +4,9 @@ import os
 import os.path as osp
 import subprocess
 import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 
 import pytest
 
@@ -208,3 +211,33 @@ def test_local_runner_fast_task_unaffected(tmp_path):
     rc = r._run_once('echo ok', dict(os.environ),
                      str(tmp_path / 'f.out'), 'fast-task')
     assert rc == 0
+
+
+def test_slot_allocator_thread_safety():
+    """Hammer the chip-slot allocator from many threads: no slot may ever
+    be double-assigned, and all slots return free at the end (the lock
+    around the slot array is the framework's only GPU/TPU-slot race
+    guard — cf. reference runners/local.py:60-92)."""
+    from opencompass_tpu.runners import LocalRunner
+    r = LocalRunner(task=dict(type='OpenICLInferTask'), num_devices=4)
+    in_use, errors = set(), []
+    guard = threading.Lock()
+
+    def worker(_):
+        for _ in range(25):
+            ids = r._acquire_slots(1 + _ % 2)
+            with guard:
+                for i in ids:
+                    if i in in_use:
+                        errors.append(f'slot {i} double-assigned')
+                    in_use.add(i)
+            time.sleep(0.001)
+            with guard:
+                for i in ids:
+                    in_use.discard(i)
+            r._release_slots(ids)
+
+    with ThreadPoolExecutor(max_workers=8) as pool:
+        list(pool.map(worker, range(8)))
+    assert not errors, errors[:3]
+    assert r._slots == [False] * 4
