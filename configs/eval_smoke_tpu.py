@@ -1,0 +1,29 @@
+"""On-chip smoke: a small random-init JaxLM over MMLU (2 subjects, 5-shot
+gen), GSM8K CoT, and BoolQ letter-PPL — both BASELINE measurement paths
+(generation and PPL ranking) end to end against
+`tools/make_synth_data.py` fixtures.
+
+    python tools/make_synth_data.py --only mmlu gsm8k superglue
+    python run.py configs/eval_smoke_tpu.py
+"""
+with read_base():
+    from .datasets.mmlu.mmlu_gen import mmlu_datasets
+    from .datasets.gsm8k.gsm8k_gen import gsm8k_datasets
+    from .datasets.SuperGLUE_BoolQ.BoolQ_ppl_letter import BoolQ_datasets
+
+datasets = [*mmlu_datasets[:2], *gsm8k_datasets, *BoolQ_datasets]
+
+models = [dict(
+    abbr='jaxlm-smoke',
+    type='JaxLM',
+    path='',
+    config=dict(preset='llama', vocab_size=32000, hidden_size=512,
+                num_layers=4, num_heads=8, intermediate_size=1408),
+    max_seq_len=2048,
+    batch_padding=True,
+    batch_size=8,
+    max_out_len=128,
+    run_cfg=dict(num_devices=1, num_procs=1),
+)]
+
+work_dir = './outputs/smoke_tpu'

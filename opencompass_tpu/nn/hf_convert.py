@@ -461,7 +461,8 @@ def save_converted(loc: str, cfg: TransformerConfig, params: Dict) -> None:
                                       np.uint8)
                      for k, v in flat.items()})
     os.replace(tmp, os.path.join(loc, 'params.npz'))
-    stored_cfg = dataclasses.replace(cfg, kv_quant=False, remat=False)
+    stored_cfg = dataclasses.replace(cfg, kv_quant=False, remat=False,
+                                     scan_layers=True)
     mtmp = os.path.join(loc, f'manifest.json.tmp.{os.getpid()}')
     with open(mtmp, 'w') as f:
         json.dump({'config': dataclasses.asdict(stored_cfg),
@@ -507,7 +508,18 @@ def convert_checkpoint_cached(path: str,
                            're-converting')
     out_cfg, params = convert_checkpoint(path, cfg)
     try:
-        save_converted(loc, out_cfg, params)
+        # store the checkpoint-derived max_seq_len (a runtime field the
+        # fingerprint normalizes away — the caller's override must not
+        # leak to later cfg=None hits); save_converted resets the rest
+        stored = out_cfg
+        try:
+            derived = TransformerConfig.from_hf_config(load_hf_config(path))
+            import dataclasses
+            stored = dataclasses.replace(out_cfg,
+                                         max_seq_len=derived.max_seq_len)
+        except Exception:
+            pass
+        save_converted(loc, stored, params)
     except OSError as exc:  # cache is best-effort (disk full, read-only fs)
         logger.warning(f'could not write convert cache {loc}: {exc}')
     return out_cfg, params

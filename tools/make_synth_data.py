@@ -1,0 +1,211 @@
+"""Generate synthetic dataset files under ./data so eval configs run
+offline (zero-egress environments, CI smoke tests, new-cluster bring-up).
+
+    python tools/make_synth_data.py [--root ./data] [--rows 8]
+
+Writes miniature but format-faithful files for the local-file dataset
+families the flagship configs use (MMLU CSVs, GSM8K jsonl, MATH json,
+C-Eval csv, ARC jsonl, SuperGLUE jsonl, triviaqa/nq tsv-ish, humaneval
+jsonl, ...).  Content is synthetic; scores are meaningless — the point is
+that the full pipeline (load → prompt → infer → eval → summarize) runs.
+"""
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import os.path as osp
+import sys
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _w(path, text):
+    os.makedirs(osp.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(text)
+
+
+def _wjsonl(path, rows):
+    _w(path, '\n'.join(json.dumps(r, ensure_ascii=False) for r in rows)
+       + '\n')
+
+
+def mmlu(root, n):
+    # loader: opencompass_tpu/datasets/mmlu.py — {name}_{split}.csv rows
+    # (question, A, B, C, D, target)
+    from opencompass_tpu.config import Config
+    cfg = Config.fromfile(osp.join(REPO,
+                                   'configs/datasets/mmlu/mmlu_gen.py'))
+    names = cfg['mmlu_all_sets']
+    for name in names:
+        for split, k in (('dev', 5), ('test', n)):
+            rows = []
+            for i in range(k):
+                gold = 'ABCD'[i % 4]
+                rows.append([f'Synthetic {name} question {i}?',
+                             'alpha', 'beta', 'gamma', 'delta', gold])
+            out = osp.join(root, 'mmlu', split, f'{name}_{split}.csv')
+            os.makedirs(osp.dirname(out), exist_ok=True)
+            with open(out, 'w', newline='', encoding='utf-8') as f:
+                csv.writer(f).writerows(rows)
+
+
+def gsm8k(root, n):
+    # loader: datasets/gsm8k.py — train/test jsonl {question, answer}
+    for split in ('train', 'test'):
+        rows = [{'question': f'What is {i} + {i + 1}?',
+                 'answer': f'Adding gives {2 * i + 1}.\n#### {2 * i + 1}'}
+                for i in range(n)]
+        _wjsonl(osp.join(root, 'gsm8k', f'{split}.jsonl'), rows)
+
+
+def math_ds(root, n):
+    rows = {f'prob_{i}': {'problem': f'Compute ${i}+{i}$.',
+                          'solution': f'${i}+{i}=\\boxed{{{2 * i}}}$',
+                          'level': 'Level 1', 'type': 'Arithmetic'}
+            for i in range(n)}
+    _w(osp.join(root, 'math', 'math.json'),
+       json.dumps(rows, ensure_ascii=False))
+
+
+def ceval(root, n):
+    from opencompass_tpu.config import Config
+    cfg = Config.fromfile(osp.join(REPO,
+                                   'configs/datasets/ceval/ceval_gen.py'))
+    names = list(cfg['ceval_subject_mapping'])
+    header = ['id', 'question', 'A', 'B', 'C', 'D', 'answer']
+    for name in names:
+        for split, k in (('dev', 5), ('val', n), ('test', n)):
+            out = osp.join(root, 'ceval', 'formal_ceval', split,
+                           f'{name}_{split}.csv')
+            os.makedirs(osp.dirname(out), exist_ok=True)
+            with open(out, 'w', newline='', encoding='utf-8') as f:
+                w = csv.writer(f)
+                hdr = list(header)
+                if split == 'dev':
+                    hdr = hdr + ['explanation']
+                if split == 'test':
+                    hdr = hdr[:-1]  # test ships without answers
+                w.writerow(hdr)
+                for i in range(k):
+                    row = [i, f'合成{name}题目{i}？', '甲', '乙', '丙', '丁']
+                    if split != 'test':
+                        row.append('ABCD'[i % 4])
+                    if split == 'dev':
+                        row.append('解析略')
+                    w.writerow(row)
+
+
+def arc(root, n):
+    for sub, fname in (('ARC-c', 'ARC-Challenge-Dev.jsonl'),
+                       ('ARC-e', 'ARC-Easy-Dev.jsonl')):
+        rows = []
+        for i in range(n):
+            rows.append({
+                'question': {
+                    'stem': f'Synthetic {sub} question {i}?',
+                    'choices': [{'label': lab, 'text': f'opt {lab}{i}'}
+                                for lab in 'ABCD'],
+                },
+                'answerKey': 'ABCD'[i % 4],
+            })
+        _wjsonl(osp.join(root, 'ARC', sub, fname), rows)
+
+
+def superglue(root, n):
+    # labels are the literal strings 'true'/'false' in SuperGLUE jsonl
+    # (datasets/boolq.py, wsc.py, wic.py map them to letters)
+    sg = osp.join(root, 'SuperGLUE')
+    _wjsonl(osp.join(sg, 'BoolQ', 'val.jsonl'),
+            [{'question': f'is {i} even', 'passage': f'number {i} facts',
+              'label': 'true' if i % 2 == 0 else 'false'}
+             for i in range(n)])
+    _wjsonl(osp.join(sg, 'COPA', 'val.jsonl'),
+            [{'premise': f'It rained on day {i}.', 'question': 'effect',
+              'choice1': 'The ground got wet.', 'choice2': 'The sun rose.',
+              'label': 0} for i in range(n)])
+    _wjsonl(osp.join(sg, 'WSC', 'val.jsonl'),
+            [{'text': f'The trophy did not fit in case {i} because it was '
+                      'too big.',
+              'target': {'span1_text': 'trophy', 'span2_text': 'it'},
+              'label': 'true'} for i in range(n)])
+    _wjsonl(osp.join(sg, 'WiC', 'val.jsonl'),
+            [{'word': 'bank', 'sentence1': f'river bank {i}',
+              'sentence2': f'money bank {i}', 'label': 'false'}
+             for i in range(n)])
+    _wjsonl(osp.join(sg, 'CB', 'val.jsonl'),
+            [{'premise': f'Premise {i}.', 'hypothesis': f'Hypothesis {i}.',
+              'label': 'entailment'} for i in range(n)])
+    _wjsonl(osp.join(sg, 'RTE', 'val.jsonl'),
+            [{'premise': f'Premise {i}.', 'hypothesis': f'Hypothesis {i}.',
+              'label': 'entailment'} for i in range(n)])
+    # MultiRC nests passage -> questions -> answers
+    _wjsonl(osp.join(sg, 'MultiRC', 'val.jsonl'),
+            [{'passage': {
+                'text': f'Paragraph {i}.',
+                'questions': [{
+                    'question': f'Question {i}?',
+                    'answers': [{'text': f'Answer {i}', 'label': 1},
+                                {'text': f'Wrong {i}', 'label': 0}],
+                }]}} for i in range(n)])
+    _wjsonl(osp.join(sg, 'AX-b', 'AX-b.jsonl'),
+            [{'sentence1': f'S1 {i}.', 'sentence2': f'S2 {i}.',
+              'label': 'entailment'} for i in range(n)])
+    _wjsonl(osp.join(sg, 'AX-g', 'AX-g.jsonl'),
+            [{'premise': f'P {i}.', 'hypothesis': f'H {i}.',
+              'label': 'entailment'} for i in range(n)])
+
+
+def qa(root, n):
+    # loaders expect TSV with a python-literal answer list
+    # (datasets/triviaqa.py, datasets/natural_question.py)
+    def tsv(path):
+        os.makedirs(osp.dirname(path), exist_ok=True)
+        with open(path, 'w', newline='', encoding='utf-8') as f:
+            w = csv.writer(f, delimiter='\t')
+            for i in range(n):
+                w.writerow([f'Who invented thing {i}?',
+                            repr([f'Person {i}', f'Inventor {i}'])])
+    for split in ('dev', 'test'):
+        tsv(osp.join(root, 'triviaqa', f'trivia-{split}.qa.csv'))
+        tsv(osp.join(root, 'nq', f'nq-{split}.qa.csv'))
+
+
+def humaneval(root, n):
+    rows = []
+    for i in range(n):
+        rows.append({
+            'task_id': f'Synth/{i}',
+            'prompt': f'def add{i}(a, b):\n    """Return a + b + {i}."""\n',
+            'entry_point': f'add{i}',
+            'canonical_solution': f'    return a + b + {i}\n',
+            'test': (f'def check(candidate):\n'
+                     f'    assert candidate(1, 2) == {3 + i}\n'),
+        })
+    _wjsonl(osp.join(root, 'humaneval', 'human-eval-v2.jsonl'), rows)
+
+
+GENERATORS = {
+    'mmlu': mmlu, 'gsm8k': gsm8k, 'math': math_ds, 'ceval': ceval,
+    'arc': arc, 'superglue': superglue, 'qa': qa, 'humaneval': humaneval,
+}
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument('--root', default='./data')
+    parser.add_argument('--rows', type=int, default=8,
+                        help='test rows per subset')
+    parser.add_argument('--only', nargs='*', choices=sorted(GENERATORS),
+                        help='subset of families (default: all)')
+    args = parser.parse_args()
+    for name in (args.only or sorted(GENERATORS)):
+        GENERATORS[name](args.root, args.rows)
+        print(f'wrote synthetic {name} under {args.root}')
+
+
+if __name__ == '__main__':
+    main()
