@@ -1,0 +1,17 @@
+"""Pythia-6.9B (GPT-NeoX family: partial rotary, parallel residual).
+
+Architecture resolves from the checkpoint's config.json; int8 weight-only
+decode fits the 6.9B on one 16 GB chip with batch headroom.
+"""
+from opencompass_tpu.models import JaxLM
+
+models = [
+    dict(type=JaxLM,
+         abbr='pythia-6.9b-jax',
+         path='./models/pythia-6.9b',
+         max_seq_len=2048,
+         batch_size=16,
+         max_out_len=100,
+         quantize='int8',
+         run_cfg=dict(num_devices=1)),
+]

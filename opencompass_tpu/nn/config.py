@@ -31,6 +31,9 @@ class TransformerConfig:
     norm: str = 'rmsnorm'             # rmsnorm | layernorm
     positional: str = 'rope'          # rope | learned | alibi
     rope_theta: float = 10000.0
+    # GPT-NeoX/pythia partial rotary: rotate only the first
+    # rotary_pct*head_dim dims, pass the rest through
+    rotary_pct: float = 1.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     qkv_bias: bool = False            # qwen2-style attention biases
@@ -129,6 +132,22 @@ class TransformerConfig:
             prefix_lm=True, **kw)
 
     @staticmethod
+    def gpt_neox(vocab_size=50304, hidden_size=2048, num_layers=24,
+                 num_heads=16, intermediate_size=8192, max_seq_len=2048,
+                 rotary_pct=0.25, parallel_residual=True, **kw):
+        """GPT-NeoX / Pythia family: LayerNorm, partial rotary, parallel
+        residual with separate mlp norm, biased plain MLP, untied head."""
+        return TransformerConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_heads, head_dim=hidden_size // num_heads,
+            intermediate_size=intermediate_size, max_seq_len=max_seq_len,
+            activation='gelu', norm='layernorm', positional='rope',
+            rotary_pct=rotary_pct, parallel_residual=parallel_residual,
+            qkv_bias=True, o_bias=True, mlp_bias=True, gated_mlp=False,
+            **kw)
+
+    @staticmethod
     def gpt2(vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12,
              intermediate_size=3072, max_seq_len=1024, **kw):
         return TransformerConfig(
@@ -222,6 +241,19 @@ class TransformerConfig:
                 num_heads=hf['num_attention_heads'],
                 intermediate_size=hf['ffn_dim'],
                 max_seq_len=hf.get('max_position_embeddings', 2048))
+        if mt == 'gpt_neox':
+            return TransformerConfig.gpt_neox(
+                vocab_size=hf['vocab_size'],
+                hidden_size=hf['hidden_size'],
+                num_layers=hf['num_hidden_layers'],
+                num_heads=hf['num_attention_heads'],
+                intermediate_size=hf['intermediate_size'],
+                max_seq_len=hf.get('max_position_embeddings', 2048),
+                rotary_pct=hf.get('rotary_pct', 0.25),
+                rope_theta=hf.get('rotary_emb_base', 10000.0),
+                parallel_residual=hf.get('use_parallel_residual', True),
+                norm_eps=hf.get('layer_norm_eps', 1e-5),
+                tie_embeddings=hf.get('tie_word_embeddings', False))
         if mt == 'gpt2':
             return TransformerConfig.gpt2(
                 vocab_size=hf['vocab_size'],

@@ -134,6 +134,42 @@ _GPT2_MAP = {
         (('layers', 'fc2', 'b'), False),
 }
 
+# GPT-NeoX / pythia: per-head fused QKV (same [q_h|k_h|v_h] interleave as
+# BLOOM), separate attn/mlp norms feeding a parallel residual, untied
+# embed_out head.
+_NEOX_MAP = {
+    r'(?:gpt_neox\.)?embed_in\.weight': (('embed',), False),
+    r'(?:gpt_neox\.)?final_layer_norm\.weight':
+        (('final_norm', 'scale'), False),
+    r'(?:gpt_neox\.)?final_layer_norm\.bias':
+        (('final_norm', 'bias'), False),
+    r'embed_out\.weight': (('lm_head',), True),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.input_layernorm\.weight':
+        (('layers', 'attn_norm', 'scale'), False),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.input_layernorm\.bias':
+        (('layers', 'attn_norm', 'bias'), False),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.post_attention_layernorm\.weight':
+        (('layers', 'mlp_norm', 'scale'), False),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.post_attention_layernorm\.bias':
+        (('layers', 'mlp_norm', 'bias'), False),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.attention\.query_key_value\.weight':
+        (('layers', '_qkv_bloom', 'w'), False),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.attention\.query_key_value\.bias':
+        (('layers', '_qkv_bloom', 'b'), False),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.attention\.dense\.weight':
+        (('layers', 'o', 'w'), True),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.attention\.dense\.bias':
+        (('layers', 'o', 'b'), False),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.mlp\.dense_h_to_4h\.weight':
+        (('layers', 'fc1', 'w'), True),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.mlp\.dense_h_to_4h\.bias':
+        (('layers', 'fc1', 'b'), False),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.mlp\.dense_4h_to_h\.weight':
+        (('layers', 'fc2', 'w'), True),
+    r'(?:gpt_neox\.)?layers\.(\d+)\.mlp\.dense_4h_to_h\.bias':
+        (('layers', 'fc2', 'b'), False),
+}
+
 # Baichuan = llama shape with fused W_pack (3*hidden, hidden).
 _BAICHUAN_MAP = dict(_LLAMA_MAP)
 _BAICHUAN_MAP[r'model\.layers\.(\d+)\.self_attn\.W_pack\.weight'] = (
@@ -226,6 +262,7 @@ _FAMILY_MAPS = {
     'internlm': _LLAMA_MAP, 'internlm2': _INTERNLM2_MAP,
     'baichuan': _BAICHUAN_MAP, 'falcon': _FALCON_MAP,
     'opt': _OPT_MAP, 'gpt2': _GPT2_MAP, 'bloom': _BLOOM_MAP,
+    'gpt_neox': _NEOX_MAP,
 }
 
 

@@ -198,16 +198,28 @@ def _alibi_bias(cfg: TransformerConfig, q_pos, kv_pos) -> jax.Array:
     return slopes[None, :, None, None] * rel[:, None, :, :]
 
 
-def _rope(x, positions, theta: float):
-    """HF-convention RoPE: rotate halves.  x: (B, T, H, hd)."""
+def _rope(x, positions, theta: float, rotary_pct: float = 1.0):
+    """HF-convention RoPE: rotate halves.  x: (B, T, H, hd).
+
+    ``rotary_pct`` < 1 (GPT-NeoX/pythia) rotates only the first
+    ``int(hd * rotary_pct)`` dims and passes the rest through unrotated.
+    """
     hd = x.shape[-1]
-    freqs = theta ** (-jnp.arange(0, hd // 2, dtype=jnp.float32) / (hd // 2))
-    angles = positions[..., None].astype(jnp.float32) * freqs  # (B, T, hd/2)
+    rot = int(hd * rotary_pct)
+    x_pass = None
+    if rot < hd:
+        x, x_pass = x[..., :rot], x[..., rot:]
+    freqs = theta ** (-jnp.arange(0, rot // 2, dtype=jnp.float32)
+                      / (rot // 2))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # (B,T,rot/2)
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
-    return out.astype(x.dtype)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1).astype(x.dtype)
+    if x_pass is not None:
+        out = jnp.concatenate([out, x_pass], axis=-1)
+    return out
 
 
 def _attention(q, k, v, mask, cfg: TransformerConfig, bias=None,
@@ -292,8 +304,8 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
     v = _shard(v, P('data', None, 'model', None))
 
     if cfg.positional == 'rope':
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rotary_pct)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rotary_pct)
 
     new_cache = None
     k_scale = v_scale = None

@@ -134,3 +134,25 @@ def test_qwen2_parity(tmp_path):
         max_position_embeddings=128, tie_word_embeddings=False,
         attn_implementation='eager')
     _compare(tmp_path, _make(transformers.Qwen2ForCausalLM, cfg), 128)
+
+
+@pytest.mark.slow
+def test_gpt_neox_partial_rotary_parity(tmp_path):
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=128, rotary_pct=0.25,
+        use_parallel_residual=True, tie_word_embeddings=False,
+        attn_implementation='eager')
+    _compare(tmp_path, _make(transformers.GPTNeoXForCausalLM, cfg), 128)
+
+
+@pytest.mark.slow
+def test_gpt_neox_sequential_residual_parity(tmp_path):
+    cfg = transformers.GPTNeoXConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=128, rotary_pct=1.0,
+        use_parallel_residual=False, tie_word_embeddings=False,
+        attn_implementation='eager')
+    _compare(tmp_path, _make(transformers.GPTNeoXForCausalLM, cfg), 128)

@@ -130,7 +130,8 @@ def superglue(root, n):
     _wjsonl(osp.join(sg, 'WSC', 'val.jsonl'),
             [{'text': f'The trophy did not fit in case {i} because it was '
                       'too big.',
-              'target': {'span1_text': 'trophy', 'span2_text': 'it'},
+              'target': {'span1_text': 'trophy', 'span1_index': 1,
+                         'span2_text': 'it', 'span2_index': 9},
               'label': 'true'} for i in range(n)])
     _wjsonl(osp.join(sg, 'WiC', 'val.jsonl'),
             [{'word': 'bank', 'sentence1': f'river bank {i}',
