@@ -34,6 +34,10 @@ class TransformerConfig:
     # GPT-NeoX/pythia partial rotary: rotate only the first
     # rotary_pct*head_dim dims, pass the rest through
     rotary_pct: float = 1.0
+    # gemma: rmsnorm weights are zero-centered (effective scale = 1 + w)
+    # and input embeddings are multiplied by sqrt(hidden)
+    norm_offset: float = 0.0
+    embed_scale: float = 0.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     qkv_bias: bool = False            # qwen2-style attention biases
@@ -130,6 +134,23 @@ class TransformerConfig:
             activation='gelu', norm='layernorm', positional='rope',
             gated_mlp=True, qkv_bias=True, o_bias=True, mlp_bias=True,
             prefix_lm=True, **kw)
+
+    @staticmethod
+    def gemma(vocab_size=256000, hidden_size=3072, num_layers=28,
+              num_heads=16, num_kv_heads=16, head_dim=256,
+              intermediate_size=24576, max_seq_len=8192, **kw):
+        """Gemma family: llama-shaped modules with zero-centered RMSNorm
+        scales, sqrt(hidden) embedding scaling, tanh-GeLU gated MLP, tied
+        embeddings, and head_dim decoupled from hidden/num_heads."""
+        import math
+        return TransformerConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_kv_heads, head_dim=head_dim,
+            intermediate_size=intermediate_size, max_seq_len=max_seq_len,
+            activation='gelu_tanh', norm='rmsnorm', positional='rope',
+            norm_offset=1.0, embed_scale=math.sqrt(hidden_size),
+            tie_embeddings=True, **kw)
 
     @staticmethod
     def gpt_neox(vocab_size=50304, hidden_size=2048, num_layers=24,
@@ -241,6 +262,42 @@ class TransformerConfig:
                 num_heads=hf['num_attention_heads'],
                 intermediate_size=hf['ffn_dim'],
                 max_seq_len=hf.get('max_position_embeddings', 2048))
+        if mt == 'phi3':
+            max_seq = hf.get('max_position_embeddings', 4096)
+            if hf.get('rope_scaling'):
+                # longrope >4k scaling is not implemented: clamp to the
+                # window where plain RoPE matches the torch reference
+                max_seq = hf.get('original_max_position_embeddings', 4096)
+            window = hf.get('sliding_window')
+            if window:
+                # HF masks keys beyond the sliding window; this stack
+                # attends fully — identical up to the window, so cap there
+                max_seq = min(max_seq, window)
+            return TransformerConfig.llama(
+                vocab_size=hf['vocab_size'],
+                hidden_size=hf['hidden_size'],
+                num_layers=hf['num_hidden_layers'],
+                num_heads=hf['num_attention_heads'],
+                num_kv_heads=(hf.get('num_key_value_heads')
+                              or hf['num_attention_heads']),
+                intermediate_size=hf['intermediate_size'],
+                max_seq_len=max_seq,
+                rope_theta=hf.get('rope_theta', 10000.0),
+                norm_eps=hf.get('rms_norm_eps', 1e-5),
+                tie_embeddings=hf.get('tie_word_embeddings', False))
+        if mt == 'gemma':
+            return TransformerConfig.gemma(
+                vocab_size=hf['vocab_size'],
+                hidden_size=hf['hidden_size'],
+                num_layers=hf['num_hidden_layers'],
+                num_heads=hf['num_attention_heads'],
+                num_kv_heads=(hf.get('num_key_value_heads')
+                              or hf['num_attention_heads']),
+                head_dim=hf.get('head_dim', 256),
+                intermediate_size=hf['intermediate_size'],
+                max_seq_len=hf.get('max_position_embeddings', 8192),
+                rope_theta=hf.get('rope_theta', 10000.0),
+                norm_eps=hf.get('rms_norm_eps', 1e-6))
         if mt == 'gpt_neox':
             return TransformerConfig.gpt_neox(
                 vocab_size=hf['vocab_size'],

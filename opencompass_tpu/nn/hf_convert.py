@@ -170,6 +170,29 @@ _NEOX_MAP = {
         (('layers', 'fc2', 'b'), False),
 }
 
+# Phi-3: llama-shaped with fused qkv_proj ([q|k|v] by q/kv sizes) and
+# fused gate_up_proj ([gate|up] halves).  The longrope >4k position
+# scaling is not implemented; contexts up to the base 4k window match.
+_PHI3_MAP = {
+    r'model\.embed_tokens\.weight': (('embed',), False),
+    r'model\.norm\.weight': (('final_norm', 'scale'), False),
+    r'lm_head\.weight': (('lm_head',), True),
+    r'model\.layers\.(\d+)\.input_layernorm\.weight':
+        (('layers', 'attn_norm', 'scale'), False),
+    r'model\.layers\.(\d+)\.post_attention_layernorm\.weight':
+        (('layers', 'mlp_norm', 'scale'), False),
+    # [q_dim | kv | kv] concatenation — the same split as falcon's
+    # query_key_value, so it reuses the _qkv_mqa branch
+    r'model\.layers\.(\d+)\.self_attn\.qkv_proj\.weight':
+        (('layers', '_qkv_mqa', 'w'), True),
+    r'model\.layers\.(\d+)\.self_attn\.o_proj\.weight':
+        (('layers', 'o', 'w'), True),
+    r'model\.layers\.(\d+)\.mlp\.gate_up_proj\.weight':
+        (('layers', '_gate_up', 'w'), True),
+    r'model\.layers\.(\d+)\.mlp\.down_proj\.weight':
+        (('layers', 'down', 'w'), True),
+}
+
 # Baichuan = llama shape with fused W_pack (3*hidden, hidden).
 _BAICHUAN_MAP = dict(_LLAMA_MAP)
 _BAICHUAN_MAP[r'model\.layers\.(\d+)\.self_attn\.W_pack\.weight'] = (
@@ -259,6 +282,8 @@ _INTERNLM2_MAP = {
 
 _FAMILY_MAPS = {
     'llama': _LLAMA_MAP, 'mistral': _LLAMA_MAP, 'qwen2': _LLAMA_MAP,
+    'gemma': _LLAMA_MAP,  # same module names; arch switches via config
+    'phi3': _PHI3_MAP,
     'internlm': _LLAMA_MAP, 'internlm2': _INTERNLM2_MAP,
     'baichuan': _BAICHUAN_MAP, 'falcon': _FALCON_MAP,
     'opt': _OPT_MAP, 'gpt2': _GPT2_MAP, 'bloom': _BLOOM_MAP,
@@ -342,6 +367,12 @@ def _split_fused_qkv(layers: Dict, cfg: TransformerConfig):
         layers['q'] = {'w': _nt(w[:, :, :q_dim])}
         layers['k'] = {'w': _nt(w[:, :, q_dim:q_dim + K * hd])}
         layers['v'] = {'w': _nt(w[:, :, q_dim + K * hd:])}
+    if '_gate_up' in layers:
+        # [gate | up] halves (Phi-3 gate_up_proj), (L, in, 2F)
+        w = layers.pop('_gate_up')['w']
+        F = w.shape[-1] // 2
+        layers['gate'] = {'w': np.ascontiguousarray(w[:, :, :F])}
+        layers['up'] = {'w': np.ascontiguousarray(w[:, :, F:])}
     if '_wqkv_grouped' in layers:
         w = layers.pop('_wqkv_grouped')['w']  # (L, D, K*(ratio+2)*hd)
         L = w.shape[0]

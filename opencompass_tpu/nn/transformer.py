@@ -121,7 +121,9 @@ def _norm(x, p, cfg: TransformerConfig):
     if cfg.norm == 'rmsnorm':
         x32 = x32 * jax.lax.rsqrt(
             jnp.mean(jnp.square(x32), axis=-1, keepdims=True) + cfg.norm_eps)
-        return (x32 * p['scale'].astype(jnp.float32)).astype(x.dtype)
+        # gemma stores zero-centered scales: effective weight = offset + w
+        scale = p['scale'].astype(jnp.float32) + cfg.norm_offset
+        return (x32 * scale).astype(x.dtype)
     mean = jnp.mean(x32, axis=-1, keepdims=True)
     var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
     x32 = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
@@ -134,7 +136,7 @@ def _act(x, kind: str):
         return jax.nn.silu(x)
     if kind == 'gelu':
         return jax.nn.gelu(x, approximate=False)
-    if kind == 'gelu_new':
+    if kind in ('gelu_new', 'gelu_tanh'):
         return jax.nn.gelu(x, approximate=True)
     if kind == 'relu':
         return jax.nn.relu(x)
@@ -442,6 +444,10 @@ def slot_positions(pad_mask, total: int) -> jax.Array:
 
 def _embed(params, cfg: TransformerConfig, tokens, positions):
     x = params['embed'][tokens].astype(cfg.jnp_dtype)
+    if cfg.embed_scale:
+        # gemma multiplies embeddings by sqrt(hidden) on input only (the
+        # tied lm_head reads the unscaled table)
+        x = x * jnp.asarray(cfg.embed_scale, cfg.jnp_dtype)
     if cfg.positional == 'learned':
         pos = jnp.clip(positions + cfg.pos_offset, 0,
                        params['pos_embed'].shape[0] - 1)

@@ -156,3 +156,23 @@ def test_gpt_neox_sequential_residual_parity(tmp_path):
         use_parallel_residual=False, tie_word_embeddings=False,
         attn_implementation='eager')
     _compare(tmp_path, _make(transformers.GPTNeoXForCausalLM, cfg), 128)
+
+
+@pytest.mark.slow
+def test_gemma_parity(tmp_path):
+    cfg = transformers.GemmaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=32, max_position_embeddings=128,
+        attn_implementation='eager')
+    _compare(tmp_path, _make(transformers.GemmaForCausalLM, cfg), 128)
+
+
+@pytest.mark.slow
+def test_phi3_fused_proj_parity(tmp_path):
+    cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, tie_word_embeddings=False,
+        pad_token_id=0, attn_implementation='eager')
+    _compare(tmp_path, _make(transformers.Phi3ForCausalLM, cfg), 128)
