@@ -273,6 +273,13 @@ class TransformerConfig:
                 # HF masks keys beyond the sliding window; this stack
                 # attends fully — identical up to the window, so cap there
                 max_seq = min(max_seq, window)
+            if max_seq < hf.get('max_position_embeddings', 4096):
+                from opencompass_tpu.utils.logging import get_logger
+                get_logger().warning(
+                    f'phi3: clamping max_seq_len to {max_seq} '
+                    '(longrope scaling / sliding-window attention beyond '
+                    'it are not implemented; longer inputs would be '
+                    'silently truncated)')
             return TransformerConfig.llama(
                 vocab_size=hf['vocab_size'],
                 hidden_size=hf['hidden_size'],

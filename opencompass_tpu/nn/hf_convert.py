@@ -472,9 +472,11 @@ def _ckpt_fingerprint(path: str, cfg: Optional[TransformerConfig]) -> str:
         except Exception:
             pass
     if cfg is not None:
+        # normalize out everything that only affects forward-time math,
+        # not the stored pytree bytes
         structural = dataclasses.asdict(dataclasses.replace(
             cfg, kv_quant=False, remat=False, scan_layers=True,
-            max_seq_len=0))
+            max_seq_len=0, norm_offset=0.0, embed_scale=0.0))
         cfg_key = json.dumps(structural, sort_keys=True)
     else:
         cfg_key = 'auto'

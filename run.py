@@ -37,6 +37,11 @@ def parse_args():
                         action='store_true',
                         default=False,
                         help='submit tasks via slurm')
+    parser.add_argument('--dlc',
+                        action='store_true',
+                        default=False,
+                        help='submit tasks via Aliyun DLC (uses the '
+                        "config's `aliyun_cfg` dict)")
     parser.add_argument('-p', '--partition', help='slurm partition')
     parser.add_argument('-q', '--quotatype', help='slurm quota type')
     parser.add_argument('--debug',
@@ -100,39 +105,39 @@ def get_config_from_arg(args) -> Config:
     return cfg
 
 
-def exec_infer_runner(tasks, args, cfg):
+def _build_runner(task_type, args, cfg):
+    if args.slurm and args.dlc:
+        raise SystemExit('--slurm and --dlc are mutually exclusive')
     if args.slurm:
-        runner = SlurmRunner(dict(type='OpenICLInferTask'),
-                             max_num_workers=args.max_num_workers,
-                             partition=args.partition,
-                             quotatype=args.quotatype,
-                             retry=args.retry,
-                             debug=args.debug,
-                             lark_bot_url=cfg.get('lark_bot_url'))
-    else:
-        runner = LocalRunner(dict(type='OpenICLInferTask'),
-                             max_num_workers=args.max_num_workers,
-                             num_devices=args.num_devices,
-                             debug=args.debug,
-                             lark_bot_url=cfg.get('lark_bot_url'))
+        return SlurmRunner(dict(type=task_type),
+                           max_num_workers=args.max_num_workers,
+                           partition=args.partition,
+                           quotatype=args.quotatype,
+                           retry=args.retry,
+                           debug=args.debug,
+                           lark_bot_url=cfg.get('lark_bot_url'))
+    if args.dlc:
+        from opencompass_tpu.runners import DLCRunner
+        return DLCRunner(dict(type=task_type),
+                         aliyun_cfg=cfg.get('aliyun_cfg'),
+                         max_num_workers=args.max_num_workers,
+                         retry=args.retry,
+                         debug=args.debug,
+                         lark_bot_url=cfg.get('lark_bot_url'))
+    return LocalRunner(dict(type=task_type),
+                       max_num_workers=args.max_num_workers,
+                       num_devices=args.num_devices,
+                       debug=args.debug,
+                       lark_bot_url=cfg.get('lark_bot_url'))
+
+
+def exec_infer_runner(tasks, args, cfg):
+    runner = _build_runner('OpenICLInferTask', args, cfg)
     runner(tasks)
 
 
 def exec_eval_runner(tasks, args, cfg):
-    if args.slurm:
-        runner = SlurmRunner(dict(type='OpenICLEvalTask'),
-                             max_num_workers=args.max_num_workers,
-                             partition=args.partition,
-                             quotatype=args.quotatype,
-                             retry=args.retry,
-                             debug=args.debug,
-                             lark_bot_url=cfg.get('lark_bot_url'))
-    else:
-        runner = LocalRunner(dict(type='OpenICLEvalTask'),
-                             max_num_workers=args.max_num_workers,
-                             num_devices=args.num_devices,
-                             debug=args.debug,
-                             lark_bot_url=cfg.get('lark_bot_url'))
+    runner = _build_runner('OpenICLEvalTask', args, cfg)
     runner(tasks)
 
 
