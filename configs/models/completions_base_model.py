@@ -1,0 +1,20 @@
+"""A base model served over an OpenAI-compatible /v1/completions endpoint
+(vLLM, llama.cpp server, TGI with the openai shim, ...).  Supports BOTH
+eval modes: generation and PPL ranking via echoed prompt logprobs.
+
+Point `url` at your server and `path` at its model name.
+"""
+from opencompass_tpu.models import CompletionsAPI
+
+models = [
+    dict(type=CompletionsAPI,
+         abbr='served-base-model',
+         path='my-base-model',
+         url='http://localhost:8000/v1/completions',
+         key='',
+         query_per_second=4,
+         max_out_len=512,
+         max_seq_len=2048,
+         batch_size=8,
+         run_cfg=dict(num_devices=0)),
+]

@@ -1,0 +1,21 @@
+"""GPT-4 through the OpenAI-compatible chat API (generation-mode datasets
+only; API chat endpoints cannot score PPL)."""
+from opencompass_tpu.models import OpenAI
+
+api_meta_template = dict(round=[
+    dict(role='HUMAN', api_role='HUMAN'),
+    dict(role='BOT', api_role='BOT', generate=True),
+])
+
+models = [
+    dict(type=OpenAI,
+         abbr='gpt-4',
+         path='gpt-4',
+         key='ENV',  # reads OPENAI_API_KEY
+         meta_template=api_meta_template,
+         query_per_second=1,
+         max_out_len=2048,
+         max_seq_len=2048,
+         batch_size=8,
+         run_cfg=dict(num_devices=0)),
+]

@@ -4,10 +4,11 @@ from .completions_api import CompletionsAPI  # noqa
 from .fake import FakeModel  # noqa
 from .glm import GLM130B  # noqa
 from .jax_lm import JaxLM  # noqa
+from .openai_api import OpenAI  # noqa
 from .tokenizer import ByteTokenizer, load_tokenizer  # noqa
 
 __all__ = [
     'BaseModel', 'LMTemplateParser', 'APITemplateParser', 'BaseAPIModel',
     'CompletionsAPI', 'TokenBucket', 'FakeModel', 'GLM130B', 'JaxLM',
-    'ByteTokenizer', 'load_tokenizer'
+    'OpenAI', 'ByteTokenizer', 'load_tokenizer'
 ]

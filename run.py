@@ -33,15 +33,16 @@ def parse_args():
     parser = argparse.ArgumentParser(
         description='Run an evaluation from a config file')
     parser.add_argument('config', help='train config file path')
-    parser.add_argument('--slurm',
-                        action='store_true',
-                        default=False,
-                        help='submit tasks via slurm')
-    parser.add_argument('--dlc',
-                        action='store_true',
-                        default=False,
-                        help='submit tasks via Aliyun DLC (uses the '
-                        "config's `aliyun_cfg` dict)")
+    launcher = parser.add_mutually_exclusive_group()
+    launcher.add_argument('--slurm',
+                          action='store_true',
+                          default=False,
+                          help='submit tasks via slurm')
+    launcher.add_argument('--dlc',
+                          action='store_true',
+                          default=False,
+                          help='submit tasks via Aliyun DLC (uses the '
+                          "config's `aliyun_cfg` dict)")
     parser.add_argument('-p', '--partition', help='slurm partition')
     parser.add_argument('-q', '--quotatype', help='slurm quota type')
     parser.add_argument('--debug',
@@ -106,8 +107,6 @@ def get_config_from_arg(args) -> Config:
 
 
 def _build_runner(task_type, args, cfg):
-    if args.slurm and args.dlc:
-        raise SystemExit('--slurm and --dlc are mutually exclusive')
     if args.slurm:
         return SlurmRunner(dict(type=task_type),
                            max_num_workers=args.max_num_workers,

@@ -82,13 +82,17 @@ MODEL_CONFIGS = sorted(
 def test_model_config_architecture_consistent(path):
     """Every model config must resolve to a coherent architecture even
     without checkpoint assets (random-init benchmarking/dryruns)."""
+    from opencompass_tpu.registry import MODELS
     from opencompass_tpu.utils.build import build_model_from_cfg
     cfg = Config.fromfile(path)
     for model_cfg in cfg['models']:
         m = dict(model_cfg)
-        m['tokenizer_only'] = True  # no weights needed for this check
+        cls = m['type'] if not isinstance(m['type'], str) \
+            else MODELS.get(m['type'])
+        if not getattr(cls, 'is_api', False):
+            m['tokenizer_only'] = True  # no weights needed for this check
         model = build_model_from_cfg(m)
-        arch = model.cfg
+        arch = getattr(model, 'cfg', None)
         if arch is None:  # API/fake models carry no architecture
             continue
         assert arch.q_dim == arch.num_heads * arch.head_dim

@@ -225,3 +225,20 @@ def test_choice_truncates_overlong_context():
     long_input = 'word ' * 500
     out = m.choice([long_input], [' yes', ' no'])
     assert out[0] in (' yes', ' no')
+
+
+def test_glm130b_wrapper_tensor_parallel_scoring():
+    """The GLM130B wrapper builds on a model-parallel mesh (tiny geometry
+    override) and scores through the prefix-LM path."""
+    if len(jax.devices()) < 2:
+        pytest.skip('needs multi-device mesh')
+    from opencompass_tpu.models import GLM130B
+    lm = GLM130B(config=dict(vocab_size=512, hidden_size=64, num_layers=2,
+                             num_heads=4, intermediate_size=128),
+                 parallel=dict(data=1, model=2, seq=1),
+                 max_seq_len=128, dtype='float32')
+    assert lm.cfg.prefix_lm
+    nll = lm.get_ppl(['bidirectional context test'], mask_length=[2])
+    assert np.isfinite(nll[0])
+    out = lm.choice(['pick one:'], [' A', ' B'])
+    assert out[0] in (' A', ' B')
