@@ -68,8 +68,19 @@ def analyze(model_cfg, dataset_cfg, work_dir, out_dir):
                 _norm(eval_cfg, gold, 'dataset_postprocessor'):
             continue
         n_bad += 1
+        prompt = rec.get('origin_prompt', '')
+        if not prompt:
+            # PPL-mode records keep per-label {'label: X': {prompt, PPL}}
+            # entries instead of one origin_prompt — show each candidate
+            # with its score so the ranking mistake is inspectable
+            labels = {k[len('label: '):]: v for k, v in rec.items()
+                      if k.startswith('label: ') and isinstance(v, dict)}
+            if labels:
+                prompt = '\n\n'.join(
+                    f"[{lab}] PPL={v.get('PPL'):.4f}\n{v.get('prompt', '')}"
+                    for lab, v in labels.items())
         lines += [f'## case {i}', '### prompt', '```',
-                  str(rec.get('origin_prompt', ''))[:2000], '```',
+                  str(prompt)[:2000], '```',
                   f'### prediction\n`{pred}`', f'### gold\n`{gold}`', '']
     os.makedirs(out_dir, exist_ok=True)
     report = osp.join(out_dir, f'{m_abbr}_{d_abbr}.md')

@@ -37,6 +37,13 @@ def main():
         abbr = model_abbr_from_cfg(model_cfg)
         print(f'=== {abbr} ===')
         model = build_model_from_cfg(model_cfg)
+        try:
+            ppl = model.get_ppl(['The capital of France is Paris.'])
+            print(f'get_ppl probe: {ppl}')
+        except NotImplementedError:
+            print('get_ppl: not supported by this endpoint (chat API)')
+        except Exception as exc:  # dead endpoint: keep probing templates
+            print(f'get_ppl probe failed: {exc}')
         for probe in PROBES[:args.n]:
             parsed = model.parse_template(probe, mode='gen')
             print(f'--- parsed prompt ---\n{parsed}')
