@@ -1,0 +1,62 @@
+"""The driver's multi-chip dry run must never trust the caller's devices.
+
+Round-2 postmortem: `jax.devices()` on the axon pool reported >= 8 TPU
+endpoints, the dry run took the in-process path, and compilation died on a
+libtpu version skew — turning the driver's only multi-chip signal red.
+These tests pin the routing contract of `__graft_entry__.dryrun_multichip`:
+in-process execution only in a provably CPU-pinned environment, subprocess
+fallback everywhere else (including when the in-process attempt throws).
+"""
+import os
+
+import pytest
+
+import __graft_entry__ as ge
+
+
+@pytest.fixture
+def routing(monkeypatch):
+    """Record which implementation dryrun_multichip routes to."""
+    calls = []
+    monkeypatch.setattr(ge, '_dryrun_impl', lambda n: calls.append(('impl', n)))
+    monkeypatch.setattr(ge, '_reexec_dryrun',
+                        lambda n: calls.append(('reexec', n)))
+    return calls
+
+
+def test_axon_env_routes_to_subprocess(monkeypatch, routing):
+    monkeypatch.setenv('PALLAS_AXON_POOL_IPS', '10.0.0.1')
+    ge.dryrun_multichip(8)
+    assert routing == [('reexec', 8)]
+
+
+def test_unpinned_platform_routes_to_subprocess(monkeypatch, routing):
+    monkeypatch.delenv('JAX_PLATFORMS', raising=False)
+    ge.dryrun_multichip(8)
+    assert routing == [('reexec', 8)]
+
+
+def test_pinned_cpu_env_runs_in_process(monkeypatch, routing):
+    # conftest pins JAX_PLATFORMS=cpu with 8 virtual devices
+    assert os.environ.get('JAX_PLATFORMS') == 'cpu'
+    ge.dryrun_multichip(8)
+    assert routing == [('impl', 8)]
+
+
+def test_in_process_failure_falls_back_to_subprocess(monkeypatch):
+    calls = []
+
+    def boom(n):
+        calls.append(('impl', n))
+        raise RuntimeError('synthetic compile failure')
+
+    monkeypatch.setattr(ge, '_dryrun_impl', boom)
+    monkeypatch.setattr(ge, '_reexec_dryrun',
+                        lambda n: calls.append(('reexec', n)))
+    ge.dryrun_multichip(8)
+    assert calls == [('impl', 8), ('reexec', 8)]
+
+
+def test_dryrun_executes_on_virtual_mesh():
+    """End-to-end: the real impl compiles and runs on the 8-device CPU mesh."""
+    ge.dryrun_multichip(8)
