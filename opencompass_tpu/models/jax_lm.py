@@ -114,14 +114,27 @@ class JaxLM(BaseModel):
         self._ids_cache_max = 8192
         self._len_cache_max = 1_000_000
         self._gen_fn_cache: Dict[tuple, object] = {}
-        if quantize not in (None, 'int8', 'int8-kv'):
+        # quantize modes compose 'base[-kvN]': base 'int8' (weight-only)
+        # or 'w8a8' (int8 weights + dynamic per-token int8 activations on
+        # the MXU); '-kv'/'-kv8' adds an int8 decode KV cache, '-kv4'
+        # an int4 one.  'w8a8-kv4' is the serving/throughput recipe.
+        base, dash, kv = (quantize or '').partition('-')
+        if quantize is not None and (
+                base not in ('int8', 'w8a8') or
+                (dash and kv not in ('kv', 'kv8', 'kv4'))):
             raise ValueError(f'unsupported quantize={quantize!r} '
-                             "('int8' = weight-only, 'int8-kv' = weights "
-                             '+ decode KV cache)')
+                             "(want 'int8'|'w8a8' optionally + "
+                             "'-kv8'|'-kv4', e.g. 'w8a8-kv4')")
         self.quantize = quantize
-        if quantize == 'int8-kv' and self.cfg is not None:
+        if quantize and self.cfg is not None:
             import dataclasses
-            self.cfg = dataclasses.replace(self.cfg, kv_quant=True)
+            updates = {}
+            if kv:
+                updates['kv_quant'] = 'int4' if kv == 'kv4' else 'int8'
+            if base == 'w8a8':
+                updates['act_quant'] = True
+            if updates:
+                self.cfg = dataclasses.replace(self.cfg, **updates)
         self.convert_cache = convert_cache
         self.mesh = None
         self.params = None
@@ -172,7 +185,7 @@ class JaxLM(BaseModel):
             self.cfg, self.params = convert_checkpoint_cached(
                 path, self.cfg, cache_dir=self.convert_cache)
             logger.info(f'loaded checkpoint from {path}')
-            if self.quantize in ('int8', 'int8-kv'):
+            if self.quantize:
                 # host-side: only the int8 tensors ever reach a chip
                 from opencompass_tpu.nn.quant import quantize_params
                 self.params = quantize_params(self.params, self.cfg)
@@ -185,7 +198,7 @@ class JaxLM(BaseModel):
             # *local* device — jax.devices()[0] may belong to rank 0.)
             with jax.default_device(jax.local_devices(backend='cpu')[0]):
                 self.params = init_params(self.cfg, jax.random.PRNGKey(seed))
-            if self.quantize in ('int8', 'int8-kv'):
+            if self.quantize:
                 from opencompass_tpu.nn.quant import quantize_params
                 self.params = jax.tree_util.tree_map(np.asarray,
                                                      self.params)
@@ -194,7 +207,7 @@ class JaxLM(BaseModel):
             if path:
                 logger.warning(f'no weights under {path!r}; random init '
                                f'(seed={seed})')
-            if self.quantize in ('int8', 'int8-kv'):
+            if self.quantize:
                 # ONE fused program: the bf16 weights are scheduler temps
                 # freed as each int8 consumer runs, so init+quantize of a
                 # near-HBM-sized model fits without fragmentation (a

@@ -53,10 +53,16 @@ class TransformerConfig:
     # tokens causally (scoring passes the context extent via mask_length;
     # generation treats the whole prompt as context)
     prefix_lm: bool = False
-    # int8 KV cache with per-vector scales (decode path only — scoring
-    # builds no cache and is numerically unaffected); halves the
-    # cache-read bytes that dominate large-batch decode attention
-    kv_quant: bool = False
+    # Quantized KV cache with per-vector scales (decode path only — scoring
+    # builds no cache and is numerically unaffected): False, 'int8' (True is
+    # accepted as 'int8'), or 'int4'.  Cache reads dominate large-batch
+    # decode attention, so halving/quartering those bytes is the main
+    # batch-scaling lever.
+    kv_quant: object = False
+    # Dynamic per-token int8 activation quantization for the quantized
+    # matmuls (W8A8): the MXU consumes int8 x int8 natively, so prefill
+    # and scoring matmuls run at the int8 rate instead of bf16.
+    act_quant: bool = False
     dtype: str = 'bfloat16'           # parameter/compute dtype
     # scan-over-layers keeps compile time O(1) in depth; turn off to inspect
     # per-layer arrays by name.
@@ -66,6 +72,17 @@ class TransformerConfig:
     @property
     def jnp_dtype(self):
         return jnp.dtype(self.dtype)
+
+    @property
+    def kv_quant_mode(self):
+        """None | 'int8' | 'int4' (True normalizes to 'int8')."""
+        if not self.kv_quant:
+            return None
+        mode = 'int8' if self.kv_quant is True else str(self.kv_quant)
+        if mode not in ('int8', 'int4'):
+            raise ValueError(f'kv_quant must be False/True/"int8"/"int4", '
+                             f'got {self.kv_quant!r}')
+        return mode
 
     @property
     def q_dim(self) -> int:

@@ -1,16 +1,25 @@
-"""Weight-only int8 quantization for the decode-bound eval path.
+"""Weight-only quantization for the decode-bound eval path.
 
 Decode reads every weight byte once per generated token, so on a v5e the
-per-step floor is weight-bytes / HBM bandwidth (measured ~75% of peak on
-the matmul stream).  Storing the transformer matmul weights as int8 with a
-per-output-channel bf16 scale halves those bytes; the MXU consumes the
-int8 operand through an on-the-fly convert fused into the matmul, and the
-product is rescaled after the contraction (valid because the scale is
-constant along the contraction axis).
+per-step floor is weight-bytes / HBM bandwidth (measured ~600 GB/s on the
+matmul stream).  Storing the transformer matmul weights as int8 (or int4)
+with a per-output-channel bf16 scale halves (quarters) those bytes; the
+MXU consumes the quantized operand through an on-the-fly convert fused
+into the matmul, and the product is rescaled after the contraction (valid
+because the scale is constant along the contraction axis).
 
 Quality: symmetric per-channel weight-only int8 is the standard inference
-recipe — embeddings, lm_head, norms, and biases stay in bf16, activations
-are never quantized.  Opt in via ``JaxLM(..., quantize='int8')``.
+recipe — embeddings, lm_head, norms, and biases stay in bf16.  int4 is
+the aggressive storage tier (GPTQ/AWQ-class width; this implementation
+keeps per-channel scales).  Activations are quantized only when
+``cfg.act_quant`` is on (W8A8: dynamic per-token int8, int8 x int8 on the
+MXU — see transformer._dyn_act_quant).
+
+JaxLM exposes the int8 tiers (``quantize='int8'|'w8a8'`` plus
+``-kv8``/``-kv4`` cache suffixes).  ``mode='int4'`` weights work at this
+API level (useful on backends whose runtime accepts int4 jit arguments —
+CPU does) but are not a JaxLM mode: the current TPU plugin cannot pass
+int4 arrays across the jit boundary, and model parameters cross it.
 """
 from __future__ import annotations
 
@@ -24,35 +33,47 @@ import numpy as np
 _NT_KEYS = ('q', 'k', 'v')
 _IN_OUT_KEYS = ('o', 'gate', 'up', 'down', 'fc1', 'fc2')
 
+_QMAX = {'int8': 127.0, 'int4': 7.0}
 
-def _quantize_math(w, axis: int, xp):
+
+def _quantize_math(w, axis: int, xp, mode: str, store_dtype=None):
+    qmax = _QMAX[mode]
+    if store_dtype is None:
+        store_dtype = jnp.int4 if mode == 'int4' else xp.int8
     amax = xp.max(xp.abs(w.astype(xp.float32)), axis=axis, keepdims=True)
-    scale = xp.maximum(amax / 127.0, 1e-12)
-    wq = xp.clip(xp.round(w.astype(xp.float32) / scale), -127,
-                 127).astype(xp.int8)
+    scale = xp.maximum(amax / qmax, 1e-12)
+    wq = xp.clip(xp.round(w.astype(xp.float32) / scale), -qmax, qmax)
+    wq = wq.astype(store_dtype)
     return wq, xp.squeeze(scale, axis=axis).astype(xp.float32)
 
 
-def _quantize_weight(w, axis: int):
-    """Symmetric int8 over `axis` (the contraction axis); returns (wq, s)
-    with s shaped like w minus that axis.
+def _quantize_weight(w, axis: int, mode: str):
+    """Symmetric quantization over `axis` (the contraction axis); returns
+    (wq, s) with s shaped like w minus that axis.
 
     Host numpy arrays stay on the host (checkpoint params are quantized
-    before sharding so the full model never has to fit one chip).  Device
-    arrays go through a per-leaf jit; for near-HBM-sized models prefer
-    tracing quantize_params together with the initializer in ONE program
-    (see models/jax_lm.py) so the full-precision weights only ever exist
-    as scheduler temps.
+    before sharding so the full model never has to fit one chip; int4
+    leaves stay int8-valued on the host and narrow on device transfer).
+    Device arrays go through a per-leaf jit; for near-HBM-sized models
+    prefer tracing quantize_params together with the initializer in ONE
+    program (see models/jax_lm.py) so the full-precision weights only
+    ever exist as scheduler temps.
     """
     import jax
     if isinstance(w, jax.core.Tracer) or not isinstance(w, jax.Array):
         xp = jnp if isinstance(w, jax.core.Tracer) else np
-        return _quantize_math(w, axis, xp)
-    return jax.jit(functools.partial(_quantize_math, axis=axis, xp=jnp))(w)
+        # numpy has no int4: host copies of int4-mode weights stay
+        # int8-valued (already clipped to +-7, so a later on-device
+        # astype(int4) inside the loading jit is lossless)
+        store = np.int8 if xp is np else None
+        return _quantize_math(w, axis, xp, mode, store_dtype=store)
+    return jax.jit(functools.partial(_quantize_math, axis=axis, xp=jnp,
+                                     mode=mode))(w)
 
 
-def quantize_params(params, cfg):
-    """Return a copy of `params` with layer matmul weights int8-quantized.
+def quantize_params(params, cfg, mode: str = 'int8'):
+    """Return a copy of `params` with layer matmul weights quantized to
+    ``mode`` ('int8' or 'int4').
 
     Works on host numpy or device arrays (and traces cleanly under jit);
     leaves everything except the layer matmul 'w' entries untouched.
@@ -60,16 +81,20 @@ def quantize_params(params, cfg):
     the contraction axis is counted from the trailing end so a leading
     layer dim never shifts it.
     """
+    if mode not in _QMAX:
+        raise ValueError(f'unknown quantization mode {mode!r}')
+
     def quantize_layer(layer):
         out = {}
         for name, p in layer.items():
             if isinstance(p, dict) and 'w' in p and np.ndim(p['w']) >= 2:
-                if getattr(p['w'], 'dtype', None) == jnp.int8:
+                if getattr(p['w'], 'dtype', None) in (
+                        jnp.dtype(jnp.int8), jnp.dtype(jnp.int4)):
                     out[name] = p  # already quantized: keep its scales
                     continue
                 axis = -1 if name in _NT_KEYS else -2
                 if name in _NT_KEYS or name in _IN_OUT_KEYS:
-                    wq, s = _quantize_weight(p['w'], axis)
+                    wq, s = _quantize_weight(p['w'], axis, mode)
                     q = dict(p, w=wq, s=s.astype(jnp.bfloat16))
                     out[name] = q
                     continue

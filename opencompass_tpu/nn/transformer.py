@@ -143,10 +143,37 @@ def _act(x, kind: str):
     raise ValueError(kind)
 
 
-def _linear(x, p):
+def _is_quant(w) -> bool:
+    return w.dtype in (jnp.dtype(jnp.int8), jnp.dtype(jnp.int4))
+
+
+def _dyn_act_quant(x):
+    """Dynamic per-token symmetric int8: returns (x_int8, scales (...,1))."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    xq = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127,
+                  127).astype(jnp.int8)
+    return xq, scale
+
+
+def _linear(x, p, act_quant=False, pre=None):
+    """``pre`` carries an already-quantized (x_int8, scales) pair so
+    several projections of the same activation (q/k/v, gate/up) share one
+    dynamic-quant pass."""
     w = p['w']
-    if w.dtype == jnp.int8:  # weight-only quant (nn/quant.py)
-        y = (x @ w.astype(x.dtype)) * p['s'].astype(x.dtype)
+    if _is_quant(w):  # weight-only quant (nn/quant.py)
+        if act_quant:
+            # W8A8: int8 x int8 contraction natively on the MXU; int4
+            # weights convert to int8 inside the matmul fusion (the HBM
+            # stream stays at the 4-bit width either way)
+            xq, xs = pre if pre is not None else _dyn_act_quant(x)
+            y = jax.lax.dot_general(
+                xq, w.astype(jnp.int8), (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = (y.astype(jnp.float32) * xs
+                 * p['s'].astype(jnp.float32)).astype(x.dtype)
+        else:
+            y = (x @ w.astype(x.dtype)) * p['s'].astype(x.dtype)
     else:
         y = x @ w
     if 'b' in p:
@@ -154,7 +181,7 @@ def _linear(x, p):
     return y
 
 
-def _linear_nt(x, p):
+def _linear_nt(x, p, act_quant=False, pre=None):
     """Linear with the weight stored (out, in) — torch/HF orientation.
 
     q/k/v keep this layout on purpose: the KV-cache decode step prefers the
@@ -166,9 +193,17 @@ def _linear_nt(x, p):
     full-sequence path loses nothing.
     """
     w = p['w']
-    if w.dtype == jnp.int8:
-        y = jnp.einsum('...i,oi->...o', x, w.astype(x.dtype)) \
-            * p['s'].astype(x.dtype)
+    if _is_quant(w):
+        if act_quant:
+            xq, xs = pre if pre is not None else _dyn_act_quant(x)
+            y = jax.lax.dot_general(
+                xq, w.astype(jnp.int8), (((x.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = (y.astype(jnp.float32) * xs
+                 * p['s'].astype(jnp.float32)).astype(x.dtype)
+        else:
+            y = jnp.einsum('...i,oi->...o', x, w.astype(x.dtype)) \
+                * p['s'].astype(x.dtype)
     else:
         y = jnp.einsum('...i,oi->...o', x, w)
     if 'b' in p:
@@ -225,46 +260,58 @@ def _rope(x, positions, theta: float, rotary_pct: float = 1.0):
 
 
 def _attention(q, k, v, mask, cfg: TransformerConfig, bias=None,
-               k_scale=None, v_scale=None):
-    """Grouped-query attention.  q: (B,T,H,hd); k,v: (B,S,K,hd);
-    mask: (B,T,S) boolean (True = attend); bias: optional (B,H,T,S)
-    additive fp32 scores (ALiBi).  fp32 softmax accumulation.
+               k_scale=None, v_scale=None, head_major=False):
+    """Grouped-query attention.  q: (B,T,H,hd); k,v: (B,S,K,hd) — or, with
+    ``head_major``, (B,K,S,hd) (the KV-cache layout: each head's (S,hd)
+    block contiguous, so decode-step cache reads DMA long runs instead of
+    128-byte strided chunks).  mask: (B,T,S) boolean (True = attend);
+    bias: optional (B,H,T,S) additive fp32 scores (ALiBi).  fp32 softmax
+    accumulation.
 
-    With an int8 KV cache, k/v arrive int8 and k_scale/v_scale (B,S,K)
+    With an int8 KV cache, k/v arrive int8 and k_scale/v_scale (B,K,S)
     carry each vector's dequant scale.  The scales are constant along the
     head_dim contraction, so they fold into the scores (for k) and the
     probabilities (for v) instead of materializing a dequantized cache.
     """
     B, T, H, hd = q.shape
-    S, K = k.shape[1], k.shape[2]
+    if head_major:
+        K, S = k.shape[1], k.shape[2]
+    else:
+        S, K = k.shape[1], k.shape[2]
     G = H // K
     qg = q.reshape(B, T, K, G, hd)
-    kk = k.astype(qg.dtype) if k.dtype == jnp.int8 else k
-    scores = jnp.einsum('btkgh,bskh->bkgts', qg, kk,
+    kk = k.astype(qg.dtype) if _is_quant(k) else k
+    scores = jnp.einsum('btkgh,bksh->bkgts' if head_major
+                        else 'btkgh,bskh->bkgts', qg, kk,
                         preferred_element_type=jnp.float32)
     scores = scores * (hd ** -0.5)
     if k_scale is not None:
-        # (B,S,K) -> (B,K,1,1,S)
-        scores = scores * jnp.transpose(
-            k_scale.astype(jnp.float32), (0, 2, 1))[:, :, None, None, :]
+        # head_major: (B,K,S); seq-major: (B,S,K) -> (B,K,1,1,S)
+        ks = k_scale.astype(jnp.float32)
+        if not head_major:
+            ks = jnp.transpose(ks, (0, 2, 1))
+        scores = scores * ks[:, :, None, None, :]
     if bias is not None:
         scores = scores + bias.reshape(B, K, G, T, S)
     scores = jnp.where(mask[:, None, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    if v.dtype == jnp.int8:
+    if _is_quant(v):
         pd = qg.dtype
         if v_scale is not None:
-            probs = probs * jnp.transpose(
-                v_scale.astype(jnp.float32),
-                (0, 2, 1))[:, :, None, None, :]
-        out = jnp.einsum('bkgts,bskh->btkgh', probs.astype(pd),
+            vs = v_scale.astype(jnp.float32)
+            if not head_major:
+                vs = jnp.transpose(vs, (0, 2, 1))
+            probs = probs * vs[:, :, None, None, :]
+        out = jnp.einsum('bkgts,bksh->btkgh' if head_major
+                         else 'bkgts,bskh->btkgh', probs.astype(pd),
                          v.astype(pd))
     else:
-        out = jnp.einsum('bkgts,bskh->btkgh', probs.astype(v.dtype), v)
+        out = jnp.einsum('bkgts,bksh->btkgh' if head_major
+                         else 'bkgts,bskh->btkgh', probs.astype(v.dtype), v)
     return out.reshape(B, T, H, hd)
 
 
-def _row_parallel(x, p, tp_axis):
+def _row_parallel(x, p, tp_axis, act_quant=False):
     """Row-sharded linear inside shard_map: local matmul, psum over the
     tensor-parallel axis, bias added once after the reduction (the bias is
     replicated — adding it per shard would count it n_tp times).  The int8
@@ -272,8 +319,9 @@ def _row_parallel(x, p, tp_axis):
     contraction), so rescaling the local partial product commutes with the
     psum."""
     w = p['w']
-    if w.dtype == jnp.int8:
-        y = (x @ w.astype(x.dtype)) * p['s'].astype(x.dtype)
+    if _is_quant(w):
+        y = _linear(x, {k: v for k, v in p.items() if k != 'b'},
+                    act_quant=act_quant)
     else:
         y = x @ w
     y = jax.lax.psum(y, tp_axis)
@@ -295,12 +343,14 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
     column-sharded so head/ffn dims below are local, and the o/down
     projections psum over it."""
     B, T, D = x.shape
+    aq = cfg.act_quant
     h = _norm(x, lp['attn_norm'], cfg)
     # head dims inferred (-1): under tp_axis the projections are local
     # shards with num_heads/n_tp (and num_kv_heads/n_tp) heads
-    q = _linear_nt(h, lp['q']).reshape(B, T, -1, cfg.head_dim)
-    k = _linear_nt(h, lp['k']).reshape(B, T, -1, cfg.head_dim)
-    v = _linear_nt(h, lp['v']).reshape(B, T, -1, cfg.head_dim)
+    h_pre = _dyn_act_quant(h) if aq and _is_quant(lp['q']['w']) else None
+    q = _linear_nt(h, lp['q'], aq, h_pre).reshape(B, T, -1, cfg.head_dim)
+    k = _linear_nt(h, lp['k'], aq, h_pre).reshape(B, T, -1, cfg.head_dim)
+    v = _linear_nt(h, lp['v'], aq, h_pre).reshape(B, T, -1, cfg.head_dim)
     q = _shard(q, P('data', None, 'model', None))
     k = _shard(k, P('data', None, 'model', None))
     v = _shard(v, P('data', None, 'model', None))
@@ -311,10 +361,15 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
 
     new_cache = None
     k_scale = v_scale = None
+    head_major = cache_slice is not None
     if cache_slice is not None:
-        if 'ks' in cache_slice:  # int8 KV cache (cfg.kv_quant)
-            k, ks_new = _quantize_kv(k)
-            v, vs_new = _quantize_kv(v)
+        # cache layout is head-major (B,K,S,hd): per-head (S,hd) blocks
+        # stay contiguous, so the per-step cache read is long DMA runs
+        k = jnp.swapaxes(k, 1, 2)  # (B,K,T,hd)
+        v = jnp.swapaxes(v, 1, 2)
+        if 'ks' in cache_slice:  # quantized KV cache (cfg.kv_quant)
+            k, ks_new = _quantize_kv(k, cfg.kv_quant_mode)
+            v, vs_new = _quantize_kv(v, cfg.kv_quant_mode)
             kq = {'ks': ks_new.astype(cache_slice['ks'].dtype),
                   'vs': vs_new.astype(cache_slice['vs'].dtype)}
         else:
@@ -323,7 +378,7 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
         for name, cur in (('k', k), ('v', v), *kq.items()):
             new_cache[name] = jax.lax.dynamic_update_slice_in_dim(
                 cache_slice[name], cur.astype(cache_slice[name].dtype),
-                cache_index, axis=1)
+                cache_index, axis=2)
         k, v = new_cache['k'], new_cache['v']
         if kq:
             k_scale, v_scale = new_cache['ks'], new_cache['vs']
@@ -336,12 +391,13 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
             kv_pos = kv_positions if kv_positions is not None else positions
             bias = _alibi_bias(cfg, positions, kv_pos)
         attn = _attention(q, k, v, mask, cfg, bias=bias,
-                          k_scale=k_scale, v_scale=v_scale)
+                          k_scale=k_scale, v_scale=v_scale,
+                          head_major=head_major)
     attn2d = attn.reshape(B, T, -1)
     if tp_axis is None:
-        attn = _linear(attn2d, lp['o'])
+        attn = _linear(attn2d, lp['o'], aq)
     else:
-        attn = _row_parallel(attn2d, lp['o'], tp_axis)
+        attn = _row_parallel(attn2d, lp['o'], tp_axis, aq)
     attn = _shard(attn, P('data', None, None))
 
     if cfg.parallel_residual:
@@ -352,15 +408,19 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
         h2 = _norm(x, lp['mlp_norm'], cfg)
 
     if cfg.gated_mlp:
-        inner = _shard(_act(_linear(h2, lp['gate']), cfg.activation)
-                       * _linear(h2, lp['up']), P('data', None, 'model'))
-        mlp = _linear(inner, lp['down']) if tp_axis is None \
-            else _row_parallel(inner, lp['down'], tp_axis)
+        h2_pre = _dyn_act_quant(h2) if aq and _is_quant(lp['gate']['w']) \
+            else None
+        inner = _shard(
+            _act(_linear(h2, lp['gate'], aq, h2_pre), cfg.activation)
+            * _linear(h2, lp['up'], aq, h2_pre),
+            P('data', None, 'model'))
+        mlp = _linear(inner, lp['down'], aq) if tp_axis is None \
+            else _row_parallel(inner, lp['down'], tp_axis, aq)
     else:
-        inner = _shard(_act(_linear(h2, lp['fc1']), cfg.activation),
+        inner = _shard(_act(_linear(h2, lp['fc1'], aq), cfg.activation),
                        P('data', None, 'model'))
-        mlp = _linear(inner, lp['fc2']) if tp_axis is None \
-            else _row_parallel(inner, lp['fc2'], tp_axis)
+        mlp = _linear(inner, lp['fc2'], aq) if tp_axis is None \
+            else _row_parallel(inner, lp['fc2'], tp_axis, aq)
     mlp = _shard(mlp, P('data', None, None))
 
     if cfg.parallel_residual:
@@ -514,24 +574,30 @@ def forward(params: Params, cfg: TransformerConfig, tokens: jax.Array,
 
 def init_cache(cfg: TransformerConfig, batch: int, max_len: int,
                dtype=None) -> Dict:
+    """KV cache, head-major: k/v are (L, B, K, S, hd) so each head's
+    (S, hd) block is contiguous in HBM (long DMA runs per decode step);
+    int8 mode adds per-vector scales (L, B, K, S)."""
     dtype = dtype or cfg.jnp_dtype
-    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
-    if cfg.kv_quant:
+    shape = (cfg.num_layers, batch, cfg.num_kv_heads, max_len, cfg.head_dim)
+    mode = cfg.kv_quant_mode
+    if mode:
+        kv_dtype = jnp.int4 if mode == 'int4' else jnp.int8
         sshape = shape[:-1]
-        return {'k': jnp.zeros(shape, jnp.int8),
-                'v': jnp.zeros(shape, jnp.int8),
+        return {'k': jnp.zeros(shape, kv_dtype),
+                'v': jnp.zeros(shape, kv_dtype),
                 'ks': jnp.ones(sshape, dtype),
                 'vs': jnp.ones(sshape, dtype)}
     return {'k': jnp.zeros(shape, dtype), 'v': jnp.zeros(shape, dtype)}
 
 
-def _quantize_kv(x):
-    """Per-vector (over head_dim) symmetric int8: (B,T,K,hd) ->
-    (int8 same shape, scales (B,T,K))."""
+def _quantize_kv(x, mode='int8'):
+    """Per-vector (over head_dim) symmetric quantization: returns
+    (int8-or-int4 same shape, scales with head_dim reduced)."""
+    qmax, dtype = (7.0, jnp.int4) if mode == 'int4' else (127.0, jnp.int8)
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
-    scale = jnp.maximum(amax / 127.0, 1e-12)
+    scale = jnp.maximum(amax / qmax, 1e-12)
     xi = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
-                  -127, 127).astype(jnp.int8)
+                  -qmax, qmax).astype(dtype)
     return xi, scale
 
 
@@ -549,9 +615,9 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     pad_mask = pad_mask.astype(jnp.bool_)
     positions = token_positions(pad_mask)
     # prompt token i occupies cache slot i → query i may attend slots j <= i
-    causal = jnp.tril(jnp.ones((S, cache['k'].shape[2]), jnp.bool_))
+    causal = jnp.tril(jnp.ones((S, cache['k'].shape[3]), jnp.bool_))
     # valid kv slots during prefill: the first S slots, minus pads
-    kv_valid = jnp.zeros((B, cache['k'].shape[2]), jnp.bool_)
+    kv_valid = jnp.zeros((B, cache['k'].shape[3]), jnp.bool_)
     kv_valid = jax.lax.dynamic_update_slice_in_dim(kv_valid, pad_mask, 0,
                                                    axis=1)
     mask = causal[None, :, :] & kv_valid[:, None, :]
@@ -560,7 +626,7 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
         # follow are causal over it (GLM-family generation)
         mask = kv_valid[:, None, :]
     # per-slot positions for position-dependent attention bias (ALiBi)
-    kv_positions = slot_positions(pad_mask, cache['k'].shape[2])
+    kv_positions = slot_positions(pad_mask, cache['k'].shape[3])
     x = _embed(params, cfg, tokens, positions)
     x, cache = _stack(cfg, x, params['layers'], positions, mask, cache, 0,
                       kv_positions=kv_positions)

@@ -130,3 +130,91 @@ def test_jaxlm_int8_kv_end_to_end():
     assert len(out) == 1
     nll = lm.get_ppl(['scoring path unaffected'])
     assert np.isfinite(nll[0])
+
+
+def test_w8a8_forward_close_to_fp():
+    cfga = dataclasses.replace(CFG, act_quant=True)
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qparams = quantize_params(params, CFG)
+    tokens, mask = _data()
+    ref = np.asarray(forward(params, CFG, tokens, mask, use_flash=False))
+    got = np.asarray(forward(qparams, cfga, tokens, mask, use_flash=False))
+    denom = np.maximum(np.abs(ref).max(), 1e-6)
+    # dynamic per-token int8 activations on top of int8 weights: a little
+    # looser than weight-only, still tracking
+    assert np.abs(ref - got).max() / denom < 0.08
+    nll_ref = np.asarray(sequence_nll(jnp.asarray(ref), tokens, mask))
+    nll_got = np.asarray(sequence_nll(jnp.asarray(got), tokens, mask))
+    np.testing.assert_allclose(nll_got, nll_ref, rtol=0.05)
+
+
+def test_w8a8_ppl_ranking_agrees_with_bf16():
+    """The PPL-mode eval contract is argmin over choices: W8A8 scoring must
+    rank a tiny model's choices like the full-precision path."""
+    lm_q = JaxLM(config='tiny', max_seq_len=128, quantize='w8a8')
+    lm_fp = JaxLM(config='tiny', max_seq_len=128)
+    choices = ['the answer is yes', 'the answer is no',
+               'the answer is maybe', 'completely different text here']
+    nll_q = lm_q.get_ppl(choices)
+    nll_fp = lm_fp.get_ppl(choices)
+    assert np.argmin(nll_q) == np.argmin(nll_fp)
+    np.testing.assert_allclose(nll_q, nll_fp, rtol=0.08)
+
+
+def test_int4_kv_greedy_generate_runs_and_tracks():
+    cfgq = dataclasses.replace(CFG, kv_quant='int4')
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    tokens, mask = _data(B=2, S=8)
+    out_fp, _ = jax.jit(lambda p, t, m: greedy_generate(p, CFG, t, m, 8))(
+        params, tokens, mask)
+    out_q, _ = jax.jit(lambda p, t, m: greedy_generate(p, cfgq, t, m, 8))(
+        params, tokens, mask)
+    assert out_q.shape == (2, 8)
+    agree = (np.asarray(out_fp) == np.asarray(out_q)).mean()
+    assert agree >= 0.4, f'int4 KV diverged too much: agree={agree}'
+
+
+def test_jaxlm_w8a8_kv4_end_to_end():
+    lm = JaxLM(config='tiny', max_seq_len=128, quantize='w8a8-kv4')
+    assert lm.cfg.kv_quant_mode == 'int4' and lm.cfg.act_quant
+    out = lm.generate(['hello world'], max_out_len=6)
+    assert len(out) == 1
+    nll = lm.get_ppl(['scoring path quantized but finite'])
+    assert np.isfinite(nll[0])
+
+
+def test_quantize_mode_validation():
+    import pytest
+    with pytest.raises(ValueError):
+        JaxLM(config='tiny', quantize='int4')  # int4 weights: not a mode
+    with pytest.raises(ValueError):
+        JaxLM(config='tiny', quantize='w8a8-kv2')
+
+
+def test_int4_weight_quantize_forward_close():
+    """int4 weights at the quantize_params level (CPU backend accepts int4
+    jit arguments; JaxLM gates the mode off on TPU — see nn/quant.py)."""
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    q4 = quantize_params(params, CFG, mode='int4')
+    assert q4['layers']['q']['w'].dtype == jnp.int4
+    tokens, mask = _data()
+    ref = np.asarray(forward(params, CFG, tokens, mask,
+                             use_flash=False)).ravel()
+    got = np.asarray(forward(q4, CFG, tokens, mask,
+                             use_flash=False)).ravel()
+    # 4-bit per-channel scales are coarse on random gaussian weights (a
+    # production int4 recipe would add group-wise scales); this pins the
+    # storage/compute pipeline, not a shipped accuracy tier — the shipped
+    # int4 config is the KV cache, whose per-vector scales are tested
+    # above by decode token agreement
+    assert np.all(np.isfinite(got))
+    cos = np.dot(ref, got) / (np.linalg.norm(ref) * np.linalg.norm(got))
+    assert cos > 0.9, f'int4 forward decorrelated: cos={cos}'
+
+
+def test_kv_quant_mode_validation():
+    import pytest
+    bad = dataclasses.replace(CFG, kv_quant='int2')
+    with pytest.raises(ValueError):
+        bad.kv_quant_mode
+    assert dataclasses.replace(CFG, kv_quant=True).kv_quant_mode == 'int8'
