@@ -1,11 +1,18 @@
 """Generation inferencer — the free-form completion measurement path.
 
-Pipeline: retrieve example ids → render prompts (dropping trailing in-context
-examples until each prompt fits ``max_seq_len``) → resume from a ``tmp_``
-partial file if present → batched ``generate_from_template`` → periodic
-``save_every`` flushes → final predictions JSON.
-Parity: reference openicl/icl_inferencer/icl_gen_inferencer.py:22-183.
+Measurement contract (parity with reference openicl/icl_inferencer/
+icl_gen_inferencer.py:22-183): retrieve example ids, render each prompt
+with as many in-context examples as fit ``max_seq_len``, resume from a
+``tmp_`` partial flush when present, generate in batches, flush every
+``save_every`` samples, write the final predictions JSON.
+
+The shape is this codebase's own: prompt fitting bisects the in-context
+count through ``IceFitter`` (the reference re-renders after every dropped
+example), and resume is a rank-0 read broadcast to the whole process group
+so multi-host runs execute the same number of batches.
 """
+from __future__ import annotations
+
 import os
 import os.path as osp
 from typing import List, Optional
@@ -16,6 +23,7 @@ from opencompass_tpu.utils.logging import get_logger
 
 from .base import (BaseInferencer, GenInferencerOutputHandler,
                    load_results_dict)
+from .prompting import IceFitter
 
 logger = get_logger()
 
@@ -23,23 +31,17 @@ logger = get_logger()
 @ICL_INFERENCERS.register_module()
 class GenInferencer(BaseInferencer):
 
-    def __init__(self,
-                 model,
-                 max_out_len: int,
-                 max_seq_len: Optional[int] = None,
-                 batch_size: int = 1,
+    def __init__(self, model, max_out_len: int,
+                 max_seq_len: Optional[int] = None, batch_size: int = 1,
                  gen_field_replace_token: str = '',
                  output_json_filepath: str = './icl_inference_output',
                  output_json_filename: str = 'predictions',
                  save_every: Optional[int] = None,
-                 fix_id_list: Optional[List[int]] = None,
-                 **kwargs):
-        super().__init__(model=model,
-                         max_seq_len=max_seq_len,
+                 fix_id_list: Optional[List[int]] = None, **kwargs):
+        super().__init__(model=model, max_seq_len=max_seq_len,
                          batch_size=batch_size,
                          output_json_filepath=output_json_filepath,
-                         output_json_filename=output_json_filename,
-                         **kwargs)
+                         output_json_filename=output_json_filename, **kwargs)
         self.gen_field_replace_token = gen_field_replace_token
         self.max_out_len = max_out_len
         self.fix_id_list = fix_id_list
@@ -47,65 +49,53 @@ class GenInferencer(BaseInferencer):
             save_every = 1  # API calls are slow and flaky: flush each batch
         self.save_every = save_every
 
-    def inference(self,
-                  retriever,
-                  ice_template=None,
-                  prompt_template=None,
+    def inference(self, retriever, ice_template=None, prompt_template=None,
                   output_json_filepath: Optional[str] = None,
                   output_json_filename: Optional[str] = None) -> List:
-        output_handler = GenInferencerOutputHandler()
-        output_json_filepath = output_json_filepath \
-            or self.output_json_filepath
-        output_json_filename = output_json_filename \
-            or self.output_json_filename
+        handler = GenInferencerOutputHandler()
+        out_dir = output_json_filepath or self.output_json_filepath
+        out_name = output_json_filename or self.output_json_filename
 
-        if 'Fix' in type(retriever).__name__ and self.fix_id_list:
-            ice_idx_list = retriever.retrieve(self.fix_id_list)
-        else:
-            ice_idx_list = retriever.retrieve()
+        use_fixed = 'Fix' in type(retriever).__name__ and self.fix_id_list
+        example_ids = (retriever.retrieve(self.fix_id_list) if use_fixed
+                       else retriever.retrieve())
+        prompts = self.build_prompt_list(example_ids, retriever,
+                                         ice_template=ice_template,
+                                         prompt_template=prompt_template)
 
-        prompt_list = self.build_prompt_list(
-            ice_idx_list,
-            retriever,
-            ice_template=ice_template,
-            prompt_template=prompt_template)
-
-        # Sample-level resume: pick up from a tmp_ flush of a previous run.
-        # Rank 0 reads the file; the decision is broadcast so every process
-        # in a multi-host group runs the same number of batches.
-        index = 0
-        tmp_json_filepath = os.path.join(output_json_filepath,
-                                         'tmp_' + output_json_filename)
-        resumed = None
-        if self.is_main_process and osp.exists(tmp_json_filepath):
-            resumed = load_results_dict(tmp_json_filepath)
-        resumed = broadcast_object(resumed)
-        if resumed:
-            output_handler.results_dict = resumed
-            index = len(resumed)
+        scratch = os.path.join(out_dir, 'tmp_' + out_name)
+        done = self._resume(scratch)
+        if done:
+            handler.results_dict = done
+        cursor = len(done)
 
         logger.info('Starting inference process...')
-        for entry in self.get_batches(prompt_list[index:], self.batch_size):
-            parsed_entries = self.model.parse_template(entry, mode='gen')
-            generated = self._generate_batch(entry, parsed_entries)
-            for prompt, prediction in zip(parsed_entries, generated):
-                output_handler.save_results(prompt, prediction, index)
-                index += 1
-            if (self.save_every is not None and index % self.save_every == 0
-                    and self.is_main_process):
-                output_handler.write_to_json(output_json_filepath,
-                                             'tmp_' + output_json_filename)
+        for chunk in self.get_batches(prompts[cursor:], self.batch_size):
+            shown = self.model.parse_template(chunk, mode='gen')
+            completions = self._generate_batch(chunk, shown)
+            for text, completion in zip(shown, completions):
+                handler.save_results(text, completion, cursor)
+                cursor += 1
+            if (self.save_every is not None and self.is_main_process
+                    and cursor % self.save_every == 0):
+                handler.write_to_json(out_dir, 'tmp_' + out_name)
 
         if self.is_main_process:
-            os.makedirs(output_json_filepath, exist_ok=True)
-            output_handler.write_to_json(output_json_filepath,
-                                         output_json_filename)
-            if osp.exists(tmp_json_filepath):
-                os.remove(tmp_json_filepath)
-        return [
-            sample['prediction']
-            for sample in output_handler.results_dict.values()
-        ]
+            os.makedirs(out_dir, exist_ok=True)
+            handler.write_to_json(out_dir, out_name)
+            if osp.exists(scratch):
+                os.remove(scratch)
+        return [sample['prediction']
+                for sample in handler.results_dict.values()]
+
+    def _resume(self, scratch_path: str) -> dict:
+        """Sample-level resume from a previous run's tmp_ flush.  Rank 0
+        reads; the result is broadcast so every process in a multi-host
+        group skips the same samples."""
+        partial = None
+        if self.is_main_process and osp.exists(scratch_path):
+            partial = load_results_dict(scratch_path)
+        return broadcast_object(partial) or {}
 
     def _generate_batch(self, entry, parsed_entries) -> List[str]:
         """One batched model call; the hook GLMChoiceInferencer overrides."""
@@ -117,34 +107,20 @@ class GenInferencer(BaseInferencer):
                           retriever,
                           ice_template=None,
                           prompt_template=None) -> List:
-        """Render every prompt, shrinking each one's in-context example list
-        from the tail until it fits ``max_seq_len``."""
-        prompt_list = []
-        for idx, ice_idx in enumerate(ice_idx_list):
-            ice = retriever.generate_ice(ice_idx, ice_template=ice_template)
-            prompt = retriever.generate_prompt_for_generate_task(
-                idx,
-                ice,
-                gen_field_replace_token=self.gen_field_replace_token,
-                ice_template=ice_template,
-                prompt_template=prompt_template)
-            if self.max_seq_len is not None:
-                token_num = self.model.get_token_len_from_template(prompt,
-                                                                   mode='gen')
-                while len(ice_idx) > 0 and token_num > self.max_seq_len:
-                    ice_idx = ice_idx[:-1]
-                    ice = retriever.generate_ice(ice_idx,
-                                                 ice_template=ice_template)
-                    prompt = retriever.generate_prompt_for_generate_task(
-                        idx,
-                        ice,
-                        gen_field_replace_token=self.gen_field_replace_token,
-                        ice_template=ice_template,
-                        prompt_template=prompt_template)
-                    token_num = self.model.get_token_len_from_template(
-                        prompt, mode='gen')
-            prompt_list.append(prompt)
-        return prompt_list
+        """Render every prompt with the largest in-context example count
+        that fits ``max_seq_len`` (bisection via IceFitter)."""
+        fitter = IceFitter(ice_idx_list, retriever, self.model, 'gen',
+                           self.max_seq_len, ice_template)
+        prompts = []
+        for item in range(len(fitter)):
+            def render(ice_block, item=item):
+                return retriever.generate_prompt_for_generate_task(
+                    item, ice_block,
+                    gen_field_replace_token=self.gen_field_replace_token,
+                    ice_template=ice_template,
+                    prompt_template=prompt_template)
+            prompts.append(fitter.fit(item, render)[1])
+        return prompts
 
 
 @ICL_INFERENCERS.register_module()

@@ -1,13 +1,21 @@
 """Perplexity inferencer — the label-ranking measurement path.
 
-For each candidate label, every test item is rendered into a label-conditional
-prompt and scored by mean per-token NLL; the prediction is the argmin-PPL
-label.  With ``normalizing_str`` the prompt is split at the template's
-``sep_token`` into context+answer, and the score is
-``PPL(context+answer | mask context) − PPL(normalizing_str+answer | mask
-normalizing_str)`` — length-normalized conditional scoring.
-Parity: reference openicl/icl_inferencer/icl_ppl_inferencer.py:20-212.
+Measurement contract (parity with reference openicl/icl_inferencer/
+icl_ppl_inferencer.py:20-212): every test item is rendered once per
+candidate label and scored by mean per-token NLL; the prediction is the
+argmin-PPL label.  With ``normalizing_str`` the template's ``sep_token``
+marks the context/answer boundary and the score becomes
+``PPL(context+answer | context masked) − PPL(normalizing_str+answer |
+normalizing_str masked)`` — length-normalized conditional scoring.
+
+The shape is this codebase's own: prompt fitting goes through
+``IceFitter`` (bisection over the in-context count instead of the
+reference's drop-one-rerender loop), each label's rows are assembled as
+``_Row`` records up front, and scoring is one batched pass per label.
 """
+from __future__ import annotations
+
+import dataclasses
 import os
 from typing import List, Optional
 
@@ -17,166 +25,138 @@ from opencompass_tpu.registry import ICL_INFERENCERS
 from opencompass_tpu.utils.logging import get_logger
 
 from .base import BaseInferencer, PPLInferencerOutputHandler
+from .prompting import IceFitter
 
 logger = get_logger()
+
+
+@dataclasses.dataclass
+class _Row:
+    """One (item, label) scoring row."""
+    prompt: object                       # str | PromptList
+    n_ice: int                           # fitted in-context example count
+    context_tokens: Optional[int] = None  # masked prefix (normalizing mode)
+    normalizer: Optional[str] = None      # normalizing_str + answer
 
 
 @ICL_INFERENCERS.register_module()
 class PPLInferencer(BaseInferencer):
 
-    def __init__(self,
-                 model,
-                 max_seq_len: Optional[int] = None,
+    def __init__(self, model, max_seq_len: Optional[int] = None,
                  batch_size: int = 1,
                  output_json_filepath: str = './icl_inference_output',
                  output_json_filename: str = 'predictions',
                  labels: Optional[List] = None,
-                 fix_id_list: Optional[List[int]] = None,
-                 **kwargs):
-        super().__init__(model=model,
-                         max_seq_len=max_seq_len,
+                 fix_id_list: Optional[List[int]] = None, **kwargs):
+        super().__init__(model=model, max_seq_len=max_seq_len,
                          batch_size=batch_size,
                          output_json_filepath=output_json_filepath,
-                         output_json_filename=output_json_filename,
-                         **kwargs)
+                         output_json_filename=output_json_filename, **kwargs)
         self.labels = labels
         self.fix_id_list = fix_id_list
 
-    def inference(self,
-                  retriever,
-                  ice_template=None,
-                  prompt_template=None,
+    def inference(self, retriever, ice_template=None, prompt_template=None,
                   output_json_filepath: Optional[str] = None,
                   output_json_filename: Optional[str] = None,
                   normalizing_str: Optional[str] = None) -> List:
-        output_handler = PPLInferencerOutputHandler()
-        output_json_filepath = output_json_filepath \
-            or self.output_json_filepath
-        output_json_filename = output_json_filename \
-            or self.output_json_filename
+        handler = PPLInferencerOutputHandler()
+        out_dir = output_json_filepath or self.output_json_filepath
+        out_name = output_json_filename or self.output_json_filename
 
-        if self.fix_id_list:
-            ice_idx_list = retriever.retrieve(self.fix_id_list)
-        else:
-            ice_idx_list = retriever.retrieve()
-
+        example_ids = (retriever.retrieve(self.fix_id_list)
+                       if self.fix_id_list else retriever.retrieve())
         labels = self.labels if self.labels is not None else \
             retriever.get_labels(ice_template=ice_template,
                                  prompt_template=prompt_template)
+        fitter = IceFitter(example_ids, retriever, self.model, 'ppl',
+                           self.max_seq_len, ice_template)
+        handler.save_ice(self.model.parse_template(
+            [fitter.ice(i) for i in range(len(fitter))], mode='ppl'))
 
-        ice = [
-            retriever.generate_ice(ice_idx_list[idx],
-                                   ice_template=ice_template)
-            for idx in range(len(ice_idx_list))
-        ]
-        output_handler.save_ice(self.model.parse_template(ice, mode='ppl'))
+        sep = None
+        if normalizing_str is not None:
+            tmpl = prompt_template if prompt_template is not None \
+                else ice_template
+            sep = tmpl.sep_token
+            if sep is None:
+                raise ValueError(
+                    'normalizing_str needs a template constructed with a '
+                    'sep_token marking the context/answer split')
 
-        label_ppls = []
+        score_table = []  # [label][item]
         for label in labels:
-            index = 0
-            prompt_list = []
-            sub_ppl_list = []
-            normalizing_prompt_list = []
-            context_length_list = []
-
-            for idx in range(len(ice_idx_list)):
-                prompt = retriever.generate_label_prompt(
-                    idx,
-                    ice[idx],
-                    label,
-                    ice_template=ice_template,
-                    prompt_template=prompt_template,
-                    remain_sep=normalizing_str is not None)
-                if self.max_seq_len is not None:
-                    token_num = self.model.get_token_len_from_template(
-                        prompt, mode='ppl')
-                    while len(ice_idx_list[idx]) > 0 \
-                            and token_num > self.max_seq_len:
-                        ice_idx_list[idx] = ice_idx_list[idx][:-1]
-                        ice[idx] = retriever.generate_ice(
-                            ice_idx_list[idx], ice_template=ice_template)
-                        prompt = retriever.generate_label_prompt(
-                            idx,
-                            ice[idx],
-                            label,
-                            ice_template=ice_template,
-                            prompt_template=prompt_template,
-                            remain_sep=normalizing_str is not None)
-                        token_num = self.model.get_token_len_from_template(
-                            prompt, mode='ppl')
-
-                if normalizing_str is not None:
-                    assert isinstance(prompt, str), (
-                        'normalizing_str requires plain-string prompts')
-                    sep_token = (prompt_template.sep_token
-                                 if prompt_template is not None else
-                                 ice_template.sep_token)
-                    if sep_token is None:
-                        raise ValueError(
-                            'normalizing_str needs a template constructed '
-                            'with a sep_token marking the context/answer '
-                            'split')
-                    sep_pos = prompt.find(sep_token)
-                    if sep_pos < 0:
-                        raise ValueError(
-                            f'sep_token {sep_token!r} not found in prompt; '
-                            'normalizing_str needs a template with a '
-                            'sep_token marking the context/answer split')
-                    context = prompt[:sep_pos]
-                    answer = prompt[sep_pos:].replace(sep_token, '')
-                    prompt = context + answer
-                    normalizing_prompt_list.append(normalizing_str + answer)
-                    context_length_list.append(
-                        self.model.get_token_len_from_template(context,
-                                                               mode='ppl'))
-                prompt_list.append(prompt)
-
-            if normalizing_str is not None:
-                norm_len = self.model.get_token_len_from_template(
-                    normalizing_str, mode='ppl')
-
             logger.info(f"Calculating PPL for prompts labeled '{label}'")
-            for start in range(0, len(prompt_list), self.batch_size):
-                sub_prompt_list = prompt_list[start:start + self.batch_size]
-                if normalizing_str is not None:
-                    sub_ctx_lens = context_length_list[start:start +
-                                                       self.batch_size]
-                    sub_norm_prompts = normalizing_prompt_list[
-                        start:start + self.batch_size]
-                    res1 = np.asarray(
-                        self.model.get_ppl_from_template(
-                            sub_prompt_list, mask_length=sub_ctx_lens))
-                    res2 = np.asarray(
-                        self.model.get_ppl_from_template(
-                            sub_norm_prompts,
-                            mask_length=[norm_len] * len(sub_norm_prompts)))
-                    sub_res = (res1 - res2).tolist()
-                else:
-                    sub_res = list(
-                        self.model.get_ppl_from_template(sub_prompt_list))
-                for res, prompt in zip(
-                        sub_res,
-                        self.model.parse_template(sub_prompt_list,
-                                                  mode='ppl')):
-                    sub_ppl_list.append(res)
-                    ice_str = str(
-                        self.model.parse_template(ice[index], mode='ppl'))
-                    output_handler.save_prompt_and_ppl(
-                        label, prompt.replace(ice_str, ''), prompt, res,
-                        index)
-                    index += 1
-            label_ppls.append(sub_ppl_list)
+            rows = [self._assemble(fitter, idx, label, ice_template,
+                                   prompt_template, sep, normalizing_str)
+                    for idx in range(len(fitter))]
+            ppls = self._score(rows, normalizing_str)
+            shown = self.model.parse_template([r.prompt for r in rows],
+                                              mode='ppl')
+            for idx, (row, text, ppl) in enumerate(zip(rows, shown, ppls)):
+                ice_text = str(self.model.parse_template(
+                    fitter.ice(idx, row.n_ice), mode='ppl'))
+                handler.save_prompt_and_ppl(
+                    label, text.replace(ice_text, ''), text, ppl, idx)
+            score_table.append(ppls)
 
-        predictions = []
-        for per_item in zip(*label_ppls):
-            predictions.append(labels[per_item.index(min(per_item))])
-        output_handler.save_predictions(predictions)
+        winners = [labels[int(np.argmin(item_scores))]
+                   for item_scores in zip(*score_table)]
+        handler.save_predictions(winners)
 
         if self.is_main_process:
-            os.makedirs(output_json_filepath, exist_ok=True)
-            output_handler.write_to_json(output_json_filepath,
-                                         output_json_filename)
-        return [
-            sample['prediction']
-            for sample in output_handler.results_dict.values()
-        ]
+            os.makedirs(out_dir, exist_ok=True)
+            handler.write_to_json(out_dir, out_name)
+        return [sample['prediction']
+                for sample in handler.results_dict.values()]
+
+    # -- assembly / scoring ------------------------------------------------
+
+    def _assemble(self, fitter, idx, label, ice_template, prompt_template,
+                  sep, normalizing_str) -> _Row:
+        """Fit one (item, label) prompt; in normalizing mode also split it
+        at the sep token and prepare the normalizer row."""
+        keep_sep = normalizing_str is not None
+
+        def render(ice_block):
+            return fitter.retriever.generate_label_prompt(
+                idx, ice_block, label, ice_template=ice_template,
+                prompt_template=prompt_template, remain_sep=keep_sep)
+
+        n_ice, prompt = fitter.fit(idx, render)
+        if normalizing_str is None:
+            return _Row(prompt, n_ice)
+        if not isinstance(prompt, str):
+            raise TypeError('normalizing_str requires plain-string prompts')
+        head, found, tail = prompt.partition(sep)
+        if not found:
+            raise ValueError(
+                f'sep_token {sep!r} not found in prompt; normalizing_str '
+                'needs a template with a sep_token marking the '
+                'context/answer split')
+        answer = tail.replace(sep, '')
+        return _Row(head + answer, n_ice,
+                    context_tokens=self.model.get_token_len_from_template(
+                        head, mode='ppl'),
+                    normalizer=normalizing_str + answer)
+
+    def _score(self, rows: List[_Row], normalizing_str) -> List[float]:
+        """Batched PPL over one label's rows; in normalizing mode each batch
+        is two masked calls whose difference is the score."""
+        if normalizing_str is not None:
+            norm_tokens = self.model.get_token_len_from_template(
+                normalizing_str, mode='ppl')
+        scores: List[float] = []
+        for chunk in self.get_batches(rows, self.batch_size):
+            prompts = [r.prompt for r in chunk]
+            if normalizing_str is None:
+                got = np.asarray(self.model.get_ppl_from_template(prompts))
+            else:
+                conditional = np.asarray(self.model.get_ppl_from_template(
+                    prompts,
+                    mask_length=[r.context_tokens for r in chunk]))
+                baseline = np.asarray(self.model.get_ppl_from_template(
+                    [r.normalizer for r in chunk],
+                    mask_length=[norm_tokens] * len(chunk)))
+                got = conditional - baseline
+            scores.extend(got.tolist())
+        return scores

@@ -1,0 +1,98 @@
+"""Prompt assembly shared by the PPL and Gen inferencers.
+
+TPU-first reshaping of the reference's truncation loops (reference
+openicl/icl_inferencer/icl_ppl_inferencer.py:105-182 and
+icl_gen_inferencer.py:150-183 re-render and re-tokenize the prompt after
+every single dropped in-context example — O(n_ice) template renders and
+token counts per item, the framework's hottest host loop on 100k-sample
+tasks).  Token length is monotone in the number of in-context examples, so
+the largest fitting count can be found by bisection: O(log n_ice) renders
+per item, with each rendered variant's token count deduped by the model's
+digest-keyed length cache (models/jax_lm.py).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+
+class IceFitter:
+    """Fits per-item prompts under ``max_seq_len`` by bisecting over the
+    in-context example count.
+
+    One instance serves one retriever pass: it owns the per-item example-id
+    lists and memoizes rendered ICE strings by (item, count) so repeated
+    fits (e.g. one per candidate label) re-render nothing.
+    """
+
+    def __init__(self, ice_ids: List[List[int]], retriever, model,
+                 mode: str, max_seq_len: Optional[int] = None,
+                 ice_template=None):
+        self.ice_ids = ice_ids
+        self.retriever = retriever
+        self.model = model
+        self.mode = mode
+        self.max_seq_len = max_seq_len
+        self.ice_template = ice_template
+        self._ice_memo = {}
+        self._memo_item = None
+        # truncation persists across fits of the same item (the PPL path
+        # fits once per candidate label): once some label's prompt forced
+        # the item down to k examples, later labels start from k — the
+        # reference mutates a shared ice_idx_list to the same effect
+        # (icl_ppl_inferencer.py:93-106), so label-by-label ICE counts
+        # match its non-increasing sequence exactly
+        self._ceiling = {}
+
+    def __len__(self):
+        return len(self.ice_ids)
+
+    def ice(self, item: int, count: Optional[int] = None):
+        """Rendered in-context-example block for ``item`` using its first
+        ``count`` retrieved examples (all of them by default).
+
+        The memo holds one item's variants at a time (a bisection needs
+        O(log n_ice) of them); keeping every item's blocks for a whole
+        100k-sample pass would pile up GBs of host RAM, the same reason
+        models/jax_lm.py bounds its token caches.
+        """
+        if count is None:
+            count = len(self.ice_ids[item])
+        if self._memo_item != item:
+            self._ice_memo.clear()
+            self._memo_item = item
+        if count not in self._ice_memo:
+            self._ice_memo[count] = self.retriever.generate_ice(
+                self.ice_ids[item][:count], ice_template=self.ice_template)
+        return self._ice_memo[count]
+
+    def _too_long(self, prompt) -> bool:
+        return self.model.get_token_len_from_template(
+            prompt, mode=self.mode) > self.max_seq_len
+
+    def fit(self, item: int, render: Callable) -> Tuple[int, object]:
+        """Largest ICE count whose rendered prompt fits -> (count, prompt).
+
+        ``render(ice_block)`` produces the full prompt for this item.  When
+        even the zero-example prompt is too long the zero-example prompt is
+        returned (the reference likewise stops dropping at zero and sends
+        the overlong prompt to the model's own truncation).
+        """
+        full = self._ceiling.get(item, len(self.ice_ids[item]))
+        prompt = render(self.ice(item, full))
+        if self.max_seq_len is None or not self._too_long(prompt):
+            return full, prompt
+        # invariant: lo fits (or is 0), hi is too long
+        lo, hi = 0, full
+        fitting = render(self.ice(item, 0))
+        if self._too_long(fitting):
+            self._ceiling[item] = 0
+            return 0, fitting
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            candidate = render(self.ice(item, mid))
+            if self._too_long(candidate):
+                hi = mid
+            else:
+                lo, fitting = mid, candidate
+        self._ceiling[item] = lo
+        return lo, fitting

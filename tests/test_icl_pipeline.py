@@ -144,3 +144,26 @@ def test_ppl_normalizing_str(tmp_path):
     assert real_mask is not None
     norm_inputs, norm_mask = calls[1]
     assert norm_inputs[0] == 'NORManswer A'
+
+
+def test_ppl_truncation_carries_across_labels(tmp_path):
+    """Once one label's prompt forces an item's ICE count down, later
+    labels start from the truncated count (reference ppl semantics)."""
+    from opencompass_tpu.icl.inferencers.prompting import IceFitter
+    ds = ToyDataset(reader_cfg=READER_CFG, n_test=1)
+    ice_template = PromptTemplate('Q: {question}\nA: {answer}')
+    model = FakeModel()  # token len = word count
+    retriever = FixKRetriever(ds, fix_id_list=[0, 1, 2, 3])
+    fitter = IceFitter(retriever.retrieve(), retriever, model, 'ppl',
+                       max_seq_len=26, ice_template=ice_template)
+
+    def render_long(ice):  # a long label: forces ICE drop
+        return str(ice) + ' tail with quite a few extra words ' * 1
+
+    def render_short(ice):  # a short label: would fit more ICE alone
+        return str(ice) + ' t'
+
+    k_long, _ = fitter.fit(0, render_long)
+    k_short, _ = fitter.fit(0, render_short)
+    assert k_long < 4          # truncation happened
+    assert k_short <= k_long   # carried ceiling, not refit from full
