@@ -54,8 +54,13 @@ def _pred_letters(pred: str) -> str:
     seg = _answer_segment(pred)
     if seg is not None:
         return _extract_letters(seg)
-    # unmarked prediction: only standalone capital letters count — bare
-    # [A-G] over prose would harvest letters out of ordinary words
+    # bare short answer like 'b' or 'a,c': uppercase and read directly,
+    # matching the uppercased marked-segment path
+    stripped = pred.strip()
+    if re.fullmatch(r'[A-Ga-g][\sA-Ga-g,，、和]*', stripped):
+        return _extract_letters(stripped.upper())
+    # unmarked prose: only standalone CAPITAL letters count — lowercase
+    # matching would harvest the article 'a' out of ordinary English
     return ''.join(sorted(dict.fromkeys(
         re.findall(r'\b([A-G])\b', pred))))
 

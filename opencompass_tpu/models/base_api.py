@@ -203,19 +203,20 @@ class BaseAPIModel(BaseModel):
             except urllib.error.HTTPError as err:
                 if err.code == 429:
                     logger.warning('rate limited; backing off')
-                    sleep(2 ** attempt)
-                    last_exc = err
-                    continue
-                if 400 <= err.code < 500:
+                elif 400 <= err.code < 500:
                     raise RuntimeError(
                         f'API rejected the request ({err.code} '
                         f'{err.reason}, {url})') from err
-                logger.error(f'API error {err.code}: {err.reason}')
+                else:
+                    logger.error(f'API error {err.code}: {err.reason}')
                 last_exc = err
+                if attempt < self.retry:  # no pointless terminal sleep
+                    sleep(2 ** attempt)   # 429/5xx: back off, don't hammer
             except Exception as exc:  # noqa: BLE001 — network variance
                 logger.error(f'API request failed: {exc}')
                 last_exc = exc
-                sleep(1)
+                if attempt < self.retry:
+                    sleep(1)
         raise RuntimeError(
             f'API request failed after {self.retry + 1} attempts '
             f'({url})') from last_exc

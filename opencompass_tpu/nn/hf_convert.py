@@ -484,7 +484,9 @@ def _ckpt_fingerprint(path: str, cfg: Optional[TransformerConfig]) -> str:
     for f in sorted(os.listdir(path)):
         if f.endswith(('.safetensors', '.bin', '.json')):
             st = os.stat(os.path.join(path, f))
-            parts.append(f'{f}:{st.st_size}:{int(st.st_mtime)}')
+            # nanosecond mtime: an in-place shard edit within the same
+            # second must not serve a stale cached conversion
+            parts.append(f'{f}:{st.st_size}:{st.st_mtime_ns}')
     return hashlib.sha256('|'.join(parts).encode()).hexdigest()[:16]
 
 

@@ -37,6 +37,7 @@ class DLCRunner(CloudRunner):
                  retry: int = 2,
                  debug: bool = False,
                  lark_bot_url: str = None):
+        import shlex
         aliyun_cfg = dict(aliyun_cfg or {})
         setup = []
         bashrc = aliyun_cfg.get('bashrc_path')
@@ -52,10 +53,14 @@ class DLCRunner(CloudRunner):
         # the reference) — a literal $PWD would expand on the worker to the
         # container's initial directory and break relative output paths
         setup.append(f'cd {os.getcwd()}')
+        # the WHOLE inner command is quoted once (quoting fragments inside
+        # an already-quoted string would break at the first space); the
+        # {task_cmd} placeholder survives quoting and CloudRunner
+        # substitutes the tempfile-based task line inside the quotes
         shell = '; '.join(setup + ['{task_cmd}'])
         parts = [
             'dlc create job',
-            f"--command '{shell}'",
+            f'--command {shlex.quote(shell)}',
             '--kind PyTorchJob',
             '--name {name}',
             '--worker_count 1',
@@ -65,11 +70,14 @@ class DLCRunner(CloudRunner):
             '--interactive',
         ]
         if aliyun_cfg.get('worker_image'):
-            parts.append(f"--worker_image {aliyun_cfg['worker_image']}")
+            parts.append(
+                f"--worker_image {shlex.quote(aliyun_cfg['worker_image'])}")
         if aliyun_cfg.get('workspace_id'):
-            parts.append(f"--workspace_id {aliyun_cfg['workspace_id']}")
+            parts.append(
+                f"--workspace_id {shlex.quote(str(aliyun_cfg['workspace_id']))}")
         if aliyun_cfg.get('dlc_config_path'):
-            parts.append(f"--config {aliyun_cfg['dlc_config_path']}")
+            parts.append(
+                f"--config {shlex.quote(aliyun_cfg['dlc_config_path'])}")
         super().__init__(task=task,
                          submit_template=' '.join(parts),
                          max_num_workers=max_num_workers,

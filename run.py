@@ -127,6 +127,9 @@ def _build_runner(task_type, args, cfg):
                        max_num_workers=args.max_num_workers,
                        num_devices=args.num_devices,
                        debug=args.debug,
+                       retry=args.retry,
+                       task_timeout=cfg.get('task_timeout'),
+                       stall_timeout=cfg.get('stall_timeout'),
                        lark_bot_url=cfg.get('lark_bot_url'))
 
 

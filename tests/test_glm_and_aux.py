@@ -242,3 +242,13 @@ def test_glm130b_wrapper_tensor_parallel_scoring():
     assert np.isfinite(nll[0])
     out = lm.choice(['pick one:'], [' A', ' B'])
     assert out[0] in (' A', ' B')
+
+
+def test_pjexam_letter_extraction_cases():
+    from opencompass_tpu.datasets.pjexam import _pred_letters
+    # bare lowercase short answers uppercase cleanly
+    assert _pred_letters('b') == 'B'
+    assert _pred_letters('a, c') == 'AC'
+    # English prose must not harvest the article 'a' as choice A
+    assert _pred_letters('It is a tricky one, but the answer is B') == 'B'
+    assert _pred_letters('The answer is B') == 'B'
