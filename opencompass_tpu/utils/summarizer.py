@@ -2,8 +2,9 @@
 
 Loads every ``results/{model}/{dataset}.json``, picks each dataset's primary
 metric, computes summary-group averages (naive or weighted), and renders a
-model × dataset table.  Parity: reference utils/summarizer.py:19-233 (minus
-the external tabulate dep — plain fixed-width rendering).
+model × dataset table.  Parity: reference utils/summarizer.py:19-233,
+including the sectioned summary_*.txt layout (tabulate when available,
+falling back to plain fixed-width rendering) and the exact csv table.
 """
 from __future__ import annotations
 
@@ -160,22 +161,30 @@ class Summarizer:
                         seen.append(abbr)
             dataset_abbrs = seen
 
-        # 'version' = prompt-hash prefix: two runs whose prompts differ show
-        # different versions (reference utils/summarizer.py:134 parity)
-        header = ['dataset', 'version', 'mode'] + model_abbrs
+        # reference-compatible table: dataset / version / metric / mode /
+        # one column per model ('version' = prompt-hash prefix: two runs
+        # whose prompts differ show different versions; reference
+        # utils/summarizer.py:158-179 parity)
+        header = ['dataset', 'version', 'metric', 'mode'] + model_abbrs
         rows = [header]
         for d_abbr in dataset_abbrs:
-            row = [d_abbr, versions.get(d_abbr, '-'),
+            metric = None
+            for m_abbr in model_abbrs:
+                result = raw.get(m_abbr, {}).get(d_abbr)
+                if result:
+                    metric = self._primary_metric(result)
+                    if metric:
+                        break
+            if metric is None:
+                rows.append([d_abbr, '-', '-', '-'] + ['-'] * len(model_abbrs))
+                continue
+            row = [d_abbr, versions.get(d_abbr, '-'), metric,
                    modes.get(d_abbr, '-')]
             for m_abbr in model_abbrs:
                 result = raw.get(m_abbr, {}).get(d_abbr)
-                metric = self._primary_metric(result) if result else None
-                if metric is None:
-                    row.append('-')
-                else:
-                    value = result[metric]
-                    row.append(f'{value:.2f}'
-                               if isinstance(value, float) else str(value))
+                value = result.get(metric) if result else None
+                row.append('{:.02f}'.format(value)
+                           if isinstance(value, (int, float)) else '-')
             rows.append(row)
         table = self._render(rows)
 
@@ -201,15 +210,58 @@ class Summarizer:
         work_dir = self.cfg['work_dir']
         out_dir = osp.join(work_dir, 'summary')
         os.makedirs(out_dir, exist_ok=True)
+        # summary_*.txt follows the reference's sectioned layout (time
+        # stamp, tabulate / csv / raw sections fenced by ^...$ with
+        # dividers — utils/summarizer.py:209-224) so downstream parsers of
+        # reference summaries read ours unchanged; the perf table is an
+        # extra trailing section
+        try:
+            import tabulate as _tabulate
+            pretty = _tabulate.tabulate(rows, headers='firstrow')
+        except ImportError:  # same table, plain fixed-width rendering
+            pretty = self._render(rows)
+        divider = '\n' + '-' * 128 + ' THIS IS A DIVIDER ' + '-' * 128 \
+            + '\n\n'
+        raw_lines = []
+        for m_abbr in model_abbrs:
+            raw_lines.append('-------------------------------')
+            raw_lines.append(f'Model: {m_abbr}')
+            for d_abbr in dataset_abbrs:
+                raw_lines.append(
+                    f'{d_abbr}: {raw.get(m_abbr, {}).get(d_abbr, "{}")}')
+        csv_text = '\n'.join(','.join(r) for r in rows) + '\n'
         txt_path = osp.join(out_dir, f'summary_{time_str}.txt')
         with open(txt_path, 'w') as f:
-            f.write(table + '\n')
+            f.write(time_str + '\n')
+            f.write('tabulate format\n')
+            f.write('^' * 128 + '\n')
+            f.write(pretty + '\n')
+            f.write('$' * 128 + '\n')
+            f.write(divider)
+            f.write('csv format\n')
+            f.write('^' * 128 + '\n')
+            f.write(csv_text)
+            f.write('$' * 128 + '\n')
+            f.write(divider)
+            f.write('raw format\n')
+            f.write('^' * 128 + '\n')
+            f.write('\n'.join(raw_lines) + '\n')
+            f.write('$' * 128 + '\n')
+            if len(perf_rows) > 1:
+                f.write(divider)
+                f.write('perf format\n')
+                f.write('^' * 128 + '\n')
+                f.write(self._render(perf_rows) + '\n')
+                f.write('$' * 128 + '\n')
+        # summary_*.csv is EXACTLY the reference's table (no perf rows);
+        # the perf table gets its own csv beside it
         csv_path = osp.join(out_dir, f'summary_{time_str}.csv')
         with open(csv_path, 'w', newline='') as f:
-            writer = csv.writer(f)
-            writer.writerows(rows)
-            if len(perf_rows) > 1:
-                writer.writerow([])
+            f.write(csv_text)
+        if len(perf_rows) > 1:
+            with open(osp.join(out_dir, f'perf_{time_str}.csv'), 'w',
+                      newline='') as f:
+                writer = csv.writer(f)
                 writer.writerows(perf_rows)
         self.logger.info(f'write summary to {osp.abspath(txt_path)}')
         print(table)

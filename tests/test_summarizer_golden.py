@@ -1,0 +1,84 @@
+"""Golden-file summarizer parity: the summary table must keep the
+reference's column set and file layout (reference utils/summarizer.py:
+157-233 — dataset/version/metric/mode + one column per model; txt with
+time stamp and tabulate/csv/raw sections fenced by ^...$; csv identical
+to the table).  The fixture under tests/fixtures pins the exact csv
+bytes so format drift fails loudly."""
+import os.path as osp
+
+from tests.test_orchestration import _demo_cfg
+
+FIXTURE = osp.join(osp.dirname(__file__), 'fixtures',
+                   'summary_golden.csv')
+
+
+def _summarize_two_models(tmp_path):
+    from opencompass_tpu.utils.summarizer import Summarizer
+    cfg = _demo_cfg(tmp_path)
+    base_model = dict(cfg['models'][0])
+    model_a = dict(base_model, abbr='model-a')
+    model_b = dict(base_model, abbr='model-b')
+    cfg['models'] = [model_a, model_b]
+    cfg['summarizer'] = {
+        'summary_groups': [
+            {'name': 'demo-avg', 'subsets': ['demo-gen', 'demo-ppl']},
+            {'name': 'demo-weighted',
+             'subsets': ['demo-gen', 'demo-ppl'],
+             'weights': {'demo-gen': 3, 'demo-ppl': 1}},
+        ]
+    }
+    for abbr, scores in [('model-a', {'demo-gen': '{"score": 80.0}',
+                                      'demo-ppl': '{"accuracy": 40.0}'}),
+                         ('model-b', {'demo-gen': '{"score": 50.0}'})]:
+        res_dir = tmp_path / 'results' / abbr
+        res_dir.mkdir(parents=True)
+        for d_abbr, payload in scores.items():
+            (res_dir / f'{d_abbr}.json').write_text(payload)
+    Summarizer(cfg).summarize('golden')
+    out = tmp_path / 'summary'
+    return ((out / 'summary_golden.txt').read_text(),
+            (out / 'summary_golden.csv').read_text())
+
+
+def test_csv_matches_golden_fixture(tmp_path):
+    _, csv_text = _summarize_two_models(tmp_path)
+    assert csv_text == open(FIXTURE).read()
+
+
+def test_csv_columns_and_group_metrics(tmp_path):
+    _, csv_text = _summarize_two_models(tmp_path)
+    rows = [line.split(',') for line in csv_text.strip().splitlines()]
+    assert rows[0] == ['dataset', 'version', 'metric', 'mode',
+                      'model-a', 'model-b']
+    by_dataset = {r[0]: r for r in rows[1:]}
+    # per-dataset rows: metric + mode + '{:.02f}' scores, '-' when absent
+    assert by_dataset['demo-gen'][2:] == ['score', 'gen', '80.00', '50.00']
+    assert by_dataset['demo-ppl'][2] == 'accuracy'
+    assert by_dataset['demo-ppl'][4:] == ['40.00', '-']
+    # group rows: naive + weighted averages with the reference metric names
+    assert by_dataset['demo-avg'][2] == 'naive_average'
+    assert by_dataset['demo-avg'][4] == '60.00'
+    assert by_dataset['demo-weighted'][2] == 'weighted_average'
+    assert by_dataset['demo-weighted'][4] == '70.00'
+    # model-b is missing demo-ppl, so its groups cannot aggregate
+    assert by_dataset['demo-avg'][5] == '-'
+    # version column is a 6-char prompt hash
+    assert len(by_dataset['demo-gen'][1]) == 6
+
+
+def test_txt_sections_match_reference_layout(tmp_path):
+    txt, csv_text = _summarize_two_models(tmp_path)
+    lines = txt.splitlines()
+    assert lines[0] == 'golden'                 # time_str stamp
+    assert lines[1] == 'tabulate format'
+    assert lines[2] == '^' * 128
+    for section in ('csv format', 'raw format'):
+        assert section in lines
+    assert 'THIS IS A DIVIDER' in txt
+    # the csv section reproduces the csv file byte for byte
+    start = lines.index('csv format') + 2
+    end = lines.index('$' * 128, start)
+    assert '\n'.join(lines[start:end]) + '\n' == csv_text
+    # raw section lists every model with its raw result dicts
+    assert 'Model: model-a' in txt and 'Model: model-b' in txt
+    assert "{'score': 80.0}" in txt
