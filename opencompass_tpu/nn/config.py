@@ -34,6 +34,9 @@ class TransformerConfig:
     # GPT-NeoX/pythia partial rotary: rotate only the first
     # rotary_pct*head_dim dims, pass the rest through
     rotary_pct: float = 1.0
+    # ChatGLM2/3 rotary convention: rotate adjacent (even, odd) pairs
+    # within the rotary dims instead of first/second halves
+    rope_interleaved: bool = False
     # gemma: rmsnorm weights are zero-centered (effective scale = 1 + w)
     # and input embeddings are multiplied by sqrt(hidden)
     norm_offset: float = 0.0
@@ -151,6 +154,23 @@ class TransformerConfig:
             activation='gelu', norm='layernorm', positional='rope',
             gated_mlp=True, qkv_bias=True, o_bias=True, mlp_bias=True,
             prefix_lm=True, **kw)
+
+    @staticmethod
+    def chatglm2(vocab_size=65024, hidden_size=4096, num_layers=28,
+                 num_heads=32, num_kv_heads=2, head_dim=128,
+                 intermediate_size=13696, max_seq_len=8192,
+                 rope_theta=10000.0, qkv_bias=True, norm='rmsnorm', **kw):
+        """ChatGLM2/3 family (causal, unlike the prefix-LM GLM-130B):
+        RMSNorm, SwiGLU, QKV biases, MQA with 2 kv groups, and rotary
+        over HALF the head dims in the interleaved-pairs convention."""
+        return TransformerConfig(
+            vocab_size=vocab_size, hidden_size=hidden_size,
+            num_layers=num_layers, num_heads=num_heads,
+            num_kv_heads=num_kv_heads, head_dim=head_dim,
+            intermediate_size=intermediate_size, max_seq_len=max_seq_len,
+            activation='silu', norm=norm, positional='rope',
+            rope_theta=rope_theta, rotary_pct=0.5, rope_interleaved=True,
+            qkv_bias=qkv_bias, gated_mlp=True, **kw)
 
     @staticmethod
     def gemma(vocab_size=256000, hidden_size=3072, num_layers=28,
@@ -308,6 +328,30 @@ class TransformerConfig:
                 max_seq_len=max_seq,
                 rope_theta=hf.get('rope_theta', 10000.0),
                 norm_eps=hf.get('rms_norm_eps', 1e-5),
+                tie_embeddings=hf.get('tie_word_embeddings', False))
+        if mt == 'chatglm':
+            # ChatGLM2/3 config.json (THUDM modeling_chatglm convention)
+            heads = hf['num_attention_heads']
+            if hf.get('multi_query_attention'):
+                num_kv = hf.get('multi_query_group_num', 2)
+            else:
+                num_kv = heads
+            return TransformerConfig.chatglm2(
+                vocab_size=hf.get('padded_vocab_size',
+                                  hf.get('vocab_size')),
+                hidden_size=hf['hidden_size'],
+                num_layers=hf['num_layers'],
+                num_heads=heads,
+                num_kv_heads=num_kv,
+                head_dim=hf.get('kv_channels',
+                                hf['hidden_size'] // heads),
+                intermediate_size=hf['ffn_hidden_size'],
+                max_seq_len=hf.get('seq_length', 8192),
+                rope_theta=10000.0 * hf.get('rope_ratio', 1),
+                qkv_bias=hf.get('add_qkv_bias', True),
+                norm=('rmsnorm' if hf.get('rmsnorm', True)
+                      else 'layernorm'),
+                norm_eps=hf.get('layernorm_epsilon', 1e-5),
                 tie_embeddings=hf.get('tie_word_embeddings', False))
         if mt == 'gemma':
             return TransformerConfig.gemma(

@@ -259,6 +259,38 @@ _BLOOM_MAP = {
         (('layers', 'fc2', 'b'), False),
 }
 
+# ChatGLM2/3: fused query_key_value in the block layout [H*hd q | K*hd k |
+# K*hd v] (same as falcon-7b, plus biases), fused dense_h_to_4h producing
+# [gate | up] halves for SwiGLU, RMSNorm, untied output_layer.  The
+# rotary_pos_emb.inv_freq buffer is derivable from config — dropped.
+_CHATGLM_MAP = {
+    r'transformer\.embedding\.word_embeddings\.weight': (('embed',), False),
+    r'transformer\.encoder\.final_layernorm\.weight':
+        (('final_norm', 'scale'), False),
+    r'transformer\.output_layer\.weight': (('lm_head',), True),
+    r'transformer\.rotary_pos_emb\.inv_freq': (('_ignore',), False),
+    r'transformer\.encoder\.final_layernorm\.bias':
+        (('final_norm', 'bias'), False),
+    r'transformer\.encoder\.layers\.(\d+)\.input_layernorm\.weight':
+        (('layers', 'attn_norm', 'scale'), False),
+    r'transformer\.encoder\.layers\.(\d+)\.input_layernorm\.bias':
+        (('layers', 'attn_norm', 'bias'), False),
+    r'transformer\.encoder\.layers\.(\d+)\.post_attention_layernorm'
+    r'\.weight': (('layers', 'mlp_norm', 'scale'), False),
+    r'transformer\.encoder\.layers\.(\d+)\.post_attention_layernorm'
+    r'\.bias': (('layers', 'mlp_norm', 'bias'), False),
+    r'transformer\.encoder\.layers\.(\d+)\.self_attention'
+    r'\.query_key_value\.weight': (('layers', '_qkv_mqa', 'w'), True),
+    r'transformer\.encoder\.layers\.(\d+)\.self_attention'
+    r'\.query_key_value\.bias': (('layers', '_qkv_mqa', 'b'), False),
+    r'transformer\.encoder\.layers\.(\d+)\.self_attention\.dense\.weight':
+        (('layers', 'o', 'w'), True),
+    r'transformer\.encoder\.layers\.(\d+)\.mlp\.dense_h_to_4h\.weight':
+        (('layers', '_gate_up', 'w'), True),
+    r'transformer\.encoder\.layers\.(\d+)\.mlp\.dense_4h_to_h\.weight':
+        (('layers', 'down', 'w'), True),
+}
+
 # InternLM2: fused grouped wqkv [per kv group: ratio q heads | k | v].
 _INTERNLM2_MAP = {
     r'model\.tok_embeddings\.weight': (('embed',), False),
@@ -287,7 +319,7 @@ _FAMILY_MAPS = {
     'internlm': _LLAMA_MAP, 'internlm2': _INTERNLM2_MAP,
     'baichuan': _BAICHUAN_MAP, 'falcon': _FALCON_MAP,
     'opt': _OPT_MAP, 'gpt2': _GPT2_MAP, 'bloom': _BLOOM_MAP,
-    'gpt_neox': _NEOX_MAP,
+    'gpt_neox': _NEOX_MAP, 'chatglm': _CHATGLM_MAP,
 }
 
 
@@ -362,11 +394,17 @@ def _split_fused_qkv(layers: Dict, cfg: TransformerConfig):
                 layers[name]['b'] = np.ascontiguousarray(
                     b[:, :, i].reshape(L, H * hd))
     if '_qkv_mqa' in layers:
-        w = layers.pop('_qkv_mqa')['w']     # (L, D, (H+2K)*hd)
+        fused = layers.pop('_qkv_mqa')
+        w = fused['w']                      # (L, D, (H+2K)*hd)
         q_dim = H * hd
         layers['q'] = {'w': _nt(w[:, :, :q_dim])}
         layers['k'] = {'w': _nt(w[:, :, q_dim:q_dim + K * hd])}
         layers['v'] = {'w': _nt(w[:, :, q_dim + K * hd:])}
+        if 'b' in fused:                    # chatglm2/3 add_qkv_bias
+            b = fused['b']
+            layers['q']['b'] = b[:, :q_dim]
+            layers['k']['b'] = b[:, q_dim:q_dim + K * hd]
+            layers['v']['b'] = b[:, q_dim + K * hd:]
     if '_gate_up' in layers:
         # [gate | up] halves (Phi-3 gate_up_proj), (L, in, 2F)
         w = layers.pop('_gate_up')['w']
@@ -435,6 +473,7 @@ def convert_checkpoint(path: str, cfg: Optional[TransformerConfig] = None,
                 raise ValueError(f'{dest}: missing layers {missing[:5]}...')
             put(dest, np.stack([by_layer[i] for i in range(L)]))
 
+    params.pop('_ignore', None)  # derivable buffers (e.g. rope inv_freq)
     layers = params.get('layers', {})
     if family == 'falcon' and hf_cfg.get('new_decoder_architecture') \
             and '_qkv_mqa' in layers:

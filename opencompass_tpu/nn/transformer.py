@@ -235,11 +235,16 @@ def _alibi_bias(cfg: TransformerConfig, q_pos, kv_pos) -> jax.Array:
     return slopes[None, :, None, None] * rel[:, None, :, :]
 
 
-def _rope(x, positions, theta: float, rotary_pct: float = 1.0):
-    """HF-convention RoPE: rotate halves.  x: (B, T, H, hd).
+def _rope(x, positions, theta: float, rotary_pct: float = 1.0,
+          interleaved: bool = False):
+    """RoPE.  x: (B, T, H, hd).
 
-    ``rotary_pct`` < 1 (GPT-NeoX/pythia) rotates only the first
-    ``int(hd * rotary_pct)`` dims and passes the rest through unrotated.
+    ``rotary_pct`` < 1 (GPT-NeoX/pythia, ChatGLM2/3) rotates only the
+    first ``int(hd * rotary_pct)`` dims and passes the rest through
+    unrotated.  The frequency ladder theta^(-j/(rot/2)) is shared; what
+    differs by family is the pairing: HF convention rotates (j, j+rot/2)
+    halves, ``interleaved`` (ChatGLM2/3) rotates adjacent (2j, 2j+1)
+    pairs.
     """
     hd = x.shape[-1]
     rot = int(hd * rotary_pct)
@@ -251,9 +256,15 @@ def _rope(x, positions, theta: float, rotary_pct: float = 1.0):
     angles = positions[..., None].astype(jnp.float32) * freqs  # (B,T,rot/2)
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
-                          axis=-1).astype(x.dtype)
+    x32 = x.astype(jnp.float32)
+    if interleaved:
+        x1, x2 = x32[..., 0::2], x32[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                        axis=-1).reshape(x.shape).astype(x.dtype)
+    else:
+        x1, x2 = jnp.split(x32, 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                              axis=-1).astype(x.dtype)
     if x_pass is not None:
         out = jnp.concatenate([out, x_pass], axis=-1)
     return out
@@ -356,8 +367,10 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
     v = _shard(v, P('data', None, 'model', None))
 
     if cfg.positional == 'rope':
-        q = _rope(q, positions, cfg.rope_theta, cfg.rotary_pct)
-        k = _rope(k, positions, cfg.rope_theta, cfg.rotary_pct)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rotary_pct,
+                  cfg.rope_interleaved)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rotary_pct,
+                  cfg.rope_interleaved)
 
     new_cache = None
     k_scale = v_scale = None

@@ -1,0 +1,201 @@
+"""ChatGLM2/3 numerical parity.
+
+``transformers`` ships no chatglm class (public checkpoints rely on
+``trust_remote_code``), so the torch side here is an independent
+reimplementation of the public modeling_chatglm.py architecture
+(THUDM/chatglm2-6b): fused query_key_value with bias in the block layout,
+MQA with grouped kv heads, rotary over HALF the head dims in the
+interleaved-pairs convention, RMSNorm, SwiGLU over a fused dense_h_to_4h,
+untied output_layer.  The checkpoint round-trips through
+``convert_checkpoint`` exactly like a downloaded one.
+
+tests/fixtures/chatglm2_golden.npz holds the tiny model's WEIGHTS along
+with the torch-produced logits/nll, so the golden test is self-contained:
+it neither imports torch nor depends on torch's init RNG stream staying
+stable across versions.
+"""
+import dataclasses
+import json
+import os.path as osp
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from opencompass_tpu.nn import forward, greedy_generate, sequence_nll
+from opencompass_tpu.nn.hf_convert import convert_checkpoint
+
+B, S = 2, 12
+V, D, H, K, HD, F, L = 128, 64, 4, 2, 16, 96, 2
+GOLDEN = osp.join(osp.dirname(__file__), 'fixtures',
+                  'chatglm2_golden.npz')
+
+HF_CONFIG = {
+    'model_type': 'chatglm', 'hidden_size': D, 'num_layers': L,
+    'num_attention_heads': H, 'kv_channels': HD,
+    'multi_query_attention': True, 'multi_query_group_num': K,
+    'ffn_hidden_size': F, 'padded_vocab_size': V, 'seq_length': 128,
+    'add_qkv_bias': True, 'rmsnorm': True, 'layernorm_epsilon': 1e-5,
+    'tie_word_embeddings': False,
+}
+
+
+def _write_checkpoint(state_dict, tmp_path):
+    """state_dict: checkpoint-name -> numpy array (fp32)."""
+    from safetensors.numpy import save_file
+    save_file({k: np.ascontiguousarray(v, dtype=np.float32)
+               for k, v in state_dict.items()},
+              str(tmp_path / 'model.safetensors'))
+    (tmp_path / 'config.json').write_text(json.dumps(HF_CONFIG))
+    return str(tmp_path)
+
+
+def _jax_logits(path, toks):
+    cfg, params = convert_checkpoint(path)
+    cfg = dataclasses.replace(cfg, dtype='float32')
+    assert cfg.rope_interleaved and cfg.rotary_pct == 0.5
+    assert cfg.num_kv_heads == K and cfg.qkv_bias
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    return cfg, params, np.asarray(
+        forward(params, cfg, jnp.asarray(toks), use_flash=False))
+
+
+def test_chatglm2_matches_committed_golden(tmp_path):
+    """Torch-free: weights + expected logits both come from the fixture."""
+    golden = np.load(GOLDEN)
+    sd = {name[len('w::'):]: golden[name] for name in golden.files
+          if name.startswith('w::')}
+    assert sd, 'fixture is missing the committed weights'
+    path = _write_checkpoint(sd, tmp_path)
+    toks = golden['tokens']
+    _, _, ours = _jax_logits(path, toks)
+    scale = np.abs(golden['logits']).max()
+    np.testing.assert_allclose(ours, golden['logits'],
+                               rtol=0.0, atol=5e-3 * scale)
+    nll = np.asarray(sequence_nll(
+        jnp.asarray(ours), jnp.asarray(toks),
+        jnp.ones(toks.shape, bool)))
+    np.testing.assert_allclose(nll, golden['nll'], rtol=1e-3, atol=1e-3)
+
+
+# -- live torch cross-check (independent reimplementation) -----------------
+
+def _torch_model_and_toks():
+    torch = pytest.importorskip('torch')
+
+    def _rms(x, w, eps=1e-5):
+        var = x.float().pow(2).mean(-1, keepdim=True)
+        return (x.float() * torch.rsqrt(var + eps) * w.float()).to(x.dtype)
+
+    def _rotary_cache(seq_len, rot_dim, base=10000.0):
+        # modeling_chatglm.RotaryEmbedding.forward_impl
+        theta = 1.0 / (base ** (torch.arange(0, rot_dim, 2).float()
+                                / rot_dim))
+        idx = torch.outer(torch.arange(seq_len).float(), theta)
+        return torch.stack([torch.cos(idx), torch.sin(idx)], dim=-1)
+
+    def _apply_rotary(x, cache):
+        # x: (B,S,nh,hd); cache: (S, rot/2, 2) — interleaved pairs
+        rot = cache.shape[-2] * 2
+        xr, x_pass = x[..., :rot], x[..., rot:]
+        xs = xr.reshape(*xr.shape[:-1], rot // 2, 2)
+        cos = cache[..., 0].view(1, x.shape[1], 1, rot // 2)
+        sin = cache[..., 1].view(1, x.shape[1], 1, rot // 2)
+        out = torch.stack(
+            [xs[..., 0] * cos - xs[..., 1] * sin,
+             xs[..., 1] * cos + xs[..., 0] * sin], dim=-1)
+        return torch.cat([out.flatten(-2), x_pass], dim=-1)
+
+    class TinyChatGLM2(torch.nn.Module):
+
+        def __init__(self):
+            super().__init__()
+            nn = torch.nn
+            self.embed = nn.Embedding(V, D)
+            self.layers = nn.ModuleList()
+            for _ in range(L):
+                blk = nn.Module()
+                blk.ln1 = nn.Parameter(torch.ones(D))
+                blk.qkv = nn.Linear(D, (H + 2 * K) * HD, bias=True)
+                blk.dense = nn.Linear(H * HD, D, bias=False)
+                blk.ln2 = nn.Parameter(torch.ones(D))
+                blk.h4 = nn.Linear(D, 2 * F, bias=False)
+                blk.h4o = nn.Linear(F, D, bias=False)
+                self.layers.append(blk)
+            self.lnf = nn.Parameter(torch.ones(D))
+            self.out = nn.Linear(D, V, bias=False)
+
+        def forward(self, tokens):
+            Bq, Sq = tokens.shape
+            x = self.embed(tokens)
+            cache = _rotary_cache(Sq, HD // 2)
+            causal = torch.tril(torch.ones(Sq, Sq, dtype=torch.bool))
+            for blk in self.layers:
+                h = _rms(x, blk.ln1)
+                qkv = blk.qkv(h)
+                q = qkv[..., :H * HD].view(Bq, Sq, H, HD)
+                k = qkv[..., H * HD:(H + K) * HD].view(Bq, Sq, K, HD)
+                v = qkv[..., (H + K) * HD:].view(Bq, Sq, K, HD)
+                q = _apply_rotary(q, cache)
+                k = _apply_rotary(k, cache)
+                # kv group g serves q heads [g*ratio, (g+1)*ratio)
+                k = k.repeat_interleave(H // K, dim=2)
+                v = v.repeat_interleave(H // K, dim=2)
+                scores = torch.einsum('bqhd,bkhd->bhqk', q.float(),
+                                      k.float()) / (HD ** 0.5)
+                scores = scores.masked_fill(~causal, float('-inf'))
+                probs = torch.softmax(scores, dim=-1)
+                attn = torch.einsum('bhqk,bkhd->bqhd', probs, v.float())
+                x = x + blk.dense(
+                    attn.reshape(Bq, Sq, H * HD).to(x.dtype))
+                h2 = _rms(x, blk.ln2)
+                gate, up = blk.h4(h2).chunk(2, dim=-1)
+                x = x + blk.h4o(torch.nn.functional.silu(gate) * up)
+            return self.out(_rms(x, self.lnf))
+
+    torch.manual_seed(0)
+    model = TinyChatGLM2().eval()
+    toks = np.random.RandomState(0).randint(0, V, (B, S))
+    return torch, model, toks
+
+
+def torch_state_dict(model):
+    """Checkpoint-name -> numpy, matching _CHATGLM_MAP."""
+    pre = 'transformer.encoder.layers'
+    sd = {'transformer.embedding.word_embeddings.weight':
+          model.embed.weight,
+          'transformer.encoder.final_layernorm.weight': model.lnf,
+          'transformer.output_layer.weight': model.out.weight}
+    for i, blk in enumerate(model.layers):
+        sd[f'{pre}.{i}.input_layernorm.weight'] = blk.ln1
+        sd[f'{pre}.{i}.self_attention.query_key_value.weight'] = \
+            blk.qkv.weight
+        sd[f'{pre}.{i}.self_attention.query_key_value.bias'] = blk.qkv.bias
+        sd[f'{pre}.{i}.self_attention.dense.weight'] = blk.dense.weight
+        sd[f'{pre}.{i}.post_attention_layernorm.weight'] = blk.ln2
+        sd[f'{pre}.{i}.mlp.dense_h_to_4h.weight'] = blk.h4.weight
+        sd[f'{pre}.{i}.mlp.dense_4h_to_h.weight'] = blk.h4o.weight
+    return {k: v.detach().numpy() for k, v in sd.items()}
+
+
+@pytest.mark.slow
+def test_chatglm2_torch_parity(tmp_path):
+    torch, model, toks = _torch_model_and_toks()
+    path = _write_checkpoint(torch_state_dict(model), tmp_path)
+    with torch.no_grad():
+        ref = model(torch.tensor(toks)).float().numpy()
+    cfg, params, ours = _jax_logits(path, toks)
+    scale = np.abs(ref).max()
+    np.testing.assert_allclose(ours, ref, rtol=0.0, atol=5e-3 * scale)
+    # greedy continuation parity via repeated torch forward
+    cur = torch.tensor(toks)
+    for _ in range(5):
+        with torch.no_grad():
+            nxt = model(cur)[:, -1].argmax(-1, keepdim=True)
+        cur = torch.cat([cur, nxt], dim=1)
+    ours_gen, _ = greedy_generate(params, cfg, jnp.asarray(toks),
+                                  jnp.ones((B, S), bool), 5)
+    np.testing.assert_array_equal(np.asarray(ours_gen),
+                                  cur[:, S:].numpy())
