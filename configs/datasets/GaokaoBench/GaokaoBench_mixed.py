@@ -1,0 +1,10 @@
+# direct-answer bundle: GaokaoBench_gen with an answer-only instruction appended
+from opencompass_tpu.config import read_base
+from opencompass_tpu.utils import prompt_variants as pv
+
+with read_base():
+    from .GaokaoBench_gen import GaokaoBench_datasets as _base_datasets
+
+GaokaoBench_datasets = pv.suffix_prompts(
+    pv.derive(_base_datasets, 'mixed'),
+    '请直接给出最终答案，不要写出推理过程。')

@@ -1,0 +1,10 @@
+# strict answer-format variant of GaokaoBench_mixed
+from opencompass_tpu.config import read_base
+from opencompass_tpu.utils import prompt_variants as pv
+
+with read_base():
+    from .GaokaoBench_gen import GaokaoBench_datasets as _base_datasets
+
+GaokaoBench_datasets = pv.suffix_prompts(
+    pv.derive(_base_datasets, 'mixed-strict'),
+    '请只输出答案本身。')

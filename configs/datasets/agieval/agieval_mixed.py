@@ -1,0 +1,10 @@
+# direct-answer bundle: agieval_gen with an answer-only instruction appended
+from opencompass_tpu.config import read_base
+from opencompass_tpu.utils import prompt_variants as pv
+
+with read_base():
+    from .agieval_gen import agieval_datasets as _base_datasets
+
+agieval_datasets = pv.suffix_prompts(
+    pv.derive(_base_datasets, 'mixed'),
+    '\nGive only the final answer; do not show your reasoning.')

@@ -1,0 +1,10 @@
+# strict answer-format variant of agieval_mixed
+from opencompass_tpu.config import read_base
+from opencompass_tpu.utils import prompt_variants as pv
+
+with read_base():
+    from .agieval_gen import agieval_datasets as _base_datasets
+
+agieval_datasets = pv.suffix_prompts(
+    pv.derive(_base_datasets, 'mixed-strict'),
+    '\nOutput the answer itself and nothing else.')

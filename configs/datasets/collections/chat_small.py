@@ -1,0 +1,13 @@
+# chat-oriented small bundle: generation-mode tasks an instruction-tuned
+# model answers conversationally (reference collections/chat_small.py)
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from ..mmlu.mmlu_gen import mmlu_datasets
+    from ..gsm8k.gsm8k_gen import gsm8k_datasets
+    from ..triviaqa.triviaqa_gen import triviaqa_datasets
+    from ..nq.nq_gen import nq_datasets
+    from ..race.race_gen import race_datasets
+
+datasets = sum((v for k, v in locals().items() if k.endswith('_datasets')),
+               [])

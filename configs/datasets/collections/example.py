@@ -1,0 +1,10 @@
+# tiny two-family bundle for docs/smoke runs (reference
+# configs/datasets/collections/example.py equivalent)
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from ..siqa.siqa_gen import siqa_datasets
+    from ..winograd.winograd_ppl import winograd_datasets
+
+datasets = sum((v for k, v in locals().items() if k.endswith('_datasets')),
+               [])

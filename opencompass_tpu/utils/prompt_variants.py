@@ -1,0 +1,108 @@
+"""Transforms for deriving prompt-variant dataset configs.
+
+The reference maintains its config breadth as hand-copied files differing
+in prompt phrasing, shot count, or answer format (e.g.
+reference configs/datasets/mmlu/ ships several ``*_gen_<hash>.py``
+variants of one task).  Here variants are *derived*: a generated config
+``read_base``s the family's base file and applies one of these transforms,
+so the intent of each variant is explicit and the long tail stays
+maintainable.  Used by tools/gen_dataset_configs.py.
+
+Every transform returns a deep copy and never mutates its input, so a
+``read_base``-imported base list stays intact; ``derive`` additionally
+re-abbreviates so a variant's results/predictions land in their own
+files.
+"""
+from __future__ import annotations
+
+import copy
+from typing import List
+
+
+def derive(datasets: List[dict], suffix: str) -> List[dict]:
+    """Deep-copied dataset list with ``-suffix`` appended to every abbr."""
+    out = copy.deepcopy(list(datasets))
+    for d in out:
+        base = d.get('abbr') or getattr(d['type'], '__name__', str(d['type']))
+        d['abbr'] = f'{base}-{suffix}'
+    return out
+
+
+def _map_prompts(template, fn, where: str):
+    """Apply ``fn`` to the first/last prompt string of one template.
+
+    Handles the three template shapes (icl/prompt_template.py): plain
+    string, label-keyed dict of alternatives (each alternative is a full
+    prompt → mapped independently), and meta dicts with begin/round/end
+    message lists.
+    """
+    if isinstance(template, str):
+        return fn(template)
+    if isinstance(template, dict):
+        if 'round' in template or 'begin' in template:
+            new = dict(template)
+            msgs = list(new.get('round', []))
+            idx_iter = range(len(msgs)) if where == 'first' \
+                else range(len(msgs) - 1, -1, -1)
+            for i in idx_iter:
+                m = msgs[i]
+                if isinstance(m, dict) and isinstance(m.get('prompt'), str):
+                    msgs[i] = dict(m, prompt=fn(m['prompt']))
+                    break
+                if isinstance(m, str):
+                    msgs[i] = fn(m)
+                    break
+            new['round'] = msgs
+            return new
+        return {label: _map_prompts(t, fn, where)
+                for label, t in template.items()}
+    return template
+
+
+def _transform_templates(datasets, fn, where):
+    out = copy.deepcopy(list(datasets))
+    for d in out:
+        infer = d['infer_cfg']
+        # without a prompt_template the ice_template renders the prompt
+        # (icl/retrievers semantics), so the transform applies there
+        tpl_cfg = infer.get('prompt_template') or infer['ice_template']
+        tpl_cfg['template'] = _map_prompts(tpl_cfg['template'], fn, where)
+    return out
+
+
+def prefix_prompts(datasets: List[dict], text: str) -> List[dict]:
+    """Prepend an instruction to every prompt (before any in-context
+    examples; for PPL label alternatives the same constant prefix
+    conditions every label, so the argmin comparison stays balanced)."""
+    return _transform_templates(datasets, lambda s: text + s, 'first')
+
+
+def suffix_prompts(datasets: List[dict], text: str) -> List[dict]:
+    """Append an answer-format instruction to the final prompt message.
+    Generation-mode only: in PPL mode a suffix would land inside the
+    scored answer region."""
+    for d in datasets:
+        inferencer = str(d['infer_cfg']['inferencer'].get('type', ''))
+        if 'PPL' in inferencer:
+            raise ValueError('suffix_prompts is for generation configs; '
+                             f'{d.get("abbr")} scores PPL')
+    return _transform_templates(datasets, lambda s: s + text, 'last')
+
+
+def few_shot(datasets: List[dict], k: int) -> List[dict]:
+    """Switch to a FixKRetriever over the first ``k`` train examples.
+    The base config must support in-context examples (an ice_token in the
+    prompt template, or a separate ice_template)."""
+    from opencompass_tpu.icl.retrievers import FixKRetriever
+    out = copy.deepcopy(list(datasets))
+    for d in out:
+        infer = d['infer_cfg']
+        has_ice = ('ice_template' in infer
+                   or infer.get('prompt_template', {}).get('ice_token'))
+        if not has_ice:
+            raise ValueError(
+                f'{d.get("abbr")}: base config has no ice_token/'
+                'ice_template; cannot derive a few-shot variant')
+        infer['retriever'] = dict(type=FixKRetriever,
+                                  fix_id_list=list(range(k)))
+    return out

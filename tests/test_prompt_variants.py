@@ -1,0 +1,58 @@
+"""Variant transforms: purity (no input mutation), abbr suffixing, and
+template-shape coverage."""
+import copy
+
+import pytest
+
+from opencompass_tpu.utils import prompt_variants as pv
+
+
+def _entry(template, **infer_extra):
+    infer = dict(prompt_template=dict(type='PromptTemplate',
+                                      template=template,
+                                      ice_token='</E>'),
+                 retriever=dict(type='ZeroRetriever'),
+                 inferencer=dict(type='GenInferencer'))
+    infer.update(infer_extra)
+    return dict(abbr='toy', type='Toy',
+                reader_cfg=dict(input_columns=['q'], output_column='a'),
+                infer_cfg=infer)
+
+
+def test_transforms_do_not_mutate_input():
+    base = [_entry('</E>Q: {q}\nA:')]
+    snapshot = copy.deepcopy(base)
+    pv.few_shot(pv.prefix_prompts(pv.derive(base, 'v'), 'X\n'), 3)
+    pv.suffix_prompts(base, '\nY')
+    assert base == snapshot
+
+
+def test_prefix_covers_all_template_shapes():
+    s = pv.prefix_prompts([_entry('Q: {q}\nA:')], 'I\n')
+    assert s[0]['infer_cfg']['prompt_template']['template'] == 'I\nQ: {q}\nA:'
+    r = pv.prefix_prompts(
+        [_entry(dict(round=[dict(role='HUMAN', prompt='Q: {q}')]))], 'I\n')
+    assert r[0]['infer_cfg']['prompt_template']['template']['round'][0][
+        'prompt'] == 'I\nQ: {q}'
+    lbl = pv.prefix_prompts([_entry({'A': 'p {q} A', 'B': 'p {q} B'})],
+                            'I\n')
+    tpl = lbl[0]['infer_cfg']['prompt_template']['template']
+    assert tpl == {'A': 'I\np {q} A', 'B': 'I\np {q} B'}
+
+
+def test_suffix_rejects_ppl_and_appends_for_gen():
+    g = pv.suffix_prompts([_entry('Q: {q}\nA:')], ' S')
+    assert g[0]['infer_cfg']['prompt_template']['template'].endswith(' S')
+    ppl = _entry({'A': 'x'})
+    ppl['infer_cfg']['inferencer'] = dict(type='PPLInferencer')
+    with pytest.raises(ValueError):
+        pv.suffix_prompts([ppl], ' S')
+
+
+def test_few_shot_requires_ice_support():
+    no_ice = _entry('Q: {q}\nA:')
+    no_ice['infer_cfg']['prompt_template'].pop('ice_token')
+    with pytest.raises(ValueError):
+        pv.few_shot([no_ice], 3)
+    ok = pv.few_shot([_entry('</E>Q: {q}\nA:')], 4)
+    assert ok[0]['infer_cfg']['retriever']['fix_id_list'] == [0, 1, 2, 3]
