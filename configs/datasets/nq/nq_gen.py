@@ -10,9 +10,10 @@ nq_reader_cfg = dict(input_columns=['question'], output_column='answer',
 nq_infer_cfg = dict(
     ice_template=dict(
         type=PromptTemplate,
+        ice_token='</E>',
         template=dict(round=[
             dict(role='HUMAN',
-                 prompt='Answer these questions:\nQ: {question}?\nA: '),
+                 prompt='</E>Answer these questions:\nQ: {question}?\nA: '),
             dict(role='BOT', prompt='{answer}'),
         ])),
     retriever=dict(type=ZeroRetriever),

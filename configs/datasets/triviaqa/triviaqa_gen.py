@@ -10,8 +10,9 @@ triviaqa_reader_cfg = dict(input_columns=['question'], output_column='answer',
 triviaqa_infer_cfg = dict(
     ice_template=dict(
         type=PromptTemplate,
+        ice_token='</E>',
         template=dict(round=[
-            dict(role='HUMAN', prompt='Answer these questions:\nQ: {question}\nA: '),
+            dict(role='HUMAN', prompt='</E>Answer these questions:\nQ: {question}\nA: '),
             dict(role='BOT', prompt='{answer}'),
         ])),
     retriever=dict(type=ZeroRetriever),

@@ -1,0 +1,37 @@
+"""Suite-scale wallclock benchmark: ~120 tasks through the full
+partition → infer → eval → summarize pipeline.
+
+Pairs with `tools/make_synth_data.py` fixtures so the whole suite runs
+offline; the model is FakeModel, so the measured wallclock is pure
+framework overhead (scheduling, prompt rendering, shard stitching,
+summarizing) — the per-sample model time is what bench.py measures on
+real hardware.  Results are recorded in BASELINE_RUN.md.
+
+    python tools/make_synth_data.py --rows 16
+    python run.py configs/eval_suite_wallclock.py --max-partition-size 64
+"""
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from .datasets.mmlu.mmlu_ppl import mmlu_datasets          # 57 tasks
+    from .datasets.ceval.ceval_gen import ceval_datasets       # 52 tasks
+    from .datasets.arc.arc_ppl import arc_datasets
+    from .datasets.SuperGLUE_BoolQ.BoolQ_ppl_letter import BoolQ_datasets
+    from .datasets.gsm8k.gsm8k_gen import gsm8k_datasets
+    from .datasets.math.math_gen import math_datasets
+    from .datasets.humaneval.humaneval_gen import humaneval_datasets
+    from .datasets.triviaqa.triviaqa_gen import triviaqa_datasets
+    from .datasets.nq.nq_gen import nq_datasets
+    from .summarizers.groups.mmlu import mmlu_summary_groups
+    from .summarizers.groups.ceval import ceval_summary_groups
+
+datasets = sum((v for k, v in list(locals().items())
+                if k.endswith('_datasets')), [])
+
+models = [dict(abbr='fake-suite', type='FakeModel', max_out_len=64,
+               batch_size=8, run_cfg=dict(num_devices=0, num_procs=1))]
+
+summarizer = dict(
+    summary_groups=[*mmlu_summary_groups, *ceval_summary_groups])
+
+work_dir = './outputs/suite_wallclock'

@@ -47,6 +47,13 @@ def _map_prompts(template, fn, where: str):
             for i in idx_iter:
                 m = msgs[i]
                 if isinstance(m, dict) and isinstance(m.get('prompt'), str):
+                    # never touch BOT turns: in gen mode the prompt is
+                    # truncated at the generate point, so text appended
+                    # to a trailing BOT '{answer}' would be a silent
+                    # no-op (and in scored modes it would pollute the
+                    # answer region)
+                    if m.get('role', '').upper() == 'BOT':
+                        continue
                     msgs[i] = dict(m, prompt=fn(m['prompt']))
                     break
                 if isinstance(m, str):
@@ -83,9 +90,10 @@ def suffix_prompts(datasets: List[dict], text: str) -> List[dict]:
     scored answer region."""
     for d in datasets:
         inferencer = str(d['infer_cfg']['inferencer'].get('type', ''))
-        if 'PPL' in inferencer:
+        if 'PPL' in inferencer or 'CLP' in inferencer:
             raise ValueError('suffix_prompts is for generation configs; '
-                             f'{d.get("abbr")} scores PPL')
+                             f'{d.get("abbr")} scores completions '
+                             f'({inferencer})')
     return _transform_templates(datasets, lambda s: s + text, 'last')
 
 

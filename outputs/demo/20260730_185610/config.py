@@ -1,0 +1,160 @@
+demo_gen_datasets = [
+    {
+        'type': 'opencompass_tpu.datasets.demo.DemoDataset',
+        'abbr': 'demo-gen',
+        'reader_cfg': {
+            'input_columns': [
+                'question'
+            ],
+            'output_column': 'answer'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': 'Q: {question}\nA: {answer}\n'
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': '</E>Q: {question}\nA:',
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2
+                ]
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'max_out_len': 8
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.em.EMEvaluator'
+            }
+        }
+    }
+]
+demo_ppl_datasets = [
+    {
+        'type': 'opencompass_tpu.datasets.demo.DemoDataset',
+        'abbr': 'demo-ppl',
+        'reader_cfg': {
+            'input_columns': [
+                'question'
+            ],
+            'output_column': 'parity',
+            'test_range': '[0:8]'
+        },
+        'infer_cfg': {
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'even': 'Q: is {question} even or odd?\nA: even',
+                    'odd': 'Q: is {question} even or odd?\nA: odd'
+                }
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer'
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    }
+]
+datasets = [
+    {
+        'type': 'opencompass_tpu.datasets.demo.DemoDataset',
+        'abbr': 'demo-gen',
+        'reader_cfg': {
+            'input_columns': [
+                'question'
+            ],
+            'output_column': 'answer'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': 'Q: {question}\nA: {answer}\n'
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': '</E>Q: {question}\nA:',
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2
+                ]
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'max_out_len': 8
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.em.EMEvaluator'
+            }
+        }
+    },
+    {
+        'type': 'opencompass_tpu.datasets.demo.DemoDataset',
+        'abbr': 'demo-ppl',
+        'reader_cfg': {
+            'input_columns': [
+                'question'
+            ],
+            'output_column': 'parity',
+            'test_range': '[0:8]'
+        },
+        'infer_cfg': {
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'even': 'Q: is {question} even or odd?\nA: even',
+                    'odd': 'Q: is {question} even or odd?\nA: odd'
+                }
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer'
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    }
+]
+models = [
+    {
+        'type': 'opencompass_tpu.models.fake.FakeModel',
+        'abbr': 'fake-demo',
+        'path': 'fake',
+        'max_seq_len': 2048,
+        'batch_size': 4,
+        'canned_responses': {
+            'A:': '101'
+        },
+        'run_cfg': {
+            'num_devices': 0
+        }
+    }
+]
+work_dir = './outputs/demo/20260730_185610'

@@ -61,6 +61,14 @@ def test_dataset_config_loads(path):
                                         tpl_cfg.pop('type'))
                     assert tpl_type is not None
                     tpl_type(**tpl_cfg)
+            # an ice_template doubling as the prompt template must carry
+            # an ice_token or the retriever rejects it at run time
+            # (retrievers/base._pick_template; same contract as the
+            # reference's icl_base_retriever)
+            if 'prompt_template' not in infer and 'ice_template' in infer:
+                assert infer['ice_template'].get('ice_token'), \
+                    f'{ds.get("abbr")}: ice_template-only config needs ' \
+                    'an ice_token'
             if 'eval_cfg' in ds and 'evaluator' in ds['eval_cfg']:
                 ev = ds['eval_cfg']['evaluator']['type']
                 assert _resolve(ICL_EVALUATORS, ev) is not None, \
