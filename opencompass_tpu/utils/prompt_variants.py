@@ -84,17 +84,30 @@ def prefix_prompts(datasets: List[dict], text: str) -> List[dict]:
     return _transform_templates(datasets, lambda s: text + s, 'first')
 
 
+def _before_answer_cue(s: str, text: str) -> str:
+    """Insert ``text`` before a trailing answer cue ('A: ', 'Answer:',
+    '答：' …) so generation stays anchored to the cue; plain append when
+    no cue is present."""
+    import re
+    m = re.search(r'(\n[^\n]{0,40}[:：]\s*)$|^([^\n]{0,40}[:：]\s*)$', s)
+    if m:
+        return s[:m.start()] + text + s[m.start():]
+    return s + text
+
+
 def suffix_prompts(datasets: List[dict], text: str) -> List[dict]:
-    """Append an answer-format instruction to the final prompt message.
-    Generation-mode only: in PPL mode a suffix would land inside the
-    scored answer region."""
+    """Add an answer-format instruction at the end of the final prompt
+    message, kept BEFORE any trailing answer cue so the model still
+    generates at the cue.  Generation-mode only: in scored modes (PPL,
+    CLP) the text would land inside the scored answer region."""
     for d in datasets:
         inferencer = str(d['infer_cfg']['inferencer'].get('type', ''))
         if 'PPL' in inferencer or 'CLP' in inferencer:
             raise ValueError('suffix_prompts is for generation configs; '
                              f'{d.get("abbr")} scores completions '
                              f'({inferencer})')
-    return _transform_templates(datasets, lambda s: s + text, 'last')
+    return _transform_templates(
+        datasets, lambda s: _before_answer_cue(s, text), 'last')
 
 
 def few_shot(datasets: List[dict], k: int) -> List[dict]:

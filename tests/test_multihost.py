@@ -69,3 +69,95 @@ def test_two_process_sharded_eval(tmp_path):
     assert all(p > 0 for p in results[0]['ppl'])
     # write gating: exactly the rank-0 file exists
     assert (tmp_path / 'main_only.json').exists()
+
+
+_TASK_WORKER = r"""
+import json, os, sys
+sys.path.insert(0, {repo!r})
+from opencompass_tpu.parallel.distributed import (init_from_env,
+                                                  is_main_process, shutdown)
+rank = init_from_env()
+import jax
+assert len(jax.devices()) == 4, jax.devices()
+
+from datasets import Dataset, DatasetDict
+from opencompass_tpu.datasets.base import BaseDataset
+from opencompass_tpu.icl.prompt_template import PromptTemplate
+from opencompass_tpu.icl.retrievers import ZeroRetriever
+from opencompass_tpu.icl.inferencers import GenInferencer, PPLInferencer
+from opencompass_tpu.models import JaxLM
+
+class Toy(BaseDataset):
+    @staticmethod
+    def load():
+        rows = [dict(q=f'question number {{i}}', a='yes') for i in range(4)]
+        return DatasetDict(dict(train=Dataset.from_list(rows),
+                                test=Dataset.from_list(rows)))
+
+out = {out!r}
+ds = Toy(reader_cfg=dict(input_columns=['q'], output_column='a'))
+lm = JaxLM(config='tiny', max_seq_len=128, parallel=dict(data=2, model=2))
+
+# real PPL task: label-ranked scoring through the sharded model; only
+# rank 0 may write the predictions JSON
+tpl = PromptTemplate({{'yes': 'Q: {{q}}\nA: yes', 'no': 'Q: {{q}}\nA: no'}})
+ppl_inf = PPLInferencer(model=lm, batch_size=2, output_json_filepath=out,
+                        output_json_filename='ppl_predictions')
+ppl_preds = ppl_inf.inference(ZeroRetriever(ds), prompt_template=tpl)
+
+# real Gen task resuming from a pre-seeded tmp_ flush: the resume
+# decision is read by rank 0 and broadcast, so both ranks skip the same
+# samples and run the same number of batches
+gen_tpl = PromptTemplate('Q: {{q}}\nA: {{a}}')
+gen_inf = GenInferencer(model=lm, max_out_len=4, batch_size=2,
+                        output_json_filepath=out,
+                        output_json_filename='gen_predictions')
+gen_preds = gen_inf.inference(ZeroRetriever(ds), prompt_template=gen_tpl)
+
+print('RESULT ' + json.dumps(dict(rank=rank, main=is_main_process(),
+                                  ppl_preds=ppl_preds,
+                                  gen_preds=gen_preds)))
+shutdown()
+"""
+
+
+@pytest.mark.slow
+def test_two_process_real_ppl_and_resume(tmp_path):
+    """A real PPL task and a resumed Gen task across a 2-process group:
+    rank-0 write gating under the file-existence protocol, and the
+    broadcast resume decision keeping both ranks in lockstep."""
+    # pre-seed a partial gen flush: both ranks must resume past it
+    (tmp_path / 'tmp_gen_predictions').write_text(json.dumps({
+        '0': {'origin_prompt': 'p0', 'prediction': 'SAVED0'},
+        '1': {'origin_prompt': 'p1', 'prediction': 'SAVED1'},
+    }))
+    script = tmp_path / 'task_worker.py'
+    script.write_text(_TASK_WORKER.format(repo=REPO, out=str(tmp_path)))
+    env = dict(os.environ)
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=2'
+    proc = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.tasks.launch',
+         '--nprocs', '2', '--', sys.executable, str(script)],
+        capture_output=True, text=True, timeout=420, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+
+    results = {}
+    for line in proc.stdout.splitlines():
+        if 'RESULT ' in line:
+            rec = json.loads(line.split('RESULT ', 1)[1])
+            results[rec['rank']] = rec
+    assert sorted(results) == [0, 1], proc.stdout[-3000:]
+    # identical argmin-PPL predictions on both controllers
+    assert results[0]['ppl_preds'] == results[1]['ppl_preds']
+    assert set(results[0]['ppl_preds']) <= {'yes', 'no'}
+    # resume: the broadcast decision preserved the saved prefix on BOTH
+    # ranks, and the remaining samples were generated
+    for rank in (0, 1):
+        assert results[rank]['gen_preds'][:2] == ['SAVED0', 'SAVED1']
+        assert len(results[rank]['gen_preds']) == 4
+    # write gating: rank 0 produced the final files, tmp_ was cleaned up
+    assert (tmp_path / 'ppl_predictions').exists()
+    assert (tmp_path / 'gen_predictions').exists()
+    assert not (tmp_path / 'tmp_gen_predictions').exists()

@@ -41,8 +41,14 @@ def test_prefix_covers_all_template_shapes():
 
 
 def test_suffix_rejects_ppl_and_appends_for_gen():
-    g = pv.suffix_prompts([_entry('Q: {q}\nA:')], ' S')
-    assert g[0]['infer_cfg']['prompt_template']['template'].endswith(' S')
+    # with a trailing answer cue the instruction goes BEFORE the cue so
+    # generation stays anchored to it
+    g = pv.suffix_prompts([_entry('Q: {q}\nA:')], '\nS.')
+    assert g[0]['infer_cfg']['prompt_template']['template'] \
+        == 'Q: {q}\nS.\nA:'
+    # no cue: plain append
+    g2 = pv.suffix_prompts([_entry('Summarize {q}')], ' S')
+    assert g2[0]['infer_cfg']['prompt_template']['template'].endswith(' S')
     ppl = _entry({'A': 'x'})
     ppl['infer_cfg']['inferencer'] = dict(type='PPLInferencer')
     with pytest.raises(ValueError):
