@@ -65,6 +65,7 @@ _PEAK_TFLOPS = {'TPU v5 lite': 197.0, 'TPU v5': 459.0, 'TPU v4': 275.0,
 PPL_BATCH, PPL_SEQ, PPL_ITERS = 16, 512, 6
 GEN_BATCH, GEN_PROMPT, GEN_NEW = 32, 128, 64
 GEN_BATCH_HEADLINE = 128  # W8A8 + int4-KV throughput configuration
+LONG_SEQ, LONG_BATCH, LONG_ITERS = 2048, 4, 3  # long-context scoring leg
 
 
 def _param_count(cfg):
@@ -79,14 +80,15 @@ def _blend(a, b):
     return 2.0 / (1.0 / a + 1.0 / b)
 
 
-def _bench_ppl(params, cfg, iters, use_flash=True, batch=PPL_BATCH):
+def _bench_ppl(params, cfg, iters, use_flash=True, batch=PPL_BATCH,
+               seq=PPL_SEQ):
     @jax.jit
     def step(params, tokens, mask):
         logits = forward(params, cfg, tokens, mask, use_flash=use_flash)
         return sequence_nll(logits, tokens, mask)
 
-    tokens = jnp.ones((batch, PPL_SEQ), jnp.int32)
-    mask = jnp.ones((batch, PPL_SEQ), jnp.bool_)
+    tokens = jnp.ones((batch, seq), jnp.int32)
+    mask = jnp.ones((batch, seq), jnp.bool_)
     # host fetch (not block_until_ready) to fully drain compile + queue
     np.asarray(step(params, tokens, mask))
     t0 = time.perf_counter()
@@ -95,7 +97,7 @@ def _bench_ppl(params, cfg, iters, use_flash=True, batch=PPL_BATCH):
     np.asarray(out)
     dt = (time.perf_counter() - t0) / iters
     samples_per_sec = batch / dt
-    tflops = 2 * _param_count(cfg) * batch * PPL_SEQ / dt / 1e12
+    tflops = 2 * _param_count(cfg) * batch * seq / dt / 1e12
     return samples_per_sec, tflops
 
 
@@ -186,6 +188,11 @@ def main():
     ppl_sps, ppl_tflops = _bench_ppl(params, CFG_7B, PPL_ITERS)
     _, ppl_tflops_noflash = _bench_ppl(params, CFG_7B, PPL_ITERS,
                                        use_flash=False)
+    # long-context scoring leg: 4x the headline sequence through the
+    # flash kernel (the reference truncates instead; SURVEY §5
+    # long-context row)
+    long_sps, long_tflops = _bench_ppl(params, CFG_7B, LONG_ITERS,
+                                       batch=LONG_BATCH, seq=LONG_SEQ)
     gen_sps, gen_tps = _bench_gen(params, CFG_7B)
     del params
     jax.clear_caches()
@@ -246,6 +253,9 @@ def main():
             'ppl_mfu': round(ppl_tflops / peak, 3) if peak else None,
             'ppl_tflops_noflash': round(ppl_tflops_noflash, 1),
             'flash_speedup': round(ppl_tflops / ppl_tflops_noflash, 3),
+            'ppl_long_s%d_samples_per_sec' % LONG_SEQ:
+                round(long_sps, 3),
+            'ppl_long_s%d_tflops' % LONG_SEQ: round(long_tflops, 1),
             'gen_samples_per_sec': round(genhl_sps, 3),
             'gen_tokens_per_sec': round(genhl_tps, 1),
             'gen_quantize': 'W8A8 matmuls + int4 KV cache (per-vector '

@@ -39,24 +39,27 @@ class DLCRunner(CloudRunner):
                  lark_bot_url: str = None):
         import shlex
         aliyun_cfg = dict(aliyun_cfg or {})
+        # two shells parse this line: the submit host's (which sees the
+        # whole --command argument, quoted once below) and the WORKER's,
+        # which re-parses the inner string — so each interpolated value is
+        # also quoted individually, and the outer shlex.quote escapes the
+        # inner quotes correctly
         setup = []
         bashrc = aliyun_cfg.get('bashrc_path')
         if bashrc:
-            setup.append(f'source {bashrc}')
+            setup.append(f'source {shlex.quote(bashrc)}')
         conda_env = aliyun_cfg.get('conda_env_name')
         if conda_env:
-            setup.append(f'conda activate {conda_env}')
+            setup.append(f'conda activate {shlex.quote(conda_env)}')
         python_env = aliyun_cfg.get('python_env_path')
         if python_env:
-            setup.append(f'export PATH={python_env}/bin:$PATH')
+            setup.append(f'export PATH={shlex.quote(python_env)}/bin:$PATH')
         # bake in the submit host's cwd (shared filesystem assumption, as in
         # the reference) — a literal $PWD would expand on the worker to the
         # container's initial directory and break relative output paths
-        setup.append(f'cd {os.getcwd()}')
-        # the WHOLE inner command is quoted once (quoting fragments inside
-        # an already-quoted string would break at the first space); the
-        # {task_cmd} placeholder survives quoting and CloudRunner
-        # substitutes the tempfile-based task line inside the quotes
+        setup.append(f'cd {shlex.quote(os.getcwd())}')
+        # the {task_cmd} placeholder survives the outer quoting and
+        # CloudRunner substitutes the tempfile-based task line inside it
         shell = '; '.join(setup + ['{task_cmd}'])
         parts = [
             'dlc create job',

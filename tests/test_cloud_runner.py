@@ -67,3 +67,35 @@ def test_cloud_runner_requires_task_cmd_placeholder():
     with pytest.raises(ValueError, match='task_cmd'):
         CloudRunner(task=dict(type='OpenICLInferTask'),
                     submit_template='gcloud submit')
+
+
+def test_dlc_submit_line_quotes_config_values(monkeypatch, tmp_path):
+    """Paths with spaces/quotes in aliyun_cfg (or the cwd) must not split
+    the submit line: the whole inner command is shlex-quoted once and the
+    flag values individually."""
+    import shlex
+    from opencompass_tpu.runners.dlc import DLCRunner
+    weird = tmp_path / 'my dir'
+    weird.mkdir()
+    monkeypatch.chdir(weird)
+    runner = DLCRunner(
+        dict(type='OpenICLInferTask'),
+        aliyun_cfg=dict(bashrc_path='/home/my user/.bashrc',
+                        conda_env_name="eval's env",
+                        worker_image='repo/image:v1',
+                        workspace_id='ws 42'))
+    line = runner.submit_template
+    # the submit host's shell tokenizes the line cleanly...
+    final = line.replace('{task_cmd}', 'python -m opencompass_tpu.tasks c.py') \
+                .replace('{name}', 'n').replace('{num_devices}', '1')
+    toks = shlex.split(final)
+    assert toks[:3] == ['dlc', 'create', 'job']
+    assert toks[toks.index('--workspace_id') + 1] == 'ws 42'
+    # ...and the WORKER's shell re-parses the inner command, so each
+    # setup statement must tokenize back to intact values there too
+    cmd = toks[toks.index('--command') + 1]
+    stmts = [shlex.split(s.strip()) for s in cmd.split(';')]
+    assert stmts[0] == ['source', '/home/my user/.bashrc']
+    assert stmts[1] == ['conda', 'activate', "eval's env"]
+    assert stmts[2] == ['cd', str(weird)]
+    assert stmts[3] == ['python', '-m', 'opencompass_tpu.tasks', 'c.py']
