@@ -5,6 +5,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from opencompass_tpu.models import JaxLM
 from opencompass_tpu.nn import (TransformerConfig, forward, greedy_generate,
@@ -72,7 +73,6 @@ def test_jaxlm_quantize_end_to_end():
 
 def test_quantized_tensor_parallel_matches_single():
     if len(jax.devices()) < 2:
-        import pytest
         pytest.skip('needs multi-device mesh')
     tokens, mask = _data()
     params = quantize_params(init_params(CFG, jax.random.PRNGKey(0)), CFG)
@@ -184,7 +184,6 @@ def test_jaxlm_w8a8_kv4_end_to_end():
 
 
 def test_quantize_mode_validation():
-    import pytest
     with pytest.raises(ValueError):
         JaxLM(config='tiny', quantize='int4')  # int4 weights: not a mode
     with pytest.raises(ValueError):
@@ -213,8 +212,37 @@ def test_int4_weight_quantize_forward_close():
 
 
 def test_kv_quant_mode_validation():
-    import pytest
     bad = dataclasses.replace(CFG, kv_quant='int2')
     with pytest.raises(ValueError):
         bad.kv_quant_mode
     assert dataclasses.replace(CFG, kv_quant=True).kv_quant_mode == 'int8'
+
+
+@pytest.mark.slow
+def test_w8a8_ranking_agreement_at_scale():
+    """Stronger accuracy evidence for the W8A8 headline: at llama-512x4
+    scale, quantized scoring must rank a pool of candidate completions
+    like the full-precision (fp32 here, for bit-stable CPU math) path:
+    top choice identical, full ranking nearly so."""
+    cfg = TransformerConfig.llama(
+        vocab_size=2048, hidden_size=512, num_layers=4, num_heads=8,
+        num_kv_heads=8, intermediate_size=1408, max_seq_len=128,
+        dtype='float32')
+    cfga = dataclasses.replace(cfg, act_quant=True)
+    params = init_params(cfg, jax.random.PRNGKey(1))
+    qparams = quantize_params(params, cfg)
+    key = jax.random.PRNGKey(5)
+    tokens = jax.random.randint(key, (16, 24), 0, cfg.vocab_size)
+    mask = jnp.ones((16, 24), bool)
+    nll_fp = np.asarray(sequence_nll(
+        forward(params, cfg, tokens, mask, use_flash=False), tokens, mask))
+    nll_q = np.asarray(sequence_nll(
+        forward(qparams, cfga, tokens, mask, use_flash=False), tokens,
+        mask))
+    assert np.argmin(nll_q) == np.argmin(nll_fp)
+    # rank correlation over the candidate pool stays near-perfect
+    rank_fp = np.argsort(np.argsort(nll_fp))
+    rank_q = np.argsort(np.argsort(nll_q))
+    corr = np.corrcoef(rank_fp, rank_q)[0, 1]
+    assert corr > 0.95, f'rank correlation degraded: {corr}'
+    np.testing.assert_allclose(nll_q, nll_fp, rtol=0.05)
