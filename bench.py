@@ -49,6 +49,9 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 from opencompass_tpu.nn import (TransformerConfig, forward, greedy_generate,
                                 init_params, sequence_nll)
+from opencompass_tpu.nn.agreement import (eval_pool, forced_decode,
+                                          forced_stats, score_pool,
+                                          scoring_stats)
 
 CFG_7B = TransformerConfig.llama(
     vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
@@ -194,6 +197,23 @@ def main():
     long_sps, long_tflops = _bench_ppl(params, CFG_7B, LONG_ITERS,
                                        batch=LONG_BATCH, seq=LONG_SEQ)
     gen_sps, gen_tps = _bench_gen(params, CFG_7B)
+    jax.clear_caches()  # drop timed executables' program space first
+    # headline-accuracy leg (VERDICT r03 #1): the quantized configs the
+    # headline rides are scored for agreement against THIS bf16 model at
+    # full 7B geometry — scoring pool now, quantized halves below.
+    # Pool sizes chosen to fit next to the 13.5 GB weights on a 16 GB
+    # chip (see nn/agreement.py docstrings).
+    AG_ITEMS, AG_CHOICES = 32, 4
+    ag_tok, ag_mask, ag_prompts, ag_pmask = eval_pool(
+        CFG_7B, AG_ITEMS, AG_CHOICES, seq=128, gen_batch=16,
+        gen_prompt=GEN_PROMPT)
+    ag_nll_fp = score_pool(params, CFG_7B, ag_tok, ag_mask)
+    ag_forced = jax.jit(lambda p, t, m: greedy_generate(
+        p, CFG_7B, t, m, GEN_NEW, eos_token_id=None)[0])(
+            params, ag_prompts, ag_pmask)
+    ag_forced = jnp.asarray(np.asarray(ag_forced))
+    ag_lp_fp, ag_am_fp, ag_margin_fp, _ = forced_decode(
+        params, CFG_7B, ag_prompts, ag_pmask, ag_forced)
     del params
     jax.clear_caches()
 
@@ -224,6 +244,22 @@ def main():
     cfg_hl = dataclasses.replace(CFG_7B, kv_quant='int4', act_quant=True)
     genhl_sps, genhl_tps = _bench_gen(qparams, cfg_hl,
                                       batch=GEN_BATCH_HEADLINE)
+    jax.clear_caches()
+    # quantized halves of the headline-accuracy leg (same pool, same
+    # weights re-materialized as int8 from the same PRNG key)
+    ag_nll_q = score_pool(qparams, cfg_aq, ag_tok, ag_mask)
+    ag_lp_q, ag_am_q, _, ag_rank_q = forced_decode(
+        qparams, cfg_hl, ag_prompts, ag_pmask, ag_forced)
+    agreement = {
+        'scoring_w8a8_vs_bf16': scoring_stats(ag_nll_fp, ag_nll_q,
+                                              AG_CHOICES),
+        'forced_decode_w8a8kv4_vs_bf16': forced_stats(
+            ag_forced, ag_am_fp, ag_margin_fp, ag_lp_fp, ag_am_q,
+            ag_rank_q, ag_lp_q),
+        'pool': {'items': AG_ITEMS, 'choices': AG_CHOICES, 'seq': 128,
+                 'gen_rows': 16, 'gen_prompt': GEN_PROMPT,
+                 'gen_new': GEN_NEW},
+    }
     del qparams
     jax.clear_caches()
 
@@ -275,6 +311,7 @@ def main():
             'platform': jax.devices()[0].platform,
             'device_kind': kind,
             'peak_tflops': peak,
+            'quant_agreement': agreement,
             'a100_est': a100,
             'a100_est_b32': a100_b32,
             'small': {

@@ -54,10 +54,15 @@ def _pred_letters(pred: str) -> str:
     seg = _answer_segment(pred)
     if seg is not None:
         return _extract_letters(seg)
-    # bare short answer like 'b' or 'a,c': uppercase and read directly,
-    # matching the uppercased marked-segment path
+    # bare short answer like 'B', 'AC' or 'a,c': uppercase and read
+    # directly, matching the uppercased marked-segment path.  Lowercase
+    # letters only count when separator-delimited — an unseparated run
+    # like 'ace' or 'bag' is an ordinary English word, not an answer
+    # (uppercase runs like 'AC' are the standard multi-choice form)
     stripped = pred.strip()
-    if re.fullmatch(r'[A-Ga-g][\sA-Ga-g,，、和]*', stripped):
+    if re.fullmatch(r'[A-G][\sA-G,，、和]*', stripped) or \
+            re.fullmatch(r'[A-Ga-g](?:[\s,，、和]+[A-Ga-g])*[\s,，、和]*',
+                         stripped):
         return _extract_letters(stripped.upper())
     # unmarked prose: only standalone CAPITAL letters count — lowercase
     # matching would harvest the article 'a' out of ordinary English

@@ -20,8 +20,16 @@ class IceFitter:
     in-context example count.
 
     One instance serves one retriever pass: it owns the per-item example-id
-    lists and memoizes rendered ICE strings by (item, count) so repeated
-    fits (e.g. one per candidate label) re-render nothing.
+    lists and memoizes rendered ICE strings by count *within the current
+    item* — that serves the bisection's O(log n) probes of one fit.  Across
+    candidate labels (the PPL path iterates label-outer/item-inner, so the
+    item changes between fits) the memo does NOT survive; what carries over
+    is ``_ceiling``, which starts each later label's bisection at the
+    previous label's fitted count, so the common case is a single render
+    per label.  A cross-label memo would have to hold every item's ICE
+    block for a whole dataset pass (GBs on 100k-sample tasks), which is
+    why it is bounded to one item, same as the token caches in
+    models/jax_lm.py.
     """
 
     def __init__(self, ice_ids: List[List[int]], retriever, model,

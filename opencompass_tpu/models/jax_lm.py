@@ -34,8 +34,9 @@ import numpy as np
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from opencompass_tpu.nn import (TransformerConfig, forward, greedy_generate,
-                                init_params, sequence_nll, shard_params)
+from opencompass_tpu.nn import (TransformerConfig, beam_generate, forward,
+                                greedy_generate, init_params, sequence_nll,
+                                shard_params)
 from opencompass_tpu.parallel.mesh import MeshSpec, make_mesh, use_mesh
 from opencompass_tpu.registry import MODELS
 from opencompass_tpu.utils.logging import get_logger
@@ -301,10 +302,11 @@ class JaxLM(BaseModel):
                 sequence_nll(logits, tokens, mask, mask_length))
         return ppl
 
-    def _gen_fn(self, max_new: int, temperature: float, top_k: int):
+    def _gen_fn(self, max_new: int, temperature: float, top_k: int,
+                num_beams: int = 1, length_penalty: float = 1.0):
         # per-instance cache (a class-level lru_cache would pin `self` — and
         # its multi-GB param pytree — alive across model swaps)
-        key = (max_new, temperature, top_k)
+        key = (max_new, temperature, top_k, num_beams, length_penalty)
         fn = self._gen_fn_cache.get(key)
         if fn is not None:
             return fn
@@ -314,10 +316,18 @@ class JaxLM(BaseModel):
 
         @jax.jit
         def gen(params, tokens, mask, rng):
-            out = greedy_generate(params, cfg, tokens, mask, max_new,
-                                  eos_token_id=eos, pad_token_id=pad,
-                                  temperature=temperature, top_k=top_k,
-                                  rng=rng)
+            if num_beams > 1:
+                # beam search is deterministic: rng unused (reference
+                # glm.py:166-285 BeamSearchStrategy semantics)
+                out = beam_generate(params, cfg, tokens, mask, max_new,
+                                    num_beams=num_beams,
+                                    eos_token_id=eos, pad_token_id=pad,
+                                    length_penalty=length_penalty)
+            else:
+                out = greedy_generate(params, cfg, tokens, mask, max_new,
+                                      eos_token_id=eos, pad_token_id=pad,
+                                      temperature=temperature,
+                                      top_k=top_k, rng=rng)
             return jax.tree_util.tree_map(self._replicate, out)
         self._gen_fn_cache[key] = gen
         return gen
@@ -480,11 +490,14 @@ class JaxLM(BaseModel):
             temperature = 0.0  # greedy
         top_k = int(gk.get('top_k', 0))
         seed = int(gk.get('seed', 0))
+        num_beams = int(gk.get('num_beams', 1))
+        length_penalty = float(gk.get('length_penalty', 1.0))
         with use_mesh(self.mesh):
             max_prompt = max(self.max_seq_len - max_out_len, 32)
             tokens, mask, ids = self._encode_batch(
                 inputs, left_pad=True, max_len=max_prompt)
-            fn = self._gen_fn(int(max_out_len), temperature, top_k)
+            fn = self._gen_fn(int(max_out_len), temperature, top_k,
+                              num_beams, length_penalty)
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
                              samples=len(inputs)):

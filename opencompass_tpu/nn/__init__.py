@@ -10,11 +10,12 @@ from .config import TransformerConfig
 from .transformer import (init_params, forward, prefill, decode_step,
                           init_cache)
 from .loss import sequence_nll
-from .decode import greedy_generate
+from .decode import beam_generate, greedy_generate
 from .sharding import param_shardings, shard_params
 
 __all__ = [
     'TransformerConfig', 'init_params', 'forward', 'prefill', 'decode_step',
     'init_cache',
-    'sequence_nll', 'greedy_generate', 'param_shardings', 'shard_params',
+    'sequence_nll', 'greedy_generate', 'beam_generate', 'param_shardings',
+    'shard_params',
 ]

@@ -1,0 +1,196 @@
+"""Quantized-vs-bf16 eval agreement measurement.
+
+The serving configs (``JaxLM(quantize='w8a8')`` scoring, ``'w8a8-kv4'``
+generation) only earn their bench headline if they preserve the eval
+semantics of the full-precision path — candidate ranking by mean
+per-token NLL (reference opencompass/models/huggingface.py:254-293) and
+greedy decode.  This module measures that agreement at any geometry;
+``tools/quant_agreement.py`` is the CLI, ``bench.py`` reports the same
+stats next to the headline, and ``tests/test_quant.py`` pins thresholds
+at llama-512x4 (hermetic) and 7B geometry (on-chip, slow-marked).
+
+Metric design notes (both matter when the weights are random-init):
+
+- Scoring pools of i.i.d. random choices contain statistical ties —
+  items whose bf16 best/runner-up gap is below the quantization noise
+  floor, where argmin is a coin flip for ANY perturbation (a different
+  chip or XLA version flips them too).  ``scoring_stats`` therefore
+  reports plain top-1 agreement AND 'decided' agreement over items with
+  > 0.5% relative margin — the regime real benchmark choices live in —
+  plus the margins of the flipped items, which should sit inside the
+  tie band.
+- Greedy decode is chaotic: one flipped token reroutes the suffix, and
+  random-init logits are near-uniform so most argmax decisions are
+  near-ties (even bf16 re-walking its own greedy output only reproduces
+  ~97% of steps at 7B — the prefill-vs-decode numerics alone flip the
+  rest).  ``forced_decode`` removes the chaos by walking both models
+  down the SAME token sequence, and the stats are margin-conditioned
+  the same way scoring is.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .loss import sequence_nll
+from .transformer import (decode_step, forward, init_cache, prefill,
+                          slot_positions)
+
+
+def eval_pool(cfg, items, choices, seq, gen_batch, gen_prompt, seed=1234):
+    """Deterministic random eval pool shared by the compared phases."""
+    rng = np.random.RandomState(seed)
+    tokens = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (items * choices, seq)), jnp.int32)
+    mask = jnp.ones(tokens.shape, jnp.bool_)
+    prompts = jnp.asarray(
+        rng.randint(0, cfg.vocab_size, (gen_batch, gen_prompt)), jnp.int32)
+    pmask = jnp.ones(prompts.shape, jnp.bool_)
+    return tokens, mask, prompts, pmask
+
+
+def score_pool(params, cfg, tokens, mask, chunk=32):
+    """Per-sequence mean NLL, chunked so the fp32 log-softmax over the
+    vocab fits next to the 7B weights (256 x 128 in one shot needs
+    ~21 GB on a 16 GB chip; even 64 x 128 misses by kilobytes)."""
+    step = jax.jit(lambda p, t, m: sequence_nll(
+        forward(p, cfg, t, m), t, m))
+    outs = [np.asarray(step(params, tokens[i:i + chunk],
+                            mask[i:i + chunk]), np.float64)
+            for i in range(0, tokens.shape[0], chunk)]
+    return np.concatenate(outs)
+
+
+def forced_decode(params, cfg, prompts, pmask, forced):
+    """Teacher-forced re-walk of ``forced`` through the decode-cache path.
+
+    Mirrors greedy_generate's loop (nn/decode.py) but feeds the given
+    tokens instead of sampling, so two models can be compared on
+    identical prefixes at every step.  Returns per-step (B, T) arrays:
+    logprob of the forced token, argmax, top1-top2 margin, and the
+    forced token's rank in this model's ordering (0 = it IS the argmax).
+    """
+    B, S = prompts.shape
+    T = forced.shape[1]
+    total = S + T
+    use_kv_pos = cfg.positional == 'alibi'
+
+    def lp_am(logits, tok):
+        lf = logits.astype(jnp.float32)
+        lse = jax.nn.log_softmax(lf, axis=-1)
+        tok = tok[:, None].astype(jnp.int32)
+        lp = jnp.take_along_axis(lse, tok, axis=-1)[:, 0]
+        top2 = jax.lax.top_k(lf, 2)[0]
+        margin = top2[:, 0] - top2[:, 1]        # argmax decisiveness
+        rank = jnp.sum(lf > jnp.take_along_axis(lf, tok, axis=-1),
+                       axis=-1)
+        return (lp, jnp.argmax(lf, axis=-1).astype(jnp.int32), margin,
+                rank.astype(jnp.int32))
+
+    @jax.jit
+    def run(params, prompts, pmask, forced):
+        cache = init_cache(cfg, B, total)
+        logits, cache, next_pos = prefill(params, cfg, prompts, pmask,
+                                          cache)
+        o0 = lp_am(logits, forced[:, 0])
+        kv_valid = jnp.zeros((B, total), jnp.bool_)
+        kv_valid = jax.lax.dynamic_update_slice_in_dim(
+            kv_valid, pmask.astype(jnp.bool_), 0, axis=1)
+        # ALiBi models need per-slot positions, same as nn/decode.py
+        kv_pos = (slot_positions(pmask, total) if use_kv_pos
+                  else jnp.zeros((B, 0), jnp.int32))
+
+        def body(carry, step):
+            cache, kv_valid, kv_pos, positions = carry
+            token = jax.lax.dynamic_index_in_dim(forced, step - 1, axis=1,
+                                                 keepdims=False)
+            slot = S + step - 1
+            is_slot = jnp.arange(total)[None, :] == slot
+            kv_valid = kv_valid | is_slot
+            if use_kv_pos:
+                kv_pos = jnp.where(is_slot, positions[:, None], kv_pos)
+            logits, cache = decode_step(params, cfg, token, cache, slot,
+                                        positions, kv_valid,
+                                        kv_positions=kv_pos if use_kv_pos
+                                        else None)
+            tgt = jax.lax.dynamic_index_in_dim(forced, step, axis=1,
+                                               keepdims=False)
+            return (cache, kv_valid, kv_pos, positions + 1), \
+                lp_am(logits, tgt)
+
+        _, outs = jax.lax.scan(
+            body, (cache, kv_valid, kv_pos, next_pos), jnp.arange(1, T))
+        # each stream: (T-1, B) scanned + (B,) prefill step -> (B, T)
+        return tuple(jnp.concatenate([first[None], rest], axis=0).T
+                     for first, rest in zip(o0, outs))
+
+    lps, ams, margins, ranks = run(params, prompts, pmask, forced)
+    return (np.asarray(lps, np.float64), np.asarray(ams),
+            np.asarray(margins, np.float64), np.asarray(ranks))
+
+
+def scoring_stats(nll_fp, nll_q, choices):
+    """Agreement stats between two per-sequence NLL vectors."""
+    items = nll_fp.reshape(-1, choices)
+    items_q = nll_q.reshape(-1, choices)
+    agree = items.argmin(1) == items_q.argmin(1)
+    top1 = float(agree.mean())
+    rank_fp = np.argsort(np.argsort(nll_fp))
+    rank_q = np.argsort(np.argsort(nll_q))
+    corr = float(np.corrcoef(rank_fp, rank_q)[0, 1])
+    rel = np.abs(nll_q - nll_fp) / np.maximum(nll_fp, 1e-9)
+    srt = np.sort(items, axis=1)
+    margin = (srt[:, 1] - srt[:, 0]) / np.maximum(srt[:, 0], 1e-9)
+    decided = margin > 0.005
+    flips = margin[~agree]
+    return {
+        'top1_agreement': top1,
+        'decided_top1_agreement':
+            float(agree[decided].mean()) if decided.any() else None,
+        'n_decided_items': int(decided.sum()),
+        'n_items': int(len(agree)),
+        'max_flip_margin': round(float(flips.max()), 6) if len(flips)
+            else 0.0,
+        'rank_correlation': round(corr, 5),
+        'median_rel_dnll': round(float(np.median(rel)), 6),
+        'p95_rel_dnll': round(float(np.percentile(rel, 95)), 6),
+        'max_rel_dnll': round(float(rel.max()), 6),
+    }
+
+
+def gen_stats(out_fp, out_q):
+    """Free-running greedy-trajectory agreement between (B, T) grids.
+
+    A lower bound, not the decode-quality metric — see module docstring.
+    """
+    match = out_fp == out_q
+    ever = (~match).cumsum(axis=1) == 0        # True until first mismatch
+    first_div = ever.sum(axis=1)               # == T when identical
+    return {
+        'token_match_rate': round(float(match.mean()), 4),
+        'identical_seq_frac': round(float(match.all(axis=1).mean()), 4),
+        'mean_first_divergence_step': round(float(first_div.mean()), 2),
+        'median_first_divergence_step': float(np.median(first_div)),
+        'n_new_tokens': int(out_fp.shape[1]),
+    }
+
+
+def forced_stats(forced, am_fp, margin_fp, lp_fp, am_q, rank_q, lp_q):
+    """Teacher-forced decode agreement — the decode-quality metric."""
+    forced = np.asarray(forced)
+    dlp = np.abs(lp_q - lp_fp)
+    decided = margin_fp > 1.0
+    return {
+        'step_argmax_agreement': round(float((am_q == forced).mean()), 4),
+        'decided_step_agreement': round(float(
+            (am_q == forced)[decided].mean()), 4) if decided.any()
+            else None,
+        'n_decided_steps': int(decided.sum()),
+        'n_steps': int(forced.size),
+        'bf16_choice_in_quant_top5': round(float((rank_q < 5).mean()), 4),
+        'median_quant_rank_of_bf16_choice': float(np.median(rank_q)),
+        'bf16_self_consistency': round(float((am_fp == forced).mean()), 4),
+        'median_abs_dlogprob': round(float(np.median(dlp)), 5),
+        'p95_abs_dlogprob': round(float(np.percentile(dlp, 95)), 5),
+    }

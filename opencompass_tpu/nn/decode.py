@@ -106,11 +106,141 @@ def greedy_generate(params, cfg: TransformerConfig, tokens: jax.Array,
     step, _, _, _, _, _, _, out, _ = jax.lax.while_loop(cond, body, carry)
 
     if eos_token_id is not None:
-        # length = index of first EOS + 1, or max_new_tokens
-        is_eos = out == eos_token_id
-        any_eos = jnp.any(is_eos, axis=-1)
-        first_eos = jnp.argmax(is_eos, axis=-1)
-        lengths = jnp.where(any_eos, first_eos + 1, max_new_tokens)
+        lengths = _emitted_lengths(out, eos_token_id, max_new_tokens)
     else:
         lengths = jnp.full((B,), max_new_tokens)
+    return out, lengths
+
+
+def _emitted_lengths(out, eos_token_id, max_new_tokens):
+    """Emitted length over the trailing axis: first EOS index + 1, else
+    the budget.  Shared by the greedy and beam paths."""
+    is_eos = out == eos_token_id
+    any_eos = jnp.any(is_eos, axis=-1)
+    first_eos = jnp.argmax(is_eos, axis=-1)
+    return jnp.where(any_eos, first_eos + 1, max_new_tokens)
+
+
+def beam_generate(params, cfg: TransformerConfig, tokens: jax.Array,
+                  pad_mask: jax.Array, max_new_tokens: int,
+                  num_beams: int = 4,
+                  eos_token_id: Optional[int] = None,
+                  pad_token_id: int = 0,
+                  length_penalty: float = 1.0
+                  ) -> Tuple[jax.Array, jax.Array]:
+    """Batched beam search as a single jitted `lax.while_loop`.
+
+    Covers the reference's beam decoding strategy (reference
+    opencompass/models/glm.py:166-285: BeamSearchStrategy with length
+    penalty and end-token handling) the TPU way: static shapes
+    throughout — the B-row prompt is prefilled once, the KV cache is
+    tiled to B*num_beams rows, and each step does one batched
+    decode_step followed by a top-k over ``num_beams * vocab``
+    candidates and a gather-reorder of the cache along the batch axis.
+    Finished beams are frozen by forcing their only continuation to
+    ``pad_token_id`` at zero added score.  Hypothesis selection applies
+    GLM/HF-style length normalization ``score / len(tokens) **
+    length_penalty`` at the end.
+
+    tokens/pad_mask: (B, S) left-padded prompts.  Returns (out (B,
+    max_new_tokens) — the best beam per item, padded after EOS; lengths
+    (B,)).  Jit-safe with ``max_new_tokens``/``num_beams`` static.
+    """
+    B, S = tokens.shape
+    nb = num_beams
+    total = S + max_new_tokens
+    V = cfg.vocab_size
+    NEG = jnp.float32(-1e30)
+
+    cache = init_cache(cfg, B, total)
+    logits, cache, next_pos = prefill(params, cfg, tokens, pad_mask, cache)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    # beam-expand every per-row carry: row b's beams live at b*nb..b*nb+nb-1
+    # (cache leaves are (L, B, K, S, hd) — batch is axis 1)
+    tile = lambda x: jnp.repeat(x, nb, axis=0)
+    cache = {k: jnp.repeat(v, nb, axis=1) for k, v in cache.items()}
+    positions = tile(next_pos)
+
+    kv_valid = jnp.zeros((B, total), jnp.bool_)
+    kv_valid = jax.lax.dynamic_update_slice_in_dim(
+        kv_valid, pad_mask.astype(jnp.bool_), 0, axis=1)
+    kv_valid = tile(kv_valid)
+    use_kv_pos = cfg.positional == 'alibi'
+    kv_pos = (tile(slot_positions(pad_mask, total)) if use_kv_pos
+              else jnp.zeros((B * nb, 0), jnp.int32))
+
+    # first expansion: top nb tokens per row seed the beams
+    scores, first = jax.lax.top_k(logp, nb)          # (B, nb)
+    first = first.astype(tokens.dtype)
+    empty = ~jnp.any(pad_mask.astype(jnp.bool_), axis=-1)   # (B,)
+    first = jnp.where(empty[:, None], jnp.asarray(pad_token_id,
+                                                  first.dtype), first)
+    scores = jnp.where(empty[:, None], 0.0, scores)
+    done = jnp.broadcast_to(empty[:, None], (B, nb))
+    if eos_token_id is not None:
+        done = done | (first == eos_token_id)
+    out = jnp.full((B, nb, max_new_tokens), pad_token_id, tokens.dtype)
+    out = out.at[:, :, 0].set(first)
+
+    # a frozen beam's single continuation: pad token at zero added score
+    frozen_row = jnp.full((V,), NEG).at[pad_token_id].set(0.0)
+
+    def cond(carry):
+        step = carry[0]
+        return (step < max_new_tokens) & ~jnp.all(carry[6])
+
+    def body(carry):
+        (step, token, cache, kv_valid, kv_pos, positions, done, out,
+         scores) = carry
+        slot = S + step - 1
+        is_slot = jnp.arange(total)[None, :] == slot
+        kv_valid = kv_valid | is_slot
+        if use_kv_pos:
+            kv_pos = jnp.where(is_slot, positions[:, None], kv_pos)
+        logits, cache = decode_step(params, cfg, token, cache, slot,
+                                    positions, kv_valid,
+                                    kv_positions=kv_pos if use_kv_pos
+                                    else None)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        logp = jnp.where(done.reshape(B * nb)[:, None], frozen_row[None],
+                         logp)
+        cand = scores[:, :, None] + logp.reshape(B, nb, V)   # (B, nb, V)
+        scores, idx = jax.lax.top_k(cand.reshape(B, nb * V), nb)
+        beam_idx = idx // V                                   # (B, nb)
+        tok_idx = (idx % V).astype(token.dtype)
+
+        # reorder all per-beam state to the surviving beams
+        flat = (jnp.arange(B)[:, None] * nb + beam_idx).reshape(-1)
+        cache = {k: jnp.take(v, flat, axis=1) for k, v in cache.items()}
+        kv_valid = jnp.take(kv_valid, flat, axis=0)
+        if use_kv_pos:
+            kv_pos = jnp.take(kv_pos, flat, axis=0)
+        positions = jnp.take(positions, flat, axis=0)
+        done = jnp.take_along_axis(done, beam_idx, axis=1)
+        out = jnp.take_along_axis(out, beam_idx[:, :, None], axis=1)
+
+        nxt = jnp.where(done, jnp.asarray(pad_token_id, token.dtype),
+                        tok_idx)
+        out = jax.lax.dynamic_update_slice(
+            out, nxt[:, :, None], (0, 0, step))
+        if eos_token_id is not None:
+            done = done | (nxt == eos_token_id)
+        return (step + 1, nxt.reshape(B * nb), cache, kv_valid, kv_pos,
+                positions + 1, done, out, scores)
+
+    carry = (jnp.asarray(1), first.reshape(B * nb), cache, kv_valid,
+             kv_pos, positions, done, out, scores)
+    *_, done, out, scores = jax.lax.while_loop(cond, body, carry)
+
+    # length-normalized hypothesis selection
+    if eos_token_id is not None:
+        lens = _emitted_lengths(out, eos_token_id, max_new_tokens)  # (B,nb)
+    else:
+        lens = jnp.full((B, nb), max_new_tokens)
+    norm = scores / jnp.maximum(lens, 1).astype(jnp.float32) \
+        ** jnp.float32(length_penalty)
+    best = jnp.argmax(norm, axis=1)                              # (B,)
+    out = jnp.take_along_axis(out, best[:, None, None], axis=1)[:, 0]
+    lengths = jnp.take_along_axis(lens, best[:, None], axis=1)[:, 0]
     return out, lengths

@@ -63,8 +63,14 @@ def _quantize_weight(w, axis: int, mode: str):
     if isinstance(w, jax.core.Tracer) or not isinstance(w, jax.Array):
         xp = jnp if isinstance(w, jax.core.Tracer) else np
         # numpy has no int4: host copies of int4-mode weights stay
-        # int8-valued (already clipped to +-7, so a later on-device
-        # astype(int4) inside the loading jit is lossless)
+        # int8-valued, and no load path currently narrows them — they
+        # keep int8 storage on device (numerically identical, values
+        # already clipped to +-7; the int4 memory saving is only realized
+        # for device-array/traced inputs, where store_dtype is int4).
+        # int4 *weights* are not a shipped JaxLM mode anyway (the axon
+        # plugin can't pass int4 across the jit boundary; see
+        # models/jax_lm.py quantize validation) — the shipped int4 tier
+        # is the KV cache, which is created inside the decode program.
         store = np.int8 if xp is np else None
         return _quantize_math(w, axis, xp, mode, store_dtype=store)
     return jax.jit(functools.partial(_quantize_math, axis=axis, xp=jnp,

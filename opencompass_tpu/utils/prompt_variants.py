@@ -44,6 +44,7 @@ def _map_prompts(template, fn, where: str):
             msgs = list(new.get('round', []))
             idx_iter = range(len(msgs)) if where == 'first' \
                 else range(len(msgs) - 1, -1, -1)
+            transformed = False
             for i in idx_iter:
                 m = msgs[i]
                 if isinstance(m, dict) and isinstance(m.get('prompt'), str):
@@ -55,10 +56,20 @@ def _map_prompts(template, fn, where: str):
                     if m.get('role', '').upper() == 'BOT':
                         continue
                     msgs[i] = dict(m, prompt=fn(m['prompt']))
+                    transformed = True
                     break
                 if isinstance(m, str):
                     msgs[i] = fn(m)
+                    transformed = True
                     break
+            if not transformed:
+                # no 'round', or a round with only BOT / prompt-less
+                # turns: nothing was rewritten, and silently returning
+                # the template would let the variant generator count a
+                # byte-identical config as a real variant
+                raise ValueError(
+                    'meta template has no transformable round message: '
+                    f'{sorted(template)}')
             new['round'] = msgs
             return new
         return {label: _map_prompts(t, fn, where)

@@ -1,205 +1,16 @@
-"""CLI driver: config → partition → run → summarize.
+#!/usr/bin/env python
+"""Repo-root launcher: thin shim over :mod:`opencompass_tpu.cli`.
 
-Usage::
-
-    python run.py configs/eval_demo.py              # full pipeline
-    python run.py cfg.py -m infer                   # one phase
-    python run.py cfg.py -r [TIMESTAMP]             # resume a prior run
-    python run.py cfg.py --debug                    # serial, in-process
-    python run.py cfg.py --slurm -p PARTITION       # cluster launch
-
-Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
-Every phase is resumable because completion is keyed on output files
-(SURVEY.md appendix).  Parity: reference run.py:15-319.
+The driver itself lives in the package so the installed console script
+(``opencompass-tpu``, see pyproject.toml) and this in-repo entry point
+share one implementation.  Parity: reference run.py:15-319.
 """
-import argparse
-import getpass
 import os
-import os.path as osp
-from datetime import datetime
+import sys
 
-from opencompass_tpu.config import Config
-from opencompass_tpu.partitioners import NaivePartitioner, SizePartitioner
-from opencompass_tpu.registry import PARTITIONERS, RUNNERS
-from opencompass_tpu.runners import LocalRunner, SlurmRunner
-from opencompass_tpu.tasks import OpenICLEvalTask, OpenICLInferTask
-from opencompass_tpu.utils.logging import get_logger
-from opencompass_tpu.utils.summarizer import Summarizer
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-logger = get_logger()
-
-
-def parse_args():
-    parser = argparse.ArgumentParser(
-        description='Run an evaluation from a config file')
-    parser.add_argument('config', help='train config file path')
-    launcher = parser.add_mutually_exclusive_group()
-    launcher.add_argument('--slurm',
-                          action='store_true',
-                          default=False,
-                          help='submit tasks via slurm')
-    launcher.add_argument('--dlc',
-                          action='store_true',
-                          default=False,
-                          help='submit tasks via Aliyun DLC (uses the '
-                          "config's `aliyun_cfg` dict)")
-    parser.add_argument('-p', '--partition', help='slurm partition')
-    parser.add_argument('-q', '--quotatype', help='slurm quota type')
-    parser.add_argument('--debug',
-                        action='store_true',
-                        help='run tasks serially in-process with live '
-                        'output')
-    parser.add_argument('-m', '--mode',
-                        default='all',
-                        choices=['all', 'infer', 'eval', 'viz'],
-                        help='phases to run')
-    parser.add_argument('-r', '--reuse',
-                        nargs='?',
-                        type=str,
-                        const='latest',
-                        help='reuse previous outputs (timestamp or '
-                        '"latest")')
-    parser.add_argument('-w', '--work-dir',
-                        default=None,
-                        help='work dir (default outputs/default)')
-    parser.add_argument('--max-num-workers',
-                        type=int,
-                        default=16,
-                        help='max concurrent tasks')
-    parser.add_argument('--max-partition-size',
-                        type=int,
-                        default=2000,
-                        help='SizePartitioner task budget')
-    parser.add_argument('--gen-task-coef',
-                        type=int,
-                        default=20,
-                        help='SizePartitioner generation cost factor')
-    parser.add_argument('--num-devices',
-                        type=int,
-                        default=None,
-                        help='accelerator chips available to LocalRunner')
-    parser.add_argument('--retry',
-                        type=int,
-                        default=2,
-                        help='cluster task retry count')
-    parser.add_argument('--lark',
-                        action='store_true',
-                        help='enable webhook status reports')
-    parser.add_argument('--profile',
-                        action='store_true',
-                        help='record jax.profiler traces per infer task '
-                        '(under {work_dir}/profile/) in addition to the '
-                        'always-on perf counters')
-    return parser.parse_args()
-
-
-def get_config_from_arg(args) -> Config:
-    cfg = Config.fromfile(args.config)
-    if args.work_dir is not None:
-        cfg['work_dir'] = args.work_dir
-    else:
-        cfg.setdefault('work_dir', './outputs/default')
-    if not args.lark:
-        cfg.pop('lark_bot_url', None)
-    if args.profile:
-        cfg['profile'] = True
-    return cfg
-
-
-def _build_runner(task_type, args, cfg):
-    if args.slurm:
-        return SlurmRunner(dict(type=task_type),
-                           max_num_workers=args.max_num_workers,
-                           partition=args.partition,
-                           quotatype=args.quotatype,
-                           retry=args.retry,
-                           debug=args.debug,
-                           lark_bot_url=cfg.get('lark_bot_url'))
-    if args.dlc:
-        from opencompass_tpu.runners import DLCRunner
-        return DLCRunner(dict(type=task_type),
-                         aliyun_cfg=cfg.get('aliyun_cfg'),
-                         max_num_workers=args.max_num_workers,
-                         retry=args.retry,
-                         debug=args.debug,
-                         lark_bot_url=cfg.get('lark_bot_url'))
-    return LocalRunner(dict(type=task_type),
-                       max_num_workers=args.max_num_workers,
-                       num_devices=args.num_devices,
-                       debug=args.debug,
-                       retry=args.retry,
-                       task_timeout=cfg.get('task_timeout'),
-                       stall_timeout=cfg.get('stall_timeout'),
-                       lark_bot_url=cfg.get('lark_bot_url'))
-
-
-def exec_infer_runner(tasks, args, cfg):
-    runner = _build_runner('OpenICLInferTask', args, cfg)
-    runner(tasks)
-
-
-def exec_eval_runner(tasks, args, cfg):
-    runner = _build_runner('OpenICLEvalTask', args, cfg)
-    runner(tasks)
-
-
-def main():
-    args = parse_args()
-    cfg = get_config_from_arg(args)
-    work_dir = cfg['work_dir']
-
-    # timestamped run dir; -r points back at an old one
-    if args.reuse:
-        if args.reuse == 'latest':
-            dirs = sorted(d for d in os.listdir(work_dir)
-                          if osp.isdir(osp.join(work_dir, d))) \
-                if osp.isdir(work_dir) else []
-            if not dirs:
-                logger.warning('No previous results to reuse, starting '
-                               'fresh.')
-                dir_time_str = datetime.now().strftime('%Y%m%d_%H%M%S')
-            else:
-                dir_time_str = dirs[-1]
-        else:
-            dir_time_str = args.reuse
-    else:
-        dir_time_str = datetime.now().strftime('%Y%m%d_%H%M%S')
-    cfg['work_dir'] = osp.join(work_dir, dir_time_str)
-    os.makedirs(cfg['work_dir'], exist_ok=True)
-
-    # dump the resolved config for the record / reuse
-    cfg.dump(osp.join(cfg['work_dir'], 'config.py'))
-    logger.info(f'Current exp folder: {cfg["work_dir"]}')
-
-    if args.mode in ('all', 'infer'):
-        if 'infer' in cfg and 'partitioner' in cfg['infer']:
-            part_cfg = dict(cfg['infer']['partitioner'])
-            part_cfg['out_dir'] = osp.join(cfg['work_dir'], 'predictions/')
-            partitioner = PARTITIONERS.build(part_cfg)
-        else:
-            partitioner = SizePartitioner(
-                osp.join(cfg['work_dir'], 'predictions/'),
-                max_task_size=args.max_partition_size,
-                gen_task_coef=args.gen_task_coef)
-        tasks = partitioner(cfg)
-        if tasks:
-            exec_infer_runner(tasks, args, cfg)
-        else:
-            logger.info('All predictions already exist; skipping infer.')
-
-    if args.mode in ('all', 'eval'):
-        partitioner = NaivePartitioner(
-            osp.join(cfg['work_dir'], 'results/'))
-        tasks = partitioner(cfg)
-        if tasks:
-            exec_eval_runner(tasks, args, cfg)
-        else:
-            logger.info('All results already exist; skipping eval.')
-
-    if args.mode in ('all', 'eval', 'viz'):
-        summarizer = Summarizer(cfg)
-        summarizer.summarize(time_str=dir_time_str)
-
+from opencompass_tpu.cli import main  # noqa: E402
 
 if __name__ == '__main__':
     main()

@@ -1,6 +1,8 @@
 """Weight-only int8 quantization: numerics stay close to the full-precision
 model, decode runs, and tensor-parallel sharding accepts the int8 pytree."""
 import dataclasses
+import os
+import sys
 
 import jax
 import jax.numpy as jnp
@@ -216,6 +218,96 @@ def test_kv_quant_mode_validation():
     with pytest.raises(ValueError):
         bad.kv_quant_mode
     assert dataclasses.replace(CFG, kv_quant=True).kv_quant_mode == 'int8'
+
+
+def test_agreement_stats_math():
+    """Hermetic unit test of nn/agreement.py's stat functions."""
+    from opencompass_tpu.nn.agreement import gen_stats, scoring_stats
+    # two items, 2 choices: item 0 decided + agreeing, item 1 a tie flip
+    nll_fp = np.array([1.0, 2.0, 1.0, 1.0001])
+    nll_q = np.array([1.001, 2.001, 1.0002, 1.0001])
+    s = scoring_stats(nll_fp, nll_q, choices=2)
+    assert s['n_items'] == 2 and s['n_decided_items'] == 1
+    assert s['decided_top1_agreement'] == 1.0
+    assert s['top1_agreement'] == 0.5
+    assert s['max_flip_margin'] < 0.005  # the flip was a statistical tie
+    g = gen_stats(np.array([[1, 2, 3, 4]]), np.array([[1, 2, 9, 9]]))
+    assert g['token_match_rate'] == 0.5
+    assert g['identical_seq_frac'] == 0.0
+    assert g['mean_first_divergence_step'] == 2.0
+
+
+def test_forced_decode_self_consistency_tiny():
+    """Teacher-forcing the model's own greedy output through the decode
+    path reproduces it (per-step argmax == forced token) on the CPU mesh,
+    where the math is bit-stable."""
+    from opencompass_tpu.nn.agreement import forced_decode
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    tokens, mask = _data(B=2, S=8)
+    out, _ = jax.jit(
+        lambda p, t, m: greedy_generate(p, CFG, t, m, 8))(params, tokens,
+                                                          mask)
+    lp, am, margin, rank = forced_decode(params, CFG, tokens, mask, out)
+    assert am.shape == out.shape == rank.shape
+    assert (np.asarray(am) == np.asarray(out)).all()
+    assert (np.asarray(rank) == 0).all()
+    assert np.all(np.asarray(margin) >= 0)
+    assert np.all(np.isfinite(np.asarray(lp)))
+
+
+def test_forced_decode_alibi_runs():
+    """forced_decode mirrors greedy_generate's kv_positions carry for
+    ALiBi models (it raised without it)."""
+    from opencompass_tpu.nn.agreement import forced_decode
+    cfg = dataclasses.replace(CFG, positional='alibi')
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    tokens, mask = _data(B=2, S=8)
+    out, _ = jax.jit(
+        lambda p, t, m: greedy_generate(p, cfg, t, m, 4))(params, tokens,
+                                                          mask)
+    lp, am, margin, rank = forced_decode(params, cfg, tokens, mask, out)
+    assert (np.asarray(am) == np.asarray(out)).all()
+    assert np.all(np.isfinite(np.asarray(lp)))
+
+
+@pytest.mark.slow
+def test_w8a8_agreement_at_7b_geometry_on_tpu():
+    """VERDICT r03 #1: the headline's quantized recipes (W8A8 scoring,
+    W8A8+int4-KV decode) must preserve eval semantics at FULL 7B geometry
+    (4096x32) on the real chip, not just at 512x4.  Runs
+    tools/quant_agreement.py in a TPU subprocess (~2 min; the committed
+    record lives in QUANT_AGREEMENT_7B.json and next to the headline in
+    BENCH_r04.json's detail.quant_agreement)."""
+    import json
+    import subprocess
+    axon = os.environ.get('OC_TPU_AXON_IPS')
+    if not axon:
+        pytest.skip('no TPU plugin config in environment')
+    env = dict(os.environ)
+    env['PALLAS_AXON_POOL_IPS'] = axon
+    env.pop('JAX_PLATFORMS', None)
+    env.pop('XLA_FLAGS', None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, 'tools', 'quant_agreement.py'),
+         '--geometry', '7b'],
+        capture_output=True, text=True, timeout=1800, env=env)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    rec = json.loads(proc.stdout)
+    s = rec['scoring_w8a8_vs_bf16']
+    # items whose bf16 margin exceeds the tie band must rank identically
+    assert s['decided_top1_agreement'] >= 0.97, s
+    # per-sample NLL shift well under 1% (VERDICT's done criterion)
+    assert s['median_rel_dnll'] < 0.01, s
+    assert s['p95_rel_dnll'] < 0.01, s
+    # any argmin flips are confined to statistical ties
+    assert s['max_flip_margin'] < 0.005, s
+    f = rec['forced_decode_w8a8kv4_vs_bf16']
+    # where the bf16 model is decisive, the quantized decode picks the
+    # same token at (at least) the bf16 self-consistency rate minus noise
+    if f['n_decided_steps'] >= 20:
+        assert f['decided_step_agreement'] >= 0.9, f
+    assert f['median_quant_rank_of_bf16_choice'] <= 5, f
 
 
 @pytest.mark.slow

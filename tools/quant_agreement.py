@@ -1,0 +1,137 @@
+"""Measure quantized-vs-bf16 eval agreement at real model geometry.
+
+CLI over :mod:`opencompass_tpu.nn.agreement` (metric design notes live
+there).  The headline bench (bench.py) scores PPL with W8A8 and
+generates with W8A8 + int4-KV; tests/test_quant.py pins those recipes'
+accuracy at toy and llama-512x4 scale; this tool pins them at full
+geometry (default: llama-7B, 4096x32) on the real chip, where
+quantization error has had 32 layers x 4096 channels to compound.
+
+Memory: the two model variants never coexist — the bf16 phase runs
+first, params are dropped and caches cleared, then one fused
+init+quantize jit rebuilds the SAME weights (same PRNG key) as int8.
+This keeps peak HBM at the bf16 model size (~13.5 GB at 7B on a 16 GB
+v5e).
+
+Usage:  python tools/quant_agreement.py [--geometry 7b] [--items 64]
+Prints one JSON record; bench.py reports the same stats inline.
+"""
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from opencompass_tpu.nn import (TransformerConfig, greedy_generate,
+                                init_params)
+from opencompass_tpu.nn.agreement import (eval_pool, forced_decode,
+                                          forced_stats, gen_stats,
+                                          score_pool, scoring_stats)
+from opencompass_tpu.nn.quant import quantize_params
+
+GEOMETRIES = {
+    '7b': dict(vocab_size=32000, hidden_size=4096, num_layers=32,
+               num_heads=32, num_kv_heads=32, intermediate_size=11008,
+               max_seq_len=2048),
+    '1b': dict(vocab_size=32000, hidden_size=1024, num_layers=8,
+               num_heads=16, num_kv_heads=16, intermediate_size=2816,
+               max_seq_len=2048),
+    '512x4': dict(vocab_size=2048, hidden_size=512, num_layers=4,
+                  num_heads=8, num_kv_heads=8, intermediate_size=1408,
+                  max_seq_len=128),
+}
+
+
+def _gen(params, cfg, prompts, pmask, n_new):
+    step = jax.jit(lambda p, t, m: greedy_generate(
+        p, cfg, t, m, n_new, eos_token_id=None)[0])
+    return np.asarray(step(params, prompts, pmask))
+
+
+def measure(geometry='7b', items=64, choices=4, seq=128, gen_batch=32,
+            gen_prompt=128, gen_new=64, seed=0):
+    cfg = TransformerConfig.llama(**GEOMETRIES[geometry])
+    cfg_aq = dataclasses.replace(cfg, act_quant=True)
+    cfg_hl = dataclasses.replace(cfg, act_quant=True, kv_quant='int4')
+    tokens, mask, prompts, pmask = eval_pool(cfg, items, choices, seq,
+                                             gen_batch, gen_prompt)
+    key = jax.random.PRNGKey(seed)
+
+    def note(msg):
+        print('[quant_agreement] %s (t=%.0fs)'
+              % (msg, time.perf_counter() - t0), file=sys.stderr)
+
+    t0 = time.perf_counter()
+    params = jax.jit(init_params, static_argnums=0)(cfg, key)
+    jax.block_until_ready(params)
+    note('bf16 params ready')
+    nll_fp = score_pool(params, cfg, tokens, mask)
+    note('bf16 scoring done')
+    out_fp = _gen(params, cfg, prompts, pmask, gen_new)
+    note('bf16 greedy done')
+    # forced decode re-walks a 16-row slice: at 7B the batch-32 cache plus
+    # the scan's stacked outputs overshoots the 16 GB chip by kilobytes
+    fr = min(prompts.shape[0], 16)
+    forced = jnp.asarray(out_fp[:fr])
+    lp_fp, am_fp, margin_fp, _ = forced_decode(params, cfg, prompts[:fr],
+                                               pmask[:fr], forced)
+    note('bf16 forced decode done')
+    del params
+    jax.clear_caches()
+
+    # same key => same weights, re-materialized straight into int8 so the
+    # bf16 and int8 trees never coexist in HBM
+    qparams = jax.jit(
+        lambda k: quantize_params(init_params(cfg, k), cfg))(key)
+    jax.block_until_ready(qparams)
+    note('int8 params ready')
+    nll_q = score_pool(qparams, cfg_aq, tokens, mask)
+    note('w8a8 scoring done')
+    out_q = _gen(qparams, cfg_hl, prompts, pmask, gen_new)
+    note('w8a8-kv4 greedy done')
+    lp_q, am_q, _, rank_q = forced_decode(qparams, cfg_hl, prompts[:fr],
+                                          pmask[:fr], forced)
+    note('w8a8-kv4 forced decode done')
+    del qparams
+    jax.clear_caches()
+
+    return {
+        'geometry': geometry,
+        'config': '%dx%d heads=%d vocab=%d' % (
+            cfg.hidden_size, cfg.num_layers, cfg.num_heads, cfg.vocab_size),
+        'platform': jax.devices()[0].platform,
+        'scoring_w8a8_vs_bf16': scoring_stats(nll_fp, nll_q, choices),
+        'scoring_pool': {'items': items, 'choices': choices, 'seq': seq},
+        'gen_w8a8kv4_vs_bf16': gen_stats(out_fp, out_q),
+        'forced_decode_w8a8kv4_vs_bf16': forced_stats(
+            forced, am_fp, margin_fp, lp_fp, am_q, rank_q, lp_q),
+        'gen_pool': {'batch': gen_batch, 'prompt': gen_prompt,
+                     'new': gen_new, 'forced_rows': fr},
+        'wallclock_sec': round(time.perf_counter() - t0, 1),
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument('--geometry', default='7b', choices=sorted(GEOMETRIES))
+    ap.add_argument('--items', type=int, default=64)
+    ap.add_argument('--choices', type=int, default=4)
+    ap.add_argument('--seq', type=int, default=128)
+    ap.add_argument('--gen-batch', type=int, default=32)
+    ap.add_argument('--gen-prompt', type=int, default=128)
+    ap.add_argument('--gen-new', type=int, default=64)
+    args = ap.parse_args()
+    rec = measure(args.geometry, args.items, args.choices, args.seq,
+                  args.gen_batch, args.gen_prompt, args.gen_new)
+    print(json.dumps(rec))
+
+
+if __name__ == '__main__':
+    main()
