@@ -1,14 +1,67 @@
-"""BASELINE milestone 2: Llama-7B on MMLU 5-shot generation, one chip.
+"""BASELINE milestone 2: Llama-7B geometry on all 57 MMLU subsets, one chip.
 
     python run.py configs/eval_llama_7b_mmlu.py
+
+Runs BOTH eval paths at the serving (bench-headline) quantization:
+
+- 5-shot generation (`mmlu_gen`): long prefill + 100-token greedy decode
+- 5-shot PPL ranking (`mmlu_ppl`, abbrs suffixed `_ppl`): 2k-token
+  scored batches — the HBM-heaviest scoring shape on a 16 GB v5e
+
+With no checkpoint under ./models/ the model runs random-init with the
+byte-fallback tokenizer: scores are chance-level by construction; the
+committed record (outputs/llama_7b_mmlu) is the pipeline/perf anchor —
+samples/sec vs bench.py, compile churn across the subset/bucket spread,
+and HBM behavior at 2k-token PPL batches (BASELINE_RUN.md §4).
+
+The partitioner packs all 114 (dataset x path) units into a handful of
+tasks: each task is a fresh process that pays 7B init + quantize + jit
+compile once, so packing — not max parallelism — is what a single-chip
+run wants.
 """
 with read_base():
     from .datasets.mmlu.mmlu_gen import mmlu_datasets
-    from .models.jax_llama_7b import models
+    from .datasets.mmlu.mmlu_ppl import mmlu_datasets as mmlu_ppl_datasets
     from .summarizers.groups.mmlu import mmlu_summary_groups
 
-datasets = [*mmlu_datasets]
+from opencompass_tpu.models import JaxLM
 
-summarizer = dict(summary_groups=mmlu_summary_groups)
+mmlu_ppl_datasets = [dict(d, abbr=d['abbr'] + '_ppl')
+                     for d in mmlu_ppl_datasets]
+datasets = [*mmlu_datasets, *mmlu_ppl_datasets]
+
+models = [
+    dict(type=JaxLM,
+         abbr='llama-7b-jax',
+         path='./models/llama-7b-hf',   # HF checkpoint dir (config+shards)
+         config=dict(preset='llama'),
+         max_seq_len=2048,
+         # batch 8: the largest that fits BOTH hot shapes on a 16 GB v5e
+         # at 7B W8A8 — gen prefill at ~1.9k-token prompts OOMs at 12+
+         # (19 GB), while PPL scoring at (8, 2048) gives up <4% vs (16,
+         # 2048) — measured, see BASELINE_RUN.md §4
+         batch_size=8,
+         max_out_len=100,
+         dtype='bfloat16',
+         quantize='w8a8-kv4',           # the serving / bench-headline recipe
+         parallel=dict(data=-1, model=1),
+         run_cfg=dict(num_devices=1)),
+]
+
+summarizer = dict(summary_groups=mmlu_summary_groups + [
+    {'name': 'mmlu_ppl',
+     'subsets': [d['abbr'] for d in mmlu_ppl_datasets]},
+])
+
+infer = dict(
+    partitioner=dict(type='SizePartitioner',
+                     max_task_size=40000, gen_task_coef=20),
+)
+
+# LocalRunner watchdog (cli.py forwards these): generous task budget —
+# a packed task pays one 7B init + several jit compiles before its first
+# sample — and a stall kill well above worst-case single-compile time
+task_timeout = 14400
+stall_timeout = 1800
 
 work_dir = './outputs/llama_7b_mmlu'

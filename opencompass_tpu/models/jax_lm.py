@@ -176,6 +176,18 @@ class JaxLM(BaseModel):
         return cfg
 
     def _load_params(self, path: str, seed: int):
+        from opencompass_tpu.nn.sat_convert import is_sat_checkpoint
+        if is_sat_checkpoint(path):
+            # GLM-130B-style SAT model-parallel shards (nn/sat_convert.py)
+            from opencompass_tpu.nn.sat_convert import \
+                convert_sat_checkpoint_cached
+            self.cfg, self.params = convert_sat_checkpoint_cached(
+                path, self.cfg, cache_dir=self.convert_cache)
+            logger.info(f'loaded SAT checkpoint from {path}')
+            if self.quantize:
+                from opencompass_tpu.nn.quant import quantize_params
+                self.params = quantize_params(self.params, self.cfg)
+            return
         has_ckpt = path and os.path.isdir(path) and any(
             f.endswith(('.safetensors', '.bin')) for f in os.listdir(path))
         if has_ckpt:

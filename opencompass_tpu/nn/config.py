@@ -56,6 +56,10 @@ class TransformerConfig:
     # tokens causally (scoring passes the context extent via mask_length;
     # generation treats the whole prompt as context)
     prefix_lm: bool = False
+    # GLM-130B DeepNorm residuals (post-LN variant): each sublayer output
+    # joins the *normed* input scaled by (2L)^0.5 — x' = LN-out * alpha +
+    # sublayer(LN-out) — instead of the pre-norm x + sublayer(LN(x)).
+    deepnorm: bool = False
     # Quantized KV cache with per-vector scales (decode path only — scoring
     # builds no cache and is numerically unaffected): False, 'int8' (True is
     # accepted as 'int8'), or 'int4'.  Cache reads dominate large-batch
@@ -86,6 +90,11 @@ class TransformerConfig:
             raise ValueError(f'kv_quant must be False/True/"int8"/"int4", '
                              f'got {self.kv_quant!r}')
         return mode
+
+    @property
+    def deepnorm_alpha(self) -> float:
+        """GLM-130B residual scale: (2 * num_layers) ** 0.5."""
+        return (2.0 * self.num_layers) ** 0.5
 
     @property
     def q_dim(self) -> int:
@@ -141,11 +150,12 @@ class TransformerConfig:
                 num_heads=96, intermediate_size=32768, max_seq_len=2048,
                 **kw):
         """GLM-130B family (reference models/glm.py evaluates it through the
-        external SwissArmyTransformer package): RoPE, GeGLU, LayerNorm,
+        external SwissArmyTransformer package): RoPE (1D, rotate-half),
+        GeGLU, LayerNorm, DeepNorm residuals (post-LN, alpha=(2L)^0.5),
         prefix-LM attention (bidirectional context / causal answer).
-        Approximation: pre-norm residuals instead of DeepNorm post-norm —
-        the measurement paths (choice/get_ppl/generate) are exact, the
-        checkpoint math is the documented divergence."""
+        Weights load from SAT model-parallel shards via nn/sat_convert.py;
+        block math is pinned against a torch reimplementation in
+        tests/test_glm_deepnorm.py."""
         return TransformerConfig(
             vocab_size=vocab_size, hidden_size=hidden_size,
             num_layers=num_layers, num_heads=num_heads,
@@ -153,7 +163,7 @@ class TransformerConfig:
             intermediate_size=intermediate_size, max_seq_len=max_seq_len,
             activation='gelu', norm='layernorm', positional='rope',
             gated_mlp=True, qkv_bias=True, o_bias=True, mlp_bias=True,
-            prefix_lm=True, **kw)
+            prefix_lm=True, deepnorm=True, **kw)
 
     @staticmethod
     def chatglm2(vocab_size=65024, hidden_size=4096, num_layers=28,

@@ -1,0 +1,202 @@
+"""GLM-130B SAT (SwissArmyTransformer) checkpoint conversion.
+
+The reference evaluates GLM-130B by driving the external SAT package over
+8 model-parallel GPUs (reference opencompass/models/glm.py:34-120, which
+calls ``initialize_model_and_tokenizer`` on a megatron-sharded
+checkpoint).  Here the checkpoint itself is converted once into the
+in-repo pytree layout (nn/transformer.py) and runs on the JAX stack —
+model parallelism becomes a `jax.sharding` mesh axis, not a process
+group, so the same converted weights serve 1 chip or a pod slice.
+
+Expected layout: a directory of megatron/deepspeed model-parallel shards
+
+    <dir>/mp_rank_00_model_states.pt
+    <dir>/mp_rank_01_model_states.pt
+    ...
+
+each a ``torch.save`` dict whose ``'module'`` entry maps SAT parameter
+names to tensors.  Shard-merge rules (megatron conventions):
+
+- ``word_embeddings.weight``: vocab-sharded, concat on dim 0; the output
+  head is tied (logits = h @ embed.T).
+- ``attention.query_key_value``: column-parallel with each shard holding
+  its heads' [q; k; v] stacked on dim 0 — split per shard, then concat
+  per component.
+- ``attention.dense`` / ``mlp.dense_4h_to_h``: row-parallel, concat on
+  dim 1 (bias replicated).
+- ``mlp.dense_h_to_4h``: column-parallel GeGLU, each shard [gate; up]
+  stacked on dim 0 — split per shard, concat per half.  The first half
+  is the GELU-gated branch (pinned by tests/test_glm_deepnorm.py's torch
+  reimplementation).
+- layernorms: replicated, shard 0 wins.
+
+Orientation: q/k/v stay in torch's (out, in) layout — the pytree stores
+them that way on purpose (nn/transformer.py `_linear_nt`: the decode
+step wants the contraction dim minor-most); o/gate/up/down transpose to
+(in, out) like every other family map in nn/hf_convert.py.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .config import TransformerConfig
+
+_SHARD_RE = re.compile(r'mp_rank_(\d+)_model_states\.pt$')
+
+
+def is_sat_checkpoint(path: str) -> bool:
+    """True when ``path`` is a directory of SAT model-parallel shards."""
+    return bool(path) and os.path.isdir(path) and any(
+        _SHARD_RE.search(f) for f in os.listdir(path))
+
+
+def _load_shards(path: str):
+    import torch
+    shards = sorted(
+        (int(m.group(1)), os.path.join(path, f))
+        for f in os.listdir(path) if (m := _SHARD_RE.search(f)))
+    if not shards:
+        raise ValueError(f'no mp_rank_*_model_states.pt under {path!r}')
+    out = []
+    for _, f in shards:
+        blob = torch.load(f, map_location='cpu', weights_only=False)
+        module = blob.get('module', blob)
+        out.append({k: v.float().numpy() for k, v in module.items()})
+    return out
+
+
+def _np(arrs, axis=None):
+    return arrs[0] if axis is None else np.concatenate(arrs, axis=axis)
+
+
+def convert_sat_checkpoint(path: str,
+                           cfg: TransformerConfig,
+                           dtype=None
+                           ) -> Tuple[TransformerConfig, Dict]:
+    """Merge SAT model-parallel shards into the nn/ pytree for ``cfg``."""
+    import jax.numpy as jnp
+    dtype = dtype or (jnp.bfloat16 if cfg.dtype == 'bfloat16'
+                      else np.dtype(cfg.dtype))
+    shards = _load_shards(path)
+    names = shards[0].keys()
+
+    def gather(name, axis=None):
+        if name not in shards[0]:
+            raise ValueError(f'SAT checkpoint missing {name!r}')
+        return _np([s[name] for s in shards], axis)
+
+    def qkv_split(name, axis0_parts=3):
+        """Per-shard split on dim 0 into ``axis0_parts``, concat each
+        part across shards (megatron stacked column-parallel layout)."""
+        parts = [[] for _ in range(axis0_parts)]
+        for s in shards:
+            chunks = np.split(s[name], axis0_parts, axis=0)
+            for p, c in zip(parts, chunks):
+                p.append(c)
+        return [np.concatenate(p, axis=0) for p in parts]
+
+    L = cfg.num_layers
+    pre = 'transformer.layers.%d.'
+    layer_names = {n for n in names if n.startswith('transformer.layers.')}
+    seen_layers = {int(n.split('.')[2]) for n in layer_names}
+    if seen_layers != set(range(L)):
+        raise ValueError(
+            f'checkpoint has layers {sorted(seen_layers)[:4]}..., config '
+            f'wants {L}')
+
+    layers: Dict[str, list] = {}
+    for i in range(L):
+        p = pre % i
+        qw, kw, vw = qkv_split(p + 'attention.query_key_value.weight')
+        qb, kb, vb = qkv_split(p + 'attention.query_key_value.bias')
+        gw, uw = qkv_split(p + 'mlp.dense_h_to_4h.weight', 2)
+        gb, ub = qkv_split(p + 'mlp.dense_h_to_4h.bias', 2)
+        row = {
+            'attn_norm': {'scale': gather(p + 'input_layernorm.weight'),
+                          'bias': gather(p + 'input_layernorm.bias')},
+            'mlp_norm': {
+                'scale': gather(p + 'post_attention_layernorm.weight'),
+                'bias': gather(p + 'post_attention_layernorm.bias')},
+            'q': {'w': qw, 'b': qb},
+            'k': {'w': kw, 'b': kb},
+            'v': {'w': vw, 'b': vb},
+            'o': {'w': gather(p + 'attention.dense.weight', axis=1).T,
+                  'b': gather(p + 'attention.dense.bias')},
+            'gate': {'w': gw.T, 'b': gb},
+            'up': {'w': uw.T, 'b': ub},
+            'down': {'w': gather(p + 'mlp.dense_4h_to_h.weight', axis=1).T,
+                     'b': gather(p + 'mlp.dense_4h_to_h.bias')},
+        }
+        for k, v in row.items():
+            for kk, arr in v.items():
+                layers.setdefault(k, {}).setdefault(kk, []).append(arr)
+
+    stacked = {k: {kk: np.stack(arrs).astype(dtype)
+                   for kk, arrs in v.items()}
+               for k, v in layers.items()}
+    embed = gather('transformer.word_embeddings.weight', axis=0)
+    params = {
+        'embed': embed.astype(dtype),
+        'layers': stacked,
+        'final_norm': {
+            'scale': gather('transformer.final_layernorm.weight')
+            .astype(dtype),
+            'bias': gather('transformer.final_layernorm.bias')
+            .astype(dtype)},
+        # GLM-130B ties the output head to the word embeddings
+        'lm_head': np.ascontiguousarray(embed.T).astype(dtype),
+    }
+    if embed.shape[0] != cfg.vocab_size:
+        raise ValueError(f'embed vocab {embed.shape[0]} != config '
+                         f'{cfg.vocab_size}')
+    return cfg, params
+
+
+def _sat_fingerprint(path: str, cfg: TransformerConfig) -> str:
+    """Cache key over the shard set (name/size/mtime) + structural cfg,
+    mirroring hf_convert._ckpt_fingerprint but for .pt shards."""
+    import dataclasses
+    import hashlib
+    import json
+    structural = dataclasses.asdict(dataclasses.replace(
+        cfg, kv_quant=False, remat=False, scan_layers=True,
+        max_seq_len=0))
+    parts = [json.dumps(structural, sort_keys=True)]
+    for f in sorted(os.listdir(path)):
+        if _SHARD_RE.search(f):
+            st = os.stat(os.path.join(path, f))
+            parts.append(f'{f}:{st.st_size}:{st.st_mtime_ns}')
+    return hashlib.sha256('|'.join(parts).encode()).hexdigest()[:16]
+
+
+def convert_sat_checkpoint_cached(path: str,
+                                  cfg: TransformerConfig,
+                                  cache_dir: Optional[str] = None
+                                  ) -> Tuple[TransformerConfig, Dict]:
+    """convert_sat_checkpoint with the same on-disk pytree cache as
+    hf_convert.convert_checkpoint_cached — a packed multi-task run pays
+    the torch shard walk + fp32 merge once, not once per task process."""
+    from .hf_convert import load_converted, save_converted
+    from opencompass_tpu.utils.logging import get_logger
+    logger = get_logger()
+    if not cache_dir:
+        return convert_sat_checkpoint(path, cfg)
+    loc = os.path.join(cache_dir, 'sat_' + _sat_fingerprint(path, cfg))
+    if os.path.isfile(os.path.join(loc, 'manifest.json')):
+        try:
+            _, params = load_converted(loc)
+            logger.info(f'loaded SAT convert cache {loc}')
+            return cfg, params
+        except Exception as exc:
+            logger.warning(f'SAT convert cache {loc} unreadable ({exc}); '
+                           're-converting')
+    out_cfg, params = convert_sat_checkpoint(path, cfg)
+    try:
+        save_converted(loc, out_cfg, params)
+    except OSError as exc:  # best-effort (disk full, read-only fs)
+        logger.warning(f'could not write SAT convert cache {loc}: {exc}')
+    return out_cfg, params

@@ -416,6 +416,11 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
     if cfg.parallel_residual:
         # falcon-7b: one shared pre-norm; falcon-40b/180b: separate ln_mlp
         h2 = _norm(x, lp['mlp_norm'], cfg) if 'mlp_norm' in lp else h
+    elif cfg.deepnorm:
+        # GLM-130B DeepNorm (post-LN): the residual branch is the *normed*
+        # input scaled by alpha, not the raw input
+        x = h * cfg.deepnorm_alpha + attn
+        h2 = _norm(x, lp['mlp_norm'], cfg)
     else:
         x = x + attn
         h2 = _norm(x, lp['mlp_norm'], cfg)
@@ -438,6 +443,8 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
 
     if cfg.parallel_residual:
         x = x + attn + mlp
+    elif cfg.deepnorm:
+        x = h2 * cfg.deepnorm_alpha + mlp
     else:
         x = x + mlp
     return x, new_cache

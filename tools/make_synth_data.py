@@ -40,13 +40,24 @@ def mmlu(root, n):
     cfg = Config.fromfile(osp.join(REPO,
                                    'configs/datasets/mmlu/mmlu_gen.py'))
     names = cfg['mmlu_all_sets']
+    # realistic question lengths (real MMLU items average ~250 chars, so
+    # a 5-shot prompt is 1.5-2k+ tokens): pad each question with a
+    # deterministic filler clause so milestone runs exercise the same
+    # truncation / long-prefill behavior as the real benchmark
+    filler = ('Consider the following scenario drawn from %s, where a '
+              'careful reading of the premises is required before any '
+              'of the candidate answers can be ruled out, and partial '
+              'credit is never awarded for an unjustified guess. ')
     for name in names:
         for split, k in (('dev', 5), ('test', n)):
             rows = []
             for i in range(k):
                 gold = 'ABCD'[i % 4]
-                rows.append([f'Synthetic {name} question {i}?',
-                             'alpha', 'beta', 'gamma', 'delta', gold])
+                body = filler % name.replace('_', ' ') * (1 + i % 2)
+                rows.append([f'{body}Synthetic {name} question {i}?',
+                             'alpha option %d' % i, 'beta option %d' % i,
+                             'gamma option %d' % i, 'delta option %d' % i,
+                             gold])
             out = osp.join(root, 'mmlu', split, f'{name}_{split}.csv')
             os.makedirs(osp.dirname(out), exist_ok=True)
             with open(out, 'w', newline='', encoding='utf-8') as f:
