@@ -263,6 +263,46 @@ def main():
     del qparams
     jax.clear_caches()
 
+    # int4x2 packed weights (nn/quant.py): two group-quantized int4 per
+    # uint8, nibbles split inside the matmul program.  NOT a throughput
+    # tier on this toolchain — XLA materializes the unpacked operand
+    # instead of fusing it into the matmul read, so w4a8 decode measures
+    # SLOWER than w8a8 (docs/user_guides/performance.md roofline) — but
+    # it is the CAPACITY tier: weights at rest are 4-bit, which is what
+    # lets 13B-class geometry decode on one 16 GB chip below.
+    q4 = jax.jit(
+        lambda key: quantize_params(init_params(CFG_7B, key), CFG_7B,
+                                    mode='int4x2'))(jax.random.PRNGKey(0))
+    jax.block_until_ready(q4)
+    jax.clear_caches()
+    gen4_sps, gen4_tps = _bench_gen(q4, cfg_hl, batch=GEN_BATCH_HEADLINE)
+    jax.clear_caches()
+    ppl4_sps, ppl4_tops = _bench_ppl(q4, cfg_aq, PPL_ITERS)
+    del q4
+    jax.clear_caches()
+
+    # capacity leg: llama-13B geometry on ONE 16 GB chip.  bf16 (26 GB)
+    # and int8 (13 GB + cache) cannot run at all; the packed form can —
+    # weights 6.5 GB at rest.  Random packed init (nn/quant.py
+    # init_packed_params): the bf16 stack a fused init+quantize would
+    # need exceeds HBM by construction here.
+    from opencompass_tpu.nn.quant import init_packed_params
+    CFG_13B = TransformerConfig.llama(
+        vocab_size=32000, hidden_size=5120, num_layers=40, num_heads=40,
+        num_kv_heads=40, intermediate_size=13824, max_seq_len=2048)
+    cfg13_hl = dataclasses.replace(CFG_13B, kv_quant='int4',
+                                   act_quant=True)
+    cfg13_aq = dataclasses.replace(CFG_13B, act_quant=True)
+    q13 = jax.jit(lambda key: init_packed_params(CFG_13B, key))(
+        jax.random.PRNGKey(0))
+    jax.block_until_ready(q13)
+    jax.clear_caches()
+    gen13_sps, gen13_tps = _bench_gen(q13, cfg13_hl, batch=32)
+    jax.clear_caches()
+    ppl13_sps, _ = _bench_ppl(q13, cfg13_aq, 4, batch=8)
+    del q13
+    jax.clear_caches()
+
     # headline: the serving/throughput config end to end — W8A8 scoring +
     # W8A8/int4-KV batch-128 generation (accuracy tracked vs bf16 by
     # tests/test_quant.py); value_bf16 is the same blend fully unquantized
@@ -304,6 +344,21 @@ def main():
             'gen_int8kv_tokens_per_sec': round(gen8kv_tps, 1),
             'gen_int8kv_b64_samples_per_sec': round(gen8kv64_sps, 3),
             'gen_int8kv_b64_tokens_per_sec': round(gen8kv64_tps, 1),
+            'gen_w4a8kv4_b%d_samples_per_sec' % GEN_BATCH_HEADLINE:
+                round(gen4_sps, 3),
+            'gen_w4a8kv4_b%d_tokens_per_sec' % GEN_BATCH_HEADLINE:
+                round(gen4_tps, 1),
+            'ppl_w4a8_samples_per_sec': round(ppl4_sps, 3),
+            'ppl_w4a8_tops': round(ppl4_tops, 1),
+            'cap_13b_w4a8': {
+                'note': 'llama-13B geometry on ONE 16 GB chip — only '
+                        'runnable via int4x2 packed weights (bf16/int8 '
+                        'exceed HBM); EXPERIMENTAL accuracy tier '
+                        '(group-RTN int4; QUANT_AGREEMENT_7B_W4A8.json)',
+                'gen_b32_samples_per_sec': round(gen13_sps, 3),
+                'gen_b32_tokens_per_sec': round(gen13_tps, 1),
+                'ppl_b8_samples_per_sec': round(ppl13_sps, 3),
+            },
             'value_bf16': round(_blend(ppl_sps, gen_sps) / n_chips, 3),
             'value_int8_b32': round(_blend(ppl_sps, gen8_sps) / n_chips, 3),
             'params_b': round(_param_count(CFG_7B) / 1e9, 2),

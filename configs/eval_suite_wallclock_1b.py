@@ -1,0 +1,63 @@
+"""Suite-scale wallclock with a REAL on-chip model (BASELINE milestone 3
+single-chip anchor; VERDICT r03 #6).
+
+Same ~120-task breadth as eval_suite_wallclock.py, but the model is a
+random-init llama-1B-class JaxLM at the serving quantization instead of
+FakeModel — so the measured wallclock includes real device time (jit
+compiles across the suite's shape-bucket spread, PPL scoring, greedy
+decode), not just framework overhead.  Scores stay chance-level by
+construction (random weights + byte tokenizer); the record is the
+committed summary + per-task perf tables under outputs/suite_1b.
+
+    python tools/make_synth_data.py --rows 16
+    python run.py configs/eval_suite_wallclock_1b.py
+
+Packing note: one packed infer task (SizePartitioner below) loads the
+1B model once and amortizes compiles over all datasets — the right
+shape for a single-chip run (same reasoning as eval_llama_7b_mmlu.py).
+"""
+from opencompass_tpu.config import read_base
+
+with read_base():
+    from .datasets.mmlu.mmlu_ppl import mmlu_datasets          # 57 tasks
+    from .datasets.ceval.ceval_gen import ceval_datasets       # 52 tasks
+    from .datasets.arc.arc_ppl import arc_datasets
+    from .datasets.SuperGLUE_BoolQ.BoolQ_ppl_letter import BoolQ_datasets
+    from .datasets.gsm8k.gsm8k_gen import gsm8k_datasets
+    from .datasets.triviaqa.triviaqa_gen import triviaqa_datasets
+    from .summarizers.groups.mmlu import mmlu_summary_groups
+    from .summarizers.groups.ceval import ceval_summary_groups
+
+from opencompass_tpu.models import JaxLM
+
+datasets = sum((v for k, v in list(locals().items())
+                if k.endswith('_datasets')), [])
+
+models = [
+    dict(type=JaxLM,
+         abbr='llama-1b-jax',
+         path='',                        # random init (no checkpoint)
+         config=dict(preset='llama', vocab_size=32000, hidden_size=2048,
+                     num_layers=16, num_heads=16, num_kv_heads=16,
+                     intermediate_size=5632, max_seq_len=2048),
+         max_seq_len=2048,
+         batch_size=16,
+         max_out_len=64,
+         dtype='bfloat16',
+         quantize='w8a8-kv4',
+         parallel=dict(data=-1, model=1),
+         run_cfg=dict(num_devices=1)),
+]
+
+summarizer = dict(
+    summary_groups=[*mmlu_summary_groups, *ceval_summary_groups])
+
+infer = dict(
+    partitioner=dict(type='SizePartitioner',
+                     max_task_size=100000, gen_task_coef=20),
+)
+
+task_timeout = 14400
+stall_timeout = 1800
+
+work_dir = './outputs/suite_1b'

@@ -1,0 +1,25 @@
+"""GLM-130B through the TPU-native GLM130B wrapper.
+
+``path`` points at a directory of SAT/megatron model-parallel shards
+(``mp_rank_00_model_states.pt`` ...) — the format the reference drives
+through SwissArmyTransformer over 8 GPUs (reference
+opencompass/models/glm.py:34-120).  Here the shards are merged once
+(nn/sat_convert.py, cached via ``convert_cache``) and the model runs
+DeepNorm + prefix-LM on the JAX stack, tensor-parallel over the mesh
+``model`` axis.
+"""
+from opencompass_tpu.models import GLM130B
+
+models = [
+    dict(type=GLM130B,
+         abbr='glm-130b',
+         path='./models/glm-130b-sat',   # dir of mp_rank_*_model_states.pt
+         max_seq_len=2048,
+         batch_size=8,
+         max_out_len=100,
+         convert_cache='.cache/converted',
+         # 130B needs >= 8-chip tensor parallelism (the reference uses
+         # --model-parallel-size 8 on A100s); a v5e-8 slice matches
+         parallel=dict(data=1, model=8, seq=1),
+         run_cfg=dict(num_devices=8)),
+]

@@ -115,24 +115,34 @@ class JaxLM(BaseModel):
         self._ids_cache_max = 8192
         self._len_cache_max = 1_000_000
         self._gen_fn_cache: Dict[tuple, object] = {}
-        # quantize modes compose 'base[-kvN]': base 'int8' (weight-only)
-        # or 'w8a8' (int8 weights + dynamic per-token int8 activations on
-        # the MXU); '-kv'/'-kv8' adds an int8 decode KV cache, '-kv4'
-        # an int4 one.  'w8a8-kv4' is the serving/throughput recipe.
+        # quantize modes compose 'base[-kvN]': base 'int8' (weight-only),
+        # 'w8a8' (int8 weights + dynamic per-token int8 activations on
+        # the MXU), or 'w4a8' (int4 weights packed two-per-uint8 with
+        # 128-wide group scales, unpacked inside the jit — nn/quant.py
+        # int4x2 — + int8 activations); '-kv'/'-kv8' adds an int8 decode
+        # KV cache, '-kv4' an int4 one.  'w8a8-kv4' is the accuracy-
+        # pinned serving recipe; 'w4a8-kv4' halves the decode weight
+        # stream again (group-RTN int4: check the agreement probe for
+        # your model before trusting scores).
         base, dash, kv = (quantize or '').partition('-')
         if quantize is not None and (
-                base not in ('int8', 'w8a8') or
+                base not in ('int8', 'w8a8', 'w4a8') or
                 (dash and kv not in ('kv', 'kv8', 'kv4'))):
             raise ValueError(f'unsupported quantize={quantize!r} '
-                             "(want 'int8'|'w8a8' optionally + "
+                             "(want 'int8'|'w8a8'|'w4a8' optionally + "
                              "'-kv8'|'-kv4', e.g. 'w8a8-kv4')")
         self.quantize = quantize
+        self._weight_mode = 'int4x2' if base == 'w4a8' else 'int8'
+        if base == 'w4a8' and abs((parallel or {}).get('model', 1)) != 1:
+            raise NotImplementedError(
+                'w4a8 packed weights are stored NT and do not yet carry '
+                'tensor-parallel sharding specs; use model=1 or w8a8')
         if quantize and self.cfg is not None:
             import dataclasses
             updates = {}
             if kv:
                 updates['kv_quant'] = 'int4' if kv == 'kv4' else 'int8'
-            if base == 'w8a8':
+            if base in ('w8a8', 'w4a8'):
                 updates['act_quant'] = True
             if updates:
                 self.cfg = dataclasses.replace(self.cfg, **updates)
@@ -186,7 +196,8 @@ class JaxLM(BaseModel):
             logger.info(f'loaded SAT checkpoint from {path}')
             if self.quantize:
                 from opencompass_tpu.nn.quant import quantize_params
-                self.params = quantize_params(self.params, self.cfg)
+                self.params = quantize_params(self.params, self.cfg,
+                                              mode=self._weight_mode)
             return
         has_ckpt = path and os.path.isdir(path) and any(
             f.endswith(('.safetensors', '.bin')) for f in os.listdir(path))
@@ -201,7 +212,8 @@ class JaxLM(BaseModel):
             if self.quantize:
                 # host-side: only the int8 tensors ever reach a chip
                 from opencompass_tpu.nn.quant import quantize_params
-                self.params = quantize_params(self.params, self.cfg)
+                self.params = quantize_params(self.params, self.cfg,
+                                              mode=self._weight_mode)
         elif jax.process_count() > 1:
             if path:
                 logger.warning(f'no weights under {path!r}; random init '
@@ -215,12 +227,23 @@ class JaxLM(BaseModel):
                 from opencompass_tpu.nn.quant import quantize_params
                 self.params = jax.tree_util.tree_map(np.asarray,
                                                      self.params)
-                self.params = quantize_params(self.params, self.cfg)
+                self.params = quantize_params(self.params, self.cfg,
+                                              mode=self._weight_mode)
         else:
             if path:
                 logger.warning(f'no weights under {path!r}; random init '
                                f'(seed={seed})')
-            if self.quantize:
+            if self.quantize and self._weight_mode == 'int4x2':
+                # direct packed init: the fused init+quantize below needs
+                # the full bf16 stack as the pack's input, which exceeds
+                # HBM for the geometries w4a8 exists to serve (13B-class
+                # on one 16 GB chip) — see nn/quant.init_packed_params
+                from opencompass_tpu.nn.quant import init_packed_params
+                cfg = self.cfg
+                self.params = jax.jit(
+                    lambda key: init_packed_params(cfg, key))(
+                        jax.random.PRNGKey(seed))
+            elif self.quantize:
                 # ONE fused program: the bf16 weights are scheduler temps
                 # freed as each int8 consumer runs, so init+quantize of a
                 # near-HBM-sized model fits without fragmentation (a
@@ -228,9 +251,10 @@ class JaxLM(BaseModel):
                 # host init is minutes-slow at 7B)
                 from opencompass_tpu.nn.quant import quantize_params
                 cfg = self.cfg
+                mode = self._weight_mode
                 self.params = jax.jit(
                     lambda key: quantize_params(init_params(cfg, key),
-                                                cfg))(
+                                                cfg, mode=mode))(
                                                     jax.random.PRNGKey(seed))
             else:
                 self.params = init_params(self.cfg,

@@ -16,10 +16,24 @@ keeps per-channel scales).  Activations are quantized only when
 MXU — see transformer._dyn_act_quant).
 
 JaxLM exposes the int8 tiers (``quantize='int8'|'w8a8'`` plus
-``-kv8``/``-kv4`` cache suffixes).  ``mode='int4'`` weights work at this
-API level (useful on backends whose runtime accepts int4 jit arguments —
-CPU does) but are not a JaxLM mode: the current TPU plugin cannot pass
-int4 arrays across the jit boundary, and model parameters cross it.
+``-kv8``/``-kv4`` cache suffixes) and the packed-int4 tier
+(``'w4a8'``): mode ``'int4x2'`` stores two group-quantized int4 values
+per uint8 (GROUP=128 contraction groups, NT orientation) and the
+nibbles are split *inside* the matmul program
+(transformer._packed_matmul) — uint8 crosses the jit boundary fine, so
+this sidesteps the TPU plugin's int4-across-jit limitation while the
+HBM weight stream stays 4 bits wide.  Plain ``mode='int4'`` (unpacked
+int4 arrays) still works on backends whose runtime accepts int4 jit
+arguments (CPU does) but is not a JaxLM mode for that reason.
+
+Accuracy ladder: int8/W8A8 is the pinned serving recipe
+(QUANT_AGREEMENT_7B.json: decided-item agreement 1.0).  w4a8 is
+group-RTN int4 and EXPERIMENTAL: at 7B geometry on random-init weights
+its decided-item agreement is 79% and forced-decode agreement 12%
+(QUANT_AGREEMENT_7B_W4A8.json) — its value is capacity (13B-class
+geometry on one 16 GB chip; weights rest at 4 bits), not fidelity.
+Measure your model with ``tools/quant_agreement.py --quant w4a8-kv4``
+before trusting scores.
 """
 from __future__ import annotations
 
@@ -33,7 +47,11 @@ import numpy as np
 _NT_KEYS = ('q', 'k', 'v')
 _IN_OUT_KEYS = ('o', 'gate', 'up', 'down', 'fc1', 'fc2')
 
-_QMAX = {'int8': 127.0, 'int4': 7.0}
+_QMAX = {'int8': 127.0, 'int4': 7.0, 'int4x2': 7.0}
+
+# int4x2: group size along the contraction axis.  128 matches the MXU
+# systolic dim, so the per-group batched contractions still tile cleanly.
+GROUP = 128
 
 
 def _quantize_math(w, axis: int, xp, mode: str, store_dtype=None):
@@ -45,6 +63,52 @@ def _quantize_math(w, axis: int, xp, mode: str, store_dtype=None):
     wq = xp.clip(xp.round(w.astype(xp.float32) / scale), -qmax, qmax)
     wq = wq.astype(store_dtype)
     return wq, xp.squeeze(scale, axis=axis).astype(xp.float32)
+
+
+def _pack_int4x2(w, axis: int, xp):
+    """Group-wise int4 quantization packed two-per-uint8.
+
+    The weight is brought to NT orientation (contraction axis LAST) and
+    quantized per (output-channel, 128-wide contraction group) to
+    [-7, 7]; adjacent contraction pairs pack into one uint8 (low nibble
+    = even index).  Returns (packed (..., out, in/2) uint8,
+    scales (..., out, in/GROUP) fp32).
+
+    This is the TPU answer to the plugin's int4-across-jit limitation:
+    uint8 crosses the jit boundary fine, and the nibbles are split
+    inside the matmul program (transformer._packed_matmul), so the HBM
+    weight stream — the decode bottleneck — is 4-bit wide while the MXU
+    still contracts int8 x int8.
+
+    Stacked (scan-layout) device tensors are packed layer-by-layer via
+    ``lax.map``: the pack math makes several fp32-sized temps of its
+    input, and doing the whole (L, ...) stack at once inside the fused
+    init+quantize program peaks at ~17 GB for a 7B model (measured OOM);
+    per-layer sequencing bounds the temps to one layer's worth.
+    """
+    if xp is jnp and getattr(w, 'ndim', 0) >= 3:
+        import jax
+        neg = axis if axis < 0 else axis - w.ndim
+        return jax.lax.map(lambda wl: _pack_int4x2(wl, neg, xp), w)
+    if axis in (-2, w.ndim - 2):           # (in, out) -> NT (out, in)
+        w = xp.swapaxes(w, -1, -2)
+    K = w.shape[-1]
+    if K % GROUP:
+        raise ValueError(f'contraction dim {K} not divisible by group '
+                         f'{GROUP} (int4x2 mode)')
+    wf = w.astype(xp.float32)
+    grouped = wf.reshape(*wf.shape[:-1], K // GROUP, GROUP)
+    amax = xp.max(xp.abs(grouped), axis=-1, keepdims=True)
+    scale = xp.maximum(amax / 7.0, 1e-12)
+    q = xp.clip(xp.round(grouped / scale), -7, 7)
+    q = q.reshape(wf.shape).astype(xp.int8)
+    # split-half pairing: element i shares a byte with element i + K/2,
+    # so unpacking is two contiguous nibble-extracts + a concat in
+    # natural order — no stride-2 interleave for XLA to materialize
+    lo = q[..., :K // 2]
+    hi = q[..., K // 2:]
+    packed = (lo.astype(xp.uint8) & 0xF) | (hi.astype(xp.uint8) << 4)
+    return packed, xp.squeeze(scale, -1).astype(xp.float32)
 
 
 def _quantize_weight(w, axis: int, mode: str):
@@ -60,6 +124,10 @@ def _quantize_weight(w, axis: int, mode: str):
     ever exist as scheduler temps.
     """
     import jax
+    if mode == 'int4x2':
+        xp = np if (not isinstance(w, jax.core.Tracer)
+                    and not isinstance(w, jax.Array)) else jnp
+        return _pack_int4x2(w, axis, xp)
     if isinstance(w, jax.core.Tracer) or not isinstance(w, jax.Array):
         xp = jnp if isinstance(w, jax.core.Tracer) else np
         # numpy has no int4: host copies of int4-mode weights stay
@@ -77,9 +145,66 @@ def _quantize_weight(w, axis: int, mode: str):
                                      mode=mode))(w)
 
 
+def init_packed_params(cfg, key):
+    """Random-init parameters DIRECTLY in int4x2 packed form.
+
+    For geometries whose bf16 stack exceeds HBM, the usual fused
+    init+quantize program cannot run (a 13B init needs the full ~26 GB
+    bf16 stack as the pack's input; measured OOM on a 16 GB v5e) — but
+    the packed form itself fits with room to spare.  Random nibbles +
+    magnitude-matched scales are statistically the same benchmark
+    construct as random bf16 weights, so this is the random-init path
+    for ``JaxLM(quantize='w4a8...')`` and the capacity bench legs.
+    Real checkpoints never hit this: they quantize host-side in numpy
+    and transfer the packed arrays.
+    """
+    import jax
+    if (not cfg.gated_mlp or cfg.qkv_bias or cfg.norm != 'rmsnorm'
+            or cfg.parallel_residual or cfg.tie_embeddings):
+        raise NotImplementedError(
+            'init_packed_params covers the llama-family tree (gated '
+            'mlp, rmsnorm, no biases); quantize a real checkpoint '
+            'host-side for other families')
+    D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    V = cfg.vocab_size
+    dt = cfg.jnp_dtype
+
+    def bf16(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * scale).astype(dt)
+
+    def packed(key, out_dim, in_dim):
+        kw, = jax.random.split(key, 1)
+        w = jax.random.randint(kw, (L, out_dim, in_dim // 2), 0, 256,
+                               dtype=jnp.int32).astype(jnp.uint8)
+        # scale so dequantized std ~ 1/sqrt(in) (init_params' magnitude):
+        # uniform nibbles have std ~4.6
+        s = jnp.full((L, out_dim, in_dim // GROUP),
+                     1.0 / (4.6 * np.sqrt(in_dim)), jnp.bfloat16)
+        return {'w': w, 's': s}
+
+    ks = jax.random.split(key, 10)
+    layers = {
+        'attn_norm': {'scale': jnp.ones((L, D), dt)},
+        'mlp_norm': {'scale': jnp.ones((L, D), dt)},
+        'q': packed(ks[0], cfg.q_dim, D),
+        'k': packed(ks[1], cfg.kv_dim, D),
+        'v': packed(ks[2], cfg.kv_dim, D),
+        'o': packed(ks[3], D, cfg.q_dim),
+        'gate': packed(ks[4], F, D),
+        'up': packed(ks[5], F, D),
+        'down': packed(ks[6], D, F),
+    }
+    return {'embed': bf16(ks[7], (V, D), 0.02),
+            'layers': layers,
+            'final_norm': {'scale': jnp.ones((D,), dt)},
+            'lm_head': bf16(ks[8], (D, V), 1.0 / np.sqrt(D))}
+
+
 def quantize_params(params, cfg, mode: str = 'int8'):
     """Return a copy of `params` with layer matmul weights quantized to
-    ``mode`` ('int8' or 'int4').
+    ``mode`` ('int8', 'int4', or 'int4x2' — packed two-per-uint8 with
+    GROUP-wide scales, NT storage; see _pack_int4x2).
 
     Works on host numpy or device arrays (and traces cleanly under jit);
     leaves everything except the layer matmul 'w' entries untouched.
@@ -95,7 +220,8 @@ def quantize_params(params, cfg, mode: str = 'int8'):
         for name, p in layer.items():
             if isinstance(p, dict) and 'w' in p and np.ndim(p['w']) >= 2:
                 if getattr(p['w'], 'dtype', None) in (
-                        jnp.dtype(jnp.int8), jnp.dtype(jnp.int4)):
+                        jnp.dtype(jnp.int8), jnp.dtype(jnp.int4),
+                        jnp.dtype(jnp.uint8)):
                     out[name] = p  # already quantized: keep its scales
                     continue
                 axis = -1 if name in _NT_KEYS else -2

@@ -147,6 +147,65 @@ def _is_quant(w) -> bool:
     return w.dtype in (jnp.dtype(jnp.int8), jnp.dtype(jnp.int4))
 
 
+def _is_packed(w) -> bool:
+    """int4x2 packed weights (nn/quant.py): uint8, two nibbles each."""
+    return w.dtype == jnp.dtype(jnp.uint8)
+
+
+def _unpack_int4x2(w):
+    """(..., K/2) uint8 -> (..., K) int8 in [-7, 7].  Split-half pairing
+    (quant._pack_int4x2): low nibbles are elements [0, K/2), high
+    nibbles [K/2, K) — the unpack is two nibble-extracts + a concat in
+    natural order, with no stride-2 interleave to materialize."""
+    lo = jnp.bitwise_and(w, 0xF).astype(jnp.int8)
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.right_shift(w, 4).astype(jnp.int8)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    return jnp.concatenate([lo, hi], axis=-1)
+
+
+def _packed_matmul(x, p, act_quant=False, pre=None):
+    """W4A8 / W4 matmul for int4x2-packed weights.
+
+    ``p['w']``: (out, K/2) uint8 (NT orientation for every projection —
+    quant._pack_int4x2 normalizes); ``p['s']``: (out, K/GROUP) group
+    scales.  The contraction runs per 128-wide group so each group's
+    int32 partial sum can be rescaled by its own factor: y[o] = xs *
+    sum_g s[o,g] * (xq[g] . w[o,g]).  G=128 matches the MXU tile, so
+    the batched small contractions still run on the systolic array.
+    """
+    from .quant import GROUP
+    # Defeat while-loop invariant code motion: the packed bytes are
+    # loop-invariant in the decode loop, and XLA will otherwise hoist
+    # the nibble unpack out of it and materialize the full int8 weight
+    # stack (6.7 GB at 7B — measured OOM at batch 128).  XOR-ing with a
+    # barrier-wrapped zero derived from the (always loop-variant)
+    # activation makes the unpack loop-variant, so it stays fused into
+    # each step's matmul read and the HBM stream stays 4-bit.
+    zero = jax.lax.optimization_barrier(
+        x.ravel()[0] * 0).astype(jnp.uint8)
+    w8 = _unpack_int4x2(jnp.bitwise_xor(p['w'], zero))   # (out, K) int8
+    out, K = w8.shape[-2], w8.shape[-1]
+    g = K // GROUP
+    wg = w8.reshape(*w8.shape[:-1], g, GROUP)            # (out, g, G)
+    s = p['s'].astype(jnp.float32)                       # (out, g)
+    lead = x.shape[:-1]
+    if act_quant:
+        xq, xs = pre if pre is not None else _dyn_act_quant(x)
+        xg = xq.reshape(*lead, g, GROUP)
+        partial = jnp.einsum('...gi,ogi->...og', xg, wg,
+                             preferred_element_type=jnp.int32)
+        y = jnp.einsum('...og,og->...o', partial.astype(jnp.float32), s)
+        y = (y * xs).astype(x.dtype)
+    else:
+        xg = x.astype(jnp.float32).reshape(*lead, g, GROUP)
+        wf = wg.astype(jnp.float32) * s[..., None]       # (out, g, G)
+        y = jnp.einsum('...gi,ogi->...o', xg, wf).astype(x.dtype)
+    if 'b' in p:
+        y = y + p['b']
+    return y
+
+
 def _dyn_act_quant(x):
     """Dynamic per-token symmetric int8: returns (x_int8, scales (...,1))."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
@@ -161,6 +220,8 @@ def _linear(x, p, act_quant=False, pre=None):
     several projections of the same activation (q/k/v, gate/up) share one
     dynamic-quant pass."""
     w = p['w']
+    if _is_packed(w):  # int4x2: stored NT regardless of caller
+        return _packed_matmul(x, p, act_quant, pre)
     if _is_quant(w):  # weight-only quant (nn/quant.py)
         if act_quant:
             # W8A8: int8 x int8 contraction natively on the MXU; int4
@@ -193,6 +254,8 @@ def _linear_nt(x, p, act_quant=False, pre=None):
     full-sequence path loses nothing.
     """
     w = p['w']
+    if _is_packed(w):
+        return _packed_matmul(x, p, act_quant, pre)
     if _is_quant(w):
         if act_quant:
             xq, xs = pre if pre is not None else _dyn_act_quant(x)
@@ -358,7 +421,8 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
     h = _norm(x, lp['attn_norm'], cfg)
     # head dims inferred (-1): under tp_axis the projections are local
     # shards with num_heads/n_tp (and num_kv_heads/n_tp) heads
-    h_pre = _dyn_act_quant(h) if aq and _is_quant(lp['q']['w']) else None
+    h_pre = _dyn_act_quant(h) if aq and (
+        _is_quant(lp['q']['w']) or _is_packed(lp['q']['w'])) else None
     q = _linear_nt(h, lp['q'], aq, h_pre).reshape(B, T, -1, cfg.head_dim)
     k = _linear_nt(h, lp['k'], aq, h_pre).reshape(B, T, -1, cfg.head_dim)
     v = _linear_nt(h, lp['v'], aq, h_pre).reshape(B, T, -1, cfg.head_dim)
@@ -426,8 +490,9 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
         h2 = _norm(x, lp['mlp_norm'], cfg)
 
     if cfg.gated_mlp:
-        h2_pre = _dyn_act_quant(h2) if aq and _is_quant(lp['gate']['w']) \
-            else None
+        h2_pre = _dyn_act_quant(h2) if aq and (
+            _is_quant(lp['gate']['w'])
+            or _is_packed(lp['gate']['w'])) else None
         inner = _shard(
             _act(_linear(h2, lp['gate'], aq, h2_pre), cfg.activation)
             * _linear(h2, lp['up'], aq, h2_pre),

@@ -190,6 +190,103 @@ def test_quantize_mode_validation():
         JaxLM(config='tiny', quantize='int4')  # int4 weights: not a mode
     with pytest.raises(ValueError):
         JaxLM(config='tiny', quantize='w8a8-kv2')
+    with pytest.raises(NotImplementedError):
+        JaxLM(config='tiny', quantize='w4a8',
+              parallel=dict(data=1, model=2), tokenizer_only=True)
+
+
+def test_int4x2_pack_roundtrip():
+    """Packing then unpacking restores the quantized int4 grid exactly,
+    for both storage orientations."""
+    from opencompass_tpu.nn.quant import GROUP, _pack_int4x2
+    from opencompass_tpu.nn.transformer import _unpack_int4x2
+    rng = np.random.RandomState(0)
+    w = rng.randn(2 * GROUP, 3 * GROUP).astype(np.float32)  # (in, out)
+    packed, s = _pack_int4x2(w, axis=-2, xp=np)
+    assert packed.dtype == np.uint8
+    assert packed.shape == (3 * GROUP, GROUP)        # NT, halved
+    assert s.shape == (3 * GROUP, 2)                 # (out, groups)
+    w8 = np.asarray(_unpack_int4x2(jnp.asarray(packed)))
+    assert w8.min() >= -7 and w8.max() <= 7
+    # dequantized reconstruction ~ original within one int4 step/group
+    recon = (w8.reshape(3 * GROUP, 2, GROUP).astype(np.float32)
+             * s[:, :, None]).reshape(3 * GROUP, 2 * GROUP).T
+    step = np.repeat(s.T, GROUP, axis=0).reshape(2 * GROUP, 3 * GROUP)
+    assert np.all(np.abs(recon - w) <= step / 2 + 1e-6)
+    # NT orientation input packs without the transpose
+    packed_nt, s_nt = _pack_int4x2(w.T.copy(), axis=-1, xp=np)
+    np.testing.assert_array_equal(packed, packed_nt)
+    np.testing.assert_array_equal(s, s_nt)
+
+
+def test_w4a8_forward_tracks_fp():
+    """int4x2 weights with group scales keep the forward usable: logits
+    correlate with full precision and the NLL ranking survives (group
+    RTN int4 is coarser than int8 — tolerances reflect that)."""
+    cfg128 = TransformerConfig.llama(
+        vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=4, intermediate_size=256, max_seq_len=64,
+        dtype='float32')
+    cfga = dataclasses.replace(cfg128, act_quant=True)
+    params = init_params(cfg128, jax.random.PRNGKey(0))
+    q4 = quantize_params(params, cfg128, mode='int4x2')
+    assert q4['layers']['q']['w'].dtype == jnp.uint8
+    assert q4['layers']['down']['w'].dtype == jnp.uint8
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (4, 24), 0, 512)
+    mask = jnp.ones((4, 24), bool)
+    ref = np.asarray(forward(params, cfg128, tokens, mask,
+                             use_flash=False))
+    got = np.asarray(forward(q4, cfga, tokens, mask, use_flash=False))
+    assert np.all(np.isfinite(got))
+    # group-RTN int4 on random gaussian weights is the worst case (no
+    # outlier structure to hide behind): correlation, not closeness, is
+    # the hermetic bar — cross-precision eval agreement at real geometry
+    # is measured by tools/quant_agreement.py --quant w4a8-kv4
+    cos = np.dot(ref.ravel(), got.ravel()) / (
+        np.linalg.norm(ref) * np.linalg.norm(got))
+    assert cos > 0.9, f'w4a8 decorrelated: cos={cos}'
+    # per-sample NLL shift stays small (argmin over 4 i.i.d. random
+    # sequences is a statistical tie at this scale — see nn/agreement.py
+    # on tie bands — so the bar is the NLL shift, not the tie-break)
+    nll_ref = np.asarray(sequence_nll(jnp.asarray(ref), tokens, mask))
+    nll_got = np.asarray(sequence_nll(jnp.asarray(got), tokens, mask))
+    assert np.all(np.abs(nll_got - nll_ref) / nll_ref < 0.02)
+
+
+def test_w4a8_decode_runs_and_tracks():
+    cfg128 = TransformerConfig.llama(
+        vocab_size=512, hidden_size=128, num_layers=2, num_heads=4,
+        num_kv_heads=4, intermediate_size=256, max_seq_len=64,
+        dtype='float32')
+    cfg_hl = dataclasses.replace(cfg128, act_quant=True, kv_quant='int4')
+    params = init_params(cfg128, jax.random.PRNGKey(0))
+    q4 = quantize_params(params, cfg128, mode='int4x2')
+    tokens = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, 512)
+    mask = jnp.ones((2, 8), bool)
+    out_q, _ = jax.jit(lambda p, t, m: greedy_generate(
+        p, cfg_hl, t, m, 8))(q4, tokens, mask)
+    assert out_q.shape == (2, 8)
+    # wiring check (free-running cross-precision agreement on a tiny
+    # random model is chaos, not signal): the packed decode path's first
+    # token must equal the packed parallel forward's argmax — prefill,
+    # cache, and _packed_matmul all agree with each other
+    logits_q = forward(q4, cfg_hl, tokens, mask, use_flash=False)
+    first = np.asarray(jnp.argmax(logits_q[:, -1], -1))
+    assert (np.asarray(out_q)[:, 0] == first).all()
+
+
+def test_jaxlm_w4a8_kv4_end_to_end():
+    lm = JaxLM(config=dict(preset='llama', vocab_size=512,
+                           hidden_size=128, num_layers=2, num_heads=4,
+                           num_kv_heads=4, intermediate_size=256,
+                           max_seq_len=128),
+               max_seq_len=128, quantize='w4a8-kv4')
+    assert lm.cfg.act_quant and lm.cfg.kv_quant_mode == 'int4'
+    assert lm.params['layers']['q']['w'].dtype == jnp.uint8
+    out = lm.generate(['hello world'], max_out_len=6)
+    assert len(out) == 1
+    nll = lm.get_ppl(['finite scoring please'])
+    assert np.isfinite(nll[0])
 
 
 def test_int4_weight_quantize_forward_close():

@@ -56,7 +56,10 @@ def _gen(params, cfg, prompts, pmask, n_new):
 
 
 def measure(geometry='7b', items=64, choices=4, seq=128, gen_batch=32,
-            gen_prompt=128, gen_new=64, seed=0):
+            gen_prompt=128, gen_new=64, seed=0, quant='w8a8-kv4'):
+    """``quant``: 'w8a8-kv4' (the pinned serving recipe) or 'w4a8-kv4'
+    (packed int4x2 weights — nn/quant.py — group-RTN, coarser)."""
+    weight_mode = 'int4x2' if quant.startswith('w4') else 'int8'
     cfg = TransformerConfig.llama(**GEOMETRIES[geometry])
     cfg_aq = dataclasses.replace(cfg, act_quant=True)
     cfg_hl = dataclasses.replace(cfg, act_quant=True, kv_quant='int4')
@@ -89,28 +92,32 @@ def measure(geometry='7b', items=64, choices=4, seq=128, gen_batch=32,
     # same key => same weights, re-materialized straight into int8 so the
     # bf16 and int8 trees never coexist in HBM
     qparams = jax.jit(
-        lambda k: quantize_params(init_params(cfg, k), cfg))(key)
+        lambda k: quantize_params(init_params(cfg, k), cfg,
+                                  mode=weight_mode))(key)
     jax.block_until_ready(qparams)
-    note('int8 params ready')
+    note('%s params ready' % weight_mode)
+    wtag_note = quant.split('-')[0]
     nll_q = score_pool(qparams, cfg_aq, tokens, mask)
-    note('w8a8 scoring done')
+    note('%s scoring done' % wtag_note)
     out_q = _gen(qparams, cfg_hl, prompts, pmask, gen_new)
-    note('w8a8-kv4 greedy done')
+    note('%s greedy done' % quant)
     lp_q, am_q, _, rank_q = forced_decode(qparams, cfg_hl, prompts[:fr],
                                           pmask[:fr], forced)
-    note('w8a8-kv4 forced decode done')
+    note('%s forced decode done' % quant)
     del qparams
     jax.clear_caches()
 
+    wtag = quant.split('-')[0]
     return {
         'geometry': geometry,
+        'quant': quant,
         'config': '%dx%d heads=%d vocab=%d' % (
             cfg.hidden_size, cfg.num_layers, cfg.num_heads, cfg.vocab_size),
         'platform': jax.devices()[0].platform,
-        'scoring_w8a8_vs_bf16': scoring_stats(nll_fp, nll_q, choices),
+        'scoring_%s_vs_bf16' % wtag: scoring_stats(nll_fp, nll_q, choices),
         'scoring_pool': {'items': items, 'choices': choices, 'seq': seq},
-        'gen_w8a8kv4_vs_bf16': gen_stats(out_fp, out_q),
-        'forced_decode_w8a8kv4_vs_bf16': forced_stats(
+        'gen_%skv4_vs_bf16' % wtag: gen_stats(out_fp, out_q),
+        'forced_decode_%skv4_vs_bf16' % wtag: forced_stats(
             forced, am_fp, margin_fp, lp_fp, am_q, rank_q, lp_q),
         'gen_pool': {'batch': gen_batch, 'prompt': gen_prompt,
                      'new': gen_new, 'forced_rows': fr},
@@ -127,9 +134,12 @@ def main():
     ap.add_argument('--gen-batch', type=int, default=32)
     ap.add_argument('--gen-prompt', type=int, default=128)
     ap.add_argument('--gen-new', type=int, default=64)
+    ap.add_argument('--quant', default='w8a8-kv4',
+                    choices=['w8a8-kv4', 'w4a8-kv4'])
     args = ap.parse_args()
     rec = measure(args.geometry, args.items, args.choices, args.seq,
-                  args.gen_batch, args.gen_prompt, args.gen_new)
+                  args.gen_batch, args.gen_prompt, args.gen_new,
+                  quant=args.quant)
     print(json.dumps(rec))
 
 
