@@ -1,0 +1,13229 @@
+mmlu_datasets = [
+    {
+        'abbr': 'lukaemon_mmlu_college_biology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_chemistry',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_computer_science',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_electrical_engineering',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'electrical_engineering',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_astronomy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'astronomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_anatomy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'anatomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_abstract_algebra',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'abstract_algebra',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_machine_learning',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'machine_learning',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_clinical_knowledge',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'clinical_knowledge',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_global_facts',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'global_facts',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_management',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'management',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_nutrition',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'nutrition',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_marketing',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'marketing',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_accounting',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_accounting',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_geography',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_geography',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_international_law',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'international_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_scenarios',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_scenarios',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_computer_security',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'computer_security',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_microeconomics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_microeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_law',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_medical_genetics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'medical_genetics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_psychology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_jurisprudence',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'jurisprudence',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_world_religions',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'world_religions',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_philosophy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'philosophy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_virology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'virology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_chemistry',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_public_relations',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'public_relations',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_macroeconomics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_macroeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_sexuality',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_sexuality',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_elementary_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'elementary_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_computer_science',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_european_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_european_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_business_ethics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'business_ethics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_disputes',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_disputes',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_statistics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_statistics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_miscellaneous',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'miscellaneous',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_formal_logic',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'formal_logic',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_government_and_politics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_government_and_politics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_prehistory',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'prehistory',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_security_studies',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'security_studies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_biology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_logical_fallacies',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'logical_fallacies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_world_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_world_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_medicine',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_medicine',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_us_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_us_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_sociology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'sociology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_econometrics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'econometrics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_psychology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_aging',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_aging',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_us_foreign_policy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'us_foreign_policy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_conceptual_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'conceptual_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    }
+]
+ceval_datasets = [
+    {
+        'abbr': 'ceval-computer_network',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'computer_network',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于计算机网络考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-operating_system',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'operating_system',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于操作系统考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-computer_architecture',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'computer_architecture',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于计算机组成考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-college_programming',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'college_programming',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于大学编程考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-college_physics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'college_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于大学物理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-college_chemistry',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'college_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于大学化学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-advanced_mathematics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'advanced_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高等数学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-probability_and_statistics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'probability_and_statistics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于概率统计考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-discrete_mathematics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'discrete_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于离散数学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-electrical_engineer',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'electrical_engineer',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于注册电气工程师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-metrology_engineer',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'metrology_engineer',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于注册计量师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_mathematics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中数学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_physics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中物理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_chemistry',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中化学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_biology',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中生物考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_mathematics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中数学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_biology',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中生物考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_physics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中物理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_chemistry',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中化学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-veterinary_medicine',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'veterinary_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于兽医学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-college_economics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'college_economics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于大学经济学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-business_administration',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'business_administration',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于工商管理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-marxism',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'marxism',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于马克思主义基本原理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-mao_zedong_thought',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'mao_zedong_thought',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于毛泽东思想和中国特色社会主义理论体系概论考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-education_science',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'education_science',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于教育学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-teacher_qualification',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'teacher_qualification',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于教师资格考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_politics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_politics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中政治考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_geography',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_geography',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中地理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_politics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_politics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中政治考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_geography',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_geography',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中地理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-modern_chinese_history',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'modern_chinese_history',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于近代史纲要考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-ideological_and_moral_cultivation',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'ideological_and_moral_cultivation',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于思想道德修养与法律基础考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-logic',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'logic',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于逻辑学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-law',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'law',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于法学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-chinese_language_and_literature',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'chinese_language_and_literature',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于中国语言文学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-art_studies',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'art_studies',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于艺术学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-professional_tour_guide',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'professional_tour_guide',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于导游资格考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-legal_professional',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'legal_professional',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于法律职业资格考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_chinese',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_chinese',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中语文考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_history',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_history',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中历史考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_history',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_history',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中历史考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-civil_servant',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'civil_servant',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于公务员考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-sports_science',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'sports_science',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于体育学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-plant_protection',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'plant_protection',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于植物保护考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-basic_medicine',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'basic_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于基础医学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-clinical_medicine',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'clinical_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于临床医学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-urban_and_rural_planner',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'urban_and_rural_planner',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于注册城乡规划师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-accountant',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'accountant',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于注册会计师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-fire_engineer',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'fire_engineer',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于注册消防工程师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-environmental_impact_assessment_engineer',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'environmental_impact_assessment_engineer',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于环境影响评价工程师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-tax_accountant',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'tax_accountant',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于税务师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-physician',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'physician',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于医师资格考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    }
+]
+arc_datasets = [
+    {
+        'abbr': 'ARC-c',
+        'type': 'opencompass_tpu.datasets.arc.ARCDataset',
+        'path': './data/ARC/ARC-c/ARC-Challenge-Dev.jsonl',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'textA',
+                'textB',
+                'textC',
+                'textD'
+            ],
+            'output_column': 'answerKey'
+        },
+        'infer_cfg': {
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'Question: {question}\nAnswer: {textA}',
+                    'B': 'Question: {question}\nAnswer: {textB}',
+                    'C': 'Question: {question}\nAnswer: {textC}',
+                    'D': 'Question: {question}\nAnswer: {textD}'
+                }
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer'
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'ARC-e',
+        'type': 'opencompass_tpu.datasets.arc.ARCDataset',
+        'path': './data/ARC/ARC-e/ARC-Easy-Dev.jsonl',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'textA',
+                'textB',
+                'textC',
+                'textD'
+            ],
+            'output_column': 'answerKey'
+        },
+        'infer_cfg': {
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'Question: {question}\nAnswer: {textA}',
+                    'B': 'Question: {question}\nAnswer: {textB}',
+                    'C': 'Question: {question}\nAnswer: {textC}',
+                    'D': 'Question: {question}\nAnswer: {textD}'
+                }
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer'
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    }
+]
+BoolQ_datasets = [
+    {
+        'abbr': 'BoolQ_letter',
+        'type': 'BoolQDataset_V2',
+        'path': './data/SuperGLUE/BoolQ/val.jsonl',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'passage'
+            ],
+            'output_column': 'label'
+        },
+        'infer_cfg': {
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{passage}\nQuestion: {question}?\nA. Yes\nB. No\nAnswer: A',
+                    'B': '{passage}\nQuestion: {question}?\nA. Yes\nB. No\nAnswer: B'
+                }
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer'
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    }
+]
+gsm8k_datasets = [
+    {
+        'abbr': 'gsm8k',
+        'type': 'opencompass_tpu.datasets.gsm8k.GSM8KDataset',
+        'path': './data/gsm8k',
+        'reader_cfg': {
+            'input_columns': [
+                'question'
+            ],
+            'output_column': 'answer'
+        },
+        'infer_cfg': {
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': "Question: A pencil costs 3 dollars and a notebook costs 5 dollars. How much do 2 pencils and 1 notebook cost?\nLet's think step by step\nAnswer:\nTwo pencils cost 2 x 3 = 6 dollars.\nAdding one notebook costs 6 + 5 = 11 dollars.\nThe answer is 11\n\nQuestion: A farm has 12 cows and sells a quarter of them. How many cows remain?\nLet's think step by step\nAnswer:\nA quarter of 12 is 12 / 4 = 3 cows sold.\nSo 12 - 3 = 9 cows remain.\nThe answer is 9\n\nQuestion: {question}\nLet's think step by step\nAnswer:{answer}"
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'max_out_len': 512
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'opencompass_tpu.datasets.gsm8k.gsm8k_postprocess'
+            },
+            'dataset_postprocessor': {
+                'type': 'opencompass_tpu.datasets.gsm8k.gsm8k_dataset_postprocess'
+            }
+        }
+    }
+]
+triviaqa_datasets = [
+    {
+        'abbr': 'triviaqa',
+        'type': 'opencompass_tpu.datasets.triviaqa.TriviaQADataset',
+        'path': './data/triviaqa',
+        'reader_cfg': {
+            'input_columns': [
+                'question'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'ice_token': '</E>',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '</E>Answer these questions:\nQ: {question}\nA: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                }
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'max_out_len': 50
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.datasets.triviaqa.TriviaQAEvaluator'
+            },
+            'pred_role': 'BOT'
+        }
+    }
+]
+mmlu_summary_groups = [
+    {
+        'name': 'mmlu',
+        'subsets': [
+            'lukaemon_mmlu_college_biology',
+            'lukaemon_mmlu_college_chemistry',
+            'lukaemon_mmlu_college_computer_science',
+            'lukaemon_mmlu_college_mathematics',
+            'lukaemon_mmlu_college_physics',
+            'lukaemon_mmlu_electrical_engineering',
+            'lukaemon_mmlu_astronomy',
+            'lukaemon_mmlu_anatomy',
+            'lukaemon_mmlu_abstract_algebra',
+            'lukaemon_mmlu_machine_learning',
+            'lukaemon_mmlu_clinical_knowledge',
+            'lukaemon_mmlu_global_facts',
+            'lukaemon_mmlu_management',
+            'lukaemon_mmlu_nutrition',
+            'lukaemon_mmlu_marketing',
+            'lukaemon_mmlu_professional_accounting',
+            'lukaemon_mmlu_high_school_geography',
+            'lukaemon_mmlu_international_law',
+            'lukaemon_mmlu_moral_scenarios',
+            'lukaemon_mmlu_computer_security',
+            'lukaemon_mmlu_high_school_microeconomics',
+            'lukaemon_mmlu_professional_law',
+            'lukaemon_mmlu_medical_genetics',
+            'lukaemon_mmlu_professional_psychology',
+            'lukaemon_mmlu_jurisprudence',
+            'lukaemon_mmlu_world_religions',
+            'lukaemon_mmlu_philosophy',
+            'lukaemon_mmlu_virology',
+            'lukaemon_mmlu_high_school_chemistry',
+            'lukaemon_mmlu_public_relations',
+            'lukaemon_mmlu_high_school_macroeconomics',
+            'lukaemon_mmlu_human_sexuality',
+            'lukaemon_mmlu_elementary_mathematics',
+            'lukaemon_mmlu_high_school_physics',
+            'lukaemon_mmlu_high_school_computer_science',
+            'lukaemon_mmlu_high_school_european_history',
+            'lukaemon_mmlu_business_ethics',
+            'lukaemon_mmlu_moral_disputes',
+            'lukaemon_mmlu_high_school_statistics',
+            'lukaemon_mmlu_miscellaneous',
+            'lukaemon_mmlu_formal_logic',
+            'lukaemon_mmlu_high_school_government_and_politics',
+            'lukaemon_mmlu_prehistory',
+            'lukaemon_mmlu_security_studies',
+            'lukaemon_mmlu_high_school_biology',
+            'lukaemon_mmlu_logical_fallacies',
+            'lukaemon_mmlu_high_school_world_history',
+            'lukaemon_mmlu_professional_medicine',
+            'lukaemon_mmlu_high_school_mathematics',
+            'lukaemon_mmlu_college_medicine',
+            'lukaemon_mmlu_high_school_us_history',
+            'lukaemon_mmlu_sociology',
+            'lukaemon_mmlu_econometrics',
+            'lukaemon_mmlu_high_school_psychology',
+            'lukaemon_mmlu_human_aging',
+            'lukaemon_mmlu_us_foreign_policy',
+            'lukaemon_mmlu_conceptual_physics'
+        ]
+    }
+]
+ceval_summary_groups = [
+    {
+        'name': 'ceval-humanities',
+        'subsets': [
+            'ceval-modern_chinese_history',
+            'ceval-ideological_and_moral_cultivation',
+            'ceval-logic',
+            'ceval-law',
+            'ceval-chinese_language_and_literature',
+            'ceval-art_studies',
+            'ceval-professional_tour_guide',
+            'ceval-legal_professional',
+            'ceval-high_school_chinese',
+            'ceval-high_school_history',
+            'ceval-middle_school_history'
+        ]
+    },
+    {
+        'name': 'ceval-other',
+        'subsets': [
+            'ceval-civil_servant',
+            'ceval-sports_science',
+            'ceval-plant_protection',
+            'ceval-basic_medicine',
+            'ceval-clinical_medicine',
+            'ceval-urban_and_rural_planner',
+            'ceval-accountant',
+            'ceval-fire_engineer',
+            'ceval-environmental_impact_assessment_engineer',
+            'ceval-tax_accountant',
+            'ceval-physician'
+        ]
+    },
+    {
+        'name': 'ceval-stem',
+        'subsets': [
+            'ceval-computer_network',
+            'ceval-operating_system',
+            'ceval-computer_architecture',
+            'ceval-college_programming',
+            'ceval-college_physics',
+            'ceval-college_chemistry',
+            'ceval-advanced_mathematics',
+            'ceval-probability_and_statistics',
+            'ceval-discrete_mathematics',
+            'ceval-electrical_engineer',
+            'ceval-metrology_engineer',
+            'ceval-high_school_mathematics',
+            'ceval-high_school_physics',
+            'ceval-high_school_chemistry',
+            'ceval-high_school_biology',
+            'ceval-middle_school_mathematics',
+            'ceval-middle_school_biology',
+            'ceval-middle_school_physics',
+            'ceval-middle_school_chemistry',
+            'ceval-veterinary_medicine'
+        ]
+    },
+    {
+        'name': 'ceval-social-science',
+        'subsets': [
+            'ceval-college_economics',
+            'ceval-business_administration',
+            'ceval-marxism',
+            'ceval-mao_zedong_thought',
+            'ceval-education_science',
+            'ceval-teacher_qualification',
+            'ceval-high_school_politics',
+            'ceval-high_school_geography',
+            'ceval-middle_school_politics',
+            'ceval-middle_school_geography'
+        ]
+    },
+    {
+        'name': 'ceval',
+        'subsets': [
+            'ceval-computer_network',
+            'ceval-operating_system',
+            'ceval-computer_architecture',
+            'ceval-college_programming',
+            'ceval-college_physics',
+            'ceval-college_chemistry',
+            'ceval-advanced_mathematics',
+            'ceval-probability_and_statistics',
+            'ceval-discrete_mathematics',
+            'ceval-electrical_engineer',
+            'ceval-metrology_engineer',
+            'ceval-high_school_mathematics',
+            'ceval-high_school_physics',
+            'ceval-high_school_chemistry',
+            'ceval-high_school_biology',
+            'ceval-middle_school_mathematics',
+            'ceval-middle_school_biology',
+            'ceval-middle_school_physics',
+            'ceval-middle_school_chemistry',
+            'ceval-veterinary_medicine',
+            'ceval-college_economics',
+            'ceval-business_administration',
+            'ceval-marxism',
+            'ceval-mao_zedong_thought',
+            'ceval-education_science',
+            'ceval-teacher_qualification',
+            'ceval-high_school_politics',
+            'ceval-high_school_geography',
+            'ceval-middle_school_politics',
+            'ceval-middle_school_geography',
+            'ceval-modern_chinese_history',
+            'ceval-ideological_and_moral_cultivation',
+            'ceval-logic',
+            'ceval-law',
+            'ceval-chinese_language_and_literature',
+            'ceval-art_studies',
+            'ceval-professional_tour_guide',
+            'ceval-legal_professional',
+            'ceval-high_school_chinese',
+            'ceval-high_school_history',
+            'ceval-middle_school_history',
+            'ceval-civil_servant',
+            'ceval-sports_science',
+            'ceval-plant_protection',
+            'ceval-basic_medicine',
+            'ceval-clinical_medicine',
+            'ceval-urban_and_rural_planner',
+            'ceval-accountant',
+            'ceval-fire_engineer',
+            'ceval-environmental_impact_assessment_engineer',
+            'ceval-tax_accountant',
+            'ceval-physician'
+        ]
+    }
+]
+datasets = [
+    {
+        'abbr': 'lukaemon_mmlu_college_biology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_chemistry',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_computer_science',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_electrical_engineering',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'electrical_engineering',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_astronomy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'astronomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_anatomy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'anatomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_abstract_algebra',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'abstract_algebra',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_machine_learning',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'machine_learning',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_clinical_knowledge',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'clinical_knowledge',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_global_facts',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'global_facts',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_management',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'management',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_nutrition',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'nutrition',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_marketing',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'marketing',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_accounting',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_accounting',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_geography',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_geography',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_international_law',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'international_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_scenarios',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_scenarios',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_computer_security',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'computer_security',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_microeconomics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_microeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_law',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_medical_genetics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'medical_genetics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_psychology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_jurisprudence',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'jurisprudence',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_world_religions',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'world_religions',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_philosophy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'philosophy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_virology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'virology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_chemistry',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_public_relations',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'public_relations',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_macroeconomics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_macroeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_sexuality',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_sexuality',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_elementary_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'elementary_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_computer_science',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_european_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_european_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_business_ethics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'business_ethics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_disputes',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_disputes',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_statistics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_statistics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_miscellaneous',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'miscellaneous',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_formal_logic',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'formal_logic',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_government_and_politics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_government_and_politics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_prehistory',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'prehistory',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_security_studies',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'security_studies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_biology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_logical_fallacies',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'logical_fallacies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_world_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_world_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_medicine',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_medicine',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_us_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_us_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_sociology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'sociology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_econometrics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'econometrics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_psychology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_aging',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_aging',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_us_foreign_policy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'us_foreign_policy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_conceptual_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'conceptual_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-computer_network',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'computer_network',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于计算机网络考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-operating_system',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'operating_system',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于操作系统考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-computer_architecture',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'computer_architecture',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于计算机组成考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-college_programming',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'college_programming',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于大学编程考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-college_physics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'college_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于大学物理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-college_chemistry',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'college_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于大学化学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-advanced_mathematics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'advanced_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高等数学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-probability_and_statistics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'probability_and_statistics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于概率统计考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-discrete_mathematics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'discrete_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于离散数学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-electrical_engineer',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'electrical_engineer',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于注册电气工程师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-metrology_engineer',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'metrology_engineer',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于注册计量师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_mathematics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中数学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_physics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中物理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_chemistry',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中化学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_biology',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中生物考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_mathematics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中数学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_biology',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中生物考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_physics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中物理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_chemistry',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中化学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-veterinary_medicine',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'veterinary_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于兽医学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-college_economics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'college_economics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于大学经济学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-business_administration',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'business_administration',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于工商管理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-marxism',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'marxism',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于马克思主义基本原理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-mao_zedong_thought',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'mao_zedong_thought',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于毛泽东思想和中国特色社会主义理论体系概论考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-education_science',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'education_science',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于教育学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-teacher_qualification',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'teacher_qualification',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于教师资格考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_politics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_politics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中政治考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_geography',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_geography',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中地理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_politics',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_politics',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中政治考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_geography',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_geography',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中地理考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-modern_chinese_history',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'modern_chinese_history',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于近代史纲要考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-ideological_and_moral_cultivation',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'ideological_and_moral_cultivation',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于思想道德修养与法律基础考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-logic',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'logic',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于逻辑学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-law',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'law',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于法学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-chinese_language_and_literature',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'chinese_language_and_literature',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于中国语言文学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-art_studies',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'art_studies',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于艺术学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-professional_tour_guide',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'professional_tour_guide',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于导游资格考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-legal_professional',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'legal_professional',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于法律职业资格考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_chinese',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_chinese',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中语文考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-high_school_history',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'high_school_history',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于高中历史考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-middle_school_history',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'middle_school_history',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于初中历史考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-civil_servant',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'civil_servant',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于公务员考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-sports_science',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'sports_science',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于体育学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-plant_protection',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'plant_protection',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于植物保护考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-basic_medicine',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'basic_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于基础医学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-clinical_medicine',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'clinical_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于临床医学考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-urban_and_rural_planner',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'urban_and_rural_planner',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于注册城乡规划师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-accountant',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'accountant',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于注册会计师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-fire_engineer',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'fire_engineer',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于注册消防工程师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-environmental_impact_assessment_engineer',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'environmental_impact_assessment_engineer',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于环境影响评价工程师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-tax_accountant',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'tax_accountant',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于税务师考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ceval-physician',
+        'type': 'opencompass_tpu.datasets.ceval.CEvalDataset',
+        'path': './data/ceval/formal_ceval',
+        'name': 'physician',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'val'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '以下是中国关于医师资格考试的单项选择题，请选出其中的正确答案。\n{question}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\n答案: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'ARC-c',
+        'type': 'opencompass_tpu.datasets.arc.ARCDataset',
+        'path': './data/ARC/ARC-c/ARC-Challenge-Dev.jsonl',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'textA',
+                'textB',
+                'textC',
+                'textD'
+            ],
+            'output_column': 'answerKey'
+        },
+        'infer_cfg': {
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'Question: {question}\nAnswer: {textA}',
+                    'B': 'Question: {question}\nAnswer: {textB}',
+                    'C': 'Question: {question}\nAnswer: {textC}',
+                    'D': 'Question: {question}\nAnswer: {textD}'
+                }
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer'
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'ARC-e',
+        'type': 'opencompass_tpu.datasets.arc.ARCDataset',
+        'path': './data/ARC/ARC-e/ARC-Easy-Dev.jsonl',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'textA',
+                'textB',
+                'textC',
+                'textD'
+            ],
+            'output_column': 'answerKey'
+        },
+        'infer_cfg': {
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'Question: {question}\nAnswer: {textA}',
+                    'B': 'Question: {question}\nAnswer: {textB}',
+                    'C': 'Question: {question}\nAnswer: {textC}',
+                    'D': 'Question: {question}\nAnswer: {textD}'
+                }
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer'
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'BoolQ_letter',
+        'type': 'BoolQDataset_V2',
+        'path': './data/SuperGLUE/BoolQ/val.jsonl',
+        'reader_cfg': {
+            'input_columns': [
+                'question',
+                'passage'
+            ],
+            'output_column': 'label'
+        },
+        'infer_cfg': {
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{passage}\nQuestion: {question}?\nA. Yes\nB. No\nAnswer: A',
+                    'B': '{passage}\nQuestion: {question}?\nA. Yes\nB. No\nAnswer: B'
+                }
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer'
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'gsm8k',
+        'type': 'opencompass_tpu.datasets.gsm8k.GSM8KDataset',
+        'path': './data/gsm8k',
+        'reader_cfg': {
+            'input_columns': [
+                'question'
+            ],
+            'output_column': 'answer'
+        },
+        'infer_cfg': {
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': "Question: A pencil costs 3 dollars and a notebook costs 5 dollars. How much do 2 pencils and 1 notebook cost?\nLet's think step by step\nAnswer:\nTwo pencils cost 2 x 3 = 6 dollars.\nAdding one notebook costs 6 + 5 = 11 dollars.\nThe answer is 11\n\nQuestion: A farm has 12 cows and sells a quarter of them. How many cows remain?\nLet's think step by step\nAnswer:\nA quarter of 12 is 12 / 4 = 3 cows sold.\nSo 12 - 3 = 9 cows remain.\nThe answer is 9\n\nQuestion: {question}\nLet's think step by step\nAnswer:{answer}"
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'max_out_len': 512
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'opencompass_tpu.datasets.gsm8k.gsm8k_postprocess'
+            },
+            'dataset_postprocessor': {
+                'type': 'opencompass_tpu.datasets.gsm8k.gsm8k_dataset_postprocess'
+            }
+        }
+    },
+    {
+        'abbr': 'triviaqa',
+        'type': 'opencompass_tpu.datasets.triviaqa.TriviaQADataset',
+        'path': './data/triviaqa',
+        'reader_cfg': {
+            'input_columns': [
+                'question'
+            ],
+            'output_column': 'answer',
+            'train_split': 'dev',
+            'test_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'ice_token': '</E>',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': '</E>Answer these questions:\nQ: {question}\nA: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{answer}'
+                        }
+                    ]
+                }
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.zero.ZeroRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'max_out_len': 50
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.datasets.triviaqa.TriviaQAEvaluator'
+            },
+            'pred_role': 'BOT'
+        }
+    }
+]
+models = [
+    {
+        'type': 'opencompass_tpu.models.jax_lm.JaxLM',
+        'abbr': 'llama-1b-jax',
+        'path': '',
+        'config': {
+            'preset': 'llama',
+            'vocab_size': 32000,
+            'hidden_size': 2048,
+            'num_layers': 16,
+            'num_heads': 16,
+            'num_kv_heads': 16,
+            'intermediate_size': 5632,
+            'max_seq_len': 2048
+        },
+        'max_seq_len': 2048,
+        'batch_size': 16,
+        'max_out_len': 64,
+        'dtype': 'bfloat16',
+        'quantize': 'w8a8-kv4',
+        'parallel': {
+            'data': -1,
+            'model': 1
+        },
+        'run_cfg': {
+            'num_devices': 1
+        }
+    }
+]
+summarizer = {
+    'summary_groups': [
+        {
+            'name': 'mmlu',
+            'subsets': [
+                'lukaemon_mmlu_college_biology',
+                'lukaemon_mmlu_college_chemistry',
+                'lukaemon_mmlu_college_computer_science',
+                'lukaemon_mmlu_college_mathematics',
+                'lukaemon_mmlu_college_physics',
+                'lukaemon_mmlu_electrical_engineering',
+                'lukaemon_mmlu_astronomy',
+                'lukaemon_mmlu_anatomy',
+                'lukaemon_mmlu_abstract_algebra',
+                'lukaemon_mmlu_machine_learning',
+                'lukaemon_mmlu_clinical_knowledge',
+                'lukaemon_mmlu_global_facts',
+                'lukaemon_mmlu_management',
+                'lukaemon_mmlu_nutrition',
+                'lukaemon_mmlu_marketing',
+                'lukaemon_mmlu_professional_accounting',
+                'lukaemon_mmlu_high_school_geography',
+                'lukaemon_mmlu_international_law',
+                'lukaemon_mmlu_moral_scenarios',
+                'lukaemon_mmlu_computer_security',
+                'lukaemon_mmlu_high_school_microeconomics',
+                'lukaemon_mmlu_professional_law',
+                'lukaemon_mmlu_medical_genetics',
+                'lukaemon_mmlu_professional_psychology',
+                'lukaemon_mmlu_jurisprudence',
+                'lukaemon_mmlu_world_religions',
+                'lukaemon_mmlu_philosophy',
+                'lukaemon_mmlu_virology',
+                'lukaemon_mmlu_high_school_chemistry',
+                'lukaemon_mmlu_public_relations',
+                'lukaemon_mmlu_high_school_macroeconomics',
+                'lukaemon_mmlu_human_sexuality',
+                'lukaemon_mmlu_elementary_mathematics',
+                'lukaemon_mmlu_high_school_physics',
+                'lukaemon_mmlu_high_school_computer_science',
+                'lukaemon_mmlu_high_school_european_history',
+                'lukaemon_mmlu_business_ethics',
+                'lukaemon_mmlu_moral_disputes',
+                'lukaemon_mmlu_high_school_statistics',
+                'lukaemon_mmlu_miscellaneous',
+                'lukaemon_mmlu_formal_logic',
+                'lukaemon_mmlu_high_school_government_and_politics',
+                'lukaemon_mmlu_prehistory',
+                'lukaemon_mmlu_security_studies',
+                'lukaemon_mmlu_high_school_biology',
+                'lukaemon_mmlu_logical_fallacies',
+                'lukaemon_mmlu_high_school_world_history',
+                'lukaemon_mmlu_professional_medicine',
+                'lukaemon_mmlu_high_school_mathematics',
+                'lukaemon_mmlu_college_medicine',
+                'lukaemon_mmlu_high_school_us_history',
+                'lukaemon_mmlu_sociology',
+                'lukaemon_mmlu_econometrics',
+                'lukaemon_mmlu_high_school_psychology',
+                'lukaemon_mmlu_human_aging',
+                'lukaemon_mmlu_us_foreign_policy',
+                'lukaemon_mmlu_conceptual_physics'
+            ]
+        },
+        {
+            'name': 'ceval-humanities',
+            'subsets': [
+                'ceval-modern_chinese_history',
+                'ceval-ideological_and_moral_cultivation',
+                'ceval-logic',
+                'ceval-law',
+                'ceval-chinese_language_and_literature',
+                'ceval-art_studies',
+                'ceval-professional_tour_guide',
+                'ceval-legal_professional',
+                'ceval-high_school_chinese',
+                'ceval-high_school_history',
+                'ceval-middle_school_history'
+            ]
+        },
+        {
+            'name': 'ceval-other',
+            'subsets': [
+                'ceval-civil_servant',
+                'ceval-sports_science',
+                'ceval-plant_protection',
+                'ceval-basic_medicine',
+                'ceval-clinical_medicine',
+                'ceval-urban_and_rural_planner',
+                'ceval-accountant',
+                'ceval-fire_engineer',
+                'ceval-environmental_impact_assessment_engineer',
+                'ceval-tax_accountant',
+                'ceval-physician'
+            ]
+        },
+        {
+            'name': 'ceval-stem',
+            'subsets': [
+                'ceval-computer_network',
+                'ceval-operating_system',
+                'ceval-computer_architecture',
+                'ceval-college_programming',
+                'ceval-college_physics',
+                'ceval-college_chemistry',
+                'ceval-advanced_mathematics',
+                'ceval-probability_and_statistics',
+                'ceval-discrete_mathematics',
+                'ceval-electrical_engineer',
+                'ceval-metrology_engineer',
+                'ceval-high_school_mathematics',
+                'ceval-high_school_physics',
+                'ceval-high_school_chemistry',
+                'ceval-high_school_biology',
+                'ceval-middle_school_mathematics',
+                'ceval-middle_school_biology',
+                'ceval-middle_school_physics',
+                'ceval-middle_school_chemistry',
+                'ceval-veterinary_medicine'
+            ]
+        },
+        {
+            'name': 'ceval-social-science',
+            'subsets': [
+                'ceval-college_economics',
+                'ceval-business_administration',
+                'ceval-marxism',
+                'ceval-mao_zedong_thought',
+                'ceval-education_science',
+                'ceval-teacher_qualification',
+                'ceval-high_school_politics',
+                'ceval-high_school_geography',
+                'ceval-middle_school_politics',
+                'ceval-middle_school_geography'
+            ]
+        },
+        {
+            'name': 'ceval',
+            'subsets': [
+                'ceval-computer_network',
+                'ceval-operating_system',
+                'ceval-computer_architecture',
+                'ceval-college_programming',
+                'ceval-college_physics',
+                'ceval-college_chemistry',
+                'ceval-advanced_mathematics',
+                'ceval-probability_and_statistics',
+                'ceval-discrete_mathematics',
+                'ceval-electrical_engineer',
+                'ceval-metrology_engineer',
+                'ceval-high_school_mathematics',
+                'ceval-high_school_physics',
+                'ceval-high_school_chemistry',
+                'ceval-high_school_biology',
+                'ceval-middle_school_mathematics',
+                'ceval-middle_school_biology',
+                'ceval-middle_school_physics',
+                'ceval-middle_school_chemistry',
+                'ceval-veterinary_medicine',
+                'ceval-college_economics',
+                'ceval-business_administration',
+                'ceval-marxism',
+                'ceval-mao_zedong_thought',
+                'ceval-education_science',
+                'ceval-teacher_qualification',
+                'ceval-high_school_politics',
+                'ceval-high_school_geography',
+                'ceval-middle_school_politics',
+                'ceval-middle_school_geography',
+                'ceval-modern_chinese_history',
+                'ceval-ideological_and_moral_cultivation',
+                'ceval-logic',
+                'ceval-law',
+                'ceval-chinese_language_and_literature',
+                'ceval-art_studies',
+                'ceval-professional_tour_guide',
+                'ceval-legal_professional',
+                'ceval-high_school_chinese',
+                'ceval-high_school_history',
+                'ceval-middle_school_history',
+                'ceval-civil_servant',
+                'ceval-sports_science',
+                'ceval-plant_protection',
+                'ceval-basic_medicine',
+                'ceval-clinical_medicine',
+                'ceval-urban_and_rural_planner',
+                'ceval-accountant',
+                'ceval-fire_engineer',
+                'ceval-environmental_impact_assessment_engineer',
+                'ceval-tax_accountant',
+                'ceval-physician'
+            ]
+        }
+    ]
+}
+infer = {
+    'partitioner': {
+        'type': 'SizePartitioner',
+        'max_task_size': 100000,
+        'gen_task_coef': 20
+    }
+}
+task_timeout = 14400
+stall_timeout = 1800
+work_dir = './outputs/suite_1b/20260731_010416'
