@@ -250,6 +250,54 @@ def main():
     ag_nll_q = score_pool(qparams, cfg_aq, ag_tok, ag_mask)
     ag_lp_q, ag_am_q, _, ag_rank_q = forced_decode(
         qparams, cfg_hl, ag_prompts, ag_pmask, ag_forced)
+    jax.clear_caches()
+
+    # shared-prefix eval-workload leg (nn/transformer.prefill_suffix):
+    # 5-shot-shaped prompts — a 1408-token common ICE block + 128-token
+    # per-item remainders — scored/generated with the prefix prefilled
+    # once vs the plain full-prompt paths.  This is the pipeline's
+    # actual hot shape on MMLU-class few-shot tasks (BASELINE_RUN.md).
+    from opencompass_tpu.nn import (greedy_generate_prefixed,
+                                    shared_prefix_nll)
+    SP_P, SP_S, SP_B, SP_NEW = 1408, 128, 8, 100
+    rsp = np.random.RandomState(9)
+    sp_pre = jnp.asarray(rsp.randint(0, 32000, (SP_P,)), jnp.int32)
+    sp_rows = jnp.asarray(rsp.randint(0, 32000, (SP_B, SP_S)), jnp.int32)
+    sp_mask = jnp.ones((SP_B, SP_S), jnp.bool_)
+    sp_full = jnp.concatenate(
+        [jnp.broadcast_to(sp_pre, (SP_B, SP_P)), sp_rows], axis=1)
+    sp_fmask = jnp.ones_like(sp_full, jnp.bool_)
+
+    def timeit(fn, *args, iters=4):
+        np.asarray(fn(*args))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(*args)
+        np.asarray(out)
+        return SP_B / ((time.perf_counter() - t0) / iters)
+
+    ppl_plain = timeit(jax.jit(lambda p, t, m: sequence_nll(
+        forward(p, cfg_aq, t, m), t, m)), qparams, sp_full, sp_fmask)
+    ppl_shared = timeit(jax.jit(lambda p, pre, t, m: shared_prefix_nll(
+        p, cfg_aq, pre, t, m)), qparams, sp_pre, sp_rows, sp_mask)
+    jax.clear_caches()
+    gen_plain = timeit(jax.jit(lambda p, t, m: greedy_generate(
+        p, cfg_hl, t, m, SP_NEW, eos_token_id=None)[0]),
+        qparams, sp_full, sp_fmask, iters=1)
+    gen_shared = timeit(jax.jit(
+        lambda p, pre, t, m: greedy_generate_prefixed(
+            p, cfg_hl, pre, t, m, SP_NEW, eos_token_id=None)[0]),
+        qparams, sp_pre, sp_rows, sp_mask, iters=1)
+    shared_leg = {
+        'workload': '5-shot shape: prefix %d + suffix %d, batch %d, '
+                    'W8A8(+int4-KV gen)' % (SP_P, SP_S, SP_B),
+        'ppl_plain_samples_per_sec': round(ppl_plain, 3),
+        'ppl_shared_samples_per_sec': round(ppl_shared, 3),
+        'ppl_speedup': round(ppl_shared / ppl_plain, 2),
+        'gen_plain_samples_per_sec': round(gen_plain, 3),
+        'gen_shared_samples_per_sec': round(gen_shared, 3),
+        'gen_speedup': round(gen_shared / gen_plain, 2),
+    }
     agreement = {
         'scoring_w8a8_vs_bf16': scoring_stats(ag_nll_fp, ag_nll_q,
                                               AG_CHOICES),
@@ -367,6 +415,7 @@ def main():
             'device_kind': kind,
             'peak_tflops': peak,
             'quant_agreement': agreement,
+            'shared_prefix': shared_leg,
             'a100_est': a100,
             'a100_est_b32': a100_b32,
             'small': {

@@ -35,8 +35,8 @@ from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from opencompass_tpu.nn import (TransformerConfig, beam_generate, forward,
-                                greedy_generate, init_params, sequence_nll,
-                                shard_params)
+                                greedy_generate, greedy_generate_prefixed,
+                                init_params, sequence_nll, shard_params)
 from opencompass_tpu.parallel.mesh import MeshSpec, make_mesh, use_mesh
 from opencompass_tpu.registry import MODELS
 from opencompass_tpu.utils.logging import get_logger
@@ -88,6 +88,7 @@ class JaxLM(BaseModel):
                  batch_padding: bool = True,
                  quantize: Optional[str] = None,
                  convert_cache: Optional[str] = None,
+                 shared_prefix: bool = True,
                  run_cfg: Optional[Dict] = None):
         super().__init__(path=path, max_seq_len=max_seq_len,
                          tokenizer_only=tokenizer_only,
@@ -115,6 +116,17 @@ class JaxLM(BaseModel):
         self._ids_cache_max = 8192
         self._len_cache_max = 1_000_000
         self._gen_fn_cache: Dict[tuple, object] = {}
+        # shared-prefix prefill reuse (nn/transformer.prefill_suffix): a
+        # batch whose prompts share a long common token prefix (fixed
+        # few-shot ICE blocks; PPL label variants) prefills it once.
+        # Applied when the batch's common prefix is >= _sp_quantum
+        # tokens; the prefix length is rounded down to a multiple of the
+        # quantum so jit shape buckets stay bounded.  Single-chip only
+        # (mesh users keep the plain path) and off for prefix-LM models
+        # (their prompt attends bidirectionally, so a frozen prefix
+        # cache would change semantics).
+        self.shared_prefix = shared_prefix
+        self._sp_quantum = 64
         # quantize modes compose 'base[-kvN]': base 'int8' (weight-only),
         # 'w8a8' (int8 weights + dynamic per-token int8 activations on
         # the MXU), or 'w4a8' (int4 weights packed two-per-uint8 with
@@ -339,16 +351,29 @@ class JaxLM(BaseModel):
         return ppl
 
     def _gen_fn(self, max_new: int, temperature: float, top_k: int,
-                num_beams: int = 1, length_penalty: float = 1.0):
+                num_beams: int = 1, length_penalty: float = 1.0,
+                prefixed: bool = False):
         # per-instance cache (a class-level lru_cache would pin `self` — and
         # its multi-GB param pytree — alive across model swaps)
-        key = (max_new, temperature, top_k, num_beams, length_penalty)
+        key = (max_new, temperature, top_k, num_beams, length_penalty,
+               prefixed)
         fn = self._gen_fn_cache.get(key)
         if fn is not None:
             return fn
         cfg = self.cfg
         eos = self.eos_token_id
         pad = self.tokenizer.pad_token_id or 0
+
+        if prefixed:
+            @jax.jit
+            def gen(params, prefix, tokens, mask, rng):
+                out = greedy_generate_prefixed(
+                    params, cfg, prefix, tokens, mask, max_new,
+                    eos_token_id=eos, pad_token_id=pad,
+                    temperature=temperature, top_k=top_k, rng=rng)
+                return jax.tree_util.tree_map(self._replicate, out)
+            self._gen_fn_cache[key] = gen
+            return gen
 
         @jax.jit
         def gen(params, tokens, mask, rng):
@@ -401,6 +426,42 @@ class JaxLM(BaseModel):
             n = len(self._encode_ids(prompt))
         return n
 
+    @staticmethod
+    def _common_prefix_len(ids: List[List[int]]) -> int:
+        """Longest common token prefix across the batch's id rows."""
+        if len(ids) < 2:
+            return 0
+        n = len(ids[0])
+        for row in ids[1:]:
+            m = min(n, len(row))
+            i = 0
+            while i < m and row[i] == ids[0][i]:
+                i += 1
+            n = i
+            if n == 0:
+                break
+        return n
+
+    def _shared_prefix_split(self, ids: List[List[int]]):
+        """(prefix ids, suffix id rows) when the shared-prefix path
+        applies to this batch, else (None, ids).  The prefix is rounded
+        down to a _sp_quantum multiple (bounded jit shapes) and capped
+        so every row keeps at least one suffix token."""
+        mesh_ok = self.mesh is None or (
+            not self._multihost()
+            and self.mesh.shape.get('model', 1) == 1
+            and self.mesh.shape.get('seq', 1) == 1)
+        if (not self.shared_prefix or not mesh_ok
+                or self.cfg is None or self.cfg.prefix_lm
+                or self.cfg.positional == 'alibi' or len(ids) < 2):
+            return None, ids
+        cp = self._common_prefix_len(ids)
+        cap = min(len(r) for r in ids) - 1
+        P = (min(cp, cap) // self._sp_quantum) * self._sp_quantum
+        if P < self._sp_quantum:
+            return None, ids
+        return ids[0][:P], [row[P:] for row in ids]
+
     def _encode_batch(self, inputs: List[str], left_pad: bool,
                       max_len: int, keep: str = 'head') -> tuple:
         """Tokenize + bucket-pad.  Returns (tokens, mask) int32/bool arrays
@@ -410,6 +471,13 @@ class JaxLM(BaseModel):
         ids = [self._encode_ids(str(s)) for s in inputs]
         ids = [(row[:max_len] if keep == 'head' else row[-max_len:])
                for row in ids]
+        tokens, mask = self._pad_ids(ids, left_pad, max_len)
+        spec = P('data', None)
+        return self._put(tokens, spec), self._put(mask, spec), ids
+
+    def _pad_ids(self, ids: List[List[int]], left_pad: bool,
+                 max_len: int) -> tuple:
+        """Bucket-pad pre-encoded id rows into (tokens, mask) numpy."""
         longest = max((len(x) for x in ids), default=1)
         S = _bucket(max(longest, 1), hi=max(max_len, 32))
         min_b = self.mesh.shape.get('data', 1) if self.mesh is not None else 1
@@ -430,23 +498,47 @@ class JaxLM(BaseModel):
             else:
                 tokens[i, :len(row)] = row
                 mask[i, :len(row)] = True
-        spec = P('data', None)
-        return self._put(tokens, spec), self._put(mask, spec), ids
+        return tokens, mask
+
+    @functools.cached_property
+    def _ppl_shared_fn(self):
+        cfg = self.cfg
+
+        @jax.jit
+        def shared_nll(params, prefix, tokens, mask, ml):
+            from opencompass_tpu.nn import shared_prefix_nll
+            return shared_prefix_nll(params, cfg, prefix, tokens, mask,
+                                     mask_length=ml)
+        return shared_nll
 
     def get_ppl(self,
                 inputs: List[str],
                 mask_length: Optional[List[int]] = None) -> List[float]:
         with use_mesh(self.mesh):
-            tokens, mask, ids = self._encode_batch(
-                inputs, left_pad=False, max_len=self.max_seq_len)
-            ml = np.zeros((tokens.shape[0],), np.int32)
+            ids = [self._encode_ids(str(s))[:self.max_seq_len]
+                   for s in inputs]
+            prefix, rows = self._shared_prefix_split(ids)
+            ml = np.zeros((max(len(ids), 1),), np.int32)
             if mask_length is not None:
                 ml[:len(mask_length)] = np.asarray(mask_length, np.int32)
+            tokens, mask = self._pad_ids(rows, left_pad=False,
+                                         max_len=self.max_seq_len)
+            mlb = np.zeros((tokens.shape[0],), np.int32)
+            mlb[:len(ml)] = ml
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
                              samples=len(inputs)):
-                nll = self._ppl_fn(self.params, tokens, mask,
-                                   self._put(ml, P('data')))
+                if prefix is not None:
+                    nll = self._ppl_shared_fn(
+                        self.params, jnp.asarray(prefix, jnp.int32),
+                        jnp.asarray(tokens), jnp.asarray(mask),
+                        jnp.asarray(mlb))
+                else:
+                    spec = P('data', None)
+                    nll = self._ppl_fn(self.params,
+                                       self._put(tokens, spec),
+                                       self._put(mask, spec),
+                                       self._put(mlb, P('data')))
                 out = np.asarray(nll)
             return out[:len(inputs)].tolist()
 
@@ -530,15 +622,29 @@ class JaxLM(BaseModel):
         length_penalty = float(gk.get('length_penalty', 1.0))
         with use_mesh(self.mesh):
             max_prompt = max(self.max_seq_len - max_out_len, 32)
-            tokens, mask, ids = self._encode_batch(
-                inputs, left_pad=True, max_len=max_prompt)
-            fn = self._gen_fn(int(max_out_len), temperature, top_k,
-                              num_beams, length_penalty)
+            ids = [self._encode_ids(str(s))[:max_prompt] for s in inputs]
+            prefix, rows = (None, ids) if num_beams > 1 \
+                else self._shared_prefix_split(ids)
+            tokens, mask = self._pad_ids(rows, left_pad=True,
+                                         max_len=max_prompt)
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
                              samples=len(inputs)):
-                out, lengths = fn(self.params, tokens, mask,
-                                  self._put(jax.random.PRNGKey(seed), P()))
+                rng = self._put(jax.random.PRNGKey(seed), P())
+                if prefix is not None:
+                    fn = self._gen_fn(int(max_out_len), temperature,
+                                      top_k, prefixed=True)
+                    out, lengths = fn(self.params,
+                                      jnp.asarray(prefix, jnp.int32),
+                                      jnp.asarray(tokens),
+                                      jnp.asarray(mask), rng)
+                else:
+                    spec = P('data', None)
+                    fn = self._gen_fn(int(max_out_len), temperature,
+                                      top_k, num_beams, length_penalty)
+                    out, lengths = fn(self.params,
+                                      self._put(tokens, spec),
+                                      self._put(mask, spec), rng)
                 out = np.asarray(out)
                 lengths = np.asarray(lengths)
         self.perf.tokens_out += int(lengths[:len(inputs)].sum())

@@ -9,13 +9,14 @@ single code path serves one chip, a v5e-8 slice, or a multi-host pod.
 from .config import TransformerConfig
 from .transformer import (init_params, forward, prefill, decode_step,
                           init_cache)
-from .loss import sequence_nll
-from .decode import beam_generate, greedy_generate
+from .loss import sequence_nll, shared_prefix_nll
+from .decode import beam_generate, greedy_generate, greedy_generate_prefixed
 from .sharding import param_shardings, shard_params
 
 __all__ = [
     'TransformerConfig', 'init_params', 'forward', 'prefill', 'decode_step',
     'init_cache',
-    'sequence_nll', 'greedy_generate', 'beam_generate', 'param_shardings',
+    'sequence_nll', 'shared_prefix_nll', 'greedy_generate',
+    'greedy_generate_prefixed', 'beam_generate', 'param_shardings',
     'shard_params',
 ]

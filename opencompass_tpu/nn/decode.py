@@ -16,7 +16,8 @@ import jax
 import jax.numpy as jnp
 
 from .config import TransformerConfig
-from .transformer import decode_step, init_cache, prefill, slot_positions
+from .transformer import (broadcast_cache, decode_step, init_cache,
+                          prefill, prefill_suffix, slot_positions)
 
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
@@ -63,14 +64,32 @@ def greedy_generate(params, cfg: TransformerConfig, tokens: jax.Array,
     else:
         kv_pos = jnp.zeros((B, 0), jnp.int32)  # empty carry placeholder
 
+    # all-pad rows (batch-bucket filler) count as done immediately so they
+    # can't defeat the all-done early exit in the loop
+    empty = ~jnp.any(pad_mask.astype(jnp.bool_), axis=-1)
+    return _greedy_loop(params, cfg, logits, cache, next_pos, kv_valid,
+                        kv_pos, S, max_new_tokens, tokens.dtype, empty,
+                        eos_token_id, pad_token_id, temperature, top_k,
+                        rng)
+
+
+def _greedy_loop(params, cfg, logits, cache, positions, kv_valid, kv_pos,
+                 base_slot, max_new_tokens, token_dtype, empty,
+                 eos_token_id, pad_token_id, temperature, top_k, rng
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """The sample/append/decode while_loop shared by the plain and
+    shared-prefix generators.  ``base_slot``: cache slot where the first
+    generated token will be written + 1 == slot of the token emitted at
+    step-1; ``logits``: the prefill's last-position logits."""
+    B = logits.shape[0]
+    total = cache['k'].shape[3]
+    use_kv_pos = cfg.positional == 'alibi'
+
     rng, key = jax.random.split(rng)
     first = _sample(logits, key, temperature, top_k)
-    # all-pad rows (batch-bucket filler) count as done immediately so they
-    # can't defeat the all-done early exit below
-    empty = ~jnp.any(pad_mask.astype(jnp.bool_), axis=-1)
     first = jnp.where(empty, jnp.asarray(pad_token_id, first.dtype), first)
-    out = jnp.full((B, max_new_tokens), pad_token_id, tokens.dtype)
-    out = out.at[:, 0].set(first.astype(tokens.dtype))
+    out = jnp.full((B, max_new_tokens), pad_token_id, token_dtype)
+    out = out.at[:, 0].set(first.astype(token_dtype))
     done = empty
     if eos_token_id is not None:
         done = done | (first == eos_token_id)
@@ -82,7 +101,8 @@ def greedy_generate(params, cfg: TransformerConfig, tokens: jax.Array,
     def body(carry):
         (step, token, cache, kv_valid, kv_pos, positions, done, out,
          rng) = carry
-        slot = S + step - 1  # slot where `token` (emitted at step-1) lives
+        # slot where `token` (emitted at step-1) lives
+        slot = base_slot + step - 1
         is_slot = jnp.arange(total)[None, :] == slot
         kv_valid = kv_valid | is_slot
         if use_kv_pos:
@@ -101,8 +121,8 @@ def greedy_generate(params, cfg: TransformerConfig, tokens: jax.Array,
         return (step + 1, nxt, cache, kv_valid, kv_pos, positions + 1,
                 done, out, rng)
 
-    carry = (jnp.asarray(1), first.astype(tokens.dtype), cache, kv_valid,
-             kv_pos, next_pos, done, out, rng)
+    carry = (jnp.asarray(1), first.astype(token_dtype), cache, kv_valid,
+             kv_pos, positions, done, out, rng)
     step, _, _, _, _, _, _, out, _ = jax.lax.while_loop(cond, body, carry)
 
     if eos_token_id is not None:
@@ -110,6 +130,57 @@ def greedy_generate(params, cfg: TransformerConfig, tokens: jax.Array,
     else:
         lengths = jnp.full((B,), max_new_tokens)
     return out, lengths
+
+
+def greedy_generate_prefixed(params, cfg: TransformerConfig,
+                             prefix: jax.Array, tokens: jax.Array,
+                             pad_mask: jax.Array, max_new_tokens: int,
+                             eos_token_id: Optional[int] = None,
+                             pad_token_id: int = 0,
+                             temperature: float = 0.0,
+                             top_k: int = 0,
+                             rng: Optional[jax.Array] = None
+                             ) -> Tuple[jax.Array, jax.Array]:
+    """greedy_generate for a batch whose prompts share a common prefix.
+
+    ``prefix`` (P,): the shared leading tokens (a few-shot ICE block is
+    identical across a subset's items); ``tokens``/``pad_mask``
+    (B, S'): left-padded per-row remainders.  The prefix is prefilled
+    ONCE at batch 1 and its K/V broadcast, so prefill compute drops
+    from O(B * (P + S')) to O(P + B * S') — the dominant cost of
+    long-few-shot generation tasks.  Numerics match greedy_generate on
+    the concatenated prompts (pinned by tests/test_shared_prefix.py).
+    """
+    if cfg.positional == 'alibi':
+        raise NotImplementedError('shared-prefix decode does not carry '
+                                  'ALiBi slot positions; use the plain '
+                                  'path')
+    B, S = tokens.shape
+    P = prefix.shape[0]
+    total = P + S + max_new_tokens
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    cache1 = init_cache(cfg, 1, total)
+    pmask1 = jnp.ones((1, P), jnp.bool_)
+    _, cache1, _ = prefill(params, cfg, prefix[None, :], pmask1, cache1)
+    cache = broadcast_cache(cache1, B)
+    logits, cache, next_pos = prefill_suffix(params, cfg, tokens,
+                                             pad_mask, cache, P)
+
+    kv_valid = jnp.zeros((B, total), jnp.bool_)
+    kv_valid = kv_valid.at[:, :P].set(True)
+    kv_valid = jax.lax.dynamic_update_slice_in_dim(
+        kv_valid, pad_mask.astype(jnp.bool_), P, axis=1)
+    kv_pos = jnp.zeros((B, 0), jnp.int32)
+    # a REAL row always has >=1 suffix token (the caller caps the prefix
+    # below the shortest prompt), so an all-pad suffix row is a
+    # batch-bucket filler: done immediately, same as the plain path
+    empty = ~jnp.any(pad_mask.astype(jnp.bool_), axis=-1)
+    return _greedy_loop(params, cfg, logits, cache, next_pos, kv_valid,
+                        kv_pos, P + S, max_new_tokens, tokens.dtype,
+                        empty, eos_token_id, pad_token_id, temperature,
+                        top_k, rng)
 
 
 def _emitted_lengths(out, eos_token_id, max_new_tokens):

@@ -22,6 +22,83 @@ def token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
     return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
 
 
+def shared_prefix_nll(params, cfg, prefix: jax.Array, tokens: jax.Array,
+                      pad_mask: jax.Array,
+                      mask_length: Optional[jax.Array] = None
+                      ) -> jax.Array:
+    """``sequence_nll`` over ``concat(prefix, row)`` without re-running
+    the shared prefix per row.
+
+    The eval workload's scoring batches share long prefixes (a fixed
+    few-shot ICE block across a subset's items; everything but the
+    answer across a PPL item's label variants).  The prefix forward
+    runs ONCE at batch 1 — its per-token NLLs and final logit are
+    common — and only the RIGHT-padded per-row remainders (B, S') run
+    at batch B, attending the broadcast prefix K/V
+    (transformer.prefill_suffix).  Numerically equivalent to
+    ``sequence_nll(forward(concat), ...)`` (pinned by
+    tests/test_shared_prefix.py); the reference has no counterpart —
+    it re-encodes and re-scores every full prompt
+    (reference models/huggingface.py:254-293).
+
+    ``mask_length`` (B,) counts from the START of the concatenated
+    sequence, exactly like sequence_nll.
+    """
+    import dataclasses
+
+    from .transformer import (broadcast_cache, init_cache, prefill,
+                              prefill_suffix)
+    if cfg.positional == 'alibi' or cfg.prefix_lm:
+        raise NotImplementedError(
+            'shared-prefix scoring supports neither ALiBi slot positions '
+            'nor prefix-LM bidirectional context; use the plain '
+            'forward+sequence_nll path')
+    B, S = tokens.shape
+    P = prefix.shape[0]
+    # scoring stays cache-dtype-full-precision even when the model's
+    # decode config quantizes the KV cache: the plain PPL path builds no
+    # cache, so this path must not either (semantically)
+    cfg_s = dataclasses.replace(cfg, kv_quant=False)
+    cache = init_cache(cfg_s, 1, P + S)
+    logits_p, cache, _ = prefill(params, cfg_s, prefix[None, :],
+                                 jnp.ones((1, P), jnp.bool_), cache,
+                                 return_all_logits=True)
+    p_nll = token_nll(logits_p, prefix[None, :])[0]        # (P-1,)
+    last_lp = jax.nn.log_softmax(
+        logits_p[0, -1].astype(jnp.float32), axis=-1)      # (V,)
+
+    logits_s, _, _ = prefill_suffix(params, cfg_s, tokens, pad_mask,
+                                    broadcast_cache(cache, B), P,
+                                    return_all_logits=True)
+    s_nll = token_nll(logits_s, tokens)                    # (B, S-1)
+    valid = pad_mask[:, 1:].astype(jnp.float32)
+    # the prefix->suffix transition: the prefix's last logit scores each
+    # row's FIRST token (right-padded suffixes, so it is tokens[:, 0])
+    cross = -last_lp[tokens[:, 0].astype(jnp.int32)]       # (B,)
+    real = jnp.sum(pad_mask.astype(jnp.float32), axis=-1)
+    has_suffix = real > 0
+
+    if mask_length is None:
+        prefix_sum = jnp.sum(p_nll)
+        total = prefix_sum + jnp.where(has_suffix, cross, 0.0) \
+            + jnp.sum(s_nll * valid, axis=-1)
+        count = P + real
+        return total / jnp.maximum(count, 1.0)
+
+    ml = mask_length.astype(jnp.int32)
+    # prefix transition j scores global token j+1: drop when j+1 < ml
+    pj = jnp.arange(1, P)[None, :]
+    prefix_sum = jnp.sum(p_nll[None, :] * (pj >= ml[:, None]), axis=-1)
+    # the cross transition's target sits at global position P
+    cross = jnp.where(has_suffix & (P >= ml), cross, 0.0)
+    # suffix transition j scores global token P+j+1
+    sj = P + jnp.arange(1, S)[None, :]
+    svalid = valid * (sj >= ml[:, None])
+    total = prefix_sum + cross + jnp.sum(s_nll * svalid, axis=-1)
+    count = P + real - ml.astype(jnp.float32)
+    return total / jnp.maximum(count, 1.0)
+
+
 def sequence_nll(logits: jax.Array, tokens: jax.Array, pad_mask: jax.Array,
                  mask_length: Optional[jax.Array] = None) -> jax.Array:
     """Mean NLL per sequence (B,).

@@ -687,7 +687,8 @@ def _quantize_kv(x, mode='int8'):
 
 
 def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
-            pad_mask: jax.Array, cache: Dict
+            pad_mask: jax.Array, cache: Dict,
+            return_all_logits: bool = False
             ) -> Tuple[jax.Array, Dict, jax.Array]:
     """Process a left-padded prompt batch, filling cache slots [0, S).
 
@@ -715,9 +716,77 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     x = _embed(params, cfg, tokens, positions)
     x, cache = _stack(cfg, x, params['layers'], positions, mask, cache, 0,
                       kv_positions=kv_positions)
-    logits = _unembed(params, cfg, x[:, -1:, :])[:, 0, :]
+    if return_all_logits:
+        logits = _unembed(params, cfg, x)
+    else:
+        logits = _unembed(params, cfg, x[:, -1:, :])[:, 0, :]
     next_pos = positions[:, -1] + 1
     return logits, cache, next_pos
+
+
+def prefill_suffix(params: Params, cfg: TransformerConfig,
+                   tokens: jax.Array, pad_mask: jax.Array, cache: Dict,
+                   prefix_len: int, return_all_logits: bool = False
+                   ) -> Tuple[jax.Array, Dict, jax.Array]:
+    """Prefill left-padded per-row suffixes behind a shared prefix.
+
+    The eval workload's prompts share long prefixes — a FixKRetriever
+    5-shot ICE block is identical across a subset's items, and a PPL
+    item's label variants differ only in the answer — so the prefix's
+    K/V can be computed ONCE (a batch-1 `prefill`) and reused:
+    ``cache`` arrives with slots [0, prefix_len) already filled (and
+    broadcast across the batch); this fills [prefix_len,
+    prefix_len + S') with the suffixes and runs attention over
+    prefix + causal-suffix.  No reference counterpart — the reference
+    re-prefills the full prompt per item (reference
+    models/huggingface.py:127-199).
+
+    tokens/pad_mask: (B, S') LEFT-padded suffixes, so every row's last
+    real token lands at slot prefix_len + S' - 1 and decode steps stay
+    batch-uniform.  Returns (logits, cache, next-token positions);
+    ``return_all_logits`` selects (B, S', V) full-position logits (the
+    scoring path) over last-position (B, V).
+    """
+    if cfg.prefix_lm or cfg.positional == 'alibi':
+        # prefix-LM would need the cached prefix K/V to have attended the
+        # suffix bidirectionally (it was computed causally at batch 1),
+        # and the ALiBi slot-position bookkeeping below doesn't offset
+        # the prefix — both would be silently wrong, so refuse
+        raise NotImplementedError(
+            'prefill_suffix supports neither prefix-LM nor ALiBi; use '
+            'the plain prefill path')
+    B, S = tokens.shape
+    P = prefix_len
+    total = cache['k'].shape[3]
+    pad_mask = pad_mask.astype(jnp.bool_)
+    positions = P + token_positions(pad_mask)
+    # valid kv: the whole prefix + this batch's real suffix tokens
+    kv_valid = jnp.zeros((B, total), jnp.bool_)
+    kv_valid = kv_valid.at[:, :P].set(True)
+    kv_valid = jax.lax.dynamic_update_slice_in_dim(kv_valid, pad_mask, P,
+                                                   axis=1)
+    # suffix query i -> prefix slots (all) + suffix slots j <= i
+    slot = jnp.arange(total)[None, :]
+    causal = (slot < P) | (slot <= (P + jnp.arange(S))[:, None])
+    mask = causal[None, :, :] & kv_valid[:, None, :]
+    kv_positions = slot_positions(pad_mask, total)
+    x = _embed(params, cfg, tokens, positions)
+    x, cache = _stack(cfg, x, params['layers'], positions, mask, cache, P,
+                      kv_positions=kv_positions)
+    if return_all_logits:
+        logits = _unembed(params, cfg, x)
+    else:
+        logits = _unembed(params, cfg, x[:, -1:, :])[:, 0, :]
+    next_pos = positions[:, -1] + 1
+    return logits, cache, next_pos
+
+
+def broadcast_cache(cache: Dict, batch: int) -> Dict:
+    """Tile a batch-1 cache (shared-prefix K/V) across ``batch`` rows.
+    Cache leaves are (L, B, K, S, hd) — batch is axis 1."""
+    return {k: jnp.broadcast_to(
+        v, (v.shape[0], batch) + v.shape[2:]).copy()
+        for k, v in cache.items()}
 
 
 def decode_step(params: Params, cfg: TransformerConfig, token: jax.Array,

@@ -1,0 +1,232 @@
+"""Shared-prefix prefill reuse (nn/transformer.prefill_suffix).
+
+The eval workload's prompts share long prefixes — FixKRetriever 5-shot
+ICE blocks are identical across a subset's items, and a PPL item's
+label variants differ only in the answer.  These tests pin the
+optimization's contract: scoring and generation over
+``concat(prefix, row)`` computed via one batch-1 prefix prefill +
+per-row suffixes must match the plain full-prompt paths numerically.
+No reference counterpart (the reference re-runs every full prompt:
+reference opencompass/models/huggingface.py:127-293).
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opencompass_tpu.nn import (TransformerConfig, forward,
+                                greedy_generate, greedy_generate_prefixed,
+                                init_params, sequence_nll,
+                                shared_prefix_nll)
+
+CFG = TransformerConfig.tiny()
+V = CFG.vocab_size
+
+
+def _rows(B=3, P=10, S=6, seed=0):
+    rng = np.random.RandomState(seed)
+    prefix = jnp.asarray(rng.randint(0, V, (P,)), jnp.int32)
+    # ragged suffixes, right-padded for scoring
+    lens = [S, S - 2, S - 4][:B]
+    toks = np.zeros((B, S), np.int32)
+    mask = np.zeros((B, S), bool)
+    for i, L in enumerate(lens):
+        toks[i, :L] = rng.randint(0, V, (L,))
+        mask[i, :L] = True
+    return prefix, jnp.asarray(toks), jnp.asarray(mask), lens
+
+
+def _concat(prefix, toks, mask, lens):
+    """Plain-path equivalents: full prompts, right-padded."""
+    P = prefix.shape[0]
+    B, S = toks.shape
+    full = np.zeros((B, P + S), np.int32)
+    fmask = np.zeros((B, P + S), bool)
+    for i, L in enumerate(lens):
+        full[i, :P] = np.asarray(prefix)
+        full[i, P:P + L] = np.asarray(toks)[i, :L]
+        fmask[i, :P + L] = True
+    return jnp.asarray(full), jnp.asarray(fmask)
+
+
+def test_shared_prefix_nll_matches_plain():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    prefix, toks, mask, lens = _rows()
+    full, fmask = _concat(prefix, toks, mask, lens)
+    want = np.asarray(sequence_nll(
+        forward(params, CFG, full, fmask, use_flash=False), full, fmask))
+    got = np.asarray(shared_prefix_nll(params, CFG, prefix, toks, mask))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_shared_prefix_nll_mask_length_matches_plain():
+    params = init_params(CFG, jax.random.PRNGKey(1))
+    prefix, toks, mask, lens = _rows(seed=3)
+    full, fmask = _concat(prefix, toks, mask, lens)
+    P = prefix.shape[0]
+    # context exclusion at, below, and above the prefix boundary
+    ml = jnp.asarray([P, P - 3, P + 2], jnp.int32)
+    want = np.asarray(sequence_nll(
+        forward(params, CFG, full, fmask, use_flash=False), full, fmask,
+        mask_length=ml))
+    got = np.asarray(shared_prefix_nll(params, CFG, prefix, toks, mask,
+                                       mask_length=ml))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
+def test_shared_prefix_nll_kv_quant_config_unaffected():
+    """A w8a8-kv4 model's SCORING must be identical through the shared
+    path: the decode-only KV quantization may not leak into it."""
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    cfgq = dataclasses.replace(CFG, kv_quant='int4')
+    prefix, toks, mask, lens = _rows(seed=5)
+    a = np.asarray(shared_prefix_nll(params, CFG, prefix, toks, mask))
+    b = np.asarray(shared_prefix_nll(params, cfgq, prefix, toks, mask))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_prefixed_generate_matches_plain():
+    """Left-padded remainders behind a shared prefix must reproduce the
+    plain generator's tokens (greedy chain equality on the CPU mesh)."""
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    rng = np.random.RandomState(7)
+    P, B, S = 12, 3, 5
+    prefix = jnp.asarray(rng.randint(0, V, (P,)), jnp.int32)
+    lens = [S, S - 1, S - 3]
+    toks = np.zeros((B, S), np.int32)
+    mask = np.zeros((B, S), bool)
+    for i, L in enumerate(lens):           # LEFT-padded for generation
+        toks[i, S - L:] = rng.randint(0, V, (L,))
+        mask[i, S - L:] = True
+    toks, mask = jnp.asarray(toks), jnp.asarray(mask)
+
+    fullB = np.zeros((B, P + S), np.int32)
+    fmask = np.zeros((B, P + S), bool)
+    for i, L in enumerate(lens):           # left-padded full prompts
+        fullB[i, S - L:S - L + P] = np.asarray(prefix)
+        fullB[i, S - L + P:] = np.asarray(toks)[i, S - L:]
+        fmask[i, S - L:] = True
+    out_plain, len_plain = jax.jit(lambda p, t, m: greedy_generate(
+        p, CFG, t, m, 8, eos_token_id=None))(params, jnp.asarray(fullB),
+                                             jnp.asarray(fmask))
+    out_pre, len_pre = jax.jit(lambda p, pre, t, m: greedy_generate_prefixed(
+        p, CFG, pre, t, m, 8, eos_token_id=None))(params, prefix, toks,
+                                                  mask)
+    np.testing.assert_array_equal(np.asarray(out_plain),
+                                  np.asarray(out_pre))
+    np.testing.assert_array_equal(np.asarray(len_plain),
+                                  np.asarray(len_pre))
+
+
+def test_prefixed_generate_eos_and_quant():
+    """Composes with the serving quantization and EOS handling."""
+    from opencompass_tpu.nn.quant import quantize_params
+    cfgq = dataclasses.replace(CFG, act_quant=True, kv_quant='int4')
+    params = quantize_params(init_params(CFG, jax.random.PRNGKey(0)), CFG)
+    rng = np.random.RandomState(11)
+    prefix = jnp.asarray(rng.randint(0, V, (8,)), jnp.int32)
+    toks = jnp.asarray(rng.randint(0, V, (2, 4)), jnp.int32)
+    mask = jnp.ones((2, 4), bool)
+    out, lengths = jax.jit(lambda p, pre, t, m: greedy_generate_prefixed(
+        p, cfgq, pre, t, m, 6, eos_token_id=5))(params, prefix, toks,
+                                                mask)
+    assert out.shape == (2, 6)
+    out = np.asarray(out)
+    for i in range(2):
+        if (out[i] == 5).any():
+            first = int(np.argmax(out[i] == 5))
+            assert (out[i, first + 1:] == 0).all()
+
+
+def _mk_lms():
+    from opencompass_tpu.models import JaxLM
+    kw = dict(config='tiny', max_seq_len=256, dtype='float32')
+    return (JaxLM(shared_prefix=True, **kw),
+            JaxLM(shared_prefix=False, **kw))
+
+
+def test_jaxlm_ppl_shared_matches_plain():
+    lm_on, lm_off = _mk_lms()
+    base = ('Passage: the quick brown fox jumps over the lazy dog and '
+            'then continues running through the long field for a while '
+            'before finally stopping near the river to rest. Question: ')
+    texts = [base + q for q in
+             ('what is A?', 'what is B maybe?', 'what is C exactly now?')]
+    # confirm the shared path actually engages (byte tokenizer: prefix
+    # is > 64 tokens)
+    ids = [lm_on._encode_ids(t) for t in texts]
+    pre, _ = lm_on._shared_prefix_split(ids)
+    assert pre is not None and len(pre) >= 64
+    a = lm_on.get_ppl(texts)
+    b = lm_off.get_ppl(texts)
+    np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3)
+
+
+def test_jaxlm_ppl_shared_mask_length_matches_plain():
+    lm_on, lm_off = _mk_lms()
+    base = 'x' * 150 + ' answer choice: '
+    texts = [base + c for c in ('alpha', 'beta', 'gamma gamma')]
+    ml = [len(lm_on._encode_ids(base))] * 3
+    a = lm_on.get_ppl(texts, mask_length=ml)
+    b = lm_off.get_ppl(texts, mask_length=ml)
+    np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3)
+
+
+def test_jaxlm_generate_shared_matches_plain():
+    lm_on, lm_off = _mk_lms()
+    base = ('Example 1: in goes one, out comes two. Example 2: in goes '
+            'two, out comes three. Example 3: in goes nine, out comes '
+            'ten. Now the question is about the number ')
+    texts = [base + q for q in ('four.', 'seventeen!', 'zero?')]
+    a = lm_on.generate(texts, max_out_len=8)
+    b = lm_off.generate(texts, max_out_len=8)
+    assert a == b
+
+
+def test_jaxlm_short_prompts_skip_shared_path():
+    lm_on, _ = _mk_lms()
+    ids = [lm_on._encode_ids(t) for t in ('short a', 'short b')]
+    pre, rows = lm_on._shared_prefix_split(ids)
+    assert pre is None and rows == ids
+    out = lm_on.get_ppl(['short a', 'short b'])
+    assert all(np.isfinite(out))
+
+
+def test_prefixed_generate_alibi_raises():
+    cfg = dataclasses.replace(CFG, positional='alibi')
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(NotImplementedError):
+        greedy_generate_prefixed(params, cfg,
+                                 jnp.zeros((4,), jnp.int32),
+                                 jnp.zeros((1, 2), jnp.int32),
+                                 jnp.ones((1, 2), bool), 4)
+
+
+def test_shared_nll_guards_unsupported_configs():
+    """ALiBi / prefix-LM must refuse loudly, not return wrong NLLs."""
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    args = (jnp.zeros((4,), jnp.int32), jnp.zeros((1, 2), jnp.int32),
+            jnp.ones((1, 2), bool))
+    for bad in (dataclasses.replace(CFG, positional='alibi'),
+                dataclasses.replace(CFG, prefix_lm=True)):
+        with pytest.raises(NotImplementedError):
+            shared_prefix_nll(params, bad, *args)
+
+
+def test_prefixed_generate_filler_rows_done_immediately():
+    """All-pad suffix rows are batch-bucket filler: they emit pads and
+    count as done, so they can't defeat the all-done early exit."""
+    params = init_params(CFG, jax.random.PRNGKey(2))
+    prefix = jnp.asarray(np.random.RandomState(1).randint(0, V, (8,)),
+                         jnp.int32)
+    toks = jnp.zeros((2, 3), jnp.int32)
+    mask = jnp.zeros((2, 3), bool)
+    mask = mask.at[0].set(True)            # row 1 is filler
+    toks = toks.at[0].set(jnp.asarray([1, 2, 3]))
+    out, lengths = jax.jit(lambda p, pre, t, m: greedy_generate_prefixed(
+        p, CFG, pre, t, m, 5, eos_token_id=None, pad_token_id=0))(
+            params, prefix, toks, mask)
+    out = np.asarray(out)
+    assert (out[1] == 0).all()             # filler emitted only pads
