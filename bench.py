@@ -252,7 +252,8 @@ def main():
         qparams, cfg_hl, ag_prompts, ag_pmask, ag_forced)
     jax.clear_caches()
 
-    # shared-prefix eval-workload leg (nn/transformer.prefill_suffix):
+    # shared-prefix eval-workload leg (nn/loss.shared_prefix_nll for
+    # scoring, nn/decode.greedy_generate_prefixed for generation):
     # 5-shot-shaped prompts — a 1408-token common ICE block + 128-token
     # per-item remainders — scored/generated with the prefix prefilled
     # once vs the plain full-prompt paths.  This is the pipeline's

@@ -100,6 +100,13 @@ class JaxLM(BaseModel):
             if not tokenizer_only:
                 raise
             self.cfg = None  # token counting needs no model config
+        # NOTE: with no local checkpoint/tokenizer this falls back to the
+        # deterministic byte tokenizer (512-id floor).  Byte token counts
+        # differ from the real tokenizer's (usually ~3-4x more tokens per
+        # text), so in tokenizer_only mode the SizePartitioner's cost
+        # model sees inflated-but-consistent sizes: task packing stays
+        # balanced, absolute size estimates don't transfer to real-vocab
+        # runs.
         self.tokenizer = load_tokenizer(
             tokenizer_path or path, tokenizer_kwargs,
             vocab_size=self.cfg.vocab_size if self.cfg else 512)
@@ -529,10 +536,12 @@ class JaxLM(BaseModel):
                              tokens_in=sum(len(r) for r in ids),
                              samples=len(inputs)):
                 if prefix is not None:
+                    spec = P('data', None)
                     nll = self._ppl_shared_fn(
-                        self.params, jnp.asarray(prefix, jnp.int32),
-                        jnp.asarray(tokens), jnp.asarray(mask),
-                        jnp.asarray(mlb))
+                        self.params,
+                        self._put(np.asarray(prefix, np.int32), P(None)),
+                        self._put(tokens, spec), self._put(mask, spec),
+                        self._put(mlb, P('data')))
                 else:
                     spec = P('data', None)
                     nll = self._ppl_fn(self.params,
@@ -632,12 +641,15 @@ class JaxLM(BaseModel):
                              samples=len(inputs)):
                 rng = self._put(jax.random.PRNGKey(seed), P())
                 if prefix is not None:
+                    spec = P('data', None)
                     fn = self._gen_fn(int(max_out_len), temperature,
                                       top_k, prefixed=True)
                     out, lengths = fn(self.params,
-                                      jnp.asarray(prefix, jnp.int32),
-                                      jnp.asarray(tokens),
-                                      jnp.asarray(mask), rng)
+                                      self._put(np.asarray(prefix,
+                                                           np.int32),
+                                                P(None)),
+                                      self._put(tokens, spec),
+                                      self._put(mask, spec), rng)
                 else:
                     spec = P('data', None)
                     fn = self._gen_fn(int(max_out_len), temperature,

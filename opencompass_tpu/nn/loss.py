@@ -34,8 +34,8 @@ def shared_prefix_nll(params, cfg, prefix: jax.Array, tokens: jax.Array,
     answer across a PPL item's label variants).  The prefix forward
     runs ONCE at batch 1 — its per-token NLLs and final logit are
     common — and only the RIGHT-padded per-row remainders (B, S') run
-    at batch B, attending the broadcast prefix K/V
-    (transformer.prefill_suffix).  Numerically equivalent to
+    at batch B, attending the batch-1 prefix K/V through two-source
+    attention (transformer.forward_shared).  Numerically equivalent to
     ``sequence_nll(forward(concat), ...)`` (pinned by
     tests/test_shared_prefix.py); the reference has no counterpart —
     it re-encodes and re-scores every full prompt
@@ -46,8 +46,7 @@ def shared_prefix_nll(params, cfg, prefix: jax.Array, tokens: jax.Array,
     """
     import dataclasses
 
-    from .transformer import (broadcast_cache, init_cache, prefill,
-                              prefill_suffix)
+    from .transformer import forward_shared, init_cache, prefill
     if cfg.positional == 'alibi' or cfg.prefix_lm:
         raise NotImplementedError(
             'shared-prefix scoring supports neither ALiBi slot positions '
@@ -57,9 +56,12 @@ def shared_prefix_nll(params, cfg, prefix: jax.Array, tokens: jax.Array,
     P = prefix.shape[0]
     # scoring stays cache-dtype-full-precision even when the model's
     # decode config quantizes the KV cache: the plain PPL path builds no
-    # cache, so this path must not either (semantically)
+    # cache, so this path must not either (semantically).  The prefix
+    # cache is sized to P exactly and stays batch-1 (two-source
+    # attention) — the broadcast-cache alternative measured an OOM at
+    # 7B milestone shapes.
     cfg_s = dataclasses.replace(cfg, kv_quant=False)
-    cache = init_cache(cfg_s, 1, P + S)
+    cache = init_cache(cfg_s, 1, P)
     logits_p, cache, _ = prefill(params, cfg_s, prefix[None, :],
                                  jnp.ones((1, P), jnp.bool_), cache,
                                  return_all_logits=True)
@@ -67,9 +69,7 @@ def shared_prefix_nll(params, cfg, prefix: jax.Array, tokens: jax.Array,
     last_lp = jax.nn.log_softmax(
         logits_p[0, -1].astype(jnp.float32), axis=-1)      # (V,)
 
-    logits_s, _, _ = prefill_suffix(params, cfg_s, tokens, pad_mask,
-                                    broadcast_cache(cache, B), P,
-                                    return_all_logits=True)
+    logits_s = forward_shared(params, cfg_s, cache, tokens, pad_mask, P)
     s_nll = token_nll(logits_s, tokens)                    # (B, S-1)
     valid = pad_mask[:, 1:].astype(jnp.float32)
     # the prefix->suffix transition: the prefix's last logit scores each

@@ -404,9 +404,43 @@ def _row_parallel(x, p, tp_axis, act_quant=False):
     return y
 
 
+def _attention_shared(q, k, v, k1, v1, own_mask):
+    """Two-source attention for shared-prefix scoring.
+
+    q/k/v: (B, T, H|K, hd) seq-major per-row suffix projections;
+    k1/v1: (1, K, P, hd) head-major batch-1 prefix K/V (a prefill's
+    cache slice).  The softmax spans prefix + own keys, but the prefix
+    stays batch-1 inside the einsums — no B-fold broadcast is ever
+    materialized, so scoring a batch behind a long prefix costs the
+    memory of a plain forward plus ONE copy of the prefix K/V (the
+    broadcast-cache alternative allocates B full-length bf16 caches:
+    ~8.6 GB at 7B batch 8, a measured OOM).  Prefix slots are fully
+    valid (the prefix is unpadded); ``own_mask`` (B, T, S') carries the
+    suffix causal+pad structure.
+    """
+    B, T, H, hd = q.shape
+    K = k.shape[2]
+    G = H // K
+    Pn = k1.shape[2]
+    qg = q.reshape(B, T, K, G, hd)
+    scale = hd ** -0.5
+    s_pre = jnp.einsum('btkgh,kph->bkgtp', qg, k1[0].astype(qg.dtype),
+                       preferred_element_type=jnp.float32) * scale
+    s_own = jnp.einsum('btkgh,bskh->bkgts', qg, k,
+                       preferred_element_type=jnp.float32) * scale
+    s_own = jnp.where(own_mask[:, None, None, :, :], s_own, -1e30)
+    probs = jax.nn.softmax(
+        jnp.concatenate([s_pre, s_own], axis=-1), axis=-1)
+    p_pre = probs[..., :Pn].astype(v1.dtype)
+    p_own = probs[..., Pn:].astype(v.dtype)
+    out = jnp.einsum('bkgtp,kph->btkgh', p_pre, v1[0]) \
+        + jnp.einsum('bkgts,bskh->btkgh', p_own, v)
+    return out.reshape(B, T, H, hd).astype(q.dtype)
+
+
 def _block(cfg: TransformerConfig, x, lp, positions, mask,
            cache_slice=None, cache_index=None, attn_fn=None,
-           kv_positions=None, tp_axis=None):
+           kv_positions=None, tp_axis=None, shared_kv=None):
     """One transformer block.  x: (B,T,D).  With a cache slice, K/V for the
     current tokens are written at ``cache_index`` and attention runs over the
     whole cache; without, attention is over the current sequence only.
@@ -460,7 +494,10 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
         if kq:
             k_scale, v_scale = new_cache['ks'], new_cache['vs']
 
-    if attn_fn is not None:
+    if shared_kv is not None:
+        attn = _attention_shared(q, k, v, shared_kv['k'], shared_kv['v'],
+                                 mask)
+    elif attn_fn is not None:
         attn = attn_fn(q, k, v)
     else:
         bias = None
@@ -517,7 +554,7 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
 
 def _stack(cfg: TransformerConfig, x, layers, positions, mask,
            cache=None, cache_index=None, attn_fn=None, kv_positions=None,
-           tp_axis=None):
+           tp_axis=None, shared_kv=None):
     """Run the block stack via lax.scan over stacked layer params."""
     def block(cfg, *args, **kw):
         return _block(cfg, *args, attn_fn=attn_fn,
@@ -526,6 +563,23 @@ def _stack(cfg: TransformerConfig, x, layers, positions, mask,
         block = jax.checkpoint(
             block, static_argnums=(0,),
             policy=jax.checkpoint_policies.nothing_saveable)
+
+    if shared_kv is not None:
+        # read-only per-layer prefix K/V ride the scan xs (sliced per
+        # iteration, never copied whole)
+        skv = {'k': shared_kv['k'], 'v': shared_kv['v']}
+
+        def step(h, xs):
+            lp, kv = xs
+            h, _ = block(cfg, h, lp, positions, mask, shared_kv=kv)
+            return h, None
+        if cfg.scan_layers:
+            x, _ = jax.lax.scan(step, x, (layers, skv))
+        else:
+            for i in range(cfg.num_layers):
+                sl = jax.tree_util.tree_map(lambda a: a[i], (layers, skv))
+                x, _ = step(x, sl)
+        return x, None
 
     if cache is None:
         def step(h, lp):
@@ -726,8 +780,7 @@ def prefill(params: Params, cfg: TransformerConfig, tokens: jax.Array,
 
 def prefill_suffix(params: Params, cfg: TransformerConfig,
                    tokens: jax.Array, pad_mask: jax.Array, cache: Dict,
-                   prefix_len: int, return_all_logits: bool = False
-                   ) -> Tuple[jax.Array, Dict, jax.Array]:
+                   prefix_len: int) -> Tuple[jax.Array, Dict, jax.Array]:
     """Prefill left-padded per-row suffixes behind a shared prefix.
 
     The eval workload's prompts share long prefixes — a FixKRetriever
@@ -743,9 +796,10 @@ def prefill_suffix(params: Params, cfg: TransformerConfig,
 
     tokens/pad_mask: (B, S') LEFT-padded suffixes, so every row's last
     real token lands at slot prefix_len + S' - 1 and decode steps stay
-    batch-uniform.  Returns (logits, cache, next-token positions);
-    ``return_all_logits`` selects (B, S', V) full-position logits (the
-    scoring path) over last-position (B, V).
+    batch-uniform.  Returns (last-position logits (B, V), cache,
+    next-token positions).  This is the GENERATION half of the
+    shared-prefix optimization; scoring goes through ``forward_shared``
+    (batch-1 prefix K/V, no broadcast cache).
     """
     if cfg.prefix_lm or cfg.positional == 'alibi':
         # prefix-LM would need the cached prefix K/V to have attended the
@@ -773,12 +827,36 @@ def prefill_suffix(params: Params, cfg: TransformerConfig,
     x = _embed(params, cfg, tokens, positions)
     x, cache = _stack(cfg, x, params['layers'], positions, mask, cache, P,
                       kv_positions=kv_positions)
-    if return_all_logits:
-        logits = _unembed(params, cfg, x)
-    else:
-        logits = _unembed(params, cfg, x[:, -1:, :])[:, 0, :]
+    logits = _unembed(params, cfg, x[:, -1:, :])[:, 0, :]
     next_pos = positions[:, -1] + 1
     return logits, cache, next_pos
+
+
+def forward_shared(params: Params, cfg: TransformerConfig,
+                   prefix_cache: Dict, tokens: jax.Array,
+                   pad_mask: jax.Array, prefix_len: int) -> jax.Array:
+    """Full-sequence scoring forward for suffixes behind a shared prefix.
+
+    ``prefix_cache``: a batch-1 prefill's cache, leaves (L, 1, K, P, hd)
+    — kept batch-1 throughout (two-source attention,
+    ``_attention_shared``), so memory is a plain forward plus one copy
+    of the prefix K/V.  tokens/pad_mask: (B, S') RIGHT-padded
+    remainders.  Returns fp32 logits (B, S', V) at every suffix
+    position.  Guards mirror prefill_suffix: no prefix-LM, no ALiBi.
+    """
+    if cfg.prefix_lm or cfg.positional == 'alibi':
+        raise NotImplementedError(
+            'shared-prefix forward supports neither prefix-LM nor ALiBi')
+    B, S = tokens.shape
+    pad_mask = pad_mask.astype(jnp.bool_)
+    positions = prefix_len + token_positions(pad_mask)
+    causal = jnp.tril(jnp.ones((S, S), jnp.bool_))
+    mask = causal[None, :, :] & pad_mask[:, None, :]
+    x = _embed(params, cfg, tokens, positions)
+    x, _ = _stack(cfg, x, params['layers'], positions, mask,
+                  shared_kv={'k': prefix_cache['k'],
+                             'v': prefix_cache['v']})
+    return _unembed(params, cfg, x)
 
 
 def broadcast_cache(cache: Dict, batch: int) -> Dict:

@@ -1,4 +1,5 @@
-"""Shared-prefix prefill reuse (nn/transformer.prefill_suffix).
+"""Shared-prefix prefill reuse (transformer.forward_shared for
+scoring, prefill_suffix for generation).
 
 The eval workload's prompts share long prefixes — FixKRetriever 5-shot
 ICE blocks are identical across a subset's items, and a PPL item's
