@@ -123,17 +123,20 @@ class JaxLM(BaseModel):
         self._ids_cache_max = 8192
         self._len_cache_max = 1_000_000
         self._gen_fn_cache: Dict[tuple, object] = {}
-        # shared-prefix prefill reuse (nn/transformer.prefill_suffix): a
-        # batch whose prompts share a long common token prefix (fixed
-        # few-shot ICE blocks; PPL label variants) prefills it once.
-        # Applied when the batch's common prefix is >= _sp_quantum
-        # tokens; the prefix length is rounded down to a multiple of the
-        # quantum so jit shape buckets stay bounded.  Single-chip only
-        # (mesh users keep the plain path) and off for prefix-LM models
-        # (their prompt attends bidirectionally, so a frozen prefix
-        # cache would change semantics).
+        # shared-prefix prefill reuse: a batch whose prompts share a long
+        # common token prefix (fixed few-shot ICE blocks; PPL label
+        # variants) prefills it once (nn: forward_shared for scoring,
+        # prefill_suffix for generation).  Applied when the batch's
+        # common prefix is >= _sp_quantum tokens; the prefix length is
+        # rounded DOWN to a multiple of the quantum so jit shape buckets
+        # stay bounded.  The quantum is coarse (256) on purpose: every
+        # distinct (prefix, suffix) shape pair compiles its own
+        # executables, and occasional shape pairs hit pathologically
+        # slow XLA compiles (measured 10-16 min through the remote-
+        # compile tunnel at 7B) — fewer pairs, fewer rolls of that die.
+        # Off for prefix-LM / ALiBi models and seq/model meshes.
         self.shared_prefix = shared_prefix
-        self._sp_quantum = 64
+        self._sp_quantum = 256
         # quantize modes compose 'base[-kvN]': base 'int8' (weight-only),
         # 'w8a8' (int8 weights + dynamic per-token int8 activations on
         # the MXU), or 'w4a8' (int4 weights packed two-per-uint8 with

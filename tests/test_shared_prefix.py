@@ -143,7 +143,7 @@ def test_prefixed_generate_eos_and_quant():
 
 def _mk_lms():
     from opencompass_tpu.models import JaxLM
-    kw = dict(config='tiny', max_seq_len=256, dtype='float32')
+    kw = dict(config='tiny', max_seq_len=512, dtype='float32')
     return (JaxLM(shared_prefix=True, **kw),
             JaxLM(shared_prefix=False, **kw))
 
@@ -152,14 +152,15 @@ def test_jaxlm_ppl_shared_matches_plain():
     lm_on, lm_off = _mk_lms()
     base = ('Passage: the quick brown fox jumps over the lazy dog and '
             'then continues running through the long field for a while '
-            'before finally stopping near the river to rest. Question: ')
+            'before finally stopping near the river to rest. ') * 4 \
+        + 'Question: '
     texts = [base + q for q in
              ('what is A?', 'what is B maybe?', 'what is C exactly now?')]
-    # confirm the shared path actually engages (byte tokenizer: prefix
-    # is > 64 tokens)
+    # confirm the shared path actually engages (byte tokenizer: the
+    # prefix exceeds the 256-token engagement quantum)
     ids = [lm_on._encode_ids(t) for t in texts]
     pre, _ = lm_on._shared_prefix_split(ids)
-    assert pre is not None and len(pre) >= 64
+    assert pre is not None and len(pre) >= 256
     a = lm_on.get_ppl(texts)
     b = lm_off.get_ppl(texts)
     np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-3)
@@ -167,7 +168,7 @@ def test_jaxlm_ppl_shared_matches_plain():
 
 def test_jaxlm_ppl_shared_mask_length_matches_plain():
     lm_on, lm_off = _mk_lms()
-    base = 'x' * 150 + ' answer choice: '
+    base = 'x' * 300 + ' answer choice: '
     texts = [base + c for c in ('alpha', 'beta', 'gamma gamma')]
     ml = [len(lm_on._encode_ids(base))] * 3
     a = lm_on.get_ppl(texts, mask_length=ml)
@@ -179,7 +180,7 @@ def test_jaxlm_generate_shared_matches_plain():
     lm_on, lm_off = _mk_lms()
     base = ('Example 1: in goes one, out comes two. Example 2: in goes '
             'two, out comes three. Example 3: in goes nine, out comes '
-            'ten. Now the question is about the number ')
+            'ten. ') * 3 + 'Now the question is about the number '
     texts = [base + q for q in ('four.', 'seventeen!', 'zero?')]
     a = lm_on.generate(texts, max_out_len=8)
     b = lm_off.generate(texts, max_out_len=8)
