@@ -109,7 +109,16 @@ def get_config_from_arg(args) -> Config:
     return cfg
 
 
-def _build_runner(task_type, args, cfg):
+def _build_runner(task_type, args, cfg, phase='infer'):
+    # a config-declared runner (cfg[phase].runner, reference run.py
+    # semantics) wins unless a CLI launcher flag (--slurm/--dlc)
+    # explicitly overrides it; its dict is constructor kwargs + 'type'
+    rcfg = cfg.get(phase, {}).get('runner') if phase in cfg else None
+    if rcfg and not (args.slurm or args.dlc):
+        rcfg = dict(rcfg, task=dict(type=task_type))
+        rcfg.setdefault('debug', args.debug)
+        rcfg.setdefault('lark_bot_url', cfg.get('lark_bot_url'))
+        return RUNNERS.build(rcfg)
     if args.slurm:
         return SlurmRunner(dict(type=task_type),
                            max_num_workers=args.max_num_workers,
@@ -137,12 +146,12 @@ def _build_runner(task_type, args, cfg):
 
 
 def exec_infer_runner(tasks, args, cfg):
-    runner = _build_runner('OpenICLInferTask', args, cfg)
+    runner = _build_runner('OpenICLInferTask', args, cfg, phase='infer')
     runner(tasks)
 
 
 def exec_eval_runner(tasks, args, cfg):
-    runner = _build_runner('OpenICLEvalTask', args, cfg)
+    runner = _build_runner('OpenICLEvalTask', args, cfg, phase='eval')
     runner(tasks)
 
 

@@ -241,3 +241,27 @@ def test_slot_allocator_thread_safety():
         list(pool.map(worker, range(8)))
     assert not errors, errors[:3]
     assert r._slots == [False] * 4
+
+
+def test_cli_config_declared_runner():
+    """cfg[phase].runner (reference run.py semantics) builds the runner;
+    CLI flags fill unset defaults and launcher flags override."""
+    import types
+
+    from opencompass_tpu.cli import _build_runner
+    args = types.SimpleNamespace(slurm=False, dlc=False, debug=True,
+                                 max_num_workers=4, partition=None,
+                                 quotatype=None, retry=0, num_devices=None)
+    cfg = {'infer': {'runner': dict(type='LocalRunner', max_num_workers=2,
+                                    retry=3, stall_timeout=900)}}
+    r = _build_runner('OpenICLInferTask', args, cfg, phase='infer')
+    assert type(r).__name__ == 'LocalRunner'
+    assert (r.max_num_workers, r.retry, r.stall_timeout) == (2, 3, 900)
+    assert r.debug is True  # CLI default filled in
+    # phase without a config runner falls back to CLI construction
+    r2 = _build_runner('OpenICLEvalTask', args, cfg, phase='eval')
+    assert r2.max_num_workers == 4
+    # an explicit launcher flag overrides the config runner
+    args.slurm = True
+    r3 = _build_runner('OpenICLInferTask', args, cfg, phase='infer')
+    assert type(r3).__name__ == 'SlurmRunner'
