@@ -167,3 +167,50 @@ def test_ppl_truncation_carries_across_labels(tmp_path):
     k_short, _ = fitter.fit(0, render_short)
     assert k_long < 4          # truncation happened
     assert k_short <= k_long   # carried ceiling, not refit from full
+
+
+def test_ppl_item_major_batching_same_scores(tmp_path):
+    """With a shared-prefix model, the PPL inferencer batches one item's
+    label variants together (deep common prefix); predictions and saved
+    PPLs must be identical to label-major batching."""
+    ds = ToyDataset(reader_cfg=READER_CFG)
+    template = PromptTemplate({
+        'A': '</E>Q: {question}\nA: A',
+        'B': '</E>Q: {question}\nA: B',
+    }, ice_token='</E>')
+    canned = {
+        'q0\nA: A': 1.0, 'q0\nA: B': 5.0,
+        'q1\nA: A': 5.0, 'q1\nA: B': 1.0,
+        'q2\nA: A': 1.0, 'q2\nA: B': 5.0,
+        'q3\nA: A': 5.0, 'q3\nA: B': 1.0,
+    }
+
+    class SharedPrefixModel(FakeModel):
+        shared_prefix_active = True
+
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.batches = []
+
+        def get_ppl_from_template(self, templates, **kw):
+            self.batches.append([str(t) for t in templates])
+            return super().get_ppl_from_template(templates, **kw)
+
+    model = SharedPrefixModel(canned_ppls=dict(canned))
+    inferencer = PPLInferencer(model=model, batch_size=2,
+                               output_json_filepath=str(tmp_path))
+    preds = inferencer.inference(ZeroRetriever(ds),
+                                 prompt_template=template)
+    assert preds == ['A', 'B', 'A', 'B']
+    # every scoring batch held ONE item's label variants
+    assert all(len(b) == 2 and 'A: A' in b[0] and 'A: B' in b[1]
+               for b in model.batches)
+    q_of = [b[0].split('Q: ')[1].split('\n')[0] for b in model.batches]
+    assert q_of == ['test q0', 'test q1', 'test q2', 'test q3']
+
+    # plain model (no shared_prefix attr -> label-major) agrees exactly
+    plain = FakeModel(canned_ppls=dict(canned))
+    inferencer2 = PPLInferencer(model=plain, batch_size=2,
+                                output_json_filepath=str(tmp_path / 'b'))
+    assert inferencer2.inference(ZeroRetriever(ds),
+                                 prompt_template=template) == preds
