@@ -136,6 +136,13 @@ class LocalRunner(BaseRunner):
             ':' + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
         if task.num_devices > 0:
             env['TPU_VISIBLE_CHIPS'] = ','.join(map(str, chip_ids))
+            # persistent XLA compilation cache shared across task
+            # processes and runs: each task is a fresh interpreter, and
+            # recompiling the suite's shape buckets per task is pure
+            # waste (occasional shapes hit pathologically slow compiles
+            # — measured 3-14 min through the remote-compile tunnel)
+            env.setdefault('JAX_COMPILATION_CACHE_DIR',
+                           osp.abspath('.cache/jax_compilation'))
         else:
             # CPU-only task: never contend for the exclusive chip
             env['JAX_PLATFORMS'] = 'cpu'

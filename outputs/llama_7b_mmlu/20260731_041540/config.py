@@ -1,0 +1,14366 @@
+mmlu_datasets = [
+    {
+        'abbr': 'lukaemon_mmlu_college_biology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college biology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college biology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_chemistry',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college chemistry. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college chemistry. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_computer_science',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college computer science. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college computer science. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college mathematics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college mathematics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college physics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college physics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_electrical_engineering',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'electrical_engineering',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about electrical engineering. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about electrical engineering. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_astronomy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'astronomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about astronomy. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about astronomy. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_anatomy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'anatomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about anatomy. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about anatomy. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_abstract_algebra',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'abstract_algebra',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about abstract algebra. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about abstract algebra. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_machine_learning',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'machine_learning',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about machine learning. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about machine learning. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_clinical_knowledge',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'clinical_knowledge',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about clinical knowledge. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about clinical knowledge. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_global_facts',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'global_facts',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about global facts. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about global facts. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_management',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'management',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about management. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about management. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_nutrition',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'nutrition',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about nutrition. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about nutrition. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_marketing',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'marketing',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about marketing. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about marketing. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_accounting',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_accounting',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional accounting. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional accounting. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_geography',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_geography',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school geography. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school geography. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_international_law',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'international_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about international law. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about international law. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_scenarios',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_scenarios',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about moral scenarios. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about moral scenarios. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_computer_security',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'computer_security',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about computer security. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about computer security. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_microeconomics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_microeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school microeconomics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school microeconomics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_law',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional law. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional law. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_medical_genetics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'medical_genetics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about medical genetics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about medical genetics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_psychology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional psychology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional psychology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_jurisprudence',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'jurisprudence',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about jurisprudence. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about jurisprudence. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_world_religions',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'world_religions',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about world religions. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about world religions. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_philosophy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'philosophy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about philosophy. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about philosophy. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_virology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'virology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about virology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about virology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_chemistry',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school chemistry. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school chemistry. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_public_relations',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'public_relations',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about public relations. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about public relations. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_macroeconomics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_macroeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school macroeconomics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school macroeconomics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_sexuality',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_sexuality',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about human sexuality. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about human sexuality. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_elementary_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'elementary_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about elementary mathematics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about elementary mathematics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school physics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school physics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_computer_science',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school computer science. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school computer science. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_european_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_european_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school european history. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school european history. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_business_ethics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'business_ethics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about business ethics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about business ethics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_disputes',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_disputes',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about moral disputes. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about moral disputes. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_statistics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_statistics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school statistics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school statistics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_miscellaneous',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'miscellaneous',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about miscellaneous. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about miscellaneous. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_formal_logic',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'formal_logic',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about formal logic. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about formal logic. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_government_and_politics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_government_and_politics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school government and politics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school government and politics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_prehistory',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'prehistory',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about prehistory. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about prehistory. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_security_studies',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'security_studies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about security studies. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about security studies. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_biology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school biology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school biology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_logical_fallacies',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'logical_fallacies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about logical fallacies. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about logical fallacies. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_world_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_world_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school world history. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school world history. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_medicine',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional medicine. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional medicine. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school mathematics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school mathematics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_medicine',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college medicine. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college medicine. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_us_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_us_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school us history. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school us history. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_sociology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'sociology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about sociology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about sociology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_econometrics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'econometrics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about econometrics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about econometrics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_psychology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school psychology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school psychology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_aging',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_aging',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about human aging. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about human aging. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_us_foreign_policy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'us_foreign_policy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about us foreign policy. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about us foreign policy. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_conceptual_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'conceptual_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about conceptual physics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about conceptual physics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    }
+]
+mmlu_ppl_datasets = [
+    {
+        'abbr': 'lukaemon_mmlu_college_biology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_chemistry_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_computer_science_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_mathematics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_physics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_electrical_engineering_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'electrical_engineering',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_astronomy_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'astronomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_anatomy_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'anatomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_abstract_algebra_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'abstract_algebra',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_machine_learning_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'machine_learning',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_clinical_knowledge_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'clinical_knowledge',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_global_facts_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'global_facts',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_management_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'management',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_nutrition_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'nutrition',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_marketing_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'marketing',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_accounting_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_accounting',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_geography_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_geography',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_international_law_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'international_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_scenarios_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_scenarios',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_computer_security_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'computer_security',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_microeconomics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_microeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_law_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_medical_genetics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'medical_genetics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_psychology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_jurisprudence_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'jurisprudence',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_world_religions_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'world_religions',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_philosophy_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'philosophy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_virology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'virology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_chemistry_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_public_relations_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'public_relations',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_macroeconomics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_macroeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_sexuality_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_sexuality',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_elementary_mathematics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'elementary_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_physics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_computer_science_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_european_history_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_european_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_business_ethics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'business_ethics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_disputes_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_disputes',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_statistics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_statistics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_miscellaneous_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'miscellaneous',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_formal_logic_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'formal_logic',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_government_and_politics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_government_and_politics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_prehistory_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'prehistory',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_security_studies_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'security_studies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_biology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_logical_fallacies_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'logical_fallacies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_world_history_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_world_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_medicine_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_mathematics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_medicine_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_us_history_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_us_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_sociology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'sociology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_econometrics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'econometrics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_psychology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_aging_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_aging',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_us_foreign_policy_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'us_foreign_policy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_conceptual_physics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'conceptual_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    }
+]
+mmlu_summary_groups = [
+    {
+        'name': 'mmlu',
+        'subsets': [
+            'lukaemon_mmlu_college_biology',
+            'lukaemon_mmlu_college_chemistry',
+            'lukaemon_mmlu_college_computer_science',
+            'lukaemon_mmlu_college_mathematics',
+            'lukaemon_mmlu_college_physics',
+            'lukaemon_mmlu_electrical_engineering',
+            'lukaemon_mmlu_astronomy',
+            'lukaemon_mmlu_anatomy',
+            'lukaemon_mmlu_abstract_algebra',
+            'lukaemon_mmlu_machine_learning',
+            'lukaemon_mmlu_clinical_knowledge',
+            'lukaemon_mmlu_global_facts',
+            'lukaemon_mmlu_management',
+            'lukaemon_mmlu_nutrition',
+            'lukaemon_mmlu_marketing',
+            'lukaemon_mmlu_professional_accounting',
+            'lukaemon_mmlu_high_school_geography',
+            'lukaemon_mmlu_international_law',
+            'lukaemon_mmlu_moral_scenarios',
+            'lukaemon_mmlu_computer_security',
+            'lukaemon_mmlu_high_school_microeconomics',
+            'lukaemon_mmlu_professional_law',
+            'lukaemon_mmlu_medical_genetics',
+            'lukaemon_mmlu_professional_psychology',
+            'lukaemon_mmlu_jurisprudence',
+            'lukaemon_mmlu_world_religions',
+            'lukaemon_mmlu_philosophy',
+            'lukaemon_mmlu_virology',
+            'lukaemon_mmlu_high_school_chemistry',
+            'lukaemon_mmlu_public_relations',
+            'lukaemon_mmlu_high_school_macroeconomics',
+            'lukaemon_mmlu_human_sexuality',
+            'lukaemon_mmlu_elementary_mathematics',
+            'lukaemon_mmlu_high_school_physics',
+            'lukaemon_mmlu_high_school_computer_science',
+            'lukaemon_mmlu_high_school_european_history',
+            'lukaemon_mmlu_business_ethics',
+            'lukaemon_mmlu_moral_disputes',
+            'lukaemon_mmlu_high_school_statistics',
+            'lukaemon_mmlu_miscellaneous',
+            'lukaemon_mmlu_formal_logic',
+            'lukaemon_mmlu_high_school_government_and_politics',
+            'lukaemon_mmlu_prehistory',
+            'lukaemon_mmlu_security_studies',
+            'lukaemon_mmlu_high_school_biology',
+            'lukaemon_mmlu_logical_fallacies',
+            'lukaemon_mmlu_high_school_world_history',
+            'lukaemon_mmlu_professional_medicine',
+            'lukaemon_mmlu_high_school_mathematics',
+            'lukaemon_mmlu_college_medicine',
+            'lukaemon_mmlu_high_school_us_history',
+            'lukaemon_mmlu_sociology',
+            'lukaemon_mmlu_econometrics',
+            'lukaemon_mmlu_high_school_psychology',
+            'lukaemon_mmlu_human_aging',
+            'lukaemon_mmlu_us_foreign_policy',
+            'lukaemon_mmlu_conceptual_physics'
+        ]
+    }
+]
+datasets = [
+    {
+        'abbr': 'lukaemon_mmlu_college_biology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college biology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college biology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_chemistry',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college chemistry. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college chemistry. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_computer_science',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college computer science. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college computer science. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college mathematics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college mathematics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college physics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college physics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_electrical_engineering',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'electrical_engineering',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about electrical engineering. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about electrical engineering. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_astronomy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'astronomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about astronomy. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about astronomy. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_anatomy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'anatomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about anatomy. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about anatomy. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_abstract_algebra',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'abstract_algebra',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about abstract algebra. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about abstract algebra. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_machine_learning',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'machine_learning',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about machine learning. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about machine learning. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_clinical_knowledge',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'clinical_knowledge',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about clinical knowledge. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about clinical knowledge. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_global_facts',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'global_facts',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about global facts. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about global facts. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_management',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'management',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about management. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about management. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_nutrition',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'nutrition',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about nutrition. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about nutrition. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_marketing',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'marketing',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about marketing. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about marketing. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_accounting',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_accounting',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional accounting. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional accounting. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_geography',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_geography',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school geography. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school geography. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_international_law',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'international_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about international law. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about international law. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_scenarios',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_scenarios',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about moral scenarios. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about moral scenarios. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_computer_security',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'computer_security',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about computer security. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about computer security. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_microeconomics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_microeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school microeconomics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school microeconomics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_law',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional law. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional law. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_medical_genetics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'medical_genetics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about medical genetics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about medical genetics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_psychology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional psychology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional psychology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_jurisprudence',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'jurisprudence',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about jurisprudence. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about jurisprudence. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_world_religions',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'world_religions',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about world religions. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about world religions. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_philosophy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'philosophy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about philosophy. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about philosophy. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_virology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'virology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about virology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about virology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_chemistry',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school chemistry. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school chemistry. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_public_relations',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'public_relations',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about public relations. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about public relations. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_macroeconomics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_macroeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school macroeconomics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school macroeconomics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_sexuality',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_sexuality',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about human sexuality. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about human sexuality. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_elementary_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'elementary_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about elementary mathematics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about elementary mathematics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school physics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school physics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_computer_science',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school computer science. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school computer science. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_european_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_european_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school european history. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school european history. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_business_ethics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'business_ethics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about business ethics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about business ethics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_disputes',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_disputes',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about moral disputes. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about moral disputes. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_statistics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_statistics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school statistics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school statistics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_miscellaneous',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'miscellaneous',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about miscellaneous. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about miscellaneous. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_formal_logic',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'formal_logic',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about formal logic. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about formal logic. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_government_and_politics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_government_and_politics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school government and politics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school government and politics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_prehistory',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'prehistory',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about prehistory. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about prehistory. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_security_studies',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'security_studies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about security studies. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about security studies. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_biology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school biology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school biology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_logical_fallacies',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'logical_fallacies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about logical fallacies. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about logical fallacies. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_world_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_world_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school world history. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school world history. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_medicine',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional medicine. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about professional medicine. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_mathematics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school mathematics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school mathematics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_medicine',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college medicine. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about college medicine. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_us_history',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_us_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school us history. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school us history. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_sociology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'sociology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about sociology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about sociology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_econometrics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'econometrics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about econometrics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about econometrics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_psychology',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school psychology. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about high school psychology. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_aging',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_aging',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about human aging. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about human aging. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_us_foreign_policy',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'us_foreign_policy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about us foreign policy. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about us foreign policy. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_conceptual_physics',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'conceptual_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about conceptual physics. Answer the question by replying A, B, C or D.\nQuestion: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: '
+                        },
+                        {
+                            'role': 'BOT',
+                            'prompt': '{target}\n'
+                        }
+                    ]
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'begin': '</E>',
+                    'round': [
+                        {
+                            'role': 'HUMAN',
+                            'prompt': 'There is a single choice question about conceptual physics. Answer the question by replying A, B, C or D.\nQ: {input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nA: '
+                        }
+                    ]
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.gen.GenInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            },
+            'pred_postprocessor': {
+                'type': 'first-capital'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_biology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_chemistry_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_computer_science_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_mathematics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_physics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_electrical_engineering_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'electrical_engineering',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about electrical engineering.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_astronomy_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'astronomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about astronomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_anatomy_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'anatomy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about anatomy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_abstract_algebra_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'abstract_algebra',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about abstract algebra.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_machine_learning_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'machine_learning',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about machine learning.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_clinical_knowledge_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'clinical_knowledge',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about clinical knowledge.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_global_facts_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'global_facts',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about global facts.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_management_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'management',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about management.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_nutrition_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'nutrition',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about nutrition.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_marketing_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'marketing',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about marketing.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_accounting_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_accounting',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional accounting.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_geography_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_geography',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school geography.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_international_law_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'international_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about international law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_scenarios_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_scenarios',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about moral scenarios.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_computer_security_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'computer_security',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about computer security.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_microeconomics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_microeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school microeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_law_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_law',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional law.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_medical_genetics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'medical_genetics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about medical genetics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_psychology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_jurisprudence_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'jurisprudence',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about jurisprudence.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_world_religions_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'world_religions',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about world religions.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_philosophy_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'philosophy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about philosophy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_virology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'virology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about virology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_chemistry_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_chemistry',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school chemistry.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_public_relations_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'public_relations',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about public relations.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_macroeconomics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_macroeconomics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school macroeconomics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_sexuality_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_sexuality',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about human sexuality.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_elementary_mathematics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'elementary_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about elementary mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_physics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_computer_science_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_computer_science',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school computer science.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_european_history_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_european_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school european history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_business_ethics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'business_ethics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about business ethics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_moral_disputes_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'moral_disputes',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about moral disputes.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_statistics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_statistics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school statistics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_miscellaneous_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'miscellaneous',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about miscellaneous.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_formal_logic_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'formal_logic',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about formal logic.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_government_and_politics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_government_and_politics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school government and politics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_prehistory_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'prehistory',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about prehistory.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_security_studies_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'security_studies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about security studies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_biology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_biology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school biology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_logical_fallacies_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'logical_fallacies',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about logical fallacies.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_world_history_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_world_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school world history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_professional_medicine_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'professional_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about professional medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_mathematics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_mathematics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school mathematics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_college_medicine_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'college_medicine',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about college medicine.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_us_history_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_us_history',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school us history.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_sociology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'sociology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about sociology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_econometrics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'econometrics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about econometrics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_high_school_psychology_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'high_school_psychology',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about high school psychology.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_human_aging_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'human_aging',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about human aging.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_us_foreign_policy_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'us_foreign_policy',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about us foreign policy.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    },
+    {
+        'abbr': 'lukaemon_mmlu_conceptual_physics_ppl',
+        'type': 'opencompass_tpu.datasets.mmlu.MMLUDataset',
+        'path': './data/mmlu/',
+        'name': 'conceptual_physics',
+        'reader_cfg': {
+            'input_columns': [
+                'input',
+                'A',
+                'B',
+                'C',
+                'D'
+            ],
+            'output_column': 'target',
+            'train_split': 'dev'
+        },
+        'infer_cfg': {
+            'ice_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A\n',
+                    'B': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B\n',
+                    'C': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C\n',
+                    'D': '{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D\n'
+                }
+            },
+            'prompt_template': {
+                'type': 'opencompass_tpu.icl.prompt_template.PromptTemplate',
+                'template': {
+                    'A': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: A',
+                    'B': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: B',
+                    'C': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: C',
+                    'D': 'The following are multiple choice questions (with answers) about conceptual physics.\n</E>{input}\nA. {A}\nB. {B}\nC. {C}\nD. {D}\nAnswer: D'
+                },
+                'ice_token': '</E>'
+            },
+            'retriever': {
+                'type': 'opencompass_tpu.icl.retrievers.fix_k.FixKRetriever'
+            },
+            'inferencer': {
+                'type': 'opencompass_tpu.icl.inferencers.ppl.PPLInferencer',
+                'fix_id_list': [
+                    0,
+                    1,
+                    2,
+                    3,
+                    4
+                ]
+            }
+        },
+        'eval_cfg': {
+            'evaluator': {
+                'type': 'opencompass_tpu.icl.evaluators.metrics.AccEvaluator'
+            }
+        }
+    }
+]
+models = [
+    {
+        'type': 'opencompass_tpu.models.jax_lm.JaxLM',
+        'abbr': 'llama-7b-jax',
+        'path': './models/llama-7b-hf',
+        'config': {
+            'preset': 'llama'
+        },
+        'max_seq_len': 2048,
+        'batch_size': 8,
+        'max_out_len': 100,
+        'dtype': 'bfloat16',
+        'quantize': 'w8a8-kv4',
+        'parallel': {
+            'data': -1,
+            'model': 1
+        },
+        'run_cfg': {
+            'num_devices': 1
+        }
+    }
+]
+summarizer = {
+    'summary_groups': [
+        {
+            'name': 'mmlu',
+            'subsets': [
+                'lukaemon_mmlu_college_biology',
+                'lukaemon_mmlu_college_chemistry',
+                'lukaemon_mmlu_college_computer_science',
+                'lukaemon_mmlu_college_mathematics',
+                'lukaemon_mmlu_college_physics',
+                'lukaemon_mmlu_electrical_engineering',
+                'lukaemon_mmlu_astronomy',
+                'lukaemon_mmlu_anatomy',
+                'lukaemon_mmlu_abstract_algebra',
+                'lukaemon_mmlu_machine_learning',
+                'lukaemon_mmlu_clinical_knowledge',
+                'lukaemon_mmlu_global_facts',
+                'lukaemon_mmlu_management',
+                'lukaemon_mmlu_nutrition',
+                'lukaemon_mmlu_marketing',
+                'lukaemon_mmlu_professional_accounting',
+                'lukaemon_mmlu_high_school_geography',
+                'lukaemon_mmlu_international_law',
+                'lukaemon_mmlu_moral_scenarios',
+                'lukaemon_mmlu_computer_security',
+                'lukaemon_mmlu_high_school_microeconomics',
+                'lukaemon_mmlu_professional_law',
+                'lukaemon_mmlu_medical_genetics',
+                'lukaemon_mmlu_professional_psychology',
+                'lukaemon_mmlu_jurisprudence',
+                'lukaemon_mmlu_world_religions',
+                'lukaemon_mmlu_philosophy',
+                'lukaemon_mmlu_virology',
+                'lukaemon_mmlu_high_school_chemistry',
+                'lukaemon_mmlu_public_relations',
+                'lukaemon_mmlu_high_school_macroeconomics',
+                'lukaemon_mmlu_human_sexuality',
+                'lukaemon_mmlu_elementary_mathematics',
+                'lukaemon_mmlu_high_school_physics',
+                'lukaemon_mmlu_high_school_computer_science',
+                'lukaemon_mmlu_high_school_european_history',
+                'lukaemon_mmlu_business_ethics',
+                'lukaemon_mmlu_moral_disputes',
+                'lukaemon_mmlu_high_school_statistics',
+                'lukaemon_mmlu_miscellaneous',
+                'lukaemon_mmlu_formal_logic',
+                'lukaemon_mmlu_high_school_government_and_politics',
+                'lukaemon_mmlu_prehistory',
+                'lukaemon_mmlu_security_studies',
+                'lukaemon_mmlu_high_school_biology',
+                'lukaemon_mmlu_logical_fallacies',
+                'lukaemon_mmlu_high_school_world_history',
+                'lukaemon_mmlu_professional_medicine',
+                'lukaemon_mmlu_high_school_mathematics',
+                'lukaemon_mmlu_college_medicine',
+                'lukaemon_mmlu_high_school_us_history',
+                'lukaemon_mmlu_sociology',
+                'lukaemon_mmlu_econometrics',
+                'lukaemon_mmlu_high_school_psychology',
+                'lukaemon_mmlu_human_aging',
+                'lukaemon_mmlu_us_foreign_policy',
+                'lukaemon_mmlu_conceptual_physics'
+            ]
+        },
+        {
+            'name': 'mmlu_ppl',
+            'subsets': [
+                'lukaemon_mmlu_college_biology_ppl',
+                'lukaemon_mmlu_college_chemistry_ppl',
+                'lukaemon_mmlu_college_computer_science_ppl',
+                'lukaemon_mmlu_college_mathematics_ppl',
+                'lukaemon_mmlu_college_physics_ppl',
+                'lukaemon_mmlu_electrical_engineering_ppl',
+                'lukaemon_mmlu_astronomy_ppl',
+                'lukaemon_mmlu_anatomy_ppl',
+                'lukaemon_mmlu_abstract_algebra_ppl',
+                'lukaemon_mmlu_machine_learning_ppl',
+                'lukaemon_mmlu_clinical_knowledge_ppl',
+                'lukaemon_mmlu_global_facts_ppl',
+                'lukaemon_mmlu_management_ppl',
+                'lukaemon_mmlu_nutrition_ppl',
+                'lukaemon_mmlu_marketing_ppl',
+                'lukaemon_mmlu_professional_accounting_ppl',
+                'lukaemon_mmlu_high_school_geography_ppl',
+                'lukaemon_mmlu_international_law_ppl',
+                'lukaemon_mmlu_moral_scenarios_ppl',
+                'lukaemon_mmlu_computer_security_ppl',
+                'lukaemon_mmlu_high_school_microeconomics_ppl',
+                'lukaemon_mmlu_professional_law_ppl',
+                'lukaemon_mmlu_medical_genetics_ppl',
+                'lukaemon_mmlu_professional_psychology_ppl',
+                'lukaemon_mmlu_jurisprudence_ppl',
+                'lukaemon_mmlu_world_religions_ppl',
+                'lukaemon_mmlu_philosophy_ppl',
+                'lukaemon_mmlu_virology_ppl',
+                'lukaemon_mmlu_high_school_chemistry_ppl',
+                'lukaemon_mmlu_public_relations_ppl',
+                'lukaemon_mmlu_high_school_macroeconomics_ppl',
+                'lukaemon_mmlu_human_sexuality_ppl',
+                'lukaemon_mmlu_elementary_mathematics_ppl',
+                'lukaemon_mmlu_high_school_physics_ppl',
+                'lukaemon_mmlu_high_school_computer_science_ppl',
+                'lukaemon_mmlu_high_school_european_history_ppl',
+                'lukaemon_mmlu_business_ethics_ppl',
+                'lukaemon_mmlu_moral_disputes_ppl',
+                'lukaemon_mmlu_high_school_statistics_ppl',
+                'lukaemon_mmlu_miscellaneous_ppl',
+                'lukaemon_mmlu_formal_logic_ppl',
+                'lukaemon_mmlu_high_school_government_and_politics_ppl',
+                'lukaemon_mmlu_prehistory_ppl',
+                'lukaemon_mmlu_security_studies_ppl',
+                'lukaemon_mmlu_high_school_biology_ppl',
+                'lukaemon_mmlu_logical_fallacies_ppl',
+                'lukaemon_mmlu_high_school_world_history_ppl',
+                'lukaemon_mmlu_professional_medicine_ppl',
+                'lukaemon_mmlu_high_school_mathematics_ppl',
+                'lukaemon_mmlu_college_medicine_ppl',
+                'lukaemon_mmlu_high_school_us_history_ppl',
+                'lukaemon_mmlu_sociology_ppl',
+                'lukaemon_mmlu_econometrics_ppl',
+                'lukaemon_mmlu_high_school_psychology_ppl',
+                'lukaemon_mmlu_human_aging_ppl',
+                'lukaemon_mmlu_us_foreign_policy_ppl',
+                'lukaemon_mmlu_conceptual_physics_ppl'
+            ]
+        }
+    ]
+}
+infer = {
+    'partitioner': {
+        'type': 'SizePartitioner',
+        'max_task_size': 40000,
+        'gen_task_coef': 20
+    }
+}
+task_timeout = 14400
+stall_timeout = 1800
+work_dir = './outputs/llama_7b_mmlu/20260731_041540'
