@@ -156,6 +156,13 @@ def exec_eval_runner(tasks, args, cfg):
 
 
 def main():
+    # persistent XLA compilation cache for the whole pipeline — tasks
+    # inherit it (LocalRunner also sets it for device tasks), and the
+    # --debug in-process path benefits directly.  Rare shapes compile
+    # for minutes through remote-compile tunnels; the cache serves them
+    # from disk on every later run.
+    os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
+                          osp.abspath('.cache/jax_compilation'))
     args = parse_args()
     cfg = get_config_from_arg(args)
     work_dir = cfg['work_dir']
