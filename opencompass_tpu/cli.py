@@ -16,7 +16,6 @@ Every phase is resumable because completion is keyed on output files
 (SURVEY.md appendix).  Parity: reference run.py:15-319.
 """
 import argparse
-import getpass
 import os
 import os.path as osp
 from datetime import datetime

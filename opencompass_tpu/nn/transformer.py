@@ -393,6 +393,14 @@ def _row_parallel(x, p, tp_axis, act_quant=False):
     contraction), so rescaling the local partial product commutes with the
     psum."""
     w = p['w']
+    if _is_packed(w):
+        # int4x2 uint8 bytes must never reach a raw matmul: contracting
+        # packed bytes produces garbage silently.  JaxLM guards
+        # w4a8+model-parallel, but direct nn-API users with a tp_axis
+        # would bypass that guard.
+        raise NotImplementedError(
+            'int4x2 packed weights are not supported under tensor '
+            'parallelism (unpack to int8 or run single-chip)')
     if _is_quant(w):
         y = _linear(x, {k: v for k, v in p.items() if k != 'b'},
                     act_quant=act_quant)
