@@ -1,0 +1,15 @@
+"""Backend probe shared by the Pallas kernel modules."""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+@functools.cache
+def on_tpu() -> bool:
+    """True when the default JAX backend is a TPU (kernel gates)."""
+    try:
+        return jax.devices()[0].platform == 'tpu'
+    except RuntimeError:  # pragma: no cover - no backend configured
+        return False

@@ -1,0 +1,353 @@
+"""Pallas decode-step attention over the (possibly quantized) KV cache.
+
+Why this kernel exists: at decode the XLA path (`transformer._attention`
+with T=1) converts the ENTIRE cache to bf16 before the score einsum —
+the convert cannot fuse into a TPU dot operand, so every step
+materializes a bf16 copy of the cache in HBM (visible in the optimized
+HLO: `convert = bf16[B,K,S,hd] convert(s8[...])` per layer per step).
+At llama-7B batch 128 that is ~20 GB of hidden traffic per generated
+token; attention was 21-31 ms of the ~31-41 ms step against a 2-5 GB
+actual cache.  This kernel reads the cache tiles into VMEM once and
+keeps every wide intermediate on-chip.
+
+Shape strategy: one grid step handles one batch row and one S-chunk of
+all KV heads at once.  Both contractions are K-batched `dot_general`s
+(batch dim = kv head), so only own-head pairs are ever computed — no
+cross-head masking, gathers, or scatters.  For an int8 cache the score
+dot runs int8 x int8 natively on the MXU: q is dynamically quantized
+per head in-kernel, so the K tile is consumed in its stored dtype with
+NO dequantized copy; per-vector cache scales fold into the scores
+afterwards.  The V pass mirrors this: the per-vector V scales fold
+into the probabilities, which are dynamically quantized to int8 per
+head, so the V tile is contracted int8 x int8 as well.  The only
+full-tile dequantization anywhere is thus avoided entirely; the cost
+is dynamic-int8 noise on q and the probabilities (the same construct
+as the W8A8 matmul activations, pinned by the agreement stats).
+
+Online softmax across S-chunks (running max/sum + output accumulator in
+VMEM scratch, flash-attention style) keeps long caches within VMEM;
+short caches run as a single chunk.
+
+Padding/garbage discipline: S is padded up to the chunk size, so tile
+reads past the real array would be undefined.  The per-layer
+(non-stacked) entry physically zero-pads its inputs, making every read
+defined — this is also why it is the only entry accepting bf16 caches
+(bf16 garbage can be NaN, and Mosaic folds the x==x scrub away).  The
+stacked entry cannot pad its multi-GB cache; it is int8-only (finite
+garbage), zeroes the scale tiles behind an in-bounds iota mask, and
+applies -1e30 validity biases built in the wrapper from real, padded
+arrays.
+
+Numerics pinned by tests/test_decode_attention.py (CPU interpret parity
+vs `transformer._attention` and an on-chip slow-tier run).  The
+reference never had this problem: torch decodes through HF
+transformers' fused attention (reference models/huggingface.py:127-199).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ._platform import on_tpu as _on_tpu
+
+# S-chunk width: fp32 score tiles (H, K, CHUNK) plus two cache tiles stay
+# ~4-6 MB at llama-7B geometry
+_CHUNK = 512
+
+# test hook: run the kernels through the Pallas interpreter (and pass the
+# platform gate) so the hermetic CPU suite can exercise the full decode
+# path end to end
+FORCE_INTERPRET = False
+
+
+def supported(cfg_positional: str, head_dim: int, num_heads: int,
+              num_kv_heads: int, k_dtype, interpret: bool = False) -> bool:
+    """Conservative gate for the decode kernel.  ALiBi needs per-slot
+    additive biases (not implemented); head_dim must be lane-aligned."""
+    if not (interpret or FORCE_INTERPRET) and not _on_tpu():
+        return False
+    if cfg_positional == 'alibi':
+        return False
+    if head_dim % 128:
+        return False
+    if num_heads % num_kv_heads:
+        return False
+    if jnp.dtype(k_dtype) not in (jnp.dtype(jnp.int8),
+                                  jnp.dtype(jnp.bfloat16),
+                                  jnp.dtype(jnp.float32)):
+        return False
+    return True
+
+
+def _kernel(q_ref, k_ref, v_ref, ks_ref, vs_ref, vb_ref, o_ref,
+            m_ref, l_ref, acc_ref, *, scale, chunks, s_total, chunk):
+    import jax.experimental.pallas as pl
+
+    ci = pl.program_id(1)
+    q = q_ref[0]                                     # (H, hd) bf16
+    H, hd = q.shape
+    k = k_ref[0]                                     # (K, CH, hd)
+    K, CH, _ = k.shape
+
+    @pl.when(ci == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # chunk-local in-bounds mask: tile columns past the real array hold
+    # undefined bytes (see module docstring)
+    in_bounds = jax.lax.broadcasted_iota(jnp.int32, (1, CH), 1) \
+        < (s_total - ci * chunk)
+
+    G = H // K
+    quant = k.dtype == jnp.int8
+    if quant:
+        # int8 x int8 scores on the MXU (K-batched dot: only own-head
+        # pairs are computed): quantize q per head, keep the cache tile
+        # in its stored dtype — no dequantized K copy exists, and every
+        # elementwise pass below runs on the small (H, CH) tile.
+        qf = q.astype(jnp.float32)
+        qa = jnp.max(jnp.abs(qf), axis=1, keepdims=True)
+        qs = jnp.maximum(qa / 127.0, 1e-12)          # (H, 1)
+        q8 = jnp.round(qf / qs).astype(jnp.int8)
+        si = jax.lax.dot_general(q8.reshape(K, G, hd), k,
+                                 (((2,), (2,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.int32)
+        s_own = si.reshape(H, CH).astype(jnp.float32)
+        ks = ks_ref[0].astype(jnp.float32)           # (K, CH)
+        ks = jnp.where(in_bounds, ks, 0.0)
+        if G > 1:  # expand per-kv-head scales to query heads
+            ks_g = jnp.broadcast_to(ks[:, None, :],
+                                    (K, G, CH)).reshape(H, CH)
+        else:
+            ks_g = ks
+        s_own = s_own * (qs * scale) * ks_g
+    else:
+        # bf16 caches only reach this kernel through the padded
+        # (non-stacked) entry, so tile reads are always defined
+        kbf = k
+        s = jax.lax.dot_general(q.reshape(K, G, hd), kbf,
+                                (((2,), (2,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32)
+        s_own = s.reshape(H, CH) * scale
+
+    s_own = s_own + vb_ref[0]                        # (1, CH) validity bias
+
+    m_prev = m_ref[:, :1]                            # (H, 1)
+    m_cur = jnp.max(s_own, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)                  # (H, 1)
+    p = jnp.exp(s_own - m_new)                       # (H, CH) f32
+    l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+
+    v = v_ref[0]                                     # (K, CH, hd)
+    if quant:
+        # V pass in int8 too: fold v's per-vector scales into the
+        # probabilities, quantize them per head, and contract
+        # int8 x int8 (K-batched) — the V tile is never dequantized
+        vs = vs_ref[0].astype(jnp.float32)
+        vs = jnp.where(in_bounds, vs, 0.0)
+        if G > 1:
+            vs_g = jnp.broadcast_to(vs[:, None, :],
+                                    (K, G, CH)).reshape(H, CH)
+        else:
+            vs_g = vs
+        pw = p * vs_g                                # (H, CH), >= 0
+        pa = jnp.max(pw, axis=1, keepdims=True)
+        pws = jnp.maximum(pa / 127.0, 1e-30)
+        p8 = jnp.round(pw / pws).astype(jnp.int8)    # (H, CH)
+        oi = jax.lax.dot_general(p8.reshape(K, G, CH), v,
+                                 (((2,), (1,)), ((0,), (0,))),
+                                 preferred_element_type=jnp.int32)
+        o = oi.reshape(H, hd).astype(jnp.float32) * pws
+    else:
+        vbf = v
+        pb = p.astype(jnp.bfloat16)
+        o = jax.lax.dot_general(pb.reshape(K, G, CH), vbf,
+                                (((2,), (1,)), ((0,), (0,))),
+                                preferred_element_type=jnp.float32)
+        o = o.reshape(H, hd)
+    acc_ref[:] = acc_ref[:] * alpha[:, :1] + o
+    m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+    l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ci == chunks - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def decode_attention(q, k, v, kv_valid, scale, k_scale=None,
+                     v_scale=None, interpret=False):
+    """q: (B, H, hd) query for ONE decode position; k/v: (B, K, S, hd)
+    head-major cache (bf16 or int8); kv_valid: (B, S) bool; k_scale /
+    v_scale: (B, K, S) per-vector dequant scales for int8 caches.
+    Returns (B, H, hd) in q.dtype."""
+    interpret = interpret or FORCE_INTERPRET
+    import jax.experimental.pallas as pl
+
+    B, H, hd = q.shape
+    K, S = k.shape[1], k.shape[2]
+    ch = min(_CHUNK, -(-S // 128) * 128)
+    s_pad = -(-S // ch) * ch
+    chunks = s_pad // ch
+    # validity as an additive f32 bias, padded on a REAL array (the
+    # kernel must never branch on garbage tile columns)
+    vb = jnp.where(kv_valid, 0.0, -1e30).astype(jnp.float32)
+    vb = jnp.pad(vb, ((0, 0), (0, s_pad - S)),
+                 constant_values=-1e30)[:, None, :]  # (B, 1, S_pad)
+    if s_pad != S:
+        # this entry point takes per-layer (aux/test) shapes, so a real
+        # zero-pad is affordable and guarantees tile reads are defined
+        # (the stacked entry can't pad its multi-GB cache and relies on
+        # int8 garbage being finite + scales zeroed behind the iota
+        # in-bounds mask instead)
+        pad4 = ((0, 0), (0, 0), (0, s_pad - S), (0, 0))
+        k = jnp.pad(k, pad4)
+        v = jnp.pad(v, pad4)
+        if k_scale is not None:
+            pad3 = ((0, 0), (0, 0), (0, s_pad - S))
+            k_scale = jnp.pad(k_scale, pad3)
+            v_scale = jnp.pad(v_scale, pad3)
+    quant = k_scale is not None
+    kern = functools.partial(_kernel, scale=float(scale), chunks=chunks,
+                             s_total=s_pad, chunk=ch)
+    if not quant:
+        kern = _strip_scales(kern)
+
+    in_specs = [
+        pl.BlockSpec((1, H, hd), lambda b, c: (b, 0, 0)),
+        pl.BlockSpec((1, K, ch, hd), lambda b, c: (b, 0, c, 0)),
+        pl.BlockSpec((1, K, ch, hd), lambda b, c: (b, 0, c, 0)),
+    ]
+    args = [q.astype(jnp.bfloat16), k, v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, K, ch), lambda b, c: (b, 0, c)),
+                     pl.BlockSpec((1, K, ch), lambda b, c: (b, 0, c))]
+        args += [k_scale, v_scale]
+    in_specs.append(pl.BlockSpec((1, 1, ch), lambda b, c: (b, 0, c)))
+    args.append(vb)
+
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        grid=(B, chunks),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, H, hd), lambda b, c: (b, 0, 0)),
+        scratch_shapes=[
+            _vmem((H, 128), jnp.float32, interpret),
+            _vmem((H, 128), jnp.float32, interpret),
+            _vmem((H, hd), jnp.float32, interpret),
+        ],
+        interpret=interpret,
+    )(*args)
+    return out
+
+
+def decode_attention_stacked(q, k, v, ks, vs, kv_valid, scale, layer,
+                             interpret=False):
+    """Same computation as `decode_attention`, but reading the FULL
+    stacked int8 cache (L, B, K, S, hd) with the layer index selected by
+    a scalar-prefetch block index map.
+
+    Why: inside the layer scan the per-layer cache is a `dynamic_slice`
+    of the stacked buffer, and a custom call (pallas) can't consume a
+    slice without XLA materializing it — a 2x38 MB copy per layer per
+    step that erased the kernel's win.  The full stacked array IS a
+    buffer, so passing it whole makes the kernel's tile DMAs the only
+    cache traffic; the token append stays an in-place XLA
+    dynamic-update-slice on the scan carry before this call.
+
+    q: (B, H, hd); k/v: (L, B, K, S, hd) int8; ks/vs: (L, B, K, S)
+    scales; kv_valid: (B, S) bool; layer: i32 scalar (traced).
+    Returns (B, H, hd) in q.dtype.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    interpret = interpret or FORCE_INTERPRET
+    if k.dtype != jnp.dtype(jnp.int8):
+        # bf16 tails can hold NaN bit patterns the kernel cannot scrub
+        # (Mosaic folds x==x); the padded non-stacked entry covers bf16
+        raise ValueError('decode_attention_stacked requires an int8 '
+                         'cache')
+    B, H, hd = q.shape
+    K, S = k.shape[2], k.shape[3]
+    ch = min(_CHUNK, -(-S // 128) * 128)
+    s_pad = -(-S // ch) * ch
+    chunks = s_pad // ch
+    vb = jnp.where(kv_valid, 0.0, -1e30).astype(jnp.float32)
+    vb = jnp.pad(vb, ((0, 0), (0, s_pad - S)),
+                 constant_values=-1e30)[:, None, :]
+    kern = functools.partial(_kernel, scale=float(scale), chunks=chunks,
+                             s_total=S, chunk=ch)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, chunks),
+        in_specs=[
+            # index maps receive (*grid_indices, *scalar_prefetch_refs)
+            pl.BlockSpec((1, H, hd), lambda b, c, l: (b, 0, 0)),
+            pl.BlockSpec((1, 1, K, ch, hd),
+                         lambda b, c, l: (l[0], b, 0, c, 0)),
+            pl.BlockSpec((1, 1, K, ch, hd),
+                         lambda b, c, l: (l[0], b, 0, c, 0)),
+            pl.BlockSpec((1, 1, K, ch), lambda b, c, l: (l[0], b, 0, c)),
+            pl.BlockSpec((1, 1, K, ch), lambda b, c, l: (l[0], b, 0, c)),
+            pl.BlockSpec((1, 1, ch), lambda b, c, l: (b, 0, c)),
+        ],
+        out_specs=pl.BlockSpec((1, H, hd), lambda b, c, l: (b, 0, 0)),
+        scratch_shapes=[
+            _vmem((H, 128), jnp.float32, interpret),
+            _vmem((H, 128), jnp.float32, interpret),
+            _vmem((H, hd), jnp.float32, interpret),
+        ],
+    )
+    out = pl.pallas_call(
+        _squeeze_layer(kern),
+        out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(jnp.reshape(layer, (1,)).astype(jnp.int32),
+      q.astype(jnp.bfloat16), k, v, ks, vs, vb)
+    return out
+
+
+def _squeeze_layer(kern):
+    """Adapt `_kernel` to the stacked-cache block shapes: the scalar-
+    prefetch ref comes first and the cache blocks carry a leading
+    singleton layer dim."""
+    class _View:
+        __slots__ = ('ref',)
+
+        def __init__(self, ref):
+            self.ref = ref
+
+        def __getitem__(self, idx):
+            if idx == 0:
+                return self.ref[0, 0]
+            return self.ref[idx]
+
+    def wrapped(l_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, vb_ref,
+                o_ref, m_ref, l_sc, acc_ref):
+        return kern(q_ref, _View(k_ref), _View(v_ref), _View(ks_ref),
+                    _View(vs_ref), vb_ref, o_ref, m_ref, l_sc, acc_ref)
+    return wrapped
+
+
+def _vmem(shape, dtype, interpret=False):
+    del interpret  # the interpreter accepts TPU memory-space scratch
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, dtype)
+
+
+def _strip_scales(kern):
+    def wrapped(q_ref, k_ref, v_ref, vb_ref, o_ref, m_ref, l_ref,
+                acc_ref):
+        return kern(q_ref, k_ref, v_ref, None, None, vb_ref, o_ref,
+                    m_ref, l_ref, acc_ref)
+    return wrapped

@@ -1,0 +1,138 @@
+"""Pallas fused int4-dequant matmul for the decode-bound W4 path.
+
+The XLA path for int4x2-packed weights (transformer._packed_matmul)
+unpacks the uint8 bytes into an int8 operand *before* the matmul, and the
+compiler materializes that operand in HBM: a decode step then streams
+4-bit reads + 8-bit writes + 8-bit reads — strictly worse than plain
+int8 weights, which is why w4a8 measured SLOWER than w8a8 through round 4
+(docs/user_guides/performance.md roofline).
+
+This kernel keeps the nibble split on-chip: each grid step DMAs one
+(block_out, K/2) uint8 weight tile into VMEM, splits nibbles and applies
+the 128-wide group scales on the VPU, and contracts against the
+activations on the MXU — so the HBM weight stream is genuinely 4 bits
+wide (weight bytes are the decode floor; baseline discussion in
+bench.py).
+
+Wiring status: NOT yet on the decode path.  Measured in-loop, a
+per-layer pallas call inside the layer scan loses its win to
+custom-call operand materialization — the scan's dynamic weight slices
+get copied per layer per step, exactly the failure mode
+decode_attention_stacked solves for the KV cache with a stacked-array +
+scalar-prefetch layout.  This module is the validated compute core for
+that same treatment of the packed weights (stacked (L, out, K/2) blocks
+indexed by a prefetched layer scalar); until that lands, the XLA path
+in transformer._packed_matmul remains the shipped W4 route and this
+kernel is covered by tests/test_int4_kernel.py alone.
+
+Math: y[m, o] = sum_g s[o, g] * (x[m, g*128:(g+1)*128] . w_int4[o, g*128:...])
+with the weight dequantized to bf16 in VMEM (W4A16).  The grouped-int8
+XLA path quantizes activations too (W4A8); on the MXU at decode batch
+sizes the matmul is nowhere near the bottleneck, so the kernel spends
+its headroom on *more* accuracy, not less — tests/test_int4_kernel.py
+pins kernel-vs-dequant-reference closeness.
+
+Storage contract (quant._pack_int4x2): w (out, K/2) uint8, byte j of a
+row packing logical elements j (low nibble) and j + K/2 (high nibble),
+both int4 in [-7, 7]; s (out, K/GROUP) per-group scales, GROUP=128.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ._platform import on_tpu as _on_tpu
+
+GROUP = 128  # quant.GROUP; re-declared to keep this module import-light
+
+# largest dequantized bf16 weight tile the kernel materializes in VMEM
+# (block_out * K * 2 bytes); 4 MB leaves room for the activation block,
+# the packed tile double-buffer, and the output tile in ~16 MB VMEM
+_TILE_BUDGET = 4 * 1024 * 1024
+
+
+def _block_out(out_dim: int, k: int) -> int:
+    """Largest multiple of 128 dividing out_dim whose dequantized bf16
+    tile stays under the VMEM budget."""
+    best = 0
+    cap = _TILE_BUDGET // (2 * k)
+    for cand in (1024, 512, 256, 128):
+        if cand <= cap and out_dim % cand == 0:
+            best = cand
+            break
+    return best
+
+
+def supported(m: int, out_dim: int, k: int, x_dtype,
+              interpret: bool = False) -> bool:
+    """Gate: TPU backend (bypassed under ``interpret``), lane-aligned
+    packed/scale tiles, an activation block that fits beside the weight
+    tile, and a token-level m."""
+    if not interpret and not _on_tpu():
+        return False
+    if x_dtype not in (jnp.bfloat16, jnp.dtype(jnp.bfloat16)):
+        return False
+    if k % (2 * GROUP) or (k // 2) % 128:
+        return False
+    if m > 1024 or m * k * 2 > 6 * 1024 * 1024:
+        return False
+    return _block_out(out_dim, k) > 0
+
+
+def _kernel(x_ref, w_ref, s_ref, o_ref):
+    # nibble split in int32 (Mosaic's VPU int8 compare support is
+    # incomplete): two's-complement sign extension is (n ^ 8) - 8
+    w = w_ref[:].astype(jnp.int32)                 # (BO, K/2)
+    lo = jnp.bitwise_xor(jnp.bitwise_and(w, 0xF), 8) - 8
+    hi = jnp.bitwise_xor(jnp.right_shift(w, 4), 8) - 8
+    w8 = jnp.concatenate([lo, hi], axis=-1)        # (BO, K) int32
+    bo, k = w8.shape
+    g = k // GROUP
+    s = s_ref[:].astype(jnp.float32)               # (BO, g)
+    wf = w8.reshape(bo, g, GROUP).astype(jnp.float32) * s[..., None]
+    wf = wf.reshape(bo, k).astype(jnp.bfloat16)
+    y = jax.lax.dot_general(
+        x_ref[:], wf, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[:] = y.astype(o_ref.dtype)
+
+
+def packed_matmul(x: jax.Array, w: jax.Array, s: jax.Array,
+                  interpret: bool = False) -> jax.Array:
+    """x: (M, K) bf16; w: (O, K/2) uint8 (split-half int4x2);
+    s: (O, K/GROUP) scales.  Returns (M, O) in x.dtype."""
+    m, k = x.shape
+    out_dim = w.shape[0]
+    bo = _block_out(out_dim, k)
+    # sublane alignment for the bf16 activation/output blocks
+    m_pad = -m % 16
+    if m_pad:
+        x = jnp.pad(x, ((0, m_pad), (0, 0)))
+    mp = m + m_pad
+    grid = (out_dim // bo,)
+    y = _call(x, w, s, bo=bo, grid=grid, mp=mp, k=k, out_dim=out_dim,
+              interpret=interpret)
+    return y[:m] if m_pad else y
+
+
+def _call(x, w, s, *, bo, grid, mp, k, out_dim, interpret=False):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((mp, out_dim), x.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((mp, k), lambda o: (0, 0)),
+            pl.BlockSpec((bo, k // 2), lambda o: (o, 0)),
+            pl.BlockSpec((bo, k // GROUP), lambda o: (o, 0)),
+        ],
+        out_specs=pl.BlockSpec((mp, bo), lambda o: (0, o)),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * mp * out_dim * k,
+            bytes_accessed=out_dim * k // 2 + mp * k * 2 + mp * out_dim * 2,
+            transcendentals=0),
+        interpret=interpret,
+    )(x, w, s)

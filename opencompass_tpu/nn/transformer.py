@@ -448,7 +448,8 @@ def _attention_shared(q, k, v, k1, v1, own_mask):
 
 def _block(cfg: TransformerConfig, x, lp, positions, mask,
            cache_slice=None, cache_index=None, attn_fn=None,
-           kv_positions=None, tp_axis=None, shared_kv=None):
+           kv_positions=None, tp_axis=None, shared_kv=None,
+           full_cache=None):
     """One transformer block.  x: (B,T,D).  With a cache slice, K/V for the
     current tokens are written at ``cache_index`` and attention runs over the
     whole cache; without, attention is over the current sequence only.
@@ -480,8 +481,35 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
 
     new_cache = None
     k_scale = v_scale = None
-    head_major = cache_slice is not None
-    if cache_slice is not None:
+    head_major = cache_slice is not None or full_cache is not None
+    if full_cache is not None:
+        # decode-kernel path (T=1, int8 cache): append this token's K/V
+        # in place on the FULL stacked cache (small XLA dynamic updates
+        # on the scan carry), then run attention through the Pallas
+        # kernel reading the stacked buffer directly — per-layer cache
+        # slices never exist, so nothing gets materialized or copied
+        # (see decode_attention_stacked).
+        cache_full, li = full_cache
+        k = jnp.swapaxes(k, 1, 2)  # (B, K, 1, hd)
+        v = jnp.swapaxes(v, 1, 2)
+        k8, ks_new = _quantize_kv(k, 'int8')
+        v8, vs_new = _quantize_kv(v, 'int8')
+        zero = jnp.zeros((), jnp.int32)
+        new_cache = dict(cache_full)
+        for name, cur in (('k', k8), ('v', v8)):
+            new_cache[name] = jax.lax.dynamic_update_slice(
+                cache_full[name], cur.astype(cache_full[name].dtype)[None],
+                (li, zero, zero, cache_index, zero))
+        for name, cur in (('ks', ks_new), ('vs', vs_new)):
+            new_cache[name] = jax.lax.dynamic_update_slice(
+                cache_full[name], cur.astype(cache_full[name].dtype)[None],
+                (li, zero, zero, cache_index))
+        from .decode_attention import decode_attention_stacked
+        attn = decode_attention_stacked(
+            q[:, 0], new_cache['k'], new_cache['v'], new_cache['ks'],
+            new_cache['vs'], mask[:, 0, :], cfg.head_dim ** -0.5, li)
+        attn = attn[:, None].astype(x.dtype)
+    elif cache_slice is not None:
         # cache layout is head-major (B,K,S,hd): per-head (S,hd) blocks
         # stay contiguous, so the per-step cache read is long DMA runs
         k = jnp.swapaxes(k, 1, 2)  # (B,K,T,hd)
@@ -502,7 +530,9 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
         if kq:
             k_scale, v_scale = new_cache['ks'], new_cache['vs']
 
-    if shared_kv is not None:
+    if full_cache is not None:
+        pass  # attn already computed by the decode kernel above
+    elif shared_kv is not None:
         attn = _attention_shared(q, k, v, shared_kv['k'], shared_kv['v'],
                                  mask)
     elif attn_fn is not None:
@@ -560,6 +590,14 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
     return x, new_cache
 
 
+def _mesh_size() -> int:
+    """Devices in the framework's active mesh; 1 when no mesh is set.
+    The decode kernel runs under plain jit — GSPMD cannot partition a
+    pallas_call, so multi-device meshes keep the XLA attention path."""
+    mesh = current_mesh()
+    return mesh.size if mesh is not None else 1
+
+
 def _stack(cfg: TransformerConfig, x, layers, positions, mask,
            cache=None, cache_index=None, attn_fn=None, kv_positions=None,
            tp_axis=None, shared_kv=None):
@@ -607,9 +645,24 @@ def _stack(cfg: TransformerConfig, x, layers, positions, mask,
     # slice on every decode step (~1.5 GB/step at 7B geometry); carried
     # buffers alias across iterations, so the dynamic updates happen in
     # place and each step writes only the new token's slots.
+    use_decode_kernel = False
+    if (x.shape[1] == 1 and cfg.kv_quant_mode == 'int8'
+            and attn_fn is None and tp_axis is None
+            and shared_kv is None and 'ks' in cache):
+        from .decode_attention import supported as _dk_supported
+        use_decode_kernel = (
+            _dk_supported(cfg.positional, cfg.head_dim, cfg.num_heads,
+                          cfg.num_kv_heads, cache['k'].dtype)
+            and _mesh_size() == 1)
+
     def step(carry, layer_and_index):
         h, cache_full = carry
         lp, li = layer_and_index
+        if use_decode_kernel:
+            h, cache_full = block(cfg, h, lp, positions, mask,
+                                  cache_index=cache_index,
+                                  full_cache=(cache_full, li))
+            return (h, cache_full), None
         cs = jax.tree_util.tree_map(
             lambda a: jax.lax.dynamic_index_in_dim(a, li, 0,
                                                    keepdims=False),

@@ -1,0 +1,52 @@
+"""Numerics for the Pallas fused int4-dequant matmul
+(nn/int4_matmul.py), via the Pallas interpreter on CPU.  The kernel is
+the compute core for the (in-progress) stacked-weight decode path; its
+contract is closeness to the dequantized reference product under the
+int4x2 storage scheme (quant._pack_int4x2)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opencompass_tpu.nn import int4_matmul as im
+from opencompass_tpu.nn.quant import GROUP, _pack_int4x2
+
+
+def _dequant(packed, scales):
+    lo = (packed & 0xF).astype(np.int8)
+    lo = np.where(lo > 7, lo - 16, lo)
+    hi = (packed >> 4).astype(np.int8)
+    hi = np.where(hi > 7, hi - 16, hi)
+    w8 = np.concatenate([lo, hi], -1).astype(np.float32)
+    O, K = w8.shape
+    s = np.asarray(scales.astype(jnp.float32))
+    return (w8.reshape(O, K // GROUP, GROUP) * s[..., None]).reshape(O, K)
+
+
+@pytest.mark.parametrize('M,O,K', [
+    (8, 256, 256),        # minimal aligned shapes
+    (5, 384, 512),        # M needs sublane padding
+    (32, 256, 768),       # multiple groups per row
+])
+def test_packed_matmul_matches_dequant_reference(M, O, K):
+    rs = np.random.RandomState(0)
+    w = rs.randn(K, O).astype(np.float32) * 0.05
+    packed, s = _pack_int4x2(w, -2, np)          # NT: (O, K/2), (O, K/G)
+    x = jnp.asarray(rs.randn(M, K), jnp.bfloat16)
+    sp = jnp.asarray(s, jnp.bfloat16)
+    y = im.packed_matmul(x, jnp.asarray(packed), sp, interpret=True)
+    ref = np.asarray(x, np.float32) @ _dequant(
+        packed, jnp.asarray(s, jnp.bfloat16)).T
+    err = np.abs(np.asarray(y, np.float32) - ref).max()
+    assert err < 0.02 * max(1.0, np.abs(ref).max())
+
+
+def test_supported_gates():
+    bf16 = jnp.bfloat16
+    # interpret=True bypasses the platform gate so the shape/dtype
+    # logic is actually exercised on the CPU suite
+    assert im.supported(8, 256, 256, bf16, interpret=True)
+    assert not im.supported(8, 256, 250, bf16, interpret=True)   # K align
+    assert not im.supported(2048, 256, 256, bf16, interpret=True)
+    assert not im.supported(8, 256, 256, jnp.float32, interpret=True)
+    # TPU gate: this suite runs on CPU, so even good shapes are gated
+    assert not im.supported(8, 256, 256, bf16)
