@@ -47,6 +47,10 @@ from ._platform import on_tpu as _on_tpu
 
 GROUP = 128  # quant.GROUP; re-declared to keep this module import-light
 
+# test hook (mirrors decode_attention.FORCE_INTERPRET): run through the
+# Pallas interpreter and pass the platform gate on CPU
+FORCE_INTERPRET = False
+
 # largest dequantized bf16 weight tile the kernel materializes in VMEM
 # (block_out * K * 2 bytes); 4 MB leaves room for the activation block,
 # the packed tile double-buffer, and the output tile in ~16 MB VMEM
@@ -70,7 +74,7 @@ def supported(m: int, out_dim: int, k: int, x_dtype,
     """Gate: TPU backend (bypassed under ``interpret``), lane-aligned
     packed/scale tiles, an activation block that fits beside the weight
     tile, and a token-level m."""
-    if not interpret and not _on_tpu():
+    if not (interpret or FORCE_INTERPRET) and not _on_tpu():
         return False
     if x_dtype not in (jnp.bfloat16, jnp.dtype(jnp.bfloat16)):
         return False
@@ -102,37 +106,76 @@ def _kernel(x_ref, w_ref, s_ref, o_ref):
 def packed_matmul(x: jax.Array, w: jax.Array, s: jax.Array,
                   interpret: bool = False) -> jax.Array:
     """x: (M, K) bf16; w: (O, K/2) uint8 (split-half int4x2);
-    s: (O, K/GROUP) scales.  Returns (M, O) in x.dtype."""
+    s: (O, K/GROUP) scales.  Returns (M, O) in x.dtype.  The flat case
+    is the stacked case with a single layer."""
+    return packed_matmul_stacked(x, w[None], s[None], jnp.int32(0),
+                                 interpret=interpret)
+
+
+def packed_matmul_stacked(x: jax.Array, w: jax.Array, s: jax.Array,
+                          layer: jax.Array,
+                          interpret: bool = False) -> jax.Array:
+    """`packed_matmul` over the FULL stacked weights (L, O, K/2) with the
+    layer selected by a scalar-prefetch block index map.
+
+    This is the piece that makes the kernel usable inside the decode
+    layer scan: a per-layer pallas call would consume a `dynamic_slice`
+    of the stacked weight array, which XLA must materialize (copy) per
+    layer per step — the same failure mode decode_attention_stacked
+    documents for the KV cache.  Passing the stacked array whole makes
+    the kernel's tile DMAs the only weight traffic, and those stay
+    4-bit wide.
+
+    x: (M, K) bf16; w: (L, O, K/2) uint8; s: (L, O, K/GROUP) scales;
+    layer: i32 scalar (traced).  Returns (M, O) in x.dtype.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    interpret = interpret or FORCE_INTERPRET
     m, k = x.shape
-    out_dim = w.shape[0]
+    out_dim = w.shape[1]
     bo = _block_out(out_dim, k)
-    # sublane alignment for the bf16 activation/output blocks
     m_pad = -m % 16
     if m_pad:
         x = jnp.pad(x, ((0, m_pad), (0, 0)))
     mp = m + m_pad
-    grid = (out_dim // bo,)
-    y = _call(x, w, s, bo=bo, grid=grid, mp=mp, k=k, out_dim=out_dim,
-              interpret=interpret)
-    return y[:m] if m_pad else y
 
+    def kern(l_ref, x_ref, w_ref, s_ref, o_ref):
+        del l_ref
+        _kernel(x_ref, _Squeeze0(w_ref), _Squeeze0(s_ref), o_ref)
 
-def _call(x, w, s, *, bo, grid, mp, k, out_dim, interpret=False):
-    from jax.experimental import pallas as pl
-
-    return pl.pallas_call(
-        _kernel,
-        out_shape=jax.ShapeDtypeStruct((mp, out_dim), x.dtype),
-        grid=grid,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(out_dim // bo,),
         in_specs=[
-            pl.BlockSpec((mp, k), lambda o: (0, 0)),
-            pl.BlockSpec((bo, k // 2), lambda o: (o, 0)),
-            pl.BlockSpec((bo, k // GROUP), lambda o: (o, 0)),
+            pl.BlockSpec((mp, k), lambda o, l: (0, 0)),
+            pl.BlockSpec((1, bo, k // 2), lambda o, l: (l[0], o, 0)),
+            pl.BlockSpec((1, bo, k // GROUP), lambda o, l: (l[0], o, 0)),
         ],
-        out_specs=pl.BlockSpec((mp, bo), lambda o: (0, o)),
+        out_specs=pl.BlockSpec((mp, bo), lambda o, l: (0, o)),
+    )
+    y = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((mp, out_dim), x.dtype),
+        grid_spec=grid_spec,
         cost_estimate=pl.CostEstimate(
             flops=2 * mp * out_dim * k,
             bytes_accessed=out_dim * k // 2 + mp * k * 2 + mp * out_dim * 2,
             transcendentals=0),
         interpret=interpret,
-    )(x, w, s)
+    )(jnp.reshape(layer, (1,)).astype(jnp.int32), x, w, s)
+    return y[:m] if m_pad else y
+
+
+class _Squeeze0:
+    """Present a (1, ...) block ref as its [0] slice to `_kernel`."""
+    __slots__ = ('ref',)
+
+    def __init__(self, ref):
+        self.ref = ref
+
+    def __getitem__(self, idx):
+        if idx == slice(None):
+            return self.ref[0]
+        return self.ref[idx]

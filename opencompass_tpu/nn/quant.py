@@ -165,6 +165,16 @@ def init_packed_params(cfg, key):
             'init_packed_params covers the llama-family tree (gated '
             'mlp, rmsnorm, no biases); quantize a real checkpoint '
             'host-side for other families')
+    for dim, what in ((cfg.hidden_size, 'hidden_size'),
+                      (cfg.intermediate_size, 'intermediate_size'),
+                      (cfg.q_dim, 'q_dim')):
+        if dim % GROUP:
+            # same contract _pack_int4x2 enforces; without this the
+            # in_dim // GROUP scale shapes silently collapse to 0
+            raise ValueError(
+                f'int4x2 packing needs contraction dims divisible by '
+                f'{GROUP}; {what}={dim} is not (w4a8 targets 7B/13B-'
+                f'class geometries)')
     D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
     V = cfg.vocab_size
     dt = cfg.jnp_dtype

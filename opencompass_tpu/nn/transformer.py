@@ -206,6 +206,43 @@ def _packed_matmul(x, p, act_quant=False, pre=None):
     return y
 
 
+class _StackedPacked(dict):
+    """Marker param dict routing a matmul to the stacked-weight Pallas
+    kernel (int4_matmul.packed_matmul_stacked): carries the FULL
+    (L, out, K/2) packed weights + scales (+ optional (L, out) bias)
+    and the scan's layer index.  Built only inside `_stack`'s
+    decode-kernel path — everywhere else packed weights keep the XLA
+    route.  Registered as a pytree node so jax.checkpoint (cfg.remat)
+    can flatten it like any other param dict."""
+
+    def __init__(self, w_full, s_full, li, b_full=None):
+        super().__init__(w=w_full, s=s_full)
+        if b_full is not None:
+            self['b'] = b_full
+        self.li = li
+
+
+jax.tree_util.register_pytree_node(
+    _StackedPacked,
+    lambda sp: ((sp['w'], sp['s'], sp.li, sp.get('b')), None),
+    lambda _, ch: _StackedPacked(ch[0], ch[1], ch[2], ch[3]))
+
+
+def _stacked_packed_matmul(x, p: _StackedPacked):
+    from .int4_matmul import packed_matmul_stacked
+    lead = x.shape[:-1]
+    m = 1
+    for d in lead:
+        m *= int(d)
+    y = packed_matmul_stacked(x.reshape(m, x.shape[-1]).astype(
+        jnp.bfloat16), p['w'], p['s'], p.li)
+    y = y.reshape(*lead, -1).astype(x.dtype)
+    if 'b' in p:  # per-layer bias row of the stacked (L, out) biases
+        y = y + jax.lax.dynamic_index_in_dim(
+            p['b'], p.li, 0, keepdims=False).astype(y.dtype)
+    return y
+
+
 def _dyn_act_quant(x):
     """Dynamic per-token symmetric int8: returns (x_int8, scales (...,1))."""
     amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
@@ -219,6 +256,8 @@ def _linear(x, p, act_quant=False, pre=None):
     """``pre`` carries an already-quantized (x_int8, scales) pair so
     several projections of the same activation (q/k/v, gate/up) share one
     dynamic-quant pass."""
+    if isinstance(p, _StackedPacked):
+        return _stacked_packed_matmul(x, p)
     w = p['w']
     if _is_packed(w):  # int4x2: stored NT regardless of caller
         return _packed_matmul(x, p, act_quant, pre)
@@ -253,6 +292,8 @@ def _linear_nt(x, p, act_quant=False, pre=None):
     handles the 'NT' contraction in prefill/PPL matmuls natively, so the
     full-sequence path loses nothing.
     """
+    if isinstance(p, _StackedPacked):
+        return _stacked_packed_matmul(x, p)
     w = p['w']
     if _is_packed(w):
         return _packed_matmul(x, p, act_quant, pre)
@@ -655,10 +696,38 @@ def _stack(cfg: TransformerConfig, x, layers, positions, mask,
                           cfg.num_kv_heads, cache['k'].dtype)
             and _mesh_size() == 1)
 
+    # int4x2-packed weights on the decode-kernel path additionally route
+    # their matmuls through the stacked-weight Pallas kernel — per-layer
+    # scan slices of the packed arrays would be copied for a custom-call
+    # operand (and the XLA unpack materializes int8 anyway), while the
+    # stacked layout keeps the HBM weight stream 4-bit (int4_matmul).
+    packed_stacked = None
+    if use_decode_kernel and not isinstance(layers, (list, tuple)):
+        from .int4_matmul import supported as _w4_supported
+        cand = {}
+        all_ok = True
+        for name, p in layers.items():
+            if (isinstance(p, dict)
+                    and getattr(p.get('w'), 'dtype', None)
+                    == jnp.dtype(jnp.uint8)):
+                out_dim = p['w'].shape[-2]
+                kk = p['w'].shape[-1] * 2
+                if _w4_supported(x.shape[0], out_dim, kk, jnp.bfloat16):
+                    cand[name] = p
+                else:
+                    all_ok = False
+        if cand and all_ok:
+            packed_stacked = cand
+
     def step(carry, layer_and_index):
         h, cache_full = carry
         lp, li = layer_and_index
         if use_decode_kernel:
+            if packed_stacked:
+                lp = dict(lp)
+                for name, p in packed_stacked.items():
+                    lp[name] = _StackedPacked(p['w'], p['s'], li,
+                                              p.get('b'))
             h, cache_full = block(cfg, h, lp, positions, mask,
                                   cache_index=cache_index,
                                   full_cache=(cache_full, li))

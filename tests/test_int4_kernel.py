@@ -50,3 +50,58 @@ def test_supported_gates():
     assert not im.supported(8, 256, 256, jnp.float32, interpret=True)
     # TPU gate: this suite runs on CPU, so even good shapes are gated
     assert not im.supported(8, 256, 256, bf16)
+
+
+def test_stacked_matches_flat():
+    from opencompass_tpu.nn.quant import _pack_int4x2
+    import jax.numpy as jnp
+    rs = np.random.RandomState(1)
+    L, M, O, K = 3, 8, 256, 512
+    packs, scales = [], []
+    for layer in range(L):
+        w = rs.randn(K, O).astype(np.float32) * 0.05
+        pw, s = _pack_int4x2(w, -2, np)
+        packs.append(pw)
+        scales.append(s)
+    wst = jnp.asarray(np.stack(packs))
+    sst = jnp.asarray(np.stack(scales), jnp.bfloat16)
+    x = jnp.asarray(rs.randn(M, K), jnp.bfloat16)
+    for layer in range(L):
+        flat = im.packed_matmul(x, wst[layer], sst[layer], interpret=True)
+        stacked = im.packed_matmul_stacked(x, wst, sst, jnp.int32(layer),
+                                           interpret=True)
+        assert np.array_equal(np.asarray(flat, np.float32),
+                              np.asarray(stacked, np.float32))
+
+
+@pytest.mark.parametrize('remat', [False, True])
+def test_full_w4_decode_path(monkeypatch, remat):
+    """End-to-end packed-weight decode through _stack's kernel path
+    (stacked-weight matmuls + decode-attention kernel, interpreted)
+    agrees with the XLA packed path."""
+    import dataclasses
+    import functools
+    import jax
+    import opencompass_tpu.nn.decode_attention as DA
+    from opencompass_tpu.nn import TransformerConfig
+    from opencompass_tpu.nn.decode import greedy_generate
+    from opencompass_tpu.nn.quant import init_packed_params
+
+    cfg = dataclasses.replace(
+        TransformerConfig.llama(
+            vocab_size=97, hidden_size=256, num_layers=2, num_heads=2,
+            num_kv_heads=2, intermediate_size=512, max_seq_len=128),
+        kv_quant='int8', remat=remat)  # remat flattens _StackedPacked
+    params = init_packed_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.asarray(
+        np.random.RandomState(3).randint(1, 97, (2, 10)), jnp.int32)
+    mask = jnp.ones_like(tokens, jnp.bool_)
+    gen = jax.jit(functools.partial(
+        greedy_generate, cfg=cfg, max_new_tokens=5, eos_token_id=None))
+    ref = np.asarray(gen(params, tokens=tokens, pad_mask=mask)[0])
+    monkeypatch.setattr(DA, 'FORCE_INTERPRET', True)
+    monkeypatch.setattr(im, 'FORCE_INTERPRET', True)
+    jax.clear_caches()
+    out = np.asarray(gen(params, tokens=tokens, pad_mask=mask)[0])
+    agree = (ref == out).mean()
+    assert agree >= 0.8, (ref, out)
