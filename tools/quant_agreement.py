@@ -2,7 +2,7 @@
 
 CLI over :mod:`opencompass_tpu.nn.agreement` (metric design notes live
 there).  The headline bench (bench.py) scores PPL with W8A8 and
-generates with W8A8 + int4-KV; tests/test_quant.py pins those recipes'
+generates with W8A8 + an int8 or int4 KV cache; tests/test_quant.py pins those recipes'
 accuracy at toy and llama-512x4 scale; this tool pins them at full
 geometry (default: llama-7B, 4096x32) on the real chip, where
 quantization error has had 32 layers x 4096 channels to compound.
@@ -56,13 +56,17 @@ def _gen(params, cfg, prompts, pmask, n_new):
 
 
 def measure(geometry='7b', items=64, choices=4, seq=128, gen_batch=32,
-            gen_prompt=128, gen_new=64, seed=0, quant='w8a8-kv4'):
-    """``quant``: 'w8a8-kv4' (the pinned serving recipe) or 'w4a8-kv4'
-    (packed int4x2 weights — nn/quant.py — group-RTN, coarser)."""
+            gen_prompt=128, gen_new=64, seed=0, quant='w8a8-kv8'):
+    """``quant``: 'w8a8-kv8' (the serving recipe — int8 KV through the
+    Pallas decode kernel), 'w8a8-kv4' (capacity cache), or
+    'w4a8-kv8'/'w4a8-kv4' (packed int4x2 weights — nn/quant.py —
+    group-RTN, coarser)."""
     weight_mode = 'int4x2' if quant.startswith('w4') else 'int8'
+    kv_mode = 'int8' if quant.endswith('kv8') else 'int4'
+    kv_tag = '8' if kv_mode == 'int8' else '4'
     cfg = TransformerConfig.llama(**GEOMETRIES[geometry])
     cfg_aq = dataclasses.replace(cfg, act_quant=True)
-    cfg_hl = dataclasses.replace(cfg, act_quant=True, kv_quant='int4')
+    cfg_hl = dataclasses.replace(cfg, act_quant=True, kv_quant=kv_mode)
     tokens, mask, prompts, pmask = eval_pool(cfg, items, choices, seq,
                                              gen_batch, gen_prompt)
     key = jax.random.PRNGKey(seed)
@@ -116,8 +120,8 @@ def measure(geometry='7b', items=64, choices=4, seq=128, gen_batch=32,
         'platform': jax.devices()[0].platform,
         'scoring_%s_vs_bf16' % wtag: scoring_stats(nll_fp, nll_q, choices),
         'scoring_pool': {'items': items, 'choices': choices, 'seq': seq},
-        'gen_%skv4_vs_bf16' % wtag: gen_stats(out_fp, out_q),
-        'forced_decode_%skv4_vs_bf16' % wtag: forced_stats(
+        'gen_%skv%s_vs_bf16' % (wtag, kv_tag): gen_stats(out_fp, out_q),
+        'forced_decode_%skv%s_vs_bf16' % (wtag, kv_tag): forced_stats(
             forced, am_fp, margin_fp, lp_fp, am_q, rank_q, lp_q),
         'gen_pool': {'batch': gen_batch, 'prompt': gen_prompt,
                      'new': gen_new, 'forced_rows': fr},
@@ -134,8 +138,9 @@ def main():
     ap.add_argument('--gen-batch', type=int, default=32)
     ap.add_argument('--gen-prompt', type=int, default=128)
     ap.add_argument('--gen-new', type=int, default=64)
-    ap.add_argument('--quant', default='w8a8-kv4',
-                    choices=['w8a8-kv4', 'w4a8-kv4'])
+    ap.add_argument('--quant', default='w8a8-kv8',
+                    choices=['w8a8-kv8', 'w8a8-kv4', 'w4a8-kv8',
+                             'w4a8-kv4'])
     args = ap.parse_args()
     rec = measure(args.geometry, args.items, args.choices, args.seq,
                   args.gen_batch, args.gen_prompt, args.gen_new,
