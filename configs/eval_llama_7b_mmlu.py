@@ -43,7 +43,7 @@ models = [
          batch_size=8,
          max_out_len=100,
          dtype='bfloat16',
-         quantize='w8a8-kv4',           # the serving / bench-headline recipe
+         quantize='w8a8-kv8',           # the serving / bench-headline recipe
          parallel=dict(data=-1, model=1),
          run_cfg=dict(num_devices=1)),
 ]

@@ -44,7 +44,7 @@ models = [
          batch_size=16,
          max_out_len=64,
          dtype='bfloat16',
-         quantize='w8a8-kv4',
+         quantize='w8a8-kv8',
          # shared-prefix reuse pays when PREFILL dominates (7B-class
          # models); at 1B the item-major PPL batching it triggers
          # shrinks batches to n_labels rows and the per-item dispatch

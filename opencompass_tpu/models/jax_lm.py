@@ -142,8 +142,9 @@ class JaxLM(BaseModel):
         # the MXU), or 'w4a8' (int4 weights packed two-per-uint8 with
         # 128-wide group scales, unpacked inside the jit — nn/quant.py
         # int4x2 — + int8 activations); '-kv'/'-kv8' adds an int8 decode
-        # KV cache, '-kv4' an int4 one.  'w8a8-kv4' is the accuracy-
-        # pinned serving recipe; 'w4a8-kv4' halves the decode weight
+        # KV cache, '-kv4' an int4 one.  'w8a8-kv8' is the accuracy-
+        # pinned serving recipe (int8 KV rides the Pallas decode kernel
+        # on TPU); 'w8a8-kv4'/'w4a8-kv4' halve the cache/decode weight
         # stream again (group-RTN int4: check the agreement probe for
         # your model before trusting scores).
         base, dash, kv = (quantize or '').partition('-')

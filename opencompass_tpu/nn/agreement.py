@@ -1,6 +1,6 @@
 """Quantized-vs-bf16 eval agreement measurement.
 
-The serving configs (``JaxLM(quantize='w8a8')`` scoring, ``'w8a8-kv4'``
+The serving configs (``JaxLM(quantize='w8a8')`` scoring, ``'w8a8-kv8'``
 generation) only earn their bench headline if they preserve the eval
 semantics of the full-precision path — candidate ranking by mean
 per-token NLL (reference opencompass/models/huggingface.py:254-293) and

@@ -292,6 +292,12 @@ def decode_attention_stacked(q, k, v, ks, vs, kv_valid, scale, layer,
     ch = min(_CHUNK, -(-S // 128) * 128)
     s_pad = -(-S // ch) * ch
     chunks = s_pad // ch
+    # NOTE (measured): the tile fetch sustains only ~300 GB/s and is the
+    # kernel's bottleneck at batch 128; neither longer contiguous runs
+    # (an exact-S single-chunk layout was A/B'd at -2%) nor batch-
+    # blocking nor parallel grid semantics move it — it appears to be
+    # the Pallas pipeline's fetch rate for this pattern, ~2x better
+    # than the XLA path's effective traffic all the same
     vb = jnp.where(kv_valid, 0.0, -1e30).astype(jnp.float32)
     vb = jnp.pad(vb, ((0, 0), (0, s_pad - S)),
                  constant_values=-1e30)[:, None, :]
@@ -330,6 +336,9 @@ def decode_attention_stacked(q, k, v, ks, vs, kv_valid, scale, layer,
         _squeeze_layer(kern),
         out_shape=jax.ShapeDtypeStruct((B, H, hd), q.dtype),
         grid_spec=grid_spec,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'arbitrary'),
+            vmem_limit_bytes=100 * 1024 * 1024),
         interpret=interpret,
     )(jnp.reshape(layer, (1,)).astype(jnp.int32),
       q.astype(jnp.bfloat16), k, v, ks, vs, vb)

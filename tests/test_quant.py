@@ -399,7 +399,7 @@ def test_w8a8_agreement_at_7b_geometry_on_tpu():
     assert s['p95_rel_dnll'] < 0.01, s
     # any argmin flips are confined to statistical ties
     assert s['max_flip_margin'] < 0.005, s
-    f = rec['forced_decode_w8a8kv4_vs_bf16']
+    f = rec['forced_decode_w8a8kv8_vs_bf16']
     # where the bf16 model is decisive, the quantized decode picks the
     # same token at (at least) the bf16 self-consistency rate minus noise
     if f['n_decided_steps'] >= 20:
