@@ -1,0 +1,29 @@
+"""One-subset slice of eval_llama_7b_mmlu (astronomy, gen + ppl):
+a ~10-minute single-chip smoke of the milestone-2 workload at the
+serving recipe — handy for validating a chip/driver setup before
+committing to the full 57-subset run, and the measured round-5
+kernel-path pipeline record (BASELINE_RUN.md §4)."""
+with read_base():
+    from .datasets.mmlu.mmlu_gen import mmlu_datasets
+    from .datasets.mmlu.mmlu_ppl import mmlu_datasets as mmlu_ppl_datasets
+
+from opencompass_tpu.models import JaxLM
+
+mmlu_datasets = [d for d in mmlu_datasets if 'astronomy' in d['abbr']]
+mmlu_ppl_datasets = [dict(d, abbr=d['abbr'] + '_ppl')
+                     for d in mmlu_ppl_datasets if 'astronomy' in d['abbr']]
+datasets = [*mmlu_datasets, *mmlu_ppl_datasets]
+
+models = [
+    dict(type=JaxLM,
+         abbr='llama-7b-jax',
+         path='./models/llama-7b-hf',
+         config=dict(preset='llama'),
+         max_seq_len=2048,
+         batch_size=8,
+         max_out_len=100,
+         dtype='bfloat16',
+         quantize='w8a8-kv8',
+         parallel=dict(data=-1, model=1),
+         run_cfg=dict(num_devices=1)),
+]
