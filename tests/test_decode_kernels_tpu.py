@@ -1,0 +1,135 @@
+"""Hardware parity for the decode-path Pallas kernels (slow tier).
+
+The hermetic suite runs these kernels through the Pallas interpreter
+(tests/test_decode_attention.py, tests/test_int4_kernel.py); this test
+compiles them with Mosaic on the real chip — the lowering that actually
+ships — and compares teacher-forced per-step decode logits (kernel
+path vs XLA cache path, same int8 quantization; the forcing token is
+fixed so the two runs walk identical cache states), plus the packed
+stacked-weight matmul against its dequantized reference.
+
+Same launch pattern as test_flash_tpu.py: a subprocess with the TPU
+plugin env restored; skipped when no TPU is configured.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_SCRIPT = r"""
+import sys
+sys.path.insert(0, %(repo)r)
+import dataclasses
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+assert jax.devices()[0].platform == 'tpu', jax.devices()
+
+import opencompass_tpu.nn.decode_attention as DA
+from opencompass_tpu.nn import TransformerConfig, init_params
+from opencompass_tpu.nn.quant import _pack_int4x2, quantize_params
+from opencompass_tpu.nn import int4_matmul as im
+
+# --- decode attention: kernel vs XLA cache path, same quantization ---
+cfg = dataclasses.replace(
+    TransformerConfig.llama(
+        vocab_size=1024, hidden_size=512, num_layers=2, num_heads=4,
+        num_kv_heads=2, intermediate_size=1024, max_seq_len=256),
+    kv_quant='int8', act_quant=True)
+assert DA.supported(cfg.positional, cfg.head_dim, cfg.num_heads,
+                    cfg.num_kv_heads, jnp.int8)
+params = quantize_params(init_params(cfg, jax.random.PRNGKey(0)), cfg)
+rng = np.random.RandomState(0)
+tokens = jnp.asarray(rng.randint(1, 1024, (4, 24)), jnp.int32)
+tokens = jnp.pad(tokens, ((0, 0), (5, 0)))   # left pads
+mask = tokens != 0
+# teacher-forced per-step logits: both paths walk the SAME tokens, so
+# the only difference is the kernel's dynamic-int8 q/p noise — token
+# trajectories on a flat random-init model would diverge after any
+# single flip and measure nothing
+from opencompass_tpu.nn.transformer import decode_step, init_cache, prefill
+
+def forced_logits(params, tokens, mask, nsteps):
+    B, S = tokens.shape
+    total = S + nsteps
+
+    @jax.jit
+    def run(params, tokens, mask):
+        cache = init_cache(cfg, B, total)
+        logits, cache, pos = prefill(params, cfg, tokens, mask, cache)
+        kv_valid = jnp.pad(mask, ((0, 0), (0, nsteps)))
+        outs = [logits]
+        tok = jnp.argmax(logits, -1)
+        for i in range(nsteps):
+            slot = S + i
+            kv_valid2 = kv_valid.at[:, slot].set(True)
+            logits, cache = decode_step(params, cfg, tok, cache, slot,
+                                        pos + i, kv_valid2)
+            kv_valid = kv_valid2
+            outs.append(logits)
+            tok = jnp.argmax(outs[0], -1)  # fixed forcing token
+        return jnp.stack(outs)
+    return np.asarray(run(params, tokens, mask), np.float32)
+
+lk = forced_logits(params, tokens, mask, 4)
+DA.supported = lambda *a, **k: False
+jax.clear_caches()
+lx = forced_logits(params, tokens, mask, 4)
+diff = np.abs(lk - lx)
+scale = np.abs(lx).max()
+print('forced logits max diff', diff.max(), 'scale', scale)
+# step 0 is the prefill (identical path): must match to bf16 noise
+assert diff[0].max() <= 0.05 * scale, diff[0].max()
+# decode steps differ only by the kernel's int8 q/p quantization; a
+# zero diff would mean the kernel path never engaged (gate drift) and
+# the comparison measured nothing
+assert diff[1:].max() > 0.0, 'kernel path did not engage'
+assert diff[1:].max() <= 0.15 * scale, (diff[1:].max(), scale)
+
+# --- stacked packed matmul vs dequantized reference ---
+rs = np.random.RandomState(1)
+L, M, O, K = 2, 16, 256, 512
+packs, scales = [], []
+for _ in range(L):
+    w = rs.randn(K, O).astype(np.float32) * 0.05
+    pw, s = _pack_int4x2(w, -2, np)
+    packs.append(pw)
+    scales.append(s)
+wst = jnp.asarray(np.stack(packs))
+sst = jnp.asarray(np.stack(scales), jnp.bfloat16)
+x = jnp.asarray(rs.randn(M, K), jnp.bfloat16)
+for layer in range(L):
+    y = np.asarray(jax.jit(im.packed_matmul_stacked)(
+        x, wst, sst, jnp.int32(layer)), np.float32)
+    pw = packs[layer]
+    lo = (pw & 0xF).astype(np.int8); lo = np.where(lo > 7, lo - 16, lo)
+    hi = (pw >> 4).astype(np.int8); hi = np.where(hi > 7, hi - 16, hi)
+    w8 = np.concatenate([lo, hi], -1).astype(np.float32)
+    sref = np.asarray(sst[layer].astype(jnp.float32))
+    wf = (w8.reshape(O, K // 128, 128) * sref[..., None]).reshape(O, K)
+    ref = np.asarray(x, np.float32) @ wf.T
+    err = np.abs(y - ref).max()
+    print('stacked matmul layer', layer, 'err', err)
+    assert err < 0.02 * max(1.0, np.abs(ref).max())
+print('DECODE_KERNELS_PARITY_OK')
+"""
+
+
+@pytest.mark.slow
+def test_decode_kernels_on_tpu():
+    axon = os.environ.get('OC_TPU_AXON_IPS')
+    if not axon:
+        pytest.skip('no TPU plugin config in environment')
+    env = dict(os.environ)
+    env['PALLAS_AXON_POOL_IPS'] = axon
+    env.pop('JAX_PLATFORMS', None)
+    env.pop('XLA_FLAGS', None)
+    proc = subprocess.run(
+        [sys.executable, '-c', _SCRIPT % {'repo': REPO}],
+        capture_output=True, text=True, env=env, timeout=1200)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'DECODE_KERNELS_PARITY_OK' in proc.stdout, proc.stdout
