@@ -202,6 +202,10 @@ def decode_attention(q, k, v, kv_valid, scale, k_scale=None,
     interpret = interpret or FORCE_INTERPRET
     import jax.experimental.pallas as pl
 
+    if k.dtype == jnp.dtype(jnp.int8) and (k_scale is None
+                                           or v_scale is None):
+        raise ValueError('int8 caches need k_scale/v_scale (the kernel '
+                         'detects quantization from the cache dtype)')
     B, H, hd = q.shape
     K, S = k.shape[1], k.shape[2]
     ch = min(_CHUNK, -(-S // 128) * 128)
