@@ -139,3 +139,30 @@ def test_full_decode_path_uses_kernel(monkeypatch):
     # rare argmax on a random-init toy, so require near-total agreement
     agree = (ref_tokens == kern_tokens).mean()
     assert agree >= 0.8, (ref_tokens, kern_tokens)
+
+
+def test_prefix_lm_decode_path_uses_kernel(monkeypatch):
+    """GLM-family (prefix-LM) decode flows through the same kernel gate:
+    the bidirectional-context structure lives entirely in the kv_valid
+    mask at T=1, so the kernel must reproduce the XLA path's tokens."""
+    import dataclasses
+    cfg = dataclasses.replace(
+        TransformerConfig.glm130b(
+            vocab_size=97, hidden_size=256, num_layers=2, num_heads=2,
+            intermediate_size=512, max_seq_len=128),
+        kv_quant='int8')
+    assert cfg.prefix_lm and cfg.positional == 'rope'
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    params = quantize_params(params, cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(5).randint(1, 97, (2, 10)), jnp.int32)
+    tokens = jnp.pad(tokens, ((0, 0), (4, 0)))  # left pads: kv_valid
+    mask = tokens != 0                          # carries real structure
+    gen = jax.jit(functools.partial(
+        greedy_generate, cfg=cfg, max_new_tokens=5, eos_token_id=None))
+    ref = np.asarray(gen(params, tokens=tokens, pad_mask=mask)[0])
+    monkeypatch.setattr(DA, 'FORCE_INTERPRET', True)
+    jax.clear_caches()
+    out = np.asarray(gen(params, tokens=tokens, pad_mask=mask)[0])
+    agree = (ref == out).mean()
+    assert agree >= 0.8, (ref, out)
