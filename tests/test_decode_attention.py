@@ -111,34 +111,64 @@ def test_supported_gates():
     assert not DA.supported('rope', 128, 8, 8, jnp.int8)
 
 
+def _assert_kernel_parity(cfg, monkeypatch, seed, pads=3, prompt=8,
+                          new=5, min_agree=0.8, init_seed=1):
+    """Shared parity harness: greedy-decode the same prompts through the
+    XLA cache path and the kernel path (FORCE_INTERPRET) and require
+    near-total token agreement (int8 q/p noise may flip a rare argmax
+    on a random-init toy)."""
+    import dataclasses
+    cfg = dataclasses.replace(cfg, kv_quant='int8')
+    params = quantize_params(
+        init_params(cfg, jax.random.PRNGKey(init_seed)), cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(seed).randint(1, cfg.vocab_size,
+                                            (2, prompt)), jnp.int32)
+    tokens = jnp.pad(tokens, ((0, 0), (pads, 0)))  # left pads: kv_valid
+    mask = tokens != 0                             # carries structure
+    gen = jax.jit(functools.partial(
+        greedy_generate, cfg=cfg, max_new_tokens=new, eos_token_id=None))
+    ref = np.asarray(gen(params, tokens=tokens, pad_mask=mask)[0])
+    monkeypatch.setattr(DA, 'FORCE_INTERPRET', True)
+    jax.clear_caches()  # drop the XLA-path executable for this shape
+    out = np.asarray(gen(params, tokens=tokens, pad_mask=mask)[0])
+    agree = (ref == out).mean()
+    assert agree >= min_agree, (ref, out)
+
+
 def test_full_decode_path_uses_kernel(monkeypatch):
     """End-to-end: greedy decode over the int8 cache with the kernel
     wired through `_stack` (FORCE_INTERPRET) matches the XLA cache path
-    step for step at the logits level."""
+    at the token level."""
+    cfg = TransformerConfig.llama(
+        vocab_size=97, hidden_size=256, num_layers=2, num_heads=2,
+        num_kv_heads=2, intermediate_size=512, max_seq_len=256)
+    _assert_kernel_parity(cfg, monkeypatch, seed=2, prompt=9,
+                          init_seed=0)
+
+
+@pytest.mark.parametrize('preset,kw', [
+    ('qwen2', dict(num_heads=2, num_kv_heads=2)),      # qkv biases
+    ('chatglm2', dict(num_heads=4, num_kv_heads=2)),   # GQA, interleaved
+                                                        # rotary
+    ('falcon', dict(num_heads=2, num_kv_heads=1)),     # true MQA +
+                                                        # parallel residual
+    ('gemma', dict(num_heads=2, num_kv_heads=2)),      # gelu_tanh, hd 256
+])
+def test_family_decode_path_uses_kernel(monkeypatch, preset, kw):
+    """Architecture families with kernel-eligible geometry must decode
+    identically (to int8 noise) through the kernel and XLA cache paths —
+    wiring insurance for family-specific structure (biases, parallel
+    residual, MQA/GQA, interleaved rotary) interacting with the
+    full-cache branch of `_block`."""
+    cfg = getattr(TransformerConfig, preset)(
+        vocab_size=97, hidden_size=256, num_layers=2,
+        intermediate_size=512, **kw)
+    if cfg.head_dim % 128 or cfg.num_heads % cfg.num_kv_heads:
+        pytest.skip('geometry not kernel-eligible')
     import dataclasses
-    cfg = dataclasses.replace(
-        TransformerConfig.llama(
-            vocab_size=97, hidden_size=256, num_layers=2, num_heads=2,
-            num_kv_heads=2, intermediate_size=512, max_seq_len=256),
-        kv_quant='int8')
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    params = quantize_params(params, cfg)
-    tokens = jnp.asarray(
-        np.random.RandomState(2).randint(1, 97, (2, 9)), jnp.int32)
-    tokens = jnp.pad(tokens, ((0, 0), (3, 0)))  # left pads
-    mask = tokens != 0
-
-    gen = jax.jit(functools.partial(
-        greedy_generate, cfg=cfg, max_new_tokens=6, eos_token_id=None))
-
-    ref_tokens = np.asarray(gen(params, tokens=tokens, pad_mask=mask)[0])
-    monkeypatch.setattr(DA, 'FORCE_INTERPRET', True)
-    jax.clear_caches()  # drop the XLA-path executable for this shape
-    kern_tokens = np.asarray(gen(params, tokens=tokens, pad_mask=mask)[0])
-    # same ICL workload, same quantized cache; q/p-int8 noise may flip a
-    # rare argmax on a random-init toy, so require near-total agreement
-    agree = (ref_tokens == kern_tokens).mean()
-    assert agree >= 0.8, (ref_tokens, kern_tokens)
+    cfg = dataclasses.replace(cfg, max_seq_len=128)
+    _assert_kernel_parity(cfg, monkeypatch, seed=7)
 
 
 def test_prefix_lm_decode_path_uses_kernel(monkeypatch):
@@ -146,23 +176,8 @@ def test_prefix_lm_decode_path_uses_kernel(monkeypatch):
     the bidirectional-context structure lives entirely in the kv_valid
     mask at T=1, so the kernel must reproduce the XLA path's tokens."""
     import dataclasses
-    cfg = dataclasses.replace(
-        TransformerConfig.glm130b(
-            vocab_size=97, hidden_size=256, num_layers=2, num_heads=2,
-            intermediate_size=512, max_seq_len=128),
-        kv_quant='int8')
+    cfg = TransformerConfig.glm130b(
+        vocab_size=97, hidden_size=256, num_layers=2, num_heads=2,
+        intermediate_size=512, max_seq_len=128)
     assert cfg.prefix_lm and cfg.positional == 'rope'
-    params = init_params(cfg, jax.random.PRNGKey(0))
-    params = quantize_params(params, cfg)
-    tokens = jnp.asarray(
-        np.random.RandomState(5).randint(1, 97, (2, 10)), jnp.int32)
-    tokens = jnp.pad(tokens, ((0, 0), (4, 0)))  # left pads: kv_valid
-    mask = tokens != 0                          # carries real structure
-    gen = jax.jit(functools.partial(
-        greedy_generate, cfg=cfg, max_new_tokens=5, eos_token_id=None))
-    ref = np.asarray(gen(params, tokens=tokens, pad_mask=mask)[0])
-    monkeypatch.setattr(DA, 'FORCE_INTERPRET', True)
-    jax.clear_caches()
-    out = np.asarray(gen(params, tokens=tokens, pad_mask=mask)[0])
-    agree = (ref == out).mean()
-    assert agree >= 0.8, (ref, out)
+    _assert_kernel_parity(cfg, monkeypatch, seed=5, pads=4, prompt=10)
