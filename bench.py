@@ -73,6 +73,7 @@ PPL_BATCH, PPL_SEQ, PPL_ITERS = 16, 512, 6
 GEN_BATCH, GEN_PROMPT, GEN_NEW = 32, 128, 64
 GEN_BATCH_HEADLINE = 128  # W8A8 + int8-KV throughput configuration
 LONG_SEQ, LONG_BATCH, LONG_ITERS = 2048, 4, 3  # long-context scoring leg
+GEN_LONG_PROMPT, GEN_LONG_BATCH = 1024, 16     # long-context gen leg
 
 
 def _param_count(cfg):
@@ -108,14 +109,14 @@ def _bench_ppl(params, cfg, iters, use_flash=True, batch=PPL_BATCH,
     return samples_per_sec, tflops
 
 
-def _bench_gen(params, cfg, batch=GEN_BATCH):
+def _bench_gen(params, cfg, batch=GEN_BATCH, prompt=GEN_PROMPT):
     @jax.jit
     def step(params, tokens, mask):
         return greedy_generate(params, cfg, tokens, mask, GEN_NEW,
                                eos_token_id=None)[0]
 
-    tokens = jnp.ones((batch, GEN_PROMPT), jnp.int32)
-    mask = jnp.ones((batch, GEN_PROMPT), jnp.bool_)
+    tokens = jnp.ones((batch, prompt), jnp.int32)
+    mask = jnp.ones((batch, prompt), jnp.bool_)
     np.asarray(step(params, tokens, mask))  # compile + full sync
     t0 = time.perf_counter()
     out = step(params, tokens, mask)
@@ -257,6 +258,14 @@ def main():
     cfg_hl = dataclasses.replace(CFG_7B, kv_quant='int8', act_quant=True)
     genhl_sps, genhl_tps = _bench_gen(qparams, cfg_hl,
                                       batch=GEN_BATCH_HEADLINE)
+    jax.clear_caches()
+    # long-context generation leg: p1024 prompts at the largest batch
+    # whose int8 cache fits beside the weights (the reference truncates
+    # long inputs instead; SURVEY long-context row).  Exercises the
+    # decode kernel's multi-chunk online softmax on-chip.
+    glong_sps, glong_tps = _bench_gen(qparams, cfg_hl,
+                                      batch=GEN_LONG_BATCH,
+                                      prompt=GEN_LONG_PROMPT)
     jax.clear_caches()
     # quantized halves of the headline-accuracy leg (same pool, same
     # weights re-materialized as int8 from the same PRNG key)
@@ -408,6 +417,10 @@ def main():
             'ppl_long_s%d_samples_per_sec' % LONG_SEQ:
                 round(long_sps, 3),
             'ppl_long_s%d_tflops' % LONG_SEQ: round(long_tflops, 1),
+            'gen_long_p%d_b%d_samples_per_sec' % (
+                GEN_LONG_PROMPT, GEN_LONG_BATCH): round(glong_sps, 3),
+            'gen_long_p%d_b%d_tokens_per_sec' % (
+                GEN_LONG_PROMPT, GEN_LONG_BATCH): round(glong_tps, 1),
             'gen_samples_per_sec': round(genhl_sps, 3),
             'gen_tokens_per_sec': round(genhl_tps, 1),
             'gen_quantize': 'W8A8 matmuls + int8 KV cache (per-vector '
