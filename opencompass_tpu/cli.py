@@ -10,6 +10,8 @@ Usage::
     python run.py cfg.py -r [TIMESTAMP]             # resume a prior run
     python run.py cfg.py --debug                    # serial, in-process
     python run.py cfg.py --slurm -p PARTITION       # cluster launch
+    python run.py cfg.py --obs                      # run-wide tracing
+    python -m opencompass_tpu.cli trace WORK_DIR    # render trace report
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files
@@ -18,14 +20,16 @@ Every phase is resumable because completion is keyed on output files
 import argparse
 import os
 import os.path as osp
+import sys
 from datetime import datetime
 
+from opencompass_tpu import obs
 from opencompass_tpu.config import Config
 from opencompass_tpu.partitioners import NaivePartitioner, SizePartitioner
 from opencompass_tpu.registry import PARTITIONERS, RUNNERS
 from opencompass_tpu.runners import LocalRunner, SlurmRunner
 from opencompass_tpu.tasks import OpenICLEvalTask, OpenICLInferTask
-from opencompass_tpu.utils.logging import get_logger
+from opencompass_tpu.utils.logging import add_file_handler, get_logger
 from opencompass_tpu.utils.summarizer import Summarizer
 
 logger = get_logger()
@@ -92,6 +96,13 @@ def parse_args():
                         help='record jax.profiler traces per infer task '
                         '(under {work_dir}/profile/) in addition to the '
                         'always-on perf counters')
+    parser.add_argument('--obs',
+                        action='store_true',
+                        help='run-wide span tracing + metrics: appends '
+                        'events to {work_dir}/obs/events.jsonl (render '
+                        'with `python -m opencompass_tpu.cli trace '
+                        '<work_dir>`); config key `obs = True` is '
+                        'equivalent')
     return parser.parse_args()
 
 
@@ -105,6 +116,8 @@ def get_config_from_arg(args) -> Config:
         cfg.pop('lark_bot_url', None)
     if args.profile:
         cfg['profile'] = True
+    if args.obs:
+        cfg['obs'] = True
     return cfg
 
 
@@ -154,7 +167,18 @@ def exec_eval_runner(tasks, args, cfg):
     runner(tasks)
 
 
+def trace_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli trace <work_dir>`` — render the
+    obs trace report for a finished (or live) run."""
+    from opencompass_tpu.obs.report import main as report_main
+    return report_main(argv)
+
+
 def main():
+    # subcommand dispatch before the run-config parser: `trace` takes a
+    # work_dir, not a config file
+    if len(sys.argv) > 1 and sys.argv[1] == 'trace':
+        raise SystemExit(trace_main(sys.argv[2:]))
     # persistent XLA compilation cache for the whole pipeline — tasks
     # inherit it (LocalRunner also sets it for device tasks), and the
     # --debug in-process path benefits directly.  Rare shapes compile
@@ -187,36 +211,63 @@ def main():
 
     # dump the resolved config for the record / reuse
     cfg.dump(osp.join(cfg['work_dir'], 'config.py'))
+    # rank-0 driver logs survive the terminal alongside the run outputs
+    add_file_handler(cfg['work_dir'])
     logger.info(f'Current exp folder: {cfg["work_dir"]}')
 
+    # run-wide tracing: everything below nests under the 'run' span, and
+    # subprocess tasks join the same events.jsonl via OCT_* env vars
+    tracer = obs.init_obs(cfg['work_dir'], enabled=obs.obs_enabled(cfg))
+    try:
+        with tracer.span('run', config=args.config, mode=args.mode):
+            _run_phases(args, cfg, dir_time_str)
+    finally:
+        tracer.close()
+    if tracer.enabled:
+        logger.info('obs events at '
+                    f'{osp.join(cfg["work_dir"], "obs", "events.jsonl")} — '
+                    'render with: python -m opencompass_tpu.cli trace '
+                    f'{cfg["work_dir"]}')
+
+
+def _run_phases(args, cfg, dir_time_str):
+    tracer = obs.get_tracer()
     if args.mode in ('all', 'infer'):
-        if 'infer' in cfg and 'partitioner' in cfg['infer']:
-            part_cfg = dict(cfg['infer']['partitioner'])
-            part_cfg['out_dir'] = osp.join(cfg['work_dir'], 'predictions/')
-            partitioner = PARTITIONERS.build(part_cfg)
-        else:
-            partitioner = SizePartitioner(
-                osp.join(cfg['work_dir'], 'predictions/'),
-                max_task_size=args.max_partition_size,
-                gen_task_coef=args.gen_task_coef)
-        tasks = partitioner(cfg)
-        if tasks:
-            exec_infer_runner(tasks, args, cfg)
-        else:
-            logger.info('All predictions already exist; skipping infer.')
+        with tracer.span('phase:infer'):
+            if 'infer' in cfg and 'partitioner' in cfg['infer']:
+                part_cfg = dict(cfg['infer']['partitioner'])
+                part_cfg['out_dir'] = osp.join(cfg['work_dir'],
+                                               'predictions/')
+                partitioner = PARTITIONERS.build(part_cfg)
+            else:
+                partitioner = SizePartitioner(
+                    osp.join(cfg['work_dir'], 'predictions/'),
+                    max_task_size=args.max_partition_size,
+                    gen_task_coef=args.gen_task_coef)
+            tasks = partitioner(cfg)
+            if tasks:
+                exec_infer_runner(tasks, args, cfg)
+            else:
+                logger.info('All predictions already exist; '
+                            'skipping infer.')
 
     if args.mode in ('all', 'eval'):
-        partitioner = NaivePartitioner(
-            osp.join(cfg['work_dir'], 'results/'))
-        tasks = partitioner(cfg)
-        if tasks:
-            exec_eval_runner(tasks, args, cfg)
-        else:
-            logger.info('All results already exist; skipping eval.')
+        with tracer.span('phase:eval'):
+            partitioner = NaivePartitioner(
+                osp.join(cfg['work_dir'], 'results/'))
+            tasks = partitioner(cfg)
+            if tasks:
+                exec_eval_runner(tasks, args, cfg)
+            else:
+                logger.info('All results already exist; skipping eval.')
 
     if args.mode in ('all', 'eval', 'viz'):
-        summarizer = Summarizer(cfg)
-        summarizer.summarize(time_str=dir_time_str)
+        with tracer.span('phase:viz'):
+            # metrics flushed first so the summarizer's obs section sees
+            # this process's counters in the event stream
+            tracer.flush_metrics()
+            summarizer = Summarizer(cfg)
+            summarizer.summarize(time_str=dir_time_str)
 
 
 if __name__ == '__main__':

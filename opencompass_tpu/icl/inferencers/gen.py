@@ -15,8 +15,10 @@ from __future__ import annotations
 
 import os
 import os.path as osp
+import time
 from typing import List, Optional
 
+from opencompass_tpu.obs import get_tracer, observe_batch
 from opencompass_tpu.parallel.distributed import broadcast_object
 from opencompass_tpu.registry import ICL_INFERENCERS
 from opencompass_tpu.utils.logging import get_logger
@@ -70,9 +72,16 @@ class GenInferencer(BaseInferencer):
         cursor = len(done)
 
         logger.info('Starting inference process...')
+        # hoisted once: the per-batch obs cost is one bool check when
+        # tracing is off
+        obs_on = get_tracer().enabled
         for chunk in self.get_batches(prompts[cursor:], self.batch_size):
             shown = self.model.parse_template(chunk, mode='gen')
+            if obs_on:
+                t0 = time.perf_counter()
             completions = self._generate_batch(chunk, shown)
+            if obs_on:
+                observe_batch('inferencer.gen_batches', t0)
             for text, completion in zip(shown, completions):
                 handler.save_results(text, completion, cursor)
                 cursor += 1

@@ -17,10 +17,12 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import time
 from typing import List, Optional
 
 import numpy as np
 
+from opencompass_tpu.obs import get_tracer, observe_batch
 from opencompass_tpu.registry import ICL_INFERENCERS
 from opencompass_tpu.utils.logging import get_logger
 
@@ -106,11 +108,16 @@ class PPLInferencer(BaseInferencer):
                       and getattr(self.model, 'shared_prefix_active',
                                   False))
         if item_major:
+            obs_on = get_tracer().enabled
             score_table = [[0.0] * len(fitter) for _ in labels]
             for idx in range(len(fitter)):
+                if obs_on:
+                    t0 = time.perf_counter()
                 got = np.asarray(self.model.get_ppl_from_template(
                     [rows_by_label[li][idx].prompt
                      for li in range(len(labels))]))
+                if obs_on:
+                    observe_batch('inferencer.ppl_batches', t0)
                 for li in range(len(labels)):
                     score_table[li][idx] = float(got[li])
         else:
@@ -172,9 +179,12 @@ class PPLInferencer(BaseInferencer):
         if normalizing_str is not None:
             norm_tokens = self.model.get_token_len_from_template(
                 normalizing_str, mode='ppl')
+        obs_on = get_tracer().enabled
         scores: List[float] = []
         for chunk in self.get_batches(rows, self.batch_size):
             prompts = [r.prompt for r in chunk]
+            if obs_on:
+                t0 = time.perf_counter()
             if normalizing_str is None:
                 got = np.asarray(self.model.get_ppl_from_template(prompts))
             else:
@@ -185,5 +195,7 @@ class PPLInferencer(BaseInferencer):
                     [r.normalizer for r in chunk],
                     mask_length=[norm_tokens] * len(chunk)))
                 got = conditional - baseline
+            if obs_on:
+                observe_batch('inferencer.ppl_batches', t0)
             scores.extend(got.tolist())
         return scores

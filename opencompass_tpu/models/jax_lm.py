@@ -123,6 +123,11 @@ class JaxLM(BaseModel):
         self._ids_cache_max = 8192
         self._len_cache_max = 1_000_000
         self._gen_fn_cache: Dict[tuple, object] = {}
+        # (kernel kind, static args, shape bucket) keys already dispatched:
+        # an unseen key means jax.jit compiles on this call, so its
+        # duration is attributed to PerfCounters.compile_seconds (the obs
+        # trace report's first-call-vs-steady device_call split)
+        self._dispatched_keys: set = set()
         # shared-prefix prefill reuse: a batch whose prompts share a long
         # common token prefix (fixed few-shot ICE blocks; PPL label
         # variants) prefills it once (nn: forward_shared for scoring,
@@ -404,6 +409,15 @@ class JaxLM(BaseModel):
         self._gen_fn_cache[key] = gen
         return gen
 
+    def _first_dispatch(self, kind: str, *key_parts) -> bool:
+        """True the first time a (kind, static-arg, shape-bucket) key is
+        dispatched — the call that pays XLA compilation."""
+        key = (kind,) + key_parts
+        if key in self._dispatched_keys:
+            return False
+        self._dispatched_keys.add(key)
+        return True
+
     # -- BaseModel contract ------------------------------------------------
 
     @staticmethod
@@ -565,9 +579,11 @@ class JaxLM(BaseModel):
                                          max_len=self.max_seq_len)
             mlb = np.zeros((tokens.shape[0],), np.int32)
             mlb[:len(ml)] = ml
+            first = self._first_dispatch(
+                'ppl', prefix is not None and len(prefix), tokens.shape)
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
-                             samples=len(inputs)):
+                             samples=len(inputs), first=first):
                 if prefix is not None:
                     spec = P('data', None)
                     nll = self._ppl_shared_fn(
@@ -633,9 +649,10 @@ class JaxLM(BaseModel):
             tokens, mask, ids = self._encode_batch(
                 inputs, left_pad=False, max_len=self.max_seq_len,
                 keep='tail')
+            first = self._first_dispatch('choice', tokens.shape)
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
-                             samples=len(inputs)):
+                             samples=len(inputs), first=first):
                 logits = self._choice_logits_fn(self.params, tokens, mask)
                 logits = np.asarray(logits, np.float64)
         logits = logits[:len(inputs)]
@@ -669,9 +686,13 @@ class JaxLM(BaseModel):
                 else self._shared_prefix_split(ids)
             tokens, mask = self._pad_ids(rows, left_pad=True,
                                          max_len=max_prompt)
+            first = self._first_dispatch(
+                'gen', prefix is not None and len(prefix), tokens.shape,
+                int(max_out_len), temperature, top_k, num_beams,
+                length_penalty)
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
-                             samples=len(inputs)):
+                             samples=len(inputs), first=first):
                 rng = self._put(jax.random.PRNGKey(seed), P())
                 if prefix is not None:
                     spec = P('data', None)

@@ -1,0 +1,142 @@
+"""Run-wide observability: span tracing, metrics, trace reports.
+
+Usage (driver)::
+
+    from opencompass_tpu import obs
+    tracer = obs.init_obs(work_dir)          # {work_dir}/obs/events.jsonl
+    with tracer.span('run'):
+        ...
+    tracer.close()
+
+Usage (instrumented library code — zero-overhead when disabled)::
+
+    from opencompass_tpu.obs import get_tracer
+    tr = get_tracer()                        # NoopTracer unless enabled
+    if tr.enabled:                           # single attribute check
+        tr.histogram('x.seconds').observe(dt)
+
+Subprocess tasks inherit the run's trace through ``OCT_TRACE_ID`` /
+``OCT_PARENT_SPAN`` / ``OCT_OBS_DIR`` (see :mod:`.trace`); call
+:func:`init_task_obs` with the task config to resume it.
+"""
+from __future__ import annotations
+
+import os
+import os.path as osp
+import time
+from typing import Dict, Optional
+
+from opencompass_tpu.obs.metrics import (Counter, Gauge, Histogram,
+                                         LATENCY_BUCKETS_S, MetricsRegistry)
+from opencompass_tpu.obs.trace import (ENV_OBS_DIR, ENV_PARENT_SPAN,
+                                       ENV_TRACE_ID, NoopTracer, Span,
+                                       Tracer, current_span)
+
+__all__ = ['Counter', 'Gauge', 'Histogram', 'LATENCY_BUCKETS_S',
+           'MetricsRegistry', 'NoopTracer', 'Span', 'Tracer',
+           'current_span', 'get_tracer', 'init_obs', 'init_task_obs',
+           'reset_obs', 'obs_enabled', 'device_memory_attrs',
+           'observe_batch', 'ENV_TRACE_ID', 'ENV_PARENT_SPAN',
+           'ENV_OBS_DIR']
+
+_NOOP = NoopTracer()
+_TRACER = _NOOP
+
+
+def get_tracer():
+    """The process-wide tracer; a shared ``NoopTracer`` until one of the
+    ``init_*`` functions installs a real one."""
+    return _TRACER
+
+
+def init_obs(work_dir: str, enabled: bool = True,
+             trace_id: Optional[str] = None,
+             default_parent: Optional[str] = None):
+    """Install the global tracer writing ``{work_dir}/obs/events.jsonl``.
+    With ``enabled=False`` any live tracer is torn down and the NoopTracer
+    restored — no ``obs/`` directory is ever created on the disabled
+    path.  Re-entry with the same run dir is idempotent; a new run dir
+    (second ``cli.main()`` in one process) closes the old sink and starts
+    a fresh trace there instead of appending to the previous run's file."""
+    global _TRACER
+    if not enabled:
+        reset_obs()
+        return _TRACER
+    obs_dir = osp.join(work_dir, 'obs')
+    if isinstance(_TRACER, Tracer):
+        if osp.abspath(_TRACER.obs_dir) == osp.abspath(obs_dir):
+            return _TRACER
+        reset_obs()
+    _TRACER = Tracer(obs_dir, trace_id=trace_id,
+                     default_parent=default_parent)
+    return _TRACER
+
+
+def init_task_obs(cfg: Dict):
+    """Resume (or start) tracing inside a subprocess task.
+
+    Enabled when the task config carries ``obs = True`` or the launcher
+    exported ``OCT_TRACE_ID``.  The sink is ``OCT_OBS_DIR`` when present
+    (the launcher's run dir), else ``{work_dir}/obs``; spans root under
+    ``OCT_PARENT_SPAN`` so the task nests below the runner's span.  Only
+    JAX process 0 of a multi-host group emits (same policy as logging).
+    """
+    global _TRACER
+    enabled = bool(cfg.get('obs')) or ENV_TRACE_ID in os.environ
+    if not enabled:
+        return _TRACER
+    from opencompass_tpu.utils.logging import _process_index
+    if _process_index() != 0:
+        return _NOOP
+    obs_dir = os.environ.get(ENV_OBS_DIR)
+    if not obs_dir:
+        obs_dir = osp.join(cfg.get('work_dir', '.'), 'obs')
+    if isinstance(_TRACER, Tracer):
+        return _TRACER
+    _TRACER = Tracer(obs_dir,
+                     trace_id=os.environ.get(ENV_TRACE_ID),
+                     default_parent=os.environ.get(ENV_PARENT_SPAN))
+    return _TRACER
+
+
+def reset_obs():
+    """Drop back to the NoopTracer (closing any live sink) — test hook."""
+    global _TRACER
+    if isinstance(_TRACER, Tracer):
+        try:
+            _TRACER.close()
+        except Exception:
+            pass
+    _TRACER = _NOOP
+
+
+def obs_enabled(cfg: Dict) -> bool:
+    """Is observability requested for this run config?"""
+    return bool(cfg.get('obs'))
+
+
+def observe_batch(counter: str, t0: float):
+    """Record one inferencer batch: latency into the shared
+    ``inferencer.batch_seconds`` histogram plus an increment of
+    ``counter``.  Callers hoist ``obs_on = get_tracer().enabled`` before
+    their loop and only take a ``time.perf_counter()`` / call-this pair
+    when it is True, keeping the disabled hot path at one bool check."""
+    tracer = get_tracer()
+    tracer.histogram('inferencer.batch_seconds').observe(
+        time.perf_counter() - t0)
+    tracer.counter(counter).inc()
+
+
+def device_memory_attrs() -> Dict[str, int]:
+    """Device memory stats from the first local accelerator, when the
+    backend exposes them (TPU does; CPU returns {}).  Never raises."""
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+        stats = getattr(dev, 'memory_stats', lambda: None)() or {}
+        return {k: int(stats[k])
+                for k in ('bytes_in_use', 'peak_bytes_in_use',
+                          'bytes_limit', 'largest_alloc_size')
+                if k in stats}
+    except Exception:
+        return {}

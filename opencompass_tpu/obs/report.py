@@ -1,0 +1,477 @@
+"""Trace report: aggregate one run's ``obs/events.jsonl`` into a readable
+per-run breakdown.
+
+Consumed by ``python -m opencompass_tpu.cli trace <work_dir>`` and
+``tools/trace_report.py``; the Summarizer embeds :func:`render_summary`
+next to the accuracy tables.
+
+Sections:
+
+- run header: trace id(s), wall span, event/span counts
+- critical path: root → the latest-finishing descendant chain
+- per-task table: wall / slot-wait / compile / device / retries / status
+  (compile+device come from the subprocess infer spans' TaskProfiler
+  record; wait from the runner's slot allocator)
+- slot-utilization timeline: busy fraction of device slots over run bins
+- failure/retry summary: timeouts, stalls, retries, error spans
+"""
+from __future__ import annotations
+
+import json
+import os.path as osp
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from opencompass_tpu.obs.metrics import merge_histogram_snapshots
+
+
+def resolve_events_path(path: str) -> Optional[str]:
+    """Accept a run work_dir, its ``obs/`` dir, a parent outputs dir with
+    timestamped run subdirs, or a direct events.jsonl path."""
+    import os
+    if osp.isfile(path):
+        return path
+    for cand in (osp.join(path, 'obs', 'events.jsonl'),
+                 osp.join(path, 'events.jsonl')):
+        if osp.isfile(cand):
+            return cand
+    if osp.isdir(path):  # outputs/<cfg>/ holding timestamped run dirs
+        for sub in sorted(os.listdir(path), reverse=True):
+            cand = osp.join(path, sub, 'obs', 'events.jsonl')
+            if osp.isfile(cand):
+                return cand
+    return None
+
+
+def load_events(path: str) -> List[Dict]:
+    events = []
+    with open(path, encoding='utf-8') as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn line from a killed process
+            if isinstance(rec, dict) and 'kind' in rec:
+                events.append(rec)
+    return events
+
+
+class _SpanNode:
+    __slots__ = ('span_id', 'name', 'parent', 'start', 'end', 'dur',
+                 'status', 'error', 'attrs', 'children', 'pid')
+
+    def __init__(self, span_id):
+        self.span_id = span_id
+        self.name = '?'
+        self.parent = None
+        self.start = None
+        self.end = None
+        self.dur = None
+        self.status = 'open'   # no span_end seen (killed process)
+        self.error = None
+        self.attrs: Dict = {}
+        self.children: List['_SpanNode'] = []
+        self.pid = None
+
+
+def build_span_tree(events: List[Dict]) -> Dict[str, _SpanNode]:
+    """span_id → node, with ``children`` wired from parent links."""
+    nodes: Dict[str, _SpanNode] = {}
+
+    def node(span_id):
+        n = nodes.get(span_id)
+        if n is None:
+            n = nodes[span_id] = _SpanNode(span_id)
+        return n
+
+    for ev in events:
+        kind = ev.get('kind')
+        if kind not in ('span_start', 'span_end'):
+            continue
+        n = node(ev['span'])
+        n.name = ev.get('name', n.name)
+        n.pid = ev.get('pid', n.pid)
+        if ev.get('parent'):
+            n.parent = ev['parent']
+        if ev.get('attrs'):
+            n.attrs.update(ev['attrs'])
+        if kind == 'span_start':
+            n.start = ev['ts']
+        else:
+            n.end = ev['ts']
+            n.dur = ev.get('dur')
+            n.status = ev.get('status', 'ok')
+            n.error = ev.get('error')
+    for n in nodes.values():
+        if n.parent and n.parent in nodes:
+            nodes[n.parent].children.append(n)
+    for n in nodes.values():
+        n.children.sort(key=lambda c: c.start or 0)
+    return nodes
+
+
+def _roots(nodes: Dict[str, _SpanNode]) -> List[_SpanNode]:
+    return sorted((n for n in nodes.values()
+                   if not n.parent or n.parent not in nodes),
+                  key=lambda n: n.start or 0)
+
+
+def _span_wall(n: _SpanNode) -> float:
+    if n.start is None:
+        return 0.0
+    end = n.end if n.end is not None else max(
+        [n.start] + [c.end for c in n.children if c.end is not None])
+    return max(0.0, end - n.start)
+
+
+def _critical_path(root: _SpanNode) -> List[_SpanNode]:
+    """Descend from the root into the dominant child at each level: the
+    latest-finishing one when children overlap (parallel tasks — the one
+    that gated completion), breaking near-ties by duration (sequential
+    phases — the one worth optimizing)."""
+    path = [root]
+    cur = root
+    while cur.children:
+        latest = max(c.end if c.end is not None else (c.start or 0)
+                     for c in cur.children)
+        # children finishing within 5% of the parent's wall of the latest
+        # are "at the end" — among them, the longest dominates
+        slack = 0.05 * max(_span_wall(cur), 1e-9)
+        tail = [c for c in cur.children
+                if (c.end if c.end is not None else (c.start or 0))
+                >= latest - slack]
+        cur = max(tail, key=_span_wall)
+        path.append(cur)
+    return path
+
+
+def _subtree_perf(root: _SpanNode) -> Dict[str, float]:
+    """Sum TaskProfiler perf attrs over a span's subtree, itself included
+    (runner ``task:`` spans carry none of their own; in-process ``infer:``
+    spans carry theirs directly)."""
+    out = defaultdict(float)
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        stack.extend(n.children)
+        perf = n.attrs.get('perf')
+        if isinstance(perf, dict):
+            for key in ('device_seconds', 'compile_seconds',
+                        'wall_seconds', 'tokens_in', 'tokens_out',
+                        'samples', 'device_calls'):
+                val = perf.get(key)
+                if isinstance(val, (int, float)):
+                    out[key] += val
+    return dict(out)
+
+
+def build_report(work_dir: str, trace: Optional[str] = None) -> Dict:
+    """Aggregate ``events.jsonl`` under ``work_dir`` into a report dict;
+    raises ``FileNotFoundError`` when the run has no obs stream.
+
+    A resumed run (``-r``) appends a *second* trace to the same file;
+    aggregating across traces would fold the idle gap into wall time and
+    double-count re-run tasks, so only one trace is reported: ``trace``
+    when given, else the latest (by newest event timestamp).
+    """
+    path = resolve_events_path(work_dir)
+    if path is None:
+        raise FileNotFoundError(
+            f'no obs/events.jsonl under {work_dir!r} — was the run '
+            'launched with --obs / obs = True?')
+    all_events = load_events(path)
+    all_trace_ids = sorted({ev.get('trace') for ev in all_events
+                            if ev.get('trace')})
+    if trace is None and all_trace_ids:
+        newest = {}
+        for ev in all_events:
+            if ev.get('trace') and 'ts' in ev:
+                newest[ev['trace']] = max(newest.get(ev['trace'], 0),
+                                          ev['ts'])
+        trace = max(newest, key=newest.get)
+    events = [ev for ev in all_events
+              if trace is None or ev.get('trace') == trace]
+    nodes = build_span_tree(events)
+    roots = _roots(nodes)
+
+    timestamps = [ev['ts'] for ev in events if 'ts' in ev]
+    t0 = min(timestamps) if timestamps else 0.0
+    t1 = max(timestamps) if timestamps else 0.0
+
+    # -- per-task breakdown ------------------------------------------------
+    # primary source: runner-side task:* spans.  A --debug run executes
+    # tasks in-process (no runner task spans), so fall back to the
+    # infer:/eval: spans, which carry the perf attribution directly.
+    def _task_row(n: _SpanNode, name: str) -> Dict:
+        perf = _subtree_perf(n)
+        compile_s = perf.get('compile_seconds', 0.0)
+        device_s = perf.get('device_seconds', 0.0)
+        return {
+            'name': name,
+            'wall_seconds': round(_span_wall(n), 3),
+            'wait_seconds': round(
+                float(n.attrs.get('slot_wait_seconds', 0.0)), 3),
+            'compile_seconds': round(compile_s, 3),
+            'device_seconds': round(device_s, 3),
+            'steady_device_seconds': round(
+                max(0.0, device_s - compile_s), 3),
+            'retries': int(n.attrs.get('retries', 0)),
+            'devices': n.attrs.get('devices', []),
+            'status': ('error' if n.status == 'error'
+                       or n.attrs.get('returncode') not in (0, None)
+                       else n.status),
+            'start': n.start, 'end': n.end,
+        }
+
+    tasks = [_task_row(n, n.name[len('task:'):]) for n in nodes.values()
+             if n.name.startswith('task:')]
+    if not tasks:
+        tasks = [_task_row(n, n.name) for n in nodes.values()
+                 if n.name.startswith(('infer:', 'eval:'))]
+    tasks.sort(key=lambda t: t['start'] or 0)
+
+    # -- slot-utilization timeline -----------------------------------------
+    num_slots = 0
+    for n in nodes.values():
+        for dev in n.attrs.get('devices', []) or []:
+            if isinstance(dev, int):
+                num_slots = max(num_slots, dev + 1)
+        if isinstance(n.attrs.get('num_devices_host'), int):
+            num_slots = max(num_slots, n.attrs['num_devices_host'])
+    slot_util = {'num_slots': num_slots, 'overall': None, 'timeline': []}
+    if num_slots and t1 > t0:
+        intervals = []  # (start, end, n_devices)
+        for t in tasks:
+            if t['devices'] and t['start'] is not None:
+                intervals.append((t['start'], t['end'] or t1,
+                                  len(t['devices'])))
+        busy = sum((e - s) * k for s, e, k in intervals)
+        slot_util['overall'] = round(busy / ((t1 - t0) * num_slots), 3)
+        nbins = min(24, max(1, int(t1 - t0) or 1))
+        width = (t1 - t0) / nbins
+        for b in range(nbins):
+            lo, hi = t0 + b * width, t0 + (b + 1) * width
+            overlap = sum(max(0.0, min(e, hi) - max(s, lo)) * k
+                          for s, e, k in intervals)
+            slot_util['timeline'].append(
+                round(overlap / (width * num_slots), 3))
+
+    # -- failures / retries ------------------------------------------------
+    failures = {'task_timeout': 0, 'stall_timeout': 0, 'task_retry': 0,
+                'error_spans': 0, 'failed_tasks': 0}
+    for ev in events:
+        if ev.get('kind') == 'event' and ev.get('name') in failures:
+            failures[ev['name']] += 1
+    failures['error_spans'] = sum(1 for n in nodes.values()
+                                  if n.status == 'error')
+    failures['failed_tasks'] = sum(1 for t in tasks
+                                   if t['status'] != 'ok')
+
+    # -- metrics -----------------------------------------------------------
+    # each process flushes *cumulative* registry snapshots (possibly more
+    # than once), so keep only the last metrics event per process, then
+    # merge across processes.  Keyed on (pid, proc-token): bare pids
+    # recycle over a long multi-hundred-task run
+    last_by_pid = {}
+    for ev in events:
+        if ev.get('kind') == 'metrics':
+            key = (ev.get('pid'), ev.get('proc'))
+            last_by_pid[key] = ev.get('attrs') or {}
+    counters = defaultdict(int)
+    gauges = {}
+    hist_raw = defaultdict(list)
+    for attrs in last_by_pid.values():
+        for k, v in (attrs.get('counters') or {}).items():
+            counters[k] += v
+        for k, v in (attrs.get('gauges') or {}).items():
+            prev = gauges.get(k)
+            if prev is None or (v.get('max') or 0) > (prev.get('max') or 0):
+                gauges[k] = v
+        for k, v in (attrs.get('histograms') or {}).items():
+            hist_raw[k].append(v)
+    histograms = {k: merge_histogram_snapshots(v)
+                  for k, v in hist_raw.items()}
+
+    critical = _critical_path(roots[0]) if roots else []
+    return {
+        'events_path': path,
+        'trace': trace,
+        'trace_ids': all_trace_ids,  # every trace seen (resumed runs >1)
+        'wall_seconds': round(t1 - t0, 3),
+        'n_events': len(events),
+        'n_spans': len(nodes),
+        'open_spans': [n.name for n in nodes.values()
+                       if n.status == 'open'],
+        'tasks': tasks,
+        'critical_path': [
+            {'name': n.name, 'dur': round(_span_wall(n), 3),
+             'status': n.status} for n in critical],
+        'slot_utilization': slot_util,
+        'failures': failures,
+        'metrics': {'counters': dict(counters), 'gauges': gauges,
+                    'histograms': histograms},
+    }
+
+
+# -- rendering -------------------------------------------------------------
+
+def _table(rows: List[List[str]]) -> str:
+    widths = [max(len(str(r[i])) for r in rows)
+              for i in range(len(rows[0]))]
+    out = []
+    for i, row in enumerate(rows):
+        out.append('  '.join(str(c).ljust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            out.append('  '.join('-' * w for w in widths))
+    return '\n'.join(out)
+
+
+def _sparkline(values: List[float]) -> str:
+    blocks = ' ▁▂▃▄▅▆▇█'
+    return ''.join(blocks[min(len(blocks) - 1,
+                              int(v * (len(blocks) - 1) + 0.5))]
+                   for v in values)
+
+
+def _histogram_quantile(snap: Dict, q: float):
+    """Approximate quantile from a cumulative-bucket snapshot: the upper
+    bound of the bucket holding the q-th observation, or ``'>{top}'``
+    when it lands in the +Inf overflow bucket (a 20-minute slot wait
+    must not render as 'inf')."""
+    if not snap or not snap.get('count'):
+        return None
+    target = q * snap['count']
+    seen = 0
+    for ub, c in zip(snap['buckets'], snap['counts']):
+        seen += c
+        if seen >= target:
+            return ub
+    return f">{snap['buckets'][-1]}" if snap['buckets'] else None
+
+
+def render_summary(report: Dict) -> str:
+    """The few top-level numbers the Summarizer prints next to accuracy."""
+    f = report['failures']
+    m = report['metrics']
+    lines = [
+        f"wall {report['wall_seconds']}s, {len(report['tasks'])} tasks, "
+        f"{report['n_spans']} spans",
+        f"retries {f['task_retry']}, timeouts {f['task_timeout']}, "
+        f"stalls {f['stall_timeout']}, failed tasks {f['failed_tasks']}",
+    ]
+    compile_s = sum(t['compile_seconds'] for t in report['tasks'])
+    device_s = sum(t['device_seconds'] for t in report['tasks'])
+    wait_s = sum(t['wait_seconds'] for t in report['tasks'])
+    lines.append(f'compile {compile_s:.1f}s, device {device_s:.1f}s, '
+                 f'slot-wait {wait_s:.1f}s')
+    util = report['slot_utilization']
+    if util['overall'] is not None:
+        lines.append(f"slot utilization {util['overall']:.0%} over "
+                     f"{util['num_slots']} slot(s)")
+    peak = (m['gauges'].get('device.peak_bytes_in_use') or {}).get('max')
+    if peak:
+        lines.append(f'device memory high-water {peak / 2**20:.1f} MiB')
+    return '\n'.join(lines)
+
+
+def render_report(report: Dict) -> str:
+    others = '|'.join(t for t in report['trace_ids']
+                      if t != report['trace'])
+    out = ['== trace report ==',
+           f"events: {report['events_path']}",
+           f"trace: {report['trace'] or '-'}"
+           + (f" (1 of {len(report['trace_ids'])} in this work_dir — "
+              f'resumed run; select others with --trace {others})'
+              if others else ''),
+           render_summary(report)]
+    if report['open_spans']:
+        out.append(f"open spans (process killed?): "
+                   f"{', '.join(report['open_spans'][:6])}")
+
+    out.append('\n-- critical path --')
+    for i, hop in enumerate(report['critical_path']):
+        marker = ' [error]' if hop['status'] == 'error' else ''
+        out.append(f"{'  ' * i}{hop['name']}  {hop['dur']}s{marker}")
+
+    out.append('\n-- per-task breakdown --')
+    if report['tasks']:
+        rows = [['task', 'wall_s', 'wait_s', 'compile_s', 'device_s',
+                 'steady_s', 'retries', 'devices', 'status']]
+        for t in report['tasks']:
+            rows.append([t['name'][:60], t['wall_seconds'],
+                         t['wait_seconds'], t['compile_seconds'],
+                         t['device_seconds'], t['steady_device_seconds'],
+                         t['retries'],
+                         ','.join(map(str, t['devices'])) or '-',
+                         t['status']])
+        out.append(_table(rows))
+    else:
+        out.append('(no task spans)')
+
+    out.append('\n-- slot utilization --')
+    util = report['slot_utilization']
+    if util['timeline']:
+        out.append(f"{util['num_slots']} slot(s), overall "
+                   f"{util['overall']:.0%}")
+        out.append('timeline: ' + _sparkline(util['timeline']))
+    else:
+        out.append('(no device-slot tasks in this run)')
+
+    out.append('\n-- failures / retries --')
+    f = report['failures']
+    out.append(f"wall-clock timeouts: {f['task_timeout']}   "
+               f"stall kills: {f['stall_timeout']}   "
+               f"retries: {f['task_retry']}   "
+               f"error spans: {f['error_spans']}   "
+               f"failed tasks: {f['failed_tasks']}")
+
+    hists = report['metrics']['histograms']
+    shown = [(name, snap) for name, snap in sorted(hists.items())
+             if snap and snap.get('count')]
+    if shown:
+        out.append('\n-- latency histograms --')
+        rows = [['metric', 'count', 'mean_s', 'p50_s', 'p99_s']]
+        for name, snap in shown:
+            mean = snap['sum'] / snap['count']
+            rows.append([name, snap['count'], f'{mean:.4f}',
+                         _histogram_quantile(snap, 0.5),
+                         _histogram_quantile(snap, 0.99)])
+        out.append(_table(rows))
+    counters = report['metrics']['counters']
+    if counters:
+        out.append('\n-- counters --')
+        for k in sorted(counters):
+            out.append(f'{k}: {counters[k]}')
+    return '\n'.join(out) + '\n'
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """CLI body shared by ``opencompass_tpu.cli trace`` and
+    ``tools/trace_report.py``."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog='trace', description='Render a run trace report from '
+        'obs/events.jsonl')
+    parser.add_argument('work_dir',
+                        help='run work dir (or its obs/ dir, a parent '
+                        'outputs dir, or an events.jsonl path)')
+    parser.add_argument('--json', action='store_true',
+                        help='emit the raw report dict as JSON')
+    parser.add_argument('--trace', default=None,
+                        help='report a specific trace id (resumed runs '
+                        'append several to one events.jsonl; default: '
+                        'the latest — the header lists all of them)')
+    args = parser.parse_args(argv)
+    try:
+        report = build_report(args.work_dir, trace=args.trace)
+    except FileNotFoundError as exc:
+        print(exc)
+        return 1
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(render_report(report), end='')
+    return 0

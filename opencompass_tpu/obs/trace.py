@@ -1,0 +1,306 @@
+"""Span-based tracing with an append-only JSONL sink.
+
+The run driver owns a ``Tracer`` writing ``{work_dir}/obs/events.jsonl``;
+every subprocess task appends to the *same* file (single-line appends,
+``O_APPEND`` semantics) so one run produces one event stream.  Trace/span
+ids cross the process boundary via env vars (``OCT_TRACE_ID``,
+``OCT_PARENT_SPAN``, ``OCT_OBS_DIR``) so a task launched by ``LocalRunner``
+nests under the runner's span.
+
+Contract (same as ``TaskProfiler``): observability must never fail a task —
+every sink write is exception-guarded, and the disabled path is a
+``NoopTracer`` whose methods do nothing, so hot loops only ever pay a
+single ``tracer.enabled`` attribute check.
+
+Event schema — versioned, one JSON object per line (``docs/observability.md``
+documents it field-by-field)::
+
+    {"v": 1, "kind": "span_start"|"span_end"|"event"|"metrics",
+     "ts": <unix seconds>, "trace": <hex>, "span": <hex>, "parent": <hex|null>,
+     "name": <str>, "pid": <int>,
+     # span_end only:
+     "dur": <seconds>, "status": "ok"|"error", "error": <str, on error>,
+     "attrs": {<free-form JSON-safe attributes>}}
+"""
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import os.path as osp
+import secrets
+import threading
+import time
+from typing import Dict, Optional
+
+from opencompass_tpu.obs.metrics import MetricsRegistry
+
+SCHEMA_VERSION = 1
+
+ENV_TRACE_ID = 'OCT_TRACE_ID'
+ENV_PARENT_SPAN = 'OCT_PARENT_SPAN'
+ENV_OBS_DIR = 'OCT_OBS_DIR'
+
+# per-thread/-context current span for automatic in-process nesting;
+# cross-thread parents (the runner's pool workers) are passed explicitly
+_CURRENT_SPAN: contextvars.ContextVar = contextvars.ContextVar(
+    'oct_current_span', default=None)
+
+_UNSET = object()
+
+
+def _new_id() -> str:
+    return secrets.token_hex(8)
+
+
+def _json_safe(obj):
+    """Best-effort conversion so attrs never kill a sink write."""
+    try:
+        json.dumps(obj)
+        return obj
+    except (TypeError, ValueError):
+        return str(obj)
+
+
+class _JsonlSink:
+    """Append-only, thread-safe, flush-per-line JSONL writer."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(osp.dirname(osp.abspath(path)), exist_ok=True)
+        self._lock = threading.Lock()
+        self._fh = open(path, 'a', encoding='utf-8')
+
+    def write(self, record: Dict):
+        try:
+            line = json.dumps(record, separators=(',', ':'),
+                              default=str) + '\n'
+            with self._lock:
+                self._fh.write(line)
+                self._fh.flush()
+        except Exception:
+            pass  # never fail the task for an event
+
+    def close(self):
+        try:
+            with self._lock:
+                self._fh.close()
+        except Exception:
+            pass
+
+
+class Span:
+    """One traced operation: emits ``span_start`` on enter and ``span_end``
+    (with duration + ok/error status) on exit.  Usable as a context
+    manager; ``set_attrs`` adds attributes that ride on the end event."""
+
+    __slots__ = ('tracer', 'name', 'span_id', 'parent_id', 'attrs',
+                 '_t0', '_wall0', '_token')
+
+    def __init__(self, tracer: 'Tracer', name: str,
+                 parent_id: Optional[str], attrs: Dict):
+        self.tracer = tracer
+        self.name = name
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.attrs = {k: _json_safe(v) for k, v in attrs.items()}
+        self._t0 = None
+        self._wall0 = None
+        self._token = None
+
+    def set_attrs(self, **attrs):
+        for k, v in attrs.items():
+            self.attrs[k] = _json_safe(v)
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._wall0 = time.time()
+        self._token = _CURRENT_SPAN.set(self)
+        self.tracer._emit('span_start', name=self.name, span=self.span_id,
+                          parent=self.parent_id, ts=self._wall0,
+                          attrs=self.attrs or None)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._token is not None:
+            try:
+                _CURRENT_SPAN.reset(self._token)
+            except ValueError:
+                pass  # exited in a different context; nesting only degrades
+        rec = dict(name=self.name, span=self.span_id, parent=self.parent_id,
+                   dur=round(time.perf_counter() - self._t0, 6),
+                   status='error' if exc_type is not None else 'ok',
+                   attrs=self.attrs or None)
+        if exc_type is not None:
+            rec['error'] = f'{exc_type.__name__}: {exc}'
+        self.tracer._emit('span_end', **rec)
+        return False
+
+
+class _NoopMetric:
+    __slots__ = ()
+
+    def inc(self, n=1):
+        pass
+
+    def set(self, value):
+        pass
+
+    def observe(self, value):
+        pass
+
+
+class _NoopSpan:
+    __slots__ = ()
+    span_id = None
+
+    def set_attrs(self, **attrs):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+_NOOP_METRIC = _NoopMetric()
+_NOOP_SPAN = _NoopSpan()
+
+
+class NoopTracer:
+    """The default, disabled tracer: every operation is a cheap no-op, so
+    instrumented code can call it unconditionally.  Hot loops should still
+    guard non-trivial measurement work behind ``tracer.enabled``."""
+
+    enabled = False
+    trace_id = None
+
+    def span(self, name, parent=_UNSET, **attrs):
+        return _NOOP_SPAN
+
+    def event(self, name, **attrs):
+        pass
+
+    def counter(self, name):
+        return _NOOP_METRIC
+
+    def gauge(self, name):
+        return _NOOP_METRIC
+
+    def histogram(self, name, buckets=None):
+        return _NOOP_METRIC
+
+    def propagation_env(self, span=None) -> Dict[str, str]:
+        return {}
+
+    def flush_metrics(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class Tracer:
+    """Enabled tracer bound to one run's ``obs/`` directory.
+
+    Args:
+        obs_dir: directory holding ``events.jsonl`` (created on demand).
+        trace_id: run-wide id; generated when absent, inherited from
+            ``OCT_TRACE_ID`` in subprocess tasks.
+        default_parent: span id adopted by root spans of this process
+            (``OCT_PARENT_SPAN`` across the process boundary).
+    """
+
+    enabled = True
+
+    def __init__(self, obs_dir: str, trace_id: Optional[str] = None,
+                 default_parent: Optional[str] = None):
+        self.obs_dir = obs_dir
+        self.events_path = osp.join(obs_dir, 'events.jsonl')
+        self.trace_id = trace_id or _new_id()
+        self.default_parent = default_parent
+        self.metrics = MetricsRegistry()
+        self._sink = _JsonlSink(self.events_path)
+        self._pid = os.getpid()
+        # unique per tracer instance: pids recycle over a long run, and
+        # the report dedupes cumulative metrics snapshots per process
+        self._proc_token = _new_id()
+
+    # -- spans / events ----------------------------------------------------
+
+    def span(self, name: str, parent=_UNSET, **attrs) -> Span:
+        """Open a span.  ``parent`` accepts a Span, a span-id string, or
+        ``None`` (explicit root); when omitted the current context's span
+        (or this process's ``default_parent``) is used."""
+        if parent is _UNSET:
+            cur = _CURRENT_SPAN.get()
+            parent_id = cur.span_id if cur is not None \
+                else self.default_parent
+        elif isinstance(parent, Span):
+            parent_id = parent.span_id
+        elif isinstance(parent, _NoopSpan):
+            parent_id = self.default_parent
+        else:
+            parent_id = parent
+        return Span(self, name, parent_id, attrs)
+
+    def event(self, name: str, **attrs):
+        """A point-in-time structured event under the current span."""
+        cur = _CURRENT_SPAN.get()
+        self._emit('event', name=name,
+                   span=cur.span_id if cur is not None else None,
+                   attrs={k: _json_safe(v)
+                          for k, v in attrs.items()} or None)
+
+    def _emit(self, kind: str, ts: Optional[float] = None, **fields):
+        rec = {'v': SCHEMA_VERSION, 'kind': kind,
+               'ts': round(ts if ts is not None else time.time(), 6),
+               'trace': self.trace_id, 'pid': self._pid}
+        rec.update((k, v) for k, v in fields.items() if v is not None)
+        self._sink.write(rec)
+
+    # -- metrics -----------------------------------------------------------
+
+    def counter(self, name):
+        return self.metrics.counter(name)
+
+    def gauge(self, name):
+        return self.metrics.gauge(name)
+
+    def histogram(self, name, buckets=None):
+        return self.metrics.histogram(name, buckets=buckets)
+
+    def flush_metrics(self):
+        """Write one ``metrics`` event with the registry snapshot (no-op
+        when nothing was recorded)."""
+        snap = self.metrics.snapshot()
+        if any(snap.values()):
+            self._emit('metrics', name='metrics', proc=self._proc_token,
+                       attrs=snap)
+
+    # -- cross-process propagation -----------------------------------------
+
+    def propagation_env(self, span=None) -> Dict[str, str]:
+        """Env vars that make a subprocess task's spans nest under
+        ``span`` (default: this process's current/ default parent)."""
+        if isinstance(span, Span):
+            parent = span.span_id
+        elif isinstance(span, str):
+            parent = span
+        else:
+            cur = _CURRENT_SPAN.get()
+            parent = cur.span_id if cur is not None else self.default_parent
+        env = {ENV_TRACE_ID: self.trace_id,
+               ENV_OBS_DIR: osp.abspath(self.obs_dir)}
+        if parent:
+            env[ENV_PARENT_SPAN] = parent
+        return env
+
+    def close(self):
+        self.flush_metrics()
+        self._sink.close()
+
+
+def current_span() -> Optional[Span]:
+    return _CURRENT_SPAN.get()

@@ -29,11 +29,18 @@ class BasePartitioner:
         datasets = cfg['datasets']
         work_dir = cfg['work_dir']
         tasks = self.partition(models, datasets, work_dir, self.out_dir)
-        # shared run-level switches every task inherits
-        for key in ('profile',):
+        # shared run-level switches every task inherits ('obs' rides along
+        # so subprocess tasks re-enable tracing from their own config)
+        for key in ('profile', 'obs'):
             if key in cfg:
                 for task in tasks:
                     task[key] = cfg[key]
+        from opencompass_tpu.obs import get_tracer
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.counter('partitioner.tasks').inc(len(tasks))
+            tracer.event('partitioned', n_tasks=len(tasks),
+                         partitioner=type(self).__name__)
         self.logger.info(f'Partitioned into {len(tasks)} tasks.')
         for i, task in enumerate(tasks):
             self.logger.debug(f'Task {i}: {task}')

@@ -14,6 +14,7 @@ from abc import abstractmethod
 from typing import Any, Dict, List, Optional, Tuple
 
 from opencompass_tpu.config import ConfigDict
+from opencompass_tpu.obs import get_tracer
 from opencompass_tpu.registry import TASKS
 from opencompass_tpu.utils.logging import get_logger
 from opencompass_tpu.utils.notify import LarkReporter
@@ -36,7 +37,21 @@ class BaseRunner:
         self.reporter = LarkReporter(lark_bot_url) if lark_bot_url else None
 
     def __call__(self, tasks: List[Dict]):
-        status = self.launch(tasks)
+        tracer = get_tracer()
+        task_type = self.task_cfg.get('type')
+        type_name = task_type if isinstance(task_type, str) \
+            else getattr(task_type, '__name__', str(task_type))
+        # the runner span is the parent every launched task nests under
+        # (pool threads and subprocesses reference it explicitly — see
+        # LocalRunner._launch / Tracer.propagation_env)
+        with tracer.span(f'runner:{type_name}', n_tasks=len(tasks)) as sp:
+            self._runner_span = sp
+            try:
+                status = self.launch(tasks)
+                sp.set_attrs(n_failed=sum(1 for _, code in status
+                                          if code != 0))
+            finally:
+                self._runner_span = None
         self.summarize(status)
         return status
 
@@ -69,22 +84,42 @@ class BaseRunner:
         """Run ``cmd``, re-submitting while it fails the completion contract:
         exit ≠ 0 *or* any expected output file missing (a cluster job can
         "succeed" while preemption ate the work — reference
-        runners/slurm.py:127-148, dlc.py:135-145)."""
+        runners/slurm.py:127-148, dlc.py:135-145).
+
+        Traced runs get a ``task:`` span plus OCT_* propagation env here,
+        so cluster runners (slurm/cloud) nest their subprocess tasks the
+        same way LocalRunner does."""
+        tracer = get_tracer()
         log_path = task.get_log_path('out')
         os.makedirs(osp.dirname(log_path), exist_ok=True)
         returncode = 1
-        for attempt in range(retry + 1):
-            with open(log_path, log_mode) as log_file:
-                result = subprocess.run(cmd, shell=True, text=True,
-                                        stdout=log_file,
-                                        stderr=subprocess.STDOUT, env=env)
-            returncode = result.returncode
-            if not self.job_failed(returncode, task):
-                return 0
-            self.logger.warning(
-                f'{task.name} attempt {attempt + 1} failed '
-                f'(code {returncode}); retrying')
-        return returncode or 1
+        with tracer.span(f'task:{task.name}',
+                         parent=getattr(self, '_runner_span', None),
+                         num_devices=task.num_devices) as span:
+            if tracer.enabled:
+                env = dict(env if env is not None else os.environ)
+                env.update(tracer.propagation_env(span))
+            for attempt in range(retry + 1):
+                if attempt:
+                    tracer.event('task_retry', task=task.name,
+                                 attempt=attempt)
+                    tracer.counter('runner.task_retries').inc()
+                    span.set_attrs(retries=attempt)
+                with open(log_path, log_mode) as log_file:
+                    result = subprocess.run(cmd, shell=True, text=True,
+                                            stdout=log_file,
+                                            stderr=subprocess.STDOUT,
+                                            env=env)
+                returncode = result.returncode
+                if not self.job_failed(returncode, task):
+                    span.set_attrs(returncode=0)
+                    return 0
+                self.logger.warning(
+                    f'{task.name} attempt {attempt + 1} failed '
+                    f'(code {returncode}); retrying')
+            returncode = returncode or 1
+            span.set_attrs(returncode=returncode)
+        return returncode
 
     @staticmethod
     def job_failed(returncode: int, task) -> bool:
@@ -93,6 +128,8 @@ class BaseRunner:
 
     def summarize(self, status: List[Tuple[str, int]]):
         failed = [name for name, code in status if code != 0]
+        if failed:
+            get_tracer().counter('runner.task_failures').inc(len(failed))
         for name in failed:
             self.logger.error(f'{name} failed with code '
                               f'{dict(status)[name]}')

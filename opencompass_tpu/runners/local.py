@@ -19,6 +19,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Tuple
 
+from opencompass_tpu.obs import get_tracer
 from opencompass_tpu.registry import RUNNERS
 from opencompass_tpu.utils.abbr import task_abbr_from_cfg
 
@@ -103,30 +104,47 @@ class LocalRunner(BaseRunner):
     # -- per-task launch ---------------------------------------------------
 
     def _launch(self, task_cfg: Dict) -> Tuple[str, int]:
+        tracer = get_tracer()
         task = self.build_task(task_cfg)
         name = task.name
+        wait0 = time.perf_counter()
         chip_ids = self._acquire_slots(task.num_devices)
+        slot_wait = time.perf_counter() - wait0
+        # only chip-holding tasks feed the contention histogram: eval
+        # tasks (num_devices=0) acquire instantly and would bury the
+        # real waits under a pile of ~0s samples
+        if tracer.enabled and task.num_devices:
+            tracer.histogram('runner.slot_wait_seconds').observe(slot_wait)
         returncode = 1  # dump/get_command failures must not mask as success
-        try:
-            tmp = tempfile.NamedTemporaryFile(
-                mode='w', suffix='_params.py', delete=False)
+        # explicit parent: this runs on a pool thread, where the runner
+        # span's contextvar is invisible
+        with tracer.span(f'task:{name}',
+                         parent=getattr(self, '_runner_span', None),
+                         devices=chip_ids,
+                         num_devices_host=self.num_devices,
+                         slot_wait_seconds=round(slot_wait, 3)) as span:
             try:
-                task.cfg.dump(tmp.name)
-                returncode = self._run_task(task, name, tmp.name, chip_ids)
+                tmp = tempfile.NamedTemporaryFile(
+                    mode='w', suffix='_params.py', delete=False)
+                try:
+                    task.cfg.dump(tmp.name)
+                    returncode = self._run_task(task, name, tmp.name,
+                                                chip_ids, span)
+                finally:
+                    if self.keep_tmp_file:
+                        self.logger.info(f'task cfg kept at {tmp.name}')
+                    else:
+                        os.unlink(tmp.name)
+            except Exception:
+                # one bad task must not crash the pool and its siblings
+                self.logger.exception(f'task {name} failed to launch')
             finally:
-                if self.keep_tmp_file:
-                    self.logger.info(f'task cfg kept at {tmp.name}')
-                else:
-                    os.unlink(tmp.name)
-        except Exception:
-            # one bad task must not crash the pool and its sibling tasks
-            self.logger.exception(f'task {name} failed to launch')
-        finally:
-            self._release_slots(chip_ids)
+                self._release_slots(chip_ids)
+            span.set_attrs(returncode=returncode)
         return name, returncode
 
     def _run_task(self, task, name: str, cfg_path: str,
-                  chip_ids: List[int]) -> int:
+                  chip_ids: List[int], span=None) -> int:
         cmd = task.get_command(cfg_path=cfg_path, template='{task_cmd}')
         env = dict(os.environ)
         # make the package importable from any cwd
@@ -147,9 +165,20 @@ class LocalRunner(BaseRunner):
             # CPU-only task: never contend for the exclusive chip
             env['JAX_PLATFORMS'] = 'cpu'
             env.pop('PALLAS_AXON_POOL_IPS', None)
+        tracer = get_tracer()
+        if tracer.enabled:
+            # the subprocess task resumes this trace (OCT_* env vars) so
+            # its spans nest under the runner-side task span
+            env.update(tracer.propagation_env(span))
         log_path = task.get_log_path('out')
         os.makedirs(osp.dirname(log_path), exist_ok=True)
         for attempt in range(self.retry + 1):
+            if attempt:
+                # structured relaunch event: the trace report counts these
+                tracer.event('task_retry', task=name, attempt=attempt)
+                tracer.counter('runner.task_retries').inc()
+                if span is not None:
+                    span.set_attrs(retries=attempt)
             self.logger.info(f'launch {name} (devices={chip_ids}'
                              + (f', attempt {attempt + 1}' if attempt
                                 else '') + ')')
@@ -160,6 +189,8 @@ class LocalRunner(BaseRunner):
             if returncode == 0 and missing:
                 self.logger.warning(
                     f'{name}: exit 0 but outputs missing: {missing[:3]}')
+                tracer.event('task_outputs_missing', task=name,
+                             missing=missing[:3])
                 returncode = 1
             if returncode == 0:
                 return 0
@@ -221,6 +252,11 @@ class LocalRunner(BaseRunner):
                         self.logger.error(
                             f'{name}: killed after '
                             f'{self.task_timeout:.0f}s wall-clock timeout')
+                        tracer = get_tracer()
+                        tracer.event('task_timeout', task=name,
+                                     timeout_seconds=self.task_timeout,
+                                     attempt=attempt)
+                        tracer.counter('runner.task_timeouts').inc()
                         kill_tree()
                         return -9
                     if self.stall_timeout:
@@ -234,6 +270,12 @@ class LocalRunner(BaseRunner):
                             self.logger.error(
                                 f'{name}: killed — log stalled for '
                                 f'{self.stall_timeout:.0f}s')
+                            tracer = get_tracer()
+                            tracer.event(
+                                'stall_timeout', task=name,
+                                stall_seconds=self.stall_timeout,
+                                attempt=attempt)
+                            tracer.counter('runner.stall_timeouts').inc()
                             kill_tree()
                             return -9
             except BaseException:

@@ -5,8 +5,10 @@ module directly via ``-m`` would execute it twice: once as a package import,
 once as ``__main__``, re-registering its class).
 """
 import argparse
+import os
 import time
 
+from opencompass_tpu import obs
 from opencompass_tpu.config import Config
 from opencompass_tpu.parallel.distributed import init_from_env, shutdown
 from opencompass_tpu.registry import TASKS
@@ -25,13 +27,21 @@ def main():
     if cls is None:
         raise SystemExit(f'unknown task type {args.task_type!r}')
     cfg = Config.fromfile(args.config)
+    # resume the run's trace across the process boundary (OCT_* env vars
+    # injected by the runner; no-op when the run is not traced)
+    tracer = obs.init_task_obs(cfg)
     task = cls(cfg)
     logger.info(f'Task {task.name}')
     start = time.time()
     try:
-        task.run()
+        with tracer.span(f'proc:{args.task_type}', task=task.name,
+                         pid=os.getpid()):
+            try:
+                task.run()
+            finally:
+                shutdown()
     finally:
-        shutdown()
+        tracer.close()
     logger.info(f'time elapsed: {time.time() - start:.2f}s')
 
 

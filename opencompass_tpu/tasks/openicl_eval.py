@@ -12,9 +12,12 @@ import os
 import os.path as osp
 from typing import Dict, List, Optional
 
+from opencompass_tpu.obs import get_tracer
 from opencompass_tpu.registry import (ICL_EVALUATORS, TASKS,
                                       TEXT_POSTPROCESSORS)
-from opencompass_tpu.utils.abbr import get_infer_output_path
+from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,
+                                        get_infer_output_path,
+                                        model_abbr_from_cfg)
 from opencompass_tpu.utils.build import build_dataset_from_cfg
 from opencompass_tpu.utils.logging import get_logger
 
@@ -71,6 +74,7 @@ class OpenICLEvalTask(BaseTask):
         return template.format(task_cmd=task_cmd)
 
     def run(self):
+        tracer = get_tracer()
         for i, model_cfg in enumerate(self.model_cfgs):
             for dataset_cfg in self.dataset_cfgs[i]:
                 self.model_cfg = model_cfg
@@ -78,12 +82,17 @@ class OpenICLEvalTask(BaseTask):
                 self.eval_cfg = dataset_cfg.get('eval_cfg', {})
                 self.output_column = dataset_cfg['reader_cfg'][
                     'output_column']
+                m_abbr = model_abbr_from_cfg(model_cfg)
+                d_abbr = dataset_abbr_from_cfg(dataset_cfg)
                 out_path = get_infer_output_path(
                     model_cfg, dataset_cfg,
                     osp.join(self.work_dir, 'results'))
                 if osp.exists(out_path):
+                    tracer.event('eval_skip', model=m_abbr, dataset=d_abbr)
                     continue
-                self._score(out_path)
+                with tracer.span(f'eval:{m_abbr}/{d_abbr}') as span:
+                    self._score(out_path)
+                    span.set_attrs(scored=osp.exists(out_path))
 
     def _load_predictions(self) -> Optional[List[Dict]]:
         """Prediction records in index order, stitching `_k` shards."""

@@ -13,11 +13,14 @@ from __future__ import annotations
 import os.path as osp
 from typing import Any, Dict
 
+from opencompass_tpu.obs import device_memory_attrs, get_tracer
 from opencompass_tpu.parallel.distributed import (broadcast_object,
                                                   is_main_process)
 from opencompass_tpu.registry import (ICL_INFERENCERS, ICL_PROMPT_TEMPLATES,
                                       ICL_RETRIEVERS, TASKS)
-from opencompass_tpu.utils.abbr import get_infer_output_path
+from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,
+                                        get_infer_output_path,
+                                        model_abbr_from_cfg)
 from opencompass_tpu.utils.build import (build_dataset_from_cfg,
                                          build_model_from_cfg)
 from opencompass_tpu.utils.logging import get_logger
@@ -47,6 +50,7 @@ class OpenICLInferTask(BaseTask):
         return template.format(task_cmd=task_cmd)
 
     def run(self):
+        tracer = get_tracer()
         for i, model_cfg in enumerate(self.model_cfgs):
             self.max_out_len = model_cfg.get('max_out_len')
             self.batch_size = model_cfg.get('batch_size', 1)
@@ -57,6 +61,8 @@ class OpenICLInferTask(BaseTask):
                 self.model_cfg = model_cfg
                 self.dataset_cfg = dataset_cfg
                 self.infer_cfg = dataset_cfg['infer_cfg']
+                m_abbr = model_abbr_from_cfg(model_cfg)
+                d_abbr = dataset_abbr_from_cfg(dataset_cfg)
                 out_path = get_infer_output_path(
                     model_cfg, dataset_cfg,
                     osp.join(self.work_dir, 'predictions'))
@@ -64,6 +70,8 @@ class OpenICLInferTask(BaseTask):
                 # multi-host group takes the same skip decision
                 if broadcast_object(osp.exists(out_path)
                                     if is_main_process() else None):
+                    tracer.event('infer_skip', model=m_abbr,
+                                 dataset=d_abbr)
                     continue
                 perf_path = trace_dir = None
                 if is_main_process():
@@ -71,14 +79,30 @@ class OpenICLInferTask(BaseTask):
                         model_cfg, dataset_cfg,
                         osp.join(self.work_dir, 'perf'))
                     if self.cfg.get('profile'):
-                        from opencompass_tpu.utils.abbr import (
-                            dataset_abbr_from_cfg, model_abbr_from_cfg)
                         trace_dir = osp.join(
-                            self.work_dir, 'profile',
-                            model_abbr_from_cfg(model_cfg),
-                            dataset_abbr_from_cfg(dataset_cfg))
-                with TaskProfiler(model, perf_path, trace_dir) as prof:
-                    self._inference(model, out_path)
+                            self.work_dir, 'profile', m_abbr, d_abbr)
+                with tracer.span(f'infer:{m_abbr}/{d_abbr}') as span:
+                    prof = TaskProfiler(model, perf_path, trace_dir)
+                    try:
+                        with prof:
+                            self._inference(model, out_path)
+                    finally:
+                        # attach even when _inference raised: the failed
+                        # task's compile/device time must reach the trace
+                        # report (TaskProfiler.__exit__ always builds the
+                        # record, with 'error' on failure)
+                        if prof.record:
+                            # the span-local counter backend: the trace
+                            # report reads compile/device attribution here
+                            span.set_attrs(perf=prof.record)
+                        if tracer.enabled:
+                            mem = device_memory_attrs()
+                            if mem:
+                                span.set_attrs(device_memory=mem)
+                                if 'peak_bytes_in_use' in mem:
+                                    tracer.gauge(
+                                        'device.peak_bytes_in_use').set(
+                                            mem['peak_bytes_in_use'])
                 if prof.record and is_main_process():
                     logger.info(
                         f'perf: {prof.record.get("samples_per_sec", "?")} '

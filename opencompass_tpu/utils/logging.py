@@ -27,7 +27,11 @@ def _process_index() -> int:
     return 0
 
 
-def get_logger(level=logging.INFO) -> logging.Logger:
+def get_logger(level: Optional[int] = None) -> logging.Logger:
+    """The process-wide logger.  ``level`` is applied on *every* call that
+    passes one explicitly (the old singleton silently ignored it after the
+    first call); omit it to leave the configured level untouched.  Non-zero
+    JAX processes stay pinned to ERROR regardless."""
     global _LOGGER
     if _LOGGER is None:
         logger = logging.getLogger('opencompass_tpu')
@@ -35,6 +39,40 @@ def get_logger(level=logging.INFO) -> logging.Logger:
         handler = logging.StreamHandler(sys.stdout)
         handler.setFormatter(logging.Formatter(LOG_FORMAT))
         logger.addHandler(handler)
-        logger.setLevel(level if _process_index() == 0 else logging.ERROR)
+        logger.setLevel(logging.INFO if _process_index() == 0
+                        else logging.ERROR)
         _LOGGER = logger
+    if level is not None and _process_index() == 0:
+        _LOGGER.setLevel(level)
     return _LOGGER
+
+
+def add_file_handler(work_dir: str,
+                     filename: str = 'driver.log') -> Optional[str]:
+    """Attach a per-run file handler writing ``{work_dir}/logs/{filename}``
+    so rank-0 logs survive the terminal.  Idempotent per path; a handler
+    from a *previous* run dir is detached first (a second ``cli.main()``
+    in one process must not bleed its lines into the first run's log).
+    Non-zero ranks are a no-op.  Returns the log path (None when
+    skipped)."""
+    if _process_index() != 0:
+        return None
+    logger = get_logger()
+    path = os.path.abspath(os.path.join(work_dir, 'logs', filename))
+    for h in list(logger.handlers):
+        if not getattr(h, '_oct_run_handler', False):
+            continue
+        if getattr(h, 'baseFilename', None) == path:
+            return path
+        logger.removeHandler(h)
+        h.close()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        handler = logging.FileHandler(path)
+    except OSError as exc:  # a read-only work_dir must not kill the run
+        logger.warning(f'file logging unavailable: {exc}')
+        return None
+    handler.setFormatter(logging.Formatter(LOG_FORMAT))
+    handler._oct_run_handler = True
+    logger.addHandler(handler)
+    return path

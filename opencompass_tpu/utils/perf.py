@@ -11,6 +11,11 @@ exceed-the-reference axis here.  Three layers:
   (viewable in XProf/TensorBoard), and writes a ``perf`` JSON next to the
   predictions for the Summarizer to surface.
 - ``run.py --profile`` / config key ``profile = True`` turns traces on.
+
+These counters double as the span-local backend of the run-wide obs
+subsystem (``opencompass_tpu/obs/``): with ``--obs`` the infer task
+attaches each TaskProfiler record to its span, so the trace report can
+split per-task time into wait/compile/device.
 """
 from __future__ import annotations
 
@@ -33,19 +38,27 @@ class PerfCounters:
     samples: int = 0         # rows scored/generated (incl. pad rows: real)
     device_seconds: float = 0.0  # time blocked on dispatch+device
     calls: int = 0           # jitted calls (compile included on first)
+    # first-call-vs-steady split: a call whose (fn, shape) was never seen
+    # before pays XLA compilation; its whole duration lands here too, so
+    # device_seconds - compile_seconds approximates steady-state device
+    # time (the obs trace report's compile attribution column)
+    compile_seconds: float = 0.0
+    first_calls: int = 0
 
     def snapshot(self) -> dict:
         return dataclasses.asdict(self)
 
     def delta_since(self, snap: dict) -> dict:
         now = self.snapshot()
-        return {k: now[k] - snap[k] for k in now}
+        return {k: now[k] - snap.get(k, 0) for k in now}
 
 
 @contextlib.contextmanager
 def device_call(counters: Optional[PerfCounters], tokens_in: int = 0,
-                tokens_out: int = 0, samples: int = 0):
-    """Time one device call and add token/sample counts."""
+                tokens_out: int = 0, samples: int = 0,
+                first: bool = False):
+    """Time one device call and add token/sample counts.  ``first`` marks
+    a call expected to trigger compilation (unseen fn/shape bucket)."""
     if counters is None:
         yield
         return
@@ -53,11 +66,15 @@ def device_call(counters: Optional[PerfCounters], tokens_in: int = 0,
     try:
         yield
     finally:
-        counters.device_seconds += time.perf_counter() - t0
+        elapsed = time.perf_counter() - t0
+        counters.device_seconds += elapsed
         counters.tokens_in += tokens_in
         counters.tokens_out += tokens_out
         counters.samples += samples
         counters.calls += 1
+        if first:
+            counters.compile_seconds += elapsed
+            counters.first_calls += 1
 
 
 class TaskProfiler:
@@ -110,6 +127,8 @@ class TaskProfiler:
                 tokens_in=d['tokens_in'],
                 tokens_out=d['tokens_out'],
                 device_seconds=round(d['device_seconds'], 3),
+                compile_seconds=round(d['compile_seconds'], 3),
+                first_calls=d['first_calls'],
                 device_calls=d['calls'],
                 samples_per_sec=round(d['samples'] / wall, 3) if wall else 0,
                 tokens_per_sec=round(
@@ -120,10 +139,18 @@ class TaskProfiler:
             )
         if self.trace_dir and self._trace_active:
             record['trace_dir'] = self.trace_dir
+        # a failed task's perf record must survive too (with the error
+        # attached) — otherwise failures vanish from the summarizer's
+        # perf table and the obs trace report
+        if exc_type is not None:
+            record['error'] = f'{exc_type.__name__}: {exc}'
         self.record = record
-        if self.out_path and exc_type is None:
-            os.makedirs(os.path.dirname(os.path.abspath(self.out_path)),
-                        exist_ok=True)
-            with open(self.out_path, 'w') as f:
-                json.dump(record, f, indent=2)
+        if self.out_path:
+            try:
+                os.makedirs(os.path.dirname(os.path.abspath(self.out_path)),
+                            exist_ok=True)
+                with open(self.out_path, 'w') as f:
+                    json.dump(record, f, indent=2)
+            except Exception as write_exc:  # never mask the task's outcome
+                logger.warning(f'perf record write failed: {write_exc}')
         return False

@@ -88,6 +88,22 @@ class Summarizer:
         return perf
 
     @staticmethod
+    def _obs_summary(work_dir: str) -> Optional[str]:
+        """Top-level trace-report numbers (``opencompass_tpu/obs``) when
+        the run was traced; None otherwise.  Must never fail the summary."""
+        if not osp.exists(osp.join(work_dir, 'obs', 'events.jsonl')):
+            return None
+        try:
+            from opencompass_tpu.obs.report import (build_report,
+                                                    render_summary)
+            text = render_summary(build_report(work_dir))
+            return text + ('\n(full report: python -m opencompass_tpu.cli '
+                           f'trace {work_dir})')
+        except Exception as exc:
+            get_logger().warning(f'obs summary unavailable: {exc}')
+            return None
+
+    @staticmethod
     def _primary_metric(result: Dict) -> Optional[str]:
         for metric in METRIC_WHITELIST:
             if metric in result:
@@ -192,20 +208,33 @@ class Summarizer:
         perf_rows = []
         if perf:
             perf_rows = [['dataset', 'model', 'samples/s', 'tokens/s',
-                          'device_util', 'wall_s']]
+                          'device_util', 'compile_s', 'wall_s', 'error']]
             for d_abbr in dataset_abbrs:
                 for m_abbr in model_abbrs:
                     rec = perf.get(m_abbr, {}).get(d_abbr)
                     if not rec:
                         continue
+                    err = rec.get('error', '-')
                     perf_rows.append([
                         d_abbr, m_abbr,
                         rec.get('samples_per_sec', '-'),
                         rec.get('tokens_per_sec', '-'),
                         rec.get('device_utilization', '-'),
-                        rec.get('wall_seconds', '-')])
+                        rec.get('compile_seconds', '-'),
+                        rec.get('wall_seconds', '-'),
+                        err if len(str(err)) <= 40 else str(err)[:37]
+                        + '...'])
             if len(perf_rows) > 1:
                 table += '\n\nperf:\n' + self._render(perf_rows)
+
+        # obs section: run-wide tracing summary next to accuracy — gated
+        # on THIS run's obs flag, not bare file existence: a resume (-r)
+        # without --obs must not relabel a previous attempt's events as
+        # this run's numbers
+        obs_text = self._obs_summary(work_dir=self.cfg['work_dir']) \
+            if self.cfg.get('obs') else None
+        if obs_text:
+            table += '\n\nobs:\n' + obs_text
 
         work_dir = self.cfg['work_dir']
         out_dir = osp.join(work_dir, 'summary')
@@ -252,6 +281,12 @@ class Summarizer:
                 f.write('perf format\n')
                 f.write('^' * 128 + '\n')
                 f.write(self._render(perf_rows) + '\n')
+                f.write('$' * 128 + '\n')
+            if obs_text:
+                f.write(divider)
+                f.write('obs format\n')
+                f.write('^' * 128 + '\n')
+                f.write(obs_text + '\n')
                 f.write('$' * 128 + '\n')
         # summary_*.csv is EXACTLY the reference's table (no perf rows);
         # the perf table gets its own csv beside it

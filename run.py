@@ -4,6 +4,9 @@
 The driver itself lives in the package so the installed console script
 (``opencompass-tpu``, see pyproject.toml) and this in-repo entry point
 share one implementation.  Parity: reference run.py:15-319.
+
+``python run.py <cfg> --obs`` traces the run (see docs/observability.md);
+``python run.py trace <work_dir>`` renders the trace report.
 """
 import os
 import sys

@@ -1,0 +1,51 @@
+"""Logger singleton fixes: explicit level honored on every call, per-run
+file handler."""
+import logging
+
+from opencompass_tpu.utils.logging import add_file_handler, get_logger
+
+
+def test_get_logger_level_honored_after_first_call():
+    logger = get_logger()
+    original = logger.level
+    try:
+        assert get_logger(logging.DEBUG).level == logging.DEBUG
+        # the old singleton ignored this second explicit level
+        assert get_logger(logging.WARNING).level == logging.WARNING
+        # level-less calls leave the configured level untouched
+        assert get_logger().level == logging.WARNING
+    finally:
+        logger.setLevel(original)
+
+
+def test_add_file_handler_writes_driver_log(tmp_path):
+    logger = get_logger()
+    path = add_file_handler(str(tmp_path))
+    try:
+        assert path and path.endswith('logs/driver.log')
+        # idempotent: re-adding the same path attaches no second handler
+        assert add_file_handler(str(tmp_path)) == path
+        n_file_handlers = sum(
+            isinstance(h, logging.FileHandler)
+            and getattr(h, 'baseFilename', None) == path
+            for h in logger.handlers)
+        assert n_file_handlers == 1
+        logger.warning('hello-from-test')
+        with open(path) as f:
+            assert 'hello-from-test' in f.read()
+        # a second run dir swaps the handler: run 2's lines must not
+        # bleed into run 1's driver.log
+        path2 = add_file_handler(str(tmp_path / 'run2'))
+        assert path2 != path
+        logger.warning('second-run-line')
+        with open(path) as f:
+            assert 'second-run-line' not in f.read()
+        with open(path2) as f:
+            assert 'second-run-line' in f.read()
+        assert sum(getattr(h, '_oct_run_handler', False)
+                   for h in logger.handlers) == 1
+    finally:
+        for h in list(logger.handlers):
+            if getattr(h, '_oct_run_handler', False):
+                logger.removeHandler(h)
+                h.close()

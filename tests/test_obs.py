@@ -1,0 +1,401 @@
+"""Obs subsystem: span JSONL format, cross-process propagation, metrics,
+no-op overhead, trace report, CLI smoke, and the FakeModel e2e run."""
+import json
+import os
+import os.path as osp
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+FIXTURE_RUN = osp.join(REPO, 'tests', 'fixtures', 'obs_run')
+
+
+@pytest.fixture(autouse=True)
+def _isolated_tracer():
+    """Each test starts and ends on the NoopTracer."""
+    from opencompass_tpu import obs
+    obs.reset_obs()
+    yield
+    obs.reset_obs()
+
+
+def _read_events(work_dir):
+    path = osp.join(work_dir, 'obs', 'events.jsonl')
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# -- span / event JSONL format --------------------------------------------
+
+def test_span_jsonl_format_and_nesting(tmp_path):
+    from opencompass_tpu import obs
+    tracer = obs.init_obs(str(tmp_path))
+    with tracer.span('outer', phase='infer') as outer:
+        with tracer.span('inner') as inner:
+            inner.set_attrs(rows=3)
+        tracer.event('ping', detail='x')
+    with pytest.raises(RuntimeError):
+        with tracer.span('boom'):
+            raise RuntimeError('kaput')
+    tracer.close()
+
+    events = _read_events(str(tmp_path))
+    by_kind = {}
+    for ev in events:
+        by_kind.setdefault(ev['kind'], []).append(ev)
+        # schema invariants on every line
+        assert ev['v'] == 1
+        assert isinstance(ev['ts'], float) and ev['ts'] > 0
+        assert ev['trace'] == tracer.trace_id
+        assert isinstance(ev['pid'], int)
+    starts = {e['name']: e for e in by_kind['span_start']}
+    ends = {e['name']: e for e in by_kind['span_end']}
+    assert set(starts) == {'outer', 'inner', 'boom'}
+    # in-process nesting via contextvars
+    assert starts['inner']['parent'] == starts['outer']['span']
+    assert 'parent' not in starts['outer']
+    # attrs set mid-span ride on the end event
+    assert ends['inner']['attrs']['rows'] == 3
+    assert ends['outer']['attrs']['phase'] == 'infer'
+    assert ends['outer']['dur'] >= ends['inner']['dur'] >= 0
+    # error spans record status + exception
+    assert ends['boom']['status'] == 'error'
+    assert 'RuntimeError: kaput' in ends['boom']['error']
+    assert ends['inner']['status'] == 'ok'
+    # the ping event is attributed to the then-current span
+    (ping,) = by_kind['event']
+    assert ping['span'] == starts['outer']['span']
+    assert ping['attrs'] == {'detail': 'x'}
+
+
+def test_span_explicit_parent_for_pool_threads(tmp_path):
+    from opencompass_tpu import obs
+    tracer = obs.init_obs(str(tmp_path))
+    with tracer.span('runner') as runner_span:
+        pass
+    with tracer.span('task', parent=runner_span):
+        pass
+    with tracer.span('orphan', parent=None):
+        pass
+    tracer.close()
+    starts = {e['name']: e for e in _read_events(str(tmp_path))
+              if e['kind'] == 'span_start'}
+    assert starts['task']['parent'] == starts['runner']['span']
+    assert 'parent' not in starts['orphan']
+
+
+# -- cross-process propagation --------------------------------------------
+
+def test_env_propagation_across_subprocess(tmp_path):
+    """A real subprocess resumes the trace from OCT_* env vars and its
+    spans parent under the launcher's span — the LocalRunner contract."""
+    from opencompass_tpu import obs
+    tracer = obs.init_obs(str(tmp_path))
+    with tracer.span('task:demo') as span:
+        env = dict(os.environ, JAX_PLATFORMS='cpu',
+                   **tracer.propagation_env(span))
+        child = textwrap.dedent(f"""
+            import sys
+            sys.path.insert(0, {REPO!r})
+            from opencompass_tpu import obs
+            tracer = obs.init_task_obs({{'work_dir': 'unused'}})
+            assert tracer.enabled
+            with tracer.span('proc:child'):
+                with tracer.span('inner:child'):
+                    pass
+            tracer.close()
+        """)
+        r = subprocess.run([sys.executable, '-c', child], env=env,
+                           capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    tracer.close()
+
+    events = _read_events(str(tmp_path))
+    starts = {e['name']: e for e in events if e['kind'] == 'span_start'}
+    parent_pid = starts['task:demo']['pid']
+    child_root = starts['proc:child']
+    # same trace, different process, parent = the launcher-side span
+    assert child_root['trace'] == tracer.trace_id
+    assert child_root['pid'] != parent_pid
+    assert child_root['parent'] == starts['task:demo']['span']
+    assert starts['inner:child']['parent'] == child_root['span']
+
+
+def test_init_task_obs_disabled_without_env_or_cfg():
+    from opencompass_tpu import obs
+    for var in (obs.ENV_TRACE_ID, obs.ENV_PARENT_SPAN, obs.ENV_OBS_DIR):
+        assert var not in os.environ
+    tracer = obs.init_task_obs({'work_dir': 'unused'})
+    assert not tracer.enabled
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_histogram_bucketing():
+    from opencompass_tpu.obs import Histogram
+    h = Histogram(buckets=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.1, 0.5, 2.0, 99.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap['buckets'] == [0.1, 1.0, 10.0]
+    # cumulative-upper-bound semantics: 0.05 and 0.1 land in <=0.1,
+    # 0.5 in <=1.0, 2.0 in <=10.0, 99.0 overflows to +Inf
+    assert snap['counts'] == [2, 1, 1, 1]
+    assert snap['count'] == 5
+    assert snap['sum'] == pytest.approx(101.65)
+
+
+def test_metrics_registry_snapshot_and_flush(tmp_path):
+    from opencompass_tpu import obs
+    tracer = obs.init_obs(str(tmp_path))
+    tracer.counter('c').inc()
+    tracer.counter('c').inc(4)
+    tracer.gauge('g').set(7)
+    tracer.gauge('g').set(3)           # max tracks the high-water
+    tracer.histogram('h').observe(0.2)
+    tracer.close()                     # flushes the registry
+    metrics = [e for e in _read_events(str(tmp_path))
+               if e['kind'] == 'metrics']
+    assert len(metrics) == 1
+    attrs = metrics[0]['attrs']
+    assert attrs['counters'] == {'c': 5}
+    assert attrs['gauges']['g'] == {'value': 3, 'max': 7}
+    assert attrs['histograms']['h']['count'] == 1
+
+
+# -- disabled path ----------------------------------------------------------
+
+def test_noop_tracer_emits_nothing(tmp_path):
+    """The enabled-off path: no obs/ dir, no events, metric and span calls
+    are inert, and the hot-loop guard is a single False attribute."""
+    from opencompass_tpu import obs
+    tracer = obs.get_tracer()
+    assert tracer.enabled is False
+    with tracer.span('x', foo=1) as sp:
+        sp.set_attrs(bar=2)
+        tracer.event('nothing')
+        tracer.counter('n').inc()
+        tracer.gauge('n').set(1)
+        tracer.histogram('n').observe(0.1)
+    tracer.flush_metrics()
+    tracer.close()
+    assert tracer.propagation_env() == {}
+    assert os.listdir(str(tmp_path)) == []
+
+
+def test_init_obs_disabled_creates_no_dir(tmp_path):
+    from opencompass_tpu import obs
+    tracer = obs.init_obs(str(tmp_path), enabled=False)
+    assert not tracer.enabled
+    assert not osp.exists(osp.join(str(tmp_path), 'obs'))
+
+
+# -- trace report (fixture, in-process) -------------------------------------
+
+def test_build_report_from_fixture():
+    from opencompass_tpu.obs.report import build_report
+    rep = build_report(FIXTURE_RUN)
+    assert rep['wall_seconds'] == pytest.approx(40.4)
+    tasks = {t['name']: t for t in rep['tasks']}
+    gen = tasks['OpenICLInfer[tiny/demo-gen]']
+    # per-task wait/compile/device breakdown from the subprocess perf attrs
+    assert gen['wait_seconds'] == 0.2
+    assert gen['compile_seconds'] == 9.0
+    assert gen['device_seconds'] == 12.5
+    assert gen['steady_device_seconds'] == pytest.approx(3.5)
+    assert gen['status'] == 'ok'
+    ppl = tasks['OpenICLInfer[tiny/demo-ppl]']
+    assert ppl['retries'] == 1 and ppl['status'] == 'error'
+    # failure/retry summary counts the structured runner events
+    assert rep['failures']['stall_timeout'] == 1
+    assert rep['failures']['task_retry'] == 1
+    assert rep['failures']['failed_tasks'] == 1
+    # critical path descends run → phase → runner → gating task
+    names = [h['name'] for h in rep['critical_path']]
+    assert names[0] == 'run'
+    assert names[-1] == 'task:OpenICLInfer[tiny/demo-ppl]'
+    # slot utilization over the 2 declared host slots
+    assert rep['slot_utilization']['num_slots'] == 2
+    assert 0 < rep['slot_utilization']['overall'] <= 1
+    # metrics merged across the two processes' flushes
+    assert rep['metrics']['counters']['inferencer.gen_batches'] == 16
+    assert rep['metrics']['counters']['runner.task_retries'] == 1
+    assert rep['metrics']['histograms']['inferencer.batch_seconds'][
+        'count'] == 16
+
+
+def test_render_report_sections():
+    from opencompass_tpu.obs.report import build_report, render_report
+    text = render_report(build_report(FIXTURE_RUN))
+    for needle in ('critical path', 'per-task breakdown', 'wait_s',
+                   'compile_s', 'device_s', 'slot utilization',
+                   'failures / retries', 'retries: 1', 'stall kills: 1'):
+        assert needle in text, f'{needle!r} missing from report'
+
+
+def test_build_report_resumed_run_uses_latest_trace(tmp_path):
+    """A resumed run appends a second trace to the same events.jsonl;
+    the report must not fold the idle gap / dead first attempt in."""
+    obs_dir = tmp_path / 'obs'
+    obs_dir.mkdir()
+    lines = [
+        # first attempt at t=1000, killed (no span_end)
+        {'v': 1, 'kind': 'span_start', 'ts': 1000.0, 'trace': 'old1',
+         'pid': 1, 'name': 'run', 'span': 's1'},
+        # resume 5 h later under a fresh trace id
+        {'v': 1, 'kind': 'span_start', 'ts': 19000.0, 'trace': 'new2',
+         'pid': 2, 'name': 'run', 'span': 's2'},
+        {'v': 1, 'kind': 'span_end', 'ts': 19010.0, 'trace': 'new2',
+         'pid': 2, 'name': 'run', 'span': 's2', 'dur': 10.0,
+         'status': 'ok'},
+    ]
+    with open(obs_dir / 'events.jsonl', 'w') as f:
+        for rec in lines:
+            f.write(json.dumps(rec) + '\n')
+    from opencompass_tpu.obs.report import build_report
+    rep = build_report(str(tmp_path))
+    assert rep['trace'] == 'new2'
+    assert rep['trace_ids'] == ['new2', 'old1']
+    assert rep['wall_seconds'] == pytest.approx(10.0)  # not ~5 hours
+    assert rep['n_spans'] == 1 and not rep['open_spans']
+    # the first attempt stays reachable explicitly
+    old = build_report(str(tmp_path), trace='old1')
+    assert old['open_spans'] == ['run']
+
+
+def test_histogram_quantile_overflow_bucket():
+    from opencompass_tpu.obs.report import _histogram_quantile
+    snap = {'buckets': [1.0, 10.0], 'counts': [1, 0, 3], 'sum': 100.0,
+            'count': 4}
+    assert _histogram_quantile(snap, 0.25) == 1.0
+    # the 99th percentile lands in the +Inf overflow: render a marker,
+    # never the string 'inf'
+    assert _histogram_quantile(snap, 0.99) == '>10.0'
+    assert _histogram_quantile({}, 0.5) is None
+
+
+def test_resolve_events_path_variants(tmp_path):
+    from opencompass_tpu.obs.report import resolve_events_path
+    direct = osp.join(FIXTURE_RUN, 'obs', 'events.jsonl')
+    assert resolve_events_path(FIXTURE_RUN) == direct
+    assert resolve_events_path(osp.join(FIXTURE_RUN, 'obs')) == direct
+    assert resolve_events_path(direct) == direct
+    # parent dir holding timestamped run dirs → newest run with obs
+    assert resolve_events_path(osp.dirname(FIXTURE_RUN)) is not None
+    assert resolve_events_path(str(tmp_path)) is None
+
+
+# -- CLI smoke (subprocess, no TPU) -----------------------------------------
+
+def _cpu_env():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    return env
+
+
+def test_trace_cli_smoke_on_fixture():
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'trace',
+         'tests/fixtures/obs_run'],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'per-task breakdown' in r.stdout
+    assert 'OpenICLInfer[tiny/demo-gen]' in r.stdout
+    assert 'compile_s' in r.stdout and 'wait_s' in r.stdout
+    assert 'retries: 1' in r.stdout
+
+
+def test_trace_cli_missing_events_dir(tmp_path):
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'trace',
+         str(tmp_path)],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=180)
+    assert r.returncode == 1
+    assert 'events.jsonl' in r.stdout
+
+
+# -- end-to-end FakeModel run ------------------------------------------------
+
+@pytest.fixture(scope='module')
+def obs_e2e_run(tmp_path_factory):
+    """One full `run.py --obs` pipeline (LocalRunner subprocesses, CPU)
+    shared by the e2e assertions below."""
+    work = str(tmp_path_factory.mktemp('obs_e2e'))
+    r = subprocess.run(
+        [sys.executable, 'run.py', 'configs/eval_demo.py', '-w', work,
+         '--obs', '--max-num-workers', '2'],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=420)
+    assert r.returncode == 0, r.stdout + r.stderr
+    (run_dir,) = os.listdir(work)
+    return osp.join(work, run_dir), r
+
+
+def test_e2e_obs_events_and_nesting(obs_e2e_run):
+    run_dir, _ = obs_e2e_run
+    events = _read_events(run_dir)
+    starts = {e['span']: e for e in events if e['kind'] == 'span_start'}
+    by_name = {}
+    for e in starts.values():
+        by_name.setdefault(e['name'].split(':')[0], []).append(e)
+    # runner → task → proc → infer/eval nesting, across processes
+    assert by_name.get('run') and by_name.get('runner') \
+        and by_name.get('task') and by_name.get('proc')
+    for proc in by_name['proc']:
+        parent = starts[proc['parent']]
+        assert parent['name'].startswith('task:')
+        assert proc['pid'] != parent['pid']  # real process boundary
+    for leaf_kind in ('infer', 'eval'):
+        for leaf in by_name[leaf_kind]:
+            assert starts[leaf['parent']]['name'].startswith('proc:')
+    # infer spans carry the TaskProfiler perf record (compile/device split)
+    infer_ends = [e for e in events if e['kind'] == 'span_end'
+                  and e['name'].startswith('infer:')]
+    assert infer_ends
+    for e in infer_ends:
+        perf = e['attrs']['perf']
+        assert 'device_seconds' in perf and 'compile_seconds' in perf
+
+
+def test_e2e_trace_report_renders(obs_e2e_run):
+    run_dir, _ = obs_e2e_run
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'trace', run_dir],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'per-task breakdown' in r.stdout
+    assert 'wait_s' in r.stdout and 'compile_s' in r.stdout \
+        and 'device_s' in r.stdout
+    assert 'failures / retries' in r.stdout
+    assert 'OpenICLInfer' in r.stdout and 'OpenICLEval' in r.stdout
+
+
+def test_e2e_summarizer_obs_section(obs_e2e_run):
+    run_dir, r = obs_e2e_run
+    assert '\nobs:\n' in r.stdout
+    (summary,) = [f for f in os.listdir(osp.join(run_dir, 'summary'))
+                  if f.endswith('.txt')]
+    text = open(osp.join(run_dir, 'summary', summary)).read()
+    assert 'obs format' in text
+    assert 'tasks' in text and 'retries' in text
+    # driver log file handler (logging satellite)
+    assert osp.exists(osp.join(run_dir, 'logs', 'driver.log'))
+
+
+def test_obs_unset_creates_no_obs_dir(tmp_path):
+    """Default runs must not grow an obs/ directory (zero-overhead-off)."""
+    work = str(tmp_path / 'out')
+    r = subprocess.run(
+        [sys.executable, 'run.py', 'configs/eval_demo.py', '-w', work,
+         '--debug'],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=420)
+    assert r.returncode == 0, r.stdout + r.stderr
+    (run_dir,) = os.listdir(work)
+    assert not osp.exists(osp.join(work, run_dir, 'obs'))

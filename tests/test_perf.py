@@ -2,6 +2,8 @@
 import json
 import os
 
+import pytest
+
 from opencompass_tpu.models import FakeModel
 from opencompass_tpu.utils.perf import PerfCounters, TaskProfiler, device_call
 
@@ -45,6 +47,45 @@ def test_task_profiler_writes_record(tmp_path):
     assert rec['samples_per_sec'] > 0
     assert rec['tokens_per_sec'] > 0
     assert prof.record == rec
+
+
+def test_task_profiler_writes_record_on_error(tmp_path):
+    """A failed task's perf JSON must still be written (with the error
+    attached) so it shows in the summarizer's perf table."""
+    model = FakeModel()
+    out = str(tmp_path / 'perf' / 'fake' / 'ds.json')
+    with pytest.raises(RuntimeError):
+        with TaskProfiler(model, out_path=out) as prof:
+            model.get_ppl(['x y'])
+            raise RuntimeError('device wedged')
+    assert os.path.exists(out)
+    with open(out) as f:
+        rec = json.load(f)
+    assert rec['samples'] == 1
+    assert rec['error'] == 'RuntimeError: device wedged'
+    assert prof.record == rec
+
+
+def test_device_call_first_flag_splits_compile_time():
+    c = PerfCounters()
+    with device_call(c, samples=1, first=True):
+        pass
+    with device_call(c, samples=1):
+        pass
+    assert c.calls == 2 and c.first_calls == 1
+    assert 0 <= c.compile_seconds <= c.device_seconds
+    d = c.delta_since({})  # snapshot-less delta tolerates new fields
+    assert d['first_calls'] == 1
+
+
+def test_task_profiler_record_has_compile_split(tmp_path):
+    model = FakeModel()
+    out = str(tmp_path / 'p.json')
+    with TaskProfiler(model, out_path=out):
+        model.get_ppl(['a b c'])
+    with open(out) as f:
+        rec = json.load(f)
+    assert 'compile_seconds' in rec and 'first_calls' in rec
 
 
 def test_task_profiler_jax_trace(tmp_path):

@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Render a run's obs trace report (standalone twin of
+``python -m opencompass_tpu.cli trace``).
+
+Usage::
+
+    python tools/trace_report.py outputs/demo/20240101_120000
+    python tools/trace_report.py outputs/demo            # latest run
+    python tools/trace_report.py path/to/events.jsonl --json
+
+See docs/observability.md for the event schema and how to read the
+report.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from opencompass_tpu.obs.report import main  # noqa: E402
+
+if __name__ == '__main__':
+    raise SystemExit(main())
