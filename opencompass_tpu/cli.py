@@ -11,7 +11,9 @@ Usage::
     python run.py cfg.py --debug                    # serial, in-process
     python run.py cfg.py --slurm -p PARTITION       # cluster launch
     python run.py cfg.py --obs                      # run-wide tracing
+    python run.py cfg.py --obs --obs-port 9464      # + live /metrics HTTP
     python -m opencompass_tpu.cli trace WORK_DIR    # render trace report
+    python -m opencompass_tpu.cli status WORK_DIR --watch   # live progress
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files
@@ -103,6 +105,16 @@ def parse_args():
                         'with `python -m opencompass_tpu.cli trace '
                         '<work_dir>`); config key `obs = True` is '
                         'equivalent')
+    parser.add_argument('--obs-port',
+                        type=int,
+                        default=None,
+                        metavar='PORT',
+                        help='serve live telemetry over HTTP while the '
+                        'run is active: /metrics (Prometheus text), '
+                        '/status (JSON), /healthz.  PORT 0 binds an '
+                        'ephemeral port (logged, and written to '
+                        '{work_dir}/obs/http.json).  Implies --obs.  '
+                        'Default: off')
     return parser.parse_args()
 
 
@@ -116,7 +128,7 @@ def get_config_from_arg(args) -> Config:
         cfg.pop('lark_bot_url', None)
     if args.profile:
         cfg['profile'] = True
-    if args.obs:
+    if args.obs or args.obs_port is not None:
         cfg['obs'] = True
     return cfg
 
@@ -174,11 +186,21 @@ def trace_main(argv=None) -> int:
     return report_main(argv)
 
 
+def status_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli status <work_dir> [--watch]`` —
+    live (or final) run progress from obs/ heartbeats + status.json.
+    File-based: needs no server and works on a dead run."""
+    from opencompass_tpu.obs.live import main as live_main
+    return live_main(argv)
+
+
 def main():
-    # subcommand dispatch before the run-config parser: `trace` takes a
-    # work_dir, not a config file
+    # subcommand dispatch before the run-config parser: `trace`/`status`
+    # take a work_dir, not a config file
     if len(sys.argv) > 1 and sys.argv[1] == 'trace':
         raise SystemExit(trace_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == 'status':
+        raise SystemExit(status_main(sys.argv[2:]))
     # persistent XLA compilation cache for the whole pipeline — tasks
     # inherit it (LocalRunner also sets it for device tasks), and the
     # --debug in-process path benefits directly.  Rare shapes compile
@@ -218,15 +240,41 @@ def main():
     # run-wide tracing: everything below nests under the 'run' span, and
     # subprocess tasks join the same events.jsonl via OCT_* env vars
     tracer = obs.init_obs(cfg['work_dir'], enabled=obs.obs_enabled(cfg))
+    if tracer.enabled:
+        # run lifecycle marker: phase aggregators finish between
+        # phases, so run-over is the driver's call, not a runner's
+        from opencompass_tpu.obs.live import mark_run
+        mark_run(tracer.obs_dir, 'running')
+    # opt-in live HTTP exposition (--obs-port): /metrics, /status,
+    # /healthz served from the driver for the duration of the run
+    server = None
+    if tracer.enabled and args.obs_port is not None:
+        from opencompass_tpu.obs.promexport import ObsHTTPServer
+        server = ObsHTTPServer(tracer.obs_dir, port=args.obs_port,
+                               registry=tracer.metrics)
+        port = server.start()
+        if port is not None:
+            logger.info(f'obs http endpoint at http://127.0.0.1:{port} '
+                        '(/metrics /status /healthz)')
+        else:
+            logger.warning(f'obs http endpoint failed to bind port '
+                           f'{args.obs_port}; continuing without it')
     try:
         with tracer.span('run', config=args.config, mode=args.mode):
             _run_phases(args, cfg, dir_time_str)
     finally:
+        if tracer.enabled:
+            from opencompass_tpu.obs.live import mark_run
+            mark_run(tracer.obs_dir, 'done')
+        if server is not None:
+            server.stop()
         tracer.close()
     if tracer.enabled:
         logger.info('obs events at '
                     f'{osp.join(cfg["work_dir"], "obs", "events.jsonl")} — '
                     'render with: python -m opencompass_tpu.cli trace '
+                    f'{cfg["work_dir"]}; live/final status with: '
+                    'python -m opencompass_tpu.cli status '
                     f'{cfg["work_dir"]}')
 
 

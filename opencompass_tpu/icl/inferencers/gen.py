@@ -18,7 +18,7 @@ import os.path as osp
 import time
 from typing import List, Optional
 
-from opencompass_tpu.obs import get_tracer, observe_batch
+from opencompass_tpu.obs import get_heartbeat, get_tracer, observe_batch
 from opencompass_tpu.parallel.distributed import broadcast_object
 from opencompass_tpu.registry import ICL_INFERENCERS
 from opencompass_tpu.utils.logging import get_logger
@@ -75,13 +75,19 @@ class GenInferencer(BaseInferencer):
         # hoisted once: the per-batch obs cost is one bool check when
         # tracing is off
         obs_on = get_tracer().enabled
+        if obs_on:
+            # seed the heartbeat so a resumed task reports its true
+            # starting position before the first batch lands
+            get_heartbeat().progress(cursor, len(prompts), force=True)
         for chunk in self.get_batches(prompts[cursor:], self.batch_size):
             shown = self.model.parse_template(chunk, mode='gen')
             if obs_on:
                 t0 = time.perf_counter()
             completions = self._generate_batch(chunk, shown)
             if obs_on:
-                observe_batch('inferencer.gen_batches', t0)
+                observe_batch('inferencer.gen_batches', t0,
+                              done=cursor + len(shown),
+                              total=len(prompts))
             for text, completion in zip(shown, completions):
                 handler.save_results(text, completion, cursor)
                 cursor += 1

@@ -22,7 +22,7 @@ from typing import List, Optional
 
 import numpy as np
 
-from opencompass_tpu.obs import get_tracer, observe_batch
+from opencompass_tpu.obs import get_heartbeat, get_tracer, observe_batch
 from opencompass_tpu.registry import ICL_INFERENCERS
 from opencompass_tpu.utils.logging import get_logger
 
@@ -107,6 +107,9 @@ class PPLInferencer(BaseInferencer):
         item_major = (normalizing_str is None and len(labels) > 1
                       and getattr(self.model, 'shared_prefix_active',
                                   False))
+        # total scoring rows across every label: the heartbeat's
+        # example-level denominator for this unit
+        n_rows = len(labels) * len(fitter)
         if item_major:
             obs_on = get_tracer().enabled
             score_table = [[0.0] * len(fitter) for _ in labels]
@@ -117,10 +120,14 @@ class PPLInferencer(BaseInferencer):
                     [rows_by_label[li][idx].prompt
                      for li in range(len(labels))]))
                 if obs_on:
-                    observe_batch('inferencer.ppl_batches', t0)
+                    observe_batch('inferencer.ppl_batches', t0,
+                                  done=(idx + 1) * len(labels),
+                                  total=n_rows)
                 for li in range(len(labels)):
                     score_table[li][idx] = float(got[li])
         else:
+            if get_tracer().enabled:
+                get_heartbeat().progress(0, n_rows, force=True)
             score_table = [self._score(rows, normalizing_str)
                            for rows in rows_by_label]
 
@@ -197,5 +204,8 @@ class PPLInferencer(BaseInferencer):
                 got = conditional - baseline
             if obs_on:
                 observe_batch('inferencer.ppl_batches', t0)
+                # label-major scoring only knows per-chunk increments;
+                # inference() seeded done/total for the whole unit
+                get_heartbeat().add(len(chunk))
             scores.extend(got.tolist())
         return scores

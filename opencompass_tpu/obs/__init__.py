@@ -26,6 +26,9 @@ import os.path as osp
 import time
 from typing import Dict, Optional
 
+from opencompass_tpu.obs import live as _live
+from opencompass_tpu.obs.live import (Heartbeat, NoopHeartbeat,
+                                      get_heartbeat, heartbeat_path)
 from opencompass_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                          LATENCY_BUCKETS_S, MetricsRegistry)
 from opencompass_tpu.obs.trace import (ENV_OBS_DIR, ENV_PARENT_SPAN,
@@ -36,8 +39,9 @@ __all__ = ['Counter', 'Gauge', 'Histogram', 'LATENCY_BUCKETS_S',
            'MetricsRegistry', 'NoopTracer', 'Span', 'Tracer',
            'current_span', 'get_tracer', 'init_obs', 'init_task_obs',
            'reset_obs', 'obs_enabled', 'device_memory_attrs',
-           'observe_batch', 'ENV_TRACE_ID', 'ENV_PARENT_SPAN',
-           'ENV_OBS_DIR']
+           'observe_batch', 'Heartbeat', 'NoopHeartbeat',
+           'get_heartbeat', 'heartbeat_path', 'init_task_heartbeat',
+           'ENV_TRACE_ID', 'ENV_PARENT_SPAN', 'ENV_OBS_DIR']
 
 _NOOP = NoopTracer()
 _TRACER = _NOOP
@@ -99,6 +103,24 @@ def init_task_obs(cfg: Dict):
     return _TRACER
 
 
+def init_task_heartbeat(task_name: str):
+    """Install the process-wide :class:`Heartbeat` for a subprocess
+    task (``{obs_dir}/progress/<task>.json``).  Follows the tracer:
+    stays the shared :class:`NoopHeartbeat` unless this process's
+    tracing is enabled (so multi-host non-zero ranks and untraced runs
+    pay nothing).  Never raises."""
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return _live.get_heartbeat()
+    try:
+        # keepalive: the file stays fresh through one long device call
+        # (XLA compile) so the runner's stall watchdog sees a live task
+        return _live.install_heartbeat(
+            Heartbeat(tracer.obs_dir, task_name, keepalive=True))
+    except Exception:
+        return _live.get_heartbeat()
+
+
 def reset_obs():
     """Drop back to the NoopTracer (closing any live sink) — test hook."""
     global _TRACER
@@ -108,6 +130,7 @@ def reset_obs():
         except Exception:
             pass
     _TRACER = _NOOP
+    _live.reset_heartbeat()
 
 
 def obs_enabled(cfg: Dict) -> bool:
@@ -115,16 +138,25 @@ def obs_enabled(cfg: Dict) -> bool:
     return bool(cfg.get('obs'))
 
 
-def observe_batch(counter: str, t0: float):
+def observe_batch(counter: str, t0: float, done: Optional[int] = None,
+                  total: Optional[int] = None):
     """Record one inferencer batch: latency into the shared
     ``inferencer.batch_seconds`` histogram plus an increment of
     ``counter``.  Callers hoist ``obs_on = get_tracer().enabled`` before
     their loop and only take a ``time.perf_counter()`` / call-this pair
-    when it is True, keeping the disabled hot path at one bool check."""
+    when it is True, keeping the disabled hot path at one bool check.
+
+    With ``done``/``total`` the task heartbeat is ticked too (rate-
+    limited atomic write of ``obs/progress/<task>.json``), feeding the
+    live status plane."""
     tracer = get_tracer()
-    tracer.histogram('inferencer.batch_seconds').observe(
-        time.perf_counter() - t0)
+    dt = time.perf_counter() - t0
+    tracer.histogram('inferencer.batch_seconds').observe(dt)
     tracer.counter(counter).inc()
+    if done is not None:
+        hb = _live.get_heartbeat()
+        if hb.enabled:
+            hb.progress(done=done, total=total, batch_seconds=dt)
 
 
 def device_memory_attrs() -> Dict[str, int]:

@@ -1,0 +1,711 @@
+"""Live telemetry: task heartbeats + run-level status aggregation.
+
+Writer side (subprocess tasks): a :class:`Heartbeat` bound to
+``{obs_dir}/progress/<task>.json`` is installed process-wide by
+``obs.init_task_heartbeat``; the task layer sets the current
+(model, dataset) unit and the inferencer loops tick example-level
+progress per batch.  Writes are atomic (temp file + ``os.replace``) so a
+concurrent reader never sees a torn file, and rate-limited so the
+per-batch cost is one clock read.  Contract identical to the tracer:
+live telemetry must **never fail a task** — every method is
+exception-guarded and the disabled path is a :class:`NoopHeartbeat`
+whose methods do nothing.
+
+Reader side (driver): :func:`read_heartbeats` scans the progress dir,
+:func:`build_status` folds heartbeats + runner-reported task states into
+one run-level snapshot (per-task progress, overall fraction, ETA, slot
+utilization), and :class:`StatusAggregator` is the background thread the
+runner starts to persist that snapshot to ``{obs_dir}/status.json``.
+
+``python -m opencompass_tpu.cli status <work_dir>`` (:func:`main`)
+renders the snapshot as a table — purely from files, so it needs no
+server and works on a dead run; ``--watch`` re-renders on an interval.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import os.path as osp
+import re
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+HEARTBEAT_VERSION = 1
+STATUS_VERSION = 1
+HEARTBEAT_INTERVAL_S = 2.0      # min seconds between heartbeat writes
+AGGREGATE_INTERVAL_S = 2.0      # status.json refresh period
+PROGRESS_SUBDIR = 'progress'
+STATUS_FILE = 'status.json'
+RUN_FILE = 'run.json'           # driver-owned run lifecycle marker
+
+
+def heartbeat_path(obs_dir: str, task_name: str) -> str:
+    """Deterministic per-task heartbeat file under ``{obs_dir}/progress/``.
+
+    Task names carry ``[``, ``]``, ``/`` and spaces; the filename is the
+    sanitized name plus a short content hash so distinct names that
+    sanitize identically never collide.  Both the writer (subprocess
+    task) and the readers (aggregator, stall watchdog) derive the path
+    with this function.
+    """
+    safe = re.sub(r'[^\w.\-]+', '_', task_name)[:80]
+    digest = hashlib.sha1(task_name.encode('utf-8')).hexdigest()[:8]
+    return osp.join(obs_dir, PROGRESS_SUBDIR, f'{safe}-{digest}.json')
+
+
+def atomic_write_json(path: str, obj: Dict):
+    """Write ``obj`` to ``path`` so readers only ever see a complete
+    file: temp file in the same directory, fsync-free ``os.replace``."""
+    dirname = osp.dirname(osp.abspath(path))
+    os.makedirs(dirname, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix='.tmp')
+    try:
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            json.dump(obj, f, separators=(',', ':'), default=str)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class NoopHeartbeat:
+    """Disabled heartbeat: every method is inert, so instrumented code
+    calls it unconditionally behind a single ``enabled`` check."""
+
+    enabled = False
+
+    def bind_perf(self, counters):
+        pass
+
+    def set_unit(self, units_done, units_total, name=None):
+        pass
+
+    def progress(self, done=None, total=None, batch_seconds=None,
+                 force=False):
+        pass
+
+    def add(self, n=1):
+        pass
+
+    def mark(self, state):
+        pass
+
+
+class Heartbeat:
+    """One task's live progress file.
+
+    Schema (``{obs_dir}/progress/<task>.json``, one JSON object)::
+
+        {"v": 1, "task": <full task name>, "pid": <int>, "ts": <unix s>,
+         "state": "running"|"done"|"failed",
+         "unit": <current model/dataset pair or null>,
+         "units_done": <pairs finished>, "units_total": <pairs in task>,
+         "done": <examples done in current unit>, "total": <examples>,
+         "tokens_per_sec": <live rate or null>,
+         "last_batch_seconds": <latest batch latency or null>,
+         "device_memory": {"peak_bytes_in_use": ..., ...}}   # when exposed
+
+    With ``keepalive=True`` a daemon thread refreshes the file every
+    ``interval`` seconds even when no progress tick arrives, so a task
+    blocked in one long device call (a 14-minute XLA compile makes no
+    per-batch progress) still proves the process is alive — that
+    freshness is what the stall watchdog keys on.
+    """
+
+    enabled = True
+
+    def __init__(self, obs_dir: str, task_name: str,
+                 interval: float = HEARTBEAT_INTERVAL_S,
+                 keepalive: bool = False):
+        self.path = heartbeat_path(obs_dir, task_name)
+        self._interval = interval
+        self._lock = threading.Lock()
+        self._last_write = 0.0
+        self._perf = None           # PerfCounters of the live model
+        self._perf_snap: Optional[Tuple[float, int]] = None
+        self._state: Dict = {
+            'v': HEARTBEAT_VERSION, 'task': task_name, 'pid': os.getpid(),
+            'ts': None, 'state': 'running', 'unit': None,
+            'units_done': 0, 'units_total': None,
+            'done': 0, 'total': None,
+            'tokens_per_sec': None, 'last_batch_seconds': None,
+        }
+        self._stop_keepalive: Optional[threading.Event] = None
+        if keepalive:
+            try:
+                self._stop_keepalive = threading.Event()
+                thread = threading.Thread(target=self._keepalive_loop,
+                                          name='obs-heartbeat',
+                                          daemon=True)
+                thread.start()
+            except Exception:
+                self._stop_keepalive = None
+
+    def _keepalive_loop(self):
+        while not self._stop_keepalive.wait(self._interval):
+            try:
+                with self._lock:
+                    # only refresh when the progress ticks went quiet —
+                    # the usual case is the main thread writing anyway
+                    if time.time() - self._last_write >= self._interval:
+                        self._write_locked(force=True)
+            except Exception:
+                pass
+
+    # -- writer API (all never-fail) ---------------------------------------
+
+    def bind_perf(self, counters):
+        """Attach the model's PerfCounters so writes report a live
+        tokens/s computed from counter deltas."""
+        try:
+            with self._lock:
+                self._perf = counters
+                self._perf_snap = None
+        except Exception:
+            pass
+
+    def set_unit(self, units_done: int, units_total: int,
+                 name: Optional[str] = None):
+        """Enter the ``units_done``-th (model, dataset) pair of
+        ``units_total``; resets the example-level cursor."""
+        try:
+            with self._lock:
+                self._state.update(units_done=units_done,
+                                   units_total=units_total, unit=name,
+                                   done=0, total=None)
+                self._write_locked(force=True)
+        except Exception:
+            pass
+
+    def progress(self, done: Optional[int] = None,
+                 total: Optional[int] = None,
+                 batch_seconds: Optional[float] = None,
+                 force: bool = False):
+        """Example-level progress inside the current unit (rate-limited
+        write; ``force`` bypasses the limiter)."""
+        try:
+            with self._lock:
+                if done is not None:
+                    self._state['done'] = int(done)
+                if total is not None:
+                    self._state['total'] = int(total)
+                if batch_seconds is not None:
+                    self._state['last_batch_seconds'] = round(
+                        float(batch_seconds), 4)
+                self._write_locked(force=force)
+        except Exception:
+            pass
+
+    def add(self, n: int = 1):
+        """Increment the example cursor (PPL label-major scoring, where
+        the caller only knows per-chunk increments)."""
+        try:
+            with self._lock:
+                self._state['done'] = int(self._state.get('done') or 0) + n
+                self._write_locked(force=False)
+        except Exception:
+            pass
+
+    def mark(self, state: str):
+        """Terminal state (``done``/``failed``); always written, and the
+        keepalive thread stands down — a finished task must go stale."""
+        try:
+            if self._stop_keepalive is not None:
+                self._stop_keepalive.set()
+            with self._lock:
+                self._state['state'] = state
+                if state == 'done' and self._state.get('units_total'):
+                    self._state['units_done'] = self._state['units_total']
+                self._write_locked(force=True)
+        except Exception:
+            pass
+
+    def _write_locked(self, force: bool):
+        now = time.time()
+        if not force and now - self._last_write < self._interval:
+            return
+        if self._perf is not None:
+            try:
+                tokens = int(getattr(self._perf, 'tokens_in', 0)
+                             + getattr(self._perf, 'tokens_out', 0))
+                if self._perf_snap is not None:
+                    t_prev, tok_prev = self._perf_snap
+                    dt = now - t_prev
+                    if dt > 0 and tokens >= tok_prev:
+                        self._state['tokens_per_sec'] = round(
+                            (tokens - tok_prev) / dt, 1)
+                self._perf_snap = (now, tokens)
+            except Exception:
+                pass
+        try:  # device-memory high-water, when the backend exposes it
+            from opencompass_tpu.obs import device_memory_attrs
+            mem = device_memory_attrs()
+            if mem:
+                self._state['device_memory'] = mem
+        except Exception:
+            pass
+        self._state['ts'] = round(now, 3)
+        atomic_write_json(self.path, self._state)
+        self._last_write = now
+
+
+_NOOP_HEARTBEAT = NoopHeartbeat()
+_HEARTBEAT = _NOOP_HEARTBEAT
+
+
+def get_heartbeat():
+    """The process-wide heartbeat; a shared no-op until
+    ``obs.init_task_heartbeat`` installs a real one."""
+    return _HEARTBEAT
+
+
+def install_heartbeat(hb):
+    global _HEARTBEAT
+    _HEARTBEAT = hb
+    return hb
+
+
+def reset_heartbeat():
+    """Back to the no-op (test hook, and ``obs.reset_obs``)."""
+    global _HEARTBEAT
+    _HEARTBEAT = _NOOP_HEARTBEAT
+
+
+# -- run lifecycle marker (driver-owned) -----------------------------------
+
+def mark_run(obs_dir: str, state: str):
+    """``{obs_dir}/run.json``: the *driver's* view of the run lifecycle.
+
+    Runner-phase aggregators finish (and write a final ``status.json``)
+    between phases, so phase completion alone cannot distinguish
+    "infer done, eval next" from "run over".  The driver writes
+    ``running`` at startup and ``done`` on exit; readers overlay this
+    on the latest phase snapshot.  Never raises."""
+    try:
+        prev = read_run_marker(obs_dir) or {}
+        now = round(time.time(), 3)
+        rec = {'v': 1, 'state': state, 'pid': os.getpid(), 'ts': now,
+               'started': prev.get('started', now)}
+        if state == 'done':
+            rec['ended'] = now
+        atomic_write_json(osp.join(obs_dir, RUN_FILE), rec)
+    except Exception:
+        pass
+
+
+def read_run_marker(obs_dir: str) -> Optional[Dict]:
+    try:
+        with open(osp.join(obs_dir, RUN_FILE), encoding='utf-8') as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _pid_alive(pid) -> bool:
+    """Best-effort liveness; unknowable (cross-host, no perms) counts
+    as alive so a valid marker is not discarded."""
+    if not isinstance(pid, int):
+        return True
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except Exception:
+        return True
+
+
+# -- reader side -----------------------------------------------------------
+
+def read_heartbeats(obs_dir: str) -> Dict[str, Dict]:
+    """task name → heartbeat record for every parseable progress file.
+
+    Tolerates concurrent writers: unreadable, torn, or non-dict files
+    are skipped, never raised.  Attaches ``heartbeat_age_seconds``
+    (from file mtime — same signal the stall watchdog uses).
+    """
+    out: Dict[str, Dict] = {}
+    progress_dir = osp.join(obs_dir, PROGRESS_SUBDIR)
+    try:
+        entries = os.listdir(progress_dir)
+    except OSError:
+        return out
+    now = time.time()
+    for fname in sorted(entries):
+        if not fname.endswith('.json'):
+            continue
+        path = osp.join(progress_dir, fname)
+        try:
+            mtime = os.stat(path).st_mtime
+            with open(path, encoding='utf-8') as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue   # torn write / vanished file: skip, never crash
+        if not isinstance(rec, dict) or 'task' not in rec:
+            continue
+        rec['heartbeat_age_seconds'] = round(max(0.0, now - mtime), 3)
+        out[rec['task']] = rec
+    return out
+
+
+def _task_fraction(rec: Dict) -> Optional[float]:
+    """0..1 completion estimate from one heartbeat record."""
+    if rec.get('state') == 'done':
+        return 1.0
+    done, total = rec.get('done'), rec.get('total')
+    unit_frac = 0.0
+    if isinstance(done, (int, float)) and total:
+        unit_frac = min(1.0, max(0.0, done / total))
+    units_total = rec.get('units_total')
+    if units_total:
+        units_done = rec.get('units_done') or 0
+        return min(1.0, (units_done + unit_frac) / units_total)
+    if total:
+        return unit_frac
+    return None
+
+
+def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
+                 now: Optional[float] = None) -> Dict:
+    """Fold heartbeats + (optional) runner-reported task states into one
+    run-level snapshot dict (the ``status.json`` schema, v1).
+
+    ``runner_state``: ``{'runner': str, 'started': ts, 'state': str,
+    'tasks': {name: {'state': ..., 'returncode': ...}},
+    'slots': {'total': n, 'in_use': m}}`` — the runner's view wins for
+    terminal states; heartbeats supply live progress.
+    """
+    now = time.time() if now is None else now
+    runner_state = runner_state or {}
+    heartbeats = read_heartbeats(obs_dir)
+
+    tasks: Dict[str, Dict] = {}
+    for name, st in (runner_state.get('tasks') or {}).items():
+        tasks[name] = {'state': st.get('state', 'pending'),
+                       'returncode': st.get('returncode')}
+    for name, rec in heartbeats.items():
+        row = tasks.setdefault(name, {'state': 'running',
+                                      'returncode': None})
+        frac = _task_fraction(rec)
+        row.update(
+            pid=rec.get('pid'), unit=rec.get('unit'),
+            units_done=rec.get('units_done'),
+            units_total=rec.get('units_total'),
+            done=rec.get('done'), total=rec.get('total'),
+            tokens_per_sec=rec.get('tokens_per_sec'),
+            last_batch_seconds=rec.get('last_batch_seconds'),
+            heartbeat_age_seconds=rec.get('heartbeat_age_seconds'),
+            device_memory=rec.get('device_memory'))
+        # a terminal runner verdict (ok/failed) overrides the
+        # heartbeat's last word; otherwise adopt the heartbeat state
+        if row['state'] in ('pending', 'running'):
+            row['state'] = {'done': 'ok'}.get(rec.get('state'),
+                                              rec.get('state', 'running'))
+        row['progress'] = round(frac, 4) if frac is not None else None
+
+    n = len(tasks)
+    by_state = {'ok': 0, 'failed': 0, 'running': 0, 'pending': 0}
+    frac_sum = 0.0
+    for row in tasks.values():
+        state = row['state']
+        if row.get('progress') is None and state == 'ok':
+            row['progress'] = 1.0
+        by_state[state if state in by_state else 'running'] += 1
+        p = row.get('progress')
+        frac_sum += p if p is not None else 0.0
+    progress = round(frac_sum / n, 4) if n else None
+
+    started = runner_state.get('started')
+    if started is None and heartbeats:
+        started = min(rec['ts'] for rec in heartbeats.values()
+                      if isinstance(rec.get('ts'), (int, float)))
+    elapsed = round(now - started, 3) if started else None
+    state = runner_state.get('state',
+                             'running' if by_state['running'] else
+                             ('done' if n else 'idle'))
+    eta = None
+    if state == 'running' and elapsed and progress \
+            and 0.02 < progress < 1.0:
+        eta = round(elapsed * (1.0 - progress) / progress, 1)
+
+    return {
+        'v': STATUS_VERSION,
+        'ts': round(now, 3),
+        'state': state,
+        'runner': runner_state.get('runner'),
+        'started': started,
+        'elapsed_seconds': elapsed,
+        'tasks': tasks,
+        'overall': {'n_tasks': n, 'progress': progress,
+                    'eta_seconds': eta, **by_state},
+        'slots': runner_state.get('slots'),
+    }
+
+
+class StatusAggregator:
+    """Background thread in the run driver: every ``interval`` seconds
+    folds task heartbeats + runner task states into ``status.json``.
+
+    Never-fail contract: construction, every notification, the thread
+    body, and ``stop`` are exception-guarded — a telemetry bug can slow
+    nothing and kill nothing.  The runner calls :meth:`task_started` /
+    :meth:`task_finished` from its pool threads (thread-safe).
+    """
+
+    def __init__(self, obs_dir: str, runner: Optional[str] = None,
+                 interval: float = AGGREGATE_INTERVAL_S,
+                 slots_probe: Optional[Callable[[], Tuple[int, int]]]
+                 = None):
+        self.obs_dir = obs_dir
+        self.status_path = osp.join(obs_dir, STATUS_FILE)
+        self.interval = interval
+        self._runner = runner
+        self._slots_probe = slots_probe
+        self._lock = threading.Lock()
+        self._tasks: Dict[str, Dict] = {}
+        # elapsed/ETA anchor at the *run* start when the driver marked
+        # one (a later phase extrapolates over the whole run, not its
+        # own few seconds), else at this phase's start
+        self._started = time.time()
+        marker = read_run_marker(obs_dir)
+        if marker and isinstance(marker.get('started'), (int, float)):
+            self._started = marker['started']
+        self._state = 'running'
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- runner notifications ----------------------------------------------
+
+    def set_tasks(self, names: List[str]):
+        try:
+            with self._lock:
+                for name in names:
+                    self._tasks.setdefault(
+                        name, {'state': 'pending', 'returncode': None})
+        except Exception:
+            pass
+
+    def task_started(self, name: str):
+        try:
+            with self._lock:
+                self._tasks[name] = {'state': 'running',
+                                     'returncode': None}
+        except Exception:
+            pass
+
+    def task_finished(self, name: str, returncode: int):
+        try:
+            with self._lock:
+                self._tasks[name] = {
+                    'state': 'ok' if returncode == 0 else 'failed',
+                    'returncode': returncode}
+        except Exception:
+            pass
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self):
+        try:
+            self.write_snapshot()
+            self._thread = threading.Thread(target=self._loop,
+                                            name='obs-status-aggregator',
+                                            daemon=True)
+            self._thread.start()
+        except Exception:
+            pass
+        return self
+
+    def stop(self):
+        """Stop the thread and persist the final (run-complete)
+        snapshot so ``cli status`` keeps working on a dead run."""
+        try:
+            self._stop.set()
+            if self._thread is not None:
+                self._thread.join(timeout=max(5.0, 2 * self.interval))
+            self._state = 'done'
+            self.write_snapshot()
+        except Exception:
+            pass
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            self.write_snapshot()
+
+    def _runner_state(self) -> Dict:
+        with self._lock:
+            tasks = {name: dict(st) for name, st in self._tasks.items()}
+        slots = None
+        if self._slots_probe is not None:
+            try:
+                in_use, total = self._slots_probe()
+                slots = {'in_use': in_use, 'total': total}
+            except Exception:
+                pass
+        return {'runner': self._runner, 'started': self._started,
+                'state': self._state, 'tasks': tasks, 'slots': slots}
+
+    def write_snapshot(self):
+        try:
+            snap = build_status(self.obs_dir,
+                                runner_state=self._runner_state())
+            atomic_write_json(self.status_path, snap)
+        except Exception:
+            pass   # telemetry never fails the run
+
+
+# -- file-based readers for the CLI / HTTP endpoints -----------------------
+
+def resolve_obs_dir(path: str) -> Optional[str]:
+    """Accept a run work_dir, its ``obs/`` dir, or a parent outputs dir
+    with timestamped run subdirs (same contract as the trace report)."""
+    def is_obs(d):
+        return osp.isdir(osp.join(d, PROGRESS_SUBDIR)) \
+            or osp.isfile(osp.join(d, STATUS_FILE)) \
+            or osp.isfile(osp.join(d, 'events.jsonl'))
+
+    if osp.isdir(path) and osp.basename(osp.normpath(path)) == 'obs' \
+            and is_obs(path):
+        return path
+    cand = osp.join(path, 'obs')
+    if is_obs(cand):
+        return cand
+    if osp.isdir(path):
+        for sub in sorted(os.listdir(path), reverse=True):
+            cand = osp.join(path, sub, 'obs')
+            if is_obs(cand):
+                return cand
+    return None
+
+
+def load_status(obs_dir: str) -> Optional[Dict]:
+    """The persisted ``status.json``, or None (missing/torn file)."""
+    try:
+        with open(osp.join(obs_dir, STATUS_FILE), encoding='utf-8') as f:
+            snap = json.load(f)
+        return snap if isinstance(snap, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def current_status(obs_dir: str) -> Dict:
+    """Freshest available snapshot: the aggregator's ``status.json``
+    while a run is live (or after it finished), else built directly
+    from the heartbeat files (aggregator died / never ran).
+
+    The driver's ``run.json`` lifecycle marker overlays the phase
+    snapshot's ``state``: a phase ending is not the run ending (the
+    eval phase is still ahead), and a driver that exited means the run
+    is over even when the last snapshot never said so."""
+    snap = load_status(obs_dir)
+    if snap is None:
+        snap = build_status(obs_dir)
+    marker = read_run_marker(obs_dir)
+    if marker:
+        if marker.get('state') == 'running' \
+                and _pid_alive(marker.get('pid')):
+            if snap.get('state') == 'done':
+                snap['state'] = 'running'   # between phases
+        elif marker.get('state') == 'done' \
+                and snap.get('state') == 'running':
+            snap['state'] = 'done'          # driver exited mid-phase
+    return snap
+
+
+# -- `cli status` rendering ------------------------------------------------
+
+def _fmt(value, suffix='') -> str:
+    if value is None:
+        return '-'
+    if isinstance(value, float):
+        value = round(value, 1)
+    return f'{value}{suffix}'
+
+
+def render_status(snap: Dict) -> str:
+    from opencompass_tpu.obs.report import _table
+    o = snap.get('overall') or {}
+    head = [f"state: {snap.get('state', '?')}"]
+    if o.get('progress') is not None:
+        head.append(f"progress {o['progress']:.0%}")
+    if o.get('eta_seconds') is not None:
+        head.append(f"ETA {_fmt(o['eta_seconds'], 's')}")
+    if snap.get('elapsed_seconds') is not None:
+        head.append(f"elapsed {_fmt(snap['elapsed_seconds'], 's')}")
+    slots = snap.get('slots')
+    if slots:
+        head.append(f"slots {slots.get('in_use', '?')}"
+                    f"/{slots.get('total', '?')}")
+    lines = ['  '.join(head),
+             f"tasks: {o.get('n_tasks', 0)} total — "
+             f"{o.get('ok', 0)} ok, {o.get('running', 0)} running, "
+             f"{o.get('pending', 0)} pending, {o.get('failed', 0)} failed"]
+    tasks = snap.get('tasks') or {}
+    if tasks:
+        rows = [['task', 'state', 'unit', 'done/total', '%', 'tok/s',
+                 'hb_age']]
+        for name in sorted(tasks):
+            t = tasks[name]
+            done, total = t.get('done'), t.get('total')
+            frac = t.get('progress')
+            units = ''
+            if t.get('units_total'):
+                units = (f"[{t.get('units_done', 0)}"
+                         f"/{t['units_total']}] ")
+            rows.append([
+                name[:58], t.get('state', '?'),
+                units + (str(t.get('unit') or '-')[:32]),
+                f'{done}/{total}' if total else '-',
+                f'{frac:.0%}' if frac is not None else '-',
+                _fmt(t.get('tokens_per_sec')),
+                _fmt(t.get('heartbeat_age_seconds'), 's'),
+            ])
+        lines.append(_table(rows))
+    else:
+        lines.append('(no tasks reported yet)')
+    return '\n'.join(lines) + '\n'
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m opencompass_tpu.cli status <work_dir>`` body."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog='status', description='Show live (or final) run status from '
+        'obs/ heartbeats + status.json — file-based, no server needed')
+    parser.add_argument('work_dir',
+                        help='run work dir (or its obs/ dir, or a parent '
+                        'outputs dir with timestamped runs)')
+    parser.add_argument('--watch', nargs='?', const=2.0, type=float,
+                        default=None, metavar='SECONDS',
+                        help='re-render every SECONDS (default 2) until '
+                        'the run completes or Ctrl-C')
+    parser.add_argument('--json', action='store_true',
+                        help='emit the raw status snapshot as JSON')
+    args = parser.parse_args(argv)
+    obs_dir = resolve_obs_dir(args.work_dir)
+    if obs_dir is None:
+        print(f'no obs/ telemetry under {args.work_dir!r} — was the run '
+              'launched with --obs / obs = True?')
+        return 1
+    try:
+        while True:
+            snap = current_status(obs_dir)
+            if args.json:
+                print(json.dumps(snap, indent=2, default=str))
+            elif args.watch is not None:
+                # clear + home, then one full frame
+                print('\x1b[2J\x1b[H' + f'== status: {obs_dir} ==')
+                print(render_status(snap), end='', flush=True)
+            else:
+                print(render_status(snap), end='')
+            if args.watch is None or snap.get('state') == 'done':
+                return 0
+            time.sleep(args.watch)
+    except KeyboardInterrupt:
+        return 0

@@ -1,0 +1,267 @@
+"""Prometheus text exposition + the driver's opt-in HTTP endpoint.
+
+``render_prometheus`` turns a ``MetricsRegistry.snapshot()`` plus the
+aggregated task-status snapshot into Prometheus text format 0.0.4
+(``oct_``-prefixed families: counters as ``_total``, gauges with a
+``_max`` high-water companion, histograms with cumulative ``le``
+buckets, and per-task gauges labeled ``{task="..."}``).
+
+``ObsHTTPServer`` is a stdlib ``http.server`` on a daemon thread serving
+
+- ``/metrics``  — Prometheus text (scrape target)
+- ``/status``   — the run status snapshot as JSON
+- ``/healthz``  — liveness probe (``ok``)
+
+Enabled only by ``--obs-port`` (port 0 = ephemeral; the bound port is
+logged and written to ``{obs_dir}/http.json`` so tooling can find it).
+Same never-fail contract as the tracer: a failed bind or a handler
+exception can never fail or slow the run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+import re
+import threading
+import time
+from typing import Dict, List, Optional
+
+from opencompass_tpu.obs.live import current_status
+
+PROM_CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
+HTTP_INFO_FILE = 'http.json'
+
+
+def sanitize_metric_name(name: str) -> str:
+    """Registry names are dotted (``runner.slot_wait_seconds``);
+    Prometheus allows ``[a-zA-Z_:][a-zA-Z0-9_:]*``."""
+    name = re.sub(r'[^a-zA-Z0-9_:]', '_', name)
+    if not name or not re.match(r'[a-zA-Z_:]', name[0]):
+        name = '_' + name
+    return name
+
+
+def escape_label_value(value: str) -> str:
+    """Label-value escaping per the text format: backslash, double
+    quote, and newline."""
+    return (str(value).replace('\\', '\\\\').replace('"', '\\"')
+            .replace('\n', '\\n'))
+
+
+def _fmt_number(value) -> str:
+    if isinstance(value, bool):
+        return '1' if value else '0'
+    if isinstance(value, float) and value == int(value) \
+            and abs(value) < 1e15:
+        return str(int(value))
+    return repr(value) if isinstance(value, float) else str(value)
+
+
+def _line(name: str, value, labels: Optional[Dict] = None) -> str:
+    if labels:
+        inner = ','.join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in labels.items())
+        return f'{name}{{{inner}}} {_fmt_number(value)}'
+    return f'{name} {_fmt_number(value)}'
+
+
+def render_prometheus(metrics_snapshot: Optional[Dict] = None,
+                      status: Optional[Dict] = None,
+                      prefix: str = 'oct') -> str:
+    """Prometheus text format from a registry snapshot
+    (``{counters, gauges, histograms}``) + run-status task gauges."""
+    out: List[str] = []
+    snap = metrics_snapshot or {}
+
+    for name in sorted(snap.get('counters') or {}):
+        metric = f'{prefix}_{sanitize_metric_name(name)}_total'
+        out.append(f'# TYPE {metric} counter')
+        out.append(_line(metric, snap['counters'][name]))
+
+    for name in sorted(snap.get('gauges') or {}):
+        g = snap['gauges'][name]
+        metric = f'{prefix}_{sanitize_metric_name(name)}'
+        if g.get('value') is not None:
+            out.append(f'# TYPE {metric} gauge')
+            out.append(_line(metric, g['value']))
+        if g.get('max') is not None:
+            out.append(f'# TYPE {metric}_max gauge')
+            out.append(_line(f'{metric}_max', g['max']))
+
+    for name in sorted(snap.get('histograms') or {}):
+        h = snap['histograms'][name]
+        metric = f'{prefix}_{sanitize_metric_name(name)}'
+        out.append(f'# TYPE {metric} histogram')
+        # registry counts are per-bucket; the text format wants
+        # cumulative counts per upper bound, ending at le="+Inf"==count
+        cum = 0
+        for ub, c in zip(h.get('buckets') or [], h.get('counts') or []):
+            cum += c
+            out.append(_line(f'{metric}_bucket', cum,
+                             {'le': _fmt_number(float(ub))}))
+        out.append(_line(f'{metric}_bucket', h.get('count', cum),
+                         {'le': '+Inf'}))
+        out.append(_line(f'{metric}_sum', h.get('sum', 0)))
+        out.append(_line(f'{metric}_count', h.get('count', 0)))
+
+    if status:
+        out.extend(_render_status_gauges(status, prefix))
+    return '\n'.join(out) + '\n'
+
+
+def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
+    out: List[str] = []
+    o = status.get('overall') or {}
+    if o.get('progress') is not None:
+        out.append(f'# TYPE {prefix}_run_progress gauge')
+        out.append(_line(f'{prefix}_run_progress', o['progress']))
+    if o.get('eta_seconds') is not None:
+        out.append(f'# TYPE {prefix}_run_eta_seconds gauge')
+        out.append(_line(f'{prefix}_run_eta_seconds', o['eta_seconds']))
+    for state in ('ok', 'failed', 'running', 'pending'):
+        if state in o:
+            out.append(f'# TYPE {prefix}_tasks_{state} gauge')
+            out.append(_line(f'{prefix}_tasks_{state}', o[state]))
+    slots = status.get('slots') or {}
+    for key in ('in_use', 'total'):
+        if slots.get(key) is not None:
+            out.append(f'# TYPE {prefix}_slots_{key} gauge')
+            out.append(_line(f'{prefix}_slots_{key}', slots[key]))
+
+    tasks = status.get('tasks') or {}
+    per_task = [
+        ('task_progress', 'progress'),
+        ('task_examples_done', 'done'),
+        ('task_examples_total', 'total'),
+        ('task_tokens_per_sec', 'tokens_per_sec'),
+        ('task_last_batch_seconds', 'last_batch_seconds'),
+        ('task_heartbeat_age_seconds', 'heartbeat_age_seconds'),
+    ]
+    for metric_suffix, field in per_task:
+        lines = []
+        for name in sorted(tasks):
+            value = tasks[name].get(field)
+            if value is not None:
+                lines.append(_line(f'{prefix}_{metric_suffix}', value,
+                                   {'task': name}))
+        if lines:
+            out.append(f'# TYPE {prefix}_{metric_suffix} gauge')
+            out.extend(lines)
+    return out
+
+
+class ObsHTTPServer:
+    """Opt-in telemetry endpoint on the run driver.
+
+    Args:
+        obs_dir: the run's ``obs/`` directory (status + heartbeats).
+        port: TCP port; 0 binds an ephemeral one (see :attr:`port`).
+        registry: the driver tracer's live ``MetricsRegistry`` (its
+            snapshot is rendered on every ``/metrics`` scrape).
+    """
+
+    def __init__(self, obs_dir: str, port: int = 0, registry=None):
+        self.obs_dir = obs_dir
+        self.requested_port = port
+        self.registry = registry
+        self.port: Optional[int] = None
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> Optional[int]:
+        """Bind + serve on a daemon thread; returns the bound port, or
+        None when the bind failed (never raises)."""
+        try:
+            from http.server import (BaseHTTPRequestHandler,
+                                     ThreadingHTTPServer)
+            server = self
+
+            class Handler(BaseHTTPRequestHandler):
+
+                def log_message(self, fmt, *args):  # no stderr chatter
+                    pass
+
+                def _send(self, code: int, content_type: str,
+                          body: bytes):
+                    self.send_response(code)
+                    self.send_header('Content-Type', content_type)
+                    self.send_header('Content-Length', str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+
+                def do_GET(self):
+                    try:
+                        path = self.path.split('?', 1)[0]
+                        if path == '/healthz':
+                            self._send(200, 'text/plain; charset=utf-8',
+                                       b'ok\n')
+                        elif path == '/status':
+                            body = json.dumps(
+                                current_status(server.obs_dir),
+                                indent=2, default=str).encode('utf-8')
+                            self._send(200,
+                                       'application/json; charset=utf-8',
+                                       body)
+                        elif path == '/metrics':
+                            snap = server.registry.snapshot() \
+                                if server.registry is not None else {}
+                            body = render_prometheus(
+                                snap,
+                                status=current_status(server.obs_dir),
+                            ).encode('utf-8')
+                            self._send(200, PROM_CONTENT_TYPE, body)
+                        else:
+                            self._send(404,
+                                       'text/plain; charset=utf-8',
+                                       b'not found\n')
+                    except Exception:
+                        try:
+                            self._send(500,
+                                       'text/plain; charset=utf-8',
+                                       b'error\n')
+                        except Exception:
+                            pass
+
+            self._httpd = ThreadingHTTPServer(
+                ('127.0.0.1', self.requested_port), Handler)
+            self._httpd.daemon_threads = True
+            self.port = self._httpd.server_address[1]
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, name='obs-http',
+                daemon=True)
+            self._thread.start()
+            self._write_info()
+            return self.port
+        except Exception:
+            self._httpd = None
+            self.port = None
+            return None
+
+    def _write_info(self):
+        """``{obs_dir}/http.json`` lets tooling (and the e2e smoke
+        test) discover an ephemeral port."""
+        try:
+            from opencompass_tpu.obs.live import atomic_write_json
+            atomic_write_json(
+                osp.join(self.obs_dir, HTTP_INFO_FILE),
+                {'port': self.port, 'pid': os.getpid(),
+                 'ts': round(time.time(), 3)})
+        except Exception:
+            pass
+
+    def stop(self):
+        try:
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            if self._thread is not None:
+                self._thread.join(timeout=5)
+        except Exception:
+            pass
+        finally:
+            self._httpd = None
+            try:  # a dead run must not advertise a stale port
+                os.unlink(osp.join(self.obs_dir, HTTP_INFO_FILE))
+            except OSError:
+                pass

@@ -297,6 +297,9 @@ def build_report(work_dir: str, trace: Optional[str] = None) -> Dict:
 
     critical = _critical_path(roots[0]) if roots else []
     return {
+        # report schema version: CI diffs `trace --json` output across
+        # runs, so additions are fine but renames/removals bump this
+        'v': 1,
         'events_path': path,
         'trace': trace,
         'trace_ids': all_trace_ids,  # every trace seen (resumed runs >1)
@@ -459,7 +462,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help='run work dir (or its obs/ dir, a parent '
                         'outputs dir, or an events.jsonl path)')
     parser.add_argument('--json', action='store_true',
-                        help='emit the raw report dict as JSON')
+                        help='emit the report (critical path, per-task '
+                        'breakdown, failures, metrics) as versioned '
+                        'machine-readable JSON for CI run-trend diffing')
     parser.add_argument('--trace', default=None,
                         help='report a specific trace id (resumed runs '
                         'append several to one events.jsonl; default: '

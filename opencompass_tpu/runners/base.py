@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from opencompass_tpu.config import ConfigDict
 from opencompass_tpu.obs import get_tracer
 from opencompass_tpu.registry import TASKS
+from opencompass_tpu.utils.abbr import task_abbr_from_cfg
 from opencompass_tpu.utils.logging import get_logger
 from opencompass_tpu.utils.notify import LarkReporter
 
@@ -41,6 +42,7 @@ class BaseRunner:
         task_type = self.task_cfg.get('type')
         type_name = task_type if isinstance(task_type, str) \
             else getattr(task_type, '__name__', str(task_type))
+        agg = self._start_status_aggregator(tracer, type_name, tasks)
         # the runner span is the parent every launched task nests under
         # (pool threads and subprocesses reference it explicitly — see
         # LocalRunner._launch / Tracer.propagation_env)
@@ -52,8 +54,46 @@ class BaseRunner:
                                           if code != 0))
             finally:
                 self._runner_span = None
+                self._status_agg = None
+                if agg is not None:
+                    agg.stop()
         self.summarize(status)
         return status
+
+    def _start_status_aggregator(self, tracer, type_name: str,
+                                 tasks: List[Dict]):
+        """Background thread folding task heartbeats + launch states
+        into ``{work_dir}/obs/status.json`` while tasks run (the live
+        telemetry plane's run-level snapshot).  Traced runs only; any
+        telemetry failure leaves the run untouched."""
+        self._status_agg = None
+        if not tracer.enabled:
+            return None
+        try:
+            from opencompass_tpu.obs.live import StatusAggregator
+            agg = StatusAggregator(
+                tracer.obs_dir, runner=type_name,
+                slots_probe=getattr(self, 'slot_state', None))
+            # pre-register every task as pending — names derived the
+            # same way BaseTask.name is (prefix + abbr), without paying
+            # task construction twice on a 100+-task sweep
+            cls = self.task_cfg.get('type')
+            if isinstance(cls, str):
+                cls = TASKS.get(cls)
+            prefix = getattr(cls, 'name_prefix', '')
+            names = []
+            for task_cfg in tasks:
+                try:
+                    names.append(prefix + task_abbr_from_cfg(task_cfg))
+                except Exception:
+                    pass   # a bad cfg fails in launch(), not here
+            agg.set_tasks(names)
+            agg.start()
+            self._status_agg = agg
+            return agg
+        except Exception:
+            self._status_agg = None
+            return None
 
     @abstractmethod
     def launch(self, tasks: List[Dict]) -> List[Tuple[str, int]]:
@@ -90,9 +130,12 @@ class BaseRunner:
         so cluster runners (slurm/cloud) nest their subprocess tasks the
         same way LocalRunner does."""
         tracer = get_tracer()
+        agg = getattr(self, '_status_agg', None)
         log_path = task.get_log_path('out')
         os.makedirs(osp.dirname(log_path), exist_ok=True)
         returncode = 1
+        if agg is not None:
+            agg.task_started(task.name)
         with tracer.span(f'task:{task.name}',
                          parent=getattr(self, '_runner_span', None),
                          num_devices=task.num_devices) as span:
@@ -113,12 +156,16 @@ class BaseRunner:
                 returncode = result.returncode
                 if not self.job_failed(returncode, task):
                     span.set_attrs(returncode=0)
+                    if agg is not None:
+                        agg.task_finished(task.name, 0)
                     return 0
                 self.logger.warning(
                     f'{task.name} attempt {attempt + 1} failed '
                     f'(code {returncode}); retrying')
             returncode = returncode or 1
             span.set_attrs(returncode=returncode)
+        if agg is not None:
+            agg.task_finished(task.name, returncode)
         return returncode
 
     @staticmethod

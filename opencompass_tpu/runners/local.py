@@ -65,14 +65,26 @@ class LocalRunner(BaseRunner):
         self.retry = retry
         self._slot_lock = threading.Lock()
         self._slots = [False] * self.num_devices  # True = in use
+        # watchdog wake period; tests shrink it to exercise kill paths
+        self._watchdog_poll_s = 5.0
+
+    def slot_state(self) -> Tuple[int, int]:
+        """(slots in use, slots total) — the status aggregator's probe."""
+        with self._slot_lock:
+            return sum(self._slots), self.num_devices
 
     def launch(self, tasks: List[Dict]) -> List[Tuple[str, int]]:
         if self.debug:
+            agg = getattr(self, '_status_agg', None)
             status = []
             for task_cfg in tasks:
                 task = self.build_task(task_cfg)
                 self.logger.info(f'Running {task.name} in-process (debug)')
+                if agg is not None:
+                    agg.task_started(task.name)
                 task.run()
+                if agg is not None:
+                    agg.task_finished(task.name, 0)
                 status.append((task.name, 0))
             return status
 
@@ -105,11 +117,14 @@ class LocalRunner(BaseRunner):
 
     def _launch(self, task_cfg: Dict) -> Tuple[str, int]:
         tracer = get_tracer()
+        agg = getattr(self, '_status_agg', None)
         task = self.build_task(task_cfg)
         name = task.name
         wait0 = time.perf_counter()
         chip_ids = self._acquire_slots(task.num_devices)
         slot_wait = time.perf_counter() - wait0
+        if agg is not None:
+            agg.task_started(name)
         # only chip-holding tasks feed the contention histogram: eval
         # tasks (num_devices=0) acquire instantly and would bury the
         # real waits under a pile of ~0s samples
@@ -140,6 +155,8 @@ class LocalRunner(BaseRunner):
                 self.logger.exception(f'task {name} failed to launch')
             finally:
                 self._release_slots(chip_ids)
+                if agg is not None:
+                    agg.task_finished(name, returncode)
             span.set_attrs(returncode=returncode)
         return name, returncode
 
@@ -202,9 +219,21 @@ class LocalRunner(BaseRunner):
     def _run_once(self, cmd: str, env: Dict, log_path: str,
                   name: str, attempt: int = 0) -> int:
         """Run the task command under the watchdog: kill on wall-clock
-        timeout or when the log file stops growing (hung process)."""
+        timeout or when the task stops making progress (hung process).
+
+        Liveness is the freshest of two signals: log-file growth and the
+        task's heartbeat file mtime (``obs/progress/<task>.json``).  A
+        traced task that computes silently past ``stall_timeout`` — a
+        long XLA compile, a quiet scoring loop — keeps heartbeating and
+        is no longer falsely killed; untraced runs fall back to the
+        log-growth heuristic alone."""
         watchdog = self.task_timeout is not None \
             or self.stall_timeout is not None
+        tracer = get_tracer()
+        hb_path = None
+        if tracer.enabled:
+            from opencompass_tpu.obs.live import heartbeat_path
+            hb_path = heartbeat_path(tracer.obs_dir, name)
         if watchdog:
             # stall detection reads the log file's size; python
             # block-buffers redirected stdout (~8 KB), which would make a
@@ -243,7 +272,7 @@ class LocalRunner(BaseRunner):
                 last_size, last_growth = -1, time.time()
                 while True:
                     try:
-                        return proc.wait(timeout=5)
+                        return proc.wait(timeout=self._watchdog_poll_s)
                     except subprocess.TimeoutExpired:
                         pass
                     now = time.time()
@@ -266,9 +295,19 @@ class LocalRunner(BaseRunner):
                             size = -1
                         if size != last_size:
                             last_size, last_growth = size, now
-                        elif now - last_growth > self.stall_timeout:
+                        # prefer heartbeat freshness over log growth: a
+                        # task in a long silent compute still heartbeats
+                        last_alive = last_growth
+                        if hb_path is not None:
+                            try:
+                                last_alive = max(
+                                    last_alive, os.stat(hb_path).st_mtime)
+                            except OSError:
+                                pass   # no heartbeat yet: log rules
+                        if now - last_alive > self.stall_timeout:
                             self.logger.error(
-                                f'{name}: killed — log stalled for '
+                                f'{name}: killed — no log growth or '
+                                f'heartbeat for '
                                 f'{self.stall_timeout:.0f}s')
                             tracer = get_tracer()
                             tracer.event(

@@ -31,6 +31,9 @@ def main():
     # injected by the runner; no-op when the run is not traced)
     tracer = obs.init_task_obs(cfg)
     task = cls(cfg)
+    # live progress file for the driver's status aggregator / stall
+    # watchdog (NoopHeartbeat when the run is untraced)
+    heartbeat = obs.init_task_heartbeat(task.name)
     logger.info(f'Task {task.name}')
     start = time.time()
     try:
@@ -40,6 +43,10 @@ def main():
                 task.run()
             finally:
                 shutdown()
+        heartbeat.mark('done')
+    except BaseException:
+        heartbeat.mark('failed')
+        raise
     finally:
         tracer.close()
     logger.info(f'time elapsed: {time.time() - start:.2f}s')

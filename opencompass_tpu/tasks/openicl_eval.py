@@ -12,7 +12,7 @@ import os
 import os.path as osp
 from typing import Dict, List, Optional
 
-from opencompass_tpu.obs import get_tracer
+from opencompass_tpu.obs import get_heartbeat, get_tracer
 from opencompass_tpu.registry import (ICL_EVALUATORS, TASKS,
                                       TEXT_POSTPROCESSORS)
 from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,
@@ -75,6 +75,9 @@ class OpenICLEvalTask(BaseTask):
 
     def run(self):
         tracer = get_tracer()
+        heartbeat = get_heartbeat()
+        units_total = sum(len(d) for d in self.dataset_cfgs)
+        units_done = 0
         for i, model_cfg in enumerate(self.model_cfgs):
             for dataset_cfg in self.dataset_cfgs[i]:
                 self.model_cfg = model_cfg
@@ -89,10 +92,16 @@ class OpenICLEvalTask(BaseTask):
                     osp.join(self.work_dir, 'results'))
                 if osp.exists(out_path):
                     tracer.event('eval_skip', model=m_abbr, dataset=d_abbr)
+                    units_done += 1
+                    heartbeat.set_unit(units_done, units_total)
                     continue
+                heartbeat.set_unit(units_done, units_total,
+                                   f'{m_abbr}/{d_abbr}')
                 with tracer.span(f'eval:{m_abbr}/{d_abbr}') as span:
                     self._score(out_path)
                     span.set_attrs(scored=osp.exists(out_path))
+                units_done += 1
+                heartbeat.set_unit(units_done, units_total)
 
     def _load_predictions(self) -> Optional[List[Dict]]:
         """Prediction records in index order, stitching `_k` shards."""

@@ -13,7 +13,8 @@ from __future__ import annotations
 import os.path as osp
 from typing import Any, Dict
 
-from opencompass_tpu.obs import device_memory_attrs, get_tracer
+from opencompass_tpu.obs import (device_memory_attrs, get_heartbeat,
+                                 get_tracer)
 from opencompass_tpu.parallel.distributed import (broadcast_object,
                                                   is_main_process)
 from opencompass_tpu.registry import (ICL_INFERENCERS, ICL_PROMPT_TEMPLATES,
@@ -51,11 +52,17 @@ class OpenICLInferTask(BaseTask):
 
     def run(self):
         tracer = get_tracer()
+        heartbeat = get_heartbeat()
+        units_total = sum(len(d) for d in self.dataset_cfgs)
+        units_done = 0
         for i, model_cfg in enumerate(self.model_cfgs):
             self.max_out_len = model_cfg.get('max_out_len')
             self.batch_size = model_cfg.get('batch_size', 1)
             self.max_seq_len = model_cfg.get('max_seq_len')
             model = build_model_from_cfg(model_cfg)
+            # heartbeat writes report live tokens/s off the model's
+            # perf counters
+            heartbeat.bind_perf(getattr(model, 'perf', None))
 
             for dataset_cfg in self.dataset_cfgs[i]:
                 self.model_cfg = model_cfg
@@ -72,7 +79,11 @@ class OpenICLInferTask(BaseTask):
                                     if is_main_process() else None):
                     tracer.event('infer_skip', model=m_abbr,
                                  dataset=d_abbr)
+                    units_done += 1
+                    heartbeat.set_unit(units_done, units_total)
                     continue
+                heartbeat.set_unit(units_done, units_total,
+                                   f'{m_abbr}/{d_abbr}')
                 perf_path = trace_dir = None
                 if is_main_process():
                     perf_path = get_infer_output_path(
@@ -103,6 +114,8 @@ class OpenICLInferTask(BaseTask):
                                     tracer.gauge(
                                         'device.peak_bytes_in_use').set(
                                             mem['peak_bytes_in_use'])
+                units_done += 1
+                heartbeat.set_unit(units_done, units_total)
                 if prof.record and is_main_process():
                     logger.info(
                         f'perf: {prof.record.get("samples_per_sec", "?")} '

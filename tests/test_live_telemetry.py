@@ -1,0 +1,477 @@
+"""Live telemetry plane: task heartbeats (atomic writes, torn-file
+tolerance), run status aggregation, Prometheus text exposition, the
+driver HTTP endpoint, the `cli status` command, and the heartbeat-aware
+stall watchdog."""
+import json
+import os
+import os.path as osp
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+FIXTURE_RUN = osp.join(REPO, 'tests', 'fixtures', 'obs_run')
+
+
+@pytest.fixture(autouse=True)
+def _isolated_tracer():
+    from opencompass_tpu import obs
+    obs.reset_obs()
+    yield
+    obs.reset_obs()
+
+
+def _cpu_env():
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    return env
+
+
+# -- heartbeat writer -------------------------------------------------------
+
+def test_heartbeat_schema_and_atomic_write(tmp_path):
+    from opencompass_tpu.obs.live import Heartbeat
+    obs_dir = str(tmp_path / 'obs')
+    hb = Heartbeat(obs_dir, 'OpenICLInfer[tiny/demo-gen]', interval=0.0)
+    hb.set_unit(0, 2, 'tiny/demo-gen')
+    hb.progress(5, 100, batch_seconds=0.125)
+    with open(hb.path) as f:
+        rec = json.load(f)
+    assert rec['v'] == 1
+    assert rec['task'] == 'OpenICLInfer[tiny/demo-gen]'
+    assert rec['pid'] == os.getpid()
+    assert rec['state'] == 'running'
+    assert rec['unit'] == 'tiny/demo-gen'
+    assert (rec['units_done'], rec['units_total']) == (0, 2)
+    assert (rec['done'], rec['total']) == (5, 100)
+    assert rec['last_batch_seconds'] == 0.125
+    assert isinstance(rec['ts'], float) and rec['ts'] > 0
+    # atomic write protocol leaves no temp droppings behind
+    leftovers = [f for f in os.listdir(osp.dirname(hb.path))
+                 if f.endswith('.tmp')]
+    assert leftovers == []
+    hb.mark('done')
+    with open(hb.path) as f:
+        rec = json.load(f)
+    assert rec['state'] == 'done'
+    assert rec['units_done'] == rec['units_total'] == 2
+
+
+def test_heartbeat_rate_limited_and_add(tmp_path):
+    from opencompass_tpu.obs.live import Heartbeat
+    hb = Heartbeat(str(tmp_path), 't', interval=3600.0)
+    hb.progress(1, 10, force=True)        # forced: lands on disk
+    hb.progress(2, 10)                    # rate-limited: skipped
+    hb.add(3)                             # rate-limited too
+    with open(hb.path) as f:
+        assert json.load(f)['done'] == 1
+    hb.mark('done')                       # terminal: always written
+    with open(hb.path) as f:
+        rec = json.load(f)
+    assert rec['state'] == 'done' and rec['done'] == 5  # add kept state
+
+
+def test_heartbeat_never_fails_on_unwritable_dir(tmp_path):
+    """The never-fail contract: a broken telemetry sink cannot raise
+    into the task."""
+    from opencompass_tpu.obs.live import Heartbeat
+    blocker = tmp_path / 'blocker'
+    blocker.write_text('a file where obs/ should be')
+    hb = Heartbeat(str(blocker / 'obs'), 't', interval=0.0)
+    hb.set_unit(0, 1, 'x')
+    hb.progress(1, 2, force=True)
+    hb.add(1)
+    hb.mark('done')                       # none of these may raise
+
+
+def test_heartbeat_path_deterministic_and_collision_free(tmp_path):
+    from opencompass_tpu.obs.live import heartbeat_path
+    a = heartbeat_path('/obs', 'OpenICLInfer[model/ds one]')
+    b = heartbeat_path('/obs', 'OpenICLInfer[model/ds_one]')
+    assert a == heartbeat_path('/obs', 'OpenICLInfer[model/ds one]')
+    assert a != b                         # sanitize-identical names differ
+    base = osp.basename(a)
+    assert base.endswith('.json')
+    assert '/' not in base and '[' not in base and ' ' not in base
+
+
+def test_init_task_heartbeat_follows_tracer(tmp_path):
+    from opencompass_tpu import obs
+    assert not obs.init_task_heartbeat('t').enabled   # untraced: noop
+    obs.init_obs(str(tmp_path))
+    hb = obs.init_task_heartbeat('t')
+    assert hb.enabled and obs.get_heartbeat() is hb
+    obs.reset_obs()
+    assert not obs.get_heartbeat().enabled            # reset restores noop
+
+
+def test_heartbeat_keepalive_refreshes_during_silent_compute(tmp_path):
+    """A task blocked in one long device call makes no progress ticks;
+    the keepalive thread must still refresh the file (the stall
+    watchdog's liveness signal), and stand down once the task ends."""
+    from opencompass_tpu.obs.live import Heartbeat
+    hb = Heartbeat(str(tmp_path), 't', interval=0.1, keepalive=True)
+    hb.progress(1, 10, force=True)
+    mtime0 = os.stat(hb.path).st_mtime
+    deadline = time.time() + 5
+    while time.time() < deadline:          # no progress calls here
+        if os.stat(hb.path).st_mtime > mtime0:
+            break
+        time.sleep(0.05)
+    assert os.stat(hb.path).st_mtime > mtime0, 'keepalive never fired'
+    hb.mark('done')
+    time.sleep(0.3)                        # give a stray beat a chance
+    mtime1 = os.stat(hb.path).st_mtime
+    time.sleep(0.3)
+    assert os.stat(hb.path).st_mtime == mtime1, \
+        'keepalive kept beating after mark()'
+    with open(hb.path) as f:
+        assert json.load(f)['state'] == 'done'
+
+
+# -- readers / aggregation --------------------------------------------------
+
+def _write_heartbeat(obs_dir, name, **fields):
+    from opencompass_tpu.obs.live import atomic_write_json, heartbeat_path
+    rec = {'v': 1, 'task': name, 'pid': 1, 'ts': time.time(),
+           'state': 'running', 'unit': None, 'units_done': 0,
+           'units_total': None, 'done': 0, 'total': None}
+    rec.update(fields)
+    atomic_write_json(heartbeat_path(obs_dir, name), rec)
+    return rec
+
+
+def test_read_heartbeats_tolerates_torn_files(tmp_path):
+    """Regression: a half-written progress file never crashes the
+    aggregator — it is skipped and the valid files still load."""
+    from opencompass_tpu.obs.live import build_status, read_heartbeats
+    obs_dir = str(tmp_path)
+    _write_heartbeat(obs_dir, 'good-task', done=3, total=9)
+    progress = tmp_path / 'progress'
+    (progress / 'torn.json').write_text('{"task": "x", "do')  # mid-write
+    (progress / 'notdict.json').write_text('[1, 2, 3]')
+    (progress / 'empty.json').write_text('')
+    (progress / 'ignored.txt').write_text('not json at all')
+    hbs = read_heartbeats(obs_dir)
+    assert list(hbs) == ['good-task']
+    assert hbs['good-task']['done'] == 3
+    assert hbs['good-task']['heartbeat_age_seconds'] >= 0
+    snap = build_status(obs_dir)          # and the full fold survives too
+    assert snap['overall']['n_tasks'] == 1
+
+
+def test_build_status_fractions_eta_and_state_merge(tmp_path):
+    from opencompass_tpu.obs.live import build_status
+    obs_dir = str(tmp_path)
+    # mid-unit progress: 1 finished pair + 50/100 of the second = 75%
+    _write_heartbeat(obs_dir, 'infer-a', units_done=1, units_total=2,
+                     done=50, total=100, tokens_per_sec=99.5)
+    # heartbeat says running, runner verdict says failed: runner wins
+    _write_heartbeat(obs_dir, 'infer-b', done=10, total=10)
+    now = time.time()
+    snap = build_status(obs_dir, runner_state={
+        'runner': 'OpenICLInferTask', 'started': now - 30.0,
+        'state': 'running',
+        'tasks': {'infer-a': {'state': 'running'},
+                  'infer-b': {'state': 'failed', 'returncode': 1},
+                  'infer-c': {'state': 'pending'}},
+        'slots': {'in_use': 2, 'total': 4}}, now=now)
+    tasks = snap['tasks']
+    assert tasks['infer-a']['progress'] == pytest.approx(0.75)
+    assert tasks['infer-a']['tokens_per_sec'] == 99.5
+    assert tasks['infer-b']['state'] == 'failed'
+    assert tasks['infer-b']['returncode'] == 1
+    assert tasks['infer-c']['state'] == 'pending'
+    o = snap['overall']
+    assert o['n_tasks'] == 3
+    # (0.75 + 1.0 [failed but fully progressed] + 0.0) / 3
+    assert o['progress'] == pytest.approx((0.75 + 1.0 + 0.0) / 3,
+                                          abs=1e-4)
+    assert o['running'] == 1 and o['failed'] == 1 and o['pending'] == 1
+    # eta = elapsed * (1-p)/p
+    p = o['progress']
+    assert o['eta_seconds'] == pytest.approx(30.0 * (1 - p) / p, abs=0.5)
+    assert snap['slots'] == {'in_use': 2, 'total': 4}
+
+
+def test_status_aggregator_persists_and_finalizes(tmp_path):
+    from opencompass_tpu.obs.live import StatusAggregator, load_status
+    obs_dir = str(tmp_path)
+    (tmp_path / 'progress').mkdir()
+    (tmp_path / 'progress' / 'torn.json').write_text('{"task"')  # hostile
+    agg = StatusAggregator(obs_dir, runner='OpenICLInferTask',
+                           interval=0.05, slots_probe=lambda: (1, 2))
+    agg.set_tasks(['a', 'b'])
+    agg.start()
+    agg.task_started('a')
+    deadline = time.time() + 5
+    snap = None
+    while time.time() < deadline:
+        snap = load_status(obs_dir)
+        if snap and snap['tasks'].get('a', {}).get('state') == 'running':
+            break
+        time.sleep(0.02)
+    assert snap and snap['state'] == 'running'
+    assert snap['tasks']['a']['state'] == 'running'
+    assert snap['tasks']['b']['state'] == 'pending'
+    assert snap['slots'] == {'in_use': 1, 'total': 2}
+    agg.task_finished('a', 0)
+    agg.task_finished('b', 0)
+    agg.stop()
+    snap = load_status(obs_dir)
+    assert snap['state'] == 'done'
+    assert snap['overall']['progress'] == 1.0
+    assert snap['overall']['ok'] == 2
+    assert snap['overall']['eta_seconds'] is None
+
+
+def test_run_marker_overlay_between_phases(tmp_path):
+    """A phase aggregator finishing is not the run finishing: while the
+    driver's run.json says running (live pid), a 'done' phase snapshot
+    reads back as 'running'; once the driver exits, 'done' wins."""
+    from opencompass_tpu.obs.live import (StatusAggregator, current_status,
+                                          mark_run)
+    obs_dir = str(tmp_path)
+    mark_run(obs_dir, 'running')           # our own (alive) pid
+    agg = StatusAggregator(obs_dir, runner='OpenICLInferTask', interval=60)
+    agg.set_tasks(['a'])
+    agg.task_finished('a', 0)
+    agg.stop()                             # phase snapshot: state done
+    assert current_status(obs_dir)['state'] == 'running'
+    mark_run(obs_dir, 'done')
+    assert current_status(obs_dir)['state'] == 'done'
+
+
+def test_run_marker_dead_pid_is_ignored(tmp_path):
+    """A crashed driver's stale 'running' marker must not pin the
+    status at running forever."""
+    from opencompass_tpu.obs.live import (atomic_write_json,
+                                          current_status, mark_run)
+    obs_dir = str(tmp_path)
+    import subprocess
+    proc = subprocess.Popen(['sleep', '0.05'])
+    proc.wait()                            # a pid known to be dead
+    atomic_write_json(osp.join(obs_dir, 'run.json'),
+                      {'v': 1, 'state': 'running', 'pid': proc.pid,
+                       'ts': time.time(), 'started': time.time()})
+    _write_heartbeat(obs_dir, 'a', state='done', units_done=1,
+                     units_total=1)
+    snap = current_status(obs_dir)
+    assert snap['state'] == 'done'         # marker overruled
+
+
+def test_aggregator_anchors_eta_at_run_start(tmp_path):
+    """A later phase's ETA extrapolates over the whole run (run.json
+    started), not the few seconds since its own phase began."""
+    from opencompass_tpu.obs.live import (StatusAggregator,
+                                          atomic_write_json, load_status)
+    obs_dir = str(tmp_path)
+    atomic_write_json(osp.join(obs_dir, 'run.json'),
+                      {'v': 1, 'state': 'running', 'pid': os.getpid(),
+                       'ts': time.time(), 'started': time.time() - 100.0})
+    agg = StatusAggregator(obs_dir, runner='OpenICLEvalTask', interval=60)
+    agg.set_tasks(['e1', 'e2'])
+    agg.task_finished('e1', 0)
+    agg.write_snapshot()
+    snap = load_status(obs_dir)
+    assert snap['elapsed_seconds'] == pytest.approx(100.0, abs=2.0)
+    # p=0.5 over ~100s elapsed -> ~100s remaining, not ~0
+    assert snap['overall']['eta_seconds'] == pytest.approx(100.0, rel=0.1)
+
+
+# -- prometheus exposition --------------------------------------------------
+
+def test_prometheus_counters_and_gauges():
+    from opencompass_tpu.obs.metrics import MetricsRegistry
+    from opencompass_tpu.obs.promexport import render_prometheus
+    reg = MetricsRegistry()
+    reg.counter('runner.task_retries').inc(3)
+    reg.gauge('device.peak_bytes_in_use').set(7)
+    reg.gauge('device.peak_bytes_in_use').set(4)
+    text = render_prometheus(reg.snapshot())
+    assert '# TYPE oct_runner_task_retries_total counter' in text
+    assert 'oct_runner_task_retries_total 3' in text
+    assert 'oct_device_peak_bytes_in_use 4' in text
+    assert 'oct_device_peak_bytes_in_use_max 7' in text
+    assert text.endswith('\n')
+
+
+def test_prometheus_histogram_cumulative_invariant():
+    """Registry counts are per-bucket; the exposition must be
+    cumulative, monotone, and end at le=\"+Inf\" == count."""
+    import re
+    from opencompass_tpu.obs.metrics import MetricsRegistry
+    from opencompass_tpu.obs.promexport import render_prometheus
+    reg = MetricsRegistry()
+    h = reg.histogram('inferencer.batch_seconds', buckets=[0.1, 1.0, 10.0])
+    for v in (0.05, 0.09, 0.5, 2.0, 99.0):
+        h.observe(v)
+    text = render_prometheus(reg.snapshot())
+    buckets = re.findall(
+        r'oct_inferencer_batch_seconds_bucket\{le="([^"]+)"\} (\d+)', text)
+    assert [b[0] for b in buckets] == ['0.1', '1', '10', '+Inf']
+    counts = [int(b[1]) for b in buckets]
+    assert counts == [2, 3, 4, 5]                 # cumulative, monotone
+    assert counts == sorted(counts)
+    assert 'oct_inferencer_batch_seconds_count 5' in text
+    assert 'oct_inferencer_batch_seconds_sum' in text
+
+
+def test_prometheus_label_escaping_and_name_sanitizing():
+    from opencompass_tpu.obs.promexport import (render_prometheus,
+                                                sanitize_metric_name)
+    assert sanitize_metric_name('a.b-c/d') == 'a_b_c_d'
+    assert sanitize_metric_name('0weird')[0] == '_'
+    hostile = 'task "quoted" back\\slash\nnewline'
+    status = {'tasks': {hostile: {'progress': 0.5}},
+              'overall': {}, 'slots': {}}
+    text = render_prometheus({}, status=status)
+    line = [ln for ln in text.splitlines()
+            if ln.startswith('oct_task_progress{')][0]
+    assert '\\"quoted\\"' in line
+    assert 'back\\\\slash' in line
+    assert '\\nnewline' in line
+    assert '\n' not in line                       # stayed one sample line
+
+
+def test_http_server_endpoints(tmp_path):
+    from opencompass_tpu.obs.live import StatusAggregator
+    from opencompass_tpu.obs.metrics import MetricsRegistry
+    from opencompass_tpu.obs.promexport import ObsHTTPServer
+    obs_dir = str(tmp_path)
+    _write_heartbeat(obs_dir, 'live-task', done=4, total=8)
+    agg = StatusAggregator(obs_dir, runner='R', interval=60)
+    agg.write_snapshot()
+    reg = MetricsRegistry()
+    reg.counter('runner.task_retries').inc()
+    server = ObsHTTPServer(obs_dir, port=0, registry=reg)
+    port = server.start()
+    assert port and port > 0
+    with open(osp.join(obs_dir, 'http.json')) as f:
+        assert json.load(f)['port'] == port
+    base = f'http://127.0.0.1:{port}'
+    assert urllib.request.urlopen(
+        base + '/healthz', timeout=10).read() == b'ok\n'
+    status = json.loads(urllib.request.urlopen(
+        base + '/status', timeout=10).read().decode())
+    assert status['v'] == 1
+    assert status['tasks']['live-task']['done'] == 4
+    resp = urllib.request.urlopen(base + '/metrics', timeout=10)
+    assert 'text/plain' in resp.headers['Content-Type']
+    metrics = resp.read().decode()
+    assert 'oct_runner_task_retries_total 1' in metrics
+    assert 'oct_task_examples_done{task="live-task"} 4' in metrics
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(base + '/nope', timeout=10)
+    assert exc.value.code == 404
+    server.stop()
+    assert not osp.exists(osp.join(obs_dir, 'http.json'))
+
+
+# -- `cli status` -----------------------------------------------------------
+
+def test_status_cli_on_fixture_tree():
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'status',
+         'tests/fixtures/obs_run'],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'state: done' in r.stdout
+    assert 'OpenICLInfer[tiny/demo-gen]' in r.stdout
+    assert 'OpenICLInfer[tiny/demo-ppl]' in r.stdout
+    assert '1 ok' in r.stdout and '1 failed' in r.stdout
+    assert '96/128' in r.stdout and '75%' in r.stdout
+    assert '100%' in r.stdout
+
+
+def test_status_cli_json():
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'status',
+         'tests/fixtures/obs_run', '--json'],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    snap = json.loads(r.stdout)
+    assert snap['v'] == 1
+    assert snap['overall'] == {'n_tasks': 2, 'progress': 0.875,
+                               'eta_seconds': None, 'ok': 1, 'failed': 1,
+                               'running': 0, 'pending': 0}
+
+
+def test_status_cli_missing_tree(tmp_path):
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'status',
+         str(tmp_path)],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=180)
+    assert r.returncode == 1
+    assert 'obs' in r.stdout
+
+
+def test_status_falls_back_to_heartbeats_without_status_json(tmp_path):
+    """A run whose aggregator died still renders from progress files."""
+    from opencompass_tpu.obs.live import (current_status, render_status,
+                                          resolve_obs_dir)
+    obs_dir = str(tmp_path / 'run' / 'obs')
+    _write_heartbeat(obs_dir, 'orphan-task', done=2, total=4,
+                     units_total=1)
+    assert resolve_obs_dir(str(tmp_path / 'run')) == obs_dir
+    assert resolve_obs_dir(str(tmp_path)) == obs_dir   # parent scan
+    snap = current_status(obs_dir)
+    assert snap['tasks']['orphan-task']['progress'] == pytest.approx(0.5)
+    text = render_status(snap)
+    assert 'orphan-task' in text and '2/4' in text
+
+
+# -- stall watchdog: heartbeat freshness beats log silence ------------------
+
+def _stall_runner(stall_timeout):
+    from opencompass_tpu.runners.local import LocalRunner
+    runner = LocalRunner(task=dict(type='OpenICLInferTask'),
+                         stall_timeout=stall_timeout)
+    runner._watchdog_poll_s = 0.2
+    return runner
+
+
+def test_stall_watchdog_kills_silent_task_without_heartbeat(tmp_path):
+    from opencompass_tpu import obs
+    obs.init_obs(str(tmp_path))
+    runner = _stall_runner(stall_timeout=0.8)
+    rc = runner._run_once('sleep 30', dict(_cpu_env()),
+                          str(tmp_path / 'task.out'), 'silent-task')
+    assert rc == -9
+
+
+def test_stall_watchdog_spares_heartbeating_task(tmp_path):
+    """Regression for the false-kill: a task that computes silently
+    (no log growth) past stall_timeout survives as long as its
+    heartbeat file stays fresh."""
+    from opencompass_tpu import obs
+    from opencompass_tpu.obs.live import atomic_write_json, heartbeat_path
+    tracer = obs.init_obs(str(tmp_path))
+    hb_path = heartbeat_path(tracer.obs_dir, 'beating-task')
+    stop = threading.Event()
+
+    def beat():
+        while not stop.wait(0.25):
+            atomic_write_json(hb_path, {'task': 'beating-task',
+                                        'ts': time.time()})
+
+    thread = threading.Thread(target=beat, daemon=True)
+    thread.start()
+    try:
+        runner = _stall_runner(stall_timeout=0.8)
+        t0 = time.time()
+        rc = runner._run_once('sleep 2.5', dict(_cpu_env()),
+                              str(tmp_path / 'task.out'), 'beating-task')
+    finally:
+        stop.set()
+        thread.join(timeout=5)
+    assert rc == 0, 'heartbeating task was falsely stall-killed'
+    assert time.time() - t0 >= 2.0        # outlived several stall windows

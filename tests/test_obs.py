@@ -309,6 +309,25 @@ def test_trace_cli_smoke_on_fixture():
     assert 'retries: 1' in r.stdout
 
 
+def test_trace_cli_json_machine_readable():
+    """`trace --json` emits the versioned report dict so CI can diff
+    run trends (critical path + per-task breakdown)."""
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'trace',
+         'tests/fixtures/obs_run', '--json'],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    rep = json.loads(r.stdout)
+    assert rep['v'] == 1
+    names = {t['name'] for t in rep['tasks']}
+    assert 'OpenICLInfer[tiny/demo-gen]' in names
+    hops = [h['name'] for h in rep['critical_path']]
+    assert hops and hops[0] == 'run'
+    assert rep['failures']['task_retry'] == 1
+    assert rep['metrics']['counters']['inferencer.gen_batches'] == 16
+
+
 def test_trace_cli_missing_events_dir(tmp_path):
     r = subprocess.run(
         [sys.executable, '-m', 'opencompass_tpu.cli', 'trace',
@@ -321,23 +340,78 @@ def test_trace_cli_missing_events_dir(tmp_path):
 
 # -- end-to-end FakeModel run ------------------------------------------------
 
+def _find_http_port(work: str):
+    """The run driver advertises its ephemeral --obs-port 0 port in
+    {run_dir}/obs/http.json."""
+    for sub in os.listdir(work):
+        cand = osp.join(work, sub, 'obs', 'http.json')
+        if osp.isfile(cand):
+            try:
+                with open(cand) as f:
+                    return json.load(f).get('port')
+            except (OSError, ValueError):
+                pass   # torn write: retry next poll
+    return None
+
+
 @pytest.fixture(scope='module')
 def obs_e2e_run(tmp_path_factory):
-    """One full `run.py --obs` pipeline (LocalRunner subprocesses, CPU)
-    shared by the e2e assertions below."""
+    """One full `run.py --obs --obs-port 0` pipeline (LocalRunner
+    subprocesses, CPU) shared by the e2e assertions below.  The driver
+    runs under Popen so the live /metrics, /status, and /healthz
+    endpoints can be scraped mid-run."""
+    import time
+    import urllib.request
     work = str(tmp_path_factory.mktemp('obs_e2e'))
-    r = subprocess.run(
-        [sys.executable, 'run.py', 'configs/eval_demo.py', '-w', work,
-         '--obs', '--max-num-workers', '2'],
-        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
-        timeout=420)
-    assert r.returncode == 0, r.stdout + r.stderr
+    out_path = osp.join(str(tmp_path_factory.mktemp('obs_e2e_log')),
+                        'driver.out')
+    live = {}
+    with open(out_path, 'w') as out_f:
+        proc = subprocess.Popen(
+            [sys.executable, 'run.py', 'configs/eval_demo.py', '-w', work,
+             '--obs', '--obs-port', '0', '--max-num-workers', '2'],
+            cwd=REPO, env=_cpu_env(), stdout=out_f,
+            stderr=subprocess.STDOUT, text=True)
+        deadline = time.time() + 420
+        try:
+            while time.time() < deadline and proc.poll() is None:
+                port = _find_http_port(work)
+                if port:
+                    base = f'http://127.0.0.1:{port}'
+                    try:
+                        metrics = urllib.request.urlopen(
+                            base + '/metrics', timeout=5).read().decode()
+                        # keep scraping until the aggregated task gauges
+                        # show up (the first seconds of a run have no
+                        # tasks registered yet)
+                        if 'oct_run_progress' in metrics:
+                            live['metrics'] = metrics
+                            live['healthz'] = urllib.request.urlopen(
+                                base + '/healthz',
+                                timeout=5).read().decode()
+                            live['status'] = json.loads(
+                                urllib.request.urlopen(
+                                    base + '/status',
+                                    timeout=5).read().decode())
+                            break
+                    except OSError:
+                        pass   # server mid-start/stop: retry
+                time.sleep(0.2)
+            proc.wait(timeout=max(1.0, deadline - time.time()))
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+    with open(out_path) as f:
+        out = f.read()
+    assert proc.returncode == 0, out
     (run_dir,) = os.listdir(work)
-    return osp.join(work, run_dir), r
+    return {'run_dir': osp.join(work, run_dir), 'stdout': out,
+            'live': live}
 
 
 def test_e2e_obs_events_and_nesting(obs_e2e_run):
-    run_dir, _ = obs_e2e_run
+    run_dir = obs_e2e_run['run_dir']
     events = _read_events(run_dir)
     starts = {e['span']: e for e in events if e['kind'] == 'span_start'}
     by_name = {}
@@ -363,7 +437,7 @@ def test_e2e_obs_events_and_nesting(obs_e2e_run):
 
 
 def test_e2e_trace_report_renders(obs_e2e_run):
-    run_dir, _ = obs_e2e_run
+    run_dir = obs_e2e_run['run_dir']
     r = subprocess.run(
         [sys.executable, '-m', 'opencompass_tpu.cli', 'trace', run_dir],
         cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
@@ -377,8 +451,8 @@ def test_e2e_trace_report_renders(obs_e2e_run):
 
 
 def test_e2e_summarizer_obs_section(obs_e2e_run):
-    run_dir, r = obs_e2e_run
-    assert '\nobs:\n' in r.stdout
+    run_dir = obs_e2e_run['run_dir']
+    assert '\nobs:\n' in obs_e2e_run['stdout']
     (summary,) = [f for f in os.listdir(osp.join(run_dir, 'summary'))
                   if f.endswith('.txt')]
     text = open(osp.join(run_dir, 'summary', summary)).read()
@@ -386,6 +460,70 @@ def test_e2e_summarizer_obs_section(obs_e2e_run):
     assert 'tasks' in text and 'retries' in text
     # driver log file handler (logging satellite)
     assert osp.exists(osp.join(run_dir, 'logs', 'driver.log'))
+
+
+# -- live telemetry plane (scraped mid-run by the fixture) -------------------
+
+def test_e2e_live_metrics_endpoint(obs_e2e_run):
+    """--obs-port 0 exposes /metrics (valid Prometheus text format),
+    /status (JSON snapshot), and /healthz while the run is live."""
+    import re
+    live = obs_e2e_run['live']
+    assert live, 'live endpoints were never scraped during the run'
+    assert live['healthz'].strip() == 'ok'
+    metrics = live['metrics']
+    assert '# TYPE oct_run_progress gauge' in metrics
+    assert 'oct_run_progress' in metrics
+    # every line is comment-or-sample per text format 0.0.4
+    sample = re.compile(
+        r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+-]+$')
+    for line in metrics.strip().splitlines():
+        if line.startswith('#'):
+            assert re.match(r'^# (TYPE|HELP) ', line), line
+        else:
+            assert sample.match(line), line
+    status = live['status']
+    assert status['v'] == 1
+    assert status['state'] in ('running', 'done')
+    assert isinstance(status['tasks'], dict)
+    assert status['overall']['n_tasks'] >= 1
+
+
+def test_e2e_status_json_converges(obs_e2e_run):
+    """The aggregator's final snapshot reports a fully-complete run,
+    and every task heartbeat reached a terminal state."""
+    run_dir = obs_e2e_run['run_dir']
+    with open(osp.join(run_dir, 'obs', 'status.json')) as f:
+        snap = json.load(f)
+    assert snap['v'] == 1 and snap['state'] == 'done'
+    assert snap['overall']['progress'] == 1.0
+    assert snap['overall']['failed'] == 0
+    assert snap['overall']['ok'] == snap['overall']['n_tasks'] >= 1
+    progress_dir = osp.join(run_dir, 'obs', 'progress')
+    heartbeats = [f for f in os.listdir(progress_dir)
+                  if f.endswith('.json')]
+    assert heartbeats, 'no task heartbeat files were written'
+    for fname in heartbeats:
+        with open(osp.join(progress_dir, fname)) as f:
+            rec = json.load(f)
+        assert rec['v'] == 1 and rec['state'] == 'done'
+        if rec.get('units_total'):
+            assert rec['units_done'] == rec['units_total']
+    # a dead run must not advertise a stale endpoint
+    assert not osp.exists(osp.join(run_dir, 'obs', 'http.json'))
+
+
+def test_e2e_status_cli_on_finished_run(obs_e2e_run):
+    """`cli status` works purely from files after the run has exited."""
+    run_dir = obs_e2e_run['run_dir']
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'status', run_dir],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'state: done' in r.stdout
+    assert '100%' in r.stdout
+    assert 'OpenICLEval' in r.stdout
 
 
 def test_obs_unset_creates_no_obs_dir(tmp_path):
