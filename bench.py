@@ -177,6 +177,48 @@ def _a100_estimate(cfg, gen_batch=GEN_BATCH):
     }
 
 
+def _bench_planner():
+    """Host-only batch-planner leg (icl/inferencers/schedule.py): padding
+    efficiency and distinct jit-shape count, planned vs sequential
+    chunking, on a skewed MMLU-like arrival order (subject-clustered
+    short/medium prompts with long few-shot outliers sprinkled through).
+    No device involved — this measures the scheduler, and regressions
+    here show up before any TPU time is spent."""
+    import random
+
+    from opencompass_tpu.icl.inferencers import schedule
+    from opencompass_tpu.models.jax_lm import _bucket
+
+    def shape_fn(n, longest):
+        return _bucket(max(n, 1), lo=1), _bucket(max(longest, 1), hi=2048)
+
+    rng = random.Random(3)
+    lengths = []
+    for block in range(8):
+        lo, hi = (70, 128) if block % 2 == 0 else (300, 500)
+        lengths += [rng.randint(lo, hi) for _ in range(46)]
+    for _ in range(24):
+        lengths.insert(rng.randrange(len(lengths)),
+                       rng.randint(1400, 1900))
+    t0 = time.perf_counter()
+    planned = schedule.plan_batches(lengths, 16, shape_fn=shape_fn)
+    plan_ms = (time.perf_counter() - t0) * 1e3
+    seq = schedule.sequential_plan(lengths, 16, shape_fn=shape_fn)
+    return {
+        'workload': '8 length-clustered blocks of 46 + 24 long outliers, '
+                    'batch 16',
+        'pad_eff_planned': round(planned.stats.pad_eff, 4),
+        'pad_eff_sequential': round(seq.stats.pad_eff, 4),
+        'pad_eff_speedup': round(
+            planned.stats.pad_eff / seq.stats.pad_eff, 2),
+        'shapes_planned': planned.stats.n_shapes,
+        'shapes_sequential': seq.stats.n_shapes,
+        'batches_planned': planned.stats.n_batches,
+        'batches_sequential': seq.stats.n_batches,
+        'plan_ms': round(plan_ms, 2),
+    }
+
+
 def main():
     n_chips = max(1, len(jax.devices()))
     kind = getattr(jax.devices()[0], 'device_kind', '')
@@ -470,6 +512,7 @@ def main():
             'peak_tflops': peak,
             'quant_agreement': agreement,
             'shared_prefix': shared_leg,
+            'batch_planner': _bench_planner(),
             'a100_est': a100,
             'a100_est_b32': a100_b32,
             'small': {

@@ -14,6 +14,7 @@ Usage::
     python run.py cfg.py --obs --obs-port 9464      # + live /metrics HTTP
     python -m opencompass_tpu.cli trace WORK_DIR    # render trace report
     python -m opencompass_tpu.cli status WORK_DIR --watch   # live progress
+    python -m opencompass_tpu.cli plan cfg.py       # batch-plan dry run
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files
@@ -194,6 +195,14 @@ def status_main(argv=None) -> int:
     return live_main(argv)
 
 
+def plan_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli plan <config>`` — device-free
+    batch-plan dry run: per-task planned batch shapes, estimated compile
+    count, and padding efficiency vs sequential chunking."""
+    from opencompass_tpu.utils.plan_preview import main as preview_main
+    return preview_main(argv)
+
+
 def main():
     # subcommand dispatch before the run-config parser: `trace`/`status`
     # take a work_dir, not a config file
@@ -201,6 +210,8 @@ def main():
         raise SystemExit(trace_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'status':
         raise SystemExit(status_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == 'plan':
+        raise SystemExit(plan_main(sys.argv[2:]))
     # persistent XLA compilation cache for the whole pipeline — tasks
     # inherit it (LocalRunner also sets it for device tasks), and the
     # --debug in-process path benefits directly.  Rare shapes compile

@@ -7,14 +7,31 @@ Parity: reference openicl/icl_inferencer/icl_base_inferencer.py:15-163.
 import json
 import os
 from pathlib import Path
-from typing import Iterator, List, Optional
+from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from opencompass_tpu.icl.retrievers.base import is_main_process
+from opencompass_tpu.obs import get_tracer
+
+from . import schedule
 
 
 class BaseInferencer:
+    """Common inferencer knobs.
+
+    Args:
+        batch_size: max rows per device batch.
+        batch_plan: length-aware batch planning (schedule.py) — rows are
+            re-packed into length-sorted, token-budget-capped batches and
+            executed out of order (results scatter back to original
+            indices).  ``None`` (default) follows the model:
+            on for models advertising ``supports_batch_plan`` (JaxLM),
+            off otherwise (API models keep arrival order).
+        token_budget: cap on a batch's padded ``B x S`` footprint; None
+            sizes it off the measured lengths
+            (:func:`schedule.default_token_budget`).
+    """
 
     def __init__(self,
                  model,
@@ -22,19 +39,103 @@ class BaseInferencer:
                  batch_size: int = 1,
                  output_json_filepath: str = './icl_inference_output',
                  output_json_filename: str = 'predictions',
+                 batch_plan: Optional[bool] = None,
+                 token_budget: Optional[int] = None,
                  **kwargs):
         self.model = model
         self.max_seq_len = max_seq_len
         self.batch_size = batch_size
         self.output_json_filepath = output_json_filepath
         self.output_json_filename = output_json_filename
+        self.batch_plan = batch_plan
+        self.token_budget = token_budget
+        # shape buckets already charged to perf.planned_shapes by this
+        # inferencer — a task may execute several plans (one per PPL
+        # label) that share buckets, which must count once
+        self._counted_plan_shapes = set()
         self.is_main_process = is_main_process()
 
     @staticmethod
     def get_batches(items: List, batch_size: int) -> Iterator[List]:
-        """Plain host-side batching — no torch DataLoader on the TPU path."""
+        """Plain host-side batching — no torch DataLoader on the TPU path.
+        Superseded in the built-in inferencers by the batch planner
+        (``schedule.sequential_plan`` reproduces this chunking exactly for
+        the bypass path); kept as reference-parity API for subclasses."""
         for i in range(0, len(items), batch_size):
             yield items[i:i + batch_size]
+
+    # -- batch planning ----------------------------------------------------
+
+    @property
+    def plan_enabled(self) -> bool:
+        if self.batch_plan is not None:
+            return bool(self.batch_plan)
+        return bool(getattr(self.model, 'supports_batch_plan', False))
+
+    def measure_lengths(self, prompts: Sequence, mode: str,
+                        cap: Optional[int] = None) -> List[int]:
+        """Token length per prompt via the model's (cached) tokenizer,
+        optionally clamped to the padder's truncation cap."""
+        lens = self.model.get_token_len_from_template(list(prompts),
+                                                      mode=mode)
+        if cap is not None:
+            lens = [min(int(n), cap) for n in lens]
+        return [int(n) for n in lens]
+
+    def shape_fn(self, seq_cap: Optional[int] = None):
+        """The model's padded-bucket geometry as a planner ``shape_fn``
+        (exact row counts/lengths for models without one)."""
+        plan_shape = getattr(self.model, 'plan_shape', None)
+
+        def fn(n_rows, longest):
+            if plan_shape is None:
+                return schedule._default_shape(n_rows, longest)
+            return plan_shape(n_rows, longest, max_len=seq_cap)
+        return fn
+
+    def make_plan(self, lengths: Sequence[int],
+                  groups: Optional[Sequence[Sequence[int]]] = None,
+                  exclusive_groups: bool = False,
+                  seq_cap: Optional[int] = None,
+                  force_sequential: bool = False) -> schedule.BatchPlan:
+        """Planned (or, when bypassed, arrival-order) batches over rows
+        ``0..len(lengths)-1``."""
+        shape_fn = self.shape_fn(seq_cap)
+        if force_sequential or not self.plan_enabled:
+            return schedule.sequential_plan(
+                lengths, self.batch_size, shape_fn=shape_fn, groups=groups,
+                exclusive_groups=exclusive_groups)
+        return schedule.plan_batches(
+            lengths, self.batch_size, shape_fn=shape_fn,
+            token_budget=self.token_budget, groups=groups,
+            exclusive_groups=exclusive_groups)
+
+    def run_plan(self, plan: schedule.BatchPlan, dispatch, collect) -> float:
+        """Execute a plan (double-buffered when planning is on) and
+        charge overlap/shape telemetry to the model's perf counters and
+        the obs plane.  Returns overlapped host seconds."""
+        depth = 1 if plan.planned else 0
+        overlap = schedule.execute_plan(plan, dispatch, collect,
+                                        depth=depth)
+        perf = getattr(self.model, 'perf', None)
+        if perf is not None and hasattr(perf, 'overlap_seconds'):
+            perf.overlap_seconds += overlap
+            if plan.planned:
+                # a task may run several plans (one per PPL label) that
+                # share buckets — each distinct bucket counts once
+                fresh = set(plan.stats.shapes) - self._counted_plan_shapes
+                self._counted_plan_shapes |= fresh
+                perf.planned_shapes += len(fresh)
+        tracer = get_tracer()
+        if tracer.enabled and plan.batches:
+            tracer.counter('planner.batches').inc(len(plan.batches))
+            if plan.planned:
+                tracer.gauge('planner.pad_eff').set(
+                    round(plan.stats.pad_eff, 4))
+                tracer.gauge('planner.shapes_planned').set(
+                    plan.stats.n_shapes)
+                tracer.histogram('planner.overlap_seconds').observe(overlap)
+        return overlap
 
     def inference(self, retriever, ice_template=None, prompt_template=None,
                   output_json_filepath=None, output_json_filename=None):

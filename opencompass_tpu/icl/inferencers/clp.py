@@ -9,14 +9,29 @@ positions on-device (left-aligned padding mask), so there is no dummy-token
 bookkeeping and one jitted executable serves the whole batch.
 """
 import os
+import time
 from typing import List, Optional
 
+from opencompass_tpu.obs import get_tracer, observe_batch
 from opencompass_tpu.registry import ICL_INFERENCERS
 from opencompass_tpu.utils.logging import get_logger
 
 from .base import BaseInferencer, PPLInferencerOutputHandler
 
 logger = get_logger()
+
+
+class _ClpTicket:
+    """Parsed prompts + the in-flight choice-logprob handle."""
+    __slots__ = ('parsed', 'handle', 't0')
+
+    def __init__(self, parsed, handle, t0):
+        self.parsed = parsed
+        self.handle = handle
+        self.t0 = t0
+
+    def result(self):
+        return self.parsed, self.handle.result(), self.t0
 
 
 @ICL_INFERENCERS.register_module()
@@ -75,7 +90,63 @@ class CLPInferencer(BaseInferencer):
         output_handler.save_ice(ice)
 
         choices = retriever.test_ds[0]['choices']
+        prompt_list = self._build_prompts(retriever, ice_idx_list, ice,
+                                          ice_template, prompt_template)
 
+        logger.info('Calculating conditional log probability for prompts.')
+        obs_on = get_tracer().enabled
+        if self.plan_enabled and prompt_list:
+            lengths = self.measure_lengths(prompt_list, 'gen',
+                                           cap=self.max_seq_len)
+        else:
+            lengths = [1] * len(prompt_list)
+        plan = self.make_plan(lengths, seq_cap=self.max_seq_len)
+        state = {'done': 0}
+
+        def dispatch(batch):
+            sub_prompts = [prompt_list[p] for p in batch.indices]
+            parsed = self.model.parse_template(sub_prompts, mode='gen')
+            t0 = time.perf_counter() if obs_on else 0.0
+            fn = getattr(self.model, 'get_choice_logprobs_async', None)
+            if fn is not None:
+                handle = fn(parsed, choices)
+            else:
+                from .schedule import ReadyHandle
+                handle = ReadyHandle(
+                    self.model.get_choice_logprobs(parsed, choices))
+            return _ClpTicket(parsed, handle, t0)
+
+        def collect(batch, result):
+            parsed, probs, t0 = result
+            state['done'] += len(batch.indices)
+            if obs_on:
+                observe_batch('inferencer.clp_batches', t0,
+                              done=state['done'], total=len(prompt_list))
+            for index, res, prompt in zip(batch.indices, probs, parsed):
+                ice_str = str(
+                    self.model.parse_template(ice[index], mode='gen'))
+                output_handler.save_prompt_and_condprob(
+                    prompt.replace(ice_str, ''), prompt, list(res), index,
+                    choices)
+
+        # out-of-order collection is safe here: save_ice pre-created
+        # every index's entry in item order, and collect only fills
+        # existing entries, so the dict order never changes
+        self.run_plan(plan, dispatch, collect)
+
+        if self.is_main_process:
+            os.makedirs(output_json_filepath, exist_ok=True)
+            output_handler.write_to_json(output_json_filepath,
+                                         output_json_filename)
+        return [
+            sample['prediction']
+            for sample in output_handler.results_dict.values()
+        ]
+
+    def _build_prompts(self, retriever, ice_idx_list, ice, ice_template,
+                       prompt_template) -> List:
+        """Render prompts, dropping trailing in-context examples until
+        each fits ``max_seq_len`` (+1 for the choice token)."""
         prompt_list = []
         for idx in range(len(ice_idx_list)):
             prompt = retriever.generate_prompt_for_generate_task(
@@ -95,26 +166,24 @@ class CLPInferencer(BaseInferencer):
                     token_num = self.model.get_token_len_from_template(
                         prompt, mode='gen')
             prompt_list.append(prompt)
+        return prompt_list
 
-        logger.info('Calculating conditional log probability for prompts.')
-        index = 0
-        for start in range(0, len(prompt_list), self.batch_size):
-            sub_prompts = prompt_list[start:start + self.batch_size]
-            parsed = self.model.parse_template(sub_prompts, mode='gen')
-            probs = self.model.get_choice_logprobs(parsed, choices)
-            for res, prompt in zip(probs, parsed):
-                ice_str = str(
-                    self.model.parse_template(ice[index], mode='gen'))
-                output_handler.save_prompt_and_condprob(
-                    prompt.replace(ice_str, ''), prompt, list(res), index,
-                    choices)
-                index += 1
-
-        if self.is_main_process:
-            os.makedirs(output_json_filepath, exist_ok=True)
-            output_handler.write_to_json(output_json_filepath,
-                                         output_json_filename)
-        return [
-            sample['prediction']
-            for sample in output_handler.results_dict.values()
+    def plan_preview(self, retriever, ice_template=None,
+                     prompt_template=None) -> dict:
+        """Device-free dry run for ``cli plan``."""
+        from .gen import preview_from_lengths
+        if self.fix_id_list:
+            ice_idx_list = retriever.retrieve(self.fix_id_list)
+        else:
+            ice_idx_list = retriever.retrieve()
+        ice = [
+            retriever.generate_ice(ice_idx_list[idx],
+                                   ice_template=ice_template)
+            for idx in range(len(ice_idx_list))
         ]
+        prompt_list = self._build_prompts(retriever, ice_idx_list, ice,
+                                          ice_template, prompt_template)
+        lengths = self.measure_lengths(prompt_list, 'gen',
+                                       cap=self.max_seq_len)
+        return preview_from_lengths(self, lengths,
+                                    seq_cap=self.max_seq_len)

@@ -8,8 +8,13 @@ with as many in-context examples as fit ``max_seq_len``, resume from a
 
 The shape is this codebase's own: prompt fitting bisects the in-context
 count through ``IceFitter`` (the reference re-renders after every dropped
-example), and resume is a rank-0 read broadcast to the whole process group
-so multi-host runs execute the same number of batches.
+example), resume is a rank-0 read broadcast to the whole process group
+so multi-host runs execute the same number of batches, and batching goes
+through the length-aware planner (``schedule.py``): rows are re-packed
+into token-budget-capped, shape-minimizing batches, executed out of
+order behind a double-buffered dispatch pipeline, and scattered back to
+original indices — completion is idx-keyed, so flush/resume survive
+out-of-order execution and partial files with holes.
 """
 from __future__ import annotations
 
@@ -23,11 +28,25 @@ from opencompass_tpu.parallel.distributed import broadcast_object
 from opencompass_tpu.registry import ICL_INFERENCERS
 from opencompass_tpu.utils.logging import get_logger
 
+from . import schedule
 from .base import (BaseInferencer, GenInferencerOutputHandler,
                    load_results_dict)
 from .prompting import IceFitter
 
 logger = get_logger()
+
+
+class _GenTicket:
+    """Parsed prompts + the in-flight completion handle for one batch."""
+    __slots__ = ('shown', 'handle', 't0')
+
+    def __init__(self, shown, handle, t0):
+        self.shown = shown
+        self.handle = handle
+        self.t0 = t0
+
+    def result(self):
+        return self.shown, self.handle.result(), self.t0
 
 
 @ICL_INFERENCERS.register_module()
@@ -66,10 +85,20 @@ class GenInferencer(BaseInferencer):
                                          prompt_template=prompt_template)
 
         scratch = os.path.join(out_dir, 'tmp_' + out_name)
+        # resume is keyed on completed sample indices, not a contiguous
+        # cursor: a planned (out-of-order) run killed mid-flight leaves a
+        # tmp file with holes, and even sequential flushes may be partial
         done = self._resume(scratch)
-        if done:
-            handler.results_dict = done
-        cursor = len(done)
+        done_idx = set()
+        for key, record in done.items():
+            try:
+                idx = int(key)
+            except (TypeError, ValueError):
+                continue
+            if 0 <= idx < len(prompts):
+                done_idx.add(idx)
+                handler.results_dict[str(idx)] = record
+        todo = [i for i in range(len(prompts)) if i not in done_idx]
 
         logger.info('Starting inference process...')
         # hoisted once: the per-batch obs cost is one bool check when
@@ -78,22 +107,56 @@ class GenInferencer(BaseInferencer):
         if obs_on:
             # seed the heartbeat so a resumed task reports its true
             # starting position before the first batch lands
-            get_heartbeat().progress(cursor, len(prompts), force=True)
-        for chunk in self.get_batches(prompts[cursor:], self.batch_size):
+            get_heartbeat().progress(len(done_idx), len(prompts),
+                                    force=True)
+
+        # a generation batch pads prompts to max_seq_len - max_out_len at
+        # most (the model reserves decode room); clamp planned lengths the
+        # same way so planned shapes match dispatched ones
+        seq_cap = None
+        model_max = getattr(self.model, 'max_seq_len', None)
+        if model_max:
+            seq_cap = max(model_max - self.max_out_len, 32)
+        if self.plan_enabled and todo:
+            lengths = self.measure_lengths([prompts[i] for i in todo],
+                                           'gen', cap=seq_cap)
+        else:
+            lengths = [1] * len(todo)
+        plan = self.make_plan(lengths, seq_cap=seq_cap)
+
+        state = {'completed': len(done_idx), 'last_flush': len(done_idx)}
+
+        def dispatch(batch):
+            chunk = [prompts[todo[p]] for p in batch.indices]
             shown = self.model.parse_template(chunk, mode='gen')
-            if obs_on:
-                t0 = time.perf_counter()
-            completions = self._generate_batch(chunk, shown)
+            t0 = time.perf_counter() if obs_on else 0.0
+            return _GenTicket(shown, self._generate_batch_async(chunk,
+                                                                shown), t0)
+
+        def collect(batch, result):
+            shown, completions, t0 = result
+            state['completed'] += len(batch.indices)
             if obs_on:
                 observe_batch('inferencer.gen_batches', t0,
-                              done=cursor + len(shown),
-                              total=len(prompts))
-            for text, completion in zip(shown, completions):
-                handler.save_results(text, completion, cursor)
-                cursor += 1
+                              done=state['completed'], total=len(prompts))
+            for pos, text, completion in zip(batch.indices, shown,
+                                             completions):
+                handler.save_results(text, completion, todo[pos])
+            # flush on completed-count distance, not modulo: batch sizes
+            # that don't divide save_every must still flush
             if (self.save_every is not None and self.is_main_process
-                    and cursor % self.save_every == 0):
+                    and state['completed'] - state['last_flush']
+                    >= self.save_every):
                 handler.write_to_json(out_dir, 'tmp_' + out_name)
+                state['last_flush'] = state['completed']
+
+        self.run_plan(plan, dispatch, collect)
+
+        # restore dataset order: out-of-order execution (and idx-keyed
+        # resume) fill results_dict in completion order
+        order = sorted(int(k) for k in handler.results_dict)
+        handler.results_dict = {
+            str(i): handler.results_dict[str(i)] for i in order}
 
         if self.is_main_process:
             os.makedirs(out_dir, exist_ok=True)
@@ -106,7 +169,8 @@ class GenInferencer(BaseInferencer):
     def _resume(self, scratch_path: str) -> dict:
         """Sample-level resume from a previous run's tmp_ flush.  Rank 0
         reads; the result is broadcast so every process in a multi-host
-        group skips the same samples."""
+        group skips the same samples.  The file's keys are sample
+        indices and may be unordered or have holes."""
         partial = None
         if self.is_main_process and osp.exists(scratch_path):
             partial = load_results_dict(scratch_path)
@@ -115,6 +179,16 @@ class GenInferencer(BaseInferencer):
     def _generate_batch(self, entry, parsed_entries) -> List[str]:
         """One batched model call; the hook GLMChoiceInferencer overrides."""
         return self.model.generate_from_template(
+            entry, max_out_len=self.max_out_len)
+
+    def _generate_batch_async(self, entry, parsed_entries):
+        """Async dispatch of one batch.  Subclasses that override the
+        sync ``_generate_batch`` hook keep working: their result is
+        wrapped in an already-completed handle."""
+        if type(self)._generate_batch is not GenInferencer._generate_batch:
+            return schedule.ReadyHandle(
+                self._generate_batch(entry, parsed_entries))
+        return self.model.generate_from_template_async(
             entry, max_out_len=self.max_out_len)
 
     def build_prompt_list(self,
@@ -136,6 +210,41 @@ class GenInferencer(BaseInferencer):
                     prompt_template=prompt_template)
             prompts.append(fitter.fit(item, render)[1])
         return prompts
+
+    def plan_preview(self, retriever, ice_template=None,
+                     prompt_template=None) -> dict:
+        """Device-free dry run: build prompts, measure lengths, and
+        return planned-vs-sequential batch/shape/padding stats (the
+        ``cli plan`` pre-flight)."""
+        use_fixed = 'Fix' in type(retriever).__name__ and self.fix_id_list
+        example_ids = (retriever.retrieve(self.fix_id_list) if use_fixed
+                       else retriever.retrieve())
+        prompts = self.build_prompt_list(example_ids, retriever,
+                                         ice_template=ice_template,
+                                         prompt_template=prompt_template)
+        seq_cap = None
+        model_max = getattr(self.model, 'max_seq_len', None)
+        if model_max:
+            seq_cap = max(model_max - self.max_out_len, 32)
+        lengths = self.measure_lengths(prompts, 'gen', cap=seq_cap)
+        return preview_from_lengths(self, lengths, seq_cap=seq_cap)
+
+
+def preview_from_lengths(inferencer, lengths, groups=None,
+                         exclusive_groups=False, seq_cap=None) -> dict:
+    """Planned vs sequential stats for one task's measured row lengths."""
+    plan = inferencer.make_plan(lengths, groups=groups,
+                                exclusive_groups=exclusive_groups,
+                                seq_cap=seq_cap)
+    seq = inferencer.make_plan(lengths, groups=groups,
+                               exclusive_groups=exclusive_groups,
+                               seq_cap=seq_cap, force_sequential=True)
+    return {
+        'rows': len(lengths),
+        'plan_enabled': inferencer.plan_enabled,
+        'planned': plan.stats.as_dict(),
+        'sequential': seq.stats.as_dict(),
+    }
 
 
 @ICL_INFERENCERS.register_module()

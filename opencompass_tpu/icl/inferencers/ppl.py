@@ -32,6 +32,23 @@ from .prompting import IceFitter
 logger = get_logger()
 
 
+class _PplTicket:
+    """In-flight NLL batch; normalizing mode holds the baseline call too
+    (score = conditional − baseline, resolved at fetch time)."""
+    __slots__ = ('cond', 'base', 't0')
+
+    def __init__(self, cond, base, t0):
+        self.cond = cond
+        self.base = base
+        self.t0 = t0
+
+    def result(self):
+        got = np.asarray(self.cond.result())
+        if self.base is not None:
+            got = got - np.asarray(self.base.result())
+        return got, self.t0
+
+
 @dataclasses.dataclass
 class _Row:
     """One (item, label) scoring row."""
@@ -111,20 +128,8 @@ class PPLInferencer(BaseInferencer):
         # example-level denominator for this unit
         n_rows = len(labels) * len(fitter)
         if item_major:
-            obs_on = get_tracer().enabled
-            score_table = [[0.0] * len(fitter) for _ in labels]
-            for idx in range(len(fitter)):
-                if obs_on:
-                    t0 = time.perf_counter()
-                got = np.asarray(self.model.get_ppl_from_template(
-                    [rows_by_label[li][idx].prompt
-                     for li in range(len(labels))]))
-                if obs_on:
-                    observe_batch('inferencer.ppl_batches', t0,
-                                  done=(idx + 1) * len(labels),
-                                  total=n_rows)
-                for li in range(len(labels)):
-                    score_table[li][idx] = float(got[li])
+            score_table = self._score_item_major(rows_by_label, labels,
+                                                 len(fitter), n_rows)
         else:
             if get_tracer().enabled:
                 get_heartbeat().progress(0, n_rows, force=True)
@@ -180,32 +185,120 @@ class PPLInferencer(BaseInferencer):
                         head, mode='ppl'),
                     normalizer=normalizing_str + answer)
 
+    def _score_item_major(self, rows_by_label, labels, n_items: int,
+                          n_rows: int):
+        """One batch per item (its label variants — indivisible, so the
+        shared-prefix prefill reuse keeps its deep common prefix), in a
+        planned shape-minimizing order with scores scattered back."""
+        obs_on = get_tracer().enabled
+        n_labels = len(labels)
+        score_table = [[0.0] * n_items for _ in labels]
+        # flat row space (li * n_items + idx) with one indivisible group
+        # per item, so plan stats see the real device batches
+        if self.plan_enabled and n_items:
+            lengths = [0] * (n_labels * n_items)
+            for li in range(n_labels):
+                got = self.measure_lengths(
+                    [r.prompt for r in rows_by_label[li]], 'ppl')
+                lengths[li * n_items:(li + 1) * n_items] = got
+        else:
+            lengths = [1] * (n_labels * n_items)
+        groups = [[li * n_items + idx for li in range(n_labels)]
+                  for idx in range(n_items)]
+        plan = self.make_plan(lengths, groups=groups,
+                              exclusive_groups=True)
+        state = {'done': 0}
+
+        def dispatch(batch):
+            idx = batch.indices[0] % n_items
+            prompts = [rows_by_label[li][idx].prompt
+                       for li in range(n_labels)]
+            t0 = time.perf_counter() if obs_on else 0.0
+            return _PplTicket(
+                self.model.get_ppl_from_template_async(prompts), None, t0)
+
+        def collect(batch, result):
+            got, t0 = result
+            idx = batch.indices[0] % n_items
+            for li in range(n_labels):
+                score_table[li][idx] = float(got[li])
+            state['done'] += n_labels
+            if obs_on:
+                observe_batch('inferencer.ppl_batches', t0,
+                              done=state['done'], total=n_rows)
+
+        self.run_plan(plan, dispatch, collect)
+        return score_table
+
     def _score(self, rows: List[_Row], normalizing_str) -> List[float]:
-        """Batched PPL over one label's rows; in normalizing mode each batch
-        is two masked calls whose difference is the score."""
+        """Planned batched PPL over one label's rows; in normalizing mode
+        each batch is two masked calls whose difference is the score.
+        Batches may execute out of dataset order — scores scatter back to
+        row positions."""
         if normalizing_str is not None:
             norm_tokens = self.model.get_token_len_from_template(
                 normalizing_str, mode='ppl')
         obs_on = get_tracer().enabled
-        scores: List[float] = []
-        for chunk in self.get_batches(rows, self.batch_size):
+        scores: List[float] = [0.0] * len(rows)
+        if self.plan_enabled and rows:
+            lengths = self.measure_lengths([r.prompt for r in rows], 'ppl')
+        else:
+            lengths = [1] * len(rows)
+        plan = self.make_plan(lengths)
+
+        def dispatch(batch):
+            chunk = [rows[p] for p in batch.indices]
             prompts = [r.prompt for r in chunk]
-            if obs_on:
-                t0 = time.perf_counter()
+            t0 = time.perf_counter() if obs_on else 0.0
             if normalizing_str is None:
-                got = np.asarray(self.model.get_ppl_from_template(prompts))
-            else:
-                conditional = np.asarray(self.model.get_ppl_from_template(
-                    prompts,
-                    mask_length=[r.context_tokens for r in chunk]))
-                baseline = np.asarray(self.model.get_ppl_from_template(
-                    [r.normalizer for r in chunk],
-                    mask_length=[norm_tokens] * len(chunk)))
-                got = conditional - baseline
+                return _PplTicket(
+                    self.model.get_ppl_from_template_async(prompts),
+                    None, t0)
+            cond = self.model.get_ppl_from_template_async(
+                prompts, mask_length=[r.context_tokens for r in chunk])
+            base = self.model.get_ppl_from_template_async(
+                [r.normalizer for r in chunk],
+                mask_length=[norm_tokens] * len(chunk))
+            return _PplTicket(cond, base, t0)
+
+        def collect(batch, result):
+            got, t0 = result
+            for pos, val in zip(batch.indices, got):
+                scores[pos] = float(val)
             if obs_on:
                 observe_batch('inferencer.ppl_batches', t0)
                 # label-major scoring only knows per-chunk increments;
                 # inference() seeded done/total for the whole unit
-                get_heartbeat().add(len(chunk))
-            scores.extend(got.tolist())
+                get_heartbeat().add(len(batch.indices))
+
+        self.run_plan(plan, dispatch, collect)
         return scores
+
+    def plan_preview(self, retriever, ice_template=None,
+                     prompt_template=None,
+                     normalizing_str: Optional[str] = None) -> dict:
+        """Device-free dry run for ``cli plan``: assemble every (item,
+        label) row, measure lengths, and report planned-vs-sequential
+        stats.  Mirrors the label-major scoring layout (the item-major
+        path has fixed per-item batches either way)."""
+        from .gen import preview_from_lengths
+        example_ids = (retriever.retrieve(self.fix_id_list)
+                       if self.fix_id_list else retriever.retrieve())
+        labels = self.labels if self.labels is not None else \
+            retriever.get_labels(ice_template=ice_template,
+                                 prompt_template=prompt_template)
+        fitter = IceFitter(example_ids, retriever, self.model, 'ppl',
+                           self.max_seq_len, ice_template)
+        sep = None
+        if normalizing_str is not None:
+            tmpl = prompt_template if prompt_template is not None \
+                else ice_template
+            sep = tmpl.sep_token
+        lengths: List[int] = []
+        for label in labels:
+            rows = [self._assemble(fitter, idx, label, ice_template,
+                                   prompt_template, sep, normalizing_str)
+                    for idx in range(len(fitter))]
+            lengths.extend(self.measure_lengths(
+                [r.prompt for r in rows], 'ppl'))
+        return preview_from_lengths(self, lengths)

@@ -1,0 +1,346 @@
+"""Length-aware batch planning + a double-buffered dispatch pipeline.
+
+Sequential chunking (``BaseInferencer.get_batches``) feeds the device in
+dataset order: one 2k-token prompt drags a batch of 128-token prompts up
+to its padded bucket, and a task's mixed lengths fan out into many
+distinct ``(B, S)`` jit shapes, each costing an XLA compile.  Every
+scoring/generation row is independent, so the scheduler can fix both
+without touching numerics:
+
+- **Token-budget packing** (:func:`plan_batches`): rows are measured with
+  the model's (cached) tokenizer, sorted into length order, and packed
+  greedily so each batch's padded footprint ``B_bucket x S_bucket`` stays
+  under a token budget — long-prompt batches shrink instead of OOMing,
+  short prompts batch densely instead of padding to the stray long one.
+- **Shape-bucket minimization**: length-sorted batches are near-uniform,
+  so a task resolves to a handful of padded shapes; batches are emitted
+  grouped by shape (largest first, so the worst compile is paid while the
+  host still has planning work queued behind it).
+- **Grouping constraints**: indivisible units (one PPL item's label
+  variants, shared-prefix sub-batches) move through the plan as single
+  units and are never split across batches.
+- **Out-of-order execution, in-order results** (:func:`execute_plan`):
+  each :class:`PlannedBatch` remembers the original row indices, so
+  callers scatter results back and the predictions JSON is bit-identical
+  per row to the sequential path.
+- **Double buffering**: JAX dispatch is async — :func:`execute_plan`
+  keeps ``depth`` batches in flight, tokenizing/padding batch N+1 (and
+  decoding batch N-1's host copies) while the device executes batch N,
+  instead of blocking on ``np.asarray`` between every batch.
+
+The planner itself is host-only and model-agnostic: the model supplies a
+``shape_fn(n_rows, longest) -> (B, S)`` describing its padded bucket
+geometry (:meth:`BaseModel.plan_shape`); without one, shapes are exact
+row counts/lengths (FakeModel, API models).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+ShapeFn = Callable[[int, int], Tuple[int, int]]
+
+
+def _default_shape(n_rows: int, longest: int) -> Tuple[int, int]:
+    """No bucketing: the padded batch is exactly (rows, longest)."""
+    return n_rows, max(longest, 1)
+
+
+@dataclasses.dataclass(frozen=True)
+class PlannedBatch:
+    """One device batch: original row indices + its planned padded shape."""
+    indices: Tuple[int, ...]
+    shape: Tuple[int, int]
+    longest: int
+    real_tokens: int
+
+    @property
+    def padded_tokens(self) -> int:
+        return self.shape[0] * self.shape[1]
+
+
+@dataclasses.dataclass
+class PlanStats:
+    """Padding/shape accounting for one plan (host-side, device-free)."""
+    n_rows: int = 0
+    n_batches: int = 0
+    real_tokens: int = 0
+    padded_tokens: int = 0
+    shapes: Dict[Tuple[int, int], int] = dataclasses.field(
+        default_factory=dict)
+
+    @property
+    def pad_eff(self) -> float:
+        """real / padded tokens in [0, 1]; 1.0 means zero padding waste."""
+        return (self.real_tokens / self.padded_tokens
+                if self.padded_tokens else 1.0)
+
+    @property
+    def n_shapes(self) -> int:
+        return len(self.shapes)
+
+    def as_dict(self) -> dict:
+        return {
+            'n_rows': self.n_rows,
+            'n_batches': self.n_batches,
+            'real_tokens': self.real_tokens,
+            'padded_tokens': self.padded_tokens,
+            'pad_eff': round(self.pad_eff, 4),
+            'n_shapes': self.n_shapes,
+            'shapes': {f'{b}x{s}': c
+                       for (b, s), c in sorted(self.shapes.items())},
+        }
+
+
+@dataclasses.dataclass
+class BatchPlan:
+    """An ordered list of batches covering every row exactly once."""
+    batches: List[PlannedBatch]
+    stats: PlanStats
+    planned: bool = True  # False: arrival-order fallback (planner bypassed)
+
+    def __iter__(self):
+        return iter(self.batches)
+
+    def __len__(self):
+        return len(self.batches)
+
+
+def _stats_for(batches: Sequence[PlannedBatch]) -> PlanStats:
+    stats = PlanStats()
+    for b in batches:
+        stats.n_rows += len(b.indices)
+        stats.n_batches += 1
+        stats.real_tokens += b.real_tokens
+        stats.padded_tokens += b.padded_tokens
+        stats.shapes[b.shape] = stats.shapes.get(b.shape, 0) + 1
+    return stats
+
+
+def _make_units(lengths: Sequence[int],
+                groups: Optional[Sequence[Sequence[int]]]):
+    """(rows, longest, total) units; groups are indivisible, rest single."""
+    if groups is None:
+        return [((i,), max(int(n), 1), max(int(n), 1))
+                for i, n in enumerate(lengths)]
+    seen = set()
+    units = []
+    for g in groups:
+        rows = tuple(g)
+        if not rows:
+            continue
+        for r in rows:
+            if r in seen:
+                raise ValueError(f'row {r} appears in multiple groups')
+            seen.add(r)
+        lens = [max(int(lengths[r]), 1) for r in rows]
+        units.append((rows, max(lens), sum(lens)))
+    missing = [i for i in range(len(lengths)) if i not in seen]
+    units.extend(((i,), max(int(lengths[i]), 1), max(int(lengths[i]), 1))
+                 for i in missing)
+    return units
+
+
+def default_token_budget(lengths: Sequence[int], batch_size: int,
+                         shape_fn: Optional[ShapeFn] = None) -> int:
+    """Padded-token cap assuming ``batch_size`` was sized for the
+    *typical* row: ``batch_size x S_bucket(median length)``, raised when
+    necessary so the single longest row still fits in a batch of one."""
+    shape_fn = shape_fn or _default_shape
+    if not lengths:
+        return max(batch_size, 1)
+    ordered = sorted(max(int(n), 1) for n in lengths)
+    median = ordered[len(ordered) // 2]
+    # the bucketed footprint of a FULL batch at the median length — using
+    # raw batch_size here would undercut the budget whenever the model
+    # rounds B up (non-pow2 batch_size, data-axis rounding) and silently
+    # split full batches
+    b_med, s_med = shape_fn(max(batch_size, 1), median)
+    b1, s1 = shape_fn(1, ordered[-1])
+    return max(b_med * s_med, b1 * s1)
+
+
+def plan_batches(lengths: Sequence[int],
+                 batch_size: int,
+                 shape_fn: Optional[ShapeFn] = None,
+                 token_budget: Optional[int] = None,
+                 groups: Optional[Sequence[Sequence[int]]] = None,
+                 exclusive_groups: bool = False) -> BatchPlan:
+    """Pack rows into length-sorted, budget-capped batches.
+
+    Args:
+        lengths: per-row token lengths, indexed by original row position.
+        batch_size: max rows per batch (the inferencer's knob, unchanged).
+        shape_fn: ``(n_rows, longest) -> (B, S)`` padded-bucket geometry.
+        token_budget: cap on ``B x S`` per batch.  ``None`` uses
+            :func:`default_token_budget`.  A single unit larger than the
+            budget still forms its own (unsplittable) batch.
+        groups: indivisible row groups (e.g. one PPL item's label
+            variants); rows not named stay individual.
+        exclusive_groups: one batch per group — used when batching two
+            groups together would defeat a per-group optimization (the
+            shared-prefix item-major PPL path); the planner then only
+            reorders groups into a shape-minimizing sequence.
+    """
+    shape_fn = shape_fn or _default_shape
+    units = _make_units(lengths, groups)
+    if token_budget is None:
+        token_budget = default_token_budget(lengths, batch_size, shape_fn)
+    # longest first: a batch's S bucket is fixed by its first unit, and
+    # every later unit is no longer than it; ties break on original
+    # position so the plan is deterministic
+    units.sort(key=lambda u: (-u[1], u[0][0]))
+
+    # greedy fill, keeping each batch's unit list so the rebalance pass
+    # below can move whole (indivisible) units between batches
+    packed: List[List[tuple]] = []
+    cur: List[tuple] = []
+    for unit in units:
+        if cur:
+            longest_if = max(max(u[1] for u in cur), unit[1])
+            n_if = sum(len(u[0]) for u in cur) + len(unit[0])
+            b_if, s_if = shape_fn(n_if, longest_if)
+            if (exclusive_groups or n_if > max(batch_size, 1)
+                    or b_if * s_if > token_budget):
+                packed.append(cur)
+                cur = []
+        cur.append(unit)
+    if cur:
+        packed.append(cur)
+
+    # tail rebalancing: a class's final partial batch would mint a fresh
+    # (B_small, S) jit shape; shifting this batch's shortest units into
+    # the tail until both land in the same B bucket (e.g. 16+8 -> 12+12,
+    # both bucketing to 16) removes the extra compile.  This can COST
+    # padded tokens (24S -> 32S in that example — stats count the real
+    # bucketed footprint): one skipped XLA compile (seconds to minutes on
+    # remote-compile tunnels) is worth a single batch's extra pad rows
+    if not exclusive_groups:
+        def _n(batch):
+            return sum(len(u[0]) for u in batch)
+        for i in range(len(packed) - 1):
+            a, b = packed[i], packed[i + 1]
+            n_a, n_b = _n(a), _n(b)
+            s_a = shape_fn(n_a, max(u[1] for u in a))[1]
+            s_b = shape_fn(n_b, max(u[1] for u in b))[1]
+            if s_a != s_b or shape_fn(n_b, 1)[0] == shape_fn(n_a, 1)[0]:
+                continue
+            # smallest k tail units of a whose move equalizes B buckets
+            moved = 0
+            for k in range(1, len(a)):
+                moved += len(a[-k][0])
+                na, nb = n_a - moved, n_b + moved
+                if nb > na or nb > max(batch_size, 1):
+                    break
+                if shape_fn(na, 1)[0] == shape_fn(nb, 1)[0]:
+                    packed[i + 1] = a[-k:] + b
+                    del a[-k:]
+                    break
+
+    batches: List[PlannedBatch] = []
+    for group in packed:
+        if not group:
+            continue
+        rows: List[int] = []
+        for u in group:
+            rows.extend(u[0])
+        longest = max(u[1] for u in group)
+        batches.append(PlannedBatch(
+            indices=tuple(rows),
+            shape=shape_fn(len(rows), longest),
+            longest=longest, real_tokens=sum(u[2] for u in group)))
+
+    # emit grouped by shape, biggest S (then B) first: identical shapes
+    # run back to back and the most expensive compile is paid first
+    batches.sort(key=lambda b: (-b.shape[1], -b.shape[0], b.indices[0]))
+    return BatchPlan(batches=batches, stats=_stats_for(batches),
+                     planned=True)
+
+
+def sequential_plan(lengths: Sequence[int],
+                    batch_size: int,
+                    shape_fn: Optional[ShapeFn] = None,
+                    groups: Optional[Sequence[Sequence[int]]] = None,
+                    exclusive_groups: bool = False) -> BatchPlan:
+    """Arrival-order chunking expressed as a plan — the bypass path
+    (``batch_plan=False``, API models) and the planner's comparison
+    baseline.  Batch composition matches ``get_batches`` exactly."""
+    shape_fn = shape_fn or _default_shape
+    units = _make_units(lengths, groups)
+    units.sort(key=lambda u: u[0][0])
+    batches: List[PlannedBatch] = []
+    cur_rows: List[int] = []
+    cur_longest = 0
+    cur_real = 0
+    for rows, longest, total in units:
+        if cur_rows and (exclusive_groups
+                         or len(cur_rows) + len(rows)
+                         > max(batch_size, 1)):
+            batches.append(PlannedBatch(
+                indices=tuple(cur_rows),
+                shape=shape_fn(len(cur_rows), cur_longest),
+                longest=cur_longest, real_tokens=cur_real))
+            cur_rows, cur_longest, cur_real = [], 0, 0
+        cur_rows.extend(rows)
+        cur_longest = max(cur_longest, longest)
+        cur_real += total
+    if cur_rows:
+        batches.append(PlannedBatch(
+            indices=tuple(cur_rows),
+            shape=shape_fn(len(cur_rows), cur_longest),
+            longest=cur_longest, real_tokens=cur_real))
+    return BatchPlan(batches=batches, stats=_stats_for(batches),
+                     planned=False)
+
+
+# ---------------------------------------------------------------------------
+# execution
+# ---------------------------------------------------------------------------
+
+class ReadyHandle:
+    """A completed async result — the sync fallback when a dispatch hook
+    has no real async path (e.g. a subclass's overridden sync batch
+    hook).  The executor only requires ``.result()``; models provide
+    their own duck-compatible handles (``models/base.py`` ``_Ready`` for
+    sync models, ``_Lazy`` deferring the device fetch)."""
+    __slots__ = ('_value',)
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self):
+        return self._value
+
+
+def execute_plan(plan, dispatch, collect, depth: int = 1) -> float:
+    """Run a plan through a bounded in-flight window.
+
+    ``dispatch(batch)`` encodes/pads/enqueues one batch and returns a
+    handle with ``.result()``; ``collect(batch, result)`` scatters its
+    results.  ``depth`` is the number of batches left in flight while the
+    host works ahead (1 = double buffering; 0 = fully synchronous, the
+    legacy loop).  Returns the host seconds spent in ``dispatch``/
+    ``collect`` while at least one earlier batch was still in flight —
+    work the pipeline overlapped with device execution.
+    """
+    pending = collections.deque()
+    overlap = 0.0
+    for batch in plan:
+        t0 = time.perf_counter()
+        handle = dispatch(batch)
+        if pending:
+            overlap += time.perf_counter() - t0
+        pending.append((batch, handle))
+        while len(pending) > max(depth, 0):
+            b, h = pending.popleft()
+            result = h.result()
+            t0 = time.perf_counter()
+            collect(b, result)
+            if pending:
+                overlap += time.perf_counter() - t0
+    while pending:
+        b, h = pending.popleft()
+        collect(b, h.result())
+    return overlap

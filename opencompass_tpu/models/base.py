@@ -24,6 +24,41 @@ from opencompass_tpu.utils.prompt import PromptList
 PromptType = Union[PromptList, str]
 
 
+class _Ready:
+    """A completed async result: the sync fallback for models without a
+    real dispatch/fetch split.  Intentionally duplicates the scheduler's
+    ``ReadyHandle`` (icl/inferencers/schedule.py) rather than importing
+    it — the handle contract is duck-typed (``.result()`` only) precisely
+    so the model and inferencer layers stay import-decoupled; keep edits
+    to either copy in sync."""
+    __slots__ = ('_value',)
+
+    def __init__(self, value):
+        self._value = value
+
+    def result(self):
+        return self._value
+
+
+class _Lazy:
+    """An in-flight async result: ``result()`` blocks on the deferred
+    fetch once and caches (accelerator models wrap their host fetch —
+    ``np.asarray`` + decode — in one of these)."""
+    __slots__ = ('_fetch', '_value', '_done')
+
+    def __init__(self, fetch):
+        self._fetch = fetch
+        self._done = False
+        self._value = None
+
+    def result(self):
+        if not self._done:
+            self._value = self._fetch()
+            self._done = True
+            self._fetch = None  # drop closed-over device arrays
+        return self._value
+
+
 class MetaTemplateWalker:
     """Shared machinery for walking a PromptList against a meta template.
 
@@ -226,6 +261,12 @@ class BaseModel(abc.ABC):
     """
 
     is_api: bool = False
+    # opt-in for the inferencers' length-aware batch planner
+    # (icl/inferencers/schedule.py): True means batches may be reordered
+    # and re-packed under a token budget (results are scattered back to
+    # original indices, so per-row outputs are unchanged).  API models
+    # keep arrival order; JaxLM turns this on.
+    supports_batch_plan: bool = False
 
     def __init__(self,
                  path: str,
@@ -289,6 +330,39 @@ class BaseModel(abc.ABC):
             out.append(choices[scores.index(max(scores))])
         return out
 
+    # -- batch planning / async dispatch hooks -----------------------------
+
+    def plan_shape(self, n_rows: int, longest: int,
+                   max_len: Optional[int] = None) -> Tuple[int, int]:
+        """Padded device shape ``(B, S)`` for a batch of ``n_rows`` rows
+        whose longest row is ``longest`` tokens.  The batch planner uses
+        it to cost candidate batches; models with bucketed static shapes
+        (JaxLM) override it to mirror their padder exactly."""
+        longest = max(int(longest), 1)
+        if max_len is not None:
+            longest = min(longest, max(int(max_len), 1))
+        return max(int(n_rows), 1), longest
+
+    def generate_async(self, inputs: List[str], max_out_len: int):
+        """Dispatch one generation batch; returns a handle whose
+        ``result()`` yields what :meth:`generate` would.  Default is
+        synchronous — accelerator models override to enqueue the device
+        work and defer the host fetch, enabling the inferencers' double-
+        buffered pipeline."""
+        return _Ready(self.generate(inputs, max_out_len=max_out_len))
+
+    def get_ppl_async(self, inputs: List[str],
+                      mask_length: Optional[List[int]] = None):
+        """Async counterpart of :meth:`get_ppl` (see generate_async)."""
+        return _Ready(self.get_ppl(inputs, mask_length))
+
+    def get_choice_logprobs_async(self, inputs: List[str],
+                                  choices: List[str]):
+        """Async counterpart of ``get_choice_logprobs`` for models that
+        implement it (raises AttributeError otherwise, same as the sync
+        call would)."""
+        return _Ready(self.get_choice_logprobs(inputs, choices))
+
     # -- template-aware entry points used by inferencers -------------------
     def parse_template(self, prompt_template: PromptType, mode: str):
         return self.template_parser.parse_template(prompt_template, mode)
@@ -297,9 +371,26 @@ class BaseModel(abc.ABC):
         inputs = self.parse_template(templates, mode='ppl')
         return self.get_ppl(inputs, mask_length)
 
+    def get_ppl_from_template_async(self, templates, mask_length=None):
+        # models without a real dispatch/fetch split go through the SYNC
+        # template method so subclass overrides of it keep observing
+        # every batch; models with real async primitives skip it
+        if type(self).get_ppl_async is BaseModel.get_ppl_async:
+            return _Ready(self.get_ppl_from_template(
+                templates, mask_length=mask_length))
+        inputs = self.parse_template(templates, mode='ppl')
+        return self.get_ppl_async(inputs, mask_length)
+
     def generate_from_template(self, templates, max_out_len: int):
         inputs = self.parse_template(templates, mode='gen')
         return self.generate(inputs, max_out_len=max_out_len)
+
+    def generate_from_template_async(self, templates, max_out_len: int):
+        if type(self).generate_async is BaseModel.generate_async:
+            return _Ready(self.generate_from_template(
+                templates, max_out_len=max_out_len))
+        inputs = self.parse_template(templates, mode='gen')
+        return self.generate_async(inputs, max_out_len=max_out_len)
 
     def get_token_len_from_template(self, templates, mode: str = 'ppl'):
         prompts = self.parse_template(templates, mode=mode)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import functools
 import hashlib
 import os
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Union
 
@@ -42,7 +43,7 @@ from opencompass_tpu.registry import MODELS
 from opencompass_tpu.utils.logging import get_logger
 from opencompass_tpu.utils.perf import device_call
 
-from .base import BaseModel
+from .base import BaseModel, _Lazy
 from .tokenizer import load_tokenizer
 
 logger = get_logger()
@@ -72,6 +73,11 @@ class JaxLM(BaseModel):
             'float32' for bit-stable CPU tests).
         batch_bucket / seq_bucket_min: shape-bucketing knobs.
     """
+
+    # inferencers may re-pack/reorder batches (length-aware planner,
+    # icl/inferencers/schedule.py): per-row outputs are batch-independent
+    # here, and fewer distinct (B, S) buckets means fewer XLA compiles
+    supports_batch_plan = True
 
     def __init__(self,
                  path: str = '',
@@ -528,19 +534,33 @@ class JaxLM(BaseModel):
         spec = P('data', None)
         return self._put(tokens, spec), self._put(mask, spec), ids
 
-    def _pad_ids(self, ids: List[List[int]], left_pad: bool,
-                 max_len: int) -> tuple:
-        """Bucket-pad pre-encoded id rows into (tokens, mask) numpy."""
-        longest = max((len(x) for x in ids), default=1)
-        S = _bucket(max(longest, 1), hi=max(max_len, 32))
+    def plan_shape(self, n_rows: int, longest: int,
+                   max_len: Optional[int] = None) -> tuple:
+        """Padded device shape for a batch — the single source of truth
+        shared by :meth:`_pad_ids` (what actually ships) and the batch
+        planner (what it costs), so the two can never drift."""
+        if max_len is None:
+            max_len = self.max_seq_len
+        S = _bucket(max(int(longest), 1), hi=max(int(max_len), 32))
         min_b = self.mesh.shape.get('data', 1) if self.mesh is not None else 1
         seq_par = self.mesh.shape.get('seq', 1) if self.mesh is not None \
             else 1
         if S % seq_par:  # ring attention shards S over the seq axis
             S = (S // seq_par + 1) * seq_par
-        B = _bucket(len(ids), lo=max(1, min_b))
+        B = _bucket(max(int(n_rows), 1), lo=max(1, min_b))
         if B % min_b:  # non-pow2 data axis
             B = (B // min_b + 1) * min_b
+        return B, S
+
+    def _pad_ids(self, ids: List[List[int]], left_pad: bool,
+                 max_len: int) -> tuple:
+        """Bucket-pad pre-encoded id rows into (tokens, mask) numpy.
+        Also charges the padding waste (pad slots actually materialized
+        on device) to ``perf.pad_tokens`` — the padding-efficiency
+        counter surfaced by the perf table and obs plane."""
+        longest = max((len(x) for x in ids), default=1)
+        B, S = self.plan_shape(len(ids), longest, max_len)
+        self.perf.pad_tokens += B * S - sum(len(row) for row in ids)
         pad_id = self.tokenizer.pad_token_id or 0
         tokens = np.full((B, S), pad_id, np.int32)
         mask = np.zeros((B, S), bool)
@@ -567,6 +587,15 @@ class JaxLM(BaseModel):
     def get_ppl(self,
                 inputs: List[str],
                 mask_length: Optional[List[int]] = None) -> List[float]:
+        return self.get_ppl_async(inputs, mask_length).result()
+
+    def get_ppl_async(self,
+                      inputs: List[str],
+                      mask_length: Optional[List[int]] = None):
+        """Tokenize, pad and enqueue one scoring batch; the returned
+        handle's ``result()`` blocks on the device and copies the NLLs
+        to host.  JAX dispatch is async, so the caller can prepare the
+        next batch while this one executes (double buffering)."""
         with use_mesh(self.mesh):
             ids = [self._encode_ids(str(s))[:self.max_seq_len]
                    for s in inputs]
@@ -597,8 +626,14 @@ class JaxLM(BaseModel):
                                        self._put(tokens, spec),
                                        self._put(mask, spec),
                                        self._put(mlb, P('data')))
-                out = np.asarray(nll)
-            return out[:len(inputs)].tolist()
+        n = len(inputs)
+
+        def fetch():
+            t0 = time.perf_counter()
+            out = np.asarray(nll)
+            self.perf.device_seconds += time.perf_counter() - t0
+            return out[:n].tolist()
+        return _Lazy(fetch)
 
     @functools.cached_property
     def _choice_logits_fn(self):
@@ -636,6 +671,10 @@ class JaxLM(BaseModel):
                             choices: List[str]) -> List[List[float]]:
         """Softmax over the choices' first-token logits at the prompt end
         (the CLP measurement — reference icl_clp_inferencer.py:206-223)."""
+        return self.get_choice_logprobs_async(inputs, choices).result()
+
+    def get_choice_logprobs_async(self, inputs: List[str],
+                                  choices: List[str]):
         choice_ids = []
         for choice in choices:
             # no specials here: we want the choice's own first token, not BOS
@@ -654,14 +693,22 @@ class JaxLM(BaseModel):
                              tokens_in=sum(len(r) for r in ids),
                              samples=len(inputs), first=first):
                 logits = self._choice_logits_fn(self.params, tokens, mask)
-                logits = np.asarray(logits, np.float64)
-        logits = logits[:len(inputs)]
-        sub = logits[:, choice_ids]
-        sub = np.exp(sub - sub.max(axis=-1, keepdims=True))
-        sub = sub / sub.sum(axis=-1, keepdims=True)
-        return sub.tolist()
+        n = len(inputs)
+
+        def fetch():
+            t0 = time.perf_counter()
+            logits_h = np.asarray(logits, np.float64)
+            self.perf.device_seconds += time.perf_counter() - t0
+            sub = logits_h[:n][:, choice_ids]
+            sub = np.exp(sub - sub.max(axis=-1, keepdims=True))
+            sub = sub / sub.sum(axis=-1, keepdims=True)
+            return sub.tolist()
+        return _Lazy(fetch)
 
     def generate(self, inputs: List[str], max_out_len: int) -> List[str]:
+        return self.generate_async(inputs, max_out_len).result()
+
+    def generate_async(self, inputs: List[str], max_out_len: int):
         if self.mesh is not None and self.mesh.shape.get('seq', 1) > 1 \
                 and not getattr(self, '_warned_seq_gen', False):
             self._warned_seq_gen = True
@@ -711,14 +758,20 @@ class JaxLM(BaseModel):
                     out, lengths = fn(self.params,
                                       self._put(tokens, spec),
                                       self._put(mask, spec), rng)
-                out = np.asarray(out)
-                lengths = np.asarray(lengths)
-        self.perf.tokens_out += int(lengths[:len(inputs)].sum())
-        texts = []
-        for i in range(len(inputs)):
-            n = int(lengths[i])
-            row = out[i, :n]
-            if self.eos_token_id is not None:
-                row = row[row != self.eos_token_id]
-            texts.append(self.tokenizer.decode(row))
-        return texts
+        n_in = len(inputs)
+
+        def fetch():
+            t0 = time.perf_counter()
+            out_h = np.asarray(out)
+            lengths_h = np.asarray(lengths)
+            self.perf.device_seconds += time.perf_counter() - t0
+            self.perf.tokens_out += int(lengths_h[:n_in].sum())
+            texts = []
+            for i in range(n_in):
+                n = int(lengths_h[i])
+                row = out_h[i, :n]
+                if self.eos_token_id is not None:
+                    row = row[row != self.eos_token_id]
+                texts.append(self.tokenizer.decode(row))
+            return texts
+        return _Lazy(fetch)

@@ -161,7 +161,9 @@ def _subtree_perf(root: _SpanNode) -> Dict[str, float]:
         if isinstance(perf, dict):
             for key in ('device_seconds', 'compile_seconds',
                         'wall_seconds', 'tokens_in', 'tokens_out',
-                        'samples', 'device_calls'):
+                        'samples', 'device_calls', 'pad_tokens',
+                        'overlap_seconds', 'planned_shapes',
+                        'first_calls'):
                 val = perf.get(key)
                 if isinstance(val, (int, float)):
                     out[key] += val
@@ -209,6 +211,8 @@ def build_report(work_dir: str, trace: Optional[str] = None) -> Dict:
         perf = _subtree_perf(n)
         compile_s = perf.get('compile_seconds', 0.0)
         device_s = perf.get('device_seconds', 0.0)
+        tokens_in = perf.get('tokens_in', 0.0)
+        pad = perf.get('pad_tokens', 0.0)
         return {
             'name': name,
             'wall_seconds': round(_span_wall(n), 3),
@@ -218,6 +222,15 @@ def build_report(work_dir: str, trace: Optional[str] = None) -> Dict:
             'device_seconds': round(device_s, 3),
             'steady_device_seconds': round(
                 max(0.0, device_s - compile_s), 3),
+            # batch-planner telemetry: padding efficiency of what the
+            # device actually saw, planned shape buckets vs the jit
+            # compiles actually paid, host time hidden by the pipeline
+            'pad_eff': round(tokens_in / (tokens_in + pad), 4)
+            if tokens_in + pad > 0 else None,
+            'planned_shapes': int(perf.get('planned_shapes', 0)),
+            'dispatched_shapes': int(perf.get('first_calls', 0)),
+            'overlap_seconds': round(
+                perf.get('overlap_seconds', 0.0), 3),
             'retries': int(n.attrs.get('retries', 0)),
             'devices': n.attrs.get('devices', []),
             'status': ('error' if n.status == 'error'
@@ -402,11 +415,19 @@ def render_report(report: Dict) -> str:
     out.append('\n-- per-task breakdown --')
     if report['tasks']:
         rows = [['task', 'wall_s', 'wait_s', 'compile_s', 'device_s',
-                 'steady_s', 'retries', 'devices', 'status']]
+                 'steady_s', 'pad_eff', 'shapes', 'overlap_s', 'retries',
+                 'devices', 'status']]
         for t in report['tasks']:
+            shapes = '-'
+            if t.get('planned_shapes') or t.get('dispatched_shapes'):
+                shapes = (f"{t.get('planned_shapes', 0)}/"
+                          f"{t.get('dispatched_shapes', 0)}")
             rows.append([t['name'][:60], t['wall_seconds'],
                          t['wait_seconds'], t['compile_seconds'],
                          t['device_seconds'], t['steady_device_seconds'],
+                         t.get('pad_eff') if t.get('pad_eff') is not None
+                         else '-',
+                         shapes, t.get('overlap_seconds', 0.0),
                          t['retries'],
                          ','.join(map(str, t['devices'])) or '-',
                          t['status']])

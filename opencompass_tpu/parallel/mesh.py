@@ -81,8 +81,15 @@ def use_mesh(mesh: Optional[Mesh]):
     _state.mesh = mesh
     try:
         if mesh is not None:
-            with jax.set_mesh(mesh):
-                yield mesh
+            # jax.set_mesh landed after 0.4.x; older jax spells the same
+            # thing as the Mesh context manager (the pjit-era API), which
+            # equally makes bare-PartitionSpec sharding constraints resolve
+            if hasattr(jax, 'set_mesh'):
+                with jax.set_mesh(mesh):
+                    yield mesh
+            else:
+                with mesh:
+                    yield mesh
         else:
             yield None
     finally:

@@ -25,7 +25,8 @@ from jax.sharding import PartitionSpec as P
 from .mesh import manual_axes
 
 
-def _ring_attention(q, k, v, kv_valid, q_index, axis_name: str):
+def _ring_attention(q, k, v, kv_valid, q_index, axis_name: str,
+                    axis_size=None):
     """Blockwise ring attention for one shard_map-mapped chunk.
 
     q: (B, T, H, hd) local queries; k/v: (B, T, K, hd) local K/V chunk;
@@ -36,7 +37,11 @@ def _ring_attention(q, k, v, kv_valid, q_index, axis_name: str):
     B, T, H, hd = q.shape
     K = k.shape[2]
     G = H // K
-    n = jax.lax.axis_size(axis_name)
+    # the ring length must be a static int (it sizes the ppermute table
+    # and loop bound); jax.lax.axis_size is missing pre-0.5, so callers
+    # inside shard_map pass the mesh axis size explicitly
+    n = axis_size if axis_size is not None \
+        else jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     qg = q.reshape(B, T, K, G, hd)
     scale = hd ** -0.5
@@ -132,7 +137,8 @@ def ring_forward(params, cfg, tokens: jax.Array, pad_mask: jax.Array,
         q_index = my * T + jnp.arange(T)
 
         def attn_fn(q, k, v):
-            return _ring_attention(q, k, v, pad_c, q_index, 'seq')
+            return _ring_attention(q, k, v, pad_c, q_index, 'seq',
+                                   axis_size=mesh.shape['seq'])
 
         with manual_axes():
             x = _embed(params, cfg, tokens_c, pos_c)
@@ -140,10 +146,15 @@ def ring_forward(params, cfg, tokens: jax.Array, pad_mask: jax.Array,
                           attn_fn=attn_fn, tp_axis=tp_axis)
             return _unembed(params, cfg, x)
 
-    f = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(param_in_specs, P('data', 'seq'), P('data', 'seq'),
-                  P('data', 'seq')),
-        out_specs=logits_spec,
-        check_vma=False)
+    in_specs = (param_in_specs, P('data', 'seq'), P('data', 'seq'),
+                P('data', 'seq'))
+    if hasattr(jax, 'shard_map'):
+        f = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                          out_specs=logits_spec, check_vma=False)
+    else:
+        # pre-0.5 jax: shard_map lives in jax.experimental and the
+        # replication-check flag is spelled check_rep
+        from jax.experimental.shard_map import shard_map as _shard_map
+        f = _shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=logits_spec, check_rep=False)
     return f(params, tokens, pad_mask, positions)

@@ -44,6 +44,18 @@ class PerfCounters:
     # time (the obs trace report's compile attribution column)
     compile_seconds: float = 0.0
     first_calls: int = 0
+    # padding efficiency: pad slots actually materialized on device
+    # (B*S minus real tokens, charged by the model's padder); pad_eff =
+    # tokens_in / (tokens_in + pad_tokens) in the perf record
+    pad_tokens: int = 0
+    # host seconds the batch-plan pipeline overlapped with device
+    # execution (tokenize/pad of batch N+1 + decode of batch N-1 while
+    # batch N ran) — 0 without the planner's double buffering
+    overlap_seconds: float = 0.0
+    # distinct (B, S) shape buckets the batch planner scheduled for this
+    # task (planner-instrumented inferencers add it; compare with
+    # first_calls for the planned-vs-dispatched compile story)
+    planned_shapes: int = 0
 
     def snapshot(self) -> dict:
         return dataclasses.asdict(self)
@@ -136,6 +148,12 @@ class TaskProfiler:
                 if wall else 0,
                 device_utilization=round(d['device_seconds'] / wall, 3)
                 if wall else 0,
+                pad_tokens=d['pad_tokens'],
+                pad_eff=round(
+                    d['tokens_in'] / (d['tokens_in'] + d['pad_tokens']), 4)
+                if d['tokens_in'] + d['pad_tokens'] > 0 else 1.0,
+                overlap_seconds=round(d['overlap_seconds'], 3),
+                planned_shapes=d['planned_shapes'],
             )
         if self.trace_dir and self._trace_active:
             record['trace_dir'] = self.trace_dir

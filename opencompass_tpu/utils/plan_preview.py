@@ -1,0 +1,148 @@
+"""``python -m opencompass_tpu.cli plan <config>`` — device-free batch-plan
+dry run.
+
+For every (model, dataset) pair in the config this builds the real
+prompts (retriever + templates + truncation loops), measures token
+lengths through the model's tokenizer (``tokenizer_only`` — no weights,
+no accelerator), and prints each task's planned batch shapes, estimated
+compile count (distinct jit shape buckets), and padding efficiency
+against the sequential-chunking baseline.  Cheap pre-flight for
+expensive remote-compile runs: a task showing dozens of distinct shapes
+or a pad_eff under ~0.5 is worth re-bucketing before it ever touches a
+device.
+"""
+from __future__ import annotations
+
+import argparse
+import copy
+import json
+from typing import List, Optional
+
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+def _tokenizer_only_model(model_cfg):
+    from opencompass_tpu.utils.build import build_model_from_cfg
+    cfg = copy.deepcopy(model_cfg)
+    cfg['tokenizer_only'] = True
+    try:
+        return build_model_from_cfg(cfg)
+    except TypeError:
+        # model type without a tokenizer_only knob (API wrappers):
+        # build as declared — still device-free
+        return build_model_from_cfg(model_cfg)
+
+
+def _preview_task(model, model_cfg, dataset_cfg,
+                  token_budget: Optional[int]):
+    from opencompass_tpu.registry import (ICL_INFERENCERS,
+                                          ICL_PROMPT_TEMPLATES,
+                                          ICL_RETRIEVERS)
+    from opencompass_tpu.utils.build import build_dataset_from_cfg
+    infer_cfg = dataset_cfg['infer_cfg']
+    ice_template = None
+    if 'ice_template' in infer_cfg:
+        ice_template = ICL_PROMPT_TEMPLATES.build(infer_cfg['ice_template'])
+    prompt_template = None
+    if 'prompt_template' in infer_cfg:
+        prompt_template = ICL_PROMPT_TEMPLATES.build(
+            infer_cfg['prompt_template'])
+    dataset = build_dataset_from_cfg(dataset_cfg)
+    retriever_cfg = dict(infer_cfg['retriever'])
+    retriever_cfg['dataset'] = dataset
+    retriever = ICL_RETRIEVERS.build(retriever_cfg)
+
+    inferencer_cfg = dict(infer_cfg['inferencer'])
+    inferencer_cfg['model'] = model
+    for key in ('max_out_len', 'max_seq_len'):
+        if model_cfg.get(key) is not None:
+            inferencer_cfg.setdefault(key, model_cfg[key])
+    inferencer_cfg.setdefault('batch_size',
+                              model_cfg.get('batch_size', 1))
+    if token_budget is not None:
+        inferencer_cfg['token_budget'] = token_budget
+    inferencer = ICL_INFERENCERS.build(inferencer_cfg)
+    if not hasattr(inferencer, 'plan_preview'):
+        return None
+    return inferencer.plan_preview(retriever, ice_template=ice_template,
+                                   prompt_template=prompt_template)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='opencompass-tpu plan',
+        description='dry-run the batch planner over a run config: batch '
+                    'shapes, estimated compile count and padding '
+                    'efficiency per task, without touching a device')
+    parser.add_argument('config', help='run config file path')
+    parser.add_argument('--token-budget', type=int, default=None,
+                        help='override the planner token budget '
+                        '(max padded B*S per batch)')
+    parser.add_argument('--json', action='store_true',
+                        help='emit one JSON object instead of the table')
+    args = parser.parse_args(argv)
+
+    from opencompass_tpu.config import Config
+    from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,
+                                            model_abbr_from_cfg)
+    cfg = Config.fromfile(args.config)
+
+    results = []
+    for model_cfg in cfg.get('models', []):
+        m_abbr = model_abbr_from_cfg(model_cfg)
+        try:
+            model = _tokenizer_only_model(model_cfg)
+        except Exception as exc:
+            logger.warning(f'plan: cannot build {m_abbr}: {exc}')
+            continue
+        for dataset_cfg in cfg.get('datasets', []):
+            d_abbr = dataset_abbr_from_cfg(dataset_cfg)
+            try:
+                preview = _preview_task(model, model_cfg, dataset_cfg,
+                                        args.token_budget)
+            except Exception as exc:
+                logger.warning(f'plan: {m_abbr}/{d_abbr} failed: {exc}')
+                preview = None
+            if preview is None:
+                continue
+            preview['model'] = m_abbr
+            preview['dataset'] = d_abbr
+            results.append(preview)
+
+    if args.json:
+        print(json.dumps({'v': 1, 'tasks': results}, indent=2))
+        return 0
+    if not results:
+        print('no plannable (model, dataset) tasks found')
+        return 1
+    header = ['model', 'dataset', 'rows', 'plan', 'batches', 'shapes',
+              'pad_eff', 'seq_batches', 'seq_shapes', 'seq_pad_eff']
+    rows = [header]
+    for r in results:
+        planned, seq = r['planned'], r['sequential']
+        rows.append([
+            r['model'], r['dataset'], r['rows'],
+            'on' if r['plan_enabled'] else 'off',
+            planned['n_batches'], planned['n_shapes'],
+            planned['pad_eff'], seq['n_batches'], seq['n_shapes'],
+            seq['pad_eff']])
+    widths = [max(len(str(row[i])) for row in rows)
+              for i in range(len(header))]
+    for i, row in enumerate(rows):
+        print('  '.join(str(c).ljust(w) for c, w in zip(row, widths)))
+        if i == 0:
+            print('  '.join('-' * w for w in widths))
+    print('\nshapes = distinct padded (B, S) jit buckets; each unseen '
+          'shape pays one XLA compile.')
+    for r in results:
+        shapes = r['planned'].get('shapes', {})
+        if shapes:
+            print(f"  {r['model']}/{r['dataset']}: "
+                  + ', '.join(f'{k} x{v}' for k, v in shapes.items()))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

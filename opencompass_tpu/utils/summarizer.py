@@ -208,7 +208,8 @@ class Summarizer:
         perf_rows = []
         if perf:
             perf_rows = [['dataset', 'model', 'samples/s', 'tokens/s',
-                          'device_util', 'compile_s', 'wall_s', 'error']]
+                          'device_util', 'compile_s', 'pad_eff', 'wall_s',
+                          'error']]
             for d_abbr in dataset_abbrs:
                 for m_abbr in model_abbrs:
                     rec = perf.get(m_abbr, {}).get(d_abbr)
@@ -221,6 +222,7 @@ class Summarizer:
                         rec.get('tokens_per_sec', '-'),
                         rec.get('device_utilization', '-'),
                         rec.get('compile_seconds', '-'),
+                        rec.get('pad_eff', '-'),
                         rec.get('wall_seconds', '-'),
                         err if len(str(err)) <= 40 else str(err)[:37]
                         + '...'])
