@@ -219,6 +219,121 @@ def _bench_planner():
     }
 
 
+def _warm_path_child(cache_dir):
+    """One cold-start 'task': fresh interpreter, build a tiny JaxLM, run
+    one scoring + one generation batch against the persistent compile
+    cache at ``cache_dir``.  Prints the TaskProfiler perf record (plus
+    model-build seconds) as one JSON line for the parent to diff."""
+    os.environ['OCT_COMPILE_CACHE'] = cache_dir
+    from opencompass_tpu.models.jax_lm import JaxLM
+    from opencompass_tpu.utils import compile_cache
+    from opencompass_tpu.utils.perf import TaskProfiler
+    compile_cache.enable()
+    t0 = time.perf_counter()
+    lm = JaxLM(config='tiny', max_seq_len=256)
+    build_s = time.perf_counter() - t0
+    with TaskProfiler(lm) as prof:
+        lm.get_ppl(['the quick brown fox jumps over the lazy dog',
+                    'pack my box with five dozen liquor jugs'])
+        lm.generate(['warm path check'], max_out_len=8)
+    rec = dict(prof.record)
+    rec['model_build_seconds'] = round(build_s, 3)
+    print(json.dumps(rec))
+
+
+def _bench_worker_pool():
+    """Worker-mode FakeModel leg: N dataset shards through ONE
+    model-resident worker — asserts the residency story end to end
+    (model built exactly once, every shard green) and times it."""
+    import os.path as osp
+    import tempfile
+
+    from opencompass_tpu import obs
+    from opencompass_tpu.config import Config
+    from opencompass_tpu.partitioners import SizePartitioner
+    from opencompass_tpu.runners import LocalRunner
+
+    work = tempfile.mkdtemp(prefix='oct_warm_worker_')
+    cfg = Config.fromfile(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     'configs/eval_demo.py'))
+    cfg['work_dir'] = work
+    cfg['obs'] = True
+    obs.reset_obs()
+    tracer = obs.init_obs(work, enabled=True)
+    part = SizePartitioner(osp.join(work, 'predictions/'),
+                           max_task_size=100,
+                           dataset_size_path=osp.join(work, 'size.json'))
+    tasks = part(cfg)
+    t0 = time.perf_counter()
+    runner = LocalRunner(task=dict(type='OpenICLInferTask'),
+                         use_workers=True, max_num_workers=4)
+    status = runner(tasks)
+    wall = time.perf_counter() - t0
+    tracer.close()
+    builds = 0
+    with open(osp.join(work, 'obs', 'events.jsonl')) as f:
+        for line in f:
+            if '"worker_model_build"' in line:
+                builds += 1
+    obs.reset_obs()
+    return {
+        'n_tasks': len(tasks),
+        'model_builds': builds,
+        'failed': sum(1 for _, rc in status if rc != 0),
+        'wall_seconds': round(wall, 2),
+    }
+
+
+def _bench_warm_path(out_json='BENCH_WARM.json'):
+    """detail.warm_path: the same tiny-JaxLM task twice, each a fresh
+    interpreter, sharing one persistent XLA compile cache — the
+    second run's compile_seconds is the warm-path win (cache retrieval
+    instead of cold compiles) — plus the worker-pool residency leg.
+    The record is also written to ``BENCH_WARM.json`` so the perf
+    trajectory accumulates round over round."""
+    import subprocess
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix='oct_warm_cache_')
+    here = os.path.abspath(__file__)
+    runs = []
+    for _ in range(2):
+        r = subprocess.run(
+            [sys.executable, here, '--warm-path-child', cache_dir],
+            capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(here))
+        if r.returncode != 0:
+            return {'error': (r.stderr or r.stdout)[-500:]}
+        runs.append(json.loads(r.stdout.strip().splitlines()[-1]))
+    cold, warm = runs
+    record = {
+        'v': 1,
+        'workload': 'tiny JaxLM (s256): 1 ppl + 1 gen batch per run, '
+                    'two fresh processes sharing one compile cache',
+        'cold': cold,
+        'warm': warm,
+        'compile_seconds_cold': cold.get('compile_seconds'),
+        'compile_seconds_warm': warm.get('compile_seconds'),
+        'compile_speedup': round(
+            cold.get('compile_seconds', 0.0)
+            / max(warm.get('compile_seconds', 0.0), 1e-3), 2),
+        'wall_delta_seconds': round(
+            cold.get('wall_seconds', 0.0) - warm.get('wall_seconds',
+                                                     0.0), 3),
+        'cache_hits_warm': warm.get('compile_cache_hits'),
+        'cache_misses_cold': cold.get('compile_cache_misses'),
+        'worker_pool': _bench_worker_pool(),
+    }
+    try:
+        with open(os.path.join(os.path.dirname(here), out_json),
+                  'w') as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
+    return record
+
+
 def main():
     n_chips = max(1, len(jax.devices()))
     kind = getattr(jax.devices()[0], 'device_kind', '')
@@ -513,6 +628,7 @@ def main():
             'quant_agreement': agreement,
             'shared_prefix': shared_leg,
             'batch_planner': _bench_planner(),
+            'warm_path': _bench_warm_path(),
             'a100_est': a100,
             'a100_est_b32': a100_b32,
             'small': {
@@ -529,4 +645,12 @@ def main():
 
 
 if __name__ == '__main__':
+    if '--warm-path-child' in sys.argv:
+        _warm_path_child(sys.argv[sys.argv.index('--warm-path-child') + 1])
+        sys.exit(0)
+    if '--warm-path' in sys.argv:
+        # standalone warm-path leg (device-free; runs on CPU hosts)
+        print(json.dumps({'metric': 'warm_path', 'v': 1,
+                          'detail': _bench_warm_path()}))
+        sys.exit(0)
     main()

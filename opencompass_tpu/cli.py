@@ -12,9 +12,11 @@ Usage::
     python run.py cfg.py --slurm -p PARTITION       # cluster launch
     python run.py cfg.py --obs                      # run-wide tracing
     python run.py cfg.py --obs --obs-port 9464      # + live /metrics HTTP
+    python run.py cfg.py --no-workers               # one subprocess per task
     python -m opencompass_tpu.cli trace WORK_DIR    # render trace report
     python -m opencompass_tpu.cli status WORK_DIR --watch   # live progress
     python -m opencompass_tpu.cli plan cfg.py       # batch-plan dry run
+    python -m opencompass_tpu.cli plan cfg.py --cache-dir DIR  # warm/cold probe
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files
@@ -87,6 +89,21 @@ def parse_args():
                         type=int,
                         default=None,
                         help='accelerator chips available to LocalRunner')
+    workers = parser.add_mutually_exclusive_group()
+    workers.add_argument('--workers',
+                         action='store_true',
+                         default=None,
+                         dest='use_workers',
+                         help='route same-model tasks to model-resident '
+                         'worker processes (weights loaded and shapes '
+                         'compiled once per model instead of once per '
+                         'task).  Default: auto — on for device-model '
+                         'tasks under LocalRunner, off otherwise')
+    workers.add_argument('--no-workers',
+                         action='store_false',
+                         default=None,
+                         dest='use_workers',
+                         help='always use one subprocess per task')
     parser.add_argument('--retry',
                         type=int,
                         default=2,
@@ -131,6 +148,8 @@ def get_config_from_arg(args) -> Config:
         cfg['profile'] = True
     if args.obs or args.obs_port is not None:
         cfg['obs'] = True
+    if args.use_workers is not None:
+        cfg['use_workers'] = args.use_workers
     return cfg
 
 
@@ -167,6 +186,7 @@ def _build_runner(task_type, args, cfg, phase='infer'):
                        retry=args.retry,
                        task_timeout=cfg.get('task_timeout'),
                        stall_timeout=cfg.get('stall_timeout'),
+                       use_workers=cfg.get('use_workers'),
                        lark_bot_url=cfg.get('lark_bot_url'))
 
 
@@ -212,16 +232,20 @@ def main():
         raise SystemExit(status_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'plan':
         raise SystemExit(plan_main(sys.argv[2:]))
-    # persistent XLA compilation cache for the whole pipeline — tasks
-    # inherit it (LocalRunner also sets it for device tasks), and the
-    # --debug in-process path benefits directly.  Rare shapes compile
-    # for minutes through remote-compile tunnels; the cache serves them
-    # from disk on every later run.
-    os.environ.setdefault('JAX_COMPILATION_CACHE_DIR',
-                          osp.abspath('.cache/jax_compilation'))
     args = parse_args()
     cfg = get_config_from_arg(args)
     work_dir = cfg['work_dir']
+    # persistent XLA compilation cache for the whole pipeline, rooted
+    # under {work_dir}/cache (pre-timestamp, so consecutive runs share
+    # it) or a user-set OCT_COMPILE_CACHE.  Tasks and workers inherit
+    # the exported env vars; the --debug in-process path is enabled
+    # directly.  Rare shapes compile for minutes through remote-compile
+    # tunnels; the cache serves them from disk on every later run, and
+    # hit/miss counters split compile time into cold vs cached
+    # (utils/compile_cache.py).
+    from opencompass_tpu.utils import compile_cache
+    compile_cache.export_env(work_dir)
+    compile_cache.enable(work_dir)
 
     # timestamped run dir; -r points back at an old one
     if args.reuse:

@@ -330,6 +330,12 @@ class BaseModel(abc.ABC):
             out.append(choices[scores.index(max(scores))])
         return out
 
+    def save_caches(self):
+        """Persist any host-side caches worth sharing with successor
+        processes (token-length measurements, …).  The infer task calls
+        this when a model's datasets finish; base models hold nothing
+        persistable."""
+
     # -- batch planning / async dispatch hooks -----------------------------
 
     def plan_shape(self, n_rows: int, longest: int,

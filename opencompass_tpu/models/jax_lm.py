@@ -128,6 +128,18 @@ class JaxLM(BaseModel):
             OrderedDict()
         self._ids_cache_max = 8192
         self._len_cache_max = 1_000_000
+        # persisted token-length cache (utils/toklen_cache.py): when the
+        # sweep pins a cache root, resumed/retried/sibling tasks start
+        # from the lengths a previous process already measured instead
+        # of re-tokenizing the dataset.  Text never hits disk — only
+        # the 16-byte digests this cache is keyed on.
+        from opencompass_tpu.utils import toklen_cache
+        self._toklen_dir = toklen_cache.resolve_dir()
+        self._toklen_digest = toklen_cache.tokenizer_digest(
+            self.tokenizer, tokenizer_path or path)
+        if self._toklen_dir:
+            self._token_len_cache.update(
+                toklen_cache.load(self._toklen_dir, self._toklen_digest))
         self._gen_fn_cache: Dict[tuple, object] = {}
         # (kernel kind, static args, shape bucket) keys already dispatched:
         # an unseen key means jax.jit compiles on this call, so its
@@ -424,6 +436,133 @@ class JaxLM(BaseModel):
         self._dispatched_keys.add(key)
         return True
 
+    @functools.cached_property
+    def shape_signature(self) -> Optional[str]:
+        """Model identity for the compile-cache shape manifest: configs
+        producing the same signature compile the same executables for a
+        given (kind, B, S), so `cli plan --cache-dir` can join planned
+        shapes against shapes a previous run already compiled."""
+        if self.cfg is None:
+            return None
+        import dataclasses
+        ident = (dataclasses.asdict(self.cfg), self.quantize,
+                 self.max_seq_len)
+        return hashlib.blake2b(repr(ident).encode('utf-8'),
+                               digest_size=8).hexdigest()
+
+    def _note_compile(self, kind: str, shape, seconds: float):
+        """Record a first-dispatched shape bucket (and its observed
+        first-call seconds) into the persistent cache's sidecar shape
+        manifest.  Never raises; no-op without a cache dir."""
+        try:
+            from opencompass_tpu.utils import compile_cache
+            sig = self.shape_signature
+            if sig:
+                compile_cache.record_shape(sig, kind, shape, seconds)
+        except Exception:
+            pass
+
+    def _gen_params(self) -> tuple:
+        """(temperature, top_k, seed, num_beams, length_penalty) resolved
+        from ``generation_kwargs`` — the static half of the gen-fn cache
+        key, shared by :meth:`generate_async` and :meth:`warm_up` so a
+        warmed shape is exactly the shape the run dispatches."""
+        gk = dict(self.generation_kwargs)
+        if gk.get('do_sample', False):
+            temperature = float(gk.get('temperature', 1.0))  # HF default
+        else:
+            temperature = 0.0  # greedy
+        return (temperature, int(gk.get('top_k', 0)),
+                int(gk.get('seed', 0)), int(gk.get('num_beams', 1)),
+                float(gk.get('length_penalty', 1.0)))
+
+    def warm_up(self, specs: List[Dict]) -> int:
+        """Pre-compile the planned (B, S_bucket) set before the first
+        real batch: each spec is ``{kind: 'ppl'|'gen'|'choice', b, s[,
+        max_out_len]}`` (the planner's shape census).  Dispatches one
+        dummy batch per unseen bucket through the same jitted functions
+        and ``_first_dispatch`` keys the real calls use, so compile time
+        lands in one visible warm-up span (and in the persistent cache)
+        instead of stalling mid-run.  Shared-prefix variants are not
+        warmed (their shapes depend on batch content); those still
+        compile lazily.  Returns the number of buckets compiled."""
+        if self.tokenizer_only or self.params is None:
+            return 0
+        pad = self.tokenizer.pad_token_id or 0
+        temperature, top_k, seed, num_beams, length_penalty = \
+            self._gen_params()
+        warmed = 0
+        with use_mesh(self.mesh):
+            for spec in specs:
+                try:
+                    kind = spec['kind']
+                    max_new = int(spec.get('max_out_len') or 0)
+                    # gen batches pad under a decode-reserved cap
+                    # (max_seq_len - max_out_len, matching
+                    # generate_async); re-bucketing a census shape
+                    # without it would round a clamped S back up and
+                    # compile an executable the run never dispatches
+                    max_len = max(self.max_seq_len - max_new, 32) \
+                        if kind == 'gen' else None
+                    B, S = self.plan_shape(int(spec['b']),
+                                           int(spec['s']), max_len)
+                    cs0 = self.perf.compile_seconds
+                    spec_arrs = P('data', None)
+                    tokens = self._put(np.full((B, S), pad, np.int32),
+                                       spec_arrs)
+                    mask = self._put(np.ones((B, S), bool), spec_arrs)
+                    if kind == 'ppl':
+                        if not self._first_dispatch('ppl', False, (B, S)):
+                            continue
+                        with device_call(self.perf, first=True):
+                            out = self._ppl_fn(
+                                self.params, tokens, mask,
+                                self._put(np.zeros((B,), np.int32),
+                                          P('data')))
+                            jax.block_until_ready(out)
+                    elif kind == 'choice':
+                        if not self._first_dispatch('choice', (B, S)):
+                            continue
+                        with device_call(self.perf, first=True):
+                            out = self._choice_logits_fn(self.params,
+                                                         tokens, mask)
+                            jax.block_until_ready(out)
+                    elif kind == 'gen':
+                        if not max_new:
+                            # unknown decode length = unknown jit key; a
+                            # guessed warm-up would compile a shape the
+                            # run never dispatches (pure waste at 7B)
+                            continue
+                        if not self._first_dispatch(
+                                'gen', False, (B, S), max_new,
+                                temperature, top_k, num_beams,
+                                length_penalty):
+                            continue
+                        fn = self._gen_fn(max_new, temperature, top_k,
+                                          num_beams, length_penalty)
+                        rng = self._put(jax.random.PRNGKey(seed), P())
+                        with device_call(self.perf, first=True):
+                            out = fn(self.params, tokens, mask, rng)
+                            jax.block_until_ready(out)
+                    else:
+                        continue
+                    warmed += 1
+                    self._note_compile(kind, (B, S),
+                                       self.perf.compile_seconds - cs0)
+                except Exception as exc:
+                    logger.warning(
+                        f'warm-up of {spec} failed (will compile '
+                        f'lazily): {exc}')
+        return warmed
+
+    def save_caches(self):
+        """Persist the token-length cache for successor processes (the
+        task layer calls this when a model's datasets finish)."""
+        if self._toklen_dir and self._token_len_cache:
+            from opencompass_tpu.utils import toklen_cache
+            toklen_cache.save(self._toklen_dir, self._toklen_digest,
+                              self._token_len_cache)
+
     # -- BaseModel contract ------------------------------------------------
 
     @staticmethod
@@ -610,6 +749,7 @@ class JaxLM(BaseModel):
             mlb[:len(ml)] = ml
             first = self._first_dispatch(
                 'ppl', prefix is not None and len(prefix), tokens.shape)
+            cs0 = self.perf.compile_seconds
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
                              samples=len(inputs), first=first):
@@ -626,6 +766,11 @@ class JaxLM(BaseModel):
                                        self._put(tokens, spec),
                                        self._put(mask, spec),
                                        self._put(mlb, P('data')))
+            if first and prefix is None:
+                # shared-prefix executables are batch-content-dependent;
+                # only plain-path shapes enter the manifest
+                self._note_compile('ppl', tokens.shape,
+                                   self.perf.compile_seconds - cs0)
         n = len(inputs)
 
         def fetch():
@@ -689,10 +834,14 @@ class JaxLM(BaseModel):
                 inputs, left_pad=False, max_len=self.max_seq_len,
                 keep='tail')
             first = self._first_dispatch('choice', tokens.shape)
+            cs0 = self.perf.compile_seconds
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
                              samples=len(inputs), first=first):
                 logits = self._choice_logits_fn(self.params, tokens, mask)
+            if first:
+                self._note_compile('choice', tokens.shape,
+                                   self.perf.compile_seconds - cs0)
         n = len(inputs)
 
         def fetch():
@@ -717,15 +866,8 @@ class JaxLM(BaseModel):
                 'decode work is replicated across it — size the seq axis '
                 'for scoring workloads, or use a data/model-only mesh for '
                 'generation tasks')
-        gk = dict(self.generation_kwargs)
-        if gk.get('do_sample', False):
-            temperature = float(gk.get('temperature', 1.0))  # HF default
-        else:
-            temperature = 0.0  # greedy
-        top_k = int(gk.get('top_k', 0))
-        seed = int(gk.get('seed', 0))
-        num_beams = int(gk.get('num_beams', 1))
-        length_penalty = float(gk.get('length_penalty', 1.0))
+        temperature, top_k, seed, num_beams, length_penalty = \
+            self._gen_params()
         with use_mesh(self.mesh):
             max_prompt = max(self.max_seq_len - max_out_len, 32)
             ids = [self._encode_ids(str(s))[:max_prompt] for s in inputs]
@@ -737,6 +879,7 @@ class JaxLM(BaseModel):
                 'gen', prefix is not None and len(prefix), tokens.shape,
                 int(max_out_len), temperature, top_k, num_beams,
                 length_penalty)
+            cs0 = self.perf.compile_seconds
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
                              samples=len(inputs), first=first):
@@ -758,6 +901,9 @@ class JaxLM(BaseModel):
                     out, lengths = fn(self.params,
                                       self._put(tokens, spec),
                                       self._put(mask, spec), rng)
+            if first and prefix is None:
+                self._note_compile('gen', tokens.shape,
+                                   self.perf.compile_seconds - cs0)
         n_in = len(inputs)
 
         def fetch():

@@ -163,7 +163,8 @@ def _subtree_perf(root: _SpanNode) -> Dict[str, float]:
                         'wall_seconds', 'tokens_in', 'tokens_out',
                         'samples', 'device_calls', 'pad_tokens',
                         'overlap_seconds', 'planned_shapes',
-                        'first_calls'):
+                        'first_calls', 'compile_cache_hits',
+                        'compile_cache_misses'):
                 val = perf.get(key)
                 if isinstance(val, (int, float)):
                     out[key] += val
@@ -229,6 +230,11 @@ def build_report(work_dir: str, trace: Optional[str] = None) -> Dict:
             if tokens_in + pad > 0 else None,
             'planned_shapes': int(perf.get('planned_shapes', 0)),
             'dispatched_shapes': int(perf.get('first_calls', 0)),
+            # persistent-compile-cache split of compile_seconds: a hit
+            # deserialized a prior run's executable, a miss compiled cold
+            'compile_cache_hits': int(perf.get('compile_cache_hits', 0)),
+            'compile_cache_misses': int(
+                perf.get('compile_cache_misses', 0)),
             'overlap_seconds': round(
                 perf.get('overlap_seconds', 0.0), 3),
             'retries': int(n.attrs.get('retries', 0)),
@@ -383,6 +389,13 @@ def render_summary(report: Dict) -> str:
     wait_s = sum(t['wait_seconds'] for t in report['tasks'])
     lines.append(f'compile {compile_s:.1f}s, device {device_s:.1f}s, '
                  f'slot-wait {wait_s:.1f}s')
+    cc_hits = sum(t.get('compile_cache_hits', 0)
+                  for t in report['tasks'])
+    cc_miss = sum(t.get('compile_cache_misses', 0)
+                  for t in report['tasks'])
+    if cc_hits or cc_miss:
+        lines.append(f'compile cache: {cc_hits} hit(s), {cc_miss} '
+                     'cold compile(s)')
     util = report['slot_utilization']
     if util['overall'] is not None:
         lines.append(f"slot utilization {util['overall']:.0%} over "
@@ -415,19 +428,24 @@ def render_report(report: Dict) -> str:
     out.append('\n-- per-task breakdown --')
     if report['tasks']:
         rows = [['task', 'wall_s', 'wait_s', 'compile_s', 'device_s',
-                 'steady_s', 'pad_eff', 'shapes', 'overlap_s', 'retries',
-                 'devices', 'status']]
+                 'steady_s', 'pad_eff', 'shapes', 'cc_hit/miss',
+                 'overlap_s', 'retries', 'devices', 'status']]
         for t in report['tasks']:
             shapes = '-'
             if t.get('planned_shapes') or t.get('dispatched_shapes'):
                 shapes = (f"{t.get('planned_shapes', 0)}/"
                           f"{t.get('dispatched_shapes', 0)}")
+            cc = '-'
+            if t.get('compile_cache_hits') or t.get(
+                    'compile_cache_misses'):
+                cc = (f"{t.get('compile_cache_hits', 0)}/"
+                      f"{t.get('compile_cache_misses', 0)}")
             rows.append([t['name'][:60], t['wall_seconds'],
                          t['wait_seconds'], t['compile_seconds'],
                          t['device_seconds'], t['steady_device_seconds'],
                          t.get('pad_eff') if t.get('pad_eff') is not None
                          else '-',
-                         shapes, t.get('overlap_seconds', 0.0),
+                         shapes, cc, t.get('overlap_seconds', 0.0),
                          t['retries'],
                          ','.join(map(str, t['devices'])) or '-',
                          t['status']])

@@ -35,6 +35,17 @@ class BasePartitioner:
             if key in cfg:
                 for task in tasks:
                     task[key] = cfg[key]
+        # model-affinity key: tasks whose models build identically carry
+        # the same digest, so the worker-pool runner routes them — split
+        # dataset shards included — to one model-resident process
+        # instead of paying a fresh checkpoint load + compile per task
+        from opencompass_tpu.utils.build import model_cfg_key
+        for task in tasks:
+            try:
+                task['model_key'] = '+'.join(
+                    model_cfg_key(m) for m in task['models'])
+            except Exception:
+                pass  # un-digestable cfg: the runner derives it lazily
         from opencompass_tpu.obs import get_tracer
         tracer = get_tracer()
         if tracer.enabled:

@@ -81,6 +81,20 @@ class SizePartitioner(BasePartitioner):
                 else:
                     bins.append([dataset])
                     bin_sizes.append(cost)
+            # launch order: biggest bins first (the FFD straggler
+            # guard — a large task emitted last would run alone after
+            # the small ones drain), with the lead-dataset abbr as the
+            # tie-break so equal-cost split shards (`abbr_0..abbr_k`)
+            # stay consecutive — on a model-resident worker consecutive
+            # shards of one dataset reuse the exact same (B, S) jit
+            # shapes, so the warm path pays zero compiles after the
+            # first shard (the bins themselves are unchanged; only
+            # their launch order is)
+            order = sorted(range(len(bins)),
+                           key=lambda j: (-bin_sizes[j],
+                                          dataset_abbr_from_cfg(
+                                              bins[j][0])))
+            bins = [bins[j] for j in order]
             for bin_datasets in bins:
                 tasks.append({
                     'models': [model],

@@ -45,14 +45,23 @@ class LocalRunner(BaseRunner):
                  keep_tmp_file: bool = False,
                  task_timeout: float = None,
                  stall_timeout: float = None,
-                 retry: int = 0):
+                 retry: int = 0,
+                 use_workers: bool = None):
         """``task_timeout``: kill a task after this many wall-clock seconds.
         ``stall_timeout``: kill a task whose log stops growing for this
         long (hung-process detection — a compile or a wedged device holds a
         chip slot forever otherwise; first-compile on TPU takes minutes, so
         values under ~600 s are risky).  ``retry``: relaunch attempts after
         a failure/kill (the reference's LocalRunner has none —
-        reference runners/local.py:139-141 only warns)."""
+        reference runners/local.py:139-141 only warns).
+
+        ``use_workers``: route same-model tasks to a model-resident
+        worker process (runners/worker.py) so the checkpoint loads and
+        planned shapes compile once per model instead of once per task.
+        ``None`` (default) = auto: worker mode for device-model tasks
+        (``num_devices > 0``), one-shot subprocesses otherwise.  API
+        models and multi-host tasks always take the one-shot path, and
+        any worker failure falls back to it per task."""
         super().__init__(task=task, debug=debug, lark_bot_url=lark_bot_url)
         self.max_num_workers = max_num_workers
         if num_devices is None:
@@ -63,6 +72,7 @@ class LocalRunner(BaseRunner):
         self.task_timeout = task_timeout
         self.stall_timeout = stall_timeout
         self.retry = retry
+        self.use_workers = use_workers
         self._slot_lock = threading.Lock()
         self._slots = [False] * self.num_devices  # True = in use
         # watchdog wake period; tests shrink it to exercise kill paths
@@ -88,8 +98,75 @@ class LocalRunner(BaseRunner):
                 status.append((task.name, 0))
             return status
 
+        groups, singles = self._plan_worker_groups(tasks)
+        results: List = [None] * len(tasks)
         with ThreadPoolExecutor(max_workers=self.max_num_workers) as pool:
-            return list(pool.map(self._launch, tasks))
+            futures = [
+                pool.submit(self._launch_worker_group, key,
+                            [(i, tasks[i]) for i in idxs], results)
+                for key, idxs in groups
+            ]
+            futures += [pool.submit(self._launch_at, i, tasks[i], results)
+                        for i in singles]
+            for fut in futures:
+                fut.result()
+        return results
+
+    def _launch_at(self, i: int, task_cfg: Dict, results: List):
+        results[i] = self._launch(task_cfg)
+
+    def _plan_worker_groups(self, tasks: List[Dict]):
+        """Split the task list into model-affinity worker groups and
+        one-shot singles.  Auto mode (``use_workers=None``) restricts
+        worker routing to device-model tasks — CPU/eval tasks are cheap
+        to launch and gain nothing from residency."""
+        singles = list(range(len(tasks)))
+        if self.use_workers is False:
+            return [], singles
+        from opencompass_tpu.runners import worker as workermod
+        by_key: Dict[str, List[int]] = {}
+        for i, task_cfg in enumerate(tasks):
+            if not workermod.task_worker_eligible(task_cfg):
+                continue
+            if self.use_workers is None:
+                run_cfgs = [m.get('run_cfg', {})
+                            for m in task_cfg.get('models', [])]
+                if not any(rc.get('num_devices', rc.get('num_gpus', 0))
+                           for rc in run_cfgs):
+                    continue
+            key = workermod.model_affinity_key(task_cfg)
+            by_key.setdefault(key, []).append(i)
+        grouped = {i for idxs in by_key.values() for i in idxs}
+        singles = [i for i in singles if i not in grouped]
+        # a multi-chip host must not lose its task parallelism to
+        # residency: shard each device-model group into as many workers
+        # as fit the chips (each worker still builds its model once).
+        # Chipless groups (explicit use_workers with CPU models) stay
+        # one worker — residency is the whole point there.
+        sharded = []
+        for key, idxs in sorted(by_key.items()):
+            devices = self._group_devices(tasks[idxs[0]])
+            n_workers = 1 if devices == 0 else max(
+                1, min(len(idxs), self.num_devices // max(devices, 1)))
+            if n_workers <= 1:
+                sharded.append((key, idxs))
+            else:
+                # contiguous chunks, not striding: the size partitioner
+                # deliberately emits same-dataset shards consecutively
+                # so one worker's shards share jit shapes — a stride
+                # would hand every worker a slice of every dataset
+                per = -(-len(idxs) // n_workers)  # ceil
+                sharded.extend(
+                    (f'{key}-{s}', idxs[s * per:(s + 1) * per])
+                    for s in range(n_workers) if idxs[s * per:(s + 1) * per])
+        return sharded, singles
+
+    @staticmethod
+    def _group_devices(task_cfg: Dict) -> int:
+        run_cfgs = [m.get('run_cfg', {})
+                    for m in task_cfg.get('models', [])]
+        return max((rc.get('num_devices', rc.get('num_gpus', 0))
+                    for rc in run_cfgs), default=0)
 
     # -- slot allocator ----------------------------------------------------
 
@@ -160,28 +237,202 @@ class LocalRunner(BaseRunner):
             span.set_attrs(returncode=returncode)
         return name, returncode
 
-    def _run_task(self, task, name: str, cfg_path: str,
-                  chip_ids: List[int], span=None) -> int:
-        cmd = task.get_command(cfg_path=cfg_path, template='{task_cmd}')
+    # -- model-resident worker path ----------------------------------------
+
+    def _launch_worker_group(self, key: str, indexed_tasks, results: List):
+        """Run one model-affinity group through a resident worker: the
+        group holds its chip slots for its whole lifetime (every task
+        needs the same model on the same chips), the worker builds the
+        model once, and each task is a protocol round-trip.  Any worker
+        failure downgrades the affected task — and, after a crash, the
+        rest of the group — to the one-shot subprocess path."""
+        from opencompass_tpu.runners.worker import WorkerHandle
+        tracer = get_tracer()
+        built = [(i, self.build_task(cfg)) for i, cfg in indexed_tasks]
+        group_devices = max(t.num_devices for _, t in built)
+        wait0 = time.perf_counter()
+        chip_ids = self._acquire_slots(group_devices)
+        slot_wait = time.perf_counter() - wait0
+        if tracer.enabled and group_devices:
+            tracer.histogram('runner.slot_wait_seconds').observe(slot_wait)
+        work_dir = built[0][1].work_dir
+        env = self._task_env(group_devices, chip_ids, work_dir)
+        if tracer.enabled:
+            env.update(tracer.propagation_env(
+                getattr(self, '_runner_span', None)))
+        log_path = osp.join(work_dir, 'logs', 'worker', f'{key}.out')
+        handle = None
+        try:
+            try:
+                handle = WorkerHandle(env, log_path)
+                self.logger.info(
+                    f'worker {key}: resident for {len(built)} task(s) '
+                    f'(devices={chip_ids}), log at {log_path}')
+                tracer.event('worker_started', model_key=key,
+                             n_tasks=len(built))
+            except Exception:
+                self.logger.exception(f'worker {key} failed to start; '
+                                      'using one-shot subprocesses')
+            for i, task in built:
+                if handle is not None and handle.dead:
+                    handle = None  # crashed mid-group: no respawn
+                results[i] = self._launch_via_worker(handle, key, task,
+                                                     chip_ids, slot_wait)
+        finally:
+            if handle is not None:
+                handle.shutdown()
+            self._release_slots(chip_ids)
+
+    def _launch_via_worker(self, handle, key: str, task, chip_ids,
+                           slot_wait: float) -> Tuple[str, int]:
+        """One task over the worker channel, with the same span/agg/tmp
+        bookkeeping as :meth:`_launch` and one-shot fallback."""
+        tracer = get_tracer()
+        agg = getattr(self, '_status_agg', None)
+        name = task.name
+        if agg is not None:
+            agg.task_started(name)
+        returncode = 1
+        with tracer.span(f'task:{name}',
+                         parent=getattr(self, '_runner_span', None),
+                         devices=chip_ids,
+                         num_devices_host=self.num_devices,
+                         worker=key,
+                         slot_wait_seconds=round(slot_wait, 3)) as span:
+            try:
+                tmp = tempfile.NamedTemporaryFile(
+                    mode='w', suffix='_params.py', delete=False)
+                try:
+                    task.cfg.dump(tmp.name)
+                    returncode = self._run_task_via_worker(
+                        handle, task, name, tmp.name, chip_ids, span)
+                finally:
+                    if self.keep_tmp_file:
+                        self.logger.info(f'task cfg kept at {tmp.name}')
+                    else:
+                        os.unlink(tmp.name)
+            except Exception:
+                self.logger.exception(f'task {name} failed to launch')
+            finally:
+                if agg is not None:
+                    agg.task_finished(name, returncode)
+            span.set_attrs(returncode=returncode)
+        return name, returncode
+
+    def _run_task_via_worker(self, handle, task, name: str, cfg_path: str,
+                             chip_ids: List[int], span=None) -> int:
+        from opencompass_tpu.runners.worker import WorkerError
+        tracer = get_tracer()
+        if handle is not None and not handle.dead:
+            t = self.task_cfg.get('type')
+            task_type = t if isinstance(t, str) \
+                else getattr(t, '__name__', str(t))
+            log_path = task.get_log_path('out')
+            os.makedirs(osp.dirname(log_path), exist_ok=True)
+            self.logger.info(f'worker run {name} (devices={chip_ids})')
+            # same liveness signals as the one-shot watchdog: heartbeat
+            # file freshness (preferred — survives silent compiles) with
+            # task-log growth as the untraced fallback
+            hb_path = None
+            tracer_live = get_tracer()
+            if tracer_live.enabled:
+                from opencompass_tpu.obs.live import heartbeat_path
+                hb_path = heartbeat_path(tracer_live.obs_dir, name)
+
+            def liveness():
+                newest = None
+                for p in (hb_path, log_path):
+                    if not p:
+                        continue
+                    try:
+                        ts = os.stat(p).st_mtime
+                        newest = ts if newest is None else max(newest, ts)
+                    except OSError:
+                        pass
+                return newest
+            try:
+                resp = handle.request_watched(
+                    {'cmd': 'run', 'task_type': task_type,
+                     'cfg_path': cfg_path, 'name': name,
+                     'log_path': log_path,
+                     # per-task re-rooting: the worker's proc: span must
+                     # nest under THIS task's runner-side span (the
+                     # spawn-time propagation parent is the runner span,
+                     # which outlives any one task) so the trace
+                     # report's subtree perf aggregation still works
+                     'parent_span': getattr(span, 'span_id', None)},
+                    timeout=self.task_timeout,
+                    stall_timeout=self.stall_timeout,
+                    liveness=liveness,
+                    poll=self._watchdog_poll_s)
+                returncode = int(resp.get('returncode', 1))
+                if span is not None and resp.get('warmed'):
+                    span.set_attrs(warmed_shapes=resp['warmed'])
+                missing = [p for p in task.get_output_paths()
+                           if not osp.exists(p)]
+                if returncode == 0 and missing:
+                    self.logger.warning(f'{name}: worker exit 0 but '
+                                        f'outputs missing: {missing[:3]}')
+                    tracer.event('task_outputs_missing', task=name,
+                                 missing=missing[:3])
+                    returncode = 1
+                if returncode == 0:
+                    return 0
+                self.logger.warning(
+                    f'{name}: worker run failed (code {returncode}, '
+                    f'{resp.get("error", "no error detail")}); falling '
+                    f'back to one-shot subprocess; see {log_path}')
+                # the fallback subprocess needs the chips to itself — a
+                # TPU chip is exclusive to one process, and the resident
+                # worker still holds device memory/locks even after a
+                # soft task failure
+                handle.kill()
+            except WorkerError as exc:
+                # worker died or timed out: kill it so the fallback (and
+                # the rest of the group) can't race it for the chips
+                handle.kill()
+                self.logger.warning(f'{name}: worker failed ({exc}); '
+                                    'falling back to one-shot subprocess')
+            tracer.event('worker_fallback', task=name, worker_dead=bool(
+                handle.dead and handle.proc.poll() not in (None, 0)))
+            tracer.counter('runner.worker_fallbacks').inc()
+        # the one-shot path brings its own retry loop — a worker-crashed
+        # task retries cleanly in a fresh interpreter
+        return self._run_task(task, name, cfg_path, chip_ids, span)
+
+    def _task_env(self, num_devices: int, chip_ids: List[int],
+                  work_dir: str = None) -> Dict:
+        """Subprocess env for a task or worker: package importable from
+        any cwd, chips pinned (or CPU forced for chipless tasks), and
+        the persistent XLA compilation cache shared across task
+        processes and runs — each task is a fresh interpreter, and
+        recompiling the suite's shape buckets per task is pure waste
+        (occasional shapes hit pathologically slow compiles — measured
+        3-14 min through the remote-compile tunnel).  The driver
+        normally exports the cache dir; fall back to the task's
+        work_dir for direct runner use."""
         env = dict(os.environ)
-        # make the package importable from any cwd
         import opencompass_tpu
         pkg_root = osp.dirname(osp.dirname(opencompass_tpu.__file__))
         env['PYTHONPATH'] = pkg_root + (
             ':' + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
-        if task.num_devices > 0:
+        if num_devices > 0:
             env['TPU_VISIBLE_CHIPS'] = ','.join(map(str, chip_ids))
-            # persistent XLA compilation cache shared across task
-            # processes and runs: each task is a fresh interpreter, and
-            # recompiling the suite's shape buckets per task is pure
-            # waste (occasional shapes hit pathologically slow compiles
-            # — measured 3-14 min through the remote-compile tunnel)
-            env.setdefault('JAX_COMPILATION_CACHE_DIR',
-                           osp.abspath('.cache/jax_compilation'))
         else:
             # CPU-only task: never contend for the exclusive chip
             env['JAX_PLATFORMS'] = 'cpu'
             env.pop('PALLAS_AXON_POOL_IPS', None)
+        from opencompass_tpu.utils import compile_cache
+        cache_dir = compile_cache.xla_cache_dir(work_dir)
+        if cache_dir:
+            env.setdefault('JAX_COMPILATION_CACHE_DIR',
+                           osp.abspath(cache_dir))
+        return env
+
+    def _run_task(self, task, name: str, cfg_path: str,
+                  chip_ids: List[int], span=None) -> int:
+        cmd = task.get_command(cfg_path=cfg_path, template='{task_cmd}')
+        env = self._task_env(task.num_devices, chip_ids, task.work_dir)
         tracer = get_tracer()
         if tracer.enabled:
             # the subprocess task resumes this trace (OCT_* env vars) so

@@ -1,0 +1,417 @@
+"""Model-resident worker: run many same-model tasks in one process.
+
+The size partitioner splits a model's datasets across many tasks; the
+one-shot launch path pays a fresh interpreter + checkpoint load + XLA
+compile set per task.  A *worker* is a subprocess that stays alive for a
+whole model-affinity group: the first task builds the model (weights on
+device, ``_gen_fn_cache`` hot), warm-up pre-compiles the planned
+(B, S_bucket) set, and every later task for the same model config reuses
+all of it — the amortization behind production TPU serving stacks
+(arXiv:2211.05102).
+
+Wire protocol — **length-prefixed JSON over the worker's stdin/stdout
+pipes** (stdlib only): each frame is a 4-byte big-endian length followed
+by one UTF-8 JSON object.  The worker re-points fd 0/1 away immediately
+at startup (protocol fds are ``dup``'ed first), so stray prints from
+task code land in the worker log, never in the protocol channel.
+
+Requests::
+
+    {"cmd": "run", "task_type": "OpenICLInferTask",
+     "cfg_path": "/tmp/...py", "name": "<task name>",
+     "log_path": "<per-task log>"}
+    {"cmd": "ping"}
+    {"cmd": "shutdown"}
+
+Responses::
+
+    {"ok": true, "returncode": 0, "warmed": <shapes precompiled>}
+    {"ok": false, "error": "<traceback tail>", "returncode": 1}
+
+Failure containment: a worker crash (or request timeout) surfaces as an
+EOF/timeout on the runner side; ``LocalRunner`` then falls back to the
+one-shot subprocess path for the affected task — worker mode can only
+ever *add* reuse, never lose a task.
+
+Fault injection (tests): ``OCT_WORKER_FAULT=crash:<substr>`` makes the
+worker ``os._exit(13)`` before executing a task whose name contains the
+substring, exercising the fallback path deterministically.
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+import select
+import struct
+import subprocess
+import sys
+import time
+import traceback
+from typing import Dict, List, Optional
+
+ENV_WORKER_FAULT = 'OCT_WORKER_FAULT'
+_HEADER = struct.Struct('>I')
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class WorkerError(RuntimeError):
+    """The worker died, timed out, or spoke garbage — caller should fall
+    back to the one-shot subprocess path."""
+
+
+# -- framing ---------------------------------------------------------------
+
+def write_frame(fh, obj: Dict):
+    data = json.dumps(obj, default=str).encode('utf-8')
+    fh.write(_HEADER.pack(len(data)) + data)
+    fh.flush()
+
+
+def _read_exact(fd: int, n: int, deadline: Optional[float]) -> bytes:
+    buf = b''
+    while len(buf) < n:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise WorkerError('worker response timed out')
+            ready, _, _ = select.select([fd], [], [], remaining)
+            if not ready:
+                raise WorkerError('worker response timed out')
+        chunk = os.read(fd, n - len(buf))
+        if not chunk:
+            raise WorkerError('worker pipe closed (process died?)')
+        buf += chunk
+    return buf
+
+
+def read_frame(fd: int, timeout: Optional[float] = None) -> Dict:
+    deadline = time.monotonic() + timeout if timeout else None
+    (length,) = _HEADER.unpack(_read_exact(fd, _HEADER.size, deadline))
+    if length > MAX_FRAME:
+        raise WorkerError(f'oversized worker frame ({length} bytes)')
+    try:
+        return json.loads(_read_exact(fd, length, deadline))
+    except json.JSONDecodeError as exc:
+        raise WorkerError(f'bad worker frame: {exc}') from exc
+
+
+# -- runner-side handle ----------------------------------------------------
+
+class WorkerHandle:
+    """One resident worker subprocess + its protocol channel."""
+
+    def __init__(self, env: Dict[str, str], log_path: str):
+        os.makedirs(osp.dirname(osp.abspath(log_path)), exist_ok=True)
+        self._log_fh = open(log_path, 'a')
+        # own session: a kill tears down the worker's whole tree without
+        # reaching the runner (same rationale as the watchdog launch)
+        self.proc = subprocess.Popen(
+            [sys.executable, '-m', 'opencompass_tpu.runners.worker'],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=self._log_fh, env=env, start_new_session=True)
+        self.dead = False
+
+    def request(self, msg: Dict, timeout: Optional[float] = None) -> Dict:
+        if self.dead:
+            raise WorkerError('worker already dead')
+        try:
+            write_frame(self.proc.stdin, msg)
+            return read_frame(self.proc.stdout.fileno(), timeout=timeout)
+        except (WorkerError, OSError, ValueError) as exc:
+            self.kill()
+            if isinstance(exc, WorkerError):
+                raise
+            raise WorkerError(f'worker channel broke: {exc}') from exc
+
+    def request_watched(self, msg: Dict,
+                        timeout: Optional[float] = None,
+                        stall_timeout: Optional[float] = None,
+                        liveness=None,
+                        poll: float = 5.0) -> Dict:
+        """``request`` plus the one-shot path's hung-task semantics:
+        ``timeout`` bounds the whole round-trip, ``stall_timeout`` kills
+        a worker whose task shows no life — ``liveness()`` returns the
+        latest wall-clock activity timestamp (heartbeat/log mtime) or
+        None.  Waiting consumes no response bytes, so the channel stays
+        framed right up until a kill."""
+        if self.dead:
+            raise WorkerError('worker already dead')
+        try:
+            write_frame(self.proc.stdin, msg)
+        except OSError as exc:
+            self.kill()
+            raise WorkerError(f'worker channel broke: {exc}') from exc
+        fd = self.proc.stdout.fileno()
+        deadline = time.monotonic() + timeout if timeout else None
+        last_alive = time.time()
+        while True:
+            slice_s = poll
+            if deadline is not None:
+                slice_s = min(slice_s, max(deadline - time.monotonic(),
+                                           0.01))
+            ready, _, _ = select.select([fd], [], [], slice_s)
+            if ready:
+                remaining = None
+                if deadline is not None:
+                    remaining = max(deadline - time.monotonic(), 0.01)
+                try:
+                    return read_frame(fd, timeout=remaining)
+                except (WorkerError, OSError, ValueError) as exc:
+                    self.kill()
+                    if isinstance(exc, WorkerError):
+                        raise
+                    raise WorkerError(
+                        f'worker channel broke: {exc}') from exc
+            if deadline is not None and time.monotonic() >= deadline:
+                self.kill()
+                raise WorkerError(
+                    f'worker response timed out after {timeout:.0f}s')
+            if self.proc.poll() is not None:
+                self.kill()
+                raise WorkerError('worker pipe closed (process died?)')
+            if stall_timeout:
+                ts = liveness() if liveness is not None else None
+                if ts:
+                    last_alive = max(last_alive, ts)
+                if time.time() - last_alive > stall_timeout:
+                    self.kill()
+                    raise WorkerError(
+                        f'no heartbeat or log growth for '
+                        f'{stall_timeout:.0f}s (task wedged?)')
+
+    def shutdown(self, timeout: float = 10.0):
+        """Polite stop; falls back to kill."""
+        if not self.dead:
+            try:
+                self.request({'cmd': 'shutdown'}, timeout=timeout)
+                self.proc.wait(timeout=timeout)
+            except (WorkerError, subprocess.TimeoutExpired):
+                pass
+        self.kill()
+
+    def kill(self):
+        self.dead = True
+        if self.proc.poll() is None:
+            import signal
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                self.proc.kill()
+            self.proc.wait()
+        for fh in (self.proc.stdin, self.proc.stdout, self._log_fh):
+            try:
+                fh.close()
+            except Exception:
+                pass
+
+
+# -- eligibility / grouping (used by LocalRunner) --------------------------
+
+def model_affinity_key(task_cfg: Dict) -> Optional[str]:
+    """The task's model-affinity digest (partitioner-stamped, else
+    derived).  None when underivable — such tasks stay on the one-shot
+    path."""
+    key = task_cfg.get('model_key')
+    if key:
+        return str(key)
+    try:
+        from opencompass_tpu.utils.build import model_cfg_key
+        return '+'.join(model_cfg_key(m) for m in task_cfg['models'])
+    except Exception:
+        return None
+
+
+def task_worker_eligible(task_cfg: Dict) -> bool:
+    """Worker mode is for local, single-process, non-API-model tasks."""
+    from opencompass_tpu.registry import MODELS
+    try:
+        for model_cfg in task_cfg['models']:
+            t = model_cfg.get('type')
+            if isinstance(t, str):
+                # dumped cfgs carry the dotted path; the registry knows
+                # the bare class name
+                cls = MODELS.get(t) or MODELS.get(t.rsplit('.', 1)[-1])
+            else:
+                cls = t
+            if cls is None or getattr(cls, 'is_api', False):
+                return False
+            run_cfg = model_cfg.get('run_cfg', {})
+            if run_cfg.get('num_procs', 1) > 1:
+                return False  # multi-host launcher owns those processes
+    except Exception:
+        return False
+    return model_affinity_key(task_cfg) is not None
+
+
+# -- worker-side server ----------------------------------------------------
+
+def _redirect_fds(log_fd: int):
+    """Point fd 1/2 at ``log_fd`` (task output), keeping python's
+    ``sys.stdout``/``sys.stderr`` in sync."""
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.dup2(log_fd, 1)
+    os.dup2(log_fd, 2)
+
+
+# model keys already census-warmed by this worker: the census re-builds
+# the dataset + prompts (host work task.run repeats right after), so pay
+# it once per resident model — later shards of the same dataset reuse
+# the same (B, S) buckets anyway, and truly new shapes compile lazily
+# into the persistent cache
+_WARMED_MODELS = set()
+
+
+def _warm_up(task, tracer) -> int:
+    """Pre-compile the planned (B, S_bucket) set for the task's models:
+    the PR 3 planner's shape census (plan_preview machinery) feeds the
+    model's ``warm_up`` hook, so compiles happen in one visible
+    ``warmup:`` span instead of stalls scattered through the run.  Best
+    effort — any failure leaves the task to compile lazily."""
+    from opencompass_tpu.utils.build import (build_model_from_cfg,
+                                             model_cfg_key)
+    from opencompass_tpu.utils.plan_preview import shape_census
+    if not getattr(task, 'dataset_cfgs', None):
+        return 0
+    warmed = 0
+    for i, model_cfg in enumerate(getattr(task, 'model_cfgs', [])):
+        try:
+            key = model_cfg_key(model_cfg)
+            if key in _WARMED_MODELS:
+                continue
+            model = build_model_from_cfg(model_cfg)  # memoized build
+            if not hasattr(model, 'warm_up'):
+                _WARMED_MODELS.add(key)
+                continue
+            _WARMED_MODELS.add(key)
+            specs: List[Dict] = []
+            for dataset_cfg in task.dataset_cfgs[i]:
+                specs.extend(shape_census(model, model_cfg, dataset_cfg))
+            if not specs:
+                continue
+            from opencompass_tpu.utils.abbr import model_abbr_from_cfg
+            with tracer.span(f'warmup:{model_abbr_from_cfg(model_cfg)}',
+                             planned=len(specs)) as span:
+                n = model.warm_up(specs)
+                span.set_attrs(compiled=n)
+                warmed += n
+        except Exception:
+            traceback.print_exc()
+    return warmed
+
+
+def _handle_run(msg: Dict) -> Dict:
+    from opencompass_tpu import obs
+    from opencompass_tpu.config import Config
+    from opencompass_tpu.registry import TASKS
+    from opencompass_tpu.utils import compile_cache
+
+    cls = TASKS.get(msg['task_type'])
+    if cls is None:
+        return {'ok': False, 'returncode': 1,
+                'error': f"unknown task type {msg['task_type']!r}"}
+    cfg = Config.fromfile(msg['cfg_path'])
+    compile_cache.export_env(cfg.get('work_dir'))
+    compile_cache.enable(cfg.get('work_dir'))
+    tracer = obs.init_task_obs(cfg)
+    task = cls(cfg)
+    name = msg.get('name') or task.name
+
+    fault = os.environ.get(ENV_WORKER_FAULT, '')
+    if fault.startswith('crash:') and fault[len('crash:'):] in name:
+        os._exit(13)
+
+    heartbeat = obs.init_task_heartbeat(name)
+    warmed = 0
+    returncode, error = 0, None
+    log_path = msg.get('log_path') or task.get_log_path('out')
+    os.makedirs(osp.dirname(osp.abspath(log_path)), exist_ok=True)
+    log_fd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                     0o644)
+    saved = (os.dup(1), os.dup(2))
+    span_kwargs = {}
+    if msg.get('parent_span'):
+        # nest under the runner-side task: span (report aggregation
+        # walks that subtree); without one, the worker's default parent
+        # (the runner span) applies
+        span_kwargs['parent'] = msg['parent_span']
+    try:
+        _redirect_fds(log_fd)
+        with tracer.span(f'proc:{msg["task_type"]}', task=name,
+                         pid=os.getpid(), worker=True, **span_kwargs):
+            warmed = _warm_up(task, tracer)
+            try:
+                task.run()
+                heartbeat.mark('done')
+            except BaseException as exc:
+                heartbeat.mark('failed')
+                traceback.print_exc()
+                returncode, error = 1, f'{type(exc).__name__}: {exc}'
+                if isinstance(exc, (KeyboardInterrupt, SystemExit)):
+                    raise
+    finally:
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.dup2(saved[0], 1)
+        os.dup2(saved[1], 2)
+        for fd in (*saved, log_fd):
+            os.close(fd)
+    resp = {'ok': returncode == 0, 'returncode': returncode,
+            'warmed': warmed}
+    if error:
+        resp['error'] = error
+    return resp
+
+
+def serve():
+    """Worker main loop: read request frames from the saved stdin,
+    answer on the saved stdout.  Anything the tasks print goes to the
+    worker log (runner-redirected stderr)."""
+    proto_in = os.dup(0)
+    proto_out = os.fdopen(os.dup(1), 'wb')
+    # protocol channel secured — re-point 0/1 so task code can't touch it
+    devnull = os.open(os.devnull, os.O_RDONLY)
+    os.dup2(devnull, 0)
+    os.close(devnull)
+    os.dup2(2, 1)
+
+    from opencompass_tpu.utils import compile_cache
+    from opencompass_tpu.utils.build import enable_model_cache
+    enable_model_cache()
+    compile_cache.enable()
+
+    while True:
+        try:
+            msg = read_frame(proto_in)
+        except WorkerError:
+            break  # runner hung up
+        cmd = msg.get('cmd')
+        if cmd == 'shutdown':
+            write_frame(proto_out, {'ok': True, 'bye': True})
+            break
+        if cmd == 'ping':
+            write_frame(proto_out, {'ok': True, 'pong': True})
+            continue
+        if cmd != 'run':
+            write_frame(proto_out, {'ok': False,
+                                    'error': f'unknown cmd {cmd!r}'})
+            continue
+        try:
+            resp = _handle_run(msg)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException:
+            resp = {'ok': False, 'returncode': 1,
+                    'error': traceback.format_exc(limit=20)[-2000:]}
+        write_frame(proto_out, resp)
+
+    from opencompass_tpu.obs import get_tracer
+    try:
+        get_tracer().close()
+    except Exception:
+        pass
+
+
+if __name__ == '__main__':
+    serve()

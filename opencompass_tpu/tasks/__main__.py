@@ -27,6 +27,14 @@ def main():
     if cls is None:
         raise SystemExit(f'unknown task type {args.task_type!r}')
     cfg = Config.fromfile(args.config)
+    # persistent XLA compilation cache: resolve from the driver-exported
+    # env (OCT_CACHE_ROOT / JAX_COMPILATION_CACHE_DIR) or, for a task
+    # launched standalone, this task's own work_dir — a resumed/retried
+    # task then deserializes the previous attempt's executables instead
+    # of recompiling (utils/compile_cache.py)
+    from opencompass_tpu.utils import compile_cache
+    compile_cache.export_env(cfg.get('work_dir'))
+    compile_cache.enable(cfg.get('work_dir'))
     # resume the run's trace across the process boundary (OCT_* env vars
     # injected by the runner; no-op when the run is not traced)
     tracer = obs.init_task_obs(cfg)

@@ -64,63 +64,80 @@ class OpenICLInferTask(BaseTask):
             # perf counters
             heartbeat.bind_perf(getattr(model, 'perf', None))
 
-            for dataset_cfg in self.dataset_cfgs[i]:
-                self.model_cfg = model_cfg
-                self.dataset_cfg = dataset_cfg
-                self.infer_cfg = dataset_cfg['infer_cfg']
-                m_abbr = model_abbr_from_cfg(model_cfg)
-                d_abbr = dataset_abbr_from_cfg(dataset_cfg)
-                out_path = get_infer_output_path(
-                    model_cfg, dataset_cfg,
-                    osp.join(self.work_dir, 'predictions'))
-                # rank 0 owns the filesystem view; broadcast so a
-                # multi-host group takes the same skip decision
-                if broadcast_object(osp.exists(out_path)
-                                    if is_main_process() else None):
-                    tracer.event('infer_skip', model=m_abbr,
-                                 dataset=d_abbr)
-                    units_done += 1
-                    heartbeat.set_unit(units_done, units_total)
-                    continue
-                heartbeat.set_unit(units_done, units_total,
-                                   f'{m_abbr}/{d_abbr}')
-                perf_path = trace_dir = None
-                if is_main_process():
-                    perf_path = get_infer_output_path(
-                        model_cfg, dataset_cfg,
-                        osp.join(self.work_dir, 'perf'))
-                    if self.cfg.get('profile'):
-                        trace_dir = osp.join(
-                            self.work_dir, 'profile', m_abbr, d_abbr)
-                with tracer.span(f'infer:{m_abbr}/{d_abbr}') as span:
-                    prof = TaskProfiler(model, perf_path, trace_dir)
-                    try:
-                        with prof:
-                            self._inference(model, out_path)
-                    finally:
-                        # attach even when _inference raised: the failed
-                        # task's compile/device time must reach the trace
-                        # report (TaskProfiler.__exit__ always builds the
-                        # record, with 'error' on failure)
-                        if prof.record:
-                            # the span-local counter backend: the trace
-                            # report reads compile/device attribution here
-                            span.set_attrs(perf=prof.record)
-                        if tracer.enabled:
-                            mem = device_memory_attrs()
-                            if mem:
-                                span.set_attrs(device_memory=mem)
-                                if 'peak_bytes_in_use' in mem:
-                                    tracer.gauge(
-                                        'device.peak_bytes_in_use').set(
-                                            mem['peak_bytes_in_use'])
+            try:
+                self._infer_model_datasets(
+                    model, model_cfg, i, tracer, heartbeat,
+                    units_done, units_total)
+            finally:
+                # persist the token-length cache even on failure: the
+                # retry/resume attempt skips re-tokenizing what this
+                # attempt already measured
+                try:
+                    model.save_caches()
+                except Exception:
+                    logger.warning('model cache persistence failed',
+                                   exc_info=True)
+            units_done += len(self.dataset_cfgs[i])
+
+    def _infer_model_datasets(self, model, model_cfg, i, tracer,
+                              heartbeat, units_done, units_total):
+        for dataset_cfg in self.dataset_cfgs[i]:
+            self.model_cfg = model_cfg
+            self.dataset_cfg = dataset_cfg
+            self.infer_cfg = dataset_cfg['infer_cfg']
+            m_abbr = model_abbr_from_cfg(model_cfg)
+            d_abbr = dataset_abbr_from_cfg(dataset_cfg)
+            out_path = get_infer_output_path(
+                model_cfg, dataset_cfg,
+                osp.join(self.work_dir, 'predictions'))
+            # rank 0 owns the filesystem view; broadcast so a
+            # multi-host group takes the same skip decision
+            if broadcast_object(osp.exists(out_path)
+                                if is_main_process() else None):
+                tracer.event('infer_skip', model=m_abbr,
+                             dataset=d_abbr)
                 units_done += 1
                 heartbeat.set_unit(units_done, units_total)
-                if prof.record and is_main_process():
-                    logger.info(
-                        f'perf: {prof.record.get("samples_per_sec", "?")} '
-                        f'samples/s, {prof.record.get("tokens_per_sec", "?")}'
-                        f' tokens/s (wall {prof.record["wall_seconds"]}s)')
+                continue
+            heartbeat.set_unit(units_done, units_total,
+                               f'{m_abbr}/{d_abbr}')
+            perf_path = trace_dir = None
+            if is_main_process():
+                perf_path = get_infer_output_path(
+                    model_cfg, dataset_cfg,
+                    osp.join(self.work_dir, 'perf'))
+                if self.cfg.get('profile'):
+                    trace_dir = osp.join(
+                        self.work_dir, 'profile', m_abbr, d_abbr)
+            with tracer.span(f'infer:{m_abbr}/{d_abbr}') as span:
+                prof = TaskProfiler(model, perf_path, trace_dir)
+                try:
+                    with prof:
+                        self._inference(model, out_path)
+                finally:
+                    # attach even when _inference raised: the failed
+                    # task's compile/device time must reach the trace
+                    # report (TaskProfiler.__exit__ always builds the
+                    # record, with 'error' on failure)
+                    if prof.record:
+                        # the span-local counter backend: the trace
+                        # report reads compile/device attribution here
+                        span.set_attrs(perf=prof.record)
+                    if tracer.enabled:
+                        mem = device_memory_attrs()
+                        if mem:
+                            span.set_attrs(device_memory=mem)
+                            if 'peak_bytes_in_use' in mem:
+                                tracer.gauge(
+                                    'device.peak_bytes_in_use').set(
+                                        mem['peak_bytes_in_use'])
+            units_done += 1
+            heartbeat.set_unit(units_done, units_total)
+            if prof.record and is_main_process():
+                logger.info(
+                    f'perf: {prof.record.get("samples_per_sec", "?")} '
+                    f'samples/s, {prof.record.get("tokens_per_sec", "?")}'
+                    f' tokens/s (wall {prof.record["wall_seconds"]}s)')
 
     def _inference(self, model, out_path: str):
         assert 'ice_template' in self.infer_cfg \

@@ -3,14 +3,29 @@
 Dataset configs carry ``infer_cfg``/``eval_cfg``/``abbr`` and model configs
 carry ``run_cfg``/``max_out_len``/``batch_size``/``abbr`` which are consumed by
 the scheduler, not the constructors.  Parity: reference utils/build.py:8-22.
+
+**Model residency.**  A resident worker process (runners/worker.py) runs
+many tasks that share one model config; rebuilding the model per task
+would re-load the checkpoint and re-upload weights every time.  The
+worker calls :func:`enable_model_cache`, after which
+:func:`build_model_from_cfg` memoizes on the constructor-relevant config
+digest — the second task for the same model reuses the live object
+(weights on device, jit caches hot).  One-shot task processes never
+enable it, so their behavior is unchanged.
 """
 import copy
+import hashlib
+import json
+from typing import Dict, Optional
 
 from opencompass_tpu.registry import LOAD_DATASET, MODELS
 
 DATASET_NON_CTOR_KEYS = ('infer_cfg', 'eval_cfg', 'abbr')
 MODEL_NON_CTOR_KEYS = ('run_cfg', 'max_out_len', 'batch_size', 'abbr',
                        'summarizer_abbr')
+
+# None = disabled (default); {} = enabled.  Keyed by model_cfg_key.
+_MODEL_CACHE: Optional[Dict] = None
 
 
 def build_dataset_from_cfg(dataset_cfg):
@@ -20,8 +35,47 @@ def build_dataset_from_cfg(dataset_cfg):
     return LOAD_DATASET.build(dataset_cfg)
 
 
+def model_cfg_key(model_cfg) -> str:
+    """Stable digest of a model config's constructor-relevant fields —
+    two configs with the same key build interchangeable models.  Doubles
+    as the partitioners' model-affinity key (tasks with equal keys are
+    routed to the same resident worker)."""
+    cfg = {k: v for k, v in dict(model_cfg).items()
+           if k not in MODEL_NON_CTOR_KEYS}
+    blob = json.dumps(cfg, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode('utf-8')).hexdigest()[:16]
+
+
+def enable_model_cache():
+    """Turn on model memoization for this process (resident workers)."""
+    global _MODEL_CACHE
+    if _MODEL_CACHE is None:
+        _MODEL_CACHE = {}
+
+
+def model_cache_enabled() -> bool:
+    return _MODEL_CACHE is not None
+
+
 def build_model_from_cfg(model_cfg):
+    key = None
+    if _MODEL_CACHE is not None:
+        key = model_cfg_key(model_cfg)
+        model = _MODEL_CACHE.get(key)
+        if model is not None:
+            from opencompass_tpu.obs import get_tracer
+            tracer = get_tracer()
+            tracer.event('worker_model_reuse', model_key=key)
+            tracer.counter('worker.model_reuses').inc()
+            return model
     model_cfg = copy.deepcopy(model_cfg)
-    for key in MODEL_NON_CTOR_KEYS:
-        model_cfg.pop(key, None)
-    return MODELS.build(model_cfg)
+    for key_name in MODEL_NON_CTOR_KEYS:
+        model_cfg.pop(key_name, None)
+    model = MODELS.build(model_cfg)
+    if key is not None:
+        _MODEL_CACHE[key] = model
+        from opencompass_tpu.obs import get_tracer
+        tracer = get_tracer()
+        tracer.event('worker_model_build', model_key=key)
+        tracer.counter('worker.model_builds').inc()
+    return model

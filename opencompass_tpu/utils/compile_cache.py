@@ -1,0 +1,279 @@
+"""Persistent XLA compilation cache: the sweep-level warm path.
+
+The size partitioner splits one model's datasets across many subprocess
+tasks, and every task is a fresh interpreter that recompiles every
+(B, S) shape bucket from nothing — measured 10-16 min per shape pair at
+7B through the remote-compile tunnel.  This module makes those compiles
+happen **once per sweep**: every process (driver, one-shot task,
+resident worker) points ``jax``'s persistent compilation cache at a
+shared directory, so the first process to compile a shape serializes
+the executable and every later process deserializes it in seconds.
+
+Layout (all under one cache root, shared by every run of a sweep)::
+
+    {cache_root}/xla/        XLA compilation cache (jax-managed blobs)
+    {cache_root}/xla/shapes.json   our sidecar shape manifest (see below)
+    {cache_root}/toklen/     persisted token-length caches (toklen_cache)
+
+Resolution order for the root: ``OCT_CACHE_ROOT`` env var, else
+``{work_dir}/cache`` (the driver exports the env var so subprocess
+tasks agree on the root; ``work_dir`` is the *pre-timestamp* output
+root, so consecutive runs share the cache).  The XLA dir itself can be
+pinned independently with ``OCT_COMPILE_CACHE`` (or jax's own
+``JAX_COMPILATION_CACHE_DIR``).
+
+**Hit/miss counters.**  jax announces persistent-cache activity through
+``jax.monitoring`` events; :func:`install_listeners` folds them into
+process-wide totals (read with :func:`counters_snapshot` — the
+``TaskProfiler`` snapshots deltas into the per-task perf record) and
+into the obs metrics registry (``compile_cache.hits`` /
+``compile_cache.misses`` counters + ``compile_cache.retrieval_seconds``
+histogram) so ``trace``/``status`` can tell cold compiles from cache
+loads.
+
+**Shape manifest.**  XLA cache keys are opaque HLO hashes, so "is shape
+(B, S) already cached?" cannot be asked of the cache directly.  JaxLM
+therefore records every first-dispatched (kind, B, S) bucket — plus the
+observed first-call seconds — into ``shapes.json``, keyed by a model
+signature (config + quantize digest).  ``cli plan --cache-dir`` joins
+the planner's shape census against this manifest to report planned
+shapes as warm (seconds observed) vs cold (estimated).
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+import threading
+from typing import Dict, Optional
+
+ENV_CACHE_ROOT = 'OCT_CACHE_ROOT'
+ENV_COMPILE_CACHE = 'OCT_COMPILE_CACHE'
+ENV_JAX_CACHE = 'JAX_COMPILATION_CACHE_DIR'
+
+MANIFEST_NAME = 'shapes.json'
+# rough cold-compile estimate for a shape with no observed timing (used
+# only by the `cli plan --cache-dir` warm/cold pre-flight estimate;
+# real compiles at 7B measure minutes, tiny test models milliseconds)
+DEFAULT_COLD_COMPILE_S = 90.0
+
+_lock = threading.Lock()
+_counters = {'hits': 0, 'misses': 0, 'retrieval_seconds': 0.0}
+_listeners_installed = False
+_enabled_dir: Optional[str] = None
+
+
+def cache_root(work_dir: Optional[str] = None) -> Optional[str]:
+    """The sweep-shared cache root, or None when nothing pins one."""
+    root = os.environ.get(ENV_CACHE_ROOT)
+    if root:
+        return root
+    if work_dir:
+        return osp.join(work_dir, 'cache')
+    return None
+
+
+def xla_cache_dir(work_dir: Optional[str] = None) -> Optional[str]:
+    """The persistent XLA cache directory (env overrides, then root)."""
+    for env in (ENV_COMPILE_CACHE, ENV_JAX_CACHE):
+        d = os.environ.get(env)
+        if d:
+            return d
+    root = cache_root(work_dir)
+    return osp.join(root, 'xla') if root else None
+
+
+def toklen_cache_dir(work_dir: Optional[str] = None) -> Optional[str]:
+    root = cache_root(work_dir)
+    return osp.join(root, 'toklen') if root else None
+
+
+def export_env(work_dir: str):
+    """Driver-side: pin the cache root + XLA dir into ``os.environ`` so
+    every subprocess (tasks, workers) resolves the same directories.
+    User-set values win (``setdefault``)."""
+    root = cache_root(work_dir)
+    if root:
+        os.environ.setdefault(ENV_CACHE_ROOT, osp.abspath(root))
+    d = xla_cache_dir(work_dir)
+    if d:
+        os.environ.setdefault(ENV_JAX_CACHE, osp.abspath(d))
+
+
+def enable(work_dir: Optional[str] = None) -> Optional[str]:
+    """Point this process's jax at the persistent cache and install the
+    hit/miss listeners.  Idempotent; never raises (a broken cache must
+    not fail a run — jax falls back to compiling).  Returns the cache
+    dir in effect, or None when unresolvable/unsupported."""
+    global _enabled_dir
+    d = xla_cache_dir(work_dir)
+    if not d:
+        return None
+    d = osp.abspath(d)
+    if _enabled_dir == d:
+        return d
+    try:
+        import jax
+        jax.config.update('jax_compilation_cache_dir', d)
+    except Exception:
+        return None
+    # tuning knobs are best-effort (names drift across jax versions):
+    # cache every executable — the default 1s floor skips exactly the
+    # small-shape compiles whose sheer count dominates test/CI runs —
+    # but bound the sweep-shared directory with jax's LRU eviction so
+    # caching everything can't grow it without limit
+    # (OCT_COMPILE_CACHE_MAX_BYTES overrides; default 16 GiB)
+    for knob, value in (
+            ('jax_persistent_cache_min_compile_time_secs', 0.0),
+            ('jax_persistent_cache_min_entry_size_bytes', 0),
+            ('jax_compilation_cache_max_size',
+             int(os.environ.get('OCT_COMPILE_CACHE_MAX_BYTES',
+                                16 * 2**30)))):
+        try:
+            jax.config.update(knob, value)
+        except Exception:
+            pass
+    try:
+        # jax memoizes "is the cache used?" at the first compile; a
+        # process that compiled anything before this call (in-process
+        # drivers, tests) has it pinned to the old answer/dir — reset so
+        # the new dir actually takes effect.  Private API, so best
+        # effort: worst case the cache engages only in fresh processes.
+        from jax._src import compilation_cache as _cc
+        if getattr(_cc, '_cache_checked', False) or _cc.is_initialized():
+            _cc.reset_cache()
+    except Exception:
+        pass
+    install_listeners()
+    _enabled_dir = d
+    return d
+
+
+def install_listeners():
+    """Subscribe to jax's compilation-cache monitoring events.  Totals
+    land in this module (per-process) and, when tracing is enabled at
+    event time, in the obs metrics registry."""
+    global _listeners_installed
+    if _listeners_installed:
+        return
+    try:
+        from jax import monitoring
+    except Exception:
+        return
+
+    def _on_event(name: str, **kw):
+        key = None
+        if name.endswith('/cache_hits'):
+            key = 'hits'
+        elif name.endswith('/cache_misses'):
+            key = 'misses'
+        if key is None:
+            return
+        with _lock:
+            _counters[key] += 1
+        try:
+            from opencompass_tpu.obs import get_tracer
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.counter(f'compile_cache.{key}').inc()
+        except Exception:
+            pass
+
+    def _on_duration(name: str, secs: float, **kw):
+        if not name.endswith('/cache_retrieval_time_sec'):
+            return
+        with _lock:
+            _counters['retrieval_seconds'] += float(secs)
+        try:
+            from opencompass_tpu.obs import get_tracer
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.histogram(
+                    'compile_cache.retrieval_seconds').observe(secs)
+        except Exception:
+            pass
+
+    try:
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        _listeners_installed = True
+    except Exception:
+        pass
+
+
+def counters_snapshot() -> Dict[str, float]:
+    """Process totals since import: {'hits', 'misses',
+    'retrieval_seconds'} (TaskProfiler diffs these around a task)."""
+    with _lock:
+        return dict(_counters)
+
+
+# -- shape manifest (the `cli plan --cache-dir` join key) -----------------
+
+def manifest_path(cache_dir: Optional[str] = None) -> Optional[str]:
+    d = cache_dir or _enabled_dir or xla_cache_dir()
+    return osp.join(d, MANIFEST_NAME) if d else None
+
+
+def load_manifest(cache_dir: Optional[str] = None) -> Dict:
+    """``{model_sig: {"kind:BxS": first_call_seconds}}``; {} when
+    absent/corrupt."""
+    path = manifest_path(cache_dir)
+    if not path or not osp.exists(path):
+        return {}
+    try:
+        with open(path, encoding='utf-8') as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else {}
+    except Exception:
+        return {}
+
+
+def record_shape(model_sig: str, kind: str, shape, seconds: float,
+                 cache_dir: Optional[str] = None):
+    """Merge one first-dispatched shape bucket into the manifest.
+    Read-merge-write (last-writer-wins on a race: the manifest is an
+    estimation aid, not a correctness surface).  Never raises."""
+    path = manifest_path(cache_dir)
+    if not path or not model_sig:
+        return
+    key = f'{kind}:{int(shape[0])}x{int(shape[1])}'
+    try:
+        with _lock:
+            data = load_manifest(osp.dirname(path))
+            entry = data.setdefault(model_sig, {})
+            # keep the slowest observed first call: that is the cold
+            # compile; later cache-served first calls are fast
+            entry[key] = round(max(float(seconds),
+                                   float(entry.get(key, 0.0))), 3)
+            from opencompass_tpu.obs.live import atomic_write_json
+            atomic_write_json(path, data)
+    except Exception:
+        pass
+
+
+def probe_shapes(model_sig: str, shape_keys, cache_dir: Optional[str] =
+                 None) -> Dict:
+    """Join planned shape keys ("kind:BxS") against the manifest: which
+    are already warm, and the estimated warm vs cold startup seconds."""
+    known = load_manifest(cache_dir).get(model_sig, {})
+    warm, cold = [], []
+    warm_s = 0.0
+    for key in shape_keys:
+        if key in known:
+            warm.append(key)
+            warm_s += known[key]
+        else:
+            cold.append(key)
+    cold_s = sum(DEFAULT_COLD_COMPILE_S for _ in cold)
+    # a warm shape still pays deserialization (~seconds); call it 5% of
+    # the observed compile, floored at 1s per shape but never above the
+    # compile itself (tiny-model compiles undercut the floor).  Cold
+    # shapes pay the full compile in either scenario.
+    retrieval_s = min(max(0.05 * warm_s, 1.0 * len(warm)), warm_s)
+    return {
+        'warm': sorted(warm), 'cold': sorted(cold),
+        'n_warm': len(warm), 'n_cold': len(cold),
+        'est_warm_startup_s': round(retrieval_s + cold_s, 1),
+        'est_cold_startup_s': round(warm_s + cold_s, 1),
+    }

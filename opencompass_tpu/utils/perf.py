@@ -56,6 +56,12 @@ class PerfCounters:
     # task (planner-instrumented inferencers add it; compare with
     # first_calls for the planned-vs-dispatched compile story)
     planned_shapes: int = 0
+    # persistent-XLA-cache activity (utils/compile_cache.py listeners):
+    # a first call that HIT deserializes a prior run's executable in
+    # seconds instead of recompiling for minutes — these split
+    # compile_seconds into true cold compiles vs cache loads
+    compile_cache_hits: int = 0
+    compile_cache_misses: int = 0
 
     def snapshot(self) -> dict:
         return dataclasses.asdict(self)
@@ -111,6 +117,12 @@ class TaskProfiler:
         counters = getattr(self.model, 'perf', None)
         if isinstance(counters, PerfCounters):
             self._snap = counters.snapshot()
+        # persistent-compile-cache totals are process-wide (jax
+        # monitoring events); diff them around the task and credit the
+        # delta to this model's counters so the perf record and the
+        # trace report can split compile_seconds into cold vs cached
+        from opencompass_tpu.utils import compile_cache
+        self._cc_snap = compile_cache.counters_snapshot()
         self._trace_active = False
         if self.trace_dir:
             try:
@@ -133,6 +145,12 @@ class TaskProfiler:
         record = {'wall_seconds': round(wall, 3)}
         counters = getattr(self.model, 'perf', None)
         if isinstance(counters, PerfCounters) and self._snap is not None:
+            from opencompass_tpu.utils import compile_cache
+            cc = compile_cache.counters_snapshot()
+            counters.compile_cache_hits += \
+                int(cc['hits'] - self._cc_snap['hits'])
+            counters.compile_cache_misses += \
+                int(cc['misses'] - self._cc_snap['misses'])
             d = counters.delta_since(self._snap)
             record.update(
                 samples=d['samples'],
@@ -154,6 +172,8 @@ class TaskProfiler:
                 if d['tokens_in'] + d['pad_tokens'] > 0 else 1.0,
                 overlap_seconds=round(d['overlap_seconds'], 3),
                 planned_shapes=d['planned_shapes'],
+                compile_cache_hits=d['compile_cache_hits'],
+                compile_cache_misses=d['compile_cache_misses'],
             )
         if self.trace_dir and self._trace_active:
             record['trace_dir'] = self.trace_dir

@@ -16,11 +16,55 @@ from __future__ import annotations
 import argparse
 import copy
 import json
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from opencompass_tpu.utils.logging import get_logger
 
 logger = get_logger()
+
+# inferencer class → model dispatch kind (the jit-cache key family the
+# planned shapes will be dispatched under).  Exact names only:
+# subclasses (GLMChoiceInferencer routes through model.choice) dispatch
+# differently and are not warmed/probed.
+_KIND_BY_INFERENCER = {
+    'GenInferencer': 'gen',
+    'PPLInferencer': 'ppl',
+    'CLPInferencer': 'choice',
+}
+
+
+def inferencer_kind(infer_cfg: Dict) -> Optional[str]:
+    t = infer_cfg.get('inferencer', {}).get('type', '')
+    name = t if isinstance(t, str) else getattr(t, '__name__', '')
+    # a dump/reload round-trip (worker/task cfg files) serializes the
+    # class as its dotted path — match on the class name
+    return _KIND_BY_INFERENCER.get(name.rsplit('.', 1)[-1])
+
+
+def shape_census(model, model_cfg, dataset_cfg,
+                 token_budget: Optional[int] = None) -> List[Dict]:
+    """Planned (kind, B, S_bucket) specs for one (model, dataset) task —
+    the batch planner's shape set in the form ``JaxLM.warm_up`` (and the
+    ``--cache-dir`` probe) consume: ``[{kind, b, s[, max_out_len]},
+    ...]``.  Device-free; empty when the task isn't plannable."""
+    infer_cfg = dataset_cfg.get('infer_cfg', {})
+    kind = inferencer_kind(infer_cfg)
+    if kind is None:
+        return []
+    preview = _preview_task(model, model_cfg, dataset_cfg, token_budget)
+    if not preview:
+        return []
+    shapes = preview.get('planned', {}).get('shapes', {})
+    max_out_len = (infer_cfg.get('inferencer', {}).get('max_out_len')
+                   or model_cfg.get('max_out_len'))
+    specs = []
+    for key in shapes:
+        b, _, s = key.partition('x')
+        spec = {'kind': kind, 'b': int(b), 's': int(s)}
+        if kind == 'gen':
+            spec['max_out_len'] = max_out_len
+        specs.append(spec)
+    return specs
 
 
 def _tokenizer_only_model(model_cfg):
@@ -70,6 +114,23 @@ def _preview_task(model, model_cfg, dataset_cfg,
                                    prompt_template=prompt_template)
 
 
+def _probe_cache(model, dataset_cfg, preview: Dict,
+                 cache_dir: str) -> Optional[Dict]:
+    """Join one task's planned shapes against the persistent cache's
+    shape manifest (utils/compile_cache.py): which of them are already
+    warm, and the estimated warm vs cold startup seconds.  None when the
+    model has no shape signature (FakeModel, API wrappers) or the
+    inferencer kind is unknown."""
+    from opencompass_tpu.utils import compile_cache
+    sig = getattr(model, 'shape_signature', None)
+    kind = inferencer_kind(dataset_cfg.get('infer_cfg', {}))
+    if not sig or kind is None:
+        return None
+    keys = [f'{kind}:{k}'
+            for k in preview.get('planned', {}).get('shapes', {})]
+    return compile_cache.probe_shapes(sig, keys, cache_dir)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog='opencompass-tpu plan',
@@ -80,6 +141,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument('--token-budget', type=int, default=None,
                         help='override the planner token budget '
                         '(max padded B*S per batch)')
+    parser.add_argument('--cache-dir', default=None, metavar='DIR',
+                        help='probe a persistent compile cache: report '
+                        'which planned shapes a previous run already '
+                        'compiled there (warm) vs which would compile '
+                        'cold, with estimated startup seconds for each '
+                        'scenario.  DIR is the XLA cache dir (e.g. '
+                        '{work_dir}/cache/xla)')
     parser.add_argument('--json', action='store_true',
                         help='emit one JSON object instead of the table')
     args = parser.parse_args(argv)
@@ -109,6 +177,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                 continue
             preview['model'] = m_abbr
             preview['dataset'] = d_abbr
+            if args.cache_dir:
+                preview['cache_probe'] = _probe_cache(
+                    model, dataset_cfg, preview, args.cache_dir)
             results.append(preview)
 
     if args.json:
@@ -141,6 +212,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         if shapes:
             print(f"  {r['model']}/{r['dataset']}: "
                   + ', '.join(f'{k} x{v}' for k, v in shapes.items()))
+    if args.cache_dir:
+        print(f'\ncompile-cache probe ({args.cache_dir}):')
+        for r in results:
+            probe = r.get('cache_probe')
+            tag = f"  {r['model']}/{r['dataset']}: "
+            if probe is None:
+                print(tag + 'not probeable (no shape signature)')
+                continue
+            print(tag + f"{probe['n_warm']} warm / {probe['n_cold']} "
+                  f"cold shapes; est startup "
+                  f"{probe['est_warm_startup_s']}s warm vs "
+                  f"{probe['est_cold_startup_s']}s cold"
+                  + (f"; cold: {', '.join(probe['cold'])}"
+                     if probe['cold'] else ''))
     return 0
 
 

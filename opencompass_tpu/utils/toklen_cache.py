@@ -1,0 +1,101 @@
+"""Persistent token-length cache: tokenize each prompt once per sweep.
+
+The inferencers' truncation loops call ``get_token_len`` repeatedly per
+prompt variant; JaxLM already holds an in-memory LRU for that
+(``_token_len_cache``), but every subprocess task starts it cold and
+re-tokenizes its whole dataset shard — including resumed/retried tasks
+re-measuring prompts the previous attempt already measured.  This module
+persists that cache to ``{cache_root}/toklen/<tokenizer_digest>.json``
+(the same sweep-shared cache root as the XLA compile cache) so the
+second process skips straight to cached lengths.
+
+Keys are the model layer's 16-byte blake2b text digests (hex-encoded in
+JSON) — prompt text itself never lands on disk.  The file is bounded
+(most-recently-used ``MAX_ENTRIES``) and written atomically, so a
+concurrent reader never sees a torn file and two finishing tasks at
+worst lose each other's newest entries (a cache, not a ledger).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os.path as osp
+from collections import OrderedDict
+from typing import Optional
+
+from opencompass_tpu.utils import compile_cache
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+MAX_ENTRIES = 200_000
+VERSION = 1
+
+
+def resolve_dir(work_dir: Optional[str] = None) -> Optional[str]:
+    """The toklen cache dir, or None when no cache root is pinned."""
+    return compile_cache.toklen_cache_dir(work_dir)
+
+
+def tokenizer_digest(tokenizer, path: Optional[str] = None) -> str:
+    """Identity of a tokenizer's *behavior*: two tokenizers sharing a
+    digest must produce identical token counts.  Keyed on kind (hf vs
+    byte), source path, vocab size, special ids, AND the encoding of a
+    probe string — the probe catches a tokenizer updated in place at
+    the same path (same vocab size, different merges), which would
+    otherwise silently serve stale lengths to the truncation loops."""
+    try:
+        probe = tokenizer.encode(
+            'The quick brown fox 123 jumps! 狐狸 éß',
+            add_special_tokens=True)
+    except Exception:
+        probe = None
+    ident = json.dumps([
+        VERSION, getattr(tokenizer, 'kind', '?'), str(path or ''),
+        getattr(tokenizer, 'vocab_size', 0),
+        getattr(tokenizer, 'bos_token_id', None),
+        getattr(tokenizer, 'eos_token_id', None),
+        getattr(tokenizer, 'pad_token_id', None),
+        probe,
+    ], default=str)
+    return hashlib.sha1(ident.encode('utf-8')).hexdigest()[:16]
+
+
+def cache_path(cache_dir: str, digest: str) -> str:
+    return osp.join(cache_dir, f'{digest}.json')
+
+
+def load(cache_dir: str, digest: str) -> 'OrderedDict[bytes, int]':
+    """Previously persisted lengths, oldest-first (so LRU eviction in
+    the in-memory cache drops them before fresh entries).  Empty on any
+    problem — a cache miss, never an error."""
+    out: 'OrderedDict[bytes, int]' = OrderedDict()
+    path = cache_path(cache_dir, digest)
+    if not osp.exists(path):
+        return out
+    try:
+        with open(path, encoding='utf-8') as f:
+            data = json.load(f)
+        if data.get('v') != VERSION:
+            return out
+        for hex_key, n in data.get('lengths', {}).items():
+            out[bytes.fromhex(hex_key)] = int(n)
+    except Exception as exc:
+        logger.warning(f'toklen cache unreadable ({path}): {exc}')
+        out.clear()
+    return out
+
+
+def save(cache_dir: str, digest: str,
+         lengths: 'OrderedDict[bytes, int]',
+         max_entries: int = MAX_ENTRIES):
+    """Atomic, bounded write of the newest ``max_entries`` lengths.
+    Never raises — persistence failures cost a warning, not the task."""
+    try:
+        items = list(lengths.items())[-max_entries:]
+        payload = {'v': VERSION, 'tokenizer': digest,
+                   'lengths': {k.hex(): int(n) for k, n in items}}
+        from opencompass_tpu.obs.live import atomic_write_json
+        atomic_write_json(cache_path(cache_dir, digest), payload)
+    except Exception as exc:
+        logger.warning(f'toklen cache write failed: {exc}')

@@ -1,0 +1,355 @@
+"""Warm-path execution: persistent compile cache, model-resident
+workers, planned-shape warm-up, persisted token-length cache."""
+import json
+import os
+import os.path as osp
+import sys
+
+import pytest
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+# -- wire protocol ---------------------------------------------------------
+
+def test_worker_frame_roundtrip():
+    from opencompass_tpu.runners.worker import (WorkerError, read_frame,
+                                                write_frame)
+    r, w = os.pipe()
+    with os.fdopen(w, 'wb') as wf:
+        write_frame(wf, {'cmd': 'run', 'x': [1, 2, 3]})
+        write_frame(wf, {'cmd': 'shutdown'})
+    assert read_frame(r) == {'cmd': 'run', 'x': [1, 2, 3]}
+    assert read_frame(r) == {'cmd': 'shutdown'}
+    with pytest.raises(WorkerError):
+        read_frame(r)  # EOF
+    os.close(r)
+
+
+def test_worker_request_watched_kills_stalled_worker():
+    """A worker that never answers and shows no liveness is killed
+    after stall_timeout (the one-shot watchdog's semantics, ported)."""
+    import subprocess
+
+    from opencompass_tpu.runners.worker import WorkerError, WorkerHandle
+    handle = WorkerHandle.__new__(WorkerHandle)
+    handle._log_fh = open(os.devnull, 'a')
+    handle.proc = subprocess.Popen(
+        [sys.executable, '-c', 'import time; time.sleep(60)'],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=handle._log_fh, start_new_session=True)
+    handle.dead = False
+    with pytest.raises(WorkerError, match='wedged|died'):
+        handle.request_watched({'cmd': 'run'}, stall_timeout=1.0,
+                               liveness=lambda: None, poll=0.2)
+    assert handle.dead
+    assert handle.proc.poll() is not None
+
+
+def test_worker_read_timeout():
+    from opencompass_tpu.runners.worker import WorkerError, read_frame
+    r, w = os.pipe()
+    try:
+        with pytest.raises(WorkerError, match='timed out'):
+            read_frame(r, timeout=0.2)
+    finally:
+        os.close(r)
+        os.close(w)
+
+
+# -- eligibility / grouping ------------------------------------------------
+
+def _demo_tasks(tmp_path, max_task_size=100, datasets=None):
+    from opencompass_tpu.config import Config
+    from opencompass_tpu.partitioners import SizePartitioner
+    cfg = Config.fromfile(osp.join(REPO, 'configs/eval_demo.py'))
+    cfg['work_dir'] = str(tmp_path / 'run')
+    if datasets is not None:
+        cfg['datasets'] = [d for d in cfg['datasets']
+                           if d['abbr'] in datasets]
+    part = SizePartitioner(str(tmp_path / 'run' / 'predictions'),
+                           max_task_size=max_task_size,
+                           dataset_size_path=str(tmp_path / 'size.json'))
+    return cfg, part(cfg)
+
+
+def test_partitioner_stamps_model_key(tmp_path):
+    _, tasks = _demo_tasks(tmp_path)
+    keys = {t['model_key'] for t in tasks}
+    assert len(keys) == 1 and all(keys)  # one model -> one affinity key
+
+
+def test_worker_grouping_modes(tmp_path):
+    from opencompass_tpu.runners import LocalRunner
+    _, tasks = _demo_tasks(tmp_path)
+
+    def plan(**kw):
+        r = LocalRunner(task=dict(type='OpenICLInferTask'), **kw)
+        return r._plan_worker_groups(tasks)
+
+    groups, singles = plan(use_workers=False)
+    assert not groups and len(singles) == len(tasks)
+    # auto: FakeModel tasks are chipless -> stay one-shot
+    groups, singles = plan()
+    assert not groups and len(singles) == len(tasks)
+    # explicit: all tasks share one model -> one worker group, in order
+    groups, singles = plan(use_workers=True)
+    assert not singles and len(groups) == 1
+    assert groups[0][1] == list(range(len(tasks)))
+
+
+def test_api_models_never_worker_eligible():
+    from opencompass_tpu.runners.worker import task_worker_eligible
+    api_task = {'models': [dict(type='OpenAI', path='gpt-4')],
+                'datasets': [[]], 'work_dir': '.'}
+    assert not task_worker_eligible(api_task)
+
+
+# -- worker pool end to end ------------------------------------------------
+
+def _run_worker_pool(tmp_path, n_expected_tasks, env=None, retry=0):
+    from opencompass_tpu import obs
+    from opencompass_tpu.runners import LocalRunner
+    cfg, tasks = _demo_tasks(tmp_path, max_task_size=160,
+                             datasets={'demo-gen'})
+    assert len(tasks) == n_expected_tasks
+    work = cfg['work_dir']
+    os.makedirs(work, exist_ok=True)
+    old_env = {}
+    for k, v in (env or {}).items():
+        old_env[k] = os.environ.get(k)
+        os.environ[k] = v
+    obs.reset_obs()
+    tracer = obs.init_obs(work, enabled=True)
+    try:
+        runner = LocalRunner(task=dict(type='OpenICLInferTask'),
+                             use_workers=True, max_num_workers=4,
+                             retry=retry)
+        status = runner(tasks)
+    finally:
+        tracer.close()
+        obs.reset_obs()
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    events = [json.loads(line)
+              for line in open(osp.join(work, 'obs/events.jsonl'))]
+    return work, tasks, status, events
+
+
+def test_worker_pool_end_to_end(tmp_path):
+    """Two dataset shards through one resident worker: exactly one
+    model construction, in-order green results, predictions written,
+    heartbeats still flowing."""
+    work, tasks, status, events = _run_worker_pool(tmp_path, 2)
+    # in-order, all green
+    assert [rc for _, rc in status] == [0, 0]
+    expected = [t['datasets'][0][0]['abbr'] for t in tasks]
+    assert [name for name, _ in status] == \
+        [f'OpenICLInfer[fake-demo/{a}]' for a in expected]
+    # exactly one model build; the second shard reused it
+    builds = [e for e in events if e.get('name') == 'worker_model_build']
+    reuses = [e for e in events if e.get('name') == 'worker_model_reuse']
+    assert len(builds) == 1
+    assert reuses
+    # outputs on disk (the completion contract)
+    preds = sorted(os.listdir(osp.join(work, 'predictions/fake-demo')))
+    assert preds == [f'{a}.json' for a in expected]
+    # heartbeats flowed from inside the worker, one file per task
+    hb_files = os.listdir(osp.join(work, 'obs/progress'))
+    assert len(hb_files) == 2
+    for f in hb_files:
+        hb = json.load(open(osp.join(work, 'obs/progress', f)))
+        assert hb['state'] == 'done'
+
+
+def test_worker_crash_falls_back_to_subprocess(tmp_path):
+    """A worker crash mid-group must not lose the task: the runner falls
+    back to the one-shot subprocess path and the run stays green."""
+    work, tasks, status, events = _run_worker_pool(
+        tmp_path, 2, env={'OCT_WORKER_FAULT': 'crash:demo-gen_1'})
+    assert [rc for _, rc in status] == [0, 0]
+    fallbacks = [e for e in events if e.get('name') == 'worker_fallback']
+    assert len(fallbacks) == 1
+    preds = sorted(os.listdir(osp.join(work, 'predictions/fake-demo')))
+    assert preds == ['demo-gen_0.json', 'demo-gen_1.json']
+
+
+# -- persistent compile cache ----------------------------------------------
+
+def test_compile_cache_counters_and_manifest(tmp_path, monkeypatch):
+    """Cold build pays cache misses; a rebuilt model after
+    jax.clear_caches() deserializes from the persistent cache (hits in
+    the perf record, compile_seconds under the cold figure) and the
+    sidecar shape manifest knows the dispatched shape."""
+    import jax
+    from opencompass_tpu.models.jax_lm import JaxLM
+    from opencompass_tpu.utils import compile_cache
+    from opencompass_tpu.utils.perf import TaskProfiler
+    cache_dir = str(tmp_path / 'xla')
+    monkeypatch.setenv('OCT_COMPILE_CACHE', cache_dir)
+    monkeypatch.setattr(compile_cache, '_enabled_dir', None)
+    assert compile_cache.enable() == osp.abspath(cache_dir)
+    # earlier tests in a full-suite run may have compiled the tiny
+    # model's shapes into jax's in-memory executable cache, which would
+    # serve the "cold" pass without ever consulting the persistent
+    # cache — start genuinely cold
+    jax.clear_caches()
+
+    def one_pass():
+        lm = JaxLM(config='tiny', max_seq_len=128)
+        with TaskProfiler(lm) as prof:
+            lm.get_ppl(['hello warm world'])
+        return lm, prof.record
+
+    lm1, cold = one_pass()
+    assert cold['compile_cache_misses'] > 0
+    assert cold['compile_cache_hits'] == 0
+    jax.clear_caches()
+    _, warm = one_pass()
+    assert warm['compile_cache_hits'] > 0
+    assert warm['compile_cache_misses'] == 0
+    assert warm['compile_seconds'] < cold['compile_seconds']
+    # the manifest recorded the dispatched ppl shape with its seconds
+    manifest = compile_cache.load_manifest(cache_dir)
+    sig = lm1.shape_signature
+    assert sig in manifest
+    assert any(k.startswith('ppl:') for k in manifest[sig])
+
+
+def test_shape_manifest_probe(tmp_path):
+    from opencompass_tpu.utils import compile_cache
+    cache_dir = str(tmp_path / 'xla')
+    compile_cache.record_shape('sig1', 'gen', (4, 128), 120.0,
+                               cache_dir=cache_dir)
+    compile_cache.record_shape('sig1', 'ppl', (8, 256), 60.0,
+                               cache_dir=cache_dir)
+    # slower observation wins (cold compile vs later cache-served call)
+    compile_cache.record_shape('sig1', 'gen', (4, 128), 1.0,
+                               cache_dir=cache_dir)
+    manifest = compile_cache.load_manifest(cache_dir)
+    assert manifest['sig1']['gen:4x128'] == 120.0
+    probe = compile_cache.probe_shapes(
+        'sig1', ['gen:4x128', 'gen:8x128'], cache_dir)
+    assert probe['n_warm'] == 1 and probe['n_cold'] == 1
+    assert probe['warm'] == ['gen:4x128']
+    assert probe['est_warm_startup_s'] < probe['est_cold_startup_s']
+    # unknown signature: everything cold
+    probe2 = compile_cache.probe_shapes('other', ['gen:4x128'], cache_dir)
+    assert probe2['n_warm'] == 0 and probe2['n_cold'] == 1
+
+
+def test_cli_plan_cache_dir_probe(tmp_path):
+    """`cli plan --cache-dir` joins the planner census against the
+    manifest: a manifest seeded with the planned shapes reports them
+    warm."""
+    from opencompass_tpu.config import Config
+    from opencompass_tpu.utils import compile_cache
+    from opencompass_tpu.utils.build import build_model_from_cfg
+    from opencompass_tpu.utils.plan_preview import main as plan_main
+    from opencompass_tpu.utils.plan_preview import shape_census
+
+    mcfg = Config.fromfile(
+        osp.join(REPO, 'configs/models/jax_llama_tiny.py'))
+    model_cfg = dict(mcfg['models'][0])
+    model_cfg['tokenizer_only'] = True
+    cfg_path = tmp_path / 'plan_cfg.py'
+    cfg = Config.fromfile(osp.join(REPO, 'configs/eval_demo.py'))
+    cfg['models'] = [model_cfg]
+    cfg.dump(str(cfg_path))
+
+    # seed the manifest with exactly the census shapes
+    model = build_model_from_cfg(model_cfg)
+    cache_dir = str(tmp_path / 'xla')
+    n_seeded = 0
+    for ds in cfg['datasets']:
+        for spec in shape_census(model, model_cfg, ds):
+            compile_cache.record_shape(
+                model.shape_signature, spec['kind'],
+                (spec['b'], spec['s']), 42.0, cache_dir=cache_dir)
+            n_seeded += 1
+    assert n_seeded > 0
+
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = plan_main([str(cfg_path), '--cache-dir', cache_dir])
+    out = buf.getvalue()
+    assert rc == 0
+    assert 'compile-cache probe' in out
+    assert 'warm' in out
+    # every planned shape was seeded -> no task may report cold shapes
+    assert ' 0 warm' not in out
+
+
+# -- persisted token-length cache ------------------------------------------
+
+def test_toklen_cache_roundtrip_and_bound(tmp_path):
+    from collections import OrderedDict
+
+    from opencompass_tpu.utils import toklen_cache
+    d = str(tmp_path / 'toklen')
+    lengths = OrderedDict((bytes([i]) * 16, i) for i in range(10))
+    toklen_cache.save(d, 'abc123', lengths, max_entries=4)
+    loaded = toklen_cache.load(d, 'abc123')
+    assert list(loaded.values()) == [6, 7, 8, 9]  # newest 4 kept
+    assert toklen_cache.load(d, 'missing') == OrderedDict()
+
+
+def test_jaxlm_persists_token_lengths(tmp_path, monkeypatch):
+    """A second JaxLM process-alike starts with the first one's token
+    lengths preloaded (no re-tokenization on resume/retry)."""
+    from opencompass_tpu.models.jax_lm import JaxLM
+    monkeypatch.setenv('OCT_CACHE_ROOT', str(tmp_path / 'cache'))
+    lm = JaxLM(config='tiny', max_seq_len=128, tokenizer_only=True)
+    n = lm.get_token_len('a prompt worth remembering')
+    lm.save_caches()
+    path = osp.join(str(tmp_path / 'cache'), 'toklen',
+                    f'{lm._toklen_digest}.json')
+    assert osp.exists(path)
+    lm2 = JaxLM(config='tiny', max_seq_len=128, tokenizer_only=True)
+    key = lm2._cache_key('a prompt worth remembering')
+    assert lm2._token_len_cache.get(key) == n
+
+
+def test_cli_plumbs_use_workers():
+    """--workers/--no-workers reach LocalRunner via the config."""
+    import types
+
+    from opencompass_tpu.cli import _build_runner, get_config_from_arg
+    args = types.SimpleNamespace(slurm=False, dlc=False, debug=False,
+                                 max_num_workers=4, partition=None,
+                                 quotatype=None, retry=0, num_devices=None,
+                                 work_dir=None, lark=False, profile=False,
+                                 obs=False, obs_port=None,
+                                 config=osp.join(
+                                     REPO, 'configs/eval_demo.py'),
+                                 use_workers=False)
+    cfg = get_config_from_arg(args)
+    assert cfg['use_workers'] is False
+    runner = _build_runner('OpenICLInferTask', args, cfg)
+    assert runner.use_workers is False
+    args.use_workers = None  # default: auto
+    cfg2 = get_config_from_arg(args)
+    assert 'use_workers' not in cfg2
+    assert _build_runner('OpenICLInferTask', args, cfg2).use_workers is None
+
+
+# -- bench glue ------------------------------------------------------------
+
+def test_bench_warm_path_child_smoke(tmp_path):
+    """The bench's cold-start child prints one JSON perf record."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    r = subprocess.run(
+        [sys.executable, osp.join(REPO, 'bench.py'), '--warm-path-child',
+         str(tmp_path / 'xla')],
+        capture_output=True, text=True, timeout=300, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr[-500:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec['compile_cache_misses'] > 0
+    assert rec['model_build_seconds'] > 0
