@@ -334,6 +334,128 @@ def _bench_warm_path(out_json='BENCH_WARM.json'):
     return record
 
 
+def _result_cache_child(cache_root, work_dir):
+    """One infer sweep of the demo config (fresh interpreter) against
+    the shared result-store cache root, with obs on.  Prints one JSON
+    line: wall, task count, device batches executed, store activity —
+    the parent diffs cold vs warm."""
+    import os.path as osp
+
+    os.environ['OCT_CACHE_ROOT'] = cache_root
+    from opencompass_tpu import obs
+    from opencompass_tpu.config import Config
+    from opencompass_tpu.partitioners import SizePartitioner
+    from opencompass_tpu.runners import LocalRunner
+    cfg = Config.fromfile(
+        osp.join(osp.dirname(osp.abspath(__file__)),
+                 'configs/eval_demo.py'))
+    cfg['work_dir'] = work_dir
+    cfg['obs'] = True
+    tracer = obs.init_obs(work_dir, enabled=True)
+    t0 = time.perf_counter()
+    part = SizePartitioner(osp.join(work_dir, 'predictions/'),
+                           dataset_size_path=osp.join(work_dir,
+                                                      'size.json'))
+    tasks = part(cfg)
+    failed = 0
+    if tasks:
+        status = LocalRunner(task=dict(type='OpenICLInferTask'),
+                             debug=True)(tasks)
+        failed = sum(1 for _, rc in status if rc != 0)
+    wall = time.perf_counter() - t0
+    tracer.flush_metrics()
+    tracer.close()
+    counters = {}
+    with open(osp.join(work_dir, 'obs', 'events.jsonl')) as f:
+        for line in f:
+            try:
+                ev = json.loads(line)
+            except ValueError:
+                continue
+            if ev.get('kind') == 'metrics':
+                counters = (ev.get('attrs') or {}).get('counters') or {}
+    batches = sum(counters.get(k, 0)
+                  for k in ('inferencer.gen_batches',
+                            'inferencer.ppl_batches',
+                            'inferencer.clp_batches'))
+    print(json.dumps({
+        'wall_seconds': round(wall, 3), 'n_tasks': len(tasks),
+        'failed': failed, 'device_batches': batches,
+        'store_hits': counters.get('store.hits', 0),
+        'store_misses': counters.get('store.misses', 0),
+        'store_commits': counters.get('store.commits', 0),
+        'pruned_rows': counters.get('store.pruned_rows', 0),
+    }))
+
+
+def _bench_result_cache(out_json='BENCH_STORE.json'):
+    """detail.result_cache: the same FakeModel sweep three times, each a
+    fresh interpreter, sharing one result store:
+
+    - cold: empty store, every row executes and commits;
+    - warm_prune: identical rerun — the partitioner materializes every
+      prediction file pre-launch and emits ZERO tasks;
+    - warm_rows: unit manifests removed — tasks launch but every row is
+      served from the store (zero device batches).
+
+    Written to ``BENCH_STORE.json`` so the perf trajectory accumulates
+    round over round."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    cache_root = tempfile.mkdtemp(prefix='oct_store_cache_')
+    here = os.path.abspath(__file__)
+
+    def child(tag):
+        work = tempfile.mkdtemp(prefix=f'oct_store_{tag}_')
+        r = subprocess.run(
+            [sys.executable, here, '--result-cache-child', cache_root,
+             work],
+            capture_output=True, text=True, timeout=900,
+            cwd=os.path.dirname(here),
+            env=dict(os.environ, JAX_PLATFORMS='cpu'))
+        if r.returncode != 0:
+            return {'error': (r.stderr or r.stdout)[-500:]}
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    cold = child('cold')
+    warm_prune = child('warm_prune')
+    if 'error' not in warm_prune:
+        shutil.rmtree(os.path.join(cache_root, 'store', 'units'),
+                      ignore_errors=True)
+    warm_rows = child('warm_rows')
+    hits = warm_rows.get('store_hits', 0)
+    misses = warm_rows.get('store_misses', 0)
+    record = {
+        'v': 1,
+        'workload': 'FakeModel demo sweep (gen 16 rows + ppl 8x2 rows), '
+                    'three fresh processes sharing one result store',
+        'cold': cold,
+        'warm_prune': warm_prune,
+        'warm_rows': warm_rows,
+        'cold_batches': cold.get('device_batches'),
+        'warm_rows_batches': warm_rows.get('device_batches'),
+        'warm_rows_hit_rate': round(hits / (hits + misses), 4)
+        if hits + misses else None,
+        'prune_tasks_cold_vs_warm': [cold.get('n_tasks'),
+                                     warm_prune.get('n_tasks')],
+        'wall_speedup_prune': round(
+            cold.get('wall_seconds', 0.0)
+            / max(warm_prune.get('wall_seconds', 0.0), 1e-3), 2),
+        'wall_speedup_rows': round(
+            cold.get('wall_seconds', 0.0)
+            / max(warm_rows.get('wall_seconds', 0.0), 1e-3), 2),
+    }
+    try:
+        with open(os.path.join(os.path.dirname(here), out_json),
+                  'w') as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
+    return record
+
+
 def main():
     n_chips = max(1, len(jax.devices()))
     kind = getattr(jax.devices()[0], 'device_kind', '')
@@ -629,6 +751,7 @@ def main():
             'shared_prefix': shared_leg,
             'batch_planner': _bench_planner(),
             'warm_path': _bench_warm_path(),
+            'result_cache': _bench_result_cache(),
             'a100_est': a100,
             'a100_est_b32': a100_b32,
             'small': {
@@ -652,5 +775,14 @@ if __name__ == '__main__':
         # standalone warm-path leg (device-free; runs on CPU hosts)
         print(json.dumps({'metric': 'warm_path', 'v': 1,
                           'detail': _bench_warm_path()}))
+        sys.exit(0)
+    if '--result-cache-child' in sys.argv:
+        i = sys.argv.index('--result-cache-child')
+        _result_cache_child(sys.argv[i + 1], sys.argv[i + 2])
+        sys.exit(0)
+    if '--result-cache' in sys.argv:
+        # standalone result-store leg (device-free; runs on CPU hosts)
+        print(json.dumps({'metric': 'result_cache', 'v': 1,
+                          'detail': _bench_result_cache()}))
         sys.exit(0)
     main()

@@ -13,10 +13,14 @@ Usage::
     python run.py cfg.py --obs                      # run-wide tracing
     python run.py cfg.py --obs --obs-port 9464      # + live /metrics HTTP
     python run.py cfg.py --no-workers               # one subprocess per task
+    python run.py cfg.py --no-result-cache          # skip the result store
     python -m opencompass_tpu.cli trace WORK_DIR    # render trace report
     python -m opencompass_tpu.cli status WORK_DIR --watch   # live progress
     python -m opencompass_tpu.cli plan cfg.py       # batch-plan dry run
     python -m opencompass_tpu.cli plan cfg.py --cache-dir DIR  # warm/cold probe
+    python -m opencompass_tpu.cli cache stats WORK_DIR      # result store
+    python -m opencompass_tpu.cli cache verify WORK_DIR     # integrity (CI)
+    python -m opencompass_tpu.cli cache gc WORK_DIR --max-bytes N
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files
@@ -123,6 +127,16 @@ def parse_args():
                         'with `python -m opencompass_tpu.cli trace '
                         '<work_dir>`); config key `obs = True` is '
                         'equivalent')
+    parser.add_argument('--no-result-cache',
+                        action='store_false',
+                        default=None,
+                        dest='result_cache',
+                        help='disable the content-addressed result '
+                        'store: rows are neither served from nor '
+                        'committed to {cache_root}/store/ and the '
+                        'partitioners skip pre-launch pruning '
+                        '(docs/user_guides/caching.md).  Default: on '
+                        'whenever a cache root resolves')
     parser.add_argument('--obs-port',
                         type=int,
                         default=None,
@@ -150,6 +164,9 @@ def get_config_from_arg(args) -> Config:
         cfg['obs'] = True
     if args.use_workers is not None:
         cfg['use_workers'] = args.use_workers
+    # getattr: tests drive this with hand-built namespaces
+    if getattr(args, 'result_cache', None) is not None:
+        cfg['result_cache'] = args.result_cache
     return cfg
 
 
@@ -223,6 +240,14 @@ def plan_main(argv=None) -> int:
     return preview_main(argv)
 
 
+def cache_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli cache stats|gc|verify`` —
+    inspect, garbage-collect, or integrity-check the content-addressed
+    result store under ``{cache_root}/store/``."""
+    from opencompass_tpu.store.cli import main as store_main
+    return store_main(argv)
+
+
 def main():
     # subcommand dispatch before the run-config parser: `trace`/`status`
     # take a work_dir, not a config file
@@ -232,6 +257,8 @@ def main():
         raise SystemExit(status_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'plan':
         raise SystemExit(plan_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == 'cache':
+        raise SystemExit(cache_main(sys.argv[2:]))
     args = parse_args()
     cfg = get_config_from_arg(args)
     work_dir = cfg['work_dir']

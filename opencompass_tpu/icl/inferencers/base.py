@@ -110,6 +110,17 @@ class BaseInferencer:
             token_budget=self.token_budget, groups=groups,
             exclusive_groups=exclusive_groups)
 
+    # -- result store ------------------------------------------------------
+
+    def result_store(self, kind: str, params=None):
+        """StoreContext scoped to this (model, kind, params), or None
+        when no store is bound (untracked run, API model,
+        ``--no-result-cache``).  Inferencers consult it *before*
+        planning so cached rows never enter device batches, and commit
+        rows as batches complete so a killed run resumes across runs."""
+        from opencompass_tpu.store import context_for
+        return context_for(self.model, kind, params)
+
     def run_plan(self, plan: schedule.BatchPlan, dispatch, collect) -> float:
         """Execute a plan (double-buffered when planning is on) and
         charge overlap/shape telemetry to the model's perf counters and

@@ -13,6 +13,7 @@ import time
 from typing import List, Optional
 
 from opencompass_tpu.obs import get_tracer, observe_batch
+from opencompass_tpu.parallel.distributed import broadcast_object
 from opencompass_tpu.registry import ICL_INFERENCERS
 from opencompass_tpu.utils.logging import get_logger
 
@@ -95,16 +96,53 @@ class CLPInferencer(BaseInferencer):
 
         logger.info('Calculating conditional log probability for prompts.')
         obs_on = get_tracer().enabled
-        if self.plan_enabled and prompt_list:
-            lengths = self.measure_lengths(prompt_list, 'gen',
-                                           cap=self.max_seq_len)
+
+        def save_row(index, prompt, probs):
+            ice_str = str(
+                self.model.parse_template(ice[index], mode='gen'))
+            output_handler.save_prompt_and_condprob(
+                prompt.replace(ice_str, ''), prompt, list(probs), index,
+                choices)
+
+        # result store: cached rows are saved directly and only the
+        # misses are planned/executed (rank-0 lookup + broadcast so a
+        # multi-host group plans identically); executed rows commit per
+        # batch on rank 0
+        ctx = self.result_store('clp', {'choices': list(choices)})
+        row_keys = None
+        commit = ctx is not None and self.is_main_process
+        miss = list(range(len(prompt_list)))
+        if ctx is not None and prompt_list:
+            hits = None
+            if self.is_main_process:
+                rendered = self.model.parse_template(prompt_list,
+                                                     mode='gen')
+                row_keys = [ctx.key(str(p)) for p in rendered]
+                hits = {}
+                for i, key in enumerate(row_keys):
+                    cached = ctx.get(key)
+                    if cached is not None:
+                        hits[i] = (rendered[i], cached)
+            hits = broadcast_object(hits) or {}
+            for i, (prompt, cached) in hits.items():
+                save_row(i, prompt, cached)
+            miss = [i for i in range(len(prompt_list)) if i not in hits]
+        n_hits = len(prompt_list) - len(miss)
+        if obs_on and n_hits:
+            from opencompass_tpu.obs import get_heartbeat
+            get_heartbeat().progress(n_hits, len(prompt_list),
+                                     force=True)
+        if self.plan_enabled and miss:
+            lengths = self.measure_lengths(
+                [prompt_list[i] for i in miss], 'gen',
+                cap=self.max_seq_len)
         else:
-            lengths = [1] * len(prompt_list)
+            lengths = [1] * len(miss)
         plan = self.make_plan(lengths, seq_cap=self.max_seq_len)
-        state = {'done': 0}
+        state = {'done': n_hits}
 
         def dispatch(batch):
-            sub_prompts = [prompt_list[p] for p in batch.indices]
+            sub_prompts = [prompt_list[miss[p]] for p in batch.indices]
             parsed = self.model.parse_template(sub_prompts, mode='gen')
             t0 = time.perf_counter() if obs_on else 0.0
             fn = getattr(self.model, 'get_choice_logprobs_async', None)
@@ -122,12 +160,11 @@ class CLPInferencer(BaseInferencer):
             if obs_on:
                 observe_batch('inferencer.clp_batches', t0,
                               done=state['done'], total=len(prompt_list))
-            for index, res, prompt in zip(batch.indices, probs, parsed):
-                ice_str = str(
-                    self.model.parse_template(ice[index], mode='gen'))
-                output_handler.save_prompt_and_condprob(
-                    prompt.replace(ice_str, ''), prompt, list(res), index,
-                    choices)
+            for pos, res, prompt in zip(batch.indices, probs, parsed):
+                index = miss[pos]
+                save_row(index, prompt, res)
+                if commit:
+                    ctx.put(row_keys[index], list(res))
 
         # out-of-order collection is safe here: save_ice pre-created
         # every index's entry in item order, and collect only fills

@@ -100,6 +100,35 @@ class GenInferencer(BaseInferencer):
                 handler.results_dict[str(idx)] = record
         todo = [i for i in range(len(prompts)) if i not in done_idx]
 
+        # content-addressed result store: any identical row evaluated by
+        # ANY previous run (this work_dir or another) is served from
+        # disk here, before planning, so cached rows never enter device
+        # batches; misses are committed per batch in collect() below,
+        # which is what makes a kill -9 resumable across runs.  Like
+        # _resume, the lookup is rank-0-read + broadcast so every
+        # process in a multi-host group plans the same misses; only
+        # rank 0 commits.
+        ctx = self.result_store('gen', self._store_params())
+        row_keys = {}
+        if ctx is not None and todo:
+            hits = None
+            if self.is_main_process:
+                rendered = self.model.parse_template(
+                    [prompts[i] for i in todo], mode='gen')
+                hits = {}
+                for i, shown in zip(todo, rendered):
+                    key = ctx.key(str(shown))
+                    row_keys[i] = key
+                    cached = ctx.get(key)
+                    if cached is not None:
+                        hits[i] = (shown, cached)
+            hits = broadcast_object(hits) or {}
+            for i, (shown, cached) in hits.items():
+                handler.save_results(shown, cached, i)
+                done_idx.add(i)
+            todo = [i for i in todo if i not in hits]
+        commit = ctx is not None and self.is_main_process
+
         logger.info('Starting inference process...')
         # hoisted once: the per-batch obs cost is one bool check when
         # tracing is off
@@ -142,6 +171,8 @@ class GenInferencer(BaseInferencer):
             for pos, text, completion in zip(batch.indices, shown,
                                              completions):
                 handler.save_results(text, completion, todo[pos])
+                if commit:
+                    ctx.put(row_keys[todo[pos]], completion)
             # flush on completed-count distance, not modulo: batch sizes
             # that don't divide save_every must still flush
             if (self.save_every is not None and self.is_main_process
@@ -175,6 +206,16 @@ class GenInferencer(BaseInferencer):
         if self.is_main_process and osp.exists(scratch_path):
             partial = load_results_dict(scratch_path)
         return broadcast_object(partial) or {}
+
+    def _store_params(self) -> dict:
+        """The result-relevant inference params folded into this
+        inferencer's store namespace — anything that changes a row's
+        output for the same rendered prompt must appear here."""
+        return {
+            'max_out_len': self.max_out_len,
+            'generation_kwargs':
+                getattr(self.model, 'generation_kwargs', None) or {},
+        }
 
     def _generate_batch(self, entry, parsed_entries) -> List[str]:
         """One batched model call; the hook GLMChoiceInferencer overrides."""
@@ -257,6 +298,10 @@ class GLMChoiceInferencer(GenInferencer):
     def __init__(self, *args, choices=('A', 'B', 'C', 'D'), **kwargs):
         super().__init__(*args, **kwargs)
         self.choices = list(choices)
+
+    def _store_params(self) -> dict:
+        # the choice set changes the prediction for the same prompt
+        return dict(super()._store_params(), choices=self.choices)
 
     def _generate_batch(self, entry, parsed_entries) -> List[str]:
         inputs = parsed_entries

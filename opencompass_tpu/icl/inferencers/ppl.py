@@ -23,6 +23,7 @@ from typing import List, Optional
 import numpy as np
 
 from opencompass_tpu.obs import get_heartbeat, get_tracer, observe_batch
+from opencompass_tpu.parallel.distributed import broadcast_object
 from opencompass_tpu.registry import ICL_INFERENCERS
 from opencompass_tpu.utils.logging import get_logger
 
@@ -185,32 +186,75 @@ class PPLInferencer(BaseInferencer):
                         head, mode='ppl'),
                     normalizer=normalizing_str + answer)
 
+    def _row_keys(self, ctx, rows) -> List[str]:
+        """Store keys for a list of rows: rendered prompt plus the
+        per-row extras that change the score (normalizing-mode context
+        mask and normalizer text)."""
+        parsed = self.model.parse_template([r.prompt for r in rows],
+                                           mode='ppl')
+        return [ctx.key(str(p), extra=[r.context_tokens, r.normalizer])
+                for p, r in zip(parsed, rows)]
+
     def _score_item_major(self, rows_by_label, labels, n_items: int,
                           n_rows: int):
         """One batch per item (its label variants — indivisible, so the
         shared-prefix prefill reuse keeps its deep common prefix), in a
-        planned shape-minimizing order with scores scattered back."""
+        planned shape-minimizing order with scores scattered back.
+        Fully-cached items (every label row in the result store) never
+        enter the plan; executed scores commit per item."""
         obs_on = get_tracer().enabled
         n_labels = len(labels)
         score_table = [[0.0] * n_items for _ in labels]
-        # flat row space (li * n_items + idx) with one indivisible group
-        # per item, so plan stats see the real device batches
-        if self.plan_enabled and n_items:
-            lengths = [0] * (n_labels * n_items)
+        ctx = self.result_store('ppl', {'normalizing_str': None})
+        keys = None   # [label][item] -> store key (rank 0 only)
+        commit = ctx is not None and self.is_main_process
+        todo_items = list(range(n_items))
+        if ctx is not None and n_items:
+            # rank-0 lookup + broadcast: every process in a multi-host
+            # group must plan the same item set (same collective count)
+            hits = None
+            if self.is_main_process:
+                keys = [self._row_keys(ctx, rows_by_label[li])
+                        for li in range(n_labels)]
+                hits = {}
+                for idx in range(n_items):
+                    cached = [ctx.get(keys[li][idx])
+                              for li in range(n_labels)]
+                    # the item batch is indivisible: one cold label
+                    # re-executes the item (recommits are suppressed)
+                    if all(c is not None for c in cached):
+                        hits[idx] = [float(c) for c in cached]
+            hits = broadcast_object(hits) or {}
+            for idx, cached in hits.items():
+                for li in range(n_labels):
+                    score_table[li][idx] = cached[li]
+            todo_items = [idx for idx in range(n_items)
+                          if idx not in hits]
+        n_todo = len(todo_items)
+        done_rows = n_rows - n_labels * n_todo
+        if obs_on:
+            # cached rows count as done from the first heartbeat
+            get_heartbeat().progress(done_rows, n_rows, force=True)
+        # compact flat row space (li * n_todo + ti) over store misses
+        # with one indivisible group per item, so plan stats see the
+        # real device batches
+        if self.plan_enabled and n_todo:
+            lengths = [0] * (n_labels * n_todo)
             for li in range(n_labels):
                 got = self.measure_lengths(
-                    [r.prompt for r in rows_by_label[li]], 'ppl')
-                lengths[li * n_items:(li + 1) * n_items] = got
+                    [rows_by_label[li][i].prompt for i in todo_items],
+                    'ppl')
+                lengths[li * n_todo:(li + 1) * n_todo] = got
         else:
-            lengths = [1] * (n_labels * n_items)
-        groups = [[li * n_items + idx for li in range(n_labels)]
-                  for idx in range(n_items)]
+            lengths = [1] * (n_labels * n_todo)
+        groups = [[li * n_todo + ti for li in range(n_labels)]
+                  for ti in range(n_todo)]
         plan = self.make_plan(lengths, groups=groups,
                               exclusive_groups=True)
-        state = {'done': 0}
+        state = {'done': done_rows}
 
         def dispatch(batch):
-            idx = batch.indices[0] % n_items
+            idx = todo_items[batch.indices[0] % n_todo]
             prompts = [rows_by_label[li][idx].prompt
                        for li in range(n_labels)]
             t0 = time.perf_counter() if obs_on else 0.0
@@ -219,9 +263,11 @@ class PPLInferencer(BaseInferencer):
 
         def collect(batch, result):
             got, t0 = result
-            idx = batch.indices[0] % n_items
+            idx = todo_items[batch.indices[0] % n_todo]
             for li in range(n_labels):
                 score_table[li][idx] = float(got[li])
+                if commit:
+                    ctx.put(keys[li][idx], float(got[li]))
             state['done'] += n_labels
             if obs_on:
                 observe_batch('inferencer.ppl_batches', t0,
@@ -240,14 +286,41 @@ class PPLInferencer(BaseInferencer):
                 normalizing_str, mode='ppl')
         obs_on = get_tracer().enabled
         scores: List[float] = [0.0] * len(rows)
-        if self.plan_enabled and rows:
-            lengths = self.measure_lengths([r.prompt for r in rows], 'ppl')
+        # result store: cached rows are filled directly and only the
+        # misses are planned/executed (rank-0 lookup + broadcast so a
+        # multi-host group plans identically); executed scores commit
+        # per batch on rank 0
+        ctx = self.result_store('ppl',
+                                {'normalizing_str': normalizing_str})
+        keys = None
+        commit = ctx is not None and self.is_main_process
+        miss = list(range(len(rows)))
+        if ctx is not None and rows:
+            hits = None
+            if self.is_main_process:
+                keys = self._row_keys(ctx, rows)
+                hits = {}
+                for i, key in enumerate(keys):
+                    cached = ctx.get(key)
+                    if cached is not None:
+                        hits[i] = float(cached)
+            hits = broadcast_object(hits) or {}
+            for i, val in hits.items():
+                scores[i] = val
+            miss = [i for i in range(len(rows)) if i not in hits]
+            if obs_on and hits:
+                # cached rows count as done (inference() seeded the
+                # unit's done/total)
+                get_heartbeat().add(len(hits))
+        if self.plan_enabled and miss:
+            lengths = self.measure_lengths(
+                [rows[i].prompt for i in miss], 'ppl')
         else:
-            lengths = [1] * len(rows)
+            lengths = [1] * len(miss)
         plan = self.make_plan(lengths)
 
         def dispatch(batch):
-            chunk = [rows[p] for p in batch.indices]
+            chunk = [rows[miss[p]] for p in batch.indices]
             prompts = [r.prompt for r in chunk]
             t0 = time.perf_counter() if obs_on else 0.0
             if normalizing_str is None:
@@ -264,7 +337,9 @@ class PPLInferencer(BaseInferencer):
         def collect(batch, result):
             got, t0 = result
             for pos, val in zip(batch.indices, got):
-                scores[pos] = float(val)
+                scores[miss[pos]] = float(val)
+                if commit:
+                    ctx.put(keys[miss[pos]], float(val))
             if obs_on:
                 observe_batch('inferencer.ppl_batches', t0)
                 # label-major scoring only knows per-chunk increments;

@@ -267,6 +267,12 @@ class BaseModel(abc.ABC):
     # original indices, so per-row outputs are unchanged).  API models
     # keep arrival order; JaxLM turns this on.
     supports_batch_plan: bool = False
+    # eligibility for the content-addressed result store
+    # (opencompass_tpu/store/): True means this model's outputs are pure
+    # functions of (prompt, params), so a row evaluated once may be
+    # served from disk forever.  API models opt out — sampled
+    # completions and provider-side drift break the purity assumption.
+    supports_result_cache: bool = True
 
     def __init__(self,
                  path: str,

@@ -140,6 +140,9 @@ class BaseAPIModel(BaseModel):
     """
 
     is_api: bool = True
+    # API completions are not pure functions of the prompt (sampling,
+    # provider-side model drift) — never serve them from the result store
+    supports_result_cache: bool = False
 
     def __init__(self,
                  path: str,

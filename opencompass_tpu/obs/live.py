@@ -28,10 +28,14 @@ import json
 import os
 import os.path as osp
 import re
-import tempfile
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
+
+# canonical home is utils/fileio.py (obs/ may depend on utils/, not the
+# reverse); re-exported here because instrumented code historically
+# imported it from the obs plane
+from opencompass_tpu.utils.fileio import atomic_write_json  # noqa: F401
 
 HEARTBEAT_VERSION = 1
 STATUS_VERSION = 1
@@ -54,24 +58,6 @@ def heartbeat_path(obs_dir: str, task_name: str) -> str:
     safe = re.sub(r'[^\w.\-]+', '_', task_name)[:80]
     digest = hashlib.sha1(task_name.encode('utf-8')).hexdigest()[:8]
     return osp.join(obs_dir, PROGRESS_SUBDIR, f'{safe}-{digest}.json')
-
-
-def atomic_write_json(path: str, obj: Dict):
-    """Write ``obj`` to ``path`` so readers only ever see a complete
-    file: temp file in the same directory, fsync-free ``os.replace``."""
-    dirname = osp.dirname(osp.abspath(path))
-    os.makedirs(dirname, exist_ok=True)
-    fd, tmp = tempfile.mkstemp(dir=dirname, suffix='.tmp')
-    try:
-        with os.fdopen(fd, 'w', encoding='utf-8') as f:
-            json.dump(obj, f, separators=(',', ':'), default=str)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
 
 
 class NoopHeartbeat:

@@ -242,7 +242,7 @@ class ObsHTTPServer:
         """``{obs_dir}/http.json`` lets tooling (and the e2e smoke
         test) discover an ephemeral port."""
         try:
-            from opencompass_tpu.obs.live import atomic_write_json
+            from opencompass_tpu.utils.fileio import atomic_write_json
             atomic_write_json(
                 osp.join(self.obs_dir, HTTP_INFO_FILE),
                 {'port': self.port, 'pid': os.getpid(),

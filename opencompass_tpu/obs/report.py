@@ -164,7 +164,8 @@ def _subtree_perf(root: _SpanNode) -> Dict[str, float]:
                         'samples', 'device_calls', 'pad_tokens',
                         'overlap_seconds', 'planned_shapes',
                         'first_calls', 'compile_cache_hits',
-                        'compile_cache_misses'):
+                        'compile_cache_misses', 'store_hits',
+                        'store_misses', 'store_commits'):
                 val = perf.get(key)
                 if isinstance(val, (int, float)):
                     out[key] += val
@@ -235,6 +236,16 @@ def build_report(work_dir: str, trace: Optional[str] = None) -> Dict:
             'compile_cache_hits': int(perf.get('compile_cache_hits', 0)),
             'compile_cache_misses': int(
                 perf.get('compile_cache_misses', 0)),
+            # result-store activity: hit rows were served from disk and
+            # never reached the device
+            'store_hits': int(perf.get('store_hits', 0)),
+            'store_misses': int(perf.get('store_misses', 0)),
+            'hit_rate': round(
+                perf.get('store_hits', 0)
+                / (perf.get('store_hits', 0)
+                   + perf.get('store_misses', 0)), 4)
+            if perf.get('store_hits', 0) + perf.get('store_misses', 0)
+            else None,
             'overlap_seconds': round(
                 perf.get('overlap_seconds', 0.0), 3),
             'retries': int(n.attrs.get('retries', 0)),
@@ -396,6 +407,14 @@ def render_summary(report: Dict) -> str:
     if cc_hits or cc_miss:
         lines.append(f'compile cache: {cc_hits} hit(s), {cc_miss} '
                      'cold compile(s)')
+    st_hits = sum(t.get('store_hits', 0) for t in report['tasks'])
+    st_miss = sum(t.get('store_misses', 0) for t in report['tasks'])
+    pruned = m['counters'].get('store.pruned_rows', 0)
+    if st_hits or st_miss or pruned:
+        rate = st_hits / (st_hits + st_miss) if st_hits + st_miss else 1.0
+        lines.append(f'result store: {st_hits} row hit(s), {st_miss} '
+                     f'miss(es) ({rate:.0%} hit rate), {pruned} row(s) '
+                     'pruned pre-launch')
     util = report['slot_utilization']
     if util['overall'] is not None:
         lines.append(f"slot utilization {util['overall']:.0%} over "
@@ -429,7 +448,8 @@ def render_report(report: Dict) -> str:
     if report['tasks']:
         rows = [['task', 'wall_s', 'wait_s', 'compile_s', 'device_s',
                  'steady_s', 'pad_eff', 'shapes', 'cc_hit/miss',
-                 'overlap_s', 'retries', 'devices', 'status']]
+                 'hit_rate', 'overlap_s', 'retries', 'devices',
+                 'status']]
         for t in report['tasks']:
             shapes = '-'
             if t.get('planned_shapes') or t.get('dispatched_shapes'):
@@ -440,12 +460,16 @@ def render_report(report: Dict) -> str:
                     'compile_cache_misses'):
                 cc = (f"{t.get('compile_cache_hits', 0)}/"
                       f"{t.get('compile_cache_misses', 0)}")
+            hit_rate = '-'
+            if t.get('hit_rate') is not None:
+                hit_rate = f"{t['hit_rate']:.0%}"
             rows.append([t['name'][:60], t['wall_seconds'],
                          t['wait_seconds'], t['compile_seconds'],
                          t['device_seconds'], t['steady_device_seconds'],
                          t.get('pad_eff') if t.get('pad_eff') is not None
                          else '-',
-                         shapes, cc, t.get('overlap_seconds', 0.0),
+                         shapes, cc, hit_rate,
+                         t.get('overlap_seconds', 0.0),
                          t['retries'],
                          ','.join(map(str, t['devices'])) or '-',
                          t['status']])

@@ -19,6 +19,12 @@ class BasePartitioner:
     def __init__(self, out_dir: str):
         self.logger = get_logger()
         self.out_dir = out_dir
+        # result-store prune state for this partition pass (set up per
+        # __call__; partition() implementations consult try_materialize
+        # at their output-existence checks)
+        self._store = None
+        self._pruned_tasks = 0
+        self._pruned_rows = 0
 
     def __call__(self, cfg: Dict) -> List[Dict]:
         """cfg has ``models``, ``datasets``, ``work_dir``; returns a list of
@@ -28,10 +34,17 @@ class BasePartitioner:
         models = cfg['models']
         datasets = cfg['datasets']
         work_dir = cfg['work_dir']
+        self._setup_store_prune(cfg, work_dir)
         tasks = self.partition(models, datasets, work_dir, self.out_dir)
+        if self._pruned_tasks:
+            self.logger.info(
+                f'result store: pruned {self._pruned_tasks} fully-cached '
+                f'task(s) ({self._pruned_rows} row(s) materialized '
+                'pre-launch)')
         # shared run-level switches every task inherits ('obs' rides along
-        # so subprocess tasks re-enable tracing from their own config)
-        for key in ('profile', 'obs'):
+        # so subprocess tasks re-enable tracing from their own config;
+        # 'result_cache' so --no-result-cache reaches subprocess tasks)
+        for key in ('profile', 'obs', 'result_cache'):
             if key in cfg:
                 for task in tasks:
                     task[key] = cfg[key]
@@ -56,6 +69,55 @@ class BasePartitioner:
         for i, task in enumerate(tasks):
             self.logger.debug(f'Task {i}: {task}')
         return tasks
+
+    # -- result-store pre-launch prune -------------------------------------
+
+    def _setup_store_prune(self, cfg: Dict, work_dir: str):
+        """Open the sweep result store for this pass when pruning makes
+        sense: infer-phase out_dir (predictions), cache enabled.  Never
+        raises — a broken store just disables pruning."""
+        self._store = None
+        self._pruned_tasks = 0
+        self._pruned_rows = 0
+        import os.path as osp
+        if osp.basename(osp.normpath(self.out_dir)) != 'predictions':
+            return   # eval-phase partitioning reuses result files as-is
+        try:
+            from opencompass_tpu import store as storemod
+            if not storemod.result_cache_enabled(cfg):
+                return
+            self._store = storemod.open_store(work_dir)
+        except Exception:
+            self._store = None
+
+    def try_materialize(self, model_cfg: Dict, dataset_cfg: Dict,
+                        filename: str) -> bool:
+        """Prune hook for partition() existence checks: when the whole
+        (model, dataset) unit is in the result store, write its
+        prediction file here and now — the caller's ``exists`` protocol
+        then skips the task before any launch.  Stamps the expected hit
+        count for the trace report."""
+        if self._store is None:
+            return False
+        from opencompass_tpu.store import materialize_unit
+        n_rows = materialize_unit(self._store, model_cfg, dataset_cfg,
+                                  filename)
+        if n_rows is None:
+            return False
+        self._pruned_tasks += 1
+        self._pruned_rows += n_rows
+        from opencompass_tpu.obs import get_tracer
+        tracer = get_tracer()
+        if tracer.enabled:
+            from opencompass_tpu.utils.abbr import (dataset_abbr_from_cfg,
+                                                    model_abbr_from_cfg)
+            tracer.event('store_prune',
+                         model=model_abbr_from_cfg(model_cfg),
+                         dataset=dataset_abbr_from_cfg(dataset_cfg),
+                         expected_hits=n_rows)
+            tracer.counter('store.pruned_tasks').inc()
+            tracer.counter('store.pruned_rows').inc(n_rows)
+        return True
 
     @abstractmethod
     def partition(self, models: List[ConfigDict], datasets: List[ConfigDict],

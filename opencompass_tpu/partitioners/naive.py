@@ -21,7 +21,10 @@ class NaivePartitioner(BasePartitioner):
         for model in models:
             for dataset in datasets:
                 filename = get_infer_output_path(model, dataset, out_dir)
-                if osp.exists(filename):
+                # a fully-cached pair materializes from the result store
+                # here, then skips through the normal exists protocol
+                if osp.exists(filename) \
+                        or self.try_materialize(model, dataset, filename):
                     continue
                 tasks.append({
                     'models': [model],

@@ -55,14 +55,20 @@ class SizePartitioner(BasePartitioner):
             chunks = []  # (cost, dataset(s)) pending bin-packing
             for dataset in datasets:
                 filename = get_infer_output_path(model, dataset, out_dir)
-                if osp.exists(filename):
+                # fully-cached pairs/shards materialize from the result
+                # store and skip through the normal exists protocol
+                if osp.exists(filename) \
+                        or self.try_materialize(model, dataset, filename):
                     continue
                 dataset_size = self.get_cost(dataset)
                 if dataset_size > self.max_task_size:
                     root, ext = osp.splitext(filename)
                     dataset_splits = self.split_dataset(dataset)
                     for i, dataset_split in enumerate(dataset_splits):
-                        if not osp.exists(f'{root}_{i}{ext}'):
+                        shard_file = f'{root}_{i}{ext}'
+                        if not osp.exists(shard_file) \
+                                and not self.try_materialize(
+                                    model, dataset_split, shard_file):
                             chunks.append((self.max_task_size,
                                            dataset_split))
                 else:

@@ -99,6 +99,34 @@ class BaseRunner:
     def launch(self, tasks: List[Dict]) -> List[Tuple[str, int]]:
         """Launch all tasks; return (task_name, returncode) pairs."""
 
+    def oct_env_exports(self) -> str:
+        """Shell-safe ``K=V`` assignments propagating the run's OCT_*
+        state to a cluster-launched task: trace id / parent span / obs
+        dir (from the live tracer) plus the sweep cache roots (compile
+        cache, result cache) from the driver's environment.
+
+        Cluster task processes run on other hosts with fresh shells, so
+        driver ``os.environ`` exports never reach them implicitly — a
+        slurm/dlc sweep would silently run untraced with cold compile
+        caches and no result store.  Callers splice the returned string
+        into the task command via ``env`` (empty string = nothing to
+        propagate).  Task spans parent on the runner span (the per-task
+        span id is not known at command-build time); the trace report
+        nests them one level up, which beats losing them entirely."""
+        import shlex
+        pairs = {}
+        tracer = get_tracer()
+        if tracer.enabled:
+            pairs.update(tracer.propagation_env(
+                getattr(self, '_runner_span', None)))
+        for key in ('OCT_CACHE_ROOT', 'OCT_COMPILE_CACHE',
+                    'JAX_COMPILATION_CACHE_DIR', 'OCT_RESULT_CACHE',
+                    'OCT_STORE_MAX_BYTES'):
+            if os.environ.get(key):
+                pairs.setdefault(key, os.environ[key])
+        return ' '.join(f'{k}={shlex.quote(str(v))}'
+                        for k, v in sorted(pairs.items()))
+
     def build_task(self, task_cfg: Dict) -> Any:
         type_cfg = dict(self.task_cfg)
         cls = type_cfg.pop('type')

@@ -85,6 +85,13 @@ class CloudRunner(BaseRunner):
             # through untouched
             task_cmd = task.get_command(cfg_path=tmp.name,
                                         template='{task_cmd}')
+            # OCT_* propagation (trace + cache roots): the worker runs
+            # on a remote host with a fresh shell, so the exports must
+            # travel *inside* the submitted command (for DLC, inside
+            # the --command string), not in the submit host env
+            exports = self.oct_env_exports()
+            if exports:
+                task_cmd = f'env {exports} {task_cmd}'
             cmd = (self.submit_template
                    .replace('{name}', safe_name)
                    .replace('{num_devices}', str(task.num_devices))

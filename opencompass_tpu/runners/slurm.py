@@ -80,7 +80,15 @@ class SlurmRunner(BaseRunner):
             mode='w', suffix='_params.py', delete=False)
         try:
             task.cfg.dump(tmp.name)
-            template = self._srun_prefix(task) + ' {task_cmd}'
+            # OCT_* propagation (trace + cache roots) must ride inside
+            # the srun allocation: the compute node's shell does not
+            # inherit the submit host's environment reliably, and the
+            # PR 4 compile cache / result store silently disable without
+            # their env.  `env K=V ... python` keeps srun's argv exec
+            # (no shell on the node) working.
+            exports = self.oct_env_exports()
+            wrap = f'env {exports} ' if exports else ''
+            template = self._srun_prefix(task) + ' ' + wrap + '{task_cmd}'
             cmd = task.get_command(cfg_path=tmp.name, template=template)
             import opencompass_tpu
             pkg_root = osp.dirname(osp.dirname(opencompass_tpu.__file__))

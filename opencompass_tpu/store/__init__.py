@@ -1,0 +1,42 @@
+"""Content-addressed result store: cross-run incremental evaluation.
+
+The framework's legacy reuse mechanism is the timestamp-directory
+``--reuse`` protocol — file existence inside *one* run dir.  This
+subsystem makes reuse **content-addressed and run-independent**: every
+evaluated row (rendered prompt × model identity × inference params ×
+inferencer kind) is committed to ``{cache_root}/store/`` as it
+completes, with per-row atomic appends that survive ``kill -9``; any
+identical row ever evaluated — in any run, any work_dir — is served
+from disk instead of the device.
+
+Three layers consume it:
+
+- **inferencers** (gen/ppl/clp) consult the store before planning, so
+  cached rows never enter batches and the planner packs only misses;
+- **partitioners** prune fully-cached (model, dataset) pairs pre-launch
+  by materializing their prediction files from unit manifests;
+- **tasks** bind the store to each model and record unit manifests as
+  units complete.
+
+See docs/user_guides/caching.md for layout, keying and invalidation.
+"""
+from opencompass_tpu.store.context import (ENV_RESULT_CACHE, StoreContext,
+                                           bind_model_store, context_for,
+                                           open_store, reset_stores,
+                                           result_cache_enabled,
+                                           store_root)
+from opencompass_tpu.store.keys import (model_store_id, namespace_digest,
+                                        row_key, unit_key)
+from opencompass_tpu.store.prune import materialize_unit, record_unit
+from opencompass_tpu.store.store import (ENV_MAX_BYTES, NUM_SHARDS,
+                                         ResultStore, counters_snapshot,
+                                         iter_jsonl)
+
+__all__ = [
+    'ENV_MAX_BYTES', 'ENV_RESULT_CACHE', 'NUM_SHARDS', 'ResultStore',
+    'StoreContext', 'bind_model_store', 'context_for',
+    'counters_snapshot', 'iter_jsonl', 'materialize_unit',
+    'model_store_id', 'namespace_digest', 'open_store', 'record_unit',
+    'reset_stores', 'result_cache_enabled', 'row_key', 'store_root',
+    'unit_key',
+]

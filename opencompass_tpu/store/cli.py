@@ -1,0 +1,120 @@
+"""``python -m opencompass_tpu.cli cache {stats|gc|verify}``.
+
+Operates purely on the store directory — no model, no config, works on
+a dead run.  The store is resolved from ``--store DIR``, a work-dir
+positional (its ``cache/store``), or ``OCT_CACHE_ROOT``.
+
+- ``stats``: file/row/byte counts (cheap — no JSON parsing).
+- ``gc [--max-bytes N]``: delete oldest segment/unit files until the
+  store fits the budget (default ``OCT_STORE_MAX_BYTES``).
+- ``verify``: full integrity pass (parse every line); exits non-zero on
+  corrupt unit manifests, so it slots into CI after a cached sweep.
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+from typing import List, Optional
+
+from opencompass_tpu.store.store import (ENV_MAX_BYTES, NUM_SHARDS,
+                                         ResultStore, STORE_SUBDIR)
+
+
+def resolve_store_dir(path: Optional[str],
+                      explicit: Optional[str] = None) -> Optional[str]:
+    """The store directory: ``--store`` wins, then a ``path`` that IS a
+    store dir, then ``OCT_CACHE_ROOT`` (the env beats the work-dir
+    *fallback* because the runtime resolves the cache root env-first —
+    ``compile_cache.cache_root`` — and the CI ``verify`` gate must
+    inspect the store the sweep actually wrote), then
+    ``<path>/cache/store``."""
+    if explicit:
+        return explicit
+    if path and (osp.isdir(osp.join(path, 'segments'))
+                 or osp.basename(osp.normpath(path)) == STORE_SUBDIR):
+        return path
+    root = os.environ.get('OCT_CACHE_ROOT')
+    if root:
+        return osp.join(root, STORE_SUBDIR)
+    if path:
+        # a fresh/empty store dir is still addressable (stats read 0s)
+        return osp.join(path, 'cache', STORE_SUBDIR)
+    return None
+
+
+def _fmt_bytes(n: int) -> str:
+    for unit in ('B', 'KiB', 'MiB', 'GiB'):
+        if n < 1024 or unit == 'GiB':
+            return f'{n:.1f} {unit}' if unit != 'B' else f'{n} B'
+        n /= 1024
+    return f'{n}'
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog='cache', description='Inspect / garbage-collect / verify '
+        'the content-addressed result store')
+    parser.add_argument('command', choices=['stats', 'gc', 'verify'])
+    parser.add_argument('path', nargs='?', default=None,
+                        help='a store directory, or a sweep output '
+                        'root (its cache/store is used unless '
+                        '$OCT_CACHE_ROOT is set — the env wins, '
+                        'matching the runtime cache-root resolution)')
+    parser.add_argument('--store', default=None, metavar='DIR',
+                        help='explicit store directory (overrides path)')
+    parser.add_argument('--max-bytes', type=int, default=None,
+                        help=f'gc byte budget (default ${ENV_MAX_BYTES})')
+    parser.add_argument('--json', action='store_true',
+                        help='emit machine-readable JSON')
+    args = parser.parse_args(argv)
+
+    store_dir = resolve_store_dir(args.path, args.store)
+    if store_dir is None:
+        print('no store directory: pass a work dir, --store DIR, or set '
+              'OCT_CACHE_ROOT')
+        return 1
+    store = ResultStore(store_dir)
+
+    if args.command == 'stats':
+        stats = store.stats()
+        if args.json:
+            print(json.dumps(stats, indent=2))
+        else:
+            print(f"store: {stats['root']}")
+            print(f"rows: {stats['rows']} across "
+                  f"{stats['segment_files']} segment file(s) in "
+                  f"{stats['shards']}/{NUM_SHARDS} shard(s) "
+                  f"({_fmt_bytes(stats['segment_bytes'])})")
+            print(f"units: {stats['units']} "
+                  f"({_fmt_bytes(stats['unit_bytes'])})")
+            print(f"total: {_fmt_bytes(stats['total_bytes'])}")
+        return 0
+
+    if args.command == 'gc':
+        rec = store.gc(args.max_bytes)
+        if args.json:
+            print(json.dumps(rec, indent=2))
+        elif not rec['max_bytes']:
+            print('no byte budget (set --max-bytes or '
+                  f'{ENV_MAX_BYTES}); nothing deleted')
+        else:
+            print(f"deleted {rec['deleted_files']} file(s), freed "
+                  f"{_fmt_bytes(rec['freed_bytes'])}; store now "
+                  f"{_fmt_bytes(rec['remaining_bytes'])} of "
+                  f"{_fmt_bytes(rec['max_bytes'])}")
+        return 0
+
+    # verify
+    rec = store.verify()
+    if args.json:
+        print(json.dumps(rec, indent=2))
+    else:
+        print(f"store: {rec['root']}")
+        print(f"rows: {rec['rows']}  torn lines: {rec['torn_lines']}  "
+              f"duplicate keys: {rec['duplicate_keys']}")
+        if rec['bad_units']:
+            print(f"CORRUPT unit manifests: {rec['bad_units']}")
+        print('ok' if rec['ok'] else 'FAILED')
+    return 0 if rec['ok'] else 1

@@ -1,0 +1,143 @@
+"""Wiring between the result store and the model/inferencer layers.
+
+The infer task binds a store to each model it builds
+(:func:`bind_model_store`); inferencers then ask for a
+:class:`StoreContext` scoped to their (model, kind, params) namespace
+(:func:`context_for`) and consult it *before planning*, so cached rows
+never enter device batches, and commit rows as batches complete, so a
+``kill -9`` anywhere resumes across runs.
+
+Gating (all must hold for a context to exist):
+
+- a sweep cache root is pinned (``OCT_CACHE_ROOT`` or ``{work_dir}/cache``
+  — the same resolution as the XLA compile cache);
+- the run config does not carry ``result_cache = False`` (CLI
+  ``--no-result-cache``) and ``OCT_RESULT_CACHE`` is not ``0``/``false``;
+- the model advertises ``supports_result_cache`` (BaseModel default
+  True; API models are False — sampled completions are not pure
+  functions of the prompt).
+
+Contract identical to the obs plane: the store must **never fail a
+task** — every entry point is exception-guarded and degrades to "no
+cache" (the model simply runs).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+from opencompass_tpu.store import keys as keymod
+from opencompass_tpu.store.store import ResultStore, count
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+ENV_RESULT_CACHE = 'OCT_RESULT_CACHE'
+
+_stores: Dict[str, ResultStore] = {}
+
+
+def result_cache_enabled(cfg: Optional[Dict] = None) -> bool:
+    """Is the result cache requested?  Config beats env beats default-on."""
+    if cfg is not None and cfg.get('result_cache') is False:
+        return False
+    flag = os.environ.get(ENV_RESULT_CACHE, '').strip().lower()
+    return flag not in ('0', 'false', 'off', 'no')
+
+
+def store_root(work_dir: Optional[str] = None) -> Optional[str]:
+    """``{cache_root}/store``, or None when no cache root is pinned."""
+    from opencompass_tpu.utils import compile_cache
+    from opencompass_tpu.store.store import STORE_SUBDIR
+    root = compile_cache.cache_root(work_dir)
+    return os.path.join(root, STORE_SUBDIR) if root else None
+
+
+def open_store(work_dir: Optional[str] = None,
+               root: Optional[str] = None) -> Optional[ResultStore]:
+    """Process-wide store singleton per root path (one in-memory index
+    per store, shared by every model/inferencer in the process)."""
+    root = os.path.abspath(root) if root else store_root(work_dir)
+    if not root:
+        return None
+    store = _stores.get(root)
+    if store is None:
+        store = _stores[root] = ResultStore(root)
+    return store
+
+
+def reset_stores():
+    """Forget every open store (test hook — a fresh tmp cache root per
+    test must not see a previous test's in-memory index)."""
+    _stores.clear()
+
+
+def bind_model_store(model, model_cfg: Dict,
+                     cfg: Optional[Dict] = None,
+                     work_dir: Optional[str] = None):
+    """Attach the sweep store + this model's identity to ``model`` so
+    inferencers can build namespaces.  Never raises; on any problem the
+    model simply has no store bound."""
+    try:
+        model._result_store = None
+        if not result_cache_enabled(cfg):
+            return
+        if not getattr(model, 'supports_result_cache', True):
+            return
+        store = open_store(work_dir)
+        if store is None:
+            return
+        model._result_store = store
+        model._store_model_id = keymod.model_store_id(
+            model_cfg, getattr(model, '_toklen_digest', '') or '')
+    except Exception:
+        logger.warning('result-store binding failed; caching disabled '
+                       'for this model', exc_info=True)
+        model._result_store = None
+
+
+class StoreContext:
+    """One (model, inferencer-kind, params) namespace over the store.
+
+    ``get``/``put`` count hits/misses/commits into the process totals
+    (TaskProfiler attribution) and the obs ``store.*`` metrics; both are
+    exception-guarded so a broken disk degrades to cache-off."""
+
+    __slots__ = ('store', 'namespace')
+
+    def __init__(self, store: ResultStore, namespace: str):
+        self.store = store
+        self.namespace = namespace
+
+    def key(self, prompt: str, extra=None) -> str:
+        return keymod.row_key(self.namespace, prompt, extra)
+
+    def get(self, key: str):
+        try:
+            value = self.store.get(key)
+        except Exception:
+            return None
+        count('hits' if value is not None else 'misses')
+        return value
+
+    def put(self, key: str, value):
+        try:
+            if self.store.put(key, value):
+                count('commits')
+        except Exception:
+            logger.warning('result-store commit failed', exc_info=True)
+
+
+def context_for(model, kind: str,
+                params: Optional[Dict] = None) -> Optional[StoreContext]:
+    """A StoreContext for ``model``, or None when the model has no
+    store bound (untracked run, API model, cache disabled)."""
+    try:
+        store = getattr(model, '_result_store', None)
+        if store is None:
+            return None
+        ns = keymod.namespace_digest(
+            getattr(model, '_store_model_id', ''), kind, params)
+        return StoreContext(store, ns)
+    except Exception:
+        return None

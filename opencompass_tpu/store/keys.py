@@ -1,0 +1,89 @@
+"""Content-addressed keying for the result store.
+
+A row is addressed by everything that determines its output and nothing
+else:
+
+- the **model identity**: ``utils.build.model_cfg_key`` (constructor-
+  relevant config digest) plus the tokenizer *behavior* digest when the
+  model exposes one (``toklen_cache.tokenizer_digest`` — catches a
+  tokenizer updated in place at the same path);
+- the **inferencer kind** (``gen`` / ``ppl`` / ``clp``) and its
+  result-relevant **inference params** (``max_out_len``,
+  ``generation_kwargs``, candidate choices, normalizing string, ...);
+- the **rendered prompt** — the exact string handed to the model after
+  meta-template folding, so template or in-context-example edits miss
+  naturally;
+- optional per-row **extras** (PPL context mask length, normalizer
+  text).
+
+Model identity + kind + params fold into a 16-hex **namespace** digest;
+namespace + prompt + extras fold into the 32-hex **row key**.  Keys are
+pure functions of their inputs — two processes (or two runs, or two
+work_dirs) computing the key for the same row always agree, which is the
+whole cross-run reuse contract (tested by
+``tests/test_store.py::test_key_stable_across_processes``).
+
+**Unit keys** address a whole (model, dataset-shard) prediction file for
+the partitioners' pre-launch prune.  They are computable from configs
+alone (no model build, no tokenizer), so they deliberately omit the
+tokenizer-behavior probe — a tokenizer swapped in place at the same path
+invalidates row keys but not unit keys (documented in
+docs/user_guides/caching.md under invalidation caveats).  ``eval_cfg``
+and ``abbr`` are excluded: neither changes prediction content.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Dict, Optional
+
+# bump to invalidate every stored row/unit after a semantic change to
+# the keying or the stored value layout
+KEY_VERSION = 1
+
+# dataset-config keys that do not affect prediction content
+_UNIT_NON_CONTENT_KEYS = ('eval_cfg', 'abbr')
+
+
+def _blob(obj) -> bytes:
+    return json.dumps(obj, sort_keys=True, default=str).encode('utf-8')
+
+
+def namespace_digest(model_id: str, kind: str,
+                     params: Optional[Dict] = None) -> str:
+    """16-hex digest of (model identity, inferencer kind, params)."""
+    return hashlib.blake2b(
+        _blob([KEY_VERSION, model_id, kind, params or {}]),
+        digest_size=8).hexdigest()
+
+
+def model_store_id(model_cfg: Dict, tokenizer_digest: str = '') -> str:
+    """The model half of a namespace: config digest + tokenizer
+    behavior digest (empty for models without a real tokenizer)."""
+    from opencompass_tpu.utils.build import model_cfg_key
+    return f'{model_cfg_key(model_cfg)}:{tokenizer_digest}'
+
+
+def row_key(namespace: str, prompt: str, extra=None) -> str:
+    """32-hex content address of one row within a namespace."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(namespace.encode('ascii'))
+    h.update(b'\x00')
+    h.update(str(prompt).encode('utf-8'))
+    if extra is not None:
+        h.update(b'\x00')
+        h.update(_blob(extra))
+    return h.hexdigest()
+
+
+def unit_key(model_cfg: Dict, dataset_cfg: Dict) -> str:
+    """24-hex address of a whole (model, dataset-shard) prediction file,
+    computable pre-launch from configs alone."""
+    from opencompass_tpu.utils.build import model_cfg_key
+    ds = {k: v for k, v in dict(dataset_cfg).items()
+          if k not in _UNIT_NON_CONTENT_KEYS}
+    blob = _blob([KEY_VERSION, model_cfg_key(model_cfg),
+                  # result-relevant model knobs that model_cfg_key
+                  # deliberately strips (they are scheduler-consumed)
+                  dict(model_cfg).get('max_out_len'), ds])
+    return hashlib.blake2b(blob, digest_size=12).hexdigest()

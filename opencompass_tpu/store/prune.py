@@ -1,0 +1,77 @@
+"""Unit manifests: whole-prediction-file reuse for pre-launch pruning.
+
+Row-level hits save device work but still pay a task launch (process
+spawn, model build, tokenization) per (model, dataset) pair.  For the
+common nightly-sweep case — *nothing* about a pair changed — the store
+also remembers the complete prediction file under a config-derived
+**unit key** (:func:`opencompass_tpu.store.keys.unit_key`).  The
+partitioners consult it at their output-existence checks: a missing
+prediction file whose unit manifest is present is **materialized on the
+spot** (byte-identical re-dump of the recorded results), after which the
+normal "output exists → skip" protocol prunes the task before launch.
+
+Units are recorded by ``OpenICLInferTask`` after each (model, dataset)
+unit completes — including units it *skipped* because the file already
+existed, so legacy ``--reuse`` runs seed the store too.
+
+Both directions are exception-guarded: a torn manifest or unwritable
+path degrades to "launch the task normally".
+"""
+from __future__ import annotations
+
+import json
+import os.path as osp
+from typing import Dict, Optional
+
+from opencompass_tpu.store import keys as keymod
+from opencompass_tpu.store.store import ResultStore, STORE_VERSION
+from opencompass_tpu.utils.fileio import atomic_write_json
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+
+def record_unit(store: ResultStore, model_cfg: Dict, dataset_cfg: Dict,
+                predictions_path: str):
+    """Snapshot one finished prediction file into the unit store.
+    Never raises."""
+    try:
+        with open(predictions_path, encoding='utf-8') as f:
+            results = json.load(f)
+        if not isinstance(results, dict):
+            return
+        store.put_unit(keymod.unit_key(model_cfg, dataset_cfg), {
+            'v': STORE_VERSION,
+            'n_rows': len(results),
+            'results': results,
+        })
+    except Exception:
+        logger.warning('result-store unit record failed '
+                       f'({predictions_path})', exc_info=True)
+
+
+def materialize_unit(store: ResultStore, model_cfg: Dict,
+                     dataset_cfg: Dict,
+                     predictions_path: str) -> Optional[int]:
+    """Write ``predictions_path`` from the unit store when its key is
+    present; returns the row count (the task's expected store hits) or
+    None when the unit is unknown.  The written file is byte-identical
+    to what the infer task produced (same ``dump_results_dict``
+    serialization of the same dict, insertion order preserved)."""
+    try:
+        rec = store.get_unit(keymod.unit_key(model_cfg, dataset_cfg))
+        if not rec or not isinstance(rec.get('results'), dict):
+            return None
+        # temp-file + os.replace, NOT a plain write: a driver preempted
+        # mid-materialize must not leave a torn prediction file — the
+        # exists-protocol would trust it forever and eval would fail
+        # with no self-heal.  Serialization matches dump_results_dict
+        # exactly (indent=4, ensure_ascii=False) for byte-identity.
+        atomic_write_json(osp.abspath(predictions_path), rec['results'],
+                          dump_kwargs={'indent': 4,
+                                       'ensure_ascii': False})
+        return int(rec.get('n_rows', len(rec['results'])))
+    except Exception:
+        logger.warning('result-store unit materialization failed '
+                       f'({predictions_path})', exc_info=True)
+        return None

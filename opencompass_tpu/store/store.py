@@ -1,0 +1,337 @@
+"""The content-addressed result store: sharded JSONL segments + units.
+
+Layout (under ``{cache_root}/store/``, shared by every run of a sweep —
+the same pre-timestamp root as the XLA compile cache)::
+
+    segments/<shard>/<writer>.jsonl   row records, one JSON object/line
+    units/<unit_key>.json             whole prediction files (prune fast
+                                      path, written atomically)
+    meta.json                         store format marker
+
+**Rows.**  A row record is ``{"k": <32-hex key>, "v": <value>, "t": ts}``.
+Keys shard by their first byte into ``NUM_SHARDS`` directories; each
+writer *process* appends to its own segment file per shard through
+``utils.fileio.append_jsonl_atomic`` (one ``os.write`` on an ``O_APPEND``
+fd per commit), so:
+
+- concurrent writers never interleave mid-record;
+- a ``kill -9`` can tear at most the final line of a segment, which
+  readers skip (torn-write recovery) — every *prior* commit survives;
+- there is no lock file and no cross-process coordination at all.
+
+Reads load a shard's segments lazily into memory on first lookup.
+Duplicate keys (two processes racing the same miss, or a resumed task
+recommitting) are benign: last line wins and :meth:`put` suppresses the
+disk write when the value is already present and equal.
+
+**Counters.**  Process-wide hit/miss/commit totals mirror the
+compile-cache pattern: ``counters_snapshot`` is diffed by TaskProfiler
+into the per-task perf record, feeding the trace report's ``hit_rate``
+column; obs ``store.*`` metrics are incremented at event time when
+tracing is live.
+
+**GC.**  :meth:`gc` deletes oldest files (segments and units, by mtime)
+until the store fits a byte budget (``OCT_STORE_MAX_BYTES``).  Eviction
+is file-granular — the store is a cache, not a ledger; evicted rows
+recompute and recommit.
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+import threading
+import time
+import uuid
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from opencompass_tpu.utils.fileio import (append_jsonl_atomic,
+                                          atomic_write_json)
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+STORE_VERSION = 1
+NUM_SHARDS = 16
+STORE_SUBDIR = 'store'
+ENV_MAX_BYTES = 'OCT_STORE_MAX_BYTES'
+
+_counters_lock = threading.Lock()
+_counters = {'hits': 0, 'misses': 0, 'commits': 0}
+
+
+def count(key: str, n: int = 1):
+    """Bump a process-wide store counter + the obs metric (when live)."""
+    with _counters_lock:
+        _counters[key] += n
+    try:
+        from opencompass_tpu.obs import get_tracer
+        tracer = get_tracer()
+        if tracer.enabled:
+            tracer.counter(f'store.{key}').inc(n)
+    except Exception:
+        pass
+
+
+def counters_snapshot() -> Dict[str, int]:
+    """Process totals since import (TaskProfiler diffs these around a
+    task, the same way compile-cache hits/misses are attributed)."""
+    with _counters_lock:
+        return dict(_counters)
+
+
+def iter_jsonl(path: str) -> Iterator[Dict]:
+    """Parseable row records in ``path``; torn / garbage lines are
+    skipped, never raised (the recovery half of the commit protocol)."""
+    try:
+        f = open(path, encoding='utf-8', errors='replace')
+    except OSError:
+        return
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue   # torn final line from a killed writer
+            if isinstance(rec, dict) and 'k' in rec and 'v' in rec:
+                yield rec
+
+
+class ResultStore:
+    """One content-addressed store rooted at ``root``.
+
+    Thread-safe; cheap to construct (directories are created lazily on
+    first commit, so a read-only consumer never litters the disk).
+    """
+
+    def __init__(self, root: str):
+        self.root = osp.abspath(root)
+        self.seg_root = osp.join(self.root, 'segments')
+        self.units_dir = osp.join(self.root, 'units')
+        self._lock = threading.Lock()
+        self._mem: Dict[int, Dict[str, object]] = {}   # shard -> key -> v
+        self._seg_files: Dict[int, str] = {}           # shard -> my file
+        # unique per store *instance*: two stores in one process (tests)
+        # or two processes never append to the same segment file
+        self._writer = f'{os.getpid()}-{uuid.uuid4().hex[:6]}'
+        self._meta_written = False
+
+    # -- row API -----------------------------------------------------------
+
+    @staticmethod
+    def _shard_of(key: str) -> int:
+        try:
+            return int(key[:2], 16) % NUM_SHARDS
+        except ValueError:
+            return 0
+
+    def _shard_dir(self, shard: int) -> str:
+        return osp.join(self.seg_root, f'{shard:02d}')
+
+    def _load_shard(self, shard: int) -> Dict[str, object]:
+        mem = self._mem.get(shard)
+        if mem is not None:
+            return mem
+        mem = {}
+        sdir = self._shard_dir(shard)
+        try:
+            names = sorted(os.listdir(sdir))
+        except OSError:
+            names = []
+        for name in names:
+            if not name.endswith('.jsonl'):
+                continue
+            for rec in iter_jsonl(osp.join(sdir, name)):
+                mem[rec['k']] = rec['v']
+        self._mem[shard] = mem
+        return mem
+
+    def get(self, key: str):
+        """The stored value for ``key``, or None.  Does not count
+        hit/miss — the StoreContext does, so probes (verify, stats)
+        stay silent."""
+        with self._lock:
+            return self._load_shard(self._shard_of(key)).get(key)
+
+    def put(self, key: str, value) -> bool:
+        """Commit one row (atomic append).  Returns True when a disk
+        write actually happened — an identical row already present is
+        suppressed, so resumed tasks don't balloon the segments."""
+        shard = self._shard_of(key)
+        with self._lock:
+            mem = self._load_shard(shard)
+            if key in mem and mem[key] == value:
+                return False
+            mem[key] = value
+            path = self._seg_files.get(shard)
+            if path is None:
+                path = osp.join(self._shard_dir(shard),
+                                f'{self._writer}.jsonl')
+                self._seg_files[shard] = path
+            append_jsonl_atomic(
+                path, [{'k': key, 'v': value, 't': round(time.time(), 3)}])
+            self.write_meta()
+        return True
+
+    def invalidate_memory(self):
+        """Drop the in-memory shard maps so the next lookup re-reads
+        disk (after an external writer or a GC pass)."""
+        with self._lock:
+            self._mem.clear()
+
+    # -- unit API (whole prediction files, the prune fast path) ------------
+
+    def unit_path(self, unit_key: str) -> str:
+        return osp.join(self.units_dir, f'{unit_key}.json')
+
+    def put_unit(self, unit_key: str, record: Dict):
+        atomic_write_json(self.unit_path(unit_key), record)
+        self.write_meta()
+
+    def get_unit(self, unit_key: str) -> Optional[Dict]:
+        try:
+            with open(self.unit_path(unit_key), encoding='utf-8') as f:
+                rec = json.load(f)
+            return rec if isinstance(rec, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    # -- maintenance (cli cache stats|gc|verify) ---------------------------
+
+    @staticmethod
+    def _count_lines(path: str) -> Tuple[int, bool]:
+        """(newline count, file-ends-mid-line) via bounded chunk reads —
+        a multi-GiB segment must not be slurped into one bytes object."""
+        n = 0
+        last = b'\n'
+        try:
+            with open(path, 'rb') as f:
+                while True:
+                    chunk = f.read(1 << 20)
+                    if not chunk:
+                        break
+                    n += chunk.count(b'\n')
+                    last = chunk[-1:]
+        except OSError:
+            return 0, False
+        return n, last != b'\n'
+
+    def _all_files(self) -> List[Tuple[str, float, int]]:
+        """(path, mtime, bytes) for every segment + unit file."""
+        out = []
+        for base in (self.seg_root, self.units_dir):
+            for dirpath, _, names in os.walk(base):
+                for name in names:
+                    path = osp.join(dirpath, name)
+                    try:
+                        st = os.stat(path)
+                    except OSError:
+                        continue
+                    out.append((path, st.st_mtime, st.st_size))
+        return out
+
+    def stats(self) -> Dict:
+        """Cheap store summary: file/byte counts per kind, rows by line
+        count (no JSON parsing — ``verify`` does the expensive pass)."""
+        seg_files = units = 0
+        seg_bytes = unit_bytes = 0
+        rows = 0
+        shards = set()
+        for path, _, size in self._all_files():
+            if path.startswith(self.units_dir):
+                units += 1
+                unit_bytes += size
+                continue
+            seg_files += 1
+            seg_bytes += size
+            shards.add(osp.basename(osp.dirname(path)))
+            rows += self._count_lines(path)[0]
+        return {
+            'v': STORE_VERSION, 'root': self.root,
+            'segment_files': seg_files, 'rows': rows,
+            'segment_bytes': seg_bytes, 'shards': len(shards),
+            'units': units, 'unit_bytes': unit_bytes,
+            'total_bytes': seg_bytes + unit_bytes,
+        }
+
+    def verify(self) -> Dict:
+        """Full integrity pass: parse every segment line and unit file.
+        Torn lines (killed writers) are expected and reported, not
+        errors; an unparseable unit file is an error.  ``ok`` is the
+        CI gate ``cli cache verify`` exits on."""
+        rows = torn = dup = 0
+        bad_units = []
+        seen: Dict[int, set] = {}
+        for path, _, _ in sorted(self._all_files()):
+            if path.startswith(self.units_dir):
+                try:
+                    with open(path, encoding='utf-8') as f:
+                        rec = json.load(f)
+                    if not isinstance(rec, dict) or 'results' not in rec:
+                        bad_units.append(osp.basename(path))
+                except (OSError, ValueError):
+                    bad_units.append(osp.basename(path))
+                continue
+            if not path.endswith('.jsonl'):
+                continue
+            # a file not ending in \n has one torn tail line
+            n_lines, mid_line = self._count_lines(path)
+            if mid_line:
+                n_lines += 1
+            good = 0
+            try:
+                shard = int(osp.basename(osp.dirname(path)), 10)
+            except ValueError:
+                shard = -1
+            keys = seen.setdefault(shard, set())
+            for rec in iter_jsonl(path):
+                good += 1
+                if rec['k'] in keys:
+                    dup += 1
+                keys.add(rec['k'])
+            rows += good
+            torn += max(0, n_lines - good)
+        return {
+            'v': STORE_VERSION, 'root': self.root, 'rows': rows,
+            'torn_lines': torn, 'duplicate_keys': dup,
+            'bad_units': bad_units, 'ok': not bad_units,
+        }
+
+    def gc(self, max_bytes: Optional[int] = None) -> Dict:
+        """Delete oldest files until the store fits ``max_bytes``
+        (default from ``OCT_STORE_MAX_BYTES``; 0/unset = no limit)."""
+        if max_bytes is None:
+            max_bytes = int(os.environ.get(ENV_MAX_BYTES, 0) or 0)
+        files = self._all_files()
+        total = sum(size for _, _, size in files)
+        deleted = freed = 0
+        if max_bytes > 0:
+            for path, _, size in sorted(files, key=lambda f: f[1]):
+                if total <= max_bytes:
+                    break
+                try:
+                    os.unlink(path)
+                except OSError:
+                    continue
+                total -= size
+                freed += size
+                deleted += 1
+            self.invalidate_memory()
+        return {'deleted_files': deleted, 'freed_bytes': freed,
+                'remaining_bytes': total, 'max_bytes': max_bytes}
+
+    def write_meta(self):
+        """Stamp the format marker (called by every write path; one
+        stat per instance after the first check)."""
+        if self._meta_written:
+            return
+        path = osp.join(self.root, 'meta.json')
+        try:
+            if not osp.exists(path):
+                atomic_write_json(path, {'v': STORE_VERSION})
+            self._meta_written = True
+        except OSError:
+            pass

@@ -90,8 +90,13 @@ class OpenICLEvalTask(BaseTask):
                 out_path = get_infer_output_path(
                     model_cfg, dataset_cfg,
                     osp.join(self.work_dir, 'results'))
-                if osp.exists(out_path):
-                    tracer.event('eval_skip', model=m_abbr, dataset=d_abbr)
+                # resume mirror of the infer side: skip only when the
+                # result is at least as new as its predictions — a
+                # re-inferred (or store-materialized) prediction file
+                # must be re-scored, not shadowed by a stale result
+                if osp.exists(out_path) and self._result_fresh(out_path):
+                    tracer.event('eval_skipped', model=m_abbr,
+                                 dataset=d_abbr)
                     units_done += 1
                     heartbeat.set_unit(units_done, units_total)
                     continue
@@ -103,24 +108,40 @@ class OpenICLEvalTask(BaseTask):
                 units_done += 1
                 heartbeat.set_unit(units_done, units_total)
 
-    def _load_predictions(self) -> Optional[List[Dict]]:
-        """Prediction records in index order, stitching `_k` shards."""
+    def _prediction_paths(self) -> List[str]:
+        """Existing prediction file(s) for the current pair: the whole
+        file, or its ``_k`` shards from a size-partitioned run."""
         filename = get_infer_output_path(
             self.model_cfg, self.dataset_cfg,
             osp.join(self.work_dir, 'predictions'))
         if osp.exists(filename):
-            with open(filename) as f:
-                preds = json.load(f)
-            return [preds[str(i)] for i in range(len(preds))]
-        # partial shards from a size-partitioned run
+            return [filename]
         root, ext = osp.splitext(filename)
-        records = []
+        paths = []
         i = 0
         while osp.exists(f'{root}_{i}{ext}'):
-            with open(f'{root}_{i}{ext}') as f:
+            paths.append(f'{root}_{i}{ext}')
+            i += 1
+        return paths
+
+    def _result_fresh(self, out_path: str) -> bool:
+        """Is the existing result at least as new as every prediction
+        file it scored?  Vacuously fresh with no predictions (nothing
+        to rescore)."""
+        try:
+            result_mtime = osp.getmtime(out_path)
+            return all(osp.getmtime(p) <= result_mtime
+                       for p in self._prediction_paths())
+        except OSError:
+            return False   # raced file: re-evaluate to be safe
+
+    def _load_predictions(self) -> Optional[List[Dict]]:
+        """Prediction records in index order, stitching `_k` shards."""
+        records = []
+        for path in self._prediction_paths():
+            with open(path) as f:
                 sub = json.load(f)
             records.extend(sub[str(k)] for k in range(len(sub)))
-            i += 1
         return records or None
 
     def _score(self, out_path: str):

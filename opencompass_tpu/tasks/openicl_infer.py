@@ -63,6 +63,12 @@ class OpenICLInferTask(BaseTask):
             # heartbeat writes report live tokens/s off the model's
             # perf counters
             heartbeat.bind_perf(getattr(model, 'perf', None))
+            # content-addressed result store: inferencers serve cached
+            # rows from disk and commit fresh ones as batches complete
+            # (no-op when disabled / no cache root / API model)
+            from opencompass_tpu import store as result_store
+            result_store.bind_model_store(model, model_cfg, self.cfg,
+                                          work_dir=self.work_dir)
 
             try:
                 self._infer_model_datasets(
@@ -96,6 +102,10 @@ class OpenICLInferTask(BaseTask):
                                 if is_main_process() else None):
                 tracer.event('infer_skip', model=m_abbr,
                              dataset=d_abbr)
+                # seed the unit store from pre-existing outputs too, so
+                # legacy --reuse runs feed cross-run pruning
+                self._record_unit(model, model_cfg, dataset_cfg,
+                                  out_path)
                 units_done += 1
                 heartbeat.set_unit(units_done, units_total)
                 continue
@@ -131,6 +141,10 @@ class OpenICLInferTask(BaseTask):
                                 tracer.gauge(
                                     'device.peak_bytes_in_use').set(
                                         mem['peak_bytes_in_use'])
+            # whole-unit manifest for the partitioners' pre-launch
+            # prune: an identical (model, dataset) pair in a future run
+            # materializes its predictions without launching a task
+            self._record_unit(model, model_cfg, dataset_cfg, out_path)
             units_done += 1
             heartbeat.set_unit(units_done, units_total)
             if prof.record and is_main_process():
@@ -138,6 +152,17 @@ class OpenICLInferTask(BaseTask):
                     f'perf: {prof.record.get("samples_per_sec", "?")} '
                     f'samples/s, {prof.record.get("tokens_per_sec", "?")}'
                     f' tokens/s (wall {prof.record["wall_seconds"]}s)')
+
+    @staticmethod
+    def _record_unit(model, model_cfg, dataset_cfg, out_path: str):
+        """Snapshot a completed prediction file into the unit store
+        (rank 0, bound-store models only).  Never fails the task."""
+        store = getattr(model, '_result_store', None)
+        if store is None or not is_main_process() \
+                or not osp.exists(out_path):
+            return
+        from opencompass_tpu.store import record_unit
+        record_unit(store, model_cfg, dataset_cfg, out_path)
 
     def _inference(self, model, out_path: str):
         assert 'ice_template' in self.infer_cfg \

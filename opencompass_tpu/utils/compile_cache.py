@@ -246,7 +246,7 @@ def record_shape(model_sig: str, kind: str, shape, seconds: float,
             # compile; later cache-served first calls are fast
             entry[key] = round(max(float(seconds),
                                    float(entry.get(key, 0.0))), 3)
-            from opencompass_tpu.obs.live import atomic_write_json
+            from opencompass_tpu.utils.fileio import atomic_write_json
             atomic_write_json(path, data)
     except Exception:
         pass

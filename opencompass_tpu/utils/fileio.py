@@ -19,11 +19,89 @@ A backend must implement: ``get(path) -> bytes``, ``exists(path) -> bool``,
 from __future__ import annotations
 
 import io
+import json
 import os
+import os.path as osp
+import tempfile
 from contextlib import contextmanager
-from typing import Dict, Optional
+from typing import Dict, Iterable, Optional
 
 _BACKENDS: Dict[str, object] = {}
+
+
+# -- atomic local-file primitives ------------------------------------------
+# Shared by the obs plane (heartbeats, status.json), the cache layer
+# (compile-cache manifest, toklen cache) and the result store.  They live
+# here — not in obs/ — because utils/ must not depend on obs/ (the
+# subsystem layering goes the other way); obs/live.py re-exports
+# atomic_write_json for compatibility.
+
+def atomic_write_json(path: str, obj: Dict, dump_kwargs: Dict = None):
+    """Write ``obj`` to ``path`` so readers only ever see a complete
+    file: temp file in the same directory, fsync-free ``os.replace``.
+    ``dump_kwargs`` overrides the default compact serialization (the
+    result store's unit materialization needs the prediction files'
+    ``indent=4, ensure_ascii=False`` for byte-identity)."""
+    if dump_kwargs is None:
+        dump_kwargs = {'separators': (',', ':'), 'default': str}
+    dirname = osp.dirname(osp.abspath(path))
+    os.makedirs(dirname, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix='.tmp')
+    try:
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            json.dump(obj, f, **dump_kwargs)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def append_jsonl_atomic(path: str, records: Iterable[Dict]):
+    """Append ``records`` to a JSONL file so each record commits whole.
+
+    All lines are serialized first and pushed through a single
+    ``os.write`` on an ``O_APPEND`` descriptor: on a local filesystem an
+    append write is atomic with respect to other appenders, so
+    concurrent writer processes interleave at record granularity, never
+    mid-line.  A process killed inside the write can leave at most one
+    torn *final* line, which JSONL readers skip (the result store's
+    torn-write recovery contract)."""
+    payload = ''.join(
+        json.dumps(rec, separators=(',', ':'), default=str) + '\n'
+        for rec in records)
+    if not payload:
+        return
+    os.makedirs(osp.dirname(osp.abspath(path)), exist_ok=True)
+    data = payload.encode('utf-8')
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        # loop on short writes: a partial os.write (ENOSPC mid-write,
+        # EINTR after partial transfer) would otherwise silently drop a
+        # committed record — and a later append by this writer would
+        # bury the torn line mid-file, violating the recovery contract
+        # that only the FINAL line of a segment can be torn
+        view = memoryview(data)
+        try:
+            while view:
+                n = os.write(fd, view)
+                view = view[n:]
+        except BaseException:
+            # the write failed mid-payload in a SURVIVING process (a
+            # dead one leaves the tear at EOF, which is fine): cap the
+            # partial line with a newline so this writer's next append
+            # starts a fresh line instead of being absorbed into the
+            # torn one and lost
+            if len(view) not in (0, len(data)):
+                try:
+                    os.write(fd, b'\n')
+                except OSError:
+                    pass
+            raise
+    finally:
+        os.close(fd)
 
 
 def register_backend(prefix: str, backend) -> None:

@@ -123,6 +123,10 @@ class TaskProfiler:
         # trace report can split compile_seconds into cold vs cached
         from opencompass_tpu.utils import compile_cache
         self._cc_snap = compile_cache.counters_snapshot()
+        # result-store totals are process-wide too; the delta around
+        # this task feeds the trace report's hit_rate column
+        from opencompass_tpu.store import store as result_store
+        self._store_snap = result_store.counters_snapshot()
         self._trace_active = False
         if self.trace_dir:
             try:
@@ -175,6 +179,14 @@ class TaskProfiler:
                 compile_cache_hits=d['compile_cache_hits'],
                 compile_cache_misses=d['compile_cache_misses'],
             )
+        from opencompass_tpu.store import store as result_store
+        st = result_store.counters_snapshot()
+        record.update(
+            store_hits=int(st['hits'] - self._store_snap['hits']),
+            store_misses=int(st['misses'] - self._store_snap['misses']),
+            store_commits=int(
+                st['commits'] - self._store_snap['commits']),
+        )
         if self.trace_dir and self._trace_active:
             record['trace_dir'] = self.trace_dir
         # a failed task's perf record must survive too (with the error

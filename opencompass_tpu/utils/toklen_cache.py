@@ -95,7 +95,7 @@ def save(cache_dir: str, digest: str,
         items = list(lengths.items())[-max_entries:]
         payload = {'v': VERSION, 'tokenizer': digest,
                    'lengths': {k.hex(): int(n) for k, n in items}}
-        from opencompass_tpu.obs.live import atomic_write_json
+        from opencompass_tpu.utils.fileio import atomic_write_json
         atomic_write_json(cache_path(cache_dir, digest), payload)
     except Exception as exc:
         logger.warning(f'toklen cache write failed: {exc}')

@@ -405,7 +405,9 @@ def obs_e2e_run(tmp_path_factory):
     with open(out_path) as f:
         out = f.read()
     assert proc.returncode == 0, out
-    (run_dir,) = os.listdir(work)
+    # the work root holds the timestamped run dir plus the sweep-shared
+    # cache/ (compile cache + result store)
+    (run_dir,) = [d for d in os.listdir(work) if d != 'cache']
     return {'run_dir': osp.join(work, run_dir), 'stdout': out,
             'live': live}
 
@@ -535,5 +537,5 @@ def test_obs_unset_creates_no_obs_dir(tmp_path):
         cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
         timeout=420)
     assert r.returncode == 0, r.stdout + r.stderr
-    (run_dir,) = os.listdir(work)
+    (run_dir,) = [d for d in os.listdir(work) if d != 'cache']
     assert not osp.exists(osp.join(work, run_dir, 'obs'))

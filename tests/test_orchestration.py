@@ -87,7 +87,7 @@ def test_run_cli_end_to_end_with_resume(tmp_path):
     r = _run_cli(['configs/eval_demo.py', '-w', work,
                   '--max-num-workers', '2'])
     assert r.returncode == 0, r.stdout + r.stderr
-    run_dirs = os.listdir(work)
+    run_dirs = [d for d in os.listdir(work) if d != 'cache']
     assert len(run_dirs) == 1
     root = osp.join(work, run_dirs[0])
     assert osp.exists(osp.join(root, 'predictions/fake-demo/demo-gen.json'))
@@ -112,7 +112,8 @@ def test_run_cli_size_split_stitching(tmp_path):
     r = _run_cli(['configs/eval_demo.py', '-w', work,
                   '--max-partition-size', '100', '--debug'])
     assert r.returncode == 0, r.stdout + r.stderr
-    root = osp.join(work, os.listdir(work)[0])
+    root = osp.join(work, [d for d in os.listdir(work)
+                           if d != 'cache'][0])
     shards = [f for f in os.listdir(osp.join(root, 'predictions/fake-demo'))
               if f.startswith('demo-gen_')]
     assert len(shards) == 4

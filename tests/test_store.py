@@ -1,0 +1,444 @@
+"""Content-addressed result store: keying, commit protocol, recovery,
+GC, partitioner pruning, cross-run FakeModel e2e, and the cache CLI."""
+import json
+import os
+import os.path as osp
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from opencompass_tpu import store as S
+from opencompass_tpu.store.store import ResultStore
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+def _cpu_env(extra=None):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env.pop('OCT_CACHE_ROOT', None)
+    env.pop('OCT_TRACE_ID', None)
+    if extra:
+        env.update(extra)
+    return env
+
+
+@pytest.fixture(autouse=True)
+def _fresh_stores(monkeypatch):
+    """Each test gets its own store world: no singleton bleed, no
+    inherited cache-root env."""
+    monkeypatch.delenv('OCT_CACHE_ROOT', raising=False)
+    monkeypatch.delenv('OCT_RESULT_CACHE', raising=False)
+    monkeypatch.delenv('OCT_STORE_MAX_BYTES', raising=False)
+    S.reset_stores()
+    yield
+    S.reset_stores()
+
+
+# -- keying ------------------------------------------------------------------
+
+def test_key_stable_across_processes():
+    """The whole cross-run contract: a key computed here equals the key
+    computed by a different interpreter for the same inputs."""
+    model_cfg = {'type': 'FakeModel', 'path': 'fake', 'max_seq_len': 128,
+                 'abbr': 'ignored', 'batch_size': 7}
+    here_ns = S.namespace_digest(
+        S.model_store_id(model_cfg, 'tokdigest'), 'gen',
+        {'max_out_len': 8})
+    here_key = S.row_key(here_ns, 'Q: what?\nA:', extra=[3, None])
+    here_unit = S.unit_key(model_cfg, {'path': 'ds', 'reader_cfg': {}})
+    script = (
+        'from opencompass_tpu import store as S;'
+        "mc={'type':'FakeModel','path':'fake','max_seq_len':128,"
+        "'abbr':'ignored','batch_size':7};"
+        "ns=S.namespace_digest(S.model_store_id(mc,'tokdigest'),'gen',"
+        "{'max_out_len':8});"
+        "print(S.row_key(ns,'Q: what?\\nA:',extra=[3,None]));"
+        "print(S.unit_key(mc,{'path':'ds','reader_cfg':{}}))")
+    r = subprocess.run([sys.executable, '-c', script], cwd=REPO,
+                       env=_cpu_env(), capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stderr
+    other_key, other_unit = r.stdout.split()
+    assert other_key == here_key
+    assert other_unit == here_unit
+
+
+def test_key_sensitivity():
+    ns = S.namespace_digest('m:t', 'gen', {'max_out_len': 8})
+    base = S.row_key(ns, 'prompt')
+    assert S.row_key(ns, 'prompt2') != base
+    assert S.row_key(ns, 'prompt', extra=[1]) != base
+    assert S.row_key(S.namespace_digest('m:t', 'ppl', None),
+                     'prompt') != base
+    assert S.row_key(S.namespace_digest('m2:t', 'gen',
+                                        {'max_out_len': 8}),
+                     'prompt') != base
+    # abbr-only / eval_cfg-only edits must NOT invalidate a unit
+    mc = {'type': 'FakeModel', 'path': 'fake'}
+    ds = {'path': 'ds', 'reader_cfg': {'test_range': '[0:4]'}}
+    assert S.unit_key(mc, ds) == S.unit_key(
+        mc, dict(ds, abbr='other', eval_cfg={'evaluator': 'x'}))
+    # a test_range edit must
+    assert S.unit_key(mc, ds) != S.unit_key(
+        mc, dict(ds, reader_cfg={'test_range': '[0:5]'}))
+
+
+# -- commit protocol ---------------------------------------------------------
+
+def test_roundtrip_and_reload(tmp_path):
+    st = ResultStore(str(tmp_path / 'store'))
+    key = S.row_key('ns', 'p1')
+    assert st.get(key) is None
+    assert st.put(key, {'x': 1}) is True
+    assert st.put(key, {'x': 1}) is False   # identical recommit: no write
+    assert st.get(key) == {'x': 1}
+    # a fresh instance (fresh process equivalent) reads it back
+    assert ResultStore(str(tmp_path / 'store')).get(key) == {'x': 1}
+
+
+def test_concurrent_writers_one_store(tmp_path):
+    """Several writers (one ResultStore each — own segment files, like
+    processes) commit interleaved; every row survives."""
+    root = str(tmp_path / 'store')
+    n_writers, n_rows = 4, 60
+
+    def write(w):
+        st = ResultStore(root)
+        for i in range(n_rows):
+            st.put(S.row_key('ns', f'w{w}-row{i}'), f'v{w}-{i}')
+            # everyone also races the same shared keys
+            st.put(S.row_key('ns', f'shared-{i % 7}'), f'shared-{i % 7}')
+
+    threads = [threading.Thread(target=write, args=(w,))
+               for w in range(n_writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    st = ResultStore(root)
+    for w in range(n_writers):
+        for i in range(n_rows):
+            assert st.get(S.row_key('ns', f'w{w}-row{i}')) == f'v{w}-{i}'
+    for i in range(7):
+        assert st.get(S.row_key('ns', f'shared-{i}')) == f'shared-{i}'
+    assert st.verify()['ok']
+
+
+def test_torn_write_recovery(tmp_path):
+    """A killed writer tears at most the final line; committed rows
+    before it load fine and commits after it append fine."""
+    root = str(tmp_path / 'store')
+    st = ResultStore(root)
+    keys = [S.row_key('ns', f'p{i}') for i in range(5)]
+    for i, key in enumerate(keys):
+        st.put(key, i)
+    # tear the tail of one segment file (kill -9 mid-os.write)
+    seg = next(p for p, _, _ in st._all_files() if p.endswith('.jsonl'))
+    with open(seg, 'a') as f:
+        f.write('{"k": "deadbeef", "v": "tor')   # no newline, truncated
+    fresh = ResultStore(root)
+    for i, key in enumerate(keys):
+        assert fresh.get(key) == i
+    rep = fresh.verify()
+    assert rep['rows'] == 5 and rep['torn_lines'] == 1 and rep['ok']
+    # the store stays writable after the torn line
+    fresh.put(S.row_key('ns', 'after'), 'ok')
+    assert ResultStore(root).get(S.row_key('ns', 'after')) == 'ok'
+
+
+def test_gc_honors_max_bytes(tmp_path, monkeypatch):
+    root = str(tmp_path / 'store')
+    # several writer instances → several segment files with distinct
+    # mtimes, oldest first
+    for gen in range(4):
+        st = ResultStore(root)
+        for i in range(20):
+            st.put(S.row_key('ns', f'g{gen}-p{i}'), 'x' * 50)
+        time.sleep(0.05)
+    total = ResultStore(root).stats()['total_bytes']
+    budget = total // 2
+    monkeypatch.setenv('OCT_STORE_MAX_BYTES', str(budget))
+    rec = ResultStore(root).gc()     # budget read from env
+    assert rec['max_bytes'] == budget
+    assert rec['remaining_bytes'] <= budget
+    assert rec['deleted_files'] >= 1
+    survivor = ResultStore(root)
+    assert survivor.stats()['total_bytes'] <= budget
+    # newest generation survives (LRU drops oldest files first)
+    assert survivor.get(S.row_key('ns', 'g3-p0')) == 'x' * 50
+    assert survivor.verify()['ok']
+
+
+# -- pipeline integration ----------------------------------------------------
+
+def _run_demo_infer(work, cache_root, max_task_size=2000):
+    """One infer phase of the demo config, in-process (debug runner),
+    against the given cache root.  Returns the partitioned task count."""
+    os.environ['OCT_CACHE_ROOT'] = cache_root
+    S.reset_stores()
+    from opencompass_tpu.config import Config
+    from opencompass_tpu.partitioners import SizePartitioner
+    from opencompass_tpu.runners import LocalRunner
+    cfg = Config.fromfile(osp.join(REPO, 'configs/eval_demo.py'))
+    cfg['work_dir'] = work
+    part = SizePartitioner(osp.join(work, 'predictions/'),
+                           max_task_size=max_task_size,
+                           dataset_size_path=osp.join(work, 'size.json'))
+    tasks = part(cfg)
+    if tasks:
+        LocalRunner(task=dict(type='OpenICLInferTask'),
+                    debug=True)(tasks)
+    return len(tasks)
+
+
+def _prediction_files(work):
+    out = {}
+    pred_root = osp.join(work, 'predictions')
+    for dirpath, _, names in os.walk(pred_root):
+        for name in sorted(names):
+            path = osp.join(dirpath, name)
+            out[osp.relpath(path, pred_root)] = open(path, 'rb').read()
+    return out
+
+
+def test_partitioner_prunes_fully_cached_task(tmp_path, monkeypatch):
+    cache_root = str(tmp_path / 'cache')
+    w1, w2 = str(tmp_path / 'run1'), str(tmp_path / 'run2')
+    monkeypatch.setenv('OCT_CACHE_ROOT', cache_root)
+    n1 = _run_demo_infer(w1, cache_root)
+    assert n1 == 1
+    # identical sweep, fresh work_dir: the partitioner materializes the
+    # predictions pre-launch and emits ZERO tasks
+    n2 = _run_demo_infer(w2, cache_root)
+    assert n2 == 0
+    assert _prediction_files(w1) == _prediction_files(w2)
+
+
+def test_warm_rows_zero_model_calls(tmp_path, monkeypatch):
+    """Acceptance bar: an identical sweep against a warm row store
+    executes zero model forwards and reproduces predictions
+    byte-identically (unit manifests removed, so the partitioner can't
+    shortcut — the inferencers themselves must serve every row)."""
+    import shutil
+    from opencompass_tpu.models import fake
+    cache_root = str(tmp_path / 'cache')
+    w1, w2 = str(tmp_path / 'run1'), str(tmp_path / 'run2')
+    monkeypatch.setenv('OCT_CACHE_ROOT', cache_root)
+    _run_demo_infer(w1, cache_root)
+    shutil.rmtree(osp.join(cache_root, 'store', 'units'))
+
+    def boom(*a, **k):
+        raise AssertionError('model forward on a fully-warm store')
+    monkeypatch.setattr(fake.FakeModel, 'generate', boom)
+    monkeypatch.setattr(fake.FakeModel, 'get_ppl', boom)
+    n2 = _run_demo_infer(w2, cache_root)
+    assert n2 == 1   # task launched, but zero forwards inside it
+    assert _prediction_files(w1) == _prediction_files(w2)
+
+
+def test_kill9_midrun_converges(tmp_path, monkeypatch):
+    """kill -9 mid-sweep: committed rows survive; the rerun executes
+    only the missing rows and converges to the bit-identical output of
+    a never-killed run."""
+    from opencompass_tpu.models import fake
+    ref_cache = str(tmp_path / 'cache_ref')
+    killed_cache = str(tmp_path / 'cache_killed')
+    w_ref = str(tmp_path / 'ref')
+    monkeypatch.setenv('OCT_CACHE_ROOT', ref_cache)
+    _run_demo_infer(w_ref, ref_cache)     # clean reference run
+
+    # child process: SIGKILLs itself on the 3rd generate batch
+    script = f'''
+import os, signal
+os.environ['OCT_CACHE_ROOT'] = {killed_cache!r}
+import sys; sys.path.insert(0, {REPO!r})
+from opencompass_tpu.models import fake
+orig = fake.FakeModel.generate
+state = {{'n': 0}}
+def gen(self, inputs, max_out_len):
+    state['n'] += 1
+    if state['n'] >= 3:
+        os.kill(os.getpid(), signal.SIGKILL)
+    return orig(self, inputs, max_out_len)
+fake.FakeModel.generate = gen
+from opencompass_tpu.config import Config
+from opencompass_tpu.partitioners import SizePartitioner
+from opencompass_tpu.runners import LocalRunner
+cfg = Config.fromfile({osp.join(REPO, 'configs/eval_demo.py')!r})
+work = {str(tmp_path / 'killed')!r}
+cfg['work_dir'] = work
+part = SizePartitioner(os.path.join(work, 'predictions/'),
+                       dataset_size_path=os.path.join(work, 'size.json'))
+LocalRunner(task=dict(type='OpenICLInferTask'), debug=True)(part(cfg))
+'''
+    r = subprocess.run([sys.executable, '-c', script], cwd=REPO,
+                       env=_cpu_env(), capture_output=True, text=True,
+                       timeout=300)
+    assert r.returncode == -signal.SIGKILL
+    # two committed gen batches (batch_size 4) survived the kill
+    killed_store = ResultStore(osp.join(killed_cache, 'store'))
+    assert killed_store.verify()['rows'] == 8
+
+    # rerun in a fresh work_dir: only the 8 missing gen rows (2 batches)
+    # + the never-reached ppl rows execute
+    calls = {'gen_rows': 0}
+    orig_gen = fake.FakeModel.generate
+
+    def counting_gen(self, inputs, max_out_len):
+        calls['gen_rows'] += len(inputs)
+        return orig_gen(self, inputs, max_out_len)
+    monkeypatch.setattr(fake.FakeModel, 'generate', counting_gen)
+    w2 = str(tmp_path / 'rerun')
+    monkeypatch.setenv('OCT_CACHE_ROOT', killed_cache)
+    _run_demo_infer(w2, killed_cache)
+    assert calls['gen_rows'] == 8
+    assert _prediction_files(w_ref) == _prediction_files(w2)
+
+
+def test_no_result_cache_flag(tmp_path, monkeypatch):
+    """result_cache=False (--no-result-cache) really disables binding,
+    committing, and pruning."""
+    from opencompass_tpu.models import FakeModel
+    monkeypatch.setenv('OCT_CACHE_ROOT', str(tmp_path / 'cache'))
+    model = FakeModel()
+    S.bind_model_store(model, {'type': 'FakeModel', 'path': 'fake'},
+                       cfg={'result_cache': False})
+    assert S.context_for(model, 'gen', None) is None
+    # env kill switch too
+    S.bind_model_store(model, {'type': 'FakeModel', 'path': 'fake'})
+    assert S.context_for(model, 'gen', None) is not None
+    monkeypatch.setenv('OCT_RESULT_CACHE', '0')
+    S.bind_model_store(model, {'type': 'FakeModel', 'path': 'fake'})
+    assert S.context_for(model, 'gen', None) is None
+
+
+def test_api_models_never_cached(tmp_path, monkeypatch):
+    from opencompass_tpu.models import FakeModel
+    monkeypatch.setenv('OCT_CACHE_ROOT', str(tmp_path / 'cache'))
+    model = FakeModel()
+    monkeypatch.setattr(FakeModel, 'supports_result_cache', False,
+                        raising=False)
+    S.bind_model_store(model, {'type': 'FakeModel', 'path': 'fake'})
+    assert S.context_for(model, 'gen', None) is None
+
+
+def test_eval_skip_is_mtime_aware(tmp_path):
+    """Satellite: a result older than its predictions is re-evaluated;
+    a newer one is skipped."""
+    from opencompass_tpu.config import Config
+    from opencompass_tpu.tasks import OpenICLEvalTask
+    mc = {'type': 'FakeModel', 'path': 'fake', 'abbr': 'm'}
+    dc = {'path': 'ds', 'abbr': 'd',
+          'reader_cfg': {'input_columns': ['q'], 'output_column': 'a'}}
+    task = OpenICLEvalTask(Config({'models': [mc], 'datasets': [[dc]],
+                                   'work_dir': str(tmp_path)}))
+    task.model_cfg, task.dataset_cfg = mc, dc
+    pred = tmp_path / 'predictions' / 'm' / 'd.json'
+    res = tmp_path / 'results' / 'm' / 'd.json'
+    pred.parent.mkdir(parents=True)
+    res.parent.mkdir(parents=True)
+    pred.write_text('{}')
+    res.write_text('{}')
+    now = time.time()
+    os.utime(pred, (now, now))
+    os.utime(res, (now + 5, now + 5))
+    assert task._result_fresh(str(res)) is True
+    os.utime(pred, (now + 10, now + 10))   # re-inferred predictions
+    assert task._result_fresh(str(res)) is False
+
+
+def test_runner_oct_env_exports(monkeypatch, tmp_path):
+    """Satellite: cluster runners splice OCT_* trace + cache env into
+    the submitted command."""
+    from opencompass_tpu import obs
+    from opencompass_tpu.runners import SlurmRunner
+    monkeypatch.setenv('OCT_CACHE_ROOT', '/sweeps/cache root')
+    monkeypatch.setenv('OCT_STORE_MAX_BYTES', '12345')
+    runner = SlurmRunner(task=dict(type='OpenICLInferTask'))
+    try:
+        tracer = obs.init_obs(str(tmp_path), enabled=True)
+        exports = runner.oct_env_exports()
+        assert "OCT_CACHE_ROOT='/sweeps/cache root'" in exports
+        assert 'OCT_STORE_MAX_BYTES=12345' in exports
+        assert f'OCT_TRACE_ID={tracer.trace_id}' in exports
+        assert 'OCT_OBS_DIR=' in exports
+    finally:
+        obs.reset_obs()
+    # untraced: cache roots still propagate
+    exports = runner.oct_env_exports()
+    assert 'OCT_CACHE_ROOT=' in exports
+    assert 'OCT_TRACE_ID' not in exports
+
+
+def test_append_jsonl_atomic(tmp_path):
+    from opencompass_tpu.utils.fileio import append_jsonl_atomic
+    path = str(tmp_path / 'x.jsonl')
+    append_jsonl_atomic(path, [{'k': 'a', 'v': 1}])
+    append_jsonl_atomic(path, [{'k': 'b', 'v': 2}, {'k': 'c', 'v': 3}])
+    recs = list(S.iter_jsonl(path))
+    assert [r['k'] for r in recs] == ['a', 'b', 'c']
+
+
+# -- cache CLI ---------------------------------------------------------------
+
+def _fixture_store(root) -> str:
+    st = ResultStore(root)
+    for i in range(10):
+        st.put(S.row_key('ns', f'p{i}'), f'pred-{i}')
+    st.put_unit('cafebabe', {'v': 1, 'n_rows': 2,
+                             'results': {'0': {}, '1': {}}})
+    return root
+
+
+def test_cli_cache_smoke(tmp_path, capsys):
+    from opencompass_tpu.store.cli import main
+    root = _fixture_store(str(tmp_path / 'store'))
+
+    assert main(['stats', '--store', root]) == 0
+    out = capsys.readouterr().out
+    assert 'rows: 10' in out and 'units: 1' in out
+
+    assert main(['verify', '--store', root, '--json']) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep['ok'] and rep['rows'] == 10
+
+    # corrupt unit → verify fails (the CI gate)
+    with open(osp.join(root, 'units', 'cafebabe.json'), 'w') as f:
+        f.write('{not json')
+    assert main(['verify', '--store', root]) == 1
+    capsys.readouterr()
+
+    # gc with no budget is a no-op; with a tiny budget it deletes
+    assert main(['gc', '--store', root]) == 0
+    assert 'nothing deleted' in capsys.readouterr().out
+    assert main(['gc', '--store', root, '--max-bytes', '1']) == 0
+    assert ResultStore(root).stats()['total_bytes'] <= 1
+
+
+def test_cli_cache_resolves_work_dir(tmp_path, capsys):
+    from opencompass_tpu.store.cli import main
+    _fixture_store(str(tmp_path / 'out' / 'cache' / 'store'))
+    assert main(['stats', str(tmp_path / 'out'), '--json']) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats['rows'] == 10
+
+
+def test_cli_cache_env_beats_work_dir_fallback(tmp_path, capsys,
+                                               monkeypatch):
+    """With OCT_CACHE_ROOT set, the CLI must inspect the store the
+    runtime actually wrote (env-first, like compile_cache.cache_root),
+    not an empty {work_dir}/cache/store."""
+    from opencompass_tpu.store.cli import resolve_store_dir
+    real = str(tmp_path / 'shared')
+    _fixture_store(osp.join(real, 'store'))
+    monkeypatch.setenv('OCT_CACHE_ROOT', real)
+    assert resolve_store_dir(str(tmp_path / 'out')) == \
+        osp.join(real, 'store')
+    # an explicit store dir still wins over the env
+    store_dir = str(tmp_path / 'direct' / 'store')
+    _fixture_store(store_dir)
+    assert resolve_store_dir(store_dir) == store_dir
