@@ -177,6 +177,38 @@ def _a100_estimate(cfg, gen_batch=GEN_BATCH):
     }
 
 
+TRAJECTORY_JSON = 'BENCH_TRAJECTORY.json'
+
+
+def _append_trajectory(leg, metric, value, unit, direction='higher',
+                       detail=None):
+    """Append one normalized record to ``BENCH_TRAJECTORY.json`` (a JSON
+    array) so the per-PR perf trajectory accumulates round over round;
+    ``cli ledger check --trajectory BENCH_TRAJECTORY.json`` gates the
+    latest value against the previous one.  ``direction`` says which way
+    is better ('higher' for speedups/hit rates, 'lower' for seconds).
+    Never raises — the bench numbers still print when the file is
+    unwritable."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        TRAJECTORY_JSON)
+    try:
+        try:
+            with open(path, encoding='utf-8') as f:
+                records = json.load(f)
+            if not isinstance(records, list):
+                records = []
+        except (OSError, ValueError):
+            records = []
+        records.append({'v': 1, 'ts': round(time.time(), 3), 'leg': leg,
+                        'metric': metric, 'value': value, 'unit': unit,
+                        'direction': direction, 'detail': detail})
+        from opencompass_tpu.utils.fileio import atomic_write_json
+        atomic_write_json(path, records, dump_kwargs={'indent': 2,
+                                                      'default': str})
+    except Exception:
+        pass
+
+
 def _bench_planner():
     """Host-only batch-planner leg (icl/inferencers/schedule.py): padding
     efficiency and distinct jit-shape count, planned vs sequential
@@ -331,6 +363,10 @@ def _bench_warm_path(out_json='BENCH_WARM.json'):
             json.dump(record, f, indent=2)
     except OSError:
         pass
+    _append_trajectory(
+        'warm_path', 'compile_speedup', record.get('compile_speedup'),
+        'x', detail={'cold_s': record.get('compile_seconds_cold'),
+                     'warm_s': record.get('compile_seconds_warm')})
     return record
 
 
@@ -453,6 +489,86 @@ def _bench_result_cache(out_json='BENCH_STORE.json'):
             json.dump(record, f, indent=2)
     except OSError:
         pass
+    _append_trajectory(
+        'result_cache', 'warm_rows_hit_rate',
+        record.get('warm_rows_hit_rate'), 'fraction',
+        detail={'cold_batches': record.get('cold_batches'),
+                'warm_rows_batches': record.get('warm_rows_batches')})
+    return record
+
+
+def _bench_flight_recorder(out_json='BENCH_FLIGHT.json'):
+    """detail.flight_recorder: one FakeModel demo sweep with the flight
+    recorder on — asserts the observability contract end to end (per-
+    batch timeline files written, Chrome export well-formed, a ledger
+    record appended) and records the recorder's measured overhead-free
+    throughput.  Device-free; runs on CPU hosts."""
+    import os.path as osp
+    import tempfile
+
+    from opencompass_tpu import ledger, obs
+    from opencompass_tpu.config import Config
+    from opencompass_tpu.obs.export import build_chrome_trace
+    from opencompass_tpu.obs.timeline import summarize_timelines
+    from opencompass_tpu.partitioners import SizePartitioner
+    from opencompass_tpu.runners import LocalRunner
+
+    work = tempfile.mkdtemp(prefix='oct_flight_')
+    cache_root = osp.join(work, 'cache')
+    prev_root = os.environ.get('OCT_CACHE_ROOT')
+    os.environ['OCT_CACHE_ROOT'] = cache_root
+    cfg = Config.fromfile(
+        osp.join(osp.dirname(osp.abspath(__file__)),
+                 'configs/eval_demo.py'))
+    cfg['work_dir'] = work
+    cfg['obs'] = True
+    cfg['result_cache'] = False   # every row must execute and record
+    obs.reset_obs()
+    tracer = obs.init_obs(work, enabled=True)
+    part = SizePartitioner(osp.join(work, 'predictions/'),
+                           dataset_size_path=osp.join(work, 'size.json'))
+    tasks = part(cfg)
+    t0 = time.perf_counter()
+    status = LocalRunner(task=dict(type='OpenICLInferTask'),
+                         debug=True)(tasks)
+    wall = time.perf_counter() - t0
+    tracer.close()
+    summaries = summarize_timelines(tracer.obs_dir)
+    doc = build_chrome_trace(work)
+    ledger_records = ledger.append_run(work, run_id='bench_flight')
+    obs.reset_obs()
+    if prev_root is None:
+        os.environ.pop('OCT_CACHE_ROOT', None)
+    else:
+        os.environ['OCT_CACHE_ROOT'] = prev_root
+    batches = sum(s.get('batches', 0) for s in summaries.values())
+    tps = [s['tokens_per_sec'] for s in summaries.values()
+           if s.get('tokens_per_sec')]
+    record = {
+        'v': 1,
+        'workload': 'FakeModel demo sweep, --obs flight recorder on '
+                    '(timeline + Chrome export + ledger record)',
+        'n_tasks': len(tasks),
+        'failed': sum(1 for _, rc in status if rc != 0),
+        'wall_seconds': round(wall, 3),
+        'timeline_files': len(summaries),
+        'timeline_batches': batches,
+        'export_events': len(doc.get('traceEvents') or []),
+        'ledger_records': len(ledger_records),
+        'tokens_per_sec_mean': round(sum(tps) / len(tps), 1)
+        if tps else None,
+    }
+    try:
+        with open(os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), out_json),
+                'w') as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
+    _append_trajectory(
+        'flight_recorder', 'timeline_batches', batches, 'batches',
+        detail={'export_events': record['export_events'],
+                'ledger_records': record['ledger_records']})
     return record
 
 
@@ -752,6 +868,7 @@ def main():
             'batch_planner': _bench_planner(),
             'warm_path': _bench_warm_path(),
             'result_cache': _bench_result_cache(),
+            'flight_recorder': _bench_flight_recorder(),
             'a100_est': a100,
             'a100_est_b32': a100_b32,
             'small': {
@@ -784,5 +901,10 @@ if __name__ == '__main__':
         # standalone result-store leg (device-free; runs on CPU hosts)
         print(json.dumps({'metric': 'result_cache', 'v': 1,
                           'detail': _bench_result_cache()}))
+        sys.exit(0)
+    if '--flight-recorder' in sys.argv:
+        # standalone observability leg (device-free; runs on CPU hosts)
+        print(json.dumps({'metric': 'flight_recorder', 'v': 1,
+                          'detail': _bench_flight_recorder()}))
         sys.exit(0)
     main()

@@ -15,12 +15,17 @@ Usage::
     python run.py cfg.py --no-workers               # one subprocess per task
     python run.py cfg.py --no-result-cache          # skip the result store
     python -m opencompass_tpu.cli trace WORK_DIR    # render trace report
+    python -m opencompass_tpu.cli trace WORK_DIR --export trace.json
+                                    # Chrome/Perfetto export (ui.perfetto.dev)
     python -m opencompass_tpu.cli status WORK_DIR --watch   # live progress
     python -m opencompass_tpu.cli plan cfg.py       # batch-plan dry run
     python -m opencompass_tpu.cli plan cfg.py --cache-dir DIR  # warm/cold probe
     python -m opencompass_tpu.cli cache stats WORK_DIR      # result store
     python -m opencompass_tpu.cli cache verify WORK_DIR     # integrity (CI)
     python -m opencompass_tpu.cli cache gc WORK_DIR --max-bytes N
+    python -m opencompass_tpu.cli ledger list WORK_DIR      # perf ledger
+    python -m opencompass_tpu.cli ledger diff WORK_DIR      # vs baseline
+    python -m opencompass_tpu.cli ledger check WORK_DIR     # CI perf gate
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files
@@ -127,6 +132,15 @@ def parse_args():
                         'with `python -m opencompass_tpu.cli trace '
                         '<work_dir>`); config key `obs = True` is '
                         'equivalent')
+    parser.add_argument('--xprof',
+                        action='store_true',
+                        help='record one driver-managed jax.profiler '
+                        'session for the whole run under '
+                        '{work_dir}/obs/xprof (op-level XProf/'
+                        'TensorBoard view; linked from `cli trace '
+                        '--export`).  Driver-process device work only — '
+                        'use --profile for per-task subprocess traces.  '
+                        'Implies --obs')
     parser.add_argument('--no-result-cache',
                         action='store_false',
                         default=None,
@@ -160,7 +174,8 @@ def get_config_from_arg(args) -> Config:
         cfg.pop('lark_bot_url', None)
     if args.profile:
         cfg['profile'] = True
-    if args.obs or args.obs_port is not None:
+    if args.obs or args.obs_port is not None \
+            or getattr(args, 'xprof', False):
         cfg['obs'] = True
     if args.use_workers is not None:
         cfg['use_workers'] = args.use_workers
@@ -248,6 +263,15 @@ def cache_main(argv=None) -> int:
     return store_main(argv)
 
 
+def ledger_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli ledger list|diff|check|pin`` —
+    the cross-run performance regression ledger under
+    ``{cache_root}/ledger/``; ``check`` exits non-zero on thresholded
+    throughput/accuracy regressions (the CI perf gate)."""
+    from opencompass_tpu.ledger.cli import main as ledger_cli_main
+    return ledger_cli_main(argv)
+
+
 def main():
     # subcommand dispatch before the run-config parser: `trace`/`status`
     # take a work_dir, not a config file
@@ -259,6 +283,8 @@ def main():
         raise SystemExit(plan_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'cache':
         raise SystemExit(cache_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == 'ledger':
+        raise SystemExit(ledger_main(sys.argv[2:]))
     args = parse_args()
     cfg = get_config_from_arg(args)
     work_dir = cfg['work_dir']
@@ -321,16 +347,52 @@ def main():
         else:
             logger.warning(f'obs http endpoint failed to bind port '
                            f'{args.obs_port}; continuing without it')
+    # driver-managed XProf session (--xprof): one jax.profiler capture
+    # spanning every phase, written under obs/ so `cli trace --export`
+    # links it next to the Chrome trace.  Never-fail: a backend without
+    # profiler support degrades to no capture.
+    xprof_on = False
+    if getattr(args, 'xprof', False) and tracer.enabled:
+        try:
+            import jax
+            xprof_dir = osp.join(tracer.obs_dir, 'xprof')
+            os.makedirs(xprof_dir, exist_ok=True)
+            jax.profiler.start_trace(xprof_dir)
+            xprof_on = True
+            logger.info(f'xprof session capture at {xprof_dir}')
+        except Exception as exc:
+            logger.warning(f'--xprof unavailable: {exc}')
     try:
         with tracer.span('run', config=args.config, mode=args.mode):
             _run_phases(args, cfg, dir_time_str)
     finally:
+        if xprof_on:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+            except Exception as exc:
+                logger.warning(f'xprof stop_trace failed: {exc}')
         if tracer.enabled:
             from opencompass_tpu.obs.live import mark_run
             mark_run(tracer.obs_dir, 'done')
         if server is not None:
             server.stop()
         tracer.close()
+    # regression ledger: append one perf fingerprint per (model,
+    # dataset) to {cache_root}/ledger/runs.jsonl so future runs (and
+    # CI's `cli ledger check`) can diff against this one.  Never-fail:
+    # a broken ledger cannot fail a finished run.
+    try:
+        from opencompass_tpu import ledger
+        fresh = ledger.append_run(cfg['work_dir'], run_id=dir_time_str)
+        if fresh:
+            logger.info(
+                f'ledger: {len(fresh)} record(s) appended to '
+                f'{ledger.runs_path()} — compare runs with: '
+                'python -m opencompass_tpu.cli ledger diff '
+                f'{work_dir}')
+    except Exception:
+        logger.warning('ledger append failed', exc_info=True)
     if tracer.enabled:
         logger.info('obs events at '
                     f'{osp.join(cfg["work_dir"], "obs", "events.jsonl")} — '

@@ -6,15 +6,22 @@ Parity: reference openicl/icl_inferencer/icl_base_inferencer.py:15-163.
 """
 import json
 import os
+import time
 from pathlib import Path
 from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
 from opencompass_tpu.icl.retrievers.base import is_main_process
-from opencompass_tpu.obs import get_tracer
+from opencompass_tpu.obs import get_timeline, get_tracer
 
 from . import schedule
+
+# test/bench hook: a positive float makes every collected batch sleep
+# that many seconds — the deterministic "injected slowdown" the ledger's
+# regression gate is exercised against (bench.py flight-recorder leg,
+# tests/test_flight_recorder.py).  Read per plan, not per batch.
+ENV_DEBUG_BATCH_SLEEP = 'OCT_DEBUG_BATCH_SLEEP_S'
 
 
 class BaseInferencer:
@@ -121,10 +128,35 @@ class BaseInferencer:
         from opencompass_tpu.store import context_for
         return context_for(self.model, kind, params)
 
-    def run_plan(self, plan: schedule.BatchPlan, dispatch, collect) -> float:
+    def run_plan(self, plan: schedule.BatchPlan, dispatch, collect,
+                 kind: Optional[str] = None,
+                 cached_rows: int = 0) -> float:
         """Execute a plan (double-buffered when planning is on) and
         charge overlap/shape telemetry to the model's perf counters and
-        the obs plane.  Returns overlapped host seconds."""
+        the obs plane.  ``kind`` names the measurement path
+        (gen/ppl/clp) for the flight recorder; ``cached_rows`` is how
+        many rows the result store served before planning.  Returns
+        overlapped host seconds."""
+        kind = kind or 'batch'
+        timeline = get_timeline()
+        if timeline.enabled:
+            if plan.batches:
+                dispatch, collect = self._record_batches(
+                    plan, dispatch, collect, timeline, kind, cached_rows)
+            else:
+                # a fully store-served plan executes no batches but must
+                # still leave its plan record — the ledger's kind
+                # attribution and cached-row accounting ride on it
+                timeline.plan(kind, stats=plan.stats.as_dict(),
+                              planned=plan.planned,
+                              cached_rows=cached_rows)
+        sleep_s = float(os.environ.get(ENV_DEBUG_BATCH_SLEEP, 0) or 0)
+        if sleep_s > 0:
+            inner_collect = collect
+
+            def collect(batch, result):
+                time.sleep(sleep_s)
+                inner_collect(batch, result)
         depth = 1 if plan.planned else 0
         overlap = schedule.execute_plan(plan, dispatch, collect,
                                         depth=depth)
@@ -147,6 +179,71 @@ class BaseInferencer:
                     plan.stats.n_shapes)
                 tracer.histogram('planner.overlap_seconds').observe(overlap)
         return overlap
+
+    def _record_batches(self, plan, dispatch, collect, timeline,
+                        kind: str, cached_rows: int):
+        """Wrap ``dispatch``/``collect`` so every executed batch lands in
+        the flight recorder.  Perf-counter deltas are taken sequentially
+        at each collect (every increment lands in exactly one record —
+        totals stay exact under the double-buffered pipeline, at the
+        cost of ±1-batch attribution for work the pipeline overlapped).
+        """
+        from opencompass_tpu.utils.perf import PerfCounters
+        timeline.plan(kind, stats=plan.stats.as_dict(),
+                      planned=plan.planned, cached_rows=cached_rows)
+        model = self.model
+        perf = getattr(model, 'perf', None)
+        if not isinstance(perf, PerfCounters):
+            perf = None
+        state = {'snap': perf.snapshot() if perf else None, 'meta': {}}
+        inner_dispatch, inner_collect = dispatch, collect
+
+        def rec_dispatch(batch):
+            calls0 = getattr(model, '_tl_call_count', 0)
+            wall = time.time()
+            t0 = time.perf_counter()
+            handle = inner_dispatch(batch)
+            state['meta'][id(batch)] = (
+                wall, t0, time.perf_counter() - t0,
+                getattr(model, '_tl_call_count', 0) - calls0)
+            return handle
+
+        def rec_collect(batch, result):
+            wall, t0, dispatch_s, n_calls = state['meta'].pop(
+                id(batch), (None, None, None, 0))
+            fields = {
+                'shape': list(batch.shape),
+                'rows': len(batch.indices),
+                'real_tokens': batch.real_tokens,
+                'pad_tokens': batch.padded_tokens - batch.real_tokens,
+            }
+            if t0 is not None:
+                fields['ts'] = round(wall, 6)
+                fields['dispatch_s'] = round(dispatch_s, 6)
+                fields['batch_s'] = round(time.perf_counter() - t0, 6)
+            if perf is not None:
+                d = perf.delta_since(state['snap'])
+                state['snap'] = perf.snapshot()
+                fields.update(
+                    device_s=round(d['device_seconds'], 6),
+                    compile_s=round(d['compile_seconds'], 6),
+                    tokens_in=int(d['tokens_in']),
+                    tokens_out=int(d['tokens_out']),
+                    first_calls=int(d['first_calls']),
+                    cc_hits=int(d['compile_cache_hits']) or None,
+                    cc_misses=int(d['compile_cache_misses']) or None,
+                )
+            pop = getattr(model, 'pop_batch_calls', None)
+            if pop is not None and n_calls:
+                calls = pop(n_calls)
+                if calls:
+                    fields['calls'] = calls
+            # record before the scatter so a failing collect still
+            # leaves the executed batch on the flight recorder
+            timeline.batch(kind, **fields)
+            inner_collect(batch, result)
+
+        return rec_dispatch, rec_collect
 
     def inference(self, retriever, ice_template=None, prompt_template=None,
                   output_json_filepath=None, output_json_filename=None):

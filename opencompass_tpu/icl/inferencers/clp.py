@@ -131,7 +131,7 @@ class CLPInferencer(BaseInferencer):
         if obs_on and n_hits:
             from opencompass_tpu.obs import get_heartbeat
             get_heartbeat().progress(n_hits, len(prompt_list),
-                                     force=True)
+                                     cached=n_hits, force=True)
         if self.plan_enabled and miss:
             lengths = self.measure_lengths(
                 [prompt_list[i] for i in miss], 'gen',
@@ -169,7 +169,8 @@ class CLPInferencer(BaseInferencer):
         # out-of-order collection is safe here: save_ice pre-created
         # every index's entry in item order, and collect only fills
         # existing entries, so the dict order never changes
-        self.run_plan(plan, dispatch, collect)
+        self.run_plan(plan, dispatch, collect, kind='clp',
+                      cached_rows=n_hits)
 
         if self.is_main_process:
             os.makedirs(output_json_filepath, exist_ok=True)

@@ -135,9 +135,11 @@ class GenInferencer(BaseInferencer):
         obs_on = get_tracer().enabled
         if obs_on:
             # seed the heartbeat so a resumed task reports its true
-            # starting position before the first batch lands
+            # starting position before the first batch lands; resumed +
+            # store-served rows are marked cached so the ETA
+            # extrapolates from computed-row rate only
             get_heartbeat().progress(len(done_idx), len(prompts),
-                                    force=True)
+                                     cached=len(done_idx), force=True)
 
         # a generation batch pads prompts to max_seq_len - max_out_len at
         # most (the model reserves decode room); clamp planned lengths the
@@ -181,7 +183,8 @@ class GenInferencer(BaseInferencer):
                 handler.write_to_json(out_dir, 'tmp_' + out_name)
                 state['last_flush'] = state['completed']
 
-        self.run_plan(plan, dispatch, collect)
+        self.run_plan(plan, dispatch, collect, kind='gen',
+                      cached_rows=len(done_idx))
 
         # restore dataset order: out-of-order execution (and idx-keyed
         # resume) fill results_dict in completion order

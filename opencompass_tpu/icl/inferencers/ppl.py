@@ -233,8 +233,10 @@ class PPLInferencer(BaseInferencer):
         n_todo = len(todo_items)
         done_rows = n_rows - n_labels * n_todo
         if obs_on:
-            # cached rows count as done from the first heartbeat
-            get_heartbeat().progress(done_rows, n_rows, force=True)
+            # cached rows count as done from the first heartbeat, and
+            # are flagged so ETA extrapolates from computed rows only
+            get_heartbeat().progress(done_rows, n_rows,
+                                     cached=done_rows, force=True)
         # compact flat row space (li * n_todo + ti) over store misses
         # with one indivisible group per item, so plan stats see the
         # real device batches
@@ -273,7 +275,8 @@ class PPLInferencer(BaseInferencer):
                 observe_batch('inferencer.ppl_batches', t0,
                               done=state['done'], total=n_rows)
 
-        self.run_plan(plan, dispatch, collect)
+        self.run_plan(plan, dispatch, collect, kind='ppl',
+                      cached_rows=done_rows)
         return score_table
 
     def _score(self, rows: List[_Row], normalizing_str) -> List[float]:
@@ -310,8 +313,9 @@ class PPLInferencer(BaseInferencer):
             miss = [i for i in range(len(rows)) if i not in hits]
             if obs_on and hits:
                 # cached rows count as done (inference() seeded the
-                # unit's done/total)
-                get_heartbeat().add(len(hits))
+                # unit's done/total) but are tracked separately so the
+                # ETA only extrapolates from computed-row rate
+                get_heartbeat().add(len(hits), cached=True)
         if self.plan_enabled and miss:
             lengths = self.measure_lengths(
                 [rows[i].prompt for i in miss], 'ppl')
@@ -346,7 +350,8 @@ class PPLInferencer(BaseInferencer):
                 # inference() seeded done/total for the whole unit
                 get_heartbeat().add(len(batch.indices))
 
-        self.run_plan(plan, dispatch, collect)
+        self.run_plan(plan, dispatch, collect, kind='ppl',
+                      cached_rows=len(rows) - len(miss))
         return scores
 
     def plan_preview(self, retriever, ice_template=None,

@@ -1,0 +1,22 @@
+"""Cross-run performance regression ledger (``{cache_root}/ledger/``).
+
+One fingerprint record per (run, model, dataset, kind) — tokens/s,
+padding efficiency, compile seconds, compile-cache and result-store hit
+rates, accuracy — appended at the end of every run and compared across
+runs by ``cli ledger list|diff|check|pin``.  ``check`` exits non-zero on
+thresholded throughput/accuracy regressions, so it gates CI and future
+PRs the same way ``cli cache verify`` gates store integrity.
+"""
+from opencompass_tpu.ledger.ledger import (LEDGER_SUBDIR, LEDGER_VERSION,
+                                           append_run, check_records,
+                                           check_trajectory,
+                                           collect_run_records,
+                                           diff_records, iter_ledger,
+                                           ledger_dir, pin_baseline,
+                                           read_baseline, resolve_runs,
+                                           runs_path)
+
+__all__ = ['LEDGER_SUBDIR', 'LEDGER_VERSION', 'append_run',
+           'check_records', 'check_trajectory', 'collect_run_records',
+           'diff_records', 'iter_ledger', 'ledger_dir', 'pin_baseline',
+           'read_baseline', 'resolve_runs', 'runs_path']

@@ -1,0 +1,236 @@
+"""``python -m opencompass_tpu.cli ledger {list|diff|check|pin}``.
+
+Operates purely on the ledger directory — no model, no config, works on
+a dead run.  Resolution mirrors ``cli cache``: ``--ledger DIR`` wins,
+then a positional path that IS a ledger dir, then ``OCT_CACHE_ROOT``,
+then ``<path>/cache/ledger``.
+
+- ``list``: the run series with per-run aggregate throughput.
+- ``diff [--baseline RUN] [--run RUN]``: per-(model, dataset, kind)
+  deltas vs the baseline (pinned, or the previous run).
+- ``check``: same comparison, exits **2** when any row regresses past
+  ``--max-slowdown`` / ``--max-accuracy-drop`` — the CI gate.  With
+  ``--trajectory BENCH_TRAJECTORY.json`` it additionally gates the
+  per-PR bench legs (the run ledger still gates whenever it has
+  records).
+- ``pin RUN``: pin the baseline run id (``baseline.json``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+from typing import List, Optional
+
+from opencompass_tpu.ledger import ledger as ledmod
+
+
+def resolve_ledger_dir(path: Optional[str],
+                       explicit: Optional[str] = None) -> Optional[str]:
+    if explicit:
+        return explicit
+    if path and (osp.isfile(osp.join(path, ledmod.RUNS_FILE))
+                 or osp.basename(osp.normpath(path))
+                 == ledmod.LEDGER_SUBDIR):
+        return path
+    root = os.environ.get('OCT_CACHE_ROOT')
+    if root:
+        return osp.join(root, ledmod.LEDGER_SUBDIR)
+    if path:
+        return osp.join(path, 'cache', ledmod.LEDGER_SUBDIR)
+    return None
+
+
+def _fmt(value, suffix=''):
+    return '-' if value is None else f'{value}{suffix}'
+
+
+def _table(rows: List[List]) -> str:
+    from opencompass_tpu.obs.report import _table as t
+    return t(rows)
+
+
+def _cmd_list(records, args) -> int:
+    series = ledmod.run_series(records)
+    baseline = ledmod.read_baseline(args.ledger_dir)
+    if args.json:
+        out = []
+        for run in series:
+            rows = [r for r in records if r['run'] == run]
+            out.append({'run': run, 'records': len(rows),
+                        'pinned_baseline': run == baseline})
+        print(json.dumps(out, indent=2))
+        return 0
+    if not series:
+        print('(empty ledger)')
+        return 0
+    table = [['run', 'records', 'tokens/s (mean)', 'pad_eff (mean)',
+              'errors', '']]
+    for run in series:
+        rows = [r for r in records if r['run'] == run]
+        tps = [r['tokens_per_sec'] for r in rows
+               if isinstance(r.get('tokens_per_sec'), (int, float))]
+        pe = [r['pad_eff'] for r in rows
+              if isinstance(r.get('pad_eff'), (int, float))]
+        table.append([
+            run, len(rows),
+            round(sum(tps) / len(tps), 1) if tps else '-',
+            round(sum(pe) / len(pe), 4) if pe else '-',
+            sum(1 for r in rows if r.get('error')),
+            '<- baseline' if run == baseline else ''])
+    print(_table(table))
+    return 0
+
+
+def _cmd_diff(records, args) -> int:
+    base, cur = ledmod.resolve_runs(records, args.baseline, args.run,
+                                    args.ledger_dir)
+    if not base or not cur or base == cur:
+        print('need two runs to diff — the ledger has '
+              f'{len(ledmod.run_series(records))} run(s) '
+              '(pin or pass --baseline)')
+        return 1
+    rows = ledmod.diff_records(records, base, cur)
+    if args.json:
+        print(json.dumps({'baseline': base, 'run': cur, 'rows': rows},
+                         indent=2))
+        return 0
+    print(f'baseline {base} -> run {cur}')
+    table = [['model/dataset', 'kind', 'tok/s', 'base', 'Δ%', 'acc Δ']]
+    for row in rows:
+        if not (row['in_baseline'] and row['in_run']):
+            note = ('only in run' if row['in_run']
+                    else 'only in baseline')
+            table.append([f"{row['model']}/{row['dataset']}",
+                          row.get('kind') or '-', '-', '-', note, '-'])
+            continue
+        rel = row.get('tokens_per_sec_rel')
+        acc = row.get('accuracy_delta')
+        # a fully store-served side did no device work — its tokens/s
+        # is not comparable (and `check` skips the throughput gate)
+        cached = 1.0 in (row.get('store_hit_rate'),
+                         row.get('store_hit_rate_base'))
+        table.append([
+            f"{row['model']}/{row['dataset']}", row.get('kind') or '-',
+            _fmt(row.get('tokens_per_sec')),
+            _fmt(row.get('tokens_per_sec_base')),
+            (f'{rel:+.1%}' if rel is not None else '-')
+            + (' (cached)' if cached else ''),
+            ' '.join(f'{m}{d:+.2f}' for m, d in acc.items())
+            if acc else '-'])
+    print(_table(table))
+    return 0
+
+
+def _cmd_check(records, args) -> int:
+    regressions = []
+    compared = None
+    if args.trajectory:
+        regressions += ledmod.check_trajectory(
+            args.trajectory, max_slowdown=args.max_slowdown)
+    # the run ledger gates whenever it has records — `--trajectory` adds
+    # the bench gate, it must not silently disable this one
+    if not args.trajectory or args.baseline or args.run or records:
+        base, cur = ledmod.resolve_runs(records, args.baseline,
+                                        args.run, args.ledger_dir)
+        if base and cur and base != cur:
+            compared = (base, cur)
+            regressions += ledmod.check_records(
+                records, base, cur, max_slowdown=args.max_slowdown,
+                max_accuracy_drop=args.max_accuracy_drop)
+        elif not args.trajectory:
+            # a gate with no baseline passes: the FIRST run of a sweep
+            # (or a fresh cache root) has nothing to regress against,
+            # and CI must not go red before a series exists
+            print('nothing to compare yet (fewer than two runs in the '
+                  'ledger and no --trajectory file); ok')
+            return 0
+    if args.json:
+        print(json.dumps({'compared': compared,
+                          'regressions': regressions}, indent=2))
+    else:
+        if compared:
+            print(f'baseline {compared[0]} -> run {compared[1]}')
+        for reg in regressions:
+            if reg['regression'] == 'trajectory':
+                print(f"REGRESSION [bench {reg['leg']}/{reg['metric']}]: "
+                      f"{reg['previous']} -> {reg['current']} "
+                      f"({reg['rel']:+.1%})")
+            elif reg['regression'] == 'throughput':
+                print(f"REGRESSION [{reg['model']}/{reg['dataset']}]: "
+                      f"tokens/s {reg['tokens_per_sec_base']} -> "
+                      f"{reg['tokens_per_sec']} "
+                      f"({reg['tokens_per_sec_rel']:+.1%}, threshold "
+                      f"{reg['threshold']:.0%})")
+            else:
+                print(f"REGRESSION [{reg['model']}/{reg['dataset']}]: "
+                      f"accuracy {reg['drops']}")
+        print('ok' if not regressions
+              else f'{len(regressions)} regression(s)')
+    return 2 if regressions else 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog='ledger', description='Cross-run performance regression '
+        'ledger: list runs, diff vs a baseline, gate CI on thresholded '
+        'throughput/accuracy regressions')
+    parser.add_argument('command',
+                        choices=['list', 'diff', 'check', 'pin'])
+    parser.add_argument('path', nargs='?', default=None,
+                        help='a ledger directory, a sweep output root '
+                        '(its cache/ledger is used unless '
+                        '$OCT_CACHE_ROOT is set), or — for pin — the '
+                        'run id to pin')
+    parser.add_argument('--ledger', default=None, metavar='DIR',
+                        help='explicit ledger directory (overrides '
+                        'path)')
+    parser.add_argument('--baseline', default=None, metavar='RUN',
+                        help='baseline run id (default: the pinned '
+                        'baseline, else the previous run)')
+    parser.add_argument('--run', default=None, metavar='RUN',
+                        help='run id to compare (default: latest)')
+    parser.add_argument('--max-slowdown', type=float, default=0.25,
+                        metavar='FRAC',
+                        help='tokens/s may drop at most this fraction '
+                        'below baseline (default 0.25)')
+    parser.add_argument('--max-accuracy-drop', type=float, default=0.5,
+                        metavar='PTS',
+                        help='accuracy may drop at most this many '
+                        'points below baseline (default 0.5)')
+    parser.add_argument('--trajectory', default=None, metavar='FILE',
+                        help='additionally gate a bench '
+                        'BENCH_TRAJECTORY.json (latest vs previous '
+                        'value per leg)')
+    parser.add_argument('--json', action='store_true',
+                        help='emit machine-readable JSON')
+    args = parser.parse_args(argv)
+
+    if args.command == 'pin':
+        run_id = args.run or args.path
+        if not run_id:
+            print('pin needs a run id (positional or --run)')
+            return 1
+        args.ledger_dir = resolve_ledger_dir(None, args.ledger)
+        try:
+            path = ledmod.pin_baseline(run_id, args.ledger_dir)
+        except ValueError as exc:
+            print(exc)
+            return 1
+        print(f'pinned baseline {run_id} at {path}')
+        return 0
+
+    args.ledger_dir = resolve_ledger_dir(args.path, args.ledger)
+    if args.ledger_dir is None and not args.trajectory:
+        print('no ledger directory: pass a work dir, --ledger DIR, or '
+              'set OCT_CACHE_ROOT')
+        return 1
+    records = list(ledmod.iter_ledger(
+        ledmod.runs_path(args.ledger_dir))) if args.ledger_dir else []
+
+    if args.command == 'list':
+        return _cmd_list(records, args)
+    if args.command == 'diff':
+        return _cmd_diff(records, args)
+    return _cmd_check(records, args)

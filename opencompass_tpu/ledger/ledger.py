@@ -1,0 +1,370 @@
+"""The regression ledger: per-run perf fingerprints + cross-run gates.
+
+Why a ledger when the trace report already breaks a run down?  The
+report sees ONE run; the upcoming engine work (continuous-batching
+decode, quantized serving) changes the hot path, and "is this PR slower
+than the last one" needs a durable series.  The ledger is that series:
+
+- **Records.**  After every run the driver appends one record per
+  (run, model, dataset, kind) to ``{cache_root}/ledger/runs.jsonl`` —
+  the same pre-timestamp cache root (and the same single-``os.write``
+  ``O_APPEND`` / torn-line-recovery discipline) as the result store, so
+  consecutive runs of a sweep share one ledger with no locks.  Numbers
+  come from the run's own artifacts: the TaskProfiler perf JSONs
+  (throughput, device/compile seconds, pad_eff, cache/store activity),
+  the eval results JSONs (accuracy), and the flight-recorder timelines
+  (inferencer-kind attribution, duty cycle).
+
+- **Baseline.**  ``baseline.json`` pins a run id; unpinned, the diff
+  baseline is the previous run in the series.  ``cli ledger pin`` moves
+  the pin (e.g. to the last known-good PR).
+
+- **Gates.**  :func:`check_records` flags rows whose tokens/s fell more
+  than ``max_slowdown`` below baseline or whose accuracy dropped more
+  than ``max_accuracy_drop``; ``cli ledger check`` exits 2 when any row
+  trips, so CI fails loudly instead of a regression landing silently.
+  :func:`check_trajectory` applies the same idea to ``bench.py``'s
+  ``BENCH_TRAJECTORY.json`` (per-PR bench legs).
+
+Never-fail contract on the write path: :func:`append_run` is wrapped by
+the driver in a guard — a broken ledger can log a warning, never fail a
+finished run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+import time
+from typing import Dict, List, Optional, Tuple
+
+from opencompass_tpu.utils.fileio import (append_jsonl_atomic,
+                                          atomic_write_json)
+
+LEDGER_VERSION = 1
+LEDGER_SUBDIR = 'ledger'
+RUNS_FILE = 'runs.jsonl'
+BASELINE_FILE = 'baseline.json'
+
+# metric the throughput gate rides (per-record); accuracy gates every
+# shared numeric metric in the record's ``accuracy`` dict
+THROUGHPUT_KEY = 'tokens_per_sec'
+
+
+def ledger_dir(cache_root: Optional[str] = None,
+               work_dir: Optional[str] = None) -> Optional[str]:
+    """``{cache_root}/ledger`` (same root resolution as the compile
+    cache / result store), or None when nothing pins a root."""
+    if cache_root:
+        return osp.join(cache_root, LEDGER_SUBDIR)
+    from opencompass_tpu.utils import compile_cache
+    root = compile_cache.cache_root(work_dir)
+    return osp.join(root, LEDGER_SUBDIR) if root else None
+
+
+def runs_path(ledger: Optional[str] = None) -> Optional[str]:
+    d = ledger or ledger_dir()
+    return osp.join(d, RUNS_FILE) if d else None
+
+
+# -- record collection -----------------------------------------------------
+
+def _load_json(path: str) -> Optional[Dict]:
+    try:
+        with open(path, encoding='utf-8') as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def _scan_pair_files(root: str) -> List[Tuple[str, str, str]]:
+    """(model, dataset, path) for every ``root/<model>/<dataset>.json``."""
+    out = []
+    try:
+        models = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for model in models:
+        mdir = osp.join(root, model)
+        if not osp.isdir(mdir):
+            continue
+        for fname in sorted(os.listdir(mdir)):
+            if fname.endswith('.json'):
+                out.append((model, fname[:-len('.json')],
+                            osp.join(mdir, fname)))
+    return out
+
+
+def collect_run_records(work_dir: str,
+                        run_id: Optional[str] = None) -> List[Dict]:
+    """Build ledger records from one finished run's artifacts.
+
+    ``work_dir`` is the timestamped run dir; ``run_id`` defaults to its
+    basename.  Perf records are required (no perf JSON → no record);
+    accuracy and kind attribution are joined when present.
+    """
+    work_dir = osp.abspath(work_dir)
+    run_id = run_id or osp.basename(osp.normpath(work_dir))
+    kinds: Dict[str, str] = {}
+    duty: Dict[str, Dict] = {}
+    try:
+        # flight-recorder join: inferencer-kind attribution + per-unit
+        # duty cycle (absent on untraced runs — fields stay None)
+        from opencompass_tpu.obs.timeline import (read_timelines,
+                                                  summarize_records,
+                                                  unit_kinds)
+        obs_dir = osp.join(work_dir, 'obs')
+        kinds = unit_kinds(obs_dir)
+        by_unit: Dict[str, List] = {}
+        for recs in read_timelines(obs_dir).values():
+            for r in recs:
+                if r.get('unit'):
+                    by_unit.setdefault(r['unit'], []).append(r)
+        for unit, unit_recs in by_unit.items():
+            duty[unit] = summarize_records(unit_recs)
+    except Exception:
+        pass
+
+    records = []
+    now = round(time.time(), 3)
+    for model, dataset, perf_path in _scan_pair_files(
+            osp.join(work_dir, 'perf')):
+        perf = _load_json(perf_path)
+        if not perf:
+            continue
+        unit = f'{model}/{dataset}'
+        result = _load_json(
+            osp.join(work_dir, 'results', model, f'{dataset}.json'))
+        accuracy = {k: v for k, v in (result or {}).items()
+                    if isinstance(v, (int, float))
+                    and not isinstance(v, bool)} or None
+        cc_h = perf.get('compile_cache_hits') or 0
+        cc_m = perf.get('compile_cache_misses') or 0
+        st_h = perf.get('store_hits') or 0
+        st_m = perf.get('store_misses') or 0
+        tl = duty.get(unit) or {}
+        records.append({
+            'v': LEDGER_VERSION, 'ts': now, 'run': run_id,
+            'work_dir': work_dir,
+            'model': model, 'dataset': dataset,
+            'kind': kinds.get(unit),
+            'wall_seconds': perf.get('wall_seconds'),
+            'samples': perf.get('samples'),
+            'samples_per_sec': perf.get('samples_per_sec'),
+            'tokens_per_sec': perf.get('tokens_per_sec'),
+            'device_seconds': perf.get('device_seconds'),
+            'compile_seconds': perf.get('compile_seconds'),
+            'pad_eff': perf.get('pad_eff'),
+            'cc_hit_rate': round(cc_h / (cc_h + cc_m), 4)
+            if cc_h + cc_m else None,
+            'store_hit_rate': round(st_h / (st_h + st_m), 4)
+            if st_h + st_m else None,
+            'duty_cycle': tl.get('duty_cycle'),
+            'error': perf.get('error'),
+            'accuracy': accuracy,
+        })
+    return records
+
+
+def append_run(work_dir: str, run_id: Optional[str] = None,
+               ledger: Optional[str] = None) -> List[Dict]:
+    """Collect + append this run's records (skipping (run, model,
+    dataset) keys already present, so a resumed ``-r`` run does not
+    duplicate its first attempt's rows).  Returns the records actually
+    appended; [] when no ledger root resolves or nothing is new."""
+    path = runs_path(ledger)
+    if not path:
+        return []
+    records = collect_run_records(work_dir, run_id)
+    if not records:
+        return []
+    seen = {(r.get('run'), r.get('model'), r.get('dataset'))
+            for r in iter_ledger(path)}
+    fresh = [r for r in records
+             if (r['run'], r['model'], r['dataset']) not in seen]
+    if fresh:
+        append_jsonl_atomic(path, fresh)
+    return fresh
+
+
+# -- readers / series ------------------------------------------------------
+
+def iter_ledger(path: Optional[str] = None):
+    """Parseable ledger records (torn lines skipped, same recovery
+    contract as the store)."""
+    from opencompass_tpu.utils.fileio import iter_jsonl_records
+    path = path or runs_path()
+    if not path:
+        return iter(())
+    return iter_jsonl_records(path, keep=lambda r: bool(r.get('run')))
+
+
+def run_series(records: List[Dict]) -> List[str]:
+    """Distinct run ids in first-seen (i.e. chronological append)
+    order."""
+    seen = []
+    for rec in records:
+        if rec['run'] not in seen:
+            seen.append(rec['run'])
+    return seen
+
+
+def pin_baseline(run_id: str, ledger: Optional[str] = None) -> str:
+    d = ledger or ledger_dir()
+    if not d:
+        raise ValueError('no ledger directory resolves — set '
+                         'OCT_CACHE_ROOT or pass a work dir')
+    path = osp.join(d, BASELINE_FILE)
+    atomic_write_json(path, {'v': LEDGER_VERSION, 'run': run_id,
+                             'ts': round(time.time(), 3)})
+    return path
+
+
+def read_baseline(ledger: Optional[str] = None) -> Optional[str]:
+    d = ledger or ledger_dir()
+    if not d:
+        return None
+    rec = _load_json(osp.join(d, BASELINE_FILE))
+    return rec.get('run') if rec else None
+
+
+def resolve_runs(records: List[Dict], baseline: Optional[str] = None,
+                 run: Optional[str] = None,
+                 ledger: Optional[str] = None
+                 ) -> Tuple[Optional[str], Optional[str]]:
+    """(baseline run id, current run id): explicit args win, then the
+    pinned baseline, then previous-vs-latest in the series."""
+    series = run_series(records)
+    cur = run or (series[-1] if series else None)
+    base = baseline or read_baseline(ledger)
+    if base is None:
+        earlier = [r for r in series if r != cur]
+        base = earlier[-1] if earlier else None
+    return base, cur
+
+
+# -- diff / check ----------------------------------------------------------
+
+def _index(records: List[Dict], run_id: str) -> Dict[tuple, Dict]:
+    """(model, dataset) → record for one run (last record wins)."""
+    out = {}
+    for rec in records:
+        if rec['run'] == run_id:
+            out[(rec.get('model'), rec.get('dataset'))] = rec
+    return out
+
+
+def _rel(cur, base) -> Optional[float]:
+    if not isinstance(cur, (int, float)) \
+            or not isinstance(base, (int, float)) or not base:
+        return None
+    return round((cur - base) / base, 4)
+
+
+def diff_records(records: List[Dict], baseline: str,
+                 run: str) -> List[Dict]:
+    """Per-(model, dataset) delta rows between two runs."""
+    base_idx = _index(records, baseline)
+    cur_idx = _index(records, run)
+    rows = []
+    for key in sorted(set(base_idx) | set(cur_idx),
+                      key=lambda k: (str(k[0]), str(k[1]))):
+        base, cur = base_idx.get(key), cur_idx.get(key)
+        row = {'model': key[0], 'dataset': key[1],
+               'kind': (cur or {}).get('kind') or (base or {}).get('kind'),
+               'in_baseline': base is not None, 'in_run': cur is not None}
+        if base and cur:
+            for metric in (THROUGHPUT_KEY, 'samples_per_sec',
+                           'wall_seconds', 'compile_seconds'):
+                row[metric] = cur.get(metric)
+                row[f'{metric}_base'] = base.get(metric)
+                row[f'{metric}_rel'] = _rel(cur.get(metric),
+                                            base.get(metric))
+            row['store_hit_rate'] = cur.get('store_hit_rate')
+            row['store_hit_rate_base'] = base.get('store_hit_rate')
+            acc_b = base.get('accuracy') or {}
+            acc_c = cur.get('accuracy') or {}
+            row['accuracy_delta'] = {
+                m: round(acc_c[m] - acc_b[m], 4)
+                for m in sorted(set(acc_b) & set(acc_c))} or None
+        rows.append(row)
+    return rows
+
+
+def check_records(records: List[Dict], baseline: str, run: str,
+                  max_slowdown: float = 0.25,
+                  max_accuracy_drop: float = 0.5) -> List[Dict]:
+    """Regression rows: tokens/s below ``baseline * (1 - max_slowdown)``
+    or any shared accuracy metric down more than ``max_accuracy_drop``
+    (absolute, in the metric's own units — the summarizer's scores are
+    0-100).  Rows missing from the current run are NOT regressions (a
+    narrower sweep is legitimate); new rows have no baseline to fail.
+    A side the result store served *fully* (``store_hit_rate == 1.0``)
+    did no device work, so its tokens/s is meaningless — such rows skip
+    the throughput gate (a warm rerun must not read as a -100%
+    regression) but still gate on accuracy."""
+
+    def computed(rate) -> bool:
+        # None = store off / pre-store record: assume real device work
+        return not isinstance(rate, (int, float)) or rate < 1.0
+
+    out = []
+    for row in diff_records(records, baseline, run):
+        if not (row['in_baseline'] and row['in_run']):
+            continue
+        rel = row.get(f'{THROUGHPUT_KEY}_rel')
+        if not (computed(row.get('store_hit_rate'))
+                and computed(row.get('store_hit_rate_base'))):
+            rel = None
+        if rel is not None and rel < -max_slowdown:
+            out.append({**row, 'regression': 'throughput',
+                        'threshold': -max_slowdown})
+            continue
+        drops = {m: d for m, d in (row.get('accuracy_delta') or {}).items()
+                 if d < -max_accuracy_drop}
+        if drops:
+            out.append({**row, 'regression': 'accuracy',
+                        'threshold': -max_accuracy_drop,
+                        'drops': drops})
+    return out
+
+
+# -- bench trajectory gate (BENCH_TRAJECTORY.json) -------------------------
+
+def load_trajectory(path: str) -> List[Dict]:
+    try:
+        with open(path, encoding='utf-8') as f:
+            data = json.load(f)
+        return [r for r in data if isinstance(r, dict)] \
+            if isinstance(data, list) else []
+    except (OSError, ValueError):
+        return []
+
+
+def check_trajectory(path: str,
+                     max_slowdown: float = 0.25) -> List[Dict]:
+    """Per-(leg, metric) gate over bench.py's normalized trajectory:
+    the latest value must not fall more than ``max_slowdown`` below the
+    previous one (``direction: lower`` metrics gate the other way)."""
+    series: Dict[tuple, List[Dict]] = {}
+    for rec in load_trajectory(path):
+        if isinstance(rec.get('value'), (int, float)) and rec.get('leg'):
+            series.setdefault((rec['leg'], rec.get('metric')),
+                              []).append(rec)
+    out = []
+    for (leg, metric), recs in sorted(series.items()):
+        if len(recs) < 2:
+            continue
+        prev, cur = recs[-2]['value'], recs[-1]['value']
+        lower_better = recs[-1].get('direction') == 'lower'
+        if lower_better:
+            bad = prev > 0 and cur > prev * (1 + max_slowdown)
+        else:
+            bad = prev > 0 and cur < prev * (1 - max_slowdown)
+        if bad:
+            out.append({'leg': leg, 'metric': metric, 'previous': prev,
+                        'current': cur,
+                        'rel': _rel(cur, prev),
+                        'regression': 'trajectory'})
+    return out

@@ -15,6 +15,7 @@ parser via :class:`MetaTemplateWalker` instead of being duplicated.
 from __future__ import annotations
 
 import abc
+from collections import deque
 from copy import deepcopy
 from typing import Dict, List, Optional, Tuple, Union
 
@@ -286,9 +287,51 @@ class BaseModel(abc.ABC):
         self.template_parser = LMTemplateParser(meta_template)
         self.generation_kwargs = generation_kwargs or {}
         self.perf = PerfCounters()
+        # flight-recorder call queue (obs/timeline.py): device models
+        # push one dict per dispatched device call (_tl_track) with the
+        # host-enqueue/fetch wall split; the inferencer's batch recorder
+        # pops exactly the calls its dispatch made (FIFO — the pipeline
+        # collects batches in dispatch order)
+        # bounded: calls dispatched outside a recorded plan (warm-up
+        # probes, ad-hoc model use) would otherwise accumulate forever
+        self._tl_pending: deque = deque(maxlen=1024)
+        self._tl_call_count = 0
         self.eos_token_id = None
         if meta_template and 'eos_token_id' in meta_template:
             self.eos_token_id = meta_template['eos_token_id']
+
+    def _tl_track(self, kind: str, shape, first: bool,
+                  prefill_tokens: int) -> Optional[Dict]:
+        """Register one device call with the flight recorder (no-op —
+        returning None — when no timeline is installed).  The caller
+        keeps mutating the returned dict (``fetch_s``,
+        ``decode_tokens``) until the host fetch completes; the recorder
+        serializes it at batch-collect time."""
+        from opencompass_tpu.obs import get_timeline
+        if not get_timeline().enabled:
+            return None
+        info = {'kind': kind, 'shape': [int(shape[0]), int(shape[1])],
+                'first': bool(first),
+                'prefill_tokens': int(prefill_tokens),
+                'dispatch_s': 0.0}
+        self._tl_pending.append(info)
+        self._tl_call_count += 1
+        return info
+
+    def pop_batch_calls(self, n: int):
+        """Drain the ``n`` oldest tracked calls (the ones a batch's
+        dispatch made) for the flight recorder.  Never raises."""
+        out = []
+        try:
+            for _ in range(int(n)):
+                if not self._tl_pending:
+                    break
+                info = self._tl_pending.popleft()
+                out.append({k: (round(v, 6) if isinstance(v, float)
+                                else v) for k, v in info.items()})
+        except Exception:
+            pass
+        return out
 
     @abc.abstractmethod
     def generate(self, inputs: List[str], max_out_len: int) -> List[str]:

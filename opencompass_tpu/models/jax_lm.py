@@ -750,6 +750,9 @@ class JaxLM(BaseModel):
             first = self._first_dispatch(
                 'ppl', prefix is not None and len(prefix), tokens.shape)
             cs0 = self.perf.compile_seconds
+            info = self._tl_track('ppl', tokens.shape, first,
+                                  sum(len(r) for r in ids))
+            td0 = time.perf_counter()
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
                              samples=len(inputs), first=first):
@@ -766,6 +769,8 @@ class JaxLM(BaseModel):
                                        self._put(tokens, spec),
                                        self._put(mask, spec),
                                        self._put(mlb, P('data')))
+            if info is not None:
+                info['dispatch_s'] = time.perf_counter() - td0
             if first and prefix is None:
                 # shared-prefix executables are batch-content-dependent;
                 # only plain-path shapes enter the manifest
@@ -776,7 +781,10 @@ class JaxLM(BaseModel):
         def fetch():
             t0 = time.perf_counter()
             out = np.asarray(nll)
-            self.perf.device_seconds += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.perf.device_seconds += dt
+            if info is not None:
+                info['fetch_s'] = dt
             return out[:n].tolist()
         return _Lazy(fetch)
 
@@ -835,10 +843,15 @@ class JaxLM(BaseModel):
                 keep='tail')
             first = self._first_dispatch('choice', tokens.shape)
             cs0 = self.perf.compile_seconds
+            info = self._tl_track('choice', tokens.shape, first,
+                                  sum(len(r) for r in ids))
+            td0 = time.perf_counter()
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
                              samples=len(inputs), first=first):
                 logits = self._choice_logits_fn(self.params, tokens, mask)
+            if info is not None:
+                info['dispatch_s'] = time.perf_counter() - td0
             if first:
                 self._note_compile('choice', tokens.shape,
                                    self.perf.compile_seconds - cs0)
@@ -847,7 +860,10 @@ class JaxLM(BaseModel):
         def fetch():
             t0 = time.perf_counter()
             logits_h = np.asarray(logits, np.float64)
-            self.perf.device_seconds += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            self.perf.device_seconds += dt
+            if info is not None:
+                info['fetch_s'] = dt
             sub = logits_h[:n][:, choice_ids]
             sub = np.exp(sub - sub.max(axis=-1, keepdims=True))
             sub = sub / sub.sum(axis=-1, keepdims=True)
@@ -880,6 +896,9 @@ class JaxLM(BaseModel):
                 int(max_out_len), temperature, top_k, num_beams,
                 length_penalty)
             cs0 = self.perf.compile_seconds
+            info = self._tl_track('gen', tokens.shape, first,
+                                  sum(len(r) for r in ids))
+            td0 = time.perf_counter()
             with device_call(self.perf,
                              tokens_in=sum(len(r) for r in ids),
                              samples=len(inputs), first=first):
@@ -901,6 +920,8 @@ class JaxLM(BaseModel):
                     out, lengths = fn(self.params,
                                       self._put(tokens, spec),
                                       self._put(mask, spec), rng)
+            if info is not None:
+                info['dispatch_s'] = time.perf_counter() - td0
             if first and prefix is None:
                 self._note_compile('gen', tokens.shape,
                                    self.perf.compile_seconds - cs0)
@@ -910,8 +931,17 @@ class JaxLM(BaseModel):
             t0 = time.perf_counter()
             out_h = np.asarray(out)
             lengths_h = np.asarray(lengths)
-            self.perf.device_seconds += time.perf_counter() - t0
-            self.perf.tokens_out += int(lengths_h[:n_in].sum())
+            dt = time.perf_counter() - t0
+            self.perf.device_seconds += dt
+            decode_tokens = int(lengths_h[:n_in].sum())
+            if info is not None:
+                # the fused prefill+decode executable gives no on-device
+                # split; dispatch_s ≈ trace/compile + enqueue, fetch_s ≈
+                # device wall, and the prefill/decode *token* split lets
+                # the report reconstruct the cost structure
+                info['fetch_s'] = dt
+                info['decode_tokens'] = decode_tokens
+            self.perf.tokens_out += decode_tokens
             texts = []
             for i in range(n_in):
                 n = int(lengths_h[i])

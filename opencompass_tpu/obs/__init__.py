@@ -27,10 +27,13 @@ import time
 from typing import Dict, Optional
 
 from opencompass_tpu.obs import live as _live
+from opencompass_tpu.obs import timeline as _timeline
 from opencompass_tpu.obs.live import (Heartbeat, NoopHeartbeat,
                                       get_heartbeat, heartbeat_path)
 from opencompass_tpu.obs.metrics import (Counter, Gauge, Histogram,
                                          LATENCY_BUCKETS_S, MetricsRegistry)
+from opencompass_tpu.obs.timeline import (NoopTimeline, Timeline,
+                                          get_timeline, timeline_path)
 from opencompass_tpu.obs.trace import (ENV_OBS_DIR, ENV_PARENT_SPAN,
                                        ENV_TRACE_ID, NoopTracer, Span,
                                        Tracer, current_span)
@@ -41,6 +44,8 @@ __all__ = ['Counter', 'Gauge', 'Histogram', 'LATENCY_BUCKETS_S',
            'reset_obs', 'obs_enabled', 'device_memory_attrs',
            'observe_batch', 'Heartbeat', 'NoopHeartbeat',
            'get_heartbeat', 'heartbeat_path', 'init_task_heartbeat',
+           'NoopTimeline', 'Timeline', 'get_timeline', 'timeline_path',
+           'init_task_timeline',
            'ENV_TRACE_ID', 'ENV_PARENT_SPAN', 'ENV_OBS_DIR']
 
 _NOOP = NoopTracer()
@@ -121,6 +126,21 @@ def init_task_heartbeat(task_name: str):
         return _live.get_heartbeat()
 
 
+def init_task_timeline(task_name: str):
+    """Install the process-wide per-batch flight recorder for a
+    subprocess task (``{obs_dir}/timeline/<task>.jsonl``).  Follows the
+    heartbeat policy exactly: stays the shared :class:`NoopTimeline`
+    unless this process's tracing is enabled.  Never raises."""
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return _timeline.get_timeline()
+    try:
+        return _timeline.install_timeline(
+            Timeline(tracer.obs_dir, task_name))
+    except Exception:
+        return _timeline.get_timeline()
+
+
 def reset_obs():
     """Drop back to the NoopTracer (closing any live sink) — test hook."""
     global _TRACER
@@ -131,6 +151,7 @@ def reset_obs():
             pass
     _TRACER = _NOOP
     _live.reset_heartbeat()
+    _timeline.reset_timeline()
 
 
 def obs_enabled(cfg: Dict) -> bool:

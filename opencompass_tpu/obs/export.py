@@ -1,0 +1,291 @@
+"""Chrome/Perfetto trace export: ``cli trace <work_dir> --export out.json``.
+
+Converts one run's span tree (``obs/events.jsonl``) plus the per-batch
+flight-recorder timelines (``obs/timeline/``) into Chrome
+``traceEvents`` JSON — loadable in ui.perfetto.dev or
+``chrome://tracing`` — so a sweep's concurrency structure is inspectable
+visually instead of through tables:
+
+- **driver track** (pid 0): the ``run`` → ``phase:*`` → ``runner:*``
+  span chain as matched ``B``/``E`` duration events;
+- **one track per device slot / lane** (pid 1): every ``task:`` span
+  lands on the track of its first assigned device slot (tasks without
+  devices pack greedily into free lanes), with its subprocess descendants
+  (``proc:`` / ``warmup:`` / ``infer:`` / ``eval:``) nested below it;
+- **batch slices**: each flight-recorder batch becomes a complete
+  (``X``) event nested under its task — name ``gen 8x256``, args carry
+  rows/real/pad tokens and the dispatch/fetch + prefill/decode splits;
+- **tokens/s counter track** per task (``C`` events from the batch
+  records);
+- thread/process ``M`` metadata naming every track.
+
+Well-formedness by construction: B/E pairs are emitted by a recursive
+descent over the span tree with child intervals clamped inside their
+parent (and siblings de-overlapped), so every ``B`` has a matching ``E``
+and nesting is valid on every track — the property
+``tests/test_flight_recorder.py`` locks down.
+
+A driver-level XProf capture (``run.py ... --xprof`` →
+``{work_dir}/obs/xprof``) is linked from the export's ``otherData`` so
+the op-level story sits next to the scheduling story.
+"""
+from __future__ import annotations
+
+import json
+import os.path as osp
+from typing import Dict, List, Optional
+
+from opencompass_tpu.obs.report import (_SpanNode, build_span_tree,
+                                        load_events, resolve_events_path)
+
+XPROF_SUBDIR = 'xprof'
+
+
+def _span_interval(n: _SpanNode, fallback_end: float):
+    start = n.start
+    end = n.end
+    if start is None:
+        return None
+    if end is None:
+        end = max([fallback_end, start]
+                  + [c.end for c in n.children if c.end is not None])
+    return start, max(end, start)
+
+
+class _TraceBuilder:
+    def __init__(self, t0: float):
+        self.t0 = t0
+        self._meta: List[Dict] = []
+        self._tracks: Dict[tuple, List[Dict]] = {}
+        # per-track busy-until cursor: two tasks reusing one slot must
+        # not interleave their B/E pairs
+        self._cursor: Dict[tuple, float] = {}
+
+    def us(self, ts: float) -> int:
+        return max(0, int(round((ts - self.t0) * 1e6)))
+
+    def _push(self, pid: int, tid: int, ev: Dict):
+        self._tracks.setdefault((pid, tid), []).append(ev)
+
+    def meta(self, pid: int, tid: Optional[int], name: str):
+        rec = {'ph': 'M', 'pid': pid,
+               'name': 'process_name' if tid is None else 'thread_name',
+               'args': {'name': name}}
+        if tid is not None:
+            rec['tid'] = tid
+        self._meta.append(rec)
+
+    def finalize(self) -> List[Dict]:
+        """Metadata first, then each track's events in non-decreasing
+        timestamp order.  The sort is STABLE and emission order already
+        resolves every same-timestamp tie correctly (parent-B before
+        child-B, child-E before parent-E, sibling-E before next
+        sibling's B), so sorting by ts alone merges the later-emitted
+        batch slices into the span stream without ever producing an
+        E-before-B inversion."""
+        out = list(self._meta)
+        for key in sorted(self._tracks):
+            out.extend(sorted(self._tracks[key],
+                              key=lambda e: e.get('ts', 0)))
+        return out
+
+    def emit_span(self, node: _SpanNode, pid: int, tid: int,
+                  lo: float, hi: float, fallback_end: float):
+        """Matched B/E pair for ``node`` clamped to [lo, hi], with
+        same-track children nested inside and de-overlapped."""
+        iv = _span_interval(node, fallback_end)
+        if iv is None:
+            return lo
+        start = min(max(iv[0], lo), hi)
+        end = min(max(iv[1], start), hi)
+        args = {'span': node.span_id, 'status': node.status}
+        for key in ('devices', 'returncode', 'retries', 'slot_wait_seconds',
+                    'n_tasks', 'task', 'worker', 'model', 'dataset'):
+            if key in node.attrs:
+                args[key] = node.attrs[key]
+        self._push(pid, tid, {'name': node.name, 'ph': 'B',
+                              'cat': 'span', 'ts': self.us(start),
+                              'pid': pid, 'tid': tid, 'args': args})
+        cursor = start
+        for child in sorted(node.children, key=lambda c: c.start or 0):
+            cursor = self.emit_span(child, pid, tid, cursor, end,
+                                    fallback_end)
+        self._push(pid, tid, {'name': node.name, 'ph': 'E',
+                              'cat': 'span', 'ts': self.us(end),
+                              'pid': pid, 'tid': tid})
+        self._cursor[(pid, tid)] = max(self._cursor.get((pid, tid),
+                                                        0.0), end)
+        return end
+
+    def emit_batches(self, records: List[Dict], pid: int, tid: int,
+                     lo: float, hi: float, counter_name: str):
+        for rec in records:
+            if rec.get('t') != 'batch' or not isinstance(
+                    rec.get('ts'), (int, float)):
+                continue
+            start = min(max(rec['ts'], lo), hi)
+            dur = max(float(rec.get('batch_s') or 0.0), 1e-6)
+            dur = min(dur, max(hi - start, 1e-6))
+            shape = rec.get('shape') or []
+            name = rec.get('kind', 'batch')
+            if len(shape) == 2:
+                name = f'{name} {shape[0]}x{shape[1]}'
+            args = {k: rec[k] for k in
+                    ('unit', 'seq', 'rows', 'real_tokens', 'pad_tokens',
+                     'dispatch_s', 'device_s', 'compile_s', 'tokens_in',
+                     'tokens_out', 'first_calls', 'cc_hits', 'cc_misses',
+                     'calls') if k in rec}
+            self._push(pid, tid, {'name': name, 'ph': 'X',
+                                  'cat': 'batch', 'ts': self.us(start),
+                                  'dur': max(1, int(round(dur * 1e6))),
+                                  'pid': pid, 'tid': tid, 'args': args})
+            tokens = (rec.get('tokens_in') or 0) + (rec.get('tokens_out')
+                                                    or 0)
+            if tokens and rec.get('batch_s'):
+                self._push(pid, tid, {
+                    'name': counter_name, 'ph': 'C', 'cat': 'batch',
+                    'ts': self.us(start), 'pid': pid,
+                    'args': {'tokens_per_sec':
+                             round(tokens / rec['batch_s'], 1)}})
+
+
+def _slot_lane(task: _SpanNode, lanes: Dict[int, float],
+               fallback_end: float) -> int:
+    """Track id for a task span: its first device slot when assigned,
+    else the first free packing lane (lane busy-until bookkeeping)."""
+    devices = [d for d in (task.attrs.get('devices') or [])
+               if isinstance(d, int)]
+    if devices:
+        return min(devices)
+    iv = _span_interval(task, fallback_end)
+    start, end = iv if iv else (0.0, 0.0)
+    # lanes above 1000 are overflow lanes, never device slots
+    lane = 1000
+    while lanes.get(lane, -1.0) > start:
+        lane += 1
+    lanes[lane] = end
+    return lane
+
+
+def build_chrome_trace(work_dir: str, trace: Optional[str] = None) -> Dict:
+    """The ``{"traceEvents": [...]}`` dict for one run (latest trace id
+    unless ``trace`` picks one, matching the trace report)."""
+    path = resolve_events_path(work_dir)
+    if path is None:
+        raise FileNotFoundError(
+            f'no obs/events.jsonl under {work_dir!r} — was the run '
+            'launched with --obs / obs = True?')
+    obs_dir = osp.dirname(path)
+    all_events = load_events(path)
+    if trace is None:
+        newest: Dict[str, float] = {}
+        for ev in all_events:
+            if ev.get('trace') and 'ts' in ev:
+                newest[ev['trace']] = max(newest.get(ev['trace'], 0),
+                                          ev['ts'])
+        trace = max(newest, key=newest.get) if newest else None
+    events = [ev for ev in all_events
+              if trace is None or ev.get('trace') == trace]
+    nodes = build_span_tree(events)
+    timestamps = [ev['ts'] for ev in events if 'ts' in ev]
+    t0 = min(timestamps) if timestamps else 0.0
+    t1 = max(timestamps) if timestamps else 0.0
+
+    builder = _TraceBuilder(t0)
+    builder.meta(0, None, 'driver')
+    builder.meta(1, None, 'device slots')
+    builder.meta(0, 0, 'run/phases')
+
+    # split the forest: task: spans (and their subtrees) go to slot
+    # tracks; everything else that is a root or whose parent is a task
+    # ancestor stays on the driver track
+    task_nodes = [n for n in nodes.values() if n.name.startswith('task:')]
+    in_task = set()
+    stack = list(task_nodes)
+    while stack:
+        n = stack.pop()
+        if n.span_id in in_task:
+            continue
+        in_task.add(n.span_id)
+        stack.extend(n.children)
+
+    lanes: Dict[int, float] = {}
+    named_tids = set()
+    from opencompass_tpu.obs.timeline import read_timelines
+    timelines = read_timelines(obs_dir)
+    for task in sorted(task_nodes, key=lambda n: n.start or 0):
+        tid = _slot_lane(task, lanes, t1)
+        if tid not in named_tids:
+            named_tids.add(tid)
+            builder.meta(1, tid, f'slot {tid}' if tid < 1000
+                         else f'lane {tid - 1000}')
+        iv = _span_interval(task, t1)
+        if iv is None:
+            continue
+        # a slot's next task starts no earlier than its previous task's
+        # end on this track — retries/requeues must not interleave pairs
+        lo = max(iv[0], builder._cursor.get((1, tid), 0.0))
+        hi = max(iv[1], lo)
+        builder.emit_span(task, 1, tid, lo, hi, t1)
+        task_name = task.name[len('task:'):]
+        if task_name in timelines:
+            builder.emit_batches(timelines.pop(task_name), 1, tid,
+                                 lo, hi, f'tok/s {task_name}')
+
+    def emit_driver(n: _SpanNode):
+        if n.span_id in in_task:
+            return
+        builder.emit_span(
+            # prune task subtrees: they were emitted on slot tracks
+            _strip_task_children(n, in_task), 0, 0,
+            n.start if n.start is not None else t0, t1, t1)
+
+    roots = sorted((n for n in nodes.values()
+                    if not n.parent or n.parent not in nodes),
+                   key=lambda n: n.start or 0)
+    for root in roots:
+        emit_driver(root)
+
+    # a --debug run has no task: spans — orphan timelines get overflow
+    # lanes of their own so batches are still visible
+    for task_name, records in sorted(timelines.items()):
+        tid = 1000
+        while tid in named_tids:
+            tid += 1
+        named_tids.add(tid)
+        builder.meta(1, tid, task_name[:48])
+        builder.emit_batches(records, 1, tid, t0, max(t1, t0) + 1e9,
+                             f'tok/s {task_name}')
+
+    other = {'trace': trace, 'events_path': path,
+             'wall_seconds': round(t1 - t0, 3)}
+    xprof = osp.join(obs_dir, XPROF_SUBDIR)
+    if osp.isdir(xprof):
+        # driver-managed jax.profiler session (run.py --xprof): the
+        # op-level complement to this scheduling-level export
+        other['xprof'] = osp.abspath(xprof)
+    return {'traceEvents': builder.finalize(),
+            'displayTimeUnit': 'ms', 'otherData': other}
+
+
+def _strip_task_children(node: _SpanNode, in_task: set) -> _SpanNode:
+    """A shallow view of ``node`` whose task-subtree children (emitted
+    on slot tracks) are removed; non-task children are kept recursively.
+    The original tree is never mutated."""
+    clone = _SpanNode(node.span_id)
+    for slot in ('name', 'parent', 'start', 'end', 'dur', 'status',
+                 'error', 'pid'):
+        setattr(clone, slot, getattr(node, slot))
+    clone.attrs = node.attrs
+    clone.children = [_strip_task_children(c, in_task)
+                      for c in node.children if c.span_id not in in_task]
+    return clone
+
+
+def export_chrome_trace(work_dir: str, out_path: str,
+                        trace: Optional[str] = None) -> Dict:
+    """Write the Chrome trace JSON and return it (CLI body)."""
+    doc = build_chrome_trace(work_dir, trace=trace)
+    with open(out_path, 'w', encoding='utf-8') as f:
+        json.dump(doc, f, separators=(',', ':'), default=str)
+    return doc

@@ -73,10 +73,10 @@ class NoopHeartbeat:
         pass
 
     def progress(self, done=None, total=None, batch_seconds=None,
-                 force=False):
+                 cached=None, force=False):
         pass
 
-    def add(self, n=1):
+    def add(self, n=1, cached=False):
         pass
 
     def mark(self, state):
@@ -93,8 +93,14 @@ class Heartbeat:
          "unit": <current model/dataset pair or null>,
          "units_done": <pairs finished>, "units_total": <pairs in task>,
          "done": <examples done in current unit>, "total": <examples>,
+         "cached": <of `done`, rows served at ~0 cost (store/resume)>,
+         "rows_done": <cumulative rows across units>,
+         "rows_cached": <cumulative ~0-cost rows across units>,
          "tokens_per_sec": <live rate or null>,
          "last_batch_seconds": <latest batch latency or null>,
+         "pad_eff": <planner padding efficiency so far, or null>,
+         "store_hits": <result-store row hits in this task>,
+         "store_misses": <result-store row misses in this task>,
          "device_memory": {"peak_bytes_in_use": ..., ...}}   # when exposed
 
     With ``keepalive=True`` a daemon thread refreshes the file every
@@ -115,11 +121,22 @@ class Heartbeat:
         self._last_write = 0.0
         self._perf = None           # PerfCounters of the live model
         self._perf_snap: Optional[Tuple[float, int]] = None
+        self._pad_snap: Tuple[int, int] = (0, 0)
+        # cumulative row counters across *finished* units (the current
+        # unit's done/cached fold in at set_unit time); rows_cached
+        # tracks rows served at ~0 cost (result store / resume), so the
+        # status plane can extrapolate ETA from computed rows only
+        self._cum_done = 0
+        self._cum_cached = 0
+        # result-store totals are process-wide; snapshot at heartbeat
+        # birth so a model-resident worker's Nth task reports only its
+        # own store activity
+        self._store_snap = self._store_counters()
         self._state: Dict = {
             'v': HEARTBEAT_VERSION, 'task': task_name, 'pid': os.getpid(),
             'ts': None, 'state': 'running', 'unit': None,
             'units_done': 0, 'units_total': None,
-            'done': 0, 'total': None,
+            'done': 0, 'total': None, 'cached': 0,
             'tokens_per_sec': None, 'last_batch_seconds': None,
         }
         self._stop_keepalive: Optional[threading.Event] = None
@@ -146,25 +163,41 @@ class Heartbeat:
 
     # -- writer API (all never-fail) ---------------------------------------
 
+    @staticmethod
+    def _store_counters() -> Tuple[int, int]:
+        try:
+            from opencompass_tpu.store.store import counters_snapshot
+            snap = counters_snapshot()
+            return int(snap['hits']), int(snap['misses'])
+        except Exception:
+            return 0, 0
+
     def bind_perf(self, counters):
         """Attach the model's PerfCounters so writes report a live
-        tokens/s computed from counter deltas."""
+        tokens/s (and padding efficiency) computed from counter
+        deltas."""
         try:
             with self._lock:
                 self._perf = counters
                 self._perf_snap = None
+                self._pad_snap = (
+                    int(getattr(counters, 'tokens_in', 0) or 0),
+                    int(getattr(counters, 'pad_tokens', 0) or 0))
         except Exception:
             pass
 
     def set_unit(self, units_done: int, units_total: int,
                  name: Optional[str] = None):
         """Enter the ``units_done``-th (model, dataset) pair of
-        ``units_total``; resets the example-level cursor."""
+        ``units_total``; resets the example-level cursor (the finished
+        unit's rows fold into the cumulative counters first)."""
         try:
             with self._lock:
+                self._cum_done += int(self._state.get('done') or 0)
+                self._cum_cached += int(self._state.get('cached') or 0)
                 self._state.update(units_done=units_done,
                                    units_total=units_total, unit=name,
-                                   done=0, total=None)
+                                   done=0, total=None, cached=0)
                 self._write_locked(force=True)
         except Exception:
             pass
@@ -172,15 +205,20 @@ class Heartbeat:
     def progress(self, done: Optional[int] = None,
                  total: Optional[int] = None,
                  batch_seconds: Optional[float] = None,
+                 cached: Optional[int] = None,
                  force: bool = False):
         """Example-level progress inside the current unit (rate-limited
-        write; ``force`` bypasses the limiter)."""
+        write; ``force`` bypasses the limiter).  ``cached`` counts the
+        rows of ``done`` that were served at ~0 cost (result store or
+        resume) — the status ETA excludes them from the rate."""
         try:
             with self._lock:
                 if done is not None:
                     self._state['done'] = int(done)
                 if total is not None:
                     self._state['total'] = int(total)
+                if cached is not None:
+                    self._state['cached'] = int(cached)
                 if batch_seconds is not None:
                     self._state['last_batch_seconds'] = round(
                         float(batch_seconds), 4)
@@ -188,12 +226,15 @@ class Heartbeat:
         except Exception:
             pass
 
-    def add(self, n: int = 1):
+    def add(self, n: int = 1, cached: bool = False):
         """Increment the example cursor (PPL label-major scoring, where
         the caller only knows per-chunk increments)."""
         try:
             with self._lock:
                 self._state['done'] = int(self._state.get('done') or 0) + n
+                if cached:
+                    self._state['cached'] = int(
+                        self._state.get('cached') or 0) + n
                 self._write_locked(force=False)
         except Exception:
             pass
@@ -227,8 +268,29 @@ class Heartbeat:
                         self._state['tokens_per_sec'] = round(
                             (tokens - tok_prev) / dt, 1)
                 self._perf_snap = (now, tokens)
+                # live padding efficiency of what this task shipped so
+                # far (delta vs the bind_perf snapshot — a resident
+                # worker's counters span many tasks)
+                t_in = int(getattr(self._perf, 'tokens_in', 0) or 0) \
+                    - self._pad_snap[0]
+                pad = int(getattr(self._perf, 'pad_tokens', 0) or 0) \
+                    - self._pad_snap[1]
+                if t_in + pad > 0:
+                    self._state['pad_eff'] = round(t_in / (t_in + pad), 4)
             except Exception:
                 pass
+        try:   # result-store activity attributable to this task
+            hits, misses = self._store_counters()
+            self._state['store_hits'] = hits - self._store_snap[0]
+            self._state['store_misses'] = misses - self._store_snap[1]
+        except Exception:
+            pass
+        # cumulative row counters (finished units + current unit): the
+        # aggregator's computed-row-rate ETA reads these
+        self._state['rows_done'] = self._cum_done \
+            + int(self._state.get('done') or 0)
+        self._state['rows_cached'] = self._cum_cached \
+            + int(self._state.get('cached') or 0)
         try:  # device-memory high-water, when the backend exposes it
             from opencompass_tpu.obs import device_memory_attrs
             mem = device_memory_attrs()
@@ -376,17 +438,30 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
     for name, st in (runner_state.get('tasks') or {}).items():
         tasks[name] = {'state': st.get('state', 'pending'),
                        'returncode': st.get('returncode')}
+        if isinstance(st.get('started'), (int, float)) \
+                and isinstance(st.get('ended'), (int, float)):
+            tasks[name]['wall_seconds'] = round(
+                st['ended'] - st['started'], 3)
     for name, rec in heartbeats.items():
         row = tasks.setdefault(name, {'state': 'running',
                                       'returncode': None})
         frac = _task_fraction(rec)
+        st_hits = rec.get('store_hits') or 0
+        st_misses = rec.get('store_misses') or 0
         row.update(
             pid=rec.get('pid'), unit=rec.get('unit'),
             units_done=rec.get('units_done'),
             units_total=rec.get('units_total'),
             done=rec.get('done'), total=rec.get('total'),
+            rows_done=rec.get('rows_done'),
+            rows_cached=rec.get('rows_cached'),
             tokens_per_sec=rec.get('tokens_per_sec'),
             last_batch_seconds=rec.get('last_batch_seconds'),
+            pad_eff=rec.get('pad_eff'),
+            store_hits=rec.get('store_hits'),
+            store_misses=rec.get('store_misses'),
+            store_hit_rate=round(st_hits / (st_hits + st_misses), 4)
+            if st_hits + st_misses else None,
             heartbeat_age_seconds=rec.get('heartbeat_age_seconds'),
             device_memory=rec.get('device_memory'))
         # a terminal runner verdict (ok/failed) overrides the
@@ -399,6 +474,9 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
     n = len(tasks)
     by_state = {'ok': 0, 'failed': 0, 'running': 0, 'pending': 0}
     frac_sum = 0.0
+    cached_sum = 0.0     # progress attributable to ~0-cost cached rows
+    st_hits = st_misses = 0
+    pad_effs = []
     for row in tasks.values():
         state = row['state']
         if row.get('progress') is None and state == 'ok':
@@ -406,7 +484,16 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
         by_state[state if state in by_state else 'running'] += 1
         p = row.get('progress')
         frac_sum += p if p is not None else 0.0
+        rows_done = row.get('rows_done') or 0
+        if p and rows_done:
+            cached_sum += p * min(
+                (row.get('rows_cached') or 0) / rows_done, 1.0)
+        st_hits += row.get('store_hits') or 0
+        st_misses += row.get('store_misses') or 0
+        if row.get('pad_eff') is not None:
+            pad_effs.append(row['pad_eff'])
     progress = round(frac_sum / n, 4) if n else None
+    cached_progress = round(cached_sum / n, 4) if n else None
 
     started = runner_state.get('started')
     if started is None and heartbeats:
@@ -419,7 +506,13 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
     eta = None
     if state == 'running' and elapsed and progress \
             and 0.02 < progress < 1.0:
-        eta = round(elapsed * (1.0 - progress) / progress, 1)
+        # extrapolate from COMPUTED progress only: store-served /
+        # resumed rows complete in ~0s, so counting them in the rate
+        # (the pre-flight-recorder formula) made a half-cached sweep
+        # predict half the real remaining time
+        computed = progress - (cached_progress or 0.0)
+        if computed > 0.02:
+            eta = round(elapsed * (1.0 - progress) / computed, 1)
 
     return {
         'v': STATUS_VERSION,
@@ -430,7 +523,14 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
         'elapsed_seconds': elapsed,
         'tasks': tasks,
         'overall': {'n_tasks': n, 'progress': progress,
-                    'eta_seconds': eta, **by_state},
+                    'cached_progress': cached_progress,
+                    'eta_seconds': eta,
+                    'store_hit_rate':
+                        round(st_hits / (st_hits + st_misses), 4)
+                        if st_hits + st_misses else None,
+                    'pad_eff': round(sum(pad_effs) / len(pad_effs), 4)
+                        if pad_effs else None,
+                    **by_state},
         'slots': runner_state.get('slots'),
     }
 
@@ -482,16 +582,20 @@ class StatusAggregator:
         try:
             with self._lock:
                 self._tasks[name] = {'state': 'running',
-                                     'returncode': None}
+                                     'returncode': None,
+                                     'started': round(time.time(), 3)}
         except Exception:
             pass
 
     def task_finished(self, name: str, returncode: int):
         try:
             with self._lock:
+                prev = self._tasks.get(name) or {}
                 self._tasks[name] = {
                     'state': 'ok' if returncode == 0 else 'failed',
-                    'returncode': returncode}
+                    'returncode': returncode,
+                    'started': prev.get('started'),
+                    'ended': round(time.time(), 3)}
         except Exception:
             pass
 
@@ -622,6 +726,10 @@ def render_status(snap: Dict) -> str:
         head.append(f"progress {o['progress']:.0%}")
     if o.get('eta_seconds') is not None:
         head.append(f"ETA {_fmt(o['eta_seconds'], 's')}")
+    if o.get('store_hit_rate') is not None:
+        head.append(f"store hit {o['store_hit_rate']:.0%}")
+    if o.get('pad_eff') is not None:
+        head.append(f"pad_eff {o['pad_eff']:.2f}")
     if snap.get('elapsed_seconds') is not None:
         head.append(f"elapsed {_fmt(snap['elapsed_seconds'], 's')}")
     slots = snap.get('slots')
@@ -635,7 +743,7 @@ def render_status(snap: Dict) -> str:
     tasks = snap.get('tasks') or {}
     if tasks:
         rows = [['task', 'state', 'unit', 'done/total', '%', 'tok/s',
-                 'hb_age']]
+                 'pad_eff', 'hit%', 'hb_age']]
         for name in sorted(tasks):
             t = tasks[name]
             done, total = t.get('done'), t.get('total')
@@ -644,12 +752,15 @@ def render_status(snap: Dict) -> str:
             if t.get('units_total'):
                 units = (f"[{t.get('units_done', 0)}"
                          f"/{t['units_total']}] ")
+            hit = t.get('store_hit_rate')
             rows.append([
                 name[:58], t.get('state', '?'),
                 units + (str(t.get('unit') or '-')[:32]),
                 f'{done}/{total}' if total else '-',
                 f'{frac:.0%}' if frac is not None else '-',
                 _fmt(t.get('tokens_per_sec')),
+                _fmt(t.get('pad_eff')),
+                f'{hit:.0%}' if hit is not None else '-',
                 _fmt(t.get('heartbeat_age_seconds'), 's'),
             ])
         lines.append(_table(rows))

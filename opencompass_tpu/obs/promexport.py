@@ -119,6 +119,12 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
     if o.get('eta_seconds') is not None:
         out.append(f'# TYPE {prefix}_run_eta_seconds gauge')
         out.append(_line(f'{prefix}_run_eta_seconds', o['eta_seconds']))
+    # live-plane surfacing of the planner/store efficiency signals
+    # (they existed only in perf records + trace report before)
+    for key in ('cached_progress', 'store_hit_rate', 'pad_eff'):
+        if o.get(key) is not None:
+            out.append(f'# TYPE {prefix}_run_{key} gauge')
+            out.append(_line(f'{prefix}_run_{key}', o[key]))
     for state in ('ok', 'failed', 'running', 'pending'):
         if state in o:
             out.append(f'# TYPE {prefix}_tasks_{state} gauge')
@@ -134,8 +140,11 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
         ('task_progress', 'progress'),
         ('task_examples_done', 'done'),
         ('task_examples_total', 'total'),
+        ('task_rows_cached', 'rows_cached'),
         ('task_tokens_per_sec', 'tokens_per_sec'),
         ('task_last_batch_seconds', 'last_batch_seconds'),
+        ('task_pad_eff', 'pad_eff'),
+        ('task_store_hit_rate', 'store_hit_rate'),
         ('task_heartbeat_age_seconds', 'heartbeat_age_seconds'),
     ]
     for metric_suffix, field in per_task:

@@ -325,6 +325,13 @@ def build_report(work_dir: str, trace: Optional[str] = None) -> Dict:
     histograms = {k: merge_histogram_snapshots(v)
                   for k, v in hist_raw.items()}
 
+    # -- flight recorder (per-batch timelines, when recorded) --------------
+    from opencompass_tpu.obs.timeline import summarize_timelines
+    try:
+        timeline = summarize_timelines(osp.dirname(path))
+    except Exception:
+        timeline = {}
+
     critical = _critical_path(roots[0]) if roots else []
     return {
         # report schema version: CI diffs `trace --json` output across
@@ -344,6 +351,10 @@ def build_report(work_dir: str, trace: Optional[str] = None) -> Dict:
              'status': n.status} for n in critical],
         'slot_utilization': slot_util,
         'failures': failures,
+        # per-task flight-recorder summaries ({} when the run predates
+        # the recorder or was untraced); timelines are not trace-scoped
+        # — a resumed run's batches accumulate in the same files
+        'timeline': timeline,
         'metrics': {'counters': dict(counters), 'gauges': gauges,
                     'histograms': histograms},
     }
@@ -415,6 +426,12 @@ def render_summary(report: Dict) -> str:
         lines.append(f'result store: {st_hits} row hit(s), {st_miss} '
                      f'miss(es) ({rate:.0%} hit rate), {pruned} row(s) '
                      'pruned pre-launch')
+    tl = report.get('timeline') or {}
+    if tl:
+        lines.append(
+            f'flight recorder: '
+            f'{sum(s.get("batches", 0) for s in tl.values())} batch(es) '
+            f'across {len(tl)} task timeline(s)')
     util = report['slot_utilization']
     if util['overall'] is not None:
         lines.append(f"slot utilization {util['overall']:.0%} over "
@@ -477,6 +494,38 @@ def render_report(report: Dict) -> str:
     else:
         out.append('(no task spans)')
 
+    tl = report.get('timeline') or {}
+    if tl:
+        out.append('\n-- flight recorder (per-batch timelines) --')
+        rows = [['task', 'kind', 'batches', 'rows', 'tok/s', 'duty',
+                 'pad_eff', 'pre/dec_tok', 'disp/fetch_s', 'cached',
+                 'tok/s over batches']]
+        for name in sorted(tl):
+            s = tl[name]
+            predec = '-'
+            if s.get('prefill_tokens') or s.get('decode_tokens'):
+                predec = (f"{s.get('prefill_tokens', 0)}/"
+                          f"{s.get('decode_tokens', 0)}")
+            df = '-'
+            if s.get('dispatch_seconds') or s.get('fetch_seconds'):
+                df = (f"{s.get('dispatch_seconds', 0.0)}/"
+                      f"{s.get('fetch_seconds', 0.0)}")
+            series = s.get('tps_series') or []
+            peak = max(series) if series else 0.0
+            spark = _sparkline([v / peak for v in series]) if peak \
+                else ''
+            rows.append([
+                name[:52], ','.join(s.get('kinds') or []) or '-',
+                s.get('batches', 0), s.get('rows', 0),
+                s.get('tokens_per_sec')
+                if s.get('tokens_per_sec') is not None else '-',
+                f"{s['duty_cycle']:.0%}"
+                if s.get('duty_cycle') is not None else '-',
+                s.get('pad_eff')
+                if s.get('pad_eff') is not None else '-',
+                predec, df, s.get('cached_rows', 0), spark])
+        out.append(_table(rows))
+
     out.append('\n-- slot utilization --')
     util = report['slot_utilization']
     if util['timeline']:
@@ -532,7 +581,29 @@ def main(argv: Optional[List[str]] = None) -> int:
                         help='report a specific trace id (resumed runs '
                         'append several to one events.jsonl; default: '
                         'the latest — the header lists all of them)')
+    parser.add_argument('--export', default=None, metavar='OUT.json',
+                        help='instead of the text report, write a '
+                        'Chrome traceEvents JSON (span tree + flight-'
+                        'recorder batch slices, one track per device '
+                        'slot) loadable in ui.perfetto.dev or '
+                        'chrome://tracing')
     args = parser.parse_args(argv)
+    if args.export:
+        from opencompass_tpu.obs.export import export_chrome_trace
+        try:
+            doc = export_chrome_trace(args.work_dir, args.export,
+                                      trace=args.trace)
+        except FileNotFoundError as exc:
+            print(exc)
+            return 1
+        other = doc.get('otherData') or {}
+        print(f"wrote {len(doc['traceEvents'])} trace event(s) to "
+              f'{args.export} — open in https://ui.perfetto.dev '
+              '(or chrome://tracing)')
+        if other.get('xprof'):
+            print(f"xprof session capture: {other['xprof']} "
+                  '(view with tensorboard/xprof)')
+        return 0
     try:
         report = build_report(args.work_dir, trace=args.trace)
     except FileNotFoundError as exc:

@@ -1,0 +1,286 @@
+"""Per-batch flight recorder: one compact JSONL timeline per task.
+
+The live plane (heartbeats, status.json) answers "how far along is the
+run"; the trace report answers "where did the task's *total* time go".
+Neither can answer "what did batch 17 look like" — which is exactly
+where decode-vs-prefill cost structure, padding waste, and compile
+stalls hide.  The flight recorder closes that gap: every device batch an
+inferencer executes appends one structured record to
+``{obs_dir}/timeline/<task>.jsonl``:
+
+- the planned padded **shape** and real-vs-pad token split;
+- the **dispatch/fetch** wall split (host enqueue vs blocked-on-device),
+  and for generation the **prefill/decode** token split — the cost
+  structure "Efficiently Scaling Transformer Inference" shows serving
+  wins and regressions live in;
+- per-batch deltas of the model's perf counters (device/compile
+  seconds, tokens, compile-cache hits/misses), attributed sequentially
+  so totals are exact even under the double-buffered pipeline;
+- one ``plan`` record per executed plan (shape census, padding
+  efficiency, rows served from the result store before planning).
+
+Write discipline is the result store's: each record is a single
+``os.write`` on an ``O_APPEND`` fd (``utils.fileio.append_jsonl_atomic``)
+so concurrent writers interleave at record granularity and a ``kill -9``
+tears at most the final line, which readers skip.  Contract identical to
+the tracer: the recorder must **never fail a task** — every method is
+exception-guarded, and the disabled path is a :class:`NoopTimeline`.
+
+Consumers: the trace report's flight-recorder section (per-task
+throughput/duty-cycle rows + sparklines), the Chrome/Perfetto exporter
+(batch slices nested under task spans — ``obs/export.py``), and the
+regression ledger (per-unit kind attribution).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import os.path as osp
+import re
+import threading
+import time
+from typing import Dict, List, Optional
+
+from opencompass_tpu.utils.fileio import append_jsonl_atomic
+
+TIMELINE_VERSION = 1
+TIMELINE_SUBDIR = 'timeline'
+
+
+def timeline_path(obs_dir: str, task_name: str) -> str:
+    """Deterministic per-task timeline file under ``{obs_dir}/timeline/``
+    (same sanitize-plus-digest scheme as the heartbeat files, so distinct
+    task names never collide)."""
+    safe = re.sub(r'[^\w.\-]+', '_', task_name)[:80]
+    digest = hashlib.sha1(task_name.encode('utf-8')).hexdigest()[:8]
+    return osp.join(obs_dir, TIMELINE_SUBDIR, f'{safe}-{digest}.jsonl')
+
+
+class NoopTimeline:
+    """Disabled recorder: every method inert behind one ``enabled``
+    check, so instrumented code calls it unconditionally."""
+
+    enabled = False
+
+    def set_unit(self, name):
+        pass
+
+    def plan(self, kind, stats=None, planned=True, cached_rows=0):
+        pass
+
+    def batch(self, kind, **fields):
+        pass
+
+
+class Timeline:
+    """One task's flight-recorder file (append-only JSONL).
+
+    Record schema (one JSON object per line, ``v`` = 1):
+
+    ``{"v":1,"t":"plan","ts":...,"task":...,"unit":...,"kind":"gen",
+    "planned":true,"cached_rows":N,"stats":{planner PlanStats dict}}``
+
+    ``{"v":1,"t":"batch","ts":<dispatch wall s>,"unit":...,"kind":...,
+    "seq":n,"shape":[B,S],"rows":r,"real_tokens":...,"pad_tokens":...,
+    "dispatch_s":...,"batch_s":...,"device_s":...,"compile_s":...,
+    "tokens_in":...,"tokens_out":...,"first_calls":...,"cc_hits":...,
+    "cc_misses":...,"calls":[{per-model-call dispatch/fetch split}]}``
+
+    ``batch_s`` is dispatch-start → collect wall (the same latency
+    ``observe_batch`` histograms); perf-counter deltas are sequential
+    (each increment lands in exactly one record), so summing records
+    reproduces the task totals even though the pipeline overlaps
+    batches.
+    """
+
+    enabled = True
+
+    def __init__(self, obs_dir: str, task_name: str):
+        self.path = timeline_path(obs_dir, task_name)
+        self.task = task_name
+        self._unit: Optional[str] = None
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    def set_unit(self, name: Optional[str]):
+        try:
+            with self._lock:
+                self._unit = name
+        except Exception:
+            pass
+
+    def _append(self, rec: Dict):
+        rec = {'v': TIMELINE_VERSION, **rec}
+        append_jsonl_atomic(self.path, [rec])
+
+    def plan(self, kind: str, stats: Optional[Dict] = None,
+             planned: bool = True, cached_rows: int = 0):
+        """One record per executed plan: the shape census + how many
+        rows the result store served before planning."""
+        try:
+            with self._lock:
+                self._append({
+                    't': 'plan', 'ts': round(time.time(), 6),
+                    'task': self.task, 'unit': self._unit, 'kind': kind,
+                    'planned': bool(planned),
+                    'cached_rows': int(cached_rows),
+                    'stats': stats or {},
+                })
+        except Exception:
+            pass
+
+    def batch(self, kind: str, **fields):
+        """One record per executed device batch (see class docstring)."""
+        try:
+            with self._lock:
+                self._seq += 1
+                rec = {'t': 'batch', 'ts': fields.pop(
+                    'ts', round(time.time(), 6)),
+                    'unit': self._unit, 'kind': kind, 'seq': self._seq}
+                for key, val in fields.items():
+                    if val is not None:
+                        rec[key] = val
+                self._append(rec)
+        except Exception:
+            pass
+
+
+_NOOP_TIMELINE = NoopTimeline()
+_TIMELINE = _NOOP_TIMELINE
+
+
+def get_timeline():
+    """The process-wide recorder; a shared no-op until
+    ``obs.init_task_timeline`` installs a real one."""
+    return _TIMELINE
+
+
+def install_timeline(tl):
+    global _TIMELINE
+    _TIMELINE = tl
+    return tl
+
+
+def reset_timeline():
+    """Back to the no-op (test hook, and ``obs.reset_obs``)."""
+    global _TIMELINE
+    _TIMELINE = _NOOP_TIMELINE
+
+
+# -- readers ---------------------------------------------------------------
+
+def iter_records(path: str):
+    """Parseable timeline records in ``path``; torn/garbage lines are
+    skipped, never raised (same recovery contract as the store)."""
+    from opencompass_tpu.utils.fileio import iter_jsonl_records
+    return iter_jsonl_records(
+        path, keep=lambda r: r.get('t') in ('plan', 'batch'))
+
+
+def read_timelines(obs_dir: str) -> Dict[str, List[Dict]]:
+    """task name → records for every timeline file under ``obs_dir``.
+    The task name comes from the file's ``plan`` records (falls back to
+    the filename stem for a timeline torn before its first plan)."""
+    out: Dict[str, List[Dict]] = {}
+    tdir = osp.join(obs_dir, TIMELINE_SUBDIR)
+    try:
+        entries = sorted(os.listdir(tdir))
+    except OSError:
+        return out
+    for fname in entries:
+        if not fname.endswith('.jsonl'):
+            continue
+        records = list(iter_records(osp.join(tdir, fname)))
+        if not records:
+            continue
+        task = next((r['task'] for r in records
+                     if r.get('t') == 'plan' and r.get('task')),
+                    fname[:-len('.jsonl')])
+        out.setdefault(task, []).extend(records)
+    return out
+
+
+def _downsample(values: List[float], nbins: int = 24) -> List[float]:
+    """Average runs of values down to <= nbins points (sparkline feed)."""
+    if len(values) <= nbins:
+        return values
+    out = []
+    step = len(values) / nbins
+    for b in range(nbins):
+        lo, hi = int(b * step), max(int((b + 1) * step), int(b * step) + 1)
+        chunk = values[lo:hi]
+        out.append(sum(chunk) / len(chunk))
+    return out
+
+
+def summarize_records(records: List[Dict]) -> Dict:
+    """Fold one task's timeline into the report row: throughput, device
+    duty cycle over the batch span, prefill/decode + dispatch/fetch
+    splits, padding efficiency, and a per-batch tokens/s series."""
+    batches = [r for r in records if r.get('t') == 'batch']
+    plans = [r for r in records if r.get('t') == 'plan']
+
+    def tot(key, recs=batches):
+        return sum(r.get(key) or 0 for r in recs)
+
+    tokens = tot('tokens_in') + tot('tokens_out')
+    device_s = tot('device_s')
+    span = 0.0
+    if batches:
+        t0 = min(r['ts'] for r in batches)
+        t1 = max(r['ts'] + (r.get('batch_s') or 0) for r in batches)
+        span = max(t1 - t0, 1e-9)
+    real = tot('real_tokens')
+    pad = tot('pad_tokens')
+    calls = [c for r in batches for c in (r.get('calls') or [])]
+    series = [(r.get('tokens_in', 0) + r.get('tokens_out', 0))
+              / max(r.get('batch_s') or 0.0, 1e-9) for r in batches]
+    return {
+        'batches': len(batches),
+        'plans': len(plans),
+        'kinds': sorted({r.get('kind') for r in batches if r.get('kind')}),
+        'cached_rows': tot('cached_rows', plans),
+        'rows': tot('rows'),
+        'tokens_in': tot('tokens_in'),
+        'tokens_out': tot('tokens_out'),
+        'span_seconds': round(span, 3),
+        'tokens_per_sec': round(tokens / span, 1) if span else None,
+        'device_seconds': round(device_s, 3),
+        'compile_seconds': round(tot('compile_s'), 3),
+        # fraction of the batch span the device was actually busy —
+        # dispatch gaps, host stalls and fetch overhead all shrink it
+        'duty_cycle': round(min(device_s / span, 1.0), 3)
+        if span else None,
+        'pad_eff': round(real / (real + pad), 4) if real + pad else None,
+        'first_calls': tot('first_calls'),
+        'cc_hits': tot('cc_hits'),
+        'cc_misses': tot('cc_misses'),
+        # model-call level split: host enqueue (compile+trace+transfer
+        # setup) vs blocked-on-device fetch; gen calls also split tokens
+        # into prefill (prompt) vs decode (generated)
+        'dispatch_seconds': round(tot('dispatch_s', calls), 3),
+        'fetch_seconds': round(
+            sum(c.get('fetch_s') or 0 for c in calls), 3),
+        'prefill_tokens': sum(c.get('prefill_tokens') or 0 for c in calls),
+        'decode_tokens': sum(c.get('decode_tokens') or 0 for c in calls),
+        'tps_series': [round(v, 1) for v in _downsample(series)],
+    }
+
+
+def summarize_timelines(obs_dir: str) -> Dict[str, Dict]:
+    """task → flight-recorder summary for every timeline under
+    ``obs_dir`` (the report's per-task rows)."""
+    return {task: summarize_records(recs)
+            for task, recs in read_timelines(obs_dir).items()}
+
+
+def unit_kinds(obs_dir: str) -> Dict[str, str]:
+    """unit name (``model/dataset``) → inferencer kind, joined from the
+    plan records — the regression ledger's kind attribution."""
+    out: Dict[str, str] = {}
+    for recs in read_timelines(obs_dir).values():
+        for r in recs:
+            if r.get('t') == 'plan' and r.get('unit') and r.get('kind'):
+                out.setdefault(r['unit'], r['kind'])
+    return out

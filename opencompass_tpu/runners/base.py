@@ -138,11 +138,22 @@ class BaseRunner:
         return cls(task_cfg, **type_cfg)
 
     def debug_launch(self, tasks: List[Dict]) -> List[Tuple[str, int]]:
-        """Serial in-process execution with live output (``--debug``)."""
+        """Serial in-process execution with live output (``--debug``).
+        Traced runs still feed the status aggregator and the per-batch
+        flight recorder (heartbeats stay off — the driver process must
+        not masquerade as a task process to the stall watchdog)."""
+        from opencompass_tpu import obs
+        agg = getattr(self, '_status_agg', None)
         status = []
         for task_cfg in tasks:
             task = self.build_task(task_cfg)
+            self.logger.info(f'Running {task.name} in-process (debug)')
+            if agg is not None:
+                agg.task_started(task.name)
+            obs.init_task_timeline(task.name)
             task.run()
+            if agg is not None:
+                agg.task_finished(task.name, 0)
             status.append((task.name, 0))
         return status
 

@@ -85,18 +85,7 @@ class LocalRunner(BaseRunner):
 
     def launch(self, tasks: List[Dict]) -> List[Tuple[str, int]]:
         if self.debug:
-            agg = getattr(self, '_status_agg', None)
-            status = []
-            for task_cfg in tasks:
-                task = self.build_task(task_cfg)
-                self.logger.info(f'Running {task.name} in-process (debug)')
-                if agg is not None:
-                    agg.task_started(task.name)
-                task.run()
-                if agg is not None:
-                    agg.task_finished(task.name, 0)
-                status.append((task.name, 0))
-            return status
+            return self.debug_launch(tasks)
 
         groups, singles = self._plan_worker_groups(tasks)
         results: List = [None] * len(tasks)

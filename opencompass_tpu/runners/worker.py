@@ -323,6 +323,9 @@ def _handle_run(msg: Dict) -> Dict:
         os._exit(13)
 
     heartbeat = obs.init_task_heartbeat(name)
+    # per-batch flight recorder, re-bound per task so each task's
+    # batches land in its own timeline file
+    obs.init_task_timeline(name)
     warmed = 0
     returncode, error = 0, None
     log_path = msg.get('log_path') or task.get_log_path('out')

@@ -82,22 +82,11 @@ def counters_snapshot() -> Dict[str, int]:
 
 def iter_jsonl(path: str) -> Iterator[Dict]:
     """Parseable row records in ``path``; torn / garbage lines are
-    skipped, never raised (the recovery half of the commit protocol)."""
-    try:
-        f = open(path, encoding='utf-8', errors='replace')
-    except OSError:
-        return
-    with f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except ValueError:
-                continue   # torn final line from a killed writer
-            if isinstance(rec, dict) and 'k' in rec and 'v' in rec:
-                yield rec
+    skipped, never raised (the recovery half of the commit protocol —
+    the generic reader lives in ``utils.fileio.iter_jsonl_records``)."""
+    from opencompass_tpu.utils.fileio import iter_jsonl_records
+    return iter_jsonl_records(
+        path, keep=lambda rec: 'k' in rec and 'v' in rec)
 
 
 class ResultStore:

@@ -42,6 +42,9 @@ def main():
     # live progress file for the driver's status aggregator / stall
     # watchdog (NoopHeartbeat when the run is untraced)
     heartbeat = obs.init_task_heartbeat(task.name)
+    # per-batch flight recorder ({obs_dir}/timeline/<task>.jsonl;
+    # NoopTimeline when the run is untraced)
+    obs.init_task_timeline(task.name)
     logger.info(f'Task {task.name}')
     start = time.time()
     try:

@@ -14,7 +14,7 @@ import os.path as osp
 from typing import Any, Dict
 
 from opencompass_tpu.obs import (device_memory_attrs, get_heartbeat,
-                                 get_tracer)
+                                 get_timeline, get_tracer)
 from opencompass_tpu.parallel.distributed import (broadcast_object,
                                                   is_main_process)
 from opencompass_tpu.registry import (ICL_INFERENCERS, ICL_PROMPT_TEMPLATES,
@@ -111,6 +111,8 @@ class OpenICLInferTask(BaseTask):
                 continue
             heartbeat.set_unit(units_done, units_total,
                                f'{m_abbr}/{d_abbr}')
+            # flight-recorder batches attribute to this unit
+            get_timeline().set_unit(f'{m_abbr}/{d_abbr}')
             perf_path = trace_dir = None
             if is_main_process():
                 perf_path = get_infer_output_path(

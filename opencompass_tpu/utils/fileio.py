@@ -104,6 +104,29 @@ def append_jsonl_atomic(path: str, records: Iterable[Dict]):
         os.close(fd)
 
 
+def iter_jsonl_records(path: str, keep=None):
+    """Parseable JSON-object lines of ``path``; torn / garbage lines are
+    skipped, never raised — the reader half of the ``append_jsonl_atomic``
+    recovery contract, shared by the result store, the flight-recorder
+    timelines, and the regression ledger.  ``keep`` optionally filters
+    records (e.g. require specific keys)."""
+    try:
+        f = open(path, encoding='utf-8', errors='replace')
+    except OSError:
+        return
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue   # torn final line from a killed writer
+            if isinstance(rec, dict) and (keep is None or keep(rec)):
+                yield rec
+
+
 def register_backend(prefix: str, backend) -> None:
     """Route paths starting with `prefix` (e.g. ``'gs://'``) to `backend`."""
     _BACKENDS[prefix] = backend

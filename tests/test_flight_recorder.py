@@ -1,0 +1,578 @@
+"""Flight recorder + Perfetto export + regression ledger.
+
+Unit level: timeline schema & torn-line recovery, Chrome traceEvents
+well-formedness, ledger diff/check exit codes on synthetic regressions,
+and the ETA-skew fix (cached rows must not inflate the completion rate).
+
+E2e (module fixture): a FakeModel sweep with ``--obs`` twice plus an
+env-slowed third run against one shared cache root — per-batch timeline
+files, a loadable ``cli trace --export`` JSON, ledger records per run,
+~0 diff between identical runs, and ``cli ledger check`` exiting
+non-zero on the injected slowdown (the ISSUE 6 acceptance bar).
+"""
+import json
+import os
+import os.path as osp
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs():
+    from opencompass_tpu import obs
+    obs.reset_obs()
+    yield
+    obs.reset_obs()
+
+
+def _cpu_env(**extra):
+    env = dict(os.environ, JAX_PLATFORMS='cpu', **extra)
+    env.pop('PALLAS_AXON_POOL_IPS', None)
+    return env
+
+
+# -- timeline schema + torn-line recovery -----------------------------------
+
+def test_timeline_schema_and_summary(tmp_path):
+    from opencompass_tpu import obs
+    from opencompass_tpu.obs import timeline as tmod
+    tracer = obs.init_obs(str(tmp_path))
+    tl = obs.init_task_timeline('Task[m/d] with/odd chars')
+    assert tl.enabled
+    tl.set_unit('m/d')
+    tl.plan('gen', stats={'n_rows': 8, 'pad_eff': 0.9}, planned=True,
+            cached_rows=3)
+    tl.batch('gen', ts=100.0, shape=[4, 128], rows=4, real_tokens=400,
+             pad_tokens=112, dispatch_s=0.01, batch_s=0.5, device_s=0.4,
+             compile_s=0.1, tokens_in=400, tokens_out=64, first_calls=1,
+             calls=[{'kind': 'gen', 'dispatch_s': 0.01, 'fetch_s': 0.39,
+                     'prefill_tokens': 400, 'decode_tokens': 64,
+                     'first': True}])
+    tl.batch('gen', ts=100.5, shape=[4, 128], rows=4, real_tokens=300,
+             pad_tokens=212, batch_s=0.25, device_s=0.2, compile_s=0.0,
+             tokens_in=300, tokens_out=64, first_calls=0)
+    records = list(tmod.iter_records(tl.path))
+    assert [r['t'] for r in records] == ['plan', 'batch', 'batch']
+    assert all(r['v'] == 1 for r in records)
+    assert records[0]['task'] == 'Task[m/d] with/odd chars'
+    assert records[1]['seq'] == 1 and records[2]['seq'] == 2
+    assert records[1]['unit'] == 'm/d'
+
+    by_task = tmod.read_timelines(tracer.obs_dir)
+    assert set(by_task) == {'Task[m/d] with/odd chars'}
+    summary = tmod.summarize_records(records)
+    assert summary['batches'] == 2
+    assert summary['cached_rows'] == 3
+    assert summary['rows'] == 8
+    assert summary['kinds'] == ['gen']
+    # span 100.0 -> 100.75; device 0.6 busy
+    assert summary['span_seconds'] == pytest.approx(0.75)
+    assert summary['duty_cycle'] == pytest.approx(0.8)
+    assert summary['tokens_per_sec'] == pytest.approx(
+        (400 + 300 + 128) / 0.75, rel=1e-3)
+    assert summary['pad_eff'] == pytest.approx(700 / 1024, abs=1e-3)
+    assert summary['prefill_tokens'] == 400
+    assert summary['decode_tokens'] == 64
+    assert summary['dispatch_seconds'] == pytest.approx(0.01)
+    assert len(summary['tps_series']) == 2
+    assert tmod.unit_kinds(tracer.obs_dir) == {'m/d': 'gen'}
+
+
+def test_timeline_torn_line_recovery(tmp_path):
+    from opencompass_tpu import obs
+    from opencompass_tpu.obs import timeline as tmod
+    obs.init_obs(str(tmp_path))
+    tl = obs.init_task_timeline('torn')
+    tl.plan('ppl', stats={}, planned=False, cached_rows=0)
+    tl.batch('ppl', ts=1.0, shape=[2, 8], rows=2, real_tokens=10,
+             pad_tokens=6, batch_s=0.1)
+    # a kill -9 mid-write tears the final line; readers must skip it
+    with open(tl.path, 'a', encoding='utf-8') as f:
+        f.write('{"v":1,"t":"batch","ts":2.0,"shape":[2,')
+    records = list(tmod.iter_records(tl.path))
+    assert [r['t'] for r in records] == ['plan', 'batch']
+    # and a writer appending after the tear starts a clean line
+    tl.batch('ppl', ts=3.0, shape=[2, 8], rows=2, real_tokens=10,
+             pad_tokens=6, batch_s=0.1)
+    records = list(tmod.iter_records(tl.path))
+    assert len(records) == 2  # torn line still skipped, not resurrected
+    # (the torn fragment absorbed the next record's line — that is the
+    # documented cost of an interleaved tear; counts stay conservative)
+
+
+def test_timeline_disabled_noop(tmp_path):
+    from opencompass_tpu import obs
+    tl = obs.get_timeline()
+    assert tl.enabled is False
+    tl.set_unit('x')
+    tl.plan('gen')
+    tl.batch('gen', shape=[1, 1], rows=1)
+    assert os.listdir(str(tmp_path)) == []
+    # untraced processes stay on the noop even through init
+    assert obs.init_task_timeline('t').enabled is False
+
+
+def test_tl_track_gates_on_timeline(tmp_path):
+    """Model call tracking follows the *timeline* (its consumer), not
+    the tracer: a directly-installed recorder captures calls, and the
+    noop default drops them."""
+    from opencompass_tpu.models import FakeModel
+    from opencompass_tpu.obs import timeline as tmod
+    model = FakeModel(path='fake')
+    assert model._tl_track('gen', (2, 8), True, 10) is None
+    tmod.install_timeline(tmod.Timeline(str(tmp_path), 'tl-gate'))
+    try:
+        info = model._tl_track('gen', (2, 8), True, 10)
+        assert info is not None and info['prefill_tokens'] == 10
+        assert model.pop_batch_calls(1) == [info]
+    finally:
+        tmod.reset_timeline()
+
+
+def test_run_plan_emits_timeline_records(tmp_path):
+    """The inferencer's run_plan wrapper records one batch per executed
+    plan batch, with exact real/pad token accounting."""
+    from opencompass_tpu import obs
+    from opencompass_tpu.icl.inferencers.base import BaseInferencer
+    from opencompass_tpu.models import FakeModel
+    from opencompass_tpu.obs import timeline as tmod
+    obs.init_obs(str(tmp_path))
+    obs.init_task_timeline('plan-task')
+
+    from opencompass_tpu.icl.inferencers import schedule
+    model = FakeModel(path='fake')
+    inf = BaseInferencer(model=model, batch_size=2, batch_plan=True)
+    plan = inf.make_plan([5, 3, 8, 2])
+    seen = []
+    inf.run_plan(plan,
+                 lambda b: schedule.ReadyHandle([0] * len(b.indices)),
+                 lambda b, r: seen.append(b), kind='gen', cached_rows=7)
+    assert len(seen) == len(plan.batches)
+    (records,) = tmod.read_timelines(
+        osp.join(str(tmp_path), 'obs')).values()
+    plans = [r for r in records if r['t'] == 'plan']
+    batches = [r for r in records if r['t'] == 'batch']
+    assert len(plans) == 1 and plans[0]['cached_rows'] == 7
+    assert plans[0]['kind'] == 'gen'
+    assert len(batches) == len(plan.batches)
+    assert sum(b['rows'] for b in batches) == 4
+    assert sum(b['real_tokens'] for b in batches) == 5 + 3 + 8 + 2
+    for b in batches:
+        assert b['batch_s'] >= 0 and b['shape'][0] >= 1
+    # a fully store-served plan executes zero batches but still leaves
+    # its plan record (ledger kind attribution + cached-row accounting)
+    inf.run_plan(inf.make_plan([]),
+                 lambda b: schedule.ReadyHandle(None),
+                 lambda b, r: None, kind='ppl', cached_rows=9)
+    (records,) = tmod.read_timelines(
+        osp.join(str(tmp_path), 'obs')).values()
+    empty = [r for r in records
+             if r['t'] == 'plan' and r['kind'] == 'ppl']
+    assert len(empty) == 1 and empty[0]['cached_rows'] == 9
+
+
+def test_debug_batch_sleep_env(tmp_path, monkeypatch):
+    """OCT_DEBUG_BATCH_SLEEP_S slows every collected batch — the
+    deterministic slowdown the ledger acceptance test injects."""
+    from opencompass_tpu.icl.inferencers import schedule
+    from opencompass_tpu.icl.inferencers.base import BaseInferencer
+    from opencompass_tpu.models import FakeModel
+    inf = BaseInferencer(model=FakeModel(path='fake'), batch_size=4,
+                         batch_plan=True)
+    plan = inf.make_plan([2, 2])
+    monkeypatch.setenv('OCT_DEBUG_BATCH_SLEEP_S', '0.2')
+    t0 = time.perf_counter()
+    inf.run_plan(plan, lambda b: schedule.ReadyHandle(None),
+                 lambda b, r: None)
+    assert time.perf_counter() - t0 >= 0.2 * len(plan.batches)
+
+
+# -- Chrome/Perfetto export -------------------------------------------------
+
+def _validate_chrome(doc):
+    """The acceptance bar: loadable traceEvents, per-track monotonic
+    timestamps, matched + properly nested B/E pairs."""
+    assert isinstance(doc['traceEvents'], list) and doc['traceEvents']
+    tracks = {}
+    for ev in doc['traceEvents']:
+        assert ev['ph'] in 'BEXMC'
+        if ev['ph'] in 'BEX':
+            assert isinstance(ev['ts'], int) and ev['ts'] >= 0
+            tracks.setdefault((ev['pid'], ev.get('tid')),
+                              []).append(ev)
+    for key, events in tracks.items():
+        stack, last = [], -1
+        for ev in events:
+            assert ev['ts'] >= last, (key, ev, last)
+            last = ev['ts']
+            if ev['ph'] == 'B':
+                stack.append(ev['name'])
+            elif ev['ph'] == 'E':
+                assert stack and stack[-1] == ev['name'], (key, ev)
+                stack.pop()
+        assert not stack, (key, stack)
+    return tracks
+
+
+def test_chrome_export_from_fixture(tmp_path):
+    from opencompass_tpu.obs.export import export_chrome_trace
+    out = str(tmp_path / 'trace.json')
+    export_chrome_trace(osp.join(REPO, 'tests', 'fixtures', 'obs_run'),
+                        out)
+    doc = json.load(open(out))
+    tracks = _validate_chrome(doc)
+    # fixture tasks ran on device slots 0 and 1 → slot tracks on pid 1
+    assert (1, 0) in tracks and (1, 1) in tracks
+    names = {e['args']['name'] for e in doc['traceEvents']
+             if e['ph'] == 'M'}
+    assert {'driver', 'device slots', 'slot 0', 'slot 1'} <= names
+    task_spans = [e for e in doc['traceEvents'] if e['ph'] == 'B'
+                  and e['name'].startswith('task:')]
+    assert len(task_spans) == 2
+
+
+def test_chrome_export_missing_run(tmp_path):
+    from opencompass_tpu.obs.export import build_chrome_trace
+    with pytest.raises(FileNotFoundError):
+        build_chrome_trace(str(tmp_path))
+
+
+# -- ledger unit level ------------------------------------------------------
+
+def _synthetic_ledger(tmp_path, rows):
+    from opencompass_tpu.utils.fileio import append_jsonl_atomic
+    led = tmp_path / 'ledger'
+    led.mkdir()
+    append_jsonl_atomic(str(led / 'runs.jsonl'), rows)
+    return str(led)
+
+
+def _rec(run, model='m', dataset='d', tps=100.0, acc=80.0):
+    return {'v': 1, 'ts': 1.0, 'run': run, 'model': model,
+            'dataset': dataset, 'kind': 'gen', 'tokens_per_sec': tps,
+            'samples_per_sec': tps / 10, 'wall_seconds': 1.0,
+            'compile_seconds': 0.1, 'pad_eff': 0.9,
+            'accuracy': {'score': acc}}
+
+
+def test_ledger_diff_and_check_thresholds(tmp_path):
+    from opencompass_tpu.ledger import (check_records, diff_records,
+                                        iter_ledger)
+    led = _synthetic_ledger(tmp_path, [
+        _rec('r1'), _rec('r1', dataset='d2', tps=50.0),
+        _rec('r2', tps=95.0), _rec('r2', dataset='d2', tps=20.0,
+                                   acc=70.0),
+    ])
+    records = list(iter_ledger(osp.join(led, 'runs.jsonl')))
+    assert len(records) == 4
+    rows = {(r['model'], r['dataset']): r
+            for r in diff_records(records, 'r1', 'r2')}
+    assert rows[('m', 'd')]['tokens_per_sec_rel'] == pytest.approx(-0.05)
+    regs = check_records(records, 'r1', 'r2', max_slowdown=0.25,
+                         max_accuracy_drop=0.5)
+    # d2 regressed both ways; throughput is reported first
+    assert len(regs) == 1 and regs[0]['dataset'] == 'd2'
+    assert regs[0]['regression'] == 'throughput'
+    # accuracy-only regression when throughput is within budget
+    regs = check_records(records, 'r1', 'r2', max_slowdown=0.9,
+                         max_accuracy_drop=0.5)
+    assert len(regs) == 1 and regs[0]['regression'] == 'accuracy'
+    assert regs[0]['drops'] == {'score': -10.0}
+    # missing rows are not regressions
+    regs = check_records(records + [_rec('r3')], 'r2', 'r3',
+                         max_slowdown=0.25)
+    assert regs == []
+
+
+def test_ledger_check_skips_fully_cached_rows(tmp_path):
+    """A warm rerun the result store served fully records tokens/s ~0;
+    that must not trip the throughput gate (the run did no device
+    work), while accuracy still gates."""
+    from opencompass_tpu.ledger import check_records, iter_ledger
+    cold = dict(_rec('r1'), store_hit_rate=0.0)
+    warm = dict(_rec('r2', tps=0.0), store_hit_rate=1.0)
+    led = _synthetic_ledger(tmp_path, [cold, warm])
+    records = list(iter_ledger(osp.join(led, 'runs.jsonl')))
+    assert check_records(records, 'r1', 'r2', max_slowdown=0.25) == []
+    # ...in either direction (cold run vs a fully-cached baseline)
+    assert check_records(records, 'r2', 'r1', max_slowdown=0.25) == []
+    # but an accuracy drop on the cached run still fails the gate
+    worse = dict(_rec('r3', tps=0.0, acc=70.0), store_hit_rate=1.0)
+    regs = check_records(records + [worse], 'r1', 'r3',
+                         max_slowdown=0.25, max_accuracy_drop=0.5)
+    assert len(regs) == 1 and regs[0]['regression'] == 'accuracy'
+
+
+def test_ledger_cli_exit_codes(tmp_path):
+    led = _synthetic_ledger(tmp_path, [
+        _rec('r1'), _rec('r2', tps=30.0)])
+
+    def cli(*argv):
+        return subprocess.run(
+            [sys.executable, '-m', 'opencompass_tpu.cli', 'ledger',
+             *argv], cwd=REPO, env=_cpu_env(), capture_output=True,
+            text=True, timeout=120)
+
+    r = cli('list', '--ledger', led)
+    assert r.returncode == 0 and 'r1' in r.stdout and 'r2' in r.stdout
+    r = cli('check', '--ledger', led)
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert 'REGRESSION' in r.stdout
+    r = cli('check', '--ledger', led, '--max-slowdown', '0.9')
+    assert r.returncode == 0
+    # pin r2 as baseline: r2 vs r2 is no comparison -> usage error
+    assert cli('pin', 'r1', '--ledger', led).returncode == 0
+    r = cli('diff', '--ledger', led)
+    assert r.returncode == 0 and 'baseline r1' in r.stdout
+
+
+def test_ledger_trajectory_gate(tmp_path):
+    from opencompass_tpu.ledger import check_trajectory
+    path = str(tmp_path / 'BENCH_TRAJECTORY.json')
+    rows = [
+        {'v': 1, 'leg': 'warm_path', 'metric': 'compile_speedup',
+         'value': 2.6},
+        {'v': 1, 'leg': 'warm_path', 'metric': 'compile_speedup',
+         'value': 2.5},
+        {'v': 1, 'leg': 'lat', 'metric': 'seconds', 'value': 1.0,
+         'direction': 'lower'},
+        {'v': 1, 'leg': 'lat', 'metric': 'seconds', 'value': 2.0,
+         'direction': 'lower'},
+    ]
+    json.dump(rows, open(path, 'w'))
+    regs = check_trajectory(path, max_slowdown=0.25)
+    assert [r['leg'] for r in regs] == ['lat']  # lower-is-better doubled
+    rows[1]['value'] = 1.0
+    rows[3]['value'] = 1.1
+    json.dump(rows, open(path, 'w'))
+    regs = check_trajectory(path, max_slowdown=0.25)
+    assert [r['leg'] for r in regs] == ['warm_path']
+
+
+def test_ledger_torn_line_and_dedup(tmp_path):
+    from opencompass_tpu.ledger import append_run, iter_ledger
+    led = _synthetic_ledger(tmp_path, [_rec('r1')])
+    path = osp.join(led, 'runs.jsonl')
+    with open(path, 'a', encoding='utf-8') as f:
+        f.write('{"run": "torn...')
+    assert [r['run'] for r in iter_ledger(path)] == ['r1']
+    # append_run with no perf artifacts is a no-op, never an error
+    assert append_run(str(tmp_path / 'nowork'), ledger=led) == []
+
+
+# -- ETA skew (cached vs computed rows) ------------------------------------
+
+def test_eta_extrapolates_from_computed_rows_only(tmp_path):
+    """A half-cached sweep: 50 of 100 rows served instantly from the
+    store, 10 more computed over 60s.  The pre-fix formula extrapolated
+    the remaining 40 rows at the blended (cache-inflated) rate; the fix
+    must use the computed-row rate."""
+    from opencompass_tpu.obs.live import build_status
+    from opencompass_tpu.utils.fileio import atomic_write_json
+    obs_dir = tmp_path / 'obs'
+    (obs_dir / 'progress').mkdir(parents=True)
+    from opencompass_tpu.obs.live import heartbeat_path
+    hb = {'v': 1, 'task': 'T', 'pid': 1, 'ts': time.time(),
+          'state': 'running', 'unit': 'm/d', 'units_done': 0,
+          'units_total': 1, 'done': 60, 'total': 100, 'cached': 50,
+          'rows_done': 60, 'rows_cached': 50, 'tokens_per_sec': None,
+          'last_batch_seconds': None, 'store_hits': 50,
+          'store_misses': 10, 'pad_eff': 0.75}
+    atomic_write_json(heartbeat_path(str(obs_dir), 'T'), hb)
+    now = time.time()
+    snap = build_status(str(obs_dir),
+                        runner_state={'runner': 'x', 'started': now - 60,
+                                      'state': 'running',
+                                      'tasks': {'T': {'state': 'running',
+                                                      'returncode':
+                                                          None}}},
+                        now=now)
+    o = snap['overall']
+    assert o['progress'] == pytest.approx(0.6)
+    assert o['cached_progress'] == pytest.approx(0.5)
+    # 10 computed rows took 60s -> 40 remaining at that rate = 240s.
+    # progress formula: 60 * (1-0.6) / (0.6-0.5) = 240 (old: 40s)
+    assert o['eta_seconds'] == pytest.approx(240.0, rel=0.05)
+    # new live-plane surfacing
+    assert o['store_hit_rate'] == pytest.approx(50 / 60, abs=1e-3)
+    assert o['pad_eff'] == pytest.approx(0.75)
+    task = snap['tasks']['T']
+    assert task['store_hit_rate'] == pytest.approx(50 / 60, abs=1e-3)
+    assert task['pad_eff'] == 0.75
+    assert task['rows_cached'] == 50
+
+
+def test_eta_none_when_all_progress_cached(tmp_path):
+    """100% cache-served progress carries no rate information — the
+    ETA must be None, not 0."""
+    from opencompass_tpu.obs.live import build_status, heartbeat_path
+    from opencompass_tpu.utils.fileio import atomic_write_json
+    obs_dir = tmp_path / 'obs'
+    (obs_dir / 'progress').mkdir(parents=True)
+    hb = {'v': 1, 'task': 'T', 'pid': 1, 'ts': time.time(),
+          'state': 'running', 'unit': None, 'units_done': 0,
+          'units_total': 1, 'done': 50, 'total': 100, 'cached': 50,
+          'rows_done': 50, 'rows_cached': 50}
+    atomic_write_json(heartbeat_path(str(obs_dir), 'T'), hb)
+    now = time.time()
+    snap = build_status(str(obs_dir),
+                        runner_state={'started': now - 60,
+                                      'state': 'running',
+                                      'tasks': {'T': {'state':
+                                                      'running'}}},
+                        now=now)
+    assert snap['overall']['eta_seconds'] is None
+
+
+def test_heartbeat_cached_accounting(tmp_path):
+    """Heartbeat folds per-unit cached counts into cumulative rows_*
+    counters across set_unit boundaries."""
+    from opencompass_tpu.obs.live import Heartbeat
+    hb = Heartbeat(str(tmp_path), 'T', interval=0.0)
+    hb.set_unit(0, 2, 'u1')
+    hb.progress(done=10, total=10, cached=4, force=True)
+    hb.set_unit(1, 2, 'u2')
+    hb.add(3)
+    hb.add(2, cached=True)
+    hb.progress(force=True)
+    rec = json.load(open(hb.path))
+    assert rec['rows_done'] == 15
+    assert rec['rows_cached'] == 6
+    assert rec['done'] == 5 and rec['cached'] == 2
+
+
+# -- e2e acceptance ---------------------------------------------------------
+
+@pytest.fixture(scope='module')
+def flight_e2e(tmp_path_factory):
+    """Three FakeModel sweeps sharing one cache root: two identical
+    (--no-result-cache so both execute), one with the env-injected
+    batch slowdown.  Run 1 takes the subprocess LocalRunner path so
+    timelines are written by real task processes (and task: spans give
+    the export its slot tracks); runs 2-3 use --debug for speed — the
+    ledger only needs their perf/results artifacts."""
+    work = str(tmp_path_factory.mktemp('flight_e2e'))
+    cache_root = osp.join(work, 'cache')
+    runs = []
+    for i, slow in enumerate((None, None, '0.3')):
+        extra = {'OCT_CACHE_ROOT': cache_root}
+        if slow:
+            extra['OCT_DEBUG_BATCH_SLEEP_S'] = slow
+        argv = [sys.executable, 'run.py', 'configs/eval_demo.py', '-w',
+                work, '--obs', '--no-result-cache',
+                '--max-num-workers', '2']
+        if i > 0:
+            argv.append('--debug')
+        before = set(os.listdir(work)) if osp.isdir(work) else set()
+        r = subprocess.run(argv, cwd=REPO, env=_cpu_env(**extra),
+                           capture_output=True, text=True, timeout=420)
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+        (run_dir,) = [d for d in os.listdir(work)
+                      if d not in before and d != 'cache']
+        runs.append(run_dir)
+        time.sleep(1.1)   # distinct timestamped run dirs
+    return {'work': work, 'cache_root': cache_root, 'runs': runs}
+
+
+@pytest.mark.slow
+def test_e2e_timeline_files_written(flight_e2e):
+    from opencompass_tpu.obs.timeline import summarize_timelines
+    obs_dir = osp.join(flight_e2e['work'], flight_e2e['runs'][0], 'obs')
+    summaries = summarize_timelines(obs_dir)
+    assert summaries, 'no timeline files were written'
+    total = sum(s['batches'] for s in summaries.values())
+    assert total >= 2
+    kinds = {k for s in summaries.values() for k in s['kinds']}
+    assert {'gen', 'ppl'} <= kinds
+    for s in summaries.values():
+        assert s['tokens_per_sec'] is None or s['tokens_per_sec'] > 0
+
+
+@pytest.mark.slow
+def test_e2e_export_loads_and_validates(flight_e2e, tmp_path):
+    out = str(tmp_path / 'trace.json')
+    run_dir = osp.join(flight_e2e['work'], flight_e2e['runs'][0])
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'trace', run_dir,
+         '--export', out],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'ui.perfetto.dev' in r.stdout
+    doc = json.load(open(out))
+    tracks = _validate_chrome(doc)
+    # batch slices landed on the task tracks (pid 1)
+    xs = [e for e in doc['traceEvents'] if e['ph'] == 'X']
+    assert xs and all(e['pid'] == 1 for e in xs)
+    assert any(e['name'].startswith(('gen ', 'ppl ')) for e in xs)
+    # task spans and their subprocess descendants share a track
+    names_by_track = {}
+    for key, events in tracks.items():
+        names_by_track[key] = [e['name'] for e in events
+                               if e['ph'] == 'B']
+    task_tracks = [names for names in names_by_track.values()
+                   if any(n.startswith('task:') for n in names)]
+    assert task_tracks
+    assert any(any(n.startswith('proc:') for n in names)
+               for names in task_tracks)
+
+
+@pytest.mark.slow
+def test_e2e_ledger_records_and_identical_diff(flight_e2e):
+    led = osp.join(flight_e2e['cache_root'], 'ledger')
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'ledger', 'diff',
+         '--ledger', led, '--baseline', flight_e2e['runs'][0],
+         '--run', flight_e2e['runs'][1], '--json'],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    rows = [row for row in doc['rows']
+            if row['in_baseline'] and row['in_run']]
+    assert rows, 'identical runs produced no comparable ledger rows'
+    for row in rows:
+        assert row['kind'] in ('gen', 'ppl', 'clp')
+        # identical sweep: accuracy deltas exactly 0
+        for delta in (row.get('accuracy_delta') or {}).values():
+            assert delta == 0
+    # and check passes with a generous wall-noise allowance
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'ledger', 'check',
+         '--ledger', led, '--baseline', flight_e2e['runs'][0],
+         '--run', flight_e2e['runs'][1], '--max-slowdown', '0.9'],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+@pytest.mark.slow
+def test_e2e_injected_slowdown_fails_check(flight_e2e):
+    """The CI gate: an env-forced per-batch sleep in run 3 must trip
+    `cli ledger check` (exit 2) against the run-1 baseline."""
+    led = osp.join(flight_e2e['cache_root'], 'ledger')
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'ledger', 'check',
+         '--ledger', led, '--baseline', flight_e2e['runs'][0],
+         '--run', flight_e2e['runs'][2], '--max-slowdown', '0.9'],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert 'REGRESSION' in r.stdout
+
+
+@pytest.mark.slow
+def test_e2e_trace_report_flight_section(flight_e2e):
+    run_dir = osp.join(flight_e2e['work'], flight_e2e['runs'][0])
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'trace', run_dir],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'flight recorder' in r.stdout
+    assert 'tok/s over batches' in r.stdout

@@ -528,6 +528,45 @@ def test_e2e_status_cli_on_finished_run(obs_e2e_run):
     assert 'OpenICLEval' in r.stdout
 
 
+def test_e2e_flight_recorder_and_ledger(obs_e2e_run):
+    """Tier-1 wiring check for the flight-recorder layer: the
+    subprocess sweep wrote per-batch timelines, one ledger record per
+    (model, dataset) landed under the sweep cache root with inferencer-
+    kind attribution, and the CI perf-gate invocation (`cli ledger
+    check --trajectory`) runs clean on the repo's bench trajectory."""
+    run_dir = obs_e2e_run['run_dir']
+    from opencompass_tpu.obs.timeline import summarize_timelines
+    summaries = summarize_timelines(osp.join(run_dir, 'obs'))
+    assert summaries, 'no per-batch timeline files were written'
+    assert sum(s['batches'] for s in summaries.values()) >= 2
+    # trace report grew the flight-recorder section
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'trace', run_dir],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'flight recorder' in r.stdout
+    # one ledger record per (model, dataset), kind-attributed
+    led = osp.join(osp.dirname(run_dir), 'cache', 'ledger')
+    from opencompass_tpu.ledger import iter_ledger
+    records = list(iter_ledger(osp.join(led, 'runs.jsonl')))
+    assert records, 'driver appended no ledger records'
+    assert all(rec['run'] == osp.basename(run_dir) for rec in records)
+    assert {'gen', 'ppl'} <= {rec['kind'] for rec in records}
+    # CI perf gate: exits 0 here, non-zero on a thresholded regression
+    # (tests/test_flight_recorder.py proves the failing side)
+    # generous threshold: the committed bench trajectory carries real
+    # machine-to-machine noise (this gate exercises the wiring and
+    # catches order-of-magnitude regressions, not 25% jitter)
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'ledger', 'check',
+         '--ledger', led, '--trajectory', 'BENCH_TRAJECTORY.json',
+         '--max-slowdown', '0.9'],
+        cwd=REPO, env=_cpu_env(), capture_output=True, text=True,
+        timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 def test_obs_unset_creates_no_obs_dir(tmp_path):
     """Default runs must not grow an obs/ directory (zero-overhead-off)."""
     work = str(tmp_path / 'out')

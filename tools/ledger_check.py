@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Gate CI on the cross-run performance regression ledger (standalone
+twin of ``python -m opencompass_tpu.cli ledger check``).
+
+Exits **2** when the latest run's tokens/s or accuracy regressed past
+the thresholds vs the baseline (pinned, or the previous run), so a perf
+regression in a PR fails loudly instead of landing silently.
+
+Usage::
+
+    python tools/ledger_check.py outputs/demo                # work root
+    python tools/ledger_check.py --ledger /path/cache/ledger
+    python tools/ledger_check.py --baseline 20260801_120000 ...
+    python tools/ledger_check.py --trajectory BENCH_TRAJECTORY.json
+
+See docs/observability.md ("Regression ledger") for the record schema
+and baseline pinning.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from opencompass_tpu.ledger.cli import main  # noqa: E402
+
+if __name__ == '__main__':
+    raise SystemExit(main(['check'] + sys.argv[1:]))
