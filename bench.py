@@ -572,6 +572,147 @@ def _bench_flight_recorder(out_json='BENCH_FLIGHT.json'):
     return record
 
 
+def _bench_serve(out_json='BENCH_SERVE.json'):
+    """detail.serve: the evaluation-as-a-service loop end to end —
+    daemon up (fleet warmed), demo sweep enqueued, an interactive
+    /v1/completions answered mid-sweep, an identical sweep enqueued
+    behind it (served by the store: zero tasks), a repeated completion
+    (store hit: zero device rows), then SIGTERM drain.  Records queue
+    wait, warm reuse, and interactive latency.  Device-free."""
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix='oct_serve_')
+    cfg_path = os.path.join(here, 'configs', 'eval_demo.py')
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               OCT_CACHE_ROOT=os.path.join(tmp, 'cache'))
+    env.pop('OCT_TRACE_ID', None)
+    env.pop('OCT_OBS_DIR', None)
+    log_path = os.path.join(tmp, 'daemon.log')
+    log = open(log_path, 'w')
+    t_up = time.perf_counter()
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'serve', cfg_path,
+         '--port', '0', '--work-dir', os.path.join(tmp, 'out')],
+        stdout=log, stderr=subprocess.STDOUT, env=env, cwd=here)
+
+    def http(method, url, body=None, timeout=120):
+        req = urllib.request.Request(
+            url, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+
+    try:
+        port = None
+        deadline = time.time() + 180
+        while time.time() < deadline and port is None:
+            if proc.poll() is not None:
+                raise RuntimeError('daemon died at startup: '
+                                   + open(log_path).read()[-500:])
+            for line in open(log_path).read().splitlines():
+                if 'engine listening on http://127.0.0.1:' in line:
+                    port = int(line.split('127.0.0.1:')[1].split()[0])
+            time.sleep(0.2)
+        base = f'http://127.0.0.1:{port}'
+        while True:
+            try:
+                code, _ = http('GET', base + '/healthz', timeout=5)
+                if code == 200:
+                    break
+            except Exception:
+                pass
+            if time.time() > deadline:
+                raise RuntimeError('daemon never became ready')
+            time.sleep(0.5)
+        ready_s = time.perf_counter() - t_up
+
+        t0 = time.perf_counter()
+        _, s1 = http('POST', base + '/v1/sweeps',
+                     {'config_path': cfg_path, 'mode': 'infer'})
+        t1 = time.perf_counter()
+        _, comp = http('POST', base + '/v1/completions',
+                       {'model': 'fake-demo',
+                        'prompt': 'Q: serve bench?\nA:', 'max_tokens': 8})
+        interactive_ms = (time.perf_counter() - t1) * 1e3
+        mid_sweep = http('GET', f'{base}/v1/sweeps/{s1["id"]}')[1][
+            'status'] in ('queued', 'running')
+        while http('GET', f'{base}/v1/sweeps/{s1["id"]}')[1][
+                'status'] not in ('done', 'failed'):
+            time.sleep(0.25)
+        cold_wall = time.perf_counter() - t0
+        rep1 = http('GET', f'{base}/v1/sweeps/{s1["id"]}')[1]
+
+        # identical sweep behind a warm fleet + full store: the
+        # partitioner prunes every task pre-launch
+        t0 = time.perf_counter()
+        _, s2 = http('POST', base + '/v1/sweeps',
+                     {'config_path': cfg_path, 'mode': 'infer'})
+        while http('GET', f'{base}/v1/sweeps/{s2["id"]}')[1][
+                'status'] not in ('done', 'failed'):
+            time.sleep(0.25)
+        warm_wall = time.perf_counter() - t0
+        rep2 = http('GET', f'{base}/v1/sweeps/{s2["id"]}')[1]
+
+        t1 = time.perf_counter()
+        _, comp2 = http('POST', base + '/v1/completions',
+                        {'model': 'fake-demo',
+                         'prompt': 'Q: serve bench?\nA:',
+                         'max_tokens': 8})
+        cached_ms = (time.perf_counter() - t1) * 1e3
+        _, snap = http('GET', base + '/status')
+        serve = snap['serve']
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+    record = {
+        'v': 1,
+        'workload': 'FakeModel demo sweep through the serve daemon: '
+                    'cold sweep + mid-sweep completion, identical warm '
+                    'sweep (store-pruned), repeated completion '
+                    '(store hit), SIGTERM drain',
+        'ready_seconds': round(ready_s, 3),
+        'sweep_cold_wall_seconds': round(cold_wall, 3),
+        'sweep_warm_wall_seconds': round(warm_wall, 3),
+        'sweep_warm_speedup': round(cold_wall / max(warm_wall, 1e-3), 2),
+        'queue_wait_seconds': (rep1.get('detail') or {}).get(
+            'queue_wait_seconds'),
+        'cold_n_tasks': (rep1.get('detail') or {}).get('n_tasks'),
+        'warm_n_tasks': (rep2.get('detail') or {}).get('n_tasks'),
+        'interactive_mid_sweep': mid_sweep,
+        'interactive_latency_ms': round(interactive_ms, 1),
+        'interactive_cached_latency_ms': round(cached_ms, 1),
+        'interactive_model_built': comp.get('oct', {}).get('model_built'),
+        'cached_store_hits': comp2.get('oct', {}).get('store_hits'),
+        'cached_device_rows': comp2.get('oct', {}).get('device_rows'),
+        'worker_spawns': serve.get('worker_spawns'),
+        'worker_reuses': serve.get('worker_reuses'),
+        'drain_exit_code': proc.returncode,
+    }
+    try:
+        with open(os.path.join(here, out_json), 'w') as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
+    _append_trajectory(
+        'serve', 'interactive_cached_latency_ms',
+        record['interactive_cached_latency_ms'], 'ms', direction='lower',
+        detail={'warm_n_tasks': record['warm_n_tasks'],
+                'worker_reuses': record['worker_reuses'],
+                'queue_wait_seconds': record['queue_wait_seconds']})
+    return record
+
+
 def main():
     n_chips = max(1, len(jax.devices()))
     kind = getattr(jax.devices()[0], 'device_kind', '')
@@ -906,5 +1047,10 @@ if __name__ == '__main__':
         # standalone observability leg (device-free; runs on CPU hosts)
         print(json.dumps({'metric': 'flight_recorder', 'v': 1,
                           'detail': _bench_flight_recorder()}))
+        sys.exit(0)
+    if '--serve' in sys.argv:
+        # standalone serve-daemon leg (device-free; runs on CPU hosts)
+        print(json.dumps({'metric': 'serve', 'v': 1,
+                          'detail': _bench_serve()}))
         sys.exit(0)
     main()

@@ -26,6 +26,9 @@ Usage::
     python -m opencompass_tpu.cli ledger list WORK_DIR      # perf ledger
     python -m opencompass_tpu.cli ledger diff WORK_DIR      # vs baseline
     python -m opencompass_tpu.cli ledger check WORK_DIR     # CI perf gate
+    python -m opencompass_tpu.cli serve cfg.py --port 8000  # engine daemon
+                    # durable sweep queue + resident worker fleet +
+                    # OpenAI-compatible /v1/completions (docs/serving.md)
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files
@@ -272,9 +275,23 @@ def ledger_main(argv=None) -> int:
     return ledger_cli_main(argv)
 
 
+def serve_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli serve <config> [--port N]`` —
+    the persistent evaluation engine: durable FIFO sweep queue under
+    ``{cache_root}/serve/queue/``, model-resident worker fleet shared
+    across sweeps, and an OpenAI-compatible HTTP front door
+    (``POST /v1/sweeps``, ``POST /v1/completions``) next to the
+    telemetry endpoints.  Runs until SIGTERM/SIGINT; killing it
+    mid-sweep loses nothing (docs/serving.md)."""
+    from opencompass_tpu.serve.daemon import serve_main as engine_main
+    return engine_main(argv)
+
+
 def main():
     # subcommand dispatch before the run-config parser: `trace`/`status`
     # take a work_dir, not a config file
+    if len(sys.argv) > 1 and sys.argv[1] == 'serve':
+        raise SystemExit(serve_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'trace':
         raise SystemExit(trace_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'status':

@@ -471,29 +471,11 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
                                               rec.get('state', 'running'))
         row['progress'] = round(frac, 4) if frac is not None else None
 
-    n = len(tasks)
-    by_state = {'ok': 0, 'failed': 0, 'running': 0, 'pending': 0}
-    frac_sum = 0.0
-    cached_sum = 0.0     # progress attributable to ~0-cost cached rows
-    st_hits = st_misses = 0
-    pad_effs = []
-    for row in tasks.values():
-        state = row['state']
-        if row.get('progress') is None and state == 'ok':
-            row['progress'] = 1.0
-        by_state[state if state in by_state else 'running'] += 1
-        p = row.get('progress')
-        frac_sum += p if p is not None else 0.0
-        rows_done = row.get('rows_done') or 0
-        if p and rows_done:
-            cached_sum += p * min(
-                (row.get('rows_cached') or 0) / rows_done, 1.0)
-        st_hits += row.get('store_hits') or 0
-        st_misses += row.get('store_misses') or 0
-        if row.get('pad_eff') is not None:
-            pad_effs.append(row['pad_eff'])
-    progress = round(frac_sum / n, 4) if n else None
-    cached_progress = round(cached_sum / n, 4) if n else None
+    overall = fold_task_rows(tasks)
+    by_state = {state: overall[state]
+                for state in ('ok', 'failed', 'running', 'pending')}
+    progress = overall['progress']
+    cached_progress = overall['cached_progress']
 
     started = runner_state.get('started')
     if started is None and heartbeats:
@@ -502,7 +484,7 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
     elapsed = round(now - started, 3) if started else None
     state = runner_state.get('state',
                              'running' if by_state['running'] else
-                             ('done' if n else 'idle'))
+                             ('done' if overall['n_tasks'] else 'idle'))
     eta = None
     if state == 'running' and elapsed and progress \
             and 0.02 < progress < 1.0:
@@ -522,16 +504,70 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
         'started': started,
         'elapsed_seconds': elapsed,
         'tasks': tasks,
-        'overall': {'n_tasks': n, 'progress': progress,
-                    'cached_progress': cached_progress,
-                    'eta_seconds': eta,
-                    'store_hit_rate':
-                        round(st_hits / (st_hits + st_misses), 4)
-                        if st_hits + st_misses else None,
-                    'pad_eff': round(sum(pad_effs) / len(pad_effs), 4)
-                        if pad_effs else None,
-                    **by_state},
+        'overall': dict(overall, eta_seconds=eta),
         'slots': runner_state.get('slots'),
+    }
+
+
+def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
+    """Aggregate per-task status rows into the ``overall`` block.
+
+    Shared by the run-level :func:`build_status` and the serve plane's
+    per-sweep view (:func:`sweep_task_status`), so "what fraction of
+    these tasks is done" means the same thing whether *these tasks* is
+    the whole run or one queued sweep's slice of it.  Mutates rows only
+    to default a finished task's missing progress to 1.0 (the same
+    normalization build_status always applied)."""
+    n = len(tasks)
+    by_state = {'ok': 0, 'failed': 0, 'running': 0, 'pending': 0}
+    frac_sum = 0.0
+    cached_sum = 0.0     # progress attributable to ~0-cost cached rows
+    st_hits = st_misses = 0
+    pad_effs = []
+    for row in tasks.values():
+        state = row.get('state', 'running')
+        if row.get('progress') is None and state == 'ok':
+            row['progress'] = 1.0
+        by_state[state if state in by_state else 'running'] += 1
+        p = row.get('progress')
+        frac_sum += p if p is not None else 0.0
+        rows_done = row.get('rows_done') or 0
+        if p and rows_done:
+            cached_sum += p * min(
+                (row.get('rows_cached') or 0) / rows_done, 1.0)
+        st_hits += row.get('store_hits') or 0
+        st_misses += row.get('store_misses') or 0
+        if row.get('pad_eff') is not None:
+            pad_effs.append(row['pad_eff'])
+    return {
+        'n_tasks': n,
+        'progress': round(frac_sum / n, 4) if n else None,
+        'cached_progress': round(cached_sum / n, 4) if n else None,
+        'store_hit_rate': round(st_hits / (st_hits + st_misses), 4)
+        if st_hits + st_misses else None,
+        'pad_eff': round(sum(pad_effs) / len(pad_effs), 4)
+        if pad_effs else None,
+        **by_state,
+    }
+
+
+def sweep_task_status(snap: Dict, task_names) -> Dict:
+    """Narrow a run-level status snapshot to one sweep's tasks.
+
+    The serve daemon runs many queued sweeps under ONE obs dir, so the
+    aggregator's ``status.json`` mixes every sweep's tasks;
+    ``GET /v1/sweeps/<id>`` answers from this slice instead: the rows
+    whose names belong to the sweep, with the overall block recomputed
+    over just them."""
+    names = set(task_names or [])
+    tasks = {name: dict(row)
+             for name, row in (snap.get('tasks') or {}).items()
+             if name in names}
+    return {
+        'tasks': tasks,
+        'overall': fold_task_rows(tasks),
+        'missing': sorted(names - set(tasks)),
+        'ts': snap.get('ts'),
     }
 
 

@@ -10,12 +10,27 @@ buckets, and per-task gauges labeled ``{task="..."}``).
 
 - ``/metrics``  — Prometheus text (scrape target)
 - ``/status``   — the run status snapshot as JSON
-- ``/healthz``  — liveness probe (``ok``)
+- ``/healthz``  — health probe (see below)
 
 Enabled only by ``--obs-port`` (port 0 = ephemeral; the bound port is
 logged and written to ``{obs_dir}/http.json`` so tooling can find it).
 Same never-fail contract as the tracer: a failed bind or a handler
 exception can never fail or slow the run.
+
+The server is also the serve daemon's front door (serve/http.py):
+
+- ``routes`` registers extra ``(METHOD, path)`` handlers — exact keys,
+  or prefix keys ending in ``/`` — dispatched before the built-ins, so
+  a daemon can mount ``POST /v1/sweeps`` / ``POST /v1/completions``
+  next to the scrape endpoints;
+- ``readiness`` upgrades ``/healthz`` from liveness to *readiness*: the
+  probe returns a dict with a ``ready`` bool (workers warmed, queue
+  draining, store writable) served as JSON with 200 when ready and
+  **503** before the engine can actually answer traffic — a load
+  balancer never routes to a cold daemon;
+- ``status_fn`` overrides what ``/status`` (and the ``/metrics`` status
+  gauges) render, so the daemon can fold queue depth and fleet state
+  into the run snapshot.
 """
 from __future__ import annotations
 
@@ -28,6 +43,9 @@ import time
 from typing import Dict, List, Optional
 
 from opencompass_tpu.obs.live import current_status
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
 
 PROM_CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
 HTTP_INFO_FILE = 'http.json'
@@ -134,6 +152,14 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
         if slots.get(key) is not None:
             out.append(f'# TYPE {prefix}_slots_{key} gauge')
             out.append(_line(f'{prefix}_slots_{key}', slots[key]))
+    # serve-plane gauges (engine daemons fold these into their status
+    # snapshot): queue pressure + resident-fleet state
+    serve = status.get('serve') or {}
+    for key in ('queue_depth', 'sweeps_running', 'sweeps_done',
+                'sweeps_failed', 'workers_resident', 'workers_in_use'):
+        if serve.get(key) is not None:
+            out.append(f'# TYPE {prefix}_serve_{key} gauge')
+            out.append(_line(f'{prefix}_serve_{key}', serve[key]))
 
     tasks = status.get('tasks') or {}
     per_task = [
@@ -168,15 +194,46 @@ class ObsHTTPServer:
         port: TCP port; 0 binds an ephemeral one (see :attr:`port`).
         registry: the driver tracer's live ``MetricsRegistry`` (its
             snapshot is rendered on every ``/metrics`` scrape).
+        routes: extra handlers, ``{(METHOD, path): fn}`` — a key whose
+            path ends in ``/`` prefix-matches (longest prefix wins).
+            ``fn(path, query, body_bytes) -> (code, payload)`` where a
+            dict/list payload is rendered as JSON, bytes/str as text.
+        readiness: optional zero-arg probe returning a dict with a
+            ``ready`` bool; upgrades ``/healthz`` to 200/503 readiness.
+        status_fn: optional zero-arg snapshot provider for ``/status``
+            and the ``/metrics`` status gauges (default:
+            ``current_status(obs_dir)``).
     """
 
-    def __init__(self, obs_dir: str, port: int = 0, registry=None):
+    def __init__(self, obs_dir: str, port: int = 0, registry=None,
+                 routes: Optional[Dict] = None, readiness=None,
+                 status_fn=None):
         self.obs_dir = obs_dir
         self.requested_port = port
         self.registry = registry
+        self.routes = dict(routes or {})
+        self.readiness = readiness
+        self.status_fn = status_fn
         self.port: Optional[int] = None
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
+
+    def _route_for(self, method: str, path: str):
+        handler = self.routes.get((method, path))
+        if handler is not None:
+            return handler
+        best = None
+        for (m, prefix), fn in self.routes.items():
+            if m == method and prefix.endswith('/') \
+                    and path.startswith(prefix):
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, fn)
+        return best[1] if best else None
+
+    def _current_status(self):
+        if self.status_fn is not None:
+            return self.status_fn()
+        return current_status(self.obs_dir)
 
     def start(self) -> Optional[int]:
         """Bind + serve on a daemon thread; returns the bound port, or
@@ -199,38 +256,89 @@ class ObsHTTPServer:
                     self.end_headers()
                     self.wfile.write(body)
 
-                def do_GET(self):
+                def _send_payload(self, code: int, payload):
+                    if isinstance(payload, (dict, list)):
+                        body = json.dumps(payload, indent=2,
+                                          default=str).encode('utf-8')
+                        ctype = 'application/json; charset=utf-8'
+                    else:
+                        body = payload if isinstance(payload, bytes) \
+                            else str(payload).encode('utf-8')
+                        ctype = 'text/plain; charset=utf-8'
+                    self._send(code, ctype, body)
+
+                def _body(self) -> bytes:
                     try:
-                        path = self.path.split('?', 1)[0]
-                        if path == '/healthz':
-                            self._send(200, 'text/plain; charset=utf-8',
-                                       b'ok\n')
+                        n = int(self.headers.get('Content-Length') or 0)
+                    except (TypeError, ValueError):
+                        n = 0
+                    return self.rfile.read(n) if n > 0 else b''
+
+                def _dispatch(self, method: str):
+                    """Registered routes first (the serve daemon's API),
+                    then the built-ins; every handler exception becomes
+                    a 500 — the server itself never dies."""
+                    try:
+                        path, _, query = self.path.partition('?')
+                        handler = server._route_for(method, path)
+                        if handler is not None:
+                            body = self._body() \
+                                if method in ('POST', 'PUT') else b''
+                            code, payload = handler(path, query, body)
+                            self._send_payload(code, payload)
+                            return
+                        if method != 'GET':
+                            self._send_payload(404, 'not found\n')
+                        elif path == '/healthz':
+                            self._do_healthz()
                         elif path == '/status':
-                            body = json.dumps(
-                                current_status(server.obs_dir),
-                                indent=2, default=str).encode('utf-8')
-                            self._send(200,
-                                       'application/json; charset=utf-8',
-                                       body)
+                            self._send_payload(
+                                200, server._current_status())
                         elif path == '/metrics':
                             snap = server.registry.snapshot() \
                                 if server.registry is not None else {}
                             body = render_prometheus(
                                 snap,
-                                status=current_status(server.obs_dir),
+                                status=server._current_status(),
                             ).encode('utf-8')
                             self._send(200, PROM_CONTENT_TYPE, body)
                         else:
-                            self._send(404,
-                                       'text/plain; charset=utf-8',
-                                       b'not found\n')
-                    except Exception:
+                            self._send_payload(404, 'not found\n')
+                    except Exception as exc:
+                        logger.warning(
+                            f'handler error on {method} {self.path}',
+                            exc_info=True)
                         try:
-                            self._send(500,
-                                       'text/plain; charset=utf-8',
-                                       b'error\n')
+                            self._send_payload(
+                                500,
+                                {'error': {'message': f'{type(exc).__name__}: {exc}',
+                                           'type': 'server_error'}})
                         except Exception:
                             pass
+
+                def _do_healthz(self):
+                    """Plain liveness without a probe; with one, a
+                    readiness report — 503 until ``ready`` so a load
+                    balancer never routes to a cold engine."""
+                    if server.readiness is None:
+                        self._send(200, 'text/plain; charset=utf-8',
+                                   b'ok\n')
+                        return
+                    try:
+                        report = dict(server.readiness() or {})
+                    except Exception as exc:
+                        report = {'ready': False, 'error': str(exc)}
+                    code = 200 if report.get('ready') else 503
+                    self._send_payload(code, report)
+
+                def do_GET(self):
+                    self._dispatch('GET')
+
+                def do_POST(self):
+                    self._dispatch('POST')
+
+                def do_DELETE(self):
+                    self._dispatch('DELETE')
 
             self._httpd = ThreadingHTTPServer(
                 ('127.0.0.1', self.requested_port), Handler)

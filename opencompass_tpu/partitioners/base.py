@@ -43,8 +43,9 @@ class BasePartitioner:
                 'pre-launch)')
         # shared run-level switches every task inherits ('obs' rides along
         # so subprocess tasks re-enable tracing from their own config;
-        # 'result_cache' so --no-result-cache reaches subprocess tasks)
-        for key in ('profile', 'obs', 'result_cache'):
+        # 'result_cache' so --no-result-cache reaches subprocess tasks;
+        # 'cache_root' so serve-mode tasks bind the engine's store)
+        for key in ('profile', 'obs', 'result_cache', 'cache_root'):
             if key in cfg:
                 for task in tasks:
                     task[key] = cfg[key]
@@ -86,7 +87,14 @@ class BasePartitioner:
             from opencompass_tpu import store as storemod
             if not storemod.result_cache_enabled(cfg):
                 return
-            self._store = storemod.open_store(work_dir)
+            # engine-owned binding: an explicit cache_root (serve-mode
+            # sweep configs) beats the work_dir/env resolution, so the
+            # pre-launch prune reads the same store the tasks commit to
+            root = None
+            if cfg.get('cache_root'):
+                from opencompass_tpu.store.store import STORE_SUBDIR
+                root = osp.join(cfg['cache_root'], STORE_SUBDIR)
+            self._store = storemod.open_store(work_dir, root=root)
         except Exception:
             self._store = None
 

@@ -17,7 +17,7 @@ import tempfile
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from opencompass_tpu.obs import get_tracer
 from opencompass_tpu.registry import RUNNERS
@@ -46,7 +46,8 @@ class LocalRunner(BaseRunner):
                  task_timeout: float = None,
                  stall_timeout: float = None,
                  retry: int = 0,
-                 use_workers: bool = None):
+                 use_workers: bool = None,
+                 worker_pool=None):
         """``task_timeout``: kill a task after this many wall-clock seconds.
         ``stall_timeout``: kill a task whose log stops growing for this
         long (hung-process detection — a compile or a wedged device holds a
@@ -61,7 +62,14 @@ class LocalRunner(BaseRunner):
         ``None`` (default) = auto: worker mode for device-model tasks
         (``num_devices > 0``), one-shot subprocesses otherwise.  API
         models and multi-host tasks always take the one-shot path, and
-        any worker failure falls back to it per task."""
+        any worker failure falls back to it per task.
+
+        ``worker_pool``: a :class:`serve.scheduler.WorkerPool` owning
+        resident workers *across* launches (the serve daemon's fleet).
+        With one, affinity groups lease and release workers instead of
+        spawning and shutting them down — a model stays hot between
+        sweeps, and the pool's idle TTL (not this runner) decides when
+        it dies."""
         super().__init__(task=task, debug=debug, lark_bot_url=lark_bot_url)
         self.max_num_workers = max_num_workers
         if num_devices is None:
@@ -73,6 +81,7 @@ class LocalRunner(BaseRunner):
         self.stall_timeout = stall_timeout
         self.retry = retry
         self.use_workers = use_workers
+        self.worker_pool = worker_pool
         self._slot_lock = threading.Lock()
         self._slots = [False] * self.num_devices  # True = in use
         # watchdog wake period; tests shrink it to exercise kill paths
@@ -159,11 +168,14 @@ class LocalRunner(BaseRunner):
 
     # -- slot allocator ----------------------------------------------------
 
-    def _acquire_slots(self, n: int) -> List[int]:
+    def _acquire_slots(self, n: int,
+                       timeout: Optional[float] = None) -> List[int]:
         if n == 0:
             return []
         assert n <= self.num_devices, (
             f'task wants {n} devices, host offers {self.num_devices}')
+        deadline = None if timeout is None \
+            else time.monotonic() + timeout
         while True:
             with self._slot_lock:
                 free = [i for i, used in enumerate(self._slots) if not used]
@@ -172,6 +184,11 @@ class LocalRunner(BaseRunner):
                     for i in ids:
                         self._slots[i] = True
                     return ids
+            if deadline is not None and time.monotonic() >= deadline:
+                # bounded waiters (the serve pool's interactive path)
+                # get an error to surface instead of a parked thread
+                raise TimeoutError(
+                    f'no {n} free device slot(s) within {timeout:.0f}s')
             time.sleep(1)
 
     def _release_slots(self, ids: List[int]):
@@ -181,10 +198,11 @@ class LocalRunner(BaseRunner):
 
     # -- per-task launch ---------------------------------------------------
 
-    def _launch(self, task_cfg: Dict) -> Tuple[str, int]:
+    def _launch(self, task_cfg: Dict, task=None) -> Tuple[str, int]:
         tracer = get_tracer()
         agg = getattr(self, '_status_agg', None)
-        task = self.build_task(task_cfg)
+        if task is None:
+            task = self.build_task(task_cfg)
         name = task.name
         wait0 = time.perf_counter()
         chip_ids = self._acquire_slots(task.num_devices)
@@ -236,6 +254,8 @@ class LocalRunner(BaseRunner):
         failure downgrades the affected task — and, after a crash, the
         rest of the group — to the one-shot subprocess path."""
         from opencompass_tpu.runners.worker import WorkerHandle
+        if self.worker_pool is not None:
+            return self._launch_group_pooled(key, indexed_tasks, results)
         tracer = get_tracer()
         built = [(i, self.build_task(cfg)) for i, cfg in indexed_tasks]
         group_devices = max(t.num_devices for _, t in built)
@@ -271,6 +291,68 @@ class LocalRunner(BaseRunner):
             if handle is not None:
                 handle.shutdown()
             self._release_slots(chip_ids)
+
+    def _launch_group_pooled(self, key: str, indexed_tasks,
+                             results: List):
+        """One affinity group through the shared persistent
+        :class:`~opencompass_tpu.serve.scheduler.WorkerPool` (the serve
+        daemon's fleet).  Differences from the owned-worker path above:
+        the worker — and its chips — outlive this launch (lease/release,
+        never shutdown), the pool allocates chips at spawn via this
+        runner's slot callbacks, and requests serialize on the
+        resident's lock so interactive ``complete`` calls interleave
+        between task round-trips.  Worker death downgrades tasks to the
+        one-shot path exactly as before; ``pool.discard`` then frees the
+        corpse and its chips."""
+        tracer = get_tracer()
+        built = [(i, self.build_task(cfg)) for i, cfg in indexed_tasks]
+        group_devices = max(t.num_devices for _, t in built)
+        work_dir = built[0][1].work_dir
+        pool = self.worker_pool
+
+        def spawn(chip_ids):
+            env = self._task_env(group_devices, chip_ids, work_dir)
+            if tracer.enabled:
+                env.update(tracer.propagation_env(
+                    getattr(self, '_runner_span', None)))
+            return env, osp.join(work_dir, 'logs', 'worker',
+                                 f'{key}.out')
+
+        worker = None
+        try:
+            try:
+                worker = pool.acquire(key, spawn, devices=group_devices)
+                self.logger.info(
+                    f'worker {key}: leased for {len(built)} task(s) '
+                    f'(devices={worker.chip_ids}, '
+                    f'requests so far: {worker.requests})')
+                tracer.event('worker_leased', model_key=key,
+                             n_tasks=len(built),
+                             resident=worker.requests > 0)
+            except Exception:
+                self.logger.exception(
+                    f'worker lease {key} failed; using one-shot '
+                    'subprocesses')
+            for pos, (i, task) in enumerate(built):
+                if worker is not None and not worker.alive:
+                    # died mid-group: discard (frees chips) and finish
+                    # the group one-shot — no respawn, same policy as
+                    # the owned-worker path
+                    pool.discard(worker)
+                    worker = None
+                if worker is None:
+                    # task was already built for the group: reuse it
+                    results[i] = self._launch(indexed_tasks[pos][1],
+                                              task=task)
+                else:
+                    results[i] = self._launch_via_worker(
+                        worker, key, task, worker.chip_ids, 0.0)
+        finally:
+            if worker is not None:
+                if worker.alive:
+                    pool.release(worker)
+                else:
+                    pool.discard(worker)
 
     def _launch_via_worker(self, handle, key: str, task, chip_ids,
                            slot_wait: float) -> Tuple[str, int]:

@@ -20,13 +20,35 @@ Requests::
     {"cmd": "run", "task_type": "OpenICLInferTask",
      "cfg_path": "/tmp/...py", "name": "<task name>",
      "log_path": "<per-task log>"}
+    {"cmd": "complete", "model_cfg": {...}, "prompts": ["..."],
+     "max_out_len": 16}
     {"cmd": "ping"}
     {"cmd": "shutdown"}
 
 Responses::
 
     {"ok": true, "returncode": 0, "warmed": <shapes precompiled>}
+    {"ok": true, "completions": [...], "store_hits": n, ...}
     {"ok": false, "error": "<traceback tail>", "returncode": 1}
+
+``complete`` is the serving data plane (serve/daemon.py): generate
+completions for raw prompt strings on the resident model, consulting
+the content-addressed result store first with exactly the gen
+inferencer's row keying — an interactive request identical to a sweep
+row (or to a previous identical request) is served from disk without a
+device call, and fresh rows are committed so the next one is.  An empty
+prompt list is the engine's warm-up probe: it builds the model (weights
+on device) and returns without generating.
+
+Lifecycle (the serve plane's residency contract):
+
+- ``OCT_WORKER_IDLE_TTL_S``: a worker that receives no request for this
+  many seconds flushes its host caches (``BaseModel.save_caches``) and
+  exits on its own — a leaked worker cannot hold chips forever.
+- ``SIGTERM`` drains gracefully: the in-flight request (if any) runs to
+  completion and its response is written, caches are flushed, then the
+  worker exits 0.  Only ``SIGKILL`` is abrupt — and the result store's
+  per-row commits make even that resumable.
 
 Failure containment: a worker crash (or request timeout) surfaces as an
 EOF/timeout on the runner side; ``LocalRunner`` then falls back to the
@@ -51,6 +73,7 @@ import traceback
 from typing import Dict, List, Optional
 
 ENV_WORKER_FAULT = 'OCT_WORKER_FAULT'
+ENV_IDLE_TTL = 'OCT_WORKER_IDLE_TTL_S'
 _HEADER = struct.Struct('>I')
 MAX_FRAME = 64 * 1024 * 1024
 
@@ -367,10 +390,97 @@ def _handle_run(msg: Dict) -> Dict:
     return resp
 
 
+def _handle_complete(msg: Dict) -> Dict:
+    """Interactive generation on the resident model (the engine's
+    ``/v1/completions`` data plane).  Rows are keyed exactly like the
+    gen inferencer's store rows — namespace (model identity, 'gen',
+    {max_out_len, generation_kwargs}), key on the rendered prompt — so
+    sweep rows, repeated requests, and future sweeps all dedupe into
+    one store entry."""
+    from opencompass_tpu import store as result_store
+    from opencompass_tpu.utils.build import (build_model_from_cfg,
+                                             model_cached)
+    model_cfg = msg.get('model_cfg')
+    if not isinstance(model_cfg, dict):
+        return {'ok': False, 'error': 'complete needs a model_cfg dict'}
+    prompts = [str(p) for p in (msg.get('prompts') or [])]
+    max_out_len = int(msg.get('max_out_len') or 16)
+    t0 = time.perf_counter()
+    built = not model_cached(model_cfg)
+    model = build_model_from_cfg(model_cfg)   # memoized (residency)
+    if not prompts:   # warm-up probe: model on device, nothing to say
+        return {'ok': True, 'completions': [], 'built': built,
+                'build_seconds': round(time.perf_counter() - t0, 3)}
+
+    if getattr(model, '_result_store', None) is None:
+        # engine-owned binding: the explicit cache root wins so the
+        # worker serves the daemon's store even when its env predates it
+        result_store.bind_model_store(model, model_cfg, cfg=None,
+                                      work_dir=msg.get('work_dir'),
+                                      root=msg.get('cache_root'))
+    ctx = result_store.context_for(model, 'gen', {
+        'max_out_len': max_out_len,
+        'generation_kwargs':
+            getattr(model, 'generation_kwargs', None) or {},
+    })
+    completions: List = [None] * len(prompts)
+    keys: Dict[int, str] = {}
+    hits = 0
+    if ctx is not None:
+        for i, prompt in enumerate(prompts):
+            keys[i] = ctx.key(prompt)
+            cached = ctx.get(keys[i])
+            if cached is not None:
+                completions[i] = cached
+                hits += 1
+    todo = [i for i, c in enumerate(completions) if c is None]
+    if todo:
+        outs = model.generate([prompts[i] for i in todo],
+                              max_out_len=max_out_len)
+        for i, out in zip(todo, outs):
+            completions[i] = out
+            if ctx is not None:
+                ctx.put(keys[i], out)
+    prompt_tokens = completion_tokens = None
+    try:
+        prompt_tokens = sum(model.get_token_len(p) for p in prompts)
+        completion_tokens = sum(model.get_token_len(str(c))
+                                for c in completions)
+    except Exception:
+        pass
+    return {'ok': True, 'completions': completions, 'built': built,
+            'store_hits': hits, 'device_rows': len(todo),
+            'prompt_tokens': prompt_tokens,
+            'completion_tokens': completion_tokens,
+            'elapsed_seconds': round(time.perf_counter() - t0, 4)}
+
+
+def _flush_model_caches():
+    """Graceful-exit hook: persist every resident model's host caches
+    (token-length measurements) so the next worker starts warm.  Never
+    raises — drain must reach exit."""
+    try:
+        from opencompass_tpu.utils.build import cached_models
+        for model in cached_models():
+            try:
+                model.save_caches()
+            except Exception:
+                traceback.print_exc()
+    except Exception:
+        pass
+
+
 def serve():
     """Worker main loop: read request frames from the saved stdin,
     answer on the saved stdout.  Anything the tasks print goes to the
-    worker log (runner-redirected stderr)."""
+    worker log (runner-redirected stderr).
+
+    Exits on: runner hang-up (EOF), protocol ``shutdown``, idle TTL
+    expiry (``OCT_WORKER_IDLE_TTL_S``), or SIGTERM — the latter two
+    drain gracefully (finish the in-flight request, flush model caches,
+    exit 0) so a reaped worker never loses committed work."""
+    import signal
+
     proto_in = os.dup(0)
     proto_out = os.fdopen(os.dup(1), 'wb')
     # protocol channel secured — re-point 0/1 so task code can't touch it
@@ -383,8 +493,61 @@ def serve():
     from opencompass_tpu.utils.build import enable_model_cache
     enable_model_cache()
     compile_cache.enable()
+    # resume the launcher's trace immediately (not at the first task) so
+    # model_build/reuse events from interactive `complete` requests and
+    # warm-up probes land in the engine's event stream too
+    if os.environ.get('OCT_TRACE_ID') and os.environ.get('OCT_OBS_DIR'):
+        try:
+            from opencompass_tpu import obs
+            obs.init_task_obs({'obs': True})
+        except Exception:
+            pass
 
+    # SIGTERM drain: the handler only sets a flag and pokes the wake
+    # pipe (select alone would restart on EINTR per PEP 475) — the loop
+    # finishes any in-flight request first, so drain is always graceful
+    drain = {'sigterm': False}
+    wake_r, wake_w = os.pipe()
+
+    def _on_sigterm(signum, frame):
+        drain['sigterm'] = True
+        try:
+            os.write(wake_w, b'x')
+        except OSError:
+            pass
+
+    try:
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        pass   # non-main-thread embedding: drain via shutdown cmd only
+
+    idle_ttl = 0.0
+    try:
+        idle_ttl = float(os.environ.get(ENV_IDLE_TTL, '') or 0.0)
+    except ValueError:
+        pass
+
+    reason = 'eof'
     while True:
+        timeout = idle_ttl if idle_ttl > 0 else None
+        try:
+            ready, _, _ = select.select([proto_in, wake_r], [], [],
+                                        timeout)
+        except OSError:
+            break
+        if wake_r in ready:
+            try:
+                os.read(wake_r, 4096)
+            except OSError:
+                pass
+        if drain['sigterm'] and proto_in not in ready:
+            reason = 'sigterm'
+            break
+        if not ready:
+            reason = 'idle_ttl'   # nobody spoke for a whole TTL
+            break
+        if proto_in not in ready:
+            continue
         try:
             msg = read_frame(proto_in)
         except WorkerError:
@@ -392,22 +555,31 @@ def serve():
         cmd = msg.get('cmd')
         if cmd == 'shutdown':
             write_frame(proto_out, {'ok': True, 'bye': True})
+            reason = 'shutdown'
             break
         if cmd == 'ping':
             write_frame(proto_out, {'ok': True, 'pong': True})
             continue
-        if cmd != 'run':
+        if cmd not in ('run', 'complete'):
             write_frame(proto_out, {'ok': False,
                                     'error': f'unknown cmd {cmd!r}'})
             continue
         try:
-            resp = _handle_run(msg)
+            resp = _handle_run(msg) if cmd == 'run' \
+                else _handle_complete(msg)
         except (KeyboardInterrupt, SystemExit):
             raise
         except BaseException:
             resp = {'ok': False, 'returncode': 1,
                     'error': traceback.format_exc(limit=20)[-2000:]}
         write_frame(proto_out, resp)
+        if drain['sigterm']:
+            reason = 'sigterm'   # arrived mid-request: drained, now go
+            break
+
+    if reason in ('sigterm', 'idle_ttl', 'shutdown'):
+        _flush_model_caches()
+    print(f'worker: exiting ({reason})', file=sys.stderr, flush=True)
 
     from opencompass_tpu.obs import get_tracer
     try:

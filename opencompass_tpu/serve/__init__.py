@@ -1,0 +1,19 @@
+"""Evaluation-as-a-service: persistent engine daemon, durable sweep
+queue, model-affinity worker scheduling, and the OpenAI-compatible
+HTTP front door.
+
+Entry point: ``python -m opencompass_tpu.cli serve <config> [--port N]``
+(docs/serving.md).  The daemon fuses the warm-worker fleet (PR 4), the
+content-addressed result store (PR 5), and the telemetry HTTP plane
+(PR 2) into one long-running service: models stay resident across
+sweeps, every result row is a store commit, and killing the daemon
+mid-sweep loses nothing — the restarted engine re-claims the queue and
+recomputes only missing rows.
+"""
+from opencompass_tpu.serve.daemon import EvalEngine, serve_main
+from opencompass_tpu.serve.queue import (QUEUE_SUBDIR, SweepQueue,
+                                         new_sweep_id)
+from opencompass_tpu.serve.scheduler import ResidentWorker, WorkerPool
+
+__all__ = ['EvalEngine', 'QUEUE_SUBDIR', 'ResidentWorker', 'SweepQueue',
+           'WorkerPool', 'new_sweep_id', 'serve_main']

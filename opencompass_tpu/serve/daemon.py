@@ -1,0 +1,608 @@
+"""Evaluation-as-a-service: the persistent engine daemon.
+
+``cli serve <config> [--port N]`` turns the batch driver into a
+long-running service.  One :class:`EvalEngine` owns
+
+- a **resident worker fleet** (:class:`~opencompass_tpu.serve.scheduler
+  .WorkerPool`): model weights, the XLA compile cache, and the
+  token-length cache stay hot *across* sweeps — two sweeps of the same
+  model, enqueued back to back, cost one checkpoint load and one
+  compile set total;
+- a **durable FIFO sweep queue** (:class:`~opencompass_tpu.serve.queue
+  .SweepQueue` under ``{cache_root}/serve/queue/``) that survives the
+  daemon process: kill the daemon mid-sweep, restart it, and the sweep
+  is re-claimed with only the rows the dead daemon never committed
+  recomputed (the content-addressed store's per-row commits are the
+  whole recovery story);
+- the **HTTP front door** on the PR 2 telemetry server
+  (``obs/promexport.py``): a control plane (``POST/GET/DELETE
+  /v1/sweeps``) and an OpenAI-style data plane (``POST
+  /v1/completions``) next to ``/metrics`` / ``/status`` / ``/healthz``
+  (which upgrades from liveness to readiness — 503 until the fleet has
+  warmed).
+
+Layout under the daemon's run dir (``{work_dir}/<timestamp>/``)::
+
+    obs/            one shared trace + status plane for every sweep
+    sweeps/<id>/    per-sweep work dir (predictions/results/summary)
+
+Every sweep config is stamped with the engine's ``cache_root`` before
+partitioning, so pre-launch pruning, task store binding, and worker
+commits all address the engine's store — an interactive completion and
+a sweep row for the same prompt are one store entry.
+"""
+from __future__ import annotations
+
+import os
+import os.path as osp
+import threading
+import time
+from datetime import datetime
+from typing import Dict, List, Optional
+
+from opencompass_tpu.serve.queue import QUEUE_SUBDIR, SweepQueue
+from opencompass_tpu.serve.scheduler import WorkerPool
+from opencompass_tpu.utils.logging import add_file_handler, get_logger
+
+logger = get_logger()
+
+DEFAULT_IDLE_TTL_S = 600.0
+DEFAULT_COMPLETE_TIMEOUT_S = 300.0
+
+
+def _wire_model_cfg(model_cfg: Dict) -> Dict:
+    """A JSON-safe copy of a model config for the worker protocol.
+
+    ``type`` travels as its **dotted path** — the exact representation
+    ``Config.dump`` writes into sweep-task configs — so the worker-side
+    model memoization key and the store model identity
+    (``model_cfg_key`` over the received dict) match the sweep path
+    byte for byte: an interactive request reuses the model a sweep
+    task built, and its rows dedupe into the sweep's store namespace."""
+    from opencompass_tpu.utils.build import normalize_cfg_types
+    return normalize_cfg_types(dict(model_cfg))
+
+
+class EvalEngine:
+    """The serve daemon: queue → warm fleet → store, behind HTTP.
+
+    Args:
+        cfg: the serve config (a ``Config``) — its ``models`` list is
+            the interactive catalog (``/v1/completions`` routes by model
+            ``abbr``), its ``work_dir`` roots the daemon run, and its
+            task/stall timeouts apply to every sweep.
+        port: HTTP port for the front door (0 = ephemeral; the bound
+            port lands in ``{run_dir}/obs/http.json``).
+        num_devices / max_num_workers: LocalRunner fleet geometry.
+        idle_ttl_s: reap a resident worker nobody used for this long.
+        max_resident: cap on resident workers (None = unbounded).
+        warm: pre-build every catalog model at startup (readiness flips
+            once the fleet is warm); False = lazily on first use.
+    """
+
+    def __init__(self, cfg, port: int = 0,
+                 num_devices: Optional[int] = None,
+                 max_num_workers: int = 16,
+                 idle_ttl_s: float = DEFAULT_IDLE_TTL_S,
+                 max_resident: Optional[int] = None,
+                 warm: bool = True,
+                 poll_s: float = 0.5):
+        from opencompass_tpu.utils import compile_cache
+        self.cfg = cfg
+        self.base_work_dir = cfg.get('work_dir', './outputs/serve')
+        self.requested_port = port
+        self.idle_ttl_s = idle_ttl_s
+        self.poll_s = poll_s
+        self.warm = warm
+        self.run_id = 'serve_' + datetime.now().strftime('%Y%m%d_%H%M%S')
+        self.run_dir = osp.join(self.base_work_dir, self.run_id)
+        # the cache root is pre-timestamp: every daemon restart (and
+        # every plain batch run of the same work_dir) shares one store,
+        # one compile cache, one queue — that continuity IS the service
+        self.cache_root = osp.abspath(
+            compile_cache.cache_root(self.base_work_dir))
+        self.queue = SweepQueue(osp.join(self.cache_root, QUEUE_SUBDIR))
+        self.pool: Optional[WorkerPool] = None
+        self.infer_runner = None
+        self.eval_runner = None
+        self.server = None
+        self.tracer = None
+        self.port: Optional[int] = None
+        self._num_devices = num_devices
+        self._max_num_workers = max_num_workers
+        self._max_resident = max_resident
+        self._stop = threading.Event()
+        self._loop_thread: Optional[threading.Thread] = None
+        self._warmed = threading.Event()
+        self._current_sweep: Optional[str] = None
+        self._completions = 0
+        self._complete_lock = threading.Lock()   # catalog + counters
+        # sweep_id -> expected task names (feeds GET /v1/sweeps/<id>);
+        # in-memory only: a restarted daemon answers from the journal +
+        # the store, not from a dead engine's task census
+        self._sweep_tasks: Dict[str, List[str]] = {}
+        self._catalog: Dict[str, Dict] = {}
+        for model_cfg in cfg.get('models', []) or []:
+            try:
+                from opencompass_tpu.utils.abbr import model_abbr_from_cfg
+                self._catalog[model_abbr_from_cfg(model_cfg)] = model_cfg
+            except Exception:
+                pass
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> int:
+        """Bring the engine up: obs plane, HTTP front door, worker
+        pool, queue recovery, drain loop, warm-up.  Returns the bound
+        HTTP port."""
+        from opencompass_tpu import obs
+        from opencompass_tpu.obs.live import mark_run
+        from opencompass_tpu.obs.promexport import ObsHTTPServer
+        from opencompass_tpu.runners import LocalRunner
+        from opencompass_tpu.serve.http import build_routes
+        from opencompass_tpu.utils import compile_cache
+
+        os.makedirs(self.run_dir, exist_ok=True)
+        add_file_handler(self.run_dir)
+        # pin the shared roots into the env so every subprocess — worker
+        # or one-shot task — resolves the same store/compile caches
+        os.environ['OCT_CACHE_ROOT'] = self.cache_root
+        compile_cache.export_env(self.base_work_dir)
+        compile_cache.enable(self.base_work_dir)
+        # worker-side idle TTL as the leak backstop (2x the pool TTL so
+        # the pool's protocol-clean reap normally wins the race)
+        if self.idle_ttl_s:
+            os.environ.setdefault('OCT_WORKER_IDLE_TTL_S',
+                                  str(self.idle_ttl_s * 2))
+
+        self.tracer = obs.init_obs(self.run_dir, enabled=True)
+        mark_run(self.tracer.obs_dir, 'running')
+
+        self.infer_runner = LocalRunner(
+            dict(type='OpenICLInferTask'),
+            max_num_workers=self._max_num_workers,
+            num_devices=self._num_devices,
+            task_timeout=self.cfg.get('task_timeout'),
+            stall_timeout=self.cfg.get('stall_timeout'),
+            # residency is the daemon's point: every eligible task goes
+            # through the pool, FakeModel smoke sweeps included
+            use_workers=True)
+        self.pool = WorkerPool(
+            idle_ttl_s=self.idle_ttl_s,
+            max_resident=self._max_resident,
+            alloc=self.infer_runner._acquire_slots,
+            free=self.infer_runner._release_slots)
+        self.infer_runner.worker_pool = self.pool
+        self.eval_runner = LocalRunner(
+            dict(type='OpenICLEvalTask'),
+            max_num_workers=self._max_num_workers,
+            num_devices=self._num_devices,
+            use_workers=False)
+        self.pool.start_reaper(interval=max(self.poll_s * 4, 5.0))
+
+        self.server = ObsHTTPServer(
+            self.tracer.obs_dir, port=self.requested_port,
+            registry=self.tracer.metrics,
+            routes=build_routes(self),
+            readiness=self.readiness,
+            status_fn=self.status_snapshot)
+        self.port = self.server.start()
+        if self.port is None:
+            raise RuntimeError(
+                f'engine HTTP server failed to bind port '
+                f'{self.requested_port}')
+
+        requeued = self.queue.recover()
+        if requeued:
+            logger.info(f'queue recovery: re-queued {requeued} '
+                        '(stale claims from a dead daemon)')
+        self._loop_thread = threading.Thread(
+            target=self._loop, name='serve-queue-loop', daemon=True)
+        self._loop_thread.start()
+        if self.warm and self._catalog:
+            threading.Thread(target=self._warm_fleet,
+                             name='serve-warmup', daemon=True).start()
+        else:
+            self._warmed.set()
+        logger.info(
+            f'engine up: http://127.0.0.1:{self.port} '
+            f'(queue at {self.queue.root}, store at {self.cache_root})')
+        return self.port
+
+    def stop(self):
+        """Graceful shutdown: stop claiming, retire the fleet (protocol
+        shutdown → SIGKILL fallback; workers flush their host caches),
+        close the front door, mark the run over."""
+        from opencompass_tpu.obs.live import mark_run
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=30)
+        if self.pool is not None:
+            self.pool.shutdown()
+        if self.server is not None:
+            self.server.stop()
+        if self.tracer is not None:
+            try:
+                mark_run(self.tracer.obs_dir, 'done')
+                self.tracer.close()
+            except Exception:
+                pass
+        logger.info('engine stopped')
+
+    # -- queue drain loop --------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            rec = None
+            try:
+                rec = self.queue.claim_next(owner=self.run_id)
+            except Exception:
+                logger.exception('queue claim failed')
+            if rec is None:
+                self._publish_gauges()
+                self._stop.wait(self.poll_s)
+                continue
+            sweep_id = rec['id']
+            self._current_sweep = sweep_id
+            self._publish_gauges()
+            ok, detail = False, None
+            try:
+                ok, detail = self._run_sweep(rec)
+            except Exception as exc:
+                logger.exception(f'sweep {sweep_id} failed')
+                detail = {'error': f'{type(exc).__name__}: {exc}'}
+            finally:
+                self._current_sweep = None
+                try:
+                    self.queue.mark_done(sweep_id, ok=ok, detail=detail)
+                except Exception:
+                    logger.exception(f'sweep {sweep_id}: journal '
+                                     'terminal record failed')
+            self._publish_gauges()
+
+    def _run_sweep(self, rec: Dict):
+        """One queued sweep end to end: partition → infer (through the
+        resident fleet) → eval → summarize → ledger.  The engine's
+        ``cache_root`` is stamped into the sweep config, so every layer
+        (pre-launch pruning, inferencer row serving, worker commits)
+        addresses the engine's store."""
+        from opencompass_tpu.config import Config
+        from opencompass_tpu.partitioners import (NaivePartitioner,
+                                                  SizePartitioner)
+        from opencompass_tpu.registry import TASKS
+        from opencompass_tpu.utils.abbr import task_abbr_from_cfg
+        from opencompass_tpu.utils.summarizer import Summarizer
+
+        sweep_id = rec['id']
+        t0 = time.perf_counter()
+        queue_wait = None
+        if rec.get('submitted_ts'):
+            queue_wait = round(time.time() - rec['submitted_ts'], 3)
+        cfg = Config.fromfile(rec['config_path'])
+        work_dir = rec.get('work_dir') \
+            or osp.join(self.run_dir, 'sweeps', sweep_id)
+        cfg['work_dir'] = work_dir
+        cfg['cache_root'] = self.cache_root
+        cfg['obs'] = True
+        os.makedirs(work_dir, exist_ok=True)
+        cfg.dump(osp.join(work_dir, 'config.py'))
+        mode = rec.get('mode') or 'all'
+        logger.info(f'sweep {sweep_id}: starting (mode={mode}, '
+                    f'work_dir={work_dir}, queue_wait='
+                    f'{queue_wait if queue_wait is not None else "?"}s)')
+
+        detail: Dict = {'work_dir': work_dir, 'mode': mode,
+                        'queue_wait_seconds': queue_wait}
+        failed = 0
+        with self.tracer.span(f'sweep:{sweep_id}', mode=mode,
+                              config=rec.get('config_path')) as span:
+            if mode in ('all', 'infer'):
+                partitioner = SizePartitioner(
+                    osp.join(work_dir, 'predictions/'))
+                tasks = partitioner(cfg)
+                prefix = getattr(TASKS.get('OpenICLInferTask'),
+                                 'name_prefix', '')
+                names = []
+                for task_cfg in tasks:
+                    try:
+                        names.append(prefix
+                                     + task_abbr_from_cfg(task_cfg))
+                    except Exception:
+                        pass
+                self._sweep_tasks[sweep_id] = names
+                detail['n_tasks'] = len(tasks)
+                if tasks:
+                    status = self.infer_runner(tasks)
+                    failed += sum(1 for _, rc in status if rc != 0)
+            if mode in ('all', 'eval'):
+                partitioner = NaivePartitioner(
+                    osp.join(work_dir, 'results/'))
+                tasks = partitioner(cfg)
+                if tasks:
+                    status = self.eval_runner(tasks)
+                    failed += sum(1 for _, rc in status if rc != 0)
+            if mode in ('all', 'eval', 'viz'):
+                try:
+                    self.tracer.flush_metrics()
+                    Summarizer(cfg).summarize(time_str=sweep_id)
+                except Exception:
+                    logger.exception(f'sweep {sweep_id}: summarize '
+                                     'failed')
+            span.set_attrs(n_failed=failed)
+        detail['failed_tasks'] = failed
+        detail['wall_seconds'] = round(time.perf_counter() - t0, 3)
+        # per-sweep ledger records under the shared daemon run: the
+        # cross-run regression trajectory sees served sweeps too
+        try:
+            from opencompass_tpu import ledger
+            fresh = ledger.append_run(
+                work_dir, run_id=f'{self.run_id}/{sweep_id}')
+            detail['ledger_records'] = len(fresh)
+        except Exception:
+            logger.warning(f'sweep {sweep_id}: ledger append failed',
+                           exc_info=True)
+        logger.info(f'sweep {sweep_id}: done '
+                    f'({failed} failed task(s), '
+                    f'{detail["wall_seconds"]}s)')
+        return failed == 0, detail
+
+    # -- interactive data plane --------------------------------------------
+
+    def models(self) -> List[str]:
+        return sorted(self._catalog)
+
+    def affinity_key(self, model_cfg: Dict) -> str:
+        """The pool key for one model config — the same digest the
+        partitioner stamps on sweep tasks (``model_key``), so an
+        interactive request and a queued sweep of the same model land
+        on the same resident worker."""
+        from opencompass_tpu.utils.build import model_cfg_key
+        return model_cfg_key(model_cfg)
+
+    def complete(self, model: str, prompts: List[str],
+                 max_out_len: int = 16,
+                 timeout: float = DEFAULT_COMPLETE_TIMEOUT_S) -> Dict:
+        """Generate completions on the resident worker for ``model``
+        (catalog abbr).  Store-first: a prompt identical to a sweep row
+        or a previous request is served from disk without touching the
+        device.  Raises ``KeyError`` for an unknown model,
+        ``RuntimeError`` when the worker fails."""
+        model_cfg = self._catalog.get(model)
+        if model_cfg is None:
+            raise KeyError(model)
+        resp = self._request_complete(model_cfg, prompts, max_out_len,
+                                      timeout)
+        with self._complete_lock:
+            self._completions += 1
+        if self.tracer is not None:
+            self.tracer.counter('serve.completions').inc()
+            if resp.get('store_hits'):
+                self.tracer.counter('serve.completion_store_hits').inc(
+                    resp['store_hits'])
+        return resp
+
+    def _request_complete(self, model_cfg: Dict, prompts: List[str],
+                          max_out_len: int, timeout: float) -> Dict:
+        from opencompass_tpu.runners.worker import WorkerError
+        from opencompass_tpu.serve.scheduler import WorkerBusyError
+        key = self.affinity_key(model_cfg)
+        run_cfg = model_cfg.get('run_cfg', {}) or {}
+        devices = run_cfg.get('num_devices', run_cfg.get('num_gpus', 0))
+        try:
+            # bound the chip wait by the request budget: every host chip
+            # held by a sweep must surface as back-pressure (502), not
+            # park this HTTP thread until the sweep drains
+            worker = self.pool.acquire(key, self._spawn_fn(key, devices),
+                                       devices=devices,
+                                       alloc_timeout_s=timeout)
+        except TimeoutError as exc:
+            raise RuntimeError(str(exc)) from exc
+        try:
+            resp = worker.request(
+                {'cmd': 'complete',
+                 'model_cfg': _wire_model_cfg(model_cfg),
+                 'prompts': list(prompts),
+                 'max_out_len': max_out_len,
+                 'cache_root': self.cache_root,
+                 'work_dir': self.run_dir},
+                timeout=timeout)
+        except WorkerBusyError as exc:
+            # healthy worker, channel occupied: back-pressure, not a
+            # corpse — release the lease and surface 502 to the client
+            self.pool.release(worker)
+            raise RuntimeError(str(exc)) from exc
+        except WorkerError as exc:
+            self.pool.discard(worker)
+            raise RuntimeError(f'worker failed: {exc}') from exc
+        self.pool.release(worker)
+        if not resp.get('ok'):
+            raise RuntimeError(resp.get('error') or 'completion failed')
+        return resp
+
+    def _spawn_fn(self, key: str, devices: int):
+        def spawn(chip_ids):
+            env = self.infer_runner._task_env(devices, chip_ids,
+                                              self.run_dir)
+            if self.tracer is not None and self.tracer.enabled:
+                env.update(self.tracer.propagation_env())
+            return env, osp.join(self.run_dir, 'logs', 'worker',
+                                 f'{key}.out')
+        return spawn
+
+    def _warm_fleet(self):
+        """Pre-build every catalog model (empty-prompt probe = weights
+        on device, zero generation) so the first real request pays no
+        cold start; readiness flips when the fleet is warm."""
+        for abbr, model_cfg in list(self._catalog.items()):
+            if self._stop.is_set():
+                break
+            try:
+                t0 = time.perf_counter()
+                resp = self._request_complete(model_cfg, [], 0,
+                                              DEFAULT_COMPLETE_TIMEOUT_S)
+                logger.info(
+                    f'warm-up {abbr}: '
+                    f'{"built" if resp.get("built") else "resident"} in '
+                    f'{time.perf_counter() - t0:.1f}s')
+            except Exception:
+                logger.exception(f'warm-up {abbr} failed')
+        self._warmed.set()
+
+    # -- status / readiness ------------------------------------------------
+
+    def readiness(self) -> Dict:
+        """The ``/healthz`` readiness report: 503 until the fleet is
+        warm, the drain loop is alive, and the store root is writable —
+        a load balancer never routes to an engine that would cold-start
+        or drop the request."""
+        loop_alive = (self._loop_thread is not None
+                      and self._loop_thread.is_alive())
+        store_writable = os.access(
+            self.cache_root, os.W_OK) if osp.isdir(self.cache_root) \
+            else os.access(osp.dirname(self.cache_root) or '.', os.W_OK)
+        warmed = self._warmed.is_set()
+        return {
+            'ready': bool(warmed and loop_alive and store_writable),
+            'workers_warmed': warmed,
+            'queue_draining': loop_alive,
+            'store_writable': store_writable,
+            'resident_workers': self.pool.resident_count
+            if self.pool is not None else 0,
+            'models': self.models(),
+        }
+
+    def status_snapshot(self) -> Dict:
+        """The run-status snapshot with the serve plane folded in —
+        what ``/status`` serves and ``/metrics`` turns into
+        ``oct_serve_*`` gauges."""
+        from opencompass_tpu.obs.live import current_status
+        snap = current_status(self.tracer.obs_dir) \
+            if self.tracer is not None else {}
+        counts = self.queue.counts()
+        stats = self.pool.stats() if self.pool is not None else {}
+        workers = stats.get('workers') or {}
+        snap['serve'] = {
+            'run_dir': self.run_dir,
+            'queue_depth': counts.get('queued', 0),
+            'sweeps_running': counts.get('running', 0),
+            'sweeps_done': counts.get('done', 0),
+            'sweeps_failed': counts.get('failed', 0),
+            'sweeps_cancelled': counts.get('cancelled', 0),
+            'current_sweep': self._current_sweep,
+            'workers_resident': stats.get('resident', 0),
+            'workers_in_use': sum(w.get('in_use', 0)
+                                  for w in workers.values()),
+            'worker_spawns': stats.get('spawns', 0),
+            'worker_reuses': stats.get('reuses', 0),
+            'worker_reaped': stats.get('reaped', 0),
+            # per-worker table (pid, devices, idle/age, in_use): the
+            # operator's view of the fleet — what to kill, what's hot
+            'workers': workers,
+            'completions': self._completions,
+            'ready': self._warmed.is_set(),
+        }
+        return snap
+
+    def sweep_status(self, sweep_id: str) -> Optional[Dict]:
+        """Journal record + (when this engine ran it) the live per-task
+        slice of the shared status plane."""
+        rec = self.queue.status(sweep_id)
+        if rec is None:
+            return None
+        out = dict(rec)
+        names = self._sweep_tasks.get(sweep_id)
+        if names:
+            from opencompass_tpu.obs.live import (current_status,
+                                                  sweep_task_status)
+            out['progress'] = sweep_task_status(
+                current_status(self.tracer.obs_dir), names)
+        return out
+
+    def _publish_gauges(self):
+        """Queue-depth / fleet gauges into the metrics registry (the
+        ``/metrics`` families that don't come from the status fold)."""
+        if self.tracer is None or not self.tracer.enabled:
+            return
+        try:
+            counts = self.queue.counts()
+            self.tracer.gauge('serve.queue_depth').set(
+                counts.get('queued', 0))
+            self.tracer.gauge('serve.sweeps_done').set(
+                counts.get('done', 0))
+            if self.pool is not None:
+                self.tracer.gauge('serve.workers_resident').set(
+                    self.pool.resident_count)
+        except Exception:
+            pass
+
+
+def serve_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli serve <config> [--port N]`` —
+    run the evaluation engine until SIGTERM/SIGINT."""
+    import argparse
+    import signal
+
+    from opencompass_tpu.config import Config
+
+    parser = argparse.ArgumentParser(
+        prog='opencompass-tpu serve',
+        description='Persistent evaluation engine: durable sweep queue '
+        '+ resident worker fleet + OpenAI-compatible HTTP front door')
+    parser.add_argument('config', help='serve config (models list = '
+                        'the interactive catalog; work_dir roots the '
+                        'daemon run)')
+    parser.add_argument('--port', type=int, default=0,
+                        help='HTTP port (0 = ephemeral, written to '
+                        '{run_dir}/obs/http.json)')
+    parser.add_argument('-w', '--work-dir', default=None)
+    parser.add_argument('--num-devices', type=int, default=None)
+    parser.add_argument('--max-num-workers', type=int, default=16)
+    parser.add_argument('--idle-ttl', type=float,
+                        default=DEFAULT_IDLE_TTL_S,
+                        help='reap resident workers idle past this '
+                        'many seconds')
+    parser.add_argument('--max-resident', type=int, default=None,
+                        help='cap on resident workers (evicts '
+                        'longest-idle first)')
+    parser.add_argument('--no-warm', action='store_false', dest='warm',
+                        default=True,
+                        help='skip startup model warm-up (models build '
+                        'lazily on first use; /healthz reports ready '
+                        'immediately)')
+    args = parser.parse_args(argv)
+
+    cfg = Config.fromfile(args.config)
+    if args.work_dir is not None:
+        cfg['work_dir'] = args.work_dir
+    else:
+        cfg.setdefault('work_dir', './outputs/serve')
+
+    engine = EvalEngine(cfg, port=args.port,
+                        num_devices=args.num_devices,
+                        max_num_workers=args.max_num_workers,
+                        idle_ttl_s=args.idle_ttl,
+                        max_resident=args.max_resident,
+                        warm=args.warm)
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        stop.set()
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, _on_signal)
+        except (ValueError, OSError):
+            pass
+    port = engine.start()
+    print(f'engine listening on http://127.0.0.1:{port} '
+          f'(queue: {engine.queue.root})', flush=True)
+    try:
+        while not stop.is_set():
+            stop.wait(1.0)
+    finally:
+        engine.stop()
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(serve_main())

@@ -1,0 +1,173 @@
+"""HTTP front door for the evaluation engine.
+
+Two API surfaces mounted on the PR 2 telemetry server
+(``obs/promexport.ObsHTTPServer``), next to ``/metrics`` / ``/status``
+/ ``/healthz``:
+
+**Control plane** (sweeps are the unit of work)::
+
+    POST   /v1/sweeps        {"config": "<python text>"} |
+                             {"config_path": "/abs/path.py"}
+                             [, "mode": "all|infer|eval|viz",
+                                "label": "..."]        → 202 {id, ...}
+    GET    /v1/sweeps                                   → queue listing
+    GET    /v1/sweeps/<id>    journal record + live per-task progress
+    DELETE /v1/sweeps/<id>    cancel while queued       → 200 / 409
+
+**Data plane** (OpenAI-compatible)::
+
+    POST /v1/completions     {"model": "<abbr>", "prompt": "...",
+                              "max_tokens": 16}
+    GET  /v1/models          catalog listing
+
+``/v1/completions`` answers in the OpenAI ``text_completion`` shape
+(``choices``, ``usage``) plus an ``oct`` block with the serving truth:
+store hits, device rows, whether the model was resident.  Identical
+requests are store hits — no device call.
+
+Handlers follow the server's route contract:
+``fn(path, query, body_bytes) -> (code, payload)`` where dict payloads
+render as JSON.  Handler exceptions surface as 500 via the server's
+dispatch guard; expected failures return structured OpenAI-style
+errors (``{"error": {"message", "type"}}``).
+"""
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Dict, Tuple
+
+SWEEPS_PATH = '/v1/sweeps'
+COMPLETIONS_PATH = '/v1/completions'
+MODELS_PATH = '/v1/models'
+
+
+def _err(code: int, message: str,
+         err_type: str = 'invalid_request_error') -> Tuple[int, Dict]:
+    return code, {'error': {'message': message, 'type': err_type}}
+
+
+def _parse_json(body: bytes) -> Dict:
+    if not body:
+        return {}
+    obj = json.loads(body.decode('utf-8'))
+    if not isinstance(obj, dict):
+        raise ValueError('request body must be a JSON object')
+    return obj
+
+
+def build_routes(engine) -> Dict:
+    """The route table for one :class:`~opencompass_tpu.serve.daemon
+    .EvalEngine` — handed to ``ObsHTTPServer(routes=...)``."""
+
+    def post_sweep(path, query, body):
+        try:
+            req = _parse_json(body)
+        except ValueError as exc:
+            return _err(400, f'bad JSON: {exc}')
+        config_path = req.get('config_path')
+        config_text = req.get('config')
+        if not config_path and not config_text:
+            return _err(400, 'need "config" (inline python text) or '
+                             '"config_path" (daemon-readable file)')
+        try:
+            rec = engine.queue.enqueue(
+                config_path=config_path, config_text=config_text,
+                mode=req.get('mode', 'all'), label=req.get('label'),
+                work_dir=req.get('work_dir'))
+        except Exception as exc:
+            return _err(500, f'enqueue failed: {exc}', 'server_error')
+        return 202, {'id': rec['id'], 'object': 'sweep',
+                     'status': 'queued', 'mode': rec['mode'],
+                     'created': rec['ts'],
+                     'config_path': rec['config_path']}
+
+    def list_sweeps(path, query, body):
+        return 200, {'object': 'list',
+                     'data': list(engine.queue.state().values())}
+
+    def sweep_by_id(path, query, body):
+        sweep_id = path[len(SWEEPS_PATH) + 1:].strip('/')
+        if not sweep_id:
+            return list_sweeps(path, query, body)
+        rec = engine.sweep_status(sweep_id)
+        if rec is None:
+            return _err(404, f'unknown sweep {sweep_id!r}')
+        return 200, dict(rec, object='sweep')
+
+    def cancel_sweep(path, query, body):
+        sweep_id = path[len(SWEEPS_PATH) + 1:].strip('/')
+        if not sweep_id:
+            return _err(400, 'DELETE needs a sweep id')
+        rec = engine.queue.status(sweep_id)
+        if rec is None:
+            return _err(404, f'unknown sweep {sweep_id!r}')
+        if engine.queue.cancel(sweep_id):
+            return 200, {'id': sweep_id, 'object': 'sweep',
+                         'status': 'cancelled'}
+        return _err(409, f'sweep {sweep_id!r} is {rec["status"]} — '
+                         'only queued sweeps cancel',
+                    'sweep_not_cancellable')
+
+    def completions(path, query, body):
+        try:
+            req = _parse_json(body)
+        except ValueError as exc:
+            return _err(400, f'bad JSON: {exc}')
+        model = req.get('model')
+        if not model:
+            return _err(400, 'missing "model"')
+        prompt = req.get('prompt', '')
+        prompts = [str(p) for p in prompt] \
+            if isinstance(prompt, list) else [str(prompt)]
+        if not prompts or not any(prompts):
+            return _err(400, 'missing "prompt"')
+        max_tokens = int(req.get('max_tokens') or 16)
+        try:
+            resp = engine.complete(model, prompts,
+                                   max_out_len=max_tokens)
+        except KeyError:
+            return _err(404, f'model {model!r} not served; have: '
+                             f'{engine.models()}', 'model_not_found')
+        except RuntimeError as exc:
+            return _err(502, str(exc), 'server_error')
+        usage = {}
+        if resp.get('prompt_tokens') is not None:
+            usage = {'prompt_tokens': resp['prompt_tokens'],
+                     'completion_tokens': resp.get('completion_tokens'),
+                     'total_tokens': (resp['prompt_tokens']
+                                      + (resp.get('completion_tokens')
+                                         or 0))}
+        return 200, {
+            'id': f'cmpl-{uuid.uuid4().hex[:24]}',
+            'object': 'text_completion',
+            'created': int(time.time()),
+            'model': model,
+            'choices': [{'index': i, 'text': str(text),
+                         'logprobs': None, 'finish_reason': 'length'}
+                        for i, text in
+                        enumerate(resp.get('completions') or [])],
+            'usage': usage,
+            # the serving truth OpenAI's shape has no slot for: how the
+            # engine actually answered (disk vs device, warm vs cold)
+            'oct': {'store_hits': resp.get('store_hits'),
+                    'device_rows': resp.get('device_rows'),
+                    'model_built': resp.get('built'),
+                    'elapsed_seconds': resp.get('elapsed_seconds')},
+        }
+
+    def list_models(path, query, body):
+        return 200, {'object': 'list',
+                     'data': [{'id': abbr, 'object': 'model',
+                               'owned_by': 'opencompass-tpu'}
+                              for abbr in engine.models()]}
+
+    return {
+        ('POST', SWEEPS_PATH): post_sweep,
+        ('GET', SWEEPS_PATH): list_sweeps,
+        ('GET', SWEEPS_PATH + '/'): sweep_by_id,
+        ('DELETE', SWEEPS_PATH + '/'): cancel_sweep,
+        ('POST', COMPLETIONS_PATH): completions,
+        ('GET', MODELS_PATH): list_models,
+    }

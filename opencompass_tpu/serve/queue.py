@@ -1,0 +1,388 @@
+"""Durable FIFO sweep queue for the evaluation engine.
+
+A *sweep* is one queued evaluation request (a config file + mode).  The
+queue lives under ``{cache_root}/serve/queue/`` — the same pre-timestamp
+root as the result store — so it survives the daemon process and is
+shared by every daemon restart:
+
+    journal.jsonl        O_APPEND op log (enqueue/done/failed/cancel)
+    claims/<id>.json     atomic ownership markers (O_CREAT|O_EXCL)
+    configs/<id>.py      configs submitted inline over HTTP
+
+Durability discipline is the result store's, reused verbatim: every
+journal append is a single ``os.write`` on an ``O_APPEND`` descriptor
+(``utils.fileio.append_jsonl_atomic``), so concurrent enqueuers — two
+HTTP clients, a CLI in another process — interleave at record
+granularity and a ``kill -9`` can tear at most the final line, which
+replay skips (``iter_jsonl_records``).  FIFO order *is* journal order.
+
+Claims are separate files because a claim must be **exclusive**, not
+just durable: ``claim_next`` takes a sweep by creating its claim file
+with ``O_CREAT|O_EXCL`` — the filesystem arbitrates racing daemons.  A
+claim records the owner pid; a claim whose pid is dead is *stale* and
+the sweep counts as queued again, which is the whole preemption story:
+``kill -9`` the daemon mid-sweep, restart it, and the sweep is
+re-claimed and re-run — the content-addressed store makes the re-run
+recompute only the rows the dead daemon never committed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+import time
+import uuid
+
+try:
+    import fcntl
+except ImportError:       # non-POSIX: claims still O_EXCL-exclusive,
+    fcntl = None          # only the stale-break race window reopens
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from opencompass_tpu.utils.fileio import append_jsonl_atomic
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+QUEUE_VERSION = 1
+QUEUE_SUBDIR = osp.join('serve', 'queue')
+JOURNAL_FILE = 'journal.jsonl'
+CLAIMS_SUBDIR = 'claims'
+CONFIGS_SUBDIR = 'configs'
+
+# journal ops; anything else in a record is replayed but ignored, so the
+# format is forward-extensible without a version bump
+_TERMINAL_OPS = ('done', 'failed', 'cancel')
+
+
+def _pid_alive(pid) -> bool:
+    """Same policy as the run-marker reader: unknowable counts as
+    alive, so a valid claim is never stolen on a permissions hiccup."""
+    if not isinstance(pid, int):
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except Exception:
+        return True
+
+
+def new_sweep_id() -> str:
+    """Opaque, collision-safe id; ordering comes from the journal, not
+    from the id."""
+    return f'sw-{uuid.uuid4().hex[:12]}'
+
+
+class SweepQueue:
+    """One queue directory.  Every method is safe to call from multiple
+    processes concurrently — the journal append and the O_EXCL claim are
+    the only write primitives."""
+
+    def __init__(self, root: str):
+        self.root = osp.abspath(root)
+        self.journal_path = osp.join(self.root, JOURNAL_FILE)
+        self.claims_dir = osp.join(self.root, CLAIMS_SUBDIR)
+        self.configs_dir = osp.join(self.root, CONFIGS_SUBDIR)
+        os.makedirs(self.claims_dir, exist_ok=True)
+        os.makedirs(self.configs_dir, exist_ok=True)
+        # incremental-replay cache: the journal is append-only, so each
+        # handle parses a record once and state() re-reads only the
+        # bytes appended since — the daemon polls the queue ~4x/s and
+        # /metrics scrapes add more, so full-journal replay per call
+        # would grow O(lifetime sweeps) forever
+        self._replay: 'OrderedDict[str, Dict]' = OrderedDict()
+        self._replay_offset = 0
+        self._seal_torn_tail()
+
+    def _append(self, rec: Dict):
+        """One journal append, re-sealing the tail first: an external
+        writer (CLI client in another process) killed mid-append leaves
+        an unterminated line that would otherwise absorb this record —
+        both lines lost to replay.  The seal is one open/seek/read."""
+        self._seal_torn_tail()
+        append_jsonl_atomic(self.journal_path, [rec])
+
+    def _seal_torn_tail(self):
+        """Cap an unterminated final journal line with a newline.
+
+        The store never needs this because its segments are per-writer:
+        a dead writer's torn line sits at the EOF of a file nobody
+        appends to again.  The journal is ONE file shared by every
+        client and daemon — without the cap, the next append would be
+        absorbed into the dead writer's torn line and both records
+        would be lost to replay.  Sealing turns the tear back into the
+        store's contract: exactly one skippable garbage line."""
+        try:
+            with open(self.journal_path, 'rb') as f:
+                f.seek(0, os.SEEK_END)
+                if f.tell() == 0:
+                    return
+                f.seek(-1, os.SEEK_END)
+                torn = f.read(1) != b'\n'
+            if torn:
+                fd = os.open(self.journal_path,
+                             os.O_WRONLY | os.O_APPEND)
+                try:
+                    os.write(fd, b'\n')
+                finally:
+                    os.close(fd)
+        except OSError:
+            pass   # no journal yet, or unreadable: replay copes
+
+    # -- write side --------------------------------------------------------
+
+    def enqueue(self,
+                config_path: Optional[str] = None,
+                config_text: Optional[str] = None,
+                work_dir: Optional[str] = None,
+                mode: str = 'all',
+                sweep_id: Optional[str] = None,
+                label: Optional[str] = None) -> Dict:
+        """Append one sweep request; returns its journal record.
+
+        ``config_text`` (an inline Python config, the HTTP body case) is
+        persisted to ``configs/<id>.py`` first so the journal only ever
+        references files — a claimed sweep must be runnable after the
+        submitting client is gone."""
+        if not config_path and not config_text:
+            raise ValueError('enqueue needs config_path or config_text')
+        sweep_id = sweep_id or new_sweep_id()
+        if config_text is not None:
+            config_path = osp.join(self.configs_dir, f'{sweep_id}.py')
+            tmp = config_path + '.tmp'
+            with open(tmp, 'w', encoding='utf-8') as f:
+                f.write(config_text)
+            os.replace(tmp, config_path)
+        rec = {'v': QUEUE_VERSION, 'op': 'enqueue', 'id': sweep_id,
+               'ts': round(time.time(), 3),
+               'config_path': osp.abspath(config_path),
+               'work_dir': work_dir, 'mode': mode, 'label': label}
+        self._append(rec)
+        return rec
+
+    def cancel(self, sweep_id: str) -> bool:
+        """Cancel a *queued* sweep.  Returns False when the sweep is
+        unknown, already terminal, or currently claimed by a live
+        daemon — a running sweep finishes (its rows are store commits
+        either way; cancelling mid-flight would buy nothing)."""
+        rec = self.status(sweep_id)
+        if rec is None or rec['status'] != 'queued':
+            return False
+        self._append({'v': QUEUE_VERSION, 'op': 'cancel', 'id': sweep_id,
+                      'ts': round(time.time(), 3)})
+        return True
+
+    def mark_done(self, sweep_id: str, ok: bool = True,
+                  detail: Optional[Dict] = None):
+        """Terminal journal record + claim release."""
+        rec = {'v': QUEUE_VERSION, 'op': 'done' if ok else 'failed',
+               'id': sweep_id, 'ts': round(time.time(), 3)}
+        if detail:
+            rec['detail'] = detail
+        self._append(rec)
+        try:
+            os.unlink(self._claim_path(sweep_id))
+        except OSError:
+            pass
+
+    # -- claim protocol ----------------------------------------------------
+
+    def _claim_path(self, sweep_id: str) -> str:
+        return osp.join(self.claims_dir, f'{sweep_id}.json')
+
+    def _claims_flock(self):
+        """Exclusive advisory lock serializing stale-claim *breaks*.
+
+        O_EXCL arbitrates claim creation, but breaking a dead owner's
+        claim is unlink-then-create — without a lock, daemon B's unlink
+        can land between daemon A's create and its first heartbeat,
+        deleting A's brand-new live claim, and both daemons run the
+        sweep.  flock is held only around re-check + unlink + create,
+        is released by the kernel if the holder dies (no stale-lock
+        recursion), and costs nothing on the common single-daemon path.
+        Returns an fd to close, or None when flock is unavailable."""
+        if fcntl is None:
+            return None
+        try:
+            fd = os.open(osp.join(self.claims_dir, '.lock'),
+                         os.O_CREAT | os.O_RDWR, 0o644)
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            return fd
+        except OSError:
+            return None
+
+    def read_claim(self, sweep_id: str) -> Optional[Dict]:
+        try:
+            with open(self._claim_path(sweep_id), encoding='utf-8') as f:
+                rec = json.load(f)
+            return rec if isinstance(rec, dict) else None
+        except (OSError, ValueError):
+            return None
+
+    def claim_next(self, owner: str = 'daemon') -> Optional[Dict]:
+        """Atomically take the oldest queued sweep; None when the queue
+        is drained.  Stale claims (dead owner pid) are broken here, so a
+        restarted daemon resumes a preempted sweep without a separate
+        recovery pass."""
+        lock_fd = self._claims_flock()
+        try:
+            for sweep_id, rec in self.state().items():
+                if rec['status'] != 'queued':
+                    continue
+                path = self._claim_path(sweep_id)
+                if rec.get('stale_claim'):
+                    # re-check under the flock: another daemon may have
+                    # broken this claim and taken the sweep since our
+                    # state() snapshot — unlink only a still-dead owner
+                    existing = self.read_claim(sweep_id)
+                    if existing is not None \
+                            and _pid_alive(existing.get('pid')):
+                        continue
+                    try:   # break the dead owner's claim, race O_EXCL
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                claim = {'v': QUEUE_VERSION, 'id': sweep_id,
+                         'owner': owner, 'pid': os.getpid(),
+                         'ts': round(time.time(), 3)}
+                try:
+                    fd = os.open(path,
+                                 os.O_WRONLY | os.O_CREAT | os.O_EXCL,
+                                 0o644)
+                except FileExistsError:
+                    continue   # another daemon won this sweep
+                with os.fdopen(fd, 'w', encoding='utf-8') as f:
+                    json.dump(claim, f)
+                out = dict(rec)
+                out['claim'] = claim
+                return out
+            return None
+        finally:
+            if lock_fd is not None:
+                os.close(lock_fd)
+
+    def recover(self) -> List[str]:
+        """Break every stale claim (dead pid); returns the re-queued
+        sweep ids.  ``claim_next`` also does this lazily — this is the
+        eager startup sweep so queue depth reads right immediately."""
+        requeued = []
+        lock_fd = self._claims_flock()
+        try:
+            for sweep_id, rec in self.state().items():
+                if not rec.get('stale_claim'):
+                    continue
+                # same flock + re-check discipline as claim_next: never
+                # unlink a claim another daemon just took over
+                existing = self.read_claim(sweep_id)
+                if existing is not None \
+                        and _pid_alive(existing.get('pid')):
+                    continue
+                try:
+                    os.unlink(self._claim_path(sweep_id))
+                    requeued.append(sweep_id)
+                except OSError:
+                    pass
+            return requeued
+        finally:
+            if lock_fd is not None:
+                os.close(lock_fd)
+
+    # -- read side ---------------------------------------------------------
+
+    def _apply_record(self, rec: Dict):
+        """Fold one journal record into the replay cache."""
+        op, sweep_id = rec.get('op'), rec.get('id')
+        if not sweep_id:
+            return
+        if op == 'enqueue':
+            row = dict(rec)
+            row.pop('op', None)
+            row['status'] = 'queued'
+            row['submitted_ts'] = rec.get('ts')
+            self._replay.setdefault(sweep_id, row)
+        elif op in _TERMINAL_OPS and sweep_id in self._replay:
+            row = self._replay[sweep_id]
+            row['status'] = {'done': 'done', 'failed': 'failed',
+                             'cancel': 'cancelled'}[op]
+            row['ended_ts'] = rec.get('ts')
+            if rec.get('detail'):
+                row['detail'] = rec['detail']
+
+    def _refresh_replay(self):
+        """Parse journal bytes appended since the last call.  Whole
+        lines only — an in-flight (or torn) unterminated tail is left
+        for the next refresh, exactly the record granularity
+        ``iter_jsonl_records`` guarantees on full replay."""
+        try:
+            size = os.path.getsize(self.journal_path)
+        except OSError:
+            size = 0
+        if size < self._replay_offset:   # journal replaced/truncated
+            self._replay = OrderedDict()
+            self._replay_offset = 0
+        if size == self._replay_offset:
+            return
+        try:
+            with open(self.journal_path, 'rb') as f:
+                f.seek(self._replay_offset)
+                chunk = f.read(size - self._replay_offset)
+        except OSError:
+            return
+        end = chunk.rfind(b'\n')
+        if end < 0:
+            return
+        for line in chunk[:end].splitlines():
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue   # sealed torn line: one skippable garbage row
+            if isinstance(rec, dict):
+                self._apply_record(rec)
+        self._replay_offset += end + 1
+
+    def state(self) -> 'OrderedDict[str, Dict]':
+        """Replay the journal into sweep records, FIFO (journal) order.
+
+        Status: ``queued`` / ``running`` (live claim) / ``done`` /
+        ``failed`` / ``cancelled``.  A queued record whose claim file
+        names a dead pid additionally carries ``stale_claim: True``.
+
+        Journal parsing is incremental (append-only file, cached
+        offset); the claim overlay below runs per call but only stats
+        non-terminal sweeps, so a long-lived daemon's poll cost is
+        bounded by *active* sweeps, not lifetime throughput."""
+        self._refresh_replay()
+        sweeps: 'OrderedDict[str, Dict]' = OrderedDict(
+            (sweep_id, dict(row))
+            for sweep_id, row in self._replay.items())
+        for sweep_id, row in sweeps.items():
+            if row['status'] != 'queued':
+                continue
+            claim = self.read_claim(sweep_id)
+            if claim is None:
+                continue
+            if _pid_alive(claim.get('pid')):
+                row['status'] = 'running'
+                row['owner'] = claim.get('owner')
+                row['claimed_ts'] = claim.get('ts')
+            else:
+                row['stale_claim'] = True
+        return sweeps
+
+    def status(self, sweep_id: str) -> Optional[Dict]:
+        return self.state().get(sweep_id)
+
+    def depth(self) -> int:
+        """Sweeps waiting to run (queued, including stale claims)."""
+        return sum(1 for rec in self.state().values()
+                   if rec['status'] == 'queued')
+
+    def counts(self) -> Dict[str, int]:
+        out = {'queued': 0, 'running': 0, 'done': 0, 'failed': 0,
+               'cancelled': 0}
+        for rec in self.state().values():
+            out[rec['status']] = out.get(rec['status'], 0) + 1
+        return out

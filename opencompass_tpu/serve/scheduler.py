@@ -1,0 +1,412 @@
+"""Model-affinity worker scheduling for the evaluation engine.
+
+PR 4's resident workers are owned by one ``LocalRunner.launch`` call:
+the group spawns its worker, runs its shards, and shuts it down — the
+model dies with the sweep.  The :class:`WorkerPool` inverts that
+ownership: workers are **pool residents** keyed by model-affinity
+digest, leased to whoever needs the model next — a queued sweep's task
+group, an interactive ``/v1/completions`` request — and only reaped by
+idle TTL, capacity eviction, or daemon shutdown.  Two sweeps of the
+same model, enqueued back to back, hit the same worker process: one
+checkpoint load, one compile set, total.
+
+Leases are **request-scoped**, not group-scoped: every protocol
+round-trip serializes on the resident's lock, so an interactive
+completion interleaves *between* a sweep's task round-trips on the same
+channel instead of waiting for the whole sweep.
+
+Chip accounting: a resident worker owns its chips for its lifetime
+(that is what residency means on a TPU — the weights sit in chip HBM).
+The pool takes them from the runner's slot allocator via the
+``alloc``/``free`` callbacks at spawn/reap time, so pooled workers and
+one-shot tasks share one chip ledger.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+DEFAULT_IDLE_TTL_S = 600.0
+
+
+class WorkerBusyError(RuntimeError):
+    """The resident's channel lock could not be taken within the
+    caller's budget — the worker is healthy but occupied (a sweep task
+    round-trip holds the lock).  Deliberately NOT a ``WorkerError``:
+    busy must map to back-pressure (release the lease, tell the
+    client), never to the discard-and-kill path a broken channel
+    takes."""
+
+
+class ResidentWorker:
+    """One pooled worker process + its serialized protocol channel.
+
+    Quacks like :class:`runners.worker.WorkerHandle` for the runner's
+    ``_run_task_via_worker`` (``request_watched`` / ``kill`` / ``dead``
+    / ``proc``) but adds the request lock, lease refcount, and idle
+    clock the pool schedules by."""
+
+    def __init__(self, key: str, handle, chip_ids: List[int],
+                 devices: int):
+        self.key = key
+        self.handle = handle
+        self.chip_ids = list(chip_ids)
+        self.devices = devices
+        self.lock = threading.RLock()
+        self.in_use = 0                    # live leases (pool-locked)
+        self.requests = 0                  # round-trips served
+        self.retired = False               # chips freed once (pool-locked)
+        self.born = time.monotonic()
+        self.last_used = time.monotonic()
+
+    @property
+    def dead(self) -> bool:
+        return self.handle.dead
+
+    @property
+    def proc(self):
+        return self.handle.proc
+
+    @property
+    def alive(self) -> bool:
+        return not self.handle.dead and self.handle.proc.poll() is None
+
+    def request(self, msg: Dict, timeout: Optional[float] = None) -> Dict:
+        """One protocol round-trip.  ``timeout`` is the *total* budget:
+        it bounds the wait for the channel lock — an interactive request
+        queued behind a long sweep round-trip raises
+        :class:`WorkerBusyError` instead of hanging its HTTP thread
+        until the shard finishes — and whatever the lock wait consumed
+        is deducted from the protocol round-trip's share."""
+        remaining = timeout
+        if timeout is not None:
+            t0 = time.monotonic()
+            if not self.lock.acquire(timeout=timeout):
+                raise WorkerBusyError(
+                    f'worker {self.key} busy past {timeout:.0f}s '
+                    '(an in-flight request holds the channel)')
+            remaining = max(1.0, timeout - (time.monotonic() - t0))
+        else:
+            self.lock.acquire()
+        try:
+            self.requests += 1
+            try:
+                return self.handle.request(msg, timeout=remaining)
+            finally:
+                self.last_used = time.monotonic()
+        finally:
+            self.lock.release()
+
+    def request_watched(self, msg: Dict, **kwargs) -> Dict:
+        with self.lock:
+            self.requests += 1
+            try:
+                return self.handle.request_watched(msg, **kwargs)
+            finally:
+                self.last_used = time.monotonic()
+
+    def kill(self):
+        self.handle.kill()
+
+
+class WorkerPool:
+    """Resident workers keyed by model-affinity digest.
+
+    Args:
+        idle_ttl_s: reap a worker nobody has leased for this long
+            (``reap_idle`` / the reaper thread); None/0 disables.
+        max_resident: cap on resident workers; acquiring past it evicts
+            the longest-idle unleased worker first.  None = unbounded.
+        alloc/free: chip-slot callbacks (``LocalRunner._acquire_slots``
+            / ``_release_slots``); None for chipless fleets.
+    """
+
+    def __init__(self,
+                 idle_ttl_s: Optional[float] = DEFAULT_IDLE_TTL_S,
+                 max_resident: Optional[int] = None,
+                 alloc: Optional[Callable[[int], List[int]]] = None,
+                 free: Optional[Callable[[List[int]], None]] = None):
+        self.idle_ttl_s = idle_ttl_s
+        self.max_resident = max_resident
+        self.alloc = alloc
+        self.free = free
+        self._lock = threading.Lock()
+        self._workers: Dict[str, ResidentWorker] = {}
+        # live-but-replaced residents (an under-provisioned worker whose
+        # leases were in flight when a bigger sibling took its key):
+        # unreachable for new leases, retired by the reaper once drained
+        self._orphans: List[ResidentWorker] = []
+        self._spawns = 0
+        self._reuses = 0
+        self._reaped = 0
+        self._stop_reaper: Optional[threading.Event] = None
+
+    # -- lease API ---------------------------------------------------------
+
+    def acquire(self, key: str,
+                spawn: Callable[[List[int]], Tuple[Dict, str]],
+                devices: int = 0,
+                alloc_timeout_s: Optional[float] = None
+                ) -> ResidentWorker:
+        """Lease the resident worker for ``key``, spawning one when none
+        is alive.  ``spawn(chip_ids) -> (env, log_path)`` supplies the
+        subprocess environment; the pool owns the handle it creates.
+        Always pair with :meth:`release` (or :meth:`discard` when the
+        caller killed it).
+
+        ``alloc_timeout_s`` bounds the wait for device slots (the
+        ``alloc`` callback must accept a ``timeout`` kwarg and raise
+        ``TimeoutError`` past it) — interactive callers pass their
+        request budget so an HTTP thread never parks forever behind a
+        sweep that owns every chip; sweep callers leave it None and
+        block, which is the batch path's contract."""
+        corpse = None
+        with self._lock:
+            worker = self._workers.get(key)
+            if worker is not None and not worker.alive:
+                self._pop_locked(worker)
+                corpse, worker = worker, None
+            elif worker is not None and worker.devices < devices:
+                # under-provisioned resident (model_cfg_key strips
+                # run_cfg, so a 0-chip interactive spawn and a 4-chip
+                # sweep share a key): respawn with enough chips rather
+                # than run device tasks on a worker that reserved none.
+                # A leased under-provisioned worker can't be torn down
+                # — leave it to its lease holders, spawn a bigger one,
+                # and orphan the small one at install time (the reaper
+                # retires it once its leases drain)
+                if worker.in_use == 0:
+                    self._pop_locked(worker)
+                    corpse, worker = worker, None
+                else:
+                    worker = None   # force the spawn path
+            if worker is not None:
+                worker.in_use += 1
+                worker.last_used = time.monotonic()
+                self._reuses += 1
+                return worker
+        if corpse is not None:
+            # a quietly-dead (or idle under-provisioned) resident still
+            # owns chips — retire (not just pop) or the slot ledger
+            # leaks and the alloc below can wait forever on chips
+            # nobody will ever free
+            self._retire(corpse, graceful=corpse.alive)
+        if self.max_resident:
+            # make room BEFORE chip allocation: the evictee's chips may
+            # be the very ones alloc() is about to block on
+            with self._lock:
+                evicted = self._over_capacity_locked(
+                    limit=self.max_resident - 1)
+            for victim in evicted:
+                self._retire(victim, graceful=True)
+        # spawn outside the lock: chip allocation may block on slots
+        # another group still holds, and process startup is slow
+        if self.alloc is not None and devices:
+            chip_ids = list(
+                self.alloc(devices) if alloc_timeout_s is None
+                else self.alloc(devices, timeout=alloc_timeout_s))
+        else:
+            chip_ids = []
+        try:
+            env, log_path = spawn(chip_ids)
+            from opencompass_tpu.runners.worker import WorkerHandle
+            handle = WorkerHandle(env, log_path)
+        except BaseException:
+            if chip_ids and self.free is not None:
+                self.free(chip_ids)
+            raise
+        worker = ResidentWorker(key, handle, chip_ids, devices)
+        worker.in_use = 1
+        loser = None
+        displaced = None
+        evicted: List[ResidentWorker] = []
+        with self._lock:
+            incumbent = self._workers.get(key)
+            if incumbent is not None and incumbent.alive \
+                    and incumbent.devices >= devices:
+                # lost a spawn race: lease the incumbent, drop ours
+                incumbent.in_use += 1
+                incumbent.last_used = time.monotonic()
+                self._reuses += 1
+                loser, worker = worker, incumbent
+            else:
+                if incumbent is not None:
+                    self._pop_locked(incumbent)
+                    if incumbent.alive and incumbent.in_use > 0:
+                        self._orphans.append(incumbent)
+                    else:
+                        displaced = incumbent   # chips still charged
+                self._workers[key] = worker
+                self._spawns += 1
+                if self.max_resident:
+                    evicted = self._over_capacity_locked(
+                        limit=self.max_resident)
+        if displaced is not None:
+            self._retire(displaced, graceful=displaced.alive)
+        if loser is not None:
+            self._retire(loser, graceful=False)
+        for victim in evicted:
+            self._retire(victim, graceful=True)
+        self._observe('worker_pool_spawn' if loser is None
+                      else 'worker_pool_reuse', key, devices=devices)
+        return worker
+
+    def release(self, worker: ResidentWorker):
+        """Return a lease; the worker stays resident (idle clock starts
+        ticking toward the TTL)."""
+        with self._lock:
+            worker.in_use = max(0, worker.in_use - 1)
+            worker.last_used = time.monotonic()
+
+    def discard(self, worker: ResidentWorker):
+        """Drop a worker the caller observed dead (or killed): remove it
+        from the pool and free its chips."""
+        with self._lock:
+            self._pop_locked(worker)
+            worker.in_use = max(0, worker.in_use - 1)
+        self._retire(worker, graceful=False)
+
+    # -- reaping -----------------------------------------------------------
+
+    def reap_idle(self, now: Optional[float] = None) -> List[str]:
+        """Retire every unleased worker idle past the TTL (and any that
+        quietly died — self-reaped on its own idle TTL, crashed, or
+        drained by SIGTERM).  Returns the reaped keys."""
+        now = time.monotonic() if now is None else now
+        victims: List[ResidentWorker] = []
+        with self._lock:
+            for worker in list(self._workers.values()):
+                if worker.in_use > 0:
+                    continue
+                expired = (self.idle_ttl_s
+                           and now - worker.last_used >= self.idle_ttl_s)
+                if expired or not worker.alive:
+                    self._pop_locked(worker)
+                    victims.append(worker)
+            for worker in list(self._orphans):
+                # orphans drained their leases (or died): retire now —
+                # no TTL, nobody can lease them again
+                if worker.in_use == 0 or not worker.alive:
+                    self._orphans.remove(worker)
+                    victims.append(worker)
+        for worker in victims:
+            self._retire(worker, graceful=True)
+            self._reaped += 1
+            self._observe('worker_pool_reaped', worker.key,
+                          idle_s=round(now - worker.last_used, 1))
+        return [w.key for w in victims]
+
+    def start_reaper(self, interval: float = 30.0):
+        """Daemon thread calling :meth:`reap_idle` every ``interval``
+        seconds (the engine's idle keep-alive bound)."""
+        if self._stop_reaper is not None:
+            return
+        self._stop_reaper = threading.Event()
+
+        def loop():
+            while not self._stop_reaper.wait(interval):
+                try:
+                    self.reap_idle()
+                except Exception:
+                    pass
+
+        threading.Thread(target=loop, name='serve-worker-reaper',
+                         daemon=True).start()
+
+    def shutdown(self):
+        """Retire every resident (graceful protocol shutdown, kill
+        fallback) and stop the reaper."""
+        if self._stop_reaper is not None:
+            self._stop_reaper.set()
+            self._stop_reaper = None
+        with self._lock:
+            victims = list(self._workers.values()) + list(self._orphans)
+            self._workers.clear()
+            self._orphans.clear()
+        for worker in victims:
+            self._retire(worker, graceful=True)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def resident_count(self) -> int:
+        with self._lock:
+            return len(self._workers)
+
+    def stats(self) -> Dict:
+        now = time.monotonic()
+        with self._lock:
+            workers = {
+                worker.key: {
+                    'pid': worker.proc.pid,
+                    'devices': worker.devices,
+                    'chip_ids': worker.chip_ids,
+                    'in_use': worker.in_use,
+                    'requests': worker.requests,
+                    'idle_seconds': round(now - worker.last_used, 1),
+                    'age_seconds': round(now - worker.born, 1),
+                    'alive': worker.alive,
+                } for worker in self._workers.values()
+            }
+            orphans = len(self._orphans)
+        return {'resident': len(workers), 'spawns': self._spawns,
+                'reuses': self._reuses, 'reaped': self._reaped,
+                'orphans': orphans, 'workers': workers}
+
+    # -- internals ---------------------------------------------------------
+
+    def _pop_locked(self, worker: ResidentWorker):
+        if self._workers.get(worker.key) is worker:
+            del self._workers[worker.key]
+
+    def _over_capacity_locked(self, limit: int) -> List[ResidentWorker]:
+        """Pop longest-idle unleased workers until at most ``limit``
+        remain (callers retire the returned victims outside the lock).
+        ``limit = max_resident - 1`` *reserves* a slot for a spawn that
+        has not allocated chips yet."""
+        evicted = []
+        idle = sorted((w for w in self._workers.values()
+                       if w.in_use == 0), key=lambda w: w.last_used)
+        while len(self._workers) > max(limit, 0) and idle:
+            worker = idle.pop(0)
+            self._pop_locked(worker)
+            evicted.append(worker)
+        return evicted
+
+    def _retire(self, worker: ResidentWorker, graceful: bool):
+        with self._lock:
+            # shutdown() racing a lease-holder's discard() must not free
+            # the same chip_ids twice — a second free would hand chips
+            # already re-allocated to a new worker back to the ledger
+            if worker.retired:
+                return
+            worker.retired = True
+        try:
+            if graceful:
+                worker.handle.shutdown()
+            else:
+                worker.handle.kill()
+        except Exception:
+            pass
+        if worker.chip_ids and self.free is not None:
+            try:
+                self.free(worker.chip_ids)
+            except Exception:
+                pass
+
+    @staticmethod
+    def _observe(event: str, key: str, **attrs):
+        """Pool events into the obs stream when tracing is live; the
+        never-fail telemetry contract applies."""
+        try:
+            from opencompass_tpu.obs import get_tracer
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.event(event, model_key=key, **attrs)
+        except Exception:
+            pass

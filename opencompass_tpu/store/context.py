@@ -74,17 +74,28 @@ def reset_stores():
 
 def bind_model_store(model, model_cfg: Dict,
                      cfg: Optional[Dict] = None,
-                     work_dir: Optional[str] = None):
+                     work_dir: Optional[str] = None,
+                     root: Optional[str] = None):
     """Attach the sweep store + this model's identity to ``model`` so
     inferencers can build namespaces.  Never raises; on any problem the
-    model simply has no store bound."""
+    model simply has no store bound.
+
+    ``root`` (or a ``cache_root`` key in ``cfg``) pins the cache root
+    explicitly — *engine-owned* binding: a serve daemon stamps its root
+    into every sweep config so tasks and workers commit to the engine's
+    store regardless of their own work_dir or inherited environment."""
     try:
         model._result_store = None
         if not result_cache_enabled(cfg):
             return
         if not getattr(model, 'supports_result_cache', True):
             return
-        store = open_store(work_dir)
+        cache_root = root or (cfg.get('cache_root') if cfg else None)
+        explicit = None
+        if cache_root:
+            from opencompass_tpu.store.store import STORE_SUBDIR
+            explicit = os.path.join(cache_root, STORE_SUBDIR)
+        store = open_store(work_dir, root=explicit)
         if store is None:
             return
         model._result_store = store

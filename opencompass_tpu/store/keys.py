@@ -78,10 +78,15 @@ def row_key(namespace: str, prompt: str, extra=None) -> str:
 
 def unit_key(model_cfg: Dict, dataset_cfg: Dict) -> str:
     """24-hex address of a whole (model, dataset-shard) prediction file,
-    computable pre-launch from configs alone."""
-    from opencompass_tpu.utils.build import model_cfg_key
-    ds = {k: v for k, v in dict(dataset_cfg).items()
-          if k not in _UNIT_NON_CONTENT_KEYS}
+    computable pre-launch from configs alone.  ``type`` values are
+    normalized to dotted paths (``normalize_cfg_types``) so the driver,
+    which partitions from a fresh config holding class objects, computes
+    the same key as the task that wrote the manifest from its dumped
+    param config."""
+    from opencompass_tpu.utils.build import (model_cfg_key,
+                                             normalize_cfg_types)
+    ds = normalize_cfg_types({k: v for k, v in dict(dataset_cfg).items()
+                              if k not in _UNIT_NON_CONTENT_KEYS})
     blob = _blob([KEY_VERSION, model_cfg_key(model_cfg),
                   # result-relevant model knobs that model_cfg_key
                   # deliberately strips (they are scheduler-consumed)

@@ -65,10 +65,14 @@ class OpenICLInferTask(BaseTask):
             heartbeat.bind_perf(getattr(model, 'perf', None))
             # content-addressed result store: inferencers serve cached
             # rows from disk and commit fresh ones as batches complete
-            # (no-op when disabled / no cache root / API model)
+            # (no-op when disabled / no cache root / API model).  A
+            # serve-mode sweep carries the engine's cache_root, so the
+            # binding is engine-owned — this task commits to the
+            # daemon's store no matter which work_dir it runs under
             from opencompass_tpu import store as result_store
             result_store.bind_model_store(model, model_cfg, self.cfg,
-                                          work_dir=self.work_dir)
+                                          work_dir=self.work_dir,
+                                          root=self.cfg.get('cache_root'))
 
             try:
                 self._infer_model_datasets(

@@ -35,13 +35,38 @@ def build_dataset_from_cfg(dataset_cfg):
     return LOAD_DATASET.build(dataset_cfg)
 
 
+def normalize_cfg_types(obj):
+    """Recursive copy of a config fragment with every ``type`` value in
+    its dumped form (dotted path).  A fresh config holds class objects
+    while its ``Config.dump`` round-trip holds ``module.qualname``
+    strings; digests must not distinguish the two, or a driver-side key
+    (class objects) never matches the key a subprocess task computed
+    from its dumped param config."""
+    if isinstance(obj, dict):
+        out = {}
+        for k, v in obj.items():
+            if k == 'type' and v is not None and not isinstance(v, str):
+                mod = getattr(v, '__module__', None)
+                qual = getattr(v, '__qualname__',
+                               getattr(v, '__name__', None))
+                out[k] = f'{mod}.{qual}' if mod and qual else str(v)
+            else:
+                out[k] = normalize_cfg_types(v)
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [normalize_cfg_types(v) for v in obj]
+    return obj
+
+
 def model_cfg_key(model_cfg) -> str:
     """Stable digest of a model config's constructor-relevant fields —
     two configs with the same key build interchangeable models.  Doubles
     as the partitioners' model-affinity key (tasks with equal keys are
-    routed to the same resident worker)."""
-    cfg = {k: v for k, v in dict(model_cfg).items()
-           if k not in MODEL_NON_CTOR_KEYS}
+    routed to the same resident worker).  ``type`` values are
+    normalized to dotted paths so the key is representation-independent
+    (class object vs dumped string)."""
+    cfg = normalize_cfg_types({k: v for k, v in dict(model_cfg).items()
+                               if k not in MODEL_NON_CTOR_KEYS})
     blob = json.dumps(cfg, sort_keys=True, default=str)
     return hashlib.sha1(blob.encode('utf-8')).hexdigest()[:16]
 
@@ -55,6 +80,19 @@ def enable_model_cache():
 
 def model_cache_enabled() -> bool:
     return _MODEL_CACHE is not None
+
+
+def model_cached(model_cfg) -> bool:
+    """Is this config's model already memoized in-process?  (The serve
+    plane reports build-vs-reuse per interactive request with this.)"""
+    return _MODEL_CACHE is not None \
+        and model_cfg_key(model_cfg) in _MODEL_CACHE
+
+
+def cached_models():
+    """Every model memoized by this process — the resident worker's
+    drain hook iterates these to persist host caches before exit."""
+    return list((_MODEL_CACHE or {}).values())
 
 
 def build_model_from_cfg(model_cfg):

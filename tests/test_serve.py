@@ -1,0 +1,1035 @@
+"""Evaluation-as-a-service: sweep queue durability, worker-pool
+scheduling, the HTTP front door, worker lifecycle (idle TTL / SIGTERM
+drain), and the daemon end-to-end (slow tier)."""
+import hashlib
+import json
+import os
+import os.path as osp
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+DEMO_CFG = osp.join(REPO, 'configs', 'eval_demo.py')
+
+
+# -- durable FIFO sweep queue ----------------------------------------------
+
+def _queue(tmp_path):
+    from opencompass_tpu.serve.queue import SweepQueue
+    return SweepQueue(str(tmp_path / 'queue'))
+
+
+def test_queue_fifo_and_terminal_ops(tmp_path):
+    q = _queue(tmp_path)
+    a = q.enqueue(config_path='/cfg/a.py')['id']
+    b = q.enqueue(config_path='/cfg/b.py', mode='infer')['id']
+    c = q.enqueue(config_text='datasets = []\nmodels = []\n')['id']
+    state = q.state()
+    assert list(state) == [a, b, c]          # FIFO == journal order
+    assert all(r['status'] == 'queued' for r in state.values())
+    # inline config persisted to a daemon-readable file
+    assert osp.isfile(state[c]['config_path'])
+    assert 'datasets' in open(state[c]['config_path']).read()
+    assert q.depth() == 3
+
+    first = q.claim_next(owner='t')
+    assert first['id'] == a                  # oldest first
+    assert q.status(a)['status'] == 'running'
+    q.mark_done(a, ok=True, detail={'n_tasks': 2})
+    assert q.status(a)['status'] == 'done'
+    assert q.status(a)['detail'] == {'n_tasks': 2}
+
+    second = q.claim_next(owner='t')
+    assert second['id'] == b
+    q.mark_done(b, ok=False)
+    assert q.status(b)['status'] == 'failed'
+    assert q.counts() == {'queued': 1, 'running': 0, 'done': 1,
+                          'failed': 1, 'cancelled': 0}
+
+
+def test_queue_concurrent_enqueue_two_clients(tmp_path):
+    """Two clients (threads, each with its own SweepQueue handle on the
+    same directory) enqueue concurrently: every record lands, order is
+    journal order, and the drain sees all of them FIFO."""
+    from opencompass_tpu.serve.queue import SweepQueue
+    root = str(tmp_path / 'queue')
+    ids = {0: [], 1: []}
+
+    def client(n):
+        q = SweepQueue(root)
+        for i in range(20):
+            ids[n].append(
+                q.enqueue(config_path=f'/cfg/c{n}-{i}.py')['id'])
+
+    threads = [threading.Thread(target=client, args=(n,))
+               for n in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    q = SweepQueue(root)
+    state = list(q.state())
+    assert len(state) == 40
+    assert set(state) == set(ids[0]) | set(ids[1])
+    # per-client FIFO survives the interleave
+    for n in (0, 1):
+        order = [s for s in state if s in set(ids[n])]
+        assert order == ids[n]
+    drained = []
+    while True:
+        rec = q.claim_next(owner='drain')
+        if rec is None:
+            break
+        drained.append(rec['id'])
+        q.mark_done(rec['id'])
+    assert drained == state
+
+
+def test_queue_claim_is_exclusive(tmp_path):
+    """Two daemons on one queue directory: O_EXCL arbitrates — each
+    sweep is claimed exactly once."""
+    from opencompass_tpu.serve.queue import SweepQueue
+    root = str(tmp_path / 'queue')
+    q1, q2 = SweepQueue(root), SweepQueue(root)
+    ids = [q1.enqueue(config_path=f'/c{i}.py')['id'] for i in range(2)]
+    first = q1.claim_next(owner='d1')
+    second = q2.claim_next(owner='d2')
+    assert {first['id'], second['id']} == set(ids)
+    assert q2.claim_next(owner='d2') is None   # both taken
+
+
+def test_queue_cancel_only_while_queued(tmp_path):
+    q = _queue(tmp_path)
+    a = q.enqueue(config_path='/a.py')['id']
+    b = q.enqueue(config_path='/b.py')['id']
+    q.claim_next(owner='d')                    # a now running (live pid)
+    assert q.cancel(a) is False                # running: not cancellable
+    assert q.cancel(b) is True
+    assert q.status(b)['status'] == 'cancelled'
+    assert q.cancel(b) is False                # already terminal
+    assert q.cancel('sw-nope') is False        # unknown
+    assert q.claim_next(owner='d2') is None    # nothing queued remains
+
+
+def test_queue_stale_claim_recovery(tmp_path):
+    """A claim whose owner pid is dead re-queues the sweep — the whole
+    kill -9 resume story at queue level."""
+    import json as jsonlib
+    q = _queue(tmp_path)
+    a = q.enqueue(config_path='/a.py')['id']
+    # a dead daemon's claim: a pid that existed and exited
+    proc = subprocess.Popen([sys.executable, '-c', 'pass'])
+    proc.wait()
+    with open(q._claim_path(a), 'w') as f:
+        jsonlib.dump({'v': 1, 'id': a, 'owner': 'dead',
+                      'pid': proc.pid, 'ts': 0}, f)
+    rec = q.status(a)
+    assert rec['status'] == 'queued'
+    assert rec.get('stale_claim') is True
+    assert q.recover() == [a]
+    claimed = q.claim_next(owner='d2')
+    assert claimed['id'] == a
+    assert q.status(a)['status'] == 'running'
+
+
+def test_queue_torn_journal_line_recovery(tmp_path):
+    """kill -9 can tear at most the final journal line; replay skips it
+    and — because a reopened queue seals the torn tail — the next
+    append lands on its own line instead of being absorbed."""
+    from opencompass_tpu.serve.queue import SweepQueue
+    q = _queue(tmp_path)
+    a = q.enqueue(config_path='/a.py')['id']
+    b = q.enqueue(config_path='/b.py')['id']
+    with open(q.journal_path, 'a') as f:
+        f.write('{"v": 1, "op": "enqueue", "id": "sw-torn", "conf')
+    state = q.state()
+    assert list(state) == [a, b]
+    assert 'sw-torn' not in state
+    # a restarted daemon (fresh handle) seals the tear, so its appends
+    # start clean on their own line
+    q2 = SweepQueue(q.root)
+    c = q2.enqueue(config_path='/c.py')['id']
+    assert list(q2.state()) == [a, b, c]
+    assert list(q.state()) == [a, b, c]
+
+
+def test_queue_mid_life_torn_tail_reseal(tmp_path):
+    """A torn line created DURING the daemon's lifetime (an external
+    CLI client killed mid-append) must not absorb the daemon's next
+    append — every write re-seals the tail, not just __init__."""
+    from opencompass_tpu.serve.queue import SweepQueue
+    q = _queue(tmp_path)
+    a = q.enqueue(config_path='/a.py')['id']
+    with open(q.journal_path, 'a') as f:
+        f.write('{"v": 1, "op": "enqueue", "id": "sw-torn", "conf')
+    b = q.enqueue(config_path='/b.py')['id']   # same live handle
+    q.mark_done(a)
+    state = SweepQueue(q.root).state()         # full replay from disk
+    assert list(state) == [a, b]
+    assert state[a]['status'] == 'done'
+    assert state[b]['status'] == 'queued'
+    assert 'sw-torn' not in state
+
+
+def test_queue_claim_break_rechecks_live_takeover(tmp_path):
+    """Breaking a stale claim must re-check the file under the claims
+    flock: if another daemon broke it and took the sweep after our
+    state() snapshot, unlinking would delete the winner's LIVE claim
+    and both daemons would run the sweep."""
+    import json as jsonlib
+    q = _queue(tmp_path)
+    a = q.enqueue(config_path='/a.py')['id']
+    proc = subprocess.Popen([sys.executable, '-c', 'pass'])
+    proc.wait()
+    with open(q._claim_path(a), 'w') as f:
+        jsonlib.dump({'v': 1, 'id': a, 'owner': 'dead',
+                      'pid': proc.pid, 'ts': 0}, f)
+    stale_snap = q.state()
+    assert stale_snap[a].get('stale_claim') is True
+    # another daemon wins the break and claims: live pid on disk now
+    live = {'v': 1, 'id': a, 'owner': 'winner', 'pid': os.getpid(),
+            'ts': 1}
+    with open(q._claim_path(a), 'w') as f:
+        jsonlib.dump(live, f)
+    q.state = lambda: stale_snap            # freeze the stale snapshot
+    assert q.claim_next(owner='loser') is None
+    assert q.recover() == []
+    assert q.read_claim(a) == live          # winner's claim untouched
+
+
+# -- worker pool scheduling (fake handles) ---------------------------------
+
+class _FakeHandle:
+    """Quacks like WorkerHandle without a subprocess."""
+    spawned = []
+
+    def __init__(self, env, log_path):
+        self.env, self.log_path = env, log_path
+        self.dead = False
+        self.proc = type('P', (), {'pid': 4242,
+                                   'poll': staticmethod(lambda: None)})()
+        self.requests = []
+        self.shutdowns = 0
+        _FakeHandle.spawned.append(self)
+
+    def request(self, msg, timeout=None):
+        self.requests.append(msg)
+        return {'ok': True}
+
+    def request_watched(self, msg, **kw):
+        return self.request(msg)
+
+    def shutdown(self, timeout=10.0):
+        self.shutdowns += 1
+        self.dead = True
+        self.proc.poll = lambda: 0
+
+    def kill(self):
+        self.dead = True
+        self.proc.poll = lambda: 0
+
+
+@pytest.fixture()
+def fake_worker(monkeypatch):
+    from opencompass_tpu.runners import worker as workermod
+    _FakeHandle.spawned = []
+    monkeypatch.setattr(workermod, 'WorkerHandle', _FakeHandle)
+    return _FakeHandle
+
+
+def _spawn(chip_ids):
+    return {'CHIPS': ','.join(map(str, chip_ids))}, '/dev/null'
+
+
+def test_pool_lease_reuse_and_release(fake_worker):
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    pool = WorkerPool(idle_ttl_s=None)
+    w1 = pool.acquire('m1', _spawn)
+    w2 = pool.acquire('m1', _spawn)     # same key, concurrent lease
+    assert w1 is w2
+    assert w1.in_use == 2
+    assert len(fake_worker.spawned) == 1
+    pool.release(w1)
+    pool.release(w2)
+    assert w1.in_use == 0
+    w3 = pool.acquire('m1', _spawn)     # released, still resident
+    assert w3 is w1
+    stats = pool.stats()
+    assert stats['spawns'] == 1
+    assert stats['reuses'] == 2
+    assert stats['resident'] == 1
+    pool.shutdown()
+    assert w1.handle.shutdowns == 1
+    assert pool.resident_count == 0
+
+
+def test_pool_chip_accounting(fake_worker):
+    """Chips come from the runner's allocator at spawn and go back at
+    retire — pooled workers and one-shot tasks share one ledger."""
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    ledger = {'out': 0}
+
+    def alloc(n):
+        ledger['out'] += n
+        return list(range(n))
+
+    def free(ids):
+        ledger['out'] -= len(ids)
+
+    pool = WorkerPool(alloc=alloc, free=free)
+    w = pool.acquire('m1', _spawn, devices=2)
+    assert ledger['out'] == 2
+    pool.release(w)
+    assert ledger['out'] == 2           # residency holds the chips
+    pool.shutdown()
+    assert ledger['out'] == 0
+
+
+def test_pool_idle_ttl_reap(fake_worker):
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    pool = WorkerPool(idle_ttl_s=10.0)
+    w1 = pool.acquire('m1', _spawn)
+    w2 = pool.acquire('m2', _spawn)
+    pool.release(w1)                    # idle from now
+    now = time.monotonic()
+    assert pool.reap_idle(now=now + 5) == []        # not yet
+    assert pool.reap_idle(now=now + 11) == ['m1']   # past TTL
+    assert w1.handle.shutdowns == 1                 # graceful retire
+    # w2 still leased: never reaped, no matter how idle
+    assert pool.reap_idle(now=now + 1000) == []
+    assert pool.resident_count == 1
+    pool.shutdown()
+
+
+def test_pool_reaps_quietly_dead_worker(fake_worker):
+    """A worker that self-exited (its own idle TTL, a crash) is swept
+    out by the reaper even before the pool TTL."""
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    pool = WorkerPool(idle_ttl_s=1e9)
+    w = pool.acquire('m1', _spawn)
+    pool.release(w)
+    w.handle.dead = True                # died on its own
+    assert pool.reap_idle() == ['m1']
+    assert pool.resident_count == 0
+
+
+def test_pool_capacity_eviction(fake_worker):
+    """Past max_resident the longest-idle unleased worker is evicted;
+    leased workers are never victims."""
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    pool = WorkerPool(idle_ttl_s=None, max_resident=2)
+    w1 = pool.acquire('m1', _spawn)
+    pool.release(w1)
+    w1.last_used -= 100                 # clearly the oldest
+    w2 = pool.acquire('m2', _spawn)
+    pool.acquire('m3', _spawn)          # over capacity: evict m1
+    assert pool.resident_count == 2
+    assert w1.handle.shutdowns == 1
+    keys = set(pool.stats()['workers'])
+    assert keys == {'m2', 'm3'}
+    # m2 is leased: acquiring a 4th key must evict m3, not m2
+    pool.release(pool.acquire('m3', _spawn))
+    pool.acquire('m4', _spawn)
+    assert 'm2' in pool.stats()['workers']
+    pool.shutdown()
+
+
+def test_pool_acquire_retires_quietly_dead_worker(fake_worker):
+    """acquire() on a key whose resident quietly died must retire the
+    corpse — freeing its chips — not just drop the dict entry, or the
+    slot ledger leaks and the replacement spawn can block forever on
+    chips nobody will ever release."""
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    ledger = {'out': 0}
+
+    def alloc(n):
+        ledger['out'] += n
+        return list(range(n))
+
+    pool = WorkerPool(alloc=alloc,
+                      free=lambda ids: ledger.__setitem__(
+                          'out', ledger['out'] - len(ids)))
+    w = pool.acquire('m1', _spawn, devices=2)
+    pool.release(w)
+    w.handle.dead = True                # self-exited (own TTL / crash)
+    w2 = pool.acquire('m1', _spawn, devices=2)
+    assert w2 is not w
+    assert ledger['out'] == 2           # corpse's chips were freed
+    pool.shutdown()
+    assert ledger['out'] == 0
+
+
+def test_pool_capacity_eviction_frees_chips_before_alloc(fake_worker):
+    """With max_resident, the evictee must be retired BEFORE the new
+    worker's chip allocation — its chips may be the very ones alloc()
+    would otherwise block on (2-chip host, 2-chip models, cap 1)."""
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    ledger = {'out': 0}
+
+    def alloc(n):
+        # the real allocator blocks; here over-subscription = the bug
+        assert ledger['out'] + n <= 2, 'alloc would deadlock'
+        ledger['out'] += n
+        return list(range(n))
+
+    pool = WorkerPool(idle_ttl_s=None, max_resident=1, alloc=alloc,
+                      free=lambda ids: ledger.__setitem__(
+                          'out', ledger['out'] - len(ids)))
+    w1 = pool.acquire('m1', _spawn, devices=2)
+    pool.release(w1)
+    w2 = pool.acquire('m2', _spawn, devices=2)   # must evict m1 first
+    assert w1.handle.shutdowns == 1
+    assert set(pool.stats()['workers']) == {'m2'}
+    assert ledger['out'] == 2
+    pool.release(w2)
+    pool.shutdown()
+
+
+def test_worker_busy_is_backpressure_not_a_corpse(fake_worker):
+    """A bounded request() that cannot take the channel lock raises
+    WorkerBusyError — distinct from WorkerError, so the daemon releases
+    the lease instead of discarding (killing) a healthy mid-sweep
+    worker."""
+    from opencompass_tpu.runners.worker import WorkerError
+    from opencompass_tpu.serve.scheduler import (WorkerBusyError,
+                                                 WorkerPool)
+    pool = WorkerPool(idle_ttl_s=None)
+    w = pool.acquire('m1', _spawn)
+    assert not issubclass(WorkerBusyError, WorkerError)
+    hold = threading.Event()
+    done = threading.Event()
+
+    def occupant():
+        with w.lock:                    # a sweep round-trip in flight
+            hold.set()
+            done.wait(10)
+
+    t = threading.Thread(target=occupant)
+    t.start()
+    assert hold.wait(5)
+    try:
+        with pytest.raises(WorkerBusyError):
+            w.request({'cmd': 'ping'}, timeout=0.05)
+    finally:
+        done.set()
+        t.join()
+    # unbounded / post-release requests still work
+    assert w.request({'cmd': 'ping'}) == {'ok': True}
+    pool.shutdown()
+
+
+def test_pool_discard_dead_worker(fake_worker):
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    freed = []
+    pool = WorkerPool(alloc=lambda n: [7], free=freed.extend)
+    w = pool.acquire('m1', _spawn, devices=1)
+    w.handle.dead = True
+    pool.discard(w)
+    assert pool.resident_count == 0
+    assert freed == [7]
+    # next acquire spawns fresh
+    w2 = pool.acquire('m1', _spawn, devices=1)
+    assert w2 is not w
+    pool.shutdown()
+
+
+def test_pool_leased_underprovisioned_worker_spawns_bigger(fake_worker):
+    """A leased under-provisioned resident (0-chip interactive worker,
+    in flight) must NOT be handed to a caller that needs chips — the
+    pool spawns a bigger sibling and orphans the small one, which the
+    reaper retires once its leases drain."""
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    ledger = {'out': 0}
+
+    def alloc(n):
+        ledger['out'] += n
+        return list(range(n))
+
+    pool = WorkerPool(idle_ttl_s=None, alloc=alloc,
+                      free=lambda ids: ledger.__setitem__(
+                          'out', ledger['out'] - len(ids)))
+    w_small = pool.acquire('m1', _spawn)            # interactive, 0 chips
+    w_big = pool.acquire('m1', _spawn, devices=2)   # sweep group
+    assert w_big is not w_small
+    assert w_big.devices == 2 and ledger['out'] == 2
+    stats = pool.stats()
+    assert stats['resident'] == 1 and stats['orphans'] == 1
+    # new leases land on the big worker; the orphan is unreachable
+    pool.release(pool.acquire('m1', _spawn))
+    assert w_big.in_use == 1 and w_small.in_use == 1
+    # orphan survives reaping while leased, retires once drained
+    assert pool.reap_idle() == []
+    pool.release(w_small)
+    assert pool.reap_idle() == ['m1']
+    assert w_small.handle.shutdowns == 1
+    assert pool.stats()['orphans'] == 0
+    pool.release(w_big)
+    pool.shutdown()
+    assert ledger['out'] == 0
+
+
+def test_pool_retire_frees_chips_exactly_once(fake_worker):
+    """shutdown() racing a lease-holder's discard() must not free the
+    same chip_ids twice — a double free would mark chips re-allocated
+    to a new worker as free and hand one chip to two owners."""
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    freed = []
+    pool = WorkerPool(alloc=lambda n: [3, 4], free=freed.extend)
+    w = pool.acquire('m1', _spawn, devices=2)
+    pool.shutdown()                 # engine stop with the lease in flight
+    pool.discard(w)                 # holder sees the killed channel
+    assert freed == [3, 4]
+
+
+def test_pool_alloc_timeout_surfaces(fake_worker):
+    """acquire(alloc_timeout_s=...) propagates the allocator's
+    TimeoutError instead of parking the caller — the interactive path's
+    bound when sweeps hold every chip.  Sweeps pass no timeout and keep
+    the blocking contract."""
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    calls = []
+
+    def alloc(n, timeout=None):
+        calls.append(timeout)
+        if timeout is not None:
+            raise TimeoutError(f'no {n} free slot(s) within {timeout}s')
+        return list(range(n))
+
+    pool = WorkerPool(idle_ttl_s=None, alloc=alloc,
+                      free=lambda ids: None)
+    with pytest.raises(TimeoutError):
+        pool.acquire('m1', _spawn, devices=2, alloc_timeout_s=0.1)
+    assert pool.resident_count == 0
+    w = pool.acquire('m1', _spawn, devices=2)   # unbounded sweep path
+    assert w.chip_ids == [0, 1]
+    assert calls == [0.1, None]
+    pool.shutdown()
+
+
+def test_acquire_slots_timeout():
+    """LocalRunner._acquire_slots with a timeout raises instead of
+    spinning forever when the chips never free."""
+    from opencompass_tpu.runners import LocalRunner
+    runner = LocalRunner(dict(type='OpenICLInferTask'), num_devices=1)
+    ids = runner._acquire_slots(1)
+    with pytest.raises(TimeoutError):
+        runner._acquire_slots(1, timeout=1.5)
+    runner._release_slots(ids)
+    assert runner._acquire_slots(1, timeout=5.0) == ids
+    runner._release_slots(ids)
+
+
+def test_request_timeout_is_total_budget(fake_worker):
+    """The caller's timeout covers lock wait + protocol round-trip:
+    time spent queued behind a sweep round-trip is deducted from the
+    handle request's share, so worst-case wall time is ~timeout, not
+    2x timeout."""
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    pool = WorkerPool(idle_ttl_s=None)
+    w = pool.acquire('m1', _spawn)
+    seen = {}
+    orig = w.handle.request
+    w.handle.request = lambda msg, timeout=None: (
+        seen.__setitem__('timeout', timeout) or orig(msg))
+    hold = threading.Event()
+
+    def occupant():
+        with w.lock:
+            hold.set()
+            time.sleep(0.5)
+
+    t = threading.Thread(target=occupant)
+    t.start()
+    assert hold.wait(5)
+    assert w.request({'cmd': 'ping'}, timeout=5.0) == {'ok': True}
+    t.join()
+    assert seen['timeout'] is not None
+    assert 1.0 <= seen['timeout'] <= 4.9
+    pool.shutdown()
+
+
+# -- HTTP server: route dispatch + readiness -------------------------------
+
+def _http(method, url, body=None, timeout=10):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as exc:
+        payload = exc.read()
+        try:
+            payload = json.loads(payload)
+        except ValueError:
+            payload = payload.decode('utf-8', 'replace')
+        return exc.code, payload
+
+
+def test_http_routes_and_readiness(tmp_path):
+    """Registered routes dispatch ahead of the built-ins (exact and
+    prefix keys, every method) and a readiness probe turns /healthz
+    into a 200/503 gate."""
+    from opencompass_tpu.obs.promexport import ObsHTTPServer
+    ready = {'ready': False}
+    calls = []
+
+    def echo(path, query, body):
+        calls.append((path, query, body))
+        return 201, {'path': path, 'body': body.decode() or None}
+
+    server = ObsHTTPServer(
+        str(tmp_path / 'obs'), port=0,
+        routes={('POST', '/v1/things'): echo,
+                ('GET', '/v1/things/'): echo,
+                ('DELETE', '/v1/things/'): echo},
+        readiness=lambda: dict(ready),
+        status_fn=lambda: {'overall': {},
+                           'serve': {'queue_depth': 3}})
+    port = server.start()
+    assert port
+    base = f'http://127.0.0.1:{port}'
+    try:
+        code, rep = _http('GET', base + '/healthz')
+        assert code == 503 and rep['ready'] is False
+        ready['ready'] = True
+        code, rep = _http('GET', base + '/healthz')
+        assert code == 200 and rep['ready'] is True
+
+        code, rep = _http('POST', base + '/v1/things', {'x': 1})
+        assert code == 201 and json.loads(rep['body']) == {'x': 1}
+        code, rep = _http('GET', base + '/v1/things/abc?full=1')
+        assert code == 201 and rep['path'] == '/v1/things/abc'
+        code, rep = _http('DELETE', base + '/v1/things/abc')
+        assert code == 201
+        # built-ins still answer; status_fn override feeds /status and
+        # the /metrics serve gauges
+        code, rep = _http('GET', base + '/status')
+        assert code == 200 and rep['serve']['queue_depth'] == 3
+        req = urllib.request.Request(base + '/metrics')
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            text = resp.read().decode()
+        assert 'oct_serve_queue_depth 3' in text
+        code, _ = _http('POST', base + '/nope', {})
+        assert code == 404
+    finally:
+        server.stop()
+
+
+def test_serve_route_handlers_validation(tmp_path):
+    """Control/data-plane handlers against a stub engine: request
+    validation, enqueue, cancel semantics, unknown model."""
+    from opencompass_tpu.serve.http import build_routes
+    from opencompass_tpu.serve.queue import SweepQueue
+
+    class StubEngine:
+        def __init__(self):
+            self.queue = SweepQueue(str(tmp_path / 'q'))
+
+        def models(self):
+            return ['fake-demo']
+
+        def sweep_status(self, sweep_id):
+            return self.queue.status(sweep_id)
+
+        def complete(self, model, prompts, max_out_len=16):
+            if model not in self.models():
+                raise KeyError(model)
+            return {'ok': True, 'completions': [f'echo:{p}'
+                                                for p in prompts],
+                    'store_hits': 0, 'device_rows': len(prompts),
+                    'built': False, 'prompt_tokens': 2,
+                    'completion_tokens': 2, 'elapsed_seconds': 0.01}
+
+    engine = StubEngine()
+    routes = build_routes(engine)
+    post = routes[('POST', '/v1/sweeps')]
+    get_one = routes[('GET', '/v1/sweeps/')]
+    delete = routes[('DELETE', '/v1/sweeps/')]
+    completions = routes[('POST', '/v1/completions')]
+
+    code, rep = post('/v1/sweeps', '', b'not json')
+    assert code == 400
+    code, rep = post('/v1/sweeps', '', b'{}')
+    assert code == 400
+    code, rep = post('/v1/sweeps', '',
+                     json.dumps({'config': 'models = []\n',
+                                 'mode': 'infer'}).encode())
+    assert code == 202 and rep['status'] == 'queued'
+    sid = rep['id']
+    code, rep = get_one(f'/v1/sweeps/{sid}', '', b'')
+    assert code == 200 and rep['status'] == 'queued'
+    code, rep = get_one('/v1/sweeps/sw-unknown', '', b'')
+    assert code == 404
+    code, rep = delete(f'/v1/sweeps/{sid}', '', b'')
+    assert code == 200 and rep['status'] == 'cancelled'
+    code, rep = delete(f'/v1/sweeps/{sid}', '', b'')
+    assert code == 409                      # already terminal
+
+    code, rep = completions('/v1/completions', '', b'{}')
+    assert code == 400
+    code, rep = completions(
+        '/v1/completions', '',
+        json.dumps({'model': 'nope', 'prompt': 'hi'}).encode())
+    assert code == 404 and rep['error']['type'] == 'model_not_found'
+    code, rep = completions(
+        '/v1/completions', '',
+        json.dumps({'model': 'fake-demo', 'prompt': 'hi',
+                    'max_tokens': 4}).encode())
+    assert code == 200
+    assert rep['object'] == 'text_completion'
+    assert rep['choices'][0]['text'] == 'echo:hi'
+    assert rep['usage']['total_tokens'] == 4
+    assert rep['oct']['device_rows'] == 1
+
+
+def test_sweep_task_status_slices_run_snapshot():
+    from opencompass_tpu.obs.live import sweep_task_status
+    snap = {'ts': 1.0, 'tasks': {
+        'OpenICLInfer[a]': {'state': 'ok', 'progress': 1.0,
+                            'rows_done': 4, 'rows_cached': 4},
+        'OpenICLInfer[b]': {'state': 'running', 'progress': 0.5,
+                            'rows_done': 2, 'rows_cached': 0},
+        'OpenICLInfer[other-sweep]': {'state': 'running',
+                                      'progress': 0.1},
+    }}
+    out = sweep_task_status(
+        snap, ['OpenICLInfer[a]', 'OpenICLInfer[b]',
+               'OpenICLInfer[pending]'])
+    assert set(out['tasks']) == {'OpenICLInfer[a]', 'OpenICLInfer[b]'}
+    assert out['missing'] == ['OpenICLInfer[pending]']
+    o = out['overall']
+    assert o['n_tasks'] == 2
+    assert o['progress'] == 0.75
+    assert o['ok'] == 1 and o['running'] == 1
+    # the other sweep's task must not leak into this sweep's fold
+    assert 'OpenICLInfer[other-sweep]' not in out['tasks']
+
+
+# -- worker lifecycle: idle TTL + SIGTERM drain (subprocess: slow) ---------
+
+def _worker_env(extra=None):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    env['PYTHONPATH'] = REPO + (
+        ':' + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+    env.update(extra or {})
+    return env
+
+
+@pytest.mark.slow
+def test_worker_idle_ttl_self_exit(tmp_path):
+    """A worker nobody talks to for OCT_WORKER_IDLE_TTL_S exits on its
+    own with code 0 — a leaked worker cannot hold chips forever."""
+    from opencompass_tpu.runners.worker import WorkerHandle
+    log = str(tmp_path / 'worker.log')
+    handle = WorkerHandle(_worker_env({'OCT_WORKER_IDLE_TTL_S': '1'}),
+                          log)
+    try:
+        assert handle.request({'cmd': 'ping'},
+                              timeout=30)['pong'] is True
+        handle.proc.wait(timeout=30)
+        assert handle.proc.returncode == 0
+        assert 'exiting (idle_ttl)' in open(log).read()
+    finally:
+        handle.kill()
+
+
+@pytest.mark.slow
+def test_worker_sigterm_graceful_drain(tmp_path):
+    """SIGTERM finishes the in-flight request (its response is still
+    delivered), then the worker exits 0 — the reaper can never lose
+    committed work."""
+    from opencompass_tpu.runners.worker import WorkerHandle
+    log = str(tmp_path / 'worker.log')
+    handle = WorkerHandle(_worker_env(), log)
+    try:
+        assert handle.request({'cmd': 'ping'},
+                              timeout=30)['pong'] is True
+        # in-flight request, then SIGTERM racing it: the drain contract
+        # says the response still arrives and exit is clean
+        from opencompass_tpu.runners.worker import read_frame, \
+            write_frame
+        write_frame(handle.proc.stdin,
+                    {'cmd': 'complete',
+                     'model_cfg': {'type': 'FakeModel', 'path': 'fake',
+                                   'max_seq_len': 128},
+                     'prompts': ['Q: hi\nA:'], 'max_out_len': 4})
+        time.sleep(0.2)
+        handle.proc.send_signal(signal.SIGTERM)
+        resp = read_frame(handle.proc.stdout.fileno(), timeout=60)
+        assert resp['ok'] is True and len(resp['completions']) == 1
+        handle.proc.wait(timeout=30)
+        assert handle.proc.returncode == 0
+        assert 'exiting (sigterm)' in open(log).read()
+    finally:
+        handle.kill()
+
+
+# -- daemon end-to-end (slow) ----------------------------------------------
+
+def _daemon_env(cache_root):
+    env = _worker_env({'OCT_CACHE_ROOT': str(cache_root)})
+    env.pop('OCT_TRACE_ID', None)
+    env.pop('OCT_OBS_DIR', None)
+    return env
+
+
+def _start_daemon(tmp_path, tag, extra_args=(), env_extra=None):
+    """`cli serve` subprocess; returns (proc, base_url, log_path)."""
+    log_path = str(tmp_path / f'daemon-{tag}.log')
+    log = open(log_path, 'w')
+    env = _daemon_env(tmp_path / 'cache')
+    env.update(env_extra or {})
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'serve',
+         DEMO_CFG, '--port', '0', '--idle-ttl', '300',
+         '--work-dir', str(tmp_path / 'out'), *extra_args],
+        stdout=log, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    deadline = time.time() + 120
+    port = None
+    while time.time() < deadline and port is None:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f'daemon died at startup:\n{open(log_path).read()}')
+        for line in open(log_path).read().splitlines():
+            if 'engine listening on http://127.0.0.1:' in line:
+                port = int(line.split('127.0.0.1:')[1].split()[0])
+                break
+        time.sleep(0.2)
+    assert port, f'no listen line:\n{open(log_path).read()}'
+    return proc, f'http://127.0.0.1:{port}', log_path
+
+
+def _wait_ready(base, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            code, rep = _http('GET', base + '/healthz')
+            if code == 200:
+                return rep
+        except (OSError, urllib.error.URLError):
+            pass
+        time.sleep(0.5)
+    raise AssertionError('daemon never became ready')
+
+
+def _wait_sweep(base, sweep_id, states=('done', 'failed'), timeout=180):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        code, rep = _http('GET', f'{base}/v1/sweeps/{sweep_id}')
+        if code == 200 and rep.get('status') in states:
+            return rep
+        time.sleep(0.5)
+    raise AssertionError(f'sweep {sweep_id} never reached {states}')
+
+
+def _store_rows(cache_root):
+    """Every (key, value) committed to the store's segment files, in
+    append order, torn final lines skipped."""
+    rows = []
+    store = osp.join(str(cache_root), 'store')
+    for dirpath, _, files in os.walk(store):
+        if osp.basename(dirpath) == 'units':
+            continue
+        for fname in sorted(files):
+            if not fname.endswith('.jsonl'):
+                continue
+            for line in open(osp.join(dirpath, fname), 'rb'):
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and 'k' in rec:
+                    rows.append((rec['k'], rec['v']))
+    return rows
+
+
+def _expected_fake_prediction(origin_prompt):
+    """FakeModel.generate, replicated: the bit-identity oracle."""
+    if 'A:' in origin_prompt:
+        return '101'
+    digest = hashlib.sha256(origin_prompt.encode()).hexdigest()[:8]
+    return f'fake-{digest}'
+
+
+@pytest.mark.slow
+def test_e2e_daemon_two_sweeps_one_build_and_interactive(tmp_path):
+    """The headline acceptance: one daemon serves two sweeps enqueued
+    back to back with exactly one model build total, answers an
+    interactive /v1/completions mid-sweep, honors cancel-while-queued,
+    and a repeated completion is a pure store hit."""
+    proc, base, log_path = _start_daemon(tmp_path, 'main')
+    try:
+        ready = _wait_ready(base)
+        assert ready['models'] == ['fake-demo']
+
+        code, s1 = _http('POST', base + '/v1/sweeps',
+                         {'config_path': DEMO_CFG, 'mode': 'infer'})
+        assert code == 202
+        code, s2 = _http('POST', base + '/v1/sweeps',
+                         {'config_path': DEMO_CFG, 'mode': 'infer',
+                          'label': 'second'})
+        assert code == 202
+        code, s3 = _http('POST', base + '/v1/sweeps',
+                         {'config_path': DEMO_CFG, 'mode': 'infer'})
+        assert code == 202
+        # cancel-while-queued: s3 sits behind two sweeps
+        code, rep = _http('DELETE', f'{base}/v1/sweeps/{s3["id"]}')
+        assert code == 200 and rep['status'] == 'cancelled'
+
+        # interactive completion while the first sweep runs
+        code, comp = _http('POST', base + '/v1/completions',
+                           {'model': 'fake-demo',
+                            'prompt': 'Q: interactive?\nA:',
+                            'max_tokens': 8}, timeout=120)
+        assert code == 200
+        assert comp['choices'][0]['text'] == '101'
+        assert comp['oct']['model_built'] is False   # warm fleet
+
+        rep1 = _wait_sweep(base, s1['id'])
+        assert rep1['status'] == 'done'
+        assert rep1['detail']['failed_tasks'] == 0
+        assert rep1['detail']['queue_wait_seconds'] is not None
+        rep2 = _wait_sweep(base, s2['id'])
+        assert rep2['status'] == 'done'
+        # the identical second sweep was served by the store: the
+        # partitioner pruned every task pre-launch
+        assert rep2['detail']['n_tasks'] == 0
+
+        code, snap = _http('GET', base + '/status')
+        assert code == 200
+        serve = snap['serve']
+        assert serve['sweeps_done'] == 2
+        assert serve['sweeps_cancelled'] == 1
+        assert serve['completions'] == 1
+        assert serve['workers_resident'] >= 1
+        assert serve['worker_reuses'] >= 1
+
+        # exactly ONE model build in the daemon's whole event stream:
+        # the warm-up built it; sweep tasks and the interactive request
+        # all reused the resident
+        events_path = osp.join(serve['run_dir'], 'obs', 'events.jsonl')
+        builds = reuses = 0
+        for line in open(events_path):
+            if '"worker_model_build"' in line:
+                builds += 1
+            elif '"worker_model_reuse"' in line:
+                reuses += 1
+        assert builds == 1, f'expected 1 model build, saw {builds}'
+        assert reuses >= 2
+
+        # repeated identical completion: zero device rows
+        code, comp2 = _http('POST', base + '/v1/completions',
+                            {'model': 'fake-demo',
+                             'prompt': 'Q: interactive?\nA:',
+                             'max_tokens': 8}, timeout=60)
+        assert code == 200
+        assert comp2['oct']['store_hits'] == 1
+        assert comp2['oct']['device_rows'] == 0
+
+        # graceful shutdown
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+@pytest.mark.slow
+def test_e2e_daemon_kill9_restart_resumes(tmp_path):
+    """SIGKILL the daemon mid-sweep; a restarted daemon re-claims the
+    sweep from the durable queue and converges bit-identically, with
+    the store recomputing only the rows the dead daemon never
+    committed (no key is ever committed twice)."""
+    # stretch the device phase (per-batch injected sleep) so "running
+    # with some rows committed, more to come" is a seconds-wide window
+    # instead of a race against FakeModel's instant batches
+    proc, base, log_path = _start_daemon(
+        tmp_path, 'first', env_extra={'OCT_DEBUG_BATCH_SLEEP_S': '0.75'})
+    sweep_id = None
+    worker_pids = []
+    try:
+        _wait_ready(base)
+        code, rep = _http('POST', base + '/v1/sweeps',
+                          {'config_path': DEMO_CFG, 'mode': 'infer'})
+        assert code == 202
+        sweep_id = rep['id']
+        # wait until the sweep is mid-flight with at least one row
+        # committed, then pull the plug
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            code, st = _http('GET', f'{base}/v1/sweeps/{sweep_id}')
+            if st.get('status') == 'running' \
+                    and len(_store_rows(tmp_path / 'cache')) >= 1:
+                code, snap = _http('GET', base + '/status')
+                worker_pids = [w['pid'] for w in
+                               snap['serve']['workers'].values()]
+                break
+            time.sleep(0.25)
+        else:
+            raise AssertionError('sweep never got mid-flight')
+    finally:
+        # kill -9 the daemon AND its resident fleet: an orphaned worker
+        # (own session) would otherwise drain the in-flight task on EOF
+        # and commit the remaining rows, leaving the restart nothing to
+        # recompute
+        os.kill(proc.pid, signal.SIGKILL)
+        for pid in worker_pids:
+            try:
+                os.killpg(pid, signal.SIGKILL)   # own session: pid==pgid
+            except (OSError, ProcessLookupError):
+                pass
+        proc.wait()
+
+    rows_before = _store_rows(tmp_path / 'cache')
+    assert rows_before, 'kill happened before any commit'
+    assert len(rows_before) < 32, 'sweep finished before the kill'
+    # belt and braces: wait for the store to go quiescent before the
+    # second daemon plans against it
+    stable = len(rows_before)
+    for _ in range(30):
+        time.sleep(1)
+        n = len(_store_rows(tmp_path / 'cache'))
+        if n == stable:
+            break
+        stable = n
+    rows_before = _store_rows(tmp_path / 'cache')
+
+    proc2, base2, log2 = _start_daemon(tmp_path, 'second')
+    try:
+        rep = _wait_sweep(base2, sweep_id)
+        assert rep['status'] == 'done', open(log2).read()[-2000:]
+        rows_after = _store_rows(tmp_path / 'cache')
+        keys = [k for k, _ in rows_after]
+        # zero duplicate device work: append-only store, every key once
+        assert len(keys) == len(set(keys))
+        assert len(rows_after) >= len(rows_before)
+        # first-daemon rows survived untouched (prefix property)
+        assert rows_after[:len(rows_before)] == rows_before \
+            or set(dict(rows_before)) <= set(dict(rows_after))
+
+        # bit-identical convergence: every prediction matches the
+        # FakeModel oracle recomputed from its own origin prompt
+        code, st = _http('GET', f'{base2}/v1/sweeps/{sweep_id}')
+        pred_dir = osp.join(st['detail']['work_dir'], 'predictions',
+                            'fake-demo')
+        pred_files = sorted(os.listdir(pred_dir))
+        assert 'demo-gen.json' in pred_files
+        gen = json.load(open(osp.join(pred_dir, 'demo-gen.json')))
+        assert len(gen) == 16
+        for row in gen.values():
+            assert row['prediction'] == \
+                _expected_fake_prediction(row['origin_prompt'])
+        proc2.send_signal(signal.SIGTERM)
+        assert proc2.wait(timeout=60) == 0
+    finally:
+        if proc2.poll() is None:
+            proc2.kill()
+            proc2.wait()
