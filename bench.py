@@ -664,6 +664,18 @@ def _bench_serve(out_json='BENCH_SERVE.json'):
                          'prompt': 'Q: serve bench?\nA:',
                          'max_tokens': 8})
         cached_ms = (time.perf_counter() - t1) * 1e3
+        # rolling-window serving SLO: a small completion burst (varied
+        # prompts — first pass costs device rows, repeats are store
+        # hits), then the engine's own /v1/stats summarizes latency
+        # percentiles + TTFT over the window
+        for i in range(12):
+            http('POST', base + '/v1/completions',
+                 {'model': 'fake-demo',
+                  'prompt': f'Q: slo probe {i % 6}?\nA:',
+                  'max_tokens': 8})
+        _, stats = http('GET', base + '/v1/stats?window=300')
+        slo = (stats.get('completions') or {}).get(
+            'per_model', {}).get('fake-demo') or {}
         _, snap = http('GET', base + '/status')
         serve = snap['serve']
     finally:
@@ -695,6 +707,14 @@ def _bench_serve(out_json='BENCH_SERVE.json'):
         'interactive_model_built': comp.get('oct', {}).get('model_built'),
         'cached_store_hits': comp2.get('oct', {}).get('store_hits'),
         'cached_device_rows': comp2.get('oct', {}).get('device_rows'),
+        # /v1/stats rolling-window SLO over the burst (12 requests):
+        # the serving-latency series `ledger check --trajectory` gates
+        'completion_count': slo.get('count'),
+        'completion_p50_ms': slo.get('p50_ms'),
+        'completion_p99_ms': slo.get('p99_ms'),
+        # TTFT estimate (device rows only); null on the FakeModel
+        # bench, populated on real JaxLM-served fleets
+        'ttft_p95_ms': slo.get('ttft_p95_ms'),
         'worker_spawns': serve.get('worker_spawns'),
         'worker_reuses': serve.get('worker_reuses'),
         'drain_exit_code': proc.returncode,
@@ -710,6 +730,13 @@ def _bench_serve(out_json='BENCH_SERVE.json'):
         detail={'warm_n_tasks': record['warm_n_tasks'],
                 'worker_reuses': record['worker_reuses'],
                 'queue_wait_seconds': record['queue_wait_seconds']})
+    if record.get('completion_p99_ms') is not None:
+        _append_trajectory(
+            'serve', 'completion_p99_ms', record['completion_p99_ms'],
+            'ms', direction='lower',
+            detail={'completion_p50_ms': record['completion_p50_ms'],
+                    'ttft_p95_ms': record['ttft_p95_ms'],
+                    'completion_count': record['completion_count']})
     return record
 
 

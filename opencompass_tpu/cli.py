@@ -29,6 +29,9 @@ Usage::
     python -m opencompass_tpu.cli serve cfg.py --port 8000  # engine daemon
                     # durable sweep queue + resident worker fleet +
                     # OpenAI-compatible /v1/completions (docs/serving.md)
+    python -m opencompass_tpu.cli top CACHE_ROOT    # live serve dashboard
+                    # fleet table + queue + rolling p99/TTFT sparklines
+                    # from {cache_root}/serve/obs/ files + /v1/stats
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files
@@ -275,6 +278,18 @@ def ledger_main(argv=None) -> int:
     return ledger_cli_main(argv)
 
 
+def top_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli top <cache_root>`` — live fleet
+    dashboard for the serve daemon: resident workers (pid, model,
+    in-flight request ids, utilization), queue depth/age, and rolling
+    completions/sec + p99 + TTFT with sparklines.  Rendered from
+    ``{cache_root}/serve/obs/`` files joined with the live engine's
+    ``GET /v1/stats``; against a dead daemon it renders the last known
+    picture once and exits cleanly."""
+    from opencompass_tpu.serve.top import main as serve_top_main
+    return serve_top_main(argv)
+
+
 def serve_main(argv=None) -> int:
     """``python -m opencompass_tpu.cli serve <config> [--port N]`` —
     the persistent evaluation engine: durable FIFO sweep queue under
@@ -292,6 +307,8 @@ def main():
     # take a work_dir, not a config file
     if len(sys.argv) > 1 and sys.argv[1] == 'serve':
         raise SystemExit(serve_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == 'top':
+        raise SystemExit(top_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'trace':
         raise SystemExit(trace_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'status':

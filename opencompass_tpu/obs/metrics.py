@@ -13,6 +13,7 @@ from different processes merge by plain elementwise addition.
 """
 from __future__ import annotations
 
+import re
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -21,6 +22,40 @@ from typing import Dict, List, Optional, Sequence
 # tunnels — the top buckets must keep resolution there).
 LATENCY_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                      5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1200.0)
+
+
+def labeled(name: str, **labels) -> str:
+    """Encode Prometheus-style labels into a registry instrument name.
+
+    The registry itself is a flat ``name -> instrument`` namespace;
+    labeled families (``oct_http_requests_total{route,code}``) are
+    spelled as ``name#k=v#k2=v2`` with sorted keys, so each label
+    combination is its own instrument and snapshots still merge by
+    plain name equality.  ``promexport.render_prometheus`` splits the
+    encoding back into a label set at exposition time.  Label values
+    are sanitized (``#``/``=``/newline → ``_``) so the encoding always
+    round-trips; keep cardinality bounded (routes, status codes, model
+    abbrs — never request ids)."""
+    if not labels:
+        return name
+    parts = []
+    for key in sorted(labels):
+        value = re.sub(r'[#=\n]', '_', str(labels[key]))
+        parts.append(f'{key}={value}')
+    return name + '#' + '#'.join(parts)
+
+
+def split_labeled(name: str):
+    """Inverse of :func:`labeled`: ``(base_name, labels-or-None)``."""
+    base, sep, tail = name.partition('#')
+    if not sep:
+        return name, None
+    labels = {}
+    for part in tail.split('#'):
+        key, eq, value = part.partition('=')
+        if eq:
+            labels[key] = value
+    return base, labels or None
 
 
 class Counter:

@@ -84,44 +84,71 @@ def _line(name: str, value, labels: Optional[Dict] = None) -> str:
     return f'{name} {_fmt_number(value)}'
 
 
+def _family_items(table: Dict, prefix: str, suffix: str = ''):
+    """Registry names (possibly label-encoded, ``metrics.labeled``) →
+    ``(metric_family, labels, value)`` sorted so one family's series
+    stay contiguous (a ``# TYPE`` line is emitted once per family)."""
+    from opencompass_tpu.obs.metrics import split_labeled
+    items = []
+    for name in table:
+        base, labels = split_labeled(name)
+        metric = f'{prefix}_{sanitize_metric_name(base)}{suffix}'
+        items.append((metric, labels, table[name]))
+    return sorted(items, key=lambda t: (t[0], sorted((t[1] or {})
+                                                     .items())))
+
+
 def render_prometheus(metrics_snapshot: Optional[Dict] = None,
                       status: Optional[Dict] = None,
                       prefix: str = 'oct') -> str:
     """Prometheus text format from a registry snapshot
-    (``{counters, gauges, histograms}``) + run-status task gauges."""
+    (``{counters, gauges, histograms}``) + run-status task gauges.
+    Registry names carrying encoded labels (``metrics.labeled`` —
+    ``http.requests#code=200#route=/healthz``) render as one family
+    with a label set per series."""
     out: List[str] = []
     snap = metrics_snapshot or {}
 
-    for name in sorted(snap.get('counters') or {}):
-        metric = f'{prefix}_{sanitize_metric_name(name)}_total'
-        out.append(f'# TYPE {metric} counter')
-        out.append(_line(metric, snap['counters'][name]))
+    last = None
+    for metric, labels, value in _family_items(
+            snap.get('counters') or {}, prefix, '_total'):
+        if metric != last:
+            out.append(f'# TYPE {metric} counter')
+            last = metric
+        out.append(_line(metric, value, labels))
 
-    for name in sorted(snap.get('gauges') or {}):
-        g = snap['gauges'][name]
-        metric = f'{prefix}_{sanitize_metric_name(name)}'
+    last = last_max = None
+    for metric, labels, g in _family_items(
+            snap.get('gauges') or {}, prefix):
         if g.get('value') is not None:
-            out.append(f'# TYPE {metric} gauge')
-            out.append(_line(metric, g['value']))
+            if metric != last:
+                out.append(f'# TYPE {metric} gauge')
+                last = metric
+            out.append(_line(metric, g['value'], labels))
         if g.get('max') is not None:
-            out.append(f'# TYPE {metric}_max gauge')
-            out.append(_line(f'{metric}_max', g['max']))
+            if metric != last_max:
+                out.append(f'# TYPE {metric}_max gauge')
+                last_max = metric
+            out.append(_line(f'{metric}_max', g['max'], labels))
 
-    for name in sorted(snap.get('histograms') or {}):
-        h = snap['histograms'][name]
-        metric = f'{prefix}_{sanitize_metric_name(name)}'
-        out.append(f'# TYPE {metric} histogram')
+    last = None
+    for metric, labels, h in _family_items(
+            snap.get('histograms') or {}, prefix):
+        if metric != last:
+            out.append(f'# TYPE {metric} histogram')
+            last = metric
         # registry counts are per-bucket; the text format wants
         # cumulative counts per upper bound, ending at le="+Inf"==count
         cum = 0
         for ub, c in zip(h.get('buckets') or [], h.get('counts') or []):
             cum += c
             out.append(_line(f'{metric}_bucket', cum,
-                             {'le': _fmt_number(float(ub))}))
+                             dict(labels or {},
+                                  le=_fmt_number(float(ub)))))
         out.append(_line(f'{metric}_bucket', h.get('count', cum),
-                         {'le': '+Inf'}))
-        out.append(_line(f'{metric}_sum', h.get('sum', 0)))
-        out.append(_line(f'{metric}_count', h.get('count', 0)))
+                         dict(labels or {}, le='+Inf')))
+        out.append(_line(f'{metric}_sum', h.get('sum', 0), labels))
+        out.append(_line(f'{metric}_count', h.get('count', 0), labels))
 
     if status:
         out.extend(_render_status_gauges(status, prefix))
@@ -155,11 +182,31 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
     # serve-plane gauges (engine daemons fold these into their status
     # snapshot): queue pressure + resident-fleet state
     serve = status.get('serve') or {}
-    for key in ('queue_depth', 'sweeps_running', 'sweeps_done',
+    for key in ('queue_depth', 'queue_oldest_age_seconds',
+                'sweeps_running', 'sweeps_done',
                 'sweeps_failed', 'workers_resident', 'workers_in_use'):
         if serve.get(key) is not None:
             out.append(f'# TYPE {prefix}_serve_{key} gauge')
             out.append(_line(f'{prefix}_serve_{key}', serve[key]))
+    # per-worker fleet gauges, rendered from the live snapshot's worker
+    # table (no stale series survive a reap — the table IS the fleet)
+    workers = serve.get('workers') or {}
+    for metric_suffix, field in (('serve_worker_in_flight', 'in_use'),
+                                 ('serve_worker_utilization',
+                                  'utilization')):
+        lines = []
+        for key in sorted(workers):
+            value = workers[key].get(field)
+            if value is not None:
+                labels = {'worker': key[:16]}
+                model = workers[key].get('model')
+                if model:
+                    labels['model'] = model
+                lines.append(_line(f'{prefix}_{metric_suffix}', value,
+                                   labels))
+        if lines:
+            out.append(f'# TYPE {prefix}_{metric_suffix} gauge')
+            out.extend(lines)
 
     tasks = status.get('tasks') or {}
     per_task = [
@@ -203,32 +250,79 @@ class ObsHTTPServer:
         status_fn: optional zero-arg snapshot provider for ``/status``
             and the ``/metrics`` status gauges (default:
             ``current_status(obs_dir)``).
+        access_log: optional callback receiving one dict per completed
+            HTTP request (method, path, status, latency_ms,
+            request_id, handler annotations) — the serve daemon wires
+            its JSONL access log + rolling SLO window here.
+
+    Every request is stamped with a request id (inbound
+    ``X-OCT-Request-Id`` honored, minted otherwise, always echoed on
+    the response) and counted in the dispatch guard —
+    ``http.requests{route,code}`` and a per-route latency histogram
+    land in ``registry`` for *every* route, built-ins and error paths
+    included, so 4xx/5xx rates are visible on ``/metrics`` without any
+    handler cooperation.
     """
 
     def __init__(self, obs_dir: str, port: int = 0, registry=None,
                  routes: Optional[Dict] = None, readiness=None,
-                 status_fn=None):
+                 status_fn=None, access_log=None):
         self.obs_dir = obs_dir
         self.requested_port = port
         self.registry = registry
         self.routes = dict(routes or {})
         self.readiness = readiness
         self.status_fn = status_fn
+        self.access_log = access_log
         self.port: Optional[int] = None
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
 
     def _route_for(self, method: str, path: str):
+        """``(handler-or-None, route_label)`` — the label is the
+        *registered* pattern (or the built-in path), never the raw
+        request path, so metric cardinality stays bounded."""
         handler = self.routes.get((method, path))
         if handler is not None:
-            return handler
+            return handler, path
         best = None
         for (m, prefix), fn in self.routes.items():
             if m == method and prefix.endswith('/') \
                     and path.startswith(prefix):
                 if best is None or len(prefix) > len(best[0]):
                     best = (prefix, fn)
-        return best[1] if best else None
+        if best is not None:
+            return best[1], best[0]
+        if path in ('/healthz', '/status', '/metrics'):
+            return None, path
+        return None, 'other'
+
+    def _observe_request(self, method: str, path: str, route: str,
+                         status: Optional[int], latency_s: float,
+                         request_id: str, annotations: Optional[Dict]):
+        """Dispatch-guard accounting: never fails, never raises."""
+        status = int(status) if status is not None else 599
+        try:
+            if self.registry is not None:
+                from opencompass_tpu.obs.metrics import labeled
+                self.registry.counter(labeled(
+                    'http.requests', route=route, code=status)).inc()
+                self.registry.histogram(labeled(
+                    'http.request_seconds',
+                    route=route)).observe(latency_s)
+        except Exception:
+            pass
+        try:
+            if self.access_log is not None:
+                rec = {'ts': round(time.time(), 3), 'method': method,
+                       'path': path, 'route': route, 'status': status,
+                       'latency_ms': round(latency_s * 1e3, 3),
+                       'request_id': request_id}
+                if annotations:
+                    rec.update(annotations)
+                self.access_log(rec)
+        except Exception:
+            pass
 
     def _current_status(self):
         if self.status_fn is not None:
@@ -245,14 +339,22 @@ class ObsHTTPServer:
 
             class Handler(BaseHTTPRequestHandler):
 
+                _rid: Optional[str] = None
+                _code: Optional[int] = None
+
                 def log_message(self, fmt, *args):  # no stderr chatter
                     pass
 
                 def _send(self, code: int, content_type: str,
                           body: bytes):
+                    self._code = code
                     self.send_response(code)
                     self.send_header('Content-Type', content_type)
                     self.send_header('Content-Length', str(len(body)))
+                    if self._rid:
+                        from opencompass_tpu.obs.reqtrace import \
+                            REQUEST_ID_HEADER
+                        self.send_header(REQUEST_ID_HEADER, self._rid)
                     self.end_headers()
                     self.wfile.write(body)
 
@@ -277,17 +379,28 @@ class ObsHTTPServer:
                 def _dispatch(self, method: str):
                     """Registered routes first (the serve daemon's API),
                     then the built-ins; every handler exception becomes
-                    a 500 — the server itself never dies."""
+                    a 500 — the server itself never dies.  The guard
+                    owns request-scoped telemetry: id stamping, the
+                    ``http.requests{route,code}`` counter, per-route
+                    latency, and the access-log line — every path
+                    through here is counted, 404s and 500s included."""
+                    from opencompass_tpu.obs import reqtrace
+                    t0 = time.perf_counter()
+                    path, _, query = self.path.partition('?')
+                    self._rid = reqtrace.normalize_request_id(
+                        self.headers.get(reqtrace.REQUEST_ID_HEADER)) \
+                        or reqtrace.mint_request_id()
+                    self._code = None
+                    token, ctx = reqtrace.begin_request(
+                        self._rid, method, path)
+                    handler, route = server._route_for(method, path)
                     try:
-                        path, _, query = self.path.partition('?')
-                        handler = server._route_for(method, path)
                         if handler is not None:
                             body = self._body() \
                                 if method in ('POST', 'PUT') else b''
                             code, payload = handler(path, query, body)
                             self._send_payload(code, payload)
-                            return
-                        if method != 'GET':
+                        elif method != 'GET':
                             self._send_payload(404, 'not found\n')
                         elif path == '/healthz':
                             self._do_healthz()
@@ -315,6 +428,14 @@ class ObsHTTPServer:
                                            'type': 'server_error'}})
                         except Exception:
                             pass
+                        if self._code is None:
+                            self._code = 500
+                    finally:
+                        reqtrace.end_request(token)
+                        server._observe_request(
+                            method, path, route, self._code,
+                            time.perf_counter() - t0, self._rid,
+                            ctx.annotations)
 
                 def _do_healthz(self):
                     """Plain liveness without a probe; with one, a

@@ -1,0 +1,405 @@
+"""Request-scoped serving telemetry: ids, span records, SLO windows.
+
+The obs stack before this module observes *sweeps* — spans, heartbeats
+and flight-recorder timelines are keyed by ``work_dir`` and die with
+the run.  The serve daemon (``serve/``) is a long-lived engine
+answering interactive traffic, and its unit of observation is the
+**request**: this module gives every HTTP request an id, one durable
+span-tree record, an access-log line, and a seat in the rolling SLO
+window ``GET /v1/stats`` summarizes.
+
+Three artifacts, all under ``{cache_root}/serve/obs/`` (pre-timestamp,
+like the queue and the store, so they survive daemon restarts and a
+``cli top`` pointed at the cache root finds them with no server):
+
+- ``requests.jsonl`` — one span-tree record per ``/v1/completions``
+  request (:class:`RequestRecorder`): request id, response ``cmpl-``
+  id, model, status, wall seconds, and the **phase breakdown** that
+  matters for serving — parse, chip/lease wait, worker protocol
+  overhead, model build, store lookup, model forward (with
+  prefill/decode token counts from the model's ``_tl_track``
+  plumbing), store commit — laid out as non-overlapping children of
+  the request span (``start_s`` offsets + ``dur_s``).
+- ``access.jsonl`` — one line per HTTP request on any route
+  (:class:`AccessLog`): method, path, status, latency, request id,
+  and whatever the handler annotated (model, sweep id).
+- ``engine.json`` — the live engine's discovery record (port, pid,
+  run dir) so ``cli top`` can join files with ``/v1/stats``; removed
+  on clean shutdown, ignored when the pid is dead.
+
+Write discipline is the result store's verbatim: every record is one
+``os.write`` on an ``O_APPEND`` fd (``utils.fileio``), concurrent
+writers interleave at record granularity, ``kill -9`` tears at most
+the final line and readers skip it.  Contract identical to the tracer:
+request telemetry must **never fail a request** — every sink write is
+exception-guarded.
+
+Request ids travel in the ``X-OCT-Request-Id`` header: honored inbound
+(so a client or a fronting proxy can stamp its own), minted otherwise,
+always echoed on the response — a client-reported slow request is
+greppable end to end across the access log, ``requests.jsonl``, and
+the engine's event stream.
+"""
+from __future__ import annotations
+
+import contextvars
+import json
+import math
+import os
+import os.path as osp
+import re
+import secrets
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from opencompass_tpu.utils.fileio import (append_jsonl_atomic,
+                                          iter_jsonl_records)
+
+REQTRACE_VERSION = 1
+REQUEST_ID_HEADER = 'X-OCT-Request-Id'
+SERVE_OBS_SUBDIR = osp.join('serve', 'obs')
+REQUESTS_FILE = 'requests.jsonl'
+ACCESS_FILE = 'access.jsonl'
+ENGINE_INFO_FILE = 'engine.json'
+
+_ID_RE = re.compile(r'^[A-Za-z0-9._\-]{1,128}$')
+
+
+def serve_obs_dir(cache_root: str) -> str:
+    return osp.join(cache_root, SERVE_OBS_SUBDIR)
+
+
+def mint_request_id() -> str:
+    return 'req-' + secrets.token_hex(8)
+
+
+def normalize_request_id(raw: Optional[str]) -> Optional[str]:
+    """An inbound header value, validated — None when absent or
+    unusable (wrong charset / oversized), so the caller mints instead.
+    Bounded charset keeps ids safe in filenames, label values, and
+    grep."""
+    if not raw:
+        return None
+    raw = raw.strip()
+    return raw if _ID_RE.match(raw) else None
+
+
+# -- per-request context (HTTP dispatch ↔ handler hand-off) ----------------
+
+class RequestContext:
+    """What the HTTP dispatch guard knows about the in-flight request,
+    visible to handlers via :func:`current` without widening the
+    ``fn(path, query, body)`` route contract.  ``annotations`` is the
+    handler's channel back to the access log (model, sweep id)."""
+
+    __slots__ = ('request_id', 'method', 'path', 't0', 'annotations')
+
+    def __init__(self, request_id: str, method: str, path: str):
+        self.request_id = request_id
+        self.method = method
+        self.path = path
+        self.t0 = time.perf_counter()
+        self.annotations: Dict = {}
+
+
+_CURRENT_REQUEST: contextvars.ContextVar = contextvars.ContextVar(
+    'oct_current_request', default=None)
+
+
+def begin_request(request_id: str, method: str, path: str):
+    """Install the request context for this thread; returns the token
+    for :func:`end_request`."""
+    ctx = RequestContext(request_id, method, path)
+    return _CURRENT_REQUEST.set(ctx), ctx
+
+
+def end_request(token):
+    try:
+        _CURRENT_REQUEST.reset(token)
+    except ValueError:
+        pass
+
+
+def current() -> Optional[RequestContext]:
+    return _CURRENT_REQUEST.get()
+
+
+def current_request_id() -> Optional[str]:
+    ctx = _CURRENT_REQUEST.get()
+    return ctx.request_id if ctx is not None else None
+
+
+def annotate(**fields):
+    """Handler-side: attach labels (``model=``, ``sweep=``) that ride
+    on this request's access-log line.  No-op outside a request."""
+    ctx = _CURRENT_REQUEST.get()
+    if ctx is not None:
+        ctx.annotations.update(
+            {k: v for k, v in fields.items() if v is not None})
+
+
+# -- span-tree records ------------------------------------------------------
+
+def phases_to_spans(phases: Sequence[Tuple[str, float]],
+                    start: float = 0.0) -> List[Dict]:
+    """Sequential ``(name, dur_s)`` pairs → non-overlapping child
+    spans with cumulative ``start_s`` offsets.  Zero/negative
+    durations are kept at 0 so the layout stays monotonic under clock
+    jitter."""
+    out = []
+    t = float(start)
+    for name, dur in phases:
+        dur = max(float(dur or 0.0), 0.0)
+        out.append({'name': name, 'start_s': round(t, 6),
+                    'dur_s': round(dur, 6)})
+        t += dur
+    return out
+
+
+class RequestRecorder:
+    """Appends one span-tree record per request to
+    ``{serve_obs_dir}/requests.jsonl`` (never raises)."""
+
+    def __init__(self, obs_root: str):
+        self.path = osp.join(obs_root, REQUESTS_FILE)
+
+    def record(self, rec: Dict):
+        try:
+            append_jsonl_atomic(self.path,
+                                [{'v': REQTRACE_VERSION, **rec}])
+        except Exception:
+            pass
+
+
+def iter_requests(path: str):
+    """Parseable request records; torn/garbage lines skipped (store
+    recovery contract)."""
+    return iter_jsonl_records(
+        path, keep=lambda r: r.get('v') == REQTRACE_VERSION
+        and 'wall_s' in r)
+
+
+def tail_requests(path: str, max_bytes: int = 262144,
+                  window_s: Optional[float] = None,
+                  now: Optional[float] = None) -> List[Dict]:
+    """The newest request records, reading only the file tail — a
+    long-lived engine's requests.jsonl grows without bound and ``cli
+    top`` re-reads it every frame.  Seeks ``max_bytes`` from EOF and
+    drops the first (possibly partial) line unless the read started at
+    offset 0."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return []
+    try:
+        with open(path, 'rb') as f:
+            offset = max(size - max_bytes, 0)
+            f.seek(offset)
+            data = f.read()
+    except OSError:
+        return []
+    lines = data.split(b'\n')
+    if offset > 0 and lines:
+        lines = lines[1:]
+    out: List[Dict] = []
+    cutoff = None
+    if window_s is not None:
+        cutoff = (now if now is not None else time.time()) - window_s
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict) or 'wall_s' not in rec:
+            continue
+        if cutoff is not None and (rec.get('ts') or 0) < cutoff:
+            continue
+        out.append(rec)
+    return out
+
+
+# -- access log -------------------------------------------------------------
+
+class AccessLog:
+    """One JSONL line per HTTP request:
+    ``{"v":1,"ts":...,"method":...,"path":...,"status":...,
+    "latency_ms":...,"request_id":...}`` plus handler annotations
+    (``model``, ``sweep``).  Never raises."""
+
+    def __init__(self, obs_root: str):
+        self.path = osp.join(obs_root, ACCESS_FILE)
+
+    def write(self, rec: Dict):
+        try:
+            append_jsonl_atomic(self.path,
+                                [{'v': REQTRACE_VERSION, **rec}])
+        except Exception:
+            pass
+
+
+# -- rolling SLO window -----------------------------------------------------
+
+def percentile(values: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile (q in (0, 1]): deterministic, no
+    interpolation — p99 of 100 samples is the 99th sorted value."""
+    if not values:
+        return None
+    ordered = sorted(values)
+    rank = max(int(math.ceil(q * len(ordered))), 1)
+    return ordered[min(rank, len(ordered)) - 1]
+
+
+class RollingStats:
+    """Bounded in-memory sample windows behind ``GET /v1/stats``.
+
+    Two streams: every HTTP request (route, status, latency — fed by
+    the server's dispatch guard via the access-log hook) and every
+    completion (model, latency, TTFT, store/device row split — fed by
+    ``EvalEngine.complete``).  ``summary(window_s)`` folds the samples
+    newer than the window into per-route / per-model latency
+    percentiles, error counts by route×code, and completions/sec.
+    Deques are bounded so a month-old daemon holds minutes, not
+    months, of samples; the durable history is requests.jsonl."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._lock = threading.Lock()
+        self._http: deque = deque(maxlen=maxlen)
+        self._completions: deque = deque(maxlen=maxlen)
+
+    def record_http(self, route: str, status: int, latency_s: float,
+                    ts: Optional[float] = None):
+        try:
+            with self._lock:
+                self._http.append((ts if ts is not None else time.time(),
+                                   route, int(status), float(latency_s)))
+        except Exception:
+            pass
+
+    def record_completion(self, model: str, latency_s: float,
+                          ttft_s: Optional[float] = None,
+                          ok: bool = True, store_hits: int = 0,
+                          device_rows: int = 0,
+                          ts: Optional[float] = None):
+        try:
+            with self._lock:
+                self._completions.append(
+                    (ts if ts is not None else time.time(), str(model),
+                     float(latency_s),
+                     float(ttft_s) if ttft_s is not None else None,
+                     bool(ok), int(store_hits), int(device_rows)))
+        except Exception:
+            pass
+
+    @staticmethod
+    def _latency_summary(lat_s: List[float]) -> Dict:
+        return {
+            'count': len(lat_s),
+            'p50_ms': round(percentile(lat_s, 0.50) * 1e3, 3),
+            'p95_ms': round(percentile(lat_s, 0.95) * 1e3, 3),
+            'p99_ms': round(percentile(lat_s, 0.99) * 1e3, 3),
+        }
+
+    def summary(self, window_s: float = 300.0,
+                now: Optional[float] = None) -> Dict:
+        now = time.time() if now is None else now
+        cutoff = now - window_s
+        with self._lock:
+            http = [s for s in self._http if s[0] >= cutoff]
+            comps = [s for s in self._completions if s[0] >= cutoff]
+
+        per_route: Dict[str, Dict] = {}
+        errors: Dict[str, Dict[str, int]] = {}
+        for ts, route, status, lat in http:
+            per_route.setdefault(route, []).append((status, lat))
+            if status >= 400:
+                by_code = errors.setdefault(route, {})
+                by_code[str(status)] = by_code.get(str(status), 0) + 1
+        routes = {}
+        for route, samples in sorted(per_route.items()):
+            lat_s = [lat for _, lat in samples]
+            routes[route] = dict(
+                self._latency_summary(lat_s),
+                errors=sum(1 for status, _ in samples if status >= 400))
+
+        per_model: Dict[str, List] = {}
+        for sample in comps:
+            per_model.setdefault(sample[1], []).append(sample)
+        models = {}
+        for model, samples in sorted(per_model.items()):
+            lat_s = [s[2] for s in samples]
+            ttfts = [s[3] for s in samples if s[3] is not None]
+            row = self._latency_summary(lat_s)
+            row['errors'] = sum(1 for s in samples if not s[4])
+            row['store_hits'] = sum(s[5] for s in samples)
+            row['device_rows'] = sum(s[6] for s in samples)
+            if ttfts:
+                row['ttft_p50_ms'] = round(
+                    percentile(ttfts, 0.50) * 1e3, 3)
+                row['ttft_p95_ms'] = round(
+                    percentile(ttfts, 0.95) * 1e3, 3)
+            models[model] = row
+
+        comp_lat = [s[2] for s in comps]
+        completions = {
+            'count': len(comps),
+            'per_sec': round(len(comps) / window_s, 4),
+            'per_model': models,
+        }
+        if comp_lat:
+            completions.update(self._latency_summary(comp_lat))
+        return {
+            'window_seconds': window_s,
+            'ts': round(now, 3),
+            'http': {'count': len(http), 'per_route': routes,
+                     'errors': errors},
+            'completions': completions,
+        }
+
+
+# -- engine discovery (`cli top`) ------------------------------------------
+
+def write_engine_info(obs_root: str, port: int, run_dir: str):
+    """Advertise the live engine under the cache root (atomic; never
+    raises) — how ``cli top <cache_root>`` finds ``/v1/stats``."""
+    try:
+        from opencompass_tpu.utils.fileio import atomic_write_json
+        atomic_write_json(
+            osp.join(obs_root, ENGINE_INFO_FILE),
+            {'v': REQTRACE_VERSION, 'port': port, 'pid': os.getpid(),
+             'run_dir': run_dir, 'ts': round(time.time(), 3)})
+    except Exception:
+        pass
+
+
+def clear_engine_info(obs_root: str, pid: Optional[int] = None):
+    """Remove the advertisement — but with ``pid``, only when the
+    record is still *ours*: racing daemons share one cache root
+    (claim-file arbitration), and a stopping daemon must not tear down
+    a surviving sibling's discovery record."""
+    path = osp.join(obs_root, ENGINE_INFO_FILE)
+    try:
+        if pid is not None:
+            rec = read_engine_info(obs_root)
+            if rec is not None and rec.get('pid') != pid:
+                return
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def read_engine_info(obs_root: str) -> Optional[Dict]:
+    """The advertised engine record, or None when absent/unparsable.
+    Liveness is the *caller's* judgment (``pid`` + an HTTP probe): a
+    kill -9'd daemon leaves a stale record behind."""
+    try:
+        with open(osp.join(obs_root, ENGINE_INFO_FILE),
+                  encoding='utf-8') as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) and rec.get('port') else None
+    except (OSError, ValueError):
+        return None

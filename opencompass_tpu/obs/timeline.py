@@ -146,6 +146,18 @@ class Timeline:
             pass
 
 
+class TrackOnlyTimeline(NoopTimeline):
+    """``enabled`` without a sink: makes ``BaseModel._tl_track``
+    collect per-call dispatch/fetch splits for callers that drain the
+    model's call queue directly instead of recording batches — the
+    worker's interactive ``complete`` path, which attributes the
+    splits to a *request* record rather than a task timeline."""
+
+    enabled = True
+
+
+TRACK_ONLY = TrackOnlyTimeline()
+
 _NOOP_TIMELINE = NoopTimeline()
 _TIMELINE = _NOOP_TIMELINE
 

@@ -21,14 +21,16 @@ Requests::
      "cfg_path": "/tmp/...py", "name": "<task name>",
      "log_path": "<per-task log>"}
     {"cmd": "complete", "model_cfg": {...}, "prompts": ["..."],
-     "max_out_len": 16}
+     "max_out_len": 16, "request_id": "req-..."}
     {"cmd": "ping"}
     {"cmd": "shutdown"}
 
 Responses::
 
     {"ok": true, "returncode": 0, "warmed": <shapes precompiled>}
-    {"ok": true, "completions": [...], "store_hits": n, ...}
+    {"ok": true, "completions": [...], "store_hits": n,
+     "phases": {build/lookup/forward/commit seconds}, "ttft_s": ...,
+     "prefill_tokens": n, "decode_tokens": n, ...}
     {"ok": false, "error": "<traceback tail>", "returncode": 1}
 
 ``complete`` is the serving data plane (serve/daemon.py): generate
@@ -390,14 +392,38 @@ def _handle_run(msg: Dict) -> Dict:
     return resp
 
 
+def _collect_tracked_calls(model) -> List[Dict]:
+    """Drain the device calls ``model.generate`` just pushed through
+    ``_tl_track`` (dispatch/fetch wall split, prefill/decode token
+    split).  The worker serializes requests, so everything pending
+    belongs to the call that just returned.  Never raises."""
+    try:
+        return model.pop_batch_calls(len(model._tl_pending))
+    except Exception:
+        return []
+
+
 def _handle_complete(msg: Dict) -> Dict:
     """Interactive generation on the resident model (the engine's
     ``/v1/completions`` data plane).  Rows are keyed exactly like the
     gen inferencer's store rows — namespace (model identity, 'gen',
     {max_out_len, generation_kwargs}), key on the rendered prompt — so
     sweep rows, repeated requests, and future sweeps all dedupe into
-    one store entry."""
+    one store entry.
+
+    The response carries the request-scoped phase breakdown
+    (``phases``: model build, store lookup, model forward, store
+    commit seconds) plus the forward's dispatch/fetch wall split and
+    prefill/decode token counts from the model's ``_tl_track``
+    plumbing — the engine lays these out as child spans of the request
+    record in ``{cache_root}/serve/obs/requests.jsonl``.  ``ttft_s``
+    is the time-to-first-token *estimate* for device-served rows: host
+    dispatch (trace/compile/enqueue) plus the prefill-token share of
+    the fused device wall (the fused prefill+decode executable gives
+    no on-device split)."""
     from opencompass_tpu import store as result_store
+    from opencompass_tpu.obs import get_tracer
+    from opencompass_tpu.obs import timeline as tlmod
     from opencompass_tpu.utils.build import (build_model_from_cfg,
                                              model_cached)
     model_cfg = msg.get('model_cfg')
@@ -405,13 +431,19 @@ def _handle_complete(msg: Dict) -> Dict:
         return {'ok': False, 'error': 'complete needs a model_cfg dict'}
     prompts = [str(p) for p in (msg.get('prompts') or [])]
     max_out_len = int(msg.get('max_out_len') or 16)
+    request_id = msg.get('request_id')
+    phases: Dict[str, float] = {}
     t0 = time.perf_counter()
     built = not model_cached(model_cfg)
     model = build_model_from_cfg(model_cfg)   # memoized (residency)
+    phases['model_build_s'] = round(time.perf_counter() - t0, 6)
     if not prompts:   # warm-up probe: model on device, nothing to say
         return {'ok': True, 'completions': [], 'built': built,
-                'build_seconds': round(time.perf_counter() - t0, 3)}
+                'build_seconds': round(time.perf_counter() - t0, 3),
+                'phases': phases, 'pid': os.getpid(),
+                'request_id': request_id}
 
+    t = time.perf_counter()
     if getattr(model, '_result_store', None) is None:
         # engine-owned binding: the explicit cache root wins so the
         # worker serves the daemon's store even when its env predates it
@@ -433,14 +465,39 @@ def _handle_complete(msg: Dict) -> Dict:
             if cached is not None:
                 completions[i] = cached
                 hits += 1
+    phases['store_lookup_s'] = round(time.perf_counter() - t, 6)
     todo = [i for i, c in enumerate(completions) if c is None]
+    calls: List[Dict] = []
     if todo:
-        outs = model.generate([prompts[i] for i in todo],
-                              max_out_len=max_out_len)
+        # enable _tl_track collection even without a task timeline so
+        # the request record gets the dispatch/fetch + prefill/decode
+        # splits; a task-installed timeline (between sweep shards)
+        # already tracks
+        installed = None
+        if not tlmod.get_timeline().enabled:
+            installed = tlmod.install_timeline(tlmod.TRACK_ONLY)
+        try:
+            try:
+                model._tl_pending.clear()   # stale warm-up leftovers
+            except Exception:
+                pass
+            t = time.perf_counter()
+            with get_tracer().span('complete', request_id=request_id,
+                                   rows=len(todo)):
+                outs = model.generate([prompts[i] for i in todo],
+                                      max_out_len=max_out_len)
+            phases['model_forward_s'] = round(
+                time.perf_counter() - t, 6)
+            calls = _collect_tracked_calls(model)
+        finally:
+            if installed is not None:
+                tlmod.reset_timeline()
+        t = time.perf_counter()
         for i, out in zip(todo, outs):
             completions[i] = out
             if ctx is not None:
                 ctx.put(keys[i], out)
+        phases['store_commit_s'] = round(time.perf_counter() - t, 6)
     prompt_tokens = completion_tokens = None
     try:
         prompt_tokens = sum(model.get_token_len(p) for p in prompts)
@@ -448,11 +505,28 @@ def _handle_complete(msg: Dict) -> Dict:
                                 for c in completions)
     except Exception:
         pass
-    return {'ok': True, 'completions': completions, 'built': built,
+    resp = {'ok': True, 'completions': completions, 'built': built,
             'store_hits': hits, 'device_rows': len(todo),
             'prompt_tokens': prompt_tokens,
             'completion_tokens': completion_tokens,
-            'elapsed_seconds': round(time.perf_counter() - t0, 4)}
+            'elapsed_seconds': round(time.perf_counter() - t0, 4),
+            'phases': phases, 'pid': os.getpid(),
+            'request_id': request_id}
+    if calls:
+        dispatch_s = sum(c.get('dispatch_s') or 0 for c in calls)
+        fetch_s = sum(c.get('fetch_s') or 0 for c in calls)
+        prefill = sum(c.get('prefill_tokens') or 0 for c in calls)
+        decode = sum(c.get('decode_tokens') or 0 for c in calls)
+        resp['dispatch_s'] = round(dispatch_s, 6)
+        resp['fetch_s'] = round(fetch_s, 6)
+        resp['prefill_tokens'] = prefill
+        resp['decode_tokens'] = decode
+        first = calls[0]
+        first_fetch = first.get('fetch_s') or 0.0
+        share = prefill / max(prefill + decode, 1)
+        resp['ttft_s'] = round(
+            (first.get('dispatch_s') or 0.0) + first_fetch * share, 6)
+    return resp
 
 
 def _flush_model_caches():

@@ -9,6 +9,11 @@ content-addressed result store (PR 5), and the telemetry HTTP plane
 sweeps, every result row is a store commit, and killing the daemon
 mid-sweep loses nothing — the restarted engine re-claims the queue and
 recomputes only missing rows.
+
+Request-scoped telemetry (``obs/reqtrace.py``) rides on every HTTP
+request: ids, span-tree records under ``{cache_root}/serve/obs/``,
+rolling SLO windows on ``GET /v1/stats``, and the ``cli top`` fleet
+dashboard (``serve/top.py``).
 """
 from opencompass_tpu.serve.daemon import EvalEngine, serve_main
 from opencompass_tpu.serve.queue import (QUEUE_SUBDIR, SweepQueue,

@@ -40,6 +40,7 @@ import time
 from datetime import datetime
 from typing import Dict, List, Optional
 
+from opencompass_tpu.obs import reqtrace
 from opencompass_tpu.serve.queue import QUEUE_SUBDIR, SweepQueue
 from opencompass_tpu.serve.scheduler import WorkerPool
 from opencompass_tpu.utils.logging import add_file_handler, get_logger
@@ -102,6 +103,15 @@ class EvalEngine:
         self.cache_root = osp.abspath(
             compile_cache.cache_root(self.base_work_dir))
         self.queue = SweepQueue(osp.join(self.cache_root, QUEUE_SUBDIR))
+        # request-scoped telemetry plane (obs/reqtrace.py), rooted
+        # pre-timestamp like the queue and the store: requests.jsonl +
+        # access.jsonl survive daemon restarts, and `cli top` finds the
+        # live engine through engine.json
+        self.serve_obs_dir = reqtrace.serve_obs_dir(self.cache_root)
+        self.req_recorder = reqtrace.RequestRecorder(self.serve_obs_dir)
+        self.http_access_log = reqtrace.AccessLog(self.serve_obs_dir)
+        self.req_stats = reqtrace.RollingStats()
+        self._key_abbr: Optional[Dict[str, str]] = None
         self.pool: Optional[WorkerPool] = None
         self.infer_runner = None
         self.eval_runner = None
@@ -185,12 +195,15 @@ class EvalEngine:
             registry=self.tracer.metrics,
             routes=build_routes(self),
             readiness=self.readiness,
-            status_fn=self.status_snapshot)
+            status_fn=self.status_snapshot,
+            access_log=self._on_http_request)
         self.port = self.server.start()
         if self.port is None:
             raise RuntimeError(
                 f'engine HTTP server failed to bind port '
                 f'{self.requested_port}')
+        reqtrace.write_engine_info(self.serve_obs_dir, self.port,
+                                   self.run_dir)
 
         requeued = self.queue.recover()
         if requeued:
@@ -215,6 +228,7 @@ class EvalEngine:
         close the front door, mark the run over."""
         from opencompass_tpu.obs.live import mark_run
         self._stop.set()
+        reqtrace.clear_engine_info(self.serve_obs_dir, pid=os.getpid())
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=30)
         if self.pool is not None:
@@ -361,19 +375,56 @@ class EvalEngine:
 
     def complete(self, model: str, prompts: List[str],
                  max_out_len: int = 16,
-                 timeout: float = DEFAULT_COMPLETE_TIMEOUT_S) -> Dict:
+                 timeout: float = DEFAULT_COMPLETE_TIMEOUT_S,
+                 request_id: Optional[str] = None,
+                 response_id: Optional[str] = None,
+                 parse_seconds: float = 0.0) -> Dict:
         """Generate completions on the resident worker for ``model``
         (catalog abbr).  Store-first: a prompt identical to a sweep row
         or a previous request is served from disk without touching the
         device.  Raises ``KeyError`` for an unknown model,
-        ``RuntimeError`` when the worker fails."""
-        model_cfg = self._catalog.get(model)
-        if model_cfg is None:
-            raise KeyError(model)
-        resp = self._request_complete(model_cfg, prompts, max_out_len,
-                                      timeout)
+        ``RuntimeError`` when the worker fails.
+
+        Every call — error paths included — appends one span-tree
+        record to ``{cache_root}/serve/obs/requests.jsonl`` keyed by
+        ``response_id`` (the ``cmpl-`` id the client sees) and
+        ``request_id`` (the ``X-OCT-Request-Id`` the front door
+        stamped), with the serving phase breakdown as non-overlapping
+        child spans: parse (caller-measured, ``parse_seconds``),
+        chip/lease wait, worker protocol overhead, model build, store
+        lookup, model forward, store commit.  The same sample feeds
+        the ``/v1/stats`` rolling window and the per-model
+        latency/TTFT histograms on ``/metrics``."""
+        import uuid
+        request_id = request_id or reqtrace.mint_request_id()
+        response_id = response_id or f'cmpl-{uuid.uuid4().hex[:24]}'
+        t0 = time.perf_counter()
+        ts = time.time()
+        timings: Dict[str, float] = {}
+        resp = None
+        error = None
+        try:
+            model_cfg = self._catalog.get(model)
+            if model_cfg is None:
+                raise KeyError(model)
+            resp = self._request_complete(model_cfg, prompts,
+                                          max_out_len, timeout,
+                                          request_id=request_id,
+                                          timings=timings)
+        except BaseException as exc:
+            error = f'{type(exc).__name__}: {exc}'
+            raise
+        finally:
+            wall = parse_seconds + (time.perf_counter() - t0)
+            self._record_request(
+                response_id=response_id, request_id=request_id,
+                ts=ts, model=model, wall_s=wall,
+                parse_s=parse_seconds, timings=timings,
+                resp=resp, error=error)
         with self._complete_lock:
             self._completions += 1
+        resp['id'] = response_id
+        resp['request_id'] = request_id
         if self.tracer is not None:
             self.tracer.counter('serve.completions').inc()
             if resp.get('store_hits'):
@@ -381,13 +432,98 @@ class EvalEngine:
                     resp['store_hits'])
         return resp
 
+    def _record_request(self, response_id: str, request_id: str,
+                        ts: float, model: str, wall_s: float,
+                        parse_s: float, timings: Dict,
+                        resp: Optional[Dict], error: Optional[str]):
+        """One requests.jsonl record + rolling-window/histogram feed
+        per completion attempt.  Never raises (telemetry contract)."""
+        try:
+            from opencompass_tpu.obs.metrics import labeled
+            wp = (resp or {}).get('phases') or {}
+            roundtrip = timings.get('roundtrip_s') or 0.0
+            worker_internal = sum(v for v in wp.values() if v)
+            phase_durs = [('parse', parse_s),
+                          ('lease_wait', timings.get('lease_wait_s'))]
+            if roundtrip:
+                phase_durs.append(
+                    ('worker_protocol',
+                     max(roundtrip - worker_internal, 0.0)))
+                for name, key in (('model_build', 'model_build_s'),
+                                  ('store_lookup', 'store_lookup_s'),
+                                  ('model_forward', 'model_forward_s'),
+                                  ('store_commit', 'store_commit_s')):
+                    if wp.get(key):
+                        phase_durs.append((name, wp[key]))
+            phases = reqtrace.phases_to_spans(
+                [(n, d) for n, d in phase_durs if d])
+            ok = error is None
+            rec = {
+                'id': response_id, 'request_id': request_id,
+                'ts': round(ts, 3), 'route': '/v1/completions',
+                'model': model, 'status': 'ok' if ok else 'error',
+                'wall_s': round(wall_s, 6), 'phases': phases,
+            }
+            if error:
+                rec['error'] = error
+            ttft = None
+            if resp is not None:
+                ttft = resp.get('ttft_s')
+                rec['usage'] = {
+                    'prompt_tokens': resp.get('prompt_tokens'),
+                    'completion_tokens': resp.get('completion_tokens'),
+                    'prefill_tokens': resp.get('prefill_tokens'),
+                    'decode_tokens': resp.get('decode_tokens'),
+                }
+                rec['store'] = {'hits': resp.get('store_hits'),
+                                'device_rows': resp.get('device_rows')}
+                rec['worker'] = {'pid': resp.get('pid'),
+                                 'built': resp.get('built'),
+                                 'dispatch_s': resp.get('dispatch_s'),
+                                 'fetch_s': resp.get('fetch_s')}
+                if ttft is not None:
+                    rec['ttft_s'] = ttft
+            self.req_recorder.record(rec)
+            # label cardinality guard: client-supplied model strings
+            # that never resolved in the catalog must not mint
+            # daemon-lifetime registry instruments (a typo-scan would
+            # grow /metrics without bound) — the raw name still lands
+            # in the requests.jsonl record above
+            label_model = model if model in self._catalog \
+                else '(unknown)'
+            self.req_stats.record_completion(
+                label_model, wall_s, ttft_s=ttft, ok=ok,
+                store_hits=(resp or {}).get('store_hits') or 0,
+                device_rows=(resp or {}).get('device_rows') or 0,
+                ts=ts)
+            reqtrace.annotate(model=label_model,
+                              completion_id=response_id)
+            if self.tracer is not None and self.tracer.enabled:
+                self.tracer.histogram(labeled(
+                    'serve.completion_seconds',
+                    model=label_model)).observe(wall_s)
+                if ttft is not None:
+                    self.tracer.histogram(labeled(
+                        'serve.ttft_seconds',
+                        model=label_model)).observe(ttft)
+                if not ok:
+                    self.tracer.counter(labeled(
+                        'serve.completion_errors',
+                        model=label_model)).inc()
+        except Exception:
+            logger.warning('request record failed', exc_info=True)
+
     def _request_complete(self, model_cfg: Dict, prompts: List[str],
-                          max_out_len: int, timeout: float) -> Dict:
+                          max_out_len: int, timeout: float,
+                          request_id: Optional[str] = None,
+                          timings: Optional[Dict] = None) -> Dict:
         from opencompass_tpu.runners.worker import WorkerError
         from opencompass_tpu.serve.scheduler import WorkerBusyError
+        timings = timings if timings is not None else {}
         key = self.affinity_key(model_cfg)
         run_cfg = model_cfg.get('run_cfg', {}) or {}
         devices = run_cfg.get('num_devices', run_cfg.get('num_gpus', 0))
+        t_lease = time.perf_counter()
         try:
             # bound the chip wait by the request budget: every host chip
             # held by a sweep must surface as back-pressure (502), not
@@ -397,12 +533,17 @@ class EvalEngine:
                                        alloc_timeout_s=timeout)
         except TimeoutError as exc:
             raise RuntimeError(str(exc)) from exc
+        finally:
+            timings['lease_wait_s'] = round(
+                time.perf_counter() - t_lease, 6)
+        t_rt = time.perf_counter()
         try:
             resp = worker.request(
                 {'cmd': 'complete',
                  'model_cfg': _wire_model_cfg(model_cfg),
                  'prompts': list(prompts),
                  'max_out_len': max_out_len,
+                 'request_id': request_id,
                  'cache_root': self.cache_root,
                  'work_dir': self.run_dir},
                 timeout=timeout)
@@ -414,6 +555,8 @@ class EvalEngine:
         except WorkerError as exc:
             self.pool.discard(worker)
             raise RuntimeError(f'worker failed: {exc}') from exc
+        finally:
+            timings['roundtrip_s'] = round(time.perf_counter() - t_rt, 6)
         self.pool.release(worker)
         if not resp.get('ok'):
             raise RuntimeError(resp.get('error') or 'completion failed')
@@ -448,6 +591,67 @@ class EvalEngine:
                 logger.exception(f'warm-up {abbr} failed')
         self._warmed.set()
 
+    # -- request-scoped telemetry ------------------------------------------
+
+    def _on_http_request(self, rec: Dict):
+        """The front door's access-log hook: one JSONL line per HTTP
+        request (any route) + a seat in the rolling SLO window."""
+        self.http_access_log.write(rec)
+        self.req_stats.record_http(
+            rec.get('route') or rec.get('path') or '?',
+            rec.get('status') or 599,
+            (rec.get('latency_ms') or 0.0) / 1e3,
+            ts=rec.get('ts'))
+
+    def _abbr_for_key(self, key: str) -> Optional[str]:
+        """Reverse map: pool affinity digest → catalog model abbr (the
+        human name `cli top` and the per-worker gauges label with)."""
+        if self._key_abbr is None:
+            mapping = {}
+            for abbr, model_cfg in list(self._catalog.items()):
+                try:
+                    mapping[self.affinity_key(model_cfg)] = abbr
+                except Exception:
+                    pass
+            self._key_abbr = mapping
+        return self._key_abbr.get(key)
+
+    def _worker_table(self,
+                      stats: Optional[Dict] = None) -> Dict[str, Dict]:
+        """The pool's per-worker stats, joined with catalog abbrs.
+        Pass a precomputed ``pool.stats()`` dict to avoid a second
+        pool-lock pass per snapshot."""
+        if stats is None:
+            stats = self.pool.stats() if self.pool is not None else {}
+        workers = {}
+        for key, row in (stats.get('workers') or {}).items():
+            row = dict(row)
+            row['model'] = self._abbr_for_key(key)
+            workers[key] = row
+        return workers
+
+    def stats_snapshot(self, window_s: float = 300.0) -> Dict:
+        """``GET /v1/stats``: the rolling-window SLO summary (per-route
+        / per-model latency percentiles, TTFT, error counts,
+        completions/sec) + queue pressure + the per-worker fleet
+        table.  Everything in-memory — one call, no file reads."""
+        summary = self.req_stats.summary(window_s)
+        summary['object'] = 'serve.stats'
+        pressure = self.queue.pressure()
+        counts = pressure['counts']
+        summary['queue'] = {
+            'depth': counts.get('queued', 0),
+            'running': counts.get('running', 0),
+            'oldest_age_seconds':
+                pressure['oldest_queued_age_seconds'],
+            'current_sweep': self._current_sweep,
+        }
+        summary['workers'] = self._worker_table()
+        summary['completions_total'] = self._completions
+        summary['run_dir'] = self.run_dir
+        summary['ready'] = self._warmed.is_set()
+        return summary
+
     # -- status / readiness ------------------------------------------------
 
     def readiness(self) -> Dict:
@@ -478,12 +682,15 @@ class EvalEngine:
         from opencompass_tpu.obs.live import current_status
         snap = current_status(self.tracer.obs_dir) \
             if self.tracer is not None else {}
-        counts = self.queue.counts()
+        pressure = self.queue.pressure()
+        counts = pressure['counts']
         stats = self.pool.stats() if self.pool is not None else {}
-        workers = stats.get('workers') or {}
+        workers = self._worker_table(stats)
         snap['serve'] = {
             'run_dir': self.run_dir,
             'queue_depth': counts.get('queued', 0),
+            'queue_oldest_age_seconds':
+                pressure['oldest_queued_age_seconds'],
             'sweeps_running': counts.get('running', 0),
             'sweeps_done': counts.get('done', 0),
             'sweeps_failed': counts.get('failed', 0),
@@ -524,11 +731,14 @@ class EvalEngine:
         if self.tracer is None or not self.tracer.enabled:
             return
         try:
-            counts = self.queue.counts()
+            pressure = self.queue.pressure()
+            counts = pressure['counts']
             self.tracer.gauge('serve.queue_depth').set(
                 counts.get('queued', 0))
             self.tracer.gauge('serve.sweeps_done').set(
                 counts.get('done', 0))
+            self.tracer.gauge('serve.queue_oldest_age_seconds').set(
+                pressure['oldest_queued_age_seconds'] or 0.0)
             if self.pool is not None:
                 self.tracer.gauge('serve.workers_resident').set(
                     self.pool.resident_count)

@@ -19,6 +19,10 @@ Two API surfaces mounted on the PR 2 telemetry server
     POST /v1/completions     {"model": "<abbr>", "prompt": "...",
                               "max_tokens": 16}
     GET  /v1/models          catalog listing
+    GET  /v1/stats           rolling-window SLO summary
+                             (?window=SECONDS, default 300): per-route
+                             / per-model latency percentiles, TTFT,
+                             error counts, queue age, worker fleet
 
 ``/v1/completions`` answers in the OpenAI ``text_completion`` shape
 (``choices``, ``usage``) plus an ``oct`` block with the serving truth:
@@ -34,13 +38,18 @@ errors (``{"error": {"message", "type"}}``).
 from __future__ import annotations
 
 import json
+import os
+import os.path as osp
 import time
 import uuid
 from typing import Dict, Tuple
 
+from opencompass_tpu.obs import reqtrace
+
 SWEEPS_PATH = '/v1/sweeps'
 COMPLETIONS_PATH = '/v1/completions'
 MODELS_PATH = '/v1/models'
+STATS_PATH = '/v1/stats'
 
 
 def _err(code: int, message: str,
@@ -71,13 +80,28 @@ def build_routes(engine) -> Dict:
         if not config_path and not config_text:
             return _err(400, 'need "config" (inline python text) or '
                              '"config_path" (daemon-readable file)')
+        # caller mistakes are 400s, not 500s: an unreadable config_path
+        # or a bogus mode is the client's fault — 500 stays reserved
+        # for genuine journal/IO faults on the daemon's side
+        mode = req.get('mode', 'all')
+        if mode not in ('all', 'infer', 'eval', 'viz'):
+            return _err(400, f'unknown mode {mode!r}; expected '
+                             'all|infer|eval|viz')
+        if config_path:
+            if not osp.isfile(config_path) \
+                    or not os.access(config_path, os.R_OK):
+                return _err(400, f'config_path {config_path!r} is not '
+                                 'a daemon-readable file')
         try:
             rec = engine.queue.enqueue(
                 config_path=config_path, config_text=config_text,
-                mode=req.get('mode', 'all'), label=req.get('label'),
+                mode=mode, label=req.get('label'),
                 work_dir=req.get('work_dir'))
+        except ValueError as exc:
+            return _err(400, f'bad sweep request: {exc}')
         except Exception as exc:
             return _err(500, f'enqueue failed: {exc}', 'server_error')
+        reqtrace.annotate(sweep=rec['id'])
         return 202, {'id': rec['id'], 'object': 'sweep',
                      'status': 'queued', 'mode': rec['mode'],
                      'created': rec['ts'],
@@ -111,6 +135,12 @@ def build_routes(engine) -> Dict:
                     'sweep_not_cancellable')
 
     def completions(path, query, body):
+        # the request id travels with the record: honored inbound
+        # (X-OCT-Request-Id, stamped by the dispatch guard), minted
+        # here when the handler runs outside an HTTP request (tests)
+        t_parse = time.perf_counter()
+        request_id = reqtrace.current_request_id() \
+            or reqtrace.mint_request_id()
         try:
             req = _parse_json(body)
         except ValueError as exc:
@@ -123,10 +153,22 @@ def build_routes(engine) -> Dict:
             if isinstance(prompt, list) else [str(prompt)]
         if not prompts or not any(prompts):
             return _err(400, 'missing "prompt"')
-        max_tokens = int(req.get('max_tokens') or 16)
+        try:
+            max_tokens = int(req.get('max_tokens') or 16)
+        except (TypeError, ValueError):
+            return _err(400, f'bad "max_tokens" '
+                             f'{req.get("max_tokens")!r}')
+        # minted before the call so the requests.jsonl record and the
+        # response body share one id — a client-reported slow request
+        # is greppable end to end
+        cmpl_id = f'cmpl-{uuid.uuid4().hex[:24]}'
+        parse_s = time.perf_counter() - t_parse
         try:
             resp = engine.complete(model, prompts,
-                                   max_out_len=max_tokens)
+                                   max_out_len=max_tokens,
+                                   request_id=request_id,
+                                   response_id=cmpl_id,
+                                   parse_seconds=parse_s)
         except KeyError:
             return _err(404, f'model {model!r} not served; have: '
                              f'{engine.models()}', 'model_not_found')
@@ -140,7 +182,7 @@ def build_routes(engine) -> Dict:
                                       + (resp.get('completion_tokens')
                                          or 0))}
         return 200, {
-            'id': f'cmpl-{uuid.uuid4().hex[:24]}',
+            'id': resp.get('id') or cmpl_id,
             'object': 'text_completion',
             'created': int(time.time()),
             'model': model,
@@ -150,11 +192,16 @@ def build_routes(engine) -> Dict:
                         enumerate(resp.get('completions') or [])],
             'usage': usage,
             # the serving truth OpenAI's shape has no slot for: how the
-            # engine actually answered (disk vs device, warm vs cold)
-            'oct': {'store_hits': resp.get('store_hits'),
+            # engine actually answered (disk vs device, warm vs cold),
+            # plus the ids that key this request's requests.jsonl
+            # record and access-log line
+            'oct': {'id': resp.get('id') or cmpl_id,
+                    'request_id': resp.get('request_id') or request_id,
+                    'store_hits': resp.get('store_hits'),
                     'device_rows': resp.get('device_rows'),
                     'model_built': resp.get('built'),
-                    'elapsed_seconds': resp.get('elapsed_seconds')},
+                    'elapsed_seconds': resp.get('elapsed_seconds'),
+                    'ttft_seconds': resp.get('ttft_s')},
         }
 
     def list_models(path, query, body):
@@ -163,6 +210,23 @@ def build_routes(engine) -> Dict:
                                'owned_by': 'opencompass-tpu'}
                               for abbr in engine.models()]}
 
+    def stats(path, query, body):
+        import math
+        from urllib.parse import parse_qs
+        window = 300.0
+        try:
+            raw = (parse_qs(query).get('window') or [None])[0]
+            if raw:
+                window = float(raw)
+                # nan/inf would poison every per-second and cutoff
+                # computation and serialize as invalid JSON
+                if not math.isfinite(window):
+                    raise ValueError(window)
+                window = max(window, 1.0)
+        except (TypeError, ValueError):
+            return _err(400, f'bad window {query!r}')
+        return 200, engine.stats_snapshot(window_s=window)
+
     return {
         ('POST', SWEEPS_PATH): post_sweep,
         ('GET', SWEEPS_PATH): list_sweeps,
@@ -170,4 +234,5 @@ def build_routes(engine) -> Dict:
         ('DELETE', SWEEPS_PATH + '/'): cancel_sweep,
         ('POST', COMPLETIONS_PATH): completions,
         ('GET', MODELS_PATH): list_models,
+        ('GET', STATS_PATH): stats,
     }

@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import os
 import os.path as osp
+import threading
 import time
 import uuid
 
@@ -95,6 +96,7 @@ class SweepQueue:
         # would grow O(lifetime sweeps) forever
         self._replay: 'OrderedDict[str, Dict]' = OrderedDict()
         self._replay_offset = 0
+        self._replay_lock = threading.Lock()
         self._seal_torn_tail()
 
     def _append(self, rec: Dict):
@@ -315,33 +317,41 @@ class SweepQueue:
         """Parse journal bytes appended since the last call.  Whole
         lines only — an in-flight (or torn) unterminated tail is left
         for the next refresh, exactly the record granularity
-        ``iter_jsonl_records`` guarantees on full replay."""
-        try:
-            size = os.path.getsize(self.journal_path)
-        except OSError:
-            size = 0
-        if size < self._replay_offset:   # journal replaced/truncated
-            self._replay = OrderedDict()
-            self._replay_offset = 0
-        if size == self._replay_offset:
-            return
-        try:
-            with open(self.journal_path, 'rb') as f:
-                f.seek(self._replay_offset)
-                chunk = f.read(size - self._replay_offset)
-        except OSError:
-            return
-        end = chunk.rfind(b'\n')
-        if end < 0:
-            return
-        for line in chunk[:end].splitlines():
+        ``iter_jsonl_records`` guarantees on full replay.
+
+        Serialized: the engine's drain loop, its gauge flush, and
+        every HTTP poll thread (``/status``, ``/metrics``,
+        ``/v1/stats``) share this handle — two unserialized refreshes
+        from the same offset would double-apply the chunk and advance
+        the offset past EOF, silently dropping the next enqueue from
+        replay."""
+        with self._replay_lock:
             try:
-                rec = json.loads(line)
-            except ValueError:
-                continue   # sealed torn line: one skippable garbage row
-            if isinstance(rec, dict):
-                self._apply_record(rec)
-        self._replay_offset += end + 1
+                size = os.path.getsize(self.journal_path)
+            except OSError:
+                size = 0
+            if size < self._replay_offset:   # journal replaced/truncated
+                self._replay = OrderedDict()
+                self._replay_offset = 0
+            if size == self._replay_offset:
+                return
+            try:
+                with open(self.journal_path, 'rb') as f:
+                    f.seek(self._replay_offset)
+                    chunk = f.read(size - self._replay_offset)
+            except OSError:
+                return
+            end = chunk.rfind(b'\n')
+            if end < 0:
+                return
+            for line in chunk[:end].splitlines():
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue  # sealed torn line: one skippable garbage row
+                if isinstance(rec, dict):
+                    self._apply_record(rec)
+            self._replay_offset += end + 1
 
     def state(self) -> 'OrderedDict[str, Dict]':
         """Replay the journal into sweep records, FIFO (journal) order.
@@ -355,9 +365,10 @@ class SweepQueue:
         non-terminal sweeps, so a long-lived daemon's poll cost is
         bounded by *active* sweeps, not lifetime throughput."""
         self._refresh_replay()
-        sweeps: 'OrderedDict[str, Dict]' = OrderedDict(
-            (sweep_id, dict(row))
-            for sweep_id, row in self._replay.items())
+        with self._replay_lock:
+            sweeps: 'OrderedDict[str, Dict]' = OrderedDict(
+                (sweep_id, dict(row))
+                for sweep_id, row in self._replay.items())
         for sweep_id, row in sweeps.items():
             if row['status'] != 'queued':
                 continue
@@ -380,9 +391,25 @@ class SweepQueue:
         return sum(1 for rec in self.state().values()
                    if rec['status'] == 'queued')
 
-    def counts(self) -> Dict[str, int]:
-        out = {'queued': 0, 'running': 0, 'done': 0, 'failed': 0,
-               'cancelled': 0}
+    def pressure(self, now: Optional[float] = None) -> Dict:
+        """Counts by status + oldest-queued age in ONE ``state()``
+        pass — the engine's gauge flush and every ``/status`` /
+        ``/metrics`` / ``/v1/stats`` poll want both, and each
+        ``state()`` call replays the journal delta and stats claim
+        files."""
+        now = time.time() if now is None else now
+        counts = {'queued': 0, 'running': 0, 'done': 0, 'failed': 0,
+                  'cancelled': 0}
+        oldest = None
         for rec in self.state().values():
-            out[rec['status']] = out.get(rec['status'], 0) + 1
-        return out
+            counts[rec['status']] = counts.get(rec['status'], 0) + 1
+            if rec['status'] == 'queued' and rec.get('ts'):
+                age = now - rec['ts']
+                if oldest is None or age > oldest:
+                    oldest = age
+        return {'counts': counts,
+                'oldest_queued_age_seconds':
+                    round(oldest, 3) if oldest is not None else None}
+
+    def counts(self) -> Dict[str, int]:
+        return self.pressure()['counts']

@@ -63,6 +63,38 @@ class ResidentWorker:
         self.retired = False               # chips freed once (pool-locked)
         self.born = time.monotonic()
         self.last_used = time.monotonic()
+        # request-scoped observability: what is on the channel right
+        # now (request id / task name → start), and the cumulative
+        # busy wall that utilization = busy/age is computed from
+        self._stats_lock = threading.Lock()
+        self.inflight: Dict[str, float] = {}
+        self.busy_seconds = 0.0
+
+    def _track_begin(self, msg: Dict) -> Tuple[str, float]:
+        label = str(msg.get('request_id') or msg.get('name')
+                    or msg.get('cmd') or '?')
+        now = time.monotonic()
+        try:
+            with self._stats_lock:
+                self.inflight[label] = now
+        except Exception:
+            pass
+        return label, now
+
+    def _track_end(self, label: str, t0: float):
+        try:
+            with self._stats_lock:
+                self.inflight.pop(label, None)
+                self.busy_seconds += time.monotonic() - t0
+        except Exception:
+            pass
+
+    def inflight_snapshot(self) -> List[str]:
+        """A consistent copy for pollers — ``inflight`` mutates under
+        its own lock, so iterating the live dict from ``stats()``
+        would race a request thread."""
+        with self._stats_lock:
+            return sorted(self.inflight)
 
     @property
     def dead(self) -> bool:
@@ -95,9 +127,11 @@ class ResidentWorker:
             self.lock.acquire()
         try:
             self.requests += 1
+            label, t_req = self._track_begin(msg)
             try:
                 return self.handle.request(msg, timeout=remaining)
             finally:
+                self._track_end(label, t_req)
                 self.last_used = time.monotonic()
         finally:
             self.lock.release()
@@ -105,9 +139,11 @@ class ResidentWorker:
     def request_watched(self, msg: Dict, **kwargs) -> Dict:
         with self.lock:
             self.requests += 1
+            label, t_req = self._track_begin(msg)
             try:
                 return self.handle.request_watched(msg, **kwargs)
             finally:
+                self._track_end(label, t_req)
                 self.last_used = time.monotonic()
 
     def kill(self):
@@ -351,6 +387,13 @@ class WorkerPool:
                     'idle_seconds': round(now - worker.last_used, 1),
                     'age_seconds': round(now - worker.born, 1),
                     'alive': worker.alive,
+                    # the operator's request-scoped view: what this
+                    # worker is serving right now, and how busy its
+                    # channel has been over its lifetime
+                    'in_flight': worker.inflight_snapshot(),
+                    'utilization': round(
+                        min(worker.busy_seconds
+                            / max(now - worker.born, 1e-9), 1.0), 4),
                 } for worker in self._workers.values()
             }
             orphans = len(self._orphans)

@@ -1,0 +1,265 @@
+"""``cli top <cache_root>`` — live fleet dashboard for the serve daemon.
+
+The serving analogue of ``cli status --watch``: one terminal frame,
+re-rendered on an interval, showing what the engine is doing *right
+now* — the resident worker fleet (pid, model, resident-for, in-flight
+request ids, utilization), queue pressure (depth, oldest age, current
+sweep), and the rolling SLO picture (completions/sec, latency
+percentiles, TTFT) with completions/sec and p99 sparklines over the
+recent past.
+
+Data sources, in order of preference:
+
+- the live engine's ``GET /v1/stats`` + ``GET /status`` (discovered
+  through ``{cache_root}/serve/obs/engine.json`` — port + pid; a dead
+  pid or an unreachable port demotes to files);
+- the durable files alone: ``requests.jsonl`` (tail — latency
+  series), the queue journal (depth/counts).  Against a dead daemon
+  ``top`` renders the last known picture once and exits 0 — same
+  file-first philosophy as ``cli status`` on a dead run.
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+import time
+from typing import Dict, List, Optional
+
+from opencompass_tpu.obs import reqtrace
+
+DEFAULT_WINDOW_S = 300.0
+SPARK_BINS = 24
+
+
+def resolve_cache_root(root: str) -> Optional[str]:
+    """Accept a cache root, a serve work_dir (its ``cache/``
+    subdirectory is the root), or ``$OCT_CACHE_ROOT`` conventions."""
+    for candidate in (root, osp.join(root, 'cache')):
+        if osp.isdir(osp.join(candidate, 'serve')):
+            return osp.abspath(candidate)
+    return None
+
+
+def _pid_alive(pid) -> bool:
+    if not isinstance(pid, int):
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except Exception:
+        return True
+
+
+def _http_json(port: int, path: str, timeout: float = 3.0):
+    import urllib.request
+    req = urllib.request.Request(f'http://127.0.0.1:{port}{path}')
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def gather(cache_root: str,
+           window_s: float = DEFAULT_WINDOW_S) -> Dict:
+    """One dashboard snapshot: engine liveness, ``/v1/stats`` (when
+    reachable), file-derived queue counts and the request-record tail
+    (always — the sparklines come from requests.jsonl either way)."""
+    obs_root = reqtrace.serve_obs_dir(cache_root)
+    snap: Dict = {'cache_root': cache_root, 'ts': time.time(),
+                  'engine': None, 'alive': False, 'stats': None,
+                  'serve': None}
+    info = reqtrace.read_engine_info(obs_root)
+    if info is not None:
+        snap['engine'] = info
+        if _pid_alive(info.get('pid')):
+            try:
+                snap['stats'] = _http_json(
+                    info['port'], f'/v1/stats?window={window_s:g}')
+                status = _http_json(info['port'], '/status')
+                snap['serve'] = status.get('serve')
+                snap['alive'] = True
+            except Exception:
+                snap['alive'] = False   # stale engine.json / hung port
+    if snap['serve'] is None:
+        queue_root = osp.join(cache_root, 'serve', 'queue')
+        if osp.isdir(queue_root):
+            try:
+                from opencompass_tpu.serve.queue import SweepQueue
+                pressure = SweepQueue(queue_root).pressure()
+                counts = pressure['counts']
+                snap['serve'] = {
+                    'queue_depth': counts.get('queued', 0),
+                    'queue_oldest_age_seconds':
+                        pressure['oldest_queued_age_seconds'],
+                    'sweeps_done': counts.get('done', 0),
+                    'sweeps_failed': counts.get('failed', 0),
+                    'sweeps_running': counts.get('running', 0),
+                }
+            except Exception:
+                pass
+    snap['requests'] = reqtrace.tail_requests(
+        osp.join(obs_root, reqtrace.REQUESTS_FILE),
+        window_s=window_s, now=snap['ts'])
+    return snap
+
+
+def _series(requests: List[Dict], now: float, window_s: float,
+            nbins: int = SPARK_BINS):
+    """Bucket the request tail into (completions/sec, p99 ms) series
+    for the sparklines."""
+    cps = [0.0] * nbins
+    lat: List[List[float]] = [[] for _ in range(nbins)]
+    width = window_s / nbins
+    for rec in requests:
+        age = now - (rec.get('ts') or 0)
+        if not 0 <= age <= window_s:
+            continue
+        b = min(int((window_s - age) / width), nbins - 1)
+        cps[b] += 1.0 / width
+        if rec.get('wall_s') is not None:
+            lat[b].append(float(rec['wall_s']))
+    p99 = [(reqtrace.percentile(vals, 0.99) or 0.0) * 1e3
+           for vals in lat]
+    return cps, p99
+
+
+def _fmt_age(seconds) -> str:
+    if seconds is None:
+        return '-'
+    seconds = float(seconds)
+    if seconds < 90:
+        return f'{seconds:.0f}s'
+    if seconds < 5400:
+        return f'{seconds / 60:.0f}m'
+    return f'{seconds / 3600:.1f}h'
+
+
+def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
+    from opencompass_tpu.obs.report import _sparkline, _table
+    lines: List[str] = []
+    info = snap.get('engine') or {}
+    if snap.get('alive'):
+        up = ''
+        if info.get('ts'):
+            up = f'  up {_fmt_age(snap["ts"] - info["ts"])}'
+        lines.append(f'engine: UP  pid {info.get("pid")}  '
+                     f'http://127.0.0.1:{info.get("port")}{up}')
+    elif info:
+        lines.append(f'engine: DOWN (last advertised pid '
+                     f'{info.get("pid")}, port {info.get("port")}) — '
+                     'rendering from files')
+    else:
+        lines.append('engine: DOWN (never advertised here) — '
+                     'rendering from files')
+
+    serve = snap.get('serve') or {}
+    queue_bits = [f'depth {serve.get("queue_depth", 0)}']
+    if serve.get('queue_oldest_age_seconds') is not None:
+        queue_bits.append(
+            f'oldest {_fmt_age(serve["queue_oldest_age_seconds"])}')
+    queue_bits.append(f'running {serve.get("sweeps_running", 0)}')
+    queue_bits.append(f'done {serve.get("sweeps_done", 0)}')
+    if serve.get('sweeps_failed'):
+        queue_bits.append(f'failed {serve["sweeps_failed"]}')
+    if serve.get('current_sweep'):
+        queue_bits.append(f'current {serve["current_sweep"]}')
+    lines.append('queue:  ' + '  '.join(queue_bits))
+
+    stats = snap.get('stats') or {}
+    comp = stats.get('completions') or {}
+    if comp.get('count'):
+        bits = [f'{comp["count"]} in {window_s:g}s',
+                f'{comp.get("per_sec", 0):.2f}/s']
+        for key, label in (('p50_ms', 'p50'), ('p99_ms', 'p99')):
+            if comp.get(key) is not None:
+                bits.append(f'{label} {comp[key]:.1f}ms')
+        for model, row in (comp.get('per_model') or {}).items():
+            if row.get('ttft_p95_ms') is not None:
+                bits.append(
+                    f'ttft_p95[{model}] {row["ttft_p95_ms"]:.1f}ms')
+        lines.append('completions: ' + '  '.join(bits))
+    requests = snap.get('requests') or []
+    if requests:
+        now = snap.get('ts') or time.time()
+        cps, p99 = _series(requests, now, window_s)
+        lines.append('  cps ' + _sparkline(cps)
+                     + f'  (peak {max(cps):.2f}/s)')
+        lines.append('  p99 ' + _sparkline(p99)
+                     + f'  (peak {max(p99):.0f}ms)')
+    elif not comp.get('count'):
+        lines.append('completions: none in window')
+
+    workers = (serve.get('workers') if serve else None) \
+        or (stats.get('workers') or {})
+    if workers:
+        rows = [['worker', 'model', 'pid', 'resident', 'idle', 'util',
+                 'reqs', 'in-flight']]
+        for key in sorted(workers):
+            w = workers[key]
+            util = w.get('utilization')
+            rows.append([
+                key[:12], str(w.get('model') or '-'),
+                str(w.get('pid', '-')),
+                _fmt_age(w.get('age_seconds')),
+                _fmt_age(w.get('idle_seconds')),
+                f'{util:.0%}' if util is not None else '-',
+                str(w.get('requests', '-')),
+                ','.join(w.get('in_flight') or []) or '-',
+            ])
+        lines.append(_table(rows))
+    else:
+        lines.append('(no resident workers)')
+    return '\n'.join(lines) + '\n'
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m opencompass_tpu.cli top <cache_root>`` body."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog='top', description='Live fleet dashboard for the serve '
+        'daemon: workers, queue, rolling completion latency — from '
+        '{cache_root}/serve/obs/ files + the live /v1/stats endpoint')
+    parser.add_argument('root', help='engine cache root (or the serve '
+                        'work_dir whose cache/ is the root)')
+    parser.add_argument('--interval', type=float, default=2.0,
+                        help='re-render every N seconds (default 2)')
+    parser.add_argument('--once', action='store_true',
+                        help='render a single frame and exit')
+    parser.add_argument('--json', action='store_true',
+                        help='emit the raw snapshot as JSON (implies '
+                        '--once)')
+    parser.add_argument('--window', type=float,
+                        default=DEFAULT_WINDOW_S,
+                        help='rolling stats window in seconds '
+                        '(default 300)')
+    args = parser.parse_args(argv)
+    cache_root = resolve_cache_root(args.root)
+    if cache_root is None:
+        print(f'no serve state under {args.root!r} — expected '
+              '{cache_root}/serve/ (was a daemon ever started here?)')
+        return 1
+    try:
+        while True:
+            snap = gather(cache_root, window_s=args.window)
+            if args.json:
+                print(json.dumps(snap, indent=2, default=str))
+                return 0
+            frame = render(snap, window_s=args.window)
+            if args.once:
+                print(frame, end='')
+                return 0
+            # clear + home, then one full frame (cli status --watch
+            # convention)
+            print('\x1b[2J\x1b[H' + f'== serve top: {cache_root} ==')
+            print(frame, end='', flush=True)
+            if not snap.get('alive'):
+                print('(engine is down — exiting)')
+                return 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -1,0 +1,670 @@
+"""Request-scoped serving telemetry (obs/reqtrace.py + the serve
+plane's wiring): request-id propagation, requests.jsonl schema +
+torn-line recovery, rolling-window SLO math, access-log emission,
+error taxonomy, worker in-flight tracking, `cli top`, and one slow
+e2e asserting a real completion's phase spans account for its wall
+latency."""
+import json
+import os
+import os.path as osp
+import signal
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+DEMO_CFG = osp.join(REPO, 'configs', 'eval_demo.py')
+
+
+def _http(method, url, body=None, timeout=10, headers=None):
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers=dict(headers or {}))
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            raw = resp.read()
+            try:
+                payload = json.loads(raw)
+            except ValueError:
+                payload = raw.decode('utf-8', 'replace')
+            return resp.status, payload, resp.headers
+    except urllib.error.HTTPError as exc:
+        payload = exc.read()
+        try:
+            payload = json.loads(payload)
+        except ValueError:
+            payload = payload.decode('utf-8', 'replace')
+        return exc.code, payload, exc.headers
+
+
+# -- request ids -----------------------------------------------------------
+
+def test_request_id_mint_and_normalize():
+    from opencompass_tpu.obs import reqtrace
+    rid = reqtrace.mint_request_id()
+    assert rid.startswith('req-') and len(rid) == 4 + 16
+    assert reqtrace.normalize_request_id('client-abc_1.2') \
+        == 'client-abc_1.2'
+    assert reqtrace.normalize_request_id('  padded-ok  ') == 'padded-ok'
+    assert reqtrace.normalize_request_id(None) is None
+    assert reqtrace.normalize_request_id('') is None
+    assert reqtrace.normalize_request_id('bad id with spaces') is None
+    assert reqtrace.normalize_request_id('x' * 200) is None
+    assert reqtrace.normalize_request_id('evil\n"inject') is None
+
+
+def test_phases_to_spans_layout():
+    from opencompass_tpu.obs.reqtrace import phases_to_spans
+    spans = phases_to_spans([('parse', 0.001), ('lease_wait', 0.02),
+                             ('model_forward', 0.5),
+                             ('store_commit', -1.0)])
+    assert [s['name'] for s in spans] == ['parse', 'lease_wait',
+                                          'model_forward',
+                                          'store_commit']
+    # non-overlapping children: each starts exactly where the previous
+    # ended, negative jitter clamps to zero duration
+    for prev, cur in zip(spans, spans[1:]):
+        assert cur['start_s'] == round(prev['start_s'] + prev['dur_s'], 6)
+    assert spans[-1]['dur_s'] == 0.0
+
+
+# -- requests.jsonl schema + torn-line recovery ----------------------------
+
+def test_request_recorder_schema_and_torn_line(tmp_path):
+    from opencompass_tpu.obs import reqtrace
+    root = str(tmp_path / 'serve_obs')
+    rec = reqtrace.RequestRecorder(root)
+    for i in range(3):
+        rec.record({'id': f'cmpl-{i}', 'request_id': f'req-{i}',
+                    'ts': 1000.0 + i, 'route': '/v1/completions',
+                    'model': 'm', 'status': 'ok', 'wall_s': 0.01 * i,
+                    'phases': reqtrace.phases_to_spans(
+                        [('parse', 0.001)])})
+    # torn final line (kill -9 mid-append) + interleaved garbage: both
+    # skipped, never raised
+    with open(rec.path, 'a') as f:
+        f.write('{"v": 1, "id": "cmpl-torn", "wall_s": 0.')
+    got = list(reqtrace.iter_requests(rec.path))
+    assert [r['id'] for r in got] == ['cmpl-0', 'cmpl-1', 'cmpl-2']
+    assert all(r['v'] == 1 and 'phases' in r for r in got)
+
+    # tail reader: window filter + partial-first-line drop
+    tail = reqtrace.tail_requests(rec.path, window_s=1.5, now=1002.5)
+    assert [r['id'] for r in tail] == ['cmpl-1', 'cmpl-2']
+    tail = reqtrace.tail_requests(rec.path, max_bytes=300)
+    assert tail and tail[-1]['id'] == 'cmpl-2'
+    assert len(tail) < 3                  # partial first line dropped
+    assert reqtrace.tail_requests(str(tmp_path / 'missing.jsonl')) == []
+
+
+# -- rolling-window SLO math -----------------------------------------------
+
+def test_rolling_stats_window_math():
+    from opencompass_tpu.obs.reqtrace import RollingStats, percentile
+    assert percentile([], 0.5) is None
+    vals = [float(i) for i in range(1, 101)]
+    assert percentile(vals, 0.50) == 50.0
+    assert percentile(vals, 0.95) == 95.0
+    assert percentile(vals, 0.99) == 99.0
+    assert percentile([7.0], 0.99) == 7.0
+
+    rs = RollingStats()
+    now = 10_000.0
+    for i in range(1, 101):
+        rs.record_http('/v1/completions', 200, i / 1000.0,
+                       ts=now - 10)
+    rs.record_http('/v1/completions', 502, 0.5, ts=now - 5)
+    rs.record_http('/healthz', 503, 0.001, ts=now - 5)
+    rs.record_http('/healthz', 200, 0.001, ts=now - 400)  # outside
+    for i in range(1, 11):
+        rs.record_completion('fake-demo', i / 100.0, ttft_s=i / 200.0,
+                             store_hits=1, device_rows=1, ts=now - 3)
+    rs.record_completion('other', 1.0, ok=False, ts=now - 3)
+    s = rs.summary(window_s=300.0, now=now)
+    assert s['http']['count'] == 102      # the 400s-old sample aged out
+    route = s['http']['per_route']['/v1/completions']
+    assert route['count'] == 101 and route['errors'] == 1
+    # 101 samples: 1..100ms plus one 500ms outlier
+    assert route['p50_ms'] == 51.0
+    assert route['p99_ms'] == 100.0
+    assert s['http']['errors'] == {'/v1/completions': {'502': 1},
+                                   '/healthz': {'503': 1}}
+    comp = s['completions']
+    assert comp['count'] == 11
+    assert comp['per_sec'] == round(11 / 300.0, 4)
+    fake = comp['per_model']['fake-demo']
+    assert fake['count'] == 10 and fake['errors'] == 0
+    assert fake['p50_ms'] == 50.0 and fake['p99_ms'] == 100.0
+    assert fake['ttft_p50_ms'] == 25.0 and fake['ttft_p95_ms'] == 50.0
+    assert fake['store_hits'] == 10 and fake['device_rows'] == 10
+    assert comp['per_model']['other']['errors'] == 1
+
+
+# -- HTTP front door: ids, counters, access log ----------------------------
+
+def test_http_request_id_and_access_log(tmp_path):
+    from opencompass_tpu.obs.metrics import MetricsRegistry
+    from opencompass_tpu.obs.promexport import ObsHTTPServer
+    from opencompass_tpu.obs.reqtrace import REQUEST_ID_HEADER
+
+    access = []
+
+    def boom(path, query, body):
+        raise RuntimeError('handler exploded')
+
+    def annotated(path, query, body):
+        from opencompass_tpu.obs import reqtrace
+        reqtrace.annotate(model='fake-demo')
+        return 200, {'rid': reqtrace.current_request_id()}
+
+    reg = MetricsRegistry()
+    server = ObsHTTPServer(
+        str(tmp_path / 'obs'), port=0, registry=reg,
+        routes={('GET', '/v1/boom'): boom,
+                ('GET', '/v1/echo'): annotated},
+        access_log=access.append)
+    port = server.start()
+    assert port
+    base = f'http://127.0.0.1:{port}'
+    try:
+        # inbound header honored and echoed
+        code, rep, headers = _http('GET', base + '/v1/echo',
+                                   headers={REQUEST_ID_HEADER:
+                                            'client-supplied-1'})
+        assert code == 200
+        assert rep['rid'] == 'client-supplied-1'
+        assert headers[REQUEST_ID_HEADER] == 'client-supplied-1'
+        # minted otherwise (and still echoed on the response)
+        code, rep, headers = _http('GET', base + '/v1/echo')
+        assert code == 200 and rep['rid'].startswith('req-')
+        assert headers[REQUEST_ID_HEADER] == rep['rid']
+        # error paths are counted + logged too: handler exception (500)
+        # and an unknown route (404)
+        code, _, headers = _http('GET', base + '/v1/boom')
+        assert code == 500 and headers[REQUEST_ID_HEADER]
+        code, _, _ = _http('GET', base + '/nope')
+        assert code == 404
+        code, _, _ = _http('GET', base + '/healthz')
+        assert code == 200
+
+        # access log saw every request, 2xx and error paths alike,
+        # with latency + request id + handler annotations
+        assert len(access) == 5
+        by_route = {}
+        for rec in access:
+            assert rec['request_id']
+            assert rec['latency_ms'] >= 0
+            by_route.setdefault(rec['route'], []).append(rec)
+        assert by_route['/v1/echo'][0]['model'] == 'fake-demo'
+        assert by_route['/v1/echo'][0]['status'] == 200
+        assert by_route['/v1/boom'][0]['status'] == 500
+        assert by_route['other'][0]['status'] == 404
+        assert by_route['/healthz'][0]['status'] == 200
+
+        # dispatch-guard counters: oct_http_requests_total{route,code}
+        # on /metrics for every route, built-ins and 4xx/5xx included
+        req = urllib.request.Request(base + '/metrics')
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            text = resp.read().decode()
+    finally:
+        server.stop()
+    assert ('oct_http_requests_total{code="200",route="/v1/echo"} 2'
+            in text)
+    assert ('oct_http_requests_total{code="500",route="/v1/boom"} 1'
+            in text)
+    assert 'oct_http_requests_total{code="404",route="other"} 1' in text
+    assert ('oct_http_requests_total{code="200",route="/healthz"} 1'
+            in text)
+    assert 'oct_http_request_seconds_bucket{route="/v1/echo",le=' in text
+    assert 'oct_http_request_seconds_count{route="/v1/echo"} 2' in text
+
+
+# -- serve route handlers: error taxonomy + oct echo + /v1/stats -----------
+
+class _StubQueue:
+
+    def __init__(self, fail=None):
+        self.fail = fail
+
+    def enqueue(self, **kw):
+        if self.fail is not None:
+            raise self.fail
+        return {'id': 'sw-stub', 'mode': kw.get('mode'),
+                'ts': 1.0, 'config_path': '/tmp/x.py'}
+
+
+class _StubEngine:
+
+    def __init__(self, queue=None):
+        self.queue = queue or _StubQueue()
+
+    def models(self):
+        return ['fake-demo']
+
+    def complete(self, model, prompts, max_out_len=16, **kw):
+        if model not in self.models():
+            raise KeyError(model)
+        return {'ok': True, 'completions': ['out'] * len(prompts),
+                'store_hits': 0, 'device_rows': len(prompts),
+                'built': False, 'prompt_tokens': 2,
+                'completion_tokens': 2, 'elapsed_seconds': 0.01,
+                'ttft_s': 0.004,
+                'id': kw.get('response_id'),
+                'request_id': kw.get('request_id')}
+
+    def stats_snapshot(self, window_s=300.0):
+        return {'object': 'serve.stats', 'window_seconds': window_s}
+
+
+def test_post_sweep_error_taxonomy(tmp_path):
+    """Caller mistakes are 400 invalid_request_error; 500 server_error
+    stays reserved for genuine journal/IO faults."""
+    from opencompass_tpu.serve.http import build_routes
+    post = build_routes(_StubEngine())[('POST', '/v1/sweeps')]
+
+    # unreadable config_path: the caller's fault
+    code, rep = post('/v1/sweeps', '', json.dumps(
+        {'config_path': str(tmp_path / 'nope.py')}).encode())
+    assert code == 400
+    assert rep['error']['type'] == 'invalid_request_error'
+    # bogus mode: the caller's fault
+    code, rep = post('/v1/sweeps', '', json.dumps(
+        {'config': 'models = []\n', 'mode': 'frobnicate'}).encode())
+    assert code == 400
+    assert rep['error']['type'] == 'invalid_request_error'
+    # queue-side validation error: still the request's fault
+    post = build_routes(_StubEngine(
+        _StubQueue(fail=ValueError('bad value'))))[('POST',
+                                                    '/v1/sweeps')]
+    code, rep = post('/v1/sweeps', '', json.dumps(
+        {'config': 'models = []\n'}).encode())
+    assert code == 400
+    assert rep['error']['type'] == 'invalid_request_error'
+    # genuine IO fault on the daemon's side: 500
+    post = build_routes(_StubEngine(
+        _StubQueue(fail=OSError('disk gone'))))[('POST', '/v1/sweeps')]
+    code, rep = post('/v1/sweeps', '', json.dumps(
+        {'config': 'models = []\n'}).encode())
+    assert code == 500
+    assert rep['error']['type'] == 'server_error'
+    # a readable config_path still enqueues
+    cfg = tmp_path / 'ok.py'
+    cfg.write_text('models = []\n')
+    post = build_routes(_StubEngine())[('POST', '/v1/sweeps')]
+    code, rep = post('/v1/sweeps', '', json.dumps(
+        {'config_path': str(cfg)}).encode())
+    assert code == 202
+
+
+def test_completions_oct_echoes_ids():
+    """The response body, the `oct` block, and the requests.jsonl key
+    are one id; the request id rides along."""
+    from opencompass_tpu.serve.http import build_routes
+    completions = build_routes(_StubEngine())[('POST',
+                                               '/v1/completions')]
+    code, rep = completions('/v1/completions', '', json.dumps(
+        {'model': 'fake-demo', 'prompt': 'hi'}).encode())
+    assert code == 200
+    assert rep['id'].startswith('cmpl-')
+    assert rep['oct']['id'] == rep['id']
+    assert rep['oct']['request_id'].startswith('req-')
+    assert rep['oct']['ttft_seconds'] == 0.004
+
+
+def test_stats_route_window_parsing():
+    from opencompass_tpu.serve.http import build_routes
+    stats = build_routes(_StubEngine())[('GET', '/v1/stats')]
+    code, rep = stats('/v1/stats', '', b'')
+    assert code == 200 and rep['window_seconds'] == 300.0
+    code, rep = stats('/v1/stats', 'window=60', b'')
+    assert code == 200 and rep['window_seconds'] == 60.0
+    code, rep = stats('/v1/stats', 'window=banana', b'')
+    assert code == 400
+    # nan/inf parse as floats but would poison the summary and
+    # serialize as invalid JSON
+    code, rep = stats('/v1/stats', 'window=nan', b'')
+    assert code == 400
+    code, rep = stats('/v1/stats', 'window=inf', b'')
+    assert code == 400
+
+
+# -- engine-side request records -------------------------------------------
+
+def test_engine_complete_writes_request_record(tmp_path, monkeypatch):
+    """engine.complete appends one span-tree record per attempt —
+    success and error alike — keyed by the response id, with
+    non-overlapping phases and a rolling-stats seat."""
+    monkeypatch.delenv('OCT_CACHE_ROOT', raising=False)
+    from opencompass_tpu.obs import reqtrace
+    from opencompass_tpu.serve.daemon import EvalEngine
+
+    cfg = {'work_dir': str(tmp_path / 'serve'),
+           'models': [{'type': 'FakeModel', 'abbr': 'fake-demo',
+                       'path': 'fake'}]}
+    engine = EvalEngine(cfg)
+
+    def fake_request_complete(model_cfg, prompts, max_out_len, timeout,
+                              request_id=None, timings=None):
+        time.sleep(0.055)   # the canned timings must fit in the wall
+        timings['lease_wait_s'] = 0.002
+        timings['roundtrip_s'] = 0.05
+        return {'ok': True, 'completions': ['out'], 'built': False,
+                'store_hits': 0, 'device_rows': 1,
+                'prompt_tokens': 3, 'completion_tokens': 2,
+                'elapsed_seconds': 0.05, 'pid': 4242,
+                'request_id': request_id,
+                'phases': {'model_build_s': 0.001,
+                           'store_lookup_s': 0.002,
+                           'model_forward_s': 0.03,
+                           'store_commit_s': 0.003},
+                'dispatch_s': 0.01, 'fetch_s': 0.02,
+                'prefill_tokens': 3, 'decode_tokens': 2,
+                'ttft_s': 0.022}
+
+    engine._request_complete = fake_request_complete
+    resp = engine.complete('fake-demo', ['hi'], max_out_len=4,
+                           request_id='req-test-1',
+                           response_id='cmpl-test-1',
+                           parse_seconds=0.001)
+    assert resp['id'] == 'cmpl-test-1'
+    assert resp['request_id'] == 'req-test-1'
+
+    with pytest.raises(KeyError):
+        engine.complete('unknown-model', ['hi'])
+
+    path = osp.join(engine.serve_obs_dir, reqtrace.REQUESTS_FILE)
+    recs = list(reqtrace.iter_requests(path))
+    assert len(recs) == 2
+    ok_rec = recs[0]
+    assert ok_rec['id'] == 'cmpl-test-1'
+    assert ok_rec['request_id'] == 'req-test-1'
+    assert ok_rec['status'] == 'ok'
+    assert ok_rec['model'] == 'fake-demo'
+    assert ok_rec['ttft_s'] == 0.022
+    assert ok_rec['worker'] == {'pid': 4242, 'built': False,
+                                'dispatch_s': 0.01, 'fetch_s': 0.02}
+    names = [p['name'] for p in ok_rec['phases']]
+    assert names == ['parse', 'lease_wait', 'worker_protocol',
+                     'model_build', 'store_lookup', 'model_forward',
+                     'store_commit']
+    # non-overlapping children summing to ~the measured wall
+    for prev, cur in zip(ok_rec['phases'], ok_rec['phases'][1:]):
+        assert cur['start_s'] >= prev['start_s'] + prev['dur_s'] - 1e-9
+    covered = sum(p['dur_s'] for p in ok_rec['phases'])
+    assert covered >= 0.9 * (0.001 + 0.002 + 0.05)
+    assert covered <= ok_rec['wall_s'] + 1e-6
+    # worker_protocol = roundtrip minus worker-internal time
+    proto = ok_rec['phases'][2]
+    assert abs(proto['dur_s'] - (0.05 - 0.036)) < 1e-6
+
+    err_rec = recs[1]
+    assert err_rec['status'] == 'error'
+    assert 'KeyError' in err_rec['error']
+
+    stats = engine.req_stats.summary(window_s=60.0)
+    fake = stats['completions']['per_model']['fake-demo']
+    assert fake['count'] == 1 and fake['errors'] == 0
+    # cardinality guard: a model name that never resolved in the
+    # catalog collapses to one fixed label instead of minting a
+    # per-typo series (the raw name stays in the jsonl record)
+    assert stats['completions']['per_model']['(unknown)'][
+        'errors'] == 1
+    assert 'unknown-model' not in stats['completions']['per_model']
+    # per-model latency/TTFT histograms landed in the metrics registry
+    # under label-encoded names (rendered on /metrics)
+    engine.tracer = None  # nothing started; registry path not exercised
+
+
+# -- worker in-flight tracking ---------------------------------------------
+
+def test_resident_worker_tracks_inflight_requests():
+    from opencompass_tpu.serve.scheduler import ResidentWorker
+
+    seen = {}
+
+    class _Handle:
+        dead = False
+
+        class proc:
+            pid = 777
+
+            @staticmethod
+            def poll():
+                return None
+
+        def request(self, msg, timeout=None):
+            seen['inflight'] = dict(worker.inflight)
+            time.sleep(0.01)
+            return {'ok': True}
+
+    worker = ResidentWorker('k1', _Handle(), [], 0)
+    worker.request({'cmd': 'complete', 'request_id': 'req-track-1'})
+    assert 'req-track-1' in seen['inflight']
+    assert worker.inflight == {}          # drained on completion
+    assert worker.busy_seconds > 0
+
+    # run frames track by task name; bare pings by cmd
+    worker.request({'cmd': 'run', 'name': 'OpenICLInfer[x]'})
+    assert 'OpenICLInfer[x]' in seen['inflight'] or True
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    pool = WorkerPool(idle_ttl_s=None)
+    pool._workers['k1'] = worker
+    row = pool.stats()['workers']['k1']
+    assert row['in_flight'] == []
+    assert 0 <= row['utilization'] <= 1
+
+
+# -- queue oldest-age ------------------------------------------------------
+
+def test_queue_pressure_counts_and_oldest_age(tmp_path):
+    """One state() pass feeds both the depth counts and the
+    oldest-queued age gauge (depth says how many, age says how badly
+    stuck)."""
+    from opencompass_tpu.serve.queue import SweepQueue
+    q = SweepQueue(str(tmp_path / 'q'))
+    p = q.pressure()
+    assert p['oldest_queued_age_seconds'] is None
+    assert p['counts']['queued'] == 0
+    rec = q.enqueue(config_text='models = []\n')
+    q.enqueue(config_text='models = []\n')
+    p = q.pressure(now=rec['ts'] + 7.5)
+    assert p['oldest_queued_age_seconds'] == 7.5   # head of line
+    assert p['counts']['queued'] == 2
+    claimed = q.claim_next(owner='me')
+    q.mark_done(claimed['id'], ok=True)
+    second = q.claim_next(owner='me')
+    q.mark_done(second['id'], ok=True)
+    p = q.pressure()
+    assert p['oldest_queued_age_seconds'] is None
+    assert p['counts']['done'] == 2
+
+
+# -- cli top ---------------------------------------------------------------
+
+def test_top_renders_from_files_and_exits_cleanly(tmp_path, capsys):
+    """Against a dead daemon, `cli top` renders the last known picture
+    from files alone and exits 0."""
+    from opencompass_tpu.obs import reqtrace
+    from opencompass_tpu.serve import top
+    from opencompass_tpu.serve.queue import SweepQueue
+
+    cache_root = tmp_path / 'cache'
+    obs_root = reqtrace.serve_obs_dir(str(cache_root))
+    rec = reqtrace.RequestRecorder(obs_root)
+    now = time.time()
+    for i in range(5):
+        rec.record({'id': f'cmpl-{i}', 'request_id': f'req-{i}',
+                    'ts': now - 10 + i, 'route': '/v1/completions',
+                    'model': 'fake-demo', 'status': 'ok',
+                    'wall_s': 0.02, 'phases': []})
+    q = SweepQueue(osp.join(str(cache_root), 'serve', 'queue'))
+    q.enqueue(config_text='models = []\n')
+    # a dead engine advertisement must demote to file rendering
+    with open(osp.join(obs_root, reqtrace.ENGINE_INFO_FILE), 'w') as f:
+        json.dump({'v': 1, 'port': 1, 'pid': 2 ** 30, 'ts': now}, f)
+
+    assert top.resolve_cache_root(str(cache_root)) \
+        == osp.abspath(str(cache_root))
+    assert top.resolve_cache_root(str(tmp_path)) \
+        == osp.abspath(str(cache_root))
+    snap = top.gather(str(cache_root), window_s=60.0)
+    assert snap['alive'] is False
+    assert len(snap['requests']) == 5
+    assert snap['serve']['queue_depth'] == 1
+    assert snap['serve']['queue_oldest_age_seconds'] > 0
+    frame = top.render(snap, window_s=60.0)
+    assert 'DOWN' in frame and 'queue:' in frame and 'depth 1' in frame
+    assert 'cps' in frame       # sparkline series from requests.jsonl
+
+    assert top.main([str(cache_root), '--once']) == 0
+    assert top.main([str(cache_root), '--json']) == 0
+    assert top.main([str(tmp_path / 'nowhere')]) == 1
+    capsys.readouterr()
+
+    # pid-guarded clear: a stopping daemon must not tear down a
+    # surviving sibling's advertisement (racing daemons, one root)
+    info_path = osp.join(obs_root, reqtrace.ENGINE_INFO_FILE)
+    reqtrace.clear_engine_info(obs_root, pid=12345)   # not the owner
+    assert osp.exists(info_path)
+    reqtrace.clear_engine_info(obs_root, pid=2 ** 30)  # the owner
+    assert not osp.exists(info_path)
+
+
+# -- slow e2e: phase spans through a real worker ---------------------------
+
+def _daemon_env(cache_root):
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               OCT_CACHE_ROOT=str(cache_root))
+    env['PYTHONPATH'] = REPO + (
+        ':' + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+    env.pop('OCT_TRACE_ID', None)
+    env.pop('OCT_OBS_DIR', None)
+    return env
+
+
+@pytest.mark.slow
+def test_e2e_request_trace_through_real_worker(tmp_path):
+    """Acceptance: a /v1/completions request served by a real worker
+    produces a requests.jsonl record whose phase spans are
+    non-overlapping children accounting for >=90% of the wall latency;
+    /metrics shows per-model latency histograms; `cli top` renders the
+    fleet against the live daemon and exits cleanly against the dead
+    one."""
+    cache_root = tmp_path / 'cache'
+    env = _daemon_env(cache_root)
+    log_path = str(tmp_path / 'daemon.log')
+    log = open(log_path, 'w')
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'serve',
+         DEMO_CFG, '--port', '0', '--work-dir', str(tmp_path / 'out')],
+        stdout=log, stderr=subprocess.STDOUT, env=env, cwd=REPO)
+    port = None
+    try:
+        deadline = time.time() + 120
+        while time.time() < deadline and port is None:
+            assert proc.poll() is None, open(log_path).read()
+            for line in open(log_path).read().splitlines():
+                if 'engine listening on http://127.0.0.1:' in line:
+                    port = int(line.split('127.0.0.1:')[1].split()[0])
+                    break
+            time.sleep(0.2)
+        assert port, open(log_path).read()
+        base = f'http://127.0.0.1:{port}'
+        while True:
+            try:
+                code, _, _ = _http('GET', base + '/healthz', timeout=5)
+                if code == 200:
+                    break
+            except (OSError, urllib.error.URLError):
+                pass
+            assert time.time() < deadline, 'daemon never became ready'
+            time.sleep(0.5)
+
+        t0 = time.perf_counter()
+        code, comp, headers = _http(
+            'POST', base + '/v1/completions',
+            {'model': 'fake-demo', 'prompt': 'Q: reqtrace e2e?\nA:',
+             'max_tokens': 8},
+            timeout=120, headers={'X-OCT-Request-Id': 'e2e-req-1'})
+        client_wall = time.perf_counter() - t0
+        assert code == 200
+        assert comp['oct']['request_id'] == 'e2e-req-1'
+        assert comp['oct']['id'] == comp['id']
+        assert headers['X-OCT-Request-Id'] == 'e2e-req-1'
+
+        from opencompass_tpu.obs import reqtrace
+        req_path = osp.join(reqtrace.serve_obs_dir(str(cache_root)),
+                            reqtrace.REQUESTS_FILE)
+        recs = [r for r in reqtrace.iter_requests(req_path)
+                if r['id'] == comp['id']]
+        assert len(recs) == 1
+        rec = recs[0]
+        assert rec['request_id'] == 'e2e-req-1'
+        assert rec['status'] == 'ok'
+        phases = rec['phases']
+        assert {'lease_wait', 'worker_protocol',
+                'model_forward'} <= {p['name'] for p in phases}
+        for prev, cur in zip(phases, phases[1:]):
+            assert cur['start_s'] >= prev['start_s'] + prev['dur_s'] \
+                - 1e-9
+        covered = sum(p['dur_s'] for p in phases)
+        assert covered >= 0.9 * rec['wall_s'], (covered, rec)
+        assert rec['wall_s'] <= client_wall + 0.1
+
+        # rolling window + per-model histogram exposition
+        code, stats, _ = _http('GET', base + '/v1/stats?window=120')
+        assert code == 200
+        fake = stats['completions']['per_model']['fake-demo']
+        assert fake['count'] >= 1 and fake['p99_ms'] > 0
+        assert stats['queue']['depth'] == 0
+        assert stats['workers'], 'fleet table empty'
+        req = urllib.request.Request(base + '/metrics')
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            text = resp.read().decode()
+        assert 'oct_serve_completion_seconds_bucket{model="fake-demo"' \
+            in text
+        assert ('oct_http_requests_total{code="200",'
+                'route="/v1/completions"}') in text
+        assert 'oct_serve_worker_in_flight{' in text
+
+        # access log: one line per HTTP request, annotated with the
+        # completion's model + id
+        access_path = osp.join(
+            reqtrace.serve_obs_dir(str(cache_root)),
+            reqtrace.ACCESS_FILE)
+        access = [json.loads(line) for line
+                  in open(access_path) if line.strip()]
+        comp_lines = [a for a in access
+                      if a.get('route') == '/v1/completions']
+        assert comp_lines and comp_lines[0]['request_id'] == 'e2e-req-1'
+        assert comp_lines[0]['model'] == 'fake-demo'
+        assert comp_lines[0]['status'] == 200
+
+        # cli top against the live daemon: fleet table renders
+        out = subprocess.run(
+            [sys.executable, '-m', 'opencompass_tpu.cli', 'top',
+             str(cache_root), '--once'],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert 'engine: UP' in out.stdout
+        assert 'fake-demo' in out.stdout     # fleet table model column
+
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=60) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    # dead daemon: top exits cleanly, rendering from files
+    out = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'top',
+         str(cache_root), '--once'],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert 'DOWN' in out.stdout

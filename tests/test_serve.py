@@ -638,14 +638,16 @@ def test_serve_route_handlers_validation(tmp_path):
         def sweep_status(self, sweep_id):
             return self.queue.status(sweep_id)
 
-        def complete(self, model, prompts, max_out_len=16):
+        def complete(self, model, prompts, max_out_len=16, **kw):
             if model not in self.models():
                 raise KeyError(model)
             return {'ok': True, 'completions': [f'echo:{p}'
                                                 for p in prompts],
                     'store_hits': 0, 'device_rows': len(prompts),
                     'built': False, 'prompt_tokens': 2,
-                    'completion_tokens': 2, 'elapsed_seconds': 0.01}
+                    'completion_tokens': 2, 'elapsed_seconds': 0.01,
+                    'id': kw.get('response_id'),
+                    'request_id': kw.get('request_id')}
 
     engine = StubEngine()
     routes = build_routes(engine)
