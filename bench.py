@@ -572,6 +572,142 @@ def _bench_flight_recorder(out_json='BENCH_FLIGHT.json'):
     return record
 
 
+def _bench_continuous(out_json='BENCH_DECODE.json'):
+    """detail.continuous_batching: the continuous-batching decode engine
+    vs the fixed-shape ``lax.while_loop`` path on a skewed-length gen
+    workload (tiny JaxLM, CPU-runnable).
+
+    Skew is the serving-realistic kind: mixed prompt lengths AND mixed
+    decode budgets (4/8/32 new tokens).  The fixed-shape path must run
+    each (B×S bucket, max_new) combination as its own compiled
+    executable and every row in a batch waits for the batch's longest;
+    the engine runs ONE decode shape (slots×1) + ONE prefill-chunk
+    shape, rows join as others retire, and each row pays only its own
+    tokens.  Asserts greedy token-identity between the two paths and
+    exactly one decode shape in the compile-cache manifest."""
+    import tempfile
+
+    from opencompass_tpu.models import JaxLM
+    from opencompass_tpu.utils import compile_cache
+    from opencompass_tpu.utils.compile_cache import load_manifest
+
+    cache_dir = tempfile.mkdtemp(prefix='oct_cont_cache_')
+    os.environ['OCT_COMPILE_CACHE'] = cache_dir
+    compile_cache.enable()
+
+    rng = np.random.RandomState(7)
+    prompts = []
+    budgets = []
+    for i in range(20):
+        n_words = int(rng.choice([3, 6, 12, 40, 90]))
+        prompts.append(' '.join(
+            f'w{rng.randint(999)}' for _ in range(n_words)))
+        budgets.append(int(rng.choice([4, 4, 8, 8, 8, 32])))
+
+    # -- fixed-shape path: group rows by decode budget (as a sweep of
+    # per-task max_out_len values would), sub-batch at 8
+    lm_fixed = JaxLM(config='tiny', max_seq_len=256)
+    fixed_texts = [None] * len(prompts)
+    fixed_lat = [None] * len(prompts)
+    t0 = time.perf_counter()
+    by_budget = {}
+    for i, b in enumerate(budgets):
+        by_budget.setdefault(b, []).append(i)
+    for b, idxs in sorted(by_budget.items()):
+        for lo in range(0, len(idxs), 8):
+            chunk = idxs[lo:lo + 8]
+            outs = lm_fixed.generate([prompts[i] for i in chunk],
+                                     max_out_len=b)
+            done = time.perf_counter() - t0
+            for i, out in zip(chunk, outs):
+                fixed_texts[i] = out
+                fixed_lat[i] = done
+    fixed_wall = time.perf_counter() - t0
+    fixed_tokens = lm_fixed.perf.tokens_out
+    fixed_gen_shapes = sorted(
+        {k[1:] for k in lm_fixed._dispatched_keys if k[0] == 'gen'})
+
+    # -- continuous engine: every row enters the feed queue with its own
+    # budget; rows join the resident step as slots free up
+    lm_cont = JaxLM(config='tiny', max_seq_len=256,
+                    continuous_batching=True, decode_slots=4,
+                    kv_page_size=32)
+    engine = lm_cont.continuous_engine()
+    cap = lm_cont.max_seq_len
+    ids = [lm_cont._encode_ids(p) for p in prompts]
+    ids = [r[:max(cap - b, 32)] for r, b in zip(ids, budgets)]
+    cont_texts = [None] * len(prompts)
+    cont_lat = [None] * len(prompts)
+    t0 = time.perf_counter()
+    order = sorted(range(len(ids)), key=lambda i: (-len(ids[i]), i))
+    rows = [engine.submit(ids[i], budgets[i], tag=i) for i in order]
+
+    def deliver(row):
+        toks = [t for t in row.emitted if t != lm_cont.eos_token_id] \
+            if lm_cont.eos_token_id is not None else row.emitted
+        cont_texts[row.tag] = lm_cont.tokenizer.decode(toks)
+        cont_lat[row.tag] = time.perf_counter() - t0
+
+    engine.drain(rows, deliver)
+    cont_wall = time.perf_counter() - t0
+    cont_tokens = sum(len(r.emitted) for r in rows)
+    sig = lm_cont.shape_signature
+    manifest = load_manifest(cache_dir).get(sig, {})
+    decode_shapes = sorted(k for k in manifest if k.startswith('decode:'))
+
+    identical = fixed_texts == cont_texts
+
+    def p95(vals):
+        # nearest-rank: ceil(q*n)-1 (same convention as reqtrace's
+        # rolling-window percentiles)
+        vals = sorted(vals)
+        return vals[max(0, -(-95 * len(vals) // 100) - 1)]
+
+    fixed_tps = fixed_tokens / max(fixed_wall, 1e-9)
+    cont_tps = cont_tokens / max(cont_wall, 1e-9)
+    record = {
+        'v': 1,
+        'workload': '20 rows, prompt words in {3..90}, decode budgets '
+                    '{4,8,32}, tiny JaxLM (CPU); fixed path groups by '
+                    'budget at batch 8, engine runs 4 slots / page 32',
+        'rows': len(prompts),
+        'decode_tokens_fixed': int(fixed_tokens),
+        'decode_tokens_continuous': int(cont_tokens),
+        'fixed_wall_seconds': round(fixed_wall, 3),
+        'continuous_wall_seconds': round(cont_wall, 3),
+        'fixed_tokens_per_sec': round(fixed_tps, 1),
+        'continuous_tokens_per_sec': round(cont_tps, 1),
+        'tokens_per_sec_speedup': round(cont_tps / max(fixed_tps, 1e-9),
+                                        2),
+        'fixed_row_latency_p95_s': round(p95(fixed_lat), 3),
+        'continuous_row_latency_p95_s': round(p95(cont_lat), 3),
+        'fixed_gen_compile_shapes': len(fixed_gen_shapes),
+        'continuous_compile_shapes': 2,
+        'decode_manifest_shapes': decode_shapes,
+        'slot_util': engine.stats()['slot_util'],
+        'greedy_identical': bool(identical),
+    }
+    assert identical, 'continuous outputs diverged from fixed-shape path'
+    assert len(decode_shapes) == 1, decode_shapes
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, out_json), 'w') as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
+    _append_trajectory(
+        'continuous_batching', 'tokens_per_sec_speedup',
+        record['tokens_per_sec_speedup'], 'x', direction='higher',
+        detail={'fixed_tokens_per_sec': record['fixed_tokens_per_sec'],
+                'continuous_tokens_per_sec':
+                    record['continuous_tokens_per_sec'],
+                'row_latency_p95_s':
+                    record['continuous_row_latency_p95_s'],
+                'slot_util': record['slot_util'],
+                'decode_manifest_shapes': decode_shapes})
+    return record
+
+
 def _bench_serve(out_json='BENCH_SERVE.json'):
     """detail.serve: the evaluation-as-a-service loop end to end —
     daemon up (fleet warmed), demo sweep enqueued, an interactive
@@ -1079,5 +1215,10 @@ if __name__ == '__main__':
         # standalone serve-daemon leg (device-free; runs on CPU hosts)
         print(json.dumps({'metric': 'serve', 'v': 1,
                           'detail': _bench_serve()}))
+        sys.exit(0)
+    if '--continuous-batching' in sys.argv:
+        # standalone continuous-batching leg (tiny JaxLM; CPU-runnable)
+        print(json.dumps({'metric': 'continuous_batching', 'v': 1,
+                          'detail': _bench_continuous()}))
         sys.exit(0)
     main()

@@ -141,6 +141,23 @@ class GenInferencer(BaseInferencer):
             get_heartbeat().progress(len(done_idx), len(prompts),
                                      cached=len(done_idx), force=True)
 
+        state = {'completed': len(done_idx), 'last_flush': len(done_idx)}
+
+        # continuous-batching engine: when the model's resident decode
+        # engine is active the planner degenerates to a feed queue —
+        # rows stream into the engine's fixed slot set and retire
+        # individually, so save/commit/flush and the heartbeat tick per
+        # row instead of per fixed-shape batch
+        if (todo and self.plan_enabled
+                and getattr(self.model, 'continuous_active', False)
+                and type(self)._generate_batch
+                is GenInferencer._generate_batch):
+            self._run_continuous(prompts, todo, handler, row_keys,
+                                 ctx if commit else None, state,
+                                 out_dir, out_name, obs_on,
+                                 cached_rows=len(done_idx))
+            return self._finalize(handler, out_dir, out_name, scratch)
+
         # a generation batch pads prompts to max_seq_len - max_out_len at
         # most (the model reserves decode room); clamp planned lengths the
         # same way so planned shapes match dispatched ones
@@ -154,8 +171,6 @@ class GenInferencer(BaseInferencer):
         else:
             lengths = [1] * len(todo)
         plan = self.make_plan(lengths, seq_cap=seq_cap)
-
-        state = {'completed': len(done_idx), 'last_flush': len(done_idx)}
 
         def dispatch(batch):
             chunk = [prompts[todo[p]] for p in batch.indices]
@@ -185,7 +200,9 @@ class GenInferencer(BaseInferencer):
 
         self.run_plan(plan, dispatch, collect, kind='gen',
                       cached_rows=len(done_idx))
+        return self._finalize(handler, out_dir, out_name, scratch)
 
+    def _finalize(self, handler, out_dir, out_name, scratch) -> List:
         # restore dataset order: out-of-order execution (and idx-keyed
         # resume) fill results_dict in completion order
         order = sorted(int(k) for k in handler.results_dict)
@@ -199,6 +216,56 @@ class GenInferencer(BaseInferencer):
                 os.remove(scratch)
         return [sample['prediction']
                 for sample in handler.results_dict.values()]
+
+    def _run_continuous(self, prompts, todo, handler, row_keys, ctx,
+                        state, out_dir, out_name, obs_on,
+                        cached_rows: int = 0):
+        """Feed every miss into the model's continuous-batching engine
+        and collect rows as they retire.  Store commits, tmp flushes,
+        and heartbeat ``rows_done`` all happen per retired row — with
+        continuous batching rows complete individually, so batch-sized
+        progress jumps (and the batch-granular ETA) disappear."""
+        from opencompass_tpu.obs import get_timeline
+        chunk = [prompts[i] for i in todo]
+        shown = self.model.parse_template(chunk, mode='gen')
+        if not isinstance(shown, list):
+            shown = [shown]
+        timeline = get_timeline()
+        if timeline.enabled:
+            # plan record for the ledger's kind attribution + cached-row
+            # accounting; the shape census is the engine's two shapes
+            stats = {'n_rows': len(todo), 'continuous': True}
+            plan_info = getattr(self.model, 'continuous_plan', None)
+            cont = plan_info() if plan_info is not None else None
+            if cont:
+                stats['shapes'] = {cont['decode_shape']: 1,
+                                   cont['prefill_shape']: 1}
+                stats['n_shapes'] = 2
+            timeline.plan('gen', stats=stats, planned=True,
+                          cached_rows=cached_rows)
+        total = len(prompts)
+
+        def on_result(k, text):
+            i = todo[k]
+            handler.save_results(shown[k], text, i)
+            if ctx is not None:
+                ctx.put(row_keys[i], text)
+            state['completed'] += 1
+            if obs_on:
+                from opencompass_tpu.obs import get_heartbeat, get_tracer
+                get_tracer().counter('inferencer.gen_rows').inc()
+                hb = get_heartbeat()
+                if hb.enabled:
+                    hb.progress(done=state['completed'], total=total)
+            if (self.save_every is not None and self.is_main_process
+                    and state['completed'] - state['last_flush']
+                    >= self.save_every):
+                handler.write_to_json(out_dir, 'tmp_' + out_name)
+                state['last_flush'] = state['completed']
+
+        self.model.generate_continuous([str(s) for s in shown],
+                                       self.max_out_len,
+                                       on_result=on_result)
 
     def _resume(self, scratch_path: str) -> dict:
         """Sample-level resume from a previous run's tmp_ flush.  Rank 0
@@ -271,7 +338,26 @@ class GenInferencer(BaseInferencer):
         if model_max:
             seq_cap = max(model_max - self.max_out_len, 32)
         lengths = self.measure_lengths(prompts, 'gen', cap=seq_cap)
-        return preview_from_lengths(self, lengths, seq_cap=seq_cap)
+        preview = preview_from_lengths(self, lengths, seq_cap=seq_cap)
+        # continuous-batching engine: when eligible the per-bucket B×S
+        # census above is moot — the sweep dispatches exactly two
+        # compiled shapes and occupancy replaces padding efficiency.
+        # Configs the engine rejects (beams/ALiBi/...) keep the census.
+        cont_plan = getattr(self.model, 'continuous_plan', None)
+        cont = cont_plan() if (
+            cont_plan is not None and self.plan_enabled
+            and getattr(self.model, 'continuous_eligible', False)) \
+            else None
+        if cont:
+            page = cont['page_size']
+            cont = dict(cont)
+            cont['rows'] = len(lengths)
+            cont['expected_in_flight'] = min(cont['slots'], len(lengths))
+            cont['est_pages_per_row'] = round(sum(
+                -(-(n + self.max_out_len) // page)
+                for n in lengths) / max(len(lengths), 1), 1)
+            preview['continuous'] = cont
+        return preview
 
 
 def preview_from_lengths(inferencer, lengths, groups=None,

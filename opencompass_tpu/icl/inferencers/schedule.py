@@ -295,6 +295,22 @@ def sequential_plan(lengths: Sequence[int],
                      planned=False)
 
 
+def feed_queue_order(lengths: Sequence[int]) -> List[int]:
+    """Row admission order for the continuous-batching engine.
+
+    When a model's resident decode engine is active the planner's whole
+    batch-shape problem disappears — every device step is one fixed
+    (slots, T) shape — so the planner degenerates to this: an order for
+    feeding rows into the engine's queue.  Longest prompts first, so
+    the expensive prefill chunks are in flight while shorter rows fill
+    the remaining slots behind them (the same pay-the-worst-first
+    rationale as :func:`plan_batches`' shape ordering); ties break on
+    original position for determinism.
+    """
+    return sorted(range(len(lengths)),
+                  key=lambda i: (-max(int(lengths[i]), 1), i))
+
+
 # ---------------------------------------------------------------------------
 # execution
 # ---------------------------------------------------------------------------

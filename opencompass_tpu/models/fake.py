@@ -25,12 +25,18 @@ class FakeModel(BaseModel):
     * ``get_token_len``: whitespace token count (×1 token per word).
     """
 
+    # mirrors JaxLM's continuous-batching contract so the inferencer's
+    # feed-queue wiring (out-of-order retirement, per-row flush/commit)
+    # is testable without a device
+    supports_continuous_batching = True
+
     def __init__(self,
                  path: str = 'fake',
                  max_seq_len: int = 2048,
                  meta_template: Optional[Dict] = None,
                  canned_responses: Optional[Dict[str, str]] = None,
                  canned_ppls: Optional[Dict[str, float]] = None,
+                 continuous: bool = False,
                  tokenizer_only: bool = False):
         super().__init__(path=path,
                          max_seq_len=max_seq_len,
@@ -38,6 +44,31 @@ class FakeModel(BaseModel):
                          meta_template=meta_template)
         self.canned_responses = canned_responses or {}
         self.canned_ppls = canned_ppls or {}
+        self.continuous_batching = continuous
+
+    @property
+    def continuous_active(self) -> bool:
+        return self.continuous_batching
+
+    def generate_continuous(self, inputs: List[str], max_out_len: int,
+                            on_result=None, stats_out=None) -> List[str]:
+        """FakeModel 'engine': same pure outputs as :meth:`generate`,
+        delivered per row in the engine's feed order (longest prompt
+        first) — deliberately NOT dataset order, so callers must
+        scatter results back exactly as they would for the real
+        engine's out-of-order retirements."""
+        texts = self.generate(list(inputs), max_out_len=max_out_len)
+        order = sorted(range(len(texts)),
+                       key=lambda i: (-len(str(inputs[i]).split()), i))
+        for k in order:
+            if on_result is not None:
+                on_result(k, texts[k])
+        if stats_out is not None:
+            stats_out['prefill_tokens'] = sum(
+                self.get_token_len(str(p)) for p in inputs)
+            stats_out['decode_tokens'] = sum(
+                self.get_token_len(t) for t in texts)
+        return texts
 
     def generate(self, inputs: List[str], max_out_len: int) -> List[str]:
         self.perf.samples += len(inputs)

@@ -21,12 +21,14 @@ Design points (SURVEY.md §7):
 """
 from __future__ import annotations
 
+import collections
 import functools
 import hashlib
 import os
+import threading
 import time
 from collections import OrderedDict
-from typing import Dict, List, Optional, Union
+from typing import Callable, Dict, List, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +39,8 @@ from jax.sharding import PartitionSpec as P
 
 from opencompass_tpu.nn import (TransformerConfig, beam_generate, forward,
                                 greedy_generate, greedy_generate_prefixed,
-                                init_params, sequence_nll, shard_params)
+                                init_params, paged_generate_step,
+                                sequence_nll, shard_params)
 from opencompass_tpu.parallel.mesh import MeshSpec, make_mesh, use_mesh
 from opencompass_tpu.registry import MODELS
 from opencompass_tpu.utils.logging import get_logger
@@ -55,6 +58,372 @@ def _bucket(n: int, lo: int = 32, hi: Optional[int] = None) -> int:
     while b < n:
         b *= 2
     return min(b, hi) if hi else b
+
+
+class _EngineRow:
+    """One sequence moving through the continuous engine."""
+    __slots__ = ('ids', 'max_new', 'tag', 'emitted', 'kv_len', 'slot',
+                 'done', 'retire_seq', 'event', 'interactive',
+                 'submit_ts', 'first_token_ts', 'done_ts')
+
+    def __init__(self, ids, max_new, tag, interactive=False):
+        self.ids = list(ids)
+        self.max_new = int(max_new)
+        self.tag = tag
+        self.emitted: List[int] = []
+        self.kv_len = 0
+        self.slot: Optional[int] = None
+        self.done = False
+        self.retire_seq: Optional[int] = None
+        self.event = threading.Event()
+        self.interactive = interactive
+        self.submit_ts = time.perf_counter()
+        self.first_token_ts: Optional[float] = None
+        self.done_ts: Optional[float] = None
+
+
+class ContinuousEngine:
+    """Slot-based continuous batcher over a paged KV cache.
+
+    A fixed-capacity set of ``slots`` in-flight sequences shares one
+    preallocated page pool (nn/paged_kv.py).  Rows join as earlier rows
+    retire, prompts prefill in page-sized chunks, and every device call
+    is one of exactly two compiled shapes — ``(slots, page_size)`` for
+    prefill chunks and ``(slots, 1)`` for decode — regardless of the
+    in-flight length mix.  That replaces the fixed-shape path's
+    per-``B×S``-bucket executables and its short-rows-wait-for-long-rows
+    padding with one resident step.
+
+    Thread model: any number of threads may :meth:`submit` rows (the
+    serve data plane joins interactive requests mid-sweep this way);
+    whoever calls :meth:`drain` drives device steps — a non-blocking
+    driver lock guarantees exactly one stepping thread, and waiters
+    whose rows are being carried by someone else's drain just wait on
+    their rows' events.  Greedy outputs are per-row deterministic
+    regardless of co-residents (each slot's attention spans only its
+    own pages, and the batch shape never changes).
+    """
+
+    def __init__(self, model: 'JaxLM', slots: int, page_size: int,
+                 num_pages: Optional[int] = None):
+        from opencompass_tpu.nn.paged_kv import (PageAllocator, PageTable,
+                                                 init_page_pool,
+                                                 pages_per_seq,
+                                                 pool_pages_for)
+        self.model = model
+        self.cfg = model.cfg
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.max_pages = pages_per_seq(model.max_seq_len, page_size)
+        self.num_pages = int(num_pages or pool_pages_for(
+            self.slots, model.max_seq_len, page_size))
+        self.pool = init_page_pool(self.cfg, self.num_pages, page_size)
+        self.alloc = PageAllocator(self.num_pages)
+        self.table = PageTable(self.slots, self.max_pages)
+        self._slots: List[Optional[_EngineRow]] = [None] * self.slots
+        self._queue: 'collections.deque[_EngineRow]' = collections.deque()
+        self._lock = threading.Lock()         # queue/slots/alloc/stats
+        self._driver = threading.Lock()       # one stepping thread
+        (self.temperature, self.top_k, self._seed, num_beams,
+         _lp) = model._gen_params()
+        if num_beams > 1:
+            raise ValueError('continuous batching is greedy/sampling '
+                             'only (num_beams == 1)')
+        self._base_rng = jax.random.PRNGKey(self._seed)
+        # donation keeps the pool update in place on accelerators; CPU
+        # ignores donation (and warns), so skip it there
+        donate = (1,) if jax.default_backend() != 'cpu' else ()
+        cfg, ps = self.cfg, self.page_size
+        temp, top_k = self.temperature, self.top_k
+
+        def _step(params, pool, tokens, start, n_new, page_table, rng):
+            return paged_generate_step(params, cfg, tokens, start, n_new,
+                                       page_table, pool, ps, rng,
+                                       temp, top_k)
+        self._step_fn = jax.jit(_step, donate_argnums=donate)
+        # telemetry (all under self._lock).  Counters are engine-
+        # lifetime; per-drain deltas come from snapshot()/stats(since=)
+        # so a resident engine's Nth task reports only its own work.
+        # The occupancy series is display-only (sparklines) and
+        # bounded — a serve daemon's engine decodes for days
+        self.steps = 0
+        self.prefill_steps = 0
+        self.decode_steps = 0
+        self.occupancy_sum = 0      # active slots summed over steps
+        self.joined = 0
+        self.retired = 0
+        self._retire_seq = 0
+        self._occ_series: 'collections.deque[int]' = collections.deque(
+            maxlen=4096)
+
+    # -- intake ------------------------------------------------------------
+
+    def submit(self, ids: List[int], max_new: int, tag=None,
+               interactive: bool = False) -> _EngineRow:
+        """Queue one sequence; it joins the resident step as a slot (and
+        enough pool pages) free up.  Raises when the row could never fit
+        the pool — callers fall back to the dense path for it."""
+        from opencompass_tpu.nn.paged_kv import OutOfPages, pages_per_seq
+        need = pages_per_seq(len(ids) + max_new, self.page_size)
+        if need > self.max_pages:
+            raise ValueError(
+                f'row needs {need} pages (> {self.max_pages} per-sequence '
+                f'max); prompt + max_new must fit max_seq_len '
+                f'({self.model.max_seq_len})')
+        if need > self.num_pages - 1:
+            raise OutOfPages(
+                f'row needs {need} pages but the pool holds '
+                f'{self.num_pages - 1}; raise kv_pool_pages')
+        row = _EngineRow(ids, max_new, tag, interactive=interactive)
+        with self._lock:
+            self._queue.append(row)
+        return row
+
+    def _admit_locked(self):
+        from opencompass_tpu.nn.paged_kv import OutOfPages, pages_per_seq
+        for slot in range(self.slots):
+            if self._slots[slot] is not None or not self._queue:
+                continue
+            row = self._queue[0]
+            need = pages_per_seq(len(row.ids) + row.max_new,
+                                 self.page_size)
+            try:
+                pages = self.alloc.alloc(need)
+            except OutOfPages:
+                break           # FIFO back-pressure: retries next step
+            self._queue.popleft()
+            self.table.assign(slot, pages)
+            row.slot = slot
+            self._slots[slot] = row
+            self.joined += 1
+
+    def _retire_locked(self, row: _EngineRow):
+        self.alloc.free(self.table.clear(row.slot))
+        self._slots[row.slot] = None
+        row.slot = None
+        row.done = True
+        row.retire_seq = self._retire_seq
+        self._retire_seq += 1
+        self.retired += 1
+        row.done_ts = time.perf_counter()
+
+    # -- device stepping ---------------------------------------------------
+
+    def _device_step(self) -> bool:
+        """One engine step (caller holds the driver lock).  Returns
+        False when there was nothing to do."""
+        model = self.model
+        with self._lock:
+            self._admit_locked()
+            active = [r for r in self._slots if r is not None]
+            if not active:
+                return False
+            prefilling = [r for r in active if r.kv_len < len(r.ids)]
+            t = self.page_size if prefilling else 1
+            tokens = np.zeros((self.slots, t), np.int32)
+            start = np.zeros((self.slots,), np.int32)
+            n_new = np.zeros((self.slots,), np.int32)
+            if prefilling:
+                for row in prefilling:
+                    chunk = row.ids[row.kv_len:row.kv_len + t]
+                    tokens[row.slot, :len(chunk)] = chunk
+                    start[row.slot] = row.kv_len
+                    n_new[row.slot] = len(chunk)
+            else:
+                for row in active:
+                    tokens[row.slot, 0] = row.emitted[-1]
+                    start[row.slot] = row.kv_len
+                    n_new[row.slot] = 1
+            page_table = self.table.table.copy()
+            self.steps += 1
+            step_no = self.steps
+            if prefilling:
+                self.prefill_steps += 1
+            else:
+                self.decode_steps += 1
+                self.occupancy_sum += len(active)
+                self._occ_series.append(len(active))
+
+        first = model._first_dispatch(
+            'prefill_chunk' if prefilling else 'decode',
+            (self.slots, t), self.temperature, self.top_k)
+        cs0 = model.perf.compile_seconds
+        t0 = time.perf_counter()
+        rng = jax.random.fold_in(self._base_rng, step_no)
+        nxt, self.pool = self._step_fn(
+            model.params, self.pool, jnp.asarray(tokens),
+            jnp.asarray(start), jnp.asarray(n_new),
+            jnp.asarray(page_table), rng)
+        nxt = np.asarray(nxt)
+        elapsed = time.perf_counter() - t0
+        perf = model.perf
+        perf.device_seconds += elapsed
+        perf.calls += 1
+        if first:
+            perf.compile_seconds += elapsed
+            perf.first_calls += 1
+            model._note_compile(
+                'prefill_chunk' if prefilling else 'decode',
+                (self.slots, t), perf.compile_seconds - cs0)
+
+        eos = model.eos_token_id
+        retired: List[_EngineRow] = []
+        with self._lock:
+            for row in [r for r in self._slots if r is not None]:
+                n = int(n_new[row.slot])
+                if not n:
+                    continue
+                row.kv_len += n
+                if row.kv_len < len(row.ids):
+                    continue        # still prefilling
+                tok = int(nxt[row.slot])
+                if not row.emitted:
+                    row.first_token_ts = time.perf_counter()
+                row.emitted.append(tok)
+                if (eos is not None and tok == eos) \
+                        or len(row.emitted) >= row.max_new:
+                    self._retire_locked(row)
+                    retired.append(row)
+            self._note_heartbeat_locked()
+        for row in retired:
+            row.event.set()
+        return True
+
+    def _note_heartbeat_locked(self):
+        """Live decode-slot utilization into this task's heartbeat (the
+        status plane's ``decode_slot_util`` / ``oct_run_decode_slot_util``
+        signal).  Rate-limited by the heartbeat itself; never fails."""
+        if self.decode_steps and self.decode_steps % 8 == 0:
+            try:
+                from opencompass_tpu.obs import get_heartbeat
+                hb = get_heartbeat()
+                if hb.enabled:
+                    hb.note(decode_slot_util=round(self.slot_util, 4))
+            except Exception:
+                pass
+
+    def warm(self) -> int:
+        """Pre-compile the engine's two shapes (prefill chunk and
+        decode) with an all-inactive dummy step — writes land on the
+        garbage page, the pool is otherwise untouched.  Returns the
+        number of shapes compiled (0 when both are already hot)."""
+        model = self.model
+        warmed = 0
+        for t in (self.page_size, 1):
+            kind = 'prefill_chunk' if t > 1 else 'decode'
+            if not model._first_dispatch(kind, (self.slots, t),
+                                         self.temperature, self.top_k):
+                continue
+            cs0 = model.perf.compile_seconds
+            with device_call(model.perf, first=True):
+                nxt, self.pool = self._step_fn(
+                    model.params, self.pool,
+                    jnp.zeros((self.slots, t), jnp.int32),
+                    jnp.zeros((self.slots,), jnp.int32),
+                    jnp.zeros((self.slots,), jnp.int32),
+                    jnp.asarray(self.table.table),
+                    self._base_rng)
+                jax.block_until_ready(nxt)
+            model._note_compile(kind, (self.slots, t),
+                                model.perf.compile_seconds - cs0)
+            warmed += 1
+        return warmed
+
+    @property
+    def slot_util(self) -> float:
+        """Mean fraction of decode-step slots occupied by live rows."""
+        if not self.decode_steps:
+            return 0.0
+        return self.occupancy_sum / (self.decode_steps * self.slots)
+
+    def snapshot(self) -> Dict:
+        """Counter snapshot for per-drain deltas (``stats(since=...)``)."""
+        with self._lock:
+            return {'steps': self.steps,
+                    'prefill_steps': self.prefill_steps,
+                    'decode_steps': self.decode_steps,
+                    'occupancy_sum': self.occupancy_sum,
+                    'joined': self.joined, 'retired': self.retired}
+
+    def stats(self, since: Optional[Dict] = None) -> Dict:
+        """Engine counters — lifetime by default, or the delta since a
+        :meth:`snapshot` (what one drained call did; the flight
+        recorder's per-drain ``engine`` records use this so a resident
+        engine's Nth task never re-reports task N-1's steps)."""
+        base = since or {}
+        with self._lock:
+            from opencompass_tpu.obs.timeline import _downsample
+            d_decode = self.decode_steps - base.get('decode_steps', 0)
+            d_occ = self.occupancy_sum - base.get('occupancy_sum', 0)
+            series = [float(v) for v in self._occ_series]
+            if since is not None:
+                # the bounded series keeps only the recent tail; the
+                # delta's decode steps are its newest entries
+                series = series[max(0, len(series) - d_decode):]
+            return {
+                'slots': self.slots,
+                'page_size': self.page_size,
+                'pool_pages': self.num_pages,
+                'steps': self.steps - base.get('steps', 0),
+                'prefill_steps': self.prefill_steps
+                - base.get('prefill_steps', 0),
+                'decode_steps': d_decode,
+                'joined': self.joined - base.get('joined', 0),
+                'retired': self.retired - base.get('retired', 0),
+                'slot_util': round(
+                    d_occ / (d_decode * self.slots), 4) if d_decode
+                else 0.0,
+                'occupancy_series': [
+                    round(v, 2) for v in _downsample(series)],
+            }
+
+    # -- draining ----------------------------------------------------------
+
+    def drain(self, rows: List[_EngineRow],
+              on_result: Optional[Callable[[_EngineRow], None]] = None,
+              timeout: Optional[float] = None):
+        """Drive the engine until every row in ``rows`` retires,
+        delivering each (in retirement order) through ``on_result``.
+        Safe to call from several threads at once: the driver lock picks
+        one stepper, everyone else waits on their rows' events — which
+        is exactly how an interactive request rides a sweep's resident
+        step."""
+        deadline = time.monotonic() + timeout if timeout else None
+        pending = {id(r): r for r in rows}
+        delivered: set = set()
+
+        def flush():
+            ready = sorted((r for r in pending.values()
+                            if r.event.is_set()
+                            and id(r) not in delivered),
+                           key=lambda r: r.retire_seq)
+            for row in ready:
+                delivered.add(id(row))
+                if on_result is not None:
+                    on_result(row)
+            for row in ready:
+                del pending[id(row)]
+
+        while True:
+            flush()
+            if not pending:
+                return
+            if deadline is not None and time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f'{len(pending)} row(s) still in flight after '
+                    f'{timeout:.0f}s')
+            if self._driver.acquire(blocking=False):
+                try:
+                    progressed = self._device_step()
+                finally:
+                    self._driver.release()
+                if not progressed and any(not r.event.is_set()
+                                          for r in pending.values()):
+                    raise RuntimeError(
+                        'continuous engine stalled with rows pending '
+                        '(page pool misconfigured?)')
+            else:
+                next(iter(pending.values())).event.wait(0.05)
 
 
 @MODELS.register_module()
@@ -78,6 +447,11 @@ class JaxLM(BaseModel):
     # icl/inferencers/schedule.py): per-row outputs are batch-independent
     # here, and fewer distinct (B, S) buckets means fewer XLA compiles
     supports_batch_plan = True
+    # opt-in for the continuous-batching decode engine (slot scheduler
+    # over a paged KV cache): config-selectable via ``continuous_batching``
+    # — the gen inferencer's planner degenerates to a feed queue and rows
+    # retire individually instead of per fixed-shape batch
+    supports_continuous_batching = True
 
     def __init__(self,
                  path: str = '',
@@ -95,6 +469,10 @@ class JaxLM(BaseModel):
                  quantize: Optional[str] = None,
                  convert_cache: Optional[str] = None,
                  shared_prefix: bool = True,
+                 continuous_batching: bool = False,
+                 decode_slots: int = 8,
+                 kv_page_size: int = 64,
+                 kv_pool_pages: Optional[int] = None,
                  run_cfg: Optional[Dict] = None):
         super().__init__(path=path, max_seq_len=max_seq_len,
                          tokenizer_only=tokenizer_only,
@@ -193,6 +571,19 @@ class JaxLM(BaseModel):
             if updates:
                 self.cfg = dataclasses.replace(self.cfg, **updates)
         self.convert_cache = convert_cache
+        # continuous-batching decode engine (slot scheduler over a paged
+        # KV cache): built lazily on first generate_continuous; the
+        # dense lax.while_loop path stays the fallback (and the only
+        # path for beam search / ALiBi / prefix-LM / meshes)
+        self.continuous_batching = bool(continuous_batching)
+        self.decode_slots = int(decode_slots)
+        self.kv_page_size = int(kv_page_size)
+        self.kv_pool_pages = kv_pool_pages
+        self._cont_engine: Optional[ContinuousEngine] = None
+        self._cont_engine_key = None
+        # worker protocol thread + sweep thread can both reach for the
+        # engine; double-building would allocate the page pool twice
+        self._cont_engine_lock = threading.Lock()
         self.mesh = None
         self.params = None
         if not tokenizer_only:
@@ -496,6 +887,13 @@ class JaxLM(BaseModel):
             for spec in specs:
                 try:
                     kind = spec['kind']
+                    if kind == 'gen_continuous':
+                        # continuous sweeps dispatch exactly the
+                        # engine's two shapes — warm those, not the
+                        # dense census
+                        if self.continuous_active:
+                            warmed += self.continuous_engine().warm()
+                        continue
                     max_new = int(spec.get('max_out_len') or 0)
                     # gen batches pad under a decode-reserved cap
                     # (max_seq_len - max_out_len, matching
@@ -869,6 +1267,157 @@ class JaxLM(BaseModel):
             sub = sub / sub.sum(axis=-1, keepdims=True)
             return sub.tolist()
         return _Lazy(fetch)
+
+    # -- continuous batching ----------------------------------------------
+
+    @property
+    def continuous_eligible(self) -> bool:
+        """Device-free half of :attr:`continuous_active`: flag on plus
+        a config/decode-mode the paged step supports (no ALiBi /
+        prefix-LM / int4 KV / beam search).  What ``cli plan`` and the
+        warm-up shape census key on — a config this returns False for
+        will run the dense path, so the dense B×S census must still be
+        warmed."""
+        if not self.continuous_batching or self.cfg is None:
+            return False
+        if self.cfg.positional == 'alibi' or self.cfg.prefix_lm:
+            return False
+        if self.cfg.kv_quant_mode == 'int4':
+            return False
+        gk = self.generation_kwargs or {}
+        return int(gk.get('num_beams', 1)) <= 1
+
+    @property
+    def continuous_active(self) -> bool:
+        """True when the continuous-batching engine can serve this
+        model's generation: :attr:`continuous_eligible` plus weights
+        resident and no tensor/seq/multi-host mesh (the paged
+        scatter/gather path is single-device)."""
+        if not self.continuous_eligible or self.tokenizer_only \
+                or self.params is None:
+            return False
+        # the engine's pool lives on one device: a plain/data mesh is
+        # fine (steps run un-meshed on the default device), tensor/seq
+        # parallelism and multi-host are not
+        return self.mesh is None or (
+            not self._multihost()
+            and self.mesh.shape.get('model', 1) == 1
+            and self.mesh.shape.get('seq', 1) == 1)
+
+    def continuous_plan(self) -> Optional[Dict]:
+        """Static engine geometry for the ``cli plan`` pre-flight:
+        slot capacity, page sizing, and the (exactly two) compile
+        shapes a continuous sweep dispatches.  Device-free — works on
+        tokenizer_only models.  None when the engine is off."""
+        if not self.continuous_batching:
+            return None
+        from opencompass_tpu.nn.paged_kv import (pages_per_seq,
+                                                 pool_pages_for)
+        slots, page = self.decode_slots, self.kv_page_size
+        pages = int(self.kv_pool_pages or pool_pages_for(
+            slots, self.max_seq_len, page))
+        return {
+            'slots': slots,
+            'page_size': page,
+            'pool_pages': pages,
+            'max_pages_per_seq': pages_per_seq(self.max_seq_len, page),
+            'decode_shape': f'{slots}x1',
+            'prefill_shape': f'{slots}x{page}',
+            'compile_shapes': 2,
+        }
+
+    def continuous_engine(self) -> 'ContinuousEngine':
+        """The resident engine (built on first use; rebuilt when the
+        sampling parameters change, since they are static in its
+        compiled step)."""
+        if not self.continuous_active:
+            raise RuntimeError('continuous batching is not active for '
+                               'this model (see continuous_active)')
+        key = self._gen_params()
+        with self._cont_engine_lock:
+            if self._cont_engine is None or self._cont_engine_key != key:
+                self._cont_engine = ContinuousEngine(
+                    self, slots=self.decode_slots,
+                    page_size=self.kv_page_size,
+                    num_pages=self.kv_pool_pages)
+                self._cont_engine_key = key
+            return self._cont_engine
+
+    def generate_continuous(self, inputs: List[str], max_out_len: int,
+                            on_result: Optional[Callable[[int, str],
+                                                         None]] = None,
+                            stats_out: Optional[Dict] = None
+                            ) -> List[str]:
+        """Generate through the continuous-batching engine: all rows
+        enter the feed queue at once, join the resident decode step as
+        slots free up, and retire individually — ``on_result(i, text)``
+        fires per retired row (in retirement order), which is what lets
+        the gen inferencer flush and tick progress per row instead of
+        per batch.  Greedy outputs are token-identical to
+        :meth:`generate` (pinned by tests/test_continuous_batching.py).
+        ``stats_out``: optional dict filled with this call's
+        prefill/decode token counts and measured time-to-first-token
+        (the serve plane's TTFT SLO rides it).  Returns texts in input
+        order."""
+        from opencompass_tpu.icl.inferencers.schedule import \
+            feed_queue_order
+        engine = self.continuous_engine()
+        max_new = int(max_out_len)
+        max_prompt = max(self.max_seq_len - max_new, 32)
+        with use_mesh(self.mesh):
+            ids = [self._encode_ids(str(s))[:max_prompt] for s in inputs]
+        texts: List[Optional[str]] = [None] * len(inputs)
+        rows = []
+        for k in feed_queue_order([len(r) for r in ids]):
+            if not ids[k] or max_new <= 0:
+                texts[k] = ''
+                if on_result is not None:
+                    on_result(k, '')
+                continue
+            rows.append(engine.submit(ids[k], max_new, tag=k))
+        self.perf.tokens_in += sum(len(r) for r in ids)
+        self.perf.samples += len(inputs)
+        t0 = time.time()
+        t0p = time.perf_counter()
+        snap = engine.snapshot()
+
+        def deliver(row):
+            toks = row.emitted
+            if self.eos_token_id is not None:
+                toks = [t for t in toks if t != self.eos_token_id]
+            self.perf.tokens_out += len(row.emitted)
+            text = self.tokenizer.decode(toks)
+            texts[row.tag] = text
+            if on_result is not None:
+                on_result(row.tag, text)
+
+        engine.drain(rows, deliver)
+        self._record_engine_drain(engine, snap, len(rows), t0)
+        if stats_out is not None:
+            stats_out['prefill_tokens'] = sum(len(r) for r in ids)
+            stats_out['decode_tokens'] = sum(
+                len(r.emitted) for r in rows)
+            firsts = [r.first_token_ts for r in rows
+                      if r.first_token_ts is not None]
+            if firsts:
+                # measured (not estimated): submit -> first sampled token
+                stats_out['ttft_s'] = round(min(firsts) - t0p, 6)
+        return [t if t is not None else '' for t in texts]
+
+    def _record_engine_drain(self, engine: 'ContinuousEngine',
+                             snap: Dict, n_rows: int, t0: float):
+        """One flight-recorder ``engine`` record per drained call —
+        per-drain DELTAS (this call's steps/joins/retires/occupancy),
+        so a resident engine's Nth task reports only its own work
+        (obs/timeline.py).  Never fails the call."""
+        try:
+            from opencompass_tpu.obs import get_timeline
+            tl = get_timeline()
+            if tl.enabled:
+                tl.engine('gen', ts=round(t0, 6), rows=n_rows,
+                          **engine.stats(since=snap))
+        except Exception:
+            pass
 
     def generate(self, inputs: List[str], max_out_len: int) -> List[str]:
         return self.generate_async(inputs, max_out_len).result()

@@ -8,14 +8,15 @@ single code path serves one chip, a v5e-8 slice, or a multi-host pod.
 """
 from .config import TransformerConfig
 from .transformer import (init_params, forward, prefill, decode_step,
-                          init_cache)
+                          init_cache, paged_step)
 from .loss import sequence_nll, shared_prefix_nll
-from .decode import beam_generate, greedy_generate, greedy_generate_prefixed
+from .decode import (beam_generate, greedy_generate,
+                     greedy_generate_prefixed, paged_generate_step)
 from .sharding import param_shardings, shard_params
 
 __all__ = [
     'TransformerConfig', 'init_params', 'forward', 'prefill', 'decode_step',
-    'init_cache',
+    'init_cache', 'paged_step', 'paged_generate_step',
     'sequence_nll', 'shared_prefix_nll', 'greedy_generate',
     'greedy_generate_prefixed', 'beam_generate', 'param_shardings',
     'shard_params',

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 
 from .config import TransformerConfig
 from .transformer import (broadcast_cache, decode_step, init_cache,
-                          prefill, prefill_suffix, slot_positions)
+                          paged_step, prefill, prefill_suffix,
+                          slot_positions)
 
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
@@ -130,6 +131,27 @@ def _greedy_loop(params, cfg, logits, cache, positions, kv_valid, kv_pos,
     else:
         lengths = jnp.full((B,), max_new_tokens)
     return out, lengths
+
+
+def paged_generate_step(params, cfg: TransformerConfig, tokens: jax.Array,
+                        start: jax.Array, n_new: jax.Array,
+                        page_table: jax.Array, pool: Dict, page_size: int,
+                        rng: jax.Array, temperature: float = 0.0,
+                        top_k: int = 0) -> Tuple[jax.Array, Dict]:
+    """One continuous-batching engine step: advance every active slot by
+    its chunk of tokens through the paged KV cache and sample each
+    slot's next token from the last-real-position logits.
+
+    The continuous engine (models/jax_lm.py) jits this twice per model —
+    once at (slots, page_size) for prefill chunks, once at (slots, 1)
+    for decode — and those two shapes serve the whole sweep regardless
+    of the in-flight length mix.  Returns (sampled next tokens (slots,),
+    pool); samples for slots whose chunk did not reach a sampling point
+    (mid-prompt, inactive) are garbage the host ignores.
+    """
+    logits, pool = paged_step(params, cfg, tokens, start, n_new,
+                              page_table, pool, page_size)
+    return _sample(logits, rng, temperature, top_k), pool
 
 
 def greedy_generate_prefixed(params, cfg: TransformerConfig,

@@ -1,0 +1,229 @@
+"""Paged KV cache: fixed-size pages in a preallocated pool.
+
+The dense decode cache (``transformer.init_cache``) reserves
+``B x (S_bucket + max_new)`` slots per batch — every row pays the
+longest row's footprint, and every distinct ``(B, S)`` bucket is its own
+buffer (and its own XLA compile of everything that touches it).  The
+paged layout breaks that coupling the vLLM/"Ragged Paged Attention" way
+(PAPERS.md):
+
+- **Pool**: one preallocated buffer of ``num_pages`` fixed-size pages
+  per cache tensor, leaves shaped ``(L, P, K, page, hd)`` (plus
+  ``(L, P, K, page)`` per-vector scales for quantized caches).  The
+  pool's size is a capacity knob, not a per-batch shape.
+- **Page tables**: each in-flight sequence owns an ordered list of page
+  ids; its logical KV positions ``[0, len)`` map to
+  ``pages[p // page_size]`` at offset ``p % page_size``.  Tables are
+  tiny host arrays shipped per step — remapping a slot to a new
+  sequence costs an int32 row write, never a cache copy.
+- **Alloc/free per row**: the host-side :class:`PageAllocator` hands
+  pages out of a free list as rows join the resident decode step and
+  reclaims them as rows retire.  Page 0 is reserved as a garbage page:
+  inactive slots' writes are routed there, so a scatter can run for the
+  full fixed slot set without corrupting live sequences.
+
+Device access patterns (consumed by ``transformer._block``'s paged
+branch via :func:`gather_view` / scatter indices from
+:func:`write_indices`): reads gather a sequence's pages into a
+contiguous head-major view (the XLA-portable formulation of the ragged
+paged attention kernel — on TPU a Pallas kernel could read the pages in
+place, see docs/user_guides/performance.md), writes scatter one chunk
+of tokens into the pages the table names.
+
+Invariants (pinned by tests/test_paged_kv.py): the allocator never
+double-books or leaks a page under randomized join/retire orders, and a
+paged cache holding the same K/V as a dense cache attends bit-identically.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .config import TransformerConfig
+
+# reserved garbage page: never allocated, absorbs writes from inactive
+# slots and masked chunk tails so one fixed-shape scatter serves the
+# whole slot set
+GARBAGE_PAGE = 0
+
+
+class OutOfPages(RuntimeError):
+    """The pool has fewer free pages than a joining row needs — callers
+    keep the row queued (back-pressure) instead of failing it."""
+
+
+class PageAllocator:
+    """Host-side free list over ``num_pages`` pool pages.
+
+    Page ``GARBAGE_PAGE`` is reserved and never handed out.  ``alloc``
+    and ``free`` enforce the no-alias/no-leak invariants directly:
+    allocating a page twice or freeing a page not currently allocated
+    raises instead of silently corrupting a neighbouring sequence's
+    cache.
+    """
+
+    def __init__(self, num_pages: int):
+        if num_pages < 2:
+            raise ValueError('need >= 2 pages (page 0 is reserved)')
+        self.num_pages = num_pages
+        self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        self._allocated: set = set()
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_allocated(self) -> int:
+        return len(self._allocated)
+
+    def alloc(self, n: int) -> List[int]:
+        """``n`` distinct pages, or :class:`OutOfPages` (atomic: on
+        failure nothing is taken)."""
+        if n > len(self._free):
+            raise OutOfPages(
+                f'need {n} pages, {len(self._free)} free '
+                f'(pool of {self.num_pages})')
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            if p in self._allocated or p == GARBAGE_PAGE:
+                raise AssertionError(f'allocator handed out page {p} twice')
+            self._allocated.add(p)
+        return pages
+
+    def free(self, pages: List[int]):
+        for p in pages:
+            if p not in self._allocated:
+                raise AssertionError(
+                    f'freeing page {p} that is not allocated '
+                    '(double free or alias)')
+            self._allocated.remove(p)
+            self._free.append(p)
+
+
+def pool_pages_for(slots: int, max_len: int, page_size: int) -> int:
+    """Default pool size: every slot can hold a full-context sequence,
+    plus the reserved garbage page.  Smaller pools are legal and simply
+    back-pressure admissions."""
+    return slots * pages_per_seq(max_len, page_size) + 1
+
+
+def pages_per_seq(max_len: int, page_size: int) -> int:
+    return -(-int(max_len) // int(page_size))
+
+
+def init_page_pool(cfg: TransformerConfig, num_pages: int,
+                   page_size: int, dtype=None) -> Dict:
+    """The pooled cache tensors, same leaf roles as
+    ``transformer.init_cache`` but paged: k/v ``(L, P, K, page, hd)``
+    (+ ``ks``/``vs`` ``(L, P, K, page)`` per-vector scales when the
+    config quantizes its KV cache)."""
+    dtype = dtype or cfg.jnp_dtype
+    shape = (cfg.num_layers, num_pages, cfg.num_kv_heads, page_size,
+             cfg.head_dim)
+    mode = cfg.kv_quant_mode
+    if mode:
+        kv_dtype = jnp.int4 if mode == 'int4' else jnp.int8
+        return {'k': jnp.zeros(shape, kv_dtype),
+                'v': jnp.zeros(shape, kv_dtype),
+                'ks': jnp.ones(shape[:-1], dtype),
+                'vs': jnp.ones(shape[:-1], dtype)}
+    return {'k': jnp.zeros(shape, dtype), 'v': jnp.zeros(shape, dtype)}
+
+
+def write_indices(page_table: jnp.ndarray, start: jnp.ndarray,
+                  n_new: jnp.ndarray, t: int, page_size: int
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter coordinates for one step of ``t`` tokens per slot.
+
+    Token ``i`` of slot ``s`` lands at logical position
+    ``start[s] + i`` → ``(page_rows[s, i], offsets[s, i])``.  Tokens
+    past ``n_new[s]`` (chunk padding, inactive slots) are routed to the
+    garbage page so the scatter shape stays fixed.
+    """
+    g = start[:, None] + jnp.arange(t, dtype=start.dtype)[None, :]
+    rows = jnp.take_along_axis(page_table, g // page_size, axis=1)
+    valid = jnp.arange(t)[None, :] < n_new[:, None]
+    rows = jnp.where(valid, rows, GARBAGE_PAGE)
+    return rows, g % page_size
+
+
+def gather_view(pool_leaf: jnp.ndarray, page_table: jnp.ndarray
+                ) -> jnp.ndarray:
+    """Materialize per-slot contiguous views from one layer's pool leaf.
+
+    ``pool_leaf``: ``(P, K, page, hd)`` (or ``(P, K, page)`` for
+    scales); ``page_table``: ``(B, MP)``.  Returns head-major
+    ``(B, K, MP*page, hd)`` (or ``(B, K, MP*page)``) — logical position
+    ``j`` of slot ``s`` at ``view[s, :, j]``.  Unallocated table
+    entries point at the garbage page; their positions are beyond every
+    valid attention mask.
+    """
+    took = jnp.take(pool_leaf, page_table, axis=0)  # (B, MP, K, page[,hd])
+    if took.ndim == 5:
+        b, mp, k, page, hd = took.shape
+        return jnp.transpose(took, (0, 2, 1, 3, 4)).reshape(
+            b, k, mp * page, hd)
+    b, mp, k, page = took.shape
+    return jnp.transpose(took, (0, 2, 1, 3)).reshape(b, k, mp * page)
+
+
+def dense_equivalent(pool: Dict, page_table: np.ndarray,
+                     lengths: np.ndarray) -> Dict:
+    """Host-side reference: reassemble each slot's dense
+    ``(L, B, K, S, hd)`` cache from the pool + table (test oracle for
+    the paged-vs-dense bit-identity invariant).  ``S`` is
+    ``MP * page``."""
+    out = {}
+    page_table = np.asarray(page_table)
+    for name, leaf in pool.items():
+        leaf = np.asarray(leaf)
+        gathered = leaf[:, page_table]       # (L, B, MP, K, page[, hd])
+        if gathered.ndim == 6:
+            length, b, mp, k, page, hd = gathered.shape
+            out[name] = np.transpose(gathered, (0, 1, 3, 2, 4, 5)).reshape(
+                length, b, k, mp * page, hd)
+        else:
+            length, b, mp, k, page = gathered.shape
+            out[name] = np.transpose(gathered, (0, 1, 3, 2, 4)).reshape(
+                length, b, k, mp * page)
+    return out
+
+
+class PageTable:
+    """Host-side page-table rows for a fixed slot set.
+
+    ``table`` is the ``(slots, max_pages)`` int32 array shipped to the
+    device each step; unmapped entries hold the garbage page.  The
+    engine mutates it only through :meth:`assign` / :meth:`clear`, so
+    the allocator and the table can never disagree about ownership.
+    """
+
+    def __init__(self, slots: int, max_pages: int):
+        self.table = np.full((slots, max_pages), GARBAGE_PAGE, np.int32)
+        self._pages: List[Optional[List[int]]] = [None] * slots
+
+    def assign(self, slot: int, pages: List[int]):
+        if self._pages[slot] is not None:
+            raise AssertionError(f'slot {slot} already mapped')
+        if len(pages) > self.table.shape[1]:
+            raise ValueError(
+                f'{len(pages)} pages exceed table width '
+                f'{self.table.shape[1]}')
+        self._pages[slot] = list(pages)
+        self.table[slot, :] = GARBAGE_PAGE
+        self.table[slot, :len(pages)] = pages
+
+    def clear(self, slot: int) -> List[int]:
+        """Unmap a slot, returning its pages for the allocator."""
+        pages = self._pages[slot]
+        if pages is None:
+            return []
+        self._pages[slot] = None
+        self.table[slot, :] = GARBAGE_PAGE
+        return pages
+
+    def pages(self, slot: int) -> Optional[List[int]]:
+        return self._pages[slot]

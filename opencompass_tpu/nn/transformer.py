@@ -490,7 +490,7 @@ def _attention_shared(q, k, v, k1, v1, own_mask):
 def _block(cfg: TransformerConfig, x, lp, positions, mask,
            cache_slice=None, cache_index=None, attn_fn=None,
            kv_positions=None, tp_axis=None, shared_kv=None,
-           full_cache=None):
+           full_cache=None, paged_cache=None):
     """One transformer block.  x: (B,T,D).  With a cache slice, K/V for the
     current tokens are written at ``cache_index`` and attention runs over the
     whole cache; without, attention is over the current sequence only.
@@ -522,8 +522,37 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
 
     new_cache = None
     k_scale = v_scale = None
-    head_major = cache_slice is not None or full_cache is not None
-    if full_cache is not None:
+    head_major = (cache_slice is not None or full_cache is not None
+                  or paged_cache is not None)
+    if paged_cache is not None:
+        # paged decode / prefill-chunk (nn/paged_kv.py): this step's
+        # K/V scatter into the pool pages the slot page tables name,
+        # then attention runs over each slot's gathered contiguous
+        # view.  k/v stay (B, T, K, hd) — the scatter's advanced
+        # indices put (B, T) first, matching that layout directly.
+        from .paged_kv import gather_view
+        pool_l, page_rows, offsets, view_pt = paged_cache
+        if 'ks' in pool_l:  # quantized pool (cfg.kv_quant)
+            k, ks_new = _quantize_kv(k, cfg.kv_quant_mode)
+            v, vs_new = _quantize_kv(v, cfg.kv_quant_mode)
+            writes = (('k', k), ('v', v), ('ks', ks_new), ('vs', vs_new))
+        else:
+            writes = (('k', k), ('v', v))
+        new_cache = dict(pool_l)
+        for name, cur in writes:
+            tgt = pool_l[name]
+            if tgt.ndim == 4:        # (P, K, page, hd)
+                new_cache[name] = tgt.at[page_rows, :, offsets, :].set(
+                    cur.astype(tgt.dtype))
+            else:                    # (P, K, page) per-vector scales
+                new_cache[name] = tgt.at[page_rows, :, offsets].set(
+                    cur.astype(tgt.dtype))
+        k = gather_view(new_cache['k'], view_pt)
+        v = gather_view(new_cache['v'], view_pt)
+        if 'ks' in new_cache:
+            k_scale = gather_view(new_cache['ks'], view_pt)
+            v_scale = gather_view(new_cache['vs'], view_pt)
+    elif full_cache is not None:
         # decode-kernel path (T=1, int8 cache): append this token's K/V
         # in place on the FULL stacked cache (small XLA dynamic updates
         # on the scan carry), then run attention through the Pallas
@@ -641,7 +670,7 @@ def _mesh_size() -> int:
 
 def _stack(cfg: TransformerConfig, x, layers, positions, mask,
            cache=None, cache_index=None, attn_fn=None, kv_positions=None,
-           tp_axis=None, shared_kv=None):
+           tp_axis=None, shared_kv=None, paged=None):
     """Run the block stack via lax.scan over stacked layer params."""
     def block(cfg, *args, **kw):
         return _block(cfg, *args, attn_fn=attn_fn,
@@ -679,6 +708,38 @@ def _stack(cfg: TransformerConfig, x, layers, positions, mask,
                 lp = jax.tree_util.tree_map(lambda a: a[i], layers)
                 x, _ = step(x, lp)
         return x, None
+
+    if paged is not None:
+        # paged pool on the scan carry, same in-place aliasing rationale
+        # as the dense cache below — each step scatters only this step's
+        # token slots into the per-layer pool slice
+        page_rows, offsets, view_pt = paged
+
+        def step(carry, layer_and_index):
+            h, pool_full = carry
+            lp, li = layer_and_index
+            pool_l = jax.tree_util.tree_map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, li, 0,
+                                                       keepdims=False),
+                pool_full)
+            h, new_pool_l = block(cfg, h, lp, positions, mask,
+                                  paged_cache=(pool_l, page_rows,
+                                               offsets, view_pt))
+            pool_full = jax.tree_util.tree_map(
+                lambda full, npl: jax.lax.dynamic_update_index_in_dim(
+                    full, npl.astype(full.dtype), li, 0),
+                pool_full, new_pool_l)
+            return (h, pool_full), None
+        if cfg.scan_layers:
+            (x, new_pool), _ = jax.lax.scan(
+                step, (x, cache), (layers, jnp.arange(cfg.num_layers)))
+        else:
+            new_pool = cache
+            for i in range(cfg.num_layers):
+                lp = jax.tree_util.tree_map(lambda a: a[i], layers)
+                (x, new_pool), _ = step((x, new_pool),
+                                        (lp, jnp.asarray(i)))
+        return x, new_pool
 
     # The cache rides the scan CARRY as one stacked array with per-layer
     # dynamic indexing — NOT as scan xs/ys.  A ys output would allocate a
@@ -995,6 +1056,51 @@ def broadcast_cache(cache: Dict, batch: int) -> Dict:
     return {k: jnp.broadcast_to(
         v, (v.shape[0], batch) + v.shape[2:]).copy()
         for k, v in cache.items()}
+
+
+def paged_step(params: Params, cfg: TransformerConfig, tokens: jax.Array,
+               start: jax.Array, n_new: jax.Array,
+               page_table: jax.Array, pool: Dict, page_size: int
+               ) -> Tuple[jax.Array, Dict]:
+    """One continuous-batching step over a fixed slot set with ragged
+    lengths (paged KV — nn/paged_kv.py).
+
+    tokens: (slots, T) — T tokens per slot (T=1 for decode, T=page_size
+    for a prefill chunk); start: (slots,) logical KV position of
+    ``tokens[:, 0]`` (== tokens already in cache); n_new: (slots,) real
+    tokens in this step's chunk (0 = inactive slot); page_table:
+    (slots, MP) pool page ids (garbage page for unmapped entries);
+    pool: the paged cache (leaves (L, P, K, page, hd)).
+
+    Sequences are left-aligned at exact lengths — position ``i`` of a
+    sequence is RoPE position ``i``, no padding offsets — and each
+    slot's attention spans only its own gathered pages, so one compiled
+    (slots, T) shape serves every mix of in-flight lengths.  Returns
+    (last-real-position logits (slots, V), pool).
+    """
+    if cfg.prefix_lm or cfg.positional == 'alibi':
+        raise NotImplementedError('paged decode supports neither '
+                                  'prefix-LM nor ALiBi; use the dense '
+                                  'while_loop path')
+    from .paged_kv import write_indices
+    B, T = tokens.shape
+    start = start.astype(jnp.int32)
+    n_new = n_new.astype(jnp.int32)
+    positions = start[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    page_rows, offsets = write_indices(page_table, start, n_new, T,
+                                       page_size)
+    S = page_table.shape[1] * page_size
+    # causal over logical positions: query i sees keys j <= start + i
+    # (pages past a slot's current length are either unwritten or
+    # garbage — both beyond this bound)
+    mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]
+    x = _embed(params, cfg, tokens, positions)
+    x, pool = _stack(cfg, x, params['layers'], positions, mask,
+                     cache=pool, paged=(page_rows, offsets, page_table))
+    last = jnp.maximum(n_new - 1, 0)
+    x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)
+    logits = _unembed(params, cfg, x_last)[:, 0, :]
+    return logits, pool
 
 
 def decode_step(params: Params, cfg: TransformerConfig, token: jax.Array,

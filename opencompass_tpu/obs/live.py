@@ -79,6 +79,9 @@ class NoopHeartbeat:
     def add(self, n=1, cached=False):
         pass
 
+    def note(self, **fields):
+        pass
+
     def mark(self, state):
         pass
 
@@ -235,6 +238,19 @@ class Heartbeat:
                 if cached:
                     self._state['cached'] = int(
                         self._state.get('cached') or 0) + n
+                self._write_locked(force=False)
+        except Exception:
+            pass
+
+    def note(self, **fields):
+        """Attach free-form live gauges to the heartbeat record (e.g.
+        the continuous engine's ``decode_slot_util``).  Rate-limited
+        write, never fails."""
+        try:
+            with self._lock:
+                for key, val in fields.items():
+                    if val is not None:
+                        self._state[key] = val
                 self._write_locked(force=False)
         except Exception:
             pass
@@ -458,6 +474,7 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
             tokens_per_sec=rec.get('tokens_per_sec'),
             last_batch_seconds=rec.get('last_batch_seconds'),
             pad_eff=rec.get('pad_eff'),
+            decode_slot_util=rec.get('decode_slot_util'),
             store_hits=rec.get('store_hits'),
             store_misses=rec.get('store_misses'),
             store_hit_rate=round(st_hits / (st_hits + st_misses), 4)
@@ -524,6 +541,7 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
     cached_sum = 0.0     # progress attributable to ~0-cost cached rows
     st_hits = st_misses = 0
     pad_effs = []
+    slot_utils = []
     for row in tasks.values():
         state = row.get('state', 'running')
         if row.get('progress') is None and state == 'ok':
@@ -539,6 +557,8 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
         st_misses += row.get('store_misses') or 0
         if row.get('pad_eff') is not None:
             pad_effs.append(row['pad_eff'])
+        if row.get('decode_slot_util') is not None:
+            slot_utils.append(row['decode_slot_util'])
     return {
         'n_tasks': n,
         'progress': round(frac_sum / n, 4) if n else None,
@@ -547,6 +567,10 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
         if st_hits + st_misses else None,
         'pad_eff': round(sum(pad_effs) / len(pad_effs), 4)
         if pad_effs else None,
+        # continuous-batching engine occupancy (tasks running one):
+        # fraction of decode-step slots holding live sequences
+        'decode_slot_util': round(sum(slot_utils) / len(slot_utils), 4)
+        if slot_utils else None,
         **by_state,
     }
 

@@ -166,7 +166,8 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
         out.append(_line(f'{prefix}_run_eta_seconds', o['eta_seconds']))
     # live-plane surfacing of the planner/store efficiency signals
     # (they existed only in perf records + trace report before)
-    for key in ('cached_progress', 'store_hit_rate', 'pad_eff'):
+    for key in ('cached_progress', 'store_hit_rate', 'pad_eff',
+                'decode_slot_util'):
         if o.get(key) is not None:
             out.append(f'# TYPE {prefix}_run_{key} gauge')
             out.append(_line(f'{prefix}_run_{key}', o[key]))
@@ -217,6 +218,7 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
         ('task_tokens_per_sec', 'tokens_per_sec'),
         ('task_last_batch_seconds', 'last_batch_seconds'),
         ('task_pad_eff', 'pad_eff'),
+        ('task_decode_slot_util', 'decode_slot_util'),
         ('task_store_hit_rate', 'store_hit_rate'),
         ('task_heartbeat_age_seconds', 'heartbeat_age_seconds'),
     ]

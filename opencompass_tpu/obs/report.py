@@ -498,8 +498,8 @@ def render_report(report: Dict) -> str:
     if tl:
         out.append('\n-- flight recorder (per-batch timelines) --')
         rows = [['task', 'kind', 'batches', 'rows', 'tok/s', 'duty',
-                 'pad_eff', 'pre/dec_tok', 'disp/fetch_s', 'cached',
-                 'tok/s over batches']]
+                 'pad_eff', 'slot_util', 'pre/dec_tok', 'disp/fetch_s',
+                 'cached', 'tok/s over batches']]
         for name in sorted(tl):
             s = tl[name]
             predec = '-'
@@ -516,13 +516,18 @@ def render_report(report: Dict) -> str:
                 else ''
             rows.append([
                 name[:52], ','.join(s.get('kinds') or []) or '-',
-                s.get('batches', 0), s.get('rows', 0),
+                s.get('batches', 0),
+                s.get('rows', 0) or s.get('engine_rows') or 0,
                 s.get('tokens_per_sec')
                 if s.get('tokens_per_sec') is not None else '-',
                 f"{s['duty_cycle']:.0%}"
                 if s.get('duty_cycle') is not None else '-',
                 s.get('pad_eff')
                 if s.get('pad_eff') is not None else '-',
+                # continuous-batching decode-slot occupancy (engine
+                # records); '-' for fixed-shape tasks
+                f"{s['slot_util']:.0%}"
+                if s.get('slot_util') is not None else '-',
                 predec, df, s.get('cached_rows', 0), spark])
         out.append(_table(rows))
 

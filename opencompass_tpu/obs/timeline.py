@@ -72,6 +72,9 @@ class NoopTimeline:
     def batch(self, kind, **fields):
         pass
 
+    def engine(self, kind, **fields):
+        pass
+
 
 class Timeline:
     """One task's flight-recorder file (append-only JSONL).
@@ -145,6 +148,30 @@ class Timeline:
         except Exception:
             pass
 
+    def engine(self, kind: str, **fields):
+        """One record per continuous-batching engine drain: per-step
+        slot occupancy (``occupancy_series`` — downsampled in-flight
+        sequence counts over decode steps), ``slot_util``, join/retire
+        totals and prefill/decode step split.
+
+        ``{"v":1,"t":"engine","ts":...,"unit":...,"kind":"gen",
+        "seq":n,"rows":r,"slots":c,"page_size":p,"steps":...,
+        "prefill_steps":...,"decode_steps":...,"joined":...,
+        "retired":...,"slot_util":u,"occupancy_series":[...]}``
+        """
+        try:
+            with self._lock:
+                self._seq += 1
+                rec = {'t': 'engine', 'ts': fields.pop(
+                    'ts', round(time.time(), 6)),
+                    'unit': self._unit, 'kind': kind, 'seq': self._seq}
+                for key, val in fields.items():
+                    if val is not None:
+                        rec[key] = val
+                self._append(rec)
+        except Exception:
+            pass
+
 
 class TrackOnlyTimeline(NoopTimeline):
     """``enabled`` without a sink: makes ``BaseModel._tl_track``
@@ -187,7 +214,7 @@ def iter_records(path: str):
     skipped, never raised (same recovery contract as the store)."""
     from opencompass_tpu.utils.fileio import iter_jsonl_records
     return iter_jsonl_records(
-        path, keep=lambda r: r.get('t') in ('plan', 'batch'))
+        path, keep=lambda r: r.get('t') in ('plan', 'batch', 'engine'))
 
 
 def read_timelines(obs_dir: str) -> Dict[str, List[Dict]]:
@@ -232,6 +259,7 @@ def summarize_records(records: List[Dict]) -> Dict:
     splits, padding efficiency, and a per-batch tokens/s series."""
     batches = [r for r in records if r.get('t') == 'batch']
     plans = [r for r in records if r.get('t') == 'plan']
+    engines = [r for r in records if r.get('t') == 'engine']
 
     def tot(key, recs=batches):
         return sum(r.get(key) or 0 for r in recs)
@@ -248,6 +276,15 @@ def summarize_records(records: List[Dict]) -> Dict:
     calls = [c for r in batches for c in (r.get('calls') or [])]
     series = [(r.get('tokens_in', 0) + r.get('tokens_out', 0))
               / max(r.get('batch_s') or 0.0, 1e-9) for r in batches]
+    # continuous-batching engine drains: slot utilization weighted by
+    # each drain's decode-step count (the trace report's slot_util
+    # column; None when the task never ran the engine)
+    eng_steps = sum(r.get('decode_steps') or 0 for r in engines)
+    slot_util = None
+    if eng_steps:
+        slot_util = round(
+            sum((r.get('slot_util') or 0.0) * (r.get('decode_steps') or 0)
+                for r in engines) / eng_steps, 4)
     return {
         'batches': len(batches),
         'plans': len(plans),
@@ -277,6 +314,11 @@ def summarize_records(records: List[Dict]) -> Dict:
         'prefill_tokens': sum(c.get('prefill_tokens') or 0 for c in calls),
         'decode_tokens': sum(c.get('decode_tokens') or 0 for c in calls),
         'tps_series': [round(v, 1) for v in _downsample(series)],
+        'engine_drains': len(engines),
+        'engine_steps': eng_steps or None,
+        'engine_rows': sum(r.get('retired') or 0
+                           for r in engines) or None,
+        'slot_util': slot_util,
     }
 
 

@@ -70,6 +70,7 @@ import select
 import struct
 import subprocess
 import sys
+import threading
 import time
 import traceback
 from typing import Dict, List, Optional
@@ -83,6 +84,14 @@ MAX_FRAME = 64 * 1024 * 1024
 class WorkerError(RuntimeError):
     """The worker died, timed out, or spoke garbage — caller should fall
     back to the one-shot subprocess path."""
+
+
+class WorkerTimeout(WorkerError):
+    """A round-trip that was *abandoned* without killing the worker
+    (``kill_on_timeout=False`` — the serve plane's channel-concurrent
+    ``complete`` joins): the worker stays healthy, its eventual response
+    is dropped by the demux, and the caller maps this to back-pressure
+    instead of the discard-and-kill path."""
 
 
 # -- framing ---------------------------------------------------------------
@@ -124,7 +133,16 @@ def read_frame(fd: int, timeout: Optional[float] = None) -> Dict:
 # -- runner-side handle ----------------------------------------------------
 
 class WorkerHandle:
-    """One resident worker subprocess + its protocol channel."""
+    """One resident worker subprocess + its protocol channel.
+
+    Frames are rid-tagged and demultiplexed, so several threads may have
+    round-trips in flight on the one pipe pair at once — the serve
+    plane's interactive ``complete`` rides the channel *while* a sweep's
+    ``run`` round-trip is outstanding (the worker answers it from the
+    resident continuous engine).  Exactly one waiter reads the pipe at
+    a time; frames for other rids are routed to their waiters through a
+    condition-guarded buffer.
+    """
 
     def __init__(self, env: Dict[str, str], log_path: str):
         os.makedirs(osp.dirname(osp.abspath(log_path)), exist_ok=True)
@@ -136,18 +154,50 @@ class WorkerHandle:
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=self._log_fh, env=env, start_new_session=True)
         self.dead = False
+        self._wlock = threading.Lock()      # frame writes + rid mint
+        self._rcond = threading.Condition()  # demux buffer + reader flag
+        self._rid = 0
+        self._responses: Dict[str, Dict] = {}
+        self._abandoned: set = set()
+        self._reader_active = False
 
-    def request(self, msg: Dict, timeout: Optional[float] = None) -> Dict:
+    # -- demuxed round-trips ----------------------------------------------
+
+    def _ensure_demux(self):
+        """Tests construct handles via ``__new__`` around hand-rolled
+        subprocesses; give them the demux state lazily."""
+        if not hasattr(self, '_wlock'):
+            self._wlock = threading.Lock()
+            self._rcond = threading.Condition()
+            self._rid = 0
+            self._responses = {}
+            self._abandoned = set()
+            self._reader_active = False
+
+    def _send(self, msg: Dict) -> str:
+        self._ensure_demux()
+        with self._wlock:
+            self._rid += 1
+            rid = f'r{self._rid}'
+            try:
+                write_frame(self.proc.stdin, dict(msg, rid=rid))
+            except OSError as exc:
+                self.kill()
+                raise WorkerError(
+                    f'worker channel broke: {exc}') from exc
+        return rid
+
+    def request(self, msg: Dict, timeout: Optional[float] = None,
+                kill_on_timeout: bool = True) -> Dict:
+        """One round-trip.  With ``kill_on_timeout=False`` a timeout
+        abandons the request (:class:`WorkerTimeout`) and leaves the
+        worker — and whatever else it is serving — alive."""
         if self.dead:
             raise WorkerError('worker already dead')
-        try:
-            write_frame(self.proc.stdin, msg)
-            return read_frame(self.proc.stdout.fileno(), timeout=timeout)
-        except (WorkerError, OSError, ValueError) as exc:
-            self.kill()
-            if isinstance(exc, WorkerError):
-                raise
-            raise WorkerError(f'worker channel broke: {exc}') from exc
+        rid = self._send(msg)
+        deadline = time.monotonic() + timeout if timeout else None
+        return self._await(rid, deadline, timeout_s=timeout,
+                           kill_on_timeout=kill_on_timeout)
 
     def request_watched(self, msg: Dict,
                         timeout: Optional[float] = None,
@@ -162,13 +212,66 @@ class WorkerHandle:
         framed right up until a kill."""
         if self.dead:
             raise WorkerError('worker already dead')
-        try:
-            write_frame(self.proc.stdin, msg)
-        except OSError as exc:
-            self.kill()
-            raise WorkerError(f'worker channel broke: {exc}') from exc
-        fd = self.proc.stdout.fileno()
+        rid = self._send(msg)
         deadline = time.monotonic() + timeout if timeout else None
+        return self._await(rid, deadline, timeout_s=timeout,
+                           stall_timeout=stall_timeout,
+                           liveness=liveness, poll=poll)
+
+    def _await(self, rid: str, deadline: Optional[float],
+               timeout_s: Optional[float] = None,
+               stall_timeout: Optional[float] = None, liveness=None,
+               poll: float = 5.0, kill_on_timeout: bool = True) -> Dict:
+        """Wait for ``rid``'s response: become the pipe reader when the
+        seat is free, else wait on the demux buffer (the active reader
+        routes our frame to it)."""
+        while True:
+            became_reader = False
+            with self._rcond:
+                if rid in self._responses:
+                    return self._responses.pop(rid)
+                if self.dead:
+                    raise WorkerError('worker pipe closed '
+                                      '(process died?)')
+                if self._reader_active:
+                    slice_s = 0.2
+                    if deadline is not None:
+                        slice_s = min(slice_s, max(
+                            deadline - time.monotonic(), 0.01))
+                    self._rcond.wait(slice_s)
+                    timed_out = (deadline is not None
+                                 and time.monotonic() >= deadline
+                                 and rid not in self._responses)
+                    if not timed_out:
+                        continue
+                    self._abandoned.add(rid)
+                    if not kill_on_timeout:
+                        raise WorkerTimeout(
+                            f'worker response timed out after '
+                            f'{timeout_s:.0f}s (channel busy; request '
+                            'abandoned)')
+                else:
+                    self._reader_active = True
+                    became_reader = True
+            if not became_reader:
+                # timed out as a non-reader with kill semantics: same
+                # contract as _read_for's timeout path
+                self.kill()
+                raise WorkerError(
+                    f'worker response timed out after {timeout_s:.0f}s')
+            try:
+                got = self._read_for(rid, deadline, timeout_s,
+                                     stall_timeout, liveness, poll,
+                                     kill_on_timeout)
+            finally:
+                with self._rcond:
+                    self._reader_active = False
+                    self._rcond.notify_all()
+            return got
+
+    def _read_for(self, rid: str, deadline, timeout_s, stall_timeout,
+                  liveness, poll: float, kill_on_timeout: bool) -> Dict:
+        fd = self.proc.stdout.fileno()
         last_alive = time.time()
         while True:
             slice_s = poll
@@ -181,17 +284,34 @@ class WorkerHandle:
                 if deadline is not None:
                     remaining = max(deadline - time.monotonic(), 0.01)
                 try:
-                    return read_frame(fd, timeout=remaining)
+                    frame = read_frame(fd, timeout=remaining)
                 except (WorkerError, OSError, ValueError) as exc:
                     self.kill()
                     if isinstance(exc, WorkerError):
                         raise
                     raise WorkerError(
                         f'worker channel broke: {exc}') from exc
+                frid = frame.pop('rid', None)
+                if frid is None or frid == rid:
+                    return frame
+                with self._rcond:       # someone else's response
+                    if frid in self._abandoned:
+                        self._abandoned.discard(frid)
+                    else:
+                        self._responses[frid] = frame
+                    self._rcond.notify_all()
+                continue
             if deadline is not None and time.monotonic() >= deadline:
+                if not kill_on_timeout:
+                    with self._rcond:
+                        self._abandoned.add(rid)
+                    raise WorkerTimeout(
+                        f'worker response timed out after '
+                        f'{timeout_s:.0f}s (request abandoned, worker '
+                        'left alive)')
                 self.kill()
                 raise WorkerError(
-                    f'worker response timed out after {timeout:.0f}s')
+                    f'worker response timed out after {timeout_s:.0f}s')
             if self.proc.poll() is not None:
                 self.kill()
                 raise WorkerError('worker pipe closed (process died?)')
@@ -217,6 +337,11 @@ class WorkerHandle:
 
     def kill(self):
         self.dead = True
+        try:     # wake demux waiters so they observe the death
+            with self._rcond:
+                self._rcond.notify_all()
+        except Exception:
+            pass
         if self.proc.poll() is None:
             import signal
             try:
@@ -403,7 +528,7 @@ def _collect_tracked_calls(model) -> List[Dict]:
         return []
 
 
-def _handle_complete(msg: Dict) -> Dict:
+def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
     """Interactive generation on the resident model (the engine's
     ``/v1/completions`` data plane).  Rows are keyed exactly like the
     gen inferencer's store rows — namespace (model identity, 'gen',
@@ -435,6 +560,13 @@ def _handle_complete(msg: Dict) -> Dict:
     phases: Dict[str, float] = {}
     t0 = time.perf_counter()
     built = not model_cached(model_cfg)
+    if during_run and built:
+        # mid-sweep join needs the RESIDENT model: building a second
+        # model while a task owns the chips is how OOMs happen.  Busy
+        # maps to back-pressure on the daemon side, never a kill.
+        return {'ok': False, 'busy': True,
+                'error': 'worker busy (model not resident mid-run)',
+                'request_id': request_id}
     model = build_model_from_cfg(model_cfg)   # memoized (residency)
     phases['model_build_s'] = round(time.perf_counter() - t0, 6)
     if not prompts:   # warm-up probe: model on device, nothing to say
@@ -468,7 +600,28 @@ def _handle_complete(msg: Dict) -> Dict:
     phases['store_lookup_s'] = round(time.perf_counter() - t, 6)
     todo = [i for i, c in enumerate(completions) if c is None]
     calls: List[Dict] = []
-    if todo:
+    joined_engine = False
+    if todo and getattr(model, 'continuous_active', False):
+        # resident continuous engine: the request's rows join the
+        # fixed-capacity slot set — mid-sweep they decode alongside the
+        # sweep's in-flight rows (whichever thread drives the engine
+        # carries them), so an interactive completion costs a few slot
+        # steps instead of waiting for the whole shard round-trip
+        joined_engine = True
+        engine_stats: Dict = {}
+        t = time.perf_counter()
+        with get_tracer().span('complete', request_id=request_id,
+                               rows=len(todo), engine_join=True):
+            outs = model.generate_continuous(
+                [prompts[i] for i in todo], max_out_len,
+                stats_out=engine_stats)
+        phases['model_forward_s'] = round(time.perf_counter() - t, 6)
+    elif todo and during_run:
+        return {'ok': False, 'busy': True,
+                'error': 'worker busy (no resident continuous engine '
+                         'to join mid-run)',
+                'request_id': request_id}
+    elif todo:
         # enable _tl_track collection even without a task timeline so
         # the request record gets the dispatch/fetch + prefill/decode
         # splits; a task-installed timeline (between sweep shards)
@@ -492,6 +645,7 @@ def _handle_complete(msg: Dict) -> Dict:
         finally:
             if installed is not None:
                 tlmod.reset_timeline()
+    if todo:
         t = time.perf_counter()
         for i, out in zip(todo, outs):
             completions[i] = out
@@ -506,6 +660,7 @@ def _handle_complete(msg: Dict) -> Dict:
     except Exception:
         pass
     resp = {'ok': True, 'completions': completions, 'built': built,
+            'engine_join': joined_engine or None,
             'store_hits': hits, 'device_rows': len(todo),
             'prompt_tokens': prompt_tokens,
             'completion_tokens': completion_tokens,
@@ -526,6 +681,13 @@ def _handle_complete(msg: Dict) -> Dict:
         share = prefill / max(prefill + decode, 1)
         resp['ttft_s'] = round(
             (first.get('dispatch_s') or 0.0) + first_fetch * share, 6)
+    elif joined_engine and engine_stats:
+        # engine-served rows: token splits + a MEASURED ttft (submit →
+        # first sampled token), not the fused-executable estimate
+        resp['prefill_tokens'] = engine_stats.get('prefill_tokens')
+        resp['decode_tokens'] = engine_stats.get('decode_tokens')
+        if engine_stats.get('ttft_s') is not None:
+            resp['ttft_s'] = engine_stats['ttft_s']
     return resp
 
 
@@ -601,9 +763,49 @@ def serve():
     except ValueError:
         pass
 
+    # `run` executes in a side thread so the protocol loop keeps
+    # serving frames mid-task: an interactive `complete` can join the
+    # resident continuous engine while the sweep's round-trip is still
+    # outstanding.  Responses carry the request's rid; the runner-side
+    # WorkerHandle demultiplexes, so out-of-order completion is fine.
+    wlock = threading.Lock()
+
+    def respond(resp: Dict, rid):
+        if rid is not None:
+            resp = dict(resp, rid=rid)
+        with wlock:
+            write_frame(proto_out, resp)
+
+    run_thread: List = [None]
+
+    def run_busy() -> bool:
+        t = run_thread[0]
+        return t is not None and t.is_alive()
+
+    def _run_in_thread(msg: Dict, rid):
+        try:
+            resp = _handle_run(msg)
+        except (KeyboardInterrupt, SystemExit) as exc:
+            resp = {'ok': False, 'returncode': 1,
+                    'error': f'{type(exc).__name__}: {exc}'}
+        except BaseException:
+            resp = {'ok': False, 'returncode': 1,
+                    'error': traceback.format_exc(limit=20)[-2000:]}
+        try:
+            respond(resp, rid)
+        except OSError:
+            pass     # runner hung up mid-task; nothing to tell it
+
+    def _join_run(timeout: Optional[float] = None):
+        t = run_thread[0]
+        if t is not None and t.is_alive():
+            t.join(timeout)
+
     reason = 'eof'
     while True:
         timeout = idle_ttl if idle_ttl > 0 else None
+        if run_busy():
+            timeout = 1.0    # an in-flight task is activity, not idle
         try:
             ready, _, _ = select.select([proto_in, wake_r], [], [],
                                         timeout)
@@ -618,6 +820,8 @@ def serve():
             reason = 'sigterm'
             break
         if not ready:
+            if run_busy():
+                continue
             reason = 'idle_ttl'   # nobody spoke for a whole TTL
             break
         if proto_in not in ready:
@@ -627,29 +831,42 @@ def serve():
         except WorkerError:
             break  # runner hung up
         cmd = msg.get('cmd')
+        rid = msg.get('rid')
         if cmd == 'shutdown':
-            write_frame(proto_out, {'ok': True, 'bye': True})
+            _join_run()          # drain: a leased task must finish
+            respond({'ok': True, 'bye': True}, rid)
             reason = 'shutdown'
             break
         if cmd == 'ping':
-            write_frame(proto_out, {'ok': True, 'pong': True})
+            respond({'ok': True, 'pong': True}, rid)
             continue
         if cmd not in ('run', 'complete'):
-            write_frame(proto_out, {'ok': False,
-                                    'error': f'unknown cmd {cmd!r}'})
+            respond({'ok': False, 'error': f'unknown cmd {cmd!r}'}, rid)
+            continue
+        if cmd == 'run':
+            if run_busy():
+                respond({'ok': False, 'returncode': 1, 'busy': True,
+                         'error': 'worker already running a task'}, rid)
+                continue
+            thread = threading.Thread(target=_run_in_thread,
+                                      args=(msg, rid),
+                                      name='worker-run', daemon=True)
+            run_thread[0] = thread
+            thread.start()
             continue
         try:
-            resp = _handle_run(msg) if cmd == 'run' \
-                else _handle_complete(msg)
+            resp = _handle_complete(msg, during_run=run_busy())
         except (KeyboardInterrupt, SystemExit):
             raise
         except BaseException:
             resp = {'ok': False, 'returncode': 1,
                     'error': traceback.format_exc(limit=20)[-2000:]}
-        write_frame(proto_out, resp)
+        respond(resp, rid)
         if drain['sigterm']:
             reason = 'sigterm'   # arrived mid-request: drained, now go
             break
+
+    _join_run()    # never strand a task mid-flight on the way out
 
     if reason in ('sigterm', 'idle_ttl', 'shutdown'):
         _flush_model_caches()

@@ -538,7 +538,10 @@ class EvalEngine:
                 time.perf_counter() - t_lease, 6)
         t_rt = time.perf_counter()
         try:
-            resp = worker.request(
+            # channel-concurrent join: mid-sweep the worker answers from
+            # its resident continuous engine; without one it replies
+            # busy and request_join falls back to the serialized wait
+            resp = worker.request_join(
                 {'cmd': 'complete',
                  'model_cfg': _wire_model_cfg(model_cfg),
                  'prompts': list(prompts),

@@ -146,6 +146,43 @@ class ResidentWorker:
                 self._track_end(label, t_req)
                 self.last_used = time.monotonic()
 
+    def request_join(self, msg: Dict,
+                     timeout: Optional[float] = None) -> Dict:
+        """Channel-concurrent round-trip for requests the worker can
+        answer *while* a sweep round-trip is outstanding — the
+        continuous engine's interactive join.  The frame rides the
+        demuxed channel immediately (no lock wait); a worker that
+        cannot serve it mid-run answers ``busy``, and we then fall back
+        to the classic lock-serialized wait for whatever budget
+        remains, so non-engine workers keep the old
+        interleave-between-round-trips behavior."""
+        from opencompass_tpu.runners.worker import WorkerTimeout
+        t0 = time.monotonic()
+        self.requests += 1
+        label, t_req = self._track_begin(msg)
+        try:
+            try:
+                resp = self.handle.request(msg, timeout=timeout,
+                                           kill_on_timeout=False)
+            except WorkerTimeout as exc:
+                raise WorkerBusyError(str(exc)) from exc
+        finally:
+            self._track_end(label, t_req)
+            self.last_used = time.monotonic()
+        if not (isinstance(resp, dict) and resp.get('busy')):
+            return resp
+        # falling back: the busy probe was not a served request — undo
+        # its count so utilization/request stats see ONE logical
+        # request, whichever path answers it (self.request re-counts)
+        self.requests -= 1
+        remaining = None
+        if timeout is not None:
+            remaining = timeout - (time.monotonic() - t0)
+            if remaining <= 0.5:
+                raise WorkerBusyError(
+                    resp.get('error') or f'worker {self.key} busy')
+        return self.request(msg, timeout=remaining)
+
     def kill(self):
         self.handle.kill()
 

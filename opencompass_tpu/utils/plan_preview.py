@@ -51,6 +51,18 @@ def shape_census(model, model_cfg, dataset_cfg,
     kind = inferencer_kind(infer_cfg)
     if kind is None:
         return []
+    # the continuous engine compiles its own two shapes; warming the
+    # dense B×S census would build executables the sweep never
+    # dispatches.  Gate on the runtime verdict when the model has
+    # weights (worker warm-up), else the device-free eligibility check
+    # (cli plan's tokenizer-only models) — a config the engine will
+    # REJECT at runtime (beams/ALiBi/...) must still warm dense shapes.
+    if kind == 'gen':
+        cont = (model.continuous_active
+                if getattr(model, 'params', None) is not None
+                else getattr(model, 'continuous_eligible', False))
+        if cont:
+            return [{'kind': 'gen_continuous'}]
     preview = _preview_task(model, model_cfg, dataset_cfg, token_budget)
     if not preview:
         return []
@@ -126,8 +138,15 @@ def _probe_cache(model, dataset_cfg, preview: Dict,
     kind = inferencer_kind(dataset_cfg.get('infer_cfg', {}))
     if not sig or kind is None:
         return None
-    keys = [f'{kind}:{k}'
-            for k in preview.get('planned', {}).get('shapes', {})]
+    cont = preview.get('continuous')
+    if cont:
+        # the continuous engine dispatches exactly two shapes,
+        # whatever the length census says
+        keys = [f"decode:{cont['decode_shape']}",
+                f"prefill_chunk:{cont['prefill_shape']}"]
+    else:
+        keys = [f'{kind}:{k}'
+                for k in preview.get('planned', {}).get('shapes', {})]
     return compile_cache.probe_shapes(sig, keys, cache_dir)
 
 
@@ -212,6 +231,18 @@ def main(argv: Optional[List[str]] = None) -> int:
         if shapes:
             print(f"  {r['model']}/{r['dataset']}: "
                   + ', '.join(f'{k} x{v}' for k, v in shapes.items()))
+    cont_rows = [r for r in results if r.get('continuous')]
+    if cont_rows:
+        print('\ncontinuous batching (engine enabled — the B×S census '
+              'above does not apply to gen decode):')
+        for r in cont_rows:
+            c = r['continuous']
+            print(f"  {r['model']}/{r['dataset']}: {c['slots']} slots, "
+                  f"page {c['page_size']}, pool {c['pool_pages']} pages; "
+                  f"expected in-flight {c['expected_in_flight']}"
+                  f"/{c['slots']}, ~{c['est_pages_per_row']} pages/row; "
+                  f"compile shapes: decode {c['decode_shape']}, "
+                  f"prefill {c['prefill_shape']} (2 total)")
     if args.cache_dir:
         print(f'\ncompile-cache probe ({args.cache_dir}):')
         for r in results:

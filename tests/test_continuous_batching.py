@@ -1,0 +1,514 @@
+"""Continuous-batching decode engine: slot scheduler, inferencer feed
+queue, telemetry, store kill/resume, and the serve-plane join.
+
+Correctness bar (ISSUE 10): token-for-token agreement with the
+fixed-shape ``lax.while_loop`` path at temperature 0, on FakeModel
+(wiring) and real JaxLM geometry (numerics)."""
+import json
+import os
+import os.path as osp
+import threading
+import time
+
+import pytest
+from datasets import Dataset, DatasetDict
+
+from opencompass_tpu.datasets.base import BaseDataset
+from opencompass_tpu.icl.inferencers.gen import GenInferencer
+from opencompass_tpu.icl.inferencers.schedule import feed_queue_order
+from opencompass_tpu.icl.prompt_template import PromptTemplate
+from opencompass_tpu.icl.retrievers import ZeroRetriever
+from opencompass_tpu.models import FakeModel, JaxLM
+
+READER_CFG = dict(input_columns=['question'], output_column='answer')
+
+
+class SkewDataset(BaseDataset):
+    @staticmethod
+    def load(n_test=10):
+        def q(i):
+            if i % 3 == 0:
+                return f'q{i} ' + 'very long padded question text ' * 12
+            return f'q{i} short'
+        rows = [{'question': q(i), 'answer': 'A' if i % 2 == 0 else 'B'}
+                for i in range(n_test)]
+        return DatasetDict({'train': Dataset.from_list(rows[:4]),
+                            'test': Dataset.from_list(rows)})
+
+
+def test_feed_queue_order_longest_first():
+    assert feed_queue_order([3, 10, 10, 1]) == [1, 2, 0, 3]
+
+
+# -- engine vs fixed-shape path (real JaxLM geometry) ------------------------
+
+def test_engine_token_identical_to_fixed_shape():
+    """Greedy outputs (early-EOS rows included) match the dense path
+    exactly, the retire order is ragged, and every page returns to the
+    allocator."""
+    lm_fixed = JaxLM(config='tiny', max_seq_len=256)
+    lm_cont = JaxLM(config='tiny', max_seq_len=256,
+                    continuous_batching=True, decode_slots=3,
+                    kv_page_size=16)
+    prompts = ['the quick brown fox', 'hello',
+               'pack my box with five dozen liquor jugs and words',
+               'a b c d', 'short one',
+               'another prompt with a few more tokens in it']
+    ref = lm_fixed.generate(prompts, max_out_len=8)
+    order = []
+    got = lm_cont.generate_continuous(
+        prompts, 8, on_result=lambda i, t: order.append(i))
+    assert got == ref
+    assert sorted(order) == list(range(len(prompts)))
+    assert order != list(range(len(prompts)))   # genuinely out of order
+    engine = lm_cont.continuous_engine()
+    assert engine.alloc.n_allocated == 0        # no page leaks
+    assert engine.stats()['retired'] == len(prompts)
+    assert 0.0 < engine.slot_util <= 1.0
+    # exactly two compiled shapes, one of them the decode (slots, 1)
+    shapes = sorted(k[:2] for k in lm_cont._dispatched_keys)
+    assert shapes == [('decode', (3, 1)), ('prefill_chunk', (3, 16))]
+
+
+def test_engine_interactive_rows_join_mid_drain():
+    """A second thread's rows enter the SAME resident step while the
+    sweep thread is draining — the serve data plane's mid-sweep
+    completion, in process."""
+    lm = JaxLM(config='tiny', max_seq_len=256,
+               continuous_batching=True, decode_slots=2, kv_page_size=16)
+    ref_model = JaxLM(config='tiny', max_seq_len=256)
+    sweep_prompts = [f'sweep row {i} with some words' for i in range(10)]
+    inter_prompts = ['interactive request one', 'interactive two']
+    ref_sweep = ref_model.generate(sweep_prompts, max_out_len=10)
+    ref_inter = ref_model.generate(inter_prompts, max_out_len=10)
+
+    results = {}
+    started = threading.Event()
+
+    def sweep():
+        def on_result(i, text):
+            started.set()
+            results[i] = text
+        results['sweep'] = lm.generate_continuous(sweep_prompts, 10,
+                                                  on_result=on_result)
+
+    thread = threading.Thread(target=sweep)
+    thread.start()
+    try:
+        assert started.wait(60)     # at least one sweep row retired
+        engine = lm.continuous_engine()
+        ids = [lm._encode_ids(p) for p in inter_prompts]
+        rows = [engine.submit(r, 10, tag=k, interactive=True)
+                for k, r in enumerate(ids)]
+        inter_out = [None, None]
+
+        def deliver(row):
+            toks = [t for t in row.emitted if t != lm.eos_token_id]
+            inter_out[row.tag] = lm.tokenizer.decode(toks)
+
+        engine.drain(rows, deliver, timeout=120)
+    finally:
+        thread.join(120)
+    assert results['sweep'] == ref_sweep
+    assert inter_out == ref_inter
+    assert engine.stats()['joined'] == 12
+    assert engine.alloc.n_allocated == 0
+
+
+def test_engine_warm_precompiles_both_shapes():
+    lm = JaxLM(config='tiny', max_seq_len=256, continuous_batching=True,
+               decode_slots=2, kv_page_size=16)
+    assert lm.continuous_engine().warm() == 2
+    assert lm.continuous_engine().warm() == 0   # idempotent
+    assert lm.perf.first_calls == 2
+
+
+def test_continuous_plan_reports_geometry():
+    lm = JaxLM(config='tiny', max_seq_len=256, tokenizer_only=True,
+               continuous_batching=True, decode_slots=4, kv_page_size=64)
+    plan = lm.continuous_plan()
+    assert plan == {'slots': 4, 'page_size': 64, 'pool_pages': 17,
+                    'max_pages_per_seq': 4, 'decode_shape': '4x1',
+                    'prefill_shape': '4x64', 'compile_shapes': 2}
+    assert JaxLM(config='tiny', tokenizer_only=True).continuous_plan() \
+        is None
+
+
+def test_cli_plan_reports_engine_geometry(tmp_path):
+    """`cli plan` on a continuous-batching config reports slot
+    capacity, expected occupancy, and the single decode compile shape
+    instead of the per-bucket B×S census (device-free)."""
+    import io
+    from contextlib import redirect_stdout
+    from opencompass_tpu.config import Config
+    from opencompass_tpu.utils.plan_preview import main as plan_main
+    repo = osp.dirname(osp.dirname(osp.abspath(__file__)))
+    cfg = Config.fromfile(osp.join(repo, 'configs/eval_demo.py'))
+    cfg['models'] = [dict(
+        type='JaxLM', abbr='tiny-cont', config='tiny', max_seq_len=256,
+        continuous_batching=True, decode_slots=4, kv_page_size=32,
+        batch_size=4)]
+    cfg_path = str(tmp_path / 'cfg.py')
+    Config(cfg).dump(cfg_path)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = plan_main([cfg_path, '--json'])
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    gen_tasks = [t for t in out['tasks'] if t.get('continuous')]
+    assert gen_tasks
+    cont = gen_tasks[0]['continuous']
+    assert cont['decode_shape'] == '4x1'
+    assert cont['prefill_shape'] == '4x32'
+    assert cont['expected_in_flight'] <= 4
+    assert cont['est_pages_per_row'] >= 1
+    # human rendering names the engine section
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        plan_main([cfg_path])
+    assert 'continuous batching' in buf.getvalue()
+    assert 'decode 4x1' in buf.getvalue()
+
+
+# -- gen inferencer wiring ---------------------------------------------------
+
+def _gen_setup(tmp_path, sub, model, **kw):
+    ds = SkewDataset(reader_cfg=READER_CFG)
+    template = PromptTemplate('Q: {question}\nA: {answer}')
+    inferencer = GenInferencer(
+        model=model, max_out_len=5, batch_size=3,
+        output_json_filepath=str(tmp_path / sub), **kw)
+    return ds, template, inferencer
+
+
+def test_fake_model_continuous_matches_plain(tmp_path):
+    """FakeModel wiring bar: the continuous feed path (out-of-order
+    retirement) writes predictions identical to the batch path, in
+    dataset order."""
+    ds, template, plain = _gen_setup(tmp_path, 'plain', FakeModel(),
+                                     batch_plan=False)
+    _, _, cont = _gen_setup(tmp_path, 'cont',
+                            FakeModel(continuous=True), batch_plan=True)
+    p = plain.inference(ZeroRetriever(ds), prompt_template=template)
+    c = cont.inference(ZeroRetriever(ds), prompt_template=template)
+    assert p == c
+    saved_p = json.loads((tmp_path / 'plain' / 'predictions').read_text())
+    saved_c = json.loads((tmp_path / 'cont' / 'predictions').read_text())
+    assert saved_p == saved_c
+    assert list(saved_c) == [str(i) for i in range(10)]
+
+
+def test_jax_lm_inferencer_continuous_matches_fixed(tmp_path):
+    class ToyDS(BaseDataset):
+        @staticmethod
+        def load():
+            def q(i):
+                if i % 3 == 0:
+                    return (f'question number {i} '
+                            + 'plus lots of extra filler words ' * 3)
+                return f'q{i}?'
+            rows = [{'question': q(i), 'answer': str(i)}
+                    for i in range(6)]
+            return DatasetDict({'train': Dataset.from_list(rows),
+                                'test': Dataset.from_list(rows)})
+    ds = ToyDS(reader_cfg=READER_CFG)
+    template = PromptTemplate('Q: {question}\nA: {answer}')
+    out = {}
+    for name, kw in (('fixed', {}),
+                     ('cont', dict(continuous_batching=True,
+                                   decode_slots=2, kv_page_size=16))):
+        lm = JaxLM(config='tiny', max_seq_len=256, **kw)
+        inf = GenInferencer(model=lm, max_out_len=6, batch_size=2,
+                            output_json_filepath=str(tmp_path / name))
+        out[name] = inf.inference(ZeroRetriever(ds),
+                                  prompt_template=template)
+    assert out['fixed'] == out['cont']
+    saved_f = json.loads((tmp_path / 'fixed' / 'predictions').read_text())
+    saved_c = json.loads((tmp_path / 'cont' / 'predictions').read_text())
+    assert saved_f == saved_c
+
+
+# -- store: kill/resume round-trip ------------------------------------------
+
+class _CrashAfter(FakeModel):
+    """Delivers N rows through the continuous path, then dies —
+    deterministic mid-engine kill."""
+
+    def __init__(self, crash_after, **kw):
+        super().__init__(continuous=True, **kw)
+        self.crash_after = crash_after
+
+    def generate_continuous(self, inputs, max_out_len, on_result=None):
+        delivered = [0]
+
+        def wrapped(i, text):
+            if delivered[0] >= self.crash_after:
+                raise KeyboardInterrupt('injected mid-engine kill')
+            delivered[0] += 1
+            if on_result is not None:
+                on_result(i, text)
+        return super().generate_continuous(inputs, max_out_len,
+                                           on_result=wrapped)
+
+
+def test_continuous_kill_resume_roundtrips_store(tmp_path, monkeypatch):
+    """Mid-engine kill: committed rows survive in the store; the rerun
+    serves them pre-engine, computes only the missing rows, converges
+    to the clean run's predictions, and leaves zero duplicate keys."""
+    from opencompass_tpu import store as S
+    cache_root = str(tmp_path / 'cache')
+    monkeypatch.setenv('OCT_CACHE_ROOT', cache_root)
+    S.reset_stores()
+    ds = SkewDataset(reader_cfg=READER_CFG)
+    template = PromptTemplate('Q: {question}\nA: {answer}')
+    model_cfg = {'type': 'FakeModel', 'path': 'fake', 'continuous': True}
+
+    def bound(model):
+        S.bind_model_store(model, model_cfg)
+        return model
+
+    # clean reference (separate cache so the crashed run starts cold)
+    ref_cache = str(tmp_path / 'cache_ref')
+    monkeypatch.setenv('OCT_CACHE_ROOT', ref_cache)
+    S.reset_stores()
+    _, _, ref_inf = _gen_setup(tmp_path, 'ref',
+                               bound(FakeModel(continuous=True)),
+                               batch_plan=True)
+    ref = ref_inf.inference(ZeroRetriever(ds), prompt_template=template)
+
+    monkeypatch.setenv('OCT_CACHE_ROOT', cache_root)
+    S.reset_stores()
+    _, _, crash_inf = _gen_setup(tmp_path, 'crash',
+                                 bound(_CrashAfter(3)), batch_plan=True)
+    with pytest.raises(KeyboardInterrupt):
+        crash_inf.inference(ZeroRetriever(ds), prompt_template=template)
+
+    S.reset_stores()
+    resumed_model = bound(FakeModel(continuous=True))
+    _, _, resume_inf = _gen_setup(tmp_path, 'resume', resumed_model,
+                                  batch_plan=True)
+    out = resume_inf.inference(ZeroRetriever(ds),
+                               prompt_template=template)
+    assert out == ref
+    # only the missing rows hit the model on resume
+    assert resumed_model.perf.samples == 10 - 3
+    verdict = S.open_store().verify()
+    assert verdict['ok'] and verdict['duplicate_keys'] == 0
+    assert verdict['rows'] == 10
+
+
+# -- telemetry ---------------------------------------------------------------
+
+def test_per_row_heartbeat_and_engine_timeline(tmp_path):
+    """Rows retiring individually tick the heartbeat per row (no
+    batch-sized jumps), the engine notes decode_slot_util, and the
+    flight recorder gets plan + engine records the summarizer folds
+    into slot_util."""
+    from opencompass_tpu.obs import live as livemod
+    from opencompass_tpu.obs import timeline as tlmod
+    from opencompass_tpu.obs.timeline import (iter_records,
+                                              summarize_records,
+                                              timeline_path)
+    from opencompass_tpu import obs as obsmod
+    obs_dir = str(tmp_path / 'obs')
+    tracer = obsmod.init_obs(str(tmp_path), enabled=True)
+    livemod.install_heartbeat(
+        livemod.Heartbeat(obs_dir, 'cont-task', interval=0.0))
+    tlmod.install_timeline(tlmod.Timeline(obs_dir, 'cont-task'))
+    ticks = []
+    orig_progress = livemod.Heartbeat.progress
+
+    def spy(self, done=None, total=None, **kw):
+        if done is not None:
+            ticks.append(done)
+        return orig_progress(self, done=done, total=total, **kw)
+    livemod.Heartbeat.progress = spy
+    try:
+        lm = JaxLM(config='tiny', max_seq_len=256,
+                   continuous_batching=True, decode_slots=2,
+                   kv_page_size=16)
+        ds = SkewDataset(reader_cfg=READER_CFG)
+        template = PromptTemplate('Q: {question}\nA: {answer}')
+        inf = GenInferencer(model=lm, max_out_len=12, batch_size=4,
+                            output_json_filepath=str(tmp_path / 'out'))
+        inf.inference(ZeroRetriever(ds), prompt_template=template)
+        # a second drain on the SAME resident engine must record only
+        # its own work (per-drain deltas, not lifetime counters)
+        lm.generate_continuous(['one more prompt here'], 4)
+    finally:
+        livemod.Heartbeat.progress = orig_progress
+        obsmod.reset_obs()
+        tracer.close()
+    # per-retired-row ticks: every count 1..10 observed, not batch jumps
+    assert set(range(1, 11)) <= set(ticks)
+    state = json.loads(
+        open(livemod.heartbeat_path(obs_dir, 'cont-task')).read())
+    assert state['done'] == 10
+    assert 0 < state.get('decode_slot_util', 0) <= 1
+    records = list(iter_records(timeline_path(obs_dir, 'cont-task')))
+    kinds = [r['t'] for r in records]
+    assert 'plan' in kinds and 'engine' in kinds
+    plan = next(r for r in records if r['t'] == 'plan')
+    assert plan['stats'].get('continuous') is True
+    assert plan['stats'].get('n_shapes') == 2
+    engines = [r for r in records if r['t'] == 'engine']
+    assert len(engines) == 2
+    eng, eng2 = engines
+    assert eng['slots'] == 2 and eng['retired'] == 10
+    assert eng['occupancy_series'] and eng['decode_steps'] > 0
+    # second drain reports ITS delta, not the engine lifetime
+    assert eng2['rows'] == 1 and eng2['retired'] == 1
+    assert eng2['joined'] == 1
+    assert eng2['decode_steps'] < eng['decode_steps']
+    summary = summarize_records(records)
+    assert summary['engine_rows'] == 11
+
+
+def test_status_fold_and_metrics_carry_decode_slot_util(tmp_path):
+    from opencompass_tpu.obs.live import build_status, fold_task_rows
+    from opencompass_tpu.obs.promexport import render_prometheus
+    from opencompass_tpu.obs import live as livemod
+    obs_dir = str(tmp_path / 'obs')
+    hb = livemod.Heartbeat(obs_dir, 'engine-task', interval=0.0)
+    hb.progress(done=4, total=8)
+    hb.note(decode_slot_util=0.75)
+    snap = build_status(obs_dir)
+    row = snap['tasks']['engine-task']
+    assert row['decode_slot_util'] == 0.75
+    assert snap['overall']['decode_slot_util'] == 0.75
+    text = render_prometheus({'counters': {}, 'gauges': {},
+                              'histograms': {}}, status=snap)
+    assert 'oct_run_decode_slot_util 0.75' in text
+    assert 'oct_task_decode_slot_util{task="engine-task"} 0.75' in text
+    # tasks without the gauge fold to None, not zero
+    assert fold_task_rows({'x': {'state': 'ok'}})['decode_slot_util'] \
+        is None
+
+
+# -- serve plane: mid-sweep joins -------------------------------------------
+
+def test_resident_worker_request_join_busy_fallback():
+    """request_join: busy reply falls back to the serialized wait;
+    WorkerTimeout maps to WorkerBusyError back-pressure."""
+    from opencompass_tpu.runners.worker import WorkerTimeout
+    from opencompass_tpu.serve.scheduler import (ResidentWorker,
+                                                 WorkerBusyError)
+
+    class _Handle:
+        dead = False
+        proc = type('P', (), {'pid': 1,
+                              'poll': staticmethod(lambda: None)})()
+
+        def __init__(self):
+            self.calls = []
+
+        def request(self, msg, timeout=None, kill_on_timeout=True):
+            self.calls.append((dict(msg), timeout, kill_on_timeout))
+            if len(self.calls) == 1:
+                return {'ok': False, 'busy': True, 'error': 'mid-run'}
+            return {'ok': True, 'completions': ['x']}
+
+    handle = _Handle()
+    worker = ResidentWorker('k', handle, [], 0)
+    resp = worker.request_join({'cmd': 'complete'}, timeout=30)
+    assert resp['ok'] and len(handle.calls) == 2
+    assert handle.calls[0][2] is False      # concurrent, no kill
+    assert handle.calls[1][2] is True       # serialized fallback
+
+    class _TimeoutHandle(_Handle):
+        def request(self, msg, timeout=None, kill_on_timeout=True):
+            raise WorkerTimeout('abandoned')
+
+    worker2 = ResidentWorker('k2', _TimeoutHandle(), [], 0)
+    with pytest.raises(WorkerBusyError):
+        worker2.request_join({'cmd': 'complete'}, timeout=1)
+
+
+def test_worker_handle_demux_concurrent_roundtrips(tmp_path):
+    """Two threads share one worker channel; both round-trips complete
+    (rid demux routes each response to its waiter)."""
+    from opencompass_tpu.runners.worker import WorkerHandle
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    repo = osp.dirname(osp.dirname(osp.abspath(__file__)))
+    env['PYTHONPATH'] = repo + (
+        ':' + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+    handle = WorkerHandle(env, str(tmp_path / 'w.log'))
+    try:
+        results = []
+
+        def ping():
+            results.append(handle.request({'cmd': 'ping'}, timeout=60))
+        threads = [threading.Thread(target=ping) for _ in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(90)
+        assert len(results) == 3
+        assert all(r.get('pong') for r in results)
+    finally:
+        handle.kill()
+
+
+def test_worker_complete_joins_resident_engine_mid_run(tmp_path):
+    """End to end through the real pipes: a `complete` sent while a
+    `run` round-trip is outstanding is answered from the resident
+    model's continuous path BEFORE the sweep finishes — the continuous
+    engine is what makes mid-sweep completions cheap."""
+    from opencompass_tpu.config import Config
+    from opencompass_tpu.partitioners import SizePartitioner
+    from opencompass_tpu.runners.worker import WorkerHandle
+    repo = osp.dirname(osp.dirname(osp.abspath(__file__)))
+    cfg = Config.fromfile(osp.join(repo, 'configs/eval_demo.py'))
+    cfg['work_dir'] = str(tmp_path / 'work')
+    for m in cfg['models']:
+        m['continuous'] = True
+    part = SizePartitioner(osp.join(cfg['work_dir'], 'predictions/'),
+                           max_task_size=2000,
+                           dataset_size_path=str(tmp_path / 'size.json'))
+    tasks = part(cfg)
+    assert tasks
+    cfg_path = str(tmp_path / 'task_cfg.py')
+    Config(tasks[0]).dump(cfg_path)
+    from opencompass_tpu.utils.build import normalize_cfg_types
+    model_cfg = normalize_cfg_types(dict(tasks[0]['models'][0]))
+
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               OCT_DEBUG_BATCH_SLEEP_S='0.4')
+    env.pop('OCT_CACHE_ROOT', None)
+    env['PYTHONPATH'] = repo + (
+        ':' + env['PYTHONPATH'] if env.get('PYTHONPATH') else '')
+    handle = WorkerHandle(env, str(tmp_path / 'worker.log'))
+    done = {}
+    try:
+        def run():
+            done['run'] = handle.request_watched(
+                {'cmd': 'run', 'task_type': 'OpenICLInferTask',
+                 'cfg_path': cfg_path, 'name': 'join-test',
+                 'log_path': str(tmp_path / 'task.log')}, timeout=300)
+            done['run_ts'] = time.monotonic()
+        thread = threading.Thread(target=run)
+        thread.start()
+        # poll until the task's model is resident (busy until then);
+        # the batch-sleep env keeps the run in flight long after that
+        resp = {'busy': True}
+        deadline = time.monotonic() + 120
+        while resp.get('busy') and time.monotonic() < deadline \
+                and 'run_ts' not in done:
+            resp = handle.request(
+                {'cmd': 'complete', 'model_cfg': model_cfg,
+                 'prompts': ['Q: joined mid sweep?\nA:'],
+                 'max_out_len': 4,
+                 'cache_root': str(tmp_path / 'cache')},
+                timeout=120, kill_on_timeout=False)
+            if resp.get('busy'):
+                time.sleep(0.2)
+        done['complete_ts'] = time.monotonic()
+        thread.join(300)
+    finally:
+        handle.kill()
+    assert resp.get('ok'), resp
+    assert resp.get('engine_join') is True
+    assert len(resp['completions']) == 1
+    assert done['run'].get('ok'), done['run']
+    # the completion really was answered mid-sweep
+    assert done['complete_ts'] < done['run_ts']
