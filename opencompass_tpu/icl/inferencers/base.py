@@ -195,6 +195,16 @@ class BaseInferencer:
         perf = getattr(model, 'perf', None)
         if not isinstance(perf, PerfCounters):
             perf = None
+        # roofline attribution (obs/costmodel.py): None for models
+        # without a transformer geometry (FakeModel, API) — their
+        # records simply omit the cost fields
+        cost_model = None
+        if perf is not None:
+            try:
+                from opencompass_tpu.obs.costmodel import CostModel
+                cost_model = CostModel.for_model(model)
+            except Exception:
+                cost_model = None
         state = {'snap': perf.snapshot() if perf else None, 'meta': {}}
         inner_dispatch, inner_collect = dispatch, collect
 
@@ -238,12 +248,50 @@ class BaseInferencer:
                 calls = pop(n_calls)
                 if calls:
                     fields['calls'] = calls
+            if cost_model is not None:
+                fields.update(self._cost_fields(cost_model, kind,
+                                                fields))
             # record before the scatter so a failing collect still
             # leaves the executed batch on the flight recorder
             timeline.batch(kind, **fields)
             inner_collect(batch, result)
 
         return rec_dispatch, rec_collect
+
+    def _cost_fields(self, cost_model, kind: str, fields: dict) -> dict:
+        """Roofline fields for one recorded batch (obs/costmodel.py):
+        analytic FLOPs / weight bytes / KV bytes from this batch's
+        real token counts, MFU/MBU against its measured device wall.
+        Gen batches model the dense fixed-shape path (whole padded
+        cache buffer read per decode step); scoring batches are one
+        causal forward.  Never raises — cost attribution is telemetry."""
+        try:
+            rows = int(fields.get('rows') or 1)
+            t_in = int(fields.get('tokens_in') or 0)
+            t_out = int(fields.get('tokens_out') or 0)
+            if not t_in and not t_out:
+                return {}
+            if kind == 'gen':
+                width = None
+                shape = fields.get('shape') or []
+                max_new = getattr(self, 'max_out_len', None)
+                if len(shape) == 2 and max_new:
+                    # dense decode reads the full padded cache buffer
+                    # (prompt bucket + decode reservation) every step
+                    width = int(shape[1]) + int(max_new)
+                cost = cost_model.gen_cost(t_in, t_out, rows,
+                                           cache_width=width)
+            else:
+                cost = cost_model.score_cost(t_in, rows)
+            out = cost_model.fields(cost, fields.get('device_s'))
+            if 'mbu' in out or 'mfu' in out:
+                from opencompass_tpu.obs import get_heartbeat
+                hb = get_heartbeat()
+                if hb.enabled:
+                    hb.note(mfu=out.get('mfu'), mbu=out.get('mbu'))
+            return out
+        except Exception:
+            return {}
 
     def inference(self, retriever, ice_template=None, prompt_template=None,
                   output_json_filepath=None, output_json_filename=None):

@@ -9,9 +9,11 @@ then ``<path>/cache/ledger``.
 - ``diff [--baseline RUN] [--run RUN]``: per-(model, dataset, kind)
   deltas vs the baseline (pinned, or the previous run).
 - ``check``: same comparison, exits **2** when any row regresses past
-  ``--max-slowdown`` / ``--max-accuracy-drop`` — the CI gate.  With
-  ``--trajectory BENCH_TRAJECTORY.json`` it additionally gates the
-  per-PR bench legs (the run ledger still gates whenever it has
+  ``--max-slowdown`` / ``--max-accuracy-drop`` — the CI gate.
+  ``--min-mfu-ratio FRAC`` adds the roofline efficiency gate (MFU may
+  not fall below FRAC of baseline; rows without an MFU are skipped).
+  With ``--trajectory BENCH_TRAJECTORY.json`` it additionally gates
+  the per-PR bench legs (the run ledger still gates whenever it has
   records).
 - ``pin RUN``: pin the baseline run id (``baseline.json``).
 """
@@ -137,7 +139,8 @@ def _cmd_check(records, args) -> int:
             compared = (base, cur)
             regressions += ledmod.check_records(
                 records, base, cur, max_slowdown=args.max_slowdown,
-                max_accuracy_drop=args.max_accuracy_drop)
+                max_accuracy_drop=args.max_accuracy_drop,
+                min_mfu_ratio=args.min_mfu_ratio)
         elif not args.trajectory:
             # a gate with no baseline passes: the FIRST run of a sweep
             # (or a fresh cache root) has nothing to regress against,
@@ -162,6 +165,10 @@ def _cmd_check(records, args) -> int:
                       f"{reg['tokens_per_sec']} "
                       f"({reg['tokens_per_sec_rel']:+.1%}, threshold "
                       f"{reg['threshold']:.0%})")
+            elif reg['regression'] == 'efficiency':
+                print(f"REGRESSION [{reg['model']}/{reg['dataset']}]: "
+                      f"MFU {reg.get('mfu_base')} -> {reg.get('mfu')} "
+                      f"(below {reg['threshold']:.0%} of baseline)")
             else:
                 print(f"REGRESSION [{reg['model']}/{reg['dataset']}]: "
                       f"accuracy {reg['drops']}")
@@ -199,6 +206,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         metavar='PTS',
                         help='accuracy may drop at most this many '
                         'points below baseline (default 0.5)')
+    parser.add_argument('--min-mfu-ratio', type=float, default=None,
+                        metavar='FRAC',
+                        help='roofline efficiency gate: a row whose '
+                        'MFU falls below FRAC of the baseline MFU '
+                        'regresses (e.g. 0.5 = halved efficiency '
+                        'fails; off by default — rows without an MFU '
+                        'are skipped)')
     parser.add_argument('--trajectory', default=None, metavar='FILE',
                         help='additionally gate a bench '
                         'BENCH_TRAJECTORY.json (latest vs previous '

@@ -161,6 +161,13 @@ def collect_run_records(work_dir: str,
             'store_hit_rate': round(st_h / (st_h + st_m), 4)
             if st_h + st_m else None,
             'duty_cycle': tl.get('duty_cycle'),
+            # roofline join (obs/costmodel.py fields folded by the
+            # flight recorder): device-wall-weighted MFU/MBU and the
+            # actual-vs-ideal KV traffic ratio — what `check
+            # --min-mfu-ratio` gates on
+            'mfu': tl.get('mfu'),
+            'mbu': tl.get('mbu'),
+            'kv_ratio': tl.get('kv_ratio'),
             'error': perf.get('error'),
             'accuracy': accuracy,
         })
@@ -276,7 +283,8 @@ def diff_records(records: List[Dict], baseline: str,
                'in_baseline': base is not None, 'in_run': cur is not None}
         if base and cur:
             for metric in (THROUGHPUT_KEY, 'samples_per_sec',
-                           'wall_seconds', 'compile_seconds'):
+                           'wall_seconds', 'compile_seconds',
+                           'mfu', 'mbu', 'kv_ratio'):
                 row[metric] = cur.get(metric)
                 row[f'{metric}_base'] = base.get(metric)
                 row[f'{metric}_rel'] = _rel(cur.get(metric),
@@ -294,7 +302,8 @@ def diff_records(records: List[Dict], baseline: str,
 
 def check_records(records: List[Dict], baseline: str, run: str,
                   max_slowdown: float = 0.25,
-                  max_accuracy_drop: float = 0.5) -> List[Dict]:
+                  max_accuracy_drop: float = 0.5,
+                  min_mfu_ratio: Optional[float] = None) -> List[Dict]:
     """Regression rows: tokens/s below ``baseline * (1 - max_slowdown)``
     or any shared accuracy metric down more than ``max_accuracy_drop``
     (absolute, in the metric's own units — the summarizer's scores are
@@ -303,7 +312,15 @@ def check_records(records: List[Dict], baseline: str, run: str,
     A side the result store served *fully* (``store_hit_rate == 1.0``)
     did no device work, so its tokens/s is meaningless — such rows skip
     the throughput gate (a warm rerun must not read as a -100%
-    regression) but still gate on accuracy."""
+    regression) but still gate on accuracy.
+
+    ``min_mfu_ratio`` adds the roofline efficiency gate: a row whose
+    MFU fell below ``baseline_mfu * min_mfu_ratio`` regresses even when
+    raw tokens/s survived the throughput threshold (MFU normalizes by
+    device seconds, so it catches a hot path quietly spending more
+    device time per token).  Rows where either side lacks an MFU
+    (FakeModel/API units, pre-roofline records) or was fully
+    store-served skip this gate, like the throughput one."""
 
     def computed(rate) -> bool:
         # None = store off / pre-store record: assume real device work
@@ -313,14 +330,24 @@ def check_records(records: List[Dict], baseline: str, run: str,
     for row in diff_records(records, baseline, run):
         if not (row['in_baseline'] and row['in_run']):
             continue
+        both_computed = (computed(row.get('store_hit_rate'))
+                         and computed(row.get('store_hit_rate_base')))
         rel = row.get(f'{THROUGHPUT_KEY}_rel')
-        if not (computed(row.get('store_hit_rate'))
-                and computed(row.get('store_hit_rate_base'))):
+        if not both_computed:
             rel = None
         if rel is not None and rel < -max_slowdown:
             out.append({**row, 'regression': 'throughput',
                         'threshold': -max_slowdown})
             continue
+        if min_mfu_ratio is not None and both_computed:
+            cur_mfu, base_mfu = row.get('mfu'), row.get('mfu_base')
+            if isinstance(cur_mfu, (int, float)) \
+                    and isinstance(base_mfu, (int, float)) \
+                    and base_mfu > 0 \
+                    and cur_mfu < base_mfu * min_mfu_ratio:
+                out.append({**row, 'regression': 'efficiency',
+                            'threshold': min_mfu_ratio})
+                continue
         drops = {m: d for m, d in (row.get('accuracy_delta') or {}).items()
                  if d < -max_accuracy_drop}
         if drops:

@@ -155,6 +155,34 @@ class ContinuousEngine:
         self._retire_seq = 0
         self._occ_series: 'collections.deque[int]' = collections.deque(
             maxlen=4096)
+        # roofline accounting (obs/costmodel.py): exact per-engine
+        # token/step/attended-position counters so MFU/MBU and the
+        # paged-gather-vs-ideal KV-traffic ratio come from what the
+        # engine actually did, not an equal-length approximation
+        self.device_seconds = 0.0
+        self.prefill_tokens = 0
+        # tokens processed at decode steps = active rows summed over
+        # decode steps = occupancy_sum (already tracked above); no
+        # separate counter needed.
+        # kv_positions: per step, each active row's current KV extent
+        # (start + n_new) — the IDEAL HBM read traffic a ragged kernel
+        # would move (one materialization per step, on-chip reuse
+        # across the chunk's queries); the gather path's actual is
+        # steps * slots * max_pages * page_size.
+        # attn_positions: attended (query, key) PAIRS (token i of a
+        # chunk starting at s attends s+i+1 positions) — the attention
+        # FLOPs input, which unlike bytes scales per query token.
+        self.kv_positions = 0
+        self.attn_positions = 0
+        try:
+            from opencompass_tpu.obs.costmodel import CostModel
+            self._costmodel = CostModel.for_model(model)
+        except Exception:
+            self._costmodel = None
+        # rate-limit for the structured kv_pool_pressure obs event: an
+        # exhausted pool bounces an admission every step, the event
+        # stream must not scale with step count
+        self._last_pressure_event = 0.0
 
     # -- intake ------------------------------------------------------------
 
@@ -190,12 +218,40 @@ class ContinuousEngine:
             try:
                 pages = self.alloc.alloc(need)
             except OutOfPages:
-                break           # FIFO back-pressure: retries next step
+                # FIFO back-pressure: retries next step.  Surface the
+                # stall as a structured obs event (rate-limited) so an
+                # undersized kv_pool_pages shows up in the event
+                # stream instead of only as mysteriously low slot_util
+                self._note_pool_pressure_locked(need)
+                break
             self._queue.popleft()
             self.table.assign(slot, pages)
             row.slot = slot
             self._slots[slot] = row
             self.joined += 1
+
+    def _note_pool_pressure_locked(self, need: int):
+        """One ``kv_pool_pressure`` event per admission-stall episode
+        (>= 5 s apart): queued rows waiting on page exhaustion.  Never
+        fails a step."""
+        now = time.monotonic()
+        if now - self._last_pressure_event < 5.0:
+            return
+        self._last_pressure_event = now
+        try:
+            from opencompass_tpu.obs import get_tracer
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.event('kv_pool_pressure',
+                             need_pages=int(need),
+                             free_pages=self.alloc.n_free,
+                             pool_pages=self.num_pages,
+                             queued_rows=len(self._queue),
+                             failed_allocs=self.alloc.failed_allocs,
+                             high_water=self.alloc.high_water)
+                tracer.counter('engine.kv_pool_stalls').inc()
+        except Exception:
+            pass
 
     def _retire_locked(self, row: _EngineRow):
         self.alloc.free(self.table.clear(row.slot))
@@ -229,11 +285,22 @@ class ContinuousEngine:
                     tokens[row.slot, :len(chunk)] = chunk
                     start[row.slot] = row.kv_len
                     n_new[row.slot] = len(chunk)
+                    self.prefill_tokens += len(chunk)
+                    # ideal HBM reads: this row's KV extent after the
+                    # chunk, materialized once this step
+                    self.kv_positions += row.kv_len + len(chunk)
+                    # attended pairs: token i of a chunk starting at s
+                    # attends s + i + 1 positions
+                    self.attn_positions += (len(chunk) * row.kv_len
+                                            + len(chunk)
+                                            * (len(chunk) + 1) // 2)
             else:
                 for row in active:
                     tokens[row.slot, 0] = row.emitted[-1]
                     start[row.slot] = row.kv_len
                     n_new[row.slot] = 1
+                    self.kv_positions += row.kv_len + 1
+                    self.attn_positions += row.kv_len + 1
             page_table = self.table.table.copy()
             self.steps += 1
             step_no = self.steps
@@ -256,6 +323,7 @@ class ContinuousEngine:
             jnp.asarray(page_table), rng)
         nxt = np.asarray(nxt)
         elapsed = time.perf_counter() - t0
+        self.device_seconds += elapsed
         perf = model.perf
         perf.device_seconds += elapsed
         perf.calls += 1
@@ -290,15 +358,43 @@ class ContinuousEngine:
         return True
 
     def _note_heartbeat_locked(self):
-        """Live decode-slot utilization into this task's heartbeat (the
-        status plane's ``decode_slot_util`` / ``oct_run_decode_slot_util``
-        signal).  Rate-limited by the heartbeat itself; never fails."""
+        """Live decode-slot utilization, engine-lifetime MFU/MBU, and
+        KV-pool occupancy gauges into this task's heartbeat (the status
+        plane's ``decode_slot_util`` / ``mbu`` / ``kv_pool_*`` signals,
+        folded into status.json and ``oct_run_*`` / ``oct_kv_pool_*``
+        on ``/metrics``).  Rate-limited by the heartbeat itself; never
+        fails.  Caller holds ``self._lock`` — everything here reads
+        counters directly, never via :meth:`stats`."""
         if self.decode_steps and self.decode_steps % 8 == 0:
             try:
                 from opencompass_tpu.obs import get_heartbeat
                 hb = get_heartbeat()
-                if hb.enabled:
-                    hb.note(decode_slot_util=round(self.slot_util, 4))
+                if not hb.enabled:
+                    return
+                pool = self.alloc.stats()
+                fields = dict(
+                    decode_slot_util=round(self.slot_util, 4),
+                    kv_pool_used_frac=pool['used_frac'],
+                    kv_pool_high_water_frac=pool['high_water_frac'],
+                    kv_pool_failed_allocs=pool['failed_allocs'])
+                cm = self._costmodel
+                if cm is not None and self.device_seconds > 0:
+                    cost = cm.engine_cost(
+                        prefill_tokens=self.prefill_tokens,
+                        decode_tokens=self.occupancy_sum,
+                        prefill_steps=self.prefill_steps,
+                        decode_steps=self.decode_steps,
+                        slots=self.slots,
+                        table_positions=self.max_pages * self.page_size,
+                        kv_positions=self.kv_positions,
+                        attn_positions=self.attn_positions)
+                    mfu = cm.mfu(cost.flops, self.device_seconds)
+                    mbu = cm.mbu(cost.bytes_total, self.device_seconds)
+                    if mfu is not None:
+                        fields['mfu'] = round(mfu, 6)
+                    if mbu is not None:
+                        fields['mbu'] = round(mbu, 6)
+                hb.note(**fields)
             except Exception:
                 pass
 
@@ -343,7 +439,11 @@ class ContinuousEngine:
                     'prefill_steps': self.prefill_steps,
                     'decode_steps': self.decode_steps,
                     'occupancy_sum': self.occupancy_sum,
-                    'joined': self.joined, 'retired': self.retired}
+                    'joined': self.joined, 'retired': self.retired,
+                    'device_seconds': self.device_seconds,
+                    'prefill_tokens': self.prefill_tokens,
+                    'kv_positions': self.kv_positions,
+                    'attn_positions': self.attn_positions}
 
     def stats(self, since: Optional[Dict] = None) -> Dict:
         """Engine counters — lifetime by default, or the delta since a
@@ -375,7 +475,46 @@ class ContinuousEngine:
                 else 0.0,
                 'occupancy_series': [
                     round(v, 2) for v in _downsample(series)],
+                # roofline inputs (obs/costmodel.engine_cost): device
+                # wall, exact token/attended-position counts (decode
+                # tokens processed = occupancy delta), and the gather
+                # path's per-step table width
+                'device_seconds': round(
+                    self.device_seconds
+                    - base.get('device_seconds', 0.0), 6),
+                'prefill_tokens': self.prefill_tokens
+                - base.get('prefill_tokens', 0),
+                'decode_tokens': d_occ,
+                'kv_positions': self.kv_positions
+                - base.get('kv_positions', 0),
+                'attn_positions': self.attn_positions
+                - base.get('attn_positions', 0),
+                'table_positions': self.max_pages * self.page_size,
+                'kv_pool': self.alloc.stats(),
             }
+
+    def cost_fields(self, stats: Dict) -> Dict:
+        """Roofline fields (flops / bytes_w / bytes_kv /
+        bytes_kv_ideal / mfu / mbu) for one drain's :meth:`stats`
+        delta; {} when the model has no transformer geometry.  Never
+        raises — cost attribution is telemetry."""
+        try:
+            cm = self._costmodel
+            if cm is None:
+                return {}
+            cost = cm.engine_cost(
+                prefill_tokens=stats.get('prefill_tokens') or 0,
+                decode_tokens=stats.get('decode_tokens') or 0,
+                prefill_steps=stats.get('prefill_steps') or 0,
+                decode_steps=stats.get('decode_steps') or 0,
+                slots=stats.get('slots') or self.slots,
+                table_positions=stats.get('table_positions')
+                or self.max_pages * self.page_size,
+                kv_positions=stats.get('kv_positions'),
+                attn_positions=stats.get('attn_positions'))
+            return cm.fields(cost, stats.get('device_seconds'))
+        except Exception:
+            return {}
 
     # -- draining ----------------------------------------------------------
 
@@ -1402,6 +1541,17 @@ class JaxLM(BaseModel):
             if firsts:
                 # measured (not estimated): submit -> first sampled token
                 stats_out['ttft_s'] = round(min(firsts) - t0p, 6)
+            try:
+                # roofline attribution for the serve plane: this
+                # call's engine-step deltas → MFU/MBU against the
+                # drain's device wall (requests.jsonl forward phase)
+                cost = engine.cost_fields(engine.stats(since=snap))
+                if cost.get('mfu') is not None:
+                    stats_out['mfu'] = cost['mfu']
+                if cost.get('mbu') is not None:
+                    stats_out['mbu'] = cost['mbu']
+            except Exception:
+                pass
         return [t if t is not None else '' for t in texts]
 
     def _record_engine_drain(self, engine: 'ContinuousEngine',
@@ -1409,13 +1559,18 @@ class JaxLM(BaseModel):
         """One flight-recorder ``engine`` record per drained call —
         per-drain DELTAS (this call's steps/joins/retires/occupancy),
         so a resident engine's Nth task reports only its own work
-        (obs/timeline.py).  Never fails the call."""
+        (obs/timeline.py) — plus the drain's roofline fields
+        (flops/bytes_w/bytes_kv[_ideal]/mfu/mbu from
+        obs/costmodel.engine_cost, so the KV gather-vs-ideal traffic
+        ratio rides every drain).  Never fails the call."""
         try:
             from opencompass_tpu.obs import get_timeline
             tl = get_timeline()
             if tl.enabled:
+                stats = engine.stats(since=snap)
+                fields = dict(stats, **engine.cost_fields(stats))
                 tl.engine('gen', ts=round(t0, 6), rows=n_rows,
-                          **engine.stats(since=snap))
+                          dur_s=round(time.time() - t0, 6), **fields)
         except Exception:
             pass
 

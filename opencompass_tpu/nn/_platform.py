@@ -1,7 +1,9 @@
-"""Backend probe shared by the Pallas kernel modules."""
+"""Backend probe shared by the Pallas kernel modules and the roofline
+cost model (obs/costmodel.py keys its peak table on these)."""
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 
@@ -13,3 +15,26 @@ def on_tpu() -> bool:
         return jax.devices()[0].platform == 'tpu'
     except RuntimeError:  # pragma: no cover - no backend configured
         return False
+
+
+@functools.cache
+def platform() -> str:
+    """'tpu' | 'gpu' | 'cpu' (the default backend's platform); 'cpu'
+    when no backend is configured — the caller still gets a usable
+    (if pessimistic) roofline peak."""
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError:  # pragma: no cover - no backend configured
+        return 'cpu'
+
+
+@functools.cache
+def device_kind() -> Optional[str]:
+    """The accelerator's self-reported kind string ('TPU v4',
+    'NVIDIA A100-SXM4-40GB', ...), or None when the backend does not
+    expose one (CPU)."""
+    try:
+        kind = getattr(jax.devices()[0], 'device_kind', None)
+        return str(kind) if kind else None
+    except RuntimeError:  # pragma: no cover - no backend configured
+        return None

@@ -70,6 +70,13 @@ class PageAllocator:
         self.num_pages = num_pages
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
         self._allocated: set = set()
+        # pool-pressure telemetry (obs/costmodel roofline plane): the
+        # occupancy high-water mark and how many allocations bounced on
+        # an exhausted pool (admission back-pressure) — the two numbers
+        # that make an undersized kv_pool_pages visible instead of
+        # silently serializing the engine
+        self.high_water = 0
+        self.failed_allocs = 0
 
     @property
     def n_free(self) -> int:
@@ -79,10 +86,32 @@ class PageAllocator:
     def n_allocated(self) -> int:
         return len(self._allocated)
 
+    @property
+    def usable_pages(self) -> int:
+        """Pages the allocator can ever hand out (pool minus the
+        reserved garbage page) — the denominator for occupancy/
+        high-water fractions."""
+        return self.num_pages - 1
+
+    def stats(self) -> dict:
+        """Occupancy gauges for heartbeats / status.json / /metrics."""
+        usable = max(self.usable_pages, 1)
+        return {
+            'pages': self.num_pages,
+            'used': self.n_allocated,
+            'free': self.n_free,
+            'used_frac': round(self.n_allocated / usable, 4),
+            'high_water': self.high_water,
+            'high_water_frac': round(self.high_water / usable, 4),
+            'failed_allocs': self.failed_allocs,
+        }
+
     def alloc(self, n: int) -> List[int]:
         """``n`` distinct pages, or :class:`OutOfPages` (atomic: on
-        failure nothing is taken)."""
+        failure nothing is taken; the bounce is counted in
+        ``failed_allocs``)."""
         if n > len(self._free):
+            self.failed_allocs += 1
             raise OutOfPages(
                 f'need {n} pages, {len(self._free)} free '
                 f'(pool of {self.num_pages})')
@@ -91,6 +120,7 @@ class PageAllocator:
             if p in self._allocated or p == GARBAGE_PAGE:
                 raise AssertionError(f'allocator handed out page {p} twice')
             self._allocated.add(p)
+        self.high_water = max(self.high_water, len(self._allocated))
         return pages
 
     def free(self, pages: List[int]):

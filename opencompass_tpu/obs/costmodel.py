@@ -1,0 +1,424 @@
+"""Roofline cost model: analytic FLOPs/bytes per forward, MFU/MBU.
+
+The flight recorder (obs/timeline.py) and the request tracer
+(obs/reqtrace.py) measure how long every batch, engine step, and
+completion took; this module says how close to the hardware ceiling
+that time ran.  Following "Efficiently Scaling Transformer Inference"
+(PAPERS.md), every transformer forward decomposes into
+
+- **matmul FLOPs**: ``2 * matmul_params * tokens`` (each weight
+  participates in one multiply-add per token), plus the attention
+  score/value matmuls ``4 * L * q_dim * token_kv`` where ``token_kv``
+  sums, over every attending token, the KV length it attends to
+  (causal prefill of a length-``l`` row contributes ``l(l+1)/2``; one
+  decode step at KV length ``k`` contributes ``k``);
+- **weight bytes**: one full stream of the matmul weights per device
+  step — prefill amortizes it over the chunk's tokens, decode pays it
+  per generated token, which is why decode is bandwidth-bound;
+- **KV-cache bytes**: writes (every new token's K/V vectors, once) and
+  reads (``kv_token_bytes`` per position *materialized from HBM*).
+  Attention FLOPs count attended (query, key) pairs; HBM read traffic
+  does not — a whole prefill chunk's queries attend within ONE
+  materialized view, so bytes count positions-per-step, with on-chip
+  reuse across the chunk's query tokens assumed.  Reads come in three
+  variants, because the *implementation* determines the traffic:
+
+  - ``ideal``: each step reads only the positions the resident rows
+    actually hold (exact ragged lengths, each position once) — what a
+    Pallas ragged-paged-attention kernel would move;
+  - ``paged_gather``: the current engine's XLA gather materializes
+    every slot's full table width every step
+    (``slots * max_pages * page_size`` positions), so traffic matches
+    a dense cache even though *capacity* is paged — the
+    ``kv_ratio = paged_gather / ideal`` number quantifies ROADMAP
+    item 1's gather waste;
+  - ``dense``: the fixed-shape path reads its whole padded cache
+    buffer each step (``B * cache_width`` positions).
+
+Derived utilizations against a per-platform peak table
+(:func:`peak_rates`, keyed on ``nn/_platform.py`` detection,
+overridable via ``OCT_PEAK_FLOPS`` / ``OCT_PEAK_BYTES`` for CI
+determinism):
+
+- **MFU** = model FLOPs / (device seconds x peak FLOP/s) — *useful*
+  FLOPs only (real tokens, not padding), so padding waste lowers MFU;
+- **MBU** = (weight + KV bytes) / (device seconds x peak bytes/s).
+
+Everything here is host-side arithmetic on integers the timeline
+already records — no device work, no jax imports at module top (the
+report/ledger side runs on dead runs and CPU-only drivers).
+
+Known approximations (documented, deliberate): embedding-table gathers
+and small vectors (norms, biases, rotary tables) are excluded from both
+FLOPs and bytes; quantized weight scale tensors are excluded (sub-1% of
+the weight stream); per-row lengths inside one batch are approximated
+as equal when only totals survive into the record; activations
+(residual stream reads/writes) are excluded from MBU — weights + KV
+dominate at inference batch sizes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional
+
+ENV_PEAK_FLOPS = 'OCT_PEAK_FLOPS'
+ENV_PEAK_BYTES = 'OCT_PEAK_BYTES'
+
+# Per-chip peaks: (dense bf16 FLOP/s, HBM bytes/s).  TPU rows keyed on
+# the device_kind prefix jax reports; the bench's _PEAK_TFLOPS table
+# uses the same kind strings.  GPU falls back to A100-class numbers
+# when the kind is unrecognized; CPU numbers are a deliberately rough
+# floor — override via OCT_PEAK_FLOPS/OCT_PEAK_BYTES for anything that
+# should be compared across machines.
+_TPU_PEAKS = {
+    'TPU v2': (45e12, 700e9),
+    'TPU v3': (123e12, 900e9),
+    'TPU v4': (275e12, 1228e9),
+    'TPU v5 lite': (197e12, 819e9),
+    'TPU v5': (459e12, 2765e9),
+    'TPU v6 lite': (918e12, 1640e9),
+}
+_GPU_PEAKS = {
+    'A100': (312e12, 2039e9),
+    'H100': (989e12, 3350e9),
+    'V100': (125e12, 900e9),
+}
+_GPU_DEFAULT = (312e12, 2039e9)   # A100-class
+_CPU_DEFAULT = (2e11, 5e10)       # ~200 GFLOP/s, ~50 GB/s
+
+_DTYPE_BYTES = {'float32': 4, 'bfloat16': 2, 'float16': 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class PeakRates:
+    """The roofline ceiling MFU/MBU divide by."""
+    flops_per_s: float
+    bytes_per_s: float
+    source: str            # 'env' | detected kind | platform fallback
+
+    def mfu(self, flops: float, seconds: float) -> Optional[float]:
+        if not seconds or seconds <= 0 or not self.flops_per_s:
+            return None
+        return flops / (seconds * self.flops_per_s)
+
+    def mbu(self, nbytes: float, seconds: float) -> Optional[float]:
+        if not seconds or seconds <= 0 or not self.bytes_per_s:
+            return None
+        return nbytes / (seconds * self.bytes_per_s)
+
+
+def peak_rates(platform: Optional[str] = None,
+               device_kind: Optional[str] = None) -> PeakRates:
+    """The peak table row for this process's accelerator.
+
+    Resolution order: ``OCT_PEAK_FLOPS``/``OCT_PEAK_BYTES`` env
+    override (both must be set — the CI-determinism knob), then the
+    detected TPU/GPU kind, then a platform-level fallback.  Detection
+    arguments default to ``nn/_platform.py`` probes; pass them
+    explicitly to stay device-free (tests, dead-run reports).
+    """
+    env_f = os.environ.get(ENV_PEAK_FLOPS)
+    env_b = os.environ.get(ENV_PEAK_BYTES)
+    if env_f and env_b:
+        try:
+            return PeakRates(float(env_f), float(env_b), 'env')
+        except ValueError:
+            pass
+    if platform is None:
+        from opencompass_tpu.nn import _platform
+        platform = _platform.platform()
+        if device_kind is None:
+            device_kind = _platform.device_kind()
+    kind = device_kind or ''
+    if platform == 'tpu':
+        # longest matching prefix so 'TPU v5 lite' beats 'TPU v5'
+        best = None
+        for name, peaks in _TPU_PEAKS.items():
+            if kind.startswith(name) and (
+                    best is None or len(name) > len(best[0])):
+                best = (name, peaks)
+        if best is not None:
+            return PeakRates(*best[1], source=best[0])
+        return PeakRates(*_TPU_PEAKS['TPU v4'], source='tpu (assumed v4)')
+    if platform == 'gpu':
+        for name, peaks in _GPU_PEAKS.items():
+            if name in kind:
+                return PeakRates(*peaks, source=name)
+        return PeakRates(*_GPU_DEFAULT, source='gpu (assumed A100)')
+    return PeakRates(*_CPU_DEFAULT, source='cpu')
+
+
+# -- geometry constants ------------------------------------------------------
+
+def matmul_params(cfg) -> int:
+    """Weights participating in the per-token matmuls: QKV/O
+    projections, the MLP, and the LM head.  Embedding gathers and
+    norm/bias vectors are excluded (they are not matmuls and their
+    traffic is negligible next to these)."""
+    per_layer = (cfg.hidden_size * (cfg.q_dim + 2 * cfg.kv_dim)
+                 + cfg.q_dim * cfg.hidden_size
+                 + (3 if cfg.gated_mlp else 2)
+                 * cfg.hidden_size * cfg.intermediate_size)
+    return cfg.num_layers * per_layer + cfg.hidden_size * cfg.vocab_size
+
+
+def weight_width_bytes(cfg, quantize: Optional[str] = None) -> float:
+    """Bytes per matmul weight element as stored on device: the config
+    dtype, or 1 (int8 / w8a8) / 0.5 (w4a8 int4x2 packing) under the
+    JaxLM ``quantize`` modes.  Group/channel scale tensors are excluded
+    (sub-1% of the stream)."""
+    base = (quantize or '').partition('-')[0]
+    if base in ('int8', 'w8a8'):
+        return 1.0
+    if base == 'w4a8':
+        return 0.5
+    return float(_DTYPE_BYTES.get(cfg.dtype, 2))
+
+
+def weight_bytes(cfg, quantize: Optional[str] = None) -> float:
+    """One full stream of the matmul weights (one device step's weight
+    traffic)."""
+    return matmul_params(cfg) * weight_width_bytes(cfg, quantize)
+
+
+def kv_token_bytes(cfg) -> float:
+    """Bytes of one token's K+V vectors across ONE layer, at the
+    cache's storage width: ``2 * kv_dim`` elements (K and V) at the
+    cache element width, plus the per-vector scales (one scalar per
+    head per K/V) for quantized caches."""
+    mode = cfg.kv_quant_mode
+    act = float(_DTYPE_BYTES.get(cfg.dtype, 2))
+    if mode == 'int8':
+        el, scale = 1.0, 2 * cfg.num_kv_heads * act
+    elif mode == 'int4':
+        el, scale = 0.5, 2 * cfg.num_kv_heads * act
+    else:
+        el, scale = act, 0.0
+    return 2 * cfg.kv_dim * el + scale
+
+
+def causal_token_kv(n_tokens: float, rows: int = 1) -> float:
+    """Attended-position sum for a causal prefill of ``n_tokens`` total
+    tokens across ``rows`` equal-length rows: per row
+    ``l * (l + 1) / 2`` with ``l = n_tokens / rows``.  Row lengths
+    inside one batch are approximated as equal — only totals survive
+    into the timeline record."""
+    rows = max(int(rows), 1)
+    length = float(n_tokens) / rows
+    return rows * length * (length + 1) / 2
+
+
+def decode_token_kv(prefill_tokens: float, decode_tokens: float,
+                    rows: int = 1) -> float:
+    """Attended-position sum for decoding ``decode_tokens`` total
+    tokens across ``rows`` rows whose prompts total
+    ``prefill_tokens``: decode step ``t`` of a row attends to
+    ``l_p + t`` positions."""
+    rows = max(int(rows), 1)
+    l_p = float(prefill_tokens) / rows
+    d = float(decode_tokens) / rows
+    return rows * (d * l_p + d * (d + 1) / 2)
+
+
+def flops_matmul(cfg, n_tokens: float) -> float:
+    return 2.0 * matmul_params(cfg) * float(n_tokens)
+
+
+def flops_attention(cfg, token_kv: float) -> float:
+    """QK^T + attention-weighted V: ``2 * q_dim`` MACs each per
+    (token, attended position) pair."""
+    return 4.0 * cfg.num_layers * cfg.q_dim * float(token_kv)
+
+
+def kv_write_bytes(cfg, n_tokens: float) -> float:
+    return cfg.num_layers * kv_token_bytes(cfg) * float(n_tokens)
+
+
+def kv_read_bytes(cfg, positions: float) -> float:
+    """``positions`` counts KV positions materialized from HBM (each
+    reads one token's K+V vectors in every layer).  NOT attended
+    pairs — a chunk's query tokens share one materialized view
+    (on-chip reuse), so bytes scale with positions-per-step while
+    attention FLOPs scale with pairs."""
+    return cfg.num_layers * kv_token_bytes(cfg) * float(positions)
+
+
+# -- per-forward costs -------------------------------------------------------
+
+@dataclasses.dataclass
+class Cost:
+    """One forward's analytic cost.  ``bytes_kv`` is the traffic of the
+    path that actually ran; ``bytes_kv_ideal`` is the exact-ragged-
+    lengths floor (equal for scoring, lower for paged-gather/dense
+    decode) — their ratio is the KV-traffic waste number."""
+    flops: float = 0.0
+    bytes_w: float = 0.0
+    bytes_kv: float = 0.0
+    bytes_kv_ideal: float = 0.0
+
+    @property
+    def bytes_total(self) -> float:
+        return self.bytes_w + self.bytes_kv
+
+    @property
+    def kv_ratio(self) -> Optional[float]:
+        if not self.bytes_kv_ideal:
+            return None
+        return self.bytes_kv / self.bytes_kv_ideal
+
+    def add(self, other: 'Cost') -> 'Cost':
+        return Cost(self.flops + other.flops,
+                    self.bytes_w + other.bytes_w,
+                    self.bytes_kv + other.bytes_kv,
+                    self.bytes_kv_ideal + other.bytes_kv_ideal)
+
+
+class CostModel:
+    """Per-model analytic cost functions + the platform roofline.
+
+    Built once per (TransformerConfig, quantize mode); every method is
+    pure arithmetic on counts the instrumentation already has.  Use
+    :meth:`for_model` to derive one from a live model wrapper (returns
+    None for models without a transformer geometry — FakeModel, API
+    models — so callers skip cost fields instead of guessing).
+    """
+
+    def __init__(self, cfg, quantize: Optional[str] = None,
+                 peaks: Optional[PeakRates] = None):
+        self.cfg = cfg
+        self.quantize = quantize
+        self.peaks = peaks or peak_rates()
+        self.matmul_params = matmul_params(cfg)
+        self.weight_bytes = weight_bytes(cfg, quantize)
+        self.kv_token_bytes = kv_token_bytes(cfg)
+
+    @classmethod
+    def for_model(cls, model) -> Optional['CostModel']:
+        """A CostModel for a live model wrapper, or None when the model
+        exposes no TransformerConfig (FakeModel, API models).  Never
+        raises — cost attribution is telemetry."""
+        try:
+            from opencompass_tpu.nn.config import TransformerConfig
+            cfg = getattr(model, 'cfg', None)
+            if not isinstance(cfg, TransformerConfig):
+                return None
+            return cls(cfg, quantize=getattr(model, 'quantize', None))
+        except Exception:
+            return None
+
+    # -- forward kinds -----------------------------------------------------
+
+    def score_cost(self, n_tokens: float, rows: int = 1) -> Cost:
+        """One scoring forward (ppl/choice/clp): causal-attention
+        FLOPs over ``n_tokens`` real tokens, one weight stream, K/V
+        written once and read once from HBM (flash-style on-chip reuse
+        across the query tokens; no persistent cache)."""
+        token_kv = causal_token_kv(n_tokens, rows)
+        kv = (kv_write_bytes(self.cfg, n_tokens)
+              + kv_read_bytes(self.cfg, n_tokens))
+        return Cost(
+            flops=flops_matmul(self.cfg, n_tokens)
+            + flops_attention(self.cfg, token_kv),
+            bytes_w=self.weight_bytes,
+            bytes_kv=kv, bytes_kv_ideal=kv)
+
+    def gen_cost(self, prefill_tokens: float, decode_tokens: float,
+                 rows: int = 1, cache_width: Optional[float] = None
+                 ) -> Cost:
+        """One dense (fixed-shape ``lax.while_loop``) generation call:
+        causal prefill + ``decode_tokens/rows`` decode steps, each
+        streaming the weights once.  Ideal HBM reads: the prefill's
+        K/V once, then per decode step each row's current KV length;
+        the dense path actually materializes the whole padded cache
+        buffer of ``cache_width`` positions per row per step
+        (regardless of mask; defaults to the ideal ragged width when
+        unknown)."""
+        rows = max(int(rows), 1)
+        steps = _ceil(decode_tokens / rows) if decode_tokens else 0
+        pre_attn = causal_token_kv(prefill_tokens, rows)
+        dec_attn = decode_token_kv(prefill_tokens, decode_tokens, rows)
+        # decode reads one position-set per step per row: attended
+        # pairs == positions at one token per step
+        ideal_reads = float(prefill_tokens) + dec_attn
+        writes = kv_write_bytes(self.cfg,
+                                prefill_tokens + decode_tokens)
+        ideal = writes + kv_read_bytes(self.cfg, ideal_reads)
+        if cache_width:
+            dense_reads = (float(prefill_tokens)
+                           + steps * rows * float(cache_width))
+            actual = writes + kv_read_bytes(self.cfg, dense_reads)
+        else:
+            actual = ideal
+        return Cost(
+            flops=flops_matmul(self.cfg, prefill_tokens + decode_tokens)
+            + flops_attention(self.cfg, pre_attn + dec_attn),
+            bytes_w=self.weight_bytes * (1 + steps),
+            bytes_kv=actual, bytes_kv_ideal=ideal)
+
+    def engine_cost(self, prefill_tokens: float, decode_tokens: float,
+                    prefill_steps: int, decode_steps: int, slots: int,
+                    table_positions: float,
+                    kv_positions: Optional[float] = None,
+                    attn_positions: Optional[float] = None) -> Cost:
+        """One continuous-engine drain: exact step counts from the
+        engine's counters.  Every step (prefill chunk or decode)
+        streams the weights once and gathers ``slots *
+        table_positions`` KV positions (``table_positions`` =
+        ``max_pages * page_size`` — the XLA gather materializes the
+        full table width for every slot, active or not: the
+        paged-gather traffic).  ``kv_positions`` is the exact ideal
+        HBM read count (the engine sums active rows' current KV
+        lengths per step); ``attn_positions`` the exact attended
+        (query, key) pairs for the attention FLOPs.  Both fall back to
+        equal-length approximations."""
+        steps = int(prefill_steps) + int(decode_steps)
+        if attn_positions is None:
+            attn_positions = (causal_token_kv(prefill_tokens, slots)
+                              + decode_token_kv(prefill_tokens,
+                                                decode_tokens, slots))
+        if kv_positions is None:
+            kv_positions = float(prefill_tokens) + decode_token_kv(
+                prefill_tokens, decode_tokens, slots)
+        gather = steps * int(slots) * float(table_positions)
+        writes = kv_write_bytes(self.cfg,
+                                prefill_tokens + decode_tokens)
+        return Cost(
+            flops=flops_matmul(self.cfg, prefill_tokens + decode_tokens)
+            + flops_attention(self.cfg, attn_positions),
+            bytes_w=self.weight_bytes * steps,
+            bytes_kv=writes + kv_read_bytes(self.cfg, gather),
+            bytes_kv_ideal=writes + kv_read_bytes(self.cfg,
+                                                  kv_positions))
+
+    # -- utilizations ------------------------------------------------------
+
+    def mfu(self, flops: float, seconds: float) -> Optional[float]:
+        return self.peaks.mfu(flops, seconds)
+
+    def mbu(self, nbytes: float, seconds: float) -> Optional[float]:
+        return self.peaks.mbu(nbytes, seconds)
+
+    def fields(self, cost: Cost, seconds: Optional[float]) -> Dict:
+        """The flight-recorder field block for one record: raw
+        FLOPs/bytes (ints — exact, platform-free) plus MFU/MBU against
+        this process's peaks when a device wall is known."""
+        out = {
+            'flops': int(cost.flops),
+            'bytes_w': int(cost.bytes_w),
+            'bytes_kv': int(cost.bytes_kv),
+            'bytes_kv_ideal': int(cost.bytes_kv_ideal),
+        }
+        if seconds and seconds > 0:
+            mfu = self.mfu(cost.flops, seconds)
+            mbu = self.mbu(cost.bytes_total, seconds)
+            if mfu is not None:
+                out['mfu'] = round(mfu, 6)
+            if mbu is not None:
+                out['mbu'] = round(mbu, 6)
+        return out
+
+
+def _ceil(x: float) -> int:
+    n = int(x)
+    return n if n == x else n + 1

@@ -117,6 +117,47 @@ class _TraceBuilder:
                                                         0.0), end)
         return end
 
+    def emit_engine(self, records: List[Dict], pid: int, tid: int,
+                    lo: float, hi: float, label: str):
+        """Continuous-engine drain records: one complete (``X``) slice
+        per drain plus counter (``C``) tracks — decode-slot occupancy
+        (the downsampled ``occupancy_series`` spread across the drain
+        interval) and the drain's MFU/MBU — so engine work is visible
+        in ui.perfetto.dev instead of being dropped."""
+        for rec in records:
+            if rec.get('t') != 'engine' or not isinstance(
+                    rec.get('ts'), (int, float)):
+                continue
+            start = min(max(rec['ts'], lo), hi)
+            dur = max(float(rec.get('dur_s') or 0.0), 1e-6)
+            dur = min(dur, max(hi - start, 1e-6))
+            args = {k: rec[k] for k in
+                    ('unit', 'seq', 'rows', 'slots', 'page_size',
+                     'steps', 'prefill_steps', 'decode_steps', 'joined',
+                     'retired', 'slot_util', 'device_seconds', 'flops',
+                     'bytes_w', 'bytes_kv', 'bytes_kv_ideal', 'mfu',
+                     'mbu') if k in rec}
+            name = (f"engine drain {rec.get('retired', '?')} rows / "
+                    f"{rec.get('steps', '?')} steps")
+            self._push(pid, tid, {'name': name, 'ph': 'X',
+                                  'cat': 'engine', 'ts': self.us(start),
+                                  'dur': max(1, int(round(dur * 1e6))),
+                                  'pid': pid, 'tid': tid, 'args': args})
+            series = [v for v in (rec.get('occupancy_series') or [])
+                      if isinstance(v, (int, float))]
+            step = dur / len(series) if series else 0.0
+            for i, occ in enumerate(series):
+                self._push(pid, tid, {
+                    'name': f'slots {label}', 'ph': 'C', 'cat': 'engine',
+                    'ts': self.us(start + i * step), 'pid': pid,
+                    'args': {'occupied': round(float(occ), 2)}})
+            for key in ('mfu', 'mbu'):
+                if isinstance(rec.get(key), (int, float)):
+                    self._push(pid, tid, {
+                        'name': f'{key} {label}', 'ph': 'C',
+                        'cat': 'engine', 'ts': self.us(start),
+                        'pid': pid, 'args': {key: rec[key]}})
+
     def emit_batches(self, records: List[Dict], pid: int, tid: int,
                      lo: float, hi: float, counter_name: str):
         for rec in records:
@@ -134,7 +175,8 @@ class _TraceBuilder:
                     ('unit', 'seq', 'rows', 'real_tokens', 'pad_tokens',
                      'dispatch_s', 'device_s', 'compile_s', 'tokens_in',
                      'tokens_out', 'first_calls', 'cc_hits', 'cc_misses',
-                     'calls') if k in rec}
+                     'calls', 'flops', 'bytes_w', 'bytes_kv', 'mfu',
+                     'mbu') if k in rec}
             self._push(pid, tid, {'name': name, 'ph': 'X',
                                   'cat': 'batch', 'ts': self.us(start),
                                   'dur': max(1, int(round(dur * 1e6))),
@@ -229,8 +271,10 @@ def build_chrome_trace(work_dir: str, trace: Optional[str] = None) -> Dict:
         builder.emit_span(task, 1, tid, lo, hi, t1)
         task_name = task.name[len('task:'):]
         if task_name in timelines:
-            builder.emit_batches(timelines.pop(task_name), 1, tid,
-                                 lo, hi, f'tok/s {task_name}')
+            records = timelines.pop(task_name)
+            builder.emit_batches(records, 1, tid, lo, hi,
+                                 f'tok/s {task_name}')
+            builder.emit_engine(records, 1, tid, lo, hi, task_name[:32])
 
     def emit_driver(n: _SpanNode):
         if n.span_id in in_task:
@@ -256,6 +300,8 @@ def build_chrome_trace(work_dir: str, trace: Optional[str] = None) -> Dict:
         builder.meta(1, tid, task_name[:48])
         builder.emit_batches(records, 1, tid, t0, max(t1, t0) + 1e9,
                              f'tok/s {task_name}')
+        builder.emit_engine(records, 1, tid, t0, max(t1, t0) + 1e9,
+                            task_name[:32])
 
     other = {'trace': trace, 'events_path': path,
              'wall_seconds': round(t1 - t0, 3)}

@@ -475,6 +475,12 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
             last_batch_seconds=rec.get('last_batch_seconds'),
             pad_eff=rec.get('pad_eff'),
             decode_slot_util=rec.get('decode_slot_util'),
+            # roofline + KV-pool gauges (engine/batch-recorder notes)
+            mfu=rec.get('mfu'),
+            mbu=rec.get('mbu'),
+            kv_pool_used_frac=rec.get('kv_pool_used_frac'),
+            kv_pool_high_water_frac=rec.get('kv_pool_high_water_frac'),
+            kv_pool_failed_allocs=rec.get('kv_pool_failed_allocs'),
             store_hits=rec.get('store_hits'),
             store_misses=rec.get('store_misses'),
             store_hit_rate=round(st_hits / (st_hits + st_misses), 4)
@@ -542,6 +548,9 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
     st_hits = st_misses = 0
     pad_effs = []
     slot_utils = []
+    mfus, mbus = [], []
+    pool_used, pool_high = [], []
+    pool_failed = 0
     for row in tasks.values():
         state = row.get('state', 'running')
         if row.get('progress') is None and state == 'ok':
@@ -559,6 +568,19 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
             pad_effs.append(row['pad_eff'])
         if row.get('decode_slot_util') is not None:
             slot_utils.append(row['decode_slot_util'])
+        if row.get('mfu') is not None:
+            mfus.append(row['mfu'])
+        if row.get('mbu') is not None:
+            mbus.append(row['mbu'])
+        if row.get('kv_pool_used_frac') is not None:
+            pool_used.append(row['kv_pool_used_frac'])
+        if row.get('kv_pool_high_water_frac') is not None:
+            pool_high.append(row['kv_pool_high_water_frac'])
+        # engine-LIFETIME counter: several tasks sharing one resident
+        # engine all report the same total, so fold with max (summing
+        # would multiply one engine's stalls by its task count)
+        pool_failed = max(pool_failed,
+                          row.get('kv_pool_failed_allocs') or 0)
     return {
         'n_tasks': n,
         'progress': round(frac_sum / n, 4) if n else None,
@@ -571,6 +593,20 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
         # fraction of decode-step slots holding live sequences
         'decode_slot_util': round(sum(slot_utils) / len(slot_utils), 4)
         if slot_utils else None,
+        # roofline utilizations (obs/costmodel.py): mean over tasks
+        # reporting them — how close to the hardware ceiling the run
+        # is executing right now
+        'mfu': round(sum(mfus) / len(mfus), 6) if mfus else None,
+        'mbu': round(sum(mbus) / len(mbus), 6) if mbus else None,
+        # paged-KV pool pressure: worst-task occupancy/high-water and
+        # worst-task bounced-admission total (page exhaustion
+        # back-pressure; per-engine lifetime counters)
+        'kv_pool_used_frac': round(max(pool_used), 4)
+        if pool_used else None,
+        'kv_pool_high_water_frac': round(max(pool_high), 4)
+        if pool_high else None,
+        'kv_pool_failed_allocs': pool_failed
+        if pool_used or pool_high or pool_failed else None,
         **by_state,
     }
 
@@ -790,6 +826,11 @@ def render_status(snap: Dict) -> str:
         head.append(f"store hit {o['store_hit_rate']:.0%}")
     if o.get('pad_eff') is not None:
         head.append(f"pad_eff {o['pad_eff']:.2f}")
+    if o.get('mbu') is not None:
+        from opencompass_tpu.obs.report import _fmt_util
+        head.append(f"MBU {_fmt_util(o['mbu'])}")
+    if o.get('kv_pool_used_frac') is not None:
+        head.append(f"kv_pool {o['kv_pool_used_frac']:.0%}")
     if snap.get('elapsed_seconds') is not None:
         head.append(f"elapsed {_fmt(snap['elapsed_seconds'], 's')}")
     slots = snap.get('slots')

@@ -167,10 +167,17 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
     # live-plane surfacing of the planner/store efficiency signals
     # (they existed only in perf records + trace report before)
     for key in ('cached_progress', 'store_hit_rate', 'pad_eff',
-                'decode_slot_util'):
+                'decode_slot_util', 'mfu', 'mbu'):
         if o.get(key) is not None:
             out.append(f'# TYPE {prefix}_run_{key} gauge')
             out.append(_line(f'{prefix}_run_{key}', o[key]))
+    # paged-KV pool pressure gauges (oct_kv_pool_*): occupancy,
+    # high-water, and bounced admissions — the pool-sizing signals
+    for key in ('kv_pool_used_frac', 'kv_pool_high_water_frac',
+                'kv_pool_failed_allocs'):
+        if o.get(key) is not None:
+            out.append(f'# TYPE {prefix}_{key} gauge')
+            out.append(_line(f'{prefix}_{key}', o[key]))
     for state in ('ok', 'failed', 'running', 'pending'):
         if state in o:
             out.append(f'# TYPE {prefix}_tasks_{state} gauge')
@@ -219,6 +226,9 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
         ('task_last_batch_seconds', 'last_batch_seconds'),
         ('task_pad_eff', 'pad_eff'),
         ('task_decode_slot_util', 'decode_slot_util'),
+        ('task_mfu', 'mfu'),
+        ('task_mbu', 'mbu'),
+        ('task_kv_pool_used_frac', 'kv_pool_used_frac'),
         ('task_store_hit_rate', 'store_hit_rate'),
         ('task_heartbeat_age_seconds', 'heartbeat_age_seconds'),
     ]

@@ -380,6 +380,32 @@ def _sparkline(values: List[float]) -> str:
                    for v in values)
 
 
+def _mean(rows: List[Dict], key: str):
+    vals = [r[key] for r in rows if isinstance(r.get(key), (int, float))]
+    return sum(vals) / len(vals) if vals else None
+
+
+def _fmt_util(value) -> str:
+    """An MFU/MBU fraction for tables: percent with enough precision
+    that CPU-scale utilizations (1e-5) stay visible."""
+    if value is None:
+        return '-'
+    if value >= 0.001:
+        return f'{value:.1%}'
+    return f'{value:.2e}'
+
+
+def _fmt_qty(value) -> str:
+    """1234567890 -> '1.2G' (FLOPs/bytes magnitudes)."""
+    if not isinstance(value, (int, float)) or value <= 0:
+        return '-'
+    for unit in ('', 'K', 'M', 'G', 'T', 'P'):
+        if abs(value) < 1000:
+            return f'{value:.1f}{unit}'
+        value /= 1000.0
+    return f'{value:.1f}E'
+
+
 def _histogram_quantile(snap: Dict, q: float):
     """Approximate quantile from a cumulative-bucket snapshot: the upper
     bound of the bucket holding the q-th observation, or ``'>{top}'``
@@ -432,6 +458,18 @@ def render_summary(report: Dict) -> str:
             f'flight recorder: '
             f'{sum(s.get("batches", 0) for s in tl.values())} batch(es) '
             f'across {len(tl)} task timeline(s)')
+        costed = [s for s in tl.values() if s.get('mbu') is not None]
+        if costed:
+            flops = sum(s.get('flops') or 0 for s in tl.values())
+            kv = sum(s.get('bytes_kv') or 0 for s in tl.values())
+            kv_ideal = sum(s.get('bytes_kv_ideal') or 0
+                           for s in tl.values())
+            bits = [f'roofline: {_fmt_util(_mean(costed, "mfu"))} MFU, '
+                    f'{_fmt_util(_mean(costed, "mbu"))} MBU '
+                    f'({_fmt_qty(flops)}FLOPs)']
+            if kv_ideal:
+                bits.append(f'KV traffic {kv / kv_ideal:.2f}x ideal')
+            lines.append(', '.join(bits))
     util = report['slot_utilization']
     if util['overall'] is not None:
         lines.append(f"slot utilization {util['overall']:.0%} over "
@@ -530,6 +568,38 @@ def render_report(report: Dict) -> str:
                 if s.get('slot_util') is not None else '-',
                 predec, df, s.get('cached_rows', 0), spark])
         out.append(_table(rows))
+
+    costed = {name: s for name, s in tl.items()
+              if s.get('mfu') is not None or s.get('mbu') is not None}
+    if costed:
+        out.append('\n-- roofline (MFU/MBU attribution) --')
+        rows = [['task', 'kind', 'mfu', 'mbu', 'flops', 'bytes_w',
+                 'bytes_kv', 'kv_ratio', 'pre/dec_tok']]
+        for name in sorted(costed):
+            s = costed[name]
+            predec = '-'
+            if s.get('prefill_tokens') or s.get('decode_tokens') \
+                    or s.get('tokens_in') or s.get('tokens_out'):
+                predec = (f"{s.get('prefill_tokens') or s.get('tokens_in') or 0}/"
+                          f"{s.get('decode_tokens') or s.get('tokens_out') or 0}")
+            rows.append([
+                name[:52], ','.join(s.get('kinds') or []) or 'gen',
+                _fmt_util(s.get('mfu')), _fmt_util(s.get('mbu')),
+                _fmt_qty(s.get('flops')), _fmt_qty(s.get('bytes_w')),
+                _fmt_qty(s.get('bytes_kv')),
+                f"{s['kv_ratio']:.2f}x"
+                if s.get('kv_ratio') is not None else '-',
+                predec])
+        out.append(_table(rows))
+        kv = sum(s.get('bytes_kv') or 0 for s in costed.values())
+        kv_ideal = sum(s.get('bytes_kv_ideal') or 0
+                       for s in costed.values())
+        if kv_ideal and kv > kv_ideal:
+            out.append(
+                f'KV read traffic runs {kv / kv_ideal:.2f}x the exact-'
+                'ragged-lengths ideal — the paged-gather/dense-buffer '
+                'waste a ragged paged-attention kernel would remove '
+                '(docs/observability.md "Roofline").')
 
     out.append('\n-- slot utilization --')
     util = report['slot_utilization']

@@ -284,14 +284,16 @@ class RollingStats:
                           ttft_s: Optional[float] = None,
                           ok: bool = True, store_hits: int = 0,
                           device_rows: int = 0,
-                          ts: Optional[float] = None):
+                          ts: Optional[float] = None,
+                          mbu: Optional[float] = None):
         try:
             with self._lock:
                 self._completions.append(
                     (ts if ts is not None else time.time(), str(model),
                      float(latency_s),
                      float(ttft_s) if ttft_s is not None else None,
-                     bool(ok), int(store_hits), int(device_rows)))
+                     bool(ok), int(store_hits), int(device_rows),
+                     float(mbu) if mbu is not None else None))
         except Exception:
             pass
 
@@ -342,6 +344,13 @@ class RollingStats:
                     percentile(ttfts, 0.50) * 1e3, 3)
                 row['ttft_p95_ms'] = round(
                     percentile(ttfts, 0.95) * 1e3, 3)
+            # roofline: mean forward-phase memory-bandwidth
+            # utilization of the window's device-served completions
+            # (pre-mbu samples carry no 8th field)
+            mbus = [s[7] for s in samples
+                    if len(s) > 7 and s[7] is not None]
+            if mbus:
+                row['mbu_mean'] = round(sum(mbus) / len(mbus), 6)
             models[model] = row
 
         comp_lat = [s[2] for s in comps]

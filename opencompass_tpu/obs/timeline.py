@@ -285,6 +285,30 @@ def summarize_records(records: List[Dict]) -> Dict:
         slot_util = round(
             sum((r.get('slot_util') or 0.0) * (r.get('decode_steps') or 0)
                 for r in engines) / eng_steps, 4)
+    # roofline fold (obs/costmodel.py fields on batch AND engine
+    # records): raw FLOPs/bytes sum exactly; MFU/MBU are weighted by
+    # each record's device wall so a long batch dominates a short one;
+    # kv_ratio = actual/ideal KV read traffic (the paged-gather waste
+    # number — 1.0 for scoring, > 1 for the gather path)
+    costed = ([(r, r.get('device_s')) for r in batches]
+              + [(r, r.get('device_seconds')) for r in engines])
+    flops = sum(r.get('flops') or 0 for r, _ in costed)
+    bytes_w = sum(r.get('bytes_w') or 0 for r, _ in costed)
+    bytes_kv = sum(r.get('bytes_kv') or 0 for r, _ in costed)
+    bytes_kv_ideal = sum(r.get('bytes_kv_ideal') or 0 for r, _ in costed)
+    mfu_w = [(r['mfu'], d) for r, d in costed
+             if r.get('mfu') is not None and d]
+    mbu_w = [(r['mbu'], d) for r, d in costed
+             if r.get('mbu') is not None and d]
+    mfu = mbu = None
+    if mfu_w:
+        total = sum(d for _, d in mfu_w)
+        mfu = round(sum(v * d for v, d in mfu_w) / total, 6) \
+            if total else None
+    if mbu_w:
+        total = sum(d for _, d in mbu_w)
+        mbu = round(sum(v * d for v, d in mbu_w) / total, 6) \
+            if total else None
     return {
         'batches': len(batches),
         'plans': len(plans),
@@ -311,14 +335,29 @@ def summarize_records(records: List[Dict]) -> Dict:
         'dispatch_seconds': round(tot('dispatch_s', calls), 3),
         'fetch_seconds': round(
             sum(c.get('fetch_s') or 0 for c in calls), 3),
-        'prefill_tokens': sum(c.get('prefill_tokens') or 0 for c in calls),
-        'decode_tokens': sum(c.get('decode_tokens') or 0 for c in calls),
+        # per-call splits (dense path) plus the engine drains' exact
+        # counters, so engine-only tasks still report the split
+        'prefill_tokens': sum(c.get('prefill_tokens') or 0
+                              for c in calls)
+        + sum(r.get('prefill_tokens') or 0 for r in engines),
+        'decode_tokens': sum(c.get('decode_tokens') or 0 for c in calls)
+        + sum(r.get('decode_tokens') or 0 for r in engines),
         'tps_series': [round(v, 1) for v in _downsample(series)],
         'engine_drains': len(engines),
         'engine_steps': eng_steps or None,
         'engine_rows': sum(r.get('retired') or 0
                            for r in engines) or None,
         'slot_util': slot_util,
+        # roofline totals + device-wall-weighted utilizations; None
+        # when no record carried cost fields (FakeModel/API timelines)
+        'flops': int(flops) or None,
+        'bytes_w': int(bytes_w) or None,
+        'bytes_kv': int(bytes_kv) or None,
+        'bytes_kv_ideal': int(bytes_kv_ideal) or None,
+        'kv_ratio': round(bytes_kv / bytes_kv_ideal, 3)
+        if bytes_kv_ideal else None,
+        'mfu': mfu,
+        'mbu': mbu,
     }
 
 

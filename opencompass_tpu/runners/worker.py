@@ -681,13 +681,40 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
         share = prefill / max(prefill + decode, 1)
         resp['ttft_s'] = round(
             (first.get('dispatch_s') or 0.0) + first_fetch * share, 6)
+        # dense-path roofline: analytic cost of this forward against
+        # the blocked-on-device share of the forward wall (fetch_s —
+        # the dispatch half is host tracing/enqueue), so the request
+        # record carries an MFU/MBU comparable to the timeline batch
+        # records (same padded cache_width = S bucket + decode room)
+        try:
+            from opencompass_tpu.obs.costmodel import CostModel
+            cm = CostModel.for_model(model)
+            if cm is not None and (prefill or decode):
+                shape = first.get('shape') or []
+                width = int(shape[1]) + max_out_len \
+                    if len(shape) == 2 else None
+                cost = cm.gen_cost(prefill, decode, rows=len(todo),
+                                   cache_width=width)
+                secs = fetch_s or phases.get('model_forward_s')
+                mfu, mbu = cm.mfu(cost.flops, secs), \
+                    cm.mbu(cost.bytes_total, secs)
+                if mfu is not None:
+                    resp['mfu'] = round(mfu, 6)
+                if mbu is not None:
+                    resp['mbu'] = round(mbu, 6)
+        except Exception:
+            pass
     elif joined_engine and engine_stats:
         # engine-served rows: token splits + a MEASURED ttft (submit →
-        # first sampled token), not the fused-executable estimate
+        # first sampled token), not the fused-executable estimate —
+        # and the drain's MFU/MBU from the engine's exact step counters
         resp['prefill_tokens'] = engine_stats.get('prefill_tokens')
         resp['decode_tokens'] = engine_stats.get('decode_tokens')
         if engine_stats.get('ttft_s') is not None:
             resp['ttft_s'] = engine_stats['ttft_s']
+        for key in ('mfu', 'mbu'):
+            if engine_stats.get(key) is not None:
+                resp[key] = engine_stats[key]
     return resp
 
 

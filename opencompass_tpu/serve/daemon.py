@@ -457,6 +457,17 @@ class EvalEngine:
                         phase_durs.append((name, wp[key]))
             phases = reqtrace.phases_to_spans(
                 [(n, d) for n, d in phase_durs if d])
+            # per-request roofline: the worker's forward-phase MFU/MBU
+            # (obs/costmodel.py via _handle_complete) rides the
+            # model_forward child span, so a slow request's record
+            # shows whether the forward itself ran far from the
+            # hardware ceiling or the time went elsewhere
+            for span in phases:
+                if span.get('name') == 'model_forward':
+                    for key in ('mfu', 'mbu'):
+                        val = (resp or {}).get(key)
+                        if val is not None:
+                            span[key] = val
             ok = error is None
             rec = {
                 'id': response_id, 'request_id': request_id,
@@ -495,7 +506,7 @@ class EvalEngine:
                 label_model, wall_s, ttft_s=ttft, ok=ok,
                 store_hits=(resp or {}).get('store_hits') or 0,
                 device_rows=(resp or {}).get('device_rows') or 0,
-                ts=ts)
+                ts=ts, mbu=(resp or {}).get('mbu'))
             reqtrace.annotate(model=label_model,
                               completion_id=response_id)
             if self.tracer is not None and self.tracer.enabled:
@@ -653,7 +664,30 @@ class EvalEngine:
         summary['completions_total'] = self._completions
         summary['run_dir'] = self.run_dir
         summary['ready'] = self._warmed.is_set()
+        efficiency = self._efficiency_snapshot()
+        if efficiency:
+            summary['efficiency'] = efficiency
         return summary
+
+    def _efficiency_snapshot(self) -> Optional[Dict]:
+        """Roofline/pool gauges for ``/v1/stats`` and ``cli top``:
+        the run status overlay's decode-slot-util, MFU/MBU, and
+        KV-pool occupancy (heartbeat notes folded by the status
+        aggregator — obs/live.py).  None when no task reported any."""
+        try:
+            # current_status, not load_status: before the first sweep's
+            # aggregator persists status.json this falls back to the
+            # heartbeat fold, keeping /v1/stats consistent with /status
+            from opencompass_tpu.obs.live import current_status
+            snap = current_status(osp.join(self.run_dir, 'obs')) or {}
+            o = snap.get('overall') or {}
+            out = {k: o.get(k) for k in
+                   ('decode_slot_util', 'mfu', 'mbu',
+                    'kv_pool_used_frac', 'kv_pool_high_water_frac',
+                    'kv_pool_failed_allocs') if o.get(k) is not None}
+            return out or None
+        except Exception:
+            return None
 
     # -- status / readiness ------------------------------------------------
 

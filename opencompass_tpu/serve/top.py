@@ -136,7 +136,8 @@ def _fmt_age(seconds) -> str:
 
 
 def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
-    from opencompass_tpu.obs.report import _sparkline, _table
+    from opencompass_tpu.obs.report import (_fmt_util, _sparkline,
+                                            _table)
     lines: List[str] = []
     info = snap.get('engine') or {}
     if snap.get('alive'):
@@ -190,20 +191,55 @@ def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
     elif not comp.get('count'):
         lines.append('completions: none in window')
 
+    # engine efficiency (the roofline plane: /v1/stats `efficiency`
+    # from the run status fold — decode-slot occupancy, MFU/MBU,
+    # KV-pool pressure)
+    eff = stats.get('efficiency') or {}
+    if eff:
+        bits = []
+        if eff.get('decode_slot_util') is not None:
+            bits.append(f"slot_util {eff['decode_slot_util']:.0%}")
+        for key in ('mfu', 'mbu'):
+            if eff.get(key) is not None:
+                bits.append(f'{key} {_fmt_util(eff[key])}')
+        if eff.get('kv_pool_used_frac') is not None:
+            pool = f"kv_pool {eff['kv_pool_used_frac']:.0%}"
+            if eff.get('kv_pool_high_water_frac') is not None:
+                pool += f" (hw {eff['kv_pool_high_water_frac']:.0%})"
+            bits.append(pool)
+        if eff.get('kv_pool_failed_allocs'):
+            bits.append(
+                f"pool stalls {eff['kv_pool_failed_allocs']}")
+        if bits:
+            lines.append('efficiency: ' + '  '.join(bits))
+
     workers = (serve.get('workers') if serve else None) \
         or (stats.get('workers') or {})
     if workers:
+        per_model = (comp.get('per_model') or {})
         rows = [['worker', 'model', 'pid', 'resident', 'idle', 'util',
-                 'reqs', 'in-flight']]
+                 'slot_util', 'mbu', 'reqs', 'in-flight']]
         for key in sorted(workers):
             w = workers[key]
             util = w.get('utilization')
+            model = w.get('model')
+            # per-model MBU from the rolling completion window when
+            # the model served requests recently; the run-level gauge
+            # otherwise (a busy worker IS the engine's denominator)
+            mbu = (per_model.get(model) or {}).get('mbu_mean') \
+                if model else None
+            if mbu is None and w.get('in_use'):
+                mbu = eff.get('mbu')
+            slot_util = eff.get('decode_slot_util') \
+                if w.get('in_use') else None
             rows.append([
-                key[:12], str(w.get('model') or '-'),
+                key[:12], str(model or '-'),
                 str(w.get('pid', '-')),
                 _fmt_age(w.get('age_seconds')),
                 _fmt_age(w.get('idle_seconds')),
                 f'{util:.0%}' if util is not None else '-',
+                f'{slot_util:.0%}' if slot_util is not None else '-',
+                _fmt_util(mbu) if mbu is not None else '-',
                 str(w.get('requests', '-')),
                 ','.join(w.get('in_flight') or []) or '-',
             ])

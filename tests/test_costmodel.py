@@ -1,0 +1,587 @@
+"""Roofline cost model: analytic oracles, timeline/engine threading,
+KV-pool pressure counters, and the ledger's --min-mfu-ratio gate.
+
+Unit level: hand-computed FLOPs/bytes for the tiny geometry (prefill
+chunk, single decode step, dense vs paged-gather vs ideal, int8-KV and
+quantized weight widths), peak-table resolution + env override,
+summarize-fold math, allocator high-water/failed-alloc counters, and
+the ledger efficiency gate's exit-code matrix.
+
+Wired level (tiny JaxLM, CPU): dense gen batches through run_plan and
+engine drains both leave flops/bytes/mfu/mbu on their flight-recorder
+records with bytes_kv >= bytes_kv_ideal on the gather path; a starved
+page pool emits a structured kv_pool_pressure event; the status fold,
+Prometheus gauges, trace-report roofline section, and Perfetto engine
+counter tracks all surface the new fields; the Noop/torn paths stay
+inert.
+"""
+import json
+import os
+import os.path as osp
+import subprocess
+import sys
+
+import pytest
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs():
+    from opencompass_tpu import obs
+    obs.reset_obs()
+    yield
+    obs.reset_obs()
+
+
+def _tiny_cfg(**kw):
+    from opencompass_tpu.nn.config import TransformerConfig
+    return TransformerConfig.tiny(**kw)
+
+
+# -- geometry oracles (hand-computed for the tiny config) -------------------
+# tiny: vocab 512, hidden 64, layers 2, heads 4 (head_dim 16), kv_heads
+# 2 (kv_dim 32), intermediate 128, gated MLP, dtype float32.
+#   per-layer matmuls: qkv 64*(64+2*32)=8192, o 64*64=4096,
+#                      mlp 3*64*128=24576  -> 36864
+#   total: 2*36864 + lm_head 64*512=32768  -> 106496
+
+def test_matmul_params_oracle():
+    from opencompass_tpu.obs import costmodel as cm
+    cfg = _tiny_cfg()
+    assert cm.matmul_params(cfg) == 106496
+    # f32 weights: 4 bytes each
+    assert cm.weight_bytes(cfg) == 106496 * 4
+    # K+V vectors: 2 * kv_dim(32) * 4B = 256 per token per layer
+    assert cm.kv_token_bytes(cfg) == 256.0
+
+
+def test_quantized_widths_oracle():
+    from opencompass_tpu.obs import costmodel as cm
+    cfg = _tiny_cfg()
+    assert cm.weight_width_bytes(cfg, 'int8') == 1.0
+    assert cm.weight_width_bytes(cfg, 'w8a8-kv8') == 1.0
+    assert cm.weight_width_bytes(cfg, 'w4a8') == 0.5
+    assert cm.weight_width_bytes(cfg) == 4.0  # f32 tiny
+    # int8 KV: 2*32 elements at 1B + per-vector scales (one f32 per
+    # K/V head pair: 2 heads * 2 tensors * 4B = 16) = 80 B/token/layer
+    cfg8 = _tiny_cfg(kv_quant='int8')
+    assert cm.kv_token_bytes(cfg8) == 2 * 32 * 1 + 2 * 2 * 4
+    # int4 halves the elements, keeps the scales
+    cfg4 = _tiny_cfg(kv_quant='int4')
+    assert cm.kv_token_bytes(cfg4) == 2 * 32 * 0.5 + 2 * 2 * 4
+
+
+def test_score_cost_oracle():
+    from opencompass_tpu.obs import costmodel as cm
+    model = cm.CostModel(_tiny_cfg(), peaks=cm.PeakRates(1e12, 1e11,
+                                                        'test'))
+    cost = model.score_cost(100, rows=2)
+    # matmul: 2 * 106496 * 100; attention pairs: 2 rows of 50 tokens
+    # causal = 2 * 50*51/2 = 2550 pairs, 4 * L(2) * q_dim(64) each
+    assert cost.flops == 2 * 106496 * 100 + 4 * 2 * 64 * 2550
+    assert cost.bytes_w == 106496 * 4
+    # K/V written once and read once from HBM: 2 * L * 256 * 100
+    assert cost.bytes_kv == 2 * (2 * 256 * 100)
+    assert cost.bytes_kv == cost.bytes_kv_ideal  # scoring has no waste
+    fields = model.fields(cost, seconds=0.5)
+    assert fields['mfu'] == pytest.approx(
+        cost.flops / (0.5 * 1e12), abs=1e-6)
+    assert fields['mbu'] == pytest.approx(
+        (cost.bytes_w + cost.bytes_kv) / (0.5 * 1e11), abs=1e-6)
+
+
+def test_gen_cost_dense_buffer_vs_ideal():
+    from opencompass_tpu.obs import costmodel as cm
+    model = cm.CostModel(_tiny_cfg())
+    # 4 rows, 25-token prompts, 10 decode steps each, padded cache 160
+    cost = model.gen_cost(100, 40, rows=4, cache_width=160)
+    # weights stream once for prefill + once per decode step
+    assert cost.bytes_w == 106496 * 4 * (1 + 10)
+    # ideal reads: prefill once (100) + per decode step each row's
+    # ragged length: 4 rows * sum_{t=1..10}(25+t) = 4*305 = 1220
+    writes = 2 * 256 * 140
+    assert cost.bytes_kv_ideal == writes + 2 * 256 * (100 + 1220)
+    # dense buffer reads: 100 + 10 steps * 4 rows * 160 positions
+    assert cost.bytes_kv == writes + 2 * 256 * (100 + 6400)
+    assert cost.kv_ratio > 1.0
+    # without a cache width the dense estimate collapses to ideal
+    assert model.gen_cost(100, 40, rows=4).kv_ratio == 1.0
+
+
+def test_engine_cost_gather_vs_ideal():
+    from opencompass_tpu.obs import costmodel as cm
+    model = cm.CostModel(_tiny_cfg())
+    cost = model.engine_cost(
+        prefill_tokens=64, decode_tokens=40, prefill_steps=2,
+        decode_steps=10, slots=4, table_positions=256,
+        kv_positions=500, attn_positions=1500)
+    assert cost.flops == 2 * 106496 * 104 + 4 * 2 * 64 * 1500
+    assert cost.bytes_w == 106496 * 4 * 12       # one stream per step
+    writes = 2 * 256 * 104
+    # gather: every step reads every slot's full table width
+    assert cost.bytes_kv == writes + 2 * 256 * (12 * 4 * 256)
+    assert cost.bytes_kv_ideal == writes + 2 * 256 * 500
+    assert cost.kv_ratio > 1.0
+
+
+def test_peak_rates_resolution(monkeypatch):
+    from opencompass_tpu.obs import costmodel as cm
+    monkeypatch.delenv(cm.ENV_PEAK_FLOPS, raising=False)
+    monkeypatch.delenv(cm.ENV_PEAK_BYTES, raising=False)
+    assert cm.peak_rates('tpu', 'TPU v4').flops_per_s == 275e12
+    # longest-prefix: v5 lite must not resolve as v5
+    assert cm.peak_rates('tpu', 'TPU v5 lite').source == 'TPU v5 lite'
+    assert cm.peak_rates('gpu', 'NVIDIA H100 80GB').source == 'H100'
+    assert cm.peak_rates('cpu', None).source == 'cpu'
+    # the CI-determinism override beats detection
+    monkeypatch.setenv(cm.ENV_PEAK_FLOPS, '1e12')
+    monkeypatch.setenv(cm.ENV_PEAK_BYTES, '1e11')
+    peaks = cm.peak_rates('tpu', 'TPU v4')
+    assert peaks.source == 'env' and peaks.bytes_per_s == 1e11
+
+
+def test_cost_model_for_model_none_without_geometry():
+    from opencompass_tpu.models import FakeModel
+    from opencompass_tpu.obs.costmodel import CostModel
+    assert CostModel.for_model(FakeModel(path='fake')) is None
+    assert CostModel.for_model(object()) is None
+
+
+# -- allocator pressure counters --------------------------------------------
+
+def test_page_allocator_high_water_and_failed_allocs():
+    from opencompass_tpu.nn.paged_kv import OutOfPages, PageAllocator
+    alloc = PageAllocator(8)           # 7 usable (page 0 reserved)
+    a = alloc.alloc(3)
+    b = alloc.alloc(2)
+    assert alloc.high_water == 5
+    alloc.free(b)
+    assert alloc.high_water == 5       # high-water survives frees
+    with pytest.raises(OutOfPages):
+        alloc.alloc(5)                 # only 4 free
+    assert alloc.failed_allocs == 1
+    stats = alloc.stats()
+    assert stats['used'] == 3 and stats['high_water'] == 5
+    assert stats['used_frac'] == pytest.approx(3 / 7, abs=1e-4)
+    assert stats['high_water_frac'] == pytest.approx(5 / 7, abs=1e-4)
+    assert stats['failed_allocs'] == 1
+    alloc.free(a)
+    assert alloc.n_free == 7
+
+
+# -- summarize fold ----------------------------------------------------------
+
+def test_summarize_folds_cost_fields():
+    from opencompass_tpu.obs.timeline import summarize_records
+    records = [
+        {'t': 'batch', 'ts': 0.0, 'kind': 'gen', 'batch_s': 1.0,
+         'device_s': 1.0, 'rows': 2, 'flops': 100, 'bytes_w': 10,
+         'bytes_kv': 40, 'bytes_kv_ideal': 20, 'mfu': 0.4,
+         'mbu': 0.2},
+        {'t': 'engine', 'ts': 1.0, 'kind': 'gen', 'decode_steps': 4,
+         'slot_util': 1.0, 'device_seconds': 3.0, 'retired': 2,
+         'flops': 300, 'bytes_w': 30, 'bytes_kv': 60,
+         'bytes_kv_ideal': 30, 'mfu': 0.8, 'mbu': 0.6},
+    ]
+    s = summarize_records(records)
+    assert s['flops'] == 400 and s['bytes_w'] == 40
+    assert s['bytes_kv'] == 100 and s['bytes_kv_ideal'] == 50
+    assert s['kv_ratio'] == pytest.approx(2.0)
+    # weighted by device wall: (0.4*1 + 0.8*3) / 4
+    assert s['mfu'] == pytest.approx(0.7)
+    assert s['mbu'] == pytest.approx(0.5)
+    # records without cost fields leave the summary keys None
+    bare = summarize_records([{'t': 'batch', 'ts': 0.0, 'kind': 'ppl',
+                               'batch_s': 0.1}])
+    assert bare['mfu'] is None and bare['kv_ratio'] is None
+
+
+# -- wired: dense batches + engine drains carry cost fields ------------------
+
+def _tiny_lm(**kw):
+    from opencompass_tpu.models.jax_lm import JaxLM
+    return JaxLM(config='tiny', max_seq_len=128, **kw)
+
+
+def test_dense_gen_batches_record_cost_fields(tmp_path):
+    from opencompass_tpu import obs
+    from opencompass_tpu.icl.inferencers.gen import GenInferencer
+    from opencompass_tpu.obs import timeline as tmod
+    obs.init_obs(str(tmp_path))
+    obs.init_task_timeline('dense-cost')
+    lm = _tiny_lm()
+    inf = GenInferencer(model=lm, max_out_len=8, batch_size=4,
+                        batch_plan=True)
+    prompts = ['alpha beta', 'gamma delta epsilon', 'zeta', 'eta theta']
+    lengths = [lm.get_token_len(p) for p in prompts]
+    plan = inf.make_plan(lengths, seq_cap=120)
+    inf.run_plan(
+        plan,
+        lambda b: lm.generate_async([prompts[i] for i in b.indices], 8),
+        lambda b, r: None, kind='gen')
+    (records,) = tmod.read_timelines(
+        osp.join(str(tmp_path), 'obs')).values()
+    batches = [r for r in records if r['t'] == 'batch']
+    assert batches
+    for b in batches:
+        assert b['flops'] > 0 and b['bytes_w'] > 0
+        # dense decode reads the padded buffer: actual >= ideal
+        assert b['bytes_kv'] >= b['bytes_kv_ideal'] > 0
+        assert 0 < b['mfu'] < 1 and 0 < b['mbu'] < 1
+    summary = tmod.summarize_records(records)
+    assert summary['mfu'] and summary['mbu']
+    assert summary['kv_ratio'] >= 1.0
+
+
+def test_scoring_batches_record_cost_fields(tmp_path):
+    from opencompass_tpu import obs
+    from opencompass_tpu.icl.inferencers.base import BaseInferencer
+    from opencompass_tpu.obs import timeline as tmod
+    obs.init_obs(str(tmp_path))
+    obs.init_task_timeline('score-cost')
+    lm = _tiny_lm()
+    inf = BaseInferencer(model=lm, batch_size=4, batch_plan=True)
+    prompts = ['one two three', 'four five', 'six']
+    plan = inf.make_plan([lm.get_token_len(p) for p in prompts])
+    inf.run_plan(
+        plan,
+        lambda b: lm.get_ppl_async([prompts[i] for i in b.indices]),
+        lambda b, r: None, kind='ppl')
+    (records,) = tmod.read_timelines(
+        osp.join(str(tmp_path), 'obs')).values()
+    batches = [r for r in records if r['t'] == 'batch']
+    assert batches
+    for b in batches:
+        # scoring has no decode buffer waste: actual == ideal
+        assert b['bytes_kv'] == b['bytes_kv_ideal'] > 0
+        assert b['mfu'] > 0
+
+
+def test_engine_drain_records_cost_and_pool(tmp_path):
+    from opencompass_tpu import obs
+    from opencompass_tpu.obs import timeline as tmod
+    obs.init_obs(str(tmp_path))
+    obs.init_task_timeline('engine-cost')
+    lm = _tiny_lm(continuous_batching=True, decode_slots=2,
+                  kv_page_size=16)
+    outs = lm.generate_continuous(
+        ['the quick brown fox', 'jumps over'], 8)
+    assert len(outs) == 2
+    (records,) = tmod.read_timelines(
+        osp.join(str(tmp_path), 'obs')).values()
+    (eng,) = [r for r in records if r['t'] == 'engine']
+    assert eng['flops'] > 0 and eng['bytes_w'] > 0
+    # XLA paged-gather reads the full table width every step: the
+    # actual-vs-ideal ratio is the ROADMAP-item-1 waste number, > 1
+    assert eng['bytes_kv'] > eng['bytes_kv_ideal'] > 0
+    assert eng['mfu'] > 0 and eng['mbu'] > 0
+    assert eng['dur_s'] > 0
+    assert eng['kv_positions'] > 0
+    assert eng['attn_positions'] >= eng['kv_positions']
+    pool = eng['kv_pool']
+    assert pool['high_water'] > 0 and pool['failed_allocs'] == 0
+    assert pool['used'] == 0            # all rows retired: pages freed
+
+
+def test_kv_pool_pressure_event(tmp_path):
+    """A pool too small for the queued rows bounces admissions — the
+    allocator counts them and a structured kv_pool_pressure event
+    lands in the run's event stream."""
+    from opencompass_tpu import obs
+    tracer = obs.init_obs(str(tmp_path))
+    # pool of 5 (4 usable) pages; each row needs 2 pages -> only two
+    # rows resident at once, the rest queue (back-pressure)
+    lm = _tiny_lm(continuous_batching=True, decode_slots=4,
+                  kv_page_size=16, kv_pool_pages=5)
+    outs = lm.generate_continuous(
+        ['aa bb cc', 'dd ee ff', 'gg hh ii', 'jj kk ll'], 8)
+    assert all(isinstance(t, str) for t in outs)
+    engine = lm.continuous_engine()
+    assert engine.alloc.failed_allocs > 0
+    assert engine.alloc.n_allocated == 0     # drained clean
+    tracer.close()
+    events = [json.loads(line) for line in
+              open(osp.join(str(tmp_path), 'obs', 'events.jsonl'))
+              if line.strip()]
+    pressure = [e for e in events
+                if e.get('name') == 'kv_pool_pressure']
+    assert pressure, 'admission stall left no kv_pool_pressure event'
+    attrs = pressure[0]['attrs']
+    assert attrs['need_pages'] >= 1 and attrs['pool_pages'] == 5
+    assert attrs['queued_rows'] >= 1
+
+
+def test_noop_timeline_skips_cost_work(tmp_path):
+    """With no timeline installed the cost path never runs and no
+    files appear (the disabled-path contract)."""
+    from opencompass_tpu.icl.inferencers.base import BaseInferencer
+    lm = _tiny_lm()
+    inf = BaseInferencer(model=lm, batch_size=2, batch_plan=True)
+    plan = inf.make_plan([3, 4])
+    inf.run_plan(
+        plan,
+        lambda b: lm.get_ppl_async(['x y z', 'p q r s'][:len(
+            b.indices)]),
+        lambda b, r: None, kind='ppl')
+    assert os.listdir(str(tmp_path)) == []
+
+
+def test_torn_cost_record_recovery(tmp_path):
+    from opencompass_tpu import obs
+    from opencompass_tpu.obs import timeline as tmod
+    obs.init_obs(str(tmp_path))
+    tl = obs.init_task_timeline('torn-cost')
+    tl.batch('gen', ts=1.0, shape=[2, 8], rows=2, real_tokens=10,
+             pad_tokens=6, batch_s=0.1, device_s=0.1, flops=1000,
+             bytes_w=100, bytes_kv=50, bytes_kv_ideal=25, mfu=0.1,
+             mbu=0.2)
+    with open(tl.path, 'a', encoding='utf-8') as f:
+        f.write('{"v":1,"t":"engine","ts":2.0,"flops":12')
+    records = list(tmod.iter_records(tl.path))
+    assert len(records) == 1
+    s = tmod.summarize_records(records)
+    assert s['flops'] == 1000 and s['kv_ratio'] == 2.0
+
+
+# -- status fold / prometheus / report / export ------------------------------
+
+def test_status_fold_and_prom_gauges():
+    from opencompass_tpu.obs.live import fold_task_rows
+    from opencompass_tpu.obs.promexport import render_prometheus
+    tasks = {
+        'a': {'state': 'running', 'progress': 0.5, 'mfu': 0.2,
+              'mbu': 0.4, 'kv_pool_used_frac': 0.3,
+              'kv_pool_high_water_frac': 0.6,
+              'kv_pool_failed_allocs': 2, 'decode_slot_util': 0.9},
+        'b': {'state': 'running', 'progress': 0.5, 'mfu': 0.4,
+              'mbu': 0.6, 'kv_pool_used_frac': 0.1,
+              'kv_pool_high_water_frac': 0.2},
+    }
+    overall = fold_task_rows(tasks)
+    assert overall['mfu'] == pytest.approx(0.3)
+    assert overall['mbu'] == pytest.approx(0.5)
+    # pool gauges fold pessimistically (worst task) + stall total
+    assert overall['kv_pool_used_frac'] == pytest.approx(0.3)
+    assert overall['kv_pool_high_water_frac'] == pytest.approx(0.6)
+    assert overall['kv_pool_failed_allocs'] == 2
+    text = render_prometheus({}, status={'overall': overall,
+                                         'tasks': tasks})
+    assert 'oct_run_mfu 0.3' in text
+    assert 'oct_run_mbu 0.5' in text
+    assert 'oct_kv_pool_used_frac 0.3' in text
+    assert 'oct_kv_pool_failed_allocs 2' in text
+    assert 'oct_task_mbu{task="a"} 0.4' in text
+    assert 'oct_task_mfu{task="b"} 0.4' in text
+
+
+def test_trace_report_roofline_section(tmp_path):
+    from opencompass_tpu import obs
+    from opencompass_tpu.obs.report import build_report, render_report
+    tracer = obs.init_obs(str(tmp_path))
+    with tracer.span('run'):
+        tl = obs.init_task_timeline('roof-task')
+        tl.set_unit('m/d')
+        tl.plan('gen', stats={}, planned=True)
+        tl.batch('gen', ts=1.0, shape=[2, 16], rows=2, real_tokens=20,
+                 pad_tokens=12, batch_s=0.5, device_s=0.4,
+                 tokens_in=20, tokens_out=8, flops=5000, bytes_w=400,
+                 bytes_kv=200, bytes_kv_ideal=100, mfu=0.12, mbu=0.34)
+    tracer.close()
+    report = build_report(str(tmp_path))
+    text = render_report(report)
+    assert 'roofline (MFU/MBU attribution)' in text
+    assert '12.0%' in text and '34.0%' in text   # mfu/mbu columns
+    assert '2.00x' in text                       # kv_ratio column
+    assert 'KV read traffic runs 2.00x' in text
+    # summary line rides render_summary
+    assert 'roofline:' in text
+
+
+def test_perfetto_export_engine_counter_tracks(tmp_path):
+    from opencompass_tpu import obs
+    from opencompass_tpu.obs.export import build_chrome_trace
+    tracer = obs.init_obs(str(tmp_path))
+    with tracer.span('run'):
+        tl = obs.init_task_timeline('eng-task')
+        tl.plan('gen', stats={}, planned=True)
+        tl.engine('gen', ts=10.0, dur_s=2.0, rows=3, slots=4,
+                  page_size=16, steps=12, prefill_steps=2,
+                  decode_steps=10, joined=3, retired=3, slot_util=0.75,
+                  occupancy_series=[3, 4, 2], flops=9000, bytes_w=800,
+                  bytes_kv=600, bytes_kv_ideal=200, mfu=0.11, mbu=0.22)
+    tracer.close()
+    doc = build_chrome_trace(str(tmp_path))
+    events = doc['traceEvents']
+    drains = [e for e in events if e.get('cat') == 'engine'
+              and e['ph'] == 'X']
+    assert drains and drains[0]['args']['mbu'] == 0.22
+    counters = [e for e in events if e.get('cat') == 'engine'
+                and e['ph'] == 'C']
+    occ = [e for e in counters if e['name'].startswith('slots ')]
+    assert [e['args']['occupied'] for e in occ] == [3, 4, 2]
+    # monotone: occupancy samples spread across the drain interval
+    assert [e['ts'] for e in occ] == sorted(e['ts'] for e in occ)
+    assert any(e['name'].startswith('mfu ') for e in counters)
+    assert any(e['name'].startswith('mbu ') for e in counters)
+    # well-formedness is preserved: every B still has its E per track
+    by_track = {}
+    for e in events:
+        if e['ph'] in ('B', 'E'):
+            by_track.setdefault((e['pid'], e.get('tid')),
+                                []).append(e['ph'])
+    for phs in by_track.values():
+        depth = 0
+        for ph in phs:
+            depth += 1 if ph == 'B' else -1
+            assert depth >= 0
+        assert depth == 0
+
+
+# -- serve plane: per-request MBU --------------------------------------------
+
+def test_request_record_carries_forward_phase_mbu(tmp_path):
+    """The daemon lays the worker's forward-phase MFU/MBU onto the
+    model_forward child span of the requests.jsonl record, and the
+    rolling /v1/stats window folds a per-model mbu_mean."""
+    import time
+
+    from opencompass_tpu.obs import reqtrace
+    from opencompass_tpu.serve.daemon import EvalEngine
+    obs_root = str(tmp_path)
+    eng = EvalEngine.__new__(EvalEngine)
+    eng.req_recorder = reqtrace.RequestRecorder(obs_root)
+    eng.req_stats = reqtrace.RollingStats()
+    eng._catalog = {'m': {}}
+    eng.tracer = None
+    eng._record_request(
+        response_id='cmpl-x', request_id='req-1', ts=time.time(),
+        model='m', wall_s=0.5, parse_s=0.001,
+        timings={'lease_wait_s': 0.01, 'roundtrip_s': 0.3},
+        resp={'phases': {'model_forward_s': 0.2,
+                         'store_lookup_s': 0.01},
+              'mbu': 0.42, 'mfu': 0.1, 'ttft_s': 0.05,
+              'store_hits': 0, 'device_rows': 1,
+              'prompt_tokens': 10, 'completion_tokens': 8},
+        error=None)
+    (rec,) = reqtrace.iter_requests(
+        osp.join(obs_root, reqtrace.REQUESTS_FILE))
+    forward = [p for p in rec['phases']
+               if p['name'] == 'model_forward']
+    assert forward and forward[0]['mbu'] == 0.42
+    assert forward[0]['mfu'] == 0.1
+    # no other phase carries the fields
+    assert all('mbu' not in p for p in rec['phases']
+               if p['name'] != 'model_forward')
+    summary = eng.req_stats.summary(window_s=60)
+    assert summary['completions']['per_model']['m']['mbu_mean'] \
+        == pytest.approx(0.42)
+
+
+def test_rolling_stats_mbu_mean_mixed_samples():
+    from opencompass_tpu.obs.reqtrace import RollingStats
+    rs = RollingStats()
+    rs.record_completion('m', 0.1, mbu=0.5)
+    rs.record_completion('m', 0.2, mbu=0.3)
+    rs.record_completion('m', 0.3)          # store-served: no mbu
+    row = rs.summary(window_s=60)['completions']['per_model']['m']
+    assert row['mbu_mean'] == pytest.approx(0.4)
+    assert row['count'] == 3
+
+
+# -- ledger efficiency gate ---------------------------------------------------
+
+def _ledger(tmp_path, rows):
+    from opencompass_tpu.utils.fileio import append_jsonl_atomic
+    led = tmp_path / 'ledger'
+    led.mkdir(parents=True, exist_ok=True)
+    append_jsonl_atomic(str(led / 'runs.jsonl'), rows)
+    return str(led)
+
+
+def _rec(run, mfu=None, tps=100.0, model='m', dataset='d', acc=80.0):
+    rec = {'v': 1, 'ts': 1.0, 'run': run, 'model': model,
+           'dataset': dataset, 'kind': 'gen', 'tokens_per_sec': tps,
+           'samples_per_sec': tps / 10, 'wall_seconds': 1.0,
+           'compile_seconds': 0.1, 'pad_eff': 0.9,
+           'accuracy': {'score': acc}}
+    if mfu is not None:
+        rec['mfu'] = mfu
+        rec['mbu'] = mfu * 2
+    return rec
+
+
+def test_check_records_min_mfu_ratio():
+    from opencompass_tpu.ledger import check_records
+    records = [_rec('r1', mfu=0.40), _rec('r2', mfu=0.15)]
+    # off by default: tokens/s identical -> no regression
+    assert check_records(records, 'r1', 'r2') == []
+    regs = check_records(records, 'r1', 'r2', min_mfu_ratio=0.5)
+    assert len(regs) == 1 and regs[0]['regression'] == 'efficiency'
+    assert regs[0]['mfu'] == 0.15 and regs[0]['mfu_base'] == 0.40
+    # identical rerun passes
+    assert check_records([_rec('r1', mfu=0.4), _rec('r3', mfu=0.4)],
+                         'r1', 'r3', min_mfu_ratio=0.5) == []
+    # rows without an MFU on either side are skipped, not failed
+    assert check_records([_rec('r1'), _rec('r2', mfu=0.1)],
+                         'r1', 'r2', min_mfu_ratio=0.5) == []
+    assert check_records([_rec('r1', mfu=0.4), _rec('r2')],
+                         'r1', 'r2', min_mfu_ratio=0.5) == []
+    # a fully store-served side skips the gate like the throughput one
+    cached = dict(_rec('r2', mfu=0.01, tps=0.0), store_hit_rate=1.0)
+    assert check_records([_rec('r1', mfu=0.4), cached],
+                         'r1', 'r2', min_mfu_ratio=0.5) == []
+
+
+def test_ledger_cli_min_mfu_ratio_exit_codes(tmp_path):
+    led = _ledger(tmp_path, [_rec('r1', mfu=0.40),
+                             _rec('r2', mfu=0.15)])
+
+    def cli(*argv):
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        return subprocess.run(
+            [sys.executable, '-m', 'opencompass_tpu.cli', 'ledger',
+             *argv], cwd=REPO, env=env, capture_output=True,
+            text=True, timeout=120)
+
+    # throughput unchanged: plain check passes
+    assert cli('check', '--ledger', led).returncode == 0
+    # the efficiency gate trips on the halved MFU
+    r = cli('check', '--ledger', led, '--min-mfu-ratio', '0.5')
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert 'MFU' in r.stdout
+    # an identical rerun passes the same gate
+    led2 = _ledger(tmp_path / 'b', [_rec('r1', mfu=0.40),
+                                    _rec('r2', mfu=0.40)])
+    r = cli('check', '--ledger', led2, '--min-mfu-ratio', '0.5')
+    assert r.returncode == 0, r.stdout + r.stderr
+    # json mode carries the regression row
+    r = cli('check', '--ledger', led, '--min-mfu-ratio', '0.5',
+            '--json')
+    assert r.returncode == 2
+    payload = json.loads(r.stdout)
+    assert payload['regressions'][0]['regression'] == 'efficiency'
+
+
+def test_collect_run_records_joins_roofline(tmp_path):
+    """Ledger records pick up mfu/mbu/kv_ratio from the run's timeline
+    summaries (the check gate's data source)."""
+    from opencompass_tpu import obs
+    from opencompass_tpu.ledger import collect_run_records
+    work = tmp_path / 'run'
+    (work / 'perf' / 'm').mkdir(parents=True)
+    json.dump({'wall_seconds': 1.0, 'tokens_per_sec': 10.0,
+               'samples': 2}, open(work / 'perf' / 'm' / 'd.json', 'w'))
+    obs.init_obs(str(work))
+    tl = obs.init_task_timeline('t')
+    tl.set_unit('m/d')
+    tl.plan('gen', stats={}, planned=True)
+    tl.batch('gen', ts=1.0, shape=[1, 8], rows=1, real_tokens=8,
+             pad_tokens=0, batch_s=0.2, device_s=0.2, tokens_in=8,
+             flops=100, bytes_w=10, bytes_kv=40, bytes_kv_ideal=20,
+             mfu=0.25, mbu=0.5)
+    obs.reset_obs()
+    (rec,) = collect_run_records(str(work), run_id='rX')
+    assert rec['mfu'] == pytest.approx(0.25)
+    assert rec['mbu'] == pytest.approx(0.5)
+    assert rec['kv_ratio'] == pytest.approx(2.0)
