@@ -992,6 +992,218 @@ def _bench_serve(out_json='BENCH_SERVE.json'):
     return record
 
 
+def _bench_slo(out_json='BENCH_SERVE.json'):
+    """detail.slo: the burn-rate alerting loop end to end — a serve
+    daemon with a tight latency objective, the 12-request burst
+    replayed with an injected per-completion sleep past the objective
+    (file-based knob, so the slowdown can be LIFTED mid-daemon),
+    asserting the alert fires (alerts.jsonl + /v1/alerts + /metrics +
+    /healthz degraded) and then resolves once the fast window
+    recovers.  Also records the measured inter-token-latency
+    percentiles (`itl_p99_ms`) the engine path now reports.
+    Device-free (continuous FakeModel with paced token emission)."""
+    import signal
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    tmp = tempfile.mkdtemp(prefix='oct_slo_')
+    objective_ms = 200.0
+    sleep_file = os.path.join(tmp, 'sleep_s')
+    with open(sleep_file, 'w') as f:
+        f.write('0.5')
+    cfg_path = os.path.join(tmp, 'serve_slo.py')
+    with open(cfg_path, 'w') as f:
+        f.write(f"""
+from opencompass_tpu.models import FakeModel
+models = [dict(type=FakeModel, abbr='fake-slo', path='fake',
+               continuous=True,
+               canned_responses={{'Q': 'tok ' * 8}},
+               run_cfg=dict(num_devices=0))]
+slos = [dict(name='completion_latency', kind='latency',
+             objective_ms={objective_ms}, target=0.5,
+             fast_s=5.0, slow_s=30.0, burn_factor=1.5,
+             min_samples=3, severity='page')]
+slo_eval_interval_s = 0.5
+work_dir = {os.path.join(tmp, 'out')!r}
+""")
+    env = dict(os.environ, JAX_PLATFORMS='cpu',
+               OCT_CACHE_ROOT=os.path.join(tmp, 'cache'),
+               OCT_DEBUG_COMPLETE_SLEEP_FILE=sleep_file,
+               OCT_FAKE_TOKEN_SLEEP_S='0.003')
+    env.pop('OCT_TRACE_ID', None)
+    env.pop('OCT_OBS_DIR', None)
+    log_path = os.path.join(tmp, 'daemon.log')
+    log = open(log_path, 'w')
+    proc = subprocess.Popen(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'serve', cfg_path,
+         '--port', '0'],
+        stdout=log, stderr=subprocess.STDOUT, env=env, cwd=here)
+
+    def http(method, url, body=None, timeout=60):
+        req = urllib.request.Request(
+            url, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={'Content-Type': 'application/json'})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+
+    fired_after_s = resolved_after_s = None
+    degraded_during_burn = None
+    try:
+        port = None
+        deadline = time.time() + 180
+        while time.time() < deadline and port is None:
+            if proc.poll() is not None:
+                raise RuntimeError('daemon died at startup: '
+                                   + open(log_path).read()[-800:])
+            for line in open(log_path).read().splitlines():
+                if 'engine listening on http://127.0.0.1:' in line:
+                    port = int(line.split('127.0.0.1:')[1].split()[0])
+            time.sleep(0.2)
+        base = f'http://127.0.0.1:{port}'
+        while True:
+            try:
+                code, _ = http('GET', base + '/healthz', timeout=5)
+                if code == 200:
+                    break
+            except Exception:
+                pass
+            if time.time() > deadline:
+                raise RuntimeError('daemon never became ready')
+            time.sleep(0.5)
+
+        def active_rules():
+            _, alerts = http('GET', base + '/v1/alerts')
+            return [a['rule'] for a in alerts.get('active') or []]
+
+        # burst A: the 12-request serve burst, each one slowed past
+        # the objective by the injected sleep (unique prompts — store
+        # hits would dodge the device path, not the sleep, but keep
+        # the replay honest)
+        t_burn = time.perf_counter()
+        for i in range(12):
+            http('POST', base + '/v1/completions',
+                 {'model': 'fake-slo',
+                  'prompt': f'Q: slo burn probe {i}?\nA:',
+                  'max_tokens': 8})
+            if fired_after_s is None \
+                    and 'completion_latency' in active_rules():
+                fired_after_s = time.perf_counter() - t_burn
+        while fired_after_s is None \
+                and time.perf_counter() - t_burn < 20:
+            if 'completion_latency' in active_rules():
+                fired_after_s = time.perf_counter() - t_burn
+                break
+            time.sleep(0.25)
+        if fired_after_s is None:
+            raise RuntimeError('burn-rate alert never fired')
+        _, health = http('GET', base + '/healthz')
+        degraded_during_burn = health.get('degraded')
+
+        # lift the slowdown; fresh fast requests push the slow samples
+        # out of the fast window and the alert resolves
+        with open(sleep_file, 'w') as f:
+            f.write('0')
+        t_lift = time.perf_counter()
+        i = 0
+        while time.perf_counter() - t_lift < 30:
+            http('POST', base + '/v1/completions',
+                 {'model': 'fake-slo',
+                  'prompt': f'Q: slo recovery probe {i}?\nA:',
+                  'max_tokens': 8})
+            i += 1
+            if 'completion_latency' not in active_rules():
+                resolved_after_s = time.perf_counter() - t_lift
+                break
+            time.sleep(0.5)
+        if resolved_after_s is None:
+            raise RuntimeError('burn-rate alert never resolved after '
+                               'the slowdown lifted')
+
+        _, stats = http('GET', base + '/v1/stats?window=300')
+        slo_row = (stats.get('completions') or {}).get(
+            'per_model', {}).get('fake-slo') or {}
+        _, alerts = http('GET', base + '/v1/alerts')
+        import urllib.request as _ur
+        with _ur.urlopen(base + '/metrics', timeout=10) as r:
+            metrics_text = r.read().decode()
+    finally:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+    alerts_file = os.path.join(tmp, 'cache', 'serve', 'obs',
+                               'alerts.jsonl')
+    transitions = [json.loads(line) for line
+                   in open(alerts_file, encoding='utf-8')
+                   if line.strip()]
+    kinds = [t['t'] for t in transitions
+             if t.get('rule') == 'completion_latency']
+    assert 'fire' in kinds and 'resolve' in kinds, kinds
+    assert 'oct_alert_active' in metrics_text
+    assert 'oct_slo_budget_remaining' in metrics_text
+    # dead-daemon alert pane renders from the alerts.jsonl tail
+    top = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'top',
+         os.path.join(tmp, 'cache'), '--once'],
+        env=env, cwd=here, capture_output=True, text=True, timeout=120)
+
+    slo_record = {
+        'workload': '12-request serve burst with a 0.5s injected '
+                    'per-completion sleep past a 200ms p50 latency '
+                    'objective (fast 5s / slow 30s windows, burn '
+                    'factor 1.5), then lifted',
+        'objective_ms': objective_ms,
+        'injected_sleep_s': 0.5,
+        'alert_fired': True,
+        'fire_latency_s': round(fired_after_s, 2),
+        'alert_resolved': True,
+        'resolve_latency_s': round(resolved_after_s, 2),
+        'healthz_degraded_during_burn': degraded_during_burn,
+        'alert_transitions': len(transitions),
+        'recent_transitions': len(alerts.get('recent') or []),
+        # measured engine-path serving latencies over the whole window
+        'completion_count': slo_row.get('count'),
+        'completion_p99_ms': slo_row.get('p99_ms'),
+        'ttft_p95_ms': slo_row.get('ttft_p95_ms'),
+        'itl_p50_ms': slo_row.get('itl_p50_ms'),
+        'itl_p99_ms': slo_row.get('itl_p99_ms'),
+        'top_file_mode_alert_pane': 'alerts:' in top.stdout,
+    }
+    # merge into BENCH_SERVE.json next to the --serve leg's record
+    path = os.path.join(here, out_json)
+    try:
+        with open(path, encoding='utf-8') as f:
+            record = json.load(f)
+        if not isinstance(record, dict):
+            record = {}
+    except (OSError, ValueError):
+        record = {}
+    record['slo'] = slo_record
+    record['itl_p99_ms'] = slo_record['itl_p99_ms']
+    try:
+        with open(path, 'w') as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
+    if slo_record.get('itl_p99_ms') is not None:
+        _append_trajectory(
+            'serve', 'itl_p99_ms', slo_record['itl_p99_ms'], 'ms',
+            direction='lower',
+            detail={'itl_p50_ms': slo_record['itl_p50_ms'],
+                    'ttft_p95_ms': slo_record['ttft_p95_ms'],
+                    'fire_latency_s': slo_record['fire_latency_s'],
+                    'resolve_latency_s':
+                        slo_record['resolve_latency_s']})
+    return slo_record
+
+
 def main():
     n_chips = max(1, len(jax.devices()))
     kind = getattr(jax.devices()[0], 'device_kind', '')
@@ -1332,6 +1544,11 @@ if __name__ == '__main__':
         # standalone serve-daemon leg (device-free; runs on CPU hosts)
         print(json.dumps({'metric': 'serve', 'v': 1,
                           'detail': _bench_serve()}))
+        sys.exit(0)
+    if '--slo' in sys.argv:
+        # standalone SLO burn-rate alerting leg (device-free)
+        print(json.dumps({'metric': 'slo', 'v': 1,
+                          'detail': _bench_slo()}))
         sys.exit(0)
     if '--continuous-batching' in sys.argv:
         # standalone continuous-batching leg (tiny JaxLM; CPU-runnable)

@@ -30,8 +30,12 @@ Usage::
                     # durable sweep queue + resident worker fleet +
                     # OpenAI-compatible /v1/completions (docs/serving.md)
     python -m opencompass_tpu.cli top CACHE_ROOT    # live serve dashboard
-                    # fleet table + queue + rolling p99/TTFT sparklines
-                    # from {cache_root}/serve/obs/ files + /v1/stats
+                    # fleet table + queue + alerts + rolling p99/TTFT
+                    # sparklines from {cache_root}/serve/obs/ + /v1/stats
+    python -m opencompass_tpu.cli doctor DIR        # auto-triage
+                    # ranked findings (stragglers, compile storms, SLO
+                    # breaches by phase...) from a run work_dir or serve
+                    # cache root; --check exits 2 on error findings (CI)
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files
@@ -290,6 +294,19 @@ def top_main(argv=None) -> int:
     return serve_top_main(argv)
 
 
+def doctor_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli doctor <work_dir|cache_root>``
+    — rule-based auto-triage over every telemetry artifact a run (or
+    serve cache root) left on disk: ranked findings with evidence
+    lines and remediation hints (straggler tasks, cold-compile storms,
+    pad-efficiency collapse, KV-pool pressure, prefill-induced decode
+    stalls, SLO breaches attributed to phase, ...).  Purely file-based
+    — works on dead runs; ``--check`` exits 2 on error-severity
+    findings so CI can gate on run health next to ``ledger check``."""
+    from opencompass_tpu.obs.doctor import main as doctor_cli_main
+    return doctor_cli_main(argv)
+
+
 def serve_main(argv=None) -> int:
     """``python -m opencompass_tpu.cli serve <config> [--port N]`` —
     the persistent evaluation engine: durable FIFO sweep queue under
@@ -319,6 +336,8 @@ def main():
         raise SystemExit(cache_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'ledger':
         raise SystemExit(ledger_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == 'doctor':
+        raise SystemExit(doctor_main(sys.argv[2:]))
     args = parse_args()
     cfg = get_config_from_arg(args)
     work_dir = cfg['work_dir']

@@ -56,11 +56,39 @@ class FakeModel(BaseModel):
         delivered per row in the engine's feed order (longest prompt
         first) — deliberately NOT dataset order, so callers must
         scatter results back exactly as they would for the real
-        engine's out-of-order retirements."""
+        engine's out-of-order retirements.
+
+        Token emission is *paced*: each output token is stamped with a
+        wall-clock timestamp (optionally slowed by
+        ``OCT_FAKE_TOKEN_SLEEP_S`` seconds per token), so ``stats_out``
+        carries a measured TTFT and inter-token-latency samples through
+        exactly the serve plumbing the real engine feeds — the
+        device-free ``bench.py --slo`` leg and the reqtrace tests ride
+        this."""
+        import os
+        import time
+        try:
+            sleep_s = float(os.environ.get('OCT_FAKE_TOKEN_SLEEP_S')
+                            or 0.0)
+        except (TypeError, ValueError):
+            sleep_s = 0.0
+        t0 = time.perf_counter()
         texts = self.generate(list(inputs), max_out_len=max_out_len)
         order = sorted(range(len(texts)),
                        key=lambda i: (-len(str(inputs[i]).split()), i))
+        first_ts = None
+        itl: List[float] = []
         for k in order:
+            prev = None
+            for _ in range(max(len(texts[k].split()), 1)):
+                if sleep_s > 0:
+                    time.sleep(min(sleep_s, 1.0))
+                now = time.perf_counter()
+                if first_ts is None:
+                    first_ts = now
+                if prev is not None:
+                    itl.append(now - prev)
+                prev = now
             if on_result is not None:
                 on_result(k, texts[k])
         if stats_out is not None:
@@ -68,6 +96,17 @@ class FakeModel(BaseModel):
                 self.get_token_len(str(p)) for p in inputs)
             stats_out['decode_tokens'] = sum(
                 self.get_token_len(t) for t in texts)
+            if first_ts is not None:
+                stats_out['ttft_s'] = round(first_ts - t0, 6)
+            if itl:
+                # the one nearest-rank percentile every surface uses
+                from opencompass_tpu.obs.reqtrace import percentile
+                stats_out['itl_p50_ms'] = round(
+                    percentile(itl, 0.50) * 1e3, 3)
+                stats_out['itl_p99_ms'] = round(
+                    percentile(itl, 0.99) * 1e3, 3)
+                stats_out['itl_ms'] = [round(v * 1e3, 3)
+                                       for v in itl[:64]]
         return texts
 
     def generate(self, inputs: List[str], max_out_len: int) -> List[str]:

@@ -64,7 +64,7 @@ class _EngineRow:
     """One sequence moving through the continuous engine."""
     __slots__ = ('ids', 'max_new', 'tag', 'emitted', 'kv_len', 'slot',
                  'done', 'retire_seq', 'event', 'interactive',
-                 'submit_ts', 'first_token_ts', 'done_ts')
+                 'submit_ts', 'first_token_ts', 'done_ts', 'token_ts')
 
     def __init__(self, ids, max_new, tag, interactive=False):
         self.ids = list(ids)
@@ -80,6 +80,14 @@ class _EngineRow:
         self.submit_ts = time.perf_counter()
         self.first_token_ts: Optional[float] = None
         self.done_ts: Optional[float] = None
+        # one perf_counter stamp per emitted token: consecutive diffs
+        # are this row's inter-token latencies (bounded by max_new)
+        self.token_ts: List[float] = []
+
+    def itl_seconds(self) -> List[float]:
+        """Inter-token gaps (len = emitted - 1)."""
+        return [b - a for a, b in zip(self.token_ts,
+                                      self.token_ts[1:])]
 
 
 class ContinuousEngine:
@@ -155,6 +163,17 @@ class ContinuousEngine:
         self._retire_seq = 0
         self._occ_series: 'collections.deque[int]' = collections.deque(
             maxlen=4096)
+        # decode-ready rows idled by a prefill step, summed over steps:
+        # the MEASURED "prefill stalls decode slots" number a mixed
+        # prefill+decode step (ROADMAP item 1) would reclaim
+        self.stall_slot_steps = 0
+        # per-step records (kind, wall, slot composition, retirements)
+        # — bounded like the occupancy series; per-drain deltas take
+        # the tail.  Schema: {'k': 'p'|'d', 'w': wall_s, 'pf':
+        # prefilling rows, 'dc': decoding rows, 'st': decode-ready
+        # rows stalled behind the prefill chunk, 'ret': retired}
+        self._step_records: 'collections.deque[Dict]' = \
+            collections.deque(maxlen=4096)
         # roofline accounting (obs/costmodel.py): exact per-engine
         # token/step/attended-position counters so MFU/MBU and the
         # paged-gather-vs-ideal KV-traffic ratio come from what the
@@ -304,8 +323,15 @@ class ContinuousEngine:
             page_table = self.table.table.copy()
             self.steps += 1
             step_no = self.steps
+            n_active = len(active)
+            n_prefill = len(prefilling)
+            # a prefill step advances only prefilling rows; every
+            # decode-ready co-resident idles this step — that idling is
+            # the head-of-line cost the per-step record makes visible
+            stalled = n_active - n_prefill if prefilling else 0
             if prefilling:
                 self.prefill_steps += 1
+                self.stall_slot_steps += stalled
             else:
                 self.decode_steps += 1
                 self.occupancy_sum += len(active)
@@ -345,13 +371,22 @@ class ContinuousEngine:
                 if row.kv_len < len(row.ids):
                     continue        # still prefilling
                 tok = int(nxt[row.slot])
+                now_tok = time.perf_counter()
                 if not row.emitted:
-                    row.first_token_ts = time.perf_counter()
+                    row.first_token_ts = now_tok
+                row.token_ts.append(now_tok)
                 row.emitted.append(tok)
                 if (eos is not None and tok == eos) \
                         or len(row.emitted) >= row.max_new:
                     self._retire_locked(row)
                     retired.append(row)
+            self._step_records.append({
+                'k': 'p' if prefilling else 'd',
+                'w': round(elapsed, 6),
+                'pf': n_prefill,
+                'dc': 0 if prefilling else n_active,
+                'st': stalled,
+                'ret': len(retired)})
             self._note_heartbeat_locked()
         for row in retired:
             row.event.set()
@@ -377,6 +412,13 @@ class ContinuousEngine:
                     kv_pool_used_frac=pool['used_frac'],
                     kv_pool_high_water_frac=pool['high_water_frac'],
                     kv_pool_failed_allocs=pool['failed_allocs'])
+                # fraction of decode-ready slot-steps lost to prefill
+                # head-of-line blocking (engine lifetime; the live
+                # "prefill stalls decode" gauge)
+                denom = self.stall_slot_steps + self.occupancy_sum
+                if denom:
+                    fields['decode_stall_frac'] = round(
+                        self.stall_slot_steps / denom, 4)
                 cm = self._costmodel
                 if cm is not None and self.device_seconds > 0:
                     cost = cm.engine_cost(
@@ -443,7 +485,8 @@ class ContinuousEngine:
                     'device_seconds': self.device_seconds,
                     'prefill_tokens': self.prefill_tokens,
                     'kv_positions': self.kv_positions,
-                    'attn_positions': self.attn_positions}
+                    'attn_positions': self.attn_positions,
+                    'stall_slot_steps': self.stall_slot_steps}
 
     def stats(self, since: Optional[Dict] = None) -> Dict:
         """Engine counters — lifetime by default, or the delta since a
@@ -452,14 +495,26 @@ class ContinuousEngine:
         engine's Nth task never re-reports task N-1's steps)."""
         base = since or {}
         with self._lock:
+            from opencompass_tpu.obs.reqtrace import percentile
             from opencompass_tpu.obs.timeline import _downsample
             d_decode = self.decode_steps - base.get('decode_steps', 0)
             d_occ = self.occupancy_sum - base.get('occupancy_sum', 0)
+            d_steps = self.steps - base.get('steps', 0)
             series = [float(v) for v in self._occ_series]
+            step_recs = list(self._step_records)
             if since is not None:
                 # the bounded series keeps only the recent tail; the
                 # delta's decode steps are its newest entries
                 series = series[max(0, len(series) - d_decode):]
+                step_recs = step_recs[max(0,
+                                          len(step_recs) - d_steps):]
+            walls = [r['w'] for r in step_recs]
+            # per-step detail capped for the timeline record: stride-
+            # sample past 128 entries (aggregates stay exact; the
+            # detail is the shape of the drain, not its totals)
+            if len(step_recs) > 128:
+                stride = (len(step_recs) + 127) // 128
+                step_recs = step_recs[::stride]
             return {
                 'slots': self.slots,
                 'page_size': self.page_size,
@@ -491,6 +546,20 @@ class ContinuousEngine:
                 - base.get('attn_positions', 0),
                 'table_positions': self.max_pages * self.page_size,
                 'kv_pool': self.alloc.stats(),
+                # per-step telemetry: the slot-composition records
+                # (prefill vs decode vs stalled rows per step), the
+                # stalled-slot-step total, and the step-wall spread —
+                # what makes "prefill stalls decode slots" a measured
+                # number instead of an assertion
+                'stall_slot_steps': self.stall_slot_steps
+                - base.get('stall_slot_steps', 0),
+                'steps_detail': step_recs,
+                'step_wall_p50_ms': round(
+                    percentile(walls, 0.50) * 1e3, 3)
+                if walls else None,
+                'step_wall_p99_ms': round(
+                    percentile(walls, 0.99) * 1e3, 3)
+                if walls else None,
             }
 
     def cost_fields(self, stats: Dict) -> Dict:
@@ -1531,11 +1600,29 @@ class JaxLM(BaseModel):
                 on_result(row.tag, text)
 
         engine.drain(rows, deliver)
-        self._record_engine_drain(engine, snap, len(rows), t0)
+        # per-request inter-token latencies: consecutive emitted-token
+        # gaps pooled over this call's rows (measured, not estimated —
+        # the steady decode cadence next to TTFT's prefill cost)
+        itl = [gap for row in rows for gap in row.itl_seconds()]
+        itl_fields: Dict = {}
+        if itl:
+            from opencompass_tpu.obs.reqtrace import percentile
+            from opencompass_tpu.obs.timeline import _downsample
+            itl_fields = {
+                'itl_p50_ms': round(percentile(itl, 0.50) * 1e3, 3),
+                'itl_p99_ms': round(percentile(itl, 0.99) * 1e3, 3),
+                'itl_ms': [round(v * 1e3, 3)
+                           for v in _downsample(itl, 64)],
+            }
+        self._record_engine_drain(engine, snap, len(rows), t0,
+                                  extra={k: v for k, v in
+                                         itl_fields.items()
+                                         if k != 'itl_ms'})
         if stats_out is not None:
             stats_out['prefill_tokens'] = sum(len(r) for r in ids)
             stats_out['decode_tokens'] = sum(
                 len(r.emitted) for r in rows)
+            stats_out.update(itl_fields)
             firsts = [r.first_token_ts for r in rows
                       if r.first_token_ts is not None]
             if firsts:
@@ -1555,7 +1642,8 @@ class JaxLM(BaseModel):
         return [t if t is not None else '' for t in texts]
 
     def _record_engine_drain(self, engine: 'ContinuousEngine',
-                             snap: Dict, n_rows: int, t0: float):
+                             snap: Dict, n_rows: int, t0: float,
+                             extra: Optional[Dict] = None):
         """One flight-recorder ``engine`` record per drained call —
         per-drain DELTAS (this call's steps/joins/retires/occupancy),
         so a resident engine's Nth task reports only its own work
@@ -1569,6 +1657,8 @@ class JaxLM(BaseModel):
             if tl.enabled:
                 stats = engine.stats(since=snap)
                 fields = dict(stats, **engine.cost_fields(stats))
+                if extra:
+                    fields.update(extra)
                 tl.engine('gen', ts=round(t0, 6), rows=n_rows,
                           dur_s=round(time.time() - t0, 6), **fields)
         except Exception:

@@ -1,0 +1,596 @@
+"""``cli doctor`` — rule-based auto-triage over the telemetry artifacts.
+
+Every prior observability layer records; none interprets.  An operator
+staring at a slow sweep has to join ``cli trace`` (spans), the flight
+recorder (per-batch/engine records), ``cli status`` (heartbeat fold),
+``requests.jsonl`` (serving phases), the ledger, and the event stream
+— and *know what bad looks like* in each.  The doctor does that join:
+it reads every artifact a run (or a serve cache root) left on disk and
+emits **ranked findings** — severity, rule, one-line diagnosis,
+evidence lines quoting the numbers that triggered it, and a
+remediation hint naming the knob or doc to reach for.
+
+Purely file-based: works on dead runs exactly like ``cli status`` and
+``cli trace`` (no daemon, no device).  ``--json`` emits the findings
+machine-readably; ``--check`` exits **2** when any error-severity
+finding is present (0 otherwise), so CI can gate on "the run is not
+just complete but healthy" next to ``cli ledger check`` and
+``cli cache verify``.
+
+Rules (each one is a pure function over the collected artifacts; all
+are exception-guarded — a torn artifact degrades to fewer findings,
+never to a crash):
+
+- ``failed_tasks``       (error) tasks that exited non-zero.
+- ``slo_breach``         (error for page severity) active burn-rate
+                         alerts from alerts.jsonl, with the breach
+                         attributed to a serving phase (queue wait vs
+                         prefill vs decode vs store) from the
+                         requests.jsonl phase spans.
+- ``worker_instability`` (warn)  retry/timeout/stall-kill loops from
+                         the event stream.
+- ``straggler_task``     (warn)  one task's wall far beyond the rest.
+- ``cold_compile_storm`` (warn)  compile time dominating device time
+                         with cache misses outnumbering hits.
+- ``pad_collapse``       (warn)  padding efficiency below 50%.
+- ``kv_pool_pressure``   (warn)  bounced page allocations / admission
+                         stalls on the paged KV pool.
+- ``prefill_stall``      (warn)  decode-ready slots idled by prefill
+                         chunks (per-step engine records).
+- ``gather_waste``       (info)  paged-gather KV read traffic far over
+                         the ragged ideal (``kv_ratio``).
+- ``dead_run``           (info)  a 'running' run marker whose driver
+                         pid is gone.
+- ``queue_backlog``      (warn)  queued sweeps aging past bounds.
+"""
+from __future__ import annotations
+
+import json
+import os.path as osp
+from typing import Callable, Dict, List, Optional
+
+DOCTOR_VERSION = 1
+SEVERITIES = ('error', 'warn', 'info')
+
+# rule thresholds — module-level so tests can reference them
+STRAGGLER_RATIO = 2.5
+STRAGGLER_MIN_GAP_S = 5.0
+COMPILE_STORM_FRAC = 0.5
+PAD_COLLAPSE_EFF = 0.5
+PAD_COLLAPSE_MIN_TOKENS = 500
+GATHER_WASTE_RATIO = 4.0
+PREFILL_STALL_FRAC = 0.3
+QUEUE_BACKLOG_AGE_S = 600.0
+SLOW_REQUEST_FACTOR = 2.0
+
+
+def _finding(severity: str, rule: str, title: str,
+             evidence: Optional[List[str]] = None,
+             fix: Optional[str] = None,
+             data: Optional[Dict] = None) -> Dict:
+    assert severity in SEVERITIES
+    out = {'severity': severity, 'rule': rule, 'title': title,
+           'evidence': list(evidence or [])[:8]}
+    if fix:
+        out['fix'] = fix
+    if data:
+        out['data'] = data
+    return out
+
+
+# -- artifact collection ----------------------------------------------------
+
+def collect(path: str) -> Dict:
+    """Everything the rules read, resolved from one path: a run
+    work_dir (or its obs/ dir, or a parent outputs dir — the
+    ``cli status`` contract), a serve ``cache_root``, or a serve
+    work_dir whose ``cache/`` is the root.  Each artifact loads
+    independently; a missing or torn one is simply absent."""
+    from opencompass_tpu.obs import live, reqtrace, timeline
+    art: Dict = {'path': path, 'obs_dir': None, 'serve_obs_dir': None,
+                 'cache_root': None, 'status': None, 'timelines': {},
+                 'events': [], 'requests': [], 'alerts_active': [],
+                 'alerts_recent': [], 'run_marker': None,
+                 'queue_pressure': None}
+    try:
+        art['obs_dir'] = live.resolve_obs_dir(path)
+    except Exception:
+        pass
+    # cache root: the path itself, its cache/ child, or the run's
+    # pre-timestamp work root's cache/ (obs_dir = {base}/{ts}/obs)
+    candidates = [path, osp.join(path, 'cache')]
+    if art['obs_dir']:
+        base = osp.dirname(osp.dirname(osp.abspath(art['obs_dir'])))
+        candidates += [osp.join(base, 'cache'),
+                       osp.join(osp.dirname(base), 'cache')]
+    for cand in candidates:
+        if any(osp.isdir(osp.join(cand, sub))
+               for sub in ('serve', 'store', 'ledger')):
+            art['cache_root'] = osp.abspath(cand)
+            break
+    if art['cache_root']:
+        serve_obs = reqtrace.serve_obs_dir(art['cache_root'])
+        if osp.isdir(serve_obs):
+            art['serve_obs_dir'] = serve_obs
+
+    if art['obs_dir']:
+        try:
+            art['status'] = live.current_status(art['obs_dir'])
+        except Exception:
+            pass
+        try:
+            art['run_marker'] = live.read_run_marker(art['obs_dir'])
+        except Exception:
+            pass
+        try:
+            art['timelines'] = timeline.summarize_timelines(
+                art['obs_dir'])
+        except Exception:
+            pass
+        try:
+            art['events'] = _load_events(
+                osp.join(art['obs_dir'], 'events.jsonl'))
+        except Exception:
+            pass
+    if art['serve_obs_dir']:
+        try:
+            from opencompass_tpu.obs import slo as slomod
+            alerts_path = osp.join(art['serve_obs_dir'],
+                                   slomod.ALERTS_FILE)
+            art['alerts_active'] = slomod.read_active_alerts(alerts_path)
+            art['alerts_recent'] = slomod.tail_alerts(alerts_path, 50)
+        except Exception:
+            pass
+        try:
+            art['requests'] = reqtrace.tail_requests(
+                osp.join(art['serve_obs_dir'], reqtrace.REQUESTS_FILE),
+                max_bytes=4 * 1024 * 1024)
+        except Exception:
+            pass
+    if art['cache_root']:
+        queue_root = osp.join(art['cache_root'], 'serve', 'queue')
+        if osp.isdir(queue_root):
+            try:
+                from opencompass_tpu.serve.queue import SweepQueue
+                art['queue_pressure'] = SweepQueue(queue_root).pressure()
+            except Exception:
+                pass
+    return art
+
+
+def _load_events(path: str) -> List[Dict]:
+    """The run's structured *events* (not spans) — the failure/pressure
+    signals the rules count.  Torn lines skipped."""
+    from opencompass_tpu.utils.fileio import iter_jsonl_records
+    return [r for r in iter_jsonl_records(
+        path, keep=lambda r: r.get('kind') in ('event', 'span_end'))]
+
+
+# -- rules ------------------------------------------------------------------
+
+def _rule_failed_tasks(art: Dict) -> List[Dict]:
+    tasks = (art.get('status') or {}).get('tasks') or {}
+    failed = [(name, row) for name, row in tasks.items()
+              if row.get('state') == 'failed'
+              or (row.get('returncode') not in (None, 0))]
+    if not failed:
+        return []
+    evidence = [f'{name}: state={row.get("state")} '
+                f'returncode={row.get("returncode")}'
+                for name, row in failed]
+    return [_finding(
+        'error', 'failed_tasks',
+        f'{len(failed)} task(s) failed',
+        evidence,
+        fix='inspect the task log under logs/ and the span tree '
+            '(`cli trace <work_dir>`); rerun with `-r <timestamp>` to '
+            'resume — completed rows are served from the result store',
+        data={'failed': [name for name, _ in failed]})]
+
+
+def _rule_worker_instability(art: Dict) -> List[Dict]:
+    counts: Dict[str, int] = {}
+    samples: Dict[str, str] = {}
+    for rec in art.get('events') or []:
+        if rec.get('kind') != 'event':
+            continue
+        name = rec.get('name')
+        if name in ('task_retry', 'task_timeout', 'stall_timeout',
+                    'worker_fallback', 'worker_crash'):
+            counts[name] = counts.get(name, 0) + 1
+            attrs = rec.get('attrs') or {}
+            samples.setdefault(
+                name, f'{name}: {attrs.get("task") or attrs}')
+    if not counts:
+        return []
+    total = sum(counts.values())
+    evidence = [f'{k} x{v}' for k, v in sorted(counts.items())]
+    evidence += [v for v in samples.values()][:3]
+    return [_finding(
+        'warn', 'worker_instability',
+        f'{total} retry/timeout/crash event(s) in the run',
+        evidence,
+        fix='check task_timeout/stall_timeout settings vs real step '
+            'durations; a crash-looping resident worker falls back to '
+            'one-shot subprocesses (slower but correct) — see '
+            'docs/observability.md "Doctor"',
+        data=counts)]
+
+
+def _rule_straggler(art: Dict) -> List[Dict]:
+    tasks = (art.get('status') or {}).get('tasks') or {}
+    walls = [(name, row['wall_seconds']) for name, row in tasks.items()
+             if isinstance(row.get('wall_seconds'), (int, float))]
+    if len(walls) < 3:
+        return []
+    ordered = sorted(w for _, w in walls)
+    median = ordered[len(ordered) // 2]
+    worst_name, worst = max(walls, key=lambda t: t[1])
+    if worst < STRAGGLER_RATIO * max(median, 1e-9) \
+            or worst - median < STRAGGLER_MIN_GAP_S:
+        return []
+    return [_finding(
+        'warn', 'straggler_task',
+        f'{worst_name} ran {worst / max(median, 1e-9):.1f}x the '
+        'median task wall',
+        [f'{worst_name}: {worst:.1f}s vs median {median:.1f}s '
+         f'over {len(walls)} tasks'],
+        fix='length outliers or slot contention: check `cli trace` '
+            'slot-wait and the size partitioner split; long-prompt '
+            'shards benefit from a smaller --max-partition-size',
+        data={'task': worst_name, 'wall_seconds': worst,
+              'median_seconds': median})]
+
+
+def _rule_cold_compile(art: Dict) -> List[Dict]:
+    out = []
+    for task, s in (art.get('timelines') or {}).items():
+        compile_s = s.get('compile_seconds') or 0
+        device_s = s.get('device_seconds') or 0
+        misses = s.get('cc_misses') or 0
+        hits = s.get('cc_hits') or 0
+        if device_s and compile_s / device_s > COMPILE_STORM_FRAC \
+                and misses > hits:
+            out.append(
+                (task, compile_s, device_s, hits, misses))
+    if not out:
+        return []
+    evidence = [f'{task}: compile {c:.1f}s of {d:.1f}s device, '
+                f'cache {h} hit(s)/{m} miss(es)'
+                for task, c, d, h, m in out[:5]]
+    return [_finding(
+        'warn', 'cold_compile_storm',
+        f'{len(out)} task(s) spent >{COMPILE_STORM_FRAC:.0%} of device '
+        'time compiling with a cold cache',
+        evidence,
+        fix='point OCT_COMPILE_CACHE(_ROOT) at persistent storage and '
+            'pre-warm with `cli plan --cache-dir`; the batch planner '
+            'minimizes distinct shapes (docs/user_guides/'
+            'performance.md "Warm path")')]
+
+
+def _rule_pad_collapse(art: Dict) -> List[Dict]:
+    out = []
+    for task, s in (art.get('timelines') or {}).items():
+        eff = s.get('pad_eff')
+        real = (s.get('tokens_in') or 0) + (s.get('tokens_out') or 0)
+        if eff is not None and eff < PAD_COLLAPSE_EFF \
+                and real >= PAD_COLLAPSE_MIN_TOKENS:
+            out.append((task, eff))
+    if not out:
+        return []
+    evidence = [f'{task}: pad_eff {eff:.0%}' for task, eff in out[:5]]
+    return [_finding(
+        'warn', 'pad_collapse',
+        f'{len(out)} task(s) below {PAD_COLLAPSE_EFF:.0%} padding '
+        'efficiency (most device FLOPs hit pad tokens)',
+        evidence,
+        fix='enable the length-aware batch planner (batch_plan=True / '
+            'token_budget) or continuous batching for skewed decode '
+            'lengths (docs/user_guides/performance.md)')]
+
+
+def _rule_kv_pool(art: Dict) -> List[Dict]:
+    pressure_events = [r for r in art.get('events') or []
+                       if r.get('kind') == 'event'
+                       and r.get('name') == 'kv_pool_pressure']
+    overall = ((art.get('status') or {}).get('overall') or {})
+    failed = overall.get('kv_pool_failed_allocs') or 0
+    if not pressure_events and not failed:
+        return []
+    evidence = []
+    if pressure_events:
+        attrs = pressure_events[-1].get('attrs') or {}
+        evidence.append(
+            f'{len(pressure_events)} kv_pool_pressure event(s); last: '
+            f'need {attrs.get("need_pages")} pages, '
+            f'{attrs.get("free_pages")} free of '
+            f'{attrs.get("pool_pages")}')
+    if failed:
+        evidence.append(f'{failed} bounced page allocation(s) '
+                        '(kv_pool_failed_allocs)')
+    return [_finding(
+        'warn', 'kv_pool_pressure',
+        'paged KV pool exhaustion stalled engine admissions',
+        evidence,
+        fix='raise kv_pool_pages (or shrink decode_slots / max_seq_len)'
+            ' — each admission stall serializes rows that could decode '
+            'concurrently (docs/observability.md "KV-pool pressure")')]
+
+
+def _rule_prefill_stall(art: Dict) -> List[Dict]:
+    out = []
+    for task, s in (art.get('timelines') or {}).items():
+        frac = s.get('decode_stall_frac')
+        if frac is not None and frac > PREFILL_STALL_FRAC:
+            out.append((task, frac, s.get('decode_stall_slot_steps')))
+    if not out:
+        return []
+    evidence = [f'{task}: {frac:.0%} of decode-ready slot-steps '
+                f'({steps} slot-step(s)) idled by prefill chunks'
+                for task, frac, steps in out[:5]]
+    return [_finding(
+        'warn', 'prefill_stall',
+        'prefill chunks are stalling decode slots '
+        '(head-of-line blocking in the continuous engine)',
+        evidence,
+        fix='mixed prefill+decode steps (ROADMAP item 1) reclaim these '
+            'slot-steps; until then, smaller kv_page_size prefill '
+            'chunks shorten each stall')]
+
+
+def _rule_gather_waste(art: Dict) -> List[Dict]:
+    out = []
+    for task, s in (art.get('timelines') or {}).items():
+        ratio = s.get('kv_ratio')
+        if ratio is not None and ratio > GATHER_WASTE_RATIO:
+            out.append((task, ratio))
+    if not out:
+        return []
+    evidence = [f'{task}: KV read traffic {ratio:.1f}x the ragged '
+                'ideal' for task, ratio in out[:5]]
+    return [_finding(
+        'info', 'gather_waste',
+        'paged-gather KV reads run far over the ragged-attention ideal',
+        evidence,
+        fix='expected until the Pallas ragged-paged-attention kernel '
+            'lands (ROADMAP item 1); the ratio is the measured payoff '
+            'waiting there')]
+
+
+def _rule_slo_breach(art: Dict) -> List[Dict]:
+    active = art.get('alerts_active') or []
+    if not active:
+        return []
+    phase_note = _attribute_phases(art.get('requests') or [])
+    out = []
+    for alert in active:
+        severity = 'error' if alert.get('severity') == 'page' else 'warn'
+        value = alert.get('value') or {}
+        evidence = [f'rule {alert.get("rule")} firing since '
+                    f'ts={alert.get("ts")}']
+        if value.get('burn_fast') is not None:
+            evidence.append(
+                f'burn {value["burn_fast"]}x (fast) / '
+                f'{value.get("burn_slow")}x (slow) vs factor '
+                f'{value.get("burn_factor")}')
+        if value.get('gauge'):
+            evidence.append(f'{value["gauge"]} = {value.get("value")} '
+                            f'vs bound {value.get("bound")}')
+        if phase_note:
+            evidence.append(phase_note)
+        out.append(_finding(
+            severity, 'slo_breach',
+            f'SLO alert {alert.get("rule")!r} '
+            f'({alert.get("severity")}) is firing',
+            evidence,
+            fix='see `GET /v1/alerts` on the live daemon and the '
+                'phase attribution above: queue-dominated breaches '
+                'need admission control or fleet capacity, '
+                'prefill-dominated ones need prefix caching, '
+                'decode-dominated ones need the engine/kernel work '
+                '(docs/observability.md "SLOs & alerting")',
+            data={'rule': alert.get('rule')}))
+    return out
+
+
+def _attribute_phases(requests: List[Dict]) -> Optional[str]:
+    """Where slow requests spend their time: fold the phase spans of
+    the tail's slowest half against its fastest half and name the
+    dominant phase (queue wait vs prefill vs decode vs store)."""
+    recs = [r for r in requests
+            if isinstance(r.get('wall_s'), (int, float))
+            and r.get('phases')]
+    if len(recs) < 4:
+        return None
+    walls = sorted(r['wall_s'] for r in recs)
+    median = walls[len(walls) // 2]
+    slow = [r for r in recs
+            if r['wall_s'] > SLOW_REQUEST_FACTOR * max(median, 1e-9)]
+    if not slow:
+        slow = sorted(recs, key=lambda r: -r['wall_s'])[
+            :max(len(recs) // 4, 1)]
+    buckets = {'queue': 0.0, 'prefill': 0.0, 'decode': 0.0,
+               'store': 0.0, 'other': 0.0}
+    for r in slow:
+        usage = r.get('usage') or {}
+        prefill_tok = usage.get('prefill_tokens') or 0
+        decode_tok = usage.get('decode_tokens') or 0
+        ttft = r.get('ttft_s')
+        for span in r.get('phases') or []:
+            dur = span.get('dur_s') or 0.0
+            name = span.get('name')
+            if name in ('parse', 'lease_wait', 'worker_protocol'):
+                buckets['queue'] += dur
+            elif name in ('store_lookup', 'store_commit'):
+                buckets['store'] += dur
+            elif name == 'model_forward':
+                # split the forward between prefill and decode: by the
+                # measured TTFT share when available, by token counts
+                # otherwise
+                if ttft is not None and dur > 0:
+                    share = min(max(ttft / max(r['wall_s'], 1e-9), 0.0),
+                                1.0)
+                elif prefill_tok + decode_tok:
+                    share = prefill_tok / (prefill_tok + decode_tok)
+                else:
+                    share = 0.5
+                buckets['prefill'] += dur * share
+                buckets['decode'] += dur * (1.0 - share)
+            else:
+                buckets['other'] += dur
+    total = sum(buckets.values())
+    if total <= 0:
+        return None
+    dominant = max(buckets, key=buckets.get)
+    shares = ', '.join(f'{k} {v / total:.0%}'
+                       for k, v in sorted(buckets.items(),
+                                          key=lambda kv: -kv[1])
+                       if v > 0)
+    return (f'slow requests ({len(slow)} of {len(recs)} in the tail) '
+            f'spend their time in: {shares} — dominated by {dominant}')
+
+
+def _rule_dead_run(art: Dict) -> List[Dict]:
+    marker = art.get('run_marker') or {}
+    if marker.get('state') != 'running':
+        return []
+    pid = marker.get('pid')
+    try:
+        from opencompass_tpu.obs.live import _pid_alive
+        alive = _pid_alive(pid)
+    except Exception:
+        alive = True
+    if alive:
+        return []
+    return [_finding(
+        'info', 'dead_run',
+        f'run marker says running but driver pid {pid} is gone '
+        '(killed mid-flight)',
+        [f'obs/run.json: state=running pid={pid}'],
+        fix='resume with `-r <timestamp>` — the result store replays '
+            'committed rows, only missing ones recompute')]
+
+
+def _rule_queue_backlog(art: Dict) -> List[Dict]:
+    pressure = art.get('queue_pressure') or {}
+    counts = pressure.get('counts') or {}
+    age = pressure.get('oldest_queued_age_seconds')
+    if not counts.get('queued') or age is None \
+            or age < QUEUE_BACKLOG_AGE_S:
+        return []
+    return [_finding(
+        'warn', 'queue_backlog',
+        f'{counts["queued"]} sweep(s) queued, oldest waiting '
+        f'{age:.0f}s',
+        [f'queued={counts.get("queued")} running='
+         f'{counts.get("running")} oldest_age={age:.0f}s'],
+        fix='the daemon drains one sweep at a time; a dead daemon '
+            'leaves the queue parked — check `cli top <cache_root>` '
+            'and restart `cli serve` (recovery re-claims stale sweeps)')]
+
+
+RULES: List[Callable[[Dict], List[Dict]]] = [
+    _rule_failed_tasks,
+    _rule_slo_breach,
+    _rule_worker_instability,
+    _rule_straggler,
+    _rule_cold_compile,
+    _rule_pad_collapse,
+    _rule_kv_pool,
+    _rule_prefill_stall,
+    _rule_gather_waste,
+    _rule_queue_backlog,
+    _rule_dead_run,
+]
+
+
+def diagnose(path: str) -> Dict:
+    """Collect artifacts, run every rule, rank the findings.  The
+    versioned report dict ``--json`` emits."""
+    art = collect(path)
+    findings: List[Dict] = []
+    for rule in RULES:
+        try:
+            findings.extend(rule(art))
+        except Exception:
+            continue   # a torn artifact costs a finding, not the run
+    rank = {s: i for i, s in enumerate(SEVERITIES)}
+    findings.sort(key=lambda f: rank.get(f['severity'], 99))
+    return {
+        'v': DOCTOR_VERSION,
+        'path': osp.abspath(path),
+        'sources': {
+            'obs_dir': art.get('obs_dir'),
+            'serve_obs_dir': art.get('serve_obs_dir'),
+            'cache_root': art.get('cache_root'),
+        },
+        'counts': {s: sum(1 for f in findings if f['severity'] == s)
+                   for s in SEVERITIES},
+        'findings': findings,
+    }
+
+
+def render(report: Dict) -> str:
+    lines = [f"== doctor: {report['path']} =="]
+    src = report['sources']
+    lines.append('sources: '
+                 f"obs={src.get('obs_dir') or '-'}  "
+                 f"serve={src.get('serve_obs_dir') or '-'}  "
+                 f"cache={src.get('cache_root') or '-'}")
+    findings = report['findings']
+    if not findings:
+        lines.append('no findings — run looks healthy')
+        return '\n'.join(lines) + '\n'
+    c = report['counts']
+    lines.append(f"{len(findings)} finding(s): {c['error']} error, "
+                 f"{c['warn']} warn, {c['info']} info")
+    for f in findings:
+        lines.append('')
+        lines.append(f"[{f['severity'].upper()}] {f['rule']} — "
+                     f"{f['title']}")
+        for ev in f.get('evidence') or []:
+            lines.append(f'    - {ev}')
+        if f.get('fix'):
+            lines.append(f"    fix: {f['fix']}")
+    return '\n'.join(lines) + '\n'
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m opencompass_tpu.cli doctor <work_dir|cache_root>``
+    body.  Exit codes: 0 healthy (or warnings only), 2 when any
+    error-severity finding is present AND ``--check`` was passed, 1 on
+    unusable input."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog='doctor',
+        description='Auto-triage a run or serve cache root: join '
+        'spans, timelines, heartbeats, request records, alerts and '
+        'the queue into ranked findings with evidence + remediation')
+    parser.add_argument('root', help='run work_dir (or its obs/ dir, '
+                        'a parent outputs dir) or a serve cache_root')
+    parser.add_argument('--json', action='store_true',
+                        help='emit the versioned findings report as '
+                        'JSON')
+    parser.add_argument('--check', action='store_true',
+                        help='CI gate: exit 2 when any error-severity '
+                        'finding is present (0 otherwise)')
+    args = parser.parse_args(argv)
+
+    report = diagnose(args.root)
+    src = report['sources']
+    if not any(src.values()):
+        print(f'no telemetry under {args.root!r} — expected a run '
+              'work_dir (obs/) or a serve cache root (serve/obs/)')
+        return 1
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        print(render(report), end='')
+    if args.check and report['counts']['error']:
+        return 2
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

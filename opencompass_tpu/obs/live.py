@@ -475,6 +475,7 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
             last_batch_seconds=rec.get('last_batch_seconds'),
             pad_eff=rec.get('pad_eff'),
             decode_slot_util=rec.get('decode_slot_util'),
+            decode_stall_frac=rec.get('decode_stall_frac'),
             # roofline + KV-pool gauges (engine/batch-recorder notes)
             mfu=rec.get('mfu'),
             mbu=rec.get('mbu'),
@@ -548,6 +549,7 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
     st_hits = st_misses = 0
     pad_effs = []
     slot_utils = []
+    stall_fracs = []
     mfus, mbus = [], []
     pool_used, pool_high = [], []
     pool_failed = 0
@@ -568,6 +570,8 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
             pad_effs.append(row['pad_eff'])
         if row.get('decode_slot_util') is not None:
             slot_utils.append(row['decode_slot_util'])
+        if row.get('decode_stall_frac') is not None:
+            stall_fracs.append(row['decode_stall_frac'])
         if row.get('mfu') is not None:
             mfus.append(row['mfu'])
         if row.get('mbu') is not None:
@@ -593,6 +597,11 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
         # fraction of decode-step slots holding live sequences
         'decode_slot_util': round(sum(slot_utils) / len(slot_utils), 4)
         if slot_utils else None,
+        # fraction of decode-ready slot-steps idled by prefill chunks
+        # (engine head-of-line blocking; worst task wins — one stalled
+        # engine is the problem regardless of its quiet siblings)
+        'decode_stall_frac': round(max(stall_fracs), 4)
+        if stall_fracs else None,
         # roofline utilizations (obs/costmodel.py): mean over tasks
         # reporting them — how close to the hardware ceiling the run
         # is executing right now

@@ -167,7 +167,7 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
     # live-plane surfacing of the planner/store efficiency signals
     # (they existed only in perf records + trace report before)
     for key in ('cached_progress', 'store_hit_rate', 'pad_eff',
-                'decode_slot_util', 'mfu', 'mbu'):
+                'decode_slot_util', 'decode_stall_frac', 'mfu', 'mbu'):
         if o.get(key) is not None:
             out.append(f'# TYPE {prefix}_run_{key} gauge')
             out.append(_line(f'{prefix}_run_{key}', o[key]))
@@ -226,6 +226,7 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
         ('task_last_batch_seconds', 'last_batch_seconds'),
         ('task_pad_eff', 'pad_eff'),
         ('task_decode_slot_util', 'decode_slot_util'),
+        ('task_decode_stall_frac', 'decode_stall_frac'),
         ('task_mfu', 'mfu'),
         ('task_mbu', 'mbu'),
         ('task_kv_pool_used_frac', 'kv_pool_used_frac'),

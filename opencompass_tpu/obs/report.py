@@ -536,8 +536,9 @@ def render_report(report: Dict) -> str:
     if tl:
         out.append('\n-- flight recorder (per-batch timelines) --')
         rows = [['task', 'kind', 'batches', 'rows', 'tok/s', 'duty',
-                 'pad_eff', 'slot_util', 'pre/dec_tok', 'disp/fetch_s',
-                 'cached', 'tok/s over batches']]
+                 'pad_eff', 'slot_util', 'stall', 'itl_p99',
+                 'pre/dec_tok', 'disp/fetch_s', 'cached',
+                 'tok/s over batches']]
         for name in sorted(tl):
             s = tl[name]
             predec = '-'
@@ -566,6 +567,13 @@ def render_report(report: Dict) -> str:
                 # records); '-' for fixed-shape tasks
                 f"{s['slot_util']:.0%}"
                 if s.get('slot_util') is not None else '-',
+                # prefill head-of-line blocking: fraction of decode-
+                # ready slot-steps idled by prefill chunks (per-step
+                # engine records), and the measured inter-token p99
+                f"{s['decode_stall_frac']:.0%}"
+                if s.get('decode_stall_frac') is not None else '-',
+                f"{s['itl_p99_ms']:.1f}ms"
+                if s.get('itl_p99_ms') is not None else '-',
                 predec, df, s.get('cached_rows', 0), spark])
         out.append(_table(rows))
 

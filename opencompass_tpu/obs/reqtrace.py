@@ -64,6 +64,54 @@ REQUESTS_FILE = 'requests.jsonl'
 ACCESS_FILE = 'access.jsonl'
 ENGINE_INFO_FILE = 'engine.json'
 
+# -- size-capped rotation ---------------------------------------------------
+# A long-lived daemon appends to requests.jsonl / access.jsonl /
+# alerts.jsonl forever; without a cap they eventually fill the disk.
+# Budget per file via OCT_REQTRACE_MAX_BYTES (total across the live
+# file and its one rolled segment).  When the live file crosses half
+# the budget it is renamed to `<name>.1`, evicting the previous `.1`
+# (oldest-segment eviction, the store GC's policy) — so on-disk usage
+# stays <= max_bytes per file and the newest half-budget of records is
+# always intact.  Renames are atomic; appenders reopen per write
+# (O_APPEND path in utils.fileio), so a post-rotation append starts
+# the fresh live file without coordination.
+
+REQTRACE_MAX_BYTES_ENV = 'OCT_REQTRACE_MAX_BYTES'
+DEFAULT_REQTRACE_MAX_BYTES = 256 * 1024 * 1024
+_ROTATE_LOCK = threading.Lock()
+
+
+def reqtrace_max_bytes() -> int:
+    try:
+        raw = int(os.environ.get(REQTRACE_MAX_BYTES_ENV) or 0)
+    except (TypeError, ValueError):
+        raw = 0
+    return raw if raw > 0 else DEFAULT_REQTRACE_MAX_BYTES
+
+
+def rotate_if_oversize(path: str,
+                       max_bytes: Optional[int] = None) -> bool:
+    """Roll ``path`` to ``path.1`` (replacing the previous segment)
+    when it exceeds half the budget.  Returns True when a rotation
+    happened.  Never raises — rotation is telemetry upkeep."""
+    limit = (max_bytes if max_bytes is not None
+             else reqtrace_max_bytes()) // 2
+    try:
+        if os.path.getsize(path) <= limit:
+            return False
+    except OSError:
+        return False
+    with _ROTATE_LOCK:
+        try:
+            # re-check under the lock: a racing writer thread may have
+            # rotated while we waited
+            if os.path.getsize(path) <= limit:
+                return False
+            os.replace(path, path + '.1')
+            return True
+        except OSError:
+            return False
+
 _ID_RE = re.compile(r'^[A-Za-z0-9._\-]{1,128}$')
 
 
@@ -167,6 +215,7 @@ class RequestRecorder:
 
     def record(self, rec: Dict):
         try:
+            rotate_if_oversize(self.path)
             append_jsonl_atomic(self.path,
                                 [{'v': REQTRACE_VERSION, **rec}])
         except Exception:
@@ -236,6 +285,7 @@ class AccessLog:
 
     def write(self, rec: Dict):
         try:
+            rotate_if_oversize(self.path)
             append_jsonl_atomic(self.path,
                                 [{'v': REQTRACE_VERSION, **rec}])
         except Exception:
@@ -285,7 +335,8 @@ class RollingStats:
                           ok: bool = True, store_hits: int = 0,
                           device_rows: int = 0,
                           ts: Optional[float] = None,
-                          mbu: Optional[float] = None):
+                          mbu: Optional[float] = None,
+                          itl_ms: Optional[List[float]] = None):
         try:
             with self._lock:
                 self._completions.append(
@@ -293,12 +344,34 @@ class RollingStats:
                      float(latency_s),
                      float(ttft_s) if ttft_s is not None else None,
                      bool(ok), int(store_hits), int(device_rows),
-                     float(mbu) if mbu is not None else None))
+                     float(mbu) if mbu is not None else None,
+                     # per-request inter-token-latency samples (engine
+                     # path; already downsampled by the producer) —
+                     # pooled across the window so the per-model
+                     # itl_p50/p99 are true percentiles over tokens,
+                     # not percentiles of per-request percentiles
+                     [float(v) for v in itl_ms] if itl_ms else None))
         except Exception:
             pass
 
+    def completion_samples(self, window_s: float,
+                           now: Optional[float] = None) -> List[Dict]:
+        """The raw completion samples newer than the window, as dicts —
+        the SLO evaluator's feed (``obs/slo.py``).  The deque bound
+        (default 4096) caps how much of a long slow window survives
+        under heavy traffic; the durable history is requests.jsonl."""
+        now = time.time() if now is None else now
+        cutoff = now - window_s
+        with self._lock:
+            samples = [s for s in self._completions if s[0] >= cutoff]
+        return [{'ts': s[0], 'model': s[1], 'latency_s': s[2],
+                 'ttft_s': s[3], 'ok': s[4]} for s in samples]
+
     @staticmethod
     def _latency_summary(lat_s: List[float]) -> Dict:
+        if not lat_s:   # empty window: explicit nulls, never a crash
+            return {'count': 0, 'p50_ms': None, 'p95_ms': None,
+                    'p99_ms': None}
         return {
             'count': len(lat_s),
             'p50_ms': round(percentile(lat_s, 0.50) * 1e3, 3),
@@ -351,6 +424,15 @@ class RollingStats:
                     if len(s) > 7 and s[7] is not None]
             if mbus:
                 row['mbu_mean'] = round(sum(mbus) / len(mbus), 6)
+            # inter-token latency pooled over every engine-served
+            # request in the window (next to TTFT: TTFT is the prefill
+            # cost, ITL is the steady decode cadence — the pair the
+            # prefill/decode cost split says to watch separately)
+            itls = [v for s in samples if len(s) > 8 and s[8]
+                    for v in s[8]]
+            if itls:
+                row['itl_p50_ms'] = round(percentile(itls, 0.50), 3)
+                row['itl_p99_ms'] = round(percentile(itls, 0.99), 3)
             models[model] = row
 
         comp_lat = [s[2] for s in comps]

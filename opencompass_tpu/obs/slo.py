@@ -1,0 +1,532 @@
+"""Declarative SLOs with multi-window burn-rate alerting.
+
+The telemetry plane records everything — request records, rolling
+percentiles, roofline gauges — but interprets nothing: ``/v1/stats``
+has a p99, not an *objective*, and an operator watching ``cli top``
+has to decide for themselves whether 800 ms is fine.  This module is
+the interpretation layer: a config-loadable set of **objectives**
+(:class:`SLO`) evaluated continuously by the serve daemon
+(:class:`SLOEvaluator`), with SRE-style multi-window burn-rate rules
+deciding when an objective is *burning its error budget fast enough to
+wake someone up*.
+
+Two rule families:
+
+- **ratio SLOs** (``availability``, ``latency``, ``ttft``): every
+  completion sample is good or bad (errored; over the latency
+  objective; over the TTFT objective).  With target ``t`` the error
+  budget is ``1 - t``; the **burn rate** of a window is
+  ``bad_fraction / (1 - t)`` — 1.0 means "spending budget exactly as
+  fast as the SLO allows", N means N× too fast.  A rule fires when
+  BOTH the fast window (default 5 m — catches the spike) and the slow
+  window (default 1 h — proves it is not a blip) burn at ≥
+  ``burn_factor``, and resolves when the fast window recovers.  The
+  two-window AND is the standard SRE construction: fast-only pages on
+  noise, slow-only pages an hour late.
+- **gauge SLOs** (``gauge_max``, ``gauge_min``): an instantaneous
+  signal (queue oldest-age, MFU/MBU floor) breaching its bound for a
+  sustained ``for_s`` seconds fires; returning within bounds resolves.
+
+Firing/resolving transitions are appended to a durable
+``{cache_root}/serve/obs/alerts.jsonl`` (single-``os.write`` O_APPEND
++ torn-line recovery — the store's discipline, via
+``utils.fileio.append_jsonl_atomic``), size-capped by the same
+rotation budget as ``requests.jsonl``.  The active set is served on
+``GET /v1/alerts``, exported as ``oct_alert_active{rule,severity}`` /
+``oct_slo_budget_remaining{slo}`` on ``/metrics``, rendered as an
+alert pane in ``cli top`` (live from the endpoint, or folded from the
+alerts.jsonl tail against a dead daemon), and listed as degradation on
+``/healthz``.
+
+Everything takes an explicit ``now`` so the burn-rate math is
+deterministic under an injected clock (no wall-time sleeps in tests).
+Evaluation is telemetry: it must never fail the daemon — the evaluator
+is exception-guarded at the sink edges, and a malformed SLO spec fails
+at **load** time, not at 3 a.m.
+"""
+from __future__ import annotations
+
+import os.path as osp
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from opencompass_tpu.utils.fileio import (append_jsonl_atomic,
+                                          iter_jsonl_records)
+
+SLO_VERSION = 1
+ALERTS_FILE = 'alerts.jsonl'
+
+RATIO_KINDS = ('availability', 'latency', 'ttft')
+GAUGE_KINDS = ('gauge_max', 'gauge_min')
+
+DEFAULT_FAST_S = 300.0
+DEFAULT_SLOW_S = 3600.0
+DEFAULT_BURN_FACTOR = 6.0
+DEFAULT_MIN_SAMPLES = 3
+
+
+class SLO:
+    """One declarative objective.
+
+    Args:
+        name: rule identifier (label value on ``/metrics``; keep it
+            short and stable).
+        kind: ``availability`` (sample bad = errored), ``latency`` /
+            ``ttft`` (bad = over ``objective_ms``), or ``gauge_max`` /
+            ``gauge_min`` (instantaneous ``gauge`` vs ``bound``).
+        target: ratio kinds — fraction of samples that must be good
+            (error budget = ``1 - target``).
+        objective_ms: latency/ttft threshold a sample must beat.
+        gauge: gauge kinds — key into the evaluator's gauges dict
+            (e.g. ``queue_oldest_age_seconds``, ``mbu``).
+        bound: gauge kinds — the limit (max or min by kind).
+        for_s: gauge kinds — breach must persist this long to fire.
+        fast_s / slow_s / burn_factor / min_samples: burn-rate rule
+            geometry (see module docstring).  ``min_samples`` keeps an
+            idle daemon's single unlucky request from paging.
+        severity: ``page`` (listed as degradation on ``/healthz``) or
+            ``ticket``.
+        model: optional — restrict latency/ttft samples to one catalog
+            model (None = all completions).
+    """
+
+    def __init__(self, name: str, kind: str, *, target: float = 0.99,
+                 objective_ms: Optional[float] = None,
+                 gauge: Optional[str] = None,
+                 bound: Optional[float] = None,
+                 for_s: float = 60.0,
+                 fast_s: float = DEFAULT_FAST_S,
+                 slow_s: float = DEFAULT_SLOW_S,
+                 burn_factor: float = DEFAULT_BURN_FACTOR,
+                 min_samples: int = DEFAULT_MIN_SAMPLES,
+                 severity: str = 'page',
+                 model: Optional[str] = None):
+        if kind not in RATIO_KINDS + GAUGE_KINDS:
+            raise ValueError(f'unknown SLO kind {kind!r}; expected one '
+                             f'of {RATIO_KINDS + GAUGE_KINDS}')
+        if kind in ('latency', 'ttft') and not objective_ms:
+            raise ValueError(f'SLO {name!r}: kind {kind!r} needs '
+                             'objective_ms')
+        if kind in GAUGE_KINDS and (not gauge or bound is None):
+            raise ValueError(f'SLO {name!r}: kind {kind!r} needs '
+                             'gauge and bound')
+        if not 0.0 < target < 1.0 and kind in RATIO_KINDS:
+            raise ValueError(f'SLO {name!r}: target must be in (0, 1)')
+        if severity not in ('page', 'ticket'):
+            raise ValueError(f'SLO {name!r}: severity must be '
+                             "'page' or 'ticket'")
+        self.name = str(name)
+        self.kind = kind
+        self.target = float(target)
+        self.objective_ms = float(objective_ms) if objective_ms else None
+        self.gauge = gauge
+        self.bound = float(bound) if bound is not None else None
+        self.for_s = float(for_s)
+        self.fast_s = float(fast_s)
+        self.slow_s = float(slow_s)
+        self.burn_factor = float(burn_factor)
+        self.min_samples = max(int(min_samples), 1)
+        self.severity = severity
+        self.model = model
+
+    def spec(self) -> Dict:
+        """The JSON-safe definition (``/v1/alerts`` echoes it so an
+        operator can read the rule without the config file)."""
+        out = {'name': self.name, 'kind': self.kind,
+               'severity': self.severity}
+        if self.kind in RATIO_KINDS:
+            out.update(target=self.target, fast_s=self.fast_s,
+                       slow_s=self.slow_s, burn_factor=self.burn_factor)
+            if self.objective_ms is not None:
+                out['objective_ms'] = self.objective_ms
+            if self.model:
+                out['model'] = self.model
+        else:
+            out.update(gauge=self.gauge, bound=self.bound,
+                       for_s=self.for_s)
+        return out
+
+    # -- sample classification (ratio kinds) -------------------------------
+
+    def _bad(self, sample: Dict) -> Optional[bool]:
+        """True/False verdict for one completion sample; None when the
+        sample does not participate in this SLO (no TTFT measured,
+        other model)."""
+        if self.model and sample.get('model') != self.model:
+            return None
+        if self.kind == 'availability':
+            return not sample.get('ok', True)
+        if self.kind == 'latency':
+            lat = sample.get('latency_s')
+            if lat is None:
+                return None
+            return lat * 1e3 > self.objective_ms
+        # ttft
+        ttft = sample.get('ttft_s')
+        if ttft is None:
+            return None
+        return ttft * 1e3 > self.objective_ms
+
+    def window_burn(self, samples: Sequence[Dict], window_s: float,
+                    now: float) -> Optional[Dict]:
+        """``{'burn': r, 'bad': n, 'total': m}`` for the samples inside
+        ``[now - window_s, now]``; None below ``min_samples`` (no
+        verdict without data)."""
+        cutoff = now - window_s
+        bad = total = 0
+        for sample in samples:
+            if (sample.get('ts') or 0) < cutoff:
+                continue
+            verdict = self._bad(sample)
+            if verdict is None:
+                continue
+            total += 1
+            bad += bool(verdict)
+        if total < self.min_samples:
+            return None
+        frac = bad / total
+        return {'burn': round(frac / max(1.0 - self.target, 1e-9), 3),
+                'bad': bad, 'total': total,
+                'bad_frac': round(frac, 4)}
+
+
+def default_slos() -> List[SLO]:
+    """The objectives a daemon evaluates when the serve config declares
+    none.  Deliberately loose — defaults must page on *broken*, not on
+    *unconfigured*."""
+    return [
+        SLO('availability', 'availability', target=0.99,
+            severity='page'),
+        SLO('completion_p99', 'latency', objective_ms=30_000.0,
+            target=0.99, severity='page'),
+        SLO('ttft_p95', 'ttft', objective_ms=10_000.0, target=0.95,
+            severity='ticket'),
+        SLO('queue_oldest_age', 'gauge_max',
+            gauge='queue_oldest_age_seconds', bound=600.0, for_s=120.0,
+            severity='ticket'),
+    ]
+
+
+def load_slos(spec) -> List[SLO]:
+    """SLO list from a serve config's ``slos = [...]`` (list of kwarg
+    dicts); None/empty → :func:`default_slos`.  Malformed entries raise
+    ``ValueError`` at load time — a daemon must not come up with a
+    silently-dropped objective."""
+    if not spec:
+        return default_slos()
+    out = []
+    for entry in spec:
+        if not isinstance(entry, dict):
+            raise ValueError(f'slos entries must be dicts, got '
+                             f'{type(entry).__name__}')
+        kwargs = dict(entry)
+        name = kwargs.pop('name', None)
+        kind = kwargs.pop('kind', None)
+        if not name or not kind:
+            raise ValueError(f'slos entry needs name and kind: {entry}')
+        out.append(SLO(name, kind, **kwargs))
+    names = [s.name for s in out]
+    if len(set(names)) != len(names):
+        raise ValueError(f'duplicate SLO names: {sorted(names)}')
+    return out
+
+
+# -- durable alert log ------------------------------------------------------
+
+class AlertLog:
+    """Fire/resolve transitions appended to ``alerts.jsonl`` (rotation
+    + torn-line discipline shared with the request records).  Never
+    raises."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def _reseal(self):
+        """Cap an unterminated tail (daemon killed mid-append) with a
+        newline so this append starts a fresh line instead of being
+        absorbed into the torn one — the queue journal's discipline.
+        Transitions are rare and each one matters; requests.jsonl
+        skips this (losing one post-crash record there is within its
+        documented contract)."""
+        import os
+        try:
+            with open(self.path, 'rb') as f:
+                f.seek(-1, os.SEEK_END)
+                torn = f.read(1) != b'\n'
+            if torn:
+                with open(self.path, 'ab') as f:
+                    f.write(b'\n')
+        except (OSError, ValueError):
+            pass   # missing or empty file: nothing to seal
+
+    def write(self, transitions: Sequence[Dict]):
+        if not transitions:
+            return
+        try:
+            from opencompass_tpu.obs.reqtrace import rotate_if_oversize
+            rotate_if_oversize(self.path)
+            self._reseal()
+            append_jsonl_atomic(
+                self.path,
+                [{'v': SLO_VERSION, **t} for t in transitions])
+        except Exception:
+            pass
+
+
+def iter_alerts(path: str):
+    """Parseable alert transitions; torn/garbage lines skipped (store
+    recovery contract)."""
+    return iter_jsonl_records(
+        path, keep=lambda r: r.get('v') == SLO_VERSION
+        and r.get('t') in ('fire', 'resolve'))
+
+
+def fold_alerts(transitions) -> List[Dict]:
+    """Fire/resolve stream → the currently-firing set (newest state per
+    rule wins) — how ``cli top`` reconstructs the alert pane from files
+    against a dead daemon."""
+    state: Dict[str, Dict] = {}
+    for rec in transitions:
+        rule = rec.get('rule')
+        if not rule:
+            continue
+        if rec.get('t') == 'fire':
+            state[rule] = rec
+        else:
+            state.pop(rule, None)
+    return sorted(state.values(), key=lambda r: r.get('ts') or 0)
+
+
+def read_active_alerts(path: str) -> List[Dict]:
+    """Active alerts folded from the durable log.  A rotated log can
+    lose a fire record's segment; folding both segments (oldest first)
+    keeps the reconstruction exact across one rotation."""
+    transitions: List[Dict] = []
+    for candidate in (path + '.1', path):
+        transitions.extend(iter_alerts(candidate))
+    transitions.sort(key=lambda r: r.get('ts') or 0)
+    return fold_alerts(transitions)
+
+
+def tail_alerts(path: str, limit: int = 20) -> List[Dict]:
+    """The newest ``limit`` transitions (both segments), oldest first —
+    the ``/v1/alerts`` ``recent`` block and the dead-daemon pane."""
+    transitions: List[Dict] = []
+    for candidate in (path + '.1', path):
+        transitions.extend(iter_alerts(candidate))
+    transitions.sort(key=lambda r: r.get('ts') or 0)
+    return transitions[-limit:]
+
+
+# -- evaluator --------------------------------------------------------------
+
+class _RuleState:
+    __slots__ = ('firing', 'fired_ts', 'breach_since', 'last')
+
+    def __init__(self):
+        self.firing = False
+        self.fired_ts: Optional[float] = None
+        self.breach_since: Optional[float] = None
+        self.last: Dict = {}
+
+
+class SLOEvaluator:
+    """Continuous evaluation of a rule set against the rolling sample
+    window + instantaneous gauges.
+
+    One instance per daemon; :meth:`evaluate` is called on a cadence
+    (the daemon's SLO loop) with the completion samples covering at
+    least the slowest window and the current gauge dict.  State
+    transitions append to the durable log and update the metrics
+    registry; the in-memory active set feeds ``/v1/alerts`` and the
+    ``/healthz`` degradation list.  Thread-safe: the HTTP threads read
+    snapshots under the same lock the evaluation loop writes under.
+    """
+
+    def __init__(self, slos: Sequence[SLO],
+                 alert_path: Optional[str] = None, registry=None):
+        self.slos = list(slos)
+        self.log = AlertLog(alert_path) if alert_path else None
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._state: Dict[str, _RuleState] = {
+            s.name: _RuleState() for s in self.slos}
+
+    @property
+    def max_window_s(self) -> float:
+        """How much sample history one evaluation needs."""
+        return max([s.slow_s for s in self.slos
+                    if s.kind in RATIO_KINDS] or [DEFAULT_SLOW_S])
+
+    def evaluate(self, samples: Sequence[Dict],
+                 gauges: Optional[Dict] = None,
+                 now: Optional[float] = None) -> List[Dict]:
+        """One evaluation round; returns the transitions it appended
+        (``[]`` when nothing changed).  ``samples``: completion dicts
+        with ``ts``/``ok``/``latency_s``/``ttft_s``/``model``;
+        ``gauges``: instantaneous values by name; ``now``: injected
+        clock (tests) or wall time."""
+        now = time.time() if now is None else float(now)
+        gauges = gauges or {}
+        transitions: List[Dict] = []
+        with self._lock:
+            for slo in self.slos:
+                st = self._state[slo.name]
+                if slo.kind in RATIO_KINDS:
+                    self._eval_ratio(slo, st, samples, now, transitions)
+                else:
+                    self._eval_gauge(slo, st, gauges, now, transitions)
+        if self.log is not None:
+            self.log.write(transitions)
+        self._publish_metrics()
+        return transitions
+
+    def _eval_ratio(self, slo: SLO, st: _RuleState,
+                    samples: Sequence[Dict], now: float,
+                    transitions: List[Dict]):
+        fast = slo.window_burn(samples, slo.fast_s, now)
+        slow = slo.window_burn(samples, slo.slow_s, now)
+        burn_fast = fast['burn'] if fast else None
+        burn_slow = slow['burn'] if slow else None
+        budget = None
+        if slow is not None:
+            # fraction of the slow window's error budget unspent: 1.0
+            # with a clean window, 0.0 at/after exhaustion
+            budget = round(max(0.0, 1.0 - slow['bad_frac']
+                               / max(1.0 - slo.target, 1e-9)), 4)
+        st.last = {'burn_fast': burn_fast, 'burn_slow': burn_slow,
+                   'budget_remaining': budget,
+                   'samples_fast': fast['total'] if fast else 0,
+                   'samples_slow': slow['total'] if slow else 0}
+        value = {'burn_fast': burn_fast, 'burn_slow': burn_slow,
+                 'burn_factor': slo.burn_factor,
+                 'bad_fast': fast['bad'] if fast else None,
+                 'total_fast': fast['total'] if fast else None}
+        if not st.firing:
+            if burn_fast is not None and burn_slow is not None \
+                    and burn_fast >= slo.burn_factor \
+                    and burn_slow >= slo.burn_factor:
+                st.firing, st.fired_ts = True, now
+                transitions.append(self._transition(
+                    'fire', slo, now, value))
+        else:
+            # resolve only on MEASURED fast-window recovery: the slow
+            # window keeps the stale breach for up to slow_s (holding
+            # the page that long teaches operators to ignore it), but
+            # an EMPTY fast window is absence of data, not health —
+            # traffic may have stopped *because* of the incident (a
+            # load balancer reading /healthz degraded, clients backing
+            # off), and un-paging on silence would flap the alert
+            # through every outage.  The alert holds until samples
+            # return and genuinely recover.
+            if burn_fast is not None and burn_fast < slo.burn_factor:
+                transitions.append(self._transition(
+                    'resolve', slo, now, value,
+                    duration_s=round(now - (st.fired_ts or now), 3)))
+                st.firing, st.fired_ts = False, None
+
+    def _eval_gauge(self, slo: SLO, st: _RuleState, gauges: Dict,
+                    now: float, transitions: List[Dict]):
+        value = gauges.get(slo.gauge)
+        if value is None:
+            # gauge outage (the provider raised / the signal has no
+            # reporter yet): hold ALL state — neither resolving a
+            # firing rule nor resetting its for_s breach timer.  One
+            # failed pressure() call must not un-page a real backlog
+            # and force it to re-sustain the full for_s.
+            st.last = {'gauge': slo.gauge, 'value': None,
+                       'bound': slo.bound, 'breaching': None,
+                       'budget_remaining': None}
+            return
+        breach = (value > slo.bound if slo.kind == 'gauge_max'
+                  else value < slo.bound)
+        st.last = {'gauge': slo.gauge, 'value': value,
+                   'bound': slo.bound, 'breaching': breach,
+                   'budget_remaining': 0.0 if breach else 1.0}
+        detail = {'gauge': slo.gauge, 'value': value,
+                  'bound': slo.bound, 'for_s': slo.for_s}
+        if breach:
+            if st.breach_since is None:
+                st.breach_since = now
+            if not st.firing and now - st.breach_since >= slo.for_s:
+                st.firing, st.fired_ts = True, now
+                transitions.append(self._transition(
+                    'fire', slo, now, detail))
+        else:
+            st.breach_since = None
+            if st.firing:
+                transitions.append(self._transition(
+                    'resolve', slo, now, detail,
+                    duration_s=round(now - (st.fired_ts or now), 3)))
+                st.firing, st.fired_ts = False, None
+
+    @staticmethod
+    def _transition(t: str, slo: SLO, now: float, value: Dict,
+                    **extra) -> Dict:
+        return {'t': t, 'ts': round(now, 3), 'rule': slo.name,
+                'kind': slo.kind, 'severity': slo.severity,
+                'value': value, **extra}
+
+    def _publish_metrics(self):
+        """``oct_alert_active{rule,severity}`` (1 firing / 0 not) and
+        ``oct_slo_budget_remaining{slo}`` into the registry.  Cardinality
+        is bounded by the rule set, so resolved rules keep their series
+        at 0 instead of disappearing (a vanishing series reads as
+        'scrape broke', not 'alert cleared')."""
+        if self.registry is None:
+            return
+        try:
+            from opencompass_tpu.obs.metrics import labeled
+            with self._lock:
+                for slo in self.slos:
+                    st = self._state[slo.name]
+                    self.registry.gauge(labeled(
+                        'alert.active', rule=slo.name,
+                        severity=slo.severity)).set(
+                            1 if st.firing else 0)
+                    budget = st.last.get('budget_remaining')
+                    if budget is not None:
+                        self.registry.gauge(labeled(
+                            'slo.budget_remaining',
+                            slo=slo.name)).set(budget)
+        except Exception:
+            pass
+
+    # -- read side ---------------------------------------------------------
+
+    def active(self) -> List[Dict]:
+        """The currently-firing rules (``/v1/alerts`` + the ``cli top``
+        pane + ``/healthz`` degradation)."""
+        out = []
+        with self._lock:
+            for slo in self.slos:
+                st = self._state[slo.name]
+                if st.firing:
+                    out.append({'rule': slo.name, 'kind': slo.kind,
+                                'severity': slo.severity,
+                                'since': st.fired_ts, **st.last})
+        return out
+
+    def snapshot(self) -> Dict:
+        """Everything ``GET /v1/alerts`` serves: the active set plus
+        per-SLO rule status (burn rates, budget, sample counts)."""
+        slos = []
+        with self._lock:
+            for slo in self.slos:
+                st = self._state[slo.name]
+                slos.append(dict(slo.spec(), firing=st.firing,
+                                 since=st.fired_ts, **st.last))
+        return {'active': self.active(), 'slos': slos}
+
+    def degraded(self) -> List[str]:
+        """Active page-severity rule names — the ``/healthz``
+        ``degraded`` list (degraded ≠ down: readiness stays 200)."""
+        return [a['rule'] for a in self.active()
+                if a.get('severity') == 'page']
+
+
+def serve_alerts_path(cache_root: str) -> str:
+    """Where a daemon rooted at ``cache_root`` keeps its alert log."""
+    from opencompass_tpu.obs.reqtrace import serve_obs_dir
+    return osp.join(serve_obs_dir(cache_root), ALERTS_FILE)

@@ -285,6 +285,17 @@ def summarize_records(records: List[Dict]) -> Dict:
         slot_util = round(
             sum((r.get('slot_util') or 0.0) * (r.get('decode_steps') or 0)
                 for r in engines) / eng_steps, 4)
+    # per-step telemetry fold (PR 12): decode-ready slot-steps stalled
+    # behind prefill chunks (summed — exact) and the stall fraction of
+    # all decode-ready slot-steps.  ITL folds as the WORST drain's p99
+    # — a conservative upper bound; per-drain medians cannot be pooled
+    # into a true task-level p50, so no p50 is reported here (the
+    # token-pooled percentiles live in /v1/stats and requests.jsonl)
+    stall = sum(r.get('stall_slot_steps') or 0 for r in engines)
+    occ = sum((r.get('decode_tokens') or 0) for r in engines)
+    stall_frac = round(stall / (stall + occ), 4) if stall + occ else None
+    itl_p99 = [r['itl_p99_ms'] for r in engines
+               if r.get('itl_p99_ms') is not None]
     # roofline fold (obs/costmodel.py fields on batch AND engine
     # records): raw FLOPs/bytes sum exactly; MFU/MBU are weighted by
     # each record's device wall so a long batch dominates a short one;
@@ -348,6 +359,9 @@ def summarize_records(records: List[Dict]) -> Dict:
         'engine_rows': sum(r.get('retired') or 0
                            for r in engines) or None,
         'slot_util': slot_util,
+        'decode_stall_slot_steps': stall if engines else None,
+        'decode_stall_frac': stall_frac,
+        'itl_p99_ms': max(itl_p99) if itl_p99 else None,
         # roofline totals + device-wall-weighted utilizations; None
         # when no record carried cost fields (FakeModel/API timelines)
         'flops': int(flops) or None,

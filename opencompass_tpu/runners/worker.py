@@ -569,6 +569,8 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
                 'request_id': request_id}
     model = build_model_from_cfg(model_cfg)   # memoized (residency)
     phases['model_build_s'] = round(time.perf_counter() - t0, 6)
+    if prompts:
+        _debug_complete_sleep()
     if not prompts:   # warm-up probe: model on device, nothing to say
         return {'ok': True, 'completions': [], 'built': built,
                 'build_seconds': round(time.perf_counter() - t0, 3),
@@ -712,10 +714,33 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
         resp['decode_tokens'] = engine_stats.get('decode_tokens')
         if engine_stats.get('ttft_s') is not None:
             resp['ttft_s'] = engine_stats['ttft_s']
-        for key in ('mfu', 'mbu'):
+        # measured inter-token latencies (downsampled sample list +
+        # percentiles) — the daemon lays them onto the request record
+        # and pools the samples into the /v1/stats window
+        for key in ('mfu', 'mbu', 'itl_p50_ms', 'itl_p99_ms',
+                    'itl_ms'):
             if engine_stats.get(key) is not None:
                 resp[key] = engine_stats[key]
     return resp
+
+
+def _debug_complete_sleep():
+    """Deterministic serving-latency injection for SLO tests and the
+    ``bench.py --slo`` leg: ``OCT_DEBUG_COMPLETE_SLEEP_FILE`` names a
+    file whose content is a float of seconds to sleep per completion —
+    file-based so the harness can LIFT the slowdown mid-daemon (write
+    ``0``/truncate) and watch the burn-rate alert resolve.  Missing or
+    unparsable file = no sleep.  Never raises."""
+    path = os.environ.get('OCT_DEBUG_COMPLETE_SLEEP_FILE')
+    if not path:
+        return
+    try:
+        with open(path, encoding='utf-8') as f:
+            seconds = float(f.read().strip() or 0.0)
+    except (OSError, ValueError):
+        return
+    if seconds > 0:
+        time.sleep(min(seconds, 30.0))
 
 
 def _flush_model_caches():

@@ -41,6 +41,7 @@ from datetime import datetime
 from typing import Dict, List, Optional
 
 from opencompass_tpu.obs import reqtrace
+from opencompass_tpu.obs import slo as slomod
 from opencompass_tpu.serve.queue import QUEUE_SUBDIR, SweepQueue
 from opencompass_tpu.serve.scheduler import WorkerPool
 from opencompass_tpu.utils.logging import add_file_handler, get_logger
@@ -49,6 +50,7 @@ logger = get_logger()
 
 DEFAULT_IDLE_TTL_S = 600.0
 DEFAULT_COMPLETE_TIMEOUT_S = 300.0
+DEFAULT_SLO_EVAL_INTERVAL_S = 5.0
 
 
 def _wire_model_cfg(model_cfg: Dict) -> Dict:
@@ -111,6 +113,17 @@ class EvalEngine:
         self.req_recorder = reqtrace.RequestRecorder(self.serve_obs_dir)
         self.http_access_log = reqtrace.AccessLog(self.serve_obs_dir)
         self.req_stats = reqtrace.RollingStats()
+        # SLO interpretation layer (obs/slo.py): config-declared
+        # objectives (`slos = [...]` in the serve config; defaults
+        # otherwise) evaluated continuously against the rolling
+        # completion window + queue/efficiency gauges.  Malformed
+        # specs fail HERE, at daemon construction, not mid-flight.
+        self.slo_eval = slomod.SLOEvaluator(
+            slomod.load_slos(cfg.get('slos')),
+            alert_path=osp.join(self.serve_obs_dir, slomod.ALERTS_FILE))
+        self.slo_eval_interval_s = float(
+            cfg.get('slo_eval_interval_s', DEFAULT_SLO_EVAL_INTERVAL_S))
+        self._slo_thread: Optional[threading.Thread] = None
         self._key_abbr: Optional[Dict[str, str]] = None
         self.pool: Optional[WorkerPool] = None
         self.infer_runner = None
@@ -212,6 +225,13 @@ class EvalEngine:
         self._loop_thread = threading.Thread(
             target=self._loop, name='serve-queue-loop', daemon=True)
         self._loop_thread.start()
+        # SLO evaluation on its own thread: the queue loop blocks for a
+        # whole sweep at a time, and burn-rate windows must keep moving
+        # (an alert that can't fire mid-sweep fires an hour late)
+        self.slo_eval.registry = self.tracer.metrics
+        self._slo_thread = threading.Thread(
+            target=self._slo_loop, name='serve-slo-loop', daemon=True)
+        self._slo_thread.start()
         if self.warm and self._catalog:
             threading.Thread(target=self._warm_fleet,
                              name='serve-warmup', daemon=True).start()
@@ -231,6 +251,8 @@ class EvalEngine:
         reqtrace.clear_engine_info(self.serve_obs_dir, pid=os.getpid())
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=30)
+        if self._slo_thread is not None:
+            self._slo_thread.join(timeout=10)
         if self.pool is not None:
             self.pool.shutdown()
         if self.server is not None:
@@ -494,6 +516,12 @@ class EvalEngine:
                                  'fetch_s': resp.get('fetch_s')}
                 if ttft is not None:
                     rec['ttft_s'] = ttft
+                # measured inter-token latency percentiles (engine-
+                # served rows): the steady decode cadence, next to
+                # TTFT's prefill cost
+                if resp.get('itl_p99_ms') is not None:
+                    rec['itl'] = {'p50_ms': resp.get('itl_p50_ms'),
+                                  'p99_ms': resp.get('itl_p99_ms')}
             self.req_recorder.record(rec)
             # label cardinality guard: client-supplied model strings
             # that never resolved in the catalog must not mint
@@ -506,7 +534,8 @@ class EvalEngine:
                 label_model, wall_s, ttft_s=ttft, ok=ok,
                 store_hits=(resp or {}).get('store_hits') or 0,
                 device_rows=(resp or {}).get('device_rows') or 0,
-                ts=ts, mbu=(resp or {}).get('mbu'))
+                ts=ts, mbu=(resp or {}).get('mbu'),
+                itl_ms=(resp or {}).get('itl_ms'))
             reqtrace.annotate(model=label_model,
                               completion_id=response_id)
             if self.tracer is not None and self.tracer.enabled:
@@ -605,6 +634,56 @@ class EvalEngine:
                 logger.exception(f'warm-up {abbr} failed')
         self._warmed.set()
 
+    # -- SLO evaluation ----------------------------------------------------
+
+    def _slo_loop(self):
+        while not self._stop.is_set():
+            self.evaluate_slos()
+            self._stop.wait(self.slo_eval_interval_s)
+        # final round so a drain-time breach still lands a transition
+        self.evaluate_slos()
+
+    def evaluate_slos(self, now: Optional[float] = None) -> List[Dict]:
+        """One burn-rate evaluation round: rolling completion samples ×
+        queue/efficiency gauges through the rule set.  Transitions land
+        in alerts.jsonl + the metrics registry; returns them (tests and
+        the bench leg poll the return).  Never raises."""
+        try:
+            samples = self.req_stats.completion_samples(
+                self.slo_eval.max_window_s, now=now)
+            gauges: Dict = {}
+            try:
+                pressure = self.queue.pressure()
+                gauges['queue_depth'] = \
+                    pressure['counts'].get('queued', 0)
+                gauges['queue_oldest_age_seconds'] = \
+                    pressure['oldest_queued_age_seconds']
+            except Exception:
+                pass
+            gauges.update(self._efficiency_snapshot() or {})
+            transitions = self.slo_eval.evaluate(samples, gauges,
+                                                 now=now)
+            for t in transitions:
+                logger.warning(
+                    f"SLO alert {t['t']}: {t['rule']} "
+                    f"(severity={t['severity']}, {t.get('value')})")
+            return transitions
+        except Exception:
+            logger.warning('SLO evaluation failed', exc_info=True)
+            return []
+
+    def alerts_snapshot(self) -> Dict:
+        """``GET /v1/alerts``: the active set, per-rule burn/budget
+        status, and the newest durable transitions."""
+        snap = self.slo_eval.snapshot()
+        return {
+            'object': 'serve.alerts',
+            'active': snap['active'],
+            'slos': snap['slos'],
+            'recent': slomod.tail_alerts(
+                osp.join(self.serve_obs_dir, slomod.ALERTS_FILE)),
+        }
+
     # -- request-scoped telemetry ------------------------------------------
 
     def _on_http_request(self, rec: Dict):
@@ -702,8 +781,17 @@ class EvalEngine:
             self.cache_root, os.W_OK) if osp.isdir(self.cache_root) \
             else os.access(osp.dirname(self.cache_root) or '.', os.W_OK)
         warmed = self._warmed.is_set()
+        # active page-severity alerts list as DEGRADATION, not as
+        # down: the engine still answers (readiness stays 200), but a
+        # load balancer or operator probing /healthz sees the burn
+        degraded = []
+        try:
+            degraded = self.slo_eval.degraded()
+        except Exception:
+            pass
         return {
             'ready': bool(warmed and loop_alive and store_writable),
+            'degraded': degraded,
             'workers_warmed': warmed,
             'queue_draining': loop_alive,
             'store_writable': store_writable,

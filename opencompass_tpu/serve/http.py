@@ -22,7 +22,10 @@ Two API surfaces mounted on the PR 2 telemetry server
     GET  /v1/stats           rolling-window SLO summary
                              (?window=SECONDS, default 300): per-route
                              / per-model latency percentiles, TTFT,
-                             error counts, queue age, worker fleet
+                             ITL, error counts, queue age, worker fleet
+    GET  /v1/alerts          burn-rate alerting state (obs/slo.py):
+                             active alerts, per-SLO burn/budget status,
+                             recent fire/resolve transitions
 
 ``/v1/completions`` answers in the OpenAI ``text_completion`` shape
 (``choices``, ``usage``) plus an ``oct`` block with the serving truth:
@@ -50,6 +53,7 @@ SWEEPS_PATH = '/v1/sweeps'
 COMPLETIONS_PATH = '/v1/completions'
 MODELS_PATH = '/v1/models'
 STATS_PATH = '/v1/stats'
+ALERTS_PATH = '/v1/alerts'
 
 
 def _err(code: int, message: str,
@@ -227,6 +231,12 @@ def build_routes(engine) -> Dict:
             return _err(400, f'bad window {query!r}')
         return 200, engine.stats_snapshot(window_s=window)
 
+    def alerts(path, query, body):
+        # the interpretation layer's read side: active burn-rate
+        # alerts, per-SLO budget status, and the newest durable
+        # transitions from alerts.jsonl (obs/slo.py)
+        return 200, engine.alerts_snapshot()
+
     return {
         ('POST', SWEEPS_PATH): post_sweep,
         ('GET', SWEEPS_PATH): list_sweeps,
@@ -235,4 +245,5 @@ def build_routes(engine) -> Dict:
         ('POST', COMPLETIONS_PATH): completions,
         ('GET', MODELS_PATH): list_models,
         ('GET', STATS_PATH): stats,
+        ('GET', ALERTS_PATH): alerts,
     }

@@ -81,6 +81,26 @@ def gather(cache_root: str,
                 snap['alive'] = True
             except Exception:
                 snap['alive'] = False   # stale engine.json / hung port
+            if snap['alive']:
+                try:
+                    snap['alerts'] = _http_json(info['port'],
+                                                '/v1/alerts')
+                except Exception:
+                    snap['alerts'] = None
+    if not snap['alive']:
+        # dead daemon: reconstruct the alert pane from the durable
+        # alerts.jsonl transitions (same file-first philosophy as the
+        # queue counts below)
+        try:
+            from opencompass_tpu.obs import slo as slomod
+            alerts_path = osp.join(obs_root, slomod.ALERTS_FILE)
+            snap['alerts'] = {
+                'active': slomod.read_active_alerts(alerts_path),
+                'recent': slomod.tail_alerts(alerts_path, limit=8),
+                'from_files': True,
+            }
+        except Exception:
+            snap['alerts'] = None
     if snap['serve'] is None:
         queue_root = osp.join(cache_root, 'serve', 'queue')
         if osp.isdir(queue_root):
@@ -167,6 +187,37 @@ def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
         queue_bits.append(f'current {serve["current_sweep"]}')
     lines.append('queue:  ' + '  '.join(queue_bits))
 
+    # alert pane (the interpretation layer): active burn-rate alerts
+    # from the live /v1/alerts, or folded from the alerts.jsonl tail
+    # when the daemon is down
+    alerts = snap.get('alerts') or {}
+    active = alerts.get('active') or []
+    if active:
+        src = ' (from files)' if alerts.get('from_files') else ''
+        lines.append(f'alerts: {len(active)} firing{src}')
+        now = snap.get('ts') or time.time()
+        for a in active:
+            rule = a.get('rule', '?')
+            sev = (a.get('severity') or '?').upper()
+            since = a.get('since') or (a.get('ts'))
+            age = _fmt_age(now - since) if since else '-'
+            detail = ''
+            if a.get('burn_fast') is not None:
+                detail = (f"  burn {a['burn_fast']:.1f}x fast"
+                          f" / {a.get('burn_slow') or 0:.1f}x slow")
+            elif (a.get('value') or {}) and isinstance(a.get('value'),
+                                                       dict):
+                v = a['value']
+                if v.get('burn_fast') is not None:
+                    detail = (f"  burn {v['burn_fast']:.1f}x fast"
+                              f" / {v.get('burn_slow') or 0:.1f}x slow")
+                elif v.get('gauge'):
+                    detail = (f"  {v['gauge']} {v.get('value')}"
+                              f" vs bound {v.get('bound')}")
+            lines.append(f'  [{sev}] {rule}  for {age}{detail}')
+    else:
+        lines.append('alerts: none')
+
     stats = snap.get('stats') or {}
     comp = stats.get('completions') or {}
     if comp.get('count'):
@@ -179,6 +230,9 @@ def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
             if row.get('ttft_p95_ms') is not None:
                 bits.append(
                     f'ttft_p95[{model}] {row["ttft_p95_ms"]:.1f}ms')
+            if row.get('itl_p99_ms') is not None:
+                bits.append(
+                    f'itl_p99[{model}] {row["itl_p99_ms"]:.1f}ms')
         lines.append('completions: ' + '  '.join(bits))
     requests = snap.get('requests') or []
     if requests:
@@ -189,7 +243,9 @@ def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
         lines.append('  p99 ' + _sparkline(p99)
                      + f'  (peak {max(p99):.0f}ms)')
     elif not comp.get('count'):
-        lines.append('completions: none in window')
+        # empty stats window (daemon up, no completions yet): explicit
+        # placeholder cells instead of a blank pane
+        lines.append('completions: 0 in window  p50 -  p99 -  ttft -')
 
     # engine efficiency (the roofline plane: /v1/stats `efficiency`
     # from the run status fold — decode-slot occupancy, MFU/MBU,

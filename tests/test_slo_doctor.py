@@ -1,0 +1,477 @@
+"""SLO burn-rate alerting, per-step decode telemetry, and `cli doctor`
+auto-triage (ISSUE 12).
+
+Burn-rate math runs under an injected clock (explicit sample ``ts`` +
+``now=``) — no wall-time sleeps.  The alert log inherits the store's
+torn-line recovery contract.  The doctor tests run against the seeded
+``tests/fixtures/obs_run/`` fixture (known findings, ``--check`` exit
+codes) and a synthetic clean run."""
+import json
+import os
+import os.path as osp
+import subprocess
+import sys
+
+import pytest
+
+from opencompass_tpu.obs import slo as slomod
+from opencompass_tpu.obs.slo import (SLO, SLOEvaluator, default_slos,
+                                     fold_alerts, load_slos,
+                                     read_active_alerts)
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+FIXTURE = osp.join(REPO, 'tests', 'fixtures', 'obs_run')
+
+
+def _lat_samples(now, n, latency_s, age_step=1.0, ok=True,
+                 model='m', start_age=0.0):
+    """n completion samples ending at `now`, spaced age_step apart."""
+    return [{'ts': now - start_age - i * age_step, 'model': model,
+             'latency_s': latency_s, 'ttft_s': latency_s / 4,
+             'ok': ok} for i in range(n)]
+
+
+def _slo(**kw):
+    base = dict(name='lat', kind='latency', objective_ms=100.0,
+                target=0.9, fast_s=60.0, slow_s=600.0, burn_factor=2.0,
+                min_samples=3, severity='page')
+    base.update(kw)
+    return SLO(base.pop('name'), base.pop('kind'), **base)
+
+
+# -- burn-rate math (injected clock) ----------------------------------------
+
+def test_window_burn_math():
+    slo = _slo()
+    now = 10_000.0
+    # 10 samples, 3 bad (over 100ms): bad_frac 0.3, budget 0.1 -> 3.0x
+    samples = _lat_samples(now, 7, 0.05) + _lat_samples(
+        now, 3, 0.5, start_age=20.0)
+    w = slo.window_burn(samples, 60.0, now)
+    assert w['total'] == 10 and w['bad'] == 3
+    assert w['bad_frac'] == 0.3
+    assert w['burn'] == pytest.approx(3.0)
+    # below min_samples: no verdict
+    assert slo.window_burn(samples[:2], 60.0, now) is None
+    # an old sample is outside the fast window but inside the slow one
+    old = _lat_samples(now, 3, 0.5, start_age=120.0)
+    assert slo.window_burn(old, 60.0, now) is None
+    assert slo.window_burn(old, 600.0, now)['bad'] == 3
+
+
+def test_fire_requires_both_windows_and_resolves_on_fast_recovery():
+    ev = SLOEvaluator([_slo()])
+    now = 50_000.0
+    # burst of bad samples ONLY in the last 30s: fast window burns,
+    # slow window has the same samples -> both burn -> fire
+    bad = _lat_samples(now, 6, 0.5, age_step=4.0)
+    trans = ev.evaluate(bad, now=now)
+    assert [t['t'] for t in trans] == ['fire']
+    assert trans[0]['rule'] == 'lat'
+    assert trans[0]['severity'] == 'page'
+    assert trans[0]['value']['burn_fast'] >= 2.0
+    assert ev.active() and ev.active()[0]['rule'] == 'lat'
+    assert ev.degraded() == ['lat']
+    # steady-state firing: no duplicate transition
+    assert ev.evaluate(bad, now=now + 1) == []
+    # 90s later the bad burst left the fast window; fresh good traffic
+    # fills it -> resolve, even though the slow window still burns
+    later = now + 90.0
+    mixed = bad + _lat_samples(later, 8, 0.01, age_step=2.0)
+    slow_burn = _slo().window_burn(mixed, 600.0, later)['burn']
+    assert slow_burn >= 2.0     # slow window alone would hold the page
+    trans = ev.evaluate(mixed, now=later)
+    assert [t['t'] for t in trans] == ['resolve']
+    assert trans[0]['duration_s'] == pytest.approx(90.0)
+    assert ev.active() == [] and ev.degraded() == []
+
+
+def test_no_data_holds_alert_state():
+    """Absence of data is not health: a firing ratio alert must NOT
+    resolve when traffic stops (the stop may be the incident's own
+    back-pressure), and a gauge outage must neither resolve a firing
+    gauge rule nor reset its for_s breach timer."""
+    ev = SLOEvaluator([_slo()])
+    now = 60_000.0
+    ev.evaluate(_lat_samples(now, 6, 0.5), now=now)
+    assert ev.active()
+    # total silence for 10 minutes: the alert holds, no transitions
+    assert ev.evaluate([], now=now + 600) == []
+    assert ev.active() and ev.active()[0]['rule'] == 'lat'
+    # measured recovery resolves it
+    trans = ev.evaluate(_lat_samples(now + 700, 6, 0.01),
+                        now=now + 700)
+    assert [t['t'] for t in trans] == ['resolve']
+
+    gauge = SLO('age', 'gauge_max', gauge='g', bound=10, for_s=10)
+    ev = SLOEvaluator([gauge])
+    t0 = 100.0
+    ev.evaluate([], {'g': 50}, now=t0)
+    # a gauge outage mid-sustain must not reset the breach timer:
+    # the rule still fires once for_s elapses around the gap
+    assert ev.evaluate([], {}, now=t0 + 5) == []
+    trans = ev.evaluate([], {'g': 50}, now=t0 + 11)
+    assert [t['t'] for t in trans] == ['fire']
+    # and an outage while firing must not resolve
+    assert ev.evaluate([], {}, now=t0 + 20) == []
+    assert ev.active()
+    trans = ev.evaluate([], {'g': 1}, now=t0 + 30)
+    assert [t['t'] for t in trans] == ['resolve']
+
+
+def test_fast_spike_alone_does_not_fire():
+    """3 bad samples in a fast window over an otherwise-clean hour:
+    fast burns, slow does not -> no page (the multi-window point)."""
+    ev = SLOEvaluator([_slo()])
+    now = 90_000.0
+    clean_hour = _lat_samples(now, 60, 0.01, age_step=9.0,
+                              start_age=70.0)
+    spike = _lat_samples(now, 3, 0.5, age_step=2.0)
+    assert ev.evaluate(clean_hour + spike, now=now) == []
+    assert ev.active() == []
+
+
+def test_availability_and_budget_remaining():
+    slo = SLO('avail', 'availability', target=0.9, fast_s=60,
+              slow_s=600, burn_factor=2.0, min_samples=2)
+    ev = SLOEvaluator([slo])
+    now = 1000.0
+    # 50% errors: burn 5.0x on both windows -> fire; budget exhausted
+    samples = (_lat_samples(now, 5, 0.01, ok=False)
+               + _lat_samples(now, 5, 0.01, ok=True, start_age=20.0))
+    trans = ev.evaluate(samples, now=now)
+    assert [t['t'] for t in trans] == ['fire']
+    snap = ev.snapshot()
+    row = next(s for s in snap['slos'] if s['name'] == 'avail')
+    assert row['firing'] is True
+    assert row['budget_remaining'] == 0.0   # 0.5 bad / 0.1 budget
+    # clean traffic: budget fully unspent
+    ev2 = SLOEvaluator([slo])
+    ev2.evaluate(_lat_samples(now, 5, 0.01, ok=True), now=now)
+    row = next(s for s in ev2.snapshot()['slos']
+               if s['name'] == 'avail')
+    assert row['budget_remaining'] == 1.0
+
+
+def test_gauge_rule_sustained_breach_and_resolve():
+    slo = SLO('queue_age', 'gauge_max',
+              gauge='queue_oldest_age_seconds', bound=60.0,
+              for_s=10.0, severity='ticket')
+    ev = SLOEvaluator([slo])
+    t0 = 5000.0
+    # breach starts: no fire before for_s elapses
+    assert ev.evaluate([], {'queue_oldest_age_seconds': 90}, now=t0) \
+        == []
+    assert ev.evaluate([], {'queue_oldest_age_seconds': 95},
+                       now=t0 + 5) == []
+    trans = ev.evaluate([], {'queue_oldest_age_seconds': 99},
+                        now=t0 + 11)
+    assert [t['t'] for t in trans] == ['fire']
+    assert trans[0]['severity'] == 'ticket'
+    assert ev.degraded() == []        # ticket severity: not degraded
+    # back within bounds -> resolve; a fresh breach restarts the timer
+    trans = ev.evaluate([], {'queue_oldest_age_seconds': 5},
+                        now=t0 + 20)
+    assert [t['t'] for t in trans] == ['resolve']
+    assert ev.evaluate([], {'queue_oldest_age_seconds': 90},
+                       now=t0 + 21) == []
+
+
+def test_load_slos_validation():
+    assert [s.name for s in load_slos(None)] \
+        == [s.name for s in default_slos()]
+    loaded = load_slos([dict(name='x', kind='latency',
+                             objective_ms=50, target=0.5)])
+    assert loaded[0].objective_ms == 50
+    with pytest.raises(ValueError):
+        load_slos([dict(name='x', kind='nope')])
+    with pytest.raises(ValueError):
+        load_slos([dict(name='x', kind='latency')])   # no objective
+    with pytest.raises(ValueError):
+        load_slos([dict(name='x', kind='gauge_max')])  # no gauge/bound
+    with pytest.raises(ValueError):
+        load_slos([dict(name='x', kind='latency', objective_ms=1),
+                   dict(name='x', kind='availability')])  # dup name
+
+
+# -- durable alert log ------------------------------------------------------
+
+def test_alert_log_durable_and_torn_line_recovery(tmp_path):
+    path = str(tmp_path / 'alerts.jsonl')
+    ev = SLOEvaluator([_slo()], alert_path=path)
+    now = 7000.0
+    ev.evaluate(_lat_samples(now, 6, 0.5), now=now)
+    assert read_active_alerts(path)[0]['rule'] == 'lat'
+    # a kill -9 tears the final line: readers skip it, the folded
+    # active set survives
+    with open(path, 'ab') as f:
+        f.write(b'{"v":1,"t":"resolve","rule":"lat","ts":9')
+    assert [a['rule'] for a in read_active_alerts(path)] == ['lat']
+    # the next append re-seals the torn tail (queue-journal
+    # discipline) instead of being absorbed into it: the COMPLETE
+    # resolve lands on its own line and clears the rule
+    ev.evaluate(_lat_samples(now + 90, 8, 0.01), now=now + 90)
+    assert read_active_alerts(path) == []
+    kinds = [r['t'] for r in slomod.tail_alerts(path)]
+    assert kinds == ['fire', 'resolve']
+
+
+def test_fold_alerts_newest_state_wins():
+    stream = [{'t': 'fire', 'rule': 'a', 'ts': 1},
+              {'t': 'fire', 'rule': 'b', 'ts': 2},
+              {'t': 'resolve', 'rule': 'a', 'ts': 3},
+              {'t': 'fire', 'rule': 'a', 'ts': 4}]
+    active = fold_alerts(stream)
+    assert [(r['rule'], r['ts']) for r in active] == [('b', 2),
+                                                     ('a', 4)]
+
+
+# -- rotation ---------------------------------------------------------------
+
+def test_reqtrace_rotation_bounds_disk(tmp_path, monkeypatch):
+    from opencompass_tpu.obs import reqtrace
+    monkeypatch.setenv(reqtrace.REQTRACE_MAX_BYTES_ENV, '8192')
+    rec = reqtrace.RequestRecorder(str(tmp_path))
+    row = {'id': 'cmpl-x', 'wall_s': 0.1, 'pad': 'z' * 100}
+    for i in range(200):
+        rec.record(dict(row, i=i))
+    live = os.path.getsize(rec.path)
+    rolled = os.path.getsize(rec.path + '.1')
+    # live + one rolled segment, each bounded by half the budget (+1
+    # record of slack for the append that crossed the line)
+    assert live <= 4096 + 200
+    assert rolled <= 4096 + 200
+    assert not osp.exists(rec.path + '.2')   # oldest segment evicted
+    # the newest records are intact and parseable
+    tail = list(reqtrace.iter_requests(rec.path))
+    assert tail and tail[-1]['i'] == 199
+
+
+# -- rolling-window ITL + empty-window safety -------------------------------
+
+def test_rolling_stats_itl_and_empty_window():
+    from opencompass_tpu.obs.reqtrace import RollingStats
+    rs = RollingStats()
+    # empty window: explicit nulls, no crash
+    empty = rs.summary(window_s=60, now=1000.0)
+    assert empty['completions']['count'] == 0
+    rs.record_completion('m', 0.2, ttft_s=0.05, ts=990.0,
+                         itl_ms=[2.0, 3.0, 4.0])
+    rs.record_completion('m', 0.3, ttft_s=0.06, ts=991.0,
+                         itl_ms=[5.0, 30.0])
+    summary = rs.summary(window_s=60, now=1000.0)
+    row = summary['completions']['per_model']['m']
+    assert row['itl_p50_ms'] == 4.0     # pooled over tokens
+    assert row['itl_p99_ms'] == 30.0
+    assert row['ttft_p95_ms'] is not None
+    # the SLO evaluator's raw feed
+    samples = rs.completion_samples(60, now=1000.0)
+    assert len(samples) == 2
+    assert samples[0]['latency_s'] == 0.2 and samples[0]['ok'] is True
+
+
+# -- daemon glue (no HTTP: injected clock through EvalEngine) ---------------
+
+def test_engine_evaluates_slos_and_reports_degraded(tmp_path,
+                                                    monkeypatch):
+    monkeypatch.delenv('OCT_CACHE_ROOT', raising=False)
+    from opencompass_tpu.serve.daemon import EvalEngine
+    cfg = {'work_dir': str(tmp_path / 'serve'),
+           'models': [],
+           'slos': [dict(name='lat', kind='latency', objective_ms=100,
+                         target=0.5, fast_s=60, slow_s=600,
+                         burn_factor=1.5, min_samples=3,
+                         severity='page')]}
+    engine = EvalEngine(cfg)
+    now = 4242.0
+    for i in range(6):
+        engine.req_stats.record_completion('m', 0.8, ts=now - i)
+    trans = engine.evaluate_slos(now=now)
+    assert [t['t'] for t in trans] == ['fire']
+    # /healthz: degraded lists the page alert, readiness is orthogonal
+    report = engine.readiness()
+    assert report['degraded'] == ['lat']
+    snap = engine.alerts_snapshot()
+    assert snap['active'][0]['rule'] == 'lat'
+    assert any(r['t'] == 'fire' for r in snap['recent'])
+    # durable transition landed under {cache_root}/serve/obs/
+    path = osp.join(engine.serve_obs_dir, slomod.ALERTS_FILE)
+    assert read_active_alerts(path)[0]['rule'] == 'lat'
+
+
+def test_alerts_route():
+    from opencompass_tpu.serve.http import ALERTS_PATH, build_routes
+
+    class _Stub:
+        def alerts_snapshot(self):
+            return {'object': 'serve.alerts', 'active': [],
+                    'slos': [], 'recent': []}
+
+    routes = build_routes(_Stub())
+    code, payload = routes[('GET', ALERTS_PATH)]('/v1/alerts', '', b'')
+    assert code == 200 and payload['object'] == 'serve.alerts'
+
+
+# -- cli top: alert pane + empty-window polish ------------------------------
+
+def test_top_renders_empty_stats_and_file_mode_alerts():
+    from opencompass_tpu.serve.top import render
+    # live daemon, zero completions yet: placeholder cells, no crash
+    snap = {'cache_root': '/x', 'ts': 1000.0, 'alive': True,
+            'engine': {'pid': 1, 'port': 1234, 'ts': 990.0},
+            'stats': {'completions': {'count': 0, 'per_model': {}}},
+            'serve': {'queue_depth': 0}, 'requests': [],
+            'alerts': {'active': [], 'recent': []}}
+    out = render(snap)
+    assert 'alerts: none' in out
+    assert 'completions: 0 in window  p50 -  p99 -' in out
+    # dead daemon: the pane folds from the alerts.jsonl tail
+    snap = {'cache_root': '/x', 'ts': 1000.0, 'alive': False,
+            'engine': {'pid': 1, 'port': 1234}, 'stats': None,
+            'serve': None, 'requests': [],
+            'alerts': {'from_files': True, 'recent': [],
+                       'active': [{'rule': 'completion_p99',
+                                   'severity': 'page', 'ts': 900.0,
+                                   'value': {'burn_fast': 22.0,
+                                             'burn_slow': 15.0}}]}}
+    out = render(snap)
+    assert 'alerts: 1 firing (from files)' in out
+    assert '[PAGE] completion_p99' in out
+    assert 'burn 22.0x fast' in out
+
+
+# -- cli doctor -------------------------------------------------------------
+
+def test_doctor_fixture_findings():
+    from opencompass_tpu.obs.doctor import diagnose
+    report = diagnose(FIXTURE)
+    rules = {f['rule']: f for f in report['findings']}
+    assert {'failed_tasks', 'slo_breach', 'worker_instability',
+            'cold_compile_storm', 'pad_collapse', 'prefill_stall',
+            'gather_waste'} <= set(rules)
+    assert rules['failed_tasks']['severity'] == 'error'
+    assert rules['slo_breach']['severity'] == 'error'   # page alert
+    assert rules['gather_waste']['severity'] == 'info'
+    # findings are ranked most-severe first
+    sevs = [f['severity'] for f in report['findings']]
+    assert sevs == sorted(
+        sevs, key=['error', 'warn', 'info'].index)
+    # SLO breach carries the phase attribution from requests.jsonl
+    joined = ' '.join(rules['slo_breach']['evidence'])
+    assert 'dominated by queue' in joined
+    # every finding ships evidence + a remediation hint
+    for f in report['findings']:
+        assert f['evidence'] and f.get('fix')
+
+
+def test_doctor_cli_check_exit_codes(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'doctor',
+         'tests/fixtures/obs_run', '--check'],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert '[ERROR] failed_tasks' in r.stdout
+    # a clean run: no findings, exit 0
+    obs = tmp_path / 'run' / 'obs'
+    obs.mkdir(parents=True)
+    (obs / 'status.json').write_text(json.dumps({
+        'v': 1, 'ts': 10.0, 'state': 'done',
+        'tasks': {'t1': {'state': 'ok', 'returncode': 0},
+                  't2': {'state': 'ok', 'returncode': 0}},
+        'overall': {'n_tasks': 2, 'progress': 1.0, 'ok': 2,
+                    'failed': 0, 'running': 0, 'pending': 0}}))
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'doctor',
+         str(tmp_path / 'run'), '--check'],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 'no findings' in r.stdout
+    # unusable input: exit 1
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'doctor',
+         str(tmp_path / 'nothing-here')],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert r.returncode == 1
+
+
+def test_doctor_cli_json():
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'doctor',
+         'tests/fixtures/obs_run', '--json'],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS='cpu'),
+        capture_output=True, text=True, timeout=180)
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert report['v'] == 1
+    assert report['counts']['error'] >= 2
+    assert report['sources']['obs_dir']
+
+
+# -- per-step engine telemetry on the real tiny JaxLM -----------------------
+
+def test_engine_per_step_records_and_itl(tmp_path):
+    from opencompass_tpu.models import JaxLM
+    from opencompass_tpu.obs import timeline as tlmod
+    lm = JaxLM(config='tiny', max_seq_len=256,
+               continuous_batching=True, decode_slots=2,
+               kv_page_size=16)
+    tl = tlmod.install_timeline(
+        tlmod.Timeline(str(tmp_path), 'engine-task'))
+    try:
+        stats_out = {}
+        # mixed lengths in one join wave: the short row finishes its
+        # prefill first and sits decode-ready while the long one keeps
+        # chunking -> stall_slot_steps must be measured > 0
+        prompts = ['hi',
+                   'a long prompt with many more words ' * 4,
+                   'mid size prompt here', 'tiny']
+        lm.generate_continuous(prompts, 6, stats_out=stats_out)
+    finally:
+        tlmod.reset_timeline()
+    engine = lm.continuous_engine()
+    stats = engine.stats()
+    assert stats['stall_slot_steps'] > 0
+    assert stats['step_wall_p99_ms'] >= stats['step_wall_p50_ms'] > 0
+    # per-request ITL: measured, in stats_out for the serve plane
+    assert stats_out['itl_p99_ms'] >= stats_out['itl_p50_ms'] > 0
+    assert stats_out['itl_ms']
+    # the flight-recorder engine record carries the per-step slot
+    # composition
+    recs = list(tlmod.iter_records(tl.path))
+    eng = [r for r in recs if r.get('t') == 'engine']
+    assert len(eng) == 1
+    detail = eng[0]['steps_detail']
+    assert detail and all(
+        set(d) == {'k', 'w', 'pf', 'dc', 'st', 'ret'} for d in detail)
+    kinds = {d['k'] for d in detail}
+    assert kinds == {'p', 'd'}
+    # prefill steps carry the stalled decode-ready rows; the summed
+    # detail matches the counter when the drain fits the cap
+    assert sum(d['st'] for d in detail) == stats['stall_slot_steps']
+    assert sum(d['ret'] for d in detail) == len(prompts)
+    assert eng[0]['stall_slot_steps'] == stats['stall_slot_steps']
+    assert eng[0]['itl_p99_ms'] == stats_out['itl_p99_ms']
+    # summarize_records folds the new fields for the report/doctor
+    summary = tlmod.summarize_records(recs)
+    assert summary['decode_stall_slot_steps'] \
+        == stats['stall_slot_steps']
+    assert 0 < summary['decode_stall_frac'] < 1
+    assert summary['itl_p99_ms'] == stats_out['itl_p99_ms']
+
+
+def test_fake_model_continuous_itl_pacing(monkeypatch):
+    """FakeModel's engine mirror reports measured TTFT/ITL through the
+    same stats_out contract — what the device-free bench --slo leg and
+    the serve plumbing ride."""
+    from opencompass_tpu.models import FakeModel
+    monkeypatch.setenv('OCT_FAKE_TOKEN_SLEEP_S', '0.002')
+    fm = FakeModel(continuous=True,
+                   canned_responses={'Q': 'one two three four'})
+    stats_out = {}
+    out = fm.generate_continuous(['Q: a?', 'Q: b?'], 8,
+                                 stats_out=stats_out)
+    assert out == ['one two three four'] * 2
+    assert stats_out['ttft_s'] > 0
+    assert stats_out['itl_p99_ms'] >= stats_out['itl_p50_ms'] > 0
+    assert len(stats_out['itl_ms']) == 6   # 3 gaps per 4-token row
