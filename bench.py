@@ -708,6 +708,35 @@ def _bench_continuous(out_json='BENCH_DECODE.json'):
     return record
 
 
+def _bench_lint(out_json='BENCH_LINT.json'):
+    """detail.lint: oct-lint coverage smoke over the package — files
+    scanned, per-rule finding counts, pragma/baseline triage state
+    (docs/static_analysis.md).  Written to BENCH_LINT.json so lint
+    coverage (and any drift toward wholesale suppression) is tracked
+    per PR next to the perf legs.  Device-free."""
+    import time as _time
+    from opencompass_tpu.analysis.linter import run_lint
+    t0 = _time.perf_counter()
+    report = run_lint()
+    record = {
+        'v': 1,
+        'files_scanned': report.files_scanned,
+        'findings_active': len(report.active),
+        'findings_baselined': len(report.baselined),
+        'pragmas': report.pragma_count,
+        'by_rule': report.by_rule(),
+        'stale_baseline': len(report.stale_baseline),
+        'parse_errors': len(report.parse_errors),
+        'clean': not report.active and not report.parse_errors,
+        'lint_seconds': round(_time.perf_counter() - t0, 3),
+    }
+    if out_json:
+        with open(out_json, 'w') as fh:
+            json.dump(record, fh, indent=2)
+            fh.write('\n')
+    return record
+
+
 def _bench_roofline(out_json='BENCH_ROOFLINE.json'):
     """detail.roofline: MFU/MBU attribution (obs/costmodel.py) for a
     dense fixed-shape gen leg and a continuous-batching engine leg on
@@ -1559,5 +1588,10 @@ if __name__ == '__main__':
         # standalone roofline/MFU/MBU leg (tiny JaxLM; CPU-runnable)
         print(json.dumps({'metric': 'roofline', 'v': 1,
                           'detail': _bench_roofline()}))
+        sys.exit(0)
+    if '--lint' in sys.argv:
+        # standalone oct-lint coverage smoke (pure stdlib; device-free)
+        print(json.dumps({'metric': 'lint', 'v': 1,
+                          'detail': _bench_lint()}))
         sys.exit(0)
     main()

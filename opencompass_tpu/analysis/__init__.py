@@ -1,0 +1,35 @@
+"""Static analysis + runtime sanitizers enforcing the repo's
+load-bearing invariants as a *checked contract* instead of review
+convention (ISSUE 13; docs/static_analysis.md).
+
+Three tools, one subsystem:
+
+- :mod:`opencompass_tpu.analysis.linter` — ``oct-lint``, an AST-based
+  project linter (``python -m opencompass_tpu.cli lint``) with seven
+  repo-specific rules (OCT001–OCT007): durable-append discipline,
+  atomic-replace discipline, ``# guarded-by:`` lock discipline, thread
+  hygiene, clock injection, host-sync-in-hot-path, and jit retrace
+  risk.  Findings are triaged through inline
+  ``# oct-lint: disable=RULE(reason)`` pragmas and a committed baseline
+  (``tools/lint_baseline.json``) — every suppression carries a written
+  reason.
+
+- :mod:`opencompass_tpu.analysis.racecheck` — an instrumented-lock
+  harness for concurrency tests: wraps ``threading`` locks, records the
+  cross-thread acquisition-order graph, and fails on lock-order
+  inversions (potential deadlock cycles) that a lucky interleaving
+  would otherwise hide.
+
+- :mod:`opencompass_tpu.analysis.crashfuzz` — a crash-consistency
+  fuzzer: kills a child writer at randomized byte offsets inside a
+  journal append and asserts every journal reader (store segments,
+  queue journal, requests/alerts/access logs) recovers exactly per its
+  torn-line contract, converging bit-identically after recovery.
+
+Imports stay lazy here: the linter is pure stdlib (``ast``), and the
+crashfuzz child process must start fast — nothing in this package may
+import jax at module import time.
+"""
+from __future__ import annotations
+
+__all__ = ['linter', 'racecheck', 'crashfuzz']

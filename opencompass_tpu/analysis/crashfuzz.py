@@ -1,0 +1,383 @@
+"""Crash-consistency fuzzer for the repo's append-only journals.
+
+Every durable artifact here — store segments, the sweep-queue journal,
+``requests.jsonl`` / ``access.jsonl`` / ``alerts.jsonl`` — rides the
+same discipline: records append as one ``os.write`` on an ``O_APPEND``
+fd, a killed writer tears at most the final line, and each reader
+recovers per a documented torn-line contract.  This module *tests that
+contract by construction*: it spawns a *child writer process* that
+appends real records through ``utils.fileio.append_jsonl_atomic`` and
+then dies (``os._exit``) **mid-write at a chosen byte offset** of a
+chosen record — byte-for-byte what ``kill -9`` between two ``write(2)``
+calls leaves on disk — and asserts, in the parent:
+
+1. **prefix recovery** — the reader yields exactly the fully-committed
+   records: nothing torn surfaces, nothing committed is lost;
+2. **recovery append** — a surviving writer appends the remaining
+   records through the journal's own recovery path (tail-seal for the
+   shared queue/alert journals, a fresh segment for the per-writer
+   store, plain append for the lossy-by-contract request log) and the
+   reader then sees the full intended sequence (minus exactly the
+   absorbed record where the contract documents that loss);
+3. **bit-identical convergence** — recovery is deterministic: two
+   independent recoveries of copies of the torn file produce identical
+   bytes, and re-reading is stable.
+
+Contracts are registered in :data:`CONTRACTS` so the test suite sweeps
+every journal kind with randomized (record, byte-offset) cut points::
+
+    from opencompass_tpu.analysis import crashfuzz
+    report = crashfuzz.run_crashfuzz('queue_journal', tmp_path,
+                                     n_records=16, rounds=8, seed=0)
+    assert report['rounds'] == 8     # violations raise AssertionError
+
+The child is ``python -m opencompass_tpu.analysis.crashfuzz --child
+<spec.json>`` — this module imports only stdlib + ``utils.fileio`` so
+the child starts in ~0.2 s.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import os.path as osp
+import random
+import shutil
+import subprocess
+import sys
+from typing import Callable, Dict, List, Optional
+
+from opencompass_tpu.utils.fileio import (append_jsonl_atomic,
+                                          iter_jsonl_records)
+
+CHILD_EXIT = 17    # distinguishes the planned mid-write death
+
+
+def _check(cond, msg: str):
+    """Contract check that survives ``python -O`` (bare asserts are
+    stripped under PYTHONOPTIMIZE — the fuzzer must never print a
+    success report while checking nothing)."""
+    if not cond:
+        raise AssertionError(msg)
+
+
+def _encode(rec: Dict) -> bytes:
+    return (json.dumps(rec, separators=(',', ':'), default=str)
+            + '\n').encode('utf-8')
+
+
+def torn_write(path: str, records: List[Dict], cut_record: int,
+               cut_bytes: int):
+    """Append ``records[:cut_record]`` whole (the real append path),
+    then the first ``cut_bytes`` bytes of ``records[cut_record]`` raw,
+    simulating a writer killed at that byte offset.  Runs in the CHILD
+    process — callers in the parent use :func:`fuzz_kill_writer`."""
+    for rec in records[:cut_record]:
+        append_jsonl_atomic(path, [rec])
+    data = _encode(records[cut_record])[:cut_bytes]
+    os.makedirs(osp.dirname(osp.abspath(path)), exist_ok=True)
+    # oct-lint: disable=OCT001(deliberately torn raw append — this IS the crash being injected)
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+    try:
+        if data:
+            os.write(fd, data)
+    finally:
+        os.close(fd)
+
+
+def fuzz_kill_writer(path: str, records: List[Dict], cut_record: int,
+                     cut_bytes: int, timeout: float = 60.0):
+    """Run :func:`torn_write` in a child process that ``os._exit``-s
+    immediately after the partial write (no atexit, no buffered-IO
+    flush — the kill-at-byte-offset semantics)."""
+    spec = {'path': osp.abspath(path), 'records': records,
+            'cut_record': cut_record, 'cut_bytes': cut_bytes}
+    spec_path = osp.abspath(path) + '.fuzzspec.json'
+    from opencompass_tpu.utils.fileio import atomic_write_json
+    atomic_write_json(spec_path, spec)
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    try:
+        proc = subprocess.run(
+            [sys.executable, '-m', 'opencompass_tpu.analysis.crashfuzz',
+             '--child', spec_path],
+            timeout=timeout, env=env, capture_output=True)
+    finally:
+        try:
+            os.unlink(spec_path)
+        except OSError:
+            pass
+    if proc.returncode != CHILD_EXIT:
+        raise RuntimeError(
+            f'crashfuzz child exited {proc.returncode} (wanted '
+            f'{CHILD_EXIT}): {proc.stderr.decode(errors="replace")}')
+
+
+def _child_main(spec_path: str):
+    with open(spec_path, encoding='utf-8') as f:
+        spec = json.load(f)
+    torn_write(spec['path'], spec['records'], spec['cut_record'],
+               spec['cut_bytes'])
+    os._exit(CHILD_EXIT)
+
+
+# -- journal contracts ------------------------------------------------------
+
+@dataclasses.dataclass
+class JournalContract:
+    """One journal kind's writer/reader/recovery triple.
+
+    ``read`` returns the canonical comparable projection of what the
+    reader recovered; ``recover_append`` pushes the not-yet-committed
+    records through the surviving-writer path.  ``lossy_absorb`` marks
+    the documented requests.jsonl contract: without a tail seal the
+    first post-crash append is absorbed into the torn line (both
+    skipped by readers) — recovery may lose exactly that one record
+    when the tear was mid-record."""
+    name: str
+    filename: str
+    make_record: Callable[[int], Dict]
+    read: Callable[[str], List]
+    recover_append: Callable[[str, List[Dict]], None]
+    canon: Callable[[Dict], object]
+    lossy_absorb: bool = False
+    new_segment: Optional[str] = None   # per-writer store recovery
+
+
+def _store_contract() -> JournalContract:
+    def make(i):
+        return {'k': f'key{i:04d}', 'v': {'pred': f'answer {i}'},
+                't': 1000.0 + i}
+
+    def read(path):
+        out = []
+        d = osp.dirname(path)
+        for name in sorted(os.listdir(d)) if osp.isdir(d) else []:
+            if name.endswith('.jsonl'):
+                out.extend(iter_jsonl_records(osp.join(d, name)))
+        return sorted((r['k'] for r in out if 'k' in r))
+
+    def recover(path, remaining):
+        # store contract: a dead writer's segment is never appended
+        # again — the restarted writer (new pid) opens its own segment
+        append_jsonl_atomic(osp.join(osp.dirname(path),
+                                     'writer-recovered.jsonl'),
+                            remaining)
+
+    return JournalContract(
+        name='store_segment', filename=osp.join('segments', 'sh',
+                                                'writer-dead.jsonl'),
+        make_record=make, read=read, recover_append=recover,
+        canon=lambda r: r['k'])
+
+
+def _queue_contract() -> JournalContract:
+    from opencompass_tpu.serve.queue import JOURNAL_FILE
+
+    def make(i):
+        return {'v': 1, 'op': 'enqueue', 'id': f'sw-{i:04d}',
+                'ts': 1000.0 + i, 'config_path': f'/cfg/{i}.py',
+                'work_dir': None, 'mode': 'all', 'label': None}
+
+    def read(path):
+        from opencompass_tpu.serve.queue import SweepQueue
+        q = SweepQueue(osp.dirname(path))
+        return [sid for sid, rec in q.state().items()
+                if rec['status'] == 'queued']
+
+    def recover(path, remaining):
+        # the surviving daemon's path: SweepQueue._append re-seals the
+        # torn tail before every append, so no record is absorbed
+        from opencompass_tpu.serve.queue import SweepQueue
+        q = SweepQueue(osp.dirname(path))
+        for rec in remaining:
+            q._append(rec)
+
+    return JournalContract(
+        name='queue_journal', filename=JOURNAL_FILE,
+        make_record=make, read=read, recover_append=recover,
+        canon=lambda r: r['id'])
+
+
+def _alerts_contract() -> JournalContract:
+    def make(i):
+        return {'v': 1, 't': 'fire', 'rule': f'slo-{i:04d}',
+                'ts': 1000.0 + i, 'severity': 'page'}
+
+    def read(path):
+        from opencompass_tpu.obs import slo
+        return [r['rule'] for r in slo.iter_alerts(path)]
+
+    def recover(path, remaining):
+        from opencompass_tpu.obs import slo
+        # AlertLog.write reseals the torn tail, then single-write
+        # appends — every transition matters
+        slo.AlertLog(path).write(remaining)
+
+    return JournalContract(
+        name='alerts', filename='alerts.jsonl',
+        make_record=make, read=read, recover_append=recover,
+        canon=lambda r: r['rule'])
+
+
+def _requests_contract(filename: str, name: str) -> JournalContract:
+    def make(i):
+        return {'v': 1, 'request_id': f'req-{i:04d}',
+                'ts': 1000.0 + i, 'wall_s': 0.01 * (i + 1),
+                'route': '/v1/completions', 'status': 200}
+
+    def read(path):
+        return [r['request_id'] for r in iter_jsonl_records(
+            path, keep=lambda r: r.get('v') == 1
+            and 'request_id' in r)]
+
+    def recover(path, remaining):
+        # requests/access contract: plain re-append, no seal — the
+        # first post-crash record may be absorbed into the torn line
+        # (documented, bounded loss of exactly one telemetry record)
+        append_jsonl_atomic(path, remaining)
+
+    return JournalContract(
+        name=name, filename=filename, make_record=make, read=read,
+        recover_append=recover, canon=lambda r: r['request_id'],
+        lossy_absorb=True)
+
+
+CONTRACTS: Dict[str, Callable[[], JournalContract]] = {
+    'store_segment': _store_contract,
+    'queue_journal': _queue_contract,
+    'alerts': _alerts_contract,
+    'requests': lambda: _requests_contract('requests.jsonl',
+                                           'requests'),
+    'access': lambda: _requests_contract('access.jsonl', 'access'),
+}
+
+
+# -- the fuzz loop ----------------------------------------------------------
+
+def run_crashfuzz(contract_name: str, workdir: str, n_records: int = 16,
+                  rounds: int = 8, seed: int = 0,
+                  in_process: bool = False) -> Dict:
+    """``rounds`` randomized kill points against one journal contract.
+
+    Each round gets a fresh directory, a child writer killed at a
+    random (record, byte-offset) cut, then the three assertions from
+    the module docstring.  Raises ``AssertionError`` on the first
+    contract violation; returns a summary dict when every round holds.
+    ``in_process=True`` skips the subprocess (same bytes on disk, used
+    by quick tests where child spawn overhead dominates)."""
+    contract = CONTRACTS[contract_name]()
+    rng = random.Random(seed)
+    rounds_run = []
+    for rnd in range(rounds):
+        root = osp.join(workdir, f'{contract_name}-{rnd:03d}')
+        shutil.rmtree(root, ignore_errors=True)
+        path = osp.join(root, contract.filename)
+        os.makedirs(osp.dirname(path), exist_ok=True)
+        records = [contract.make_record(i) for i in range(n_records)]
+        cut_record = rng.randrange(n_records)
+        line = _encode(records[cut_record])
+        # strictly torn: 0 bytes (nothing landed) .. len-2 (JSON one
+        # byte short).  A cut at len-1 writes the complete JSON minus
+        # only the newline — readers legitimately recover that record
+        # (commit happens at the last JSON byte, not the '\n'), so it
+        # is not a torn case
+        cut_bytes = rng.randrange(len(line) - 1)
+        if in_process:
+            torn_write(path, records, cut_record, cut_bytes)
+        else:
+            fuzz_kill_writer(path, records, cut_record, cut_bytes)
+
+        committed = [contract.canon(r) for r in records[:cut_record]]
+        expect_all = [contract.canon(r) for r in records]
+
+        # 1. prefix recovery: exactly the committed records, in order
+        # (the store reader returns sorted keys across segments)
+        got = contract.read(path)
+        want_prefix = sorted(committed) \
+            if contract_name == 'store_segment' else committed
+        _check(list(got) == want_prefix,
+               f'{contract.name} round {rnd}: reader returned {got!r}, '
+               f'wanted committed prefix {want_prefix!r} '
+               f'(cut at record {cut_record} byte {cut_bytes})')
+
+        # 2. recovery append through the surviving-writer path; the
+        # convergence check runs on an independent byte-copy too
+        clone_root = root + '.clone'
+        shutil.rmtree(clone_root, ignore_errors=True)
+        shutil.copytree(root, clone_root)
+        clone_path = osp.join(clone_root, contract.filename)
+        remaining = records[cut_record:]
+        contract.recover_append(path, remaining)
+        contract.recover_append(clone_path, remaining)
+
+        got_all = contract.read(path)
+        want = sorted(expect_all) if contract_name == 'store_segment' \
+            else expect_all
+        if contract.lossy_absorb and cut_bytes > 0:
+            # documented absorption: torn line + first re-append merge
+            # into one garbage line readers skip
+            want2 = (committed
+                     + [contract.canon(r) for r in remaining[1:]])
+            _check(list(got_all) in (want, want2),
+                   f'{contract.name} round {rnd}: post-recovery read '
+                   f'{got_all!r} matches neither full {want!r} nor '
+                   f'absorb-one {want2!r}')
+        else:
+            _check(list(got_all) == want,
+                   f'{contract.name} round {rnd}: post-recovery read '
+                   f'{got_all!r} != {want!r} '
+                   f'(cut at record {cut_record} byte {cut_bytes})')
+
+        # 3. bit-identical convergence: same torn input + same
+        # recovery => same bytes, and re-reading is stable
+        with open(path, 'rb') as f:
+            final = f.read()
+        with open(clone_path, 'rb') as f:
+            clone_final = f.read()
+        _check(final == clone_final,
+               f'{contract.name} round {rnd}: recovery is not '
+               'deterministic — two recoveries of the same torn file '
+               'diverged')
+        _check(list(contract.read(path)) == list(got_all),
+               f'{contract.name} round {rnd}: re-read changed the '
+               'result')
+        rounds_run.append({'cut_record': cut_record,
+                           'cut_bytes': cut_bytes,
+                           'committed': len(committed)})
+        shutil.rmtree(clone_root, ignore_errors=True)
+    # fail-fast contract: any violation raised above, so a returned
+    # report IS the all-clear (no 'failures' list to mislead callers)
+    return {'contract': contract.name, 'rounds': len(rounds_run),
+            'n_records': n_records, 'cuts': rounds_run}
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog='crashfuzz',
+        description='crash-consistency fuzzer for the append-only '
+                    'journals (docs/static_analysis.md)')
+    parser.add_argument('--child', metavar='SPEC',
+                        help='internal: run the torn writer from a '
+                        'spec file and die mid-write')
+    parser.add_argument('--contract', choices=sorted(CONTRACTS),
+                        help='fuzz one contract standalone')
+    parser.add_argument('--workdir', default='/tmp/oct-crashfuzz')
+    parser.add_argument('--rounds', type=int, default=8)
+    parser.add_argument('--records', type=int, default=16)
+    parser.add_argument('--seed', type=int, default=0)
+    args = parser.parse_args(argv)
+    if args.child:
+        _child_main(args.child)    # never returns
+        return 0
+    names = [args.contract] if args.contract else sorted(CONTRACTS)
+    for name in names:
+        report = run_crashfuzz(name, args.workdir,
+                               n_records=args.records,
+                               rounds=args.rounds, seed=args.seed)
+        print(json.dumps(report))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

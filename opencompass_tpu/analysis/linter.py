@@ -1,0 +1,1002 @@
+"""``oct-lint`` — AST-based project linter for this repo's invariants.
+
+The repo encodes a handful of load-bearing conventions — single-write
+``O_APPEND`` JSONL appends with torn-line recovery, temp+``os.replace``
+atomic state files, lock-guarded engine/queue/pool state, injected
+clocks in SLO/queue-age math, and jit-friendly hot paths.  The last few
+PRs each found violations by hand in review; this module makes them
+machine-checked (``python -m opencompass_tpu.cli lint [--check]``).
+
+Rules (full rationale + examples in docs/static_analysis.md):
+
+========  ==================================================================
+OCT001    durable-append discipline: append-mode ``open()`` (or a raw
+          ``os.open`` with ``O_APPEND``) bypasses
+          ``utils.fileio.append_jsonl_atomic`` — the single-``os.write``
+          contract that makes concurrent appends record-granular and
+          torn lines recoverable.
+OCT002    atomic-replace discipline: ``json.dump`` into a file opened
+          with ``open(path, 'w')`` exposes readers to half-written
+          state; cross-process state files must go through
+          ``utils.fileio.atomic_write_json`` (or temp + ``os.replace``).
+OCT003    lock discipline: attributes annotated ``# guarded-by: <lock>``
+          in ``__init__`` may only be touched inside ``with
+          self.<lock>:`` (or from ``*_locked`` caller-holds methods).
+OCT004    thread hygiene: a ``threading.Thread`` must be
+          ``daemon=True`` or provably ``.join()``-ed — anything else
+          can outlive (and hang) interpreter shutdown.
+OCT005    clock injection: in modules marked
+          ``# oct-lint: clock-discipline``, bare ``time.time()`` is
+          forbidden outside the ``x if now is None else y`` injected-
+          clock fallback — SLO/burn-rate/queue-age math must stay
+          deterministic under an injected ``now=``.
+OCT006    host sync in hot path: ``.item()`` / ``np.asarray`` /
+          ``jax.device_get`` / ``.block_until_ready()`` inside a
+          function handed to ``jax.jit`` forces a device→host sync (or
+          a trace error) on every step.
+OCT007    retrace risk: ``jax.jit(...)(args)`` invoked immediately
+          inside a function/loop builds a fresh wrapper (and compile
+          cache) per call; list/dict literals passed in static arg
+          positions are unhashable and retrace every call.
+========  ==================================================================
+
+Suppression is always *triaged*, never wholesale:
+
+- inline pragma on the offending line (or the line above)::
+
+      # oct-lint: disable=OCT001(reason why this append is safe)
+
+  A pragma without a reason is itself a finding (OCT000).
+
+- a committed baseline (``tools/lint_baseline.json``) keyed on
+  ``(rule, path, stripped source line)`` — line-number independent, so
+  unrelated edits don't invalidate it.  Every entry carries a
+  ``reason``; ``--update-baseline --reason '...'`` adds the current
+  unsuppressed findings.
+
+Exit codes follow the repo's CI-gate convention (``ledger check``,
+``doctor --check``): ``lint`` reports and exits 0; ``lint --check``
+exits 2 on unbaselined, unpragma'd findings.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import io
+import json
+import os
+import os.path as osp
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+LINT_VERSION = 1
+
+RULES: Dict[str, str] = {
+    'OCT000': 'malformed oct-lint suppression (pragma or baseline '
+              'entry without a written reason)',
+    'OCT001': 'durable-append discipline: route appends through '
+              'utils.fileio.append_jsonl_atomic',
+    'OCT002': 'atomic-replace discipline: cross-process state files '
+              'need utils.fileio.atomic_write_json (temp+os.replace)',
+    'OCT003': 'lock discipline: guarded-by attribute touched outside '
+              'its lock',
+    'OCT004': 'thread hygiene: non-daemon thread is never joined',
+    'OCT005': 'clock injection: bare time.time() in a clock-'
+              'disciplined module',
+    'OCT006': 'host sync inside a jitted function',
+    'OCT007': 'jit retrace risk (per-call wrapper or unhashable '
+              'static arg)',
+}
+
+# modules that IMPLEMENT the disciplines are exempt from the rules that
+# reference them (paths relative to the repo root)
+_FILEIO_REL = osp.join('opencompass_tpu', 'utils', 'fileio.py')
+
+_PRAGMA_RE = re.compile(r'#\s*oct-lint:\s*(?P<body>[^#]*)')
+_DISABLE_RE = re.compile(r'disable\s*=\s*(?P<rules>.*)', re.S)
+_RULE_RE = re.compile(r'(?P<rule>OCT\d{3})')
+_GUARDED_RE = re.compile(r'#\s*guarded-by:\s*(?P<lock>[A-Za-z_][\w.:]*)')
+CLOCK_MARK = 'oct-lint: clock-discipline'
+
+_HOST_SYNC_ATTRS = ('item', 'block_until_ready')
+_HOST_SYNC_CALLS = (('np', 'asarray'), ('np', 'array'),
+                    ('numpy', 'asarray'), ('numpy', 'array'),
+                    ('onp', 'asarray'), ('jax', 'device_get'))
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str            # repo-relative, '/'-separated
+    line: int
+    msg: str
+    line_text: str       # stripped source of the offending line
+    baselined: bool = False
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.line_text)
+
+    def to_dict(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        mark = '  [baselined]' if self.baselined else ''
+        return f'{self.path}:{self.line}: {self.rule} {self.msg}{mark}'
+
+
+class _FileCtx:
+    """One parsed source file + its comment-level annotations."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel.replace(os.sep, '/')
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # parent links let rules walk ancestor chains (IfExp fallbacks,
+        # enclosing function defs) without a second visitor framework
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                child._oct_parent = node  # type: ignore[attr-defined]
+        self.pragmas: Dict[int, Dict[str, str]] = {}
+        self.bad_pragma_lines: List[int] = []
+        # real COMMENT tokens only — a docstring that *mentions* the
+        # pragma syntax (this module's own, say) must not parse as one
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except (tokenize.TokenizeError, IndentationError):
+            pass
+        self.clock_discipline = any(
+            CLOCK_MARK in c for c in self.comments.values())
+        # innermost statement span per line, so a pragma on ANY line of
+        # a multi-line statement (continuation lines included)
+        # suppresses findings anchored to its first line
+        self._stmt_spans: Dict[int, Tuple[int, int]] = {}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.stmt):
+                continue
+            start = node.lineno
+            end = getattr(node, 'end_lineno', start) or start
+            for ln in range(start, end + 1):
+                cur = self._stmt_spans.get(ln)
+                if cur is None or (end - start) < (cur[1] - cur[0]):
+                    self._stmt_spans[ln] = (start, end)
+        self._parse_pragmas()
+
+    def _parse_pragmas(self):
+        for lineno, text in self.comments.items():
+            m = _PRAGMA_RE.search(text)
+            if not m:
+                continue
+            body = m.group('body').strip()
+            if body.startswith('clock-discipline'):
+                continue
+            dm = _DISABLE_RE.match(body)
+            if not dm:
+                self.bad_pragma_lines.append(lineno)
+                continue
+            entries, malformed = _parse_disable_body(dm.group('rules'))
+            if malformed or not entries \
+                    or any(not r for r in entries.values()):
+                self.bad_pragma_lines.append(lineno)
+            if entries:
+                self.pragmas[lineno] = entries
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ''
+
+    def suppressed_at(self, rule: str, line: int) -> bool:
+        """A finding anchored to ``line`` is pragma-suppressed when any
+        line of its innermost enclosing statement — or the line just
+        above the statement — carries a ``disable=`` pragma naming the
+        rule *with a reason* (reasonless pragmas are OCT000 findings,
+        not suppressions)."""
+        start, end = self._stmt_spans.get(line, (line, line))
+        for lineno in range(start - 1, end + 1):
+            if self.pragmas.get(lineno, {}).get(rule):
+                return True
+        return False
+
+    def guarded_annotation(self, lineno: int) -> Optional[str]:
+        """``# guarded-by: <lock>`` on the line itself, or on a pure
+        comment line directly above (long assignments can't always fit
+        an inline comment)."""
+        cand = self.comments.get(lineno)
+        if cand:
+            m = _GUARDED_RE.search(cand)
+            if m:
+                return m.group('lock')
+        # line above counts only when it is a standalone comment — a
+        # trailing comment there annotates ITS OWN assignment
+        if self.line_text(lineno - 1).startswith('#'):
+            cand = self.comments.get(lineno - 1)
+            if cand:
+                m = _GUARDED_RE.search(cand)
+                if m:
+                    return m.group('lock')
+        return None
+
+
+def _parse_disable_body(body: str) -> Tuple[Dict[str, str], bool]:
+    """``OCT001(reason one),OCT004(reason (with) parens)`` → entries +
+    malformed flag.  Reasons are scanned with paren-depth counting so
+    parentheticals inside a reason survive (a plain regex cannot)."""
+    entries: Dict[str, str] = {}
+    malformed = False
+    pos, matched_any = 0, False
+    while True:
+        m = _RULE_RE.search(body, pos)
+        if not m:
+            break
+        matched_any = True
+        rule = m.group('rule')
+        i = m.end()
+        while i < len(body) and body[i].isspace():
+            i += 1
+        reason = ''
+        if i < len(body) and body[i] == '(':
+            depth, j = 1, i + 1
+            while j < len(body) and depth:
+                if body[j] == '(':
+                    depth += 1
+                elif body[j] == ')':
+                    depth -= 1
+                j += 1
+            if depth:           # unclosed paren
+                malformed = True
+                reason = body[i + 1:].strip()
+            else:
+                reason = body[i + 1:j - 1].strip()
+            i = j
+        entries[rule] = reason
+        pos = i
+    return entries, malformed or not matched_any
+
+
+# -- small AST helpers ------------------------------------------------------
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    cur = getattr(node, '_oct_parent', None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, '_oct_parent', None)
+
+
+def _call_kwarg(call: ast.Call, name: str) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _open_mode(call: ast.Call) -> Optional[str]:
+    mode = _call_kwarg(call, 'mode')
+    if mode is None and len(call.args) >= 2:
+        mode = call.args[1]
+    return _const_str(mode)
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) \
+        and _dotted(node.func) in ('jax.jit', 'jit', 'pjit', 'jax.pjit')
+
+
+# -- rule checkers ----------------------------------------------------------
+
+def _check_oct001(ctx: _FileCtx) -> List[Finding]:
+    if ctx.rel == _FILEIO_REL.replace(os.sep, '/'):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        if fn in ('open', 'io.open', 'builtins.open'):
+            mode = _open_mode(node)
+            if mode and 'a' in mode:
+                out.append(('open() in append mode bypasses the '
+                            'single-write O_APPEND discipline — use '
+                            'utils.fileio.append_jsonl_atomic for '
+                            'journals (or pragma a non-journal append '
+                            'with its reason)', node))
+        elif fn == 'os.open':
+            flags_src = ' '.join(
+                ast.dump(a) for a in list(node.args) + [
+                    kw.value for kw in node.keywords])
+            if 'O_APPEND' in flags_src:
+                out.append(('raw os.open(..., O_APPEND) outside '
+                            'utils.fileio — appends must go through '
+                            'append_jsonl_atomic or carry a pragma '
+                            'explaining the contract', node))
+    return [Finding('OCT001', ctx.rel, n.lineno, msg,
+                    ctx.line_text(n.lineno)) for msg, n in out]
+
+
+def _check_oct002(ctx: _FileCtx) -> List[Finding]:
+    if ctx.rel == _FILEIO_REL.replace(os.sep, '/'):
+        return []
+    out: List[Finding] = []
+    scopes = [n for n in ast.walk(ctx.tree)
+              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Module))]
+    for scope in scopes:
+        # this scope's OWN statements only — nested function bodies
+        # are their own scopes (a helper's os.replace must not exempt
+        # module-level dumps, nor its `with open` bind names here)
+        body_nodes = [n for n in ast.walk(scope)
+                      if not _in_other_function(n, scope)]
+        # a scope that os.replace()s is implementing the atomic
+        # pattern itself — the dump target is the temp file
+        if any(isinstance(n, ast.Call)
+               and _dotted(n.func) == 'os.replace' for n in body_nodes):
+            continue
+        write_names: Dict[str, int] = {}
+        for n in body_nodes:
+            if not isinstance(n, ast.With):
+                continue
+            for item in n.items:
+                call = item.context_expr
+                if not (isinstance(call, ast.Call)
+                        and _dotted(call.func) in ('open', 'io.open')):
+                    continue
+                mode = _open_mode(call) or 'r'
+                if 'w' in mode and 'b' not in mode \
+                        and isinstance(item.optional_vars, ast.Name):
+                    write_names[item.optional_vars.id] = n.lineno
+        for n in body_nodes:
+            if not (isinstance(n, ast.Call)
+                    and _dotted(n.func) == 'json.dump'):
+                continue
+            if _in_other_function(n, scope):
+                continue
+            target = n.args[1] if len(n.args) >= 2 else None
+            hit = (isinstance(target, ast.Name)
+                   and target.id in write_names)
+            if not hit and isinstance(target, ast.Call) \
+                    and _dotted(target.func) in ('open', 'io.open'):
+                hit = 'w' in (_open_mode(target) or '')
+            if hit:
+                out.append(Finding(
+                    'OCT002', ctx.rel, n.lineno,
+                    "json.dump into open(..., 'w') lets readers see a "
+                    'half-written file — use utils.fileio.'
+                    'atomic_write_json (temp + os.replace)',
+                    ctx.line_text(n.lineno)))
+    # de-dup (module scope re-walks function bodies)
+    seen, unique = set(), []
+    for f in out:
+        if (f.line) not in seen:
+            seen.add(f.line)
+            unique.append(f)
+    return unique
+
+
+def _in_other_function(node: ast.AST, scope: ast.AST) -> bool:
+    """True when ``node``'s nearest enclosing function is not
+    ``scope`` (module-scope walks must not re-attribute function
+    bodies)."""
+    for anc in _ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc is not scope
+    return not isinstance(scope, ast.Module)
+
+
+def _check_oct003(ctx: _FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded: Dict[str, str] = {}
+        init = next((m for m in cls.body
+                     if isinstance(m, ast.FunctionDef)
+                     and m.name == '__init__'), None)
+        if init is None:
+            continue
+        for stmt in ast.walk(init):
+            targets: List[ast.AST] = []
+            if isinstance(stmt, ast.Assign):
+                targets = stmt.targets
+            elif isinstance(stmt, ast.AnnAssign):
+                targets = [stmt.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == 'self':
+                    lock = ctx.guarded_annotation(stmt.lineno)
+                    if lock:
+                        guarded[t.attr] = lock
+        if not guarded:
+            continue
+        checkable = {a: l for a, l in guarded.items()
+                     if not l.startswith('external:')}
+        if not checkable:
+            continue
+        for method in cls.body:
+            if not isinstance(method, ast.FunctionDef):
+                continue
+            if method.name in ('__init__', '__del__') \
+                    or method.name.endswith('_locked'):
+                continue
+            out.extend(_scan_guarded(ctx, method, checkable))
+    return out
+
+
+def _scan_guarded(ctx: _FileCtx, method: ast.FunctionDef,
+                  guarded: Dict[str, str]) -> List[Finding]:
+    out: List[Finding] = []
+
+    def visit(node: ast.AST, held: frozenset):
+        if isinstance(node, ast.With):
+            locks = set()
+            for item in node.items:
+                name = _dotted(item.context_expr)
+                if name and name.startswith('self.'):
+                    locks.add(name[len('self.'):])
+            inner = held | locks
+            for item in node.items:
+                visit(item.context_expr, held)
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == 'self' and node.attr in guarded:
+            lock = guarded[node.attr]
+            if lock not in held:
+                out.append(Finding(
+                    'OCT003', ctx.rel, node.lineno,
+                    f'self.{node.attr} is guarded-by self.{lock} but '
+                    f'accessed in {method.name}() outside '
+                    f'`with self.{lock}:` (rename the method '
+                    f'*_locked if the caller holds it)',
+                    ctx.line_text(node.lineno)))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in method.body:
+        visit(stmt, frozenset())
+    return out
+
+
+def _check_oct004(ctx: _FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _dotted(node.func) in ('threading.Thread', 'Thread')):
+            continue
+        daemon = _call_kwarg(node, 'daemon')
+        if isinstance(daemon, ast.Constant) and daemon.value is True:
+            continue
+        # joined? find the assignment target and search its OWN scope
+        # (enclosing function for local names, enclosing class for
+        # self attrs, else the module) for a thread-style
+        # `<target>.join()` / `<target>.join(timeout...)` — scoping +
+        # the empty/timeout argument shape keep an unrelated same-name
+        # handle or a str.join(parts) from silencing a real
+        # never-joined thread
+        joined = False
+        parent = getattr(node, '_oct_parent', None)
+        target_res: List[str] = []
+        local_scope = True
+        _join_args = r'\.join\s*\(\s*(\)|timeout)'
+        if isinstance(parent, ast.Assign):
+            for t in parent.targets:
+                if isinstance(t, ast.Name):
+                    target_res.append(
+                        rf'\b{re.escape(t.id)}\s*(\[[^]]*\]\s*)?'
+                        + _join_args)
+                elif isinstance(t, ast.Attribute):
+                    local_scope = False   # self attr: class-wide
+                    target_res.append(
+                        rf'\.{re.escape(t.attr)}\s*' + _join_args)
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Name):
+                    target_res.append(
+                        rf'\b{re.escape(t.value.id)}\s*\[[^]]*\]\s*'
+                        + _join_args)
+        scope_node = None
+        for anc in _ancestors(node):
+            if local_scope and isinstance(anc, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef)):
+                scope_node = anc
+                break
+            if not local_scope and isinstance(anc, ast.ClassDef):
+                scope_node = anc
+                break
+        if scope_node is not None:
+            start = scope_node.lineno
+            end = getattr(scope_node, 'end_lineno', start) or start
+            haystack = '\n'.join(ctx.lines[start - 1:end])
+        else:
+            haystack = ctx.source
+        for pattern in target_res:
+            if re.search(pattern, haystack):
+                joined = True
+                break
+        if joined:
+            continue
+        out.append(Finding(
+            'OCT004', ctx.rel, node.lineno,
+            'threading.Thread is neither daemon=True nor joined — it '
+            'can outlive shutdown and hang the process',
+            ctx.line_text(node.lineno)))
+    return out
+
+
+def _is_none_compare(test: ast.AST, negated: bool) -> bool:
+    """``X is None`` (negated=False) / ``X is not None`` (negated=True)
+    with X a plain name — the injected-clock sentinel test."""
+    return (isinstance(test, ast.Compare)
+            and isinstance(test.left, ast.Name)
+            and len(test.ops) == 1
+            and isinstance(test.ops[0],
+                           ast.IsNot if negated else ast.Is)
+            and len(test.comparators) == 1
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None)
+
+
+def _clock_call_names(ctx: _FileCtx) -> set:
+    """Every spelling of the wall clock this module can reach:
+    ``time.time`` plus alias forms (``import time as t`` → ``t.time``,
+    ``from time import time [as now_fn]`` → the bare name) — an import
+    alias must not bypass the rule."""
+    names = {'time.time'}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == 'time' and alias.asname:
+                    names.add(f'{alias.asname}.time')
+        elif isinstance(node, ast.ImportFrom) and node.module == 'time':
+            for alias in node.names:
+                if alias.name == 'time':
+                    names.add(alias.asname or 'time')
+    return names
+
+
+def _check_oct005(ctx: _FileCtx) -> List[Finding]:
+    if not ctx.clock_discipline:
+        return []
+    clock_names = _clock_call_names(ctx)
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and _dotted(node.func) in clock_names):
+            continue
+        # the ONE blessed shape: time.time() as the whole fallback
+        # branch of an injected-clock conditional — `time.time() if
+        # now is None else now` / `ts if ts is not None else
+        # time.time()`.  The call must be the branch itself (not
+        # buried in arithmetic) and the test must be the matching
+        # None-check, else `(time.time() - t0) if flag else 0.0`-style
+        # wall reads would slip through
+        parent = getattr(node, '_oct_parent', None)
+        if isinstance(parent, ast.IfExp) and (
+                (parent.body is node
+                 and _is_none_compare(parent.test, negated=False))
+                or (parent.orelse is node
+                    and _is_none_compare(parent.test, negated=True))):
+            continue
+        out.append(Finding(
+            'OCT005', ctx.rel, node.lineno,
+            'bare time.time() in a clock-disciplined module — thread '
+            'an injected `now=` through (fallback shape: '
+            '`time.time() if now is None else now`)',
+            ctx.line_text(node.lineno)))
+    return out
+
+
+def _check_oct006(ctx: _FileCtx) -> List[Finding]:
+    jitted: List[ast.FunctionDef] = []
+    jit_names = set()
+    for node in ast.walk(ctx.tree):
+        if _is_jax_jit(node):
+            if node.args and isinstance(node.args[0], ast.Name):
+                jit_names.add(node.args[0].id)
+        if isinstance(node, ast.FunctionDef):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = _dotted(target)
+                if name in ('jax.jit', 'jit', 'partial',
+                            'functools.partial'):
+                    if name in ('partial', 'functools.partial'):
+                        if not (isinstance(dec, ast.Call) and dec.args
+                                and _dotted(dec.args[0])
+                                in ('jax.jit', 'jit')):
+                            continue
+                    jitted.append(node)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef) and node.name in jit_names:
+            jitted.append(node)
+    out: List[Finding] = []
+    seen = set()
+    for fn in jitted:
+        if fn.lineno in seen:
+            continue
+        seen.add(fn.lineno)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _dotted(node.func)
+            hit = None
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _HOST_SYNC_ATTRS:
+                hit = f'.{node.func.attr}()'
+            elif name and tuple(name.split('.')) in _HOST_SYNC_CALLS:
+                hit = name
+            if hit:
+                out.append(Finding(
+                    'OCT006', ctx.rel, node.lineno,
+                    f'{hit} inside jitted `{fn.name}` forces a '
+                    'device→host sync (or a tracer error) every step — '
+                    'keep host transfers outside the compiled function',
+                    ctx.line_text(node.lineno)))
+    return out
+
+
+def _check_oct007(ctx: _FileCtx) -> List[Finding]:
+    out: List[Finding] = []
+    static_positions: Dict[str, List[int]] = {}
+    for node in ast.walk(ctx.tree):
+        # jax.jit(...)(args) — a fresh wrapper (fresh compile cache)
+        # per evaluation; fine once at module import, a retrace-per-
+        # call bug inside a function or loop
+        if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+            in_fn = any(isinstance(a, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.For, ast.While))
+                        for a in _ancestors(node))
+            if in_fn:
+                out.append(Finding(
+                    'OCT007', ctx.rel, node.lineno,
+                    'jax.jit(...)(...) builds a new wrapper per '
+                    'evaluation — hoist the jitted callable out of the '
+                    'function/loop or the compile cache is discarded '
+                    'every call',
+                    ctx.line_text(node.lineno)))
+        # name = jax.jit(f, static_argnums=...) → calls of `name` with
+        # list/dict/set displays in static positions retrace per call
+        # (unhashable statics raise; fresh tuples of varying values
+        # retrace silently)
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and _is_jax_jit(node.value):
+            sa = _call_kwarg(node.value, 'static_argnums')
+            positions: List[int] = []
+            if isinstance(sa, ast.Constant) and isinstance(sa.value, int):
+                positions = [sa.value]
+            elif isinstance(sa, (ast.Tuple, ast.List)):
+                positions = [e.value for e in sa.elts
+                             if isinstance(e, ast.Constant)
+                             and isinstance(e.value, int)]
+            if positions:
+                static_positions[node.targets[0].id] = positions
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in static_positions):
+            continue
+        for pos in static_positions[node.func.id]:
+            if pos < len(node.args) and isinstance(
+                    node.args[pos], (ast.List, ast.Dict, ast.Set)):
+                out.append(Finding(
+                    'OCT007', ctx.rel, node.lineno,
+                    f'unhashable literal passed in static arg '
+                    f'position {pos} of jitted '
+                    f'`{node.func.id}` — static args must be '
+                    'hashable and call-stable or every call retraces',
+                    ctx.line_text(node.lineno)))
+    return out
+
+
+_CHECKERS = {
+    'OCT001': _check_oct001,
+    'OCT002': _check_oct002,
+    'OCT003': _check_oct003,
+    'OCT004': _check_oct004,
+    'OCT005': _check_oct005,
+    'OCT006': _check_oct006,
+    'OCT007': _check_oct007,
+}
+
+
+# -- driver ----------------------------------------------------------------
+
+def repo_root() -> str:
+    import opencompass_tpu
+    return osp.dirname(osp.dirname(osp.abspath(opencompass_tpu.__file__)))
+
+
+def default_paths() -> List[str]:
+    import opencompass_tpu
+    return [osp.dirname(osp.abspath(opencompass_tpu.__file__))]
+
+
+def default_baseline_path() -> str:
+    return osp.join(repo_root(), 'tools', 'lint_baseline.json')
+
+
+def iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if osp.isfile(path):
+            out.append(osp.abspath(path))
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in ('__pycache__', 'outputs',
+                                              '.git'))
+            for name in sorted(filenames):
+                if name.endswith('.py'):
+                    out.append(osp.join(dirpath, name))
+    return out
+
+
+@dataclasses.dataclass
+class LintReport:
+    findings: List[Finding]          # every finding incl. baselined
+    files_scanned: int
+    pragma_count: int                # reasoned disable pragmas seen
+    parse_errors: List[str]
+    stale_baseline: List[Dict]
+
+    @property
+    def active(self) -> List[Finding]:
+        """Unsuppressed, unbaselined — what ``--check`` gates on."""
+        return [f for f in self.findings if not f.baselined]
+
+    @property
+    def baselined(self) -> List[Finding]:
+        return [f for f in self.findings if f.baselined]
+
+    def by_rule(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return dict(sorted(counts.items()))
+
+    def to_dict(self) -> Dict:
+        return {
+            'v': LINT_VERSION,
+            'files_scanned': self.files_scanned,
+            'findings': [f.to_dict() for f in self.findings],
+            'active': len(self.active),
+            'baselined': len(self.baselined),
+            'by_rule': self.by_rule(),
+            'pragmas': self.pragma_count,
+            'parse_errors': self.parse_errors,
+            'stale_baseline': self.stale_baseline,
+        }
+
+
+def load_baseline(path: Optional[str]) -> Tuple[Dict[Tuple, Dict],
+                                                List[Dict]]:
+    """Baseline index keyed (rule, path, line_text) + the entries that
+    are malformed (no reason — they do NOT suppress)."""
+    if not path or not osp.isfile(path):
+        return {}, []
+    try:
+        with open(path, encoding='utf-8') as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}, []
+    index: Dict[Tuple, Dict] = {}
+    bad: List[Dict] = []
+    for entry in doc.get('entries', []):
+        key = (entry.get('rule'), entry.get('path'),
+               (entry.get('line_text') or '').strip())
+        if not (entry.get('reason') or '').strip():
+            bad.append(entry)
+            continue
+        index[key] = entry
+    return index, bad
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             baseline_path: Optional[str] = 'auto',
+             rules: Optional[Sequence[str]] = None) -> LintReport:
+    paths = list(paths) if paths else default_paths()
+    if baseline_path == 'auto':
+        baseline_path = default_baseline_path()
+    root = repo_root()
+    baseline, bad_baseline = load_baseline(baseline_path)
+    findings: List[Finding] = []
+    parse_errors: List[str] = []
+    pragma_count = 0
+    # a typo'd path must fail loudly, not scan 0 files and pass the
+    # CI gate forever
+    for p in paths:
+        if not osp.exists(p):
+            parse_errors.append(f'{p}: path does not exist')
+    files = iter_py_files([p for p in paths if osp.exists(p)])
+    active_rules = list(rules) if rules else list(_CHECKERS)
+    def _rel(path: str) -> str:
+        rel = osp.relpath(path, root) if path.startswith(root) \
+            else osp.basename(path)
+        return rel.replace(os.sep, '/')
+
+    for path in files:
+        rel = _rel(path)
+        try:
+            with open(path, encoding='utf-8') as f:
+                source = f.read()
+            ctx = _FileCtx(path, rel, source)
+        except (OSError, SyntaxError, ValueError) as exc:
+            parse_errors.append(f'{rel}: {exc}')
+            continue
+        pragma_count += sum(
+            1 for entries in ctx.pragmas.values()
+            for reason in entries.values() if reason)
+        for lineno in ctx.bad_pragma_lines:
+            findings.append(Finding(
+                'OCT000', ctx.rel, lineno,
+                'oct-lint pragma without a rule or a written reason — '
+                'suppressions are triaged, not silenced: '
+                '# oct-lint: disable=OCT00N(why this is safe)',
+                ctx.line_text(lineno)))
+        for rule in active_rules:
+            for finding in _CHECKERS[rule](ctx):
+                if ctx.suppressed_at(finding.rule, finding.line):
+                    continue
+                if finding.key() in baseline:
+                    finding.baselined = True
+                findings.append(finding)
+    for entry in bad_baseline:
+        findings.append(Finding(
+            'OCT000', str(entry.get('path')), 0,
+            f'baseline entry for {entry.get("rule")} has no written '
+            'reason — add one or drop the entry',
+            (entry.get('line_text') or '').strip()))
+    matched = {f.key() for f in findings if f.baselined}
+    # an entry is stale only when this run actually COVERED it (its
+    # rule ran and its file was scanned) and it matched nothing — a
+    # --rules/path-subset run must not smear unrelated entries
+    scanned_rels = {_rel(p) for p in files}
+    stale = [entry for key, entry in baseline.items()
+             if key not in matched
+             and entry.get('rule') in active_rules
+             and entry.get('path') in scanned_rels]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return LintReport(findings=findings, files_scanned=len(files),
+                      pragma_count=pragma_count,
+                      parse_errors=parse_errors, stale_baseline=stale)
+
+
+def update_baseline(report: LintReport, path: str, reason: str):
+    """Fold the report's active findings into the baseline at ``path``
+    with one shared ``reason`` (triage note), and prune the entries
+    this report proved stale (rule ran, file scanned, nothing
+    matched) — so "re-run --update-baseline" really clears them."""
+    index, bad = load_baseline(path)
+    for entry in report.stale_baseline:
+        index.pop((entry.get('rule'), entry.get('path'),
+                   (entry.get('line_text') or '').strip()), None)
+    for f in report.active:
+        if f.rule == 'OCT000':
+            continue
+        index[f.key()] = {'rule': f.rule, 'path': f.path,
+                          'line_text': f.line_text, 'reason': reason}
+    entries = sorted(index.values(),
+                     key=lambda e: (e['path'], e['rule'],
+                                    e['line_text']))
+    doc = {'v': LINT_VERSION,
+           'about': 'oct-lint triaged findings; every entry needs a '
+                    'written reason (docs/static_analysis.md)',
+           'entries': entries + bad}
+    tmp = path + '.tmp'
+    os.makedirs(osp.dirname(osp.abspath(path)), exist_ok=True)
+    with open(tmp, 'w', encoding='utf-8') as f:
+        json.dump(doc, f, indent=2, sort_keys=False)
+        f.write('\n')
+    os.replace(tmp, path)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog='lint',
+        description='oct-lint: project invariants as machine-checked '
+                    'rules (OCT001..OCT007; docs/static_analysis.md)')
+    parser.add_argument('paths', nargs='*',
+                        help='files/dirs to lint (default: the '
+                        'opencompass_tpu package)')
+    parser.add_argument('--check', action='store_true',
+                        help='CI gate: exit 2 when any unbaselined, '
+                        'unpragma-ed finding remains (ledger check / '
+                        'doctor --check convention)')
+    parser.add_argument('--json', action='store_true',
+                        help='emit the full report as JSON')
+    parser.add_argument('--baseline', default='auto',
+                        help='baseline file (default '
+                        'tools/lint_baseline.json; "none" disables)')
+    parser.add_argument('--update-baseline', action='store_true',
+                        help='fold current active findings into the '
+                        'baseline (requires --reason)')
+    parser.add_argument('--reason', default=None,
+                        help='triage reason recorded with '
+                        '--update-baseline entries')
+    parser.add_argument('--rules', default=None,
+                        help='comma-separated rule subset '
+                        '(e.g. OCT001,OCT005)')
+    parser.add_argument('--show-baselined', action='store_true',
+                        help='also print baselined findings')
+    parser.add_argument('--list-rules', action='store_true')
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in RULES.items():
+            print(f'{rule}  {desc}')
+        return 0
+
+    baseline = None if args.baseline == 'none' else args.baseline
+    rules = args.rules.split(',') if args.rules else None
+    if rules:
+        unknown = [r for r in rules if r not in _CHECKERS]
+        if unknown:
+            print(f'unknown rule(s): {",".join(unknown)} '
+                  f'(known: {",".join(_CHECKERS)})')
+            return 1
+    report = run_lint(args.paths or None, baseline_path=baseline,
+                      rules=rules)
+
+    if args.update_baseline:
+        if not (args.reason or '').strip():
+            print('--update-baseline requires --reason "<why these '
+                  'findings are accepted>" (triaged, not silenced)')
+            return 1
+        path = baseline if baseline not in (None, 'auto') \
+            else default_baseline_path()
+        update_baseline(report, path, args.reason.strip())
+        print(f'baseline updated: {path} '
+              f'({len(report.active)} finding(s) folded in)')
+        return 0
+
+    if args.json:
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        shown = report.findings if args.show_baselined \
+            else report.active
+        for f in shown:
+            print(f.render())
+        for err in report.parse_errors:
+            print(f'PARSE ERROR: {err}')
+        bits = [f'{report.files_scanned} file(s)',
+                f'{len(report.active)} finding(s)',
+                f'{len(report.baselined)} baselined',
+                f'{report.pragma_count} pragma(s)']
+        if report.stale_baseline:
+            bits.append(f'{len(report.stale_baseline)} stale baseline '
+                        'entr(ies) — re-run --update-baseline')
+        print('oct-lint: ' + ', '.join(bits))
+    if args.check and (report.active or report.parse_errors):
+        return 2
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

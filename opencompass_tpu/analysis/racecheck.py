@@ -1,0 +1,214 @@
+"""Instrumented-lock harness: record cross-thread lock acquisition
+order, fail on lock-order inversions.
+
+A deadlock needs two ingredients: two locks, and two threads that
+acquire them in opposite orders.  The second ingredient is *timing* —
+a test suite can pass for months on lucky interleavings and hang in
+production on the unlucky one.  This harness removes the timing from
+the detection: every instrumented acquisition while other instrumented
+locks are held adds a directed edge ``held → acquired`` to a global
+order graph, and a **cycle** in that graph is an inversion — the
+deadlock exists as soon as both orders have ever been *observed*, on
+any interleaving, even one that happened not to deadlock.
+
+Usage in a concurrency test::
+
+    rc = RaceCheck()
+    rc.instrument(engine, '_lock', 'engine._lock')
+    rc.instrument(engine, '_driver', 'engine._driver')
+    ... drive threads ...
+    rc.assert_clean()          # raises LockOrderInversion on a cycle
+
+``instrument`` swaps the attribute for a :class:`TrackedLock` proxy in
+place (same acquire/release/context-manager surface, ~a dict update of
+overhead per acquisition), so production code runs unmodified.
+Re-entrant acquisition of the same named lock records nothing — an
+RLock's re-acquire is not an ordering event.
+
+The harness never *prevents* anything: it is a recorder plus an
+assertion, safe to leave enabled for a whole test module.
+"""
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+
+class LockOrderInversion(AssertionError):
+    """Two instrumented locks have been acquired in both orders —
+    the interleaving that takes them concurrently deadlocks."""
+
+
+class _HeldStack(threading.local):
+    def __init__(self):
+        self.names: List[str] = []
+
+
+class TrackedLock:
+    """Proxy around a ``threading.Lock``/``RLock`` reporting
+    acquisitions to a :class:`RaceCheck` registry.  Supports the full
+    lock surface the repo uses: ``acquire(blocking=, timeout=)``,
+    ``release``, ``with``, ``locked``."""
+
+    def __init__(self, name: str, registry: 'RaceCheck',
+                 lock=None):
+        self.name = name
+        self._registry = registry
+        self._lock = lock if lock is not None else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._lock.acquire(blocking, timeout)
+        if got:
+            self._registry._note_acquire(self.name)
+        return got
+
+    def release(self):
+        # delegate FIRST: a bogus release (lock not held) must raise
+        # without erasing a genuinely-held acquisition from the
+        # recorder's per-thread stack
+        self._lock.release()
+        self._registry._note_release(self.name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._lock.locked()
+
+
+class RaceCheck:
+    """One acquisition-order graph shared by every lock it wraps."""
+
+    def __init__(self, keep_stacks: bool = True):
+        self._mu = threading.Lock()
+        # (held, acquired) -> {'count', 'threads', 'stack'}
+        self._edges: Dict[Tuple[str, str], Dict] = {}
+        self._held = _HeldStack()
+        self._keep_stacks = keep_stacks
+        self.acquisitions = 0
+
+    # -- instrumentation ---------------------------------------------------
+
+    def wrap(self, name: str, lock=None) -> TrackedLock:
+        """A fresh (or wrapped existing) lock reporting to this
+        registry."""
+        return TrackedLock(name, self, lock)
+
+    def instrument(self, obj, attr: str,
+                   name: Optional[str] = None) -> TrackedLock:
+        """Swap ``obj.<attr>`` (an existing threading lock) for a
+        tracked proxy in place; returns the proxy.  Idempotent for
+        THIS registry; a proxy left behind by another RaceCheck is
+        re-bound (its underlying lock re-wrapped) so acquisitions
+        report here, never silently to the dead registry."""
+        current = getattr(obj, attr)
+        if isinstance(current, TrackedLock):
+            if current._registry is self:
+                return current
+            current = current._lock     # unwrap the foreign proxy
+        tracked = TrackedLock(
+            name or f'{type(obj).__name__}.{attr}', self, current)
+        setattr(obj, attr, tracked)
+        return tracked
+
+    # -- recording ---------------------------------------------------------
+
+    def _note_acquire(self, name: str):
+        held = self._held.names
+        if name in held:          # re-entrant: not an ordering event
+            held.append(name)
+            return
+        if held:
+            thread = threading.current_thread().name
+            with self._mu:
+                self.acquisitions += 1
+                for h in set(held):
+                    edge = self._edges.setdefault(
+                        (h, name),
+                        {'count': 0, 'threads': set(), 'stack': None})
+                    edge['count'] += 1
+                    edge['threads'].add(thread)
+                    if edge['stack'] is None and self._keep_stacks:
+                        edge['stack'] = ''.join(
+                            traceback.format_stack(limit=8)[:-2])
+        else:
+            with self._mu:
+                self.acquisitions += 1
+        held.append(name)
+
+    def _note_release(self, name: str):
+        held = self._held.names
+        # releases need not be LIFO (python allows any order): drop the
+        # most recent occurrence
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    # -- verdicts ----------------------------------------------------------
+
+    def edges(self) -> Dict[Tuple[str, str], Dict]:
+        with self._mu:
+            return {k: dict(v) for k, v in self._edges.items()}
+
+    def cycles(self) -> List[List[str]]:
+        """Every elementary cycle reachable in the order graph (DFS;
+        the graphs here are a handful of nodes)."""
+        graph: Dict[str, Set[str]] = {}
+        with self._mu:
+            for (a, b) in self._edges:
+                graph.setdefault(a, set()).add(b)
+                graph.setdefault(b, set())
+        out: List[List[str]] = []
+        seen_cycles = set()
+
+        def dfs(start: str, node: str, path: List[str],
+                on_path: Set[str]):
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start:
+                    cyc = path[:]
+                    # key on the SEQUENCE (anchored at the smallest
+                    # node): A→B→C→A and A→C→B→A share a node set but
+                    # are two distinct inversions, both reported
+                    key = tuple(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(cyc + [start])
+                elif nxt not in on_path and nxt > start:
+                    # only expand nodes > start so each cycle is found
+                    # once, from its smallest node
+                    dfs(start, nxt, path + [nxt], on_path | {nxt})
+
+        for node in sorted(graph):
+            dfs(node, node, [node], {node})
+        return out
+
+    def check(self):
+        """Raise :class:`LockOrderInversion` when the observed order
+        graph contains a cycle, with per-edge thread attribution."""
+        cycles = self.cycles()
+        if not cycles:
+            return
+        lines = [f'{len(cycles)} lock-order inversion(s) observed:']
+        edges = self.edges()
+        for cyc in cycles:
+            lines.append('  cycle: ' + ' -> '.join(cyc))
+            for a, b in zip(cyc, cyc[1:]):
+                info = edges.get((a, b), {})
+                threads = ','.join(sorted(info.get('threads', ()))) \
+                    or '?'
+                lines.append(f'    {a} -> {b}  (x{info.get("count", 0)}'
+                             f' by {threads})')
+                if info.get('stack'):
+                    first = info['stack'].strip().splitlines()
+                    lines.extend(f'      {ln}' for ln in first[-4:])
+        raise LockOrderInversion('\n'.join(lines))
+
+    # alias reading better in tests
+    assert_clean = check

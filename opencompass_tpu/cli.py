@@ -36,6 +36,12 @@ Usage::
                     # ranked findings (stragglers, compile storms, SLO
                     # breaches by phase...) from a run work_dir or serve
                     # cache root; --check exits 2 on error findings (CI)
+    python -m opencompass_tpu.cli lint              # oct-lint
+                    # AST-checked project invariants (OCT001..OCT007:
+                    # durable appends, atomic state writes, guarded-by
+                    # locks, thread hygiene, clock injection, jit
+                    # hygiene); --check exits 2 on unbaselined findings
+                    # (CI), --json for tooling (docs/static_analysis.md)
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files
@@ -307,6 +313,21 @@ def doctor_main(argv=None) -> int:
     return doctor_cli_main(argv)
 
 
+def lint_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli lint [--check] [--json]`` —
+    oct-lint, the project's invariant-enforcing static analyzer: seven
+    AST-checked rules (single-write O_APPEND append discipline, atomic
+    temp+replace state files, ``# guarded-by:`` lock annotations,
+    thread hygiene, injected-clock discipline, host-sync and retrace
+    hazards in jitted code).  Suppressions are triaged through inline
+    ``# oct-lint: disable=RULE(reason)`` pragmas and the committed
+    ``tools/lint_baseline.json``; ``--check`` exits 2 on anything
+    unbaselined, same CI convention as ``ledger check`` / ``doctor
+    --check`` (docs/static_analysis.md)."""
+    from opencompass_tpu.analysis.linter import main as linter_main
+    return linter_main(argv)
+
+
 def serve_main(argv=None) -> int:
     """``python -m opencompass_tpu.cli serve <config> [--port N]`` —
     the persistent evaluation engine: durable FIFO sweep queue under
@@ -338,6 +359,8 @@ def main():
         raise SystemExit(ledger_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'doctor':
         raise SystemExit(doctor_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == 'lint':
+        raise SystemExit(lint_main(sys.argv[2:]))
     args = parse_args()
     cfg = get_config_from_arg(args)
     work_dir = cfg['work_dir']

@@ -299,9 +299,12 @@ class BaseInferencer:
 
 
 def dump_results_dict(results_dict, filename):
-    os.makedirs(os.path.dirname(os.path.abspath(filename)), exist_ok=True)
-    with open(filename, 'w', encoding='utf-8') as f:
-        json.dump(results_dict, f, indent=4, ensure_ascii=False)
+    # prediction files are the infer phase's completion markers (resume
+    # = file exists) AND the store's byte-identity inputs: atomic
+    # replace with the exact historical serialization
+    from opencompass_tpu.utils.fileio import atomic_write_json
+    atomic_write_json(filename, results_dict,
+                      dump_kwargs={'indent': 4, 'ensure_ascii': False})
 
 
 def load_results_dict(filename):

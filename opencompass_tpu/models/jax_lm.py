@@ -128,7 +128,9 @@ class ContinuousEngine:
         self.pool = init_page_pool(self.cfg, self.num_pages, page_size)
         self.alloc = PageAllocator(self.num_pages)
         self.table = PageTable(self.slots, self.max_pages)
+        # guarded-by: _lock
         self._slots: List[Optional[_EngineRow]] = [None] * self.slots
+        # guarded-by: _lock
         self._queue: 'collections.deque[_EngineRow]' = collections.deque()
         self._lock = threading.Lock()         # queue/slots/alloc/stats
         self._driver = threading.Lock()       # one stepping thread
@@ -161,6 +163,7 @@ class ContinuousEngine:
         self.joined = 0
         self.retired = 0
         self._retire_seq = 0
+        # guarded-by: _lock
         self._occ_series: 'collections.deque[int]' = collections.deque(
             maxlen=4096)
         # decode-ready rows idled by a prefill step, summed over steps:
@@ -172,6 +175,7 @@ class ContinuousEngine:
         # the tail.  Schema: {'k': 'p'|'d', 'w': wall_s, 'pf':
         # prefilling rows, 'dc': decoding rows, 'st': decode-ready
         # rows stalled behind the prefill chunk, 'ret': retired}
+        # guarded-by: _lock
         self._step_records: 'collections.deque[Dict]' = \
             collections.deque(maxlen=4096)
         # roofline accounting (obs/costmodel.py): exact per-engine
@@ -885,6 +889,7 @@ class JaxLM(BaseModel):
                 # on one 16 GB chip) — see nn/quant.init_packed_params
                 from opencompass_tpu.nn.quant import init_packed_params
                 cfg = self.cfg
+                # oct-lint: disable=OCT007(one-shot fused init program per model build; the wrapper is intentionally discarded)
                 self.params = jax.jit(
                     lambda key: init_packed_params(cfg, key))(
                         jax.random.PRNGKey(seed))
@@ -897,6 +902,7 @@ class JaxLM(BaseModel):
                 from opencompass_tpu.nn.quant import quantize_params
                 cfg = self.cfg
                 mode = self._weight_mode
+                # oct-lint: disable=OCT007(one-shot fused init+quantize program per model build; the wrapper is intentionally discarded)
                 self.params = jax.jit(
                     lambda key: quantize_params(init_params(cfg, key),
                                                 cfg, mode=mode))(

@@ -68,7 +68,12 @@ class PageAllocator:
         if num_pages < 2:
             raise ValueError('need >= 2 pages (page 0 is reserved)')
         self.num_pages = num_pages
+        # the allocator itself is lock-free: every caller mutates it
+        # under the engine's state lock (documented, not lexically
+        # checkable from this file)
+        # guarded-by: external:ContinuousEngine._lock
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        # guarded-by: external:ContinuousEngine._lock
         self._allocated: set = set()
         # pool-pressure telemetry (obs/costmodel roofline plane): the
         # occupancy high-water mark and how many allocations bounced on

@@ -141,8 +141,17 @@ def _quantize_weight(w, axis: int, mode: str):
         # is the KV cache, which is created inside the decode program.
         store = np.int8 if xp is np else None
         return _quantize_math(w, axis, xp, mode, store_dtype=store)
+    return _jitted_quantize(axis, mode)(w)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_quantize(axis: int, mode: str):
+    """One jitted per-leaf quantizer per (axis, mode) — a fresh
+    ``jax.jit`` wrapper per call would discard its compile cache and
+    retrace every leaf (oct-lint OCT007 caught this)."""
+    import jax
     return jax.jit(functools.partial(_quantize_math, axis=axis, xp=jnp,
-                                     mode=mode))(w)
+                                     mode=mode))
 
 
 def init_packed_params(cfg, key):

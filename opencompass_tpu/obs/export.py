@@ -31,7 +31,6 @@ the op-level story sits next to the scheduling story.
 """
 from __future__ import annotations
 
-import json
 import os.path as osp
 from typing import Dict, List, Optional
 
@@ -332,6 +331,10 @@ def export_chrome_trace(work_dir: str, out_path: str,
                         trace: Optional[str] = None) -> Dict:
     """Write the Chrome trace JSON and return it (CLI body)."""
     doc = build_chrome_trace(work_dir, trace=trace)
-    with open(out_path, 'w', encoding='utf-8') as f:
-        json.dump(doc, f, separators=(',', ':'), default=str)
+    # atomic: Perfetto chokes on a truncated trace, and exports can be
+    # re-run against the same out_path while a viewer has it open
+    from opencompass_tpu.utils.fileio import atomic_write_json
+    atomic_write_json(out_path, doc,
+                      dump_kwargs={'separators': (',', ':'),
+                                   'default': str})
     return doc

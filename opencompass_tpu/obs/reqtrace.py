@@ -42,6 +42,10 @@ the engine's event stream.
 """
 from __future__ import annotations
 
+# oct-lint: clock-discipline — rolling windows/latency percentiles
+# evaluate under an injected now=/ts=; bare time.time() only as the
+# `if now is None` fallback.
+
 import contextvars
 import json
 import math
@@ -454,15 +458,19 @@ class RollingStats:
 
 # -- engine discovery (`cli top`) ------------------------------------------
 
-def write_engine_info(obs_root: str, port: int, run_dir: str):
+def write_engine_info(obs_root: str, port: int, run_dir: str,
+                      now: Optional[float] = None):
     """Advertise the live engine under the cache root (atomic; never
-    raises) — how ``cli top <cache_root>`` finds ``/v1/stats``."""
+    raises) — how ``cli top <cache_root>`` finds ``/v1/stats``.  The
+    ``ts`` feeds `top`'s uptime column; ``now`` injects it for
+    deterministic snapshots."""
     try:
         from opencompass_tpu.utils.fileio import atomic_write_json
         atomic_write_json(
             osp.join(obs_root, ENGINE_INFO_FILE),
             {'v': REQTRACE_VERSION, 'port': port, 'pid': os.getpid(),
-             'run_dir': run_dir, 'ts': round(time.time(), 3)})
+             'run_dir': run_dir,
+             'ts': round(time.time() if now is None else now, 3)})
     except Exception:
         pass
 

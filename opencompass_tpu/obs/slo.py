@@ -46,6 +46,9 @@ at **load** time, not at 3 a.m.
 """
 from __future__ import annotations
 
+# oct-lint: clock-discipline — burn-rate windows evaluate under an
+# injected now=; bare time.time() only as the `if now is None` fallback.
+
 import os.path as osp
 import threading
 import time
@@ -255,6 +258,7 @@ class AlertLog:
                 f.seek(-1, os.SEEK_END)
                 torn = f.read(1) != b'\n'
             if torn:
+                # oct-lint: disable=OCT001(tail seal: writes exactly one newline to cap a dead writer's torn line, the recovery contract itself)
                 with open(self.path, 'ab') as f:
                     f.write(b'\n')
         except (OSError, ValueError):

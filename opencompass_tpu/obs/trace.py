@@ -69,6 +69,7 @@ class _JsonlSink:
         self.path = path
         os.makedirs(osp.dirname(osp.abspath(path)), exist_ok=True)
         self._lock = threading.Lock()
+        # oct-lint: disable=OCT001(single-writer buffered handle, lock-serialized flush-per-line; readers skip the one possible torn tail)
         self._fh = open(path, 'a', encoding='utf-8')
 
     def write(self, record: Dict):

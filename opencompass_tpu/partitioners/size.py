@@ -15,7 +15,6 @@ from __future__ import annotations
 import copy
 import json
 import math
-import os
 import os.path as osp
 from typing import Dict, List, Union
 
@@ -156,10 +155,11 @@ class SizePartitioner(BasePartitioner):
         if abbr not in self._size_cache:
             dataset = build_dataset_from_cfg(base_cfg)
             self._size_cache[abbr] = len(dataset.test)
-            os.makedirs(osp.dirname(self.dataset_size_path) or '.',
-                        exist_ok=True)
-            with open(self.dataset_size_path, 'w') as f:
-                json.dump(self._size_cache, f, indent=2)
+            # cross-process state file (concurrent partitioners share
+            # it): temp + os.replace so a reader never sees a torn cache
+            from opencompass_tpu.utils.fileio import atomic_write_json
+            atomic_write_json(self.dataset_size_path, self._size_cache,
+                              dump_kwargs={'indent': 2})
         size = self._size_cache[abbr]
         if test_range:
             size = len(range(size)[_parse_slice(test_range)])

@@ -27,6 +27,10 @@ recompute only the rows the dead daemon never committed.
 """
 from __future__ import annotations
 
+# oct-lint: clock-discipline — queue-age math must be deterministic
+# under an injected now= (SLO tests, dashboard snapshots); bare
+# time.time() only as the `if now is None` fallback.
+
 import json
 import os
 import os.path as osp
@@ -94,7 +98,9 @@ class SweepQueue:
         # bytes appended since — the daemon polls the queue ~4x/s and
         # /metrics scrapes add more, so full-journal replay per call
         # would grow O(lifetime sweeps) forever
+        # guarded-by: _replay_lock
         self._replay: 'OrderedDict[str, Dict]' = OrderedDict()
+        # guarded-by: _replay_lock
         self._replay_offset = 0
         self._replay_lock = threading.Lock()
         self._seal_torn_tail()
@@ -125,6 +131,7 @@ class SweepQueue:
                 f.seek(-1, os.SEEK_END)
                 torn = f.read(1) != b'\n'
             if torn:
+                # oct-lint: disable=OCT001(tail seal: single newline capping a dead writer's torn line — the recovery contract itself)
                 fd = os.open(self.journal_path,
                              os.O_WRONLY | os.O_APPEND)
                 try:
@@ -142,13 +149,16 @@ class SweepQueue:
                 work_dir: Optional[str] = None,
                 mode: str = 'all',
                 sweep_id: Optional[str] = None,
-                label: Optional[str] = None) -> Dict:
+                label: Optional[str] = None,
+                now: Optional[float] = None) -> Dict:
         """Append one sweep request; returns its journal record.
 
         ``config_text`` (an inline Python config, the HTTP body case) is
         persisted to ``configs/<id>.py`` first so the journal only ever
         references files — a claimed sweep must be runnable after the
-        submitting client is gone."""
+        submitting client is gone.  ``now`` injects the submission
+        timestamp (queue-age math downstream stays deterministic in
+        tests); default wall clock."""
         if not config_path and not config_text:
             raise ValueError('enqueue needs config_path or config_text')
         sweep_id = sweep_id or new_sweep_id()
@@ -159,13 +169,14 @@ class SweepQueue:
                 f.write(config_text)
             os.replace(tmp, config_path)
         rec = {'v': QUEUE_VERSION, 'op': 'enqueue', 'id': sweep_id,
-               'ts': round(time.time(), 3),
+               'ts': round(time.time() if now is None else now, 3),
                'config_path': osp.abspath(config_path),
                'work_dir': work_dir, 'mode': mode, 'label': label}
         self._append(rec)
         return rec
 
-    def cancel(self, sweep_id: str) -> bool:
+    def cancel(self, sweep_id: str,
+               now: Optional[float] = None) -> bool:
         """Cancel a *queued* sweep.  Returns False when the sweep is
         unknown, already terminal, or currently claimed by a live
         daemon — a running sweep finishes (its rows are store commits
@@ -174,14 +185,17 @@ class SweepQueue:
         if rec is None or rec['status'] != 'queued':
             return False
         self._append({'v': QUEUE_VERSION, 'op': 'cancel', 'id': sweep_id,
-                      'ts': round(time.time(), 3)})
+                      'ts': round(time.time() if now is None else now,
+                                  3)})
         return True
 
     def mark_done(self, sweep_id: str, ok: bool = True,
-                  detail: Optional[Dict] = None):
+                  detail: Optional[Dict] = None,
+                  now: Optional[float] = None):
         """Terminal journal record + claim release."""
         rec = {'v': QUEUE_VERSION, 'op': 'done' if ok else 'failed',
-               'id': sweep_id, 'ts': round(time.time(), 3)}
+               'id': sweep_id,
+               'ts': round(time.time() if now is None else now, 3)}
         if detail:
             rec['detail'] = detail
         self._append(rec)
@@ -224,7 +238,8 @@ class SweepQueue:
         except (OSError, ValueError):
             return None
 
-    def claim_next(self, owner: str = 'daemon') -> Optional[Dict]:
+    def claim_next(self, owner: str = 'daemon',
+                   now: Optional[float] = None) -> Optional[Dict]:
         """Atomically take the oldest queued sweep; None when the queue
         is drained.  Stale claims (dead owner pid) are broken here, so a
         restarted daemon resumes a preempted sweep without a separate
@@ -249,7 +264,8 @@ class SweepQueue:
                         pass
                 claim = {'v': QUEUE_VERSION, 'id': sweep_id,
                          'owner': owner, 'pid': os.getpid(),
-                         'ts': round(time.time(), 3)}
+                         'ts': round(time.time() if now is None
+                                     else now, 3)}
                 try:
                     fd = os.open(path,
                                  os.O_WRONLY | os.O_CREAT | os.O_EXCL,
@@ -294,8 +310,9 @@ class SweepQueue:
 
     # -- read side ---------------------------------------------------------
 
-    def _apply_record(self, rec: Dict):
-        """Fold one journal record into the replay cache."""
+    def _apply_record_locked(self, rec: Dict):
+        """Fold one journal record into the replay cache (caller holds
+        ``_replay_lock``)."""
         op, sweep_id = rec.get('op'), rec.get('id')
         if not sweep_id:
             return
@@ -350,7 +367,7 @@ class SweepQueue:
                 except ValueError:
                     continue  # sealed torn line: one skippable garbage row
                 if isinstance(rec, dict):
-                    self._apply_record(rec)
+                    self._apply_record_locked(rec)
             self._replay_offset += end + 1
 
     def state(self) -> 'OrderedDict[str, Dict]':

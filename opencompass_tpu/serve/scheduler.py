@@ -209,10 +209,12 @@ class WorkerPool:
         self.alloc = alloc
         self.free = free
         self._lock = threading.Lock()
+        # guarded-by: _lock
         self._workers: Dict[str, ResidentWorker] = {}
         # live-but-replaced residents (an under-provisioned worker whose
         # leases were in flight when a bigger sibling took its key):
         # unreachable for new leases, retired by the reaper once drained
+        # guarded-by: _lock
         self._orphans: List[ResidentWorker] = []
         self._spawns = 0
         self._reuses = 0

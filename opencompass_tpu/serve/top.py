@@ -20,6 +20,10 @@ Data sources, in order of preference:
 """
 from __future__ import annotations
 
+# oct-lint: clock-discipline — snapshot/age math renders from the
+# snapshot's own `ts` under an injected now= (deterministic dashboard
+# tests); bare time.time() only as the `if now is None` fallback.
+
 import json
 import os
 import os.path as osp
@@ -61,12 +65,17 @@ def _http_json(port: int, path: str, timeout: float = 3.0):
 
 
 def gather(cache_root: str,
-           window_s: float = DEFAULT_WINDOW_S) -> Dict:
+           window_s: float = DEFAULT_WINDOW_S,
+           now: Optional[float] = None) -> Dict:
     """One dashboard snapshot: engine liveness, ``/v1/stats`` (when
     reachable), file-derived queue counts and the request-record tail
-    (always — the sparklines come from requests.jsonl either way)."""
+    (always — the sparklines come from requests.jsonl either way).
+    ``now`` injects the snapshot clock — every age/window computed here
+    or by :func:`render` derives from ``snap['ts']``, so a test (or a
+    replay) with a pinned ``now`` is fully deterministic."""
     obs_root = reqtrace.serve_obs_dir(cache_root)
-    snap: Dict = {'cache_root': cache_root, 'ts': time.time(),
+    snap: Dict = {'cache_root': cache_root,
+                  'ts': time.time() if now is None else now,
                   'engine': None, 'alive': False, 'stats': None,
                   'serve': None}
     info = reqtrace.read_engine_info(obs_root)
@@ -106,7 +115,8 @@ def gather(cache_root: str,
         if osp.isdir(queue_root):
             try:
                 from opencompass_tpu.serve.queue import SweepQueue
-                pressure = SweepQueue(queue_root).pressure()
+                pressure = SweepQueue(queue_root).pressure(
+                    now=snap['ts'])
                 counts = pressure['counts']
                 snap['serve'] = {
                     'queue_depth': counts.get('queued', 0),
@@ -195,7 +205,7 @@ def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
     if active:
         src = ' (from files)' if alerts.get('from_files') else ''
         lines.append(f'alerts: {len(active)} firing{src}')
-        now = snap.get('ts') or time.time()
+        now = snap.get('ts') or 0.0   # ages keyed to the snapshot clock
         for a in active:
             rule = a.get('rule', '?')
             sev = (a.get('severity') or '?').upper()
@@ -236,7 +246,7 @@ def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
         lines.append('completions: ' + '  '.join(bits))
     requests = snap.get('requests') or []
     if requests:
-        now = snap.get('ts') or time.time()
+        now = snap.get('ts') or 0.0   # sparkline bins on snapshot clock
         cps, p99 = _series(requests, now, window_s)
         lines.append('  cps ' + _sparkline(cps)
                      + f'  (peak {max(cps):.2f}/s)')

@@ -148,7 +148,10 @@ class ModelEvaluator:
             if result is None:
                 continue
             results[dataset_abbr] = result
-            with open(osp.join(out_dir, f'{dataset_abbr}.json'), 'w') as f:
-                json.dump(result, f, indent=2)
+            # completion-keyed output: resume skips datasets whose file
+            # exists, so the write must be atomic (no torn half-result)
+            from opencompass_tpu.utils.fileio import atomic_write_json
+            atomic_write_json(osp.join(out_dir, f'{dataset_abbr}.json'),
+                              result, dump_kwargs={'indent': 2})
             logger.info(f'{dataset_abbr} judge scores: {result["scores"]}')
         return results

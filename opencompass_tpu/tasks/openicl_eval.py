@@ -8,7 +8,6 @@ Parity: reference tasks/openicl_eval.py:17-178.
 from __future__ import annotations
 
 import json
-import os
 import os.path as osp
 from typing import Dict, List, Optional
 
@@ -194,6 +193,8 @@ class OpenICLEvalTask(BaseTask):
             return
         logger.info(f'Task {self.name}: {result}')
 
-        os.makedirs(osp.dirname(out_path), exist_ok=True)
-        with open(out_path, 'w') as f:
-            json.dump(result, f, ensure_ascii=False, indent=4)
+        # completion-keyed output (resume checks file existence): atomic
+        # write, byte-identical serialization to the old open('w') path
+        from opencompass_tpu.utils.fileio import atomic_write_json
+        atomic_write_json(out_path, result,
+                          dump_kwargs={'ensure_ascii': False, 'indent': 4})

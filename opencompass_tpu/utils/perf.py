@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import json
 import os
 import time
 from typing import Optional
@@ -197,10 +196,12 @@ class TaskProfiler:
         self.record = record
         if self.out_path:
             try:
-                os.makedirs(os.path.dirname(os.path.abspath(self.out_path)),
-                            exist_ok=True)
-                with open(self.out_path, 'w') as f:
-                    json.dump(record, f, indent=2)
+                # atomic: the summarizer and the obs report read perf
+                # records from live runs — a torn JSON would drop the
+                # task from both tables
+                from opencompass_tpu.utils.fileio import atomic_write_json
+                atomic_write_json(self.out_path, record,
+                                  dump_kwargs={'indent': 2})
             except Exception as write_exc:  # never mask the task's outcome
                 logger.warning(f'perf record write failed: {write_exc}')
         return False

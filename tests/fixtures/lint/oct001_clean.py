@@ -1,0 +1,11 @@
+"""OCT001 clean: appends ride the single-write helper; reads are fine."""
+from opencompass_tpu.utils.fileio import append_jsonl_atomic
+
+
+def log_event(path, rec):
+    append_jsonl_atomic(path, [rec])
+
+
+def read_back(path):
+    with open(path, encoding='utf-8') as f:   # read mode: not flagged
+        return f.read()

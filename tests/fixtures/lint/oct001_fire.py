@@ -1,0 +1,14 @@
+"""OCT001 firing: JSONL append through bare open()/os.open."""
+import json
+import os
+
+
+def log_event(path, rec):
+    with open(path, 'a') as f:          # torn-line hazard: OCT001
+        f.write(json.dumps(rec) + '\n')
+
+
+def raw_append(path, data):
+    fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND)  # OCT001
+    os.write(fd, data)
+    os.close(fd)

@@ -1,0 +1,15 @@
+"""OCT002 clean: atomic helper, or an explicit temp + os.replace."""
+import json
+import os
+
+
+def save_state(path, state):
+    from opencompass_tpu.utils.fileio import atomic_write_json
+    atomic_write_json(path, state)
+
+
+def save_state_by_hand(path, state):
+    tmp = path + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(state, f)          # dump target is the temp file
+    os.replace(tmp, path)            # ...and the replace commits it

@@ -1,0 +1,7 @@
+"""OCT002 firing: state file written non-atomically."""
+import json
+
+
+def save_state(path, state):
+    with open(path, 'w') as f:
+        json.dump(state, f)          # reader can see half a file: OCT002

@@ -1,0 +1,24 @@
+"""OCT003 clean: every guarded access under the lock, or in a
+``*_locked`` caller-holds helper."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._slots = []
+        # guarded-by: _lock
+        self._queue = []
+        self._queue.append(0)            # __init__ is single-threaded
+
+    def submit(self, row):
+        with self._lock:
+            self._queue.append(row)
+
+    def occupancy(self):
+        with self._lock:
+            return len(self._slots) + self._peek_locked()
+
+    def _peek_locked(self):
+        return len(self._queue)          # caller holds _lock

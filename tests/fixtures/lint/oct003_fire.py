@@ -1,0 +1,21 @@
+"""OCT003 firing: guarded attribute touched without its lock."""
+import threading
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._slots = []
+        # guarded-by: _lock
+        self._queue = []
+
+    def submit(self, row):
+        self._queue.append(row)          # no lock held: OCT003
+
+    def occupancy(self):
+        with self._lock:
+            return len(self._slots) + self.peek()
+
+    def peek(self):
+        return len(self._queue)          # lexically lock-free: OCT003

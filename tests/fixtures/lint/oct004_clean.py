@@ -1,0 +1,12 @@
+"""OCT004 clean: daemonized, or joined before return."""
+import threading
+
+
+def start_background(fn):
+    threading.Thread(target=fn, daemon=True).start()
+
+
+def run_to_completion(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()

@@ -1,0 +1,12 @@
+"""OCT004 firing: fire-and-forget non-daemon thread."""
+import threading
+
+
+def start_background(fn):
+    threading.Thread(target=fn).start()      # never joined: OCT004
+
+
+def start_named(fn):
+    t = threading.Thread(target=fn, name='worker')   # OCT004
+    t.start()
+    return t

@@ -1,0 +1,8 @@
+"""OCT005 clean: the injected-clock fallback shape."""
+# oct-lint: clock-discipline
+import time
+
+
+def queue_age(submitted_ts, now=None):
+    now = time.time() if now is None else now
+    return now - submitted_ts

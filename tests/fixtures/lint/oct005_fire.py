@@ -1,0 +1,7 @@
+"""OCT005 firing: bare wall-clock read in a clock-disciplined module."""
+# oct-lint: clock-discipline
+import time
+
+
+def queue_age(submitted_ts):
+    return time.time() - submitted_ts        # not injectable: OCT005

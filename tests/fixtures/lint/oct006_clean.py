@@ -1,0 +1,17 @@
+"""OCT006 clean: the compiled function stays on device; host
+transfers happen at the call site."""
+import jax
+import numpy as np
+
+
+def step(params, tokens):
+    logits = params @ tokens
+    return logits
+
+
+step_fn = jax.jit(step)
+
+
+def drive(params, tokens):
+    logits = step_fn(params, tokens)
+    return np.asarray(logits)       # sync outside the jitted body: fine

@@ -1,0 +1,18 @@
+"""OCT006 firing: host sync inside a jitted step function."""
+import jax
+import numpy as np
+
+
+def step(params, tokens):
+    logits = params @ tokens
+    peak = float(np.asarray(logits).max())   # device→host sync: OCT006
+    return logits * peak
+
+
+step_fn = jax.jit(step)
+
+
+@jax.jit
+def decode_step(cache, tok):
+    out = cache + tok
+    return out, out.item()                   # sync per step: OCT006

@@ -1,0 +1,16 @@
+"""OCT007 clean: one wrapper, hoisted; statics are hashable."""
+import jax
+
+
+def _score(p, t, shape):
+    return (p @ t).reshape(shape)
+
+
+score_fn = jax.jit(_score, static_argnums=2)
+
+# immediate invocation at module import runs exactly once: fine
+_warm = jax.jit(lambda x: x + 1)
+
+
+def score_all(params, batches):
+    return [score_fn(params, b, (4, 128)) for b in batches]

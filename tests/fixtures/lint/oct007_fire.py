@@ -1,0 +1,21 @@
+"""OCT007 firing: per-call jit wrappers and unhashable static args."""
+import jax
+
+scored = jax.jit(lambda p, t: p @ t, static_argnums=1)
+
+
+def score_once(params, tokens):
+    # fresh wrapper (fresh compile cache) every call: OCT007
+    return jax.jit(lambda p: p @ tokens)(params)
+
+
+def score_all(params, batches):
+    out = []
+    for batch in batches:
+        out.append(jax.jit(lambda p: p @ batch)(params))   # OCT007
+    return out
+
+
+def score_shapes(params):
+    # list literal in a static position is unhashable: OCT007
+    return scored(params, [4, 128])

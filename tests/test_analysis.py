@@ -1,0 +1,542 @@
+"""The analysis subsystem (ISSUE 13): oct-lint rules + pragma/baseline
+triage, the repo-wide lint CI gate, the racecheck lock-order sanitizer
+(incl. an inversion reproducer and an instrumented engine run), and
+the crashfuzz crash-consistency suite over every journal contract.
+"""
+import json
+import os
+import os.path as osp
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from opencompass_tpu.analysis import crashfuzz
+from opencompass_tpu.analysis.linter import (RULES, load_baseline, main,
+                                             run_lint, update_baseline)
+from opencompass_tpu.analysis.racecheck import (LockOrderInversion,
+                                                RaceCheck)
+
+FIXTURES = osp.join(osp.dirname(__file__), 'fixtures', 'lint')
+CHECKED_RULES = [r for r in RULES if r != 'OCT000']
+
+
+# -- per-rule fixtures -------------------------------------------------------
+
+@pytest.mark.parametrize('rule', CHECKED_RULES)
+def test_rule_fires_on_fixture(rule):
+    path = osp.join(FIXTURES, f'{rule.lower()}_fire.py')
+    report = run_lint([path], baseline_path=None)
+    fired = [f.rule for f in report.active]
+    assert rule in fired, f'{rule} did not fire on {path}: {fired}'
+    assert set(fired) == {rule}, (
+        f'fixture for {rule} trips other rules too: {fired}')
+
+
+@pytest.mark.parametrize('rule', CHECKED_RULES)
+def test_rule_passes_clean_fixture(rule):
+    path = osp.join(FIXTURES, f'{rule.lower()}_clean.py')
+    report = run_lint([path], baseline_path=None)
+    assert report.active == [], (
+        f'clean fixture for {rule} still fires: '
+        f'{[f.render() for f in report.active]}')
+
+
+# -- pragma triage -----------------------------------------------------------
+
+def test_pragma_with_reason_suppresses(tmp_path):
+    src = tmp_path / 'mod.py'
+    src.write_text(
+        "import json\n"
+        "def save(path, state):\n"
+        "    with open(path, 'w') as f:\n"
+        "        # oct-lint: disable=OCT002(demo state, single process)\n"
+        "        json.dump(state, f)\n")
+    report = run_lint([str(src)], baseline_path=None)
+    assert report.active == []
+    assert report.pragma_count == 1
+
+
+def test_pragma_without_reason_is_oct000(tmp_path):
+    src = tmp_path / 'mod.py'
+    src.write_text(
+        "import json\n"
+        "def save(path, state):\n"
+        "    # oct-lint: disable=OCT002\n"
+        "    with open(path, 'w') as f:\n"
+        "        json.dump(state, f)\n")
+    report = run_lint([str(src)], baseline_path=None)
+    rules = sorted(f.rule for f in report.active)
+    # the reasonless pragma does NOT suppress, and is itself flagged
+    assert rules == ['OCT000', 'OCT002']
+
+
+def test_pragma_on_continuation_line_suppresses(tmp_path):
+    """A pragma on ANY line of a multi-line statement suppresses a
+    finding anchored to the statement's first line."""
+    src = tmp_path / 'mod.py'
+    src.write_text(
+        "import os\n"
+        "def f(path):\n"
+        "    fd = os.open(path,\n"
+        "                 os.O_WRONLY | os.O_APPEND)"
+        "  # oct-lint: disable=OCT001(seal writer)\n"
+        "    os.close(fd)\n")
+    report = run_lint([str(src)], baseline_path=None)
+    assert report.active == []
+
+
+def test_oct005_requires_exact_fallback_shape(tmp_path):
+    """An arbitrary ternary must not exempt a wall-clock read — only
+    the `time.time() if now is None else now` sentinel shape (and its
+    inverse) passes."""
+    src = tmp_path / 'mod.py'
+    src.write_text(
+        "# oct-lint: clock-discipline\n"
+        "import time\n"
+        "def f(t0, flag, now=None, ts=None):\n"
+        "    a = (time.time() - t0) if flag else 0.0\n"
+        "    b = time.time() if now is not None else now\n"
+        "    good = time.time() if now is None else now\n"
+        "    also = ts if ts is not None else time.time()\n"
+        "    return a, b, good, also\n")
+    report = run_lint([str(src)], baseline_path=None)
+    assert sorted(f.line for f in report.active) == [4, 5]
+
+
+def test_oct002_module_scope_not_exempted_by_helper(tmp_path):
+    """A helper function's os.replace must not exempt module-level
+    json.dump-into-open('w')."""
+    src = tmp_path / 'mod.py'
+    src.write_text(
+        "import json, os\n"
+        "def helper(tmp, path):\n"
+        "    os.replace(tmp, path)\n"
+        "with open('state.json', 'w') as f:\n"
+        "    json.dump({}, f)\n")
+    report = run_lint([str(src)], baseline_path=None)
+    assert [f.rule for f in report.active] == ['OCT002']
+
+
+def test_stale_baseline_scoped_to_run_and_pruned(tmp_path):
+    src = tmp_path / 'mod.py'
+    src.write_text("import json\n"
+                   "def save(path, state):\n"
+                   "    with open(path, 'w') as f:\n"
+                   "        json.dump(state, f)\n")
+    base = tmp_path / 'baseline.json'
+    report = run_lint([str(src)], baseline_path=None)
+    update_baseline(report, str(base), 'triaged')
+    # a --rules subset that does not cover OCT002 must not call the
+    # entry stale
+    report = run_lint([str(src)], baseline_path=str(base),
+                      rules=['OCT005'])
+    assert report.stale_baseline == []
+    # fix the code: full run reports the entry stale, and re-running
+    # --update-baseline prunes it
+    src.write_text('x = 1\n')
+    report = run_lint([str(src)], baseline_path=str(base))
+    assert len(report.stale_baseline) == 1
+    update_baseline(report, str(base), 'unused')
+    index, _ = load_baseline(str(base))
+    assert index == {}
+
+
+def test_pragma_reason_may_contain_parentheses(tmp_path):
+    src = tmp_path / 'mod.py'
+    src.write_text(
+        "import json\n"
+        "def save(path, state):\n"
+        "    with open(path, 'w') as f:\n"
+        "        # oct-lint: disable=OCT002(single process, see "
+        "save() docs)\n"
+        "        json.dump(state, f)\n")
+    report = run_lint([str(src)], baseline_path=None)
+    assert report.active == [], [f.render() for f in report.active]
+    assert report.pragma_count == 1
+
+
+def test_oct005_catches_import_aliases(tmp_path):
+    src = tmp_path / 'mod.py'
+    src.write_text(
+        "# oct-lint: clock-discipline\n"
+        "from time import time\n"
+        "import time as t\n"
+        "def f():\n"
+        "    return time() + t.time()\n")
+    report = run_lint([str(src)], baseline_path=None)
+    assert len(report.active) == 2
+    assert {f.rule for f in report.active} == {'OCT005'}
+
+
+def test_oct004_join_must_be_in_scope_and_thread_style(tmp_path):
+    """An unrelated same-named handle's join in ANOTHER scope, or a
+    str.join(parts), must not silence a never-joined thread; a real
+    join() / join(timeout=) in the same scope does."""
+    src = tmp_path / 'mod.py'
+    src.write_text(
+        "import threading\n"
+        "class A:\n"
+        "    def start(self, fn):\n"
+        "        self._reaper = threading.Thread(target=fn)\n"
+        "        self._reaper.start()\n"
+        "class B:\n"
+        "    def stop(self):\n"
+        "        self._reaper.join()\n"
+        "def strjoin(fn, t):\n"
+        "    th = threading.Thread(target=fn)\n"
+        "    th.start()\n"
+        "    return t.join(['a'])\n"
+        "def ok(fn):\n"
+        "    t = threading.Thread(target=fn)\n"
+        "    t.start()\n"
+        "    t.join(timeout=5)\n")
+    report = run_lint([str(src)], baseline_path=None)
+    lines = sorted(f.line for f in report.active)
+    assert {f.rule for f in report.active} == {'OCT004'}
+    assert lines == [4, 10], [f.render() for f in report.active]
+
+
+def test_nonexistent_path_fails_check(tmp_path):
+    report = run_lint([str(tmp_path / 'no_such_dir')],
+                      baseline_path=None)
+    assert report.parse_errors
+    assert main([str(tmp_path / 'no_such_dir'), '--check']) == 2
+
+
+def test_pragma_in_docstring_is_ignored(tmp_path):
+    src = tmp_path / 'mod.py'
+    src.write_text('"""Docs may mention # oct-lint: disable=OCT001'
+                   '(x) freely."""\n')
+    report = run_lint([str(src)], baseline_path=None)
+    assert report.active == []
+
+
+# -- baseline triage ---------------------------------------------------------
+
+def test_baseline_suppresses_only_with_reason(tmp_path):
+    src = tmp_path / 'mod.py'
+    src.write_text("import json\n"
+                   "def save(path, state):\n"
+                   "    with open(path, 'w') as f:\n"
+                   "        json.dump(state, f)\n")
+    base = tmp_path / 'baseline.json'
+    rel = osp.basename(str(src))
+    base.write_text(json.dumps({'v': 1, 'entries': [
+        {'rule': 'OCT002', 'path': rel,
+         'line_text': 'json.dump(state, f)', 'reason': 'triaged demo'},
+    ]}))
+    report = run_lint([str(src)], baseline_path=str(base))
+    assert report.active == []
+    assert len(report.baselined) == 1
+    # strip the reason → entry stops suppressing and is flagged OCT000
+    base.write_text(json.dumps({'v': 1, 'entries': [
+        {'rule': 'OCT002', 'path': rel,
+         'line_text': 'json.dump(state, f)', 'reason': ''},
+    ]}))
+    report = run_lint([str(src)], baseline_path=str(base))
+    assert sorted(f.rule for f in report.active) == ['OCT000', 'OCT002']
+
+
+def test_update_baseline_roundtrip(tmp_path):
+    src = tmp_path / 'mod.py'
+    src.write_text("import json\n"
+                   "def save(path, state):\n"
+                   "    with open(path, 'w') as f:\n"
+                   "        json.dump(state, f)\n")
+    base = tmp_path / 'baseline.json'
+    report = run_lint([str(src)], baseline_path=None)
+    assert len(report.active) == 1
+    update_baseline(report, str(base), 'accepted for the demo')
+    index, bad = load_baseline(str(base))
+    assert len(index) == 1 and not bad
+    report = run_lint([str(src)], baseline_path=str(base))
+    assert report.active == [] and len(report.baselined) == 1
+    # stale entries are reported once the code is fixed
+    src.write_text('x = 1\n')
+    report = run_lint([str(src)], baseline_path=str(base))
+    assert len(report.stale_baseline) == 1
+
+
+# -- the repo gate (tier-1 CI: `cli lint --check` convention) ----------------
+
+def test_repo_is_lint_clean():
+    """The package must lint clean: every remaining finding is either
+    fixed, pragma'd with a reason, or baselined with a reason — the
+    acceptance bar for every future PR (same CI role as `ledger
+    check` / `doctor --check`)."""
+    report = run_lint()     # default paths + committed baseline
+    assert report.parse_errors == []
+    assert report.active == [], (
+        'unbaselined oct-lint findings:\n  '
+        + '\n  '.join(f.render() for f in report.active))
+
+
+def test_lint_main_check_exit_codes(tmp_path):
+    # clean repo → 0 under --check
+    assert main(['--check']) == 0
+    # a firing file with no baseline → 2 under --check, 0 without
+    fire = osp.join(FIXTURES, 'oct001_fire.py')
+    assert main([fire, '--baseline', 'none']) == 0
+    assert main([fire, '--baseline', 'none', '--check']) == 2
+    # --json emits a parseable report (captured via a file redirect
+    # in the CLI smoke below; here exercise the dict path)
+    report = run_lint([fire], baseline_path=None)
+    doc = report.to_dict()
+    assert doc['by_rule'].get('OCT001') == 2
+    assert doc['active'] == 2
+
+
+def test_cli_lint_subcommand_smoke():
+    """`python -m opencompass_tpu.cli lint --check --json` wires
+    through the CLI dispatcher and exits 0 on the repo."""
+    proc = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'lint',
+         '--check', '--json'],
+        capture_output=True, text=True, timeout=240,
+        env=dict(os.environ, JAX_PLATFORMS='cpu'),
+        cwd=osp.dirname(osp.dirname(osp.abspath(__file__))))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc['active'] == 0
+    assert doc['files_scanned'] > 100
+    # suppressions stay triaged: every baselined finding has a reasoned
+    # baseline entry, every pragma carries a reason (else OCT000 would
+    # have failed --check above)
+    assert doc['baselined'] >= 1 and doc['pragmas'] >= 1
+
+
+# -- racecheck ---------------------------------------------------------------
+
+def test_racecheck_clean_consistent_order():
+    rc = RaceCheck()
+    a, b = rc.wrap('A'), rc.wrap('B')
+
+    def worker():
+        for _ in range(50):
+            with a:
+                with b:
+                    pass
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    rc.assert_clean()
+    assert ('A', 'B') in rc.edges()
+
+
+def test_racecheck_catches_inversion():
+    """The reproducer: two threads acquire {A, B} in opposite orders.
+    Neither run deadlocks (they execute sequentially), but the order
+    graph has the cycle — racecheck flags the deadlock that a lucky
+    interleaving hid."""
+    rc = RaceCheck()
+    a, b = rc.wrap('A'), rc.wrap('B')
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    for fn in (forward, backward):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    with pytest.raises(LockOrderInversion) as err:
+        rc.check()
+    msg = str(err.value)
+    assert 'A -> B' in msg and 'B -> A' in msg
+
+
+def test_racecheck_reports_distinct_cycles_over_same_locks():
+    """A→B→C→A and A→C→B→A share a node set but are two separate
+    inversions; both must appear in the diagnostic."""
+    rc = RaceCheck(keep_stacks=False)
+    for a, b in [('A', 'B'), ('B', 'C'), ('C', 'A'),
+                 ('A', 'C'), ('C', 'B'), ('B', 'A')]:
+        rc._edges[(a, b)] = {'count': 1, 'threads': {'t'},
+                             'stack': None}
+    cycles = {tuple(c) for c in rc.cycles()}
+    assert ('A', 'B', 'C', 'A') in cycles
+    assert ('A', 'C', 'B', 'A') in cycles
+
+
+def test_racecheck_reentrant_is_not_an_edge():
+    rc = RaceCheck()
+    a = rc.wrap('A', threading.RLock())
+    with a:
+        with a:
+            pass
+    rc.assert_clean()
+    assert rc.edges() == {}
+
+
+def test_racecheck_instrument_in_place():
+    class Obj:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+    obj = Obj()
+    rc = RaceCheck()
+    tracked = rc.instrument(obj, '_lock')
+    assert obj._lock is tracked
+    with obj._lock:
+        pass
+    # idempotent: instrumenting twice keeps the same proxy
+    assert rc.instrument(obj, '_lock') is tracked
+    # a NEW registry re-binds a foreign proxy so acquisitions report
+    # to it, not silently to the old (dead) registry
+    rc2 = RaceCheck()
+    other = rc2.wrap('other')
+    tracked2 = rc2.instrument(obj, '_lock')
+    assert tracked2 is not tracked
+    with other:
+        with obj._lock:
+            pass
+    assert ('other', tracked2.name) in rc2.edges()
+    assert rc.edges() == {}
+
+
+def test_racecheck_engine_and_queue_locks_are_inversion_free(tmp_path):
+    """Instrumented run of the real concurrency surface: the
+    continuous engine's state/driver locks under a sweep drain with a
+    mid-drain interactive submitter (the serve join path), plus the
+    sweep queue's replay lock under concurrent enqueue/poll threads.
+    Any lock-order inversion observed on ANY interleaving fails."""
+    from opencompass_tpu.models import JaxLM
+    from opencompass_tpu.serve.queue import SweepQueue
+
+    rc = RaceCheck()
+    lm = JaxLM(config='tiny', max_seq_len=256,
+               continuous_batching=True, decode_slots=2,
+               kv_page_size=16)
+    engine = lm.continuous_engine()
+    rc.instrument(engine, '_lock', 'engine._lock')
+    rc.instrument(engine, '_driver', 'engine._driver')
+    rc.instrument(lm, '_cont_engine_lock', 'model._cont_engine_lock')
+
+    queue = SweepQueue(str(tmp_path / 'queue'))
+    rc.instrument(queue, '_replay_lock', 'queue._replay_lock')
+
+    got = {}
+
+    def interactive():
+        got['it'] = lm.generate_continuous(['interactive row'], 4)
+
+    def poller():
+        for i in range(5):
+            queue.enqueue(config_path=f'/cfg/{i}.py', now=1000.0 + i)
+            queue.pressure(now=1010.0)
+
+    threads = [threading.Thread(target=interactive),
+               threading.Thread(target=poller)]
+    for t in threads:
+        t.start()
+    sweep = lm.generate_continuous(
+        [f'sweep row {i} with words' for i in range(4)], 4)
+    for t in threads:
+        t.join()
+    assert len(sweep) == 4 and len(got['it']) == 1
+    assert rc.acquisitions > 0
+    rc.assert_clean()
+
+
+# -- crashfuzz ---------------------------------------------------------------
+
+QUICK_CONTRACTS = sorted(crashfuzz.CONTRACTS)
+
+
+@pytest.mark.parametrize('contract', QUICK_CONTRACTS)
+def test_crashfuzz_quick_in_process(contract, tmp_path):
+    """Every journal contract under randomized torn-write cuts (in-
+    process writer: same bytes on disk as the killed child)."""
+    report = crashfuzz.run_crashfuzz(contract, str(tmp_path),
+                                     n_records=10, rounds=4, seed=7,
+                                     in_process=True)
+    assert report['rounds'] == 4     # violations raise AssertionError
+
+
+def test_crashfuzz_child_process_queue(tmp_path):
+    """One real killed-child round per sealing contract: the writer
+    dies via os._exit mid-append at a byte offset, the reader and the
+    surviving writer recover."""
+    report = crashfuzz.run_crashfuzz('queue_journal', str(tmp_path),
+                                     n_records=6, rounds=2, seed=3)
+    assert report['rounds'] == 2
+
+
+def test_crashfuzz_cut_at_zero_and_last_byte(tmp_path):
+    """Deterministic corner cuts: nothing of the record landed, and
+    torn one byte before the newline commit."""
+    contract = crashfuzz.CONTRACTS['alerts']()
+    for tag, cut_bytes_fn in (('zero', lambda line: 0),
+                              ('last', lambda line: len(line) - 2)):
+        root = tmp_path / tag
+        path = str(root / contract.filename)
+        os.makedirs(osp.dirname(path), exist_ok=True)
+        records = [contract.make_record(i) for i in range(5)]
+        line = json.dumps(records[3], separators=(',', ':')) + '\n'
+        crashfuzz.torn_write(path, records, 3,
+                             cut_bytes_fn(line.encode()))
+        assert contract.read(path) == [f'slo-{i:04d}' for i in range(3)]
+        contract.recover_append(path, records[3:])
+        assert contract.read(path) == [f'slo-{i:04d}' for i in range(5)]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize('contract', QUICK_CONTRACTS)
+def test_crashfuzz_full_child_sweep(contract, tmp_path):
+    """The heavyweight tier: many randomized kill points per contract,
+    each through a real child process, asserting bit-identical
+    convergence after recovery."""
+    report = crashfuzz.run_crashfuzz(contract, str(tmp_path),
+                                     n_records=24, rounds=12, seed=0)
+    assert report['rounds'] == 12
+
+
+# -- clock injection (OCT005's satellite) ------------------------------------
+
+def test_queue_timestamps_accept_injected_clock(tmp_path):
+    from opencompass_tpu.serve.queue import SweepQueue
+    q = SweepQueue(str(tmp_path))
+    q.enqueue(config_path='/cfg/a.py', now=1000.0)
+    q.enqueue(config_path='/cfg/b.py', now=1030.0)
+    pressure = q.pressure(now=1100.0)
+    assert pressure['oldest_queued_age_seconds'] == 100.0
+    assert pressure['counts']['queued'] == 2
+
+
+def test_top_snapshot_and_render_are_deterministic(tmp_path):
+    """`cli top` snapshot/age math keyed entirely to the injected
+    snapshot clock: two gathers with the same now= render the same
+    frame, byte for byte."""
+    from opencompass_tpu.serve import top
+    from opencompass_tpu.serve.queue import SweepQueue
+
+    cache_root = tmp_path / 'cache'
+    queue_root = cache_root / 'serve' / 'queue'
+    q = SweepQueue(str(queue_root))
+    q.enqueue(config_path='/cfg/a.py', now=2000.0)
+    frames = []
+    for _ in range(2):
+        snap = top.gather(str(cache_root), now=2060.0)
+        assert snap['ts'] == 2060.0
+        assert snap['serve']['queue_oldest_age_seconds'] == 60.0
+        frames.append(top.render(snap))
+    assert frames[0] == frames[1]
+    assert 'oldest 60s' in frames[0]
+
+
+def test_engine_info_accepts_injected_clock(tmp_path):
+    from opencompass_tpu.obs import reqtrace
+    reqtrace.write_engine_info(str(tmp_path), 8000, '/run', now=123.0)
+    info = reqtrace.read_engine_info(str(tmp_path))
+    assert info['ts'] == 123.0

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Standalone entry point for oct-lint, the project's invariant-
+enforcing static analyzer (same body as ``python -m
+opencompass_tpu.cli lint``; docs/static_analysis.md).
+
+Usage::
+
+    python tools/lint.py                    # report findings
+    python tools/lint.py --check            # CI gate (exit 2 on
+                                            # unbaselined findings)
+    python tools/lint.py --json             # machine-readable report
+    python tools/lint.py --list-rules
+    python tools/lint.py --update-baseline --reason '...'
+
+Rules OCT001..OCT007: durable-append discipline, atomic-replace state
+files, ``# guarded-by:`` lock discipline, thread hygiene, injected-
+clock discipline, host-sync-in-jit, and jit retrace risk.
+"""
+import os.path as osp
+import sys
+
+sys.path.insert(
+    0, osp.dirname(osp.dirname(osp.abspath(__file__))))
+
+from opencompass_tpu.analysis.linter import main  # noqa: E402
+
+if __name__ == '__main__':
+    raise SystemExit(main())
