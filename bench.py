@@ -1233,6 +1233,64 @@ work_dir = {os.path.join(tmp, 'out')!r}
     return slo_record
 
 
+def _bench_chaos(out_json='BENCH_CHAOS.json'):
+    """detail.chaos: the full serve-layer chaos sweep (analysis/
+    chaos.py) against a live daemon — overload burst past the
+    admission ceiling (429 + measured Retry-After, admitted p99 within
+    the objective), stuck worker vs propagated deadlines (504 with the
+    phase that ate the budget), worker SIGKILL mid-request (retry
+    budget + circuit breaker open → half-open probe → close), and
+    store write EIO (cache-off degradation, bit-identical
+    convergence).  Any violated invariant raises; the record landing
+    in BENCH_CHAOS.json IS the all-clear.  Device-free (continuous
+    FakeModel)."""
+    import tempfile
+
+    from opencompass_tpu.analysis import chaos
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    workdir = tempfile.mkdtemp(prefix='oct_chaos_')
+    report = chaos.run_chaos(workdir=workdir, quick=False)
+    scen = report['scenarios']
+    record = {
+        'workload': 'full chaos sweep vs one live daemon: '
+                    f'{", ".join(scen)} — every degradation '
+                    'invariant asserted (violations raise; this '
+                    'record is the all-clear)',
+        'scenarios_passed': len(scen),
+        'requests_checked': report['requests_checked'],
+        'wall_s': report['wall_s'],
+        'overload': scen.get('overload_burst'),
+        'stuck_worker': scen.get('stuck_worker'),
+        'worker_kill': scen.get('worker_kill'),
+        'store_eio': scen.get('store_eio'),
+    }
+    path = os.path.join(here, out_json)
+    try:
+        with open(path, 'w') as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
+    # the degradation gate rides the trajectory: scenario count must
+    # not shrink, and admitted-traffic p99 under overload is the
+    # number admission control exists to protect
+    _append_trajectory(
+        'chaos', 'scenarios_passed', record['scenarios_passed'],
+        'count', direction='higher',
+        detail={'requests_checked': record['requests_checked']})
+    p99 = (scen.get('overload_burst') or {}).get('admitted_p99_ms')
+    if p99 is not None:
+        _append_trajectory(
+            'chaos', 'overload_admitted_p99_ms', p99, 'ms',
+            direction='lower',
+            detail={'objective_ms': chaos.OBJECTIVE_MS,
+                    'shed': (scen.get('overload_burst') or {})
+                    .get('shed'),
+                    'admitted': (scen.get('overload_burst') or {})
+                    .get('admitted')})
+    return record
+
+
 def main():
     n_chips = max(1, len(jax.devices()))
     kind = getattr(jax.devices()[0], 'device_kind', '')
@@ -1593,5 +1651,11 @@ if __name__ == '__main__':
         # standalone oct-lint coverage smoke (pure stdlib; device-free)
         print(json.dumps({'metric': 'lint', 'v': 1,
                           'detail': _bench_lint()}))
+        sys.exit(0)
+    if '--chaos' in sys.argv:
+        # standalone chaos-harness leg: live fault injection against a
+        # real daemon, degradation invariants asserted (device-free)
+        print(json.dumps({'metric': 'chaos', 'v': 1,
+                          'detail': _bench_chaos()}))
         sys.exit(0)
     main()

@@ -26,10 +26,19 @@ Three tools, one subsystem:
   queue journal, requests/alerts/access logs) recovers exactly per its
   torn-line contract, converging bit-identically after recovery.
 
+- :mod:`opencompass_tpu.analysis.chaos` — the serve-layer chaos
+  harness (``cli chaos``): injects live faults into a real daemon
+  (worker SIGKILL mid-request, stuck worker, store write EIO,
+  overload burst past the admission ceiling) and asserts the
+  degradation invariants — no accepted request silently lost,
+  ``/healthz`` degraded-not-down, sheds carry ``Retry-After``,
+  admitted p99 within the objective, post-incident bit-identical
+  store convergence.  ``--check`` exits 2 on any violation.
+
 Imports stay lazy here: the linter is pure stdlib (``ast``), and the
 crashfuzz child process must start fast — nothing in this package may
 import jax at module import time.
 """
 from __future__ import annotations
 
-__all__ = ['linter', 'racecheck', 'crashfuzz']
+__all__ = ['linter', 'racecheck', 'crashfuzz', 'chaos']

@@ -1,0 +1,626 @@
+"""Serve-layer chaos harness: live fault injection + invariant checks.
+
+``crashfuzz`` proves the *at-rest* story — journals recover from a
+writer killed mid-byte.  This module proves the **live degradation
+story**: it runs a real serve daemon (``cli serve`` subprocess, a
+device-free continuous FakeModel), injects faults *while traffic is in
+flight* — worker SIGKILL mid-request, a stuck worker behind the
+file-based ``OCT_DEBUG_COMPLETE_SLEEP_FILE`` knob, store write ``EIO``
+via ``OCT_DEBUG_STORE_EIO_FILE``, an overload burst past the admission
+ceiling — and asserts the degradation invariants from docs/serving.md
+"Degradation under load":
+
+1. **no silent loss** — every admitted ``POST /v1/completions`` in
+   ``access.jsonl`` resolves to a terminal record in
+   ``requests.jsonl`` (response or typed error; a hung HTTP thread or
+   a dropped record is a violation);
+2. **degraded, not down** — ``/healthz`` keeps answering through every
+   incident and *names* the degradation (``degraded`` list, typed
+   readiness fields) instead of flat-lining;
+3. **honest back-pressure** — shed responses are ``429``/``503`` with
+   a parseable ``Retry-After`` ≥ 1 s derived from measurements;
+4. **protected objective** — admitted-traffic p99 stays within
+   :data:`OBJECTIVE_MS` while the excess sheds;
+5. **convergence** — post-incident, outputs are bit-identical to the
+   in-incident ones and the store ends up holding them (the next
+   identical request is a pure store hit).
+
+Scenario runner in the crashfuzz mold: scenarios are registered in
+:data:`SCENARIOS`, any violation raises ``AssertionError`` (a returned
+report IS the all-clear), and ``cli chaos --check`` exits **2** on any
+violated invariant — the same CI convention as ``ledger check`` /
+``lint --check`` / ``doctor --check``::
+
+    python -m opencompass_tpu.cli chaos --quick --check   # tier-1
+    python -m opencompass_tpu.cli chaos                   # full sweep
+
+One daemon serves all requested scenarios (each resets its knobs on
+the way out); the no-silent-loss check runs over the whole run's
+access/requests logs at the end, so cross-scenario interactions are
+covered too.
+"""
+from __future__ import annotations
+
+import json
+import os
+import os.path as osp
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+CHECK_EXIT = 2
+QUICK_SCENARIOS = ('overload_burst', 'stuck_worker')
+# the degradation objective: admitted-traffic p99 while shedding.
+# Generous vs the 0.4s injected service time x ceiling-2 concurrency —
+# the invariant is "bounded by admission", not "fast on a loaded CI box"
+OBJECTIVE_MS = 5000.0
+MAX_INFLIGHT = 2
+
+
+def _check(cond, msg: str):
+    """Invariant check that survives ``python -O`` (crashfuzz's
+    discipline: the harness must never print an all-clear while
+    checking nothing)."""
+    if not cond:
+        raise AssertionError(msg)
+
+
+# -- the live daemon under test ---------------------------------------------
+
+class _Resp:
+    __slots__ = ('code', 'payload', 'headers', 'wall_s')
+
+    def __init__(self, code, payload, headers, wall_s):
+        self.code = code
+        self.payload = payload
+        self.headers = headers
+        self.wall_s = wall_s
+
+    def error_type(self) -> Optional[str]:
+        err = (self.payload or {}).get('error')
+        return err.get('type') if isinstance(err, dict) else None
+
+    def retry_after(self) -> Optional[float]:
+        raw = (self.headers or {}).get('Retry-After')
+        try:
+            return float(raw)
+        except (TypeError, ValueError):
+            return None
+
+
+class ChaosDaemon:
+    """One ``cli serve`` subprocess with every chaos knob wired:
+    file-based per-completion sleep, file-based store-EIO, a tight
+    interactive admission ceiling, and a paced continuous FakeModel —
+    all device-free."""
+
+    def __init__(self, workdir: str, max_inflight: int = MAX_INFLIGHT):
+        self.root = osp.abspath(workdir)
+        os.makedirs(self.root, exist_ok=True)
+        self.cache_root = osp.join(self.root, 'cache')
+        self.serve_obs_dir = osp.join(self.cache_root, 'serve', 'obs')
+        self.sleep_file = osp.join(self.root, 'sleep_s')
+        self.eio_file = osp.join(self.root, 'store_eio')
+        self.log_path = osp.join(self.root, 'daemon.log')
+        self.cfg_path = osp.join(self.root, 'serve_chaos.py')
+        self.proc: Optional[subprocess.Popen] = None
+        self.base: Optional[str] = None
+        self._log_fh = None
+        self.set_sleep(0)
+        self.set_store_fault(False)
+        with open(self.cfg_path, 'w', encoding='utf-8') as f:
+            f.write(f"""
+from opencompass_tpu.models import FakeModel
+models = [dict(type=FakeModel, abbr='fake-chaos', path='fake',
+               continuous=True,
+               canned_responses={{'Q': 'tok ' * 8}},
+               run_cfg=dict(num_devices=0))]
+admission = dict(max_inflight={int(max_inflight)}, max_queue_depth=2)
+slo_eval_interval_s = 0.5
+work_dir = {osp.join(self.root, 'out')!r}
+""")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, timeout: float = 180.0):
+        repo = osp.dirname(osp.dirname(osp.dirname(
+            osp.abspath(__file__))))
+        env = dict(os.environ, JAX_PLATFORMS='cpu',
+                   OCT_CACHE_ROOT=self.cache_root,
+                   OCT_DEBUG_COMPLETE_SLEEP_FILE=self.sleep_file,
+                   OCT_DEBUG_STORE_EIO_FILE=self.eio_file,
+                   OCT_FAKE_TOKEN_SLEEP_S='0.003')
+        env.pop('OCT_TRACE_ID', None)
+        env.pop('OCT_OBS_DIR', None)
+        self._log_fh = open(self.log_path, 'w')
+        self.proc = subprocess.Popen(
+            [sys.executable, '-m', 'opencompass_tpu.cli', 'serve',
+             self.cfg_path, '--port', '0'],
+            stdout=self._log_fh, stderr=subprocess.STDOUT, env=env,
+            cwd=repo)
+        deadline = time.time() + timeout
+        port = None
+        while time.time() < deadline and port is None:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    'chaos daemon died at startup:\n'
+                    + open(self.log_path).read()[-2000:])
+            for line in open(self.log_path).read().splitlines():
+                if 'engine listening on http://127.0.0.1:' in line:
+                    port = int(line.split('127.0.0.1:')[1].split()[0])
+                    break
+            time.sleep(0.2)
+        if port is None:
+            raise RuntimeError('chaos daemon never listened:\n'
+                               + open(self.log_path).read()[-2000:])
+        self.base = f'http://127.0.0.1:{port}'
+        while time.time() < deadline:
+            if self.health().code == 200:
+                return
+            time.sleep(0.3)
+        raise RuntimeError('chaos daemon never became ready')
+
+    def stop(self):
+        if self.proc is None:
+            return
+        if self.proc.poll() is None:
+            self.proc.send_signal(signal.SIGTERM)
+            try:
+                self.proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+        if self._log_fh is not None:
+            self._log_fh.close()
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    # -- knobs --------------------------------------------------------------
+
+    def set_sleep(self, seconds: float):
+        with open(self.sleep_file, 'w', encoding='utf-8') as f:
+            f.write(str(seconds))
+
+    def set_store_fault(self, on: bool):
+        with open(self.eio_file, 'w', encoding='utf-8') as f:
+            f.write('1' if on else '0')
+
+    # -- HTTP ---------------------------------------------------------------
+
+    def http(self, method: str, path: str, body=None, headers=None,
+             timeout: float = 120.0) -> _Resp:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self.base + path, data=data,
+                                     method=method,
+                                     headers=dict(headers or {}))
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return _Resp(r.status, json.loads(r.read()),
+                             dict(r.headers),
+                             time.perf_counter() - t0)
+        except urllib.error.HTTPError as exc:
+            raw = exc.read()
+            try:
+                payload = json.loads(raw)
+            except ValueError:
+                payload = {'raw': raw.decode('utf-8', 'replace')}
+            return _Resp(exc.code, payload, dict(exc.headers),
+                         time.perf_counter() - t0)
+
+    def request(self, prompt: str, max_tokens: int = 8,
+                deadline_ms: Optional[float] = None,
+                timeout: float = 120.0) -> _Resp:
+        headers = {}
+        if deadline_ms is not None:
+            headers['X-OCT-Deadline-Ms'] = str(deadline_ms)
+        return self.http('POST', '/v1/completions',
+                         {'model': 'fake-chaos', 'prompt': prompt,
+                          'max_tokens': max_tokens},
+                         headers=headers, timeout=timeout)
+
+    def health(self) -> _Resp:
+        return self.http('GET', '/healthz', timeout=10)
+
+    def stats(self) -> Dict:
+        return self.http('GET', '/v1/stats', timeout=10).payload
+
+    def worker_pids(self) -> List[int]:
+        snap = self.http('GET', '/status', timeout=10).payload
+        workers = ((snap.get('serve') or {}).get('workers') or {})
+        return [w['pid'] for w in workers.values() if w.get('pid')]
+
+
+# -- invariant checks (pure; unit-tested without a daemon) ------------------
+
+def _jsonl(path: str) -> List[Dict]:
+    from opencompass_tpu.utils.fileio import iter_jsonl_records
+    out: List[Dict] = []
+    for candidate in (path + '.1', path):
+        out.extend(iter_jsonl_records(candidate))
+    return out
+
+
+def check_no_lost_requests(access_recs: List[Dict],
+                           request_recs: List[Dict]) -> List[str]:
+    """Invariant 1: every admitted ``POST /v1/completions`` access-log
+    line resolves to a terminal ``requests.jsonl`` record by request
+    id.  Validation refusals (400/404) never reach the engine and are
+    exempt; sheds (429), overloads (503) and deadline 504s all DO
+    carry a record — the engine records every attempt, error paths
+    included.  Returns violation strings (empty == invariant holds)."""
+    resolved = {r.get('request_id') for r in request_recs
+                if r.get('request_id')}
+    violations = []
+    for rec in access_recs:
+        if rec.get('route') != '/v1/completions' \
+                or rec.get('method') != 'POST':
+            continue
+        status = rec.get('status')
+        if status in (400, 404):
+            continue
+        rid = rec.get('request_id')
+        if not rid:
+            violations.append(f'access line without request id: {rec}')
+        elif rid not in resolved:
+            violations.append(
+                f'request {rid} (status {status}) has no '
+                'requests.jsonl record — silently lost')
+    return violations
+
+
+def check_retry_after(responses: List[_Resp]) -> List[str]:
+    """Invariant 3: every 429/503 carries a parseable Retry-After >= 1
+    and a typed ``overloaded`` error body."""
+    violations = []
+    for resp in responses:
+        if resp.code not in (429, 503):
+            continue
+        retry = resp.retry_after()
+        if retry is None or retry < 1:
+            violations.append(
+                f'{resp.code} without usable Retry-After '
+                f'({(resp.headers or {}).get("Retry-After")!r})')
+        if resp.error_type() != 'overloaded':
+            violations.append(
+                f'{resp.code} with error type {resp.error_type()!r}, '
+                "expected 'overloaded'")
+    return violations
+
+
+def admitted_p99_ms(responses: List[_Resp]) -> Optional[float]:
+    from opencompass_tpu.obs.reqtrace import percentile
+    walls = [r.wall_s for r in responses if r.code == 200]
+    p99 = percentile(walls, 0.99)
+    return round(p99 * 1e3, 1) if p99 is not None else None
+
+
+# -- scenarios --------------------------------------------------------------
+
+def scenario_overload_burst(daemon: ChaosDaemon,
+                            quick: bool = False) -> Dict:
+    """Concurrency burst past the admission ceiling: excess sheds with
+    429 + Retry-After while admitted p99 stays within the objective
+    and /healthz keeps answering 200."""
+    n = 8 if quick else 24
+    daemon.set_sleep(0.4)
+    responses: List[Optional[_Resp]] = [None] * n
+
+    def fire(i):
+        responses[i] = daemon.request(
+            f'Q: overload probe {i} of {n}?\nA:', timeout=90)
+
+    threads = [threading.Thread(target=fire, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    mid_health = daemon.health()
+    for t in threads:
+        t.join(timeout=120)
+    daemon.set_sleep(0)
+    _check(all(r is not None for r in responses),
+           'a burst request never returned (hung HTTP thread)')
+    ok = [r for r in responses if r.code == 200]
+    shed = [r for r in responses if r.code in (429, 503)]
+    other = [r for r in responses if r.code not in (200, 429, 503)]
+    _check(not other,
+           f'unexpected statuses in burst: {[r.code for r in other]}')
+    _check(ok, 'overload burst: nothing was admitted')
+    _check(shed, f'overload burst: {n} concurrent vs ceiling '
+                 f'{MAX_INFLIGHT} shed nothing — admission inert')
+    violations = check_retry_after(responses)
+    _check(not violations, f'Retry-After violations: {violations}')
+    p99 = admitted_p99_ms(responses)
+    _check(p99 is not None and p99 <= OBJECTIVE_MS,
+           f'admitted p99 {p99}ms exceeds the {OBJECTIVE_MS}ms '
+           'objective while shedding')
+    _check(mid_health.code == 200,
+           f'/healthz answered {mid_health.code} mid-burst — '
+           'overload must degrade, not down, a warm daemon')
+    _check(daemon.alive(), 'daemon died during the overload burst')
+    retries = [r.retry_after() for r in shed]
+    return {'fired': n, 'admitted': len(ok), 'shed': len(shed),
+            'admitted_p99_ms': p99,
+            'retry_after_s': {'min': min(retries),
+                              'max': max(retries)}}
+
+
+def scenario_stuck_worker(daemon: ChaosDaemon,
+                          quick: bool = False) -> Dict:
+    """A stuck worker (injected 2 s serving stall) against short
+    deadlines: 504 ``deadline_exceeded`` naming the phase, worker left
+    alive, full recovery once the stall lifts."""
+    pids_before = daemon.worker_pids()
+    daemon.set_sleep(2.0)
+    # budget dies while the worker stalls: the worker's own check
+    # attributes the spend to the (simulated) forward
+    r_mid = daemon.request('Q: stuck mid?\nA:', deadline_ms=500,
+                           timeout=60)
+    # budget already dead at arrival: fail fast, no chip time
+    r_pre = daemon.request('Q: stuck pre?\nA:', deadline_ms=1,
+                           timeout=60)
+    daemon.set_sleep(0)
+    r_after = daemon.request('Q: stuck recovered?\nA:', timeout=60)
+    # phase attribution is the phase that ACTUALLY consumed the
+    # budget: with a 1 ms budget that can be anywhere from parse to
+    # the worker's channel entry depending on machine speed — the
+    # invariant is that it is named and honest, and the deterministic
+    # per-phase cases live in tests/test_degradation.py
+    for name, resp, phases in (
+            ('mid', r_mid, ('model_forward', 'worker_protocol')),
+            ('pre', r_pre, ('parse', 'admission', 'lease_wait',
+                            'worker_protocol'))):
+        _check(resp.code == 504,
+               f'stuck-{name}: expected 504, got {resp.code} '
+               f'({resp.payload})')
+        _check(resp.error_type() == 'deadline_exceeded',
+               f'stuck-{name}: error type {resp.error_type()!r}')
+        phase = (resp.payload.get('error') or {}).get('phase')
+        _check(phase in phases,
+               f'stuck-{name}: phase {phase!r} not in {phases}')
+    _check(r_mid.wall_s < 30,
+           f'stuck-mid 504 took {r_mid.wall_s:.1f}s — deadline '
+           'enforcement is not bounding the wait')
+    _check(r_after.code == 200,
+           f'post-stall request failed ({r_after.code}) — the '
+           'deadline path must leave the worker alive')
+    pids_after = daemon.worker_pids()
+    _check(set(pids_before) == set(pids_after),
+           f'worker respawned across a deadline 504 ({pids_before} -> '
+           f'{pids_after}) — deadlines must not kill healthy workers')
+    return {'mid_phase':
+            (r_mid.payload.get('error') or {}).get('phase'),
+            'pre_phase':
+            (r_pre.payload.get('error') or {}).get('phase'),
+            'mid_wall_s': round(r_mid.wall_s, 2)}
+
+
+def scenario_worker_kill(daemon: ChaosDaemon,
+                         quick: bool = False) -> Dict:
+    """SIGKILL the resident worker mid-request: the in-flight request
+    resolves (retried success or typed 5xx — never a hang), a
+    replacement serves the next request, and (full mode) repeated
+    flapping opens the per-worker circuit breaker, which a half-open
+    probe closes after the cooldown."""
+    warm = daemon.request('Q: kill warmup?\nA:', timeout=60)
+    _check(warm.code == 200, f'warmup failed: {warm.code}')
+
+    def kill_mid_request(i: int) -> _Resp:
+        daemon.set_sleep(2.5)
+        pids = daemon.worker_pids()
+        _check(pids, 'no resident worker to kill')
+        holder: List[Optional[_Resp]] = [None]
+
+        def fire():
+            holder[0] = daemon.request(
+                f'Q: kill victim {i}?\nA:', timeout=90)
+
+        t = threading.Thread(target=fire)
+        t.start()
+        time.sleep(0.8)       # request is in flight on the channel
+        for pid in pids:
+            try:
+                os.killpg(pid, signal.SIGKILL)  # own session: pid==pgid
+            except (OSError, ProcessLookupError):
+                pass
+        t.join(timeout=90)
+        daemon.set_sleep(0)
+        _check(holder[0] is not None,
+               'request hung across the worker kill')
+        return holder[0]
+
+    first = kill_mid_request(0)
+    _check(first.code in (200, 502, 503),
+           f'killed-worker request resolved to {first.code} '
+           f'({first.payload}) — expected retried 200 or typed 5xx')
+    recovered = daemon.request('Q: kill recovered?\nA:', timeout=90)
+    _check(recovered.code == 200,
+           f'no replacement worker after the kill: {recovered.code}')
+    report = {'first_outcome': first.code,
+              'recovered': recovered.code == 200}
+    if not quick:
+        # flap until the breaker opens: each kill is one protocol
+        # failure; the default breaker opens at 3 in 60s, so the 3rd
+        # kill's request surfaces 503 breaker_open instead of retrying
+        last = first
+        kills = 1
+        while kills < 5 and not (
+                last.code == 503
+                and (last.payload.get('error') or {})
+                .get('reason') == 'breaker_open'):
+            last = kill_mid_request(kills)
+            kills += 1
+        _check((last.payload.get('error') or {}).get('reason')
+               == 'breaker_open',
+               f'breaker never opened after {kills} kills: '
+               f'{last.code} {last.payload}')
+        _check(last.retry_after() is not None and
+               last.retry_after() >= 1,
+               '503 breaker_open without a usable Retry-After')
+        breakers = (daemon.stats().get('overload') or {}) \
+            .get('breakers') or {}
+        _check(any(b.get('state') == 'open' for b in breakers.values()),
+               f'/v1/stats overload block shows no open breaker: '
+               f'{breakers}')
+        # cooldown, then the half-open probe closes the circuit
+        time.sleep(16)
+        probe = daemon.request('Q: breaker probe?\nA:', timeout=90)
+        _check(probe.code == 200,
+               f'half-open probe failed ({probe.code}) — the breaker '
+               'must close on a healthy replacement')
+        breakers = (daemon.stats().get('overload') or {}) \
+            .get('breakers') or {}
+        _check(all(b.get('state') == 'closed'
+                   for b in breakers.values()),
+               f'breaker did not close after the probe: {breakers}')
+        report.update(kills_to_open=kills, breaker_closed=True)
+    _check(daemon.alive(), 'daemon died during worker kills')
+    return report
+
+
+def scenario_store_eio(daemon: ChaosDaemon,
+                       quick: bool = False) -> Dict:
+    """Store write EIO mid-serve: completions degrade to cache-off
+    (still answered), /healthz names the degradation, and after the
+    fault lifts the store converges — identical prompt, identical
+    text, durably committed, next request a pure store hit."""
+    prompt = 'Q: eio convergence probe?\nA:'
+    daemon.set_store_fault(True)
+    try:
+        r_during = daemon.request(prompt, timeout=60)
+        _check(r_during.code == 200,
+               f'completion failed during store EIO ({r_during.code}) '
+               '— a broken store must degrade to cache-off, not 5xx')
+        health = daemon.health()
+        _check('store_unwritable' in (health.payload.get('degraded')
+                                      or []),
+               f'/healthz does not name the store outage: '
+               f'{health.payload}')
+        _check(health.payload.get('queue_draining') is True,
+               'store outage must not read as a dead engine')
+        r_during2 = daemon.request(prompt, timeout=60)
+        _check(r_during2.code == 200
+               and (r_during2.payload.get('oct') or {})
+               .get('store_hits') == 0,
+               'a row "committed" during EIO was served back from '
+               'memory — the store lied about durability')
+    finally:
+        daemon.set_store_fault(False)
+    r_post = daemon.request(prompt, timeout=60)
+    _check(r_post.code == 200, f'post-EIO request failed '
+                               f'({r_post.code})')
+    r_hit = daemon.request(prompt, timeout=60)
+    oct_block = r_hit.payload.get('oct') or {}
+    _check(r_hit.code == 200 and oct_block.get('store_hits') == 1
+           and oct_block.get('device_rows') == 0,
+           f'store did not converge after the fault lifted: {oct_block}')
+    texts = {r.payload['choices'][0]['text']
+             for r in (r_during, r_during2, r_post, r_hit)}
+    _check(len(texts) == 1,
+           f'outputs diverged across the incident: {texts}')
+    _check(daemon.health().code == 200,
+           '/healthz did not recover after the fault lifted')
+    return {'during_ok': True, 'converged': True,
+            'text': next(iter(texts))}
+
+
+SCENARIOS = {
+    'overload_burst': scenario_overload_burst,
+    'stuck_worker': scenario_stuck_worker,
+    'worker_kill': scenario_worker_kill,
+    'store_eio': scenario_store_eio,
+}
+
+
+# -- runner -----------------------------------------------------------------
+
+def run_chaos(names: Optional[List[str]] = None,
+              workdir: str = '/tmp/oct-chaos',
+              quick: bool = False) -> Dict:
+    """Run the named scenarios (default: all, journal order) against
+    ONE live daemon, then verify the run-wide no-silent-loss invariant
+    over the daemon's whole access/requests history.  Raises
+    ``AssertionError`` on the first violated invariant — a returned
+    report is the all-clear."""
+    names = list(names or SCENARIOS)
+    unknown = [n for n in names if n not in SCENARIOS]
+    if unknown:
+        raise ValueError(f'unknown scenario(s) {unknown}; have '
+                         f'{sorted(SCENARIOS)}')
+    shutil.rmtree(workdir, ignore_errors=True)
+    daemon = ChaosDaemon(workdir)
+    t0 = time.perf_counter()
+    reports: Dict[str, Dict] = {}
+    try:
+        daemon.start()
+        for name in names:
+            t = time.perf_counter()
+            reports[name] = SCENARIOS[name](daemon, quick=quick)
+            reports[name]['wall_s'] = round(
+                time.perf_counter() - t, 2)
+        _check(daemon.alive(), 'daemon died across the scenario sweep')
+    finally:
+        daemon.stop()
+    access = _jsonl(osp.join(daemon.serve_obs_dir, 'access.jsonl'))
+    requests = _jsonl(osp.join(daemon.serve_obs_dir, 'requests.jsonl'))
+    lost = check_no_lost_requests(access, requests)
+    _check(not lost, f'silently lost requests: {lost}')
+    checked = sum(1 for r in access
+                  if r.get('route') == '/v1/completions'
+                  and r.get('method') == 'POST')
+    return {'v': 1, 'quick': quick, 'scenarios': reports,
+            'requests_checked': checked,
+            'wall_s': round(time.perf_counter() - t0, 2)}
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog='chaos',
+        description='serve-layer chaos harness: inject live faults '
+        '(worker SIGKILL, stuck worker, store EIO, overload burst) '
+        'into a real daemon and assert the degradation invariants '
+        '(docs/serving.md "Degradation under load")')
+    parser.add_argument('--scenario', action='append',
+                        choices=sorted(SCENARIOS),
+                        help='run one scenario (repeatable); default '
+                        'all')
+    parser.add_argument('--quick', action='store_true',
+                        help='small bursts, no breaker cooldown wait '
+                        '(the tier-1 profile)')
+    parser.add_argument('--workdir', default='/tmp/oct-chaos')
+    parser.add_argument('--json', action='store_true',
+                        help='emit the report as JSON')
+    parser.add_argument('--check', action='store_true',
+                        help=f'CI gate: exit {CHECK_EXIT} on any '
+                        'violated invariant (0 otherwise)')
+    args = parser.parse_args(argv)
+    try:
+        report = run_chaos(args.scenario, workdir=args.workdir,
+                           quick=args.quick)
+    except AssertionError as exc:
+        print(f'chaos: INVARIANT VIOLATED — {exc}', file=sys.stderr)
+        return CHECK_EXIT if args.check else 1
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+    else:
+        for name, rep in report['scenarios'].items():
+            print(f'{name}: ok ({rep["wall_s"]}s) '
+                  + json.dumps({k: v for k, v in rep.items()
+                                if k != 'wall_s'}, default=str))
+        print(f'chaos: all invariants held over '
+              f'{report["requests_checked"]} request(s) '
+              f'({report["wall_s"]}s)')
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

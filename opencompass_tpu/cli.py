@@ -42,6 +42,13 @@ Usage::
                     # locks, thread hygiene, clock injection, jit
                     # hygiene); --check exits 2 on unbaselined findings
                     # (CI), --json for tooling (docs/static_analysis.md)
+    python -m opencompass_tpu.cli chaos --quick --check   # chaos harness
+                    # live fault injection against a real serve daemon
+                    # (worker SIGKILL, stuck worker, store EIO, overload
+                    # burst) asserting the degradation invariants:
+                    # no silent loss, degraded-not-down /healthz,
+                    # Retry-After on sheds, p99 within objective,
+                    # bit-identical store convergence (docs/serving.md)
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files
@@ -328,6 +335,18 @@ def lint_main(argv=None) -> int:
     return linter_main(argv)
 
 
+def chaos_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli chaos [--quick] [--check]`` —
+    the serve-layer chaos harness: spawn a real daemon, inject live
+    faults (worker SIGKILL mid-request, stuck worker via the injected
+    serving stall, store write EIO, an overload burst past the
+    admission ceiling), and assert the degradation invariants from
+    docs/serving.md "Degradation under load".  ``--check`` exits 2 on
+    any violated invariant, the ``ledger check`` convention."""
+    from opencompass_tpu.analysis.chaos import main as chaos_cli_main
+    return chaos_cli_main(argv)
+
+
 def serve_main(argv=None) -> int:
     """``python -m opencompass_tpu.cli serve <config> [--port N]`` —
     the persistent evaluation engine: durable FIFO sweep queue under
@@ -361,6 +380,8 @@ def main():
         raise SystemExit(doctor_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'lint':
         raise SystemExit(lint_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == 'chaos':
+        raise SystemExit(chaos_main(sys.argv[2:]))
     args = parse_args()
     cfg = get_config_from_arg(args)
     work_dir = cfg['work_dir']

@@ -51,7 +51,8 @@ class FakeModel(BaseModel):
         return self.continuous_batching
 
     def generate_continuous(self, inputs: List[str], max_out_len: int,
-                            on_result=None, stats_out=None) -> List[str]:
+                            on_result=None, stats_out=None,
+                            interactive: bool = False) -> List[str]:
         """FakeModel 'engine': same pure outputs as :meth:`generate`,
         delivered per row in the engine's feed order (longest prompt
         first) — deliberately NOT dataset order, so callers must

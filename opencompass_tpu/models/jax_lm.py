@@ -132,6 +132,11 @@ class ContinuousEngine:
         self._slots: List[Optional[_EngineRow]] = [None] * self.slots
         # guarded-by: _lock
         self._queue: 'collections.deque[_EngineRow]' = collections.deque()
+        # priority lane: interactive rows (serve-plane joins) admit
+        # ahead of queued sweep rows — a mid-sweep completion never
+        # waits behind the sweep's whole prefill backlog for a slot
+        # guarded-by: _lock
+        self._prio: 'collections.deque[_EngineRow]' = collections.deque()
         self._lock = threading.Lock()         # queue/slots/alloc/stats
         self._driver = threading.Lock()       # one stepping thread
         (self.temperature, self.top_k, self._seed, num_beams,
@@ -161,6 +166,7 @@ class ContinuousEngine:
         self.decode_steps = 0
         self.occupancy_sum = 0      # active slots summed over steps
         self.joined = 0
+        self.prio_joined = 0        # interactive-lane admissions
         self.retired = 0
         self._retire_seq = 0
         # guarded-by: _lock
@@ -227,15 +233,21 @@ class ContinuousEngine:
                 f'{self.num_pages - 1}; raise kv_pool_pages')
         row = _EngineRow(ids, max_new, tag, interactive=interactive)
         with self._lock:
-            self._queue.append(row)
+            (self._prio if interactive else self._queue).append(row)
         return row
 
     def _admit_locked(self):
         from opencompass_tpu.nn.paged_kv import OutOfPages, pages_per_seq
         for slot in range(self.slots):
-            if self._slots[slot] is not None or not self._queue:
+            if self._slots[slot] is not None:
                 continue
-            row = self._queue[0]
+            # priority lane first: an interactive join takes the next
+            # free slot ahead of every queued sweep row (FIFO within
+            # each lane)
+            lane = self._prio if self._prio else self._queue
+            if not lane:
+                continue
+            row = lane[0]
             need = pages_per_seq(len(row.ids) + row.max_new,
                                  self.page_size)
             try:
@@ -247,11 +259,13 @@ class ContinuousEngine:
                 # stream instead of only as mysteriously low slot_util
                 self._note_pool_pressure_locked(need)
                 break
-            self._queue.popleft()
+            lane.popleft()
             self.table.assign(slot, pages)
             row.slot = slot
             self._slots[slot] = row
             self.joined += 1
+            if row.interactive:
+                self.prio_joined += 1
 
     def _note_pool_pressure_locked(self, need: int):
         """One ``kv_pool_pressure`` event per admission-stall episode
@@ -269,7 +283,8 @@ class ContinuousEngine:
                              need_pages=int(need),
                              free_pages=self.alloc.n_free,
                              pool_pages=self.num_pages,
-                             queued_rows=len(self._queue),
+                             queued_rows=(len(self._queue)
+                                          + len(self._prio)),
                              failed_allocs=self.alloc.failed_allocs,
                              high_water=self.alloc.high_water)
                 tracer.counter('engine.kv_pool_stalls').inc()
@@ -485,7 +500,9 @@ class ContinuousEngine:
                     'prefill_steps': self.prefill_steps,
                     'decode_steps': self.decode_steps,
                     'occupancy_sum': self.occupancy_sum,
-                    'joined': self.joined, 'retired': self.retired,
+                    'joined': self.joined,
+                    'prio_joined': self.prio_joined,
+                    'retired': self.retired,
                     'device_seconds': self.device_seconds,
                     'prefill_tokens': self.prefill_tokens,
                     'kv_positions': self.kv_positions,
@@ -528,6 +545,8 @@ class ContinuousEngine:
                 - base.get('prefill_steps', 0),
                 'decode_steps': d_decode,
                 'joined': self.joined - base.get('joined', 0),
+                'prio_joined': self.prio_joined
+                - base.get('prio_joined', 0),
                 'retired': self.retired - base.get('retired', 0),
                 'slot_util': round(
                     d_occ / (d_decode * self.slots), 4) if d_decode
@@ -1560,8 +1579,8 @@ class JaxLM(BaseModel):
     def generate_continuous(self, inputs: List[str], max_out_len: int,
                             on_result: Optional[Callable[[int, str],
                                                          None]] = None,
-                            stats_out: Optional[Dict] = None
-                            ) -> List[str]:
+                            stats_out: Optional[Dict] = None,
+                            interactive: bool = False) -> List[str]:
         """Generate through the continuous-batching engine: all rows
         enter the feed queue at once, join the resident decode step as
         slots free up, and retire individually — ``on_result(i, text)``
@@ -1571,8 +1590,11 @@ class JaxLM(BaseModel):
         :meth:`generate` (pinned by tests/test_continuous_batching.py).
         ``stats_out``: optional dict filled with this call's
         prefill/decode token counts and measured time-to-first-token
-        (the serve plane's TTFT SLO rides it).  Returns texts in input
-        order."""
+        (the serve plane's TTFT SLO rides it).  ``interactive=True``
+        routes the rows through the engine's priority lane — serve
+        joins admit into free slots ahead of every queued sweep row,
+        so an interactive completion never waits behind a sweep's
+        prefill backlog.  Returns texts in input order."""
         from opencompass_tpu.icl.inferencers.schedule import \
             feed_queue_order
         engine = self.continuous_engine()
@@ -1588,7 +1610,8 @@ class JaxLM(BaseModel):
                 if on_result is not None:
                     on_result(k, '')
                 continue
-            rows.append(engine.submit(ids[k], max_new, tag=k))
+            rows.append(engine.submit(ids[k], max_new, tag=k,
+                                      interactive=interactive))
         self.perf.tokens_in += sum(len(r) for r in ids)
         self.perf.samples += len(inputs)
         t0 = time.time()

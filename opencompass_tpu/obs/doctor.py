@@ -42,6 +42,13 @@ never to a crash):
 - ``dead_run``           (info)  a 'running' run marker whose driver
                          pid is gone.
 - ``queue_backlog``      (warn)  queued sweeps aging past bounds.
+- ``overload_shedding``  (warn)  the admission controller is refusing
+                         sustained traffic (429/503 sheds from
+                         overload.json), with the shed breakdown by
+                         route and reason.
+- ``breaker_open``       (error) a per-worker circuit breaker is open
+                         (the resident flapped), named with its
+                         failure evidence.
 """
 from __future__ import annotations
 
@@ -62,6 +69,8 @@ GATHER_WASTE_RATIO = 4.0
 PREFILL_STALL_FRAC = 0.3
 QUEUE_BACKLOG_AGE_S = 600.0
 SLOW_REQUEST_FACTOR = 2.0
+SHED_SUSTAINED_MIN = 5
+SHED_SUSTAINED_FRAC = 0.01
 
 
 def _finding(severity: str, rule: str, title: str,
@@ -91,7 +100,7 @@ def collect(path: str) -> Dict:
                  'cache_root': None, 'status': None, 'timelines': {},
                  'events': [], 'requests': [], 'alerts_active': [],
                  'alerts_recent': [], 'run_marker': None,
-                 'queue_pressure': None}
+                 'queue_pressure': None, 'overload': None}
     try:
         art['obs_dir'] = live.resolve_obs_dir(path)
     except Exception:
@@ -145,6 +154,11 @@ def collect(path: str) -> Dict:
             art['requests'] = reqtrace.tail_requests(
                 osp.join(art['serve_obs_dir'], reqtrace.REQUESTS_FILE),
                 max_bytes=4 * 1024 * 1024)
+        except Exception:
+            pass
+        try:
+            from opencompass_tpu.serve.admission import read_overload
+            art['overload'] = read_overload(art['serve_obs_dir'])
         except Exception:
             pass
     if art['cache_root']:
@@ -490,8 +504,76 @@ def _rule_queue_backlog(art: Dict) -> List[Dict]:
             'and restart `cli serve` (recovery re-claims stale sweeps)')]
 
 
+def _rule_overload_shedding(art: Dict) -> List[Dict]:
+    ov = art.get('overload') or {}
+    total = ov.get('shed_total') or 0
+    if total < SHED_SUSTAINED_MIN:
+        return []
+    # the counters are daemon-lifetime: gate on the shed FRACTION too,
+    # so a 5-request blip on day 1 stops warning once a week of clean
+    # traffic dilutes it — "sustained" means demand still exceeds
+    # capacity, not "an incident ever happened"
+    attempts = total + (ov.get('admitted_total') or 0)
+    frac = total / max(attempts, 1)
+    if frac < SHED_SUSTAINED_FRAC:
+        return []
+    evidence = [f'{total} of {attempts} request(s) shed '
+                f'({frac:.1%} of traffic since daemon start)']
+    for route, by_reason in sorted((ov.get('shed') or {}).items()):
+        for reason, count in sorted(by_reason.items()):
+            evidence.append(f'{route}: {count} shed ({reason})')
+    if ov.get('deadline_exceeded_total'):
+        evidence.append(f'{ov["deadline_exceeded_total"]} request(s) '
+                        'exceeded their deadline (504)')
+    if ov.get('inflight_completions') is not None:
+        evidence.append(
+            f'interactive ceiling {ov.get("max_inflight")} '
+            f'({ov.get("inflight_completions")} in flight at '
+            'snapshot)')
+    return [_finding(
+        'warn', 'overload_shedding',
+        f'admission control shed {total} request(s) to protect the '
+        'latency objective',
+        evidence,
+        fix='sustained shedding means demand exceeds capacity: grow '
+            'the fleet (--max-num-workers, decode_slots) or raise '
+            'admission.max_inflight if the ceiling is tighter than '
+            'the hardware; clients should honor the measured '
+            'Retry-After (docs/serving.md "Degradation under load")',
+        data={'shed_total': total})]
+
+
+def _rule_breaker_open(art: Dict) -> List[Dict]:
+    breakers = (art.get('overload') or {}).get('breakers') or {}
+    out = []
+    for key, b in sorted(breakers.items()):
+        if b.get('state') not in ('open', 'half_open'):
+            continue
+        evidence = [f'worker {key}: breaker {b.get("state")} '
+                    f'({b.get("recent_failures")} protocol failure(s) '
+                    f'in window, opened {b.get("opens")}x)']
+        if b.get('last_error'):
+            evidence.append(f'last failure: {b["last_error"]}')
+        if b.get('half_open_in_s') is not None:
+            evidence.append(
+                f'half-open probe in {b["half_open_in_s"]}s')
+        out.append(_finding(
+            'error', 'breaker_open',
+            f'worker {key[:16]} is crash-looping — circuit open, '
+            'leases shed around it',
+            evidence,
+            fix='inspect the worker log under {run_dir}/logs/worker/ '
+                'for the crash; the pool spawns a replacement on the '
+                'half-open probe, but a deterministic crash (OOM, bad '
+                'checkpoint) will re-open the circuit until the cause '
+                'is fixed (docs/serving.md "Degradation under load")',
+            data={'worker': key, 'state': b.get('state')}))
+    return out
+
+
 RULES: List[Callable[[Dict], List[Dict]]] = [
     _rule_failed_tasks,
+    _rule_breaker_open,
     _rule_slo_breach,
     _rule_worker_instability,
     _rule_straggler,
@@ -501,6 +583,7 @@ RULES: List[Callable[[Dict], List[Dict]]] = [
     _rule_prefill_stall,
     _rule_gather_waste,
     _rule_queue_backlog,
+    _rule_overload_shedding,
     _rule_dead_run,
 ]
 

@@ -256,8 +256,10 @@ class ObsHTTPServer:
             snapshot is rendered on every ``/metrics`` scrape).
         routes: extra handlers, ``{(METHOD, path): fn}`` — a key whose
             path ends in ``/`` prefix-matches (longest prefix wins).
-            ``fn(path, query, body_bytes) -> (code, payload)`` where a
-            dict/list payload is rendered as JSON, bytes/str as text.
+            ``fn(path, query, body_bytes) -> (code, payload[,
+            headers])`` where a dict/list payload is rendered as JSON,
+            bytes/str as text, and the optional headers dict rides the
+            response (``Retry-After`` on sheds).
         readiness: optional zero-arg probe returning a dict with a
             ``ready`` bool; upgrades ``/healthz`` to 200/503 readiness.
         status_fn: optional zero-arg snapshot provider for ``/status``
@@ -359,11 +361,13 @@ class ObsHTTPServer:
                     pass
 
                 def _send(self, code: int, content_type: str,
-                          body: bytes):
+                          body: bytes, headers: Optional[Dict] = None):
                     self._code = code
                     self.send_response(code)
                     self.send_header('Content-Type', content_type)
                     self.send_header('Content-Length', str(len(body)))
+                    for name, value in (headers or {}).items():
+                        self.send_header(name, str(value))
                     if self._rid:
                         from opencompass_tpu.obs.reqtrace import \
                             REQUEST_ID_HEADER
@@ -371,7 +375,8 @@ class ObsHTTPServer:
                     self.end_headers()
                     self.wfile.write(body)
 
-                def _send_payload(self, code: int, payload):
+                def _send_payload(self, code: int, payload,
+                                  headers: Optional[Dict] = None):
                     if isinstance(payload, (dict, list)):
                         body = json.dumps(payload, indent=2,
                                           default=str).encode('utf-8')
@@ -380,7 +385,7 @@ class ObsHTTPServer:
                         body = payload if isinstance(payload, bytes) \
                             else str(payload).encode('utf-8')
                         ctype = 'text/plain; charset=utf-8'
-                    self._send(code, ctype, body)
+                    self._send(code, ctype, body, headers)
 
                 def _body(self) -> bytes:
                     try:
@@ -404,15 +409,29 @@ class ObsHTTPServer:
                         self.headers.get(reqtrace.REQUEST_ID_HEADER)) \
                         or reqtrace.mint_request_id()
                     self._code = None
+                    # deadline propagation: a validated
+                    # X-OCT-Deadline-Ms budget anchors the request's
+                    # absolute deadline HERE, at dispatch — every
+                    # downstream wait derives from it
                     token, ctx = reqtrace.begin_request(
-                        self._rid, method, path)
+                        self._rid, method, path,
+                        deadline_ms=reqtrace.parse_deadline_ms(
+                            self.headers.get(reqtrace.DEADLINE_HEADER)))
                     handler, route = server._route_for(method, path)
                     try:
                         if handler is not None:
                             body = self._body() \
                                 if method in ('POST', 'PUT') else b''
-                            code, payload = handler(path, query, body)
-                            self._send_payload(code, payload)
+                            out = handler(path, query, body)
+                            # route contract: (code, payload) or
+                            # (code, payload, headers) — the third
+                            # element carries Retry-After on sheds
+                            if len(out) == 3:
+                                code, payload, hdrs = out
+                            else:
+                                code, payload = out
+                                hdrs = None
+                            self._send_payload(code, payload, hdrs)
                         elif method != 'GET':
                             self._send_payload(404, 'not found\n')
                         elif path == '/healthz':

@@ -63,6 +63,7 @@ from opencompass_tpu.utils.fileio import (append_jsonl_atomic,
 
 REQTRACE_VERSION = 1
 REQUEST_ID_HEADER = 'X-OCT-Request-Id'
+DEADLINE_HEADER = 'X-OCT-Deadline-Ms'
 SERVE_OBS_SUBDIR = osp.join('serve', 'obs')
 REQUESTS_FILE = 'requests.jsonl'
 ACCESS_FILE = 'access.jsonl'
@@ -138,6 +139,51 @@ def normalize_request_id(raw: Optional[str]) -> Optional[str]:
     return raw if _ID_RE.match(raw) else None
 
 
+# -- request deadlines ------------------------------------------------------
+
+class Deadline:
+    """One absolute per-request deadline, minted from the inbound
+    ``X-OCT-Deadline-Ms`` budget at dispatch time and threaded through
+    every downstream wait (admission, chip-lease wait, worker protocol,
+    forward) so each internal timeout is a *derivation* of the one
+    budget instead of an independent knob.
+
+    Monotonic-clock based: the deadline never travels across process
+    boundaries as an absolute timestamp — callers hand the *remaining*
+    budget to the next hop (``remaining_s``), and the hop re-anchors it
+    against its own clock."""
+
+    __slots__ = ('budget_ms', 'deadline_ts')
+
+    def __init__(self, budget_ms: float, now: Optional[float] = None):
+        self.budget_ms = float(budget_ms)
+        anchor = time.monotonic() if now is None else float(now)
+        self.deadline_ts = anchor + self.budget_ms / 1e3
+
+    def remaining_s(self, now: Optional[float] = None) -> float:
+        """Seconds left (may be negative once expired)."""
+        anchor = time.monotonic() if now is None else float(now)
+        return self.deadline_ts - anchor
+
+    def expired(self, now: Optional[float] = None) -> bool:
+        return self.remaining_s(now) <= 0.0
+
+
+def parse_deadline_ms(raw) -> Optional[float]:
+    """An inbound ``X-OCT-Deadline-Ms`` header value, validated — a
+    positive finite millisecond budget, or None (absent/garbage ⇒ no
+    deadline; a malformed header must never fail the request)."""
+    if raw is None:
+        return None
+    try:
+        val = float(str(raw).strip())
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(val) or val <= 0:
+        return None
+    return val
+
+
 # -- per-request context (HTTP dispatch ↔ handler hand-off) ----------------
 
 class RequestContext:
@@ -146,24 +192,31 @@ class RequestContext:
     ``fn(path, query, body)`` route contract.  ``annotations`` is the
     handler's channel back to the access log (model, sweep id)."""
 
-    __slots__ = ('request_id', 'method', 'path', 't0', 'annotations')
+    __slots__ = ('request_id', 'method', 'path', 't0', 'annotations',
+                 'deadline')
 
-    def __init__(self, request_id: str, method: str, path: str):
+    def __init__(self, request_id: str, method: str, path: str,
+                 deadline: Optional[Deadline] = None):
         self.request_id = request_id
         self.method = method
         self.path = path
         self.t0 = time.perf_counter()
         self.annotations: Dict = {}
+        self.deadline = deadline
 
 
 _CURRENT_REQUEST: contextvars.ContextVar = contextvars.ContextVar(
     'oct_current_request', default=None)
 
 
-def begin_request(request_id: str, method: str, path: str):
+def begin_request(request_id: str, method: str, path: str,
+                  deadline_ms: Optional[float] = None):
     """Install the request context for this thread; returns the token
-    for :func:`end_request`."""
-    ctx = RequestContext(request_id, method, path)
+    for :func:`end_request`.  ``deadline_ms`` (the validated
+    ``X-OCT-Deadline-Ms`` budget) anchors the request's
+    :class:`Deadline` at dispatch time."""
+    deadline = Deadline(deadline_ms) if deadline_ms else None
+    ctx = RequestContext(request_id, method, path, deadline=deadline)
     return _CURRENT_REQUEST.set(ctx), ctx
 
 
@@ -181,6 +234,14 @@ def current() -> Optional[RequestContext]:
 def current_request_id() -> Optional[str]:
     ctx = _CURRENT_REQUEST.get()
     return ctx.request_id if ctx is not None else None
+
+
+def current_deadline() -> Optional[Deadline]:
+    """The in-flight request's deadline (None without one) — how the
+    serve handlers pick up the dispatch guard's ``X-OCT-Deadline-Ms``
+    parse without widening the route contract."""
+    ctx = _CURRENT_REQUEST.get()
+    return ctx.deadline if ctx is not None else None
 
 
 def annotate(**fields):
@@ -340,7 +401,14 @@ class RollingStats:
                           device_rows: int = 0,
                           ts: Optional[float] = None,
                           mbu: Optional[float] = None,
-                          itl_ms: Optional[List[float]] = None):
+                          itl_ms: Optional[List[float]] = None,
+                          slo_excluded: bool = False):
+        """``slo_excluded=True`` keeps the sample visible in the
+        ``/v1/stats`` window but OUT of the SLO evaluator's feed — the
+        deadline-504 case: its "latency" is the client's budget, not
+        service time, and counting client-caused failures as burned
+        error budget would let one impatient client page the
+        on-call."""
         try:
             with self._lock:
                 self._completions.append(
@@ -354,9 +422,24 @@ class RollingStats:
                      # pooled across the window so the per-model
                      # itl_p50/p99 are true percentiles over tokens,
                      # not percentiles of per-request percentiles
-                     [float(v) for v in itl_ms] if itl_ms else None))
+                     [float(v) for v in itl_ms] if itl_ms else None,
+                     bool(slo_excluded)))
         except Exception:
             pass
+
+    def median_completion_latency_s(self, window_s: float = 300.0,
+                                    now: Optional[float] = None
+                                    ) -> Optional[float]:
+        """Rolling median completion latency (None on an empty window)
+        — the admission controller's measured Retry-After unit for
+        concurrency sheds ("a seat frees in about one median
+        completion")."""
+        now = time.time() if now is None else now
+        cutoff = now - window_s
+        with self._lock:
+            lat = [s[2] for s in self._completions if s[0] >= cutoff
+                   and not (len(s) > 9 and s[9])]
+        return percentile(lat, 0.5) if lat else None
 
     def completion_samples(self, window_s: float,
                            now: Optional[float] = None) -> List[Dict]:
@@ -367,7 +450,8 @@ class RollingStats:
         now = time.time() if now is None else now
         cutoff = now - window_s
         with self._lock:
-            samples = [s for s in self._completions if s[0] >= cutoff]
+            samples = [s for s in self._completions if s[0] >= cutoff
+                       and not (len(s) > 9 and s[9])]
         return [{'ts': s[0], 'model': s[1], 'latency_s': s[2],
                  'ttft_s': s[3], 'ok': s[4]} for s in samples]
 

@@ -501,15 +501,24 @@ class SLOEvaluator:
 
     def active(self) -> List[Dict]:
         """The currently-firing rules (``/v1/alerts`` + the ``cli top``
-        pane + ``/healthz`` degradation)."""
+        pane + ``/healthz`` degradation + the admission controller's
+        burn signals).  Ratio rows carry the rule's window geometry
+        (``fast_s``, ``burn_factor``) next to the live burn values so
+        consumers — admission's burn-based Retry-After derivation in
+        particular — can reason about recovery horizons without a
+        second lookup into the rule set."""
         out = []
         with self._lock:
             for slo in self.slos:
                 st = self._state[slo.name]
                 if st.firing:
-                    out.append({'rule': slo.name, 'kind': slo.kind,
-                                'severity': slo.severity,
-                                'since': st.fired_ts, **st.last})
+                    row = {'rule': slo.name, 'kind': slo.kind,
+                           'severity': slo.severity,
+                           'since': st.fired_ts, **st.last}
+                    if slo.kind in RATIO_KINDS:
+                        row.setdefault('fast_s', slo.fast_s)
+                        row.setdefault('burn_factor', slo.burn_factor)
+                    out.append(row)
         return out
 
     def snapshot(self) -> Dict:

@@ -21,7 +21,10 @@ Requests::
      "cfg_path": "/tmp/...py", "name": "<task name>",
      "log_path": "<per-task log>"}
     {"cmd": "complete", "model_cfg": {...}, "prompts": ["..."],
-     "max_out_len": 16, "request_id": "req-..."}
+     "max_out_len": 16, "request_id": "req-...",
+     "deadline_s": 2.5}        # optional remaining deadline budget;
+                               # expired -> {"deadline_exceeded": true,
+                               #             "phase": "<where it went>"}
     {"cmd": "ping"}
     {"cmd": "shutdown"}
 
@@ -558,6 +561,28 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
     max_out_len = int(msg.get('max_out_len') or 16)
     request_id = msg.get('request_id')
     phases: Dict[str, float] = {}
+    # deadline propagation: the daemon hands over the REMAINING budget
+    # at send time (clocks never cross the process boundary); the
+    # worker re-anchors it and fails fast — a request that expired on
+    # the channel must not spend device time
+    deadline_ts = None
+    raw_deadline = msg.get('deadline_s')
+    if isinstance(raw_deadline, (int, float)) and raw_deadline > 0:
+        deadline_ts = time.monotonic() + float(raw_deadline)
+
+    def _expired() -> bool:
+        return deadline_ts is not None \
+            and time.monotonic() >= deadline_ts
+
+    def _deadline_resp(phase: str) -> Dict:
+        return {'ok': False, 'deadline_exceeded': True, 'phase': phase,
+                'error': f'deadline expired during {phase}',
+                'phases': phases, 'pid': os.getpid(),
+                'request_id': request_id}
+
+    if _expired():
+        # the budget died on the protocol channel before any work
+        return _deadline_resp('worker_protocol')
     t0 = time.perf_counter()
     built = not model_cached(model_cfg)
     if during_run and built:
@@ -569,8 +594,16 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
                 'request_id': request_id}
     model = build_model_from_cfg(model_cfg)   # memoized (residency)
     phases['model_build_s'] = round(time.perf_counter() - t0, 6)
+    if _expired():
+        return _deadline_resp('model_build')
+    inject_s = 0.0
     if prompts:
+        t = time.perf_counter()
         _debug_complete_sleep()
+        # the injected serving slowdown models forward latency — fold
+        # it into the forward phase so SLO/deadline attribution reads
+        # "the forward was slow", which is what it simulates
+        inject_s = time.perf_counter() - t
     if not prompts:   # warm-up probe: model on device, nothing to say
         return {'ok': True, 'completions': [], 'built': built,
                 'build_seconds': round(time.perf_counter() - t0, 3),
@@ -601,6 +634,11 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
                 hits += 1
     phases['store_lookup_s'] = round(time.perf_counter() - t, 6)
     todo = [i for i, c in enumerate(completions) if c is None]
+    if todo and _expired():
+        # deadline shorter than the forward could ever be (TTFT
+        # included): fail before dispatching device work
+        phases['model_forward_s'] = round(inject_s, 6)
+        return _deadline_resp('model_forward')
     calls: List[Dict] = []
     joined_engine = False
     if todo and getattr(model, 'continuous_active', False):
@@ -616,8 +654,10 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
                                rows=len(todo), engine_join=True):
             outs = model.generate_continuous(
                 [prompts[i] for i in todo], max_out_len,
-                stats_out=engine_stats)
-        phases['model_forward_s'] = round(time.perf_counter() - t, 6)
+                stats_out=engine_stats,
+                interactive=True)   # priority lane: never behind sweep
+        phases['model_forward_s'] = round(
+            time.perf_counter() - t + inject_s, 6)
     elif todo and during_run:
         return {'ok': False, 'busy': True,
                 'error': 'worker busy (no resident continuous engine '
@@ -642,7 +682,7 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
                 outs = model.generate([prompts[i] for i in todo],
                                       max_out_len=max_out_len)
             phases['model_forward_s'] = round(
-                time.perf_counter() - t, 6)
+                time.perf_counter() - t + inject_s, 6)
             calls = _collect_tracked_calls(model)
         finally:
             if installed is not None:
@@ -654,6 +694,19 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
             if ctx is not None:
                 ctx.put(keys[i], out)
         phases['store_commit_s'] = round(time.perf_counter() - t, 6)
+    if inject_s and 'model_forward_s' not in phases:
+        phases['model_forward_s'] = round(inject_s, 6)
+    if _expired():
+        # expired mid-request: the rows are committed (work not
+        # wasted — the next identical request is a store hit), but the
+        # caller's budget is spent, and a late 200 is a lie the client
+        # already timed out on.  Attribute the 504 to whichever phase
+        # ACTUALLY dominated (a store-hit-only request that expired in
+        # lookup must not claim a forward that never ran).
+        dominant = max(phases, key=phases.get) if phases \
+            else 'model_forward_s'
+        return _deadline_resp(dominant[:-2]
+                              if dominant.endswith('_s') else dominant)
     prompt_tokens = completion_tokens = None
     try:
         prompt_tokens = sum(model.get_token_len(p) for p in prompts)

@@ -14,11 +14,29 @@ Request-scoped telemetry (``obs/reqtrace.py``) rides on every HTTP
 request: ids, span-tree records under ``{cache_root}/serve/obs/``,
 rolling SLO windows on ``GET /v1/stats``, and the ``cli top`` fleet
 dashboard (``serve/top.py``).
+
+Degradation plane (``serve/admission.py``): SLO-aware admission
+control with priority classes (interactive > sweep), deadline
+propagation (``X-OCT-Deadline-Ms``), per-model retry budgets, and
+per-worker circuit breakers — overload sheds with ``429 +
+Retry-After`` derived from measured queue age / burn state, and the
+chaos harness (``analysis/chaos.py``, ``cli chaos``) proves the
+degradation invariants against a live daemon.
 """
+from opencompass_tpu.serve.admission import (AdmissionController,
+                                             DeadlineExceeded,
+                                             OverloadedError,
+                                             ShedRequest)
 from opencompass_tpu.serve.daemon import EvalEngine, serve_main
 from opencompass_tpu.serve.queue import (QUEUE_SUBDIR, SweepQueue,
                                          new_sweep_id)
-from opencompass_tpu.serve.scheduler import ResidentWorker, WorkerPool
+from opencompass_tpu.serve.scheduler import (CircuitBreaker,
+                                             CircuitOpenError,
+                                             ResidentWorker,
+                                             RetryBudget, WorkerPool)
 
-__all__ = ['EvalEngine', 'QUEUE_SUBDIR', 'ResidentWorker', 'SweepQueue',
-           'WorkerPool', 'new_sweep_id', 'serve_main']
+__all__ = ['AdmissionController', 'CircuitBreaker', 'CircuitOpenError',
+           'DeadlineExceeded', 'EvalEngine', 'OverloadedError',
+           'QUEUE_SUBDIR', 'ResidentWorker', 'RetryBudget',
+           'ShedRequest', 'SweepQueue', 'WorkerPool', 'new_sweep_id',
+           'serve_main']

@@ -1,0 +1,390 @@
+"""SLO-aware admission control: the serve daemon's degradation plane.
+
+PRs 8/10/12 taught the daemon to *measure* overload — queue age,
+p99/TTFT, burn-rate alerts — but it still *behaved* the same saturated
+as idle: every request parked an HTTP thread until some timeout fired,
+and sweeps competed with interactive traffic as equal lease-takers.
+This module is where measurement becomes behavior: an
+:class:`AdmissionController` consulted by the engine **before** any
+work is admitted, deciding per request whether to serve it now or shed
+it with an honest retry hint.
+
+Priority classes (interactive > sweep):
+
+- ``POST /v1/sweeps`` (batch work) sheds **first**: past a queue-depth
+  bound, or whenever a page-severity burn-rate alert is firing — batch
+  backlog is the load we drop to protect interactive latency.
+- ``POST /v1/completions`` (interactive) sheds **last**: only at the
+  configured concurrency ceiling, or — while an SLO is burning — at
+  half of it, so a burning daemon drains its in-flight set instead of
+  stacking more latency on it.
+
+Shed responses are ``429`` with a ``Retry-After`` derived from
+**measurements**, never a constant:
+
+- queue-depth sheds: the queue's measured drain ETA (mean recent sweep
+  wall × pending sweeps — :meth:`~opencompass_tpu.serve.queue
+  .SweepQueue.drain_eta_seconds`), falling back to the oldest queued
+  age when no sweep has finished yet;
+- concurrency sheds: the rolling window's median completion latency ×
+  the overflow depth (how long until a seat frees up);
+- burn sheds: the firing rule's fast-window span scaled down by how
+  hard it is burning (a 6× burn recovers no sooner than the window
+  that must drain).
+
+Everything evaluates under an injected ``now=`` so shed decisions are
+deterministic in tests, and every decision is counted
+(``oct_serve_shed_total{route,reason}``) and snapshotted into the
+durable ``overload.json`` so ``cli top`` and ``cli doctor`` can read
+the degradation story off a dead daemon.
+
+The typed errors at the bottom are the serve layer's degradation
+taxonomy — the HTTP front door maps them to status codes:
+
+==================  ====  =============================================
+exception           code  meaning
+==================  ====  =============================================
+ShedRequest         429   admission refused; retry after the hint
+OverloadedError     503   admitted but a bounded wait hit its budget
+                          (busy channel, no free chips, open breaker)
+                          — the worker is healthy, retry later
+DeadlineExceeded    504   the caller's X-OCT-Deadline-Ms expired;
+                          ``phase`` names where the budget went
+==================  ====  =============================================
+"""
+from __future__ import annotations
+
+# oct-lint: clock-discipline — shed decisions and retry-after math
+# evaluate under an injected now=; bare time.time() only as the
+# `if now is None` fallback.
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+OVERLOAD_FILE = 'overload.json'
+
+DEFAULT_MAX_INFLIGHT = 8
+DEFAULT_MAX_QUEUE_DEPTH = 32
+MIN_RETRY_AFTER_S = 1.0
+MAX_RETRY_AFTER_S = 600.0
+
+
+def clamp_retry_after(seconds) -> float:
+    """Retry-After values stay honest *and* useful: at least 1 s (a 0
+    would invite an immediate hammer), at most 10 min (past that the
+    client should re-plan, not sleep)."""
+    try:
+        val = float(seconds)
+    except (TypeError, ValueError):
+        return MIN_RETRY_AFTER_S
+    return min(max(val, MIN_RETRY_AFTER_S), MAX_RETRY_AFTER_S)
+
+
+# -- typed degradation errors -----------------------------------------------
+
+class ShedRequest(RuntimeError):
+    """Admission refused (429): the daemon is protecting its objective.
+    ``reason`` is the machine-readable shed class (metric label);
+    ``retry_after_s`` the measured retry hint."""
+
+    def __init__(self, reason: str, retry_after_s: float, detail: str):
+        super().__init__(detail)
+        self.reason = reason
+        self.retry_after_s = clamp_retry_after(retry_after_s)
+
+
+class OverloadedError(RuntimeError):
+    """An admitted request hit a bounded wait (busy worker channel,
+    chip-lease timeout, open circuit breaker): 503 + Retry-After —
+    "retry later", distinct from the 502 a dead worker earns."""
+
+    def __init__(self, detail: str, retry_after_s: float = 5.0,
+                 reason: str = 'busy'):
+        super().__init__(detail)
+        self.reason = reason
+        self.retry_after_s = clamp_retry_after(retry_after_s)
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's ``X-OCT-Deadline-Ms`` budget ran out (504).
+    ``phase`` names the serving phase that consumed it — parse, lease
+    wait, worker protocol, model forward — so the 504 body tells the
+    caller *where* the time went, and the requests.jsonl record's
+    spans show the same story."""
+
+    def __init__(self, phase: str, detail: str,
+                 worker_resp: Optional[Dict] = None):
+        super().__init__(detail)
+        self.phase = phase
+        # the worker's partial response (phase timings) when it was
+        # the one enforcing the deadline — the requests.jsonl record
+        # lays these out so the 504's spans show where the time went
+        self.worker_resp = worker_resp
+
+
+# -- controller -------------------------------------------------------------
+
+class AdmissionDecision:
+    """One admit/shed verdict."""
+
+    __slots__ = ('admitted', 'reason', 'retry_after_s', 'detail')
+
+    def __init__(self, admitted: bool, reason: str = 'ok',
+                 retry_after_s: Optional[float] = None,
+                 detail: str = ''):
+        self.admitted = admitted
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.detail = detail
+
+    def raise_if_shed(self):
+        if not self.admitted:
+            raise ShedRequest(self.reason, self.retry_after_s or
+                              MIN_RETRY_AFTER_S, self.detail)
+
+
+class AdmissionController:
+    """Per-request admit/shed decisions from live SLO + queue signals.
+
+    Args:
+        max_inflight: interactive concurrency ceiling (seats).  The
+            hard shed line; while a page-severity alert burns the
+            effective ceiling halves (degraded_inflight).
+        max_queue_depth: queued-sweep bound for ``POST /v1/sweeps``.
+        shed_sweeps_when_degraded: refuse new batch work while a
+            page-severity alert fires (default True — batch is the
+            load shed first).
+        alerts_fn: zero-arg provider of the active alert list
+            (``SLOEvaluator.active()`` shape: dicts with ``severity``,
+            ``burn_fast``, and the rule spec's ``fast_s`` when known).
+        queue_eta_fn: zero-arg provider of ``(depth, eta_s)`` —
+            measured sweep-queue drain estimate.
+        latency_fn: zero-arg provider of the rolling median completion
+            latency in seconds (None with an empty window).
+    """
+
+    def __init__(self,
+                 max_inflight: int = DEFAULT_MAX_INFLIGHT,
+                 max_queue_depth: int = DEFAULT_MAX_QUEUE_DEPTH,
+                 shed_sweeps_when_degraded: bool = True,
+                 alerts_fn: Optional[Callable[[], List[Dict]]] = None,
+                 queue_eta_fn: Optional[Callable] = None,
+                 latency_fn: Optional[Callable] = None):
+        self.max_inflight = max(int(max_inflight), 1)
+        self.max_queue_depth = max(int(max_queue_depth), 1)
+        self.shed_sweeps_when_degraded = bool(shed_sweeps_when_degraded)
+        self.alerts_fn = alerts_fn
+        self.queue_eta_fn = queue_eta_fn
+        self.latency_fn = latency_fn
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._inflight = 0
+        # guarded-by: _lock
+        self._shed_total: Dict[str, int] = {}
+        # guarded-by: _lock
+        self._deadline_exceeded = 0
+        # guarded-by: _lock
+        self._admitted_total = 0
+
+    # -- config -------------------------------------------------------------
+
+    @classmethod
+    def from_cfg(cls, spec: Optional[Dict], **wiring
+                 ) -> 'AdmissionController':
+        """Build from a serve config's ``admission = dict(...)`` block
+        (unknown keys rejected at daemon construction, not mid-
+        incident)."""
+        spec = dict(spec or {})
+        known = {'max_inflight', 'max_queue_depth',
+                 'shed_sweeps_when_degraded'}
+        unknown = set(spec) - known
+        if unknown:
+            raise ValueError(
+                f'unknown admission config key(s) {sorted(unknown)}; '
+                f'expected a subset of {sorted(known)}')
+        return cls(**spec, **wiring)
+
+    # -- inflight accounting ------------------------------------------------
+
+    def begin(self):
+        """Reserve a seat without an admission decision (tests and
+        callers that bypass :meth:`admit_completion`)."""
+        with self._lock:
+            self._inflight += 1
+            self._admitted_total += 1
+
+    def end(self):
+        """Release the seat an admitted decision (or :meth:`begin`)
+        holds."""
+        with self._lock:
+            self._inflight = max(0, self._inflight - 1)
+
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return self._inflight
+
+    # -- decisions ----------------------------------------------------------
+
+    def _page_alerts(self) -> List[Dict]:
+        try:
+            return [a for a in (self.alerts_fn() if self.alerts_fn
+                                else []) or []
+                    if a.get('severity') == 'page']
+        except Exception:
+            return []
+
+    def _burn_retry_after(self, alerts: List[Dict]) -> float:
+        """Recovery horizon from burn state: the firing rule's fast
+        window must drain of bad samples before the alert can resolve
+        — scale its span down by how hard it burns (a barely-burning
+        rule recovers in a fraction of the window; a 10× burn needs
+        most of it)."""
+        horizon = 30.0
+        for alert in alerts:
+            fast_s = alert.get('fast_s') or 300.0
+            burn = alert.get('burn_fast')
+            if burn is None and isinstance(alert.get('value'), dict):
+                burn = alert['value'].get('burn_fast')
+            frac = min(1.0, 1.0 - 1.0 / max(float(burn or 2.0), 1.001))
+            horizon = max(horizon, fast_s * frac)
+        return horizon
+
+    def admit_completion(self,
+                         now: Optional[float] = None
+                         ) -> AdmissionDecision:
+        """Interactive lane: shed only at the concurrency ceiling (or
+        half of it while an SLO burns).  Admission RESERVES the seat
+        atomically (decide-then-begin would let a concurrent burst
+        race past the ceiling) — the caller must pair every admitted
+        decision with one :meth:`end`."""
+        alerts = self._page_alerts()   # external call: outside _lock
+        limit = self.max_inflight
+        if alerts:
+            limit = max(1, self.max_inflight // 2)
+        with self._lock:
+            if self._inflight < limit:
+                self._inflight += 1
+                self._admitted_total += 1
+                return AdmissionDecision(True)
+            inflight = self._inflight
+        overflow = inflight - limit + 1
+        if alerts:
+            retry = self._burn_retry_after(alerts)
+            reason = 'slo_burn'
+            detail = (f'SLO burning ({len(alerts)} page alert(s)) with '
+                      f'{inflight} completion(s) in flight (degraded '
+                      f'ceiling {limit}); retry once the fast window '
+                      'recovers')
+        else:
+            median_s = None
+            try:
+                median_s = self.latency_fn() if self.latency_fn else None
+            except Exception:
+                pass
+            retry = (median_s or 1.0) * overflow
+            reason = 'interactive_concurrency'
+            detail = (f'{inflight} completion(s) in flight >= ceiling '
+                      f'{limit}; a seat frees in about a median '
+                      'completion')
+        return self._shed('/v1/completions', reason, retry, detail)
+
+    def admit_sweep(self, now: Optional[float] = None
+                    ) -> AdmissionDecision:
+        """Batch lane: shed past the queue-depth bound, or whenever a
+        page alert burns (sweeps are the load shed first)."""
+        alerts = self._page_alerts()
+        if alerts and self.shed_sweeps_when_degraded:
+            return self._shed(
+                '/v1/sweeps', 'slo_burn',
+                self._burn_retry_after(alerts),
+                f'{len(alerts)} page alert(s) firing — new batch work '
+                'is refused while interactive latency recovers')
+        depth, eta_s = 0, None
+        try:
+            if self.queue_eta_fn is not None:
+                depth, eta_s = self.queue_eta_fn()
+        except Exception:
+            pass
+        if depth >= self.max_queue_depth:
+            return self._shed(
+                '/v1/sweeps', 'queue_depth',
+                eta_s if eta_s else 60.0,
+                f'{depth} sweep(s) queued >= bound '
+                f'{self.max_queue_depth}; retry after the measured '
+                'drain ETA')
+        return AdmissionDecision(True)
+
+    def _shed(self, route: str, reason: str, retry_after_s: float,
+              detail: str) -> AdmissionDecision:
+        with self._lock:
+            key = f'{route}|{reason}'
+            self._shed_total[key] = self._shed_total.get(key, 0) + 1
+        return AdmissionDecision(False, reason,
+                                 clamp_retry_after(retry_after_s),
+                                 detail)
+
+    def note_deadline_exceeded(self):
+        with self._lock:
+            self._deadline_exceeded += 1
+
+    # -- read side ----------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        """The ``/v1/stats`` ``overload`` block (minus breaker state,
+        which the worker pool owns)."""
+        with self._lock:
+            sheds = {}
+            for key, count in sorted(self._shed_total.items()):
+                route, _, reason = key.partition('|')
+                sheds.setdefault(route, {})[reason] = count
+            return {
+                'inflight_completions': self._inflight,
+                'max_inflight': self.max_inflight,
+                'max_queue_depth': self.max_queue_depth,
+                'admitted_total': self._admitted_total,
+                'shed_total': sum(self._shed_total.values()),
+                'shed': sheds,
+                'deadline_exceeded_total': self._deadline_exceeded,
+            }
+
+    def shed_series(self) -> List[Dict]:
+        """Flat ``{route, reason, total}`` rows for the metrics
+        registry (``oct_serve_shed_total{route,reason}``)."""
+        with self._lock:
+            out = []
+            for key, count in sorted(self._shed_total.items()):
+                route, _, reason = key.partition('|')
+                out.append({'route': route, 'reason': reason,
+                            'total': count})
+            return out
+
+
+def read_overload(serve_obs_dir: str) -> Optional[Dict]:
+    """The durable ``overload.json`` snapshot (dead-daemon ``cli top``
+    and the doctor's overload rules), or None when absent/garbage."""
+    import json
+    import os.path as osp
+    try:
+        with open(osp.join(serve_obs_dir, OVERLOAD_FILE),
+                  encoding='utf-8') as f:
+            rec = json.load(f)
+        return rec if isinstance(rec, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def write_overload(serve_obs_dir: str, snapshot: Dict,
+                   now: Optional[float] = None):
+    """Atomically persist the overload snapshot (never raises — the
+    degradation plane must not fail a request over telemetry)."""
+    import os.path as osp
+    try:
+        from opencompass_tpu.utils.fileio import atomic_write_json
+        atomic_write_json(
+            osp.join(serve_obs_dir, OVERLOAD_FILE),
+            dict(snapshot,
+                 ts=round(time.time() if now is None else now, 3)))
+    except Exception:
+        pass

@@ -42,8 +42,15 @@ from typing import Dict, List, Optional
 
 from opencompass_tpu.obs import reqtrace
 from opencompass_tpu.obs import slo as slomod
+from opencompass_tpu.serve import admission as admctl
+from opencompass_tpu.serve.admission import (AdmissionController,
+                                             DeadlineExceeded,
+                                             OverloadedError,
+                                             ShedRequest)
 from opencompass_tpu.serve.queue import QUEUE_SUBDIR, SweepQueue
-from opencompass_tpu.serve.scheduler import WorkerPool
+from opencompass_tpu.serve.scheduler import (RETRY_MAX_ATTEMPTS,
+                                             RetryBudget, WorkerPool,
+                                             backoff_delay)
 from opencompass_tpu.utils.logging import add_file_handler, get_logger
 
 logger = get_logger()
@@ -51,6 +58,10 @@ logger = get_logger()
 DEFAULT_IDLE_TTL_S = 600.0
 DEFAULT_COMPLETE_TIMEOUT_S = 300.0
 DEFAULT_SLO_EVAL_INTERVAL_S = 5.0
+# how long past a request's deadline the daemon keeps waiting for the
+# worker's own (phase-attributed) deadline_exceeded response before
+# giving up with the blunter worker_protocol attribution
+DEADLINE_GRACE_S = 2.0
 
 
 def _wire_model_cfg(model_cfg: Dict) -> Dict:
@@ -124,6 +135,23 @@ class EvalEngine:
         self.slo_eval_interval_s = float(
             cfg.get('slo_eval_interval_s', DEFAULT_SLO_EVAL_INTERVAL_S))
         self._slo_thread: Optional[threading.Thread] = None
+        # degradation plane (serve/admission.py): SLO-aware admission
+        # consulted before every completion and sweep enqueue —
+        # priority classes (interactive > sweep), 429 sheds with
+        # measured Retry-After.  Config `admission = dict(...)`;
+        # malformed specs fail HERE, at construction.
+        self.admission = AdmissionController.from_cfg(
+            cfg.get('admission'),
+            # active() rows carry fast_s/burn_factor next to the live
+            # burn values — the burn-based Retry-After inputs
+            alerts_fn=self.slo_eval.active,
+            queue_eta_fn=self._queue_eta,
+            latency_fn=lambda:
+                self.req_stats.median_completion_latency_s(),
+        )
+        # per-model retry budget: worker-protocol retries draw from a
+        # token bucket so a flapping incident never amplifies load
+        self.retry_budget = RetryBudget()
         self._key_abbr: Optional[Dict[str, str]] = None
         self.pool: Optional[WorkerPool] = None
         self.infer_runner = None
@@ -217,6 +245,8 @@ class EvalEngine:
                 f'{self.requested_port}')
         reqtrace.write_engine_info(self.serve_obs_dir, self.port,
                                    self.run_dir)
+        admctl.write_overload(self.serve_obs_dir,
+                              self.overload_snapshot())
 
         requeued = self.queue.recover()
         if requeued:
@@ -395,12 +425,43 @@ class EvalEngine:
         from opencompass_tpu.utils.build import model_cfg_key
         return model_cfg_key(model_cfg)
 
+    def _queue_eta(self):
+        eta = self.queue.drain_eta_seconds()
+        return eta['depth'], eta['eta_seconds']
+
+    def admit_sweep(self):
+        """Admission gate for ``POST /v1/sweeps`` (the HTTP handler
+        consults this before enqueueing).  Counts sheds into
+        ``oct_serve_shed_total{route,reason}``."""
+        decision = self.admission.admit_sweep()
+        if not decision.admitted:
+            self._note_shed('/v1/sweeps', decision.reason)
+        return decision
+
+    def _note_shed(self, route: str, reason: str):
+        try:
+            if self.tracer is not None and self.tracer.enabled:
+                from opencompass_tpu.obs.metrics import labeled
+                self.tracer.counter(labeled(
+                    'serve.shed', route=route, reason=reason)).inc()
+        except Exception:
+            pass
+
+    def _note_deadline_exceeded(self):
+        self.admission.note_deadline_exceeded()
+        try:
+            if self.tracer is not None and self.tracer.enabled:
+                self.tracer.counter('serve.deadline_exceeded').inc()
+        except Exception:
+            pass
+
     def complete(self, model: str, prompts: List[str],
                  max_out_len: int = 16,
                  timeout: float = DEFAULT_COMPLETE_TIMEOUT_S,
                  request_id: Optional[str] = None,
                  response_id: Optional[str] = None,
-                 parse_seconds: float = 0.0) -> Dict:
+                 parse_seconds: float = 0.0,
+                 deadline: Optional[reqtrace.Deadline] = None) -> Dict:
         """Generate completions on the resident worker for ``model``
         (catalog abbr).  Store-first: a prompt identical to a sweep row
         or a previous request is served from disk without touching the
@@ -425,24 +486,54 @@ class EvalEngine:
         timings: Dict[str, float] = {}
         resp = None
         error = None
+        admitted = False
+        degraded_kind = None   # 'shed' | 'deadline' | None
         try:
             model_cfg = self._catalog.get(model)
             if model_cfg is None:
                 raise KeyError(model)
+            # deadline first: a request that arrived already expired
+            # (or whose budget died in parse) must fail fast — 504,
+            # no admission seat, no chip lease
+            if deadline is not None and deadline.expired():
+                raise DeadlineExceeded(
+                    'parse', 'deadline expired before admission '
+                    f'(budget {deadline.budget_ms:.0f}ms)')
+            # SLO-aware admission: the interactive lane sheds at the
+            # concurrency ceiling (halved while an SLO burns) — the
+            # shed still lands in requests.jsonl via the finally
+            # below, so no accepted request is ever silently dropped.
+            # An admitted decision already HOLDS the seat (atomic
+            # reserve); the finally releases it.
+            self.admission.admit_completion().raise_if_shed()
+            admitted = True
             resp = self._request_complete(model_cfg, prompts,
                                           max_out_len, timeout,
                                           request_id=request_id,
-                                          timings=timings)
+                                          timings=timings,
+                                          deadline=deadline)
         except BaseException as exc:
             error = f'{type(exc).__name__}: {exc}'
+            if isinstance(exc, DeadlineExceeded):
+                self._note_deadline_exceeded()
+                # the worker's partial phase timings ride the record:
+                # the 504's spans show where the budget went
+                resp = exc.worker_resp or resp
+                degraded_kind = 'deadline'
+            elif isinstance(exc, ShedRequest):
+                self._note_shed('/v1/completions', exc.reason)
+                degraded_kind = 'shed'
             raise
         finally:
+            if admitted:
+                self.admission.end()
             wall = parse_seconds + (time.perf_counter() - t0)
             self._record_request(
                 response_id=response_id, request_id=request_id,
                 ts=ts, model=model, wall_s=wall,
                 parse_s=parse_seconds, timings=timings,
-                resp=resp, error=error)
+                resp=resp, error=error,
+                degraded_kind=degraded_kind)
         with self._complete_lock:
             self._completions += 1
         resp['id'] = response_id
@@ -457,9 +548,19 @@ class EvalEngine:
     def _record_request(self, response_id: str, request_id: str,
                         ts: float, model: str, wall_s: float,
                         parse_s: float, timings: Dict,
-                        resp: Optional[Dict], error: Optional[str]):
+                        resp: Optional[Dict], error: Optional[str],
+                        degraded_kind: Optional[str] = None):
         """One requests.jsonl record + rolling-window/histogram feed
-        per completion attempt.  Never raises (telemetry contract)."""
+        per completion attempt.  Never raises (telemetry contract).
+
+        ``degraded_kind`` marks degradation-plane refusals: ``'shed'``
+        (429 — refused before any work; recorded durably but kept out
+        of the rolling completion window entirely, since a refusal is
+        not a completion and its ~0 ms "latency" would drag p99 *down*
+        while burning the availability budget — a shed-causes-burn-
+        causes-shed feedback loop) and ``'deadline'`` (504 — recorded
+        in the window for visibility but excluded from the SLO feed;
+        the client's budget, not our service time)."""
         try:
             from opencompass_tpu.obs.metrics import labeled
             wp = (resp or {}).get('phases') or {}
@@ -499,6 +600,8 @@ class EvalEngine:
             }
             if error:
                 rec['error'] = error
+            if degraded_kind:
+                rec['degraded'] = degraded_kind
             ttft = None
             if resp is not None:
                 ttft = resp.get('ttft_s')
@@ -530,18 +633,25 @@ class EvalEngine:
             # in the requests.jsonl record above
             label_model = model if model in self._catalog \
                 else '(unknown)'
-            self.req_stats.record_completion(
-                label_model, wall_s, ttft_s=ttft, ok=ok,
-                store_hits=(resp or {}).get('store_hits') or 0,
-                device_rows=(resp or {}).get('device_rows') or 0,
-                ts=ts, mbu=(resp or {}).get('mbu'),
-                itl_ms=(resp or {}).get('itl_ms'))
+            if degraded_kind != 'shed':
+                self.req_stats.record_completion(
+                    label_model, wall_s, ttft_s=ttft, ok=ok,
+                    store_hits=(resp or {}).get('store_hits') or 0,
+                    device_rows=(resp or {}).get('device_rows') or 0,
+                    ts=ts, mbu=(resp or {}).get('mbu'),
+                    itl_ms=(resp or {}).get('itl_ms'),
+                    slo_excluded=degraded_kind == 'deadline')
             reqtrace.annotate(model=label_model,
                               completion_id=response_id)
             if self.tracer is not None and self.tracer.enabled:
-                self.tracer.histogram(labeled(
-                    'serve.completion_seconds',
-                    model=label_model)).observe(wall_s)
+                if degraded_kind is None:
+                    # refusals keep their own counters
+                    # (oct_serve_shed_total / _deadline_exceeded_total)
+                    # — a shed's ~0ms or a 504's budget-capped wall in
+                    # the latency histogram would corrupt the p99
+                    self.tracer.histogram(labeled(
+                        'serve.completion_seconds',
+                        model=label_model)).observe(wall_s)
                 if ttft is not None:
                     self.tracer.histogram(labeled(
                         'serve.ttft_seconds',
@@ -556,51 +666,184 @@ class EvalEngine:
     def _request_complete(self, model_cfg: Dict, prompts: List[str],
                           max_out_len: int, timeout: float,
                           request_id: Optional[str] = None,
-                          timings: Optional[Dict] = None) -> Dict:
+                          timings: Optional[Dict] = None,
+                          deadline: Optional[reqtrace.Deadline] = None
+                          ) -> Dict:
+        """One completion against the resident fleet, with the
+        degradation plane wired in:
+
+        - every internal budget (chip-lease wait, protocol round-trip,
+          the worker's own checks) is a *derivation* of the one
+          request deadline when the caller set ``X-OCT-Deadline-Ms``;
+        - a worker-protocol failure (channel death) feeds the per-key
+          circuit breaker and retries through the per-model token-
+          bucket budget with deterministic exponential backoff —
+          budget empty, breaker open, or deadline short ⇒ the original
+          failure surfaces instead of retry-amplified load;
+        - busy channels / chip starvation / open breakers raise
+          :class:`OverloadedError` (503 + Retry-After), never the 502
+          a dead worker earns.
+        """
         from opencompass_tpu.runners.worker import WorkerError
-        from opencompass_tpu.serve.scheduler import WorkerBusyError
+        from opencompass_tpu.serve.scheduler import CircuitOpenError
         timings = timings if timings is not None else {}
         key = self.affinity_key(model_cfg)
+        # ONE total internal budget for the whole request, retries
+        # included: every wait below (chip alloc, protocol, backoff)
+        # spends from it, so worst-case wall is ~timeout — never
+        # attempts x phases x timeout
+        budget_ts = time.monotonic() + timeout
+        attempt = 0
+        while True:
+            try:
+                return self._complete_once(key, model_cfg, prompts,
+                                           max_out_len, budget_ts,
+                                           request_id, timings,
+                                           deadline)
+            except CircuitOpenError as exc:
+                raise OverloadedError(
+                    str(exc), retry_after_s=exc.retry_after_s,
+                    reason='breaker_open') from exc
+            except WorkerError as exc:
+                opened = self.pool.note_protocol_failure(key, str(exc))
+                if opened:
+                    # this failure opened the circuit: a retry would
+                    # burn a budget token and a backoff sleep only to
+                    # hit CircuitOpenError — shed now, honestly
+                    breaker = self.pool.breaker_for(key)
+                    raise OverloadedError(
+                        f'worker {key} circuit opened after repeated '
+                        f'protocol failures: {exc}',
+                        retry_after_s=breaker.cooldown_s,
+                        reason='breaker_open') from exc
+                delay = backoff_delay(key, attempt)
+                budget_left = budget_ts - time.monotonic()
+                if deadline is not None:
+                    budget_left = min(budget_left,
+                                      deadline.remaining_s())
+                if attempt >= RETRY_MAX_ATTEMPTS \
+                        or budget_left < delay + 0.1 \
+                        or not self.retry_budget.take(key):
+                    raise RuntimeError(f'worker failed: {exc}') from exc
+                logger.warning(
+                    f'completion retry {attempt + 1}/'
+                    f'{RETRY_MAX_ATTEMPTS} for {key} after '
+                    f'{delay:.2f}s backoff: {exc}')
+                time.sleep(delay)
+                attempt += 1
+
+    def _complete_once(self, key: str, model_cfg: Dict,
+                       prompts: List[str], max_out_len: int,
+                       budget_ts: float, request_id: Optional[str],
+                       timings: Dict,
+                       deadline: Optional[reqtrace.Deadline]) -> Dict:
+        """One attempt against the resident worker.  ``budget_ts`` is
+        the request's total internal deadline (monotonic) — chip wait
+        and protocol wait both spend from it, so one attempt can never
+        cost more than the whole request budget."""
+        from opencompass_tpu.runners.worker import WorkerError
+        from opencompass_tpu.serve.scheduler import WorkerBusyError
         run_cfg = model_cfg.get('run_cfg', {}) or {}
         devices = run_cfg.get('num_devices', run_cfg.get('num_gpus', 0))
+        budget = budget_ts - time.monotonic()
+        if budget <= 0.05:
+            raise OverloadedError(
+                'request budget exhausted before the chip-lease wait',
+                retry_after_s=self.req_stats
+                .median_completion_latency_s() or 5.0,
+                reason='busy')
+        if deadline is not None:
+            remaining = deadline.remaining_s()
+            if remaining <= 0:
+                raise DeadlineExceeded(
+                    'admission', 'deadline expired before the chip-'
+                    'lease wait')
+            budget = max(min(budget, remaining), 0.05)
         t_lease = time.perf_counter()
         try:
             # bound the chip wait by the request budget: every host chip
-            # held by a sweep must surface as back-pressure (502), not
-            # park this HTTP thread until the sweep drains
+            # held by a sweep must surface as back-pressure, not park
+            # this HTTP thread until the sweep drains
             worker = self.pool.acquire(key, self._spawn_fn(key, devices),
                                        devices=devices,
-                                       alloc_timeout_s=timeout)
+                                       alloc_timeout_s=budget)
         except TimeoutError as exc:
-            raise RuntimeError(str(exc)) from exc
+            if deadline is not None and deadline.expired():
+                raise DeadlineExceeded(
+                    'lease_wait', 'deadline expired waiting for chip '
+                    f'slots: {exc}') from exc
+            raise OverloadedError(
+                str(exc),
+                retry_after_s=self.req_stats
+                .median_completion_latency_s() or 5.0,
+                reason='no_free_chips') from exc
         finally:
             timings['lease_wait_s'] = round(
                 time.perf_counter() - t_lease, 6)
+        if deadline is not None and deadline.expired():
+            # the lease arrived after the budget died: hand it back
+            # untouched — an expired request must not consume a
+            # protocol round-trip
+            self.pool.release(worker)
+            raise DeadlineExceeded(
+                'lease_wait', 'deadline expired during the chip-lease '
+                'wait')
+        msg = {'cmd': 'complete',
+               'model_cfg': _wire_model_cfg(model_cfg),
+               'prompts': list(prompts),
+               'max_out_len': max_out_len,
+               'request_id': request_id,
+               'cache_root': self.cache_root,
+               'work_dir': self.run_dir}
+        budget = max(budget_ts - time.monotonic(), 0.05)
+        if deadline is not None:
+            # the worker re-anchors the REMAINING budget on its own
+            # clock (deadlines never travel as absolute timestamps).
+            # The daemon's own wait gets a small grace over the
+            # deadline: the worker's typed deadline response — which
+            # names the phase that consumed the budget — must win the
+            # race against this side's blunt timeout whenever the
+            # worker is still making progress
+            msg['deadline_s'] = round(deadline.remaining_s(), 6)
+            budget = max(min(budget, deadline.remaining_s()
+                             + DEADLINE_GRACE_S), 0.05)
         t_rt = time.perf_counter()
         try:
             # channel-concurrent join: mid-sweep the worker answers from
             # its resident continuous engine; without one it replies
             # busy and request_join falls back to the serialized wait
-            resp = worker.request_join(
-                {'cmd': 'complete',
-                 'model_cfg': _wire_model_cfg(model_cfg),
-                 'prompts': list(prompts),
-                 'max_out_len': max_out_len,
-                 'request_id': request_id,
-                 'cache_root': self.cache_root,
-                 'work_dir': self.run_dir},
-                timeout=timeout)
+            resp = worker.request_join(msg, timeout=budget)
         except WorkerBusyError as exc:
             # healthy worker, channel occupied: back-pressure, not a
-            # corpse — release the lease and surface 502 to the client
+            # corpse — release the lease; 503 (or 504 when the budget
+            # died queueing), never the discard-and-kill path
             self.pool.release(worker)
-            raise RuntimeError(str(exc)) from exc
-        except WorkerError as exc:
+            if deadline is not None and deadline.expired():
+                raise DeadlineExceeded(
+                    'worker_protocol', 'deadline expired queueing on '
+                    f'the worker channel: {exc}') from exc
+            raise OverloadedError(
+                str(exc),
+                retry_after_s=self.req_stats
+                .median_completion_latency_s() or 5.0,
+                reason='busy') from exc
+        except WorkerError:
             self.pool.discard(worker)
-            raise RuntimeError(f'worker failed: {exc}') from exc
+            raise    # the retry loop owns breaker + budget accounting
         finally:
             timings['roundtrip_s'] = round(time.perf_counter() - t_rt, 6)
         self.pool.release(worker)
+        # ANY structured response is a protocol-level success: the
+        # channel is healthy, so a half-open probe closes here even
+        # when the request itself failed (deadline, app error) — a
+        # probe outcome must always reach the breaker
+        self.pool.note_protocol_success(key)
+        if resp.get('deadline_exceeded'):
+            # the worker is healthy — it enforced the deadline for us
+            raise DeadlineExceeded(
+                resp.get('phase') or 'model_forward',
+                resp.get('error') or 'deadline exceeded in worker',
+                worker_resp=resp)
         if not resp.get('ok'):
             raise RuntimeError(resp.get('error') or 'completion failed')
         return resp
@@ -667,10 +910,44 @@ class EvalEngine:
                 logger.warning(
                     f"SLO alert {t['t']}: {t['rule']} "
                     f"(severity={t['severity']}, {t.get('value')})")
+            # durable degradation snapshot on the same cadence: sheds,
+            # inflight, breaker states — what a dead-daemon `cli top`
+            # and the doctor's overload rules read back
+            admctl.write_overload(self.serve_obs_dir,
+                                  self.overload_snapshot())
+            self._publish_overload_gauges()
             return transitions
         except Exception:
             logger.warning('SLO evaluation failed', exc_info=True)
             return []
+
+    def overload_snapshot(self) -> Dict:
+        """The degradation plane's state: admission counters (sheds by
+        route×reason, inflight, deadline-exceeded) + the worker pool's
+        circuit-breaker table — the ``/v1/stats`` ``overload`` block
+        and the durable ``overload.json``."""
+        snap = self.admission.snapshot()
+        snap['breakers'] = self.pool.breaker_snapshot() \
+            if self.pool is not None else {}
+        return snap
+
+    def _publish_overload_gauges(self):
+        """``oct_serve_breaker_state{worker}`` (0 closed / 1 open /
+        2 half-open) into the registry.  Shed and deadline counters are
+        incremented at their raise sites; this publishes the stateful
+        series."""
+        if self.tracer is None or not self.tracer.enabled \
+                or self.pool is None:
+            return
+        try:
+            from opencompass_tpu.obs.metrics import labeled
+            code = {'closed': 0, 'open': 1, 'half_open': 2}
+            for key, snap in self.pool.breaker_snapshot().items():
+                self.tracer.gauge(labeled(
+                    'serve.breaker_state', worker=key[:16])).set(
+                        code.get(snap['state'], 0))
+        except Exception:
+            pass
 
     def alerts_snapshot(self) -> Dict:
         """``GET /v1/alerts``: the active set, per-rule burn/budget
@@ -740,6 +1017,7 @@ class EvalEngine:
             'current_sweep': self._current_sweep,
         }
         summary['workers'] = self._worker_table()
+        summary['overload'] = self.overload_snapshot()
         summary['completions_total'] = self._completions
         summary['run_dir'] = self.run_dir
         summary['ready'] = self._warmed.is_set()
@@ -780,6 +1058,12 @@ class EvalEngine:
         store_writable = os.access(
             self.cache_root, os.W_OK) if osp.isdir(self.cache_root) \
             else os.access(osp.dirname(self.cache_root) or '.', os.W_OK)
+        try:
+            from opencompass_tpu.store.store import injected_write_fault
+            store_writable = store_writable \
+                and not injected_write_fault()
+        except Exception:
+            pass
         warmed = self._warmed.is_set()
         # active page-severity alerts list as DEGRADATION, not as
         # down: the engine still answers (readiness stays 200), but a
@@ -789,6 +1073,11 @@ class EvalEngine:
             degraded = self.slo_eval.degraded()
         except Exception:
             pass
+        if not store_writable:
+            # a store outage (EIO, perms) degrades the engine to
+            # cache-off serving — name it here so an operator probing
+            # /healthz sees WHAT is wrong, not just not-ready
+            degraded = degraded + ['store_unwritable']
         return {
             'ready': bool(warmed and loop_alive and store_writable),
             'degraded': degraded,

@@ -32,15 +32,33 @@ Two API surfaces mounted on the PR 2 telemetry server
 store hits, device rows, whether the model was resident.  Identical
 requests are store hits — no device call.
 
+**Degradation taxonomy** (docs/serving.md "Degradation under load"):
+both write lanes consult the engine's SLO-aware admission controller
+(serve/admission.py) before doing work, and every refusal is typed —
+
+- ``429`` + ``Retry-After``: admission shed the request (priority
+  classes: sweeps shed before completions; the hint is derived from
+  measured queue age / burn state, never a constant);
+- ``503 overloaded`` + ``Retry-After``: admitted, but a bounded wait
+  hit its budget — busy worker channel, no free chips, or an open
+  circuit breaker.  Retry later; the fleet is alive;
+- ``504 deadline_exceeded``: the caller's ``X-OCT-Deadline-Ms``
+  budget expired; the body names the ``phase`` that consumed it and
+  the request's ``requests.jsonl`` spans show the same story;
+- ``502``: a worker actually died mid-request (after the retry budget
+  drained) — retrying immediately is reasonable.
+
 Handlers follow the server's route contract:
-``fn(path, query, body_bytes) -> (code, payload)`` where dict payloads
-render as JSON.  Handler exceptions surface as 500 via the server's
-dispatch guard; expected failures return structured OpenAI-style
-errors (``{"error": {"message", "type"}}``).
+``fn(path, query, body_bytes) -> (code, payload[, headers])`` where
+dict payloads render as JSON and the optional third element carries
+extra response headers (``Retry-After``).  Handler exceptions surface
+as 500 via the server's dispatch guard; expected failures return
+structured OpenAI-style errors (``{"error": {"message", "type"}}``).
 """
 from __future__ import annotations
 
 import json
+import math
 import os
 import os.path as osp
 import time
@@ -48,6 +66,9 @@ import uuid
 from typing import Dict, Tuple
 
 from opencompass_tpu.obs import reqtrace
+from opencompass_tpu.serve.admission import (DeadlineExceeded,
+                                             OverloadedError,
+                                             ShedRequest)
 
 SWEEPS_PATH = '/v1/sweeps'
 COMPLETIONS_PATH = '/v1/completions'
@@ -59,6 +80,16 @@ ALERTS_PATH = '/v1/alerts'
 def _err(code: int, message: str,
          err_type: str = 'invalid_request_error') -> Tuple[int, Dict]:
     return code, {'error': {'message': message, 'type': err_type}}
+
+
+def _shed_err(code: int, message: str, err_type: str,
+              retry_after_s: float, **fields):
+    """A typed degradation error with a ``Retry-After`` header (whole
+    seconds, rounded up — a 0 would invite an immediate hammer)."""
+    err = {'message': message, 'type': err_type}
+    err.update(fields)
+    return code, {'error': err}, {
+        'Retry-After': str(max(int(math.ceil(retry_after_s)), 1))}
 
 
 def _parse_json(body: bytes) -> Dict:
@@ -96,6 +127,20 @@ def build_routes(engine) -> Dict:
                     or not os.access(config_path, os.R_OK):
                 return _err(400, f'config_path {config_path!r} is not '
                                  'a daemon-readable file')
+        # SLO-aware admission: sweeps are the LOW-priority class — past
+        # the queue-depth bound, or while a page-severity alert burns,
+        # new batch work sheds with a measured Retry-After (queue drain
+        # ETA / burn recovery horizon) so interactive latency recovers
+        # first.  getattr: stub engines without an admission plane
+        # (unit tests) admit everything.
+        admit_sweep = getattr(engine, 'admit_sweep', None)
+        if admit_sweep is not None:
+            decision = admit_sweep()
+            if not decision.admitted:
+                reqtrace.annotate(shed=decision.reason)
+                return _shed_err(
+                    429, decision.detail, 'overloaded',
+                    decision.retry_after_s, reason=decision.reason)
         try:
             rec = engine.queue.enqueue(
                 config_path=config_path, config_text=config_text,
@@ -167,15 +212,37 @@ def build_routes(engine) -> Dict:
         # is greppable end to end
         cmpl_id = f'cmpl-{uuid.uuid4().hex[:24]}'
         parse_s = time.perf_counter() - t_parse
+        # deadline propagation: the dispatch guard parsed
+        # X-OCT-Deadline-Ms into the request context; the engine
+        # threads it through lease wait -> worker protocol -> forward,
+        # so every internal budget derives from this one number
+        deadline = reqtrace.current_deadline()
         try:
             resp = engine.complete(model, prompts,
                                    max_out_len=max_tokens,
                                    request_id=request_id,
                                    response_id=cmpl_id,
-                                   parse_seconds=parse_s)
+                                   parse_seconds=parse_s,
+                                   deadline=deadline)
         except KeyError:
             return _err(404, f'model {model!r} not served; have: '
                              f'{engine.models()}', 'model_not_found')
+        except ShedRequest as exc:
+            reqtrace.annotate(shed=exc.reason)
+            return _shed_err(429, str(exc), 'overloaded',
+                             exc.retry_after_s, reason=exc.reason)
+        except OverloadedError as exc:
+            # admitted but a bounded wait hit its budget: "retry
+            # later", distinct from the 502 a dead worker earns
+            reqtrace.annotate(shed=exc.reason)
+            return _shed_err(503, str(exc), 'overloaded',
+                             exc.retry_after_s, reason=exc.reason)
+        except DeadlineExceeded as exc:
+            reqtrace.annotate(deadline_phase=exc.phase)
+            return 504, {'error': {
+                'message': str(exc), 'type': 'deadline_exceeded',
+                'phase': exc.phase,
+                'request_id': request_id}}
         except RuntimeError as exc:
             return _err(502, str(exc), 'server_error')
         usage = {}

@@ -430,3 +430,40 @@ class SweepQueue:
 
     def counts(self) -> Dict[str, int]:
         return self.pressure()['counts']
+
+    def drain_eta_seconds(self, now: Optional[float] = None,
+                          recent: int = 8) -> Dict:
+        """Measured queue-drain estimate for admission control's
+        ``Retry-After``: mean wall of the ``recent`` newest *finished*
+        sweeps (their terminal journal records carry
+        ``detail.wall_seconds``) times the sweeps still ahead (queued +
+        running).  Falls back to the oldest queued age when nothing has
+        finished yet — either way the hint is a measurement, never a
+        constant.  Returns ``{'depth', 'eta_seconds'}`` (``eta_seconds``
+        None when the queue is empty)."""
+        now = time.time() if now is None else now
+        walls: List[float] = []
+        depth = running = 0
+        oldest_age = None
+        for rec in self.state().values():
+            if rec['status'] == 'queued':
+                depth += 1
+                if rec.get('ts'):
+                    age = now - rec['ts']
+                    if oldest_age is None or age > oldest_age:
+                        oldest_age = age
+            elif rec['status'] == 'running':
+                running += 1
+            elif rec['status'] in ('done', 'failed'):
+                wall = (rec.get('detail') or {}).get('wall_seconds')
+                if isinstance(wall, (int, float)) and wall >= 0:
+                    walls.append(float(wall))
+        walls = walls[-recent:]
+        pending = depth + running
+        if not pending:
+            return {'depth': depth, 'eta_seconds': None}
+        if walls:
+            eta = (sum(walls) / len(walls)) * pending
+        else:
+            eta = oldest_age if oldest_age is not None else 30.0
+        return {'depth': depth, 'eta_seconds': round(eta, 3)}

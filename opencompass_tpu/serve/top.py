@@ -77,7 +77,7 @@ def gather(cache_root: str,
     snap: Dict = {'cache_root': cache_root,
                   'ts': time.time() if now is None else now,
                   'engine': None, 'alive': False, 'stats': None,
-                  'serve': None}
+                  'serve': None, 'overload': None}
     info = reqtrace.read_engine_info(obs_root)
     if info is not None:
         snap['engine'] = info
@@ -110,6 +110,19 @@ def gather(cache_root: str,
             }
         except Exception:
             snap['alerts'] = None
+    # degradation pane: live from the /v1/stats overload block, else
+    # the durable overload.json snapshot the daemon refreshes on its
+    # SLO cadence — the shed/breaker story survives the daemon
+    if snap['alive'] and snap.get('stats'):
+        snap['overload'] = (snap['stats'] or {}).get('overload')
+    else:
+        try:
+            from opencompass_tpu.serve.admission import read_overload
+            snap['overload'] = read_overload(obs_root)
+            if snap['overload'] is not None:
+                snap['overload']['from_files'] = True
+        except Exception:
+            snap['overload'] = None
     if snap['serve'] is None:
         queue_root = osp.join(cache_root, 'serve', 'queue')
         if osp.isdir(queue_root):
@@ -227,6 +240,47 @@ def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
             lines.append(f'  [{sev}] {rule}  for {age}{detail}')
     else:
         lines.append('alerts: none')
+
+    # degradation pane: sheds by reason, deadline 504s, inflight vs
+    # ceiling, and any troubled circuit breakers — live or from the
+    # durable overload.json against a dead daemon
+    overload = snap.get('overload') or {}
+    shed_total = overload.get('shed_total') or 0
+    breakers = overload.get('breakers') or {}
+    if overload:
+        src = ' (from files)' if overload.get('from_files') else ''
+        bits = []
+        if shed_total:
+            reasons = []
+            for route, by_reason in sorted(
+                    (overload.get('shed') or {}).items()):
+                # keep the lane visible: both routes can shed for the
+                # same reason and the interactive-vs-batch split is
+                # the whole point of the priority classes
+                lane = route.rsplit('/', 1)[-1] or route
+                for reason, count in sorted(by_reason.items()):
+                    reasons.append(f'{lane} {reason} {count}')
+            bits.append(f'shed {shed_total}'
+                        + (f' ({", ".join(reasons)})' if reasons
+                           else ''))
+        if overload.get('deadline_exceeded_total'):
+            bits.append('deadline_exceeded '
+                        f'{overload["deadline_exceeded_total"]}')
+        if overload.get('inflight_completions') is not None:
+            bits.append(f'inflight '
+                        f'{overload["inflight_completions"]}/'
+                        f'{overload.get("max_inflight", "?")}')
+        for key, b in sorted(breakers.items()):
+            state = (b.get('state') or '?').upper()
+            detail = ''
+            if b.get('state') == 'open' \
+                    and b.get('half_open_in_s') is not None:
+                detail = f' (probe in {b["half_open_in_s"]:.0f}s)'
+            elif b.get('recent_failures'):
+                detail = f' ({b["recent_failures"]} recent failure(s))'
+            bits.append(f'breaker {key[:12]} {state}{detail}')
+        lines.append((f'overload:{src} ' + '  '.join(bits))
+                     if bits else f'overload:{src} none')
 
     stats = snap.get('stats') or {}
     comp = stats.get('completions') or {}

@@ -55,6 +55,27 @@ STORE_VERSION = 1
 NUM_SHARDS = 16
 STORE_SUBDIR = 'store'
 ENV_MAX_BYTES = 'OCT_STORE_MAX_BYTES'
+# chaos-harness fault injection (analysis/chaos.py): the named file's
+# content being truthy makes every row commit raise EIO — file-based
+# like OCT_DEBUG_COMPLETE_SLEEP_FILE so the harness can inject and
+# LIFT the fault against a live daemon and its workers
+ENV_DEBUG_EIO_FILE = 'OCT_DEBUG_STORE_EIO_FILE'
+
+
+def injected_write_fault() -> bool:
+    """True while the chaos harness's store-EIO knob is set.  Consulted
+    by :meth:`ResultStore.put` (raises ``EIO``) and the serve daemon's
+    readiness probe (``store_unwritable`` degradation) — processes run
+    as root in CI containers, so permission bits can't simulate a bad
+    disk; this knob can.  Never raises."""
+    path = os.environ.get(ENV_DEBUG_EIO_FILE)
+    if not path:
+        return False
+    try:
+        with open(path, encoding='utf-8') as f:
+            return f.read().strip() not in ('', '0')
+    except OSError:
+        return False
 
 _counters_lock = threading.Lock()
 _counters = {'hits': 0, 'misses': 0, 'commits': 0}
@@ -154,14 +175,22 @@ class ResultStore:
             mem = self._load_shard(shard)
             if key in mem and mem[key] == value:
                 return False
-            mem[key] = value
             path = self._seg_files.get(shard)
             if path is None:
                 path = osp.join(self._shard_dir(shard),
                                 f'{self._writer}.jsonl')
                 self._seg_files[shard] = path
+            if injected_write_fault():
+                import errno
+                raise OSError(errno.EIO,
+                              'injected store write fault (chaos)')
             append_jsonl_atomic(
                 path, [{'k': key, 'v': value, 't': round(time.time(), 3)}])
+            # memory only AFTER the durable append: a failed write
+            # (full/failing disk) must not leave this process serving a
+            # value the disk never saw — the row recomputes and
+            # recommits once the disk recovers
+            mem[key] = value
             self.write_meta()
         return True
 

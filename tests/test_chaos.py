@@ -1,0 +1,130 @@
+"""Serve-layer chaos harness (analysis/chaos.py): invariant checkers
+as pure units, the quick scenario profile live against a real daemon
+(tier-1), the CLI exit-code convention, and the full kill-sweep (slow
+tier)."""
+import json
+import os
+import os.path as osp
+import subprocess
+import sys
+
+import pytest
+
+from opencompass_tpu.analysis import chaos
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+# -- invariant checkers (pure) ----------------------------------------------
+
+def _access(rid, status, route='/v1/completions', method='POST'):
+    return {'v': 1, 'ts': 1000.0, 'method': method, 'path': route,
+            'route': route, 'status': status, 'request_id': rid}
+
+
+def test_check_no_lost_requests():
+    access = [_access('req-a', 200), _access('req-b', 503),
+              _access('req-c', 429), _access('req-d', 400),
+              _access('req-e', 404),
+              _access('req-z', 200, route='/healthz', method='GET')]
+    requests = [{'request_id': 'req-a', 'status': 'ok'},
+                {'request_id': 'req-b', 'status': 'error'},
+                {'request_id': 'req-c', 'status': 'error'}]
+    # 400/404 never reach the engine; everything else resolved
+    assert chaos.check_no_lost_requests(access, requests) == []
+    # a 200 without a requests.jsonl record is a silent loss
+    access.append(_access('req-lost', 200))
+    violations = chaos.check_no_lost_requests(access, requests)
+    assert len(violations) == 1 and 'req-lost' in violations[0]
+    # ...and so is an admitted 5xx
+    access[-1] = _access('req-lost2', 502)
+    violations = chaos.check_no_lost_requests(access, requests)
+    assert len(violations) == 1 and 'req-lost2' in violations[0]
+
+
+def _resp(code, retry_after=None, err_type='overloaded'):
+    headers = {}
+    if retry_after is not None:
+        headers['Retry-After'] = str(retry_after)
+    return chaos._Resp(code, {'error': {'type': err_type}}, headers,
+                       0.01)
+
+
+def test_check_retry_after():
+    assert chaos.check_retry_after(
+        [_resp(200), _resp(429, 5), _resp(503, 1)]) == []
+    violations = chaos.check_retry_after([_resp(429)])
+    assert violations and 'Retry-After' in violations[0]
+    violations = chaos.check_retry_after(
+        [_resp(429, 5, err_type='server_error')])
+    assert violations and 'overloaded' in violations[0]
+    # Retry-After of 0 invites an immediate hammer: a violation
+    assert chaos.check_retry_after([_resp(503, 0)])
+
+
+def test_admitted_p99():
+    responses = [chaos._Resp(200, {}, {}, w)
+                 for w in (0.1, 0.2, 0.3)]
+    responses.append(chaos._Resp(429, {}, {}, 9.9))  # sheds excluded
+    assert chaos.admitted_p99_ms(responses) == 300.0
+    assert chaos.admitted_p99_ms([chaos._Resp(429, {}, {}, 1)]) is None
+
+
+def test_run_chaos_rejects_unknown_scenario(tmp_path):
+    with pytest.raises(ValueError):
+        chaos.run_chaos(['no_such_fault'], workdir=str(tmp_path))
+
+
+# -- CLI exit-code convention -----------------------------------------------
+
+def test_cli_check_exit_codes(monkeypatch, capsys):
+    from opencompass_tpu.analysis.chaos import main
+
+    def boom(*a, **kw):
+        raise AssertionError('invariant X violated')
+
+    monkeypatch.setattr(chaos, 'run_chaos', boom)
+    assert main(['--check']) == 2            # the ledger-check convention
+    assert main([]) == 1                     # visible failure without it
+    monkeypatch.setattr(
+        chaos, 'run_chaos',
+        lambda *a, **kw: {'v': 1, 'quick': True, 'scenarios': {},
+                          'requests_checked': 0, 'wall_s': 0.0})
+    assert main(['--check', '--json']) == 0
+    assert json.loads(capsys.readouterr().out)['v'] == 1
+
+
+# -- live: the tier-1 quick profile -----------------------------------------
+
+def test_quick_scenarios_live(tmp_path):
+    """The tier-1 chaos gate: overload shedding + stuck-worker
+    deadlines against one real daemon, every invariant asserted inside
+    run_chaos (a returned report IS the all-clear)."""
+    report = chaos.run_chaos(list(chaos.QUICK_SCENARIOS),
+                             workdir=str(tmp_path / 'chaos'),
+                             quick=True)
+    assert set(report['scenarios']) == set(chaos.QUICK_SCENARIOS)
+    burst = report['scenarios']['overload_burst']
+    assert burst['admitted'] >= 1 and burst['shed'] >= 1
+    assert burst['admitted_p99_ms'] <= chaos.OBJECTIVE_MS
+    assert report['requests_checked'] >= burst['fired']
+
+
+# -- live: the full kill-sweep (slow) ---------------------------------------
+
+@pytest.mark.slow
+def test_full_chaos_sweep_cli(tmp_path):
+    """`cli chaos --check` end to end: all four scenarios (worker
+    SIGKILL + breaker lifecycle included) exit 0 on a healthy build."""
+    env = dict(os.environ, JAX_PLATFORMS='cpu')
+    r = subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'chaos',
+         '--check', '--json', '--workdir', str(tmp_path / 'chaos')],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=500)
+    assert r.returncode == 0, r.stdout + r.stderr
+    report = json.loads(r.stdout)
+    assert set(report['scenarios']) == set(chaos.SCENARIOS)
+    kill = report['scenarios']['worker_kill']
+    assert kill['breaker_closed'] is True
+    assert report['scenarios']['store_eio']['converged'] is True

@@ -364,6 +364,30 @@ def test_doctor_fixture_findings():
         assert f['evidence'] and f.get('fix')
 
 
+def test_doctor_overload_rules_from_fixture():
+    """The degradation-plane rules (ISSUE 14) read the durable
+    overload.json: a crash-looping worker's open breaker is an error
+    finding naming the worker with its failure evidence; sustained
+    admission sheds are a warn with the route x reason breakdown."""
+    from opencompass_tpu.obs.doctor import diagnose
+    report = diagnose(FIXTURE)
+    rules = {f['rule']: f for f in report['findings']}
+    breaker = rules['breaker_open']
+    assert breaker['severity'] == 'error'
+    joined = ' '.join(breaker['evidence'])
+    assert 'a1b2c3d4e5f60718' in joined
+    assert 'worker pipe closed' in joined          # failure evidence
+    assert 'half-open probe' in joined
+    shed = rules['overload_shedding']
+    assert shed['severity'] == 'warn'
+    joined = ' '.join(shed['evidence'])
+    assert '/v1/completions: 8 shed (slo_burn)' in joined
+    assert '/v1/sweeps: 4 shed (queue_depth)' in joined
+    assert '3 request(s) exceeded their deadline' in joined
+    for f in (breaker, shed):
+        assert f.get('fix')
+
+
 def test_doctor_cli_check_exit_codes(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS='cpu')
     r = subprocess.run(
