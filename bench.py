@@ -1291,6 +1291,107 @@ def _bench_chaos(out_json='BENCH_CHAOS.json'):
     return record
 
 
+def _bench_outbound(out_json='BENCH_OUTBOUND.json'):
+    """detail.outbound: API-sweep wall-clock through the outbound
+    scheduler (AIMD in-flight window + Retry-After pacing + budgeted
+    jittered retries) vs the serial arrival-order baseline — the
+    pre-scheduler path, one row at a time through the retrying
+    ``post_json`` — against the local fault-injecting stub provider at
+    150 ms injected latency with a 20% 429 mix (Retry-After 0.25 s).
+    Both paths must produce identical outputs (the stub is a
+    deterministic function of the prompt) and the scheduler must beat
+    serial by >= 3x; violations raise, the record is the all-clear.
+    Device-free."""
+    from opencompass_tpu.models.openai_api import OpenAI
+    from opencompass_tpu.outbound import StubProvider, canned_text
+
+    N = 40
+    LATENCY_S = 0.15
+    MIX_EVERY = 5            # every 5th request answers 429 — 20% mix
+    RETRY_AFTER_S = 0.25
+    provider = StubProvider(latency_s=LATENCY_S).start()
+    try:
+        provider.set_429_every(MIX_EVERY, retry_after_s=RETRY_AFTER_S)
+        prompts = [f'bench outbound row {i}' for i in range(N)]
+        expected = [canned_text(p) for p in prompts]
+
+        # serial arrival-order baseline: every row waits for the
+        # previous one, 429 sleeps happen inline (qps cap effectively
+        # open so only scheduling is measured, not the config knob)
+        serial_model = OpenAI(path='bench-serial', key='k',
+                              openai_api_base=provider.chat_url,
+                              query_per_second=100000, retry=3)
+        t0 = time.perf_counter()
+        serial_out = []
+        for p in prompts:
+            body = {'model': 'bench-serial', 'max_tokens': 8,
+                    'messages': [{'role': 'user', 'content': p}]}
+            data = serial_model.post_json(provider.chat_url, body)
+            serial_out.append(
+                data['choices'][0]['message']['content'].strip())
+        serial_wall = time.perf_counter() - t0
+        assert serial_out == expected, 'serial baseline diverged'
+        serial_stats = provider.stats()
+
+        provider.reset_stats()
+        sched_model = OpenAI(path='bench-outbound', key='k',
+                             openai_api_base=provider.chat_url,
+                             query_per_second=100000, retry=3,
+                             max_inflight=8,
+                             outbound=dict(retry_budget_rate=10.0,
+                                           retry_budget_burst=24.0))
+        t0 = time.perf_counter()
+        out = sched_model.generate(prompts, max_out_len=8)
+        outbound_wall = time.perf_counter() - t0
+        assert out == expected, 'outbound sweep diverged'
+        outbound_stats = provider.stats()
+        sched_stats = sched_model.outbound_scheduler().stats()
+    finally:
+        provider.stop()
+    speedup = serial_wall / outbound_wall
+    assert speedup >= 3.0, (
+        f'outbound sweep only {speedup:.2f}x over serial '
+        f'({outbound_wall:.2f}s vs {serial_wall:.2f}s) — below the '
+        '3x acceptance bar')
+    record = {
+        'workload': f'{N} rows vs the stub provider at '
+                    f'{LATENCY_S * 1e3:.0f}ms injected latency, '
+                    f'1-in-{MIX_EVERY} 429 mix '
+                    f'(Retry-After {RETRY_AFTER_S}s); identical '
+                    'outputs asserted both paths',
+        'serial_wall_s': round(serial_wall, 3),
+        'outbound_wall_s': round(outbound_wall, 3),
+        'speedup': round(speedup, 2),
+        'serial_requests': serial_stats['requests_total'],
+        'serial_429s': serial_stats['http_429'],
+        'outbound_requests': outbound_stats['requests_total'],
+        'outbound_429s': outbound_stats['http_429'],
+        'outbound_max_concurrent': outbound_stats['max_concurrent'],
+        'scheduler': {
+            'retries': sched_stats['retries_total'],
+            'budget_refusals': sched_stats['retry_budget_refusals'],
+            'limit_final': sched_stats['limiter']['limit'],
+            'limit_low_water': sched_stats['limiter']['low_water'],
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        from opencompass_tpu.utils.fileio import atomic_write_json
+        atomic_write_json(os.path.join(here, out_json), record,
+                          dump_kwargs={'indent': 2})
+    except OSError:
+        pass
+    # the trajectory gate rides the scheduler's wall clock: the sweep
+    # must stay fast under the same injected throttle workload
+    _append_trajectory(
+        'outbound', 'wall_s', record['outbound_wall_s'], 's',
+        direction='lower',
+        detail={'speedup': record['speedup'],
+                'serial_wall_s': record['serial_wall_s'],
+                'max_concurrent': record['outbound_max_concurrent']})
+    return record
+
+
 def main():
     n_chips = max(1, len(jax.devices()))
     kind = getattr(jax.devices()[0], 'device_kind', '')
@@ -1657,5 +1758,12 @@ if __name__ == '__main__':
         # real daemon, degradation invariants asserted (device-free)
         print(json.dumps({'metric': 'chaos', 'v': 1,
                           'detail': _bench_chaos()}))
+        sys.exit(0)
+    if '--outbound' in sys.argv:
+        # standalone outbound-API-scheduler leg: sweep wall-clock vs
+        # the serial arrival-order baseline under injected provider
+        # latency + a 429 throttle mix (device-free; stub provider)
+        print(json.dumps({'metric': 'outbound', 'v': 1,
+                          'detail': _bench_outbound()}))
         sys.exit(0)
     main()

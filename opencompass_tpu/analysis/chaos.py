@@ -55,7 +55,7 @@ import urllib.request
 from typing import Dict, List, Optional
 
 CHECK_EXIT = 2
-QUICK_SCENARIOS = ('overload_burst', 'stuck_worker')
+QUICK_SCENARIOS = ('overload_burst', 'stuck_worker', 'flaky_api')
 # the degradation objective: admitted-traffic p99 while shedding.
 # Generous vs the 0.4s injected service time x ceiling-2 concurrency —
 # the invariant is "bounded by admission", not "fast on a loaded CI box"
@@ -532,12 +532,152 @@ def scenario_store_eio(daemon: ChaosDaemon,
             'text': next(iter(texts))}
 
 
+def scenario_flaky_api(daemon: Optional[ChaosDaemon] = None,
+                       quick: bool = False) -> Dict:
+    """The OUTBOUND degradation story, against the fault-injecting
+    stub provider (``outbound/stub.py``) — no daemon, fully
+    device-free:
+
+    - **429 burst** → the AIMD window backs off (limiter low-water
+      drops) and no retry exceeds its budget (every retry drew a
+      token; refusals are counted, not silently overridden);
+    - **crash-looping endpoint** → the provider breaker opens; once
+      the endpoint recovers, the half-open probe closes it;
+    - **stalled endpoint** → a deadline-bounded *typed* failure, not a
+      hung thread;
+    - **partial failure** → zero silently-lost rows (every row has a
+      typed outcome), failed rows resume and converge bit-identically
+      on rerun."""
+    from opencompass_tpu.models.openai_api import OpenAI
+    from opencompass_tpu.outbound import StubProvider, canned_text
+
+    provider = StubProvider(latency_s=0.01).start()
+    report: Dict = {}
+    try:
+        model = OpenAI(
+            path='flaky-chaos', key='chaos',
+            openai_api_base=provider.chat_url,
+            query_per_second=1000, retry=2, max_inflight=6,
+            outbound=dict(breaker_cooldown_s=1.0,
+                          retry_budget_rate=5.0,
+                          retry_budget_burst=8.0,
+                          request_timeout_s=10.0))
+        sched = model.outbound_scheduler()
+        rows = [f'flaky row {i}' for i in range(8 if quick else 16)]
+        expected = [canned_text(r) for r in rows]
+
+        # -- phase 1: 429 burst → pacing adapts, retries budgeted ----
+        provider.queue_429(len(rows) // 2, retry_after_s=0.2)
+        out = model.generate(rows, max_out_len=8)
+        _check(out == expected,
+               'outputs diverged under the 429 burst')
+        stats = sched.stats()
+        _check(stats['http_429_total'] >= 1,
+               'the injected 429 burst never reached the scheduler')
+        _check(stats['limiter']['low_water']
+               < stats['limiter']['max_limit'],
+               f'AIMD window never backed off under 429s: '
+               f'{stats["limiter"]}')
+        _check(stats['retries_total'] <= stats['http_429_total']
+               + stats['http_5xx_total'] + 1,
+               f'more retries than failures — retry amplification: '
+               f'{stats}')
+        report['burst'] = {
+            'http_429': stats['http_429_total'],
+            'retries': stats['retries_total'],
+            'budget_refusals': stats['retry_budget_refusals'],
+            'limit_low_water': stats['limiter']['low_water']}
+
+        # -- phase 2: crash loop → breaker opens; probe closes -------
+        provider.set_mode('500')
+        crashed = model.generate_outcomes(rows[:6], 8)
+        _check(all(not o.ok for o in crashed.outcomes),
+               'a crash-looping endpoint returned a success')
+        _check(all(o.failure.kind in ('server_error', 'breaker_open',
+                                      'aborted')
+                   for o in crashed.outcomes),
+               f'untyped failures in the crash loop: '
+               f'{[o.failure.kind for o in crashed.outcomes]}')
+        _check(sched.breaker.state in ('open', 'half_open'),
+               f'breaker never opened across a crash loop '
+               f'(state {sched.breaker.state})')
+        provider.set_mode(None)
+        time.sleep(1.1)   # past the cooldown: next call is the probe
+        probe = model.generate(['probe row'], max_out_len=8)
+        _check(probe == [canned_text('probe row')],
+               'the half-open probe returned wrong content')
+        _check(sched.breaker.state == 'closed',
+               f'probe success did not close the breaker '
+               f'(state {sched.breaker.state})')
+        report['breaker'] = {'opens': sched.breaker.opens,
+                             'closed_by_probe': True}
+
+        # -- phase 3: stall → deadline-bounded typed failure ---------
+        provider.set_mode('stall')
+        t0 = time.perf_counter()
+        stalled = model.generate_outcomes(['stalled row'], 8,
+                                          deadline_s=1.5)
+        wall = time.perf_counter() - t0
+        outcome = stalled.outcomes[0]
+        _check(not outcome.ok and outcome.failure.kind
+               in ('deadline_exceeded', 'stall'),
+               f'stall did not fail typed: {outcome.failure}')
+        _check(wall < 10.0,
+               f'deadline did not bound the stalled call '
+               f'({wall:.1f}s)')
+        provider.set_mode(None)
+        report['stall'] = {'kind': outcome.failure.kind,
+                           'wall_s': round(wall, 2)}
+
+        # -- phase 4: partial failure → resume converges -------------
+        marked = [r + (' CHAOSFAIL' if i in (1, 4) else '')
+                  for i, r in enumerate(rows[:6])]
+        provider.set_fail_marker('CHAOSFAIL')
+        partial = model.generate_outcomes(marked, 8)
+        _check(all(o is not None for o in partial.outcomes),
+               'a row was silently lost (no outcome)')
+        failed_idx = sorted(f.index for f in partial.failures)
+        _check(failed_idx == [1, 4],
+               f'wrong rows failed: {failed_idx}')
+        # server_error after exhausted retries, or breaker_open when
+        # the two crash-looping rows tripped the circuit mid-run —
+        # both typed, both resumable
+        _check(all(f.kind in ('server_error', 'breaker_open')
+                   for f in partial.failures),
+               f'partial failures untyped: '
+               f'{[f.kind for f in partial.failures]}')
+        provider.set_fail_marker(None)
+        time.sleep(1.1)   # breaker cooldown before the resume probes
+        # the resume: only the failed rows re-run, then the merged
+        # outputs must equal a clean full run bit-identically
+        resumed = model.generate([marked[i] for i in failed_idx],
+                                 max_out_len=8)
+        merged = [resumed[failed_idx.index(i)] if i in failed_idx
+                  else partial.outcomes[i].value
+                  for i in range(len(marked))]
+        clean = model.generate(marked, max_out_len=8)
+        _check(merged == clean,
+               'resumed outputs are not bit-identical to a clean run')
+        report['partial'] = {'failed_rows': failed_idx,
+                             'resume_converged': True}
+        return report
+    finally:
+        provider.stop()
+
+
 SCENARIOS = {
     'overload_burst': scenario_overload_burst,
     'stuck_worker': scenario_stuck_worker,
     'worker_kill': scenario_worker_kill,
     'store_eio': scenario_store_eio,
+    'flaky_api': scenario_flaky_api,
 }
+
+# scenarios that need no serve daemon (they drive the outbound stub
+# provider in-process) — `--scenario flaky_api` must not pay a daemon
+# spawn, and the run-wide access-log invariant only applies when a
+# daemon actually served traffic
+DAEMONLESS = {'flaky_api'}
 
 
 # -- runner -----------------------------------------------------------------
@@ -555,27 +695,36 @@ def run_chaos(names: Optional[List[str]] = None,
     if unknown:
         raise ValueError(f'unknown scenario(s) {unknown}; have '
                          f'{sorted(SCENARIOS)}')
+    needs_daemon = any(n not in DAEMONLESS for n in names)
     shutil.rmtree(workdir, ignore_errors=True)
-    daemon = ChaosDaemon(workdir)
+    daemon = ChaosDaemon(workdir) if needs_daemon else None
     t0 = time.perf_counter()
     reports: Dict[str, Dict] = {}
     try:
-        daemon.start()
+        if daemon is not None:
+            daemon.start()
         for name in names:
             t = time.perf_counter()
             reports[name] = SCENARIOS[name](daemon, quick=quick)
             reports[name]['wall_s'] = round(
                 time.perf_counter() - t, 2)
-        _check(daemon.alive(), 'daemon died across the scenario sweep')
+        if daemon is not None:
+            _check(daemon.alive(),
+                   'daemon died across the scenario sweep')
     finally:
-        daemon.stop()
-    access = _jsonl(osp.join(daemon.serve_obs_dir, 'access.jsonl'))
-    requests = _jsonl(osp.join(daemon.serve_obs_dir, 'requests.jsonl'))
-    lost = check_no_lost_requests(access, requests)
-    _check(not lost, f'silently lost requests: {lost}')
-    checked = sum(1 for r in access
-                  if r.get('route') == '/v1/completions'
-                  and r.get('method') == 'POST')
+        if daemon is not None:
+            daemon.stop()
+    checked = 0
+    if daemon is not None:
+        access = _jsonl(osp.join(daemon.serve_obs_dir,
+                                 'access.jsonl'))
+        requests = _jsonl(osp.join(daemon.serve_obs_dir,
+                                   'requests.jsonl'))
+        lost = check_no_lost_requests(access, requests)
+        _check(not lost, f'silently lost requests: {lost}')
+        checked = sum(1 for r in access
+                      if r.get('route') == '/v1/completions'
+                      and r.get('method') == 'POST')
     return {'v': 1, 'quick': quick, 'scenarios': reports,
             'requests_checked': checked,
             'wall_s': round(time.perf_counter() - t0, 2)}

@@ -49,6 +49,13 @@ Usage::
                     # no silent loss, degraded-not-down /healthz,
                     # Retry-After on sheds, p99 within objective,
                     # bit-identical store convergence (docs/serving.md)
+    python -m opencompass_tpu.cli chaos --scenario flaky_api --check
+                    # outbound API resilience drill vs the device-free
+                    # fault-injecting stub provider: 429 pacing
+                    # adaptation within retry budgets, breaker
+                    # open->half-open->close, deadline-bounded stalls,
+                    # zero lost rows + bit-identical partial-failure
+                    # resume (docs/user_guides/api_models.md)
 
 Phases: ``infer`` (predictions), ``eval`` (scores), ``viz`` (summary table).
 Every phase is resumable because completion is keyed on output files

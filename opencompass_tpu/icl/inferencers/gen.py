@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import os
 import os.path as osp
+import threading
 import time
 from typing import List, Optional
 
@@ -158,6 +159,21 @@ class GenInferencer(BaseInferencer):
                                  cached_rows=len(done_idx))
             return self._finalize(handler, out_dir, out_name, scratch)
 
+        # outbound API scheduler: API-model rows fan out through the
+        # model's per-provider scheduler (bounded AIMD in-flight,
+        # Retry-After pacing, budgeted retries, breaker) and scatter
+        # back per row in completion order — save/flush/heartbeat tick
+        # per retired row like the continuous path, and a failed row
+        # becomes a typed resumable record instead of killing its
+        # siblings' finished work
+        if (todo and getattr(self.model, 'supports_outbound', False)
+                and type(self)._generate_batch
+                is GenInferencer._generate_batch):
+            self._run_outbound(prompts, todo, handler, state, out_dir,
+                               out_name, obs_on,
+                               cached_rows=len(done_idx))
+            return self._finalize(handler, out_dir, out_name, scratch)
+
         # a generation batch pads prompts to max_seq_len - max_out_len at
         # most (the model reserves decode room); clamp planned lengths the
         # same way so planned shapes match dispatched ones
@@ -266,6 +282,94 @@ class GenInferencer(BaseInferencer):
         self.model.generate_continuous([str(s) for s in shown],
                                        self.max_out_len,
                                        on_result=on_result)
+
+    def _run_outbound(self, prompts, todo, handler, state, out_dir,
+                      out_name, obs_on, cached_rows: int = 0):
+        """Fan every miss out through the model's outbound scheduler
+        and scatter rows back as they complete.
+
+        Saves, ``tmp_`` flushes, and the heartbeat all tick per
+        completed row (out-of-order, like the continuous engine path);
+        rows that fail past their retry/deadline budgets are written
+        to ``api_errors.json`` as typed records and the task raises
+        *after* flushing every success — the idx-keyed ``tmp_`` resume
+        then recomputes exactly the failed rows, bit-identically on a
+        deterministic provider."""
+        from opencompass_tpu.obs import get_timeline
+        chunk = [prompts[i] for i in todo]
+        shown = self.model.parse_template(chunk, mode='gen')
+        if not isinstance(shown, list):
+            shown = [shown]
+        timeline = get_timeline()
+        if timeline.enabled:
+            timeline.plan('gen', stats={'n_rows': len(todo),
+                                        'outbound': True},
+                          planned=True, cached_rows=cached_rows)
+        total = len(prompts)
+        lock = threading.Lock()
+        t0 = time.perf_counter()
+
+        def on_result(k, text):
+            i = todo[k]
+            with lock:
+                handler.save_results(shown[k], text, i)
+                state['completed'] += 1
+                completed = state['completed']
+                if (self.save_every is not None
+                        and self.is_main_process
+                        and completed - state['last_flush']
+                        >= self.save_every):
+                    handler.write_to_json(out_dir, 'tmp_' + out_name)
+                    state['last_flush'] = completed
+            if obs_on:
+                from opencompass_tpu.obs import (get_heartbeat,
+                                                 get_tracer)
+                get_tracer().counter('inferencer.gen_rows').inc()
+                hb = get_heartbeat()
+                if hb.enabled:
+                    hb.progress(done=completed, total=total)
+
+        # parsed prompts ride through as-is: chat API models receive
+        # the role-structured PromptList, not a flattened string
+        report = self.model.generate_outcomes(
+            list(shown), self.max_out_len, on_result=on_result)
+        stats = report.stats
+        if timeline.enabled:
+            timeline.batch(
+                'gen', dur_s=round(time.perf_counter() - t0, 4),
+                n_rows=len(todo), outbound=True,
+                attempts=stats.get('attempts_total'),
+                retries=stats.get('retries_total'),
+                http_429=stats.get('http_429_total'),
+                hedges=stats.get('hedges_total'),
+                failed_rows=len(report.failures))
+        if obs_on:
+            from opencompass_tpu.obs import get_heartbeat
+            hb = get_heartbeat()
+            if hb.enabled:
+                hb.note(outbound_http_429=stats.get('http_429_total'),
+                        outbound_limit=(stats.get('limiter')
+                                        or {}).get('limit'))
+        err_path = osp.join(out_dir, 'api_errors.json')
+        if report.failures:
+            if self.is_main_process:
+                os.makedirs(out_dir, exist_ok=True)
+                # every finished sibling survives the failure: flush
+                # first, then fail the task typed + resumable
+                handler.write_to_json(out_dir, 'tmp_' + out_name)
+                from opencompass_tpu.utils.fileio import \
+                    atomic_write_json
+                atomic_write_json(err_path, {
+                    'v': 1,
+                    'provider': report.provider,
+                    'failed_rows': [
+                        dict(f.as_dict(), index=todo[f.index])
+                        for f in report.failures],
+                    'wall_s': round(report.wall_s, 3),
+                })
+            report.values()   # raises PartialFailure with the detail
+        if self.is_main_process and osp.exists(err_path):
+            os.remove(err_path)   # a clean pass retires stale evidence
 
     def _resume(self, scratch_path: str) -> dict:
         """Sample-level resume from a previous run's tmp_ flush.  Rank 0

@@ -12,10 +12,10 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 import warnings
-from abc import abstractmethod
 from time import sleep
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 from opencompass_tpu.utils.prompt import PromptList
 
@@ -24,26 +24,70 @@ from .base import BaseModel, MetaTemplateWalker
 PromptType = Union[PromptList, str]
 
 
+def _request_deadline_remaining_s() -> Optional[float]:
+    """Remaining wall budget for the running request, when one is
+    active in this thread: the outbound scheduler's row deadline (it
+    re-publishes the budget on its worker threads), else the serve
+    path's ``X-OCT-Deadline-Ms`` request context — both lookups live
+    in ``outbound/scheduler.py``; this is just the precedence."""
+    try:
+        from opencompass_tpu.outbound.scheduler import (
+            current_row_deadline_s, serve_deadline_remaining_s)
+        remaining = current_row_deadline_s()
+        if remaining is not None:
+            return remaining
+        return serve_deadline_remaining_s()
+    except Exception:  # noqa: BLE001 — never block transport on obs
+        return None
+
+
 class TokenBucket:
-    """Semaphore refilled by a daemon thread at ``rate`` tokens/sec, used to
-    cap API queries-per-second across the inferencer's worker threads."""
+    """QPS cap as a lazily-refilled token counter (parity shim).
+
+    The original shape — a ``Semaphore`` refilled by a per-model
+    daemon thread — had three races the outbound scheduler's limiter
+    superseded: unsynchronized ``_started`` could spawn two refill
+    threads (double the configured rate), ``_refill`` poked the
+    private ``Semaphore._value``, and the busy thread never died with
+    the model.  This shim keeps the ``get_token()`` contract for
+    legacy callers but accrues tokens arithmetically under a lock on
+    an injected clock — no thread, no private attrs, nothing to leak.
+    New code paces through :class:`opencompass_tpu.outbound.Pacer`.
+    """
 
     def __init__(self, rate: float):
-        self._rate = rate
-        self._tokens = threading.Semaphore(0)
-        self._started = False
+        self._rate = max(float(rate), 1e-6)
+        # burst matches the old semaphore's cap (value < rate)
+        self._burst = max(self._rate, 1.0)
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._tokens = 1.0
+        # guarded-by: _lock
+        self._last: Optional[float] = None
 
-    def _refill(self):
-        while True:
-            if self._tokens._value < self._rate:
-                self._tokens.release()
-            sleep(1 / self._rate)
+    def try_take(self, now: Optional[float] = None) -> float:
+        """Take one token if available (returns 0.0), else the seconds
+        until one accrues — deterministic under an injected clock."""
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            if self._last is None:
+                self._last = now
+            self._tokens = min(
+                self._burst,
+                self._tokens + (now - self._last) * self._rate)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return 0.0
+            return (1.0 - self._tokens) / self._rate
 
     def get_token(self):
-        if not self._started:
-            self._started = True
-            threading.Thread(target=self._refill, daemon=True).start()
-        self._tokens.acquire()
+        """Block until the next token (the legacy pacing call)."""
+        while True:
+            wait = self.try_take()
+            if wait <= 0.0:
+                return
+            sleep(min(wait, 1.0))
 
 
 class APITemplateParser(MetaTemplateWalker):
@@ -135,8 +179,23 @@ class BaseAPIModel(BaseModel):
 
     Args:
         path: model identifier passed to the API.
-        query_per_second: rate limit enforced via :class:`TokenBucket`.
-        retry: attempts per query before giving up.
+        query_per_second: steady pacing cap, honored by the outbound
+            scheduler's :class:`~opencompass_tpu.outbound.Pacer` (and
+            the legacy :class:`TokenBucket` shim for direct
+            ``post_json`` callers).
+        retry: attempts per query before giving up (the scheduler's
+            ``max_attempts`` is ``retry + 1``, budget permitting).
+        max_inflight: AIMD ceiling on concurrent in-flight requests
+            per provider — the adaptive window backs off from here on
+            429/5xx and re-probes on success.
+        hedge_after_s: when set, a request still in flight after this
+            many seconds launches one budgeted duplicate (first
+            completion wins) — the straggler-tail lever.
+        outbound: advanced scheduler overrides
+            (docs/user_guides/api_models.md): ``qps``,
+            ``request_timeout_s``, ``breaker_failures`` /
+            ``breaker_window_s`` / ``breaker_cooldown_s``,
+            ``retry_budget_rate`` / ``retry_budget_burst``.
     """
 
     is_api: bool = True
@@ -150,7 +209,10 @@ class BaseAPIModel(BaseModel):
                  retry: int = 2,
                  max_seq_len: int = 2048,
                  meta_template: Optional[Dict] = None,
-                 generation_kwargs: Optional[Dict] = None):
+                 generation_kwargs: Optional[Dict] = None,
+                 max_inflight: int = 8,
+                 hedge_after_s: Optional[float] = None,
+                 outbound: Optional[Dict] = None):
         self.path = path
         self.max_seq_len = max_seq_len
         self.meta_template = meta_template
@@ -159,11 +221,109 @@ class BaseAPIModel(BaseModel):
         self.token_bucket = TokenBucket(query_per_second)
         self.template_parser = APITemplateParser(meta_template)
         self.generation_kwargs = generation_kwargs or {}
+        self.max_inflight = max_inflight
+        self.hedge_after_s = hedge_after_s
+        self.outbound_cfg = dict(outbound or {})
         self.logger = None
+        self._outbound_lock = threading.Lock()
+        # guarded-by: _outbound_lock
+        self._outbound_sched = None
 
-    @abstractmethod
-    def generate(self, inputs: List[PromptType], max_out_len: int) -> List[str]:
-        """Generate completions via the API."""
+    # -- outbound scheduling -----------------------------------------------
+
+    @property
+    def provider_key(self) -> str:
+        """The provider identity outbound state (breaker, AIMD window,
+        retry budget, metrics labels) is keyed by: the endpoint host
+        when the model has a URL, else the model path."""
+        url = getattr(self, 'url', '') or ''
+        try:
+            from urllib.parse import urlsplit
+            netloc = urlsplit(url).netloc
+        except ValueError:
+            netloc = ''
+        return netloc or self.path
+
+    @property
+    def supports_outbound(self) -> bool:
+        """True when this model routes rows through the outbound
+        scheduler (it implements the single-attempt ``_generate_one``
+        hook) — the gate for the inferencer's per-row scatter-back
+        path."""
+        return type(self)._generate_one \
+            is not BaseAPIModel._generate_one
+
+    def outbound_scheduler(self):
+        """The model's lazily-built per-provider scheduler — every
+        generate/ppl/choice row flows through it."""
+        with self._outbound_lock:
+            if self._outbound_sched is None:
+                from opencompass_tpu.outbound import OutboundScheduler
+                from opencompass_tpu.utils.resilience import (
+                    CircuitBreaker, RetryBudget)
+                from opencompass_tpu.outbound.scheduler import (
+                    OUTBOUND_RETRY_BURST, OUTBOUND_RETRY_RATE)
+                cfg = self.outbound_cfg
+                key = self.provider_key
+                breaker = CircuitBreaker(
+                    key,
+                    failures=cfg.get('breaker_failures', 3),
+                    window_s=cfg.get('breaker_window_s', 60.0),
+                    cooldown_s=cfg.get('breaker_cooldown_s', 15.0))
+                budget = RetryBudget(
+                    rate=cfg.get('retry_budget_rate',
+                                 OUTBOUND_RETRY_RATE),
+                    burst=cfg.get('retry_budget_burst',
+                                  OUTBOUND_RETRY_BURST))
+                self._outbound_sched = OutboundScheduler(
+                    key,
+                    max_inflight=cfg.get('max_inflight',
+                                         self.max_inflight),
+                    qps=cfg.get('qps', self.query_per_second),
+                    max_attempts=self.retry + 1,
+                    request_timeout_s=cfg.get('request_timeout_s',
+                                              60.0),
+                    hedge_after_s=cfg.get('hedge_after_s',
+                                          self.hedge_after_s),
+                    retry_budget=budget, breaker=breaker)
+            return self._outbound_sched
+
+    def _generate_one(self, prompt: PromptType, max_out_len: int,
+                      timeout: float = 60.0) -> str:
+        """ONE un-retried completion attempt for one prompt, raising
+        typed :mod:`opencompass_tpu.outbound.errors`.  Subclasses
+        implement this; the scheduler owns retries/pacing/breakers."""
+        raise NotImplementedError(
+            f'{type(self).__name__} does not implement the outbound '
+            'single-attempt hook')
+
+    def generate_outcomes(self, inputs: List[PromptType],
+                          max_out_len: int,
+                          on_result: Optional[Callable] = None,
+                          deadline_s: Optional[float] = None,
+                          fail_fast: bool = True):
+        """Drive ``inputs`` through the outbound scheduler to typed
+        per-row outcomes (:class:`opencompass_tpu.outbound
+        .OutboundReport`).  ``on_result(index, text)`` fires per
+        completed row in completion order — the scatter-back hook the
+        inferencer's partial-failure path rides."""
+
+        def call(prompt, timeout):
+            return self._generate_one(prompt, max_out_len,
+                                      timeout=timeout)
+
+        return self.outbound_scheduler().run(
+            list(inputs), call, on_result=on_result,
+            deadline_s=deadline_s, fail_fast=fail_fast)
+
+    def generate(self, inputs: List[PromptType],
+                 max_out_len: int = 512) -> List[str]:
+        """Generate completions via the API, concurrently through the
+        outbound scheduler.  Any row failing past its budgets raises
+        :class:`~opencompass_tpu.outbound.PartialFailure` (the task
+        fails resumable rather than scoring '' as a wrong answer);
+        a non-retryable rejection fail-fasts the remaining queue."""
+        return self.generate_outcomes(inputs, max_out_len).values()
 
     def get_ppl(self, inputs, mask_length=None):
         raise NotImplementedError(
@@ -181,45 +341,101 @@ class BaseAPIModel(BaseModel):
         """Block until the rate limiter grants the next query."""
         return self.token_bucket.get_token()
 
+    def post_json_once(self, url: str, body: Dict,
+                       headers: Optional[Dict] = None,
+                       timeout: float = 120) -> Dict:
+        """ONE JSON POST attempt with typed failures
+        (:mod:`opencompass_tpu.outbound.errors`) — the transport the
+        outbound scheduler drives.  When a serve-path request deadline
+        is active (``X-OCT-Deadline-Ms``), the remaining budget is
+        forwarded on the outbound request and caps the socket
+        timeout."""
+        import json as _json
+        import urllib.request
+        from opencompass_tpu.outbound import errors as oerr
+        hdrs = {'Content-Type': 'application/json', **(headers or {})}
+        remaining = _request_deadline_remaining_s()
+        if remaining is not None:
+            if remaining <= 0:
+                raise oerr.DeadlineExceeded(
+                    'request budget exhausted before dispatch')
+            hdrs.setdefault('X-OCT-Deadline-Ms',
+                            str(int(remaining * 1000)))
+            timeout = min(timeout, max(remaining, 0.05))
+        try:
+            data = _json.dumps(body).encode()
+        except (TypeError, ValueError) as exc:
+            # a client-side bug, not a provider fault: retrying the
+            # same un-serializable body (or opening the breaker over
+            # it) would misattribute the incident — fail fast, typed
+            raise oerr.Rejected(
+                f'request body is not JSON-serializable: '
+                f'{exc}') from exc
+        request = urllib.request.Request(url, data=data, headers=hdrs)
+        try:
+            with urllib.request.urlopen(request,
+                                        timeout=timeout) as resp:
+                raw = resp.read()
+        except oerr.ProviderError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — classified below
+            raise oerr.classify(exc) from exc
+        try:
+            return _json.loads(raw)
+        except ValueError as exc:
+            raise oerr.MalformedResponse(
+                f'unparseable JSON from {url}: {exc}') from exc
+
     def post_json(self, url: str, body: Dict,
                   headers: Optional[Dict] = None,
                   timeout: float = 120) -> Dict:
-        """Rate-limited JSON POST with the shared retry policy: 429 backs
-        off exponentially, other 4xx fail fast (retrying cannot fix auth or
-        a bad request), 5xx/network errors burn the retry budget; the
-        final error chains the last underlying exception."""
-        import json as _json
-        import urllib.error
-        import urllib.request
+        """Rate-limited JSON POST with the shared retry policy: 429
+        honors the provider's ``Retry-After`` header, every backoff is
+        exponential with *deterministic jitter* (the serve daemon's
+        ``backoff_delay`` — concurrent callers decorrelate instead of
+        stampeding an already-throttling provider in lockstep), other
+        4xx fail fast (retrying cannot fix auth or a bad request),
+        5xx/network errors burn the retry budget; the final error
+        chains the last underlying exception.
+
+        Direct callers only — rows going through the scheduler use
+        :meth:`post_json_once` and the scheduler's own policy."""
+        from opencompass_tpu.outbound import errors as oerr
         from opencompass_tpu.utils.logging import get_logger
+        from opencompass_tpu.utils.resilience import backoff_delay
         logger = get_logger()
-        hdrs = {'Content-Type': 'application/json', **(headers or {})}
         last_exc = None
         for attempt in range(self.retry + 1):
             self.wait()
             try:
-                request = urllib.request.Request(
-                    url, data=_json.dumps(body).encode(), headers=hdrs)
-                with urllib.request.urlopen(request,
-                                            timeout=timeout) as resp:
-                    return _json.loads(resp.read())
-            except urllib.error.HTTPError as err:
-                if err.code == 429:
-                    logger.warning('rate limited; backing off')
-                elif 400 <= err.code < 500:
-                    raise RuntimeError(
-                        f'API rejected the request ({err.code} '
-                        f'{err.reason}, {url})') from err
-                else:
-                    logger.error(f'API error {err.code}: {err.reason}')
+                return self.post_json_once(url, body, headers=headers,
+                                           timeout=timeout)
+            except oerr.Rejected as err:
+                raise RuntimeError(
+                    f'API rejected the request ({err}, '
+                    f'{url})') from err
+            except oerr.ProviderError as err:
                 last_exc = err
+                if not err.retryable:
+                    # e.g. an expired request deadline: another
+                    # attempt cannot succeed — fail now, no backoff
+                    raise RuntimeError(
+                        f'API request failed ({err}, {url})') from err
+                if isinstance(err, oerr.RateLimited):
+                    logger.warning(
+                        'rate limited; backing off'
+                        + (f' (Retry-After {err.retry_after_s}s)'
+                           if err.retry_after_s is not None else ''))
+                else:
+                    logger.error(f'API error: {err}')
                 if attempt < self.retry:  # no pointless terminal sleep
-                    sleep(2 ** attempt)   # 429/5xx: back off, don't hammer
-            except Exception as exc:  # noqa: BLE001 — network variance
-                logger.error(f'API request failed: {exc}')
-                last_exc = exc
-                if attempt < self.retry:
-                    sleep(1)
+                    delay = backoff_delay(url, attempt, base_s=1.0,
+                                          cap_s=30.0)
+                    if err.retry_after_s is not None:
+                        # the provider named its recovery horizon;
+                        # coming back earlier only earns another 429
+                        delay = max(delay, err.retry_after_s)
+                    sleep(delay)
         raise RuntimeError(
             f'API request failed after {self.retry + 1} attempts '
             f'({url})') from last_exc

@@ -12,7 +12,6 @@ can only generate).
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Union
 
 from opencompass_tpu.registry import MODELS
@@ -46,13 +45,19 @@ class CompletionsAPI(BaseAPIModel):
                  key: str = 'ENV',
                  meta_template: Optional[Dict] = None,
                  temperature: Optional[float] = None,
-                 generation_kwargs: Optional[Dict] = None):
+                 generation_kwargs: Optional[Dict] = None,
+                 max_inflight: int = 8,
+                 hedge_after_s: Optional[float] = None,
+                 outbound: Optional[Dict] = None):
         super().__init__(path=path,
                          max_seq_len=max_seq_len,
                          meta_template=meta_template,
                          query_per_second=query_per_second,
                          retry=retry,
-                         generation_kwargs=generation_kwargs)
+                         generation_kwargs=generation_kwargs,
+                         max_inflight=max_inflight,
+                         hedge_after_s=hedge_after_s,
+                         outbound=outbound)
         self.url = url
         self.key = os.environ.get('OPENAI_API_KEY', '') if key == 'ENV' \
             else key
@@ -60,32 +65,36 @@ class CompletionsAPI(BaseAPIModel):
 
     # -- transport ---------------------------------------------------------
 
+    def _auth_headers(self) -> Dict:
+        return {'Authorization': f'Bearer {self.key}'} if self.key \
+            else {}
+
     def _post(self, body: Dict) -> Dict:
-        headers = {}
-        if self.key:
-            headers['Authorization'] = f'Bearer {self.key}'
-        return self.post_json(self.url, body, headers=headers)
+        return self.post_json(self.url, body,
+                              headers=self._auth_headers())
+
+    def _post_once(self, body: Dict, timeout: float = 60.0) -> Dict:
+        """One un-retried attempt — the outbound scheduler's
+        transport."""
+        return self.post_json_once(self.url, body,
+                                   headers=self._auth_headers(),
+                                   timeout=timeout)
 
     # -- BaseModel contract ------------------------------------------------
+    # generate() is BaseAPIModel's scheduler-driven fan-out; PPL and
+    # choice ride the same scheduler below, so EVERY row this model
+    # sends — gen, ppl, clp — shares one provider's pacing window,
+    # retry budget, and breaker.
 
-    def generate(self, inputs: List[PromptType],
-                 max_out_len: int = 512) -> List[str]:
-        def one(prompt):
-            body = {'model': self.path, 'prompt': str(prompt),
-                    'max_tokens': max_out_len}
-            if self.temperature is not None:
-                body['temperature'] = self.temperature
-            body.update(self.generation_kwargs)
-            data = self._post(body)
-            return data['choices'][0]['text']
-        with ThreadPoolExecutor() as pool:
-            futures = [pool.submit(one, p) for p in inputs]
-            try:
-                return [f.result() for f in futures]
-            except Exception:
-                for f in futures:
-                    f.cancel()
-                raise
+    def _generate_one(self, prompt: PromptType, max_out_len: int,
+                      timeout: float = 60.0) -> str:
+        body = {'model': self.path, 'prompt': str(prompt),
+                'max_tokens': max_out_len}
+        if self.temperature is not None:
+            body['temperature'] = self.temperature
+        body.update(self.generation_kwargs)
+        data = self._post_once(body, timeout=timeout)
+        return data['choices'][0]['text']
 
     def get_ppl(self,
                 inputs: List[str],
@@ -106,18 +115,19 @@ class CompletionsAPI(BaseAPIModel):
                 "not map onto the server's BPE logprobs.  Use a PPL "
                 'template without normalizing_str for API models.')
 
-        def one(text):
-            vals = self._echo_logprobs(text)
+        def one(text, timeout):
+            vals = self._echo_logprobs(text, timeout=timeout)
             if not vals:
                 return 0.0
             return -sum(vals) / len(vals)
-        with ThreadPoolExecutor() as pool:
-            return list(pool.map(one, inputs))
+        return self.outbound_scheduler().run(list(inputs),
+                                             one).values()
 
-    def _echo_logprobs(self, text: str) -> List[float]:
+    def _echo_logprobs(self, text: str,
+                       timeout: float = 60.0) -> List[float]:
         body = {'model': self.path, 'prompt': str(text),
                 'max_tokens': 0, 'echo': True, 'logprobs': 0}
-        data = self._post(body)
+        data = self._post_once(body, timeout=timeout)
         lp = data['choices'][0]['logprobs']['token_logprobs']
         # the first token has no conditional logprob (null)
         return [x for x in lp if x is not None]
@@ -128,12 +138,12 @@ class CompletionsAPI(BaseAPIModel):
         span's log prob regardless of how the heuristic client tokenizer
         would have counted it.  The bare-input term is scored once per
         input, not once per (input, choice) pair."""
-        def sum_lp(text):
-            return sum(self._echo_logprobs(text))
-        with ThreadPoolExecutor() as pool:
-            base = list(pool.map(sum_lp, inputs))
-            full = list(pool.map(
-                sum_lp, [inp + c for inp in inputs for c in choices]))
+        def sum_lp(text, timeout):
+            return sum(self._echo_logprobs(text, timeout=timeout))
+        sched = self.outbound_scheduler()
+        base = sched.run(list(inputs), sum_lp).values()
+        full = sched.run([inp + c for inp in inputs for c in choices],
+                         sum_lp).values()
         n = len(choices)
         return [choices[max(range(n),
                             key=lambda j: full[i * n + j] - base[i])]

@@ -1,16 +1,21 @@
 """OpenAI-compatible chat API model.
 
-Parity: reference opencompass/models/openai_api.py:13-155 — ThreadPoolExecutor
-fan-out, HUMAN/BOT/SYSTEM → user/assistant/system role mapping, retry on
-rate-limit with token-bucket pacing, tiktoken-or-heuristic token counting.
-Implemented over ``urllib`` so any OpenAI-compatible endpoint (vLLM, llama
-server, proxies) works without the openai SDK; zero-egress environments get
-a clean error only at call time.
+Parity: reference opencompass/models/openai_api.py:13-155 —
+HUMAN/BOT/SYSTEM → user/assistant/system role mapping, retry on
+rate-limit, tiktoken-or-heuristic token counting.  Implemented over
+``urllib`` so any OpenAI-compatible endpoint (vLLM, llama server,
+proxies) works without the openai SDK; zero-egress environments get a
+clean error only at call time.
+
+Concurrency is the outbound scheduler's, not a per-call
+``ThreadPoolExecutor``: rows fan out under an AIMD in-flight window
+with ``Retry-After``-honoring pacing, budgeted jittered retries, a
+per-provider circuit breaker, and typed per-row partial failures
+(docs/user_guides/api_models.md).
 """
 from __future__ import annotations
 
 import os
-from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Union
 
 from opencompass_tpu.registry import MODELS
@@ -47,31 +52,31 @@ class OpenAI(BaseAPIModel):
                  meta_template: Optional[Dict] = None,
                  openai_api_base: str = OPENAI_API_BASE,
                  temperature: Optional[float] = None,
-                 generation_kwargs: Optional[Dict] = None):
+                 generation_kwargs: Optional[Dict] = None,
+                 max_inflight: int = 8,
+                 hedge_after_s: Optional[float] = None,
+                 outbound: Optional[Dict] = None):
         super().__init__(path=path,
                          max_seq_len=max_seq_len,
                          meta_template=meta_template,
                          query_per_second=query_per_second,
                          retry=retry,
-                         generation_kwargs=generation_kwargs)
+                         generation_kwargs=generation_kwargs,
+                         max_inflight=max_inflight,
+                         hedge_after_s=hedge_after_s,
+                         outbound=outbound)
         self.temperature = temperature
         self.key = os.environ.get('OPENAI_API_KEY', '') if key == 'ENV' \
             else key
         self.url = openai_api_base
 
-    def generate(self, inputs: List[PromptType],
-                 max_out_len: int = 512) -> List[str]:
-        with ThreadPoolExecutor() as executor:
-            futures = [executor.submit(self._generate, p, max_out_len)
-                       for p in inputs]
-            try:
-                return [f.result() for f in futures]
-            except Exception:
-                # fail fast: a dead endpoint must not burn the full retry
-                # budget on every queued prompt before the task fails
-                for f in futures:
-                    f.cancel()
-                raise
+    # generate() is BaseAPIModel's: rows fan out through the outbound
+    # scheduler (bounded AIMD in-flight window, budgeted jittered
+    # retries, breaker routing).  On a non-retryable rejection — dead
+    # key, bad endpoint — the scheduler stops admitting queued siblings
+    # and drains the in-flight ones, so a dead endpoint can't burn the
+    # full retry budget row by row or leak request threads past the
+    # call; completed rows survive as typed partial-failure state.
 
     def _to_messages(self, prompt: PromptType) -> List[Dict]:
         if isinstance(prompt, str):
@@ -82,7 +87,14 @@ class OpenAI(BaseAPIModel):
             'content': item['prompt'],
         } for item in prompt]
 
-    def _generate(self, prompt: PromptType, max_out_len: int) -> str:
+    def _generate_one(self, prompt: PromptType, max_out_len: int,
+                      timeout: float = 60.0) -> str:
+        """ONE un-retried chat-completion attempt (the outbound
+        scheduler's transport hook).  A failure raises typed so the
+        scheduler's policy table decides retry/backoff/breaker — and
+        so the task fails rather than scoring empty predictions as
+        wrong answers (reference models/openai_api.py raises after its
+        budget)."""
         messages = self._to_messages(prompt)
         body = {
             'model': self.path,
@@ -92,14 +104,10 @@ class OpenAI(BaseAPIModel):
         if self.temperature is not None:
             body['temperature'] = self.temperature
         body.update(self.generation_kwargs)
-
-        # shared transport (base_api.post_json): rate limiting, 429
-        # backoff, 4xx fast-fail, exception chaining.  A failure raises so
-        # the task fails rather than scoring empty predictions as wrong
-        # answers (reference models/openai_api.py raises after its budget).
-        data = self.post_json(
+        data = self.post_json_once(
             self.url, body,
-            headers={'Authorization': f'Bearer {self.key}'}, timeout=60)
+            headers={'Authorization': f'Bearer {self.key}'},
+            timeout=timeout)
         return data['choices'][0]['message']['content'].strip()
 
     def get_token_len(self, prompt: str) -> int:

@@ -49,6 +49,11 @@ never to a crash):
 - ``breaker_open``       (error) a per-worker circuit breaker is open
                          (the resident flapped), named with its
                          failure evidence.
+- ``api_throttled``      (warn)  an outbound API provider is
+                         sustained-throttling (429 share of attempts)
+                         or its circuit is open, from the scheduler's
+                         durable ``outbound.json`` snapshot, with the
+                         pacing/capacity remediation.
 """
 from __future__ import annotations
 
@@ -71,6 +76,8 @@ QUEUE_BACKLOG_AGE_S = 600.0
 SLOW_REQUEST_FACTOR = 2.0
 SHED_SUSTAINED_MIN = 5
 SHED_SUSTAINED_FRAC = 0.01
+API_THROTTLED_MIN_429 = 5
+API_THROTTLED_FRAC = 0.1
 
 
 def _finding(severity: str, rule: str, title: str,
@@ -100,7 +107,8 @@ def collect(path: str) -> Dict:
                  'cache_root': None, 'status': None, 'timelines': {},
                  'events': [], 'requests': [], 'alerts_active': [],
                  'alerts_recent': [], 'run_marker': None,
-                 'queue_pressure': None, 'overload': None}
+                 'queue_pressure': None, 'overload': None,
+                 'outbound': None}
     try:
         art['obs_dir'] = live.resolve_obs_dir(path)
     except Exception:
@@ -169,6 +177,15 @@ def collect(path: str) -> Dict:
                 art['queue_pressure'] = SweepQueue(queue_root).pressure()
             except Exception:
                 pass
+    # outbound scheduler snapshot: a batch run writes it into the run's
+    # obs dir, a daemon context into the serve obs dir — first found wins
+    try:
+        from opencompass_tpu.outbound import read_outbound
+        for cand in (art['obs_dir'], art['serve_obs_dir']):
+            if cand and art['outbound'] is None:
+                art['outbound'] = read_outbound(cand)
+    except Exception:
+        pass
     return art
 
 
@@ -571,9 +588,60 @@ def _rule_breaker_open(art: Dict) -> List[Dict]:
     return out
 
 
+def _rule_api_throttled(art: Dict) -> List[Dict]:
+    """An outbound provider is sustained-throttling (429s a real share
+    of attempts) or crash-looping (breaker not closed) — the sweep is
+    pacing-bound on the remote end, not device-bound here."""
+    providers = (art.get('outbound') or {}).get('providers') or {}
+    out = []
+    for name, stats in sorted(providers.items()):
+        attempts = stats.get('attempts_total') or 0
+        n429 = stats.get('http_429_total') or 0
+        breaker = (stats.get('breaker') or {})
+        breaker_bad = breaker.get('state') in ('open', 'half_open')
+        throttled = n429 >= API_THROTTLED_MIN_429 \
+            and n429 / max(attempts, 1) >= API_THROTTLED_FRAC
+        if not throttled and not breaker_bad:
+            continue
+        limiter = stats.get('limiter') or {}
+        evidence = [f'provider {name}: {n429} x 429 over {attempts} '
+                    f'attempt(s) '
+                    f'({n429 / max(attempts, 1):.0%} throttled), '
+                    f'{stats.get("retries_total", 0)} retries, '
+                    f'{stats.get("retry_budget_refusals", 0)} budget '
+                    'refusals']
+        if limiter:
+            evidence.append(
+                f'AIMD window {limiter.get("limit")} / '
+                f'{limiter.get("max_limit")} (low-water '
+                f'{limiter.get("low_water")})')
+        if breaker_bad:
+            evidence.append(
+                f'circuit {breaker.get("state")} '
+                f'(opened {breaker.get("opens")}x, last: '
+                f'{breaker.get("last_error")})')
+        title = (f'provider {name} is crash-looping — outbound '
+                 'circuit open' if breaker_bad else
+                 f'provider {name} is throttling — outbound pacing '
+                 'bound by 429s')
+        out.append(_finding(
+            'warn', 'api_throttled', title, evidence,
+            fix='the scheduler already adapts (AIMD window + '
+                'Retry-After pacing); sustained 429s mean the '
+                'provider quota is the bottleneck — lower '
+                'query_per_second/max_inflight to stop burning '
+                'retries, raise the provider quota, or split load '
+                'across API keys/endpoints '
+                '(docs/user_guides/api_models.md)',
+            data={'provider': name, 'http_429_total': n429,
+                  'breaker_state': breaker.get('state')}))
+    return out
+
+
 RULES: List[Callable[[Dict], List[Dict]]] = [
     _rule_failed_tasks,
     _rule_breaker_open,
+    _rule_api_throttled,
     _rule_slo_breach,
     _rule_worker_instability,
     _rule_straggler,

@@ -1,0 +1,34 @@
+"""Resilient outbound API scheduling (docs/user_guides/api_models.md).
+
+All API-model traffic — batch sweeps, judge phases, interactive
+completions — flows through one per-provider
+:class:`~opencompass_tpu.outbound.scheduler.OutboundScheduler`:
+AIMD-bounded concurrent in-flight requests, ``Retry-After``-honoring
+adaptive pacing, retry budgets + deterministic-jitter backoff +
+circuit breakers (the serve daemon's own primitives, shared via
+``utils/resilience.py``), deadline propagation, optional hedged
+requests, and typed per-row partial-failure records.  The
+:class:`~opencompass_tpu.outbound.stub.StubProvider` is the
+device-free fault-injecting endpoint under the tests, the
+``cli chaos`` ``flaky_api`` scenario, and ``bench.py --outbound``.
+"""
+from .errors import (DeadlineExceeded, InternalError, MalformedResponse,
+                     NetworkError, PartialFailure, ProviderError,
+                     RateLimited, Rejected, RowFailure, ServerError,
+                     StallError, classify, from_http_error,
+                     parse_retry_after)
+from .limits import AimdLimiter, Pacer
+from .scheduler import (OUTBOUND_SNAPSHOT, Outcome, OutboundReport,
+                        OutboundScheduler, all_stats, publish_snapshot,
+                        read_outbound)
+from .stub import StubProvider, canned_text
+
+__all__ = [
+    'AimdLimiter', 'DeadlineExceeded', 'InternalError',
+    'MalformedResponse', 'NetworkError', 'OUTBOUND_SNAPSHOT',
+    'Outcome', 'OutboundReport', 'OutboundScheduler', 'Pacer',
+    'PartialFailure', 'ProviderError', 'RateLimited', 'Rejected',
+    'RowFailure', 'ServerError', 'StallError', 'StubProvider',
+    'all_stats', 'canned_text', 'classify', 'from_http_error',
+    'parse_retry_after', 'publish_snapshot', 'read_outbound',
+]

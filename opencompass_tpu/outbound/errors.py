@@ -1,0 +1,197 @@
+"""Typed failure taxonomy for outbound API traffic.
+
+Every way a remote provider can fail maps to exactly one
+:class:`ProviderError` subclass, so the scheduler's policy table
+(retry? back off? open the breaker? shed?) keys on ``kind`` instead of
+string-matching exception text, and a failed row's durable record
+(:class:`RowFailure`) names the failure the same way the operator docs
+do (docs/user_guides/api_models.md, "Failure taxonomy").
+
+``classify``/``from_http_error`` translate the raw transport layer
+(urllib / socket / json) into this taxonomy at the single point where
+HTTP happens (``BaseAPIModel.post_json_once``).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+from typing import Dict, List, Optional
+
+
+class ProviderError(RuntimeError):
+    """One failed request attempt against a remote provider.
+
+    ``kind`` is the taxonomy key; ``retryable`` says whether another
+    attempt could possibly succeed (auth and validation failures
+    cannot); ``retry_after_s`` carries a provider-supplied pacing hint
+    (the 429 ``Retry-After`` header) when one exists."""
+
+    kind = 'provider_error'
+    retryable = True
+
+    def __init__(self, message: str, status: Optional[int] = None,
+                 retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.status = status
+        self.retry_after_s = retry_after_s
+
+
+class RateLimited(ProviderError):
+    """HTTP 429: the provider is throttling.  Retryable, and the
+    scheduler treats it as a *pacing* signal (AIMD backoff + global
+    hold), not a provider fault — it never burns the breaker."""
+    kind = 'rate_limited'
+
+
+class ServerError(ProviderError):
+    """HTTP 5xx: the provider itself failed.  Retryable with backoff;
+    counts against the circuit breaker."""
+    kind = 'server_error'
+
+
+class NetworkError(ProviderError):
+    """Connection-level failure (refused, reset, DNS).  Retryable;
+    counts against the circuit breaker."""
+    kind = 'network'
+
+
+class StallError(ProviderError):
+    """The request timed out in flight — the provider accepted the
+    connection and then went quiet.  Retryable (and the hedging
+    trigger); counts against the circuit breaker."""
+    kind = 'stall'
+
+
+class MalformedResponse(ProviderError):
+    """2xx with a body that does not parse (truncated JSON, HTML error
+    page behind a proxy).  Retryable; counts against the breaker."""
+    kind = 'malformed_response'
+
+
+class Rejected(ProviderError):
+    """Non-429 4xx: auth failure or invalid request.  NOT retryable —
+    the same bytes will fail the same way — and the scheduler's
+    fail-fast path stops admitting sibling rows on it."""
+    kind = 'rejected'
+    retryable = False
+
+
+class DeadlineExceeded(ProviderError):
+    """The row's propagated wall budget died before (or while) the
+    request could run.  Not retryable within this call."""
+    kind = 'deadline_exceeded'
+    retryable = False
+
+
+class InternalError(ProviderError):
+    """A client-side programmer error surfaced inside the transport
+    hook (NotImplementedError, NameError, ...).  NOT retryable — the
+    same code path fails the same way — and it must never feed the
+    provider breaker: a local bug is not a provider incident."""
+    kind = 'internal'
+    retryable = False
+
+
+def parse_retry_after(raw) -> Optional[float]:
+    """Seconds from a ``Retry-After`` header value; ``None`` when
+    absent or unparseable (HTTP-date forms are ignored — providers in
+    this path send delta-seconds)."""
+    try:
+        val = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return val if val >= 0 else None
+
+
+def from_http_error(err) -> ProviderError:
+    """Map a ``urllib.error.HTTPError`` onto the taxonomy."""
+    status = getattr(err, 'code', None) or 0
+    reason = getattr(err, 'reason', '')
+    headers = getattr(err, 'headers', None)
+    retry_after = parse_retry_after(
+        headers.get('Retry-After') if headers else None)
+    if status == 429:
+        return RateLimited(f'rate limited (429 {reason})', status=429,
+                           retry_after_s=retry_after)
+    if status in (408, 425):
+        # transient by definition (request timeout / too early): a
+        # retry can succeed — fail-fasting the sweep over one of
+        # these would let a single slow request kill 1000 rows
+        return StallError(f'provider timeout ({status} {reason})',
+                          status=status, retry_after_s=retry_after)
+    if 400 <= status < 500:
+        return Rejected(f'provider rejected the request ({status} '
+                        f'{reason})', status=status)
+    return ServerError(f'provider error ({status} {reason})',
+                       status=status, retry_after_s=retry_after)
+
+
+def classify(exc: BaseException) -> ProviderError:
+    """Map any transport-layer exception onto the taxonomy.  Already-
+    typed errors pass through unchanged."""
+    if isinstance(exc, ProviderError):
+        return exc
+    import urllib.error
+    if isinstance(exc, urllib.error.HTTPError):
+        return from_http_error(exc)
+    if isinstance(exc, (socket.timeout, TimeoutError)):
+        return StallError(f'request stalled: {exc}')
+    if isinstance(exc, urllib.error.URLError):
+        reason = getattr(exc, 'reason', exc)
+        if isinstance(reason, (socket.timeout, TimeoutError)):
+            return StallError(f'request stalled: {reason}')
+        return NetworkError(f'network error: {reason}')
+    if isinstance(exc, (json.JSONDecodeError, ValueError, KeyError,
+                        TypeError, IndexError)):
+        return MalformedResponse(f'unparseable provider response: '
+                                 f'{type(exc).__name__}: {exc}')
+    if isinstance(exc, (ConnectionError, OSError)):
+        return NetworkError(f'network error: {exc}')
+    if isinstance(exc, (NotImplementedError, NameError,
+                        AttributeError, ImportError)):
+        # a bug in the model's transport hook, not provider weather —
+        # retrying or opening the breaker would misattribute it
+        return InternalError(f'{type(exc).__name__}: {exc}')
+    return ProviderError(f'{type(exc).__name__}: {exc}')
+
+
+@dataclasses.dataclass
+class RowFailure:
+    """The durable, typed record of one row the scheduler could not
+    complete.  Serialized into ``api_errors.json`` next to the task's
+    predictions so a rerun (which recomputes exactly the missing rows
+    via the idx-keyed ``tmp_`` resume) has the incident on disk."""
+    index: int
+    kind: str
+    error: str
+    attempts: int
+    elapsed_s: float
+    provider: str = ''
+
+    def as_dict(self) -> Dict:
+        return {'index': self.index, 'kind': self.kind,
+                'error': self.error, 'attempts': self.attempts,
+                'elapsed_s': round(self.elapsed_s, 3),
+                'provider': self.provider}
+
+
+class PartialFailure(RuntimeError):
+    """Some rows failed after the scheduler exhausted their budgets.
+    Successful siblings were still delivered (and flushed by the
+    caller) — raising this marks the *task* failed-and-resumable, it
+    does not unwind the finished work."""
+
+    def __init__(self, failures: List[RowFailure], total: int,
+                 provider: str = ''):
+        self.failures = list(failures)
+        self.total = int(total)
+        self.provider = provider
+        kinds: Dict[str, int] = {}
+        for f in self.failures:
+            kinds[f.kind] = kinds.get(f.kind, 0) + 1
+        detail = ', '.join(f'{k} x{n}' for k, n in sorted(kinds.items()))
+        first = self.failures[0].error if self.failures else ''
+        super().__init__(
+            f'{len(self.failures)}/{total} row(s) failed against '
+            f'{provider or "provider"} ({detail}); first: {first}')

@@ -1,0 +1,163 @@
+# oct-lint: clock-discipline
+"""Concurrency + pacing control for outbound API traffic.
+
+Two cooperating limiters replace the old busy-thread QPS
+``TokenBucket``:
+
+- :class:`AimdLimiter` bounds **concurrent in-flight requests** with
+  TCP-style additive-increase / multiplicative-decrease: a 429 or 5xx
+  halves the window (at most once per ``hold_s`` so one burst of
+  concurrent throttles costs one decrease, not a collapse to the
+  floor), and every success re-probes upward by ``1/limit`` — the
+  window converges near what the provider actually sustains instead of
+  what the config guessed.
+- :class:`Pacer` spaces **request launches** — an optional steady QPS
+  interval plus a global ``Retry-After`` gate: when the provider says
+  "come back in N seconds", *every* worker honors it, instead of each
+  thread discovering the 429 for itself.
+
+Both are lock-guarded and clock-injected (``now=``); the scheduler's
+tests drive them deterministically.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+DEFAULT_MAX_INFLIGHT = 8
+DEFAULT_HOLD_S = 1.0
+
+
+class AimdLimiter:
+    """Adaptive bound on concurrent in-flight requests.
+
+    ``acquire``/``release`` bracket one request; ``on_throttle``
+    (429/5xx) halves the window, ``on_success`` creeps it back up.
+    The *low-water* mark records how far the provider pushed us down —
+    the chaos harness's "pacing adapted" evidence."""
+
+    def __init__(self, max_limit: int = DEFAULT_MAX_INFLIGHT,
+                 min_limit: int = 1, backoff: float = 0.5,
+                 hold_s: float = DEFAULT_HOLD_S):
+        self.max_limit = max(int(max_limit), 1)
+        self.min_limit = max(int(min_limit), 1)
+        self.backoff = float(backoff)
+        self.hold_s = float(hold_s)
+        self._cond = threading.Condition()
+        # guarded-by: _cond
+        self._limit = float(self.max_limit)
+        # guarded-by: _cond
+        self._inflight = 0
+        # guarded-by: _cond
+        self._last_decrease_ts: Optional[float] = None
+        # guarded-by: _cond
+        self._low_water = float(self.max_limit)
+        # guarded-by: _cond
+        self._throttles = 0
+
+    def acquire(self, timeout: Optional[float] = None) -> bool:
+        """Block until an in-flight slot is free (or ``timeout``
+        expires — returns False; the caller maps that to a deadline
+        failure, never a silent skip)."""
+        with self._cond:
+            granted = self._cond.wait_for(
+                lambda: self._inflight < max(int(self._limit),
+                                             self.min_limit),
+                timeout=timeout)
+            if granted:
+                self._inflight += 1
+            return granted
+
+    def release(self):
+        with self._cond:
+            self._inflight = max(0, self._inflight - 1)
+            self._cond.notify_all()
+
+    def on_success(self):
+        """Additive increase: one success grows the window by
+        ``1/limit`` (one full window of successes ≈ +1 slot)."""
+        with self._cond:
+            if self._limit < self.max_limit:
+                self._limit = min(self.max_limit,
+                                  self._limit + 1.0 / max(self._limit,
+                                                          1.0))
+                self._cond.notify_all()
+
+    def on_throttle(self, now: Optional[float] = None):
+        """Multiplicative decrease, at most once per ``hold_s`` — N
+        concurrent requests all seeing the same 429 burst must cost
+        one halving, not ``backoff**N``."""
+        now = time.monotonic() if now is None else float(now)
+        with self._cond:
+            self._throttles += 1
+            last = self._last_decrease_ts
+            if last is not None and now - last < self.hold_s:
+                return
+            self._last_decrease_ts = now
+            self._limit = max(float(self.min_limit),
+                              self._limit * self.backoff)
+            self._low_water = min(self._low_water, self._limit)
+
+    def snapshot(self) -> Dict:
+        with self._cond:
+            return {'limit': round(self._limit, 2),
+                    'inflight': self._inflight,
+                    'max_limit': self.max_limit,
+                    'low_water': round(self._low_water, 2),
+                    'throttles': self._throttles}
+
+
+class Pacer:
+    """Launch spacing: optional steady QPS interval + a global
+    ``Retry-After`` hold.
+
+    ``reserve`` hands the caller its launch slot as a *delay to sleep*
+    (0 when clear) and advances the shared schedule, so concurrent
+    workers space themselves without a dedicated feeder thread — this
+    is the clock-disciplined replacement for the old busy-thread
+    ``TokenBucket`` refill loop."""
+
+    def __init__(self, qps: Optional[float] = None):
+        self._interval = 1.0 / float(qps) if qps else 0.0
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._next_free: Optional[float] = None
+        # guarded-by: _lock
+        self._not_before: Optional[float] = None
+        # guarded-by: _lock
+        self._holds = 0
+
+    def reserve(self, now: Optional[float] = None) -> float:
+        """Claim the next launch slot; returns seconds the caller must
+        sleep before sending (0.0 = go now)."""
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            base = now
+            if self._next_free is not None:
+                base = max(base, self._next_free)
+            if self._not_before is not None:
+                base = max(base, self._not_before)
+            self._next_free = base + self._interval
+            return max(0.0, base - now)
+
+    def hold(self, seconds: float, now: Optional[float] = None):
+        """Provider-directed pause (``Retry-After``): nothing launches
+        for ``seconds``.  Holds only ever extend the gate — two 429s
+        racing each other keep the later horizon."""
+        now = time.monotonic() if now is None else float(now)
+        gate = now + max(float(seconds), 0.0)
+        with self._lock:
+            self._holds += 1
+            if self._not_before is None or gate > self._not_before:
+                self._not_before = gate
+
+    def snapshot(self, now: Optional[float] = None) -> Dict:
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            hold_s = 0.0
+            if self._not_before is not None:
+                hold_s = max(0.0, self._not_before - now)
+            return {'interval_s': self._interval,
+                    'hold_remaining_s': round(hold_s, 3),
+                    'holds': self._holds}

@@ -1,0 +1,749 @@
+# oct-lint: clock-discipline
+"""The outbound request scheduler: every API-model row flows through here.
+
+Replaces the old per-call ``ThreadPoolExecutor`` + busy-thread QPS
+bucket + synchronized ``2**attempt`` retry loop with one provider-aware
+machine:
+
+- **bounded adaptive concurrency** — an AIMD window
+  (:class:`~opencompass_tpu.outbound.limits.AimdLimiter`) that backs
+  off on 429/5xx and re-probes on success;
+- **adaptive pacing** — a shared launch schedule that honors
+  ``Retry-After`` globally (:class:`~.limits.Pacer`);
+- **retry budgets + deterministic-jitter backoff + circuit breakers**
+  — the *same* ``RetryBudget`` / ``backoff_delay`` / ``CircuitBreaker``
+  implementations the serve daemon uses
+  (``utils/resilience.py``);
+- **deadline propagation** — an explicit per-call wall budget, or the
+  serve path's ``X-OCT-Deadline-Ms`` remaining budget via
+  ``reqtrace.current_deadline()``;
+- **hedged requests** — a straggling attempt past ``hedge_after_s``
+  launches one budgeted duplicate; first completion wins;
+- **partial-failure scatter-back** — every row ends in exactly one
+  :class:`Outcome`; failures are typed
+  :class:`~opencompass_tpu.outbound.errors.RowFailure` records, and
+  successes are delivered out-of-order through ``on_result`` as they
+  land (the planner's scatter-back contract), so one dead row never
+  unwinds its siblings.
+
+All shared state is lock-guarded (``# guarded-by:``) and every time
+read is injectable (``now=``) — the module is oct-lint
+clock-discipline checked.
+"""
+from __future__ import annotations
+
+import contextvars
+import os
+import threading
+import time
+import weakref
+from typing import Callable, Dict, List, Optional, Sequence
+
+from opencompass_tpu.utils.resilience import (CircuitBreaker,
+                                              CircuitOpenError,
+                                              RetryBudget, backoff_delay)
+
+from .errors import (DeadlineExceeded, PartialFailure, ProviderError,
+                     RateLimited, Rejected, RowFailure, classify)
+from .limits import DEFAULT_MAX_INFLIGHT, AimdLimiter, Pacer
+
+# outbound defaults: attempts per row (the model's `retry + 1` usually
+# overrides), per-attempt HTTP timeout, and the retry backoff envelope
+DEFAULT_MAX_ATTEMPTS = 3
+DEFAULT_REQUEST_TIMEOUT_S = 60.0
+OUTBOUND_BACKOFF_BASE_S = 0.25
+OUTBOUND_BACKOFF_CAP_S = 8.0
+# a 429 without a Retry-After header still holds the launch gate
+DEFAULT_RETRY_AFTER_S = 0.5
+# outbound retry budget: more generous than the serve protocol budget
+# (API blips are common) but still bounded — an incident cannot turn
+# every row into max_attempts requests
+OUTBOUND_RETRY_RATE = 0.5       # tokens/second refill
+OUTBOUND_RETRY_BURST = 8.0
+# a row rides out an open breaker in-run only when the half-open
+# horizon is this close; a longer cooldown sheds the row typed
+# immediately (breaker_open, resumable) — a provider that is DOWN must
+# fail a 1000-row sweep in seconds, not serialize every row through
+# the cooldown
+BREAKER_WAIT_CAP_S = 2.0
+SNAPSHOT_INTERVAL_S = 2.0
+OUTBOUND_SNAPSHOT = 'outbound.json'
+
+# every live scheduler, for cross-provider snapshots (weak: a dropped
+# model must not pin its scheduler forever)
+_REGISTRY_LOCK = threading.Lock()
+# guarded-by: _REGISTRY_LOCK
+_SCHEDULERS: 'weakref.WeakSet' = weakref.WeakSet()
+
+# the running row's absolute (monotonic) deadline, visible to the
+# transport on *scheduler* threads — reqtrace's request context does
+# not cross thread spawns, so the scheduler re-publishes the budget
+# here and ``post_json_once`` forwards the remainder as
+# ``X-OCT-Deadline-Ms`` on the outbound request
+_ROW_DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
+    'oct_outbound_row_deadline', default=None)
+
+
+def current_row_deadline_s(now: Optional[float] = None) \
+        -> Optional[float]:
+    """Remaining seconds of the running outbound row's deadline, when
+    one is active on this thread; None otherwise."""
+    deadline_ts = _ROW_DEADLINE.get()
+    if deadline_ts is None:
+        return None
+    now = time.monotonic() if now is None else float(now)
+    return max(deadline_ts - now, 0.0)
+
+
+class Outcome:
+    """One row's terminal result: either ``value`` (ok) or a typed
+    ``failure``.  Exactly one Outcome exists per submitted row — the
+    zero-silently-lost-rows invariant is structural."""
+    __slots__ = ('index', 'value', 'failure', 'attempts', 'hedged')
+
+    def __init__(self, index: int, value=None,
+                 failure: Optional[RowFailure] = None,
+                 attempts: int = 0, hedged: bool = False):
+        self.index = index
+        self.value = value
+        self.failure = failure
+        self.attempts = attempts
+        self.hedged = hedged
+
+    @property
+    def ok(self) -> bool:
+        return self.failure is None
+
+
+class OutboundReport:
+    """The result of one ``run``: per-row outcomes in submission order
+    plus the scheduler counters measured across THIS run (counter
+    deltas, so a scheduler shared across tasks attributes each task
+    only its own 429s/retries) and the limiter/pacer/breaker state at
+    run end."""
+
+    def __init__(self, outcomes: List[Outcome], provider: str,
+                 wall_s: float, stats: Dict):
+        self.outcomes = outcomes
+        self.provider = provider
+        self.wall_s = wall_s
+        self.stats = stats
+
+    @property
+    def failures(self) -> List[RowFailure]:
+        return [o.failure for o in self.outcomes if o.failure]
+
+    def values(self) -> List:
+        """All row values, raising :class:`PartialFailure` if any row
+        failed — the strict all-or-error contract ``generate`` keeps."""
+        fails = self.failures
+        if fails:
+            raise PartialFailure(fails, len(self.outcomes),
+                                 provider=self.provider)
+        return [o.value for o in self.outcomes]
+
+
+class OutboundScheduler:
+    """Per-provider resilient request scheduler.
+
+    ``run(payloads, call)`` drives every payload through bounded
+    worker threads; ``call(payload, timeout_s)`` performs ONE attempt
+    and raises typed :class:`ProviderError`\\ s (models supply
+    ``post_json_once``-backed callables).  The scheduler owns retries,
+    pacing, breaker routing, hedging, and deadline math.
+    """
+
+    def __init__(self, provider: str,
+                 max_inflight: int = DEFAULT_MAX_INFLIGHT,
+                 qps: Optional[float] = None,
+                 max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+                 request_timeout_s: float = DEFAULT_REQUEST_TIMEOUT_S,
+                 hedge_after_s: Optional[float] = None,
+                 retry_budget: Optional[RetryBudget] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 limiter: Optional[AimdLimiter] = None,
+                 pacer: Optional[Pacer] = None,
+                 sleep: Optional[Callable[[float], None]] = None):
+        self.provider = provider or 'api'
+        self.max_attempts = max(int(max_attempts), 1)
+        self.request_timeout_s = float(request_timeout_s)
+        self.hedge_after_s = hedge_after_s
+        self.limiter = limiter or AimdLimiter(max_limit=max_inflight)
+        self.pacer = pacer or Pacer(qps=qps)
+        self.budget = retry_budget or RetryBudget(
+            rate=OUTBOUND_RETRY_RATE, burst=OUTBOUND_RETRY_BURST)
+        self.breaker = breaker or CircuitBreaker(self.provider)
+        self._sleep = sleep or time.sleep
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._counters: Dict[str, int] = {
+            'rows_total': 0, 'ok_total': 0, 'failed_total': 0,
+            'attempts_total': 0, 'retries_total': 0,
+            'retry_budget_refusals': 0, 'http_429_total': 0,
+            'http_5xx_total': 0, 'hedges_total': 0,
+            'hedge_wins_total': 0, 'breaker_opens_total': 0,
+            'breaker_sheds_total': 0, 'deadline_failures_total': 0,
+        }
+        # guarded-by: _lock — rolling launch timestamps for the
+        # measured-qps gauge
+        self._launch_ts: List[float] = []
+        # guarded-by: _lock
+        self._last_event_ts: Optional[float] = None
+        # guarded-by: _lock
+        self._last_snapshot_ts: Optional[float] = None
+        with _REGISTRY_LOCK:
+            _SCHEDULERS.add(self)
+
+    # -- public API ---------------------------------------------------------
+
+    def run(self, payloads: Sequence, call: Callable,
+            on_result: Optional[Callable[[int, object], None]] = None,
+            deadline_s: Optional[float] = None,
+            fail_fast: bool = True) -> OutboundReport:
+        """Drive every payload to a terminal :class:`Outcome`.
+
+        ``on_result(index, value)`` fires per successful row, from
+        scheduler threads, in completion order — the scatter-back
+        hook.  ``deadline_s`` bounds the whole run's wall clock; when
+        None and a serve-path request deadline is active
+        (``X-OCT-Deadline-Ms``), the remaining budget is inherited.
+        ``fail_fast`` stops admitting new rows once a non-retryable
+        (rejected) failure proves the endpoint dead — in-flight rows
+        drain, queued rows fail typed ``aborted``."""
+        t0 = time.monotonic()
+        if deadline_s is None:
+            deadline_s = serve_deadline_remaining_s()
+        deadline_ts = None if deadline_s is None \
+            else t0 + max(float(deadline_s), 0.0)
+        with self._lock:
+            self._counters['rows_total'] += len(payloads)
+            counters_at_start = dict(self._counters)
+        outcomes: List[Optional[Outcome]] = [None] * len(payloads)
+        order = list(range(len(payloads)))
+        state = {'next': 0, 'fatal': None}
+        state_lock = threading.Lock()
+
+        def worker():
+            while True:
+                with state_lock:
+                    if state['next'] >= len(order):
+                        return
+                    i = order[state['next']]
+                    state['next'] += 1
+                    fatal = state['fatal']
+                if fatal is not None:
+                    # fail-fast drain: the endpoint is provably dead
+                    # (auth/validation) — queued rows become typed,
+                    # resumable failures instead of more requests
+                    outcomes[i] = Outcome(i, failure=RowFailure(
+                        index=i, kind='aborted',
+                        error=f'aborted after fatal sibling failure: '
+                              f'{fatal}',
+                        attempts=0, elapsed_s=0.0,
+                        provider=self.provider))
+                    continue
+                outcome = self._run_row(i, payloads[i], call,
+                                        deadline_ts, state, state_lock,
+                                        fail_fast)
+                outcomes[i] = outcome
+                if outcome.ok and on_result is not None:
+                    try:
+                        on_result(i, outcome.value)
+                    except Exception as exc:   # noqa: BLE001
+                        # a broken collector (disk full on the flush,
+                        # a bug in the save hook) means this row was
+                        # NOT persisted: it must surface as a typed
+                        # failure — an ok outcome here would finalize
+                        # the task with the row silently missing
+                        outcomes[i] = Outcome(i, failure=RowFailure(
+                            index=i, kind='collector_error',
+                            error=f'result collector failed: {exc}',
+                            attempts=outcome.attempts, elapsed_s=0.0,
+                            provider=self.provider),
+                            attempts=outcome.attempts)
+                        with state_lock:
+                            if state['fatal'] is None:
+                                state['fatal'] = exc
+
+        n_threads = max(1, min(len(payloads), self.limiter.max_limit))
+        threads = [threading.Thread(target=worker,
+                                    name=f'outbound-{self.provider}-{k}',
+                                    daemon=True)
+                   for k in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ok = sum(1 for o in outcomes if o is not None and o.ok)
+        with self._lock:
+            self._counters['ok_total'] += ok
+            self._counters['failed_total'] += len(payloads) - ok
+        self._publish(force=True)
+        run_stats = self.stats()
+        for key, start in counters_at_start.items():
+            if isinstance(run_stats.get(key), int):
+                run_stats[key] -= start
+        run_stats['rows_total'] = len(payloads)
+        return OutboundReport(
+            [o if o is not None else Outcome(i, failure=RowFailure(
+                index=i, kind='aborted', error='row never scheduled',
+                attempts=0, elapsed_s=0.0, provider=self.provider))
+             for i, o in enumerate(outcomes)],
+            self.provider, time.monotonic() - t0, run_stats)
+
+    def stats(self, now: Optional[float] = None) -> Dict:
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            counters = dict(self._counters)
+            cutoff = now - 10.0
+            self._launch_ts = [t for t in self._launch_ts
+                               if t >= cutoff]
+            qps = len(self._launch_ts) / 10.0
+        out = dict(counters)
+        out['provider'] = self.provider
+        out['measured_qps'] = round(qps, 2)
+        out['limiter'] = self.limiter.snapshot()
+        out['pacer'] = self.pacer.snapshot(now=now)
+        out['breaker'] = self.breaker.snapshot(now=now)
+        return out
+
+    # -- row state machine --------------------------------------------------
+
+    def _run_row(self, i: int, payload, call, deadline_ts, state,
+                 state_lock, fail_fast: bool = True) -> Outcome:
+        t_row = time.monotonic()
+        attempts = 0
+        hedged = False
+        last_err: Optional[ProviderError] = None
+        while True:
+            now = time.monotonic()
+            remaining = None if deadline_ts is None \
+                else deadline_ts - now
+            if remaining is not None and remaining <= 0:
+                self._count('deadline_failures_total')
+                detail = f' (last error: {last_err})' if last_err else ''
+                return self._failure(
+                    i, 'deadline_exceeded', attempts, t_row,
+                    f'deadline exhausted after {attempts} '
+                    f'attempt(s){detail}')
+            if attempts >= self.max_attempts:
+                return self._failure(
+                    i, last_err.kind if last_err else 'provider_error',
+                    attempts, t_row,
+                    f'request failed after {attempts} attempts: '
+                    f'{last_err}')
+            attempts += 1
+            self._count('attempts_total')
+            # breaker gate: a near half-open horizon (short cooldown)
+            # is ridden out in-run so the probe can recover the sweep;
+            # a far one sheds the row typed IMMEDIATELY — failed rows
+            # are resumable records, and a dead endpoint must fail the
+            # sweep in seconds, not serialize rows through cooldowns
+            try:
+                self.breaker.allow()
+            except CircuitOpenError as exc:
+                last_err = last_err or ProviderError(str(exc))
+                wait = exc.retry_after_s
+                if wait > BREAKER_WAIT_CAP_S \
+                        or (remaining is not None
+                            and wait >= remaining) \
+                        or attempts >= self.max_attempts:
+                    # counted only when the row is actually shed —
+                    # riding out a short cooldown is not a shed
+                    self._count('breaker_sheds_total')
+                    return self._failure(
+                        i, 'breaker_open', attempts, t_row,
+                        f'request failed after {attempts} attempts: '
+                        f'{exc}')
+                self._sleep(wait)
+                continue
+            if not self.limiter.acquire(timeout=remaining):
+                self._count('deadline_failures_total')
+                return self._failure(
+                    i, 'deadline_exceeded', attempts, t_row,
+                    f'deadline exhausted waiting for an in-flight '
+                    f'slot after {attempts - 1} attempt(s)')
+            err: Optional[ProviderError] = None
+            # the acquired slot is released exactly once, in the
+            # finally — UNLESS _call_hedged handed ownership to an
+            # abandoned still-in-flight attempt (its request keeps the
+            # slot until it actually ends, so true concurrency never
+            # exceeds the AIMD window)
+            slot = {'caller_releases': True}
+            try:
+                delay = self.pacer.reserve()
+                if delay > 0:
+                    if remaining is not None \
+                            and delay >= remaining:
+                        self._count('deadline_failures_total')
+                        return self._failure(
+                            i, 'deadline_exceeded', attempts, t_row,
+                            f'deadline exhausted in the pacing queue '
+                            f'(hold {delay:.2f}s)')
+                    self._sleep(delay)
+                with self._lock:
+                    self._launch_ts.append(time.monotonic())
+                timeout = self.request_timeout_s
+                if deadline_ts is not None:
+                    timeout = max(0.05,
+                                  min(timeout,
+                                      deadline_ts - time.monotonic()))
+                value, row_hedged = self._call_hedged(
+                    payload, call, timeout, deadline_ts, slot)
+                hedged = hedged or row_hedged
+            except BaseException as exc:   # noqa: BLE001 — classified
+                err = classify(exc)
+            finally:
+                if slot['caller_releases']:
+                    self.limiter.release()
+            if err is None:
+                self.limiter.on_success()
+                self.breaker.note_success()
+                self._publish()
+                return Outcome(i, value=value, attempts=attempts,
+                               hedged=hedged)
+            last_err = err
+            verdict = self._note_error(err)
+            if not err.retryable:
+                if fail_fast and isinstance(err, Rejected):
+                    with state_lock:
+                        if state['fatal'] is None:
+                            state['fatal'] = err
+                kind = err.kind
+                return self._failure(
+                    i, kind, attempts, t_row,
+                    f'request failed after {attempts} attempts: {err}')
+            if attempts >= self.max_attempts:
+                continue   # the loop head renders the terminal failure
+            if not self.budget.take(self.provider):
+                self._count('retry_budget_refusals')
+                return self._failure(
+                    i, err.kind, attempts, t_row,
+                    f'retry budget exhausted after {attempts} '
+                    f'attempt(s): {err}')
+            self._count('retries_total')
+            delay = backoff_delay(f'{self.provider}#{i}', attempts - 1,
+                                  base_s=OUTBOUND_BACKOFF_BASE_S,
+                                  cap_s=OUTBOUND_BACKOFF_CAP_S)
+            if verdict is not None:
+                delay = max(delay, verdict)
+            if remaining is not None:
+                now = time.monotonic()
+                if deadline_ts - now <= delay:
+                    self._count('deadline_failures_total')
+                    return self._failure(
+                        i, 'deadline_exceeded', attempts, t_row,
+                        f'deadline exhausted before retry '
+                        f'{attempts + 1} (backoff {delay:.2f}s, '
+                        f'last error: {err})')
+            self._sleep(delay)
+
+    def _note_error(self, err: ProviderError) -> Optional[float]:
+        """Fold one typed failure into the adaptive state; returns a
+        minimum backoff the provider demanded (Retry-After), if any."""
+        if isinstance(err, RateLimited):
+            self._count('http_429_total')
+            self.limiter.on_throttle()
+            hold = err.retry_after_s if err.retry_after_s is not None \
+                else DEFAULT_RETRY_AFTER_S
+            self.pacer.hold(hold)
+            self._event('outbound_throttled',
+                        retry_after_s=err.retry_after_s)
+            return hold
+        if isinstance(err, DeadlineExceeded):
+            self._count('deadline_failures_total')
+            return None
+        if isinstance(err, Rejected) or err.kind == 'internal':
+            # client-side causes: neither breaker evidence nor a
+            # pacing signal
+            return None
+        # server_error / network / stall / malformed: provider-fault
+        # family — breaker evidence, and 5xx also backs off the window
+        if err.kind == 'server_error':
+            self._count('http_5xx_total')
+            self.limiter.on_throttle()
+        opened = self.breaker.note_failure(str(err))
+        if opened:
+            self._count('breaker_opens_total')
+            self._event('outbound_breaker_open', error=str(err)[:200],
+                        force=True)
+        return err.retry_after_s
+
+    def _failure(self, i: int, kind: str, attempts: int, t_row: float,
+                 error: str) -> Outcome:
+        failure = RowFailure(index=i, kind=kind, error=error,
+                             attempts=attempts,
+                             elapsed_s=time.monotonic() - t_row,
+                             provider=self.provider)
+        self._publish()
+        return Outcome(i, failure=failure, attempts=attempts)
+
+    # -- hedging ------------------------------------------------------------
+
+    def _call_hedged(self, payload, call, timeout: float,
+                     deadline_ts: Optional[float], slot: Dict):
+        """One logical request, optionally hedged: when the primary
+        attempt is still in flight after ``hedge_after_s`` and both a
+        spare in-flight slot and a retry-budget token exist, a
+        duplicate launches; the first completion wins.  A loser is
+        abandoned to its timeout (urllib cannot be cancelled) but
+        keeps holding its in-flight slot until its request actually
+        ends: the primary rides the caller's slot (ownership handed
+        over via ``slot['caller_releases']``), the hedge owns the one
+        it acquired — so true concurrency never exceeds the AIMD
+        window."""
+        if self.hedge_after_s is None:
+            return self._call_one(payload, call, timeout,
+                                  deadline_ts), False
+        cond = threading.Condition()
+        # (is_hedge, ok, value_or_exc) per finished attempt and the
+        # primary-slot transfer flag, all mutated under cond
+        results: List = []
+        launched = [1]
+        transfer = [False]
+
+        def attempt(is_hedge: bool):
+            try:
+                res = (is_hedge, True,
+                       self._call_one(payload, call, timeout,
+                                      deadline_ts))
+            except BaseException as exc:   # noqa: BLE001
+                res = (is_hedge, False, exc)
+            finally:
+                if is_hedge:
+                    self.limiter.release()
+            with cond:
+                results.append(res)
+                cond.notify_all()
+                if not is_hedge and transfer[0]:
+                    # the row's caller already moved on: the abandoned
+                    # primary owns the row slot, and its request just
+                    # ended — free it now
+                    self.limiter.release()
+
+        threading.Thread(target=attempt, args=(False,),
+                         name=f'outbound-{self.provider}-primary',
+                         daemon=True).start()
+        with cond:
+            cond.wait_for(lambda: bool(results),
+                          timeout=self.hedge_after_s)
+            straggling = not results
+        if straggling and self.limiter.acquire(timeout=0):
+            if self.budget.take(self.provider):
+                self._count('hedges_total')
+                delay = self.pacer.reserve()
+                if delay > 0:
+                    self._sleep(delay)
+                launched[0] = 2
+                threading.Thread(
+                    target=attempt, args=(True,),
+                    name=f'outbound-{self.provider}-hedge',
+                    daemon=True).start()
+            else:
+                self.limiter.release()
+
+        def finish(result=None, error=None):
+            # one exit point: if the primary is still in flight, hand
+            # it the row slot before the caller's finally would free it
+            if not any(not h for h, _, _ in results):
+                transfer[0] = True
+                slot['caller_releases'] = False
+            if error is not None:
+                raise error
+            return result
+
+        with cond:
+            done = cond.wait_for(
+                lambda: any(ok for _, ok, _ in results)
+                or len(results) >= launched[0],
+                timeout=timeout + 5.0)
+            if not done and not results:
+                from .errors import StallError
+                return finish(error=StallError(
+                    f'request stalled past {timeout:.0f}s '
+                    '(hedge included)'))
+            for is_hedge, ok, res in results:
+                if ok:
+                    if is_hedge:
+                        # exact accounting: credited only when the
+                        # hedge attempt actually produced the result
+                        self._count('hedge_wins_total')
+                    return finish(result=(res, is_hedge))
+            return finish(error=results[0][2])
+
+    @staticmethod
+    def _call_one(payload, call, timeout: float,
+                  deadline_ts: Optional[float]):
+        """One transport attempt with the row deadline published on
+        THIS thread (hedge helpers included), so ``post_json_once``
+        forwards the remaining budget outbound."""
+        if deadline_ts is None:
+            return call(payload, timeout)
+        token = _ROW_DEADLINE.set(deadline_ts)
+        try:
+            return call(payload, timeout)
+        finally:
+            _ROW_DEADLINE.reset(token)
+
+    # -- telemetry ----------------------------------------------------------
+
+    def _count(self, key: str, n: int = 1):
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + n
+
+    def _event(self, name: str, force: bool = False, **attrs):
+        """Structured obs event, rate-limited to one per 5s per
+        scheduler unless ``force`` (breaker transitions always
+        land)."""
+        try:
+            now = time.monotonic()
+            if not force:
+                with self._lock:
+                    last = self._last_event_ts
+                    if last is not None and now - last < 5.0:
+                        return
+                    self._last_event_ts = now
+            from opencompass_tpu.obs import get_tracer
+            tracer = get_tracer()
+            if tracer.enabled:
+                tracer.event(name, provider=self.provider,
+                             **{k: v for k, v in attrs.items()
+                                if v is not None})
+        except Exception:   # noqa: BLE001 — never-fail telemetry
+            pass
+
+    def _publish(self, force: bool = False, now: Optional[float] = None):
+        """Push the outbound family onto the metrics registry and the
+        durable ``outbound.json`` snapshot, rate-limited."""
+        mono = time.monotonic()
+        with self._lock:
+            last = self._last_snapshot_ts
+            if not force and last is not None \
+                    and mono - last < SNAPSHOT_INTERVAL_S:
+                return
+            self._last_snapshot_ts = mono
+        try:
+            self._publish_metrics()
+        except Exception:   # noqa: BLE001 — never-fail telemetry
+            pass
+        try:
+            publish_snapshot(now=now)
+        except Exception:   # noqa: BLE001 — never-fail telemetry
+            pass
+
+    def _publish_metrics(self):
+        from opencompass_tpu.obs import get_tracer
+        from opencompass_tpu.obs.metrics import labeled
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        stats = self.stats()
+        reg = tracer.metrics
+        label = {'provider': self.provider}
+        reg.gauge(labeled('oct_outbound_inflight', **label)).set(
+            stats['limiter']['inflight'])
+        reg.gauge(labeled('oct_outbound_limit', **label)).set(
+            stats['limiter']['limit'])
+        reg.gauge(labeled('oct_outbound_qps', **label)).set(
+            stats['measured_qps'])
+        breaker_state = {'closed': 0, 'open': 1,
+                         'half_open': 2}.get(
+                             stats['breaker']['state'], 0)
+        reg.gauge(labeled('oct_outbound_breaker_state',
+                          **label)).set(breaker_state)
+        for key in ('http_429_total', 'retries_total', 'hedges_total',
+                    'attempts_total', 'failed_total'):
+            reg.gauge(labeled(f'oct_outbound_{key}', **label)).set(
+                stats[key])
+
+
+# -- cross-scheduler snapshot ------------------------------------------------
+
+def all_stats() -> Dict[str, Dict]:
+    """Current stats for every live scheduler, keyed by provider
+    (same-provider schedulers fold by max-counter wins)."""
+    with _REGISTRY_LOCK:
+        schedulers = list(_SCHEDULERS)
+    out: Dict[str, Dict] = {}
+    for sched in schedulers:
+        try:
+            stats = sched.stats()
+        except Exception:   # noqa: BLE001
+            continue
+        prev = out.get(sched.provider)
+        if prev is None or stats.get('attempts_total', 0) \
+                >= prev.get('attempts_total', 0):
+            out[sched.provider] = stats
+    return out
+
+
+def snapshot_dirs() -> List[str]:
+    """Where the durable outbound snapshot lands: the live tracer's
+    obs dir (batch runs), plus the serve obs dir when a cache root is
+    in the environment (daemon / worker context)."""
+    dirs: List[str] = []
+    try:
+        from opencompass_tpu.obs import get_tracer
+        tracer = get_tracer()
+        if tracer.enabled and getattr(tracer, 'obs_dir', None):
+            dirs.append(tracer.obs_dir)
+    except Exception:   # noqa: BLE001
+        pass
+    cache_root = os.environ.get('OCT_CACHE_ROOT')
+    if cache_root:
+        try:
+            from opencompass_tpu.obs.reqtrace import serve_obs_dir
+            serve_dir = serve_obs_dir(cache_root)
+            if os.path.isdir(serve_dir):
+                dirs.append(serve_dir)
+        except Exception:   # noqa: BLE001
+            pass
+    return dirs
+
+
+def publish_snapshot(now: Optional[float] = None) -> Optional[Dict]:
+    """Write the cross-provider snapshot (``outbound.json``) wherever
+    observers look — ``cli top``'s outbound pane and ``cli doctor``'s
+    ``api_throttled`` rule read this file, dead process or live."""
+    providers = all_stats()
+    if not providers:
+        return None
+    snap = {'v': 1,
+            'ts': time.time() if now is None else float(now),
+            'pid': os.getpid(),
+            'providers': providers}
+    from opencompass_tpu.utils.fileio import atomic_write_json
+    for dirpath in snapshot_dirs():
+        try:
+            atomic_write_json(
+                os.path.join(dirpath, OUTBOUND_SNAPSHOT), snap,
+                dump_kwargs={'indent': 2, 'default': str})
+        except Exception:   # noqa: BLE001 — never-fail telemetry
+            pass
+    return snap
+
+
+def read_outbound(dirpath: str) -> Optional[Dict]:
+    """Load a durable outbound snapshot; None when absent/torn."""
+    import json
+    try:
+        with open(os.path.join(dirpath, OUTBOUND_SNAPSHOT),
+                  encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def serve_deadline_remaining_s() -> Optional[float]:
+    """The serve path's remaining request budget, when this call is
+    running under an ``X-OCT-Deadline-Ms`` request context — the ONE
+    lookup both the scheduler's run-deadline inheritance and
+    ``post_json_once``'s header forwarding share."""
+    try:
+        from opencompass_tpu.obs.reqtrace import current_deadline
+        deadline = current_deadline()
+        if deadline is None:
+            return None
+        return max(deadline.remaining_s(), 0.0)
+    except Exception:   # noqa: BLE001
+        return None

@@ -1,0 +1,323 @@
+"""Device-free fault-injecting local API provider.
+
+A real (loopback) OpenAI-compatible HTTP server whose behavior is a
+set of thread-safe knobs: injected 429 bursts with ``Retry-After``
+headers, hard 500s, auth 401s, stalls, malformed JSON bodies,
+per-request latency, and content-targeted failures (``fail_marker``)
+for partial-failure drills.  Responses are **deterministic functions
+of the prompt**, so convergence checks ("the resumed rerun is
+bit-identical to a clean run") are exact.
+
+This is the substrate under the outbound scheduler's tests, the
+``cli chaos`` ``flaky_api`` scenario, and the ``bench.py --outbound``
+leg — the same role ``FakeModel`` plays for the device path.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+
+def canned_text(prompt: str) -> str:
+    """The stub's deterministic completion for a prompt."""
+    digest = hashlib.sha256(str(prompt).encode()).hexdigest()[:8]
+    return f'ok[{digest}] {str(prompt)[:48]}'
+
+
+def _prompt_of(body: Dict) -> str:
+    if isinstance(body.get('messages'), list):
+        users = [m.get('content', '') for m in body['messages']
+                 if isinstance(m, dict)]
+        return users[-1] if users else ''
+    return str(body.get('prompt', ''))
+
+
+class StubProvider:
+    """One loopback provider with scriptable faults.
+
+    Knobs (all thread-safe, liftable mid-flight):
+
+    - ``set_latency(s)``: per-request service time.
+    - ``queue_429(n, retry_after_s)``: the next ``n`` requests answer
+      429 (with a ``Retry-After`` header when given).
+    - ``set_429_every(k, retry_after_s)``: every ``k``-th request
+      answers 429 — the steady throttle mix for bench sweeps.
+    - ``set_mode(m)``: ``None`` (healthy) | ``'500'`` | ``'401'`` |
+      ``'stall'`` | ``'malformed'``.
+    - ``set_fail_marker(substr)``: requests whose prompt contains
+      ``substr`` answer 500 — row-targeted partial failure.
+    """
+
+    def __init__(self, latency_s: float = 0.0, stall_s: float = 30.0):
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._latency_s = float(latency_s)
+        # guarded-by: _lock
+        self._stall_s = float(stall_s)
+        # guarded-by: _lock
+        self._queued_429 = 0
+        # guarded-by: _lock
+        self._retry_after_s: Optional[float] = None
+        # guarded-by: _lock
+        self._every_429 = 0
+        # guarded-by: _lock
+        self._mode: Optional[str] = None
+        # guarded-by: _lock  (bumped on every set_mode — stalled
+        # handlers re-check it so lifting the fault frees them)
+        self._mode_gen = 0
+        # guarded-by: _lock
+        self._fail_marker: Optional[str] = None
+        # guarded-by: _lock
+        self._queued_stall = 0
+        # guarded-by: _lock
+        self._inflight = 0
+        # guarded-by: _lock
+        self._counters = {'requests_total': 0, 'http_429': 0,
+                          'http_500': 0, 'http_401': 0, 'stalls': 0,
+                          'malformed': 0, 'ok': 0,
+                          'max_concurrent': 0}
+        # guarded-by: _lock
+        self._log: List[Dict] = []
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> 'StubProvider':
+        provider = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                try:
+                    provider._handle(self)
+                except (ConnectionError, OSError):
+                    # a stalled/slow handler answering a client that
+                    # already timed out — the drill, not a bug
+                    self.close_connection = True
+
+        class Server(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                pass   # same: dead-client noise stays off stderr
+
+        self._server = Server(('127.0.0.1', 0), Handler)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name='outbound-stub-provider', daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f'http://127.0.0.1:{self.port}'
+
+    @property
+    def chat_url(self) -> str:
+        return self.url + '/v1/chat/completions'
+
+    @property
+    def completions_url(self) -> str:
+        return self.url + '/v1/completions'
+
+    # -- knobs --------------------------------------------------------------
+
+    def set_latency(self, seconds: float):
+        with self._lock:
+            self._latency_s = float(seconds)
+
+    def queue_429(self, n: int, retry_after_s: Optional[float] = None):
+        with self._lock:
+            self._queued_429 += int(n)
+            self._retry_after_s = retry_after_s
+
+    def set_429_every(self, k: int,
+                      retry_after_s: Optional[float] = None):
+        with self._lock:
+            self._every_429 = int(k)
+            self._retry_after_s = retry_after_s
+
+    def set_mode(self, mode: Optional[str]):
+        assert mode in (None, '500', '401', 'stall', 'malformed')
+        with self._lock:
+            self._mode = mode
+            self._mode_gen += 1
+
+    def set_stall_s(self, seconds: float):
+        with self._lock:
+            self._stall_s = float(seconds)
+
+    def set_fail_marker(self, marker: Optional[str]):
+        with self._lock:
+            self._fail_marker = marker
+
+    def queue_stall(self, n: int):
+        """The next ``n`` requests stall (straggler injection — the
+        hedging drill's targeted variant of ``set_mode('stall')``)."""
+        with self._lock:
+            self._queued_stall += int(n)
+
+    # -- introspection ------------------------------------------------------
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return dict(self._counters, inflight=self._inflight)
+
+    def log(self) -> List[Dict]:
+        with self._lock:
+            return list(self._log)
+
+    def reset_stats(self):
+        with self._lock:
+            for key in self._counters:
+                self._counters[key] = 0
+            self._log.clear()
+
+    # -- request handling ---------------------------------------------------
+
+    def _decide(self, prompt: str):
+        """One atomic admission decision: (status, retry_after, mode,
+        mode_gen) — and the counters/log update that goes with it."""
+        with self._lock:
+            self._counters['requests_total'] += 1
+            self._inflight += 1
+            self._counters['max_concurrent'] = max(
+                self._counters['max_concurrent'], self._inflight)
+            n_req = self._counters['requests_total']
+            mode, gen = self._mode, self._mode_gen
+            retry_after = self._retry_after_s
+            status = 200
+            if self._queued_429 > 0:
+                self._queued_429 -= 1
+                status = 429
+            elif self._every_429 and n_req % self._every_429 == 0:
+                status = 429
+            elif self._fail_marker and self._fail_marker in prompt:
+                status = 500
+                mode = None
+            elif mode == '500':
+                status = 500
+            elif mode == '401':
+                status = 401
+            if status == 429:
+                self._counters['http_429'] += 1
+            elif status == 500:
+                self._counters['http_500'] += 1
+            elif status == 401:
+                self._counters['http_401'] += 1
+            stall = status == 200 and mode == 'stall'
+            if status == 200 and self._queued_stall > 0:
+                self._queued_stall -= 1
+                stall = True
+            return (status, retry_after, mode, gen,
+                    self._latency_s, self._stall_s, stall)
+
+    def _mode_still(self, gen: int) -> bool:
+        with self._lock:
+            return self._mode_gen == gen
+
+    def _handle(self, handler: BaseHTTPRequestHandler):
+        t_in = time.monotonic()
+        try:
+            length = int(handler.headers.get('Content-Length') or 0)
+            try:
+                body = json.loads(handler.rfile.read(length) or b'{}')
+            except ValueError:
+                body = {}
+            prompt = _prompt_of(body)
+            (status, retry_after, mode, gen, latency, stall_s,
+             stall) = self._decide(prompt)
+            if latency:
+                time.sleep(latency)
+            if stall:
+                with self._lock:
+                    self._counters['stalls'] += 1
+                # sliced sleep: lifting the fault (set_mode) frees
+                # already-stalled handlers, like a provider recovering
+                waited = 0.0
+                while waited < stall_s and self._mode_still(gen):
+                    time.sleep(0.05)
+                    waited += 0.05
+                if waited >= stall_s:
+                    # never answered — the client's timeout fires
+                    return
+            payload, sent = self._respond(handler, status, retry_after,
+                                          mode, body, prompt)
+            self._log_request(handler, prompt, sent, t_in)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def _respond(self, handler, status, retry_after, mode, body,
+                 prompt):
+        if status != 200:
+            payload = json.dumps(
+                {'error': {'type': {429: 'rate_limited',
+                                    500: 'server_error',
+                                    401: 'auth'}[status],
+                           'message': f'injected {status}'}}).encode()
+            handler.send_response(status)
+            if status == 429 and retry_after is not None:
+                handler.send_header('Retry-After', str(retry_after))
+            handler.send_header('Content-Type', 'application/json')
+            handler.send_header('Content-Length', str(len(payload)))
+            handler.end_headers()
+            handler.wfile.write(payload)
+            return None, status
+        if mode == 'malformed':
+            with self._lock:
+                self._counters['malformed'] += 1
+            payload = b'{"choices": [ {"truncated'
+            handler.send_response(200)
+            handler.send_header('Content-Type', 'application/json')
+            handler.send_header('Content-Length', str(len(payload)))
+            handler.end_headers()
+            handler.wfile.write(payload)
+            return None, 200
+        with self._lock:
+            self._counters['ok'] += 1
+        text = canned_text(prompt)
+        if isinstance(body.get('messages'), list):
+            out = {'choices': [{'message': {'content': text}}]}
+        elif body.get('echo'):
+            # CompletionsAPI.get_ppl: deterministic echoed logprobs
+            n = max(len(str(prompt).split()), 1)
+            out = {'choices': [{'logprobs': {'token_logprobs':
+                   [None] + [-1.0] * min(n, 8)}}]}
+        else:
+            out = {'choices': [{'text': text}]}
+        payload = json.dumps(out).encode()
+        handler.send_response(200)
+        handler.send_header('Content-Type', 'application/json')
+        handler.send_header('Content-Length', str(len(payload)))
+        handler.end_headers()
+        handler.wfile.write(payload)
+        return out, 200
+
+    def _log_request(self, handler, prompt, status, t_in):
+        with self._lock:
+            self._log.append({
+                't': t_in,
+                'status': status,
+                'prompt': str(prompt)[:120],
+                'deadline_ms':
+                    handler.headers.get('X-OCT-Deadline-Ms'),
+            })

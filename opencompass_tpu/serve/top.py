@@ -141,6 +141,14 @@ def gather(cache_root: str,
                 }
             except Exception:
                 pass
+    # outbound pane: the API scheduler's durable snapshot (written by
+    # any process whose API traffic ran under this cache root) — the
+    # provider-side throttle/breaker story, dead daemon or live
+    try:
+        from opencompass_tpu.outbound import read_outbound
+        snap['outbound'] = read_outbound(obs_root)
+    except Exception:
+        snap['outbound'] = None
     snap['requests'] = reqtrace.tail_requests(
         osp.join(obs_root, reqtrace.REQUESTS_FILE),
         window_s=window_s, now=snap['ts'])
@@ -281,6 +289,30 @@ def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
             bits.append(f'breaker {key[:12]} {state}{detail}')
         lines.append((f'overload:{src} ' + '  '.join(bits))
                      if bits else f'overload:{src} none')
+
+    # outbound pane: the API scheduler's provider-side story (AIMD
+    # window vs configured ceiling, 429/retry/hedge counts, provider
+    # breaker) from the durable outbound.json — "(from files)" always:
+    # the writer is whichever process last ran API traffic
+    providers = (snap.get('outbound') or {}).get('providers') or {}
+    for name, ob in sorted(providers.items()):
+        limiter = ob.get('limiter') or {}
+        bits = [f'window {limiter.get("limit", "?")}/'
+                f'{limiter.get("max_limit", "?")}']
+        if ob.get('measured_qps'):
+            bits.append(f'{ob["measured_qps"]:.1f} req/s')
+        bits.append(f'429 {ob.get("http_429_total", 0)}')
+        bits.append(f'retries {ob.get("retries_total", 0)}')
+        if ob.get('hedges_total'):
+            bits.append(f'hedges {ob["hedges_total"]} '
+                        f'({ob.get("hedge_wins_total", 0)} won)')
+        ob_breaker = ob.get('breaker') or {}
+        if ob_breaker.get('state') and ob_breaker['state'] != 'closed':
+            bits.append(f'breaker {ob_breaker["state"].upper()} '
+                        f'(opened {ob_breaker.get("opens", 0)}x)')
+        if ob.get('failed_total'):
+            bits.append(f'failed_rows {ob["failed_total"]}')
+        lines.append(f'outbound[{name[:24]}]: ' + '  '.join(bits))
 
     stats = snap.get('stats') or {}
     comp = stats.get('completions') or {}

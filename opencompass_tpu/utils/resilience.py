@@ -1,0 +1,233 @@
+"""Shared resilience primitives: retry budgets, backoff, circuit breakers.
+
+One implementation serves both traffic directions.  The **inbound**
+plane (``serve/scheduler.py``) bounds worker-protocol retries and
+routes leases around flapping residents; the **outbound** plane
+(``outbound/scheduler.py``) bounds API-provider retries and sheds
+around a crash-looping endpoint.  Keeping the state machines here —
+not copy-pasted per plane — is what makes "3 failures/60s opens, one
+half-open probe closes" mean the same thing everywhere an operator
+reads it.
+
+Everything is clock-injected (``now=``) and lock-guarded; the serve
+and outbound planes both gate on these in tier-1 tests under fully
+deterministic clocks.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+# circuit-breaker defaults: N protocol failures inside the window open
+# the circuit; after the cooldown one half-open probe is let through
+BREAKER_FAILURES = 3
+BREAKER_WINDOW_S = 60.0
+BREAKER_COOLDOWN_S = 15.0
+
+# retry-budget defaults: a token bucket per key — retries draw a
+# token each, the bucket refills slowly, and an exhausted bucket stops
+# retries from amplifying load during an incident
+RETRY_BUDGET_RATE = 0.1      # tokens/second refill
+RETRY_BUDGET_BURST = 3.0     # bucket capacity
+RETRY_MAX_ATTEMPTS = 2       # retries per request, budget permitting
+RETRY_BACKOFF_BASE_S = 0.1
+RETRY_BACKOFF_CAP_S = 2.0
+
+
+def backoff_delay(key: str, attempt: int,
+                  base_s: float = RETRY_BACKOFF_BASE_S,
+                  cap_s: float = RETRY_BACKOFF_CAP_S) -> float:
+    """Exponential backoff with *deterministic injected jitter*: the
+    jitter factor in [0.5, 1.0) derives from a stable hash of
+    ``(key, attempt)`` — retries still decorrelate across models and
+    attempts (no thundering herd), but a test (and a recorded
+    incident) replays the exact same delays."""
+    raw = min(cap_s, base_s * (2 ** max(int(attempt), 0)))
+    digest = hashlib.sha256(f'{key}:{attempt}'.encode()).digest()
+    frac = int.from_bytes(digest[:4], 'big') / 0xFFFFFFFF
+    return raw * (0.5 + 0.5 * frac)
+
+
+class RetryBudget:
+    """Per-key token buckets bounding protocol retries.
+
+    ``take(key)`` spends one token when available; an empty bucket
+    refuses — the caller surfaces the original failure instead of
+    piling retry load onto an already-failing fleet.  Refill is
+    continuous (``rate`` tokens/second up to ``burst``), evaluated
+    lazily under an injected clock."""
+
+    def __init__(self, rate: float = RETRY_BUDGET_RATE,
+                 burst: float = RETRY_BUDGET_BURST):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._buckets: Dict[str, Tuple[float, float]] = {}
+
+    def take(self, key: str, now: Optional[float] = None) -> bool:
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            tokens, last = self._buckets.get(key, (self.burst, now))
+            tokens = min(self.burst, tokens + (now - last) * self.rate)
+            if tokens < 1.0:
+                self._buckets[key] = (tokens, now)
+                return False
+            self._buckets[key] = (tokens - 1.0, now)
+            return True
+
+    def remaining(self, key: str, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            tokens, last = self._buckets.get(key, (self.burst, now))
+            return min(self.burst, tokens + (now - last) * self.rate)
+
+
+class CircuitOpenError(RuntimeError):
+    """The key's circuit is open: the worker/provider flapped recently
+    and the cooldown has not elapsed — callers shed (503 + Retry-After
+    inbound, typed row failure outbound) instead of queueing onto a
+    dependency that keeps dying."""
+
+    def __init__(self, key: str, retry_after_s: float):
+        super().__init__(
+            f'circuit open for {key} (flapping); retry in '
+            f'{retry_after_s:.1f}s')
+        self.key = key
+        self.retry_after_s = max(retry_after_s, 0.5)
+
+
+class CircuitBreaker:
+    """Per-key circuit: closed → open on ``failures`` protocol
+    failures inside ``window_s`` → half-open after ``cooldown_s`` (one
+    probe rides through) → closed on probe success, re-open on probe
+    failure.  All transitions evaluate under an injected clock."""
+
+    def __init__(self, key: str,
+                 failures: int = BREAKER_FAILURES,
+                 window_s: float = BREAKER_WINDOW_S,
+                 cooldown_s: float = BREAKER_COOLDOWN_S):
+        self.key = key
+        self.failures = max(int(failures), 1)
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._state = 'closed'           # closed | open | half_open
+        # guarded-by: _lock
+        self._failure_ts: List[float] = []
+        # guarded-by: _lock
+        self._opened_ts: Optional[float] = None
+        # guarded-by: _lock
+        self._probe_ts: Optional[float] = None
+        # guarded-by: _lock
+        self._last_error: Optional[str] = None
+        # guarded-by: _lock
+        self._opens = 0
+
+    def allow(self, now: Optional[float] = None) -> str:
+        """Gate one acquire: returns ``'closed'`` (normal) or
+        ``'probe'`` (half-open — exactly one caller per cooldown gets
+        this), raises :class:`CircuitOpenError` while open.
+
+        A probe whose outcome never reports back (the request died on
+        a path that reaches neither ``note_success`` nor
+        ``note_failure`` — shed, deadline, chip starvation) must not
+        brick the key: once an outstanding probe ages past
+        ``cooldown_s`` a fresh probe is granted."""
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            if self._state == 'closed':
+                return 'closed'
+            # explicit None checks: `or now` would treat an injected
+            # t=0.0 timestamp as unset
+            since_open = now - (self._opened_ts
+                                if self._opened_ts is not None else now)
+            if self._state == 'open' and since_open >= self.cooldown_s:
+                self._state = 'half_open'
+                self._probe_ts = now
+                return 'probe'
+            if self._state == 'half_open':
+                since_probe = now - (self._probe_ts
+                                     if self._probe_ts is not None
+                                     else now)
+                if since_probe >= self.cooldown_s:
+                    # the previous probe was lost in flight: re-arm
+                    self._probe_ts = now
+                    return 'probe'
+                # a probe is in flight; hold the line until it reports
+                raise CircuitOpenError(
+                    self.key, max(self.cooldown_s - since_probe, 0.5))
+            raise CircuitOpenError(self.key,
+                                   self.cooldown_s - since_open)
+
+    def note_failure(self, error: str = '',
+                     now: Optional[float] = None) -> bool:
+        """One protocol failure; returns True when this one OPENED the
+        circuit (callers retire the flapping resident on that edge)."""
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            self._last_error = error[:500] if error else self._last_error
+            if self._state == 'half_open':
+                # failed probe: straight back to open, fresh cooldown
+                self._state = 'open'
+                self._opened_ts = now
+                self._probe_ts = None
+                self._opens += 1
+                return True
+            cutoff = now - self.window_s
+            self._failure_ts = [t for t in self._failure_ts
+                                if t >= cutoff]
+            self._failure_ts.append(now)
+            if self._state == 'closed' \
+                    and len(self._failure_ts) >= self.failures:
+                self._state = 'open'
+                self._opened_ts = now
+                self._opens += 1
+                return True
+            return False
+
+    def note_success(self, now: Optional[float] = None):
+        """A successful round-trip: closes a half-open (or open)
+        circuit and clears its failure window.  A success while
+        CLOSED deliberately leaves the rolling window alone —
+        flapping is fail/recover/fail *within the window*, and a
+        retried success between crashes must not reset the count (that
+        would make a crash-loop with working retries invisible)."""
+        with self._lock:
+            if self._state != 'closed':
+                self._state = 'closed'
+                self._opened_ts = None
+                self._probe_ts = None
+                self._failure_ts = []
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def opens(self) -> int:
+        with self._lock:
+            return self._opens
+
+    def snapshot(self, now: Optional[float] = None) -> Dict:
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            # prune to the window here too: note_failure is otherwise
+            # the only pruner, and a single long-past transient would
+            # read as "recent" forever
+            recent = [t for t in self._failure_ts
+                      if t >= now - self.window_s]
+            out = {'state': self._state,
+                   'recent_failures': len(recent),
+                   'opens': self._opens,
+                   'last_error': self._last_error}
+            if self._opened_ts is not None:
+                out['open_for_s'] = round(now - self._opened_ts, 1)
+                out['half_open_in_s'] = round(
+                    max(self.cooldown_s - (now - self._opened_ts), 0.0),
+                    1)
+            return out

@@ -110,6 +110,26 @@ def test_quick_scenarios_live(tmp_path):
     assert report['requests_checked'] >= burst['fired']
 
 
+def test_flaky_api_scenario_daemonless(tmp_path):
+    """The outbound resilience gate (`cli chaos --scenario flaky_api
+    --check`): 429 pacing adaptation with budgeted retries, breaker
+    open → half-open probe → close, deadline-bounded stall, and
+    bit-identical partial-failure resume — all against the in-process
+    stub provider, no daemon spawned."""
+    report = chaos.run_chaos(['flaky_api'],
+                             workdir=str(tmp_path / 'chaos'),
+                             quick=True)
+    assert set(report['scenarios']) == {'flaky_api'}
+    flaky = report['scenarios']['flaky_api']
+    assert flaky['burst']['http_429'] >= 1
+    assert flaky['burst']['limit_low_water'] < 6
+    assert flaky['breaker']['closed_by_probe'] is True
+    assert flaky['stall']['kind'] in ('deadline_exceeded', 'stall')
+    assert flaky['partial']['resume_converged'] is True
+    # daemonless: the access-log invariant had nothing to check
+    assert report['requests_checked'] == 0
+
+
 # -- live: the full kill-sweep (slow) ---------------------------------------
 
 @pytest.mark.slow

@@ -1,0 +1,696 @@
+"""Outbound API scheduler (opencompass_tpu/outbound/): AIMD
+concurrency + pacing units under injected clocks, the shared
+resilience primitives, typed transport errors against the stub
+provider, scheduler behaviors (scatter-back, retry budgets, breaker
+lifecycle, hedging, deadlines, fail-fast drain), the TokenBucket
+parity shim, and the GenInferencer partial-failure/resume path."""
+import json
+import os
+import os.path as osp
+import threading
+import time
+
+import pytest
+
+from opencompass_tpu.models.completions_api import CompletionsAPI
+from opencompass_tpu.models.openai_api import OpenAI
+from opencompass_tpu.outbound import (AimdLimiter, OutboundScheduler,
+                                      Pacer, PartialFailure,
+                                      RateLimited, Rejected,
+                                      ServerError, StallError,
+                                      StubProvider, canned_text,
+                                      read_outbound)
+from opencompass_tpu.outbound import errors as oerr
+
+
+@pytest.fixture
+def stub():
+    provider = StubProvider(latency_s=0.01).start()
+    yield provider
+    provider.stop()
+
+
+def _model(stub_provider, **kwargs):
+    ob = dict(breaker_cooldown_s=0.3, retry_budget_rate=5.0,
+              retry_budget_burst=8.0, request_timeout_s=10.0)
+    ob.update(kwargs.pop('outbound', {}))
+    defaults = dict(path='m', key='k',
+                    openai_api_base=stub_provider.chat_url,
+                    query_per_second=1000, retry=2, outbound=ob)
+    defaults.update(kwargs)
+    return OpenAI(**defaults)
+
+
+# -- shared primitives -------------------------------------------------------
+
+def test_resilience_primitives_are_shared():
+    """One RetryBudget/backoff/CircuitBreaker implementation serves
+    both the serve daemon and the outbound plane (acceptance: a fix in
+    one is a fix in both)."""
+    from opencompass_tpu.serve import scheduler as serve_sched
+    from opencompass_tpu.utils import resilience
+    assert serve_sched.RetryBudget is resilience.RetryBudget
+    assert serve_sched.CircuitBreaker is resilience.CircuitBreaker
+    assert serve_sched.backoff_delay is resilience.backoff_delay
+    assert serve_sched.CircuitOpenError is resilience.CircuitOpenError
+    sched = OutboundScheduler('prov-shared')
+    assert isinstance(sched.budget, resilience.RetryBudget)
+    assert isinstance(sched.breaker, resilience.CircuitBreaker)
+
+
+# -- limits (injected clocks) ------------------------------------------------
+
+def test_aimd_limiter_throttle_and_recovery():
+    lim = AimdLimiter(max_limit=8, min_limit=1, hold_s=1.0)
+    assert lim.snapshot()['limit'] == 8
+    lim.on_throttle(now=100.0)
+    assert lim.snapshot()['limit'] == 4
+    # within the hold window a second throttle is one incident, not a
+    # collapse to the floor
+    lim.on_throttle(now=100.5)
+    assert lim.snapshot()['limit'] == 4
+    lim.on_throttle(now=101.5)
+    assert lim.snapshot()['limit'] == 2
+    assert lim.snapshot()['low_water'] == 2
+    # additive increase creeps back up on success
+    for _ in range(50):
+        lim.on_success()
+    assert lim.snapshot()['limit'] > 2
+    assert lim.snapshot()['low_water'] == 2   # the evidence survives
+
+
+def test_aimd_limiter_bounds_inflight():
+    lim = AimdLimiter(max_limit=2)
+    assert lim.acquire(timeout=0.1)
+    assert lim.acquire(timeout=0.1)
+    t0 = time.perf_counter()
+    assert not lim.acquire(timeout=0.15)     # window full
+    assert time.perf_counter() - t0 >= 0.14
+    lim.release()
+    assert lim.acquire(timeout=0.1)
+    lim.release()
+    lim.release()
+
+
+def test_pacer_qps_and_retry_after_hold():
+    pacer = Pacer(qps=10)                     # 100ms interval
+    assert pacer.reserve(now=50.0) == 0.0
+    assert pacer.reserve(now=50.0) == pytest.approx(0.1)
+    assert pacer.reserve(now=50.0) == pytest.approx(0.2)
+    # a Retry-After hold gates EVERY launch, and only ever extends
+    pacer.hold(5.0, now=50.0)
+    pacer.hold(2.0, now=50.0)                 # shorter: ignored
+    assert pacer.reserve(now=50.3) == pytest.approx(4.7)
+    # no-qps pacer is free until held
+    free = Pacer()
+    assert free.reserve(now=1.0) == 0.0
+    assert free.reserve(now=1.0) == 0.0
+
+
+def test_token_bucket_shim_clock_disciplined():
+    """The parity shim: no refill thread, no Semaphore._value, tokens
+    accrue lazily on the injected clock."""
+    from opencompass_tpu.models.base_api import TokenBucket
+    threads_before = threading.active_count()
+    bucket = TokenBucket(2.0)                 # 2 qps
+    assert bucket.try_take(now=10.0) == 0.0   # initial token
+    wait = bucket.try_take(now=10.0)
+    assert wait == pytest.approx(0.5)         # 1/rate to the next
+    assert bucket.try_take(now=10.5) == 0.0   # accrued
+    # burst caps at rate: a long idle gap does not bank unlimited qps
+    for _ in range(2):
+        assert bucket.try_take(now=100.0) == 0.0
+    assert bucket.try_take(now=100.0) > 0.0
+    assert threading.active_count() == threads_before  # no daemon thread
+    bucket.get_token()                        # the blocking call works
+
+
+# -- typed transport ---------------------------------------------------------
+
+def test_post_json_once_typed_errors(stub):
+    model = _model(stub)
+    url = stub.chat_url
+    body = {'messages': [{'role': 'user', 'content': 'hi'}]}
+    stub.queue_429(1, retry_after_s=0.7)
+    with pytest.raises(RateLimited) as exc:
+        model.post_json_once(url, body)
+    assert exc.value.retry_after_s == pytest.approx(0.7)
+    assert exc.value.status == 429
+    stub.set_mode('401')
+    with pytest.raises(Rejected):
+        model.post_json_once(url, body)
+    stub.set_mode('500')
+    with pytest.raises(ServerError):
+        model.post_json_once(url, body)
+    stub.set_mode('malformed')
+    with pytest.raises(oerr.MalformedResponse):
+        model.post_json_once(url, body)
+    stub.set_mode('stall')
+    with pytest.raises(StallError):
+        model.post_json_once(url, body, timeout=0.3)
+    stub.set_mode(None)
+    assert model.post_json_once(url, body)['choices']
+
+
+def test_post_json_honors_retry_after(stub):
+    """Satellite: the retrying post_json sleeps at least the 429's
+    Retry-After before re-sending (previously a synchronized
+    2**attempt stampede that ignored the header)."""
+    model = _model(stub)
+    stub.queue_429(1, retry_after_s=0.4)
+    body = {'messages': [{'role': 'user', 'content': 'ra probe'}]}
+    out = model.post_json(stub.chat_url, body)
+    assert out['choices'][0]['message']['content'] \
+        == canned_text('ra probe')
+    log = stub.log()
+    assert len(log) == 2                      # 429 then the retry
+    assert log[1]['t'] - log[0]['t'] >= 0.38  # header honored
+
+
+def test_backoff_jitter_decorrelates():
+    from opencompass_tpu.utils.resilience import backoff_delay
+    d_a = backoff_delay('provider-a#1', 0, base_s=1.0, cap_s=30.0)
+    d_b = backoff_delay('provider-b#1', 0, base_s=1.0, cap_s=30.0)
+    assert d_a != d_b                          # no lockstep stampede
+    assert 0.5 <= d_a < 1.0 and 0.5 <= d_b < 1.0
+    # deterministic: an incident replays with the same delays
+    assert backoff_delay('provider-a#1', 0, base_s=1.0,
+                         cap_s=30.0) == d_a
+
+
+# -- scheduler behaviors -----------------------------------------------------
+
+def test_scheduler_scatter_back(stub):
+    model = _model(stub)
+    rows = [f'scatter {i}' for i in range(12)]
+    delivered = {}
+    report = model.generate_outcomes(
+        rows, 8, on_result=lambda i, v: delivered.__setitem__(i, v))
+    assert report.values() == [canned_text(r) for r in rows]
+    # every row delivered through the scatter-back hook, exactly once,
+    # with the right index mapping
+    assert delivered == {i: canned_text(r) for i, r in enumerate(rows)}
+
+
+def test_scheduler_adapts_to_429_and_bounds_retries(stub):
+    model = _model(stub, max_inflight=6)
+    sched = model.outbound_scheduler()
+    stub.queue_429(8, retry_after_s=0.1)
+    out = model.generate([f'adapt {i}' for i in range(16)],
+                         max_out_len=8)
+    assert len(out) == 16
+    stats = sched.stats()
+    assert stats['http_429_total'] >= 1
+    # the AIMD window backed off below its ceiling under the burst
+    assert stats['limiter']['low_water'] < 6
+    # every retry drew a budget token: retries never exceed failures
+    assert stats['retries_total'] <= stats['http_429_total'] \
+        + stats['http_5xx_total']
+    # the pacer recorded the provider-directed holds
+    assert stats['pacer']['holds'] >= 1
+
+
+def test_retry_budget_refusal_stops_amplification(stub):
+    """An exhausted budget surfaces the failure instead of piling
+    retries onto a failing provider."""
+    model = _model(stub, retry=3,
+                   outbound=dict(retry_budget_rate=0.0,
+                                 retry_budget_burst=1.0))
+    stub.set_mode('500')
+    report = model.generate_outcomes([f'b{i}' for i in range(4)], 8)
+    stats = model.outbound_scheduler().stats()
+    assert all(not o.ok for o in report.outcomes)
+    assert stats['retries_total'] <= 1         # the single burst token
+    assert stats['retry_budget_refusals'] >= 1
+    kinds = {o.failure.kind for o in report.outcomes}
+    assert kinds <= {'server_error', 'breaker_open', 'aborted'}
+
+
+def test_breaker_lifecycle_open_probe_close(stub):
+    model = _model(stub)
+    sched = model.outbound_scheduler()
+    stub.set_mode('500')
+    with pytest.raises(PartialFailure):
+        model.generate(['c1', 'c2', 'c3'], max_out_len=8)
+    assert sched.breaker.state in ('open', 'half_open')
+    opens_before = sched.breaker.opens
+    stub.set_mode(None)
+    time.sleep(0.4)                            # past the 0.3s cooldown
+    # the next call is the half-open probe; success closes the circuit
+    assert model.generate(['probe'], max_out_len=8) \
+        == [canned_text('probe')]
+    assert sched.breaker.state == 'closed'
+    assert sched.breaker.opens == opens_before
+
+
+def test_hedging_beats_straggler(stub):
+    stub.set_stall_s(5.0)
+    model = _model(stub, hedge_after_s=0.25,
+                   outbound=dict(request_timeout_s=8.0))
+    stub.queue_stall(1)                        # only the first stalls
+    t0 = time.perf_counter()
+    out = model.generate(['straggler row'], max_out_len=8)
+    wall = time.perf_counter() - t0
+    assert out == [canned_text('straggler row')]
+    assert wall < 4.0                          # did not eat the stall
+    stats = model.outbound_scheduler().stats()
+    assert stats['hedges_total'] == 1
+    assert stats['hedge_wins_total'] == 1
+
+
+def test_deadline_bounds_stalled_provider(stub):
+    model = _model(stub)
+    stub.set_mode('stall')
+    t0 = time.perf_counter()
+    report = model.generate_outcomes(['dl row'], 8, deadline_s=0.8)
+    wall = time.perf_counter() - t0
+    outcome = report.outcomes[0]
+    assert not outcome.ok
+    assert outcome.failure.kind in ('deadline_exceeded', 'stall')
+    assert wall < 6.0
+
+
+def test_deadline_forwarded_on_outbound_request(stub):
+    """The remaining row budget rides X-OCT-Deadline-Ms to the
+    provider (deadline propagation through scheduler threads)."""
+    model = _model(stub)
+    report = model.generate_outcomes(['fw row'], 8, deadline_s=30.0)
+    assert report.outcomes[0].ok
+    fwd = [r['deadline_ms'] for r in stub.log()
+           if r['prompt'].endswith('fw row')]
+    assert fwd and fwd[0] is not None
+    assert 0 < float(fwd[0]) <= 30000
+
+
+def test_fail_fast_drains_dead_endpoint(stub):
+    """Satellite: a dead endpoint (non-retryable auth failure) stops
+    admitting queued siblings and leaks no request threads past the
+    call."""
+    stub.set_mode('401')
+    model = _model(stub, max_inflight=4)
+    threads_before = threading.active_count()
+    with pytest.raises(PartialFailure) as exc:
+        model.generate([f'dead {i}' for i in range(30)], max_out_len=8)
+    kinds = {f.kind for f in exc.value.failures}
+    assert kinds == {'rejected', 'aborted'}
+    # fail-fast: far fewer requests than rows reached the endpoint
+    assert stub.stats()['requests_total'] < 30
+    # the scheduler joined its workers: no leaked threads
+    time.sleep(0.2)
+    assert threading.active_count() <= threads_before + 1
+
+
+def test_all_failed_message_keeps_attempt_count(monkeypatch):
+    """Contract pinned by PR reviewers past: a dead endpoint raises
+    RuntimeError naming the attempt count (see also
+    test_icl_extras.test_openai_raises_after_retry_budget)."""
+    model = OpenAI(path='m', key='k', retry=0, query_per_second=100)
+    import unittest.mock as mock
+    with mock.patch('urllib.request.urlopen',
+                    side_effect=OSError('no network')):
+        with pytest.raises(RuntimeError,
+                           match='failed after 1 attempts'):
+            model.generate(['ping'], max_out_len=4)
+
+
+def test_fail_fast_off_keeps_siblings_running():
+    """fail_fast=False: one row's non-retryable rejection must not
+    abort the queued siblings."""
+    sched = OutboundScheduler('prov-ff', max_inflight=2)
+
+    def call(prompt, timeout):
+        if 'REJECTME' in prompt:
+            raise Rejected('bad row')
+        time.sleep(0.05)     # healthy rows slow enough that siblings
+        return f'ok {prompt}'   # are still queued when rejection lands
+
+    rows = ['a', 'b REJECTME', 'c', 'd', 'e']
+    report = sched.run(rows, call, fail_fast=False)
+    kinds = {o.failure.kind for o in report.outcomes if o.failure}
+    assert kinds == {'rejected'}               # nothing aborted
+    assert sum(1 for o in report.outcomes if o.ok) == 4
+    # and with the default fail_fast=True the drain kicks in
+    report2 = sched.run(['x REJECTME'] + [f'r{i}' for i in range(20)],
+                        call)
+    kinds2 = {o.failure.kind for o in report2.outcomes if o.failure}
+    assert 'aborted' in kinds2
+    assert 'rejected' in kinds2
+
+
+def test_collector_error_surfaces_as_typed_failure():
+    """An on_result that fails to persist a row must turn that row
+    into a typed failure — never an ok outcome the caller finalizes
+    with the row silently missing."""
+    sched = OutboundScheduler('prov-coll', max_inflight=1)
+
+    def call(prompt, timeout):
+        return f'ok {prompt}'
+
+    def exploding_collector(i, value):
+        if i == 2:                              # the LAST row
+            raise OSError('disk full')
+
+    report = sched.run(['a', 'b', 'c'], call,
+                       on_result=exploding_collector)
+    failures = {f.index: f.kind for f in report.failures}
+    assert failures == {2: 'collector_error'}
+    with pytest.raises(PartialFailure):
+        report.values()
+
+
+def test_unserializable_body_is_rejected_not_provider_fault(stub):
+    """A client-side serialization bug must not burn retries or the
+    provider breaker (it is not the provider's fault)."""
+    model = _model(stub, generation_kwargs={'bad': {1, 2, 3}})
+    with pytest.raises(PartialFailure) as exc:
+        model.generate(['ser row'], max_out_len=8)
+    assert exc.value.failures[0].kind == 'rejected'
+    assert 'not JSON-serializable' in exc.value.failures[0].error
+    assert stub.stats()['requests_total'] == 0   # never hit the wire
+    assert model.outbound_scheduler().breaker.state == 'closed'
+
+
+def test_hedge_win_accounting_exact():
+    """hedge_wins_total counts only races the hedge actually won —
+    a hedge that launched but lost to the primary is not a win."""
+    calls = {'n': 0}
+
+    def call(prompt, timeout):
+        calls['n'] += 1
+        if calls['n'] == 1:
+            time.sleep(0.5)                     # slow primary, wins
+        else:
+            time.sleep(1.5)                     # slower hedge
+        return 'ok'
+
+    sched = OutboundScheduler('prov-hw', max_inflight=4,
+                              hedge_after_s=0.1)
+    report = sched.run(['row'], call)
+    assert report.outcomes[0].ok
+    stats = sched.stats()
+    assert stats['hedges_total'] == 1
+    assert stats['hedge_wins_total'] == 0
+    assert report.outcomes[0].hedged is False   # the primary's result
+
+
+def test_abandoned_attempt_keeps_its_inflight_slot():
+    """A hedge win abandons the primary to its timeout — but the
+    primary keeps holding its AIMD slot until its request actually
+    ends, so true concurrency never exceeds the window."""
+    release = threading.Event()
+    calls = {'n': 0}
+
+    def call(prompt, timeout):
+        calls['n'] += 1
+        if calls['n'] == 1:
+            release.wait(5.0)                   # primary wedged
+            return 'late'
+        return 'fast'                           # hedge wins
+
+    sched = OutboundScheduler('prov-slot', max_inflight=2,
+                              hedge_after_s=0.1)
+    report = sched.run(['row'], call)
+    assert report.outcomes[0].ok
+    assert report.outcomes[0].hedged is True
+    assert sched.stats()['hedge_wins_total'] == 1
+    # the abandoned primary still owns one slot
+    assert sched.limiter.snapshot()['inflight'] == 1
+    release.set()
+    deadline = time.monotonic() + 3.0
+    while sched.limiter.snapshot()['inflight'] and \
+            time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert sched.limiter.snapshot()['inflight'] == 0
+
+
+def test_open_breaker_sheds_fast_with_long_cooldown():
+    """A provider that is DOWN fails the whole sweep in seconds: once
+    the (default, 15s-cooldown) breaker opens, queued rows shed typed
+    immediately instead of serializing through the cooldown."""
+    from opencompass_tpu.outbound.errors import NetworkError
+    sched = OutboundScheduler('prov-down', max_inflight=4,
+                              max_attempts=2)
+
+    def call(prompt, timeout):
+        raise NetworkError('connection refused')
+
+    t0 = time.perf_counter()
+    report = sched.run([f'r{i}' for i in range(24)], call)
+    wall = time.perf_counter() - t0
+    assert all(not o.ok for o in report.outcomes)
+    kinds = {o.failure.kind for o in report.outcomes}
+    assert kinds <= {'network', 'breaker_open'}
+    assert 'breaker_open' in kinds             # the breaker DID open
+    assert wall < 10.0                         # no cooldown serialization
+
+
+def test_post_json_fails_fast_on_non_retryable(monkeypatch):
+    """post_json must not back off and retry an error another attempt
+    cannot fix (e.g. an already-expired request deadline)."""
+    from opencompass_tpu.obs import reqtrace
+    model = OpenAI(path='m', key='k', retry=3, query_per_second=1000)
+    token, _ = reqtrace.begin_request('req-dead', 'POST', '/x',
+                                      deadline_ms=0.001)
+    try:
+        time.sleep(0.01)                       # budget now expired
+        sleeps = []
+        monkeypatch.setattr('opencompass_tpu.models.base_api.sleep',
+                            sleeps.append)
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match='budget exhausted'):
+            model.post_json('http://127.0.0.1:9/never', {'a': 1})
+        assert time.perf_counter() - t0 < 1.0
+        assert sleeps == []                    # zero backoff sleeps
+    finally:
+        reqtrace.end_request(token)
+
+
+def test_report_stats_are_per_run_deltas(stub):
+    """A scheduler shared across tasks attributes each run only its
+    own traffic (flight-recorder/heartbeat numbers must not
+    double-count the previous task)."""
+    model = _model(stub)
+    stub.queue_429(2, retry_after_s=0.05)
+    first = model.generate_outcomes([f'a{i}' for i in range(6)], 8)
+    assert first.stats['http_429_total'] == 2
+    second = model.generate_outcomes([f'b{i}' for i in range(4)], 8)
+    assert second.stats['http_429_total'] == 0   # clean second run
+    assert second.stats['rows_total'] == 4
+    assert second.stats['ok_total'] == 4
+    # the scheduler's own lifetime view still accumulates
+    assert model.outbound_scheduler().stats()['http_429_total'] == 2
+
+
+def test_transient_4xx_and_internal_classification():
+    """408/425 are transient (retryable stall, never sweep-fatal);
+    client-side programmer errors are non-retryable `internal` and
+    never feed the provider breaker."""
+    import urllib.error
+    err408 = oerr.from_http_error(urllib.error.HTTPError(
+        'http://x', 408, 'Request Timeout', None, None))
+    assert isinstance(err408, StallError) and err408.retryable
+    assert oerr.classify(NotImplementedError('hook missing')).kind \
+        == 'internal'
+
+    sched = OutboundScheduler('prov-int', max_inflight=2, max_attempts=3)
+
+    def call(prompt, timeout):
+        raise NotImplementedError('transport hook missing')
+
+    report = sched.run(['a', 'b'], call)
+    assert {o.failure.kind for o in report.outcomes} == {'internal'}
+    assert all(o.attempts == 1 for o in report.outcomes)  # no retries
+    assert sched.breaker.state == 'closed'    # not a provider incident
+    assert sched.stats()['retries_total'] == 0
+
+
+def test_breaker_shed_counter_counts_only_sheds():
+    """Riding out a short cooldown is not a shed."""
+    from opencompass_tpu.utils.resilience import CircuitBreaker
+    breaker = CircuitBreaker('prov-rs', cooldown_s=0.3)
+    for _ in range(3):
+        breaker.note_failure('boom')
+    assert breaker.state == 'open'
+    sched = OutboundScheduler('prov-rs', max_inflight=2,
+                              max_attempts=3, breaker=breaker)
+    report = sched.run(['row'], lambda p, t: 'ok')
+    assert report.outcomes[0].ok              # waited out the cooldown
+    assert sched.stats()['breaker_sheds_total'] == 0
+
+
+# -- completions API through the scheduler -----------------------------------
+
+def test_completions_api_rides_scheduler(stub):
+    model = CompletionsAPI(path='m', url=stub.completions_url, key='',
+                           query_per_second=1000, retry=1)
+    out = model.generate(['alpha', 'beta'], max_out_len=8)
+    assert out == [canned_text('alpha'), canned_text('beta')]
+    ppl = model.get_ppl(['one two three'])
+    assert ppl == [1.0]                        # stub echoes -1.0 each
+    stats = model.outbound_scheduler().stats()
+    assert stats['ok_total'] >= 3              # gen + ppl shared one
+    assert stats['provider'] == f'127.0.0.1:{stub.port}'
+
+
+# -- observability -----------------------------------------------------------
+
+def test_outbound_metrics_and_snapshot(stub, tmp_path):
+    from opencompass_tpu import obs
+    tracer = obs.init_obs(str(tmp_path), enabled=True)
+    try:
+        model = _model(stub)
+        model.generate(['obs row'], max_out_len=8)
+        snap = tracer.metrics.snapshot()
+        fams = {k.split('#')[0] for k in snap.get('gauges', {})}
+        assert {'oct_outbound_inflight', 'oct_outbound_limit',
+                'oct_outbound_qps', 'oct_outbound_breaker_state',
+                'oct_outbound_http_429_total'} <= fams
+        # the durable snapshot landed in the run's obs dir
+        loaded = read_outbound(tracer.obs_dir)
+        assert loaded is not None
+        provider = loaded['providers'][model.provider_key]
+        assert provider['ok_total'] >= 1
+    finally:
+        obs.init_obs(str(tmp_path), enabled=False)
+
+
+def test_doctor_api_throttled_rule(tmp_path):
+    from opencompass_tpu.obs import doctor
+    serve_obs = tmp_path / 'cache' / 'serve' / 'obs'
+    serve_obs.mkdir(parents=True)
+    (tmp_path / 'cache' / 'serve' / 'queue').mkdir()
+    snapshot = {'v': 1, 'ts': 1.0, 'pid': 1, 'providers': {
+        'api.example.com': {
+            'attempts_total': 50, 'http_429_total': 20,
+            'retries_total': 15, 'retry_budget_refusals': 2,
+            'limiter': {'limit': 2.0, 'max_limit': 8,
+                        'low_water': 1.0},
+            'breaker': {'state': 'closed', 'opens': 0},
+        }}}
+    (serve_obs / 'outbound.json').write_text(json.dumps(snapshot))
+    report = doctor.diagnose(str(tmp_path / 'cache'))
+    rules = {f['rule']: f for f in report['findings']}
+    assert 'api_throttled' in rules
+    finding = rules['api_throttled']
+    assert finding['severity'] == 'warn'
+    assert 'api.example.com' in finding['title']
+    # breaker-open variant escalates the wording
+    snapshot['providers']['api.example.com']['http_429_total'] = 0
+    snapshot['providers']['api.example.com']['breaker'] = {
+        'state': 'open', 'opens': 3, 'last_error': 'boom'}
+    (serve_obs / 'outbound.json').write_text(json.dumps(snapshot))
+    report = doctor.diagnose(str(tmp_path / 'cache'))
+    rules = {f['rule']: f for f in report['findings']}
+    assert 'crash-looping' in rules['api_throttled']['title']
+
+
+def test_top_renders_outbound_pane(tmp_path):
+    from opencompass_tpu.serve import top
+    serve_obs = tmp_path / 'serve' / 'obs'
+    serve_obs.mkdir(parents=True)
+    (serve_obs / 'outbound.json').write_text(json.dumps(
+        {'v': 1, 'ts': 1.0, 'pid': 1, 'providers': {
+            'api.example.com': {
+                'http_429_total': 7, 'retries_total': 3,
+                'hedges_total': 2, 'hedge_wins_total': 1,
+                'failed_total': 1, 'measured_qps': 2.5,
+                'limiter': {'limit': 4.0, 'max_limit': 8},
+                'breaker': {'state': 'open', 'opens': 1},
+            }}}))
+    snap = top.gather(str(tmp_path), now=2.0)
+    out = top.render(snap)
+    assert 'outbound[api.example.com]' in out
+    assert '429 7' in out and 'breaker OPEN' in out
+
+
+# -- inferencer wiring -------------------------------------------------------
+
+def _toy_dataset(n=8, fail_rows=()):
+    from datasets import Dataset, DatasetDict
+
+    from opencompass_tpu.datasets.base import BaseDataset
+
+    class Toy(BaseDataset):
+        @staticmethod
+        def load():
+            rows = [{'q': f'question {i}'
+                     + (' FAILME' if i in fail_rows else ''),
+                     'a': 'x'} for i in range(n)]
+            return DatasetDict({'train': Dataset.from_list(rows),
+                                'test': Dataset.from_list(rows)})
+
+    return Toy(reader_cfg=dict(input_columns=['q'],
+                               output_column='a'))
+
+
+def test_gen_inferencer_partial_failure_resumes_bit_identical(
+        stub, tmp_path):
+    """The tentpole's scatter-back contract end to end: mid-sweep row
+    failures become typed api_errors.json records, successes flush,
+    the task raises resumable, and the rerun recomputes ONLY the
+    failed rows, converging bit-identically with a clean run."""
+    from opencompass_tpu.icl import PromptTemplate
+    from opencompass_tpu.icl.inferencers import GenInferencer
+    from opencompass_tpu.icl.retrievers import ZeroRetriever
+    ds = _toy_dataset(8, fail_rows=(2, 5))
+    out_dir = str(tmp_path / 'preds')
+    model = _model(stub, retry=1)
+    template = PromptTemplate('Q: {q}\nA:')
+    stub.set_fail_marker('FAILME')
+    inf = GenInferencer(model=model, max_out_len=8, batch_size=4,
+                        output_json_filepath=out_dir, save_every=1)
+    with pytest.raises(PartialFailure):
+        inf.inference(ZeroRetriever(ds), prompt_template=template)
+    # typed, durable error records for exactly the failed rows
+    errs = json.load(open(osp.join(out_dir, 'api_errors.json')))
+    assert sorted(r['index'] for r in errs['failed_rows']) == [2, 5]
+    assert all(r['kind'] for r in errs['failed_rows'])
+    # successes flushed with holes where the failures were
+    tmp = json.load(open(osp.join(out_dir, 'tmp_predictions')))
+    assert sorted(int(k) for k in tmp) == [0, 1, 3, 4, 6, 7]
+
+    stub.set_fail_marker(None)
+    time.sleep(0.4)                    # breaker cooldown from the 500s
+    before = stub.stats()['requests_total']
+    inf2 = GenInferencer(model=model, max_out_len=8, batch_size=4,
+                         output_json_filepath=out_dir, save_every=1)
+    preds = inf2.inference(ZeroRetriever(ds), prompt_template=template)
+    # the resume computed exactly the two failed rows
+    assert stub.stats()['requests_total'] - before == 2
+    assert not osp.exists(osp.join(out_dir, 'api_errors.json'))
+
+    clean_dir = str(tmp_path / 'clean')
+    inf3 = GenInferencer(model=_model(stub, retry=1), max_out_len=8,
+                         batch_size=4, output_json_filepath=clean_dir)
+    clean = inf3.inference(ZeroRetriever(ds), prompt_template=template)
+    assert preds == clean              # bit-identical convergence
+
+
+def test_gen_inferencer_outbound_rows_tick_heartbeat(stub, tmp_path):
+    """Per-row progress (not batch jumps) rides the heartbeat, like
+    the continuous-engine path."""
+    from opencompass_tpu import obs
+    from opencompass_tpu.icl import PromptTemplate
+    from opencompass_tpu.icl.inferencers import GenInferencer
+    from opencompass_tpu.icl.retrievers import ZeroRetriever
+    from opencompass_tpu.obs.live import (Heartbeat, install_heartbeat,
+                                          reset_heartbeat)
+    obs.init_obs(str(tmp_path), enabled=True)
+    try:
+        hb = install_heartbeat(
+            Heartbeat(str(tmp_path / 'obs'), 'api-task', interval=0))
+        ds = _toy_dataset(6)
+        inf = GenInferencer(model=_model(stub), max_out_len=8,
+                            batch_size=3,
+                            output_json_filepath=str(tmp_path / 'p'))
+        preds = inf.inference(
+            ZeroRetriever(ds),
+            prompt_template=PromptTemplate('Q: {q}\nA:'))
+        assert len(preds) == 6
+        beat = json.load(open(hb.path))
+        assert beat['done'] == 6
+        assert beat.get('outbound_limit') is not None
+        hb.mark('done')
+    finally:
+        reset_heartbeat()
+        obs.init_obs(str(tmp_path), enabled=False)
