@@ -853,6 +853,116 @@ def _bench_roofline(out_json='BENCH_ROOFLINE.json'):
     return record
 
 
+def _bench_devprof(out_json='BENCH_DEVPROF.json'):
+    """detail.devprof: the device introspection layer end to end on the
+    tiny JaxLM (CPU-runnable) — every fresh executable (ppl scoring +
+    both paged-engine kinds) leaves a compile-audit record with XLA's
+    own cost/memory analysis, the measured-vs-modeled flop drift is
+    summarized, and step profiling attributes the gather share of
+    decode step wall.  Trajectory series gate the deterministic
+    numbers: ``model_drift`` is pure arithmetic on XLA's accounting,
+    and the ``gather_share`` series uses the memory-bound modeled
+    value so hosts without op-level trace support gate identically;
+    the JSON keeps the measured share beside it."""
+    import tempfile
+
+    from opencompass_tpu import obs
+    from opencompass_tpu.models.jax_lm import JaxLM
+    from opencompass_tpu.obs import compileaudit
+    from opencompass_tpu.obs import timeline as tmod
+
+    work = tempfile.mkdtemp(prefix='oct_devprof_')
+    obs.reset_obs()
+    os.environ['OCT_PROFILE_STEPS'] = '2'
+    os.environ['OCT_PROFILE_STRIDE'] = '4'
+    try:
+        tracer = obs.init_obs(work)
+        tl = obs.init_task_timeline('devprof-bench')
+        rng = np.random.RandomState(7)
+        prompts = [' '.join(f'w{rng.randint(999)}' for _ in range(int(n)))
+                   for n in rng.choice([3, 6, 12, 20], size=8)]
+        lm = JaxLM(config='tiny', max_seq_len=256,
+                   continuous_batching=True, decode_slots=4,
+                   kv_page_size=16)
+        lm.get_ppl(prompts[:4])
+        lm.generate_continuous(prompts, 12)
+        records = list(tmod.iter_records(tl.path))
+        summary = tmod.summarize_records(records)
+        compiles = compileaudit.read_compiles(tracer.obs_dir)
+        audit = compileaudit.summarize_compiles(compiles)
+    finally:
+        os.environ.pop('OCT_PROFILE_STEPS', None)
+        os.environ.pop('OCT_PROFILE_STRIDE', None)
+        obs.reset_obs()
+
+    assert audit.get('analyzed', 0) >= 3, (
+        f'expected ppl + prefill_chunk + decode audits, got {audit}')
+    drift = audit.get('model_drift_max')
+    assert drift is not None and drift < 0.25, (
+        f'cost model drifted {drift} from XLA accounting '
+        f'({audit.get("model_drift_worst_shape")})')
+    engines = [r for r in records if r.get('t') == 'engine']
+    assert engines, 'engine drain left no flight-recorder record'
+    eng = engines[-1]
+    gather_modeled = eng.get('gather_share_modeled')
+    assert gather_modeled and gather_modeled > 0, (
+        'paged engine must report a nonzero modeled gather share')
+
+    record = {
+        'v': 1,
+        'workload': '8 rows, prompt words in {3..20}, max_new 12, '
+                    'tiny JaxLM at max_seq_len 256; ppl scoring + '
+                    'engine (4 slots / page 16); 2 sampled step traces',
+        'compile_audit': {
+            'records': audit.get('records'),
+            'fresh': audit.get('fresh'),
+            'cache_hits': audit.get('cache_hits'),
+            'analyzed': audit.get('analyzed'),
+            'compile_seconds': audit.get('compile_seconds'),
+            'xla_flops': audit.get('xla_flops'),
+            'xla_bytes_accessed': audit.get('xla_bytes_accessed'),
+            'temp_bytes_peak': audit.get('temp_bytes_peak'),
+        },
+        'model_drift': {
+            'max': drift,
+            'mean': audit.get('model_drift_mean'),
+            'worst_shape': audit.get('model_drift_worst_shape'),
+            'reconciled': audit.get('reconciled'),
+        },
+        'shapes': [{'shape_key': r.get('shape_key'),
+                    'xla_flops': (r.get('cost') or {}).get('flops'),
+                    'model_flops': (r.get('model') or {}).get('flops'),
+                    'model_drift': r.get('model_drift')}
+                   for r in compiles],
+        'step_profile': {
+            'profiled_steps': eng.get('profiled_steps'),
+            'profile_categories': eng.get('profile_categories'),
+            'gather_share': summary.get('gather_share'),
+            'gather_share_source': summary.get('gather_share_source'),
+            'gather_share_measured': eng.get('gather_share_measured'),
+            'gather_share_modeled': gather_modeled,
+        },
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, out_json), 'w') as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
+    _append_trajectory(
+        'devprof', 'model_drift', drift, 'frac', direction='lower',
+        detail={'worst_shape': audit.get('model_drift_worst_shape'),
+                'mean': audit.get('model_drift_mean'),
+                'reconciled': audit.get('reconciled')})
+    _append_trajectory(
+        'devprof', 'gather_share', gather_modeled, 'frac',
+        direction='lower',
+        detail={'source': 'modeled',
+                'measured': eng.get('gather_share_measured'),
+                'profiled_steps': eng.get('profiled_steps')})
+    return record
+
+
 def _bench_serve(out_json='BENCH_SERVE.json'):
     """detail.serve: the evaluation-as-a-service loop end to end —
     daemon up (fleet warmed), demo sweep enqueued, an interactive
@@ -1690,6 +1800,7 @@ def main():
             'result_cache': _bench_result_cache(),
             'flight_recorder': _bench_flight_recorder(),
             'roofline': _bench_roofline(),
+            'devprof': _bench_devprof(),
             'a100_est': a100,
             'a100_est_b32': a100_b32,
             'small': {
@@ -1747,6 +1858,13 @@ if __name__ == '__main__':
         # standalone roofline/MFU/MBU leg (tiny JaxLM; CPU-runnable)
         print(json.dumps({'metric': 'roofline', 'v': 1,
                           'detail': _bench_roofline()}))
+        sys.exit(0)
+    if '--devprof' in sys.argv:
+        # standalone device-introspection leg: compile audit +
+        # measured-vs-modeled drift + sampled step profiling (tiny
+        # JaxLM; CPU-runnable)
+        print(json.dumps({'metric': 'devprof', 'v': 1,
+                          'detail': _bench_devprof()}))
         sys.exit(0)
     if '--lint' in sys.argv:
         # standalone oct-lint coverage smoke (pure stdlib; device-free)

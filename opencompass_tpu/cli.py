@@ -12,6 +12,12 @@ Usage::
     python run.py cfg.py --slurm -p PARTITION       # cluster launch
     python run.py cfg.py --obs                      # run-wide tracing
     python run.py cfg.py --obs --obs-port 9464      # + live /metrics HTTP
+    python run.py cfg.py --xprof                    # op-level XProf session
+                                    # (driver + resident workers, linked
+                                    # from the Perfetto export)
+    python run.py cfg.py --profile-steps 8          # sampled step traces
+                                    # gather-share of decode wall in the
+                                    # trace report and ledger
     python run.py cfg.py --no-workers               # one subprocess per task
     python run.py cfg.py --no-result-cache          # skip the result store
     python -m opencompass_tpu.cli trace WORK_DIR    # render trace report
@@ -170,6 +176,20 @@ def parse_args():
                         'TensorBoard view; linked from `cli trace '
                         '--export`).  Driver-process device work only — '
                         'use --profile for per-task subprocess traces.  '
+                        'Resident workers contribute their own sessions '
+                        'under xprof/worker-<pid>/ (via OCT_XPROF_DIR).  '
+                        'Implies --obs')
+    parser.add_argument('--profile-steps',
+                        type=int,
+                        default=None,
+                        metavar='N',
+                        help='capture N stride-sampled jax.profiler '
+                        'traces around engine decode steps and dense '
+                        'batches (under {work_dir}/obs/steptrace/), '
+                        'parsed to attribute device wall to op '
+                        'categories — the gather share of decode step '
+                        'wall lands in the timeline and ledger '
+                        '(docs/observability.md, "Step profiling").  '
                         'Implies --obs')
     parser.add_argument('--no-result-cache',
                         action='store_false',
@@ -205,8 +225,13 @@ def get_config_from_arg(args) -> Config:
     if args.profile:
         cfg['profile'] = True
     if args.obs or args.obs_port is not None \
-            or getattr(args, 'xprof', False):
+            or getattr(args, 'xprof', False) \
+            or getattr(args, 'profile_steps', None):
         cfg['obs'] = True
+    if getattr(args, 'profile_steps', None):
+        # env, not config: the step profiler auto-binds in whichever
+        # process (driver or resident worker) runs the device steps
+        os.environ['OCT_PROFILE_STEPS'] = str(args.profile_steps)
     if args.use_workers is not None:
         cfg['use_workers'] = args.use_workers
     # getattr: tests drive this with hand-built namespaces
@@ -463,6 +488,10 @@ def main():
             os.makedirs(xprof_dir, exist_ok=True)
             jax.profiler.start_trace(xprof_dir)
             xprof_on = True
+            # resident workers inherit this env and contribute their
+            # own sessions under xprof/worker-<pid>/ — the driver's
+            # capture only sees driver-process device work
+            os.environ.setdefault('OCT_XPROF_DIR', xprof_dir)
             logger.info(f'xprof session capture at {xprof_dir}')
         except Exception as exc:
             logger.warning(f'--xprof unavailable: {exc}')

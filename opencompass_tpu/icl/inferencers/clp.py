@@ -223,5 +223,13 @@ class CLPInferencer(BaseInferencer):
                                           ice_template, prompt_template)
         lengths = self.measure_lengths(prompt_list, 'gen',
                                        cap=self.max_seq_len)
-        return preview_from_lengths(self, lengths,
-                                    seq_cap=self.max_seq_len)
+        preview = preview_from_lengths(self, lengths,
+                                       seq_cap=self.max_seq_len)
+        try:
+            from opencompass_tpu.utils.plan_preview import prefix_census
+            census = prefix_census(self.model, prompt_list)
+            if census:
+                preview['prefix'] = census
+        except Exception:
+            pass
+        return preview

@@ -461,6 +461,13 @@ class GenInferencer(BaseInferencer):
                 -(-(n + self.max_out_len) // page)
                 for n in lengths) / max(len(lengths), 1), 1)
             preview['continuous'] = cont
+        try:
+            from opencompass_tpu.utils.plan_preview import prefix_census
+            census = prefix_census(self.model, prompts)
+            if census:
+                preview['prefix'] = census
+        except Exception:
+            pass
         return preview
 
 

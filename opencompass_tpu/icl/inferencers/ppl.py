@@ -375,10 +375,20 @@ class PPLInferencer(BaseInferencer):
                 else ice_template
             sep = tmpl.sep_token
         lengths: List[int] = []
+        all_prompts: List[str] = []
         for label in labels:
             rows = [self._assemble(fitter, idx, label, ice_template,
                                    prompt_template, sep, normalizing_str)
                     for idx in range(len(fitter))]
-            lengths.extend(self.measure_lengths(
-                [r.prompt for r in rows], 'ppl'))
-        return preview_from_lengths(self, lengths)
+            prompts = [r.prompt for r in rows]
+            all_prompts.extend(prompts)
+            lengths.extend(self.measure_lengths(prompts, 'ppl'))
+        preview = preview_from_lengths(self, lengths)
+        try:
+            from opencompass_tpu.utils.plan_preview import prefix_census
+            census = prefix_census(self.model, all_prompts)
+            if census:
+                preview['prefix'] = census
+        except Exception:
+            pass
+        return preview

@@ -12,6 +12,10 @@ then ``<path>/cache/ledger``.
   ``--max-slowdown`` / ``--max-accuracy-drop`` — the CI gate.
   ``--min-mfu-ratio FRAC`` adds the roofline efficiency gate (MFU may
   not fall below FRAC of baseline; rows without an MFU are skipped).
+  ``--max-model-drift FRAC`` adds the compile-audit reconciliation
+  gate: the run's measured-vs-modeled flop divergence (from
+  ``obs/compiles.jsonl``) may not exceed FRAC — record-local, so it
+  fires even on the first run of a series.
   With ``--trajectory BENCH_TRAJECTORY.json`` it additionally gates
   the per-PR bench legs (the run ledger still gates whenever it has
   records).
@@ -130,6 +134,15 @@ def _cmd_check(records, args) -> int:
     if args.trajectory:
         regressions += ledmod.check_trajectory(
             args.trajectory, max_slowdown=args.max_slowdown)
+    # the reconciliation gate is record-local (XLA's accounting is the
+    # reference, not a baseline run) — it must fire BEFORE the
+    # no-baseline early return so the first run of a series gates too
+    if args.max_model_drift is not None and records:
+        _, cur = ledmod.resolve_runs(records, args.baseline, args.run,
+                                     args.ledger_dir)
+        if cur:
+            regressions += ledmod.check_model_drift(
+                records, cur, args.max_model_drift)
     # the run ledger gates whenever it has records — `--trajectory` adds
     # the bench gate, it must not silently disable this one
     if not args.trajectory or args.baseline or args.run or records:
@@ -141,7 +154,7 @@ def _cmd_check(records, args) -> int:
                 records, base, cur, max_slowdown=args.max_slowdown,
                 max_accuracy_drop=args.max_accuracy_drop,
                 min_mfu_ratio=args.min_mfu_ratio)
-        elif not args.trajectory:
+        elif not args.trajectory and args.max_model_drift is None:
             # a gate with no baseline passes: the FIRST run of a sweep
             # (or a fresh cache root) has nothing to regress against,
             # and CI must not go red before a series exists
@@ -169,6 +182,12 @@ def _cmd_check(records, args) -> int:
                 print(f"REGRESSION [{reg['model']}/{reg['dataset']}]: "
                       f"MFU {reg.get('mfu_base')} -> {reg.get('mfu')} "
                       f"(below {reg['threshold']:.0%} of baseline)")
+            elif reg['regression'] == 'model_drift':
+                print(f"REGRESSION [{reg['model']}/{reg['dataset']}]: "
+                      f"cost model drifts {reg['model_drift']:.1%} from "
+                      f"XLA accounting on "
+                      f"{reg.get('drift_shape') or '?'} (threshold "
+                      f"{reg['threshold']:.0%})")
             else:
                 print(f"REGRESSION [{reg['model']}/{reg['dataset']}]: "
                       f"accuracy {reg['drops']}")
@@ -213,6 +232,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         'regresses (e.g. 0.5 = halved efficiency '
                         'fails; off by default — rows without an MFU '
                         'are skipped)')
+    parser.add_argument('--max-model-drift', type=float, default=None,
+                        metavar='FRAC',
+                        help='reconciliation gate: fail when the run\'s '
+                        'compile-audit measured-vs-modeled flop '
+                        'divergence exceeds FRAC (record-local — '
+                        'needs no baseline run; off by default)')
     parser.add_argument('--trajectory', default=None, metavar='FILE',
                         help='additionally gate a bench '
                         'BENCH_TRAJECTORY.json (latest vs previous '

@@ -126,6 +126,20 @@ def collect_run_records(work_dir: str,
     except Exception:
         pass
 
+    # compile-audit join (obs/compileaudit.py): the worst measured-vs-
+    # modeled flop divergence across this run's fresh compiles.  The
+    # audit file is run-scoped, so every record of the run carries the
+    # same pair — what `check --max-model-drift` gates on.
+    drift = drift_shape = None
+    try:
+        from opencompass_tpu.obs import compileaudit
+        summary = compileaudit.summarize_compiles(
+            compileaudit.read_compiles(osp.join(work_dir, 'obs')))
+        drift = summary.get('model_drift_max')
+        drift_shape = summary.get('model_drift_worst_shape')
+    except Exception:
+        pass
+
     records = []
     now = round(time.time(), 3)
     for model, dataset, perf_path in _scan_pair_files(
@@ -168,6 +182,12 @@ def collect_run_records(work_dir: str,
             'mfu': tl.get('mfu'),
             'mbu': tl.get('mbu'),
             'kv_ratio': tl.get('kv_ratio'),
+            # device-wall share of the decode step spent in KV
+            # gather/scatter ops (measured from sampled profiler traces
+            # when available, else the cost-model estimate)
+            'gather_share': tl.get('gather_share'),
+            'model_drift': drift,
+            'model_drift_shape': drift_shape,
             'error': perf.get('error'),
             'accuracy': accuracy,
         })
@@ -354,6 +374,36 @@ def check_records(records: List[Dict], baseline: str, run: str,
             out.append({**row, 'regression': 'accuracy',
                         'threshold': -max_accuracy_drop,
                         'drops': drops})
+    return out
+
+
+def check_model_drift(records: List[Dict], run: str,
+                      max_drift: float) -> List[Dict]:
+    """Record-local reconciliation gate: rows of ``run`` whose compile
+    audit measured-vs-modeled flop divergence (``model_drift``, from
+    ``obs/compiles.jsonl``) exceeds ``max_drift``.  Unlike the baseline
+    gates this needs no second run — XLA's own ``cost_analysis()`` is
+    the reference — so the FIRST run of a series already fails when the
+    analytic cost model stops matching the compiler's accounting (and a
+    rerun with an unchanged model passes again)."""
+    out = []
+    seen = set()
+    for rec in records:
+        if rec.get('run') != run:
+            continue
+        drift = rec.get('model_drift')
+        if not isinstance(drift, (int, float)) or drift <= max_drift:
+            continue
+        key = (rec.get('model'), rec.get('dataset'))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append({'model': rec.get('model'),
+                    'dataset': rec.get('dataset'),
+                    'model_drift': drift,
+                    'drift_shape': rec.get('model_drift_shape'),
+                    'threshold': max_drift,
+                    'regression': 'model_drift'})
     return out
 
 

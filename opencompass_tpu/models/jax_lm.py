@@ -49,7 +49,21 @@ from opencompass_tpu.utils.perf import device_call
 from .base import BaseModel, _Lazy
 from .tokenizer import load_tokenizer
 
+try:
+    from opencompass_tpu.obs import devprof as _devprof
+except Exception:       # pragma: no cover — obs must never block models
+    _devprof = None
+
 logger = get_logger()
+
+
+def _step_scope(kind: str, **context):
+    """Sampled step profiling + OOM forensics around one device call
+    (obs/devprof.py); inert when the obs plane is unavailable."""
+    if _devprof is None:
+        import contextlib
+        return contextlib.nullcontext()
+    return _devprof.step_scope(kind, **context)
 
 
 def _bucket(n: int, lo: int = 32, hi: Optional[int] = None) -> int:
@@ -356,17 +370,19 @@ class ContinuousEngine:
                 self.occupancy_sum += len(active)
                 self._occ_series.append(len(active))
 
+        kind = 'prefill_chunk' if prefilling else 'decode'
         first = model._first_dispatch(
-            'prefill_chunk' if prefilling else 'decode',
-            (self.slots, t), self.temperature, self.top_k)
+            kind, (self.slots, t), self.temperature, self.top_k)
         cs0 = model.perf.compile_seconds
         t0 = time.perf_counter()
         rng = jax.random.fold_in(self._base_rng, step_no)
-        nxt, self.pool = self._step_fn(
-            model.params, self.pool, jnp.asarray(tokens),
-            jnp.asarray(start), jnp.asarray(n_new),
-            jnp.asarray(page_table), rng)
-        nxt = np.asarray(nxt)
+        with _step_scope(kind, site='engine_step', step=step_no,
+                         slots=self.slots, page_size=self.page_size):
+            nxt, self.pool = self._step_fn(
+                model.params, self.pool, jnp.asarray(tokens),
+                jnp.asarray(start), jnp.asarray(n_new),
+                jnp.asarray(page_table), rng)
+            nxt = np.asarray(nxt)
         elapsed = time.perf_counter() - t0
         self.device_seconds += elapsed
         perf = model.perf
@@ -375,9 +391,15 @@ class ContinuousEngine:
         if first:
             perf.compile_seconds += elapsed
             perf.first_calls += 1
+            # the post-step self.pool has the donated pool's shapes, so
+            # the compile audit's AOT re-lower sees the same avals the
+            # dispatch above compiled for
             model._note_compile(
-                'prefill_chunk' if prefilling else 'decode',
-                (self.slots, t), perf.compile_seconds - cs0)
+                kind, (self.slots, t), perf.compile_seconds - cs0,
+                fn=self._step_fn,
+                args=(model.params, self.pool, tokens, start, n_new,
+                      page_table, rng),
+                extra={'attn_width': self.max_pages * self.page_size})
 
         eos = model.eos_token_id
         retired: List[_EngineRow] = []
@@ -482,7 +504,16 @@ class ContinuousEngine:
                     self._base_rng)
                 jax.block_until_ready(nxt)
             model._note_compile(kind, (self.slots, t),
-                                model.perf.compile_seconds - cs0)
+                                model.perf.compile_seconds - cs0,
+                                fn=self._step_fn,
+                                args=(model.params, self.pool,
+                                      np.zeros((self.slots, t), np.int32),
+                                      np.zeros((self.slots,), np.int32),
+                                      np.zeros((self.slots,), np.int32),
+                                      np.asarray(self.table.table),
+                                      self._base_rng),
+                                extra={'attn_width':
+                                       self.max_pages * self.page_size})
             warmed += 1
         return warmed
 
@@ -605,6 +636,34 @@ class ContinuousEngine:
                 kv_positions=stats.get('kv_positions'),
                 attn_positions=stats.get('attn_positions'))
             return cm.fields(cost, stats.get('device_seconds'))
+        except Exception:
+            return {}
+
+    def profile_fields(self) -> Dict:
+        """Gather-share of decode step wall for this engine's drains
+        (obs/devprof.py): the sampled-trace measurement when
+        ``--profile-steps`` captured any, else the memory-bound
+        analytic share — labelled by ``gather_share_source`` so the
+        report can tell them apart.  Never raises."""
+        try:
+            out: Dict = {}
+            measured = None
+            if _devprof is not None:
+                out.update(_devprof.get_step_profiler().fields())
+                measured = out.get('gather_share_measured')
+                cm = self._costmodel
+                if cm is not None:
+                    out['gather_share_modeled'] = \
+                        _devprof.modeled_gather_share(
+                            cm, self.slots,
+                            self.max_pages * self.page_size)
+            share = measured if measured \
+                else out.get('gather_share_modeled')
+            if share:
+                out['gather_share'] = share
+                out['gather_share_source'] = \
+                    'measured' if measured else 'modeled'
+            return out
         except Exception:
             return {}
 
@@ -1074,15 +1133,28 @@ class JaxLM(BaseModel):
         return hashlib.blake2b(repr(ident).encode('utf-8'),
                                digest_size=8).hexdigest()
 
-    def _note_compile(self, kind: str, shape, seconds: float):
+    def _note_compile(self, kind: str, shape, seconds: float,
+                      fn=None, args=None, extra=None):
         """Record a first-dispatched shape bucket (and its observed
         first-call seconds) into the persistent cache's sidecar shape
-        manifest.  Never raises; no-op without a cache dir."""
+        manifest, and — when tracing is on — into the compile audit
+        (``{obs_dir}/compiles.jsonl``, obs/compileaudit.py).  ``fn`` /
+        ``args`` let the audit re-lower the just-compiled executable
+        (cache-served, ~ms) and read XLA's own cost/memory accounting;
+        ``extra['attn_width']`` carries the paged table width the
+        analytic reconciliation needs.  Never raises."""
         try:
             from opencompass_tpu.utils import compile_cache
             sig = self.shape_signature
             if sig:
                 compile_cache.record_shape(sig, kind, shape, seconds)
+        except Exception:
+            pass
+        try:
+            from opencompass_tpu.obs import compileaudit
+            compileaudit.get_compileaudit().record_compile(
+                kind, shape, seconds, fn=fn, args=args, model=self,
+                extra=extra)
         except Exception:
             pass
 
@@ -1139,18 +1211,21 @@ class JaxLM(BaseModel):
                                            int(spec['s']), max_len)
                     cs0 = self.perf.compile_seconds
                     spec_arrs = P('data', None)
+                    aot = None
                     tokens = self._put(np.full((B, S), pad, np.int32),
                                        spec_arrs)
                     mask = self._put(np.ones((B, S), bool), spec_arrs)
                     if kind == 'ppl':
                         if not self._first_dispatch('ppl', False, (B, S)):
                             continue
+                        mlb = self._put(np.zeros((B,), np.int32),
+                                        P('data'))
                         with device_call(self.perf, first=True):
-                            out = self._ppl_fn(
-                                self.params, tokens, mask,
-                                self._put(np.zeros((B,), np.int32),
-                                          P('data')))
+                            out = self._ppl_fn(self.params, tokens,
+                                               mask, mlb)
                             jax.block_until_ready(out)
+                        aot = (self._ppl_fn,
+                               (self.params, tokens, mask, mlb))
                     elif kind == 'choice':
                         if not self._first_dispatch('choice', (B, S)):
                             continue
@@ -1158,6 +1233,8 @@ class JaxLM(BaseModel):
                             out = self._choice_logits_fn(self.params,
                                                          tokens, mask)
                             jax.block_until_ready(out)
+                        aot = (self._choice_logits_fn,
+                               (self.params, tokens, mask))
                     elif kind == 'gen':
                         if not max_new:
                             # unknown decode length = unknown jit key; a
@@ -1175,11 +1252,14 @@ class JaxLM(BaseModel):
                         with device_call(self.perf, first=True):
                             out = fn(self.params, tokens, mask, rng)
                             jax.block_until_ready(out)
+                        aot = (fn, (self.params, tokens, mask, rng))
                     else:
                         continue
                     warmed += 1
+                    aot_fn, aot_args = aot if aot else (None, None)
                     self._note_compile(kind, (B, S),
-                                       self.perf.compile_seconds - cs0)
+                                       self.perf.compile_seconds - cs0,
+                                       fn=aot_fn, args=aot_args)
                 except Exception as exc:
                     logger.warning(
                         f'warm-up of {spec} failed (will compile '
@@ -1406,12 +1486,19 @@ class JaxLM(BaseModel):
                 # shared-prefix executables are batch-content-dependent;
                 # only plain-path shapes enter the manifest
                 self._note_compile('ppl', tokens.shape,
-                                   self.perf.compile_seconds - cs0)
+                                   self.perf.compile_seconds - cs0,
+                                   fn=self._ppl_fn,
+                                   args=(self.params,
+                                         self._put(tokens, spec),
+                                         self._put(mask, spec),
+                                         self._put(mlb, P('data'))))
         n = len(inputs)
+        shape = list(tokens.shape)
 
         def fetch():
             t0 = time.perf_counter()
-            out = np.asarray(nll)
+            with _step_scope('ppl', site='dense_fetch', shape=shape):
+                out = np.asarray(nll)
             dt = time.perf_counter() - t0
             self.perf.device_seconds += dt
             if info is not None:
@@ -1485,7 +1572,9 @@ class JaxLM(BaseModel):
                 info['dispatch_s'] = time.perf_counter() - td0
             if first:
                 self._note_compile('choice', tokens.shape,
-                                   self.perf.compile_seconds - cs0)
+                                   self.perf.compile_seconds - cs0,
+                                   fn=self._choice_logits_fn,
+                                   args=(self.params, tokens, mask))
         n = len(inputs)
 
         def fetch():
@@ -1686,6 +1775,7 @@ class JaxLM(BaseModel):
             if tl.enabled:
                 stats = engine.stats(since=snap)
                 fields = dict(stats, **engine.cost_fields(stats))
+                fields.update(engine.profile_fields())
                 if extra:
                     fields.update(extra)
                 tl.engine('gen', ts=round(t0, 6), rows=n_rows,
@@ -1747,13 +1837,19 @@ class JaxLM(BaseModel):
                 info['dispatch_s'] = time.perf_counter() - td0
             if first and prefix is None:
                 self._note_compile('gen', tokens.shape,
-                                   self.perf.compile_seconds - cs0)
+                                   self.perf.compile_seconds - cs0,
+                                   fn=fn,
+                                   args=(self.params,
+                                         self._put(tokens, spec),
+                                         self._put(mask, spec), rng))
         n_in = len(inputs)
+        shape = list(tokens.shape)
 
         def fetch():
             t0 = time.perf_counter()
-            out_h = np.asarray(out)
-            lengths_h = np.asarray(lengths)
+            with _step_scope('gen', site='dense_fetch', shape=shape):
+                out_h = np.asarray(out)
+                lengths_h = np.asarray(lengths)
             dt = time.perf_counter() - t0
             self.perf.device_seconds += dt
             decode_tokens = int(lengths_h[:n_in].sum())

@@ -26,8 +26,15 @@ import os.path as osp
 import time
 from typing import Dict, Optional
 
+from opencompass_tpu.obs import compileaudit as _compileaudit
+from opencompass_tpu.obs import devprof as _devprof
 from opencompass_tpu.obs import live as _live
 from opencompass_tpu.obs import timeline as _timeline
+from opencompass_tpu.obs.compileaudit import (CompileAudit,
+                                              NoopCompileAudit,
+                                              get_compileaudit)
+from opencompass_tpu.obs.devprof import (NoopStepProfiler, StepProfiler,
+                                         get_step_profiler)
 from opencompass_tpu.obs.live import (Heartbeat, NoopHeartbeat,
                                       get_heartbeat, heartbeat_path)
 from opencompass_tpu.obs.metrics import (Counter, Gauge, Histogram,
@@ -46,6 +53,9 @@ __all__ = ['Counter', 'Gauge', 'Histogram', 'LATENCY_BUCKETS_S',
            'get_heartbeat', 'heartbeat_path', 'init_task_heartbeat',
            'NoopTimeline', 'Timeline', 'get_timeline', 'timeline_path',
            'init_task_timeline',
+           'CompileAudit', 'NoopCompileAudit', 'get_compileaudit',
+           'init_task_compileaudit',
+           'StepProfiler', 'NoopStepProfiler', 'get_step_profiler',
            'ENV_TRACE_ID', 'ENV_PARENT_SPAN', 'ENV_OBS_DIR']
 
 _NOOP = NoopTracer()
@@ -141,6 +151,22 @@ def init_task_timeline(task_name: str):
         return _timeline.get_timeline()
 
 
+def init_task_compileaudit(task_name: str):
+    """Install the process-wide :class:`CompileAudit` with task
+    attribution (``{obs_dir}/compiles.jsonl``).  Optional — the audit
+    auto-binds to the tracer on the first recorded compile even without
+    this call; installing it here just stamps records with the task
+    name.  Follows the heartbeat policy; never raises."""
+    tracer = get_tracer()
+    if not tracer.enabled:
+        return _compileaudit.get_compileaudit()
+    try:
+        return _compileaudit.install_compileaudit(
+            CompileAudit(tracer.obs_dir, task=task_name))
+    except Exception:
+        return _compileaudit.get_compileaudit()
+
+
 def reset_obs():
     """Drop back to the NoopTracer (closing any live sink) — test hook."""
     global _TRACER
@@ -152,6 +178,8 @@ def reset_obs():
     _TRACER = _NOOP
     _live.reset_heartbeat()
     _timeline.reset_timeline()
+    _compileaudit.reset_compileaudit()
+    _devprof.reset_devprof()
 
 
 def obs_enabled(cfg: Dict) -> bool:

@@ -1,0 +1,392 @@
+"""Compile audit: durable per-executable XLA cost/memory records.
+
+Every number the roofline layer reports today is *analytic* —
+:mod:`opencompass_tpu.obs.costmodel` derives FLOPs and bytes from model
+geometry.  This module records what the **compiler** says each
+executable costs, so the analytic model can be audited instead of
+asserted:
+
+- ``JaxLM._note_compile`` (the single funnel every first-dispatched
+  shape already passes through for the compile-cache shape manifest)
+  calls :func:`get_compileaudit().record_compile(...) <CompileAudit.
+  record_compile>` with the jitted callable and its call args;
+- the audit re-lowers and re-compiles ahead-of-time —
+  ``fn.lower(*args).compile()`` — which is served out of jax's
+  in-process/persistent compilation cache in milliseconds (measured
+  ~5 ms on the tiny model; the fresh compile the program just paid for
+  is the cache entry), then reads XLA's own accounting:
+  ``compiled.cost_analysis()`` (flops, bytes accessed, transcendentals)
+  and ``compiled.memory_analysis()`` (argument/output/temp/generated-
+  code bytes plus donated-alias bytes — donation effectiveness);
+- each record joins the analytic expectation for the same shape
+  (:func:`model_expectation`) and carries ``model_drift`` — the
+  relative flop disagreement the ``ledger check --max-model-drift``
+  gate and the ``model_drift`` doctor rule consume;
+- records land in ``{obs_dir}/compiles.jsonl`` through
+  ``utils.fileio.append_jsonl_atomic`` (single-write ``O_APPEND``,
+  torn-line recovery on read).
+
+Cache-served compiles are cheap and analysing them again tells us
+nothing new: ``utils.compile_cache``'s ``jax.monitoring`` listener
+forwards hit/miss events here (:func:`note_cache_event`), and a first
+dispatch whose window saw only hits is recorded as ``{"hit": true}``
+without re-analysis.
+
+Never-fail contract: every public entry point is exception-guarded —
+a broken profiler must not fail a run.  ``OCT_COMPILE_AUDIT=0``
+disables AOT analysis (records still carry shape + compile wall), for
+sharded deployments where a re-lower without the original shardings
+would itself trigger a fresh compile.
+"""
+# oct-lint: clock-discipline
+from __future__ import annotations
+
+import os
+import os.path as osp
+import threading
+import time
+from typing import Dict, Iterable, List, Optional
+
+from opencompass_tpu.utils.fileio import (append_jsonl_atomic,
+                                          iter_jsonl_records)
+
+COMPILES_FILE = 'compiles.jsonl'
+AUDIT_VERSION = 1
+
+ENV_AUDIT = 'OCT_COMPILE_AUDIT'            # '0' disables AOT analysis
+# fault-injection knob: scale the analytic expectation by (1 + frac) so
+# the --max-model-drift CI gate can be exercised without editing the
+# cost model (same pattern as the chaos/fault knobs elsewhere)
+ENV_DRIFT_INJECT = 'OCT_MODEL_DRIFT_INJECT'
+
+
+def compiles_path(obs_dir: str) -> str:
+    return osp.join(obs_dir, COMPILES_FILE)
+
+
+# -- analytic expectation ---------------------------------------------------
+
+def model_expectation(model, kind: str, shape,
+                      extra: Optional[Dict] = None) -> Optional[Dict]:
+    """What :mod:`costmodel` predicts the *compiled executable* for
+    ``(kind, shape)`` should cost, in XLA's terms.
+
+    The expectation mirrors what XLA's ``HloCostAnalysis`` actually
+    counts for our compiled programs, which differs from wall-clock
+    arithmetic in three verified ways:
+
+    - **Dense rectangles.**  Every query position attends the full
+      padded key width (causal masking zeroes weights, not work):
+      pairs are ``B*S*S`` for the scoring executables and
+      ``slots*t*table_width`` for the paged engine step
+      (``extra['attn_width']``).
+    - **Scanned stacks count once.**  With ``cfg.scan_layers`` the
+      layer stack is a single ``lax.scan`` whose body HLO appears once
+      in the module; XLA reports one body's flops regardless of trip
+      count, so the per-layer terms are divided by ``num_layers``.
+    - **Engine head is per-slot.**  ``prefill_chunk``/``decode``
+      executables project logits only at the last position of each
+      slot (``B`` tokens through the LM head); ``ppl``/``choice``
+      project every position (``B*S`` tokens).
+    - **Per-device modules.**  ``cost_analysis`` describes the program
+      one device runs: the scoring executables shard their batch over
+      the ``data`` mesh axis, so the expectation divides ``B`` by the
+      data-parallel degree (the batch bucketing already pads ``B`` to
+      a multiple of it).  The engine's slot pool is replicated, not
+      sharded — engine kinds keep the full batch.
+
+    Dense ``gen`` executables wrap a decode ``while``-loop whose trip
+    count XLA cannot see, so they have no well-defined static
+    expectation and return None.
+    """
+    try:
+        from opencompass_tpu.obs.costmodel import (CostModel,
+                                                   flops_attention,
+                                                   flops_matmul)
+    except Exception:
+        return None
+    cm = CostModel.for_model(model) if model is not None else None
+    if cm is None:
+        return None
+    cfg = cm.cfg
+    b, s = int(shape[0]), int(shape[1])
+    if kind in ('ppl', 'choice'):
+        try:
+            mesh = getattr(model, 'mesh', None)
+            dp = int(mesh.shape.get('data', 1)) if mesh is not None \
+                else 1
+        except Exception:
+            dp = 1
+        b = max(1, b // max(1, dp))
+        tokens = b * s
+        pairs = tokens * s
+        head_tokens = tokens
+    elif kind in ('prefill_chunk', 'decode'):
+        width = int((extra or {}).get('attn_width') or 0)
+        if not width:
+            return None
+        tokens = b * s
+        pairs = tokens * width
+        head_tokens = b
+    else:
+        return None
+    head_params = float(cfg.vocab_size * cfg.hidden_size)
+    # flops_matmul counts all layers + head per token; split the head
+    # out so layer and head terms can scale independently
+    layer_params = float(flops_matmul(cfg, 1)) / 2.0 - head_params
+    layers_counted = (1 if getattr(cfg, 'scan_layers', False)
+                      else cfg.num_layers)
+    scale = layers_counted / float(cfg.num_layers)
+    flops = (2.0 * layer_params * tokens * scale
+             + float(flops_attention(cfg, pairs)) * scale
+             + 2.0 * head_params * head_tokens)
+    inject = os.environ.get(ENV_DRIFT_INJECT)
+    if inject:
+        try:
+            flops *= 1.0 + float(inject)
+        except ValueError:
+            pass
+    return {'flops': flops}
+
+
+def analyze_executable(fn, args) -> Dict:
+    """XLA's own accounting for the executable ``fn`` compiles for
+    ``args``' shapes: ``fn.lower(*args).compile()`` is served from the
+    compilation cache the real dispatch just populated (~ms), and the
+    compiled object exposes per-module cost and memory analyses."""
+    out: Dict[str, Dict] = {}
+    compiled = fn.lower(*args).compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if isinstance(ca, dict):
+            out['cost'] = {
+                'flops': float(ca.get('flops', 0.0)),
+                'bytes_accessed': float(ca.get('bytes accessed', 0.0)),
+                'transcendentals': float(ca.get('transcendentals', 0.0)),
+            }
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            arg_b = int(getattr(ma, 'argument_size_in_bytes', 0))
+            alias_b = int(getattr(ma, 'alias_size_in_bytes', 0))
+            mem = {
+                'argument_bytes': arg_b,
+                'output_bytes': int(getattr(ma, 'output_size_in_bytes',
+                                            0)),
+                'temp_bytes': int(getattr(ma, 'temp_size_in_bytes', 0)),
+                'code_bytes': int(getattr(
+                    ma, 'generated_code_size_in_bytes', 0)),
+                'alias_bytes': alias_b,
+            }
+            if arg_b > 0:
+                # donation effectiveness: the fraction of argument HBM
+                # the compiler aliased into outputs instead of copying
+                mem['donated_frac'] = round(alias_b / arg_b, 4)
+            out['memory'] = mem
+    except Exception:
+        pass
+    return out
+
+
+class NoopCompileAudit:
+    """Inert twin used whenever tracing is off; callable everywhere."""
+    enabled = False
+
+    def note_cache_event(self, key: str):
+        pass
+
+    def record_compile(self, *args, **kwargs):
+        pass
+
+
+class CompileAudit:
+    """Durable per-executable compile records for one obs dir."""
+
+    enabled = True
+
+    def __init__(self, obs_dir: str, task: Optional[str] = None):
+        self.path = compiles_path(obs_dir)
+        self.task = task
+        self._lock = threading.Lock()
+        # pending persistent-cache hit/miss events since the last
+        # record, forwarded by utils.compile_cache's monitoring
+        # listener  # guarded-by: _lock
+        self._pending = {'hits': 0, 'misses': 0}
+
+    def note_cache_event(self, key: str):
+        """Fold one ``jax.monitoring`` cache event ('hits'/'misses')
+        into the window the next :meth:`record_compile` drains."""
+        try:
+            with self._lock:
+                if key in self._pending:
+                    self._pending[key] += 1
+        except Exception:
+            pass
+
+    def record_compile(self, kind: str, shape, seconds: float,
+                       fn=None, args=None, model=None,
+                       extra: Optional[Dict] = None,
+                       now: Optional[float] = None):
+        """Append one compile record.  Exception-guarded: telemetry
+        must never fail the dispatch that triggered it."""
+        try:
+            self._record(kind, shape, seconds, fn, args, model, extra,
+                         now)
+        except Exception:
+            pass
+
+    def _record(self, kind, shape, seconds, fn, args, model, extra,
+                now):
+        with self._lock:
+            hits = self._pending['hits']
+            misses = self._pending['misses']
+            self._pending['hits'] = 0
+            self._pending['misses'] = 0
+        # a first dispatch whose event window saw only cache hits was
+        # deserialized, not compiled — record the hit, skip re-analysis
+        hit = hits > 0 and misses == 0
+        rec: Dict = {
+            'v': AUDIT_VERSION,
+            't': 'compile',
+            'ts': round(time.time() if now is None else now, 6),
+            'kind': kind,
+            'shape': [int(shape[0]), int(shape[1])],
+            'shape_key': f'{kind}:{int(shape[0])}x{int(shape[1])}',
+            'compile_seconds': round(float(seconds), 6),
+            'cc_hits': hits,
+            'cc_misses': misses,
+            'hit': hit,
+        }
+        sig = getattr(model, 'shape_signature', None)
+        if sig:
+            rec['model_sig'] = sig
+        if self.task:
+            rec['task'] = self.task
+        width = int((extra or {}).get('attn_width') or 0)
+        if width:
+            rec['attn_width'] = width
+        analyzed = (not hit and fn is not None and args is not None
+                    and os.environ.get(ENV_AUDIT, '1') not in
+                    ('0', 'false'))
+        if analyzed:
+            try:
+                rec.update(analyze_executable(fn, args))
+            except Exception:
+                pass
+            # the AOT re-compile above emits its own cache-hit events;
+            # drop them so they don't masquerade as the NEXT dispatch's
+            # cache activity (best effort — a concurrent thread's real
+            # event can be absorbed, which only skews the counters)
+            with self._lock:
+                self._pending['hits'] = 0
+                self._pending['misses'] = 0
+        expected = model_expectation(model, kind, shape, extra)
+        if expected:
+            rec['model'] = {'flops': round(expected['flops'], 1)}
+            xla_flops = rec.get('cost', {}).get('flops')
+            if xla_flops:
+                rec['model_drift'] = round(
+                    abs(xla_flops - expected['flops'])
+                    / max(xla_flops, 1.0), 6)
+        append_jsonl_atomic(self.path, [rec])
+
+
+# -- module registry (obs install/get/reset pattern) ------------------------
+
+_NOOP = NoopCompileAudit()
+_AUDIT: Optional[CompileAudit] = None
+_AUDIT_LOCK = threading.Lock()
+
+
+def install_compileaudit(audit: CompileAudit) -> CompileAudit:
+    global _AUDIT
+    with _AUDIT_LOCK:
+        _AUDIT = audit
+    return audit
+
+
+def get_compileaudit():
+    """The process audit.  Auto-binds to the live tracer's obs dir the
+    first time tracing is enabled, so every traced process records its
+    compiles with zero per-task wiring; the noop twin otherwise."""
+    global _AUDIT
+    audit = _AUDIT
+    if audit is not None:
+        return audit
+    try:
+        from opencompass_tpu.obs import get_tracer
+        tracer = get_tracer()
+        if not (tracer.enabled and getattr(tracer, 'obs_dir', None)):
+            return _NOOP
+        with _AUDIT_LOCK:
+            if _AUDIT is None:
+                _AUDIT = CompileAudit(tracer.obs_dir)
+            return _AUDIT
+    except Exception:
+        return _NOOP
+
+
+def reset_compileaudit():
+    global _AUDIT
+    with _AUDIT_LOCK:
+        _AUDIT = None
+
+
+def note_cache_event(key: str):
+    """Module-level forwarding target for ``utils.compile_cache``'s
+    monitoring listener ('hits' / 'misses').  Never raises."""
+    try:
+        get_compileaudit().note_cache_event(key)
+    except Exception:
+        pass
+
+
+# -- readers ----------------------------------------------------------------
+
+def iter_compiles(path: str) -> Iterable[Dict]:
+    """Parseable compile records of ``path`` (torn lines skipped)."""
+    return iter_jsonl_records(
+        path, keep=lambda r: r.get('t') == 'compile')
+
+
+def read_compiles(obs_dir: str) -> List[Dict]:
+    return list(iter_compiles(compiles_path(obs_dir)))
+
+
+def summarize_compiles(records: List[Dict]) -> Dict:
+    """Fold compile records into the report/ledger summary: counts,
+    compile wall, XLA totals, and the worst measured-vs-modeled flop
+    drift (with the shape that produced it)."""
+    fresh = [r for r in records if not r.get('hit')]
+    analyzed = [r for r in fresh if r.get('cost')]
+    drifts = [(r.get('shape_key'), r['model_drift'])
+              for r in fresh if r.get('model_drift') is not None]
+    out: Dict = {
+        'records': len(records),
+        'fresh': len(fresh),
+        'cache_hits': len(records) - len(fresh),
+        'analyzed': len(analyzed),
+        'compile_seconds': round(sum(
+            float(r.get('compile_seconds') or 0.0) for r in records), 3),
+    }
+    if analyzed:
+        out['xla_flops'] = sum(r['cost'].get('flops', 0.0)
+                               for r in analyzed)
+        out['xla_bytes_accessed'] = sum(
+            r['cost'].get('bytes_accessed', 0.0) for r in analyzed)
+        temp = [r['memory'].get('temp_bytes', 0) for r in analyzed
+                if r.get('memory')]
+        if temp:
+            out['temp_bytes_peak'] = max(temp)
+    if drifts:
+        worst = max(drifts, key=lambda kv: kv[1])
+        out['model_drift_max'] = round(worst[1], 6)
+        out['model_drift_mean'] = round(
+            sum(d for _, d in drifts) / len(drifts), 6)
+        out['model_drift_worst_shape'] = worst[0]
+        out['reconciled'] = len(drifts)
+    return out

@@ -1,0 +1,521 @@
+"""Device introspection: HBM accounting, OOM forensics, and sampled
+step profiling.
+
+Three planes, all feeding surfaces that already exist:
+
+- **HBM gauges** (:func:`hbm_gauges`): per-device allocator stats
+  (``bytes_in_use`` / ``peak_bytes_in_use`` vs ``bytes_limit``) folded
+  into the heartbeat on its own cadence, so ``status.json`` /
+  ``/metrics`` (``oct_hbm_*``) / ``cli status`` / ``cli top`` show live
+  HBM used/high-water fractions next to the kv_pool gauges.  On the
+  same cadence (rate-limited) a ``jax.profiler.device_memory_profile``
+  snapshot is kept in memory and mirrored to
+  ``{obs_dir}/hbm_profile.pb.gz`` for offline pprof inspection.
+- **OOM forensics** (:func:`dump_oom`, :func:`oom_guard`): when a
+  device step dies with ``RESOURCE_EXHAUSTED``, the allocator stats,
+  the memory profile, and the top executables by HBM footprint (from
+  the compile audit) are dumped to ``{obs_dir}/oom/`` before the error
+  re-raises — the forensics you need exactly when the process is about
+  to die.
+- **Sampled step profiling** (:class:`StepProfiler`): ``--profile-steps
+  N`` (env ``OCT_PROFILE_STEPS``) captures N stride-sampled
+  ``jax.profiler`` traces around engine steps / dense batches, parses
+  the emitted Chrome-trace JSON (op-level XLA events), and attributes
+  device wall to op categories — the ``gather`` share of decode step
+  wall is the direct before/after counter for the ragged-paged-
+  attention kernel (ROADMAP item 1).  When no trace sample is
+  available the memory-bound analytic share
+  (:func:`modeled_gather_share`) stands in, labelled ``modeled``.
+
+Never-fail contract: every entry point is exception-guarded; a broken
+profiler must not fail a run.
+"""
+# oct-lint: clock-discipline
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import os.path as osp
+import tempfile
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from opencompass_tpu.utils.fileio import atomic_write_json
+
+OOM_DIR = 'oom'
+STEPPROF_DIR = 'stepprof'
+HBM_PROFILE_FILE = 'hbm_profile.pb.gz'
+
+ENV_PROFILE_STEPS = 'OCT_PROFILE_STEPS'    # traces to capture (N)
+ENV_PROFILE_STRIDE = 'OCT_PROFILE_STRIDE'  # steps between captures
+
+# seconds between device_memory_profile snapshots (each serializes a
+# pprof protobuf; the allocator-stat gauges themselves are cheap and
+# sampled on every heartbeat)
+PROFILE_SNAPSHOT_EVERY_S = 15.0
+
+
+# -- allocator stats --------------------------------------------------------
+
+def device_memory_stats() -> List[Dict]:
+    """Per-device allocator stats (``device.memory_stats()``), one dict
+    per local device; [] on CPU-only or any failure."""
+    try:
+        import jax
+        out = []
+        for dev in jax.local_devices():
+            stats = dev.memory_stats() or {}
+            if not stats:
+                continue
+            rec = {'device': str(dev), 'platform': dev.platform}
+            for key in ('bytes_in_use', 'peak_bytes_in_use',
+                        'bytes_limit', 'largest_alloc_size',
+                        'bytes_reserved', 'num_allocs'):
+                if key in stats:
+                    rec[key] = int(stats[key])
+            out.append(rec)
+        return out
+    except Exception:
+        return []
+
+
+class HbmSampler:
+    """Process-wide HBM gauge fold: live used fraction + monotone
+    high-water, with a rate-limited memory-profile snapshot."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._high_water = 0.0
+        # guarded-by: _lock
+        self._last_snapshot_mono = 0.0
+        # last captured device_memory_profile (gzipped pprof bytes) —
+        # the OOM dump's fallback when a post-OOM capture fails
+        # guarded-by: _lock
+        self._last_profile = b''
+
+    def gauges(self, obs_dir: Optional[str] = None) -> Dict[str, float]:
+        """{'hbm_used_frac', 'hbm_high_water_frac'} from device 0's
+        allocator, {} on CPU-only platforms (no ``bytes_limit``)."""
+        try:
+            stats = device_memory_stats()
+            if not stats:
+                return {}
+            first = stats[0]
+            limit = float(first.get('bytes_limit') or 0.0)
+            if limit <= 0:
+                return {}
+            used = float(first.get('bytes_in_use', 0)) / limit
+            peak = float(first.get('peak_bytes_in_use', 0)) / limit
+            with self._lock:
+                self._high_water = max(self._high_water, used, peak)
+                high = self._high_water
+            self._maybe_snapshot(obs_dir)
+            return {'hbm_used_frac': round(used, 4),
+                    'hbm_high_water_frac': round(high, 4)}
+        except Exception:
+            return {}
+
+    def last_profile(self) -> bytes:
+        with self._lock:
+            return self._last_profile
+
+    def _maybe_snapshot(self, obs_dir: Optional[str]):
+        """Rate-limited ``device_memory_profile`` capture; mirrored to
+        ``{obs_dir}/hbm_profile.pb.gz`` when an obs dir is known."""
+        mono = time.monotonic()
+        with self._lock:
+            if mono - self._last_snapshot_mono < PROFILE_SNAPSHOT_EVERY_S:
+                return
+            self._last_snapshot_mono = mono
+        try:
+            import jax
+            data = jax.profiler.device_memory_profile()
+        except Exception:
+            return
+        if not data:
+            return
+        with self._lock:
+            self._last_profile = data
+        if obs_dir:
+            try:
+                _atomic_write_bytes(
+                    osp.join(obs_dir, HBM_PROFILE_FILE), data)
+            except Exception:
+                pass
+
+
+def _atomic_write_bytes(path: str, data: bytes):
+    """Binary sibling of atomic_write_json: temp + ``os.replace`` so
+    readers never see a half-written profile."""
+    dirname = osp.dirname(osp.abspath(path))
+    os.makedirs(dirname, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=dirname, suffix='.tmp')
+    try:
+        with os.fdopen(fd, 'wb') as f:
+            f.write(data)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+_SAMPLER = HbmSampler()
+
+
+def hbm_gauges(obs_dir: Optional[str] = None) -> Dict[str, float]:
+    """Module-level convenience over the process :class:`HbmSampler`;
+    the heartbeat writer calls this on every status fold."""
+    return _SAMPLER.gauges(obs_dir)
+
+
+# -- OOM forensics ----------------------------------------------------------
+
+_OOM_SEQ_LOCK = threading.Lock()
+_OOM_SEQ = [0]
+
+
+def is_oom(exc) -> bool:
+    """True for XLA allocation failures (``RESOURCE_EXHAUSTED`` /
+    "Resource exhausted" in the message)."""
+    try:
+        msg = str(exc)
+    except Exception:
+        return False
+    return 'RESOURCE_EXHAUSTED' in msg or 'Resource exhausted' in msg
+
+
+def dump_oom(context: Optional[Dict] = None, exc=None,
+             obs_dir: Optional[str] = None,
+             now: Optional[float] = None) -> Optional[str]:
+    """Write OOM forensics to ``{obs_dir}/oom/``: allocator stats, the
+    caller's context (shape, step, pool geometry), the top executables
+    by HBM footprint from the compile audit, and — when capturable —
+    the raw memory profile.  Returns the dump path, or None when no
+    obs dir is resolvable.  Never raises."""
+    try:
+        if obs_dir is None:
+            from opencompass_tpu.obs import get_tracer
+            tracer = get_tracer()
+            if tracer.enabled and getattr(tracer, 'obs_dir', None):
+                obs_dir = tracer.obs_dir
+        if not obs_dir:
+            return None
+        with _OOM_SEQ_LOCK:
+            _OOM_SEQ[0] += 1
+            seq = _OOM_SEQ[0]
+        base = f'oom-{os.getpid()}-{seq:03d}'
+        path = osp.join(obs_dir, OOM_DIR, base + '.json')
+        info: Dict = {
+            'v': 1,
+            'ts': round(time.time() if now is None else now, 6),
+            'pid': os.getpid(),
+            'error': str(exc)[:2000] if exc is not None else None,
+            'context': context or {},
+            'device_memory': device_memory_stats(),
+            'top_executables': _top_executables(obs_dir),
+        }
+        profile = b''
+        try:
+            import jax
+            profile = jax.profiler.device_memory_profile() or b''
+        except Exception:
+            pass
+        if not profile:
+            # post-OOM captures can themselves fail to allocate; fall
+            # back to the sampler's last periodic snapshot
+            profile = _SAMPLER.last_profile()
+        if profile:
+            prof_path = osp.join(obs_dir, OOM_DIR,
+                                 base + '.memprof.pb.gz')
+            try:
+                _atomic_write_bytes(prof_path, profile)
+                info['memory_profile'] = osp.basename(prof_path)
+            except Exception:
+                pass
+        atomic_write_json(path, info)
+        return path
+    except Exception:
+        return None
+
+
+def _top_executables(obs_dir: str, top_n: int = 8) -> List[Dict]:
+    """Largest analyzed executables by resident HBM (argument + temp +
+    output bytes) from this run's compile audit — the "top allocations"
+    view of what was on the device when the allocator gave up."""
+    try:
+        from opencompass_tpu.obs import compileaudit
+        rows = []
+        for rec in compileaudit.read_compiles(obs_dir):
+            mem = rec.get('memory') or {}
+            if not mem:
+                continue
+            total = (mem.get('argument_bytes', 0)
+                     + mem.get('temp_bytes', 0)
+                     + mem.get('output_bytes', 0))
+            rows.append({'shape_key': rec.get('shape_key'),
+                         'bytes': int(total),
+                         'argument_bytes': mem.get('argument_bytes', 0),
+                         'temp_bytes': mem.get('temp_bytes', 0),
+                         'output_bytes': mem.get('output_bytes', 0)})
+        rows.sort(key=lambda r: -r['bytes'])
+        return rows[:top_n]
+    except Exception:
+        return []
+
+
+@contextmanager
+def oom_guard(**context):
+    """Re-raise everything; on an allocation failure, dump forensics
+    first (``{obs_dir}/oom/``)."""
+    try:
+        yield
+    except Exception as exc:
+        if is_oom(exc):
+            dump_oom(context, exc=exc)
+        raise
+
+
+# -- sampled step profiling -------------------------------------------------
+
+# op-name → category for device-wall attribution.  Order matters:
+# fusions are named after their root op, so 'gather_fusion' must land
+# in 'gather', not 'elementwise'.
+_GATHER_MARKS = ('gather', 'scatter', 'dynamic-slice', 'dynamic_slice',
+                 'dynamic-update', 'dynamic_update', 'take')
+_MATMUL_MARKS = ('dot', 'conv', 'einsum', 'matmul')
+# host-side wrapper/runtime events that are not device op work
+_WRAPPER_MARKS = ('pjitfunction', 'executable', 'thunk', 'threadpool',
+                  'parseargs', 'start_trace', 'stop_trace', 'xlacompile',
+                  'backend_compile', 'transferto', 'transferfrom',
+                  'bufferfromhost', 'copytohost', '__exit__')
+
+
+def categorize_op(name: str) -> Optional[str]:
+    """'gather' / 'matmul' / 'elementwise' for XLA op events; None for
+    host wrappers and runtime scaffolding."""
+    low = name.lower()
+    if low.startswith('$') or '::' in low:
+        return None
+    if any(mark in low for mark in _WRAPPER_MARKS):
+        return None
+    if any(mark in low for mark in _GATHER_MARKS):
+        return 'gather'
+    if any(mark in low for mark in _MATMUL_MARKS):
+        return 'matmul'
+    return 'elementwise'
+
+
+def parse_trace_dir(trace_dir: str) -> Dict[str, float]:
+    """Fold every ``*.trace.json.gz`` under ``trace_dir`` (the Chrome-
+    trace emission of one ``jax.profiler`` session) into seconds per op
+    category.  {} when nothing parseable is found."""
+    totals: Dict[str, float] = {}
+    for dirpath, _dirnames, filenames in os.walk(trace_dir):
+        for fname in filenames:
+            if not fname.endswith('.trace.json.gz'):
+                continue
+            try:
+                with gzip.open(osp.join(dirpath, fname), 'rt',
+                               encoding='utf-8', errors='replace') as f:
+                    doc = json.load(f)
+            except Exception:
+                continue
+            for event in doc.get('traceEvents', []):
+                if not isinstance(event, dict):
+                    continue
+                if event.get('ph') != 'X':
+                    continue
+                dur = event.get('dur')
+                name = event.get('name')
+                if not dur or not isinstance(name, str):
+                    continue
+                cat = categorize_op(name)
+                if cat is None:
+                    continue
+                totals[cat] = totals.get(cat, 0.0) + float(dur) * 1e-6
+    return totals
+
+
+class NoopStepProfiler:
+    enabled = False
+
+    @contextmanager
+    def maybe_trace(self, kind: str):
+        yield False
+
+    def fields(self) -> Dict:
+        return {}
+
+
+class StepProfiler:
+    """Stride-sampled ``jax.profiler`` traces around device steps.
+
+    ``max_traces`` bounds total captures (``--profile-steps N``);
+    ``stride`` spaces them out per step kind so samples land past the
+    warm-up step (step 0 — the compile — is never sampled)."""
+
+    enabled = True
+
+    def __init__(self, obs_dir: str, max_traces: int = 4,
+                 stride: int = 16):
+        self.dir = osp.join(obs_dir, STEPPROF_DIR)
+        self.max_traces = max(1, int(max_traces))
+        self.stride = max(1, int(stride))
+        self._lock = threading.Lock()
+        # dispatch count per step kind  # guarded-by: _lock
+        self._seen: Dict[str, int] = {}
+        # guarded-by: _lock
+        self._captured = 0
+        # accumulated device seconds per op category  # guarded-by: _lock
+        self._category_s: Dict[str, float] = {}
+
+    @contextmanager
+    def maybe_trace(self, kind: str):
+        """Trace this step when it falls on the sampling stride and the
+        capture budget is not exhausted; yields whether it did."""
+        trace_dir = None
+        with self._lock:
+            seen = self._seen.get(kind, 0)
+            self._seen[kind] = seen + 1
+            if (seen > 0 and self._captured < self.max_traces
+                    and seen % self.stride == 1 % self.stride):
+                self._captured += 1
+                trace_dir = osp.join(self.dir, f'{kind}-{seen:06d}')
+        if trace_dir is None:
+            yield False
+            return
+        started = False
+        try:
+            import jax
+            jax.profiler.start_trace(trace_dir)
+            started = True
+        except Exception:
+            # another session may already be tracing (cli --xprof);
+            # sampling simply stands down
+            pass
+        try:
+            yield started
+        finally:
+            if started:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                try:
+                    cats = parse_trace_dir(trace_dir)
+                    if cats:
+                        with self._lock:
+                            for cat, secs in cats.items():
+                                self._category_s[cat] = \
+                                    self._category_s.get(cat, 0.0) + secs
+                except Exception:
+                    pass
+
+    def fields(self) -> Dict:
+        """Fold of all captures so far: sampled-step count, per-category
+        device seconds, and the measured gather share of sampled wall."""
+        with self._lock:
+            cats = dict(self._category_s)
+            captured = self._captured
+        if not captured:
+            return {}
+        out: Dict = {'profiled_steps': captured}
+        total = sum(cats.values())
+        if total > 0:
+            out['profile_categories'] = {
+                cat: round(secs, 6) for cat, secs in sorted(cats.items())}
+            out['gather_share_measured'] = round(
+                cats.get('gather', 0.0) / total, 4)
+        return out
+
+
+def modeled_gather_share(costmodel, slots: int,
+                         table_positions: int) -> float:
+    """Memory-bound analytic share of one decode step's HBM traffic
+    spent on the paged-KV gather: every slot reads its full table width
+    of KV bytes against the step's weight read + KV append."""
+    try:
+        kv_read = float(costmodel.kv_token_bytes) * float(slots) \
+            * float(table_positions)
+        kv_write = float(costmodel.kv_token_bytes) * float(slots)
+        weights = float(costmodel.weight_bytes)
+        total = kv_read + kv_write + weights
+        return round(kv_read / total, 4) if total > 0 else 0.0
+    except Exception:
+        return 0.0
+
+
+# -- step-profiler registry -------------------------------------------------
+
+_NOOP_PROFILER = NoopStepProfiler()
+_PROFILER: Optional[StepProfiler] = None
+_PROFILER_LOCK = threading.Lock()
+
+
+def install_step_profiler(profiler) -> StepProfiler:
+    global _PROFILER
+    with _PROFILER_LOCK:
+        _PROFILER = profiler
+    return profiler
+
+
+def get_step_profiler():
+    """The process step profiler.  Auto-binds when ``OCT_PROFILE_STEPS``
+    is a positive count and tracing is enabled; noop twin otherwise."""
+    global _PROFILER
+    profiler = _PROFILER
+    if profiler is not None:
+        return profiler
+    try:
+        steps = int(os.environ.get(ENV_PROFILE_STEPS, '0') or 0)
+    except ValueError:
+        steps = 0
+    if steps <= 0:
+        return _NOOP_PROFILER
+    try:
+        from opencompass_tpu.obs import get_tracer
+        tracer = get_tracer()
+        if not (tracer.enabled and getattr(tracer, 'obs_dir', None)):
+            return _NOOP_PROFILER
+        try:
+            stride = int(os.environ.get(ENV_PROFILE_STRIDE, '16') or 16)
+        except ValueError:
+            stride = 16
+        with _PROFILER_LOCK:
+            if _PROFILER is None:
+                _PROFILER = StepProfiler(tracer.obs_dir,
+                                         max_traces=steps,
+                                         stride=stride)
+            return _PROFILER
+    except Exception:
+        return _NOOP_PROFILER
+
+
+def reset_devprof():
+    """Drop the process profiler + HBM high-water (obs re-init)."""
+    global _PROFILER, _SAMPLER
+    with _PROFILER_LOCK:
+        _PROFILER = None
+    _SAMPLER = HbmSampler()
+
+
+@contextmanager
+def step_scope(kind: str, **context):
+    """One context for a device step: sampled profiling + OOM
+    forensics.  Used by the engine step loop and the dense batch
+    dispatch paths."""
+    profiler = get_step_profiler()
+    with profiler.maybe_trace(kind):
+        try:
+            yield
+        except Exception as exc:
+            if is_oom(exc):
+                dump_oom(dict(context, kind=kind), exc=exc)
+            raise

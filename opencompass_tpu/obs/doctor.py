@@ -54,6 +54,14 @@ never to a crash):
                          or its circuit is open, from the scheduler's
                          durable ``outbound.json`` snapshot, with the
                          pacing/capacity remediation.
+- ``hbm_pressure``       (warn)  sampled device-HBM high-water above
+                         ~90% of capacity (one more allocation from an
+                         OOM), with the kv_pool/slots sizing
+                         remediation.
+- ``model_drift``        (warn)  the analytic cost model diverges from
+                         XLA's own per-executable accounting
+                         (``obs/compiles.jsonl``) past the gate
+                         threshold, naming the worst shape.
 """
 from __future__ import annotations
 
@@ -78,6 +86,8 @@ SHED_SUSTAINED_MIN = 5
 SHED_SUSTAINED_FRAC = 0.01
 API_THROTTLED_MIN_429 = 5
 API_THROTTLED_FRAC = 0.1
+HBM_PRESSURE_FRAC = 0.9
+MODEL_DRIFT_FRAC = 0.25
 
 
 def _finding(severity: str, rule: str, title: str,
@@ -108,7 +118,7 @@ def collect(path: str) -> Dict:
                  'events': [], 'requests': [], 'alerts_active': [],
                  'alerts_recent': [], 'run_marker': None,
                  'queue_pressure': None, 'overload': None,
-                 'outbound': None}
+                 'outbound': None, 'compiles': []}
     try:
         art['obs_dir'] = live.resolve_obs_dir(path)
     except Exception:
@@ -147,6 +157,11 @@ def collect(path: str) -> Dict:
         try:
             art['events'] = _load_events(
                 osp.join(art['obs_dir'], 'events.jsonl'))
+        except Exception:
+            pass
+        try:
+            from opencompass_tpu.obs import compileaudit
+            art['compiles'] = compileaudit.read_compiles(art['obs_dir'])
         except Exception:
             pass
     if art['serve_obs_dir']:
@@ -638,6 +653,71 @@ def _rule_api_throttled(art: Dict) -> List[Dict]:
     return out
 
 
+def _rule_hbm_pressure(art: Dict) -> List[Dict]:
+    """Sampled device-HBM high-water near capacity: the next large
+    allocation (a new shape's temp buffers, a bigger KV pool) is an
+    OOM waiting to happen."""
+    overall = ((art.get('status') or {}).get('overall') or {})
+    high = overall.get('hbm_high_water_frac')
+    if not isinstance(high, (int, float)) or high <= HBM_PRESSURE_FRAC:
+        return []
+    used = overall.get('hbm_used_frac')
+    evidence = [f'HBM high-water {high:.0%} of device memory'
+                + (f' (currently {used:.0%} in use)'
+                   if isinstance(used, (int, float)) else '')]
+    # name the hungriest executables when the compile audit recorded
+    # their memory analyses — that is usually where the headroom went
+    sized = sorted(
+        (r for r in art.get('compiles') or [] if r.get('memory')),
+        key=lambda r: -((r['memory'].get('argument_bytes') or 0)
+                        + (r['memory'].get('temp_bytes') or 0)))
+    for rec in sized[:3]:
+        mem = rec['memory']
+        total = ((mem.get('argument_bytes') or 0)
+                 + (mem.get('temp_bytes') or 0))
+        evidence.append(f'{rec.get("shape_key")}: '
+                        f'{total / 2**20:.1f} MiB argument+temp')
+    return [_finding(
+        'warn', 'hbm_pressure',
+        f'sampled HBM high-water at {high:.0%} of device memory',
+        evidence,
+        fix='shrink kv_pool_pages / decode_slots / max_seq_len (or the '
+            'batch token_budget) before the next allocation OOMs; '
+            'an actual OOM dumps forensics under {obs_dir}/oom/ '
+            '(docs/observability.md "HBM accounting")',
+        data={'hbm_high_water_frac': high})]
+
+
+def _rule_model_drift(art: Dict) -> List[Dict]:
+    """The analytic cost model (obs/costmodel.py) and XLA's own
+    cost_analysis disagree past the gate threshold: roofline MFU/MBU
+    numbers and plan estimates are built on the analytic side, so
+    drift there silently skews every efficiency surface."""
+    try:
+        from opencompass_tpu.obs import compileaudit
+        summary = compileaudit.summarize_compiles(
+            art.get('compiles') or [])
+    except Exception:
+        return []
+    drift = summary.get('model_drift_max')
+    if not isinstance(drift, (int, float)) or drift <= MODEL_DRIFT_FRAC:
+        return []
+    shape = summary.get('model_drift_worst_shape')
+    return [_finding(
+        'warn', 'model_drift',
+        f'cost model drifts {drift:.0%} from XLA accounting '
+        f'on {shape}',
+        [f'worst shape {shape}: measured-vs-modeled flop divergence '
+         f'{drift:.1%} (threshold {MODEL_DRIFT_FRAC:.0%}) across '
+         f'{summary.get("reconciled", 0)} reconciled executable(s)'],
+        fix='the model geometry or costmodel.py formulas no longer '
+            'match what XLA compiles (new fusion, changed attention '
+            'path?) — reconcile against obs/compiles.jsonl and gate '
+            'CI with `cli ledger check --max-model-drift` '
+            '(docs/observability.md "Compile audit")',
+        data={'model_drift_max': drift, 'shape': shape})]
+
+
 RULES: List[Callable[[Dict], List[Dict]]] = [
     _rule_failed_tasks,
     _rule_breaker_open,
@@ -648,6 +728,8 @@ RULES: List[Callable[[Dict], List[Dict]]] = [
     _rule_cold_compile,
     _rule_pad_collapse,
     _rule_kv_pool,
+    _rule_hbm_pressure,
+    _rule_model_drift,
     _rule_prefill_stall,
     _rule_gather_waste,
     _rule_queue_backlog,

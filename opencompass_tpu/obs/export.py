@@ -27,10 +27,14 @@ and nesting is valid on every track — the property
 
 A driver-level XProf capture (``run.py ... --xprof`` →
 ``{work_dir}/obs/xprof``) is linked from the export's ``otherData`` so
-the op-level story sits next to the scheduling story.
+the op-level story sits next to the scheduling story; resident-worker
+sessions (``xprof/worker-<pid>/``, recorded because ``OCT_XPROF_DIR``
+propagates to the worker fleet) are listed under
+``otherData.xprof_workers``.
 """
 from __future__ import annotations
 
+import os
 import os.path as osp
 from typing import Dict, List, Optional
 
@@ -309,6 +313,15 @@ def build_chrome_trace(work_dir: str, trace: Optional[str] = None) -> Dict:
         # driver-managed jax.profiler session (run.py --xprof): the
         # op-level complement to this scheduling-level export
         other['xprof'] = osp.abspath(xprof)
+        # resident workers contribute their own sessions (OCT_XPROF_DIR
+        # propagation, runners/worker.py) under worker-<pid>/
+        workers = sorted(
+            osp.abspath(osp.join(xprof, d))
+            for d in os.listdir(xprof)
+            if d.startswith('worker-')
+            and osp.isdir(osp.join(xprof, d)))
+        if workers:
+            other['xprof_workers'] = workers
     return {'traceEvents': builder.finalize(),
             'displayTimeUnit': 'ms', 'otherData': other}
 

@@ -119,6 +119,7 @@ class Heartbeat:
                  interval: float = HEARTBEAT_INTERVAL_S,
                  keepalive: bool = False):
         self.path = heartbeat_path(obs_dir, task_name)
+        self._obs_dir = obs_dir
         self._interval = interval
         self._lock = threading.Lock()
         self._last_write = 0.0
@@ -314,6 +315,14 @@ class Heartbeat:
                 self._state['device_memory'] = mem
         except Exception:
             pass
+        try:
+            # sampled HBM gauges (obs/devprof.py): used/high-water as a
+            # fraction of device capacity, plus the rate-limited
+            # device_memory_profile snapshot for post-mortem digging
+            from opencompass_tpu.obs import devprof
+            self._state.update(devprof.hbm_gauges(self._obs_dir))
+        except Exception:
+            pass
         self._state['ts'] = round(now, 3)
         atomic_write_json(self.path, self._state)
         self._last_write = now
@@ -482,6 +491,9 @@ def build_status(obs_dir: str, runner_state: Optional[Dict] = None,
             kv_pool_used_frac=rec.get('kv_pool_used_frac'),
             kv_pool_high_water_frac=rec.get('kv_pool_high_water_frac'),
             kv_pool_failed_allocs=rec.get('kv_pool_failed_allocs'),
+            # sampled HBM occupancy (obs/devprof.py heartbeat fold)
+            hbm_used_frac=rec.get('hbm_used_frac'),
+            hbm_high_water_frac=rec.get('hbm_high_water_frac'),
             store_hits=rec.get('store_hits'),
             store_misses=rec.get('store_misses'),
             store_hit_rate=round(st_hits / (st_hits + st_misses), 4)
@@ -552,6 +564,7 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
     stall_fracs = []
     mfus, mbus = [], []
     pool_used, pool_high = [], []
+    hbm_used, hbm_high = [], []
     pool_failed = 0
     for row in tasks.values():
         state = row.get('state', 'running')
@@ -580,6 +593,10 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
             pool_used.append(row['kv_pool_used_frac'])
         if row.get('kv_pool_high_water_frac') is not None:
             pool_high.append(row['kv_pool_high_water_frac'])
+        if row.get('hbm_used_frac') is not None:
+            hbm_used.append(row['hbm_used_frac'])
+        if row.get('hbm_high_water_frac') is not None:
+            hbm_high.append(row['hbm_high_water_frac'])
         # engine-LIFETIME counter: several tasks sharing one resident
         # engine all report the same total, so fold with max (summing
         # would multiply one engine's stalls by its task count)
@@ -616,6 +633,11 @@ def fold_task_rows(tasks: Dict[str, Dict]) -> Dict:
         if pool_high else None,
         'kv_pool_failed_allocs': pool_failed
         if pool_used or pool_high or pool_failed else None,
+        # sampled device-HBM occupancy (all tasks share the device, so
+        # worst-task = the device's real pressure)
+        'hbm_used_frac': round(max(hbm_used), 4) if hbm_used else None,
+        'hbm_high_water_frac': round(max(hbm_high), 4)
+        if hbm_high else None,
         **by_state,
     }
 
@@ -840,6 +862,8 @@ def render_status(snap: Dict) -> str:
         head.append(f"MBU {_fmt_util(o['mbu'])}")
     if o.get('kv_pool_used_frac') is not None:
         head.append(f"kv_pool {o['kv_pool_used_frac']:.0%}")
+    if o.get('hbm_used_frac') is not None:
+        head.append(f"hbm {o['hbm_used_frac']:.0%}")
     if snap.get('elapsed_seconds') is not None:
         head.append(f"elapsed {_fmt(snap['elapsed_seconds'], 's')}")
     slots = snap.get('slots')
@@ -853,7 +877,7 @@ def render_status(snap: Dict) -> str:
     tasks = snap.get('tasks') or {}
     if tasks:
         rows = [['task', 'state', 'unit', 'done/total', '%', 'tok/s',
-                 'pad_eff', 'hit%', 'hb_age']]
+                 'pad_eff', 'hit%', 'hbm', 'hb_age']]
         for name in sorted(tasks):
             t = tasks[name]
             done, total = t.get('done'), t.get('total')
@@ -863,6 +887,7 @@ def render_status(snap: Dict) -> str:
                 units = (f"[{t.get('units_done', 0)}"
                          f"/{t['units_total']}] ")
             hit = t.get('store_hit_rate')
+            hbm = t.get('hbm_used_frac')
             rows.append([
                 name[:58], t.get('state', '?'),
                 units + (str(t.get('unit') or '-')[:32]),
@@ -871,6 +896,7 @@ def render_status(snap: Dict) -> str:
                 _fmt(t.get('tokens_per_sec')),
                 _fmt(t.get('pad_eff')),
                 f'{hit:.0%}' if hit is not None else '-',
+                f'{hbm:.0%}' if hbm is not None else '-',
                 _fmt(t.get('heartbeat_age_seconds'), 's'),
             ])
         lines.append(_table(rows))

@@ -178,6 +178,12 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
         if o.get(key) is not None:
             out.append(f'# TYPE {prefix}_{key} gauge')
             out.append(_line(f'{prefix}_{key}', o[key]))
+    # sampled device-HBM occupancy gauges (oct_hbm_*): used/high-water
+    # fraction of device memory (obs/devprof.py heartbeat fold)
+    for key in ('hbm_used_frac', 'hbm_high_water_frac'):
+        if o.get(key) is not None:
+            out.append(f'# TYPE {prefix}_{key} gauge')
+            out.append(_line(f'{prefix}_{key}', o[key]))
     for state in ('ok', 'failed', 'running', 'pending'):
         if state in o:
             out.append(f'# TYPE {prefix}_tasks_{state} gauge')
@@ -230,6 +236,7 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
         ('task_mfu', 'mfu'),
         ('task_mbu', 'mbu'),
         ('task_kv_pool_used_frac', 'kv_pool_used_frac'),
+        ('task_hbm_used_frac', 'hbm_used_frac'),
         ('task_store_hit_rate', 'store_hit_rate'),
         ('task_heartbeat_age_seconds', 'heartbeat_age_seconds'),
     ]

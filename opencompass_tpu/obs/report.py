@@ -332,6 +332,19 @@ def build_report(work_dir: str, trace: Optional[str] = None) -> Dict:
     except Exception:
         timeline = {}
 
+    # -- compile audit (obs/compiles.jsonl, when recorded) -----------------
+    compiles: Dict = {}
+    try:
+        from opencompass_tpu.obs import compileaudit
+        compile_records = compileaudit.read_compiles(osp.dirname(path))
+        if compile_records:
+            compiles = {
+                'records': compile_records,
+                'summary': compileaudit.summarize_compiles(
+                    compile_records)}
+    except Exception:
+        pass
+
     critical = _critical_path(roots[0]) if roots else []
     return {
         # report schema version: CI diffs `trace --json` output across
@@ -355,6 +368,9 @@ def build_report(work_dir: str, trace: Optional[str] = None) -> Dict:
         # the recorder or was untraced); timelines are not trace-scoped
         # — a resumed run's batches accumulate in the same files
         'timeline': timeline,
+        # per-executable compile audit with XLA cost/memory accounting
+        # and measured-vs-modeled reconciliation ({} when not recorded)
+        'compiles': compiles,
         'metrics': {'counters': dict(counters), 'gauges': gauges,
                     'histograms': histograms},
     }
@@ -470,6 +486,14 @@ def render_summary(report: Dict) -> str:
             if kv_ideal:
                 bits.append(f'KV traffic {kv / kv_ideal:.2f}x ideal')
             lines.append(', '.join(bits))
+    comp = (report.get('compiles') or {}).get('summary') or {}
+    if comp.get('records'):
+        bits = [f"compile audit: {comp.get('fresh', 0)} fresh / "
+                f"{comp.get('cache_hits', 0)} cached executable(s)"]
+        if comp.get('model_drift_max') is not None:
+            bits.append('worst model drift '
+                        f"{comp['model_drift_max']:.1%}")
+        lines.append(', '.join(bits))
     util = report['slot_utilization']
     if util['overall'] is not None:
         lines.append(f"slot utilization {util['overall']:.0%} over "
@@ -608,6 +632,39 @@ def render_report(report: Dict) -> str:
                 'ragged-lengths ideal — the paged-gather/dense-buffer '
                 'waste a ragged paged-attention kernel would remove '
                 '(docs/observability.md "Roofline").')
+
+    comp = report.get('compiles') or {}
+    if comp.get('records'):
+        out.append('\n-- compile audit (measured vs modeled) --')
+        rows = [['shape', 'compile_s', 'cache', 'xla_flops',
+                 'model_flops', 'drift', 'bytes_acc', 'arg+tmp']]
+        for r in comp['records']:
+            cost = r.get('cost') or {}
+            mem = r.get('memory') or {}
+            model = r.get('model') or {}
+            resident = ((mem.get('argument_bytes') or 0)
+                        + (mem.get('temp_bytes') or 0))
+            drift = r.get('model_drift')
+            rows.append([
+                r.get('shape_key') or '-',
+                r.get('compile_seconds')
+                if r.get('compile_seconds') is not None else '-',
+                'hit' if r.get('hit') else 'cold',
+                _fmt_qty(cost.get('flops')),
+                _fmt_qty(model.get('flops')),
+                f'{drift:.1%}'
+                if isinstance(drift, (int, float)) else '-',
+                _fmt_qty(cost.get('bytes_accessed')),
+                _fmt_qty(resident)])
+        out.append(_table(rows))
+        s = comp.get('summary') or {}
+        if s.get('model_drift_max') is not None:
+            out.append(
+                f"worst model drift {s['model_drift_max']:.1%} on "
+                f"{s.get('model_drift_worst_shape')} across "
+                f"{s.get('reconciled', 0)} reconciled executable(s) — "
+                'gate with `cli ledger check --max-model-drift` '
+                '(docs/observability.md "Compile audit").')
 
     out.append('\n-- slot utilization --')
     util = report['slot_utilization']

@@ -320,6 +320,19 @@ def summarize_records(records: List[Dict]) -> Dict:
         total = sum(d for _, d in mbu_w)
         mbu = round(sum(v * d for v, d in mbu_w) / total, 6) \
             if total else None
+    # gather-share of decode step wall (obs/devprof.py): engine drains
+    # carry it (measured from sampled step traces when --profile-steps
+    # ran, else the memory-bound analytic model), weighted here by each
+    # drain's device wall — ROADMAP item 1's before/after counter
+    gs_w = [(r['gather_share'], d) for r, d in costed
+            if r.get('gather_share') is not None and d]
+    gather_share = None
+    if gs_w:
+        total = sum(d for _, d in gs_w)
+        gather_share = round(sum(v * d for v, d in gs_w) / total, 4) \
+            if total else None
+    gs_sources = {r.get('gather_share_source') for r, _ in costed
+                  if r.get('gather_share_source')}
     return {
         'batches': len(batches),
         'plans': len(plans),
@@ -372,6 +385,9 @@ def summarize_records(records: List[Dict]) -> Dict:
         if bytes_kv_ideal else None,
         'mfu': mfu,
         'mbu': mbu,
+        'gather_share': gather_share,
+        'gather_share_source': ('measured' if 'measured' in gs_sources
+                                else 'modeled') if gs_sources else None,
     }
 
 

@@ -843,6 +843,27 @@ def serve():
             obs.init_task_obs({'obs': True})
         except Exception:
             pass
+    # --xprof contribution: the driver's jax.profiler session only sees
+    # driver-process device work, so a resident worker records its own
+    # session under {OCT_XPROF_DIR}/worker-<pid>/ for the lifetime of
+    # the process.  `cli trace --export` links these from
+    # otherData.xprof_workers.  Never-fail: a backend without profiler
+    # support degrades to no capture.
+    xprof_on = False
+    xprof_root = os.environ.get('OCT_XPROF_DIR')
+    if xprof_root:
+        try:
+            import jax
+            xprof_dir = os.path.join(xprof_root,
+                                     f'worker-{os.getpid()}')
+            os.makedirs(xprof_dir, exist_ok=True)
+            jax.profiler.start_trace(xprof_dir)
+            xprof_on = True
+            print(f'worker: xprof session capture at {xprof_dir}',
+                  file=sys.stderr, flush=True)
+        except Exception as exc:
+            print(f'worker: xprof unavailable: {exc}',
+                  file=sys.stderr, flush=True)
 
     # SIGTERM drain: the handler only sets a flag and pokes the wake
     # pipe (select alone would restart on EINTR per PEP 475) — the loop
@@ -976,6 +997,13 @@ def serve():
     if reason in ('sigterm', 'idle_ttl', 'shutdown'):
         _flush_model_caches()
     print(f'worker: exiting ({reason})', file=sys.stderr, flush=True)
+
+    if xprof_on:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
 
     from opencompass_tpu.obs import get_tracer
     try:

@@ -1041,7 +1041,9 @@ class EvalEngine:
             out = {k: o.get(k) for k in
                    ('decode_slot_util', 'mfu', 'mbu',
                     'kv_pool_used_frac', 'kv_pool_high_water_frac',
-                    'kv_pool_failed_allocs') if o.get(k) is not None}
+                    'kv_pool_failed_allocs',
+                    'hbm_used_frac', 'hbm_high_water_frac')
+                   if o.get(k) is not None}
             return out or None
         except Exception:
             return None

@@ -362,6 +362,11 @@ def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
         if eff.get('kv_pool_failed_allocs'):
             bits.append(
                 f"pool stalls {eff['kv_pool_failed_allocs']}")
+        if eff.get('hbm_used_frac') is not None:
+            hbm = f"hbm {eff['hbm_used_frac']:.0%}"
+            if eff.get('hbm_high_water_frac') is not None:
+                hbm += f" (hw {eff['hbm_high_water_frac']:.0%})"
+            bits.append(hbm)
         if bits:
             lines.append('efficiency: ' + '  '.join(bits))
 

@@ -178,6 +178,13 @@ def install_listeners():
                 tracer.counter(f'compile_cache.{key}').inc()
         except Exception:
             pass
+        try:
+            # the compile audit windows these events around each first
+            # dispatch to tell cache-served compiles from fresh ones
+            from opencompass_tpu.obs import compileaudit
+            compileaudit.note_cache_event(key)
+        except Exception:
+            pass
 
     def _on_duration(name: str, secs: float, **kw):
         if not name.endswith('/cache_retrieval_time_sec'):

@@ -10,6 +10,12 @@ against the sequential-chunking baseline.  Cheap pre-flight for
 expensive remote-compile runs: a task showing dozens of distinct shapes
 or a pad_eff under ~0.5 is worth re-bucketing before it ever touches a
 device.
+
+The preview also runs a **shared-prefix census**: the token-level
+common prefix across each task's built prompts (few-shot ICL examples
+make this large), reported as the fraction of prefill tokens a
+prefix cache / shared-prefix split could avoid recomputing — per task
+and summed over the run.
 """
 from __future__ import annotations
 
@@ -31,6 +37,52 @@ _KIND_BY_INFERENCER = {
     'PPLInferencer': 'ppl',
     'CLPInferencer': 'choice',
 }
+
+
+# rows sampled per task for the token-level prefix census: the common
+# prefix stabilizes after a handful of rows; encoding thousands of
+# prompts would dominate an otherwise-cheap dry run
+PREFIX_SAMPLE_CAP = 512
+
+
+def prefix_census(model, prompts: List[str],
+                  sample_cap: int = PREFIX_SAMPLE_CAP) -> Optional[Dict]:
+    """Token-level shared-prefix census over one task's built prompts.
+
+    Encodes (a sample of) the prompts with the model's tokenizer and
+    measures the longest token prefix common to ALL rows — for ICL
+    tasks that is the shared few-shot block.  Reports the fraction of
+    total prompt tokens that are prefix-shareable: every row after the
+    first could skip ``prefix_tokens`` of prefill against a prefix
+    cache (or the dense path's shared-prefix split).  None when the
+    model cannot encode (API wrappers) or there are fewer than 2 rows.
+    """
+    encode = getattr(model, '_encode_ids', None)
+    if encode is None or len(prompts) < 2:
+        return None
+    try:
+        ids = [list(encode(str(p))) for p in prompts[:sample_cap]]
+    except Exception:
+        return None
+    ids = [r for r in ids if r]
+    if len(ids) < 2:
+        return None
+    first = ids[0]
+    prefix_len = 0
+    for i in range(min(len(r) for r in ids)):
+        tok = first[i]
+        if any(r[i] != tok for r in ids):
+            break
+        prefix_len += 1
+    total = sum(len(r) for r in ids)
+    shareable = prefix_len * (len(ids) - 1)
+    return {
+        'rows_sampled': len(ids),
+        'prefix_tokens': prefix_len,
+        'total_prompt_tokens': total,
+        'shareable_tokens': shareable,
+        'shareable_frac': round(shareable / total, 4) if total else 0.0,
+    }
 
 
 def inferencer_kind(infer_cfg: Dict) -> Optional[str]:
@@ -208,16 +260,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         print('no plannable (model, dataset) tasks found')
         return 1
     header = ['model', 'dataset', 'rows', 'plan', 'batches', 'shapes',
-              'pad_eff', 'seq_batches', 'seq_shapes', 'seq_pad_eff']
+              'pad_eff', 'seq_batches', 'seq_shapes', 'seq_pad_eff',
+              'prefix%']
     rows = [header]
     for r in results:
         planned, seq = r['planned'], r['sequential']
+        prefix = r.get('prefix') or {}
         rows.append([
             r['model'], r['dataset'], r['rows'],
             'on' if r['plan_enabled'] else 'off',
             planned['n_batches'], planned['n_shapes'],
             planned['pad_eff'], seq['n_batches'], seq['n_shapes'],
-            seq['pad_eff']])
+            seq['pad_eff'],
+            f"{prefix['shareable_frac']:.0%}"
+            if prefix.get('shareable_frac') is not None else '-'])
     widths = [max(len(str(row[i])) for row in rows)
               for i in range(len(header))]
     for i, row in enumerate(rows):
@@ -243,6 +299,24 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"/{c['slots']}, ~{c['est_pages_per_row']} pages/row; "
                   f"compile shapes: decode {c['decode_shape']}, "
                   f"prefill {c['prefill_shape']} (2 total)")
+    pref_rows = [r for r in results if r.get('prefix')]
+    if pref_rows:
+        print('\nshared-prefix census (token-level common prefix across '
+              "each task's prompts — prefill work a prefix cache or the "
+              'shared-prefix split skips):')
+        total = share = 0
+        for r in pref_rows:
+            p = r['prefix']
+            total += p['total_prompt_tokens']
+            share += p['shareable_tokens']
+            print(f"  {r['model']}/{r['dataset']}: "
+                  f"{p['prefix_tokens']} shared token(s) x "
+                  f"{p['rows_sampled']} sampled row(s) -> "
+                  f"{p['shareable_frac']:.1%} of prompt tokens "
+                  'prefix-shareable')
+        if total:
+            print(f'  total: {share}/{total} prompt tokens '
+                  f'({share / total:.1%}) prefix-shareable')
     if args.cache_dir:
         print(f'\ncompile-cache probe ({args.cache_dir}):')
         for r in results:

@@ -1,0 +1,408 @@
+"""Device introspection layer: compile audit, measured-vs-modeled
+reconciliation, HBM accounting / OOM forensics, sampled step profiling,
+the shared-prefix census, and the ledger/doctor gates they feed."""
+import json
+import os
+import os.path as osp
+import subprocess
+import sys
+
+import pytest
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+FIXTURE_RUN = osp.join(REPO, 'tests', 'fixtures', 'obs_run')
+
+
+@pytest.fixture(autouse=True)
+def _isolated_obs():
+    from opencompass_tpu import obs
+    obs.reset_obs()
+    yield
+    obs.reset_obs()
+
+
+# -- analytic expectation: hand-computed tiny geometry ----------------------
+#
+# tiny config: vocab=512 hidden=64 layers=2 scan_layers=True,
+# matmul_params=106496 -> head_params = 512*64 = 32768,
+# layer_params = 106496 - 32768 = 73728, scan scale = 1/2.
+#
+# ppl (2, 32): tokens=64, pairs=64*32=2048, head over all 64 tokens:
+#   2*73728*64*0.5 + 4*2*64*2048*0.5 + 2*32768*64
+#   = 4718592 + 524288 + 4194304 = 9437184
+# decode (2, 1) attn_width=256: tokens=2, pairs=512, head over 2 slots:
+#   2*73728*2*0.5 + 4*2*64*512*0.5 + 2*32768*2
+#   = 147456 + 131072 + 131072 = 409600
+
+def _tiny_model():
+    from opencompass_tpu.models import JaxLM
+    return JaxLM(config='tiny', tokenizer_only=True)
+
+
+def test_model_expectation_hand_math():
+    from opencompass_tpu.obs import compileaudit
+    lm = _tiny_model()
+    ppl = compileaudit.model_expectation(lm, 'ppl', (2, 32))
+    assert ppl['flops'] == 9437184.0
+    dec = compileaudit.model_expectation(lm, 'decode', (2, 1),
+                                         {'attn_width': 256})
+    assert dec['flops'] == 409600.0
+    # engine kinds without a table width have no defined expectation,
+    # and dense gen wraps a while-loop XLA can't statically count
+    assert compileaudit.model_expectation(lm, 'decode', (2, 1)) is None
+    assert compileaudit.model_expectation(lm, 'gen', (2, 32)) is None
+
+
+def test_model_expectation_drift_injection(monkeypatch):
+    from opencompass_tpu.obs import compileaudit
+    lm = _tiny_model()
+    monkeypatch.setenv(compileaudit.ENV_DRIFT_INJECT, '0.5')
+    ppl = compileaudit.model_expectation(lm, 'ppl', (2, 32))
+    assert ppl['flops'] == pytest.approx(9437184.0 * 1.5)
+
+
+def test_reconciliation_join_math(tmp_path):
+    """model_drift is |xla - model| / xla, computed at record time."""
+    from opencompass_tpu.obs import compileaudit
+
+    class _FakeCompiled:
+        def cost_analysis(self):
+            return [{'flops': 10000000.0, 'bytes accessed': 4096.0}]
+
+        def memory_analysis(self):
+            return None
+
+    class _FakeLowered:
+        def compile(self):
+            return _FakeCompiled()
+
+    class _FakeFn:
+        def lower(self, *args):
+            return _FakeLowered()
+
+    audit = compileaudit.CompileAudit(str(tmp_path))
+    audit.record_compile('ppl', (2, 32), 0.5, fn=_FakeFn(), args=(1,),
+                         model=_tiny_model())
+    (rec,) = compileaudit.read_compiles(str(tmp_path))
+    assert rec['cost']['flops'] == 10000000.0
+    assert rec['model']['flops'] == 9437184.0
+    assert rec['model_drift'] == pytest.approx(
+        (10000000.0 - 9437184.0) / 10000000.0, abs=1e-6)
+
+
+# -- compile audit: record schema on the real tiny JaxLM --------------------
+
+def test_compile_audit_e2e_tiny_jaxlm(tmp_path):
+    """Every fresh first dispatch (dense gen + ppl + both engine
+    executables) lands one durable record with XLA cost/memory fields,
+    and the scoring/engine records reconcile against the cost model
+    within the default gate."""
+    from opencompass_tpu import obs
+    from opencompass_tpu.models import JaxLM
+    from opencompass_tpu.obs import compileaudit
+    tracer = obs.init_obs(str(tmp_path))
+    try:
+        lm = JaxLM(config='tiny', max_seq_len=256,
+                   continuous_batching=True, decode_slots=2,
+                   kv_page_size=16)
+        lm.get_ppl(['the quick brown fox', 'hello world'])
+        lm.generate(['one two three'], 4)
+        lm.generate_continuous(['alpha beta', 'gamma'], 4)
+    finally:
+        tracer.close()
+    records = compileaudit.read_compiles(tracer.obs_dir)
+    kinds = {r['kind'] for r in records}
+    assert {'ppl', 'gen', 'prefill_chunk', 'decode'} <= kinds
+    for rec in records:
+        assert rec['v'] == compileaudit.AUDIT_VERSION
+        assert rec['t'] == 'compile'
+        assert rec['shape_key'].startswith(rec['kind'] + ':')
+        assert rec['compile_seconds'] > 0
+        assert rec['hit'] is False
+        # XLA's own accounting, from the AOT re-lower
+        assert rec['cost']['flops'] > 0
+        assert rec['cost']['bytes_accessed'] > 0
+        assert rec['memory']['argument_bytes'] > 0
+        assert rec['memory']['output_bytes'] > 0
+    by_kind = {r['kind']: r for r in records}
+    # engine records carry the attention table width the expectation
+    # was computed against
+    assert by_kind['decode']['attn_width'] == 256
+    for kind in ('ppl', 'prefill_chunk', 'decode'):
+        assert by_kind[kind]['model']['flops'] > 0
+        assert 0 <= by_kind[kind]['model_drift'] < 0.25
+    # dense gen has no static expectation (while-loop decode)
+    assert 'model_drift' not in by_kind['gen']
+    summary = compileaudit.summarize_compiles(records)
+    assert summary['fresh'] == summary['records'] >= 4
+    assert summary['analyzed'] == summary['fresh']
+    assert summary['reconciled'] >= 3
+    assert summary['model_drift_max'] < 0.25
+
+
+def test_torn_line_recovery(tmp_path):
+    from opencompass_tpu.obs import compileaudit
+    path = compileaudit.compiles_path(str(tmp_path))
+    os.makedirs(osp.dirname(path), exist_ok=True)
+    good = {'v': 1, 't': 'compile', 'kind': 'ppl', 'shape': [2, 32],
+            'shape_key': 'ppl:2x32', 'compile_seconds': 0.1,
+            'hit': False}
+    with open(path, 'w') as f:
+        f.write(json.dumps(good) + '\n')
+        f.write('{"v": 1, "t": "compile", "kind": "dec')  # torn tail
+    assert [r['shape_key'] for r in compileaudit.iter_compiles(path)] \
+        == ['ppl:2x32']
+    # a crashed writer's torn tail must not poison later appends
+    with open(path, 'a') as f:
+        f.write('\n' + json.dumps(dict(good, shape_key='ppl:4x32'))
+                + '\n')
+    keys = [r['shape_key'] for r in compileaudit.iter_compiles(path)]
+    assert keys == ['ppl:2x32', 'ppl:4x32']
+
+
+def test_cache_hit_recorded_without_reanalysis(tmp_path):
+    """A first dispatch whose monitoring window saw only persistent-
+    cache hits was deserialized, not compiled: the record says so and
+    skips the AOT re-analysis."""
+    from opencompass_tpu.obs import compileaudit
+
+    class _Boom:
+        def lower(self, *args):
+            raise AssertionError('cache hit must not re-analyze')
+
+    audit = compileaudit.install_compileaudit(
+        compileaudit.CompileAudit(str(tmp_path), task='t1'))
+    # module-level forwarding target (what utils.compile_cache calls)
+    compileaudit.note_cache_event('hits')
+    audit.record_compile('ppl', (2, 32), 0.004, fn=_Boom(), args=(1,))
+    # a window with a miss is a real compile
+    compileaudit.note_cache_event('misses')
+    compileaudit.note_cache_event('hits')
+    audit.record_compile('ppl', (4, 32), 1.2)
+    recs = compileaudit.read_compiles(str(tmp_path))
+    assert [r['hit'] for r in recs] == [True, False]
+    assert recs[0]['cc_hits'] == 1 and recs[0]['cc_misses'] == 0
+    assert 'cost' not in recs[0]
+    assert recs[0]['task'] == 't1'
+    assert recs[1]['cc_misses'] == 1 and recs[1]['cc_hits'] == 1
+    summary = compileaudit.summarize_compiles(recs)
+    assert summary['cache_hits'] == 1 and summary['fresh'] == 1
+
+
+# -- HBM accounting + OOM forensics -----------------------------------------
+
+def test_hbm_gauges_never_fail():
+    """CPU-only platforms report no bytes_limit: the gauges degrade to
+    {} rather than raising — the heartbeat fold rides on this."""
+    from opencompass_tpu.obs import devprof
+    gauges = devprof.hbm_gauges()
+    assert isinstance(gauges, dict)
+    for value in gauges.values():
+        assert 0 <= value
+
+
+def test_status_fold_carries_hbm_gauges():
+    """The seeded fixture's HBM gauges flow through the status fold the
+    same way kv_pool does: per-task columns + worst-task overall."""
+    from opencompass_tpu.obs.live import build_status, fold_task_rows
+    status = build_status(osp.join(FIXTURE_RUN, 'obs'))
+    tasks = status['tasks']
+    used = [r['hbm_used_frac'] for r in tasks.values()
+            if r.get('hbm_used_frac') is not None]
+    assert used, 'fixture must carry hbm gauges'
+    overall = fold_task_rows(tasks)
+    assert overall['hbm_used_frac'] == max(used)
+    assert overall['hbm_high_water_frac'] >= overall['hbm_used_frac']
+
+
+def test_is_oom_classifier():
+    from opencompass_tpu.obs import devprof
+    assert devprof.is_oom(RuntimeError(
+        'RESOURCE_EXHAUSTED: Out of memory allocating 2.1G'))
+    assert devprof.is_oom(ValueError('Resource exhausted: HBM'))
+    assert not devprof.is_oom(RuntimeError('shape mismatch'))
+
+
+def test_oom_forensics_dump(tmp_path):
+    """On RESOURCE_EXHAUSTED the guard dumps allocator stats, caller
+    context, and the compile audit's top executables by HBM footprint
+    to {obs_dir}/oom/ before re-raising."""
+    from opencompass_tpu import obs
+    from opencompass_tpu.obs import compileaudit, devprof
+    tracer = obs.init_obs(str(tmp_path))
+    try:
+        # two analyzed executables with known footprints for the
+        # "top allocations" ranking
+        path = compileaudit.compiles_path(tracer.obs_dir)
+        with open(path, 'w') as f:
+            for key, arg_b in (('decode:2x1', 2000000),
+                               ('ppl:2x32', 500000)):
+                f.write(json.dumps({
+                    'v': 1, 't': 'compile', 'kind': key.split(':')[0],
+                    'shape_key': key, 'hit': False,
+                    'memory': {'argument_bytes': arg_b,
+                               'temp_bytes': 1000,
+                               'output_bytes': 24}}) + '\n')
+        with pytest.raises(RuntimeError, match='RESOURCE_EXHAUSTED'):
+            with devprof.oom_guard(step='decode', slots=2):
+                raise RuntimeError(
+                    'RESOURCE_EXHAUSTED: Out of memory while trying to '
+                    'allocate 2147483648 bytes')
+        oom_dir = osp.join(tracer.obs_dir, devprof.OOM_DIR)
+        (dump,) = [f for f in os.listdir(oom_dir) if f.endswith('.json')]
+        with open(osp.join(oom_dir, dump)) as f:
+            info = json.load(f)
+        assert 'RESOURCE_EXHAUSTED' in info['error']
+        assert info['context'] == {'step': 'decode', 'slots': 2}
+        tops = info['top_executables']
+        assert [t['shape_key'] for t in tops] \
+            == ['decode:2x1', 'ppl:2x32']
+        assert tops[0]['bytes'] == 2000000 + 1000 + 24
+        # a non-OOM failure must re-raise without dumping
+        with pytest.raises(ValueError):
+            with devprof.oom_guard(step='decode'):
+                raise ValueError('not an oom')
+        assert len([f for f in os.listdir(oom_dir)
+                    if f.endswith('.json')]) == 1
+    finally:
+        tracer.close()
+
+
+# -- sampled step profiling -------------------------------------------------
+
+def test_categorize_op():
+    from opencompass_tpu.obs.devprof import categorize_op
+    assert categorize_op('gather.42') == 'gather'
+    assert categorize_op('fusion.dynamic-slice.7') == 'gather'
+    assert categorize_op('dot_general.1') == 'matmul'
+    assert categorize_op('add.3') == 'elementwise'
+    # host wrappers and runtime scaffolding are not device op work
+    assert categorize_op('PjitFunction(step)') is None
+    assert categorize_op('tsl::Thunk') is None
+
+
+def test_step_profiler_stride_and_fields(tmp_path):
+    """Step 0 (the compile) is never sampled; captures land on the
+    stride and fold into measured per-category device seconds."""
+    import jax.numpy as jnp
+    from opencompass_tpu.obs.devprof import StepProfiler
+    prof = StepProfiler(str(tmp_path), max_traces=1, stride=2)
+    traced = []
+    for _ in range(3):
+        with prof.maybe_trace('decode') as active:
+            traced.append(active)
+            jnp.ones((8, 8)).sum().block_until_ready()
+    assert traced[0] is False          # warm-up step skipped
+    assert traced.count(True) == 1     # budget of one capture
+    fields = prof.fields()
+    assert fields['profiled_steps'] == 1
+    if 'profile_categories' in fields:     # CPU backends emit op events
+        total = sum(fields['profile_categories'].values())
+        assert total > 0
+        assert 0 <= fields['gather_share_measured'] <= 1
+
+
+def test_modeled_gather_share_hand_math():
+    from opencompass_tpu.obs.devprof import modeled_gather_share
+
+    class _CM:
+        kv_token_bytes = 4.0
+        weight_bytes = 100.0
+
+    # kv_read = 4*2*10 = 80, kv_write = 4*2 = 8, weights = 100
+    assert modeled_gather_share(_CM(), 2, 10) \
+        == pytest.approx(80.0 / 188.0, abs=1e-4)
+    assert modeled_gather_share(None, 2, 10) == 0.0
+
+
+# -- ledger gate: cli check --max-model-drift -------------------------------
+
+def _run_ledger_check(ledger_dir, *extra):
+    return subprocess.run(
+        [sys.executable, '-m', 'opencompass_tpu.cli', 'ledger',
+         'check', str(ledger_dir), *extra],
+        cwd=REPO, env=dict(os.environ, JAX_PLATFORMS='cpu'),
+        capture_output=True, text=True, timeout=180)
+
+
+def test_ledger_model_drift_gate(tmp_path):
+    """The gate is record-local (XLA is the reference — no baseline run
+    needed): exit 2 past the threshold, 0 within it."""
+    ledger_dir = tmp_path / 'ledger'
+    ledger_dir.mkdir()
+    recs = [
+        {'run': 'r1', 'model': 'tiny', 'dataset': 'demo',
+         'tokens_per_sec': 100.0, 'model_drift': 0.31,
+         'model_drift_shape': 'decode:2x1'},
+        {'run': 'r1', 'model': 'tiny', 'dataset': 'demo-ppl',
+         'tokens_per_sec': 90.0, 'model_drift': 0.04,
+         'model_drift_shape': 'ppl:2x32'},
+    ]
+    with open(ledger_dir / 'runs.jsonl', 'w') as f:
+        for rec in recs:
+            f.write(json.dumps(rec) + '\n')
+    r = _run_ledger_check(ledger_dir, '--max-model-drift', '0.25')
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert 'model drift' in r.stdout or 'drifts' in r.stdout
+    assert 'decode:2x1' in r.stdout
+    # identical records, looser gate: clean exit
+    r = _run_ledger_check(ledger_dir, '--max-model-drift', '0.5')
+    assert r.returncode == 0, r.stdout + r.stderr
+    # without the flag the single-run ledger has nothing to check
+    r = _run_ledger_check(ledger_dir)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_check_model_drift_dedup():
+    from opencompass_tpu.ledger import ledger as ledmod
+    recs = [{'run': 'r1', 'model': 'm', 'dataset': 'd',
+             'model_drift': 0.4, 'model_drift_shape': 'decode:2x1'},
+            {'run': 'r1', 'model': 'm', 'dataset': 'd',
+             'model_drift': 0.4, 'model_drift_shape': 'decode:2x1'},
+            {'run': 'r0', 'model': 'm', 'dataset': 'd',
+             'model_drift': 0.9}]
+    out = ledmod.check_model_drift(recs, 'r1', 0.25)
+    assert len(out) == 1      # (model, dataset) deduped, r0 ignored
+    assert out[0]['regression'] == 'model_drift'
+    assert out[0]['drift_shape'] == 'decode:2x1'
+    assert ledmod.check_model_drift(recs, 'r1', 0.5) == []
+
+
+# -- doctor rules on the seeded fixture -------------------------------------
+
+def test_doctor_hbm_pressure_and_model_drift_rules():
+    from opencompass_tpu.obs.doctor import diagnose
+    report = diagnose(FIXTURE_RUN)
+    rules = {f['rule']: f for f in report['findings']}
+    hbm = rules['hbm_pressure']
+    assert hbm['severity'] == 'warn'
+    assert '94' in hbm['title'] or '0.94' in hbm['title']
+    assert any('decode:2x1' in ev for ev in hbm['evidence'])
+    drift = rules['model_drift']
+    assert drift['severity'] == 'warn'
+    assert 'decode:2x1' in drift['title'] + ''.join(drift['evidence'])
+    assert 'max-model-drift' in drift['fix']
+
+
+# -- shared-prefix census ---------------------------------------------------
+
+def test_prefix_census_token_level():
+    from opencompass_tpu.utils.plan_preview import prefix_census
+
+    class _M:
+        def _encode_ids(self, text):
+            return [ord(c) for c in text]
+
+    prompts = ['shared head A', 'shared head BB', 'shared head C']
+    census = prefix_census(_M(), prompts)
+    assert census['rows_sampled'] == 3
+    assert census['prefix_tokens'] == len('shared head ')
+    total = sum(len(p) for p in prompts)
+    assert census['total_prompt_tokens'] == total
+    assert census['shareable_tokens'] == len('shared head ') * 2
+    assert census['shareable_frac'] == pytest.approx(
+        len('shared head ') * 2 / total, abs=1e-4)
+    # degenerate inputs: a census needs >= 2 rows and an encoder
+    assert prefix_census(_M(), ['only one']) is None
+    assert prefix_census(object(), prompts) is None
+    assert prefix_census(_M(), ['abc', 'xyz'])['shareable_frac'] == 0.0

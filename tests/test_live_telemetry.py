@@ -401,7 +401,9 @@ def test_status_cli_json():
     assert snap['v'] == 1
     assert snap['overall'] == {'n_tasks': 2, 'progress': 0.875,
                                'eta_seconds': None, 'ok': 1, 'failed': 1,
-                               'running': 0, 'pending': 0}
+                               'running': 0, 'pending': 0,
+                               'hbm_used_frac': 0.88,
+                               'hbm_high_water_frac': 0.94}
 
 
 def test_status_cli_missing_tree(tmp_path):
