@@ -963,6 +963,130 @@ def _bench_devprof(out_json='BENCH_DEVPROF.json'):
     return record
 
 
+def _bench_obshub(out_json='BENCH_OBSHUB.json'):
+    """detail.obshub: the fleet observability hub on a synthetic
+    multi-worker fleet — four sources' durable request streams ingested
+    into tail-sampled traces and windowed rollups, a p99 answered from
+    rollups alone (and cross-checked against the raw nearest-rank
+    answer), then the retention budget enforced so the raw streams
+    vanish while the query still answers.  Trajectory series gate
+    ingest throughput, rollup-query latency, and how much the hub
+    shrinks the telemetry footprint."""
+    import tempfile
+
+    from opencompass_tpu.obs import hub as hubmod
+    from opencompass_tpu.utils.journal import journal_append
+
+    root = tempfile.mkdtemp(prefix='oct_obshub_')
+    n_sources, n_records = 4, 1200
+    now = time.time()
+    t0 = now - 660.0
+    rng = np.random.RandomState(11)
+    error_ids = []
+    for s in range(n_sources):
+        src = os.path.join(root, 'worker%d' % s, 'obs')
+        os.makedirs(src)
+        recs = []
+        for i in range(n_records):
+            ts = t0 + (i / n_records) * 600.0
+            wall = float(0.05 + rng.gamma(2.0, 0.04))
+            rid = 'w%d-r%d' % (s, i)
+            err = (i % 97 == 13)
+            if err:
+                error_ids.append(rid)
+            recs.append({
+                'v': 1, 'id': rid, 'ts': round(ts, 3),
+                'route': '/v1/completions', 'model': 'tiny',
+                'status': 'error' if err else 'ok',
+                'wall_s': round(wall, 5),
+                'phases': [
+                    {'name': 'prefill', 'start_s': 0.0,
+                     'dur_s': round(wall * 0.3, 5)},
+                    {'name': 'decode', 'start_s': round(wall * 0.3, 5),
+                     'dur_s': round(wall * 0.7, 5)}],
+            })
+        journal_append(os.path.join(src, 'requests.jsonl'), recs,
+                       version=1)
+        hubmod.register_source(root, 'host%d' % s, 'worker', src)
+
+    total = n_sources * n_records
+    hub = hubmod.ObsHub(root, budget_bytes=1)
+    t_start = time.perf_counter()
+    stats = hub.ingest(now=now, force_flush=True)
+    ingest_s = time.perf_counter() - t_start
+    assert stats['ingested'] >= total, (
+        'hub ingested %s of %s records' % (stats['ingested'], total))
+
+    raw_ans = hub.query(since=now - 3600.0, q=0.99, raw=True, now=now)
+    lat_ms = []
+    ans = None
+    for _ in range(20):
+        q0 = time.perf_counter()
+        ans = hub.query(since=now - 3600.0, q=0.99, now=now)
+        lat_ms.append((time.perf_counter() - q0) * 1e3)
+    query_ms = sorted(lat_ms)[len(lat_ms) // 2]
+    assert ans['count'] == total and raw_ans['count'] == total
+    rel = abs(ans['value_s'] - raw_ans['value_s']) / raw_ans['value_s']
+    assert rel <= 0.05, (
+        'rollup p99 %s drifted %.1f%% from raw %s'
+        % (ans['value_s'], rel * 100, raw_ans['value_s']))
+
+    kept_errors = {t['trace'] for t in hub.read_traces()
+                   if t.get('keep') == 'error'}
+    assert set(error_ids) <= kept_errors, (
+        'tail sampling dropped %d error traces'
+        % len(set(error_ids) - kept_errors))
+
+    comp = hub.compact(now=now)
+    after = hubmod.ObsHub(root, budget_bytes=1).query(
+        since=now - 3600.0, q=0.99, now=now)
+    assert after['count'] == total and comp['raw_bytes_after'] == 0, (
+        'post-compaction query lost history: %s' % after)
+    footprint_ratio = round(
+        comp['raw_bytes_before']
+        / max(comp['raw_bytes_after'] + comp['hub_bytes_after'], 1), 2)
+
+    record = {
+        'v': 1,
+        'workload': '%d sources x %d requests (gamma latencies, ~1%% '
+                    'errors), 0.1 sample rate, 1-byte retention budget'
+                    % (n_sources, n_records),
+        'ingest_records_per_sec': round(total / ingest_s, 1),
+        'ingest_wall_s': round(ingest_s, 4),
+        'query_p99_ms': round(query_ms, 3),
+        'rollup_p99_s': ans['value_s'],
+        'raw_p99_s': raw_ans['value_s'],
+        'rollup_vs_raw_rel': round(rel, 5),
+        'exact_tail': ans.get('exact'),
+        'kept_traces': stats['kept'],
+        'error_traces_kept': len(kept_errors & set(error_ids)),
+        'error_traces_total': len(error_ids),
+        'windows_emitted': stats['windows_emitted'],
+        'compaction': comp,
+        'footprint_ratio': footprint_ratio,
+    }
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, out_json), 'w') as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
+    _append_trajectory(
+        'obshub', 'ingest_records_per_sec', record['ingest_records_per_sec'],
+        'rec/s', direction='higher',
+        detail={'sources': n_sources, 'records': total})
+    _append_trajectory(
+        'obshub', 'query_ms', record['query_p99_ms'], 'ms',
+        direction='lower',
+        detail={'exact': ans.get('exact'), 'windows': ans.get('windows')})
+    _append_trajectory(
+        'obshub', 'footprint_ratio', footprint_ratio, 'x',
+        direction='higher',
+        detail={'raw_bytes_before': comp['raw_bytes_before'],
+                'hub_bytes_after': comp['hub_bytes_after']})
+    return record
+
+
 def _bench_serve(out_json='BENCH_SERVE.json'):
     """detail.serve: the evaluation-as-a-service loop end to end —
     daemon up (fleet warmed), demo sweep enqueued, an interactive
@@ -1801,6 +1925,7 @@ def main():
             'flight_recorder': _bench_flight_recorder(),
             'roofline': _bench_roofline(),
             'devprof': _bench_devprof(),
+            'obshub': _bench_obshub(),
             'a100_est': a100,
             'a100_est_b32': a100_b32,
             'small': {
@@ -1865,6 +1990,12 @@ if __name__ == '__main__':
         # JaxLM; CPU-runnable)
         print(json.dumps({'metric': 'devprof', 'v': 1,
                           'detail': _bench_devprof()}))
+        sys.exit(0)
+    if '--obshub' in sys.argv:
+        # standalone observability-hub leg: multi-source ingest, tail
+        # sampling, rollup queries, retention compaction (device-free)
+        print(json.dumps({'metric': 'obshub', 'v': 1,
+                          'detail': _bench_obshub()}))
         sys.exit(0)
     if '--lint' in sys.argv:
         # standalone oct-lint coverage smoke (pure stdlib; device-free)

@@ -107,6 +107,7 @@ class ChaosDaemon:
         self.serve_obs_dir = osp.join(self.cache_root, 'serve', 'obs')
         self.sleep_file = osp.join(self.root, 'sleep_s')
         self.eio_file = osp.join(self.root, 'store_eio')
+        self.skew_file = osp.join(self.root, 'deadline_skew_s')
         self.log_path = osp.join(self.root, 'daemon.log')
         self.cfg_path = osp.join(self.root, 'serve_chaos.py')
         self.proc: Optional[subprocess.Popen] = None
@@ -114,6 +115,7 @@ class ChaosDaemon:
         self._log_fh = None
         self.set_sleep(0)
         self.set_store_fault(False)
+        self.set_deadline_skew(0)
         with open(self.cfg_path, 'w', encoding='utf-8') as f:
             f.write(f"""
 from opencompass_tpu.models import FakeModel
@@ -135,6 +137,7 @@ work_dir = {osp.join(self.root, 'out')!r}
                    OCT_CACHE_ROOT=self.cache_root,
                    OCT_DEBUG_COMPLETE_SLEEP_FILE=self.sleep_file,
                    OCT_DEBUG_STORE_EIO_FILE=self.eio_file,
+                   OCT_DEBUG_DEADLINE_SKEW_FILE=self.skew_file,
                    OCT_FAKE_TOKEN_SLEEP_S='0.003')
         env.pop('OCT_TRACE_ID', None)
         env.pop('OCT_OBS_DIR', None)
@@ -191,6 +194,15 @@ work_dir = {osp.join(self.root, 'out')!r}
     def set_store_fault(self, on: bool):
         with open(self.eio_file, 'w', encoding='utf-8') as f:
             f.write('1' if on else '0')
+
+    def set_deadline_skew(self, seconds: float):
+        """Shift the daemon's deadline anchor backwards by ``seconds``
+        (reqtrace's injected budget clock): with a positive skew, any
+        budget smaller than the skew is *already expired* when the
+        first phase checks it — the deterministic way to pin the
+        dead-at-arrival deadline case to the 'parse' phase."""
+        with open(self.skew_file, 'w', encoding='utf-8') as f:
+            f.write(str(seconds))
 
     # -- HTTP ---------------------------------------------------------------
 
@@ -364,22 +376,20 @@ def scenario_stuck_worker(daemon: ChaosDaemon,
     # attributes the spend to the (simulated) forward
     r_mid = daemon.request('Q: stuck mid?\nA:', deadline_ms=500,
                            timeout=60)
-    # budget already dead at arrival: fail fast, no chip time
+    # budget already dead at arrival: fail fast, no chip time.  The
+    # injected budget-clock skew makes "already dead" a fact rather
+    # than a race — the 1 ms budget is expired the instant the
+    # deadline is minted, so the first phase check (parse, before
+    # admission) always attributes it, on any machine speed
+    daemon.set_deadline_skew(10.0)
     r_pre = daemon.request('Q: stuck pre?\nA:', deadline_ms=1,
                            timeout=60)
+    daemon.set_deadline_skew(0)
     daemon.set_sleep(0)
     r_after = daemon.request('Q: stuck recovered?\nA:', timeout=60)
-    # phase attribution is the phase that ACTUALLY consumed the
-    # budget: with a 1 ms budget that can be anywhere from parse to
-    # the still-stalled forward depending on machine speed (a fast box
-    # dispatches in under a millisecond and the budget dies inside the
-    # injected stall, same as the mid case) — the invariant is that it
-    # is named and honest, and the deterministic per-phase cases live
-    # in tests/test_degradation.py
     for name, resp, phases in (
             ('mid', r_mid, ('model_forward', 'worker_protocol')),
-            ('pre', r_pre, ('parse', 'admission', 'lease_wait',
-                            'worker_protocol', 'model_forward'))):
+            ('pre', r_pre, ('parse',))):
         _check(resp.code == 504,
                f'stuck-{name}: expected 504, got {resp.code} '
                f'({resp.payload})')

@@ -115,6 +115,8 @@ def fuzz_kill_writer(path: str, records: List[Dict], cut_record: int,
 def _child_main(spec_path: str):
     with open(spec_path, encoding='utf-8') as f:
         spec = json.load(f)
+    if spec.get('kind') == 'hub':
+        _hub_child_main(spec)      # never returns
     torn_write(spec['path'], spec['records'], spec['cut_record'],
                spec['cut_bytes'])
     os._exit(CHILD_EXIT)
@@ -242,6 +244,151 @@ def _requests_contract(filename: str, name: str) -> JournalContract:
         lossy_absorb=True)
 
 
+# -- observability-hub crash contract ---------------------------------------
+#
+# The hub (obs/hub.py) is a *reader-aggregator* with its own durable
+# outputs: kept traces + rollup windows appended journal-style, then
+# the cursor snapshot committed last (atomic replace).  Its crash
+# contract is therefore end-to-end, not per-file: kill -9 anywhere in
+# an ingest or compaction round — including mid-append, between the
+# appends and the cursor commit, and mid-compaction — must never (a)
+# lose a kept (error/breach) trace, nor (b) double-count any rollup
+# window, once a surviving hub finishes the round.  The fuzzer spawns
+# a child hub whose K-th durable operation dies mid-write, then
+# re-runs a fresh hub in the parent and checks both invariants against
+# ground truth computed from the source records.
+
+def _hub_child_main(spec: Dict):
+    """Child: run one hub round, dying before (or torn inside) the
+    K-th durable operation — journal appends die mid-line (half the
+    first record's bytes land raw, byte-for-byte a kill -9 between
+    two ``write(2)`` calls), cursor commits die before the write."""
+    from opencompass_tpu.obs import hub as hubmod
+    countdown = [int(spec['die_before_op'])]
+    real_append = hubmod.journal_append
+
+    def dying_append(path, records, version=None):
+        countdown[0] -= 1
+        if countdown[0] <= 0:
+            records = list(records)
+            if version is not None:
+                records = [{'v': version, **r} for r in records]
+            data = _encode(records[0])
+            # oct-lint: disable=OCT001(deliberately torn raw append — this IS the crash being injected)
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                         0o644)
+            try:
+                os.write(fd, data[:max(len(data) // 2, 1)])
+            finally:
+                os.close(fd)
+            os._exit(CHILD_EXIT)
+        return real_append(path, records, version=version)
+
+    real_save = hubmod.atomic_write_json
+
+    def dying_save(path, obj):
+        countdown[0] -= 1
+        if countdown[0] <= 0:
+            os._exit(CHILD_EXIT)
+        return real_save(path, obj)
+
+    hubmod.journal_append = dying_append
+    hubmod.atomic_write_json = dying_save
+    hub = hubmod.ObsHub(spec['obs_dir'],
+                        budget_bytes=int(spec['budget_bytes']))
+    if spec['op'] == 'compact':
+        hub.compact(now=spec['now'])
+    else:
+        hub.ingest(now=spec['now'], force_flush=True)
+    # the countdown outlived the round: still a clean planned exit —
+    # the parent treats "crashed later than every op" as a no-op round
+    os._exit(CHILD_EXIT)
+
+
+def _hub_fixture(obs_dir: str, n_records: int, t0: float) -> Dict:
+    """Synthetic source streams + the ground truth the invariants are
+    checked against: every 25th request errors (must-keep traces)."""
+    os.makedirs(obs_dir, exist_ok=True)
+    error_ids = []
+    with open(osp.join(obs_dir, 'requests.jsonl'), 'w',
+              encoding='utf-8') as f:
+        for i in range(n_records):
+            err = (i % 25 == 0)
+            rec = {'v': 1, 'id': f'r{i}', 'request_id': f'req-{i:04d}',
+                   'ts': t0 + i * 0.5, 'route': '/v1/completions',
+                   'model': 'm0', 'status': 'error' if err else 'ok',
+                   'wall_s': 0.05 + (i % 7) * 0.03}
+            if err:
+                rec['error'] = 'injected'
+                error_ids.append(rec['request_id'])
+            f.write(json.dumps(rec, separators=(',', ':')) + '\n')
+    with open(osp.join(obs_dir, 'alerts.jsonl'), 'w',
+              encoding='utf-8') as f:
+        f.write(json.dumps({'v': 1, 't': 'fire', 'rule': 'slo',
+                            'severity': 'page', 'ts': t0 + 1.0}) + '\n')
+        f.write(json.dumps({'v': 1, 't': 'resolve', 'rule': 'slo',
+                            'ts': t0 + 2.0}) + '\n')
+    return {'error_ids': error_ids, 'n_records': n_records}
+
+
+def run_hub_crashfuzz(workdir: str, rounds: int = 6,
+                      n_records: int = 120, seed: int = 0) -> Dict:
+    """``rounds`` randomized kill points inside hub ingest/compaction.
+
+    Each round: fresh fixture, a child hub killed mid-durable-op (the
+    op index and ingest-vs-compact both randomized), then a surviving
+    hub finishes the round and the two invariants are asserted —
+    every error trace kept, every rollup window counted exactly once.
+    Raises ``AssertionError`` on the first violation."""
+    from opencompass_tpu.obs import hub as hubmod
+    rng = random.Random(seed)
+    t0 = 1_700_000_000.0
+    now = t0 + n_records * 0.5 + 4000.0   # every window closed
+    rounds_run = []
+    for rnd in range(rounds):
+        root = osp.join(workdir, f'obs_hub-{rnd:03d}')
+        shutil.rmtree(root, ignore_errors=True)
+        truth = _hub_fixture(root, n_records, t0)
+        op = rng.choice(['ingest', 'compact'])
+        die_before_op = rng.randrange(1, 8)
+        spec = {'kind': 'hub', 'obs_dir': root, 'op': op,
+                'now': now, 'die_before_op': die_before_op,
+                'budget_bytes': 1 if op == 'compact' else 1 << 30}
+        spec_path = osp.join(root, 'fuzzspec.json')
+        from opencompass_tpu.utils.fileio import atomic_write_json
+        atomic_write_json(spec_path, spec)
+        env = dict(os.environ, JAX_PLATFORMS='cpu')
+        proc = subprocess.run(
+            [sys.executable, '-m',
+             'opencompass_tpu.analysis.crashfuzz', '--child',
+             spec_path], timeout=120, env=env, capture_output=True)
+        _check(proc.returncode == CHILD_EXIT,
+               f'hub crashfuzz child exited {proc.returncode} (wanted '
+               f'{CHILD_EXIT}): '
+               f'{proc.stderr.decode(errors="replace")[-2000:]}')
+        # the surviving hub finishes the round (replay + dedup)
+        hub = hubmod.ObsHub(root, budget_bytes=1 << 30)
+        hub.ingest(now=now + 60.0, force_flush=True)
+        hub.compact(now=now + 120.0)
+        kept = {t['trace'] for t in hub.read_traces()}
+        missing = [e for e in truth['error_ids'] if e not in kept]
+        _check(not missing,
+               f'hub round {rnd} ({op}, die@{die_before_op}): lost '
+               f'kept error traces {missing} across the crash')
+        res = hub.query(since=t0 - 1, until=now + 120.0, q=0.5,
+                        now=now + 120.0)
+        _check(res['count'] == truth['n_records'],
+               f'hub round {rnd} ({op}, die@{die_before_op}): rollups '
+               f"count {res['count']} != {truth['n_records']} — a "
+               'window was double-counted or lost across the crash')
+        _check(res['errors'] == len(truth['error_ids']),
+               f"hub round {rnd}: rollup errors {res['errors']} != "
+               f"{len(truth['error_ids'])}")
+        rounds_run.append({'op': op, 'die_before_op': die_before_op})
+    return {'contract': 'obs_hub', 'rounds': len(rounds_run),
+            'n_records': n_records, 'cuts': rounds_run}
+
+
 CONTRACTS: Dict[str, Callable[[], JournalContract]] = {
     'store_segment': _store_contract,
     'queue_journal': _queue_contract,
@@ -360,7 +507,8 @@ def main(argv=None) -> int:
     parser.add_argument('--child', metavar='SPEC',
                         help='internal: run the torn writer from a '
                         'spec file and die mid-write')
-    parser.add_argument('--contract', choices=sorted(CONTRACTS),
+    parser.add_argument('--contract',
+                        choices=sorted(CONTRACTS) + ['obs_hub'],
                         help='fuzz one contract standalone')
     parser.add_argument('--workdir', default='/tmp/oct-crashfuzz')
     parser.add_argument('--rounds', type=int, default=8)
@@ -370,11 +518,17 @@ def main(argv=None) -> int:
     if args.child:
         _child_main(args.child)    # never returns
         return 0
-    names = [args.contract] if args.contract else sorted(CONTRACTS)
+    names = [args.contract] if args.contract \
+        else sorted(CONTRACTS) + ['obs_hub']
     for name in names:
-        report = run_crashfuzz(name, args.workdir,
-                               n_records=args.records,
-                               rounds=args.rounds, seed=args.seed)
+        if name == 'obs_hub':
+            report = run_hub_crashfuzz(args.workdir,
+                                       rounds=args.rounds,
+                                       seed=args.seed)
+        else:
+            report = run_crashfuzz(name, args.workdir,
+                                   n_records=args.records,
+                                   rounds=args.rounds, seed=args.seed)
         print(json.dumps(report))
     return 0
 

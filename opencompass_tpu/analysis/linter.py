@@ -88,11 +88,15 @@ RULES: Dict[str, str] = {
     'OCT006': 'host sync inside a jitted function',
     'OCT007': 'jit retrace risk (per-call wrapper or unhashable '
               'static arg)',
+    'OCT008': 'journal discipline: hand-rolled torn-tail seal — '
+              'shared JSONL journals go through utils.journal '
+              '(seal_torn_tail / journal_append)',
 }
 
 # modules that IMPLEMENT the disciplines are exempt from the rules that
 # reference them (paths relative to the repo root)
 _FILEIO_REL = osp.join('opencompass_tpu', 'utils', 'fileio.py')
+_JOURNAL_REL = osp.join('opencompass_tpu', 'utils', 'journal.py')
 
 _PRAGMA_RE = re.compile(r'#\s*oct-lint:\s*(?P<body>[^#]*)')
 _DISABLE_RE = re.compile(r'disable\s*=\s*(?P<rules>.*)', re.S)
@@ -715,6 +719,36 @@ def _check_oct007(ctx: _FileCtx) -> List[Finding]:
     return out
 
 
+def _check_oct008(ctx: _FileCtx) -> List[Finding]:
+    """A ``f.seek(-1, ...)`` tail-byte probe is the signature of a
+    hand-rolled torn-tail RE-SEAL (read the last byte, append ``\\n``
+    when it isn't one).  That discipline lives in ``utils/journal.py``
+    now — new shared-journal writers should call ``seal_torn_tail`` /
+    ``journal_append`` instead of re-deriving it."""
+    if ctx.rel == _JOURNAL_REL.replace(os.sep, '/'):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == 'seek' and node.args):
+            continue
+        first = node.args[0]
+        neg_one = (isinstance(first, ast.UnaryOp)
+                   and isinstance(first.op, ast.USub)
+                   and isinstance(first.operand, ast.Constant)
+                   and first.operand.value == 1) \
+            or (isinstance(first, ast.Constant) and first.value == -1)
+        if neg_one:
+            out.append(Finding(
+                'OCT008', ctx.rel, node.lineno,
+                'tail-byte probe (seek(-1, ...)) re-implements the '
+                'torn-tail seal — use utils.journal.seal_torn_tail / '
+                'journal_append for shared JSONL journals',
+                ctx.line_text(node.lineno)))
+    return out
+
+
 _CHECKERS = {
     'OCT001': _check_oct001,
     'OCT002': _check_oct002,
@@ -723,6 +757,7 @@ _CHECKERS = {
     'OCT005': _check_oct005,
     'OCT006': _check_oct006,
     'OCT007': _check_oct007,
+    'OCT008': _check_oct008,
 }
 
 

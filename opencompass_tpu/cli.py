@@ -55,6 +55,22 @@ Usage::
                     # no silent loss, degraded-not-down /healthz,
                     # Retry-After on sheds, p99 within objective,
                     # bit-identical store convergence (docs/serving.md)
+    python -m opencompass_tpu.cli obs query CACHE_ROOT --q 0.99
+                    # fleet observability hub: p99 (and any percentile)
+                    # answered from durable 1m/10m/1h rollups alone —
+                    # exact for tail ranks via per-window reservoirs,
+                    # with a kept-trace exemplar; --raw opts back into
+                    # the raw streams while they exist
+    python -m opencompass_tpu.cli obs compact CACHE_ROOT
+                    # finalize rollups + kept traces, then enforce the
+                    # raw-stream retention budget
+                    # (OCT_HUB_RETENTION_BYTES); never drops a byte
+                    # that is not yet rolled up
+    python -m opencompass_tpu.cli obs diff RUN_A RUN_B
+                    # cross-run regression attribution: wall-time
+                    # deltas ranked and pinned to phase (queue wait,
+                    # compile, prefill, decode, eval) and to the
+                    # compiled shape key that moved
     python -m opencompass_tpu.cli chaos --scenario flaky_api --check
                     # outbound API resilience drill vs the device-free
                     # fault-injecting stub provider: 429 pacing
@@ -379,6 +395,21 @@ def chaos_main(argv=None) -> int:
     return chaos_cli_main(argv)
 
 
+def obs_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli obs {ingest|query|compact|diff}``
+    — the fleet observability hub: aggregate every obs stream (daemon,
+    driver, resident workers — each a ``(host, role, obs_dir)``
+    source) into tail-sampled traces and windowed rollups under
+    ``{obs_dir}/hub/``.  ``query`` answers time-range + label +
+    percentile questions from rollups alone (``--raw`` opts back into
+    the raw streams); ``compact`` enforces the raw-stream retention
+    budget after rollups and kept traces are durable; ``diff A B``
+    attributes cross-run wall-time regressions to phase and compiled
+    shape (docs/observability.md "Fleet hub")."""
+    from opencompass_tpu.obs.hub import main as hub_main
+    return hub_main(argv)
+
+
 def serve_main(argv=None) -> int:
     """``python -m opencompass_tpu.cli serve <config> [--port N]`` —
     the persistent evaluation engine: durable FIFO sweep queue under
@@ -412,6 +443,8 @@ def main():
         raise SystemExit(doctor_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'lint':
         raise SystemExit(lint_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == 'obs':
+        raise SystemExit(obs_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'chaos':
         raise SystemExit(chaos_main(sys.argv[2:]))
     args = parse_args()

@@ -12,6 +12,10 @@ then ``<path>/cache/ledger``.
   ``--max-slowdown`` / ``--max-accuracy-drop`` — the CI gate.
   ``--min-mfu-ratio FRAC`` adds the roofline efficiency gate (MFU may
   not fall below FRAC of baseline; rows without an MFU are skipped).
+  ``--max-regression FRAC`` adds the attributed wall-time gate: a row
+  whose wall clock grew past FRAC of baseline fails, and the
+  observability hub names the phase (and, for compile regressions,
+  the shape key) that ate the delta.
   ``--max-model-drift FRAC`` adds the compile-audit reconciliation
   gate: the run's measured-vs-modeled flop divergence (from
   ``obs/compiles.jsonl``) may not exceed FRAC — record-local, so it
@@ -154,6 +158,9 @@ def _cmd_check(records, args) -> int:
                 records, base, cur, max_slowdown=args.max_slowdown,
                 max_accuracy_drop=args.max_accuracy_drop,
                 min_mfu_ratio=args.min_mfu_ratio)
+            if args.max_regression is not None:
+                regressions += ledmod.check_wall_regression(
+                    records, base, cur, args.max_regression)
         elif not args.trajectory and args.max_model_drift is None:
             # a gate with no baseline passes: the FIRST run of a sweep
             # (or a fresh cache root) has nothing to regress against,
@@ -182,6 +189,14 @@ def _cmd_check(records, args) -> int:
                 print(f"REGRESSION [{reg['model']}/{reg['dataset']}]: "
                       f"MFU {reg.get('mfu_base')} -> {reg.get('mfu')} "
                       f"(below {reg['threshold']:.0%} of baseline)")
+            elif reg['regression'] == 'wall_time':
+                shape = reg.get('shape_key')
+                print(f"REGRESSION [{reg['model']}/{reg['dataset']}]: "
+                      f"wall {reg['wall_seconds_base']}s -> "
+                      f"{reg['wall_seconds']}s "
+                      f"({reg['wall_rel']:+.1%}, threshold "
+                      f"{reg['threshold']:.0%}) — {reg['phase']} phase"
+                      + (f', shape {shape}' if shape else ''))
             elif reg['regression'] == 'model_drift':
                 print(f"REGRESSION [{reg['model']}/{reg['dataset']}]: "
                       f"cost model drifts {reg['model_drift']:.1%} from "
@@ -232,6 +247,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                         'regresses (e.g. 0.5 = halved efficiency '
                         'fails; off by default — rows without an MFU '
                         'are skipped)')
+    parser.add_argument('--max-regression', type=float, default=None,
+                        metavar='FRAC',
+                        help='wall-time gate with attribution: a row '
+                        'whose wall_seconds grew more than FRAC over '
+                        'baseline regresses, printed with the hub\'s '
+                        'phase (+ shape key for compile regressions) '
+                        'attribution (off by default)')
     parser.add_argument('--max-model-drift', type=float, default=None,
                         metavar='FRAC',
                         help='reconciliation gate: fail when the run\'s '

@@ -377,6 +377,46 @@ def check_records(records: List[Dict], baseline: str, run: str,
     return out
 
 
+def check_wall_regression(records: List[Dict], baseline: str, run: str,
+                          max_regression: float) -> List[Dict]:
+    """Wall-time gate with attribution: rows whose ``wall_seconds`` grew
+    more than ``max_regression`` (fractional) over baseline, each
+    annotated by the observability hub with the phase that ate the
+    delta (compile/decode/other from the rows' own accounting) and —
+    for compile-dominated regressions whose work_dirs survive — the
+    shape key whose audit records moved the most.  Rows either side of
+    which was fully store-served skip the gate (a warm rerun's wall is
+    not comparable), like the throughput one."""
+    from opencompass_tpu.obs import hub as hubmod
+
+    def computed(rec) -> bool:
+        rate = rec.get('store_hit_rate')
+        return not isinstance(rate, (int, float)) or rate < 1.0
+
+    base_idx = _index(records, baseline)
+    cur_idx = _index(records, run)
+    out = []
+    for key in sorted(set(base_idx) & set(cur_idx),
+                      key=lambda k: (str(k[0]), str(k[1]))):
+        base, cur = base_idx[key], cur_idx[key]
+        if not (computed(base) and computed(cur)):
+            continue
+        wall_b, wall_c = base.get('wall_seconds'), cur.get('wall_seconds')
+        if not isinstance(wall_b, (int, float)) \
+                or not isinstance(wall_c, (int, float)) or wall_b <= 0:
+            continue
+        rel = (wall_c - wall_b) / wall_b
+        if rel <= max_regression:
+            continue
+        out.append({'model': key[0], 'dataset': key[1],
+                    'regression': 'wall_time',
+                    'wall_seconds_base': wall_b, 'wall_seconds': wall_c,
+                    'wall_rel': round(rel, 4),
+                    'threshold': max_regression,
+                    **hubmod.attribute_ledger_delta(base, cur)})
+    return out
+
+
 def check_model_drift(records: List[Dict], run: str,
                       max_drift: float) -> List[Dict]:
     """Record-local reconciliation gate: rows of ``run`` whose compile

@@ -47,8 +47,8 @@ import threading
 import time
 from typing import Dict, Iterable, List, Optional
 
-from opencompass_tpu.utils.fileio import (append_jsonl_atomic,
-                                          iter_jsonl_records)
+from opencompass_tpu.utils.fileio import iter_jsonl_records
+from opencompass_tpu.utils.journal import journal_append
 
 COMPILES_FILE = 'compiles.jsonl'
 AUDIT_VERSION = 1
@@ -292,7 +292,10 @@ class CompileAudit:
                 rec['model_drift'] = round(
                     abs(xla_flops - expected['flops'])
                     / max(xla_flops, 1.0), 6)
-        append_jsonl_atomic(self.path, [rec])
+        # sealed append: compiles.jsonl is shared by the driver and
+        # every worker/task process in one obs dir, so a writer killed
+        # mid-append must not absorb the next writer's record
+        journal_append(self.path, [rec])
 
 
 # -- module registry (obs install/get/reset pattern) ------------------------

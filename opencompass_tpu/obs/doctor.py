@@ -62,6 +62,11 @@ never to a crash):
                          XLA's own per-executable accounting
                          (``obs/compiles.jsonl``) past the gate
                          threshold, naming the worst shape.
+- ``obs_disk_pressure``  (warn; error past 2x) raw telemetry streams
+                         exceed the observability hub's retention
+                         budget — compaction is absent or losing the
+                         race (``cli obs compact``,
+                         OCT_HUB_RETENTION_BYTES).
 """
 from __future__ import annotations
 
@@ -88,6 +93,11 @@ API_THROTTLED_MIN_429 = 5
 API_THROTTLED_FRAC = 0.1
 HBM_PRESSURE_FRAC = 0.9
 MODEL_DRIFT_FRAC = 0.25
+# raw obs streams past this fraction of the hub's retention budget
+# fire obs_disk_pressure (warn at the budget, error at 2x — by then
+# compaction has clearly not been keeping up)
+OBS_DISK_PRESSURE_FRAC = 1.0
+OBS_DISK_PRESSURE_ERROR_FRAC = 2.0
 
 
 def _finding(severity: str, rule: str, title: str,
@@ -118,7 +128,7 @@ def collect(path: str) -> Dict:
                  'events': [], 'requests': [], 'alerts_active': [],
                  'alerts_recent': [], 'run_marker': None,
                  'queue_pressure': None, 'overload': None,
-                 'outbound': None, 'compiles': []}
+                 'outbound': None, 'compiles': [], 'hub': None}
     try:
         art['obs_dir'] = live.resolve_obs_dir(path)
     except Exception:
@@ -199,6 +209,19 @@ def collect(path: str) -> Dict:
         for cand in (art['obs_dir'], art['serve_obs_dir']):
             if cand and art['outbound'] is None:
                 art['outbound'] = read_outbound(cand)
+    except Exception:
+        pass
+    # hub accounting: raw-stream weight vs the retention budget for
+    # the nearest hub (serve obs dir first — that one has a daemon
+    # compacting on a cadence, so pressure there is a real finding)
+    try:
+        from opencompass_tpu.obs import hub as hubmod
+        for cand in (art['serve_obs_dir'], art['obs_dir']):
+            if cand and art.get('hub') is None:
+                art['hub'] = {
+                    'obs_dir': cand,
+                    'raw_bytes': hubmod.raw_stream_bytes(cand),
+                    'budget_bytes': hubmod.retention_bytes()}
     except Exception:
         pass
     return art
@@ -718,6 +741,36 @@ def _rule_model_drift(art: Dict) -> List[Dict]:
         data={'model_drift_max': drift, 'shape': shape})]
 
 
+def _rule_obs_disk_pressure(art: Dict) -> List[Dict]:
+    """Raw telemetry streams past the hub's retention budget: either
+    nothing is compacting (no daemon, nobody runs `cli obs compact`)
+    or compaction cannot keep up with the write rate — left alone the
+    obs dir eats the disk the run needs."""
+    hub = art.get('hub') or {}
+    raw = hub.get('raw_bytes')
+    budget = hub.get('budget_bytes')
+    if not raw or not budget:
+        return []
+    frac = raw / budget
+    if frac <= OBS_DISK_PRESSURE_FRAC:
+        return []
+    severity = 'error' if frac > OBS_DISK_PRESSURE_ERROR_FRAC \
+        else 'warn'
+    return [_finding(
+        severity, 'obs_disk_pressure',
+        f'raw obs streams at {raw / 2**20:.1f} MiB — '
+        f'{frac:.1f}x the retention budget',
+        [f'{hub.get("obs_dir")}: {raw} bytes of raw streams vs '
+         f'budget {budget} (OCT_HUB_RETENTION_BYTES)'],
+        fix='run `cli obs compact <root>` (rollups and kept traces '
+            'are written before any raw byte is dropped), or raise '
+            'OCT_HUB_RETENTION_BYTES; a serve daemon compacts '
+            'automatically — pressure there means the cadence lost '
+            'the race (docs/observability.md "Fleet hub")',
+        data={'raw_bytes': raw, 'budget_bytes': budget,
+              'frac': round(frac, 3)})]
+
+
 RULES: List[Callable[[Dict], List[Dict]]] = [
     _rule_failed_tasks,
     _rule_breaker_open,
@@ -734,6 +787,7 @@ RULES: List[Callable[[Dict], List[Dict]]] = [
     _rule_gather_waste,
     _rule_queue_backlog,
     _rule_overload_shedding,
+    _rule_obs_disk_pressure,
     _rule_dead_run,
 ]
 

@@ -1,0 +1,1235 @@
+"""Fleet observability hub: multi-source aggregation with tail-based
+trace sampling, windowed metric rollups, retention, and cross-run
+regression attribution.
+
+Every process in the stack records richly but *locally*: the daemon,
+each resident worker, sweep subprocesses and the outbound scheduler
+write their own ``obs/`` JSONL streams, and nothing merges, retains,
+or compares them — the gap that blocks the multi-host fleet (ROADMAP
+item 4), where "one logical engine across TPU slices" is unobservable
+without a single aggregated view.  The hub is that view, and it is
+remote-host-shaped from day one: every source is a ``(host, role,
+obs_dir)`` tuple, so a future fleet registers remote mounts or
+synced stream copies without an API change.
+
+Three materializations, all durable files under ``{obs_dir}/hub/``:
+
+1. **Tail-based trace sampling** (``traces.jsonl``).  A request trace
+   completes when its ``requests.jsonl`` span-tree record lands (the
+   daemon writes it once, at completion, with the full daemon →
+   scheduler → worker → engine phase breakdown).  The keep/drop
+   decision is made *at that completion point*, never at span
+   emission: 100% of error / deadline-breach / degraded traces and of
+   traces overlapping an SLO-burn (firing alert) window are kept, as
+   are p99-slow traces against a rolling latency estimate; the rest
+   are downsampled by a deterministic hash of the trace id
+   (``OCT_HUB_SAMPLE_RATE``, default 0.1).  Sampled-away traces still
+   count in every rollup — the drop loses the span detail, never the
+   statistics.
+
+2. **Metric rollups with retention** (``rollups.jsonl``).  Fixed
+   1m/10m/1h windows aggregate completion latency histograms (shared
+   ``LATENCY_BUCKETS_S``), HTTP/alert/compile counters and heartbeat
+   gauges into compact per-window records with **exemplars** — each
+   latency bucket links a kept trace id, so a dashboard percentile
+   click lands on a real trace.  Raw streams grow without bound;
+   :meth:`ObsHub.compact` (and ``cli obs compact`` / the daemon's hub
+   thread) enforces a size budget (``OCT_HUB_RETENTION_BYTES``) by
+   deleting fully-ingested rotated segments first and rotating
+   fully-ingested live files after — rollups and kept traces are
+   written *before* a byte of raw is dropped, so queries keep
+   answering from rollups alone (``cli obs query``; ``--raw`` opts
+   back into the raw streams while they exist).
+
+3. **Cross-run regression attribution** (:func:`diff_runs`, ``cli obs
+   diff A B``).  Joins two runs' ledger-shaped perf records, compile
+   audits and request phases by task key and shape key, attributes
+   wall-time deltas to phase (queue wait, compile, prefill, decode,
+   eval) and to specific compiled shapes, and ranks "what got slower
+   and why"; ``cli ledger check --max-regression FRAC`` gates the
+   same attribution in CI.
+
+Durability discipline is the shared journal's (``utils.journal``):
+sealed O_APPEND appends, torn-line tolerant reads, last-wins read-side
+dedup by window/trace key — so a ``kill -9`` anywhere (mid-ingest,
+mid-compaction) can only duplicate an append, never lose a kept trace
+or double-count a window (``analysis/crashfuzz.py`` drills exactly
+this).  The commit point is ``cursors.json`` (atomic replace): state
+written after the appends it describes, so a crash replays, and replay
+deduplicates.
+"""
+from __future__ import annotations
+
+# oct-lint: clock-discipline — window math, staleness and sampling
+# evaluate under an injected now=; bare time.time() only as the
+# `if now is None` fallback.
+
+import hashlib
+import json
+import os
+import os.path as osp
+import tempfile
+import time
+from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
+
+from opencompass_tpu.obs.metrics import LATENCY_BUCKETS_S, labeled
+from opencompass_tpu.utils.fileio import (atomic_write_json,
+                                          iter_jsonl_records)
+from opencompass_tpu.utils.journal import journal_append, read_journal
+
+HUB_VERSION = 1
+HUB_SUBDIR = 'hub'
+ROLLUPS_FILE = 'rollups.jsonl'
+TRACES_FILE = 'traces.jsonl'
+CURSORS_FILE = 'cursors.json'
+SOURCES_FILE = 'sources.jsonl'
+
+# fixed rollup windows (seconds): 1m for live panes, 10m for day-scale
+# dashboards, 1h for the long series that outlives raw retention
+WINDOWS_S = (60, 600, 3600)
+
+ENV_SAMPLE_RATE = 'OCT_HUB_SAMPLE_RATE'
+DEFAULT_SAMPLE_RATE = 0.1
+ENV_RETENTION_BYTES = 'OCT_HUB_RETENTION_BYTES'
+DEFAULT_RETENTION_BYTES = 64 * 1024 * 1024
+# a gauge/source older than this is STALE: exported with a marker, not
+# at its last value (the promexport staleness contract)
+STALE_AFTER_S = 300.0
+# windows finalize once now passes end + grace (late records inside the
+# grace still land; after it they re-emit the window, last-wins dedup)
+WINDOW_GRACE_S = 10.0
+SLOW_QUANTILE = 0.99
+_SLOW_WINDOW = 512             # rolling latency samples for p99-slow
+# exact tail reservoir: each latency window keeps its top-K wall
+# times.  Any global top-m value lives in its window's top-m, so a
+# percentile whose from-top rank is <= K is answered EXACTLY from the
+# merged reservoirs; only deeper ranks fall back to histogram
+# interpolation.  p99 stays exact up to 3200 completions per merge.
+TAIL_K = 32
+
+# raw streams the hub ingests / retains per source obs dir, with their
+# record → stream kind mapping
+RAW_STREAMS = ('requests.jsonl', 'access.jsonl', 'alerts.jsonl',
+               'compiles.jsonl', 'events.jsonl')
+
+
+class Source(NamedTuple):
+    """One telemetry producer.  ``host`` is free-form ('local' today, a
+    hostname once streams sync across machines); ``role`` is
+    daemon/driver/worker/...; ``obs_dir`` is where its streams live."""
+    host: str
+    role: str
+    obs_dir: str
+
+    @property
+    def key(self) -> str:
+        return f'{self.host}:{self.role}:{osp.abspath(self.obs_dir)}'
+
+
+def hub_dir(obs_dir: str) -> str:
+    return osp.join(obs_dir, HUB_SUBDIR)
+
+
+def sample_rate() -> float:
+    try:
+        raw = float(os.environ.get(ENV_SAMPLE_RATE) or '')
+    except (TypeError, ValueError):
+        return DEFAULT_SAMPLE_RATE
+    return min(max(raw, 0.0), 1.0)
+
+
+def retention_bytes() -> int:
+    try:
+        raw = int(os.environ.get(ENV_RETENTION_BYTES) or 0)
+    except (TypeError, ValueError):
+        raw = 0
+    return raw if raw > 0 else DEFAULT_RETENTION_BYTES
+
+
+def raw_stream_bytes(obs_dir: str) -> int:
+    """On-disk weight of every raw stream across ``obs_dir``'s sources
+    — read-only (no hub dir is created), for doctor's disk-pressure
+    rule and anything else that wants the accounting without a hub."""
+    total = 0
+    sources = discover_sources(obs_dir) \
+        or [Source('local', 'driver', obs_dir)]
+    seen = set()
+    for source in sources:
+        for name in RAW_STREAMS:
+            for seg in ('.1', ''):
+                path = osp.join(source.obs_dir, name + seg)
+                if path in seen:
+                    continue
+                seen.add(path)
+                try:
+                    total += os.path.getsize(path)
+                except OSError:
+                    pass
+    return total
+
+
+def register_source(obs_dir: str, host: str, role: str,
+                    source_obs_dir: str,
+                    now: Optional[float] = None) -> None:
+    """Durably register an extra source under ``{obs_dir}/hub/`` — the
+    remote-host hook: a fleet syncs a slice's streams somewhere and
+    registers the mount here.  Idempotent by (host, role, obs_dir) at
+    read time.  Never raises."""
+    try:
+        path = osp.join(hub_dir(obs_dir), SOURCES_FILE)
+        os.makedirs(osp.dirname(path), exist_ok=True)
+        journal_append(path, [{
+            'host': host, 'role': role,
+            'obs_dir': osp.abspath(source_obs_dir),
+            'ts': round(time.time() if now is None else now, 3),
+        }], version=HUB_VERSION)
+    except Exception:
+        pass
+
+
+def discover_sources(root: str) -> List[Source]:
+    """Enumerate sources for ``root`` — a serve cache root, a run
+    work_dir, or an obs dir itself.
+
+    Local discovery: the serve obs dir (role ``daemon``), the run obs
+    dir (role ``driver``), then explicit registrations from
+    ``hub/sources.jsonl`` (how remote hosts join before any code here
+    changes), then every heartbeat that registered itself with
+    ``host``/``role``/``obs_dir`` fields (resident workers do) — the
+    heartbeat scan runs last so workers under a *registered* slice are
+    found too.
+    """
+    sources: Dict[str, Source] = {}
+
+    def add(host, role, obs_dir):
+        if obs_dir and osp.isdir(obs_dir):
+            src = Source(str(host or 'local'), str(role or '?'),
+                         osp.abspath(obs_dir))
+            sources.setdefault(src.key, src)
+
+    serve_dir = osp.join(root, 'serve', 'obs')
+    if osp.isdir(serve_dir):
+        add('local', 'daemon', serve_dir)
+    try:
+        from opencompass_tpu.obs.live import resolve_obs_dir
+        run_obs = resolve_obs_dir(root)
+    except Exception:
+        run_obs = None
+    if run_obs:
+        add('local', 'driver', run_obs)
+    if not sources and osp.isdir(root):
+        # bare directory holding streams (tests, synced copies)
+        if any(osp.isfile(osp.join(root, f)) for f in RAW_STREAMS):
+            add('local', 'driver', root)
+
+    bases = [osp.abspath(root)] + [s.obs_dir
+                                   for s in list(sources.values())]
+    for base in dict.fromkeys(bases):
+        for rec in read_journal(osp.join(hub_dir(base), SOURCES_FILE)):
+            add(rec.get('host'), rec.get('role'), rec.get('obs_dir'))
+
+    # heartbeat self-registration: a worker's note(host=, role=,
+    # obs_dir=) makes it a first-class source even when its obs dir is
+    # elsewhere (subprocess work dirs, remote mounts)
+    try:
+        from opencompass_tpu.obs.live import read_heartbeats
+        for base in [s.obs_dir for s in list(sources.values())]:
+            for rec in read_heartbeats(base).values():
+                if rec.get('obs_dir'):
+                    add(rec.get('host'), rec.get('role') or 'worker',
+                        rec['obs_dir'])
+    except Exception:
+        pass
+    return sorted(sources.values())
+
+
+# -- histogram helpers ------------------------------------------------------
+
+def _bucket_index(buckets: List[float], value: float) -> int:
+    lo, hi = 0, len(buckets)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if value <= buckets[mid]:
+            hi = mid
+        else:
+            lo = mid + 1
+    return lo
+
+
+def percentile_from_histogram(buckets: List[float], counts: List[int],
+                              q: float) -> Optional[float]:
+    """q-th percentile from cumulative-upper-bound bucket counts, with
+    linear interpolation inside the bucket (Prometheus
+    ``histogram_quantile`` semantics).  The overflow bucket clamps to
+    the top finite edge — an honest floor, not an invented value."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    lo = 0.0
+    for i, edge in enumerate(buckets):
+        c = counts[i]
+        if c > 0 and cum + c >= rank:
+            return lo + (rank - cum) / c * (edge - lo)
+        cum += c
+        lo = edge
+    return buckets[-1] if buckets else None
+
+
+# -- the hub ---------------------------------------------------------------
+
+class ObsHub:
+    """Ingest → sample → roll up → retain, incrementally and durably.
+
+    One instance owns one ``{obs_dir}/hub/`` directory.  All methods
+    are crash-safe in the journal sense: state that matters is either
+    an appended (deduplicated-on-read) journal record or the atomic
+    ``cursors.json`` snapshot; kill -9 between the two replays work,
+    never loses it."""
+
+    def __init__(self, base_obs_dir: str,
+                 sources: Optional[Iterable[Source]] = None,
+                 rate: Optional[float] = None,
+                 budget_bytes: Optional[int] = None,
+                 windows: Tuple[int, ...] = WINDOWS_S):
+        self.base_obs_dir = osp.abspath(base_obs_dir)
+        self.dir = hub_dir(self.base_obs_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        self.rollups_path = osp.join(self.dir, ROLLUPS_FILE)
+        self.traces_path = osp.join(self.dir, TRACES_FILE)
+        self.cursors_path = osp.join(self.dir, CURSORS_FILE)
+        self.sources = list(sources) if sources is not None else \
+            discover_sources(self.base_obs_dir)
+        if not self.sources:
+            self.sources = [Source('local', 'driver',
+                                   self.base_obs_dir)]
+        self.rate = sample_rate() if rate is None else float(rate)
+        self.budget_bytes = (retention_bytes() if budget_bytes is None
+                             else int(budget_bytes))
+        self.windows = tuple(sorted(int(w) for w in windows))
+        self._state = self._load_state()
+
+    # -- persistent state --------------------------------------------------
+
+    def _load_state(self) -> Dict:
+        try:
+            with open(self.cursors_path, encoding='utf-8') as f:
+                state = json.load(f)
+            if isinstance(state, dict) and state.get('v') == HUB_VERSION:
+                return state
+        except (OSError, ValueError):
+            pass
+        return {'v': HUB_VERSION, 'cursors': {}, 'pending': {},
+                'slow': [], 'burn': [], 'last_seen': {}}
+
+    def _save_state(self, now: float) -> None:
+        self._state['ts'] = round(now, 3)
+        atomic_write_json(self.cursors_path, self._state)
+
+    # -- ingestion ---------------------------------------------------------
+
+    def _read_new(self, path: str) -> List[Dict]:
+        """Records appended to ``path`` since the cursor.  Cursors are
+        byte offsets per absolute path; a shrunk file (rotation) resets
+        to 0 — the `.1` segment has its own cursor, and read-side dedup
+        absorbs any overlap."""
+        cursors = self._state['cursors']
+        key = osp.abspath(path)
+        offset = int(cursors.get(key) or 0)
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return []
+        if size < offset:
+            offset = 0
+        if size == offset:
+            return []
+        try:
+            with open(path, 'rb') as f:
+                f.seek(offset)
+                data = f.read()
+        except OSError:
+            return []
+        # only consume whole lines; a torn tail stays un-cursored so
+        # the finishing write is picked up next pass
+        end = data.rfind(b'\n')
+        if end < 0:
+            return []
+        data = data[:end + 1]
+        cursors[key] = offset + len(data)
+        out = []
+        for line in data.split(b'\n'):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+        return out
+
+    def _windows_for(self, ts: float):
+        for w in self.windows:
+            yield w, int(ts // w) * w
+
+    def _acc(self, window_s: int, start: int, series: str,
+             **labels) -> Dict:
+        key = f'{window_s}|{start}|{labeled(series, **labels)}'
+        acc = self._state['pending'].get(key)
+        if acc is None:
+            acc = self._state['pending'][key] = {
+                'window_s': window_s, 'start': start, 'series': series,
+                'labels': {k: str(v) for k, v in sorted(labels.items())},
+                'count': 0}
+        return acc
+
+    def _observe_latency(self, acc: Dict, wall_s: float,
+                         kept_trace: Optional[str]) -> None:
+        if 'counts' not in acc:
+            acc['buckets'] = list(LATENCY_BUCKETS_S)
+            acc['counts'] = [0] * (len(LATENCY_BUCKETS_S) + 1)
+            acc['sum'] = 0.0
+            acc['exemplars'] = {}
+            acc['top'] = []
+        i = _bucket_index(acc['buckets'], wall_s)
+        acc['counts'][i] += 1
+        acc['sum'] = round(acc['sum'] + wall_s, 6)
+        acc['count'] += 1
+        top = acc['top']
+        top.append(round(wall_s, 6))
+        top.sort(reverse=True)
+        del top[TAIL_K:]
+        if kept_trace:
+            le = (str(acc['buckets'][i]) if i < len(acc['buckets'])
+                  else '+Inf')
+            acc['exemplars'][le] = kept_trace
+
+    def _slow_threshold(self) -> Optional[float]:
+        slow = self._state['slow']
+        if len(slow) < 20:    # too few samples to call anything p99
+            return None
+        ordered = sorted(slow)
+        rank = max(int(SLOW_QUANTILE * len(ordered)), 1)
+        return ordered[min(rank, len(ordered)) - 1]
+
+    def _in_burn(self, ts: float) -> bool:
+        for iv in self._state['burn']:
+            t0, t1 = iv[0], iv[1]
+            if ts >= t0 and (t1 is None or ts <= t1):
+                return True
+        return False
+
+    def _keep_reason(self, rec: Dict, wall_s: float) -> Optional[str]:
+        if rec.get('status') not in (None, 'ok') or rec.get('error'):
+            return 'error'
+        if rec.get('degraded'):
+            return 'degraded'
+        if self._in_burn(rec.get('ts') or 0.0):
+            return 'slo_burn'
+        threshold = self._slow_threshold()
+        if threshold is not None and wall_s >= threshold:
+            return 'p99_slow'
+        return None
+
+    def _hash_sampled(self, trace_id: str) -> bool:
+        digest = hashlib.sha1(trace_id.encode('utf-8')).hexdigest()
+        return (int(digest[:8], 16) / 0xffffffff) < self.rate
+
+    def _complete_trace(self, rec: Dict, source: Source,
+                        kept_out: List[Dict]) -> None:
+        """The tail-sampling decision point: one completed request."""
+        trace_id = str(rec.get('request_id') or rec.get('id')
+                       or f"anon-{rec.get('ts')}")
+        wall_s = float(rec.get('wall_s') or 0.0)
+        ts = float(rec.get('ts') or 0.0)
+        reason = self._keep_reason(rec, wall_s)
+        kept = reason is not None or self._hash_sampled(trace_id)
+        if kept and reason is None:
+            reason = 'sampled'
+        slow = self._state['slow']
+        slow.append(round(wall_s, 6))
+        del slow[:-_SLOW_WINDOW]
+        model = rec.get('model') or '?'
+        error = (rec.get('status') not in (None, 'ok')
+                 or bool(rec.get('error')))
+        for w, start in self._windows_for(ts):
+            acc = self._acc(w, start, 'completion_latency',
+                            model=model, role=source.role)
+            self._observe_latency(acc, wall_s,
+                                  trace_id if kept else None)
+            if error:
+                acc['errors'] = acc.get('errors', 0) + 1
+            if kept:
+                acc['kept'] = acc.get('kept', 0) + 1
+        if kept:
+            out = {'t': 'trace', 'trace': trace_id,
+                   'ts': round(ts, 6), 'wall_s': round(wall_s, 6),
+                   'model': model, 'keep': reason,
+                   'host': source.host, 'role': source.role}
+            for field in ('status', 'error', 'degraded', 'phases',
+                          'ttft_ms', 'route'):
+                if rec.get(field) is not None:
+                    out[field] = rec[field]
+            kept_out.append(out)
+
+    def _count(self, rec_ts: float, series: str, **labels) -> None:
+        for w, start in self._windows_for(rec_ts):
+            acc = self._acc(w, start, series, **labels)
+            acc['count'] += 1
+
+    def _ingest_source(self, source: Source, kept_out: List[Dict],
+                       now: float) -> int:
+        n = 0
+        base = source.obs_dir
+        # alerts first: burn intervals must exist before this pass's
+        # completions are judged against them
+        for seg in ('.1', ''):
+            for rec in self._read_new(
+                    osp.join(base, 'alerts.jsonl' + seg)):
+                n += 1
+                ts = float(rec.get('ts') or 0.0)
+                if rec.get('t') == 'fire':
+                    self._state['burn'].append([ts, None])
+                    self._count(ts, 'alerts', rule=rec.get('rule'),
+                                transition='fire')
+                elif rec.get('t') == 'resolve':
+                    for iv in self._state['burn']:
+                        if iv[1] is None:
+                            iv[1] = ts
+                    self._count(ts, 'alerts', rule=rec.get('rule'),
+                                transition='resolve')
+        # drop burn intervals that can no longer matter
+        horizon = now - 2 * max(self.windows)
+        self._state['burn'] = [
+            iv for iv in self._state['burn']
+            if iv[1] is None or iv[1] >= horizon]
+        for seg in ('.1', ''):
+            for rec in self._read_new(
+                    osp.join(base, 'requests.jsonl' + seg)):
+                if 'wall_s' not in rec:
+                    continue
+                n += 1
+                self._complete_trace(rec, source, kept_out)
+            for rec in self._read_new(
+                    osp.join(base, 'access.jsonl' + seg)):
+                n += 1
+                self._count(float(rec.get('ts') or 0.0),
+                            'http_requests',
+                            route=rec.get('route') or rec.get('path')
+                            or '?', code=rec.get('status') or 0)
+        for rec in self._read_new(osp.join(base, 'compiles.jsonl')):
+            if rec.get('t') != 'compile':
+                continue
+            n += 1
+            ts = float(rec.get('ts') or 0.0)
+            secs = float(rec.get('compile_seconds') or 0.0)
+            for w, start in self._windows_for(ts):
+                acc = self._acc(w, start, 'compile_seconds',
+                                shape=rec.get('shape_key') or '?',
+                                role=source.role)
+                self._observe_latency(acc, secs, None)
+        # heartbeat gauges: last value per window, stamped so readers
+        # can age them out instead of trusting a dead worker's numbers
+        try:
+            from opencompass_tpu.obs.live import read_heartbeats
+            beats = read_heartbeats(base)
+        except Exception:
+            beats = {}
+        for task, beat in beats.items():
+            ts = float(beat.get('ts') or
+                       (now - float(beat.get('heartbeat_age_seconds')
+                                    or 0.0)))
+            for name, value in beat.items():
+                if name in ('ts', 'pid', 'v') or \
+                        not isinstance(value, (int, float)) or \
+                        isinstance(value, bool):
+                    continue
+                for w, start in self._windows_for(ts):
+                    acc = self._acc(w, start, 'gauge', name=name,
+                                    role=source.role,
+                                    host=source.host)
+                    acc['count'] += 1
+                    if ts >= acc.get('last_ts', -1):
+                        acc['last'] = value
+                        acc['last_ts'] = round(ts, 3)
+        if n:
+            self._state['last_seen'][source.key] = round(now, 3)
+        return n
+
+    def ingest(self, now: Optional[float] = None,
+               force_flush: bool = False) -> Dict:
+        """One incremental pass over every source: sample completed
+        traces, accumulate rollup windows, finalize the closed ones,
+        persist.  Returns counters for the caller's telemetry."""
+        now = time.time() if now is None else float(now)
+        kept: List[Dict] = []
+        ingested = 0
+        for source in self.sources:
+            try:
+                ingested += self._ingest_source(source, kept, now)
+            except Exception:
+                continue     # one broken source must not stall the rest
+        emitted = self._flush_windows(now, force=force_flush)
+        if kept:
+            journal_append(self.traces_path, kept, version=HUB_VERSION)
+        if emitted:
+            journal_append(self.rollups_path, emitted,
+                           version=HUB_VERSION)
+        # commit point: cursors/pending written AFTER the appends they
+        # describe — a crash in between replays, and replay dedups
+        self._save_state(now)
+        return {'ingested': ingested, 'kept': len(kept),
+                'windows_emitted': len(emitted),
+                'sources': len(self.sources)}
+
+    def _flush_windows(self, now: float, force: bool) -> List[Dict]:
+        """Closed windows → rollup records (dropped from pending);
+        ``force`` also emits still-open windows (kept in pending — the
+        later re-emit supersedes via last-wins dedup) plus staleness
+        markers for silent sources."""
+        emitted: List[Dict] = []
+        pending = self._state['pending']
+        for key in sorted(pending):
+            acc = pending[key]
+            closed = now >= acc['start'] + acc['window_s'] \
+                + WINDOW_GRACE_S
+            if not (closed or force):
+                continue
+            rec = {'t': 'rollup', 'final_ts': round(now, 3)}
+            rec.update(acc)
+            if 'sum' in rec:
+                rec['sum'] = round(rec['sum'], 6)
+            emitted.append(rec)
+            if closed:
+                del pending[key]
+        if force:
+            for src_key, seen_ts in sorted(
+                    self._state['last_seen'].items()):
+                if now - float(seen_ts) > STALE_AFTER_S:
+                    emitted.append({'t': 'marker', 'kind': 'stale',
+                                    'source': src_key,
+                                    'last_seen': seen_ts,
+                                    'ts': round(now, 3)})
+        return emitted
+
+    # -- reading back ------------------------------------------------------
+
+    def read_rollups(self) -> List[Dict]:
+        return read_rollups(self.dir)
+
+    def read_traces(self) -> List[Dict]:
+        return read_traces(self.dir)
+
+    def query(self, series: str = 'completion_latency',
+              since: Optional[float] = None,
+              until: Optional[float] = None,
+              labels: Optional[Dict] = None,
+              q: float = 0.99, raw: bool = False,
+              now: Optional[float] = None) -> Dict:
+        now = time.time() if now is None else float(now)
+        until = now if until is None else float(until)
+        since = until - 3600.0 if since is None else float(since)
+        if raw:
+            return self._query_raw(series, since, until, labels, q)
+        return query_rollups(self.read_rollups(), series, since, until,
+                             labels, q)
+
+    def _query_raw(self, series: str, since: float, until: float,
+                   labels: Optional[Dict], q: float) -> Dict:
+        """The raw-stream answer (``--raw``): exact nearest-rank
+        percentiles while the raw streams still exist."""
+        from opencompass_tpu.obs.reqtrace import percentile
+        model = (labels or {}).get('model')
+        walls: List[float] = []
+        errors = 0
+        for source in self.sources:
+            for seg in ('.1', ''):
+                path = osp.join(source.obs_dir, 'requests.jsonl' + seg)
+                for rec in iter_jsonl_records(
+                        path, keep=lambda r: 'wall_s' in r):
+                    ts = float(rec.get('ts') or 0.0)
+                    if not (since <= ts <= until):
+                        continue
+                    if model and rec.get('model') != model:
+                        continue
+                    walls.append(float(rec['wall_s']))
+                    if rec.get('status') not in (None, 'ok') \
+                            or rec.get('error'):
+                        errors += 1
+        pct = percentile(walls, q)
+        return {'series': series, 'source': 'raw',
+                'count': len(walls), 'errors': errors,
+                'p': q,
+                'value_s': round(pct, 6) if pct is not None else None,
+                'mean_s': round(sum(walls) / len(walls), 6)
+                if walls else None}
+
+    # -- retention / compaction -------------------------------------------
+
+    def _retention_candidates(self) -> List[Tuple[str, bool]]:
+        """(path, is_segment) for every raw stream file across sources,
+        oldest-first (segments before their live files)."""
+        out: List[Tuple[float, str, bool]] = []
+        seen = set()
+        for source in self.sources:
+            for name in RAW_STREAMS:
+                for seg in ('.1', ''):
+                    path = osp.join(source.obs_dir, name + seg)
+                    if path in seen or not osp.isfile(path):
+                        continue
+                    seen.add(path)
+                    try:
+                        mtime = os.stat(path).st_mtime
+                    except OSError:
+                        continue
+                    out.append((mtime, path, seg == '.1'))
+        # segments are always older than their live files; global order
+        # is by mtime with segments first on ties
+        out.sort(key=lambda t: (t[0], not t[2]))
+        return [(path, is_seg) for _, path, is_seg in out]
+
+    def _fully_ingested(self, path: str) -> bool:
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            return False
+        return int(self._state['cursors'].get(osp.abspath(path))
+                   or 0) >= size
+
+    def raw_bytes(self) -> int:
+        return sum(os.path.getsize(p)
+                   for p, _ in self._retention_candidates()
+                   if osp.isfile(p))
+
+    def compact(self, now: Optional[float] = None) -> Dict:
+        """Ingest everything outstanding, force-flush rollups, then
+        enforce the raw-stream byte budget and rewrite the hub's own
+        journals deduplicated.
+
+        Deletion is gated on *fully ingested*: a byte of raw is only
+        dropped after its records are represented in rollups (and its
+        kept traces copied out) — the order that makes kill -9 during
+        compaction harmless."""
+        now = time.time() if now is None else float(now)
+        self.ingest(now=now, force_flush=True)
+        before = self.raw_bytes()
+        freed = 0
+        total = before
+        for path, is_segment in self._retention_candidates():
+            if total - freed <= self.budget_bytes:
+                break
+            if not self._fully_ingested(path):
+                continue
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if is_segment:
+                try:
+                    os.unlink(path)
+                    freed += size
+                except OSError:
+                    pass
+            else:
+                # rotate the live file out (appenders reopen per
+                # write, so this is safe under a live daemon), then
+                # drop the rotated segment we just fully ingested
+                try:
+                    os.replace(path, path + '.1')
+                    os.unlink(path + '.1')
+                    freed += size
+                    self._state['cursors'].pop(osp.abspath(path), None)
+                except OSError:
+                    pass
+        hub_before = self._hub_bytes()
+        self._rewrite_dedup(self.rollups_path, _rollup_key)
+        self._rewrite_dedup(self.traces_path, _trace_key)
+        hub_after = self._hub_bytes()
+        self._save_state(now)
+        return {'raw_bytes_before': before,
+                'raw_bytes_after': before - freed,
+                'freed_bytes': freed,
+                'hub_bytes_before': hub_before,
+                'hub_bytes_after': hub_after,
+                'ratio': round(hub_before / hub_after, 3)
+                if hub_after else None,
+                'budget_bytes': self.budget_bytes}
+
+    def _hub_bytes(self) -> int:
+        total = 0
+        for path in (self.rollups_path, self.traces_path):
+            try:
+                total += os.path.getsize(path)
+            except OSError:
+                pass
+        return total
+
+    def _rewrite_dedup(self, path: str, key_fn) -> None:
+        """Rewrite a hub journal with last-wins dedup — the same
+        collapse every reader performs, made durable.  Atomic
+        (temp + os.replace): a kill -9 leaves either the old file or
+        the new one, both complete."""
+        if not osp.isfile(path):
+            return
+        records: Dict[str, Dict] = {}
+        order: List[str] = []
+        for rec in iter_jsonl_records(path):
+            key = key_fn(rec)
+            if key is None:
+                continue
+            if key not in records:
+                order.append(key)
+            records[key] = rec
+        fd, tmp = tempfile.mkstemp(dir=osp.dirname(path),
+                                   suffix='.tmp')
+        try:
+            with os.fdopen(fd, 'w', encoding='utf-8') as f:
+                for key in order:
+                    f.write(json.dumps(records[key],
+                                       separators=(',', ':'),
+                                       default=str) + '\n')
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+
+# -- journal keys / module-level readers ------------------------------------
+
+def _rollup_key(rec: Dict) -> Optional[str]:
+    if rec.get('t') == 'rollup':
+        return 'r|{}|{}|{}|{}'.format(
+            rec.get('window_s'), rec.get('start'), rec.get('series'),
+            json.dumps(rec.get('labels') or {}, sort_keys=True))
+    if rec.get('t') == 'marker':
+        return 'm|{}|{}'.format(rec.get('kind'), rec.get('source'))
+    return None
+
+
+def _trace_key(rec: Dict) -> Optional[str]:
+    if rec.get('t') == 'trace':
+        return str(rec.get('trace'))
+    return None
+
+
+def read_rollups(hub_directory: str) -> List[Dict]:
+    """Deduplicated rollup + marker records (last occurrence wins —
+    a re-emitted window supersedes its earlier, partial emission)."""
+    out: Dict[str, Dict] = {}
+    for rec in read_journal(osp.join(hub_directory, ROLLUPS_FILE),
+                            keep=lambda r: r.get('v') == HUB_VERSION):
+        key = _rollup_key(rec)
+        if key is not None:
+            out[key] = rec
+    return list(out.values())
+
+
+def read_traces(hub_directory: str) -> List[Dict]:
+    """Deduplicated kept traces (replayed appends collapse by id)."""
+    out: Dict[str, Dict] = {}
+    for rec in read_journal(osp.join(hub_directory, TRACES_FILE),
+                            keep=lambda r: r.get('v') == HUB_VERSION
+                            and r.get('t') == 'trace'):
+        out[str(rec.get('trace'))] = rec
+    return list(out.values())
+
+
+def query_rollups(rollups: List[Dict], series: str, since: float,
+                  until: float, labels: Optional[Dict] = None,
+                  q: float = 0.99) -> Dict:
+    """Answer a time-range + label-filter + percentile query from
+    rollup records alone.  Windows of the finest available granularity
+    that intersect the range are merged; coarser windows only serve
+    ranges whose fine windows were never written (pre-hub history)."""
+    labels = {k: str(v) for k, v in (labels or {}).items()}
+
+    def matches(rec):
+        if rec.get('t') != 'rollup' or rec.get('series') != series:
+            return False
+        start, w = rec.get('start') or 0, rec.get('window_s') or 0
+        if start + w <= since or start >= until:
+            return False
+        rl = rec.get('labels') or {}
+        return all(rl.get(k) == v for k, v in labels.items())
+
+    candidates = [r for r in rollups if matches(r)]
+    chosen: List[Dict] = []
+    for w in sorted({r['window_s'] for r in candidates}):
+        chosen = [r for r in candidates if r['window_s'] == w]
+        break
+    count = sum(r.get('count') or 0 for r in chosen)
+    errors = sum(r.get('errors') or 0 for r in chosen)
+    kept = sum(r.get('kept') or 0 for r in chosen)
+    merged_counts: Optional[List[int]] = None
+    buckets: List[float] = []
+    total_sum = 0.0
+    exemplars: Dict[str, str] = {}
+    tail: List[float] = []
+    for rec in chosen:
+        if 'counts' not in rec:
+            continue
+        if merged_counts is None:
+            buckets = list(rec['buckets'])
+            merged_counts = [0] * len(rec['counts'])
+        if rec['buckets'] == buckets:
+            merged_counts = [a + b for a, b in zip(merged_counts,
+                                                   rec['counts'])]
+        total_sum += rec.get('sum') or 0.0
+        exemplars.update(rec.get('exemplars') or {})
+        tail.extend(rec.get('top') or [])
+    value = None
+    exact = False
+    exemplar = None
+    hist_total = sum(merged_counts) if merged_counts else 0
+    if hist_total and tail:
+        # nearest-rank from the merged tail reservoirs.  A window whose
+        # count exceeds its reservoir hides only values BELOW its
+        # reservoir floor, so a merged-tail candidate is exact whenever
+        # it clears every saturated window's floor — across W windows
+        # that answers p99 exactly up to ~W*TAIL_K/0.01 completions,
+        # not just TAIL_K ranks.
+        import math
+        rank_top = hist_total - max(math.ceil(q * hist_total), 1) + 1
+        sat_floor = max(
+            (rec['top'][-1] for rec in chosen
+             if rec.get('top')
+             and sum(rec.get('counts') or []) > len(rec['top'])),
+            default=None)
+        if 1 <= rank_top <= len(tail):
+            tail.sort(reverse=True)
+            cand = tail[rank_top - 1]
+            if sat_floor is None or cand >= sat_floor:
+                value = cand
+                exact = True
+    if merged_counts is not None and value is None:
+        value = percentile_from_histogram(buckets, merged_counts, q)
+    if value is not None and buckets:
+        i = _bucket_index(buckets, value)
+        le = str(buckets[i]) if i < len(buckets) else '+Inf'
+        exemplar = exemplars.get(le)
+        if exemplar is None and exemplars:
+            # nearest kept trace above the percentile bucket
+            for j in range(i, len(buckets)):
+                exemplar = exemplars.get(str(buckets[j]))
+                if exemplar:
+                    break
+            exemplar = exemplar or exemplars.get('+Inf')
+    newest_end = max((r['start'] + r['window_s'] for r in chosen),
+                     default=None)
+    stale = newest_end is None or \
+        newest_end < until - (chosen[0]['window_s'] if chosen else 0) \
+        - STALE_AFTER_S
+    out = {'series': series, 'source': 'rollups', 'count': count,
+           'errors': errors, 'kept': kept, 'p': q,
+           'value_s': round(value, 6) if value is not None else None,
+           'mean_s': round(total_sum / count, 6) if count else None,
+           'windows': len(chosen), 'stale': bool(stale),
+           'exact': exact}
+    if exemplar:
+        out['exemplar'] = exemplar
+    return out
+
+
+# -- cross-run regression attribution ---------------------------------------
+
+# request-phase span names → attribution phase buckets
+PHASE_MAP = {
+    'admission': 'queue_wait', 'lease_wait': 'queue_wait',
+    'model_build': 'compile', 'compile': 'compile',
+    'prefill': 'prefill',
+    'model_forward': 'decode', 'decode': 'decode',
+    'eval': 'eval',
+}
+PHASES = ('queue_wait', 'compile', 'prefill', 'decode', 'eval',
+          'other')
+
+
+def _run_profile(path: str) -> Dict:
+    """Everything :func:`diff_runs` joins for one run work_dir: ledger-
+    shaped per-task perf rows, the compile audit per shape key, and
+    request-phase sums (when the run has a requests stream)."""
+    from opencompass_tpu.ledger.ledger import collect_run_records
+    path = osp.abspath(path)
+    profile: Dict = {'path': path, 'tasks': {}, 'shapes': {},
+                     'phases': dict.fromkeys(PHASES, 0.0)}
+    try:
+        rows = collect_run_records(path)
+    except Exception:
+        rows = []
+    for row in rows:
+        key = f"{row.get('model')}/{row.get('dataset')}"
+        task = profile['tasks'].setdefault(
+            key, {'wall': 0.0, 'phases': dict.fromkeys(PHASES, 0.0)})
+        wall = float(row.get('wall_seconds') or 0.0)
+        task['wall'] += wall
+        compile_s = float(row.get('compile_seconds') or 0.0)
+        task['phases']['compile'] += compile_s
+        if row.get('kind') == 'eval' or (row.get('kind') is None
+                                         and wall and not compile_s
+                                         and row.get('tokens_per_sec')
+                                         is None):
+            task['phases']['eval'] += wall
+        else:
+            task['phases']['other'] += max(wall - compile_s, 0.0)
+    obs_dirs = [osp.join(path, 'obs'), path,
+                osp.join(path, 'serve', 'obs')]
+    for obs_dir in obs_dirs:
+        for rec in iter_jsonl_records(
+                osp.join(obs_dir, 'compiles.jsonl'),
+                keep=lambda r: r.get('t') == 'compile'):
+            shape = rec.get('shape_key') or '?'
+            slot = profile['shapes'].setdefault(
+                shape, {'seconds': 0.0, 'count': 0})
+            slot['seconds'] += float(rec.get('compile_seconds') or 0.0)
+            slot['count'] += 1
+        for seg in ('.1', ''):
+            for rec in iter_jsonl_records(
+                    osp.join(obs_dir, 'requests.jsonl' + seg),
+                    keep=lambda r: 'wall_s' in r):
+                for span in rec.get('phases') or []:
+                    bucket = PHASE_MAP.get(span.get('name'), 'other')
+                    profile['phases'][bucket] += \
+                        float(span.get('dur_s') or 0.0)
+    for slot in profile['shapes'].values():
+        slot['seconds'] = round(slot['seconds'], 6)
+    profile['phases'] = {k: round(v, 6)
+                         for k, v in profile['phases'].items()}
+    return profile
+
+
+def diff_runs(path_a: str, path_b: str) -> Dict:
+    """The ranked "what got slower and why" report between two runs.
+
+    Per-task wall deltas are attributed to the dominant phase delta;
+    compile regressions are further pinned to the shape key whose
+    audit records moved the most.  Positive delta = B slower than A.
+    """
+    a, b = _run_profile(path_a), _run_profile(path_b)
+    tasks = []
+    for key in sorted(set(a['tasks']) | set(b['tasks'])):
+        ta = a['tasks'].get(key, {'wall': 0.0,
+                                  'phases': dict.fromkeys(PHASES, 0.0)})
+        tb = b['tasks'].get(key, {'wall': 0.0,
+                                  'phases': dict.fromkeys(PHASES, 0.0)})
+        delta = tb['wall'] - ta['wall']
+        phase_deltas = {p: round(tb['phases'].get(p, 0.0)
+                                 - ta['phases'].get(p, 0.0), 6)
+                        for p in PHASES}
+        dominant = max(phase_deltas, key=lambda p: phase_deltas[p]) \
+            if any(v > 0 for v in phase_deltas.values()) else None
+        tasks.append({
+            'key': key, 'wall_a': round(ta['wall'], 6),
+            'wall_b': round(tb['wall'], 6),
+            'delta_s': round(delta, 6),
+            'rel': round(delta / ta['wall'], 4) if ta['wall'] else None,
+            'phase': dominant, 'phase_deltas': phase_deltas,
+        })
+    tasks.sort(key=lambda r: -abs(r['delta_s']))
+    shapes = []
+    for key in sorted(set(a['shapes']) | set(b['shapes'])):
+        sa = a['shapes'].get(key, {'seconds': 0.0, 'count': 0})
+        sb = b['shapes'].get(key, {'seconds': 0.0, 'count': 0})
+        shapes.append({
+            'shape_key': key,
+            'seconds_a': sa['seconds'], 'seconds_b': sb['seconds'],
+            'delta_s': round(sb['seconds'] - sa['seconds'], 6),
+            'count_a': sa['count'], 'count_b': sb['count'],
+        })
+    shapes.sort(key=lambda r: -abs(r['delta_s']))
+    # pin compile-dominant task regressions to their worst shape
+    worst_shape = shapes[0]['shape_key'] if shapes and \
+        shapes[0]['delta_s'] > 0 else None
+    for row in tasks:
+        if row['phase'] == 'compile' and worst_shape:
+            row['shape_key'] = worst_shape
+    phase_deltas = {p: round(b['phases'].get(p, 0.0)
+                             - a['phases'].get(p, 0.0), 6)
+                    for p in PHASES}
+    return {'run_a': a['path'], 'run_b': b['path'], 'tasks': tasks,
+            'shapes': shapes, 'phase_deltas': phase_deltas}
+
+
+def attribute_ledger_delta(base_row: Dict, cur_row: Dict) -> Dict:
+    """Phase + shape attribution for one regressed ledger row pair —
+    what ``ledger check --max-regression`` prints next to the gate.
+    Works from the rows alone (compile_seconds/device_seconds) plus
+    the runs' compile audits when their work_dirs are still on disk."""
+    wall_delta = float(cur_row.get('wall_seconds') or 0.0) \
+        - float(base_row.get('wall_seconds') or 0.0)
+    compile_delta = float(cur_row.get('compile_seconds') or 0.0) \
+        - float(base_row.get('compile_seconds') or 0.0)
+    device_delta = float(cur_row.get('device_seconds') or 0.0) \
+        - float(base_row.get('device_seconds') or 0.0)
+    if wall_delta > 0 and compile_delta >= 0.5 * wall_delta:
+        phase = 'compile'
+    elif wall_delta > 0 and device_delta >= 0.5 * wall_delta:
+        phase = 'decode'
+    else:
+        phase = 'other'
+    out = {'phase': phase, 'wall_delta_s': round(wall_delta, 6),
+           'compile_delta_s': round(compile_delta, 6)}
+    if phase == 'compile':
+        shapes: Dict[str, float] = {}
+        for row, sign in ((base_row, -1.0), (cur_row, 1.0)):
+            work_dir = row.get('work_dir')
+            if not work_dir:
+                continue
+            for rec in iter_jsonl_records(
+                    osp.join(work_dir, 'obs', 'compiles.jsonl'),
+                    keep=lambda r: r.get('t') == 'compile'):
+                key = rec.get('shape_key') or '?'
+                shapes[key] = shapes.get(key, 0.0) + sign * float(
+                    rec.get('compile_seconds') or 0.0)
+        if shapes:
+            worst = max(shapes, key=lambda k: shapes[k])
+            if shapes[worst] > 0:
+                out['shape_key'] = worst
+                out['shape_delta_s'] = round(shapes[worst], 6)
+    return out
+
+
+# -- CLI --------------------------------------------------------------------
+
+def _resolve_root_obs(path: str) -> Optional[str]:
+    """The obs dir whose ``hub/`` owns ``path`` — serve obs dir for a
+    cache root, run obs dir for a work_dir, the dir itself otherwise."""
+    serve_dir = osp.join(path, 'serve', 'obs')
+    if osp.isdir(serve_dir):
+        return serve_dir
+    try:
+        from opencompass_tpu.obs.live import resolve_obs_dir
+        resolved = resolve_obs_dir(path)
+    except Exception:
+        resolved = None
+    if resolved:
+        return resolved
+    if osp.isdir(path):
+        return path
+    return None
+
+
+def _render_diff(report: Dict) -> str:
+    from opencompass_tpu.obs.report import _table
+    lines = [f"run A: {report['run_a']}", f"run B: {report['run_b']}",
+             '']
+    rows = [['task', 'wall A', 'wall B', 'Δs', 'phase', 'shape']]
+    for row in report['tasks'][:20]:
+        rows.append([row['key'], row['wall_a'], row['wall_b'],
+                     f"{row['delta_s']:+.3f}", row['phase'] or '-',
+                     row.get('shape_key') or '-'])
+    lines.append(_table(rows))
+    slow_shapes = [s for s in report['shapes'] if s['delta_s'] > 0]
+    if slow_shapes:
+        lines.append('')
+        rows = [['shape', 'compile A (s)', 'compile B (s)', 'Δs']]
+        for s in slow_shapes[:10]:
+            rows.append([s['shape_key'], s['seconds_a'],
+                         s['seconds_b'], f"{s['delta_s']:+.3f}"])
+        lines.append(_table(rows))
+    return '\n'.join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m opencompass_tpu.cli obs {ingest|query|compact|diff}``."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog='obs', description='Fleet observability hub: aggregate '
+        'obs streams, query rollups, compact raw telemetry, diff runs')
+    sub = parser.add_subparsers(dest='command', required=True)
+
+    p_ing = sub.add_parser('ingest', help='one incremental ingest pass')
+    p_ing.add_argument('path')
+    p_ing.add_argument('--json', action='store_true')
+
+    p_q = sub.add_parser('query', help='time-range + label + '
+                         'percentile query answered from rollups')
+    p_q.add_argument('path')
+    p_q.add_argument('--series', default='completion_latency')
+    p_q.add_argument('--model', default=None)
+    p_q.add_argument('--window', type=float, default=3600.0,
+                     metavar='S', help='look back this many seconds '
+                     '(default 3600)')
+    p_q.add_argument('--q', type=float, default=0.99,
+                     help='percentile in (0,1] (default 0.99)')
+    p_q.add_argument('--raw', action='store_true',
+                     help='answer from the raw request streams '
+                     'instead of rollups')
+    p_q.add_argument('--now', type=float, default=None, metavar='TS',
+                     help='override the wall clock the window is '
+                     'anchored to (deterministic queries in tests)')
+    p_q.add_argument('--json', action='store_true')
+
+    p_c = sub.add_parser('compact', help='finalize rollups, enforce '
+                         'the raw-stream retention budget, dedup hub '
+                         'journals')
+    p_c.add_argument('path')
+    p_c.add_argument('--budget-bytes', type=int, default=None)
+    p_c.add_argument('--json', action='store_true')
+
+    p_d = sub.add_parser('diff', help='cross-run regression '
+                         'attribution: what got slower and why')
+    p_d.add_argument('run_a')
+    p_d.add_argument('run_b')
+    p_d.add_argument('--json', action='store_true')
+    args = parser.parse_args(argv)
+
+    if args.command == 'diff':
+        report = diff_runs(args.run_a, args.run_b)
+        print(json.dumps(report, indent=2) if args.json
+              else _render_diff(report))
+        return 0
+
+    base = _resolve_root_obs(args.path)
+    if base is None:
+        print(f'no obs dir under {args.path}')
+        return 1
+    hub = ObsHub(base,
+                 budget_bytes=getattr(args, 'budget_bytes', None))
+    if args.command == 'ingest':
+        stats = hub.ingest()
+        print(json.dumps(stats, indent=2) if args.json else
+              f"ingested {stats['ingested']} record(s) from "
+              f"{stats['sources']} source(s), kept {stats['kept']} "
+              f"trace(s), emitted {stats['windows_emitted']} "
+              'window(s)')
+        return 0
+    if args.command == 'compact':
+        stats = hub.compact()
+        print(json.dumps(stats, indent=2) if args.json else
+              f"raw {stats['raw_bytes_before']} -> "
+              f"{stats['raw_bytes_after']} bytes "
+              f"(freed {stats['freed_bytes']}, budget "
+              f"{stats['budget_bytes']}); hub "
+              f"{stats['hub_bytes_before']} -> "
+              f"{stats['hub_bytes_after']} bytes")
+        return 0
+    # query: ingest first so the answer covers the newest raw records
+    now = args.now
+    now = time.time() if now is None else now
+    hub.ingest(now=now, force_flush=True)
+    labels = {'model': args.model} if args.model else None
+    result = hub.query(series=args.series, since=now - args.window,
+                       labels=labels, q=args.q, raw=args.raw, now=now)
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        val = result.get('value_s')
+        print(f"{args.series} p{int(args.q * 100)} = "
+              f"{val if val is not None else '-'} s over "
+              f"{result['count']} completion(s) "
+              f"({result['errors']} error(s), source "
+              f"{result['source']}"
+              + (', STALE' if result.get('stale') else '') + ')'
+              + (f" exemplar {result['exemplar']}"
+                 if result.get('exemplar') else ''))
+    return 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

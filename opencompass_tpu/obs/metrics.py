@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 # Latency buckets in seconds: sub-10ms host work through multi-minute
@@ -71,18 +72,23 @@ class Counter:
 
 
 class Gauge:
-    """Last-set value, plus the high-water mark (device memory wants max)."""
+    """Last-set value, plus the high-water mark (device memory wants
+    max) and the last-set timestamp — exporters use the stamp to age a
+    series out instead of scraping a dead writer's final value forever
+    (the promexport staleness contract)."""
 
-    __slots__ = ('_lock', 'value', 'max_value')
+    __slots__ = ('_lock', 'value', 'max_value', 'last_set_ts')
 
     def __init__(self):
         self._lock = threading.Lock()
         self.value = None
         self.max_value = None
+        self.last_set_ts = None
 
-    def set(self, value):
+    def set(self, value, now: Optional[float] = None):
         with self._lock:
             self.value = value
+            self.last_set_ts = time.time() if now is None else now
             if self.max_value is None or value > self.max_value:
                 self.max_value = value
 
@@ -163,7 +169,8 @@ class MetricsRegistry:
             return {
                 'counters': {k: c.value
                              for k, c in self._counters.items()},
-                'gauges': {k: {'value': g.value, 'max': g.max_value}
+                'gauges': {k: {'value': g.value, 'max': g.max_value,
+                               'ts': g.last_set_ts}
                            for k, g in self._gauges.items()},
                 'histograms': {k: h.snapshot()
                                for k, h in self._histograms.items()},

@@ -49,6 +49,12 @@ logger = get_logger()
 
 PROM_CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
 HTTP_INFO_FILE = 'http.json'
+# a gauge not re-set for this long stops being exported: the series
+# goes Prometheus-stale at the scraper instead of lying at its last
+# value forever (dead-worker oct_hbm_*, a resolved-then-dead
+# evaluator's oct_alert_active).  High-water ``_max`` companions are
+# historical by definition and stay.
+GAUGE_STALE_AFTER_S = 300.0
 
 
 def sanitize_metric_name(name: str) -> str:
@@ -100,14 +106,24 @@ def _family_items(table: Dict, prefix: str, suffix: str = ''):
 
 def render_prometheus(metrics_snapshot: Optional[Dict] = None,
                       status: Optional[Dict] = None,
-                      prefix: str = 'oct') -> str:
+                      prefix: str = 'oct',
+                      now: Optional[float] = None,
+                      stale_after_s: float = GAUGE_STALE_AFTER_S) -> str:
     """Prometheus text format from a registry snapshot
     (``{counters, gauges, histograms}``) + run-status task gauges.
     Registry names carrying encoded labels (``metrics.labeled`` —
     ``http.requests#code=200#route=/healthz``) render as one family
-    with a label set per series."""
+    with a label set per series.
+
+    Gauges carry their last-set timestamp (``Gauge.set`` stamps it);
+    one not refreshed within ``stale_after_s`` is withheld so the
+    series goes stale at the scraper instead of exporting a dead
+    writer's final value forever.  Counters and histograms are
+    monotonic — their last value is still true — and are never aged.
+    """
     out: List[str] = []
     snap = metrics_snapshot or {}
+    now = time.time() if now is None else now
 
     last = None
     for metric, labels, value in _family_items(
@@ -118,18 +134,27 @@ def render_prometheus(metrics_snapshot: Optional[Dict] = None,
         out.append(_line(metric, value, labels))
 
     last = last_max = None
+    stale_gauges = 0
     for metric, labels, g in _family_items(
             snap.get('gauges') or {}, prefix):
-        if g.get('value') is not None:
+        ts = g.get('ts')
+        fresh = ts is None or (now - ts) <= stale_after_s
+        if g.get('value') is not None and fresh:
             if metric != last:
                 out.append(f'# TYPE {metric} gauge')
                 last = metric
             out.append(_line(metric, g['value'], labels))
+        elif g.get('value') is not None:
+            stale_gauges += 1
         if g.get('max') is not None:
             if metric != last_max:
                 out.append(f'# TYPE {metric}_max gauge')
                 last_max = metric
             out.append(_line(f'{metric}_max', g['max'], labels))
+    # the staleness marker: how many series were withheld — zero on a
+    # healthy exporter, so any positive value is itself a signal
+    out.append(f'# TYPE {prefix}_stale_series gauge')
+    out.append(_line(f'{prefix}_stale_series', stale_gauges))
 
     last = None
     for metric, labels, h in _family_items(
@@ -151,13 +176,28 @@ def render_prometheus(metrics_snapshot: Optional[Dict] = None,
         out.append(_line(f'{metric}_count', h.get('count', 0), labels))
 
     if status:
-        out.extend(_render_status_gauges(status, prefix))
+        out.extend(_render_status_gauges(status, prefix,
+                                         stale_after_s=stale_after_s))
     return '\n'.join(out) + '\n'
 
 
-def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
+def _render_status_gauges(status: Dict, prefix: str,
+                          stale_after_s: float = GAUGE_STALE_AFTER_S
+                          ) -> List[str]:
     out: List[str] = []
     o = status.get('overall') or {}
+    tasks = status.get('tasks') or {}
+    # a task whose heartbeat went quiet is a dead (or wedged) writer:
+    # its sampled gauges (hbm, kv pool, tok/s...) describe a process
+    # that no longer exists, so they are withheld — only the heartbeat
+    # age itself keeps exporting, because the age IS the signal
+    ages = [t.get('heartbeat_age_seconds') for t in tasks.values()
+            if t.get('heartbeat_age_seconds') is not None]
+    all_beats_stale = bool(ages) and min(ages) > stale_after_s
+
+    def _task_fresh(name: str) -> bool:
+        age = tasks[name].get('heartbeat_age_seconds')
+        return age is None or age <= stale_after_s
     if o.get('progress') is not None:
         out.append(f'# TYPE {prefix}_run_progress gauge')
         out.append(_line(f'{prefix}_run_progress', o['progress']))
@@ -179,9 +219,12 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
             out.append(f'# TYPE {prefix}_{key} gauge')
             out.append(_line(f'{prefix}_{key}', o[key]))
     # sampled device-HBM occupancy gauges (oct_hbm_*): used/high-water
-    # fraction of device memory (obs/devprof.py heartbeat fold)
+    # fraction of device memory (obs/devprof.py heartbeat fold).  The
+    # fold is over task heartbeats — when every heartbeat is stale the
+    # number describes dead processes, so the series is withheld and
+    # goes stale at the scraper instead of lying
     for key in ('hbm_used_frac', 'hbm_high_water_frac'):
-        if o.get(key) is not None:
+        if o.get(key) is not None and not all_beats_stale:
             out.append(f'# TYPE {prefix}_{key} gauge')
             out.append(_line(f'{prefix}_{key}', o[key]))
     for state in ('ok', 'failed', 'running', 'pending'):
@@ -222,7 +265,6 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
             out.append(f'# TYPE {prefix}_{metric_suffix} gauge')
             out.extend(lines)
 
-    tasks = status.get('tasks') or {}
     per_task = [
         ('task_progress', 'progress'),
         ('task_examples_done', 'done'),
@@ -243,6 +285,9 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
     for metric_suffix, field in per_task:
         lines = []
         for name in sorted(tasks):
+            if field != 'heartbeat_age_seconds' \
+                    and not _task_fresh(name):
+                continue
             value = tasks[name].get(field)
             if value is not None:
                 lines.append(_line(f'{prefix}_{metric_suffix}', value,
@@ -251,6 +296,87 @@ def _render_status_gauges(status: Dict, prefix: str) -> List[str]:
             out.append(f'# TYPE {prefix}_{metric_suffix} gauge')
             out.extend(lines)
     return out
+
+
+def render_rollup_exposition(hub_directory: str, prefix: str = 'oct',
+                             now: Optional[float] = None) -> str:
+    """The observability hub's rollups as scrape-able series:
+    ``oct_hub_<series>`` histograms from each series' newest finished
+    finest window, with OpenMetrics-style exemplars — every latency
+    bucket that holds a kept trace links its trace id, so a dashboard
+    percentile click lands on a real request.  Never raises; an empty
+    or missing hub renders as the empty string."""
+    try:
+        from opencompass_tpu.obs.hub import read_rollups
+        rollups = read_rollups(hub_directory)
+    except Exception:
+        return ''
+    if not rollups:
+        return ''
+    now = time.time() if now is None else now
+    # newest window per (series, labels) at the finest granularity
+    newest: Dict[str, Dict] = {}
+    for rec in rollups:
+        if rec.get('t') != 'rollup':
+            continue
+        key = '{}|{}'.format(
+            rec.get('series'),
+            json.dumps(rec.get('labels') or {}, sort_keys=True))
+        cur = newest.get(key)
+        if cur is None or rec['window_s'] < cur['window_s'] \
+                or (rec['window_s'] == cur['window_s']
+                    and rec['start'] > cur['start']):
+            newest[key] = rec
+    out: List[str] = []
+    last = None
+    for key in sorted(newest):
+        rec = newest[key]
+        # a window whose end is long past is a silent series — withhold
+        # it (the staleness contract) rather than re-export forever
+        end = (rec.get('start') or 0) + (rec.get('window_s') or 0)
+        if now - end > GAUGE_STALE_AFTER_S + (rec.get('window_s') or 0):
+            continue
+        series = sanitize_metric_name(str(rec.get('series')))
+        metric = f'{prefix}_hub_{series}'
+        labels = dict(rec.get('labels') or {})
+        labels['window_s'] = str(rec.get('window_s'))
+        if 'counts' in rec:
+            if metric != last:
+                out.append(f'# TYPE {metric} histogram')
+                last = metric
+            exemplars = rec.get('exemplars') or {}
+            cum = 0
+            for ub, c in zip(rec.get('buckets') or [],
+                             rec.get('counts') or []):
+                cum += c
+                line = _line(f'{metric}_bucket', cum,
+                             dict(labels, le=_fmt_number(float(ub))))
+                trace = exemplars.get(str(ub))
+                if trace:
+                    line += (' # {trace_id="'
+                             + escape_label_value(trace) + '"} '
+                             + _fmt_number(float(ub)))
+                out.append(line)
+            out.append(_line(f'{metric}_bucket',
+                             rec.get('count', cum),
+                             dict(labels, le='+Inf')))
+            out.append(_line(f'{metric}_sum', rec.get('sum', 0),
+                             labels))
+            out.append(_line(f'{metric}_count', rec.get('count', 0),
+                             labels))
+        elif rec.get('last') is not None:
+            name = sanitize_metric_name(str(labels.pop('name', '')
+                                            or series))
+            gauge_metric = f'{prefix}_hub_{name}'
+            out.append(f'# TYPE {gauge_metric} gauge')
+            out.append(_line(gauge_metric, rec['last'], labels))
+        else:
+            if metric != last:
+                out.append(f'# TYPE {metric}_total counter')
+                last = metric
+            out.append(_line(f'{metric}_total', rec.get('count', 0),
+                             labels))
+    return '\n'.join(out) + ('\n' if out else '')
 
 
 class ObsHTTPServer:
@@ -288,7 +414,7 @@ class ObsHTTPServer:
 
     def __init__(self, obs_dir: str, port: int = 0, registry=None,
                  routes: Optional[Dict] = None, readiness=None,
-                 status_fn=None, access_log=None):
+                 status_fn=None, access_log=None, metrics_extra=None):
         self.obs_dir = obs_dir
         self.requested_port = port
         self.registry = registry
@@ -296,6 +422,11 @@ class ObsHTTPServer:
         self.readiness = readiness
         self.status_fn = status_fn
         self.access_log = access_log
+        # optional zero-arg provider of extra exposition text appended
+        # to every /metrics body (the serve daemon wires the hub's
+        # rollup histograms + exemplars here); a failure renders
+        # nothing, never a broken scrape
+        self.metrics_extra = metrics_extra
         self.port: Optional[int] = None
         self._httpd = None
         self._thread: Optional[threading.Thread] = None
@@ -449,11 +580,17 @@ class ObsHTTPServer:
                         elif path == '/metrics':
                             snap = server.registry.snapshot() \
                                 if server.registry is not None else {}
-                            body = render_prometheus(
+                            text = render_prometheus(
                                 snap,
                                 status=server._current_status(),
-                            ).encode('utf-8')
-                            self._send(200, PROM_CONTENT_TYPE, body)
+                            )
+                            if server.metrics_extra is not None:
+                                try:
+                                    text += server.metrics_extra() or ''
+                                except Exception:
+                                    pass
+                            self._send(200, PROM_CONTENT_TYPE,
+                                       text.encode('utf-8'))
                         else:
                             self._send_payload(404, 'not found\n')
                     except Exception as exc:

@@ -82,6 +82,10 @@ ENGINE_INFO_FILE = 'engine.json'
 # the fresh live file without coordination.
 
 REQTRACE_MAX_BYTES_ENV = 'OCT_REQTRACE_MAX_BYTES'
+# chaos/test-only deadline clock skew, file-based like the serving
+# stall knob so a live daemon's skew is toggled per-case at runtime
+# (see Deadline.__init__)
+ENV_DEADLINE_SKEW_FILE = 'OCT_DEBUG_DEADLINE_SKEW_FILE'
 DEFAULT_REQTRACE_MAX_BYTES = 256 * 1024 * 1024
 _ROTATE_LOCK = threading.Lock()
 
@@ -158,6 +162,20 @@ class Deadline:
     def __init__(self, budget_ms: float, now: Optional[float] = None):
         self.budget_ms = float(budget_ms)
         anchor = time.monotonic() if now is None else float(now)
+        # test-only clock skew: the file named by
+        # OCT_DEBUG_DEADLINE_SKEW_FILE shifts the anchor backwards, so
+        # a tiny budget is *deterministically* expired by the time the
+        # first phase checks it — the chaos harness pins the
+        # already-dead-at-arrival case to the 'parse' phase without
+        # racing a fast box through dispatch before the stall (never
+        # set outside the chaos/test harness)
+        skew_file = os.environ.get(ENV_DEADLINE_SKEW_FILE)
+        if skew_file:
+            try:
+                with open(skew_file, encoding='utf-8') as f:
+                    anchor -= float(f.read().strip() or 0.0)
+            except (OSError, ValueError):
+                pass
         self.deadline_ts = anchor + self.budget_ms / 1e3
 
     def remaining_s(self, now: Optional[float] = None) -> float:

@@ -54,8 +54,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
-from opencompass_tpu.utils.fileio import (append_jsonl_atomic,
-                                          iter_jsonl_records)
+from opencompass_tpu.utils.fileio import iter_jsonl_records
 
 SLO_VERSION = 1
 ALERTS_FILE = 'alerts.jsonl'
@@ -246,34 +245,22 @@ class AlertLog:
         self.path = path
 
     def _reseal(self):
-        """Cap an unterminated tail (daemon killed mid-append) with a
-        newline so this append starts a fresh line instead of being
-        absorbed into the torn one — the queue journal's discipline.
-        Transitions are rare and each one matters; requests.jsonl
-        skips this (losing one post-crash record there is within its
-        documented contract)."""
-        import os
-        try:
-            with open(self.path, 'rb') as f:
-                f.seek(-1, os.SEEK_END)
-                torn = f.read(1) != b'\n'
-            if torn:
-                # oct-lint: disable=OCT001(tail seal: writes exactly one newline to cap a dead writer's torn line, the recovery contract itself)
-                with open(self.path, 'ab') as f:
-                    f.write(b'\n')
-        except (OSError, ValueError):
-            pass   # missing or empty file: nothing to seal
+        """Cap an unterminated tail (daemon killed mid-append) —
+        shared journal discipline (``utils.journal``).  Transitions
+        are rare and each one matters; requests.jsonl skips this
+        (losing one post-crash record there is within its documented
+        contract)."""
+        from opencompass_tpu.utils.journal import seal_torn_tail
+        seal_torn_tail(self.path)
 
     def write(self, transitions: Sequence[Dict]):
         if not transitions:
             return
         try:
             from opencompass_tpu.obs.reqtrace import rotate_if_oversize
+            from opencompass_tpu.utils.journal import journal_append
             rotate_if_oversize(self.path)
-            self._reseal()
-            append_jsonl_atomic(
-                self.path,
-                [{'v': SLO_VERSION, **t} for t in transitions])
+            journal_append(self.path, transitions, version=SLO_VERSION)
         except Exception:
             pass
 
@@ -477,7 +464,10 @@ class SLOEvaluator:
         ``oct_slo_budget_remaining{slo}`` into the registry.  Cardinality
         is bounded by the rule set, so resolved rules keep their series
         at 0 instead of disappearing (a vanishing series reads as
-        'scrape broke', not 'alert cleared')."""
+        'scrape broke', not 'alert cleared').  Every round re-stamps
+        the gauges' last-set timestamps, so when this evaluator dies
+        the exporter withholds them (promexport staleness) rather than
+        scraping the final pre-death verdict forever."""
         if self.registry is None:
             return
         try:

@@ -476,6 +476,16 @@ def _handle_run(msg: Dict) -> Dict:
         os._exit(13)
 
     heartbeat = obs.init_task_heartbeat(name)
+    # self-register as an observability-hub source: the note's
+    # host/role/obs_dir fields make this worker's streams first-class
+    # in hub discovery even when its obs dir lives in a subprocess
+    # work_dir the hub root never scans (obs/hub.py discover_sources)
+    try:
+        import socket
+        heartbeat.note(host=socket.gethostname(), role='worker',
+                       obs_dir=getattr(tracer, 'obs_dir', None))
+    except Exception:
+        pass
     # per-batch flight recorder, re-bound per task so each task's
     # batches land in its own timeline file
     obs.init_task_timeline(name)

@@ -58,6 +58,10 @@ logger = get_logger()
 DEFAULT_IDLE_TTL_S = 600.0
 DEFAULT_COMPLETE_TIMEOUT_S = 300.0
 DEFAULT_SLO_EVAL_INTERVAL_S = 5.0
+# hub ingest cadence, and how many ingest rounds between automatic
+# compactions (retention enforcement rides the same thread)
+DEFAULT_HUB_INTERVAL_S = 15.0
+DEFAULT_HUB_COMPACT_EVERY = 40
 # how long past a request's deadline the daemon keeps waiting for the
 # worker's own (phase-attributed) deadline_exceeded response before
 # giving up with the blunter worker_protocol attribution
@@ -135,6 +139,19 @@ class EvalEngine:
         self.slo_eval_interval_s = float(
             cfg.get('slo_eval_interval_s', DEFAULT_SLO_EVAL_INTERVAL_S))
         self._slo_thread: Optional[threading.Thread] = None
+        # fleet observability hub (obs/hub.py): tail-sampled traces +
+        # windowed rollups over every source's streams, materialized
+        # under {serve_obs_dir}/hub/ on its own thread so raw stream
+        # retention never depends on anyone running `cli obs` by hand
+        from opencompass_tpu.obs import hub as hubmod
+        self.hub = hubmod.ObsHub(self.serve_obs_dir)
+        self.hub_interval_s = float(
+            cfg.get('obs_hub_interval_s', DEFAULT_HUB_INTERVAL_S))
+        self.hub_compact_every = max(int(
+            cfg.get('obs_hub_compact_every', DEFAULT_HUB_COMPACT_EVERY)
+        ), 1)
+        self._hub_thread: Optional[threading.Thread] = None
+        self._hub_stats: Dict = {}
         # degradation plane (serve/admission.py): SLO-aware admission
         # consulted before every completion and sweep enqueue —
         # priority classes (interactive > sweep), 429 sheds with
@@ -231,13 +248,18 @@ class EvalEngine:
             use_workers=False)
         self.pool.start_reaper(interval=max(self.poll_s * 4, 5.0))
 
+        from opencompass_tpu.obs.promexport import \
+            render_rollup_exposition
         self.server = ObsHTTPServer(
             self.tracer.obs_dir, port=self.requested_port,
             registry=self.tracer.metrics,
             routes=build_routes(self),
             readiness=self.readiness,
             status_fn=self.status_snapshot,
-            access_log=self._on_http_request)
+            access_log=self._on_http_request,
+            # hub rollups + exemplars ride every /metrics scrape
+            metrics_extra=lambda:
+                render_rollup_exposition(self.hub.dir))
         self.port = self.server.start()
         if self.port is None:
             raise RuntimeError(
@@ -262,6 +284,12 @@ class EvalEngine:
         self._slo_thread = threading.Thread(
             target=self._slo_loop, name='serve-slo-loop', daemon=True)
         self._slo_thread.start()
+        # hub ingestion on its own thread for the same reason: traces
+        # complete and rollup windows close while a sweep blocks the
+        # queue loop, and retention must keep pace with the writers
+        self._hub_thread = threading.Thread(
+            target=self._hub_loop, name='serve-obs-hub', daemon=True)
+        self._hub_thread.start()
         if self.warm and self._catalog:
             threading.Thread(target=self._warm_fleet,
                              name='serve-warmup', daemon=True).start()
@@ -283,6 +311,8 @@ class EvalEngine:
             self._loop_thread.join(timeout=30)
         if self._slo_thread is not None:
             self._slo_thread.join(timeout=10)
+        if self._hub_thread is not None:
+            self._hub_thread.join(timeout=10)
         if self.pool is not None:
             self.pool.shutdown()
         if self.server is not None:
@@ -886,6 +916,32 @@ class EvalEngine:
         # final round so a drain-time breach still lands a transition
         self.evaluate_slos()
 
+    # -- observability hub -------------------------------------------------
+
+    def _hub_loop(self):
+        rounds = 0
+        while not self._stop.is_set():
+            self._hub_round(rounds)
+            rounds += 1
+            self._stop.wait(self.hub_interval_s)
+        # final round: flush open windows so a drained daemon leaves
+        # queryable rollups behind, then enforce retention once
+        self._hub_round(rounds, final=True)
+
+    def _hub_round(self, rounds: int, final: bool = False):
+        """One ingest pass; every Nth round (and at drain) a full
+        compaction.  Never raises — the hub is an observer, and an
+        observer fault must not take the engine down."""
+        try:
+            if final or (rounds and rounds % self.hub_compact_every
+                         == 0):
+                self._hub_stats = {**self.hub.ingest(),
+                                   'compact': self.hub.compact()}
+            else:
+                self._hub_stats = self.hub.ingest()
+        except Exception:
+            logger.warning('obs hub round failed', exc_info=True)
+
     def evaluate_slos(self, now: Optional[float] = None) -> List[Dict]:
         """One burn-rate evaluation round: rolling completion samples ×
         queue/efficiency gauges through the rule set.  Transitions land
@@ -1124,6 +1180,17 @@ class EvalEngine:
             'completions': self._completions,
             'ready': self._warmed.is_set(),
         }
+        # hub block: last ingest round's counters + raw-stream bytes
+        # vs the retention budget — `cli top` renders this line and
+        # doctor's obs_disk_pressure rule reads the same numbers
+        try:
+            snap['serve']['hub'] = {
+                **(self._hub_stats or {}),
+                'raw_bytes': self.hub.raw_bytes(),
+                'budget_bytes': self.hub.budget_bytes,
+            }
+        except Exception:
+            pass
         return snap
 
     def sweep_status(self, sweep_id: str) -> Optional[Dict]:

@@ -75,6 +75,7 @@ COMPLETIONS_PATH = '/v1/completions'
 MODELS_PATH = '/v1/models'
 STATS_PATH = '/v1/stats'
 ALERTS_PATH = '/v1/alerts'
+OBS_QUERY_PATH = '/v1/obs/query'
 
 
 def _err(code: int, message: str,
@@ -304,6 +305,44 @@ def build_routes(engine) -> Dict:
         # transitions from alerts.jsonl (obs/slo.py)
         return 200, engine.alerts_snapshot()
 
+    def obs_query(path, query, body):
+        # the hub's query plane: ?series=&model=&window=&q=&raw=1 —
+        # percentiles answered from durable rollups (exact for tail
+        # ranks via per-window reservoirs) so the answer survives raw
+        # stream retention; stub engines without a hub 404
+        import math
+        from urllib.parse import parse_qs
+        hub = getattr(engine, 'hub', None)
+        if hub is None:
+            return _err(404, 'observability hub not enabled')
+        params = parse_qs(query or '')
+
+        def first(name, default=None):
+            vals = params.get(name)
+            return vals[0] if vals else default
+
+        try:
+            window = float(first('window', 3600.0))
+            q = float(first('q', 0.99))
+            if not (math.isfinite(window) and math.isfinite(q)
+                    and 0.0 < q <= 1.0 and window > 0.0):
+                raise ValueError((window, q))
+        except (TypeError, ValueError):
+            return _err(400, f'bad obs query {query!r}')
+        labels = {}
+        if first('model'):
+            labels['model'] = first('model')
+        raw = first('raw') in ('1', 'true', 'yes')
+        try:
+            result = hub.query(series=first('series',
+                                            'completion_latency'),
+                               since=time.time() - window,
+                               labels=labels or None, q=q, raw=raw)
+        except Exception as exc:
+            return _err(500, f'obs query failed: {exc}',
+                        'server_error')
+        return 200, result
+
     return {
         ('POST', SWEEPS_PATH): post_sweep,
         ('GET', SWEEPS_PATH): list_sweeps,
@@ -313,4 +352,5 @@ def build_routes(engine) -> Dict:
         ('GET', MODELS_PATH): list_models,
         ('GET', STATS_PATH): stats,
         ('GET', ALERTS_PATH): alerts,
+        ('GET', OBS_QUERY_PATH): obs_query,
     }

@@ -45,7 +45,7 @@ except ImportError:       # non-POSIX: claims still O_EXCL-exclusive,
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
-from opencompass_tpu.utils.fileio import append_jsonl_atomic
+from opencompass_tpu.utils.journal import journal_append, seal_torn_tail
 from opencompass_tpu.utils.logging import get_logger
 
 logger = get_logger()
@@ -110,36 +110,15 @@ class SweepQueue:
         writer (CLI client in another process) killed mid-append leaves
         an unterminated line that would otherwise absorb this record —
         both lines lost to replay.  The seal is one open/seek/read."""
-        self._seal_torn_tail()
-        append_jsonl_atomic(self.journal_path, [rec])
+        journal_append(self.journal_path, [rec])
 
     def _seal_torn_tail(self):
         """Cap an unterminated final journal line with a newline.
 
-        The store never needs this because its segments are per-writer:
-        a dead writer's torn line sits at the EOF of a file nobody
-        appends to again.  The journal is ONE file shared by every
-        client and daemon — without the cap, the next append would be
-        absorbed into the dead writer's torn line and both records
-        would be lost to replay.  Sealing turns the tear back into the
-        store's contract: exactly one skippable garbage line."""
-        try:
-            with open(self.journal_path, 'rb') as f:
-                f.seek(0, os.SEEK_END)
-                if f.tell() == 0:
-                    return
-                f.seek(-1, os.SEEK_END)
-                torn = f.read(1) != b'\n'
-            if torn:
-                # oct-lint: disable=OCT001(tail seal: single newline capping a dead writer's torn line — the recovery contract itself)
-                fd = os.open(self.journal_path,
-                             os.O_WRONLY | os.O_APPEND)
-                try:
-                    os.write(fd, b'\n')
-                finally:
-                    os.close(fd)
-        except OSError:
-            pass   # no journal yet, or unreadable: replay copes
+        The store never needs this because its segments are per-writer;
+        the journal is ONE file shared by every client and daemon.
+        Shared discipline in ``utils.journal`` (rationale there)."""
+        seal_torn_tail(self.journal_path)
 
     # -- write side --------------------------------------------------------
 

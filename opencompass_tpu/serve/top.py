@@ -218,6 +218,29 @@ def render(snap: Dict, window_s: float = DEFAULT_WINDOW_S) -> str:
         queue_bits.append(f'current {serve["current_sweep"]}')
     lines.append('queue:  ' + '  '.join(queue_bits))
 
+    # hub pane: the observability hub's last round — what the fleet's
+    # telemetry weighs on disk vs its retention budget, and how much
+    # got sampled into durable traces/rollups
+    hub = serve.get('hub') or {}
+    if hub:
+        bits = []
+        if hub.get('raw_bytes') is not None:
+            budget = hub.get('budget_bytes') or 0
+            pct = (f' ({100.0 * hub["raw_bytes"] / budget:.0f}% of '
+                   'budget)') if budget else ''
+            bits.append(f'raw {hub["raw_bytes"] / 1e6:.1f}MB{pct}')
+        if hub.get('sources') is not None:
+            bits.append(f'sources {hub["sources"]}')
+        if hub.get('kept') is not None:
+            bits.append(f'kept {hub["kept"]} trace(s)')
+        if hub.get('windows_emitted'):
+            bits.append(f'windows {hub["windows_emitted"]}')
+        compact = hub.get('compact') or {}
+        if compact.get('freed_bytes'):
+            bits.append(f'freed {compact["freed_bytes"] / 1e6:.1f}MB')
+        if bits:
+            lines.append('hub:    ' + '  '.join(bits))
+
     # alert pane (the interpretation layer): active burn-rate alerts
     # from the live /v1/alerts, or folded from the alerts.jsonl tail
     # when the daemon is down

@@ -1,0 +1,100 @@
+"""Shared durable-journal discipline for multi-writer JSONL files.
+
+Three obs streams grew the same three-part append protocol
+independently — the SLO alert log (``alerts.jsonl``), the sweep queue
+journal (``queue/journal.jsonl``), and the compile audit
+(``compiles.jsonl``):
+
+1. **O_APPEND single-write appends** (``utils.fileio.append_jsonl_atomic``)
+   so concurrent writer processes interleave at record granularity and a
+   killed writer tears at most the final line;
+2. **torn-line tolerant reads** (``utils.fileio.iter_jsonl_records``)
+   that skip the at-most-one garbage line instead of raising;
+3. **tail RE-SEAL**: before appending to a file that OTHER processes
+   also append to, cap an unterminated final line with a newline.
+   Per-writer segments (the result store) never need this — a dead
+   writer's torn line sits at an EOF nobody touches again.  A *shared*
+   journal does: without the cap, the next append would be absorbed
+   into the dead writer's torn line and both records would be lost to
+   replay.  Sealing turns the tear back into the store's contract:
+   exactly one skippable garbage line.
+
+This module is that protocol, extracted once.  New JSONL journals (the
+observability hub's ``rollups.jsonl`` / ``traces.jsonl``) use
+:func:`journal_append` / :func:`read_journal` instead of re-deriving
+the discipline; oct-lint rule OCT008 nudges hand-rolled tail seals
+here.
+
+Lives in utils/ — not obs/ — because the queue (serve/) and the obs
+plane both depend on it and utils/ sits below both in the layering.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Iterable, Iterator, Optional
+
+from opencompass_tpu.utils.fileio import (append_jsonl_atomic,
+                                          iter_jsonl_records)
+
+
+def seal_torn_tail(path: str) -> bool:
+    """Cap an unterminated final line of ``path`` with a newline.
+
+    Returns True when a seal byte was written, False when the file is
+    missing, empty, already sealed, or unwritable (never raises —
+    journal upkeep must not fail the caller; replay copes either way).
+    The write is a single appended newline, the one case exempt from
+    the single-write O_APPEND rule because it IS the recovery contract.
+    """
+    try:
+        with open(path, 'rb') as f:
+            f.seek(0, os.SEEK_END)
+            if f.tell() == 0:
+                return False
+            f.seek(-1, os.SEEK_END)
+            torn = f.read(1) != b'\n'
+        if not torn:
+            return False
+        # oct-lint: disable=OCT001(tail seal: single newline capping a dead writer's torn line — the recovery contract itself)
+        fd = os.open(path, os.O_WRONLY | os.O_APPEND)
+        try:
+            os.write(fd, b'\n')
+        finally:
+            os.close(fd)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def journal_append(path: str, records: Iterable[Dict],
+                   version: Optional[int] = None) -> None:
+    """One sealed journal append: RE-SEAL the tail, then push all
+    ``records`` through a single O_APPEND write.  ``version`` stamps a
+    ``'v'`` field onto each record (the shared schema-version idiom).
+
+    Raises on write failure like ``append_jsonl_atomic`` — callers with
+    a never-fail telemetry contract wrap this in their own guard (the
+    alert log does); callers whose records are load-bearing (the queue
+    journal) want the exception."""
+    records = list(records)
+    if not records:
+        return
+    if version is not None:
+        records = [{'v': version, **rec} for rec in records]
+    seal_torn_tail(path)
+    append_jsonl_atomic(path, records)
+
+
+def read_journal(path: str, keep: Optional[Callable[[Dict], bool]] = None,
+                 segments: bool = True) -> Iterator[Dict]:
+    """Parseable records of a journal, rotated segment first.
+
+    Folds ``path + '.1'`` (the size-capped rotation's evicted-oldest
+    segment, ``obs.reqtrace.rotate_if_oversize``) before ``path`` so
+    callers see records oldest-first across one rotation; torn/garbage
+    lines are skipped per the recovery contract.  ``segments=False``
+    reads only the live file."""
+    candidates = (path + '.1', path) if segments else (path,)
+    for candidate in candidates:
+        for rec in iter_jsonl_records(candidate, keep=keep):
+            yield rec

@@ -1,0 +1,18 @@
+"""OCT008 clean: the shared helper owns the torn-tail discipline."""
+from opencompass_tpu.utils.journal import journal_append, seal_torn_tail
+
+
+def log_event(path, line):
+    journal_append(path, line)
+
+
+def recover(path):
+    seal_torn_tail(path)
+
+
+def read_back(path):
+    with open(path, 'rb') as f:
+        f.seek(0, 2)                    # absolute/positive seeks: fine
+        size = f.tell()
+        f.seek(max(size - 4096, 0))
+        return f.read()

@@ -1,0 +1,12 @@
+"""OCT008 firing: hand-rolled torn-tail seal via a seek(-1, ...) probe."""
+import os
+
+
+def seal_tail(path):
+    with open(path, 'rb+') as f:
+        f.seek(0, os.SEEK_END)
+        if f.tell() == 0:
+            return
+        f.seek(-1, os.SEEK_END)         # tail-byte probe: OCT008
+        if f.read(1) != b'\n':
+            f.write(b'\n')

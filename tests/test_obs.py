@@ -162,7 +162,9 @@ def test_metrics_registry_snapshot_and_flush(tmp_path):
     assert len(metrics) == 1
     attrs = metrics[0]['attrs']
     assert attrs['counters'] == {'c': 5}
-    assert attrs['gauges']['g'] == {'value': 3, 'max': 7}
+    g = attrs['gauges']['g']
+    assert (g['value'], g['max']) == (3, 7)
+    assert g['ts'] > 0                 # last-set stamp drives staleness
     assert attrs['histograms']['h']['count'] == 1
 
 

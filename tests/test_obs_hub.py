@@ -1,0 +1,408 @@
+"""Fleet observability hub: durable multi-source aggregation,
+tail-based trace sampling, metric rollups with retention, cross-run
+regression attribution — plus the satellites that ride with it (the
+shared journal helper + OCT008, promexport staleness, the doctor
+disk-pressure rule, the chaos deadline-skew knob, and the hub
+crash-fuzz contract)."""
+import json
+import os
+import os.path as osp
+
+import pytest
+
+REPO = osp.dirname(osp.dirname(osp.abspath(__file__)))
+
+
+def _write_jsonl(path, records):
+    os.makedirs(osp.dirname(path), exist_ok=True)
+    with open(path, 'a', encoding='utf-8') as f:  # oct-lint: disable=OCT001(test fixture writer, single process)
+        for rec in records:
+            f.write(json.dumps(rec) + '\n')
+
+
+def _mk_requests(n, t0, model='tiny', err_every=25, wall=None):
+    recs = []
+    for i in range(n):
+        w = wall(i) if wall else 0.05 + (i % 20) * 0.01
+        recs.append({
+            'v': 1, 'id': f'req-{model}-{i}', 'ts': round(t0 + i * 0.5, 3),
+            'route': '/v1/completions', 'model': model,
+            'status': 'error' if i % err_every == 7 else 'ok',
+            'wall_s': round(w, 5),
+            'phases': [{'name': 'prefill', 'start_s': 0.0,
+                        'dur_s': round(w * 0.4, 5)},
+                       {'name': 'decode', 'start_s': round(w * 0.4, 5),
+                        'dur_s': round(w * 0.6, 5)}],
+        })
+    return recs
+
+
+@pytest.fixture
+def obs_run(tmp_path):
+    """One synthetic source obs dir: 200 completions (8 errors), one
+    SLO burn interval covering ts [t0+30, t0+40]."""
+    from opencompass_tpu.obs import hub as hubmod
+    root = str(tmp_path / 'fleet')
+    src = osp.join(root, 'w0', 'obs')
+    t0 = 1_700_000_000.0
+    recs = _mk_requests(200, t0)
+    _write_jsonl(osp.join(src, 'requests.jsonl'), recs)
+    _write_jsonl(osp.join(src, 'alerts.jsonl'), [
+        {'t': 'fire', 'rule': 'completion_p99', 'ts': t0 + 30.0},
+        {'t': 'resolve', 'rule': 'completion_p99', 'ts': t0 + 40.0}])
+    hubmod.register_source(root, 'hostA', 'worker', src)
+    return {'root': root, 'src': src, 't0': t0, 'recs': recs,
+            'now': t0 + 200 * 0.5 + 30.0}
+
+
+# -- utils/journal.py (satellite 1) -----------------------------------------
+
+def test_journal_roundtrip_and_torn_tail(tmp_path):
+    from opencompass_tpu.utils.journal import (journal_append,
+                                               read_journal,
+                                               seal_torn_tail)
+    path = str(tmp_path / 'j.jsonl')
+    journal_append(path, [{'a': 1}, {'a': 2}], version=1)
+    assert [r['a'] for r in read_journal(path)] == [1, 2]
+    # a dead writer's torn final line is sealed, not fatal
+    with open(path, 'ab') as f:  # oct-lint: disable=OCT001(test: simulating a torn write)
+        f.write(b'{"a": 3')
+    seal_torn_tail(path)
+    journal_append(path, [{'a': 4}], version=1)
+    assert [r.get('a') for r in read_journal(path)
+            if 'a' in r] == [1, 2, 4]
+
+
+def test_journal_reads_segments_first(tmp_path):
+    from opencompass_tpu.utils.journal import journal_append, read_journal
+    path = str(tmp_path / 'j.jsonl')
+    journal_append(path + '.1', [{'a': 'old'}], version=1)
+    journal_append(path, [{'a': 'new'}], version=1)
+    assert [r['a'] for r in read_journal(path)] == ['old', 'new']
+
+
+def test_oct008_flags_tail_probe(tmp_path):
+    from opencompass_tpu.analysis.linter import run_lint
+    src = tmp_path / 'mod.py'
+    src.write_text(
+        "import os\n"
+        "def probe(f):\n"
+        "    f.seek(-1, os.SEEK_END)\n"
+        "    return f.read(1)\n")
+    report = run_lint([str(src)], baseline_path=None)
+    assert 'OCT008' in {f.rule for f in report.active}
+
+
+def test_oct008_journal_module_exempt():
+    from opencompass_tpu.analysis.linter import run_lint
+    path = osp.join(REPO, 'opencompass_tpu', 'utils', 'journal.py')
+    report = run_lint([path], baseline_path=None)
+    assert 'OCT008' not in {f.rule for f in report.active}
+
+
+# -- tail-based sampling ----------------------------------------------------
+
+def test_tail_sampling_keeps_all_errors_and_burn(obs_run):
+    from opencompass_tpu.obs import hub as hubmod
+    hub = hubmod.ObsHub(obs_run['root'], rate=0.0)
+    stats = hub.ingest(now=obs_run['now'], force_flush=True)
+    assert stats['ingested'] >= 200
+    traces = {t['trace']: t for t in hub.read_traces()}
+    # 100% of error traces survive a zero sample rate
+    error_ids = {r['id'] for r in obs_run['recs']
+                 if r['status'] == 'error'}
+    assert error_ids <= set(traces)
+    assert all(traces[i]['keep'] == 'error' for i in error_ids)
+    # completions inside the fire..resolve burn interval survive too
+    t0 = obs_run['t0']
+    burn_ids = {r['id'] for r in obs_run['recs']
+                if t0 + 30.0 <= r['ts'] <= t0 + 40.0
+                and r['status'] == 'ok'}
+    assert burn_ids and burn_ids <= set(traces)
+    assert {traces[i]['keep'] for i in burn_ids} <= {'slo_burn',
+                                                     'p99_slow'}
+    # the healthy bulk was NOT all kept, but every completion counted
+    assert len(traces) < 200
+    ans = hub.query(since=t0 - 1, until=obs_run['now'], q=0.5,
+                    now=obs_run['now'])
+    assert ans['count'] == 200 and ans['errors'] == len(error_ids)
+
+
+def test_hash_sampling_is_deterministic(tmp_path):
+    from opencompass_tpu.obs import hub as hubmod
+    hub = hubmod.ObsHub(str(tmp_path), rate=0.3)
+    picks = [hub._hash_sampled(f'trace-{i}') for i in range(500)]
+    assert picks == [hub._hash_sampled(f'trace-{i}') for i in range(500)]
+    assert 0.15 < sum(picks) / len(picks) < 0.45
+
+
+def test_degraded_and_slow_keep_reasons(tmp_path):
+    from opencompass_tpu.obs import hub as hubmod
+    src = str(tmp_path / 'obs')
+    t0 = 1_700_000_000.0
+    recs = _mk_requests(100, t0, err_every=10 ** 9)
+    recs[50]['degraded'] = True
+    recs[99]['wall_s'] = 9.5    # far past the rolling p99
+    _write_jsonl(osp.join(src, 'requests.jsonl'), recs)
+    hub = hubmod.ObsHub(src, rate=0.0)
+    hub.ingest(now=t0 + 120.0, force_flush=True)
+    traces = {t['trace']: t['keep'] for t in hub.read_traces()}
+    assert traces.get('req-tiny-50') == 'degraded'
+    assert traces.get('req-tiny-99') == 'p99_slow'
+
+
+# -- rollups: the acceptance bar --------------------------------------------
+
+def test_rollup_p99_matches_raw_after_raw_deleted(obs_run):
+    """`cli obs query` must answer p99 from rollups alone, within 5%
+    of the raw-stream answer, after the raw streams are gone."""
+    from opencompass_tpu.obs import hub as hubmod
+    hub = hubmod.ObsHub(obs_run['root'], budget_bytes=1)
+    hub.ingest(now=obs_run['now'], force_flush=True)
+    since, until = obs_run['t0'] - 1, obs_run['now']
+    raw = hub.query(since=since, until=until, q=0.99, raw=True,
+                    now=until)
+    assert raw['count'] == 200 and raw['value_s'] is not None
+    hub.compact(now=until)
+    assert not osp.isfile(osp.join(obs_run['src'], 'requests.jsonl'))
+    ans = hubmod.ObsHub(obs_run['root'], budget_bytes=1).query(
+        since=since, until=until, q=0.99, now=until)
+    assert ans['source'] == 'rollups' and ans['count'] == 200
+    assert abs(ans['value_s'] - raw['value_s']) \
+        <= 0.05 * raw['value_s']
+    assert ans['exact'] is True     # tail reservoir answered exactly
+
+
+def test_rollup_exact_tail_respects_saturation_floor():
+    """A merged-tail candidate below a saturated window's reservoir
+    floor must NOT be declared exact (hidden values could outrank it)."""
+    from opencompass_tpu.obs import hub as hubmod
+    buckets = list(hubmod.LATENCY_BUCKETS_S)
+    counts = [0] * (len(buckets) + 1)
+    counts[-1] = 100    # 100 observations, all in +Inf
+    rollups = [{'t': 'rollup', 'series': 's', 'window_s': 60,
+                'start': 0, 'labels': {}, 'count': 100, 'kept': 0,
+                'errors': 0, 'buckets': buckets, 'counts': counts,
+                'sum': 100.0, 'exemplars': {},
+                'top': [200.0 - i for i in range(hubmod.TAIL_K)]}]
+    # q=0.5 on a saturated window: rank 51-from-top is hidden
+    ans = hubmod.query_rollups(rollups, 's', -1, 61, q=0.5)
+    assert ans['exact'] is False
+    # q=0.99 (rank 2-from-top) is inside the reservoir: exact
+    ans = hubmod.query_rollups(rollups, 's', -1, 61, q=0.99)
+    assert ans['exact'] is True and ans['value_s'] == 199.0
+
+
+def test_reingest_is_idempotent(obs_run):
+    from opencompass_tpu.obs import hub as hubmod
+    hub = hubmod.ObsHub(obs_run['root'], rate=0.0)
+    hub.ingest(now=obs_run['now'], force_flush=True)
+    first = hub.query(since=obs_run['t0'] - 1, until=obs_run['now'],
+                      q=0.9, now=obs_run['now'])
+    again = hubmod.ObsHub(obs_run['root'], rate=0.0)
+    stats = again.ingest(now=obs_run['now'] + 60.0, force_flush=True)
+    assert stats['ingested'] == 0    # cursors advanced durably
+    second = again.query(since=obs_run['t0'] - 1, until=obs_run['now'],
+                         q=0.9, now=obs_run['now'])
+    assert second['count'] == first['count'] == 200
+    assert second['value_s'] == first['value_s']
+
+
+def test_compaction_spares_uningested_bytes(obs_run):
+    from opencompass_tpu.obs import hub as hubmod
+    hub = hubmod.ObsHub(obs_run['root'], budget_bytes=1)
+    hub.ingest(now=obs_run['now'], force_flush=True)
+    # new records appended AFTER the ingest pass must survive compaction
+    late = _mk_requests(5, obs_run['now'] + 1.0, model='late')
+    _write_jsonl(osp.join(obs_run['src'], 'requests.jsonl'), late)
+    monkey_ingest = hub.ingest                  # compact() re-ingests
+    hub.ingest = lambda **kw: {'ingested': 0}   # ... suppress it here
+    try:
+        hub.compact(now=obs_run['now'])
+    finally:
+        hub.ingest = monkey_ingest
+    assert osp.isfile(osp.join(obs_run['src'], 'requests.jsonl'))
+
+
+def test_hub_exemplars_survive_to_query(obs_run):
+    from opencompass_tpu.obs import hub as hubmod
+    hub = hubmod.ObsHub(obs_run['root'], rate=0.0)
+    hub.ingest(now=obs_run['now'], force_flush=True)
+    ans = hub.query(since=obs_run['t0'] - 1, until=obs_run['now'],
+                    q=0.99, now=obs_run['now'])
+    assert ans.get('exemplar', '').startswith('req-tiny-')
+
+
+# -- source discovery -------------------------------------------------------
+
+def test_register_source_and_heartbeat_self_registration(tmp_path):
+    from opencompass_tpu.obs import hub as hubmod
+    root = str(tmp_path / 'root')
+    a = osp.join(root, 'a')
+    b = str(tmp_path / 'elsewhere' / 'obs')
+    _write_jsonl(osp.join(a, 'requests.jsonl'),
+                 _mk_requests(1, 0.0))
+    os.makedirs(b)
+    hubmod.register_source(root, 'hostA', 'worker', a)
+    # a heartbeat carrying host/role/obs_dir joins discovery too —
+    # the self-registration path runners/worker.py rides
+    from opencompass_tpu.utils.fileio import atomic_write_json
+    os.makedirs(osp.join(a, 'progress'), exist_ok=True)
+    atomic_write_json(osp.join(a, 'progress', 'task1.json'),
+                      {'v': 1, 'task': 'task1', 'ts': 0.0,
+                       'state': 'running', 'host': 'hostB',
+                       'role': 'worker', 'obs_dir': b})
+    sources = hubmod.discover_sources(root)
+    dirs = {s.obs_dir for s in sources}
+    assert osp.abspath(a) in dirs and osp.abspath(b) in dirs
+    roles = {s.role for s in sources}
+    assert roles == {'worker'}
+
+
+# -- cross-run regression attribution (acceptance) --------------------------
+
+def _mk_run(root, name, compile_s, wall_s, shape_extra=0.0):
+    """A minimal run work_dir: one perf row + a compile audit with two
+    shapes, the second inflatable to inject a regression."""
+    run = osp.join(root, name)
+    os.makedirs(osp.join(run, 'perf', 'tiny'), exist_ok=True)
+    from opencompass_tpu.utils.fileio import atomic_write_json
+    atomic_write_json(osp.join(run, 'perf', 'tiny', 'mmlu.json'),
+                      {'wall_seconds': wall_s, 'samples': 10,
+                       'tokens_per_sec': 100.0,
+                       'device_seconds': 5.0,
+                       'compile_seconds': compile_s})
+    _write_jsonl(osp.join(run, 'obs', 'compiles.jsonl'), [
+        {'t': 'compile', 'ts': 1.0, 'shape_key': 'ppl:2x32',
+         'compile_seconds': 1.0},
+        {'t': 'compile', 'ts': 2.0, 'shape_key': 'gen:8x128',
+         'compile_seconds': compile_s - 1.0 + shape_extra}])
+    return run
+
+
+def test_obs_diff_attributes_compile_regression_to_shape(tmp_path):
+    """Inject a compile regression into run B; `obs diff` must rank the
+    task, attribute the delta to the compile phase, and pin it on the
+    inflated shape key."""
+    from opencompass_tpu.obs import hub as hubmod
+    root = str(tmp_path)
+    run_a = _mk_run(root, 'run_a', compile_s=5.0, wall_s=60.0)
+    run_b = _mk_run(root, 'run_b', compile_s=45.0, wall_s=100.0)
+    report = hubmod.diff_runs(run_a, run_b)
+    top = report['tasks'][0]
+    assert top['key'] == 'tiny/mmlu'
+    assert top['delta_s'] == pytest.approx(40.0)
+    assert top['phase'] == 'compile'
+    assert top['shape_key'] == 'gen:8x128'
+    worst = report['shapes'][0]
+    assert worst['shape_key'] == 'gen:8x128' and worst['delta_s'] > 0
+
+
+def test_obs_diff_cli_renders(tmp_path, capsys):
+    from opencompass_tpu.obs import hub as hubmod
+    root = str(tmp_path)
+    run_a = _mk_run(root, 'run_a', compile_s=5.0, wall_s=60.0)
+    run_b = _mk_run(root, 'run_b', compile_s=45.0, wall_s=100.0)
+    rc = hubmod.main(['diff', run_a, run_b])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert 'tiny/mmlu' in out and 'compile' in out \
+        and 'gen:8x128' in out
+
+
+def test_ledger_check_max_regression_gate(tmp_path, capsys):
+    """`ledger check --max-regression` exits 2 on a wall-time
+    regression and names the phase that ate the delta."""
+    from opencompass_tpu.ledger import ledger as ledmod
+    from opencompass_tpu.ledger.cli import main as ledger_main
+    led = str(tmp_path / 'ledger')
+    os.makedirs(led)
+    rows = [{'v': 1, 'run': 'r1', 'model': 'tiny', 'dataset': 'mmlu',
+             'wall_seconds': 100.0, 'compile_seconds': 5.0,
+             'device_seconds': 40.0, 'tokens_per_sec': 100.0},
+            {'v': 1, 'run': 'r2', 'model': 'tiny', 'dataset': 'mmlu',
+             'wall_seconds': 160.0, 'compile_seconds': 52.0,
+             'device_seconds': 40.0, 'tokens_per_sec': 100.0}]
+    _write_jsonl(osp.join(led, ledmod.RUNS_FILE), rows)
+    rc = ledger_main(['check', '--ledger', led,
+                      '--max-regression', '0.2'])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert 'wall 100.0s -> 160.0s' in out and 'compile phase' in out
+    # under the threshold the gate passes
+    assert ledger_main(['check', '--ledger', led,
+                        '--max-regression', '0.9']) == 0
+    capsys.readouterr()
+
+
+# -- promexport staleness (satellite 2) -------------------------------------
+
+def test_stale_gauge_withheld_from_exposition():
+    from opencompass_tpu.obs.promexport import render_prometheus
+    now = 10_000.0
+    snap = {'gauges': {
+        'fresh.value': {'value': 1.0, 'max': 2.0, 'ts': now - 10},
+        'dead.value': {'value': 7.0, 'max': 9.0, 'ts': now - 9_000},
+    }}
+    text = render_prometheus(snap, None, now=now)
+    assert 'oct_fresh_value 1' in text
+    assert 'oct_dead_value 7' not in text
+    assert 'oct_dead_value_max 9' in text    # max stays (monotonic)
+    assert 'oct_stale_series 1' in text
+
+
+def test_gauge_set_stamps_timestamp():
+    from opencompass_tpu.obs.metrics import Gauge
+    g = Gauge()
+    g.set(3.0, now=123.0)
+    assert g.last_set_ts == 123.0
+
+
+def test_rollup_exposition_has_exemplars(obs_run):
+    from opencompass_tpu.obs import hub as hubmod
+    from opencompass_tpu.obs.promexport import render_rollup_exposition
+    hub = hubmod.ObsHub(obs_run['root'], rate=0.0)
+    hub.ingest(now=obs_run['now'], force_flush=True)
+    text = render_rollup_exposition(hub.dir, now=obs_run['now'])
+    assert 'oct_hub_completion_latency_bucket' in text
+    assert '# {trace_id="req-tiny-' in text
+
+
+# -- doctor disk-pressure rule (satellite 6) --------------------------------
+
+def test_doctor_obs_disk_pressure(tmp_path, monkeypatch):
+    from opencompass_tpu.obs import doctor
+    src = str(tmp_path / 'obs')
+    _write_jsonl(osp.join(src, 'requests.jsonl'),
+                 _mk_requests(50, 0.0))
+    _write_jsonl(osp.join(src, 'events.jsonl'), [])   # obs-dir marker
+    monkeypatch.setenv('OCT_HUB_RETENTION_BYTES', '10')
+    art = doctor.collect(src)
+    assert art['hub'] and art['hub']['raw_bytes'] > 10
+    findings = doctor._rule_obs_disk_pressure(art)
+    assert findings and findings[0]['severity'] == 'error'
+    monkeypatch.setenv('OCT_HUB_RETENTION_BYTES',
+                       str(art['hub']['raw_bytes'] * 10))
+    art = doctor.collect(src)
+    assert doctor._rule_obs_disk_pressure(art) == []
+
+
+# -- chaos deadline-skew knob (satellite 3) ---------------------------------
+
+def test_deadline_skew_file_expires_budget(tmp_path, monkeypatch):
+    from opencompass_tpu.obs import reqtrace
+    skew = tmp_path / 'skew'
+    skew.write_text('10.0')
+    monkeypatch.setenv(reqtrace.ENV_DEADLINE_SKEW_FILE, str(skew))
+    assert reqtrace.Deadline(5000).expired()
+    skew.write_text('0')
+    assert not reqtrace.Deadline(5000).expired()
+
+
+# -- crash-safety contract (satellite 4) ------------------------------------
+
+def test_hub_crashfuzz_contract(tmp_path):
+    from opencompass_tpu.analysis import crashfuzz
+    report = crashfuzz.run_hub_crashfuzz(str(tmp_path), rounds=2,
+                                         n_records=60, seed=3)
+    assert report['rounds'] == 2 and len(report['cuts']) == 2

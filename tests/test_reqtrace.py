@@ -192,7 +192,13 @@ def test_http_request_id_and_access_log(tmp_path):
         assert code == 200
 
         # access log saw every request, 2xx and error paths alike,
-        # with latency + request id + handler annotations
+        # with latency + request id + handler annotations.  The log
+        # line lands in the handler's ``finally`` AFTER the response
+        # bytes flush, so the last entry can trail the client's read
+        # by a scheduler quantum — poll briefly instead of racing it.
+        deadline = time.time() + 5.0
+        while len(access) < 5 and time.time() < deadline:
+            time.sleep(0.01)
         assert len(access) == 5
         by_route = {}
         for rec in access:
@@ -503,7 +509,10 @@ def test_top_renders_from_files_and_exits_cleanly(tmp_path, capsys):
                     'model': 'fake-demo', 'status': 'ok',
                     'wall_s': 0.02, 'phases': []})
     q = SweepQueue(osp.join(str(cache_root), 'serve', 'queue'))
-    q.enqueue(config_text='models = []\n')
+    # pin the submission clock: a same-millisecond enqueue→gather gap
+    # would round the queue age down to 0.0 (the pressure math keeps
+    # ms precision on purpose — inject, don't sleep)
+    q.enqueue(config_text='models = []\n', now=now - 5.0)
     # a dead engine advertisement must demote to file rendering
     with open(osp.join(obs_root, reqtrace.ENGINE_INFO_FILE), 'w') as f:
         json.dump({'v': 1, 'port': 1, 'pid': 2 ** 30, 'ts': now}, f)
