@@ -583,8 +583,10 @@ def _bench_continuous(out_json='BENCH_DECODE.json'):
     executable and every row in a batch waits for the batch's longest;
     the engine runs ONE decode shape (slots×1) + ONE prefill-chunk
     shape, rows join as others retire, and each row pays only its own
-    tokens.  Asserts greedy token-identity between the two paths and
-    exactly one decode shape in the compile-cache manifest."""
+    tokens.  Since the mixed-step PR the engine compiles ONE fused
+    prefill+decode executable (was two).  Asserts greedy token-identity
+    between the two paths and exactly one mixed shape in the
+    compile-cache manifest."""
     import tempfile
 
     from opencompass_tpu.models import JaxLM
@@ -653,7 +655,9 @@ def _bench_continuous(out_json='BENCH_DECODE.json'):
     cont_tokens = sum(len(r.emitted) for r in rows)
     sig = lm_cont.shape_signature
     manifest = load_manifest(cache_dir).get(sig, {})
-    decode_shapes = sorted(k for k in manifest if k.startswith('decode:'))
+    engine_shapes = sorted(k for k in manifest
+                           if k.startswith(('mixed:', 'decode:',
+                                            'prefill_chunk:')))
 
     identical = fixed_texts == cont_texts
 
@@ -682,13 +686,19 @@ def _bench_continuous(out_json='BENCH_DECODE.json'):
         'fixed_row_latency_p95_s': round(p95(fixed_lat), 3),
         'continuous_row_latency_p95_s': round(p95(cont_lat), 3),
         'fixed_gen_compile_shapes': len(fixed_gen_shapes),
-        'continuous_compile_shapes': 2,
-        'decode_manifest_shapes': decode_shapes,
+        'continuous_compile_shapes': len(engine_shapes),
+        'engine_manifest_shapes': engine_shapes,
+        'stall_slot_steps': engine.stats()['stall_slot_steps'],
+        'kv_read_path': engine.stats()['kv_read_path'],
         'slot_util': engine.stats()['slot_util'],
         'greedy_identical': bool(identical),
     }
     assert identical, 'continuous outputs diverged from fixed-shape path'
-    assert len(decode_shapes) == 1, decode_shapes
+    # ONE fused mixed executable — the legacy decode/prefill_chunk pair
+    # must not appear in the manifest
+    assert len(engine_shapes) == 1 \
+        and engine_shapes[0].startswith('mixed:'), engine_shapes
+    assert record['stall_slot_steps'] == 0
     here = os.path.dirname(os.path.abspath(__file__))
     try:
         with open(os.path.join(here, out_json), 'w') as f:
@@ -704,7 +714,10 @@ def _bench_continuous(out_json='BENCH_DECODE.json'):
                 'row_latency_p95_s':
                     record['continuous_row_latency_p95_s'],
                 'slot_util': record['slot_util'],
-                'decode_manifest_shapes': decode_shapes})
+                'compile_shapes': len(engine_shapes),
+                'stall_slot_steps': record['stall_slot_steps'],
+                'kv_read_path': record['kv_read_path'],
+                'engine_manifest_shapes': engine_shapes})
     return record
 
 
@@ -739,12 +752,15 @@ def _bench_lint(out_json='BENCH_LINT.json'):
 
 def _bench_roofline(out_json='BENCH_ROOFLINE.json'):
     """detail.roofline: MFU/MBU attribution (obs/costmodel.py) for a
-    dense fixed-shape gen leg and a continuous-batching engine leg on
-    the tiny JaxLM (CPU-runnable).  The engine leg's flight-recorder
-    record carries the analytic cost fields end to end, and the
-    actual-vs-ideal KV-traffic ratio (> 1: the XLA paged-gather reads
-    every slot's full table width per step) is the number ROADMAP
-    item 1's Pallas kernel exists to close — this leg pins it per PR."""
+    dense fixed-shape gen leg and TWO continuous-batching engine legs
+    on the tiny JaxLM (CPU-runnable): the XLA paged-gather fallback and
+    the Pallas ragged-paged-attention kernel (interpret mode off-TPU —
+    identical read accounting, exact kernel semantics).  Each engine
+    leg's flight-recorder record carries the analytic cost fields end
+    to end; the actual-vs-ideal KV-traffic ratio is the number the
+    kernel exists to close (gather read 8.64x the ragged ideal when
+    this leg first pinned it) — the kernel leg must hold it near 1
+    (<= 1.5, page-rounding only), gated on the trajectory."""
     import tempfile
 
     from opencompass_tpu import obs
@@ -789,7 +805,6 @@ def _bench_roofline(out_json='BENCH_ROOFLINE.json'):
     cont_texts = lm_cont.generate_continuous(prompts, max_new)
     records = list(tmod.iter_records(tl.path))
     engines = [r for r in records if r.get('t') == 'engine']
-    obs.reset_obs()
     assert engines, 'engine drain left no flight-recorder record'
     eng = engines[-1]
     assert dense_texts == cont_texts, 'greedy identity broke'
@@ -799,6 +814,28 @@ def _bench_roofline(out_json='BENCH_ROOFLINE.json'):
     assert kv_ratio is not None and kv_ratio > 1.0, (
         'paged-gather KV traffic should exceed the ragged ideal '
         f'(got {kv_ratio})')
+
+    # -- ragged-kernel leg: same workload, KV read through the Pallas
+    # kernel (page-granular reads; page 16 keeps the rounding slack
+    # small against these prompt+decode extents).  data=1 pins a
+    # single-device mesh — the kernel's CPU routing requirement.
+    lm_rk = JaxLM(config='tiny', max_seq_len=512,
+                  continuous_batching=True, decode_slots=4,
+                  kv_page_size=16, ragged_kernel='on',
+                  parallel={'data': 1})
+    rk_texts = lm_rk.generate_continuous(prompts, max_new)
+    records = list(tmod.iter_records(tl.path))
+    rk_eng = [r for r in records if r.get('t') == 'engine'][-1]
+    obs.reset_obs()
+    assert rk_texts == dense_texts, 'kernel-path greedy identity broke'
+    assert rk_eng.get('kv_read_path') == 'ragged_kernel'
+    kv_ratio_kernel = None
+    if rk_eng.get('bytes_kv_ideal'):
+        kv_ratio_kernel = round(
+            rk_eng['bytes_kv'] / rk_eng['bytes_kv_ideal'], 3)
+    assert kv_ratio_kernel is not None and kv_ratio_kernel <= 1.5, (
+        'ragged-kernel KV traffic should be page-rounding away from '
+        f'the ideal (got {kv_ratio_kernel})')
     record = {
         'v': 1,
         'workload': '12 rows, prompt words in {3..20}, max_new 16, '
@@ -826,7 +863,16 @@ def _bench_roofline(out_json='BENCH_ROOFLINE.json'):
             'mfu': eng.get('mfu'),
             'mbu': eng.get('mbu'),
         },
-        'kv_traffic_ratio': kv_ratio,
+        'ragged_kernel': {
+            'kv_read_path': rk_eng.get('kv_read_path'),
+            'page_size': 16,
+            'device_seconds': rk_eng.get('device_seconds'),
+            'bytes_kv': rk_eng.get('bytes_kv'),
+            'bytes_kv_ideal': rk_eng.get('bytes_kv_ideal'),
+            'page_read_positions': rk_eng.get('page_read_positions'),
+        },
+        'kv_traffic_ratio_gather': kv_ratio,
+        'kv_traffic_ratio': kv_ratio_kernel,
         'greedy_identical': True,
     }
     here = os.path.dirname(os.path.abspath(__file__))
@@ -843,22 +889,28 @@ def _bench_roofline(out_json='BENCH_ROOFLINE.json'):
         _append_trajectory(
             'roofline', 'mbu', eng['mbu'], 'frac', direction='higher',
             detail={'dense_mbu': record['dense']['mbu'],
-                    'kv_traffic_ratio': kv_ratio,
+                    'kv_traffic_ratio_gather': kv_ratio,
                     'peaks_source': cm.peaks.source})
+    # the gated series is the ACTIVE read path's ratio: the ragged
+    # kernel's page-rounded traffic against the ideal (the gather
+    # fallback's 8.64x rides along in detail for the attribution)
     _append_trajectory(
-        'roofline', 'kv_traffic_ratio', kv_ratio, 'x',
+        'roofline', 'kv_traffic_ratio', kv_ratio_kernel, 'x',
         direction='lower',
-        detail={'table_positions': eng.get('table_positions'),
-                'kv_positions': eng.get('kv_positions')})
+        detail={'kv_read_path': rk_eng.get('kv_read_path'),
+                'kv_traffic_ratio_gather': kv_ratio,
+                'page_read_positions':
+                    rk_eng.get('page_read_positions'),
+                'kv_positions': rk_eng.get('kv_positions')})
     return record
 
 
 def _bench_devprof(out_json='BENCH_DEVPROF.json'):
     """detail.devprof: the device introspection layer end to end on the
     tiny JaxLM (CPU-runnable) — every fresh executable (ppl scoring +
-    both paged-engine kinds) leaves a compile-audit record with XLA's
-    own cost/memory analysis, the measured-vs-modeled flop drift is
-    summarized, and step profiling attributes the gather share of
+    the engine's fused mixed step) leaves a compile-audit record with
+    XLA's own cost/memory analysis, the measured-vs-modeled flop drift
+    is summarized, and step profiling attributes the gather share of
     decode step wall.  Trajectory series gate the deterministic
     numbers: ``model_drift`` is pure arithmetic on XLA's accounting,
     and the ``gather_share`` series uses the memory-bound modeled
@@ -895,8 +947,10 @@ def _bench_devprof(out_json='BENCH_DEVPROF.json'):
         os.environ.pop('OCT_PROFILE_STRIDE', None)
         obs.reset_obs()
 
-    assert audit.get('analyzed', 0) >= 3, (
-        f'expected ppl + prefill_chunk + decode audits, got {audit}')
+    assert audit.get('analyzed', 0) >= 2, (
+        f'expected ppl + mixed engine audits, got {audit}')
+    assert any(r.get('kind') == 'mixed' for r in compiles), (
+        'engine should compile ONE fused mixed executable')
     drift = audit.get('model_drift_max')
     assert drift is not None and drift < 0.25, (
         f'cost model drifted {drift} from XLA accounting '
@@ -941,6 +995,7 @@ def _bench_devprof(out_json='BENCH_DEVPROF.json'):
             'gather_share_source': summary.get('gather_share_source'),
             'gather_share_measured': eng.get('gather_share_measured'),
             'gather_share_modeled': gather_modeled,
+            'kv_read_path': eng.get('kv_read_path'),
         },
     }
     here = os.path.dirname(os.path.abspath(__file__))
@@ -954,10 +1009,15 @@ def _bench_devprof(out_json='BENCH_DEVPROF.json'):
         detail={'worst_shape': audit.get('model_drift_worst_shape'),
                 'mean': audit.get('model_drift_mean'),
                 'reconciled': audit.get('reconciled')})
+    # fresh series name: the modeled share was under-counting KV bytes
+    # by num_layers until the ragged-kernel PR's reconciliation fix
+    # (kv_token_bytes is per layer; the weight stream spans the depth),
+    # so values are not comparable with the old 'gather_share' series
     _append_trajectory(
-        'devprof', 'gather_share', gather_modeled, 'frac',
+        'devprof', 'gather_share_modeled', gather_modeled, 'frac',
         direction='lower',
         detail={'source': 'modeled',
+                'kv_read_path': eng.get('kv_read_path'),
                 'measured': eng.get('gather_share_measured'),
                 'profiled_steps': eng.get('profiled_steps')})
     return record

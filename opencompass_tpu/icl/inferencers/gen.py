@@ -249,14 +249,20 @@ class GenInferencer(BaseInferencer):
         timeline = get_timeline()
         if timeline.enabled:
             # plan record for the ledger's kind attribution + cached-row
-            # accounting; the shape census is the engine's two shapes
+            # accounting; the shape census is the engine's compiled
+            # shape set (one mixed step, or two legacy shapes)
             stats = {'n_rows': len(todo), 'continuous': True}
             plan_info = getattr(self.model, 'continuous_plan', None)
             cont = plan_info() if plan_info is not None else None
             if cont:
-                stats['shapes'] = {cont['decode_shape']: 1,
-                                   cont['prefill_shape']: 1}
-                stats['n_shapes'] = 2
+                if cont.get('mixed_step', True):
+                    stats['shapes'] = {cont['mixed_shape']: 1}
+                else:
+                    stats['shapes'] = {cont['decode_shape']: 1,
+                                       cont['prefill_shape']: 1}
+                stats['n_shapes'] = cont.get('compile_shapes',
+                                             len(stats['shapes']))
+                stats['kv_read_path'] = cont.get('kv_read_path')
             timeline.plan('gen', stats=stats, planned=True,
                           cached_rows=cached_rows)
         total = len(prompts)

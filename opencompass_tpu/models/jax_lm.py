@@ -110,11 +110,19 @@ class ContinuousEngine:
     A fixed-capacity set of ``slots`` in-flight sequences shares one
     preallocated page pool (nn/paged_kv.py).  Rows join as earlier rows
     retire, prompts prefill in page-sized chunks, and every device call
-    is one of exactly two compiled shapes — ``(slots, page_size)`` for
-    prefill chunks and ``(slots, 1)`` for decode — regardless of the
-    in-flight length mix.  That replaces the fixed-shape path's
-    per-``B×S``-bucket executables and its short-rows-wait-for-long-rows
-    padding with one resident step.
+    is ONE compiled mixed step: a ``(slots, page_size)`` prefill-chunk
+    sub-batch plus a ``(slots, 1)`` decode sub-batch, each
+    ``lax.cond``-gated so a pure-decode step skips the prefill compute
+    at runtime — regardless of the in-flight length mix.  That replaces
+    the fixed-shape path's per-``B×S``-bucket executables and its
+    short-rows-wait-for-long-rows padding with one resident step, and
+    (unlike the legacy two-shape step, kept behind ``mixed_step=False``)
+    lets decode-ready rows advance while a co-resident prompt is still
+    prefilling: ``stall_slot_steps`` is 0 by construction.  The KV read
+    inside the step is either the Pallas ragged-paged-attention kernel
+    (``kv_read_path == 'ragged_kernel'``; attention computed in place
+    over the pool pages) or the XLA gather fallback — decided host-side
+    once at engine build via ``JaxLM.kv_read_path()``.
 
     Thread model: any number of threads may :meth:`submit` rows (the
     serve data plane joins interactive requests mid-sweep this way);
@@ -164,12 +172,50 @@ class ContinuousEngine:
         donate = (1,) if jax.default_backend() != 'cpu' else ()
         cfg, ps = self.cfg, self.page_size
         temp, top_k = self.temperature, self.top_k
+        self.mixed = bool(getattr(model, 'continuous_mixed_step', True))
+        # decided once, host-side, under the model's mesh — the step
+        # traces the identical predicate, so this label IS the path
+        self.kv_read_path = model.kv_read_path()
+        rk = self.kv_read_path == 'ragged_kernel'
+        slots = self.slots
 
         def _step(params, pool, tokens, start, n_new, page_table, rng):
             return paged_generate_step(params, cfg, tokens, start, n_new,
                                        page_table, pool, ps, rng,
-                                       temp, top_k)
-        self._step_fn = jax.jit(_step, donate_argnums=donate)
+                                       temp, top_k, ragged_kernel=rk)
+
+        def _step_mixed(params, pool, pf_tokens, pf_start, pf_n,
+                        dc_tokens, dc_start, dc_n, page_table, rng):
+            # both sub-batches live in ONE executable; each is cond-
+            # gated so a pure-decode step runs no prefill compute (and
+            # vice versa).  Slots are disjoint between sub-batches —
+            # inactive rows (n == 0) write to the garbage page and
+            # their sampled tokens are ignored host-side.
+            def pf(pool):
+                nxt, pool = paged_generate_step(
+                    params, cfg, pf_tokens, pf_start, pf_n, page_table,
+                    pool, ps, jax.random.fold_in(rng, 0), temp, top_k,
+                    ragged_kernel=rk)
+                return nxt.astype(jnp.int32), pool
+
+            def dc(pool):
+                nxt, pool = paged_generate_step(
+                    params, cfg, dc_tokens, dc_start, dc_n, page_table,
+                    pool, ps, jax.random.fold_in(rng, 1), temp, top_k,
+                    ragged_kernel=rk)
+                return nxt.astype(jnp.int32), pool
+
+            def skip(pool):
+                return jnp.zeros((slots,), jnp.int32), pool
+
+            pf_nxt, pool = jax.lax.cond(jnp.any(pf_n > 0), pf, skip,
+                                        pool)
+            dc_nxt, pool = jax.lax.cond(jnp.any(dc_n > 0), dc, skip,
+                                        pool)
+            return jnp.where(pf_n > 0, pf_nxt, dc_nxt), pool
+
+        self._step_fn = jax.jit(_step_mixed if self.mixed else _step,
+                                donate_argnums=donate)
         # telemetry (all under self._lock).  Counters are engine-
         # lifetime; per-drain deltas come from snapshot()/stats(since=)
         # so a resident engine's Nth task reports only its own work.
@@ -192,9 +238,10 @@ class ContinuousEngine:
         self.stall_slot_steps = 0
         # per-step records (kind, wall, slot composition, retirements)
         # — bounded like the occupancy series; per-drain deltas take
-        # the tail.  Schema: {'k': 'p'|'d', 'w': wall_s, 'pf':
-        # prefilling rows, 'dc': decoding rows, 'st': decode-ready
-        # rows stalled behind the prefill chunk, 'ret': retired}
+        # the tail.  Schema: {'k': 'm' (mixed) | 'p'|'d' (legacy
+        # two-shape), 'w': wall_s, 'pf': prefilling rows, 'dc':
+        # decoding rows, 'st': decode-ready rows stalled behind the
+        # prefill chunk (always 0 for mixed steps), 'ret': retired}
         # guarded-by: _lock
         self._step_records: 'collections.deque[Dict]' = \
             collections.deque(maxlen=4096)
@@ -217,6 +264,13 @@ class ContinuousEngine:
         # FLOPs input, which unlike bytes scales per query token.
         self.kv_positions = 0
         self.attn_positions = 0
+        # page_read_positions: what the ragged kernel actually fetches
+        # — page-granular: per executed sub-batch each slot reads
+        # ceil(extent / page) pages (inactive slots one clamped page:
+        # the kernel's index-map clamp makes repeat pages free but the
+        # first fetch is real).  The kernel-path kv_ratio numerator
+        # (obs/costmodel.engine_cost kv_read_path='ragged_kernel').
+        self.page_read_positions = 0
         try:
             from opencompass_tpu.obs.costmodel import CostModel
             self._costmodel = CostModel.for_model(model)
@@ -327,61 +381,102 @@ class ContinuousEngine:
             if not active:
                 return False
             prefilling = [r for r in active if r.kv_len < len(r.ids)]
-            t = self.page_size if prefilling else 1
-            tokens = np.zeros((self.slots, t), np.int32)
-            start = np.zeros((self.slots,), np.int32)
-            n_new = np.zeros((self.slots,), np.int32)
-            if prefilling:
-                for row in prefilling:
-                    chunk = row.ids[row.kv_len:row.kv_len + t]
-                    tokens[row.slot, :len(chunk)] = chunk
-                    start[row.slot] = row.kv_len
-                    n_new[row.slot] = len(chunk)
-                    self.prefill_tokens += len(chunk)
-                    # ideal HBM reads: this row's KV extent after the
-                    # chunk, materialized once this step
-                    self.kv_positions += row.kv_len + len(chunk)
-                    # attended pairs: token i of a chunk starting at s
-                    # attends s + i + 1 positions
-                    self.attn_positions += (len(chunk) * row.kv_len
-                                            + len(chunk)
-                                            * (len(chunk) + 1) // 2)
+            if self.mixed:
+                # the mixed step advances BOTH populations at once:
+                # prefilling rows take a chunk, decode-ready rows take
+                # a token — nobody idles behind head-of-line prefill
+                pf_rows = prefilling
+                dc_rows = [r for r in active
+                           if r.kv_len >= len(r.ids)]
+            elif prefilling:
+                pf_rows, dc_rows = prefilling, []
             else:
-                for row in active:
-                    tokens[row.slot, 0] = row.emitted[-1]
-                    start[row.slot] = row.kv_len
-                    n_new[row.slot] = 1
-                    self.kv_positions += row.kv_len + 1
-                    self.attn_positions += row.kv_len + 1
+                pf_rows, dc_rows = [], active
+            t = self.page_size
+            pf_tokens = np.zeros((self.slots, t), np.int32)
+            pf_start = np.zeros((self.slots,), np.int32)
+            pf_n = np.zeros((self.slots,), np.int32)
+            dc_tokens = np.zeros((self.slots, 1), np.int32)
+            dc_start = np.zeros((self.slots,), np.int32)
+            dc_n = np.zeros((self.slots,), np.int32)
+            for row in pf_rows:
+                chunk = row.ids[row.kv_len:row.kv_len + t]
+                pf_tokens[row.slot, :len(chunk)] = chunk
+                pf_start[row.slot] = row.kv_len
+                pf_n[row.slot] = len(chunk)
+                self.prefill_tokens += len(chunk)
+                # ideal HBM reads: this row's KV extent after the
+                # chunk, materialized once this step
+                self.kv_positions += row.kv_len + len(chunk)
+                # attended pairs: token i of a chunk starting at s
+                # attends s + i + 1 positions
+                self.attn_positions += (len(chunk) * row.kv_len
+                                        + len(chunk)
+                                        * (len(chunk) + 1) // 2)
+            for row in dc_rows:
+                dc_tokens[row.slot, 0] = row.emitted[-1]
+                dc_start[row.slot] = row.kv_len
+                dc_n[row.slot] = 1
+                self.kv_positions += row.kv_len + 1
+                self.attn_positions += row.kv_len + 1
+            # kernel-path actual reads, page-granular per executed
+            # sub-batch: each slot fetches ceil(extent / page) pages
+            # (>= 1: inactive slots still pull one clamped page)
+            for start_a, n_a, ran in ((pf_start, pf_n, bool(pf_rows)),
+                                      (dc_start, dc_n, bool(dc_rows))):
+                if ran:
+                    pages = np.maximum(
+                        1, -(-(start_a + n_a) // self.page_size))
+                    self.page_read_positions += (int(pages.sum())
+                                                 * self.page_size)
+            n_new = pf_n + dc_n      # sub-batch slots are disjoint
             page_table = self.table.table.copy()
             self.steps += 1
             step_no = self.steps
             n_active = len(active)
-            n_prefill = len(prefilling)
-            # a prefill step advances only prefilling rows; every
-            # decode-ready co-resident idles this step — that idling is
-            # the head-of-line cost the per-step record makes visible
-            stalled = n_active - n_prefill if prefilling else 0
-            if prefilling:
+            n_prefill = len(pf_rows)
+            n_decode = len(dc_rows)
+            # legacy two-shape step: a prefill step advances only
+            # prefilling rows; every decode-ready co-resident idles —
+            # that head-of-line cost is what the mixed step reclaims
+            # (stalled is 0 by construction there)
+            stalled = 0 if self.mixed else (
+                n_active - n_prefill if pf_rows else 0)
+            if pf_rows:
                 self.prefill_steps += 1
                 self.stall_slot_steps += stalled
-            else:
+            if dc_rows:
                 self.decode_steps += 1
-                self.occupancy_sum += len(active)
-                self._occ_series.append(len(active))
+                self.occupancy_sum += n_decode
+                self._occ_series.append(n_decode)
 
-        kind = 'prefill_chunk' if prefilling else 'decode'
+        if self.mixed:
+            kind, shape = 'mixed', (self.slots, self.page_size + 1)
+        elif pf_rows:
+            kind, shape = 'prefill_chunk', (self.slots, self.page_size)
+        else:
+            kind, shape = 'decode', (self.slots, 1)
         first = model._first_dispatch(
-            kind, (self.slots, t), self.temperature, self.top_k)
+            kind, shape, self.temperature, self.top_k)
         cs0 = model.perf.compile_seconds
         t0 = time.perf_counter()
         rng = jax.random.fold_in(self._base_rng, step_no)
-        with _step_scope(kind, site='engine_step', step=step_no,
-                         slots=self.slots, page_size=self.page_size):
-            nxt, self.pool = self._step_fn(
-                model.params, self.pool, jnp.asarray(tokens),
-                jnp.asarray(start), jnp.asarray(n_new),
-                jnp.asarray(page_table), rng)
+        if self.mixed:
+            step_args = (model.params, self.pool,
+                         jnp.asarray(pf_tokens), jnp.asarray(pf_start),
+                         jnp.asarray(pf_n), jnp.asarray(dc_tokens),
+                         jnp.asarray(dc_start), jnp.asarray(dc_n),
+                         jnp.asarray(page_table), rng)
+        else:
+            tokens, start = (pf_tokens, pf_start) if pf_rows \
+                else (dc_tokens, dc_start)
+            step_args = (model.params, self.pool, jnp.asarray(tokens),
+                         jnp.asarray(start), jnp.asarray(n_new),
+                         jnp.asarray(page_table), rng)
+        with use_mesh(model.mesh), \
+                _step_scope(kind, site='engine_step', step=step_no,
+                            slots=self.slots, page_size=self.page_size):
+            nxt, self.pool = self._step_fn(*step_args)
             nxt = np.asarray(nxt)
         elapsed = time.perf_counter() - t0
         self.device_seconds += elapsed
@@ -395,11 +490,11 @@ class ContinuousEngine:
             # the compile audit's AOT re-lower sees the same avals the
             # dispatch above compiled for
             model._note_compile(
-                kind, (self.slots, t), perf.compile_seconds - cs0,
+                kind, shape, perf.compile_seconds - cs0,
                 fn=self._step_fn,
-                args=(model.params, self.pool, tokens, start, n_new,
-                      page_table, rng),
-                extra={'attn_width': self.max_pages * self.page_size})
+                args=(model.params, self.pool) + step_args[2:],
+                extra={'attn_width': self.max_pages * self.page_size,
+                       'kv_read_path': self.kv_read_path})
 
         eos = model.eos_token_id
         retired: List[_EngineRow] = []
@@ -422,10 +517,10 @@ class ContinuousEngine:
                     self._retire_locked(row)
                     retired.append(row)
             self._step_records.append({
-                'k': 'p' if prefilling else 'd',
+                'k': 'm' if self.mixed else ('p' if pf_rows else 'd'),
                 'w': round(elapsed, 6),
                 'pf': n_prefill,
-                'dc': 0 if prefilling else n_active,
+                'dc': n_decode,
                 'st': stalled,
                 'ret': len(retired)})
             self._note_heartbeat_locked()
@@ -470,7 +565,9 @@ class ContinuousEngine:
                         slots=self.slots,
                         table_positions=self.max_pages * self.page_size,
                         kv_positions=self.kv_positions,
-                        attn_positions=self.attn_positions)
+                        attn_positions=self.attn_positions,
+                        kv_read_path=self.kv_read_path,
+                        page_read_positions=self.page_read_positions)
                     mfu = cm.mfu(cost.flops, self.device_seconds)
                     mbu = cm.mbu(cost.bytes_total, self.device_seconds)
                     if mfu is not None:
@@ -482,25 +579,52 @@ class ContinuousEngine:
                 pass
 
     def warm(self) -> int:
-        """Pre-compile the engine's two shapes (prefill chunk and
-        decode) with an all-inactive dummy step — writes land on the
-        garbage page, the pool is otherwise untouched.  Returns the
-        number of shapes compiled (0 when both are already hot)."""
+        """Pre-compile the engine's step with an all-inactive dummy
+        dispatch — writes land on the garbage page, the pool is
+        otherwise untouched.  The mixed engine compiles ONE shape (both
+        cond-gated sub-batches live in the same executable); the legacy
+        ``mixed_step=False`` engine compiles two.  Returns the number
+        of shapes compiled (0 when already hot)."""
         model = self.model
         warmed = 0
+        zs = jnp.zeros((self.slots,), jnp.int32)
+        if self.mixed:
+            kind, shape = 'mixed', (self.slots, self.page_size + 1)
+            if not model._first_dispatch(kind, shape,
+                                         self.temperature, self.top_k):
+                return 0
+            cs0 = model.perf.compile_seconds
+            args = (model.params, self.pool,
+                    jnp.zeros((self.slots, self.page_size), jnp.int32),
+                    zs, zs, jnp.zeros((self.slots, 1), jnp.int32),
+                    zs, zs, jnp.asarray(self.table.table),
+                    self._base_rng)
+            with use_mesh(model.mesh), device_call(model.perf,
+                                                   first=True):
+                nxt, self.pool = self._step_fn(*args)
+                jax.block_until_ready(nxt)
+            model._note_compile(kind, shape,
+                                model.perf.compile_seconds - cs0,
+                                fn=self._step_fn,
+                                args=(model.params, self.pool)
+                                + args[2:],
+                                extra={'attn_width':
+                                       self.max_pages * self.page_size,
+                                       'kv_read_path':
+                                       self.kv_read_path})
+            return 1
         for t in (self.page_size, 1):
             kind = 'prefill_chunk' if t > 1 else 'decode'
             if not model._first_dispatch(kind, (self.slots, t),
                                          self.temperature, self.top_k):
                 continue
             cs0 = model.perf.compile_seconds
-            with device_call(model.perf, first=True):
+            with use_mesh(model.mesh), device_call(model.perf,
+                                                   first=True):
                 nxt, self.pool = self._step_fn(
                     model.params, self.pool,
                     jnp.zeros((self.slots, t), jnp.int32),
-                    jnp.zeros((self.slots,), jnp.int32),
-                    jnp.zeros((self.slots,), jnp.int32),
-                    jnp.asarray(self.table.table),
+                    zs, zs, jnp.asarray(self.table.table),
                     self._base_rng)
                 jax.block_until_ready(nxt)
             model._note_compile(kind, (self.slots, t),
@@ -513,7 +637,9 @@ class ContinuousEngine:
                                       np.asarray(self.table.table),
                                       self._base_rng),
                                 extra={'attn_width':
-                                       self.max_pages * self.page_size})
+                                       self.max_pages * self.page_size,
+                                       'kv_read_path':
+                                       self.kv_read_path})
             warmed += 1
         return warmed
 
@@ -538,6 +664,7 @@ class ContinuousEngine:
                     'prefill_tokens': self.prefill_tokens,
                     'kv_positions': self.kv_positions,
                     'attn_positions': self.attn_positions,
+                    'page_read_positions': self.page_read_positions,
                     'stall_slot_steps': self.stall_slot_steps}
 
     def stats(self, since: Optional[Dict] = None) -> Dict:
@@ -598,7 +725,11 @@ class ContinuousEngine:
                 - base.get('kv_positions', 0),
                 'attn_positions': self.attn_positions
                 - base.get('attn_positions', 0),
+                'page_read_positions': self.page_read_positions
+                - base.get('page_read_positions', 0),
                 'table_positions': self.max_pages * self.page_size,
+                'kv_read_path': self.kv_read_path,
+                'mixed_step': self.mixed,
                 'kv_pool': self.alloc.stats(),
                 # per-step telemetry: the slot-composition records
                 # (prefill vs decode vs stalled rows per step), the
@@ -634,7 +765,10 @@ class ContinuousEngine:
                 table_positions=stats.get('table_positions')
                 or self.max_pages * self.page_size,
                 kv_positions=stats.get('kv_positions'),
-                attn_positions=stats.get('attn_positions'))
+                attn_positions=stats.get('attn_positions'),
+                kv_read_path=stats.get('kv_read_path',
+                                       self.kv_read_path),
+                page_read_positions=stats.get('page_read_positions'))
             return cm.fields(cost, stats.get('device_seconds'))
         except Exception:
             return {}
@@ -656,7 +790,8 @@ class ContinuousEngine:
                     out['gather_share_modeled'] = \
                         _devprof.modeled_gather_share(
                             cm, self.slots,
-                            self.max_pages * self.page_size)
+                            self.max_pages * self.page_size,
+                            kv_read_path=self.kv_read_path)
             share = measured if measured \
                 else out.get('gather_share_modeled')
             if share:
@@ -763,6 +898,8 @@ class JaxLM(BaseModel):
                  decode_slots: int = 8,
                  kv_page_size: int = 64,
                  kv_pool_pages: Optional[int] = None,
+                 mixed_step: bool = True,
+                 ragged_kernel: str = 'auto',
                  run_cfg: Optional[Dict] = None):
         super().__init__(path=path, max_seq_len=max_seq_len,
                          tokenizer_only=tokenizer_only,
@@ -869,6 +1006,21 @@ class JaxLM(BaseModel):
         self.decode_slots = int(decode_slots)
         self.kv_page_size = int(kv_page_size)
         self.kv_pool_pages = kv_pool_pages
+        # one mixed prefill+decode engine step (single compiled shape;
+        # prefilling rows no longer stall decode-ready slots).  False
+        # keeps the legacy two-shape step — the stall-regression pin in
+        # tests/test_continuous_batching.py measures the difference.
+        self.continuous_mixed_step = bool(mixed_step)
+        # KV-read path inside the engine step: 'auto' takes the Pallas
+        # ragged-paged-attention kernel on a real TPU where
+        # nn/transformer.ragged_kernel_active covers the config and the
+        # XLA gather everywhere else; 'on' forces the kernel (interpret
+        # mode off-TPU — correct but slow, for tests/bench); 'off'
+        # pins the gather.
+        if ragged_kernel not in ('auto', 'on', 'off'):
+            raise ValueError(f'unsupported ragged_kernel='
+                             f'{ragged_kernel!r} (want auto|on|off)')
+        self.ragged_kernel = ragged_kernel
         self._cont_engine: Optional[ContinuousEngine] = None
         self._cont_engine_key = None
         # worker protocol thread + sweep thread can both reach for the
@@ -1596,40 +1748,72 @@ class JaxLM(BaseModel):
     def continuous_eligible(self) -> bool:
         """Device-free half of :attr:`continuous_active`: flag on plus
         a config/decode-mode the paged step supports (no ALiBi /
-        prefix-LM / int4 KV / beam search).  What ``cli plan`` and the
-        warm-up shape census key on — a config this returns False for
-        will run the dense path, so the dense B×S census must still be
-        warmed."""
+        prefix-LM / beam search; int4-KV pools run the gather-fallback
+        read path).  What ``cli plan`` and the warm-up shape census key
+        on — a config this returns False for will run the dense path,
+        so the dense B×S census must still be warmed."""
         if not self.continuous_batching or self.cfg is None:
             return False
         if self.cfg.positional == 'alibi' or self.cfg.prefix_lm:
             return False
-        if self.cfg.kv_quant_mode == 'int4':
-            return False
         gk = self.generation_kwargs or {}
         return int(gk.get('num_beams', 1)) <= 1
+
+    def kv_read_path(self) -> str:
+        """Which KV-read path a continuous-engine step takes for this
+        model: ``'ragged_kernel'`` (Pallas ragged-paged-attention over
+        the pool pages) or ``'gather_fallback'`` (XLA gather of each
+        slot's full table width).  Device-free host-side arithmetic —
+        ``cli plan`` calls it on tokenizer_only models — and the same
+        predicate ``nn/transformer.paged_step`` applies at trace time,
+        so the plan/timeline label can never drift from the dispatch.
+        ``ragged_kernel='auto'`` keeps the gather off-TPU (interpret-
+        mode Pallas is correct but orders of magnitude too slow for a
+        hot decode loop); ``'on'`` forces the kernel wherever
+        ``ragged_kernel_active`` covers the config."""
+        if self.ragged_kernel == 'off' or self.cfg is None:
+            return 'gather_fallback'
+        from opencompass_tpu.nn import transformer as _tf
+        from opencompass_tpu.nn._platform import on_tpu
+        if self.ragged_kernel == 'auto' and not on_tpu():
+            return 'gather_fallback'
+        mode = self.cfg.kv_quant_mode
+        if mode == 'int8':
+            k_dtype = jnp.int8
+        elif mode == 'int4':
+            k_dtype = 'int4'   # never kernel-supported; avoids jnp.int4
+        else:
+            k_dtype = jnp.dtype(self.cfg.dtype)
+        with use_mesh(self.mesh):
+            active = _tf.ragged_kernel_active(self.cfg, k_dtype)
+        return 'ragged_kernel' if active else 'gather_fallback'
 
     @property
     def continuous_active(self) -> bool:
         """True when the continuous-batching engine can serve this
         model's generation: :attr:`continuous_eligible` plus weights
-        resident and no tensor/seq/multi-host mesh (the paged
-        scatter/gather path is single-device)."""
+        resident and a mesh the engine step supports — none, a
+        plain/data mesh (steps run un-meshed on the default device), or
+        a tensor-parallel ('model') mesh when the ragged kernel covers
+        it (the step is head-sharded via shard_map; the gather fallback
+        stays single-device, so seq axes and multi-host stay out)."""
         if not self.continuous_eligible or self.tokenizer_only \
                 or self.params is None:
             return False
-        # the engine's pool lives on one device: a plain/data mesh is
-        # fine (steps run un-meshed on the default device), tensor/seq
-        # parallelism and multi-host are not
-        return self.mesh is None or (
-            not self._multihost()
-            and self.mesh.shape.get('model', 1) == 1
-            and self.mesh.shape.get('seq', 1) == 1)
+        if self.mesh is None:
+            return True
+        if self._multihost() or self.mesh.shape.get('seq', 1) > 1:
+            return False
+        return (self.mesh.shape.get('model', 1) == 1
+                or self.kv_read_path() == 'ragged_kernel')
 
     def continuous_plan(self) -> Optional[Dict]:
         """Static engine geometry for the ``cli plan`` pre-flight:
-        slot capacity, page sizing, and the (exactly two) compile
-        shapes a continuous sweep dispatches.  Device-free — works on
+        slot capacity, page sizing, the compile shapes a continuous
+        sweep dispatches (ONE mixed prefill+decode step by default;
+        the legacy ``mixed_step=False`` engine compiles two), and
+        which KV-read path the step takes (``kv_read_path``:
+        ragged_kernel vs gather_fallback).  Device-free — works on
         tokenizer_only models.  None when the engine is off."""
         if not self.continuous_batching:
             return None
@@ -1638,15 +1822,24 @@ class JaxLM(BaseModel):
         slots, page = self.decode_slots, self.kv_page_size
         pages = int(self.kv_pool_pages or pool_pages_for(
             slots, self.max_seq_len, page))
-        return {
+        mixed = bool(getattr(self, 'continuous_mixed_step', True))
+        plan = {
             'slots': slots,
             'page_size': page,
             'pool_pages': pages,
             'max_pages_per_seq': pages_per_seq(self.max_seq_len, page),
             'decode_shape': f'{slots}x1',
             'prefill_shape': f'{slots}x{page}',
-            'compile_shapes': 2,
+            'mixed_step': mixed,
+            'compile_shapes': 1 if mixed else 2,
+            'kv_read_path': self.kv_read_path(),
         }
+        if mixed:
+            # T = page + 1 encodes the fused sub-batches (page-wide
+            # prefill chunk + 1-wide decode) — the same key the compile
+            # manifest / audit record for the engine's one executable
+            plan['mixed_shape'] = f'{slots}x{page + 1}'
+        return plan
 
     def continuous_engine(self) -> 'ContinuousEngine':
         """The resident engine (built on first use; rebuilt when the

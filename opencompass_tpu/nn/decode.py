@@ -137,20 +137,27 @@ def paged_generate_step(params, cfg: TransformerConfig, tokens: jax.Array,
                         start: jax.Array, n_new: jax.Array,
                         page_table: jax.Array, pool: Dict, page_size: int,
                         rng: jax.Array, temperature: float = 0.0,
-                        top_k: int = 0) -> Tuple[jax.Array, Dict]:
+                        top_k: int = 0,
+                        ragged_kernel: bool = False
+                        ) -> Tuple[jax.Array, Dict]:
     """One continuous-batching engine step: advance every active slot by
     its chunk of tokens through the paged KV cache and sample each
     slot's next token from the last-real-position logits.
 
-    The continuous engine (models/jax_lm.py) jits this twice per model —
-    once at (slots, page_size) for prefill chunks, once at (slots, 1)
-    for decode — and those two shapes serve the whole sweep regardless
-    of the in-flight length mix.  Returns (sampled next tokens (slots,),
+    The continuous engine (models/jax_lm.py) compiles ONE mixed step
+    per model containing a (slots, page_size) prefill-chunk sub-batch
+    and a (slots, 1) decode sub-batch (each `lax.cond`-gated, so a
+    pure-decode step skips the prefill compute at runtime), and that
+    single shape serves the whole sweep regardless of the in-flight
+    length mix.  ``ragged_kernel`` routes the KV read through the
+    Pallas ragged-paged-attention kernel where supported (see
+    `transformer.paged_step`).  Returns (sampled next tokens (slots,),
     pool); samples for slots whose chunk did not reach a sampling point
     (mid-prompt, inactive) are garbage the host ignores.
     """
     logits, pool = paged_step(params, cfg, tokens, start, n_new,
-                              page_table, pool, page_size)
+                              page_table, pool, page_size,
+                              ragged_kernel=ragged_kernel)
     return _sample(logits, rng, temperature, top_k), pool
 
 

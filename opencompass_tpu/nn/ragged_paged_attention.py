@@ -1,0 +1,283 @@
+"""Pallas ragged paged attention: decode/prefill-chunk attention read
+directly from the paged KV pool through the page table.
+
+Why this kernel exists: the continuous engine's portable paged read is
+an XLA gather (`paged_kv.gather_view`) that materializes every slot's
+contiguous KV view — the FULL table width, every step, whatever the
+slot's actual length.  The roofline layer measured that read traffic at
+8.64x the ragged ideal (BENCH_ROOFLINE.json `kv_traffic_ratio`) while
+decode is memory-bandwidth-bound, so the gather is the single largest
+raw-speed leak in the serving path.  This kernel computes attention in
+place over the pool pages named by each slot's page table, touching
+only the pages that hold valid tokens: read traffic drops from
+O(slots * table_width) to O(sum of per-slot page-rounded lengths).
+
+Shape strategy: the grid is (batch-slot, page-index).  Each grid step
+reads ONE pool page of all KV heads for one slot and folds it into a
+flash-attention online softmax (running max / sum / output accumulator
+in VMEM scratch).  The page-table indirection happens in the BLOCK
+INDEX MAPS via scalar prefetch: the k/v BlockSpecs index the full
+stacked pool `(L, P, K, page, hd)` at `(layer, table[b, p], ...)`, so
+the Pallas pipeline DMAs exactly the named page — the pool is already
+head-major per page for this.  Past a slot's last valid page the index
+map CLAMPS to the last valid page: consecutive grid steps that name
+the same block skip the re-fetch entirely (the Pallas pipeline elides
+DMAs for unchanged block indices), so invalid pages cost neither HBM
+reads nor compute (`pl.when` skips the body).
+
+Ragged/causal discipline: queries are this step's chunk (T=1 decode,
+T=page_size prefill chunk), left-aligned at `start[b]`; query i holds
+RoPE/causal position `start[b] + i` and attends kv positions
+`<= start[b] + i` — the same mask `transformer.paged_step` builds for
+the gather path, enforced in-kernel from a 2D iota against the scalar-
+prefetched starts.  Rows with nothing to do this step (inactive slots,
+or the other sub-batch of a mixed engine step) clamp to one page and
+produce garbage the host ignores, exactly like the gather path.
+
+Quantized pools: int8-KV pages are read from HBM in their stored int8
+dtype (the bandwidth win) and converted to f32 ON THE VMEM TILE, with
+the per-vector pool scales folded into the scores / probabilities —
+the same arithmetic as the gather path's `_attention`, so greedy
+decode stays token-identical under quantized pools too.  (The int8 x
+int8 MXU-dot variant with dynamically quantized q/probs —
+`decode_attention._row` — trades that identity for MXU throughput; it
+is a follow-on once the agreement harness covers this kernel, and
+changes compute only: the HBM traffic is int8 either way.)  int4-KV
+pools keep the gather fallback (`supported()` returns False): an
+in-kernel unpack is not wired and int4 agreement is bounded by the
+quant envelope tests, not bit identity.
+
+`interpret=True` (or the module-level FORCE_INTERPRET test hook) runs
+the kernel through the Pallas interpreter so the hermetic CPU suite —
+and the CPU bench legs — exercise the exact kernel semantics
+deviceless; `paged_kv.dense_equivalent` is the oracle
+(tests/test_ragged_paged_attention.py pins bit-level parity against
+the gather path and token identity end to end).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ._platform import on_tpu as _on_tpu
+
+# test hook: run the kernel through the Pallas interpreter (and pass the
+# platform gate) so the hermetic CPU suite exercises the paged read path
+FORCE_INTERPRET = False
+
+
+def supported(cfg_positional: str, head_dim: int, num_heads: int,
+              num_kv_heads: int, k_dtype, interpret: bool = False) -> bool:
+    """Conservative gate for the ragged paged kernel.  ALiBi needs
+    per-slot additive biases (not implemented); int4 pools keep the
+    gather fallback (no int4 MXU dot); on a real TPU head_dim must be
+    lane-aligned (the interpreter has no such constraint, which is what
+    lets the tiny hermetic geometry exercise the kernel)."""
+    if not (interpret or FORCE_INTERPRET) and not _on_tpu():
+        return False
+    if cfg_positional == 'alibi':
+        return False
+    if num_heads % num_kv_heads:
+        return False
+    if not (interpret or FORCE_INTERPRET) and head_dim % 128:
+        return False
+    if jnp.dtype(k_dtype) not in (jnp.dtype(jnp.int8),
+                                  jnp.dtype(jnp.bfloat16),
+                                  jnp.dtype(jnp.float32)):
+        return False
+    return True
+
+
+def _kernel(start_ref, pages_ref, table_ref, layer_ref, q_ref, k_ref,
+            v_ref, ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref, *,
+            scale, page, max_pages, groups):
+    """One grid step: slot b, page p.  q block (1, K, TG, hd) where
+    TG = T * groups (query chunk folded into the per-kv-head group
+    dim); k/v blocks (1, 1, K, page, hd) — ONE pool page, selected by
+    the index map; scratch m/l (K, TG, 128) f32, acc (K, TG, hd) f32."""
+    import jax.experimental.pallas as pl
+
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, -1e30)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(p < pages_ref[b])
+    def _page():
+        q = q_ref[0]                                 # (K, TG, hd)
+        K, TG, hd = q.shape
+        k = k_ref[0, 0]                              # (K, page, hd)
+
+        # causal/ragged mask from real positions: query row tg holds
+        # token index start[b] + tg // groups and attends kv positions
+        # <= its own (left-aligned, RoPE position = token index)
+        q_pos = start_ref[b] + \
+            jax.lax.broadcasted_iota(jnp.int32, (TG, page), 0) // groups
+        kv_pos = p * page + \
+            jax.lax.broadcasted_iota(jnp.int32, (TG, page), 1)
+        bias = jnp.where(kv_pos <= q_pos, 0.0, -1e30)  # (TG, page)
+
+        quant = k.dtype == jnp.int8
+        if quant:
+            # the HBM read was int8 (the bandwidth win); convert the
+            # VMEM tile to f32 and fold the per-vector pool scales into
+            # the scores — the gather path's exact arithmetic, so
+            # greedy tokens stay identical under quantized pools
+            qf = q.astype(jnp.float32)
+            s = jax.lax.dot_general(qf, k.astype(jnp.float32),
+                                    (((2,), (2,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+            ks = ks_ref[0, 0].astype(jnp.float32)    # (K, page)
+            s = s * scale * ks[:, None, :]
+        else:
+            s = jax.lax.dot_general(q, k, (((2,), (2,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+            s = s * scale
+        s = s + bias[None]                           # (K, TG, page)
+
+        m_prev = m_ref[:, :, :1]                     # (K, TG, 1)
+        m_cur = jnp.max(s, axis=2, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        pr = jnp.exp(s - m_new)                      # (K, TG, page) f32
+        l_new = alpha * l_ref[:, :, :1] \
+            + jnp.sum(pr, axis=2, keepdims=True)
+
+        v = v_ref[0, 0]                              # (K, page, hd)
+        if quant:
+            # mirror the score fold: v's per-vector scales into the
+            # probabilities, V tile converted on-chip, f32 contraction
+            vs = vs_ref[0, 0].astype(jnp.float32)
+            pw = pr * vs[:, None, :]
+            o = jax.lax.dot_general(pw, v.astype(jnp.float32),
+                                    (((2,), (1,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+        else:
+            ob = pr.astype(v.dtype) if v.dtype == jnp.bfloat16 else pr
+            o = jax.lax.dot_general(ob, v, (((2,), (1,)), ((0,), (0,))),
+                                    preferred_element_type=jnp.float32)
+        acc_ref[:] = acc_ref[:] * alpha + o
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(p == max_pages - 1)
+    def _finish():
+        l = l_ref[:, :, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, pool_k, pool_v, page_table, start, t_valid,
+                           scale, layer, pool_ks=None, pool_vs=None,
+                           interpret=False):
+    """Attention for one engine step read in place from the paged pool.
+
+    q: (B, T, H, hd) this step's queries (T=1 decode, T=page_size
+    prefill chunk), already RoPE'd at positions ``start[b] + i``;
+    pool_k/pool_v: (L, P, K, page, hd) FULL stacked pool (this step's
+    K/V already scattered in); page_table: (B, MP) int32 page ids
+    (GARBAGE_PAGE for unassigned); start: (B,) int32 first query
+    position; t_valid: (B,) int32 how many of this row's T queries are
+    real (0 = inactive row, output garbage); layer: i32 scalar
+    (traced); pool_ks/pool_vs: (L, P, K, page) per-vector scales for
+    int8 pools.  Returns (B, T, H, hd) in q.dtype.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    interpret = interpret or FORCE_INTERPRET
+    B, T, H, hd = q.shape
+    K, page = pool_k.shape[2], pool_k.shape[3]
+    MP = page_table.shape[1]
+    G = H // K
+    TG = T * G
+    quant = pool_ks is not None
+    if pool_k.dtype == jnp.dtype(jnp.int8) and not quant:
+        raise ValueError('int8 pools need pool_ks/pool_vs (the kernel '
+                         'detects quantization from the pool dtype)')
+
+    # valid pages per row, >= 1 so the clamp always names a real block
+    # (inactive rows read the garbage page once and mask everything)
+    last = start + jnp.maximum(t_valid, 1) - 1
+    pages = jnp.minimum(last // page + 1, MP).astype(jnp.int32)
+    pages = jnp.maximum(pages, 1)
+
+    # fold the query chunk into the per-kv-head group dim OUTSIDE the
+    # kernel (free XLA transpose) so the in-kernel dots are K-batched
+    # over a single (TG, hd) row block
+    qk = q.reshape(B, T, K, G, hd).transpose(0, 2, 1, 3, 4)
+    qk = qk.reshape(B, K, TG, hd)
+    if qk.dtype not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        qk = qk.astype(jnp.float32)
+
+    def _page_map(b, p, start_s, pages_s, table_s, layer_s):
+        # clamp past-the-end page indices to the last valid page:
+        # consecutive identical block indices make the Pallas pipeline
+        # skip the re-fetch, so invalid pages cost no HBM traffic
+        pp = jnp.minimum(p, pages_s[b] - 1)
+        return (layer_s[0], table_s[b, pp], 0, 0, 0)
+
+    def _scale_map(b, p, start_s, pages_s, table_s, layer_s):
+        pp = jnp.minimum(p, pages_s[b] - 1)
+        return (layer_s[0], table_s[b, pp], 0, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, K, TG, hd), lambda b, p, *_: (b, 0, 0, 0)),
+        pl.BlockSpec((1, 1, K, page, hd), _page_map),
+        pl.BlockSpec((1, 1, K, page, hd), _page_map),
+    ]
+    args = [qk, pool_k, pool_v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, K, page), _scale_map),
+                     pl.BlockSpec((1, 1, K, page), _scale_map)]
+        args += [pool_ks, pool_vs]
+
+    kern = functools.partial(_kernel, scale=float(scale), page=page,
+                             max_pages=MP, groups=G)
+    if not quant:
+        kern = _strip_scales(kern)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(B, MP),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, K, TG, hd), lambda b, p, *_: (b, 0, 0, 0)),
+        scratch_shapes=[
+            _vmem((K, TG, 128), jnp.float32),
+            _vmem((K, TG, 128), jnp.float32),
+            _vmem((K, TG, hd), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((B, K, TG, hd), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'arbitrary'),
+            vmem_limit_bytes=100 * 1024 * 1024),
+        interpret=interpret,
+    )(start.astype(jnp.int32), pages, page_table.astype(jnp.int32),
+      jnp.reshape(layer, (1,)).astype(jnp.int32), *args)
+    # unfold (B, K, TG, hd) -> (B, T, H, hd)
+    out = out.reshape(B, K, T, G, hd).transpose(0, 2, 1, 3, 4)
+    return out.reshape(B, T, H, hd)
+
+
+def _vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, dtype)
+
+
+def _strip_scales(kern):
+    def wrapped(start_ref, pages_ref, table_ref, layer_ref, q_ref, k_ref,
+                v_ref, o_ref, m_ref, l_ref, acc_ref):
+        return kern(start_ref, pages_ref, table_ref, layer_ref, q_ref,
+                    k_ref, v_ref, None, None, o_ref, m_ref, l_ref,
+                    acc_ref)
+    return wrapped

@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 
 from opencompass_tpu.parallel.mesh import current_mesh
 
+from ._platform import on_tpu as _on_tpu
 from .config import TransformerConfig
 
 Params = Dict
@@ -490,7 +491,7 @@ def _attention_shared(q, k, v, k1, v1, own_mask):
 def _block(cfg: TransformerConfig, x, lp, positions, mask,
            cache_slice=None, cache_index=None, attn_fn=None,
            kv_positions=None, tp_axis=None, shared_kv=None,
-           full_cache=None, paged_cache=None):
+           full_cache=None, paged_cache=None, ragged_paged=None):
     """One transformer block.  x: (B,T,D).  With a cache slice, K/V for the
     current tokens are written at ``cache_index`` and attention runs over the
     whole cache; without, attention is over the current sequence only.
@@ -524,7 +525,40 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
     k_scale = v_scale = None
     head_major = (cache_slice is not None or full_cache is not None
                   or paged_cache is not None)
-    if paged_cache is not None:
+    if ragged_paged is not None:
+        # ragged-kernel path: this step's K/V scatter into the FULL
+        # stacked pool (the scan carry — per-layer pool slices never
+        # exist, so nothing is materialized for the custom call), then
+        # attention reads the pool pages in place through the page
+        # table (nn/ragged_paged_attention.py).  No contiguous
+        # per-slot view is ever built; read traffic is page-granular
+        # in each slot's actual length instead of the full table width.
+        (pool_full, li, page_rows, offsets, view_pt, pg_start,
+         pg_valid) = ragged_paged
+        if 'ks' in pool_full:  # quantized pool (cfg.kv_quant)
+            k, ks_new = _quantize_kv(k, cfg.kv_quant_mode)
+            v, vs_new = _quantize_kv(v, cfg.kv_quant_mode)
+            writes = (('k', k), ('v', v), ('ks', ks_new), ('vs', vs_new))
+        else:
+            writes = (('k', k), ('v', v))
+        new_cache = dict(pool_full)
+        for name, cur in writes:
+            tgt = pool_full[name]
+            upd = cur.astype(tgt.dtype)
+            if tgt.dtype == jnp.int4:
+                # XLA forbids s4 collectives: pin the scatter replicated
+                # so the partitioner computes it redundantly per device
+                # instead of sharding updates + all-reducing
+                tgt, upd = _shard(tgt, P()), _shard(upd, P())
+            if tgt.ndim == 5:        # (L, P, K, page, hd)
+                out = tgt.at[li, page_rows, :, offsets, :].set(upd)
+            else:                    # (L, P, K, page) per-vector scales
+                out = tgt.at[li, page_rows, :, offsets].set(upd)
+            new_cache[name] = (_shard(out, P())
+                               if tgt.dtype == jnp.int4 else out)
+        attn = _ragged_attention(cfg, q, new_cache, view_pt, pg_start,
+                                 pg_valid, li).astype(x.dtype)
+    elif paged_cache is not None:
         # paged decode / prefill-chunk (nn/paged_kv.py): this step's
         # K/V scatter into the pool pages the slot page tables name,
         # then attention runs over each slot's gathered contiguous
@@ -541,12 +575,16 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
         new_cache = dict(pool_l)
         for name, cur in writes:
             tgt = pool_l[name]
+            upd = cur.astype(tgt.dtype)
+            if tgt.dtype == jnp.int4:
+                # XLA forbids s4 collectives (see the ragged branch)
+                tgt, upd = _shard(tgt, P()), _shard(upd, P())
             if tgt.ndim == 4:        # (P, K, page, hd)
-                new_cache[name] = tgt.at[page_rows, :, offsets, :].set(
-                    cur.astype(tgt.dtype))
+                out = tgt.at[page_rows, :, offsets, :].set(upd)
             else:                    # (P, K, page) per-vector scales
-                new_cache[name] = tgt.at[page_rows, :, offsets].set(
-                    cur.astype(tgt.dtype))
+                out = tgt.at[page_rows, :, offsets].set(upd)
+            new_cache[name] = (_shard(out, P())
+                               if tgt.dtype == jnp.int4 else out)
         k = gather_view(new_cache['k'], view_pt)
         v = gather_view(new_cache['v'], view_pt)
         if 'ks' in new_cache:
@@ -600,8 +638,8 @@ def _block(cfg: TransformerConfig, x, lp, positions, mask,
         if kq:
             k_scale, v_scale = new_cache['ks'], new_cache['vs']
 
-    if full_cache is not None:
-        pass  # attn already computed by the decode kernel above
+    if full_cache is not None or ragged_paged is not None:
+        pass  # attn already computed by the Pallas kernel above
     elif shared_kv is not None:
         attn = _attention_shared(q, k, v, shared_kv['k'], shared_kv['v'],
                                  mask)
@@ -668,9 +706,82 @@ def _mesh_size() -> int:
     return mesh.size if mesh is not None else 1
 
 
+def ragged_kernel_active(cfg: TransformerConfig, k_dtype) -> bool:
+    """Would `paged_step(..., ragged_kernel=True)` route attention
+    through the ragged paged kernel (vs the gather fallback)?
+
+    The continuous engine applies this host-side — under its mesh
+    context — to report and cost the active KV-read path
+    (`kv_read_path` in `continuous_plan()` / the timeline engine
+    record), and `paged_step` applies the identical predicate at trace
+    time, so the report can never drift from the dispatch.  Fallback
+    matrix: ALiBi, int4-KV pools, non-lane-aligned head_dim on a real
+    TPU, and meshes whose model axis does not divide the head counts
+    (or that shard anything besides 'model') all keep the gather."""
+    from .ragged_paged_attention import supported
+    if not supported(cfg.positional, cfg.head_dim, cfg.num_heads,
+                     cfg.num_kv_heads, k_dtype, interpret=not _on_tpu()):
+        return False
+    mesh = current_mesh()
+    if mesh is None:
+        return True
+    n_model = int(mesh.shape.get('model', 1))
+    if n_model == 1:
+        return mesh.size == 1
+    # head-sharded shard_map invocation: each model shard must own a
+    # whole number of KV heads, and no other axis may shard the call
+    # (batch stays replicated inside the shard_map island)
+    return (mesh.size == n_model
+            and cfg.num_kv_heads % n_model == 0
+            and cfg.num_heads % n_model == 0)
+
+
+def _ragged_attention(cfg, q, pool, view_pt, start, t_valid, li):
+    """Invoke the ragged paged kernel on the full pool; under a
+    tensor-parallel mesh the call is head-sharded via shard_map (GSPMD
+    cannot partition a pallas_call): every model shard runs the kernel
+    over its own KV heads with the page table replicated."""
+    from .ragged_paged_attention import ragged_paged_attention
+    scale = cfg.head_dim ** -0.5
+    interpret = not _on_tpu()
+    mesh = current_mesh()
+    n_model = int(mesh.shape.get('model', 1)) if mesh is not None else 1
+    if n_model <= 1:
+        return ragged_paged_attention(
+            q, pool['k'], pool['v'], view_pt, start, t_valid, scale, li,
+            pool_ks=pool.get('ks'), pool_vs=pool.get('vs'),
+            interpret=interpret)
+    from opencompass_tpu.parallel.mesh import manual_axes
+    quant = 'ks' in pool
+
+    def local(li_, qx, pt, st, tv, kx, vx, *scales):
+        ksx, vsx = scales if scales else (None, None)
+        with manual_axes():
+            return ragged_paged_attention(qx, kx, vx, pt, st, tv, scale,
+                                          li_, pool_ks=ksx, pool_vs=vsx,
+                                          interpret=interpret)
+
+    in_specs = [P(), P(None, None, 'model', None), P(None, None),
+                P(None), P(None),
+                P(None, None, 'model', None, None),
+                P(None, None, 'model', None, None)]
+    args = [jnp.reshape(li, ()).astype(jnp.int32), q, view_pt, start,
+            t_valid, pool['k'], pool['v']]
+    if quant:
+        in_specs += [P(None, None, 'model', None)] * 2
+        args += [pool['ks'], pool['vs']]
+    shard_map = getattr(jax, 'shard_map', None)
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map
+    fn = shard_map(local, mesh=mesh, in_specs=tuple(in_specs),
+                   out_specs=P(None, None, 'model', None),
+                   check_rep=False)
+    return fn(*args)
+
+
 def _stack(cfg: TransformerConfig, x, layers, positions, mask,
            cache=None, cache_index=None, attn_fn=None, kv_positions=None,
-           tp_axis=None, shared_kv=None, paged=None):
+           tp_axis=None, shared_kv=None, paged=None, ragged=None):
     """Run the block stack via lax.scan over stacked layer params."""
     def block(cfg, *args, **kw):
         return _block(cfg, *args, attn_fn=attn_fn,
@@ -714,6 +825,34 @@ def _stack(cfg: TransformerConfig, x, layers, positions, mask,
         # as the dense cache below — each step scatters only this step's
         # token slots into the per-layer pool slice
         page_rows, offsets, view_pt = paged
+
+        if ragged is not None:
+            # ragged-kernel path: the FULL pool rides the carry and
+            # both the scatter and the kernel read index it at the
+            # traced layer — per-layer pool slices never exist (a
+            # custom call can't consume a dynamic_slice without XLA
+            # materializing it; see decode_attention_stacked)
+            pg_start, pg_valid = ragged
+
+            def rstep(carry, layer_and_index):
+                h, pool_full = carry
+                lp, li = layer_and_index
+                h, pool_full = block(
+                    cfg, h, lp, positions, mask,
+                    ragged_paged=(pool_full, li, page_rows, offsets,
+                                  view_pt, pg_start, pg_valid))
+                return (h, pool_full), None
+            if cfg.scan_layers:
+                (x, new_pool), _ = jax.lax.scan(
+                    rstep, (x, cache),
+                    (layers, jnp.arange(cfg.num_layers)))
+            else:
+                new_pool = cache
+                for i in range(cfg.num_layers):
+                    lp = jax.tree_util.tree_map(lambda a: a[i], layers)
+                    (x, new_pool), _ = rstep((x, new_pool),
+                                             (lp, jnp.asarray(i)))
+            return x, new_pool
 
         def step(carry, layer_and_index):
             h, pool_full = carry
@@ -1060,7 +1199,8 @@ def broadcast_cache(cache: Dict, batch: int) -> Dict:
 
 def paged_step(params: Params, cfg: TransformerConfig, tokens: jax.Array,
                start: jax.Array, n_new: jax.Array,
-               page_table: jax.Array, pool: Dict, page_size: int
+               page_table: jax.Array, pool: Dict, page_size: int,
+               ragged_kernel: bool = False
                ) -> Tuple[jax.Array, Dict]:
     """One continuous-batching step over a fixed slot set with ragged
     lengths (paged KV — nn/paged_kv.py).
@@ -1077,6 +1217,13 @@ def paged_step(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     slot's attention spans only its own gathered pages, so one compiled
     (slots, T) shape serves every mix of in-flight lengths.  Returns
     (last-real-position logits (slots, V), pool).
+
+    ``ragged_kernel=True`` asks for the Pallas ragged-paged-attention
+    read path (attention computed in place over the pool pages — no
+    contiguous per-slot gather); it applies only where
+    `ragged_kernel_active` says the kernel covers this config, so the
+    flag is a knob, not a footgun — unsupported configs silently keep
+    the gather fallback.
     """
     if cfg.prefix_lm or cfg.positional == 'alibi':
         raise NotImplementedError('paged decode supports neither '
@@ -1095,8 +1242,11 @@ def paged_step(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     # garbage — both beyond this bound)
     mask = jnp.arange(S)[None, None, :] <= positions[:, :, None]
     x = _embed(params, cfg, tokens, positions)
+    use_ragged = bool(ragged_kernel) and ragged_kernel_active(
+        cfg, pool['k'].dtype)
     x, pool = _stack(cfg, x, params['layers'], positions, mask,
-                     cache=pool, paged=(page_rows, offsets, page_table))
+                     cache=pool, paged=(page_rows, offsets, page_table),
+                     ragged=(start, n_new) if use_ragged else None)
     last = jnp.maximum(n_new - 1, 0)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)
     logits = _unembed(params, cfg, x_last)[:, 0, :]

@@ -87,7 +87,9 @@ def model_expectation(model, kind: str, shape,
     - **Engine head is per-slot.**  ``prefill_chunk``/``decode``
       executables project logits only at the last position of each
       slot (``B`` tokens through the LM head); ``ppl``/``choice``
-      project every position (``B*S`` tokens).
+      project every position (``B*S`` tokens).  The ``mixed`` engine
+      executable fuses both sub-steps behind ``lax.cond`` — XLA counts
+      every called branch, so its expectation is their sum.
     - **Per-device modules.**  ``cost_analysis`` describes the program
       one device runs: the scoring executables shard their batch over
       the ``data`` mesh axis, so the expectation divides ``B`` by the
@@ -121,13 +123,21 @@ def model_expectation(model, kind: str, shape,
         tokens = b * s
         pairs = tokens * s
         head_tokens = tokens
-    elif kind in ('prefill_chunk', 'decode'):
+    elif kind in ('prefill_chunk', 'decode', 'mixed'):
         width = int((extra or {}).get('attn_width') or 0)
         if not width:
             return None
-        tokens = b * s
+        if kind == 'mixed':
+            # one executable holds BOTH `lax.cond` sub-steps (the
+            # page-wide prefill chunk, T = s-1, plus the 1-wide
+            # decode); XLA's cost analysis counts every called branch
+            # computation, so the expectation sums the two sub-steps
+            tokens = b * (s - 1) + b
+            head_tokens = 2 * b
+        else:
+            tokens = b * s
+            head_tokens = b
         pairs = tokens * width
-        head_tokens = b
     else:
         return None
     head_params = float(cfg.vocab_size * cfg.hidden_size)
@@ -269,6 +279,9 @@ class CompileAudit:
         width = int((extra or {}).get('attn_width') or 0)
         if width:
             rec['attn_width'] = width
+        kv_path = (extra or {}).get('kv_read_path')
+        if kv_path:
+            rec['kv_read_path'] = kv_path
         analyzed = (not hit and fn is not None and args is not None
                     and os.environ.get(ENV_AUDIT, '1') not in
                     ('0', 'false'))

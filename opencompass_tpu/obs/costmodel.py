@@ -24,9 +24,15 @@ that time ran.  Following "Efficiently Scaling Transformer Inference"
   variants, because the *implementation* determines the traffic:
 
   - ``ideal``: each step reads only the positions the resident rows
-    actually hold (exact ragged lengths, each position once) — what a
-    Pallas ragged-paged-attention kernel would move;
-  - ``paged_gather``: the current engine's XLA gather materializes
+    actually hold (exact ragged lengths, each position once) — the
+    floor every other variant is measured against;
+  - ``ragged_kernel``: the Pallas ragged-paged-attention read path —
+    page-granular: per executed sub-batch each slot fetches
+    ``ceil(extent / page) * page`` positions (inactive slots one
+    clamped page), so actual traffic sits within one page-rounding of
+    ideal (the engine counts this exactly as
+    ``page_read_positions``);
+  - ``paged_gather``: the engine's XLA-gather fallback materializes
     every slot's full table width every step
     (``slots * max_pages * page_size`` positions), so traffic matches
     a dense cache even though *capacity* is paged — the
@@ -360,18 +366,31 @@ class CostModel:
                     prefill_steps: int, decode_steps: int, slots: int,
                     table_positions: float,
                     kv_positions: Optional[float] = None,
-                    attn_positions: Optional[float] = None) -> Cost:
+                    attn_positions: Optional[float] = None,
+                    kv_read_path: str = 'gather_fallback',
+                    page_read_positions: Optional[float] = None
+                    ) -> Cost:
         """One continuous-engine drain: exact step counts from the
-        engine's counters.  Every step (prefill chunk or decode)
-        streams the weights once and gathers ``slots *
-        table_positions`` KV positions (``table_positions`` =
-        ``max_pages * page_size`` — the XLA gather materializes the
-        full table width for every slot, active or not: the
-        paged-gather traffic).  ``kv_positions`` is the exact ideal
-        HBM read count (the engine sums active rows' current KV
-        lengths per step); ``attn_positions`` the exact attended
-        (query, key) pairs for the attention FLOPs.  Both fall back to
-        equal-length approximations."""
+        engine's counters.  Every executed sub-batch (prefill chunk or
+        decode) streams the weights once; its KV read traffic depends
+        on ``kv_read_path``:
+
+        - ``'gather_fallback'`` (default): the XLA gather materializes
+          ``slots * table_positions`` positions per step
+          (``table_positions`` = ``max_pages * page_size`` — the full
+          table width for every slot, active or not);
+        - ``'ragged_kernel'``: the Pallas kernel reads pool pages in
+          place — ``page_read_positions`` (the engine's exact
+          page-granular counter: per sub-batch each slot fetches
+          ``ceil(extent / page)`` pages, inactive slots one clamped
+          page) replaces the gather term, so MBU and ``kv_ratio``
+          report the kernel's real traffic instead of the fallback's.
+
+        ``kv_positions`` is the exact ideal HBM read count (the engine
+        sums active rows' current KV lengths per step);
+        ``attn_positions`` the exact attended (query, key) pairs for
+        the attention FLOPs.  Both fall back to equal-length
+        approximations."""
         steps = int(prefill_steps) + int(decode_steps)
         if attn_positions is None:
             attn_positions = (causal_token_kv(prefill_tokens, slots)
@@ -380,14 +399,18 @@ class CostModel:
         if kv_positions is None:
             kv_positions = float(prefill_tokens) + decode_token_kv(
                 prefill_tokens, decode_tokens, slots)
-        gather = steps * int(slots) * float(table_positions)
+        if kv_read_path == 'ragged_kernel' \
+                and page_read_positions is not None:
+            read_positions = float(page_read_positions)
+        else:
+            read_positions = steps * int(slots) * float(table_positions)
         writes = kv_write_bytes(self.cfg,
                                 prefill_tokens + decode_tokens)
         return Cost(
             flops=flops_matmul(self.cfg, prefill_tokens + decode_tokens)
             + flops_attention(self.cfg, attn_positions),
             bytes_w=self.weight_bytes * steps,
-            bytes_kv=writes + kv_read_bytes(self.cfg, gather),
+            bytes_kv=writes + kv_read_bytes(self.cfg, read_positions),
             bytes_kv_ideal=writes + kv_read_bytes(self.cfg,
                                                   kv_positions))
 

@@ -373,8 +373,13 @@ class StepProfiler:
         self._seen: Dict[str, int] = {}
         # guarded-by: _lock
         self._captured = 0
-        # accumulated device seconds per op category  # guarded-by: _lock
-        self._category_s: Dict[str, float] = {}
+        # accumulated device seconds per op category, split PER STEP
+        # KIND: {kind: {category: seconds}}.  The split is what keeps
+        # `gather_share_measured` honest — folding a prefill chunk's
+        # matmul-heavy wall into the same pool as decode steps dilutes
+        # the decode gather share (the measured-vs-modeled mismatch
+        # BENCH_DEVPROF.json used to record).  # guarded-by: _lock
+        self._category_s: Dict[str, Dict[str, float]] = {}
 
     @contextmanager
     def maybe_trace(self, kind: str):
@@ -412,39 +417,71 @@ class StepProfiler:
                     cats = parse_trace_dir(trace_dir)
                     if cats:
                         with self._lock:
+                            pool = self._category_s.setdefault(kind, {})
                             for cat, secs in cats.items():
-                                self._category_s[cat] = \
-                                    self._category_s.get(cat, 0.0) + secs
+                                pool[cat] = pool.get(cat, 0.0) + secs
                 except Exception:
                     pass
 
+    # step kinds whose wall carries the paged-KV read every step — the
+    # denominator of the measured gather share.  'mixed' is the
+    # single-shape engine step (decode sub-batch every step); prefill
+    # chunks and dense-path batches are matmul-dominated and would
+    # dilute the share if pooled in.
+    DECODE_KINDS = ('decode', 'mixed')
+
     def fields(self) -> Dict:
         """Fold of all captures so far: sampled-step count, per-category
-        device seconds, and the measured gather share of sampled wall."""
+        device seconds (overall and per step kind), and the measured
+        gather share of the DECODE-bearing kinds' sampled wall."""
         with self._lock:
-            cats = dict(self._category_s)
+            by_kind = {kind: dict(cats)
+                       for kind, cats in self._category_s.items()}
             captured = self._captured
         if not captured:
             return {}
         out: Dict = {'profiled_steps': captured}
-        total = sum(cats.values())
-        if total > 0:
+        merged: Dict[str, float] = {}
+        for cats in by_kind.values():
+            for cat, secs in cats.items():
+                merged[cat] = merged.get(cat, 0.0) + secs
+        if sum(merged.values()) > 0:
             out['profile_categories'] = {
-                cat: round(secs, 6) for cat, secs in sorted(cats.items())}
+                cat: round(secs, 6)
+                for cat, secs in sorted(merged.items())}
+            out['profile_categories_by_kind'] = {
+                kind: {cat: round(secs, 6)
+                       for cat, secs in sorted(cats.items())}
+                for kind, cats in sorted(by_kind.items())}
+        dec: Dict[str, float] = {}
+        for kind in self.DECODE_KINDS:
+            for cat, secs in by_kind.get(kind, {}).items():
+                dec[cat] = dec.get(cat, 0.0) + secs
+        total = sum(dec.values())
+        if total > 0:
             out['gather_share_measured'] = round(
-                cats.get('gather', 0.0) / total, 4)
+                dec.get('gather', 0.0) / total, 4)
         return out
 
 
-def modeled_gather_share(costmodel, slots: int,
-                         table_positions: int) -> float:
+def modeled_gather_share(costmodel, slots: int, table_positions: int,
+                         kv_read_path: str = 'gather_fallback') -> float:
     """Memory-bound analytic share of one decode step's HBM traffic
     spent on the paged-KV gather: every slot reads its full table width
-    of KV bytes against the step's weight read + KV append."""
+    of KV bytes — across ALL layers (``kv_token_bytes`` is per layer;
+    the weight stream it competes with already spans the depth) —
+    against the step's weight read + KV append.  0.0 on the
+    ragged-kernel read path: the kernel reads pool pages in place, so
+    there is no gather op to attribute wall to."""
     try:
-        kv_read = float(costmodel.kv_token_bytes) * float(slots) \
-            * float(table_positions)
-        kv_write = float(costmodel.kv_token_bytes) * float(slots)
+        if kv_read_path == 'ragged_kernel':
+            return 0.0
+        layers = float(getattr(getattr(costmodel, 'cfg', None),
+                               'num_layers', 1) or 1)
+        kv_read = layers * float(costmodel.kv_token_bytes) \
+            * float(slots) * float(table_positions)
+        kv_write = layers * float(costmodel.kv_token_bytes) \
+            * float(slots)
         weights = float(costmodel.weight_bytes)
         total = kv_read + kv_write + weights
         return round(kv_read / total, 4) if total > 0 else 0.0

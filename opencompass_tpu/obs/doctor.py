@@ -37,8 +37,11 @@ never to a crash):
                          stalls on the paged KV pool.
 - ``prefill_stall``      (warn)  decode-ready slots idled by prefill
                          chunks (per-step engine records).
-- ``gather_waste``       (info)  paged-gather KV read traffic far over
-                         the ragged ideal (``kv_ratio``).
+- ``gather_waste``       (info)  KV read traffic far over the ragged
+                         ideal (``kv_ratio``) — path-aware: silent
+                         when the ragged-kernel read path holds the
+                         ratio near 1, distinct advice when the
+                         kernel path itself runs high.
 - ``dead_run``           (info)  a 'running' run marker whose driver
                          pid is gone.
 - ``queue_backlog``      (warn)  queued sweeps aging past bounds.
@@ -403,28 +406,53 @@ def _rule_prefill_stall(art: Dict) -> List[Dict]:
         'prefill chunks are stalling decode slots '
         '(head-of-line blocking in the continuous engine)',
         evidence,
-        fix='mixed prefill+decode steps (ROADMAP item 1) reclaim these '
-            'slot-steps; until then, smaller kv_page_size prefill '
-            'chunks shorten each stall')]
+        fix='the mixed prefill+decode engine step reclaims these '
+            'slot-steps (stall is 0 by construction there) — this '
+            'engine is running the legacy two-shape step: drop '
+            'mixed_step=False, or shrink kv_page_size to shorten '
+            'each stall')]
 
 
 def _rule_gather_waste(art: Dict) -> List[Dict]:
-    out = []
+    gather, kernel = [], []
     for task, s in (art.get('timelines') or {}).items():
         ratio = s.get('kv_ratio')
-        if ratio is not None and ratio > GATHER_WASTE_RATIO:
-            out.append((task, ratio))
-    if not out:
-        return []
-    evidence = [f'{task}: KV read traffic {ratio:.1f}x the ragged '
-                'ideal' for task, ratio in out[:5]]
-    return [_finding(
-        'info', 'gather_waste',
-        'paged-gather KV reads run far over the ragged-attention ideal',
-        evidence,
-        fix='expected until the Pallas ragged-paged-attention kernel '
-            'lands (ROADMAP item 1); the ratio is the measured payoff '
-            'waiting there')]
+        if ratio is None or ratio <= GATHER_WASTE_RATIO:
+            # a kernel-path engine with kv_ratio near 1 is the healthy
+            # end state — no finding
+            continue
+        if s.get('kv_read_path') == 'ragged_kernel':
+            kernel.append((task, ratio))
+        else:
+            gather.append((task, ratio,
+                           s.get('kv_read_path') or 'gather_fallback'))
+    findings = []
+    if gather:
+        evidence = [f'{task}: KV read traffic {ratio:.1f}x the ragged '
+                    f'ideal (kv_read_path={path})'
+                    for task, ratio, path in gather[:5]]
+        findings.append(_finding(
+            'info', 'gather_waste',
+            'gather-fallback KV reads run far over the '
+            'ragged-attention ideal',
+            evidence,
+            fix='switch the engine to the ragged-paged-attention '
+                'kernel path (JaxLM ragged_kernel knob; `cli plan` '
+                'shows the active kv_read_path and why a config falls '
+                'back) — docs/performance.md "Ragged paged attention"'))
+    if kernel:
+        evidence = [f'{task}: KV read traffic {ratio:.1f}x the ragged '
+                    'ideal despite the kernel path'
+                    for task, ratio in kernel[:5]]
+        findings.append(_finding(
+            'info', 'gather_waste',
+            'KV read traffic is high even on the ragged-kernel path',
+            evidence,
+            fix='the kernel reads whole pages: a ratio this size means '
+                'page rounding dominates (rows much shorter than '
+                'kv_page_size) — shrink kv_page_size or pack longer '
+                'rows per slot'))
+    return findings
 
 
 def _rule_slo_breach(art: Dict) -> List[Dict]:

@@ -333,6 +333,15 @@ def summarize_records(records: List[Dict]) -> Dict:
             if total else None
     gs_sources = {r.get('gather_share_source') for r, _ in costed
                   if r.get('gather_share_source')}
+    # engine KV-read path (ragged_kernel vs gather_fallback): one
+    # label when every drain agrees — what doctor's gather_waste rule
+    # keys on to stop blaming the gather once the kernel is active
+    kv_paths = {r.get('kv_read_path') for r in engines
+                if r.get('kv_read_path')}
+    kv_read_path = None
+    if kv_paths:
+        kv_read_path = (sorted(kv_paths)[0] if len(kv_paths) == 1
+                        else 'mixed')
     return {
         'batches': len(batches),
         'plans': len(plans),
@@ -383,6 +392,7 @@ def summarize_records(records: List[Dict]) -> Dict:
         'bytes_kv_ideal': int(bytes_kv_ideal) or None,
         'kv_ratio': round(bytes_kv / bytes_kv_ideal, 3)
         if bytes_kv_ideal else None,
+        'kv_read_path': kv_read_path,
         'mfu': mfu,
         'mbu': mbu,
         'gather_share': gather_share,

@@ -192,10 +192,13 @@ def _probe_cache(model, dataset_cfg, preview: Dict,
         return None
     cont = preview.get('continuous')
     if cont:
-        # the continuous engine dispatches exactly two shapes,
-        # whatever the length census says
-        keys = [f"decode:{cont['decode_shape']}",
-                f"prefill_chunk:{cont['prefill_shape']}"]
+        # the continuous engine dispatches ONE mixed shape (or, legacy
+        # mixed_step=False, two), whatever the length census says
+        if cont.get('mixed_step', True):
+            keys = [f"mixed:{cont['mixed_shape']}"]
+        else:
+            keys = [f"decode:{cont['decode_shape']}",
+                    f"prefill_chunk:{cont['prefill_shape']}"]
     else:
         keys = [f'{kind}:{k}'
                 for k in preview.get('planned', {}).get('shapes', {})]
@@ -293,12 +296,19 @@ def main(argv: Optional[List[str]] = None) -> int:
               'above does not apply to gen decode):')
         for r in cont_rows:
             c = r['continuous']
+            if c.get('mixed_step', True):
+                shapes_txt = (f"mixed {c['mixed_shape']} (prefill "
+                              f"{c['prefill_shape']} + decode "
+                              f"{c['decode_shape']} fused, 1 total)")
+            else:
+                shapes_txt = (f"decode {c['decode_shape']}, "
+                              f"prefill {c['prefill_shape']} (2 total)")
             print(f"  {r['model']}/{r['dataset']}: {c['slots']} slots, "
                   f"page {c['page_size']}, pool {c['pool_pages']} pages; "
                   f"expected in-flight {c['expected_in_flight']}"
                   f"/{c['slots']}, ~{c['est_pages_per_row']} pages/row; "
-                  f"compile shapes: decode {c['decode_shape']}, "
-                  f"prefill {c['prefill_shape']} (2 total)")
+                  f"compile shapes: {shapes_txt}; "
+                  f"kv read: {c.get('kv_read_path', 'gather_fallback')}")
     pref_rows = [r for r in results if r.get('prefix')]
     if pref_rows:
         print('\nshared-prefix census (token-level common prefix across '

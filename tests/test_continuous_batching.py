@@ -65,9 +65,14 @@ def test_engine_token_identical_to_fixed_shape():
     assert engine.alloc.n_allocated == 0        # no page leaks
     assert engine.stats()['retired'] == len(prompts)
     assert 0.0 < engine.slot_util <= 1.0
-    # exactly two compiled shapes, one of them the decode (slots, 1)
+    # ONE compiled shape: the mixed prefill+decode step (T = page + 1
+    # encodes the fused page-wide prefill chunk + 1-wide decode)
     shapes = sorted(k[:2] for k in lm_cont._dispatched_keys)
-    assert shapes == [('decode', (3, 1)), ('prefill_chunk', (3, 16))]
+    assert shapes == [('mixed', (3, 17))]
+    # the mixed step never stalls decode rows behind a prefill dispatch
+    assert engine.stats()['stall_slot_steps'] == 0
+    assert engine.stats()['kv_read_path'] in ('gather_fallback',
+                                              'ragged_kernel')
 
 
 def test_engine_interactive_rows_join_mid_drain():
@@ -115,12 +120,66 @@ def test_engine_interactive_rows_join_mid_drain():
     assert engine.alloc.n_allocated == 0
 
 
-def test_engine_warm_precompiles_both_shapes():
+def test_engine_warm_precompiles_single_mixed_shape():
     lm = JaxLM(config='tiny', max_seq_len=256, continuous_batching=True,
                decode_slots=2, kv_page_size=16)
-    assert lm.continuous_engine().warm() == 2
+    assert lm.continuous_engine().warm() == 1
     assert lm.continuous_engine().warm() == 0   # idempotent
+    assert lm.perf.first_calls == 1
+
+
+def test_engine_warm_legacy_two_shape_precompiles_both():
+    lm = JaxLM(config='tiny', max_seq_len=256, continuous_batching=True,
+               decode_slots=2, kv_page_size=16, mixed_step=False)
+    assert lm.continuous_engine().warm() == 2
+    assert lm.continuous_engine().warm() == 0
     assert lm.perf.first_calls == 2
+
+
+def test_mixed_step_eliminates_prefill_stall():
+    """Stall regression pin, both sides: on a skewed workload where
+    long prompts join mid-decode, the legacy two-shape engine idles
+    decode-ready rows behind every prefill dispatch
+    (stall_slot_steps > 0), the mixed step reclaims all of them
+    (== 0 by construction) — and both emit identical tokens."""
+    prompts = (['short one', 'also short', 'tiny']
+               + ['a much longer prompt with many words ' * 6]
+               + ['short again', 'brief'])
+    out, stalls = {}, {}
+    for name, mixed in (('mixed', True), ('legacy', False)):
+        lm = JaxLM(config='tiny', max_seq_len=256,
+                   continuous_batching=True, decode_slots=3,
+                   kv_page_size=16, mixed_step=mixed)
+        out[name] = lm.generate_continuous(prompts, 10)
+        stats = lm.continuous_engine().stats()
+        stalls[name] = stats['stall_slot_steps']
+        assert stats['mixed_step'] is mixed
+    assert out['mixed'] == out['legacy']
+    assert stalls['legacy'] > 0, 'workload no longer skewed enough to ' \
+        'stall the legacy engine — the regression pin lost its teeth'
+    assert stalls['mixed'] == 0
+
+
+@pytest.mark.parametrize('quantize', ['w8a8-kv8', 'w8a8-kv4'])
+def test_engine_quantized_kv_token_identical_to_fixed_shape(quantize):
+    """int8-KV and int4-KV pools ride the continuous engine (int4
+    eligibility landed with the ragged-kernel PR): greedy tokens —
+    early-EOS rows included — match the dense fixed-shape path running
+    the same quantized config exactly."""
+    kw = dict(config='tiny', max_seq_len=256, quantize=quantize)
+    lm_fixed = JaxLM(**kw)
+    lm_cont = JaxLM(continuous_batching=True, decode_slots=3,
+                    kv_page_size=16, **kw)
+    assert lm_cont.continuous_eligible and lm_cont.continuous_active
+    prompts = ['the quick brown fox', 'hello',
+               'pack my box with five dozen liquor jugs and words',
+               'a b c d', 'short one']
+    ref = lm_fixed.generate(prompts, max_out_len=8)
+    got = lm_cont.generate_continuous(prompts, 8)
+    assert got == ref
+    engine = lm_cont.continuous_engine()
+    assert engine.alloc.n_allocated == 0
+    assert engine.stats()['stall_slot_steps'] == 0
 
 
 def test_continuous_plan_reports_geometry():
@@ -129,7 +188,14 @@ def test_continuous_plan_reports_geometry():
     plan = lm.continuous_plan()
     assert plan == {'slots': 4, 'page_size': 64, 'pool_pages': 17,
                     'max_pages_per_seq': 4, 'decode_shape': '4x1',
-                    'prefill_shape': '4x64', 'compile_shapes': 2}
+                    'prefill_shape': '4x64', 'mixed_step': True,
+                    'compile_shapes': 1, 'mixed_shape': '4x65',
+                    'kv_read_path': 'gather_fallback'}
+    legacy = JaxLM(config='tiny', max_seq_len=256, tokenizer_only=True,
+                   continuous_batching=True, decode_slots=4,
+                   kv_page_size=64, mixed_step=False).continuous_plan()
+    assert legacy['compile_shapes'] == 2
+    assert legacy['mixed_step'] is False and 'mixed_shape' not in legacy
     assert JaxLM(config='tiny', tokenizer_only=True).continuous_plan() \
         is None
 
@@ -160,14 +226,19 @@ def test_cli_plan_reports_engine_geometry(tmp_path):
     cont = gen_tasks[0]['continuous']
     assert cont['decode_shape'] == '4x1'
     assert cont['prefill_shape'] == '4x32'
+    assert cont['mixed_shape'] == '4x33'
+    assert cont['compile_shapes'] == 1
+    assert cont['kv_read_path'] in ('gather_fallback', 'ragged_kernel')
     assert cont['expected_in_flight'] <= 4
     assert cont['est_pages_per_row'] >= 1
-    # human rendering names the engine section
+    # human rendering names the engine section and the fused shape
     buf = io.StringIO()
     with redirect_stdout(buf):
         plan_main([cfg_path])
     assert 'continuous batching' in buf.getvalue()
-    assert 'decode 4x1' in buf.getvalue()
+    assert 'mixed 4x33' in buf.getvalue()
+    assert 'decode 4x1 fused, 1 total' in buf.getvalue()
+    assert 'kv read:' in buf.getvalue()
 
 
 # -- gen inferencer wiring ---------------------------------------------------
@@ -350,7 +421,7 @@ def test_per_row_heartbeat_and_engine_timeline(tmp_path):
     assert 'plan' in kinds and 'engine' in kinds
     plan = next(r for r in records if r['t'] == 'plan')
     assert plan['stats'].get('continuous') is True
-    assert plan['stats'].get('n_shapes') == 2
+    assert plan['stats'].get('n_shapes') == 1
     engines = [r for r in records if r['t'] == 'engine']
     assert len(engines) == 2
     eng, eng2 = engines

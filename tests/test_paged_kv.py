@@ -195,11 +195,13 @@ def _drive_paged(params, cfg, prompts, max_new, page, slots,
     return [st['out'] for st in state]
 
 
-@pytest.mark.parametrize('kv_quant', [False, 'int8'])
+@pytest.mark.parametrize('kv_quant', [False, 'int8', 'int4'])
 def test_paged_decode_token_identical_to_dense(kv_quant):
     """The paged step emits the same greedy tokens as the dense
     while_loop path — ragged lengths, mid-page boundaries and all —
-    for both bf16/f32 and int8-quantized KV caches."""
+    for bf16/f32 and int8/int4-quantized KV caches (both paths
+    per-vector-quantize the SAME written vectors, so the noise is
+    identical on each side and greedy argmax still agrees)."""
     import jax
     import jax.numpy as jnp
     from opencompass_tpu.nn import (TransformerConfig, greedy_generate,

@@ -163,31 +163,76 @@ def test_w8a8_ppl_ranking_agrees_with_bf16():
     np.testing.assert_allclose(nll_q, nll_fp, rtol=0.08)
 
 
-@pytest.mark.xfail(
-    strict=False,
-    reason='int4 per-vector RTN KV is inherently too coarse for greedy '
-    'argmax on a RANDOM tiny model: measured prefill logit error is '
-    '~18% of the logit scale (vs 0.6% for int8 KV), while the fp '
-    "model's top-2 argmax margins are only 2-7% — so the first decode "
-    'token flips about half the time and autoregression diverges from '
-    'there (token agreement measured 0.125-0.44 across seeds 7/1/2/3; '
-    'int8 KV agrees 1.0 on the same pool).  Widening the int4 grid '
-    '(amax/7.5 into [-8,7]) measured WORSE (19.9% logit error), i.e. '
-    'this is quantization noise, not a dequant-path bug.  Real-model '
-    'int4-KV accuracy is gated by tools/quant_agreement.py '
-    '(QUANT_AGREEMENT_7B_W4A8.json) where pretrained logit margins '
-    'dwarf the noise.')
-def test_int4_kv_greedy_generate_runs_and_tracks():
+def test_int4_kv_decode_logit_envelope():
+    """Retired xfail (the blanket token-agreement mark): int4
+    per-vector RTN KV is inherently too coarse for greedy argmax on a
+    RANDOM tiny model — measured ~18% prefill logit error against
+    2-7% fp argmax margins, so token agreement vs the fp path is
+    quantization noise, not a testable contract (real-model accuracy
+    is gated by tools/quant_agreement.py).  What DOES hold strictly —
+    and what the engine's int4-KV eligibility rests on — is a logit
+    ERROR ENVELOPE on the decode path: driving the paged engine step
+    (the continuous engine's kernel) teacher-forced over a prefill
+    chunk plus decode steps, every int4-KV step's logits stay within
+    the measured envelope (~18%, bound 0.3 with slack) of the fp
+    path's."""
+    from opencompass_tpu.nn.paged_kv import (PageAllocator, PageTable,
+                                             init_page_pool,
+                                             pages_per_seq)
+    from opencompass_tpu.nn.transformer import paged_step
     cfgq = dataclasses.replace(CFG, kv_quant='int4')
     params = init_params(CFG, jax.random.PRNGKey(0))
-    tokens, mask = _data(B=2, S=8)
-    out_fp, _ = jax.jit(lambda p, t, m: greedy_generate(p, CFG, t, m, 8))(
-        params, tokens, mask)
-    out_q, _ = jax.jit(lambda p, t, m: greedy_generate(p, cfgq, t, m, 8))(
-        params, tokens, mask)
-    assert out_q.shape == (2, 8)
-    agree = (np.asarray(out_fp) == np.asarray(out_q)).mean()
-    assert agree >= 0.4, f'int4 KV diverged too much: agree={agree}'
+    page, max_new = 8, 6
+    rng = np.random.RandomState(7)
+    prompts = [list(rng.randint(1, CFG.vocab_size, n)) for n in (6, 11)]
+    mp = pages_per_seq(max(len(p) for p in prompts) + max_new, page)
+
+    def drive(cfg):
+        pool = init_page_pool(cfg, 1 + len(prompts) * mp, page)
+        alloc = PageAllocator(1 + len(prompts) * mp)
+        table = PageTable(len(prompts), mp)
+        kv = [0] * len(prompts)
+        for s, ids in enumerate(prompts):
+            table.assign(s, alloc.alloc(
+                pages_per_seq(len(ids) + max_new, page)))
+        step = jax.jit(lambda pr, pl, t, st, nn_, pt: paged_step(
+            pr, cfg, t, st, nn_, pt, pl, page))
+        out = []
+        # teacher-forced: both variants consume the SAME token stream
+        # (prompt then fixed probe tokens), isolating per-step logit
+        # error from autoregressive divergence
+        for turn in range(max(len(p) for p in prompts) // page + 1
+                          + max_new):
+            prefilling = any(kv[s] < len(p)
+                             for s, p in enumerate(prompts))
+            t = page if prefilling else 1
+            toks = np.zeros((len(prompts), t), np.int32)
+            start = np.zeros((len(prompts),), np.int32)
+            n_new = np.zeros((len(prompts),), np.int32)
+            for s, ids in enumerate(prompts):
+                if prefilling:
+                    if kv[s] < len(ids):
+                        chunk = ids[kv[s]:kv[s] + t]
+                        toks[s, :len(chunk)] = chunk
+                        start[s] = kv[s]
+                        n_new[s] = len(chunk)
+                else:
+                    toks[s, 0] = (s + 3 * turn) % CFG.vocab_size
+                    start[s] = kv[s]
+                    n_new[s] = 1
+            logits, pool = step(params, pool, jnp.asarray(toks),
+                                jnp.asarray(start), jnp.asarray(n_new),
+                                jnp.asarray(table.table))
+            out.append(np.asarray(logits))
+            for s in range(len(prompts)):
+                kv[s] += int(n_new[s])
+        return out
+
+    fp, q4 = drive(CFG), drive(cfgq)
+    assert len(fp) == len(q4) and len(fp) > 2
+    for step_fp, step_q in zip(fp, q4):
+        denom = np.maximum(np.abs(step_fp).max(), 1e-6)
+        assert np.abs(step_fp - step_q).max() / denom < 0.3
 
 
 def test_int4_kv_prefill_logits_bounded():

@@ -1,0 +1,195 @@
+"""Ragged paged attention (nn/ragged_paged_attention.py): the Pallas
+kernel that reads decode/prefill-chunk attention directly from the
+paged KV pool through the page table, replacing the
+O(slots * table_width) gather with page-granular reads.
+
+Pinned here, all deviceless (interpret mode runs the exact kernel
+semantics through the Pallas interpreter):
+
+- numerics vs the gather-path oracle (`paged_kv.gather_view` over the
+  pool == `dense_equivalent`), f32 and int8-quantized pools;
+- the `supported()` / `ragged_kernel_active` fallback matrix;
+- token identity end to end: the continuous engine with
+  ``ragged_kernel='on'`` emits exactly the dense fixed-shape path's
+  greedy tokens, fp and int8-KV, single-device and head-sharded under
+  a model-parallel mesh.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from opencompass_tpu.nn import ragged_paged_attention as rpa
+from opencompass_tpu.nn.paged_kv import GARBAGE_PAGE, gather_view
+
+L, P, K, page, hd = 2, 9, 2, 8, 16
+B, MP, G = 3, 3, 2
+H = K * G
+SCALE = hd ** -0.5
+
+
+def _pool(rng):
+    pool_k = jnp.asarray(rng.randn(L, P, K, page, hd).astype(np.float32))
+    pool_v = jnp.asarray(rng.randn(L, P, K, page, hd).astype(np.float32))
+    table = np.full((B, MP), GARBAGE_PAGE, np.int32)
+    table[0, :2] = [3, 5]
+    table[1, :1] = [7]
+    # row 2 stays inactive (all garbage pages)
+    return pool_k, pool_v, jnp.asarray(table)
+
+
+def _reference(q, pool_k_f32, pool_v_f32, table, start, layer):
+    """Gather-path semantics in numpy: contiguous per-slot view over
+    the FULL table width, causal mask at start+i — exactly what
+    `transformer.paged_step`'s fallback computes."""
+    kg = np.asarray(gather_view(pool_k_f32[layer], table))
+    vg = np.asarray(gather_view(pool_v_f32[layer], table))
+    T = q.shape[1]
+    S = MP * page
+    positions = np.asarray(start)[:, None] + np.arange(T)
+    mask = np.arange(S)[None, None, :] <= positions[:, :, None]
+    qg = np.asarray(q).reshape(B, T, K, G, hd)
+    s = np.einsum('btkgh,bksh->bkgts', qg, kg) * SCALE
+    s = np.where(mask[:, None, None], s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum('bkgts,bksh->btkgh', p, vg).reshape(B, T, H, hd)
+
+
+def test_kernel_matches_gather_oracle_decode_and_prefill():
+    rng = np.random.RandomState(0)
+    pool_k, pool_v, table = _pool(rng)
+    # decode: T=1, ragged starts, one inactive row
+    start = jnp.asarray([12, 4, 0], jnp.int32)
+    t_valid = jnp.asarray([1, 1, 0], jnp.int32)
+    q = jnp.asarray(rng.randn(B, 1, H, hd).astype(np.float32))
+    for layer in range(L):
+        out = np.asarray(rpa.ragged_paged_attention(
+            q, pool_k, pool_v, table, start, t_valid, SCALE,
+            jnp.asarray(layer), interpret=True))
+        ref = _reference(q, pool_k, pool_v, table, start, layer)
+        # active rows bit-tight; the inactive row's output is garbage
+        # the host ignores (same contract as the gather path)
+        assert np.abs(out[:2] - ref[:2]).max() < 2e-5
+    # prefill chunk: T=page, ragged n_new (row 0 mid-page, row 1 full)
+    start2 = jnp.asarray([8, 0, 0], jnp.int32)
+    t_valid2 = jnp.asarray([6, 8, 0], jnp.int32)
+    q2 = jnp.asarray(rng.randn(B, page, H, hd).astype(np.float32))
+    out = np.asarray(rpa.ragged_paged_attention(
+        q2, pool_k, pool_v, table, start2, t_valid2, SCALE,
+        jnp.asarray(0), interpret=True))
+    ref = _reference(q2, pool_k, pool_v, table, start2, 0)
+    assert np.abs(out[0, :6] - ref[0, :6]).max() < 2e-5
+    assert np.abs(out[1] - ref[1]).max() < 2e-5
+
+
+def test_kernel_int8_pool_matches_dequantized_oracle():
+    """int8 pages + per-vector scales: the kernel dequantizes ON the
+    VMEM tile with the same arithmetic as the gather path, so it must
+    match the dequantized-f32 oracle to f32 roundoff."""
+    rng = np.random.RandomState(1)
+    _, _, table = _pool(rng)
+    pk8 = jnp.asarray(
+        rng.randint(-127, 128, (L, P, K, page, hd)).astype(np.int8))
+    pv8 = jnp.asarray(
+        rng.randint(-127, 128, (L, P, K, page, hd)).astype(np.int8))
+    ks = jnp.asarray(rng.rand(L, P, K, page).astype(np.float32) + 0.01)
+    vs = jnp.asarray(rng.rand(L, P, K, page).astype(np.float32) + 0.01)
+    start = jnp.asarray([12, 4, 0], jnp.int32)
+    t_valid = jnp.asarray([1, 1, 0], jnp.int32)
+    q = jnp.asarray(rng.randn(B, 1, H, hd).astype(np.float32))
+    out = np.asarray(rpa.ragged_paged_attention(
+        q, pk8, pv8, table, start, t_valid, SCALE, jnp.asarray(0),
+        pool_ks=ks, pool_vs=vs, interpret=True))
+    k_deq = pk8.astype(jnp.float32) * ks[..., None]
+    v_deq = pv8.astype(jnp.float32) * vs[..., None]
+    ref = _reference(q, k_deq, v_deq, table, start, 0)
+    assert np.abs(out[:2] - ref[:2]).max() < 2e-5
+
+
+def test_supported_matrix():
+    ok = dict(cfg_positional='rope', head_dim=16, num_heads=4,
+              num_kv_heads=2, k_dtype=jnp.float32, interpret=True)
+    assert rpa.supported(**ok)
+    assert rpa.supported(**{**ok, 'k_dtype': jnp.int8})
+    assert rpa.supported(**{**ok, 'k_dtype': jnp.bfloat16})
+    # fallback matrix
+    assert not rpa.supported(**{**ok, 'cfg_positional': 'alibi'})
+    assert not rpa.supported(**{**ok, 'k_dtype': 'int4'})
+    assert not rpa.supported(**{**ok, 'num_heads': 3})
+    # off-TPU without interpret: never claims the kernel
+    assert not rpa.supported(**{**ok, 'interpret': False})
+
+
+def test_ragged_kernel_active_mesh_matrix():
+    """Host-side routing predicate: single-device and pure-model
+    meshes whose shards own whole KV heads take the kernel; data-
+    sharded meshes and non-dividing model axes keep the gather."""
+    from opencompass_tpu.nn import TransformerConfig
+    from opencompass_tpu.nn.transformer import ragged_kernel_active
+    from opencompass_tpu.parallel.mesh import (MeshSpec, make_mesh,
+                                               use_mesh)
+    cfg = TransformerConfig.tiny()     # H=4, K=2
+    assert ragged_kernel_active(cfg, jnp.float32)      # no mesh
+    devs = jax.devices()
+    with use_mesh(make_mesh(MeshSpec(data=1, model=2), devs[:2])):
+        assert ragged_kernel_active(cfg, jnp.float32)
+    with use_mesh(make_mesh(MeshSpec(data=2, model=1), devs[:2])):
+        assert not ragged_kernel_active(cfg, jnp.float32)  # data-sharded
+    with use_mesh(make_mesh(MeshSpec(data=1, model=4), devs[:4])):
+        assert not ragged_kernel_active(cfg, jnp.float32)  # 4 !| K=2
+    with use_mesh(make_mesh(MeshSpec(data=2, model=2), devs[:4])):
+        assert not ragged_kernel_active(cfg, jnp.float32)  # mixed axes
+    assert not ragged_kernel_active(cfg, 'int4')
+
+
+# -- end to end through the continuous engine --------------------------------
+
+PROMPTS = ['the quick brown fox', 'hello',
+           'pack my box with five dozen liquor jugs and words',
+           'a b c d', 'short one']
+
+
+@pytest.mark.parametrize('kv_quant', [False, 'int8'])
+def test_engine_kernel_path_token_identical(kv_quant):
+    """`ragged_kernel='on'` (interpret off-TPU) routes the engine's KV
+    read through the kernel — greedy tokens stay exactly the dense
+    path's, and the engine reports/costs the kernel path."""
+    from opencompass_tpu.models import JaxLM
+    cfg = {'preset': 'tiny', 'kv_quant': kv_quant}
+    lm_fixed = JaxLM(config=cfg, max_seq_len=256)
+    lm = JaxLM(config=cfg, max_seq_len=256, continuous_batching=True,
+               decode_slots=3, kv_page_size=16, ragged_kernel='on',
+               parallel={'data': 1})
+    assert lm.kv_read_path() == 'ragged_kernel'
+    ref = lm_fixed.generate(PROMPTS, max_out_len=8)
+    got = lm.generate_continuous(PROMPTS, 8)
+    assert got == ref
+    stats = lm.continuous_engine().stats()
+    assert stats['kv_read_path'] == 'ragged_kernel'
+    assert stats['stall_slot_steps'] == 0
+    # page-granular read accounting: strictly less traffic than the
+    # gather's slots * table_width per step
+    table_w = lm.continuous_plan()['max_pages_per_seq'] * 16
+    gather_positions = stats['steps'] * 3 * table_w
+    assert 0 < stats['page_read_positions'] < gather_positions
+
+
+def test_engine_kernel_head_sharded_under_model_mesh():
+    """Tensor-parallel eligibility (this PR): under a pure model-axis
+    mesh the kernel runs head-sharded via shard_map and the engine
+    stays token-identical to the dense path on the same mesh."""
+    from opencompass_tpu.models import JaxLM
+    if len(jax.devices()) < 2:
+        pytest.skip('needs >= 2 devices for a model=2 mesh')
+    par = {'data': 1, 'model': 2}
+    lm_fixed = JaxLM(config='tiny', max_seq_len=256, parallel=par)
+    lm = JaxLM(config='tiny', max_seq_len=256, continuous_batching=True,
+               decode_slots=3, kv_page_size=16, ragged_kernel='on',
+               parallel=par)
+    assert lm.kv_read_path() == 'ragged_kernel'
+    assert lm.continuous_active
+    ref = lm_fixed.generate(PROMPTS, max_out_len=6)
+    got = lm.generate_continuous(PROMPTS, 6)
+    assert got == ref
+    assert lm.continuous_engine().alloc.n_allocated == 0

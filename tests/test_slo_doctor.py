@@ -437,9 +437,13 @@ def test_doctor_cli_json():
 def test_engine_per_step_records_and_itl(tmp_path):
     from opencompass_tpu.models import JaxLM
     from opencompass_tpu.obs import timeline as tlmod
+    # mixed_step=False: this test pins the LEGACY two-shape step's
+    # measured stall counter and its 'p'/'d' per-step records; the
+    # mixed step's stall==0-by-construction is pinned in
+    # tests/test_continuous_batching.py.
     lm = JaxLM(config='tiny', max_seq_len=256,
                continuous_batching=True, decode_slots=2,
-               kv_page_size=16)
+               kv_page_size=16, mixed_step=False)
     tl = tlmod.install_timeline(
         tlmod.Timeline(str(tmp_path), 'engine-task'))
     try:
