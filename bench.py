@@ -721,6 +721,126 @@ def _bench_continuous(out_json='BENCH_DECODE.json'):
     return record
 
 
+def _bench_prefix(out_json='BENCH_PREFIX.json'):
+    """detail.prefix_cache: radix prefix cache + draft-model speculative
+    decoding over the paged engine (tiny JaxLM, CPU-runnable).
+
+    Workload is the few-shot eval shape the cache targets: one shared
+    ICE block (~75%% of prompt tokens) + short per-item remainders.
+    Leg 1 runs the same sweep with the trie off and on and asserts the
+    trie (a) halves prefill tokens (the ISSUE floor is a 50%% drop at
+    >=70%% share) and (b) leaves outputs byte-identical.  Leg 2 runs
+    draft-model speculative decoding (same tiny config as draft) and
+    asserts greedy token-identity to the plain engine while reporting
+    the acceptance rate and tokens/s."""
+    from opencompass_tpu.models import JaxLM
+
+    shared = ('Q: what color is the sky above the sea at noon? '
+              'A: blue. ' * 12)
+    rng = np.random.RandomState(11)
+    prompts = [shared + 'Q: item ' + ' '.join(
+        f'w{rng.randint(999)}' for _ in range(rng.randint(2, 6)))
+        + '? A:' for i in range(16)]
+
+    kw = dict(config='tiny', max_seq_len=512, continuous_batching=True,
+              decode_slots=4, kv_page_size=16)
+
+    # -- leg 1: trie off vs on, identical greedy sweep
+    lm_off = JaxLM(**kw)
+    t0 = time.perf_counter()
+    out_off = lm_off.generate_continuous(prompts, 8)
+    off_wall = time.perf_counter() - t0
+    eng_off = lm_off.continuous_engine()
+    off_prefill = int(eng_off.prefill_tokens)
+
+    lm_on = JaxLM(prefix_cache=True, **kw)
+    t0 = time.perf_counter()
+    out_on = lm_on.generate_continuous(prompts, 8)
+    on_wall = time.perf_counter() - t0
+    eng_on = lm_on.continuous_engine()
+    st = eng_on.stats()
+    on_prefill = int(eng_on.prefill_tokens)
+    saved = int(st['prefill_tokens_saved'])
+    saved_frac = saved / max(saved + on_prefill, 1)
+    share = saved / max(off_prefill, 1)
+
+    # -- leg 2: speculative decoding, identity vs the plain engine
+    lm_spec = JaxLM(draft_model=dict(config='tiny', max_seq_len=512),
+                    draft_k=4, **kw)
+    assert lm_spec.speculative_active, 'spec engine did not activate'
+    t0 = time.perf_counter()
+    out_spec = lm_spec.generate_continuous(prompts, 24)
+    spec_wall = time.perf_counter() - t0
+    sst = lm_spec.continuous_engine().stats()
+    t0 = time.perf_counter()
+    out_ref = lm_off.generate_continuous(prompts, 24)
+    ref_wall = time.perf_counter() - t0
+    ref_tokens = sum(
+        len(lm_off._encode_ids(o)) for o in out_ref)
+
+    record = {
+        'v': 1,
+        'workload': f'{len(prompts)} rows, shared ICE block '
+                    f'({share:.0%} of prefill tokens), tiny JaxLM '
+                    '(CPU); 4 slots / page 16',
+        'rows': len(prompts),
+        'prefill_tokens_off': off_prefill,
+        'prefill_tokens_on': on_prefill,
+        'prefill_tokens_saved': saved,
+        'prefill_tokens_saved_frac': round(saved_frac, 4),
+        'prefix_hits': int(st['prefix_hits']),
+        'prefix_cow_copies': int(st['prefix_cow_copies']),
+        'trie': st['prefix_cache'],
+        'off_wall_seconds': round(off_wall, 3),
+        'on_wall_seconds': round(on_wall, 3),
+        'greedy_identical': bool(out_on == out_off),
+        'spec': {
+            'draft_k': 4,
+            'proposed': int(sst['spec_proposed']),
+            'accepted': int(sst['spec_accepted']),
+            'accept_rate': round(sst['spec_accept_rate'] or 0.0, 4),
+            'decode_tokens': int(sst['decode_tokens']),
+            'wall_seconds': round(spec_wall, 3),
+            'tokens_per_sec': round(
+                sst['decode_tokens'] / max(spec_wall, 1e-9), 1),
+            'ref_tokens_per_sec': round(
+                ref_tokens / max(ref_wall, 1e-9), 1),
+            'greedy_identical': bool(out_spec == out_ref),
+        },
+    }
+    assert record['greedy_identical'], \
+        'prefix-cache outputs diverged from the trie-off sweep'
+    assert on_prefill <= 0.5 * off_prefill, (
+        f'trie saved only {saved_frac:.1%} of prefill tokens '
+        f'({on_prefill} vs {off_prefill})')
+    assert record['spec']['greedy_identical'], \
+        'speculative outputs diverged from the plain engine'
+    assert record['spec']['proposed'] > 0
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, out_json), 'w') as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
+    _append_trajectory(
+        'prefix', 'prefill_tokens_saved_frac',
+        record['prefill_tokens_saved_frac'], 'frac', direction='higher',
+        detail={'prefill_tokens_off': off_prefill,
+                'prefill_tokens_on': on_prefill,
+                'prefix_hits': record['prefix_hits'],
+                'prefix_cow_copies': record['prefix_cow_copies'],
+                'greedy_identical': record['greedy_identical']})
+    _append_trajectory(
+        'spec', 'accept_rate',
+        record['spec']['accept_rate'], 'frac', direction='higher',
+        detail={'draft_k': record['spec']['draft_k'],
+                'proposed': record['spec']['proposed'],
+                'accepted': record['spec']['accepted'],
+                'tokens_per_sec': record['spec']['tokens_per_sec'],
+                'greedy_identical': record['spec']['greedy_identical']})
+    return record
+
+
 def _bench_lint(out_json='BENCH_LINT.json'):
     """detail.lint: oct-lint coverage smoke over the package — files
     scanned, per-rule finding counts, pragma/baseline triage state
@@ -2038,6 +2158,12 @@ if __name__ == '__main__':
         # standalone continuous-batching leg (tiny JaxLM; CPU-runnable)
         print(json.dumps({'metric': 'continuous_batching', 'v': 1,
                           'detail': _bench_continuous()}))
+        sys.exit(0)
+    if '--prefix-cache' in sys.argv:
+        # standalone radix-prefix-cache + speculative-decoding leg
+        # (tiny JaxLM; CPU-runnable)
+        print(json.dumps({'metric': 'prefix_cache', 'v': 1,
+                          'detail': _bench_prefix()}))
         sys.exit(0)
     if '--roofline' in sys.argv:
         # standalone roofline/MFU/MBU leg (tiny JaxLM; CPU-runnable)

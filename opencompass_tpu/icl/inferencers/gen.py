@@ -473,7 +473,23 @@ class GenInferencer(BaseInferencer):
             if census:
                 preview['prefix'] = census
         except Exception:
-            pass
+            census = None
+        if cont and census and census.get('prefix_tokens', 0) > 0:
+            # expected radix-trie reuse: every row after the first skips
+            # prefilling the shared prefix (page-granular, so pages saved
+            # round down to whole pages).
+            page = cont['page_size']
+            rows = len(lengths)
+            ptok = census['prefix_tokens']
+            cont['prefix_cache'] = bool(
+                getattr(self.model, 'prefix_cache', False))
+            cont['prefix_reuse'] = {
+                'est_prefill_tokens_saved': ptok * max(rows - 1, 0),
+                'est_pages_saved': (ptok // page) * max(rows - 1, 0),
+                'est_saved_frac': round(
+                    ptok * max(rows - 1, 0)
+                    / max(sum(lengths), 1), 4),
+            }
         return preview
 
 

@@ -40,7 +40,8 @@ from jax.sharding import PartitionSpec as P
 from opencompass_tpu.nn import (TransformerConfig, beam_generate, forward,
                                 greedy_generate, greedy_generate_prefixed,
                                 init_params, paged_generate_step,
-                                sequence_nll, shard_params)
+                                paged_verify_step, sequence_nll,
+                                shard_params)
 from opencompass_tpu.parallel.mesh import MeshSpec, make_mesh, use_mesh
 from opencompass_tpu.registry import MODELS
 from opencompass_tpu.utils.logging import get_logger
@@ -78,7 +79,8 @@ class _EngineRow:
     """One sequence moving through the continuous engine."""
     __slots__ = ('ids', 'max_new', 'tag', 'emitted', 'kv_len', 'slot',
                  'done', 'retire_seq', 'event', 'interactive',
-                 'submit_ts', 'first_token_ts', 'done_ts', 'token_ts')
+                 'submit_ts', 'first_token_ts', 'done_ts', 'token_ts',
+                 'prefix_tokens')
 
     def __init__(self, ids, max_new, tag, interactive=False):
         self.ids = list(ids)
@@ -86,6 +88,9 @@ class _EngineRow:
         self.tag = tag
         self.emitted: List[int] = []
         self.kv_len = 0
+        # prompt tokens served from the radix prefix cache at admission
+        # (prefill skipped them entirely)
+        self.prefix_tokens = 0
         self.slot: Optional[int] = None
         self.done = False
         self.retire_seq: Optional[int] = None
@@ -137,6 +142,7 @@ class ContinuousEngine:
     def __init__(self, model: 'JaxLM', slots: int, page_size: int,
                  num_pages: Optional[int] = None):
         from opencompass_tpu.nn.paged_kv import (PageAllocator, PageTable,
+                                                 RadixPrefixCache,
                                                  init_page_pool,
                                                  pages_per_seq,
                                                  pool_pages_for)
@@ -150,6 +156,25 @@ class ContinuousEngine:
         self.pool = init_page_pool(self.cfg, self.num_pages, page_size)
         self.alloc = PageAllocator(self.num_pages)
         self.table = PageTable(self.slots, self.max_pages)
+        # radix prefix cache (nn/paged_kv.py): trie nodes own refcounted
+        # pool pages keyed by page-granular prompt chunks.  The key —
+        # (weights identity, tokenizer digest, sampling params) — is
+        # recorded for observability; correctness comes from lifetime:
+        # the trie lives and dies with THIS engine, and JaxLM rebuilds
+        # the engine whenever any key component changes.
+        # guarded-by: _lock
+        self.prefix: Optional[RadixPrefixCache] = None
+        if getattr(model, 'prefix_cache', False):
+            self.prefix = RadixPrefixCache(
+                self.alloc, page_size,
+                key=(model.shape_signature,
+                     getattr(model, '_toklen_digest', ''),
+                     model._gen_params()))
+        # copy-on-write copies queued by admission, applied to the pool
+        # by the driver before the next step's dispatch
+        # guarded-by: _lock
+        self._pending_cow: List[tuple] = []
+        self._copy_fn = None
         # guarded-by: _lock
         self._slots: List[Optional[_EngineRow]] = [None] * self.slots
         # guarded-by: _lock
@@ -216,6 +241,90 @@ class ContinuousEngine:
 
         self._step_fn = jax.jit(_step_mixed if self.mixed else _step,
                                 donate_argnums=donate)
+        # draft-model speculative decoding: decided once at engine
+        # build (JaxLM.speculative_active gates on greedy sampling, an
+        # un-meshed target and a vocab-matched draft); the engine then
+        # compiles TWO extra executables — the draft's propose step (a
+        # prefill lane keeping the draft's KV in lockstep plus a
+        # k-step greedy scan) and the target's spec step (prefill lane
+        # plus a (slots, k+1) teacher-forced verify lane)
+        self.spec = bool(getattr(model, 'speculative_active', False))
+        self.spec_k = int(getattr(model, 'draft_k', 0)) if self.spec else 0
+        self.draft = model.draft_lm() if self.spec else None
+        self.draft_pool = None
+        self._draft_copy_fn = None
+        if self.spec:
+            K = self.spec_k
+            draft = self.draft
+            dcfg = draft.cfg
+            self.draft_pool = init_page_pool(dcfg, self.num_pages,
+                                             page_size)
+            zero_rng = jax.random.PRNGKey(0)    # greedy: rng unused
+
+            def _step_spec(params, pool, pf_tokens, pf_start, pf_n,
+                           vf_tokens, vf_start, vf_n, page_table, rng):
+                def pf(pool):
+                    nxt, pool = paged_generate_step(
+                        params, cfg, pf_tokens, pf_start, pf_n,
+                        page_table, pool, ps, jax.random.fold_in(rng, 0),
+                        temp, top_k, ragged_kernel=rk)
+                    return nxt.astype(jnp.int32), pool
+
+                def skip_pf(pool):
+                    return jnp.zeros((slots,), jnp.int32), pool
+
+                def vf(pool):
+                    return paged_verify_step(
+                        params, cfg, vf_tokens, vf_start, vf_n,
+                        page_table, pool, ps, ragged_kernel=rk)
+
+                def skip_vf(pool):
+                    return jnp.zeros((slots, K + 1), jnp.int32), pool
+
+                pf_nxt, pool = jax.lax.cond(jnp.any(pf_n > 0), pf,
+                                            skip_pf, pool)
+                vf_out, pool = jax.lax.cond(jnp.any(vf_n > 0), vf,
+                                            skip_vf, pool)
+                return pf_nxt, vf_out, pool
+
+            def _step_draft(dparams, dpool, pf_tokens, pf_start, pf_n,
+                            dc_tok, dc_start, dc_n, page_table):
+                # lockstep prefill: the draft's pool mirrors the
+                # target's prompt coverage page for page (same page
+                # table!), so trie-matched pages are valid draft KV too
+                def pf(dpool):
+                    _, dpool = paged_generate_step(
+                        dparams, dcfg, pf_tokens, pf_start, pf_n,
+                        page_table, dpool, ps, zero_rng, 0.0, 0)
+                    return dpool
+
+                dpool = jax.lax.cond(jnp.any(pf_n > 0), pf,
+                                     lambda p: p, dpool)
+
+                def propose(dpool):
+                    def body(carry, _):
+                        tok, pos, dpool = carry
+                        nxt, dpool = paged_generate_step(
+                            dparams, dcfg, tok[:, None], pos, dc_n,
+                            page_table, dpool, ps, zero_rng, 0.0, 0)
+                        nxt = nxt.astype(jnp.int32)
+                        return (nxt, pos + dc_n, dpool), nxt
+
+                    (_, _, dpool), props = jax.lax.scan(
+                        body, (dc_tok, dc_start, dpool), None, length=K)
+                    return jnp.transpose(props), dpool   # (slots, K)
+
+                def skip(dpool):
+                    return jnp.zeros((slots, K), jnp.int32), dpool
+
+                props, dpool = jax.lax.cond(jnp.any(dc_n > 0), propose,
+                                            skip, dpool)
+                return props, dpool
+
+            self._spec_step_fn = jax.jit(_step_spec,
+                                         donate_argnums=donate)
+            self._draft_step_fn = jax.jit(_step_draft,
+                                          donate_argnums=donate)
         # telemetry (all under self._lock).  Counters are engine-
         # lifetime; per-drain deltas come from snapshot()/stats(since=)
         # so a resident engine's Nth task reports only its own work.
@@ -239,9 +348,10 @@ class ContinuousEngine:
         # per-step records (kind, wall, slot composition, retirements)
         # — bounded like the occupancy series; per-drain deltas take
         # the tail.  Schema: {'k': 'm' (mixed) | 'p'|'d' (legacy
-        # two-shape), 'w': wall_s, 'pf': prefilling rows, 'dc':
-        # decoding rows, 'st': decode-ready rows stalled behind the
-        # prefill chunk (always 0 for mixed steps), 'ret': retired}
+        # two-shape) | 's' (speculative draft+verify), 'w': wall_s,
+        # 'pf': prefilling rows, 'dc': decoding rows, 'st':
+        # decode-ready rows stalled behind the prefill chunk (always 0
+        # for mixed and speculative steps), 'ret': retired}
         # guarded-by: _lock
         self._step_records: 'collections.deque[Dict]' = \
             collections.deque(maxlen=4096)
@@ -271,6 +381,30 @@ class ContinuousEngine:
         # first fetch is real).  The kernel-path kv_ratio numerator
         # (obs/costmodel.engine_cost kv_read_path='ragged_kernel').
         self.page_read_positions = 0
+        # decode tokens actually processed (teacher-forced verify
+        # chunks count every scored position).  For the non-spec engine
+        # this equals occupancy_sum by construction; with speculation a
+        # decode row advances up to k+1 tokens per step.
+        # guarded-by: _lock
+        self.decode_tokens = 0
+        # prefix-cache counters (all under _lock): admissions that
+        # matched the trie, prompt tokens whose prefill was skipped,
+        # the attended (query, key) pairs those tokens would have cost,
+        # and copy-on-write page copies
+        # guarded-by: _lock
+        self.prefix_hits = 0
+        # guarded-by: _lock
+        self.prefix_saved_tokens = 0
+        # guarded-by: _lock
+        self.prefix_saved_attn = 0
+        # guarded-by: _lock
+        self.prefix_cow_copies = 0
+        # speculative-decoding counters: draft proposals scored and
+        # accepted (acceptance rate = accepted / proposed per drain)
+        # guarded-by: _lock
+        self.spec_proposed = 0
+        # guarded-by: _lock
+        self.spec_accepted = 0
         try:
             from opencompass_tpu.obs.costmodel import CostModel
             self._costmodel = CostModel.for_model(model)
@@ -316,24 +450,67 @@ class ContinuousEngine:
             if not lane:
                 continue
             row = lane[0]
-            need = pages_per_seq(len(row.ids) + row.max_new,
-                                 self.page_size)
+            total = pages_per_seq(len(row.ids) + row.max_new,
+                                  self.page_size)
+            # prefix-cache fast path: fully-matched pages map read-only
+            # into this slot (one row reference each); a partial match
+            # copies its page before any divergent write (COW)
+            matched_pages: List[int] = []
+            matched = 0
+            cow_src = None
+            if self.prefix is not None:
+                matched_pages, matched, cow_src = \
+                    self.prefix.match(row.ids)
+            need = total - len(matched_pages)
             try:
-                pages = self.alloc.alloc(need)
+                pages = self._alloc_or_evict_locked(need)
             except OutOfPages:
                 # FIFO back-pressure: retries next step.  Surface the
                 # stall as a structured obs event (rate-limited) so an
                 # undersized kv_pool_pages shows up in the event
                 # stream instead of only as mysteriously low slot_util
+                if matched_pages or cow_src is not None:
+                    self.alloc.free(
+                        matched_pages
+                        + ([cow_src] if cow_src is not None else []))
                 self._note_pool_pressure_locked(need)
                 break
+            if cow_src is not None:
+                # pages[0] becomes the COW destination: the driver
+                # copies the shared page into it before the next step,
+                # and the row's suffix prefill overwrites the divergent
+                # tail before any of its queries can attend it
+                self._pending_cow.append((cow_src, pages[0]))
+                self.prefix_cow_copies += 1
             lane.popleft()
-            self.table.assign(slot, pages)
+            self.table.assign(slot, matched_pages + pages)
+            row.kv_len = matched
+            row.prefix_tokens = matched
+            if matched:
+                self.prefix_hits += 1
+                self.prefix_saved_tokens += matched
+                # pairs the skipped prefill would have attended:
+                # token i attends i + 1 positions
+                self.prefix_saved_attn += matched * (matched + 1) // 2
             row.slot = slot
             self._slots[slot] = row
             self.joined += 1
             if row.interactive:
                 self.prio_joined += 1
+
+    def _alloc_or_evict_locked(self, need: int) -> List[int]:
+        """Allocate ``need`` pages, evicting cold trie pages (LRU,
+        trie-only references) to make room before giving up."""
+        from opencompass_tpu.nn.paged_kv import OutOfPages
+        try:
+            return self.alloc.alloc(need)
+        except OutOfPages:
+            if self.prefix is None:
+                raise
+            short = need - self.alloc.n_free
+            if self.prefix.evict(short) < short:
+                raise
+            return self.alloc.alloc(need)
 
     def _note_pool_pressure_locked(self, need: int):
         """One ``kv_pool_pressure`` event per admission-stall episode
@@ -371,12 +548,51 @@ class ContinuousEngine:
 
     # -- device stepping ---------------------------------------------------
 
+    def _apply_cow(self, pending: List[tuple]):
+        """Execute queued copy-on-write page copies (driver thread,
+        before the step that first writes into the copies), then drop
+        the match's temporary reference on each source page."""
+        if not pending:
+            return
+        if self._copy_fn is None:
+            def _copy(pool, src, dst):
+                return {k: v.at[:, dst].set(v[:, src])
+                        for k, v in pool.items()}
+            donate = (0,) if jax.default_backend() != 'cpu' else ()
+            self._copy_fn = jax.jit(_copy, donate_argnums=donate)
+        model = self.model
+        first = model._first_dispatch('page_copy', (1, 1),
+                                      self.temperature, self.top_k)
+        cs0 = model.perf.compile_seconds
+        t0 = time.perf_counter()
+        for src, dst in pending:
+            s, d = np.int32(src), np.int32(dst)
+            with use_mesh(model.mesh):
+                self.pool = self._copy_fn(self.pool, s, d)
+                if self.draft_pool is not None:
+                    self.draft_pool = self._copy_fn(self.draft_pool,
+                                                    s, d)
+        elapsed = time.perf_counter() - t0
+        self.device_seconds += elapsed
+        model.perf.device_seconds += elapsed
+        model.perf.calls += 1
+        if first:
+            model.perf.compile_seconds += elapsed
+            model.perf.first_calls += 1
+            model._note_compile('page_copy', (1, 1),
+                                model.perf.compile_seconds - cs0)
+        with self._lock:
+            self.alloc.free([src for src, _ in pending])
+
     def _device_step(self) -> bool:
         """One engine step (caller holds the driver lock).  Returns
         False when there was nothing to do."""
+        if self.spec:
+            return self._device_step_spec()
         model = self.model
         with self._lock:
             self._admit_locked()
+            pending_cow, self._pending_cow = self._pending_cow, []
             active = [r for r in self._slots if r is not None]
             if not active:
                 return False
@@ -448,8 +664,10 @@ class ContinuousEngine:
             if dc_rows:
                 self.decode_steps += 1
                 self.occupancy_sum += n_decode
+                self.decode_tokens += n_decode
                 self._occ_series.append(n_decode)
 
+        self._apply_cow(pending_cow)
         if self.mixed:
             kind, shape = 'mixed', (self.slots, self.page_size + 1)
         elif pf_rows:
@@ -510,6 +728,12 @@ class ContinuousEngine:
                 now_tok = time.perf_counter()
                 if not row.emitted:
                     row.first_token_ts = now_tok
+                    # prefill just finished: donate this row's full
+                    # prompt pages to the trie (before any retire can
+                    # clear the slot's table row)
+                    if self.prefix is not None:
+                        self.prefix.insert(
+                            row.ids, self.table.pages(row.slot))
                 row.token_ts.append(now_tok)
                 row.emitted.append(tok)
                 if (eos is not None and tok == eos) \
@@ -522,6 +746,197 @@ class ContinuousEngine:
                 'pf': n_prefill,
                 'dc': n_decode,
                 'st': stalled,
+                'ret': len(retired)})
+            self._note_heartbeat_locked()
+        for row in retired:
+            row.event.set()
+        return True
+
+    def _device_step_spec(self) -> bool:
+        """One speculative engine step (caller holds the driver lock):
+        the draft proposes ``spec_k`` greedy tokens per decode row
+        (after a lockstep prefill keeping its own pool page-identical
+        to the target's), the target scores all proposals in ONE
+        teacher-forced verify lane, and the host accepts the longest
+        agreeing prefix plus the target's bonus token.  Every emitted
+        token is a target argmax, so greedy output is token-identical
+        to the unspeculated engine by construction; rejected positions'
+        stale K/V is overwritten before any later query can attend it.
+        Rows within ``spec_k`` tokens of their budget fall back to
+        one-token verify chunks (no draft writes past their pages).
+        """
+        model = self.model
+        K = self.spec_k
+        with self._lock:
+            self._admit_locked()
+            pending_cow, self._pending_cow = self._pending_cow, []
+            active = [r for r in self._slots if r is not None]
+            if not active:
+                return False
+            pf_rows = [r for r in active if r.kv_len < len(r.ids)]
+            dc_rows = [r for r in active if r.kv_len >= len(r.ids)]
+            t = self.page_size
+            pf_tokens = np.zeros((self.slots, t), np.int32)
+            pf_start = np.zeros((self.slots,), np.int32)
+            pf_n = np.zeros((self.slots,), np.int32)
+            vf_tokens = np.zeros((self.slots, K + 1), np.int32)
+            vf_start = np.zeros((self.slots,), np.int32)
+            vf_n = np.zeros((self.slots,), np.int32)
+            dc_tok = np.zeros((self.slots,), np.int32)
+            dc_n = np.zeros((self.slots,), np.int32)
+            for row in pf_rows:
+                chunk = row.ids[row.kv_len:row.kv_len + t]
+                pf_tokens[row.slot, :len(chunk)] = chunk
+                pf_start[row.slot] = row.kv_len
+                pf_n[row.slot] = len(chunk)
+                self.prefill_tokens += len(chunk)
+                self.kv_positions += row.kv_len + len(chunk)
+                self.attn_positions += (len(chunk) * row.kv_len
+                                        + len(chunk)
+                                        * (len(chunk) + 1) // 2)
+            for row in dc_rows:
+                cap = row.max_new - len(row.emitted)
+                n_v = K + 1 if cap >= K + 1 else 1
+                vf_tokens[row.slot, 0] = row.emitted[-1]
+                vf_start[row.slot] = row.kv_len
+                vf_n[row.slot] = n_v
+                if n_v > 1:
+                    dc_tok[row.slot] = row.emitted[-1]
+                    dc_n[row.slot] = 1
+                self.kv_positions += row.kv_len + n_v
+                self.attn_positions += (n_v * row.kv_len
+                                        + n_v * (n_v + 1) // 2)
+            for start_a, n_a, ran in ((pf_start, pf_n, bool(pf_rows)),
+                                      (vf_start, vf_n, bool(dc_rows))):
+                if ran:
+                    pages = np.maximum(
+                        1, -(-(start_a + n_a) // self.page_size))
+                    self.page_read_positions += (int(pages.sum())
+                                                 * self.page_size)
+            page_table = self.table.table.copy()
+            self.steps += 1
+            step_no = self.steps
+            n_prefill = len(pf_rows)
+            n_decode = len(dc_rows)
+            if pf_rows:
+                self.prefill_steps += 1
+            if dc_rows:
+                self.decode_steps += 1
+                self.occupancy_sum += n_decode
+                self._occ_series.append(n_decode)
+
+        self._apply_cow(pending_cow)
+        rng = jax.random.fold_in(self._base_rng, step_no)
+        t0 = time.perf_counter()
+        # draft pass: lockstep prefill + k-token greedy proposal scan
+        d_kind, d_shape = 'spec_draft', (self.slots, self.page_size + K)
+        d_first = model._first_dispatch(d_kind, d_shape,
+                                        self.temperature, self.top_k)
+        cs0 = model.perf.compile_seconds
+        with _step_scope(d_kind, site='engine_step', step=step_no,
+                         slots=self.slots, page_size=self.page_size):
+            props, self.draft_pool = self._draft_step_fn(
+                self.draft.params, self.draft_pool,
+                jnp.asarray(pf_tokens), jnp.asarray(pf_start),
+                jnp.asarray(pf_n), jnp.asarray(dc_tok),
+                jnp.asarray(vf_start), jnp.asarray(dc_n),
+                jnp.asarray(page_table))
+            props = np.asarray(props)
+        d_el = time.perf_counter() - t0
+        if d_first:
+            model.perf.compile_seconds += d_el
+            model.perf.first_calls += 1
+            model._note_compile(d_kind, d_shape,
+                                model.perf.compile_seconds - cs0)
+        for row in dc_rows:
+            n_v = int(vf_n[row.slot])
+            if n_v > 1:
+                vf_tokens[row.slot, 1:n_v] = props[row.slot, :n_v - 1]
+        # target pass: prefill lane + teacher-forced verify lane
+        v_kind = 'spec_mixed'
+        v_shape = (self.slots, self.page_size + K + 1)
+        v_first = model._first_dispatch(v_kind, v_shape,
+                                        self.temperature, self.top_k)
+        cs0 = model.perf.compile_seconds
+        t1 = time.perf_counter()
+        step_args = (model.params, self.pool,
+                     jnp.asarray(pf_tokens), jnp.asarray(pf_start),
+                     jnp.asarray(pf_n), jnp.asarray(vf_tokens),
+                     jnp.asarray(vf_start), jnp.asarray(vf_n),
+                     jnp.asarray(page_table), rng)
+        with use_mesh(model.mesh), \
+                _step_scope(v_kind, site='engine_step', step=step_no,
+                            slots=self.slots, page_size=self.page_size):
+            pf_nxt, vf_out, self.pool = self._spec_step_fn(*step_args)
+            pf_nxt = np.asarray(pf_nxt)
+            vf_out = np.asarray(vf_out)
+        v_el = time.perf_counter() - t1
+        elapsed = time.perf_counter() - t0
+        self.device_seconds += elapsed
+        model.perf.device_seconds += elapsed
+        model.perf.calls += 2
+        if v_first:
+            model.perf.compile_seconds += v_el
+            model.perf.first_calls += 1
+            model._note_compile(
+                v_kind, v_shape, model.perf.compile_seconds - cs0,
+                fn=self._spec_step_fn,
+                args=(model.params, self.pool) + step_args[2:],
+                extra={'attn_width': self.max_pages * self.page_size,
+                       'kv_read_path': self.kv_read_path})
+
+        eos = model.eos_token_id
+        retired: List[_EngineRow] = []
+        with self._lock:
+            for row in [r for r in self._slots if r is not None]:
+                if pf_n[row.slot]:
+                    row.kv_len += int(pf_n[row.slot])
+                    if row.kv_len < len(row.ids):
+                        continue        # still prefilling
+                    tok = int(pf_nxt[row.slot])
+                    now_tok = time.perf_counter()
+                    row.first_token_ts = now_tok
+                    if self.prefix is not None:
+                        self.prefix.insert(
+                            row.ids, self.table.pages(row.slot))
+                    row.token_ts.append(now_tok)
+                    row.emitted.append(tok)
+                    if (eos is not None and tok == eos) \
+                            or len(row.emitted) >= row.max_new:
+                        self._retire_locked(row)
+                        retired.append(row)
+                    continue
+                n_v = int(vf_n[row.slot])
+                if not n_v:
+                    continue
+                fed = vf_tokens[row.slot]
+                out = vf_out[row.slot]
+                # accept the longest prefix of proposals the target's
+                # argmax reproduces; output m is the bonus token the
+                # target emits after the last accepted proposal
+                m = 0
+                while m < n_v - 1 and int(fed[m + 1]) == int(out[m]):
+                    m += 1
+                if n_v > 1:
+                    self.spec_proposed += n_v - 1
+                    self.spec_accepted += m
+                self.decode_tokens += n_v
+                row.kv_len += m + 1
+                now_tok = time.perf_counter()
+                for tok in (int(x) for x in out[:m + 1]):
+                    row.token_ts.append(now_tok)
+                    row.emitted.append(tok)
+                    if (eos is not None and tok == eos) \
+                            or len(row.emitted) >= row.max_new:
+                        self._retire_locked(row)
+                        retired.append(row)
+                        break
+            self._step_records.append({
+                'k': 's',
+                'w': round(elapsed, 6),
+                'pf': n_prefill,
+                'dc': n_decode,
+                'st': 0,
                 'ret': len(retired)})
             self._note_heartbeat_locked()
         for row in retired:
@@ -559,7 +974,7 @@ class ContinuousEngine:
                 if cm is not None and self.device_seconds > 0:
                     cost = cm.engine_cost(
                         prefill_tokens=self.prefill_tokens,
-                        decode_tokens=self.occupancy_sum,
+                        decode_tokens=self.decode_tokens,
                         prefill_steps=self.prefill_steps,
                         decode_steps=self.decode_steps,
                         slots=self.slots,
@@ -588,6 +1003,8 @@ class ContinuousEngine:
         model = self.model
         warmed = 0
         zs = jnp.zeros((self.slots,), jnp.int32)
+        if self.spec:
+            return self._warm_spec()
         if self.mixed:
             kind, shape = 'mixed', (self.slots, self.page_size + 1)
             if not model._first_dispatch(kind, shape,
@@ -643,6 +1060,46 @@ class ContinuousEngine:
             warmed += 1
         return warmed
 
+    def _warm_spec(self) -> int:
+        """Pre-compile the speculative engine's two executables (draft
+        propose + target verify) with all-inactive dummy dispatches."""
+        model = self.model
+        K = self.spec_k
+        warmed = 0
+        zs = jnp.zeros((self.slots,), jnp.int32)
+        pt = jnp.asarray(self.table.table)
+        pf0 = jnp.zeros((self.slots, self.page_size), jnp.int32)
+        d_kind, d_shape = 'spec_draft', (self.slots, self.page_size + K)
+        if model._first_dispatch(d_kind, d_shape, self.temperature,
+                                 self.top_k):
+            cs0 = model.perf.compile_seconds
+            with device_call(model.perf, first=True):
+                props, self.draft_pool = self._draft_step_fn(
+                    self.draft.params, self.draft_pool, pf0, zs, zs,
+                    zs, zs, zs, pt)
+                jax.block_until_ready(props)
+            model._note_compile(d_kind, d_shape,
+                                model.perf.compile_seconds - cs0)
+            warmed += 1
+        v_kind = 'spec_mixed'
+        v_shape = (self.slots, self.page_size + K + 1)
+        if model._first_dispatch(v_kind, v_shape, self.temperature,
+                                 self.top_k):
+            cs0 = model.perf.compile_seconds
+            with use_mesh(model.mesh), device_call(model.perf,
+                                                   first=True):
+                pf_nxt, vf_out, self.pool = self._spec_step_fn(
+                    model.params, self.pool, pf0, zs, zs,
+                    jnp.zeros((self.slots, K + 1), jnp.int32),
+                    zs, zs, pt, self._base_rng)
+                jax.block_until_ready(vf_out)
+            model._note_compile(
+                v_kind, v_shape, model.perf.compile_seconds - cs0,
+                extra={'attn_width': self.max_pages * self.page_size,
+                       'kv_read_path': self.kv_read_path})
+            warmed += 1
+        return warmed
+
     @property
     def slot_util(self) -> float:
         """Mean fraction of decode-step slots occupied by live rows."""
@@ -665,7 +1122,14 @@ class ContinuousEngine:
                     'kv_positions': self.kv_positions,
                     'attn_positions': self.attn_positions,
                     'page_read_positions': self.page_read_positions,
-                    'stall_slot_steps': self.stall_slot_steps}
+                    'stall_slot_steps': self.stall_slot_steps,
+                    'decode_tokens': self.decode_tokens,
+                    'prefix_hits': self.prefix_hits,
+                    'prefix_saved_tokens': self.prefix_saved_tokens,
+                    'prefix_saved_attn': self.prefix_saved_attn,
+                    'prefix_cow_copies': self.prefix_cow_copies,
+                    'spec_proposed': self.spec_proposed,
+                    'spec_accepted': self.spec_accepted}
 
     def stats(self, since: Optional[Dict] = None) -> Dict:
         """Engine counters — lifetime by default, or the delta since a
@@ -720,7 +1184,8 @@ class ContinuousEngine:
                     - base.get('device_seconds', 0.0), 6),
                 'prefill_tokens': self.prefill_tokens
                 - base.get('prefill_tokens', 0),
-                'decode_tokens': d_occ,
+                'decode_tokens': self.decode_tokens
+                - base.get('decode_tokens', 0),
                 'kv_positions': self.kv_positions
                 - base.get('kv_positions', 0),
                 'attn_positions': self.attn_positions
@@ -738,6 +1203,32 @@ class ContinuousEngine:
                 # number instead of an assertion
                 'stall_slot_steps': self.stall_slot_steps
                 - base.get('stall_slot_steps', 0),
+                # prefix-cache / speculative-decoding deltas (0/None
+                # when the features are off — consumers treat absence
+                # of savings and absence of the feature alike)
+                'prefix_cache_enabled': self.prefix is not None,
+                'prefix_hits': self.prefix_hits
+                - base.get('prefix_hits', 0),
+                'prefill_tokens_saved': self.prefix_saved_tokens
+                - base.get('prefix_saved_tokens', 0),
+                'prefix_saved_attn': self.prefix_saved_attn
+                - base.get('prefix_saved_attn', 0),
+                'prefix_cow_copies': self.prefix_cow_copies
+                - base.get('prefix_cow_copies', 0),
+                'prefix_cache': (self.prefix.stats()
+                                 if self.prefix is not None else None),
+                'speculative': self.spec,
+                'spec_k': self.spec_k,
+                'spec_proposed': self.spec_proposed
+                - base.get('spec_proposed', 0),
+                'spec_accepted': self.spec_accepted
+                - base.get('spec_accepted', 0),
+                'spec_accept_rate': round(
+                    (self.spec_accepted - base.get('spec_accepted', 0))
+                    / (self.spec_proposed
+                       - base.get('spec_proposed', 0)), 4)
+                if self.spec_proposed - base.get('spec_proposed', 0)
+                else None,
                 'steps_detail': step_recs,
                 'step_wall_p50_ms': round(
                     percentile(walls, 0.50) * 1e3, 3)
@@ -769,7 +1260,14 @@ class ContinuousEngine:
                 kv_read_path=stats.get('kv_read_path',
                                        self.kv_read_path),
                 page_read_positions=stats.get('page_read_positions'))
-            return cm.fields(cost, stats.get('device_seconds'))
+            out = cm.fields(cost, stats.get('device_seconds'))
+            saved_tokens = stats.get('prefill_tokens_saved') or 0
+            if saved_tokens:
+                # prefill FLOPs the radix prefix cache avoided (the
+                # matmul + attention work of the skipped prompt tokens)
+                out['flops_prefill_saved'] = int(cm.prefill_saved(
+                    saved_tokens, stats.get('prefix_saved_attn') or 0))
+            return out
         except Exception:
             return {}
 
@@ -900,6 +1398,9 @@ class JaxLM(BaseModel):
                  kv_pool_pages: Optional[int] = None,
                  mixed_step: bool = True,
                  ragged_kernel: str = 'auto',
+                 prefix_cache: bool = False,
+                 draft_model: Optional[Dict] = None,
+                 draft_k: int = 4,
                  run_cfg: Optional[Dict] = None):
         super().__init__(path=path, max_seq_len=max_seq_len,
                          tokenizer_only=tokenizer_only,
@@ -1021,6 +1522,21 @@ class JaxLM(BaseModel):
             raise ValueError(f'unsupported ragged_kernel='
                              f'{ragged_kernel!r} (want auto|on|off)')
         self.ragged_kernel = ragged_kernel
+        # radix prefix cache over the engine's page pool: rows whose
+        # prompts share a cached prefix map its pages read-only and
+        # prefill only their suffix (nn/paged_kv.RadixPrefixCache).
+        # Off by default: the trie deliberately HOLDS pages between
+        # drains (that is the point — a later task reuses them), which
+        # changes the pool-empty-after-drain invariant some telemetry
+        # consumers assume.
+        self.prefix_cache = bool(prefix_cache)
+        # draft-model speculative decoding: a small JaxLM built from
+        # this config dict (e.g. dict(config='tiny', seed=0)) proposes
+        # draft_k greedy tokens per engine step; the target verifies
+        # them in one fused call.  Greedy-only — see speculative_eligible
+        self.draft_model = draft_model
+        self.draft_k = int(draft_k)
+        self._draft_lm: Optional['JaxLM'] = None
         self._cont_engine: Optional[ContinuousEngine] = None
         self._cont_engine_key = None
         # worker protocol thread + sweep thread can both reach for the
@@ -1807,6 +2323,52 @@ class JaxLM(BaseModel):
         return (self.mesh.shape.get('model', 1) == 1
                 or self.kv_read_path() == 'ragged_kernel')
 
+    @property
+    def speculative_eligible(self) -> bool:
+        """Device-free gate for draft-model speculative decoding: a
+        ``draft_model`` config is set, the continuous engine could run,
+        and sampling is pure greedy (temperature 0, no top-k, one
+        beam) — acceptance compares argmax ids, so anything stochastic
+        falls back to the plain engine path."""
+        if not self.draft_model or self.draft_k < 1 \
+                or not self.continuous_eligible:
+            return False
+        temperature, top_k, _seed, num_beams, _lp = self._gen_params()
+        return temperature <= 0.0 and top_k == 0 and num_beams == 1
+
+    @property
+    def speculative_active(self) -> bool:
+        """:attr:`speculative_eligible` plus runtime conditions: the
+        engine itself is active, the step runs un-meshed (none or a
+        plain/data mesh — the tensor-parallel shard_map path has no
+        draft/verify executables), and the draft's vocab matches the
+        target's.  False here means the engine silently keeps its
+        current (unspeculated) step — never an error."""
+        if not self.speculative_eligible or not self.continuous_active:
+            return False
+        if self.mesh is not None and self.mesh.shape.get('model', 1) > 1:
+            return False
+        try:
+            draft = self.draft_lm()
+        except Exception as exc:       # unbuildable draft → fall back
+            logger.warning('draft model unavailable, speculative '
+                           'decoding disabled: %s', exc)
+            return False
+        return (draft.cfg is not None and self.cfg is not None
+                and draft.cfg.vocab_size == self.cfg.vocab_size
+                and draft.params is not None)
+
+    def draft_lm(self) -> 'JaxLM':
+        """The draft model, built once from the ``draft_model`` config
+        dict (a JaxLM kwargs dict — e.g. ``dict(config='tiny')``).
+        Inherits the target's max_seq_len unless overridden so both
+        page pools cover the same positions."""
+        if self._draft_lm is None:
+            kw = dict(self.draft_model or {})
+            kw.setdefault('max_seq_len', self.max_seq_len)
+            self._draft_lm = JaxLM(**kw)
+        return self._draft_lm
+
     def continuous_plan(self) -> Optional[Dict]:
         """Static engine geometry for the ``cli plan`` pre-flight:
         slot capacity, page sizing, the compile shapes a continuous
@@ -1839,6 +2401,16 @@ class JaxLM(BaseModel):
             # prefill chunk + 1-wide decode) — the same key the compile
             # manifest / audit record for the engine's one executable
             plan['mixed_shape'] = f'{slots}x{page + 1}'
+        # feature keys appear only when the knobs are on, so the base
+        # geometry dict stays pinned by existing tests/tooling
+        if self.prefix_cache:
+            plan['prefix_cache'] = True
+        if self.draft_model:
+            plan['speculative'] = {
+                'draft_k': self.draft_k,
+                'eligible': bool(self.speculative_eligible),
+                'verify_shape': f'{slots}x{self.draft_k + 1}',
+            }
         return plan
 
     def continuous_engine(self) -> 'ContinuousEngine':
@@ -1925,14 +2497,32 @@ class JaxLM(BaseModel):
                 'itl_ms': [round(v * 1e3, 3)
                            for v in _downsample(itl, 64)],
             }
+        extra = {k: v for k, v in itl_fields.items() if k != 'itl_ms'}
+        # host-side shared-prefix census of THIS drain (tokens all rows
+        # share × reusing rows / total prompt tokens): the doctor's
+        # prefix_waste rule compares this headroom against what the
+        # trie actually saved, so it rides every engine record
+        live = [r for r in ids if r]
+        total_prompt = sum(len(r) for r in live)
+        if len(live) > 1 and total_prompt:
+            cp = len(os.path.commonprefix(live))
+            extra['prefix_shareable_frac'] = round(
+                cp * (len(live) - 1) / total_prompt, 4)
         self._record_engine_drain(engine, snap, len(rows), t0,
-                                  extra={k: v for k, v in
-                                         itl_fields.items()
-                                         if k != 'itl_ms'})
+                                  extra=extra)
         if stats_out is not None:
             stats_out['prefill_tokens'] = sum(len(r) for r in ids)
             stats_out['decode_tokens'] = sum(
                 len(r.emitted) for r in rows)
+            try:
+                es = engine.stats(since=snap)
+                stats_out['prefill_tokens_saved'] = \
+                    es.get('prefill_tokens_saved') or 0
+                if es.get('spec_accept_rate') is not None:
+                    stats_out['spec_accept_rate'] = \
+                        es['spec_accept_rate']
+            except Exception:
+                pass
             stats_out.update(itl_fields)
             firsts = [r.first_token_ts for r in rows
                       if r.first_token_ts is not None]

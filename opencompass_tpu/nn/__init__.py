@@ -11,12 +11,13 @@ from .transformer import (init_params, forward, prefill, decode_step,
                           init_cache, paged_step)
 from .loss import sequence_nll, shared_prefix_nll
 from .decode import (beam_generate, greedy_generate,
-                     greedy_generate_prefixed, paged_generate_step)
+                     greedy_generate_prefixed, paged_generate_step,
+                     paged_verify_step)
 from .sharding import param_shardings, shard_params
 
 __all__ = [
     'TransformerConfig', 'init_params', 'forward', 'prefill', 'decode_step',
-    'init_cache', 'paged_step', 'paged_generate_step',
+    'init_cache', 'paged_step', 'paged_generate_step', 'paged_verify_step',
     'sequence_nll', 'shared_prefix_nll', 'greedy_generate',
     'greedy_generate_prefixed', 'beam_generate', 'param_shardings',
     'shard_params',

@@ -161,6 +161,37 @@ def paged_generate_step(params, cfg: TransformerConfig, tokens: jax.Array,
     return _sample(logits, rng, temperature, top_k), pool
 
 
+def paged_verify_step(params, cfg: TransformerConfig, tokens: jax.Array,
+                      start: jax.Array, n_new: jax.Array,
+                      page_table: jax.Array, pool: Dict, page_size: int,
+                      ragged_kernel: bool = False
+                      ) -> Tuple[jax.Array, Dict]:
+    """Teacher-forced verify-chunk scoring for draft-model speculative
+    decoding.
+
+    ``tokens`` (slots, k+1) is each slot's last accepted token followed
+    by the draft's k proposals; the target scores the whole chunk in
+    ONE paged step (the same fused prefill lane geometry the engine
+    already compiles for prompt chunks) and returns the greedy next
+    token at EVERY position ((slots, k+1) int32): position ``i``'s
+    output is what the target would have emitted after ``tokens[:, i]``.
+    The host accepts the longest prefix where proposal ``i+1`` equals
+    output ``i`` — and always gains output ``m`` as a bonus token — so
+    greedy decode is token-identical to the unspeculated engine by
+    construction.  Greedy only: acceptance compares argmax ids, which
+    is exactly ``_sample`` at temperature 0.
+
+    Writes land for all ``n_new`` positions; rejected positions hold
+    stale K/V that the next verify chunk overwrites *before* any query
+    attends them (causal mask), so no rollback pass is needed.
+    """
+    logits, pool = paged_step(params, cfg, tokens, start, n_new,
+                              page_table, pool, page_size,
+                              ragged_kernel=ragged_kernel,
+                              all_logits=True)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), pool
+
+
 def greedy_generate_prefixed(params, cfg: TransformerConfig,
                              prefix: jax.Array, tokens: jax.Array,
                              pad_mask: jax.Array, max_new_tokens: int,

@@ -33,6 +33,15 @@ of tokens into the pages the table names.
 Invariants (pinned by tests/test_paged_kv.py): the allocator never
 double-books or leaks a page under randomized join/retire orders, and a
 paged cache holding the same K/V as a dense cache attends bit-identically.
+
+**Prefix sharing** (:class:`RadixPrefixCache`): pages are refcounted, so
+one physical page can back the same prompt prefix in several slots' page
+tables at once.  A radix-style token trie maps page-granular prompt
+chunks to the pool page that already holds their K/V; rows that match
+skip prefilling the matched tokens entirely.  The trie holds one
+reference per adopted page and each matching row holds another, so
+``free()`` at retirement only recycles a page once the last reference
+drops — retirement can never corrupt a sibling row mid-decode.
 """
 from __future__ import annotations
 
@@ -55,13 +64,20 @@ class OutOfPages(RuntimeError):
 
 
 class PageAllocator:
-    """Host-side free list over ``num_pages`` pool pages.
+    """Host-side refcounted free list over ``num_pages`` pool pages.
 
     Page ``GARBAGE_PAGE`` is reserved and never handed out.  ``alloc``
     and ``free`` enforce the no-alias/no-leak invariants directly:
     allocating a page twice or freeing a page not currently allocated
     raises instead of silently corrupting a neighbouring sequence's
     cache.
+
+    Prefix sharing adds reference counts: ``alloc`` hands a page out at
+    refcount 1, ``retain`` adds a reference (a trie node or a second
+    row adopting the page read-only), and ``free`` only returns a page
+    to the free list once its last reference drops.  Occupancy gauges
+    (``n_allocated`` / ``stats()``) count *distinct* pages, never
+    per-reference — a page shared by five rows is one used page.
     """
 
     def __init__(self, num_pages: int):
@@ -73,8 +89,9 @@ class PageAllocator:
         # checkable from this file)
         # guarded-by: external:ContinuousEngine._lock
         self._free: List[int] = list(range(num_pages - 1, 0, -1))
+        # page id -> reference count (>= 1 while allocated)
         # guarded-by: external:ContinuousEngine._lock
-        self._allocated: set = set()
+        self._refs: Dict[int, int] = {}
         # pool-pressure telemetry (obs/costmodel roofline plane): the
         # occupancy high-water mark and how many allocations bounced on
         # an exhausted pool (admission back-pressure) — the two numbers
@@ -89,7 +106,13 @@ class PageAllocator:
 
     @property
     def n_allocated(self) -> int:
-        return len(self._allocated)
+        """Distinct allocated pages (shared pages count once)."""
+        return len(self._refs)
+
+    @property
+    def n_shared(self) -> int:
+        """Pages currently held by more than one reference."""
+        return sum(1 for c in self._refs.values() if c > 1)
 
     @property
     def usable_pages(self) -> int:
@@ -105,6 +128,7 @@ class PageAllocator:
             'pages': self.num_pages,
             'used': self.n_allocated,
             'free': self.n_free,
+            'shared': self.n_shared,
             'used_frac': round(self.n_allocated / usable, 4),
             'high_water': self.high_water,
             'high_water_frac': round(self.high_water / usable, 4),
@@ -112,8 +136,8 @@ class PageAllocator:
         }
 
     def alloc(self, n: int) -> List[int]:
-        """``n`` distinct pages, or :class:`OutOfPages` (atomic: on
-        failure nothing is taken; the bounce is counted in
+        """``n`` distinct pages at refcount 1, or :class:`OutOfPages`
+        (atomic: on failure nothing is taken; the bounce is counted in
         ``failed_allocs``)."""
         if n > len(self._free):
             self.failed_allocs += 1
@@ -122,20 +146,36 @@ class PageAllocator:
                 f'(pool of {self.num_pages})')
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
-            if p in self._allocated or p == GARBAGE_PAGE:
+            if p in self._refs or p == GARBAGE_PAGE:
                 raise AssertionError(f'allocator handed out page {p} twice')
-            self._allocated.add(p)
-        self.high_water = max(self.high_water, len(self._allocated))
+            self._refs[p] = 1
+        self.high_water = max(self.high_water, len(self._refs))
         return pages
 
-    def free(self, pages: List[int]):
+    def retain(self, pages: List[int]):
+        """Add one reference to each (already allocated) page — a trie
+        node adopting it, or a row mapping it read-only into its slot."""
         for p in pages:
-            if p not in self._allocated:
+            if p not in self._refs:
+                raise AssertionError(
+                    f'retaining page {p} that is not allocated')
+            self._refs[p] += 1
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
+
+    def free(self, pages: List[int]):
+        """Drop one reference per page; a page returns to the free list
+        only when its last reference drops."""
+        for p in pages:
+            if p not in self._refs:
                 raise AssertionError(
                     f'freeing page {p} that is not allocated '
                     '(double free or alias)')
-            self._allocated.remove(p)
-            self._free.append(p)
+            self._refs[p] -= 1
+            if self._refs[p] == 0:
+                del self._refs[p]
+                self._free.append(p)
 
 
 def pool_pages_for(slots: int, max_len: int, page_size: int) -> int:
@@ -262,3 +302,218 @@ class PageTable:
 
     def pages(self, slot: int) -> Optional[List[int]]:
         return self._pages[slot]
+
+
+class _TrieNode:
+    """One page-granular chunk of a cached prompt prefix.
+
+    ``chunk`` is the ``page_size``-token tuple that keys this node under
+    its parent, ``page`` the pool page holding that chunk's K/V (the
+    trie owns one allocator reference to it), ``tick`` the LRU stamp.
+    """
+
+    __slots__ = ('chunk', 'page', 'children', 'parent', 'tick')
+
+    def __init__(self, chunk: Tuple[int, ...], page: int,
+                 parent: Optional['_TrieNode'], tick: int):
+        self.chunk = chunk
+        self.page = page
+        self.children: Dict[Tuple[int, ...], '_TrieNode'] = {}
+        self.parent = parent
+        self.tick = tick
+
+
+class RadixPrefixCache:
+    """Radix-style prefix cache over the refcounted page pool.
+
+    A token trie at page granularity: each node is one full
+    ``page_size``-token prompt chunk mapped to the pool page that
+    already holds its K/V.  The vLLM-style contract (PAPERS.md):
+
+    - ``match(ids)`` walks the trie along a new prompt and returns the
+      longest chain of already-cached full pages (each retained once
+      for the calling row, so retirement elsewhere cannot recycle
+      them), plus an optional *partial* continuation — a cached page
+      whose chunk shares at least ``min_partial`` leading tokens with
+      the prompt's next chunk.  The caller copies that page
+      (copy-on-write) before its first divergent write lands in it.
+    - ``insert(ids, pages)`` adopts the full-prompt pages of a row that
+      just finished prefill; pages already present are skipped (the
+      row keeps its own references either way), new tail pages gain a
+      trie reference.
+    - ``evict(n)`` frees least-recently-used leaf pages whose only
+      remaining reference is the trie's own — shared pages and interior
+      nodes are never touched — so pool pressure reclaims cold prefixes
+      instead of bouncing admissions.
+
+    The cache is keyed by ``key`` — ``(model identity, tokenizer
+    digest, sampling-relevant params)`` — and lives exactly as long as
+    one :class:`~opencompass_tpu.models.jax_lm.ContinuousEngine`
+    (which is itself rebuilt whenever any of those change), so a trie
+    can never serve K/V computed under different weights, tokenization
+    or sampling geometry.  All methods run under the engine's state
+    lock, like the allocator they mutate.
+    """
+
+    def __init__(self, alloc: PageAllocator, page_size: int,
+                 key: Optional[tuple] = None,
+                 min_partial: Optional[int] = None):
+        self.alloc = alloc
+        self.page_size = int(page_size)
+        self.key = key
+        # a partial (copy-on-write) match must save at least this many
+        # prefill tokens to be worth one page alloc + device copy
+        self.min_partial = (max(1, self.page_size // 4)
+                           if min_partial is None else int(min_partial))
+        # guarded-by: external:ContinuousEngine._lock
+        self._root: Dict[Tuple[int, ...], _TrieNode] = {}
+        # guarded-by: external:ContinuousEngine._lock
+        self._tick = 0
+        # lifetime gauges (distinct from the engine's per-drain deltas)
+        self.nodes = 0
+        self.hits = 0
+        self.misses = 0
+        self.matched_tokens = 0
+        self.inserted_pages = 0
+        self.evicted_pages = 0
+
+    def match(self, ids) -> Tuple[List[int], int, Optional[int]]:
+        """Longest cached prefix of ``ids``.
+
+        Returns ``(pages, n_tokens, cow_src)``: ``pages`` the
+        fully-matched pool pages in prompt order, ``n_tokens`` the
+        total matched token count (full pages plus any partial match
+        inside ``cow_src``), and ``cow_src`` the page to copy-on-write
+        from (or None).  Every returned page — including ``cow_src`` —
+        is retained once for the caller, who must ``free`` them all
+        exactly once (for ``cow_src``: right after the copy).
+
+        At least one suffix token is always left unmatched so the
+        row's final prefill chunk can produce its first-token logits.
+        """
+        ps = self.page_size
+        self._tick += 1
+        ids = list(ids)
+        limit = len(ids) - 1
+        pages: List[int] = []
+        children = self._root
+        pos = 0
+        while pos + ps <= limit:
+            node = children.get(tuple(ids[pos:pos + ps]))
+            if node is None:
+                break
+            node.tick = self._tick
+            pages.append(node.page)
+            children = node.children
+            pos += ps
+        # partial continuation: best common-prefix overlap between the
+        # prompt's next (incomplete) chunk and any cached child chunk
+        cow_src = None
+        best_len = 0
+        rem = ids[pos:limit]
+        if rem:
+            for chunk, node in children.items():
+                n = 0
+                for a, b in zip(chunk, rem):
+                    if a != b:
+                        break
+                    n += 1
+                if n > best_len:
+                    best_len, cow_src = n, node.page
+        if best_len < self.min_partial:
+            cow_src, best_len = None, 0
+        matched = pos + best_len
+        if matched:
+            self.hits += 1
+            self.matched_tokens += matched
+            self.alloc.retain(pages)
+            if cow_src is not None:
+                self.alloc.retain([cow_src])
+        else:
+            self.misses += 1
+        return pages, matched, cow_src
+
+    def insert(self, ids, pages: List[int]) -> int:
+        """Adopt the full-page prompt chunks of a freshly prefilled row.
+
+        ``pages`` is the row's page-table row (prompt pages first).
+        Chunks already in the trie are skipped; each newly adopted page
+        gains one trie reference.  Returns the number of pages adopted.
+        """
+        ps = self.page_size
+        self._tick += 1
+        ids = list(ids)
+        adopted = 0
+        children = self._root
+        parent: Optional[_TrieNode] = None
+        for i in range(len(ids) // ps):
+            chunk = tuple(ids[i * ps:(i + 1) * ps])
+            node = children.get(chunk)
+            if node is None:
+                page = pages[i]
+                self.alloc.retain([page])
+                node = _TrieNode(chunk, page, parent, self._tick)
+                children[chunk] = node
+                self.nodes += 1
+                self.inserted_pages += 1
+                adopted += 1
+            else:
+                node.tick = self._tick
+            parent = node
+            children = node.children
+        return adopted
+
+    def evict(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` cold trie pages, LRU leaves first.
+
+        Only pages whose *sole* remaining reference is the trie's own
+        are eligible — anything a live row still maps stays put.
+        Evicting a leaf can expose its parent, so sweep until satisfied
+        or nothing is evictable.  Returns the number of pages freed.
+        """
+        freed = 0
+        while freed < n_pages:
+            leaves = [n for n in self._iter_nodes()
+                      if not n.children and self.alloc.refcount(n.page) == 1]
+            if not leaves:
+                break
+            leaves.sort(key=lambda n: n.tick)
+            for node in leaves:
+                if freed >= n_pages:
+                    break
+                siblings = (node.parent.children if node.parent is not None
+                            else self._root)
+                del siblings[node.chunk]
+                self.alloc.free([node.page])
+                self.nodes -= 1
+                self.evicted_pages += 1
+                freed += 1
+        return freed
+
+    def drop_all(self) -> int:
+        """Release every trie reference (engine teardown / tests).
+        Pages shared with live rows survive until those rows retire."""
+        nodes = list(self._iter_nodes())
+        for node in nodes:
+            self.alloc.free([node.page])
+        self._root = {}
+        self.nodes = 0
+        return len(nodes)
+
+    def _iter_nodes(self):
+        stack = list(self._root.values())
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(node.children.values())
+
+    def stats(self) -> dict:
+        """Lifetime trie gauges for engine stats / heartbeats."""
+        return {
+            'nodes': self.nodes,
+            'hits': self.hits,
+            'misses': self.misses,
+            'matched_tokens': self.matched_tokens,
+            'inserted_pages': self.inserted_pages,
+            'evicted_pages': self.evicted_pages,
+        }

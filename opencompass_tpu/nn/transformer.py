@@ -1200,7 +1200,8 @@ def broadcast_cache(cache: Dict, batch: int) -> Dict:
 def paged_step(params: Params, cfg: TransformerConfig, tokens: jax.Array,
                start: jax.Array, n_new: jax.Array,
                page_table: jax.Array, pool: Dict, page_size: int,
-               ragged_kernel: bool = False
+               ragged_kernel: bool = False,
+               all_logits: bool = False
                ) -> Tuple[jax.Array, Dict]:
     """One continuous-batching step over a fixed slot set with ragged
     lengths (paged KV — nn/paged_kv.py).
@@ -1216,7 +1217,11 @@ def paged_step(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     sequence is RoPE position ``i``, no padding offsets — and each
     slot's attention spans only its own gathered pages, so one compiled
     (slots, T) shape serves every mix of in-flight lengths.  Returns
-    (last-real-position logits (slots, V), pool).
+    (last-real-position logits (slots, V), pool) — or, with
+    ``all_logits=True``, the logits at EVERY chunk position
+    ((slots, T, V), pool): teacher-forced scoring of a multi-token
+    chunk for speculative-decoding verification (nn/decode.py's
+    ``paged_verify_step``).
 
     ``ragged_kernel=True`` asks for the Pallas ragged-paged-attention
     read path (attention computed in place over the pool pages — no
@@ -1247,6 +1252,8 @@ def paged_step(params: Params, cfg: TransformerConfig, tokens: jax.Array,
     x, pool = _stack(cfg, x, params['layers'], positions, mask,
                      cache=pool, paged=(page_rows, offsets, page_table),
                      ragged=(start, n_new) if use_ragged else None)
+    if all_logits:
+        return _unembed(params, cfg, x), pool
     last = jnp.maximum(n_new - 1, 0)
     x_last = jnp.take_along_axis(x, last[:, None, None], axis=1)
     logits = _unembed(params, cfg, x_last)[:, 0, :]

@@ -414,6 +414,18 @@ class CostModel:
             bytes_kv_ideal=writes + kv_read_bytes(self.cfg,
                                                   kv_positions))
 
+    def prefill_saved(self, saved_tokens: float,
+                      saved_attn_positions: float = 0.0) -> float:
+        """FLOPs the radix prefix cache avoided in one drain: the
+        matmul work of the skipped prompt tokens plus the attention
+        work of the (query, key) pairs they would have attended
+        (``saved_attn_positions``, the engine's exact counter —
+        ``sum m*(m+1)/2`` over matched prefixes).  Pure accounting for
+        the per-drain ``flops_prefill_saved`` field; the savings are
+        already absent from the drain's measured ``flops``."""
+        return (flops_matmul(self.cfg, saved_tokens)
+                + flops_attention(self.cfg, saved_attn_positions))
+
     # -- utilizations ------------------------------------------------------
 
     def mfu(self, flops: float, seconds: float) -> Optional[float]:

@@ -88,6 +88,11 @@ PAD_COLLAPSE_EFF = 0.5
 PAD_COLLAPSE_MIN_TOKENS = 500
 GATHER_WASTE_RATIO = 4.0
 PREFILL_STALL_FRAC = 0.3
+# prefix_waste: fire when this fraction of a task's prompt tokens was
+# shareable across rows (host census per drain) but the radix prefix
+# cache saved (almost) none of it
+PREFIX_WASTE_SHARE = 0.3
+PREFIX_WASTE_MIN_SAVED = 0.05
 QUEUE_BACKLOG_AGE_S = 600.0
 SLOW_REQUEST_FACTOR = 2.0
 SHED_SUSTAINED_MIN = 5
@@ -455,6 +460,53 @@ def _rule_gather_waste(art: Dict) -> List[Dict]:
     return findings
 
 
+def _rule_prefix_waste(art: Dict) -> List[Dict]:
+    off, cold = [], []
+    for task, s in (art.get('timelines') or {}).items():
+        share = s.get('prefix_shareable_frac')
+        if share is None or share < PREFIX_WASTE_SHARE:
+            continue
+        saved = s.get('prefill_tokens_saved') or 0
+        prefilled = s.get('prefill_tokens') or 0
+        saved_frac = saved / max(saved + prefilled, 1)
+        if not s.get('prefix_cache_enabled'):
+            off.append((task, share))
+        elif saved_frac < PREFIX_WASTE_MIN_SAVED:
+            # cache on but (nearly) nothing reused: prompts churned
+            # past the trie (eviction) or diverge before a full page
+            cold.append((task, share, saved_frac))
+        # cache on and saving real prefill work → healthy, silent
+    findings = []
+    if off:
+        evidence = [f'{task}: {share:.0%} of prompt tokens were '
+                    'shareable across rows but every row prefilled '
+                    'from token zero'
+                    for task, share in off[:5]]
+        findings.append(_finding(
+            'warn', 'prefix_waste',
+            'rows re-prefill a shared prompt prefix the radix prefix '
+            'cache would serve from the KV pool',
+            evidence,
+            fix='enable prefix_cache=True on the JaxLM config (the '
+                'continuous engine then walks the token trie at '
+                'admission and prefills only each row\'s suffix) — '
+                'docs/user_guides/performance.md "Prefix cache & '
+                'speculative decoding"'))
+    if cold:
+        evidence = [f'{task}: {share:.0%} shareable but the trie saved '
+                    f'only {sf:.1%} of prefill tokens'
+                    for task, share, sf in cold[:5]]
+        findings.append(_finding(
+            'info', 'prefix_waste',
+            'the prefix cache is on but its hit-rate is near zero',
+            evidence,
+            fix='check for trie churn: a pool too small for the '
+                'working set evicts prefixes before reuse (raise '
+                'kv_pool_pages), and prefixes shorter than one page '
+                'never enter the trie (shrink kv_page_size)'))
+    return findings
+
+
 def _rule_slo_breach(art: Dict) -> List[Dict]:
     active = art.get('alerts_active') or []
     if not active:
@@ -813,6 +865,7 @@ RULES: List[Callable[[Dict], List[Dict]]] = [
     _rule_model_drift,
     _rule_prefill_stall,
     _rule_gather_waste,
+    _rule_prefix_waste,
     _rule_queue_backlog,
     _rule_overload_shedding,
     _rule_obs_disk_pressure,

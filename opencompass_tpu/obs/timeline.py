@@ -398,6 +398,25 @@ def summarize_records(records: List[Dict]) -> Dict:
         'gather_share': gather_share,
         'gather_share_source': ('measured' if 'measured' in gs_sources
                                 else 'modeled') if gs_sources else None,
+        # prefix-cache / speculative rollup over engine drains: the
+        # measured shareable headroom (host census per drain, worst
+        # case = max) vs what the radix trie actually saved, and the
+        # draft's acceptance — the doctor's prefix_waste evidence
+        'prefix_cache_enabled': (
+            any(r.get('prefix_cache_enabled') for r in engines)
+            if engines else None),
+        'prefix_shareable_frac': (
+            max((r.get('prefix_shareable_frac') or 0.0
+                 for r in engines), default=0.0) or None)
+        if engines else None,
+        'prefill_tokens_saved': sum(
+            r.get('prefill_tokens_saved') or 0 for r in engines)
+        if engines else None,
+        'spec_accept_rate': (
+            round(sum(r.get('spec_accepted') or 0 for r in engines)
+                  / max(sum(r.get('spec_proposed') or 0
+                            for r in engines), 1), 4)
+            if any(r.get('spec_proposed') for r in engines) else None),
     }
 
 

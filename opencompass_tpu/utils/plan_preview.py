@@ -309,6 +309,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                   f"/{c['slots']}, ~{c['est_pages_per_row']} pages/row; "
                   f"compile shapes: {shapes_txt}; "
                   f"kv read: {c.get('kv_read_path', 'gather_fallback')}")
+            reuse = c.get('prefix_reuse')
+            if reuse:
+                state = ('on' if c.get('prefix_cache')
+                         else 'off — set prefix_cache=True to claim')
+                print(f"    prefix reuse: ~{reuse['est_prefill_tokens_saved']}"
+                      f" prefill tokens ({reuse['est_saved_frac']:.1%}) and "
+                      f"~{reuse['est_pages_saved']} KV pages skippable via "
+                      f"radix cache (cache {state})")
     pref_rows = [r for r in results if r.get('prefix')]
     if pref_rows:
         print('\nshared-prefix census (token-level common prefix across '

@@ -48,9 +48,9 @@ def test_allocator_rejects_tiny_pool():
 
 
 def test_allocator_randomized_join_retire_never_leaks_or_aliases():
-    """200 randomized join/retire ops: live rows' page sets stay
-    disjoint, the ledger always balances, and a full drain returns the
-    allocator to pristine."""
+    """200 randomized join/retire ops: exclusively-owned pages stay
+    disjoint across rows, the ledger always balances, and a full drain
+    returns the allocator to pristine."""
     rng = random.Random(11)
     alloc = PageAllocator(64)
     live = {}     # row id -> pages
@@ -75,6 +75,73 @@ def test_allocator_randomized_join_retire_never_leaks_or_aliases():
     for pages in live.values():
         alloc.free(pages)
     assert alloc.n_free == 63 and alloc.n_allocated == 0
+
+
+def test_allocator_randomized_shared_refcounts_balance():
+    """Randomized join/retire with prefix sharing (the radix-trie usage
+    pattern): rows may retain a prefix of another live row's pages.  The
+    per-page refcount must always equal the number of live holders, the
+    ``n_allocated``/``n_shared`` gauges count each shared page once, and
+    a full drain returns the allocator to pristine."""
+    rng = random.Random(23)
+    alloc = PageAllocator(64)
+    live = {}     # row id -> pages (shared prefix + owned suffix)
+    next_row = 0
+    for _ in range(300):
+        roll = rng.random()
+        if live and (roll < 0.40 or alloc.n_free < 6):
+            row = rng.choice(sorted(live))
+            alloc.free(live.pop(row))
+        elif live and roll < 0.65:
+            # join sharing a prefix of an existing row (trie hit)
+            donor = live[rng.choice(sorted(live))]
+            k = rng.randint(1, len(donor))
+            fresh = rng.randint(0, min(3, alloc.n_free))
+            shared = donor[:k]
+            alloc.retain(shared)
+            live[next_row] = shared + (alloc.alloc(fresh) if fresh
+                                       else [])
+            next_row += 1
+        else:
+            need = rng.randint(1, 5)
+            if need > alloc.n_free:
+                continue
+            live[next_row] = alloc.alloc(need)
+            next_row += 1
+        # invariants after every op
+        counts = {}
+        for pages in live.values():
+            for p in pages:
+                counts[p] = counts.get(p, 0) + 1
+        assert GARBAGE_PAGE not in counts
+        for p, n in counts.items():
+            assert alloc.refcount(p) == n, \
+                f'page {p}: refcount {alloc.refcount(p)} != {n} holders'
+        # gauges count distinct pages, not references
+        assert alloc.n_allocated == len(counts)
+        assert alloc.n_shared == sum(1 for n in counts.values() if n > 1)
+        assert alloc.n_free + len(counts) == 63
+    for pages in live.values():
+        alloc.free(pages)
+    assert alloc.n_free == 63
+    assert alloc.n_allocated == 0 and alloc.n_shared == 0
+
+
+def test_allocator_shared_page_over_free_raises():
+    """Freeing a shared page once per holder is fine; one more free past
+    a zero refcount is a double free and must raise."""
+    alloc = PageAllocator(8)
+    (page,) = alloc.alloc(1)
+    alloc.retain([page])
+    assert alloc.refcount(page) == 2 and alloc.n_shared == 1
+    alloc.free([page])                 # still held by one row
+    assert alloc.refcount(page) == 1 and alloc.n_shared == 0
+    assert alloc.n_allocated == 1
+    alloc.free([page])                 # last holder -> recycled
+    with pytest.raises(AssertionError, match='double free|not allocated'):
+        alloc.free([page])
+    with pytest.raises(AssertionError, match='not allocated'):
+        alloc.retain([page])           # can't resurrect a freed page
 
 
 def test_page_table_assign_clear():

@@ -1,0 +1,411 @@
+"""Radix prefix cache over the paged KV pool + draft-model speculative
+decoding (ISSUE 19).
+
+Correctness bar: with the trie on, greedy outputs stay byte-identical
+to the trie-off engine while matched rows skip prefilling the shared
+prefix; with a draft model, speculative decoding stays token-identical
+to the plain engine under greedy sampling and falls back cleanly
+whenever its preconditions fail."""
+import json
+import threading
+
+import pytest
+from datasets import Dataset, DatasetDict
+
+from opencompass_tpu.datasets.base import BaseDataset
+from opencompass_tpu.icl.inferencers.gen import GenInferencer
+from opencompass_tpu.icl.prompt_template import PromptTemplate
+from opencompass_tpu.icl.retrievers import ZeroRetriever
+from opencompass_tpu.models import JaxLM
+from opencompass_tpu.nn.paged_kv import (GARBAGE_PAGE, PageAllocator,
+                                         RadixPrefixCache)
+
+READER_CFG = dict(input_columns=['question'], output_column='answer')
+SHARED = 'Q: what color is the sky over the harbor at noon? A: blue. ' * 8
+KW = dict(config='tiny', max_seq_len=512, continuous_batching=True,
+          decode_slots=4, kv_page_size=16)
+
+
+def _prompts(n, tag='item'):
+    return [SHARED + f'Q: {tag} {i}? A:' for i in range(n)]
+
+
+@pytest.fixture(scope='module')
+def lm_plain():
+    return JaxLM(**KW)
+
+
+@pytest.fixture(scope='module')
+def lm_cached():
+    return JaxLM(prefix_cache=True, **KW)
+
+
+# -- trie unit ---------------------------------------------------------------
+
+def test_trie_match_insert_refcounts():
+    """insert() adopts full-page chunks with one trie reference each;
+    match() returns them retained for the caller and always leaves at
+    least one suffix token unmatched."""
+    alloc = PageAllocator(32)
+    trie = RadixPrefixCache(alloc, 4, min_partial=2)
+    ids = list(range(12))
+    pages = alloc.alloc(3)
+    assert trie.insert(ids, pages) == 3
+    assert all(alloc.refcount(p) == 2 for p in pages)
+    assert trie.insert(ids, pages) == 0          # idempotent
+    assert trie.nodes == 3
+
+    got, n, cow = trie.match(ids)
+    # the exact same prompt matches 2 full pages + a partial third —
+    # never all 12 tokens (the final chunk must prefill for logits)
+    assert got == pages[:2] and cow == pages[2] and n == 11
+    assert all(alloc.refcount(p) == 3 for p in pages)
+    alloc.free(got + [cow])                       # caller's references
+    assert all(alloc.refcount(p) == 2 for p in pages)
+    assert trie.hits == 1 and trie.matched_tokens == 11
+
+    got, n, cow = trie.match([99] * 12)           # no overlap
+    assert got == [] and n == 0 and cow is None
+    assert trie.misses == 1
+    assert GARBAGE_PAGE not in pages
+
+
+def test_trie_partial_match_copy_on_write_threshold():
+    """A divergent chunk yields a COW source only when the common
+    prefix clears ``min_partial``."""
+    alloc = PageAllocator(16)
+    ids_a = [1, 2, 3, 4, 5, 6, 7, 8]
+    pages_a = alloc.alloc(2)
+    trie = RadixPrefixCache(alloc, 4, min_partial=2)
+    assert trie.insert(ids_a, pages_a) == 2
+
+    ids_b = ids_a[:6] + [77] * 6                  # diverges mid-page-2
+    got, n, cow = trie.match(ids_b)
+    assert got == pages_a[:1] and n == 6 and cow == pages_a[1]
+    assert alloc.refcount(pages_a[1]) == 3        # row + trie + cow ref
+    alloc.free(got + [cow])
+
+    strict = RadixPrefixCache(alloc, 4, min_partial=3)
+    assert strict.insert(ids_a, pages_a) == 2     # its own references
+    got, n, cow = strict.match(ids_b)
+    assert got == pages_a[:1] and n == 4 and cow is None
+    alloc.free(got)
+
+
+def test_trie_evict_lru_spares_shared_pages():
+    """evict() frees cold leaves whose only reference is the trie's;
+    pages a live row still maps are never touched."""
+    alloc = PageAllocator(16)
+    trie = RadixPrefixCache(alloc, 4)
+    ids_a, ids_b = [1] * 8, [2] * 8
+    pages_a, pages_b = alloc.alloc(2), alloc.alloc(2)
+    trie.insert(ids_a, pages_a)
+    trie.insert(ids_b, pages_b)
+    alloc.free(pages_a)                           # row A retired
+    assert trie.evict(10) == 2                    # A's leaf, then head
+    assert trie.nodes == 2 and trie.evicted_pages == 2
+    assert all(alloc.refcount(p) == 0 for p in pages_a)
+    assert all(alloc.refcount(p) == 2 for p in pages_b)
+    alloc.free(pages_b)                           # row B retired
+    assert trie.evict(10) == 2
+    assert alloc.n_allocated == 0 and trie.nodes == 0
+
+
+# -- engine: prefix cache ----------------------------------------------------
+
+def test_engine_prefix_cache_identity_and_savings(lm_plain, lm_cached):
+    """>=70%-shared workload: the trie halves prefill tokens (ISSUE
+    floor) while outputs stay byte-identical; a second drain reuses the
+    warm trie; retired rows leave only the trie's own references."""
+    prompts = _prompts(12)
+    eng_off = lm_plain.continuous_engine()
+    p0 = eng_off.prefill_tokens
+    ref = lm_plain.generate_continuous(prompts, 6)
+    off_prefill = eng_off.prefill_tokens - p0
+
+    stats_out = {}
+    out = lm_cached.generate_continuous(prompts, 6, stats_out=stats_out)
+    assert out == ref
+    engine = lm_cached.continuous_engine()
+    st = engine.stats()
+    assert st['prefix_cache_enabled'] and st['prefix_hits'] > 0
+    assert st['prefill_tokens_saved'] > 0
+    assert stats_out['prefill_tokens_saved'] == st['prefill_tokens_saved']
+    assert engine.prefill_tokens <= 0.5 * off_prefill
+    assert st['prefix_cache']['nodes'] > 0
+    # every page still allocated after the drain is a trie reference
+    assert engine.alloc.n_allocated == engine.prefix.nodes
+
+    out2 = lm_cached.generate_continuous(prompts, 6)   # warm trie
+    assert out2 == ref
+    st2 = engine.stats()
+    assert st2['prefill_tokens_saved'] > st['prefill_tokens_saved']
+    assert lm_cached.continuous_plan()['prefix_cache'] is True
+    assert 'prefix_cache' not in lm_plain.continuous_plan()
+
+
+def test_engine_prefix_eviction_under_pool_pressure():
+    """Distinct prefixes overflow a small pool: admission evicts cold
+    trie pages instead of failing, and outputs stay correct."""
+    kw = dict(config='tiny', max_seq_len=128, continuous_batching=True,
+              decode_slots=2, kv_page_size=16)
+    prompts = ['row %d ' % i
+               + ' '.join('w%d_%d' % (i, j) for j in range(28)) + ' ?'
+               for i in range(8)]
+    ref = JaxLM(**kw).generate_continuous(prompts, 4)
+    lm = JaxLM(prefix_cache=True, **kw)
+    assert lm.generate_continuous(prompts, 4) == ref
+    engine = lm.continuous_engine()
+    assert engine.prefix.evicted_pages > 0
+    assert engine.alloc.n_allocated == engine.prefix.nodes
+
+
+def test_concurrent_interactive_rows_share_pages(lm_plain, lm_cached):
+    """A second thread's interactive rows join the cached engine
+    mid-drain and hit the same trie pages the sweep rows map — sibling
+    outputs stay uncorrupted on both sides."""
+    sweep_prompts = _prompts(10, 'sweep')
+    inter_prompts = _prompts(2, 'join')
+    ref_sweep = lm_plain.generate_continuous(sweep_prompts, 8)
+    ref_inter = lm_plain.generate_continuous(inter_prompts, 8)
+
+    engine = lm_cached.continuous_engine()
+    hits0 = engine.prefix.hits
+    results = {}
+    started = threading.Event()
+
+    def sweep():
+        def on_result(i, text):
+            started.set()
+            results[i] = text
+        results['sweep'] = lm_cached.generate_continuous(
+            sweep_prompts, 8, on_result=on_result)
+
+    thread = threading.Thread(target=sweep)
+    thread.start()
+    try:
+        assert started.wait(60)
+        ids = [lm_cached._encode_ids(p) for p in inter_prompts]
+        rows = [engine.submit(r, 8, tag=k, interactive=True)
+                for k, r in enumerate(ids)]
+        inter_out = [None, None]
+
+        def deliver(row):
+            toks = [t for t in row.emitted
+                    if t != lm_cached.eos_token_id]
+            inter_out[row.tag] = lm_cached.tokenizer.decode(toks)
+
+        engine.drain(rows, deliver, timeout=120)
+    finally:
+        thread.join(120)
+    assert results['sweep'] == ref_sweep
+    assert inter_out == ref_inter
+    assert engine.prefix.hits > hits0
+    assert engine.alloc.n_allocated == engine.prefix.nodes
+
+
+# -- engine: speculative decoding --------------------------------------------
+
+def test_speculative_identity_and_accept_rate(lm_plain):
+    """Draft-model speculative decoding emits token-identical greedy
+    output (every emitted token is the target's verify-lane argmax) and
+    surfaces its acceptance rate per drain."""
+    prompts = _prompts(6)
+    ref = lm_plain.generate_continuous(prompts, 12)
+    lm = JaxLM(draft_model=dict(config='tiny', max_seq_len=512),
+               draft_k=4, **KW)
+    assert lm.speculative_eligible and lm.speculative_active
+    stats_out = {}
+    out = lm.generate_continuous(prompts, 12, stats_out=stats_out)
+    assert out == ref
+    engine = lm.continuous_engine()
+    assert engine.spec and engine.spec_k == 4
+    st = engine.stats()
+    assert st['speculative'] and st['spec_proposed'] > 0
+    assert st['spec_accepted'] <= st['spec_proposed']
+    assert 0.0 < st['spec_accept_rate'] <= 1.0
+    assert stats_out['spec_accept_rate'] == st['spec_accept_rate']
+    plan = lm.continuous_plan()['speculative']
+    assert plan == {'draft_k': 4, 'eligible': True, 'verify_shape': '4x5'}
+
+
+def test_speculative_fallback_pins():
+    """Every precondition failure degrades to the plain engine path —
+    never an error: no draft config, draft_k < 1, stochastic sampling,
+    and a draft without resident params."""
+    base = dict(config='tiny', max_seq_len=256, tokenizer_only=True,
+                continuous_batching=True, decode_slots=2,
+                kv_page_size=16)
+    draft = dict(config='tiny', tokenizer_only=True)
+    assert not JaxLM(**base).speculative_eligible
+    assert not JaxLM(draft_model=draft, draft_k=0,
+                     **base).speculative_eligible
+    assert not JaxLM(draft_model=draft,
+                     generation_kwargs=dict(do_sample=True,
+                                            temperature=0.7),
+                     **base).speculative_eligible
+    lm = JaxLM(draft_model=draft, **base)
+    assert lm.speculative_eligible           # device-free gate passes
+    assert not lm.speculative_active         # ...but no resident params
+    assert 'speculative' not in JaxLM(**base).continuous_plan()
+
+
+# -- store: kill/resume with a warm trie -------------------------------------
+
+class SharedPrefixDataset(BaseDataset):
+    @staticmethod
+    def load(n_test=10):
+        ctx = ('the harbor master logs every vessel arriving before '
+               'noon and files a daily report with the port '
+               'authority. ') * 3
+        rows = [{'question': ctx + f'what is log entry {i}?',
+                 'answer': 'A'} for i in range(n_test)]
+        return DatasetDict({'train': Dataset.from_list(rows[:2]),
+                            'test': Dataset.from_list(rows)})
+
+
+class _CrashAfterLM(JaxLM):
+    """Delivers N rows through the continuous path, then dies with the
+    radix trie warm and shared pages mapped by in-flight rows."""
+
+    def __init__(self, crash_after, **kw):
+        super().__init__(**kw)
+        self.crash_after = crash_after
+
+    def generate_continuous(self, inputs, max_out_len, on_result=None,
+                            **kw):
+        delivered = [0]
+
+        def wrapped(i, text):
+            if delivered[0] >= self.crash_after:
+                raise KeyboardInterrupt('injected mid-engine kill')
+            delivered[0] += 1
+            if on_result is not None:
+                on_result(i, text)
+        return super().generate_continuous(inputs, max_out_len,
+                                           on_result=wrapped, **kw)
+
+
+def test_kill_resume_with_shared_pages(tmp_path, monkeypatch):
+    """Mid-sweep kill while trie pages are shared across live rows:
+    committed rows survive in the store, the restart recomputes only
+    the missing rows, converges bit-identical to a clean run, and
+    leaves zero duplicate store keys."""
+    from opencompass_tpu import store as S
+    kw = dict(config='tiny', max_seq_len=512, continuous_batching=True,
+              decode_slots=2, kv_page_size=16, prefix_cache=True)
+    model_cfg = {'type': 'JaxLM', 'path': 'tiny-prefix',
+                 'config': 'tiny'}
+    ds = SharedPrefixDataset(reader_cfg=READER_CFG)
+    template = PromptTemplate('Q: {question}\nA: {answer}')
+
+    def bound(model):
+        S.bind_model_store(model, model_cfg)
+        return model
+
+    def infer(sub, model):
+        inf = GenInferencer(model=model, max_out_len=5, batch_size=4,
+                            output_json_filepath=str(tmp_path / sub),
+                            batch_plan=True)
+        return inf.inference(ZeroRetriever(ds),
+                             prompt_template=template)
+
+    ref_cache = str(tmp_path / 'cache_ref')
+    monkeypatch.setenv('OCT_CACHE_ROOT', ref_cache)
+    S.reset_stores()
+    ref = infer('ref', bound(JaxLM(**kw)))
+
+    cache_root = str(tmp_path / 'cache')
+    monkeypatch.setenv('OCT_CACHE_ROOT', cache_root)
+    S.reset_stores()
+    with pytest.raises(KeyboardInterrupt):
+        infer('crash', bound(_CrashAfterLM(3, **kw)))
+
+    S.reset_stores()
+    resumed = bound(JaxLM(**kw))
+    out = infer('resume', resumed)
+    assert out == ref
+    assert resumed.perf.samples == 10 - 3    # only the missing rows
+    verdict = S.open_store().verify()
+    assert verdict['ok'] and verdict['duplicate_keys'] == 0
+    assert verdict['rows'] == 10
+
+
+# -- observability: rollup, doctor, plan -------------------------------------
+
+def test_timeline_rollup_prefix_and_spec():
+    from opencompass_tpu.obs.timeline import summarize_records
+    recs = [
+        {'t': 'engine', 'prefix_cache_enabled': True,
+         'prefix_shareable_frac': 0.74, 'prefill_tokens': 300,
+         'prefill_tokens_saved': 700, 'spec_proposed': 40,
+         'spec_accepted': 30},
+        {'t': 'engine', 'prefix_cache_enabled': True,
+         'prefix_shareable_frac': 0.5, 'prefill_tokens': 100,
+         'prefill_tokens_saved': 100, 'spec_proposed': 10,
+         'spec_accepted': 10},
+    ]
+    s = summarize_records(recs)
+    assert s['prefix_cache_enabled'] is True
+    assert s['prefix_shareable_frac'] == 0.74
+    assert s['prefill_tokens_saved'] == 800
+    assert s['spec_accept_rate'] == 0.8
+    empty = summarize_records([])
+    assert empty['prefix_cache_enabled'] is None
+    assert empty['spec_accept_rate'] is None
+
+
+def test_doctor_prefix_waste_rule():
+    """warn when a high-share sweep ran with the cache off, info when
+    the cache is on but never hits, silent when healthy or when the
+    census says there is nothing to share."""
+    from opencompass_tpu.obs.doctor import _rule_prefix_waste
+
+    def art(**kw):
+        base = dict(prefill_tokens=1000)
+        base.update(kw)
+        return {'timelines': {'task': base}}
+
+    f = _rule_prefix_waste(art(prefix_shareable_frac=0.8,
+                               prefill_tokens_saved=0,
+                               prefix_cache_enabled=False))
+    assert [x['severity'] for x in f] == ['warn']
+    assert f[0]['rule'] == 'prefix_waste' and 'prefix_cache=True' \
+        in f[0]['fix']
+    f = _rule_prefix_waste(art(prefix_shareable_frac=0.8,
+                               prefill_tokens_saved=10,
+                               prefix_cache_enabled=True))
+    assert [x['severity'] for x in f] == ['info']
+    assert _rule_prefix_waste(art(prefix_shareable_frac=0.8,
+                                  prefill_tokens_saved=900,
+                                  prefix_cache_enabled=True)) == []
+    assert _rule_prefix_waste(art(prefix_shareable_frac=0.1,
+                                  prefill_tokens_saved=0,
+                                  prefix_cache_enabled=False)) == []
+    assert _rule_prefix_waste(art()) == []
+
+
+def test_plan_preview_reports_prefix_reuse(tmp_path):
+    """`cli plan` pre-flight: the continuous block carries the expected
+    trie reuse — census prefix share x rows -> est. prefill tokens and
+    pages saved (device-free; tokenizer_only)."""
+    ds = SharedPrefixDataset(reader_cfg=READER_CFG)
+    template = PromptTemplate('Q: {question}\nA: {answer}')
+    lm = JaxLM(config='tiny', max_seq_len=512, tokenizer_only=True,
+               continuous_batching=True, decode_slots=4,
+               kv_page_size=16, prefix_cache=True)
+    inf = GenInferencer(model=lm, max_out_len=5, batch_size=4,
+                        output_json_filepath=str(tmp_path / 'plan'),
+                        batch_plan=True)
+    preview = inf.plan_preview(ZeroRetriever(ds),
+                               prompt_template=template)
+    cont = preview['continuous']
+    assert cont['prefix_cache'] is True
+    reuse = cont['prefix_reuse']
+    census = preview['prefix']
+    assert reuse['est_prefill_tokens_saved'] == \
+        census['prefix_tokens'] * (cont['rows'] - 1)
+    assert reuse['est_pages_saved'] == \
+        (census['prefix_tokens'] // 16) * (cont['rows'] - 1)
+    assert 0.0 < reuse['est_saved_frac'] <= 1.0
+    assert json.dumps(preview)               # stays JSON-serializable
