@@ -1705,6 +1705,118 @@ def _bench_chaos(out_json='BENCH_CHAOS.json'):
     return record
 
 
+def _bench_loadgen(out_json='BENCH_LOADGEN.json'):
+    """detail.loadgen: the replay load generator (opencompass_tpu/
+    loadgen) against a live autoscaler-enabled daemon, every request
+    streamed over SSE so TTFT is a measured first-byte delivery
+    timestamp and ITL comes from inter-frame gaps at the client.
+
+    Two legs: (1) a recorded trace replayed at 20x its native pacing
+    (``arrival='replay'``) — the measured compression factor is the
+    replay-speedup number and must clear 10x; (2) a sustained open-loop
+    Poisson step that the autoscaler has to absorb — the gap between
+    step start and the first journaled scale-up decision is the
+    elasticity latency.  Device-free (continuous FakeModel)."""
+    import os.path as osp
+    import shutil
+    import tempfile
+
+    from opencompass_tpu.analysis.chaos import ChaosDaemon, _jsonl
+    from opencompass_tpu.loadgen.replay import run_load, synth_trace
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    workdir = tempfile.mkdtemp(prefix='oct_loadgen_')
+    extra = (
+        'autoscaler = dict(min_replicas=1, max_replicas=3,\n'
+        '                  interval_s=0.25, scale_up_cooldown_s=1.0,\n'
+        '                  scale_down_cooldown_s=2.0,\n'
+        '                  up_queue_eta_s=5.0, up_slot_util=0.2,\n'
+        '                  down_slot_util=0.5, up_consecutive=2,\n'
+        '                  down_consecutive=6)\n')
+    daemon = ChaosDaemon(workdir, max_inflight=8, extra_cfg=extra)
+    try:
+        daemon.start()
+        host = '127.0.0.1'
+        port = int(daemon.base.rsplit(':', 1)[1])
+
+        # -- leg 1: recorded-timestamp replay, 20x compression.  The
+        # trace's native span is (n-1)/rate seconds; light service time
+        # so the measured wall is arrival-schedule-bound, not
+        # seat-bound.
+        daemon.set_sleep(0.01)
+        n_replay, native_rate, compress = 40, 0.5, 20.0
+        trace = synth_trace(n_replay, 'fake-chaos', rate=native_rate,
+                            max_tokens=8, prefix='Q: replay row')
+        native_span_s = (trace[-1]['ts'] - trace[0]['ts'])
+        replay = run_load(host, port, trace, stream=True,
+                          arrival='replay', speedup=compress, seed=3)
+        replay_speedup = native_span_s / max(replay['wall_s'], 1e-9)
+
+        # -- leg 2: sustained 10x Poisson step; scale-up latency is
+        # first journaled 'up' ts minus step start (both wall-clock)
+        daemon.set_sleep(0.2)
+        t_step = time.time()
+        stepped = run_load(
+            host, port,
+            synth_trace(60, 'fake-chaos', rate=1.5, max_tokens=8,
+                        prefix='Q: bench step row'),
+            stream=True, arrival='poisson', speedup=10.0, seed=11)
+        daemon.set_sleep(0)
+        ups = [r for r in _jsonl(osp.join(daemon.serve_obs_dir,
+                                          'autoscaler.jsonl'))
+               if r.get('direction') == 'up' and r.get('ts')]
+        scale_up_latency_s = (min(r['ts'] for r in ups) - t_step) \
+            if ups else None
+
+        record = {
+            'workload': f'replay {n_replay} recorded rows at '
+                        f'{compress:.0f}x native pacing + 60-row '
+                        '10x Poisson step vs one autoscaler-enabled '
+                        'daemon (FakeModel, SSE streaming on every '
+                        'request)',
+            'replay': {
+                'native_span_s': round(native_span_s, 2),
+                'wall_s': replay['wall_s'],
+                'speedup': round(replay_speedup, 2),
+                'completed': replay['completed'],
+                'errors': replay['errors'],
+            },
+            'sustained_rps': stepped['sustained_rps'],
+            'offered_rps': stepped['offered_rps'],
+            'ttft_p95_ms': stepped['ttft_ms']['p95'],
+            'itl_p99_ms': stepped['itl_ms']['p99'],
+            'frames_total': stepped['frames_total'],
+            'scale_ups': len(ups),
+            'scale_up_latency_s': (round(scale_up_latency_s, 3)
+                                   if scale_up_latency_s is not None
+                                   else None),
+            'shed': stepped['status_counts'].get('429', 0),
+        }
+    finally:
+        daemon.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+    path = os.path.join(here, out_json)
+    try:
+        with open(path, 'w') as f:
+            json.dump(record, f, indent=2)
+    except OSError:
+        pass
+    if record['ttft_p95_ms'] is not None:
+        _append_trajectory(
+            'loadgen', 'ttft_p95_ms', record['ttft_p95_ms'], 'ms',
+            direction='lower',
+            detail={'itl_p99_ms': record['itl_p99_ms'],
+                    'frames_total': record['frames_total'],
+                    'scale_up_latency_s': record['scale_up_latency_s']})
+    _append_trajectory(
+        'loadgen', 'sustained_rps', record['sustained_rps'], 'rps',
+        direction='higher',
+        detail={'offered_rps': record['offered_rps'],
+                'replay_speedup': record['replay']['speedup'],
+                'shed': record['shed']})
+    return record
+
+
 def _bench_outbound(out_json='BENCH_OUTBOUND.json'):
     """detail.outbound: API-sweep wall-clock through the outbound
     scheduler (AIMD in-flight window + Retry-After pacing + budgeted
@@ -2193,6 +2305,13 @@ if __name__ == '__main__':
         # real daemon, degradation invariants asserted (device-free)
         print(json.dumps({'metric': 'chaos', 'v': 1,
                           'detail': _bench_chaos()}))
+        sys.exit(0)
+    if '--loadgen' in sys.argv:
+        # standalone load-generator leg: recorded-trace replay at >=10x
+        # native pacing + a Poisson step vs a live autoscaler-enabled
+        # daemon, SSE streaming throughout (device-free)
+        print(json.dumps({'metric': 'loadgen', 'v': 1,
+                          'detail': _bench_loadgen()}))
         sys.exit(0)
     if '--outbound' in sys.argv:
         # standalone outbound-API-scheduler leg: sweep wall-clock vs

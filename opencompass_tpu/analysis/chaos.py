@@ -55,7 +55,8 @@ import urllib.request
 from typing import Dict, List, Optional
 
 CHECK_EXIT = 2
-QUICK_SCENARIOS = ('overload_burst', 'stuck_worker', 'flaky_api')
+QUICK_SCENARIOS = ('overload_burst', 'stuck_worker', 'flaky_api',
+                   'traffic_step')
 # the degradation objective: admitted-traffic p99 while shedding.
 # Generous vs the 0.4s injected service time x ceiling-2 concurrency —
 # the invariant is "bounded by admission", not "fast on a loaded CI box"
@@ -100,7 +101,8 @@ class ChaosDaemon:
     interactive admission ceiling, and a paced continuous FakeModel —
     all device-free."""
 
-    def __init__(self, workdir: str, max_inflight: int = MAX_INFLIGHT):
+    def __init__(self, workdir: str, max_inflight: int = MAX_INFLIGHT,
+                 extra_cfg: str = ''):
         self.root = osp.abspath(workdir)
         os.makedirs(self.root, exist_ok=True)
         self.cache_root = osp.join(self.root, 'cache')
@@ -126,6 +128,7 @@ models = [dict(type=FakeModel, abbr='fake-chaos', path='fake',
 admission = dict(max_inflight={int(max_inflight)}, max_queue_depth=2)
 slo_eval_interval_s = 0.5
 work_dir = {osp.join(self.root, 'out')!r}
+{extra_cfg}
 """)
 
     # -- lifecycle ----------------------------------------------------------
@@ -677,19 +680,128 @@ def scenario_flaky_api(daemon: Optional[ChaosDaemon] = None,
         provider.stop()
 
 
+def scenario_traffic_step(daemon: Optional[ChaosDaemon] = None,
+                          quick: bool = False) -> Dict:
+    """The ELASTICITY story: the replay load generator drives a 10×
+    arrival-rate step (open-loop Poisson, seeded — the schedule is
+    deterministic) against an autoscaler-enabled daemon.  The
+    autoscaler must *absorb* the step:
+
+    - at least one journaled scale-up decision lands during the step
+      (measured pressure → more replicas, no operator);
+    - no page-severity SLO alert fires at any point;
+    - the streamed traffic itself stays healthy — zero transport
+      failures, measured per-request TTFT on the step leg;
+    - (full mode) once the step ends, sustained idleness shrinks the
+      fleet back down — scale-up must not be a ratchet.
+
+    Runs on its own daemon (registered daemonless): the autoscaler
+    config and the loose admission ceiling here must not perturb the
+    other scenarios' tight-ceiling invariants."""
+    import tempfile
+
+    from opencompass_tpu.loadgen.replay import run_load, synth_trace
+
+    workdir = tempfile.mkdtemp(prefix='oct-chaos-traffic-')
+    # aggressive knobs: the scenario needs decisions in seconds, not
+    # the production-paced minutes
+    extra = (
+        'autoscaler = dict(min_replicas=1, max_replicas=3,\n'
+        '                  interval_s=0.25, scale_up_cooldown_s=1.0,\n'
+        '                  scale_down_cooldown_s=2.0,\n'
+        '                  up_queue_eta_s=5.0, up_slot_util=0.2,\n'
+        '                  down_slot_util=0.5, up_consecutive=2,\n'
+        '                  down_consecutive=6)\n')
+    step = ChaosDaemon(workdir, max_inflight=8, extra_cfg=extra)
+    try:
+        step.start()
+        host = '127.0.0.1'
+        port = int(step.base.rsplit(':', 1)[1])
+        # ~0.2 s injected service time: the step's offered load holds
+        # admission seats long enough to read as measured pressure
+        step.set_sleep(0.2)
+        n_base, n_step = (6, 45) if quick else (10, 150)
+        base_rate = 1.5
+        baseline = run_load(
+            host, port,
+            synth_trace(n_base, 'fake-chaos', rate=base_rate,
+                        max_tokens=8, prefix='Q: step baseline row'),
+            stream=True, arrival='poisson', speedup=1.0, seed=7)
+        stepped = run_load(
+            host, port,
+            synth_trace(n_step, 'fake-chaos', rate=base_rate,
+                        max_tokens=8, prefix='Q: step burst row'),
+            stream=True, arrival='poisson', speedup=10.0, seed=11)
+        step.set_sleep(0)
+        _check(baseline['completed'] > 0,
+               f'baseline leg completed nothing: {baseline}')
+        _check(stepped['completed'] > 0,
+               f'step leg completed nothing: {stepped}')
+        transport = stepped['status_counts'].get('transport', 0) \
+            + stepped['status_counts'].get('0', 0)
+        _check(transport == 0 and stepped['dropped_local'] == 0,
+               f'transport-level failures under the step: '
+               f'{stepped["status_counts"]} '
+               f'(dropped {stepped["dropped_local"]})')
+        _check(stepped['frames_total'] > 0
+               and stepped['ttft_ms']['p95'] is not None,
+               f'step leg streamed nothing measurable: {stepped}')
+        ups = [r for r in _jsonl(osp.join(step.serve_obs_dir,
+                                          'autoscaler.jsonl'))
+               if r.get('direction') == 'up']
+        _check(ups, 'the 10x step produced no scale-up decision — '
+                    'the autoscaler is inert')
+        health = step.health()
+        _check(health.code == 200,
+               f'/healthz answered {health.code} after the step')
+        alerts = step.http('GET', '/v1/alerts', timeout=10).payload
+        paged = [a for a in (alerts.get('active') or [])
+                 if a.get('severity') == 'page']
+        fired = [t for t in (alerts.get('recent') or [])
+                 if t.get('severity') == 'page' and t.get('t') == 'fire']
+        _check(not paged and not fired,
+               f'page-severity SLO breach during the step: '
+               f'active={paged} fired={fired}')
+        report = {'baseline_rps': baseline['sustained_rps'],
+                  'step_rps': stepped['sustained_rps'],
+                  'step_ttft_p95_ms': stepped['ttft_ms']['p95'],
+                  'step_itl_p99_ms': stepped['itl_ms']['p99'],
+                  'scale_ups': len(ups),
+                  'max_replicas_seen': max(r['to'] for r in ups),
+                  'shed': stepped['status_counts'].get('429', 0)}
+        if not quick:
+            # the fleet must come back down once the step ends
+            deadline = time.monotonic() + 30.0
+            downs = []
+            while time.monotonic() < deadline and not downs:
+                downs = [r for r in _jsonl(
+                    osp.join(step.serve_obs_dir, 'autoscaler.jsonl'))
+                    if r.get('direction') == 'down']
+                time.sleep(0.5)
+            _check(downs, 'fleet never scaled back down after the '
+                          'step ended — scale-up is a ratchet')
+            report['scale_downs'] = len(downs)
+        _check(step.alive(), 'daemon died during the traffic step')
+        return report
+    finally:
+        step.stop()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 SCENARIOS = {
     'overload_burst': scenario_overload_burst,
     'stuck_worker': scenario_stuck_worker,
     'worker_kill': scenario_worker_kill,
     'store_eio': scenario_store_eio,
     'flaky_api': scenario_flaky_api,
+    'traffic_step': scenario_traffic_step,
 }
 
 # scenarios that need no serve daemon (they drive the outbound stub
 # provider in-process) — `--scenario flaky_api` must not pay a daemon
 # spawn, and the run-wide access-log invariant only applies when a
 # daemon actually served traffic
-DAEMONLESS = {'flaky_api'}
+DAEMONLESS = {'flaky_api', 'traffic_step'}
 
 
 # -- runner -----------------------------------------------------------------

@@ -410,6 +410,17 @@ def obs_main(argv=None) -> int:
     return hub_main(argv)
 
 
+def loadgen_main(argv=None) -> int:
+    """``python -m opencompass_tpu.cli loadgen --port N ...`` — the
+    open-loop replay load generator: fire ``access.jsonl``-shaped
+    traffic at a running engine at 10–100× recorded speed (Poisson or
+    recorded-timestamp arrivals), streaming-aware (true per-request
+    TTFT / ITL from SSE deliveries), and write the durable report that
+    feeds the trajectory gate (docs/serving.md "Load generation")."""
+    from opencompass_tpu.loadgen.cli import main as loadgen_cli_main
+    return loadgen_cli_main(argv)
+
+
 def serve_main(argv=None) -> int:
     """``python -m opencompass_tpu.cli serve <config> [--port N]`` —
     the persistent evaluation engine: durable FIFO sweep queue under
@@ -447,6 +458,8 @@ def main():
         raise SystemExit(obs_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == 'chaos':
         raise SystemExit(chaos_main(sys.argv[2:]))
+    if len(sys.argv) > 1 and sys.argv[1] == 'loadgen':
+        raise SystemExit(loadgen_main(sys.argv[2:]))
     args = parse_args()
     cfg = get_config_from_arg(args)
     work_dir = cfg['work_dir']

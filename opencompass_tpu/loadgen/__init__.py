@@ -1,0 +1,13 @@
+"""Replay load generator for the serve front door (``cli loadgen``).
+
+Replays ``access.jsonl``-shaped traffic against a running engine at
+10–100× recorded speed — open-loop (arrivals never wait for
+completions, like real users) and streaming-aware (per-request TTFT /
+ITL measured from SSE chunk *deliveries*, not from response totals).
+See :mod:`opencompass_tpu.loadgen.replay` for the core and
+:mod:`opencompass_tpu.loadgen.cli` for the command.
+"""
+from opencompass_tpu.loadgen.replay import (REPORT_FILE,  # noqa: F401
+                                            build_arrivals, load_trace,
+                                            run_load, summarize,
+                                            synth_trace, write_report)

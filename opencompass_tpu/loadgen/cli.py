@@ -1,0 +1,106 @@
+"""``python -m opencompass_tpu.cli loadgen`` — replay load generator.
+
+Typical runs::
+
+    # replay a recorded access log at 20x, streaming, report to disk
+    cli loadgen --port 8080 --trace obs/serve/access.jsonl \
+        --arrival replay --speedup 20 --out loadgen_report.json
+
+    # synthetic open-loop Poisson at ~50 req/s for 500 requests
+    cli loadgen --port 8080 --model fake-tiny --requests 500 \
+        --rate 5 --speedup 10
+
+Exit code 0 when at least one request completed and no transport-level
+failure took the whole run down; 1 otherwise (``--check`` tightens
+this to "zero errors").
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Sequence
+from urllib.parse import urlsplit
+
+from opencompass_tpu.loadgen.replay import (build_arrivals, load_trace,
+                                            run_load, synth_trace,
+                                            write_report)
+
+
+def _target(args) -> tuple:
+    if args.target:
+        parts = urlsplit(args.target if '//' in args.target
+                         else f'//{args.target}')
+        return parts.hostname or '127.0.0.1', \
+            int(parts.port or args.port or 8080)
+    return '127.0.0.1', int(args.port or 8080)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog='loadgen',
+        description='open-loop replay load generator for the serve '
+                    'front door (docs/serving.md "Load generation")')
+    ap.add_argument('--target', help='engine URL or host:port')
+    ap.add_argument('--port', type=int, help='engine port on localhost')
+    ap.add_argument('--trace', help='access.jsonl-shaped recording; '
+                    'omit for a synthetic trace')
+    ap.add_argument('--model', help='catalog abbr (required for '
+                    'synthetic traces; overrides rows without one)')
+    ap.add_argument('--requests', type=int, default=100,
+                    help='synthetic trace size / trace row cap')
+    ap.add_argument('--rate', type=float, default=10.0,
+                    help='synthetic trace base rate, req/s')
+    ap.add_argument('--arrival', choices=('poisson', 'replay'),
+                    default='poisson')
+    ap.add_argument('--speedup', type=float, default=10.0,
+                    help='replay compression / Poisson rate multiplier')
+    ap.add_argument('--seed', type=int, default=0)
+    ap.add_argument('--max-tokens', type=int, default=16)
+    ap.add_argument('--distinct', type=int,
+                    help='synthetic prompt cardinality (1 = all '
+                    'store hits after the first)')
+    ap.add_argument('--no-stream', action='store_true',
+                    help='buffered JSON responses instead of SSE')
+    ap.add_argument('--timeout', type=float, default=120.0)
+    ap.add_argument('--max-inflight', type=int, default=256)
+    ap.add_argument('--out', help='report path (atomic JSON)')
+    ap.add_argument('--check', action='store_true',
+                    help='exit 1 on ANY failed request')
+    args = ap.parse_args(argv)
+
+    host, port = _target(args)
+    if args.trace:
+        specs = load_trace(args.trace, model=args.model,
+                           max_tokens=args.max_tokens,
+                           limit=args.requests or None)
+        if not specs:
+            print(f'loadgen: no replayable rows in {args.trace}',
+                  file=sys.stderr)
+            return 1
+    else:
+        if not args.model:
+            print('loadgen: --model is required without --trace',
+                  file=sys.stderr)
+            return 1
+        specs = synth_trace(args.requests, args.model, rate=args.rate,
+                            max_tokens=args.max_tokens,
+                            distinct=args.distinct)
+    offsets = build_arrivals(specs, mode=args.arrival,
+                             speedup=args.speedup, seed=args.seed)
+    report = run_load(host, port, specs, offsets=offsets,
+                      stream=not args.no_stream, timeout=args.timeout,
+                      max_inflight=args.max_inflight,
+                      arrival=args.arrival, speedup=args.speedup,
+                      seed=args.seed)
+    if args.out:
+        write_report(args.out, report)
+    print(json.dumps(report, indent=2, default=str))
+    if args.check:
+        return 0 if report['requests'] and not report['errors'] \
+            and not report['dropped_local'] else 1
+    return 0 if report['completed'] else 1
+
+
+if __name__ == '__main__':
+    raise SystemExit(main())

@@ -1,0 +1,338 @@
+"""Open-loop replay load generation against ``POST /v1/completions``.
+
+The front door's scale story needs a traffic source that behaves like
+traffic: arrivals that do not slow down when the engine does
+(open-loop — a closed loop hides overload by self-throttling), prompt
+streams shaped like a recorded workload, and latency measured where
+the user feels it (SSE chunk deliveries, not response totals).
+
+Three pieces, all library-first so bench/chaos drive them in-process:
+
+- **Trace**: :func:`load_trace` reads ``access.jsonl``-shaped records
+  (the serve plane's own durable HTTP log) and keeps the completion
+  rows — their ``ts`` spacing is the recorded arrival process, their
+  ``model`` annotation picks the catalog entry.  :func:`synth_trace`
+  fabricates the same shape at a target rate when no recording exists
+  (fresh deployments, chaos scenarios).
+- **Arrivals**: :func:`build_arrivals` turns a trace into start
+  offsets — ``replay`` compresses the recorded timestamps by
+  ``speedup`` (a 10× replay of an hour is six minutes with the same
+  burst structure), ``poisson`` draws i.i.d. exponential gaps at the
+  trace's mean rate × ``speedup`` from a seeded RNG (deterministic
+  runs).
+- **Runner**: :func:`run_load` fires each request at its offset on its
+  own thread (open loop), speaks SSE when ``stream`` is on, stamps
+  first-chunk TTFT and inter-chunk ITL walls per request, and folds
+  everything into a report :func:`write_report` persists atomically —
+  the artifact ``bench.py --loadgen`` feeds the trajectory gate.
+
+Clock discipline: arrival offsets and latency walls ride
+``time.monotonic``/``perf_counter``; ``time.time`` appears only as the
+report's wall-clock stamp.
+"""
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+from http.client import HTTPConnection
+from typing import Dict, List, Optional
+
+from opencompass_tpu.obs.reqtrace import percentile
+from opencompass_tpu.utils.fileio import (atomic_write_json,
+                                          iter_jsonl_records)
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+REPORT_FILE = 'loadgen_report.json'
+COMPLETIONS_PATH = '/v1/completions'
+# open-loop, but not unbounded: past this many in-flight threads new
+# arrivals are dropped locally and counted — a wedged engine must show
+# up as drops in the report, not as a thread explosion in the client
+DEFAULT_MAX_INFLIGHT = 256
+
+
+# -- trace ------------------------------------------------------------------
+
+def load_trace(path: str, model: Optional[str] = None,
+               max_tokens: int = 16, limit: Optional[int] = None
+               ) -> List[Dict]:
+    """Request specs from an ``access.jsonl``-shaped file: one spec per
+    ``POST /v1/completions`` row (or any row carrying a ``prompt``
+    field — hand-written traces are first-class), sorted by ``ts``.
+    A spec is ``{ts, model, prompt, max_tokens}``; rows without a
+    recorded prompt get a deterministic synthetic one (the access log
+    never stores prompt text), distinct per row so replay exercises
+    the device, not just the store."""
+    specs: List[Dict] = []
+    for rec in iter_jsonl_records(path):
+        if not isinstance(rec, dict):
+            continue
+        is_completion = (rec.get('method', 'POST') == 'POST'
+                         and str(rec.get('path', COMPLETIONS_PATH))
+                         .startswith(COMPLETIONS_PATH))
+        if not is_completion and 'prompt' not in rec:
+            continue
+        spec_model = rec.get('model') or model
+        if not spec_model:
+            continue
+        i = len(specs)
+        specs.append({
+            'ts': float(rec.get('ts') or i),
+            'model': str(spec_model),
+            'prompt': str(rec.get('prompt')
+                          or f'loadgen replay row {i:06d}'),
+            'max_tokens': int(rec.get('max_tokens') or max_tokens),
+        })
+        if limit is not None and len(specs) >= limit:
+            break
+    specs.sort(key=lambda s: s['ts'])
+    return specs
+
+
+def synth_trace(n: int, model: str, rate: float = 10.0,
+                max_tokens: int = 16, distinct: Optional[int] = None,
+                prefix: str = 'loadgen synthetic row') -> List[Dict]:
+    """A fabricated trace: ``n`` requests at a uniform ``rate``
+    (req/s), prompts cycling over ``distinct`` templates (default: all
+    distinct) — ``distinct=1`` turns the whole run into store hits,
+    which is its own useful experiment.  ``prefix`` shapes the prompt
+    text (e.g. to hit a FakeModel canned-response key)."""
+    n = max(int(n), 1)
+    rate = max(float(rate), 1e-6)
+    cycle = max(int(distinct), 1) if distinct else n
+    return [{'ts': i / rate, 'model': model,
+             'prompt': f'{prefix} {i % cycle:06d}',
+             'max_tokens': int(max_tokens)}
+            for i in range(n)]
+
+
+def build_arrivals(specs: List[Dict], mode: str = 'poisson',
+                   speedup: float = 10.0, seed: int = 0
+                   ) -> List[float]:
+    """Start offsets (seconds from run start) for each spec.
+
+    ``replay`` keeps the recorded burst structure, compressed:
+    ``(ts_i - ts_0) / speedup``.  ``poisson`` is an open-loop Poisson
+    process at the trace's mean rate × ``speedup`` (i.i.d. exponential
+    gaps, seeded RNG — two runs with one seed fire identically)."""
+    if not specs:
+        return []
+    speedup = max(float(speedup), 1e-6)
+    if mode == 'replay':
+        t0 = specs[0]['ts']
+        return [max(s['ts'] - t0, 0.0) / speedup for s in specs]
+    if mode != 'poisson':
+        raise ValueError(f'unknown arrival mode {mode!r}; '
+                         "expected 'replay' or 'poisson'")
+    span = max(specs[-1]['ts'] - specs[0]['ts'], 0.0)
+    base_rate = (len(specs) - 1) / span if span > 0 and len(specs) > 1 \
+        else float(len(specs))
+    lam = max(base_rate * speedup, 1e-6)
+    rng = random.Random(seed)
+    offsets, t = [], 0.0
+    for _ in specs:
+        offsets.append(t)
+        t += rng.expovariate(lam)
+    return offsets
+
+
+# -- one request ------------------------------------------------------------
+
+def _parse_sse(resp, result: Dict, t_send: float):
+    """Drain one SSE body, stamping delivery walls: first data event =
+    TTFT, gaps between text-bearing chunks = ITL.  The final chunk's
+    ``oct`` block and any in-band error event land on the result."""
+    last_text_t = None
+    while True:
+        line = resp.readline()
+        if not line:
+            break
+        line = line.strip()
+        if not line.startswith(b'data: '):
+            continue
+        now = time.perf_counter()
+        data = line[len(b'data: '):]
+        if data == b'[DONE]':
+            break
+        if result['ttft_s'] is None:
+            result['ttft_s'] = now - t_send
+        try:
+            event = json.loads(data.decode('utf-8'))
+        except ValueError:
+            continue
+        result['frames'] += 1
+        if event.get('object') == 'error' or 'error' in event:
+            err = event.get('error') or {}
+            result['error'] = err.get('message') or 'stream error'
+            result['error_type'] = err.get('type')
+            continue
+        text = ''.join(str(c.get('text') or '')
+                       for c in event.get('choices') or [])
+        if text:
+            if last_text_t is not None:
+                result['itl_s'].append(now - last_text_t)
+            last_text_t = now
+            result['chars'] += len(text)
+        if 'oct' in event:
+            result['oct'] = event['oct']
+
+
+def run_one(host: str, port: int, spec: Dict, stream: bool = True,
+            timeout: float = 120.0) -> Dict:
+    """One request against the front door; returns the measured
+    record: status, total latency, TTFT/ITL (delivery walls when
+    streaming, the engine's own ``oct.ttft_seconds`` otherwise),
+    frames, chars, error."""
+    result: Dict = {'model': spec['model'], 'status': 0, 'ok': False,
+                    'stream': bool(stream), 'ttft_s': None,
+                    'itl_s': [], 'frames': 0, 'chars': 0,
+                    'error': None}
+    body = json.dumps({'model': spec['model'],
+                       'prompt': spec['prompt'],
+                       'max_tokens': spec['max_tokens'],
+                       'stream': bool(stream)}).encode('utf-8')
+    conn = HTTPConnection(host, port, timeout=timeout)
+    t_send = time.perf_counter()
+    try:
+        conn.request('POST', COMPLETIONS_PATH, body=body,
+                     headers={'Content-Type': 'application/json'})
+        resp = conn.getresponse()
+        result['status'] = resp.status
+        if stream and resp.status == 200:
+            _parse_sse(resp, result, t_send)
+            result['ok'] = result['error'] is None
+        else:
+            payload = resp.read()
+            result['ok'] = resp.status == 200
+            try:
+                obj = json.loads(payload.decode('utf-8'))
+            except ValueError:
+                obj = {}
+            if result['ok']:
+                result['chars'] = sum(
+                    len(str(c.get('text') or ''))
+                    for c in obj.get('choices') or [])
+                oct_block = obj.get('oct') or {}
+                result['oct'] = oct_block
+                if oct_block.get('ttft_seconds') is not None:
+                    result['ttft_s'] = float(oct_block['ttft_seconds'])
+            else:
+                err = (obj.get('error') or {})
+                result['error'] = err.get('message') \
+                    or f'HTTP {resp.status}'
+                result['error_type'] = err.get('type')
+    except Exception as exc:
+        result['error'] = f'{type(exc).__name__}: {exc}'
+        result['error_type'] = 'transport'
+    finally:
+        result['latency_s'] = time.perf_counter() - t_send
+        try:
+            conn.close()
+        except Exception:
+            pass
+    return result
+
+
+# -- the open-loop runner ---------------------------------------------------
+
+def run_load(host: str, port: int, specs: List[Dict],
+             offsets: Optional[List[float]] = None,
+             stream: bool = True, timeout: float = 120.0,
+             max_inflight: int = DEFAULT_MAX_INFLIGHT,
+             arrival: str = 'poisson', speedup: float = 10.0,
+             seed: int = 0) -> Dict:
+    """Fire every spec at its offset (open loop: a slow engine never
+    slows the arrival process) and fold the per-request records into
+    the report dict.  Offsets default to
+    ``build_arrivals(specs, arrival, speedup, seed)``."""
+    if offsets is None:
+        offsets = build_arrivals(specs, mode=arrival, speedup=speedup,
+                                 seed=seed)
+    results: List[Dict] = []
+    rlock = threading.Lock()
+    inflight = threading.Semaphore(max(int(max_inflight), 1))
+    dropped = [0]
+    threads: List[threading.Thread] = []
+
+    def fire(spec):
+        try:
+            out = run_one(host, port, spec, stream=stream,
+                          timeout=timeout)
+        finally:
+            inflight.release()
+        with rlock:
+            results.append(out)
+
+    t0 = time.monotonic()
+    for spec, offset in zip(specs, offsets):
+        delay = t0 + offset - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        if not inflight.acquire(blocking=False):
+            dropped[0] += 1
+            continue
+        th = threading.Thread(target=fire, args=(spec,),
+                              name='loadgen-req')
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=timeout + 30.0)
+    wall_s = time.monotonic() - t0
+    report = summarize(results, wall_s=wall_s)
+    report.update(arrival=arrival, speedup=float(speedup),
+                  stream=bool(stream), dropped_local=dropped[0],
+                  offered=len(specs),
+                  offered_rps=round(len(specs) / wall_s, 3)
+                  if wall_s > 0 else None,
+                  target=f'{host}:{port}')
+    return report
+
+
+def summarize(results: List[Dict], wall_s: float) -> Dict:
+    """Per-request records → the report's aggregate view: status
+    counts, sustained RPS, nearest-rank TTFT/ITL/latency percentiles
+    (delivery-side when streamed)."""
+    status_counts: Dict[str, int] = {}
+    for r in results:
+        k = str(r.get('status') or 'transport')
+        status_counts[k] = status_counts.get(k, 0) + 1
+    completed = [r for r in results if r.get('ok')]
+    ttfts = [r['ttft_s'] for r in completed
+             if r.get('ttft_s') is not None]
+    itls = [v for r in completed for v in r.get('itl_s') or []]
+    lats = [r['latency_s'] for r in completed
+            if r.get('latency_s') is not None]
+
+    def ms(values, q):
+        v = percentile(values, q)
+        return round(v * 1e3, 3) if v is not None else None
+
+    return {
+        'v': 1,
+        'ts': round(time.time(), 3),
+        'requests': len(results),
+        'completed': len(completed),
+        'errors': len(results) - len(completed),
+        'status_counts': status_counts,
+        'wall_s': round(wall_s, 3),
+        'sustained_rps': round(len(completed) / wall_s, 3)
+        if wall_s > 0 else None,
+        'frames_total': sum(r.get('frames') or 0 for r in results),
+        'chars_total': sum(r.get('chars') or 0 for r in results),
+        'ttft_ms': {'p50': ms(ttfts, 0.50), 'p95': ms(ttfts, 0.95),
+                    'p99': ms(ttfts, 0.99), 'n': len(ttfts)},
+        'itl_ms': {'p50': ms(itls, 0.50), 'p95': ms(itls, 0.95),
+                   'p99': ms(itls, 0.99), 'n': len(itls)},
+        'latency_ms': {'p50': ms(lats, 0.50), 'p95': ms(lats, 0.95),
+                       'p99': ms(lats, 0.99), 'n': len(lats)},
+    }
+
+
+def write_report(path: str, report: Dict):
+    """Durable report artifact (atomic replace — a killed loadgen
+    never leaves a torn report for the trajectory gate to read)."""
+    atomic_write_json(path, report)
+    logger.info(f'loadgen report -> {path}')

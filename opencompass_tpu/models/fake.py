@@ -52,7 +52,9 @@ class FakeModel(BaseModel):
 
     def generate_continuous(self, inputs: List[str], max_out_len: int,
                             on_result=None, stats_out=None,
-                            interactive: bool = False) -> List[str]:
+                            interactive: bool = False,
+                            on_token=None,
+                            cancel_out=None) -> List[str]:
         """FakeModel 'engine': same pure outputs as :meth:`generate`,
         delivered per row in the engine's feed order (longest prompt
         first) — deliberately NOT dataset order, so callers must
@@ -65,23 +67,41 @@ class FakeModel(BaseModel):
         carries a measured TTFT and inter-token-latency samples through
         exactly the serve plumbing the real engine feeds — the
         device-free ``bench.py --slo`` leg and the reqtrace tests ride
-        this."""
+        this.  ``on_token(i, piece, n_emitted)`` mirrors the real
+        engine's streaming hook — one whitespace-delimited piece per
+        paced token, concatenating exactly to the row's final text —
+        and ``cancel_out`` receives a zero-arg cancel callable that
+        stops emission mid-row (the cancelled row delivers the partial
+        text it streamed so far)."""
         import os
+        import re
         import time
         try:
             sleep_s = float(os.environ.get('OCT_FAKE_TOKEN_SLEEP_S')
                             or 0.0)
         except (TypeError, ValueError):
             sleep_s = 0.0
+        cancelled: List[bool] = []
+        if cancel_out is not None:
+            cancel_out.append(lambda: cancelled.append(True))
         t0 = time.perf_counter()
         texts = self.generate(list(inputs), max_out_len=max_out_len)
         order = sorted(range(len(texts)),
                        key=lambda i: (-len(str(inputs[i]).split()), i))
         first_ts = None
+        n_cancelled = 0
         itl: List[float] = []
         for k in order:
+            # piece boundaries at whitespace->non-space transitions, so
+            # ''.join(pieces) == text exactly (streamed concat is
+            # token-identical to the buffered reply by construction)
+            pieces = re.split(r'(?<=\s)(?=\S)', texts[k]) \
+                if texts[k] else ['']
             prev = None
-            for _ in range(max(len(texts[k].split()), 1)):
+            emitted = 0
+            for piece in pieces:
+                if cancelled:
+                    break
                 if sleep_s > 0:
                     time.sleep(min(sleep_s, 1.0))
                 now = time.perf_counter()
@@ -90,9 +110,17 @@ class FakeModel(BaseModel):
                 if prev is not None:
                     itl.append(now - prev)
                 prev = now
+                emitted += 1
+                if on_token is not None and piece:
+                    on_token(k, piece, emitted)
+            if cancelled and emitted < len(pieces):
+                n_cancelled += 1
+                texts[k] = ''.join(pieces[:emitted])
             if on_result is not None:
                 on_result(k, texts[k])
         if stats_out is not None:
+            if n_cancelled:
+                stats_out['cancelled_rows'] = n_cancelled
             stats_out['prefill_tokens'] = sum(
                 self.get_token_len(str(p)) for p in inputs)
             stats_out['decode_tokens'] = sum(

@@ -80,9 +80,10 @@ class _EngineRow:
     __slots__ = ('ids', 'max_new', 'tag', 'emitted', 'kv_len', 'slot',
                  'done', 'retire_seq', 'event', 'interactive',
                  'submit_ts', 'first_token_ts', 'done_ts', 'token_ts',
-                 'prefix_tokens')
+                 'prefix_tokens', 'on_token', 'cancelled')
 
-    def __init__(self, ids, max_new, tag, interactive=False):
+    def __init__(self, ids, max_new, tag, interactive=False,
+                 on_token=None):
         self.ids = list(ids)
         self.max_new = int(max_new)
         self.tag = tag
@@ -102,6 +103,13 @@ class _EngineRow:
         # one perf_counter stamp per emitted token: consecutive diffs
         # are this row's inter-token latencies (bounded by max_new)
         self.token_ts: List[float] = []
+        # per-token emit hook (streaming): called OUTSIDE the engine
+        # lock as (row, token_id) right after each token lands; any
+        # exception it raises is swallowed by the driver
+        self.on_token = on_token
+        # cooperative cancel (client disconnect): the driver retires
+        # the row and frees its slot/pages at the next step boundary
+        self.cancelled = False
 
     def itl_seconds(self) -> List[float]:
         """Inter-token gaps (len = emitted - 1)."""
@@ -337,6 +345,9 @@ class ContinuousEngine:
         self.joined = 0
         self.prio_joined = 0        # interactive-lane admissions
         self.retired = 0
+        # rows cancelled before natural retirement (client disconnects)
+        # guarded-by: _lock
+        self.cancelled_rows = 0
         self._retire_seq = 0
         # guarded-by: _lock
         self._occ_series: 'collections.deque[int]' = collections.deque(
@@ -418,7 +429,8 @@ class ContinuousEngine:
     # -- intake ------------------------------------------------------------
 
     def submit(self, ids: List[int], max_new: int, tag=None,
-               interactive: bool = False) -> _EngineRow:
+               interactive: bool = False,
+               on_token=None) -> _EngineRow:
         """Queue one sequence; it joins the resident step as a slot (and
         enough pool pages) free up.  Raises when the row could never fit
         the pool — callers fall back to the dense path for it."""
@@ -433,10 +445,69 @@ class ContinuousEngine:
             raise OutOfPages(
                 f'row needs {need} pages but the pool holds '
                 f'{self.num_pages - 1}; raise kv_pool_pages')
-        row = _EngineRow(ids, max_new, tag, interactive=interactive)
+        row = _EngineRow(ids, max_new, tag, interactive=interactive,
+                         on_token=on_token)
         with self._lock:
             (self._prio if interactive else self._queue).append(row)
         return row
+
+    def cancel(self, rows: List[_EngineRow]) -> int:
+        """Cancel rows cooperatively (client disconnect): queued rows
+        leave their lane immediately; slotted rows are marked and the
+        driver retires them — freeing slot and pool pages — at the
+        next step boundary.  Each cancelled row's event fires so any
+        drainer stops waiting on it.  Returns the number of rows that
+        had not already retired."""
+        cancelled: List[_EngineRow] = []
+        with self._lock:
+            wanted = {id(r) for r in rows}
+            for lane in (self._prio, self._queue):
+                for row in [r for r in lane if id(r) in wanted]:
+                    lane.remove(row)
+                    row.done = True
+                    row.cancelled = True
+                    row.retire_seq = self._retire_seq
+                    self._retire_seq += 1
+                    row.done_ts = time.perf_counter()
+                    cancelled.append(row)
+            for row in rows:
+                if row.slot is not None and not row.done:
+                    row.cancelled = True
+                    cancelled.append(row)
+            self.cancelled_rows += len(cancelled)
+        # queued rows retire right here; slotted rows' events fire
+        # from the driver once their slot and pages are reclaimed
+        for row in cancelled:
+            if row.done:
+                row.event.set()
+        return len(cancelled)
+
+    def pin_prefix(self, ids: List[int]) -> int:
+        """Pin ``ids``' cached full-page prefix chain against LRU
+        eviction (hot system prompts the serve front door keeps
+        seeing).  No-op without a prefix cache; returns newly pinned
+        trie nodes."""
+        with self._lock:
+            if self.prefix is None:
+                return 0
+            return self.prefix.pin(ids)
+
+    def unpin_prefix(self, ids: List[int]) -> int:
+        """Release a :meth:`pin_prefix` shield; returns nodes unpinned."""
+        with self._lock:
+            if self.prefix is None:
+                return 0
+            return self.prefix.unpin(ids)
+
+    def _sweep_cancelled_locked(self) -> List[_EngineRow]:
+        """Retire slotted rows whose cancel flag is set (caller holds
+        ``_lock``); the caller fires their events after release."""
+        swept = []
+        for row in [r for r in self._slots if r is not None]:
+            if row.cancelled:
+                self._retire_locked(row)
+                swept.append(row)
+        return swept
 
     def _admit_locked(self):
         from opencompass_tpu.nn.paged_kv import OutOfPages, pages_per_seq
@@ -591,6 +662,10 @@ class ContinuousEngine:
             return self._device_step_spec()
         model = self.model
         with self._lock:
+            swept = self._sweep_cancelled_locked()
+        for row in swept:
+            row.event.set()
+        with self._lock:
             self._admit_locked()
             pending_cow, self._pending_cow = self._pending_cow, []
             active = [r for r in self._slots if r is not None]
@@ -716,6 +791,7 @@ class ContinuousEngine:
 
         eos = model.eos_token_id
         retired: List[_EngineRow] = []
+        emits: List[tuple] = []
         with self._lock:
             for row in [r for r in self._slots if r is not None]:
                 n = int(n_new[row.slot])
@@ -736,6 +812,8 @@ class ContinuousEngine:
                             row.ids, self.table.pages(row.slot))
                 row.token_ts.append(now_tok)
                 row.emitted.append(tok)
+                if row.on_token is not None:
+                    emits.append((row, tok))
                 if (eos is not None and tok == eos) \
                         or len(row.emitted) >= row.max_new:
                     self._retire_locked(row)
@@ -748,6 +826,13 @@ class ContinuousEngine:
                 'st': stalled,
                 'ret': len(retired)})
             self._note_heartbeat_locked()
+        # streaming emit hooks fire outside the lock (they do I/O);
+        # before the done events so a drainer never beats the last token
+        for row, tok in emits:
+            try:
+                row.on_token(row, tok)
+            except Exception:
+                pass
         for row in retired:
             row.event.set()
         return True
@@ -767,6 +852,10 @@ class ContinuousEngine:
         """
         model = self.model
         K = self.spec_k
+        with self._lock:
+            swept = self._sweep_cancelled_locked()
+        for row in swept:
+            row.event.set()
         with self._lock:
             self._admit_locked()
             pending_cow, self._pending_cow = self._pending_cow, []
@@ -887,6 +976,7 @@ class ContinuousEngine:
 
         eos = model.eos_token_id
         retired: List[_EngineRow] = []
+        emits: List[tuple] = []
         with self._lock:
             for row in [r for r in self._slots if r is not None]:
                 if pf_n[row.slot]:
@@ -901,6 +991,8 @@ class ContinuousEngine:
                             row.ids, self.table.pages(row.slot))
                     row.token_ts.append(now_tok)
                     row.emitted.append(tok)
+                    if row.on_token is not None:
+                        emits.append((row, tok))
                     if (eos is not None and tok == eos) \
                             or len(row.emitted) >= row.max_new:
                         self._retire_locked(row)
@@ -926,6 +1018,8 @@ class ContinuousEngine:
                 for tok in (int(x) for x in out[:m + 1]):
                     row.token_ts.append(now_tok)
                     row.emitted.append(tok)
+                    if row.on_token is not None:
+                        emits.append((row, tok))
                     if (eos is not None and tok == eos) \
                             or len(row.emitted) >= row.max_new:
                         self._retire_locked(row)
@@ -939,6 +1033,11 @@ class ContinuousEngine:
                 'st': 0,
                 'ret': len(retired)})
             self._note_heartbeat_locked()
+        for row, tok in emits:
+            try:
+                row.on_token(row, tok)
+            except Exception:
+                pass
         for row in retired:
             row.event.set()
         return True
@@ -1117,6 +1216,7 @@ class ContinuousEngine:
                     'joined': self.joined,
                     'prio_joined': self.prio_joined,
                     'retired': self.retired,
+                    'cancelled_rows': self.cancelled_rows,
                     'device_seconds': self.device_seconds,
                     'prefill_tokens': self.prefill_tokens,
                     'kv_positions': self.kv_positions,
@@ -1170,6 +1270,8 @@ class ContinuousEngine:
                 'prio_joined': self.prio_joined
                 - base.get('prio_joined', 0),
                 'retired': self.retired - base.get('retired', 0),
+                'cancelled_rows': self.cancelled_rows
+                - base.get('cancelled_rows', 0),
                 'slot_util': round(
                     d_occ / (d_decode * self.slots), 4) if d_decode
                 else 0.0,
@@ -2434,7 +2536,11 @@ class JaxLM(BaseModel):
                             on_result: Optional[Callable[[int, str],
                                                          None]] = None,
                             stats_out: Optional[Dict] = None,
-                            interactive: bool = False) -> List[str]:
+                            interactive: bool = False,
+                            on_token: Optional[Callable[[int, str, int],
+                                                        None]] = None,
+                            cancel_out: Optional[List] = None) \
+            -> List[str]:
         """Generate through the continuous-batching engine: all rows
         enter the feed queue at once, join the resident decode step as
         slots free up, and retire individually — ``on_result(i, text)``
@@ -2448,7 +2554,15 @@ class JaxLM(BaseModel):
         routes the rows through the engine's priority lane — serve
         joins admit into free slots ahead of every queued sweep row,
         so an interactive completion never waits behind a sweep's
-        prefill backlog.  Returns texts in input order."""
+        prefill backlog.  ``on_token(i, piece, n_emitted)`` streams
+        incremental text deltas per row as tokens land (fired from the
+        stepping thread, outside the engine lock; concatenated pieces
+        equal the row's final text whenever detokenization is
+        prefix-monotone — unstable decodes hold a piece back until the
+        next token resolves it).  ``cancel_out``: a list that receives
+        one zero-arg callable cancelling this call's in-flight rows
+        (client disconnect) — cancelled rows retire early with partial
+        text.  Returns texts in input order."""
         from opencompass_tpu.icl.inferencers.schedule import \
             feed_queue_order
         engine = self.continuous_engine()
@@ -2458,6 +2572,23 @@ class JaxLM(BaseModel):
             ids = [self._encode_ids(str(s))[:max_prompt] for s in inputs]
         texts: List[Optional[str]] = [None] * len(inputs)
         rows = []
+        sent: Dict[int, str] = {}    # tag -> chars already streamed
+        eos = self.eos_token_id
+
+        def _stream_hook(row, _tok):
+            toks = row.emitted if eos is None \
+                else [t for t in row.emitted if t != eos]
+            text = self.tokenizer.decode(toks)
+            prev = sent.get(row.tag, '')
+            # only emit when the running decode extends what was
+            # already delivered — a mid-sequence flip (incomplete
+            # multi-byte piece) holds back until a later token or the
+            # final flush in deliver() resolves it
+            if len(text) > len(prev) and text.startswith(prev):
+                sent[row.tag] = text
+                on_token(row.tag, text[len(prev):], len(row.emitted))
+
+        hook = _stream_hook if on_token is not None else None
         for k in feed_queue_order([len(r) for r in ids]):
             if not ids[k] or max_new <= 0:
                 texts[k] = ''
@@ -2465,7 +2596,10 @@ class JaxLM(BaseModel):
                     on_result(k, '')
                 continue
             rows.append(engine.submit(ids[k], max_new, tag=k,
-                                      interactive=interactive))
+                                      interactive=interactive,
+                                      on_token=hook))
+        if cancel_out is not None:
+            cancel_out.append(lambda: engine.cancel(rows))
         self.perf.tokens_in += sum(len(r) for r in ids)
         self.perf.samples += len(inputs)
         t0 = time.time()
@@ -2479,6 +2613,14 @@ class JaxLM(BaseModel):
             self.perf.tokens_out += len(row.emitted)
             text = self.tokenizer.decode(toks)
             texts[row.tag] = text
+            if on_token is not None:
+                # final flush: anything detokenization held back (or
+                # the EOS strip shortened) streams as one last piece
+                prev = sent.get(row.tag, '')
+                if len(text) > len(prev) and text.startswith(prev):
+                    sent[row.tag] = text
+                    on_token(row.tag, text[len(prev):],
+                             len(row.emitted))
             if on_result is not None:
                 on_result(row.tag, text)
 
@@ -2514,6 +2656,9 @@ class JaxLM(BaseModel):
             stats_out['prefill_tokens'] = sum(len(r) for r in ids)
             stats_out['decode_tokens'] = sum(
                 len(r.emitted) for r in rows)
+            cancelled = sum(1 for r in rows if r.cancelled)
+            if cancelled:
+                stats_out['cancelled_rows'] = cancelled
             try:
                 es = engine.stats(since=snap)
                 stats_out['prefill_tokens_saved'] = \

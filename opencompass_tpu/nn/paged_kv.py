@@ -309,10 +309,12 @@ class _TrieNode:
 
     ``chunk`` is the ``page_size``-token tuple that keys this node under
     its parent, ``page`` the pool page holding that chunk's K/V (the
-    trie owns one allocator reference to it), ``tick`` the LRU stamp.
+    trie owns one allocator reference to it), ``tick`` the LRU stamp,
+    ``pinned`` an eviction shield for hot shared prefixes (system
+    prompts the serve front door sees repeatedly).
     """
 
-    __slots__ = ('chunk', 'page', 'children', 'parent', 'tick')
+    __slots__ = ('chunk', 'page', 'children', 'parent', 'tick', 'pinned')
 
     def __init__(self, chunk: Tuple[int, ...], page: int,
                  parent: Optional['_TrieNode'], tick: int):
@@ -321,6 +323,7 @@ class _TrieNode:
         self.children: Dict[Tuple[int, ...], '_TrieNode'] = {}
         self.parent = parent
         self.tick = tick
+        self.pinned = False
 
 
 class RadixPrefixCache:
@@ -376,6 +379,7 @@ class RadixPrefixCache:
         self.matched_tokens = 0
         self.inserted_pages = 0
         self.evicted_pages = 0
+        self.pinned_nodes = 0
 
     def match(self, ids) -> Tuple[List[int], int, Optional[int]]:
         """Longest cached prefix of ``ids``.
@@ -463,18 +467,64 @@ class RadixPrefixCache:
             children = node.children
         return adopted
 
+    def _chain(self, ids) -> List[_TrieNode]:
+        """The trie nodes covering ``ids``' full-page chunks, longest
+        cached run first-to-last (empty when nothing is cached)."""
+        ps = self.page_size
+        ids = list(ids)
+        out: List[_TrieNode] = []
+        children = self._root
+        for i in range(len(ids) // ps):
+            node = children.get(tuple(ids[i * ps:(i + 1) * ps]))
+            if node is None:
+                break
+            out.append(node)
+            children = node.children
+        return out
+
+    def pin(self, ids) -> int:
+        """Shield ``ids``' cached full-page chain from LRU eviction.
+
+        Hot shared prefixes (system prompts the front door sees over
+        and over) stay resident under pool pressure; everything else
+        still churns.  Idempotent; pages not yet in the trie are
+        simply not pinned (call again after the next insert).  Returns
+        the number of newly pinned nodes.
+        """
+        pinned = 0
+        for node in self._chain(ids):
+            if not node.pinned:
+                node.pinned = True
+                self.pinned_nodes += 1
+                pinned += 1
+        return pinned
+
+    def unpin(self, ids) -> int:
+        """Release the eviction shield on ``ids``' cached chain.
+        Idempotent; returns the number of nodes unpinned."""
+        unpinned = 0
+        for node in self._chain(ids):
+            if node.pinned:
+                node.pinned = False
+                self.pinned_nodes -= 1
+                unpinned += 1
+        return unpinned
+
     def evict(self, n_pages: int) -> int:
         """Free up to ``n_pages`` cold trie pages, LRU leaves first.
 
         Only pages whose *sole* remaining reference is the trie's own
-        are eligible — anything a live row still maps stays put.
+        are eligible — anything a live row still maps stays put, and
+        pinned nodes (plus their ancestors, by construction) are
+        skipped.
         Evicting a leaf can expose its parent, so sweep until satisfied
         or nothing is evictable.  Returns the number of pages freed.
         """
         freed = 0
         while freed < n_pages:
             leaves = [n for n in self._iter_nodes()
-                      if not n.children and self.alloc.refcount(n.page) == 1]
+                      if not n.children and not n.pinned
+                      and self.alloc.refcount(n.page) == 1]
             if not leaves:
                 break
             leaves.sort(key=lambda n: n.tick)
@@ -498,6 +548,7 @@ class RadixPrefixCache:
             self.alloc.free([node.page])
         self._root = {}
         self.nodes = 0
+        self.pinned_nodes = 0
         return len(nodes)
 
     def _iter_nodes(self):
@@ -516,4 +567,5 @@ class RadixPrefixCache:
             'matched_tokens': self.matched_tokens,
             'inserted_pages': self.inserted_pages,
             'evicted_pages': self.evicted_pages,
+            'pinned_nodes': self.pinned_nodes,
         }

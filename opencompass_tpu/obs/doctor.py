@@ -106,6 +106,15 @@ MODEL_DRIFT_FRAC = 0.25
 # compaction has clearly not been keeping up)
 OBS_DISK_PRESSURE_FRAC = 1.0
 OBS_DISK_PRESSURE_ERROR_FRAC = 2.0
+# autoscaler_flapping: an up->down (or down->up) reversal for the same
+# model key inside this window means the scale-up and scale-down
+# triggers straddle steady-state load — capacity oscillates (prewarm
+# compiles, cold KV) instead of settling
+AUTOSCALER_FLAP_WINDOW_S = 120.0
+AUTOSCALER_FLAP_MIN_REVERSALS = 2
+# stream_backpressure: a single SSE send that blocked the delivery path
+# this long means a slow consumer held its decode slot + admission seat
+STREAM_BACKPRESSURE_BLOCK_MS = 1000.0
 
 
 def _finding(severity: str, rule: str, title: str,
@@ -136,7 +145,8 @@ def collect(path: str) -> Dict:
                  'events': [], 'requests': [], 'alerts_active': [],
                  'alerts_recent': [], 'run_marker': None,
                  'queue_pressure': None, 'overload': None,
-                 'outbound': None, 'compiles': [], 'hub': None}
+                 'outbound': None, 'compiles': [], 'hub': None,
+                 'autoscaler': []}
     try:
         art['obs_dir'] = live.resolve_obs_dir(path)
     except Exception:
@@ -200,6 +210,13 @@ def collect(path: str) -> Dict:
         try:
             from opencompass_tpu.serve.admission import read_overload
             art['overload'] = read_overload(art['serve_obs_dir'])
+        except Exception:
+            pass
+        try:
+            from opencompass_tpu.serve.autoscaler import AUTOSCALER_FILE
+            from opencompass_tpu.utils.fileio import iter_jsonl_records
+            art['autoscaler'] = list(iter_jsonl_records(
+                osp.join(art['serve_obs_dir'], AUTOSCALER_FILE)))
         except Exception:
             pass
     if art['cache_root']:
@@ -851,6 +868,89 @@ def _rule_obs_disk_pressure(art: Dict) -> List[Dict]:
               'frac': round(frac, 3)})]
 
 
+def _rule_autoscaler_flapping(art: Dict) -> List[Dict]:
+    """The autoscaler keeps reversing itself for the same model —
+    scale-up followed by scale-down (or vice versa) inside the flap
+    window.  Each oscillation pays a prewarm compile on the way up and
+    evicts a warm KV pool on the way down, so capacity churns without
+    ever settling on the load."""
+    by_key: Dict[str, List[Dict]] = {}
+    for rec in art.get('autoscaler') or []:
+        if rec.get('direction') not in ('up', 'down'):
+            continue
+        if not isinstance(rec.get('ts'), (int, float)):
+            continue
+        by_key.setdefault(str(rec.get('key')), []).append(rec)
+    out = []
+    for key, recs in sorted(by_key.items()):
+        recs.sort(key=lambda r: r['ts'])
+        reversals = []
+        for prev, cur in zip(recs, recs[1:]):
+            gap = cur['ts'] - prev['ts']
+            if cur['direction'] != prev['direction'] \
+                    and gap <= AUTOSCALER_FLAP_WINDOW_S:
+                reversals.append((prev, cur, gap))
+        if len(reversals) < AUTOSCALER_FLAP_MIN_REVERSALS:
+            continue
+        evidence = [f'{key[:24]}: {len(reversals)} reversal(s) within '
+                    f'{AUTOSCALER_FLAP_WINDOW_S:.0f}s across '
+                    f'{len(recs)} scaling decision(s)']
+        for prev, cur, gap in reversals[:4]:
+            evidence.append(
+                f'{prev["direction"]} to {prev.get("to")} replica(s) '
+                f'then {cur["direction"]} to {cur.get("to")} '
+                f'{gap:.0f}s later ({cur.get("reason")})')
+        out.append(_finding(
+            'warn', 'autoscaler_flapping',
+            f'autoscaler is flapping on {key[:24]} — scale decisions '
+            'reverse before the fleet settles',
+            evidence,
+            fix='widen the hysteresis: raise up_consecutive / '
+                'down_consecutive or the per-direction cooldowns, and '
+                'keep down_slot_util well below up_slot_util so '
+                'steady-state load cannot sit between the two '
+                'triggers (docs/serving.md "Autoscaling")',
+            data={'key': key, 'reversals': len(reversals)}))
+    return out
+
+
+def _rule_stream_backpressure(art: Dict) -> List[Dict]:
+    """A streaming client read slowly enough that an SSE send blocked
+    the token-delivery path.  The request held its decode slot and
+    admission seat for the whole stall, so a handful of slow consumers
+    can starve everyone else."""
+    slow = []
+    for rec in art.get('requests') or []:
+        st = rec.get('stream') or {}
+        blk = st.get('send_block_ms_max')
+        if isinstance(blk, (int, float)) \
+                and blk >= STREAM_BACKPRESSURE_BLOCK_MS:
+            slow.append((float(blk), rec, st))
+    if not slow:
+        return []
+    slow.sort(key=lambda t: -t[0])
+    evidence = [f'{len(slow)} streamed request(s) had an SSE send '
+                f'block >= {STREAM_BACKPRESSURE_BLOCK_MS:.0f}ms']
+    for blk, rec, st in slow[:5]:
+        evidence.append(
+            f'{rec.get("request_id") or rec.get("id") or "?"}: max '
+            f'send block {blk:.0f}ms over {st.get("frames", "?")} '
+            'frame(s)'
+            + (' (client disconnected)' if st.get('disconnected')
+               else ''))
+    return [_finding(
+        'warn', 'stream_backpressure',
+        f'{len(slow)} slow streaming consumer(s) stalled token '
+        'delivery while holding decode slots',
+        evidence,
+        fix='slow consumers hold decode slots and admission seats for '
+            'the duration of the stall: front the daemon with a '
+            'buffering proxy, have clients drain the socket promptly, '
+            'or lower admission.max_inflight so a few stalled streams '
+            'cannot occupy every seat (docs/serving.md "Streaming")',
+        data={'count': len(slow), 'worst_ms': round(slow[0][0], 1)})]
+
+
 RULES: List[Callable[[Dict], List[Dict]]] = [
     _rule_failed_tasks,
     _rule_breaker_open,
@@ -869,6 +969,8 @@ RULES: List[Callable[[Dict], List[Dict]]] = [
     _rule_queue_backlog,
     _rule_overload_shedding,
     _rule_obs_disk_pressure,
+    _rule_autoscaler_flapping,
+    _rule_stream_backpressure,
     _rule_dead_run,
 ]
 

@@ -49,6 +49,39 @@ logger = get_logger()
 
 PROM_CONTENT_TYPE = 'text/plain; version=0.0.4; charset=utf-8'
 HTTP_INFO_FILE = 'http.json'
+
+
+class ClientDisconnected(OSError):
+    """The HTTP peer hung up mid-stream (broken pipe / reset).  Raised
+    by a :class:`StreamingResponse` ``send`` so the producer can abort
+    upstream work promptly (a consumer that can never read another
+    byte must not keep decode slots warm)."""
+
+
+class StreamingResponse:
+    """A route payload that writes its own incremental body.
+
+    A handler returns ``(code, StreamingResponse(producer), headers)``
+    instead of a dict; the dispatch guard sends the headers *without*
+    ``Content-Length`` (the connection close delimits the body) and
+    runs ``producer(send)`` on the request thread, where
+    ``send(chunk: bytes)`` writes and flushes one chunk and raises
+    :class:`ClientDisconnected` once the peer is gone.  The producer
+    owns cleanup on disconnect — the guard treats a disconnect as a
+    completed request (the access-log line still lands), never a 500.
+
+    ``annotations``: a dict the producer may fill during the stream;
+    the guard merges it into the access-log record after the body ends
+    (so streamed requests can report frames sent / disconnect state).
+    """
+
+    def __init__(self, producer,
+                 content_type: str = 'application/octet-stream',
+                 annotations: Optional[Dict] = None):
+        self.producer = producer
+        self.content_type = content_type
+        self.annotations: Dict = annotations \
+            if annotations is not None else {}
 # a gauge not re-set for this long stops being exported: the series
 # goes Prometheus-stale at the scraper instead of lying at its last
 # value forever (dead-worker oct_hbm_*, a resolved-then-dead
@@ -513,6 +546,47 @@ class ObsHTTPServer:
                     self.end_headers()
                     self.wfile.write(body)
 
+                def _send_streaming(self, code: int,
+                                    stream: StreamingResponse,
+                                    headers: Optional[Dict] = None):
+                    """Chunk-at-a-time response body: headers go out
+                    with no Content-Length (close delimits), every
+                    chunk is flushed immediately, and a peer hang-up
+                    surfaces to the producer as ClientDisconnected —
+                    never as a handler 500."""
+                    self._code = code
+                    self.send_response(code)
+                    self.send_header('Content-Type',
+                                     stream.content_type)
+                    self.send_header('Cache-Control', 'no-cache')
+                    # an incremental body through a buffering proxy is
+                    # a buffered blob again
+                    self.send_header('X-Accel-Buffering', 'no')
+                    self.send_header('Connection', 'close')
+                    for name, value in (headers or {}).items():
+                        self.send_header(name, str(value))
+                    if self._rid:
+                        from opencompass_tpu.obs.reqtrace import \
+                            REQUEST_ID_HEADER
+                        self.send_header(REQUEST_ID_HEADER, self._rid)
+                    self.end_headers()
+
+                    def send(chunk: bytes):
+                        try:
+                            self.wfile.write(chunk)
+                            self.wfile.flush()
+                        except (BrokenPipeError, ConnectionResetError,
+                                OSError) as exc:
+                            raise ClientDisconnected(str(exc)) from exc
+
+                    try:
+                        stream.producer(send)
+                    except ClientDisconnected:
+                        # the producer let the hang-up propagate after
+                        # its own cleanup: the request is over, not
+                        # broken — the access log records the truth
+                        pass
+
                 def _send_payload(self, code: int, payload,
                                   headers: Optional[Dict] = None):
                     if isinstance(payload, (dict, list)):
@@ -569,7 +643,14 @@ class ObsHTTPServer:
                             else:
                                 code, payload = out
                                 hdrs = None
-                            self._send_payload(code, payload, hdrs)
+                            if isinstance(payload, StreamingResponse):
+                                self._send_streaming(code, payload,
+                                                     hdrs)
+                                if payload.annotations:
+                                    ctx.annotations.update(
+                                        payload.annotations)
+                            else:
+                                self._send_payload(code, payload, hdrs)
                         elif method != 'GET':
                             self._send_payload(404, 'not found\n')
                         elif path == '/healthz':

@@ -163,6 +163,10 @@ class WorkerHandle:
         self._responses: Dict[str, Dict] = {}
         self._abandoned: set = set()
         self._reader_active = False
+        # per-rid interim-frame sinks (streaming round-trips): frames
+        # carrying {'stream': true} route here instead of completing
+        # the rid  # guarded-by: _rcond
+        self._sinks: Dict[str, object] = {}
 
     # -- demuxed round-trips ----------------------------------------------
 
@@ -176,15 +180,24 @@ class WorkerHandle:
             self._responses = {}
             self._abandoned = set()
             self._reader_active = False
+            # oct-lint: disable=OCT003(lazy attribute creation before any reader thread exists — nothing can race the first assignment)
+            self._sinks = {}
 
-    def _send(self, msg: Dict) -> str:
+    def _send(self, msg: Dict, sink=None) -> str:
         self._ensure_demux()
         with self._wlock:
             self._rid += 1
             rid = f'r{self._rid}'
+            if sink is not None:
+                # registered before the frame is written, so the first
+                # interim frame can never beat its sink
+                with self._rcond:
+                    self._sinks[rid] = sink
             try:
                 write_frame(self.proc.stdin, dict(msg, rid=rid))
             except OSError as exc:
+                with self._rcond:
+                    self._sinks.pop(rid, None)
                 self.kill()
                 raise WorkerError(
                     f'worker channel broke: {exc}') from exc
@@ -201,6 +214,45 @@ class WorkerHandle:
         deadline = time.monotonic() + timeout if timeout else None
         return self._await(rid, deadline, timeout_s=timeout,
                            kill_on_timeout=kill_on_timeout)
+
+    def request_stream(self, msg: Dict, on_event,
+                       timeout: Optional[float] = None,
+                       kill_on_timeout: bool = True) -> Dict:
+        """One round-trip that also delivers interim ``stream`` frames.
+
+        The worker answers a streaming command with any number of
+        ``{'stream': true, ...}`` frames on the same rid followed by
+        one final normal response frame.  ``on_event(frame)`` fires
+        for each interim frame from whichever thread holds the
+        pipe-reader seat — it must be thread-safe and fast (it sits on
+        the protocol read path).  The final frame is returned; the
+        sink is deregistered on every exit path."""
+        if self.dead:
+            raise WorkerError('worker already dead')
+        rid = self._send(msg, sink=on_event)
+        deadline = time.monotonic() + timeout if timeout else None
+        try:
+            return self._await(rid, deadline, timeout_s=timeout,
+                               kill_on_timeout=kill_on_timeout)
+        finally:
+            with self._rcond:
+                self._sinks.pop(rid, None)
+
+    def post(self, msg: Dict) -> Optional[str]:
+        """Fire-and-forget frame: send and pre-abandon the rid so the
+        eventual response is dropped by whoever holds the reader seat.
+        Safe from a sink callback (never waits on the pipe — waiting
+        there would deadlock the reader that must deliver the reply).
+        Returns the rid, or None when the channel is already dead."""
+        if self.dead:
+            return None
+        try:
+            rid = self._send(msg)
+        except WorkerError:
+            return None
+        with self._rcond:
+            self._abandoned.add(rid)
+        return rid
 
     def request_watched(self, msg: Dict,
                         timeout: Optional[float] = None,
@@ -295,6 +347,19 @@ class WorkerHandle:
                     raise WorkerError(
                         f'worker channel broke: {exc}') from exc
                 frid = frame.pop('rid', None)
+                if frame.get('stream') and frid is not None:
+                    # interim streaming frame: deliver to its sink (or
+                    # drop it — an abandoned/finished stream) and keep
+                    # reading; only a final non-stream frame completes
+                    # a rid
+                    with self._rcond:
+                        sink = self._sinks.get(frid)
+                    if sink is not None:
+                        try:
+                            sink(frame)
+                        except Exception:
+                            pass
+                    continue
                 if frid is None or frid == rid:
                     return frame
                 with self._rcond:       # someone else's response
@@ -541,7 +606,9 @@ def _collect_tracked_calls(model) -> List[Dict]:
         return []
 
 
-def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
+def _handle_complete(msg: Dict, during_run: bool = False,
+                     emit=None, cancel_out: Optional[List] = None) \
+        -> Dict:
     """Interactive generation on the resident model (the engine's
     ``/v1/completions`` data plane).  Rows are keyed exactly like the
     gen inferencer's store rows — namespace (model identity, 'gen',
@@ -554,11 +621,21 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
     commit seconds) plus the forward's dispatch/fetch wall split and
     prefill/decode token counts from the model's ``_tl_track``
     plumbing — the engine lays these out as child spans of the request
-    record in ``{cache_root}/serve/obs/requests.jsonl``.  ``ttft_s``
-    is the time-to-first-token *estimate* for device-served rows: host
-    dispatch (trace/compile/enqueue) plus the prefill-token share of
-    the fused device wall (the fused prefill+decode executable gives
-    no on-device split)."""
+    record in ``{cache_root}/serve/obs/requests.jsonl``.
+
+    ``ttft_s``: engine-served rows report the MEASURED submit→first-
+    sampled-token wall; dense-path rows report the legacy *estimate*
+    (host dispatch plus the prefill-token share of the fused device
+    wall) and flag it ``ttft_estimated`` — streamed requests replace
+    both with the daemon's first-byte delivery timestamp.
+
+    ``emit`` (streaming): a callable receiving one dict per generated
+    text piece (``{'row': prompt index, 'piece': str, 'n': tokens so
+    far}``) as tokens retire from the engine — store-hit rows emit
+    their full cached text as one piece.  ``cancel_out``: a list that
+    receives zero-arg cancel callables while the engine drains —
+    calling them (the ``abort`` cmd / client disconnect) retires this
+    request's rows early and frees their slots and pages."""
     from opencompass_tpu import store as result_store
     from opencompass_tpu.obs import get_tracer
     from opencompass_tpu.obs import timeline as tlmod
@@ -644,6 +721,13 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
                 hits += 1
     phases['store_lookup_s'] = round(time.perf_counter() - t, 6)
     todo = [i for i, c in enumerate(completions) if c is None]
+    if emit is not None and hits:
+        # store-served rows stream their whole cached text as one
+        # piece — the client sees bytes at lookup speed, not a silent
+        # gap until the device rows retire
+        for i, c in enumerate(completions):
+            if c is not None:
+                emit({'row': i, 'piece': str(c), 'store_hit': True})
     if todo and _expired():
         # deadline shorter than the forward could ever be (TTFT
         # included): fail before dispatching device work
@@ -659,13 +743,22 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
         # steps instead of waiting for the whole shard round-trip
         joined_engine = True
         engine_stats: Dict = {}
+        on_token = None
+        if emit is not None:
+            def on_token(k, piece, n):
+                # k indexes the todo-subset the engine saw; the wire
+                # frame carries the ORIGINAL prompt index so the daemon
+                # fans pieces out to the right response row
+                emit({'row': todo[k], 'piece': piece, 'n': n})
         t = time.perf_counter()
         with get_tracer().span('complete', request_id=request_id,
                                rows=len(todo), engine_join=True):
             outs = model.generate_continuous(
                 [prompts[i] for i in todo], max_out_len,
                 stats_out=engine_stats,
-                interactive=True)   # priority lane: never behind sweep
+                interactive=True,   # priority lane: never behind sweep
+                on_token=on_token,
+                cancel_out=cancel_out)
         phases['model_forward_s'] = round(
             time.perf_counter() - t + inject_s, 6)
     elif todo and during_run:
@@ -746,6 +839,10 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
         share = prefill / max(prefill + decode, 1)
         resp['ttft_s'] = round(
             (first.get('dispatch_s') or 0.0) + first_fetch * share, 6)
+        # dense (non-engine) rows have no per-token retirement to
+        # timestamp — flag the estimate so reqtrace/doctor can tell a
+        # modeled ttft from the engine/stream measured ones
+        resp['ttft_estimated'] = True
         # dense-path roofline: analytic cost of this forward against
         # the blocked-on-device share of the forward wall (fetch_s —
         # the dispatch half is host tracing/enqueue), so the request
@@ -784,7 +881,53 @@ def _handle_complete(msg: Dict, during_run: bool = False) -> Dict:
                     'itl_ms'):
             if engine_stats.get(key) is not None:
                 resp[key] = engine_stats[key]
+        if engine_stats.get('cancelled_rows'):
+            # client went away mid-stream: rows were aborted and their
+            # pages freed early; the daemon records the request as
+            # degraded=client_disconnect off this count
+            resp['cancelled_rows'] = engine_stats['cancelled_rows']
     return resp
+
+
+def _handle_prefix_pin(msg: Dict) -> Dict:
+    """Pin (or unpin, ``pin: false``) a hot prompt prefix in the
+    resident engine's radix trie so interactive traffic stops
+    re-prefilling a shared system prompt (the serve front door sends
+    this once a prefix crosses its request-count threshold).
+
+    Pinning never *builds*: if the model isn't resident yet there is no
+    trie to pin, so the handler answers ``pinned: 0`` and lets the
+    front door retry after the next completion makes it resident.
+    Models without a continuous engine (dense path, FakeModel) answer
+    the same — the pin is a cache hint, never an error."""
+    from opencompass_tpu.utils.build import (build_model_from_cfg,
+                                             model_cached)
+    model_cfg = msg.get('model_cfg')
+    if not isinstance(model_cfg, dict):
+        return {'ok': False,
+                'error': 'prefix_pin needs a model_cfg dict'}
+    prefix = str(msg.get('prefix') or '')
+    want_pin = bool(msg.get('pin', True))
+    resident = model_cached(model_cfg)
+    if not prefix or not resident:
+        return {'ok': True, 'pinned': 0, 'resident': resident,
+                'engine': False, 'pid': os.getpid()}
+    model = build_model_from_cfg(model_cfg)   # memoized: no build here
+    if not getattr(model, 'continuous_active', False) \
+            or not hasattr(model, 'continuous_engine'):
+        return {'ok': True, 'pinned': 0, 'resident': True,
+                'engine': False, 'pid': os.getpid()}
+    try:
+        engine = model.continuous_engine()
+        ids = model._encode_ids(prefix)
+        count = engine.pin_prefix(ids) if want_pin \
+            else engine.unpin_prefix(ids)
+    except Exception:
+        # no prefix cache configured / tokenizer edge: a hint, not a 500
+        return {'ok': True, 'pinned': 0, 'resident': True,
+                'engine': False, 'pid': os.getpid()}
+    return {'ok': True, 'pinned': count, 'resident': True,
+            'engine': True, 'pin': want_pin, 'pid': os.getpid()}
 
 
 def _debug_complete_sleep():
@@ -918,6 +1061,53 @@ def serve():
         t = run_thread[0]
         return t is not None and t.is_alive()
 
+    # streaming completes run in side threads so the protocol loop can
+    # still receive their `abort` frames mid-generation; the registry
+    # maps request_id -> cancel callables the engine handed out
+    # guarded-by: stream_lock
+    stream_lock = threading.Lock()
+    active_streams: Dict[str, List] = {}
+
+    def _complete_in_thread(msg: Dict, rid, during_run: bool):
+        request_id = str(msg.get('request_id') or rid or '')
+        cancels: List = []
+        with stream_lock:
+            active_streams[request_id] = cancels
+        seq = [0]
+
+        def emit(ev: Dict):
+            # interim frame: same rid, stream marker + monotone seq so
+            # the handle routes it to the sink, never completes the rid
+            seq[0] += 1
+            try:
+                respond(dict(ev, stream=True, seq=seq[0]), rid)
+            except OSError:
+                # runner hung up mid-stream: stop generating for a
+                # consumer that can never read another byte
+                for cancel in list(cancels):
+                    try:
+                        cancel()
+                    except Exception:
+                        pass
+        try:
+            try:
+                resp = _handle_complete(msg, during_run=during_run,
+                                        emit=emit, cancel_out=cancels)
+            except (KeyboardInterrupt, SystemExit) as exc:
+                resp = {'ok': False,
+                        'error': f'{type(exc).__name__}: {exc}'}
+            except BaseException:
+                resp = {'ok': False,
+                        'error': traceback.format_exc(limit=20)[-2000:]}
+            resp.setdefault('stream_frames', seq[0])
+            try:
+                respond(resp, rid)
+            except OSError:
+                pass     # runner hung up; nothing to tell it
+        finally:
+            with stream_lock:
+                active_streams.pop(request_id, None)
+
     def _run_in_thread(msg: Dict, rid):
         try:
             resp = _handle_run(msg)
@@ -976,6 +1166,31 @@ def serve():
         if cmd == 'ping':
             respond({'ok': True, 'pong': True}, rid)
             continue
+        if cmd == 'abort':
+            # cancel a streaming complete's in-flight rows (client
+            # disconnect): handled inline so it works even while the
+            # stream's side thread is blocked inside the engine
+            target = str(msg.get('request_id') or '')
+            with stream_lock:
+                cancels = list(active_streams.get(target) or ())
+            for cancel in cancels:
+                try:
+                    cancel()
+                except Exception:
+                    pass
+            respond({'ok': True, 'aborted': bool(cancels),
+                     'request_id': target}, rid)
+            continue
+        if cmd == 'prefix_pin':
+            try:
+                resp = _handle_prefix_pin(msg)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except BaseException:
+                resp = {'ok': False,
+                        'error': traceback.format_exc(limit=20)[-2000:]}
+            respond(resp, rid)
+            continue
         if cmd not in ('run', 'complete'):
             respond({'ok': False, 'error': f'unknown cmd {cmd!r}'}, rid)
             continue
@@ -988,6 +1203,16 @@ def serve():
                                       args=(msg, rid),
                                       name='worker-run', daemon=True)
             run_thread[0] = thread
+            thread.start()
+            continue
+        if msg.get('stream'):
+            # streaming complete: a side thread generates + emits
+            # interim frames while this loop stays free to field the
+            # request's `abort` (and any concurrent frames)
+            thread = threading.Thread(
+                target=_complete_in_thread,
+                args=(msg, rid, run_busy()),
+                name='worker-stream', daemon=True)
             thread.start()
             continue
         try:

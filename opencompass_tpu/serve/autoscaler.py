@@ -1,0 +1,354 @@
+"""Elastic worker autoscaling from measured serve signals.
+
+PR 14's degradation plane *sheds* on queue drain ETA, SLO burn,
+breaker state, and decode-slot saturation; until now the only capacity
+mechanism was the static idle-TTL reaper.  This module closes the
+loop: a control thread samples those same signals and scales each
+served model's resident-worker replica count up or down with
+hysteresis and per-direction cooldowns — load grows the fleet before
+the shed wall, idleness shrinks it back without flapping.
+
+Replica addressing: replica 0 of a model key IS the bare pool key (so
+a one-replica fleet is byte-identical to the static pool, and sweeps
+keep their affinity), replicas 1..n-1 get instance keys ``key@r<i>``.
+``route()`` spreads interactive requests round-robin across the
+current target; a scale-up makes the new instance key routable (the
+next routed request spawns its worker — and the control loop prewarms
+it eagerly so the spawn wall lands on the autoscaler, not on a user
+request).  A scale-down retires excess instances through
+``WorkerPool.retire_excess`` (graceful, lease-respecting).
+
+Every decision appends one durable record to
+``{serve_obs_dir}/autoscaler.jsonl``::
+
+    {"v": 1, "ts": ..., "key": ..., "from": 1, "to": 2,
+     "direction": "up", "reason": "queue_eta", "signals": {...}}
+
+which the ``autoscaler_flapping`` doctor rule and the loadgen report's
+scale-up-latency metric both read.  The policy core
+(:func:`decide`) is a pure function of (signals, config, per-key
+state, now) — unit-testable without a daemon.
+"""
+from __future__ import annotations
+
+import os.path as osp
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from opencompass_tpu.utils.logging import get_logger
+
+logger = get_logger()
+
+AUTOSCALER_FILE = 'autoscaler.jsonl'
+
+_KNOWN_KEYS = frozenset({
+    'min_replicas', 'max_replicas', 'interval_s',
+    'scale_up_cooldown_s', 'scale_down_cooldown_s',
+    'up_queue_eta_s', 'up_slot_util', 'down_slot_util',
+    'up_consecutive', 'down_consecutive', 'prewarm',
+})
+
+
+class AutoscalerConfig:
+    """Validated policy knobs (serve config ``autoscaler = dict(...)``;
+    unknown keys fail at daemon construction, like admission's)."""
+
+    def __init__(self, min_replicas: int = 1, max_replicas: int = 4,
+                 interval_s: float = 2.0,
+                 scale_up_cooldown_s: float = 10.0,
+                 scale_down_cooldown_s: float = 60.0,
+                 up_queue_eta_s: float = 10.0,
+                 up_slot_util: float = 0.85,
+                 down_slot_util: float = 0.25,
+                 up_consecutive: int = 2,
+                 down_consecutive: int = 5,
+                 prewarm: bool = True):
+        self.min_replicas = max(int(min_replicas), 1)
+        self.max_replicas = max(int(max_replicas), self.min_replicas)
+        self.interval_s = max(float(interval_s), 0.05)
+        self.scale_up_cooldown_s = max(float(scale_up_cooldown_s), 0.0)
+        self.scale_down_cooldown_s = max(
+            float(scale_down_cooldown_s), 0.0)
+        self.up_queue_eta_s = float(up_queue_eta_s)
+        self.up_slot_util = float(up_slot_util)
+        self.down_slot_util = float(down_slot_util)
+        self.up_consecutive = max(int(up_consecutive), 1)
+        self.down_consecutive = max(int(down_consecutive), 1)
+        self.prewarm = bool(prewarm)
+
+    @classmethod
+    def from_cfg(cls, raw) -> Optional['AutoscalerConfig']:
+        """None (autoscaler off) for a missing block; a malformed one
+        fails loudly at construction, not mid-incident."""
+        if raw is None:
+            return None
+        if not isinstance(raw, dict):
+            raise ValueError(
+                f'autoscaler config must be a dict, got {type(raw)}')
+        unknown = set(raw) - _KNOWN_KEYS
+        if unknown:
+            raise ValueError(
+                f'unknown autoscaler key(s) {sorted(unknown)}; '
+                f'known: {sorted(_KNOWN_KEYS)}')
+        return cls(**raw)
+
+
+class KeyState:
+    """Per-model-key control state (hysteresis counters + cooldown
+    clocks).  # guarded-by: Autoscaler._lock"""
+
+    def __init__(self, replicas: int):
+        self.replicas = replicas
+        self.up_streak = 0
+        self.down_streak = 0
+        self.last_up_ts: Optional[float] = None
+        self.last_down_ts: Optional[float] = None
+        self.rr = 0              # round-robin cursor for route()
+
+
+def instance_key(key: str, index: int) -> str:
+    """Replica 0 is the bare key (static-pool compatibility); the rest
+    are ``key@r<i>``."""
+    return key if index == 0 else f'{key}@r{index}'
+
+
+def decide(signals: Dict, cfg: AutoscalerConfig, state: KeyState,
+           now: float) -> Optional[Dict]:
+    """One policy evaluation for one model key.  Pure: mutates only
+    ``state`` (streaks; the caller applies the replica change).
+
+    ``signals``: ``queue_eta_s`` (drain ETA for queued work),
+    ``page_alerts`` (active page-severity SLO count), ``breakers_open``
+    (open circuits for this key's instances), ``slot_util`` (decode
+    slot utilization 0..1, or worker busy-utilization fallback),
+    ``inflight`` (admission seats held).  Missing signals read as
+    "calm".
+
+    Returns a decision dict (direction/from/to/reason) or None.
+    Hysteresis: ``up_consecutive``/``down_consecutive`` evaluations
+    must agree before a move; each direction then honors its own
+    cooldown — and a scale-up resets the down streak (and vice versa),
+    so one noisy sample never whipsaws the fleet."""
+    pressure = []
+    if (signals.get('queue_eta_s') or 0.0) >= cfg.up_queue_eta_s:
+        pressure.append('queue_eta')
+    if (signals.get('page_alerts') or 0) > 0:
+        pressure.append('page_burn')
+    if (signals.get('slot_util') or 0.0) >= cfg.up_slot_util:
+        pressure.append('slot_util')
+    if (signals.get('breakers_open') or 0) > 0 \
+            and state.replicas < cfg.max_replicas:
+        # an open circuit means a replica is effectively gone: more
+        # capacity routes around it while it cools
+        pressure.append('breaker_open')
+    idle = (not pressure
+            and (signals.get('slot_util') or 0.0)
+            <= cfg.down_slot_util
+            and (signals.get('inflight') or 0) == 0
+            and (signals.get('queue_eta_s') or 0.0) == 0.0)
+
+    if pressure:
+        state.up_streak += 1
+        state.down_streak = 0
+    elif idle:
+        state.down_streak += 1
+        state.up_streak = 0
+    else:
+        state.up_streak = 0
+        state.down_streak = 0
+        return None
+
+    if pressure and state.up_streak >= cfg.up_consecutive \
+            and state.replicas < cfg.max_replicas:
+        if state.last_up_ts is not None \
+                and now - state.last_up_ts < cfg.scale_up_cooldown_s:
+            return None
+        target = state.replicas + 1
+        decision = {'direction': 'up', 'from': state.replicas,
+                    'to': target, 'reason': pressure[0],
+                    'pressure': pressure}
+        state.replicas = target
+        state.last_up_ts = now
+        state.up_streak = 0
+        return decision
+    if idle and state.down_streak >= cfg.down_consecutive \
+            and state.replicas > cfg.min_replicas:
+        if state.last_down_ts is not None \
+                and now - state.last_down_ts \
+                < cfg.scale_down_cooldown_s:
+            return None
+        # a down right after an up is the flapping signature: give the
+        # new capacity one full up-cooldown to prove itself first
+        if state.last_up_ts is not None \
+                and now - state.last_up_ts < cfg.scale_up_cooldown_s:
+            return None
+        target = state.replicas - 1
+        decision = {'direction': 'down', 'from': state.replicas,
+                    'to': target, 'reason': 'idle'}
+        state.replicas = target
+        state.last_down_ts = now
+        state.down_streak = 0
+        return decision
+    return None
+
+
+class Autoscaler:
+    """The control loop: sample signals, decide per key, apply.
+
+    Args:
+        cfg: validated :class:`AutoscalerConfig`.
+        keys_fn: zero-arg → the model keys currently served (the
+            daemon's catalog, by affinity digest).
+        signals_fn: ``(key) -> signals dict`` (see :func:`decide`).
+        retire_fn: ``(key, keep) -> list`` — retire instance keys past
+            ``keep`` replicas (``WorkerPool.retire_excess``).
+        prewarm_fn: optional ``(instance_key) -> None`` — spawn the new
+            replica's worker eagerly off the control thread.
+        obs_dir: serve obs dir for the durable decision journal.
+    """
+
+    def __init__(self, cfg: AutoscalerConfig,
+                 keys_fn: Callable[[], List[str]],
+                 signals_fn: Callable[[str], Dict],
+                 retire_fn: Callable[[str, int], List[str]],
+                 prewarm_fn: Optional[Callable[[str], None]] = None,
+                 obs_dir: Optional[str] = None):
+        self.cfg = cfg
+        self.keys_fn = keys_fn
+        self.signals_fn = signals_fn
+        self.retire_fn = retire_fn
+        self.prewarm_fn = prewarm_fn
+        self.path = osp.join(obs_dir, AUTOSCALER_FILE) \
+            if obs_dir else None
+        self._lock = threading.Lock()
+        # guarded-by: _lock
+        self._states: Dict[str, KeyState] = {}
+        self.decisions = 0
+        self._stop: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- routing -----------------------------------------------------------
+
+    def _state_for_locked(self, key: str) -> KeyState:
+        state = self._states.get(key)
+        if state is None:
+            state = self._states[key] = KeyState(self.cfg.min_replicas)
+        return state
+
+    def route(self, key: str) -> str:
+        """Round-robin an interactive request across the key's current
+        replica set (replica 0 = the bare key)."""
+        with self._lock:
+            state = self._state_for_locked(key)
+            if state.replicas <= 1:
+                return key
+            state.rr = (state.rr + 1) % state.replicas
+            return instance_key(key, state.rr)
+
+    def replicas(self, key: str) -> int:
+        with self._lock:
+            return self._state_for_locked(key).replicas
+
+    # -- control loop ------------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> List[Dict]:
+        """One evaluation over every served key; applies and journals
+        any decisions.  Called by the loop thread — and directly by
+        tests/chaos, which is why it takes ``now``."""
+        now = time.monotonic() if now is None else now
+        applied: List[Dict] = []
+        try:
+            keys = list(self.keys_fn() or [])
+        except Exception:
+            return applied
+        for key in keys:
+            try:
+                signals = dict(self.signals_fn(key) or {})
+            except Exception:
+                continue
+            with self._lock:
+                state = self._state_for_locked(key)
+                decision = decide(signals, self.cfg, state, now)
+            if decision is None:
+                continue
+            decision.update(key=key, signals=signals)
+            self._apply(key, decision)
+            self._journal(decision)
+            applied.append(decision)
+        return applied
+
+    def _apply(self, key: str, decision: Dict):
+        self.decisions += 1
+        if decision['direction'] == 'down':
+            try:
+                retired = self.retire_fn(key, decision['to'])
+                decision['retired'] = retired
+            except Exception:
+                logger.warning(f'autoscaler retire failed for {key}',
+                               exc_info=True)
+        elif self.cfg.prewarm and self.prewarm_fn is not None:
+            new_key = instance_key(key, decision['to'] - 1)
+            threading.Thread(
+                target=self._prewarm, args=(new_key,),
+                name='serve-autoscale-warm', daemon=True).start()
+        logger.info(
+            f"autoscaler: {key} {decision['from']} -> "
+            f"{decision['to']} ({decision['reason']})")
+
+    def _prewarm(self, new_key: str):
+        try:
+            self.prewarm_fn(new_key)
+        except Exception:
+            logger.warning(f'autoscaler prewarm failed for {new_key}',
+                           exc_info=True)
+
+    def _journal(self, decision: Dict):
+        """Durable decision record; never-fail telemetry contract."""
+        if self.path is None:
+            return
+        try:
+            from opencompass_tpu.utils.fileio import append_jsonl_atomic
+            rec = {'v': 1, 'ts': round(time.time(), 3)}
+            rec.update(decision)
+            append_jsonl_atomic(self.path, [rec])
+        except Exception:
+            pass
+
+    def start(self):
+        if self._thread is not None:
+            return
+        self._stop = threading.Event()
+
+        def loop():
+            while not self._stop.wait(self.cfg.interval_s):
+                try:
+                    self.step()
+                except Exception:
+                    logger.warning('autoscaler step failed',
+                                   exc_info=True)
+
+        self._thread = threading.Thread(
+            target=loop, name='serve-autoscaler', daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+            self._stop = None
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            per_key = {
+                key: {'replicas': state.replicas,
+                      'up_streak': state.up_streak,
+                      'down_streak': state.down_streak}
+                for key, state in self._states.items()
+            }
+        return {'enabled': True, 'decisions': self.decisions,
+                'min_replicas': self.cfg.min_replicas,
+                'max_replicas': self.cfg.max_replicas,
+                'keys': per_key}

@@ -47,6 +47,9 @@ from opencompass_tpu.serve.admission import (AdmissionController,
                                              DeadlineExceeded,
                                              OverloadedError,
                                              ShedRequest)
+from opencompass_tpu.serve.autoscaler import (Autoscaler,
+                                              AutoscalerConfig)
+from opencompass_tpu.serve.pinner import HotPrefixPinner
 from opencompass_tpu.serve.queue import QUEUE_SUBDIR, SweepQueue
 from opencompass_tpu.serve.scheduler import (RETRY_MAX_ATTEMPTS,
                                              RetryBudget, WorkerPool,
@@ -169,6 +172,20 @@ class EvalEngine:
         # per-model retry budget: worker-protocol retries draw from a
         # token bucket so a flapping incident never amplifies load
         self.retry_budget = RetryBudget()
+        # elastic fleet (serve/autoscaler.py): config block
+        # `autoscaler = dict(max_replicas=..., ...)` — validated here,
+        # the control loop itself starts with the pool in start().
+        # None = static fleet (idle-TTL only), the pre-PR default.
+        self.autoscaler_cfg = AutoscalerConfig.from_cfg(
+            cfg.get('autoscaler'))
+        self.autoscaler: Optional[Autoscaler] = None
+        # hot-prefix pinning (serve/pinner.py): on by default —
+        # advisory fire-and-forget frames; `prefix_pin = False`
+        # disables, `prefix_pin = dict(min_count=..., ...)` tunes
+        pin_cfg = cfg.get('prefix_pin', {})
+        self.prefix_pinner: Optional[HotPrefixPinner] = None
+        if pin_cfg is not False and pin_cfg is not None:
+            self.prefix_pinner = HotPrefixPinner(**dict(pin_cfg or {}))
         self._key_abbr: Optional[Dict[str, str]] = None
         self.pool: Optional[WorkerPool] = None
         self.infer_runner = None
@@ -247,6 +264,16 @@ class EvalEngine:
             num_devices=self._num_devices,
             use_workers=False)
         self.pool.start_reaper(interval=max(self.poll_s * 4, 5.0))
+        if self.autoscaler_cfg is not None:
+            self.autoscaler = Autoscaler(
+                self.autoscaler_cfg,
+                keys_fn=lambda: [self.affinity_key(cfg) for cfg in
+                                 list(self._catalog.values())],
+                signals_fn=self._autoscaler_signals,
+                retire_fn=self.pool.retire_excess,
+                prewarm_fn=self._prewarm_instance,
+                obs_dir=self.serve_obs_dir)
+            self.autoscaler.start()
 
         from opencompass_tpu.obs.promexport import \
             render_rollup_exposition
@@ -307,6 +334,8 @@ class EvalEngine:
         from opencompass_tpu.obs.live import mark_run
         self._stop.set()
         reqtrace.clear_engine_info(self.serve_obs_dir, pid=os.getpid())
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=30)
         if self._slo_thread is not None:
@@ -491,7 +520,8 @@ class EvalEngine:
                  request_id: Optional[str] = None,
                  response_id: Optional[str] = None,
                  parse_seconds: float = 0.0,
-                 deadline: Optional[reqtrace.Deadline] = None) -> Dict:
+                 deadline: Optional[reqtrace.Deadline] = None,
+                 stream=None, preadmitted: bool = False) -> Dict:
         """Generate completions on the resident worker for ``model``
         (catalog abbr).  Store-first: a prompt identical to a sweep row
         or a previous request is served from disk without touching the
@@ -507,7 +537,19 @@ class EvalEngine:
         chip/lease wait, worker protocol overhead, model build, store
         lookup, model forward, store commit.  The same sample feeds
         the ``/v1/stats`` rolling window and the per-model
-        latency/TTFT histograms on ``/metrics``."""
+        latency/TTFT histograms on ``/metrics``.
+
+        ``stream``: a :class:`~opencompass_tpu.serve.stream
+        .CompletionStreamSession` — the worker round-trip becomes a
+        streaming one (interim frames land on the session as they
+        retire from the engine), the record's ``ttft_s`` becomes the
+        session's measured first-byte delivery wall, its ITL
+        percentiles come from delivery timestamps, and a client that
+        hung up mid-stream marks the record ``degraded:
+        client_disconnect``.  ``preadmitted=True`` means the HTTP
+        handler already holds the admission seat (it shed with a real
+        429 *before* committing to a 200 + SSE body) — this call still
+        releases it."""
         import uuid
         request_id = request_id or reqtrace.mint_request_id()
         response_id = response_id or f'cmpl-{uuid.uuid4().hex[:24]}'
@@ -516,7 +558,7 @@ class EvalEngine:
         timings: Dict[str, float] = {}
         resp = None
         error = None
-        admitted = False
+        admitted = preadmitted
         degraded_kind = None   # 'shed' | 'deadline' | None
         try:
             model_cfg = self._catalog.get(model)
@@ -535,13 +577,22 @@ class EvalEngine:
             # below, so no accepted request is ever silently dropped.
             # An admitted decision already HOLDS the seat (atomic
             # reserve); the finally releases it.
-            self.admission.admit_completion().raise_if_shed()
-            admitted = True
+            if not preadmitted:
+                self.admission.admit_completion().raise_if_shed()
+                admitted = True
             resp = self._request_complete(model_cfg, prompts,
                                           max_out_len, timeout,
                                           request_id=request_id,
                                           timings=timings,
-                                          deadline=deadline)
+                                          deadline=deadline,
+                                          stream=stream)
+            if stream is not None and (stream.disconnected
+                                       or resp.get('cancelled_rows')):
+                # the consumer dropped mid-stream: the rows were
+                # aborted (slots + pages freed early) — durable record,
+                # out of the SLO feed (the client's choice, not our
+                # service time)
+                degraded_kind = 'client_disconnect'
         except BaseException as exc:
             error = f'{type(exc).__name__}: {exc}'
             if isinstance(exc, DeadlineExceeded):
@@ -563,7 +614,7 @@ class EvalEngine:
                 ts=ts, model=model, wall_s=wall,
                 parse_s=parse_seconds, timings=timings,
                 resp=resp, error=error,
-                degraded_kind=degraded_kind)
+                degraded_kind=degraded_kind, stream=stream)
         with self._complete_lock:
             self._completions += 1
         resp['id'] = response_id
@@ -579,7 +630,8 @@ class EvalEngine:
                         ts: float, model: str, wall_s: float,
                         parse_s: float, timings: Dict,
                         resp: Optional[Dict], error: Optional[str],
-                        degraded_kind: Optional[str] = None):
+                        degraded_kind: Optional[str] = None,
+                        stream=None):
         """One requests.jsonl record + rolling-window/histogram feed
         per completion attempt.  Never raises (telemetry contract).
 
@@ -588,9 +640,20 @@ class EvalEngine:
         of the rolling completion window entirely, since a refusal is
         not a completion and its ~0 ms "latency" would drag p99 *down*
         while burning the availability budget — a shed-causes-burn-
-        causes-shed feedback loop) and ``'deadline'`` (504 — recorded
+        causes-shed feedback loop), ``'deadline'`` (504 — recorded
         in the window for visibility but excluded from the SLO feed;
-        the client's budget, not our service time)."""
+        the client's budget, not our service time), and
+        ``'client_disconnect'`` (the streamed consumer hung up — rows
+        aborted, record kept, SLO-excluded: their walk-away, not our
+        latency).
+
+        ``stream``: a finished CompletionStreamSession — its measured
+        first-byte wall REPLACES the worker-side ``ttft_s`` (estimate
+        or device-side measurement alike: the delivery timestamp is the
+        latency the client felt) and its delivery-gap ITL percentiles
+        replace the device-side ones; the record carries a ``stream``
+        block (frames, disconnect, send-block backpressure walls) the
+        ``stream_backpressure`` doctor rule reads."""
         try:
             from opencompass_tpu.obs.metrics import labeled
             wp = (resp or {}).get('phases') or {}
@@ -635,6 +698,8 @@ class EvalEngine:
             ttft = None
             if resp is not None:
                 ttft = resp.get('ttft_s')
+                if resp.get('ttft_estimated'):
+                    rec['ttft_estimated'] = True
                 rec['usage'] = {
                     'prompt_tokens': resp.get('prompt_tokens'),
                     'completion_tokens': resp.get('completion_tokens'),
@@ -655,6 +720,27 @@ class EvalEngine:
                 if resp.get('itl_p99_ms') is not None:
                     rec['itl'] = {'p50_ms': resp.get('itl_p50_ms'),
                                   'p99_ms': resp.get('itl_p99_ms')}
+            itl_ms = (resp or {}).get('itl_ms')
+            if stream is not None:
+                # delivery truth wins: the session's first flushed byte
+                # is the TTFT the client felt (retires the dense-path
+                # estimate AND supersedes the device-side measurement),
+                # and delivery-gap ITL replaces emission-side ITL
+                if stream.first_byte_s is not None:
+                    ttft = stream.first_byte_s
+                    rec['ttft_s'] = ttft
+                    rec.pop('ttft_estimated', None)
+                    rec['ttft_source'] = 'stream_first_byte'
+                stream_itl = stream.itl_ms()
+                if stream_itl:
+                    itl_ms = stream_itl
+                    rec['itl'] = {
+                        'p50_ms': round(reqtrace.percentile(
+                            stream_itl, 0.50), 3),
+                        'p99_ms': round(reqtrace.percentile(
+                            stream_itl, 0.99), 3),
+                        'source': 'delivery'}
+                rec['stream'] = stream.record_fields()
             self.req_recorder.record(rec)
             # label cardinality guard: client-supplied model strings
             # that never resolved in the catalog must not mint
@@ -669,8 +755,9 @@ class EvalEngine:
                     store_hits=(resp or {}).get('store_hits') or 0,
                     device_rows=(resp or {}).get('device_rows') or 0,
                     ts=ts, mbu=(resp or {}).get('mbu'),
-                    itl_ms=(resp or {}).get('itl_ms'),
-                    slo_excluded=degraded_kind == 'deadline')
+                    itl_ms=itl_ms,
+                    slo_excluded=degraded_kind in (
+                        'deadline', 'client_disconnect'))
             reqtrace.annotate(model=label_model,
                               completion_id=response_id)
             if self.tracer is not None and self.tracer.enabled:
@@ -697,8 +784,8 @@ class EvalEngine:
                           max_out_len: int, timeout: float,
                           request_id: Optional[str] = None,
                           timings: Optional[Dict] = None,
-                          deadline: Optional[reqtrace.Deadline] = None
-                          ) -> Dict:
+                          deadline: Optional[reqtrace.Deadline] = None,
+                          stream=None) -> Dict:
         """One completion against the resident fleet, with the
         degradation plane wired in:
 
@@ -718,6 +805,11 @@ class EvalEngine:
         from opencompass_tpu.serve.scheduler import CircuitOpenError
         timings = timings if timings is not None else {}
         key = self.affinity_key(model_cfg)
+        if self.autoscaler is not None:
+            # elastic fleet: route to one of the key's replica
+            # instances (replica 0 IS the bare key, so a one-replica
+            # fleet behaves byte-identically to the static pool)
+            key = self.autoscaler.route(key)
         # ONE total internal budget for the whole request, retries
         # included: every wait below (chip alloc, protocol, backoff)
         # spends from it, so worst-case wall is ~timeout — never
@@ -729,7 +821,7 @@ class EvalEngine:
                 return self._complete_once(key, model_cfg, prompts,
                                            max_out_len, budget_ts,
                                            request_id, timings,
-                                           deadline)
+                                           deadline, stream=stream)
             except CircuitOpenError as exc:
                 raise OverloadedError(
                     str(exc), retry_after_s=exc.retry_after_s,
@@ -766,7 +858,8 @@ class EvalEngine:
                        prompts: List[str], max_out_len: int,
                        budget_ts: float, request_id: Optional[str],
                        timings: Dict,
-                       deadline: Optional[reqtrace.Deadline]) -> Dict:
+                       deadline: Optional[reqtrace.Deadline],
+                       stream=None) -> Dict:
         """One attempt against the resident worker.  ``budget_ts`` is
         the request's total internal deadline (monotonic) — chip wait
         and protocol wait both spend from it, so one attempt can never
@@ -842,7 +935,19 @@ class EvalEngine:
             # channel-concurrent join: mid-sweep the worker answers from
             # its resident continuous engine; without one it replies
             # busy and request_join falls back to the serialized wait
-            resp = worker.request_join(msg, timeout=budget)
+            if stream is not None:
+                msg['stream'] = True
+                # the disconnect abort is fire-and-forget: it must be
+                # sendable from the handle's own reader thread (a
+                # waiting round-trip there would deadlock the reader
+                # that has to deliver the abort's reply)
+                handle = worker.handle
+                stream.bind_abort(lambda: handle.post(
+                    {'cmd': 'abort', 'request_id': request_id}))
+                resp = worker.request_stream(msg, stream.on_frame,
+                                             timeout=budget)
+            else:
+                resp = worker.request_join(msg, timeout=budget)
         except WorkerBusyError as exc:
             # healthy worker, channel occupied: back-pressure, not a
             # corpse — release the lease; 503 (or 504 when the budget
@@ -868,6 +973,20 @@ class EvalEngine:
         # when the request itself failed (deadline, app error) — a
         # probe outcome must always reach the breaker
         self.pool.note_protocol_success(key)
+        if self.prefix_pinner is not None and resp.get('ok'):
+            # hot-prefix pinning rides fire-and-forget frames on the
+            # still-open handle: advisory end to end, never a failure
+            try:
+                to_pin, to_unpin = self.prefix_pinner.observe(
+                    key, prompts)
+                for prefix, pin in ([(p, True) for p in to_pin]
+                                    + [(p, False) for p in to_unpin]):
+                    worker.handle.post(
+                        {'cmd': 'prefix_pin',
+                         'model_cfg': _wire_model_cfg(model_cfg),
+                         'prefix': prefix, 'pin': pin})
+            except Exception:
+                pass
         if resp.get('deadline_exceeded'):
             # the worker is healthy — it enforced the deadline for us
             raise DeadlineExceeded(
@@ -887,6 +1006,76 @@ class EvalEngine:
             return env, osp.join(self.run_dir, 'logs', 'worker',
                                  f'{key}.out')
         return spawn
+
+    # -- elastic autoscaling -----------------------------------------------
+
+    def _autoscaler_signals(self, key: str) -> Dict:
+        """The measured pressure/idle signals one autoscaler ``decide``
+        round consumes for ``key`` — the same facts admission sheds on
+        (queue drain ETA, page-severity burn, breaker state, decode
+        slot utilization), never a new estimator.  Never raises: a
+        telemetry fault reads as "no pressure", not as a crash in the
+        control loop."""
+        signals: Dict = {'queue_eta_s': 0.0, 'page_alerts': 0,
+                         'breakers_open': 0, 'slot_util': 0.0,
+                         'inflight': 0}
+        try:
+            depth, eta = self._queue_eta()
+            signals['queue_eta_s'] = float(eta or 0.0)
+        except Exception:
+            pass
+        try:
+            signals['page_alerts'] = sum(
+                1 for a in self.slo_eval.active()
+                if a.get('severity') == 'page')
+        except Exception:
+            pass
+        try:
+            breakers = self.pool.breaker_snapshot() \
+                if self.pool is not None else {}
+            signals['breakers_open'] = sum(
+                1 for bkey, snap in breakers.items()
+                if (bkey == key or bkey.startswith(key + '@r'))
+                and snap.get('state') == 'open')
+        except Exception:
+            pass
+        try:
+            inflight = int(self.admission.inflight)
+            signals['inflight'] = inflight
+            seat_util = inflight / max(self.admission.max_inflight, 1)
+            eff = self._efficiency_snapshot() or {}
+            signals['slot_util'] = max(
+                float(eff.get('decode_slot_util') or 0.0), seat_util)
+        except Exception:
+            pass
+        return signals
+
+    def _prewarm_instance(self, instance_key: str):
+        """Build a new replica's worker BEFORE the router sends it
+        traffic: acquire the instance's lease, run the same
+        empty-prompt probe ``_warm_fleet`` uses (weights on device,
+        zero generation), release.  Raises on failure — the autoscaler
+        journals the error and retries on a later round."""
+        base = instance_key.split('@r', 1)[0]
+        abbr = self._abbr_for_key(base)
+        model_cfg = self._catalog.get(abbr) if abbr else None
+        if model_cfg is None:
+            raise KeyError(f'no catalog model for pool key {base!r}')
+        run_cfg = model_cfg.get('run_cfg', {}) or {}
+        devices = run_cfg.get('num_devices', run_cfg.get('num_gpus', 0))
+        worker = self.pool.acquire(
+            instance_key, self._spawn_fn(instance_key, devices),
+            devices=devices, alloc_timeout_s=60.0)
+        try:
+            worker.request_join(
+                {'cmd': 'complete',
+                 'model_cfg': _wire_model_cfg(model_cfg),
+                 'prompts': [], 'max_out_len': 0,
+                 'cache_root': self.cache_root,
+                 'work_dir': self.run_dir},
+                timeout=DEFAULT_COMPLETE_TIMEOUT_S)
+        finally:
+            self.pool.release(worker)
 
     def _warm_fleet(self):
         """Pre-build every catalog model (empty-prompt probe = weights
@@ -1031,7 +1220,9 @@ class EvalEngine:
 
     def _abbr_for_key(self, key: str) -> Optional[str]:
         """Reverse map: pool affinity digest → catalog model abbr (the
-        human name `cli top` and the per-worker gauges label with)."""
+        human name `cli top` and the per-worker gauges label with).
+        Autoscaler replica keys (``<digest>@r<i>``) resolve to their
+        base model's abbr."""
         if self._key_abbr is None:
             mapping = {}
             for abbr, model_cfg in list(self._catalog.items()):
@@ -1040,7 +1231,7 @@ class EvalEngine:
                 except Exception:
                     pass
             self._key_abbr = mapping
-        return self._key_abbr.get(key)
+        return self._key_abbr.get(key.split('@r', 1)[0])
 
     def _worker_table(self,
                       stats: Optional[Dict] = None) -> Dict[str, Dict]:
@@ -1080,6 +1271,10 @@ class EvalEngine:
         efficiency = self._efficiency_snapshot()
         if efficiency:
             summary['efficiency'] = efficiency
+        summary['autoscaler'] = self.autoscaler.snapshot() \
+            if self.autoscaler is not None else {'enabled': False}
+        if self.prefix_pinner is not None:
+            summary['prefix_pin'] = self.prefix_pinner.snapshot()
         return summary
 
     def _efficiency_snapshot(self) -> Optional[Dict]:

@@ -17,7 +17,9 @@ Two API surfaces mounted on the PR 2 telemetry server
 **Data plane** (OpenAI-compatible)::
 
     POST /v1/completions     {"model": "<abbr>", "prompt": "...",
-                              "max_tokens": 16}
+                              "max_tokens": 16[, "stream": true]}
+                             stream=true → SSE ``text_completion.chunk``
+                             events as tokens retire (serve/stream.py)
     GET  /v1/models          catalog listing
     GET  /v1/stats           rolling-window SLO summary
                              (?window=SECONDS, default 300): per-route
@@ -184,6 +186,80 @@ def build_routes(engine) -> Dict:
                          'only queued sweeps cancel',
                     'sweep_not_cancellable')
 
+    def _stream_completion(model, prompts, max_tokens, request_id,
+                           cmpl_id, parse_s, deadline):
+        """The ``"stream": true`` lane: everything that can refuse with
+        a REAL status code (404 / 429 + Retry-After / 504) refuses
+        *before* the 200 + SSE headers leave; past that point failures
+        ride the stream as typed error events.  The admission seat is
+        taken here (so the shed is an honest 429, not an in-band
+        event) and handed to ``engine.complete(preadmitted=True)``,
+        which releases it."""
+        from opencompass_tpu.obs.promexport import StreamingResponse
+        from opencompass_tpu.serve.stream import (SSE_CONTENT_TYPE,
+                                                  CompletionStreamSession)
+        if model not in (engine.models() or []):
+            return _err(404, f'model {model!r} not served; have: '
+                             f'{engine.models()}', 'model_not_found')
+        if deadline is not None and deadline.expired():
+            reqtrace.annotate(deadline_phase='admission')
+            return 504, {'error': {
+                'message': 'deadline expired before streaming started',
+                'type': 'deadline_exceeded', 'phase': 'admission',
+                'request_id': request_id}}
+        preadmitted = False
+        admission = getattr(engine, 'admission', None)
+        if admission is not None:
+            decision = admission.admit_completion()
+            if not decision.admitted:
+                reqtrace.annotate(shed=decision.reason)
+                return _shed_err(
+                    429, decision.detail, 'overloaded',
+                    decision.retry_after_s, reason=decision.reason)
+            preadmitted = True
+        session = CompletionStreamSession(cmpl_id, model,
+                                          request_id=request_id)
+        annotations = {}
+
+        def producer(send):
+            session.bind_send(send)
+            try:
+                resp = engine.complete(model, prompts,
+                                       max_out_len=max_tokens,
+                                       request_id=request_id,
+                                       response_id=cmpl_id,
+                                       parse_seconds=parse_s,
+                                       deadline=deadline,
+                                       stream=session,
+                                       preadmitted=preadmitted)
+            except (ShedRequest, OverloadedError) as exc:
+                reqtrace.annotate(shed=exc.reason)
+                session.send_error(str(exc), 'overloaded',
+                                   reason=exc.reason)
+            except DeadlineExceeded as exc:
+                reqtrace.annotate(deadline_phase=exc.phase)
+                session.send_error(str(exc), 'deadline_exceeded',
+                                   phase=exc.phase,
+                                   request_id=request_id)
+            except Exception as exc:
+                session.send_error(f'{type(exc).__name__}: {exc}',
+                                   'server_error')
+            else:
+                session.finish(resp)
+            finally:
+                # merged into the access-log line by the dispatch
+                # guard once the stream closes
+                annotations['stream_frames'] = session.frames
+                if session.first_byte_s is not None:
+                    annotations['stream_first_byte_s'] = \
+                        session.first_byte_s
+                if session.disconnected:
+                    annotations['client_disconnect'] = True
+
+        return 200, StreamingResponse(producer,
+                                      content_type=SSE_CONTENT_TYPE,
+                                      annotations=annotations)
+
     def completions(path, query, body):
         # the request id travels with the record: honored inbound
         # (X-OCT-Request-Id, stamped by the dispatch guard), minted
@@ -218,6 +294,10 @@ def build_routes(engine) -> Dict:
         # threads it through lease wait -> worker protocol -> forward,
         # so every internal budget derives from this one number
         deadline = reqtrace.current_deadline()
+        if req.get('stream'):
+            return _stream_completion(model, prompts, max_tokens,
+                                      request_id, cmpl_id, parse_s,
+                                      deadline)
         try:
             resp = engine.complete(model, prompts,
                                    max_out_len=max_tokens,

@@ -1,0 +1,108 @@
+"""Hot-prefix pinning from the serve front door (ROADMAP item 2 tail).
+
+Interactive traffic repeats system prompts: every ``/v1/completions``
+request that shares the leading instruction block re-prefills the same
+tokens unless the radix prefix cache still holds them — and under
+memory pressure the evictor treats a hot system prompt like any other
+cold chain.  :class:`HotPrefixPinner` watches the request stream,
+counts normalized prompt prefixes per model key, and once a prefix
+crosses ``min_count`` asks the resident worker to **pin** its trie
+chain (``prefix_pin`` protocol cmd →
+``ContinuousEngine.pin_prefix`` → ``RadixPrefixCache.pin``), making
+those pages ineligible for eviction.  A prefix that falls out of the
+bounded hot set (LRU past ``max_pinned``) is unpinned the same way, so
+a drifting workload never wedges the page pool.
+
+The pinner is advisory end to end: pins ride fire-and-forget frames
+(``WorkerHandle.post``), a worker without a resident engine answers
+``pinned: 0``, and any tracker failure degrades to "no pin", never to
+a failed completion.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+DEFAULT_MIN_COUNT = 4
+DEFAULT_MAX_PINNED = 8
+DEFAULT_PREFIX_CHARS = 256
+
+
+class HotPrefixPinner:
+    """Request-count keyed pin/unpin decisions over prompt prefixes.
+
+    Args:
+        min_count: requests sharing a prefix before it pins.
+        max_pinned: pinned prefixes kept per model key (LRU beyond).
+        prefix_chars: leading characters of the prompt treated as "the
+            prefix" — system prompts live at the front, and the trie
+            pin only covers full pages of it anyway.
+    """
+
+    def __init__(self, min_count: int = DEFAULT_MIN_COUNT,
+                 max_pinned: int = DEFAULT_MAX_PINNED,
+                 prefix_chars: int = DEFAULT_PREFIX_CHARS):
+        self.min_count = max(int(min_count), 1)
+        self.max_pinned = max(int(max_pinned), 1)
+        self.prefix_chars = max(int(prefix_chars), 1)
+        self._lock = threading.Lock()
+        # key -> prefix -> request count  # guarded-by: _lock
+        self._counts: Dict[str, Dict[str, int]] = {}
+        # key -> prefix -> last-use monotonic  # guarded-by: _lock
+        self._pinned: Dict[str, Dict[str, float]] = {}
+        self.pins = 0
+        self.unpins = 0
+
+    def observe(self, key: str, prompts: List[str],
+                now: Optional[float] = None
+                ) -> Tuple[List[str], List[str]]:
+        """Count one request's prompt prefixes; returns
+        ``(to_pin, to_unpin)`` — prefixes that just crossed the
+        threshold, and pinned prefixes LRU-evicted past ``max_pinned``.
+        The caller owns delivery (the worker frame); this is pure
+        bookkeeping and never raises."""
+        now = time.monotonic() if now is None else now
+        to_pin: List[str] = []
+        to_unpin: List[str] = []
+        with self._lock:
+            counts = self._counts.setdefault(key, {})
+            pinned = self._pinned.setdefault(key, {})
+            for prompt in prompts:
+                prefix = str(prompt)[:self.prefix_chars]
+                if not prefix:
+                    continue
+                if prefix in pinned:
+                    pinned[prefix] = now   # keep the hot set hot
+                    continue
+                counts[prefix] = counts.get(prefix, 0) + 1
+                if counts[prefix] >= self.min_count:
+                    del counts[prefix]
+                    pinned[prefix] = now
+                    to_pin.append(prefix)
+            while len(pinned) > self.max_pinned:
+                coldest = min(pinned, key=pinned.get)
+                del pinned[coldest]
+                to_unpin.append(coldest)
+            # bound the candidate table too: a high-cardinality prompt
+            # stream must not grow daemon memory without limit
+            if len(counts) > 64 * self.max_pinned:
+                for prefix in sorted(counts, key=counts.get)[
+                        :len(counts) // 2]:
+                    del counts[prefix]
+            self.pins += len(to_pin)
+            self.unpins += len(to_unpin)
+        return to_pin, to_unpin
+
+    def snapshot(self) -> Dict:
+        """Counts only — raw prompt text stays out of ``/v1/stats``."""
+        with self._lock:
+            return {
+                'pinned': {key: len(prefixes)
+                           for key, prefixes in self._pinned.items()
+                           if prefixes},
+                'pins': self.pins,
+                'unpins': self.unpins,
+                'min_count': self.min_count,
+                'max_pinned': self.max_pinned,
+            }

@@ -192,6 +192,58 @@ class ResidentWorker:
                     resp.get('error') or f'worker {self.key} busy')
         return self.request(msg, timeout=remaining)
 
+    def request_stream(self, msg: Dict, on_event,
+                       timeout: Optional[float] = None) -> Dict:
+        """:meth:`request_join`'s streaming twin: the frame rides the
+        demuxed channel immediately and interim ``stream`` frames land
+        on ``on_event`` as the engine retires tokens; a mid-run worker
+        without a resident engine answers ``busy`` and we fall back to
+        the lock-serialized wait (sink still attached) for whatever
+        budget remains."""
+        from opencompass_tpu.runners.worker import WorkerTimeout
+        t0 = time.monotonic()
+        self.requests += 1
+        label, t_req = self._track_begin(msg)
+        try:
+            try:
+                resp = self.handle.request_stream(
+                    msg, on_event, timeout=timeout,
+                    kill_on_timeout=False)
+            except WorkerTimeout as exc:
+                raise WorkerBusyError(str(exc)) from exc
+        finally:
+            self._track_end(label, t_req)
+            self.last_used = time.monotonic()
+        if not (isinstance(resp, dict) and resp.get('busy')):
+            return resp
+        # the busy probe was not a served request (see request_join)
+        self.requests -= 1
+        remaining = None
+        if timeout is not None:
+            remaining = timeout - (time.monotonic() - t0)
+            if remaining <= 0.5:
+                raise WorkerBusyError(
+                    resp.get('error') or f'worker {self.key} busy')
+            t1 = time.monotonic()
+            if not self.lock.acquire(timeout=remaining):
+                raise WorkerBusyError(
+                    f'worker {self.key} busy past {timeout:.0f}s '
+                    '(an in-flight request holds the channel)')
+            remaining = max(1.0, remaining - (time.monotonic() - t1))
+        else:
+            self.lock.acquire()
+        try:
+            self.requests += 1
+            label, t_req = self._track_begin(msg)
+            try:
+                return self.handle.request_stream(msg, on_event,
+                                                  timeout=remaining)
+            finally:
+                self._track_end(label, t_req)
+                self.last_used = time.monotonic()
+        finally:
+            self.lock.release()
+
     def kill(self):
         self.handle.kill()
 
@@ -396,6 +448,30 @@ class WorkerPool:
             self._reaped += 1
             self._observe('worker_pool_reaped', worker.key,
                           idle_s=round(now - worker.last_used, 1))
+        return [w.key for w in victims]
+
+    def retire_excess(self, base_key: str, keep: int) -> List[str]:
+        """Autoscaler scale-down: retire replica instances of
+        ``base_key`` (instance keys ``base_key@r<i>``) with ``i >=
+        keep``.  Leased instances are skipped — their leases drain and
+        the next control-loop pass (or the reaper, once the instance
+        key stops being routed) catches them.  Returns retired keys."""
+        marker = base_key + '@r'
+        victims: List[ResidentWorker] = []
+        with self._lock:
+            for key, worker in list(self._workers.items()):
+                if not key.startswith(marker):
+                    continue
+                try:
+                    index = int(key[len(marker):])
+                except ValueError:
+                    continue
+                if index >= max(keep, 1) and worker.in_use == 0:
+                    self._pop_locked(worker)
+                    victims.append(worker)
+        for worker in victims:
+            self._retire(worker, graceful=True)
+            self._observe('worker_pool_scaled_down', worker.key)
         return [w.key for w in victims]
 
     def start_reaper(self, interval: float = 30.0):

@@ -1,0 +1,258 @@
+"""SSE token streaming for ``POST /v1/completions``.
+
+The front door's streaming lane: ``{"stream": true}`` turns the
+buffered ``text_completion`` blob into Server-Sent Events, one
+``text_completion.chunk``-shaped event per delivered text piece::
+
+    data: {"id": "cmpl-...", "object": "text_completion.chunk",
+           "choices": [{"index": 0, "text": "tok ", ...}], ...}
+    data: {... final chunk with "usage" and the "oct" block ...}
+    data: [DONE]
+
+Wire path: the continuous engine's per-token emit hook
+(``models/jax_lm.py``) → the worker's interim ``{'stream': true}``
+frames (``runners/worker.py``) → the handle sink on the daemon side →
+:class:`CompletionStreamSession.on_frame` → one flushed SSE chunk on
+the client socket.  Because the session timestamps each *delivery*
+(the flushed write, not the device-side sample), the request record's
+``ttft_s`` becomes a measured first-byte wall and its ITL percentiles
+come from what the client actually observed — retiring the PR 8
+dense-path TTFT estimate for engine-backed models.
+
+Disconnect contract: a consumer that drops mid-stream raises
+``ClientDisconnected`` out of the send; the session marks itself
+disconnected, fires the bound abort hook (a fire-and-forget worker
+``abort`` frame → ``ContinuousEngine.cancel`` retires the rows and
+frees their pages at the next step boundary), and the request lands in
+requests.jsonl as ``degraded: client_disconnect``.
+
+Backpressure: every send's blocking wall is measured; a slow consumer
+shows up as ``send_block_ms_max`` / ``send_block_s_total`` on the
+record, which the ``stream_backpressure`` doctor rule reads.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from opencompass_tpu.obs.promexport import ClientDisconnected
+
+SSE_CONTENT_TYPE = 'text/event-stream; charset=utf-8'
+SSE_DONE = b'data: [DONE]\n\n'
+
+
+def sse_event(payload: Dict) -> bytes:
+    """One SSE frame: ``data: <json>\\n\\n`` (single-line JSON, so no
+    multi-line ``data:`` continuation is ever needed)."""
+    return b'data: ' + json.dumps(
+        payload, separators=(',', ':'), default=str).encode('utf-8') \
+        + b'\n\n'
+
+
+class CompletionStreamSession:
+    """One streamed completion: worker frames in, SSE chunks out,
+    delivery-side latency truth kept.
+
+    Threading: ``on_frame`` runs on whichever thread holds the worker
+    handle's pipe-reader seat while the HTTP thread blocks inside
+    ``engine.complete``; ``finish``/``send_error`` run on the HTTP
+    thread after the round-trip returns.  The send lock serializes the
+    socket writes; counters/timestamps are only touched under it.
+    """
+
+    def __init__(self, response_id: str, model: str,
+                 request_id: Optional[str] = None,
+                 created: Optional[int] = None):
+        self.response_id = response_id
+        self.model = model
+        self.request_id = request_id
+        self.created = created if created is not None \
+            else int(time.time())
+        self._send: Optional[Callable[[bytes], None]] = None
+        self._abort: Optional[Callable[[], None]] = None
+        self._lock = threading.Lock()
+        # request-arrival anchor: first_byte_s is measured from HERE
+        # (session construction in the handler), so it includes parse,
+        # admission, lease wait, and prefill — the wall the user feels
+        self._t0 = time.perf_counter()
+        self._last_delivery: Optional[float] = None
+        # chars already streamed per row: finish() emits only each
+        # row's unstreamed tail, so streamed concat == buffered text
+        # even for dense-path rows that never produced interim frames
+        self._nsent: Dict[int, int] = {}
+        self.first_byte_s: Optional[float] = None
+        self.itl_s: List[float] = []
+        self.frames = 0
+        self.disconnected = False
+        self.send_block_s_total = 0.0
+        self.send_block_s_max = 0.0
+
+    # -- wiring (producer / engine side) -----------------------------------
+
+    def bind_send(self, send: Callable[[bytes], None]):
+        self._send = send
+
+    def bind_abort(self, abort: Callable[[], None]):
+        """Called by the daemon once the worker round-trip is in
+        flight; if the client already hung up, fire it immediately —
+        the disconnect must never wait for another token."""
+        fire = False
+        with self._lock:
+            self._abort = abort
+            fire = self.disconnected
+        if fire:
+            self._fire_abort()
+
+    def _fire_abort(self):
+        abort = self._abort
+        if abort is None:
+            return
+        try:
+            abort()
+        except Exception:
+            pass
+
+    # -- frame delivery ----------------------------------------------------
+
+    def _chunk(self, row: int, piece: str,
+               finish_reason: Optional[str] = None,
+               extra: Optional[Dict] = None) -> bytes:
+        payload = {
+            'id': self.response_id,
+            'object': 'text_completion.chunk',
+            'created': self.created,
+            'model': self.model,
+            'choices': [{'index': int(row), 'text': piece,
+                         'logprobs': None,
+                         'finish_reason': finish_reason}],
+        }
+        if extra:
+            payload.update(extra)
+        return sse_event(payload)
+
+    def _deliver(self, chunk: bytes) -> bool:
+        """Write one chunk; returns False once the client is gone.
+        Delivery timestamps and backpressure walls are stamped here —
+        after the flush, because the flush IS the delivery."""
+        with self._lock:
+            if self.disconnected or self._send is None:
+                return False
+            t_w = time.perf_counter()
+            try:
+                self._send(chunk)
+            except ClientDisconnected:
+                self.disconnected = True
+            else:
+                now = time.perf_counter()
+                block = now - t_w
+                self.send_block_s_total += block
+                self.send_block_s_max = max(self.send_block_s_max,
+                                            block)
+                if self.first_byte_s is None:
+                    self.first_byte_s = round(now - self._t0, 6)
+                elif self._last_delivery is not None:
+                    self.itl_s.append(now - self._last_delivery)
+                self._last_delivery = now
+                self.frames += 1
+                return True
+        # outside the lock: the abort frame must not serialize behind
+        # another in-flight send
+        self._fire_abort()
+        return False
+
+    def on_frame(self, frame: Dict):
+        """Worker interim-frame sink (see ``WorkerHandle.request_stream``
+        — runs on the pipe-reader thread, must stay fast and must not
+        raise)."""
+        piece = frame.get('piece')
+        if not piece:
+            return
+        row = int(frame.get('row') or 0)
+        if self._deliver(self._chunk(row, str(piece))):
+            self._nsent[row] = self._nsent.get(row, 0) \
+                + len(str(piece))
+
+    # -- terminal frames (HTTP thread) -------------------------------------
+
+    def finish(self, resp: Dict):
+        """Final frames after the worker round-trip: each row's
+        unstreamed tail (dense-path rows stream their whole text here),
+        then a summary chunk carrying usage and the ``oct`` block, then
+        ``[DONE]``."""
+        completions = resp.get('completions') or []
+        for row, text in enumerate(completions):
+            text = str(text)
+            tail = text[self._nsent.get(row, 0):]
+            if tail:
+                if not self._deliver(self._chunk(row, tail)):
+                    return
+                self._nsent[row] = len(text)
+        usage = {}
+        if resp.get('prompt_tokens') is not None:
+            usage = {'prompt_tokens': resp['prompt_tokens'],
+                     'completion_tokens': resp.get('completion_tokens'),
+                     'total_tokens': (resp['prompt_tokens']
+                                      + (resp.get('completion_tokens')
+                                         or 0))}
+        final = {
+            'id': self.response_id,
+            'object': 'text_completion.chunk',
+            'created': self.created,
+            'model': self.model,
+            'choices': [{'index': row, 'text': '', 'logprobs': None,
+                         'finish_reason': 'length'}
+                        for row in range(len(completions))],
+            'usage': usage,
+            'oct': {'id': self.response_id,
+                    'request_id': resp.get('request_id')
+                    or self.request_id,
+                    'store_hits': resp.get('store_hits'),
+                    'device_rows': resp.get('device_rows'),
+                    'model_built': resp.get('built'),
+                    'elapsed_seconds': resp.get('elapsed_seconds'),
+                    'ttft_seconds': self.first_byte_s,
+                    'stream_frames': self.frames,
+                    'cancelled_rows': resp.get('cancelled_rows')},
+        }
+        if self._deliver(sse_event(final)):
+            self._deliver_done()
+
+    def send_error(self, message: str, err_type: str,
+                   **fields):
+        """Mid-stream failure: one typed error event, then ``[DONE]`` —
+        the 200 already left, so the error rides the stream (same shape
+        as the JSON error body, greppable by the same clients)."""
+        err = {'message': message, 'type': err_type}
+        err.update(fields)
+        if self._deliver(sse_event({'id': self.response_id,
+                                    'object': 'error',
+                                    'error': err})):
+            self._deliver_done()
+
+    def _deliver_done(self):
+        with self._lock:
+            if self.disconnected or self._send is None:
+                return
+            try:
+                self._send(SSE_DONE)
+            except ClientDisconnected:
+                self.disconnected = True
+
+    # -- record-side truth -------------------------------------------------
+
+    def itl_ms(self) -> List[float]:
+        return [round(v * 1e3, 3) for v in self.itl_s]
+
+    def record_fields(self) -> Dict:
+        """The streamed request's slice of its requests.jsonl record
+        (the daemon's ``_record_request`` merges this in)."""
+        out: Dict = {'frames': self.frames,
+                     'disconnected': self.disconnected}
+        if self.send_block_s_max:
+            out['send_block_ms_max'] = round(
+                self.send_block_s_max * 1e3, 3)
+            out['send_block_s_total'] = round(
+                self.send_block_s_total, 6)
+        return out

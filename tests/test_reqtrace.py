@@ -355,7 +355,7 @@ def test_engine_complete_writes_request_record(tmp_path, monkeypatch):
 
     def fake_request_complete(model_cfg, prompts, max_out_len, timeout,
                               request_id=None, timings=None,
-                              deadline=None):
+                              deadline=None, stream=None):
         time.sleep(0.055)   # the canned timings must fit in the wall
         timings['lease_wait_s'] = 0.002
         timings['roundtrip_s'] = 0.05

@@ -388,6 +388,49 @@ def test_doctor_overload_rules_from_fixture():
         assert f.get('fix')
 
 
+def test_doctor_autoscaler_and_stream_rules_from_fixture():
+    """ISSUE 20 satellites: a flapping autoscaler journal and slow
+    streaming consumers both surface as warn findings with journal /
+    request-record evidence."""
+    from opencompass_tpu.obs.doctor import diagnose
+    report = diagnose(FIXTURE)
+    rules = {f['rule']: f for f in report['findings']}
+    flap = rules['autoscaler_flapping']
+    assert flap['severity'] == 'warn'
+    joined = ' '.join(flap['evidence'])
+    assert 'tiny' in joined and 'reversal' in joined
+    assert flap['data']['reversals'] >= 2
+    bp = rules['stream_backpressure']
+    assert bp['severity'] == 'warn'
+    joined = ' '.join(bp['evidence'])
+    assert 'req-fixture0008' in joined
+    assert '(client disconnected)' in joined
+    assert bp['data']['worst_ms'] == 2400.0
+    for f in (flap, bp):
+        assert f.get('fix')
+
+
+def test_doctor_new_rules_silent_on_clean_data():
+    """A single slow reversal outside the flap window and fast SSE
+    sends produce no findings — the rules fire on pathology, not on
+    normal elasticity or healthy streams."""
+    from opencompass_tpu.obs import doctor
+    art = {
+        'autoscaler': [
+            {'v': 1, 'ts': 100.0, 'key': 'tiny', 'direction': 'up',
+             'from': 1, 'to': 2, 'reason': 'queue_eta'},
+            {'v': 1, 'ts': 100.0 + doctor.AUTOSCALER_FLAP_WINDOW_S + 1,
+             'key': 'tiny', 'direction': 'down', 'from': 2, 'to': 1,
+             'reason': 'idle'}],
+        'requests': [
+            {'request_id': 'r1',
+             'stream': {'frames': 5, 'send_block_ms_max': 12.0}},
+            {'request_id': 'r2'}],   # non-streamed record
+    }
+    assert doctor._rule_autoscaler_flapping(art) == []
+    assert doctor._rule_stream_backpressure(art) == []
+
+
 def test_doctor_cli_check_exit_codes(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS='cpu')
     r = subprocess.run(

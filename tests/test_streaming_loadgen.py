@@ -1,0 +1,518 @@
+"""ISSUE 20 front door: SSE token streaming, the elastic autoscaler's
+policy core, hot-prefix pinning, and the replay load generator.
+
+Unit tier: CompletionStreamSession delivery/identity/disconnect
+semantics, the pure ``decide()`` hysteresis+cooldown policy,
+``WorkerPool.retire_excess``, ``HotPrefixPinner`` bookkeeping, and the
+loadgen trace/arrival/summary math — all daemonless.
+
+Live tier (tier-1, one shared chaos daemon like
+``test_quick_scenarios_live``): streamed and buffered responses are
+token-identical with a *measured* first-byte ``ttft_s``, and a client
+that hangs up mid-stream leaves a ``degraded: client_disconnect``
+record while the daemon stays healthy."""
+import json
+import os.path as osp
+import socket
+import time
+
+import pytest
+
+from opencompass_tpu.serve.autoscaler import (AutoscalerConfig,
+                                              KeyState, decide,
+                                              instance_key)
+from opencompass_tpu.serve.pinner import HotPrefixPinner
+from opencompass_tpu.serve.stream import (SSE_DONE,
+                                          CompletionStreamSession,
+                                          sse_event)
+
+
+def _events(sends):
+    """Decode a list of raw SSE byte frames into payload dicts."""
+    out = []
+    for raw in sends:
+        if raw == SSE_DONE:
+            out.append('[DONE]')
+            continue
+        assert raw.startswith(b'data: ') and raw.endswith(b'\n\n')
+        out.append(json.loads(raw[len(b'data: '):].decode('utf-8')))
+    return out
+
+
+def _chunk_text(events):
+    return ''.join(c.get('text') or ''
+                   for e in events if isinstance(e, dict)
+                   for c in e.get('choices') or [])
+
+
+# -- CompletionStreamSession ------------------------------------------------
+
+def test_stream_session_tail_makes_concat_identical():
+    """finish() emits only each row's unstreamed tail, so the streamed
+    concatenation equals the buffered text whether zero, some, or all
+    pieces arrived as interim frames."""
+    sends = []
+    s = CompletionStreamSession('cmpl-x', 'm')
+    s.bind_send(sends.append)
+    s.on_frame({'row': 0, 'piece': 'tok '})
+    s.on_frame({'row': 0, 'piece': 'tok '})
+    s.finish({'completions': ['tok tok tok '], 'prompt_tokens': 2,
+              'completion_tokens': 3})
+    events = _events(sends)
+    assert events[-1] == '[DONE]'
+    assert _chunk_text(events) == 'tok tok tok '
+    final = events[-2]
+    assert final['usage']['total_tokens'] == 5
+    # stream_frames is stamped when the summary chunk is BUILT, i.e.
+    # before its own delivery bumps the counter
+    assert final['oct']['stream_frames'] == s.frames - 1
+    # delivery-side truth: measured first byte, ITL between frames
+    assert s.first_byte_s is not None and s.first_byte_s >= 0
+    assert len(s.itl_s) == 3   # 4 delivered frames -> 3 gaps
+    assert s.record_fields()['frames'] == 4
+
+    # dense path: no interim frames at all, whole text rides the tail
+    sends2 = []
+    s2 = CompletionStreamSession('cmpl-y', 'm')
+    s2.bind_send(sends2.append)
+    s2.finish({'completions': ['whole answer']})
+    assert _chunk_text(_events(sends2)) == 'whole answer'
+    assert s2.first_byte_s is not None
+
+
+def test_stream_session_disconnect_fires_abort_once_bound():
+    from opencompass_tpu.obs.promexport import ClientDisconnected
+
+    def dead_send(_chunk):
+        raise ClientDisconnected('gone')
+
+    aborts = []
+    s = CompletionStreamSession('cmpl-z', 'm')
+    s.bind_send(dead_send)
+    s.on_frame({'row': 0, 'piece': 'tok '})   # send raises -> mark dead
+    assert s.disconnected
+    # abort bound AFTER the disconnect must fire immediately
+    s.bind_abort(lambda: aborts.append(1))
+    assert aborts == [1]
+    # further frames are dropped without touching the socket
+    s.on_frame({'row': 0, 'piece': 'tok '})
+    s.finish({'completions': ['tok tok ']})
+    fields = s.record_fields()
+    assert fields['disconnected'] and fields['frames'] == 0
+
+
+def test_stream_session_error_event_shape():
+    sends = []
+    s = CompletionStreamSession('cmpl-e', 'm')
+    s.bind_send(sends.append)
+    s.send_error('budget exhausted', 'deadline_exceeded',
+                 phase='model_forward')
+    events = _events(sends)
+    assert events[-1] == '[DONE]'
+    assert events[0]['object'] == 'error'
+    assert events[0]['error']['type'] == 'deadline_exceeded'
+    assert events[0]['error']['phase'] == 'model_forward'
+
+
+def test_sse_event_single_line_framing():
+    raw = sse_event({'a': 1, 'b': 'x\ny'})   # newline survives as \n
+    assert raw.startswith(b'data: ') and raw.endswith(b'\n\n')
+    assert raw.count(b'\n') == 2   # JSON stays single-line
+
+
+# -- autoscaler policy core -------------------------------------------------
+
+def test_decide_hysteresis_cooldowns_and_bounds():
+    cfg = AutoscalerConfig(min_replicas=1, max_replicas=3,
+                           up_consecutive=2, down_consecutive=3,
+                           scale_up_cooldown_s=10.0,
+                           scale_down_cooldown_s=20.0,
+                           up_queue_eta_s=5.0, up_slot_util=0.8,
+                           down_slot_util=0.2)
+    st = KeyState(replicas=1)
+    hot = {'queue_eta_s': 9.0}
+    calm = {'queue_eta_s': 0.0, 'slot_util': 0.0, 'inflight': 0}
+
+    # one pressure read is not enough (hysteresis)
+    assert decide(hot, cfg, st, now=0.0) is None
+    d = decide(hot, cfg, st, now=1.0)
+    assert d and d['direction'] == 'up' and (d['from'], d['to']) == (1, 2)
+    assert d['reason'] == 'queue_eta'
+    # streak reset + cooldown: two more hot reads inside the window -> no
+    assert decide(hot, cfg, st, now=2.0) is None
+    assert decide(hot, cfg, st, now=3.0) is None
+    # past the up-cooldown the streak is satisfied again
+    d2 = decide(hot, cfg, st, now=12.0)
+    assert d2 and d2['to'] == 3
+    # at max_replicas pressure can never scale further
+    assert decide(hot, cfg, st, now=30.0) is None
+    assert decide(hot, cfg, st, now=31.0) is None
+
+    # idle shrinks only after down_consecutive calm reads AND the
+    # down-after-up guard (one full up-cooldown) has passed
+    for now in (32.0, 33.0):
+        assert decide(calm, cfg, st, now=now) is None
+    d3 = decide(calm, cfg, st, now=34.0)
+    assert d3 and d3['direction'] == 'down' and d3['to'] == 2
+    # down cooldown holds the next shrink
+    for now in (35.0, 36.0, 37.0, 38.0):
+        assert decide(calm, cfg, st, now=now) is None
+    d4 = decide(calm, cfg, st, now=60.0)
+    assert d4 and d4['to'] == 1
+    # at min_replicas idleness never shrinks further
+    for now in (61.0, 62.0, 63.0, 90.0):
+        assert decide(calm, cfg, st, now=now) is None
+    assert st.replicas == 1
+
+
+def test_decide_mixed_signal_resets_streaks_and_inflight_blocks_down():
+    cfg = AutoscalerConfig(up_consecutive=2, down_consecutive=2,
+                           up_slot_util=0.8, down_slot_util=0.3)
+    st = KeyState(replicas=2)
+    # busy-but-not-pressured (mid utilization) is neither hot nor idle
+    assert decide({'slot_util': 0.5}, cfg, st, now=0.0) is None
+    assert st.up_streak == 0 and st.down_streak == 0
+    # calm utilization but a held admission seat blocks the idle path
+    seat = {'slot_util': 0.0, 'inflight': 1, 'queue_eta_s': 0.0}
+    for now in (1.0, 2.0, 3.0):
+        assert decide(seat, cfg, st, now=now) is None
+    assert st.down_streak == 0
+
+
+def test_decide_breaker_open_is_pressure():
+    cfg = AutoscalerConfig(up_consecutive=1, max_replicas=2)
+    st = KeyState(replicas=1)
+    d = decide({'breakers_open': 1}, cfg, st, now=0.0)
+    assert d and d['reason'] == 'breaker_open' and d['to'] == 2
+
+
+def test_autoscaler_config_validation_and_instance_keys():
+    assert AutoscalerConfig.from_cfg(None) is None
+    with pytest.raises(ValueError, match='unknown autoscaler key'):
+        AutoscalerConfig.from_cfg({'max_replicas': 2, 'bogus': 1})
+    with pytest.raises(ValueError, match='must be a dict'):
+        AutoscalerConfig.from_cfg([1])
+    cfg = AutoscalerConfig.from_cfg({'min_replicas': 2,
+                                     'max_replicas': 1})
+    assert cfg.max_replicas >= cfg.min_replicas
+    assert instance_key('k', 0) == 'k'          # replica 0 IS the key
+    assert instance_key('k', 2) == 'k@r2'
+
+
+# -- WorkerPool.retire_excess ----------------------------------------------
+
+class _FakeHandle:
+    spawned = []
+
+    def __init__(self, env, log_path):
+        self.env, self.log_path = env, log_path
+        self.dead = False
+        self.proc = type('P', (), {
+            'pid': 4242, 'poll': staticmethod(lambda: None)})()
+        self.shutdowns = 0
+        _FakeHandle.spawned.append(self)
+
+    def request(self, msg, timeout=None):
+        return {'ok': True}
+
+    def request_watched(self, msg, **kw):
+        return self.request(msg)
+
+    def shutdown(self, timeout=10.0):
+        self.shutdowns += 1
+        self.dead = True
+        self.proc.poll = lambda: 0
+
+    def kill(self):
+        self.dead = True
+        self.proc.poll = lambda: 0
+
+
+@pytest.fixture()
+def fake_worker(monkeypatch):
+    from opencompass_tpu.runners import worker as workermod
+    _FakeHandle.spawned = []
+    monkeypatch.setattr(workermod, 'WorkerHandle', _FakeHandle)
+    return _FakeHandle
+
+
+def _spawn(chip_ids):
+    return {'CHIPS': ','.join(map(str, chip_ids))}, '/dev/null'
+
+
+def test_retire_excess_keeps_base_and_leased_replicas(fake_worker):
+    from opencompass_tpu.serve.scheduler import WorkerPool
+    pool = WorkerPool(idle_ttl_s=None)
+    base = pool.acquire('m1', _spawn)
+    r1 = pool.acquire('m1@r1', _spawn)
+    r2 = pool.acquire('m1@r2', _spawn)
+    pool.acquire('other@r1', _spawn)      # different base key: untouched
+    pool.release(base)
+    pool.release(r2)                       # r1 stays leased
+    retired = pool.retire_excess('m1', keep=1)
+    assert retired == ['m1@r2']            # r1 leased, base never retired
+    assert r2.handle.shutdowns == 1
+    pool.release(r1)
+    assert pool.retire_excess('m1', keep=1) == ['m1@r1']
+    # keep clamps at 1: replica 0 (the bare key) is not an @r instance
+    assert pool.retire_excess('m1', keep=0) == []
+    assert pool.resident_count == 2        # m1 + other@r1
+    pool.shutdown()
+
+
+# -- hot-prefix pinner ------------------------------------------------------
+
+def test_pinner_pins_hot_prefix_and_unpins_lru():
+    p = HotPrefixPinner(min_count=3, max_pinned=2, prefix_chars=8)
+    sys_a, sys_b, sys_c = 'AAAAAAAA-x', 'BBBBBBBB-y', 'CCCCCCCC-z'
+    assert p.observe('k', [sys_a], now=1.0) == ([], [])
+    assert p.observe('k', [sys_a], now=2.0) == ([], [])
+    to_pin, to_unpin = p.observe('k', [sys_a], now=3.0)
+    assert to_pin == [sys_a[:8]] and not to_unpin
+    # a pinned prefix refreshes recency instead of recounting
+    assert p.observe('k', [sys_a], now=10.0) == ([], [])
+    for now in (4.0, 5.0, 6.0):
+        p.observe('k', [sys_b], now=now)
+    # third distinct hot prefix displaces the LRU one (sys_b: older)
+    for now in (7.0, 8.0):
+        p.observe('k', [sys_c], now=now)
+    to_pin, to_unpin = p.observe('k', [sys_c], now=9.0)
+    assert to_pin == [sys_c[:8]]
+    assert to_unpin == [sys_b[:8]]
+    snap = p.snapshot()
+    assert snap['pinned'] == {'k': 2}
+    assert snap['pins'] == 3 and snap['unpins'] == 1
+    # counts only — never raw prompt text
+    assert sys_a[:8] not in json.dumps(snap)
+
+
+def test_pinner_bounds_candidate_table():
+    p = HotPrefixPinner(min_count=99, max_pinned=1, prefix_chars=64)
+    for i in range(200):
+        p.observe('k', [f'unique prompt {i:04d}'], now=float(i))
+    assert len(p._counts['k']) <= 64 * p.max_pinned
+
+
+# -- loadgen math -----------------------------------------------------------
+
+def test_load_trace_reads_access_shaped_rows(tmp_path):
+    from opencompass_tpu.loadgen.replay import load_trace
+    path = tmp_path / 'access.jsonl'
+    rows = [
+        {'v': 1, 'ts': 30.0, 'method': 'POST',
+         'path': '/v1/completions', 'status': 200, 'model': 'm'},
+        {'v': 1, 'ts': 10.0, 'method': 'POST',
+         'path': '/v1/completions', 'status': 200, 'model': 'm'},
+        {'v': 1, 'ts': 20.0, 'method': 'GET', 'path': '/healthz'},
+        {'ts': 15.0, 'prompt': 'hand-written row', 'model': 'm',
+         'max_tokens': 4},
+    ]
+    path.write_text('\n'.join(json.dumps(r) for r in rows) + '\n')
+    specs = load_trace(str(path))
+    # completions + prompt-bearing rows only, sorted by ts
+    assert [s['ts'] for s in specs] == [10.0, 15.0, 30.0]
+    assert specs[1]['prompt'] == 'hand-written row'
+    assert specs[1]['max_tokens'] == 4
+    # promptless rows synthesize distinct prompts
+    assert specs[0]['prompt'] != specs[2]['prompt']
+    # rows with no model anywhere are skipped; --model fills the gap
+    path2 = tmp_path / 'nomodel.jsonl'
+    path2.write_text(json.dumps({'ts': 1.0, 'method': 'POST',
+                                 'path': '/v1/completions'}) + '\n')
+    assert load_trace(str(path2)) == []
+    assert load_trace(str(path2), model='m')[0]['model'] == 'm'
+
+
+def test_build_arrivals_replay_compression_and_poisson_determinism():
+    from opencompass_tpu.loadgen.replay import (build_arrivals,
+                                                synth_trace)
+    trace = synth_trace(5, 'm', rate=0.5)        # ts: 0, 2, 4, 6, 8
+    replayed = build_arrivals(trace, mode='replay', speedup=4.0)
+    assert replayed == [0.0, 0.5, 1.0, 1.5, 2.0]
+    a = build_arrivals(trace, mode='poisson', speedup=10.0, seed=7)
+    b = build_arrivals(trace, mode='poisson', speedup=10.0, seed=7)
+    assert a == b and a[0] == 0.0                # seeded => identical
+    assert a != build_arrivals(trace, mode='poisson', speedup=10.0,
+                               seed=8)
+    # mean gap ~ 1/(base_rate*speedup) = 0.2s: sanity-band the span
+    assert 0.05 < a[-1] / (len(a) - 1) < 1.0
+    with pytest.raises(ValueError, match='unknown arrival mode'):
+        build_arrivals(trace, mode='uniform')
+    assert build_arrivals([], mode='replay') == []
+
+
+def test_synth_trace_prefix_and_distinct_cycle():
+    from opencompass_tpu.loadgen.replay import synth_trace
+    trace = synth_trace(4, 'm', rate=2.0, distinct=2, prefix='Q: row')
+    assert [s['ts'] for s in trace] == [0.0, 0.5, 1.0, 1.5]
+    assert trace[0]['prompt'].startswith('Q: row')
+    assert trace[0]['prompt'] == trace[2]['prompt']   # cycle of 2
+    assert trace[0]['prompt'] != trace[1]['prompt']
+
+
+def test_summarize_percentiles_and_status_counts():
+    from opencompass_tpu.loadgen.replay import summarize
+    results = [
+        {'status': 200, 'ok': True, 'ttft_s': 0.010,
+         'itl_s': [0.004, 0.006], 'latency_s': 0.1, 'frames': 3,
+         'chars': 12},
+        {'status': 200, 'ok': True, 'ttft_s': 0.030, 'itl_s': [0.008],
+         'latency_s': 0.2, 'frames': 2, 'chars': 8},
+        {'status': 429, 'ok': False, 'ttft_s': None, 'itl_s': [],
+         'frames': 0, 'chars': 0},
+        {'status': 0, 'ok': False, 'error': 'boom', 'frames': 0,
+         'chars': 0},
+    ]
+    rep = summarize(results, wall_s=2.0)
+    assert rep['requests'] == 4 and rep['completed'] == 2
+    assert rep['errors'] == 2
+    assert rep['status_counts'] == {'200': 2, '429': 1,
+                                    'transport': 1}
+    assert rep['sustained_rps'] == 1.0
+    assert rep['frames_total'] == 5 and rep['chars_total'] == 20
+    assert rep['ttft_ms']['p50'] == 10.0
+    assert rep['ttft_ms']['p99'] == 30.0 and rep['ttft_ms']['n'] == 2
+    assert rep['itl_ms']['p99'] == 8.0
+    empty = summarize([], wall_s=0.0)
+    assert empty['sustained_rps'] is None
+    assert empty['ttft_ms']['p50'] is None
+
+
+def test_loadgen_cli_check_on_dead_target(capsys):
+    """Nothing listening: every request is a transport error and
+    --check exits non-zero with the report still printed."""
+    from opencompass_tpu.loadgen.cli import main
+    rc = main(['--target', 'http://127.0.0.1:9', '--model', 'm',
+               '--requests', '2', '--rate', '50', '--timeout', '2',
+               '--check'])
+    assert rc != 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep['completed'] == 0 and rep['errors'] == 2
+
+
+# -- live daemon: streaming identity + disconnect cleanup -------------------
+
+@pytest.fixture(scope='module')
+def live_daemon(tmp_path_factory):
+    from opencompass_tpu.analysis.chaos import ChaosDaemon
+    workdir = tmp_path_factory.mktemp('stream-daemon')
+    daemon = ChaosDaemon(str(workdir), max_inflight=4)
+    daemon.start()
+    yield daemon
+    daemon.stop()
+
+
+def _read_sse(host, port, body, close_after_frames=None, timeout=60.0):
+    """Minimal SSE client over a raw socket: returns (status, events).
+    With ``close_after_frames`` it RST-closes the connection once that
+    many data events arrived (the mid-stream hang-up)."""
+    payload = json.dumps(body).encode('utf-8')
+    sock = socket.create_connection((host, port), timeout=timeout)
+    try:
+        sock.sendall(
+            b'POST /v1/completions HTTP/1.1\r\n'
+            + f'Host: {host}:{port}\r\n'.encode()
+            + f'Content-Length: {len(payload)}\r\n'.encode()
+            + b'Content-Type: application/json\r\n\r\n' + payload)
+        buf = b''
+        while b'\r\n\r\n' not in buf:
+            buf += sock.recv(4096)
+        head, buf = buf.split(b'\r\n\r\n', 1)
+        status = int(head.split(b' ', 2)[1])
+        events = []
+        while True:
+            while b'\n\n' not in buf:
+                chunk = sock.recv(4096)
+                if not chunk:
+                    return status, events
+                buf += chunk
+            frame, buf = buf.split(b'\n\n', 1)
+            for line in frame.splitlines():
+                if not line.startswith(b'data: '):
+                    continue
+                data = line[len(b'data: '):]
+                if data == b'[DONE]':
+                    return status, events
+                events.append(json.loads(data.decode('utf-8')))
+            if close_after_frames is not None \
+                    and len(events) >= close_after_frames:
+                # RST on close: the daemon's next flush must fail
+                sock.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                b'\x01\x00\x00\x00\x00\x00\x00\x00')
+                return status, events
+    finally:
+        sock.close()
+
+
+def test_streamed_identical_to_buffered_with_measured_ttft(live_daemon):
+    """Acceptance: streamed and non-streamed greedy responses are
+    token-identical, and the streamed record's ttft_s is a measured
+    first-byte delivery timestamp, not the estimate."""
+    from opencompass_tpu.utils.fileio import iter_jsonl_records
+    host, port = '127.0.0.1', int(live_daemon.base.rsplit(':', 1)[1])
+    prompt = 'Q: stream identity check'
+    status, events = _read_sse(
+        host, port, {'model': 'fake-chaos', 'prompt': prompt,
+                     'max_tokens': 8, 'stream': True})
+    assert status == 200
+    streamed_text = _chunk_text(events)
+    final = events[-1]
+    assert final['oct']['stream_frames'] >= 2   # engine-paced pieces
+    assert final['oct']['ttft_seconds'] is not None
+    assert final['usage']['completion_tokens'] is not None
+    cmpl_id = final['oct']['id']
+
+    buffered = live_daemon.request(prompt, max_tokens=8)
+    assert buffered.code == 200
+    buffered_text = buffered.payload['choices'][0]['text']
+    assert streamed_text == buffered_text and streamed_text.strip()
+
+    # the durable record: measured first-byte ttft + stream counters
+    req_path = osp.join(live_daemon.serve_obs_dir, 'requests.jsonl')
+    rec = None
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline and rec is None:
+        rec = next((r for r in iter_jsonl_records(req_path)
+                    if r.get('id') == cmpl_id), None)
+        time.sleep(0.2)
+    assert rec, f'no record for {cmpl_id}'
+    assert rec['ttft_source'] == 'stream_first_byte'
+    assert rec['ttft_s'] == pytest.approx(
+        final['oct']['ttft_seconds'], abs=1e-6)
+    assert 'ttft_estimated' not in rec
+    # the record is cut when the worker round-trip returns (before the
+    # summary chunk ships), so it counts the interim frames
+    assert 2 <= rec['stream']['frames'] \
+        <= final['oct']['stream_frames']
+    assert not rec['stream']['disconnected']
+
+
+def test_client_disconnect_aborts_and_records(live_daemon):
+    """Regression: a consumer hanging up mid-stream must cancel the
+    engine rows (no slot leak) and land a ``degraded:
+    client_disconnect`` record — and the daemon keeps serving."""
+    from opencompass_tpu.utils.fileio import iter_jsonl_records
+    host, port = '127.0.0.1', int(live_daemon.base.rsplit(':', 1)[1])
+    status, events = _read_sse(
+        host, port, {'model': 'fake-chaos',
+                     'prompt': 'Q: disconnect me', 'max_tokens': 8,
+                     'stream': True},
+        close_after_frames=1)
+    assert status == 200 and len(events) >= 1
+
+    req_path = osp.join(live_daemon.serve_obs_dir, 'requests.jsonl')
+    rec = None
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline and rec is None:
+        rec = next(
+            (r for r in iter_jsonl_records(req_path)
+             if r.get('degraded') == 'client_disconnect'), None)
+        time.sleep(0.2)
+    assert rec, 'no client_disconnect record after the hang-up'
+    assert rec['stream']['disconnected']
+    # availability SLO must not count the client's own hang-up
+    assert rec.get('slo_excluded') or rec.get('status') != 'error'
+    # daemon healthy and still serving afterwards
+    assert live_daemon.health().code == 200
+    after = live_daemon.request('Q: after disconnect', max_tokens=4)
+    assert after.code == 200
